@@ -1,0 +1,34 @@
+"""dynamo-tpu: a TPU-native distributed LLM inference serving framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of NVIDIA Dynamo
+(reference: /root/reference, surveyed in SURVEY.md): disaggregated
+prefill/decode serving, KV-aware routing, multi-tier KV block management,
+an OpenAI-compatible frontend, and a planner for dynamic scaling — built
+for TPU device meshes (ICI/DCN) instead of CUDA/NVLink/RDMA.
+
+Layer map (TPU-native analogue of reference layer map, SURVEY.md §1):
+
+  runtime/    distributed runtime: component model, streaming engines,
+              pipeline graph, push routers        (≈ lib/runtime, Rust)
+  store/      control plane: KV+lease+watch, pub/sub, queues, object
+              store — self-hosted, no external etcd/NATS (≈ L0 infra)
+  tokens.py   token blocks + chained hashing      (≈ lib/llm/src/tokens.rs)
+  protocols/  OpenAI protocol types, SSE, deltas  (≈ lib/llm/src/protocols)
+  preprocessor/ chat templates + tokenization     (≈ lib/llm/src/preprocessor.rs)
+  backend.py  incremental detokenize + stop logic (≈ lib/llm/src/backend.rs)
+  http/       OpenAI HTTP service                 (≈ lib/llm/src/http)
+  kv_router/  radix indexer + KV-aware scheduler  (≈ lib/llm/src/kv_router)
+  block_manager/ tiered KV block pools + offload  (≈ lib/llm/src/block_manager)
+  engine/     native JAX inference engine (continuous batching, paged KV)
+  models/     flagship model families (Llama, Mixtral, ...)
+  ops/        Pallas TPU kernels (paged attention, block copy, rearrange)
+  parallel/   mesh/sharding utilities, ring attention, collectives
+  disagg/     disaggregated prefill/decode + KV transfer agent
+  planner/    dynamic scaling
+  sdk/        @service decorators + serve/run CLI (≈ deploy/sdk)
+
+Heavy imports (jax, transformers) are deferred: importing ``dynamo_tpu``
+itself is cheap so control-plane tools start fast.
+"""
+
+__version__ = "0.1.0"

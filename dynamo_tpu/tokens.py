@@ -1,0 +1,213 @@
+"""Token blocks and chained content hashing.
+
+TPU-native analogue of the reference's token sequence machinery
+(reference: lib/llm/src/tokens.rs:46-830 — ``Tokens``, ``TokenBlock``,
+``PartialTokenBlock``, ``TokenBlockSequence`` with chained xxh3 sequence
+hashes). The hashes here are the currency of the whole KV system: the KV
+router's radix indexer, the block manager's reuse pools, and the KV event
+plane all key on ``(block_hash, sequence_hash)`` pairs.
+
+Design notes (deliberately different from the reference where it helps):
+- Hashing is vectorised over numpy buffers; a whole prompt is hashed in one
+  pass per block rather than token-at-a-time.
+- ``SequenceHash`` chaining: ``seq_hash[i] = xxh3_64(u64le(seq_hash[i-1]) ||
+  u64le(block_hash[i]))`` with the first block seeded by the salt. This keeps
+  the "same prefix ⇒ same chained hash" property the radix tree needs.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Iterable, Sequence
+
+import numpy as np
+import xxhash
+
+# Salt seed for all block hashes. The reference salts its xxh3 hashes too
+# (lib/llm/src/tokens.rs: compute_hash_v2 w/ salt) so that unrelated
+# deployments don't collide in shared infrastructure.
+DEFAULT_SALT: int = 0x5D1_7B0_057  # "dynamo-tpu" default salt seed
+
+TokenId = int
+
+
+def compute_block_hash(tokens: Sequence[int] | np.ndarray, salt: int = DEFAULT_SALT) -> int:
+    """Content hash of one block of token ids (u32 little-endian buffer)."""
+    arr = np.ascontiguousarray(np.asarray(tokens, dtype=np.uint32))
+    return xxhash.xxh3_64_intdigest(arr.tobytes(), seed=salt)
+
+
+def chain_hash(parent_seq_hash: int | None, block_hash: int, salt: int = DEFAULT_SALT) -> int:
+    """Chained sequence hash: parent ∘ block → new sequence hash."""
+    if parent_seq_hash is None:
+        return xxhash.xxh3_64_intdigest(struct.pack("<Q", block_hash), seed=salt)
+    return xxhash.xxh3_64_intdigest(
+        struct.pack("<QQ", parent_seq_hash, block_hash), seed=salt
+    )
+
+
+def compute_block_hashes_for_seq(
+    tokens: Sequence[int] | np.ndarray, block_size: int, salt: int = DEFAULT_SALT
+) -> list[int]:
+    """Block hashes for every *complete* block of a token sequence.
+
+    Analogue of the reference's ``compute_block_hash_for_seq``
+    (lib/llm/src/kv_router/indexer.rs:122) — used when routing a new request:
+    the router hashes the prompt into block hashes and walks the radix tree.
+    """
+    arr = np.ascontiguousarray(np.asarray(tokens, dtype=np.uint32))
+    n_blocks = len(arr) // block_size
+    return [
+        compute_block_hash(arr[i * block_size : (i + 1) * block_size], salt)
+        for i in range(n_blocks)
+    ]
+
+
+def compute_seq_hashes(block_hashes: Iterable[int], salt: int = DEFAULT_SALT) -> list[int]:
+    """Chained sequence hashes for a list of block hashes."""
+    out: list[int] = []
+    parent: int | None = None
+    for bh in block_hashes:
+        parent = chain_hash(parent, bh, salt)
+        out.append(parent)
+    return out
+
+
+@dataclass(frozen=True)
+class TokenBlock:
+    """An immutable, complete block of ``block_size`` tokens.
+
+    ``sequence_hash`` identifies the whole prefix ending at this block;
+    ``block_hash`` identifies only this block's contents.
+    (reference: lib/llm/src/tokens.rs TokenBlock)
+    """
+
+    tokens: tuple[int, ...]
+    block_hash: int
+    sequence_hash: int
+    parent_sequence_hash: int | None
+
+    @property
+    def block_size(self) -> int:
+        return len(self.tokens)
+
+
+@dataclass
+class PartialTokenBlock:
+    """The mutable tail block of a sequence; commits into a TokenBlock."""
+
+    block_size: int
+    salt: int = DEFAULT_SALT
+    tokens: list[int] = field(default_factory=list)
+    parent_sequence_hash: int | None = None
+
+    def push(self, token: int) -> TokenBlock | None:
+        """Append one token; returns a completed TokenBlock when full."""
+        self.tokens.append(int(token))
+        if len(self.tokens) == self.block_size:
+            return self._commit()
+        return None
+
+    def _commit(self) -> TokenBlock:
+        bh = compute_block_hash(self.tokens, self.salt)
+        sh = chain_hash(self.parent_sequence_hash, bh, self.salt)
+        block = TokenBlock(
+            tokens=tuple(self.tokens),
+            block_hash=bh,
+            sequence_hash=sh,
+            parent_sequence_hash=self.parent_sequence_hash,
+        )
+        self.tokens = []
+        self.parent_sequence_hash = sh
+        return block
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+
+class TokenBlockSequence:
+    """A token sequence chunked into hashed blocks + a partial tail.
+
+    Supports append/extend/truncate/unwind like the reference
+    (lib/llm/src/tokens.rs TokenBlockSequence). Truncation rebuilds the
+    partial tail from the kept tokens; block hashes for the kept complete
+    blocks are unchanged (content-addressed).
+    """
+
+    def __init__(
+        self,
+        tokens: Sequence[int] | None = None,
+        block_size: int = 16,
+        salt: int = DEFAULT_SALT,
+    ):
+        if block_size <= 0:
+            raise ValueError(f"block_size must be positive, got {block_size}")
+        self.block_size = block_size
+        self.salt = salt
+        self.blocks: list[TokenBlock] = []
+        self.partial = PartialTokenBlock(block_size=block_size, salt=salt)
+        if tokens is not None:
+            self.extend(tokens)
+
+    # -- mutation ---------------------------------------------------------
+    def append(self, token: int) -> TokenBlock | None:
+        """Append a single token; returns the newly completed block, if any."""
+        block = self.partial.push(token)
+        if block is not None:
+            self.blocks.append(block)
+        return block
+
+    def extend(self, tokens: Sequence[int]) -> list[TokenBlock]:
+        """Append many tokens; returns all newly completed blocks."""
+        new_blocks: list[TokenBlock] = []
+        for t in tokens:
+            b = self.append(t)
+            if b is not None:
+                new_blocks.append(b)
+        return new_blocks
+
+    def truncate(self, length: int) -> None:
+        """Keep only the first ``length`` tokens."""
+        if length < 0 or length > len(self):
+            raise ValueError(f"truncate length {length} out of range 0..{len(self)}")
+        tokens = self.all_tokens()[:length]
+        n_keep = length // self.block_size
+        self.blocks = self.blocks[:n_keep]
+        parent = self.blocks[-1].sequence_hash if self.blocks else None
+        self.partial = PartialTokenBlock(
+            block_size=self.block_size, salt=self.salt, parent_sequence_hash=parent
+        )
+        for t in tokens[n_keep * self.block_size :]:
+            self.partial.push(t)
+
+    def unwind(self, n: int = 1) -> None:
+        """Remove the last ``n`` tokens (e.g. speculative-decode rollback)."""
+        self.truncate(len(self) - n)
+
+    # -- views ------------------------------------------------------------
+    def all_tokens(self) -> list[int]:
+        out: list[int] = []
+        for b in self.blocks:
+            out.extend(b.tokens)
+        out.extend(self.partial.tokens)
+        return out
+
+    def block_hashes(self) -> list[int]:
+        return [b.block_hash for b in self.blocks]
+
+    def sequence_hashes(self) -> list[int]:
+        return [b.sequence_hash for b in self.blocks]
+
+    @property
+    def num_complete_blocks(self) -> int:
+        return len(self.blocks)
+
+    def __len__(self) -> int:
+        return len(self.blocks) * self.block_size + len(self.partial)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"TokenBlockSequence(len={len(self)}, blocks={len(self.blocks)}, "
+            f"partial={len(self.partial)}, block_size={self.block_size})"
+        )

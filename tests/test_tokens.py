@@ -1,0 +1,101 @@
+"""Tests for token block hashing (≈ reference lib/llm/src/tokens.rs tests)."""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.tokens import (
+    TokenBlockSequence,
+    chain_hash,
+    compute_block_hash,
+    compute_block_hashes_for_seq,
+    compute_seq_hashes,
+)
+
+
+def test_block_hash_deterministic():
+    a = compute_block_hash([1, 2, 3, 4])
+    b = compute_block_hash(np.array([1, 2, 3, 4], dtype=np.uint32))
+    assert a == b
+    assert compute_block_hash([1, 2, 3, 5]) != a
+
+
+def test_salt_changes_hash():
+    assert compute_block_hash([1, 2, 3], salt=1) != compute_block_hash([1, 2, 3], salt=2)
+
+
+def test_chained_seq_hash_prefix_property():
+    """Same prefix => same chained hashes; divergence changes all later ones."""
+    toks_a = list(range(64))
+    toks_b = list(range(48)) + [999] + list(range(49, 64))
+    ha = compute_block_hashes_for_seq(toks_a, 16)
+    hb = compute_block_hashes_for_seq(toks_b, 16)
+    assert ha[:3] == hb[:3]
+    assert ha[3] != hb[3]
+    sa = compute_seq_hashes(ha)
+    sb = compute_seq_hashes(hb)
+    assert sa[:3] == sb[:3]
+    assert sa[3] != sb[3]
+
+
+def test_seq_hash_position_sensitivity():
+    """Identical block contents at different positions hash differently (chained)."""
+    toks = [7] * 32
+    bh = compute_block_hashes_for_seq(toks, 16)
+    assert bh[0] == bh[1]  # content hash identical
+    sh = compute_seq_hashes(bh)
+    assert sh[0] != sh[1]  # chained hash differs
+
+
+def test_sequence_append_extend():
+    seq = TokenBlockSequence(block_size=4)
+    assert len(seq) == 0
+    completed = seq.extend([1, 2, 3])
+    assert completed == []
+    b = seq.append(4)
+    assert b is not None
+    assert b.tokens == (1, 2, 3, 4)
+    assert seq.num_complete_blocks == 1
+    assert len(seq) == 4
+    seq.extend([5, 6, 7, 8, 9])
+    assert seq.num_complete_blocks == 2
+    assert len(seq) == 9
+    assert seq.all_tokens() == [1, 2, 3, 4, 5, 6, 7, 8, 9]
+
+
+def test_sequence_matches_batch_hashing():
+    toks = list(range(100))
+    seq = TokenBlockSequence(toks, block_size=16)
+    assert seq.block_hashes() == compute_block_hashes_for_seq(toks, 16)
+    assert seq.sequence_hashes() == compute_seq_hashes(seq.block_hashes())
+
+
+def test_truncate_and_unwind():
+    toks = list(range(40))
+    seq = TokenBlockSequence(toks, block_size=16)
+    seq.truncate(20)
+    assert seq.all_tokens() == toks[:20]
+    assert seq.num_complete_blocks == 1
+    # hashes of kept blocks unchanged
+    assert seq.block_hashes() == compute_block_hashes_for_seq(toks[:20], 16)
+    # re-extending reproduces the original hashes
+    seq.extend(toks[20:])
+    assert seq.block_hashes() == compute_block_hashes_for_seq(toks, 16)
+    seq.unwind(8)
+    assert len(seq) == 32
+    assert seq.num_complete_blocks == 2
+
+
+def test_truncate_rebuilds_partial_parent():
+    seq = TokenBlockSequence(list(range(33)), block_size=16)
+    seq.truncate(17)
+    seq.extend(list(range(17, 33)))
+    ref = TokenBlockSequence(list(range(33)), block_size=16)
+    assert seq.sequence_hashes() == ref.sequence_hashes()
+
+
+def test_invalid_args():
+    with pytest.raises(ValueError):
+        TokenBlockSequence(block_size=0)
+    seq = TokenBlockSequence([1, 2, 3], block_size=2)
+    with pytest.raises(ValueError):
+        seq.truncate(10)
