@@ -1,0 +1,24 @@
+"""Wire protocols: OpenAI-compatible types, SSE codec, streaming envelopes.
+
+TPU-native analogue of the reference's protocol layer
+(reference: lib/llm/src/protocols/*.rs — openai types, codec.rs SSE,
+common.rs sampling/stop options, annotated.rs envelope).
+"""
+
+from dynamo_tpu.protocols.annotated import Annotated
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    OutputOptions,
+    SamplingOptions,
+    StopConditions,
+)
+
+__all__ = [
+    "Annotated",
+    "FinishReason",
+    "LLMEngineOutput",
+    "OutputOptions",
+    "SamplingOptions",
+    "StopConditions",
+]

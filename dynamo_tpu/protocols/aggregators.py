@@ -1,0 +1,140 @@
+"""Stream → full-response aggregation.
+
+Analogue of the reference's delta aggregators
+(reference: lib/llm/src/protocols/openai/chat_completions/aggregator.rs,
+completions/aggregator.rs): fold a stream of chunks into the single
+non-streaming response object, for clients that set ``stream=false``.
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, Iterable
+
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionChunk,
+    ChatCompletionChoice,
+    ChatCompletionResponse,
+    ChatMessage,
+    CompletionChoice,
+    CompletionResponse,
+    Usage,
+)
+
+
+class ChatAggregator:
+    def __init__(self) -> None:
+        self._id: str | None = None
+        self._model: str | None = None
+        self._created: int = 0
+        self._texts: dict[int, list[str]] = {}
+        self._roles: dict[int, str] = {}
+        self._finish: dict[int, str | None] = {}
+        self._usage: Usage | None = None
+
+    def push(self, chunk: ChatCompletionChunk) -> None:
+        self._id = self._id or chunk.id
+        self._model = self._model or chunk.model
+        self._created = self._created or chunk.created
+        if chunk.usage is not None:
+            self._usage = chunk.usage
+        for choice in chunk.choices:
+            idx = choice.index
+            if choice.delta.role:
+                self._roles[idx] = choice.delta.role
+            if choice.delta.content:
+                self._texts.setdefault(idx, []).append(choice.delta.content)
+            if choice.finish_reason is not None:
+                self._finish[idx] = choice.finish_reason
+
+    def response(self) -> ChatCompletionResponse:
+        indices = sorted(set(self._texts) | set(self._finish) | set(self._roles) | {0})
+        choices = [
+            ChatCompletionChoice(
+                index=i,
+                message=ChatMessage(
+                    role=self._roles.get(i, "assistant"),
+                    content="".join(self._texts.get(i, [])),
+                ),
+                finish_reason=self._finish.get(i),
+            )
+            for i in indices
+        ]
+        return ChatCompletionResponse(
+            id=self._id or "chatcmpl-empty",
+            created=self._created,
+            model=self._model or "",
+            choices=choices,
+            usage=self._usage,
+        )
+
+    @classmethod
+    def aggregate(cls, chunks: Iterable[ChatCompletionChunk]) -> ChatCompletionResponse:
+        agg = cls()
+        for c in chunks:
+            agg.push(c)
+        return agg.response()
+
+    @classmethod
+    async def aggregate_async(
+        cls, chunks: AsyncIterator[ChatCompletionChunk]
+    ) -> ChatCompletionResponse:
+        agg = cls()
+        async for c in chunks:
+            agg.push(c)
+        return agg.response()
+
+
+class CompletionAggregator:
+    def __init__(self) -> None:
+        self._id: str | None = None
+        self._model: str | None = None
+        self._created: int = 0
+        self._texts: dict[int, list[str]] = {}
+        self._finish: dict[int, str | None] = {}
+        self._usage: Usage | None = None
+
+    def push(self, chunk: CompletionResponse) -> None:
+        self._id = self._id or chunk.id
+        self._model = self._model or chunk.model
+        self._created = self._created or chunk.created
+        if chunk.usage is not None:
+            self._usage = chunk.usage
+        for choice in chunk.choices:
+            if choice.text:
+                self._texts.setdefault(choice.index, []).append(choice.text)
+            if choice.finish_reason is not None:
+                self._finish[choice.index] = choice.finish_reason
+
+    def response(self) -> CompletionResponse:
+        indices = sorted(set(self._texts) | set(self._finish) | {0})
+        choices = [
+            CompletionChoice(
+                index=i,
+                text="".join(self._texts.get(i, [])),
+                finish_reason=self._finish.get(i),
+            )
+            for i in indices
+        ]
+        return CompletionResponse(
+            id=self._id or "cmpl-empty",
+            created=self._created,
+            model=self._model or "",
+            choices=choices,
+            usage=self._usage,
+        )
+
+    @classmethod
+    def aggregate(cls, chunks: Iterable[CompletionResponse]) -> CompletionResponse:
+        agg = cls()
+        for c in chunks:
+            agg.push(c)
+        return agg.response()
+
+    @classmethod
+    async def aggregate_async(
+        cls, chunks: AsyncIterator[CompletionResponse]
+    ) -> CompletionResponse:
+        agg = cls()
+        async for c in chunks:
+            agg.push(c)
+        return agg.response()

@@ -1,0 +1,48 @@
+"""Annotated: the SSE-able streaming envelope.
+
+Analogue of the reference's Annotated<R>
+(lib/runtime/src/protocols/annotated.rs:168): every item on a response
+stream carries optional ``data`` plus SSE metadata (event name, comments,
+id). Errors travel in-band as ``event="error"`` so a stream can terminate
+with a structured error instead of a broken connection.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Generic, Optional, TypeVar
+
+from pydantic import BaseModel, Field
+
+T = TypeVar("T")
+
+
+class Annotated(BaseModel, Generic[T]):
+    data: Optional[T] = None
+    id: Optional[str] = None
+    event: Optional[str] = None
+    comment: list[str] = Field(default_factory=list)
+
+    @classmethod
+    def from_data(cls, data: T) -> "Annotated[T]":
+        return cls(data=data)
+
+    @classmethod
+    def from_error(cls, message: str) -> "Annotated[T]":
+        return cls(event="error", comment=[message])
+
+    @classmethod
+    def from_annotation(cls, name: str, value: Any) -> "Annotated[T]":
+        """Out-of-band annotation events (e.g. timing traces) requested via
+        request ``annotations`` (reference: nvext annotations)."""
+        import json
+
+        return cls(event=name, comment=[json.dumps(value)])
+
+    @property
+    def is_error(self) -> bool:
+        return self.event == "error"
+
+    def error_message(self) -> Optional[str]:
+        if not self.is_error:
+            return None
+        return "; ".join(self.comment) if self.comment else "unknown error"

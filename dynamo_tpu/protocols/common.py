@@ -1,0 +1,124 @@
+"""Engine-facing protocol types shared by all frontends.
+
+Analogue of the reference's internal request/response model
+(reference: lib/llm/src/protocols/common.rs — SamplingOptions,
+StopConditions, and lib/llm/src/protocols/common/llm_backend.rs —
+BackendInput/BackendOutput/LLMEngineOutput). Frontend-specific types
+(OpenAI chat/completions) are *adapted into* these; engines only ever see
+these types, which keeps every engine frontend-agnostic.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Optional
+
+from pydantic import BaseModel, Field
+
+
+class FinishReason(str, enum.Enum):
+    STOP = "stop"            # hit a stop token / stop string
+    LENGTH = "length"        # hit max_tokens / context limit
+    CANCELLED = "cancelled"  # client disconnected or kill-signalled
+    ERROR = "error"
+    CONTENT_FILTER = "content_filter"
+
+
+class SamplingOptions(BaseModel):
+    """Sampling knobs, engine-agnostic (reference: common.rs SamplingOptions)."""
+
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    min_p: Optional[float] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    repetition_penalty: Optional[float] = None
+    seed: Optional[int] = None
+    n: int = 1
+    use_greedy: bool = False
+
+    def normalized(self) -> "SamplingOptions":
+        """Resolve greedy mode: temperature<=0 means greedy decoding."""
+        s = self.model_copy()
+        if s.temperature is not None and s.temperature <= 0.0:
+            s.use_greedy = True
+            s.temperature = None
+        return s
+
+
+class StopConditions(BaseModel):
+    """Stop criteria (reference: common.rs StopConditions).
+
+    ``stop_token_ids_hidden`` stop generation and are excluded from output;
+    ``stop`` strings are matched against the detokenized stream.
+    """
+
+    max_tokens: Optional[int] = None
+    stop: list[str] = Field(default_factory=list)
+    stop_token_ids_hidden: list[int] = Field(default_factory=list)
+    min_tokens: Optional[int] = None
+    ignore_eos: bool = False
+
+    def apply_ignore_eos(self) -> "StopConditions":
+        if self.ignore_eos:
+            s = self.model_copy()
+            s.stop = []
+            s.stop_token_ids_hidden = []
+            return s
+        return self
+
+
+class OutputOptions(BaseModel):
+    """What the caller wants back beyond text (reference: common.rs)."""
+
+    logprobs: Optional[int] = None
+    echo: bool = False
+    skip_special_tokens: bool = True
+
+
+class PreprocessedRequest(BaseModel):
+    """Tokenized, template-rendered request — what engines consume.
+
+    Analogue of the reference's BackendInput
+    (lib/llm/src/protocols/common/llm_backend.rs).
+    """
+
+    request_id: str
+    token_ids: list[int]
+    sampling: SamplingOptions = Field(default_factory=SamplingOptions)
+    stop: StopConditions = Field(default_factory=StopConditions)
+    output: OutputOptions = Field(default_factory=OutputOptions)
+    # Routing hints
+    model: Optional[str] = None
+    lora_name: Optional[str] = None
+    # Disaggregation: filled by the disagg router when prefill is remote
+    remote_prefill: Optional[dict[str, Any]] = None
+    annotations: list[str] = Field(default_factory=list)
+
+
+class LLMEngineOutput(BaseModel):
+    """One streamed engine step for one request.
+
+    Analogue of the reference's LLMEngineOutput
+    (lib/llm/src/protocols/common/llm_backend.rs): token ids (deltas), optional
+    pre-detokenized text, cumulative log prob, finish reason.
+    """
+
+    request_id: str = ""
+    token_ids: list[int] = Field(default_factory=list)
+    text: Optional[str] = None
+    cum_log_probs: Optional[float] = None
+    log_probs: Optional[list[float]] = None
+    finish_reason: Optional[FinishReason] = None
+    # Engine metrics piggybacked on the final chunk
+    prompt_tokens: Optional[int] = None
+    completion_tokens: Optional[int] = None
+
+    @classmethod
+    def final(cls, request_id: str, reason: FinishReason) -> "LLMEngineOutput":
+        return cls(request_id=request_id, finish_reason=reason)
+
+    @property
+    def is_final(self) -> bool:
+        return self.finish_reason is not None

@@ -1,0 +1,367 @@
+"""OpenAI-compatible API types: chat completions + completions.
+
+Analogue of the reference's OpenAI protocol layer
+(reference: lib/llm/src/protocols/openai.rs, openai/chat_completions*.rs,
+openai/completions*.rs, openai/nvext.rs). Includes the ``nvext``-style
+extension field (named ``ext`` here) for engine-specific knobs like
+ignore_eos/greedy and annotation requests.
+
+Delta generators build the streaming chunk objects
+(reference: chat_completions/delta.rs DeltaGenerator).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Literal, Optional, Union
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    OutputOptions,
+    SamplingOptions,
+    StopConditions,
+)
+
+# ---------------------------------------------------------------------------
+# Extension payload (reference: nvext.rs NvExt)
+# ---------------------------------------------------------------------------
+
+
+class ExtOptions(BaseModel):
+    """Engine extensions carried alongside the standard OpenAI fields."""
+
+    model_config = ConfigDict(extra="allow")
+
+    ignore_eos: Optional[bool] = None
+    greedy_sampling: Optional[bool] = None
+    top_k: Optional[int] = None
+    min_p: Optional[float] = None
+    repetition_penalty: Optional[float] = None
+    annotations: list[str] = Field(default_factory=list)
+    use_raw_prompt: Optional[bool] = None
+
+
+# ---------------------------------------------------------------------------
+# Chat completions
+# ---------------------------------------------------------------------------
+
+
+class ChatMessage(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+    role: str
+    content: Union[str, list[dict[str, Any]], None] = None
+    name: Optional[str] = None
+    tool_calls: Optional[list[dict[str, Any]]] = None
+    tool_call_id: Optional[str] = None
+
+    def text_content(self) -> str:
+        if self.content is None:
+            return ""
+        if isinstance(self.content, str):
+            return self.content
+        # multimodal list-of-parts: concatenate text parts
+        return "".join(
+            p.get("text", "") for p in self.content if p.get("type") == "text"
+        )
+
+
+class StreamOptions(BaseModel):
+    include_usage: bool = False
+
+
+class ChatCompletionRequest(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+    model: str
+    messages: list[ChatMessage]
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    n: Optional[int] = 1
+    stream: bool = False
+    stream_options: Optional[StreamOptions] = None
+    stop: Union[str, list[str], None] = None
+    max_tokens: Optional[int] = None
+    max_completion_tokens: Optional[int] = None
+    presence_penalty: Optional[float] = None
+    frequency_penalty: Optional[float] = None
+    logit_bias: Optional[dict[str, float]] = None
+    logprobs: Optional[bool] = None
+    top_logprobs: Optional[int] = None
+    user: Optional[str] = None
+    seed: Optional[int] = None
+    tools: Optional[list[dict[str, Any]]] = None
+    tool_choice: Optional[Union[str, dict[str, Any]]] = None
+    response_format: Optional[dict[str, Any]] = None
+    ext: Optional[ExtOptions] = None
+    # accept the reference's field name too
+    nvext: Optional[ExtOptions] = None
+
+    def extension(self) -> ExtOptions:
+        return self.ext or self.nvext or ExtOptions()
+
+    # -- adaptation into engine-facing types (reference: common.rs From impls)
+    def sampling_options(self) -> SamplingOptions:
+        ext = self.extension()
+        return SamplingOptions(
+            temperature=self.temperature,
+            top_p=self.top_p,
+            top_k=ext.top_k,
+            min_p=ext.min_p,
+            frequency_penalty=self.frequency_penalty,
+            presence_penalty=self.presence_penalty,
+            repetition_penalty=ext.repetition_penalty,
+            seed=self.seed,
+            n=self.n or 1,
+            use_greedy=bool(ext.greedy_sampling),
+        ).normalized()
+
+    def stop_conditions(self) -> StopConditions:
+        stop = [self.stop] if isinstance(self.stop, str) else list(self.stop or [])
+        return StopConditions(
+            max_tokens=self.max_completion_tokens or self.max_tokens,
+            stop=stop,
+            ignore_eos=bool(self.extension().ignore_eos),
+        )
+
+    def output_options(self) -> OutputOptions:
+        # logprobs=true alone returns the sampled token's logprob (0 extra
+        # alternatives); top_logprobs adds the top-N alternatives
+        return OutputOptions(
+            logprobs=(self.top_logprobs or 0) if self.logprobs else None
+        )
+
+
+class ChatCompletionChoice(BaseModel):
+    index: int = 0
+    message: ChatMessage
+    finish_reason: Optional[str] = None
+    logprobs: Optional[dict[str, Any]] = None
+
+
+class Usage(BaseModel):
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+class ChatCompletionResponse(BaseModel):
+    id: str
+    object: Literal["chat.completion"] = "chat.completion"
+    created: int
+    model: str
+    choices: list[ChatCompletionChoice]
+    usage: Optional[Usage] = None
+    system_fingerprint: Optional[str] = None
+
+
+class ChatDelta(BaseModel):
+    role: Optional[str] = None
+    content: Optional[str] = None
+    tool_calls: Optional[list[dict[str, Any]]] = None
+
+
+class ChatCompletionChunkChoice(BaseModel):
+    index: int = 0
+    delta: ChatDelta
+    finish_reason: Optional[str] = None
+    logprobs: Optional[dict[str, Any]] = None
+
+
+class ChatCompletionChunk(BaseModel):
+    id: str
+    object: Literal["chat.completion.chunk"] = "chat.completion.chunk"
+    created: int
+    model: str
+    choices: list[ChatCompletionChunkChoice]
+    usage: Optional[Usage] = None
+
+
+# ---------------------------------------------------------------------------
+# Completions (legacy text API)
+# ---------------------------------------------------------------------------
+
+
+class CompletionRequest(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+    model: str
+    prompt: Union[str, list[str], list[int], list[list[int]]]
+    suffix: Optional[str] = None
+    max_tokens: Optional[int] = 16
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    n: Optional[int] = 1
+    stream: bool = False
+    stream_options: Optional[StreamOptions] = None
+    logprobs: Optional[int] = None
+    echo: bool = False
+    stop: Union[str, list[str], None] = None
+    presence_penalty: Optional[float] = None
+    frequency_penalty: Optional[float] = None
+    seed: Optional[int] = None
+    user: Optional[str] = None
+    ext: Optional[ExtOptions] = None
+    nvext: Optional[ExtOptions] = None
+
+    def extension(self) -> ExtOptions:
+        return self.ext or self.nvext or ExtOptions()
+
+    def sampling_options(self) -> SamplingOptions:
+        ext = self.extension()
+        return SamplingOptions(
+            temperature=self.temperature,
+            top_p=self.top_p,
+            top_k=ext.top_k,
+            min_p=ext.min_p,
+            frequency_penalty=self.frequency_penalty,
+            presence_penalty=self.presence_penalty,
+            repetition_penalty=ext.repetition_penalty,
+            seed=self.seed,
+            n=self.n or 1,
+            use_greedy=bool(ext.greedy_sampling),
+        ).normalized()
+
+    def stop_conditions(self) -> StopConditions:
+        stop = [self.stop] if isinstance(self.stop, str) else list(self.stop or [])
+        return StopConditions(
+            max_tokens=self.max_tokens,
+            stop=stop,
+            ignore_eos=bool(self.extension().ignore_eos),
+        )
+
+    def output_options(self) -> OutputOptions:
+        return OutputOptions(logprobs=self.logprobs, echo=self.echo)
+
+
+class CompletionChoice(BaseModel):
+    index: int = 0
+    text: str = ""
+    finish_reason: Optional[str] = None
+    logprobs: Optional[dict[str, Any]] = None
+
+
+class CompletionResponse(BaseModel):
+    id: str
+    object: Literal["text_completion"] = "text_completion"
+    created: int
+    model: str
+    choices: list[CompletionChoice]
+    usage: Optional[Usage] = None
+
+
+# ---------------------------------------------------------------------------
+# Models listing
+# ---------------------------------------------------------------------------
+
+
+class ModelInfo(BaseModel):
+    id: str
+    object: Literal["model"] = "model"
+    created: int = 0
+    owned_by: str = "dynamo-tpu"
+
+
+class ModelList(BaseModel):
+    object: Literal["list"] = "list"
+    data: list[ModelInfo] = Field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Delta generators (reference: chat_completions/delta.rs, completions/delta.rs)
+# ---------------------------------------------------------------------------
+
+
+def _now() -> int:
+    return int(time.time())
+
+
+class ChatDeltaGenerator:
+    """Builds the streaming chunk sequence for one chat request."""
+
+    def __init__(self, model: str, request_id: Optional[str] = None):
+        self.id = request_id or f"chatcmpl-{uuid.uuid4().hex}"
+        self.model = model
+        self.created = _now()
+        self._first = True
+
+    def role_chunk(self) -> ChatCompletionChunk:
+        self._first = False
+        return ChatCompletionChunk(
+            id=self.id,
+            created=self.created,
+            model=self.model,
+            choices=[
+                ChatCompletionChunkChoice(delta=ChatDelta(role="assistant", content=""))
+            ],
+        )
+
+    def text_chunk(self, text: str, index: int = 0) -> ChatCompletionChunk:
+        delta = ChatDelta(content=text)
+        if self._first:
+            delta.role = "assistant"
+            self._first = False
+        return ChatCompletionChunk(
+            id=self.id,
+            created=self.created,
+            model=self.model,
+            choices=[ChatCompletionChunkChoice(index=index, delta=delta)],
+        )
+
+    def finish_chunk(
+        self, reason: FinishReason | str, index: int = 0, usage: Optional[Usage] = None
+    ) -> ChatCompletionChunk:
+        reason_str = reason.value if isinstance(reason, FinishReason) else reason
+        # OpenAI wire format only knows stop/length/content_filter/tool_calls
+        if reason_str in ("cancelled", "error"):
+            reason_str = "stop"
+        return ChatCompletionChunk(
+            id=self.id,
+            created=self.created,
+            model=self.model,
+            choices=[
+                ChatCompletionChunkChoice(
+                    index=index, delta=ChatDelta(), finish_reason=reason_str
+                )
+            ],
+            usage=usage,
+        )
+
+    def usage_chunk(self, usage: Usage) -> ChatCompletionChunk:
+        return ChatCompletionChunk(
+            id=self.id, created=self.created, model=self.model, choices=[], usage=usage
+        )
+
+
+class CompletionDeltaGenerator:
+    """Builds the streaming chunk sequence for one text completion request."""
+
+    def __init__(self, model: str, request_id: Optional[str] = None):
+        self.id = request_id or f"cmpl-{uuid.uuid4().hex}"
+        self.model = model
+        self.created = _now()
+
+    def text_chunk(self, text: str, index: int = 0) -> CompletionResponse:
+        return CompletionResponse(
+            id=self.id,
+            created=self.created,
+            model=self.model,
+            choices=[CompletionChoice(index=index, text=text)],
+        )
+
+    def finish_chunk(
+        self, reason: FinishReason | str, index: int = 0, usage: Optional[Usage] = None
+    ) -> CompletionResponse:
+        reason_str = reason.value if isinstance(reason, FinishReason) else reason
+        if reason_str in ("cancelled", "error"):
+            reason_str = "stop"
+        return CompletionResponse(
+            id=self.id,
+            created=self.created,
+            model=self.model,
+            choices=[CompletionChoice(index=index, text="", finish_reason=reason_str)],
+            usage=usage,
+        )

@@ -1,0 +1,112 @@
+"""Server-Sent Events codec.
+
+Analogue of the reference's SSE codec (lib/llm/src/protocols/codec.rs:36-120):
+encode ``Annotated`` items to SSE wire lines and incrementally parse SSE
+byte streams back into messages. Used by the HTTP service (encode) and by
+clients/recorders (decode).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Optional
+
+DONE_SENTINEL = "[DONE]"
+
+
+@dataclass
+class SseMessage:
+    data: Optional[str] = None
+    event: Optional[str] = None
+    id: Optional[str] = None
+    comments: list[str] = field(default_factory=list)
+    retry: Optional[int] = None
+
+    @property
+    def is_done(self) -> bool:
+        return self.data is not None and self.data.strip() == DONE_SENTINEL
+
+    def json(self) -> Any:
+        if self.data is None:
+            return None
+        return json.loads(self.data)
+
+
+def encode_sse(
+    data: Any = None,
+    event: Optional[str] = None,
+    id: Optional[str] = None,
+    comments: Optional[list[str]] = None,
+) -> str:
+    """Encode one SSE message. ``data`` may be a str or a JSON-serializable
+    object (dumped compactly)."""
+    lines: list[str] = []
+    for c in comments or []:
+        for ln in str(c).splitlines() or [""]:
+            lines.append(f": {ln}")
+    if id is not None:
+        lines.append(f"id: {id}")
+    if event is not None:
+        lines.append(f"event: {event}")
+    if data is not None:
+        if not isinstance(data, str):
+            data = json.dumps(data, separators=(",", ":"))
+        for ln in data.splitlines() or [""]:
+            lines.append(f"data: {ln}")
+    return "\n".join(lines) + "\n\n"
+
+
+def encode_done() -> str:
+    return f"data: {DONE_SENTINEL}\n\n"
+
+
+class SseDecoder:
+    """Incremental SSE parser: feed bytes/str, yields SseMessages."""
+
+    def __init__(self) -> None:
+        self._buf = ""
+        self._cur = SseMessage()
+        self._data_lines: list[str] = []
+
+    def feed(self, chunk: bytes | str) -> Iterator[SseMessage]:
+        if isinstance(chunk, bytes):
+            chunk = chunk.decode("utf-8", errors="replace")
+        self._buf += chunk
+        while "\n" in self._buf:
+            line, self._buf = self._buf.split("\n", 1)
+            line = line.rstrip("\r")
+            msg = self._feed_line(line)
+            if msg is not None:
+                yield msg
+
+    def _feed_line(self, line: str) -> Optional[SseMessage]:
+        if line == "":
+            # dispatch event if non-empty
+            if self._data_lines or self._cur.event or self._cur.comments or self._cur.id:
+                msg = self._cur
+                msg.data = "\n".join(self._data_lines) if self._data_lines else None
+                self._cur = SseMessage()
+                self._data_lines = []
+                return msg
+            return None
+        if line.startswith(":"):
+            self._cur.comments.append(line[1:].lstrip(" "))
+            return None
+        if ":" in line:
+            name, _, value = line.partition(":")
+            value = value[1:] if value.startswith(" ") else value
+        else:
+            name, value = line, ""
+        if name == "data":
+            self._data_lines.append(value)
+        elif name == "event":
+            self._cur.event = value
+        elif name == "id":
+            self._cur.id = value
+        elif name == "retry":
+            try:
+                self._cur.retry = int(value)
+            except ValueError:
+                pass
+        return None

@@ -1,0 +1,26 @@
+"""Distributed runtime: component model, streaming engines, routers.
+
+TPU-native analogue of the reference's Rust runtime crate
+(reference: lib/runtime/src — Runtime/DistributedRuntime, Namespace→
+Component→Endpoint, AsyncEngine, PushRouter, transports). Differences by
+design:
+
+- Control plane is the self-hosted coordinator (`dynamo_tpu.store`), not
+  external etcd+NATS.
+- The request plane is a **direct TCP connection to the worker** with
+  multiplexed response streams — one hop, instead of the reference's
+  NATS-request + worker-dials-back-TCP two-hop design
+  (reference: lib/runtime/src/pipeline/network/egress/addressed_router.rs).
+  Discovery/liveness still flows through store leases exactly like etcd.
+"""
+
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
+from dynamo_tpu.runtime.runtime import DistributedRuntime, Runtime
+
+__all__ = [
+    "AsyncEngine",
+    "Context",
+    "DistributedRuntime",
+    "EngineStream",
+    "Runtime",
+]

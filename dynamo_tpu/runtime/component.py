@@ -1,0 +1,277 @@
+"""Namespace → Component → Endpoint hierarchy + discovery-backed clients.
+
+Analogue of the reference's component model (reference:
+lib/runtime/src/component.rs:106-360, component/client.rs:1-197).
+
+Store layout (≈ the reference's etcd path scheme, component.rs:153-155):
+
+  instances/{namespace}/{component}/{endpoint}:{lease_id_hex}
+      → msgpack {host, port, instance_id}
+
+Event subjects (≈ NATS subject scheme, component.rs:281-292):
+
+  {namespace}.{component}.{event_name}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Optional
+
+import msgpack
+
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+from dynamo_tpu.store.base import Subscription, WatchEvent
+
+log = logging.getLogger("dynamo_tpu.runtime.component")
+
+INSTANCE_PREFIX = "instances"
+
+
+@dataclass(frozen=True)
+class Instance:
+    """A live serving instance of an endpoint."""
+
+    instance_id: int  # == lease id, as in the reference
+    host: str
+    port: int
+    namespace: str
+    component: str
+    endpoint: str
+
+    @property
+    def path(self) -> str:
+        return (
+            f"{INSTANCE_PREFIX}/{self.namespace}/{self.component}/"
+            f"{self.endpoint}:{self.instance_id:x}"
+        )
+
+
+class Namespace:
+    def __init__(self, drt: DistributedRuntime, name: str):
+        if "/" in name or "." in name:
+            raise ValueError(f"invalid namespace name: {name!r}")
+        self.drt = drt
+        self.name = name
+
+    def component(self, name: str) -> "Component":
+        return Component(self, name)
+
+    # -- namespace-scoped events (≈ traits/events.rs) ---------------------
+    async def publish(self, event_name: str, payload: Any) -> None:
+        await self.drt.store.publish(
+            f"{self.name}.{event_name}", msgpack.packb(payload, use_bin_type=True)
+        )
+
+    async def subscribe(self, event_name: str) -> "EventSubscriber":
+        sub = await self.drt.store.subscribe(f"{self.name}.{event_name}")
+        return EventSubscriber(sub)
+
+
+class Component:
+    def __init__(self, namespace: Namespace, name: str):
+        if "/" in name or "." in name:
+            raise ValueError(f"invalid component name: {name!r}")
+        self.namespace = namespace
+        self.name = name
+
+    @property
+    def drt(self) -> DistributedRuntime:
+        return self.namespace.drt
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self, name)
+
+    @property
+    def path(self) -> str:
+        return f"{self.namespace.name}/{self.name}"
+
+    # -- component-scoped events ------------------------------------------
+    def event_subject(self, event_name: str) -> str:
+        return f"{self.namespace.name}.{self.name}.{event_name}"
+
+    async def publish(self, event_name: str, payload: Any) -> None:
+        await self.drt.store.publish(
+            self.event_subject(event_name), msgpack.packb(payload, use_bin_type=True)
+        )
+
+    async def subscribe(self, event_name: str) -> "EventSubscriber":
+        sub = await self.drt.store.subscribe(self.event_subject(event_name))
+        return EventSubscriber(sub)
+
+    async def list_instances(self) -> list[Instance]:
+        prefix = f"{INSTANCE_PREFIX}/{self.path}/"
+        entries = await self.drt.store.kv_get_prefix(prefix)
+        return [_decode_instance(e.key, e.value) for e in entries]
+
+
+class EventSubscriber:
+    def __init__(self, sub: Subscription):
+        self._sub = sub
+
+    def __aiter__(self) -> AsyncIterator[tuple[str, Any]]:
+        return self._iter()
+
+    async def _iter(self) -> AsyncIterator[tuple[str, Any]]:
+        async for subject, payload in self._sub:
+            yield subject, msgpack.unpackb(payload, raw=False)
+
+    async def close(self) -> None:
+        await self._sub.close()
+
+
+def _decode_instance(key: str, value: bytes) -> Instance:
+    # key: instances/{ns}/{comp}/{ep}:{lease_hex}
+    meta = msgpack.unpackb(value, raw=False)
+    rest = key[len(INSTANCE_PREFIX) + 1 :]
+    ns, comp, ep_lease = rest.split("/", 2)
+    ep, _, lease_hex = ep_lease.rpartition(":")
+    return Instance(
+        instance_id=int(lease_hex, 16),
+        host=meta["host"],
+        port=meta["port"],
+        namespace=ns,
+        component=comp,
+        endpoint=ep,
+    )
+
+
+class Endpoint:
+    def __init__(self, component: Component, name: str):
+        if "/" in name or "." in name or ":" in name:
+            raise ValueError(f"invalid endpoint name: {name!r}")
+        self.component = component
+        self.name = name
+
+    @property
+    def drt(self) -> DistributedRuntime:
+        return self.component.drt
+
+    @property
+    def path(self) -> str:
+        return f"{self.component.path}/{self.name}"
+
+    def instance_path(self, lease_id: int) -> str:
+        return f"{INSTANCE_PREFIX}/{self.path}:{lease_id:x}"
+
+    # -- serving ----------------------------------------------------------
+    async def serve(
+        self, engine: AsyncEngine, lease_id: Optional[int] = None
+    ) -> Instance:
+        """Register this engine on the shared worker TCP server and publish
+        the instance in the store, attached to the (primary) lease.
+
+        (reference: component/endpoint.rs serve + etcd registration)
+        """
+        drt = self.drt
+        server = await drt.ensure_endpoint_server()
+        server.register(self.path, engine)
+        lid = lease_id if lease_id is not None else drt.primary_lease_id
+        instance = Instance(
+            instance_id=lid,
+            host=drt.config.advertise_host,
+            port=server.port,
+            namespace=self.component.namespace.name,
+            component=self.component.name,
+            endpoint=self.name,
+        )
+        payload = msgpack.packb(
+            {"host": instance.host, "port": instance.port}, use_bin_type=True
+        )
+        created = await drt.store.kv_create(instance.path, payload, lease_id=lid)
+        if not created:
+            await drt.store.kv_put(instance.path, payload, lease_id=lid)
+        log.info("serving %s as instance %x on port %d", self.path, lid, server.port)
+        return instance
+
+    # -- client -----------------------------------------------------------
+    async def client(self, static_instance: Optional[Instance] = None) -> "Client":
+        c = Client(self, static_instance=static_instance)
+        if static_instance is None:
+            await c._start_watch()
+        return c
+
+
+class Client:
+    """Endpoint client: watches discovery, issues streaming requests.
+
+    (reference: component/client.rs — etcd-watched instance list;
+    pipeline/network/egress/push_router.rs for selection modes, which live
+    in push_router.py on top of this.)
+    """
+
+    def __init__(self, endpoint: Endpoint, static_instance: Optional[Instance] = None):
+        self.endpoint = endpoint
+        self.instances: dict[int, Instance] = {}
+        if static_instance is not None:
+            self.instances[static_instance.instance_id] = static_instance
+        self._watch = None
+        self._watch_task: Optional[asyncio.Task] = None
+        self._instances_event = asyncio.Event()
+        if static_instance is not None:
+            self._instances_event.set()
+
+    async def _start_watch(self) -> None:
+        prefix = f"{INSTANCE_PREFIX}/{self.endpoint.path}:"
+        self._watch = await self.endpoint.drt.store.watch_prefix(prefix)
+        for entry in self._watch.snapshot():
+            inst = _decode_instance(entry.key, entry.value)
+            self.instances[inst.instance_id] = inst
+        if self.instances:
+            self._instances_event.set()
+        self._watch_task = asyncio.get_running_loop().create_task(self._watch_loop())
+
+    async def _watch_loop(self) -> None:
+        assert self._watch is not None
+        async for ev in self._watch:
+            self._apply(ev)
+
+    def _apply(self, ev: WatchEvent) -> None:
+        if ev.type == "put":
+            inst = _decode_instance(ev.entry.key, ev.entry.value)
+            self.instances[inst.instance_id] = inst
+            self._instances_event.set()
+        elif ev.type == "delete":
+            _, _, lease_hex = ev.entry.key.rpartition(":")
+            try:
+                self.instances.pop(int(lease_hex, 16), None)
+            except ValueError:
+                pass
+            if not self.instances:
+                self._instances_event.clear()
+
+    def instance_ids(self) -> list[int]:
+        return sorted(self.instances)
+
+    async def wait_for_instances(self, timeout_s: float = 30.0) -> list[int]:
+        """Block until at least one instance is live
+        (reference: client.wait_for_endpoints)."""
+        await asyncio.wait_for(self._instances_event.wait(), timeout_s)
+        return self.instance_ids()
+
+    async def generate_direct(
+        self, instance_id: int, payload: Any, context: Optional[Context] = None
+    ) -> AsyncIterator[Any]:
+        """Stream from one specific instance."""
+        inst = self.instances.get(instance_id)
+        if inst is None:
+            raise KeyError(f"instance {instance_id:x} not found for {self.endpoint.path}")
+        pool = self.endpoint.drt.connection_pool
+        try:
+            conn = await pool.get(inst.host, inst.port)
+            return await conn.request(self.endpoint.path, payload, context)
+        except (OSError, asyncio.TimeoutError) as exc:
+            # OSError covers ConnectionError plus EHOSTUNREACH/ETIMEDOUT etc.
+            pool.invalidate(inst.host, inst.port)
+            if isinstance(exc, ConnectionError):
+                raise
+            raise ConnectionError(str(exc)) from exc
+
+    async def close(self) -> None:
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+        if self._watch is not None:
+            await self._watch.close()

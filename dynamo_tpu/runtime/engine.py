@@ -1,0 +1,101 @@
+"""The universal streaming-engine abstraction.
+
+Analogue of the reference's AsyncEngine trait + AsyncEngineContext
+(reference: lib/runtime/src/engine.rs:47-168): every unit of work in the
+system — preprocessors, routers, model engines — is "a thing that takes one
+request and returns a stream of responses", with per-request cancellation
+(graceful ``stop`` vs immediate ``kill``).
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import uuid
+from typing import Any, AsyncIterator, Awaitable, Callable, Generic, Optional, TypeVar
+
+Req = TypeVar("Req")
+Resp = TypeVar("Resp")
+
+
+class Context:
+    """Per-request control: id + cooperative cancellation.
+
+    ``stop`` asks the producer to finish gracefully (emit what it has);
+    ``kill`` demands immediate termination (reference: engine.rs
+    AsyncEngineContext stop_generating/kill).
+    """
+
+    def __init__(self, id: Optional[str] = None):
+        self.id = id or uuid.uuid4().hex
+        self._stop = asyncio.Event()
+        self._kill = asyncio.Event()
+
+    def stop_generating(self) -> None:
+        self._stop.set()
+
+    def kill(self) -> None:
+        self._stop.set()
+        self._kill.set()
+
+    @property
+    def is_stopped(self) -> bool:
+        return self._stop.is_set()
+
+    @property
+    def is_killed(self) -> bool:
+        return self._kill.is_set()
+
+    async def wait_stopped(self) -> None:
+        await self._stop.wait()
+
+    def child(self) -> "Context":
+        """A linked context sharing cancellation with this one."""
+        c = Context(id=self.id)
+        c._stop = self._stop
+        c._kill = self._kill
+        return c
+
+
+EngineStream = AsyncIterator[Resp]
+
+
+class AsyncEngine(abc.ABC, Generic[Req, Resp]):
+    """A streaming engine: one request in, an async stream of responses out."""
+
+    @abc.abstractmethod
+    def generate(self, request: Req, context: Context) -> EngineStream:
+        """Returns an async iterator of responses. Implementations should
+        poll ``context.is_stopped`` between items and terminate early."""
+
+
+class FnEngine(AsyncEngine[Req, Resp]):
+    """Wrap an async-generator function as an engine (test/mock helper;
+    ≈ reference tests/common/engines.rs LambdaEngine)."""
+
+    def __init__(
+        self, fn: Callable[[Req, Context], AsyncIterator[Resp]], name: str = "fn"
+    ):
+        self._fn = fn
+        self.name = name
+
+    def generate(self, request: Req, context: Context) -> EngineStream:
+        return self._fn(request, context)
+
+
+class UnaryFnEngine(AsyncEngine[Req, Resp]):
+    """Wrap a plain async function returning one response."""
+
+    def __init__(self, fn: Callable[[Req, Context], Awaitable[Resp]]):
+        self._fn = fn
+
+    async def _gen(self, request: Req, context: Context) -> AsyncIterator[Resp]:
+        yield await self._fn(request, context)
+
+    def generate(self, request: Req, context: Context) -> EngineStream:
+        return self._gen(request, context)
+
+
+async def collect(stream: EngineStream) -> list[Any]:
+    """Drain a stream into a list (test helper)."""
+    return [item async for item in stream]

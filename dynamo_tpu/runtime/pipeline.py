@@ -1,0 +1,72 @@
+"""Composable request/response pipeline.
+
+Analogue of the reference's pipeline node graph (reference:
+lib/runtime/src/pipeline/{nodes.rs, nodes/sources.rs, nodes/sinks.rs}):
+ServiceFrontend → Operator(s) → ServiceBackend with forward (request) and
+backward (response-stream) edges. Here an Operator is one object with a
+forward transform and a backward stream transform; ``build_pipeline`` folds
+operators onto a terminal engine, yielding a plain AsyncEngine.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, AsyncIterator, Generic, TypeVar
+
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
+
+Req = TypeVar("Req")
+DownReq = TypeVar("DownReq")
+Resp = TypeVar("Resp")
+DownResp = TypeVar("DownResp")
+
+
+class Operator(abc.ABC, Generic[Req, DownReq, DownResp, Resp]):
+    """A bidirectional pipeline stage.
+
+    forward: transform the incoming request into the downstream request,
+    returning per-request state shared with the backward edge.
+    backward: transform the downstream response stream into the upstream one.
+    (reference: pipeline/nodes.rs Operator fwd/bwd edges; e.g. the
+    OpenAIPreprocessor renders+tokenizes forward and detokenizes backward.)
+    """
+
+    @abc.abstractmethod
+    async def forward(self, request: Req, context: Context) -> tuple[DownReq, Any]: ...
+
+    @abc.abstractmethod
+    def backward(
+        self, stream: AsyncIterator[DownResp], state: Any, context: Context
+    ) -> AsyncIterator[Resp]: ...
+
+
+class _OperatorEngine(AsyncEngine):
+    def __init__(self, op: Operator, inner: AsyncEngine):
+        self.op = op
+        self.inner = inner
+
+    async def _gen(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        down_req, state = await self.op.forward(request, context)
+        down_stream = self.inner.generate(down_req, context)
+        async for item in self.op.backward(down_stream, state, context):
+            yield item
+
+    def generate(self, request: Any, context: Context) -> EngineStream:
+        return self._gen(request, context)
+
+
+def build_pipeline(*stages: Any) -> AsyncEngine:
+    """Fold ``(op1, op2, ..., engine)`` into a single AsyncEngine.
+
+    The last element must be an AsyncEngine (the sink); the rest Operators.
+    """
+    if not stages:
+        raise ValueError("pipeline needs at least a terminal engine")
+    engine = stages[-1]
+    if not isinstance(engine, AsyncEngine):
+        raise TypeError(f"pipeline sink must be an AsyncEngine, got {type(engine)}")
+    for op in reversed(stages[:-1]):
+        if not isinstance(op, Operator):
+            raise TypeError(f"pipeline stage must be an Operator, got {type(op)}")
+        engine = _OperatorEngine(op, engine)
+    return engine

@@ -1,0 +1,97 @@
+"""PushRouter: instance selection + streaming dispatch.
+
+Analogue of the reference's PushRouter (reference:
+lib/runtime/src/pipeline/network/egress/push_router.rs:34-204) with the
+same modes: random, round-robin, direct, and a pluggable selector hook the
+KV-aware router uses (reference: lib/llm/src/kv_router.rs KvPushRouter).
+Retries on connection failure against a different instance.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+import random
+from typing import Any, AsyncIterator, Awaitable, Callable, Optional
+
+from dynamo_tpu.runtime.component import Client
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
+
+log = logging.getLogger("dynamo_tpu.runtime.push_router")
+
+# A selector maps (request, live instance ids) -> chosen instance id.
+Selector = Callable[[Any, list[int]], Awaitable[int]]
+
+
+class RouterMode(str, enum.Enum):
+    RANDOM = "random"
+    ROUND_ROBIN = "round_robin"
+    DIRECT = "direct"
+    CUSTOM = "custom"  # external selector (e.g. KV-aware)
+
+
+class PushRouter(AsyncEngine):
+    def __init__(
+        self,
+        client: Client,
+        mode: RouterMode = RouterMode.RANDOM,
+        selector: Optional[Selector] = None,
+        max_attempts: int = 3,
+    ):
+        self.client = client
+        self.mode = mode
+        self.selector = selector
+        self.max_attempts = max_attempts
+        self._rr_index = 0
+        if mode == RouterMode.CUSTOM and selector is None:
+            raise ValueError("CUSTOM mode requires a selector")
+
+    async def _pick(self, request: Any, exclude: set[int]) -> int:
+        ids = [i for i in self.client.instance_ids() if i not in exclude]
+        if not ids:
+            ids = await self.client.wait_for_instances()
+            ids = [i for i in ids if i not in exclude]
+            if not ids:
+                raise RuntimeError(
+                    f"no live instances for {self.client.endpoint.path}"
+                )
+        if self.mode == RouterMode.RANDOM:
+            return random.choice(ids)
+        if self.mode == RouterMode.ROUND_ROBIN:
+            self._rr_index = (self._rr_index + 1) % len(ids)
+            return ids[self._rr_index]
+        if self.mode == RouterMode.CUSTOM:
+            assert self.selector is not None
+            return await self.selector(request, ids)
+        raise ValueError(f"cannot auto-pick in mode {self.mode}")
+
+    async def _gen(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        exclude: set[int] = set()
+        last_err: Exception | None = None
+        for _ in range(self.max_attempts):
+            instance_id = await self._pick(request, exclude)
+            try:
+                stream = await self.client.generate_direct(
+                    instance_id, request, context
+                )
+            except (OSError, asyncio.TimeoutError, KeyError) as exc:
+                # worker vanished between discovery and dial: try another
+                log.warning("instance %x unreachable: %s", instance_id, exc)
+                exclude.add(instance_id)
+                last_err = exc
+                continue
+            async for item in stream:
+                yield item
+            return
+        raise RuntimeError(
+            f"all attempts failed for {self.client.endpoint.path}: {last_err}"
+        )
+
+    def generate(self, request: Any, context: Context) -> EngineStream:
+        return self._gen(request, context)
+
+    async def generate_direct(
+        self, instance_id: int, request: Any, context: Optional[Context] = None
+    ) -> AsyncIterator[Any]:
+        return await self.client.generate_direct(instance_id, request, context)

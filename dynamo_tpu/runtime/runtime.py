@@ -1,0 +1,132 @@
+"""Runtime and DistributedRuntime: process-level handles.
+
+Analogue of the reference's Runtime/DistributedRuntime/Worker
+(reference: lib/runtime/src/{lib.rs:62-91, distributed.rs:32-176,
+worker.rs:61-117}). A ``DistributedRuntime`` owns:
+
+- the store connection (coordinator client, or in-process MemoryStore in
+  "static" single-process mode),
+- the primary lease + background keepalive (liveness primitive: if this
+  process dies, everything it registered vanishes from discovery),
+- one shared TCP EndpointServer for all endpoints served by this process.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+from typing import Optional
+
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.service import ConnectionPool, EndpointServer
+from dynamo_tpu.store.base import Store
+from dynamo_tpu.store.client import StoreClient
+from dynamo_tpu.store.memory import MemoryStore
+
+log = logging.getLogger("dynamo_tpu.runtime")
+
+
+class Runtime:
+    """Process-level runtime: the event loop + shutdown signal."""
+
+    def __init__(self) -> None:
+        self._shutdown = asyncio.Event()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+
+    @property
+    def is_shutdown(self) -> bool:
+        return self._shutdown.is_set()
+
+    async def wait_shutdown(self) -> None:
+        await self._shutdown.wait()
+
+    def install_signal_handlers(self) -> None:
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, self.shutdown)
+            except NotImplementedError:  # pragma: no cover
+                pass
+
+
+class DistributedRuntime:
+    def __init__(
+        self,
+        runtime: Runtime,
+        store: Store,
+        config: RuntimeConfig,
+        primary_lease_id: int,
+    ):
+        self.runtime = runtime
+        self.store = store
+        self.config = config
+        self.primary_lease_id = primary_lease_id
+        self.endpoint_server = EndpointServer(
+            host=config.worker_host, port=config.worker_port
+        )
+        self.connection_pool = ConnectionPool()
+        self._keepalive_task: Optional[asyncio.Task] = None
+        self._server_started = False
+
+    @classmethod
+    async def create(
+        cls,
+        config: Optional[RuntimeConfig] = None,
+        runtime: Optional[Runtime] = None,
+        store: Optional[Store] = None,
+    ) -> "DistributedRuntime":
+        """Connect to the coordinator (or spin an in-process store in static
+        mode), grant the primary lease, start keepalive."""
+        config = config or RuntimeConfig.from_settings()
+        runtime = runtime or Runtime()
+        if store is None:
+            if config.static:
+                store = MemoryStore()
+            else:
+                store = await StoreClient.connect(config.store_host, config.store_port)
+        lease_id = await store.lease_grant(config.lease_ttl_s)
+        drt = cls(runtime, store, config, lease_id)
+        drt._keepalive_task = asyncio.get_running_loop().create_task(
+            drt._keepalive_loop()
+        )
+        return drt
+
+    async def _keepalive_loop(self) -> None:
+        while not self.runtime.is_shutdown:
+            await asyncio.sleep(self.config.lease_keepalive_s)
+            try:
+                ok = await self.store.lease_keepalive(self.primary_lease_id)
+                if not ok:
+                    log.error("primary lease lost; shutting down")
+                    self.runtime.shutdown()
+                    return
+            except ConnectionError:
+                log.error("store connection lost; shutting down")
+                self.runtime.shutdown()
+                return
+
+    async def ensure_endpoint_server(self) -> EndpointServer:
+        if not self._server_started:
+            await self.endpoint_server.start()
+            self._server_started = True
+        return self.endpoint_server
+
+    def namespace(self, name: str):
+        from dynamo_tpu.runtime.component import Namespace
+
+        return Namespace(self, name)
+
+    async def shutdown(self) -> None:
+        self.runtime.shutdown()
+        if self._keepalive_task is not None:
+            self._keepalive_task.cancel()
+        try:
+            await self.store.lease_revoke(self.primary_lease_id)
+        except (ConnectionError, RuntimeError):
+            pass
+        await self.endpoint_server.stop()
+        await self.connection_pool.close()
+        await self.store.close()

@@ -1,0 +1,265 @@
+"""Worker data plane: TCP endpoint server + client with multiplexed streams.
+
+Analogue of the reference's request/response planes (reference:
+lib/runtime/src/pipeline/network/{egress/addressed_router.rs,
+ingress/push_handler.rs, tcp/server.rs, codec/two_part.rs}) collapsed into
+one direct connection: the caller dials the worker's TCP port (discovered
+via the store) and sends a two-part message (control header + payload);
+response items stream back on the same connection, multiplexed by stream id.
+This removes the NATS hop and the reverse TCP dial of the reference design.
+
+Wire frames (length-prefixed msgpack, see store/wire.py):
+  caller→worker: {t:"req",  sid, ep, ctx:{id}, p: payload}
+                 {t:"stop", sid} | {t:"kill", sid}
+  worker→caller: {t:"item", sid, p} | {t:"err", sid, e} | {t:"fin", sid}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.store.wire import read_frame, shutdown_server, write_frame
+
+log = logging.getLogger("dynamo_tpu.runtime.service")
+
+
+class EndpointServer:
+    """Serves one or more named endpoints, each backed by an AsyncEngine."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self.host = host
+        self.port = port
+        self._endpoints: dict[str, AsyncEngine] = {}
+        self._server: asyncio.AbstractServer | None = None
+        self._conn_writers: set[asyncio.StreamWriter] = set()
+        self.active_requests = 0
+
+    def register(self, name: str, engine: AsyncEngine) -> None:
+        self._endpoints[name] = engine
+
+    def unregister(self, name: str) -> None:
+        self._endpoints.pop(name, None)
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        log.debug("endpoint server on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        await shutdown_server(self._server, self._conn_writers)
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self._conn_writers.add(writer)
+        write_lock = asyncio.Lock()
+        streams: dict[int, tuple[asyncio.Task, Context]] = {}
+
+        async def send(obj: Any) -> None:
+            async with write_lock:
+                write_frame(writer, obj)
+                await writer.drain()
+
+        async def run_stream(sid: int, ep: str, ctx: Context, payload: Any) -> None:
+            self.active_requests += 1
+            try:
+                engine = self._endpoints.get(ep)
+                if engine is None:
+                    await send({"t": "err", "sid": sid, "e": f"no such endpoint: {ep}"})
+                    return
+                try:
+                    async for item in engine.generate(payload, ctx):
+                        if ctx.is_killed:
+                            break
+                        await send({"t": "item", "sid": sid, "p": item})
+                    await send({"t": "fin", "sid": sid})
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    log.exception("engine error on %s", ep)
+                    await send({"t": "err", "sid": sid, "e": f"{type(exc).__name__}: {exc}"})
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+            finally:
+                self.active_requests -= 1
+                streams.pop(sid, None)
+
+        try:
+            while True:
+                try:
+                    msg = await read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                t = msg.get("t")
+                if t == "req":
+                    sid = msg["sid"]
+                    ctx = Context(id=msg.get("ctx", {}).get("id"))
+                    task = asyncio.get_running_loop().create_task(
+                        run_stream(sid, msg["ep"], ctx, msg.get("p"))
+                    )
+                    streams[sid] = (task, ctx)
+                elif t in ("stop", "kill"):
+                    entry = streams.get(msg["sid"])
+                    if entry is not None:
+                        _, ctx = entry
+                        ctx.kill() if t == "kill" else ctx.stop_generating()
+                elif t == "ping":
+                    await send({"t": "pong"})
+        finally:
+            # connection gone: kill all in-flight streams for this caller
+            for task, ctx in streams.values():
+                ctx.kill()
+                task.cancel()
+            self._conn_writers.discard(writer)
+            writer.close()
+
+
+class EndpointConnection:
+    """One pooled connection to a worker; multiplexes many request streams."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._sids = itertools.count(1)
+        self._queues: dict[int, asyncio.Queue] = {}
+        self._rx: asyncio.Task | None = None
+        self._lock = asyncio.Lock()
+        self.closed = False
+
+    @classmethod
+    async def connect(
+        cls, host: str, port: int, timeout_s: float = 5.0
+    ) -> "EndpointConnection":
+        conn = cls(host, port)
+        conn._reader, conn._writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout=timeout_s
+        )
+        conn._rx = asyncio.get_running_loop().create_task(conn._rx_loop())
+        return conn
+
+    async def _rx_loop(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                msg = await read_frame(self._reader)
+                q = self._queues.get(msg.get("sid"))
+                if q is not None:
+                    q.put_nowait(msg)
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self.closed = True
+            for q in self._queues.values():
+                q.put_nowait({"t": "err", "e": "connection lost"})
+
+    async def _send(self, obj: Any) -> None:
+        if self._writer is None or self.closed:
+            raise ConnectionError("endpoint connection closed")
+        async with self._lock:
+            write_frame(self._writer, obj)
+            await self._writer.drain()
+
+    async def request(
+        self, endpoint: str, payload: Any, context: Optional[Context] = None
+    ) -> AsyncIterator[Any]:
+        """Send one request; yields response items until fin/err."""
+        ctx = context or Context()
+        sid = next(self._sids)
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[sid] = q
+        loop = asyncio.get_running_loop()
+        await self._send({"t": "req", "sid": sid, "ep": endpoint, "ctx": {"id": ctx.id}, "p": payload})
+
+        # Cancellation rides the Context, not the consumer: the moment the
+        # caller stops/kills the context, the worker is notified — even if
+        # the consumer has abandoned the stream (generator finalization is
+        # GC-deferred in CPython, so it can't be the cancel path).
+        async def cancel_notifier() -> None:
+            await ctx.wait_stopped()
+            if sid in self._queues and not self.closed:
+                try:
+                    await self._send(
+                        {"t": "kill" if ctx.is_killed else "stop", "sid": sid}
+                    )
+                except (ConnectionError, RuntimeError):
+                    pass
+
+        notifier = loop.create_task(cancel_notifier())
+
+        async def iterate() -> AsyncIterator[Any]:
+            finished = False
+            try:
+                while True:
+                    msg = await q.get()
+                    t = msg.get("t")
+                    if t == "item":
+                        yield msg.get("p")
+                    elif t == "fin":
+                        finished = True
+                        return
+                    elif t == "err":
+                        finished = True
+                        raise RuntimeError(msg.get("e", "remote error"))
+            finally:
+                notifier.cancel()
+                self._queues.pop(sid, None)
+                # consumer abandoned the stream early (break / aclose) and
+                # never cancelled the context: kill the in-flight request
+                if not finished and not ctx.is_stopped and not self.closed:
+                    try:
+                        await self._send({"t": "kill", "sid": sid})
+                    except (ConnectionError, RuntimeError):
+                        pass
+
+        return iterate()
+
+    async def close(self) -> None:
+        self.closed = True
+        if self._rx is not None:
+            self._rx.cancel()
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+
+class ConnectionPool:
+    """Caches one EndpointConnection per (host, port).
+
+    Locking is per-target: dialing one unreachable host must not stall
+    traffic to healthy workers.
+    """
+
+    def __init__(self, connect_timeout_s: float = 5.0) -> None:
+        self._conns: dict[tuple[str, int], EndpointConnection] = {}
+        self._locks: dict[tuple[str, int], asyncio.Lock] = {}
+        self.connect_timeout_s = connect_timeout_s
+
+    async def get(self, host: str, port: int) -> EndpointConnection:
+        key = (host, port)
+        lock = self._locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(key)
+            if conn is None or conn.closed:
+                conn = await EndpointConnection.connect(
+                    host, port, timeout_s=self.connect_timeout_s
+                )
+                self._conns[key] = conn
+            return conn
+
+    def invalidate(self, host: str, port: int) -> None:
+        self._conns.pop((host, port), None)
+
+    async def close(self) -> None:
+        for conn in self._conns.values():
+            await conn.close()
+        self._conns.clear()

@@ -1,0 +1,22 @@
+"""Control plane: KV + leases + watches, pub/sub, queues, object store.
+
+The reference delegates its control plane to external infrastructure — etcd
+for discovery/leases and NATS (+JetStream) for messaging/queues/object store
+(reference: SURVEY.md §1 L0; lib/runtime/src/transports/{etcd,nats}.rs).
+dynamo-tpu self-hosts an equivalent single "coordinator" service instead:
+one process (`python -m dynamo_tpu.store.server`) provides
+
+- versioned KV with leases (TTL + keepalive) and prefix watches  (≈ etcd)
+- subject-based pub/sub with wildcard matching                    (≈ NATS)
+- at-least-once work queues with ack/visibility-timeout           (≈ JetStream)
+- a bytes object store                                            (≈ NATS obj store)
+
+`MemoryStore` implements the full semantics in-process (used directly for
+single-process deployments and tests); the TCP server/client expose the same
+abstract API across hosts.
+"""
+
+from dynamo_tpu.store.base import KvEntry, Store, WatchEvent
+from dynamo_tpu.store.memory import MemoryStore
+
+__all__ = ["KvEntry", "MemoryStore", "Store", "WatchEvent"]

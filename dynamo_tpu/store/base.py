@@ -1,0 +1,164 @@
+"""Store abstract interface + shared types.
+
+Semantics modelled on what the reference actually uses from etcd/NATS
+(reference: lib/runtime/src/transports/etcd.rs:41-496 — kv_create CAS,
+kv_get_and_watch_prefix, primary lease w/ keepalive; transports/nats.rs —
+publish/subscribe, JetStream queues, object store).
+"""
+
+from __future__ import annotations
+
+import abc
+import fnmatch
+from dataclasses import dataclass
+from typing import AsyncIterator, Optional
+
+NO_LEASE = 0
+
+
+@dataclass(frozen=True)
+class KvEntry:
+    key: str
+    value: bytes
+    version: int
+    lease_id: int = NO_LEASE
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    """One KV change. type is 'put' or 'delete'."""
+
+    type: str
+    entry: KvEntry
+
+
+@dataclass(frozen=True)
+class QueueMessage:
+    id: int
+    payload: bytes
+
+
+def subject_matches(pattern: str, subject: str) -> bool:
+    """NATS-style subject matching: '.'-separated tokens, '*' = one token,
+    '>' = one-or-more trailing tokens."""
+    if "*" not in pattern and ">" not in pattern:
+        return pattern == subject
+    p_toks = pattern.split(".")
+    s_toks = subject.split(".")
+    for i, pt in enumerate(p_toks):
+        if pt == ">":
+            return len(s_toks) >= i + 1
+        if i >= len(s_toks):
+            return False
+        if pt != "*" and pt != s_toks[i]:
+            return False
+    return len(p_toks) == len(s_toks)
+
+
+def glob_matches(pattern: str, s: str) -> bool:
+    return fnmatch.fnmatchcase(s, pattern)
+
+
+class Watch(abc.ABC):
+    """A prefix watch: initial snapshot + live event stream."""
+
+    @abc.abstractmethod
+    def snapshot(self) -> list[KvEntry]: ...
+
+    @abc.abstractmethod
+    def __aiter__(self) -> AsyncIterator[WatchEvent]: ...
+
+    @abc.abstractmethod
+    async def close(self) -> None: ...
+
+
+class Subscription(abc.ABC):
+    """A pub/sub subscription yielding (subject, payload)."""
+
+    @abc.abstractmethod
+    def __aiter__(self) -> AsyncIterator[tuple[str, bytes]]: ...
+
+    @abc.abstractmethod
+    async def close(self) -> None: ...
+
+
+class Store(abc.ABC):
+    """The full control-plane interface."""
+
+    # -- kv ---------------------------------------------------------------
+    @abc.abstractmethod
+    async def kv_put(self, key: str, value: bytes, lease_id: int = NO_LEASE) -> int: ...
+
+    @abc.abstractmethod
+    async def kv_create(
+        self, key: str, value: bytes, lease_id: int = NO_LEASE
+    ) -> bool:
+        """Atomic create-if-absent (CAS). Returns False if the key exists."""
+
+    @abc.abstractmethod
+    async def kv_get(self, key: str) -> Optional[KvEntry]: ...
+
+    @abc.abstractmethod
+    async def kv_get_prefix(self, prefix: str) -> list[KvEntry]: ...
+
+    @abc.abstractmethod
+    async def kv_delete(self, key: str) -> bool: ...
+
+    @abc.abstractmethod
+    async def kv_delete_prefix(self, prefix: str) -> int: ...
+
+    @abc.abstractmethod
+    async def watch_prefix(self, prefix: str) -> Watch: ...
+
+    # -- leases -----------------------------------------------------------
+    @abc.abstractmethod
+    async def lease_grant(self, ttl_s: float) -> int: ...
+
+    @abc.abstractmethod
+    async def lease_keepalive(self, lease_id: int) -> bool:
+        """Refresh; False if the lease no longer exists (expired/revoked)."""
+
+    @abc.abstractmethod
+    async def lease_revoke(self, lease_id: int) -> None:
+        """Revoke: deletes all keys attached to the lease (watchers fire)."""
+
+    # -- pub/sub ----------------------------------------------------------
+    @abc.abstractmethod
+    async def publish(self, subject: str, payload: bytes) -> None: ...
+
+    @abc.abstractmethod
+    async def subscribe(self, pattern: str) -> Subscription: ...
+
+    # -- queues (at-least-once w/ ack) ------------------------------------
+    @abc.abstractmethod
+    async def queue_push(self, queue: str, payload: bytes) -> int: ...
+
+    @abc.abstractmethod
+    async def queue_pop(
+        self, queue: str, timeout_s: Optional[float] = None, visibility_s: float = 30.0
+    ) -> Optional[QueueMessage]:
+        """Pop one message; it must be acked within visibility_s or it is
+        redelivered to another consumer."""
+
+    @abc.abstractmethod
+    async def queue_ack(self, queue: str, msg_id: int) -> bool: ...
+
+    @abc.abstractmethod
+    async def queue_len(self, queue: str) -> int: ...
+
+    # -- object store -----------------------------------------------------
+    @abc.abstractmethod
+    async def obj_put(self, bucket: str, name: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    async def obj_get(self, bucket: str, name: str) -> Optional[bytes]: ...
+
+    @abc.abstractmethod
+    async def obj_delete(self, bucket: str, name: str) -> bool: ...
+
+    @abc.abstractmethod
+    async def obj_list(self, bucket: str) -> list[str]: ...
+
+    # -- lifecycle --------------------------------------------------------
+    @abc.abstractmethod
+    async def close(self) -> None: ...

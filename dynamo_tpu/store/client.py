@@ -1,0 +1,232 @@
+"""TCP client for the coordinator store; implements the Store interface.
+
+Multiplexes concurrent requests over one connection; watches and
+subscriptions are server-push streams dispatched to local queues.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.store.base import (
+    NO_LEASE,
+    KvEntry,
+    QueueMessage,
+    Store,
+    Subscription,
+    Watch,
+    WatchEvent,
+)
+from dynamo_tpu.store.wire import read_frame, write_frame
+
+
+def _dec_entry(d: dict) -> KvEntry:
+    return KvEntry(key=d["k"], value=d["v"], version=d["ver"], lease_id=d["l"])
+
+
+class _RemoteWatch(Watch):
+    def __init__(self, client: "StoreClient", sid: int, snapshot: list[KvEntry]):
+        self._client = client
+        self._sid = sid
+        self._snapshot = snapshot
+        self.queue: asyncio.Queue[Any] = asyncio.Queue()
+
+    def snapshot(self) -> list[KvEntry]:
+        return list(self._snapshot)
+
+    def __aiter__(self) -> AsyncIterator[WatchEvent]:
+        return self._iter()
+
+    async def _iter(self) -> AsyncIterator[WatchEvent]:
+        while True:
+            item = await self.queue.get()
+            if item is None:
+                return
+            yield WatchEvent(type=item["t"], entry=_dec_entry(item["e"]))
+
+    async def close(self) -> None:
+        await self._client._close_stream(self._sid)
+
+
+class _RemoteSubscription(Subscription):
+    def __init__(self, client: "StoreClient", sid: int):
+        self._client = client
+        self._sid = sid
+        self.queue: asyncio.Queue[Any] = asyncio.Queue()
+
+    def __aiter__(self) -> AsyncIterator[tuple[str, bytes]]:
+        return self._iter()
+
+    async def _iter(self) -> AsyncIterator[tuple[str, bytes]]:
+        while True:
+            item = await self.queue.get()
+            if item is None:
+                return
+            yield item["subj"], item["p"]
+
+    async def close(self) -> None:
+        await self._client._close_stream(self._sid)
+
+
+class StoreClient(Store):
+    def __init__(self, host: str = "127.0.0.1", port: int = 4222):
+        self.host = host
+        self.port = port
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._req_ids = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._streams: dict[int, asyncio.Queue] = {}
+        self._rx_task: asyncio.Task | None = None
+        self._write_lock = asyncio.Lock()
+        self._closed = False
+
+    @classmethod
+    async def connect(cls, host: str = "127.0.0.1", port: int = 4222) -> "StoreClient":
+        client = cls(host, port)
+        client._reader, client._writer = await asyncio.open_connection(host, port)
+        client._rx_task = asyncio.get_running_loop().create_task(client._rx_loop())
+        return client
+
+    async def _rx_loop(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                msg = await read_frame(self._reader)
+                mid = msg.get("i")
+                if "s" in msg:  # stream item
+                    q = self._streams.get(mid)
+                    if q is not None:
+                        q.put_nowait(msg["s"])
+                elif msg.get("end"):
+                    q = self._streams.pop(mid, None)
+                    if q is not None:
+                        q.put_nowait(None)
+                else:  # unary reply
+                    fut = self._pending.pop(mid, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg)
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            err = ConnectionError("store connection lost")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(err)
+            self._pending.clear()
+            for q in self._streams.values():
+                q.put_nowait(None)
+            self._streams.clear()
+
+    async def _call(self, op: str, *args: Any) -> Any:
+        if self._writer is None or self._closed:
+            raise ConnectionError("store client not connected")
+        rid = next(self._req_ids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        async with self._write_lock:
+            write_frame(self._writer, {"i": rid, "op": op, "a": list(args)})
+            await self._writer.drain()
+        reply = await fut
+        if not reply.get("ok"):
+            raise RuntimeError(f"store error for {op}: {reply.get('e')}")
+        return reply.get("v")
+
+    async def _close_stream(self, sid: int) -> None:
+        q = self._streams.pop(sid, None)
+        if q is not None:
+            q.put_nowait(None)
+        if not self._closed:
+            try:
+                await self._call("stream_close", sid)
+            except (ConnectionError, RuntimeError):
+                pass
+
+    # -- kv ---------------------------------------------------------------
+    async def kv_put(self, key: str, value: bytes, lease_id: int = NO_LEASE) -> int:
+        return await self._call("kv_put", key, value, lease_id)
+
+    async def kv_create(self, key: str, value: bytes, lease_id: int = NO_LEASE) -> bool:
+        return await self._call("kv_create", key, value, lease_id)
+
+    async def kv_get(self, key: str) -> Optional[KvEntry]:
+        d = await self._call("kv_get", key)
+        return _dec_entry(d) if d else None
+
+    async def kv_get_prefix(self, prefix: str) -> list[KvEntry]:
+        return [_dec_entry(d) for d in await self._call("kv_get_prefix", prefix)]
+
+    async def kv_delete(self, key: str) -> bool:
+        return await self._call("kv_delete", key)
+
+    async def kv_delete_prefix(self, prefix: str) -> int:
+        return await self._call("kv_delete_prefix", prefix)
+
+    async def watch_prefix(self, prefix: str) -> Watch:
+        v = await self._call("watch_prefix", prefix)
+        watch = _RemoteWatch(self, v["sid"], [_dec_entry(d) for d in v["snapshot"]])
+        self._streams[v["sid"]] = watch.queue
+        return watch
+
+    # -- leases -----------------------------------------------------------
+    async def lease_grant(self, ttl_s: float) -> int:
+        return await self._call("lease_grant", ttl_s)
+
+    async def lease_keepalive(self, lease_id: int) -> bool:
+        return await self._call("lease_keepalive", lease_id)
+
+    async def lease_revoke(self, lease_id: int) -> None:
+        await self._call("lease_revoke", lease_id)
+
+    # -- pub/sub ----------------------------------------------------------
+    async def publish(self, subject: str, payload: bytes) -> None:
+        await self._call("publish", subject, payload)
+
+    async def subscribe(self, pattern: str) -> Subscription:
+        v = await self._call("subscribe", pattern)
+        sub = _RemoteSubscription(self, v["sid"])
+        self._streams[v["sid"]] = sub.queue
+        return sub
+
+    # -- queues -----------------------------------------------------------
+    async def queue_push(self, queue: str, payload: bytes) -> int:
+        return await self._call("queue_push", queue, payload)
+
+    async def queue_pop(
+        self, queue: str, timeout_s: Optional[float] = None, visibility_s: float = 30.0
+    ) -> Optional[QueueMessage]:
+        d = await self._call("queue_pop", queue, timeout_s, visibility_s)
+        return QueueMessage(id=d["id"], payload=d["p"]) if d else None
+
+    async def queue_ack(self, queue: str, msg_id: int) -> bool:
+        return await self._call("queue_ack", queue, msg_id)
+
+    async def queue_len(self, queue: str) -> int:
+        return await self._call("queue_len", queue)
+
+    # -- object store -----------------------------------------------------
+    async def obj_put(self, bucket: str, name: str, data: bytes) -> None:
+        await self._call("obj_put", bucket, name, data)
+
+    async def obj_get(self, bucket: str, name: str) -> Optional[bytes]:
+        return await self._call("obj_get", bucket, name)
+
+    async def obj_delete(self, bucket: str, name: str) -> bool:
+        return await self._call("obj_delete", bucket, name)
+
+    async def obj_list(self, bucket: str) -> list[str]:
+        return await self._call("obj_list", bucket)
+
+    # -- lifecycle --------------------------------------------------------
+    async def close(self) -> None:
+        self._closed = True
+        if self._rx_task is not None:
+            self._rx_task.cancel()
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass

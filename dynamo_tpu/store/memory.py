@@ -1,0 +1,296 @@
+"""In-process control-plane implementation with full semantics.
+
+Single source of truth for store behavior: the TCP server wraps one of
+these; tests and single-process deployments use it directly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Optional
+
+from dynamo_tpu.store.base import (
+    NO_LEASE,
+    KvEntry,
+    QueueMessage,
+    Store,
+    Subscription,
+    Watch,
+    WatchEvent,
+    subject_matches,
+)
+
+
+class _MemWatch(Watch):
+    def __init__(self, store: "MemoryStore", prefix: str, snapshot: list[KvEntry]):
+        self._store = store
+        self.prefix = prefix
+        self._snapshot = snapshot
+        self._queue: asyncio.Queue[WatchEvent | None] = asyncio.Queue()
+        self._closed = False
+
+    def snapshot(self) -> list[KvEntry]:
+        return list(self._snapshot)
+
+    def _notify(self, event: WatchEvent) -> None:
+        if not self._closed:
+            self._queue.put_nowait(event)
+
+    def __aiter__(self) -> AsyncIterator[WatchEvent]:
+        return self._iter()
+
+    async def _iter(self) -> AsyncIterator[WatchEvent]:
+        while True:
+            ev = await self._queue.get()
+            if ev is None:
+                return
+            yield ev
+
+    async def close(self) -> None:
+        self._closed = True
+        self._queue.put_nowait(None)
+        self._store._watches.discard(self)
+
+
+class _MemSubscription(Subscription):
+    def __init__(self, store: "MemoryStore", pattern: str):
+        self._store = store
+        self.pattern = pattern
+        self._queue: asyncio.Queue[tuple[str, bytes] | None] = asyncio.Queue()
+        self._closed = False
+
+    def _deliver(self, subject: str, payload: bytes) -> None:
+        if not self._closed:
+            self._queue.put_nowait((subject, payload))
+
+    def __aiter__(self) -> AsyncIterator[tuple[str, bytes]]:
+        return self._iter()
+
+    async def _iter(self) -> AsyncIterator[tuple[str, bytes]]:
+        while True:
+            item = await self._queue.get()
+            if item is None:
+                return
+            yield item
+
+    async def close(self) -> None:
+        self._closed = True
+        self._queue.put_nowait(None)
+        self._store._subs.discard(self)
+
+
+@dataclass
+class _Lease:
+    id: int
+    ttl_s: float
+    expires_at: float
+    keys: set[str] = field(default_factory=set)
+
+
+@dataclass
+class _QueueState:
+    next_id: itertools.count = field(default_factory=lambda: itertools.count(1))
+    ready: deque[QueueMessage] = field(default_factory=deque)
+    # msg_id -> (message, redelivery deadline)
+    in_flight: dict[int, tuple[QueueMessage, float]] = field(default_factory=dict)
+    cond: asyncio.Condition = field(default_factory=asyncio.Condition)
+
+
+class MemoryStore(Store):
+    """Full-semantics in-process store. All methods are asyncio-safe within
+    one event loop (the store is not thread-safe by design; cross-thread use
+    goes through the TCP client)."""
+
+    def __init__(self, lease_sweep_interval_s: float = 0.5):
+        self._kv: dict[str, KvEntry] = {}
+        self._version = itertools.count(1)
+        self._watches: set[_MemWatch] = set()
+        self._subs: set[_MemSubscription] = set()
+        self._leases: dict[int, _Lease] = {}
+        self._lease_ids = itertools.count(1)
+        self._queues: dict[str, _QueueState] = defaultdict(_QueueState)
+        self._objects: dict[str, dict[str, bytes]] = defaultdict(dict)
+        self._sweep_interval = lease_sweep_interval_s
+        self._sweeper: Optional[asyncio.Task] = None
+        self._closed = False
+
+    def _ensure_sweeper(self) -> None:
+        if self._sweeper is None or self._sweeper.done():
+            self._sweeper = asyncio.get_running_loop().create_task(self._sweep_loop())
+
+    async def _sweep_loop(self) -> None:
+        while not self._closed:
+            await asyncio.sleep(self._sweep_interval)
+            now = time.monotonic()
+            expired = [l.id for l in self._leases.values() if l.expires_at <= now]
+            for lid in expired:
+                await self.lease_revoke(lid)
+            # redeliver timed-out in-flight queue messages
+            for q in self._queues.values():
+                timed_out = [
+                    mid for mid, (_, ddl) in q.in_flight.items() if ddl <= now
+                ]
+                if timed_out:
+                    async with q.cond:
+                        for mid in timed_out:
+                            msg, _ = q.in_flight.pop(mid)
+                            q.ready.appendleft(msg)
+                        q.cond.notify_all()
+
+    # -- kv ---------------------------------------------------------------
+    def _emit(self, event: WatchEvent) -> None:
+        for w in list(self._watches):
+            if event.entry.key.startswith(w.prefix):
+                w._notify(event)
+
+    async def kv_put(self, key: str, value: bytes, lease_id: int = NO_LEASE) -> int:
+        # detach from a previous owner lease so a stale lease's expiry can't
+        # delete a key that has since been re-registered by a live process
+        prev = self._kv.get(key)
+        if prev is not None and prev.lease_id != lease_id:
+            old = self._leases.get(prev.lease_id)
+            if old is not None:
+                old.keys.discard(key)
+        if lease_id != NO_LEASE:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                raise KeyError(f"lease {lease_id} does not exist")
+            lease.keys.add(key)
+        version = next(self._version)
+        entry = KvEntry(key=key, value=value, version=version, lease_id=lease_id)
+        self._kv[key] = entry
+        self._emit(WatchEvent("put", entry))
+        return version
+
+    async def kv_create(self, key: str, value: bytes, lease_id: int = NO_LEASE) -> bool:
+        if key in self._kv:
+            return False
+        await self.kv_put(key, value, lease_id)
+        return True
+
+    async def kv_get(self, key: str) -> Optional[KvEntry]:
+        return self._kv.get(key)
+
+    async def kv_get_prefix(self, prefix: str) -> list[KvEntry]:
+        return sorted(
+            (e for k, e in self._kv.items() if k.startswith(prefix)),
+            key=lambda e: e.key,
+        )
+
+    async def kv_delete(self, key: str) -> bool:
+        entry = self._kv.pop(key, None)
+        if entry is None:
+            return False
+        if entry.lease_id != NO_LEASE and entry.lease_id in self._leases:
+            self._leases[entry.lease_id].keys.discard(key)
+        self._emit(WatchEvent("delete", entry))
+        return True
+
+    async def kv_delete_prefix(self, prefix: str) -> int:
+        keys = [k for k in self._kv if k.startswith(prefix)]
+        for k in keys:
+            await self.kv_delete(k)
+        return len(keys)
+
+    async def watch_prefix(self, prefix: str) -> Watch:
+        snapshot = await self.kv_get_prefix(prefix)
+        w = _MemWatch(self, prefix, snapshot)
+        self._watches.add(w)
+        return w
+
+    # -- leases -----------------------------------------------------------
+    async def lease_grant(self, ttl_s: float) -> int:
+        self._ensure_sweeper()
+        lid = next(self._lease_ids)
+        self._leases[lid] = _Lease(
+            id=lid, ttl_s=ttl_s, expires_at=time.monotonic() + ttl_s
+        )
+        return lid
+
+    async def lease_keepalive(self, lease_id: int) -> bool:
+        lease = self._leases.get(lease_id)
+        if lease is None:
+            return False
+        lease.expires_at = time.monotonic() + lease.ttl_s
+        return True
+
+    async def lease_revoke(self, lease_id: int) -> None:
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return
+        for key in list(lease.keys):
+            await self.kv_delete(key)
+
+    # -- pub/sub ----------------------------------------------------------
+    async def publish(self, subject: str, payload: bytes) -> None:
+        for sub in list(self._subs):
+            if subject_matches(sub.pattern, subject):
+                sub._deliver(subject, payload)
+
+    async def subscribe(self, pattern: str) -> Subscription:
+        sub = _MemSubscription(self, pattern)
+        self._subs.add(sub)
+        return sub
+
+    # -- queues -----------------------------------------------------------
+    async def queue_push(self, queue: str, payload: bytes) -> int:
+        self._ensure_sweeper()
+        q = self._queues[queue]
+        msg = QueueMessage(id=next(q.next_id), payload=payload)
+        async with q.cond:
+            q.ready.append(msg)
+            q.cond.notify()
+        return msg.id
+
+    async def queue_pop(
+        self, queue: str, timeout_s: Optional[float] = None, visibility_s: float = 30.0
+    ) -> Optional[QueueMessage]:
+        q = self._queues[queue]
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        async with q.cond:
+            while not q.ready:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                try:
+                    await asyncio.wait_for(q.cond.wait(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    return None
+            msg = q.ready.popleft()
+            q.in_flight[msg.id] = (msg, time.monotonic() + visibility_s)
+            return msg
+
+    async def queue_ack(self, queue: str, msg_id: int) -> bool:
+        q = self._queues[queue]
+        return q.in_flight.pop(msg_id, None) is not None
+
+    async def queue_len(self, queue: str) -> int:
+        q = self._queues[queue]
+        return len(q.ready) + len(q.in_flight)
+
+    # -- object store -----------------------------------------------------
+    async def obj_put(self, bucket: str, name: str, data: bytes) -> None:
+        self._objects[bucket][name] = bytes(data)
+
+    async def obj_get(self, bucket: str, name: str) -> Optional[bytes]:
+        return self._objects.get(bucket, {}).get(name)
+
+    async def obj_delete(self, bucket: str, name: str) -> bool:
+        return self._objects.get(bucket, {}).pop(name, None) is not None
+
+    async def obj_list(self, bucket: str) -> list[str]:
+        return sorted(self._objects.get(bucket, {}).keys())
+
+    # -- lifecycle --------------------------------------------------------
+    async def close(self) -> None:
+        self._closed = True
+        if self._sweeper is not None:
+            self._sweeper.cancel()
+        for w in list(self._watches):
+            await w.close()
+        for s in list(self._subs):
+            await s.close()

@@ -1,0 +1,233 @@
+"""TCP server exposing a MemoryStore to remote clients.
+
+One coordinator process per deployment replaces the reference's etcd+NATS
+pair (reference: SURVEY.md §1 L0). Run with::
+
+    python -m dynamo_tpu.store.server --host 0.0.0.0 --port 4222
+
+Protocol: length-prefixed msgpack frames. Client request:
+``{i: req_id, op: name, a: [args]}``. Server unary reply:
+``{i, ok, v}`` (or ``{i, ok: false, e: msg}``). Streams (watch/subscribe)
+are server-push: ``{i: stream_id, s: item}`` and ``{i, end: true}``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import itertools
+import logging
+from typing import Any
+
+from dynamo_tpu.store.base import KvEntry, QueueMessage, WatchEvent
+from dynamo_tpu.store.memory import MemoryStore
+from dynamo_tpu.store.wire import read_frame, shutdown_server, write_frame
+
+log = logging.getLogger("dynamo_tpu.store.server")
+
+
+def _enc_entry(e: KvEntry) -> dict:
+    return {"k": e.key, "v": e.value, "ver": e.version, "l": e.lease_id}
+
+
+def _enc_event(ev: WatchEvent) -> dict:
+    return {"t": ev.type, "e": _enc_entry(ev.entry)}
+
+
+def _enc_qmsg(m: QueueMessage | None) -> dict | None:
+    if m is None:
+        return None
+    return {"id": m.id, "p": m.payload}
+
+
+class StoreServer:
+    def __init__(self, store: MemoryStore | None = None, host: str = "127.0.0.1", port: int = 4222):
+        self.store = store or MemoryStore()
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+        self._conn_writers: set[asyncio.StreamWriter] = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        log.info("store server listening on %s:%d", self.host, self.port)
+
+    async def serve_forever(self) -> None:
+        await self.start()
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def stop(self) -> None:
+        await shutdown_server(self._server, self._conn_writers)
+        await self.store.close()
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        self._conn_writers.add(writer)
+        # per-connection state: leases granted and streams opened, cleaned on drop
+        conn_leases: set[int] = set()
+        streams: dict[int, asyncio.Task] = {}
+        stream_handles: dict[int, Any] = {}
+        stream_ids = itertools.count(1)
+        write_lock = asyncio.Lock()
+
+        async def send(obj: Any) -> None:
+            async with write_lock:
+                write_frame(writer, obj)
+                await writer.drain()
+
+        async def pump_watch(sid: int, watch: Any) -> None:
+            try:
+                async for ev in watch:
+                    await send({"i": sid, "s": _enc_event(ev)})
+                await send({"i": sid, "end": True})
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+
+        async def pump_sub(sid: int, sub: Any) -> None:
+            try:
+                async for subject, payload in sub:
+                    await send({"i": sid, "s": {"subj": subject, "p": payload}})
+                await send({"i": sid, "end": True})
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+
+        store = self.store
+        pending: set[asyncio.Task] = set()
+
+        async def handle_request(rid: int, op: str, args: list) -> None:
+            try:
+                value: Any = None
+                if op == "kv_put":
+                    value = await store.kv_put(args[0], args[1], args[2])
+                elif op == "kv_create":
+                    value = await store.kv_create(args[0], args[1], args[2])
+                elif op == "kv_get":
+                    e = await store.kv_get(args[0])
+                    value = _enc_entry(e) if e else None
+                elif op == "kv_get_prefix":
+                    value = [_enc_entry(e) for e in await store.kv_get_prefix(args[0])]
+                elif op == "kv_delete":
+                    value = await store.kv_delete(args[0])
+                elif op == "kv_delete_prefix":
+                    value = await store.kv_delete_prefix(args[0])
+                elif op == "watch_prefix":
+                    watch = await store.watch_prefix(args[0])
+                    sid = next(stream_ids)
+                    stream_handles[sid] = watch
+                    streams[sid] = asyncio.get_running_loop().create_task(
+                        pump_watch(sid, watch)
+                    )
+                    value = {
+                        "sid": sid,
+                        "snapshot": [_enc_entry(e) for e in watch.snapshot()],
+                    }
+                elif op == "lease_grant":
+                    value = await store.lease_grant(args[0])
+                    conn_leases.add(value)
+                elif op == "lease_keepalive":
+                    value = await store.lease_keepalive(args[0])
+                elif op == "lease_revoke":
+                    await store.lease_revoke(args[0])
+                    conn_leases.discard(args[0])
+                    value = True
+                elif op == "publish":
+                    await store.publish(args[0], args[1])
+                    value = True
+                elif op == "subscribe":
+                    sub = await store.subscribe(args[0])
+                    sid = next(stream_ids)
+                    stream_handles[sid] = sub
+                    streams[sid] = asyncio.get_running_loop().create_task(
+                        pump_sub(sid, sub)
+                    )
+                    value = {"sid": sid}
+                elif op == "stream_close":
+                    sid = args[0]
+                    handle = stream_handles.pop(sid, None)
+                    task = streams.pop(sid, None)
+                    if handle is not None:
+                        await handle.close()
+                    if task is not None:
+                        task.cancel()
+                    value = True
+                elif op == "queue_push":
+                    value = await store.queue_push(args[0], args[1])
+                elif op == "queue_pop":
+                    value = _enc_qmsg(
+                        await store.queue_pop(args[0], args[1], args[2])
+                    )
+                elif op == "queue_ack":
+                    value = await store.queue_ack(args[0], args[1])
+                elif op == "queue_len":
+                    value = await store.queue_len(args[0])
+                elif op == "obj_put":
+                    await store.obj_put(args[0], args[1], args[2])
+                    value = True
+                elif op == "obj_get":
+                    value = await store.obj_get(args[0], args[1])
+                elif op == "obj_delete":
+                    value = await store.obj_delete(args[0], args[1])
+                elif op == "obj_list":
+                    value = await store.obj_list(args[0])
+                elif op == "ping":
+                    value = "pong"
+                else:
+                    raise ValueError(f"unknown op {op!r}")
+                await send({"i": rid, "ok": True, "v": value})
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+            except Exception as exc:  # structured error back to caller
+                try:
+                    await send({"i": rid, "ok": False, "e": f"{type(exc).__name__}: {exc}"})
+                except ConnectionError:
+                    pass
+
+        try:
+            while True:
+                try:
+                    msg = await read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                # each request runs concurrently so a blocking queue_pop
+                # doesn't stall keepalives on the same connection
+                task = asyncio.get_running_loop().create_task(
+                    handle_request(msg["i"], msg["op"], msg.get("a", []))
+                )
+                pending.add(task)
+                task.add_done_callback(pending.discard)
+        finally:
+            for task in pending:
+                task.cancel()
+            for task in streams.values():
+                task.cancel()
+            for handle in stream_handles.values():
+                try:
+                    await handle.close()
+                except Exception:
+                    pass
+            # a dropped connection revokes its leases (liveness semantics:
+            # same effect as etcd lease expiry in the reference)
+            for lid in conn_leases:
+                try:
+                    await store.lease_revoke(lid)
+                except Exception:
+                    pass
+            self._conn_writers.discard(writer)
+            writer.close()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="dynamo-tpu coordinator store")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=4222)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    server = StoreServer(host=args.host, port=args.port)
+    asyncio.run(server.serve_forever())
+
+
+if __name__ == "__main__":
+    main()

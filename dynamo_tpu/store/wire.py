@@ -1,0 +1,46 @@
+"""Length-prefixed msgpack framing shared by the store server and client."""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any
+
+import msgpack
+
+MAX_FRAME = 256 * 1024 * 1024  # 256 MiB: object store blobs can be large
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(4)
+    (length,) = struct.unpack("<I", header)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    body = await reader.readexactly(length)
+    return msgpack.unpackb(body, raw=False)
+
+
+def write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
+    body = msgpack.packb(obj, use_bin_type=True)
+    writer.write(struct.pack("<I", len(body)) + body)
+
+
+async def shutdown_server(
+    server: asyncio.AbstractServer | None,
+    conn_writers: set[asyncio.StreamWriter],
+    drain_timeout_s: float = 5.0,
+) -> None:
+    """Close a listener and force-close its live connections.
+
+    py3.12's ``Server.wait_closed()`` blocks until every connection handler
+    returns, so open client connections must be closed first.
+    """
+    if server is not None:
+        server.close()
+    for w in list(conn_writers):
+        w.close()
+    if server is not None:
+        try:
+            await asyncio.wait_for(server.wait_closed(), timeout=drain_timeout_s)
+        except asyncio.TimeoutError:  # pragma: no cover
+            pass

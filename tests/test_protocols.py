@@ -1,0 +1,137 @@
+"""Tests for the protocols layer (≈ reference lib/llm/tests/{openai_completions,aggregators}.rs)."""
+
+import json
+
+from dynamo_tpu.protocols.aggregators import ChatAggregator, CompletionAggregator
+from dynamo_tpu.protocols.annotated import Annotated
+from dynamo_tpu.protocols.common import FinishReason
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionRequest,
+    ChatDeltaGenerator,
+    CompletionDeltaGenerator,
+    CompletionRequest,
+    ExtOptions,
+    Usage,
+)
+from dynamo_tpu.protocols.sse import SseDecoder, encode_done, encode_sse
+
+
+def test_chat_request_adaptation():
+    req = ChatCompletionRequest.model_validate(
+        {
+            "model": "llama",
+            "messages": [{"role": "user", "content": "hi"}],
+            "temperature": 0.0,
+            "max_tokens": 7,
+            "stop": "END",
+            "ext": {"ignore_eos": True, "top_k": 5},
+        }
+    )
+    s = req.sampling_options()
+    assert s.use_greedy is True and s.temperature is None and s.top_k == 5
+    sc = req.stop_conditions()
+    assert sc.max_tokens == 7 and sc.stop == ["END"] and sc.ignore_eos
+
+
+def test_nvext_alias_accepted():
+    req = ChatCompletionRequest.model_validate(
+        {
+            "model": "m",
+            "messages": [{"role": "user", "content": "x"}],
+            "nvext": {"greedy_sampling": True},
+        }
+    )
+    assert req.extension().greedy_sampling is True
+
+
+def test_multimodal_content_parts():
+    req = ChatCompletionRequest.model_validate(
+        {
+            "model": "m",
+            "messages": [
+                {
+                    "role": "user",
+                    "content": [
+                        {"type": "text", "text": "describe "},
+                        {"type": "image_url", "image_url": {"url": "http://x/y.png"}},
+                        {"type": "text", "text": "this"},
+                    ],
+                }
+            ],
+        }
+    )
+    assert req.messages[0].text_content() == "describe this"
+
+
+def test_completion_prompt_forms():
+    for prompt in ["abc", ["a", "b"], [1, 2, 3], [[1, 2], [3]]]:
+        req = CompletionRequest.model_validate({"model": "m", "prompt": prompt})
+        assert req.prompt == prompt
+
+
+def test_sse_roundtrip():
+    wire = encode_sse({"a": 1}, event="delta", id="7", comments=["keepalive"])
+    wire += encode_sse("plain text")
+    wire += encode_done()
+    dec = SseDecoder()
+    msgs = []
+    # feed in awkward chunk sizes to exercise incremental parsing
+    for i in range(0, len(wire), 7):
+        msgs.extend(dec.feed(wire[i : i + 7]))
+    assert len(msgs) == 3
+    assert msgs[0].event == "delta" and msgs[0].json() == {"a": 1}
+    assert msgs[0].comments == ["keepalive"] and msgs[0].id == "7"
+    assert msgs[1].data == "plain text"
+    assert msgs[2].is_done
+
+
+def test_sse_multiline_data():
+    wire = encode_sse("line1\nline2")
+    dec = SseDecoder()
+    (msg,) = list(dec.feed(wire))
+    assert msg.data == "line1\nline2"
+
+
+def test_annotated_envelope():
+    a = Annotated.from_data({"x": 1})
+    assert not a.is_error
+    e = Annotated.from_error("boom")
+    assert e.is_error and e.error_message() == "boom"
+    ann = Annotated.from_annotation("ttft_ms", 12.5)
+    assert ann.event == "ttft_ms" and json.loads(ann.comment[0]) == 12.5
+
+
+def test_chat_delta_stream_and_aggregate():
+    gen = ChatDeltaGenerator(model="llama")
+    chunks = [
+        gen.text_chunk("Hel"),
+        gen.text_chunk("lo"),
+        gen.finish_chunk(FinishReason.STOP, usage=Usage(prompt_tokens=3, completion_tokens=2, total_tokens=5)),
+    ]
+    # first chunk carries the role
+    assert chunks[0].choices[0].delta.role == "assistant"
+    assert chunks[1].choices[0].delta.role is None
+    resp = ChatAggregator.aggregate(chunks)
+    assert resp.choices[0].message.content == "Hello"
+    assert resp.choices[0].finish_reason == "stop"
+    assert resp.usage.total_tokens == 5
+    assert resp.id == gen.id
+
+
+def test_completion_delta_stream_and_aggregate():
+    gen = CompletionDeltaGenerator(model="llama")
+    chunks = [gen.text_chunk("a"), gen.text_chunk("b"), gen.finish_chunk("length")]
+    resp = CompletionAggregator.aggregate(chunks)
+    assert resp.choices[0].text == "ab"
+    assert resp.choices[0].finish_reason == "length"
+
+
+def test_finish_reason_wire_mapping():
+    gen = ChatDeltaGenerator(model="m")
+    c = gen.finish_chunk(FinishReason.CANCELLED)
+    assert c.choices[0].finish_reason == "stop"  # OpenAI wire has no 'cancelled'
+
+
+def test_ext_extra_fields_allowed():
+    ext = ExtOptions.model_validate({"ignore_eos": True, "custom_field": 42})
+    assert ext.ignore_eos and ext.model_extra["custom_field"] == 42

@@ -1,0 +1,200 @@
+"""Distributed runtime tests (≈ reference lib/runtime/tests/{pipeline,lifecycle}.rs).
+
+Two deployment shapes are exercised:
+- static: one process, in-memory store
+- distributed: coordinator on TCP + two DistributedRuntimes ("processes")
+  in one event loop, talking over real sockets.
+"""
+
+import asyncio
+from typing import Any, AsyncIterator
+
+import pytest
+
+from dynamo_tpu.runtime.component import Instance
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, FnEngine, collect
+from dynamo_tpu.runtime.pipeline import Operator, build_pipeline
+from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+from dynamo_tpu.store.memory import MemoryStore
+from dynamo_tpu.store.server import StoreServer
+
+
+async def echo_stream(request: Any, ctx: Context) -> AsyncIterator[Any]:
+    """Stream each token of the request back (≈ reference EchoEngineCore)."""
+    for tok in request["tokens"]:
+        if ctx.is_stopped:
+            return
+        yield {"token": tok}
+
+
+def make_static_config() -> RuntimeConfig:
+    return RuntimeConfig(static=True, worker_host="127.0.0.1", lease_ttl_s=2.0,
+                         lease_keepalive_s=0.5)
+
+
+async def test_static_serve_and_call():
+    drt = await DistributedRuntime.create(config=make_static_config())
+    try:
+        ep = drt.namespace("test").component("echo").endpoint("generate")
+        await ep.serve(FnEngine(echo_stream))
+        client = await ep.client()
+        ids = await client.wait_for_instances(timeout_s=5)
+        assert len(ids) == 1
+        stream = await client.generate_direct(ids[0], {"tokens": [1, 2, 3]})
+        items = [i async for i in stream]
+        assert items == [{"token": 1}, {"token": 2}, {"token": 3}]
+        await client.close()
+    finally:
+        await drt.shutdown()
+
+
+async def test_push_router_round_robin_and_failover():
+    """Two workers; round-robin spreads load; killing one fails over."""
+    server = StoreServer(MemoryStore(lease_sweep_interval_s=0.1), port=0)
+    await server.start()
+    cfg = lambda: RuntimeConfig(  # noqa: E731
+        store_port=server.port, worker_host="127.0.0.1",
+        lease_ttl_s=1.0, lease_keepalive_s=0.2,
+    )
+    w1 = await DistributedRuntime.create(config=cfg())
+    w2 = await DistributedRuntime.create(config=cfg())
+    frontend = await DistributedRuntime.create(config=cfg())
+
+    async def worker_engine(tag: str):
+        async def gen(request: Any, ctx: Context) -> AsyncIterator[Any]:
+            yield {"worker": tag, "echo": request}
+
+        return FnEngine(gen)
+
+    try:
+        for drt, tag in ((w1, "w1"), (w2, "w2")):
+            ep = drt.namespace("ns").component("gen").endpoint("generate")
+            await ep.serve(await worker_engine(tag))
+
+        ep = frontend.namespace("ns").component("gen").endpoint("generate")
+        client = await ep.client()
+        await client.wait_for_instances(timeout_s=5)
+        # wait until both instances are discovered
+        for _ in range(50):
+            if len(client.instance_ids()) == 2:
+                break
+            await asyncio.sleep(0.05)
+        assert len(client.instance_ids()) == 2
+
+        router = PushRouter(client, RouterMode.ROUND_ROBIN)
+        seen = set()
+        for i in range(4):
+            items = await collect(router.generate({"n": i}, Context()))
+            seen.add(items[0]["worker"])
+        assert seen == {"w1", "w2"}
+
+        # kill w1: lease revoked => discovery prunes it; router fails over
+        await w1.shutdown()
+        for _ in range(100):
+            if len(client.instance_ids()) == 1:
+                break
+            await asyncio.sleep(0.05)
+        assert len(client.instance_ids()) == 1
+        for i in range(3):
+            items = await collect(router.generate({"n": i}, Context()))
+            assert items[0]["worker"] == "w2"
+        await client.close()
+    finally:
+        for drt in (w2, frontend):
+            await drt.shutdown()
+        await server.stop()
+
+
+async def test_cancellation_stops_worker_stream():
+    """Client-side kill propagates to the worker's Context."""
+    drt = await DistributedRuntime.create(config=make_static_config())
+    try:
+        produced = []
+
+        async def slow(request: Any, ctx: Context) -> AsyncIterator[Any]:
+            for i in range(1000):
+                if ctx.is_stopped:
+                    return
+                produced.append(i)
+                yield {"i": i}
+                await asyncio.sleep(0.01)
+
+        ep = drt.namespace("ns").component("slow").endpoint("generate")
+        await ep.serve(FnEngine(slow))
+        client = await ep.client()
+        (iid,) = await client.wait_for_instances(5)
+        ctx = Context()
+        stream = await client.generate_direct(iid, {}, ctx)
+        got = []
+        async for item in stream:
+            got.append(item)
+            if len(got) == 3:
+                ctx.kill()
+                break
+        await asyncio.sleep(0.3)
+        n = len(produced)
+        await asyncio.sleep(0.3)
+        assert len(produced) == n, "worker kept producing after kill"
+        assert n < 1000
+        await client.close()
+    finally:
+        await drt.shutdown()
+
+
+async def test_pipeline_operators():
+    """Forward/backward edges compose (≈ reference pipeline.rs tests)."""
+
+    class TokenizeOp(Operator):
+        async def forward(self, request: str, context: Context):
+            return {"tokens": [ord(c) for c in request]}, {"n": len(request)}
+
+        async def backward(self, stream, state, context):
+            async for item in stream:
+                yield chr(item["token"] + 1)
+
+    engine = build_pipeline(TokenizeOp(), FnEngine(echo_stream))
+    out = await collect(engine.generate("abc", Context()))
+    assert out == ["b", "c", "d"]
+
+
+async def test_pipeline_type_errors():
+    with pytest.raises(TypeError):
+        build_pipeline(FnEngine(echo_stream), FnEngine(echo_stream))
+    with pytest.raises(ValueError):
+        build_pipeline()
+
+
+async def test_component_events_pubsub():
+    drt = await DistributedRuntime.create(config=make_static_config())
+    try:
+        comp = drt.namespace("ns").component("worker")
+        sub = await comp.subscribe("kv_events")
+        await comp.publish("kv_events", {"block_hash": 42, "op": "stored"})
+        it = sub.__aiter__()
+        subject, payload = await asyncio.wait_for(it.__anext__(), 5)
+        assert subject == "ns.worker.kv_events"
+        assert payload == {"block_hash": 42, "op": "stored"}
+        await sub.close()
+    finally:
+        await drt.shutdown()
+
+
+async def test_static_client_without_discovery():
+    """Static mode: direct instance without store watch
+    (≈ reference static client, component.rs:294-300)."""
+    drt = await DistributedRuntime.create(config=make_static_config())
+    try:
+        ep = drt.namespace("ns").component("echo").endpoint("generate")
+        inst = await ep.serve(FnEngine(echo_stream))
+        static = Instance(
+            instance_id=inst.instance_id, host="127.0.0.1", port=inst.port,
+            namespace="ns", component="echo", endpoint="generate",
+        )
+        client = await ep.client(static_instance=static)
+        stream = await client.generate_direct(inst.instance_id, {"tokens": [9]})
+        assert [i async for i in stream] == [{"token": 9}]
+        await client.close()
+    finally:
+        await drt.shutdown()

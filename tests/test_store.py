@@ -1,0 +1,192 @@
+"""Control-plane store tests: memory semantics + TCP server/client parity.
+
+Mirrors the reference's strategy of exercising real-but-local control-plane
+processes (reference: lib/bindings/python/tests/test_kv_bindings.py spawns
+real nats-server+etcd); here the coordinator runs in-process on a loopback
+socket.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.store.base import subject_matches
+from dynamo_tpu.store.client import StoreClient
+from dynamo_tpu.store.memory import MemoryStore
+from dynamo_tpu.store.server import StoreServer
+
+
+def test_subject_matching():
+    assert subject_matches("a.b.c", "a.b.c")
+    assert not subject_matches("a.b.c", "a.b.d")
+    assert subject_matches("a.*.c", "a.x.c")
+    assert not subject_matches("a.*.c", "a.x.y.c")
+    assert subject_matches("a.>", "a.b.c.d")
+    assert not subject_matches("a.>", "a")
+    assert subject_matches(">", "anything.at.all")
+
+
+async def _exercise_kv(store):
+    assert await store.kv_get("x") is None
+    v1 = await store.kv_put("x", b"1")
+    e = await store.kv_get("x")
+    assert e.value == b"1" and e.version == v1
+    assert await store.kv_create("x", b"2") is False  # CAS: exists
+    assert await store.kv_create("y", b"2") is True
+    entries = await store.kv_get_prefix("")
+    assert {e.key for e in entries} == {"x", "y"}
+    assert await store.kv_delete("x") is True
+    assert await store.kv_delete("x") is False
+
+
+async def _exercise_watch(store):
+    await store.kv_put("ns/a", b"1")
+    watch = await store.watch_prefix("ns/")
+    assert [e.key for e in watch.snapshot()] == ["ns/a"]
+    await store.kv_put("ns/b", b"2")
+    await store.kv_delete("ns/a")
+    await store.kv_put("other/c", b"3")  # outside prefix: no event
+    it = watch.__aiter__()
+    ev1 = await asyncio.wait_for(it.__anext__(), 5)
+    assert ev1.type == "put" and ev1.entry.key == "ns/b"
+    ev2 = await asyncio.wait_for(it.__anext__(), 5)
+    assert ev2.type == "delete" and ev2.entry.key == "ns/a"
+    await watch.close()
+
+
+async def _exercise_lease(store):
+    lid = await store.lease_grant(ttl_s=0.4)
+    await store.kv_put("lease/k1", b"v", lease_id=lid)
+    watch = await store.watch_prefix("lease/")
+    assert len(watch.snapshot()) == 1
+    # keepalive holds it
+    for _ in range(3):
+        await asyncio.sleep(0.2)
+        assert await store.lease_keepalive(lid) is True
+    assert await store.kv_get("lease/k1") is not None
+    # stop keepalives: expiry deletes the key and notifies the watcher
+    it = watch.__aiter__()
+    ev = await asyncio.wait_for(it.__anext__(), 5)
+    assert ev.type == "delete" and ev.entry.key == "lease/k1"
+    assert await store.kv_get("lease/k1") is None
+    assert await store.lease_keepalive(lid) is False
+    await watch.close()
+
+
+async def _exercise_pubsub(store):
+    sub = await store.subscribe("events.*")
+    await store.publish("events.kv", b"hello")
+    await store.publish("unrelated.kv", b"nope")
+    it = sub.__aiter__()
+    subject, payload = await asyncio.wait_for(it.__anext__(), 5)
+    assert subject == "events.kv" and payload == b"hello"
+    await sub.close()
+
+
+async def _exercise_queue(store):
+    assert await store.queue_pop("q1", timeout_s=0.05) is None
+    await store.queue_push("q1", b"job1")
+    await store.queue_push("q1", b"job2")
+    assert await store.queue_len("q1") == 2
+    m1 = await store.queue_pop("q1", timeout_s=1)
+    assert m1.payload == b"job1"
+    assert await store.queue_ack("q1", m1.id) is True
+    # unacked message gets redelivered after visibility timeout
+    m2 = await store.queue_pop("q1", timeout_s=1, visibility_s=0.3)
+    assert m2.payload == b"job2"
+    m2b = await store.queue_pop("q1", timeout_s=5)
+    assert m2b.payload == b"job2"  # redelivered
+    await store.queue_ack("q1", m2b.id)
+    assert await store.queue_len("q1") == 0
+
+
+async def _exercise_objects(store):
+    blob = b"\x00\x01" * 1000
+    await store.obj_put("models", "card.json", blob)
+    assert await store.obj_get("models", "card.json") == blob
+    assert await store.obj_list("models") == ["card.json"]
+    assert await store.obj_delete("models", "card.json") is True
+    assert await store.obj_get("models", "card.json") is None
+
+
+EXERCISES = [
+    _exercise_kv,
+    _exercise_watch,
+    _exercise_lease,
+    _exercise_pubsub,
+    _exercise_queue,
+    _exercise_objects,
+]
+
+
+@pytest.mark.parametrize("exercise", EXERCISES, ids=lambda f: f.__name__)
+async def test_memory_store(exercise):
+    store = MemoryStore(lease_sweep_interval_s=0.1)
+    try:
+        await exercise(store)
+    finally:
+        await store.close()
+
+
+@pytest.mark.parametrize("exercise", EXERCISES, ids=lambda f: f.__name__)
+async def test_tcp_store(exercise):
+    server = StoreServer(MemoryStore(lease_sweep_interval_s=0.1), port=0)
+    await server.start()
+    client = await StoreClient.connect(port=server.port)
+    try:
+        await exercise(client)
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_tcp_disconnect_revokes_leases():
+    """Dropping the client connection revokes its leases — the liveness
+    primitive workers rely on (≈ reference etcd lease expiry on crash)."""
+    server = StoreServer(MemoryStore(lease_sweep_interval_s=0.1), port=0)
+    await server.start()
+    observer = await StoreClient.connect(port=server.port)
+    worker = await StoreClient.connect(port=server.port)
+    lid = await worker.lease_grant(ttl_s=60)
+    await worker.kv_put("instances/w1", b"alive", lease_id=lid)
+    watch = await observer.watch_prefix("instances/")
+    assert len(watch.snapshot()) == 1
+    await worker.close()  # simulate crash
+    it = watch.__aiter__()
+    ev = await asyncio.wait_for(it.__anext__(), 5)
+    assert ev.type == "delete" and ev.entry.key == "instances/w1"
+    await observer.close()
+    await server.stop()
+
+
+async def test_tcp_concurrent_queue_pop_does_not_block_connection():
+    """A blocking queue_pop must not stall other requests on the connection."""
+    server = StoreServer(MemoryStore(), port=0)
+    await server.start()
+    client = await StoreClient.connect(port=server.port)
+    pop_task = asyncio.create_task(client.queue_pop("jobs", timeout_s=5))
+    await asyncio.sleep(0.05)
+    # unary op completes while pop is pending
+    assert await asyncio.wait_for(client.kv_put("k", b"v"), 2) > 0
+    await client.queue_push("jobs", b"work")
+    msg = await asyncio.wait_for(pop_task, 2)
+    assert msg.payload == b"work"
+    await client.close()
+    await server.stop()
+
+
+async def test_kv_put_reattaches_lease_ownership():
+    """Re-registering a key under a new lease detaches it from the old one:
+    the stale lease's expiry must not delete the live registration."""
+    store = MemoryStore(lease_sweep_interval_s=0.05)
+    try:
+        old = await store.lease_grant(ttl_s=0.2)
+        await store.kv_put("instances/w", b"v1", lease_id=old)
+        new = await store.lease_grant(ttl_s=60)
+        await store.kv_put("instances/w", b"v2", lease_id=new)
+        await asyncio.sleep(0.5)  # old lease expires and is swept
+        e = await store.kv_get("instances/w")
+        assert e is not None and e.value == b"v2"
+        await store.lease_keepalive(new)
+    finally:
+        await store.close()
