@@ -1,0 +1,176 @@
+"""Backend post-processing: incremental detokenize + stop triggers.
+
+Analogue of the reference's Backend operator (reference:
+lib/llm/src/backend.rs:63-496 — Decoder/DecodeStream wrapping, StopTrigger
+for hidden/visible stop tokens and max-token limits, and the "jail" that
+holds back text while it partially matches a stop string).
+
+Sits between the preprocessor and the engine/router: forward passes the
+``PreprocessedRequest`` through; backward maps the engine's token-delta
+stream into a text-delta stream, terminating it the moment a stop
+condition fires (and telling the engine to stop via the context).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.pipeline import Operator
+from dynamo_tpu.tokenizer import DecodeStream, Tokenizer
+
+
+def _longest_partial_suffix(text: str, stops: list[str]) -> int:
+    """Length of the longest suffix of ``text`` that is a proper prefix of
+    any stop string — the portion that must stay jailed."""
+    best = 0
+    for stop in stops:
+        max_k = min(len(text), len(stop) - 1)
+        for k in range(max_k, 0, -1):
+            if text.endswith(stop[:k]):
+                best = max(best, k)
+                break
+    return best
+
+
+@dataclass
+class SequenceState:
+    """Per-request detok/stop state (≈ reference backend.rs SeqResult)."""
+
+    decode: DecodeStream
+    stop_strings: list[str]
+    hidden_stop_ids: set[int]
+    max_tokens: Optional[int]
+    min_tokens: Optional[int]
+    jailed: str = ""
+    completion_tokens: int = 0
+    finish: Optional[FinishReason] = None
+
+    def step(self, token_ids: list[int]) -> tuple[str, Optional[FinishReason]]:
+        """Feed engine token deltas; returns (text_to_emit, finish_reason)."""
+        out_parts: list[str] = []
+        for tid in token_ids:
+            if self.finish is not None:
+                break
+            self.completion_tokens += 1
+            past_min = (
+                self.min_tokens is None or self.completion_tokens >= self.min_tokens
+            )
+            if tid in self.hidden_stop_ids and past_min:
+                # hidden stop (eos): stop now, do not emit its text
+                self.finish = FinishReason.STOP
+                break
+            text = self.decode.step(tid)
+            if text:
+                emit, fin = self._apply_stop_strings(text, past_min)
+                if emit:
+                    out_parts.append(emit)
+                if fin is not None:
+                    self.finish = fin
+                    break
+            if self.max_tokens is not None and self.completion_tokens >= self.max_tokens:
+                self.finish = FinishReason.LENGTH
+                break
+        return "".join(out_parts), self.finish
+
+    def _apply_stop_strings(
+        self, new_text: str, past_min: bool
+    ) -> tuple[str, Optional[FinishReason]]:
+        if not self.stop_strings:
+            return new_text, None
+        pending = self.jailed + new_text
+        if past_min:
+            # cut at the earliest occurrence across all stop strings
+            hits = [i for s in self.stop_strings if (i := pending.find(s)) != -1]
+            if hits:
+                emit = pending[: min(hits)]
+                self.jailed = ""
+                return emit, FinishReason.STOP
+        # jail the longest tail that could still become a stop string
+        hold = _longest_partial_suffix(pending, self.stop_strings)
+        emit = pending[: len(pending) - hold] if hold else pending
+        self.jailed = pending[len(pending) - hold :] if hold else ""
+        return emit, None
+
+    def flush(self) -> str:
+        """Release any jailed text at end-of-stream (no stop matched)."""
+        out, self.jailed = self.jailed, ""
+        return out
+
+
+class Backend(Operator):
+    """Token-stream → text-stream operator."""
+
+    def __init__(self, tokenizer: Tokenizer, eos_token_ids: Optional[list[int]] = None):
+        self.tokenizer = tokenizer
+        self.eos_token_ids = set(eos_token_ids or [])
+
+    async def forward(
+        self, request: PreprocessedRequest, context: Context
+    ) -> tuple[PreprocessedRequest, SequenceState]:
+        stop = request.stop.apply_ignore_eos()
+        hidden = set(stop.stop_token_ids_hidden)
+        if not stop.ignore_eos:
+            hidden |= self.eos_token_ids
+        state = SequenceState(
+            decode=self.tokenizer.decode_stream(
+                skip_special_tokens=request.output.skip_special_tokens
+            ),
+            stop_strings=list(stop.stop),
+            hidden_stop_ids=hidden,
+            max_tokens=stop.max_tokens,
+            min_tokens=stop.min_tokens,
+        )
+        return request, state
+
+    async def backward(
+        self,
+        stream: AsyncIterator[Any],
+        state: SequenceState,
+        context: Context,
+    ) -> AsyncIterator[LLMEngineOutput]:
+        async for raw in stream:
+            item = (
+                raw
+                if isinstance(raw, LLMEngineOutput)
+                else LLMEngineOutput.model_validate(raw)
+            )
+            text, finish = state.step(item.token_ids)
+            if text or item.finish_reason is None and finish is None:
+                yield LLMEngineOutput(
+                    request_id=item.request_id,
+                    token_ids=item.token_ids,
+                    text=text,
+                    cum_log_probs=item.cum_log_probs,
+                    log_probs=item.log_probs,
+                )
+            if finish is not None:
+                # our stop fired first: tell the engine to stop generating
+                context.stop_generating()
+                yield LLMEngineOutput(
+                    request_id=item.request_id,
+                    finish_reason=finish,
+                    prompt_tokens=item.prompt_tokens,
+                    completion_tokens=state.completion_tokens,
+                )
+                return
+            if item.finish_reason is not None:
+                # engine-side finish (e.g. its own length accounting)
+                tail = state.flush()
+                yield LLMEngineOutput(
+                    request_id=item.request_id,
+                    text=tail or None,
+                    finish_reason=item.finish_reason,
+                    prompt_tokens=item.prompt_tokens,
+                    completion_tokens=state.completion_tokens,
+                )
+                return
+        # stream ended without an explicit finish: treat as cancelled
+        tail = state.flush()
+        yield LLMEngineOutput(
+            text=tail or None,
+            finish_reason=FinishReason.CANCELLED,
+            completion_tokens=state.completion_tokens,
+        )

@@ -1,0 +1,292 @@
+"""dynamo-tpu CLI: run/serve/store/models.
+
+Analogue of the reference's launch binaries (reference:
+launch/dynamo-run/src/{lib.rs:45-278, opt.rs:23-216, flags.rs:1-205} —
+the in×out matrix; launch/llmctl — model registration ctl;
+components/http — standalone frontend).
+
+  dynamo-tpu run --in {http|text|dyn://NS.COMP.EP} \
+                 --out {echo_core|echo_full|jax|dyn://NS.COMP.EP} \
+                 [--model-path DIR] [--model-name NAME] ...
+
+  dynamo-tpu store            # run the coordinator (replaces etcd+NATS)
+  dynamo-tpu models list      # ≈ llmctl
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+from typing import Any, Optional
+
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.logging import init_logging
+
+log = logging.getLogger("dynamo_tpu.cli")
+
+DYN_SCHEME = "dyn://"
+
+
+def parse_dyn_path(value: str) -> tuple[str, str, str]:
+    """Parse dyn://namespace.component.endpoint
+    (reference: lib/runtime/src/protocols.rs Endpoint path parsing)."""
+    if not value.startswith(DYN_SCHEME):
+        raise ValueError(f"expected {DYN_SCHEME} prefix: {value!r}")
+    parts = value[len(DYN_SCHEME) :].split(".")
+    if len(parts) != 3 or not all(parts):
+        raise ValueError(
+            f"expected dyn://namespace.component.endpoint, got {value!r}"
+        )
+    return parts[0], parts[1], parts[2]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dynamo-tpu", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    run = sub.add_parser("run", help="run an input×output engine pairing")
+    run.add_argument("--in", dest="in_mode", default="http",
+                     help="http | text | dyn://ns.comp.ep (serve as worker)")
+    run.add_argument("--out", dest="out_mode", default="echo_full",
+                     help="echo_core | echo_full | jax | dyn://ns.comp.ep")
+    run.add_argument("--model-path", default=None,
+                     help="local model directory (tokenizer/config/weights)")
+    run.add_argument("--model-name", default=None)
+    run.add_argument("--http-host", default="0.0.0.0")
+    run.add_argument("--http-port", type=int, default=8000)
+    run.add_argument("--store-host", default=None)
+    run.add_argument("--store-port", type=int, default=None)
+    run.add_argument("--static", action="store_true",
+                     help="single-process mode: no coordinator needed")
+    run.add_argument("--max-tokens-default", type=int, default=None)
+    # engine knobs (reference: flags.rs)
+    run.add_argument("--tensor-parallel-size", type=int, default=1)
+    run.add_argument("--num-nodes", type=int, default=1)
+    run.add_argument("--node-rank", type=int, default=0)
+    run.add_argument("--leader-addr", default="")
+    run.add_argument("--extra-engine-args", default=None,
+                     help="JSON file with engine-specific settings")
+    run.add_argument("--router-mode", default="round_robin",
+                     choices=["random", "round_robin", "kv"])
+
+    store = sub.add_parser("store", help="run the coordinator store")
+    store.add_argument("--host", default="0.0.0.0")
+    store.add_argument("--port", type=int, default=4222)
+
+    models = sub.add_parser("models", help="model registry ctl (≈ llmctl)")
+    models.add_argument("action", choices=["list", "remove"])
+    models.add_argument("name", nargs="?")
+    models.add_argument("--store-host", default="127.0.0.1")
+    models.add_argument("--store-port", type=int, default=4222)
+    return p
+
+
+def _load_model_assets(args: Any):
+    """Load tokenizer + optional chat template from --model-path."""
+    from dynamo_tpu.preprocessor import PromptFormatter
+    from dynamo_tpu.tokenizer import Tokenizer
+
+    if not args.model_path:
+        raise SystemExit(f"--out {args.out_mode} requires --model-path")
+    tokenizer = Tokenizer.from_file(args.model_path)
+    try:
+        formatter = PromptFormatter.from_model_dir(args.model_path)
+    except Exception:
+        formatter = None
+        log.warning("no chat template found; chat requests will fail")
+    model_name = args.model_name or args.model_path.rstrip("/").rsplit("/", 1)[-1]
+    return tokenizer, formatter, model_name
+
+
+def _wrap_pipeline(args: Any, core, eos_ids: list[int]):
+    """preprocessor → backend → core engine."""
+    from dynamo_tpu.backend import Backend
+    from dynamo_tpu.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.runtime.pipeline import build_pipeline
+
+    tokenizer, formatter, model_name = _load_model_assets(args)
+    pre = OpenAIPreprocessor(tokenizer, formatter, model_name=model_name)
+    backend = Backend(tokenizer, eos_token_ids=eos_ids)
+    return model_name, build_pipeline(pre, backend, core)
+
+
+async def _build_core_engine(args: Any):
+    """The tokens-in/tokens-out core engine for out={echo_core,jax}."""
+    if args.out_mode == "echo_core":
+        from dynamo_tpu.engines import EchoEngineCore
+
+        return EchoEngineCore(), []
+    try:
+        from dynamo_tpu.engine import JaxEngine, load_engine_config
+    except ImportError as exc:
+        raise SystemExit(f"jax engine unavailable: {exc}")
+    config = load_engine_config(args)
+    engine = await JaxEngine.launch(config)
+    return engine.as_async_engine(), engine.eos_token_ids
+
+
+async def _build_local_pipeline(args: Any):
+    core, eos_ids = await _build_core_engine(args)
+    return _wrap_pipeline(args, core, eos_ids)
+
+
+async def cmd_run(args: Any) -> None:
+    from dynamo_tpu.http.service import HttpService, ModelManager
+
+    out = args.out_mode
+    in_mode = args.in_mode
+    worker_mode = in_mode.startswith(DYN_SCHEME)
+
+    # ---- output side: build the engine -----------------------------------
+    if out in ("echo_core", "jax"):
+        if worker_mode:
+            # workers serve the core tokens-in/tokens-out engine; pre/post
+            # runs at the frontend (reference: subprocess engine pattern)
+            model_name = args.model_name or "worker"
+            engine, _ = await _build_core_engine(args)
+        else:
+            model_name, engine = await _build_local_pipeline(args)
+    elif out == "echo_full":
+        from dynamo_tpu.engines import EchoEngineFull
+
+        model_name = args.model_name or "echo"
+        engine = EchoEngineFull()
+    elif out.startswith(DYN_SCHEME):
+        # remote worker(s) behind a push router
+        from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        ns, comp, ep = parse_dyn_path(out)
+        cfg = _runtime_config(args)
+        drt = await DistributedRuntime.create(config=cfg)
+        client = await drt.namespace(ns).component(comp).endpoint(ep).client()
+        await client.wait_for_instances()
+        mode = (
+            RouterMode.ROUND_ROBIN
+            if args.router_mode == "round_robin"
+            else RouterMode.RANDOM
+        )
+        router = PushRouter(client, mode)
+        # remote workers speak PreprocessedRequest: wrap with local pre/post
+        model_name, engine = _wrap_pipeline(args, router, [])
+    else:
+        raise SystemExit(f"unknown --out {out!r}")
+
+    # ---- input side ------------------------------------------------------
+    if in_mode == "http":
+        manager = ModelManager()
+        manager.add_chat_model(model_name, engine)
+        manager.add_completion_model(model_name, engine)
+        service = HttpService(manager, host=args.http_host, port=args.http_port)
+        await service.start()
+        print(f"listening on http://{args.http_host}:{service.port}", flush=True)
+        await asyncio.Event().wait()
+    elif in_mode == "text":
+        await _interactive_text(engine, model_name)
+    elif in_mode.startswith(DYN_SCHEME):
+        # worker mode: serve the core engine on an endpoint
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        ns, comp, ep = parse_dyn_path(in_mode)
+        cfg = _runtime_config(args)
+        drt = await DistributedRuntime.create(config=cfg)
+        drt.runtime.install_signal_handlers()
+        endpoint = drt.namespace(ns).component(comp).endpoint(ep)
+        await endpoint.serve(engine)
+        print(f"worker serving {in_mode}", flush=True)
+        await drt.runtime.wait_shutdown()
+        await drt.shutdown()
+    else:
+        raise SystemExit(f"unknown --in {in_mode!r}")
+
+
+async def _interactive_text(engine: Any, model_name: str) -> None:
+    """REPL chat (reference: dynamo-run in=text)."""
+    from dynamo_tpu.protocols.openai import ChatCompletionRequest
+    from dynamo_tpu.runtime.engine import Context
+
+    messages: list[dict] = []
+    print(f"chatting with {model_name}; /clear resets, ctrl-d exits", flush=True)
+    loop = asyncio.get_running_loop()
+    while True:
+        try:
+            line = await loop.run_in_executor(None, lambda: input("> "))
+        except (EOFError, KeyboardInterrupt):
+            return
+        if not line.strip():
+            continue
+        if line.strip() == "/clear":
+            messages.clear()
+            continue
+        messages.append({"role": "user", "content": line})
+        req = ChatCompletionRequest.model_validate(
+            {"model": model_name, "messages": messages, "stream": True}
+        )
+        reply_parts: list[str] = []
+        async for chunk in engine.generate(req, Context()):
+            for choice in chunk.choices:
+                if choice.delta.content:
+                    reply_parts.append(choice.delta.content)
+                    print(choice.delta.content, end="", flush=True)
+        print()
+        messages.append({"role": "assistant", "content": "".join(reply_parts)})
+
+
+def _runtime_config(args: Any) -> RuntimeConfig:
+    overrides: dict[str, Any] = {}
+    if getattr(args, "static", False):
+        overrides["static"] = True
+    if getattr(args, "store_host", None):
+        overrides["store_host"] = args.store_host
+    if getattr(args, "store_port", None):
+        overrides["store_port"] = args.store_port
+    return RuntimeConfig.from_settings(**overrides)
+
+
+async def cmd_models(args: Any) -> None:
+    from dynamo_tpu.store.client import StoreClient
+
+    client = await StoreClient.connect(args.store_host, args.store_port)
+    try:
+        if args.action == "list":
+            entries = await client.kv_get_prefix("models/")
+            for e in entries:
+                print(e.key)
+            instances = await client.kv_get_prefix("instances/")
+            for e in instances:
+                print(e.key)
+        elif args.action == "remove":
+            if not args.name:
+                raise SystemExit("models remove requires a name")
+            n = await client.kv_delete_prefix(f"models/{args.name}")
+            print(f"removed {n} entries")
+    finally:
+        await client.close()
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    args = build_parser().parse_args(argv)
+    init_logging()
+    if args.command == "run":
+        try:
+            asyncio.run(cmd_run(args))
+        except KeyboardInterrupt:
+            pass
+    elif args.command == "store":
+        from dynamo_tpu.store.server import StoreServer
+
+        server = StoreServer(host=args.host, port=args.port)
+        try:
+            asyncio.run(server.serve_forever())
+        except KeyboardInterrupt:
+            pass
+    elif args.command == "models":
+        asyncio.run(cmd_models(args))
+    else:  # pragma: no cover
+        sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()

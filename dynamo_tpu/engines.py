@@ -1,0 +1,101 @@
+"""Built-in test engines + engine glue types.
+
+Analogue of the reference's engines glue (reference:
+lib/llm/src/engines.rs:41-296 — EchoEngineCore/EchoEngineFull,
+MultiNodeConfig). Echo engines validate the full pipeline without a model:
+``EchoEngineCore`` is tokens-in/tokens-out (sits behind the preprocessor +
+backend), ``EchoEngineFull`` is OpenAI-in/OpenAI-out.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from dataclasses import dataclass
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
+
+# Per-token delay knob, ≈ reference DYN_TOKEN_ECHO_DELAY_MS (engines.rs:66-75)
+TOKEN_ECHO_DELAY_MS = float(os.environ.get("DYN_TOKEN_ECHO_DELAY_MS", "10"))
+
+
+@dataclass
+class MultiNodeConfig:
+    """Multi-host engine bring-up settings (reference: engines.rs:41-58).
+
+    For JAX engines these feed jax.distributed.initialize: the leader is the
+    coordinator address, node_rank the process index.
+    """
+
+    num_nodes: int = 1
+    node_rank: int = 0
+    leader_addr: str = ""
+
+
+class EchoEngineCore(AsyncEngine):
+    """Tokens-in/tokens-out echo: streams the prompt back one token at a
+    time, honoring max_tokens and cancellation."""
+
+    async def _gen(
+        self, request: PreprocessedRequest, context: Context
+    ) -> AsyncIterator[LLMEngineOutput]:
+        if not isinstance(request, PreprocessedRequest):
+            request = PreprocessedRequest.model_validate(request)
+        delay = TOKEN_ECHO_DELAY_MS / 1000.0
+        max_tokens = request.stop.max_tokens
+        if max_tokens is None:
+            max_tokens = len(request.token_ids)
+        emitted = 0
+        for tok in request.token_ids:
+            if context.is_stopped or emitted >= max_tokens:
+                break
+            if delay:
+                await asyncio.sleep(delay)
+            yield LLMEngineOutput(request_id=request.request_id, token_ids=[int(tok)])
+            emitted += 1
+        reason = (
+            FinishReason.CANCELLED if context.is_stopped else FinishReason.LENGTH
+        )
+        yield LLMEngineOutput(
+            request_id=request.request_id,
+            finish_reason=reason,
+            prompt_tokens=len(request.token_ids),
+            completion_tokens=emitted,
+        )
+
+    def generate(self, request: Any, context: Context) -> EngineStream:
+        return self._gen(request, context)
+
+
+class EchoEngineFull(AsyncEngine):
+    """OpenAI-in/OpenAI-out echo: no tokenization at all; streams the last
+    message's text back word by word."""
+
+    async def _gen(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        from dynamo_tpu.protocols.openai import (
+            ChatCompletionRequest,
+            ChatDeltaGenerator,
+            CompletionDeltaGenerator,
+            CompletionRequest,
+        )
+
+        delay = TOKEN_ECHO_DELAY_MS / 1000.0
+        if isinstance(request, ChatCompletionRequest):
+            text = request.messages[-1].text_content() if request.messages else ""
+            gen = ChatDeltaGenerator(model=request.model)
+        else:
+            assert isinstance(request, CompletionRequest)
+            text = request.prompt if isinstance(request.prompt, str) else ""
+            gen = CompletionDeltaGenerator(model=request.model)
+        for word in text.split(" "):
+            if context.is_stopped:
+                break
+            if delay:
+                await asyncio.sleep(delay)
+            yield gen.text_chunk(word + " ")
+        yield gen.finish_chunk(FinishReason.STOP)
+
+    def generate(self, request: Any, context: Context) -> EngineStream:
+        return self._gen(request, context)

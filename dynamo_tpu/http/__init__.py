@@ -1,0 +1,5 @@
+"""OpenAI-compatible HTTP service (aiohttp)."""
+
+from dynamo_tpu.http.service import HttpService, ModelManager
+
+__all__ = ["HttpService", "ModelManager"]

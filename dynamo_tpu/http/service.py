@@ -1,0 +1,263 @@
+"""OpenAI-compatible HTTP frontend.
+
+Analogue of the reference's axum HTTP service (reference:
+lib/llm/src/http/service/{openai.rs:133-560, service_v2.rs:26-151,
+metrics.rs:36-311}): /v1/chat/completions, /v1/completions, /v1/models,
+SSE streaming, Prometheus middleware, model add/remove at runtime via the
+ModelManager (fed either programmatically or by the store-driven
+ModelWatcher in discovery.py).
+
+aiohttp replaces axum (fastapi/uvicorn are unavailable in this image and
+aiohttp's raw StreamResponse is lower overhead for SSE anyway).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+import time
+from typing import Optional
+
+from aiohttp import web
+from prometheus_client import (
+    CONTENT_TYPE_LATEST,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+from dynamo_tpu.protocols.aggregators import ChatAggregator, CompletionAggregator
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    ModelInfo,
+    ModelList,
+)
+from dynamo_tpu.protocols.sse import encode_done, encode_sse
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+log = logging.getLogger("dynamo_tpu.http")
+
+# -- Prometheus metrics (≈ reference http/service/metrics.rs) ---------------
+REQUEST_COUNTER = Counter(
+    "dynamo_http_requests_total",
+    "Total HTTP LLM requests",
+    ["model", "endpoint", "status"],
+)
+INFLIGHT_GAUGE = Gauge(
+    "dynamo_http_inflight_requests", "In-flight HTTP LLM requests", ["model"]
+)
+DURATION_HISTOGRAM = Histogram(
+    "dynamo_http_request_duration_seconds",
+    "HTTP LLM request duration",
+    ["model", "endpoint"],
+)
+TTFT_HISTOGRAM = Histogram(
+    "dynamo_http_time_to_first_token_seconds",
+    "Time to first streamed token",
+    ["model"],
+)
+
+
+class ModelManager:
+    """Live model registry: name → chat/completion pipeline engines.
+
+    (reference: http/service/discovery.rs ModelManager — models are added
+    and removed while the service runs.)
+    """
+
+    def __init__(self) -> None:
+        self.chat_engines: dict[str, AsyncEngine] = {}
+        self.completion_engines: dict[str, AsyncEngine] = {}
+        self._created: dict[str, int] = {}
+
+    def add_chat_model(self, name: str, engine: AsyncEngine) -> None:
+        self.chat_engines[name] = engine
+        self._created.setdefault(name, int(time.time()))
+
+    def add_completion_model(self, name: str, engine: AsyncEngine) -> None:
+        self.completion_engines[name] = engine
+        self._created.setdefault(name, int(time.time()))
+
+    def remove_model(self, name: str) -> None:
+        self.chat_engines.pop(name, None)
+        self.completion_engines.pop(name, None)
+        self._created.pop(name, None)
+
+    def list_models(self) -> ModelList:
+        names = sorted(set(self.chat_engines) | set(self.completion_engines))
+        return ModelList(
+            data=[
+                ModelInfo(id=n, created=self._created.get(n, 0)) for n in names
+            ]
+        )
+
+
+class HttpService:
+    def __init__(
+        self,
+        model_manager: Optional[ModelManager] = None,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+    ):
+        self.models = model_manager or ModelManager()
+        self.host = host
+        self.port = port
+        self.app = web.Application(client_max_size=64 * 1024 * 1024)
+        self.app.add_routes(
+            [
+                web.get("/health", self._health),
+                web.get("/live", self._health),
+                web.get("/metrics", self._metrics),
+                web.get("/v1/models", self._models),
+                web.post("/v1/chat/completions", self._chat),
+                web.post("/v1/completions", self._completions),
+            ]
+        )
+        self._runner: Optional[web.AppRunner] = None
+
+    # -- lifecycle --------------------------------------------------------
+    async def start(self) -> None:
+        # handler_cancellation: client disconnect cancels the handler task so
+        # in-flight generation is killed promptly (off by default in aiohttp 3.9+)
+        self._runner = web.AppRunner(self.app, handler_cancellation=True)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = self._runner.addresses[0][1]
+        log.info("OpenAI HTTP service on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def run_forever(self) -> None:
+        await self.start()
+        await asyncio.Event().wait()
+
+    # -- handlers ---------------------------------------------------------
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "healthy", "models": [m.id for m in self.models.list_models().data]}
+        )
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(body=generate_latest(), content_type=CONTENT_TYPE_LATEST.split(";")[0])
+
+    async def _models(self, request: web.Request) -> web.Response:
+        return web.json_response(self.models.list_models().model_dump())
+
+    async def _chat(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle_llm(request, kind="chat")
+
+    async def _completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle_llm(request, kind="completion")
+
+    async def _handle_llm(self, request: web.Request, kind: str) -> web.StreamResponse:
+        endpoint = "chat_completions" if kind == "chat" else "completions"
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return self._error(400, "invalid JSON body", "", endpoint)
+        try:
+            if kind == "chat":
+                req = ChatCompletionRequest.model_validate(body)
+            else:
+                req = CompletionRequest.model_validate(body)
+        except Exception as exc:
+            return self._error(400, f"invalid request: {exc}", "", endpoint)
+
+        model = req.model
+        engines = (
+            self.models.chat_engines if kind == "chat" else self.models.completion_engines
+        )
+        engine = engines.get(model)
+        if engine is None:
+            return self._error(404, f"model {model!r} not found", model, endpoint)
+
+        ctx = Context()
+        start = time.monotonic()
+        INFLIGHT_GAUGE.labels(model).inc()
+        try:
+            stream = engine.generate(req, ctx)
+            if req.stream:
+                return await self._stream_sse(request, stream, ctx, model, endpoint, start)
+            # aggregate to a single response object
+            agg = ChatAggregator() if kind == "chat" else CompletionAggregator()
+            async for chunk in stream:
+                agg.push(chunk)
+            REQUEST_COUNTER.labels(model, endpoint, "200").inc()
+            DURATION_HISTOGRAM.labels(model, endpoint).observe(time.monotonic() - start)
+            return web.json_response(agg.response().model_dump(exclude_none=True))
+        except asyncio.CancelledError:
+            ctx.kill()
+            raise
+        except Exception as exc:
+            log.exception("engine failure for %s", model)
+            return self._error(500, f"engine error: {exc}", model, endpoint)
+        finally:
+            INFLIGHT_GAUGE.labels(model).dec()
+
+    async def _stream_sse(
+        self,
+        request: web.Request,
+        stream,
+        ctx: Context,
+        model: str,
+        endpoint: str,
+        start: float,
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+        first = True
+        status = "200"
+        try:
+            async for chunk in stream:
+                if first:
+                    TTFT_HISTOGRAM.labels(model).observe(time.monotonic() - start)
+                    first = False
+                payload = chunk.model_dump(exclude_none=True) if hasattr(chunk, "model_dump") else chunk
+                await resp.write(encode_sse(payload).encode())
+            await resp.write(encode_done().encode())
+        except asyncio.CancelledError:
+            # client went away: kill the in-flight generation, let the
+            # cancellation propagate (aiohttp expects it); finally still
+            # records the 499
+            ctx.kill()
+            status = "499"
+            raise
+        except ConnectionResetError:
+            ctx.kill()
+            status = "499"
+        except Exception as exc:
+            log.exception("stream failure for %s", model)
+            await resp.write(
+                encode_sse({"error": str(exc)}, event="error").encode()
+            )
+            status = "500"
+        finally:
+            REQUEST_COUNTER.labels(model, endpoint, status).inc()
+            DURATION_HISTOGRAM.labels(model, endpoint).observe(time.monotonic() - start)
+        with contextlib.suppress(ConnectionResetError):
+            await resp.write_eof()
+        return resp
+
+    def _error(self, status: int, message: str, model: str, endpoint: str) -> web.Response:
+        REQUEST_COUNTER.labels(model, endpoint, str(status)).inc()
+        return web.json_response(
+            {"error": {"message": message, "type": "invalid_request_error"}},
+            status=status,
+        )
+
+

@@ -1,0 +1,6 @@
+"""Request preprocessing: chat templates + tokenization + option extraction."""
+
+from dynamo_tpu.preprocessor.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.preprocessor.prompt import PromptFormatter
+
+__all__ = ["OpenAIPreprocessor", "PromptFormatter"]

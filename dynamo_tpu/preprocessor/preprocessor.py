@@ -1,0 +1,156 @@
+"""OpenAIPreprocessor: OpenAI request ⇄ engine-facing request/stream.
+
+Analogue of the reference's preprocessor (reference:
+lib/llm/src/preprocessor.rs:63-184 — chat-template render + tokenize +
+sampling/stop extraction into BackendInput; backward:
+transform_postprocessor_stream into SSE delta objects).
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Optional, Union
+
+from dynamo_tpu.preprocessor.prompt import PromptFormatter
+from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionRequest,
+    ChatDeltaGenerator,
+    CompletionDeltaGenerator,
+    CompletionRequest,
+    Usage,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.pipeline import Operator
+from dynamo_tpu.tokenizer import Tokenizer
+
+
+@dataclass
+class _ReqState:
+    kind: str  # "chat" | "completion"
+    model: str
+    request_id: str
+    prompt_tokens: int
+    include_usage: bool
+    logprobs: bool
+
+
+class OpenAIPreprocessor(Operator):
+    def __init__(
+        self,
+        tokenizer: Tokenizer,
+        formatter: Optional[PromptFormatter] = None,
+        model_name: str = "",
+    ):
+        self.tokenizer = tokenizer
+        self.formatter = formatter
+        self.model_name = model_name
+
+    # -- request adaptation ----------------------------------------------
+    def preprocess_chat(self, request: ChatCompletionRequest) -> PreprocessedRequest:
+        if self.formatter is None:
+            raise ValueError("chat requests need a PromptFormatter (chat template)")
+        ext = request.extension()
+        if ext.use_raw_prompt:
+            prompt = "".join(m.text_content() for m in request.messages)
+        else:
+            prompt = self.formatter.render(
+                [m.model_dump(exclude_none=True) for m in request.messages],
+                add_generation_prompt=True,
+                tools=request.tools,
+            )
+        token_ids = self.tokenizer.encode(prompt)
+        return PreprocessedRequest(
+            request_id=f"chatcmpl-{uuid.uuid4().hex}",
+            token_ids=token_ids,
+            sampling=request.sampling_options(),
+            stop=request.stop_conditions(),
+            output=request.output_options(),
+            model=request.model,
+            annotations=list(ext.annotations),
+        )
+
+    def preprocess_completion(self, request: CompletionRequest) -> PreprocessedRequest:
+        prompt = request.prompt
+        if isinstance(prompt, str):
+            token_ids = self.tokenizer.encode(prompt)
+        elif prompt and isinstance(prompt[0], int):
+            token_ids = list(prompt)  # pre-tokenized
+        elif prompt and isinstance(prompt[0], str):
+            if len(prompt) != 1:
+                raise ValueError("batched string prompts not supported per-request")
+            token_ids = self.tokenizer.encode(prompt[0])
+        elif prompt and isinstance(prompt[0], list):
+            if len(prompt) != 1:
+                raise ValueError("batched token prompts not supported per-request")
+            token_ids = list(prompt[0])
+        else:
+            raise ValueError("empty prompt")
+        return PreprocessedRequest(
+            request_id=f"cmpl-{uuid.uuid4().hex}",
+            token_ids=token_ids,
+            sampling=request.sampling_options(),
+            stop=request.stop_conditions(),
+            output=request.output_options(),
+            model=request.model,
+            annotations=list(request.extension().annotations),
+        )
+
+    # -- Operator interface ----------------------------------------------
+    async def forward(
+        self,
+        request: Union[ChatCompletionRequest, CompletionRequest],
+        context: Context,
+    ) -> tuple[PreprocessedRequest, _ReqState]:
+        if isinstance(request, ChatCompletionRequest):
+            pre = self.preprocess_chat(request)
+            kind = "chat"
+        elif isinstance(request, CompletionRequest):
+            pre = self.preprocess_completion(request)
+            kind = "completion"
+        else:
+            raise TypeError(f"unsupported request type {type(request)}")
+        include_usage = bool(request.stream_options and request.stream_options.include_usage)
+        state = _ReqState(
+            kind=kind,
+            model=request.model or self.model_name,
+            request_id=pre.request_id,
+            prompt_tokens=len(pre.token_ids),
+            include_usage=include_usage,
+            logprobs=pre.output.logprobs is not None,
+        )
+        return pre, state
+
+    async def backward(
+        self,
+        stream: AsyncIterator[Any],
+        state: _ReqState,
+        context: Context,
+    ) -> AsyncIterator[Any]:
+        """Map the Backend's text-delta stream into OpenAI chunk objects."""
+        if state.kind == "chat":
+            gen = ChatDeltaGenerator(model=state.model, request_id=state.request_id)
+        else:
+            gen = CompletionDeltaGenerator(model=state.model, request_id=state.request_id)
+        completion_tokens = 0
+        async for raw in stream:
+            item = (
+                raw
+                if isinstance(raw, LLMEngineOutput)
+                else LLMEngineOutput.model_validate(raw)
+            )
+            completion_tokens += len(item.token_ids)
+            if item.text:
+                yield gen.text_chunk(item.text)
+            if item.finish_reason is not None:
+                usage = None
+                if state.include_usage:
+                    ct = item.completion_tokens or completion_tokens
+                    usage = Usage(
+                        prompt_tokens=state.prompt_tokens,
+                        completion_tokens=ct,
+                        total_tokens=state.prompt_tokens + ct,
+                    )
+                yield gen.finish_chunk(item.finish_reason, usage=usage)
+                return

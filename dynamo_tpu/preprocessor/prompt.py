@@ -1,0 +1,101 @@
+"""Chat-template rendering with Jinja2.
+
+Analogue of the reference's prompt formatter (reference:
+lib/llm/src/preprocessor/prompt/template/{tokcfg,oai,formatters}.rs —
+minijinja rendering of the HF tokenizer_config chat_template with pycompat
+helpers). Templates come from ``tokenizer_config.json`` or an explicit
+string; rendering gets the usual HF context: messages, tools, bos/eos
+tokens, add_generation_prompt, plus ``raise_exception``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jinja2
+
+
+class TemplateError(ValueError):
+    pass
+
+
+def _raise_exception(message: str) -> None:
+    raise TemplateError(message)
+
+
+def _strftime_now(fmt: str) -> str:
+    import datetime
+
+    return datetime.datetime.now().strftime(fmt)
+
+
+class PromptFormatter:
+    def __init__(
+        self,
+        chat_template: str,
+        bos_token: str = "",
+        eos_token: str = "",
+        extra_context: Optional[dict[str, Any]] = None,
+    ):
+        self.bos_token = bos_token
+        self.eos_token = eos_token
+        self.extra_context = extra_context or {}
+        env = jinja2.Environment(
+            loader=jinja2.BaseLoader(),
+            trim_blocks=True,
+            lstrip_blocks=True,
+            extensions=["jinja2.ext.loopcontrols"],
+        )
+        env.filters["tojson"] = lambda v, indent=None: json.dumps(v, indent=indent)
+        env.globals["raise_exception"] = _raise_exception
+        env.globals["strftime_now"] = _strftime_now
+        self._template = env.from_string(chat_template)
+
+    @classmethod
+    def from_model_dir(cls, path: str) -> "PromptFormatter":
+        """Load chat_template/bos/eos from a model dir's tokenizer_config.json."""
+        cfg_path = os.path.join(path, "tokenizer_config.json")
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+        template = cfg.get("chat_template")
+        if template is None:
+            raise TemplateError(f"no chat_template in {cfg_path}")
+        if isinstance(template, list):
+            # multi-template form: pick "default"
+            by_name = {t["name"]: t["template"] for t in template}
+            template = by_name.get("default") or next(iter(by_name.values()))
+
+        def _tok_str(v: Any) -> str:
+            if isinstance(v, dict):  # AddedToken serialized form
+                return v.get("content", "")
+            return v or ""
+
+        return cls(
+            chat_template=template,
+            bos_token=_tok_str(cfg.get("bos_token")),
+            eos_token=_tok_str(cfg.get("eos_token")),
+        )
+
+    def render(
+        self,
+        messages: list[dict[str, Any]],
+        add_generation_prompt: bool = True,
+        tools: Optional[list[dict[str, Any]]] = None,
+        **kwargs: Any,
+    ) -> str:
+        ctx: dict[str, Any] = {
+            "messages": messages,
+            "add_generation_prompt": add_generation_prompt,
+            "bos_token": self.bos_token,
+            "eos_token": self.eos_token,
+            **self.extra_context,
+            **kwargs,
+        }
+        if tools is not None:
+            ctx["tools"] = tools
+        try:
+            return self._template.render(**ctx)
+        except jinja2.TemplateError as exc:
+            raise TemplateError(f"chat template failed: {exc}") from exc

@@ -27,6 +27,17 @@ from dynamo_tpu.store.wire import read_frame, shutdown_server, write_frame
 log = logging.getLogger("dynamo_tpu.runtime.service")
 
 
+def to_wire(obj: Any) -> Any:
+    """Make a payload msgpack-safe: pydantic models become dicts.
+
+    Engines on both sides of the wire accept dicts (they re-validate), so
+    the data plane only ever carries plain msgpack types.
+    """
+    if hasattr(obj, "model_dump"):
+        return obj.model_dump(exclude_none=True)
+    return obj
+
+
 class EndpointServer:
     """Serves one or more named endpoints, each backed by an AsyncEngine."""
 
@@ -76,7 +87,7 @@ class EndpointServer:
                     async for item in engine.generate(payload, ctx):
                         if ctx.is_killed:
                             break
-                        await send({"t": "item", "sid": sid, "p": item})
+                        await send({"t": "item", "sid": sid, "p": to_wire(item)})
                     await send({"t": "fin", "sid": sid})
                 except asyncio.CancelledError:
                     raise
@@ -175,7 +186,9 @@ class EndpointConnection:
         q: asyncio.Queue = asyncio.Queue()
         self._queues[sid] = q
         loop = asyncio.get_running_loop()
-        await self._send({"t": "req", "sid": sid, "ep": endpoint, "ctx": {"id": ctx.id}, "p": payload})
+        await self._send(
+            {"t": "req", "sid": sid, "ep": endpoint, "ctx": {"id": ctx.id}, "p": to_wire(payload)}
+        )
 
         # Cancellation rides the Context, not the consumer: the moment the
         # caller stops/kills the context, the worker is notified — even if

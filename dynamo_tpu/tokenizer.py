@@ -1,0 +1,98 @@
+"""Tokenizer wrapper + incremental streaming detokenizer.
+
+Analogue of the reference's tokenizer layer (reference:
+lib/llm/src/tokenizers.rs, tokenizers/hf.rs — HF tokenizer wrapper, and
+backend.rs Decoder/DecodeStream — incremental detokenization).
+
+``DecodeStream`` implements the standard streaming-detok algorithm used
+across open-source servers: keep a window [prefix_offset, read_offset) of
+already-emitted ids; on each new token decode the extended window and emit
+only the textual suffix, holding back while the tail decodes to an
+incomplete UTF-8 sequence (the U+FFFD replacement char).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from tokenizers import Tokenizer as _HfTokenizer
+
+REPLACEMENT_CHAR = "�"
+
+
+class Tokenizer:
+    """Thin wrapper over a HuggingFace `tokenizers` fast tokenizer."""
+
+    def __init__(self, inner: _HfTokenizer):
+        self._tok = inner
+
+    @classmethod
+    def from_file(cls, path: str) -> "Tokenizer":
+        """Load from a tokenizer.json file or a model directory."""
+        if os.path.isdir(path):
+            path = os.path.join(path, "tokenizer.json")
+        return cls(_HfTokenizer.from_file(path))
+
+    def encode(self, text: str, add_special_tokens: bool = False) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=add_special_tokens).ids
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = False) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=skip_special_tokens)
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        return self._tok.token_to_id(token)
+
+    def id_to_token(self, id_: int) -> Optional[str]:
+        return self._tok.id_to_token(id_)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()
+
+    def special_token_ids(self) -> set[int]:
+        return {
+            tok_id
+            for tok_id, added in self._tok.get_added_tokens_decoder().items()
+            if added.special
+        }
+
+    def decode_stream(self, skip_special_tokens: bool = True) -> "DecodeStream":
+        return DecodeStream(self, skip_special_tokens=skip_special_tokens)
+
+
+class DecodeStream:
+    """Incremental detokenizer for one sequence."""
+
+    def __init__(self, tokenizer: Tokenizer, skip_special_tokens: bool = True):
+        self._tok = tokenizer
+        self._skip_special = skip_special_tokens
+        self.ids: list[int] = []
+        self.prefix_offset = 0
+        self.read_offset = 0
+
+    def step(self, token_id: int) -> Optional[str]:
+        """Feed one token id; returns newly-decodable text or None."""
+        self.ids.append(int(token_id))
+        prefix_text = self._tok.decode(
+            self.ids[self.prefix_offset : self.read_offset],
+            skip_special_tokens=self._skip_special,
+        )
+        new_text = self._tok.decode(
+            self.ids[self.prefix_offset :], skip_special_tokens=self._skip_special
+        )
+        if new_text.endswith(REPLACEMENT_CHAR):
+            # tail is an incomplete multi-byte sequence; hold back
+            return None
+        if len(new_text) > len(prefix_text):
+            out = new_text[len(prefix_text) :]
+            self.prefix_offset = self.read_offset
+            self.read_offset = len(self.ids)
+            return out
+        self.read_offset = len(self.ids)
+        return None
+
+    def extend(self, token_ids: Sequence[int]) -> str:
+        """Feed many ids, returning all newly-decodable text."""
+        parts = [self.step(t) for t in token_ids]
+        return "".join(p for p in parts if p)

@@ -1,0 +1,85 @@
+"""Generate the checked-in tiny test tokenizer (BPE, Llama-3-style specials).
+
+Run once: python tests/data/make_tiny_tokenizer.py
+Mirrors the reference's checked-in sample-model configs
+(reference: lib/llm/tests/data/sample-models/).
+"""
+import json
+import os
+
+from tokenizers import Tokenizer, models, pre_tokenizers, decoders, trainers
+
+HERE = os.path.dirname(__file__)
+OUT = os.path.join(HERE, "tiny_llama_model")
+os.makedirs(OUT, exist_ok=True)
+
+SPECIALS = [
+    "<|begin_of_text|>", "<|end_of_text|>", "<|start_header_id|>",
+    "<|end_header_id|>", "<|eot_id|>",
+]
+
+corpus = [
+    "The quick brown fox jumps over the lazy dog. ",
+    "You are a helpful assistant. Hello, how are you today? ",
+    "What is the capital of France? The capital of France is Paris. ",
+    "def main(): print('hello world') return 0 ",
+    "Deep learning on TPUs with JAX and XLA compiles fast kernels. ",
+    "0 1 2 3 4 5 6 7 8 9 10 11 12 13 14 15 16 ",
+    "a b c d e f g h i j k l m n o p q r s t u v w x y z ",
+] * 50
+
+tok = Tokenizer(models.BPE(unk_token=None, byte_fallback=True))
+tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+tok.decoder = decoders.ByteLevel()
+trainer = trainers.BpeTrainer(
+    vocab_size=2048, special_tokens=SPECIALS,
+    initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+)
+tok.train_from_iterator(corpus, trainer)
+tok.save(os.path.join(OUT, "tokenizer.json"))
+
+# Llama-3-style chat template (public format), written fresh
+chat_template = (
+    "{{- bos_token }}"
+    "{%- for message in messages %}"
+    "{{- '<|start_header_id|>' + message['role'] + '<|end_header_id|>\n\n' }}"
+    "{{- message['content'] | trim }}{{- '<|eot_id|>' }}"
+    "{%- endfor %}"
+    "{%- if add_generation_prompt %}"
+    "{{- '<|start_header_id|>assistant<|end_header_id|>\n\n' }}"
+    "{%- endif %}"
+)
+cfg = {
+    "bos_token": "<|begin_of_text|>",
+    "eos_token": "<|eot_id|>",
+    "chat_template": chat_template,
+    "model_max_length": 512,
+    "tokenizer_class": "PreTrainedTokenizerFast",
+}
+with open(os.path.join(OUT, "tokenizer_config.json"), "w") as f:
+    json.dump(cfg, f, indent=1)
+
+# tiny llama config for the JAX engine tests
+model_config = {
+    "architectures": ["LlamaForCausalLM"],
+    "model_type": "llama",
+    "hidden_size": 128,
+    "intermediate_size": 256,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "num_hidden_layers": 2,
+    "vocab_size": 2048,
+    "max_position_embeddings": 512,
+    "rms_norm_eps": 1e-5,
+    "rope_theta": 10000.0,
+    "bos_token_id": 0,
+    "eos_token_id": 4,
+    "tie_word_embeddings": False,
+    "torch_dtype": "bfloat16",
+}
+with open(os.path.join(OUT, "config.json"), "w") as f:
+    json.dump(model_config, f, indent=1)
+print("wrote", OUT)
+ids = tok.encode("Hello, how are you?").ids
+print("sample encode:", ids)
+print("roundtrip:", tok.decode(ids))

@@ -1,0 +1,170 @@
+"""HTTP service tests with a counting mock engine over real sockets
+(≈ reference lib/llm/tests/http-service.rs CounterEngine)."""
+
+import asyncio
+import json
+from typing import Any, AsyncIterator
+
+import aiohttp
+
+from dynamo_tpu.http.service import HttpService, ModelManager
+from dynamo_tpu.protocols.common import FinishReason
+from dynamo_tpu.protocols.openai import ChatCompletionRequest, ChatDeltaGenerator
+from dynamo_tpu.protocols.sse import SseDecoder
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
+
+
+class CounterEngine(AsyncEngine):
+    """Streams N words; counts requests and cancellations."""
+
+    def __init__(self, n: int = 5, delay: float = 0.0):
+        self.n = n
+        self.delay = delay
+        self.requests = 0
+        self.cancelled = 0
+
+    async def _gen(self, request: Any, ctx: Context) -> AsyncIterator[Any]:
+        self.requests += 1
+        self.produced = 0
+        assert isinstance(request, ChatCompletionRequest)
+        gen = ChatDeltaGenerator(model=request.model)
+        for i in range(self.n):
+            if ctx.is_stopped:
+                self.cancelled += 1
+                return
+            if self.delay:
+                await asyncio.sleep(self.delay)
+            self.produced += 1
+            yield gen.text_chunk(f"w{i} ")
+        yield gen.finish_chunk(FinishReason.STOP)
+
+    def generate(self, request: Any, context: Context) -> EngineStream:
+        return self._gen(request, context)
+
+
+async def _start_service(engine) -> tuple[HttpService, str]:
+    manager = ModelManager()
+    manager.add_chat_model("foo", engine)
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    return service, f"http://127.0.0.1:{service.port}"
+
+
+async def test_models_and_health():
+    service, base = await _start_service(CounterEngine())
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/v1/models") as r:
+                assert r.status == 200
+                body = await r.json()
+                assert [m["id"] for m in body["data"]] == ["foo"]
+            async with s.get(f"{base}/health") as r:
+                assert (await r.json())["status"] == "healthy"
+    finally:
+        await service.stop()
+
+
+async def test_chat_streaming_sse():
+    service, base = await _start_service(CounterEngine(n=3))
+    try:
+        async with aiohttp.ClientSession() as s:
+            payload = {
+                "model": "foo",
+                "messages": [{"role": "user", "content": "hi"}],
+                "stream": True,
+            }
+            async with s.post(f"{base}/v1/chat/completions", json=payload) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/event-stream")
+                dec = SseDecoder()
+                msgs = []
+                async for chunk, _ in r.content.iter_chunks():
+                    msgs.extend(dec.feed(chunk))
+        assert msgs[-1].is_done
+        chunks = [m.json() for m in msgs[:-1]]
+        text = "".join(
+            c["choices"][0]["delta"].get("content", "")
+            for c in chunks
+            if c["choices"]
+        )
+        assert text == "w0 w1 w2 "
+        assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+    finally:
+        await service.stop()
+
+
+async def test_chat_non_streaming_aggregates():
+    service, base = await _start_service(CounterEngine(n=4))
+    try:
+        async with aiohttp.ClientSession() as s:
+            payload = {
+                "model": "foo",
+                "messages": [{"role": "user", "content": "hi"}],
+            }
+            async with s.post(f"{base}/v1/chat/completions", json=payload) as r:
+                assert r.status == 200
+                body = await r.json()
+        assert body["object"] == "chat.completion"
+        assert body["choices"][0]["message"]["content"] == "w0 w1 w2 w3 "
+        assert body["choices"][0]["finish_reason"] == "stop"
+    finally:
+        await service.stop()
+
+
+async def test_unknown_model_404_and_bad_json_400():
+    service, base = await _start_service(CounterEngine())
+    try:
+        async with aiohttp.ClientSession() as s:
+            payload = {"model": "nope", "messages": [{"role": "user", "content": "x"}]}
+            async with s.post(f"{base}/v1/chat/completions", json=payload) as r:
+                assert r.status == 404
+                assert "not found" in (await r.json())["error"]["message"]
+            async with s.post(
+                f"{base}/v1/chat/completions",
+                data=b"{not json",
+                headers={"Content-Type": "application/json"},
+            ) as r:
+                assert r.status == 400
+            # missing required field
+            async with s.post(f"{base}/v1/chat/completions", json={"model": "foo"}) as r:
+                assert r.status == 400
+    finally:
+        await service.stop()
+
+
+async def test_client_disconnect_cancels_engine():
+    engine = CounterEngine(n=1000, delay=0.01)
+    service, base = await _start_service(engine)
+    try:
+        async with aiohttp.ClientSession() as s:
+            payload = {
+                "model": "foo",
+                "messages": [{"role": "user", "content": "x"}],
+                "stream": True,
+            }
+            resp = await s.post(f"{base}/v1/chat/completions", json=payload)
+            # read a few chunks then slam the connection shut
+            await resp.content.read(64)
+            resp.close()
+        await asyncio.sleep(0.5)
+        n = engine.produced
+        assert n < 1000, "engine was not interrupted"
+        await asyncio.sleep(0.3)
+        assert engine.produced == n, "engine kept producing after disconnect"
+    finally:
+        await service.stop()
+
+
+async def test_metrics_endpoint():
+    service, base = await _start_service(CounterEngine(n=1))
+    try:
+        async with aiohttp.ClientSession() as s:
+            payload = {"model": "foo", "messages": [{"role": "user", "content": "x"}]}
+            async with s.post(f"{base}/v1/chat/completions", json=payload) as r:
+                await r.json()
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+        assert "dynamo_http_requests_total" in text
+        assert 'model="foo"' in text
+    finally:
+        await service.stop()
