@@ -269,6 +269,9 @@ async def cmd_models(args: Any) -> None:
 def main(argv: Optional[list[str]] = None) -> None:
     args = build_parser().parse_args(argv)
     init_logging()
+    from dynamo_tpu.utils.jaxtools import configure_from_env
+
+    configure_from_env()
     if args.command == "run":
         try:
             asyncio.run(cmd_run(args))

@@ -1,0 +1,13 @@
+"""The native JAX inference engine: continuous batching over paged KV.
+
+This is the component the reference outsources to vLLM/SGLang/TRT-LLM
+(reference: SURVEY.md §1 L3, §7 step 4) — here it is first-class and
+TPU-native: jitted unified prefill/decode steps over a device mesh, paged
+KV cache with prefix reuse, on-device sampling, and an async streaming
+front matching the AsyncEngine contract.
+"""
+
+from dynamo_tpu.engine.config import EngineConfig, load_engine_config
+from dynamo_tpu.engine.engine import JaxEngine
+
+__all__ = ["EngineConfig", "JaxEngine", "load_engine_config"]

@@ -1,0 +1,65 @@
+"""Engine configuration."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class EngineConfig:
+    model_path: str = ""
+    model_name: str = ""
+    # parallelism (≈ reference flags.rs --tensor-parallel-size etc.)
+    tensor_parallel_size: int = 1
+    data_parallel_size: int = 1
+    expert_parallel_size: int = 1
+    num_nodes: int = 1
+    node_rank: int = 0
+    leader_addr: str = ""
+    # KV cache
+    block_size: int = 16
+    num_blocks: Optional[int] = None  # None = size by gpu_memory_utilization
+    hbm_utilization: float = 0.9
+    kv_cache_dtype: str = "bfloat16"
+    enable_prefix_caching: bool = True
+    # batching
+    max_batch_size: int = 64
+    max_prefill_tokens: int = 4096
+    prefill_chunk_size: int = 1024
+    max_model_len: Optional[int] = None
+    # weights
+    random_weights: bool = False  # bench/test mode: skip checkpoint load
+    seed: int = 0
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def mesh_devices(self) -> int:
+        return (
+            self.tensor_parallel_size
+            * self.data_parallel_size
+            * self.expert_parallel_size
+        )
+
+
+def load_engine_config(args: Any) -> EngineConfig:
+    """Build an EngineConfig from CLI args (+ --extra-engine-args JSON)."""
+    extra: dict[str, Any] = {}
+    if getattr(args, "extra_engine_args", None):
+        with open(args.extra_engine_args) as f:
+            extra = json.load(f)
+    cfg = EngineConfig(
+        model_path=args.model_path or "",
+        model_name=args.model_name or (args.model_path or "model").rstrip("/").rsplit("/", 1)[-1],
+        tensor_parallel_size=getattr(args, "tensor_parallel_size", 1),
+        num_nodes=getattr(args, "num_nodes", 1),
+        node_rank=getattr(args, "node_rank", 0),
+        leader_addr=getattr(args, "leader_addr", ""),
+    )
+    for k, v in extra.items():
+        if hasattr(cfg, k):
+            setattr(cfg, k, v)
+        else:
+            cfg.extra[k] = v
+    return cfg

@@ -1,0 +1,436 @@
+"""JaxEngine: the async-facing native TPU inference engine.
+
+Orchestration (≈ what vLLM's AsyncLLMEngine does for the reference):
+
+- a dedicated **engine thread** runs the step loop (JAX dispatch blocks;
+  the asyncio event loop must never wait on the device);
+- one **fused jitted step** does forward + KV-cache update + sampling on
+  device, with cache buffers donated so XLA updates them in place;
+- per-request output queues bridge back into asyncio via
+  ``loop.call_soon_threadsafe``;
+- publishes ForwardPassMetrics-shaped stats for the KV router
+  (reference: lib/llm/src/kv_router/publisher.rs ForwardPassMetrics).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import logging
+import queue as thread_queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.allocator import BlockAllocator
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.sampling import SamplingBatch, sample
+from dynamo_tpu.engine.scheduler import (
+    Scheduler,
+    SeqState,
+    Sequence,
+    StepPlan,
+)
+from dynamo_tpu.models import ModelConfig
+from dynamo_tpu.models.llama import (
+    CACHE_SPEC,
+    forward,
+    init_cache,
+    init_params,
+    param_specs,
+)
+from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
+from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
+from dynamo_tpu.tokens import TokenBlockSequence
+
+log = logging.getLogger("dynamo_tpu.engine")
+
+
+@dataclass
+class ForwardPassMetrics:
+    """Worker load metrics for routers/planners
+    (reference: kv_router/protocols.rs:43-57)."""
+
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 0
+    num_requests_waiting: int = 0
+    gpu_cache_usage_perc: float = 0.0
+    gpu_prefix_cache_hit_rate: float = 0.0
+
+    def to_dict(self) -> dict:
+        return self.__dict__.copy()
+
+
+class JaxEngine:
+    def __init__(self, config: EngineConfig):
+        self.config = config
+        self.model_config: Optional[ModelConfig] = None
+        self.mesh = None
+        self.params = None
+        self.k_cache = None
+        self.v_cache = None
+        self.allocator: Optional[BlockAllocator] = None
+        self.scheduler: Optional[Scheduler] = None
+        self.eos_token_ids: list[int] = []
+        self._step_fn: Optional[Callable] = None
+        self._thread: Optional[threading.Thread] = None
+        self._incoming: thread_queue.Queue = thread_queue.Queue()
+        self._wake = threading.Event()
+        self._running = False
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._seed_counter = 0
+        self.kv_event_sink: Optional[Callable[[str, list[int], list[int]], None]] = None
+
+    # ------------------------------------------------------------------
+    # Launch
+    # ------------------------------------------------------------------
+    @classmethod
+    async def launch(cls, config: EngineConfig) -> "JaxEngine":
+        engine = cls(config)
+        loop = asyncio.get_running_loop()
+        engine._loop = loop
+        await loop.run_in_executor(None, engine._initialize)
+        engine._running = True
+        engine._thread = threading.Thread(
+            target=engine._step_loop, name="jax-engine", daemon=True
+        )
+        engine._thread.start()
+        return engine
+
+    def _initialize(self) -> None:
+        cfg = self.config
+        if cfg.num_nodes > 1:
+            # multi-host bring-up (reference: MultiNodeConfig, engines.rs:41)
+            jax.distributed.initialize(
+                coordinator_address=cfg.leader_addr,
+                num_processes=cfg.num_nodes,
+                process_id=cfg.node_rank,
+            )
+        self.model_config = ModelConfig.from_dir(cfg.model_path)
+        self.eos_token_ids = self.model_config.eos_token_ids
+        mesh_cfg = MeshConfig(
+            dp=cfg.data_parallel_size,
+            tp=cfg.tensor_parallel_size,
+            ep=cfg.expert_parallel_size,
+        )
+        devices = jax.devices()[: mesh_cfg.size]
+        self.mesh = build_mesh(mesh_cfg, devices)
+
+        from dynamo_tpu.models import loader
+
+        if not cfg.random_weights and loader.has_weights(cfg.model_path):
+            self.params = loader.load_params(
+                self.model_config, cfg.model_path, self.mesh
+            )
+        else:
+            log.warning("initializing RANDOM weights (no checkpoint found)")
+            self.params = init_params(self.model_config, cfg.seed, self.mesh)
+
+        num_blocks = cfg.num_blocks or self._auto_num_blocks(devices)
+        self.k_cache, self.v_cache = init_cache(
+            self.model_config, num_blocks, cfg.block_size, self.mesh
+        )
+        self.allocator = BlockAllocator(
+            num_blocks,
+            cfg.block_size,
+            enable_prefix_caching=cfg.enable_prefix_caching,
+            on_event=self._on_kv_event,
+        )
+        self.scheduler = Scheduler(
+            self.allocator,
+            cfg.block_size,
+            max_batch_size=cfg.max_batch_size,
+            prefill_chunk_size=cfg.prefill_chunk_size,
+            max_model_len=cfg.max_model_len
+            or self.model_config.max_position_embeddings,
+        )
+        self.scheduler.on_finish = self._emit_finish
+        self._build_step_fn()
+        log.info(
+            "engine up: %s, mesh=%s, blocks=%d×%d",
+            cfg.model_name,
+            dict(zip(self.mesh.axis_names, self.mesh.devices.shape)),
+            num_blocks,
+            cfg.block_size,
+        )
+
+    def _auto_num_blocks(self, devices) -> int:
+        """Size the KV cache from free HBM (fallback: modest default)."""
+        mc = self.model_config
+        assert mc is not None
+        bytes_per_block_total = (
+            2  # K and V
+            * mc.num_hidden_layers
+            * self.config.block_size
+            * mc.num_key_value_heads
+            * mc.head_dim
+            * 2  # bf16
+        )
+        try:
+            stats = devices[0].memory_stats()
+            free = stats["bytes_limit"] - stats["bytes_in_use"]
+            budget = free * self.config.hbm_utilization
+            # cache is sharded over tp: each device holds Hkv/tp heads
+            budget_total = budget * self.config.tensor_parallel_size
+            n = int(budget_total // bytes_per_block_total)
+            return max(16, min(n, 1_000_000))
+        except Exception:
+            return 512
+
+    def _on_kv_event(self, op: str, hashes: list[int], blocks: list[int]) -> None:
+        if self.kv_event_sink is not None:
+            self.kv_event_sink(op, hashes, blocks)
+
+    # ------------------------------------------------------------------
+    # The fused device step
+    # ------------------------------------------------------------------
+    def _build_step_fn(self) -> None:
+        mc = self.model_config
+        block_size = self.config.block_size
+        assert mc is not None
+
+        def step(
+            params,
+            k_cache,
+            v_cache,
+            tokens,
+            positions,
+            slot_mapping,
+            block_tables,
+            context_lens,
+            last_token_idx,
+            temperature,
+            top_k,
+            top_p,
+            seeds,
+        ):
+            logits, new_k, new_v = forward(
+                mc,
+                params,
+                k_cache,
+                v_cache,
+                tokens,
+                positions,
+                slot_mapping,
+                block_tables,
+                context_lens,
+                last_token_idx,
+                block_size,
+            )
+            next_tokens, logprobs = sample(logits, temperature, top_k, top_p, seeds)
+            return next_tokens, logprobs, new_k, new_v
+
+        # donate the caches: XLA aliases them in-place
+        self._step_fn = jax.jit(step, donate_argnums=(1, 2))
+
+    def _run_device_step(self, arrays: dict[str, np.ndarray], sampling: SamplingBatch):
+        assert self._step_fn is not None
+        next_tokens, logprobs, self.k_cache, self.v_cache = self._step_fn(
+            self.params,
+            self.k_cache,
+            self.v_cache,
+            arrays["tokens"],
+            arrays["positions"],
+            arrays["slot_mapping"],
+            arrays["block_tables"],
+            arrays["context_lens"],
+            arrays["last_token_idx"],
+            sampling.temperature,
+            sampling.top_k,
+            sampling.top_p,
+            sampling.seeds,
+        )
+        return np.asarray(next_tokens), np.asarray(logprobs)
+
+    # ------------------------------------------------------------------
+    # Engine thread loop
+    # ------------------------------------------------------------------
+    def _step_loop(self) -> None:
+        assert self.scheduler is not None
+        while self._running:
+            self._drain_incoming()
+            if not self.scheduler.has_work:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            try:
+                self._one_step()
+            except Exception:
+                log.exception("engine step failed; failing in-flight requests")
+                self._fail_all()
+
+    def _drain_incoming(self) -> None:
+        assert self.scheduler is not None
+        while True:
+            try:
+                item = self._incoming.get_nowait()
+            except thread_queue.Empty:
+                return
+            self.scheduler.add_request(item)
+
+    def _one_step(self) -> None:
+        sched = self.scheduler
+        assert sched is not None
+        plan = sched.plan()
+        if plan.kind == "idle":
+            time.sleep(0.001)
+            return
+        if plan.kind == "prefill":
+            work = plan.prefill
+            assert work is not None
+            arrays = sched.build_prefill_arrays(work)
+            seqs = [work.seq]
+        else:
+            seqs = plan.decode_seqs
+            if not seqs:
+                return
+            arrays = sched.build_decode_arrays(seqs)
+
+        B = arrays["tokens"].shape[0]
+        opts = [s.request.sampling.normalized() for s in seqs]
+        opts += [opts[-1]] * (B - len(seqs))  # pad
+        seeds = []
+        for s in seqs:
+            base = s.request.sampling.seed
+            seeds.append(
+                (base if base is not None else hash(s.request_id) & 0x7FFFFFFF)
+                + s.generated
+            )
+        seeds += [0] * (B - len(seqs))
+        sampling = SamplingBatch.from_options(opts, seeds)
+        next_tokens, logprobs = self._run_device_step(arrays, sampling)
+
+        if plan.kind == "prefill":
+            work = plan.prefill
+            assert work is not None
+            sched.complete_prefill_chunk(work)
+            if work.is_last_chunk:
+                self._emit_token(work.seq, int(next_tokens[0]), float(logprobs[0]))
+        else:
+            for i, seq in enumerate(seqs):
+                if seq.state != SeqState.RUNNING:
+                    continue
+                self._emit_token(seq, int(next_tokens[i]), float(logprobs[i]))
+
+    def _emit_token(self, seq: Sequence, token: int, logprob: float) -> None:
+        sched = self.scheduler
+        assert sched is not None
+        sched.append_token(seq, token)
+        if seq.emit is not None:
+            seq.emit(
+                LLMEngineOutput(
+                    request_id=seq.request_id,
+                    token_ids=[token],
+                    log_probs=[logprob],
+                )
+            )
+        reason = sched.should_finish(seq)
+        if reason is not None:
+            sched.finish(seq, reason)
+
+    def _emit_finish(self, seq: Sequence, reason: FinishReason) -> None:
+        """Scheduler on_finish hook: close the request's output stream."""
+        if seq.emit is not None:
+            seq.emit(
+                LLMEngineOutput(
+                    request_id=seq.request_id,
+                    finish_reason=reason,
+                    prompt_tokens=len(seq.request.token_ids),
+                    completion_tokens=seq.generated,
+                )
+            )
+            seq.emit(None)  # sentinel: stream closed
+
+    def _fail_all(self) -> None:
+        assert self.scheduler is not None
+        for seq in list(self.scheduler.running) + list(
+            self.scheduler.prefilling
+        ) + list(self.scheduler.waiting):
+            self.scheduler.finish(seq, FinishReason.ERROR)
+        self.scheduler.running.clear()
+        self.scheduler.prefilling.clear()
+        self.scheduler.waiting.clear()
+
+    # ------------------------------------------------------------------
+    # Async interface
+    # ------------------------------------------------------------------
+    def submit(
+        self, request: PreprocessedRequest, context: Context
+    ) -> asyncio.Queue:
+        """Thread-safe submit; returns the asyncio output queue."""
+        assert self._loop is not None
+        out: asyncio.Queue = asyncio.Queue()
+        loop = self._loop
+
+        def emit(item) -> None:
+            loop.call_soon_threadsafe(out.put_nowait, item)
+
+        seq = Sequence(
+            request=request,
+            tokens=TokenBlockSequence(
+                request.token_ids, block_size=self.config.block_size
+            ),
+            emit=emit,
+            is_cancelled=lambda: context.is_stopped,
+        )
+        self._incoming.put(seq)
+        self._wake.set()
+        return out
+
+    def as_async_engine(self) -> "JaxEngineAdapter":
+        return JaxEngineAdapter(self)
+
+    def stats(self) -> ForwardPassMetrics:
+        sched, alloc = self.scheduler, self.allocator
+        assert sched is not None and alloc is not None
+        return ForwardPassMetrics(
+            request_active_slots=sched.num_running,
+            request_total_slots=self.config.max_batch_size,
+            kv_active_blocks=alloc.num_blocks - 1 - alloc.num_free,
+            kv_total_blocks=alloc.num_blocks - 1,
+            num_requests_waiting=sched.num_waiting,
+            gpu_cache_usage_perc=alloc.usage,
+            gpu_prefix_cache_hit_rate=(
+                sched.prefix_hits / sched.prefix_queries
+                if sched.prefix_queries
+                else 0.0
+            ),
+        )
+
+    async def shutdown(self) -> None:
+        self._running = False
+        self._wake.set()
+        if self._thread is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, functools.partial(self._thread.join, timeout=10)
+            )
+
+
+class JaxEngineAdapter(AsyncEngine):
+    """AsyncEngine facade: PreprocessedRequest in → LLMEngineOutput stream."""
+
+    def __init__(self, engine: JaxEngine):
+        self.engine = engine
+
+    async def _gen(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        if not isinstance(request, PreprocessedRequest):
+            request = PreprocessedRequest.model_validate(request)
+        out = self.engine.submit(request, context)
+        while True:
+            item = await out.get()
+            if item is None:
+                return
+            yield item
+            if isinstance(item, LLMEngineOutput) and item.is_final:
+                return
+
+    def generate(self, request: Any, context: Context) -> EngineStream:
+        return self._gen(request, context)

@@ -1,0 +1,89 @@
+"""On-device batched sampling.
+
+One jitted function samples the whole batch: greedy and
+temperature/top-k/top-p paths are blended with `jnp.where` so a mixed
+batch compiles once (no per-request Python branching — XLA-friendly).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.protocols.common import SamplingOptions
+
+NEG_INF = -1e30
+
+
+@dataclass
+class SamplingBatch:
+    """Host-side per-slot sampling params, uploaded each step."""
+
+    temperature: np.ndarray  # [B] f32 (0 = greedy)
+    top_k: np.ndarray  # [B] i32 (0 = off)
+    top_p: np.ndarray  # [B] f32 (1.0 = off)
+    seeds: np.ndarray  # [B] u32 per-slot RNG streams
+
+    @classmethod
+    def from_options(cls, opts: list[SamplingOptions], step_seeds: list[int]) -> "SamplingBatch":
+        n = len(opts)
+        temp = np.zeros((n,), np.float32)
+        top_k = np.zeros((n,), np.int32)
+        top_p = np.ones((n,), np.float32)
+        seeds = np.asarray(step_seeds, np.uint32)
+        for i, o in enumerate(opts):
+            if not o.use_greedy and o.temperature is not None:
+                temp[i] = max(o.temperature, 1e-4)
+            elif not o.use_greedy:
+                temp[i] = 1.0
+            if o.top_k:
+                top_k[i] = o.top_k
+            if o.top_p is not None:
+                top_p[i] = o.top_p
+        return cls(temp, top_k, top_p, seeds)
+
+
+def sample(
+    logits: jax.Array,  # [B, V] f32
+    temperature: jax.Array,  # [B]
+    top_k: jax.Array,  # [B]
+    top_p: jax.Array,  # [B]
+    seeds: jax.Array,  # [B] u32
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (next_tokens [B] i32, logprobs_of_chosen [B] f32)."""
+    B, V = logits.shape
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # --- sampled path: top-k / top-p filtering on sorted logits ----------
+    temp = jnp.maximum(temperature, 1e-4)[:, None]
+    scaled = logits / temp
+    sort_idx = jnp.argsort(-scaled, axis=-1)  # descending
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    ranks = jnp.arange(V, dtype=jnp.int32)[None, :]
+    # top-k mask (0 = disabled)
+    k = jnp.where(top_k > 0, top_k, V)[:, None]
+    k_mask = ranks < k
+    # top-p mask on the sorted distribution (always keep rank 0)
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumprobs = jnp.cumsum(sorted_probs, axis=-1)
+    p_mask = (cumprobs - sorted_probs) < top_p[:, None]
+    keep = k_mask & p_mask
+    filtered = jnp.where(keep, sorted_logits, NEG_INF)
+    # per-slot independent RNG streams
+    keys = jax.vmap(jax.random.key)(seeds)
+    gumbel = jax.vmap(
+        lambda key, shape=(V,): jax.random.gumbel(key, shape, jnp.float32)
+    )(keys)
+    choice_sorted = jnp.argmax(filtered + gumbel, axis=-1)
+    sampled_tok = jnp.take_along_axis(
+        sort_idx, choice_sorted[:, None], axis=-1
+    )[:, 0].astype(jnp.int32)
+
+    is_greedy = temperature <= 0.0
+    next_tok = jnp.where(is_greedy, greedy_tok, sampled_tok)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    chosen_lp = jnp.take_along_axis(logprobs, next_tok[:, None], axis=-1)[:, 0]
+    return next_tok, chosen_lp

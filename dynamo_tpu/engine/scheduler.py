@@ -1,0 +1,374 @@
+"""Continuous-batching scheduler: admission, chunked prefill, decode batches.
+
+The reference relies on vLLM's scheduler for this (reference: SURVEY.md §1
+L3); here it is native and shaped for XLA's compilation model:
+
+- every device step has **bucketed static shapes** (batch, chunk length,
+  block-table width are rounded up to a small set of sizes) so the jitted
+  step function compiles a handful of variants and then never recompiles;
+- prefill is **chunked** (prefill_chunk_size) so long prompts can't starve
+  decode; one prefill chunk or one decode batch per engine step;
+- admission is capacity-checked against the block allocator, with
+  vLLM-style recompute preemption: if decode can't grow a sequence, the
+  youngest sequence is rolled back to the waiting queue and its blocks
+  freed.
+
+Pure host-side logic — fully unit-testable without a device.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+from dynamo_tpu.engine.allocator import BlockAllocator, NoBlocksError
+from dynamo_tpu.protocols.common import FinishReason, PreprocessedRequest
+from dynamo_tpu.tokens import TokenBlockSequence
+
+log = logging.getLogger("dynamo_tpu.engine.scheduler")
+
+
+def next_bucket(n: int, buckets: list[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    # beyond the precomputed list: next power of two (never under-allocate)
+    b = buckets[-1]
+    while b < n:
+        b *= 2
+    return b
+
+
+class SeqState(str, enum.Enum):
+    WAITING = "waiting"
+    PREFILL = "prefill"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+@dataclass
+class Sequence:
+    request: PreprocessedRequest
+    tokens: TokenBlockSequence
+    state: SeqState = SeqState.WAITING
+    block_table: list[int] = field(default_factory=list)
+    num_computed: int = 0  # tokens whose KV is in cache
+    num_cached_prompt: int = 0  # prefix-cache hit length (tokens)
+    committed_blocks: int = 0  # prefix of block_table already content-addressed
+    generated: int = 0
+    arrival: int = 0
+    # engine-facing hooks
+    emit: Optional[Callable] = None  # called with LLMEngineOutput-shaped dicts
+    is_cancelled: Optional[Callable[[], bool]] = None
+    finish_reason: Optional[FinishReason] = None
+
+    @property
+    def request_id(self) -> str:
+        return self.request.request_id
+
+    @property
+    def total_len(self) -> int:
+        return len(self.tokens)
+
+    @property
+    def max_new_tokens(self) -> Optional[int]:
+        return self.request.stop.max_tokens
+
+    def blocks_needed(self, for_len: int, block_size: int) -> int:
+        return (for_len + block_size - 1) // block_size
+
+
+@dataclass
+class PrefillWork:
+    """One chunk of prompt to run this step."""
+
+    seq: Sequence
+    tokens: np.ndarray  # [t] token ids for this chunk
+    start_pos: int  # absolute position of tokens[0]
+    is_last_chunk: bool
+
+
+@dataclass
+class StepPlan:
+    """What the engine should run this step."""
+
+    kind: str  # "prefill" | "decode" | "idle"
+    prefill: Optional[PrefillWork] = None
+    decode_seqs: list[Sequence] = field(default_factory=list)
+
+
+class Scheduler:
+    def __init__(
+        self,
+        allocator: BlockAllocator,
+        block_size: int,
+        max_batch_size: int = 64,
+        prefill_chunk_size: int = 1024,
+        max_model_len: Optional[int] = None,
+    ):
+        self.allocator = allocator
+        self.block_size = block_size
+        self.max_batch_size = max_batch_size
+        self.prefill_chunk_size = prefill_chunk_size
+        self.max_model_len = max_model_len
+        self.waiting: deque[Sequence] = deque()
+        self.prefilling: deque[Sequence] = deque()
+        self.running: list[Sequence] = []
+        self._arrival = 0
+        # invoked on every finish (incl. cancellations reaped inside plan())
+        self.on_finish: Optional[Callable[[Sequence, FinishReason], None]] = None
+        # prefix-cache stats (one query per admitted request)
+        self.prefix_queries = 0
+        self.prefix_hits = 0
+
+    # -- intake -----------------------------------------------------------
+    def add_request(self, seq: Sequence) -> None:
+        seq.arrival = self._arrival
+        self._arrival += 1
+        self.waiting.append(seq)
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting) + len(self.prefilling)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.prefilling or self.running)
+
+    # -- planning ---------------------------------------------------------
+    def plan(self) -> StepPlan:
+        self._reap_cancelled()
+        self._admit()
+        if self.prefilling:
+            work = self._plan_prefill()
+            if work is not None:
+                return StepPlan(kind="prefill", prefill=work)
+        if self.running:
+            return StepPlan(kind="decode", decode_seqs=self._plan_decode())
+        return StepPlan(kind="idle")
+
+    def _reap_cancelled(self) -> None:
+        for pool in (self.waiting, self.prefilling):
+            for seq in list(pool):
+                if seq.is_cancelled and seq.is_cancelled():
+                    pool.remove(seq)
+                    self.finish(seq, FinishReason.CANCELLED)
+        for seq in list(self.running):
+            if seq.is_cancelled and seq.is_cancelled():
+                self.running.remove(seq)
+                self.finish(seq, FinishReason.CANCELLED)
+
+    def _admit(self) -> None:
+        while self.waiting and (
+            len(self.running) + len(self.prefilling) < self.max_batch_size
+        ):
+            seq = self.waiting[0]
+            if self.max_model_len and seq.total_len >= self.max_model_len:
+                self.waiting.popleft()
+                self.finish(seq, FinishReason.ERROR)
+                continue
+            seq_hashes = seq.tokens.sequence_hashes()
+            # blocks for the whole prompt + 1 growth block
+            n_prompt_blocks = seq.blocks_needed(seq.total_len, self.block_size)
+            try:
+                complete = seq_hashes[: n_prompt_blocks]
+                blocks, cached = self.allocator.allocate_prefix(complete)
+                extra = n_prompt_blocks - len(complete)
+                for _ in range(max(0, extra)):
+                    blocks.append(self.allocator.allocate_block())
+            except NoBlocksError:
+                break  # backpressure: try again next step
+            self.waiting.popleft()
+            seq.block_table = blocks
+            seq.num_cached_prompt = cached * self.block_size
+            seq.num_computed = seq.num_cached_prompt
+            seq.committed_blocks = cached  # reused blocks are already addressed
+            seq.state = SeqState.PREFILL
+            self.prefilling.append(seq)
+            # prefix-cache stats: one query per admitted request
+            self.prefix_queries += 1
+            if cached > 0:
+                self.prefix_hits += 1
+
+    def _plan_prefill(self) -> Optional[PrefillWork]:
+        seq = self.prefilling[0]
+        prompt = seq.tokens.all_tokens()
+        start = seq.num_computed
+        remaining = len(prompt) - start
+        if remaining <= 0:
+            # fully cached prompt: recompute the last token so we have its
+            # logits to sample from
+            start = max(0, len(prompt) - 1)
+            remaining = len(prompt) - start
+        chunk = min(remaining, self.prefill_chunk_size)
+        tokens = np.asarray(prompt[start : start + chunk], dtype=np.int32)
+        return PrefillWork(
+            seq=seq,
+            tokens=tokens,
+            start_pos=start,
+            is_last_chunk=(start + chunk >= len(prompt)),
+        )
+
+    def complete_prefill_chunk(self, work: PrefillWork) -> None:
+        seq = work.seq
+        seq.num_computed = work.start_pos + len(work.tokens)
+        self._commit_full_blocks(seq)
+        if work.is_last_chunk:
+            self.prefilling.remove(seq)
+            seq.state = SeqState.RUNNING
+            self.running.append(seq)
+
+    def _plan_decode(self) -> list[Sequence]:
+        """Ensure each running seq has a slot for its next token; on block
+        exhaustion preempt the YOUNGEST running sequence (possibly the
+        requester itself) back to waiting — recompute preemption."""
+        batch = sorted(self.running, key=lambda s: s.arrival)[: self.max_batch_size]
+        safe: list[Sequence] = []
+        for seq in batch:
+            if seq.state != SeqState.RUNNING:
+                continue  # preempted earlier in this pass
+            needed_blocks = seq.blocks_needed(seq.total_len + 1, self.block_size)
+            while (
+                seq.state == SeqState.RUNNING
+                and len(seq.block_table) < needed_blocks
+            ):
+                try:
+                    seq.block_table.append(self.allocator.allocate_block())
+                except NoBlocksError:
+                    if not self.running:
+                        break
+                    victim = max(self.running, key=lambda s: s.arrival)
+                    self._preempt(victim)
+                    if victim is seq:
+                        break
+            if seq.state == SeqState.RUNNING:
+                safe.append(seq)
+        return safe
+
+    def _preempt(self, victim: Sequence) -> None:
+        log.warning("preempting %s (recompute)", victim.request_id)
+        self.running.remove(victim)
+        self.allocator.free_sequence(victim.block_table)
+        victim.block_table = []
+        victim.num_computed = 0
+        victim.num_cached_prompt = 0
+        victim.committed_blocks = 0
+        victim.state = SeqState.WAITING
+        self.waiting.appendleft(victim)
+
+    # -- post-step bookkeeping -------------------------------------------
+    def append_token(self, seq: Sequence, token: int) -> None:
+        seq.tokens.append(int(token))
+        seq.generated += 1
+        # the just-sampled token's KV is NOT in the cache yet — it only gets
+        # written when it is fed as input on the next step. Counting it as
+        # computed would let _commit_full_blocks content-address a block
+        # whose last slot holds garbage, poisoning the prefix cache.
+        seq.num_computed = seq.total_len - 1
+        self._commit_full_blocks(seq)
+
+    def _commit_full_blocks(self, seq: Sequence) -> None:
+        """Content-address newly completed, fully-computed blocks."""
+        hashes = seq.tokens.sequence_hashes()
+        n_complete_computed = min(
+            seq.num_computed // self.block_size, len(seq.block_table), len(hashes)
+        )
+        for i in range(seq.committed_blocks, n_complete_computed):
+            self.allocator.commit_block(seq.block_table[i], hashes[i])
+        seq.committed_blocks = max(seq.committed_blocks, n_complete_computed)
+
+    def should_finish(self, seq: Sequence) -> Optional[FinishReason]:
+        if seq.max_new_tokens is not None and seq.generated >= seq.max_new_tokens:
+            return FinishReason.LENGTH
+        if self.max_model_len and seq.total_len >= self.max_model_len:
+            return FinishReason.LENGTH
+        if len(seq.block_table) >= (
+            self.allocator.num_blocks - 1
+        ):  # can't possibly grow further
+            return FinishReason.LENGTH
+        return None
+
+    def finish(self, seq: Sequence, reason: FinishReason) -> None:
+        if seq.state == SeqState.FINISHED:
+            return
+        seq.state = SeqState.FINISHED
+        seq.finish_reason = reason
+        if seq in self.running:
+            self.running.remove(seq)
+        if seq.block_table:
+            self.allocator.free_sequence(seq.block_table)
+            seq.block_table = []
+        if self.on_finish is not None:
+            self.on_finish(seq, reason)
+
+    # -- step-tensor construction (static-shaped, bucketed) ---------------
+    BATCH_BUCKETS = [1, 2, 4, 8, 16, 32, 64, 128, 256]
+    CHUNK_BUCKETS = [16, 32, 64, 128, 256, 512, 1024, 2048, 4096]
+    TABLE_BUCKET = 8  # block-table width rounded to multiples of this
+
+    def build_prefill_arrays(self, work: PrefillWork) -> dict[str, np.ndarray]:
+        bs = self.block_size
+        seq = work.seq
+        t = len(work.tokens)
+        T = next_bucket(t, self.CHUNK_BUCKETS)
+        width = max(
+            self.TABLE_BUCKET,
+            -(-len(seq.block_table) // self.TABLE_BUCKET) * self.TABLE_BUCKET,
+        )
+        tokens = np.zeros((1, T), np.int32)
+        tokens[0, :t] = work.tokens
+        positions = np.zeros((1, T), np.int32)
+        positions[0, :t] = np.arange(work.start_pos, work.start_pos + t)
+        slot_mapping = np.zeros((T,), np.int32)  # pad -> slot 0 (garbage block)
+        for j in range(t):
+            pos = work.start_pos + j
+            slot_mapping[j] = seq.block_table[pos // bs] * bs + pos % bs
+        tables = np.zeros((1, width), np.int32)
+        tables[0, : len(seq.block_table)] = seq.block_table
+        return {
+            "tokens": tokens,
+            "positions": positions,
+            "slot_mapping": slot_mapping,
+            "block_tables": tables,
+            "context_lens": np.asarray([work.start_pos + t], np.int32),
+            "last_token_idx": np.asarray([t - 1], np.int32),
+        }
+
+    def build_decode_arrays(self, seqs: list[Sequence]) -> dict[str, np.ndarray]:
+        bs = self.block_size
+        n = len(seqs)
+        B = next_bucket(n, self.BATCH_BUCKETS)
+        max_blocks = max(len(s.block_table) for s in seqs)
+        width = max(
+            self.TABLE_BUCKET, -(-max_blocks // self.TABLE_BUCKET) * self.TABLE_BUCKET
+        )
+        tokens = np.zeros((B, 1), np.int32)
+        positions = np.zeros((B, 1), np.int32)
+        slot_mapping = np.zeros((B,), np.int32)
+        tables = np.zeros((B, width), np.int32)
+        ctx = np.zeros((B,), np.int32)
+        for i, s in enumerate(seqs):
+            all_toks = s.tokens.all_tokens()
+            tokens[i, 0] = all_toks[-1]
+            pos = s.total_len - 1
+            positions[i, 0] = pos
+            slot_mapping[i] = s.block_table[pos // bs] * bs + pos % bs
+            tables[i, : len(s.block_table)] = s.block_table
+            ctx[i] = s.total_len
+        return {
+            "tokens": tokens,
+            "positions": positions,
+            "slot_mapping": slot_mapping,
+            "block_tables": tables,
+            "context_lens": ctx,
+            "last_token_idx": np.zeros((B,), np.int32),
+        }

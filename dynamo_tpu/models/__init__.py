@@ -1,0 +1,12 @@
+"""Model families: pure-JAX decoder implementations with mesh shardings.
+
+The reference delegates model execution to external engines (vLLM/SGLang/
+TRT-LLM, reference: SURVEY.md §1 L3); dynamo-tpu's flagship engine is
+native: functional JAX models (params as pytrees), lax.scan over layers for
+fast compiles, paged KV cache, and named-axis shardings so pjit/XLA place
+the collectives.
+"""
+
+from dynamo_tpu.models.config import ModelConfig
+
+__all__ = ["ModelConfig"]

@@ -1,0 +1,335 @@
+"""Llama-family decoder in pure functional JAX with paged KV cache.
+
+The flagship native engine model (reference analogue: the external vLLM
+engine the reference shells out to — here the model is first-class,
+SURVEY.md §7 step 4). Design choices for TPU:
+
+- params are a flat pytree with layers **stacked on a leading L axis** and
+  the transformer body is a single `lax.scan` over layers: one layer gets
+  compiled once regardless of depth — fast compiles, identical performance.
+- one **unified step function** serves prefill and decode: write new K/V
+  into the paged cache at `slot_mapping`, gather each sequence's pages via
+  its block table, and do masked attention. Decode is the T=1 special case.
+  (The Pallas paged-attention kernel in ops/ replaces the gather on TPU.)
+- GQA with head_dim-scaled RoPE; RMSNorm in f32; weights/activations bf16;
+  attention softmax in f32.
+- TP sharding over the "tp" mesh axis: q/k/v/o heads and MLP hidden are
+  sharded; the KV cache is sharded on its KV-head axis so paged attention
+  is fully local to each TP shard; XLA inserts the psum on o_proj/down_proj
+  output via sharding propagation.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.models.config import ModelConfig
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Parameter init / sharding specs
+# ---------------------------------------------------------------------------
+
+
+def param_shapes(cfg: ModelConfig) -> dict[str, tuple[tuple[int, ...], Any]]:
+    """name -> (shape, dtype). Layer params carry a leading L axis."""
+    L = cfg.num_hidden_layers
+    D = cfg.hidden_size
+    H = cfg.num_attention_heads
+    Hk = cfg.num_key_value_heads
+    Dh = cfg.head_dim
+    F = cfg.intermediate_size
+    V = cfg.vocab_size
+    bf16 = jnp.bfloat16
+    shapes = {
+        "embed": ((V, D), bf16),
+        "attn_norm": ((L, D), jnp.float32),
+        "wq": ((L, D, H * Dh), bf16),
+        "wk": ((L, D, Hk * Dh), bf16),
+        "wv": ((L, D, Hk * Dh), bf16),
+        "wo": ((L, H * Dh, D), bf16),
+        "mlp_norm": ((L, D), jnp.float32),
+        "final_norm": ((D,), jnp.float32),
+        "lm_head": ((D, V), bf16),
+    }
+    if cfg.is_moe:
+        E = cfg.num_local_experts
+        shapes.update(
+            {
+                "router": ((L, D, E), bf16),
+                "w_gate": ((L, E, D, F), bf16),
+                "w_up": ((L, E, D, F), bf16),
+                "w_down": ((L, E, F, D), bf16),
+            }
+        )
+    else:
+        shapes.update(
+            {
+                "w_gate": ((L, D, F), bf16),
+                "w_up": ((L, D, F), bf16),
+                "w_down": ((L, F, D), bf16),
+            }
+        )
+    return shapes
+
+
+def param_specs(cfg: ModelConfig) -> dict[str, P]:
+    """PartitionSpecs per param (tp shards heads/hidden, ep shards experts)."""
+    specs = {
+        "embed": P("tp", None),
+        "attn_norm": P(None, None),
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "mlp_norm": P(None, None),
+        "final_norm": P(None),
+        "lm_head": P(None, "tp"),
+    }
+    if cfg.is_moe:
+        specs.update(
+            {
+                "router": P(None, None, None),
+                "w_gate": P(None, "ep", None, "tp"),
+                "w_up": P(None, "ep", None, "tp"),
+                "w_down": P(None, "ep", "tp", None),
+            }
+        )
+    else:
+        specs.update(
+            {
+                "w_gate": P(None, None, "tp"),
+                "w_up": P(None, None, "tp"),
+                "w_down": P(None, "tp", None),
+            }
+        )
+    return specs
+
+
+def init_params(cfg: ModelConfig, seed: int = 0, mesh: Optional[Mesh] = None) -> Params:
+    """Random init (for tests / benchmarks without weights)."""
+    shapes = param_shapes(cfg)
+    key = jax.random.PRNGKey(seed)
+    keys = jax.random.split(key, len(shapes))
+    params: Params = {}
+    for (name, (shape, dtype)), k in zip(shapes.items(), keys):
+        fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
+        scale = 1.0 / math.sqrt(max(1, fan_in))
+        if name.endswith("norm"):
+            arr = jnp.ones(shape, dtype=dtype)
+        else:
+            arr = (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+        if mesh is not None:
+            arr = jax.device_put(arr, NamedSharding(mesh, param_specs(cfg)[name]))
+        params[name] = arr
+    return params
+
+
+def cache_shape(
+    cfg: ModelConfig, num_blocks: int, block_size: int
+) -> tuple[int, int, int, int]:
+    """KV cache per K and V: [L, num_blocks*block_size, Hkv, Dh]."""
+    return (
+        cfg.num_hidden_layers,
+        num_blocks * block_size,
+        cfg.num_key_value_heads,
+        cfg.head_dim,
+    )
+
+
+CACHE_SPEC = P(None, None, "tp", None)
+
+
+def init_cache(
+    cfg: ModelConfig,
+    num_blocks: int,
+    block_size: int,
+    mesh: Optional[Mesh] = None,
+    dtype=jnp.bfloat16,
+) -> tuple[jax.Array, jax.Array]:
+    shape = cache_shape(cfg, num_blocks, block_size)
+    k = jnp.zeros(shape, dtype=dtype)
+    v = jnp.zeros(shape, dtype=dtype)
+    if mesh is not None:
+        sh = NamedSharding(mesh, CACHE_SPEC)
+        k, v = jax.device_put(k, sh), jax.device_put(v, sh)
+    return k, v
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def rmsnorm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + eps) * w
+    return out.astype(x.dtype)
+
+
+def rope(q: jax.Array, k: jax.Array, positions: jax.Array, theta: float) -> tuple[jax.Array, jax.Array]:
+    """Rotary embeddings; q/k: [B, T, H, Dh], positions: [B, T]."""
+    dh = q.shape[-1]
+    half = dh // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, half]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B, T, 1, half]
+    sin = jnp.sin(angles)[:, :, None, :]
+
+    def rot(x: jax.Array) -> jax.Array:
+        x1, x2 = x[..., :half], x[..., half:]
+        xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+        return jnp.concatenate(
+            [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin], axis=-1
+        ).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def paged_attention_reference(
+    q: jax.Array,  # [B, T, H, Dh]
+    k_cache_l: jax.Array,  # [n_slots, Hkv, Dh] (one layer)
+    v_cache_l: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks] int32 block ids
+    positions: jax.Array,  # [B, T] absolute positions of the queries
+    context_lens: jax.Array,  # [B] total valid tokens per sequence
+    block_size: int,
+) -> jax.Array:
+    """Gather-then-attend paged attention (XLA reference path).
+
+    Works on any backend; the Pallas kernel (ops/paged_attention.py) is the
+    TPU fast path with identical semantics.
+    """
+    B, T, H, Dh = q.shape
+    Hk = k_cache_l.shape[-2]
+    S = block_tables.shape[1] * block_size
+    # gather pages: [B, S] flat slot ids
+    slot_ids = (
+        block_tables[:, :, None] * block_size
+        + jnp.arange(block_size, dtype=block_tables.dtype)[None, None, :]
+    ).reshape(B, S)
+    keys = k_cache_l[slot_ids]  # [B, S, Hk, Dh]
+    vals = v_cache_l[slot_ids]
+    # GQA: expand kv heads to q heads
+    group = H // Hk
+    keys = jnp.repeat(keys, group, axis=2)  # [B, S, H, Dh]
+    vals = jnp.repeat(vals, group, axis=2)
+    scale = 1.0 / math.sqrt(Dh)
+    scores = jnp.einsum(
+        "bthd,bshd->bhts", q, keys, preferred_element_type=jnp.float32
+    ) * scale  # [B, H, T, S]
+    key_pos = jnp.arange(S, dtype=jnp.int32)[None, None, None, :]
+    mask = (key_pos <= positions[:, None, :, None]) & (
+        key_pos < context_lens[:, None, None, None]
+    )
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhts,bshd->bthd", probs, vals)  # [B, T, H, Dh]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The unified forward step
+# ---------------------------------------------------------------------------
+
+
+def forward(
+    cfg: ModelConfig,
+    params: Params,
+    k_cache: jax.Array,  # [L, n_slots, Hkv, Dh]
+    v_cache: jax.Array,
+    tokens: jax.Array,  # [B, T] int32 (padded)
+    positions: jax.Array,  # [B, T] int32 absolute positions (padded: 0)
+    slot_mapping: jax.Array,  # [B*T] int32 flat cache slots (padded: slot 0)
+    block_tables: jax.Array,  # [B, max_blocks] int32 (padded: block 0)
+    context_lens: jax.Array,  # [B] int32 valid tokens incl. new ones
+    last_token_idx: jax.Array,  # [B] int32 index of last real token in T
+    block_size: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One model step. Returns (logits[B, V], new_k_cache, new_v_cache)."""
+    B, T = tokens.shape
+    H, Hk, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+
+    x = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
+
+    layer_params = {
+        k: params[k]
+        for k in params
+        if k not in ("embed", "final_norm", "lm_head")
+    }
+
+    def layer_fn(x, scanned):
+        lp, k_cache_l, v_cache_l = scanned
+        # attention
+        h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ lp["wq"]).reshape(B, T, H, Dh)
+        k = (h @ lp["wk"]).reshape(B, T, Hk, Dh)
+        v = (h @ lp["wv"]).reshape(B, T, Hk, Dh)
+        q, k = rope(q, k, positions, cfg.rope_theta)
+        # write new kv into the paged cache
+        k_cache_l = k_cache_l.at[slot_mapping].set(k.reshape(B * T, Hk, Dh))
+        v_cache_l = v_cache_l.at[slot_mapping].set(v.reshape(B * T, Hk, Dh))
+        attn = paged_attention_reference(
+            q, k_cache_l, v_cache_l, block_tables, positions, context_lens, block_size
+        )
+        x = x + (attn.reshape(B, T, H * Dh) @ lp["wo"]).astype(x.dtype)
+        # mlp
+        h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        if cfg.is_moe:
+            x = x + _moe_mlp(cfg, lp, h).astype(x.dtype)
+        else:
+            mlp_out = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+            x = x + mlp_out.astype(x.dtype)
+        return x, (k_cache_l, v_cache_l)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_fn, x, (layer_params, k_cache, v_cache)
+    )
+
+    x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
+    # logits only at each sequence's last real token
+    x_last = jnp.take_along_axis(
+        x, last_token_idx[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]  # [B, D]
+    logits = (x_last @ params["lm_head"]).astype(jnp.float32)  # [B, V]
+    return logits, new_k, new_v
+
+
+def _moe_mlp(cfg: ModelConfig, lp: Params, h: jax.Array) -> jax.Array:
+    """Mixtral-style sparse MoE MLP (dense-compute formulation).
+
+    Computes router softmax over E experts, selects top-k, and evaluates
+    via einsum over the expert axis with a top-k weight mask — the
+    MXU-friendly formulation: no scatter/gather, experts sharded on "ep".
+    """
+    B, T, D = h.shape
+    E, k = cfg.num_local_experts, cfg.num_experts_per_tok
+    logits = (h @ lp["router"]).astype(jnp.float32)  # [B, T, E]
+    weights = jax.nn.softmax(logits, axis=-1)
+    topw, topi = jax.lax.top_k(weights, k)  # [B, T, k]
+    topw = topw / jnp.sum(topw, axis=-1, keepdims=True)
+    # dense routing mask [B, T, E] of normalized top-k weights
+    routing = (
+        jnp.zeros((B, T, E), jnp.float32)
+        .at[
+            jnp.arange(B)[:, None, None],
+            jnp.arange(T)[None, :, None],
+            topi,
+        ]
+        .set(topw)
+    ).astype(h.dtype)
+    # expert compute: g/u/d per expert; einsum keeps everything batched
+    ge = jnp.einsum("btd,edf->btef", h, lp["w_gate"])
+    ue = jnp.einsum("btd,edf->btef", h, lp["w_up"])
+    he = jax.nn.silu(ge) * ue  # [B, T, E, F]
+    oe = jnp.einsum("btef,efd->bted", he, lp["w_down"])
+    return jnp.einsum("bted,bte->btd", oe, routing)

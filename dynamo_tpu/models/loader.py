@@ -1,0 +1,159 @@
+"""Load HF-format (safetensors) checkpoints into the stacked-layer pytree.
+
+Analogue of the reference's model resolution path (reference:
+lib/llm/src/local_model.rs, hub.rs — resolve local dir / download), minus
+the hub download (deployments mount weights locally; zero-egress builds use
+random init). Torch checkpoints store linear weights as [out, in]; our
+params are [in, out], so projections are transposed on load. Per-layer
+tensors are stacked onto the leading L axis to match the lax.scan layout.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import Params, param_shapes, param_specs
+
+log = logging.getLogger("dynamo_tpu.models.loader")
+
+# our-name -> (hf per-layer template | hf global name, transpose?)
+_LAYER_MAP = {
+    "attn_norm": ("model.layers.{i}.input_layernorm.weight", False),
+    "wq": ("model.layers.{i}.self_attn.q_proj.weight", True),
+    "wk": ("model.layers.{i}.self_attn.k_proj.weight", True),
+    "wv": ("model.layers.{i}.self_attn.v_proj.weight", True),
+    "wo": ("model.layers.{i}.self_attn.o_proj.weight", True),
+    "mlp_norm": ("model.layers.{i}.post_attention_layernorm.weight", False),
+    "w_gate": ("model.layers.{i}.mlp.gate_proj.weight", True),
+    "w_up": ("model.layers.{i}.mlp.up_proj.weight", True),
+    "w_down": ("model.layers.{i}.mlp.down_proj.weight", True),
+}
+# Mixtral-style MoE: router + per-expert w1(gate)/w3(up)/w2(down)
+_MOE_LAYER_MAP = {
+    "attn_norm": ("model.layers.{i}.input_layernorm.weight", False),
+    "wq": ("model.layers.{i}.self_attn.q_proj.weight", True),
+    "wk": ("model.layers.{i}.self_attn.k_proj.weight", True),
+    "wv": ("model.layers.{i}.self_attn.v_proj.weight", True),
+    "wo": ("model.layers.{i}.self_attn.o_proj.weight", True),
+    "mlp_norm": ("model.layers.{i}.post_attention_layernorm.weight", False),
+    "router": ("model.layers.{i}.block_sparse_moe.gate.weight", True),
+    "w_gate": ("model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight", True),
+    "w_up": ("model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight", True),
+    "w_down": ("model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight", True),
+}
+_GLOBAL_MAP = {
+    "embed": ("model.embed_tokens.weight", False),
+    "final_norm": ("model.norm.weight", False),
+    "lm_head": ("lm_head.weight", True),
+}
+
+
+def has_weights(model_dir: str) -> bool:
+    return bool(glob.glob(os.path.join(model_dir, "*.safetensors")))
+
+
+class _ShardedCheckpoint:
+    """Lazily reads tensors across sharded safetensors files."""
+
+    def __init__(self, model_dir: str):
+        self.files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
+        if not self.files:
+            raise FileNotFoundError(f"no *.safetensors under {model_dir}")
+        index_path = os.path.join(model_dir, "model.safetensors.index.json")
+        self._name_to_file: dict[str, str] = {}
+        if os.path.exists(index_path):
+            with open(index_path) as f:
+                weight_map = json.load(f)["weight_map"]
+            self._name_to_file = {
+                k: os.path.join(model_dir, v) for k, v in weight_map.items()
+            }
+        else:
+            from safetensors import safe_open
+
+            for path in self.files:
+                with safe_open(path, framework="np") as f:
+                    for name in f.keys():
+                        self._name_to_file[name] = path
+        self._open_handles: dict[str, Any] = {}
+
+    def names(self) -> set[str]:
+        return set(self._name_to_file)
+
+    def get(self, name: str) -> np.ndarray:
+        from safetensors import safe_open
+
+        path = self._name_to_file[name]
+        handle = self._open_handles.get(path)
+        if handle is None:
+            handle = safe_open(path, framework="np")
+            self._open_handles[path] = handle
+        return handle.get_tensor(name)
+
+
+def _to_jax(arr: np.ndarray, dtype) -> jnp.ndarray:
+    if arr.dtype == np.uint16:
+        # numpy has no bfloat16: reinterpret via jax
+        return jax.lax.bitcast_convert_type(jnp.asarray(arr), jnp.bfloat16).astype(dtype)
+    return jnp.asarray(arr, dtype=dtype)
+
+
+def load_params(
+    cfg: ModelConfig, model_dir: str, mesh: Optional[Mesh] = None
+) -> Params:
+    """Load and stack weights; device_put with TP shardings as we go so the
+    full f32 copy never materializes on one device."""
+    ckpt = _ShardedCheckpoint(model_dir)
+    shapes = param_shapes(cfg)
+    specs = param_specs(cfg)
+    params: Params = {}
+
+    def put(name: str, arr: jnp.ndarray) -> jnp.ndarray:
+        shape, dtype = shapes[name]
+        arr = arr.astype(dtype)
+        if arr.shape != shape:
+            raise ValueError(f"{name}: expected {shape}, got {arr.shape}")
+        if mesh is not None:
+            arr = jax.device_put(arr, NamedSharding(mesh, specs[name]))
+        return arr
+
+    for name, (hf_name, transpose) in _GLOBAL_MAP.items():
+        if name == "lm_head" and hf_name not in ckpt.names():
+            # tied embeddings
+            arr = params["embed"].T
+            params[name] = put(name, arr)
+            continue
+        arr = _to_jax(ckpt.get(hf_name), shapes[name][1])
+        if transpose:
+            arr = arr.T
+        params[name] = put(name, arr)
+
+    L = cfg.num_hidden_layers
+    layer_map = _MOE_LAYER_MAP if cfg.is_moe else _LAYER_MAP
+    for name, (tmpl, transpose) in layer_map.items():
+        if name not in shapes:
+            continue
+        per_layer = []
+        for i in range(L):
+            if "{e}" in tmpl:
+                # stack experts: [E, in, out]
+                per_expert = []
+                for e in range(cfg.num_local_experts):
+                    arr = _to_jax(ckpt.get(tmpl.format(i=i, e=e)), shapes[name][1])
+                    per_expert.append(arr.T if transpose else arr)
+                per_layer.append(jnp.stack(per_expert))
+            else:
+                arr = _to_jax(ckpt.get(tmpl.format(i=i)), shapes[name][1])
+                per_layer.append(arr.T if transpose else arr)
+        params[name] = put(name, jnp.stack(per_layer))
+    log.info("loaded %d params from %s", len(params), model_dir)
+    return params

@@ -1,0 +1,16 @@
+"""Device mesh + sharding utilities (the TPU-native parallelism layer).
+
+Where the reference delegates tensor/expert parallelism to its engines'
+NCCL groups (reference: SURVEY.md §2.6), dynamo-tpu owns them natively:
+a `jax.sharding.Mesh` with named axes
+
+  dp — data parallel (batch)           sp — sequence/context parallel
+  tp — tensor parallel (heads/hidden)  ep — expert parallel (MoE)
+
+and `NamedSharding` rules applied to params, KV cache, and activations.
+XLA inserts the collectives (psum/all-gather/reduce-scatter) over ICI.
+"""
+
+from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh, shard
+
+__all__ = ["MeshConfig", "build_mesh", "shard"]

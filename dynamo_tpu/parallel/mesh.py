@@ -1,0 +1,61 @@
+"""Mesh construction + sharding helpers."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "tp", "ep", "sp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. Product must equal the device count in use.
+
+    For inference engines the common shapes are (dp=1, tp=N) for dense
+    models and (dp=1, tp=k, ep=m) for MoE decode.
+    """
+
+    dp: int = 1
+    tp: int = 1
+    ep: int = 1
+    sp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.tp * self.ep * self.sp
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {"dp": self.dp, "tp": self.tp, "ep": self.ep, "sp": self.sp}
+
+
+def build_mesh(
+    config: MeshConfig, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build a named-axis mesh over the given (or all) devices.
+
+    Axis order is (dp, tp, ep, sp) with tp innermost-but-one so TP
+    collectives ride the fastest ICI dimension on real slices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if config.size != len(devices):
+        raise ValueError(
+            f"mesh {config} needs {config.size} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(config.dp, config.ep, config.sp, config.tp)
+    # mesh dims named in the same order as the reshape
+    return Mesh(arr, axis_names=("dp", "ep", "sp", "tp"))
+
+
+def shard(mesh: Mesh, *spec) -> NamedSharding:
+    """NamedSharding shorthand: shard(mesh, 'tp', None) etc."""
+    return NamedSharding(mesh, P(*spec))
+
+
+def host_to_device(mesh: Mesh, array, *spec):
+    """device_put with a named sharding."""
+    return jax.device_put(array, shard(mesh, *spec))

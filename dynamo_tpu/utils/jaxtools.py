@@ -1,0 +1,39 @@
+"""JAX platform selection helpers.
+
+Some environments register accelerator PJRT plugins at interpreter boot;
+jax initializes every registered backend on first use, which can dial
+remote hardware even for CPU-only dev runs. ``force_platform("cpu")``
+deregisters other factories before any backend is created.
+
+Controlled by ``DYN_JAX_PLATFORM`` (e.g. "cpu") and
+``DYN_JAX_CPU_DEVICES`` (virtual device count for sharding dev-runs).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_platform(platform: str, cpu_devices: int | None = None) -> None:
+    """Must be called before the first JAX backend initialization."""
+    if cpu_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={cpu_devices}"
+            ).strip()
+    import jax
+    import jax._src.xla_bridge as xb
+
+    jax.config.update("jax_platforms", platform)
+    if platform == "cpu":
+        for name in list(getattr(xb, "_backend_factories", {})):
+            if name != "cpu":
+                xb._backend_factories.pop(name, None)
+
+
+def configure_from_env() -> None:
+    plat = os.environ.get("DYN_JAX_PLATFORM")
+    if plat:
+        n = os.environ.get("DYN_JAX_CPU_DEVICES")
+        force_platform(plat, int(n) if n else None)

@@ -22,6 +22,16 @@ import inspect  # noqa: E402
 import pytest  # noqa: E402
 
 
+# Tests must never touch the real chip (the TPU plugin registers at
+# interpreter boot and backend init dials the single-tenant TPU tunnel).
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from dynamo_tpu.utils.jaxtools import force_platform  # noqa: E402
+
+force_platform("cpu", cpu_devices=8)
+
+
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
     """Minimal asyncio support: run ``async def`` tests via asyncio.run."""

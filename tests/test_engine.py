@@ -1,0 +1,355 @@
+"""JAX engine tests on the virtual CPU backend.
+
+The load-bearing test is prefill+decode ≡ one-shot forward: running a
+sequence incrementally through the paged cache must produce the same
+logits/greedy tokens as processing it in a single pass.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.allocator import BlockAllocator, NoBlocksError
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.scheduler import Scheduler, Sequence
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.tokens import TokenBlockSequence
+
+MODEL_DIR = os.path.join(os.path.dirname(__file__), "data", "tiny_llama_model")
+
+
+# ---------------------------------------------------------------------------
+# Allocator
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_basic_and_prefix_reuse():
+    events = []
+    alloc = BlockAllocator(8, 4, on_event=lambda op, h, b: events.append((op, h)))
+    hashes = [101, 102, 103]
+    blocks, cached = alloc.allocate_prefix(hashes)
+    assert cached == 0 and len(blocks) == 3
+    for b, h in zip(blocks, hashes):
+        alloc.commit_block(b, h)
+    assert [e[0] for e in events] == ["stored"] * 3
+    # a second sequence with the same prefix reuses all three
+    blocks2, cached2 = alloc.allocate_prefix(hashes)
+    assert cached2 == 3 and blocks2 == blocks
+    assert alloc.match_prefix([101, 102, 999]) == 2
+    alloc.free_sequence(blocks)
+    alloc.free_sequence(blocks2)
+    # still cached after free (inactive pool keeps content)
+    blocks3, cached3 = alloc.allocate_prefix(hashes)
+    assert cached3 == 3
+    alloc.free_sequence(blocks3)
+
+
+def test_allocator_eviction_lru_and_events():
+    events = []
+    alloc = BlockAllocator(4, 4, on_event=lambda op, h, b: events.append((op, h[0])))
+    b1, _ = alloc.allocate_prefix([1, 2, 3])
+    for b, h in zip(b1, [1, 2, 3]):
+        alloc.commit_block(b, h)
+    alloc.free_sequence(b1)
+    # allocating new content evicts the LRU cached blocks and emits removals
+    b2, cached = alloc.allocate_prefix([7, 8])
+    assert cached == 0
+    removed = [h for op, h in events if op == "removed"]
+    assert len(removed) == 2
+    assert alloc.match_prefix([1]) == (1 if 1 not in removed else 0)
+
+
+def test_allocator_capacity_rollback():
+    alloc = BlockAllocator(4, 4)  # 3 usable
+    blocks, _ = alloc.allocate_prefix([1, 2])
+    with pytest.raises(NoBlocksError):
+        alloc.allocate_prefix([9, 10])  # needs 2, only 1 free
+    assert alloc.num_free == 1  # rollback left state intact
+    alloc.free_sequence(blocks)
+    assert alloc.num_free == 3
+
+
+# ---------------------------------------------------------------------------
+# Scheduler
+# ---------------------------------------------------------------------------
+
+
+def _mk_seq(tokens, block_size=4, max_tokens=None, request_id="r"):
+    return Sequence(
+        request=PreprocessedRequest(
+            request_id=request_id,
+            token_ids=list(tokens),
+            stop=StopConditions(max_tokens=max_tokens),
+        ),
+        tokens=TokenBlockSequence(list(tokens), block_size=block_size),
+    )
+
+
+def test_scheduler_admission_and_chunked_prefill():
+    alloc = BlockAllocator(64, 4)
+    sched = Scheduler(alloc, 4, max_batch_size=4, prefill_chunk_size=8)
+    seq = _mk_seq(list(range(20)))
+    sched.add_request(seq)
+    # chunk 1: 8 tokens
+    plan = sched.plan()
+    assert plan.kind == "prefill" and len(plan.prefill.tokens) == 8
+    assert plan.prefill.start_pos == 0 and not plan.prefill.is_last_chunk
+    sched.complete_prefill_chunk(plan.prefill)
+    # chunk 2
+    plan = sched.plan()
+    assert plan.prefill.start_pos == 8 and len(plan.prefill.tokens) == 8
+    sched.complete_prefill_chunk(plan.prefill)
+    # chunk 3 (final, 4 tokens)
+    plan = sched.plan()
+    assert plan.prefill.is_last_chunk and len(plan.prefill.tokens) == 4
+    sched.complete_prefill_chunk(plan.prefill)
+    assert sched.num_running == 1
+    plan = sched.plan()
+    assert plan.kind == "decode" and plan.decode_seqs == [seq]
+
+
+def test_scheduler_decode_arrays_shapes():
+    alloc = BlockAllocator(64, 4)
+    sched = Scheduler(alloc, 4, max_batch_size=8)
+    seqs = []
+    for i in range(3):
+        s = _mk_seq(list(range(5 + i)), request_id=f"r{i}")
+        sched.add_request(s)
+        seqs.append(s)
+    while sched.prefilling or sched.waiting:
+        plan = sched.plan()
+        assert plan.kind == "prefill"
+        sched.complete_prefill_chunk(plan.prefill)
+    plan = sched.plan()
+    arrays = sched.build_decode_arrays(plan.decode_seqs)
+    assert arrays["tokens"].shape[0] == 4  # bucket of 3 -> 4
+    assert arrays["block_tables"].shape[1] % sched.TABLE_BUCKET == 0
+    # slot mapping points at the last token's slot
+    s0 = plan.decode_seqs[0]
+    pos = s0.total_len - 1
+    assert arrays["slot_mapping"][0] == s0.block_table[pos // 4] * 4 + pos % 4
+
+
+def test_scheduler_preemption_frees_blocks():
+    alloc = BlockAllocator(8, 4)  # 7 usable
+    sched = Scheduler(alloc, 4, max_batch_size=4)
+    a = _mk_seq(list(range(12)), request_id="a")  # 3 blocks
+    b = _mk_seq(list(range(12)), request_id="b")  # 3 blocks
+    sched.add_request(a)
+    sched.add_request(b)
+    while sched.prefilling or sched.waiting:
+        plan = sched.plan()
+        if plan.kind != "prefill":
+            break
+        sched.complete_prefill_chunk(plan.prefill)
+    assert sched.num_running == 2
+    # grow a: next token needs block 4 for a; only 1 free; then b needs one
+    # too -> b (younger) gets preempted when pool is exhausted
+    for seq in (a, b):
+        sched.append_token(seq, 1)  # fills to 13 tokens
+    for _ in range(4):
+        plan = sched.plan()
+        if plan.kind != "decode":
+            break
+        for s in plan.decode_seqs:
+            sched.append_token(s, 1)
+        if sched.waiting:
+            break
+    # the OLDER sequence keeps running; the younger one is the preemption
+    # victim (vLLM recompute policy)
+    assert a.state.value == "running"
+    assert b.state.value == "waiting"
+    assert b.block_table == []  # its blocks were freed
+
+
+# ---------------------------------------------------------------------------
+# Model correctness: incremental == one-shot
+# ---------------------------------------------------------------------------
+
+
+def test_paged_forward_incremental_matches_oneshot():
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models import ModelConfig
+    from dynamo_tpu.models.llama import forward, init_cache, init_params
+
+    cfg = ModelConfig.from_dir(MODEL_DIR)
+    cfg.num_hidden_layers = 2
+    params = init_params(cfg, seed=0)
+    bs = 4
+    prompt = list(range(1, 11))  # 10 tokens
+
+    def run_oneshot(tokens):
+        k, v = init_cache(cfg, 16, bs, dtype=jnp.float32)
+        T = len(tokens)
+        n_blocks = -(-T // bs)
+        tables = np.zeros((1, 8), np.int32)
+        tables[0, :n_blocks] = np.arange(1, n_blocks + 1)
+        slots = np.zeros((T,), np.int32)
+        for j in range(T):
+            slots[j] = tables[0, j // bs] * bs + j % bs
+        logits, _, _ = forward(
+            cfg, params, k, v,
+            np.asarray([tokens], np.int32),
+            np.arange(T, dtype=np.int32)[None, :],
+            slots, tables,
+            np.asarray([T], np.int32),
+            np.asarray([T - 1], np.int32),
+            bs,
+        )
+        return np.asarray(logits[0])
+
+    # incremental: prefill prompt, then decode 4 tokens greedily
+    k, v = init_cache(cfg, 16, bs, dtype=jnp.float32)
+    tables = np.zeros((1, 8), np.int32)
+    seq_tokens = list(prompt)
+    n_blocks = -(-len(seq_tokens) // bs)
+    tables[0, :n_blocks] = np.arange(1, n_blocks + 1)
+    slots = np.zeros((len(prompt),), np.int32)
+    for j in range(len(prompt)):
+        slots[j] = tables[0, j // bs] * bs + j % bs
+    logits, k, v = forward(
+        cfg, params, k, v,
+        np.asarray([prompt], np.int32),
+        np.arange(len(prompt), dtype=np.int32)[None, :],
+        slots, tables,
+        np.asarray([len(prompt)], np.int32),
+        np.asarray([len(prompt) - 1], np.int32),
+        bs,
+    )
+    for _ in range(4):
+        nxt = int(np.argmax(np.asarray(logits)[0]))
+        # one-shot over the full sequence must agree on the next prediction
+        oneshot_logits = run_oneshot(seq_tokens)
+        assert int(np.argmax(oneshot_logits)) == nxt
+        np.testing.assert_allclose(
+            np.asarray(logits)[0], oneshot_logits, rtol=2e-2, atol=2e-2
+        )
+        seq_tokens.append(nxt)
+        pos = len(seq_tokens) - 1
+        n_blocks = -(-len(seq_tokens) // bs)
+        tables[0, :n_blocks] = np.arange(1, n_blocks + 1)
+        slot = np.asarray([tables[0, pos // bs] * bs + pos % bs], np.int32)
+        logits, k, v = forward(
+            cfg, params, k, v,
+            np.asarray([[nxt]], np.int32),
+            np.asarray([[pos]], np.int32),
+            slot, tables,
+            np.asarray([len(seq_tokens)], np.int32),
+            np.asarray([0], np.int32),
+            bs,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Engine end-to-end (async, CPU)
+# ---------------------------------------------------------------------------
+
+
+def _engine_config(**kw) -> EngineConfig:
+    defaults = dict(
+        model_path=MODEL_DIR,
+        model_name="tiny",
+        random_weights=True,
+        num_blocks=128,
+        block_size=8,
+        max_batch_size=8,
+        prefill_chunk_size=32,
+        max_model_len=256,
+    )
+    defaults.update(kw)
+    return EngineConfig(**defaults)
+
+
+async def _generate(engine, prompt_ids, max_tokens=8, greedy=True, request_id="r"):
+    from dynamo_tpu.protocols.common import SamplingOptions
+
+    adapter = engine.as_async_engine()
+    req = PreprocessedRequest(
+        request_id=request_id,
+        token_ids=list(prompt_ids),
+        sampling=SamplingOptions(use_greedy=greedy),
+        stop=StopConditions(max_tokens=max_tokens),
+    )
+    out = []
+    final = None
+    async for item in adapter.generate(req, Context()):
+        out.extend(item.token_ids)
+        if item.is_final:
+            final = item
+    return out, final
+
+
+async def test_engine_greedy_determinism_and_prefix_cache():
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    engine = await JaxEngine.launch(_engine_config())
+    try:
+        prompt = list(range(1, 40))
+        toks1, fin1 = await _generate(engine, prompt, request_id="r1")
+        assert len(toks1) == 8
+        assert fin1.finish_reason == FinishReason.LENGTH
+        assert fin1.completion_tokens == 8
+        # same prompt again: identical greedy continuation + prefix-cache hit
+        toks2, _ = await _generate(engine, prompt, request_id="r2")
+        assert toks2 == toks1
+        stats = engine.stats()
+        assert stats.gpu_prefix_cache_hit_rate > 0.0
+        assert stats.kv_total_blocks == 127
+    finally:
+        await engine.shutdown()
+
+
+async def test_engine_concurrent_batching():
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    engine = await JaxEngine.launch(_engine_config())
+    try:
+        prompts = [list(range(1, 10 + i)) for i in range(5)]
+        results = await asyncio.gather(
+            *[
+                _generate(engine, p, max_tokens=6, request_id=f"c{i}")
+                for i, p in enumerate(prompts)
+            ]
+        )
+        for toks, fin in results:
+            assert len(toks) == 6
+            assert fin.finish_reason == FinishReason.LENGTH
+        # determinism under batching: re-run one prompt alone and compare
+        solo, _ = await _generate(engine, prompts[0], max_tokens=6, request_id="solo")
+        assert solo == results[0][0]
+    finally:
+        await engine.shutdown()
+
+
+async def test_engine_cancellation_frees_blocks():
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    engine = await JaxEngine.launch(_engine_config())
+    try:
+        adapter = engine.as_async_engine()
+        ctx = Context()
+        req = PreprocessedRequest(
+            request_id="cancel-me",
+            token_ids=list(range(1, 30)),
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=200),
+        )
+        got = 0
+        async for item in adapter.generate(req, ctx):
+            if item.token_ids:
+                got += 1
+            if got == 3:
+                ctx.stop_generating()
+        await asyncio.sleep(0.3)
+        assert engine.allocator.num_free == engine.allocator.num_blocks - 1
+    finally:
+        await engine.shutdown()
