@@ -1,0 +1,154 @@
+"""End-to-end serving benchmark: continuous-batching decode throughput.
+
+Prints ONE JSON line: {"metric","value","unit","vs_baseline"}.
+
+Runs the full native engine (scheduler + paged KV + fused jitted step) on
+the available accelerator with a flagship-shaped Llama (random weights —
+throughput is weight-agnostic). ``vs_baseline`` is measured throughput as
+a fraction of the single-chip HBM roofline (weights + KV traffic at ~819
+GB/s for v5e): 1.0 would mean perfectly bandwidth-bound decode, so higher
+is better and the number is comparable across rounds.
+
+Env knobs: DYN_BENCH_PLATFORM=cpu for a tiny smoke run; DYN_BENCH_BATCH,
+DYN_BENCH_ISL, DYN_BENCH_OSL to override the workload.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+HBM_BW_BYTES = 819e9  # v5e HBM bandwidth
+
+
+def _build_config(cpu_mode: bool):
+    from dynamo_tpu.models.config import ModelConfig
+
+    if cpu_mode:
+        model = ModelConfig(
+            vocab_size=2048, hidden_size=128, intermediate_size=256,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=2048,
+        )
+        workload = dict(batch=4, isl=32, osl=16, num_blocks=256, block_size=16)
+    else:
+        # ~3.8B-param Llama shape: fits one 16GB v5e chip in bf16 + KV
+        model = ModelConfig(
+            vocab_size=32768, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=16, num_attention_heads=32, num_key_value_heads=8,
+            max_position_embeddings=8192,
+        )
+        workload = dict(batch=32, isl=128, osl=128, num_blocks=4096, block_size=16)
+    workload["batch"] = int(os.environ.get("DYN_BENCH_BATCH", workload["batch"]))
+    workload["isl"] = int(os.environ.get("DYN_BENCH_ISL", workload["isl"]))
+    workload["osl"] = int(os.environ.get("DYN_BENCH_OSL", workload["osl"]))
+    return model, workload
+
+
+def _param_bytes(mc) -> int:
+    D, F, V, L = mc.hidden_size, mc.intermediate_size, mc.vocab_size, mc.num_hidden_layers
+    H, Hk, Dh = mc.num_attention_heads, mc.num_key_value_heads, mc.head_dim
+    per_layer = D * H * Dh + 2 * D * Hk * Dh + H * Dh * D + 3 * D * F
+    return 2 * (per_layer * L + 2 * V * D)  # bf16
+
+
+def _kv_bytes_per_token(mc) -> int:
+    return 2 * mc.num_hidden_layers * mc.num_key_value_heads * mc.head_dim * 2
+
+
+async def _run(model_cfg, wl) -> dict:
+    import numpy as np
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    cfg = EngineConfig(
+        model_path="", model_name="bench", random_weights=True,
+        num_blocks=wl["num_blocks"], block_size=wl["block_size"],
+        max_batch_size=wl["batch"], prefill_chunk_size=1024,
+        max_model_len=wl["isl"] + wl["osl"] + 8,
+    )
+    engine = await JaxEngine.launch(cfg, model_config=model_cfg)
+
+    rng = np.random.default_rng(0)
+    adapter = engine.as_async_engine()
+
+    async def one_request(i: int) -> tuple[float, float, int]:
+        prompt = rng.integers(1, model_cfg.vocab_size, size=wl["isl"]).tolist()
+        prompt[0] = 7 + i  # unique head: avoid total prefix collapse
+        req = PreprocessedRequest(
+            request_id=f"bench-{i}",
+            token_ids=prompt,
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=wl["osl"], ignore_eos=True),
+        )
+        t_start = time.monotonic()
+        t_first = None
+        n = 0
+        async for item in adapter.generate(req, Context()):
+            if item.token_ids and t_first is None:
+                t_first = time.monotonic()
+            n += len(item.token_ids)
+        return t_start, t_first or time.monotonic(), n
+
+    # warmup: trigger all compiles (prefill buckets + decode buckets)
+    await one_request(9999)
+
+    t0 = time.monotonic()
+    results = await asyncio.gather(*[one_request(i) for i in range(wl["batch"])])
+    t1 = time.monotonic()
+    total_tokens = sum(r[2] for r in results)
+    ttfts = [r[1] - r[0] for r in results]
+    wall = t1 - t0
+    tput = total_tokens / wall
+
+    # roofline: per decode step, read all weights once + each seq's KV
+    avg_ctx = wl["isl"] + wl["osl"] / 2
+    step_bytes = _param_bytes(model_cfg) + wl["batch"] * avg_ctx * _kv_bytes_per_token(model_cfg)
+    roofline_tput = wl["batch"] / (step_bytes / HBM_BW_BYTES)
+
+    await engine.shutdown()
+    return {
+        "tput": tput,
+        "p50_ttft_s": sorted(ttfts)[len(ttfts) // 2],
+        "total_tokens": total_tokens,
+        "wall_s": wall,
+        "roofline": roofline_tput,
+    }
+
+
+def main() -> None:
+    cpu_mode = os.environ.get("DYN_BENCH_PLATFORM") == "cpu"
+    if cpu_mode:
+        from dynamo_tpu.utils.jaxtools import force_platform
+
+        force_platform("cpu")
+    model_cfg, wl = _build_config(cpu_mode)
+    r = asyncio.run(_run(model_cfg, wl))
+    out = {
+        "metric": "engine_decode_throughput_1chip",
+        "value": round(r["tput"], 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(r["tput"] / r["roofline"], 4),
+    }
+    print(json.dumps(out))
+    print(
+        f"# detail: total_tokens={r['total_tokens']} wall={r['wall_s']:.2f}s "
+        f"p50_ttft={r['p50_ttft_s'] * 1000:.0f}ms roofline={r['roofline']:.0f} tok/s",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -113,22 +113,25 @@ def _wrap_pipeline(args: Any, core, eos_ids: list[int]):
 
 
 async def _build_core_engine(args: Any):
-    """The tokens-in/tokens-out core engine for out={echo_core,jax}."""
+    """The tokens-in/tokens-out core engine for out={echo_core,jax}.
+
+    Returns (async_engine, eos_token_ids, jax_engine_or_None).
+    """
     if args.out_mode == "echo_core":
         from dynamo_tpu.engines import EchoEngineCore
 
-        return EchoEngineCore(), []
+        return EchoEngineCore(), [], None
     try:
         from dynamo_tpu.engine import JaxEngine, load_engine_config
     except ImportError as exc:
         raise SystemExit(f"jax engine unavailable: {exc}")
     config = load_engine_config(args)
     engine = await JaxEngine.launch(config)
-    return engine.as_async_engine(), engine.eos_token_ids
+    return engine.as_async_engine(), engine.eos_token_ids, engine
 
 
 async def _build_local_pipeline(args: Any):
-    core, eos_ids = await _build_core_engine(args)
+    core, eos_ids, _ = await _build_core_engine(args)
     return _wrap_pipeline(args, core, eos_ids)
 
 
@@ -140,12 +143,13 @@ async def cmd_run(args: Any) -> None:
     worker_mode = in_mode.startswith(DYN_SCHEME)
 
     # ---- output side: build the engine -----------------------------------
+    jax_engine = None
     if out in ("echo_core", "jax"):
         if worker_mode:
             # workers serve the core tokens-in/tokens-out engine; pre/post
             # runs at the frontend (reference: subprocess engine pattern)
             model_name = args.model_name or "worker"
-            engine, _ = await _build_core_engine(args)
+            engine, _, jax_engine = await _build_core_engine(args)
         else:
             model_name, engine = await _build_local_pipeline(args)
     elif out == "echo_full":
@@ -161,14 +165,21 @@ async def cmd_run(args: Any) -> None:
         ns, comp, ep = parse_dyn_path(out)
         cfg = _runtime_config(args)
         drt = await DistributedRuntime.create(config=cfg)
-        client = await drt.namespace(ns).component(comp).endpoint(ep).client()
+        component = drt.namespace(ns).component(comp)
+        client = await component.endpoint(ep).client()
         await client.wait_for_instances()
-        mode = (
-            RouterMode.ROUND_ROBIN
-            if args.router_mode == "round_robin"
-            else RouterMode.RANDOM
-        )
-        router = PushRouter(client, mode)
+        if args.router_mode == "kv":
+            from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+
+            kv_router = await KvRouter.create(component, client)
+            router = KvPushRouter(kv_router)
+        else:
+            mode = (
+                RouterMode.ROUND_ROBIN
+                if args.router_mode == "round_robin"
+                else RouterMode.RANDOM
+            )
+            router = PushRouter(client, mode)
         # remote workers speak PreprocessedRequest: wrap with local pre/post
         model_name, engine = _wrap_pipeline(args, router, [])
     else:
@@ -193,7 +204,27 @@ async def cmd_run(args: Any) -> None:
         cfg = _runtime_config(args)
         drt = await DistributedRuntime.create(config=cfg)
         drt.runtime.install_signal_handlers()
-        endpoint = drt.namespace(ns).component(comp).endpoint(ep)
+        component = drt.namespace(ns).component(comp)
+        endpoint = component.endpoint(ep)
+        # KV event + load-metrics publication must be wired BEFORE the
+        # instance becomes discoverable, or blocks cached in the window
+        # between serve() and wiring never reach the router's index
+        if jax_engine is not None:
+            from dynamo_tpu.kv_router.publisher import (
+                KvEventPublisher,
+                KvMetricsPublisher,
+            )
+
+            kv_pub = KvEventPublisher(
+                component,
+                worker_id=drt.primary_lease_id,
+                block_size=jax_engine.config.block_size,
+            )
+            jax_engine.kv_event_sink = kv_pub.sink
+            metrics_pub = KvMetricsPublisher(
+                component, drt.primary_lease_id, jax_engine.stats
+            )
+            metrics_pub.start()
         await endpoint.serve(engine)
         print(f"worker serving {in_mode}", flush=True)
         await drt.runtime.wait_shutdown()

@@ -93,8 +93,13 @@ class JaxEngine:
     # Launch
     # ------------------------------------------------------------------
     @classmethod
-    async def launch(cls, config: EngineConfig) -> "JaxEngine":
+    async def launch(
+        cls, config: EngineConfig, model_config: Optional[ModelConfig] = None
+    ) -> "JaxEngine":
+        """``model_config`` injection skips reading config.json from
+        model_path (benchmarks / synthetic model shapes)."""
         engine = cls(config)
+        engine.model_config = model_config
         loop = asyncio.get_running_loop()
         engine._loop = loop
         await loop.run_in_executor(None, engine._initialize)
@@ -114,7 +119,8 @@ class JaxEngine:
                 num_processes=cfg.num_nodes,
                 process_id=cfg.node_rank,
             )
-        self.model_config = ModelConfig.from_dir(cfg.model_path)
+        if self.model_config is None:
+            self.model_config = ModelConfig.from_dir(cfg.model_path)
         self.eos_token_ids = self.model_config.eos_token_ids
         mesh_cfg = MeshConfig(
             dp=cfg.data_parallel_size,
@@ -126,7 +132,11 @@ class JaxEngine:
 
         from dynamo_tpu.models import loader
 
-        if not cfg.random_weights and loader.has_weights(cfg.model_path):
+        if (
+            not cfg.random_weights
+            and cfg.model_path
+            and loader.has_weights(cfg.model_path)
+        ):
             self.params = loader.load_params(
                 self.model_config, cfg.model_path, self.mesh
             )

@@ -1,0 +1,27 @@
+"""KV-aware routing: radix indexer + cost-function scheduler + event plane.
+
+TPU-native analogue of the reference's KV router (reference:
+lib/llm/src/kv_router/{indexer.rs,scheduler.rs,publisher.rs,protocols.rs,
+recorder.rs}). Workers publish KV cache events (block stored/removed) and
+load metrics; the router maintains a global radix tree over block hashes
+with per-worker ownership, scores workers as
+
+    logit = 2·overlap_blocks − gpu_cache_usage − normalized_waiting
+
+and dispatches to the argmax (random tie-break).
+"""
+
+from dynamo_tpu.kv_router.indexer import KvIndexer, OverlapScores, RadixTree
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvCacheEvent, RouterEvent
+from dynamo_tpu.kv_router.scheduler import KvScheduler, default_selector
+
+__all__ = [
+    "ForwardPassMetrics",
+    "KvCacheEvent",
+    "KvIndexer",
+    "KvScheduler",
+    "OverlapScores",
+    "RadixTree",
+    "RouterEvent",
+    "default_selector",
+]

@@ -1,0 +1,150 @@
+"""Global prefix index over KV block hashes with per-worker ownership.
+
+Analogue of the reference's radix indexer (reference:
+lib/llm/src/kv_router/indexer.rs:86-876 — RadixTree, apply_event,
+find_matches, KvIndexer). Because dynamo-tpu's block hashes are *chained*
+sequence hashes (each hash commits to its whole prefix, tokens.py), the
+radix trie collapses to a flat hash→owners map: a chain walk IS a trie
+descent, with O(1) lookups and no explicit parent/child bookkeeping.
+
+``find_matches`` returns, per worker, the longest consecutive block prefix
+of the request present on that worker — the quantity the cost function
+feeds on (a non-prefix match cannot be reused by a paged decode).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from dynamo_tpu.kv_router.protocols import KvCacheEvent, RouterEvent
+from dynamo_tpu.tokens import compute_block_hashes_for_seq, compute_seq_hashes
+
+log = logging.getLogger("dynamo_tpu.kv_router.indexer")
+
+
+@dataclass
+class OverlapScores:
+    """worker_id -> matched consecutive prefix blocks
+    (reference: indexer.rs OverlapScores)."""
+
+    scores: dict[int, int] = field(default_factory=dict)
+    total_blocks: int = 0
+
+    def best(self) -> tuple[Optional[int], int]:
+        if not self.scores:
+            return None, 0
+        wid = max(self.scores, key=lambda w: self.scores[w])
+        return wid, self.scores[wid]
+
+
+class RadixTree:
+    """hash → owning workers, plus per-worker hash sets for cleanup."""
+
+    def __init__(self) -> None:
+        self._owners: dict[int, set[int]] = defaultdict(set)
+        self._by_worker: dict[int, set[int]] = defaultdict(set)
+        self.applied_events = 0
+
+    def apply_event(self, event: RouterEvent) -> None:
+        wid = event.worker_id
+        ev = event.event
+        if ev.op == "stored":
+            for h in ev.block_hashes:
+                self._owners[h].add(wid)
+                self._by_worker[wid].add(h)
+        elif ev.op == "removed":
+            for h in ev.block_hashes:
+                owners = self._owners.get(h)
+                if owners:
+                    owners.discard(wid)
+                    if not owners:
+                        self._owners.pop(h, None)
+                self._by_worker[wid].discard(h)
+        elif ev.op == "cleared":
+            self.remove_worker(wid)
+        self.applied_events += 1
+
+    def remove_worker(self, worker_id: int) -> None:
+        for h in self._by_worker.pop(worker_id, set()):
+            owners = self._owners.get(h)
+            if owners:
+                owners.discard(worker_id)
+                if not owners:
+                    self._owners.pop(h, None)
+
+    def find_matches(self, seq_hashes: Iterable[int]) -> OverlapScores:
+        hashes = list(seq_hashes)
+        scores: dict[int, int] = {}
+        active: Optional[set[int]] = None
+        for i, h in enumerate(hashes):
+            owners = self._owners.get(h)
+            if not owners:
+                break
+            active = set(owners) if active is None else active & owners
+            if not active:
+                break
+            for w in active:
+                scores[w] = i + 1
+        return OverlapScores(scores=scores, total_blocks=len(hashes))
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self._owners)
+
+    def workers(self) -> set[int]:
+        return set(self._by_worker)
+
+
+class KvIndexer:
+    """Event-driven indexer: subscribes to worker KV events and answers
+    overlap queries (reference: indexer.rs KvIndexer)."""
+
+    def __init__(self, block_size: int = 16):
+        self.tree = RadixTree()
+        self.block_size = block_size
+        self._task: Optional[asyncio.Task] = None
+
+    # -- queries ----------------------------------------------------------
+    def find_matches(self, seq_hashes: list[int]) -> OverlapScores:
+        return self.tree.find_matches(seq_hashes)
+
+    def find_matches_for_request(self, token_ids: list[int]) -> OverlapScores:
+        block_hashes = compute_block_hashes_for_seq(token_ids, self.block_size)
+        return self.tree.find_matches(compute_seq_hashes(block_hashes))
+
+    # -- event intake -----------------------------------------------------
+    def apply(self, event: RouterEvent) -> None:
+        # adopt the workers' block size: a mismatch would silently zero
+        # every overlap score (hashes computed over different block sizes)
+        ev_bs = event.event.token_block_size
+        if ev_bs and ev_bs != self.block_size:
+            log.warning(
+                "adopting worker token_block_size=%d (was %d)", ev_bs, self.block_size
+            )
+            self.block_size = ev_bs
+        self.tree.apply_event(event)
+
+    def start_consuming(self, subscriber) -> None:
+        """Consume RouterEvents from an async iterator of (subject, dict)."""
+
+        async def pump() -> None:
+            try:
+                async for _subject, payload in subscriber:
+                    try:
+                        self.apply(RouterEvent.model_validate(payload))
+                    except Exception:
+                        log.exception("bad router event")
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("kv event subscription died; index is frozen")
+
+        self._task = asyncio.get_running_loop().create_task(pump())
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()

@@ -1,0 +1,103 @@
+"""Worker-side KV event + metrics publication.
+
+Analogue of the reference's publishers (reference:
+lib/llm/src/kv_router/publisher.rs — KvEventPublisher to the event plane,
+ForwardPassMetrics on the load_metrics endpoint). Transport here is the
+store's pub/sub (component subjects) instead of NATS/ZMQ.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Optional
+
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvCacheEvent, RouterEvent
+from dynamo_tpu.runtime.component import Component
+
+log = logging.getLogger("dynamo_tpu.kv_router.publisher")
+
+KV_EVENTS_SUBJECT = "kv_events"
+LOAD_METRICS_SUBJECT = "load_metrics"
+
+
+class KvEventPublisher:
+    """Bridges the engine's allocator events onto the event plane.
+
+    Wire it as ``engine.kv_event_sink = publisher.sink`` — the sink is
+    thread-safe (the engine thread calls it; publication happens on the
+    event loop).
+    """
+
+    def __init__(self, component: Component, worker_id: int, block_size: int = 16):
+        self.component = component
+        self.worker_id = worker_id
+        self.block_size = block_size
+        self._event_ids = itertools.count(1)
+        self._loop = asyncio.get_event_loop()
+        self._pending: set[asyncio.Task] = set()
+
+    def sink(self, op: str, block_hashes: list[int], _block_ids: list[int]) -> None:
+        """Engine-thread-safe event sink."""
+        event = RouterEvent(
+            worker_id=self.worker_id,
+            event_id=next(self._event_ids),
+            event=KvCacheEvent(
+                op=op, block_hashes=list(block_hashes), token_block_size=self.block_size
+            ),
+        )
+        self._loop.call_soon_threadsafe(self._publish, event)
+
+    def _publish(self, event: RouterEvent) -> None:
+        task = self._loop.create_task(
+            self.component.publish(KV_EVENTS_SUBJECT, event.model_dump())
+        )
+        self._pending.add(task)
+        task.add_done_callback(self._pending.discard)
+
+    async def publish_cleared(self) -> None:
+        await self.component.publish(
+            KV_EVENTS_SUBJECT,
+            RouterEvent(
+                worker_id=self.worker_id,
+                event_id=next(self._event_ids),
+                event=KvCacheEvent(op="cleared"),
+            ).model_dump(),
+        )
+
+
+class KvMetricsPublisher:
+    """Periodically publishes the engine's ForwardPassMetrics."""
+
+    def __init__(
+        self,
+        component: Component,
+        worker_id: int,
+        stats_fn,
+        interval_s: float = 1.0,
+    ):
+        self.component = component
+        self.worker_id = worker_id
+        self.stats_fn = stats_fn
+        self.interval_s = interval_s
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.get_event_loop().create_task(self._loop())
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                stats = self.stats_fn()
+                payload = ForwardPassMetrics(
+                    worker_id=self.worker_id, **stats.to_dict()
+                ).model_dump()
+                await self.component.publish(LOAD_METRICS_SUBJECT, payload)
+            except Exception:
+                log.exception("metrics publish failed")
+            await asyncio.sleep(self.interval_s)
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()

@@ -1,0 +1,58 @@
+"""Record/replay router events as JSONL.
+
+Analogue of the reference's recorders (reference:
+lib/llm/src/{recorder.rs:38-273, kv_router/recorder.rs}): capture the KV
+event stream to JSONL for offline router simulation, and replay a file
+into an indexer — the test strategy for router behavior
+(reference: lib/llm/tests/data/replays/).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Iterator, Optional, TextIO
+
+from dynamo_tpu.kv_router.protocols import RouterEvent
+
+
+class KvRecorder:
+    def __init__(self, path: str):
+        self.path = path
+        self._fh: Optional[TextIO] = None
+        self.count = 0
+
+    def __enter__(self) -> "KvRecorder":
+        self._fh = open(self.path, "a")
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+    def record(self, event: RouterEvent) -> None:
+        assert self._fh is not None, "use as a context manager"
+        line = {"ts": time.time(), "event": event.model_dump()}
+        self._fh.write(json.dumps(line) + "\n")
+        self._fh.flush()
+        self.count += 1
+
+
+def iter_replay(path: str) -> Iterator[RouterEvent]:
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            raw = json.loads(line)
+            yield RouterEvent.model_validate(raw["event"])
+
+
+def replay_into(path: str, apply: Callable[[RouterEvent], None]) -> int:
+    """Feed a recorded event log into e.g. ``KvIndexer.apply``."""
+    n = 0
+    for event in iter_replay(path):
+        apply(event)
+        n += 1
+    return n

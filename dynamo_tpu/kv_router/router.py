@@ -1,0 +1,104 @@
+"""KvRouter: the routed engine facade.
+
+Analogue of the reference's KvRouter/KvPushRouter (reference:
+lib/llm/src/kv_router.rs:54-210): subscribes to a component's KV events +
+load metrics, and exposes (a) ``schedule()`` for explicit decisions and
+(b) an AsyncEngine that picks a worker per request and dispatches direct.
+Instance death prunes the worker from the index (liveness via discovery,
+like the reference's etcd-watch-driven cleanup).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.kv_router.indexer import KvIndexer
+from dynamo_tpu.kv_router.publisher import KV_EVENTS_SUBJECT, LOAD_METRICS_SUBJECT
+from dynamo_tpu.kv_router.scheduler import (
+    KvMetricsAggregator,
+    KvScheduler,
+    SchedulingDecision,
+)
+from dynamo_tpu.runtime.component import Client, Component
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
+
+log = logging.getLogger("dynamo_tpu.kv_router")
+
+
+class KvRouter:
+    def __init__(self, component: Component, client: Client, block_size: int = 16):
+        self.component = component
+        self.client = client
+        self.indexer = KvIndexer(block_size=block_size)
+        self.aggregator = KvMetricsAggregator()
+        self.scheduler = KvScheduler(self.indexer, self.aggregator)
+        self._prune_task: Optional[asyncio.Task] = None
+
+    @classmethod
+    async def create(
+        cls, component: Component, client: Client, block_size: int = 16
+    ) -> "KvRouter":
+        router = cls(component, client, block_size)
+        router.indexer.start_consuming(
+            await component.subscribe(KV_EVENTS_SUBJECT)
+        )
+        router.aggregator.start_consuming(
+            await component.subscribe(LOAD_METRICS_SUBJECT)
+        )
+        router._prune_task = asyncio.get_running_loop().create_task(
+            router._prune_dead_workers()
+        )
+        return router
+
+    async def _prune_dead_workers(self) -> None:
+        """Drop departed instances from index + metrics (reference:
+        scheduler.rs endpoint-watch driven cleanup)."""
+        known: set[int] = set()
+        while True:
+            live = set(self.client.instance_ids())
+            for dead in known - live:
+                log.info("pruning dead worker %x from kv index", dead)
+                self.indexer.tree.remove_worker(dead)
+                self.aggregator.remove_worker(dead)
+            known = live
+            await asyncio.sleep(1.0)
+
+    def schedule(self, token_ids: list[int]) -> SchedulingDecision:
+        return self.scheduler.schedule(token_ids, self.client.instance_ids())
+
+    async def close(self) -> None:
+        if self._prune_task is not None:
+            self._prune_task.cancel()
+        await self.indexer.close()
+        await self.aggregator.close()
+
+
+class KvPushRouter(AsyncEngine):
+    """AsyncEngine that KV-routes each PreprocessedRequest then streams
+    from the chosen worker (reference: kv_router.rs KvPushRouter)."""
+
+    def __init__(self, router: KvRouter):
+        self.router = router
+
+    async def _gen(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        token_ids = (
+            request.token_ids if hasattr(request, "token_ids") else request["token_ids"]
+        )
+        await self.router.client.wait_for_instances()
+        decision = self.router.schedule(list(token_ids))
+        # annotate the request with the expected prefix hit (the worker's
+        # disagg router uses it, reference: worker.py prefix_hit_rate)
+        if hasattr(request, "annotations"):
+            request.annotations = list(request.annotations) + [
+                f"kv_hit_rate:{decision.prefix_hit_rate:.3f}"
+            ]
+        stream = await self.router.client.generate_direct(
+            decision.worker_id, request, context
+        )
+        async for item in stream:
+            yield item
+
+    def generate(self, request: Any, context: Context) -> EngineStream:
+        return self._gen(request, context)

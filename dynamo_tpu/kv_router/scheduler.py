@@ -1,0 +1,154 @@
+"""KV-aware worker selection: metrics aggregation + cost function.
+
+Analogue of the reference's scheduler (reference:
+lib/llm/src/kv_router/scheduler.rs:88-337 — DefaultWorkerSelector:
+``logit = 2*overlap − gpu_cache_usage − normalized_waiting``, random
+tie-break; lib/llm/src/kv_router/{metrics_aggregator.rs,scoring.rs}).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from dynamo_tpu.kv_router.indexer import KvIndexer, OverlapScores
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvHitRateEvent
+
+log = logging.getLogger("dynamo_tpu.kv_router.scheduler")
+
+# selector: (overlaps, metrics by worker, candidate ids) -> worker id
+Selector = Callable[[OverlapScores, dict[int, ForwardPassMetrics], list[int]], int]
+
+
+def default_selector(
+    overlaps: OverlapScores,
+    metrics: dict[int, ForwardPassMetrics],
+    candidates: list[int],
+) -> int:
+    """reference: scheduler.rs DefaultWorkerSelector."""
+    max_waiting = max(
+        (metrics[w].num_requests_waiting for w in candidates if w in metrics),
+        default=0,
+    )
+    best_ids: list[int] = []
+    best_logit = float("-inf")
+    for wid in candidates:
+        m = metrics.get(wid, ForwardPassMetrics(worker_id=wid))
+        overlap = overlaps.scores.get(wid, 0)
+        waiting_norm = (
+            m.num_requests_waiting / max_waiting if max_waiting > 0 else 0.0
+        )
+        logit = 2.0 * overlap - m.gpu_cache_usage_perc - waiting_norm
+        if logit > best_logit:
+            best_logit, best_ids = logit, [wid]
+        elif logit == best_logit:
+            best_ids.append(wid)
+    return random.choice(best_ids)
+
+
+class KvMetricsAggregator:
+    """Holds the latest ForwardPassMetrics per worker, fed by pub/sub
+    (reference: metrics_aggregator.rs; transport differs — the reference
+    scrapes NATS service stats, we subscribe to a metrics subject)."""
+
+    def __init__(self, stale_after_s: float = 10.0):
+        self.metrics: dict[int, ForwardPassMetrics] = {}
+        self._updated: dict[int, float] = {}
+        self.stale_after_s = stale_after_s
+        self._task: Optional[asyncio.Task] = None
+
+    def update(self, m: ForwardPassMetrics) -> None:
+        self.metrics[m.worker_id] = m
+        self._updated[m.worker_id] = time.monotonic()
+
+    def fresh_metrics(self) -> dict[int, ForwardPassMetrics]:
+        now = time.monotonic()
+        return {
+            w: m
+            for w, m in self.metrics.items()
+            if now - self._updated.get(w, 0) < self.stale_after_s
+        }
+
+    def remove_worker(self, worker_id: int) -> None:
+        self.metrics.pop(worker_id, None)
+        self._updated.pop(worker_id, None)
+
+    def start_consuming(self, subscriber) -> None:
+        async def pump() -> None:
+            try:
+                async for _subject, payload in subscriber:
+                    try:
+                        self.update(ForwardPassMetrics.model_validate(payload))
+                    except Exception:
+                        log.exception("bad metrics payload")
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("metrics subscription died; snapshot is frozen")
+
+        self._task = asyncio.get_running_loop().create_task(pump())
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+
+@dataclass
+class SchedulingDecision:
+    worker_id: int
+    overlap_blocks: int
+    total_blocks: int
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        return self.overlap_blocks / self.total_blocks if self.total_blocks else 0.0
+
+
+class KvScheduler:
+    """indexer + metrics + selector → routing decisions
+    (reference: kv_router.rs KvRouter.schedule)."""
+
+    def __init__(
+        self,
+        indexer: KvIndexer,
+        aggregator: KvMetricsAggregator,
+        selector: Selector = default_selector,
+        on_hit_rate: Optional[Callable[[KvHitRateEvent], None]] = None,
+    ):
+        self.indexer = indexer
+        self.aggregator = aggregator
+        self.selector = selector
+        self.on_hit_rate = on_hit_rate
+
+    def schedule(
+        self, token_ids: list[int], candidates: list[int]
+    ) -> SchedulingDecision:
+        if not candidates:
+            raise RuntimeError("no candidate workers")
+        overlaps = self.indexer.find_matches_for_request(token_ids)
+        metrics = self.aggregator.fresh_metrics()
+        # prefer workers with a live health signal: if SOME candidates have
+        # fresh metrics, a candidate without them is stale (hung publisher /
+        # dead worker) — don't reward it with a default zero-load score
+        with_fresh = [w for w in candidates if w in metrics]
+        if with_fresh:
+            candidates = with_fresh
+        wid = self.selector(overlaps, metrics, candidates)
+        decision = SchedulingDecision(
+            worker_id=wid,
+            overlap_blocks=overlaps.scores.get(wid, 0),
+            total_blocks=overlaps.total_blocks,
+        )
+        if self.on_hit_rate is not None:
+            self.on_hit_rate(
+                KvHitRateEvent(
+                    worker_id=wid,
+                    isl_blocks=decision.total_blocks,
+                    overlap_blocks=decision.overlap_blocks,
+                )
+            )
+        return decision
