@@ -65,6 +65,10 @@ class BlockAllocator:
         usable = self.num_blocks - 1
         return 1.0 - len(self._free) / usable if usable else 1.0
 
+    def lookup_block(self, seq_hash: int) -> Optional[int]:
+        """Device block currently holding this content (if cached)."""
+        return self._hash_index.get(seq_hash)
+
     def match_prefix(self, seq_hashes: list[int]) -> int:
         """How many leading complete blocks are cached (no allocation)."""
         n = 0

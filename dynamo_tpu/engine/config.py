@@ -24,6 +24,11 @@ class EngineConfig:
     hbm_utilization: float = 0.9
     kv_cache_dtype: str = "bfloat16"
     enable_prefix_caching: bool = True
+    # KV offload tiers (G2 host / G3 disk; 0 = disabled)
+    host_kv_blocks: int = 0
+    disk_kv_blocks: int = 0
+    disk_kv_path: str = ""
+    kv_offload_batch: int = 16
     # batching
     max_batch_size: int = 64
     max_prefill_tokens: int = 4096

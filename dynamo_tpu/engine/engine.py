@@ -17,9 +17,11 @@ from __future__ import annotations
 import asyncio
 import functools
 import logging
+import os
 import queue as thread_queue
 import threading
 import time
+import uuid
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Callable, Optional
 
@@ -29,6 +31,8 @@ import numpy as np
 
 from dynamo_tpu.engine.allocator import BlockAllocator
 from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.kvbm import BlockLayout, KvbmConfig, KvBlockManager
+from dynamo_tpu.ops.block_copy import gather_blocks, scatter_blocks
 from dynamo_tpu.engine.sampling import SamplingBatch, sample
 from dynamo_tpu.engine.scheduler import (
     Scheduler,
@@ -79,6 +83,7 @@ class JaxEngine:
         self.v_cache = None
         self.allocator: Optional[BlockAllocator] = None
         self.scheduler: Optional[Scheduler] = None
+        self.kvbm: Optional[KvBlockManager] = None
         self.eos_token_ids: list[int] = []
         self._step_fn: Optional[Callable] = None
         self._thread: Optional[threading.Thread] = None
@@ -146,7 +151,11 @@ class JaxEngine:
 
         num_blocks = cfg.num_blocks or self._auto_num_blocks(devices)
         self.k_cache, self.v_cache = init_cache(
-            self.model_config, num_blocks, cfg.block_size, self.mesh
+            self.model_config,
+            num_blocks,
+            cfg.block_size,
+            self.mesh,
+            dtype=jnp.dtype(cfg.kv_cache_dtype),
         )
         self.allocator = BlockAllocator(
             num_blocks,
@@ -163,6 +172,32 @@ class JaxEngine:
             or self.model_config.max_position_embeddings,
         )
         self.scheduler.on_finish = self._emit_finish
+        if cfg.disk_kv_blocks > 0 and cfg.host_kv_blocks <= 0:
+            raise ValueError(
+                "disk_kv_blocks requires host_kv_blocks > 0 (G3 demotion "
+                "cascades from the G2 host tier)"
+            )
+        if cfg.host_kv_blocks > 0 and cfg.num_nodes > 1:
+            # multi-host caches are not fully addressable from one process;
+            # cross-host offload arrives with the G4 transfer agent
+            log.warning("KV offload tiers unsupported with num_nodes>1; disabled")
+        elif cfg.host_kv_blocks > 0:
+            self.kvbm = KvBlockManager(
+                KvbmConfig(
+                    host_num_blocks=cfg.host_kv_blocks,
+                    disk_num_blocks=cfg.disk_kv_blocks,
+                    disk_path=cfg.disk_kv_path
+                    or f"/tmp/dynamo_tpu_kv_{os.getpid()}_{uuid.uuid4().hex[:8]}.bin",
+                    offload_batch=cfg.kv_offload_batch,
+                ),
+                BlockLayout.for_model(
+                    self.model_config, cfg.block_size, cfg.kv_cache_dtype
+                ),
+                gather_fn=self._kv_gather,
+                scatter_fn=self._kv_scatter,
+                resolve_fn=self.allocator.lookup_block,
+            )
+            self.scheduler.onboard = self._safe_onboard
         self._build_step_fn()
         log.info(
             "engine up: %s, mesh=%s, blocks=%d×%d",
@@ -196,8 +231,34 @@ class JaxEngine:
             return 512
 
     def _on_kv_event(self, op: str, hashes: list[int], blocks: list[int]) -> None:
+        if self.kvbm is not None and op == "stored":
+            for h, b in zip(hashes, blocks):
+                self.kvbm.on_block_committed(h, b)
         if self.kv_event_sink is not None:
             self.kv_event_sink(op, hashes, blocks)
+
+    def _safe_onboard(self, hashes: list[int], blocks: list[int]) -> int:
+        """Onboarding is an optimization: a lower-tier failure degrades to
+        G1-only (a 0 return just means 'prefill those tokens normally')."""
+        if self.kvbm is None:
+            return 0
+        try:
+            return self.kvbm.onboard(hashes, blocks)
+        except Exception:
+            log.exception("kv onboard failed; disabling kvbm")
+            self._disable_kvbm()
+            return 0
+
+    # -- KVBM device data path (engine thread only: caches are donated) ----
+    def _kv_gather(self, block_ids: list[int]) -> np.ndarray:
+        return gather_blocks(
+            self.k_cache, self.v_cache, block_ids, self.config.block_size
+        )
+
+    def _kv_scatter(self, block_ids: list[int], data: np.ndarray) -> None:
+        self.k_cache, self.v_cache = scatter_blocks(
+            self.k_cache, self.v_cache, block_ids, data, self.config.block_size
+        )
 
     # ------------------------------------------------------------------
     # The fused device step
@@ -268,6 +329,14 @@ class JaxEngine:
         while self._running:
             self._drain_incoming()
             if not self.scheduler.has_work:
+                # idle: drain the offload queue before sleeping
+                if self.kvbm is not None and self.kvbm.pending_offloads:
+                    try:
+                        self.kvbm.pump()
+                    except Exception:
+                        log.exception("kv offload pump failed; disabling kvbm")
+                        self._disable_kvbm()
+                    continue
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
@@ -276,6 +345,25 @@ class JaxEngine:
             except Exception:
                 log.exception("engine step failed; failing in-flight requests")
                 self._fail_all()
+                continue
+            if self.kvbm is not None:
+                try:
+                    self.kvbm.pump()
+                except Exception:
+                    log.exception("kv offload pump failed; disabling kvbm")
+                    self._disable_kvbm()
+
+    def _disable_kvbm(self) -> None:
+        """Offload tiers are an optimization: on failure, degrade to
+        G1-only rather than taking the engine down."""
+        if self.kvbm is not None:
+            kvbm, self.kvbm = self.kvbm, None
+            if self.scheduler is not None:
+                self.scheduler.onboard = None
+            try:
+                kvbm.close()
+            except Exception:
+                pass
 
     def _drain_incoming(self) -> None:
         assert self.scheduler is not None
@@ -422,6 +510,8 @@ class JaxEngine:
             await asyncio.get_running_loop().run_in_executor(
                 None, functools.partial(self._thread.join, timeout=10)
             )
+        if self.kvbm is not None:
+            self.kvbm.close()
 
 
 class JaxEngineAdapter(AsyncEngine):

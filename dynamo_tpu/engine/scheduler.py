@@ -33,15 +33,7 @@ from dynamo_tpu.tokens import TokenBlockSequence
 log = logging.getLogger("dynamo_tpu.engine.scheduler")
 
 
-def next_bucket(n: int, buckets: list[int]) -> int:
-    for b in buckets:
-        if n <= b:
-            return b
-    # beyond the precomputed list: next power of two (never under-allocate)
-    b = buckets[-1]
-    while b < n:
-        b *= 2
-    return b
+from dynamo_tpu.utils.bucketing import next_bucket  # noqa: F401 (re-export)
 
 
 class SeqState(str, enum.Enum):
@@ -122,6 +114,9 @@ class Scheduler:
         self._arrival = 0
         # invoked on every finish (incl. cancellations reaped inside plan())
         self.on_finish: Optional[Callable[[Sequence, FinishReason], None]] = None
+        # KVBM hook: (remaining_hashes, their_device_blocks) -> n onboarded
+        # from host/disk tiers (dynamo_tpu/kvbm/manager.py onboard())
+        self.onboard: Optional[Callable[[list[int], list[int]], int]] = None
         # prefix-cache stats (one query per admitted request)
         self.prefix_queries = 0
         self.prefix_hits = 0
@@ -182,9 +177,24 @@ class Scheduler:
             try:
                 complete = seq_hashes[: n_prompt_blocks]
                 blocks, cached = self.allocator.allocate_prefix(complete)
+                if self.onboard is not None and cached < len(complete):
+                    n_on = self.onboard(
+                        complete[cached:], blocks[cached : len(complete)]
+                    )
+                    for i in range(n_on):
+                        self.allocator.commit_block(
+                            blocks[cached + i], complete[cached + i]
+                        )
+                    cached += n_on
                 extra = n_prompt_blocks - len(complete)
-                for _ in range(max(0, extra)):
-                    blocks.append(self.allocator.allocate_block())
+                try:
+                    for _ in range(max(0, extra)):
+                        blocks.append(self.allocator.allocate_block())
+                except NoBlocksError:
+                    # roll back the whole allocation (reused pins + fresh
+                    # + onboarded blocks) or they leak with a permanent ref
+                    self.allocator.free_sequence(blocks)
+                    raise
             except NoBlocksError:
                 break  # backpressure: try again next step
             self.waiting.popleft()

@@ -1,0 +1,27 @@
+"""Multi-tier KV block manager (G1 HBM / G2 host / G3 disk / G4 remote).
+
+Reference: lib/llm/src/block_manager/ (KvBlockManager, tier pools,
+layouts, offload manager). See manager.py for the TPU-native design.
+"""
+
+from dynamo_tpu.kvbm.layout import BlockLayout
+from dynamo_tpu.kvbm.manager import KvbmConfig, KvbmStats, KvBlockManager
+from dynamo_tpu.kvbm.pool import TierPool
+from dynamo_tpu.kvbm.storage import (
+    BlockStorage,
+    DiskBlockStorage,
+    HostBlockStorage,
+    NullBlockStorage,
+)
+
+__all__ = [
+    "BlockLayout",
+    "KvbmConfig",
+    "KvbmStats",
+    "KvBlockManager",
+    "TierPool",
+    "BlockStorage",
+    "DiskBlockStorage",
+    "HostBlockStorage",
+    "NullBlockStorage",
+]

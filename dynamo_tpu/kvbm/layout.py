@@ -1,0 +1,72 @@
+"""Block layout descriptors for the multi-tier KV block manager.
+
+A layout describes the shape of one KV block as it travels between tiers
+and between workers: a *packed block* is ``[2, L, block_size, Hkv, Dh]``
+(K then V, all layers together) so a block is one contiguous unit that
+can be DMA'd, memmapped, or shipped over the wire as raw bytes.
+
+The descriptor is JSON-serializable: the disaggregation transfer agent
+publishes it (≈ reference ``SerializedNixlBlockLayout``,
+lib/llm/src/block_manager/layout/nixl.rs) so a peer can interpret a raw
+block buffer without sharing Python objects. Unlike the reference's
+stride-bearing CUDA layouts (lib/llm/src/block_manager/layout.rs:128-535),
+TPU-side blocks live inside logical jax.Arrays — the layout only needs
+logical dims + dtype, XLA owns physical tiling.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class BlockLayout:
+    num_layers: int
+    block_size: int
+    num_kv_heads: int
+    head_dim: int
+    dtype: str = "bfloat16"  # numpy/ml_dtypes name
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        if self.dtype == "bfloat16":
+            import ml_dtypes
+
+            return np.dtype(ml_dtypes.bfloat16)
+        return np.dtype(self.dtype)
+
+    @property
+    def packed_shape(self) -> tuple[int, int, int, int, int]:
+        """One packed block: [2(K,V), L, block_size, Hkv, Dh]."""
+        return (2, self.num_layers, self.block_size, self.num_kv_heads, self.head_dim)
+
+    @property
+    def block_elems(self) -> int:
+        n = 1
+        for d in self.packed_shape:
+            n *= d
+        return n
+
+    @property
+    def block_bytes(self) -> int:
+        return self.block_elems * self.np_dtype.itemsize
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "BlockLayout":
+        return cls(**json.loads(s))
+
+    @classmethod
+    def for_model(cls, model_config, block_size: int, dtype: str = "bfloat16"):
+        return cls(
+            num_layers=model_config.num_hidden_layers,
+            block_size=block_size,
+            num_kv_heads=model_config.num_key_value_heads,
+            head_dim=model_config.head_dim,
+            dtype=dtype,
+        )

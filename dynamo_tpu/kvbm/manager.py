@@ -1,0 +1,182 @@
+"""KvBlockManager: multi-tier KV cache orchestration.
+
+Composes the engine's device allocator (G1, HBM) with host (G2) and disk
+(G3) tier pools (reference: lib/llm/src/block_manager.rs:60-166 +
+offload.rs:43-751). Responsibilities:
+
+- **offload** (G1→G2): device blocks that become content-addressed are
+  queued; ``pump()`` — called from the engine thread between steps —
+  batches them through one jitted gather and inserts into the host pool.
+  Single-threaded by design: the engine donates its cache buffers every
+  step, so only the engine thread may touch them (the reference gets the
+  same serialization from its progress-engine actor, block_manager/pool.rs).
+- **demotion** (G2→G3): host-pool eviction writes through to disk.
+- **onboarding** (G2/G3→G1): at admission, prompt blocks that miss in G1
+  but hit in lower tiers are copied into freshly allocated device blocks
+  via one jitted scatter, extending the prefix-cache hit (reference:
+  offload.rs onboarding + docs/architecture.md:91-96 — the +40% TTFT
+  system-memory-tier win this tier structure exists for).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+from dynamo_tpu.kvbm.layout import BlockLayout
+from dynamo_tpu.kvbm.pool import TierPool
+from dynamo_tpu.kvbm.storage import DiskBlockStorage, HostBlockStorage
+
+log = logging.getLogger("dynamo_tpu.kvbm")
+
+GatherFn = Callable[[list[int]], np.ndarray]  # device block ids -> packed
+ScatterFn = Callable[[list[int], np.ndarray], None]  # packed -> device blocks
+ResolveFn = Callable[[int], Optional[int]]  # seq_hash -> device block id
+
+
+@dataclass
+class KvbmConfig:
+    host_num_blocks: int = 0
+    disk_num_blocks: int = 0
+    disk_path: str = ""
+    offload_batch: int = 16  # max blocks gathered per pump
+
+
+@dataclass
+class KvbmStats:
+    offloaded_blocks: int = 0
+    onboarded_blocks: int = 0
+    demoted_blocks: int = 0
+    host_cached_blocks: int = 0
+    disk_cached_blocks: int = 0
+
+
+class KvBlockManager:
+    def __init__(
+        self,
+        config: KvbmConfig,
+        layout: BlockLayout,
+        gather_fn: GatherFn,
+        scatter_fn: ScatterFn,
+        resolve_fn: ResolveFn,
+    ):
+        self.config = config
+        # an offload batch larger than the host tier would just thrash it
+        if config.host_num_blocks > 0:
+            config.offload_batch = min(config.offload_batch, config.host_num_blocks)
+        self.layout = layout
+        self._gather = gather_fn
+        self._scatter = scatter_fn
+        self._resolve = resolve_fn
+        self.disk: Optional[TierPool] = None
+        if config.disk_num_blocks > 0:
+            self.disk = TierPool(
+                DiskBlockStorage(layout, config.disk_num_blocks, config.disk_path)
+            )
+        self.host = TierPool(
+            HostBlockStorage(layout, config.host_num_blocks),
+            on_evict=self._demote,
+        )
+        # offload candidates: seq_hash -> device block id at commit time
+        self._pending: OrderedDict[int, int] = OrderedDict()
+        self.stats = KvbmStats()
+
+    # -- event intake (engine thread) -------------------------------------
+    def on_block_committed(self, seq_hash: int, device_block: int) -> None:
+        if self.host.contains(seq_hash):
+            return
+        self._pending[seq_hash] = device_block
+
+    # -- offload pump (engine thread, between steps) -----------------------
+    def pump(self) -> int:
+        """Offload up to ``offload_batch`` pending blocks; returns count."""
+        if not self._pending:
+            return 0
+        batch: list[tuple[int, int]] = []
+        while self._pending and len(batch) < self.config.offload_batch:
+            h, bid = self._pending.popitem(last=False)
+            # the device block may have been evicted/reassigned since commit
+            if self._resolve(h) == bid and not self.host.contains(h):
+                batch.append((h, bid))
+        if not batch:
+            return 0
+        hashes = [h for h, _ in batch]
+        ids = [b for _, b in batch]
+        packed = self._gather(ids)
+        self.host.insert_many(hashes, packed)
+        self.stats.offloaded_blocks += len(batch)
+        self._refresh_gauges()
+        return len(batch)
+
+    @property
+    def pending_offloads(self) -> int:
+        return len(self._pending)
+
+    def _demote(self, seq_hash: int, data: np.ndarray) -> None:
+        if self.disk is not None:
+            self.disk.insert(seq_hash, data)
+            self.stats.demoted_blocks += 1
+
+    # -- onboarding (engine thread, at admission) --------------------------
+    def match_offloaded(self, seq_hashes: list[int]) -> int:
+        """Leading consecutive blocks available in G2/G3 (no copies)."""
+        n = 0
+        for h in seq_hashes:
+            if self.host.contains(h) or (self.disk is not None and self.disk.contains(h)):
+                n += 1
+            else:
+                break
+        return n
+
+    def onboard(self, seq_hashes: list[int], device_blocks: list[int]) -> int:
+        """Copy the longest available prefix of ``seq_hashes`` from lower
+        tiers into the given (freshly allocated) device blocks. Returns the
+        number of blocks onboarded."""
+        # plan first (membership only — no reads, no promotions yet, so the
+        # plan can't be invalidated by eviction cascades mid-loop)
+        host_rows: list[tuple[int, int]] = []  # (row index, hash)
+        disk_rows: list[tuple[int, int]] = []
+        limit = min(len(seq_hashes), len(device_blocks))
+        n = 0
+        for i in range(limit):
+            h = seq_hashes[i]
+            if self.host.contains(h):
+                host_rows.append((i, h))
+            elif self.disk is not None and self.disk.contains(h):
+                disk_rows.append((i, h))
+            else:
+                break
+            n += 1
+        if n == 0:
+            return 0
+        rows = np.zeros((n, *self.layout.packed_shape), self.layout.np_dtype)
+        if host_rows:
+            data = self.host.read([h for _, h in host_rows])  # one batched read
+            for j, (i, _) in enumerate(host_rows):
+                rows[i] = data[j]
+        disk_data = None
+        if disk_rows:
+            assert self.disk is not None
+            disk_data = self.disk.read([h for _, h in disk_rows])
+            for j, (i, _) in enumerate(disk_rows):
+                rows[i] = disk_data[j]
+        self._scatter(device_blocks[:n], rows)
+        # promote disk hits into the host tier AFTER all reads and the
+        # scatter: promotion may trigger host->disk demotion evictions
+        for j, (_, h) in enumerate(disk_rows):
+            self.host.insert(h, disk_data[j])
+        self.stats.onboarded_blocks += n
+        self._refresh_gauges()
+        return n
+
+    def _refresh_gauges(self) -> None:
+        self.stats.host_cached_blocks = self.host.num_cached
+        self.stats.disk_cached_blocks = self.disk.num_cached if self.disk else 0
+
+    def close(self) -> None:
+        if self.disk is not None:
+            self.disk.storage.close()

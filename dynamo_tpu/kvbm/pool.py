@@ -1,0 +1,97 @@
+"""Content-addressed block pool over a storage tier.
+
+A tier pool is a sequence-hash → block cache with LRU eviction — the
+host/disk analogue of the device allocator's inactive pool (reference:
+lib/llm/src/block_manager/pool/inactive.rs — FIFO VecDeque + seq-hash
+dedupe map + priority eviction order). Offloaded tiers hold no *active*
+(ref-counted) blocks: every block is a cached copy whose ground truth is
+re-computable, so the pool is a pure cache and eviction is always legal.
+
+``on_evict`` is the demotion hook: when G2 evicts, the manager writes the
+block down to G3 (reference offload cascade: block_manager/offload.rs).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Optional
+
+import numpy as np
+
+from dynamo_tpu.kvbm.storage import BlockStorage
+
+EvictFn = Callable[[int, np.ndarray], None]  # (seq_hash, packed_block)
+
+
+class TierPool:
+    def __init__(self, storage: BlockStorage, on_evict: Optional[EvictFn] = None):
+        self.storage = storage
+        self.on_evict = on_evict
+        self._free: list[int] = list(range(storage.num_blocks))
+        self._hash_to_block: dict[int, int] = {}
+        # LRU order over cached hashes: first = evict first
+        self._lru: OrderedDict[int, None] = OrderedDict()
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def num_cached(self) -> int:
+        return len(self._hash_to_block)
+
+    @property
+    def num_blocks(self) -> int:
+        return self.storage.num_blocks
+
+    def contains(self, seq_hash: int) -> bool:
+        return seq_hash in self._hash_to_block
+
+    def match_prefix(self, seq_hashes: list[int]) -> int:
+        """Leading consecutive hits (no side effects)."""
+        n = 0
+        for h in seq_hashes:
+            if h in self._hash_to_block:
+                n += 1
+            else:
+                break
+        return n
+
+    # -- data path --------------------------------------------------------
+    def insert(self, seq_hash: int, data: np.ndarray) -> None:
+        """Cache one packed block, evicting LRU if full."""
+        if seq_hash in self._hash_to_block:
+            self._lru.move_to_end(seq_hash)
+            return
+        if not self._free:
+            self._evict_one()
+        bid = self._free.pop()
+        self.storage.write_blocks([bid], data[None])
+        self._hash_to_block[seq_hash] = bid
+        self._lru[seq_hash] = None
+
+    def insert_many(self, seq_hashes: list[int], data: np.ndarray) -> None:
+        # write each block as it is admitted: if the batch overflows the
+        # tier, a same-batch victim must already hold real data when the
+        # demotion hook reads it
+        for i, h in enumerate(seq_hashes):
+            self.insert(h, data[i])
+
+    def read(self, seq_hashes: list[int]) -> np.ndarray:
+        """Read cached blocks (all must be present); refreshes LRU."""
+        ids = []
+        for h in seq_hashes:
+            ids.append(self._hash_to_block[h])
+            self._lru.move_to_end(h)
+        return self.storage.read_blocks(ids)
+
+    def evict(self, seq_hash: int) -> None:
+        bid = self._hash_to_block.pop(seq_hash, None)
+        if bid is None:
+            return
+        self._lru.pop(seq_hash, None)
+        self._free.append(bid)
+
+    def _evict_one(self) -> None:
+        victim, _ = self._lru.popitem(last=False)
+        bid = self._hash_to_block.pop(victim)
+        if self.on_evict is not None:
+            self.on_evict(victim, self.storage.read_blocks([bid])[0])
+        self._free.append(bid)

@@ -1,0 +1,95 @@
+"""Storage backends for KV block tiers.
+
+Tier ladder (≈ reference G1-G4, lib/llm/src/block_manager.rs:60-78):
+G1 = device HBM (owned by the engine as jax.Arrays — not stored here),
+G2 = host DRAM (``HostBlockStorage``), G3 = local disk
+(``DiskBlockStorage`` via np.memmap), G4 = remote (the disaggregation
+transfer agent, dynamo_tpu/disagg/).
+
+Each storage holds ``num_blocks`` packed blocks of ``layout.packed_shape``
+(reference Storage trait: lib/llm/src/block_manager/storage.rs:212-310;
+``NullBlockStorage`` ≈ the Null test allocators at storage.rs:431-520
+that let pool/layout logic run without real memory).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from dynamo_tpu.kvbm.layout import BlockLayout
+
+
+class BlockStorage:
+    """num_blocks packed blocks; read/write by block index."""
+
+    def __init__(self, layout: BlockLayout, num_blocks: int):
+        self.layout = layout
+        self.num_blocks = num_blocks
+
+    def write_blocks(self, ids: list[int], data: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def read_blocks(self, ids: list[int]) -> np.ndarray:
+        """Returns [len(ids), *layout.packed_shape]."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class HostBlockStorage(BlockStorage):
+    """G2: host-DRAM block pool (one contiguous ndarray)."""
+
+    def __init__(self, layout: BlockLayout, num_blocks: int):
+        super().__init__(layout, num_blocks)
+        self._buf = np.zeros((num_blocks, *layout.packed_shape), layout.np_dtype)
+
+    def write_blocks(self, ids: list[int], data: np.ndarray) -> None:
+        self._buf[np.asarray(ids, np.int64)] = data
+
+    def read_blocks(self, ids: list[int]) -> np.ndarray:
+        return self._buf[np.asarray(ids, np.int64)]
+
+
+class DiskBlockStorage(BlockStorage):
+    """G3: local-disk block pool (np.memmap file)."""
+
+    def __init__(self, layout: BlockLayout, num_blocks: int, path: str):
+        super().__init__(layout, num_blocks)
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._mm = np.memmap(
+            path,
+            dtype=layout.np_dtype,
+            mode="w+",
+            shape=(num_blocks, *layout.packed_shape),
+        )
+
+    def write_blocks(self, ids: list[int], data: np.ndarray) -> None:
+        self._mm[np.asarray(ids, np.int64)] = data
+
+    def read_blocks(self, ids: list[int]) -> np.ndarray:
+        return np.array(self._mm[np.asarray(ids, np.int64)])
+
+    def close(self) -> None:
+        mm = self._mm
+        self._mm = None
+        if mm is not None:
+            del mm
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class NullBlockStorage(BlockStorage):
+    """Metadata-only storage: pool/eviction logic without allocation."""
+
+    def write_blocks(self, ids: list[int], data: np.ndarray) -> None:
+        pass
+
+    def read_blocks(self, ids: list[int]) -> np.ndarray:
+        return np.zeros((len(ids), *self.layout.packed_shape), self.layout.np_dtype)

@@ -1,0 +1,1 @@
+"""Device kernels and transfer ops (XLA + Pallas)."""

@@ -1,0 +1,15 @@
+"""Shape bucketing: round sizes up to a small set so jitted functions
+compile a handful of variants and then never recompile."""
+
+from __future__ import annotations
+
+
+def next_bucket(n: int, buckets: list[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    # beyond the precomputed list: next power of two (never under-allocate)
+    b = buckets[-1]
+    while b < n:
+        b *= 2
+    return b
