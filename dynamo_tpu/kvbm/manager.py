@@ -65,9 +65,13 @@ class KvBlockManager:
         resolve_fn: ResolveFn,
     ):
         self.config = config
+        if config.host_num_blocks <= 0:
+            raise ValueError("host_num_blocks must be positive")
+        if config.offload_batch <= 0:
+            raise ValueError("offload_batch must be positive")
         # an offload batch larger than the host tier would just thrash it
-        if config.host_num_blocks > 0:
-            config.offload_batch = min(config.offload_batch, config.host_num_blocks)
+        # (clamped copy: never mutate the caller's config)
+        self._offload_batch = min(config.offload_batch, config.host_num_blocks)
         self.layout = layout
         self._gather = gather_fn
         self._scatter = scatter_fn
@@ -97,7 +101,7 @@ class KvBlockManager:
         if not self._pending:
             return 0
         batch: list[tuple[int, int]] = []
-        while self._pending and len(batch) < self.config.offload_batch:
+        while self._pending and len(batch) < self._offload_batch:
             h, bid = self._pending.popitem(last=False)
             # the device block may have been evicted/reassigned since commit
             if self._resolve(h) == bid and not self.host.contains(h):

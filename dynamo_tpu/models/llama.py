@@ -22,6 +22,7 @@ SURVEY.md §7 step 4). Design choices for TPU:
 from __future__ import annotations
 
 import math
+import os
 from typing import Any, Optional
 
 import jax
@@ -237,6 +238,23 @@ def paged_attention_reference(
     return out
 
 
+def attn_impl() -> str:
+    """Attention implementation: DYN_ATTN_IMPL = auto|reference|pallas.
+
+    auto = the Pallas decode kernel on TPU, XLA gather path elsewhere
+    (Pallas runs interpreted off-TPU: correct but slow — tests only).
+    Multi-device meshes stay on the gather path until the kernel is
+    shard_map-wrapped over the "tp" axis (attention is local per KV-head
+    shard, so that wrap is mechanical).
+    """
+    impl = os.environ.get("DYN_ATTN_IMPL", "auto")
+    if impl == "auto":
+        if jax.default_backend() == "tpu" and jax.device_count() == 1:
+            return "pallas"
+        return "reference"
+    return impl
+
+
 # ---------------------------------------------------------------------------
 # The unified forward step
 # ---------------------------------------------------------------------------
@@ -278,9 +296,18 @@ def forward(
         # write new kv into the paged cache
         k_cache_l = k_cache_l.at[slot_mapping].set(k.reshape(B * T, Hk, Dh))
         v_cache_l = v_cache_l.at[slot_mapping].set(v.reshape(B * T, Hk, Dh))
-        attn = paged_attention_reference(
-            q, k_cache_l, v_cache_l, block_tables, positions, context_lens, block_size
-        )
+        if T == 1 and attn_impl() == "pallas":
+            from dynamo_tpu.ops.paged_attention import paged_attention_decode
+
+            attn = paged_attention_decode(
+                q[:, 0], k_cache_l, v_cache_l, block_tables, context_lens,
+                block_size, interpret=jax.default_backend() != "tpu",
+            )[:, None]  # [B, 1, H, Dh]
+        else:
+            attn = paged_attention_reference(
+                q, k_cache_l, v_cache_l, block_tables, positions,
+                context_lens, block_size,
+            )
         x = x + (attn.reshape(B, T, H * Dh) @ lp["wo"]).astype(x.dtype)
         # mlp
         h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)

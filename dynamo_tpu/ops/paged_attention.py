@@ -1,0 +1,149 @@
+"""Pallas TPU paged-attention decode kernel.
+
+The hot op of the decode loop (TPU replacement for the CUDA/Triton paged
+attention the reference delegates to vLLM; ≈ the role of the patch's
+Triton kernels, container/deps/vllm/...-patch kv_rearrange + vLLM's
+paged_attention_v1). Semantics match
+``models.llama.paged_attention_reference`` for T=1 queries.
+
+Design (see /opt/skills/guides/pallas_guide.md):
+- grid = (batch, kv_head, page): pages iterate innermost, so the
+  flash-attention running (max, sum, acc) state lives in VMEM scratch
+  across page steps; Pallas double-buffers the per-page K/V fetches
+  from HBM automatically.
+- ``block_tables`` and ``context_lens`` ride as scalar-prefetch args:
+  the page index_map dereferences the block table *before* the body
+  runs, so only the pages a sequence actually references are pulled
+  into VMEM — no [B, S, H, Dh] gather materialization, no
+  ``jnp.repeat`` over GQA groups (the kv head's page is shared by all
+  ``H // Hkv`` query heads in the program).
+- pages past a sequence's context length are masked out AND their
+  compute is skipped via ``pl.when``.
+
+HBM traffic per decode step ≈ ctx_len × Hkv × Dh × 2 per sequence —
+the roofline minimum — vs the reference path's group-expanded
+materialization.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _decode_kernel(
+    tables_ref,  # scalar prefetch: [B, W] int32
+    ctx_ref,  # scalar prefetch: [B] int32
+    q_ref,  # [1, 1, G, Dh]
+    k_ref,  # [1, bs, 1, Dh] — page j of kv head h
+    v_ref,  # [1, bs, 1, Dh]
+    o_ref,  # [1, 1, G, Dh]
+    acc_ref,  # VMEM scratch [G, Dh] f32
+    m_ref,  # VMEM scratch [G, 1] f32
+    l_ref,  # VMEM scratch [G, 1] f32
+    *,
+    block_size: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    ctx = ctx_ref[b]
+
+    @pl.when(j * block_size < ctx)
+    def _page():
+        q = q_ref[0, 0].astype(jnp.float32)  # [G, Dh]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [bs, Dh]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [G, bs]
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1
+        )
+        valid = pos < ctx  # [1, bs]; decode query attends to all < ctx
+        s = jnp.where(valid, s, -1e30)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(valid, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_ref[:] = m_new
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finish():
+        # padded batch rows have ctx == 0 -> l == 0; clamp instead of NaN
+        o_ref[0, 0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-9)).astype(
+            o_ref.dtype
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "interpret"))
+def paged_attention_decode(
+    q: jax.Array,  # [B, H, Dh] (decode: one query token per sequence)
+    k_cache_l: jax.Array,  # [n_slots, Hkv, Dh] (one layer)
+    v_cache_l: jax.Array,
+    block_tables: jax.Array,  # [B, W] int32
+    context_lens: jax.Array,  # [B] int32
+    block_size: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns [B, H, Dh] attention outputs."""
+    B, H, Dh = q.shape
+    S, Hk, _ = k_cache_l.shape
+    N = S // block_size
+    W = block_tables.shape[1]
+    G = H // Hk
+    scale = 1.0 / math.sqrt(Dh)
+
+    qg = q.reshape(B, Hk, G, Dh)
+    kp = k_cache_l.reshape(N, block_size, Hk, Dh)
+    vp = v_cache_l.reshape(N, block_size, Hk, Dh)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block_tables, context_lens
+        grid=(B, Hk, W),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, G, Dh), lambda b, h, j, t, c: (b, h, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_size, 1, Dh),
+                lambda b, h, j, t, c: (t[b, j], 0, h, 0),
+            ),
+            pl.BlockSpec(
+                (1, block_size, 1, Dh),
+                lambda b, h, j, t, c: (t[b, j], 0, h, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, G, Dh), lambda b, h, j, t, c: (b, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((G, Dh), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, block_size=block_size, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hk, G, Dh), q.dtype),
+        interpret=interpret,
+    )(block_tables, context_lens, qg, kp, vp)
+    return out.reshape(B, H, Dh)

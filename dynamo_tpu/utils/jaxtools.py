@@ -25,6 +25,14 @@ def force_platform(platform: str, cpu_devices: int | None = None) -> None:
     import jax
     import jax._src.xla_bridge as xb
 
+    try:
+        # Pallas-TPU registers MLIR lowerings for the "tpu" platform at
+        # import; that registration fails once jax_platforms is
+        # restricted, so pre-import while "tpu" is still known. This
+        # does not initialize any backend (no hardware is dialed).
+        from jax.experimental.pallas import tpu as _pltpu  # noqa: F401
+    except Exception:
+        pass
     jax.config.update("jax_platforms", platform)
     if platform == "cpu":
         for name in list(getattr(xb, "_backend_factories", {})):
