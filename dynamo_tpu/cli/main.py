@@ -70,6 +70,22 @@ def build_parser() -> argparse.ArgumentParser:
                      help="JSON file with engine-specific settings")
     run.add_argument("--router-mode", default="round_robin",
                      choices=["random", "round_robin", "kv"])
+    # disaggregated prefill/decode (reference: docs/disagg_serving.md)
+    run.add_argument("--role", default="decode", choices=["decode", "prefill"],
+                     help="worker role when disaggregation is enabled")
+    run.add_argument("--disagg", action="store_true",
+                     help="decode workers ship long prefills to the queue")
+    run.add_argument("--namespace", default="dynamo",
+                     help="namespace for prefill-role workers (no --in)")
+    run.add_argument("--max-local-prefill-length", type=int, default=512)
+    run.add_argument("--max-prefill-queue-size", type=int, default=16)
+    run.add_argument("--advertise-host", default="127.0.0.1",
+                     help="address prefill workers use to reach this "
+                          "worker's KV transfer server")
+    # KV offload tiers
+    run.add_argument("--host-kv-blocks", type=int, default=0)
+    run.add_argument("--disk-kv-blocks", type=int, default=0)
+    run.add_argument("--disk-kv-path", default="")
 
     store = sub.add_parser("store", help="run the coordinator store")
     store.add_argument("--host", default="0.0.0.0")
@@ -142,6 +158,12 @@ async def cmd_run(args: Any) -> None:
     in_mode = args.in_mode
     worker_mode = in_mode.startswith(DYN_SCHEME)
 
+    if args.role == "prefill":
+        await _run_prefill_worker(args)
+        return
+    if args.disagg and not worker_mode:
+        raise SystemExit("--disagg applies to workers (--in dyn://...)")
+
     # ---- output side: build the engine -----------------------------------
     jax_engine = None
     if out in ("echo_core", "jax"):
@@ -206,6 +228,26 @@ async def cmd_run(args: Any) -> None:
         drt.runtime.install_signal_handlers()
         component = drt.namespace(ns).component(comp)
         endpoint = component.endpoint(ep)
+        if args.disagg:
+            if jax_engine is None:
+                raise SystemExit("--disagg requires --out jax (worker mode)")
+            from dynamo_tpu.disagg.protocols import DisaggConfig
+            from dynamo_tpu.disagg.worker import DisaggDecodeEngine
+
+            engine = await DisaggDecodeEngine.create(
+                jax_engine,
+                drt.store,
+                ns,
+                worker_id=drt.primary_lease_id,
+                lease_id=drt.primary_lease_id,
+                conf=DisaggConfig(
+                    enabled=True,
+                    max_local_prefill_length=args.max_local_prefill_length,
+                    max_prefill_queue_size=args.max_prefill_queue_size,
+                ),
+                advertise_host=args.advertise_host,
+            )
+            print("disaggregation enabled (decode role)", flush=True)
         # KV event + load-metrics publication must be wired BEFORE the
         # instance becomes discoverable, or blocks cached in the window
         # between serve() and wiring never reach the router's index
@@ -231,6 +273,37 @@ async def cmd_run(args: Any) -> None:
         await drt.shutdown()
     else:
         raise SystemExit(f"unknown --in {in_mode!r}")
+
+
+async def _run_prefill_worker(args: Any) -> None:
+    """Dedicated prefill worker: consumes the namespace's prefill queue
+    (reference: examples/llm/components/prefill_worker.py)."""
+    from dynamo_tpu.disagg.worker import run_prefill_worker
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    if args.out_mode != "jax":
+        raise SystemExit("--role prefill requires --out jax")
+    ns = (
+        parse_dyn_path(args.in_mode)[0]
+        if args.in_mode.startswith(DYN_SCHEME)
+        else args.namespace
+    )
+    _, _, jax_engine = await _build_core_engine(args)
+    assert jax_engine is not None
+    drt = await DistributedRuntime.create(config=_runtime_config(args))
+    drt.runtime.install_signal_handlers()
+    print(f"prefill worker consuming {ns}_prefill_queue", flush=True)
+    shutdown = asyncio.Event()
+
+    async def _watch_shutdown() -> None:
+        await drt.runtime.wait_shutdown()
+        shutdown.set()
+
+    watcher = asyncio.create_task(_watch_shutdown())
+    await run_prefill_worker(jax_engine, drt.store, ns, shutdown)
+    watcher.cancel()
+    await jax_engine.shutdown()
+    await drt.shutdown()
 
 
 async def _interactive_text(engine: Any, model_name: str) -> None:

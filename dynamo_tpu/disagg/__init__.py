@@ -1,0 +1,20 @@
+"""Disaggregated prefill/decode serving (xPyD).
+
+Reference: docs/disagg_serving.md + examples/llm/components/{worker.py,
+prefill_worker.py} + the NIXL transfer plane. TPU-native redesign: remote
+prefill delivers content-addressed KV blocks into the decode worker's G2
+host tier over the transfer plane, and the existing KVBM onboarding path
+pulls them into HBM at admission — so disaggregation composes with (and
+reuses) the offload machinery instead of needing RDMA block descriptors.
+"""
+
+from dynamo_tpu.disagg.protocols import DisaggConfig, RemotePrefillRequest
+from dynamo_tpu.disagg.prefill_queue import PrefillQueue
+from dynamo_tpu.disagg.router import DisaggRouter
+
+__all__ = [
+    "DisaggConfig",
+    "RemotePrefillRequest",
+    "PrefillQueue",
+    "DisaggRouter",
+]

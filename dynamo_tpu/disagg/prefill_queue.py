@@ -1,0 +1,39 @@
+"""Durable prefill work queue on the coordinator store.
+
+Reference: the NATS JetStream stream "{ns}_prefill_queue"
+(examples/llm/utils/prefill_queue.py:24-56, utils/nats_queue.py:82-103).
+The store's queue primitive gives the same at-least-once semantics:
+``pop`` leases a message, ``ack`` retires it; an un-acked message is
+redelivered after its visibility timeout (prefill worker death ⇒ another
+worker picks the request up).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dynamo_tpu.disagg.protocols import RemotePrefillRequest, queue_name
+from dynamo_tpu.store.base import Store
+
+
+class PrefillQueue:
+    def __init__(self, store: Store, namespace: str):
+        self._store = store
+        self._queue = queue_name(namespace)
+
+    async def enqueue(self, req: RemotePrefillRequest) -> int:
+        return await self._store.queue_push(self._queue, req.to_bytes())
+
+    async def dequeue(
+        self, timeout_s: float = 1.0
+    ) -> Optional[tuple[int, RemotePrefillRequest]]:
+        msg = await self._store.queue_pop(self._queue, timeout_s=timeout_s)
+        if msg is None:
+            return None
+        return msg.id, RemotePrefillRequest.from_bytes(msg.payload)
+
+    async def ack(self, msg_id: int) -> bool:
+        return await self._store.queue_ack(self._queue, msg_id)
+
+    async def depth(self) -> int:
+        return await self._store.queue_len(self._queue)

@@ -1,0 +1,61 @@
+"""Disaggregation protocol types.
+
+Reference: the RemotePrefillParams/RemotePrefillRequest types the vLLM
+patch adds (patch :4181) and DisaggRouterConf (lib/llm/src/
+disagg_router.rs:24-262). Here a remote-prefill request carries the
+prompt tokens plus the *store key* of the decode worker's transfer
+metadata — the prefill worker computes KV, looks up that key, and pushes
+content-addressed blocks directly; no GPU descriptor exchange is needed
+because blocks are addressed by chained content hash on both sides.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class RemotePrefillRequest:
+    request_id: str
+    token_ids: list[int]
+    block_size: int
+    transfer_key: str  # store key holding the decode worker's TransferMetadata
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "RemotePrefillRequest":
+        return cls(**json.loads(raw.decode()))
+
+
+@dataclass
+class DisaggConfig:
+    """Conditional-disaggregation knobs (reference: DisaggRouterConf —
+    max_local_prefill_length etc., disagg_router.rs:24; queue threshold
+    from examples/llm/components/disagg_router.py)."""
+
+    enabled: bool = False
+    max_local_prefill_length: int = 512  # tokens prefilled locally at most
+    max_prefill_queue_size: int = 16  # back off to local beyond this depth
+    transfer_timeout_s: float = 30.0  # then fall back to local prefill
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "DisaggConfig":
+        return cls(**json.loads(raw.decode()))
+
+
+def conf_key(namespace: str) -> str:
+    return f"{namespace}/disagg/conf"
+
+
+def queue_name(namespace: str) -> str:
+    return f"{namespace}_prefill_queue"
+
+
+def transfer_key(namespace: str, worker_id: int) -> str:
+    return f"{namespace}/transfer/{worker_id:x}"

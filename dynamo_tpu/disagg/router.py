@@ -1,0 +1,74 @@
+"""Conditional disaggregation router.
+
+Decides per-request whether the prefill runs locally on the decode
+worker or is shipped to a dedicated prefill worker (reference decision
+logic: examples/llm/components/disagg_router.py — remote iff the
+un-cached prefill length exceeds the threshold AND the prefill queue is
+not backed up; config hot-reloaded from etcd via
+lib/llm/src/disagg_router.rs:38-100 — here from a store watch).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+from typing import Optional
+
+from dynamo_tpu.disagg.protocols import DisaggConfig, conf_key
+from dynamo_tpu.store.base import Store
+
+log = logging.getLogger("dynamo_tpu.disagg.router")
+
+
+class DisaggRouter:
+    def __init__(self, conf: DisaggConfig):
+        self.conf = conf
+        self._watch_task: Optional[asyncio.Task] = None
+
+    @classmethod
+    async def create(
+        cls,
+        store: Store,
+        namespace: str,
+        default: Optional[DisaggConfig] = None,
+        watch: bool = True,
+    ) -> "DisaggRouter":
+        """Load config from the store (publishing the default if absent)
+        and keep it hot-reloaded via a prefix watch."""
+        key = conf_key(namespace)
+        conf = default or DisaggConfig(enabled=True)
+        entry = await store.kv_get(key)
+        if entry is None:
+            await store.kv_create(key, conf.to_bytes())
+        else:
+            conf = DisaggConfig.from_bytes(entry.value)
+        router = cls(conf)
+        if watch:
+            w = await store.watch_prefix(key)
+
+            async def _follow() -> None:
+                async for ev in w:
+                    if ev.entry is not None and ev.type == "put":
+                        try:
+                            router.conf = DisaggConfig.from_bytes(ev.entry.value)
+                            log.info("disagg conf updated: %s", router.conf)
+                        except Exception:
+                            log.exception("bad disagg conf update ignored")
+
+            router._watch_task = asyncio.create_task(_follow())
+        return router
+
+    def should_prefill_remote(self, prefill_len: int, queue_depth: int) -> bool:
+        c = self.conf
+        return (
+            c.enabled
+            and prefill_len > c.max_local_prefill_length
+            and queue_depth < c.max_prefill_queue_size
+        )
+
+    async def close(self) -> None:
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._watch_task

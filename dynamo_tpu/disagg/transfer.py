@@ -1,0 +1,193 @@
+"""KV transfer plane: descriptor-addressed block shipment between workers.
+
+The TPU-native replacement for the reference's NIXL data plane
+(lib/llm/src/block_manager/{layout/nixl.rs,block/transfer/nixl.rs},
+docs/backend.md:427-516): an agent per worker with published metadata,
+async block writes, and completion notifications. Differences by design:
+
+- blocks are addressed by **content hash** (the chained TokenBlock
+  sequence hash both sides compute from the prompt), not by remote
+  memory descriptors — no address exchange, free dedup;
+- the wire is a host-staged TCP stream (DCN path). Within a slice, KV
+  never needs this plane at all: a slice is one jax process group and
+  the mesh moves KV over ICI as array shards;
+- delivery lands in the receiver's G2 host tier; the engine's KVBM
+  onboarding lifts blocks into HBM at admission (manager.py onboard()).
+
+Wire format per message: 4-byte big-endian header length, JSON header
+{request_id, hashes, dtype, shape}, then raw packed-block bytes. One
+reply line {"ok": bool}.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from dataclasses import asdict, dataclass
+from typing import Awaitable, Callable, Optional
+
+import numpy as np
+
+from dynamo_tpu.disagg.protocols import transfer_key
+from dynamo_tpu.kvbm.layout import BlockLayout, resolve_dtype
+from dynamo_tpu.store.base import Store
+
+log = logging.getLogger("dynamo_tpu.disagg.transfer")
+
+# deliver(hashes, packed) -> awaitable; runs the engine-thread insert
+DeliverFn = Callable[[list[int], np.ndarray], Awaitable[None]]
+
+
+@dataclass
+class TransferMetadata:
+    """Published under {ns}/transfer/{worker_id:x} with the worker's
+    lease (≈ NIXL metadata in etcd, docs/disagg_serving.md:87)."""
+
+    host: str
+    port: int
+    worker_id: int
+    layout: str  # BlockLayout JSON
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "TransferMetadata":
+        return cls(**json.loads(raw.decode()))
+
+
+MAX_BLOCKS_PER_TRANSFER = 4096
+
+
+class TransferServer:
+    """Receives packed KV blocks and hands them to the engine."""
+
+    def __init__(
+        self,
+        deliver: DeliverFn,
+        layout: BlockLayout,
+        host: str = "127.0.0.1",
+    ):
+        self._deliver = deliver
+        self._layout = layout
+        self._host = host
+        self._server: Optional[asyncio.base_events.Server] = None
+        self.port: int = 0
+        self._done: dict[str, asyncio.Event] = {}
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self._host, 0
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    def completion_event(self, request_id: str) -> asyncio.Event:
+        return self._done.setdefault(request_id, asyncio.Event())
+
+    def discard_completion(self, request_id: str) -> None:
+        self._done.pop(request_id, None)
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            hdr_len = int.from_bytes(await reader.readexactly(4), "big")
+            if hdr_len > 1 << 20:
+                raise ValueError("oversized transfer header")
+            header = json.loads((await reader.readexactly(hdr_len)).decode())
+            shape = tuple(int(d) for d in header["shape"])
+            hashes = [int(h) for h in header["hashes"]]
+            # validate against OUR layout before buffering anything: the
+            # socket is unauthenticated, the peer's shape claim is not
+            # trusted (bounds the allocation too)
+            expected = (len(hashes), *self._layout.packed_shape)
+            if shape != expected or len(hashes) > MAX_BLOCKS_PER_TRANSFER:
+                raise ValueError(
+                    f"transfer shape {shape} != expected {expected}"
+                )
+            dtype = resolve_dtype(header["dtype"])
+            if dtype != self._layout.np_dtype:
+                raise ValueError(
+                    f"transfer dtype {dtype} != layout {self._layout.np_dtype}"
+                )
+            payload = await reader.readexactly(int(np.prod(shape)) * dtype.itemsize)
+            packed = np.frombuffer(payload, dtype=dtype).reshape(shape)
+            await self._deliver(hashes, packed)
+            rid = header.get("request_id", "")
+            # only signal an event a local waiter created; a late delivery
+            # after discard_completion must not re-create (and leak) one
+            ev = self._done.get(rid)
+            if ev is not None:
+                ev.set()
+            writer.write(json.dumps({"ok": True}).encode() + b"\n")
+            await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        except Exception:
+            log.exception("transfer receive failed")
+            try:
+                writer.write(json.dumps({"ok": False}).encode() + b"\n")
+                await writer.drain()
+            except Exception:
+                pass
+        finally:
+            writer.close()
+
+    async def register(self, store: Store, namespace: str, worker_id: int,
+                       layout: BlockLayout, lease_id: int,
+                       advertise_host: Optional[str] = None) -> str:
+        meta = TransferMetadata(
+            host=advertise_host or self._host,
+            port=self.port,
+            worker_id=worker_id,
+            layout=layout.to_json(),
+        )
+        key = transfer_key(namespace, worker_id)
+        await store.kv_put(key, meta.to_bytes(), lease_id=lease_id)
+        return key
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+class TransferClient:
+    @staticmethod
+    async def fetch_metadata(store: Store, key: str) -> Optional[TransferMetadata]:
+        entry = await store.kv_get(key)
+        return TransferMetadata.from_bytes(entry.value) if entry else None
+
+    @staticmethod
+    async def put(
+        meta: TransferMetadata,
+        request_id: str,
+        hashes: list[int],
+        packed: np.ndarray,
+        timeout_s: float = 30.0,
+        connect_timeout_s: float = 5.0,
+    ) -> bool:
+        """Ship packed blocks to a peer; True on acknowledged delivery.
+        Every stage is bounded: a stale/unroutable peer address must not
+        stall the (sequential) prefill worker."""
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(meta.host, meta.port),
+            timeout=connect_timeout_s,
+        )
+        try:
+            header = json.dumps(
+                {
+                    "request_id": request_id,
+                    "hashes": [int(h) for h in hashes],
+                    "dtype": packed.dtype.name,
+                    "shape": list(packed.shape),
+                }
+            ).encode()
+            writer.write(len(header).to_bytes(4, "big") + header)
+            writer.write(packed.tobytes())
+            await asyncio.wait_for(writer.drain(), timeout=timeout_s)
+            line = await asyncio.wait_for(reader.readline(), timeout=timeout_s)
+            return bool(json.loads(line.decode()).get("ok"))
+        finally:
+            writer.close()

@@ -1,0 +1,240 @@
+"""Disaggregated worker roles.
+
+Decode side (``DisaggDecodeEngine``): wraps the JaxEngine's adapter; per
+request it measures the un-cached prefill length, consults the
+``DisaggRouter``, and either runs locally or enqueues a
+``RemotePrefillRequest`` and waits for the KV blocks to land in its host
+tier before submitting — at which point admission onboards them and only
+the prompt tail is prefilled locally (reference flow:
+examples/llm/components/worker.py:186-235; transfer timeout falls back
+to a plain local prefill, so disagg can only *add* latency headroom, not
+availability risk).
+
+Prefill side (``run_prefill_worker``): pops the queue, prefills with
+max_tokens=1, exports the prompt's content-addressed blocks, ships them
+to the decode worker's transfer server, acks (reference:
+examples/llm/components/prefill_worker.py:139-207). On shutdown it
+drains in-flight work before exiting, like the reference's SIGTERM
+drain (prefill_worker.py:164-176).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.disagg.prefill_queue import PrefillQueue
+from dynamo_tpu.disagg.protocols import (
+    DisaggConfig,
+    RemotePrefillRequest,
+    transfer_key,
+)
+from dynamo_tpu.disagg.router import DisaggRouter
+from dynamo_tpu.disagg.transfer import TransferClient, TransferServer
+from dynamo_tpu.engine.engine import JaxEngine
+from dynamo_tpu.kvbm import BlockLayout
+from dynamo_tpu.protocols.common import PreprocessedRequest
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
+from dynamo_tpu.store.base import Store
+from dynamo_tpu.tokens import TokenBlockSequence
+
+log = logging.getLogger("dynamo_tpu.disagg.worker")
+
+
+class DisaggDecodeEngine(AsyncEngine):
+    """Decode-worker engine with conditional remote prefill."""
+
+    def __init__(
+        self,
+        engine: JaxEngine,
+        store: Store,
+        namespace: str,
+        router: DisaggRouter,
+        server: TransferServer,
+        my_transfer_key: str,
+    ):
+        self.engine = engine
+        self.store = store
+        self.namespace = namespace
+        self.router = router
+        self.server = server
+        self.my_transfer_key = my_transfer_key
+        self.queue = PrefillQueue(store, namespace)
+        self.remote_prefills = 0
+        self.local_fallbacks = 0
+
+    @classmethod
+    async def create(
+        cls,
+        engine: JaxEngine,
+        store: Store,
+        namespace: str,
+        worker_id: int,
+        lease_id: int,
+        conf: DisaggConfig,
+        advertise_host: str = "127.0.0.1",
+    ) -> "DisaggDecodeEngine":
+        if engine.kvbm is None:
+            raise ValueError(
+                "disagg decode requires host_kv_blocks > 0 (remote KV "
+                "lands in the G2 host tier)"
+            )
+        router = await DisaggRouter.create(store, namespace, default=conf)
+        assert engine.model_config is not None
+        layout = BlockLayout.for_model(
+            engine.model_config, engine.config.block_size,
+            engine.config.kv_cache_dtype,
+        )
+        server = TransferServer(
+            deliver=lambda hashes, packed: engine.import_kv_blocks(hashes, packed),
+            layout=layout,
+            host="0.0.0.0",
+        )
+        await server.start()
+        key = await server.register(
+            store, namespace, worker_id, layout, lease_id,
+            advertise_host=advertise_host,
+        )
+        return cls(engine, store, namespace, router, server, key)
+
+    async def _maybe_remote_prefill(self, request: PreprocessedRequest) -> None:
+        conf = self.router.conf
+        if not conf.enabled:
+            return
+        bs = self.engine.config.block_size
+        tokens = TokenBlockSequence(request.token_ids, block_size=bs)
+        hashes = tokens.sequence_hashes()
+        n_full = len(request.token_ids) // bs
+        cached = self.engine.match_cached_prefix(hashes[:n_full])
+        prefill_len = len(request.token_ids) - cached * bs
+        # cheap local checks first; only then pay the store round-trip
+        if prefill_len <= conf.max_local_prefill_length:
+            return
+        assert self.engine.kvbm is not None
+        if n_full > self.engine.kvbm.host.num_blocks:
+            # the delivery could not fit the host tier without evicting
+            # its own leading blocks — remote prefill would be wasted
+            log.warning(
+                "prompt (%d blocks) exceeds host tier (%d); prefilling locally",
+                n_full, self.engine.kvbm.host.num_blocks,
+            )
+            return
+        depth = await self.queue.depth()
+        if not self.router.should_prefill_remote(prefill_len, depth):
+            return
+        self.remote_prefills += 1
+        rid = request.request_id
+        done = self.server.completion_event(rid)
+        await self.queue.enqueue(
+            RemotePrefillRequest(
+                request_id=rid,
+                token_ids=list(request.token_ids),
+                block_size=bs,
+                transfer_key=self.my_transfer_key,
+            )
+        )
+        try:
+            await asyncio.wait_for(
+                done.wait(), timeout=self.router.conf.transfer_timeout_s
+            )
+        except asyncio.TimeoutError:
+            self.local_fallbacks += 1
+            log.warning("remote prefill %s timed out; prefilling locally", rid)
+        finally:
+            self.server.discard_completion(rid)
+
+    async def _gen(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        if not isinstance(request, PreprocessedRequest):
+            request = PreprocessedRequest.model_validate(request)
+        await self._maybe_remote_prefill(request)
+        inner = self.engine.as_async_engine()
+        async for item in inner.generate(request, context):
+            yield item
+
+    def generate(self, request: Any, context: Context) -> EngineStream:
+        return self._gen(request, context)
+
+    async def close(self) -> None:
+        await self.router.close()
+        await self.server.close()
+
+
+MAX_PREFILL_ATTEMPTS = 3
+
+
+async def run_prefill_worker(
+    engine: JaxEngine,
+    store: Store,
+    namespace: str,
+    shutdown: asyncio.Event,
+    poll_s: float = 0.2,
+) -> None:
+    """Dequeue → prefill → export blocks → ship → ack, until shutdown
+    (then drain: in-flight request finishes before exit). A request that
+    keeps failing (e.g. its decode worker died and took its transfer
+    metadata with it) is dropped after MAX_PREFILL_ATTEMPTS so one
+    poison message can't spin the worker forever."""
+    queue = PrefillQueue(store, namespace)
+    bs = engine.config.block_size
+    attempts: dict[str, int] = {}
+    while not shutdown.is_set():
+        got = await queue.dequeue(timeout_s=poll_s)
+        if got is None:
+            continue
+        msg_id, req = got
+        try:
+            await _prefill_one(engine, store, req, bs)
+            await queue.ack(msg_id)
+            attempts.pop(req.request_id, None)
+        except Exception:
+            n = attempts.get(req.request_id, 0) + 1
+            attempts[req.request_id] = n
+            if n >= MAX_PREFILL_ATTEMPTS:
+                log.exception(
+                    "prefill %s failed %d times; dropping", req.request_id, n
+                )
+                await queue.ack(msg_id)  # dead-letter: retire the message
+                attempts.pop(req.request_id, None)
+            else:
+                log.exception(
+                    "prefill %s failed (attempt %d; left for redelivery)",
+                    req.request_id, n,
+                )
+    log.info("prefill worker drained; exiting")
+
+
+async def _prefill_one(
+    engine: JaxEngine, store: Store, req: RemotePrefillRequest, bs: int
+) -> None:
+    from dynamo_tpu.protocols.common import SamplingOptions, StopConditions
+
+    if req.block_size != bs:
+        raise ValueError(
+            f"block_size mismatch: decode {req.block_size} != prefill {bs}"
+        )
+    # run the prompt with max_tokens=1: computes + content-addresses the
+    # prompt's full blocks in this engine's cache
+    preq = PreprocessedRequest(
+        request_id=f"prefill-{req.request_id}",
+        token_ids=list(req.token_ids),
+        sampling=SamplingOptions(use_greedy=True),
+        stop=StopConditions(max_tokens=1, ignore_eos=True),
+    )
+    adapter = engine.as_async_engine()
+    async for _ in adapter.generate(preq, Context()):
+        pass
+    tokens = TokenBlockSequence(list(req.token_ids), block_size=bs)
+    hashes = tokens.sequence_hashes()[: len(req.token_ids) // bs]
+    found, packed = await engine.export_kv_blocks(hashes)
+    if not found:
+        raise RuntimeError("prefill produced no exportable blocks")
+    meta = await TransferClient.fetch_metadata(store, req.transfer_key)
+    if meta is None:
+        raise RuntimeError(f"no transfer metadata at {req.transfer_key}")
+    ok = await TransferClient.put(meta, req.request_id, found, packed)
+    if not ok:
+        raise RuntimeError("transfer rejected by decode worker")
+    log.info(
+        "prefilled %s: shipped %d/%d blocks", req.request_id, len(found), len(hashes)
+    )

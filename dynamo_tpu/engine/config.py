@@ -61,6 +61,9 @@ def load_engine_config(args: Any) -> EngineConfig:
         num_nodes=getattr(args, "num_nodes", 1),
         node_rank=getattr(args, "node_rank", 0),
         leader_addr=getattr(args, "leader_addr", ""),
+        host_kv_blocks=getattr(args, "host_kv_blocks", 0),
+        disk_kv_blocks=getattr(args, "disk_kv_blocks", 0),
+        disk_kv_path=getattr(args, "disk_kv_path", ""),
     )
     for k, v in extra.items():
         if hasattr(cfg, k):

@@ -15,6 +15,7 @@ Orchestration (≈ what vLLM's AsyncLLMEngine does for the reference):
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import functools
 import logging
 import os
@@ -88,6 +89,7 @@ class JaxEngine:
         self._step_fn: Optional[Callable] = None
         self._thread: Optional[threading.Thread] = None
         self._incoming: thread_queue.Queue = thread_queue.Queue()
+        self._control: thread_queue.Queue = thread_queue.Queue()
         self._wake = threading.Event()
         self._running = False
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -367,12 +369,116 @@ class JaxEngine:
 
     def _drain_incoming(self) -> None:
         assert self.scheduler is not None
+        # control calls first: a KV import enqueued before a submit must be
+        # visible to that request's admission (disagg relies on this order)
+        while True:
+            try:
+                fn, fut = self._control.get_nowait()
+            except thread_queue.Empty:
+                break
+            if fut.set_running_or_notify_cancel():
+                try:
+                    fut.set_result(fn())
+                except Exception as exc:
+                    fut.set_exception(exc)
         while True:
             try:
                 item = self._incoming.get_nowait()
             except thread_queue.Empty:
                 return
             self.scheduler.add_request(item)
+
+    # ------------------------------------------------------------------
+    # Engine-thread call plane (KV export/import for the transfer agent)
+    # ------------------------------------------------------------------
+    def call_on_thread(self, fn: Callable[[], Any]) -> "concurrent.futures.Future":
+        """Run fn on the engine thread (the only thread allowed to touch
+        the donated cache buffers and KVBM pools); returns a Future."""
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._control.put((fn, fut))
+        self._wake.set()
+        return fut
+
+    async def acall_on_thread(self, fn: Callable[[], Any]) -> Any:
+        return await asyncio.wrap_future(self.call_on_thread(fn))
+
+    def _export_blocks(self, seq_hashes: list[int]) -> tuple[list[int], np.ndarray]:
+        """ENGINE THREAD. Gather the longest cached prefix of seq_hashes
+        as packed blocks (device tier first, then host tier)."""
+        from dynamo_tpu.kvbm import BlockLayout
+
+        assert self.allocator is not None and self.model_config is not None
+        layout = BlockLayout.for_model(
+            self.model_config, self.config.block_size, self.config.kv_cache_dtype
+        )
+        plan: list[tuple[str, int]] = []  # (tier, device block | hash)
+        for h in seq_hashes:
+            bid = self.allocator.lookup_block(h)
+            if bid is not None:
+                plan.append(("dev", bid))
+            elif self.kvbm is not None and self.kvbm.host.contains(h):
+                plan.append(("host", h))
+            else:
+                break
+        n = len(plan)
+        if n == 0:
+            return [], np.zeros((0, *layout.packed_shape), layout.np_dtype)
+        packed = np.zeros((n, *layout.packed_shape), layout.np_dtype)
+        dev_rows = [i for i, (t, _) in enumerate(plan) if t == "dev"]
+        if dev_rows:
+            dev_data = self._kv_gather([plan[i][1] for i in dev_rows])
+            for j, i in enumerate(dev_rows):
+                packed[i] = dev_data[j]
+        host_rows = [i for i, (t, _) in enumerate(plan) if t == "host"]
+        if host_rows:
+            assert self.kvbm is not None
+            host_data = self.kvbm.host.read([plan[i][1] for i in host_rows])
+            for j, i in enumerate(host_rows):
+                packed[i] = host_data[j]
+        return seq_hashes[:n], packed
+
+    def _import_blocks(self, seq_hashes: list[int], packed: np.ndarray) -> int:
+        """ENGINE THREAD. Land remote KV blocks in the host tier; the
+        next admission onboards them into HBM (kvbm onboard())."""
+        if self.kvbm is None:
+            raise RuntimeError("KV import requires host_kv_blocks > 0")
+        if len(seq_hashes) > self.kvbm.host.num_blocks:
+            # inserting would LRU-evict the delivery's own leading blocks,
+            # silently voiding the remote prefill — reject instead
+            raise RuntimeError(
+                f"KV import of {len(seq_hashes)} blocks exceeds host tier "
+                f"capacity {self.kvbm.host.num_blocks}"
+            )
+        self.kvbm.host.insert_many(seq_hashes, packed)
+        return len(seq_hashes)
+
+    async def export_kv_blocks(
+        self, seq_hashes: list[int]
+    ) -> tuple[list[int], np.ndarray]:
+        return await self.acall_on_thread(
+            functools.partial(self._export_blocks, seq_hashes)
+        )
+
+    async def import_kv_blocks(self, seq_hashes: list[int], packed: np.ndarray) -> int:
+        return await self.acall_on_thread(
+            functools.partial(self._import_blocks, seq_hashes, packed)
+        )
+
+    def match_cached_prefix(self, seq_hashes: list[int]) -> int:
+        """Blocks resolvable without prefill (G1 + offload tiers). Safe to
+        call from any thread (read-only dict lookups; advisory only)."""
+        n = 0
+        for h in seq_hashes:
+            if self.allocator is not None and self.allocator.lookup_block(h) is not None:
+                n += 1
+            elif self.kvbm is not None and (
+                self.kvbm.host.contains(h)
+                or (self.kvbm.disk is not None and self.kvbm.disk.contains(h))
+            ):
+                n += 1
+            else:
+                break
+        return n
 
     def _one_step(self) -> None:
         sched = self.scheduler

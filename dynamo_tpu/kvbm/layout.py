@@ -22,6 +22,16 @@ from dataclasses import asdict, dataclass
 import numpy as np
 
 
+def resolve_dtype(name: str) -> np.dtype:
+    """numpy dtype from name, including ml_dtypes names (bfloat16 etc.)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 @dataclass(frozen=True)
 class BlockLayout:
     num_layers: int
@@ -32,11 +42,7 @@ class BlockLayout:
 
     @property
     def np_dtype(self) -> np.dtype:
-        if self.dtype == "bfloat16":
-            import ml_dtypes
-
-            return np.dtype(ml_dtypes.bfloat16)
-        return np.dtype(self.dtype)
+        return resolve_dtype(self.dtype)
 
     @property
     def packed_shape(self) -> tuple[int, int, int, int, int]:

@@ -1,0 +1,246 @@
+"""Disaggregated prefill/decode tests.
+
+Ladder (reference test strategy, SURVEY.md §4): protocol round-trips →
+queue/router logic over the in-memory store → transfer plane round-trip
+→ the flagship single-process two-worker simulation: a decode engine and
+a prefill engine exchange KV blocks through the real queue + transfer
+server, and the decode output matches a purely-local run (≈ the
+reference's two-KvBlockManager blockset exchange, block_manager.rs:232).
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.disagg.prefill_queue import PrefillQueue
+from dynamo_tpu.disagg.protocols import (
+    DisaggConfig,
+    RemotePrefillRequest,
+    conf_key,
+)
+from dynamo_tpu.disagg.router import DisaggRouter
+from dynamo_tpu.disagg.transfer import TransferClient, TransferMetadata, TransferServer
+from dynamo_tpu.kvbm import BlockLayout
+from dynamo_tpu.store.memory import MemoryStore
+
+MODEL_DIR = os.path.join(os.path.dirname(__file__), "data", "tiny_llama_model")
+
+
+def test_protocol_roundtrips():
+    req = RemotePrefillRequest("r1", [1, 2, 3], 8, "ns/transfer/ab")
+    assert RemotePrefillRequest.from_bytes(req.to_bytes()) == req
+    conf = DisaggConfig(enabled=True, max_local_prefill_length=128)
+    assert DisaggConfig.from_bytes(conf.to_bytes()) == conf
+
+
+async def test_prefill_queue_roundtrip():
+    store = MemoryStore()
+    q = PrefillQueue(store, "ns")
+    req = RemotePrefillRequest("r1", list(range(20)), 8, "k")
+    await q.enqueue(req)
+    assert await q.depth() == 1
+    got = await q.dequeue(timeout_s=0.2)
+    assert got is not None
+    msg_id, back = got
+    assert back == req
+    assert await q.ack(msg_id)
+    assert await q.dequeue(timeout_s=0.05) is None
+    await store.close()
+
+
+async def test_disagg_router_decision_and_hot_reload():
+    store = MemoryStore()
+    router = await DisaggRouter.create(
+        store, "ns",
+        default=DisaggConfig(enabled=True, max_local_prefill_length=100,
+                             max_prefill_queue_size=4),
+    )
+    assert router.should_prefill_remote(prefill_len=101, queue_depth=0)
+    assert not router.should_prefill_remote(prefill_len=100, queue_depth=0)
+    assert not router.should_prefill_remote(prefill_len=500, queue_depth=4)
+    # hot reload via the store watch
+    await store.kv_put(
+        conf_key("ns"),
+        DisaggConfig(enabled=True, max_local_prefill_length=10).to_bytes(),
+    )
+    for _ in range(50):
+        if router.conf.max_local_prefill_length == 10:
+            break
+        await asyncio.sleep(0.02)
+    assert router.conf.max_local_prefill_length == 10
+    assert router.should_prefill_remote(prefill_len=11, queue_depth=0)
+    await router.close()
+    await store.close()
+
+
+async def test_transfer_roundtrip():
+    layout = BlockLayout(num_layers=2, block_size=4, num_kv_heads=2, head_dim=8)
+    delivered = {}
+
+    async def deliver(hashes, packed):
+        delivered["hashes"] = hashes
+        delivered["packed"] = packed.copy()
+
+    server = TransferServer(deliver, layout)
+    await server.start()
+    store = MemoryStore()
+    key = await server.register(store, "ns", 0xAB, layout, lease_id=0)
+    meta = await TransferClient.fetch_metadata(store, key)
+    assert meta is not None and meta.port == server.port
+    rng = np.random.default_rng(0)
+    packed = rng.standard_normal((3, *layout.packed_shape)).astype(layout.np_dtype)
+    done = server.completion_event("req-1")
+    ok = await TransferClient.put(meta, "req-1", [11, 22, 33], packed)
+    assert ok and done.is_set()
+    assert delivered["hashes"] == [11, 22, 33]
+    np.testing.assert_array_equal(delivered["packed"], packed)
+    await server.close()
+    await store.close()
+
+
+async def test_transfer_rejects_bad_shape_and_late_delivery_no_leak():
+    layout = BlockLayout(num_layers=2, block_size=4, num_kv_heads=2, head_dim=8)
+
+    async def deliver(hashes, packed):
+        pass
+
+    server = TransferServer(deliver, layout)
+    await server.start()
+    meta = TransferMetadata("127.0.0.1", server.port, 1, layout.to_json())
+    # wrong shape (claims 2 blocks of the wrong geometry) -> rejected
+    bad = np.zeros((2, 1, 1, 1, 1, 1), layout.np_dtype)
+    ok = await TransferClient.put(meta, "bad", [1, 2], bad, timeout_s=2)
+    assert not ok
+    # late delivery after the waiter discarded: must not re-create events
+    good = np.zeros((1, *layout.packed_shape), layout.np_dtype)
+    server.completion_event("late")
+    server.discard_completion("late")
+    ok = await TransferClient.put(meta, "late", [5], good, timeout_s=2)
+    assert ok
+    assert "late" not in server._done
+    await server.close()
+
+
+# ---------------------------------------------------------------------------
+# Two-worker disaggregation simulation (single process, CPU-JAX)
+# ---------------------------------------------------------------------------
+
+
+async def _launch_engine(**kw):
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    cfg = dict(
+        model_path=MODEL_DIR, model_name="tiny", random_weights=True,
+        num_blocks=64, block_size=8, max_batch_size=4,
+        prefill_chunk_size=64, max_model_len=256,
+    )
+    cfg.update(kw)
+    return await JaxEngine.launch(EngineConfig(**cfg))
+
+
+async def test_disagg_two_worker_end_to_end():
+    from dynamo_tpu.disagg.worker import DisaggDecodeEngine, run_prefill_worker
+    from tests.test_engine import _generate
+
+    store = MemoryStore()
+    prompt = list(range(1, 60))  # 7 full blocks + tail
+
+    # oracle: plain local engine
+    local = await _launch_engine()
+    toks_local, _ = await _generate(local, prompt, request_id="oracle")
+    await local.shutdown()
+
+    decode = await _launch_engine(host_kv_blocks=64)
+    prefill = await _launch_engine()
+    shutdown = asyncio.Event()
+    worker_task = asyncio.create_task(
+        run_prefill_worker(prefill, store, "ns", shutdown, poll_s=0.05)
+    )
+    try:
+        disagg = await DisaggDecodeEngine.create(
+            decode, store, "ns", worker_id=0xD, lease_id=0,
+            conf=DisaggConfig(
+                enabled=True,
+                max_local_prefill_length=16,  # force the remote path
+                max_prefill_queue_size=8,
+                transfer_timeout_s=30.0,
+            ),
+        )
+        from dynamo_tpu.protocols.common import (
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+        from dynamo_tpu.runtime.engine import Context
+
+        req = PreprocessedRequest(
+            request_id="disagg-1", token_ids=prompt,
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=8),
+        )
+        toks = []
+        async for item in disagg.generate(req, Context()):
+            toks.extend(item.token_ids)
+        assert disagg.remote_prefills == 1
+        assert disagg.local_fallbacks == 0
+        assert toks == toks_local  # same greedy continuation
+        # KV actually traveled: decode onboarded blocks it never prefilled
+        assert decode.kvbm is not None
+        assert decode.kvbm.stats.onboarded_blocks >= 7
+        # short prompt goes local (below threshold)
+        req2 = PreprocessedRequest(
+            request_id="short", token_ids=list(range(1, 10)),
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=2),
+        )
+        async for _ in disagg.generate(req2, Context()):
+            pass
+        assert disagg.remote_prefills == 1  # unchanged
+        await disagg.close()
+    finally:
+        shutdown.set()
+        await worker_task
+        await decode.shutdown()
+        await prefill.shutdown()
+        await store.close()
+
+
+async def test_disagg_transfer_timeout_falls_back_local():
+    """No prefill worker: the decode worker must fall back to local
+    prefill after the timeout and still serve the request."""
+    from dynamo_tpu.disagg.worker import DisaggDecodeEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    store = MemoryStore()
+    decode = await _launch_engine(host_kv_blocks=32)
+    try:
+        disagg = await DisaggDecodeEngine.create(
+            decode, store, "ns2", worker_id=1, lease_id=0,
+            conf=DisaggConfig(
+                enabled=True, max_local_prefill_length=8,
+                max_prefill_queue_size=8, transfer_timeout_s=0.3,
+            ),
+        )
+        req = PreprocessedRequest(
+            request_id="fallback", token_ids=list(range(1, 40)),
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=3),
+        )
+        toks = []
+        async for item in disagg.generate(req, Context()):
+            toks.extend(item.token_ids)
+        assert len(toks) == 3
+        assert disagg.remote_prefills == 1
+        assert disagg.local_fallbacks == 1
+        await disagg.close()
+    finally:
+        await decode.shutdown()
+        await store.close()
