@@ -79,7 +79,18 @@ async def _run(model_cfg, wl) -> dict:
         max_batch_size=wl["batch"], prefill_chunk_size=1024,
         max_model_len=wl["isl"] + wl["osl"] + 8,
     )
+    # one decode bucket = one decode compile: every step pads to full
+    # batch. Compiles are minutes over the chip tunnel; the padded-lane
+    # compute overhead is noise next to that.
+    from dynamo_tpu.engine.scheduler import Scheduler
+
+    Scheduler.BATCH_BUCKETS = [wl["batch"]]
+    # hold block-table width constant across the whole run too
+    total_blocks = -(-(wl["isl"] + wl["osl"] + wl["block_size"]) // wl["block_size"])
+    Scheduler.TABLE_BUCKET = max(Scheduler.TABLE_BUCKET, total_blocks)
+    print(f"# engine launching (compile ~minutes on first run)", file=sys.stderr, flush=True)
     engine = await JaxEngine.launch(cfg, model_config=model_cfg)
+    print("# engine up", file=sys.stderr, flush=True)
 
     rng = np.random.default_rng(0)
     adapter = engine.as_async_engine()
@@ -102,8 +113,9 @@ async def _run(model_cfg, wl) -> dict:
             n += len(item.token_ids)
         return t_start, t_first or time.monotonic(), n
 
-    # warmup: trigger all compiles (prefill buckets + decode buckets)
+    # warmup: trigger the two hot compiles (prefill chunk + decode batch)
     await one_request(9999)
+    print("# warmup done; measuring", file=sys.stderr, flush=True)
 
     t0 = time.monotonic()
     results = await asyncio.gather(*[one_request(i) for i in range(wl["batch"])])

@@ -91,6 +91,34 @@ def build_parser() -> argparse.ArgumentParser:
     store.add_argument("--host", default="0.0.0.0")
     store.add_argument("--port", type=int, default=4222)
 
+    serve = sub.add_parser("serve", help="serve a @service graph "
+                           "(≈ reference `dynamo serve`)")
+    serve.add_argument("service", help="module:Attr of the entry DynamoService")
+    serve.add_argument("-f", "--config-file", default=None,
+                       help="YAML/JSON per-component overrides")
+    serve.add_argument("--store-host", default="127.0.0.1")
+    serve.add_argument("--store-port", type=int, default=4222)
+
+    metrics = sub.add_parser("metrics", help="metrics aggregation service")
+    metrics.add_argument("--namespace", default="dynamo")
+    metrics.add_argument("--component", default="backend")
+    metrics.add_argument("--port", type=int, default=9091)
+    metrics.add_argument("--store-host", default="127.0.0.1")
+    metrics.add_argument("--store-port", type=int, default=4222)
+
+    planner = sub.add_parser("planner", help="autoscaling planner")
+    planner.add_argument("--namespace", default="dynamo")
+    planner.add_argument("--component", default="backend")
+    planner.add_argument("--prefill-component", default="prefill")
+    planner.add_argument("--metric-interval", type=float, default=5.0)
+    planner.add_argument("--adjustment-interval", type=float, default=30.0)
+    planner.add_argument("--min-decode", type=int, default=1)
+    planner.add_argument("--max-decode", type=int, default=8)
+    planner.add_argument("--min-prefill", type=int, default=0)
+    planner.add_argument("--max-prefill", type=int, default=8)
+    planner.add_argument("--store-host", default="127.0.0.1")
+    planner.add_argument("--store-port", type=int, default=4222)
+
     models = sub.add_parser("models", help="model registry ctl (≈ llmctl)")
     models.add_argument("action", choices=["list", "remove"])
     models.add_argument("name", nargs="?")
@@ -349,6 +377,106 @@ def _runtime_config(args: Any) -> RuntimeConfig:
     return RuntimeConfig.from_settings(**overrides)
 
 
+async def cmd_serve(args: Any) -> None:
+    """Supervise a @service graph (reference: cli/serving.py:163-300)."""
+    import importlib
+
+    from dynamo_tpu.sdk.service import DynamoService
+    from dynamo_tpu.sdk.serving import Supervisor
+    from dynamo_tpu.store.client import StoreClient
+
+    from dynamo_tpu.sdk.runner import load_service
+
+    entry = load_service(args.service)
+    mod = importlib.import_module(args.service.partition(":")[0])
+    specs = {
+        obj.name: f"{mod.__name__}:{attr}"
+        for attr, obj in vars(mod).items()
+        if isinstance(obj, DynamoService)
+    }
+    overrides: dict[str, dict] = {}
+    if args.config_file:
+        with open(args.config_file) as f:
+            text = f.read()
+        try:
+            import yaml
+
+            overrides = yaml.safe_load(text) or {}
+        except ImportError:
+            import json as _json
+
+            overrides = _json.loads(text)
+    store = await StoreClient.connect(args.store_host, args.store_port)
+    sup = Supervisor(
+        entry=entry,
+        store=store,
+        namespace=entry.config.namespace,
+        store_host=args.store_host,
+        store_port=args.store_port,
+        overrides=overrides,
+        service_specs=specs,
+    )
+    await sup.start()
+    print(f"serving graph {entry.name}: {list(specs)}", flush=True)
+    stop = asyncio.Event()
+    import signal as _signal
+
+    loop = asyncio.get_running_loop()
+    for sig in (_signal.SIGINT, _signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # pragma: no cover
+            pass
+    await stop.wait()
+    await sup.shutdown()
+    await store.close()
+
+
+async def cmd_metrics(args: Any) -> None:
+    from dynamo_tpu.metrics.service import MetricsService
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    drt = await DistributedRuntime.create(config=_runtime_config(args))
+    drt.runtime.install_signal_handlers()
+    component = drt.namespace(args.namespace).component(args.component)
+    svc = MetricsService(component, port=args.port)
+    await svc.start()
+    print(f"metrics on :{svc.port}/metrics", flush=True)
+    await drt.runtime.wait_shutdown()
+    await svc.close()
+    await drt.shutdown()
+
+
+async def cmd_planner(args: Any) -> None:
+    from dynamo_tpu.planner.connector import LocalConnector
+    from dynamo_tpu.planner.planner import Planner, PlannerConfig
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    drt = await DistributedRuntime.create(config=_runtime_config(args))
+    drt.runtime.install_signal_handlers()
+    component = drt.namespace(args.namespace).component(args.component)
+    planner = Planner(
+        drt.store,
+        component,
+        LocalConnector(drt.store, args.namespace),
+        config=PlannerConfig(
+            decode_component=args.component,
+            prefill_component=args.prefill_component,
+            metric_interval_s=args.metric_interval,
+            adjustment_interval_s=args.adjustment_interval,
+            min_decode=args.min_decode,
+            max_decode=args.max_decode,
+            min_prefill=args.min_prefill,
+            max_prefill=args.max_prefill,
+        ),
+    )
+    await planner.start()
+    print("planner running", flush=True)
+    await drt.runtime.wait_shutdown()
+    await planner.close()
+    await drt.shutdown()
+
+
 async def cmd_models(args: Any) -> None:
     from dynamo_tpu.store.client import StoreClient
 
@@ -389,6 +517,15 @@ def main(argv: Optional[list[str]] = None) -> None:
             asyncio.run(server.serve_forever())
         except KeyboardInterrupt:
             pass
+    elif args.command == "serve":
+        try:
+            asyncio.run(cmd_serve(args))
+        except KeyboardInterrupt:
+            pass
+    elif args.command == "metrics":
+        asyncio.run(cmd_metrics(args))
+    elif args.command == "planner":
+        asyncio.run(cmd_planner(args))
     elif args.command == "models":
         asyncio.run(cmd_models(args))
     else:  # pragma: no cover

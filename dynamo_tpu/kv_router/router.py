@@ -47,6 +47,19 @@ class KvRouter:
         router.aggregator.start_consuming(
             await component.subscribe(LOAD_METRICS_SUBJECT)
         )
+        # publish per-decision hit-rate events for the metrics service
+        # (reference: scheduler.rs KVHitRateEvent on "kv-hit-rate")
+        loop = asyncio.get_running_loop()
+        pending: set[asyncio.Task] = set()
+
+        def publish_hit_rate(ev) -> None:
+            task = loop.create_task(
+                component.namespace.publish("kv-hit-rate", ev.model_dump())
+            )
+            pending.add(task)
+            task.add_done_callback(pending.discard)
+
+        router.scheduler.on_hit_rate = publish_hit_rate
         router._prune_task = asyncio.get_running_loop().create_task(
             router._prune_dead_workers()
         )

@@ -1,0 +1,5 @@
+"""Cluster metrics aggregation service (reference: components/metrics)."""
+
+from dynamo_tpu.metrics.service import MetricsService
+
+__all__ = ["MetricsService"]

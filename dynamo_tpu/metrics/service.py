@@ -1,0 +1,140 @@
+"""Metrics aggregation service: worker load → Prometheus text endpoint.
+
+Reference: components/metrics/src/lib.rs:145-612 — scrape worker
+ForwardPassMetrics, aggregate (avg/std load, active blocks/slots),
+serve Prometheus ``/metrics``, and watch KV hit-rate events. Transport
+here: subscribe to the component's ``load_metrics`` subject (same feed
+as router and planner) and the frontend's KV hit-rate events.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import math
+from typing import Optional
+
+from aiohttp import web
+
+from dynamo_tpu.kv_router.scheduler import KvMetricsAggregator
+from dynamo_tpu.runtime.component import Component
+
+log = logging.getLogger("dynamo_tpu.metrics")
+
+KV_HIT_RATE_SUBJECT = "kv-hit-rate"
+
+
+class MetricsService:
+    def __init__(
+        self,
+        component: Component,
+        host: str = "0.0.0.0",
+        port: int = 9091,
+    ):
+        self.component = component
+        self.host = host
+        self.port = port
+        self.aggregator = KvMetricsAggregator()
+        self._hit_events = 0
+        self._isl_sum = 0.0
+        self._overlap_sum = 0.0
+        self._runner: Optional[web.AppRunner] = None
+        self._hit_task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        sub = await self.component.subscribe("load_metrics")
+        self.aggregator.start_consuming(sub)
+        hit_sub = await self.component.namespace.subscribe(KV_HIT_RATE_SUBJECT)
+
+        async def pump_hits() -> None:
+            async for _subject, payload in hit_sub:
+                try:
+                    self._hit_events += 1
+                    self._isl_sum += float(payload.get("isl_blocks", 0))
+                    self._overlap_sum += float(payload.get("overlap_blocks", 0))
+                except Exception:
+                    log.exception("bad kv-hit-rate payload")
+
+        self._hit_task = asyncio.create_task(pump_hits())
+        app = web.Application()
+        app.router.add_get("/metrics", self._handle_metrics)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in self._runner.sites:
+            self.port = s._server.sockets[0].getsockname()[1]
+        log.info("metrics service on :%d/metrics", self.port)
+
+    def render(self) -> str:
+        """Prometheus text exposition (gauge names ≈ reference
+        components/metrics/src/lib.rs:339-545)."""
+        fresh = self.aggregator.fresh_metrics()
+        lines: list[str] = []
+
+        def gauge(name: str, help_: str, value: float, labels: str = "") -> None:
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name}{labels} {value}")
+
+        loads = [m.gpu_cache_usage_perc for m in fresh.values()]
+        mean = sum(loads) / len(loads) if loads else 0.0
+        std = (
+            math.sqrt(sum((x - mean) ** 2 for x in loads) / len(loads))
+            if loads
+            else 0.0
+        )
+        gauge("llm_kv_load_avg", "mean KV cache usage across workers", mean)
+        gauge("llm_kv_load_std", "stddev of KV cache usage", std)
+        gauge(
+            "llm_kv_blocks_active",
+            "total active KV blocks",
+            float(sum(m.kv_active_blocks for m in fresh.values())),
+        )
+        gauge(
+            "llm_kv_blocks_total",
+            "total KV blocks",
+            float(sum(m.kv_total_blocks for m in fresh.values())),
+        )
+        gauge(
+            "llm_requests_active_slots",
+            "busy request slots",
+            float(sum(m.request_active_slots for m in fresh.values())),
+        )
+        gauge(
+            "llm_requests_total_slots",
+            "total request slots",
+            float(sum(m.request_total_slots for m in fresh.values())),
+        )
+        gauge(
+            "llm_requests_waiting",
+            "queued requests",
+            float(sum(m.num_requests_waiting for m in fresh.values())),
+        )
+        gauge("llm_workers_reporting", "workers with fresh metrics", float(len(fresh)))
+        for wid, m in sorted(fresh.items()):
+            gauge(
+                "llm_worker_kv_cache_usage",
+                "per-worker KV cache usage",
+                m.gpu_cache_usage_perc,
+                labels=f'{{worker="{wid:x}"}}',
+            )
+        avg_hit = (
+            self._overlap_sum / self._isl_sum if self._isl_sum > 0 else 0.0
+        )
+        gauge("llm_kv_hit_rate_events", "KV hit rate events seen", float(self._hit_events))
+        gauge("llm_kv_avg_hit_rate", "mean prefix overlap fraction", avg_hit)
+        return "\n".join(lines) + "\n"
+
+    async def _handle_metrics(self, _req: web.Request) -> web.Response:
+        return web.Response(text=self.render(), content_type="text/plain")
+
+    async def close(self) -> None:
+        if self._hit_task is not None:
+            self._hit_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._hit_task
+        await self.aggregator.close()
+        if self._runner is not None:
+            await self._runner.cleanup()

@@ -1,0 +1,11 @@
+"""Dynamic autoscaling planner.
+
+Reference: examples/llm/components/planner.py:51-365 (scaling loop) +
+components/planner/src/dynamo/planner/{local_connector.py,
+kubernetes_connector.py}.
+"""
+
+from dynamo_tpu.planner.planner import Planner, PlannerConfig
+from dynamo_tpu.planner.connector import LocalConnector
+
+__all__ = ["Planner", "PlannerConfig", "LocalConnector"]

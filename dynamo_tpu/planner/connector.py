@@ -1,0 +1,118 @@
+"""Planner → supervisor/operator connectors.
+
+Reference: components/planner/src/dynamo/planner/local_connector.py:34-304
+(circus RPC + statefile) and kubernetes_connector.py:20-69 (patch the
+graph CR). The local connector speaks the supervisor's store control
+subject; add/remove round-trips are acknowledged over an ephemeral
+reply subject.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import uuid
+from typing import Any, Optional
+
+from dynamo_tpu.sdk.serving import CONTROL_SUBJECT, state_key
+from dynamo_tpu.store.base import Store
+
+log = logging.getLogger("dynamo_tpu.planner.connector")
+
+
+class LocalConnector:
+    def __init__(self, store: Store, namespace: str, timeout_s: float = 30.0):
+        self.store = store
+        self.namespace = namespace
+        self.timeout_s = timeout_s
+
+    async def _command(self, op: str, component: str) -> dict[str, Any]:
+        reply_to = f"{self.namespace}.planner.reply.{uuid.uuid4().hex[:8]}"
+        sub = await self.store.subscribe(reply_to)
+        try:
+            payload = json.dumps(
+                {"op": op, "component": component, "reply_to": reply_to}
+            ).encode()
+            await self.store.publish(
+                f"{self.namespace}.{CONTROL_SUBJECT}", payload
+            )
+
+            async def first() -> dict[str, Any]:
+                async for _subj, data in sub:
+                    return json.loads(data.decode())
+                return {"ok": False, "error": "reply stream closed"}
+
+            return await asyncio.wait_for(first(), timeout=self.timeout_s)
+        finally:
+            await sub.close()
+
+    async def add_component(self, component: str) -> bool:
+        r = await self._command("add", component)
+        if not r.get("ok"):
+            log.warning("add %s failed: %s", component, r.get("error"))
+        return bool(r.get("ok"))
+
+    async def remove_component(self, component: str) -> bool:
+        r = await self._command("remove", component)
+        if not r.get("ok"):
+            log.warning("remove %s failed: %s", component, r.get("error"))
+        return bool(r.get("ok"))
+
+    async def replicas(self, component: str) -> Optional[int]:
+        entry = await self.store.kv_get(state_key(self.namespace))
+        if entry is None:
+            return None
+        state = json.loads(entry.value.decode())
+        comp = state.get("components", {}).get(component)
+        return comp["replicas"] if comp else None
+
+
+class KubernetesConnector:
+    """Scale by patching the graph deployment CR's replica counts
+    (reference: kubernetes_connector.py:25-60, kube.py:115). Shells out
+    to kubectl; inert when kubectl/cluster are absent."""
+
+    def __init__(self, namespace: str, deployment: str, k8s_namespace: str = "default"):
+        self.namespace = namespace
+        self.deployment = deployment
+        self.k8s_namespace = k8s_namespace
+
+    async def _patch_replicas(self, component: str, delta: int) -> bool:
+        current = await self.replicas(component)
+        if current is None:
+            return False
+        patch = json.dumps(
+            {"spec": {"services": {component: {"replicas": current + delta}}}}
+        )
+        proc = await asyncio.create_subprocess_exec(
+            "kubectl", "-n", self.k8s_namespace, "patch",
+            "dynamographdeployment", self.deployment,
+            "--type", "merge", "-p", patch,
+            stdout=asyncio.subprocess.DEVNULL, stderr=asyncio.subprocess.PIPE,
+        )
+        _, err = await proc.communicate()
+        if proc.returncode != 0:
+            log.warning("kubectl patch failed: %s", err.decode()[:500])
+        return proc.returncode == 0
+
+    async def add_component(self, component: str) -> bool:
+        return await self._patch_replicas(component, +1)
+
+    async def remove_component(self, component: str) -> bool:
+        return await self._patch_replicas(component, -1)
+
+    async def replicas(self, component: str) -> Optional[int]:
+        proc = await asyncio.create_subprocess_exec(
+            "kubectl", "-n", self.k8s_namespace, "get",
+            "dynamographdeployment", self.deployment, "-o", "json",
+            stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.DEVNULL,
+        )
+        out, _ = await proc.communicate()
+        if proc.returncode != 0:
+            return None
+        try:
+            obj = json.loads(out.decode())
+            return int(obj["spec"]["services"][component]["replicas"])
+        except Exception:
+            return None

@@ -1,0 +1,178 @@
+"""The autoscaling loop.
+
+Reference: examples/llm/components/planner.py:51-365 — every
+metric-pulling interval collect decode KV-load + prefill queue depth;
+every adjustment interval compare against high/low watermarks with
+grace periods and add/remove workers through a connector. Thresholds
+default to the reference's (decode KV 0.9/0.5; prefill queue per-worker
+0.5/0.2 — planner.py:42-50).
+
+Metrics arrive over the workers' ``load_metrics`` component subject (the
+same feed the KV router's scheduler consumes), so the planner is just
+another subscriber — no extra worker-side machinery.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional, Protocol
+
+from dynamo_tpu.disagg.prefill_queue import PrefillQueue
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.kv_router.scheduler import KvMetricsAggregator
+from dynamo_tpu.runtime.component import Component
+from dynamo_tpu.store.base import Store
+
+log = logging.getLogger("dynamo_tpu.planner")
+
+
+class Connector(Protocol):
+    async def add_component(self, component: str) -> bool: ...
+    async def remove_component(self, component: str) -> bool: ...
+
+
+@dataclass
+class PlannerConfig:
+    decode_component: str = "backend"
+    prefill_component: str = "prefill"
+    metric_interval_s: float = 5.0
+    adjustment_interval_s: float = 30.0
+    # decode watermarks on mean KV-cache usage (reference planner.py:42-50)
+    decode_kv_scale_up: float = 0.9
+    decode_kv_scale_down: float = 0.5
+    # prefill watermarks on queue depth per prefill worker
+    prefill_queue_scale_up: float = 0.5
+    prefill_queue_scale_down: float = 0.2
+    min_decode: int = 1
+    max_decode: int = 8
+    min_prefill: int = 0
+    max_prefill: int = 8
+    # consecutive breaches required before acting (grace periods)
+    grace_cycles: int = 2
+
+
+@dataclass
+class _Signal:
+    up_streak: int = 0
+    down_streak: int = 0
+
+    def observe(self, up: bool, down: bool) -> None:
+        self.up_streak = self.up_streak + 1 if up else 0
+        self.down_streak = self.down_streak + 1 if down else 0
+
+
+class Planner:
+    def __init__(
+        self,
+        store: Store,
+        component: Component,  # the decode component (for load_metrics)
+        connector: Connector,
+        config: Optional[PlannerConfig] = None,
+        prefill_workers: int = 0,
+        decode_workers: int = 1,
+    ):
+        self.store = store
+        self.component = component
+        self.connector = connector
+        self.config = config or PlannerConfig()
+        self.aggregator = KvMetricsAggregator()
+        self.queue = PrefillQueue(store, component.namespace.name)
+        self.decode_workers = decode_workers
+        self.prefill_workers = prefill_workers
+        self._decode_sig = _Signal()
+        self._prefill_sig = _Signal()
+        self._task: Optional[asyncio.Task] = None
+        self.history: list[dict[str, Any]] = []  # observability ring
+        self.on_metrics: Optional[Any] = None  # hook for tracing/tensorboard
+
+    async def start(self) -> None:
+        sub = await self.component.subscribe("load_metrics")
+        self.aggregator.start_consuming(sub)
+        self._task = asyncio.create_task(self._run())
+
+    async def collect(self) -> dict[str, float]:
+        fresh = self.aggregator.fresh_metrics()
+        usages = [m.gpu_cache_usage_perc for m in fresh.values()]
+        kv_load = sum(usages) / len(usages) if usages else 0.0
+        depth = await self.queue.depth()
+        per_worker = depth / max(1, self.prefill_workers)
+        snap = {
+            "kv_load_mean": kv_load,
+            "decode_workers_reporting": float(len(fresh)),
+            "prefill_queue_depth": float(depth),
+            "prefill_queue_per_worker": per_worker,
+            "ts": time.time(),
+        }
+        self.history.append(snap)
+        del self.history[:-600]
+        if self.on_metrics is not None:
+            try:
+                self.on_metrics(snap)
+            except Exception:
+                pass
+        return snap
+
+    async def make_adjustments(self, snap: dict[str, float]) -> None:
+        c = self.config
+        self._decode_sig.observe(
+            up=snap["kv_load_mean"] > c.decode_kv_scale_up,
+            down=snap["kv_load_mean"] < c.decode_kv_scale_down,
+        )
+        self._prefill_sig.observe(
+            up=snap["prefill_queue_per_worker"] > c.prefill_queue_scale_up,
+            down=snap["prefill_queue_per_worker"] < c.prefill_queue_scale_down,
+        )
+        if (
+            self._decode_sig.up_streak >= c.grace_cycles
+            and self.decode_workers < c.max_decode
+        ):
+            if await self.connector.add_component(c.decode_component):
+                self.decode_workers += 1
+                self._decode_sig = _Signal()
+                log.info("scaled decode up to %d", self.decode_workers)
+        elif (
+            self._decode_sig.down_streak >= c.grace_cycles
+            and self.decode_workers > c.min_decode
+        ):
+            if await self.connector.remove_component(c.decode_component):
+                self.decode_workers -= 1
+                self._decode_sig = _Signal()
+                log.info("scaled decode down to %d", self.decode_workers)
+        if (
+            self._prefill_sig.up_streak >= c.grace_cycles
+            and self.prefill_workers < c.max_prefill
+        ):
+            if await self.connector.add_component(c.prefill_component):
+                self.prefill_workers += 1
+                self._prefill_sig = _Signal()
+                log.info("scaled prefill up to %d", self.prefill_workers)
+        elif (
+            self._prefill_sig.down_streak >= c.grace_cycles
+            and self.prefill_workers > c.min_prefill
+        ):
+            if await self.connector.remove_component(c.prefill_component):
+                self.prefill_workers -= 1
+                self._prefill_sig = _Signal()
+                log.info("scaled prefill down to %d", self.prefill_workers)
+
+    async def _run(self) -> None:
+        c = self.config
+        last_adjust = time.monotonic()
+        while True:
+            snap = await self.collect()
+            now = time.monotonic()
+            if now - last_adjust >= c.adjustment_interval_s:
+                await self.make_adjustments(snap)
+                last_adjust = now
+            await asyncio.sleep(c.metric_interval_s)
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+        await self.aggregator.close()

@@ -1,0 +1,17 @@
+"""dynamo_tpu SDK: service graph decorators + local serving.
+
+Reference: the BentoML-derived SDK (deploy/sdk/src/dynamo/sdk —
+@service/@dynamo_endpoint decorators, depends() graph edges,
+`dynamo serve` with circus supervision). Here: plain decorators, a
+subprocess supervisor with a store-based control plane, and a TPU
+chip allocator.
+"""
+
+from dynamo_tpu.sdk.service import (
+    DynamoService,
+    depends,
+    endpoint,
+    service,
+)
+
+__all__ = ["DynamoService", "depends", "endpoint", "service"]

@@ -1,0 +1,60 @@
+"""TPU resource allocator for local serving.
+
+Reference: deploy/sdk/src/dynamo/sdk/cli/allocator.py:54-255 (GPU
+assignment per @service resources). TPU twist: the schedulable unit is a
+*chip set* — a worker that wants tp=N needs N chips wired as one mesh,
+and JAX processes address chips via TPU_VISIBLE_DEVICES (or fall back to
+CPU for control-plane components that request no TPU).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+class AllocationError(RuntimeError):
+    pass
+
+
+@dataclass
+class Allocation:
+    chip_ids: list[int] = field(default_factory=list)
+
+    def env(self) -> dict[str, str]:
+        """Env vars that scope a child process to its chips."""
+        if not self.chip_ids:
+            # control-plane component: keep it off the TPU entirely
+            return {"DYN_JAX_PLATFORM": "cpu"}
+        return {
+            "TPU_VISIBLE_DEVICES": ",".join(str(c) for c in self.chip_ids),
+        }
+
+
+class TpuAllocator:
+    def __init__(self, total_chips: int | None = None):
+        if total_chips is None:
+            total_chips = int(os.environ.get("DYN_TPU_CHIPS", "1"))
+        self.total = total_chips
+        self._free: list[int] = list(range(total_chips))
+        self._held: dict[str, list[int]] = {}
+
+    @property
+    def free_chips(self) -> int:
+        return len(self._free)
+
+    def allocate(self, owner: str, resources: dict) -> Allocation:
+        want = int(resources.get("tpu", 0))
+        if want == 0:
+            return Allocation([])
+        if want > len(self._free):
+            raise AllocationError(
+                f"{owner}: wants {want} chips, {len(self._free)} free of {self.total}"
+            )
+        chips = [self._free.pop(0) for _ in range(want)]
+        self._held.setdefault(owner, []).extend(chips)
+        return Allocation(chips)
+
+    def release(self, owner: str) -> None:
+        self._free.extend(self._held.pop(owner, []))
+        self._free.sort()

@@ -1,0 +1,169 @@
+"""Component runner: one process serving one DynamoService.
+
+Reference: deploy/sdk/src/dynamo/sdk/cli/serve_dynamo.py:26-318 — the
+per-component worker main that creates the DistributedRuntime, binds
+dependency clients, and serves each decorated endpoint.
+
+Endpoint methods are ``async def fn(self, request)`` returning a value
+or an async iterator; they are adapted onto the runtime's AsyncEngine
+streaming interface. Dependency attributes become ``RemoteService``
+proxies whose method calls stream from the target component's endpoint
+through a round-robin PushRouter.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import importlib
+import inspect
+import json
+import logging
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime.component import Component
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
+from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+from dynamo_tpu.sdk.service import DynamoService
+
+log = logging.getLogger("dynamo_tpu.sdk.runner")
+
+
+class _EndpointEngine(AsyncEngine):
+    """Adapts one bound endpoint method onto the streaming engine trait."""
+
+    def __init__(self, bound_method: Any):
+        self._fn = bound_method
+
+    async def _gen(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        out = self._fn(request)
+        if inspect.isasyncgen(out):
+            async for item in out:
+                if context.is_stopped:
+                    return
+                yield item
+        else:
+            yield await out
+
+    def generate(self, request: Any, context: Context) -> EngineStream:
+        return self._gen(request, context)
+
+
+class RemoteService:
+    """Client proxy for a depends() edge: ``self.next.generate(req)``
+    streams from the target component's matching endpoint."""
+
+    def __init__(self, target: DynamoService, component: Component):
+        self._target = target
+        self._component = component
+        self._routers: dict[str, PushRouter] = {}
+
+    async def _router(self, ep_name: str) -> PushRouter:
+        router = self._routers.get(ep_name)
+        if router is None:
+            client = await self._component.endpoint(ep_name).client()
+            await client.wait_for_instances()
+            router = PushRouter(client, RouterMode.ROUND_ROBIN)
+            self._routers[ep_name] = router
+        return router
+
+    def __getattr__(self, ep_name: str) -> Any:
+        if ep_name.startswith("_"):
+            raise AttributeError(ep_name)
+        if ep_name not in self._target.endpoints:
+            raise AttributeError(
+                f"{self._target.name} has no endpoint {ep_name!r}"
+            )
+
+        async def call(request: Any) -> AsyncIterator[Any]:
+            router = await self._router(ep_name)
+            async for item in router.generate(request, Context()):
+                yield item
+
+        return call
+
+
+async def bind_dependencies(
+    instance: Any, svc: DynamoService, drt: DistributedRuntime
+) -> None:
+    for attr, target in svc.dependencies.items():
+        component = drt.namespace(target.config.namespace).component(
+            target.name.lower()
+        )
+        setattr(instance, f"_dynamo_dep_{attr}", RemoteService(target, component))
+
+
+async def serve_service(
+    svc: DynamoService,
+    drt: DistributedRuntime,
+    instance: Any = None,
+) -> Any:
+    """Instantiate (unless given) + bind deps + serve all endpoints."""
+    if instance is None:
+        instance = svc.inner()
+    await bind_dependencies(instance, svc, drt)
+    init = getattr(instance, "async_init", None)
+    if init is not None:
+        await init()
+    component = drt.namespace(svc.config.namespace).component(svc.name.lower())
+    for ep_name, method_name in svc.endpoints.items():
+        engine = _EndpointEngine(getattr(instance, method_name))
+        await component.endpoint(ep_name).serve(engine)
+    return instance
+
+
+def load_service(spec: str) -> DynamoService:
+    """'pkg.module:Attr' -> DynamoService."""
+    mod_name, _, attr = spec.partition(":")
+    if not attr:
+        raise ValueError(f"service spec must be module:Attr, got {spec!r}")
+    mod = importlib.import_module(mod_name)
+    svc = getattr(mod, attr)
+    if not isinstance(svc, DynamoService):
+        raise TypeError(f"{spec} is not a @service (got {type(svc)})")
+    return svc
+
+
+async def _amain(args: argparse.Namespace) -> None:
+    from dynamo_tpu.runtime.logging import init_logging
+
+    init_logging()
+    svc = load_service(args.service)
+    if args.config:
+        overrides = json.loads(args.config)
+        svc.config = svc.config.merged(overrides)
+    drt = await DistributedRuntime.create(
+        config=RuntimeConfig.from_settings(
+            store_host=args.store_host, store_port=args.store_port
+        )
+    )
+    drt.runtime.install_signal_handlers()
+    instance = await serve_service(svc, drt)
+    print(f"component {svc.name} serving", flush=True)
+    await drt.runtime.wait_shutdown()
+    stop = getattr(instance, "async_stop", None)
+    if stop is not None:
+        await stop()
+    await drt.shutdown()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(prog="dynamo-tpu-component")
+    p.add_argument("service", help="module:Attr of the DynamoService")
+    p.add_argument("--store-host", default="127.0.0.1")
+    p.add_argument("--store-port", type=int, default=4222)
+    p.add_argument("--config", default="", help="JSON ServiceConfig overrides")
+    args = p.parse_args()
+    from dynamo_tpu.utils.jaxtools import configure_from_env
+
+    configure_from_env()
+    try:
+        asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,143 @@
+"""@service / @endpoint decorators and depends() graph edges.
+
+Reference: deploy/sdk/src/dynamo/sdk/lib/{service.py:301-342,
+decorators.py:27-92, dependency.py}. A decorated class becomes a
+``DynamoService`` carrying its namespace/resources/replica config and
+its endpoint table; ``depends(Other)`` declares a graph edge that the
+component runner resolves into a live endpoint client at serve time.
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+ENDPOINT_ATTR = "__dynamo_endpoint__"
+
+
+@dataclass
+class ServiceConfig:
+    name: str
+    namespace: str = "dynamo"
+    resources: dict[str, Any] = field(default_factory=dict)  # {"tpu": 1, ...}
+    replicas: int = 1
+    config: dict[str, Any] = field(default_factory=dict)  # free-form knobs
+
+    def merged(self, overrides: dict[str, Any]) -> "ServiceConfig":
+        out = ServiceConfig(
+            name=self.name,
+            namespace=overrides.get("namespace", self.namespace),
+            resources={**self.resources, **overrides.get("resources", {})},
+            replicas=overrides.get("replicas", self.replicas),
+            config={**self.config, **overrides.get("config", {})},
+        )
+        return out
+
+
+class Dependency:
+    """A depends() edge; resolved to a client by the component runner."""
+
+    def __init__(self, target: "DynamoService"):
+        self.target = target
+        self.attr_name: Optional[str] = None
+
+    def __set_name__(self, owner: type, name: str) -> None:
+        self.attr_name = name
+
+    def __get__(self, obj: Any, objtype: Optional[type] = None) -> Any:
+        if obj is None:
+            return self
+        bound = getattr(obj, f"_dynamo_dep_{self.attr_name}", None)
+        if bound is None:
+            raise RuntimeError(
+                f"dependency {self.attr_name!r} not bound (component not "
+                "running under serve, or bind_dependencies not called)"
+            )
+        return bound
+
+
+def depends(target: "DynamoService") -> Dependency:
+    if not isinstance(target, DynamoService):
+        raise TypeError("depends() takes a @service-decorated class")
+    return Dependency(target)
+
+
+class DynamoService:
+    """A @service-decorated class: config + endpoints + dependencies."""
+
+    def __init__(self, cls: type, config: ServiceConfig):
+        self.inner = cls
+        self.config = config
+        self.endpoints: dict[str, str] = {}  # endpoint name -> method name
+        for attr, fn in inspect.getmembers(cls, callable):
+            ep_name = getattr(fn, ENDPOINT_ATTR, None)
+            if ep_name is not None:
+                self.endpoints[ep_name] = attr
+        self.dependencies: dict[str, "DynamoService"] = {
+            name: dep.target
+            for name, dep in vars(cls).items()
+            if isinstance(dep, Dependency)
+        }
+
+    @property
+    def name(self) -> str:
+        return self.config.name
+
+    def graph(self) -> list["DynamoService"]:
+        """This service + transitive dependencies, dependencies first."""
+        seen: dict[str, DynamoService] = {}
+
+        def visit(svc: "DynamoService") -> None:
+            if svc.name in seen:
+                return
+            for dep in svc.dependencies.values():
+                visit(dep)
+            seen[svc.name] = svc
+
+        visit(self)
+        return list(seen.values())
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.inner(*args, **kwargs)
+
+    def __repr__(self) -> str:
+        return f"DynamoService({self.name}, endpoints={list(self.endpoints)})"
+
+
+def service(
+    cls: Optional[type] = None,
+    *,
+    dynamo: Optional[dict[str, Any]] = None,
+    resources: Optional[dict[str, Any]] = None,
+    replicas: int = 1,
+    **config: Any,
+) -> Any:
+    """Class decorator (reference: sdk service.py:301 @service)."""
+
+    def wrap(c: type) -> DynamoService:
+        dyn = dynamo or {}
+        return DynamoService(
+            c,
+            ServiceConfig(
+                name=c.__name__,
+                namespace=dyn.get("namespace", "dynamo"),
+                resources=resources or {},
+                replicas=replicas,
+                config=config,
+            ),
+        )
+
+    return wrap(cls) if cls is not None else wrap
+
+
+def endpoint(name: Optional[str] = None) -> Callable:
+    """Method decorator (reference: sdk decorators.py:27 @dynamo_endpoint).
+    The method must be ``async def fn(self, request)`` returning either an
+    async iterator (streamed) or a single value."""
+
+    def wrap(fn: Callable) -> Callable:
+        setattr(fn, ENDPOINT_ATTR, name or fn.__name__)
+        return fn
+
+    return wrap

@@ -1,0 +1,212 @@
+"""Local graph serving: subprocess supervisor with a store control plane.
+
+Reference: deploy/sdk/src/dynamo/sdk/cli/serving.py:163-300 (circus
+arbiter, one watcher per component) + the planner's circus controller
+(components/planner/src/dynamo/planner/circusd.py). Here the supervisor
+is a plain asyncio parent process:
+
+- one child per component replica, running ``dynamo_tpu.sdk.runner``;
+- crash supervision with capped restarts;
+- a **control subject** ``{ns}.supervisor.control`` on the store accepts
+  {op: add|remove, component} commands — this is the planner's scaling
+  lever (reference: local_connector.py add/remove_component);
+- live replica state mirrored to the store key ``{ns}/supervisor/state``
+  and a local statefile (reference: ~/.dynamo/state/{ns}.json,
+  docs/planner.md:91-128).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from dynamo_tpu.sdk.allocator import TpuAllocator
+from dynamo_tpu.sdk.service import DynamoService
+from dynamo_tpu.store.base import Store
+
+log = logging.getLogger("dynamo_tpu.sdk.serving")
+
+CONTROL_SUBJECT = "supervisor.control"
+MAX_RESTARTS = 3
+
+
+def state_key(namespace: str) -> str:
+    return f"{namespace}/supervisor/state"
+
+
+def state_file(namespace: str) -> str:
+    return os.path.join(
+        os.environ.get("DYN_LOCAL_STATE_DIR", os.path.expanduser("~/.dynamo_tpu")),
+        "state", f"{namespace}.json",
+    )
+
+
+@dataclass
+class _Child:
+    name: str  # "<component>/<replica-idx>"
+    proc: asyncio.subprocess.Process
+    restarts: int = 0
+
+
+@dataclass
+class Supervisor:
+    entry: DynamoService
+    store: Store
+    namespace: str
+    store_host: str = "127.0.0.1"
+    store_port: int = 4222
+    overrides: dict[str, dict] = field(default_factory=dict)  # per-component
+    allocator: Optional[TpuAllocator] = None
+    service_specs: dict[str, str] = field(default_factory=dict)  # name -> module:Attr
+
+    def __post_init__(self) -> None:
+        self.allocator = self.allocator or TpuAllocator()
+        self._children: dict[str, _Child] = {}
+        self._replica_counter: dict[str, int] = {}
+        self._services = {s.name: s for s in self.entry.graph()}
+        self._stopping = False
+
+    # -- child lifecycle ---------------------------------------------------
+    async def _spawn(self, svc: DynamoService) -> _Child:
+        idx = self._replica_counter.get(svc.name, 0)
+        self._replica_counter[svc.name] = idx + 1
+        name = f"{svc.name}/{idx}"
+        alloc = self.allocator.allocate(name, svc.config.resources)
+        spec = self.service_specs.get(svc.name)
+        if spec is None:
+            raise RuntimeError(
+                f"no module spec for service {svc.name}; pass service_specs"
+            )
+        overrides = dict(self.overrides.get(svc.name, {}))
+        env = {**os.environ, **alloc.env()}
+        cmd = [
+            sys.executable, "-m", "dynamo_tpu.sdk.runner", spec,
+            "--store-host", self.store_host,
+            "--store-port", str(self.store_port),
+        ]
+        if overrides:
+            cmd += ["--config", json.dumps(overrides)]
+        proc = await asyncio.create_subprocess_exec(*cmd, env=env)
+        child = _Child(name, proc)
+        self._children[name] = child
+        log.info("spawned %s (pid %d, chips %s)", name, proc.pid, alloc.chip_ids)
+        return child
+
+    async def _stop_child(self, name: str, sig: int = signal.SIGTERM) -> None:
+        child = self._children.pop(name, None)
+        if child is None:
+            return
+        self.allocator.release(name)
+        if child.proc.returncode is None:
+            child.proc.send_signal(sig)
+            try:
+                await asyncio.wait_for(child.proc.wait(), timeout=15)
+            except asyncio.TimeoutError:
+                child.proc.kill()
+                await child.proc.wait()
+        log.info("stopped %s", name)
+
+    def replicas(self, component: str) -> list[str]:
+        return sorted(
+            n for n in self._children if n.startswith(component + "/")
+        )
+
+    # -- control plane -----------------------------------------------------
+    async def handle_command(self, cmd: dict[str, Any]) -> dict[str, Any]:
+        op = cmd.get("op")
+        comp = cmd.get("component", "")
+        svc = self._services.get(comp)
+        try:
+            if op == "add":
+                if svc is None:
+                    raise KeyError(f"unknown component {comp!r}")
+                child = await self._spawn(svc)
+                await self._publish_state()
+                return {"ok": True, "name": child.name}
+            if op == "remove":
+                names = self.replicas(comp)
+                if not names:
+                    return {"ok": False, "error": f"no replicas of {comp!r}"}
+                await self._stop_child(names[-1])  # newest first
+                await self._publish_state()
+                return {"ok": True, "name": names[-1]}
+            if op == "state":
+                return {"ok": True, "state": self._state()}
+            raise ValueError(f"unknown op {op!r}")
+        except Exception as exc:
+            return {"ok": False, "error": str(exc)}
+
+    def _state(self) -> dict[str, Any]:
+        return {
+            "components": {
+                s.name: {"replicas": len(self.replicas(s.name))}
+                for s in self._services.values()
+            }
+        }
+
+    async def _publish_state(self) -> None:
+        data = json.dumps(self._state()).encode()
+        await self.store.kv_put(state_key(self.namespace), data)
+        path = state_file(self.namespace)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(data.decode())
+
+    async def _control_loop(self) -> None:
+        import msgpack
+
+        sub = await self.store.subscribe(f"{self.namespace}.{CONTROL_SUBJECT}")
+        async for _subject, payload in sub:
+            try:
+                cmd = msgpack.unpackb(payload, raw=False)
+            except Exception:
+                cmd = json.loads(payload.decode())
+            result = await self.handle_command(cmd)
+            reply_to = cmd.get("reply_to")
+            if reply_to:
+                await self.store.publish(reply_to, json.dumps(result).encode())
+
+    async def _reaper_loop(self) -> None:
+        while not self._stopping:
+            await asyncio.sleep(0.5)
+            for name, child in list(self._children.items()):
+                rc = child.proc.returncode
+                if rc is None or self._stopping:
+                    continue
+                comp = name.split("/", 1)[0]
+                svc = self._services[comp]
+                self._children.pop(name)
+                self.allocator.release(name)
+                if child.restarts >= MAX_RESTARTS:
+                    log.error("%s exited rc=%s; restart cap hit", name, rc)
+                    continue
+                log.warning("%s exited rc=%s; restarting", name, rc)
+                new = await self._spawn(svc)
+                new.restarts = child.restarts + 1
+                await self._publish_state()
+
+    # -- main --------------------------------------------------------------
+    async def start(self) -> None:
+        for svc in self._services.values():
+            for _ in range(max(1, svc.config.replicas)):
+                await self._spawn(svc)
+        await self._publish_state()
+        self._tasks = [
+            asyncio.create_task(self._control_loop()),
+            asyncio.create_task(self._reaper_loop()),
+        ]
+
+    async def shutdown(self) -> None:
+        self._stopping = True
+        for t in getattr(self, "_tasks", []):
+            t.cancel()
+        # stop leaves first (reverse dependency order = entry first)
+        for svc in reversed(self.entry.graph()):
+            for name in self.replicas(svc.name):
+                await self._stop_child(name)

@@ -1,0 +1,21 @@
+"""Test service graph for supervisor e2e (≈ reference sdk tests/pipeline.py)."""
+
+from dynamo_tpu.sdk.service import depends, endpoint, service
+
+
+@service(dynamo={"namespace": "supns"})
+class Worker:
+    @endpoint()
+    async def generate(self, request):
+        for t in request["tokens"]:
+            yield {"token": t * 2}
+
+
+@service(dynamo={"namespace": "supns"})
+class Frontend:
+    worker = depends(Worker)
+
+    @endpoint()
+    async def generate(self, request):
+        async for item in self.worker.generate(request):
+            yield {"token": item["token"] + 1}

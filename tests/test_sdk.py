@@ -1,0 +1,263 @@
+"""SDK decorators, component runner, supervisor, planner, metrics service.
+
+Reference test analogue: deploy/sdk/src/dynamo/sdk/tests/test_e2e.py —
+a full `dynamo serve` of a small pipeline with real coordinator +
+subprocesses, asserting responses and scaling behavior.
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+import pytest
+
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+from dynamo_tpu.sdk.allocator import AllocationError, TpuAllocator
+from dynamo_tpu.sdk.service import DynamoService, depends, endpoint, service
+from dynamo_tpu.store.memory import MemoryStore
+from dynamo_tpu.store.server import StoreServer
+
+
+# --- a tiny two-component graph used across tests -------------------------
+
+
+@service(dynamo={"namespace": "sdktest"})
+class Backend:
+    @endpoint()
+    async def generate(self, request):
+        for t in request["tokens"]:
+            yield {"token": t * 2}
+
+
+@service(dynamo={"namespace": "sdktest"}, replicas=1)
+class Middle:
+    backend = depends(Backend)
+
+    @endpoint()
+    async def generate(self, request):
+        async for item in self.backend.generate(request):
+            yield {"token": item["token"] + 1}
+
+
+def test_decorators_and_graph():
+    assert isinstance(Backend, DynamoService)
+    assert Backend.endpoints == {"generate": "generate"}
+    assert Middle.dependencies == {"backend": Backend}
+    names = [s.name for s in Middle.graph()]
+    assert names == ["Backend", "Middle"]  # dependencies first
+    merged = Middle.config.merged({"replicas": 3, "resources": {"tpu": 2}})
+    assert merged.replicas == 3 and merged.resources == {"tpu": 2}
+
+
+def test_allocator():
+    alloc = TpuAllocator(total_chips=4)
+    a = alloc.allocate("w1", {"tpu": 2})
+    assert a.chip_ids == [0, 1]
+    assert "TPU_VISIBLE_DEVICES" in a.env()
+    b = alloc.allocate("cp", {})
+    assert b.env() == {"DYN_JAX_PLATFORM": "cpu"}
+    with pytest.raises(AllocationError):
+        alloc.allocate("w2", {"tpu": 3})
+    alloc.release("w1")
+    assert alloc.free_chips == 4
+
+
+async def test_serve_service_and_dependency_calls():
+    """Two components served in-process; depends() edge streams through
+    the real endpoint plane."""
+    from dynamo_tpu.sdk.runner import serve_service
+
+    server = StoreServer(MemoryStore(lease_sweep_interval_s=0.1), port=0)
+    await server.start()
+    cfg = lambda: RuntimeConfig(  # noqa: E731
+        store_port=server.port, worker_host="127.0.0.1",
+        lease_ttl_s=2.0, lease_keepalive_s=0.5,
+    )
+    drt_b = await DistributedRuntime.create(config=cfg())
+    drt_m = await DistributedRuntime.create(config=cfg())
+    try:
+        await serve_service(Backend, drt_b)
+        mid = await serve_service(Middle, drt_m)
+        out = []
+        async for item in mid.backend.generate({"tokens": [1, 2, 3]}):
+            out.append(item["token"])
+        assert out == [2, 4, 6]
+        # and through Middle's own endpoint engine
+        comp = drt_b.namespace("sdktest").component("middle")
+        client = await comp.endpoint("generate").client()
+        ids = await client.wait_for_instances(timeout_s=5)
+        stream = await client.generate_direct(ids[0], {"tokens": [5]})
+        items = [i async for i in stream]
+        assert items == [{"token": 11}]
+        await client.close()
+    finally:
+        await drt_m.shutdown()
+        await drt_b.shutdown()
+        await server.stop()
+
+
+# --- supervisor e2e (real subprocesses) -----------------------------------
+
+GRAPH_MODULE = "tests.sdk_graph"
+
+
+async def test_supervisor_graph_and_scaling(tmp_path, monkeypatch):
+    from dynamo_tpu.planner.connector import LocalConnector
+    from dynamo_tpu.sdk.runner import load_service
+    from dynamo_tpu.sdk.serving import Supervisor, state_file
+
+    monkeypatch.setenv("DYN_LOCAL_STATE_DIR", str(tmp_path))
+    monkeypatch.setenv("DYN_JAX_PLATFORM", "cpu")
+    monkeypatch.setenv("PYTHONPATH", os.path.dirname(os.path.dirname(__file__)))
+    server = StoreServer(MemoryStore(lease_sweep_interval_s=0.1), port=0)
+    await server.start()
+    from dynamo_tpu.store.client import StoreClient
+
+    store = await StoreClient.connect("127.0.0.1", server.port)
+    entry = load_service(f"{GRAPH_MODULE}:Frontend")
+    import importlib
+
+    mod = importlib.import_module(GRAPH_MODULE)
+    specs = {
+        obj.name: f"{GRAPH_MODULE}:{attr}"
+        for attr, obj in vars(mod).items()
+        if isinstance(obj, DynamoService)
+    }
+    sup = Supervisor(
+        entry=entry, store=store, namespace="supns",
+        store_host="127.0.0.1", store_port=server.port,
+        service_specs=specs,
+    )
+    await sup.start()
+    try:
+        drt = await DistributedRuntime.create(
+            config=RuntimeConfig(store_port=server.port, worker_host="127.0.0.1")
+        )
+        comp = drt.namespace("supns").component("frontend")
+        client = await comp.endpoint("generate").client()
+        ids = await client.wait_for_instances(timeout_s=30)
+        stream = await client.generate_direct(ids[0], {"tokens": [3]})
+        items = [i async for i in stream]
+        assert items == [{"token": 7}]  # 3*2 (worker) then +1 (frontend)
+
+        # planner connector scales the worker component up then down
+        conn = LocalConnector(store, "supns", timeout_s=15)
+        assert await conn.add_component("Worker")
+        assert await conn.replicas("Worker") == 2
+        assert await conn.remove_component("Worker")
+        assert await conn.replicas("Worker") == 1
+        assert os.path.exists(state_file("supns"))
+        with open(state_file("supns")) as f:
+            st = json.load(f)
+        assert st["components"]["Worker"]["replicas"] == 1
+        await client.close()
+        await drt.shutdown()
+    finally:
+        await sup.shutdown()
+        await store.close()
+        await server.stop()
+
+
+# --- planner unit logic ----------------------------------------------------
+
+
+class FakeConnector:
+    def __init__(self):
+        self.calls = []
+
+    async def add_component(self, c):
+        self.calls.append(("add", c))
+        return True
+
+    async def remove_component(self, c):
+        self.calls.append(("remove", c))
+        return True
+
+
+async def test_planner_thresholds_and_grace():
+    from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.planner.planner import Planner, PlannerConfig
+
+    store = MemoryStore()
+    server = StoreServer(store, port=0)
+    await server.start()
+    drt = await DistributedRuntime.create(
+        config=RuntimeConfig(store_port=server.port, worker_host="127.0.0.1")
+    )
+    comp = drt.namespace("plns").component("backend")
+    conn = FakeConnector()
+    planner = Planner(
+        drt.store, comp, conn,
+        config=PlannerConfig(grace_cycles=2, max_decode=4, min_decode=1),
+        decode_workers=1,
+    )
+    # feed synthetic overloaded metrics directly into the aggregator
+    planner.aggregator.update(
+        ForwardPassMetrics(worker_id=1, gpu_cache_usage_perc=0.95)
+    )
+    snap = await planner.collect()
+    await planner.make_adjustments(snap)  # streak 1: no action (grace)
+    assert conn.calls == []
+    await planner.make_adjustments(snap)  # streak 2: scale up
+    assert conn.calls == [("add", "backend")]
+    assert planner.decode_workers == 2
+    # low load scales back down after grace
+    planner.aggregator.update(
+        ForwardPassMetrics(worker_id=1, gpu_cache_usage_perc=0.1)
+    )
+    snap = await planner.collect()
+    await planner.make_adjustments(snap)
+    await planner.make_adjustments(snap)
+    assert conn.calls[-1] == ("remove", "backend")
+    assert planner.decode_workers == 1
+    await planner.close()
+    await drt.shutdown()
+    await server.stop()
+
+
+# --- metrics service --------------------------------------------------------
+
+
+async def test_metrics_service_render_and_http():
+    import aiohttp
+
+    from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.metrics.service import MetricsService
+
+    server = StoreServer(MemoryStore(lease_sweep_interval_s=0.1), port=0)
+    await server.start()
+    drt = await DistributedRuntime.create(
+        config=RuntimeConfig(store_port=server.port, worker_host="127.0.0.1")
+    )
+    comp = drt.namespace("mns").component("backend")
+    svc = MetricsService(comp, host="127.0.0.1", port=0)
+    await svc.start()
+    try:
+        svc.aggregator.update(
+            ForwardPassMetrics(
+                worker_id=0xAB, gpu_cache_usage_perc=0.5,
+                kv_active_blocks=10, kv_total_blocks=100,
+                request_active_slots=2, request_total_slots=8,
+            )
+        )
+        await comp.namespace.publish(
+            "kv-hit-rate", {"worker_id": 0xAB, "isl_blocks": 10, "overlap_blocks": 5}
+        )
+        await asyncio.sleep(0.2)
+        text = svc.render()
+        assert "llm_kv_load_avg 0.5" in text
+        assert "llm_kv_blocks_active 10.0" in text
+        assert 'llm_worker_kv_cache_usage{worker="ab"} 0.5' in text
+        assert "llm_kv_avg_hit_rate 0.5" in text
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(f"http://127.0.0.1:{svc.port}/metrics") as resp:
+                assert resp.status == 200
+                body = await resp.text()
+                assert "llm_workers_reporting" in body
+    finally:
+        await svc.close()
+        await drt.shutdown()
+        await server.stop()
