@@ -21,7 +21,7 @@ from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
 from dynamo_tpu.kv_router.protocols import KvCacheEvent, RouterEvent
-from dynamo_tpu.tokens import compute_block_hashes_for_seq, compute_seq_hashes
+from dynamo_tpu.tokens import hash_sequence
 
 log = logging.getLogger("dynamo_tpu.kv_router.indexer")
 
@@ -99,12 +99,62 @@ class RadixTree:
         return set(self._by_worker)
 
 
+class NativeRadixTree:
+    """Same contract as :class:`RadixTree`, backed by the C++ index
+    (native/src/radix.cc). The per-worker membership set stays in Python
+    only for ``workers()`` introspection; match/apply hot paths run native."""
+
+    def __init__(self) -> None:
+        from dynamo_tpu.native import NativeRadix
+
+        self._native = NativeRadix()
+        self._worker_ids: set[int] = set()
+
+    def apply_event(self, event: RouterEvent) -> None:
+        ev = event.event
+        if ev.op == "stored":
+            self._worker_ids.add(event.worker_id)
+        elif ev.op == "cleared":
+            self._worker_ids.discard(event.worker_id)
+        self._native.apply(event.worker_id, ev.op, ev.block_hashes)
+
+    def remove_worker(self, worker_id: int) -> None:
+        self._worker_ids.discard(worker_id)
+        self._native.remove_worker(worker_id)
+
+    def find_matches(self, seq_hashes: Iterable[int]) -> OverlapScores:
+        hashes = list(seq_hashes)
+        return OverlapScores(
+            scores=self._native.find_matches(hashes), total_blocks=len(hashes)
+        )
+
+    @property
+    def num_blocks(self) -> int:
+        return self._native.num_blocks
+
+    @property
+    def applied_events(self) -> int:
+        return self._native.applied_events
+
+    def workers(self) -> set[int]:
+        return set(self._worker_ids)
+
+
+def make_radix_tree() -> "RadixTree | NativeRadixTree":
+    """Native tree when the C++ tier is built, Python otherwise."""
+    from dynamo_tpu import native
+
+    if native.is_available():
+        return NativeRadixTree()
+    return RadixTree()
+
+
 class KvIndexer:
     """Event-driven indexer: subscribes to worker KV events and answers
     overlap queries (reference: indexer.rs KvIndexer)."""
 
     def __init__(self, block_size: int = 16):
-        self.tree = RadixTree()
+        self.tree = make_radix_tree()
         self.block_size = block_size
         self._task: Optional[asyncio.Task] = None
 
@@ -113,8 +163,8 @@ class KvIndexer:
         return self.tree.find_matches(seq_hashes)
 
     def find_matches_for_request(self, token_ids: list[int]) -> OverlapScores:
-        block_hashes = compute_block_hashes_for_seq(token_ids, self.block_size)
-        return self.tree.find_matches(compute_seq_hashes(block_hashes))
+        _, seq_hashes = hash_sequence(token_ids, self.block_size)
+        return self.tree.find_matches(seq_hashes)
 
     # -- event intake -----------------------------------------------------
     def apply(self, event: RouterEvent) -> None:

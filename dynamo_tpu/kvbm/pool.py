@@ -9,6 +9,11 @@ re-computable, so the pool is a pure cache and eviction is always legal.
 
 ``on_evict`` is the demotion hook: when G2 evicts, the manager writes the
 block down to G3 (reference offload cascade: block_manager/offload.rs).
+
+Bookkeeping (hash→block map, free list, LRU order, victim selection) runs
+in the native C++ tier when built (native/src/lru.cc); data movement stays
+in the storage backend. ``_PyLruIndex`` is the drop-in pure-Python
+fallback with identical semantics.
 """
 
 from __future__ import annotations
@@ -19,53 +24,118 @@ from typing import Callable, Optional
 import numpy as np
 
 from dynamo_tpu.kvbm.storage import BlockStorage
+from dynamo_tpu.native import LRU_EVICTED, LRU_INSERTED, LRU_PRESENT
 
 EvictFn = Callable[[int, np.ndarray], None]  # (seq_hash, packed_block)
 
+PRESENT, INSERTED, EVICTED = LRU_PRESENT, LRU_INSERTED, LRU_EVICTED
+
+
+class _PyLruIndex:
+    """Pure-Python mirror of native.NativeLru (same insert/evict contract)."""
+
+    def __init__(self, num_blocks: int) -> None:
+        self._free: list[int] = list(range(num_blocks))
+        self._map: dict[int, int] = {}
+        self._lru: OrderedDict[int, None] = OrderedDict()  # first = evict first
+
+    def lookup(self, seq_hash: int, touch: bool = True) -> Optional[int]:
+        bid = self._map.get(seq_hash)
+        if bid is not None and touch:
+            self._lru.move_to_end(seq_hash)
+        return bid
+
+    def insert(self, seq_hash: int) -> tuple[int, int, Optional[tuple[int, int]]]:
+        if seq_hash in self._map:
+            self._lru.move_to_end(seq_hash)
+            return PRESENT, self._map[seq_hash], None
+        victim = None
+        code = INSERTED
+        if not self._free:
+            v_hash, _ = self._lru.popitem(last=False)
+            v_block = self._map.pop(v_hash)
+            self._free.append(v_block)
+            victim = (v_hash, v_block)
+            code = EVICTED
+        bid = self._free.pop()
+        self._map[seq_hash] = bid
+        self._lru[seq_hash] = None
+        return code, bid, victim
+
+    def evict(self, seq_hash: int) -> Optional[int]:
+        bid = self._map.pop(seq_hash, None)
+        if bid is None:
+            return None
+        self._lru.pop(seq_hash, None)
+        self._free.append(bid)
+        return bid
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def match_prefix(self, seq_hashes: list[int]) -> int:
+        n = 0
+        for h in seq_hashes:
+            if h in self._map:
+                n += 1
+            else:
+                break
+        return n
+
+
+def _make_index(num_blocks: int, use_native: Optional[bool]):
+    from dynamo_tpu import native
+
+    if use_native is False or (use_native is None and not native.is_available()):
+        return _PyLruIndex(num_blocks)
+    return native.NativeLru(num_blocks)
+
 
 class TierPool:
-    def __init__(self, storage: BlockStorage, on_evict: Optional[EvictFn] = None):
+    def __init__(
+        self,
+        storage: BlockStorage,
+        on_evict: Optional[EvictFn] = None,
+        use_native: Optional[bool] = None,
+    ):
         self.storage = storage
         self.on_evict = on_evict
-        self._free: list[int] = list(range(storage.num_blocks))
-        self._hash_to_block: dict[int, int] = {}
-        # LRU order over cached hashes: first = evict first
-        self._lru: OrderedDict[int, None] = OrderedDict()
+        self._idx = _make_index(storage.num_blocks, use_native)
 
     # -- introspection ----------------------------------------------------
     @property
     def num_cached(self) -> int:
-        return len(self._hash_to_block)
+        return len(self._idx)
 
     @property
     def num_blocks(self) -> int:
         return self.storage.num_blocks
 
     def contains(self, seq_hash: int) -> bool:
-        return seq_hash in self._hash_to_block
+        return self._idx.lookup(seq_hash, touch=False) is not None
 
     def match_prefix(self, seq_hashes: list[int]) -> int:
         """Leading consecutive hits (no side effects)."""
-        n = 0
-        for h in seq_hashes:
-            if h in self._hash_to_block:
-                n += 1
-            else:
-                break
-        return n
+        return self._idx.match_prefix(seq_hashes)
 
     # -- data path --------------------------------------------------------
     def insert(self, seq_hash: int, data: np.ndarray) -> None:
         """Cache one packed block, evicting LRU if full."""
-        if seq_hash in self._hash_to_block:
-            self._lru.move_to_end(seq_hash)
+        code, bid, victim = self._idx.insert(seq_hash)
+        if code == PRESENT:
             return
-        if not self._free:
-            self._evict_one()
-        bid = self._free.pop()
-        self.storage.write_blocks([bid], data[None])
-        self._hash_to_block[seq_hash] = bid
-        self._lru[seq_hash] = None
+        try:
+            if code == EVICTED and self.on_evict is not None:
+                # the victim's storage is reused for the new block, so demote
+                # its data before overwriting
+                v_hash, v_block = victim  # type: ignore[misc]
+                self.on_evict(v_hash, self.storage.read_blocks([v_block])[0])
+            self.storage.write_blocks([bid], data[None])
+        except BaseException:
+            # don't leave the index pointing at a block whose write failed:
+            # a later read would return another sequence's stale KV bytes
+            self._idx.evict(seq_hash)
+            raise
 
     def insert_many(self, seq_hashes: list[int], data: np.ndarray) -> None:
         # write each block as it is admitted: if the batch overflows the
@@ -78,20 +148,15 @@ class TierPool:
         """Read cached blocks (all must be present); refreshes LRU."""
         ids = []
         for h in seq_hashes:
-            ids.append(self._hash_to_block[h])
-            self._lru.move_to_end(h)
+            bid = self._idx.lookup(h, touch=True)
+            if bid is None:
+                raise KeyError(seq_hash_missing(h))
+            ids.append(bid)
         return self.storage.read_blocks(ids)
 
     def evict(self, seq_hash: int) -> None:
-        bid = self._hash_to_block.pop(seq_hash, None)
-        if bid is None:
-            return
-        self._lru.pop(seq_hash, None)
-        self._free.append(bid)
+        self._idx.evict(seq_hash)
 
-    def _evict_one(self) -> None:
-        victim, _ = self._lru.popitem(last=False)
-        bid = self._hash_to_block.pop(victim)
-        if self.on_evict is not None:
-            self.on_evict(victim, self.storage.read_blocks([bid])[0])
-        self._free.append(bid)
+
+def seq_hash_missing(h: int) -> str:
+    return f"seq_hash {h:#x} not cached in this tier"

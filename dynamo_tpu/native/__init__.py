@@ -1,0 +1,296 @@
+"""ctypes bindings to the native C++ tier (`native/src/*.cc`).
+
+The reference keeps its runtime hot loops in a native language (reference:
+lib/tokens/src/lib.rs token hashing; lib/llm/src/kv_router/indexer.rs radix
+index; lib/llm/src/block_manager/pool/inactive.rs block pool) with Python
+bindings on top. dynamo-tpu does the same in C++: this module loads
+``_dynamo_native.so`` (built by ``python native/build.py``) and exposes
+
+- :func:`hash_sequence` — batch chained block/sequence hashing (xxh3,
+  bit-identical to :mod:`dynamo_tpu.tokens`),
+- :class:`NativeRadix` — the KV-router prefix index,
+- :class:`NativeLru` — content-addressed LRU pool bookkeeping.
+
+Every consumer falls back to its pure-Python implementation when the
+library is absent or ``DYN_NATIVE=0`` is set, so the native tier is a
+performance floor, not a dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+# insert() result protocol, shared by NativeLru, the pure-Python fallback
+# (kvbm.pool._PyLruIndex), and native/src/lru.cc (the C literals there are
+# documented against these names).
+LRU_PRESENT, LRU_INSERTED, LRU_EVICTED = 0, 1, 2
+
+
+def _so_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "_dynamo_native.so")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("DYN_NATIVE", "1") == "0":
+        return None
+    path = _so_path()
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+
+    u64, i64, sz = ctypes.c_uint64, ctypes.c_int64, ctypes.c_size_t
+    p = ctypes.POINTER
+
+    lib.dyn_xxh3_64.restype = u64
+    lib.dyn_xxh3_64.argtypes = [ctypes.c_void_p, sz, u64]
+    lib.dyn_hash_sequence.restype = sz
+    lib.dyn_hash_sequence.argtypes = [p(ctypes.c_int32), sz, sz, u64, p(u64), p(u64)]
+    lib.dyn_chain_hash.restype = u64
+    lib.dyn_chain_hash.argtypes = [u64, ctypes.c_int, u64, u64]
+
+    lib.dyn_radix_new.restype = ctypes.c_void_p
+    lib.dyn_radix_free.argtypes = [ctypes.c_void_p]
+    lib.dyn_radix_apply.argtypes = [ctypes.c_void_p, i64, ctypes.c_int, p(u64), sz]
+    lib.dyn_radix_remove_worker.argtypes = [ctypes.c_void_p, i64]
+    lib.dyn_radix_find.restype = sz
+    lib.dyn_radix_find.argtypes = [ctypes.c_void_p, p(u64), sz, p(i64), p(ctypes.c_uint32), sz]
+    lib.dyn_radix_num_blocks.restype = sz
+    lib.dyn_radix_num_blocks.argtypes = [ctypes.c_void_p]
+    lib.dyn_radix_applied.restype = u64
+    lib.dyn_radix_applied.argtypes = [ctypes.c_void_p]
+    lib.dyn_radix_num_workers.restype = sz
+    lib.dyn_radix_num_workers.argtypes = [ctypes.c_void_p]
+
+    lib.dyn_lru_new.restype = ctypes.c_void_p
+    lib.dyn_lru_new.argtypes = [sz]
+    lib.dyn_lru_free.argtypes = [ctypes.c_void_p]
+    lib.dyn_lru_lookup.restype = i64
+    lib.dyn_lru_lookup.argtypes = [ctypes.c_void_p, u64, ctypes.c_int]
+    lib.dyn_lru_insert.restype = ctypes.c_int
+    lib.dyn_lru_insert.argtypes = [ctypes.c_void_p, u64, p(i64), p(u64), p(i64)]
+    lib.dyn_lru_evict.restype = i64
+    lib.dyn_lru_evict.argtypes = [ctypes.c_void_p, u64]
+    lib.dyn_lru_len.restype = sz
+    lib.dyn_lru_len.argtypes = [ctypes.c_void_p]
+    lib.dyn_lru_match_prefix.restype = sz
+    lib.dyn_lru_match_prefix.argtypes = [ctypes.c_void_p, p(u64), sz]
+
+    _LIB = lib
+    return _LIB
+
+
+def is_available() -> bool:
+    return _load() is not None
+
+
+def build(force: bool = False) -> bool:
+    """Compile the native library in place (delegates to native/build.py)."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    spec = importlib.util.spec_from_file_location(
+        "dyn_native_build", os.path.join(repo, "native", "build.py")
+    )
+    if spec is None or spec.loader is None:
+        return False
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    ok = mod.build(force=force)
+    if ok:
+        global _TRIED, _LIB
+        _TRIED, _LIB = False, None  # reload on next use
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# hashing
+
+
+def xxh3_64(data: bytes, seed: int = 0) -> int:
+    lib = _load()
+    assert lib is not None
+    buf = (ctypes.c_char * len(data)).from_buffer_copy(data) if data else None
+    return lib.dyn_xxh3_64(buf, len(data), ctypes.c_uint64(seed))
+
+
+def hash_sequence(
+    tokens: np.ndarray, block_size: int, salt: int
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """(block_hashes, seq_hashes) for all complete blocks, or None if the
+    native tier is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    # Match the pure-Python path's dtype handling: token ids are treated as
+    # u32 (compute_block_hash casts via uint32), so ids in [2^31, 2^32) must
+    # not overflow an int32 conversion — go through uint32 and reinterpret
+    # the bytes, which is what the hash sees anyway.
+    arr = np.asarray(tokens)
+    if arr.dtype == np.int32:
+        arr = np.ascontiguousarray(arr)
+    else:
+        arr = np.ascontiguousarray(arr.astype(np.uint32, copy=False)).view(np.int32)
+    n_blocks = len(arr) // block_size if block_size else 0
+    block_out = np.empty(n_blocks, dtype=np.uint64)
+    seq_out = np.empty(n_blocks, dtype=np.uint64)
+    if n_blocks:
+        wrote = lib.dyn_hash_sequence(
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(arr),
+            block_size,
+            ctypes.c_uint64(salt),
+            block_out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            seq_out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        )
+        assert wrote == n_blocks
+    return block_out, seq_out
+
+
+def chain_hash(parent: int | None, block_hash: int, salt: int) -> int | None:
+    lib = _load()
+    if lib is None:
+        return None
+    return lib.dyn_chain_hash(
+        ctypes.c_uint64(parent or 0),
+        0 if parent is None else 1,
+        ctypes.c_uint64(block_hash),
+        ctypes.c_uint64(salt),
+    )
+
+
+# ---------------------------------------------------------------------------
+# radix index
+
+_RADIX_OPS = {"stored": 0, "removed": 1, "cleared": 2}
+
+
+class NativeRadix:
+    """Handle to the C++ prefix index (same semantics as
+    kv_router.indexer.RadixTree)."""
+
+    def __init__(self) -> None:
+        lib = _load()
+        assert lib is not None, "native tier unavailable"
+        self._lib = lib
+        self._h = lib.dyn_radix_new()
+
+    def __del__(self) -> None:  # pragma: no cover
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.dyn_radix_free(h)
+            self._h = None
+
+    @staticmethod
+    def _as_u64(hashes) -> np.ndarray:
+        return np.ascontiguousarray(
+            np.asarray(list(hashes), dtype=np.uint64) if not isinstance(hashes, np.ndarray) else hashes,
+            dtype=np.uint64,
+        )
+
+    def apply(self, worker_id: int, op: str, block_hashes) -> None:
+        arr = self._as_u64(block_hashes)
+        self._lib.dyn_radix_apply(
+            self._h,
+            ctypes.c_int64(worker_id),
+            _RADIX_OPS[op],
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            len(arr),
+        )
+
+    def remove_worker(self, worker_id: int) -> None:
+        self._lib.dyn_radix_remove_worker(self._h, ctypes.c_int64(worker_id))
+
+    def find_matches(self, seq_hashes) -> dict[int, int]:
+        arr = self._as_u64(seq_hashes)
+        cap = max(64, 2 * self._lib.dyn_radix_num_workers(self._h))
+        workers = np.empty(cap, dtype=np.int64)
+        scores = np.empty(cap, dtype=np.uint32)
+        n = self._lib.dyn_radix_find(
+            self._h,
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            len(arr),
+            workers.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            scores.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            cap,
+        )
+        return {int(workers[i]): int(scores[i]) for i in range(n)}
+
+    @property
+    def num_blocks(self) -> int:
+        return self._lib.dyn_radix_num_blocks(self._h)
+
+    @property
+    def applied_events(self) -> int:
+        return self._lib.dyn_radix_applied(self._h)
+
+
+# ---------------------------------------------------------------------------
+# LRU pool index
+
+
+class NativeLru:
+    """Handle to the C++ content-addressed LRU index (TierPool bookkeeping)."""
+
+    PRESENT, INSERTED, EVICTED = LRU_PRESENT, LRU_INSERTED, LRU_EVICTED
+
+    def __init__(self, num_blocks: int) -> None:
+        lib = _load()
+        assert lib is not None, "native tier unavailable"
+        self._lib = lib
+        self._h = lib.dyn_lru_new(num_blocks)
+
+    def __del__(self) -> None:  # pragma: no cover
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.dyn_lru_free(h)
+            self._h = None
+
+    def lookup(self, seq_hash: int, touch: bool = True) -> int | None:
+        bid = self._lib.dyn_lru_lookup(self._h, ctypes.c_uint64(seq_hash), int(touch))
+        return None if bid < 0 else int(bid)
+
+    def insert(self, seq_hash: int) -> tuple[int, int, tuple[int, int] | None]:
+        """Returns (code, block_id, victim) with victim=(hash, block) when
+        code==EVICTED. The caller must demote the victim's data before
+        writing block_id (storage is reused)."""
+        out_block = ctypes.c_int64()
+        v_hash = ctypes.c_uint64()
+        v_block = ctypes.c_int64()
+        code = self._lib.dyn_lru_insert(
+            self._h,
+            ctypes.c_uint64(seq_hash),
+            ctypes.byref(out_block),
+            ctypes.byref(v_hash),
+            ctypes.byref(v_block),
+        )
+        if code < 0:
+            raise RuntimeError("zero-capacity pool")
+        victim = (int(v_hash.value), int(v_block.value)) if code == self.EVICTED else None
+        return code, int(out_block.value), victim
+
+    def evict(self, seq_hash: int) -> int | None:
+        bid = self._lib.dyn_lru_evict(self._h, ctypes.c_uint64(seq_hash))
+        return None if bid < 0 else int(bid)
+
+    def __len__(self) -> int:
+        return self._lib.dyn_lru_len(self._h)
+
+    def match_prefix(self, seq_hashes) -> int:
+        arr = NativeRadix._as_u64(seq_hashes)
+        return self._lib.dyn_lru_match_prefix(
+            self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), len(arr)
+        )

@@ -74,6 +74,27 @@ def compute_seq_hashes(block_hashes: Iterable[int], salt: int = DEFAULT_SALT) ->
     return out
 
 
+def hash_sequence(
+    tokens: Sequence[int] | np.ndarray, block_size: int, salt: int = DEFAULT_SALT
+) -> tuple[list[int], list[int]]:
+    """(block_hashes, seq_hashes) for every complete block, in one pass.
+
+    The batch entry point used on the routing hot path. Dispatches to the
+    native C++ tier (native/src/hash.cc — the analogue of the reference's
+    rayon-parallel dynamo-tokens crate, lib/tokens/src/lib.rs) when built,
+    bit-identical to the pure-Python fallback.
+    """
+    from dynamo_tpu import native
+
+    if native.is_available():
+        res = native.hash_sequence(tokens, block_size, salt)
+        if res is not None:
+            bh, sh = res
+            return [int(x) for x in bh], [int(x) for x in sh]
+    block_hashes = compute_block_hashes_for_seq(tokens, block_size, salt)
+    return block_hashes, compute_seq_hashes(block_hashes, salt)
+
+
 @dataclass(frozen=True)
 class TokenBlock:
     """An immutable, complete block of ``block_size`` tokens.

@@ -1,0 +1,93 @@
+#!/usr/bin/env python3
+"""Build the native tier: native/src/*.cc -> dynamo_tpu/native/_dynamo_native.so.
+
+Usage: python native/build.py [--force]
+
+Finds an xxhash single-header (vendored by pyarrow/tensorflow in this image;
+falls back to /usr/include) for the hashing TU. Skips the compile when the
+.so is newer than every source. The framework degrades gracefully to its
+pure-Python paths when the .so is absent, so this is an optimization step,
+not an install requirement.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+SRC = os.path.join(HERE, "src")
+OUT = os.path.join(REPO, "dynamo_tpu", "native", "_dynamo_native.so")
+
+SOURCES = ["hash.cc", "radix.cc", "lru.cc"]
+
+
+def find_xxhash_include() -> str | None:
+    candidates = []
+    try:
+        import pyarrow  # noqa: F401
+
+        candidates.append(
+            os.path.join(
+                os.path.dirname(pyarrow.__file__), "include", "arrow", "vendored", "xxhash"
+            )
+        )
+    except Exception:
+        pass
+    purelib = sysconfig.get_paths().get("purelib", "")
+    candidates += [
+        os.path.join(
+            purelib,
+            "tensorflow/include/external/com_github_grpc_grpc/third_party/xxhash",
+        ),
+        "/usr/include",
+        "/usr/local/include",
+    ]
+    for c in candidates:
+        if os.path.exists(os.path.join(c, "xxhash.h")):
+            return c
+    return None
+
+
+def needs_build() -> bool:
+    if not os.path.exists(OUT):
+        return True
+    out_mtime = os.path.getmtime(OUT)
+    deps = [os.path.join(SRC, s) for s in SOURCES] + [os.path.abspath(__file__)]
+    return any(os.path.getmtime(d) > out_mtime for d in deps)
+
+
+def build(force: bool = False) -> bool:
+    """Compile the shared library; returns True if the .so exists after."""
+    if not force and not needs_build():
+        return True
+    inc = find_xxhash_include()
+    if inc is None:
+        print("native: xxhash.h not found; skipping native build", file=sys.stderr)
+        return os.path.exists(OUT)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+        "-Wall", "-Wextra",
+        f"-I{inc}",
+        *[os.path.join(SRC, s) for s in SOURCES],
+        "-o", OUT,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except FileNotFoundError:
+        print("native: g++ not found; skipping native build", file=sys.stderr)
+        return os.path.exists(OUT)
+    except subprocess.CalledProcessError as e:
+        print(f"native: build failed:\n{e.stderr}", file=sys.stderr)
+        return False
+    return True
+
+
+if __name__ == "__main__":
+    ok = build(force="--force" in sys.argv)
+    print(f"native: {'built' if ok else 'UNAVAILABLE'} -> {OUT}")
+    sys.exit(0 if ok else 1)

@@ -1,0 +1,111 @@
+// Batch token-block hashing — the native tier of dynamo_tpu.tokens.
+//
+// Analogue of the reference's standalone rayon-parallel token hashing crate
+// (reference: lib/tokens/src/lib.rs — dynamo-tokens) and the chained xxh3
+// block/sequence hashing in lib/llm/src/tokens.rs. Bit-for-bit compatible
+// with the pure-Python path (dynamo_tpu/tokens.py): block hash =
+// xxh3_64(i32-LE token bytes, seed=salt); sequence hash chain =
+// xxh3_64(u64-LE(parent) || u64-LE(block), seed=salt), first link omits the
+// parent. Block hashes are independent, so they parallelize across a small
+// thread pool; the chain walk is a trivial sequential pass over 16-byte
+// inputs.
+
+#define XXH_INLINE_ALL
+#include "xxhash.h"
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline void le64(uint64_t v, uint8_t* out) {
+  for (int i = 0; i < 8; ++i) out[i] = static_cast<uint8_t>(v >> (8 * i));
+}
+
+void hash_block_range(const int32_t* tokens, size_t block_size, uint64_t salt,
+                      size_t begin, size_t end, uint64_t* out_block) {
+  const size_t nbytes = block_size * sizeof(int32_t);
+  for (size_t b = begin; b < end; ++b) {
+    // Tokens arrive as native-endian int32; the Python side hashes
+    // np.int32.tobytes() which is little-endian on every platform we
+    // target (the static_assert below rejects big-endian builds rather
+    // than silently diverging).
+    out_block[b] = XXH3_64bits_withSeed(tokens + b * block_size, nbytes, salt);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Raw xxh3 for parity tests.
+uint64_t dyn_xxh3_64(const void* data, size_t len, uint64_t seed) {
+  return XXH3_64bits_withSeed(data, len, seed);
+}
+
+// Hash all complete blocks of `tokens` and the chained sequence hashes.
+// Returns the number of complete blocks written to both output arrays
+// (callers size them to n_tokens / block_size).
+size_t dyn_hash_sequence(const int32_t* tokens, size_t n_tokens,
+                         size_t block_size, uint64_t salt,
+                         uint64_t* out_block, uint64_t* out_seq) {
+  if (block_size == 0) return 0;
+  const size_t n_blocks = n_tokens / block_size;
+  if (n_blocks == 0) return 0;
+
+  // Parallel block hashes: only bother spawning threads for real batches
+  // (a long prefill re-hash); decode-path calls hash one or two blocks.
+  const size_t kParallelThreshold = 64;
+  unsigned hw = std::thread::hardware_concurrency();
+  if (n_blocks >= kParallelThreshold && hw > 1) {
+    unsigned n_threads = hw > 8 ? 8 : hw;
+    std::vector<std::thread> threads;
+    size_t chunk = (n_blocks + n_threads - 1) / n_threads;
+    for (unsigned t = 0; t < n_threads; ++t) {
+      size_t begin = t * chunk;
+      if (begin >= n_blocks) break;
+      size_t end = begin + chunk < n_blocks ? begin + chunk : n_blocks;
+      threads.emplace_back(hash_block_range, tokens, block_size, salt, begin,
+                           end, out_block);
+    }
+    for (auto& th : threads) th.join();
+  } else {
+    hash_block_range(tokens, block_size, salt, 0, n_blocks, out_block);
+  }
+
+  // Sequential chain: seq[0] = H(le64(block[0])); seq[i] =
+  // H(le64(seq[i-1]) || le64(block[i])).
+  uint8_t buf[16];
+  le64(out_block[0], buf);
+  out_seq[0] = XXH3_64bits_withSeed(buf, 8, salt);
+  for (size_t i = 1; i < n_blocks; ++i) {
+    le64(out_seq[i - 1], buf);
+    le64(out_block[i], buf + 8);
+    out_seq[i] = XXH3_64bits_withSeed(buf, 16, salt);
+  }
+  return n_blocks;
+}
+
+// Chain continuation for incremental decode: extend an existing chain
+// (parent_valid=0 means "no parent", i.e. the first link).
+uint64_t dyn_chain_hash(uint64_t parent, int parent_valid, uint64_t block_hash,
+                        uint64_t salt) {
+  uint8_t buf[16];
+  if (!parent_valid) {
+    le64(block_hash, buf);
+    return XXH3_64bits_withSeed(buf, 8, salt);
+  }
+  le64(parent, buf);
+  le64(block_hash, buf + 8);
+  return XXH3_64bits_withSeed(buf, 16, salt);
+}
+
+}  // extern "C"
+
+static_assert(sizeof(int32_t) == 4, "token width");
+#if defined(__BYTE_ORDER__) && defined(__ORDER_LITTLE_ENDIAN__)
+static_assert(__BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__,
+              "hash parity with the Python tier assumes little-endian");
+#endif

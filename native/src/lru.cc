@@ -1,0 +1,137 @@
+// Content-addressed LRU block-pool index — native tier of
+// dynamo_tpu.kvbm.pool.TierPool bookkeeping.
+//
+// Analogue of the reference's inactive block pool (reference:
+// lib/llm/src/block_manager/pool/inactive.rs — FIFO + seq-hash dedupe map
+// + eviction order). Tracks hash→block_id, the free list, and LRU order
+// with an intrusive doubly-linked list over preallocated nodes; data
+// movement stays in the storage tier (Python/numpy/jax), only the
+// bookkeeping lives here.
+
+#include <cstddef>
+#include <cstdint>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Node {
+  uint64_t hash = 0;
+  int64_t prev = -1;  // toward LRU end
+  int64_t next = -1;  // toward MRU end
+  bool used = false;
+};
+
+struct Lru {
+  std::vector<Node> nodes;          // indexed by block_id
+  std::vector<int64_t> free_list;   // unused block ids (stack)
+  std::unordered_map<uint64_t, int64_t> map;  // hash -> block_id
+  int64_t head = -1;  // least recently used
+  int64_t tail = -1;  // most recently used
+
+  explicit Lru(size_t n) : nodes(n) {
+    free_list.reserve(n);
+    // Pop order matches the Python fallback (list.pop() from the back of
+    // range(n)) so block-id assignment is identical under both backends.
+    for (size_t i = 0; i < n; ++i) free_list.push_back(static_cast<int64_t>(i));
+  }
+
+  void unlink(int64_t id) {
+    Node& nd = nodes[id];
+    if (nd.prev >= 0) nodes[nd.prev].next = nd.next; else head = nd.next;
+    if (nd.next >= 0) nodes[nd.next].prev = nd.prev; else tail = nd.prev;
+    nd.prev = nd.next = -1;
+  }
+
+  void push_mru(int64_t id) {
+    Node& nd = nodes[id];
+    nd.prev = tail;
+    nd.next = -1;
+    if (tail >= 0) nodes[tail].next = id; else head = id;
+    tail = id;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dyn_lru_new(size_t num_blocks) { return new Lru(num_blocks); }
+
+void dyn_lru_free(void* h) { delete static_cast<Lru*>(h); }
+
+// Returns block_id or -1. touch=1 refreshes recency.
+int64_t dyn_lru_lookup(void* h, uint64_t hash, int touch) {
+  Lru* l = static_cast<Lru*>(h);
+  auto it = l->map.find(hash);
+  if (it == l->map.end()) return -1;
+  if (touch) {
+    l->unlink(it->second);
+    l->push_mru(it->second);
+  }
+  return it->second;
+}
+
+// Insert `hash`. Return codes:
+//   0 = already present (recency refreshed), *out_block = its block
+//   1 = inserted into a free block, *out_block = new block
+//   2 = inserted by evicting the LRU victim; *out_victim_hash/_block tell
+//       the caller which block to demote BEFORE writing *out_block
+//       (out_block == victim block: storage is reused)
+int dyn_lru_insert(void* h, uint64_t hash, int64_t* out_block,
+                   uint64_t* out_victim_hash, int64_t* out_victim_block) {
+  Lru* l = static_cast<Lru*>(h);
+  auto it = l->map.find(hash);
+  if (it != l->map.end()) {
+    l->unlink(it->second);
+    l->push_mru(it->second);
+    *out_block = it->second;
+    return 0;
+  }
+  int rc = 1;
+  if (l->free_list.empty()) {
+    int64_t victim = l->head;
+    if (victim < 0) return -1;  // zero-capacity pool
+    *out_victim_hash = l->nodes[victim].hash;
+    *out_victim_block = victim;
+    l->map.erase(l->nodes[victim].hash);
+    l->unlink(victim);
+    l->nodes[victim].used = false;
+    l->free_list.push_back(victim);
+    rc = 2;
+  }
+  int64_t id = l->free_list.back();
+  l->free_list.pop_back();
+  Node& nd = l->nodes[id];
+  nd.hash = hash;
+  nd.used = true;
+  l->push_mru(id);
+  l->map.emplace(hash, id);
+  *out_block = id;
+  return rc;
+}
+
+// Remove `hash` if present; returns its block id or -1.
+int64_t dyn_lru_evict(void* h, uint64_t hash) {
+  Lru* l = static_cast<Lru*>(h);
+  auto it = l->map.find(hash);
+  if (it == l->map.end()) return -1;
+  int64_t id = it->second;
+  l->map.erase(it);
+  l->unlink(id);
+  l->nodes[id].used = false;
+  l->free_list.push_back(id);
+  return id;
+}
+
+size_t dyn_lru_len(void* h) { return static_cast<Lru*>(h)->map.size(); }
+
+// Leading consecutive hits, no recency side effects (pool.py match_prefix).
+size_t dyn_lru_match_prefix(void* h, const uint64_t* hashes, size_t n) {
+  Lru* l = static_cast<Lru*>(h);
+  size_t k = 0;
+  while (k < n && l->map.count(hashes[k])) ++k;
+  return k;
+}
+
+}  // extern "C"

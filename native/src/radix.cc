@@ -1,0 +1,143 @@
+// Global prefix index over KV block hashes — native tier of
+// dynamo_tpu.kv_router.indexer.
+//
+// Analogue of the reference's radix indexer (reference:
+// lib/llm/src/kv_router/indexer.rs:86-876 — RadixTree, apply_event,
+// find_matches). As in the Python implementation, chained sequence hashes
+// collapse the trie to a flat hash→owners map: a chain walk IS a trie
+// descent. This runs on the router's per-request hot path, so the match
+// loop avoids allocation: the active-owner set is a small sorted vector
+// intersected in place.
+
+#include <algorithm>
+#include <cstdint>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Radix {
+  // hash -> sorted small vector of owning workers
+  std::unordered_map<uint64_t, std::vector<int64_t>> owners;
+  // worker -> hashes it owns (for O(worker size) removal)
+  std::unordered_map<int64_t, std::unordered_set<uint64_t>> by_worker;
+  uint64_t applied = 0;
+};
+
+inline void sorted_insert(std::vector<int64_t>& v, int64_t w) {
+  auto it = std::lower_bound(v.begin(), v.end(), w);
+  if (it == v.end() || *it != w) v.insert(it, w);
+}
+
+inline void sorted_erase(std::vector<int64_t>& v, int64_t w) {
+  auto it = std::lower_bound(v.begin(), v.end(), w);
+  if (it != v.end() && *it == w) v.erase(it);
+}
+
+void remove_worker_impl(Radix* r, int64_t worker) {
+  auto it = r->by_worker.find(worker);
+  if (it == r->by_worker.end()) return;
+  for (uint64_t h : it->second) {
+    auto oit = r->owners.find(h);
+    if (oit != r->owners.end()) {
+      sorted_erase(oit->second, worker);
+      if (oit->second.empty()) r->owners.erase(oit);
+    }
+  }
+  r->by_worker.erase(it);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* dyn_radix_new() { return new Radix(); }
+
+void dyn_radix_free(void* h) { delete static_cast<Radix*>(h); }
+
+// op: 0 = stored, 1 = removed, 2 = cleared (hashes ignored)
+void dyn_radix_apply(void* h, int64_t worker, int op, const uint64_t* hashes,
+                     size_t n) {
+  Radix* r = static_cast<Radix*>(h);
+  if (op == 0) {
+    auto& mine = r->by_worker[worker];
+    for (size_t i = 0; i < n; ++i) {
+      sorted_insert(r->owners[hashes[i]], worker);
+      mine.insert(hashes[i]);
+    }
+  } else if (op == 1) {
+    auto bit = r->by_worker.find(worker);
+    for (size_t i = 0; i < n; ++i) {
+      auto oit = r->owners.find(hashes[i]);
+      if (oit != r->owners.end()) {
+        sorted_erase(oit->second, worker);
+        if (oit->second.empty()) r->owners.erase(oit);
+      }
+      if (bit != r->by_worker.end()) bit->second.erase(hashes[i]);
+    }
+  } else if (op == 2) {
+    remove_worker_impl(r, worker);
+  }
+  r->applied += 1;
+}
+
+void dyn_radix_remove_worker(void* h, int64_t worker) {
+  remove_worker_impl(static_cast<Radix*>(h), worker);
+}
+
+// Walk seq_hashes accumulating the longest consecutive prefix per worker.
+// Writes up to `cap` (worker, score) pairs; returns the number written.
+// Semantics match indexer.py::RadixTree.find_matches: the active set is
+// the intersection of owners along the walk; a worker's score is the depth
+// it stayed in the intersection.
+size_t dyn_radix_find(void* h, const uint64_t* seq_hashes, size_t n,
+                      int64_t* out_workers, uint32_t* out_scores, size_t cap) {
+  Radix* r = static_cast<Radix*>(h);
+  std::vector<int64_t> active;   // current intersection, sorted
+  std::vector<int64_t> workers;  // all workers ever active, sorted
+  std::vector<uint32_t> scores;  // parallel to `workers`
+  bool first = true;
+  std::vector<int64_t> tmp;
+  for (size_t i = 0; i < n; ++i) {
+    auto oit = r->owners.find(seq_hashes[i]);
+    if (oit == r->owners.end() || oit->second.empty()) break;
+    if (first) {
+      active = oit->second;
+      first = false;
+    } else {
+      tmp.clear();
+      std::set_intersection(active.begin(), active.end(), oit->second.begin(),
+                            oit->second.end(), std::back_inserter(tmp));
+      active.swap(tmp);
+    }
+    if (active.empty()) break;
+    for (int64_t w : active) {
+      auto wit = std::lower_bound(workers.begin(), workers.end(), w);
+      size_t idx = wit - workers.begin();
+      if (wit == workers.end() || *wit != w) {
+        workers.insert(wit, w);
+        scores.insert(scores.begin() + idx, 0);
+      }
+      scores[idx] = static_cast<uint32_t>(i + 1);
+    }
+  }
+  size_t out = workers.size() < cap ? workers.size() : cap;
+  for (size_t i = 0; i < out; ++i) {
+    out_workers[i] = workers[i];
+    out_scores[i] = scores[i];
+  }
+  return out;
+}
+
+size_t dyn_radix_num_blocks(void* h) {
+  return static_cast<Radix*>(h)->owners.size();
+}
+
+uint64_t dyn_radix_applied(void* h) { return static_cast<Radix*>(h)->applied; }
+
+size_t dyn_radix_num_workers(void* h) {
+  return static_cast<Radix*>(h)->by_worker.size();
+}
+
+}  // extern "C"
