@@ -29,17 +29,7 @@ log = logging.getLogger("dynamo_tpu.cli")
 DYN_SCHEME = "dyn://"
 
 
-def parse_dyn_path(value: str) -> tuple[str, str, str]:
-    """Parse dyn://namespace.component.endpoint
-    (reference: lib/runtime/src/protocols.rs Endpoint path parsing)."""
-    if not value.startswith(DYN_SCHEME):
-        raise ValueError(f"expected {DYN_SCHEME} prefix: {value!r}")
-    parts = value[len(DYN_SCHEME) :].split(".")
-    if len(parts) != 3 or not all(parts):
-        raise ValueError(
-            f"expected dyn://namespace.component.endpoint, got {value!r}"
-        )
-    return parts[0], parts[1], parts[2]
+from dynamo_tpu.runtime.component import parse_dyn_path  # noqa: E402
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -120,8 +110,15 @@ def build_parser() -> argparse.ArgumentParser:
     planner.add_argument("--store-port", type=int, default=4222)
 
     models = sub.add_parser("models", help="model registry ctl (≈ llmctl)")
-    models.add_argument("action", choices=["list", "remove"])
+    models.add_argument("action", choices=["list", "register", "remove"])
     models.add_argument("name", nargs="?")
+    models.add_argument("--model-path", help="local model dir (register)")
+    models.add_argument("--endpoint", help="dyn://ns.comp.ep (register)")
+    models.add_argument(
+        "--model-type",
+        default="chat_completion",
+        choices=["chat", "completion", "chat_completion"],
+    )
     models.add_argument("--store-host", default="127.0.0.1")
     models.add_argument("--store-port", type=int, default=4222)
     return p
@@ -140,7 +137,9 @@ def _load_model_assets(args: Any):
     except Exception:
         formatter = None
         log.warning("no chat template found; chat requests will fail")
-    model_name = args.model_name or args.model_path.rstrip("/").rsplit("/", 1)[-1]
+    from dynamo_tpu.model_card import default_model_name
+
+    model_name = args.model_name or default_model_name(args.model_path)
     return tokenizer, formatter, model_name
 
 
@@ -232,6 +231,27 @@ async def cmd_run(args: Any) -> None:
             router = PushRouter(client, mode)
         # remote workers speak PreprocessedRequest: wrap with local pre/post
         model_name, engine = _wrap_pipeline(args, router, [])
+    elif out == "auto":
+        # discovery-driven frontend: serve whatever models workers register
+        # (reference: components/http standalone frontend + ModelWatcher)
+        if in_mode != "http":
+            raise SystemExit("--out auto requires --in http")
+        from dynamo_tpu.http.discovery import ModelWatcher
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        drt = await DistributedRuntime.create(config=_runtime_config(args))
+        drt.runtime.install_signal_handlers()
+        manager = ModelManager()
+        watcher = ModelWatcher(drt, manager, router_mode=args.router_mode)
+        await watcher.start()
+        service = HttpService(manager, host=args.http_host, port=args.http_port)
+        await service.start()
+        print(f"listening on http://{args.http_host}:{service.port}", flush=True)
+        await drt.runtime.wait_shutdown()
+        await watcher.close()
+        await service.stop()
+        await drt.shutdown()
+        return
     else:
         raise SystemExit(f"unknown --out {out!r}")
 
@@ -296,6 +316,21 @@ async def cmd_run(args: Any) -> None:
             )
             metrics_pub.start()
         await endpoint.serve(engine)
+        if args.model_path and out in ("echo_core", "jax"):
+            # publish the deployment card + this instance's ModelEntry so
+            # discovery-driven frontends (--out auto) pick the model up
+            # (reference: register_llm / llmctl http add). Only core
+            # (PreprocessedRequest) engines register: that's the contract
+            # discovery frontends build their pipelines against.
+            from dynamo_tpu.model_card import default_model_name, register_llm
+
+            await register_llm(
+                drt.store,
+                args.model_path,
+                args.model_name or default_model_name(args.model_path),
+                in_mode,
+                drt.primary_lease_id,
+            )
         print(f"worker serving {in_mode}", flush=True)
         await drt.runtime.wait_shutdown()
         await drt.shutdown()
@@ -478,21 +513,40 @@ async def cmd_planner(args: Any) -> None:
 
 
 async def cmd_models(args: Any) -> None:
+    from dynamo_tpu.model_card import list_entries, register_llm, unregister_model
     from dynamo_tpu.store.client import StoreClient
 
     client = await StoreClient.connect(args.store_host, args.store_port)
     try:
         if args.action == "list":
-            entries = await client.kv_get_prefix("models/")
-            for e in entries:
-                print(e.key)
+            for entry in await list_entries(client):
+                print(
+                    f"{entry.name}\t{entry.model_type}\t{entry.endpoint}"
+                    f"\tlease={entry.lease_id:x}"
+                )
             instances = await client.kv_get_prefix("instances/")
             for e in instances:
                 print(e.key)
+        elif args.action == "register":
+            # llmctl http add: manual registration for engines that don't
+            # self-register (the card stays until `models remove`)
+            if not (args.name and args.model_path and args.endpoint):
+                raise SystemExit(
+                    "models register requires NAME --model-path and --endpoint"
+                )
+            await register_llm(
+                client,
+                args.model_path,
+                args.name,
+                args.endpoint,
+                lease_id=0,
+                model_type=args.model_type,
+            )
+            print(f"registered {args.name} -> {args.endpoint}")
         elif args.action == "remove":
             if not args.name:
                 raise SystemExit("models remove requires a name")
-            n = await client.kv_delete_prefix(f"models/{args.name}")
+            n = await unregister_model(client, args.name)
             print(f"removed {n} entries")
     finally:
         await client.close()

@@ -1,0 +1,169 @@
+"""Discovery-driven model add/remove for the HTTP frontend.
+
+Analogue of the reference's ModelWatcher (reference:
+lib/llm/src/http/service/discovery.rs:46-383 — etcd-watched ModelEntry
+keys drive ModelManager add/remove; components/http/src/main.rs — the
+standalone frontend that serves whatever models workers register).
+
+Watches ``models/{slug}/{lease_hex}`` entries: the first instance of a
+model fetches its deployment card, materializes tokenizer artifacts, and
+builds the full pipeline (preprocessor → backend → push router to the
+instance's endpoint); the last instance disappearing (worker death revokes
+the lease) removes the model from the manager.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from dynamo_tpu.model_card.card import MODELS_PREFIX, ModelEntry, fetch_card
+from dynamo_tpu.runtime.component import parse_dyn_path
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+log = logging.getLogger("dynamo_tpu.http.discovery")
+
+
+class ModelWatcher:
+    """Keeps a ModelManager in sync with the store's model registry."""
+
+    def __init__(
+        self,
+        drt: DistributedRuntime,
+        manager,
+        router_mode: str = "round_robin",
+        cache_dir: Optional[str] = None,
+    ):
+        self.drt = drt
+        self.manager = manager
+        self.router_mode = router_mode
+        self.cache_dir = cache_dir
+        # slug -> set of live entry keys; slug -> (display name, closer)
+        self._instances: dict[str, set[str]] = {}
+        self._models: dict[str, tuple[str, list]] = {}
+        self._watch = None
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        self._watch = await self.drt.store.watch_prefix(f"{MODELS_PREFIX}/")
+        for entry in self._watch.snapshot():
+            try:
+                await self._on_put(entry.key, entry.value)
+            except Exception:
+                # one bad registry entry must not take down the frontend
+                log.exception("bad model entry in snapshot: %s", entry.key)
+        self._task = asyncio.get_running_loop().create_task(self._pump())
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if self._watch is not None:
+            await self._watch.close()
+        for slug in list(self._models):
+            await self._drop_model(slug)
+
+    async def _pump(self) -> None:
+        assert self._watch is not None
+        try:
+            async for ev in self._watch:
+                try:
+                    if ev.type == "put":
+                        await self._on_put(ev.entry.key, ev.entry.value)
+                    else:
+                        await self._on_delete(ev.entry.key)
+                except Exception:
+                    log.exception("model watch event failed: %s", ev.entry.key)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("model watch died; registry frozen")
+
+    # -- event handling ---------------------------------------------------
+    @staticmethod
+    def _slug_of(key: str) -> Optional[str]:
+        parts = key.split("/")
+        return parts[1] if len(parts) == 3 else None
+
+    async def _on_put(self, key: str, value: bytes) -> None:
+        slug = self._slug_of(key)
+        if slug is None:
+            return
+        keys = self._instances.setdefault(slug, set())
+        keys.add(key)
+        if slug in self._models:
+            return
+        entry = ModelEntry.from_json(value)
+        await self._add_model(slug, entry)
+
+    async def _on_delete(self, key: str) -> None:
+        slug = self._slug_of(key)
+        if slug is None:
+            return
+        keys = self._instances.get(slug)
+        if keys is None:
+            return
+        keys.discard(key)
+        if not keys:
+            self._instances.pop(slug, None)
+            await self._drop_model(slug)
+
+    # -- pipeline construction --------------------------------------------
+    async def _add_model(self, slug: str, entry: ModelEntry) -> None:
+        from dynamo_tpu.backend import Backend
+        from dynamo_tpu.preprocessor import OpenAIPreprocessor, PromptFormatter
+        from dynamo_tpu.runtime.pipeline import build_pipeline
+        from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+        from dynamo_tpu.tokenizer import Tokenizer
+
+        card, local_dir = await fetch_card(
+            self.drt.store, entry.name, cache_dir=self.cache_dir
+        )
+        ns, comp, ep = parse_dyn_path(entry.endpoint)
+        component = self.drt.namespace(ns).component(comp)
+        client = await component.endpoint(ep).client()
+
+        closers: list = [client]
+        mode = entry.router_mode or self.router_mode
+        if mode == "kv":
+            from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+
+            kv_router = await KvRouter.create(component, client)
+            router = KvPushRouter(kv_router)
+            closers.append(kv_router)
+        else:
+            router = PushRouter(
+                client,
+                RouterMode.ROUND_ROBIN if mode == "round_robin" else RouterMode.RANDOM,
+            )
+
+        tokenizer = Tokenizer.from_file(local_dir)
+        try:
+            formatter = PromptFormatter.from_model_dir(local_dir)
+        except Exception:
+            formatter = None
+            log.warning("model %s: no chat template in card artifacts", entry.name)
+        pre = OpenAIPreprocessor(tokenizer, formatter, model_name=entry.name)
+        backend = Backend(tokenizer, eos_token_ids=card.model_info.eos_token_ids)
+        pipeline = build_pipeline(pre, backend, router)
+
+        if entry.model_type in ("chat", "chat_completion"):
+            self.manager.add_chat_model(entry.name, pipeline)
+        if entry.model_type in ("completion", "chat_completion"):
+            self.manager.add_completion_model(entry.name, pipeline)
+        self._models[slug] = (entry.name, closers)
+        log.info("model added: %s -> %s (router=%s)", entry.name, entry.endpoint, mode)
+
+    async def _drop_model(self, slug: str) -> None:
+        name_closers = self._models.pop(slug, None)
+        if name_closers is None:
+            return
+        name, closers = name_closers
+        self.manager.remove_model(name)
+        for c in closers:
+            try:
+                await c.close()
+            except Exception:
+                log.debug("closer failed for %s", name, exc_info=True)
+        log.info("model removed: %s", name)

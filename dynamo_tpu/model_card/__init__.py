@@ -1,0 +1,31 @@
+"""Model deployment cards: publish/discover model artifacts on the store.
+
+Analogue of the reference's model-card layer (reference:
+lib/llm/src/model_card/model.rs:58-541 — ModelDeploymentCard with
+move_to_nats/move_from_nats artifact shipping, and lib/llm/src/http/
+service/discovery.rs:46-383 — ModelWatcher-driven model add/remove).
+"""
+
+from dynamo_tpu.model_card.card import (
+    ModelDeploymentCard,
+    default_model_name,
+    ModelEntry,
+    ModelInfo,
+    fetch_card,
+    list_entries,
+    publish_card,
+    register_llm,
+    unregister_model,
+)
+
+__all__ = [
+    "ModelDeploymentCard",
+    "default_model_name",
+    "ModelEntry",
+    "ModelInfo",
+    "fetch_card",
+    "list_entries",
+    "publish_card",
+    "register_llm",
+    "unregister_model",
+]

@@ -1,0 +1,309 @@
+"""ModelDeploymentCard + ModelEntry: the model discovery data plane.
+
+Two-level scheme mirroring the reference (reference:
+lib/llm/src/model_card/model.rs — the card + artifact shipping via the
+NATS object store; lib/llm/src/discovery/model_entry and
+http/service/discovery.rs — per-instance ModelEntry keys in etcd):
+
+- ``mdc/{slug}`` (KV, unleased, create-if-absent): the card JSON — model
+  metadata plus object-store references for its artifacts
+  (tokenizer.json, tokenizer_config.json, config.json). Artifacts live in
+  object-store bucket ``mdc`` under ``{slug}/{filename}``.
+- ``models/{slug}/{lease_hex}`` (KV, attached to the worker's primary
+  lease): one ModelEntry per serving instance. Worker death revokes the
+  lease, the entry vanishes, and frontends drop the model when its last
+  entry is gone.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+from dynamo_tpu.store.base import NO_LEASE, Store
+
+MDC_PREFIX = "mdc"
+MODELS_PREFIX = "models"
+MDC_BUCKET = "mdc"
+
+# artifact files shipped with a card, in preference order
+ARTIFACT_FILES = (
+    "tokenizer.json",
+    "tokenizer_config.json",
+    "config.json",
+    "generation_config.json",
+    "preprocessor_config.json",
+)
+
+
+def default_model_name(model_path: str) -> str:
+    """Service name derived from a model directory path (shared by serving
+    and registration so the two can never diverge)."""
+    return model_path.rstrip("/").rsplit("/", 1)[-1]
+
+
+def slugify(name: str) -> str:
+    """Store-safe slug of a service name (reference: runtime slug.rs)."""
+    out = []
+    for ch in name:
+        if ch.isalnum() or ch in "-_.":
+            out.append(ch)
+        else:
+            out.append("--")
+    return "".join(out)
+
+
+@dataclass
+class ModelInfo:
+    """Subset of the model config a frontend needs without the weights."""
+
+    context_length: Optional[int] = None
+    vocab_size: Optional[int] = None
+    eos_token_ids: list[int] = field(default_factory=list)
+    architecture: Optional[str] = None
+
+    @classmethod
+    def from_config_json(cls, path: str) -> "ModelInfo":
+        try:
+            with open(path) as f:
+                cfg = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return cls()
+        eos = cfg.get("eos_token_id")
+        if eos is None:
+            eos_ids = []
+        elif isinstance(eos, list):
+            eos_ids = [int(e) for e in eos]
+        else:
+            eos_ids = [int(eos)]
+        archs = cfg.get("architectures") or []
+        return cls(
+            context_length=cfg.get("max_position_embeddings"),
+            vocab_size=cfg.get("vocab_size"),
+            eos_token_ids=eos_ids,
+            architecture=archs[0] if archs else cfg.get("model_type"),
+        )
+
+
+@dataclass
+class ModelDeploymentCard:
+    """The shippable description of a deployable model
+    (reference: model_card/model.rs:100-128)."""
+
+    display_name: str
+    service_name: str
+    model_info: ModelInfo = field(default_factory=ModelInfo)
+    artifacts: list[str] = field(default_factory=list)  # object names in MDC_BUCKET
+    # filename -> sha256 of content; makes the card content-addressed so
+    # frontends can cache artifacts immutably and re-publishes are detected
+    artifact_hashes: dict[str, str] = field(default_factory=dict)
+    revision: int = 0
+    last_published: float = 0.0
+
+    @property
+    def slug(self) -> str:
+        return slugify(self.service_name)
+
+    def fingerprint(self) -> str:
+        """Content identity: metadata + artifact hashes (not timestamps)."""
+        ident = json.dumps(
+            [
+                self.display_name,
+                self.service_name,
+                asdict(self.model_info),
+                self.artifacts,
+                self.artifact_hashes,
+            ],
+            sort_keys=True,
+        )
+        return hashlib.sha256(ident.encode()).hexdigest()[:16]
+
+    def to_json(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "ModelDeploymentCard":
+        d = json.loads(data)
+        d["model_info"] = ModelInfo(**d.get("model_info") or {})
+        return cls(**d)
+
+    @classmethod
+    def from_local(cls, model_dir: str, service_name: str) -> "ModelDeploymentCard":
+        """Build a card from a local HF-style model directory
+        (reference: model_card/create.rs from_local_path)."""
+        artifacts = []
+        hashes = {}
+        for f in ARTIFACT_FILES:
+            path = os.path.join(model_dir, f)
+            if os.path.exists(path):
+                artifacts.append(f)
+                with open(path, "rb") as fh:
+                    hashes[f] = hashlib.sha256(fh.read()).hexdigest()
+        info = ModelInfo.from_config_json(os.path.join(model_dir, "config.json"))
+        return cls(
+            display_name=service_name,
+            service_name=service_name,
+            model_info=info,
+            artifacts=artifacts,
+            artifact_hashes=hashes,
+        )
+
+
+@dataclass
+class ModelEntry:
+    """One serving instance of a model: name -> endpoint mapping
+    (reference: discovery ModelEntry registered by llmctl / register_llm)."""
+
+    name: str
+    endpoint: str  # dyn://{ns}.{component}.{endpoint}
+    model_type: str = "chat_completion"  # chat | completion | chat_completion | backend
+    lease_id: int = NO_LEASE
+    router_mode: str = ""  # hint: "" = frontend default, else random|round_robin|kv
+
+    def to_json(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "ModelEntry":
+        return cls(**json.loads(data))
+
+
+# ---------------------------------------------------------------------------
+# store operations
+
+
+def _card_key(slug: str) -> str:
+    return f"{MDC_PREFIX}/{slug}"
+
+
+def entry_key(slug: str, lease_id: int) -> str:
+    return f"{MODELS_PREFIX}/{slug}/{lease_id:x}"
+
+
+async def publish_card(
+    store: Store, card: ModelDeploymentCard, model_dir: str
+) -> bool:
+    """Upload the card + artifacts (reference model.rs move_to_nats:233).
+
+    Idempotent on identical content; a card whose fingerprint (metadata +
+    artifact hashes) differs from the stored one *replaces* it (last
+    writer wins) so re-registering a model with updated artifacts is not
+    silently ignored. Returns True if this call published content."""
+    existing = await store.kv_get(_card_key(card.slug))
+    if existing is not None:
+        try:
+            old = ModelDeploymentCard.from_json(existing.value)
+            if old.fingerprint() == card.fingerprint():
+                return False
+            card.revision = old.revision + 1
+        except (json.JSONDecodeError, TypeError):
+            card.revision += 1
+    else:
+        card.revision += 1
+    card.last_published = time.time()
+    # artifacts are stored content-addressed (by sha256), so concurrent
+    # fetches of the old card version keep working during an update
+    for fname in card.artifacts:
+        with open(os.path.join(model_dir, fname), "rb") as f:
+            await store.obj_put(
+                MDC_BUCKET, _obj_name(card, fname), f.read()
+            )
+    await store.kv_put(_card_key(card.slug), card.to_json())
+    return True
+
+
+def _obj_name(card: ModelDeploymentCard, fname: str) -> str:
+    h = card.artifact_hashes.get(fname, "v0")
+    return f"{card.slug}/{h[:16]}/{fname}"
+
+
+async def fetch_card(
+    store: Store, service_name: str, cache_dir: Optional[str] = None
+) -> tuple[ModelDeploymentCard, str]:
+    """Fetch a card and materialize its artifacts into a local directory
+    (reference: model.rs move_from_nats:282). Returns (card, local_dir)."""
+    slug = slugify(service_name)
+    entry = await store.kv_get(_card_key(slug))
+    if entry is None:
+        raise KeyError(f"no model card for {service_name!r}")
+    card = ModelDeploymentCard.from_json(entry.value)
+    if cache_dir is None:
+        cache_dir = os.path.join(
+            os.path.expanduser(os.environ.get("DYN_CACHE_DIR", "~/.cache/dynamo_tpu")),
+            "mdc",
+        )
+    # fingerprint in the path makes the cache content-addressed: a
+    # re-published card with different artifacts lands in a fresh dir, so
+    # skip-if-exists can never serve stale tokenizer/config files
+    local_dir = os.path.join(cache_dir, slug, card.fingerprint())
+    os.makedirs(local_dir, exist_ok=True)
+    for fname in card.artifacts:
+        dest = os.path.join(local_dir, fname)
+        if os.path.exists(dest):
+            continue
+        data = await store.obj_get(MDC_BUCKET, _obj_name(card, fname))
+        if data is None:
+            # card published by an older writer without hashed object names
+            data = await store.obj_get(MDC_BUCKET, f"{slug}/{fname}")
+        if data is None:
+            raise KeyError(f"artifact {fname} missing for model {service_name!r}")
+        tmp = dest + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, dest)
+    return card, local_dir
+
+
+async def register_llm(
+    store: Store,
+    model_dir: str,
+    service_name: str,
+    endpoint: str,
+    lease_id: int,
+    model_type: str = "chat_completion",
+    router_mode: str = "",
+) -> ModelDeploymentCard:
+    """Publish card (if absent) + this instance's ModelEntry.
+
+    The analogue of the reference's ``register_llm`` binding
+    (lib/bindings/python rust/lib.rs) / ``llmctl http add``: after this,
+    discovery-driven frontends serve the model.
+    """
+    card = ModelDeploymentCard.from_local(model_dir, service_name)
+    await publish_card(store, card, model_dir)
+    entry = ModelEntry(
+        name=service_name,
+        endpoint=endpoint,
+        model_type=model_type,
+        lease_id=lease_id,
+        router_mode=router_mode,
+    )
+    await store.kv_put(entry_key(card.slug, lease_id), entry.to_json(), lease_id=lease_id)
+    return card
+
+
+async def unregister_model(store: Store, service_name: str) -> int:
+    """Remove every instance entry + the card + artifacts (llmctl remove)."""
+    slug = slugify(service_name)
+    n = await store.kv_delete_prefix(f"{MODELS_PREFIX}/{slug}/")
+    if await store.kv_delete(_card_key(slug)):
+        n += 1
+    for name in await store.obj_list(MDC_BUCKET):
+        if name.startswith(f"{slug}/"):
+            await store.obj_delete(MDC_BUCKET, name)
+    return n
+
+
+async def list_entries(store: Store) -> list[ModelEntry]:
+    entries = await store.kv_get_prefix(f"{MODELS_PREFIX}/")
+    out = []
+    for e in entries:
+        try:
+            out.append(ModelEntry.from_json(e.value))
+        except (json.JSONDecodeError, TypeError):
+            continue
+    return out

@@ -28,6 +28,21 @@ from dynamo_tpu.store.base import Subscription, WatchEvent
 
 log = logging.getLogger("dynamo_tpu.runtime.component")
 
+DYN_SCHEME = "dyn://"
+
+
+def parse_dyn_path(value: str) -> tuple[str, str, str]:
+    """Parse dyn://namespace.component.endpoint
+    (reference: lib/runtime/src/protocols.rs Endpoint path parsing)."""
+    if not value.startswith(DYN_SCHEME):
+        raise ValueError(f"expected {DYN_SCHEME} prefix: {value!r}")
+    parts = value[len(DYN_SCHEME) :].split(".")
+    if len(parts) != 3 or not all(parts):
+        raise ValueError(
+            f"expected dyn://namespace.component.endpoint, got {value!r}"
+        )
+    return parts[0], parts[1], parts[2]
+
 INSTANCE_PREFIX = "instances"
 
 

@@ -41,6 +41,8 @@ def pytest_pyfunc_call(pyfuncitem):
             name: pyfuncitem.funcargs[name]
             for name in pyfuncitem._fixtureinfo.argnames
         }
-        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=120))
+        # generous hang-cap: subprocess-spawning tests (supervisor e2e) can
+        # take minutes under full-suite CPU contention
+        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=300))
         return True
     return None

@@ -138,13 +138,13 @@ async def test_supervisor_graph_and_scaling(tmp_path, monkeypatch):
         )
         comp = drt.namespace("supns").component("frontend")
         client = await comp.endpoint("generate").client()
-        ids = await client.wait_for_instances(timeout_s=30)
+        ids = await client.wait_for_instances(timeout_s=120)
         stream = await client.generate_direct(ids[0], {"tokens": [3]})
         items = [i async for i in stream]
         assert items == [{"token": 7}]  # 3*2 (worker) then +1 (frontend)
 
         # planner connector scales the worker component up then down
-        conn = LocalConnector(store, "supns", timeout_s=15)
+        conn = LocalConnector(store, "supns", timeout_s=60)
         assert await conn.add_component("Worker")
         assert await conn.replicas("Worker") == 2
         assert await conn.remove_component("Worker")
