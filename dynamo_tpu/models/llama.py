@@ -260,32 +260,23 @@ def attn_impl() -> str:
 # ---------------------------------------------------------------------------
 
 
-def forward(
+def make_layer_fn(
     cfg: ModelConfig,
-    params: Params,
-    k_cache: jax.Array,  # [L, n_slots, Hkv, Dh]
-    v_cache: jax.Array,
-    tokens: jax.Array,  # [B, T] int32 (padded)
-    positions: jax.Array,  # [B, T] int32 absolute positions (padded: 0)
-    slot_mapping: jax.Array,  # [B*T] int32 flat cache slots (padded: slot 0)
-    block_tables: jax.Array,  # [B, max_blocks] int32 (padded: block 0)
-    context_lens: jax.Array,  # [B] int32 valid tokens incl. new ones
-    last_token_idx: jax.Array,  # [B] int32 index of last real token in T
+    positions: jax.Array,  # [B, T]
+    slot_mapping: jax.Array,  # [B*T]
+    block_tables: jax.Array,  # [B, max_blocks]
+    context_lens: jax.Array,  # [B]
     block_size: int,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """One model step. Returns (logits[B, V], new_k_cache, new_v_cache)."""
-    B, T = tokens.shape
+):
+    """Per-layer scan body: (x, (layer_params, k_cache_l, v_cache_l)) -> ...
+
+    Shared by the plain lax.scan forward and the pipeline-parallel stage
+    loop (parallel/pipeline.py), which calls it with per-microbatch args.
+    """
     H, Hk, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
 
-    x = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
-
-    layer_params = {
-        k: params[k]
-        for k in params
-        if k not in ("embed", "final_norm", "lm_head")
-    }
-
     def layer_fn(x, scanned):
+        B, T = x.shape[0], x.shape[1]
         lp, k_cache_l, v_cache_l = scanned
         # attention
         h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
@@ -317,6 +308,34 @@ def forward(
             mlp_out = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
             x = x + mlp_out.astype(x.dtype)
         return x, (k_cache_l, v_cache_l)
+
+    return layer_fn
+
+
+def layer_param_names(params: Params) -> list[str]:
+    return [k for k in params if k not in ("embed", "final_norm", "lm_head")]
+
+
+def forward(
+    cfg: ModelConfig,
+    params: Params,
+    k_cache: jax.Array,  # [L, n_slots, Hkv, Dh]
+    v_cache: jax.Array,
+    tokens: jax.Array,  # [B, T] int32 (padded)
+    positions: jax.Array,  # [B, T] int32 absolute positions (padded: 0)
+    slot_mapping: jax.Array,  # [B*T] int32 flat cache slots (padded: slot 0)
+    block_tables: jax.Array,  # [B, max_blocks] int32 (padded: block 0)
+    context_lens: jax.Array,  # [B] int32 valid tokens incl. new ones
+    last_token_idx: jax.Array,  # [B] int32 index of last real token in T
+    block_size: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One model step. Returns (logits[B, V], new_k_cache, new_v_cache)."""
+    x = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
+
+    layer_params = {k: params[k] for k in layer_param_names(params)}
+    layer_fn = make_layer_fn(
+        cfg, positions, slot_mapping, block_tables, context_lens, block_size
+    )
 
     x, (new_k, new_v) = jax.lax.scan(
         layer_fn, x, (layer_params, k_cache, v_cache)

@@ -6,6 +6,7 @@ a `jax.sharding.Mesh` with named axes
 
   dp — data parallel (batch)           sp — sequence/context parallel
   tp — tensor parallel (heads/hidden)  ep — expert parallel (MoE)
+  pp — pipeline parallel (layer stages, parallel/pipeline.py)
 
 and `NamedSharding` rules applied to params, KV cache, and activations.
 XLA inserts the collectives (psum/all-gather/reduce-scatter) over ICI.
@@ -13,4 +14,12 @@ XLA inserts the collectives (psum/all-gather/reduce-scatter) over ICI.
 
 from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh, shard
 
-__all__ = ["MeshConfig", "build_mesh", "shard"]
+__all__ = ["MeshConfig", "build_mesh", "shard", "forward_pp"]
+
+
+def __getattr__(name):
+    if name == "forward_pp":  # lazy: pipeline pulls in the model module
+        from dynamo_tpu.parallel.pipeline import forward_pp
+
+        return forward_pp
+    raise AttributeError(name)

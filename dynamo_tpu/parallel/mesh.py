@@ -9,7 +9,10 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "tp", "ep", "sp")
+# mesh dimension order (single source of truth for build_mesh's reshape):
+# pp outermost after dp (stage hops cross the slower interconnect), tp
+# innermost so TP collectives ride the fastest ICI dimension.
+AXES = ("dp", "pp", "ep", "sp", "tp")
 
 
 @dataclass(frozen=True)
@@ -17,20 +20,25 @@ class MeshConfig:
     """Logical mesh shape. Product must equal the device count in use.
 
     For inference engines the common shapes are (dp=1, tp=N) for dense
-    models and (dp=1, tp=k, ep=m) for MoE decode.
+    models, (dp=1, tp=k, ep=m) for MoE decode, and (pp=s, tp=k) for
+    pipeline-staged very deep models (parallel/pipeline.py).
     """
 
     dp: int = 1
+    pp: int = 1
     tp: int = 1
     ep: int = 1
     sp: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.tp * self.ep * self.sp
+        return self.dp * self.pp * self.tp * self.ep * self.sp
 
     def axis_sizes(self) -> dict[str, int]:
-        return {"dp": self.dp, "tp": self.tp, "ep": self.ep, "sp": self.sp}
+        return {
+            "dp": self.dp, "pp": self.pp, "tp": self.tp,
+            "ep": self.ep, "sp": self.sp,
+        }
 
 
 def build_mesh(
@@ -46,9 +54,9 @@ def build_mesh(
         raise ValueError(
             f"mesh {config} needs {config.size} devices, have {len(devices)}"
         )
-    arr = np.asarray(devices).reshape(config.dp, config.ep, config.sp, config.tp)
-    # mesh dims named in the same order as the reshape
-    return Mesh(arr, axis_names=("dp", "ep", "sp", "tp"))
+    sizes = config.axis_sizes()
+    arr = np.asarray(devices).reshape(*(sizes[a] for a in AXES))
+    return Mesh(arr, axis_names=AXES)
 
 
 def shard(mesh: Mesh, *spec) -> NamedSharding:

@@ -1,0 +1,44 @@
+"""Shared fake-input builders for tests, benchmarks, and the driver dryrun.
+
+Single source of truth for the paged-KV input convention: block 0 is the
+pad/scratch block, sequence b owns blocks [1 + b*n, 1 + (b+1)*n), and
+slot_mapping addresses flat cache slots block_id*block_size + offset.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_paged_inputs(
+    vocab_size: int,
+    batch: int,
+    seq: int,
+    block_size: int,
+    n_blocks_per_seq: int,
+    seed: int = 0,
+):
+    """Build one unified-model-step input set (prefill-shaped).
+
+    Returns (tokens, positions, slot_mapping, block_tables, context_lens,
+    last_token_idx) as numpy arrays matching models.llama.forward's contract.
+    """
+    B, T = batch, seq
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(1, vocab_size, size=(B, T)).astype(np.int32)
+    positions = np.tile(np.arange(T, dtype=np.int32), (B, 1))
+    tables = np.zeros((B, n_blocks_per_seq), np.int32)
+    for b in range(B):
+        tables[b] = np.arange(
+            1 + b * n_blocks_per_seq, 1 + (b + 1) * n_blocks_per_seq,
+            dtype=np.int32,
+        )
+    slot_mapping = np.zeros((B * T,), np.int32)
+    for b in range(B):
+        for j in range(T):
+            slot_mapping[b * T + j] = (
+                tables[b, j // block_size] * block_size + j % block_size
+            )
+    context_lens = np.full((B,), T, np.int32)
+    last_token_idx = np.full((B,), T - 1, np.int32)
+    return tokens, positions, slot_mapping, tables, context_lens, last_token_idx
