@@ -15,8 +15,16 @@ async block writes, and completion notifications. Differences by design:
   onboarding lifts blocks into HBM at admission (manager.py onboard()).
 
 Wire format per message: 4-byte big-endian header length, JSON header
-{request_id, hashes, dtype, shape}, then raw packed-block bytes. One
-reply line {"ok": bool}.
+{request_id, hashes, dtype, shape, head_start?, head_count?}, then raw
+packed-block bytes. One reply line {"ok": bool}.
+
+TP-mismatch resharding (reference: Triton kv_rearrange kernels in the
+vLLM patch :914-1046) is handled here on the logical layout: a sender
+whose KV cache is tensor-parallel over fewer/more ranks than the
+receiver ships its head slice tagged with ``head_start/head_count``;
+the server assembles slices into full-head blocks (ops/kv_rearrange.py
+owns the rank→head-range mapping) and delivers once every head has
+landed. Mixed float dtypes are cast to the receiver's layout dtype.
 """
 
 from __future__ import annotations
@@ -24,6 +32,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 from dataclasses import asdict, dataclass
 from typing import Awaitable, Callable, Optional
 
@@ -31,12 +40,32 @@ import numpy as np
 
 from dynamo_tpu.disagg.protocols import transfer_key
 from dynamo_tpu.kvbm.layout import BlockLayout, resolve_dtype
+from dynamo_tpu.ops.kv_rearrange import cast_packed
 from dynamo_tpu.store.base import Store
 
 log = logging.getLogger("dynamo_tpu.disagg.transfer")
 
 # deliver(hashes, packed) -> awaitable; runs the engine-thread insert
 DeliverFn = Callable[[list[int], np.ndarray], Awaitable[None]]
+
+# float dtypes the receiver will cast from (bounds itemsize too)
+_CASTABLE = {"bfloat16", "float16", "float32"}
+
+
+class _HeadAssembler:
+    """Accumulates per-rank head slices of a block batch until the full
+    head range is covered, then yields the assembled array once."""
+
+    def __init__(self, num_blocks: int, packed_shape: tuple, dtype: np.dtype):
+        self.data = np.zeros((num_blocks, *packed_shape), dtype=dtype)
+        self.covered = np.zeros(packed_shape[-2], dtype=bool)  # per KV head
+        self.created = time.monotonic()
+
+    def add(self, head_start: int, part: np.ndarray) -> bool:
+        n = part.shape[-2]
+        self.data[..., head_start : head_start + n, :] = part
+        self.covered[head_start : head_start + n] = True
+        return bool(self.covered.all())
 
 
 @dataclass
@@ -75,6 +104,17 @@ class TransferServer:
         self._server: Optional[asyncio.base_events.Server] = None
         self.port: int = 0
         self._done: dict[str, asyncio.Event] = {}
+        # (request_id, hashes) -> partial-head assembly in flight.
+        # Bounded by resident BYTES (a partial header claims a full-size
+        # buffer, so a hash-count cap alone would let a peer amplify a
+        # tiny payload into huge allocations) and by a TTL (a dead
+        # sender must not pin buffers forever). At capacity new partial
+        # transfers are REJECTED, never evicted: an evicted assembly's
+        # earlier slices were already acked ok=true and would be lost
+        # silently.
+        self._assembling: dict[tuple, _HeadAssembler] = {}
+        self.MAX_ASSEMBLY_BYTES = 1 << 30
+        self.ASSEMBLER_TTL_S = 120.0
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -87,6 +127,21 @@ class TransferServer:
 
     def discard_completion(self, request_id: str) -> None:
         self._done.pop(request_id, None)
+        # drop any partial assembly for the abandoned request too
+        for key in [k for k in self._assembling if k[0] == request_id]:
+            del self._assembling[key]
+
+    def _purge_stale_assemblers(self) -> None:
+        now = time.monotonic()
+        for key in [
+            k for k, a in self._assembling.items()
+            if now - a.created > self.ASSEMBLER_TTL_S
+        ]:
+            log.warning("dropping expired partial transfer %s", key[0])
+            del self._assembling[key]
+
+    def _assembly_bytes(self) -> int:
+        return sum(a.data.nbytes for a in self._assembling.values())
 
     async def _handle(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
@@ -98,22 +153,61 @@ class TransferServer:
             header = json.loads((await reader.readexactly(hdr_len)).decode())
             shape = tuple(int(d) for d in header["shape"])
             hashes = [int(h) for h in header["hashes"]]
+            full_heads = self._layout.packed_shape[-2]
+            head_start = int(header.get("head_start", 0))
+            head_count = int(header.get("head_count", full_heads))
+            if not (0 <= head_start and head_start + head_count <= full_heads
+                    and head_count > 0):
+                raise ValueError(
+                    f"head slice [{head_start},+{head_count}) out of range "
+                    f"for {full_heads} heads"
+                )
             # validate against OUR layout before buffering anything: the
             # socket is unauthenticated, the peer's shape claim is not
             # trusted (bounds the allocation too)
-            expected = (len(hashes), *self._layout.packed_shape)
+            slice_shape = (*self._layout.packed_shape[:-2], head_count,
+                           self._layout.packed_shape[-1])
+            expected = (len(hashes), *slice_shape)
             if shape != expected or len(hashes) > MAX_BLOCKS_PER_TRANSFER:
                 raise ValueError(
                     f"transfer shape {shape} != expected {expected}"
                 )
+            if header["dtype"] not in _CASTABLE:
+                raise ValueError(f"transfer dtype {header['dtype']} not castable")
             dtype = resolve_dtype(header["dtype"])
-            if dtype != self._layout.np_dtype:
-                raise ValueError(
-                    f"transfer dtype {dtype} != layout {self._layout.np_dtype}"
-                )
             payload = await reader.readexactly(int(np.prod(shape)) * dtype.itemsize)
-            packed = np.frombuffer(payload, dtype=dtype).reshape(shape)
-            await self._deliver(hashes, packed)
+            packed = cast_packed(
+                np.frombuffer(payload, dtype=dtype).reshape(shape),
+                self._layout.np_dtype,
+            )
+            if head_count == full_heads:
+                await self._deliver(hashes, packed)
+            else:
+                akey = (header.get("request_id", ""), tuple(hashes))
+                asm = self._assembling.get(akey)
+                if asm is None:
+                    self._purge_stale_assemblers()
+                    new_bytes = (
+                        len(hashes) * self._layout.block_bytes
+                    )
+                    if (self._assembly_bytes() + new_bytes
+                            > self.MAX_ASSEMBLY_BYTES):
+                        raise ValueError(
+                            "partial-transfer assembly budget exhausted"
+                        )
+                    asm = _HeadAssembler(
+                        len(hashes), self._layout.packed_shape,
+                        self._layout.np_dtype,
+                    )
+                    self._assembling[akey] = asm
+                if asm.add(head_start, packed):
+                    del self._assembling[akey]
+                    await self._deliver(hashes, asm.data)
+                else:
+                    # acknowledge the slice; completion fires on last one
+                    writer.write(json.dumps({"ok": True}).encode() + b"\n")
+                    await writer.drain()
+                    return
             rid = header.get("request_id", "")
             # only signal an event a local waiter created; a late delivery
             # after discard_completion must not re-create (and leak) one
@@ -167,23 +261,28 @@ class TransferClient:
         packed: np.ndarray,
         timeout_s: float = 30.0,
         connect_timeout_s: float = 5.0,
+        head_start: int = 0,
+        head_count: Optional[int] = None,
     ) -> bool:
         """Ship packed blocks to a peer; True on acknowledged delivery.
-        Every stage is bounded: a stale/unroutable peer address must not
-        stall the (sequential) prefill worker."""
+        ``head_start/head_count`` tag a TP head slice (ops/kv_rearrange);
+        omitted means full heads. Every stage is bounded: a stale or
+        unroutable peer address must not stall the prefill worker."""
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(meta.host, meta.port),
             timeout=connect_timeout_s,
         )
         try:
-            header = json.dumps(
-                {
-                    "request_id": request_id,
-                    "hashes": [int(h) for h in hashes],
-                    "dtype": packed.dtype.name,
-                    "shape": list(packed.shape),
-                }
-            ).encode()
+            hdr: dict = {
+                "request_id": request_id,
+                "hashes": [int(h) for h in hashes],
+                "dtype": packed.dtype.name,
+                "shape": list(packed.shape),
+            }
+            if head_count is not None:
+                hdr["head_start"] = head_start
+                hdr["head_count"] = head_count
+            header = json.dumps(hdr).encode()
             writer.write(len(header).to_bytes(4, "big") + header)
             writer.write(packed.tobytes())
             await asyncio.wait_for(writer.drain(), timeout=timeout_s)

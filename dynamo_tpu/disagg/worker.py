@@ -232,6 +232,11 @@ async def _prefill_one(
     meta = await TransferClient.fetch_metadata(store, req.transfer_key)
     if meta is None:
         raise RuntimeError(f"no transfer metadata at {req.transfer_key}")
+    # Single-host: export all-gathers full heads over the mesh, so one put
+    # carries the whole block regardless of this worker's TP degree. A
+    # multi-host prefill rank ships only its local slice instead, tagged
+    # head_start/head_count; the decode side assembles (ops/kv_rearrange,
+    # ≈ reference Triton kv_rearrange for prefill-TP ≠ decode-TP).
     ok = await TransferClient.put(meta, req.request_id, found, packed)
     if not ok:
         raise RuntimeError("transfer rejected by decode worker")

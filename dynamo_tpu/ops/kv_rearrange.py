@@ -1,0 +1,126 @@
+"""KV layout rearrange between differing tensor-parallel degrees.
+
+The reference ships Triton kernels that rearrange KV cache layout when a
+prefill engine's TP degree differs from the decode engine's (vLLM patch
+``kv_rearrange.py``, vllm_v0.8.4-dynamo-kv-disagg-patch.patch:914-1046,
+used by the NIXL connector so a TP1 prefill worker can feed a TP4 decode
+worker). On GPU this needs a custom kernel because each rank's cache is
+a strided slab in its own VRAM.
+
+On TPU the equivalent is a *logical* transform: packed blocks are
+``[N, 2, L, block_size, Hkv, Dh]`` and a TP rank owns a contiguous head
+range, so resharding between TP degrees is slicing/concatenation on the
+head axis — XLA lowers the on-device variant to a relayout, and the
+host-staged transfer plane applies the numpy variant. The functions here
+are the single source of truth for how head ranges map to ranks.
+
+Supported degrees: ``Hkv % tp == 0`` (each rank owns ``Hkv/tp`` heads)
+or ``tp % Hkv == 0`` (heads replicated over ``tp/Hkv`` ranks; rank
+``r`` serves head ``r // (tp//Hkv)`` and only the first replica of each
+head is a *primary* shipper — mirrors the reference where replicated
+ranks hold identical KV).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+HEAD_AXIS = -2  # [..., Hkv, Dh]
+
+
+def head_range(num_kv_heads: int, tp: int, rank: int) -> tuple[int, int]:
+    """(start, count) of KV heads owned by ``rank`` in a ``tp``-way shard."""
+    if not 0 <= rank < tp:
+        raise ValueError(f"rank {rank} out of range for tp={tp}")
+    if num_kv_heads % tp == 0:
+        per = num_kv_heads // tp
+        return rank * per, per
+    if tp % num_kv_heads == 0:
+        # replicated: each head is held by tp/Hkv consecutive ranks
+        return rank // (tp // num_kv_heads), 1
+    raise ValueError(f"incompatible tp={tp} for {num_kv_heads} KV heads")
+
+
+def is_primary_rank(num_kv_heads: int, tp: int, rank: int) -> bool:
+    """Whether ``rank`` is the canonical shipper of its head range (always
+    true when heads shard evenly; first replica only when replicated)."""
+    head_range(num_kv_heads, tp, rank)  # same ValueError on bad combos
+    if num_kv_heads % tp == 0:
+        return True
+    return rank % (tp // num_kv_heads) == 0
+
+
+def extract_tp_shard(packed: np.ndarray, tp: int, rank: int) -> np.ndarray:
+    """Slice a full-head packed block batch down to ``rank``'s heads."""
+    num_kv_heads = packed.shape[HEAD_AXIS]
+    start, count = head_range(num_kv_heads, tp, rank)
+    return packed[..., start : start + count, :]
+
+
+def merge_tp_shards(shards: Sequence[np.ndarray], tp: int,
+                    num_kv_heads: int) -> np.ndarray:
+    """Reassemble full-head packed blocks from one shard per primary rank.
+
+    ``shards[i]`` must be the shard of the i-th *primary* rank, in rank
+    order (for even sharding that is every rank; for replicated heads,
+    one per distinct head).
+    """
+    primaries = [r for r in range(tp) if is_primary_rank(num_kv_heads, tp, r)]
+    if len(shards) != len(primaries):
+        raise ValueError(
+            f"expected {len(primaries)} primary shards for tp={tp}, "
+            f"got {len(shards)}"
+        )
+    full = np.concatenate(list(shards), axis=HEAD_AXIS)
+    if full.shape[HEAD_AXIS] != num_kv_heads:
+        raise ValueError(
+            f"merged heads {full.shape[HEAD_AXIS]} != {num_kv_heads}"
+        )
+    return full
+
+
+def rearrange_tp(shards: Sequence[np.ndarray], tp_src: int, tp_dst: int,
+                 num_kv_heads: int) -> list[np.ndarray]:
+    """Re-split source-TP shards into destination-TP shards.
+
+    The host-side equivalent of the reference's Triton rearrange: takes
+    one packed-block shard per source primary rank and returns one per
+    destination rank (replicas duplicated so every dst rank gets its
+    copy).
+    """
+    full = merge_tp_shards(shards, tp_src, num_kv_heads)
+    return [extract_tp_shard(full, tp_dst, r) for r in range(tp_dst)]
+
+
+def rearrange_tp_device(stacked, tp_src: int, tp_dst: int):
+    """On-device (jit-friendly) variant for even sharding.
+
+    ``stacked`` is ``[tp_src, ..., Hkv/tp_src, Dh]`` (source shards
+    stacked on a leading axis); returns ``[tp_dst, ..., Hkv/tp_dst, Dh]``.
+    Pure reshapes — XLA lowers this to a relayout/collective depending on
+    sharding, which is exactly the Pallas-free TPU answer to the
+    reference's custom kernel.
+    """
+    import jax.numpy as jnp
+
+    per_src = stacked.shape[HEAD_AXIS]
+    num_kv_heads = tp_src * per_src
+    if num_kv_heads % tp_dst != 0:
+        raise ValueError(f"tp_dst={tp_dst} incompatible with {num_kv_heads} heads")
+    # [tp_src, ..., per_src, Dh] -> [..., Hkv, Dh]
+    full = jnp.concatenate(jnp.split(stacked, stacked.shape[0], axis=0),
+                           axis=HEAD_AXIS)[0]
+    # [..., Hkv, Dh] -> [tp_dst, ..., Hkv/tp_dst, Dh]
+    parts = jnp.split(full, tp_dst, axis=HEAD_AXIS)
+    return jnp.stack(parts, axis=0)
+
+
+def cast_packed(packed: np.ndarray, dst_dtype: np.dtype) -> np.ndarray:
+    """Cast packed blocks between float dtypes (bf16/f16/f32) on the host
+    path; identity if already right."""
+    dst = np.dtype(dst_dtype)
+    if packed.dtype == dst:
+        return packed
+    return packed.astype(dst)

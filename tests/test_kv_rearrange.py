@@ -1,0 +1,161 @@
+"""TP-mismatch KV resharding (reference: Triton kv_rearrange kernels,
+vLLM patch :914-1046; here a logical head-axis transform + transfer-plane
+assembly of per-rank head slices)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.disagg.transfer import TransferClient, TransferMetadata, TransferServer
+from dynamo_tpu.kvbm.layout import BlockLayout
+from dynamo_tpu.ops.kv_rearrange import (
+    cast_packed,
+    extract_tp_shard,
+    head_range,
+    is_primary_rank,
+    merge_tp_shards,
+    rearrange_tp,
+    rearrange_tp_device,
+)
+
+
+def _packed(n_blocks=3, L=2, bs=4, Hkv=8, Dh=5, dtype=np.float32):
+    rng = np.random.default_rng(0)
+    return rng.standard_normal((n_blocks, 2, L, bs, Hkv, Dh)).astype(dtype)
+
+
+def test_head_range_even_and_replicated():
+    assert head_range(8, 4, 1) == (2, 2)
+    assert head_range(8, 8, 7) == (7, 1)
+    # replicated: 2 heads over tp=8 -> 4 replicas each
+    assert head_range(2, 8, 0) == (0, 1)
+    assert head_range(2, 8, 3) == (0, 1)
+    assert head_range(2, 8, 4) == (1, 1)
+    assert is_primary_rank(2, 8, 0) and not is_primary_rank(2, 8, 1)
+    assert is_primary_rank(2, 8, 4)
+    assert is_primary_rank(8, 4, 3)  # even sharding: all primary
+    with pytest.raises(ValueError):
+        head_range(6, 4, 0)
+    with pytest.raises(ValueError):
+        head_range(8, 4, 4)
+
+
+def test_rearrange_tp_roundtrip():
+    full = _packed()
+    # tp1 -> tp4 -> tp2 -> merge back
+    tp4 = rearrange_tp([full], 1, 4, 8)
+    assert len(tp4) == 4 and tp4[0].shape[-2] == 2
+    tp2 = rearrange_tp(tp4, 4, 2, 8)
+    merged = merge_tp_shards(tp2, 2, 8)
+    np.testing.assert_array_equal(merged, full)
+    # replicated destination: every dst rank gets its (single) head copy
+    small = _packed(Hkv=2)
+    reps = rearrange_tp([small], 1, 4, 2)
+    assert len(reps) == 4
+    np.testing.assert_array_equal(reps[0], reps[1])
+    np.testing.assert_array_equal(reps[0], small[..., 0:1, :])
+
+
+def test_rearrange_tp_device_matches_numpy():
+    full = _packed(Hkv=8)
+    src = np.stack([extract_tp_shard(full, 2, r) for r in range(2)])
+    out = np.asarray(rearrange_tp_device(src, 2, 4))
+    want = np.stack(rearrange_tp(list(src), 2, 4, 8))
+    np.testing.assert_allclose(out, want)
+
+
+def test_cast_packed():
+    x = _packed(n_blocks=1, dtype=np.float32)
+    import ml_dtypes
+
+    y = cast_packed(x, np.dtype(ml_dtypes.bfloat16))
+    assert y.dtype == np.dtype(ml_dtypes.bfloat16)
+    assert cast_packed(y, y.dtype) is y
+
+
+async def test_transfer_head_slice_assembly_and_cast():
+    """Two TP2 prefill ranks ship f32 head slices; the server assembles
+    full-head blocks, casts to its bf16 layout, delivers exactly once."""
+    import ml_dtypes
+
+    layout = BlockLayout(num_layers=2, block_size=4, num_kv_heads=8,
+                         head_dim=5, dtype="bfloat16")
+    delivered: list[tuple[list[int], np.ndarray]] = []
+
+    async def deliver(hashes, packed):
+        delivered.append((hashes, packed))
+
+    server = TransferServer(deliver, layout)
+    await server.start()
+    try:
+        meta = TransferMetadata("127.0.0.1", server.port, 1, layout.to_json())
+        full = _packed(n_blocks=2, dtype=np.float32)
+        hashes = [11, 22]
+        ev = server.completion_event("r1")
+        for rank in range(2):
+            start, count = head_range(8, 2, rank)
+            ok = await TransferClient.put(
+                meta, "r1", hashes, extract_tp_shard(full, 2, rank),
+                head_start=start, head_count=count,
+            )
+            assert ok
+        await asyncio.wait_for(ev.wait(), 5)
+        assert len(delivered) == 1
+        got_hashes, got = delivered[0]
+        assert got_hashes == hashes
+        assert got.dtype == np.dtype(ml_dtypes.bfloat16)
+        np.testing.assert_allclose(
+            got.astype(np.float32), full.astype(ml_dtypes.bfloat16).astype(np.float32)
+        )
+        assert not server._assembling
+    finally:
+        await server.close()
+
+
+async def test_transfer_partial_budget_rejected_not_evicted():
+    """At the assembly byte budget, a NEW partial transfer is refused
+    (ok=false) while the in-flight assembly stays alive and completes."""
+    layout = BlockLayout(num_layers=1, block_size=2, num_kv_heads=2,
+                         head_dim=3, dtype="float32")
+    delivered = []
+
+    async def deliver(h, p):
+        delivered.append(h)
+
+    server = TransferServer(deliver, layout)
+    await server.start()
+    server.MAX_ASSEMBLY_BYTES = layout.block_bytes  # room for one 1-block asm
+    try:
+        meta = TransferMetadata("127.0.0.1", server.port, 1, layout.to_json())
+        full = _packed(n_blocks=1, L=1, bs=2, Hkv=2, Dh=3)
+        first = extract_tp_shard(full, 2, 0)
+        assert await TransferClient.put(meta, "a", [1], first,
+                                        head_start=0, head_count=1)
+        # budget exhausted: a second request's partial slice is rejected
+        assert not await TransferClient.put(meta, "b", [2], first,
+                                            head_start=0, head_count=1)
+        # ...but request "a" still completes
+        assert await TransferClient.put(
+            meta, "a", [1], extract_tp_shard(full, 2, 1),
+            head_start=1, head_count=1,
+        )
+        assert delivered == [[1]]
+        assert not server._assembling
+    finally:
+        await server.close()
+
+
+async def test_transfer_rejects_bad_head_slice():
+    layout = BlockLayout(num_layers=1, block_size=2, num_kv_heads=4,
+                         head_dim=3, dtype="float32")
+    server = TransferServer(lambda h, p: asyncio.sleep(0), layout)
+    await server.start()
+    try:
+        meta = TransferMetadata("127.0.0.1", server.port, 1, layout.to_json())
+        bad = np.zeros((1, 2, 1, 2, 3, 3), np.float32)  # 3 heads: no valid slice
+        ok = await TransferClient.put(meta, "r", [1], bad, head_start=2,
+                                      head_count=3)
+        assert not ok
+    finally:
+        await server.close()
