@@ -5,8 +5,8 @@ launch/dynamo-run/src/{lib.rs:45-278, opt.rs:23-216, flags.rs:1-205} —
 the in×out matrix; launch/llmctl — model registration ctl;
 components/http — standalone frontend).
 
-  dynamo-tpu run --in {http|text|dyn://NS.COMP.EP} \
-                 --out {echo_core|echo_full|jax|dyn://NS.COMP.EP} \
+  dynamo-tpu run --in {http|text|stdin|batch:F|dyn://NS.COMP.EP} \
+                 --out {echo_core|echo_full|jax|pystr:F|dyn://NS.COMP.EP} \
                  [--model-path DIR] [--model-name NAME] ...
 
   dynamo-tpu store            # run the coordinator (replaces etcd+NATS)
@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import os
 import sys
 from typing import Any, Optional
 
@@ -38,9 +39,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     run = sub.add_parser("run", help="run an input×output engine pairing")
     run.add_argument("--in", dest="in_mode", default="http",
-                     help="http | text | dyn://ns.comp.ep (serve as worker)")
+                     help="http | text | stdin | batch:FILE.jsonl | "
+                          "dyn://ns.comp.ep (serve as worker)")
     run.add_argument("--out", dest="out_mode", default="echo_full",
-                     help="echo_core | echo_full | jax | dyn://ns.comp.ep")
+                     help="echo_core | echo_full | jax | pystr:FILE.py | "
+                          "dyn://ns.comp.ep")
+    run.add_argument("--batch-output", default=None,
+                     help="output path for --in batch: (default "
+                          "INPUT.output.jsonl)")
     run.add_argument("--model-path", default=None,
                      help="local model directory (tokenizer/config/weights)")
     run.add_argument("--model-name", default=None)
@@ -206,6 +212,13 @@ async def cmd_run(args: Any) -> None:
 
         model_name = args.model_name or "echo"
         engine = EchoEngineFull()
+    elif out.startswith("pystr:"):
+        # user python file hosted as a text-in/text-out engine
+        from dynamo_tpu.engines import PythonStrEngine
+
+        path = out[len("pystr:"):]
+        model_name = args.model_name or os.path.splitext(os.path.basename(path))[0]
+        engine = PythonStrEngine(path)
     elif out.startswith(DYN_SCHEME):
         # remote worker(s) behind a push router
         from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
@@ -266,6 +279,11 @@ async def cmd_run(args: Any) -> None:
         await asyncio.Event().wait()
     elif in_mode == "text":
         await _interactive_text(engine, model_name)
+    elif in_mode == "stdin":
+        await _stdin_once(engine, model_name, args.max_tokens_default)
+    elif in_mode.startswith("batch:"):
+        await _batch_file(engine, model_name, in_mode[len("batch:"):],
+                          args.batch_output, args.max_tokens_default)
     elif in_mode.startswith(DYN_SCHEME):
         # worker mode: serve the core engine on an endpoint
         from dynamo_tpu.runtime.runtime import DistributedRuntime
@@ -399,6 +417,106 @@ async def _interactive_text(engine: Any, model_name: str) -> None:
                     print(choice.delta.content, end="", flush=True)
         print()
         messages.append({"role": "assistant", "content": "".join(reply_parts)})
+
+
+async def _stdin_once(engine: Any, model_name: str,
+                      max_tokens: Optional[int] = None) -> None:
+    """Read all of stdin as one prompt, stream the completion, exit
+    (reference: dynamo-run in=stdin)."""
+    from dynamo_tpu.protocols.openai import CompletionRequest
+    from dynamo_tpu.runtime.engine import Context
+
+    loop = asyncio.get_running_loop()
+    prompt = await loop.run_in_executor(None, sys.stdin.read)
+    if not prompt.strip():
+        raise SystemExit("empty prompt on stdin")
+    body = {"model": model_name, "prompt": prompt, "stream": True}
+    if max_tokens is not None:
+        body["max_tokens"] = max_tokens
+    req = CompletionRequest.model_validate(body)
+    async for chunk in engine.generate(req, Context()):
+        for choice in chunk.choices:
+            if choice.text:
+                print(choice.text, end="", flush=True)
+    print()
+
+
+async def _batch_file(engine: Any, model_name: str, path: str,
+                      out_path: Optional[str],
+                      max_tokens: Optional[int]) -> None:
+    """Run a JSONL batch of prompts and write responses + timings
+    (reference: dynamo-run in=batch: — input/batch.rs; lines are
+    {"text": ...}, output lines add response/tokens/latency)."""
+    import json
+    import time
+
+    from dynamo_tpu.protocols.openai import CompletionRequest
+    from dynamo_tpu.runtime.engine import Context
+
+    with open(path) as f:
+        prompts = [json.loads(line) for line in f if line.strip()]
+    if not prompts:
+        raise SystemExit(f"no prompts in {path}")
+    for i, entry in enumerate(prompts):
+        if not isinstance(entry, dict) or not isinstance(entry.get("text"), str):
+            raise SystemExit(
+                f"{path} line {i + 1}: expected {{\"text\": \"...\"}}"
+            )
+    out_path = out_path or path + ".output.jsonl"
+    sem = asyncio.Semaphore(32)
+
+    async def one(i: int, entry: dict) -> dict:
+        async with sem:
+            # clock starts only once a slot is held: timings report engine
+            # latency, not client-side queue wait
+            body = {"model": model_name, "prompt": entry["text"], "stream": True}
+            if max_tokens is not None:
+                body["max_tokens"] = max_tokens
+            req = CompletionRequest.model_validate(body)
+            parts: list[str] = []
+            n_chunks = 0
+            t0 = time.monotonic()
+            t_first = None
+            async for chunk in engine.generate(req, Context()):
+                for choice in chunk.choices:
+                    if choice.text:
+                        if t_first is None:
+                            t_first = time.monotonic()
+                        parts.append(choice.text)
+                        n_chunks += 1
+            t1 = time.monotonic()
+        return {
+            "index": i,
+            "text": entry["text"],
+            "response": "".join(parts),
+            "chunks": n_chunks,
+            "ttft_ms": round(((t_first or t1) - t0) * 1000, 1),
+            "total_ms": round((t1 - t0) * 1000, 1),
+        }
+
+    t0 = time.monotonic()
+    results = await asyncio.gather(
+        *[one(i, e) for i, e in enumerate(prompts)],
+        return_exceptions=True,
+    )
+    wall = time.monotonic() - t0
+    n_err = 0
+    with open(out_path, "w") as f:
+        for i, r in enumerate(results):
+            if isinstance(r, BaseException):
+                n_err += 1
+                r = {"index": i, "text": prompts[i]["text"], "error": str(r)}
+            f.write(json.dumps(r) + "\n")
+    done = [r for r in results if not isinstance(r, BaseException)]
+    total_chunks = sum(r["chunks"] for r in done)
+    print(
+        f"batch done: {len(done)}/{len(results)} prompts "
+        f"({n_err} errors), {total_chunks} chunks, "
+        f"{wall:.2f}s -> {out_path}",
+        flush=True,
+    )
+    if n_err:
+        raise SystemExit(1)
 
 
 def _runtime_config(args: Any) -> RuntimeConfig:

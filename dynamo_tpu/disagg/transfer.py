@@ -30,6 +30,7 @@ landed. Mixed float dtypes are cast to the receiver's layout dtype.
 from __future__ import annotations
 
 import asyncio
+import collections
 import json
 import logging
 import time
@@ -115,6 +116,14 @@ class TransferServer:
         self._assembling: dict[tuple, _HeadAssembler] = {}
         self.MAX_ASSEMBLY_BYTES = 1 << 30
         self.ASSEMBLER_TTL_S = 120.0
+        # keys whose assembly was purged/abandoned: late slices for them
+        # must be REJECTED (ok=false), not silently re-seeded — earlier
+        # slices were acked and lost, so a fresh assembly could never
+        # complete while both senders believe they succeeded
+        self._dead_keys: "collections.OrderedDict[tuple, None]" = (
+            collections.OrderedDict()
+        )
+        self.MAX_DEAD_KEYS = 1024
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -129,7 +138,13 @@ class TransferServer:
         self._done.pop(request_id, None)
         # drop any partial assembly for the abandoned request too
         for key in [k for k in self._assembling if k[0] == request_id]:
-            del self._assembling[key]
+            self._kill_assembly(key)
+
+    def _kill_assembly(self, key: tuple) -> None:
+        del self._assembling[key]
+        self._dead_keys[key] = None
+        while len(self._dead_keys) > self.MAX_DEAD_KEYS:
+            self._dead_keys.popitem(last=False)
 
     def _purge_stale_assemblers(self) -> None:
         now = time.monotonic()
@@ -138,7 +153,7 @@ class TransferServer:
             if now - a.created > self.ASSEMBLER_TTL_S
         ]:
             log.warning("dropping expired partial transfer %s", key[0])
-            del self._assembling[key]
+            self._kill_assembly(key)
 
     def _assembly_bytes(self) -> int:
         return sum(a.data.nbytes for a in self._assembling.values())
@@ -185,6 +200,10 @@ class TransferServer:
             else:
                 akey = (header.get("request_id", ""), tuple(hashes))
                 asm = self._assembling.get(akey)
+                if asm is None and akey in self._dead_keys:
+                    raise ValueError(
+                        "late slice for a purged/abandoned assembly"
+                    )
                 if asm is None:
                     self._purge_stale_assemblers()
                     new_bytes = (

@@ -69,6 +69,68 @@ class EchoEngineCore(AsyncEngine):
         return self._gen(request, context)
 
 
+class PythonStrEngine(AsyncEngine):
+    """Hosts a user Python file as a text-in/text-out streaming engine
+    (reference: lib/engines/python hosting a user generator as a
+    StreamingEngine, lib/engines/python/src/lib.rs:77-132; CLI
+    ``out=pystr:<file.py>``).
+
+    The file must define ``async def generate(request)`` yielding string
+    deltas. ``request`` is a plain dict: ``{"model", "messages"|"prompt",
+    "max_tokens", "temperature"}`` — the OpenAI request flattened to what
+    a bring-your-own-engine script needs.
+    """
+
+    def __init__(self, path: str):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("dynamo_pystr_engine", path)
+        if spec is None or spec.loader is None:
+            raise ValueError(f"cannot load python engine from {path!r}")
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        if not hasattr(module, "generate"):
+            raise ValueError(f"{path!r} defines no generate()")
+        self._generate = module.generate
+        self.path = path
+
+    async def _gen(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        from dynamo_tpu.protocols.openai import (
+            ChatCompletionRequest,
+            ChatDeltaGenerator,
+            CompletionDeltaGenerator,
+            CompletionRequest,
+        )
+
+        payload: dict[str, Any] = {"model": getattr(request, "model", "")}
+        if isinstance(request, ChatCompletionRequest):
+            payload["messages"] = [
+                {"role": m.role, "content": m.text_content()}
+                for m in request.messages
+            ]
+            gen = ChatDeltaGenerator(model=request.model)
+        else:
+            assert isinstance(request, CompletionRequest)
+            if not isinstance(request.prompt, str):
+                # list-of-prompts / token-id forms would silently become
+                # "" — surface a client error instead
+                raise ValueError("pystr engine requires a string prompt")
+            payload["prompt"] = request.prompt
+            gen = CompletionDeltaGenerator(model=request.model)
+        for field in ("max_tokens", "temperature"):
+            val = getattr(request, field, None)
+            if val is not None:
+                payload[field] = val
+        async for delta in self._generate(payload):
+            if context.is_stopped:
+                break
+            yield gen.text_chunk(str(delta))
+        yield gen.finish_chunk(FinishReason.STOP)
+
+    def generate(self, request: Any, context: Context) -> EngineStream:
+        return self._gen(request, context)
+
+
 class EchoEngineFull(AsyncEngine):
     """OpenAI-in/OpenAI-out echo: no tokenization at all; streams the last
     message's text back word by word."""

@@ -1,0 +1,86 @@
+"""run-CLI in×out matrix additions: stdin / batch: inputs, pystr: output
+(reference: launch/dynamo-run opt.rs in/out matrix; lib/engines/python
+python-hosted engine)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dynamo_tpu.engines import EchoEngineFull, PythonStrEngine
+from dynamo_tpu.protocols.openai import ChatCompletionRequest, CompletionRequest
+from dynamo_tpu.runtime.engine import Context
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PYSTR_SRC = '''\
+async def generate(request):
+    prompt = request.get("prompt") or request["messages"][-1]["content"]
+    for word in prompt.split():
+        yield word.upper() + " "
+'''
+
+
+@pytest.fixture
+def pystr_file(tmp_path):
+    p = tmp_path / "upper_engine.py"
+    p.write_text(PYSTR_SRC)
+    return str(p)
+
+
+async def test_pystr_engine_completion_and_chat(pystr_file):
+    eng = PythonStrEngine(pystr_file)
+    req = CompletionRequest.model_validate(
+        {"model": "m", "prompt": "hello tpu world"}
+    )
+    parts = []
+    async for chunk in eng.generate(req, Context()):
+        parts.append(chunk.choices[0].text)
+    assert "".join(parts).split() == ["HELLO", "TPU", "WORLD"]
+
+    creq = ChatCompletionRequest.model_validate(
+        {"model": "m", "messages": [{"role": "user", "content": "hi there"}]}
+    )
+    got = []
+    async for chunk in eng.generate(creq, Context()):
+        if chunk.choices[0].delta.content:
+            got.append(chunk.choices[0].delta.content)
+    assert "".join(got).split() == ["HI", "THERE"]
+
+
+def test_pystr_engine_rejects_bad_file(tmp_path):
+    p = tmp_path / "no_gen.py"
+    p.write_text("x = 1\n")
+    with pytest.raises(ValueError, match="generate"):
+        PythonStrEngine(str(p))
+
+
+async def test_batch_file_writes_results(tmp_path):
+    from dynamo_tpu.cli.main import _batch_file
+
+    inp = tmp_path / "prompts.jsonl"
+    inp.write_text(
+        "\n".join(json.dumps({"text": f"prompt number {i}"}) for i in range(3))
+    )
+    out = tmp_path / "out.jsonl"
+    await _batch_file(EchoEngineFull(), "echo", str(inp), str(out), None)
+    lines = [json.loads(line) for line in out.read_text().splitlines()]
+    assert len(lines) == 3
+    by_idx = {r["index"]: r for r in lines}
+    assert by_idx[1]["response"].strip() == "prompt number 1"
+    assert by_idx[1]["ttft_ms"] >= 0 and by_idx[1]["chunks"] == 3
+
+
+def test_stdin_pystr_subprocess(pystr_file, tmp_path):
+    """Full CLI process: echo prompt | run --in stdin --out pystr:..."""
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.cli.main", "run",
+         "--in", "stdin", "--out", f"pystr:{pystr_file}", "--static"],
+        input="round trip", capture_output=True, text=True, env=env,
+        cwd=str(tmp_path), timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.split() == ["ROUND", "TRIP"]

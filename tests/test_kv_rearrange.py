@@ -146,6 +146,45 @@ async def test_transfer_partial_budget_rejected_not_evicted():
         await server.close()
 
 
+async def test_late_slice_after_abandon_rejected():
+    """A slice arriving after its assembly was abandoned must be refused
+    (its sibling slices were acked then dropped — re-seeding could never
+    complete while both senders saw success)."""
+    layout = BlockLayout(num_layers=1, block_size=2, num_kv_heads=2,
+                         head_dim=3, dtype="float32")
+    server = TransferServer(lambda h, p: asyncio.sleep(0), layout)
+    await server.start()
+    try:
+        meta = TransferMetadata("127.0.0.1", server.port, 1, layout.to_json())
+        full = _packed(n_blocks=1, L=1, bs=2, Hkv=2, Dh=3)
+        assert await TransferClient.put(
+            meta, "gone", [5], extract_tp_shard(full, 2, 0),
+            head_start=0, head_count=1,
+        )
+        server.discard_completion("gone")  # request abandoned
+        assert not await TransferClient.put(
+            meta, "gone", [5], extract_tp_shard(full, 2, 1),
+            head_start=1, head_count=1,
+        )
+        assert not server._assembling
+    finally:
+        await server.close()
+
+
+async def test_batch_file_error_isolation(tmp_path):
+    """One failing prompt must not discard the batch (gather isolates
+    errors; bad lines are rejected at load)."""
+    import json as _json
+
+    from dynamo_tpu.cli.main import _batch_file
+    from dynamo_tpu.engines import EchoEngineFull
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"prompt": "wrong key"}\n')
+    with pytest.raises(SystemExit, match="line 1"):
+        await _batch_file(EchoEngineFull(), "echo", str(bad), None, None)
+
+
 async def test_transfer_rejects_bad_head_slice():
     layout = BlockLayout(num_layers=1, block_size=2, num_kv_heads=4,
                          head_dim=3, dtype="float32")
