@@ -25,6 +25,10 @@ class ModelConfig:
     tie_word_embeddings: bool = False
     bos_token_id: int = 1
     eos_token_id: int | list[int] = 2
+    # qwen2-family: bias on q/k/v projections
+    attention_bias: bool = False
+    # mistral-family: attend only to the last `sliding_window` positions
+    sliding_window: Optional[int] = None
     # MoE (Mixtral-style)
     num_local_experts: int = 0
     num_experts_per_tok: int = 2
@@ -54,4 +58,11 @@ class ModelConfig:
     def from_dict(cls, raw: dict) -> "ModelConfig":
         known = {f.name for f in cls.__dataclass_fields__.values()}  # type: ignore[attr-defined]
         kwargs = {k: v for k, v in raw.items() if k in known}
+        # qwen2 checkpoints always use qkv bias but don't say so in config
+        if raw.get("model_type") == "qwen2" and "attention_bias" not in raw:
+            kwargs["attention_bias"] = True
+        # qwen2 configs carry sliding_window alongside
+        # use_sliding_window=false: HF semantics disable SWA then
+        if raw.get("use_sliding_window") is False:
+            kwargs["sliding_window"] = None
         return cls(**kwargs)

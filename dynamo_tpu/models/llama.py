@@ -1,5 +1,9 @@
 """Llama-family decoder in pure functional JAX with paged KV cache.
 
+Covers the Llama-architecture family the reference serves through its
+engines: Llama/DeepSeek-R1-Distill, Mistral (sliding-window attention),
+Qwen2 (QKV bias), and Mixtral-style MoE — one decoder, config-driven.
+
 The flagship native engine model (reference analogue: the external vLLM
 engine the reference shells out to — here the model is first-class,
 SURVEY.md §7 step 4). Design choices for TPU:
@@ -61,6 +65,14 @@ def param_shapes(cfg: ModelConfig) -> dict[str, tuple[tuple[int, ...], Any]]:
         "final_norm": ((D,), jnp.float32),
         "lm_head": ((D, V), bf16),
     }
+    if cfg.attention_bias:
+        shapes.update(
+            {
+                "bq": ((L, H * Dh), bf16),
+                "bk": ((L, Hk * Dh), bf16),
+                "bv": ((L, Hk * Dh), bf16),
+            }
+        )
     if cfg.is_moe:
         E = cfg.num_local_experts
         shapes.update(
@@ -95,6 +107,10 @@ def param_specs(cfg: ModelConfig) -> dict[str, P]:
         "final_norm": P(None),
         "lm_head": P(None, "tp"),
     }
+    if cfg.attention_bias:
+        specs.update(
+            {"bq": P(None, "tp"), "bk": P(None, "tp"), "bv": P(None, "tp")}
+        )
     if cfg.is_moe:
         specs.update(
             {
@@ -204,11 +220,13 @@ def paged_attention_reference(
     positions: jax.Array,  # [B, T] absolute positions of the queries
     context_lens: jax.Array,  # [B] total valid tokens per sequence
     block_size: int,
+    sliding_window: Optional[int] = None,
 ) -> jax.Array:
     """Gather-then-attend paged attention (XLA reference path).
 
     Works on any backend; the Pallas kernel (ops/paged_attention.py) is the
-    TPU fast path with identical semantics.
+    TPU fast path with identical semantics. ``sliding_window`` masks keys
+    older than the window (Mistral-family).
     """
     B, T, H, Dh = q.shape
     Hk = k_cache_l.shape[-2]
@@ -232,6 +250,8 @@ def paged_attention_reference(
     mask = (key_pos <= positions[:, None, :, None]) & (
         key_pos < context_lens[:, None, None, None]
     )
+    if sliding_window is not None:
+        mask &= key_pos > positions[:, None, :, None] - sliding_window
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bhts,bshd->bthd", probs, vals)  # [B, T, H, Dh]
@@ -280,14 +300,19 @@ def make_layer_fn(
         lp, k_cache_l, v_cache_l = scanned
         # attention
         h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = (h @ lp["wq"]).reshape(B, T, H, Dh)
-        k = (h @ lp["wk"]).reshape(B, T, Hk, Dh)
-        v = (h @ lp["wv"]).reshape(B, T, Hk, Dh)
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if cfg.attention_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(B, T, H, Dh)
+        k = k.reshape(B, T, Hk, Dh)
+        v = v.reshape(B, T, Hk, Dh)
         q, k = rope(q, k, positions, cfg.rope_theta)
         # write new kv into the paged cache
         k_cache_l = k_cache_l.at[slot_mapping].set(k.reshape(B * T, Hk, Dh))
         v_cache_l = v_cache_l.at[slot_mapping].set(v.reshape(B * T, Hk, Dh))
-        if T == 1 and attn_impl() == "pallas":
+        if T == 1 and cfg.sliding_window is None and attn_impl() == "pallas":
             from dynamo_tpu.ops.paged_attention import paged_attention_decode
 
             attn = paged_attention_decode(
@@ -297,7 +322,7 @@ def make_layer_fn(
         else:
             attn = paged_attention_reference(
                 q, k_cache_l, v_cache_l, block_tables, positions,
-                context_lens, block_size,
+                context_lens, block_size, cfg.sliding_window,
             )
         x = x + (attn.reshape(B, T, H * Dh) @ lp["wo"]).astype(x.dtype)
         # mlp

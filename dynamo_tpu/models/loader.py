@@ -37,6 +37,10 @@ _LAYER_MAP = {
     "w_gate": ("model.layers.{i}.mlp.gate_proj.weight", True),
     "w_up": ("model.layers.{i}.mlp.up_proj.weight", True),
     "w_down": ("model.layers.{i}.mlp.down_proj.weight", True),
+    # qwen2-family QKV biases (only read when cfg.attention_bias)
+    "bq": ("model.layers.{i}.self_attn.q_proj.bias", False),
+    "bk": ("model.layers.{i}.self_attn.k_proj.bias", False),
+    "bv": ("model.layers.{i}.self_attn.v_proj.bias", False),
 }
 # Mixtral-style MoE: router + per-expert w1(gate)/w3(up)/w2(down)
 _MOE_LAYER_MAP = {
@@ -50,6 +54,9 @@ _MOE_LAYER_MAP = {
     "w_gate": ("model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight", True),
     "w_up": ("model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight", True),
     "w_down": ("model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight", True),
+    "bq": ("model.layers.{i}.self_attn.q_proj.bias", False),
+    "bk": ("model.layers.{i}.self_attn.k_proj.bias", False),
+    "bv": ("model.layers.{i}.self_attn.v_proj.bias", False),
 }
 _GLOBAL_MAP = {
     "embed": ("model.embed_tokens.weight", False),
@@ -155,5 +162,10 @@ def load_params(
                 arr = _to_jax(ckpt.get(tmpl.format(i=i)), shapes[name][1])
                 per_layer.append(arr.T if transpose else arr)
         params[name] = put(name, jnp.stack(per_layer))
+    missing = set(shapes) - set(params)
+    if missing:
+        raise ValueError(
+            f"checkpoint {model_dir} missing params: {sorted(missing)}"
+        )
     log.info("loaded %d params from %s", len(params), model_dir)
     return params
