@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import functools
+import hashlib
 import logging
 import os
 import queue as thread_queue
@@ -52,7 +53,7 @@ from dynamo_tpu.models.llama import (
 from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
 from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
-from dynamo_tpu.tokens import TokenBlockSequence
+from dynamo_tpu.tokens import DEFAULT_SALT, TokenBlockSequence
 
 log = logging.getLogger("dynamo_tpu.engine")
 
@@ -87,6 +88,7 @@ class JaxEngine:
         self.kvbm: Optional[KvBlockManager] = None
         self.eos_token_ids: list[int] = []
         self._step_fn: Optional[Callable] = None
+        self._step_fn_mm: Optional[Callable] = None
         self._thread: Optional[threading.Thread] = None
         self._incoming: thread_queue.Queue = thread_queue.Queue()
         self._control: thread_queue.Queue = thread_queue.Queue()
@@ -284,6 +286,7 @@ class JaxEngine:
             top_k,
             top_p,
             seeds,
+            *mm_args,  # optionally (extra_embeds, embeds_mask)
         ):
             logits, new_k, new_v = forward(
                 mc,
@@ -297,16 +300,20 @@ class JaxEngine:
                 context_lens,
                 last_token_idx,
                 block_size,
+                *mm_args,
             )
             next_tokens, logprobs = sample(logits, temperature, top_k, top_p, seeds)
             return next_tokens, logprobs, new_k, new_v
 
-        # donate the caches: XLA aliases them in-place
+        # donate the caches: XLA aliases them in-place. One jitted fn
+        # serves both arities (jit retraces per signature); the
+        # multimodal variant compiles only if a request uses it.
         self._step_fn = jax.jit(step, donate_argnums=(1, 2))
+        self._step_fn_mm = self._step_fn
 
     def _run_device_step(self, arrays: dict[str, np.ndarray], sampling: SamplingBatch):
         assert self._step_fn is not None
-        next_tokens, logprobs, self.k_cache, self.v_cache = self._step_fn(
+        base_args = (
             self.params,
             self.k_cache,
             self.v_cache,
@@ -321,6 +328,14 @@ class JaxEngine:
             sampling.top_p,
             sampling.seeds,
         )
+        if "extra_embeds" in arrays:
+            next_tokens, logprobs, self.k_cache, self.v_cache = self._step_fn_mm(
+                *base_args, arrays["extra_embeds"], arrays["embeds_mask"]
+            )
+        else:
+            next_tokens, logprobs, self.k_cache, self.v_cache = self._step_fn(
+                *base_args
+            )
         return np.asarray(next_tokens), np.asarray(logprobs)
 
     # ------------------------------------------------------------------
@@ -577,13 +592,44 @@ class JaxEngine:
         def emit(item) -> None:
             loop.call_soon_threadsafe(out.put_nowait, item)
 
+        mm_segments = []
+        salt = DEFAULT_SALT
+        if request.mm_embeds:
+            from dynamo_tpu.multimodal.embeds import unpack_segments
+
+            # Validate HERE, where a bad request errors on its own — a
+            # malformed shape surfacing inside the jitted step would
+            # fail every in-flight request (_fail_all).
+            mm_segments = unpack_segments(request.mm_embeds)
+            assert self.model_config is not None
+            D = self.model_config.hidden_size
+            for offset, arr in mm_segments:
+                if arr.shape[1] != D:
+                    raise ValueError(
+                        f"mm embedding dim {arr.shape[1]} != model hidden {D}"
+                    )
+                if not (0 <= offset and offset + arr.shape[0] <= len(request.token_ids)):
+                    raise ValueError(
+                        f"mm segment [{offset},+{arr.shape[0]}) outside prompt "
+                        f"of {len(request.token_ids)} tokens"
+                    )
+            # Salt the block hashes with the embedding content: two
+            # prompts with identical placeholder tokens but different
+            # images must NOT share prefix-cache KV (and must not match
+            # text-only requests either).
+            h = hashlib.blake2b(digest_size=8)
+            for offset, arr in mm_segments:
+                h.update(offset.to_bytes(8, "little"))
+                h.update(np.ascontiguousarray(arr).tobytes())
+            salt = DEFAULT_SALT ^ int.from_bytes(h.digest(), "little")
         seq = Sequence(
             request=request,
             tokens=TokenBlockSequence(
-                request.token_ids, block_size=self.config.block_size
+                request.token_ids, block_size=self.config.block_size, salt=salt
             ),
             emit=emit,
             is_cancelled=lambda: context.is_stopped,
+            mm_segments=mm_segments,
         )
         self._incoming.put(seq)
         self._wake.set()

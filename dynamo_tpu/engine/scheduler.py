@@ -58,6 +58,8 @@ class Sequence:
     emit: Optional[Callable] = None  # called with LLMEngineOutput-shaped dicts
     is_cancelled: Optional[Callable[[], bool]] = None
     finish_reason: Optional[FinishReason] = None
+    # multimodal: [(token offset, embeds[n, D])] to inject during prefill
+    mm_segments: list = field(default_factory=list)
 
     @property
     def request_id(self) -> str:
@@ -344,7 +346,7 @@ class Scheduler:
             slot_mapping[j] = seq.block_table[pos // bs] * bs + pos % bs
         tables = np.zeros((1, width), np.int32)
         tables[0, : len(seq.block_table)] = seq.block_table
-        return {
+        arrays = {
             "tokens": tokens,
             "positions": positions,
             "slot_mapping": slot_mapping,
@@ -352,6 +354,36 @@ class Scheduler:
             "context_lens": np.asarray([work.start_pos + t], np.int32),
             "last_token_idx": np.asarray([t - 1], np.int32),
         }
+        mm = self._mm_chunk_arrays(seq, work.start_pos, t, T)
+        if mm is not None:
+            arrays.update(mm)
+        return arrays
+
+    @staticmethod
+    def _mm_chunk_arrays(
+        seq: Sequence, start: int, t: int, T: int
+    ) -> Optional[dict[str, np.ndarray]]:
+        """Embedding-injection arrays for the chunk [start, start+t), or
+        None if no multimodal segment overlaps it (models/llama.py
+        forward(extra_embeds=, embeds_mask=))."""
+        if not seq.mm_segments:
+            return None
+        end = start + t
+        D = seq.mm_segments[0][1].shape[-1]
+        extra = np.zeros((1, T, D), np.float32)
+        mask = np.zeros((1, T), bool)
+        hit = False
+        for offset, arr in seq.mm_segments:
+            lo = max(start, offset)
+            hi = min(end, offset + arr.shape[0])
+            if lo >= hi:
+                continue
+            hit = True
+            extra[0, lo - start : hi - start] = arr[lo - offset : hi - offset]
+            mask[0, lo - start : hi - start] = True
+        if not hit:
+            return None
+        return {"extra_embeds": extra, "embeds_mask": mask}
 
     def build_decode_arrays(self, seqs: list[Sequence]) -> dict[str, np.ndarray]:
         bs = self.block_size

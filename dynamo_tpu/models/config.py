@@ -61,8 +61,12 @@ class ModelConfig:
         # qwen2 checkpoints always use qkv bias but don't say so in config
         if raw.get("model_type") == "qwen2" and "attention_bias" not in raw:
             kwargs["attention_bias"] = True
-        # qwen2 configs carry sliding_window alongside
-        # use_sliding_window=false: HF semantics disable SWA then
-        if raw.get("use_sliding_window") is False:
+        # qwen2 configs carry sliding_window but HF defaults
+        # use_sliding_window to FALSE: the window only applies when the
+        # flag is explicitly true (mistral-family configs have no such
+        # flag and the window always applies)
+        if raw.get("model_type") == "qwen2" and not raw.get("use_sliding_window", False):
+            kwargs["sliding_window"] = None
+        elif raw.get("use_sliding_window") is False:
             kwargs["sliding_window"] = None
         return cls(**kwargs)

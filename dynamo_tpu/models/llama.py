@@ -353,9 +353,20 @@ def forward(
     context_lens: jax.Array,  # [B] int32 valid tokens incl. new ones
     last_token_idx: jax.Array,  # [B] int32 index of last real token in T
     block_size: int,
+    extra_embeds: Optional[jax.Array] = None,  # [B, T, D] injected embeds
+    embeds_mask: Optional[jax.Array] = None,  # [B, T] bool: use injected
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """One model step. Returns (logits[B, V], new_k_cache, new_v_cache)."""
+    """One model step. Returns (logits[B, V], new_k_cache, new_v_cache).
+
+    ``extra_embeds``/``embeds_mask`` splice precomputed embeddings (image
+    patches from models/vision.py) over the token embeddings at masked
+    positions — the multimodal injection point (reference:
+    examples/multimodal encode-worker → LLM embedding handoff).
+    """
     x = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
+    if extra_embeds is not None:
+        assert embeds_mask is not None
+        x = jnp.where(embeds_mask[..., None], extra_embeds.astype(x.dtype), x)
 
     layer_params = {k: params[k] for k in layer_param_names(params)}
     layer_fn = make_layer_fn(

@@ -1,0 +1,162 @@
+"""ViT vision tower + projector for vision-language serving.
+
+The native analogue of the reference's multimodal pipeline (reference:
+examples/multimodal — a dedicated encode worker runs a vision encoder
+and ships image embeddings to the LLM worker, which injects them at
+``<image>`` placeholder positions). Here the tower is a functional JAX
+ViT in the same style as models/llama.py: layers stacked on a leading
+axis, one ``lax.scan`` over the transformer body, bf16 matmuls with f32
+layernorms/softmax. Patchify is a reshape + one matmul (not a conv):
+that is the MXU-native formulation.
+
+A two-layer GELU MLP projector maps vision hidden size to the language
+model's hidden size (LLaVA-style), so ``encode_images`` output can be
+spliced directly into the decoder's embedding stream.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+
+@dataclass
+class VisionConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    layer_norm_eps: float = 1e-5
+    projection_dim: int = 4096  # language-model hidden size
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return 3 * self.patch_size * self.patch_size
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "VisionConfig":
+        known = {f for f in cls.__dataclass_fields__}  # type: ignore[attr-defined]
+        return cls(**{k: v for k, v in raw.items() if k in known})
+
+
+def vision_param_shapes(cfg: VisionConfig) -> dict[str, tuple[tuple[int, ...], Any]]:
+    L, D, F = cfg.num_hidden_layers, cfg.hidden_size, cfg.intermediate_size
+    P = cfg.projection_dim
+    bf16 = jnp.bfloat16
+    return {
+        "patch_embed": ((cfg.patch_dim, D), bf16),
+        "pos_embed": ((cfg.num_patches, D), jnp.float32),
+        "ln_pre": ((2, D), jnp.float32),  # [scale, bias]
+        "wq": ((L, D, D), bf16),
+        "bq": ((L, D), bf16),
+        "wk": ((L, D, D), bf16),
+        "bk": ((L, D), bf16),
+        "wv": ((L, D, D), bf16),
+        "bv": ((L, D), bf16),
+        "wo": ((L, D, D), bf16),
+        "bo": ((L, D), bf16),
+        "ln1": ((L, 2, D), jnp.float32),
+        "ln2": ((L, 2, D), jnp.float32),
+        "mlp_up": ((L, D, F), bf16),
+        "mlp_up_b": ((L, F), bf16),
+        "mlp_down": ((L, F, D), bf16),
+        "mlp_down_b": ((L, D), bf16),
+        "ln_post": ((2, D), jnp.float32),
+        "proj_1": ((D, P), bf16),
+        "proj_1_b": ((P,), bf16),
+        "proj_2": ((P, P), bf16),
+        "proj_2_b": ((P,), bf16),
+    }
+
+
+def init_vision_params(cfg: VisionConfig, seed: int = 0) -> Params:
+    shapes = vision_param_shapes(cfg)
+    key = jax.random.PRNGKey(seed)
+    keys = jax.random.split(key, len(shapes))
+    params: Params = {}
+    for (name, (shape, dtype)), k in zip(shapes.items(), keys):
+        if name.startswith("ln"):
+            # [scale=1, bias=0]
+            arr = jnp.stack(
+                [jnp.ones(shape[-1:], dtype), jnp.zeros(shape[-1:], dtype)]
+            )
+            arr = jnp.broadcast_to(arr, shape).astype(dtype)
+        elif name.endswith("_b") or name.startswith("b"):
+            arr = jnp.zeros(shape, dtype)
+        else:
+            fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
+            scale = 1.0 / math.sqrt(max(1, fan_in))
+            arr = (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+        params[name] = arr
+    return params
+
+
+def _layernorm(x: jax.Array, ln: jax.Array, eps: float) -> jax.Array:
+    """ln: [2, D] = [scale, bias]."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps) * ln[0] + ln[1]
+    return out.astype(x.dtype)
+
+
+def patchify(cfg: VisionConfig, pixels: jax.Array) -> jax.Array:
+    """[B, H, W, 3] -> [B, n_patches, patch_dim] (reshape-only, no conv)."""
+    B = pixels.shape[0]
+    g = cfg.image_size // cfg.patch_size
+    p = cfg.patch_size
+    x = pixels.reshape(B, g, p, g, p, 3)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # [B, g, g, p, p, 3]
+    return x.reshape(B, g * g, p * p * 3)
+
+
+def encode_images(cfg: VisionConfig, params: Params, pixels: jax.Array) -> jax.Array:
+    """[B, H, W, 3] float pixels -> [B, n_patches, projection_dim]."""
+    eps = cfg.layer_norm_eps
+    H = cfg.num_attention_heads
+    D = cfg.hidden_size
+    Dh = D // H
+
+    x = patchify(cfg, pixels).astype(jnp.bfloat16) @ params["patch_embed"]
+    x = x + params["pos_embed"].astype(x.dtype)
+    x = _layernorm(x, params["ln_pre"], eps)
+
+    def layer_fn(x, lp):
+        B, T = x.shape[0], x.shape[1]
+        h = _layernorm(x, lp["ln1"], eps)
+        q = (h @ lp["wq"] + lp["bq"]).reshape(B, T, H, Dh)
+        k = (h @ lp["wk"] + lp["bk"]).reshape(B, T, H, Dh)
+        v = (h @ lp["wv"] + lp["bv"]).reshape(B, T, H, Dh)
+        scores = jnp.einsum(
+            "bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32
+        ) / math.sqrt(Dh)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(B, T, D)
+        x = x + (attn @ lp["wo"] + lp["bo"]).astype(x.dtype)
+        h = _layernorm(x, lp["ln2"], eps)
+        mlp = jax.nn.gelu(h @ lp["mlp_up"] + lp["mlp_up_b"]) @ lp["mlp_down"]
+        x = x + (mlp + lp["mlp_down_b"]).astype(x.dtype)
+        return x, None
+
+    layer_names = [
+        "wq", "bq", "wk", "bk", "wv", "bv", "wo", "bo",
+        "ln1", "ln2", "mlp_up", "mlp_up_b", "mlp_down", "mlp_down_b",
+    ]
+    x, _ = jax.lax.scan(layer_fn, x, {n: params[n] for n in layer_names})
+    x = _layernorm(x, params["ln_post"], eps)
+    # LLaVA-style projector into the language model's embedding space
+    x = jax.nn.gelu(x @ params["proj_1"] + params["proj_1_b"])
+    x = x @ params["proj_2"] + params["proj_2_b"]
+    return x

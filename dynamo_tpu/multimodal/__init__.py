@@ -1,0 +1,17 @@
+"""Multimodal (vision-language) serving: image processing, the vision
+encode worker, and the multimodal preprocessor (reference:
+examples/multimodal — encode worker + LLM worker pipeline)."""
+
+from dynamo_tpu.multimodal.embeds import pack_segments, unpack_segments
+from dynamo_tpu.multimodal.processor import ImageProcessor
+from dynamo_tpu.multimodal.encoder import VisionEncoder, VisionEncoderEngine
+from dynamo_tpu.multimodal.preprocessor import MultimodalPreprocessor
+
+__all__ = [
+    "ImageProcessor",
+    "MultimodalPreprocessor",
+    "VisionEncoder",
+    "VisionEncoderEngine",
+    "pack_segments",
+    "unpack_segments",
+]

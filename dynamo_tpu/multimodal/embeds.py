@@ -1,0 +1,55 @@
+"""Wire format for embedding segments riding on PreprocessedRequest.
+
+A segment is (offset, array[n, D]): rows to inject over the decoder's
+token embeddings starting at token position ``offset``. Packed as
+base64 so the request stays JSON-serializable across the runtime's
+request plane (same constraint the reference's NATS request plane
+imposes on its Python-side multimodal handoff)."""
+
+from __future__ import annotations
+
+import base64
+
+import numpy as np
+
+Segment = tuple[int, np.ndarray]
+
+MAX_SEGMENT_BYTES = 256 << 20
+
+
+def pack_segments(segments: list[Segment]) -> list[dict]:
+    out = []
+    for offset, arr in segments:
+        arr = np.ascontiguousarray(arr)
+        out.append(
+            {
+                "offset": int(offset),
+                "shape": list(arr.shape),
+                "dtype": arr.dtype.name,
+                "data": base64.b64encode(arr.tobytes()).decode(),
+            }
+        )
+    return out
+
+
+def unpack_segments(packed: list[dict]) -> list[Segment]:
+    out: list[Segment] = []
+    for seg in packed:
+        shape = tuple(int(d) for d in seg["shape"])
+        if len(shape) != 2:
+            raise ValueError(f"embedding segment must be 2-D, got {shape}")
+        dtype = np.dtype(seg["dtype"])
+        if dtype.kind != "f":
+            raise ValueError(f"embedding segment dtype {dtype} not float")
+        n_bytes = int(np.prod(shape)) * dtype.itemsize
+        if n_bytes > MAX_SEGMENT_BYTES:
+            raise ValueError("embedding segment too large")
+        raw = base64.b64decode(seg["data"])
+        if len(raw) != n_bytes:
+            raise ValueError(
+                f"embedding segment payload {len(raw)}B != expected {n_bytes}B"
+            )
+        out.append(
+            (int(seg["offset"]), np.frombuffer(raw, dtype=dtype).reshape(shape))
+        )
+    return out

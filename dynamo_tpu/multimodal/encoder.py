@@ -1,0 +1,69 @@
+"""Vision encode worker: images in, projected embeddings out.
+
+The native analogue of the reference's multimodal *encode worker*
+(examples/multimodal/components/encode_worker.py): a separate service
+that runs the vision tower so LLM workers never touch image bytes. The
+engine form (``VisionEncoderEngine``) serves over the runtime's
+endpoint plane — deploy it as its own component and point the
+multimodal preprocessor's ``encode`` hook at its client."""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Optional
+
+import numpy as np
+
+from dynamo_tpu.models.vision import (
+    VisionConfig,
+    encode_images,
+    init_vision_params,
+)
+from dynamo_tpu.multimodal.embeds import pack_segments
+from dynamo_tpu.multimodal.processor import ImageProcessor
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
+
+
+class VisionEncoder:
+    """In-process vision tower: urls -> [n_images, n_patches, D] float32."""
+
+    def __init__(self, cfg: VisionConfig, params: Optional[dict] = None,
+                 seed: int = 0):
+        import jax
+
+        self.cfg = cfg
+        self.params = params if params is not None else init_vision_params(
+            cfg, seed=seed
+        )
+        self.processor = ImageProcessor(cfg.image_size)
+        self._encode = jax.jit(lambda p, px: encode_images(cfg, p, px))
+
+    @property
+    def tokens_per_image(self) -> int:
+        return self.cfg.num_patches
+
+    def encode_urls(self, urls: list[str]) -> np.ndarray:
+        pixels = self.processor.load_batch(urls)
+        return np.asarray(self._encode(self.params, pixels), np.float32)
+
+
+class VisionEncoderEngine(AsyncEngine):
+    """Endpoint-servable encode worker. Request: {"image_urls": [...]};
+    response: one message {"segments": packed, "tokens_per_image": n}
+    where segment offsets are image-relative (0, n, 2n, ...) — the
+    preprocessor rebases them onto prompt positions."""
+
+    def __init__(self, encoder: VisionEncoder):
+        self.encoder = encoder
+
+    async def _gen(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        urls = list(request["image_urls"])
+        embeds = self.encoder.encode_urls(urls)  # [B, n, D]
+        n = self.encoder.tokens_per_image
+        segments = [(i * n, embeds[i]) for i in range(len(urls))]
+        yield {
+            "segments": pack_segments(segments),
+            "tokens_per_image": n,
+        }
+
+    def generate(self, request: Any, context: Context) -> EngineStream:
+        return self._gen(request, context)

@@ -1,0 +1,124 @@
+"""Multimodal preprocessor: chat requests with images -> tokenized
+request + embedding segments.
+
+Mirrors the reference's multimodal pipeline shape (examples/multimodal:
+processor extracts image URLs, an encode worker produces embeddings,
+the LLM worker receives prompt + embeddings): each ``image_url``
+content part becomes ``tokens_per_image`` repetitions of the image
+placeholder token in the prompt, and the image's projected patch
+embeddings ride the request as ``mm_embeds`` segments anchored at the
+placeholder offsets. The decoder splices them over the token embeddings
+(models/llama.py forward(extra_embeds=...))."""
+
+from __future__ import annotations
+
+import uuid
+from typing import Awaitable, Callable, Optional
+
+import numpy as np
+
+from dynamo_tpu.multimodal.embeds import pack_segments
+from dynamo_tpu.preprocessor.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.protocols.common import PreprocessedRequest
+from dynamo_tpu.protocols.openai import ChatCompletionRequest
+
+# encode(urls) -> [n_images, tokens_per_image, D] float32
+EncodeFn = Callable[[list[str]], "np.ndarray"]
+
+IMAGE_PLACEHOLDER = "<image>"
+
+
+def extract_image_urls(request: ChatCompletionRequest) -> list[str]:
+    """Collect image_url parts across messages, in order."""
+    urls: list[str] = []
+    for m in request.messages:
+        if isinstance(m.content, list):
+            for part in m.content:
+                if part.get("type") == "image_url":
+                    img = part.get("image_url") or {}
+                    url = img.get("url") if isinstance(img, dict) else img
+                    if url:
+                        urls.append(url)
+    return urls
+
+
+class MultimodalPreprocessor(OpenAIPreprocessor):
+    """OpenAIPreprocessor + image handling.
+
+    ``encode`` runs the vision tower (a local VisionEncoder.encode_urls,
+    or a remote encode-worker call); ``image_token_id`` is the
+    placeholder token the decoder overwrites with patch embeddings.
+    """
+
+    def __init__(
+        self,
+        tokenizer,
+        formatter,
+        encode: EncodeFn,
+        image_token_id: int,
+        tokens_per_image: int,
+        model_name: str = "",
+    ):
+        super().__init__(tokenizer, formatter, model_name=model_name)
+        self._encode = encode
+        self.image_token_id = image_token_id
+        self.tokens_per_image = tokens_per_image
+
+    def preprocess_chat(self, request: ChatCompletionRequest) -> PreprocessedRequest:
+        urls = extract_image_urls(request)
+        if not urls:
+            return super().preprocess_chat(request)
+        # render with a textual placeholder per image, then expand each
+        # placeholder into tokens_per_image image tokens
+        flat = self._render_with_placeholders(request)
+        pieces = flat.split(IMAGE_PLACEHOLDER)
+        if len(pieces) != len(urls) + 1:
+            raise ValueError(
+                f"prompt has {len(pieces) - 1} image placeholders for "
+                f"{len(urls)} images"
+            )
+        embeds = self._encode(urls)  # [n_images, tokens_per_image, D]
+        if embeds.shape[:2] != (len(urls), self.tokens_per_image):
+            raise ValueError(
+                f"encoder returned {embeds.shape}, expected "
+                f"({len(urls)}, {self.tokens_per_image}, D)"
+            )
+        token_ids: list[int] = []
+        segments = []
+        for i, piece in enumerate(pieces):
+            if piece:
+                token_ids.extend(self.tokenizer.encode(piece))
+            if i < len(urls):
+                segments.append((len(token_ids), np.asarray(embeds[i], np.float32)))
+                token_ids.extend([self.image_token_id] * self.tokens_per_image)
+        return PreprocessedRequest(
+            request_id=f"chatcmpl-{uuid.uuid4().hex}",
+            token_ids=token_ids,
+            sampling=request.sampling_options(),
+            stop=request.stop_conditions(),
+            output=request.output_options(),
+            model=request.model,
+            annotations=list(request.extension().annotations),
+            mm_embeds=pack_segments(segments),
+        )
+
+    def _render_with_placeholders(self, request: ChatCompletionRequest) -> str:
+        """Chat-template render with image parts replaced by the textual
+        placeholder (most VLM chat templates expect exactly this)."""
+        messages = []
+        for m in request.messages:
+            d = m.model_dump(exclude_none=True)
+            if isinstance(m.content, list):
+                parts = []
+                for part in m.content:
+                    if part.get("type") == "image_url":
+                        parts.append(IMAGE_PLACEHOLDER)
+                    else:
+                        parts.append(part.get("text", ""))
+                d["content"] = "".join(parts)
+            messages.append(d)
+        if self.formatter is None:
+            raise ValueError("chat requests need a PromptFormatter")
+        return self.formatter.render(
+            messages, add_generation_prompt=True, tools=request.tools
+        )

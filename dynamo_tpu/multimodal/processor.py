@@ -1,0 +1,62 @@
+"""Image loading + preprocessing for the vision tower.
+
+Accepts OpenAI ``image_url`` content: ``data:`` URLs (base64 inline) and
+local ``file://`` / plain paths. Plain ``http(s)://`` fetching is
+deliberately not implemented here — serving nodes should not pull
+arbitrary remote URLs; a fronting proxy can inline them as data URLs
+(the reference's multimodal example similarly feeds local/url-resolved
+images into its encode worker, examples/multimodal/components/)."""
+
+from __future__ import annotations
+
+import base64
+import io
+import os
+
+import numpy as np
+
+# CLIP-style normalization constants
+_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
+_STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+MAX_IMAGE_BYTES = 64 << 20
+
+
+class ImageProcessor:
+    """url/path -> normalized pixel array [image_size, image_size, 3]."""
+
+    def __init__(self, image_size: int = 224):
+        self.image_size = image_size
+
+    def load(self, url: str) -> np.ndarray:
+        if url.startswith("data:"):
+            head, _, payload = url.partition(",")
+            if not head.endswith(";base64"):
+                raise ValueError("data: URL must be base64-encoded")
+            raw = base64.b64decode(payload)
+        elif url.startswith(("http://", "https://")):
+            raise ValueError(
+                "remote image URLs are not fetched by workers; inline the "
+                "image as a data: URL"
+            )
+        else:
+            path = url[len("file://"):] if url.startswith("file://") else url
+            if os.path.getsize(path) > MAX_IMAGE_BYTES:
+                raise ValueError("image file too large")
+            with open(path, "rb") as f:
+                raw = f.read()
+        if len(raw) > MAX_IMAGE_BYTES:
+            raise ValueError("image too large")
+        return self._decode(raw)
+
+    def _decode(self, raw: bytes) -> np.ndarray:
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(raw)).convert("RGB")
+        img = img.resize((self.image_size, self.image_size), Image.BICUBIC)
+        arr = np.asarray(img, np.float32) / 255.0
+        return (arr - _MEAN) / _STD
+
+    def load_batch(self, urls: list[str]) -> np.ndarray:
+        """-> [B, image_size, image_size, 3]."""
+        return np.stack([self.load(u) for u in urls])

@@ -95,6 +95,11 @@ class PreprocessedRequest(BaseModel):
     # Disaggregation: filled by the disagg router when prefill is remote
     remote_prefill: Optional[dict[str, Any]] = None
     annotations: list[str] = Field(default_factory=list)
+    # Multimodal: embedding segments to inject over placeholder tokens —
+    # [{"offset", "shape", "dtype", "data"(b64)}], packed/unpacked by
+    # dynamo_tpu.multimodal.embeds (reference: examples/multimodal
+    # encode-worker → LLM embedding handoff)
+    mm_embeds: Optional[list[dict[str, Any]]] = None
 
 
 class LLMEngineOutput(BaseModel):
