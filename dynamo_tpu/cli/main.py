@@ -137,12 +137,37 @@ def _load_model_assets(args: Any):
 
     if not args.model_path:
         raise SystemExit(f"--out {args.out_mode} requires --model-path")
-    tokenizer = Tokenizer.from_file(args.model_path)
-    try:
-        formatter = PromptFormatter.from_model_dir(args.model_path)
-    except Exception:
+    if args.model_path.endswith(".gguf"):
+        # GGUF single-file model: embedded tokenizer + chat template
+        from dynamo_tpu.gguf import GGUFReader, tokenizer_from_gguf
+
+        with GGUFReader(args.model_path) as r:
+            tokenizer = tokenizer_from_gguf(r)
+            template = r.metadata.get("tokenizer.chat_template")
+            toks = r.metadata.get("tokenizer.ggml.tokens") or []
+
+            def _tok_str(key: str) -> str:
+                i = r.metadata.get(f"tokenizer.ggml.{key}")
+                return toks[i] if i is not None and i < len(toks) else ""
+
+            bos_str, eos_str = _tok_str("bos_token_id"), _tok_str("eos_token_id")
         formatter = None
-        log.warning("no chat template found; chat requests will fail")
+        if template:
+            try:
+                formatter = PromptFormatter(
+                    template, bos_token=bos_str, eos_token=eos_str
+                )
+            except Exception:
+                log.warning("GGUF chat template failed to parse", exc_info=True)
+        if formatter is None:
+            log.warning("no chat template in GGUF; chat requests will fail")
+    else:
+        tokenizer = Tokenizer.from_file(args.model_path)
+        try:
+            formatter = PromptFormatter.from_model_dir(args.model_path)
+        except Exception:
+            formatter = None
+            log.warning("no chat template found; chat requests will fail")
     from dynamo_tpu.model_card import default_model_name
 
     model_name = args.model_name or default_model_name(args.model_path)
@@ -334,7 +359,15 @@ async def cmd_run(args: Any) -> None:
             )
             metrics_pub.start()
         await endpoint.serve(engine)
-        if args.model_path and out in ("echo_core", "jax"):
+        if args.model_path and args.model_path.endswith(".gguf"):
+            # ModelDeploymentCard artifacts (tokenizer.json etc.) come
+            # from model directories; a GGUF worker would register a
+            # card discovery frontends can't build a pipeline from
+            log.warning(
+                "GGUF models are not registered for discovery frontends; "
+                "serve them with a local pipeline (--in http) instead"
+            )
+        elif args.model_path and out in ("echo_core", "jax"):
             # publish the deployment card + this instance's ModelEntry so
             # discovery-driven frontends (--out auto) pick the model up
             # (reference: register_llm / llmctl http add). Only core

@@ -128,8 +128,21 @@ class JaxEngine:
                 num_processes=cfg.num_nodes,
                 process_id=cfg.node_rank,
             )
+        is_gguf = cfg.model_path.endswith(".gguf")
+        gguf_reader = None
+        if is_gguf:
+            # one reader for config AND weights: header parsing decodes
+            # the full embedded vocab, don't pay it twice
+            from dynamo_tpu.gguf import GGUFReader
+
+            gguf_reader = GGUFReader(cfg.model_path)
         if self.model_config is None:
-            self.model_config = ModelConfig.from_dir(cfg.model_path)
+            if gguf_reader is not None:
+                from dynamo_tpu.gguf import config_from_gguf
+
+                self.model_config = config_from_gguf(gguf_reader)
+            else:
+                self.model_config = ModelConfig.from_dir(cfg.model_path)
         self.eos_token_ids = self.model_config.eos_token_ids
         mesh_cfg = MeshConfig(
             dp=cfg.data_parallel_size,
@@ -141,17 +154,27 @@ class JaxEngine:
 
         from dynamo_tpu.models import loader
 
-        if (
-            not cfg.random_weights
-            and cfg.model_path
-            and loader.has_weights(cfg.model_path)
-        ):
-            self.params = loader.load_params(
-                self.model_config, cfg.model_path, self.mesh
-            )
-        else:
-            log.warning("initializing RANDOM weights (no checkpoint found)")
-            self.params = init_params(self.model_config, cfg.seed, self.mesh)
+        try:
+            if not cfg.random_weights and gguf_reader is not None:
+                from dynamo_tpu.gguf import load_params_from_gguf
+
+                self.params = load_params_from_gguf(
+                    self.model_config, gguf_reader, self.mesh
+                )
+            elif (
+                not cfg.random_weights
+                and cfg.model_path
+                and loader.has_weights(cfg.model_path)
+            ):
+                self.params = loader.load_params(
+                    self.model_config, cfg.model_path, self.mesh
+                )
+            else:
+                log.warning("initializing RANDOM weights (no checkpoint found)")
+                self.params = init_params(self.model_config, cfg.seed, self.mesh)
+        finally:
+            if gguf_reader is not None:
+                gguf_reader.close()
 
         num_blocks = cfg.num_blocks or self._auto_num_blocks(devices)
         self.k_cache, self.v_cache = init_cache(

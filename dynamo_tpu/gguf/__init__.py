@@ -1,0 +1,21 @@
+"""GGUF model-file support (reference: lib/llm/src/gguf/*.rs — GGUF
+metadata/content parsing + embedded-tokenizer extraction + model-card
+creation from GGUF)."""
+
+from dynamo_tpu.gguf.reader import (
+    GGUFReader,
+    GGUFTensorInfo,
+    config_from_gguf,
+    load_params_from_gguf,
+    tokenizer_from_gguf,
+    write_gguf,
+)
+
+__all__ = [
+    "GGUFReader",
+    "GGUFTensorInfo",
+    "config_from_gguf",
+    "load_params_from_gguf",
+    "tokenizer_from_gguf",
+    "write_gguf",
+]

@@ -1,0 +1,428 @@
+"""Pure-python GGUF v3 reader/writer + model bring-up from GGUF.
+
+The native analogue of the reference's GGUF layer (reference:
+lib/llm/src/gguf/{mod,content,gguf_tokenizer}.rs and
+model_card/create.rs from_gguf): parse header/metadata/tensor infos,
+mmap tensor data with dequantization (F32/F16/Q8_0), extract the
+embedded tokenizer, derive a ModelConfig, and load weights into the
+stacked-layer decoder pytree (models/llama.py layout).
+
+Format (GGUF v3, little-endian): magic "GGUF", version u32,
+tensor_count u64, kv_count u64; metadata KVs (string key + typed
+value); tensor infos (name, n_dims, dims in ne-order [fastest-varying
+first], ggml dtype, data offset); data section aligned to
+``general.alignment`` (default 32). A tensor with ne-dims [a, b] is the
+row-major array of shape (b, a) — reversed, like torch's [out, in].
+"""
+
+from __future__ import annotations
+
+import mmap
+import struct
+from dataclasses import dataclass
+from typing import Any, BinaryIO, Optional
+
+import numpy as np
+
+MAGIC = b"GGUF"
+VERSION = 3
+
+# metadata value types
+U8, I8, U16, I16, U32, I32, F32, BOOL, STRING, ARRAY, U64, I64, F64 = range(13)
+
+_SCALAR_FMT = {
+    U8: "<B", I8: "<b", U16: "<H", I16: "<h", U32: "<I", I32: "<i",
+    F32: "<f", U64: "<Q", I64: "<q", F64: "<d",
+}
+
+# ggml tensor dtypes we understand
+GGML_F32, GGML_F16 = 0, 1
+GGML_Q8_0 = 8
+Q8_0_BLOCK = 32  # values per Q8_0 quantization block
+
+
+@dataclass(frozen=True)
+class GGUFTensorInfo:
+    name: str
+    dims: tuple[int, ...]  # ne order (fastest-varying first)
+    ggml_type: int
+    offset: int  # relative to data-section start
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        """numpy (row-major) shape."""
+        return tuple(reversed(self.dims))
+
+    @property
+    def num_elements(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    @property
+    def data_bytes(self) -> int:
+        if self.ggml_type == GGML_F32:
+            return self.num_elements * 4
+        if self.ggml_type == GGML_F16:
+            return self.num_elements * 2
+        if self.ggml_type == GGML_Q8_0:
+            if self.num_elements % Q8_0_BLOCK:
+                raise ValueError(f"{self.name}: Q8_0 needs multiple of 32 elems")
+            return (self.num_elements // Q8_0_BLOCK) * (2 + Q8_0_BLOCK)
+        raise ValueError(f"{self.name}: unsupported ggml type {self.ggml_type}")
+
+
+class GGUFReader:
+    """Parses a .gguf file; tensor data stays memory-mapped until read."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f: BinaryIO = open(path, "rb")
+        self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        self._pos = 0
+        if self._read(4) != MAGIC:
+            raise ValueError(f"{path}: not a GGUF file")
+        version = self._u32()
+        if version not in (2, 3):
+            raise ValueError(f"{path}: unsupported GGUF version {version}")
+        n_tensors = self._u64()
+        n_kv = self._u64()
+        if n_tensors > 1 << 20 or n_kv > 1 << 20:
+            raise ValueError(f"{path}: implausible header counts")
+        self.metadata: dict[str, Any] = {}
+        for _ in range(n_kv):
+            key = self._string()
+            self.metadata[key] = self._value(self._u32())
+        self.tensors: dict[str, GGUFTensorInfo] = {}
+        for _ in range(n_tensors):
+            name = self._string()
+            n_dims = self._u32()
+            dims = tuple(self._u64() for _ in range(n_dims))
+            ggml_type = self._u32()
+            offset = self._u64()
+            self.tensors[name] = GGUFTensorInfo(name, dims, ggml_type, offset)
+        align = int(self.metadata.get("general.alignment", 32))
+        self._data_start = (self._pos + align - 1) // align * align
+
+    # -- primitive readers -------------------------------------------------
+    def _read(self, n: int) -> bytes:
+        out = self._mm[self._pos : self._pos + n]
+        if len(out) != n:
+            raise ValueError(f"{self.path}: truncated")
+        self._pos += n
+        return out
+
+    def _u32(self) -> int:
+        return struct.unpack("<I", self._read(4))[0]
+
+    def _u64(self) -> int:
+        return struct.unpack("<Q", self._read(8))[0]
+
+    def _string(self) -> str:
+        n = self._u64()
+        if n > 1 << 24:
+            raise ValueError(f"{self.path}: implausible string length")
+        return self._read(n).decode("utf-8")
+
+    def _value(self, vtype: int) -> Any:
+        if vtype in _SCALAR_FMT:
+            fmt = _SCALAR_FMT[vtype]
+            return struct.unpack(fmt, self._read(struct.calcsize(fmt)))[0]
+        if vtype == BOOL:
+            return bool(self._read(1)[0])
+        if vtype == STRING:
+            return self._string()
+        if vtype == ARRAY:
+            etype = self._u32()
+            count = self._u64()
+            if count > 1 << 26:
+                raise ValueError(f"{self.path}: implausible array length")
+            return [self._value(etype) for _ in range(count)]
+        raise ValueError(f"{self.path}: unknown metadata type {vtype}")
+
+    # -- tensor data -------------------------------------------------------
+    def load(self, name: str) -> np.ndarray:
+        """Read + dequantize one tensor to its numpy shape (f32/f16)."""
+        info = self.tensors[name]
+        start = self._data_start + info.offset
+        raw = self._mm[start : start + info.data_bytes]
+        if len(raw) != info.data_bytes:
+            raise ValueError(f"{name}: tensor data out of file bounds")
+        if info.ggml_type == GGML_F32:
+            arr = np.frombuffer(raw, np.float32)
+        elif info.ggml_type == GGML_F16:
+            arr = np.frombuffer(raw, np.float16)
+        elif info.ggml_type == GGML_Q8_0:
+            blocks = np.frombuffer(
+                raw, np.dtype([("d", np.float16), ("q", np.int8, Q8_0_BLOCK)])
+            )
+            arr = (blocks["d"].astype(np.float32)[:, None]
+                   * blocks["q"].astype(np.float32)).reshape(-1)
+        else:
+            raise ValueError(f"{name}: unsupported ggml type {info.ggml_type}")
+        return arr.reshape(info.shape)
+
+    def close(self) -> None:
+        self._mm.close()
+        self._f.close()
+
+    def __enter__(self) -> "GGUFReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# Model bring-up from GGUF metadata
+# ---------------------------------------------------------------------------
+
+
+def config_from_gguf(reader: GGUFReader):
+    """ModelConfig from llama.* GGUF metadata (reference:
+    model_card/create.rs from_gguf)."""
+    from dynamo_tpu.models.config import ModelConfig
+
+    md = reader.metadata
+    arch = md.get("general.architecture", "llama")
+
+    def key(suffix: str, default=None):
+        return md.get(f"{arch}.{suffix}", default)
+
+    heads = int(key("attention.head_count", 32))
+    emb = int(key("embedding_length", 4096))
+    vocab_size = md.get("llama.vocab_size") or md.get(f"{arch}.vocab_size")
+    if vocab_size is None:
+        toks = md.get("tokenizer.ggml.tokens")
+        vocab_size = len(toks) if toks else 32000
+    eos = md.get("tokenizer.ggml.eos_token_id", 2)
+    bos = md.get("tokenizer.ggml.bos_token_id", 1)
+    # qwen2-family GGUFs carry QKV bias tensors; detect either way so
+    # param_shapes includes bq/bk/bv and loading doesn't silently skip them
+    has_bias = arch == "qwen2" or "blk.0.attn_q.bias" in reader.tensors
+    return ModelConfig(
+        model_type=arch,
+        attention_bias=has_bias,
+        vocab_size=int(vocab_size),
+        hidden_size=emb,
+        intermediate_size=int(key("feed_forward_length", 11008)),
+        num_hidden_layers=int(key("block_count", 32)),
+        num_attention_heads=heads,
+        num_key_value_heads=int(key("attention.head_count_kv", heads)),
+        max_position_embeddings=int(key("context_length", 4096)),
+        rms_norm_eps=float(key("attention.layer_norm_rms_epsilon", 1e-5)),
+        rope_theta=float(key("rope.freq_base", 10000.0)),
+        bos_token_id=int(bos),
+        eos_token_id=int(eos),
+    )
+
+
+def tokenizer_from_gguf(reader: GGUFReader):
+    """Build a fast tokenizer from the GGUF-embedded vocab (reference:
+    gguf/gguf_tokenizer.rs). Supports tokenizer.ggml.model == "gpt2"
+    (byte-level BPE with merges) and "llama" (sentencepiece-style
+    unigram with scores)."""
+    from tokenizers import Tokenizer as HfTokenizer
+    from tokenizers import decoders, models, pre_tokenizers
+
+    from dynamo_tpu.tokenizer import Tokenizer
+
+    md = reader.metadata
+    kind = md.get("tokenizer.ggml.model", "llama")
+    tokens = md.get("tokenizer.ggml.tokens")
+    if not tokens:
+        raise ValueError("GGUF carries no embedded tokenizer")
+    if kind == "gpt2":
+        merges_raw = md.get("tokenizer.ggml.merges") or []
+        vocab = {tok: i for i, tok in enumerate(tokens)}
+        merges = [tuple(m.split(" ", 1)) for m in merges_raw]
+        inner = HfTokenizer(models.BPE(vocab=vocab, merges=merges))
+        inner.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+        inner.decoder = decoders.ByteLevel()
+    elif kind == "llama":
+        scores = md.get("tokenizer.ggml.scores") or [0.0] * len(tokens)
+        unk_id = int(md.get("tokenizer.ggml.unknown_token_id", 0))
+        inner = HfTokenizer(
+            models.Unigram(
+                list(zip(tokens, map(float, scores))),
+                unk_id=unk_id,
+                byte_fallback=True,
+            )
+        )
+        # byte-fallback tokens (<0x0A> etc.) must decode to real bytes,
+        # not literal text
+        inner.decoder = decoders.Sequence(
+            [
+                decoders.Replace("▁", " "),
+                decoders.ByteFallback(),
+                decoders.Fuse(),
+            ]
+        )
+    else:
+        raise ValueError(f"unsupported GGUF tokenizer model {kind!r}")
+    return Tokenizer(inner)
+
+
+# GGUF tensor name -> our param name (global + per-layer)
+_GGUF_GLOBAL = {
+    "embed": ("token_embd.weight", False),
+    "final_norm": ("output_norm.weight", False),
+    "lm_head": ("output.weight", True),
+}
+_GGUF_LAYER = {
+    "attn_norm": ("blk.{i}.attn_norm.weight", False),
+    "wq": ("blk.{i}.attn_q.weight", True),
+    "wk": ("blk.{i}.attn_k.weight", True),
+    "wv": ("blk.{i}.attn_v.weight", True),
+    "wo": ("blk.{i}.attn_output.weight", True),
+    "mlp_norm": ("blk.{i}.ffn_norm.weight", False),
+    "w_gate": ("blk.{i}.ffn_gate.weight", True),
+    "w_up": ("blk.{i}.ffn_up.weight", True),
+    "w_down": ("blk.{i}.ffn_down.weight", True),
+    "bq": ("blk.{i}.attn_q.bias", False),
+    "bk": ("blk.{i}.attn_k.bias", False),
+    "bv": ("blk.{i}.attn_v.bias", False),
+}
+
+
+def load_params_from_gguf(cfg, reader: GGUFReader, mesh=None):
+    """Load GGUF weights into the stacked-layer pytree (same contract as
+    models/loader.py load_params)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from dynamo_tpu.models.llama import param_shapes, param_specs
+
+    shapes = param_shapes(cfg)
+    specs = param_specs(cfg)
+    params: dict[str, Any] = {}
+
+    def put(name: str, arr) -> Any:
+        shape, dtype = shapes[name]
+        arr = jnp.asarray(arr).astype(dtype)
+        if arr.shape != shape:
+            raise ValueError(f"{name}: expected {shape}, got {arr.shape}")
+        if mesh is not None:
+            arr = jax.device_put(arr, NamedSharding(mesh, specs[name]))
+        return arr
+
+    for name, (gname, transpose) in _GGUF_GLOBAL.items():
+        if name == "lm_head" and gname not in reader.tensors:
+            params[name] = put(name, params["embed"].T)  # tied embeddings
+            continue
+        arr = reader.load(gname)
+        params[name] = put(name, arr.T if transpose else arr)
+
+    for name, (tmpl, transpose) in _GGUF_LAYER.items():
+        if name not in shapes:
+            continue
+        per_layer = []
+        for i in range(cfg.num_hidden_layers):
+            arr = reader.load(tmpl.format(i=i))
+            per_layer.append(arr.T if transpose else arr)
+        params[name] = put(name, np.stack(per_layer))
+
+    missing = set(shapes) - set(params)
+    if missing:
+        raise ValueError(f"GGUF missing params: {sorted(missing)}")
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Writer (tests + export parity)
+# ---------------------------------------------------------------------------
+
+
+def _write_string(f: BinaryIO, s: str) -> None:
+    b = s.encode("utf-8")
+    f.write(struct.pack("<Q", len(b)) + b)
+
+
+def _value_type(v: Any) -> int:
+    if isinstance(v, bool):
+        return BOOL
+    if isinstance(v, int):
+        return I64 if v < 0 else U64
+    if isinstance(v, float):
+        return F64
+    if isinstance(v, str):
+        return STRING
+    if isinstance(v, (list, tuple)):
+        return ARRAY
+    raise ValueError(f"cannot encode metadata value {v!r}")
+
+
+def _write_value(f: BinaryIO, v: Any, vtype: Optional[int] = None) -> None:
+    vtype = _value_type(v) if vtype is None else vtype
+    if vtype == BOOL:
+        f.write(bytes([1 if v else 0]))
+    elif vtype in _SCALAR_FMT:
+        f.write(struct.pack(_SCALAR_FMT[vtype], v))
+    elif vtype == STRING:
+        _write_string(f, v)
+    elif vtype == ARRAY:
+        etype = _value_type(v[0]) if v else STRING
+        f.write(struct.pack("<IQ", etype, len(v)))
+        for item in v:
+            _write_value(f, item, etype)
+    else:
+        raise ValueError(f"cannot encode metadata type {vtype}")
+
+
+def write_gguf(
+    path: str,
+    metadata: dict[str, Any],
+    tensors: dict[str, np.ndarray],
+    quantize: Optional[dict[str, int]] = None,
+    alignment: int = 32,
+) -> None:
+    """Write a GGUF v3 file. ``tensors`` are numpy arrays in row-major
+    shape (dims are reversed on disk per GGUF ne-order); ``quantize``
+    optionally maps tensor name -> GGML_Q8_0 to store Q8_0."""
+    quantize = quantize or {}
+
+    def encode(name: str, arr: np.ndarray) -> tuple[int, bytes]:
+        gt = quantize.get(name)
+        if gt == GGML_Q8_0:
+            flat = arr.astype(np.float32).reshape(-1, Q8_0_BLOCK)
+            d = np.abs(flat).max(axis=1) / 127.0
+            d_safe = np.where(d == 0, 1.0, d)
+            q = np.clip(np.round(flat / d_safe[:, None]), -127, 127).astype(np.int8)
+            out = np.zeros(
+                len(flat), np.dtype([("d", np.float16), ("q", np.int8, Q8_0_BLOCK)])
+            )
+            out["d"] = d.astype(np.float16)
+            out["q"] = q
+            return GGML_Q8_0, out.tobytes()
+        if arr.dtype == np.float16:
+            return GGML_F16, np.ascontiguousarray(arr).tobytes()
+        return GGML_F32, np.ascontiguousarray(arr, np.float32).tobytes()
+
+    encoded = {name: encode(name, arr) for name, arr in tensors.items()}
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(struct.pack("<IQQ", VERSION, len(tensors), len(metadata)))
+        for k, v in metadata.items():
+            _write_string(f, k)
+            vt = _value_type(v)
+            f.write(struct.pack("<I", vt))
+            _write_value(f, v, vt)
+        offset = 0
+        for name, arr in tensors.items():
+            gt, raw = encoded[name]
+            _write_string(f, name)
+            dims = tuple(reversed(arr.shape))
+            f.write(struct.pack("<I", len(dims)))
+            for d in dims:
+                f.write(struct.pack("<Q", d))
+            f.write(struct.pack("<IQ", gt, offset))
+            offset += (len(raw) + alignment - 1) // alignment * alignment
+        pos = f.tell()
+        f.write(b"\x00" * ((pos + alignment - 1) // alignment * alignment - pos))
+        for name, arr in tensors.items():
+            _, raw = encoded[name]
+            f.write(raw)
+            pad = (len(raw) + alignment - 1) // alignment * alignment - len(raw)
+            f.write(b"\x00" * pad)
