@@ -115,6 +115,24 @@ def build_parser() -> argparse.ArgumentParser:
     planner.add_argument("--store-host", default="127.0.0.1")
     planner.add_argument("--store-port", type=int, default=4222)
 
+    deploy = sub.add_parser("deploy", help="graph deployment ctl "
+                            "(≈ DynamoGraphDeployment CRs)")
+    deploy.add_argument("action", choices=["apply", "status", "delete"])
+    deploy.add_argument("target", nargs="?",
+                        help="spec YAML (apply) or deployment name (delete)")
+    deploy.add_argument("--namespace", default="dynamo")
+    deploy.add_argument("--store-host", default="127.0.0.1")
+    deploy.add_argument("--store-port", type=int, default=4222)
+
+    operator = sub.add_parser("operator", help="deployment reconciler "
+                              "(≈ the K8s operator, local mode)")
+    operator.add_argument("--namespace", default="dynamo")
+    operator.add_argument("--interval", type=float, default=10.0)
+    operator.add_argument("--api-port", type=int, default=8190,
+                          help="api-store REST port (0 disables)")
+    operator.add_argument("--store-host", default="127.0.0.1")
+    operator.add_argument("--store-port", type=int, default=4222)
+
     models = sub.add_parser("models", help="model registry ctl (≈ llmctl)")
     models.add_argument("action", choices=["list", "register", "remove"])
     models.add_argument("name", nargs="?")
@@ -663,6 +681,61 @@ async def cmd_planner(args: Any) -> None:
     await drt.shutdown()
 
 
+async def cmd_deploy(args: Any) -> None:
+    import json
+
+    from dynamo_tpu.deploy import GraphDeploymentSpec, Reconciler
+    from dynamo_tpu.store.client import StoreClient
+
+    client = await StoreClient.connect(args.store_host, args.store_port)
+    rec = Reconciler(client, args.namespace)
+    try:
+        if args.action == "apply":
+            if not args.target:
+                raise SystemExit("deploy apply requires a spec YAML path")
+            spec = GraphDeploymentSpec.from_yaml_file(args.target)
+            await rec.apply(spec)
+            print(f"applied {spec.name} ({len(spec.services)} services)")
+        elif args.action == "status":
+            print(json.dumps(await rec.status(), indent=2))
+        elif args.action == "delete":
+            if not args.target:
+                raise SystemExit("deploy delete requires a deployment name")
+            if await rec.delete(args.target):
+                print(f"deleted {args.target}")
+            else:
+                raise SystemExit(f"no deployment {args.target!r}")
+    finally:
+        await client.close()
+
+
+async def cmd_operator(args: Any) -> None:
+    from dynamo_tpu.deploy import ApiStore, Reconciler
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    drt = await DistributedRuntime.create(config=_runtime_config(args))
+    drt.runtime.install_signal_handlers()
+    rec = Reconciler(drt.store, args.namespace, interval_s=args.interval)
+    api = None
+    if args.api_port:
+        api = ApiStore(rec, port=args.api_port)
+        await api.start()
+        print(f"api-store on :{api.port}", flush=True)
+    print("operator reconciling", flush=True)
+    shutdown = asyncio.Event()
+
+    async def _watch() -> None:
+        await drt.runtime.wait_shutdown()
+        shutdown.set()
+
+    watcher = asyncio.create_task(_watch())
+    await rec.run(shutdown)
+    watcher.cancel()
+    if api is not None:
+        await api.stop()
+    await drt.shutdown()
+
+
 async def cmd_models(args: Any) -> None:
     from dynamo_tpu.model_card import list_entries, register_llm, unregister_model
     from dynamo_tpu.store.client import StoreClient
@@ -733,6 +806,13 @@ def main(argv: Optional[list[str]] = None) -> None:
         asyncio.run(cmd_planner(args))
     elif args.command == "models":
         asyncio.run(cmd_models(args))
+    elif args.command == "deploy":
+        asyncio.run(cmd_deploy(args))
+    elif args.command == "operator":
+        try:
+            asyncio.run(cmd_operator(args))
+        except KeyboardInterrupt:
+            pass
     else:  # pragma: no cover
         sys.exit(2)
 

@@ -1,0 +1,9 @@
+"""Deploy tier: graph-deployment specs, the operator-lite reconciler,
+and the deployment api-store (reference: deploy/cloud/operator — the Go
+K8s operator with DynamoGraphDeployment CRDs; deploy/cloud/api-store)."""
+
+from dynamo_tpu.deploy.spec import GraphDeploymentSpec, ServiceSpec
+from dynamo_tpu.deploy.operator import Reconciler
+from dynamo_tpu.deploy.api_store import ApiStore
+
+__all__ = ["ApiStore", "GraphDeploymentSpec", "Reconciler", "ServiceSpec"]

@@ -1,0 +1,107 @@
+"""Deployment api-store: REST CRUD for graph-deployment specs.
+
+The native analogue of the reference's cloud api-store (reference:
+deploy/cloud/api-store/ai_dynamo_store — FastAPI service storing graph
+artifacts; here aiohttp, consistent with http/service.py since fastapi
+is not in the image). Specs land in the coordinator store under
+``{ns}/deployments/{name}`` where the operator-lite reconciler
+(operator.py) picks them up.
+
+  GET    /api/v1/deployments            list
+  GET    /api/v1/deployments/{name}     fetch
+  PUT    /api/v1/deployments/{name}     create/update (JSON body = CRD doc)
+  DELETE /api/v1/deployments/{name}     remove
+  GET    /api/v1/status                 desired-vs-actual per deployment
+  GET    /healthz
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from dynamo_tpu.deploy.operator import Reconciler
+from dynamo_tpu.deploy.spec import GraphDeploymentSpec, deployment_key
+
+log = logging.getLogger("dynamo_tpu.deploy.api_store")
+
+MAX_BODY = 1 << 20
+
+
+class ApiStore:
+    def __init__(self, reconciler: Reconciler,
+                 host: str = "0.0.0.0", port: int = 8190):
+        self.reconciler = reconciler
+        self.host = host
+        self.port = port
+        self._runner: Optional[web.AppRunner] = None
+        app = web.Application(client_max_size=MAX_BODY)
+        app.router.add_get("/healthz", self._healthz)
+        app.router.add_get("/api/v1/deployments", self._list)
+        app.router.add_get("/api/v1/deployments/{name}", self._get)
+        app.router.add_put("/api/v1/deployments/{name}", self._put)
+        app.router.add_delete("/api/v1/deployments/{name}", self._delete)
+        app.router.add_get("/api/v1/status", self._status)
+        self.app = app
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in self._runner.sites:
+            self.port = s._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+            break
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- handlers ----------------------------------------------------------
+    async def _healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    async def _list(self, request: web.Request) -> web.Response:
+        specs = await self.reconciler.list_deployments()
+        return web.json_response({"items": [s.to_dict() for s in specs]})
+
+    async def _get(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        entry = await self.reconciler.store.kv_get(
+            deployment_key(self.reconciler.namespace, name)
+        )
+        if entry is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(
+            GraphDeploymentSpec.from_bytes(entry.value).to_dict()
+        )
+
+    async def _put(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        try:
+            raw = await request.json()
+            spec = GraphDeploymentSpec.from_dict(raw)
+        except Exception as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        if spec.name != name:
+            return web.json_response(
+                {"error": f"body name {spec.name!r} != path {name!r}"},
+                status=400,
+            )
+        try:
+            await self.reconciler.apply(spec)
+        except ValueError as exc:  # e.g. namespace mismatch
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response(spec.to_dict())
+
+    async def _delete(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        deleted = await self.reconciler.delete(name)
+        if not deleted:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response({"deleted": name})
+
+    async def _status(self, request: web.Request) -> web.Response:
+        return web.json_response(await self.reconciler.status())

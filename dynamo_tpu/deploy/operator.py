@@ -1,0 +1,151 @@
+"""Operator-lite: reconciles graph-deployment specs onto running
+supervisors.
+
+The native analogue of the reference's K8s operator controllers
+(reference: deploy/cloud/operator/internal/controller/
+dynamographdeployment_controller.go): level-triggered reconciliation —
+read desired state (deployment specs under ``{ns}/deployments/`` in the
+coordinator store), observe actual state (supervisor-published replica
+counts), and converge by issuing add/remove commands over the
+supervisor control subject (the same lever the planner uses,
+sdk/serving.py). Scaling remains cooperative: the planner adjusts
+replicas *within* a deployment's bounds at runtime; the operator
+enforces the declared baseline when specs change or workers die.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from dynamo_tpu.deploy.spec import GraphDeploymentSpec, deployment_key
+from dynamo_tpu.planner.connector import LocalConnector
+from dynamo_tpu.store.base import Store
+
+log = logging.getLogger("dynamo_tpu.deploy.operator")
+
+
+@dataclass
+class ReconcileResult:
+    deployment: str
+    actions: list[str] = field(default_factory=list)
+    converged: bool = True
+    errors: list[str] = field(default_factory=list)
+
+
+class Reconciler:
+    """One reconciler per namespace; drives every deployment under it."""
+
+    def __init__(self, store: Store, namespace: str,
+                 interval_s: float = 10.0, max_actions_per_pass: int = 8):
+        self.store = store
+        self.namespace = namespace
+        self.interval_s = interval_s
+        # bound convergence speed: a wild spec change scales gradually,
+        # and one pass can't wedge the supervisor with a command storm
+        self.max_actions = max_actions_per_pass
+        self.connector = LocalConnector(store, namespace)
+        self._task: Optional[asyncio.Task] = None
+
+    # -- desired/actual ----------------------------------------------------
+    async def list_deployments(self) -> list[GraphDeploymentSpec]:
+        prefix = deployment_key(self.namespace, "")
+        entries = await self.store.kv_get_prefix(prefix)
+        specs = []
+        for entry in entries:
+            try:
+                specs.append(GraphDeploymentSpec.from_bytes(entry.value))
+            except Exception as exc:
+                log.warning("skipping bad deployment spec %s: %s", entry.key, exc)
+        return specs
+
+    async def reconcile_once(self) -> list[ReconcileResult]:
+        results = []
+        for spec in await self.list_deployments():
+            results.append(await self._reconcile_deployment(spec))
+        return results
+
+    async def _reconcile_deployment(
+        self, spec: GraphDeploymentSpec
+    ) -> ReconcileResult:
+        res = ReconcileResult(deployment=spec.name)
+        budget = self.max_actions
+        for component, svc in spec.services.items():
+            actual = await self.connector.replicas(component)
+            if actual is None:
+                res.errors.append(f"{component}: no supervisor state")
+                res.converged = False
+                continue
+            delta = svc.replicas - actual
+            while delta > 0 and budget > 0:
+                ok = await self.connector.add_component(component)
+                if not ok:
+                    res.errors.append(f"{component}: add failed")
+                    res.converged = False
+                    break
+                res.actions.append(f"+{component}")
+                delta -= 1
+                budget -= 1
+            while delta < 0 and budget > 0:
+                ok = await self.connector.remove_component(component)
+                if not ok:
+                    res.errors.append(f"{component}: remove failed")
+                    res.converged = False
+                    break
+                res.actions.append(f"-{component}")
+                delta += 1
+                budget -= 1
+            if delta != 0:
+                res.converged = False  # out of budget this pass
+        if res.actions or res.errors:
+            log.info(
+                "reconciled %s: actions=%s errors=%s",
+                spec.name, res.actions, res.errors,
+            )
+        return res
+
+    # -- loop --------------------------------------------------------------
+    async def run(self, shutdown: Optional[asyncio.Event] = None) -> None:
+        shutdown = shutdown or asyncio.Event()
+        while not shutdown.is_set():
+            try:
+                await self.reconcile_once()
+            except Exception:
+                log.exception("reconcile pass failed")
+            try:
+                await asyncio.wait_for(shutdown.wait(), timeout=self.interval_s)
+            except asyncio.TimeoutError:
+                pass
+
+    # -- spec CRUD (shared by api-store and the deploy CLI) ---------------
+    async def apply(self, spec: GraphDeploymentSpec) -> None:
+        spec.validate()
+        if spec.namespace != self.namespace:
+            # a prod spec applied through a dynamo-namespace reconciler
+            # would land where no operator watches it — reject loudly
+            raise ValueError(
+                f"spec namespace {spec.namespace!r} != reconciler "
+                f"namespace {self.namespace!r}"
+            )
+        await self.store.kv_put(
+            deployment_key(self.namespace, spec.name), spec.to_bytes()
+        )
+
+    async def delete(self, name: str) -> bool:
+        return await self.store.kv_delete(deployment_key(self.namespace, name))
+
+    async def status(self) -> dict:
+        """Desired vs actual for every deployment (the CLI's view)."""
+        out: dict = {}
+        for spec in await self.list_deployments():
+            comp_status = {}
+            for component, svc in spec.services.items():
+                actual = await self.connector.replicas(component)
+                comp_status[component] = {
+                    "desired": svc.replicas,
+                    "actual": actual,
+                }
+            out[spec.name] = comp_status
+        return out

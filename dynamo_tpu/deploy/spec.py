@@ -1,0 +1,105 @@
+"""Graph-deployment spec: the declarative desired state the operator
+reconciles toward.
+
+The YAML shape mirrors the reference's ``DynamoGraphDeployment`` CRD
+(reference: deploy/cloud/operator/api/v1alpha1/,
+config/crd/bases/nvidia.com_dynamographdeployments.yaml): apiVersion/
+kind/metadata/spec with per-service replica counts and resources —
+resources here are TPU chips/topology rather than GPUs.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+API_VERSION = "dynamo-tpu.dev/v1alpha1"
+KIND = "DynamoGraphDeployment"
+
+MAX_REPLICAS = 1024
+
+
+@dataclass
+class ServiceSpec:
+    replicas: int = 1
+    tpu_chips: int = 0  # chips per replica (0 = cpu-only component)
+    config: dict[str, Any] = field(default_factory=dict)
+
+    def validate(self, name: str) -> None:
+        if not 0 <= self.replicas <= MAX_REPLICAS:
+            raise ValueError(f"{name}: replicas {self.replicas} out of range")
+        if self.tpu_chips < 0:
+            raise ValueError(f"{name}: negative tpu_chips")
+
+
+@dataclass
+class GraphDeploymentSpec:
+    name: str
+    namespace: str = "dynamo"
+    services: dict[str, ServiceSpec] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        if not self.name or "/" in self.name:
+            raise ValueError(f"bad deployment name {self.name!r}")
+        if not self.services:
+            raise ValueError(f"{self.name}: no services")
+        for sname, svc in self.services.items():
+            svc.validate(sname)
+
+    # -- serialization -----------------------------------------------------
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": KIND,
+            "metadata": {"name": self.name, "namespace": self.namespace},
+            "spec": {
+                "services": {
+                    n: {
+                        "replicas": s.replicas,
+                        "resources": {"tpu": s.tpu_chips},
+                        "config": s.config,
+                    }
+                    for n, s in self.services.items()
+                }
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "GraphDeploymentSpec":
+        if raw.get("kind") not in (None, KIND):
+            raise ValueError(f"unexpected kind {raw.get('kind')!r}")
+        meta = raw.get("metadata") or {}
+        services = {}
+        for name, s in ((raw.get("spec") or {}).get("services") or {}).items():
+            services[name] = ServiceSpec(
+                replicas=int(s.get("replicas", 1)),
+                tpu_chips=int((s.get("resources") or {}).get("tpu", 0)),
+                config=dict(s.get("config") or {}),
+            )
+        spec = cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "dynamo"),
+            services=services,
+        )
+        spec.validate()
+        return spec
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(self.to_dict()).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "GraphDeploymentSpec":
+        return cls.from_dict(json.loads(raw.decode()))
+
+    @classmethod
+    def from_yaml_file(cls, path: str) -> "GraphDeploymentSpec":
+        import yaml
+
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f))
+
+
+def deployment_key(namespace: str, name: str) -> str:
+    """Store key the api-store writes and the operator watches."""
+    return f"{namespace}/deployments/{name}"

@@ -130,31 +130,32 @@ class JaxEngine:
             )
         is_gguf = cfg.model_path.endswith(".gguf")
         gguf_reader = None
-        if is_gguf:
-            # one reader for config AND weights: header parsing decodes
-            # the full embedded vocab, don't pay it twice
-            from dynamo_tpu.gguf import GGUFReader
-
-            gguf_reader = GGUFReader(cfg.model_path)
-        if self.model_config is None:
-            if gguf_reader is not None:
-                from dynamo_tpu.gguf import config_from_gguf
-
-                self.model_config = config_from_gguf(gguf_reader)
-            else:
-                self.model_config = ModelConfig.from_dir(cfg.model_path)
-        self.eos_token_ids = self.model_config.eos_token_ids
-        mesh_cfg = MeshConfig(
-            dp=cfg.data_parallel_size,
-            tp=cfg.tensor_parallel_size,
-            ep=cfg.expert_parallel_size,
-        )
-        devices = jax.devices()[: mesh_cfg.size]
-        self.mesh = build_mesh(mesh_cfg, devices)
-
-        from dynamo_tpu.models import loader
-
         try:
+            if is_gguf and (self.model_config is None or not cfg.random_weights):
+                # one reader for config AND weights: header parsing
+                # decodes the full embedded vocab, don't pay it twice —
+                # and don't pay it at all when neither is needed
+                from dynamo_tpu.gguf import GGUFReader
+
+                gguf_reader = GGUFReader(cfg.model_path)
+            if self.model_config is None:
+                if gguf_reader is not None:
+                    from dynamo_tpu.gguf import config_from_gguf
+
+                    self.model_config = config_from_gguf(gguf_reader)
+                else:
+                    self.model_config = ModelConfig.from_dir(cfg.model_path)
+            self.eos_token_ids = self.model_config.eos_token_ids
+            mesh_cfg = MeshConfig(
+                dp=cfg.data_parallel_size,
+                tp=cfg.tensor_parallel_size,
+                ep=cfg.expert_parallel_size,
+            )
+            devices = jax.devices()[: mesh_cfg.size]
+            self.mesh = build_mesh(mesh_cfg, devices)
+
+            from dynamo_tpu.models import loader
+
             if not cfg.random_weights and gguf_reader is not None:
                 from dynamo_tpu.gguf import load_params_from_gguf
 

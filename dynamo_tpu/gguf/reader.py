@@ -201,9 +201,16 @@ def config_from_gguf(reader: GGUFReader):
     # qwen2-family GGUFs carry QKV bias tensors; detect either way so
     # param_shapes includes bq/bk/bv and loading doesn't silently skip them
     has_bias = arch == "qwen2" or "blk.0.attn_q.bias" in reader.tensors
+    # mistral-family GGUFs export the window; qwen2 disables SWA by
+    # default (parity with ModelConfig.from_dict's use_sliding_window
+    # handling for HF-dir models)
+    window = key("attention.sliding_window")
+    if arch == "qwen2":
+        window = None
     return ModelConfig(
         model_type=arch,
         attention_bias=has_bias,
+        sliding_window=int(window) if window else None,
         vocab_size=int(vocab_size),
         hidden_size=emb,
         intermediate_size=int(key("feed_forward_length", 11008)),
@@ -224,7 +231,7 @@ def tokenizer_from_gguf(reader: GGUFReader):
     (byte-level BPE with merges) and "llama" (sentencepiece-style
     unigram with scores)."""
     from tokenizers import Tokenizer as HfTokenizer
-    from tokenizers import decoders, models, pre_tokenizers
+    from tokenizers import decoders, models, normalizers, pre_tokenizers
 
     from dynamo_tpu.tokenizer import Tokenizer
 
@@ -250,6 +257,12 @@ def tokenizer_from_gguf(reader: GGUFReader):
                 byte_fallback=True,
             )
         )
+        # sentencepiece text normalization: without the Prepend/Replace
+        # pair, plain words never match their "▁word" vocab entries and
+        # everything degrades to byte fallback
+        inner.normalizer = normalizers.Sequence(
+            [normalizers.Prepend("▁"), normalizers.Replace(" ", "▁")]
+        )
         # byte-fallback tokens (<0x0A> etc.) must decode to real bytes,
         # not literal text
         inner.decoder = decoders.Sequence(
@@ -257,6 +270,8 @@ def tokenizer_from_gguf(reader: GGUFReader):
                 decoders.Replace("▁", " "),
                 decoders.ByteFallback(),
                 decoders.Fuse(),
+                # drop the space the Prepend normalizer added at encode
+                decoders.Strip(" ", 1, 0),
             ]
         )
     else:
@@ -382,6 +397,10 @@ def write_gguf(
     shape (dims are reversed on disk per GGUF ne-order); ``quantize``
     optionally maps tensor name -> GGML_Q8_0 to store Q8_0."""
     quantize = quantize or {}
+    if alignment != 32:
+        # the reader defaults to 32: a non-default alignment must be
+        # declared or every tensor offset lands wrong
+        metadata = {**metadata, "general.alignment": alignment}
 
     def encode(name: str, arr: np.ndarray) -> tuple[int, bytes]:
         gt = quantize.get(name)

@@ -1,0 +1,194 @@
+"""Deploy tier: graph-deployment specs, the operator-lite reconciler,
+and the api-store REST surface (reference: deploy/cloud/operator CRDs +
+controllers, deploy/cloud/api-store)."""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.deploy import ApiStore, GraphDeploymentSpec, Reconciler, ServiceSpec
+from dynamo_tpu.deploy.spec import deployment_key
+from dynamo_tpu.sdk.serving import CONTROL_SUBJECT, state_key
+from dynamo_tpu.store.memory import MemoryStore
+
+
+def test_spec_roundtrip_and_validation(tmp_path):
+    spec = GraphDeploymentSpec(
+        name="disagg",
+        services={
+            "backend": ServiceSpec(replicas=2, tpu_chips=4),
+            "prefill": ServiceSpec(replicas=1, tpu_chips=4, config={"x": 1}),
+        },
+    )
+    spec.validate()
+    back = GraphDeploymentSpec.from_bytes(spec.to_bytes())
+    assert back == spec
+    d = spec.to_dict()
+    assert d["kind"] == "DynamoGraphDeployment"
+    assert d["spec"]["services"]["backend"]["resources"]["tpu"] == 4
+
+    yaml_path = tmp_path / "spec.yaml"
+    import yaml
+
+    yaml_path.write_text(yaml.safe_dump(d))
+    assert GraphDeploymentSpec.from_yaml_file(str(yaml_path)) == spec
+
+    with pytest.raises(ValueError, match="no services"):
+        GraphDeploymentSpec(name="empty").validate()
+    with pytest.raises(ValueError, match="out of range"):
+        GraphDeploymentSpec(
+            name="big", services={"a": ServiceSpec(replicas=99999)}
+        ).validate()
+    with pytest.raises(ValueError, match="kind"):
+        GraphDeploymentSpec.from_dict({"kind": "Pod"})
+
+
+class FakeSupervisor:
+    """Answers supervisor control commands + publishes replica state
+    (stands in for sdk/serving.py Supervisor)."""
+
+    def __init__(self, store: MemoryStore, namespace: str,
+                 initial: dict[str, int]):
+        self.store = store
+        self.namespace = namespace
+        self.counts = dict(initial)
+        self.fail_ops = 0  # fail the next N commands
+        self._task: asyncio.Task | None = None
+
+    async def start(self):
+        await self._publish()
+        self._sub = await self.store.subscribe(
+            f"{self.namespace}.{CONTROL_SUBJECT}"
+        )
+        self._task = asyncio.create_task(self._loop())
+
+    async def _loop(self):
+        async for _subj, data in self._sub:
+            cmd = json.loads(data.decode())
+            comp = cmd["component"]
+            if self.fail_ops > 0:
+                self.fail_ops -= 1
+                reply = {"ok": False, "error": "injected"}
+            else:
+                delta = 1 if cmd["op"] == "add" else -1
+                self.counts[comp] = max(0, self.counts.get(comp, 0) + delta)
+                await self._publish()
+                reply = {"ok": True}
+            await self.store.publish(
+                cmd["reply_to"], json.dumps(reply).encode()
+            )
+
+    async def _publish(self):
+        state = {
+            "components": {
+                c: {"replicas": n, "names": []} for c, n in self.counts.items()
+            }
+        }
+        await self.store.kv_put(
+            state_key(self.namespace), json.dumps(state).encode()
+        )
+
+    async def stop(self):
+        if self._task:
+            self._task.cancel()
+        await self._sub.close()
+
+
+async def test_reconciler_converges_and_bounds_actions():
+    store = MemoryStore()
+    sup = FakeSupervisor(store, "ns", {"backend": 1, "prefill": 2})
+    await sup.start()
+    rec = Reconciler(store, "ns", max_actions_per_pass=2)
+    await rec.apply(GraphDeploymentSpec(
+        name="d1", namespace="ns",
+        services={"backend": ServiceSpec(replicas=4),
+                  "prefill": ServiceSpec(replicas=0)},
+    ))
+    # pass 1: budget 2 -> +backend, +backend, not converged
+    r1 = (await rec.reconcile_once())[0]
+    assert r1.actions == ["+backend", "+backend"] and not r1.converged
+    # pass 2: +backend, then -prefill x2
+    r2 = (await rec.reconcile_once())[0]
+    assert r2.actions.count("+backend") == 1
+    # remaining passes finish the scale-down, then go quiescent
+    for _ in range(3):
+        last = (await rec.reconcile_once())[0]
+        if last.converged and not last.actions:
+            break
+    assert last.converged and not last.actions
+    assert sup.counts == {"backend": 4, "prefill": 0}
+
+    status = await rec.status()
+    assert status["d1"]["backend"] == {"desired": 4, "actual": 4}
+
+    # failed commands surface as errors, not hangs
+    sup.fail_ops = 1
+    await rec.apply(GraphDeploymentSpec(
+        name="d1", namespace="ns",
+        services={"backend": ServiceSpec(replicas=5),
+                  "prefill": ServiceSpec(replicas=0)},
+    ))
+    r = (await rec.reconcile_once())[0]
+    assert r.errors and not r.converged
+    await sup.stop()
+    await store.close()
+
+
+async def test_apply_rejects_namespace_mismatch():
+    store = MemoryStore()
+    rec = Reconciler(store, "dynamo")
+    with pytest.raises(ValueError, match="namespace"):
+        await rec.apply(GraphDeploymentSpec(
+            name="x", namespace="prod",
+            services={"a": ServiceSpec(replicas=1)},
+        ))
+    await store.close()
+
+
+async def test_reconciler_skips_bad_specs():
+    store = MemoryStore()
+    await store.kv_put(deployment_key("ns", "junk"), b"{not json")
+    rec = Reconciler(store, "ns")
+    assert await rec.list_deployments() == []
+    await store.close()
+
+
+async def test_api_store_crud_and_status():
+    import aiohttp
+
+    store = MemoryStore()
+    sup = FakeSupervisor(store, "ns", {"backend": 1})
+    await sup.start()
+    rec = Reconciler(store, "ns")
+    api = ApiStore(rec, host="127.0.0.1", port=0)
+    await api.start()
+    base = f"http://127.0.0.1:{api.port}/api/v1"
+    doc = GraphDeploymentSpec(
+        name="d2", namespace="ns",
+        services={"backend": ServiceSpec(replicas=1)},
+    ).to_dict()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.put(f"{base}/deployments/d2", json=doc) as r:
+                assert r.status == 200
+            async with s.put(f"{base}/deployments/other", json=doc) as r:
+                assert r.status == 400  # name mismatch
+            async with s.put(f"{base}/deployments/bad", json={"kind": "Pod"}) as r:
+                assert r.status == 400
+            async with s.get(f"{base}/deployments") as r:
+                items = (await r.json())["items"]
+                assert [i["metadata"]["name"] for i in items] == ["d2"]
+            async with s.get(f"{base}/deployments/d2") as r:
+                assert (await r.json())["metadata"]["name"] == "d2"
+            async with s.get(f"{base}/status") as r:
+                st = await r.json()
+                assert st["d2"]["backend"] == {"desired": 1, "actual": 1}
+            async with s.delete(f"{base}/deployments/d2") as r:
+                assert (await r.json())["deleted"] == "d2"
+            async with s.delete(f"{base}/deployments/d2") as r:
+                assert r.status == 404
+    finally:
+        await api.stop()
+        await sup.stop()
+        await store.close()

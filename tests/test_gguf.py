@@ -93,8 +93,8 @@ def test_config_from_gguf_detects_qkv_bias(tmp_path):
 
 def test_tokenizer_from_gguf_unigram_byte_fallback(tmp_path):
     path = str(tmp_path / "u.gguf")
-    tokens = ["<unk>", "▁hi", "there"] + [f"<0x{b:02X}>" for b in range(256)]
-    scores = [0.0, -1.0, -1.0] + [-10.0] * 256
+    tokens = ["<unk>", "▁hi", "▁there", "▁"] + [f"<0x{b:02X}>" for b in range(256)]
+    scores = [0.0, -1.0, -1.0, -5.0] + [-10.0] * 256
     write_gguf(path, {
         "tokenizer.ggml.model": "llama",
         "tokenizer.ggml.tokens": tokens,
@@ -103,10 +103,39 @@ def test_tokenizer_from_gguf_unigram_byte_fallback(tmp_path):
     }, {"t": np.zeros((1, 32), np.float32)})
     with GGUFReader(path) as r:
         tok = tokenizer_from_gguf(r)
+    # sentencepiece normalization: words match their ▁-prefixed vocab
+    # entries instead of degenerating to byte fallback
+    assert tok.encode("hi there") == [1, 2]
+    assert tok.decode([1, 2]) == "hi there"
     # newline has no vocab token: must byte-fallback, not collapse to unk
     ids = tok.encode("\n")
     assert ids and all(i != 0 for i in ids)
     assert tok.decode(ids) == "\n"
+
+
+def test_write_gguf_nondefault_alignment_roundtrips(tmp_path):
+    path = str(tmp_path / "a.gguf")
+    arr = np.arange(24, dtype=np.float32).reshape(4, 6)
+    write_gguf(path, {}, {"t": arr}, alignment=64)
+    with GGUFReader(path) as r:
+        np.testing.assert_array_equal(r.load("t"), arr)
+
+
+def test_config_from_gguf_sliding_window(tmp_path):
+    path = str(tmp_path / "sw.gguf")
+    write_gguf(path, {
+        "general.architecture": "mistral",
+        "mistral.attention.sliding_window": 4096,
+    }, {"t": np.zeros((1, 32), np.float32)})
+    with GGUFReader(path) as r:
+        assert config_from_gguf(r).sliding_window == 4096
+    path2 = str(tmp_path / "sw2.gguf")
+    write_gguf(path2, {
+        "general.architecture": "qwen2",
+        "qwen2.attention.sliding_window": 32768,
+    }, {"t": np.zeros((1, 32), np.float32)})
+    with GGUFReader(path2) as r:
+        assert config_from_gguf(r).sliding_window is None
 
 
 def test_tokenizer_from_gguf_bpe(tmp_path):
