@@ -59,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--max-tokens-default", type=int, default=None)
     # engine knobs (reference: flags.rs)
     run.add_argument("--tensor-parallel-size", type=int, default=1)
+    run.add_argument("--sequence-parallel-size", type=int, default=1,
+                     help="prefill role only: shard the prompt over an "
+                          "sp mesh axis (ring attention)")
+    run.add_argument("--sp-attn", default="ring", choices=["ring", "ulysses"])
     run.add_argument("--num-nodes", type=int, default=1)
     run.add_argument("--node-rank", type=int, default=0)
     run.add_argument("--leader-addr", default="")
@@ -420,6 +424,9 @@ async def _run_prefill_worker(args: Any) -> None:
         if args.in_mode.startswith(DYN_SCHEME)
         else args.namespace
     )
+    if getattr(args, "sequence_parallel_size", 1) > 1:
+        await _run_sp_prefill_worker(args, ns)
+        return
     _, _, jax_engine = await _build_core_engine(args)
     assert jax_engine is not None
     drt = await DistributedRuntime.create(config=_runtime_config(args))
@@ -435,6 +442,48 @@ async def _run_prefill_worker(args: Any) -> None:
     await run_prefill_worker(jax_engine, drt.store, ns, shutdown)
     watcher.cancel()
     await jax_engine.shutdown()
+    await drt.shutdown()
+
+
+async def _run_sp_prefill_worker(args: Any, ns: str) -> None:
+    """Sequence-parallel prefill worker: the prompt shards over an sp
+    mesh with ring/Ulysses attention (parallel/long_context.py) and the
+    resulting KV blocks ship over the normal disagg transfer plane."""
+    import jax
+
+    from dynamo_tpu.disagg.worker import run_prefill_worker
+    from dynamo_tpu.engine import load_engine_config
+    from dynamo_tpu.models import loader
+    from dynamo_tpu.parallel.long_context import LongContextPrefiller
+    from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    ecfg = load_engine_config(args)
+    sp = args.sequence_parallel_size
+    mesh = build_mesh(MeshConfig(sp=sp), jax.devices()[:sp])
+    mc, params = loader.resolve_model(
+        ecfg.model_path, random_weights=ecfg.random_weights, seed=ecfg.seed
+    )
+    prefiller = LongContextPrefiller(
+        mc, params, mesh, block_size=ecfg.block_size, attn=args.sp_attn,
+        kv_dtype=ecfg.kv_cache_dtype,
+    )
+    drt = await DistributedRuntime.create(config=_runtime_config(args))
+    drt.runtime.install_signal_handlers()
+    print(
+        f"sp-prefill worker (sp={sp}, {args.sp_attn}) consuming "
+        f"{ns}_prefill_queue",
+        flush=True,
+    )
+    shutdown = asyncio.Event()
+
+    async def _watch_shutdown() -> None:
+        await drt.runtime.wait_shutdown()
+        shutdown.set()
+
+    watcher = asyncio.create_task(_watch_shutdown())
+    await run_prefill_worker(prefiller, drt.store, ns, shutdown)
+    watcher.cancel()
     await drt.shutdown()
 
 

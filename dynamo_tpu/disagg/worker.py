@@ -164,7 +164,7 @@ MAX_PREFILL_ATTEMPTS = 3
 
 
 async def run_prefill_worker(
-    engine: JaxEngine,
+    engine,  # JaxEngine or parallel/long_context.py LongContextPrefiller
     store: Store,
     namespace: str,
     shutdown: asyncio.Event,
@@ -205,7 +205,7 @@ async def run_prefill_worker(
 
 
 async def _prefill_one(
-    engine: JaxEngine, store: Store, req: RemotePrefillRequest, bs: int
+    engine, store: Store, req: RemotePrefillRequest, bs: int
 ) -> None:
     from dynamo_tpu.protocols.common import SamplingOptions, StopConditions
 
@@ -213,20 +213,26 @@ async def _prefill_one(
         raise ValueError(
             f"block_size mismatch: decode {req.block_size} != prefill {bs}"
         )
-    # run the prompt with max_tokens=1: computes + content-addresses the
-    # prompt's full blocks in this engine's cache
-    preq = PreprocessedRequest(
-        request_id=f"prefill-{req.request_id}",
-        token_ids=list(req.token_ids),
-        sampling=SamplingOptions(use_greedy=True),
-        stop=StopConditions(max_tokens=1, ignore_eos=True),
-    )
-    adapter = engine.as_async_engine()
-    async for _ in adapter.generate(preq, Context()):
-        pass
-    tokens = TokenBlockSequence(list(req.token_ids), block_size=bs)
-    hashes = tokens.sequence_hashes()[: len(req.token_ids) // bs]
-    found, packed = await engine.export_kv_blocks(hashes)
+    if hasattr(engine, "prefill_export"):
+        # sequence-parallel prefiller (parallel/long_context.py): the
+        # prompt is sharded over an sp mesh and attended with ring/
+        # Ulysses attention — no engine scheduler involved
+        found, packed = await engine.prefill_export(list(req.token_ids))
+    else:
+        # run the prompt with max_tokens=1: computes + content-addresses
+        # the prompt's full blocks in this engine's cache
+        preq = PreprocessedRequest(
+            request_id=f"prefill-{req.request_id}",
+            token_ids=list(req.token_ids),
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=1, ignore_eos=True),
+        )
+        adapter = engine.as_async_engine()
+        async for _ in adapter.generate(preq, Context()):
+            pass
+        tokens = TokenBlockSequence(list(req.token_ids), block_size=bs)
+        hashes = tokens.sequence_hashes()[: len(req.token_ids) // bs]
+        found, packed = await engine.export_kv_blocks(hashes)
     if not found:
         raise RuntimeError("prefill produced no exportable blocks")
     meta = await TransferClient.fetch_metadata(store, req.transfer_key)
@@ -241,5 +247,6 @@ async def _prefill_one(
     if not ok:
         raise RuntimeError("transfer rejected by decode worker")
     log.info(
-        "prefilled %s: shipped %d/%d blocks", req.request_id, len(found), len(hashes)
+        "prefilled %s: shipped %d/%d blocks",
+        req.request_id, len(found), len(req.token_ids) // bs,
     )

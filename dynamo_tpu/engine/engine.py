@@ -47,7 +47,6 @@ from dynamo_tpu.models.llama import (
     CACHE_SPEC,
     forward,
     init_cache,
-    init_params,
     param_specs,
 )
 from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
@@ -128,54 +127,24 @@ class JaxEngine:
                 num_processes=cfg.num_nodes,
                 process_id=cfg.node_rank,
             )
-        is_gguf = cfg.model_path.endswith(".gguf")
-        gguf_reader = None
-        try:
-            if is_gguf and (self.model_config is None or not cfg.random_weights):
-                # one reader for config AND weights: header parsing
-                # decodes the full embedded vocab, don't pay it twice —
-                # and don't pay it at all when neither is needed
-                from dynamo_tpu.gguf import GGUFReader
+        mesh_cfg = MeshConfig(
+            dp=cfg.data_parallel_size,
+            tp=cfg.tensor_parallel_size,
+            ep=cfg.expert_parallel_size,
+        )
+        devices = jax.devices()[: mesh_cfg.size]
+        self.mesh = build_mesh(mesh_cfg, devices)
 
-                gguf_reader = GGUFReader(cfg.model_path)
-            if self.model_config is None:
-                if gguf_reader is not None:
-                    from dynamo_tpu.gguf import config_from_gguf
+        from dynamo_tpu.models import loader
 
-                    self.model_config = config_from_gguf(gguf_reader)
-                else:
-                    self.model_config = ModelConfig.from_dir(cfg.model_path)
-            self.eos_token_ids = self.model_config.eos_token_ids
-            mesh_cfg = MeshConfig(
-                dp=cfg.data_parallel_size,
-                tp=cfg.tensor_parallel_size,
-                ep=cfg.expert_parallel_size,
-            )
-            devices = jax.devices()[: mesh_cfg.size]
-            self.mesh = build_mesh(mesh_cfg, devices)
-
-            from dynamo_tpu.models import loader
-
-            if not cfg.random_weights and gguf_reader is not None:
-                from dynamo_tpu.gguf import load_params_from_gguf
-
-                self.params = load_params_from_gguf(
-                    self.model_config, gguf_reader, self.mesh
-                )
-            elif (
-                not cfg.random_weights
-                and cfg.model_path
-                and loader.has_weights(cfg.model_path)
-            ):
-                self.params = loader.load_params(
-                    self.model_config, cfg.model_path, self.mesh
-                )
-            else:
-                log.warning("initializing RANDOM weights (no checkpoint found)")
-                self.params = init_params(self.model_config, cfg.seed, self.mesh)
-        finally:
-            if gguf_reader is not None:
-                gguf_reader.close()
+        self.model_config, self.params = loader.resolve_model(
+            cfg.model_path,
+            model_config=self.model_config,
+            random_weights=cfg.random_weights,
+            seed=cfg.seed,
+            mesh=self.mesh,
+        )
+        self.eos_token_ids = self.model_config.eos_token_ids
 
         num_blocks = cfg.num_blocks or self._auto_num_blocks(devices)
         self.k_cache, self.v_cache = init_cache(

@@ -69,6 +69,51 @@ def has_weights(model_dir: str) -> bool:
     return bool(glob.glob(os.path.join(model_dir, "*.safetensors")))
 
 
+def resolve_model(
+    model_path: str,
+    model_config: Optional[ModelConfig] = None,
+    random_weights: bool = False,
+    seed: int = 0,
+    mesh: Optional[Mesh] = None,
+):
+    """Single entry for model bring-up: (ModelConfig, Params) from a
+    single-file GGUF, an HF-format directory, or random init. The one
+    copy of the load-priority cascade — the engine and the
+    sequence-parallel prefill worker both go through here."""
+    from dynamo_tpu.models.llama import init_params
+
+    is_gguf = bool(model_path) and model_path.endswith(".gguf")
+    reader = None
+    try:
+        if is_gguf and (model_config is None or not random_weights):
+            # one reader for config AND weights: header parsing decodes
+            # the full embedded vocab, don't pay it twice — and don't
+            # pay it at all when neither is needed
+            from dynamo_tpu.gguf import GGUFReader
+
+            reader = GGUFReader(model_path)
+        if model_config is None:
+            if reader is not None:
+                from dynamo_tpu.gguf import config_from_gguf
+
+                model_config = config_from_gguf(reader)
+            else:
+                model_config = ModelConfig.from_dir(model_path)
+        if not random_weights and reader is not None:
+            from dynamo_tpu.gguf import load_params_from_gguf
+
+            params = load_params_from_gguf(model_config, reader, mesh)
+        elif not random_weights and model_path and has_weights(model_path):
+            params = load_params(model_config, model_path, mesh)
+        else:
+            log.warning("initializing RANDOM weights (no checkpoint found)")
+            params = init_params(model_config, seed, mesh)
+        return model_config, params
+    finally:
+        if reader is not None:
+            reader.close()
+
+
 class _ShardedCheckpoint:
     """Lazily reads tensors across sharded safetensors files."""
 

@@ -7,16 +7,19 @@ paged_attention_v1). Semantics match
 ``models.llama.paged_attention_reference`` for T=1 queries.
 
 Design (see /opt/skills/guides/pallas_guide.md):
-- grid = (batch, kv_head, page): pages iterate innermost, so the
-  flash-attention running (max, sum, acc) state lives in VMEM scratch
-  across page steps; Pallas double-buffers the per-page K/V fetches
-  from HBM automatically.
+- grid = (batch, page): pages iterate innermost, so the flash-attention
+  running (max, sum, acc) state lives in VMEM scratch across page
+  steps; Pallas double-buffers the per-page K/V fetches from HBM
+  automatically.
+- each step fetches one whole page ``[block_size, Hkv, Dh]`` — every
+  blocked trailing dim equals the full array dim, which is what the
+  Mosaic TPU lowering requires (trailing block dims must be ×8/×128 or
+  full), and one fetch serves all ``H`` query heads (GQA groups are a
+  reshape in-kernel, no ``jnp.repeat`` materialization).
 - ``block_tables`` and ``context_lens`` ride as scalar-prefetch args:
   the page index_map dereferences the block table *before* the body
   runs, so only the pages a sequence actually references are pulled
-  into VMEM — no [B, S, H, Dh] gather materialization, no
-  ``jnp.repeat`` over GQA groups (the kv head's page is shared by all
-  ``H // Hkv`` query heads in the program).
+  into VMEM — no [B, S, H, Dh] gather materialization.
 - pages past a sequence's context length are masked out AND their
   compute is skipped via ``pl.when``.
 
@@ -39,19 +42,19 @@ from jax.experimental.pallas import tpu as pltpu
 def _decode_kernel(
     tables_ref,  # scalar prefetch: [B, W] int32
     ctx_ref,  # scalar prefetch: [B] int32
-    q_ref,  # [1, 1, G, Dh]
-    k_ref,  # [1, bs, 1, Dh] — page j of kv head h
-    v_ref,  # [1, bs, 1, Dh]
-    o_ref,  # [1, 1, G, Dh]
-    acc_ref,  # VMEM scratch [G, Dh] f32
-    m_ref,  # VMEM scratch [G, 1] f32
-    l_ref,  # VMEM scratch [G, 1] f32
+    q_ref,  # [1, H, Dh]
+    k_ref,  # [1, bs, Hk, Dh] — page j of the sequence
+    v_ref,  # [1, bs, Hk, Dh]
+    o_ref,  # [1, H, Dh]
+    acc_ref,  # VMEM scratch [H, Dh] f32
+    m_ref,  # VMEM scratch [H, 1] f32
+    l_ref,  # VMEM scratch [H, 1] f32
     *,
     block_size: int,
     scale: float,
 ):
     b = pl.program_id(0)
-    j = pl.program_id(2)
+    j = pl.program_id(1)
 
     @pl.when(j == 0)
     def _init():
@@ -63,12 +66,26 @@ def _decode_kernel(
 
     @pl.when(j * block_size < ctx)
     def _page():
-        q = q_ref[0, 0].astype(jnp.float32)  # [G, Dh]
-        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [bs, Dh]
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [G, bs]
+        H, Dh = q_ref.shape[1], q_ref.shape[2]
+        bs, Hk = k_ref.shape[1], k_ref.shape[2]
+        G = H // Hk
+        q = q_ref[0].astype(jnp.float32)  # [H, Dh]
+        k = k_ref[0].astype(jnp.float32)  # [bs, Hk, Dh]
+        v = v_ref[0].astype(jnp.float32)
+        # GQA: group query heads over their shared KV head. Unrolled
+        # per-KV-head matmuls — Mosaic has no batched dot_general with
+        # differing batch positions, and Hk is small and static.
+        qg = q.reshape(Hk, G, Dh)
+        s = jnp.concatenate(
+            [
+                jax.lax.dot_general(
+                    qg[hk], k[:, hk, :], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                for hk in range(Hk)
+            ],
+            axis=0,
+        ) * scale  # [H, bs]
         pos = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_size), 1
         )
@@ -80,15 +97,24 @@ def _decode_kernel(
         p = jnp.where(valid, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
-            p, v, preferred_element_type=jnp.float32
-        )
+        pg = p.reshape(Hk, G, bs)
+        pv = jnp.concatenate(
+            [
+                jax.lax.dot_general(
+                    pg[hk], v[:, hk, :], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                for hk in range(Hk)
+            ],
+            axis=0,
+        )  # [H, Dh]
+        acc_ref[:] = acc_ref[:] * alpha + pv
         m_ref[:] = m_new
 
-    @pl.when(j == pl.num_programs(2) - 1)
+    @pl.when(j == pl.num_programs(1) - 1)
     def _finish():
         # padded batch rows have ctx == 0 -> l == 0; clamp instead of NaN
-        o_ref[0, 0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-9)).astype(
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-9)).astype(
             o_ref.dtype
         )
 
@@ -108,42 +134,36 @@ def paged_attention_decode(
     S, Hk, _ = k_cache_l.shape
     N = S // block_size
     W = block_tables.shape[1]
-    G = H // Hk
     scale = 1.0 / math.sqrt(Dh)
 
-    qg = q.reshape(B, Hk, G, Dh)
     kp = k_cache_l.reshape(N, block_size, Hk, Dh)
     vp = v_cache_l.reshape(N, block_size, Hk, Dh)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # block_tables, context_lens
-        grid=(B, Hk, W),
+        grid=(B, W),
         in_specs=[
+            pl.BlockSpec((1, H, Dh), lambda b, j, t, c: (b, 0, 0)),
             pl.BlockSpec(
-                (1, 1, G, Dh), lambda b, h, j, t, c: (b, h, 0, 0)
+                (1, block_size, Hk, Dh),
+                lambda b, j, t, c: (t[b, j], 0, 0, 0),
             ),
             pl.BlockSpec(
-                (1, block_size, 1, Dh),
-                lambda b, h, j, t, c: (t[b, j], 0, h, 0),
-            ),
-            pl.BlockSpec(
-                (1, block_size, 1, Dh),
-                lambda b, h, j, t, c: (t[b, j], 0, h, 0),
+                (1, block_size, Hk, Dh),
+                lambda b, j, t, c: (t[b, j], 0, 0, 0),
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, G, Dh), lambda b, h, j, t, c: (b, h, 0, 0)
-        ),
+        out_specs=pl.BlockSpec((1, H, Dh), lambda b, j, t, c: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((G, Dh), jnp.float32),
-            pltpu.VMEM((G, 1), jnp.float32),
-            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((H, Dh), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
         ],
     )
     out = pl.pallas_call(
         functools.partial(_decode_kernel, block_size=block_size, scale=scale),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hk, G, Dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, Dh), q.dtype),
         interpret=interpret,
-    )(block_tables, context_lens, qg, kp, vp)
-    return out.reshape(B, H, Dh)
+    )(block_tables, context_lens, q, kp, vp)
+    return out

@@ -1,0 +1,214 @@
+"""Sequence-parallel long-context prefill.
+
+The reference has no long-context scaling (SURVEY.md §5: no ring/
+Ulysses/context-parallel anywhere); it routes long prompts to dedicated
+prefill engines and offloads KV. The TPU build makes long context
+first-class: a prefill worker can shard the PROMPT over an ``sp`` mesh
+axis and run exact causal attention with ring (ICI-neighbor ppermute)
+or Ulysses (all-to-all) communication — parallel/ring_attention.py —
+then hand the resulting KV blocks to the normal disagg transfer plane.
+Decode workers stay tensor-parallel; the prefill-sp ↔ decode-tp handoff
+rides the same content-hash-addressed block shipment as every other
+remote prefill (disagg/worker.py), so sequence parallelism composes
+with disaggregation instead of complicating the decode engine.
+
+Design notes (TPU-first):
+- prompts pad to a multiple of the sp degree; causal masking keeps pad
+  positions from influencing real ones, and padded KV is dropped before
+  packing (only full token blocks ship);
+- the transformer body is the same stacked-layer ``lax.scan`` as
+  models/llama.py, with per-layer K/V (post-RoPE) stacked as scan
+  outputs — exactly the paged cache's content, just dense;
+- sp-mesh prefill runs tp=1: head sharding belongs to decode. The
+  transfer plane's head-slice path covers multi-host TP prefill
+  (ops/kv_rearrange.py) if both are ever combined.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import (
+    Params,
+    _moe_mlp,
+    layer_param_names,
+    rmsnorm,
+    rope,
+)
+from dynamo_tpu.parallel.ring_attention import ring_attention, ulysses_attention
+from dynamo_tpu.tokens import TokenBlockSequence
+
+
+def long_prefill(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jax.Array,  # [1, T] int32, T divisible by mesh sp degree
+    mesh: Mesh,
+    attn: str = "ring",
+    last_idx: Optional[jax.Array] = None,  # index of the last REAL token
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Full-prompt forward with sequence-sharded attention.
+
+    Returns (last_logits [1, V], k [L, T, Hkv, Dh], v [L, T, Hkv, Dh]).
+    ``last_idx`` points at the last real token when the prompt was
+    padded (logits are taken there, not at a pad position).
+    """
+    if cfg.sliding_window is not None:
+        # ring/ulysses attention here is full-causal; serving a
+        # sliding-window model through it would silently export KV the
+        # decode engine disagrees with
+        raise ValueError(
+            "sequence-parallel prefill does not support sliding-window "
+            "models yet"
+        )
+    H, Hk, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    B, T = tokens.shape
+    attend = ring_attention if attn == "ring" else ulysses_attention
+    positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+
+    x = jnp.take(params["embed"], tokens, axis=0)  # [1, T, D]
+
+    def layer_fn(x, lp):
+        h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if cfg.attention_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(B, T, H, Dh)
+        k = k.reshape(B, T, Hk, Dh)
+        v = v.reshape(B, T, Hk, Dh)
+        q, k = rope(q, k, positions, cfg.rope_theta)
+        a = attend(q, k, v, mesh)
+        x = x + (a.reshape(B, T, H * Dh) @ lp["wo"]).astype(x.dtype)
+        h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        if cfg.is_moe:
+            x = x + _moe_mlp(cfg, lp, h).astype(x.dtype)
+        else:
+            mlp = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+            x = x + mlp.astype(x.dtype)
+        return x, (k, v)
+
+    layer_params = {n: params[n] for n in layer_param_names(params)}
+    x, (ks, vs) = jax.lax.scan(layer_fn, x, layer_params)
+    x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
+    if last_idx is None:
+        last_idx = jnp.asarray(T - 1, jnp.int32)
+    x_last = jax.lax.dynamic_index_in_dim(x, last_idx, axis=1, keepdims=False)
+    logits = (x_last @ params["lm_head"]).astype(jnp.float32)
+    # [L, 1, T, Hk, Dh] -> [L, T, Hk, Dh]
+    return logits, ks[:, 0], vs[:, 0]
+
+
+def kv_to_packed_blocks(
+    k: np.ndarray, v: np.ndarray, block_size: int, n_tokens: int
+) -> np.ndarray:
+    """Dense per-token KV [L, T, Hkv, Dh] -> packed transfer blocks
+    [n_full_blocks, 2, L, block_size, Hkv, Dh] (the kvbm/layout.py wire
+    shape); the partial tail block is dropped (decode recomputes it)."""
+    n_blocks = n_tokens // block_size
+    L, _, Hk, Dh = k.shape
+    out = np.empty((n_blocks, 2, L, block_size, Hk, Dh), k.dtype)
+    for b in range(n_blocks):
+        sl = slice(b * block_size, (b + 1) * block_size)
+        out[b, 0] = k[:, sl]
+        out[b, 1] = v[:, sl]
+    return out
+
+
+class LongContextPrefiller:
+    """Duck-types what the disagg prefill loop needs (config.block_size +
+    prefill_export) while running sequence-parallel instead of through an
+    engine scheduler."""
+
+    def __init__(
+        self,
+        model_config: ModelConfig,
+        params: Params,
+        mesh: Mesh,
+        block_size: int,
+        attn: str = "ring",
+        kv_dtype: str = "bfloat16",
+    ):
+        if "sp" not in mesh.axis_names:
+            raise ValueError("LongContextPrefiller needs an 'sp' mesh axis")
+        if model_config.sliding_window is not None:
+            raise ValueError(
+                "sequence-parallel prefill does not support sliding-window "
+                "models yet"
+            )
+        self.model_config = model_config
+        self.params = params
+        self.mesh = mesh
+        self.sp = mesh.shape["sp"]
+        self.attn = attn
+        self.kv_dtype = kv_dtype
+
+        from dataclasses import dataclass
+
+        @dataclass
+        class _Cfg:
+            block_size: int
+
+        self.config = _Cfg(block_size=block_size)
+        # mesh is closed over (not a traceable argument)
+        self._fn = jax.jit(
+            functools.partial(long_prefill, model_config, mesh=mesh, attn=attn)
+        )
+
+    def _pad(self, token_ids: list[int]) -> tuple[np.ndarray, int]:
+        T = len(token_ids)
+        # pad to a multiple of sp so the sequence shards evenly; causal
+        # masking keeps pad positions from influencing real ones
+        Tp = -(-T // self.sp) * self.sp
+        arr = np.zeros((1, Tp), np.int32)
+        arr[0, :T] = token_ids
+        return arr, T
+
+    def prefill(self, token_ids: list[int]) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """-> (last_logits [V], k [L, T, Hkv, Dh], v) for the REAL tokens."""
+        arr, T = self._pad(token_ids)
+        sharding = NamedSharding(self.mesh, P(None, "sp"))
+        arr = jax.device_put(arr, sharding)
+        with self.mesh:
+            logits, k, v = self._fn(
+                self.params, arr, last_idx=jnp.asarray(T - 1, jnp.int32)
+            )
+        last = np.asarray(logits)[0]
+        return last, np.asarray(k[:, :T]), np.asarray(v[:, :T])
+
+    async def prefill_export(
+        self, token_ids: list[int]
+    ) -> tuple[list[int], np.ndarray]:
+        """Disagg hook: -> (block sequence hashes, packed blocks)."""
+        bs = self.config.block_size
+        loop = asyncio.get_running_loop()
+
+        def run():
+            _, k, v = self.prefill(token_ids)
+            packed = kv_to_packed_blocks(
+                k.astype(_np_dtype(self.kv_dtype)),
+                v.astype(_np_dtype(self.kv_dtype)),
+                bs,
+                len(token_ids),
+            )
+            return packed
+
+        packed = await loop.run_in_executor(None, run)
+        tokens = TokenBlockSequence(list(token_ids), block_size=bs)
+        hashes = tokens.sequence_hashes()[: len(token_ids) // bs]
+        return hashes[: packed.shape[0]], packed
+
+
+def _np_dtype(name: str):
+    from dynamo_tpu.kvbm.layout import resolve_dtype
+
+    return resolve_dtype(name)

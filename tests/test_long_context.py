@@ -1,0 +1,153 @@
+"""Sequence-parallel long-context prefill (parallel/long_context.py):
+ring/Ulysses-sharded prompt processing whose KV feeds the paged decode
+engine through the disagg plane. The reference has no long-context
+scaling (SURVEY.md §5) — this is TPU-native added capability, so the
+tests pin it to the engine's own prefill for equivalence."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import init_params
+from dynamo_tpu.parallel.long_context import (
+    LongContextPrefiller,
+    kv_to_packed_blocks,
+    long_prefill,
+)
+from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
+
+CFG = ModelConfig(
+    vocab_size=128, hidden_size=32, intermediate_size=64,
+    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+    max_position_embeddings=256,
+)
+
+
+def _dense_oracle(cfg, params, tokens):
+    """Plain full attention forward returning (last_logits, per-layer KV)."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.llama import (
+        _moe_mlp, layer_param_names, rmsnorm, rope,
+    )
+
+    H, Hk, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    B, T = tokens.shape
+    positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+    x = jnp.take(params["embed"], tokens, axis=0)
+    ks, vs = [], []
+    lp_all = {n: params[n] for n in layer_param_names(params)}
+    for i in range(cfg.num_hidden_layers):
+        lp = {n: lp_all[n][i] for n in lp_all}
+        h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ lp["wq"]).reshape(B, T, H, Dh)
+        k = (h @ lp["wk"]).reshape(B, T, Hk, Dh)
+        v = (h @ lp["wv"]).reshape(B, T, Hk, Dh)
+        q, k = rope(q, k, positions, cfg.rope_theta)
+        ks.append(k[0]); vs.append(v[0])
+        group = H // Hk
+        kk = jnp.repeat(k, group, axis=2)
+        vv = jnp.repeat(v, group, axis=2)
+        s = jnp.einsum("bthd,bshd->bhts", q, kk).astype(jnp.float32) / np.sqrt(Dh)
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        a = jnp.einsum("bhts,bshd->bthd", p, vv)
+        x = x + (a.reshape(B, T, H * Dh) @ lp["wo"]).astype(x.dtype)
+        h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        mlp = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+        x = x + mlp.astype(x.dtype)
+    x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = (x[:, -1] @ params["lm_head"]).astype(jnp.float32)
+    return np.asarray(logits), np.stack([np.asarray(k) for k in ks]), np.stack(
+        [np.asarray(v) for v in vs]
+    )
+
+
+@pytest.mark.parametrize("attn", ["ring", "ulysses"])
+def test_long_prefill_matches_dense_oracle(attn):
+    params = init_params(CFG, seed=0)
+    T = 32
+    tokens = np.random.default_rng(0).integers(1, 100, (1, T)).astype(np.int32)
+    # ulysses reshards heads over sp: needs Hkv (=2) divisible by sp
+    sp = 4 if attn == "ring" else 2
+    mesh = build_mesh(MeshConfig(sp=sp), jax.devices()[:sp])
+    logits, k, v = jax.jit(
+        lambda p, t: long_prefill(CFG, p, t, mesh, attn=attn)
+    )(params, tokens)
+    ref_logits, ref_k, ref_v = _dense_oracle(CFG, params, tokens)
+    # bf16 weights/activations: tolerate one ulp of bf16 around |x|~2
+    np.testing.assert_allclose(np.asarray(logits), ref_logits, rtol=5e-2, atol=5e-2)
+    np.testing.assert_allclose(np.asarray(k), ref_k, rtol=5e-2, atol=5e-2)
+    np.testing.assert_allclose(np.asarray(v), ref_v, rtol=5e-2, atol=5e-2)
+
+
+def test_kv_to_packed_blocks_layout():
+    L, T, Hk, Dh, bs = 2, 10, 2, 4, 4
+    k = np.arange(L * T * Hk * Dh, dtype=np.float32).reshape(L, T, Hk, Dh)
+    v = -k
+    packed = kv_to_packed_blocks(k, v, bs, T)
+    assert packed.shape == (2, 2, L, bs, Hk, Dh)  # tail (2 tokens) dropped
+    np.testing.assert_array_equal(packed[1, 0, 1], k[1, bs : 2 * bs])
+    np.testing.assert_array_equal(packed[0, 1, 0], v[0, :bs])
+
+
+async def test_sp_prefiller_feeds_decode_engine():
+    """Flagship: KV computed by the sp=4 ring prefiller is imported by a
+    decode engine, which then decodes identically to a purely-local
+    run (the disagg two-worker simulation, sequence-parallel edition)."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    bs = 4
+    params = init_params(CFG, seed=0)
+    mesh = build_mesh(MeshConfig(sp=4), jax.devices()[:4])
+    prefiller = LongContextPrefiller(
+        CFG, params, mesh, block_size=bs, kv_dtype="float32"
+    )
+    prompt = list(np.random.default_rng(1).integers(1, 100, 19))
+    hashes, packed = await prefiller.prefill_export(prompt)
+    assert len(hashes) == len(prompt) // bs == packed.shape[0]
+
+    # padded prompt (19 -> 20): logits must be the last REAL token's
+    last, _, _ = prefiller.prefill(prompt)
+    ref_last, _, _ = _dense_oracle(
+        CFG, params, np.asarray([prompt], np.int32)
+    )
+    np.testing.assert_allclose(last, ref_last[0], rtol=5e-2, atol=5e-2)
+
+    async def decode(with_import: bool) -> list[int]:
+        engine = await JaxEngine.launch(
+            EngineConfig(
+                model_path="", model_name="d", random_weights=True,
+                num_blocks=32, block_size=bs, max_batch_size=2,
+                host_kv_blocks=16, kv_cache_dtype="float32",
+            ),
+            model_config=CFG,
+        )
+        # same weights as the prefiller
+        engine.params = {k: v for k, v in params.items()}
+        if with_import:
+            n = await engine.import_kv_blocks(hashes, packed)
+            assert n == len(hashes)
+        req = PreprocessedRequest(
+            request_id="sp1", token_ids=list(prompt),
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=6, ignore_eos=True),
+        )
+        toks: list[int] = []
+        async for item in engine.as_async_engine().generate(req, Context()):
+            toks.extend(item.token_ids)
+        await engine.shutdown()
+        return toks
+
+    assert await decode(True) == await decode(False)
