@@ -10,7 +10,10 @@ GB/s for v5e): 1.0 would mean perfectly bandwidth-bound decode, so higher
 is better and the number is comparable across rounds.
 
 Env knobs: DYN_BENCH_PLATFORM=cpu for a tiny smoke run; DYN_BENCH_BATCH,
-DYN_BENCH_ISL, DYN_BENCH_OSL to override the workload.
+DYN_BENCH_ISL, DYN_BENCH_OSL to override the workload;
+DYN_BENCH_DECODE_STEPS (default 32) fuses that many decode steps per
+device dispatch (dispatch latency over the remote-chip tunnel otherwise
+dominates the measurement).
 """
 
 from __future__ import annotations
@@ -43,7 +46,10 @@ def _build_config(cpu_mode: bool):
             num_hidden_layers=16, num_attention_heads=32, num_key_value_heads=8,
             max_position_embeddings=8192,
         )
-        workload = dict(batch=32, isl=128, osl=128, num_blocks=4096, block_size=16)
+        # num_blocks None = auto-size from free HBM after weights load;
+        # the fused multi-step scan needs transient headroom, hence the
+        # conservative utilization below
+        workload = dict(batch=32, isl=128, osl=128, num_blocks=None, block_size=16)
     workload["batch"] = int(os.environ.get("DYN_BENCH_BATCH", workload["batch"]))
     workload["isl"] = int(os.environ.get("DYN_BENCH_ISL", workload["isl"]))
     workload["osl"] = int(os.environ.get("DYN_BENCH_OSL", workload["osl"]))
@@ -78,6 +84,8 @@ async def _run(model_cfg, wl) -> dict:
         num_blocks=wl["num_blocks"], block_size=wl["block_size"],
         max_batch_size=wl["batch"], prefill_chunk_size=1024,
         max_model_len=wl["isl"] + wl["osl"] + 8,
+        decode_steps=int(os.environ.get("DYN_BENCH_DECODE_STEPS", "32")),
+        hbm_utilization=0.7,
     )
     # one decode bucket = one decode compile: every step pads to full
     # batch. Compiles are minutes over the chip tunnel; the padded-lane

@@ -63,6 +63,14 @@ def build_parser() -> argparse.ArgumentParser:
                      help="prefill role only: shard the prompt over an "
                           "sp mesh axis (ring attention)")
     run.add_argument("--sp-attn", default="ring", choices=["ring", "ulysses"])
+    # multimodal (vision-language) serving
+    run.add_argument("--vision-config", default=None,
+                     help="VisionConfig JSON: enables image_url content "
+                          "parts (ViT encode + embedding injection)")
+    run.add_argument("--vision-weights", default=None,
+                     help=".npz vision tower weights (default: random)")
+    run.add_argument("--image-token", default="<image>",
+                     help="placeholder token for image patches")
     run.add_argument("--num-nodes", type=int, default=1)
     run.add_argument("--node-rank", type=int, default=0)
     run.add_argument("--leader-addr", default="")
@@ -203,9 +211,43 @@ def _wrap_pipeline(args: Any, core, eos_ids: list[int]):
     from dynamo_tpu.runtime.pipeline import build_pipeline
 
     tokenizer, formatter, model_name = _load_model_assets(args)
-    pre = OpenAIPreprocessor(tokenizer, formatter, model_name=model_name)
+    if getattr(args, "vision_config", None):
+        pre = _build_mm_preprocessor(args, tokenizer, formatter, model_name)
+    else:
+        pre = OpenAIPreprocessor(tokenizer, formatter, model_name=model_name)
     backend = Backend(tokenizer, eos_token_ids=eos_ids)
     return model_name, build_pipeline(pre, backend, core)
+
+
+def _build_mm_preprocessor(args: Any, tokenizer, formatter, model_name: str):
+    """Vision-language pipeline head: ViT encode + placeholder splicing
+    (reference: examples/multimodal encode worker + processor)."""
+    import json
+
+    from dynamo_tpu.models.vision import VisionConfig, load_vision_params
+    from dynamo_tpu.multimodal import MultimodalPreprocessor, VisionEncoder
+
+    with open(args.vision_config) as f:
+        vcfg = VisionConfig.from_dict(json.load(f))
+    vparams = None
+    if args.vision_weights:
+        vparams = load_vision_params(vcfg, args.vision_weights)
+    else:
+        log.warning("vision tower using RANDOM weights (no --vision-weights)")
+    encoder = VisionEncoder(vcfg, params=vparams)
+    image_token_id = tokenizer.token_to_id(args.image_token)
+    if image_token_id is None:
+        raise SystemExit(
+            f"tokenizer has no {args.image_token!r} token; pass --image-token"
+        )
+    return MultimodalPreprocessor(
+        tokenizer,
+        formatter,
+        encode=encoder.encode_urls,
+        image_token_id=image_token_id,
+        tokens_per_image=encoder.tokens_per_image,
+        model_name=model_name,
+    )
 
 
 async def _build_core_engine(args: Any):

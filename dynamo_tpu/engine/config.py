@@ -34,6 +34,12 @@ class EngineConfig:
     max_prefill_tokens: int = 4096
     prefill_chunk_size: int = 1024
     max_model_len: Optional[int] = None
+    # fused multi-step decode: tokens generated per device dispatch.
+    # >1 amortizes host↔device round-trips (the dominant decode cost
+    # when dispatch latency is high); tokens stream in bursts of this
+    # size and up to decode_steps-1 sampled-past-stop tokens are
+    # discarded per finishing request.
+    decode_steps: int = 1
     # weights
     random_weights: bool = False  # bench/test mode: skip checkpoint load
     seed: int = 0

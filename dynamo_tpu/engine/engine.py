@@ -88,6 +88,7 @@ class JaxEngine:
         self.eos_token_ids: list[int] = []
         self._step_fn: Optional[Callable] = None
         self._step_fn_mm: Optional[Callable] = None
+        self._multi_step_fn: Optional[Callable] = None
         self._thread: Optional[threading.Thread] = None
         self._incoming: thread_queue.Queue = thread_queue.Queue()
         self._control: thread_queue.Queue = thread_queue.Queue()
@@ -167,7 +168,9 @@ class JaxEngine:
             prefill_chunk_size=cfg.prefill_chunk_size,
             max_model_len=cfg.max_model_len
             or self.model_config.max_position_embeddings,
+            max_prefill_tokens=cfg.max_prefill_tokens,
         )
+        self.scheduler.decode_lookahead = max(1, cfg.decode_steps)
         self.scheduler.on_finish = self._emit_finish
         if cfg.disk_kv_blocks > 0 and cfg.host_kv_blocks <= 0:
             raise ValueError(
@@ -303,6 +306,57 @@ class JaxEngine:
         # multimodal variant compiles only if a request uses it.
         self._step_fn = jax.jit(step, donate_argnums=(1, 2))
         self._step_fn_mm = self._step_fn
+
+        K = self.config.decode_steps
+        bs = block_size
+
+        def multi_step(
+            params,
+            k_cache,
+            v_cache,
+            tokens,  # [B, 1] the last sampled token per sequence
+            positions,  # [B, 1] its position
+            block_tables,
+            context_lens,
+            temperature,
+            top_k,
+            top_p,
+            seeds,
+        ):
+            """K fused decode steps: one dispatch, K tokens per sequence.
+            Slot mapping is recomputed on-device from the advancing
+            positions; sampling seeds advance per step so outputs match
+            K single steps exactly."""
+
+            def body(carry, i):
+                k_c, v_c, tok, pos, ctx = carry
+                pos_flat = pos[:, 0]
+                slot = (
+                    jnp.take_along_axis(
+                        block_tables, (pos_flat // bs)[:, None], axis=1
+                    )[:, 0]
+                    * bs
+                    + pos_flat % bs
+                )
+                logits, k_c, v_c = forward(
+                    mc, params, k_c, v_c, tok, pos, slot, block_tables,
+                    ctx, jnp.zeros_like(pos_flat), bs,
+                )
+                nt, lp = sample(
+                    logits, temperature, top_k, top_p,
+                    seeds + i.astype(jnp.uint32),
+                )
+                return (k_c, v_c, nt[:, None], pos + 1, ctx + 1), (nt, lp)
+
+            carry = (k_cache, v_cache, tokens, positions, context_lens)
+            (k_cache, v_cache, *_), (toks, lps) = jax.lax.scan(
+                body, carry, jnp.arange(K)
+            )
+            return toks.T, lps.T, k_cache, v_cache  # [B, K]
+
+        self._multi_step_fn = (
+            jax.jit(multi_step, donate_argnums=(1, 2)) if K > 1 else None
+        )
 
     def _run_device_step(self, arrays: dict[str, np.ndarray], sampling: SamplingBatch):
         assert self._step_fn is not None
@@ -496,10 +550,10 @@ class JaxEngine:
             time.sleep(0.001)
             return
         if plan.kind == "prefill":
-            work = plan.prefill
-            assert work is not None
-            arrays = sched.build_prefill_arrays(work)
-            seqs = [work.seq]
+            works = plan.prefill_batch
+            assert works
+            arrays = sched.build_prefill_batch_arrays(works)
+            seqs = [w.seq for w in works]
         else:
             seqs = plan.decode_seqs
             if not seqs:
@@ -518,19 +572,44 @@ class JaxEngine:
             )
         seeds += [0] * (B - len(seqs))
         sampling = SamplingBatch.from_options(opts, seeds)
+
+        if plan.kind == "decode" and self._multi_step_fn is not None:
+            tok_matrix, lp_matrix = self._run_multi_step(arrays, sampling)
+            for i, seq in enumerate(seqs):
+                self._emit_window(seq, tok_matrix[i], lp_matrix[i])
+            return
+
         next_tokens, logprobs = self._run_device_step(arrays, sampling)
 
         if plan.kind == "prefill":
-            work = plan.prefill
-            assert work is not None
-            sched.complete_prefill_chunk(work)
-            if work.is_last_chunk:
-                self._emit_token(work.seq, int(next_tokens[0]), float(logprobs[0]))
+            for i, work in enumerate(plan.prefill_batch):
+                sched.complete_prefill_chunk(work)
+                if work.is_last_chunk:
+                    self._emit_token(
+                        work.seq, int(next_tokens[i]), float(logprobs[i])
+                    )
         else:
             for i, seq in enumerate(seqs):
                 if seq.state != SeqState.RUNNING:
                     continue
                 self._emit_token(seq, int(next_tokens[i]), float(logprobs[i]))
+
+    def _run_multi_step(self, arrays: dict[str, np.ndarray], sampling: SamplingBatch):
+        assert self._multi_step_fn is not None
+        toks, lps, self.k_cache, self.v_cache = self._multi_step_fn(
+            self.params,
+            self.k_cache,
+            self.v_cache,
+            arrays["tokens"],
+            arrays["positions"],
+            arrays["block_tables"],
+            arrays["context_lens"],
+            sampling.temperature,
+            sampling.top_k,
+            sampling.top_p,
+            sampling.seeds,
+        )
+        return np.asarray(toks), np.asarray(lps)
 
     def _emit_token(self, seq: Sequence, token: int, logprob: float) -> None:
         sched = self.scheduler
@@ -547,6 +626,36 @@ class JaxEngine:
         reason = sched.should_finish(seq)
         if reason is not None:
             sched.finish(seq, reason)
+
+    def _emit_window(self, seq: Sequence, tokens, logprobs) -> None:
+        """Append a fused-decode window's tokens, stopping at the first
+        finish condition (the rest of the window is discarded), and emit
+        ONE output carrying all kept tokens — the backend consumes
+        multi-token deltas, so there's no per-token queue hop."""
+        sched = self.scheduler
+        assert sched is not None
+        kept_toks: list[int] = []
+        kept_lps: list[float] = []
+        finish: Optional[FinishReason] = None
+        for j in range(len(tokens)):
+            if seq.state != SeqState.RUNNING:
+                break
+            sched.append_token(seq, int(tokens[j]))
+            kept_toks.append(int(tokens[j]))
+            kept_lps.append(float(logprobs[j]))
+            finish = sched.should_finish(seq)
+            if finish is not None:
+                break
+        if kept_toks and seq.emit is not None:
+            seq.emit(
+                LLMEngineOutput(
+                    request_id=seq.request_id,
+                    token_ids=kept_toks,
+                    log_probs=kept_lps,
+                )
+            )
+        if finish is not None:
+            sched.finish(seq, finish)
 
     def _emit_finish(self, seq: Sequence, reason: FinishReason) -> None:
         """Scheduler on_finish hook: close the request's output stream."""

@@ -92,7 +92,8 @@ class StepPlan:
     """What the engine should run this step."""
 
     kind: str  # "prefill" | "decode" | "idle"
-    prefill: Optional[PrefillWork] = None
+    prefill: Optional[PrefillWork] = None  # first of prefill_batch
+    prefill_batch: list[PrefillWork] = field(default_factory=list)
     decode_seqs: list[Sequence] = field(default_factory=list)
 
 
@@ -104,15 +105,24 @@ class Scheduler:
         max_batch_size: int = 64,
         prefill_chunk_size: int = 1024,
         max_model_len: Optional[int] = None,
+        max_prefill_tokens: Optional[int] = None,
     ):
         self.allocator = allocator
         self.block_size = block_size
         self.max_batch_size = max_batch_size
         self.prefill_chunk_size = prefill_chunk_size
         self.max_model_len = max_model_len
+        # total token budget for one BATCHED prefill step (several
+        # sequences' chunks fused into one dispatch); per-seq chunks
+        # still cap at prefill_chunk_size
+        self.max_prefill_tokens = max_prefill_tokens or prefill_chunk_size
         self.waiting: deque[Sequence] = deque()
         self.prefilling: deque[Sequence] = deque()
         self.running: list[Sequence] = []
+        # fused multi-step decode: how many tokens one device step emits
+        # (engine sets this from EngineConfig.decode_steps); block
+        # allocation must cover the whole window up front
+        self.decode_lookahead = 1
         self._arrival = 0
         # invoked on every finish (incl. cancellations reaped inside plan())
         self.on_finish: Optional[Callable[[Sequence, FinishReason], None]] = None
@@ -146,9 +156,11 @@ class Scheduler:
         self._reap_cancelled()
         self._admit()
         if self.prefilling:
-            work = self._plan_prefill()
-            if work is not None:
-                return StepPlan(kind="prefill", prefill=work)
+            works = self._plan_prefill_batch()
+            if works:
+                return StepPlan(
+                    kind="prefill", prefill=works[0], prefill_batch=works
+                )
         if self.running:
             return StepPlan(kind="decode", decode_seqs=self._plan_decode())
         return StepPlan(kind="idle")
@@ -211,24 +223,38 @@ class Scheduler:
             if cached > 0:
                 self.prefix_hits += 1
 
-    def _plan_prefill(self) -> Optional[PrefillWork]:
-        seq = self.prefilling[0]
-        prompt = seq.tokens.all_tokens()
-        start = seq.num_computed
-        remaining = len(prompt) - start
-        if remaining <= 0:
-            # fully cached prompt: recompute the last token so we have its
-            # logits to sample from
-            start = max(0, len(prompt) - 1)
+    def _plan_prefill_batch(
+        self, budget: Optional[int] = None, max_seqs: Optional[int] = None
+    ) -> list[PrefillWork]:
+        """One chunk from each of several prefilling sequences, fused
+        into a single step (total tokens bounded by max_prefill_tokens)
+        — continuous batching's batched-prefill half."""
+        budget = budget if budget is not None else self.max_prefill_tokens
+        max_seqs = max_seqs if max_seqs is not None else self.max_batch_size
+        works: list[PrefillWork] = []
+        for seq in self.prefilling:
+            if len(works) >= max_seqs or budget <= 0:
+                break
+            prompt = seq.tokens.all_tokens()
+            start = seq.num_computed
             remaining = len(prompt) - start
-        chunk = min(remaining, self.prefill_chunk_size)
-        tokens = np.asarray(prompt[start : start + chunk], dtype=np.int32)
-        return PrefillWork(
-            seq=seq,
-            tokens=tokens,
-            start_pos=start,
-            is_last_chunk=(start + chunk >= len(prompt)),
-        )
+            if remaining <= 0:
+                # fully cached prompt: recompute the last token so we
+                # have its logits to sample from
+                start = max(0, len(prompt) - 1)
+                remaining = len(prompt) - start
+            chunk = min(remaining, self.prefill_chunk_size, budget)
+            tokens = np.asarray(prompt[start : start + chunk], dtype=np.int32)
+            works.append(
+                PrefillWork(
+                    seq=seq,
+                    tokens=tokens,
+                    start_pos=start,
+                    is_last_chunk=(start + chunk >= len(prompt)),
+                )
+            )
+            budget -= chunk
+        return works
 
     def complete_prefill_chunk(self, work: PrefillWork) -> None:
         seq = work.seq
@@ -248,7 +274,9 @@ class Scheduler:
         for seq in batch:
             if seq.state != SeqState.RUNNING:
                 continue  # preempted earlier in this pass
-            needed_blocks = seq.blocks_needed(seq.total_len + 1, self.block_size)
+            needed_blocks = seq.blocks_needed(
+                seq.total_len + self.decode_lookahead, self.block_size
+            )
             while (
                 seq.state == SeqState.RUNNING
                 and len(seq.block_table) < needed_blocks
@@ -327,36 +355,60 @@ class Scheduler:
     CHUNK_BUCKETS = [16, 32, 64, 128, 256, 512, 1024, 2048, 4096]
     TABLE_BUCKET = 8  # block-table width rounded to multiples of this
 
-    def build_prefill_arrays(self, work: PrefillWork) -> dict[str, np.ndarray]:
+    def build_prefill_batch_arrays(
+        self, works: list[PrefillWork]
+    ) -> dict[str, np.ndarray]:
+        """Fuse several sequences' prefill chunks into one [B, T] step
+        (rows padded to the chunk bucket, batch padded to the batch
+        bucket; pads write to the garbage slot 0 like decode pads)."""
         bs = self.block_size
-        seq = work.seq
-        t = len(work.tokens)
-        T = next_bucket(t, self.CHUNK_BUCKETS)
+        n = len(works)
+        B = next_bucket(n, self.BATCH_BUCKETS)
+        T = next_bucket(max(len(w.tokens) for w in works), self.CHUNK_BUCKETS)
+        max_blocks = max(len(w.seq.block_table) for w in works)
         width = max(
             self.TABLE_BUCKET,
-            -(-len(seq.block_table) // self.TABLE_BUCKET) * self.TABLE_BUCKET,
+            -(-max_blocks // self.TABLE_BUCKET) * self.TABLE_BUCKET,
         )
-        tokens = np.zeros((1, T), np.int32)
-        tokens[0, :t] = work.tokens
-        positions = np.zeros((1, T), np.int32)
-        positions[0, :t] = np.arange(work.start_pos, work.start_pos + t)
-        slot_mapping = np.zeros((T,), np.int32)  # pad -> slot 0 (garbage block)
-        for j in range(t):
-            pos = work.start_pos + j
-            slot_mapping[j] = seq.block_table[pos // bs] * bs + pos % bs
-        tables = np.zeros((1, width), np.int32)
-        tables[0, : len(seq.block_table)] = seq.block_table
+        tokens = np.zeros((B, T), np.int32)
+        positions = np.zeros((B, T), np.int32)
+        slot_mapping = np.zeros((B * T,), np.int32)
+        tables = np.zeros((B, width), np.int32)
+        ctx = np.zeros((B,), np.int32)
+        last_idx = np.zeros((B,), np.int32)
+        mm_extra = None
+        mm_mask = None
+        for i, w in enumerate(works):
+            t = len(w.tokens)
+            tokens[i, :t] = w.tokens
+            positions[i, :t] = np.arange(w.start_pos, w.start_pos + t)
+            for j in range(t):
+                pos = w.start_pos + j
+                slot_mapping[i * T + j] = (
+                    w.seq.block_table[pos // bs] * bs + pos % bs
+                )
+            tables[i, : len(w.seq.block_table)] = w.seq.block_table
+            ctx[i] = w.start_pos + t
+            last_idx[i] = t - 1
+            mm = self._mm_chunk_arrays(w.seq, w.start_pos, t, T)
+            if mm is not None:
+                if mm_extra is None:
+                    D = mm["extra_embeds"].shape[-1]
+                    mm_extra = np.zeros((B, T, D), np.float32)
+                    mm_mask = np.zeros((B, T), bool)
+                mm_extra[i] = mm["extra_embeds"][0]
+                mm_mask[i] = mm["embeds_mask"][0]
         arrays = {
             "tokens": tokens,
             "positions": positions,
             "slot_mapping": slot_mapping,
             "block_tables": tables,
-            "context_lens": np.asarray([work.start_pos + t], np.int32),
-            "last_token_idx": np.asarray([t - 1], np.int32),
+            "context_lens": ctx,
+            "last_token_idx": last_idx,
         }
-        mm = self._mm_chunk_arrays(seq, work.start_pos, t, T)
-        if mm is not None:
-            arrays.update(mm)
+        if mm_extra is not None:
+            arrays["extra_embeds"] = mm_extra
+            arrays["embeds_mask"] = mm_mask
         return arrays
 
     @staticmethod

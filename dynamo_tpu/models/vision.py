@@ -103,6 +103,28 @@ def init_vision_params(cfg: VisionConfig, seed: int = 0) -> Params:
     return params
 
 
+def load_vision_params(cfg: VisionConfig, path: str) -> Params:
+    """Load tower weights from an .npz archive keyed by
+    ``vision_param_shapes`` names (the projector-merged export format;
+    HF CLIP checkpoints convert offline with a rename+stack script)."""
+    import numpy as np
+
+    shapes = vision_param_shapes(cfg)
+    with np.load(path) as data:
+        missing = set(shapes) - set(data.files)
+        if missing:
+            raise ValueError(f"{path} missing vision params: {sorted(missing)}")
+        params: Params = {}
+        for name, (shape, dtype) in shapes.items():
+            arr = data[name]
+            if arr.shape != shape:
+                raise ValueError(
+                    f"{name}: expected {shape}, got {arr.shape}"
+                )
+            params[name] = jnp.asarray(arr, dtype=dtype)
+    return params
+
+
 def _layernorm(x: jax.Array, ln: jax.Array, eps: float) -> jax.Array:
     """ln: [2, D] = [scale, bias]."""
     xf = x.astype(jnp.float32)

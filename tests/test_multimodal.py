@@ -258,3 +258,68 @@ async def test_engine_injects_image_embeddings():
     assert a == a2  # deterministic given the same image
     assert a != b  # embeddings actually reach the model
     await engine.shutdown()
+
+
+def test_load_vision_params_npz(tmp_path):
+    from dynamo_tpu.models.vision import (
+        load_vision_params,
+        vision_param_shapes,
+    )
+
+    cfg = TINY_VIT
+    shapes = vision_param_shapes(cfg)
+    rng = np.random.default_rng(0)
+    arrays = {
+        name: rng.standard_normal(shape).astype(np.float32)
+        for name, (shape, _) in shapes.items()
+    }
+    path = tmp_path / "vit.npz"
+    np.savez(path, **arrays)
+    params = load_vision_params(cfg, str(path))
+    assert set(params) == set(shapes)
+    np.testing.assert_allclose(
+        np.asarray(params["proj_1"], np.float32), arrays["proj_1"],
+        rtol=1e-2, atol=1e-2,
+    )
+    # missing key fails loudly
+    partial = {k: v for k, v in arrays.items() if k != "wq"}
+    bad = tmp_path / "bad.npz"
+    np.savez(bad, **partial)
+    with pytest.raises(ValueError, match="missing"):
+        load_vision_params(cfg, str(bad))
+
+
+def test_cli_builds_mm_preprocessor(tmp_path):
+    """--vision-config wires MultimodalPreprocessor into the pipeline
+    head; a tokenizer without the placeholder token fails loudly."""
+    import argparse
+    import json as _json
+
+    from dynamo_tpu.cli.main import _build_mm_preprocessor
+    from dynamo_tpu.preprocessor import PromptFormatter
+    from dynamo_tpu.tokenizer import Tokenizer
+
+    vcfg_path = tmp_path / "vit.json"
+    vcfg_path.write_text(_json.dumps({
+        "image_size": 28, "patch_size": 14, "hidden_size": 32,
+        "intermediate_size": 64, "num_hidden_layers": 1,
+        "num_attention_heads": 4, "projection_dim": 16,
+    }))
+    tok = Tokenizer.from_file(MODEL_DIR)
+    fmt = PromptFormatter.from_model_dir(MODEL_DIR)
+    args = argparse.Namespace(
+        vision_config=str(vcfg_path), vision_weights=None,
+        image_token="<|end_header_id|>",  # exists in the tiny vocab
+    )
+    pre = _build_mm_preprocessor(args, tok, fmt, "vlm")
+    assert pre.tokens_per_image == 4  # (28/14)^2
+    out = pre.preprocess_chat(ChatCompletionRequest.model_validate({
+        "model": "vlm",
+        "messages": [{"role": "user", "content": [
+            {"type": "image_url", "image_url": {"url": _png_data_url()}},
+        ]}],
+    }))
+    assert out.mm_embeds and len(out.mm_embeds) == 1
+    args.image_token = "<missing-token>"
+    with pytest.raises(SystemExit, match="no"):
+        _build_mm_preprocessor(args, tok, fmt, "vlm")

@@ -1,0 +1,74 @@
+"""Soak test: sustained request churn through the distributed runtime
+without leaking tasks, sockets, or store state (reference:
+lib/runtime/tests/soak.rs and lib/bindings/python/tests/soak.py)."""
+
+import asyncio
+import gc
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.engine import Context, FnEngine, collect
+from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+from dynamo_tpu.store.memory import MemoryStore
+from dynamo_tpu.store.server import StoreServer
+
+ROUNDS = 40
+CONCURRENCY = 8
+
+
+async def echo_stream(request: Any, ctx: Context) -> AsyncIterator[Any]:
+    for tok in request["tokens"]:
+        if ctx.is_stopped:
+            return
+        yield {"token": tok}
+
+
+async def test_soak_request_churn_no_leaks():
+    server = StoreServer(MemoryStore(lease_sweep_interval_s=0.1), port=0)
+    await server.start()
+    cfg = lambda: RuntimeConfig(  # noqa: E731
+        store_host="127.0.0.1", store_port=server.port,
+        worker_host="127.0.0.1", lease_ttl_s=2.0, lease_keepalive_s=0.5,
+    )
+    worker = await DistributedRuntime.create(config=cfg())
+    frontend = await DistributedRuntime.create(config=cfg())
+    try:
+        ep = worker.namespace("soak").component("w").endpoint("gen")
+        await ep.serve(FnEngine(echo_stream))
+        client = await (
+            frontend.namespace("soak").component("w").endpoint("gen").client()
+        )
+        await client.wait_for_instances()
+        router = PushRouter(client, RouterMode.ROUND_ROBIN)
+
+        async def one(i: int) -> int:
+            items = await collect(
+                router.generate({"tokens": list(range(i % 7 + 1))}, Context())
+            )
+            return len(items)
+
+        baseline_tasks = None
+        for r in range(ROUNDS):
+            counts = await asyncio.gather(
+                *[one(r * CONCURRENCY + i) for i in range(CONCURRENCY)]
+            )
+            assert all(c > 0 for c in counts)
+            if r == 4:
+                gc.collect()
+                baseline_tasks = len(asyncio.all_tasks())
+        gc.collect()
+        await asyncio.sleep(0.1)
+        # steady state: no unbounded task growth vs the warm baseline
+        assert baseline_tasks is not None
+        assert len(asyncio.all_tasks()) <= baseline_tasks + 4, (
+            f"task leak: {len(asyncio.all_tasks())} vs baseline "
+            f"{baseline_tasks}"
+        )
+        # store state stays bounded: only this worker's registrations
+        entries = await frontend.store.kv_get_prefix("soak/")
+        assert len(entries) <= 4, [e.key for e in entries]
+    finally:
+        await worker.shutdown()
+        await frontend.shutdown()
+        await server.stop()
