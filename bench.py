@@ -87,9 +87,11 @@ async def _run(model_cfg, wl) -> dict:
         decode_steps=int(os.environ.get("DYN_BENCH_DECODE_STEPS", "32")),
         hbm_utilization=0.7,
     )
-    # one decode bucket = one decode compile: every step pads to full
-    # batch. Compiles are minutes over the chip tunnel; the padded-lane
-    # compute overhead is noise next to that.
+    # one batch bucket = one compile per step kind: every step (decode
+    # AND batched prefill share BATCH_BUCKETS) pads to full batch, and
+    # all prompts chunk at the same length, so the only reachable step
+    # shapes are the ones warmup exercises. Compiles are minutes over
+    # the chip tunnel; the padded-lane compute overhead is noise.
     from dynamo_tpu.engine.scheduler import Scheduler
 
     Scheduler.BATCH_BUCKETS = [wl["batch"]]
@@ -121,8 +123,10 @@ async def _run(model_cfg, wl) -> dict:
             n += len(item.token_ids)
         return t_start, t_first or time.monotonic(), n
 
-    # warmup: trigger the two hot compiles (prefill chunk + decode batch)
-    await one_request(9999)
+    # warmup at FULL batch: the measurement's shapes (batched prefill at
+    # B=batch, decode at the batch bucket) must compile now, not inside
+    # the timed run
+    await asyncio.gather(*[one_request(9000 + i) for i in range(wl["batch"])])
     print("# warmup done; measuring", file=sys.stderr, flush=True)
 
     t0 = time.monotonic()

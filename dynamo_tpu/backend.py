@@ -137,14 +137,25 @@ class Backend(Operator):
                 if isinstance(raw, LLMEngineOutput)
                 else LLMEngineOutput.model_validate(raw)
             )
+            before = state.completion_tokens
             text, finish = state.step(item.token_ids)
+            # fused multi-step decode delivers multi-token bursts: when a
+            # stop fires mid-burst, tokens past it (and a hidden stop
+            # token itself) must not leak to token-stream consumers
+            consumed = state.completion_tokens - before
+            kept_ids = item.token_ids[:consumed]
+            if finish is not None and kept_ids and kept_ids[-1] in state.hidden_stop_ids:
+                kept_ids = kept_ids[:-1]
+            kept_lps = (
+                item.log_probs[: len(kept_ids)] if item.log_probs else item.log_probs
+            )
             if text or item.finish_reason is None and finish is None:
                 yield LLMEngineOutput(
                     request_id=item.request_id,
-                    token_ids=item.token_ids,
+                    token_ids=kept_ids,
                     text=text,
                     cum_log_probs=item.cum_log_probs,
-                    log_probs=item.log_probs,
+                    log_probs=kept_lps,
                 )
             if finish is not None:
                 # our stop fired first: tell the engine to stop generating

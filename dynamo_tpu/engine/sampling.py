@@ -57,33 +57,40 @@ def sample(
     B, V = logits.shape
     greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    # --- sampled path: top-k / top-p filtering on sorted logits ----------
-    temp = jnp.maximum(temperature, 1e-4)[:, None]
-    scaled = logits / temp
-    sort_idx = jnp.argsort(-scaled, axis=-1)  # descending
-    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
-    ranks = jnp.arange(V, dtype=jnp.int32)[None, :]
-    # top-k mask (0 = disabled)
-    k = jnp.where(top_k > 0, top_k, V)[:, None]
-    k_mask = ranks < k
-    # top-p mask on the sorted distribution (always keep rank 0)
-    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cumprobs = jnp.cumsum(sorted_probs, axis=-1)
-    p_mask = (cumprobs - sorted_probs) < top_p[:, None]
-    keep = k_mask & p_mask
-    filtered = jnp.where(keep, sorted_logits, NEG_INF)
-    # per-slot independent RNG streams
-    keys = jax.vmap(jax.random.key)(seeds)
-    gumbel = jax.vmap(
-        lambda key, shape=(V,): jax.random.gumbel(key, shape, jnp.float32)
-    )(keys)
-    choice_sorted = jnp.argmax(filtered + gumbel, axis=-1)
-    sampled_tok = jnp.take_along_axis(
-        sort_idx, choice_sorted[:, None], axis=-1
-    )[:, 0].astype(jnp.int32)
+    def sampled_path(_) -> jax.Array:
+        # top-k / top-p filtering on sorted logits
+        temp = jnp.maximum(temperature, 1e-4)[:, None]
+        scaled = logits / temp
+        sort_idx = jnp.argsort(-scaled, axis=-1)  # descending
+        sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+        ranks = jnp.arange(V, dtype=jnp.int32)[None, :]
+        # top-k mask (0 = disabled)
+        k = jnp.where(top_k > 0, top_k, V)[:, None]
+        k_mask = ranks < k
+        # top-p mask on the sorted distribution (always keep rank 0)
+        sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cumprobs = jnp.cumsum(sorted_probs, axis=-1)
+        p_mask = (cumprobs - sorted_probs) < top_p[:, None]
+        keep = k_mask & p_mask
+        filtered = jnp.where(keep, sorted_logits, NEG_INF)
+        # per-slot independent RNG streams
+        keys = jax.vmap(jax.random.key)(seeds)
+        gumbel = jax.vmap(
+            lambda key, shape=(V,): jax.random.gumbel(key, shape, jnp.float32)
+        )(keys)
+        choice_sorted = jnp.argmax(filtered + gumbel, axis=-1)
+        sampled_tok = jnp.take_along_axis(
+            sort_idx, choice_sorted[:, None], axis=-1
+        )[:, 0].astype(jnp.int32)
+        is_greedy = temperature <= 0.0
+        return jnp.where(is_greedy, greedy_tok, sampled_tok)
 
-    is_greedy = temperature <= 0.0
-    next_tok = jnp.where(is_greedy, greedy_tok, sampled_tok)
+    # the sort/gumbel machinery is ~30% of a fused decode step: skip it
+    # entirely when the whole batch decodes greedily (runtime-dependent
+    # branch — both sides are compiled, only one executes)
+    next_tok = jax.lax.cond(
+        jnp.all(temperature <= 0.0), lambda _: greedy_tok, sampled_path, None
+    )
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     chosen_lp = jnp.take_along_axis(logprobs, next_tok[:, None], axis=-1)[:, 0]
     return next_tok, chosen_lp

@@ -92,9 +92,13 @@ class StepPlan:
     """What the engine should run this step."""
 
     kind: str  # "prefill" | "decode" | "idle"
-    prefill: Optional[PrefillWork] = None  # first of prefill_batch
     prefill_batch: list[PrefillWork] = field(default_factory=list)
     decode_seqs: list[Sequence] = field(default_factory=list)
+
+    @property
+    def prefill(self) -> Optional[PrefillWork]:
+        """First prefill work item (derived — cannot drift from the batch)."""
+        return self.prefill_batch[0] if self.prefill_batch else None
 
 
 class Scheduler:
@@ -158,9 +162,7 @@ class Scheduler:
         if self.prefilling:
             works = self._plan_prefill_batch()
             if works:
-                return StepPlan(
-                    kind="prefill", prefill=works[0], prefill_batch=works
-                )
+                return StepPlan(kind="prefill", prefill_batch=works)
         if self.running:
             return StepPlan(kind="decode", decode_seqs=self._plan_decode())
         return StepPlan(kind="idle")
@@ -232,8 +234,9 @@ class Scheduler:
         budget = budget if budget is not None else self.max_prefill_tokens
         max_seqs = max_seqs if max_seqs is not None else self.max_batch_size
         works: list[PrefillWork] = []
+        max_chunk = 0
         for seq in self.prefilling:
-            if len(works) >= max_seqs or budget <= 0:
+            if len(works) >= max_seqs:
                 break
             prompt = seq.tokens.all_tokens()
             start = seq.num_computed
@@ -244,6 +247,17 @@ class Scheduler:
                 start = max(0, len(prompt) - 1)
                 remaining = len(prompt) - start
             chunk = min(remaining, self.prefill_chunk_size, budget)
+            # the dispatch cost is the PADDED B×T rectangle (every row
+            # pads to the longest chunk's bucket), so the budget bounds
+            # that area, not the sum of real tokens — one long chunk
+            # plus many short ones must not inflate into a huge step
+            new_max = max(max_chunk, chunk)
+            area = (
+                next_bucket(len(works) + 1, self.BATCH_BUCKETS)
+                * next_bucket(new_max, self.CHUNK_BUCKETS)
+            )
+            if works and area > budget:
+                break
             tokens = np.asarray(prompt[start : start + chunk], dtype=np.int32)
             works.append(
                 PrefillWork(
@@ -253,7 +267,7 @@ class Scheduler:
                     is_last_chunk=(start + chunk >= len(prompt)),
                 )
             )
-            budget -= chunk
+            max_chunk = new_max
         return works
 
     def complete_prefill_chunk(self, work: PrefillWork) -> None:
@@ -274,8 +288,17 @@ class Scheduler:
         for seq in batch:
             if seq.state != SeqState.RUNNING:
                 continue  # preempted earlier in this pass
+            # clamp the lookahead window to tokens the sequence can
+            # actually keep: near max_tokens the fused window's surplus
+            # is discarded, and allocating blocks for it would trigger
+            # phantom preemptions under pressure
+            lookahead = self.decode_lookahead
+            if seq.max_new_tokens is not None:
+                lookahead = min(
+                    lookahead, max(1, seq.max_new_tokens - seq.generated)
+                )
             needed_blocks = seq.blocks_needed(
-                seq.total_len + self.decode_lookahead, self.block_size
+                seq.total_len + lookahead, self.block_size
             )
             while (
                 seq.state == SeqState.RUNNING

@@ -232,3 +232,44 @@ def test_stop_string_earliest_occurrence_wins(tok):
     emit, fin = state._apply_stop_strings("fooSTOPbarEND", past_min=True)
     assert emit == "foo"
     assert fin == FinishReason.STOP
+
+
+async def test_backend_truncates_burst_at_stop():
+    """Multi-token bursts (fused multi-step decode) must not leak tokens
+    sampled past a hidden stop (EOS) to token-stream consumers."""
+    from dynamo_tpu.backend import Backend
+    from dynamo_tpu.protocols.common import (
+        LLMEngineOutput,
+        PreprocessedRequest,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.tokenizer import Tokenizer
+
+    tok = Tokenizer.from_file(MODEL_DIR)
+    backend = Backend(tok, eos_token_ids=[9])
+    req = PreprocessedRequest(
+        request_id="b1", token_ids=[1, 2],
+        stop=StopConditions(max_tokens=32),
+    )
+    _, state = await backend.forward(req, Context())
+
+    async def burst():
+        # eos (9) at position 2 of an 8-token burst
+        yield LLMEngineOutput(
+            request_id="b1", token_ids=[11, 12, 9, 13, 14, 15, 16, 17],
+            log_probs=[-0.1] * 8,
+        )
+
+    items = []
+    async for out in backend.backward(burst(), state, Context()):
+        items.append(out)
+    emitted_ids = [t for it in items for t in it.token_ids]
+    assert 9 not in emitted_ids  # hidden stop excluded
+    assert emitted_ids == [11, 12]  # nothing past the stop
+    final = items[-1]
+    assert final.finish_reason is not None
+    assert final.completion_tokens == 3  # eos consumed, not emitted
+    for it in items:
+        if it.log_probs:
+            assert len(it.log_probs) == len(it.token_ids)
