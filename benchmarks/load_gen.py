@@ -1,0 +1,195 @@
+"""HTTP load generator for serving benchmarks.
+
+The native stand-in for the reference's benchmark harness (reference:
+examples/llm/benchmarks/perf.sh drives genai-perf with fixed ISL/OSL and
+a concurrency sweep; planner_benchmark/sin_synth.py generates a
+sinusoidal request rate for autoscaler evaluation). Drives any
+OpenAI-compatible endpoint (ours or not) and reports TTFT/ITL/E2E
+percentiles plus token throughput as one JSON line.
+
+Modes:
+  --rate-mode constant --rate R            fixed R req/s (Poisson)
+  --rate-mode sweep --concurrency 1,2,4    closed-loop concurrency sweep
+  --rate-mode sin --rate-min 5 --rate-max 20 --period 150
+                                           sinusoidal open-loop load
+                                           (the planner benchmark shape)
+
+Example:
+  python benchmarks/load_gen.py --url http://127.0.0.1:8000 \
+      --model echo --isl 128 --osl 64 --duration 60 --rate-mode constant --rate 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import math
+import random
+import sys
+import time
+
+import aiohttp
+
+PROMPT_WORD = "benchmark "
+
+
+def _percentiles(xs: list[float], ps=(50, 90, 99)) -> dict[str, float]:
+    if not xs:
+        return {f"p{p}": 0.0 for p in ps}
+    xs = sorted(xs)
+    return {
+        f"p{p}": xs[min(len(xs) - 1, int(len(xs) * p / 100))] for p in ps
+    }
+
+
+class Stats:
+    def __init__(self) -> None:
+        self.ttft: list[float] = []
+        self.itl: list[float] = []
+        self.e2e: list[float] = []
+        self.tokens = 0
+        self.errors = 0
+        self.completed = 0
+
+
+async def one_request(session: aiohttp.ClientSession, args, stats: Stats) -> None:
+    # unique head defeats cross-request prefix caching; body sized to ~ISL
+    prompt = f"req-{random.random():.9f} " + PROMPT_WORD * max(1, args.isl - 2)
+    body = {
+        "model": args.model,
+        "prompt": prompt,
+        "max_tokens": args.osl,
+        "stream": True,
+        "ignore_eos": True,
+        # ask for exact token counts on the final chunk; servers that
+        # don't support it fall back to a word-count estimate below
+        "stream_options": {"include_usage": True},
+    }
+    t0 = time.monotonic()
+    t_prev = None
+    n_est = 0
+    n_usage = None
+    try:
+        async with session.post(
+            f"{args.url}/v1/completions", json=body,
+            timeout=aiohttp.ClientTimeout(total=args.request_timeout),
+        ) as resp:
+            if resp.status != 200:
+                stats.errors += 1
+                return
+            async for line in resp.content:
+                line = line.strip()
+                if not line.startswith(b"data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == b"[DONE]":
+                    break
+                now = time.monotonic()
+                chunk = json.loads(payload)
+                usage = chunk.get("usage") or {}
+                if usage.get("completion_tokens"):
+                    n_usage = int(usage["completion_tokens"])
+                text = "".join(
+                    c.get("text") or "" for c in chunk.get("choices", [])
+                )
+                if text:
+                    # ITL here is inter-CHUNK latency: servers with fused
+                    # multi-step decode stream several tokens per chunk
+                    if t_prev is None:
+                        stats.ttft.append(now - t0)
+                    else:
+                        stats.itl.append(now - t_prev)
+                    t_prev = now
+                    n_est += max(1, len(text.split()))
+        stats.e2e.append(time.monotonic() - t0)
+        stats.tokens += n_usage if n_usage is not None else n_est
+        stats.completed += 1
+    except Exception:
+        stats.errors += 1
+
+
+async def run_open_loop(args, rate_fn) -> Stats:
+    """Poisson arrivals at a (possibly time-varying) rate."""
+    stats = Stats()
+    tasks: set[asyncio.Task] = set()
+    async with aiohttp.ClientSession() as session:
+        t_start = time.monotonic()
+        while time.monotonic() - t_start < args.duration:
+            rate = max(0.01, rate_fn(time.monotonic() - t_start))
+            await asyncio.sleep(random.expovariate(rate))
+            task = asyncio.create_task(one_request(session, args, stats))
+            tasks.add(task)
+            task.add_done_callback(tasks.discard)
+        if tasks:
+            await asyncio.wait(tasks, timeout=args.request_timeout)
+    return stats
+
+
+async def run_closed_loop(args, concurrency: int) -> Stats:
+    """Fixed in-flight concurrency for the duration."""
+    stats = Stats()
+    stop = time.monotonic() + args.duration
+
+    async with aiohttp.ClientSession() as session:
+        async def worker() -> None:
+            while time.monotonic() < stop:
+                await one_request(session, args, stats)
+
+        await asyncio.gather(*[worker() for _ in range(concurrency)])
+    return stats
+
+
+def report(tag: str, stats: Stats, duration: float) -> None:
+    out = {
+        "tag": tag,
+        "completed": stats.completed,
+        "errors": stats.errors,
+        "output_tok_per_s": round(stats.tokens / max(duration, 1e-9), 2),
+        "ttft_ms": {k: round(v * 1000, 1) for k, v in _percentiles(stats.ttft).items()},
+        "inter_chunk_ms": {k: round(v * 1000, 1) for k, v in _percentiles(stats.itl).items()},
+        "e2e_ms": {k: round(v * 1000, 1) for k, v in _percentiles(stats.e2e).items()},
+    }
+    print(json.dumps(out), flush=True)
+
+
+async def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--url", default="http://127.0.0.1:8000")
+    p.add_argument("--model", required=True)
+    p.add_argument("--isl", type=int, default=128, help="approx input words")
+    p.add_argument("--osl", type=int, default=64, help="max output tokens")
+    p.add_argument("--duration", type=float, default=30.0)
+    p.add_argument("--request-timeout", type=float, default=120.0)
+    p.add_argument("--rate-mode", default="constant",
+                   choices=["constant", "sweep", "sin"])
+    p.add_argument("--rate", type=float, default=2.0)
+    p.add_argument("--concurrency", default="1,2,4,8",
+                   help="comma list for --rate-mode sweep")
+    p.add_argument("--rate-min", type=float, default=5.0)
+    p.add_argument("--rate-max", type=float, default=20.0)
+    p.add_argument("--period", type=float, default=150.0,
+                   help="sin period seconds (planner benchmark: 150)")
+    args = p.parse_args()
+
+    if args.rate_mode == "constant":
+        stats = await run_open_loop(args, lambda t: args.rate)
+        report(f"constant-{args.rate}", stats, args.duration)
+    elif args.rate_mode == "sin":
+        mid = (args.rate_min + args.rate_max) / 2
+        amp = (args.rate_max - args.rate_min) / 2
+        stats = await run_open_loop(
+            args, lambda t: mid + amp * math.sin(2 * math.pi * t / args.period)
+        )
+        report(f"sin-{args.rate_min}-{args.rate_max}", stats, args.duration)
+    else:
+        for c in [int(x) for x in args.concurrency.split(",")]:
+            stats = await run_closed_loop(args, c)
+            report(f"concurrency-{c}", stats, args.duration)
+
+
+if __name__ == "__main__":
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        sys.exit(1)

@@ -256,7 +256,16 @@ class Scheduler:
                 next_bucket(len(works) + 1, self.BATCH_BUCKETS)
                 * next_bucket(new_max, self.CHUNK_BUCKETS)
             )
-            if works and area > budget:
+            cur_area = (
+                next_bucket(len(works), self.BATCH_BUCKETS)
+                * next_bucket(max_chunk, self.CHUNK_BUCKETS)
+                if works
+                else 0
+            )
+            # a row whose admission leaves the padded rectangle unchanged
+            # is free — only reject when it actually GROWS the dispatch
+            # past the budget
+            if works and area > budget and area > cur_area:
                 break
             tokens = np.asarray(prompt[start : start + chunk], dtype=np.int32)
             works.append(

@@ -1,0 +1,39 @@
+"""Load generator (benchmarks/load_gen.py) against an in-process echo
+HTTP service — percentile report sanity."""
+
+import importlib.util
+import os
+
+from dynamo_tpu.engines import EchoEngineFull
+from dynamo_tpu.http.service import HttpService, ModelManager
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_gen():
+    spec = importlib.util.spec_from_file_location(
+        "load_gen", os.path.join(REPO, "benchmarks", "load_gen.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+async def test_closed_loop_against_echo():
+    lg = _load_gen()
+    manager = ModelManager()
+    manager.add_completion_model("echo", EchoEngineFull())
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        args = type("A", (), dict(
+            url=f"http://127.0.0.1:{service.port}", model="echo",
+            isl=6, osl=8, duration=1.5, request_timeout=30.0,
+        ))()
+        stats = await lg.run_closed_loop(args, concurrency=2)
+        assert stats.completed >= 2 and stats.errors == 0
+        assert stats.tokens > 0
+        p = lg._percentiles(stats.ttft)
+        assert p["p50"] >= 0
+    finally:
+        await service.stop()
