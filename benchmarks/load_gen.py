@@ -38,8 +38,9 @@ def _percentiles(xs: list[float], ps=(50, 90, 99)) -> dict[str, float]:
     if not xs:
         return {f"p{p}": 0.0 for p in ps}
     xs = sorted(xs)
+    # nearest-rank: p99 of 100 samples is the 99th value, not the max
     return {
-        f"p{p}": xs[min(len(xs) - 1, int(len(xs) * p / 100))] for p in ps
+        f"p{p}": xs[max(0, math.ceil(len(xs) * p / 100) - 1)] for p in ps
     }
 
 
@@ -51,6 +52,7 @@ class Stats:
         self.tokens = 0
         self.errors = 0
         self.completed = 0
+        self.elapsed = 0.0  # actual wall time incl. the drain window
 
 
 async def one_request(session: aiohttp.ClientSession, args, stats: Stats) -> None:
@@ -123,13 +125,16 @@ async def run_open_loop(args, rate_fn) -> Stats:
             task.add_done_callback(tasks.discard)
         if tasks:
             await asyncio.wait(tasks, timeout=args.request_timeout)
+        # tokens from the drain window count, so the denominator must too
+        stats.elapsed = time.monotonic() - t_start
     return stats
 
 
 async def run_closed_loop(args, concurrency: int) -> Stats:
     """Fixed in-flight concurrency for the duration."""
     stats = Stats()
-    stop = time.monotonic() + args.duration
+    t_start = time.monotonic()
+    stop = t_start + args.duration
 
     async with aiohttp.ClientSession() as session:
         async def worker() -> None:
@@ -137,15 +142,17 @@ async def run_closed_loop(args, concurrency: int) -> Stats:
                 await one_request(session, args, stats)
 
         await asyncio.gather(*[worker() for _ in range(concurrency)])
+    stats.elapsed = time.monotonic() - t_start
     return stats
 
 
 def report(tag: str, stats: Stats, duration: float) -> None:
+    elapsed = stats.elapsed or duration
     out = {
         "tag": tag,
         "completed": stats.completed,
         "errors": stats.errors,
-        "output_tok_per_s": round(stats.tokens / max(duration, 1e-9), 2),
+        "output_tok_per_s": round(stats.tokens / max(elapsed, 1e-9), 2),
         "ttft_ms": {k: round(v * 1000, 1) for k, v in _percentiles(stats.ttft).items()},
         "inter_chunk_ms": {k: round(v * 1000, 1) for k, v in _percentiles(stats.itl).items()},
         "e2e_ms": {k: round(v * 1000, 1) for k, v in _percentiles(stats.e2e).items()},

@@ -98,6 +98,9 @@ def build_parser() -> argparse.ArgumentParser:
     store = sub.add_parser("store", help="run the coordinator store")
     store.add_argument("--host", default="0.0.0.0")
     store.add_argument("--port", type=int, default=4222)
+    store.add_argument("--native", action="store_true",
+                       help="run the C++ coordinator (native/store; built "
+                            "on demand, wire-identical to the python one)")
 
     serve = sub.add_parser("serve", help="serve a @service graph "
                            "(≈ reference `dynamo serve`)")
@@ -661,6 +664,35 @@ async def _batch_file(engine: Any, model_name: str, path: str,
         raise SystemExit(1)
 
 
+def _exec_native_store(args: Any) -> None:
+    """Replace this process with the C++ coordinator (building it first
+    if needed); falls through to the python server on build failure."""
+    import importlib.util
+    import socket
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    build_py = os.path.join(repo, "native", "build.py")
+    binary = os.path.join(repo, "dynamo_tpu", "native", "dynamo_store")
+    if not os.path.exists(binary) and os.path.exists(build_py):
+        spec = importlib.util.spec_from_file_location("native_build", build_py)
+        mod = importlib.util.module_from_spec(spec)
+        try:
+            spec.loader.exec_module(mod)
+            mod.build_store()  # errors print to stderr inside
+        except Exception:
+            log.warning("native store build failed", exc_info=True)
+    if os.path.exists(binary):
+        # the binary only accepts numeric addresses (inet_pton falls back
+        # to INADDR_ANY): resolve hostnames here so --host localhost stays
+        # loopback-only
+        try:
+            host = socket.gethostbyname(args.host)
+        except OSError:
+            raise SystemExit(f"cannot resolve --host {args.host!r}")
+        os.execv(binary, [binary, "--host", host, "--port", str(args.port)])
+    log.warning("native store binary unavailable; using the python server")
+
+
 def _runtime_config(args: Any) -> RuntimeConfig:
     overrides: dict[str, Any] = {}
     if getattr(args, "static", False):
@@ -879,6 +911,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         except KeyboardInterrupt:
             pass
     elif args.command == "store":
+        if args.native:
+            _exec_native_store(args)
         from dynamo_tpu.store.server import StoreServer
 
         server = StoreServer(host=args.host, port=args.port)

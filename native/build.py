@@ -87,7 +87,38 @@ def build(force: bool = False) -> bool:
     return True
 
 
+STORE_SRC = os.path.join(HERE, "store", "store_server.cc")
+STORE_OUT = os.path.join(REPO, "dynamo_tpu", "native", "dynamo_store")
+
+
+def build_store(force: bool = False) -> bool:
+    """Compile the native coordinator binary (native/store/store_server.cc
+    -> dynamo_tpu/native/dynamo_store). Pure C++17, no dependencies."""
+    if (
+        not force
+        and os.path.exists(STORE_OUT)
+        and os.path.getmtime(STORE_OUT) > os.path.getmtime(STORE_SRC)
+    ):
+        return True
+    os.makedirs(os.path.dirname(STORE_OUT), exist_ok=True)
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-Wall", STORE_SRC, "-o", STORE_OUT,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except FileNotFoundError:
+        print("native: g++ not found; skipping store build", file=sys.stderr)
+        return os.path.exists(STORE_OUT)
+    except subprocess.CalledProcessError as e:
+        print(f"native: store build failed:\n{e.stderr}", file=sys.stderr)
+        return False
+    return True
+
+
 if __name__ == "__main__":
-    ok = build(force="--force" in sys.argv)
+    force = "--force" in sys.argv
+    ok = build(force=force)
     print(f"native: {'built' if ok else 'UNAVAILABLE'} -> {OUT}")
-    sys.exit(0 if ok else 1)
+    ok2 = build_store(force=force)
+    print(f"native: {'built' if ok2 else 'UNAVAILABLE'} -> {STORE_OUT}")
+    sys.exit(0 if ok and ok2 else 1)

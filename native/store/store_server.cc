@@ -1,0 +1,772 @@
+// dynamo-store: native coordinator for the distributed runtime.
+//
+// C++ implementation of the control plane the Python StoreServer exposes
+// (dynamo_tpu/store/{server,memory}.py is the semantic reference; the
+// upstream system this replaces is the reference's etcd+NATS pair,
+// lib/runtime/src/transports/{etcd,nats}.rs). Wire-compatible with
+// dynamo_tpu/store/client.py: 4-byte LE length-prefixed msgpack frames,
+// request {i, op, a}, unary reply {i, ok, v|e}, stream push {i: sid, s},
+// stream end {i: sid, end: true}.
+//
+// Single-threaded poll(2) event loop; a 100ms tick drives lease expiry,
+// queue redelivery, and blocked-pop timeouts. A dropped connection
+// revokes its leases (liveness), closes its streams, and abandons its
+// parked queue pops — identical semantics to the Python server.
+//
+// Build: g++ -O2 -std=c++17 -o dynamo_store store_server.cc
+
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <set>
+#include <stdexcept>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+// ---------------------------------------------------------------------------
+// msgpack subset (everything the store protocol uses)
+// ---------------------------------------------------------------------------
+
+struct Val {
+  enum Type { NIL, BOOL, INT, F64, STR, BIN, ARR, MAP } t = NIL;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0;
+  std::string s;                            // STR and BIN
+  std::vector<Val> a;                       // ARR
+  std::vector<std::pair<std::string, Val>> m;  // MAP (string keys only)
+
+  static Val nil() { return Val{}; }
+  static Val boolean(bool v) { Val x; x.t = BOOL; x.b = v; return x; }
+  static Val integer(int64_t v) { Val x; x.t = INT; x.i = v; return x; }
+  static Val real(double v) { Val x; x.t = F64; x.f = v; return x; }
+  static Val str(std::string v) { Val x; x.t = STR; x.s = std::move(v); return x; }
+  static Val bin(std::string v) { Val x; x.t = BIN; x.s = std::move(v); return x; }
+  static Val arr() { Val x; x.t = ARR; return x; }
+  static Val map() { Val x; x.t = MAP; return x; }
+
+  bool is_num() const { return t == INT || t == F64; }
+  double num() const { return t == INT ? (double)i : f; }
+  const Val* get(const char* key) const {
+    for (auto& kv : m)
+      if (kv.first == key) return &kv.second;
+    return nullptr;
+  }
+};
+
+static void put_be(std::string& out, uint64_t v, int bytes) {
+  for (int k = bytes - 1; k >= 0; --k) out.push_back((char)((v >> (8 * k)) & 0xff));
+}
+
+static void encode(const Val& v, std::string& out) {
+  switch (v.t) {
+    case Val::NIL: out.push_back((char)0xc0); break;
+    case Val::BOOL: out.push_back((char)(v.b ? 0xc3 : 0xc2)); break;
+    case Val::INT: {
+      int64_t x = v.i;
+      if (x >= 0 && x < 128) out.push_back((char)x);
+      else if (x < 0 && x >= -32) out.push_back((char)(int8_t)x);
+      else { out.push_back((char)0xd3); put_be(out, (uint64_t)x, 8); }
+      break;
+    }
+    case Val::F64: {
+      out.push_back((char)0xcb);
+      uint64_t bits;
+      static_assert(sizeof(bits) == sizeof(v.f), "");
+      std::memcpy(&bits, &v.f, 8);
+      put_be(out, bits, 8);
+      break;
+    }
+    case Val::STR: {
+      size_t n = v.s.size();
+      if (n < 32) out.push_back((char)(0xa0 | n));
+      else if (n < 256) { out.push_back((char)0xd9); out.push_back((char)n); }
+      else if (n < 65536) { out.push_back((char)0xda); put_be(out, n, 2); }
+      else { out.push_back((char)0xdb); put_be(out, n, 4); }
+      out += v.s;
+      break;
+    }
+    case Val::BIN: {
+      size_t n = v.s.size();
+      if (n < 256) { out.push_back((char)0xc4); out.push_back((char)n); }
+      else if (n < 65536) { out.push_back((char)0xc5); put_be(out, n, 2); }
+      else { out.push_back((char)0xc6); put_be(out, n, 4); }
+      out += v.s;
+      break;
+    }
+    case Val::ARR: {
+      size_t n = v.a.size();
+      if (n < 16) out.push_back((char)(0x90 | n));
+      else if (n < 65536) { out.push_back((char)0xdc); put_be(out, n, 2); }
+      else { out.push_back((char)0xdd); put_be(out, n, 4); }
+      for (auto& e : v.a) encode(e, out);
+      break;
+    }
+    case Val::MAP: {
+      size_t n = v.m.size();
+      if (n < 16) out.push_back((char)(0x80 | n));
+      else { out.push_back((char)0xde); put_be(out, n, 2); }
+      for (auto& kv : v.m) {
+        encode(Val::str(kv.first), out);
+        encode(kv.second, out);
+      }
+      break;
+    }
+  }
+}
+
+struct Decoder {
+  const uint8_t* p;
+  size_t n;
+  size_t pos = 0;
+  bool fail = false;
+
+  uint64_t be(int bytes) {
+    if (pos + (size_t)bytes > n) { fail = true; return 0; }
+    uint64_t v = 0;
+    for (int k = 0; k < bytes; ++k) v = (v << 8) | p[pos++];
+    return v;
+  }
+  std::string take(size_t len) {
+    if (pos + len > n) { fail = true; return {}; }
+    std::string s((const char*)p + pos, len);
+    pos += len;
+    return s;
+  }
+  Val decode() {
+    if (fail || pos >= n) { fail = true; return Val::nil(); }
+    uint8_t b = p[pos++];
+    if (b < 0x80) return Val::integer(b);
+    if (b >= 0xe0) return Val::integer((int8_t)b);
+    if ((b & 0xf0) == 0x80) return decode_map(b & 0x0f);
+    if ((b & 0xf0) == 0x90) return decode_arr(b & 0x0f);
+    if ((b & 0xe0) == 0xa0) return Val::str(take(b & 0x1f));
+    switch (b) {
+      case 0xc0: return Val::nil();
+      case 0xc2: return Val::boolean(false);
+      case 0xc3: return Val::boolean(true);
+      case 0xc4: return Val::bin(take(be(1)));
+      case 0xc5: return Val::bin(take(be(2)));
+      case 0xc6: return Val::bin(take(be(4)));
+      case 0xca: {
+        uint32_t bits = (uint32_t)be(4);
+        float f;
+        std::memcpy(&f, &bits, 4);
+        return Val::real(f);
+      }
+      case 0xcb: {
+        uint64_t bits = be(8);
+        double f;
+        std::memcpy(&f, &bits, 8);
+        return Val::real(f);
+      }
+      case 0xcc: return Val::integer((int64_t)be(1));
+      case 0xcd: return Val::integer((int64_t)be(2));
+      case 0xce: return Val::integer((int64_t)be(4));
+      case 0xcf: return Val::integer((int64_t)be(8));  // u64 (fits: ids are small)
+      case 0xd0: return Val::integer((int8_t)be(1));
+      case 0xd1: return Val::integer((int16_t)be(2));
+      case 0xd2: return Val::integer((int32_t)be(4));
+      case 0xd3: return Val::integer((int64_t)be(8));
+      case 0xd9: return Val::str(take(be(1)));
+      case 0xda: return Val::str(take(be(2)));
+      case 0xdb: return Val::str(take(be(4)));
+      case 0xdc: return decode_arr(be(2));
+      case 0xdd: return decode_arr(be(4));
+      case 0xde: return decode_map(be(2));
+      case 0xdf: return decode_map(be(4));
+      default: fail = true; return Val::nil();
+    }
+  }
+  Val decode_arr(size_t count) {
+    Val v = Val::arr();
+    for (size_t k = 0; k < count && !fail; ++k) v.a.push_back(decode());
+    return v;
+  }
+  Val decode_map(size_t count) {
+    Val v = Val::map();
+    for (size_t k = 0; k < count && !fail; ++k) {
+      Val key = decode();
+      Val val = decode();
+      v.m.emplace_back(key.s, std::move(val));
+    }
+    return v;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Store state
+// ---------------------------------------------------------------------------
+
+static double now_s() {
+  using namespace std::chrono;
+  return duration<double>(steady_clock::now().time_since_epoch()).count();
+}
+
+static bool subject_matches(const std::string& pattern, const std::string& subject) {
+  // NATS-style: '.'-separated tokens, '*' = one token, '>' = 1+ trailing
+  if (pattern.find('*') == std::string::npos && pattern.find('>') == std::string::npos)
+    return pattern == subject;
+  auto split = [](const std::string& s) {
+    std::vector<std::string> out;
+    size_t start = 0;
+    while (true) {
+      size_t dot = s.find('.', start);
+      if (dot == std::string::npos) { out.push_back(s.substr(start)); break; }
+      out.push_back(s.substr(start, dot - start));
+      start = dot + 1;
+    }
+    return out;
+  };
+  auto pt = split(pattern), st = split(subject);
+  for (size_t i = 0; i < pt.size(); ++i) {
+    if (pt[i] == ">") return st.size() >= i + 1;
+    if (i >= st.size()) return false;
+    if (pt[i] != "*" && pt[i] != st[i]) return false;
+  }
+  return pt.size() == st.size();
+}
+
+struct Conn;  // fwd
+
+struct Entry {
+  std::string value;
+  int64_t version = 0;
+  int64_t lease_id = 0;
+};
+
+struct Lease {
+  double ttl_s = 0;
+  double expires_at = 0;
+  std::set<std::string> keys;
+};
+
+struct QMsg {
+  int64_t id;
+  std::string payload;
+};
+
+struct ParkedPop {
+  Conn* conn;
+  int64_t rid;
+  double deadline;   // <0: no timeout
+  double visibility;
+  uint64_t order;
+};
+
+struct QueueState {
+  int64_t next_id = 1;
+  std::deque<QMsg> ready;
+  std::map<int64_t, std::pair<QMsg, double>> in_flight;  // id -> (msg, redeliver at)
+  std::deque<ParkedPop> parked;
+};
+
+struct WatchReg {
+  Conn* conn;
+  int64_t sid;
+  std::string prefix;
+};
+
+struct SubReg {
+  Conn* conn;
+  int64_t sid;
+  std::string pattern;
+};
+
+struct Conn {
+  int fd;
+  std::string inbuf;
+  std::string outbuf;
+  std::set<int64_t> leases;
+  std::set<int64_t> stream_ids;
+  bool dead = false;
+};
+
+struct Server {
+  int listen_fd = -1;
+  std::map<int, std::unique_ptr<Conn>> conns;
+  // kv
+  std::map<std::string, Entry> kv;  // ordered: prefix scans
+  int64_t version = 0;
+  // leases
+  std::unordered_map<int64_t, Lease> leases;
+  int64_t next_lease = 1;
+  // streams
+  std::vector<WatchReg> watches;
+  std::vector<SubReg> subs;
+  int64_t next_sid = 1;
+  // queues / objects
+  std::unordered_map<std::string, QueueState> queues;
+  std::unordered_map<std::string, std::map<std::string, std::string>> objects;
+  uint64_t pop_order = 0;
+
+  // ---- framing ----------------------------------------------------------
+  void send_frame(Conn* c, const Val& v) {
+    if (c->dead) return;
+    std::string body;
+    encode(v, body);
+    uint32_t len = (uint32_t)body.size();
+    char hdr[4];
+    hdr[0] = (char)(len & 0xff);
+    hdr[1] = (char)((len >> 8) & 0xff);
+    hdr[2] = (char)((len >> 16) & 0xff);
+    hdr[3] = (char)((len >> 24) & 0xff);
+    c->outbuf.append(hdr, 4);
+    c->outbuf += body;
+  }
+
+  void reply_ok(Conn* c, int64_t rid, Val v) {
+    Val r = Val::map();
+    r.m.emplace_back("i", Val::integer(rid));
+    r.m.emplace_back("ok", Val::boolean(true));
+    r.m.emplace_back("v", std::move(v));
+    send_frame(c, r);
+  }
+
+  void reply_err(Conn* c, int64_t rid, const std::string& msg) {
+    Val r = Val::map();
+    r.m.emplace_back("i", Val::integer(rid));
+    r.m.emplace_back("ok", Val::boolean(false));
+    r.m.emplace_back("e", Val::str(msg));
+    send_frame(c, r);
+  }
+
+  void push_stream(Conn* c, int64_t sid, Val item) {
+    Val r = Val::map();
+    r.m.emplace_back("i", Val::integer(sid));
+    r.m.emplace_back("s", std::move(item));
+    send_frame(c, r);
+  }
+
+  // ---- kv ---------------------------------------------------------------
+  static Val enc_entry(const std::string& key, const Entry& e) {
+    Val v = Val::map();
+    v.m.emplace_back("k", Val::str(key));
+    v.m.emplace_back("v", Val::bin(e.value));
+    v.m.emplace_back("ver", Val::integer(e.version));
+    v.m.emplace_back("l", Val::integer(e.lease_id));
+    return v;
+  }
+
+  void emit_watch(const char* type, const std::string& key, const Entry& e) {
+    for (auto& w : watches) {
+      if (key.rfind(w.prefix, 0) == 0) {
+        Val ev = Val::map();
+        ev.m.emplace_back("t", Val::str(type));
+        ev.m.emplace_back("e", enc_entry(key, e));
+        push_stream(w.conn, w.sid, std::move(ev));
+      }
+    }
+  }
+
+  int64_t kv_put(const std::string& key, std::string value, int64_t lease_id) {
+    auto prev = kv.find(key);
+    if (prev != kv.end() && prev->second.lease_id != lease_id) {
+      auto old = leases.find(prev->second.lease_id);
+      if (old != leases.end()) old->second.keys.erase(key);
+    }
+    if (lease_id != 0) {
+      auto it = leases.find(lease_id);
+      if (it == leases.end()) throw std::runtime_error("KeyError: lease does not exist");
+      it->second.keys.insert(key);
+    }
+    Entry e{std::move(value), ++version, lease_id};
+    kv[key] = e;
+    emit_watch("put", key, e);
+    return e.version;
+  }
+
+  bool kv_delete(const std::string& key) {
+    auto it = kv.find(key);
+    if (it == kv.end()) return false;
+    Entry e = std::move(it->second);
+    kv.erase(it);
+    if (e.lease_id != 0) {
+      auto l = leases.find(e.lease_id);
+      if (l != leases.end()) l->second.keys.erase(key);
+    }
+    emit_watch("delete", key, e);
+    return true;
+  }
+
+  void lease_revoke(int64_t lid) {
+    auto it = leases.find(lid);
+    if (it == leases.end()) return;
+    std::vector<std::string> keys(it->second.keys.begin(), it->second.keys.end());
+    leases.erase(it);
+    for (auto& k : keys) kv_delete(k);
+  }
+
+  // ---- queues -----------------------------------------------------------
+  static Val enc_qmsg(const QMsg& m) {
+    Val v = Val::map();
+    v.m.emplace_back("id", Val::integer(m.id));
+    v.m.emplace_back("p", Val::bin(m.payload));
+    return v;
+  }
+
+  void serve_parked(const std::string& qname) {
+    auto& q = queues[qname];
+    while (!q.ready.empty() && !q.parked.empty()) {
+      ParkedPop pp = q.parked.front();
+      q.parked.pop_front();
+      if (pp.conn->dead) continue;
+      QMsg msg = std::move(q.ready.front());
+      q.ready.pop_front();
+      Val v = enc_qmsg(msg);
+      q.in_flight[msg.id] = {std::move(msg), now_s() + pp.visibility};
+      reply_ok(pp.conn, pp.rid, std::move(v));
+    }
+  }
+
+  // ---- request dispatch -------------------------------------------------
+  void handle(Conn* c, const Val& msg) {
+    const Val* iv = msg.get("i");
+    const Val* opv = msg.get("op");
+    if (!iv || !opv) return;  // malformed; drop
+    int64_t rid = iv->i;
+    const std::string& op = opv->s;
+    const Val* av = msg.get("a");
+    static const Val empty_arr = Val::arr();
+    const Val& args = av ? *av : empty_arr;
+    auto arg = [&](size_t k) -> const Val& {
+      static Val nil_v;
+      return k < args.a.size() ? args.a[k] : nil_v;
+    };
+    try {
+      if (op == "ping") {
+        reply_ok(c, rid, Val::str("pong"));
+      } else if (op == "kv_put") {
+        reply_ok(c, rid, Val::integer(kv_put(arg(0).s, arg(1).s, arg(2).i)));
+      } else if (op == "kv_create") {
+        if (kv.count(arg(0).s)) reply_ok(c, rid, Val::boolean(false));
+        else {
+          kv_put(arg(0).s, arg(1).s, arg(2).i);
+          reply_ok(c, rid, Val::boolean(true));
+        }
+      } else if (op == "kv_get") {
+        auto it = kv.find(arg(0).s);
+        reply_ok(c, rid, it == kv.end() ? Val::nil() : enc_entry(it->first, it->second));
+      } else if (op == "kv_get_prefix") {
+        Val out = Val::arr();
+        const std::string& prefix = arg(0).s;
+        for (auto it = kv.lower_bound(prefix);
+             it != kv.end() && it->first.rfind(prefix, 0) == 0; ++it)
+          out.a.push_back(enc_entry(it->first, it->second));
+        reply_ok(c, rid, std::move(out));
+      } else if (op == "kv_delete") {
+        reply_ok(c, rid, Val::boolean(kv_delete(arg(0).s)));
+      } else if (op == "kv_delete_prefix") {
+        const std::string& prefix = arg(0).s;
+        std::vector<std::string> keys;
+        for (auto it = kv.lower_bound(prefix);
+             it != kv.end() && it->first.rfind(prefix, 0) == 0; ++it)
+          keys.push_back(it->first);
+        for (auto& k : keys) kv_delete(k);
+        reply_ok(c, rid, Val::integer((int64_t)keys.size()));
+      } else if (op == "watch_prefix") {
+        int64_t sid = next_sid++;
+        const std::string& prefix = arg(0).s;
+        Val snapshot = Val::arr();
+        for (auto it = kv.lower_bound(prefix);
+             it != kv.end() && it->first.rfind(prefix, 0) == 0; ++it)
+          snapshot.a.push_back(enc_entry(it->first, it->second));
+        watches.push_back({c, sid, prefix});
+        c->stream_ids.insert(sid);
+        Val v = Val::map();
+        v.m.emplace_back("sid", Val::integer(sid));
+        v.m.emplace_back("snapshot", std::move(snapshot));
+        reply_ok(c, rid, std::move(v));
+      } else if (op == "lease_grant") {
+        int64_t lid = next_lease++;
+        double ttl = arg(0).num();
+        leases[lid] = Lease{ttl, now_s() + ttl, {}};
+        c->leases.insert(lid);
+        reply_ok(c, rid, Val::integer(lid));
+      } else if (op == "lease_keepalive") {
+        auto it = leases.find(arg(0).i);
+        if (it == leases.end()) reply_ok(c, rid, Val::boolean(false));
+        else {
+          it->second.expires_at = now_s() + it->second.ttl_s;
+          reply_ok(c, rid, Val::boolean(true));
+        }
+      } else if (op == "lease_revoke") {
+        lease_revoke(arg(0).i);
+        c->leases.erase(arg(0).i);
+        reply_ok(c, rid, Val::boolean(true));
+      } else if (op == "publish") {
+        const std::string& subject = arg(0).s;
+        for (auto& s : subs) {
+          if (subject_matches(s.pattern, subject)) {
+            Val item = Val::map();
+            item.m.emplace_back("subj", Val::str(subject));
+            item.m.emplace_back("p", Val::bin(arg(1).s));
+            push_stream(s.conn, s.sid, std::move(item));
+          }
+        }
+        reply_ok(c, rid, Val::boolean(true));
+      } else if (op == "subscribe") {
+        int64_t sid = next_sid++;
+        subs.push_back({c, sid, arg(0).s});
+        c->stream_ids.insert(sid);
+        Val v = Val::map();
+        v.m.emplace_back("sid", Val::integer(sid));
+        reply_ok(c, rid, std::move(v));
+      } else if (op == "stream_close") {
+        close_stream(c, arg(0).i, /*notify_end=*/true);
+        reply_ok(c, rid, Val::boolean(true));
+      } else if (op == "queue_push") {
+        auto& q = queues[arg(0).s];
+        QMsg msg{q.next_id++, arg(1).s};
+        int64_t id = msg.id;
+        q.ready.push_back(std::move(msg));
+        serve_parked(arg(0).s);
+        reply_ok(c, rid, Val::integer(id));
+      } else if (op == "queue_pop") {
+        const std::string& qname = arg(0).s;
+        auto& q = queues[qname];
+        double visibility = arg(2).is_num() ? arg(2).num() : 30.0;
+        if (!q.ready.empty()) {
+          QMsg msg = std::move(q.ready.front());
+          q.ready.pop_front();
+          Val v = enc_qmsg(msg);
+          q.in_flight[msg.id] = {std::move(msg), now_s() + visibility};
+          reply_ok(c, rid, std::move(v));
+        } else {
+          double deadline = arg(1).is_num() ? now_s() + arg(1).num() : -1.0;
+          if (arg(1).is_num() && arg(1).num() <= 0) reply_ok(c, rid, Val::nil());
+          else q.parked.push_back({c, rid, deadline, visibility, pop_order++});
+        }
+      } else if (op == "queue_ack") {
+        auto& q = queues[arg(0).s];
+        reply_ok(c, rid, Val::boolean(q.in_flight.erase(arg(1).i) > 0));
+      } else if (op == "queue_len") {
+        auto& q = queues[arg(0).s];
+        reply_ok(c, rid,
+                 Val::integer((int64_t)(q.ready.size() + q.in_flight.size())));
+      } else if (op == "obj_put") {
+        objects[arg(0).s][arg(1).s] = arg(2).s;
+        reply_ok(c, rid, Val::boolean(true));
+      } else if (op == "obj_get") {
+        auto b = objects.find(arg(0).s);
+        if (b == objects.end()) { reply_ok(c, rid, Val::nil()); return; }
+        auto o = b->second.find(arg(1).s);
+        reply_ok(c, rid, o == b->second.end() ? Val::nil() : Val::bin(o->second));
+      } else if (op == "obj_delete") {
+        auto b = objects.find(arg(0).s);
+        bool deleted = b != objects.end() && b->second.erase(arg(1).s) > 0;
+        reply_ok(c, rid, Val::boolean(deleted));
+      } else if (op == "obj_list") {
+        Val out = Val::arr();
+        auto b = objects.find(arg(0).s);
+        if (b != objects.end())
+          for (auto& kv2 : b->second) out.a.push_back(Val::str(kv2.first));
+        reply_ok(c, rid, std::move(out));
+      } else {
+        reply_err(c, rid, "ValueError: unknown op '" + op + "'");
+      }
+    } catch (const std::exception& e) {
+      reply_err(c, rid, e.what());
+    }
+  }
+
+  void close_stream(Conn* c, int64_t sid, bool notify_end) {
+    c->stream_ids.erase(sid);
+    watches.erase(std::remove_if(watches.begin(), watches.end(),
+                                 [&](const WatchReg& w) {
+                                   return w.conn == c && w.sid == sid;
+                                 }),
+                  watches.end());
+    subs.erase(std::remove_if(subs.begin(), subs.end(),
+                              [&](const SubReg& s) {
+                                return s.conn == c && s.sid == sid;
+                              }),
+               subs.end());
+    if (notify_end) {
+      Val r = Val::map();
+      r.m.emplace_back("i", Val::integer(sid));
+      r.m.emplace_back("end", Val::boolean(true));
+      send_frame(c, r);
+    }
+  }
+
+  // ---- periodic sweep ---------------------------------------------------
+  void sweep() {
+    double now = now_s();
+    std::vector<int64_t> expired;
+    for (auto& kv2 : leases)
+      if (kv2.second.expires_at <= now) expired.push_back(kv2.first);
+    for (int64_t lid : expired) lease_revoke(lid);
+
+    for (auto& qkv : queues) {
+      auto& q = qkv.second;
+      // redeliver timed-out in-flight messages (front of the queue)
+      std::vector<int64_t> timed_out;
+      for (auto& f : q.in_flight)
+        if (f.second.second <= now) timed_out.push_back(f.first);
+      for (int64_t mid : timed_out) {
+        q.ready.push_front(std::move(q.in_flight[mid].first));
+        q.in_flight.erase(mid);
+      }
+      // expire parked pops
+      for (auto it = q.parked.begin(); it != q.parked.end();) {
+        if (it->conn->dead) {
+          it = q.parked.erase(it);
+        } else if (it->deadline >= 0 && it->deadline <= now) {
+          reply_ok(it->conn, it->rid, Val::nil());
+          it = q.parked.erase(it);
+        } else {
+          ++it;
+        }
+      }
+      if (!timed_out.empty()) serve_parked(qkv.first);
+    }
+  }
+
+  // ---- connection lifecycle --------------------------------------------
+  void drop_conn(Conn* c) {
+    c->dead = true;
+    for (int64_t sid : std::vector<int64_t>(c->stream_ids.begin(), c->stream_ids.end()))
+      close_stream(c, sid, /*notify_end=*/false);
+    for (int64_t lid : std::vector<int64_t>(c->leases.begin(), c->leases.end()))
+      lease_revoke(lid);
+    close(c->fd);
+    conns.erase(c->fd);
+  }
+
+  void pump_conn(Conn* c) {
+    // parse complete frames from inbuf
+    while (!c->dead) {
+      if (c->inbuf.size() < 4) break;
+      uint32_t len = (uint8_t)c->inbuf[0] | ((uint8_t)c->inbuf[1] << 8) |
+                     ((uint8_t)c->inbuf[2] << 16) | ((uint8_t)c->inbuf[3] << 24);
+      if (len > 256u * 1024 * 1024) { drop_conn(c); return; }
+      if (c->inbuf.size() < 4 + (size_t)len) break;
+      Decoder d{(const uint8_t*)c->inbuf.data() + 4, len};
+      Val msg = d.decode();
+      c->inbuf.erase(0, 4 + (size_t)len);
+      if (!d.fail && msg.t == Val::MAP) handle(c, msg);
+    }
+  }
+
+  // ---- main loop --------------------------------------------------------
+  int run(const char* host, int port) {
+    signal(SIGPIPE, SIG_IGN);
+    listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1)
+      addr.sin_addr.s_addr = INADDR_ANY;
+    if (bind(listen_fd, (sockaddr*)&addr, sizeof addr) != 0) {
+      perror("bind");
+      return 1;
+    }
+    if (listen(listen_fd, 128) != 0) {
+      perror("listen");
+      return 1;
+    }
+    // report the actual port (port 0 = ephemeral) on stdout for drivers
+    sockaddr_in bound{};
+    socklen_t blen = sizeof bound;
+    getsockname(listen_fd, (sockaddr*)&bound, &blen);
+    printf("LISTENING %d\n", ntohs(bound.sin_port));
+    fflush(stdout);
+
+    std::vector<pollfd> fds;
+    char buf[1 << 16];
+    while (true) {
+      fds.clear();
+      fds.push_back({listen_fd, POLLIN, 0});
+      for (auto& kv2 : conns) {
+        short ev = POLLIN;
+        if (!kv2.second->outbuf.empty()) ev |= POLLOUT;
+        fds.push_back({kv2.first, ev, 0});
+      }
+      int rc = poll(fds.data(), (nfds_t)fds.size(), 100 /*ms: sweep tick*/);
+      if (rc < 0 && errno != EINTR) {
+        perror("poll");
+        return 1;
+      }
+      if (fds[0].revents & POLLIN) {
+        int fd = accept(listen_fd, nullptr, nullptr);
+        if (fd >= 0) {
+          fcntl(fd, F_SETFL, O_NONBLOCK);
+          setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+          auto c = std::make_unique<Conn>();
+          c->fd = fd;
+          conns[fd] = std::move(c);
+        }
+      }
+      std::vector<Conn*> to_drop;
+      for (size_t k = 1; k < fds.size(); ++k) {
+        auto it = conns.find(fds[k].fd);
+        if (it == conns.end()) continue;
+        Conn* c = it->second.get();
+        if (fds[k].revents & (POLLERR | POLLHUP)) {
+          to_drop.push_back(c);
+          continue;
+        }
+        if (fds[k].revents & POLLIN) {
+          while (true) {
+            ssize_t got = recv(c->fd, buf, sizeof buf, 0);
+            if (got > 0) c->inbuf.append(buf, (size_t)got);
+            else if (got == 0) { to_drop.push_back(c); break; }
+            else if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+            else { to_drop.push_back(c); break; }
+          }
+          if (!c->dead) pump_conn(c);
+        }
+        if (fds[k].revents & POLLOUT) flush_conn(c, to_drop);
+      }
+      // writes generated by this tick's requests/streams
+      for (auto& kv2 : conns)
+        if (!kv2.second->outbuf.empty()) flush_conn(kv2.second.get(), to_drop);
+      for (Conn* c : to_drop)
+        if (conns.count(c->fd)) drop_conn(c);
+      sweep();
+    }
+  }
+
+  void flush_conn(Conn* c, std::vector<Conn*>& to_drop) {
+    while (!c->outbuf.empty()) {
+      ssize_t sent = send(c->fd, c->outbuf.data(), c->outbuf.size(), 0);
+      if (sent > 0) c->outbuf.erase(0, (size_t)sent);
+      else if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      else {
+        if (std::find(to_drop.begin(), to_drop.end(), c) == to_drop.end())
+          to_drop.push_back(c);
+        break;
+      }
+    }
+  }
+};
+
+int main(int argc, char** argv) {
+  const char* host = "0.0.0.0";
+  int port = 4222;
+  for (int i = 1; i < argc - 1; ++i) {
+    if (!strcmp(argv[i], "--host")) host = argv[++i];
+    else if (!strcmp(argv[i], "--port")) port = atoi(argv[++i]);
+  }
+  Server s;
+  return s.run(host, port);
+}

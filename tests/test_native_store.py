@@ -1,0 +1,169 @@
+"""Native (C++) coordinator parity: the python StoreClient and the full
+distributed runtime must behave identically over native/store/
+store_server.cc as over the python StoreServer (which is the semantic
+reference)."""
+
+import asyncio
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BINARY = os.path.join(REPO, "dynamo_tpu", "native", "dynamo_store")
+
+
+@pytest.fixture(scope="module")
+def native_store_binary():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "native", "build.py")],
+        capture_output=True, text=True,
+    )
+    if not os.path.exists(BINARY):
+        pytest.skip(f"native store build unavailable: {r.stderr[-200:]}")
+    return BINARY
+
+
+@pytest.fixture
+def native_store(native_store_binary):
+    # sync fixture: the conftest's asyncio shim only handles async TESTS
+    proc = subprocess.Popen(
+        [native_store_binary, "--host", "127.0.0.1", "--port", "0"],
+        stdout=subprocess.PIPE,
+    )
+    line = proc.stdout.readline()
+    assert line.startswith(b"LISTENING"), line
+    port = int(line.split()[1])
+    yield port
+    proc.kill()
+    proc.wait()
+
+
+async def test_native_store_full_parity(native_store):
+    from dynamo_tpu.store.client import StoreClient
+
+    c = await StoreClient.connect("127.0.0.1", native_store)
+    try:
+        # kv: versions, create, prefix order, delete
+        v1 = await c.kv_put("a/x", b"1")
+        v2 = await c.kv_put("a/y", b"2")
+        assert v2 > v1
+        assert not await c.kv_create("a/x", b"dupe")
+        assert await c.kv_create("a/new", b"n")
+        got = await c.kv_get_prefix("a/")
+        assert [e.key for e in got] == ["a/new", "a/x", "a/y"]
+        assert await c.kv_delete("a/new")
+        assert not await c.kv_delete("a/new")
+        assert await c.kv_delete_prefix("a/") == 2
+
+        # lease against a missing id errors like the python server
+        with pytest.raises(Exception):
+            await c.kv_put("k", b"v", lease_id=424242)
+
+        # watch: snapshot + put/delete events
+        await c.kv_put("w/1", b"a")
+        w = await c.watch_prefix("w/")
+        assert [e.key for e in w.snapshot()] == ["w/1"]
+        await c.kv_put("w/2", b"b")
+        await c.kv_delete("w/1")
+        it = w.__aiter__()
+        ev1 = await asyncio.wait_for(it.__anext__(), 3)
+        ev2 = await asyncio.wait_for(it.__anext__(), 3)
+        assert (ev1.type, ev1.entry.key) == ("put", "w/2")
+        assert (ev2.type, ev2.entry.key) == ("delete", "w/1")
+        await w.close()
+
+        # lease expiry deletes attached keys
+        lid = await c.lease_grant(0.3)
+        await c.kv_put("lease/me", b"x", lease_id=lid)
+        await asyncio.sleep(0.8)
+        assert await c.kv_get("lease/me") is None
+
+        # re-put under a new lease detaches from the old one
+        l1 = await c.lease_grant(0.3)
+        l2 = await c.lease_grant(30)
+        await c.kv_put("stable", b"1", lease_id=l1)
+        await c.kv_put("stable", b"2", lease_id=l2)
+        await asyncio.sleep(0.8)  # l1 expires: must NOT delete "stable"
+        e = await c.kv_get("stable")
+        assert e is not None and e.value == b"2"
+
+        # pub/sub wildcards
+        sub = await c.subscribe("ns.*.ev")
+        subj_all = await c.subscribe("ns.>")
+        await c.publish("ns.w1.ev", b"p1")
+        await c.publish("other.w1.ev", b"nope")
+        s, p = await asyncio.wait_for(sub.__aiter__().__anext__(), 3)
+        assert (s, p) == ("ns.w1.ev", b"p1")
+        s2, _ = await asyncio.wait_for(subj_all.__aiter__().__anext__(), 3)
+        assert s2 == "ns.w1.ev"
+        await sub.close()
+        await subj_all.close()
+
+        # queues: fifo, blocking pop, visibility redelivery, ack, len
+        await c.queue_push("q", b"m1")
+        await c.queue_push("q", b"m2")
+        m1 = await c.queue_pop("q", timeout_s=1, visibility_s=30)
+        m2 = await c.queue_pop("q", timeout_s=1, visibility_s=0.3)
+        assert (m1.payload, m2.payload) == (b"m1", b"m2")
+        assert await c.queue_ack("q", m1.id)
+        await asyncio.sleep(0.8)  # m2 visibility expires -> redelivered
+        m2b = await c.queue_pop("q", timeout_s=2)
+        assert m2b.payload == b"m2"
+        assert await c.queue_ack("q", m2b.id)
+        assert not await c.queue_ack("q", m2b.id)
+        assert await c.queue_len("q") == 0
+        assert await c.queue_pop("q", timeout_s=0.1) is None
+
+        # object plane (binary-safe)
+        blob = bytes(range(256)) * 10
+        await c.obj_put("bkt", "blob", blob)
+        assert await c.obj_get("bkt", "blob") == blob
+        assert await c.obj_list("bkt") == ["blob"]
+        assert await c.obj_delete("bkt", "blob")
+        assert await c.obj_get("bkt", "blob") is None
+    finally:
+        await c.close()
+
+
+async def test_runtime_e2e_over_native_store(native_store):
+    """The full distributed runtime (serve + discovery + streaming call +
+    lease liveness) over the C++ coordinator."""
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.engine import Context, FnEngine, collect
+    from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    cfg = lambda: RuntimeConfig(  # noqa: E731
+        store_host="127.0.0.1", store_port=native_store,
+        worker_host="127.0.0.1", lease_ttl_s=1.0, lease_keepalive_s=0.3,
+    )
+
+    async def echo(request, ctx):
+        for tok in request["tokens"]:
+            yield {"token": tok}
+
+    worker = await DistributedRuntime.create(config=cfg())
+    frontend = await DistributedRuntime.create(config=cfg())
+    try:
+        ep = worker.namespace("cns").component("w").endpoint("gen")
+        await ep.serve(FnEngine(echo))
+        client = await (
+            frontend.namespace("cns").component("w").endpoint("gen").client()
+        )
+        await client.wait_for_instances()
+        router = PushRouter(client, RouterMode.ROUND_ROBIN)
+        items = await collect(router.generate({"tokens": [1, 2, 3]}, Context()))
+        assert [i["token"] for i in items] == [1, 2, 3]
+
+        # worker death (connection drop) revokes its lease: the instance
+        # disappears from discovery within the sweep interval
+        await worker.shutdown()
+        for _ in range(40):
+            if not client.instance_ids():
+                break
+            await asyncio.sleep(0.1)
+        assert not client.instance_ids()
+    finally:
+        await frontend.shutdown()
