@@ -59,6 +59,8 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--max-tokens-default", type=int, default=None)
     # engine knobs (reference: flags.rs)
     run.add_argument("--tensor-parallel-size", type=int, default=1)
+    run.add_argument("--pipeline-parallel-size", type=int, default=1,
+                     help="GPipe stage rotation over a pp mesh axis")
     run.add_argument("--sequence-parallel-size", type=int, default=1,
                      help="prefill role only: shard the prompt over an "
                           "sp mesh axis (ring attention)")

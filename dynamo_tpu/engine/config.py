@@ -15,6 +15,7 @@ class EngineConfig:
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
     expert_parallel_size: int = 1
+    pipeline_parallel_size: int = 1  # GPipe stage rotation (parallel/pipeline.py)
     num_nodes: int = 1
     node_rank: int = 0
     leader_addr: str = ""
@@ -64,6 +65,7 @@ def load_engine_config(args: Any) -> EngineConfig:
         model_path=args.model_path or "",
         model_name=args.model_name or (args.model_path or "model").rstrip("/").rsplit("/", 1)[-1],
         tensor_parallel_size=getattr(args, "tensor_parallel_size", 1),
+        pipeline_parallel_size=getattr(args, "pipeline_parallel_size", 1),
         num_nodes=getattr(args, "num_nodes", 1),
         node_rank=getattr(args, "node_rank", 0),
         leader_addr=getattr(args, "leader_addr", ""),

@@ -45,7 +45,6 @@ from dynamo_tpu.engine.scheduler import (
 from dynamo_tpu.models import ModelConfig
 from dynamo_tpu.models.llama import (
     CACHE_SPEC,
-    forward,
     init_cache,
     param_specs,
 )
@@ -89,6 +88,7 @@ class JaxEngine:
         self._step_fn: Optional[Callable] = None
         self._step_fn_mm: Optional[Callable] = None
         self._multi_step_fn: Optional[Callable] = None
+        self._pp = config.pipeline_parallel_size
         self._thread: Optional[threading.Thread] = None
         self._incoming: thread_queue.Queue = thread_queue.Queue()
         self._control: thread_queue.Queue = thread_queue.Queue()
@@ -130,11 +130,33 @@ class JaxEngine:
             )
         mesh_cfg = MeshConfig(
             dp=cfg.data_parallel_size,
+            pp=cfg.pipeline_parallel_size,
             tp=cfg.tensor_parallel_size,
             ep=cfg.expert_parallel_size,
         )
         devices = jax.devices()[: mesh_cfg.size]
         self.mesh = build_mesh(mesh_cfg, devices)
+
+        specs_fn = None
+        cache_spec = None
+        if self._pp > 1:
+            # stage-sharded layer stacks + cache (parallel/pipeline.py);
+            # resolve_model calls specs_fn once the config is known, so
+            # the pp/layer-count compatibility check runs BEFORE any
+            # expensive weight load
+            from dynamo_tpu.parallel.pipeline import PP_CACHE_SPEC, pp_param_specs
+
+            pp = self._pp
+
+            def specs_fn(mc: ModelConfig) -> dict:
+                if mc.num_hidden_layers % pp != 0:
+                    raise ValueError(
+                        f"pipeline_parallel_size={pp} must divide "
+                        f"num_hidden_layers={mc.num_hidden_layers}"
+                    )
+                return pp_param_specs(mc)
+
+            cache_spec = PP_CACHE_SPEC
 
         from dynamo_tpu.models import loader
 
@@ -144,6 +166,7 @@ class JaxEngine:
             random_weights=cfg.random_weights,
             seed=cfg.seed,
             mesh=self.mesh,
+            specs_fn=specs_fn,
         )
         self.eos_token_ids = self.model_config.eos_token_ids
 
@@ -154,6 +177,7 @@ class JaxEngine:
             cfg.block_size,
             self.mesh,
             dtype=jnp.dtype(cfg.kv_cache_dtype),
+            spec=cache_spec,
         )
         self.allocator = BlockAllocator(
             num_blocks,
@@ -224,7 +248,8 @@ class JaxEngine:
             free = stats["bytes_limit"] - stats["bytes_in_use"]
             budget = free * self.config.hbm_utilization
             # cache is sharded over tp: each device holds Hkv/tp heads
-            budget_total = budget * self.config.tensor_parallel_size
+            budget_total = budget * (self.config.tensor_parallel_size
+                                      * self.config.pipeline_parallel_size)
             n = int(budget_total // bytes_per_block_total)
             return max(16, min(n, 1_000_000))
         except Exception:
@@ -267,6 +292,16 @@ class JaxEngine:
         mc = self.model_config
         block_size = self.config.block_size
         assert mc is not None
+
+        if self._pp > 1:
+            from dynamo_tpu.parallel.pipeline import forward_pp
+
+            mesh = self.mesh
+
+            def forward(*a, **kw):  # noqa: F811 — pp-sharded model step
+                return forward_pp(*a, mesh=mesh, **kw)
+        else:
+            from dynamo_tpu.models.llama import forward  # noqa: F811
 
         def step(
             params,
@@ -698,6 +733,12 @@ class JaxEngine:
         salt = DEFAULT_SALT
         if request.mm_embeds:
             from dynamo_tpu.multimodal.embeds import unpack_segments
+
+            if self._pp > 1:
+                raise ValueError(
+                    "multimodal embedding injection is not supported with "
+                    "pipeline parallelism yet"
+                )
 
             # Validate HERE, where a bad request errors on its own — a
             # malformed shape surfacing inside the jitted step would

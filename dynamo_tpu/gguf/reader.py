@@ -301,7 +301,7 @@ _GGUF_LAYER = {
 }
 
 
-def load_params_from_gguf(cfg, reader: GGUFReader, mesh=None):
+def load_params_from_gguf(cfg, reader: GGUFReader, mesh=None, specs=None):
     """Load GGUF weights into the stacked-layer pytree (same contract as
     models/loader.py load_params)."""
     import jax
@@ -311,7 +311,7 @@ def load_params_from_gguf(cfg, reader: GGUFReader, mesh=None):
     from dynamo_tpu.models.llama import param_shapes, param_specs
 
     shapes = param_shapes(cfg)
-    specs = param_specs(cfg)
+    specs = specs if specs is not None else param_specs(cfg)
     params: dict[str, Any] = {}
 
     def put(name: str, arr) -> Any:

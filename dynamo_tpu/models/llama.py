@@ -131,9 +131,12 @@ def param_specs(cfg: ModelConfig) -> dict[str, P]:
     return specs
 
 
-def init_params(cfg: ModelConfig, seed: int = 0, mesh: Optional[Mesh] = None) -> Params:
-    """Random init (for tests / benchmarks without weights)."""
+def init_params(cfg: ModelConfig, seed: int = 0, mesh: Optional[Mesh] = None,
+                specs: Optional[dict] = None) -> Params:
+    """Random init (for tests / benchmarks without weights). ``specs``
+    overrides the default TP PartitionSpecs (e.g. pp-sharded stacks)."""
     shapes = param_shapes(cfg)
+    specs = specs if specs is not None else param_specs(cfg)
     key = jax.random.PRNGKey(seed)
     keys = jax.random.split(key, len(shapes))
     params: Params = {}
@@ -145,7 +148,7 @@ def init_params(cfg: ModelConfig, seed: int = 0, mesh: Optional[Mesh] = None) ->
         else:
             arr = (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
         if mesh is not None:
-            arr = jax.device_put(arr, NamedSharding(mesh, param_specs(cfg)[name]))
+            arr = jax.device_put(arr, NamedSharding(mesh, specs[name]))
         params[name] = arr
     return params
 
@@ -171,12 +174,13 @@ def init_cache(
     block_size: int,
     mesh: Optional[Mesh] = None,
     dtype=jnp.bfloat16,
+    spec: Optional[P] = None,
 ) -> tuple[jax.Array, jax.Array]:
     shape = cache_shape(cfg, num_blocks, block_size)
     k = jnp.zeros(shape, dtype=dtype)
     v = jnp.zeros(shape, dtype=dtype)
     if mesh is not None:
-        sh = NamedSharding(mesh, CACHE_SPEC)
+        sh = NamedSharding(mesh, spec if spec is not None else CACHE_SPEC)
         k, v = jax.device_put(k, sh), jax.device_put(v, sh)
     return k, v
 

@@ -75,11 +75,15 @@ def resolve_model(
     random_weights: bool = False,
     seed: int = 0,
     mesh: Optional[Mesh] = None,
+    specs_fn: Optional[Any] = None,
 ):
     """Single entry for model bring-up: (ModelConfig, Params) from a
     single-file GGUF, an HF-format directory, or random init. The one
     copy of the load-priority cascade — the engine and the
-    sequence-parallel prefill worker both go through here."""
+    sequence-parallel prefill worker both go through here. ``specs_fn``
+    maps the resolved ModelConfig to PartitionSpec overrides (e.g.
+    pp-sharded layer stacks) and may validate/raise before any weight
+    loads."""
     from dynamo_tpu.models.llama import init_params
 
     is_gguf = bool(model_path) and model_path.endswith(".gguf")
@@ -99,15 +103,16 @@ def resolve_model(
                 model_config = config_from_gguf(reader)
             else:
                 model_config = ModelConfig.from_dir(model_path)
+        specs = specs_fn(model_config) if specs_fn is not None else None
         if not random_weights and reader is not None:
             from dynamo_tpu.gguf import load_params_from_gguf
 
-            params = load_params_from_gguf(model_config, reader, mesh)
+            params = load_params_from_gguf(model_config, reader, mesh, specs)
         elif not random_weights and model_path and has_weights(model_path):
-            params = load_params(model_config, model_path, mesh)
+            params = load_params(model_config, model_path, mesh, specs)
         else:
             log.warning("initializing RANDOM weights (no checkpoint found)")
-            params = init_params(model_config, seed, mesh)
+            params = init_params(model_config, seed, mesh, specs)
         return model_config, params
     finally:
         if reader is not None:
@@ -160,13 +165,15 @@ def _to_jax(arr: np.ndarray, dtype) -> jnp.ndarray:
 
 
 def load_params(
-    cfg: ModelConfig, model_dir: str, mesh: Optional[Mesh] = None
+    cfg: ModelConfig, model_dir: str, mesh: Optional[Mesh] = None,
+    specs: Optional[dict] = None,
 ) -> Params:
-    """Load and stack weights; device_put with TP shardings as we go so the
-    full f32 copy never materializes on one device."""
+    """Load and stack weights; device_put with shardings as we go so the
+    full f32 copy never materializes on one device. ``specs`` overrides
+    the default TP PartitionSpecs (e.g. pp-sharded layer stacks)."""
     ckpt = _ShardedCheckpoint(model_dir)
     shapes = param_shapes(cfg)
-    specs = param_specs(cfg)
+    specs = specs if specs is not None else param_specs(cfg)
     params: Params = {}
 
     def put(name: str, arr: jnp.ndarray) -> jnp.ndarray:

@@ -6,6 +6,8 @@ slot 0, which bubble ticks scribble on by design).
 """
 
 import numpy as np
+import pytest
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -99,3 +101,64 @@ def test_pp_more_microbatches_than_stages():
 def test_pp_decode_step():
     # T=1 decode: every microbatch is one token per sequence
     _run_pp(pp=2, tp=2, B=4, T=1, L=2)
+
+
+async def test_engine_serves_with_pipeline_parallelism():
+    """A pp=2 x tp=2 engine must produce the same greedy tokens as the
+    single-device engine for the same weights/config (the pp path is a
+    distributed reformulation of the same forward)."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    mc = ModelConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=4,
+        max_position_embeddings=128,
+    )
+
+    async def run(pp: int, tp: int) -> list[int]:
+        engine = await JaxEngine.launch(
+            EngineConfig(
+                model_path="", model_name="pp-test", random_weights=True,
+                num_blocks=32, block_size=4, max_batch_size=4,
+                pipeline_parallel_size=pp, tensor_parallel_size=tp,
+                kv_cache_dtype="float32",
+            ),
+            model_config=mc,
+        )
+        req = PreprocessedRequest(
+            request_id=f"pp{pp}", token_ids=list(range(1, 14)),
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=5, ignore_eos=True),
+        )
+        toks: list[int] = []
+        async for item in engine.as_async_engine().generate(req, Context()):
+            toks.extend(item.token_ids)
+        await engine.shutdown()
+        return toks
+
+    base = await run(1, 1)
+    pp_toks = await run(2, 2)
+    assert base == pp_toks
+
+
+async def test_engine_rejects_incompatible_pp():
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    mc = ModelConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+    )
+    with pytest.raises(ValueError, match="must divide"):
+        await JaxEngine.launch(
+            EngineConfig(model_path="", random_weights=True, num_blocks=8,
+                         block_size=4, pipeline_parallel_size=3),
+            model_config=mc,
+        )
