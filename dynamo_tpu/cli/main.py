@@ -131,6 +131,9 @@ def build_parser() -> argparse.ArgumentParser:
     planner.add_argument("--max-prefill", type=int, default=8)
     planner.add_argument("--store-host", default="127.0.0.1")
     planner.add_argument("--store-port", type=int, default=4222)
+    planner.add_argument("--log-dir", default=None,
+                         help="write planner metrics JSONL (+ TensorBoard "
+                              "events when torch is available) here")
 
     deploy = sub.add_parser("deploy", help="graph deployment ctl "
                             "(≈ DynamoGraphDeployment CRs)")
@@ -799,10 +802,20 @@ async def cmd_planner(args: Any) -> None:
             max_prefill=args.max_prefill,
         ),
     )
-    await planner.start()
-    print("planner running", flush=True)
-    await drt.runtime.wait_shutdown()
-    await planner.close()
+    mlog = None
+    if args.log_dir:
+        from dynamo_tpu.planner.metrics_log import MetricsLogger
+
+        mlog = MetricsLogger(args.log_dir)
+        planner.on_metrics = mlog
+    try:
+        await planner.start()
+        print("planner running", flush=True)
+        await drt.runtime.wait_shutdown()
+        await planner.close()
+    finally:
+        if mlog is not None:
+            mlog.close()  # flush buffered TensorBoard events
     await drt.shutdown()
 
 

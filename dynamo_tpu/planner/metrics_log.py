@@ -1,0 +1,51 @@
+"""Planner metrics logging: JSONL always, TensorBoard when available.
+
+The reference planner writes its load/scaling signals to TensorBoard
+(reference: examples/llm/components/planner.py tensorboard writer,
+docs/planner.md:73-78). Here the durable format is JSONL (greppable,
+no reader dependency) with TensorBoard event files written alongside
+when torch is importable — plug an instance into ``Planner.on_metrics``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Optional
+
+log = logging.getLogger("dynamo_tpu.planner.metrics")
+
+
+class MetricsLogger:
+    def __init__(self, log_dir: str, tensorboard: bool = True):
+        os.makedirs(log_dir, exist_ok=True)
+        self.path = os.path.join(log_dir, "planner_metrics.jsonl")
+        self._f = open(self.path, "a", buffering=1)
+        self._tb = None
+        if tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir=log_dir)
+            except Exception:
+                log.info("tensorboard unavailable; JSONL only")
+
+    def __call__(self, snap: dict[str, Any]) -> None:
+        self._f.write(json.dumps(snap) + "\n")
+        if self._tb is not None:
+            # step from wall time: restarts with the same log dir stay
+            # monotone instead of superimposing a second run at step 0
+            step = int(snap.get("ts") or time.time())
+            walltime = float(snap.get("ts") or time.time())
+            for key, value in snap.items():
+                if key != "ts" and isinstance(value, (int, float)):
+                    self._tb.add_scalar(
+                        f"planner/{key}", value, step, walltime=walltime
+                    )
+
+    def close(self) -> None:
+        self._f.close()
+        if self._tb is not None:
+            self._tb.close()
