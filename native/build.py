@@ -94,10 +94,11 @@ STORE_OUT = os.path.join(REPO, "dynamo_tpu", "native", "dynamo_store")
 def build_store(force: bool = False) -> bool:
     """Compile the native coordinator binary (native/store/store_server.cc
     -> dynamo_tpu/native/dynamo_store). Pure C++17, no dependencies."""
+    deps = [STORE_SRC, os.path.join(HERE, "store", "msgpack.h")]
     if (
         not force
         and os.path.exists(STORE_OUT)
-        and os.path.getmtime(STORE_OUT) > os.path.getmtime(STORE_SRC)
+        and all(os.path.getmtime(STORE_OUT) > os.path.getmtime(d) for d in deps)
     ):
         return True
     os.makedirs(os.path.dirname(STORE_OUT), exist_ok=True)
@@ -115,10 +116,42 @@ def build_store(force: bool = False) -> bool:
     return True
 
 
+KV_SRC = os.path.join(HERE, "store", "kv_publisher_c.cc")
+KV_OUT = os.path.join(REPO, "dynamo_tpu", "native", "libdynamo_kv.so")
+
+
+def build_kv_publisher(force: bool = False) -> bool:
+    """Compile the C-ABI KV event publisher shared library (reference:
+    lib/bindings/c — lets non-python engines emit KV events)."""
+    deps = [KV_SRC, os.path.join(HERE, "store", "msgpack.h")]
+    if (
+        not force
+        and os.path.exists(KV_OUT)
+        and all(os.path.getmtime(KV_OUT) > os.path.getmtime(d) for d in deps)
+    ):
+        return True
+    os.makedirs(os.path.dirname(KV_OUT), exist_ok=True)
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-Wall", "-shared", "-fPIC",
+        KV_SRC, "-o", KV_OUT,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except FileNotFoundError:
+        print("native: g++ not found; skipping kv publisher", file=sys.stderr)
+        return os.path.exists(KV_OUT)
+    except subprocess.CalledProcessError as e:
+        print(f"native: kv publisher build failed:\n{e.stderr}", file=sys.stderr)
+        return False
+    return True
+
+
 if __name__ == "__main__":
     force = "--force" in sys.argv
     ok = build(force=force)
     print(f"native: {'built' if ok else 'UNAVAILABLE'} -> {OUT}")
     ok2 = build_store(force=force)
     print(f"native: {'built' if ok2 else 'UNAVAILABLE'} -> {STORE_OUT}")
-    sys.exit(0 if ok and ok2 else 1)
+    ok3 = build_kv_publisher(force=force)
+    print(f"native: {'built' if ok3 else 'UNAVAILABLE'} -> {KV_OUT}")
+    sys.exit(0 if ok and ok2 and ok3 else 1)

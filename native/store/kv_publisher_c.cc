@@ -1,0 +1,153 @@
+// C ABI for the KV event publisher (reference: lib/bindings/c — a C API
+// around the KV event publisher so non-Python engines, e.g. a C++
+// serving stack, can emit cache stored/removed events onto the event
+// plane the KV-aware router indexes).
+//
+// Speaks the coordinator store's wire protocol directly (4-byte LE
+// length-prefixed msgpack, op="publish"): no Python in the path. The
+// payload matches dynamo_tpu/kv_router/protocols.py RouterEvent:
+//   {worker_id, event_id, event: {op, block_hashes, token_block_size}}
+// published on "<namespace>.<component>.kv_events".
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC -o libdynamo_kv.so kv_publisher_c.cc
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include "msgpack.h"
+
+namespace {
+
+struct Publisher {
+  int fd = -1;
+  std::string subject;
+  int64_t worker_id = 0;
+  int64_t token_block_size = 16;
+  int64_t next_event_id = 1;
+  int64_t next_req_id = 1;
+};
+
+bool send_all(int fd, const char* p, size_t n) {
+  while (n) {
+    ssize_t sent = send(fd, p, n, 0);
+    if (sent <= 0) return false;
+    p += sent;
+    n -= (size_t)sent;
+  }
+  return true;
+}
+
+bool recv_all(int fd, char* p, size_t n) {
+  while (n) {
+    ssize_t got = recv(fd, p, n, 0);
+    if (got <= 0) return false;
+    p += got;
+    n -= (size_t)got;
+  }
+  return true;
+}
+
+// send one request frame and wait for its unary {i, ok} reply
+bool roundtrip(Publisher* pub, const Val& req) {
+  std::string body;
+  encode(req, body);
+  char hdr[4] = {
+      (char)(body.size() & 0xff), (char)((body.size() >> 8) & 0xff),
+      (char)((body.size() >> 16) & 0xff), (char)((body.size() >> 24) & 0xff)};
+  if (!send_all(pub->fd, hdr, 4) || !send_all(pub->fd, body.data(), body.size()))
+    return false;
+  char rhdr[4];
+  if (!recv_all(pub->fd, rhdr, 4)) return false;
+  uint32_t len = (uint8_t)rhdr[0] | ((uint8_t)rhdr[1] << 8) |
+                 ((uint8_t)rhdr[2] << 16) | ((uint8_t)rhdr[3] << 24);
+  if (len > 1u << 20) return false;
+  std::string rbody(len, '\0');
+  if (!recv_all(pub->fd, rbody.data(), len)) return false;
+  Decoder d{(const uint8_t*)rbody.data(), rbody.size()};
+  Val reply = d.decode();
+  if (d.fail || reply.t != Val::MAP) return false;
+  const Val* ok = reply.get("ok");
+  return ok != nullptr && ok->t == Val::BOOL && ok->b;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Connect to the coordinator and bind a publisher to one worker's
+// kv_events subject ("<namespace>.<component>.kv_events"). NULL on error.
+void* dynamo_kv_publisher_connect(const char* host, int port,
+                                  const char* subject, long long worker_id,
+                                  int token_block_size) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      connect(fd, (sockaddr*)&addr, sizeof addr) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  // bound every publish round trip: a wedged coordinator must fail the
+  // call, not hang the engine's event thread forever
+  timeval tv{10, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+  auto* pub = new Publisher();
+  pub->fd = fd;
+  pub->subject = subject;
+  pub->worker_id = worker_id;
+  pub->token_block_size = token_block_size > 0 ? token_block_size : 16;
+  return pub;
+}
+
+// op is "stored", "removed", or "cleared"; hashes are chained sequence
+// hashes (position-sensitive). Returns 0 on acknowledged publish.
+int dynamo_kv_publisher_publish(void* handle, const char* op,
+                                const unsigned long long* hashes, int n) {
+  auto* pub = (Publisher*)handle;
+  if (pub == nullptr || pub->fd < 0 || op == nullptr || n < 0) return -1;
+  if (n > 0 && hashes == nullptr) return -1;
+  Val event = Val::map();
+  event.m.emplace_back("op", Val::str(op));
+  Val bh = Val::arr();
+  for (int i = 0; i < n; ++i)
+    bh.a.push_back(Val::uint64(hashes[i]));
+  event.m.emplace_back("block_hashes", std::move(bh));
+  event.m.emplace_back("token_block_size", Val::integer(pub->token_block_size));
+
+  Val router_event = Val::map();
+  router_event.m.emplace_back("worker_id", Val::integer(pub->worker_id));
+  router_event.m.emplace_back("event_id", Val::integer(pub->next_event_id++));
+  router_event.m.emplace_back("event", std::move(event));
+  std::string payload;
+  encode(router_event, payload);
+
+  Val args = Val::arr();
+  args.a.push_back(Val::str(pub->subject));
+  args.a.push_back(Val::bin(std::move(payload)));
+  Val req = Val::map();
+  req.m.emplace_back("i", Val::integer(pub->next_req_id++));
+  req.m.emplace_back("op", Val::str("publish"));
+  req.m.emplace_back("a", std::move(args));
+  return roundtrip(pub, req) ? 0 : -1;
+}
+
+void dynamo_kv_publisher_close(void* handle) {
+  auto* pub = (Publisher*)handle;
+  if (pub == nullptr) return;
+  if (pub->fd >= 0) close(pub->fd);
+  delete pub;
+}
+
+}  // extern "C"

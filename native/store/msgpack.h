@@ -1,0 +1,190 @@
+// msgpack subset shared by the native coordinator server and the C-ABI
+// KV event publisher (everything the store wire protocol uses).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <utility>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// msgpack subset (everything the store protocol uses)
+// ---------------------------------------------------------------------------
+
+struct Val {
+  enum Type { NIL, BOOL, INT, UINT, F64, STR, BIN, ARR, MAP } t = NIL;
+  bool b = false;
+  int64_t i = 0;
+  uint64_t u = 0;
+  double f = 0;
+  std::string s;                            // STR and BIN
+  std::vector<Val> a;                       // ARR
+  std::vector<std::pair<std::string, Val>> m;  // MAP (string keys only)
+
+  static Val nil() { return Val{}; }
+  // unsigned 64-bit (always 0xcf): values >= 2^63 must NOT be emitted as
+  // negative int64 — python-side consumers (e.g. the KV router's radix
+  // keys) compare against unsigned xxh3 hashes
+  static Val uint64(uint64_t v) { Val x; x.t = UINT; x.u = v; return x; }
+  static Val boolean(bool v) { Val x; x.t = BOOL; x.b = v; return x; }
+  static Val integer(int64_t v) { Val x; x.t = INT; x.i = v; return x; }
+  static Val real(double v) { Val x; x.t = F64; x.f = v; return x; }
+  static Val str(std::string v) { Val x; x.t = STR; x.s = std::move(v); return x; }
+  static Val bin(std::string v) { Val x; x.t = BIN; x.s = std::move(v); return x; }
+  static Val arr() { Val x; x.t = ARR; return x; }
+  static Val map() { Val x; x.t = MAP; return x; }
+
+  bool is_num() const { return t == INT || t == F64; }
+  double num() const { return t == INT ? (double)i : f; }
+  const Val* get(const char* key) const {
+    for (auto& kv : m)
+      if (kv.first == key) return &kv.second;
+    return nullptr;
+  }
+};
+
+static void put_be(std::string& out, uint64_t v, int bytes) {
+  for (int k = bytes - 1; k >= 0; --k) out.push_back((char)((v >> (8 * k)) & 0xff));
+}
+
+static void encode(const Val& v, std::string& out) {
+  switch (v.t) {
+    case Val::NIL: out.push_back((char)0xc0); break;
+    case Val::BOOL: out.push_back((char)(v.b ? 0xc3 : 0xc2)); break;
+    case Val::UINT:
+      out.push_back((char)0xcf);
+      put_be(out, v.u, 8);
+      break;
+    case Val::INT: {
+      int64_t x = v.i;
+      if (x >= 0 && x < 128) out.push_back((char)x);
+      else if (x < 0 && x >= -32) out.push_back((char)(int8_t)x);
+      else { out.push_back((char)0xd3); put_be(out, (uint64_t)x, 8); }
+      break;
+    }
+    case Val::F64: {
+      out.push_back((char)0xcb);
+      uint64_t bits;
+      static_assert(sizeof(bits) == sizeof(v.f), "");
+      std::memcpy(&bits, &v.f, 8);
+      put_be(out, bits, 8);
+      break;
+    }
+    case Val::STR: {
+      size_t n = v.s.size();
+      if (n < 32) out.push_back((char)(0xa0 | n));
+      else if (n < 256) { out.push_back((char)0xd9); out.push_back((char)n); }
+      else if (n < 65536) { out.push_back((char)0xda); put_be(out, n, 2); }
+      else { out.push_back((char)0xdb); put_be(out, n, 4); }
+      out += v.s;
+      break;
+    }
+    case Val::BIN: {
+      size_t n = v.s.size();
+      if (n < 256) { out.push_back((char)0xc4); out.push_back((char)n); }
+      else if (n < 65536) { out.push_back((char)0xc5); put_be(out, n, 2); }
+      else { out.push_back((char)0xc6); put_be(out, n, 4); }
+      out += v.s;
+      break;
+    }
+    case Val::ARR: {
+      size_t n = v.a.size();
+      if (n < 16) out.push_back((char)(0x90 | n));
+      else if (n < 65536) { out.push_back((char)0xdc); put_be(out, n, 2); }
+      else { out.push_back((char)0xdd); put_be(out, n, 4); }
+      for (auto& e : v.a) encode(e, out);
+      break;
+    }
+    case Val::MAP: {
+      size_t n = v.m.size();
+      if (n < 16) out.push_back((char)(0x80 | n));
+      else { out.push_back((char)0xde); put_be(out, n, 2); }
+      for (auto& kv : v.m) {
+        encode(Val::str(kv.first), out);
+        encode(kv.second, out);
+      }
+      break;
+    }
+  }
+}
+
+struct Decoder {
+  const uint8_t* p;
+  size_t n;
+  size_t pos = 0;
+  bool fail = false;
+
+  uint64_t be(int bytes) {
+    if (pos + (size_t)bytes > n) { fail = true; return 0; }
+    uint64_t v = 0;
+    for (int k = 0; k < bytes; ++k) v = (v << 8) | p[pos++];
+    return v;
+  }
+  std::string take(size_t len) {
+    if (pos + len > n) { fail = true; return {}; }
+    std::string s((const char*)p + pos, len);
+    pos += len;
+    return s;
+  }
+  Val decode() {
+    if (fail || pos >= n) { fail = true; return Val::nil(); }
+    uint8_t b = p[pos++];
+    if (b < 0x80) return Val::integer(b);
+    if (b >= 0xe0) return Val::integer((int8_t)b);
+    if ((b & 0xf0) == 0x80) return decode_map(b & 0x0f);
+    if ((b & 0xf0) == 0x90) return decode_arr(b & 0x0f);
+    if ((b & 0xe0) == 0xa0) return Val::str(take(b & 0x1f));
+    switch (b) {
+      case 0xc0: return Val::nil();
+      case 0xc2: return Val::boolean(false);
+      case 0xc3: return Val::boolean(true);
+      case 0xc4: return Val::bin(take(be(1)));
+      case 0xc5: return Val::bin(take(be(2)));
+      case 0xc6: return Val::bin(take(be(4)));
+      case 0xca: {
+        uint32_t bits = (uint32_t)be(4);
+        float f;
+        std::memcpy(&f, &bits, 4);
+        return Val::real(f);
+      }
+      case 0xcb: {
+        uint64_t bits = be(8);
+        double f;
+        std::memcpy(&f, &bits, 8);
+        return Val::real(f);
+      }
+      case 0xcc: return Val::integer((int64_t)be(1));
+      case 0xcd: return Val::integer((int64_t)be(2));
+      case 0xce: return Val::integer((int64_t)be(4));
+      case 0xcf: return Val::integer((int64_t)be(8));  // u64 (fits: ids are small)
+      case 0xd0: return Val::integer((int8_t)be(1));
+      case 0xd1: return Val::integer((int16_t)be(2));
+      case 0xd2: return Val::integer((int32_t)be(4));
+      case 0xd3: return Val::integer((int64_t)be(8));
+      case 0xd9: return Val::str(take(be(1)));
+      case 0xda: return Val::str(take(be(2)));
+      case 0xdb: return Val::str(take(be(4)));
+      case 0xdc: return decode_arr(be(2));
+      case 0xdd: return decode_arr(be(4));
+      case 0xde: return decode_map(be(2));
+      case 0xdf: return decode_map(be(4));
+      default: fail = true; return Val::nil();
+    }
+  }
+  Val decode_arr(size_t count) {
+    Val v = Val::arr();
+    for (size_t k = 0; k < count && !fail; ++k) v.a.push_back(decode());
+    return v;
+  }
+  Val decode_map(size_t count) {
+    Val v = Val::map();
+    for (size_t k = 0; k < count && !fail; ++k) {
+      Val key = decode();
+      Val val = decode();
+      v.m.emplace_back(key.s, std::move(val));
+    }
+    return v;
+  }
+};
+

@@ -22,7 +22,7 @@ def native_store_binary():
     )
     if not os.path.exists(BINARY):
         pytest.skip(f"native store build unavailable: {r.stderr[-200:]}")
-    return BINARY
+    return BINARY  # build.py also produced libdynamo_kv.so
 
 
 @pytest.fixture
@@ -167,3 +167,89 @@ async def test_runtime_e2e_over_native_store(native_store):
         assert not client.instance_ids()
     finally:
         await frontend.shutdown()
+
+
+KV_LIB = os.path.join(REPO, "dynamo_tpu", "native", "libdynamo_kv.so")
+
+
+async def _drive_c_publisher(port: int) -> None:
+    """Publish from the C ABI to the given coordinator port and assert
+    the python subscriber receives valid RouterEvents — including hashes
+    with the top bit set (must arrive as UNSIGNED ints, matching the
+    radix tree's xxh3 keys)."""
+    import ctypes
+
+    import msgpack
+
+    from dynamo_tpu.kv_router.protocols import RouterEvent
+    from dynamo_tpu.store.client import StoreClient
+
+    big = 0x9000000000000001  # >= 2^63: a signed-int64 encoding would corrupt it
+    client = await StoreClient.connect("127.0.0.1", port)
+    sub = await client.subscribe("ns.backend.kv_events")
+    try:
+        lib = ctypes.CDLL(KV_LIB)
+        lib.dynamo_kv_publisher_connect.restype = ctypes.c_void_p
+        lib.dynamo_kv_publisher_connect.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_longlong, ctypes.c_int,
+        ]
+        lib.dynamo_kv_publisher_publish.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_ulonglong), ctypes.c_int,
+        ]
+
+        def publish():
+            h = lib.dynamo_kv_publisher_connect(
+                b"127.0.0.1", port, b"ns.backend.kv_events", 42, 16
+            )
+            assert h
+            arr = (ctypes.c_ulonglong * 3)(111, big, 333)
+            assert lib.dynamo_kv_publisher_publish(h, b"stored", arr, 3) == 0
+            assert lib.dynamo_kv_publisher_publish(h, b"removed", arr, 1) == 0
+            assert lib.dynamo_kv_publisher_publish(h, b"stored", None, 1) == -1
+            lib.dynamo_kv_publisher_close(ctypes.c_void_p(h))
+
+        await asyncio.get_running_loop().run_in_executor(None, publish)
+        events = []
+
+        async def consume():
+            async for _subj, payload in sub:
+                events.append(
+                    RouterEvent.model_validate(msgpack.unpackb(payload, raw=False))
+                )
+                if len(events) == 2:
+                    return
+
+        await asyncio.wait_for(consume(), 5)
+        assert events[0].worker_id == 42
+        assert events[0].event.op == "stored"
+        assert events[0].event.block_hashes == [111, big, 333]
+        assert events[0].event.token_block_size == 16
+        assert events[1].event.op == "removed"
+        assert [e.event_id for e in events] == [1, 2]
+    finally:
+        await sub.close()
+        await client.close()
+
+
+async def test_c_abi_kv_publisher_python_server(native_store_binary):
+    """C publisher against the python StoreServer."""
+    from dynamo_tpu.store.memory import MemoryStore
+    from dynamo_tpu.store.server import StoreServer
+
+    if not os.path.exists(KV_LIB):
+        pytest.skip("kv publisher lib unavailable")
+    server = StoreServer(MemoryStore(), port=0)
+    await server.start()
+    try:
+        await _drive_c_publisher(server.port)
+    finally:
+        await server.stop()
+
+
+async def test_c_abi_kv_publisher_native_server(native_store):
+    """The no-python-in-the-path pairing: C publisher -> C++ coordinator."""
+    if not os.path.exists(KV_LIB):
+        pytest.skip("kv publisher lib unavailable")
+    await _drive_c_publisher(native_store)
