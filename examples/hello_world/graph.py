@@ -1,0 +1,37 @@
+"""Hello-world service graph (reference: examples/hello_world): three
+chained components passing a string through, each decorating it.
+
+Serve with:
+    dynamo-tpu store &
+    dynamo-tpu serve examples.hello_world.graph:Frontend
+"""
+
+from dynamo_tpu.sdk.service import depends, endpoint, service
+
+
+@service(dynamo={"namespace": "hello"})
+class Backend:
+    @endpoint()
+    async def generate(self, request):
+        for word in request["text"].split():
+            yield {"text": f"back.{word}"}
+
+
+@service(dynamo={"namespace": "hello"})
+class Middle:
+    backend = depends(Backend)
+
+    @endpoint()
+    async def generate(self, request):
+        async for item in self.backend.generate(request):
+            yield {"text": f"mid.{item['text']}"}
+
+
+@service(dynamo={"namespace": "hello"})
+class Frontend:
+    middle = depends(Middle)
+
+    @endpoint()
+    async def generate(self, request):
+        async for item in self.middle.generate(request):
+            yield {"text": f"front.{item['text']}"}

@@ -1,0 +1,92 @@
+"""LLM service graph (reference: examples/llm — Frontend→Processor→
+Worker): a tokenizing processor in front of a native JAX engine worker.
+
+Configure with MODEL_PATH (an HF-format dir or .gguf; unset = random
+weights with the repo's tiny test tokenizer). Serve with:
+
+    dynamo-tpu store &
+    dynamo-tpu serve examples.llm.graph:Processor
+
+and call the processor endpoint, or front it with
+``dynamo-tpu run --in http --out dyn://llm.Processor.generate``.
+"""
+
+import os
+
+from dynamo_tpu.sdk.service import depends, endpoint, service
+
+MODEL_PATH = os.environ.get(
+    "MODEL_PATH",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "tests", "data", "tiny_llama_model",
+    ),
+)
+
+
+@service(dynamo={"namespace": "llm"}, resources={"tpu": 1})
+class Worker:
+    """Tokens-in/tokens-out native engine (reference: the vLLM worker)."""
+
+    def __init__(self):
+        self.engine = None
+
+    async def _ensure_engine(self):
+        if self.engine is None:
+            from dynamo_tpu.engine import EngineConfig, JaxEngine
+
+            self.engine = await JaxEngine.launch(
+                EngineConfig(
+                    model_path=MODEL_PATH,
+                    model_name="llm-worker",
+                    random_weights=not os.environ.get("MODEL_PATH"),
+                    num_blocks=int(os.environ.get("NUM_BLOCKS", "256")),
+                    block_size=16,
+                    max_batch_size=8,
+                )
+            )
+        return self.engine
+
+    @endpoint()
+    async def generate(self, request):
+        from dynamo_tpu.runtime.engine import Context
+
+        engine = await self._ensure_engine()
+        async for item in engine.as_async_engine().generate(request, Context()):
+            yield item.model_dump(exclude_none=True)
+
+
+@service(dynamo={"namespace": "llm"})
+class Processor:
+    """Tokenize + detokenize around the worker (reference:
+    examples/llm/components/processor.py)."""
+
+    worker = depends(Worker)
+
+    def __init__(self):
+        from dynamo_tpu.tokenizer import Tokenizer
+
+        self.tokenizer = Tokenizer.from_file(MODEL_PATH)
+
+    @endpoint()
+    async def generate(self, request):
+        from dynamo_tpu.protocols.common import (
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+
+        req = PreprocessedRequest(
+            request_id=request.get("request_id", "example"),
+            token_ids=self.tokenizer.encode(request["prompt"]),
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(
+                max_tokens=int(request.get("max_tokens", 16)), ignore_eos=True
+            ),
+        )
+        async for item in self.worker.generate(req.model_dump()):
+            toks = item.get("token_ids") or []
+            if toks:
+                yield {"text": self.tokenizer.decode(toks), "token_ids": toks}
+            if item.get("finish_reason"):
+                yield {"finish_reason": item["finish_reason"]}

@@ -36,7 +36,7 @@ struct Publisher {
 
 bool send_all(int fd, const char* p, size_t n) {
   while (n) {
-    ssize_t sent = send(fd, p, n, 0);
+    ssize_t sent = send(fd, p, n, MSG_NOSIGNAL);
     if (sent <= 0) return false;
     p += sent;
     n -= (size_t)sent;
@@ -54,25 +54,41 @@ bool recv_all(int fd, char* p, size_t n) {
   return true;
 }
 
-// send one request frame and wait for its unary {i, ok} reply
-bool roundtrip(Publisher* pub, const Val& req) {
+// Poison the connection: after a timeout or partial send the stream is
+// desynchronized (a late reply would be misread as the next call's ack,
+// a half-sent frame corrupts the server's parse), so fail every
+// subsequent publish fast instead.
+void poison(Publisher* pub) {
+  if (pub->fd >= 0) close(pub->fd);
+  pub->fd = -1;
+}
+
+// send one request frame and wait for ITS unary {i, ok} reply
+bool roundtrip(Publisher* pub, int64_t rid, const Val& req) {
   std::string body;
   encode(req, body);
   char hdr[4] = {
       (char)(body.size() & 0xff), (char)((body.size() >> 8) & 0xff),
       (char)((body.size() >> 16) & 0xff), (char)((body.size() >> 24) & 0xff)};
-  if (!send_all(pub->fd, hdr, 4) || !send_all(pub->fd, body.data(), body.size()))
+  if (!send_all(pub->fd, hdr, 4) || !send_all(pub->fd, body.data(), body.size())) {
+    poison(pub);
     return false;
+  }
   char rhdr[4];
-  if (!recv_all(pub->fd, rhdr, 4)) return false;
+  if (!recv_all(pub->fd, rhdr, 4)) { poison(pub); return false; }
   uint32_t len = (uint8_t)rhdr[0] | ((uint8_t)rhdr[1] << 8) |
                  ((uint8_t)rhdr[2] << 16) | ((uint8_t)rhdr[3] << 24);
-  if (len > 1u << 20) return false;
+  if (len > 1u << 20) { poison(pub); return false; }
   std::string rbody(len, '\0');
-  if (!recv_all(pub->fd, rbody.data(), len)) return false;
+  if (!recv_all(pub->fd, rbody.data(), len)) { poison(pub); return false; }
   Decoder d{(const uint8_t*)rbody.data(), rbody.size()};
   Val reply = d.decode();
-  if (d.fail || reply.t != Val::MAP) return false;
+  if (d.fail || reply.t != Val::MAP) { poison(pub); return false; }
+  const Val* id = reply.get("i");
+  if (id == nullptr || id->t != Val::INT || id->i != rid) {
+    poison(pub);  // stale/mismatched reply: stream out of sync
+    return false;
+  }
   const Val* ok = reply.get("ok");
   return ok != nullptr && ok->t == Val::BOOL && ok->b;
 }
@@ -136,11 +152,12 @@ int dynamo_kv_publisher_publish(void* handle, const char* op,
   Val args = Val::arr();
   args.a.push_back(Val::str(pub->subject));
   args.a.push_back(Val::bin(std::move(payload)));
+  int64_t rid = pub->next_req_id++;
   Val req = Val::map();
-  req.m.emplace_back("i", Val::integer(pub->next_req_id++));
+  req.m.emplace_back("i", Val::integer(rid));
   req.m.emplace_back("op", Val::str("publish"));
   req.m.emplace_back("a", std::move(args));
-  return roundtrip(pub, req) ? 0 : -1;
+  return roundtrip(pub, rid, req) ? 0 : -1;
 }
 
 void dynamo_kv_publisher_close(void* handle) {

@@ -1,0 +1,94 @@
+"""The shipped example graphs serve end-to-end (reference: sdk
+tests/test_e2e.py serving the examples pipeline)."""
+
+import asyncio
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+async def test_hello_world_graph_serves():
+    from examples.hello_world.graph import Backend, Frontend, Middle
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.engine import Context, collect
+    from dynamo_tpu.sdk.runner import serve_service
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+    from dynamo_tpu.store.memory import MemoryStore
+    from dynamo_tpu.store.server import StoreServer
+
+    server = StoreServer(MemoryStore(lease_sweep_interval_s=0.1), port=0)
+    await server.start()
+    cfg = lambda: RuntimeConfig(  # noqa: E731
+        store_host="127.0.0.1", store_port=server.port,
+        worker_host="127.0.0.1",
+    )
+    drts = []
+    try:
+        for svc in (Backend, Middle, Frontend):
+            drt = await DistributedRuntime.create(config=cfg())
+            drts.append(drt)
+            await serve_service(svc, drt)
+        caller = await DistributedRuntime.create(config=cfg())
+        drts.append(caller)
+        client = await (
+            caller.namespace("hello").component("frontend")
+            .endpoint("generate").client()
+        )
+        await client.wait_for_instances()
+        from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+
+        router = PushRouter(client, RouterMode.ROUND_ROBIN)
+        items = await collect(router.generate({"text": "a b"}, Context()))
+        assert [i["text"] for i in items] == [
+            "front.mid.back.a", "front.mid.back.b"
+        ]
+    finally:
+        for drt in drts:
+            await drt.shutdown()
+        await server.stop()
+
+
+async def test_llm_graph_generates():
+    from examples.llm.graph import Processor, Worker
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.engine import Context, collect
+    from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+    from dynamo_tpu.sdk.runner import serve_service
+    from dynamo_tpu.store.memory import MemoryStore
+    from dynamo_tpu.store.server import StoreServer
+
+    server = StoreServer(MemoryStore(lease_sweep_interval_s=0.1), port=0)
+    await server.start()
+    cfg = lambda: RuntimeConfig(  # noqa: E731
+        store_host="127.0.0.1", store_port=server.port,
+        worker_host="127.0.0.1",
+    )
+    drts = []
+    try:
+        for svc in (Worker, Processor):
+            drt = await DistributedRuntime.create(config=cfg())
+            drts.append(drt)
+            await serve_service(svc, drt)
+        caller = await DistributedRuntime.create(config=cfg())
+        drts.append(caller)
+        client = await (
+            caller.namespace("llm").component("processor")
+            .endpoint("generate").client()
+        )
+        await client.wait_for_instances()
+        router = PushRouter(client, RouterMode.ROUND_ROBIN)
+        items = await collect(
+            router.generate({"prompt": "hello world", "max_tokens": 5}, Context())
+        )
+        toks = [t for i in items for t in i.get("token_ids", [])]
+        # random weights can sample ids the tiny tokenizer leaves
+        # unmapped (vocab_size > tokenizer size), so assert on tokens
+        assert len(toks) == 5
+        assert items[-1].get("finish_reason") == "length"
+    finally:
+        for drt in drts:
+            await drt.shutdown()
+        await server.stop()
