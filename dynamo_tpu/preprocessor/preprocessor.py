@@ -111,7 +111,11 @@ class OpenAIPreprocessor(Operator):
             kind = "completion"
         else:
             raise TypeError(f"unsupported request type {type(request)}")
-        include_usage = bool(request.stream_options and request.stream_options.include_usage)
+        # OpenAI semantics: non-streaming responses ALWAYS carry usage;
+        # streaming only includes it with stream_options.include_usage
+        include_usage = not request.stream or bool(
+            request.stream_options and request.stream_options.include_usage
+        )
         state = _ReqState(
             kind=kind,
             model=request.model or self.model_name,
