@@ -1,0 +1,102 @@
+"""Shared harness for full-process CLI e2e tests: spawn dynamo-tpu
+subcommands as real subprocesses (logs to temp files so chatty workers
+can't block on a full pipe), wait for HTTP readiness, and tear down
+with logs surfaced."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+from typing import Any, Callable
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MODEL_DIR = os.path.join(REPO, "tests", "data", "tiny_llama_model")
+
+ENV = dict(
+    os.environ,
+    PYTHONPATH=REPO,
+    JAX_PLATFORMS="cpu",
+    XLA_FLAGS="--xla_force_host_platform_device_count=1",
+)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class CliFleet:
+    """Spawns and tears down a set of dynamo-tpu CLI processes."""
+
+    def __init__(self) -> None:
+        self.procs: list[subprocess.Popen] = []
+        self._logs: list[Any] = []
+
+    def spawn(self, *args: str) -> subprocess.Popen:
+        logf = tempfile.TemporaryFile()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.cli.main", *args],
+            env=ENV, stdout=logf, stderr=subprocess.STDOUT,
+        )
+        self.procs.append(proc)
+        self._logs.append(logf)
+        return proc
+
+    def assert_alive(self) -> None:
+        for p in self.procs:
+            assert p.poll() is None, f"process died: {p.args}"
+
+    def teardown(self) -> None:
+        for p in self.procs:
+            p.send_signal(signal.SIGTERM)
+        chunks = []
+        for p, logf in zip(self.procs, self._logs):
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+            try:
+                logf.seek(0, os.SEEK_END)
+                size = logf.tell()
+                logf.seek(max(0, size - 1500))
+                chunks.append(logf.read().decode(errors="replace"))
+                logf.close()
+            except Exception:
+                pass
+        print("\n=== process logs ===\n" + "\n---\n".join(chunks))
+
+
+def wait_http(url: str, ready: Callable[[bytes], Any], timeout: float = 180.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2) as r:
+                if ready(r.read()):
+                    return
+        except Exception:
+            time.sleep(0.5)
+    raise TimeoutError(f"{url} never became ready")
+
+
+def complete(port: int, prompt: str, max_tokens: int,
+             model: str = "tiny_llama_model") -> dict:
+    """Non-streaming /v1/completions call; returns the parsed response.
+    ignore_eos rides the ext options (extension(), protocols/openai.py)."""
+    body = json.dumps({
+        "model": model, "prompt": prompt, "max_tokens": max_tokens,
+        "ext": {"ignore_eos": True},
+    }).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=180) as r:
+        return json.load(r)

@@ -1,126 +1,56 @@
-"""Full-process disaggregated serving e2e: the native coordinator, a
-decode worker with --disagg, a dedicated prefill worker, and an HTTP
-frontend — all real CLI subprocesses. A prompt longer than
+"""Full-process disaggregated serving e2e: the coordinator, a decode
+worker with --disagg, a dedicated prefill worker, and an HTTP frontend —
+all real CLI subprocesses. A prompt longer than
 max-local-prefill-length exercises queue → prefill engine → KV transfer
-→ host-tier onboarding → decode, and the output must match a plain
-aggregated run (the flagship path of SURVEY.md §3.3, end to end)."""
+→ host-tier onboarding → decode (the flagship path of SURVEY.md §3.3);
+with random weights the assertions are structural (finish_reason and
+usage counts), plus a short-prompt local-prefill request, and liveness
+of every process afterwards."""
 
 import json
-import os
-import signal
-import socket
-import subprocess
-import sys
 import time
-import urllib.request
 
-import pytest
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-MODEL_DIR = os.path.join(REPO, "tests", "data", "tiny_llama_model")
-
-ENV = dict(
-    os.environ,
-    PYTHONPATH=REPO,
-    JAX_PLATFORMS="cpu",
-    XLA_FLAGS="--xla_force_host_platform_device_count=1",
-)
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def _cli(*args: str, **kw) -> subprocess.Popen:
-    return subprocess.Popen(
-        [sys.executable, "-m", "dynamo_tpu.cli.main", *args],
-        env=ENV, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, **kw,
-    )
-
-
-def _wait_http(port: int, timeout: float = 120.0) -> None:
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        try:
-            with urllib.request.urlopen(
-                f"http://127.0.0.1:{port}/v1/models", timeout=2
-            ) as r:
-                if json.load(r)["data"]:
-                    return
-        except Exception:
-            time.sleep(0.5)
-    raise TimeoutError(f"frontend on :{port} never became ready")
-
-
-def _complete(port: int, prompt_words: int, max_tokens: int) -> list[str]:
-    body = json.dumps({
-        "model": "tiny_llama_model",
-        "prompt": "word " * prompt_words,
-        "max_tokens": max_tokens,
-        "ignore_eos": True,
-    }).encode()
-    req = urllib.request.Request(
-        f"http://127.0.0.1:{port}/v1/completions", data=body,
-        headers={"Content-Type": "application/json"},
-    )
-    with urllib.request.urlopen(req, timeout=180) as r:
-        out = json.load(r)
-    return out
+from cli_harness import MODEL_DIR, CliFleet, complete, free_port, wait_http
 
 
 def test_disagg_serving_end_to_end():
-    store_port = _free_port()
-    http_port = _free_port()
-    procs: list[subprocess.Popen] = []
+    store_port = free_port()
+    http_port = free_port()
+    fleet = CliFleet()
     try:
-        procs.append(_cli("store", "--host", "127.0.0.1",
-                          "--port", str(store_port)))
+        fleet.spawn("store", "--host", "127.0.0.1", "--port", str(store_port))
         time.sleep(2)
         common = ["--store-host", "127.0.0.1", "--store-port", str(store_port)]
         # decode worker: disagg on, low threshold so our prompt goes remote
-        procs.append(_cli(
+        fleet.spawn(
             "run", "--in", "dyn://e2e.backend.generate", "--out", "jax",
             "--model-path", MODEL_DIR, "--disagg",
             "--max-local-prefill-length", "24",
             "--host-kv-blocks", "64",
             *common,
-        ))
-        # dedicated prefill worker
-        procs.append(_cli(
+        )
+        fleet.spawn(
             "run", "--role", "prefill", "--out", "jax",
             "--model-path", MODEL_DIR, "--namespace", "e2e",
             *common,
-        ))
-        # frontend with local pre/post wrapping the remote worker
-        procs.append(_cli(
+        )
+        fleet.spawn(
             "run", "--in", "http", "--out", "dyn://e2e.backend.generate",
             "--model-path", MODEL_DIR, "--http-port", str(http_port),
             *common,
-        ))
-        _wait_http(http_port)
-        # long prompt (> 24 tokens): forced through the remote-prefill
-        # path. Random weights may sample tokenizer-unmapped ids (empty
-        # text), so assert on completion structure, not content.
-        out = _complete(http_port, prompt_words=40, max_tokens=8)
+        )
+        wait_http(
+            f"http://127.0.0.1:{http_port}/v1/models",
+            lambda b: json.loads(b)["data"],
+        )
+        # long prompt (> 24 tokens): forced through the remote-prefill path
+        out = complete(http_port, "word " * 40, max_tokens=8)
         assert out["choices"][0]["finish_reason"] == "length"
         assert out["usage"]["completion_tokens"] == 8
         # short prompt: local prefill on the decode worker
-        out2 = _complete(http_port, prompt_words=4, max_tokens=8)
+        out2 = complete(http_port, "word " * 4, max_tokens=8)
         assert out2["choices"][0]["finish_reason"] == "length"
         assert out2["usage"]["completion_tokens"] == 8
-        for p in procs:
-            assert p.poll() is None, f"process died: {p.args}"
+        fleet.assert_alive()
     finally:
-        logs = []
-        for p in procs:
-            p.send_signal(signal.SIGTERM)
-        for p in procs:
-            try:
-                out, _ = p.communicate(timeout=15)
-                logs.append(out.decode(errors="replace")[-1500:])
-            except subprocess.TimeoutExpired:
-                p.kill()
-        # surface worker logs on failure
-        print("\n=== process logs ===\n" + "\n---\n".join(logs))
+        fleet.teardown()

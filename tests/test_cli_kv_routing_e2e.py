@@ -1,0 +1,74 @@
+"""Full-process KV-aware routing e2e: coordinator + TWO jax workers
+publishing KV events + an HTTP frontend with --router-mode kv + the
+metrics service — all real CLI subprocesses. Repeating a prompt must
+keep landing on the worker that cached it (the reference's flagship
+3x-TTFT feature, SURVEY.md §3.3/§6), observable as a high average
+prefix-overlap in the metrics service's Prometheus exposition."""
+
+from cli_harness import MODEL_DIR, CliFleet, complete, free_port, wait_http
+
+import json
+import time
+import urllib.request
+
+
+def test_kv_routing_end_to_end():
+    store_port = free_port()
+    http_port = free_port()
+    metrics_port = free_port()
+    fleet = CliFleet()
+    try:
+        fleet.spawn("store", "--host", "127.0.0.1", "--port", str(store_port))
+        time.sleep(2)
+        common = ["--store-host", "127.0.0.1", "--store-port", str(store_port)]
+        for _ in range(2):
+            fleet.spawn(
+                "run", "--in", "dyn://kvr.backend.generate", "--out", "jax",
+                "--model-path", MODEL_DIR, *common,
+            )
+        fleet.spawn(
+            "run", "--in", "http", "--out", "dyn://kvr.backend.generate",
+            "--model-path", MODEL_DIR, "--http-port", str(http_port),
+            "--router-mode", "kv", *common,
+        )
+        fleet.spawn(
+            "metrics", "--namespace", "kvr", "--component", "backend",
+            "--port", str(metrics_port), *common,
+        )
+        wait_http(
+            f"http://127.0.0.1:{http_port}/v1/models",
+            lambda b: json.loads(b)["data"],
+        )
+
+        # a long shared prefix, repeated: after the first request caches
+        # it on one worker, the KV router must keep routing there
+        prompt = "alpha beta gamma delta " * 8
+        for _ in range(5):
+            out = complete(http_port, prompt, max_tokens=4)
+            assert out["choices"][0]["finish_reason"] == "length"
+
+        def scrape() -> dict[str, float]:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics_port}/metrics", timeout=5
+            ) as r:
+                out = {}
+                for line in r.read().decode().splitlines():
+                    if line and not line.startswith("#"):
+                        name = line.split("{")[0].split(" ")[0]
+                        out[name] = float(line.rsplit(" ", 1)[1])
+                return out
+
+        deadline = time.monotonic() + 60
+        hit = 0.0
+        while time.monotonic() < deadline:
+            hit = scrape().get("llm_kv_avg_hit_rate", 0.0)
+            if hit > 0.5:
+                break
+            time.sleep(1)
+        # repeats after the first must overlap the cached prefix almost
+        # fully; random/RR routing across 2 workers would average far
+        # lower. (4/5 requests can hit; threshold leaves slack.)
+        assert hit > 0.5, f"kv routing ineffective: avg hit rate {hit}"
+        fleet.assert_alive()
+    finally:
+        fleet.teardown()
