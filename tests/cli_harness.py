@@ -82,7 +82,10 @@ def wait_http(url: str, ready: Callable[[bytes], Any], timeout: float = 180.0) -
                 if ready(r.read()):
                     return
         except Exception:
-            time.sleep(0.5)
+            pass
+        # throttle in BOTH branches: a 200-but-not-ready endpoint must not
+        # be hammered during the startup it's waiting out
+        time.sleep(0.5)
     raise TimeoutError(f"{url} never became ready")
 
 

@@ -39,6 +39,12 @@ def test_kv_routing_end_to_end():
             f"http://127.0.0.1:{http_port}/v1/models",
             lambda b: json.loads(b)["data"],
         )
+        # BOTH workers must be routable before measuring, or the test
+        # passes vacuously with every request pinned to the only worker
+        wait_http(
+            f"http://127.0.0.1:{metrics_port}/metrics",
+            lambda b: b"llm_workers_reporting 2" in b.replace(b".0", b""),
+        )
 
         # a long shared prefix, repeated: after the first request caches
         # it on one worker, the KV router must keep routing there
