@@ -148,13 +148,17 @@ class OpenAIPreprocessor(Operator):
             if item.text:
                 yield gen.text_chunk(item.text)
             if item.finish_reason is not None:
-                usage = None
+                yield gen.finish_chunk(item.finish_reason)
                 if state.include_usage:
+                    # OpenAI semantics: usage rides a trailing chunk with
+                    # an empty choices array (stream_options.include_usage);
+                    # the non-streaming aggregators pick it up from there
                     ct = item.completion_tokens or completion_tokens
-                    usage = Usage(
-                        prompt_tokens=state.prompt_tokens,
-                        completion_tokens=ct,
-                        total_tokens=state.prompt_tokens + ct,
+                    yield gen.usage_chunk(
+                        Usage(
+                            prompt_tokens=state.prompt_tokens,
+                            completion_tokens=ct,
+                            total_tokens=state.prompt_tokens + ct,
+                        )
                     )
-                yield gen.finish_chunk(item.finish_reason, usage=usage)
                 return

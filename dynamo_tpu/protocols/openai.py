@@ -312,7 +312,7 @@ class ChatDeltaGenerator:
         )
 
     def finish_chunk(
-        self, reason: FinishReason | str, index: int = 0, usage: Optional[Usage] = None
+        self, reason: FinishReason | str, index: int = 0
     ) -> ChatCompletionChunk:
         reason_str = reason.value if isinstance(reason, FinishReason) else reason
         # OpenAI wire format only knows stop/length/content_filter/tool_calls
@@ -327,7 +327,6 @@ class ChatDeltaGenerator:
                     index=index, delta=ChatDelta(), finish_reason=reason_str
                 )
             ],
-            usage=usage,
         )
 
     def usage_chunk(self, usage: Usage) -> ChatCompletionChunk:
@@ -353,7 +352,7 @@ class CompletionDeltaGenerator:
         )
 
     def finish_chunk(
-        self, reason: FinishReason | str, index: int = 0, usage: Optional[Usage] = None
+        self, reason: FinishReason | str, index: int = 0
     ) -> CompletionResponse:
         reason_str = reason.value if isinstance(reason, FinishReason) else reason
         if reason_str in ("cancelled", "error"):
@@ -363,5 +362,10 @@ class CompletionDeltaGenerator:
             created=self.created,
             model=self.model,
             choices=[CompletionChoice(index=index, text="", finish_reason=reason_str)],
+        )
+
+    def usage_chunk(self, usage: Usage) -> CompletionResponse:
+        return CompletionResponse(
+            id=self.id, created=self.created, model=self.model, choices=[],
             usage=usage,
         )

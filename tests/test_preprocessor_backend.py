@@ -201,9 +201,12 @@ async def test_full_openai_pipeline_chat(tok):
         c.choices[0].delta.content or "" for c in chunks if c.choices
     )
     assert text == "Hello there!"
-    final = chunks[-1]
-    assert final.choices[0].finish_reason == "stop"
-    assert final.usage is not None and final.usage.prompt_tokens == len(sent.token_ids)
+    # finish chunk, then (OpenAI stream_options semantics) a trailing
+    # usage-only chunk with empty choices
+    finish, usage = chunks[-2], chunks[-1]
+    assert finish.choices[0].finish_reason == "stop"
+    assert usage.choices == []
+    assert usage.usage is not None and usage.usage.prompt_tokens == len(sent.token_ids)
 
 
 async def test_full_openai_pipeline_completion(tok):

@@ -106,7 +106,8 @@ def test_chat_delta_stream_and_aggregate():
     chunks = [
         gen.text_chunk("Hel"),
         gen.text_chunk("lo"),
-        gen.finish_chunk(FinishReason.STOP, usage=Usage(prompt_tokens=3, completion_tokens=2, total_tokens=5)),
+        gen.finish_chunk(FinishReason.STOP),
+        gen.usage_chunk(Usage(prompt_tokens=3, completion_tokens=2, total_tokens=5)),
     ]
     # first chunk carries the role
     assert chunks[0].choices[0].delta.role == "assistant"
