@@ -37,8 +37,11 @@ class CliFleet:
     """Spawns and tears down a set of dynamo-tpu CLI processes."""
 
     def __init__(self) -> None:
-        self.procs: list[subprocess.Popen] = []
-        self._logs: list[Any] = []
+        self._fleet: list[tuple[subprocess.Popen | None, Any]] = []
+
+    @property
+    def procs(self) -> list[subprocess.Popen]:
+        return [p for p, _ in self._fleet if p is not None]
 
     def spawn(self, *args: str) -> subprocess.Popen:
         logf = tempfile.TemporaryFile()
@@ -46,21 +49,30 @@ class CliFleet:
             [sys.executable, "-m", "dynamo_tpu.cli.main", *args],
             env=ENV, stdout=logf, stderr=subprocess.STDOUT,
         )
-        self.procs.append(proc)
-        self._logs.append(logf)
+        self._fleet.append((proc, logf))
         return proc
 
+    def forget(self, proc: subprocess.Popen) -> None:
+        """Stop tracking a process the test killed deliberately (its log
+        is still surfaced at teardown)."""
+        self._fleet = [
+            (p, f) if p is not proc else (None, f) for p, f in self._fleet
+        ]
+
     def assert_alive(self) -> None:
-        for p in self.procs:
-            assert p.poll() is None, f"process died: {p.args}"
+        for p, _ in self._fleet:
+            if p is not None:
+                assert p.poll() is None, f"process died: {p.args}"
 
     def teardown(self) -> None:
-        for p in self.procs:
-            p.send_signal(signal.SIGTERM)
+        for p, _ in self._fleet:
+            if p is not None:
+                p.send_signal(signal.SIGTERM)
         chunks = []
-        for p, logf in zip(self.procs, self._logs):
+        for p, logf in self._fleet:
             try:
-                p.wait(timeout=15)
+                if p is not None:
+                    p.wait(timeout=15)
             except subprocess.TimeoutExpired:
                 p.kill()
             try:

@@ -1,0 +1,76 @@
+"""Full-process elasticity e2e: two workers behind a frontend; killing
+one mid-service must not break serving (reference: etcd-lease liveness —
+lease revoke/expiry removes a dead worker from router views and traffic
+continues on the survivors, docs/disagg_serving.md elasticity story)."""
+
+import json
+import signal
+import time
+
+from cli_harness import MODEL_DIR, CliFleet, complete, free_port, wait_http
+
+
+def test_worker_death_failover():
+    store_port = free_port()
+    http_port = free_port()
+    metrics_port = free_port()
+    fleet = CliFleet()
+    try:
+        fleet.spawn("store", "--host", "127.0.0.1", "--port", str(store_port))
+        time.sleep(2)
+        common = ["--store-host", "127.0.0.1", "--store-port", str(store_port)]
+        workers = []
+        for _ in range(2):
+            workers.append(fleet.spawn(
+                "run", "--in", "dyn://ha.backend.generate", "--out", "jax",
+                "--model-path", MODEL_DIR, *common,
+            ))
+        fleet.spawn(
+            "run", "--in", "http", "--out", "dyn://ha.backend.generate",
+            "--model-path", MODEL_DIR, "--http-port", str(http_port),
+            *common,
+        )
+        fleet.spawn(
+            "metrics", "--namespace", "ha", "--component", "backend",
+            "--port", str(metrics_port), *common,
+        )
+        wait_http(
+            f"http://127.0.0.1:{http_port}/v1/models",
+            lambda b: json.loads(b)["data"],
+        )
+        wait_http(
+            f"http://127.0.0.1:{metrics_port}/metrics",
+            lambda b: b"llm_workers_reporting 2" in b.replace(b".0", b""),
+        )
+        # healthy: several requests round-robin over both workers
+        for _ in range(4):
+            out = complete(http_port, "failover test prompt", max_tokens=4)
+            assert out["choices"][0]["finish_reason"] == "length"
+
+        # hard-kill one worker (no graceful drain: its connection drop
+        # must revoke the lease and remove it from routing)
+        workers[0].send_signal(signal.SIGKILL)
+        workers[0].wait(timeout=10)
+        fleet.forget(workers[0])
+
+        # traffic must keep succeeding; allow a brief window where the
+        # router can still pick the dead instance before the lease sweep
+        deadline = time.monotonic() + 60
+        ok = 0
+        while ok < 6 and time.monotonic() < deadline:
+            try:
+                out = complete(http_port, "failover test prompt", max_tokens=4)
+                if out["choices"][0]["finish_reason"] == "length":
+                    ok += 1
+            except Exception:
+                time.sleep(0.5)
+        assert ok >= 6, f"only {ok} successful requests after worker death"
+        # and the survivor is the only one reporting
+        wait_http(
+            f"http://127.0.0.1:{metrics_port}/metrics",
+            lambda b: b"llm_workers_reporting 1" in b.replace(b".0", b""),
+            timeout=60,
+        )
+        fleet.assert_alive()
+    finally:
+        fleet.teardown()
