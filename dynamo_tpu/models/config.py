@@ -29,6 +29,12 @@ class ModelConfig:
     attention_bias: bool = False
     # mistral-family: attend only to the last `sliding_window` positions
     sliding_window: Optional[int] = None
+    # mlp activation: "silu" (llama et al) or "gelu" (gemma)
+    hidden_act: str = "silu"
+    # gemma-family: x *= sqrt(hidden_size) after embedding lookup, and
+    # rmsnorm weights are stored as (w - 1) so the norm multiplies (1+w)
+    scale_embeddings: bool = False
+    norm_bias_one: bool = False
     # MoE (Mixtral-style)
     num_local_experts: int = 0
     num_experts_per_tok: int = 2
@@ -61,6 +67,15 @@ class ModelConfig:
         # qwen2 checkpoints always use qkv bias but don't say so in config
         if raw.get("model_type") == "qwen2" and "attention_bias" not in raw:
             kwargs["attention_bias"] = True
+        # normalize HF gelu variants onto the one gelu we implement
+        if kwargs.get("hidden_act") in ("gelu_pytorch_tanh", "gelu_new"):
+            kwargs["hidden_act"] = "gelu"
+        # gemma semantics are implied by the model_type, not config keys
+        if raw.get("model_type") == "gemma":
+            kwargs["scale_embeddings"] = True
+            kwargs["norm_bias_one"] = True
+            kwargs.setdefault("hidden_act", "gelu")
+            kwargs.setdefault("tie_word_embeddings", True)
         # qwen2 configs carry sliding_window but HF defaults
         # use_sliding_window to FALSE: the window only applies when the
         # flag is explicitly true (mistral-family configs have no such

@@ -190,11 +190,33 @@ def init_cache(
 # ---------------------------------------------------------------------------
 
 
-def rmsnorm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+def rmsnorm(x: jax.Array, w: jax.Array, eps: float,
+            bias_one: bool = False) -> jax.Array:
+    """RMSNorm in f32. ``bias_one``: gemma stores weights as (w - 1) and
+    the norm multiplies by (1 + w)."""
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
-    out = xf * jax.lax.rsqrt(var + eps) * w
+    scale = (1.0 + w) if bias_one else w
+    out = xf * jax.lax.rsqrt(var + eps) * scale
     return out.astype(x.dtype)
+
+
+def mlp_act(cfg: ModelConfig, g: jax.Array) -> jax.Array:
+    """Gate activation: silu (llama family) or tanh-gelu (gemma).
+    Unknown activations fail loudly — a silent silu fallback would serve
+    corrupted logits for checkpoints we don't actually support."""
+    if cfg.hidden_act == "gelu":
+        return jax.nn.gelu(g, approximate=True)
+    if cfg.hidden_act == "silu":
+        return jax.nn.silu(g)
+    raise ValueError(f"unsupported hidden_act {cfg.hidden_act!r}")
+
+
+def scale_embed(cfg: ModelConfig, x: jax.Array) -> jax.Array:
+    """Gemma-family sqrt(hidden) embedding scaling (no-op otherwise)."""
+    if not cfg.scale_embeddings:
+        return x
+    return (x.astype(jnp.float32) * math.sqrt(cfg.hidden_size)).astype(x.dtype)
 
 
 def rope(q: jax.Array, k: jax.Array, positions: jax.Array, theta: float) -> tuple[jax.Array, jax.Array]:
@@ -303,7 +325,7 @@ def make_layer_fn(
         B, T = x.shape[0], x.shape[1]
         lp, k_cache_l, v_cache_l = scanned
         # attention
-        h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
         q = h @ lp["wq"]
         k = h @ lp["wk"]
         v = h @ lp["wv"]
@@ -330,11 +352,11 @@ def make_layer_fn(
             )
         x = x + (attn.reshape(B, T, H * Dh) @ lp["wo"]).astype(x.dtype)
         # mlp
-        h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
         if cfg.is_moe:
             x = x + _moe_mlp(cfg, lp, h).astype(x.dtype)
         else:
-            mlp_out = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+            mlp_out = (mlp_act(cfg, h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
             x = x + mlp_out.astype(x.dtype)
         return x, (k_cache_l, v_cache_l)
 
@@ -367,7 +389,7 @@ def forward(
     positions — the multimodal injection point (reference:
     examples/multimodal encode-worker → LLM embedding handoff).
     """
-    x = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
+    x = scale_embed(cfg, jnp.take(params["embed"], tokens, axis=0))  # [B, T, D]
     if extra_embeds is not None:
         assert embeds_mask is not None
         x = jnp.where(embeds_mask[..., None], extra_embeds.astype(x.dtype), x)
@@ -381,7 +403,7 @@ def forward(
         layer_fn, x, (layer_params, k_cache, v_cache)
     )
 
-    x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
     # logits only at each sequence's last real token
     x_last = jnp.take_along_axis(
         x, last_token_idx[:, None, None].astype(jnp.int32), axis=1

@@ -40,8 +40,10 @@ from dynamo_tpu.models.llama import (
     Params,
     _moe_mlp,
     layer_param_names,
+    mlp_act,
     rmsnorm,
     rope,
+    scale_embed,
 )
 from dynamo_tpu.parallel.ring_attention import ring_attention, ulysses_attention
 from dynamo_tpu.tokens import TokenBlockSequence
@@ -74,10 +76,10 @@ def long_prefill(
     attend = ring_attention if attn == "ring" else ulysses_attention
     positions = jnp.arange(T, dtype=jnp.int32)[None, :]
 
-    x = jnp.take(params["embed"], tokens, axis=0)  # [1, T, D]
+    x = scale_embed(cfg, jnp.take(params["embed"], tokens, axis=0))  # [1, T, D]
 
     def layer_fn(x, lp):
-        h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
         q = h @ lp["wq"]
         k = h @ lp["wk"]
         v = h @ lp["wv"]
@@ -89,17 +91,17 @@ def long_prefill(
         q, k = rope(q, k, positions, cfg.rope_theta)
         a = attend(q, k, v, mesh)
         x = x + (a.reshape(B, T, H * Dh) @ lp["wo"]).astype(x.dtype)
-        h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
         if cfg.is_moe:
             x = x + _moe_mlp(cfg, lp, h).astype(x.dtype)
         else:
-            mlp = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+            mlp = (mlp_act(cfg, h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
             x = x + mlp.astype(x.dtype)
         return x, (k, v)
 
     layer_params = {n: params[n] for n in layer_param_names(params)}
     x, (ks, vs) = jax.lax.scan(layer_fn, x, layer_params)
-    x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
     if last_idx is None:
         last_idx = jnp.asarray(T - 1, jnp.int32)
     x_last = jax.lax.dynamic_index_in_dim(x, last_idx, axis=1, keepdims=False)

@@ -38,6 +38,7 @@ from dynamo_tpu.models.llama import (
     make_layer_fn,
     param_specs,
     rmsnorm,
+    scale_embed,
 )
 
 
@@ -99,7 +100,7 @@ def forward_pp(
         raise ValueError(f"batch {B} not divisible by {M} microbatches")
     Bm = B // M
 
-    x = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
+    x = scale_embed(cfg, jnp.take(params["embed"], tokens, axis=0))  # [B, T, D]
     D = x.shape[-1]
 
     # microbatch views
@@ -185,6 +186,6 @@ def forward_pp(
       last_mb)
 
     x_last = outs.reshape(B, D)
-    x_last = rmsnorm(x_last, params["final_norm"], cfg.rms_norm_eps)
+    x_last = rmsnorm(x_last, params["final_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
     logits = (x_last @ params["lm_head"]).astype(jnp.float32)
     return logits, new_k, new_v

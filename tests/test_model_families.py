@@ -179,3 +179,94 @@ def test_qwen2_checkpoint_loads_biases(tmp_path):
     )
     logits = _run_forward(cfg, params, [1, 2, 3, 4, 5])
     assert np.isfinite(logits).all()
+
+
+def test_gemma_config_inference():
+    cfg = ModelConfig.from_dict({
+        "model_type": "gemma", "hidden_act": "gelu_pytorch_tanh", **TINY,
+    })
+    assert cfg.scale_embeddings and cfg.norm_bias_one
+    assert cfg.hidden_act == "gelu" and cfg.tie_word_embeddings
+    # llama untouched
+    base = ModelConfig.from_dict({"model_type": "llama", **TINY})
+    assert not base.scale_embeddings and not base.norm_bias_one
+    assert base.hidden_act == "silu"
+
+
+def test_gemma_semantics_change_outputs():
+    """Each gemma-specific behavior (embed scaling, (1+w) norm, gelu)
+    must actually alter the forward pass vs plain llama semantics."""
+    base = ModelConfig(model_type="llama", **TINY)
+    params = init_params(base, seed=0)
+    tokens = list(range(1, 9))
+    ref = _run_forward(base, params, tokens)
+    for field in ("scale_embeddings", "norm_bias_one", "hidden_act"):
+        kw = dict(TINY)
+        cfg = ModelConfig(
+            model_type="gemma-variant",
+            scale_embeddings=(field == "scale_embeddings"),
+            norm_bias_one=(field == "norm_bias_one"),
+            hidden_act="gelu" if field == "hidden_act" else "silu",
+            **kw,
+        )
+        out = _run_forward(cfg, params, tokens)
+        assert not np.allclose(out, ref), f"{field} had no effect"
+
+
+def test_gemma_rmsnorm_matches_hf_formula():
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.llama import rmsnorm
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 8)).astype(np.float32)
+    w = rng.standard_normal(8).astype(np.float32) * 0.1  # stored as (w-1)
+    got = np.asarray(rmsnorm(jnp.asarray(x), jnp.asarray(w), 1e-6,
+                             bias_one=True))
+    var = np.mean(x * x, axis=-1, keepdims=True)
+    want = x / np.sqrt(var + 1e-6) * (1.0 + w)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_gemma_checkpoint_tied_embeddings(tmp_path):
+    """Gemma ships no lm_head tensor: the loader must tie to embed.T,
+    and the full forward must run."""
+    from safetensors.numpy import save_file
+
+    from dynamo_tpu.models.loader import load_params
+
+    cfg = ModelConfig.from_dict({"model_type": "gemma", **TINY})
+    rng = np.random.default_rng(2)
+    D, H, Hk, Dh = (cfg.hidden_size, cfg.num_attention_heads,
+                    cfg.num_key_value_heads, cfg.head_dim)
+    F, V, L = cfg.intermediate_size, cfg.vocab_size, cfg.num_hidden_layers
+
+    def t(*shape):
+        return rng.standard_normal(shape).astype(np.float32) * 0.05
+
+    tensors = {
+        "model.embed_tokens.weight": t(V, D),
+        "model.norm.weight": t(D),  # gemma stores (w-1): any values
+    }
+    for i in range(L):
+        p = f"model.layers.{i}"
+        tensors.update({
+            f"{p}.input_layernorm.weight": t(D),
+            f"{p}.self_attn.q_proj.weight": t(H * Dh, D),
+            f"{p}.self_attn.k_proj.weight": t(Hk * Dh, D),
+            f"{p}.self_attn.v_proj.weight": t(Hk * Dh, D),
+            f"{p}.self_attn.o_proj.weight": t(D, H * Dh),
+            f"{p}.post_attention_layernorm.weight": t(D),
+            f"{p}.mlp.gate_proj.weight": t(F, D),
+            f"{p}.mlp.up_proj.weight": t(F, D),
+            f"{p}.mlp.down_proj.weight": t(D, F),
+        })
+    save_file(tensors, str(tmp_path / "model.safetensors"))
+    params = load_params(cfg, str(tmp_path))
+    np.testing.assert_allclose(
+        np.asarray(params["lm_head"], np.float32),
+        np.asarray(params["embed"], np.float32).T,
+        rtol=1e-2, atol=1e-2,
+    )
+    logits = _run_forward(cfg, params, [1, 2, 3, 4])
+    assert np.isfinite(logits).all()
