@@ -353,6 +353,7 @@ class JaxEngine:
             positions,  # [B, 1] its position
             block_tables,
             context_lens,
+            valid_steps,  # [B] steps the seq will actually keep (<= K)
             temperature,
             top_k,
             top_p,
@@ -373,6 +374,14 @@ class JaxEngine:
                     * bs
                     + pos_flat % bs
                 )
+                # The scheduler only allocates blocks for each sequence's
+                # remaining-token budget; steps past that window would have
+                # their table lookup clipped onto the seq's LAST REAL block
+                # (take_along_axis clips), corrupting possibly-shared KV.
+                # Redirect surplus writes to slot 0 — block 0 is the
+                # reserved garbage block. The surplus outputs are
+                # discarded host-side by _emit_window.
+                slot = jnp.where(i < valid_steps, slot, 0)
                 logits, k_c, v_c = forward(
                     mc, params, k_c, v_c, tok, pos, slot, block_tables,
                     ctx, jnp.zeros_like(pos_flat), bs,
@@ -639,6 +648,7 @@ class JaxEngine:
             arrays["positions"],
             arrays["block_tables"],
             arrays["context_lens"],
+            arrays["valid_steps"],
             sampling.temperature,
             sampling.top_k,
             sampling.top_p,

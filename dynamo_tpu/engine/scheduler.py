@@ -288,6 +288,20 @@ class Scheduler:
             seq.state = SeqState.RUNNING
             self.running.append(seq)
 
+    def _seq_lookahead(self, seq: Sequence) -> int:
+        """Fused-decode window steps this sequence can actually keep:
+        clamped to its remaining-token budget. Near max_tokens the
+        window's surplus is discarded, and allocating blocks for it would
+        trigger phantom preemptions under pressure. Block allocation
+        (_plan_decode) and the device-side KV-write mask
+        (build_decode_arrays' valid_steps) MUST use the same value — if
+        writes outrun allocation they land in another sequence's
+        possibly-shared block."""
+        lookahead = self.decode_lookahead
+        if seq.max_new_tokens is not None:
+            lookahead = min(lookahead, max(1, seq.max_new_tokens - seq.generated))
+        return lookahead
+
     def _plan_decode(self) -> list[Sequence]:
         """Ensure each running seq has a slot for its next token; on block
         exhaustion preempt the YOUNGEST running sequence (possibly the
@@ -297,15 +311,7 @@ class Scheduler:
         for seq in batch:
             if seq.state != SeqState.RUNNING:
                 continue  # preempted earlier in this pass
-            # clamp the lookahead window to tokens the sequence can
-            # actually keep: near max_tokens the fused window's surplus
-            # is discarded, and allocating blocks for it would trigger
-            # phantom preemptions under pressure
-            lookahead = self.decode_lookahead
-            if seq.max_new_tokens is not None:
-                lookahead = min(
-                    lookahead, max(1, seq.max_new_tokens - seq.generated)
-                )
+            lookahead = self._seq_lookahead(seq)
             needed_blocks = seq.blocks_needed(
                 seq.total_len + lookahead, self.block_size
             )
@@ -482,6 +488,10 @@ class Scheduler:
         slot_mapping = np.zeros((B,), np.int32)
         tables = np.zeros((B, width), np.int32)
         ctx = np.zeros((B,), np.int32)
+        # steps of the fused decode window each sequence will actually
+        # keep — mirrors _plan_decode's lookahead clamp, so the device
+        # step never writes KV past the blocks allocated for the seq
+        valid_steps = np.zeros((B,), np.int32)
         for i, s in enumerate(seqs):
             all_toks = s.tokens.all_tokens()
             tokens[i, 0] = all_toks[-1]
@@ -490,7 +500,9 @@ class Scheduler:
             slot_mapping[i] = s.block_table[pos // bs] * bs + pos % bs
             tables[i, : len(s.block_table)] = s.block_table
             ctx[i] = s.total_len
+            valid_steps[i] = self._seq_lookahead(s)
         return {
+            "valid_steps": valid_steps,
             "tokens": tokens,
             "positions": positions,
             "slot_mapping": slot_mapping,

@@ -179,13 +179,28 @@ class GGUFReader:
 # ---------------------------------------------------------------------------
 
 
+# Architectures the native decoder implements (models/llama.py). Anything
+# else would silently load with llama semantics and produce corrupted
+# logits (e.g. gemma without scale_embeddings/norm_bias_one), so unknown
+# archs must fail loudly here.
+SUPPORTED_GGUF_ARCHS = ("llama", "mistral", "qwen2", "gemma")
+
+
 def config_from_gguf(reader: GGUFReader):
     """ModelConfig from llama.* GGUF metadata (reference:
-    model_card/create.rs from_gguf)."""
+    model_card/create.rs from_gguf). The derived kwargs are routed through
+    ModelConfig.from_dict so the model_type-based semantic fixups (gemma
+    embedding scaling / +1 norm bias / gelu, qwen2 qkv-bias and
+    sliding-window gating) apply exactly as they do for HF-dir models."""
     from dynamo_tpu.models.config import ModelConfig
 
     md = reader.metadata
     arch = md.get("general.architecture", "llama")
+    if arch not in SUPPORTED_GGUF_ARCHS:
+        raise ValueError(
+            f"{reader.path}: unsupported GGUF architecture {arch!r} "
+            f"(supported: {', '.join(SUPPORTED_GGUF_ARCHS)})"
+        )
 
     def key(suffix: str, default=None):
         return md.get(f"{arch}.{suffix}", default)
@@ -198,31 +213,36 @@ def config_from_gguf(reader: GGUFReader):
         vocab_size = len(toks) if toks else 32000
     eos = md.get("tokenizer.ggml.eos_token_id", 2)
     bos = md.get("tokenizer.ggml.bos_token_id", 1)
+    raw: dict = {
+        "model_type": arch,
+        "vocab_size": int(vocab_size),
+        "hidden_size": emb,
+        "intermediate_size": int(key("feed_forward_length", 11008)),
+        "num_hidden_layers": int(key("block_count", 32)),
+        "num_attention_heads": heads,
+        "num_key_value_heads": int(key("attention.head_count_kv", heads)),
+        "max_position_embeddings": int(key("context_length", 4096)),
+        "rms_norm_eps": float(key("attention.layer_norm_rms_epsilon", 1e-5)),
+        "rope_theta": float(key("rope.freq_base", 10000.0)),
+        "bos_token_id": int(bos),
+        "eos_token_id": int(eos),
+    }
+    # gemma heads are wider than hidden_size/num_heads; GGUF records the
+    # true per-head width as attention.key_length
+    head_dim = key("attention.key_length")
+    if head_dim and int(head_dim) != emb // heads:
+        raw["head_dim"] = int(head_dim)
     # qwen2-family GGUFs carry QKV bias tensors; detect either way so
-    # param_shapes includes bq/bk/bv and loading doesn't silently skip them
-    has_bias = arch == "qwen2" or "blk.0.attn_q.bias" in reader.tensors
-    # mistral-family GGUFs export the window; qwen2 disables SWA by
-    # default (parity with ModelConfig.from_dict's use_sliding_window
-    # handling for HF-dir models)
+    # param_shapes includes bq/bk/bv and loading doesn't silently skip
+    # them (from_dict's qwen2 fixup only covers the arch==qwen2 case)
+    if arch == "qwen2" or "blk.0.attn_q.bias" in reader.tensors:
+        raw["attention_bias"] = True
+    # mistral-family GGUFs export the window; from_dict gates it off for
+    # qwen2 (no use_sliding_window key in GGUF metadata = HF default False)
     window = key("attention.sliding_window")
-    if arch == "qwen2":
-        window = None
-    return ModelConfig(
-        model_type=arch,
-        attention_bias=has_bias,
-        sliding_window=int(window) if window else None,
-        vocab_size=int(vocab_size),
-        hidden_size=emb,
-        intermediate_size=int(key("feed_forward_length", 11008)),
-        num_hidden_layers=int(key("block_count", 32)),
-        num_attention_heads=heads,
-        num_key_value_heads=int(key("attention.head_count_kv", heads)),
-        max_position_embeddings=int(key("context_length", 4096)),
-        rms_norm_eps=float(key("attention.layer_norm_rms_epsilon", 1e-5)),
-        rope_theta=float(key("rope.freq_base", 10000.0)),
-        bos_token_id=int(bos),
-        eos_token_id=int(eos),
-    )
+    if window:
+        raw["sliding_window"] = int(window)
+    return ModelConfig.from_dict(raw)
 
 
 def tokenizer_from_gguf(reader: GGUFReader):

@@ -27,14 +27,18 @@ class VisionEncoder:
     """In-process vision tower: urls -> [n_images, n_patches, D] float32."""
 
     def __init__(self, cfg: VisionConfig, params: Optional[dict] = None,
-                 seed: int = 0):
+                 seed: int = 0, image_root: Optional[str] = None):
         import jax
 
         self.cfg = cfg
         self.params = params if params is not None else init_vision_params(
             cfg, seed=seed
         )
-        self.processor = ImageProcessor(cfg.image_size)
+        import os
+
+        if image_root is None:
+            image_root = os.environ.get("DYN_IMAGE_ROOT") or None
+        self.processor = ImageProcessor(cfg.image_size, image_root=image_root)
         self._encode = jax.jit(lambda p, px: encode_images(cfg, p, px))
 
     @property

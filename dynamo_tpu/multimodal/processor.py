@@ -1,11 +1,13 @@
 """Image loading + preprocessing for the vision tower.
 
-Accepts OpenAI ``image_url`` content: ``data:`` URLs (base64 inline) and
-local ``file://`` / plain paths. Plain ``http(s)://`` fetching is
-deliberately not implemented here — serving nodes should not pull
-arbitrary remote URLs; a fronting proxy can inline them as data URLs
-(the reference's multimodal example similarly feeds local/url-resolved
-images into its encode worker, examples/multimodal/components/)."""
+Accepts OpenAI ``image_url`` content: ``data:`` URLs (base64 inline),
+and local ``file://`` / plain paths only when an ``image_root`` is
+configured (requests may then only reference files under that
+directory). Plain ``http(s)://`` fetching is deliberately not
+implemented here — serving nodes should not pull arbitrary remote URLs;
+a fronting proxy can inline them as data URLs (the reference's
+multimodal example similarly feeds local/url-resolved images into its
+encode worker, examples/multimodal/components/)."""
 
 from __future__ import annotations
 
@@ -23,10 +25,18 @@ MAX_IMAGE_BYTES = 64 << 20
 
 
 class ImageProcessor:
-    """url/path -> normalized pixel array [image_size, image_size, 3]."""
+    """url/path -> normalized pixel array [image_size, image_size, 3].
 
-    def __init__(self, image_size: int = 224):
+    ``image_root``: directory local-file references are confined to.
+    ``None`` (the default) rejects all local paths — request-facing
+    deployments must not let API clients probe/read arbitrary
+    worker-local files through image_url content."""
+
+    def __init__(self, image_size: int = 224, image_root: str | None = None):
         self.image_size = image_size
+        self.image_root = (
+            os.path.realpath(image_root) if image_root is not None else None
+        )
 
     def load(self, url: str) -> np.ndarray:
         if url.startswith("data:"):
@@ -40,14 +50,27 @@ class ImageProcessor:
                 "image as a data: URL"
             )
         else:
-            path = url[len("file://"):] if url.startswith("file://") else url
-            if os.path.getsize(path) > MAX_IMAGE_BYTES:
-                raise ValueError("image file too large")
-            with open(path, "rb") as f:
-                raw = f.read()
+            raw = self._read_local(url)
         if len(raw) > MAX_IMAGE_BYTES:
             raise ValueError("image too large")
         return self._decode(raw)
+
+    def _read_local(self, url: str) -> bytes:
+        if self.image_root is None:
+            raise ValueError(
+                "local image paths are disabled (no image_root configured); "
+                "inline the image as a data: URL"
+            )
+        path = url[len("file://"):] if url.startswith("file://") else url
+        # resolve symlinks BEFORE the containment check so a link inside
+        # the root can't escape it
+        resolved = os.path.realpath(os.path.join(self.image_root, path))
+        if os.path.commonpath([resolved, self.image_root]) != self.image_root:
+            raise ValueError("image path escapes the configured image root")
+        if os.path.getsize(resolved) > MAX_IMAGE_BYTES:
+            raise ValueError("image file too large")
+        with open(resolved, "rb") as f:
+            return f.read()
 
     def _decode(self, raw: bytes) -> np.ndarray:
         from PIL import Image

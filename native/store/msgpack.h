@@ -114,6 +114,11 @@ struct Decoder {
   size_t n;
   size_t pos = 0;
   bool fail = false;
+  int depth = 0;
+  // Nesting bound: a crafted frame of repeated fixarray/fixmap headers
+  // (unauthenticated socket) would otherwise recurse once per byte and
+  // overflow the stack. The wire protocol never nests past ~6.
+  static constexpr int kMaxDepth = 64;
 
   uint64_t be(int bytes) {
     if (pos + (size_t)bytes > n) { fail = true; return 0; }
@@ -174,16 +179,20 @@ struct Decoder {
   }
   Val decode_arr(size_t count) {
     Val v = Val::arr();
+    if (++depth > kMaxDepth || count > n - pos) { fail = true; --depth; return v; }
     for (size_t k = 0; k < count && !fail; ++k) v.a.push_back(decode());
+    --depth;
     return v;
   }
   Val decode_map(size_t count) {
     Val v = Val::map();
+    if (++depth > kMaxDepth || count > (n - pos) / 2) { fail = true; --depth; return v; }
     for (size_t k = 0; k < count && !fail; ++k) {
       Val key = decode();
       Val val = decode();
       v.m.emplace_back(key.s, std::move(val));
     }
+    --depth;
     return v;
   }
 };

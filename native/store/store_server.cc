@@ -477,6 +477,23 @@ struct Server {
       close_stream(c, sid, /*notify_end=*/false);
     for (int64_t lid : std::vector<int64_t>(c->leases.begin(), c->leases.end()))
       lease_revoke(lid);
+    // Purge every raw Conn* reference BEFORE the Conn is destroyed: parked
+    // queue pops (sweep()/serve_parked() would otherwise dereference freed
+    // memory), plus any watch/sub registration whose sid drifted out of
+    // c->stream_ids. conns.erase destroys the unique_ptr, so nothing may
+    // point at c after this.
+    for (auto& qkv : queues) {
+      auto& parked = qkv.second.parked;
+      parked.erase(std::remove_if(parked.begin(), parked.end(),
+                                  [&](const ParkedPop& pp) { return pp.conn == c; }),
+                   parked.end());
+    }
+    watches.erase(std::remove_if(watches.begin(), watches.end(),
+                                 [&](const WatchReg& w) { return w.conn == c; }),
+                  watches.end());
+    subs.erase(std::remove_if(subs.begin(), subs.end(),
+                              [&](const SubReg& s) { return s.conn == c; }),
+               subs.end());
     close(c->fd);
     conns.erase(c->fd);
   }

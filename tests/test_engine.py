@@ -448,6 +448,47 @@ async def test_multi_step_with_pipeline_parallelism():
     assert await run(2, 4) == base
 
 
+async def test_multi_step_surplus_does_not_corrupt_full_width_table():
+    """A sequence whose block table exactly fills the bucketed width at
+    the last fused window used to have surplus-step KV writes clipped
+    onto its LAST REAL block (take_along_axis clips out-of-range table
+    indices) — corrupting a block that prefix caching then serves to
+    later requests. Surplus writes must go to the garbage block instead.
+
+    Geometry: block_size=4, TABLE_BUCKET=8 -> width 8 = 32 slots.
+    prompt 26 + max_tokens 6 = 32 tokens exactly; decode_steps=4 leaves
+    2 surplus steps in the final window that would write at positions
+    32,33 -> table column 8,9 -> clipped to column 7 (a real block)."""
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    engine = await JaxEngine.launch(
+        _engine_config(block_size=4, decode_steps=4, num_blocks=64)
+    )
+    try:
+        prompt = list(range(1, 27))  # 26 tokens
+        toks, fin = await _generate(engine, prompt, max_tokens=6,
+                                    request_id="full-width")
+        assert fin.completion_tokens == 6
+        # continue from the full 32-token history: the last block is a
+        # prefix-cache hit and must hold uncorrupted KV
+        full = prompt + toks
+        cont_cached, _ = await _generate(engine, full, max_tokens=4,
+                                         request_id="reuse")
+    finally:
+        await engine.shutdown()
+
+    # ground truth: a fresh single-step engine over the same history
+    engine2 = await JaxEngine.launch(
+        _engine_config(block_size=4, decode_steps=1, num_blocks=64)
+    )
+    try:
+        cont_fresh, _ = await _generate(engine2, full, max_tokens=4,
+                                        request_id="fresh")
+    finally:
+        await engine2.shutdown()
+    assert cont_cached == cont_fresh
+
+
 async def test_multi_step_under_block_pressure():
     """Fused windows + tight block pool: preemption/recompute must keep
     outputs correct and leak no blocks."""

@@ -91,6 +91,37 @@ def test_config_from_gguf_detects_qkv_bias(tmp_path):
         assert config_from_gguf(r).attention_bias
 
 
+def test_config_from_gguf_applies_gemma_semantics(tmp_path):
+    """Gemma GGUFs must pick up the model_type fixups from
+    ModelConfig.from_dict (embedding scaling, +1 norm bias, gelu, tied
+    embeddings, wide head_dim) — a plain-llama load silently corrupts
+    logits."""
+    path = str(tmp_path / "g.gguf")
+    write_gguf(path, {
+        "general.architecture": "gemma",
+        "gemma.embedding_length": 2048,
+        "gemma.block_count": 2,
+        "gemma.attention.head_count": 8,
+        "gemma.attention.head_count_kv": 1,
+        "gemma.attention.key_length": 256,
+    }, {"t": np.zeros((1, 32), np.float32)})
+    with GGUFReader(path) as r:
+        cfg = config_from_gguf(r)
+    assert cfg.model_type == "gemma"
+    assert cfg.scale_embeddings and cfg.norm_bias_one
+    assert cfg.hidden_act == "gelu" and cfg.tie_word_embeddings
+    assert cfg.head_dim == 256  # not hidden/heads == 256 != 2048/8
+
+
+def test_config_from_gguf_rejects_unknown_arch(tmp_path):
+    path = str(tmp_path / "phi.gguf")
+    write_gguf(path, {"general.architecture": "phi2"},
+               {"t": np.zeros((1, 32), np.float32)})
+    with GGUFReader(path) as r:
+        with pytest.raises(ValueError, match="unsupported GGUF architecture"):
+            config_from_gguf(r)
+
+
 def test_tokenizer_from_gguf_unigram_byte_fallback(tmp_path):
     path = str(tmp_path / "u.gguf")
     tokens = ["<unk>", "▁hi", "▁there", "▁"] + [f"<0x{b:02X}>" for b in range(256)]

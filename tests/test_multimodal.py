@@ -67,6 +67,34 @@ def test_image_processor_data_url_and_policy(tmp_path):
         proc.load("data:image/png,notbase64")
     with pytest.raises(ValueError, match="remote image"):
         proc.load("http://example.com/x.png")
+    # no image_root configured: API clients must not be able to make the
+    # worker open arbitrary local files
+    with pytest.raises(ValueError, match="image_root"):
+        proc.load("/etc/passwd")
+    with pytest.raises(ValueError, match="image_root"):
+        proc.load("file:///etc/passwd")
+
+
+def test_image_processor_image_root_containment(tmp_path):
+    import base64 as b64
+
+    head, _, payload = _png_data_url().partition(",")
+    png = b64.b64decode(payload)
+    (tmp_path / "ok.png").write_bytes(png)
+    outside = tmp_path.parent / "outside.png"
+    outside.write_bytes(png)
+    (tmp_path / "link.png").symlink_to(outside)
+
+    proc = ImageProcessor(image_size=28, image_root=str(tmp_path))
+    # relative + absolute-in-root + file:// all resolve inside the root
+    assert proc.load("ok.png").shape == (28, 28, 3)
+    assert proc.load(str(tmp_path / "ok.png")).shape == (28, 28, 3)
+    assert proc.load(f"file://{tmp_path}/ok.png").shape == (28, 28, 3)
+    # traversal and symlink escapes are refused
+    with pytest.raises(ValueError, match="escapes"):
+        proc.load("../outside.png")
+    with pytest.raises(ValueError, match="escapes"):
+        proc.load("link.png")
 
 
 def test_embeds_roundtrip_and_validation():

@@ -255,6 +255,38 @@ async def test_c_abi_kv_publisher_native_server(native_store):
     await _drive_c_publisher(native_store)
 
 
+async def test_parked_pop_survives_client_disconnect(native_store):
+    """A client that parks a blocking queue_pop and then disconnects must
+    not leave the server holding a dangling Conn*: the next queue_push (and
+    the sweep tick) previously dereferenced the freed connection. The
+    message must be redelivered intact to a live consumer."""
+    from dynamo_tpu.store.client import StoreClient
+
+    victim = await StoreClient.connect("127.0.0.1", native_store)
+    await victim.queue_len("uaf")  # ensure the queue exists server-side
+    # park a long blocking pop, then drop the connection without unparking
+    pop_task = asyncio.ensure_future(victim.queue_pop("uaf", timeout_s=30))
+    await asyncio.sleep(0.3)  # let the pop reach the server and park
+    await victim.close()
+    with pytest.raises((ConnectionError, asyncio.CancelledError)):
+        await pop_task
+
+    c = await StoreClient.connect("127.0.0.1", native_store)
+    try:
+        # push triggers serve_parked() over the dead conn's parked entry
+        await c.queue_push("uaf", b"survivor")
+        await asyncio.sleep(0.3)  # span at least one sweep tick as well
+        # the server must still be alive and must not have delivered the
+        # message into the void: a live pop gets it
+        m = await c.queue_pop("uaf", timeout_s=3)
+        assert m is not None and m.payload == b"survivor"
+        assert await c.queue_ack("uaf", m.id)
+        # plain liveness probe after the dust settles
+        assert await c.kv_put("uaf/alive", b"1") > 0
+    finally:
+        await c.close()
+
+
 async def test_native_codec_randomized_roundtrip(native_store):
     """Property-style cross-implementation check (≈ the reference's
     proptest protocol validation): random keys/values — every bin length
