@@ -13,7 +13,11 @@ Env knobs: DYN_BENCH_PLATFORM=cpu for a tiny smoke run; DYN_BENCH_BATCH,
 DYN_BENCH_ISL, DYN_BENCH_OSL to override the workload;
 DYN_BENCH_DECODE_STEPS (default 32) fuses that many decode steps per
 device dispatch (dispatch latency over the remote-chip tunnel otherwise
-dominates the measurement).
+dominates the measurement); DYN_BENCH_QUANT=int8|none (default int8 on
+TPU: weight-only per-channel int8, which is also what lets the REAL
+8B flagship shape fit one 16 GB chip — bf16 does not);
+DYN_BENCH_MODEL=8b|3.8b (default 8b: R1-Distill-Llama-8B geometry,
+BASELINE.md config 1).
 """
 
 from __future__ import annotations
@@ -43,29 +47,45 @@ def _build_config(cpu_mode: bool):
             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
             max_position_embeddings=2048,
         )
-        workload = dict(batch=4, isl=32, osl=16, num_blocks=256, block_size=16)
+        workload = dict(batch=4, isl=32, osl=16, num_blocks=256, block_size=16,
+                        quant=os.environ.get("DYN_BENCH_QUANT", "none"),
+                        model_name="tiny")
     else:
-        # ~3.8B-param Llama shape: fits one 16GB v5e chip in bf16 + KV
-        model = ModelConfig(
-            vocab_size=32768, hidden_size=4096, intermediate_size=14336,
-            num_hidden_layers=16, num_attention_heads=32, num_key_value_heads=8,
-            max_position_embeddings=8192,
-        )
+        quant = os.environ.get("DYN_BENCH_QUANT", "int8")
+        bench_model = os.environ.get("DYN_BENCH_MODEL", "8b")
+        if bench_model == "8b":
+            # the REAL flagship geometry: DeepSeek-R1-Distill-Llama-8B
+            # (BASELINE.md config 1). int8 weights ≈ 8 GB -> fits one
+            # 16 GB v5e chip WITH a useful KV cache; bf16 (16 GB) does not.
+            model = ModelConfig(
+                vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+                num_hidden_layers=32, num_attention_heads=32,
+                num_key_value_heads=8, max_position_embeddings=8192,
+            )
+        else:
+            # ~3.8B shape: the round-1 bf16 reference point
+            model = ModelConfig(
+                vocab_size=32768, hidden_size=4096, intermediate_size=14336,
+                num_hidden_layers=16, num_attention_heads=32,
+                num_key_value_heads=8, max_position_embeddings=8192,
+            )
         # num_blocks None = auto-size from free HBM after weights load;
         # the fused multi-step scan needs transient headroom, hence the
         # conservative utilization below
-        workload = dict(batch=32, isl=128, osl=128, num_blocks=None, block_size=16)
+        workload = dict(batch=32, isl=128, osl=128, num_blocks=None,
+                        block_size=16, quant=quant, model_name=bench_model)
     workload["batch"] = int(os.environ.get("DYN_BENCH_BATCH", workload["batch"]))
     workload["isl"] = int(os.environ.get("DYN_BENCH_ISL", workload["isl"]))
     workload["osl"] = int(os.environ.get("DYN_BENCH_OSL", workload["osl"]))
     return model, workload
 
 
-def _param_bytes(mc) -> int:
+def _param_bytes(mc, quant: str) -> int:
     D, F, V, L = mc.hidden_size, mc.intermediate_size, mc.vocab_size, mc.num_hidden_layers
     H, Hk, Dh = mc.num_attention_heads, mc.num_key_value_heads, mc.head_dim
     per_layer = D * H * Dh + 2 * D * Hk * Dh + H * Dh * D + 3 * D * F
-    return 2 * (per_layer * L + 2 * V * D)  # bf16
+    bytes_per = 1 if quant == "int8" else 2
+    return bytes_per * (per_layer * L + 2 * V * D)
 
 
 def _kv_bytes_per_token(mc) -> int:
@@ -86,8 +106,10 @@ async def _run(model_cfg, wl) -> dict:
 
     cfg = EngineConfig(
         model_path="", model_name="bench", random_weights=True,
+        quantization="int8" if wl["quant"] == "int8" else None,
         num_blocks=wl["num_blocks"], block_size=wl["block_size"],
-        max_batch_size=wl["batch"], prefill_chunk_size=1024,
+        max_batch_size=wl["batch"],
+        prefill_chunk_size=int(os.environ.get("DYN_BENCH_PREFILL_CHUNK", "1024")),
         max_model_len=wl["isl"] + wl["osl"] + 8,
         decode_steps=int(os.environ.get("DYN_BENCH_DECODE_STEPS", "32")),
         hbm_utilization=0.7,
@@ -144,7 +166,7 @@ async def _run(model_cfg, wl) -> dict:
 
     # roofline: per decode step, read all weights once + each seq's KV
     avg_ctx = wl["isl"] + wl["osl"] / 2
-    step_bytes = _param_bytes(model_cfg) + wl["batch"] * avg_ctx * _kv_bytes_per_token(model_cfg)
+    step_bytes = _param_bytes(model_cfg, wl["quant"]) + wl["batch"] * avg_ctx * _kv_bytes_per_token(model_cfg)
     roofline_tput = wl["batch"] / (step_bytes / HBM_BW_BYTES)
 
     await engine.shutdown()
@@ -170,6 +192,19 @@ def main() -> None:
         "value": round(r["tput"], 2),
         "unit": "tokens/sec",
         "vs_baseline": round(r["tput"] / r["roofline"], 4),
+        # auditability: the exact workload behind the number
+        "config": {
+            "model": wl["model_name"],
+            "layers": model_cfg.num_hidden_layers,
+            "hidden": model_cfg.hidden_size,
+            "vocab": model_cfg.vocab_size,
+            "quant": wl["quant"],
+            "batch": wl["batch"],
+            "isl": wl["isl"],
+            "osl": wl["osl"],
+            "decode_steps": int(os.environ.get("DYN_BENCH_DECODE_STEPS", "32")),
+            "p50_ttft_ms": round(r["p50_ttft_s"] * 1000, 1),
+        },
     }
     print(json.dumps(out))
     print(

@@ -1,0 +1,132 @@
+"""KV router micro-benchmark: event ingest rate + match latency at scale.
+
+Quantifies the indexer implementations against the reference's scale
+story (reference: kv_router/indexer.rs — the sharded indexer exists
+because one tree saturates; lib/llm benches apply_event/find_matches):
+
+    python benchmarks/router_bench.py --blocks 1000000 --workers 32
+
+One JSON line per implementation:
+  {"impl", "blocks", "events_per_s", "match_p50_us", "match_p99_us"}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import time
+
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dynamo_tpu.kv_router.protocols import KvCacheEvent, RouterEvent  # noqa: E402
+
+
+def make_events(n_blocks: int, n_workers: int, seq_len: int, block: int,
+                seed: int = 7):
+    """Synthetic stored-events: chains of seq_len hashes per sequence,
+    sequences assigned round-robin to workers, ~10% shared prefix reuse."""
+    rng = random.Random(seed)
+    events = []
+    queries = []
+    made = 0
+    sid = 0
+    shared_roots: list[list[int]] = []
+    while made < n_blocks:
+        wid = 2**32 + (sid % n_workers)
+        if shared_roots and rng.random() < 0.3:
+            root = rng.choice(shared_roots)
+            tail = [rng.getrandbits(63) for _ in range(seq_len - len(root))]
+            hashes = root + tail
+        else:
+            hashes = [rng.getrandbits(63) for _ in range(seq_len)]
+            if rng.random() < 0.3:
+                shared_roots.append(hashes[: seq_len // 2])
+        events.append(RouterEvent(
+            worker_id=wid, event_id=sid + 1,
+            event=KvCacheEvent(op="stored", block_hashes=hashes,
+                               token_block_size=block),
+        ))
+        if rng.random() < 0.02:
+            queries.append(hashes[: rng.randrange(1, seq_len)] +
+                           [rng.getrandbits(63)])
+        made += seq_len
+        sid += 1
+    # some queries with no overlap at all
+    queries += [[rng.getrandbits(63) for _ in range(seq_len)]
+                for _ in range(20)]
+    rng.shuffle(queries)
+    return events, queries[:200]
+
+
+def bench_impl(name: str, make, events, queries) -> dict:
+    idx = make()
+    t0 = time.monotonic()
+    for ev in events:
+        idx.apply_event(ev) if hasattr(idx, "apply_event") else idx.apply(ev)
+    # sharded: wait for queues to drain
+    if hasattr(idx, "close_threads"):
+        while idx.applied_events < len(events):
+            time.sleep(0.005)
+    ingest_s = time.monotonic() - t0
+
+    lat = []
+    t0 = time.monotonic()
+    for q in queries:
+        s = time.monotonic()
+        idx.find_matches(q)
+        lat.append(time.monotonic() - s)
+    lat.sort()
+    out = {
+        "impl": name,
+        "blocks": idx.num_blocks,
+        "events_per_s": round(len(events) / ingest_s, 1),
+        "block_hashes_per_s": round(
+            sum(len(e.event.block_hashes) for e in events) / ingest_s, 1
+        ),
+        "match_p50_us": round(lat[len(lat) // 2] * 1e6, 1),
+        "match_p99_us": round(lat[int(len(lat) * 0.99) - 1] * 1e6, 1),
+    }
+    if hasattr(idx, "close_threads"):
+        idx.close_threads()
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--blocks", type=int, default=100_000)
+    p.add_argument("--workers", type=int, default=16)
+    p.add_argument("--seq-len", type=int, default=64,
+                   help="blocks per stored sequence")
+    p.add_argument("--shards", type=int, default=4)
+    args = p.parse_args()
+
+    events, queries = make_events(args.blocks, args.workers, args.seq_len, 16)
+    print(f"# {len(events)} events, {args.blocks} blocks, "
+          f"{len(queries)} queries", file=sys.stderr)
+
+    from dynamo_tpu import native
+    from dynamo_tpu.kv_router.indexer import (
+        KvIndexerSharded,
+        NativeRadixTree,
+        RadixTree,
+    )
+
+    print(json.dumps(bench_impl("python", RadixTree, events, queries)),
+          flush=True)
+    if native.is_available():
+        print(json.dumps(
+            bench_impl("native", NativeRadixTree, events, queries)
+        ), flush=True)
+        print(json.dumps(bench_impl(
+            f"sharded-{args.shards}",
+            lambda: KvIndexerSharded(num_shards=args.shards),
+            events, queries,
+        )), flush=True)
+
+
+if __name__ == "__main__":
+    main()

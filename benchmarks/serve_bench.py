@@ -1,0 +1,221 @@
+"""HTTP-level serving benchmark: real frontend+worker, concurrency sweep.
+
+The committed, reproducible version of the reference's benchmark
+methodology (reference: examples/llm/benchmarks/README.md:28-100 —
+genai-perf closed-loop concurrency sweep at fixed ISL/OSL, recording
+output tok/s and p50 TTFT). Spawns the actual serving stack
+(``dynamo-tpu run --in http --out jax --static``) as a subprocess,
+drives it with benchmarks/load_gen.py's closed loop, and emits one JSON
+line per concurrency plus a markdown table to stdout.
+
+Modes:
+  --mode cpu   tiny model, CPU backend: CI smoke / methodology check
+  --mode tpu   flagship 8B geometry, int8 weights, real chip
+
+Results land in benchmarks/results_<mode>.json (committed for the
+record; see benchmarks/RESULTS.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+sys.path.insert(0, HERE)
+
+from load_gen import Stats, one_request, run_closed_loop  # noqa: E402
+
+TINY_MODEL = os.path.join(REPO, "tests", "data", "tiny_llama_model")
+
+SHAPES = {
+    "cpu": dict(
+        config=dict(
+            model_type="llama", vocab_size=2048, hidden_size=128,
+            intermediate_size=256, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=2048,
+        ),
+        engine=dict(random_weights=True, num_blocks=512, block_size=16,
+                    max_batch_size=16, decode_steps=4,
+                    prefill_chunk_size=256),
+        isl=64, osl=32, duration=15.0, concurrency=[1, 2, 4, 8],
+    ),
+    "tpu": dict(
+        # DeepSeek-R1-Distill-Llama-8B geometry (BASELINE.md config 1);
+        # int8 weights fit the single 16 GB chip
+        config=dict(
+            model_type="llama", vocab_size=128256, hidden_size=4096,
+            intermediate_size=14336, num_hidden_layers=32,
+            num_attention_heads=32, num_key_value_heads=8,
+            max_position_embeddings=8192,
+        ),
+        engine=dict(random_weights=True, quantization="int8",
+                    block_size=16, max_batch_size=32, decode_steps=32,
+                    hbm_utilization=0.7, prefill_chunk_size=1024),
+        isl=128, osl=128, duration=90.0, concurrency=[1, 4, 16, 32],
+    ),
+}
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def make_model_dir(tmp: str, shape: dict) -> str:
+    """Model dir = tiny test tokenizer + the benchmark shape's config
+    (random weights: throughput is weight-agnostic)."""
+    d = os.path.join(tmp, "model")
+    os.makedirs(d, exist_ok=True)
+    for f in ("tokenizer.json", "tokenizer_config.json"):
+        shutil.copy(os.path.join(TINY_MODEL, f), os.path.join(d, f))
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump(shape["config"], f)
+    return d
+
+
+def wait_ready(url: str, timeout: float) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(f"{url}/v1/models", timeout=2) as r:
+                if json.load(r).get("data"):
+                    return
+        except Exception:
+            pass
+        time.sleep(1.0)
+    raise RuntimeError(f"server at {url} not ready after {timeout}s")
+
+
+async def drive(args, shape: dict) -> list[dict]:
+    import aiohttp
+
+    results = []
+    for c in shape["concurrency"]:
+        # untimed warmup at this concurrency: compiles (minutes over the
+        # chip tunnel) must not land inside the measured window
+        warm = Stats()
+        async with aiohttp.ClientSession() as session:
+            await asyncio.gather(
+                *[one_request(session, args, warm) for _ in range(c)]
+            )
+        stats = await run_closed_loop(args, c)
+        from load_gen import _percentiles
+
+        row = {
+            "concurrency": c,
+            "completed": stats.completed,
+            "errors": stats.errors,
+            "output_tok_per_s": round(stats.tokens / max(stats.elapsed, 1e-9), 2),
+            "ttft_ms": {k: round(v * 1000, 1)
+                        for k, v in _percentiles(stats.ttft).items()},
+            "e2e_ms": {k: round(v * 1000, 1)
+                       for k, v in _percentiles(stats.e2e).items()},
+        }
+        print(json.dumps(row), flush=True)
+        results.append(row)
+    return results
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--mode", choices=["cpu", "tpu"], default="cpu")
+    p.add_argument("--duration", type=float, default=None)
+    p.add_argument("--concurrency", default=None, help="comma list override")
+    p.add_argument("--ready-timeout", type=float, default=1200.0)
+    p.add_argument("--out", default=None, help="results JSON path")
+    cli = p.parse_args()
+
+    shape = SHAPES[cli.mode]
+    if cli.duration:
+        shape = dict(shape, duration=cli.duration)
+    if cli.concurrency:
+        shape = dict(
+            shape, concurrency=[int(x) for x in cli.concurrency.split(",")]
+        )
+
+    tmp = tempfile.mkdtemp(prefix="dyn_serve_bench_")
+    model_dir = make_model_dir(tmp, shape)
+    engine_args = os.path.join(tmp, "engine.json")
+    with open(engine_args, "w") as f:
+        json.dump(shape["engine"], f)
+    port = free_port()
+    env = dict(os.environ, PYTHONPATH=REPO)
+    if cli.mode == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dynamo_tpu.cli.main", "run",
+            "--in", "http", "--out", "jax", "--static",
+            "--model-path", model_dir, "--model-name", "bench",
+            "--http-host", "127.0.0.1", "--http-port", str(port),
+            "--extra-engine-args", engine_args,
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT,
+    )
+    url = f"http://127.0.0.1:{port}"
+    try:
+        wait_ready(url, cli.ready_timeout)
+
+        class A:
+            pass
+
+        a = A()
+        a.url = url
+        a.model = "bench"
+        a.isl = shape["isl"]
+        a.osl = shape["osl"]
+        a.duration = shape["duration"]
+        a.request_timeout = 600.0
+        rows = asyncio.run(drive(a, shape))
+        out_path = cli.out or os.path.join(HERE, f"results_{cli.mode}.json")
+        with open(out_path, "w") as f:
+            json.dump(
+                {
+                    "mode": cli.mode,
+                    "isl": shape["isl"],
+                    "osl": shape["osl"],
+                    "duration_s": shape["duration"],
+                    "engine": shape["engine"],
+                    "model_geometry": shape["config"],
+                    "rows": rows,
+                },
+                f,
+                indent=1,
+            )
+        # markdown table for RESULTS.md
+        print("\n| conc | out tok/s | p50 TTFT ms | p99 TTFT ms | p50 e2e ms |")
+        print("|---|---|---|---|---|")
+        for r in rows:
+            print(
+                f"| {r['concurrency']} | {r['output_tok_per_s']} "
+                f"| {r['ttft_ms']['p50']} | {r['ttft_ms']['p99']} "
+                f"| {r['e2e_ms']['p50']} |"
+            )
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

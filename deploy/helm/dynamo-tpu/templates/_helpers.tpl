@@ -1,0 +1,13 @@
+{{- define "dynamo-tpu.image" -}}
+{{ .Values.image.repository }}:{{ .Values.image.tag }}
+{{- end -}}
+
+{{- define "dynamo-tpu.labels" -}}
+app.kubernetes.io/name: dynamo-tpu
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}
+
+{{- define "dynamo-tpu.storeHost" -}}
+{{ .Release.Name }}-store
+{{- end -}}

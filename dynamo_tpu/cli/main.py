@@ -58,6 +58,9 @@ def build_parser() -> argparse.ArgumentParser:
                      help="single-process mode: no coordinator needed")
     run.add_argument("--max-tokens-default", type=int, default=None)
     # engine knobs (reference: flags.rs)
+    run.add_argument("--quantization", default=None, choices=["int8"],
+                     help="weight-only quantization applied at load "
+                          "(halves weight HBM traffic)")
     run.add_argument("--tensor-parallel-size", type=int, default=1)
     run.add_argument("--pipeline-parallel-size", type=int, default=1,
                      help="GPipe stage rotation over a pp mesh axis")
@@ -106,11 +109,41 @@ def build_parser() -> argparse.ArgumentParser:
 
     serve = sub.add_parser("serve", help="serve a @service graph "
                            "(≈ reference `dynamo serve`)")
-    serve.add_argument("service", help="module:Attr of the entry DynamoService")
+    serve.add_argument("service", nargs="?", default=None,
+                       help="module:Attr of the entry DynamoService")
+    serve.add_argument("--package", default=None,
+                       help="serve a pushed package instead: name[:version]")
     serve.add_argument("-f", "--config-file", default=None,
                        help="YAML/JSON per-component overrides")
     serve.add_argument("--store-host", default="127.0.0.1")
     serve.add_argument("--store-port", type=int, default=4222)
+
+    build = sub.add_parser("build", help="package a @service graph into a "
+                           "versioned artifact (≈ reference `dynamo build`)")
+    build.add_argument("service", help="module:Attr of the entry DynamoService")
+    build.add_argument("--name", default=None,
+                       help="package name (default: entry attr, lowered)")
+    build.add_argument("-f", "--config-file", default=None,
+                       help="YAML per-component overrides to embed")
+    build.add_argument("--deployment-spec", default=None,
+                       help="GraphDeploymentSpec YAML to embed")
+    build.add_argument("-o", "--output", default=None,
+                       help="archive path (default NAME-VERSION.tar.gz)")
+    build.add_argument("--push", action="store_true",
+                       help="push to the coordinator's package registry")
+    build.add_argument("--store-host", default="127.0.0.1")
+    build.add_argument("--store-port", type=int, default=4222)
+
+    router = sub.add_parser("router", help="standalone KV-aware router "
+                            "service (≈ reference components/router)")
+    router.add_argument("--namespace", default="dynamo")
+    router.add_argument("--component", default="backend",
+                        help="worker component to route over")
+    router.add_argument("--router-component", default="kv_aware_router",
+                        help="component name this service registers as")
+    router.add_argument("--block-size", type=int, default=16)
+    router.add_argument("--store-host", default="127.0.0.1")
+    router.add_argument("--store-port", type=int, default=4222)
 
     metrics = sub.add_parser("metrics", help="metrics aggregation service")
     metrics.add_argument("--namespace", default="dynamo")
@@ -137,12 +170,20 @@ def build_parser() -> argparse.ArgumentParser:
 
     deploy = sub.add_parser("deploy", help="graph deployment ctl "
                             "(≈ DynamoGraphDeployment CRs)")
-    deploy.add_argument("action", choices=["apply", "status", "delete"])
+    deploy.add_argument("action",
+                        choices=["apply", "status", "delete", "manifests"])
     deploy.add_argument("target", nargs="?",
-                        help="spec YAML (apply) or deployment name (delete)")
+                        help="spec YAML (apply/manifests) or deployment "
+                             "name (delete)")
     deploy.add_argument("--namespace", default="dynamo")
     deploy.add_argument("--store-host", default="127.0.0.1")
     deploy.add_argument("--store-port", type=int, default=4222)
+    deploy.add_argument("--image", default=None,
+                        help="container image for generated manifests")
+    deploy.add_argument("--output", "-o", default=None,
+                        help="manifests: write YAML here (default stdout)")
+    deploy.add_argument("--include-crd", action="store_true",
+                        help="manifests: prepend the CRD definition")
 
     operator = sub.add_parser("operator", help="deployment reconciler "
                               "(≈ the K8s operator, local mode)")
@@ -152,6 +193,15 @@ def build_parser() -> argparse.ArgumentParser:
                           help="api-store REST port (0 disables)")
     operator.add_argument("--store-host", default="127.0.0.1")
     operator.add_argument("--store-port", type=int, default=4222)
+    operator.add_argument("--backend", default="local",
+                          choices=["local", "kubectl"],
+                          help="actuation: supervisor control subject "
+                               "(local) or real cluster Deployments "
+                               "(kubectl scale)")
+    operator.add_argument("--k8s-namespace", default="default")
+    operator.add_argument("--state-dir", default=None,
+                          help="persist applied specs here (survive "
+                               "coordinator restarts)")
 
     models = sub.add_parser("models", help="model registry ctl (≈ llmctl)")
     models.add_argument("action", choices=["list", "register", "remove"])
@@ -709,6 +759,37 @@ def _runtime_config(args: Any) -> RuntimeConfig:
     return RuntimeConfig.from_settings(**overrides)
 
 
+async def cmd_build(args: Any) -> None:
+    """Package a graph (reference: sdk/cli/bentos.py build + push)."""
+    import sys
+
+    from dynamo_tpu.deploy.build import build_package, push_package
+
+    sys.path.insert(0, os.getcwd())
+    deployment = None
+    if args.deployment_spec:
+        from dynamo_tpu.deploy import GraphDeploymentSpec
+
+        deployment = GraphDeploymentSpec.from_yaml_file(
+            args.deployment_spec
+        ).to_dict()
+    path, manifest = build_package(
+        args.service, name=args.name, config_file=args.config_file,
+        deployment_spec=deployment, out_path=args.output,
+    )
+    print(f"built {manifest.name}:{manifest.version} -> {path} "
+          f"({len(manifest.files)} files)")
+    if args.push:
+        from dynamo_tpu.store.client import StoreClient
+
+        client = await StoreClient.connect(args.store_host, args.store_port)
+        try:
+            await push_package(client, path)
+            print(f"pushed {manifest.name}:{manifest.version}")
+        finally:
+            await client.close()
+
+
 async def cmd_serve(args: Any) -> None:
     """Supervise a @service graph (reference: cli/serving.py:163-300)."""
     import importlib
@@ -719,6 +800,33 @@ async def cmd_serve(args: Any) -> None:
 
     from dynamo_tpu.sdk.runner import load_service
 
+    if args.package:
+        # pull + verify + unpack, then serve the embedded entry
+        import sys
+
+        from dynamo_tpu.deploy.build import pull_package, unpack_package
+
+        name, _, version = args.package.partition(":")
+        client = await StoreClient.connect(args.store_host, args.store_port)
+        try:
+            blob, version = await pull_package(client, name, version or None)
+        finally:
+            await client.close()
+        dest_root = os.environ.get(
+            "DYN_PACKAGE_DIR",
+            os.path.join(os.path.expanduser("~"), ".dynamo_tpu", "packages"),
+        )
+        dest, manifest = unpack_package(blob, dest_root)
+        src = os.path.join(dest, "src")
+        if src not in sys.path:
+            sys.path.insert(0, src)
+        args.service = manifest.entry
+        if not args.config_file and "config.yaml" in manifest.files:
+            args.config_file = os.path.join(dest, "config.yaml")
+        print(f"serving package {manifest.name}:{version} "
+              f"(entry {manifest.entry})", flush=True)
+    if not args.service:
+        raise SystemExit("serve requires module:Attr or --package")
     entry = load_service(args.service)
     mod = importlib.import_module(args.service.partition(":")[0])
     specs = {
@@ -762,6 +870,51 @@ async def cmd_serve(args: Any) -> None:
     await stop.wait()
     await sup.shutdown()
     await store.close()
+
+
+async def cmd_router(args: Any) -> None:
+    """Standalone KV-aware router: one shared index/scheduler multiple
+    frontends consult (reference: components/router/src/main.rs:23-60 —
+    the KvRouter served over an endpoint). Serves two endpoints on the
+    router component:
+
+      generate  — full proxy: requests stream through the chosen worker
+      schedule  — decision only: {token_ids} -> {worker_id,
+                  prefix_hit_rate, matched_blocks}; frontends dispatch
+                  direct and share the index without proxy overhead
+    """
+    from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+    from dynamo_tpu.runtime.engine import AsyncEngine, Context, FnEngine
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    drt = await DistributedRuntime.create(config=_runtime_config(args))
+    drt.runtime.install_signal_handlers()
+    workers = drt.namespace(args.namespace).component(args.component)
+    client = await workers.endpoint("generate").client()
+    router = await KvRouter.create(workers, client, block_size=args.block_size)
+
+    svc = drt.namespace(args.namespace).component(args.router_component)
+    await svc.endpoint("generate").serve(KvPushRouter(router))
+
+    async def schedule(request, ctx: Context):
+        await client.wait_for_instances()
+        decision = router.schedule(list(request["token_ids"]))
+        yield {
+            "worker_id": decision.worker_id,
+            "prefix_hit_rate": decision.prefix_hit_rate,
+            "overlap_blocks": decision.overlap_blocks,
+            "total_blocks": decision.total_blocks,
+        }
+
+    await svc.endpoint("schedule").serve(FnEngine(schedule))
+    print(
+        f"kv router on dyn://{args.namespace}.{args.router_component}."
+        f"{{generate,schedule}} over {args.component}",
+        flush=True,
+    )
+    await drt.runtime.wait_shutdown()
+    await router.close()
+    await drt.shutdown()
 
 
 async def cmd_metrics(args: Any) -> None:
@@ -825,6 +978,33 @@ async def cmd_deploy(args: Any) -> None:
     from dynamo_tpu.deploy import GraphDeploymentSpec, Reconciler
     from dynamo_tpu.store.client import StoreClient
 
+    if args.action == "manifests":
+        # offline: spec YAML -> real K8s objects, no store needed
+        from dynamo_tpu.deploy.manifests import (
+            DEFAULT_IMAGE,
+            crd_manifest,
+            graph_manifests,
+            render_yaml,
+            validate_k8s_doc,
+        )
+
+        if not args.target:
+            raise SystemExit("deploy manifests requires a spec YAML path")
+        spec = GraphDeploymentSpec.from_yaml_file(args.target)
+        docs = graph_manifests(spec, image=args.image or DEFAULT_IMAGE)
+        if args.include_crd:
+            docs.insert(0, crd_manifest())
+        for d in docs:
+            validate_k8s_doc(d)
+        text = render_yaml(docs)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text)
+            print(f"wrote {len(docs)} manifests to {args.output}")
+        else:
+            print(text)
+        return
+
     client = await StoreClient.connect(args.store_host, args.store_port)
     rec = Reconciler(client, args.namespace)
     try:
@@ -853,7 +1033,21 @@ async def cmd_operator(args: Any) -> None:
 
     drt = await DistributedRuntime.create(config=_runtime_config(args))
     drt.runtime.install_signal_handlers()
-    rec = Reconciler(drt.store, args.namespace, interval_s=args.interval)
+    factory = None
+    if getattr(args, "backend", "local") == "kubectl":
+        from dynamo_tpu.deploy.operator import KubectlConnector
+
+        factory = lambda spec: KubectlConnector(  # noqa: E731
+            spec.name, k8s_namespace=args.k8s_namespace
+        )
+    rec = Reconciler(drt.store, args.namespace, interval_s=args.interval,
+                     connector_factory=factory,
+                     state_dir=getattr(args, "state_dir", None))
+    if rec.state_dir:
+        restored = await rec.restore_state()
+        if restored:
+            print(f"restored {restored} deployments from {rec.state_dir}",
+                  flush=True)
     api = None
     if args.api_port:
         api = ApiStore(rec, port=args.api_port)
@@ -935,6 +1129,10 @@ def main(argv: Optional[list[str]] = None) -> None:
             asyncio.run(server.serve_forever())
         except KeyboardInterrupt:
             pass
+    elif args.command == "build":
+        asyncio.run(cmd_build(args))
+    elif args.command == "router":
+        asyncio.run(cmd_router(args))
     elif args.command == "serve":
         try:
             asyncio.run(cmd_serve(args))

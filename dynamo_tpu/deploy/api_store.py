@@ -31,9 +31,18 @@ MAX_BODY = 1 << 20
 
 
 class ApiStore:
+    """Durable desired state lives in the RECONCILER's state mirror
+    (operator.py: restore_state + per-pass sync) — the api-store is a
+    thin REST surface over it (reference: the api-store's database
+    persistence, deploy/cloud/api-store/ai_dynamo_store/models/).
+    ``state_dir`` here forwards onto the reconciler for convenience."""
+
     def __init__(self, reconciler: Reconciler,
-                 host: str = "0.0.0.0", port: int = 8190):
+                 host: str = "0.0.0.0", port: int = 8190,
+                 state_dir: Optional[str] = None):
         self.reconciler = reconciler
+        if state_dir:
+            reconciler.state_dir = state_dir
         self.host = host
         self.port = port
         self._runner: Optional[web.AppRunner] = None
@@ -47,6 +56,8 @@ class ApiStore:
         self.app = app
 
     async def start(self) -> None:
+        if self.reconciler.state_dir:
+            await self.reconciler.restore_state()
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)

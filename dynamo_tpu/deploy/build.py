@@ -1,0 +1,244 @@
+"""Build/packaging pipeline: @service graph → versioned, pushable artifact.
+
+The native analogue of the reference's bento build + cloud push
+(reference: deploy/sdk/src/dynamo/sdk/cli/bentos.py builds a versioned
+archive of the service graph; deployment.py pushes/pulls it through the
+api-store). A package is a tar.gz:
+
+    manifest.json     name, content-derived version, entry module:Attr,
+                      per-file sha256, optional component config and an
+                      embedded GraphDeploymentSpec document
+    src/...           the graph's python source (module file, or the
+                      package directory it lives in)
+    config.yaml       optional per-component overrides (-f)
+
+Versions are content hashes (first 12 hex of the manifest-core sha256),
+so rebuilding identical sources yields the identical version — pushes
+are idempotent. Artifacts live in the coordinator store's object plane
+under bucket ``packages`` with a ``latest`` pointer in the KV plane;
+``dynamo-tpu serve --package name[:version]`` pulls, verifies hashes,
+unpacks, and serves the entry.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib
+import io
+import json
+import os
+import tarfile
+from dataclasses import dataclass
+from typing import Any, Optional
+
+PACKAGES_BUCKET = "packages"
+
+
+def latest_key(name: str) -> str:
+    return f"packages/{name}/latest"
+
+
+@dataclass
+class PackageManifest:
+    name: str
+    version: str
+    entry: str  # "module:Attr"
+    files: dict[str, str]  # relpath -> sha256
+    config: dict[str, Any]
+    deployment: Optional[dict[str, Any]] = None
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "schema": "dynamo-tpu/package.v1",
+            "name": self.name,
+            "version": self.version,
+            "entry": self.entry,
+            "files": self.files,
+            "config": self.config,
+            "deployment": self.deployment,
+        }
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "PackageManifest":
+        if raw.get("schema") != "dynamo-tpu/package.v1":
+            raise ValueError(f"not a dynamo-tpu package: {raw.get('schema')!r}")
+        return cls(
+            name=raw["name"], version=raw["version"], entry=raw["entry"],
+            files=dict(raw["files"]), config=dict(raw.get("config") or {}),
+            deployment=raw.get("deployment"),
+        )
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _collect_sources(entry: str) -> dict[str, bytes]:
+    """Resolve the entry's module to source files. A bare module packs
+    one file; a module inside a package packs the package's .py tree
+    (what the reference's bento build does with the service's project
+    dir)."""
+    module_name = entry.split(":")[0]
+    mod = importlib.import_module(module_name)
+    mod_file = getattr(mod, "__file__", None)
+    if not mod_file:
+        raise ValueError(f"module {module_name} has no source file")
+    files: dict[str, bytes] = {}
+    top = module_name.split(".")[0]
+    top_mod = importlib.import_module(top)
+    top_file = getattr(top_mod, "__file__", "")
+    if os.path.basename(top_file) == "__init__.py":
+        root = os.path.dirname(top_file)
+        base = os.path.dirname(root)
+        for dirpath, _dirs, names in os.walk(root):
+            for n in sorted(names):
+                if n.endswith(".py"):
+                    p = os.path.join(dirpath, n)
+                    rel = os.path.relpath(p, base)
+                    with open(p, "rb") as f:
+                        files[rel.replace(os.sep, "/")] = f.read()
+    else:
+        with open(mod_file, "rb") as f:
+            files[os.path.basename(mod_file)] = f.read()
+    return files
+
+
+def build_package(
+    entry: str,
+    name: Optional[str] = None,
+    config_file: Optional[str] = None,
+    deployment_spec: Optional[dict[str, Any]] = None,
+    out_path: Optional[str] = None,
+) -> tuple[str, PackageManifest]:
+    """Build the archive; returns (path, manifest). Importing the entry
+    validates the graph before anything is packaged."""
+    module_name, _, attr = entry.partition(":")
+    if not attr:
+        raise ValueError("entry must be module:Attr")
+    mod = importlib.import_module(module_name)
+    svc = getattr(mod, attr)  # raises if absent
+    if not hasattr(svc, "graph"):
+        raise ValueError(f"{entry} is not a DynamoService (no .graph())")
+    graph = svc.graph()
+
+    sources = _collect_sources(entry)
+    config: dict[str, Any] = {}
+    config_bytes = None
+    if config_file:
+        import yaml
+
+        with open(config_file, "rb") as f:
+            config_bytes = f.read()
+        config = yaml.safe_load(config_bytes) or {}
+
+    files = {f"src/{rel}": _sha256(data) for rel, data in sources.items()}
+    if config_bytes is not None:
+        files["config.yaml"] = _sha256(config_bytes)
+
+    name = name or (attr.lower() if attr else module_name.rsplit(".", 1)[-1])
+    core = json.dumps(
+        {"name": name, "entry": entry, "files": files, "config": config,
+         "deployment": deployment_spec},
+        sort_keys=True,
+    ).encode()
+    version = _sha256(core)[:12]
+    manifest = PackageManifest(
+        name=name, version=version, entry=entry, files=files,
+        config=config, deployment=deployment_spec,
+    )
+
+    out_path = out_path or f"{name}-{version}.tar.gz"
+    import gzip
+
+    # fully deterministic bytes: zero gzip mtime, no embedded filename,
+    # zero tar mtimes — identical sources => identical archive => pushes
+    # are idempotent at the blob level too
+    with open(out_path, "wb") as raw:
+        with gzip.GzipFile(fileobj=raw, mode="wb", mtime=0, filename="") as gz:
+            with tarfile.open(fileobj=gz, mode="w") as tar:
+
+                def add(relname: str, data: bytes) -> None:
+                    info = tarfile.TarInfo(relname)
+                    info.size = len(data)
+                    info.mtime = 0
+                    tar.addfile(info, io.BytesIO(data))
+
+                add("manifest.json",
+                    json.dumps(manifest.to_dict(), indent=1).encode())
+                for rel, data in sources.items():
+                    add(f"src/{rel}", data)
+                if config_bytes is not None:
+                    add("config.yaml", config_bytes)
+    return out_path, manifest
+
+
+def read_manifest(path: str) -> PackageManifest:
+    with tarfile.open(path, "r:gz") as tar:
+        f = tar.extractfile("manifest.json")
+        assert f is not None
+        return PackageManifest.from_dict(json.load(f))
+
+
+async def push_package(store, path: str) -> PackageManifest:
+    """Archive → store object plane + latest pointer."""
+    manifest = read_manifest(path)
+    with open(path, "rb") as f:
+        blob = f.read()
+    await store.obj_put(
+        PACKAGES_BUCKET, f"{manifest.name}:{manifest.version}", blob
+    )
+    await store.kv_put(latest_key(manifest.name), manifest.version.encode())
+    return manifest
+
+
+async def pull_package(store, name: str,
+                       version: Optional[str] = None) -> tuple[bytes, str]:
+    """-> (archive bytes, resolved version)."""
+    if version is None:
+        entry = await store.kv_get(latest_key(name))
+        if entry is None:
+            raise KeyError(f"no package {name!r}")
+        version = entry.value.decode()
+    blob = await store.obj_get(PACKAGES_BUCKET, f"{name}:{version}")
+    if blob is None:
+        raise KeyError(f"no package {name}:{version}")
+    return blob, version
+
+
+def unpack_package(blob: bytes, dest_root: str) -> tuple[str, PackageManifest]:
+    """Extract + verify hashes → ({dest_root}/{name}-{version}, manifest).
+    The src/ dir inside is importable (add to sys.path)."""
+    with tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz") as tar:
+        mf = tar.extractfile("manifest.json")
+        assert mf is not None
+        manifest = PackageManifest.from_dict(json.load(mf))
+        dest = os.path.join(dest_root, f"{manifest.name}-{manifest.version}")
+        os.makedirs(dest, exist_ok=True)
+        seen: set[str] = set()
+        for member in tar.getmembers():
+            if not member.isfile():
+                continue
+            rel = member.name
+            # refuse traversal; verify integrity against the manifest
+            if rel.startswith(("/", "..")) or ".." in rel.split("/"):
+                raise ValueError(f"unsafe member path {rel!r}")
+            f = tar.extractfile(member)
+            assert f is not None
+            data = f.read()
+            if rel != "manifest.json":
+                want = manifest.files.get(rel)
+                if want is None or _sha256(data) != want:
+                    raise ValueError(f"package integrity: {rel} hash mismatch")
+                seen.add(rel)
+            target = os.path.join(dest, rel)
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            with open(target, "wb") as out:
+                out.write(data)
+        # a truncated/pruned archive with only valid members must not
+        # pass: every manifest-listed file has to be present
+        missing = set(manifest.files) - seen
+        if missing:
+            raise ValueError(
+                f"package integrity: missing files {sorted(missing)[:5]}"
+            )
+    return dest, manifest

@@ -1,0 +1,319 @@
+"""K8s manifest generation: GraphDeploymentSpec → real cluster objects.
+
+The native analogue of the reference operator's rendering path
+(reference: deploy/cloud/operator/internal/controller/
+dynamographdeployment_controller.go builds Deployments/Services from the
+DynamoGraphDeployment CR; config/crd/bases/
+nvidia.com_dynamographdeployments.yaml defines the CRD). Here the same
+round trip is a library + CLI (`dynamo-tpu deploy manifests`): the CRD
+document, one apps/v1 Deployment + (where it listens) a Service per
+component, a ConfigMap carrying per-component engine config, and the
+coordinator-store Deployment/Service — all plain YAML a cluster accepts
+(`kubectl apply --dry-run=client`-shaped; no cluster needed to render).
+
+TPU resources use the GKE resource name ``google.com/tpu`` plus the
+standard node selectors for topology, replacing the reference's
+``nvidia.com/gpu``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from dynamo_tpu.deploy.spec import API_VERSION, KIND, GraphDeploymentSpec
+
+GROUP = API_VERSION.split("/")[0]
+PLURAL = "dynamographdeployments"
+DEFAULT_IMAGE = "dynamo-tpu:latest"
+STORE_PORT = 4222
+HTTP_PORT = 8000
+
+# components whose role implies a listening port worth a Service
+_HTTP_ROLES = ("frontend", "http", "processor")
+
+
+def crd_manifest() -> dict[str, Any]:
+    """CustomResourceDefinition for DynamoGraphDeployment (reference:
+    config/crd/bases/nvidia.com_dynamographdeployments.yaml)."""
+    service_schema = {
+        "type": "object",
+        "properties": {
+            "replicas": {"type": "integer", "minimum": 0},
+            "resources": {
+                "type": "object",
+                "properties": {"tpu": {"type": "integer", "minimum": 0}},
+            },
+            "config": {
+                "type": "object",
+                "x-kubernetes-preserve-unknown-fields": True,
+            },
+        },
+    }
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{PLURAL}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": KIND,
+                "listKind": f"{KIND}List",
+                "plural": PLURAL,
+                "singular": "dynamographdeployment",
+                "shortNames": ["dgd"],
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": API_VERSION.split("/")[1],
+                    "served": True,
+                    "storage": True,
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "spec": {
+                                    "type": "object",
+                                    "properties": {
+                                        "services": {
+                                            "type": "object",
+                                            "additionalProperties": service_schema,
+                                        }
+                                    },
+                                },
+                                "status": {
+                                    "type": "object",
+                                    "x-kubernetes-preserve-unknown-fields": True,
+                                },
+                            },
+                        }
+                    },
+                    "subresources": {"status": {}},
+                }
+            ],
+        },
+    }
+
+
+def _labels(spec: GraphDeploymentSpec, component: Optional[str] = None) -> dict:
+    labels = {
+        "app.kubernetes.io/name": "dynamo-tpu",
+        "app.kubernetes.io/instance": spec.name,
+        "app.kubernetes.io/managed-by": "dynamo-tpu-operator",
+    }
+    if component:
+        labels["dynamo-tpu/component"] = component
+    return labels
+
+
+def store_manifests(
+    spec: GraphDeploymentSpec, image: str = DEFAULT_IMAGE
+) -> list[dict[str, Any]]:
+    """The coordinator store (the native replacement for etcd+NATS) as a
+    single-replica Deployment + stable Service."""
+    name = f"{spec.name}-store"
+    labels = _labels(spec, "store")
+    return [
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": name, "namespace": spec.namespace,
+                         "labels": labels},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": labels},
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "store",
+                                "image": image,
+                                "command": [
+                                    "python", "-m", "dynamo_tpu.cli.main",
+                                    "store", "--host", "0.0.0.0",
+                                    "--port", str(STORE_PORT),
+                                ],
+                                "ports": [{"containerPort": STORE_PORT}],
+                            }
+                        ]
+                    },
+                },
+            },
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": name, "namespace": spec.namespace,
+                         "labels": labels},
+            "spec": {
+                "selector": labels,
+                "ports": [{"port": STORE_PORT, "targetPort": STORE_PORT}],
+            },
+        },
+    ]
+
+
+def _component_command(spec: GraphDeploymentSpec, component: str,
+                       svc_cfg: dict) -> list[str]:
+    """The container command for one component. Components carry their
+    CLI role in config["command"] (list) or config["role"]; default is
+    a dyn:// worker serving the component's endpoint."""
+    if svc_cfg.get("command"):
+        return list(svc_cfg["command"])
+    role = svc_cfg.get("role", "worker")
+    store = f"{spec.name}-store"
+    base = [
+        "python", "-m", "dynamo_tpu.cli.main", "run",
+        "--store-host", store, "--store-port", str(STORE_PORT),
+    ]
+    if role == "frontend":
+        return base + ["--in", "http", "--out", "auto",
+                       "--http-host", "0.0.0.0",
+                       "--http-port", str(HTTP_PORT)]
+    out = svc_cfg.get("out", "jax")
+    return base + [
+        "--in", f"dyn://{spec.namespace}.{component}.generate",
+        "--out", out,
+        *(["--model-path", svc_cfg["model_path"]]
+          if svc_cfg.get("model_path") else []),
+    ]
+
+
+def graph_manifests(
+    spec: GraphDeploymentSpec,
+    image: str = DEFAULT_IMAGE,
+    include_store: bool = True,
+    include_cr: bool = True,
+) -> list[dict[str, Any]]:
+    """All K8s documents for one graph deployment."""
+    spec.validate()
+    docs: list[dict[str, Any]] = []
+    if include_cr:
+        docs.append(spec.to_dict())  # the CR itself (operator input)
+    if include_store:
+        docs.extend(store_manifests(spec, image))
+    # one ConfigMap holds every component's engine config
+    docs.append(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {
+                "name": f"{spec.name}-config",
+                "namespace": spec.namespace,
+                "labels": _labels(spec),
+            },
+            "data": {
+                f"{comp}.json": json.dumps(svc.config, indent=1)
+                for comp, svc in spec.services.items()
+            },
+        }
+    )
+    for comp, svc in spec.services.items():
+        labels = _labels(spec, comp)
+        container: dict[str, Any] = {
+            "name": comp,
+            "image": image,
+            "command": _component_command(spec, comp, svc.config),
+            "env": [
+                {"name": "DYN_NAMESPACE", "value": spec.namespace},
+                {"name": "DYN_STORE_HOST", "value": f"{spec.name}-store"},
+                {"name": "DYN_STORE_PORT", "value": str(STORE_PORT)},
+            ],
+            "volumeMounts": [
+                {"name": "config", "mountPath": "/etc/dynamo-tpu"}
+            ],
+        }
+        pod: dict[str, Any] = {
+            "containers": [container],
+            "volumes": [
+                {
+                    "name": "config",
+                    "configMap": {"name": f"{spec.name}-config"},
+                }
+            ],
+        }
+        role = svc.config.get("role", comp)
+        if role in _HTTP_ROLES or svc.config.get("role") == "frontend":
+            container["ports"] = [{"containerPort": HTTP_PORT}]
+        if svc.tpu_chips > 0:
+            container["resources"] = {
+                "limits": {"google.com/tpu": svc.tpu_chips},
+                "requests": {"google.com/tpu": svc.tpu_chips},
+            }
+            # GKE TPU scheduling: accelerator + topology node selectors
+            topo = svc.config.get("tpu_topology")
+            pod["nodeSelector"] = {
+                "cloud.google.com/gke-tpu-accelerator": svc.config.get(
+                    "tpu_accelerator", "tpu-v5-lite-podslice"
+                ),
+                **(
+                    {"cloud.google.com/gke-tpu-topology": topo}
+                    if topo else {}
+                ),
+            }
+        docs.append(
+            {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {
+                    "name": f"{spec.name}-{comp}",
+                    "namespace": spec.namespace,
+                    "labels": labels,
+                },
+                "spec": {
+                    "replicas": svc.replicas,
+                    "selector": {"matchLabels": labels},
+                    "template": {"metadata": {"labels": labels}, "spec": pod},
+                },
+            }
+        )
+        if "ports" in container:
+            docs.append(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Service",
+                    "metadata": {
+                        "name": f"{spec.name}-{comp}",
+                        "namespace": spec.namespace,
+                        "labels": labels,
+                    },
+                    "spec": {
+                        "selector": labels,
+                        "ports": [{"port": HTTP_PORT,
+                                   "targetPort": HTTP_PORT}],
+                    },
+                }
+            )
+    return docs
+
+
+def render_yaml(docs: list[dict[str, Any]]) -> str:
+    import yaml
+
+    return "---\n".join(
+        yaml.safe_dump(d, sort_keys=False, default_flow_style=False)
+        for d in docs
+    )
+
+
+def validate_k8s_doc(doc: dict[str, Any]) -> None:
+    """Structural validation kubectl's client-side dry run performs:
+    apiVersion/kind/metadata.name present, selectors match template
+    labels, container commands are string lists."""
+    for key in ("apiVersion", "kind"):
+        if not doc.get(key):
+            raise ValueError(f"manifest missing {key}: {doc}")
+    meta = doc.get("metadata") or {}
+    if not meta.get("name"):
+        raise ValueError(f"{doc['kind']}: metadata.name missing")
+    if doc["kind"] == "Deployment":
+        spec = doc["spec"]
+        sel = spec["selector"]["matchLabels"]
+        tmpl_labels = spec["template"]["metadata"]["labels"]
+        if any(tmpl_labels.get(k) != v for k, v in sel.items()):
+            raise ValueError(f"{meta['name']}: selector ⊄ template labels")
+        for c in spec["template"]["spec"]["containers"]:
+            if not all(isinstance(x, str) for x in c.get("command", [])):
+                raise ValueError(f"{meta['name']}: non-string command args")

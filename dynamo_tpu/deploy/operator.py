@@ -35,11 +35,65 @@ class ReconcileResult:
     errors: list[str] = field(default_factory=list)
 
 
+class KubectlConnector:
+    """Reconcile against a real cluster by scaling the per-component
+    Deployments the manifest generator emits (``{name}-{component}``).
+    Shells out to kubectl (reference: kubernetes_connector.py /
+    kube.py patch the CR; here the operator IS the controller, so it
+    drives apps/v1 Deployments directly)."""
+
+    def __init__(self, deployment: str, k8s_namespace: str = "default",
+                 kubectl: str = "kubectl"):
+        self.deployment = deployment
+        self.k8s_namespace = k8s_namespace
+        self.kubectl = kubectl
+
+    async def _run(self, *argv: str) -> tuple[int, str]:
+        proc = await asyncio.create_subprocess_exec(
+            self.kubectl, "-n", self.k8s_namespace, *argv,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT,
+        )
+        out, _ = await proc.communicate()
+        return proc.returncode or 0, out.decode(errors="replace")
+
+    def _dep(self, component: str) -> str:
+        return f"deployment/{self.deployment}-{component}"
+
+    async def replicas(self, component: str) -> Optional[int]:
+        rc, out = await self._run(
+            "get", self._dep(component), "-o",
+            "jsonpath={.spec.replicas}",
+        )
+        if rc != 0:
+            log.warning("kubectl get %s failed: %s", component, out.strip())
+            return None
+        try:
+            return int(out.strip() or "0")
+        except ValueError:
+            return None
+
+    async def set_replicas(self, component: str, n: int) -> bool:
+        rc, out = await self._run(
+            "scale", self._dep(component), f"--replicas={n}"
+        )
+        if rc != 0:
+            log.warning("kubectl scale %s failed: %s", component, out.strip())
+        return rc == 0
+
+
 class Reconciler:
-    """One reconciler per namespace; drives every deployment under it."""
+    """One reconciler per namespace; drives every deployment under it.
+
+    ``connector_factory(spec)`` selects the actuation backend per
+    deployment: the default LocalConnector speaks the supervisor
+    control subject; a KubectlConnector drives real cluster
+    Deployments. Backends expose ``replicas``/``add_component``/
+    ``remove_component`` or the absolute ``set_replicas``."""
 
     def __init__(self, store: Store, namespace: str,
-                 interval_s: float = 10.0, max_actions_per_pass: int = 8):
+                 interval_s: float = 10.0, max_actions_per_pass: int = 8,
+                 connector_factory=None, state_dir: Optional[str] = None):
         self.store = store
         self.namespace = namespace
         self.interval_s = interval_s
@@ -47,6 +101,15 @@ class Reconciler:
         # and one pass can't wedge the supervisor with a command storm
         self.max_actions = max_actions_per_pass
         self.connector = LocalConnector(store, namespace)
+        self._connector_factory = connector_factory or (
+            lambda spec: self.connector
+        )
+        # durable mirror of DESIRED state: restore_state() seeds the
+        # store after a coordinator restart; every reconcile pass then
+        # re-syncs the mirror to the store (mirror FOLLOWS store, so
+        # deletes through any path — CLI, REST, raw store — propagate
+        # and can't resurrect)
+        self.state_dir = state_dir
         self._task: Optional[asyncio.Task] = None
 
     # -- desired/actual ----------------------------------------------------
@@ -63,24 +126,99 @@ class Reconciler:
 
     async def reconcile_once(self) -> list[ReconcileResult]:
         results = []
-        for spec in await self.list_deployments():
+        specs = await self.list_deployments()
+        self._sync_mirror(specs)
+        for spec in specs:
             results.append(await self._reconcile_deployment(spec))
         return results
+
+    # -- durable desired-state mirror --------------------------------------
+    def _mirror_path(self, name: str) -> str:
+        import os
+
+        return os.path.join(self.state_dir or "", name.replace("/", "_") + ".json")
+
+    def _sync_mirror(self, specs: list[GraphDeploymentSpec]) -> None:
+        """Make {state_dir} exactly reflect the store's desired state."""
+        if not self.state_dir:
+            return
+        import glob
+        import json
+        import os
+
+        try:
+            os.makedirs(self.state_dir, exist_ok=True)
+            want = {self._mirror_path(s.name): s for s in specs}
+            for path, spec in want.items():
+                doc = json.dumps(spec.to_dict(), indent=1)
+                try:
+                    with open(path) as f:
+                        if f.read() == doc:
+                            continue
+                except FileNotFoundError:
+                    pass
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(doc)
+                os.replace(tmp, path)
+            for path in glob.glob(os.path.join(self.state_dir, "*.json")):
+                if path not in want:
+                    os.remove(path)
+        except OSError:
+            log.exception("state mirror sync failed")
+
+    async def restore_state(self) -> int:
+        """Seed the store from the mirror after a coordinator restart.
+        kv_create only: a live (newer) spec in the store wins."""
+        if not self.state_dir:
+            return 0
+        import glob
+        import json
+        import os
+
+        restored = 0
+        for path in sorted(glob.glob(os.path.join(self.state_dir, "*.json"))):
+            try:
+                with open(path) as f:
+                    spec = GraphDeploymentSpec.from_dict(json.load(f))
+            except Exception as exc:
+                log.warning("skipping bad persisted spec %s: %s", path, exc)
+                continue
+            created = await self.store.kv_create(
+                deployment_key(self.namespace, spec.name), spec.to_bytes()
+            )
+            if created:
+                restored += 1
+                log.info("restored deployment %s from %s", spec.name, path)
+        return restored
 
     async def _reconcile_deployment(
         self, spec: GraphDeploymentSpec
     ) -> ReconcileResult:
         res = ReconcileResult(deployment=spec.name)
         budget = self.max_actions
+        conn = self._connector_factory(spec)
         for component, svc in spec.services.items():
-            actual = await self.connector.replicas(component)
+            actual = await conn.replicas(component)
             if actual is None:
                 res.errors.append(f"{component}: no supervisor state")
                 res.converged = False
                 continue
             delta = svc.replicas - actual
+            if delta != 0 and hasattr(conn, "set_replicas"):
+                # absolute backends (kubectl) converge in one action
+                if budget <= 0:
+                    res.converged = False
+                    continue
+                budget -= 1
+                if await conn.set_replicas(component, svc.replicas):
+                    res.actions.append(f"{component}={svc.replicas}")
+                else:
+                    res.errors.append(f"{component}: scale failed")
+                    res.converged = False
+                continue
             while delta > 0 and budget > 0:
-                ok = await self.connector.add_component(component)
+                ok = await conn.add_component(component)
                 if not ok:
                     res.errors.append(f"{component}: add failed")
                     res.converged = False
@@ -89,7 +227,7 @@ class Reconciler:
                 delta -= 1
                 budget -= 1
             while delta < 0 and budget > 0:
-                ok = await self.connector.remove_component(component)
+                ok = await conn.remove_component(component)
                 if not ok:
                     res.errors.append(f"{component}: remove failed")
                     res.converged = False
@@ -132,17 +270,27 @@ class Reconciler:
         await self.store.kv_put(
             deployment_key(self.namespace, spec.name), spec.to_bytes()
         )
+        # mirror immediately (don't wait for the next reconcile pass):
+        # an apply followed by a coordinator crash must survive
+        if self.state_dir:
+            self._sync_mirror(await self.list_deployments())
 
     async def delete(self, name: str) -> bool:
-        return await self.store.kv_delete(deployment_key(self.namespace, name))
+        deleted = await self.store.kv_delete(
+            deployment_key(self.namespace, name)
+        )
+        if deleted and self.state_dir:
+            self._sync_mirror(await self.list_deployments())
+        return deleted
 
     async def status(self) -> dict:
         """Desired vs actual for every deployment (the CLI's view)."""
         out: dict = {}
         for spec in await self.list_deployments():
             comp_status = {}
+            conn = self._connector_factory(spec)
             for component, svc in spec.services.items():
-                actual = await self.connector.replicas(component)
+                actual = await conn.replicas(component)
                 comp_status[component] = {
                     "desired": svc.replicas,
                     "actual": actual,

@@ -43,6 +43,10 @@ class EngineConfig:
     decode_steps: int = 1
     # weights
     random_weights: bool = False  # bench/test mode: skip checkpoint load
+    # weight-only quantization applied at load: None | "int8"
+    # (per-channel symmetric, models/quant.py — halves weight HBM
+    # traffic and fits the 8B flagship on one 16 GB chip)
+    quantization: Optional[str] = None
     seed: int = 0
     extra: dict[str, Any] = field(default_factory=dict)
 
@@ -69,6 +73,7 @@ def load_engine_config(args: Any) -> EngineConfig:
         num_nodes=getattr(args, "num_nodes", 1),
         node_rank=getattr(args, "node_rank", 0),
         leader_addr=getattr(args, "leader_addr", ""),
+        quantization=getattr(args, "quantization", None),
         host_kv_blocks=getattr(args, "host_kv_blocks", 0),
         disk_kv_blocks=getattr(args, "disk_kv_blocks", 0),
         disk_kv_path=getattr(args, "disk_kv_path", ""),

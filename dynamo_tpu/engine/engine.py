@@ -89,6 +89,9 @@ class JaxEngine:
         self._step_fn_mm: Optional[Callable] = None
         self._multi_step_fn: Optional[Callable] = None
         self._pp = config.pipeline_parallel_size
+        # multi-host: rank 0 leads (scheduler + broadcast), others follow
+        self._is_follower = config.num_nodes > 1 and config.node_rank > 0
+        self._mh_broadcast = None  # StepBroadcaster on the leader
         self._thread: Optional[threading.Thread] = None
         self._incoming: thread_queue.Queue = thread_queue.Queue()
         self._control: thread_queue.Queue = thread_queue.Queue()
@@ -167,10 +170,19 @@ class JaxEngine:
             seed=cfg.seed,
             mesh=self.mesh,
             specs_fn=specs_fn,
+            quantize=cfg.quantization,
         )
         self.eos_token_ids = self.model_config.eos_token_ids
 
         num_blocks = cfg.num_blocks or self._auto_num_blocks(devices)
+        if cfg.num_nodes > 1:
+            # every process must build identically-shaped caches; only
+            # the leader's HBM probe is authoritative
+            from jax.experimental import multihost_utils
+
+            num_blocks = int(
+                multihost_utils.broadcast_one_to_all(np.int32(num_blocks))
+            )
         self.k_cache, self.v_cache = init_cache(
             self.model_config,
             num_blocks,
@@ -202,8 +214,15 @@ class JaxEngine:
                 "cascades from the G2 host tier)"
             )
         if cfg.host_kv_blocks > 0 and cfg.num_nodes > 1:
-            # multi-host caches are not fully addressable from one process;
-            # cross-host offload arrives with the G4 transfer agent
+            # Multi-host caches are not fully addressable from one
+            # process. The design for lifting this (docs/multihost.md
+            # "Sharded KV offload"): each host offloads only its LOCAL
+            # shard of a block (no cross-host traffic), keyed by (block
+            # hash, shard index); gather/scatter become broadcast step
+            # kinds in the leader/follower protocol so every process
+            # enters the same jitted copy. Until that lands, tiers stay
+            # G1-only on multihost rather than silently serving torn
+            # blocks.
             log.warning("KV offload tiers unsupported with num_nodes>1; disabled")
         elif cfg.host_kv_blocks > 0:
             self.kvbm = KvBlockManager(
@@ -223,6 +242,10 @@ class JaxEngine:
             )
             self.scheduler.onboard = self._safe_onboard
         self._build_step_fn()
+        if cfg.num_nodes > 1 and cfg.node_rank == 0:
+            from dynamo_tpu.parallel.multihost import StepBroadcaster
+
+            self._mh_broadcast = StepBroadcaster()
         log.info(
             "engine up: %s, mesh=%s, blocks=%d×%d",
             cfg.model_name,
@@ -241,19 +264,62 @@ class JaxEngine:
             * self.config.block_size
             * mc.num_key_value_heads
             * mc.head_dim
-            * 2  # bf16
+            * jnp.dtype(self.config.kv_cache_dtype).itemsize
         )
+        free = None
         try:
             stats = devices[0].memory_stats()
             free = stats["bytes_limit"] - stats["bytes_in_use"]
-            budget = free * self.config.hbm_utilization
-            # cache is sharded over tp: each device holds Hkv/tp heads
-            budget_total = budget * (self.config.tensor_parallel_size
-                                      * self.config.pipeline_parallel_size)
-            n = int(budget_total // bytes_per_block_total)
-            return max(16, min(n, 1_000_000))
         except Exception:
+            free = None
+        if free is None and getattr(devices[0], "platform", "") != "tpu":
+            # CPU/virtual test backends: a modest fixed pool. The
+            # datasheet estimate below would size a gigantic cache and
+            # stall worker bring-up allocating it.
             return 512
+        if free is None:
+            # tunneled chips report no memory stats: estimate from
+            # datasheet HBM minus what the params actually occupy
+            # (int8-aware via nbytes). An undersized fallback causes
+            # recompute preemptions mid-serve, which is far worse than
+            # a slightly optimistic estimate under 0.x utilization.
+            hbm = {
+                "TPU v5 lite": 16, "TPU v5e": 16, "TPU v4": 32,
+                "TPU v5p": 95, "TPU v6 lite": 32, "TPU v6e": 32,
+            }.get(getattr(devices[0], "device_kind", ""), 16) * (1 << 30)
+            hbm = int(hbm * 0.98)  # runtime-reserved slice
+            n_dev = max(1, len(devices))
+            param_bytes = sum(
+                x.nbytes for x in jax.tree_util.tree_leaves(self.params)
+            ) / n_dev
+            free = max(0.0, hbm - param_bytes)
+        # step-transient headroom the cache must leave: a full batched
+        # prefill's activations dominate — per token roughly 6 D-wide
+        # bf16 tensors (h/q/k/v/attn/out), 3 F-wide (gate/up/act, ×E for
+        # dense-compute MoE), plus f32 attention scores H × S_table
+        area = self.config.max_batch_size * self.config.prefill_chunk_size
+        s_est = (
+            (self.config.max_model_len or mc.max_position_embeddings)
+            + 8 * self.config.block_size
+        )
+        e_mult = max(1, mc.num_local_experts)
+        per_tok = (
+            12 * mc.hidden_size
+            + 6 * mc.intermediate_size * e_mult
+            + 4 * mc.num_attention_heads * s_est
+        )
+        # activations shard over tp (hidden/head axes), so the per-device
+        # transient shrinks with tp; flat guard covers scan/fusion
+        # scratch the per-token model misses
+        transient = (
+            area * per_tok / self.config.tensor_parallel_size + (512 << 20)
+        )
+        budget = max(0.0, free - transient) * self.config.hbm_utilization
+        # cache is sharded over tp: each device holds Hkv/tp heads
+        budget_total = budget * (self.config.tensor_parallel_size
+                                  * self.config.pipeline_parallel_size)
+        n = int(budget_total // bytes_per_block_total)
+        return max(16, min(n, 1_000_000))
 
     def _on_kv_event(self, op: str, hashes: list[int], blocks: list[int]) -> None:
         if self.kvbm is not None and op == "stored":
@@ -419,6 +485,13 @@ class JaxEngine:
             sampling.top_p,
             sampling.seeds,
         )
+        if self._mh_broadcast is not None:
+            if "extra_embeds" in arrays:
+                raise RuntimeError(
+                    "multimodal embedding injection is not supported with "
+                    "num_nodes>1"
+                )
+            self._mh_broadcast.announce_step(arrays, sampling)
         if "extra_embeds" in arrays:
             next_tokens, logprobs, self.k_cache, self.v_cache = self._step_fn_mm(
                 *base_args, arrays["extra_embeds"], arrays["embeds_mask"]
@@ -427,12 +500,25 @@ class JaxEngine:
             next_tokens, logprobs, self.k_cache, self.v_cache = self._step_fn(
                 *base_args
             )
-        return np.asarray(next_tokens), np.asarray(logprobs)
+        from dynamo_tpu.parallel.multihost import host_value
+
+        return host_value(next_tokens), host_value(logprobs)
 
     # ------------------------------------------------------------------
     # Engine thread loop
     # ------------------------------------------------------------------
     def _step_loop(self) -> None:
+        if self._is_follower:
+            # follower ranks mirror the leader's device dispatches until
+            # the leader broadcasts STOP (parallel/multihost.py)
+            from dynamo_tpu.parallel.multihost import StepFollower
+
+            try:
+                StepFollower(self).run()
+            except Exception:
+                log.exception("multihost follower loop failed")
+            self._running = False
+            return
         assert self.scheduler is not None
         while self._running:
             self._drain_incoming()
@@ -640,6 +726,8 @@ class JaxEngine:
 
     def _run_multi_step(self, arrays: dict[str, np.ndarray], sampling: SamplingBatch):
         assert self._multi_step_fn is not None
+        if self._mh_broadcast is not None:
+            self._mh_broadcast.announce_multi_step(arrays, sampling)
         toks, lps, self.k_cache, self.v_cache = self._multi_step_fn(
             self.params,
             self.k_cache,
@@ -814,6 +902,13 @@ class JaxEngine:
         if self._thread is not None:
             await asyncio.get_running_loop().run_in_executor(
                 None, functools.partial(self._thread.join, timeout=10)
+            )
+        if self._mh_broadcast is not None:
+            # release follower ranks blocked on the next control
+            # broadcast (strictly after the step thread has joined, so
+            # STOP orders after every step announcement)
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._mh_broadcast.announce_stop
             )
         if self.kvbm is not None:
             self.kvbm.close()

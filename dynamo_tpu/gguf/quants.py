@@ -1,0 +1,392 @@
+"""GGML block-quantization formats: dequantize (+ test encoders).
+
+Dequantization is bit-exact to ggml's reference dequantize_row_*
+(reference: lib/llm/src/gguf/ loads these through candle, which mirrors
+ggml/src/ggml-quants.c) — practically every distributed GGUF is
+Q4_K/Q5_K/Q6_K, so the serving path must read them. All kernels are
+vectorized numpy over the block structure.
+
+The encoders here exist for round-trip tests and the writer; they pick
+valid (not necessarily ggml-optimal) scales, while the DEQUANT layout
+is what real llama.cpp files require.
+
+Formats (values per block / bytes per block):
+  Q4_0  32 / 18   d f16, 16B nibbles;             v = d*(q-8)
+  Q5_0  32 / 22   d f16, 4B high bits, 16B;       v = d*(q-16)
+  Q8_0  32 / 34   d f16, 32 int8;                 v = d*q
+  Q4_K 256 / 144  d,dmin f16, 12B 6-bit scales, 128B;   v = d*sc*q - dmin*m
+  Q5_K 256 / 176  + 32B high bits;                v = d*sc*q - dmin*m
+  Q6_K 256 / 210  128B low, 64B high, 16 int8 scales;   v = d*sc*(q-32)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+QK = 32       # classic block size
+QK_K = 256    # k-quant super-block size
+
+GGML_Q4_0 = 2
+GGML_Q5_0 = 6
+GGML_Q8_0 = 8
+GGML_Q4_K = 12
+GGML_Q5_K = 13
+GGML_Q6_K = 14
+
+BLOCK_SIZES = {
+    GGML_Q4_0: (QK, 18),
+    GGML_Q5_0: (QK, 22),
+    GGML_Q8_0: (QK, 34),
+    GGML_Q4_K: (QK_K, 144),
+    GGML_Q5_K: (QK_K, 176),
+    GGML_Q6_K: (QK_K, 210),
+}
+
+
+# ---------------------------------------------------------------------------
+# scale packing for Q4_K/Q5_K (ggml get_scale_min_k4)
+# ---------------------------------------------------------------------------
+
+
+def _unpack_scales_k4(scales: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """scales: [nb, 12] uint8 -> (sc [nb, 8], m [nb, 8]) 6-bit values."""
+    q = scales.astype(np.uint8)
+    sc = np.empty(q.shape[:-1] + (8,), np.uint8)
+    m = np.empty_like(sc)
+    for j in range(4):
+        sc[..., j] = q[..., j] & 63
+        m[..., j] = q[..., j + 4] & 63
+    for j in range(4, 8):
+        sc[..., j] = (q[..., j + 4] & 0x0F) | ((q[..., j - 4] >> 6) << 4)
+        m[..., j] = (q[..., j + 4] >> 4) | ((q[..., j] >> 6) << 4)
+    return sc, m
+
+
+def _pack_scales_k4(sc: np.ndarray, m: np.ndarray) -> np.ndarray:
+    """(sc [nb, 8], m [nb, 8]) 6-bit -> [nb, 12] uint8 (inverse of
+    _unpack_scales_k4)."""
+    sc = sc.astype(np.uint8)
+    m = m.astype(np.uint8)
+    out = np.zeros(sc.shape[:-1] + (12,), np.uint8)
+    for j in range(4):
+        out[..., j] = (sc[..., j] & 63) | ((sc[..., j + 4] >> 4) << 6)
+        out[..., j + 4] = (m[..., j] & 63) | ((m[..., j + 4] >> 4) << 6)
+        out[..., j + 8] = (sc[..., j + 4] & 0x0F) | ((m[..., j + 4] & 0x0F) << 4)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dequantize: raw bytes -> f32 [n]
+# ---------------------------------------------------------------------------
+
+
+def dequant_q4_0(raw: bytes, n: int) -> np.ndarray:
+    nb = n // QK
+    rec = np.frombuffer(raw, np.dtype([("d", np.float16), ("qs", np.uint8, 16)]),
+                        count=nb)
+    d = rec["d"].astype(np.float32)[:, None]
+    lo = (rec["qs"] & 0x0F).astype(np.float32) - 8.0
+    hi = (rec["qs"] >> 4).astype(np.float32) - 8.0
+    return (np.concatenate([lo, hi], axis=1) * d).reshape(-1)
+
+
+def dequant_q5_0(raw: bytes, n: int) -> np.ndarray:
+    nb = n // QK
+    rec = np.frombuffer(
+        raw,
+        np.dtype([("d", np.float16), ("qh", np.uint32), ("qs", np.uint8, 16)]),
+        count=nb,
+    )
+    d = rec["d"].astype(np.float32)[:, None]
+    qh = rec["qh"][:, None].astype(np.uint32)
+    ls = np.arange(16, dtype=np.uint32)[None, :]
+    # ggml: xh_0 = ((qh >> l) << 4) & 0x10 ; xh_1 = (qh >> (l + 12)) & 0x10
+    xh0 = ((qh >> ls) << 4) & 0x10
+    xh1 = (qh >> (ls + 12)) & 0x10
+    lo = ((rec["qs"] & 0x0F) | xh0.astype(np.uint8)).astype(np.float32) - 16.0
+    hi = ((rec["qs"] >> 4) | xh1.astype(np.uint8)).astype(np.float32) - 16.0
+    return (np.concatenate([lo, hi], axis=1) * d).reshape(-1)
+
+
+def dequant_q8_0(raw: bytes, n: int) -> np.ndarray:
+    nb = n // QK
+    rec = np.frombuffer(raw, np.dtype([("d", np.float16), ("q", np.int8, QK)]),
+                        count=nb)
+    return (rec["d"].astype(np.float32)[:, None]
+            * rec["q"].astype(np.float32)).reshape(-1)
+
+
+def dequant_q4_k(raw: bytes, n: int) -> np.ndarray:
+    nb = n // QK_K
+    rec = np.frombuffer(
+        raw,
+        np.dtype([("d", np.float16), ("dmin", np.float16),
+                  ("scales", np.uint8, 12), ("qs", np.uint8, 128)]),
+        count=nb,
+    )
+    d = rec["d"].astype(np.float32)
+    dmin = rec["dmin"].astype(np.float32)
+    sc, mn = _unpack_scales_k4(rec["scales"])  # [nb, 8]
+    out = np.empty((nb, QK_K), np.float32)
+    qs = rec["qs"].reshape(nb, 4, 32)  # 4 chunks of 64 values (32 bytes)
+    for c in range(4):
+        lo = (qs[:, c] & 0x0F).astype(np.float32)
+        hi = (qs[:, c] >> 4).astype(np.float32)
+        j0, j1 = 2 * c, 2 * c + 1
+        out[:, c * 64: c * 64 + 32] = (
+            (d * sc[:, j0])[:, None] * lo - (dmin * mn[:, j0])[:, None]
+        )
+        out[:, c * 64 + 32: c * 64 + 64] = (
+            (d * sc[:, j1])[:, None] * hi - (dmin * mn[:, j1])[:, None]
+        )
+    return out.reshape(-1)
+
+
+def dequant_q5_k(raw: bytes, n: int) -> np.ndarray:
+    nb = n // QK_K
+    rec = np.frombuffer(
+        raw,
+        np.dtype([("d", np.float16), ("dmin", np.float16),
+                  ("scales", np.uint8, 12), ("qh", np.uint8, 32),
+                  ("qs", np.uint8, 128)]),
+        count=nb,
+    )
+    d = rec["d"].astype(np.float32)
+    dmin = rec["dmin"].astype(np.float32)
+    sc, mn = _unpack_scales_k4(rec["scales"])
+    out = np.empty((nb, QK_K), np.float32)
+    qs = rec["qs"].reshape(nb, 4, 32)
+    qh = rec["qh"]  # [nb, 32], bit pairs per 64-chunk
+    for c in range(4):
+        u1 = np.uint8(1 << (2 * c))
+        u2 = np.uint8(2 << (2 * c))
+        hi1 = np.where(qh & u1, 16.0, 0.0).astype(np.float32)
+        hi2 = np.where(qh & u2, 16.0, 0.0).astype(np.float32)
+        lo = (qs[:, c] & 0x0F).astype(np.float32) + hi1
+        hi = (qs[:, c] >> 4).astype(np.float32) + hi2
+        j0, j1 = 2 * c, 2 * c + 1
+        out[:, c * 64: c * 64 + 32] = (
+            (d * sc[:, j0])[:, None] * lo - (dmin * mn[:, j0])[:, None]
+        )
+        out[:, c * 64 + 32: c * 64 + 64] = (
+            (d * sc[:, j1])[:, None] * hi - (dmin * mn[:, j1])[:, None]
+        )
+    return out.reshape(-1)
+
+
+def dequant_q6_k(raw: bytes, n: int) -> np.ndarray:
+    nb = n // QK_K
+    rec = np.frombuffer(
+        raw,
+        np.dtype([("ql", np.uint8, 128), ("qh", np.uint8, 64),
+                  ("scales", np.int8, 16), ("d", np.float16)]),
+        count=nb,
+    )
+    d = rec["d"].astype(np.float32)  # [nb]
+    sc = rec["scales"].astype(np.float32)  # [nb, 16]
+    out = np.empty((nb, QK_K), np.float32)
+    for half in range(2):  # two 128-value halves
+        ql = rec["ql"][:, half * 64:(half + 1) * 64]  # [nb, 64]
+        qh = rec["qh"][:, half * 32:(half + 1) * 32]  # [nb, 32]
+        base = half * 128
+        sbase = half * 8
+        l = np.arange(32)
+        q1 = ((ql[:, :32] & 0x0F) | (((qh >> 0) & 3) << 4)).astype(np.int8) - 32
+        q2 = ((ql[:, 32:] & 0x0F) | (((qh >> 2) & 3) << 4)).astype(np.int8) - 32
+        q3 = ((ql[:, :32] >> 4) | (((qh >> 4) & 3) << 4)).astype(np.int8) - 32
+        q4 = ((ql[:, 32:] >> 4) | (((qh >> 6) & 3) << 4)).astype(np.int8) - 32
+        for k, q in enumerate((q1, q2, q3, q4)):
+            # scale index: is = l/16 + k*2 within this half
+            s_idx = sbase + (l // 16) + 2 * k  # [32]
+            out[:, base + 32 * k: base + 32 * (k + 1)] = (
+                d[:, None] * np.take_along_axis(
+                    sc, np.broadcast_to(s_idx, (nb, 32)), axis=1
+                ) * q.astype(np.float32)
+            )
+    return out.reshape(-1)
+
+
+DEQUANT = {
+    GGML_Q4_0: dequant_q4_0,
+    GGML_Q5_0: dequant_q5_0,
+    GGML_Q8_0: dequant_q8_0,
+    GGML_Q4_K: dequant_q4_k,
+    GGML_Q5_K: dequant_q5_k,
+    GGML_Q6_K: dequant_q6_k,
+}
+
+
+# ---------------------------------------------------------------------------
+# encoders (writer/tests): pick valid scales, pack per format
+# ---------------------------------------------------------------------------
+
+
+def quant_q4_0(x: np.ndarray) -> bytes:
+    f = x.astype(np.float32).reshape(-1, QK)
+    d = np.abs(f).max(axis=1) / 8.0
+    ds = np.where(d == 0, 1.0, d).astype(np.float32)
+    q = np.clip(np.round(f / ds[:, None]) + 8, 0, 15).astype(np.uint8)
+    rec = np.zeros(len(f), np.dtype([("d", np.float16), ("qs", np.uint8, 16)]))
+    rec["d"] = d.astype(np.float16)
+    # re-derive q against the f16-rounded scale the decoder will use
+    df = rec["d"].astype(np.float32)
+    df = np.where(df == 0, 1.0, df)
+    q = np.clip(np.round(f / df[:, None]) + 8, 0, 15).astype(np.uint8)
+    rec["qs"] = q[:, :16] | (q[:, 16:] << 4)
+    return rec.tobytes()
+
+
+def quant_q5_0(x: np.ndarray) -> bytes:
+    f = x.astype(np.float32).reshape(-1, QK)
+    d = np.abs(f).max(axis=1) / 16.0
+    rec = np.zeros(
+        len(f),
+        np.dtype([("d", np.float16), ("qh", np.uint32), ("qs", np.uint8, 16)]),
+    )
+    rec["d"] = d.astype(np.float16)
+    df = rec["d"].astype(np.float32)
+    df = np.where(df == 0, 1.0, df)
+    q = np.clip(np.round(f / df[:, None]) + 16, 0, 31).astype(np.uint8)
+    q0, q1 = q[:, :16], q[:, 16:]
+    rec["qs"] = (q0 & 0x0F) | ((q1 & 0x0F) << 4)
+    qh = np.zeros(len(f), np.uint32)
+    for l in range(16):
+        qh |= ((q0[:, l] >> 4).astype(np.uint32) & 1) << l
+        qh |= ((q1[:, l] >> 4).astype(np.uint32) & 1) << (l + 16)
+    rec["qh"] = qh
+    return rec.tobytes()
+
+
+def _kquant_scales(f: np.ndarray, nsub: int):
+    """Per-sub-block (min, span-scale) for the v = d*sc*q - dmin*m shape.
+    f: [nb, QK_K] -> sub [nb, nsub, QK_K//nsub]."""
+    sub = f.reshape(f.shape[0], nsub, -1)
+    mins = np.minimum(sub.min(axis=2), 0.0)  # m >= 0 means min <= 0
+    return sub, -mins  # (sub-blocks, positive offsets)
+
+
+def quant_q4_k(x: np.ndarray) -> bytes:
+    f = x.astype(np.float32).reshape(-1, QK_K)
+    nb = len(f)
+    sub, m = _kquant_scales(f, 8)  # [nb, 8, 32], m [nb, 8]
+    span = (sub.max(axis=2) + m) / 15.0  # value step per sub-block
+    d = span.max(axis=1) / 63.0
+    dmin = m.max(axis=1) / 63.0
+    ds = np.where(d == 0, 1.0, d)
+    dm = np.where(dmin == 0, 1.0, dmin)
+    rec = np.zeros(
+        nb,
+        np.dtype([("d", np.float16), ("dmin", np.float16),
+                  ("scales", np.uint8, 12), ("qs", np.uint8, 128)]),
+    )
+    rec["d"] = d.astype(np.float16)
+    rec["dmin"] = dmin.astype(np.float16)
+    df = np.where(rec["d"].astype(np.float32) == 0, 1.0, rec["d"].astype(np.float32))
+    dmf = np.where(rec["dmin"].astype(np.float32) == 0, 1.0,
+                   rec["dmin"].astype(np.float32))
+    sc = np.clip(np.round(span / df[:, None]), 0, 63).astype(np.uint8)
+    mn = np.clip(np.round(m / dmf[:, None]), 0, 63).astype(np.uint8)
+    rec["scales"] = _pack_scales_k4(sc, mn)
+    # re-read packed 6-bit values so q is computed against decoder scales
+    sc_u, mn_u = _unpack_scales_k4(rec["scales"])
+    step = df[:, None] * sc_u.astype(np.float32)
+    step = np.where(step == 0, 1.0, step)
+    offs = dmf[:, None] * mn_u.astype(np.float32)
+    q = np.clip(
+        np.round((sub + offs[:, :, None]) / step[:, :, None]), 0, 15
+    ).astype(np.uint8)  # [nb, 8, 32]
+    qs = np.empty((nb, 4, 32), np.uint8)
+    for c in range(4):
+        qs[:, c] = q[:, 2 * c] | (q[:, 2 * c + 1] << 4)
+    rec["qs"] = qs.reshape(nb, 128)
+    return rec.tobytes()
+
+
+def quant_q5_k(x: np.ndarray) -> bytes:
+    f = x.astype(np.float32).reshape(-1, QK_K)
+    nb = len(f)
+    sub, m = _kquant_scales(f, 8)
+    span = (sub.max(axis=2) + m) / 31.0
+    d = span.max(axis=1) / 63.0
+    dmin = m.max(axis=1) / 63.0
+    rec = np.zeros(
+        nb,
+        np.dtype([("d", np.float16), ("dmin", np.float16),
+                  ("scales", np.uint8, 12), ("qh", np.uint8, 32),
+                  ("qs", np.uint8, 128)]),
+    )
+    rec["d"] = d.astype(np.float16)
+    rec["dmin"] = dmin.astype(np.float16)
+    df = np.where(rec["d"].astype(np.float32) == 0, 1.0,
+                  rec["d"].astype(np.float32))
+    dmf = np.where(rec["dmin"].astype(np.float32) == 0, 1.0,
+                   rec["dmin"].astype(np.float32))
+    sc = np.clip(np.round(span / df[:, None]), 0, 63).astype(np.uint8)
+    mn = np.clip(np.round(m / dmf[:, None]), 0, 63).astype(np.uint8)
+    rec["scales"] = _pack_scales_k4(sc, mn)
+    sc_u, mn_u = _unpack_scales_k4(rec["scales"])
+    step = df[:, None] * sc_u.astype(np.float32)
+    step = np.where(step == 0, 1.0, step)
+    offs = dmf[:, None] * mn_u.astype(np.float32)
+    q = np.clip(
+        np.round((sub + offs[:, :, None]) / step[:, :, None]), 0, 31
+    ).astype(np.uint8)  # [nb, 8, 32], 5-bit
+    qs = np.empty((nb, 4, 32), np.uint8)
+    qh = np.zeros((nb, 32), np.uint8)
+    for c in range(4):
+        lo_q, hi_q = q[:, 2 * c], q[:, 2 * c + 1]
+        qs[:, c] = (lo_q & 0x0F) | ((hi_q & 0x0F) << 4)
+        qh |= ((lo_q >> 4) & 1) << (2 * c)
+        qh |= ((hi_q >> 4) & 1) << (2 * c + 1)
+    rec["qs"] = qs.reshape(nb, 128)
+    rec["qh"] = qh
+    return rec.tobytes()
+
+
+def quant_q6_k(x: np.ndarray) -> bytes:
+    f = x.astype(np.float32).reshape(-1, QK_K)
+    nb = len(f)
+    sub = f.reshape(nb, 16, 16)
+    s = np.abs(sub).max(axis=2) / 31.0  # [nb, 16]
+    d = s.max(axis=1) / 127.0
+    rec = np.zeros(
+        nb,
+        np.dtype([("ql", np.uint8, 128), ("qh", np.uint8, 64),
+                  ("scales", np.int8, 16), ("d", np.float16)]),
+    )
+    rec["d"] = d.astype(np.float16)
+    df = np.where(rec["d"].astype(np.float32) == 0, 1.0,
+                  rec["d"].astype(np.float32))
+    sc = np.clip(np.round(s / df[:, None]), -128, 127).astype(np.int8)
+    rec["scales"] = sc
+    step = df[:, None] * sc.astype(np.float32)
+    step = np.where(step == 0, 1.0, step)
+    q = np.clip(
+        np.round(sub / step[:, :, None]), -32, 31
+    ).astype(np.int32) + 32  # [nb, 16, 16] in [0, 63]
+    qq = q.reshape(nb, QK_K)
+    ql = np.zeros((nb, 128), np.uint8)
+    qh = np.zeros((nb, 64), np.uint8)
+    for half in range(2):
+        base = half * 128
+        part = qq[:, base: base + 128]  # 128 values
+        q1, q2 = part[:, :32], part[:, 32:64]
+        q3, q4 = part[:, 64:96], part[:, 96:]
+        ql[:, half * 64: half * 64 + 32] = (q1 & 0x0F) | ((q3 & 0x0F) << 4)
+        ql[:, half * 64 + 32: half * 64 + 64] = (q2 & 0x0F) | ((q4 & 0x0F) << 4)
+        qh[:, half * 32: half * 32 + 32] = (
+            ((q1 >> 4) & 3) | (((q2 >> 4) & 3) << 2)
+            | (((q3 >> 4) & 3) << 4) | (((q4 >> 4) & 3) << 6)
+        )
+    rec["ql"] = ql
+    rec["qh"] = qh
+    return rec.tobytes()
+
+
+QUANTIZE = {
+    GGML_Q4_0: quant_q4_0,
+    GGML_Q5_0: quant_q5_0,
+    GGML_Q4_K: quant_q4_k,
+    GGML_Q5_K: quant_q5_k,
+    GGML_Q6_K: quant_q6_k,
+}

@@ -35,10 +35,19 @@ _SCALAR_FMT = {
     F32: "<f", U64: "<Q", I64: "<q", F64: "<d",
 }
 
-# ggml tensor dtypes we understand
+# ggml tensor dtypes we understand (block formats: gguf/quants.py)
 GGML_F32, GGML_F16 = 0, 1
 GGML_Q8_0 = 8
 Q8_0_BLOCK = 32  # values per Q8_0 quantization block
+
+from dynamo_tpu.gguf import quants as _quants  # noqa: E402
+from dynamo_tpu.gguf.quants import (  # noqa: E402,F401 (re-exported)
+    GGML_Q4_0,
+    GGML_Q4_K,
+    GGML_Q5_0,
+    GGML_Q5_K,
+    GGML_Q6_K,
+)
 
 
 @dataclass(frozen=True)
@@ -66,10 +75,15 @@ class GGUFTensorInfo:
             return self.num_elements * 4
         if self.ggml_type == GGML_F16:
             return self.num_elements * 2
-        if self.ggml_type == GGML_Q8_0:
-            if self.num_elements % Q8_0_BLOCK:
-                raise ValueError(f"{self.name}: Q8_0 needs multiple of 32 elems")
-            return (self.num_elements // Q8_0_BLOCK) * (2 + Q8_0_BLOCK)
+        block = _quants.BLOCK_SIZES.get(self.ggml_type)
+        if block is not None:
+            values, nbytes = block
+            if self.num_elements % values:
+                raise ValueError(
+                    f"{self.name}: type {self.ggml_type} needs a multiple "
+                    f"of {values} elements"
+                )
+            return (self.num_elements // values) * nbytes
         raise ValueError(f"{self.name}: unsupported ggml type {self.ggml_type}")
 
 
@@ -153,12 +167,8 @@ class GGUFReader:
             arr = np.frombuffer(raw, np.float32)
         elif info.ggml_type == GGML_F16:
             arr = np.frombuffer(raw, np.float16)
-        elif info.ggml_type == GGML_Q8_0:
-            blocks = np.frombuffer(
-                raw, np.dtype([("d", np.float16), ("q", np.int8, Q8_0_BLOCK)])
-            )
-            arr = (blocks["d"].astype(np.float32)[:, None]
-                   * blocks["q"].astype(np.float32)).reshape(-1)
+        elif info.ggml_type in _quants.DEQUANT:
+            arr = _quants.DEQUANT[info.ggml_type](raw, info.num_elements)
         else:
             raise ValueError(f"{name}: unsupported ggml type {info.ggml_type}")
         return arr.reshape(info.shape)
@@ -321,18 +331,25 @@ _GGUF_LAYER = {
 }
 
 
-def load_params_from_gguf(cfg, reader: GGUFReader, mesh=None, specs=None):
+def load_params_from_gguf(cfg, reader: GGUFReader, mesh=None, specs=None,
+                          quantize=None):
     """Load GGUF weights into the stacked-layer pytree (same contract as
-    models/loader.py load_params)."""
+    models/loader.py load_params, including ``quantize="int8"``:
+    GGUF-quantized tensors dequantize per layer on the host and
+    re-quantize to the engine's symmetric per-channel int8)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding
 
+    from dynamo_tpu.models import quant
     from dynamo_tpu.models.llama import param_shapes, param_specs
 
     shapes = param_shapes(cfg)
     specs = specs if specs is not None else param_specs(cfg)
     params: dict[str, Any] = {}
+
+    def quantizing(name: str) -> bool:
+        return quantize == "int8" and name in quant.QUANT_AXIS
 
     def put(name: str, arr) -> Any:
         shape, dtype = shapes[name]
@@ -343,15 +360,55 @@ def load_params_from_gguf(cfg, reader: GGUFReader, mesh=None, specs=None):
             arr = jax.device_put(arr, NamedSharding(mesh, specs[name]))
         return arr
 
+    def put_q(name: str, q_np: np.ndarray, s_np: np.ndarray) -> None:
+        shape, _ = shapes[name]
+        if q_np.shape != shape:
+            raise ValueError(f"{name}: expected {shape}, got {q_np.shape}")
+        qa, sa = jnp.asarray(q_np), jnp.asarray(s_np)
+        if mesh is not None:
+            wspec = specs[name]
+            qa = jax.device_put(qa, NamedSharding(mesh, wspec))
+            sa = jax.device_put(
+                sa,
+                NamedSharding(
+                    mesh, quant.scale_spec(wspec, quant.QUANT_AXIS[name])
+                ),
+            )
+        params[name] = qa
+        params[name + quant.SCALE_SUFFIX] = sa
+
     for name, (gname, transpose) in _GGUF_GLOBAL.items():
         if name == "lm_head" and gname not in reader.tensors:
-            params[name] = put(name, params["embed"].T)  # tied embeddings
+            # tied embeddings (quantized: transposed values, same
+            # per-row scales — both reduce over the hidden axis)
+            if quantizing(name):
+                put_q(
+                    name,
+                    np.asarray(params["embed"]).T,
+                    np.asarray(params["embed" + quant.SCALE_SUFFIX]),
+                )
+            else:
+                params[name] = put(name, params["embed"].T)
             continue
         arr = reader.load(gname)
-        params[name] = put(name, arr.T if transpose else arr)
+        arr = arr.T if transpose else arr
+        if quantizing(name):
+            q, s = quant.quantize_array(arr, quant.QUANT_AXIS[name])
+            put_q(name, q, s)
+        else:
+            params[name] = put(name, arr)
 
     for name, (tmpl, transpose) in _GGUF_LAYER.items():
         if name not in shapes:
+            continue
+        if quantizing(name):
+            qs, ss = [], []
+            for i in range(cfg.num_hidden_layers):
+                arr = reader.load(tmpl.format(i=i))
+                q, s = quant.quantize_array(arr.T if transpose else arr, -2)
+                qs.append(q)
+                ss.append(s)
+            put_q(name, np.stack(qs), np.stack(ss))
             continue
         per_layer = []
         for i in range(cfg.num_hidden_layers):
@@ -359,7 +416,7 @@ def load_params_from_gguf(cfg, reader: GGUFReader, mesh=None, specs=None):
             per_layer.append(arr.T if transpose else arr)
         params[name] = put(name, np.stack(per_layer))
 
-    missing = set(shapes) - set(params)
+    missing = set(shapes) - {k for k in params if not quant.is_quantized_name(k)}
     if missing:
         raise ValueError(f"GGUF missing params: {sorted(missing)}")
     return params
@@ -435,6 +492,15 @@ def write_gguf(
             out["d"] = d.astype(np.float16)
             out["q"] = q
             return GGML_Q8_0, out.tobytes()
+        if gt in _quants.QUANTIZE:
+            values = _quants.BLOCK_SIZES[gt][0]
+            if arr.size % values:
+                raise ValueError(
+                    f"{name}: type {gt} needs a multiple of {values} elements"
+                )
+            return gt, _quants.QUANTIZE[gt](arr)
+        if gt is not None and gt not in (GGML_F32, GGML_F16):
+            raise ValueError(f"{name}: cannot quantize to ggml type {gt}")
         if arr.dtype == np.float16:
             return GGML_F16, np.ascontiguousarray(arr).tobytes()
         return GGML_F32, np.ascontiguousarray(arr, np.float32).tobytes()

@@ -149,6 +149,169 @@ def make_radix_tree() -> "RadixTree | NativeRadixTree":
     return RadixTree()
 
 
+class KvIndexerSharded:
+    """Hash-index sharded BY WORKER for scale (reference: indexer.rs
+    KvIndexerSharded:676 — N shard threads, workers assigned to the
+    least-loaded shard on first sight, match queries broadcast to every
+    shard and merged).
+
+    Each shard owns its own tree behind a dedicated thread; events are
+    queued to the owning worker's shard, matches fan out to all shards
+    and the per-worker scores union (worker sets are disjoint across
+    shards). With the native C++ tree, shard queries overlap in real
+    parallelism — ctypes releases the GIL for the match call."""
+
+    def __init__(self, num_shards: int = 4, block_size: int = 16):
+        import queue
+        import threading
+
+        if num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        self.block_size = block_size
+        self.num_shards = num_shards
+        self._assignments: dict[int, int] = {}
+        self._counts = [0] * num_shards
+        self._trees = [make_radix_tree() for _ in range(num_shards)]
+        self._queues: list[queue.Queue] = [queue.Queue() for _ in range(num_shards)]
+        self._threads: list[threading.Thread] = []
+        self._closed = False
+        for i in range(num_shards):
+            t = threading.Thread(
+                target=self._shard_loop, args=(i,),
+                name=f"kv-indexer-shard-{i}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    # -- shard thread ------------------------------------------------------
+    def _shard_loop(self, idx: int) -> None:
+        import queue as queue_mod
+
+        q = self._queues[idx]
+        tree = self._trees[idx]
+        while True:
+            item = q.get()
+            kind = item[0]
+            if kind == "stop":
+                # fail any match that raced the shutdown — its caller
+                # would otherwise block forever on fut.result()
+                while True:
+                    try:
+                        late = q.get_nowait()
+                    except queue_mod.Empty:
+                        return
+                    if late[0] == "match":
+                        late[2].set_exception(
+                            RuntimeError("sharded indexer closed")
+                        )
+            try:
+                if kind == "event":
+                    tree.apply_event(item[1])
+                elif kind == "remove":
+                    tree.remove_worker(item[1])
+                elif kind == "match":
+                    hashes, fut = item[1], item[2]
+                    fut.set_result(tree.find_matches(hashes))
+            except Exception as exc:  # keep the shard alive
+                if kind == "match":
+                    item[2].set_exception(exc)
+                else:
+                    log.exception("shard %d op failed", idx)
+
+    def _shard_for(self, worker_id: int) -> int:
+        shard = self._assignments.get(worker_id)
+        if shard is None:
+            shard = min(range(self.num_shards), key=lambda i: self._counts[i])
+            self._assignments[worker_id] = shard
+            self._counts[shard] += 1
+        return shard
+
+    # -- KvIndexer-compatible API -----------------------------------------
+    def apply(self, event: RouterEvent) -> None:
+        ev_bs = event.event.token_block_size
+        if ev_bs and ev_bs != self.block_size:
+            log.warning(
+                "adopting worker token_block_size=%d (was %d)",
+                ev_bs, self.block_size,
+            )
+            self.block_size = ev_bs
+        self._queues[self._shard_for(event.worker_id)].put(("event", event))
+
+    def remove_worker(self, worker_id: int) -> None:
+        shard = self._assignments.pop(worker_id, None)
+        if shard is not None:
+            self._counts[shard] -= 1
+            self._queues[shard].put(("remove", worker_id))
+
+    def find_matches(self, seq_hashes: list[int]) -> OverlapScores:
+        import concurrent.futures
+
+        if self._closed:
+            raise RuntimeError("sharded indexer closed")
+        futures = []
+        for q in self._queues:
+            fut: concurrent.futures.Future = concurrent.futures.Future()
+            q.put(("match", list(seq_hashes), fut))
+            futures.append(fut)
+        merged: dict[int, int] = {}
+        for fut in futures:
+            # bounded wait: a match that loses the race with
+            # close_threads errors instead of wedging the caller
+            merged.update(fut.result(timeout=60).scores)
+        return OverlapScores(scores=merged, total_blocks=len(list(seq_hashes)))
+
+    def find_matches_for_request(self, token_ids: list[int]) -> OverlapScores:
+        _, seq_hashes = hash_sequence(token_ids, self.block_size)
+        return self.find_matches(seq_hashes)
+
+    def start_consuming(self, subscriber) -> None:
+        async def pump() -> None:
+            try:
+                async for _subject, payload in subscriber:
+                    try:
+                        self.apply(RouterEvent.model_validate(payload))
+                    except Exception:
+                        log.exception("bad router event")
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("kv event subscription died; index is frozen")
+
+        self._task = asyncio.get_running_loop().create_task(pump())
+
+    @property
+    def num_blocks(self) -> int:
+        """Sum of per-shard entries. A hash cached by workers living on
+        different shards counts once per shard (shards are independent
+        trees, matching the reference's sharded design)."""
+        return sum(t.num_blocks for t in self._trees)
+
+    @property
+    def applied_events(self) -> int:
+        return sum(t.applied_events for t in self._trees)
+
+    def close_threads(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for q in self._queues:
+            q.put(("stop",))
+        for t in self._threads:
+            t.join(timeout=5)
+
+    async def close(self) -> None:
+        task = getattr(self, "_task", None)
+        if task is not None:
+            task.cancel()
+        self.close_threads()
+
+    def __del__(self):  # best-effort thread cleanup
+        try:
+            self.close_threads()
+        except Exception:
+            pass
+
+
 class KvIndexer:
     """Event-driven indexer: subscribes to worker KV events and answers
     overlap queries (reference: indexer.rs KvIndexer)."""

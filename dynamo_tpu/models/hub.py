@@ -1,0 +1,77 @@
+"""Model hub resolution: repo-id → local checkpoint directory.
+
+Analogue of the reference's hub download path (reference:
+lib/llm/src/hub.rs:92 from_hf + local_model.rs — resolve a HF repo id,
+download into a cache, serve from the local copy). Downloading is
+OFF by default: serving nodes in zero-egress deployments must not
+dial out, so a repo id only resolves when ``DYN_ALLOW_HUB_DOWNLOAD=1``
+(or ``allow_download=True``). Already-cached models resolve without
+network either way.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger("dynamo_tpu.models.hub")
+
+_WEIGHT_PATTERNS = [
+    "*.safetensors", "*.json", "tokenizer.model", "*.txt",
+]
+
+
+def is_repo_id(path: str) -> bool:
+    """'org/name'-shaped and not an existing local path."""
+    if not path or os.path.exists(path):
+        return False
+    parts = path.split("/")
+    return len(parts) == 2 and all(p and not p.startswith(".") for p in parts)
+
+
+def cache_dir() -> str:
+    return os.environ.get(
+        "DYN_HUB_CACHE",
+        os.path.join(os.path.expanduser("~"), ".dynamo_tpu", "hub"),
+    )
+
+
+def resolve_hub_model(
+    path: str, allow_download: Optional[bool] = None
+) -> str:
+    """repo id or local path → local directory.
+
+    Local paths pass through. Repo ids resolve from the local HF cache
+    when present; a network download happens only when explicitly
+    allowed. Raises with a actionable message otherwise."""
+    if not is_repo_id(path):
+        return path
+    if allow_download is None:
+        allow_download = os.environ.get("DYN_ALLOW_HUB_DOWNLOAD", "") in (
+            "1", "true", "yes",
+        )
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError as exc:
+        raise ValueError(
+            f"{path!r} looks like a hub repo id but huggingface_hub is "
+            "not installed; mount the checkpoint locally instead"
+        ) from exc
+    if not allow_download:
+        # cache-only resolution keeps zero-egress nodes offline
+        try:
+            return snapshot_download(
+                path, local_files_only=True, cache_dir=cache_dir(),
+                allow_patterns=_WEIGHT_PATTERNS,
+            )
+        except Exception as exc:
+            raise ValueError(
+                f"{path!r} is not cached locally and hub downloads are "
+                "disabled; set DYN_ALLOW_HUB_DOWNLOAD=1 to fetch it, or "
+                "mount the checkpoint and pass its path"
+            ) from exc
+    log.info("downloading %s from the hub", path)
+    return snapshot_download(
+        path, cache_dir=cache_dir(), allow_patterns=_WEIGHT_PATTERNS
+    )

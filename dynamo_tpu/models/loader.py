@@ -76,6 +76,7 @@ def resolve_model(
     seed: int = 0,
     mesh: Optional[Mesh] = None,
     specs_fn: Optional[Any] = None,
+    quantize: Optional[str] = None,
 ):
     """Single entry for model bring-up: (ModelConfig, Params) from a
     single-file GGUF, an HF-format directory, or random init. The one
@@ -83,9 +84,17 @@ def resolve_model(
     sequence-parallel prefill worker both go through here. ``specs_fn``
     maps the resolved ModelConfig to PartitionSpec overrides (e.g.
     pp-sharded layer stacks) and may validate/raise before any weight
-    loads."""
+    loads. ``quantize="int8"`` applies weight-only int8 at load
+    (models/quant.py) regardless of source."""
     from dynamo_tpu.models.llama import init_params
 
+    if quantize not in (None, "int8"):
+        raise ValueError(f"unsupported quantization {quantize!r}")
+    if model_path and not random_weights:
+        # repo-id paths resolve through the (gated) hub cache
+        from dynamo_tpu.models.hub import resolve_hub_model
+
+        model_path = resolve_hub_model(model_path)
     is_gguf = bool(model_path) and model_path.endswith(".gguf")
     reader = None
     try:
@@ -107,9 +116,20 @@ def resolve_model(
         if not random_weights and reader is not None:
             from dynamo_tpu.gguf import load_params_from_gguf
 
-            params = load_params_from_gguf(model_config, reader, mesh, specs)
+            params = load_params_from_gguf(
+                model_config, reader, mesh, specs, quantize=quantize
+            )
         elif not random_weights and model_path and has_weights(model_path):
-            params = load_params(model_config, model_path, mesh, specs)
+            params = load_params(
+                model_config, model_path, mesh, specs, quantize=quantize
+            )
+        elif quantize == "int8":
+            # host-side quantized random init: the bf16 pytree must
+            # never materialize on device (8B bf16 > one 16 GB chip)
+            log.warning("initializing RANDOM int8 weights (no checkpoint)")
+            from dynamo_tpu.models.quant import init_params_quantized
+
+            params = init_params_quantized(model_config, seed, mesh, specs)
         else:
             log.warning("initializing RANDOM weights (no checkpoint found)")
             params = init_params(model_config, seed, mesh, specs)
@@ -166,15 +186,23 @@ def _to_jax(arr: np.ndarray, dtype) -> jnp.ndarray:
 
 def load_params(
     cfg: ModelConfig, model_dir: str, mesh: Optional[Mesh] = None,
-    specs: Optional[dict] = None,
+    specs: Optional[dict] = None, quantize: Optional[str] = None,
 ) -> Params:
     """Load and stack weights; device_put with shardings as we go so the
     full f32 copy never materializes on one device. ``specs`` overrides
-    the default TP PartitionSpecs (e.g. pp-sharded layer stacks)."""
+    the default TP PartitionSpecs (e.g. pp-sharded layer stacks).
+    ``quantize="int8"`` quantizes matmul weights per layer ON THE HOST
+    (models/quant.py) so the device only ever holds int8 + scales — the
+    real 8B flagship fits one 16 GB chip this way."""
+    from dynamo_tpu.models import quant
+
     ckpt = _ShardedCheckpoint(model_dir)
     shapes = param_shapes(cfg)
     specs = specs if specs is not None else param_specs(cfg)
     params: Params = {}
+
+    def quantizing(name: str) -> bool:
+        return quantize == "int8" and name in quant.QUANT_AXIS
 
     def put(name: str, arr: jnp.ndarray) -> jnp.ndarray:
         shape, dtype = shapes[name]
@@ -185,11 +213,45 @@ def load_params(
             arr = jax.device_put(arr, NamedSharding(mesh, specs[name]))
         return arr
 
+    def put_q(name: str, q_np: np.ndarray, s_np: np.ndarray) -> None:
+        shape, _ = shapes[name]
+        if q_np.shape != shape:
+            raise ValueError(f"{name}: expected {shape}, got {q_np.shape}")
+        qa, sa = jnp.asarray(q_np), jnp.asarray(s_np)
+        if mesh is not None:
+            wspec = specs[name]
+            qa = jax.device_put(qa, NamedSharding(mesh, wspec))
+            sa = jax.device_put(
+                sa,
+                NamedSharding(
+                    mesh, quant.scale_spec(wspec, quant.QUANT_AXIS[name])
+                ),
+            )
+        params[name] = qa
+        params[name + quant.SCALE_SUFFIX] = sa
+
+    def host_f32(hf_name: str, transpose: bool) -> np.ndarray:
+        arr = quant.np_to_f32(ckpt.get(hf_name))
+        return arr.T if transpose else arr
+
     for name, (hf_name, transpose) in _GLOBAL_MAP.items():
         if name == "lm_head" and hf_name not in ckpt.names():
-            # tied embeddings
-            arr = params["embed"].T
-            params[name] = put(name, arr)
+            # tied embeddings. Quantized: lm_head = embed.T per-row
+            # scales == embed's per-row scales (both reduce over D)
+            if quantizing(name):
+                put_q(
+                    name,
+                    np.asarray(params["embed"]).T,
+                    np.asarray(params["embed" + quant.SCALE_SUFFIX]),
+                )
+            else:
+                params[name] = put(name, params["embed"].T)
+            continue
+        if quantizing(name):
+            q, s = quant.quantize_array(
+                host_f32(hf_name, transpose), quant.QUANT_AXIS[name]
+            )
+            put_q(name, q, s)
             continue
         arr = _to_jax(ckpt.get(hf_name), shapes[name][1])
         if transpose:
@@ -200,6 +262,30 @@ def load_params(
     layer_map = _MOE_LAYER_MAP if cfg.is_moe else _LAYER_MAP
     for name, (tmpl, transpose) in layer_map.items():
         if name not in shapes:
+            continue
+        if quantizing(name):
+            # per-layer host quantization == quantizing the stacked
+            # tensor (scales reduce only the contraction axis), with
+            # peak host memory of one layer's f32 copy
+            qs, ss = [], []
+            for i in range(L):
+                if "{e}" in tmpl:
+                    eq, es = [], []
+                    for e in range(cfg.num_local_experts):
+                        q, s = quant.quantize_array(
+                            host_f32(tmpl.format(i=i, e=e), transpose), -2
+                        )
+                        eq.append(q)
+                        es.append(s)
+                    qs.append(np.stack(eq))
+                    ss.append(np.stack(es))
+                else:
+                    q, s = quant.quantize_array(
+                        host_f32(tmpl.format(i=i), transpose), -2
+                    )
+                    qs.append(q)
+                    ss.append(s)
+            put_q(name, np.stack(qs), np.stack(ss))
             continue
         per_layer = []
         for i in range(L):
@@ -214,7 +300,7 @@ def load_params(
                 arr = _to_jax(ckpt.get(tmpl.format(i=i)), shapes[name][1])
                 per_layer.append(arr.T if transpose else arr)
         params[name] = put(name, jnp.stack(per_layer))
-    missing = set(shapes) - set(params)
+    missing = set(shapes) - {k for k in params if not quant.is_quantized_name(k)}
     if missing:
         raise ValueError(
             f"checkpoint {model_dir} missing params: {sorted(missing)}"

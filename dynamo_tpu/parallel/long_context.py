@@ -39,8 +39,10 @@ from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.models.llama import (
     Params,
     _moe_mlp,
+    embed_lookup,
     layer_param_names,
     mlp_act,
+    mm,
     rmsnorm,
     rope,
     scale_embed,
@@ -76,13 +78,13 @@ def long_prefill(
     attend = ring_attention if attn == "ring" else ulysses_attention
     positions = jnp.arange(T, dtype=jnp.int32)[None, :]
 
-    x = scale_embed(cfg, jnp.take(params["embed"], tokens, axis=0))  # [1, T, D]
+    x = scale_embed(cfg, embed_lookup(params, tokens))  # [1, T, D]
 
     def layer_fn(x, lp):
         h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
-        q = h @ lp["wq"]
-        k = h @ lp["wk"]
-        v = h @ lp["wv"]
+        q = mm(lp, "wq", h)
+        k = mm(lp, "wk", h)
+        v = mm(lp, "wv", h)
         if cfg.attention_bias:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         q = q.reshape(B, T, H, Dh)
@@ -90,12 +92,14 @@ def long_prefill(
         v = v.reshape(B, T, Hk, Dh)
         q, k = rope(q, k, positions, cfg.rope_theta)
         a = attend(q, k, v, mesh)
-        x = x + (a.reshape(B, T, H * Dh) @ lp["wo"]).astype(x.dtype)
+        x = x + mm(lp, "wo", a.reshape(B, T, H * Dh)).astype(x.dtype)
         h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
         if cfg.is_moe:
             x = x + _moe_mlp(cfg, lp, h).astype(x.dtype)
         else:
-            mlp = (mlp_act(cfg, h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+            mlp = mm(
+                lp, "w_down", mlp_act(cfg, mm(lp, "w_gate", h)) * mm(lp, "w_up", h)
+            )
             x = x + mlp.astype(x.dtype)
         return x, (k, v)
 
@@ -105,7 +109,7 @@ def long_prefill(
     if last_idx is None:
         last_idx = jnp.asarray(T - 1, jnp.int32)
     x_last = jax.lax.dynamic_index_in_dim(x, last_idx, axis=1, keepdims=False)
-    logits = (x_last @ params["lm_head"]).astype(jnp.float32)
+    logits = mm(params, "lm_head", x_last).astype(jnp.float32)
     # [L, 1, T, Hk, Dh] -> [L, T, Hk, Dh]
     return logits, ks[:, 0], vs[:, 0]
 

@@ -1,0 +1,175 @@
+"""Multi-host serving: leader→follower step broadcast.
+
+The reference brings up multi-node engines with a leader that owns
+scheduling and followers that execute the same device program
+(reference: lib/llm/src/engines.rs:41-58 MultiNodeConfig — SGLang-style
+leader_addr/node_rank bring-up). The JAX equivalent: every process
+holds its shard of the globally-sharded params/KV cache, and every
+process must enter the SAME jitted step with the SAME host inputs for
+the collectives to line up.
+
+Node rank 0 (the leader) runs the scheduler, batching, detokenization
+and serving planes exactly as single-host. Before each device dispatch
+it broadcasts (a) a fixed-size control vector describing the step kind
+and array geometry, then (b) the host input arrays themselves — both
+via ``multihost_utils.broadcast_one_to_all``, which rides the same
+ICI/DCN fabric as the model collectives (no extra sockets, no second
+cluster plane). Followers loop: receive control, allocate
+matching-shape buffers, receive arrays, enter the identical jit. A STOP
+control exits the loop at shutdown.
+
+Why not broadcast through the coordinator/store? Step inputs are on the
+critical path (every decode window); the store is a control plane. The
+reference makes the same split: NATS for control, direct links for data.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+# control vector layout (int32[8]):
+# [kind, B, T, table_width, flags, reserved, reserved, reserved]
+CTRL_LEN = 8
+KIND_STOP = 0
+KIND_STEP = 1  # single fused step (prefill or 1-token decode)
+KIND_MULTI_STEP = 2  # fused K-step decode window
+
+
+class StepBroadcaster:
+    """Leader side: announce each device step to the followers."""
+
+    def __init__(self) -> None:
+        from jax.experimental import multihost_utils
+
+        self._bcast = multihost_utils.broadcast_one_to_all
+
+    def _ctrl(self, kind: int, b: int = 0, t: int = 0, w: int = 0) -> None:
+        ctrl = np.zeros((CTRL_LEN,), np.int32)
+        ctrl[:4] = (kind, b, t, w)
+        self._bcast(ctrl)
+
+    def announce_step(self, arrays: dict, sampling) -> None:
+        b, t = arrays["tokens"].shape
+        w = arrays["block_tables"].shape[1]
+        self._ctrl(KIND_STEP, b, t, w)
+        self._bcast(_step_tuple(arrays, sampling))
+
+    def announce_multi_step(self, arrays: dict, sampling) -> None:
+        b = arrays["tokens"].shape[0]
+        w = arrays["block_tables"].shape[1]
+        self._ctrl(KIND_MULTI_STEP, b, 1, w)
+        self._bcast(_multi_step_tuple(arrays, sampling))
+
+    def announce_stop(self) -> None:
+        self._ctrl(KIND_STOP)
+
+
+def _step_tuple(arrays: dict, sampling) -> tuple:
+    return (
+        np.asarray(arrays["tokens"], np.int32),
+        np.asarray(arrays["positions"], np.int32),
+        np.asarray(arrays["slot_mapping"], np.int32),
+        np.asarray(arrays["block_tables"], np.int32),
+        np.asarray(arrays["context_lens"], np.int32),
+        np.asarray(arrays["last_token_idx"], np.int32),
+        np.asarray(sampling.temperature, np.float32),
+        np.asarray(sampling.top_k, np.int32),
+        np.asarray(sampling.top_p, np.float32),
+        np.asarray(sampling.seeds, np.uint32),
+    )
+
+
+def _multi_step_tuple(arrays: dict, sampling) -> tuple:
+    return (
+        np.asarray(arrays["tokens"], np.int32),
+        np.asarray(arrays["positions"], np.int32),
+        np.asarray(arrays["block_tables"], np.int32),
+        np.asarray(arrays["context_lens"], np.int32),
+        np.asarray(arrays["valid_steps"], np.int32),
+        np.asarray(sampling.temperature, np.float32),
+        np.asarray(sampling.top_k, np.int32),
+        np.asarray(sampling.top_p, np.float32),
+        np.asarray(sampling.seeds, np.uint32),
+    )
+
+
+def _zeros_step(b: int, t: int, w: int) -> tuple:
+    return (
+        np.zeros((b, t), np.int32),
+        np.zeros((b, t), np.int32),
+        np.zeros((b * t,), np.int32),
+        np.zeros((b, w), np.int32),
+        np.zeros((b,), np.int32),
+        np.zeros((b,), np.int32),
+        np.zeros((b,), np.float32),
+        np.zeros((b,), np.int32),
+        np.zeros((b,), np.float32),
+        np.zeros((b,), np.uint32),
+    )
+
+
+def _zeros_multi_step(b: int, w: int) -> tuple:
+    return (
+        np.zeros((b, 1), np.int32),
+        np.zeros((b, 1), np.int32),
+        np.zeros((b, w), np.int32),
+        np.zeros((b,), np.int32),
+        np.zeros((b,), np.int32),
+        np.zeros((b,), np.float32),
+        np.zeros((b,), np.int32),
+        np.zeros((b,), np.float32),
+        np.zeros((b,), np.uint32),
+    )
+
+
+class StepFollower:
+    """Follower side: mirror the leader's device dispatches until STOP.
+
+    ``step_fn``/``multi_step_fn`` are the engine's jitted functions;
+    ``get_state``/``set_state`` read and write the (params, k_cache,
+    v_cache) triple so donated caches stay threaded between steps.
+    """
+
+    def __init__(self, engine) -> None:
+        from jax.experimental import multihost_utils
+
+        self._bcast = multihost_utils.broadcast_one_to_all
+        self.engine = engine
+
+    def run(self) -> None:
+        e = self.engine
+        while True:
+            ctrl = np.asarray(self._bcast(np.zeros((CTRL_LEN,), np.int32)))
+            kind, b, t, w = (int(x) for x in ctrl[:4])
+            if kind == KIND_STOP:
+                return
+            if kind == KIND_STEP:
+                args = self._bcast(_zeros_step(b, t, w))
+                (tokens, positions, slots, tables, ctx, last,
+                 temp, tk, tp, seeds) = args
+                _, _, e.k_cache, e.v_cache = e._step_fn(
+                    e.params, e.k_cache, e.v_cache, tokens, positions,
+                    slots, tables, ctx, last, temp, tk, tp, seeds,
+                )
+            elif kind == KIND_MULTI_STEP:
+                args = self._bcast(_zeros_multi_step(b, w))
+                (tokens, positions, tables, ctx, valid,
+                 temp, tk, tp, seeds) = args
+                _, _, e.k_cache, e.v_cache = e._multi_step_fn(
+                    e.params, e.k_cache, e.v_cache, tokens, positions,
+                    tables, ctx, valid, temp, tk, tp, seeds,
+                )
+            else:
+                raise RuntimeError(f"unknown multihost step kind {kind}")
+
+
+def host_value(arr) -> np.ndarray:
+    """Device array -> host numpy, robust to multi-host replication:
+    np.asarray refuses non-fully-addressable arrays, but every process
+    holds a complete copy of replicated outputs in its local shard."""
+    try:
+        return np.asarray(arr)
+    except Exception:
+        return np.asarray(arr.addressable_data(0))

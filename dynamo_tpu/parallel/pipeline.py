@@ -34,7 +34,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.models.llama import (
     Params,
+    embed_lookup,
     layer_param_names,
+    mm,
     make_layer_fn,
     param_specs,
     rmsnorm,
@@ -100,7 +102,7 @@ def forward_pp(
         raise ValueError(f"batch {B} not divisible by {M} microbatches")
     Bm = B // M
 
-    x = scale_embed(cfg, jnp.take(params["embed"], tokens, axis=0))  # [B, T, D]
+    x = scale_embed(cfg, embed_lookup(params, tokens))  # [B, T, D]
     D = x.shape[-1]
 
     # microbatch views
@@ -112,9 +114,18 @@ def forward_pp(
     last_mb = last_token_idx.reshape(M, Bm)
 
     lp = {k: params[k] for k in layer_param_names(params)}
-    lp_specs = {
-        k: _pp_only(v) for k, v in pp_param_specs(cfg).items() if k in lp
-    }
+    base_pp = pp_param_specs(cfg)
+
+    def _lp_spec(k: str) -> P:
+        if k.endswith("_scale"):
+            # int8 scales: the weight's pp spec with the contraction
+            # axis (-2) dropped (models/quant.py scale_spec)
+            from dynamo_tpu.models.quant import scale_spec
+
+            return _pp_only(scale_spec(base_pp[k[: -len("_scale")]], -2))
+        return _pp_only(base_pp[k])
+
+    lp_specs = {k: _lp_spec(k) for k in lp}
 
     def stage(lp_local, kc, vc, x_mb, pos_mb, slots_mb, tables_mb, ctx_mb,
               last_mb):
@@ -187,5 +198,5 @@ def forward_pp(
 
     x_last = outs.reshape(B, D)
     x_last = rmsnorm(x_last, params["final_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
-    logits = (x_last @ params["lm_head"]).astype(jnp.float32)
+    logits = mm(params, "lm_head", x_last).astype(jnp.float32)
     return logits, new_k, new_v

@@ -1,8 +1,20 @@
-"""Logging setup: console or JSONL structured logs.
+"""Logging setup: env-filtered console/JSONL structured logs.
 
 Analogue of the reference's tracing-subscriber init
-(reference: lib/runtime/src/logging.rs:20-344 — env-filter levels,
-DYN_LOGGING_JSONL structured output).
+(reference: lib/runtime/src/logging.rs:20-344):
+
+- ``DYN_LOG_LEVEL`` accepts an env-filter string — a default level plus
+  per-target overrides, e.g. ``info,dynamo_tpu.engine=debug,aiohttp=warning``
+  (same shape as Rust's ``RUST_LOG``/EnvFilter the reference uses).
+- ``DYN_LOGGING_JSONL=1`` switches to one-JSON-object-per-line output.
+- ``DYN_LOGGING_CONFIG_PATH`` points at a TOML or JSON config file with
+  keys ``level``, ``jsonl``, ``file``, ``local_tz`` (reference:
+  logging.rs TOML config via the same env var).
+- ``DYN_LOG_FILE`` appends to a file instead of stderr.
+- ``DYN_LOGGING_LOCAL_TZ=1`` stamps local time instead of UTC
+  (reference: logging.rs use_local_tz).
+
+Precedence: explicit args > env vars > config file > defaults.
 """
 
 from __future__ import annotations
@@ -12,13 +24,46 @@ import logging
 import os
 import sys
 import time
+from typing import Any, Optional
+
+
+def parse_env_filter(spec: str) -> tuple[int, dict[str, int]]:
+    """``"info,dynamo_tpu.engine=debug"`` -> (default level, per-target
+    overrides). Unknown level names fall back to INFO."""
+
+    def lvl(name: str) -> int:
+        return getattr(logging, name.strip().upper(), logging.INFO)
+
+    default = logging.INFO
+    targets: dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            target, _, name = part.partition("=")
+            targets[target.strip()] = lvl(name)
+        else:
+            default = lvl(part)
+    return default, targets
 
 
 class JsonlFormatter(logging.Formatter):
+    def __init__(self, local_tz: bool = False):
+        super().__init__()
+        self.local_tz = local_tz
+
     def format(self, record: logging.LogRecord) -> str:
+        if self.local_tz:
+            stamp = time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.localtime(record.created)
+            ) + f".{int(record.msecs):03d}"
+        else:
+            stamp = time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)
+            ) + f".{int(record.msecs):03d}Z"
         out = {
-            "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created))
-            + f".{int(record.msecs):03d}Z",
+            "ts": stamp,
             "level": record.levelname,
             "target": record.name,
             "message": record.getMessage(),
@@ -28,13 +73,56 @@ class JsonlFormatter(logging.Formatter):
         return json.dumps(out)
 
 
-def init_logging(level: str | None = None, jsonl: bool | None = None) -> None:
-    level = level or os.environ.get("DYN_LOG_LEVEL", "INFO")
+def _load_config_file(path: str) -> dict[str, Any]:
+    try:
+        if path.endswith(".toml"):
+            import tomllib
+
+            with open(path, "rb") as f:
+                return tomllib.load(f)
+        with open(path) as f:
+            return json.load(f)
+    except Exception as e:  # bad config must not take the process down
+        print(f"dynamo-tpu: bad logging config {path}: {e}", file=sys.stderr)
+        return {}
+
+
+def init_logging(
+    level: Optional[str] = None,
+    jsonl: Optional[bool] = None,
+    log_file: Optional[str] = None,
+    local_tz: Optional[bool] = None,
+) -> None:
+    cfg: dict[str, Any] = {}
+    cfg_path = os.environ.get("DYN_LOGGING_CONFIG_PATH")
+    if cfg_path:
+        cfg = _load_config_file(cfg_path)
+
+    def env_bool(name: str) -> Optional[bool]:
+        v = os.environ.get(name)
+        if v is None:
+            return None
+        return v.lower() in ("1", "true", "yes")
+
+    level = level or os.environ.get("DYN_LOG_LEVEL") or cfg.get("level") or "INFO"
     if jsonl is None:
-        jsonl = os.environ.get("DYN_LOGGING_JSONL", "").lower() in ("1", "true")
-    handler = logging.StreamHandler(sys.stderr)
+        jsonl = env_bool("DYN_LOGGING_JSONL")
+    if jsonl is None:
+        jsonl = bool(cfg.get("jsonl", False))
+    if log_file is None:
+        log_file = os.environ.get("DYN_LOG_FILE") or cfg.get("file")
+    if local_tz is None:
+        local_tz = env_bool("DYN_LOGGING_LOCAL_TZ")
+    if local_tz is None:
+        local_tz = bool(cfg.get("local_tz", False))
+
+    handler: logging.Handler
+    if log_file:
+        handler = logging.FileHandler(log_file)
+    else:
+        handler = logging.StreamHandler(sys.stderr)
     if jsonl:
-        handler.setFormatter(JsonlFormatter())
+        handler.setFormatter(JsonlFormatter(local_tz=local_tz))
     else:
         handler.setFormatter(
             logging.Formatter(
@@ -42,6 +130,18 @@ def init_logging(level: str | None = None, jsonl: bool | None = None) -> None:
                 datefmt="%H:%M:%S",
             )
         )
+    default, targets = parse_env_filter(str(level))
     root = logging.getLogger()
     root.handlers[:] = [handler]
-    root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    root.setLevel(default)
+    # reset overrides from a previous init_logging: a re-init with a
+    # plainer filter must not leave stale per-target levels pinned
+    global _overridden_targets
+    for stale in _overridden_targets - set(targets):
+        logging.getLogger(stale).setLevel(logging.NOTSET)
+    for target, lv in targets.items():
+        logging.getLogger(target).setLevel(lv)
+    _overridden_targets = set(targets)
+
+
+_overridden_targets: set = set()

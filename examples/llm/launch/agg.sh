@@ -1,0 +1,18 @@
+#!/bin/sh
+# Aggregated serving on one host, real processes (the CLI matrix the
+# reference drives via `dynamo serve`; reference:
+# examples/llm/benchmarks/README.md "aggregated baseline").
+set -e
+MODEL=${MODEL_PATH:?set MODEL_PATH to an HF dir or .gguf}
+
+python -m dynamo_tpu.cli.main store --port 4222 &
+STORE=$!
+trap 'kill $STORE' EXIT
+
+# N identical workers behind the round-robin frontend
+python -m dynamo_tpu.cli.main run \
+    --in dyn://dynamo.backend.generate --out jax \
+    --model-path "$MODEL" --quantization int8 &
+
+python -m dynamo_tpu.cli.main run --in http --out auto \
+    --router-mode round_robin --http-port 8000

@@ -1,0 +1,26 @@
+#!/bin/sh
+# Disaggregated prefill/decode with KV-aware routing on one host
+# (reference: examples/llm flagship path; our e2e:
+# tests/test_cli_disagg_e2e.py runs exactly this wiring).
+set -e
+MODEL=${MODEL_PATH:?set MODEL_PATH to an HF dir or .gguf}
+
+python -m dynamo_tpu.cli.main store --port 4222 &
+STORE=$!
+trap 'kill $STORE' EXIT
+
+# decode worker with disaggregation enabled: prompts longer than
+# --max-local-prefill-length go to the prefill queue
+python -m dynamo_tpu.cli.main run \
+    --in dyn://dynamo.backend.generate --out jax \
+    --model-path "$MODEL" --quantization int8 \
+    --disagg --max-local-prefill-length 512 &
+
+# dedicated prefill worker consuming the queue, KV pushed to decode
+python -m dynamo_tpu.cli.main run \
+    --role prefill --out jax \
+    --model-path "$MODEL" &
+
+# KV-aware frontend
+python -m dynamo_tpu.cli.main run --in http --out auto \
+    --router-mode kv --http-port 8000

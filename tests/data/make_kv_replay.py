@@ -1,0 +1,114 @@
+"""Generate the committed KV-event replay corpus + golden expectations
+(reference test strategy: lib/llm/tests/data/replays/ — recorded event
+streams drive router regression tests without live workers).
+
+Deterministic: 6 workers serving 40 simulated prompts drawn from a
+small set of shared system-prompt prefixes (so real cross-worker
+overlap exists), with periodic evictions and one worker clear.
+
+    python tests/data/make_kv_replay.py   # rewrites the corpus + golden
+"""
+
+import json
+import os
+import random
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT_DIR = os.path.join(HERE, "replays")
+CORPUS = os.path.join(OUT_DIR, "kv_events.jsonl")
+GOLDEN = os.path.join(OUT_DIR, "kv_events.golden.json")
+BLOCK = 16
+
+
+def main() -> None:
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(HERE)))
+    from dynamo_tpu.tokens import hash_sequence
+
+    rng = random.Random(0xC0FFEE)
+    prefixes = [
+        [100 + i for i in range(BLOCK * 4)],   # long shared system prompt
+        [500 + i for i in range(BLOCK * 2)],   # short one
+        [900 + i for i in range(BLOCK)],       # single block
+    ]
+    workers = [2**48 + w for w in range(6)]
+    events = []
+    eid = {w: 0 for w in workers}
+    stored: dict[int, list[list[int]]] = {w: [] for w in workers}
+
+    def emit(worker: int, op: str, hashes: list[int]) -> None:
+        eid[worker] += 1
+        events.append({
+            "ts": 0.0,
+            "event": {
+                "worker_id": worker,
+                "event_id": eid[worker],
+                "event": {
+                    "op": op,
+                    "block_hashes": hashes,
+                    "token_block_size": BLOCK,
+                },
+            },
+        })
+
+    prompts = []
+    for i in range(40):
+        prefix = prefixes[rng.randrange(len(prefixes))]
+        tail_len = BLOCK * rng.randrange(1, 5)
+        tail = [10_000 + i * 1000 + t for t in range(tail_len)]
+        prompts.append(prefix + tail)
+
+    for i, prompt in enumerate(prompts):
+        w = workers[rng.randrange(len(workers))]
+        _, hashes = hash_sequence(prompt, BLOCK)
+        emit(w, "stored", hashes)
+        stored[w].append(hashes)
+        # periodic eviction: some worker drops the TAIL of an old seq
+        if i % 7 == 6:
+            victim = workers[rng.randrange(len(workers))]
+            if stored[victim]:
+                seq = stored[victim][rng.randrange(len(stored[victim]))]
+                drop = seq[len(seq) // 2:]
+                if drop:
+                    emit(victim, "removed", drop)
+                    del seq[len(seq) // 2:]
+    # one worker restarts mid-stream
+    emit(workers[3], "cleared", [])
+    stored[workers[3]].clear()
+
+    os.makedirs(OUT_DIR, exist_ok=True)
+    with open(CORPUS, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+    # golden: overlap scores for probe prompts after full replay
+    from dynamo_tpu.kv_router.indexer import RadixTree
+    from dynamo_tpu.kv_router.protocols import RouterEvent
+
+    tree = RadixTree()
+    for e in events:
+        tree.apply_event(RouterEvent.model_validate(e["event"]))
+    probes = {
+        "long_prefix_plus_new_tail": prefixes[0] + [77] * BLOCK,
+        "short_prefix": prefixes[1],
+        "exact_prompt_0": prompts[0],
+        "no_overlap": [31337 + i for i in range(BLOCK * 3)],
+    }
+    golden = {"num_blocks": tree.num_blocks, "queries": {}}
+    for name, toks in probes.items():
+        _, hashes = hash_sequence(toks, BLOCK)
+        scores = tree.find_matches(hashes)
+        golden["queries"][name] = {
+            "tokens": toks,
+            "scores": {str(k): v for k, v in scores.scores.items()},
+            "total_blocks": scores.total_blocks,
+        }
+    with open(GOLDEN, "w") as f:
+        json.dump(golden, f, indent=1)
+    print(f"wrote {len(events)} events, {tree.num_blocks} blocks, "
+          f"{len(probes)} golden queries")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Two-process multihost engine worker (driven by test_multihost.py).
+
+Each process: jax.distributed over a localhost coordinator, 1 local CPU
+device, global mesh tp=2 spanning both processes. Rank 0 leads (serves a
+request); rank 1 follows (mirrors device steps). Prints RESULT <json> on
+rank 0.
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+# 1 local CPU device per process BEFORE jax import
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    .replace("--xla_force_host_platform_device_count=8", "")
+    + " --xla_force_host_platform_device_count=1"
+).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def main(rank: int, coord: str) -> None:
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    mc = ModelConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128,
+    )
+    engine = await JaxEngine.launch(
+        EngineConfig(
+            model_path="", model_name="mh", random_weights=True,
+            num_blocks=32, block_size=8, max_batch_size=4,
+            tensor_parallel_size=2, decode_steps=2,
+            num_nodes=2, node_rank=rank, leader_addr=coord,
+            kv_cache_dtype="float32",
+        ),
+        model_config=mc,
+    )
+    try:
+        if rank == 0:
+            req = PreprocessedRequest(
+                request_id="mh-0", token_ids=list(range(1, 20)),
+                sampling=SamplingOptions(use_greedy=True),
+                stop=StopConditions(max_tokens=6, ignore_eos=True),
+            )
+            toks = []
+            async for out in engine.as_async_engine().generate(req, Context()):
+                toks.extend(out.token_ids)
+            print("RESULT " + json.dumps({"tokens": toks}), flush=True)
+        else:
+            # follower: the engine thread runs the mirror loop; wait for
+            # it to exit on the leader's STOP broadcast
+            while engine._running:
+                await asyncio.sleep(0.1)
+    finally:
+        await engine.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main(int(sys.argv[1]), sys.argv[2]))

@@ -1,0 +1,112 @@
+"""Standalone KV router service e2e (reference: components/router/
+src/main.rs — a shared KvRouter served over an endpoint that multiple
+frontends consult): coordinator + two jax workers + the router service,
+all real CLI subprocesses. Exercises both endpoints: ``schedule``
+(decision-only) and ``generate`` (full proxy)."""
+
+import asyncio
+import time
+
+from cli_harness import MODEL_DIR, CliFleet, free_port
+
+
+def test_standalone_router_service():
+    store_port = free_port()
+    fleet = CliFleet()
+    try:
+        fleet.spawn("store", "--host", "127.0.0.1", "--port", str(store_port))
+        time.sleep(2)
+        common = ["--store-host", "127.0.0.1", "--store-port", str(store_port)]
+        for _ in range(2):
+            fleet.spawn(
+                "run", "--in", "dyn://rsvc.backend.generate", "--out", "jax",
+                "--model-path", MODEL_DIR, *common,
+            )
+        fleet.spawn(
+            "router", "--namespace", "rsvc", "--component", "backend",
+            "--block-size", "16", *common,
+        )
+
+        async def drive() -> None:
+            from dynamo_tpu.protocols.common import (
+                PreprocessedRequest,
+                SamplingOptions,
+                StopConditions,
+            )
+            from dynamo_tpu.runtime.config import RuntimeConfig
+            from dynamo_tpu.runtime.engine import Context, collect
+            from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+            from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+            drt = await DistributedRuntime.create(config=RuntimeConfig(
+                store_host="127.0.0.1", store_port=store_port,
+                worker_host="127.0.0.1",
+            ))
+            try:
+                ns = drt.namespace("rsvc")
+                sched_client = await (
+                    ns.component("kv_aware_router").endpoint("schedule").client()
+                )
+                await sched_client.wait_for_instances(60)
+                gen_client = await (
+                    ns.component("kv_aware_router").endpoint("generate").client()
+                )
+                await gen_client.wait_for_instances(60)
+
+                # wait until the router sees both workers (engine jit
+                # compile delays registration, minutes under CI load)
+                backend = await (
+                    ns.component("backend").endpoint("generate").client()
+                )
+                await backend.wait_for_instances(180)
+                deadline = time.monotonic() + 180
+                while (
+                    len(backend.instance_ids()) < 2
+                    and time.monotonic() < deadline
+                ):
+                    await asyncio.sleep(0.5)
+                assert len(backend.instance_ids()) == 2
+
+                router = PushRouter(sched_client, RouterMode.ROUND_ROBIN)
+                prompt = list(range(3, 60))
+                # decision endpoint: a valid live worker id
+                items = await collect(
+                    router.generate({"token_ids": prompt}, Context())
+                )
+                assert len(items) == 1
+                first = items[0]
+                assert first["worker_id"] in backend.instance_ids()
+                assert first["total_blocks"] >= 3
+
+                # proxy endpoint: a full generation streams through
+                gen_router = PushRouter(gen_client, RouterMode.ROUND_ROBIN)
+                req = PreprocessedRequest(
+                    request_id="r1", token_ids=prompt,
+                    sampling=SamplingOptions(use_greedy=True),
+                    stop=StopConditions(max_tokens=5, ignore_eos=True),
+                )
+                out = await collect(gen_router.generate(req, Context()))
+                toks = [t for item in out for t in (item["token_ids"] or [])]
+                assert len(toks) == 5
+
+                # after the proxied generation cached the prefix, the
+                # decision for the same prompt sticks to that worker
+                # with a positive hit rate
+                deadline = time.monotonic() + 30
+                hit = 0.0
+                while time.monotonic() < deadline:
+                    items = await collect(
+                        router.generate({"token_ids": prompt}, Context())
+                    )
+                    hit = items[0]["prefix_hit_rate"]
+                    if hit > 0:
+                        break
+                    await asyncio.sleep(1)
+                assert hit > 0, "router index never saw the cached blocks"
+            finally:
+                await drt.shutdown()
+
+        asyncio.run(drive())
+        fleet.assert_alive()
+    finally:
+        fleet.teardown()

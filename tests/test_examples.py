@@ -92,3 +92,59 @@ async def test_llm_graph_generates():
         for drt in drts:
             await drt.shutdown()
         await server.stop()
+
+
+def test_example_configs_generate_valid_manifests():
+    """Every checked-in example config must parse as a
+    GraphDeploymentSpec and render validating K8s manifests — configs
+    stay wired to the deploy machinery, not dead YAML."""
+    import glob
+    import os
+
+    from dynamo_tpu.deploy import GraphDeploymentSpec
+    from dynamo_tpu.deploy.manifests import graph_manifests, validate_k8s_doc
+
+    cfg_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "llm", "configs",
+    )
+    paths = sorted(glob.glob(os.path.join(cfg_dir, "*.yaml")))
+    assert len(paths) >= 4, paths
+    names = set()
+    for path in paths:
+        spec = GraphDeploymentSpec.from_yaml_file(path)
+        names.add(spec.name)
+        for doc in graph_manifests(spec, image="example/dyn:test"):
+            validate_k8s_doc(doc)
+    assert {"llm-agg", "llm-disagg", "llm-disagg-multinode", "vlm"} <= names
+
+
+def test_example_launch_scripts_use_real_cli_flags():
+    """The shell recipes must only use flags the CLI parser accepts
+    (catches drift between docs/examples and the real surface)."""
+    import glob
+    import os
+    import re
+
+    from dynamo_tpu.cli.main import build_parser
+
+    parser = build_parser()
+    run_parser = None
+    for action in parser._subparsers._group_actions:  # type: ignore[union-attr]
+        run_parser = action.choices.get("run")
+    assert run_parser is not None
+    known = set()
+    for a in run_parser._actions:
+        known.update(a.option_strings)
+
+    launch_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "llm", "launch",
+    )
+    scripts = glob.glob(os.path.join(launch_dir, "*.sh"))
+    assert scripts
+    for path in scripts:
+        text = open(path).read()
+        for m in re.finditer(r"cli\.main run(.*?)(?:&|\n\n|$)", text, re.S):
+            for flag in re.findall(r"(--[a-z][a-z0-9-]+)", m.group(1)):
+                assert flag in known, f"{os.path.basename(path)}: {flag}"

@@ -314,3 +314,149 @@ def test_reader_rejects_garbage(tmp_path):
     bad.write_bytes(b"NOPE" + b"\x00" * 64)
     with pytest.raises(ValueError, match="not a GGUF"):
         GGUFReader(str(bad))
+
+
+def test_kquant_roundtrip_all_formats(tmp_path):
+    """Q4_0/Q5_0/Q4_K/Q5_K/Q6_K: encode -> file -> dequantize within
+    each format's quantization error (reference: gguf/content.rs loads
+    these via candle; ggml-quants.c defines the layouts)."""
+    from dynamo_tpu.gguf.reader import (
+        GGML_Q4_0,
+        GGML_Q4_K,
+        GGML_Q5_0,
+        GGML_Q5_K,
+        GGML_Q6_K,
+    )
+
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal((4, 512)).astype(np.float32)
+    spread = float(np.ptp(w))
+    # per-format worst-case step ~ spread/levels; allow 1.5 steps for
+    # the two-level (super+sub) scale quantization of the k-quants
+    tolerances = {
+        # the symmetric formats lose one level on the positive side
+        # (q-8 in [-8, 7]): up to a full step of one-sided error
+        GGML_Q4_0: ("q4_0", spread / 15 * 1.25),
+        GGML_Q5_0: ("q5_0", spread / 31 * 1.25),
+        GGML_Q4_K: ("q4_k", spread / 15 * 1.5),
+        GGML_Q5_K: ("q5_k", spread / 31 * 1.5),
+        GGML_Q6_K: ("q6_k", spread / 63 * 1.5),
+    }
+    path = str(tmp_path / "kq.gguf")
+    names = {f"t_{tag}": gt for gt, (tag, _) in tolerances.items()}
+    write_gguf(path, {}, {n: w for n in names},
+               quantize={n: gt for n, gt in names.items()})
+    with GGUFReader(path) as r:
+        for name, gt in names.items():
+            deq = r.load(name)
+            assert deq.shape == w.shape
+            tol = tolerances[gt][1]
+            err = np.abs(deq - w).max()
+            assert err <= tol, f"{name}: max err {err} > {tol}"
+            # and not degenerate: correlated with the source
+            corr = np.corrcoef(deq.reshape(-1), w.reshape(-1))[0, 1]
+            assert corr > 0.98, f"{name}: corr {corr}"
+
+
+def test_q6k_scale_sign_and_block_edges(tmp_path):
+    """Q6_K carries signed int8 sub-scales; values at block boundaries
+    (positions 31/32, 127/128) must land in the right sub-blocks."""
+    from dynamo_tpu.gguf.reader import GGML_Q6_K
+
+    x = np.zeros((1, 256), np.float32)
+    x[0, 0] = -5.0     # sub-block 0
+    x[0, 31] = 5.0
+    x[0, 32] = -3.0    # sub-block 2
+    x[0, 127] = 2.0    # last sub-block of first half
+    x[0, 128] = -7.0   # first sub-block of second half
+    x[0, 255] = 1.0
+    path = str(tmp_path / "q6.gguf")
+    write_gguf(path, {}, {"t": x}, quantize={"t": GGML_Q6_K})
+    with GGUFReader(path) as r:
+        deq = r.load("t")
+    for pos in (0, 31, 32, 127, 128, 255):
+        assert abs(deq[0, pos] - x[0, pos]) <= abs(x[0, pos]) * 0.1 + 0.05, pos
+    # zeros stay zero-ish
+    assert np.abs(deq[0, 1:31]).max() < 0.2
+
+
+async def test_engine_serves_q4k_gguf(tmp_path):
+    """End-to-end: a Q4_K-quantized GGUF model serves through the native
+    engine (the format practically every distributed GGUF uses)."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.gguf.reader import GGML_Q4_K
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    # dims multiple of 256 so every projection can be Q4_K
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=256, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128,
+    )
+    rng = np.random.default_rng(1)
+    D, H, Hk, Dh = (cfg.hidden_size, cfg.num_attention_heads,
+                    cfg.num_key_value_heads, cfg.head_dim)
+    F, V, L = cfg.intermediate_size, cfg.vocab_size, cfg.num_hidden_layers
+
+    def t(*shape):
+        return (rng.standard_normal(shape) * 0.05).astype(np.float32)
+
+    tensors = {
+        "token_embd.weight": t(V, D),
+        "output_norm.weight": np.ones((D,), np.float32),
+    }
+    quantize = {}
+    for i in range(L):
+        for gname, shape in (
+            (f"blk.{i}.attn_q.weight", (H * Dh, D)),
+            (f"blk.{i}.attn_k.weight", (Hk * Dh, D)),
+            (f"blk.{i}.attn_v.weight", (Hk * Dh, D)),
+            (f"blk.{i}.attn_output.weight", (D, H * Dh)),
+            (f"blk.{i}.ffn_gate.weight", (F, D)),
+            (f"blk.{i}.ffn_up.weight", (F, D)),
+            (f"blk.{i}.ffn_down.weight", (D, F)),
+        ):
+            tensors[gname] = t(*shape)
+            quantize[gname] = GGML_Q4_K
+        tensors[f"blk.{i}.attn_norm.weight"] = np.ones((D,), np.float32)
+        tensors[f"blk.{i}.ffn_norm.weight"] = np.ones((D,), np.float32)
+    path = str(tmp_path / "q4k.gguf")
+    write_gguf(path, {
+        "general.architecture": "llama",
+        "llama.vocab_size": V,
+        "llama.embedding_length": D,
+        "llama.block_count": L,
+        "llama.attention.head_count": H,
+        "llama.attention.head_count_kv": Hk,
+        "llama.feed_forward_length": F,
+        "llama.context_length": 128,
+    }, tensors, quantize=quantize)
+
+    engine = await JaxEngine.launch(
+        EngineConfig(
+            model_path=path, model_name="q4k",
+            num_blocks=32, block_size=8, max_batch_size=2,
+            kv_cache_dtype="float32",
+        )
+    )
+    try:
+        req = PreprocessedRequest(
+            request_id="g", token_ids=list(range(1, 20)),
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=6, ignore_eos=True),
+        )
+        toks = []
+        async for out in engine.as_async_engine().generate(req, Context()):
+            toks.extend(out.token_ids)
+        assert len(toks) == 6
+        assert all(0 <= t < cfg.vocab_size for t in toks)
+    finally:
+        await engine.shutdown()

@@ -1,0 +1,58 @@
+"""Gated hub resolution (reference: lib/llm/src/hub.rs + local_model.rs
+— repo ids resolve to local checkpoints; here downloads are off by
+default for zero-egress serving nodes)."""
+
+import os
+
+import pytest
+
+from dynamo_tpu.models.hub import is_repo_id, resolve_hub_model
+
+
+def test_is_repo_id(tmp_path):
+    assert is_repo_id("meta-llama/Llama-3-8B")
+    assert not is_repo_id(str(tmp_path))        # existing dir
+    assert not is_repo_id("model.gguf")
+    assert not is_repo_id("a/b/c")
+    assert not is_repo_id("")
+    assert not is_repo_id("./relative/path")
+
+
+def test_local_paths_pass_through(tmp_path):
+    assert resolve_hub_model(str(tmp_path)) == str(tmp_path)
+    assert resolve_hub_model("") == ""
+
+
+def test_uncached_repo_refused_without_optin(monkeypatch, tmp_path):
+    monkeypatch.delenv("DYN_ALLOW_HUB_DOWNLOAD", raising=False)
+    monkeypatch.setenv("DYN_HUB_CACHE", str(tmp_path / "cache"))
+    with pytest.raises(ValueError, match="DYN_ALLOW_HUB_DOWNLOAD"):
+        resolve_hub_model("no-such-org/no-such-model")
+
+
+def test_download_gated_by_env(monkeypatch, tmp_path):
+    """With the opt-in set, resolution calls snapshot_download in
+    network mode (mocked: no egress in CI)."""
+    calls = []
+
+    def fake_snapshot_download(repo, **kw):
+        calls.append((repo, kw.get("local_files_only", False)))
+        if kw.get("local_files_only"):
+            raise FileNotFoundError(repo)
+        return str(tmp_path / "snap")
+
+    import huggingface_hub
+
+    monkeypatch.setattr(huggingface_hub, "snapshot_download",
+                        fake_snapshot_download)
+    monkeypatch.setenv("DYN_ALLOW_HUB_DOWNLOAD", "1")
+    out = resolve_hub_model("org/model")
+    assert out == str(tmp_path / "snap")
+    assert calls == [("org/model", False)]
+
+    # without the env: cache-only attempt, then a clear refusal
+    monkeypatch.delenv("DYN_ALLOW_HUB_DOWNLOAD")
+    calls.clear()
+    with pytest.raises(ValueError, match="not cached locally"):
+        resolve_hub_model("org/model")
+    assert calls == [("org/model", True)]

@@ -1,0 +1,127 @@
+"""Structured logging parity (reference: lib/runtime/src/logging.rs —
+env-filter levels, JSONL output, config file, file target)."""
+
+import json
+import logging
+
+from dynamo_tpu.runtime.logging import (
+    JsonlFormatter,
+    init_logging,
+    parse_env_filter,
+)
+
+
+def _restore_root():
+    root = logging.getLogger()
+    root.handlers[:] = []
+    root.setLevel(logging.WARNING)
+    # clear per-target overrides set by tests
+    for name in ("dynamo_tpu.engine", "aiohttp", "noisy.dep"):
+        logging.getLogger(name).setLevel(logging.NOTSET)
+
+
+def test_parse_env_filter():
+    default, targets = parse_env_filter(
+        "info,dynamo_tpu.engine=debug,aiohttp=warning"
+    )
+    assert default == logging.INFO
+    assert targets == {
+        "dynamo_tpu.engine": logging.DEBUG,
+        "aiohttp": logging.WARNING,
+    }
+    # bare level only
+    assert parse_env_filter("debug") == (logging.DEBUG, {})
+    # unknown names fall back to INFO, empty parts ignored
+    assert parse_env_filter("bogus,,x=nope") == (
+        logging.INFO, {"x": logging.INFO}
+    )
+
+
+def test_jsonl_formatter_shape():
+    rec = logging.LogRecord(
+        "dynamo_tpu.engine", logging.INFO, __file__, 1, "hello %s", ("w",), None
+    )
+    out = json.loads(JsonlFormatter().format(rec))
+    assert out["level"] == "INFO"
+    assert out["target"] == "dynamo_tpu.engine"
+    assert out["message"] == "hello w"
+    assert out["ts"].endswith("Z")
+    # local-tz variant drops the Z suffix
+    out2 = json.loads(JsonlFormatter(local_tz=True).format(rec))
+    assert not out2["ts"].endswith("Z")
+
+
+def test_init_logging_env_filter_and_file(tmp_path, monkeypatch):
+    log_path = str(tmp_path / "out.jsonl")
+    monkeypatch.setenv("DYN_LOG_LEVEL", "warning,dynamo_tpu.engine=debug")
+    monkeypatch.setenv("DYN_LOGGING_JSONL", "1")
+    monkeypatch.setenv("DYN_LOG_FILE", log_path)
+    try:
+        init_logging()
+        logging.getLogger("noisy.dep").info("dropped")  # below warning
+        logging.getLogger("dynamo_tpu.engine").debug("kept by override")
+        logging.getLogger("other").error("kept by level")
+        for h in logging.getLogger().handlers:
+            h.flush()
+        lines = [json.loads(x) for x in open(log_path).read().splitlines()]
+        messages = [x["message"] for x in lines]
+        assert "dropped" not in messages
+        assert "kept by override" in messages
+        assert "kept by level" in messages
+        assert all(set(x) >= {"ts", "level", "target", "message"} for x in lines)
+    finally:
+        _restore_root()
+
+
+def test_init_logging_config_file(tmp_path, monkeypatch):
+    log_path = str(tmp_path / "cfg.log")
+    cfg = tmp_path / "logging.toml"
+    cfg.write_text(
+        f'level = "error"\njsonl = true\nfile = "{log_path}"\n'
+    )
+    monkeypatch.delenv("DYN_LOG_LEVEL", raising=False)
+    monkeypatch.delenv("DYN_LOGGING_JSONL", raising=False)
+    monkeypatch.delenv("DYN_LOG_FILE", raising=False)
+    monkeypatch.setenv("DYN_LOGGING_CONFIG_PATH", str(cfg))
+    try:
+        init_logging()
+        logging.getLogger("x").warning("dropped")
+        logging.getLogger("x").error("kept")
+        for h in logging.getLogger().handlers:
+            h.flush()
+        lines = [json.loads(x) for x in open(log_path).read().splitlines()]
+        assert [x["message"] for x in lines] == ["kept"]
+    finally:
+        _restore_root()
+
+
+def test_init_logging_env_overrides_config(tmp_path, monkeypatch):
+    cfg = tmp_path / "logging.json"
+    cfg.write_text(json.dumps({"level": "error"}))
+    monkeypatch.setenv("DYN_LOGGING_CONFIG_PATH", str(cfg))
+    monkeypatch.setenv("DYN_LOG_LEVEL", "debug")
+    monkeypatch.delenv("DYN_LOG_FILE", raising=False)
+    monkeypatch.delenv("DYN_LOGGING_JSONL", raising=False)
+    try:
+        init_logging()
+        assert logging.getLogger().level == logging.DEBUG
+    finally:
+        _restore_root()
+
+
+def test_reinit_resets_previous_target_overrides(monkeypatch):
+    monkeypatch.setenv("DYN_LOG_LEVEL", "warning,dynamo_tpu.engine=debug")
+    monkeypatch.delenv("DYN_LOG_FILE", raising=False)
+    monkeypatch.delenv("DYN_LOGGING_CONFIG_PATH", raising=False)
+    try:
+        init_logging()
+        assert logging.getLogger("dynamo_tpu.engine").level == logging.DEBUG
+        # re-init with a plain filter: the stale DEBUG pin must clear
+        monkeypatch.setenv("DYN_LOG_LEVEL", "warning")
+        init_logging()
+        assert logging.getLogger("dynamo_tpu.engine").level == logging.NOTSET
+        assert not logging.getLogger("dynamo_tpu.engine").isEnabledFor(
+            logging.DEBUG
+        )
+    finally:
+        _restore_root()

@@ -1,0 +1,288 @@
+"""Weight-only int8 quantization (models/quant.py): numerics, loader
+integration, forward parity, and the quantized serving engine.
+
+Reference parity note: the reference serves quantized checkpoints through
+its engines (FP8-dynamic models in examples/llm/benchmarks/README.md);
+here quantization is a first-class engine knob."""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.quant import (
+    QUANT_AXIS,
+    quantize_array,
+    quantize_params_pytree,
+    scale_spec,
+)
+
+TINY = ModelConfig(
+    vocab_size=256, hidden_size=64, intermediate_size=128,
+    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+    max_position_embeddings=256,
+)
+
+
+def test_quantize_array_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((64, 128)).astype(np.float32)
+    q, s = quantize_array(w, -2)
+    assert q.dtype == np.int8 and s.shape == (128,)
+    deq = q.astype(np.float32) * s
+    # symmetric per-channel int8: max error is half a quant step
+    step = np.abs(w).max(axis=0) / 127.0
+    assert np.all(np.abs(deq - w) <= step / 2 + 1e-7)
+
+
+def test_quantize_array_bf16_uint16_input():
+    import jax.numpy as jnp
+
+    w = np.asarray(jnp.asarray([[1.5, -2.0], [0.25, 3.0]], jnp.bfloat16))
+    assert w.dtype == np.uint16 or w.dtype.name == "bfloat16"
+    raw = np.asarray(jnp.asarray(w).view(jnp.uint16)) if w.dtype.name == "bfloat16" else w
+    q, s = quantize_array(raw, -2)
+    deq = q.astype(np.float32) * s
+    np.testing.assert_allclose(deq, [[1.5, -2.0], [0.25, 3.0]], rtol=0.02)
+
+
+def test_scale_spec_drops_contraction_axis():
+    from jax.sharding import PartitionSpec as P
+
+    assert scale_spec(P(None, None, "tp"), -2) == P(None, "tp")
+    assert scale_spec(P(None, "tp", None), -2) == P(None, None)
+    assert scale_spec(P("tp", None), -1) == P("tp")
+    assert scale_spec(P(None, "ep", None, "tp"), -2) == P(None, "ep", "tp")
+
+
+def _forward_logits(cfg, params, prompt):
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.llama import forward, init_cache
+
+    T = len(prompt)
+    k_cache, v_cache = init_cache(cfg, num_blocks=32, block_size=8)
+    tokens = jnp.asarray([prompt], jnp.int32)
+    positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+    slot_mapping = jnp.arange(T, dtype=jnp.int32) + 8  # blocks 1..
+    block_tables = (jnp.arange(8, dtype=jnp.int32) + 1)[None, :]
+    context_lens = jnp.asarray([T], jnp.int32)
+    last_idx = jnp.asarray([T - 1], jnp.int32)
+    logits, _, _ = forward(
+        cfg, params, k_cache, v_cache, tokens, positions, slot_mapping,
+        block_tables, context_lens, last_idx, 8,
+    )
+    return np.asarray(logits[0], np.float32)
+
+
+def test_forward_parity_bf16_vs_int8():
+    """Quantized logits must track the bf16 forward closely (the CI
+    numerics bound quant.py's docstring promises)."""
+    from dynamo_tpu.models.llama import init_params
+
+    params = init_params(TINY, seed=3)
+    qparams = quantize_params_pytree(params)
+    assert qparams["wq"].dtype.name == "int8"
+    assert "wq_scale" in qparams and "embed_scale" in qparams
+    prompt = list(range(7, 27))
+    ref = _forward_logits(TINY, params, prompt)
+    got = _forward_logits(TINY, qparams, prompt)
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 0.05, f"relative logits error {rel:.4f}"
+
+
+def test_forward_parity_moe_int8():
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=128,
+    )
+    from dynamo_tpu.models.llama import init_params
+
+    params = init_params(cfg, seed=5)
+    qparams = quantize_params_pytree(params)
+    assert qparams["w_gate"].dtype.name == "int8"
+    assert qparams["w_gate_scale"].shape == (2, 4, 64)
+    prompt = list(range(3, 19))
+    ref = _forward_logits(cfg, params, prompt)
+    got = _forward_logits(cfg, qparams, prompt)
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 0.08, f"relative logits error {rel:.4f}"
+
+
+def _write_tiny_checkpoint(cfg, path, tied=False, seed=0):
+    """HF-format safetensors checkpoint with random weights."""
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(seed)
+    D, F, V = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    H, Hk, Dh, L = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                    cfg.head_dim, cfg.num_hidden_layers)
+    t = {}
+    t["model.embed_tokens.weight"] = rng.standard_normal((V, D)).astype(np.float32)
+    t["model.norm.weight"] = np.ones((D,), np.float32)
+    if not tied:
+        t["lm_head.weight"] = rng.standard_normal((V, D)).astype(np.float32)
+    for i in range(L):
+        p = f"model.layers.{i}"
+        t[f"{p}.input_layernorm.weight"] = np.ones((D,), np.float32)
+        t[f"{p}.post_attention_layernorm.weight"] = np.ones((D,), np.float32)
+        t[f"{p}.self_attn.q_proj.weight"] = rng.standard_normal((H * Dh, D)).astype(np.float32) * 0.1
+        t[f"{p}.self_attn.k_proj.weight"] = rng.standard_normal((Hk * Dh, D)).astype(np.float32) * 0.1
+        t[f"{p}.self_attn.v_proj.weight"] = rng.standard_normal((Hk * Dh, D)).astype(np.float32) * 0.1
+        t[f"{p}.self_attn.o_proj.weight"] = rng.standard_normal((D, H * Dh)).astype(np.float32) * 0.1
+        t[f"{p}.mlp.gate_proj.weight"] = rng.standard_normal((F, D)).astype(np.float32) * 0.1
+        t[f"{p}.mlp.up_proj.weight"] = rng.standard_normal((F, D)).astype(np.float32) * 0.1
+        t[f"{p}.mlp.down_proj.weight"] = rng.standard_normal((D, F)).astype(np.float32) * 0.1
+    os.makedirs(path, exist_ok=True)
+    save_file(t, os.path.join(path, "model.safetensors"))
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump({
+            "model_type": "llama", "vocab_size": V, "hidden_size": D,
+            "intermediate_size": F, "num_hidden_layers": L,
+            "num_attention_heads": H, "num_key_value_heads": Hk,
+            "max_position_embeddings": cfg.max_position_embeddings,
+            "tie_word_embeddings": tied,
+        }, f)
+    return t
+
+
+def test_loader_quantized_matches_host_quantization(tmp_path):
+    from dynamo_tpu.models.loader import load_params
+
+    t = _write_tiny_checkpoint(TINY, str(tmp_path))
+    params = load_params(TINY, str(tmp_path), quantize="int8")
+    for name in ("wq", "wo", "w_down", "lm_head", "embed"):
+        assert params[name].dtype.name == "int8", name
+        assert name + "_scale" in params
+    # spot-check one weight against direct host quantization
+    w0 = t["model.layers.0.self_attn.q_proj.weight"].T  # [D, H*Dh]
+    q, s = quantize_array(w0, -2)
+    np.testing.assert_array_equal(np.asarray(params["wq"])[0], q)
+    np.testing.assert_allclose(np.asarray(params["wq_scale"])[0], s)
+    # norms stay f32
+    assert params["attn_norm"].dtype.name == "float32"
+
+
+def test_loader_quantized_tied_lm_head(tmp_path):
+    from dynamo_tpu.models.loader import load_params
+
+    cfg = ModelConfig(**{**TINY.__dict__, "tie_word_embeddings": True})
+    cfg.head_dim = None
+    cfg.__post_init__()
+    _write_tiny_checkpoint(cfg, str(tmp_path), tied=True)
+    params = load_params(cfg, str(tmp_path), quantize="int8")
+    np.testing.assert_array_equal(
+        np.asarray(params["lm_head"]), np.asarray(params["embed"]).T
+    )
+    np.testing.assert_array_equal(
+        np.asarray(params["lm_head_scale"]), np.asarray(params["embed_scale"])
+    )
+
+
+def test_checkpoint_quantized_forward_parity(tmp_path):
+    """End-to-end: checkpoint -> (bf16 load, int8 load) -> close logits."""
+    from dynamo_tpu.models.loader import load_params
+
+    _write_tiny_checkpoint(TINY, str(tmp_path), seed=11)
+    ref = _forward_logits(TINY, load_params(TINY, str(tmp_path)),
+                          list(range(5, 25)))
+    got = _forward_logits(TINY, load_params(TINY, str(tmp_path), quantize="int8"),
+                          list(range(5, 25)))
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 0.05, f"relative logits error {rel:.4f}"
+
+
+def test_gguf_quantized_load(tmp_path):
+    from dynamo_tpu.gguf import GGUFReader, load_params_from_gguf, write_gguf
+
+    cfg = ModelConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64,
+    )
+    rng = np.random.default_rng(1)
+    D, H, Hk, Dh = (cfg.hidden_size, cfg.num_attention_heads,
+                    cfg.num_key_value_heads, cfg.head_dim)
+    F, V, L = cfg.intermediate_size, cfg.vocab_size, cfg.num_hidden_layers
+
+    def t(*shape):
+        return (rng.standard_normal(shape) * 0.05).astype(np.float32)
+
+    tensors = {
+        "token_embd.weight": t(V, D),
+        "output_norm.weight": np.ones((D,), np.float32),
+        # no output.weight: tied-embeddings + quantized lm_head derivation
+    }
+    for i in range(L):
+        tensors.update({
+            f"blk.{i}.attn_norm.weight": np.ones((D,), np.float32),
+            f"blk.{i}.attn_q.weight": t(H * Dh, D),
+            f"blk.{i}.attn_k.weight": t(Hk * Dh, D),
+            f"blk.{i}.attn_v.weight": t(Hk * Dh, D),
+            f"blk.{i}.attn_output.weight": t(D, H * Dh),
+            f"blk.{i}.ffn_norm.weight": np.ones((D,), np.float32),
+            f"blk.{i}.ffn_gate.weight": t(F, D),
+            f"blk.{i}.ffn_up.weight": t(F, D),
+            f"blk.{i}.ffn_down.weight": t(D, F),
+        })
+    path = str(tmp_path / "m.gguf")
+    write_gguf(path, {"general.architecture": "llama"}, tensors)
+    with GGUFReader(path) as r:
+        ref = load_params_from_gguf(cfg, r)
+        qp = load_params_from_gguf(cfg, r, quantize="int8")
+    assert qp["wq"].dtype.name == "int8"
+    assert qp["lm_head"].dtype.name == "int8"  # tied, derived from embed
+    deq = np.asarray(qp["wq"], np.float32)[0] * np.asarray(qp["wq_scale"])[0][None, :]
+    np.testing.assert_allclose(
+        deq, np.asarray(ref["wq"], np.float32)[0], atol=0.02, rtol=0.1
+    )
+
+
+async def _generate(engine, prompt_ids, max_tokens=8):
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    req = PreprocessedRequest(
+        request_id="q", token_ids=prompt_ids,
+        sampling=SamplingOptions(use_greedy=True),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    )
+    toks = []
+    adapter = engine.as_async_engine()
+    async for out in adapter.generate(req, Context()):
+        toks.extend(out.token_ids)
+    return toks
+
+
+async def test_engine_serves_int8():
+    """The engine generates deterministically with quantization=int8 and
+    the fused multi-step path."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    async def run():
+        engine = await JaxEngine.launch(
+            EngineConfig(
+                model_path="", model_name="q8", random_weights=True,
+                quantization="int8", num_blocks=64, block_size=8,
+                max_batch_size=4, decode_steps=2, kv_cache_dtype="float32",
+            ),
+            model_config=TINY,
+        )
+        try:
+            return await _generate(engine, list(range(1, 20)))
+        finally:
+            await engine.shutdown()
+
+    t1 = await run()
+    t2 = await run()
+    assert len(t1) == 8 and t1 == t2
