@@ -61,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--quantization", default=None, choices=["int8"],
                      help="weight-only quantization applied at load "
                           "(halves weight HBM traffic)")
+    run.add_argument("--decode-steps", type=int, default=1,
+                     help="fused decode window: tokens per device "
+                          "dispatch (amortizes dispatch latency; tokens "
+                          "stream in bursts of this size)")
     run.add_argument("--tensor-parallel-size", type=int, default=1)
     run.add_argument("--pipeline-parallel-size", type=int, default=1,
                      help="GPipe stage rotation over a pp mesh axis")

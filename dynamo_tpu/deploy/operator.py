@@ -133,16 +133,34 @@ class Reconciler:
         return results
 
     # -- durable desired-state mirror --------------------------------------
+    # Mirror files carry this reconciler's namespace prefix: the sync
+    # only ever creates/deletes files it owns, so a shared or misaimed
+    # state_dir (another namespace's mirror, unrelated user JSON) is
+    # never touched.
+    def _mirror_prefix(self) -> str:
+        return f"dgd.{self.namespace.replace('/', '_')}."
+
     def _mirror_path(self, name: str) -> str:
         import os
 
-        return os.path.join(self.state_dir or "", name.replace("/", "_") + ".json")
+        return os.path.join(
+            self.state_dir or "",
+            self._mirror_prefix() + name.replace("/", "_") + ".json",
+        )
+
+    def _mirror_files(self) -> list[str]:
+        import glob
+        import os
+
+        return glob.glob(
+            os.path.join(self.state_dir or "", self._mirror_prefix() + "*.json")
+        )
 
     def _sync_mirror(self, specs: list[GraphDeploymentSpec]) -> None:
-        """Make {state_dir} exactly reflect the store's desired state."""
+        """Make this namespace's mirror files exactly reflect the
+        store's desired state."""
         if not self.state_dir:
             return
-        import glob
         import json
         import os
 
@@ -161,7 +179,7 @@ class Reconciler:
                 with open(tmp, "w") as f:
                     f.write(doc)
                 os.replace(tmp, path)
-            for path in glob.glob(os.path.join(self.state_dir, "*.json")):
+            for path in self._mirror_files():
                 if path not in want:
                     os.remove(path)
         except OSError:
@@ -172,12 +190,10 @@ class Reconciler:
         kv_create only: a live (newer) spec in the store wins."""
         if not self.state_dir:
             return 0
-        import glob
         import json
-        import os
 
         restored = 0
-        for path in sorted(glob.glob(os.path.join(self.state_dir, "*.json"))):
+        for path in sorted(self._mirror_files()):
             try:
                 with open(path) as f:
                     spec = GraphDeploymentSpec.from_dict(json.load(f))

@@ -74,6 +74,7 @@ def load_engine_config(args: Any) -> EngineConfig:
         node_rank=getattr(args, "node_rank", 0),
         leader_addr=getattr(args, "leader_addr", ""),
         quantization=getattr(args, "quantization", None),
+        decode_steps=getattr(args, "decode_steps", 1),
         host_kv_blocks=getattr(args, "host_kv_blocks", 0),
         disk_kv_blocks=getattr(args, "disk_kv_blocks", 0),
         disk_kv_path=getattr(args, "disk_kv_path", ""),

@@ -459,10 +459,17 @@ class JaxEngine:
                 return (k_c, v_c, nt[:, None], pos + 1, ctx + 1), (nt, lp)
 
             carry = (k_cache, v_cache, tokens, positions, context_lens)
-            (k_cache, v_cache, *_), (toks, lps) = jax.lax.scan(
+            (k_cache, v_cache, last_tok, *_), (toks, lps) = jax.lax.scan(
                 body, carry, jnp.arange(K)
             )
-            return toks.T, lps.T, k_cache, v_cache  # [B, K]
+            # one packed host transfer per window (tokens are exact in
+            # f32: vocab ids < 2^24), plus the device-resident last
+            # token column for chaining the next window without a host
+            # round trip
+            packed = jnp.concatenate(
+                [toks.T.astype(jnp.float32), lps.T], axis=1
+            )  # [B, 2K]
+            return packed, last_tok, k_cache, v_cache
 
         self._multi_step_fn = (
             jax.jit(multi_step, donate_argnums=(1, 2)) if K > 1 else None
@@ -691,22 +698,10 @@ class JaxEngine:
             arrays = sched.build_decode_arrays(seqs)
 
         B = arrays["tokens"].shape[0]
-        opts = [s.request.sampling.normalized() for s in seqs]
-        opts += [opts[-1]] * (B - len(seqs))  # pad
-        seeds = []
-        for s in seqs:
-            base = s.request.sampling.seed
-            seeds.append(
-                (base if base is not None else hash(s.request_id) & 0x7FFFFFFF)
-                + s.generated
-            )
-        seeds += [0] * (B - len(seqs))
-        sampling = SamplingBatch.from_options(opts, seeds)
+        sampling = self._batch_sampling(seqs, B)
 
         if plan.kind == "decode" and self._multi_step_fn is not None:
-            tok_matrix, lp_matrix = self._run_multi_step(arrays, sampling)
-            for i, seq in enumerate(seqs):
-                self._emit_window(seq, tok_matrix[i], lp_matrix[i])
+            self._decode_pipelined(seqs, arrays, sampling)
             return
 
         next_tokens, logprobs = self._run_device_step(arrays, sampling)
@@ -724,15 +719,41 @@ class JaxEngine:
                     continue
                 self._emit_token(seq, int(next_tokens[i]), float(logprobs[i]))
 
-    def _run_multi_step(self, arrays: dict[str, np.ndarray], sampling: SamplingBatch):
+    def _batch_sampling(
+        self, seqs: list, B: int, offset: int = 0
+    ) -> SamplingBatch:
+        """Per-slot sampling params; ``offset`` advances the per-step
+        seeds past tokens of an in-flight (not yet host-applied) window."""
+        opts = [s.request.sampling.normalized() for s in seqs]
+        opts += [opts[-1]] * (B - len(seqs))  # pad
+        seeds = []
+        for s in seqs:
+            base = s.request.sampling.seed
+            seeds.append(
+                (base if base is not None else hash(s.request_id) & 0x7FFFFFFF)
+                + s.generated + offset
+            )
+        seeds += [0] * (B - len(seqs))
+        return SamplingBatch.from_options(opts, seeds)
+
+    def _dispatch_multi_step(
+        self,
+        arrays: dict[str, np.ndarray],
+        sampling: SamplingBatch,
+        tokens_dev=None,
+    ):
+        """Launch one fused window; returns DEVICE (toks, lps) [B, K] —
+        callers sync when they need values, so the next window can be
+        dispatched underneath. ``tokens_dev`` chains the previous
+        window's device-resident last-token column (no host hop)."""
         assert self._multi_step_fn is not None
         if self._mh_broadcast is not None:
             self._mh_broadcast.announce_multi_step(arrays, sampling)
-        toks, lps, self.k_cache, self.v_cache = self._multi_step_fn(
+        packed, last_tok, self.k_cache, self.v_cache = self._multi_step_fn(
             self.params,
             self.k_cache,
             self.v_cache,
-            arrays["tokens"],
+            arrays["tokens"] if tokens_dev is None else tokens_dev,
             arrays["positions"],
             arrays["block_tables"],
             arrays["context_lens"],
@@ -742,7 +763,69 @@ class JaxEngine:
             sampling.top_p,
             sampling.seeds,
         )
-        return np.asarray(toks), np.asarray(lps)
+        return packed, last_tok
+
+    @staticmethod
+    def _unpack_window(packed_host: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        K = packed_host.shape[1] // 2
+        return packed_host[:, :K].astype(np.int32), packed_host[:, K:]
+
+    def _run_multi_step(self, arrays: dict[str, np.ndarray], sampling: SamplingBatch):
+        packed, _ = self._dispatch_multi_step(arrays, sampling)
+        return self._unpack_window(np.asarray(packed))
+
+    def _decode_pipelined(
+        self, seqs: list, arrays: dict[str, np.ndarray], sampling: SamplingBatch
+    ) -> None:
+        """Fused decode with the host work hidden behind the device.
+
+        While window k runs on device, the host plans window k+1 (block
+        extension, shifted positions — scheduler.plan_pipelined_window)
+        and dispatches it fed by k's device-resident last tokens, THEN
+        syncs and emits window k. Over a high-latency chip link this
+        hides the per-window round trip + python bookkeeping that
+        otherwise serializes with compute (~35-40% of decode wall time
+        measured on the tunneled v5e).
+
+        Safety: the planner never preempts and requires every sequence
+        mid-stream with budget past the in-flight window; any state
+        change observed after emitting window k (finish/cancel/stop)
+        flushes the pipeline — the in-flight window is synced, surviving
+        sequences keep its tokens, finished ones discard theirs (their
+        blocks stay allocated until that flush, so no reuse races the
+        in-flight writes). Multihost leaders don't pipeline: followers
+        need host token values per announce.
+        """
+        sched = self.scheduler
+        assert sched is not None
+        K = sched.decode_lookahead
+        pipelining = self._mh_broadcast is None
+        pending = self._dispatch_multi_step(arrays, sampling)
+
+        def emit(window) -> None:
+            tok_m, lp_m = self._unpack_window(np.asarray(window[0]))
+            for i, seq in enumerate(seqs):
+                self._emit_window(seq, tok_m[i], lp_m[i])
+
+        while True:
+            nxt = None
+            if pipelining and self._incoming.empty() and self._control.empty():
+                nxt = sched.plan_pipelined_window(seqs, K)
+            if nxt is not None:
+                B = nxt["tokens"].shape[0]
+                next_sampling = self._batch_sampling(seqs, B, offset=K)
+                next_pending = self._dispatch_multi_step(
+                    nxt, next_sampling, tokens_dev=pending[1]
+                )
+            # sync + emit window k (device already busy with k+1)
+            emit(pending)
+            if nxt is None:
+                return
+            pending = next_pending
+            if any(s.state != SeqState.RUNNING for s in seqs):
+                # composition changed under the in-flight window: flush
+                emit(pending)
+                return
 
     def _emit_token(self, seq: Sequence, token: int, logprob: float) -> None:
         sched = self.scheduler

@@ -332,6 +332,86 @@ class Scheduler:
                 safe.append(seq)
         return safe
 
+    def plan_pipelined_window(
+        self, seqs: list[Sequence], offset: int
+    ) -> Optional[dict]:
+        """Arrays for the NEXT fused decode window while the current one
+        is still in flight. ``offset`` tokens per sequence (the in-flight
+        window) are not yet reflected in host state, so positions/
+        context/budget all shift by it. Returns None when pipelining is
+        ineligible — pending admissions or prefills, a sequence that is
+        not plainly mid-stream with budget beyond the in-flight window,
+        or block exhaustion (this path NEVER preempts: a preemption
+        would recompute state the in-flight window is about to change).
+
+        The tokens row is a placeholder: the engine feeds the device-
+        resident last-token column of the in-flight window's output, so
+        the dispatch never waits on a host round trip.
+        """
+        import numpy as np
+
+        if self.waiting or self.prefilling:
+            return None
+        K = self.decode_lookahead
+        for seq in seqs:
+            if seq.state != SeqState.RUNNING:
+                return None
+            if seq.is_cancelled and seq.is_cancelled():
+                return None
+            if (
+                seq.max_new_tokens is not None
+                and seq.max_new_tokens - seq.generated <= offset
+            ):
+                return None
+        added: list[Sequence] = []
+        ok = True
+        for seq in seqs:
+            needed = seq.blocks_needed(
+                seq.total_len + offset + K, self.block_size
+            )
+            while len(seq.block_table) < needed:
+                try:
+                    seq.block_table.append(self.allocator.allocate_block())
+                    added.append(seq)
+                except NoBlocksError:
+                    ok = False
+                    break
+            if not ok:
+                break
+        if not ok:
+            # rollback: the freshly-added (uncommitted) blocks go back
+            for seq in reversed(added):
+                self.allocator.free_sequence([seq.block_table.pop()])
+            return None
+
+        bs = self.block_size
+        n = len(seqs)
+        B = next_bucket(n, self.BATCH_BUCKETS)
+        max_blocks = max(len(s.block_table) for s in seqs)
+        width = max(
+            self.TABLE_BUCKET, -(-max_blocks // self.TABLE_BUCKET) * self.TABLE_BUCKET
+        )
+        tokens = np.zeros((B, 1), np.int32)  # device carry overrides
+        positions = np.zeros((B, 1), np.int32)
+        tables = np.zeros((B, width), np.int32)
+        ctx = np.zeros((B,), np.int32)
+        valid_steps = np.zeros((B,), np.int32)
+        for i, s in enumerate(seqs):
+            positions[i, 0] = s.total_len - 1 + offset
+            tables[i, : len(s.block_table)] = s.block_table
+            ctx[i] = s.total_len + offset
+            v = K
+            if s.max_new_tokens is not None:
+                v = min(v, max(1, s.max_new_tokens - s.generated - offset))
+            valid_steps[i] = v
+        return {
+            "tokens": tokens,
+            "positions": positions,
+            "block_tables": tables,
+            "context_lens": ctx,
+            "valid_steps": valid_steps,
+        }
+
     def _preempt(self, victim: Sequence) -> None:
         log.warning("preempting %s (recompute)", victim.request_id)
         self.running.remove(victim)

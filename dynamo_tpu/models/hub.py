@@ -23,11 +23,16 @@ _WEIGHT_PATTERNS = [
 
 
 def is_repo_id(path: str) -> bool:
-    """'org/name'-shaped and not an existing local path."""
+    """'org/name'-shaped and not plausibly a local path. A nonexistent
+    two-segment path whose FIRST segment exists as a local directory is
+    treated as a local-path typo, not a hub repo — a mistyped
+    ``ckpts/llama3`` must error as a missing path, not dial the hub."""
     if not path or os.path.exists(path):
         return False
     parts = path.split("/")
-    return len(parts) == 2 and all(p and not p.startswith(".") for p in parts)
+    if len(parts) != 2 or not all(p and not p.startswith(".") for p in parts):
+        return False
+    return not os.path.isdir(parts[0])
 
 
 def cache_dir() -> str:

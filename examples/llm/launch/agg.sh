@@ -5,14 +5,17 @@
 set -e
 MODEL=${MODEL_PATH:?set MODEL_PATH to an HF dir or .gguf}
 
+PIDS=""
+trap 'kill $PIDS 2>/dev/null' EXIT
+
 python -m dynamo_tpu.cli.main store --port 4222 &
-STORE=$!
-trap 'kill $STORE' EXIT
+PIDS="$PIDS $!"
 
 # N identical workers behind the round-robin frontend
 python -m dynamo_tpu.cli.main run \
     --in dyn://dynamo.backend.generate --out jax \
-    --model-path "$MODEL" --quantization int8 &
+    --model-path "$MODEL" --quantization int8 --decode-steps 32 &
+PIDS="$PIDS $!"
 
 python -m dynamo_tpu.cli.main run --in http --out auto \
     --router-mode round_robin --http-port 8000

@@ -5,21 +5,25 @@
 set -e
 MODEL=${MODEL_PATH:?set MODEL_PATH to an HF dir or .gguf}
 
+PIDS=""
+trap 'kill $PIDS 2>/dev/null' EXIT
+
 python -m dynamo_tpu.cli.main store --port 4222 &
-STORE=$!
-trap 'kill $STORE' EXIT
+PIDS="$PIDS $!"
 
 # decode worker with disaggregation enabled: prompts longer than
 # --max-local-prefill-length go to the prefill queue
 python -m dynamo_tpu.cli.main run \
     --in dyn://dynamo.backend.generate --out jax \
-    --model-path "$MODEL" --quantization int8 \
+    --model-path "$MODEL" --quantization int8 --decode-steps 32 \
     --disagg --max-local-prefill-length 512 &
+PIDS="$PIDS $!"
 
 # dedicated prefill worker consuming the queue, KV pushed to decode
 python -m dynamo_tpu.cli.main run \
     --role prefill --out jax \
     --model-path "$MODEL" &
+PIDS="$PIDS $!"
 
 # KV-aware frontend
 python -m dynamo_tpu.cli.main run --in http --out auto \

@@ -380,3 +380,36 @@ async def test_kubectl_connector_shell_contract(tmp_path):
     calls = logf.read_text().splitlines()
     assert calls[0].startswith("-n prod get deployment/disagg-backend")
     assert calls[1] == "-n prod scale deployment/disagg-backend --replicas=5"
+
+
+async def test_mirror_only_touches_owned_files(tmp_path):
+    """The state mirror must never delete files it didn't create —
+    unrelated JSON and another namespace's mirror survive a sync."""
+    import os
+
+    state = str(tmp_path)
+    (tmp_path / "unrelated.json").write_text("{}")
+    store = MemoryStore()
+    other = Reconciler(store, "other-ns", state_dir=state)
+    await other.apply(GraphDeploymentSpec(
+        name="theirs", namespace="other-ns",
+        services={"backend": ServiceSpec(replicas=1)},
+    ))
+    rec = Reconciler(store, "ns", state_dir=state)
+    await rec.apply(GraphDeploymentSpec(
+        name="mine", namespace="ns",
+        services={"backend": ServiceSpec(replicas=1)},
+    ))
+    # both reconcilers sync with zero desired overlap changes
+    rec._sync_mirror(await rec.list_deployments())
+    other._sync_mirror(await other.list_deployments())
+    names = sorted(os.listdir(state))
+    assert "unrelated.json" in names
+    assert any("other-ns" in n and "theirs" in n for n in names)
+    assert any(n.startswith("dgd.ns.") and "mine" in n for n in names)
+    # delete propagates only within the owning namespace
+    await rec.delete("mine")
+    names = sorted(os.listdir(state))
+    assert not any(n.startswith("dgd.ns.") for n in names)
+    assert any("theirs" in n for n in names)
+    await store.close()

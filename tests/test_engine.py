@@ -489,6 +489,40 @@ async def test_multi_step_surplus_does_not_corrupt_full_width_table():
     assert cont_cached == cont_fresh
 
 
+async def test_pipelined_decode_with_mid_stream_arrival():
+    """The pipelined decode path must flush cleanly when a new request
+    arrives mid-generation (the next window is already in flight when
+    the scheduler sees the newcomer), and outputs must stay identical
+    to solo runs."""
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    engine = await JaxEngine.launch(
+        _engine_config(decode_steps=4, max_batch_size=4, num_blocks=96)
+    )
+    try:
+        p1 = list(range(1, 30))
+        p2 = list(range(5, 40))
+
+        async def delayed_second():
+            await asyncio.sleep(0.25)  # lands mid-way through p1's decode
+            return await _generate(engine, p2, max_tokens=12, request_id="mid2")
+
+        (t1, f1), (t2, f2) = await asyncio.gather(
+            _generate(engine, p1, max_tokens=24, request_id="mid1"),
+            delayed_second(),
+        )
+        assert f1.completion_tokens == 24 and len(t1) == 24
+        assert f2.completion_tokens == 12 and len(t2) == 12
+        # identical to unpipelined solo reruns (prefix cache warm now,
+        # but greedy continuations must not change)
+        s1, _ = await _generate(engine, p1, max_tokens=24, request_id="solo1")
+        s2, _ = await _generate(engine, p2, max_tokens=12, request_id="solo2")
+        assert s1 == t1 and s2 == t2
+        assert not engine.scheduler.running
+    finally:
+        await engine.shutdown()
+
+
 async def test_multi_step_under_block_pressure():
     """Fused windows + tight block pool: preemption/recompute must keep
     outputs correct and leak no blocks."""

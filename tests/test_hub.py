@@ -56,3 +56,13 @@ def test_download_gated_by_env(monkeypatch, tmp_path):
     with pytest.raises(ValueError, match="not cached locally"):
         resolve_hub_model("org/model")
     assert calls == [("org/model", True)]
+
+
+def test_local_path_typo_not_treated_as_repo(tmp_path, monkeypatch):
+    """A nonexistent two-segment path whose first segment IS a local
+    directory is a typo'd local path, not a hub repo."""
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "ckpts").mkdir()
+    assert not is_repo_id("ckpts/no-such-model")
+    # passes through untouched -> downstream raises a missing-path error
+    assert resolve_hub_model("ckpts/no-such-model") == "ckpts/no-such-model"
