@@ -61,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--quantization", default=None, choices=["int8"],
                      help="weight-only quantization applied at load "
                           "(halves weight HBM traffic)")
+    run.add_argument("--remote-kv-bucket", default="",
+                     help="G4 KV tier: bucket in the coordinator's "
+                          "object plane shared across workers "
+                          "(requires --host-kv-blocks > 0)")
     run.add_argument("--decode-steps", type=int, default=1,
                      help="fused decode window: tokens per device "
                           "dispatch (amortizes dispatch latency; tokens "
@@ -484,6 +488,19 @@ async def cmd_run(args: Any) -> None:
                 component, drt.primary_lease_id, jax_engine.stats
             )
             metrics_pub.start()
+            if getattr(args, "remote_kv_bucket", "") and jax_engine.kvbm is not None:
+                # G4 remote tier rides the coordinator's object plane.
+                # attach via executor: the initial index refresh blocks
+                # on THIS loop (calling it here would deadlock)
+                from dynamo_tpu.kvbm.remote import StoreObjectAdapter
+
+                adapter = StoreObjectAdapter(
+                    drt.store, args.remote_kv_bucket,
+                    asyncio.get_running_loop(),
+                )
+                await asyncio.get_running_loop().run_in_executor(
+                    None, jax_engine.kvbm.attach_remote, adapter
+                )
         await endpoint.serve(engine)
         if args.model_path and args.model_path.endswith(".gguf"):
             # ModelDeploymentCard artifacts (tokenizer.json etc.) come

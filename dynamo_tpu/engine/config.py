@@ -30,6 +30,10 @@ class EngineConfig:
     disk_kv_blocks: int = 0
     disk_kv_path: str = ""
     kv_offload_batch: int = 16
+    # G4 remote tier: bucket in the coordinator store's object plane
+    # ("" = disabled; requires the worker to run with a store, and
+    # host_kv_blocks > 0 for the demotion cascade to reach it)
+    remote_kv_bucket: str = ""
     # batching
     max_batch_size: int = 64
     max_prefill_tokens: int = 4096
@@ -78,6 +82,7 @@ def load_engine_config(args: Any) -> EngineConfig:
         host_kv_blocks=getattr(args, "host_kv_blocks", 0),
         disk_kv_blocks=getattr(args, "disk_kv_blocks", 0),
         disk_kv_path=getattr(args, "disk_kv_path", ""),
+        remote_kv_bucket=getattr(args, "remote_kv_bucket", ""),
     )
     for k, v in extra.items():
         if hasattr(cfg, k):

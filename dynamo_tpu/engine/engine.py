@@ -106,12 +106,16 @@ class JaxEngine:
     # ------------------------------------------------------------------
     @classmethod
     async def launch(
-        cls, config: EngineConfig, model_config: Optional[ModelConfig] = None
+        cls, config: EngineConfig, model_config: Optional[ModelConfig] = None,
+        remote_kv_objects=None,
     ) -> "JaxEngine":
         """``model_config`` injection skips reading config.json from
-        model_path (benchmarks / synthetic model shapes)."""
+        model_path (benchmarks / synthetic model shapes).
+        ``remote_kv_objects``: a kvbm SyncObjectStore backing the G4
+        remote tier when config.remote_kv_bucket is set."""
         engine = cls(config)
         engine.model_config = model_config
+        engine._remote_kv_objects = remote_kv_objects
         loop = asyncio.get_running_loop()
         engine._loop = loop
         await loop.run_in_executor(None, engine._initialize)
@@ -232,6 +236,7 @@ class JaxEngine:
                     disk_path=cfg.disk_kv_path
                     or f"/tmp/dynamo_tpu_kv_{os.getpid()}_{uuid.uuid4().hex[:8]}.bin",
                     offload_batch=cfg.kv_offload_batch,
+                    remote_bucket=cfg.remote_kv_bucket,
                 ),
                 BlockLayout.for_model(
                     self.model_config, cfg.block_size, cfg.kv_cache_dtype
@@ -239,6 +244,7 @@ class JaxEngine:
                 gather_fn=self._kv_gather,
                 scatter_fn=self._kv_scatter,
                 resolve_fn=self.allocator.lookup_block,
+                remote_objects=getattr(self, "_remote_kv_objects", None),
             )
             self.scheduler.onboard = self._safe_onboard
         self._build_step_fn()
@@ -530,14 +536,16 @@ class JaxEngine:
         while self._running:
             self._drain_incoming()
             if not self.scheduler.has_work:
-                # idle: drain the offload queue before sleeping
-                if self.kvbm is not None and self.kvbm.pending_offloads:
+                # idle: drain the offload queue (and run the pump's
+                # periodic G4 index refresh) before sleeping
+                if self.kvbm is not None:
                     try:
                         self.kvbm.pump()
                     except Exception:
                         log.exception("kv offload pump failed; disabling kvbm")
                         self._disable_kvbm()
-                    continue
+                    if self.kvbm is not None and self.kvbm.pending_offloads:
+                        continue  # more queued: keep draining
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue

@@ -44,6 +44,10 @@ class KvbmConfig:
     disk_num_blocks: int = 0
     disk_path: str = ""
     offload_batch: int = 16  # max blocks gathered per pump
+    # G4: remote object-storage tier (bucket in the coordinator store's
+    # object plane; "" disables). Shared across workers — blocks another
+    # worker demoted are onboardable here after refresh_remote_index().
+    remote_bucket: str = ""
 
 
 @dataclass
@@ -53,6 +57,96 @@ class KvbmStats:
     demoted_blocks: int = 0
     host_cached_blocks: int = 0
     disk_cached_blocks: int = 0
+    remote_put_blocks: int = 0
+    remote_got_blocks: int = 0
+
+
+class SyncObjectStore:
+    """Blocking object-plane facade the G4 tier runs on (the engine
+    thread has no event loop; the coordinator client is async — see
+    StoreObjectAdapter in dynamo_tpu/kvbm/remote.py for the bridge)."""
+
+    def put(self, key: str, data: bytes) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def get(self, key: str) -> Optional[bytes]:  # pragma: no cover
+        raise NotImplementedError
+
+    def get_many(self, keys: list[str]) -> list[Optional[bytes]]:
+        """Batched fetch; backends override to overlap the round trips
+        (one blocking wait instead of one per block)."""
+        return [self.get(k) for k in keys]
+
+    def list_keys(self) -> list[str]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class RemoteTier:
+    """G4: content-addressed KV blocks in remote object storage
+    (reference: block_manager.rs CacheLevel::G4 — remote storage behind
+    NIXL; here the coordinator store's object plane, so the tier is
+    shared by every worker of the model).
+
+    Unlike G2/G3 the capacity is remote and unbounded from the worker's
+    view, so there is no LRU/slot pool — keys ARE the sequence hashes.
+    ``contains`` consults a local index only (no network on the
+    admission path); ``refresh_remote_index`` pulls the bucket's key
+    list to discover blocks other workers demoted."""
+
+    def __init__(self, objects: SyncObjectStore, layout: BlockLayout):
+        self.objects = objects
+        self.layout = layout
+        self._known: set[int] = set()
+
+    @staticmethod
+    def _key(seq_hash: int) -> str:
+        return f"{seq_hash:016x}"
+
+    def contains(self, seq_hash: int) -> bool:
+        return seq_hash in self._known
+
+    @property
+    def num_known(self) -> int:
+        return len(self._known)
+
+    def insert(self, seq_hash: int, data: np.ndarray) -> None:
+        if seq_hash in self._known:
+            return
+        self.objects.put(self._key(seq_hash), np.ascontiguousarray(data).tobytes())
+        self._known.add(seq_hash)
+
+    def read(self, seq_hashes: list[int]) -> Optional[np.ndarray]:
+        """All-or-nothing batched read (a half-onboarded prefix is not
+        usable past the first gap anyway). NEVER raises: a flaky remote
+        reads as a miss — the caller truncates, it must not take the
+        whole kvbm down (engine._safe_onboard disables tiers on error)."""
+        try:
+            raws = self.objects.get_many([self._key(h) for h in seq_hashes])
+        except Exception:
+            import logging
+
+            logging.getLogger("dynamo_tpu.kvbm").exception("G4 read failed")
+            return None
+        out = np.zeros((len(seq_hashes), *self.layout.packed_shape),
+                       self.layout.np_dtype)
+        for i, (h, raw) in enumerate(zip(seq_hashes, raws)):
+            if raw is None or len(raw) != self.layout.block_bytes:
+                self._known.discard(h)
+                return None
+            out[i] = np.frombuffer(raw, self.layout.np_dtype).reshape(
+                self.layout.packed_shape
+            )
+        return out
+
+    def refresh_remote_index(self) -> int:
+        """Adopt keys other workers wrote; returns newly-known count."""
+        before = len(self._known)
+        for key in self.objects.list_keys():
+            try:
+                self._known.add(int(key, 16))
+            except ValueError:
+                continue
+        return len(self._known) - before
 
 
 class KvBlockManager:
@@ -63,6 +157,7 @@ class KvBlockManager:
         gather_fn: GatherFn,
         scatter_fn: ScatterFn,
         resolve_fn: ResolveFn,
+        remote_objects: Optional[SyncObjectStore] = None,
     ):
         self.config = config
         if config.host_num_blocks <= 0:
@@ -76,10 +171,14 @@ class KvBlockManager:
         self._gather = gather_fn
         self._scatter = scatter_fn
         self._resolve = resolve_fn
+        self.remote: Optional[RemoteTier] = None
+        if config.remote_bucket and remote_objects is not None:
+            self.remote = RemoteTier(remote_objects, layout)
         self.disk: Optional[TierPool] = None
         if config.disk_num_blocks > 0:
             self.disk = TierPool(
-                DiskBlockStorage(layout, config.disk_num_blocks, config.disk_path)
+                DiskBlockStorage(layout, config.disk_num_blocks, config.disk_path),
+                on_evict=self._demote_remote,
             )
         self.host = TierPool(
             HostBlockStorage(layout, config.host_num_blocks),
@@ -87,7 +186,21 @@ class KvBlockManager:
         )
         # offload candidates: seq_hash -> device block id at commit time
         self._pending: OrderedDict[int, int] = OrderedDict()
+        self._last_remote_refresh = 0.0
         self.stats = KvbmStats()
+
+    def attach_remote(self, objects: SyncObjectStore) -> None:
+        """Late-bind the G4 tier (the worker's store connection usually
+        comes up after the engine). Idempotent. MUST NOT be called on
+        the event loop a StoreObjectAdapter schedules onto — the initial
+        index refresh blocks on that loop (the CLI calls this via
+        run_in_executor)."""
+        if self.remote is None and self.config.remote_bucket:
+            self.remote = RemoteTier(objects, self.layout)
+            try:
+                self.remote.refresh_remote_index()
+            except Exception:
+                log.exception("initial G4 index refresh failed")
 
     # -- event intake (engine thread) -------------------------------------
     def on_block_committed(self, seq_hash: int, device_block: int) -> None:
@@ -95,9 +208,23 @@ class KvBlockManager:
             return
         self._pending[seq_hash] = device_block
 
+    REMOTE_REFRESH_S = 5.0
+
     # -- offload pump (engine thread, between steps) -----------------------
     def pump(self) -> int:
         """Offload up to ``offload_batch`` pending blocks; returns count."""
+        if self.remote is not None:
+            # periodic G4 index refresh: discover blocks OTHER workers
+            # demoted since we attached (the cross-worker tier benefit)
+            import time as _time
+
+            now = _time.monotonic()
+            if now - self._last_remote_refresh >= self.REMOTE_REFRESH_S:
+                self._last_remote_refresh = now
+                try:
+                    self.remote.refresh_remote_index()
+                except Exception:
+                    log.exception("G4 index refresh failed")
         if not self._pending:
             return 0
         batch: list[tuple[int, int]] = []
@@ -124,13 +251,33 @@ class KvBlockManager:
         if self.disk is not None:
             self.disk.insert(seq_hash, data)
             self.stats.demoted_blocks += 1
+        elif self.remote is not None:
+            # no G3: the cascade skips straight to remote
+            self._demote_remote(seq_hash, data)
+
+    def _demote_remote(self, seq_hash: int, data: np.ndarray) -> None:
+        if self.remote is None:
+            return
+        try:
+            self.remote.insert(seq_hash, data)
+            self.stats.demoted_blocks += 1
+            self.stats.remote_put_blocks += 1
+        except Exception:
+            # remote tier is best-effort cache: a flaky store must not
+            # take the engine's offload pump down
+            log.exception("G4 remote put failed for %x", seq_hash)
 
     # -- onboarding (engine thread, at admission) --------------------------
     def match_offloaded(self, seq_hashes: list[int]) -> int:
-        """Leading consecutive blocks available in G2/G3 (no copies)."""
+        """Leading consecutive blocks available in G2/G3/G4 (no copies,
+        no network — G4 membership is the local index)."""
         n = 0
         for h in seq_hashes:
-            if self.host.contains(h) or (self.disk is not None and self.disk.contains(h)):
+            if (
+                self.host.contains(h)
+                or (self.disk is not None and self.disk.contains(h))
+                or (self.remote is not None and self.remote.contains(h))
+            ):
                 n += 1
             else:
                 break
@@ -144,6 +291,7 @@ class KvBlockManager:
         # plan can't be invalidated by eviction cascades mid-loop)
         host_rows: list[tuple[int, int]] = []  # (row index, hash)
         disk_rows: list[tuple[int, int]] = []
+        remote_rows: list[tuple[int, int]] = []
         limit = min(len(seq_hashes), len(device_blocks))
         n = 0
         for i in range(limit):
@@ -152,11 +300,25 @@ class KvBlockManager:
                 host_rows.append((i, h))
             elif self.disk is not None and self.disk.contains(h):
                 disk_rows.append((i, h))
+            elif self.remote is not None and self.remote.contains(h):
+                remote_rows.append((i, h))
             else:
                 break
             n += 1
+        # G4 reads can fail (remote eviction, another namespace's GC):
+        # fetch BEFORE committing to n so a miss just truncates the
+        # onboarded prefix at the first remote row
+        remote_data = None
+        if remote_rows:
+            assert self.remote is not None
+            remote_data = self.remote.read([h for _, h in remote_rows])
+            if remote_data is None:
+                n = remote_rows[0][0]
+                remote_rows = []
         if n == 0:
             return 0
+        host_rows = [(i, h) for i, h in host_rows if i < n]
+        disk_rows = [(i, h) for i, h in disk_rows if i < n]
         rows = np.zeros((n, *self.layout.packed_shape), self.layout.np_dtype)
         if host_rows:
             data = self.host.read([h for _, h in host_rows])  # one batched read
@@ -168,11 +330,16 @@ class KvBlockManager:
             disk_data = self.disk.read([h for _, h in disk_rows])
             for j, (i, _) in enumerate(disk_rows):
                 rows[i] = disk_data[j]
+        for j, (i, _) in enumerate(remote_rows):
+            rows[i] = remote_data[j]
         self._scatter(device_blocks[:n], rows)
-        # promote disk hits into the host tier AFTER all reads and the
-        # scatter: promotion may trigger host->disk demotion evictions
+        # promote lower-tier hits into the host tier AFTER all reads and
+        # the scatter: promotion may trigger demotion-eviction cascades
         for j, (_, h) in enumerate(disk_rows):
             self.host.insert(h, disk_data[j])
+        for j, (_, h) in enumerate(remote_rows):
+            self.host.insert(h, remote_data[j])
+            self.stats.remote_got_blocks += 1
         self.stats.onboarded_blocks += n
         self._refresh_gauges()
         return n

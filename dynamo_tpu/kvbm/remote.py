@@ -1,0 +1,67 @@
+"""G4 remote-tier bridge: coordinator object plane ↔ engine thread.
+
+The engine thread (which owns the KVBM pump) has no event loop; the
+coordinator store client is async. ``StoreObjectAdapter`` schedules the
+client's object-plane calls onto the runtime's loop and blocks the
+engine thread on the result — exactly the pattern the reference uses
+for its remote tier behind blocking NIXL calls
+(reference: block_manager.rs CacheLevel::G4, block/transfer/nixl.rs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from dynamo_tpu.kvbm.manager import SyncObjectStore
+
+
+class StoreObjectAdapter(SyncObjectStore):
+    def __init__(self, store, bucket: str, loop: asyncio.AbstractEventLoop,
+                 timeout_s: float = 30.0):
+        self.store = store
+        self.bucket = bucket
+        self.loop = loop
+        self.timeout_s = timeout_s
+
+    def _run(self, coro):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout=self.timeout_s)
+
+    def put(self, key: str, data: bytes) -> None:
+        self._run(self.store.obj_put(self.bucket, key, data))
+
+    def get(self, key: str) -> Optional[bytes]:
+        return self._run(self.store.obj_get(self.bucket, key))
+
+    def get_many(self, keys: list[str]) -> list[Optional[bytes]]:
+        """One blocking wait for the whole batch: the gets overlap on
+        the loop instead of serializing engine-thread round trips."""
+
+        async def gather():
+            import asyncio as aio
+
+            return await aio.gather(
+                *[self.store.obj_get(self.bucket, k) for k in keys]
+            )
+
+        return list(self._run(gather()))
+
+    def list_keys(self) -> list[str]:
+        return list(self._run(self.store.obj_list(self.bucket)))
+
+
+class DictObjectStore(SyncObjectStore):
+    """In-process fake for tests and single-process serving."""
+
+    def __init__(self) -> None:
+        self.data: dict[str, bytes] = {}
+
+    def put(self, key: str, data: bytes) -> None:
+        self.data[key] = data
+
+    def get(self, key: str) -> Optional[bytes]:
+        return self.data.get(key)
+
+    def list_keys(self) -> list[str]:
+        return list(self.data)

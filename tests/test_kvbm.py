@@ -271,3 +271,176 @@ async def test_engine_offload_tier_extends_prefix_cache():
         assert engine.kvbm.stats.onboarded_blocks > before
     finally:
         await engine.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# G4 remote tier
+# ---------------------------------------------------------------------------
+
+
+def _manager_g4(dev, objects, host_blocks=2, disk_blocks=0, tmp=None):
+    return KvBlockManager(
+        KvbmConfig(
+            host_num_blocks=host_blocks,
+            disk_num_blocks=disk_blocks,
+            disk_path=str(tmp / "kv.bin") if tmp else "",
+            offload_batch=16,
+            remote_bucket="kvg4",
+        ),
+        LAYOUT,
+        gather_fn=dev.gather,
+        scatter_fn=dev.scatter,
+        resolve_fn=dev.resolve,
+        remote_objects=objects,
+    )
+
+
+def test_g4_demotion_cascade_and_onboard(tmp_path):
+    """G2 -> G3 -> G4 demotion cascade; onboarding reads back through
+    the tiers (reference: block_manager.rs CacheLevel::G4)."""
+    from dynamo_tpu.kvbm.remote import DictObjectStore
+
+    dev = FakeDevice(8)
+    objects = DictObjectStore()
+    m = _manager_g4(dev, objects, host_blocks=1, disk_blocks=1, tmp=tmp_path)
+    for i, h in enumerate([31, 32, 33]):  # 3 blocks through 1+1 tier slots
+        dev.blocks[i + 1] = _block(h)
+        dev.hash_index[h] = i + 1
+        m.on_block_committed(h, i + 1)
+        m.pump()
+    # 33 in host, 32 in disk, 31 pushed all the way to remote
+    assert m.host.contains(33) and m.disk.contains(32)
+    assert m.remote is not None and m.remote.contains(31)
+    assert m.stats.remote_put_blocks == 1
+    assert m.match_offloaded([31, 32, 33]) == 3
+    dev.hash_index.clear()
+    n = m.onboard([31, 32, 33], [5, 6, 7])
+    assert n == 3
+    for slot, h in ((5, 31), (6, 32), (7, 33)):
+        np.testing.assert_array_equal(dev.blocks[slot], _block(h))
+    assert m.stats.remote_got_blocks == 1
+    m.close()
+
+
+def test_g4_without_disk_demotes_host_evictions():
+    from dynamo_tpu.kvbm.remote import DictObjectStore
+
+    dev = FakeDevice(8)
+    objects = DictObjectStore()
+    m = _manager_g4(dev, objects, host_blocks=1)
+    for i, h in enumerate([41, 42]):
+        dev.blocks[i + 1] = _block(h)
+        dev.hash_index[h] = i + 1
+        m.on_block_committed(h, i + 1)
+        m.pump()
+    assert m.remote.contains(41)  # evicted straight to G4 (no G3)
+    assert m.match_offloaded([41, 42]) == 2
+
+
+def test_g4_shared_across_workers():
+    """The remote bucket is shared: worker B discovers and onboards
+    blocks worker A demoted (the cross-worker win of a remote tier)."""
+    from dynamo_tpu.kvbm.remote import DictObjectStore
+
+    objects = DictObjectStore()
+    dev_a = FakeDevice(8)
+    a = _manager_g4(dev_a, objects, host_blocks=1)
+    for i, h in enumerate([51, 52]):
+        dev_a.blocks[i + 1] = _block(h)
+        dev_a.hash_index[h] = i + 1
+        a.on_block_committed(h, i + 1)
+        a.pump()
+    assert a.remote.contains(51)
+
+    dev_b = FakeDevice(8)
+    b = _manager_g4(dev_b, objects, host_blocks=2)
+    assert b.match_offloaded([51]) == 0  # not discovered yet
+    # the engine's pump runs the periodic index refresh
+    b.REMOTE_REFRESH_S = 0.0
+    b.pump()
+    assert b.match_offloaded([51]) == 1
+    assert b.onboard([51], [3]) == 1
+    np.testing.assert_array_equal(dev_b.blocks[3], _block(51))
+    # promoted into B's host tier on access
+    assert b.host.contains(51)
+
+
+def test_g4_missing_remote_truncates_onboard():
+    """A block that vanished from the remote bucket (GC, eviction) must
+    truncate the onboarded prefix, not corrupt it."""
+    from dynamo_tpu.kvbm.remote import DictObjectStore
+
+    dev = FakeDevice(8)
+    objects = DictObjectStore()
+    m = _manager_g4(dev, objects, host_blocks=1)
+    for i, h in enumerate([61, 62]):
+        dev.blocks[i + 1] = _block(h)
+        dev.hash_index[h] = i + 1
+        m.on_block_committed(h, i + 1)
+        m.pump()
+    assert m.remote.contains(61)
+    objects.data.clear()  # remote GC'd everything
+    dev.hash_index.clear()
+    # 61 is G4 (gone), 62 is host: prefix truncates at the missing row
+    assert m.onboard([61, 62], [5, 6]) == 0
+    assert not m.remote.contains(61)  # negative result un-indexes
+
+
+async def test_engine_g4_tier_round_trip():
+    """Engine-level G4: a tiny host tier cascades into the remote
+    object store, and a repeat prompt onboards back through it."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.kvbm.remote import DictObjectStore
+    from tests.test_engine import _generate
+
+    objects = DictObjectStore()
+    engine = await JaxEngine.launch(
+        EngineConfig(
+            model_path=MODEL_DIR, model_name="tiny", random_weights=True,
+            num_blocks=13, block_size=8, max_batch_size=4,
+            prefill_chunk_size=32, max_model_len=128,
+            host_kv_blocks=4, kv_offload_batch=8,
+            remote_kv_bucket="kvg4",
+        ),
+        remote_kv_objects=objects,
+    )
+    try:
+        assert engine.kvbm is not None and engine.kvbm.remote is not None
+        prompt_a = list(range(1, 41))
+        toks_a, _ = await _generate(engine, prompt_a, request_id="a")
+        for i, base in enumerate((50, 100, 150)):  # churn both G1 and G2
+            await _generate(
+                engine, list(range(base, base + 40)), request_id=f"churn{i}"
+            )
+        await asyncio.sleep(0.3)
+        assert engine.kvbm.stats.remote_put_blocks > 0
+        assert objects.data  # blocks really landed in the object plane
+        toks_a2, _ = await _generate(engine, prompt_a, request_id="a2")
+        assert toks_a2 == toks_a
+    finally:
+        await engine.shutdown()
+
+
+def test_g4_flaky_remote_reads_as_miss_not_crash():
+    """A raising remote store must degrade to a cache miss — one G4
+    timeout must not take the host/disk tiers down (engine._safe_onboard
+    disables the whole kvbm on exceptions)."""
+    from dynamo_tpu.kvbm.remote import DictObjectStore
+
+    class Flaky(DictObjectStore):
+        def get_many(self, keys):
+            raise TimeoutError("store stall")
+
+    dev = FakeDevice(8)
+    objects = Flaky()
+    m = _manager_g4(dev, objects, host_blocks=1)
+    for i, h in enumerate([71, 72]):
+        dev.blocks[i + 1] = _block(h)
+        dev.hash_index[h] = i + 1
+        m.on_block_committed(h, i + 1)
+        m.pump()
+    assert m.remote.contains(71)
+    dev.hash_index.clear()
+    # remote read raises -> treated as missing prefix row, no exception
+    assert m.onboard([71, 72], [5, 6]) == 0
