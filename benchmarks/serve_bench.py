@@ -157,6 +157,8 @@ def main() -> None:
     env = dict(os.environ, PYTHONPATH=REPO)
     if cli.mode == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
+    server_log = os.path.join(tmp, "server.log")
+    log_fh = open(server_log, "w")
     proc = subprocess.Popen(
         [
             sys.executable, "-m", "dynamo_tpu.cli.main", "run",
@@ -166,12 +168,18 @@ def main() -> None:
             "--extra-engine-args", engine_args,
         ],
         env=env,
-        stdout=subprocess.DEVNULL,
+        stdout=log_fh,
         stderr=subprocess.STDOUT,
     )
     url = f"http://127.0.0.1:{port}"
     try:
-        wait_ready(url, cli.ready_timeout)
+        try:
+            wait_ready(url, cli.ready_timeout)
+        except RuntimeError:
+            with open(server_log) as f:
+                print("--- server log tail ---\n" + f.read()[-4000:],
+                      file=sys.stderr)
+            raise
 
         class A:
             pass
@@ -214,6 +222,7 @@ def main() -> None:
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
+        log_fh.close()
         shutil.rmtree(tmp, ignore_errors=True)
 
 

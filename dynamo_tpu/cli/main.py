@@ -488,7 +488,12 @@ async def cmd_run(args: Any) -> None:
                 component, drt.primary_lease_id, jax_engine.stats
             )
             metrics_pub.start()
-            if getattr(args, "remote_kv_bucket", "") and jax_engine.kvbm is not None:
+            if (
+                getattr(args, "remote_kv_bucket", "")
+                and jax_engine.kvbm is not None
+                and hasattr(jax_engine.kvbm, "attach_remote")
+                # multihost ShardedKvOffload has no remote tier
+            ):
                 # G4 remote tier rides the coordinator's object plane.
                 # attach via executor: the initial index refresh blocks
                 # on THIS loop (calling it here would deadlock)

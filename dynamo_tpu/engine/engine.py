@@ -143,6 +143,10 @@ class JaxEngine:
         )
         devices = jax.devices()[: mesh_cfg.size]
         self.mesh = build_mesh(mesh_cfg, devices)
+        if cfg.num_nodes > 1 and cfg.node_rank == 0:
+            from dynamo_tpu.parallel.multihost import StepBroadcaster
+
+            self._mh_broadcast = StepBroadcaster()
 
         specs_fn = None
         cache_spec = None
@@ -218,16 +222,26 @@ class JaxEngine:
                 "cascades from the G2 host tier)"
             )
         if cfg.host_kv_blocks > 0 and cfg.num_nodes > 1:
-            # Multi-host caches are not fully addressable from one
-            # process. The design for lifting this (docs/multihost.md
-            # "Sharded KV offload"): each host offloads only its LOCAL
-            # shard of a block (no cross-host traffic), keyed by (block
-            # hash, shard index); gather/scatter become broadcast step
-            # kinds in the leader/follower protocol so every process
-            # enters the same jitted copy. Until that lands, tiers stay
-            # G1-only on multihost rather than silently serving torn
-            # blocks.
-            log.warning("KV offload tiers unsupported with num_nodes>1; disabled")
+            # Sharded KV offload (docs/multihost.md): each process
+            # offloads only its LOCAL shard via mirrored gather/scatter
+            # broadcasts — G2 host tier only; disk/remote demotion and
+            # disagg export stay single-host features.
+            if cfg.disk_kv_blocks > 0 or cfg.remote_kv_bucket:
+                log.warning(
+                    "disk/remote KV tiers unsupported with num_nodes>1; "
+                    "serving with the sharded host tier only"
+                )
+            if cfg.node_rank == 0:
+                from dynamo_tpu.parallel.multihost import ShardedKvOffload
+
+                assert self._mh_broadcast is not None
+                self.kvbm = ShardedKvOffload(
+                    self, self._mh_broadcast,
+                    host_num_blocks=cfg.host_kv_blocks,
+                    offload_batch=cfg.kv_offload_batch,
+                )
+                self.scheduler.onboard = self._safe_onboard
+            # followers build their shard pool inside StepFollower.run
         elif cfg.host_kv_blocks > 0:
             self.kvbm = KvBlockManager(
                 KvbmConfig(
@@ -248,10 +262,6 @@ class JaxEngine:
             )
             self.scheduler.onboard = self._safe_onboard
         self._build_step_fn()
-        if cfg.num_nodes > 1 and cfg.node_rank == 0:
-            from dynamo_tpu.parallel.multihost import StepBroadcaster
-
-            self._mh_broadcast = StepBroadcaster()
         log.info(
             "engine up: %s, mesh=%s, blocks=%d×%d",
             cfg.model_name,
@@ -623,7 +633,11 @@ class JaxEngine:
             bid = self.allocator.lookup_block(h)
             if bid is not None:
                 plan.append(("dev", bid))
-            elif self.kvbm is not None and self.kvbm.host.contains(h):
+            elif (
+                self.kvbm is not None
+                and hasattr(self.kvbm.host, "read")  # not the multihost shard pool
+                and self.kvbm.host.contains(h)
+            ):
                 plan.append(("host", h))
             else:
                 break
@@ -649,6 +663,10 @@ class JaxEngine:
         next admission onboards them into HBM (kvbm onboard())."""
         if self.kvbm is None:
             raise RuntimeError("KV import requires host_kv_blocks > 0")
+        if not hasattr(self.kvbm.host, "read"):
+            # ShardedKvOffload: a leader-local insert of full-packed rows
+            # would silently break pool lockstep with the followers
+            raise RuntimeError("KV import is unsupported with num_nodes > 1")
         if len(seq_hashes) > self.kvbm.host.num_blocks:
             # inserting would LRU-evict the delivery's own leading blocks,
             # silently voiding the remote prefill — reject instead

@@ -35,6 +35,8 @@ CTRL_LEN = 8
 KIND_STOP = 0
 KIND_STEP = 1  # single fused step (prefill or 1-token decode)
 KIND_MULTI_STEP = 2  # fused K-step decode window
+KIND_KV_GATHER = 3  # mirrored KV offload gather (shard-local store)
+KIND_KV_SCATTER = 4  # mirrored KV onboard scatter (shard-local load)
 
 
 class StepBroadcaster:
@@ -61,6 +63,19 @@ class StepBroadcaster:
         w = arrays["block_tables"].shape[1]
         self._ctrl(KIND_MULTI_STEP, b, 1, w)
         self._bcast(_multi_step_tuple(arrays, sampling))
+
+    def announce_kv(self, kind: int, block_ids: list[int],
+                    seq_hashes: list[int]) -> None:
+        """Mirrored KV gather/scatter: every process must enter the same
+        jitted copy with the same ids; hashes key each process's
+        shard-local pool. Hashes travel as two uint32 halves — JAX
+        canonicalizes uint64 to uint32 (x64 disabled), which would
+        silently truncate the xxh3 keys in flight."""
+        self._ctrl(kind, len(block_ids))
+        self._bcast((
+            np.asarray(block_ids, np.int32),
+            _split_hashes(seq_hashes),
+        ))
 
     def announce_stop(self) -> None:
         self._ctrl(KIND_STOP)
@@ -124,6 +139,238 @@ def _zeros_multi_step(b: int, w: int) -> tuple:
     )
 
 
+# ---------------------------------------------------------------------------
+# Sharded KV offload (docs/multihost.md "Sharded KV offload"): every
+# process runs the SAME jitted gather/scatter over the tp-sharded cache,
+# then stores/loads only its ADDRESSABLE slice of the packed blocks in a
+# process-local host pool. No cross-host traffic: G2 capacity scales
+# with the host count, and blocks reassemble implicitly because every
+# process scatters its own shard back.
+# ---------------------------------------------------------------------------
+
+
+def _split_hashes(seq_hashes: list[int]) -> np.ndarray:
+    """uint64 hashes -> uint32 [2, n] (hi, lo) — survives JAX's
+    x64-disabled canonicalization on the broadcast path."""
+    arr = np.asarray(seq_hashes, np.uint64)
+    return np.stack([
+        (arr >> np.uint64(32)).astype(np.uint32),
+        (arr & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+    ])
+
+
+def _join_hashes(halves: np.ndarray) -> list[int]:
+    halves = np.asarray(halves)
+    return [
+        (int(hi) << 32) | int(lo) for hi, lo in zip(halves[0], halves[1])
+    ]
+
+
+def _packed_spec():
+    from jax.sharding import PartitionSpec as P
+
+    # packed blocks [n, 2, L, bs, H, D]: the KV-head axis carries the
+    # cache's tp sharding, everything else replicated
+    return P(None, None, None, None, "tp", None)
+
+
+def _bucket_ids(block_ids: np.ndarray) -> np.ndarray:
+    """Pad to the block_copy ID buckets so each batch size compiles once
+    per bucket — padding reads/writes the reserved garbage block 0."""
+    from dynamo_tpu.ops.block_copy import _bucket
+
+    n = len(block_ids)
+    ids = np.zeros((_bucket(n),), np.int32)
+    ids[:n] = block_ids
+    return ids
+
+
+def mirror_gather(k_cache, v_cache, block_ids: np.ndarray, block_size: int,
+                  mesh) -> np.ndarray:
+    """All processes: jitted gather constrained to the packed spec, then
+    extract this process's H-slice (dp replicas deduped)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from dynamo_tpu.ops.block_copy import _gather
+
+    n = len(block_ids)
+    with mesh:
+        packed = _gather(
+            k_cache, v_cache, jnp_i32(_bucket_ids(block_ids)), block_size
+        )
+        packed = jax.device_put(
+            packed, NamedSharding(mesh, _packed_spec())
+        )
+        jax.block_until_ready(packed)
+    return local_packed_rows(packed)[:n]
+
+
+def mirror_scatter(k_cache, v_cache, block_ids: np.ndarray,
+                   local_rows: np.ndarray, block_size: int, mesh):
+    """All processes: assemble the global packed array from per-process
+    shard rows, then the jitted scatter. Returns new (k, v)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from dynamo_tpu.ops.block_copy import _scatter
+
+    n = len(block_ids)
+    ids = _bucket_ids(block_ids)
+    if len(ids) != n:  # pad rows to the bucket (land in garbage block 0)
+        pad = np.zeros((len(ids) - n, *local_rows.shape[1:]), local_rows.dtype)
+        local_rows = np.concatenate([local_rows, pad], axis=0)
+    global_shape = (
+        len(ids), 2, k_cache.shape[0], block_size,
+        k_cache.shape[2], k_cache.shape[3],
+    )
+    sharding = NamedSharding(mesh, _packed_spec())
+    data = jax.make_array_from_process_local_data(
+        sharding, np.ascontiguousarray(local_rows), global_shape
+    )
+    with mesh:
+        return _scatter(k_cache, v_cache, jnp_i32(ids), data, block_size)
+
+
+def jnp_i32(arr: np.ndarray):
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.asarray(arr, np.int32))
+
+
+def local_packed_rows(arr) -> np.ndarray:
+    """This process's slice of packed blocks [n, 2, L, bs, H, D]: unique
+    H-extents of its addressable shards, concatenated in H order (dp
+    replicas collapse to one copy)."""
+    seen: dict[int, np.ndarray] = {}
+    for shard in arr.addressable_shards:
+        h0 = shard.index[4].start or 0
+        if h0 not in seen:
+            seen[h0] = np.asarray(shard.data)
+    return np.concatenate([seen[h] for h in sorted(seen)], axis=4)
+
+
+class ShardKvPool:
+    """Process-local content-addressed pool of packed-block SHARDS.
+    Mutations are driven exclusively by the broadcast gather/scatter
+    sequence, so every process's pool holds the same hash set (contents
+    differ: each holds its own shard) and LRU decisions stay in
+    lockstep."""
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = num_blocks
+        self._data: "dict[int, np.ndarray]" = {}
+
+    def insert_many(self, seq_hashes: list[int], rows: np.ndarray) -> None:
+        for i, h in enumerate(seq_hashes):
+            h = int(h)
+            if h in self._data:
+                self._data.pop(h)  # re-insert refreshes recency
+            self._data[h] = rows[i]
+            if len(self._data) > self.num_blocks:
+                self._data.pop(next(iter(self._data)))  # LRU-ish FIFO
+
+    def contains(self, seq_hash: int) -> bool:
+        return int(seq_hash) in self._data
+
+    def rows(self, seq_hashes: list[int], row_shape, dtype) -> np.ndarray:
+        out = np.zeros((len(seq_hashes), *row_shape), dtype)
+        for i, h in enumerate(seq_hashes):
+            row = self._data.get(int(h))
+            if row is not None:
+                out[i] = row
+        return out
+
+    @property
+    def num_cached(self) -> int:
+        return len(self._data)
+
+
+class ShardedKvOffload:
+    """Leader-side G2 offload manager for multi-host engines — the
+    KvBlockManager surface the engine drives (on_block_committed / pump /
+    onboard / pending_offloads / close), actuated through the mirrored
+    gather/scatter broadcasts so every process moves its own shard.
+
+    Tiers are G2-only here (host DRAM per process); disk/remote demotion
+    and disagg export stay single-host features for now."""
+
+    def __init__(self, engine, broadcaster: StepBroadcaster,
+                 host_num_blocks: int, offload_batch: int = 16):
+        self.engine = engine
+        self.broadcaster = broadcaster
+        self.pool = ShardKvPool(host_num_blocks)
+        self.host = self.pool  # duck-typed contains/num_blocks for probes
+        self.disk = None
+        self.remote = None
+        self._offload_batch = max(1, min(offload_batch, host_num_blocks))
+        from collections import OrderedDict
+
+        self._pending: "OrderedDict[int, int]" = OrderedDict()
+
+    # engine surface ------------------------------------------------------
+    def on_block_committed(self, seq_hash: int, device_block: int) -> None:
+        if not self.pool.contains(seq_hash):
+            self._pending[seq_hash] = device_block
+
+    @property
+    def pending_offloads(self) -> int:
+        return len(self._pending)
+
+    def pump(self) -> int:
+        e = self.engine
+        batch: list[tuple[int, int]] = []
+        while self._pending and len(batch) < self._offload_batch:
+            h, bid = self._pending.popitem(last=False)
+            if e.allocator.lookup_block(h) == bid and not self.pool.contains(h):
+                batch.append((h, bid))
+        if not batch:
+            return 0
+        hashes = [h for h, _ in batch]
+        ids = [b for _, b in batch]
+        self.broadcaster.announce_kv(KIND_KV_GATHER, ids, hashes)
+        rows = mirror_gather(
+            e.k_cache, e.v_cache, np.asarray(ids, np.int32),
+            e.config.block_size, e.mesh,
+        )
+        self.pool.insert_many(hashes, rows)
+        return len(batch)
+
+    def match_offloaded(self, seq_hashes: list[int]) -> int:
+        n = 0
+        for h in seq_hashes:
+            if self.pool.contains(h):
+                n += 1
+            else:
+                break
+        return n
+
+    def onboard(self, seq_hashes: list[int], device_blocks: list[int]) -> int:
+        e = self.engine
+        limit = min(len(seq_hashes), len(device_blocks))
+        n = 0
+        for i in range(limit):
+            if self.pool.contains(seq_hashes[i]):
+                n += 1
+            else:
+                break
+        if n == 0:
+            return 0
+        hashes = list(seq_hashes[:n])
+        ids = list(device_blocks[:n])
+        sample = next(iter(self.pool._data.values()))
+        rows = self.pool.rows(hashes, sample.shape, sample.dtype)
+        self.broadcaster.announce_kv(KIND_KV_SCATTER, ids, hashes)
+        e.k_cache, e.v_cache = mirror_scatter(
+            e.k_cache, e.v_cache, np.asarray(ids, np.int32), rows,
+            e.config.block_size, e.mesh,
+        )
+        return n
+
+    def close(self) -> None:
+        self._pending.clear()
+
+
 class StepFollower:
     """Follower side: mirror the leader's device dispatches until STOP.
 
@@ -140,11 +387,35 @@ class StepFollower:
 
     def run(self) -> None:
         e = self.engine
+        pool: Optional[ShardKvPool] = None
+        if e.config.host_kv_blocks > 0:
+            pool = ShardKvPool(e.config.host_kv_blocks)
         while True:
             ctrl = np.asarray(self._bcast(np.zeros((CTRL_LEN,), np.int32)))
             kind, b, t, w = (int(x) for x in ctrl[:4])
             if kind == KIND_STOP:
                 return
+            if kind in (KIND_KV_GATHER, KIND_KV_SCATTER):
+                ids, halves = self._bcast((
+                    np.zeros((b,), np.int32), np.zeros((2, b), np.uint32),
+                ))
+                ids = np.asarray(ids)
+                hashes = _join_hashes(halves)
+                assert pool is not None, "leader offloads but follower has no pool"
+                if kind == KIND_KV_GATHER:
+                    rows = mirror_gather(
+                        e.k_cache, e.v_cache, ids,
+                        e.config.block_size, e.mesh,
+                    )
+                    pool.insert_many(hashes, rows)
+                else:
+                    sample = next(iter(pool._data.values()))
+                    rows = pool.rows(hashes, sample.shape, sample.dtype)
+                    e.k_cache, e.v_cache = mirror_scatter(
+                        e.k_cache, e.v_cache, ids, rows,
+                        e.config.block_size, e.mesh,
+                    )
+                continue
             if kind == KIND_STEP:
                 args = self._bcast(_zeros_step(b, t, w))
                 (tokens, positions, slots, tables, ctx, last,

@@ -41,24 +41,41 @@ async def main(rank: int, coord: str) -> None:
     engine = await JaxEngine.launch(
         EngineConfig(
             model_path="", model_name="mh", random_weights=True,
-            num_blocks=32, block_size=8, max_batch_size=4,
+            num_blocks=14, block_size=8, max_batch_size=4,
             tensor_parallel_size=2, decode_steps=2,
             num_nodes=2, node_rank=rank, leader_addr=coord,
             kv_cache_dtype="float32",
+            # sharded G2 offload: small device pool forces eviction,
+            # the repeat prompt onboards through the mirrored tier
+            host_kv_blocks=16,
         ),
         model_config=mc,
     )
     try:
         if rank == 0:
-            req = PreprocessedRequest(
-                request_id="mh-0", token_ids=list(range(1, 20)),
-                sampling=SamplingOptions(use_greedy=True),
-                stop=StopConditions(max_tokens=6, ignore_eos=True),
-            )
-            toks = []
-            async for out in engine.as_async_engine().generate(req, Context()):
-                toks.extend(out.token_ids)
-            print("RESULT " + json.dumps({"tokens": toks}), flush=True)
+            async def gen(rid: str, prompt: list) -> list:
+                req = PreprocessedRequest(
+                    request_id=rid, token_ids=prompt,
+                    sampling=SamplingOptions(use_greedy=True),
+                    stop=StopConditions(max_tokens=6, ignore_eos=True),
+                )
+                toks = []
+                async for out in engine.as_async_engine().generate(req, Context()):
+                    toks.extend(out.token_ids)
+                return toks
+
+            prompt_a = list(range(1, 34))  # 4+ blocks
+            toks = await gen("mh-0", prompt_a)
+            # churn evicts A from the device pool (13 usable blocks)
+            for i, base in enumerate((40, 80)):
+                await gen(f"churn{i}", list(range(base, base + 33)))
+            await asyncio.sleep(0.5)  # idle pump offloads shards
+            offloaded = engine.kvbm.pool.num_cached if engine.kvbm else 0
+            toks2 = await gen("mh-1", prompt_a)
+            print("RESULT " + json.dumps({
+                "tokens": toks, "repeat_matches": toks2 == toks,
+                "offloaded": offloaded,
+            }), flush=True)
         else:
             # follower: the engine thread runs the mirror loop; wait for
             # it to exit on the leader's STOP broadcast

@@ -46,7 +46,31 @@ def test_two_process_engine_serves_request():
         assert result_lines, outs[0][-3000:]
         result = json.loads(result_lines[0][len("RESULT "):])
         assert len(result["tokens"]) == 6
+        # sharded G2 offload: shards were pumped into the per-process
+        # pool, and the repeat prompt (onboarding through the mirrored
+        # tier after device eviction) continues identically
+        assert result["offloaded"] > 0, result
+        assert result["repeat_matches"], result
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+def test_hash_halves_survive_broadcast_canonicalization():
+    """xxh3 hashes are 64-bit; jax canonicalizes uint64 -> uint32 on the
+    broadcast path (x64 off), so they travel as two uint32 halves."""
+    from dynamo_tpu.parallel.multihost import _join_hashes, _split_hashes
+
+    hashes = [0, 1, 2**32 - 1, 2**32, 2**40 + 5, 2**63 + 17, 2**64 - 1]
+    halves = _split_hashes(hashes)
+    assert halves.dtype == __import__("numpy").uint32
+    assert halves.shape == (2, len(hashes))
+    assert _join_hashes(halves) == hashes
+    # and the canonicalization that motivated this: a uint64 round trip
+    # through jnp would NOT have survived
+    import jax.numpy as jnp
+    import numpy as np
+
+    truncated = np.asarray(jnp.asarray(np.asarray([2**40 + 5], np.uint64)))
+    assert int(truncated[0]) != 2**40 + 5  # the bug this guards against
