@@ -64,8 +64,13 @@ SHAPES = {
         ),
         engine=dict(random_weights=True, quantization="int8",
                     block_size=16, max_batch_size=32, decode_steps=32,
-                    hbm_utilization=0.7, prefill_chunk_size=1024),
-        isl=128, osl=128, duration=90.0, concurrency=[1, 4, 16, 32],
+                    hbm_utilization=0.7, prefill_chunk_size=1024,
+                    max_model_len=320),
+        # isl is in WORDS (load_gen builds text); the test tokenizer
+        # expands ~9 tokens/word, so 14 words ≈ 130 prompt tokens —
+        # matching bench.py's 128/128 token workload under
+        # max_model_len=320
+        isl=14, osl=128, duration=90.0, concurrency=[1, 4, 16, 32],
     ),
 }
 
@@ -116,6 +121,13 @@ async def drive(args, shape: dict) -> list[dict]:
         stats = await run_closed_loop(args, c)
         from load_gen import _percentiles
 
+        if stats.completed and not stats.tokens:
+            raise RuntimeError(
+                f"concurrency {c}: {stats.completed} requests completed "
+                "with ZERO output tokens — the server is rejecting the "
+                "workload (prompt over max_model_len?); results would "
+                "be garbage"
+            )
         row = {
             "concurrency": c,
             "completed": stats.completed,
@@ -154,7 +166,14 @@ def main() -> None:
     with open(engine_args, "w") as f:
         json.dump(shape["engine"], f)
     port = free_port()
-    env = dict(os.environ, PYTHONPATH=REPO)
+    # APPEND to PYTHONPATH: replacing it would drop the accelerator
+    # plugin's sitecustomize dir (e.g. the axon tunnel registers its
+    # backend at interpreter boot via a PYTHONPATH entry)
+    inherited = os.environ.get("PYTHONPATH", "")
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO + (os.pathsep + inherited if inherited else ""),
+    )
     if cli.mode == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
     server_log = os.path.join(tmp, "server.log")

@@ -314,9 +314,14 @@ class JaxEngine:
         # bf16 tensors (h/q/k/v/attn/out), 3 F-wide (gate/up/act, ×E for
         # dense-compute MoE), plus f32 attention scores H × S_table
         area = self.config.max_batch_size * self.config.prefill_chunk_size
-        s_est = (
+        # scores-width estimate: capped — attention scores are one
+        # layer-transient, and an uncapped max_position_embeddings
+        # (e.g. 8192 default) would swallow the whole budget and floor
+        # the cache into thrashing territory
+        s_est = min(
             (self.config.max_model_len or mc.max_position_embeddings)
-            + 8 * self.config.block_size
+            + 8 * self.config.block_size,
+            4096,
         )
         e_mult = max(1, mc.num_local_experts)
         per_tok = (
@@ -335,6 +340,15 @@ class JaxEngine:
         budget_total = budget * (self.config.tensor_parallel_size
                                   * self.config.pipeline_parallel_size)
         n = int(budget_total // bytes_per_block_total)
+        one_seq = -(-(self.config.max_model_len or mc.max_position_embeddings)
+                    // self.config.block_size) + 2
+        if n < one_seq:
+            log.warning(
+                "auto-sized KV cache (%d blocks) can't hold one "
+                "max_model_len sequence (%d blocks): serving will thrash "
+                "— lower max_batch_size/prefill_chunk_size or set "
+                "num_blocks explicitly", n, one_seq,
+            )
         return max(16, min(n, 1_000_000))
 
     def _on_kv_event(self, op: str, hashes: list[int], blocks: list[int]) -> None:
@@ -574,12 +588,16 @@ class JaxEngine:
 
     def _disable_kvbm(self) -> None:
         """Offload tiers are an optimization: on failure, degrade to
-        G1-only rather than taking the engine down."""
+        G1-only rather than taking the engine down. Multihost: the
+        sharded manager first broadcasts the disable so follower shard
+        pools drop in lockstep (runs on the engine thread, while
+        followers are still in their receive loop)."""
         if self.kvbm is not None:
             kvbm, self.kvbm = self.kvbm, None
             if self.scheduler is not None:
                 self.scheduler.onboard = None
             try:
+                getattr(kvbm, "on_disable", lambda: None)()
                 kvbm.close()
             except Exception:
                 pass
@@ -625,6 +643,11 @@ class JaxEngine:
         from dynamo_tpu.kvbm import BlockLayout
 
         assert self.allocator is not None and self.model_config is not None
+        if self.config.num_nodes > 1:
+            # the device gather below is leader-local; over a cross-
+            # process-sharded cache it would hang a collective. Disagg
+            # export is single-host (docs/multihost.md Limits).
+            raise RuntimeError("KV export is unsupported with num_nodes > 1")
         layout = BlockLayout.for_model(
             self.model_config, self.config.block_size, self.config.kv_cache_dtype
         )

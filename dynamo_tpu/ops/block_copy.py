@@ -52,13 +52,29 @@ def _scatter(k, v, ids, packed, block_size):
     return kr.reshape(L, S, H, D), vr.reshape(L, S, H, D)
 
 
+def pad_ids_to_bucket(block_ids) -> np.ndarray:
+    """Pad a block-id batch to its compile bucket. Padding entries are
+    the reserved garbage block 0 (padding gathers read it and are
+    discarded; padding scatters write it, harmlessly). The ONE home of
+    this convention — the multihost mirrored copies use it too."""
+    n = len(block_ids)
+    ids = np.zeros((_bucket(n),), np.int32)
+    ids[:n] = block_ids
+    return ids
+
+
+def pad_rows_to(n_ids: int, data: np.ndarray) -> np.ndarray:
+    """Zero-pad packed rows to match a bucketed id batch."""
+    if n_ids == len(data):
+        return data
+    pad = np.zeros((n_ids - len(data), *data.shape[1:]), data.dtype)
+    return np.concatenate([data, pad], axis=0)
+
+
 def gather_blocks(k, v, block_ids: list[int], block_size: int) -> np.ndarray:
     """Device → host: returns packed [n, 2, L, bs, Hkv, Dh] ndarray."""
     n = len(block_ids)
-    B = _bucket(n)
-    ids = np.zeros((B,), np.int32)
-    ids[:n] = block_ids
-    packed = _gather(k, v, ids, block_size)
+    packed = _gather(k, v, pad_ids_to_bucket(block_ids), block_size)
     return np.asarray(packed)[:n]
 
 
@@ -67,11 +83,6 @@ def scatter_blocks(k, v, block_ids: list[int], data: np.ndarray, block_size: int
 
     Inputs k/v are DONATED — callers must replace their references.
     """
-    n = len(block_ids)
-    B = _bucket(n)
-    ids = np.zeros((B,), np.int32)
-    ids[:n] = block_ids
-    if B != n:
-        pad = np.zeros((B - n, *data.shape[1:]), data.dtype)
-        data = np.concatenate([data, pad], axis=0)
+    ids = pad_ids_to_bucket(block_ids)
+    data = pad_rows_to(len(ids), data)
     return _scatter(k, v, ids, jnp.asarray(data), block_size)

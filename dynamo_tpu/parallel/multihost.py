@@ -37,6 +37,7 @@ KIND_STEP = 1  # single fused step (prefill or 1-token decode)
 KIND_MULTI_STEP = 2  # fused K-step decode window
 KIND_KV_GATHER = 3  # mirrored KV offload gather (shard-local store)
 KIND_KV_SCATTER = 4  # mirrored KV onboard scatter (shard-local load)
+KIND_KV_DISABLE = 5  # leader-side offload failure: drop shard pools
 
 
 class StepBroadcaster:
@@ -174,17 +175,6 @@ def _packed_spec():
     return P(None, None, None, None, "tp", None)
 
 
-def _bucket_ids(block_ids: np.ndarray) -> np.ndarray:
-    """Pad to the block_copy ID buckets so each batch size compiles once
-    per bucket — padding reads/writes the reserved garbage block 0."""
-    from dynamo_tpu.ops.block_copy import _bucket
-
-    n = len(block_ids)
-    ids = np.zeros((_bucket(n),), np.int32)
-    ids[:n] = block_ids
-    return ids
-
-
 def mirror_gather(k_cache, v_cache, block_ids: np.ndarray, block_size: int,
                   mesh) -> np.ndarray:
     """All processes: jitted gather constrained to the packed spec, then
@@ -192,12 +182,12 @@ def mirror_gather(k_cache, v_cache, block_ids: np.ndarray, block_size: int,
     import jax
     from jax.sharding import NamedSharding
 
-    from dynamo_tpu.ops.block_copy import _gather
+    from dynamo_tpu.ops.block_copy import _gather, pad_ids_to_bucket
 
     n = len(block_ids)
     with mesh:
         packed = _gather(
-            k_cache, v_cache, jnp_i32(_bucket_ids(block_ids)), block_size
+            k_cache, v_cache, jnp_i32(pad_ids_to_bucket(block_ids)), block_size
         )
         packed = jax.device_put(
             packed, NamedSharding(mesh, _packed_spec())
@@ -213,13 +203,14 @@ def mirror_scatter(k_cache, v_cache, block_ids: np.ndarray,
     import jax
     from jax.sharding import NamedSharding
 
-    from dynamo_tpu.ops.block_copy import _scatter
+    from dynamo_tpu.ops.block_copy import (
+        _scatter,
+        pad_ids_to_bucket,
+        pad_rows_to,
+    )
 
-    n = len(block_ids)
-    ids = _bucket_ids(block_ids)
-    if len(ids) != n:  # pad rows to the bucket (land in garbage block 0)
-        pad = np.zeros((len(ids) - n, *local_rows.shape[1:]), local_rows.dtype)
-        local_rows = np.concatenate([local_rows, pad], axis=0)
+    ids = pad_ids_to_bucket(block_ids)
+    local_rows = pad_rows_to(len(ids), local_rows)
     global_shape = (
         len(ids), 2, k_cache.shape[0], block_size,
         k_cache.shape[2], k_cache.shape[3],
@@ -266,7 +257,10 @@ class ShardKvPool:
             h = int(h)
             if h in self._data:
                 self._data.pop(h)  # re-insert refreshes recency
-            self._data[h] = rows[i]
+            # copy: rows[i] is a view into the whole gather batch — a
+            # stored view would pin the batch until EVERY row evicts,
+            # overrunning the pool budget by the batch factor
+            self._data[h] = np.ascontiguousarray(rows[i])
             if len(self._data) > self.num_blocks:
                 self._data.pop(next(iter(self._data)))  # LRU-ish FIFO
 
@@ -309,6 +303,16 @@ class ShardedKvOffload:
         self._pending: "OrderedDict[int, int]" = OrderedDict()
 
     # engine surface ------------------------------------------------------
+    def on_disable(self) -> None:
+        """Called by engine._disable_kvbm BEFORE close while followers
+        still listen: a leader-side failure mid-mirrored-op must not
+        leave followers with diverged pools silently serving shards the
+        leader never stored — both sides drop the tier together."""
+        try:
+            self.broadcaster._ctrl(KIND_KV_DISABLE)
+        except Exception:
+            pass
+
     def on_block_committed(self, seq_hash: int, device_block: int) -> None:
         if not self.pool.contains(seq_hash):
             self._pending[seq_hash] = device_block
@@ -395,6 +399,11 @@ class StepFollower:
             kind, b, t, w = (int(x) for x in ctrl[:4])
             if kind == KIND_STOP:
                 return
+            if kind == KIND_KV_DISABLE:
+                # leader failed mid-offload and degraded to G1-only:
+                # drop the shard pool in lockstep (no more KV kinds come)
+                pool = None
+                continue
             if kind in (KIND_KV_GATHER, KIND_KV_SCATTER):
                 ids, halves = self._bcast((
                     np.zeros((b,), np.int32), np.zeros((2, b), np.uint32),

@@ -67,10 +67,13 @@ def test_hash_halves_survive_broadcast_canonicalization():
     assert halves.dtype == __import__("numpy").uint32
     assert halves.shape == (2, len(hashes))
     assert _join_hashes(halves) == hashes
-    # and the canonicalization that motivated this: a uint64 round trip
-    # through jnp would NOT have survived
+    # and the canonicalization that motivated this: with x64 disabled
+    # (this repo's default), a uint64 round trip through jnp would NOT
+    # have survived
+    import jax
     import jax.numpy as jnp
     import numpy as np
 
-    truncated = np.asarray(jnp.asarray(np.asarray([2**40 + 5], np.uint64)))
-    assert int(truncated[0]) != 2**40 + 5  # the bug this guards against
+    if not jax.config.jax_enable_x64:
+        truncated = np.asarray(jnp.asarray(np.asarray([2**40 + 5], np.uint64)))
+        assert int(truncated[0]) != 2**40 + 5  # the bug this guards against
