@@ -432,9 +432,35 @@ def forward(
         cfg, positions, slot_mapping, block_tables, context_lens, block_size
     )
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_fn, x, (layer_params, k_cache, v_cache)
-    )
+    if tokens.shape[1] == 1:
+        # DECODE: the KV cache rides the scan CARRY with per-layer
+        # dynamic-index read/update — NOT the xs/ys stream. Scanned-over
+        # caches make XLA re-stack the ENTIRE cache every step (a
+        # read+write of all cache bytes per token); carry buffers alias
+        # in place, so only the touched layer slice moves. Measured on
+        # v5e (8B int8, fused K=32): 24.6 -> 20.7 ms/step, engine
+        # 882 -> 1022 tok/s. Prefill keeps the xs/ys layout — there the
+        # restack amortizes over the whole chunk and the carry variant
+        # measured slower end-to-end (T is static under jit, so this
+        # branch picks one layout per trace).
+        def body(carry, inp):
+            x, kc, vc = carry
+            lp, i = inp
+            kcl = jax.lax.dynamic_index_in_dim(kc, i, 0, keepdims=False)
+            vcl = jax.lax.dynamic_index_in_dim(vc, i, 0, keepdims=False)
+            x, (kcl, vcl) = layer_fn(x, (lp, kcl, vcl))
+            kc = jax.lax.dynamic_update_index_in_dim(kc, kcl, i, 0)
+            vc = jax.lax.dynamic_update_index_in_dim(vc, vcl, i, 0)
+            return (x, kc, vc), None
+
+        (x, new_k, new_v), _ = jax.lax.scan(
+            body, (x, k_cache, v_cache),
+            (layer_params, jnp.arange(cfg.num_hidden_layers)),
+        )
+    else:
+        x, (new_k, new_v) = jax.lax.scan(
+            layer_fn, x, (layer_params, k_cache, v_cache)
+        )
 
     x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
     # logits only at each sequence's last real token
