@@ -332,25 +332,24 @@ def attn_impl() -> str:
 # ---------------------------------------------------------------------------
 
 
-def make_layer_fn(
+def make_layer_parts(
     cfg: ModelConfig,
     positions: jax.Array,  # [B, T]
-    slot_mapping: jax.Array,  # [B*T]
     block_tables: jax.Array,  # [B, max_blocks]
     context_lens: jax.Array,  # [B]
     block_size: int,
 ):
-    """Per-layer scan body: (x, (layer_params, k_cache_l, v_cache_l)) -> ...
+    """The layer math in two halves so callers choose WHERE the KV write
+    lands (layer slice vs full carried stack) without duplicating it:
 
-    Shared by the plain lax.scan forward and the pipeline-parallel stage
-    loop (parallel/pipeline.py), which calls it with per-microbatch args.
+      qkv(lp, x)                 -> (q, k, v) roped, [B, T, H*, Dh]
+      attend_mlp(lp, x, q, kcl, vcl) -> new x (reads the layer cache
+                                    AFTER the caller wrote k/v into it)
     """
     H, Hk, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
 
-    def layer_fn(x, scanned):
+    def qkv(lp, x):
         B, T = x.shape[0], x.shape[1]
-        lp, k_cache_l, v_cache_l = scanned
-        # attention
         h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
         q = mm(lp, "wq", h)
         k = mm(lp, "wk", h)
@@ -361,9 +360,10 @@ def make_layer_fn(
         k = k.reshape(B, T, Hk, Dh)
         v = v.reshape(B, T, Hk, Dh)
         q, k = rope(q, k, positions, cfg.rope_theta)
-        # write new kv into the paged cache
-        k_cache_l = k_cache_l.at[slot_mapping].set(k.reshape(B * T, Hk, Dh))
-        v_cache_l = v_cache_l.at[slot_mapping].set(v.reshape(B * T, Hk, Dh))
+        return q, k, v
+
+    def attend_mlp(lp, x, q, k_cache_l, v_cache_l):
+        B, T = x.shape[0], x.shape[1]
         if T == 1 and cfg.sliding_window is None and attn_impl() == "pallas":
             from dynamo_tpu.ops.paged_attention import paged_attention_decode
 
@@ -377,7 +377,6 @@ def make_layer_fn(
                 context_lens, block_size, cfg.sliding_window,
             )
         x = x + mm(lp, "wo", attn.reshape(B, T, H * Dh)).astype(x.dtype)
-        # mlp
         h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
         if cfg.is_moe:
             x = x + _moe_mlp(cfg, lp, h).astype(x.dtype)
@@ -386,6 +385,37 @@ def make_layer_fn(
                 lp, "w_down", mlp_act(cfg, mm(lp, "w_gate", h)) * mm(lp, "w_up", h)
             )
             x = x + mlp_out.astype(x.dtype)
+        return x
+
+    return qkv, attend_mlp
+
+
+def make_layer_fn(
+    cfg: ModelConfig,
+    positions: jax.Array,  # [B, T]
+    slot_mapping: jax.Array,  # [B*T]
+    block_tables: jax.Array,  # [B, max_blocks]
+    context_lens: jax.Array,  # [B]
+    block_size: int,
+):
+    """Per-layer scan body: (x, (layer_params, k_cache_l, v_cache_l)) -> ...
+
+    Shared by the plain lax.scan forward and the pipeline-parallel stage
+    loop (parallel/pipeline.py), which calls it with per-microbatch args.
+    """
+    Hk, Dh = cfg.num_key_value_heads, cfg.head_dim
+    qkv, attend_mlp = make_layer_parts(
+        cfg, positions, block_tables, context_lens, block_size
+    )
+
+    def layer_fn(x, scanned):
+        B, T = x.shape[0], x.shape[1]
+        lp, k_cache_l, v_cache_l = scanned
+        q, k, v = qkv(lp, x)
+        # write new kv into the paged cache (layer slice)
+        k_cache_l = k_cache_l.at[slot_mapping].set(k.reshape(B * T, Hk, Dh))
+        v_cache_l = v_cache_l.at[slot_mapping].set(v.reshape(B * T, Hk, Dh))
+        x = attend_mlp(lp, x, q, k_cache_l, v_cache_l)
         return x, (k_cache_l, v_cache_l)
 
     return layer_fn
@@ -428,29 +458,35 @@ def forward(
         x = jnp.where(embeds_mask[..., None], extra_embeds.astype(x.dtype), x)
 
     layer_params = {k: params[k] for k in layer_param_names(params)}
-    layer_fn = make_layer_fn(
-        cfg, positions, slot_mapping, block_tables, context_lens, block_size
-    )
 
     if tokens.shape[1] == 1:
-        # DECODE: the KV cache rides the scan CARRY with per-layer
-        # dynamic-index read/update — NOT the xs/ys stream. Scanned-over
-        # caches make XLA re-stack the ENTIRE cache every step (a
-        # read+write of all cache bytes per token); carry buffers alias
-        # in place, so only the touched layer slice moves. Measured on
-        # v5e (8B int8, fused K=32): 24.6 -> 20.7 ms/step, engine
-        # 882 -> 1022 tok/s. Prefill keeps the xs/ys layout — there the
-        # restack amortizes over the whole chunk and the carry variant
-        # measured slower end-to-end (T is static under jit, so this
-        # branch picks one layout per trace).
+        # DECODE: the KV cache rides the scan CARRY with the new k/v
+        # scattered DIRECTLY into the full stack at [layer, slots] — NOT
+        # the xs/ys stream. Scanned-over caches make XLA re-stack the
+        # ENTIRE cache every step (a read+write of all cache bytes per
+        # token); a carried cache aliases in place, and the direct
+        # scatter touches only the written rows (a slice-copy+DUS
+        # variant still moved one full layer slice per layer). Measured
+        # on v5e (8B int8, fused K=32): 24.6 xs/ys -> 20.7 slice-DUS ->
+        # 19.3 direct-scatter ms/step; engine 882 -> 1022 -> 1090
+        # tok/s. Prefill keeps the xs/ys layout — the restack amortizes
+        # over the whole chunk there and measured faster end-to-end
+        # (T is static under jit: one layout per trace).
+        Hk, Dh = cfg.num_key_value_heads, cfg.head_dim
+        qkv, attend_mlp = make_layer_parts(
+            cfg, positions, block_tables, context_lens, block_size
+        )
+        B = tokens.shape[0]
+
         def body(carry, inp):
             x, kc, vc = carry
             lp, i = inp
+            q, k, v = qkv(lp, x)
+            kc = kc.at[i, slot_mapping].set(k.reshape(B, Hk, Dh))
+            vc = vc.at[i, slot_mapping].set(v.reshape(B, Hk, Dh))
             kcl = jax.lax.dynamic_index_in_dim(kc, i, 0, keepdims=False)
             vcl = jax.lax.dynamic_index_in_dim(vc, i, 0, keepdims=False)
-            x, (kcl, vcl) = layer_fn(x, (lp, kcl, vcl))
-            kc = jax.lax.dynamic_update_index_in_dim(kc, kcl, i, 0)
-            vc = jax.lax.dynamic_update_index_in_dim(vc, vcl, i, 0)
+            x = attend_mlp(lp, x, q, kcl, vcl)
             return (x, kc, vc), None
 
         (x, new_k, new_v), _ = jax.lax.scan(
@@ -458,6 +494,9 @@ def forward(
             (layer_params, jnp.arange(cfg.num_hidden_layers)),
         )
     else:
+        layer_fn = make_layer_fn(
+            cfg, positions, slot_mapping, block_tables, context_lens, block_size
+        )
         x, (new_k, new_v) = jax.lax.scan(
             layer_fn, x, (layer_params, k_cache, v_cache)
         )
