@@ -45,6 +45,12 @@ class EngineConfig:
     # size and up to decode_steps-1 sampled-past-stop tokens are
     # discarded per finishing request.
     decode_steps: int = 1
+    # admission coalescing: while decode has work, hold new arrivals up
+    # to this long (or until coalesce_min are waiting) so their prefills
+    # batch into one weight pass instead of one full-weight-read prefill
+    # step per straggler (0 = admit immediately)
+    prefill_coalesce_s: float = 0.0
+    prefill_coalesce_min: int = 4
     # weights
     random_weights: bool = False  # bench/test mode: skip checkpoint load
     # weight-only quantization applied at load: None | "int8"
@@ -79,6 +85,7 @@ def load_engine_config(args: Any) -> EngineConfig:
         leader_addr=getattr(args, "leader_addr", ""),
         quantization=getattr(args, "quantization", None),
         decode_steps=getattr(args, "decode_steps", 1),
+        prefill_coalesce_s=getattr(args, "prefill_coalesce_s", 0.0),
         host_kv_blocks=getattr(args, "host_kv_blocks", 0),
         disk_kv_blocks=getattr(args, "disk_kv_blocks", 0),
         disk_kv_path=getattr(args, "disk_kv_path", ""),

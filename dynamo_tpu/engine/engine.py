@@ -215,6 +215,8 @@ class JaxEngine:
             max_prefill_tokens=cfg.max_prefill_tokens,
         )
         self.scheduler.decode_lookahead = max(1, cfg.decode_steps)
+        self.scheduler.prefill_coalesce_s = cfg.prefill_coalesce_s
+        self.scheduler.prefill_coalesce_min = cfg.prefill_coalesce_min
         self.scheduler.on_finish = self._emit_finish
         if cfg.disk_kv_blocks > 0 and cfg.host_kv_blocks <= 0:
             raise ValueError(

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import enum
 import logging
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -54,6 +55,7 @@ class Sequence:
     committed_blocks: int = 0  # prefix of block_table already content-addressed
     generated: int = 0
     arrival: int = 0
+    arrived_at: float = 0.0  # wall clock, for admission coalescing
     # engine-facing hooks
     emit: Optional[Callable] = None  # called with LLMEngineOutput-shaped dicts
     is_cancelled: Optional[Callable[[], bool]] = None
@@ -123,6 +125,14 @@ class Scheduler:
         self.waiting: deque[Sequence] = deque()
         self.prefilling: deque[Sequence] = deque()
         self.running: list[Sequence] = []
+        # admission coalescing: under staggered arrivals, each lone
+        # admission triggers a prefill step that reads ALL weights for
+        # one row — a few such steps per request cycle halves serving
+        # throughput (benchmarks/RESULTS.md). While decode has work,
+        # hold arrivals up to coalesce_s (or until coalesce_min wait)
+        # so prefills batch. 0 = off; idle engines always admit.
+        self.prefill_coalesce_s = 0.0
+        self.prefill_coalesce_min = 4
         # fused multi-step decode: how many tokens one device step emits
         # (engine sets this from EngineConfig.decode_steps); block
         # allocation must cover the whole window up front
@@ -140,6 +150,7 @@ class Scheduler:
     # -- intake -----------------------------------------------------------
     def add_request(self, seq: Sequence) -> None:
         seq.arrival = self._arrival
+        seq.arrived_at = time.monotonic()
         self._arrival += 1
         self.waiting.append(seq)
 
@@ -156,9 +167,24 @@ class Scheduler:
         return bool(self.waiting or self.prefilling or self.running)
 
     # -- planning ---------------------------------------------------------
+    def _admission_held(self) -> bool:
+        """True while arrivals are deliberately coalescing: decode may
+        proceed (and keep pipelining) past the waiting queue."""
+        if not self.waiting or not self.running or self.prefill_coalesce_s <= 0:
+            return False
+        if self.prefilling:
+            return False  # joining an in-flight prefill batch is free
+        if len(self.waiting) >= self.prefill_coalesce_min:
+            return False
+        return (
+            time.monotonic() - self.waiting[0].arrived_at
+            < self.prefill_coalesce_s
+        )
+
     def plan(self) -> StepPlan:
         self._reap_cancelled()
-        self._admit()
+        if not self._admission_held():
+            self._admit()
         if self.prefilling:
             works = self._plan_prefill_batch()
             if works:
@@ -350,7 +376,7 @@ class Scheduler:
         """
         import numpy as np
 
-        if self.waiting or self.prefilling:
+        if self.prefilling or (self.waiting and not self._admission_held()):
             return None
         K = self.decode_lookahead
         for seq in seqs:
