@@ -850,6 +850,20 @@ async def cmd_serve(args: Any) -> None:
         src = os.path.join(dest, "src")
         if src not in sys.path:
             sys.path.insert(0, src)
+        # the supervisor's per-component CHILD processes import the
+        # graph themselves: without this export they'd only find it if
+        # the sources happened to be independently importable (e.g. a
+        # repo checkout) — on a package-only machine they'd crash
+        os.environ["PYTHONPATH"] = src + (
+            os.pathsep + os.environ["PYTHONPATH"]
+            if os.environ.get("PYTHONPATH") else ""
+        )
+        # ...and for `-m` launches the child's CWD precedes PYTHONPATH
+        # on sys.path, so a conflicting package under the operator's
+        # working directory (a stale checkout) would silently shadow
+        # the pulled artifact: serve from inside the package dir, which
+        # contains no importable top-level packages
+        os.chdir(dest)
         args.service = manifest.entry
         if not args.config_file and "config.yaml" in manifest.files:
             args.config_file = os.path.join(dest, "config.yaml")

@@ -1,0 +1,108 @@
+"""Full-process packaging e2e (reference: `dynamo build` + cloud deploy
+pull): `build --push` a @service graph into the coordinator's registry,
+then `serve --package` pulls, verifies, unpacks, and supervises it —
+and the served graph answers over the endpoint plane."""
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+
+from cli_harness import ENV, REPO, CliFleet, free_port
+
+
+def test_build_push_serve_package_e2e(tmp_path):
+    store_port = free_port()
+    fleet = CliFleet()
+    serve_proc = None
+    try:
+        fleet.spawn("store", "--host", "127.0.0.1", "--port", str(store_port))
+        time.sleep(2)
+        common = ["--store-host", "127.0.0.1", "--store-port", str(store_port)]
+
+        # build + push (runs to completion)
+        out = tmp_path / "hello.tar.gz"
+        r = subprocess.run(
+            [sys.executable, "-m", "dynamo_tpu.cli.main", "build",
+             "examples.hello_world.graph:Frontend", "--name", "hello",
+             "-o", str(out), "--push", *common],
+            env=ENV, cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "pushed hello:" in r.stdout
+        assert out.exists()
+
+        # serve straight from the registry. A poison `examples` package
+        # shadows the repo's on PYTHONPATH, so the graph can ONLY import
+        # from the unpacked artifact (sys.path[0]) — if serve --package
+        # ever stopped putting the package first, the shim raises and
+        # the serve process dies loudly instead of silently falling back
+        # to repo sources.
+        shield = tmp_path / "shield" / "examples"
+        shield.mkdir(parents=True)
+        (shield / "__init__.py").write_text(
+            "raise ImportError('examples must import from the unpacked "
+            "package, not the repo')\n"
+        )
+        serve_env = dict(
+            ENV,
+            DYN_PACKAGE_DIR=str(tmp_path / "pkgs"),
+            PYTHONPATH=f"{tmp_path / 'shield'}{os.pathsep}{ENV['PYTHONPATH']}",
+        )
+        serve_log = tmp_path / "serve.log"
+        logf = open(serve_log, "w")
+        # launch from the REPO deliberately: cmd_serve chdirs into the
+        # package dir before supervising, so the checkout's examples/
+        # must NOT leak into children via their cwd — combined with the
+        # shim, any import of examples outside the artifact fails loud
+        serve_proc = subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.cli.main", "serve",
+             "--package", "hello", *common],
+            env=serve_env, cwd=REPO, stdout=logf,
+            stderr=subprocess.STDOUT,
+        )
+
+        async def drive() -> list:
+            from dynamo_tpu.runtime.config import RuntimeConfig
+            from dynamo_tpu.runtime.engine import Context, collect
+            from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+            drt = await DistributedRuntime.create(config=RuntimeConfig(
+                store_host="127.0.0.1", store_port=store_port,
+                worker_host="127.0.0.1",
+            ))
+            try:
+                client = await (
+                    drt.namespace("hello").component("frontend")
+                    .endpoint("generate").client()
+                )
+                ids = await client.wait_for_instances(120)
+                stream = await client.generate_direct(
+                    ids[0], {"text": "ship it"}, Context()
+                )
+                return [i async for i in stream]
+            finally:
+                await drt.shutdown()
+
+        items = asyncio.run(drive())
+        texts = [i["text"] for i in items]
+        assert texts == ["front.mid.back.ship", "front.mid.back.it"], texts
+        # the package really was unpacked + imported from the state dir
+        unpacked = list((tmp_path / "pkgs").glob("hello-*/src/examples"))
+        assert unpacked, os.listdir(tmp_path / "pkgs")
+        fleet.assert_alive()
+        assert serve_proc.poll() is None
+    finally:
+        if serve_proc is not None:
+            if serve_proc.poll() is None:
+                serve_proc.terminate()
+                try:
+                    serve_proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    serve_proc.kill()
+            logf.close()
+            # surface the one log that matters when drive() fails
+            print("=== serve --package log ===")
+            print((tmp_path / "serve.log").read_text()[-3000:])
+        fleet.teardown()
