@@ -69,10 +69,14 @@ def build_parser() -> argparse.ArgumentParser:
                      help="fused decode window: tokens per device "
                           "dispatch (amortizes dispatch latency; tokens "
                           "stream in bursts of this size)")
-    run.add_argument("--prefill-coalesce-s", type=float, default=0.0,
-                     help="hold staggered arrivals up to this long so "
-                          "their prefills batch into one weight pass "
-                          "(raises serving throughput, bounds added TTFT)")
+    run.add_argument("--mixed-prefill-rows", type=int, default=4,
+                     help="mixed continuous batching (needs "
+                          "--decode-steps > 1): pending prefill chunks "
+                          "ride the decode window's dispatch in a fixed "
+                          "[rows, len] rectangle; 0 disables")
+    run.add_argument("--mixed-prefill-len", type=int, default=256,
+                     help="per-row token cap of the mixed prefill "
+                          "rectangle")
     run.add_argument("--tensor-parallel-size", type=int, default=1)
     run.add_argument("--pipeline-parallel-size", type=int, default=1,
                      help="GPipe stage rotation over a pp mesh axis")

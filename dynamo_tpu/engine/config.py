@@ -45,12 +45,14 @@ class EngineConfig:
     # size and up to decode_steps-1 sampled-past-stop tokens are
     # discarded per finishing request.
     decode_steps: int = 1
-    # admission coalescing: while decode has work, hold new arrivals up
-    # to this long (or until coalesce_min are waiting) so their prefills
-    # batch into one weight pass instead of one full-weight-read prefill
-    # step per straggler (0 = admit immediately)
-    prefill_coalesce_s: float = 0.0
-    prefill_coalesce_min: int = 4
+    # mixed prefill+decode batching (needs decode_steps > 1): pending
+    # prefill chunks ride the decode window's dispatch in a fixed
+    # [rows, len] rectangle, so a straggler's prefill costs ~10-15% of a
+    # window instead of a dedicated full-weight pass while decode
+    # stalls. rows=0 disables (reference behavior: vLLM's mixed
+    # scheduler, container/deps/vllm/...-patch :535).
+    mixed_prefill_rows: int = 4
+    mixed_prefill_len: int = 256
     # weights
     random_weights: bool = False  # bench/test mode: skip checkpoint load
     # weight-only quantization applied at load: None | "int8"
@@ -85,7 +87,8 @@ def load_engine_config(args: Any) -> EngineConfig:
         leader_addr=getattr(args, "leader_addr", ""),
         quantization=getattr(args, "quantization", None),
         decode_steps=getattr(args, "decode_steps", 1),
-        prefill_coalesce_s=getattr(args, "prefill_coalesce_s", 0.0),
+        mixed_prefill_rows=getattr(args, "mixed_prefill_rows", 4),
+        mixed_prefill_len=getattr(args, "mixed_prefill_len", 256),
         host_kv_blocks=getattr(args, "host_kv_blocks", 0),
         disk_kv_blocks=getattr(args, "disk_kv_blocks", 0),
         disk_kv_path=getattr(args, "disk_kv_path", ""),

@@ -35,7 +35,12 @@ from dynamo_tpu.engine.allocator import BlockAllocator
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.kvbm import BlockLayout, KvbmConfig, KvBlockManager
 from dynamo_tpu.ops.block_copy import gather_blocks, scatter_blocks
-from dynamo_tpu.engine.sampling import SamplingBatch, sample
+from dynamo_tpu.engine.sampling import (
+    SamplingBatch,
+    dense_gen_counts,
+    dense_prompt_presence,
+    sample,
+)
 from dynamo_tpu.engine.scheduler import (
     Scheduler,
     SeqState,
@@ -88,6 +93,7 @@ class JaxEngine:
         self._step_fn: Optional[Callable] = None
         self._step_fn_mm: Optional[Callable] = None
         self._multi_step_fn: Optional[Callable] = None
+        self._mixed_step_fn: Optional[Callable] = None
         self._pp = config.pipeline_parallel_size
         # multi-host: rank 0 leads (scheduler + broadcast), others follow
         self._is_follower = config.num_nodes > 1 and config.node_rank > 0
@@ -215,13 +221,32 @@ class JaxEngine:
             max_prefill_tokens=cfg.max_prefill_tokens,
         )
         self.scheduler.decode_lookahead = max(1, cfg.decode_steps)
-        self.scheduler.prefill_coalesce_s = cfg.prefill_coalesce_s
-        self.scheduler.prefill_coalesce_min = cfg.prefill_coalesce_min
+        if cfg.decode_steps > 1 and cfg.mixed_prefill_rows > 0:
+            # normalize to bucket values: _pad_prefill_rect's fixed
+            # rectangle must be >= the bucketed prefill arrays, which
+            # round UP (a non-bucket rows/len would crash every mixed
+            # step and fail all in-flight requests)
+            from dynamo_tpu.utils.bucketing import next_bucket
+
+            cfg.mixed_prefill_rows = next_bucket(
+                cfg.mixed_prefill_rows, Scheduler.BATCH_BUCKETS
+            )
+            cfg.mixed_prefill_len = next_bucket(
+                cfg.mixed_prefill_len, Scheduler.CHUNK_BUCKETS
+            )
+            self.scheduler.mixed_prefill_rows = cfg.mixed_prefill_rows
+            self.scheduler.mixed_prefill_len = cfg.mixed_prefill_len
         self.scheduler.on_finish = self._emit_finish
         if cfg.disk_kv_blocks > 0 and cfg.host_kv_blocks <= 0:
             raise ValueError(
                 "disk_kv_blocks requires host_kv_blocks > 0 (G3 demotion "
                 "cascades from the G2 host tier)"
+            )
+        if cfg.remote_kv_bucket and cfg.host_kv_blocks <= 0:
+            raise ValueError(
+                "remote_kv_bucket requires host_kv_blocks > 0 (the G4 "
+                "remote tier demotes from / onboards through the G2 host "
+                "tier) — a configured remote tier must not vanish silently"
             )
         if cfg.host_kv_blocks > 0 and cfg.num_nodes > 1:
             # Sharded KV offload (docs/multihost.md): each process
@@ -306,16 +331,29 @@ class JaxEngine:
                 "TPU v5p": 95, "TPU v6 lite": 32, "TPU v6e": 32,
             }.get(getattr(devices[0], "device_kind", ""), 16) * (1 << 30)
             hbm = int(hbm * 0.98)  # runtime-reserved slice
-            n_dev = max(1, len(devices))
+            # params shard over tp×pp only; dp/ep replicas hold full
+            # copies, so dividing by the whole device count would
+            # overestimate free HBM by the dp factor
+            n_shard = max(
+                1,
+                self.config.tensor_parallel_size
+                * self.config.pipeline_parallel_size,
+            )
             param_bytes = sum(
                 x.nbytes for x in jax.tree_util.tree_leaves(self.params)
-            ) / n_dev
+            ) / n_shard
             free = max(0.0, hbm - param_bytes)
         # step-transient headroom the cache must leave: a full batched
         # prefill's activations dominate — per token roughly 6 D-wide
         # bf16 tensors (h/q/k/v/attn/out), 3 F-wide (gate/up/act, ×E for
         # dense-compute MoE), plus f32 attention scores H × S_table
-        area = self.config.max_batch_size * self.config.prefill_chunk_size
+        # a prefill step's token area is capped by max_prefill_tokens
+        # (scheduler._plan_prefill_batch budget), NOT the full
+        # batch × chunk rectangle — ×2 covers bucket padding
+        area = min(
+            self.config.max_batch_size * self.config.prefill_chunk_size,
+            2 * (self.config.max_prefill_tokens or self.config.prefill_chunk_size),
+        )
         # scores-width estimate: capped — attention scores are one
         # layer-transient, and an uncapped max_position_embeddings
         # (e.g. 8192 default) would swallow the whole budget and floor
@@ -365,8 +403,12 @@ class JaxEngine:
         G1-only (a 0 return just means 'prefill those tokens normally')."""
         if self.kvbm is None:
             return 0
+        from dynamo_tpu.parallel.multihost import FatalMultihostError
+
         try:
             return self.kvbm.onboard(hashes, blocks)
+        except FatalMultihostError:
+            raise  # inside a mirrored collective: not recoverable
         except Exception:
             log.exception("kv onboard failed; disabling kvbm")
             self._disable_kvbm()
@@ -411,10 +453,7 @@ class JaxEngine:
             block_tables,
             context_lens,
             last_token_idx,
-            temperature,
-            top_k,
-            top_p,
-            seeds,
+            sampling,  # SamplingBatch.arrays pytree
             *mm_args,  # optionally (extra_embeds, embeds_mask)
         ):
             logits, new_k, new_v = forward(
@@ -431,7 +470,7 @@ class JaxEngine:
                 block_size,
                 *mm_args,
             )
-            next_tokens, logprobs = sample(logits, temperature, top_k, top_p, seeds)
+            next_tokens, logprobs = sample(logits, sampling)
             return next_tokens, logprobs, new_k, new_v
 
         # donate the caches: XLA aliases them in-place. One jitted fn
@@ -443,7 +482,7 @@ class JaxEngine:
         K = self.config.decode_steps
         bs = block_size
 
-        def multi_step(
+        def decode_window(
             params,
             k_cache,
             v_cache,
@@ -452,18 +491,25 @@ class JaxEngine:
             block_tables,
             context_lens,
             valid_steps,  # [B] steps the seq will actually keep (<= K)
-            temperature,
-            top_k,
-            top_p,
-            seeds,
+            sampling,  # SamplingBatch.arrays pytree
         ):
             """K fused decode steps: one dispatch, K tokens per sequence.
             Slot mapping is recomputed on-device from the advancing
             positions; sampling seeds advance per step so outputs match
-            K single steps exactly."""
+            K single steps exactly. When the batch carries penalty
+            tables, a dense [B, V] generated-token count rides the scan
+            carry and updates after every sampled token, so penalties
+            inside the window are exact too."""
+            has_pen = "rep_pen" in sampling
+            B = tokens.shape[0]
+            V = mc.vocab_size
+            gen0 = dense_gen_counts(sampling, V) if has_pen else jnp.zeros((B, 1))
+            prompt_dense = (
+                dense_prompt_presence(sampling, V) if has_pen else None
+            )
 
             def body(carry, i):
-                k_c, v_c, tok, pos, ctx = carry
+                k_c, v_c, tok, pos, ctx, gen = carry
                 pos_flat = pos[:, 0]
                 slot = (
                     jnp.take_along_axis(
@@ -484,13 +530,18 @@ class JaxEngine:
                     mc, params, k_c, v_c, tok, pos, slot, block_tables,
                     ctx, jnp.zeros_like(pos_flat), bs,
                 )
+                s_i = dict(sampling)
+                s_i["seeds"] = sampling["seeds"] + i.astype(jnp.uint32)
                 nt, lp = sample(
-                    logits, temperature, top_k, top_p,
-                    seeds + i.astype(jnp.uint32),
+                    logits, s_i,
+                    gen if has_pen else None,
+                    prompt_dense,
                 )
-                return (k_c, v_c, nt[:, None], pos + 1, ctx + 1), (nt, lp)
+                if has_pen:
+                    gen = gen.at[jnp.arange(B), nt].add(1.0)
+                return (k_c, v_c, nt[:, None], pos + 1, ctx + 1, gen), (nt, lp)
 
-            carry = (k_cache, v_cache, tokens, positions, context_lens)
+            carry = (k_cache, v_cache, tokens, positions, context_lens, gen0)
             (k_cache, v_cache, last_tok, *_), (toks, lps) = jax.lax.scan(
                 body, carry, jnp.arange(K)
             )
@@ -503,8 +554,51 @@ class JaxEngine:
             )  # [B, 2K]
             return packed, last_tok, k_cache, v_cache
 
+        def mixed_step(
+            params,
+            k_cache,
+            v_cache,
+            # prefill rectangle [P, T] (fixed shape; engine pads)
+            p_tokens,
+            p_positions,
+            p_slot_mapping,
+            p_block_tables,
+            p_context_lens,
+            p_last_idx,
+            p_sampling,
+            # decode window [B, 1]
+            d_tokens,
+            d_positions,
+            d_block_tables,
+            d_context_lens,
+            d_valid_steps,
+            d_sampling,
+        ):
+            """Mixed continuous-batching step: the pending prefill
+            chunks run FIRST (so new requests' first tokens land this
+            window), then the K-step decode window — one dispatch, one
+            host round trip, no decode stall for stragglers' prefills.
+            The prefill rectangle's weight reads are shared with the
+            window only at the XLA-fusion level; its real win is that a
+            ~1k-token rectangle adds ~10-15% to a window instead of a
+            dedicated full-weight pass per straggler."""
+            p_logits, k_cache, v_cache = forward(
+                mc, params, k_cache, v_cache, p_tokens, p_positions,
+                p_slot_mapping, p_block_tables, p_context_lens,
+                p_last_idx, bs,
+            )
+            p_next, p_lp = sample(p_logits, p_sampling)
+            packed, last_tok, k_cache, v_cache = decode_window(
+                params, k_cache, v_cache, d_tokens, d_positions,
+                d_block_tables, d_context_lens, d_valid_steps, d_sampling,
+            )
+            return p_next, p_lp, packed, last_tok, k_cache, v_cache
+
         self._multi_step_fn = (
-            jax.jit(multi_step, donate_argnums=(1, 2)) if K > 1 else None
+            jax.jit(decode_window, donate_argnums=(1, 2)) if K > 1 else None
+        )
+        self._mixed_step_fn = (
+            jax.jit(mixed_step, donate_argnums=(1, 2)) if K > 1 else None
         )
 
     def _run_device_step(self, arrays: dict[str, np.ndarray], sampling: SamplingBatch):
@@ -519,10 +613,7 @@ class JaxEngine:
             arrays["block_tables"],
             arrays["context_lens"],
             arrays["last_token_idx"],
-            sampling.temperature,
-            sampling.top_k,
-            sampling.top_p,
-            sampling.seeds,
+            sampling.arrays,
         )
         if self._mh_broadcast is not None:
             if "extra_embeds" in arrays:
@@ -559,34 +650,45 @@ class JaxEngine:
             self._running = False
             return
         assert self.scheduler is not None
+        from dynamo_tpu.parallel.multihost import FatalMultihostError
+
+        def pump_kvbm() -> None:
+            if self.kvbm is None:
+                return
+            try:
+                self.kvbm.pump()
+            except FatalMultihostError:
+                raise  # inside a mirrored collective: not recoverable
+            except Exception:
+                log.exception("kv offload pump failed; disabling kvbm")
+                self._disable_kvbm()
+
         while self._running:
             self._drain_incoming()
             if not self.scheduler.has_work:
                 # idle: drain the offload queue (and run the pump's
                 # periodic G4 index refresh) before sleeping
-                if self.kvbm is not None:
-                    try:
-                        self.kvbm.pump()
-                    except Exception:
-                        log.exception("kv offload pump failed; disabling kvbm")
-                        self._disable_kvbm()
-                    if self.kvbm is not None and self.kvbm.pending_offloads:
-                        continue  # more queued: keep draining
+                pump_kvbm()
+                if self.kvbm is not None and self.kvbm.pending_offloads:
+                    continue  # more queued: keep draining
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
             try:
                 self._one_step()
+            except FatalMultihostError:
+                log.exception(
+                    "fatal multihost failure inside a mirrored collective; "
+                    "taking the engine down"
+                )
+                self._fail_all()
+                self._running = False
+                return
             except Exception:
                 log.exception("engine step failed; failing in-flight requests")
                 self._fail_all()
                 continue
-            if self.kvbm is not None:
-                try:
-                    self.kvbm.pump()
-                except Exception:
-                    log.exception("kv offload pump failed; disabling kvbm")
-                    self._disable_kvbm()
+            pump_kvbm()
 
     def _disable_kvbm(self) -> None:
         """Offload tiers are an optimization: on failure, degrade to
@@ -737,6 +839,11 @@ class JaxEngine:
         if plan.kind == "idle":
             time.sleep(0.001)
             return
+        if plan.kind == "mixed":
+            if self._mixed_step_fn is not None:
+                self._mixed_window(plan)
+                return
+            plan.kind = "prefill"  # no fused window: prefill this step
         if plan.kind == "prefill":
             works = plan.prefill_batch
             assert works
@@ -776,7 +883,7 @@ class JaxEngine:
         """Per-slot sampling params; ``offset`` advances the per-step
         seeds past tokens of an in-flight (not yet host-applied) window."""
         opts = [s.request.sampling.normalized() for s in seqs]
-        opts += [opts[-1]] * (B - len(seqs))  # pad
+        pad = B - len(seqs)
         seeds = []
         for s in seqs:
             base = s.request.sampling.seed
@@ -784,8 +891,23 @@ class JaxEngine:
                 (base if base is not None else hash(s.request_id) & 0x7FFFFFFF)
                 + s.generated + offset
             )
-        seeds += [0] * (B - len(seqs))
-        return SamplingBatch.from_options(opts, seeds)
+        seeds += [0] * pad
+        gen_counts = prompt_ids = None
+        if any(o.needs_penalties for o in opts):
+            # sparse per-seq token state for the penalty path: generated
+            # counts (freq/pres/rep) and distinct prompt ids (rep,
+            # cached on the sequence — prompts are immutable)
+            gen_counts = [dict(s.gen_counts) for s in seqs]
+            for s in seqs:
+                if s.prompt_unique is None:
+                    s.prompt_unique = np.unique(
+                        np.asarray(s.request.token_ids, np.int32)
+                    )
+            prompt_ids = [s.prompt_unique for s in seqs]
+            gen_counts += [{} for _ in range(pad)]
+            prompt_ids += [np.zeros((0,), np.int32)] * pad
+        opts += [opts[-1]] * pad
+        return SamplingBatch.from_options(opts, seeds, gen_counts, prompt_ids)
 
     def _dispatch_multi_step(
         self,
@@ -809,10 +931,7 @@ class JaxEngine:
             arrays["block_tables"],
             arrays["context_lens"],
             arrays["valid_steps"],
-            sampling.temperature,
-            sampling.top_k,
-            sampling.top_p,
-            sampling.seeds,
+            sampling.arrays,
         )
         return packed, last_tok
 
@@ -850,7 +969,10 @@ class JaxEngine:
         sched = self.scheduler
         assert sched is not None
         K = sched.decode_lookahead
-        pipelining = self._mh_broadcast is None
+        # penalty batches don't pipeline: window k+1's sparse count
+        # tables are built from host state that lags the in-flight
+        # window's tokens, so its penalties would be silently stale
+        pipelining = self._mh_broadcast is None and not sampling.has_penalties
         pending = self._dispatch_multi_step(arrays, sampling)
 
         def emit(window) -> None:
@@ -860,7 +982,16 @@ class JaxEngine:
 
         while True:
             nxt = None
-            if pipelining and self._incoming.empty() and self._control.empty():
+            # _running: a shutdown() mid-stream must flush the in-flight
+            # window and return, not keep dispatching until the batch
+            # drains (the thread join would time out and kvbm.close()
+            # would race the still-running engine thread)
+            if (
+                pipelining
+                and self._running
+                and self._incoming.empty()
+                and self._control.empty()
+            ):
                 nxt = sched.plan_pipelined_window(seqs, K)
             if nxt is not None:
                 B = nxt["tokens"].shape[0]
@@ -877,6 +1008,104 @@ class JaxEngine:
                 # composition changed under the in-flight window: flush
                 emit(pending)
                 return
+
+    def _pad_prefill_rect(
+        self, arrays: dict[str, np.ndarray], P: int, T: int, width: int
+    ) -> dict[str, np.ndarray]:
+        """Pad bucketed prefill arrays up to the mixed step's FIXED
+        [P, T] rectangle (and ``width``-wide block tables). Pad rows
+        write to the reserved garbage slot 0 and have ctx 0, exactly
+        like batch-bucket padding."""
+        B0, T0 = arrays["tokens"].shape
+        w0 = arrays["block_tables"].shape[1]
+        out = {
+            "tokens": np.zeros((P, T), np.int32),
+            "positions": np.zeros((P, T), np.int32),
+            "slot_mapping": np.zeros((P * T,), np.int32),
+            "block_tables": np.zeros((P, width), np.int32),
+            "context_lens": np.zeros((P,), np.int32),
+            "last_token_idx": np.zeros((P,), np.int32),
+        }
+        out["tokens"][:B0, :T0] = arrays["tokens"]
+        out["positions"][:B0, :T0] = arrays["positions"]
+        out["slot_mapping"].reshape(P, T)[:B0, :T0] = arrays[
+            "slot_mapping"
+        ].reshape(B0, T0)
+        out["block_tables"][:B0, :w0] = arrays["block_tables"]
+        out["context_lens"][:B0] = arrays["context_lens"]
+        out["last_token_idx"][:B0] = arrays["last_token_idx"]
+        return out
+
+    def _mixed_window(self, plan: StepPlan) -> None:
+        """One mixed dispatch: prefill rectangle + K-step decode window
+        (see mixed_step in _build_step_fn). Multimodal chunks fall back
+        to a dedicated prefill step — embedding injection doesn't ride
+        the fixed rectangle."""
+        sched = self.scheduler
+        assert sched is not None and self._mixed_step_fn is not None
+        works = plan.prefill_batch
+        seqs = plan.decode_seqs
+        p_arrays = sched.build_prefill_batch_arrays(works)
+        if "extra_embeds" in p_arrays:
+            sampling = self._batch_sampling(
+                [w.seq for w in works], p_arrays["tokens"].shape[0]
+            )
+            next_tokens, logprobs = self._run_device_step(p_arrays, sampling)
+            for i, work in enumerate(works):
+                sched.complete_prefill_chunk(work)
+                if work.is_last_chunk:
+                    self._emit_token(
+                        work.seq, int(next_tokens[i]), float(logprobs[i])
+                    )
+            return
+        d_arrays = sched.build_decode_arrays(seqs)
+        P = self.config.mixed_prefill_rows
+        T = self.config.mixed_prefill_len
+        width = max(
+            p_arrays["block_tables"].shape[1], d_arrays["block_tables"].shape[1]
+        )
+        p_pad = self._pad_prefill_rect(p_arrays, P, T, width)
+        if d_arrays["block_tables"].shape[1] < width:
+            dt = np.zeros((d_arrays["block_tables"].shape[0], width), np.int32)
+            dt[:, : d_arrays["block_tables"].shape[1]] = d_arrays["block_tables"]
+            d_arrays["block_tables"] = dt
+        sampling_p = self._batch_sampling([w.seq for w in works], P)
+        sampling_d = self._batch_sampling(seqs, d_arrays["tokens"].shape[0])
+        if self._mh_broadcast is not None:
+            self._mh_broadcast.announce_mixed(
+                p_pad, sampling_p, d_arrays, sampling_d
+            )
+        p_next, p_lp, packed, _last_tok, self.k_cache, self.v_cache = (
+            self._mixed_step_fn(
+                self.params,
+                self.k_cache,
+                self.v_cache,
+                p_pad["tokens"],
+                p_pad["positions"],
+                p_pad["slot_mapping"],
+                p_pad["block_tables"],
+                p_pad["context_lens"],
+                p_pad["last_token_idx"],
+                sampling_p.arrays,
+                d_arrays["tokens"],
+                d_arrays["positions"],
+                d_arrays["block_tables"],
+                d_arrays["context_lens"],
+                d_arrays["valid_steps"],
+                sampling_d.arrays,
+            )
+        )
+        from dynamo_tpu.parallel.multihost import host_value
+
+        p_next_h = host_value(p_next)
+        p_lp_h = host_value(p_lp)
+        tok_m, lp_m = self._unpack_window(host_value(packed))
+        for i, work in enumerate(works):
+            sched.complete_prefill_chunk(work)
+            if work.is_last_chunk:
+                self._emit_token(work.seq, int(p_next_h[i]), float(p_lp_h[i]))
+        for i, seq in enumerate(seqs):
+            self._emit_window(seq, tok_m[i], lp_m[i])
 
     def _emit_token(self, seq: Sequence, token: int, logprob: float) -> None:
         sched = self.scheduler

@@ -1,64 +1,256 @@
 """On-device batched sampling.
 
 One jitted function samples the whole batch: greedy and
-temperature/top-k/top-p paths are blended with `jnp.where` so a mixed
-batch compiles once (no per-request Python branching — XLA-friendly).
+temperature/top-k/top-p/min-p paths are blended with `jnp.where` so a
+mixed batch compiles once (no per-request Python branching —
+XLA-friendly).
+
+Full sampling surface (reference: lib/llm/src/protocols/common.rs
+:263-309 SamplingOptions — the reference carries these into its vLLM
+engines; here they execute on device):
+
+- temperature / top_k / top_p / min_p / seed
+- logit_bias: sparse per-slot (token id, bias) pairs scatter-added into
+  the logits (OpenAI semantics) — base path, always compiled.
+- frequency/presence/repetition penalties: need per-slot token-count
+  state, so they ride a SEPARATELY-COMPILED step variant whose
+  SamplingBatch carries sparse count tables ([B, N] ids + counts,
+  bucketed). Inside a fused K-step decode window the counts are
+  scattered into a dense [B, V] table once, carried through the scan,
+  and updated on device after every sampled token — so window outputs
+  match K single steps exactly.
+
+Semantics follow vLLM (the reference's serving engine): frequency and
+presence penalties count GENERATED tokens only; repetition penalty
+applies to prompt + generated tokens (HF-style divide/multiply).
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from dynamo_tpu.protocols.common import SamplingOptions
+from dynamo_tpu.utils.bucketing import next_bucket
 
 NEG_INF = -1e30
+
+# sparse-table width buckets (per-batch max, rounded up — a handful of
+# compile variants, only for requests that actually use the feature)
+BIAS_BUCKETS = [4, 16, 64, 512]
+COUNT_BUCKETS = [64, 256, 1024, 4096]
 
 
 @dataclass
 class SamplingBatch:
-    """Host-side per-slot sampling params, uploaded each step."""
+    """Host-side per-slot sampling params, uploaded each step.
 
-    temperature: np.ndarray  # [B] f32 (0 = greedy)
-    top_k: np.ndarray  # [B] i32 (0 = off)
-    top_p: np.ndarray  # [B] f32 (1.0 = off)
-    seeds: np.ndarray  # [B] u32 per-slot RNG streams
+    ``arrays`` is a flat dict of numpy arrays (a jit-friendly pytree):
+
+    base keys (always present):
+      temperature [B] f32 (0 = greedy), top_k [B] i32 (0 = off),
+      top_p [B] f32 (1 = off), min_p [B] f32 (0 = off), seeds [B] u32,
+      bias_ids [B, NB] i32, bias_vals [B, NB] f32 (padded id 0 / val 0)
+
+    penalty keys (only when a request in the batch uses them — selects
+    the penalty-variant compiled step):
+      freq_pen [B] f32, pres_pen [B] f32, rep_pen [B] f32 (1 = off),
+      gen_ids [B, NP] i32 + gen_counts [B, NP] f32 (generated tokens),
+      prompt_ids [B, NR] i32 + prompt_counts [B, NR] f32 (presence=1)
+    """
+
+    arrays: dict[str, np.ndarray] = field(default_factory=dict)
+
+    @property
+    def temperature(self) -> np.ndarray:
+        return self.arrays["temperature"]
+
+    @property
+    def seeds(self) -> np.ndarray:
+        return self.arrays["seeds"]
+
+    @property
+    def has_penalties(self) -> bool:
+        return "rep_pen" in self.arrays
 
     @classmethod
-    def from_options(cls, opts: list[SamplingOptions], step_seeds: list[int]) -> "SamplingBatch":
+    def from_options(
+        cls,
+        opts: list[SamplingOptions],
+        step_seeds: list[int],
+        gen_token_counts: Optional[list[dict[int, int]]] = None,
+        prompt_token_ids: Optional[list[np.ndarray]] = None,
+    ) -> "SamplingBatch":
+        """``gen_token_counts``/``prompt_token_ids`` (parallel to opts)
+        supply the per-sequence token state the penalty path needs; they
+        may be None when no option in the batch needs penalties."""
         n = len(opts)
-        temp = np.zeros((n,), np.float32)
-        top_k = np.zeros((n,), np.int32)
-        top_p = np.ones((n,), np.float32)
-        seeds = np.asarray(step_seeds, np.uint32)
+        a: dict[str, np.ndarray] = {
+            "temperature": np.zeros((n,), np.float32),
+            "top_k": np.zeros((n,), np.int32),
+            "top_p": np.ones((n,), np.float32),
+            "min_p": np.zeros((n,), np.float32),
+            "seeds": np.asarray(step_seeds, np.uint32),
+        }
         for i, o in enumerate(opts):
             if not o.use_greedy and o.temperature is not None:
-                temp[i] = max(o.temperature, 1e-4)
+                a["temperature"][i] = max(o.temperature, 1e-4)
             elif not o.use_greedy:
-                temp[i] = 1.0
+                a["temperature"][i] = 1.0
             if o.top_k:
-                top_k[i] = o.top_k
+                a["top_k"][i] = o.top_k
             if o.top_p is not None:
-                top_p[i] = o.top_p
-        return cls(temp, top_k, top_p, seeds)
+                a["top_p"][i] = o.top_p
+            if o.min_p:
+                a["min_p"][i] = o.min_p
+        # sparse logit bias (base path; all-zeros rows are no-ops)
+        nb = next_bucket(
+            max((len(o.logit_bias or {}) for o in opts), default=0) or 1,
+            BIAS_BUCKETS,
+        )
+        a["bias_ids"] = np.zeros((n, nb), np.int32)
+        a["bias_vals"] = np.zeros((n, nb), np.float32)
+        for i, o in enumerate(opts):
+            for j, (tok, v) in enumerate(sorted((o.logit_bias or {}).items())):
+                a["bias_ids"][i, j] = tok
+                a["bias_vals"][i, j] = v
+        if any(o.needs_penalties for o in opts):
+            a.update(
+                cls._penalty_arrays(opts, gen_token_counts, prompt_token_ids)
+            )
+        return cls(a)
+
+    @staticmethod
+    def _penalty_arrays(
+        opts: list[SamplingOptions],
+        gen_token_counts: Optional[list[dict[int, int]]],
+        prompt_token_ids: Optional[list[np.ndarray]],
+    ) -> dict[str, np.ndarray]:
+        n = len(opts)
+        gen_token_counts = gen_token_counts or [{} for _ in opts]
+        prompt_token_ids = prompt_token_ids or [
+            np.zeros((0,), np.int32) for _ in opts
+        ]
+        a: dict[str, np.ndarray] = {
+            "freq_pen": np.zeros((n,), np.float32),
+            "pres_pen": np.zeros((n,), np.float32),
+            "rep_pen": np.ones((n,), np.float32),
+        }
+        for i, o in enumerate(opts):
+            if o.frequency_penalty:
+                a["freq_pen"][i] = o.frequency_penalty
+            if o.presence_penalty:
+                a["pres_pen"][i] = o.presence_penalty
+            if o.repetition_penalty:
+                a["rep_pen"][i] = o.repetition_penalty
+        np_w = next_bucket(
+            max((len(c) for c in gen_token_counts), default=0) or 1,
+            COUNT_BUCKETS,
+        )
+        nr_w = next_bucket(
+            max((len(p) for p in prompt_token_ids), default=0) or 1,
+            COUNT_BUCKETS,
+        )
+        a["gen_ids"] = np.zeros((n, np_w), np.int32)
+        a["gen_counts"] = np.zeros((n, np_w), np.float32)
+        a["prompt_ids"] = np.zeros((n, nr_w), np.int32)
+        a["prompt_counts"] = np.zeros((n, nr_w), np.float32)
+        for i, counts in enumerate(gen_token_counts):
+            for j, (tok, c) in enumerate(sorted(counts.items())[:np_w]):
+                a["gen_ids"][i, j] = tok
+                a["gen_counts"][i, j] = c
+        for i, toks in enumerate(prompt_token_ids):
+            t = np.asarray(toks, np.int32)[:nr_w]
+            a["prompt_ids"][i, : len(t)] = t
+            a["prompt_counts"][i, : len(t)] = 1.0
+        return a
+
+
+# ---------------------------------------------------------------------------
+# Device side
+# ---------------------------------------------------------------------------
+
+
+def dense_gen_counts(s: dict, vocab: int) -> jax.Array:
+    """Scatter the sparse generated-token table into a dense [B, V] f32
+    (the fused-window carry: updated on device after each sampled
+    token)."""
+    B = s["gen_ids"].shape[0]
+    rows = jnp.arange(B)[:, None]
+    return (
+        jnp.zeros((B, vocab), jnp.float32).at[rows, s["gen_ids"]].add(
+            s["gen_counts"]
+        )
+    )
+
+
+def dense_prompt_presence(s: dict, vocab: int) -> jax.Array:
+    """Dense [B, V] f32 presence (>=1 where the token occurs in the
+    prompt) — constant across a fused window."""
+    B = s["prompt_ids"].shape[0]
+    rows = jnp.arange(B)[:, None]
+    return (
+        jnp.zeros((B, vocab), jnp.float32).at[rows, s["prompt_ids"]].add(
+            s["prompt_counts"]
+        )
+    )
+
+
+def apply_penalties(
+    logits: jax.Array,  # [B, V] f32
+    s: dict,
+    gen_dense: jax.Array,  # [B, V] f32 generated-token counts
+    prompt_dense: jax.Array,  # [B, V] f32 prompt presence
+) -> jax.Array:
+    """HF-style repetition penalty over prompt+generated, then OpenAI
+    frequency/presence over generated only (vLLM order)."""
+    rp = s["rep_pen"][:, None]
+    seen_any = (gen_dense + prompt_dense) > 0
+    rep = jnp.where(logits > 0, logits / rp, logits * rp)
+    logits = jnp.where(seen_any, rep, logits)
+    logits = (
+        logits
+        - s["freq_pen"][:, None] * gen_dense
+        - s["pres_pen"][:, None] * (gen_dense > 0)
+    )
+    return logits
 
 
 def sample(
     logits: jax.Array,  # [B, V] f32
-    temperature: jax.Array,  # [B]
-    top_k: jax.Array,  # [B]
-    top_p: jax.Array,  # [B]
-    seeds: jax.Array,  # [B] u32
+    s: dict,  # SamplingBatch.arrays (device-side pytree)
+    gen_dense: Optional[jax.Array] = None,  # [B, V] carried counts
+    prompt_dense: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, jax.Array]:
-    """Returns (next_tokens [B] i32, logprobs_of_chosen [B] f32)."""
+    """Returns (next_tokens [B] i32, logprobs_of_chosen [B] f32).
+
+    The penalty tables (``gen_dense``/``prompt_dense``) are passed
+    explicitly by fused-window callers so the carry survives across
+    steps; single-step callers omit them and they are built from the
+    sparse tables when present.
+    """
     B, V = logits.shape
+    rows = jnp.arange(B)[:, None]
+    # logit bias first (OpenAI: bias applies before sampling of any kind)
+    logits = logits.at[rows, s["bias_ids"]].add(s["bias_vals"])
+    if "rep_pen" in s:
+        if gen_dense is None:
+            gen_dense = dense_gen_counts(s, V)
+        if prompt_dense is None:
+            prompt_dense = dense_prompt_presence(s, V)
+        logits = apply_penalties(logits, s, gen_dense, prompt_dense)
+
+    temperature, top_k, top_p, min_p, seeds = (
+        s["temperature"], s["top_k"], s["top_p"], s["min_p"], s["seeds"]
+    )
     greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     def sampled_path(_) -> jax.Array:
-        # top-k / top-p filtering on sorted logits
+        # top-k / top-p / min-p filtering on sorted logits
         temp = jnp.maximum(temperature, 1e-4)[:, None]
         scaled = logits / temp
         sort_idx = jnp.argsort(-scaled, axis=-1)  # descending
@@ -71,7 +263,10 @@ def sample(
         sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
         cumprobs = jnp.cumsum(sorted_probs, axis=-1)
         p_mask = (cumprobs - sorted_probs) < top_p[:, None]
-        keep = k_mask & p_mask
+        # min-p: drop tokens whose prob < min_p × max prob (rank 0 is
+        # the max after the descending sort, so it always survives)
+        m_mask = sorted_probs >= (min_p[:, None] * sorted_probs[:, :1])
+        keep = k_mask & p_mask & m_mask
         filtered = jnp.where(keep, sorted_logits, NEG_INF)
         # per-slot independent RNG streams
         keys = jax.vmap(jax.random.key)(seeds)
@@ -94,3 +289,27 @@ def sample(
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     chosen_lp = jnp.take_along_axis(logprobs, next_tok[:, None], axis=-1)[:, 0]
     return next_tok, chosen_lp
+
+
+def reference_sample_numpy(
+    logits: np.ndarray, s: dict, row: int
+) -> np.ndarray:
+    """Pure-numpy reference of the logits transform for row ``row`` —
+    bias + penalties + filtering masks (no RNG; used by parity tests to
+    check the device pipeline's distribution shaping)."""
+    x = logits.astype(np.float64).copy()
+    for tok, v in zip(s["bias_ids"][row], s["bias_vals"][row]):
+        x[int(tok)] += float(v)
+    if "rep_pen" in s:
+        gen = np.zeros_like(x)
+        for tok, c in zip(s["gen_ids"][row], s["gen_counts"][row]):
+            gen[int(tok)] += float(c)
+        prompt = np.zeros_like(x)
+        for tok, c in zip(s["prompt_ids"][row], s["prompt_counts"][row]):
+            prompt[int(tok)] += float(c)
+        rp = float(s["rep_pen"][row])
+        seen = (gen + prompt) > 0
+        x = np.where(seen, np.where(x > 0, x / rp, x * rp), x)
+        x = x - float(s["freq_pen"][row]) * gen
+        x = x - float(s["pres_pen"][row]) * (gen > 0)
+    return x

@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import enum
 import logging
-import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -55,13 +54,19 @@ class Sequence:
     committed_blocks: int = 0  # prefix of block_table already content-addressed
     generated: int = 0
     arrival: int = 0
-    arrived_at: float = 0.0  # wall clock, for admission coalescing
     # engine-facing hooks
     emit: Optional[Callable] = None  # called with LLMEngineOutput-shaped dicts
     is_cancelled: Optional[Callable[[], bool]] = None
     finish_reason: Optional[FinishReason] = None
     # multimodal: [(token offset, embeds[n, D])] to inject during prefill
     mm_segments: list = field(default_factory=list)
+    # generated-token counts for frequency/presence/repetition penalties
+    # (only maintained when the request's sampling options need them)
+    gen_counts: dict = field(default_factory=dict)
+    # cached distinct prompt ids for the repetition penalty (immutable;
+    # computed once — np.unique over a long prompt must not sit on the
+    # per-step host path)
+    prompt_unique: Optional[Any] = None
 
     @property
     def request_id(self) -> str:
@@ -91,9 +96,17 @@ class PrefillWork:
 
 @dataclass
 class StepPlan:
-    """What the engine should run this step."""
+    """What the engine should run this step.
 
-    kind: str  # "prefill" | "decode" | "idle"
+    kind "mixed" carries BOTH a bounded prefill batch and the decode
+    batch: the engine fuses them into one dispatch (prefill rectangle +
+    K-step decode window) so a straggler's prefill no longer costs a
+    dedicated full-weight pass while decode stalls — the serving-layer
+    half of continuous batching (reference: vLLM's mixed scheduler,
+    container/deps/vllm/...-patch :535, docs/architecture.md:55-68).
+    """
+
+    kind: str  # "prefill" | "decode" | "mixed" | "idle"
     prefill_batch: list[PrefillWork] = field(default_factory=list)
     decode_seqs: list[Sequence] = field(default_factory=list)
 
@@ -125,18 +138,16 @@ class Scheduler:
         self.waiting: deque[Sequence] = deque()
         self.prefilling: deque[Sequence] = deque()
         self.running: list[Sequence] = []
-        # admission coalescing: under staggered arrivals, each lone
-        # admission triggers a prefill step that reads ALL weights for
-        # one row — a few such steps per request cycle halves serving
-        # throughput (benchmarks/RESULTS.md). While decode has work,
-        # hold arrivals up to coalesce_s (or until coalesce_min wait)
-        # so prefills batch. 0 = off; idle engines always admit.
-        self.prefill_coalesce_s = 0.0
-        self.prefill_coalesce_min = 4
         # fused multi-step decode: how many tokens one device step emits
         # (engine sets this from EngineConfig.decode_steps); block
         # allocation must cover the whole window up front
         self.decode_lookahead = 1
+        # mixed prefill+decode: when decode has work AND prefill chunks
+        # are pending, emit a "mixed" plan whose prefill batch fits the
+        # engine's fixed [mixed_prefill_rows, mixed_prefill_len]
+        # rectangle (0 rows = mixed planning off)
+        self.mixed_prefill_rows = 0
+        self.mixed_prefill_len = 256
         self._arrival = 0
         # invoked on every finish (incl. cancellations reaped inside plan())
         self.on_finish: Optional[Callable[[Sequence, FinishReason], None]] = None
@@ -150,7 +161,6 @@ class Scheduler:
     # -- intake -----------------------------------------------------------
     def add_request(self, seq: Sequence) -> None:
         seq.arrival = self._arrival
-        seq.arrived_at = time.monotonic()
         self._arrival += 1
         self.waiting.append(seq)
 
@@ -167,24 +177,36 @@ class Scheduler:
         return bool(self.waiting or self.prefilling or self.running)
 
     # -- planning ---------------------------------------------------------
-    def _admission_held(self) -> bool:
-        """True while arrivals are deliberately coalescing: decode may
-        proceed (and keep pipelining) past the waiting queue."""
-        if not self.waiting or not self.running or self.prefill_coalesce_s <= 0:
-            return False
-        if self.prefilling:
-            return False  # joining an in-flight prefill batch is free
-        if len(self.waiting) >= self.prefill_coalesce_min:
-            return False
-        return (
-            time.monotonic() - self.waiting[0].arrived_at
-            < self.prefill_coalesce_s
-        )
-
     def plan(self) -> StepPlan:
         self._reap_cancelled()
-        if not self._admission_held():
-            self._admit()
+        self._admit()
+        if (
+            self.prefilling
+            and self.running
+            and self.mixed_prefill_rows > 0
+            and self._prefill_backlog()
+            <= 2 * self.mixed_prefill_rows * self.mixed_prefill_len
+        ):
+            # mixed step: prefill rides the decode window's dispatch,
+            # bounded to the engine's fixed rectangle. Large backlogs
+            # (cold-start bursts, long prompts) fall through to the
+            # dedicated batched-prefill step below — trickling them
+            # through the small rectangle would multiply TTFT.
+            works = self._plan_prefill_batch(
+                budget=self.mixed_prefill_rows * self.mixed_prefill_len,
+                max_seqs=self.mixed_prefill_rows,
+                max_chunk_len=self.mixed_prefill_len,
+            )
+            decode = self._plan_decode()
+            if works and decode:
+                return StepPlan(
+                    kind="mixed", prefill_batch=works, decode_seqs=decode
+                )
+            if works:
+                return StepPlan(kind="prefill", prefill_batch=works)
+            if decode:
+                return StepPlan(kind="decode", decode_seqs=decode)
+            return StepPlan(kind="idle")
         if self.prefilling:
             works = self._plan_prefill_batch()
             if works:
@@ -192,6 +214,15 @@ class Scheduler:
         if self.running:
             return StepPlan(kind="decode", decode_seqs=self._plan_decode())
         return StepPlan(kind="idle")
+
+    def _prefill_backlog(self) -> int:
+        """TRUE pending prompt tokens across prefilling sequences — NOT
+        chunk-capped: a single long prompt must trip the dedicated-
+        prefill fallback rather than trickle through the mixed
+        rectangle at mixed_prefill_len tokens per decode window."""
+        return sum(
+            max(1, s.total_len - s.num_computed) for s in self.prefilling
+        )
 
     def _reap_cancelled(self) -> None:
         for pool in (self.waiting, self.prefilling):
@@ -252,11 +283,15 @@ class Scheduler:
                 self.prefix_hits += 1
 
     def _plan_prefill_batch(
-        self, budget: Optional[int] = None, max_seqs: Optional[int] = None
+        self,
+        budget: Optional[int] = None,
+        max_seqs: Optional[int] = None,
+        max_chunk_len: Optional[int] = None,
     ) -> list[PrefillWork]:
         """One chunk from each of several prefilling sequences, fused
         into a single step (total tokens bounded by max_prefill_tokens)
-        — continuous batching's batched-prefill half."""
+        — continuous batching's batched-prefill half. ``max_chunk_len``
+        additionally caps each row's chunk (the mixed-step rectangle)."""
         budget = budget if budget is not None else self.max_prefill_tokens
         max_seqs = max_seqs if max_seqs is not None else self.max_batch_size
         works: list[PrefillWork] = []
@@ -273,6 +308,8 @@ class Scheduler:
                 start = max(0, len(prompt) - 1)
                 remaining = len(prompt) - start
             chunk = min(remaining, self.prefill_chunk_size, budget)
+            if max_chunk_len is not None:
+                chunk = min(chunk, max_chunk_len)
             # the dispatch cost is the PADDED B×T rectangle (every row
             # pads to the longest chunk's bucket), so the budget bounds
             # that area, not the sum of real tokens — one long chunk
@@ -376,7 +413,7 @@ class Scheduler:
         """
         import numpy as np
 
-        if self.prefilling or (self.waiting and not self._admission_held()):
+        if self.prefilling or self.waiting:
             return None
         K = self.decode_lookahead
         for seq in seqs:
@@ -453,6 +490,8 @@ class Scheduler:
     def append_token(self, seq: Sequence, token: int) -> None:
         seq.tokens.append(int(token))
         seq.generated += 1
+        if seq.request.sampling.needs_penalties:
+            seq.gen_counts[int(token)] = seq.gen_counts.get(int(token), 0) + 1
         # the just-sampled token's KV is NOT in the cache yet — it only gets
         # written when it is fed as input on the next step. Counting it as
         # computed would let _commit_full_blocks content-address a block

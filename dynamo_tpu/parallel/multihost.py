@@ -29,15 +29,43 @@ from typing import Optional
 
 import numpy as np
 
-# control vector layout (int32[8]):
-# [kind, B, T, table_width, flags, reserved, reserved, reserved]
-CTRL_LEN = 8
+# control vector layout (int32[16]):
+# [kind, B, T, table_width, sampling_flags, bias_width, gen_width,
+#  prompt_width, P, T_rect, p_flags, p_bias_width, p_gen_width,
+#  p_prompt_width, 0, 0] — slots 4-7 describe the (decode) sampling
+# dict structure, slots 8-13 the mixed step's prefill rectangle and its
+# sampling dict, so followers can allocate matching broadcast buffers
+CTRL_LEN = 16
+FLAG_PENALTIES = 1  # sampling dict carries the penalty tables
+
+# fixed key order for broadcasting SamplingBatch.arrays as a tuple
+SAMPLING_BASE_KEYS = (
+    ("temperature", np.float32), ("top_k", np.int32), ("top_p", np.float32),
+    ("min_p", np.float32), ("seeds", np.uint32),
+    ("bias_ids", np.int32), ("bias_vals", np.float32),
+)
+SAMPLING_PEN_KEYS = (
+    ("freq_pen", np.float32), ("pres_pen", np.float32),
+    ("rep_pen", np.float32),
+    ("gen_ids", np.int32), ("gen_counts", np.float32),
+    ("prompt_ids", np.int32), ("prompt_counts", np.float32),
+)
 KIND_STOP = 0
 KIND_STEP = 1  # single fused step (prefill or 1-token decode)
 KIND_MULTI_STEP = 2  # fused K-step decode window
 KIND_KV_GATHER = 3  # mirrored KV offload gather (shard-local store)
 KIND_KV_SCATTER = 4  # mirrored KV onboard scatter (shard-local load)
 KIND_KV_DISABLE = 5  # leader-side offload failure: drop shard pools
+KIND_MIXED = 6  # mixed prefill-rectangle + K-step decode window
+
+
+class FatalMultihostError(RuntimeError):
+    """A failure INSIDE a mirrored collective (after the announce, while
+    followers are already blocked in the same jitted op). The lockstep
+    recovery protocol (KIND_KV_DISABLE) only works BETWEEN complete
+    mirrored ops — a disable broadcast issued now would mismatch the
+    followers' in-flight collective and hang or desync the job, so the
+    only safe response is to take the multihost job down."""
 
 
 class StepBroadcaster:
@@ -48,22 +76,44 @@ class StepBroadcaster:
 
         self._bcast = multihost_utils.broadcast_one_to_all
 
-    def _ctrl(self, kind: int, b: int = 0, t: int = 0, w: int = 0) -> None:
+    def _ctrl(
+        self, kind: int, b: int = 0, t: int = 0, w: int = 0,
+        sampling: Optional[dict] = None,
+    ) -> None:
         ctrl = np.zeros((CTRL_LEN,), np.int32)
         ctrl[:4] = (kind, b, t, w)
+        if sampling is not None:
+            _fill_sampling_desc(ctrl, 4, sampling)
         self._bcast(ctrl)
 
     def announce_step(self, arrays: dict, sampling) -> None:
         b, t = arrays["tokens"].shape
         w = arrays["block_tables"].shape[1]
-        self._ctrl(KIND_STEP, b, t, w)
+        self._ctrl(KIND_STEP, b, t, w, sampling.arrays)
         self._bcast(_step_tuple(arrays, sampling))
 
     def announce_multi_step(self, arrays: dict, sampling) -> None:
         b = arrays["tokens"].shape[0]
         w = arrays["block_tables"].shape[1]
-        self._ctrl(KIND_MULTI_STEP, b, 1, w)
+        self._ctrl(KIND_MULTI_STEP, b, 1, w, sampling.arrays)
         self._bcast(_multi_step_tuple(arrays, sampling))
+
+    def announce_mixed(
+        self, p_arrays: dict, p_sampling, d_arrays: dict, d_sampling
+    ) -> None:
+        ctrl = np.zeros((CTRL_LEN,), np.int32)
+        ctrl[0] = KIND_MIXED
+        ctrl[1] = d_arrays["tokens"].shape[0]
+        ctrl[2] = 1
+        ctrl[3] = d_arrays["block_tables"].shape[1]  # == p width (padded)
+        _fill_sampling_desc(ctrl, 4, d_sampling.arrays)
+        ctrl[8], ctrl[9] = p_arrays["tokens"].shape
+        _fill_sampling_desc(ctrl, 10, p_sampling.arrays)
+        self._bcast(ctrl)
+        self._bcast(
+            _step_tuple(p_arrays, p_sampling)
+            + _multi_step_tuple(d_arrays, d_sampling)
+        )
 
     def announce_kv(self, kind: int, block_ids: list[int],
                     seq_hashes: list[int]) -> None:
@@ -82,6 +132,45 @@ class StepBroadcaster:
         self._ctrl(KIND_STOP)
 
 
+def _fill_sampling_desc(ctrl: np.ndarray, off: int, s: dict) -> None:
+    """Write a sampling dict's structure descriptor (flags + sparse
+    table widths) into ctrl[off:off+4]."""
+    ctrl[off] = FLAG_PENALTIES if "rep_pen" in s else 0
+    ctrl[off + 1] = s["bias_ids"].shape[1]
+    if "rep_pen" in s:
+        ctrl[off + 2] = s["gen_ids"].shape[1]
+        ctrl[off + 3] = s["prompt_ids"].shape[1]
+
+
+def _sampling_keys(has_pen: bool) -> tuple:
+    return SAMPLING_BASE_KEYS + (SAMPLING_PEN_KEYS if has_pen else ())
+
+
+def _sampling_tuple(sampling) -> tuple:
+    s = sampling.arrays
+    return tuple(
+        np.asarray(s[k], dt) for k, dt in _sampling_keys("rep_pen" in s)
+    )
+
+
+def _zeros_sampling(b: int, flags: int, nb: int, ng: int, nr: int) -> tuple:
+    has_pen = bool(flags & FLAG_PENALTIES)
+    widths = {"bias_ids": nb, "bias_vals": nb, "gen_ids": ng,
+              "gen_counts": ng, "prompt_ids": nr, "prompt_counts": nr}
+    return tuple(
+        np.zeros((b, widths[k]) if k in widths else (b,), dt)
+        for k, dt in _sampling_keys(has_pen)
+    )
+
+
+def _sampling_dict(args: tuple, flags: int) -> dict:
+    has_pen = bool(flags & FLAG_PENALTIES)
+    return {
+        k: np.asarray(v)
+        for (k, _), v in zip(_sampling_keys(has_pen), args)
+    }
+
+
 def _step_tuple(arrays: dict, sampling) -> tuple:
     return (
         np.asarray(arrays["tokens"], np.int32),
@@ -90,11 +179,7 @@ def _step_tuple(arrays: dict, sampling) -> tuple:
         np.asarray(arrays["block_tables"], np.int32),
         np.asarray(arrays["context_lens"], np.int32),
         np.asarray(arrays["last_token_idx"], np.int32),
-        np.asarray(sampling.temperature, np.float32),
-        np.asarray(sampling.top_k, np.int32),
-        np.asarray(sampling.top_p, np.float32),
-        np.asarray(sampling.seeds, np.uint32),
-    )
+    ) + _sampling_tuple(sampling)
 
 
 def _multi_step_tuple(arrays: dict, sampling) -> tuple:
@@ -104,14 +189,11 @@ def _multi_step_tuple(arrays: dict, sampling) -> tuple:
         np.asarray(arrays["block_tables"], np.int32),
         np.asarray(arrays["context_lens"], np.int32),
         np.asarray(arrays["valid_steps"], np.int32),
-        np.asarray(sampling.temperature, np.float32),
-        np.asarray(sampling.top_k, np.int32),
-        np.asarray(sampling.top_p, np.float32),
-        np.asarray(sampling.seeds, np.uint32),
-    )
+    ) + _sampling_tuple(sampling)
 
 
-def _zeros_step(b: int, t: int, w: int) -> tuple:
+def _zeros_step(b: int, t: int, w: int, flags: int, nb: int, ng: int,
+                nr: int) -> tuple:
     return (
         np.zeros((b, t), np.int32),
         np.zeros((b, t), np.int32),
@@ -119,25 +201,18 @@ def _zeros_step(b: int, t: int, w: int) -> tuple:
         np.zeros((b, w), np.int32),
         np.zeros((b,), np.int32),
         np.zeros((b,), np.int32),
-        np.zeros((b,), np.float32),
-        np.zeros((b,), np.int32),
-        np.zeros((b,), np.float32),
-        np.zeros((b,), np.uint32),
-    )
+    ) + _zeros_sampling(b, flags, nb, ng, nr)
 
 
-def _zeros_multi_step(b: int, w: int) -> tuple:
+def _zeros_multi_step(b: int, w: int, flags: int, nb: int, ng: int,
+                      nr: int) -> tuple:
     return (
         np.zeros((b, 1), np.int32),
         np.zeros((b, 1), np.int32),
         np.zeros((b, w), np.int32),
         np.zeros((b,), np.int32),
         np.zeros((b,), np.int32),
-        np.zeros((b,), np.float32),
-        np.zeros((b,), np.int32),
-        np.zeros((b,), np.float32),
-        np.zeros((b,), np.uint32),
-    )
+    ) + _zeros_sampling(b, flags, nb, ng, nr)
 
 
 # ---------------------------------------------------------------------------
@@ -333,10 +408,15 @@ class ShardedKvOffload:
         hashes = [h for h, _ in batch]
         ids = [b for _, b in batch]
         self.broadcaster.announce_kv(KIND_KV_GATHER, ids, hashes)
-        rows = mirror_gather(
-            e.k_cache, e.v_cache, np.asarray(ids, np.int32),
-            e.config.block_size, e.mesh,
-        )
+        try:
+            rows = mirror_gather(
+                e.k_cache, e.v_cache, np.asarray(ids, np.int32),
+                e.config.block_size, e.mesh,
+            )
+        except Exception as exc:  # followers are inside the collective
+            raise FatalMultihostError(
+                "leader failed inside a mirrored KV gather"
+            ) from exc
         self.pool.insert_many(hashes, rows)
         return len(batch)
 
@@ -365,10 +445,15 @@ class ShardedKvOffload:
         sample = next(iter(self.pool._data.values()))
         rows = self.pool.rows(hashes, sample.shape, sample.dtype)
         self.broadcaster.announce_kv(KIND_KV_SCATTER, ids, hashes)
-        e.k_cache, e.v_cache = mirror_scatter(
-            e.k_cache, e.v_cache, np.asarray(ids, np.int32), rows,
-            e.config.block_size, e.mesh,
-        )
+        try:
+            e.k_cache, e.v_cache = mirror_scatter(
+                e.k_cache, e.v_cache, np.asarray(ids, np.int32), rows,
+                e.config.block_size, e.mesh,
+            )
+        except Exception as exc:  # followers are inside the collective
+            raise FatalMultihostError(
+                "leader failed inside a mirrored KV scatter"
+            ) from exc
         return n
 
     def close(self) -> None:
@@ -396,7 +481,7 @@ class StepFollower:
             pool = ShardKvPool(e.config.host_kv_blocks)
         while True:
             ctrl = np.asarray(self._bcast(np.zeros((CTRL_LEN,), np.int32)))
-            kind, b, t, w = (int(x) for x in ctrl[:4])
+            kind, b, t, w, flags, nb, ng, nr = (int(x) for x in ctrl[:8])
             if kind == KIND_STOP:
                 return
             if kind == KIND_KV_DISABLE:
@@ -426,20 +511,37 @@ class StepFollower:
                     )
                 continue
             if kind == KIND_STEP:
-                args = self._bcast(_zeros_step(b, t, w))
-                (tokens, positions, slots, tables, ctx, last,
-                 temp, tk, tp, seeds) = args
+                args = self._bcast(_zeros_step(b, t, w, flags, nb, ng, nr))
+                tokens, positions, slots, tables, ctx, last = args[:6]
+                s = _sampling_dict(args[6:], flags)
                 _, _, e.k_cache, e.v_cache = e._step_fn(
                     e.params, e.k_cache, e.v_cache, tokens, positions,
-                    slots, tables, ctx, last, temp, tk, tp, seeds,
+                    slots, tables, ctx, last, s,
                 )
             elif kind == KIND_MULTI_STEP:
-                args = self._bcast(_zeros_multi_step(b, w))
-                (tokens, positions, tables, ctx, valid,
-                 temp, tk, tp, seeds) = args
+                args = self._bcast(
+                    _zeros_multi_step(b, w, flags, nb, ng, nr)
+                )
+                tokens, positions, tables, ctx, valid = args[:5]
+                s = _sampling_dict(args[5:], flags)
                 _, _, e.k_cache, e.v_cache = e._multi_step_fn(
                     e.params, e.k_cache, e.v_cache, tokens, positions,
-                    tables, ctx, valid, temp, tk, tp, seeds,
+                    tables, ctx, valid, s,
+                )
+            elif kind == KIND_MIXED:
+                p, t_rect, p_flags, p_nb, p_ng, p_nr = (
+                    int(x) for x in ctrl[8:14]
+                )
+                p_zeros = _zeros_step(p, t_rect, w, p_flags, p_nb, p_ng, p_nr)
+                d_zeros = _zeros_multi_step(b, w, flags, nb, ng, nr)
+                args = self._bcast(p_zeros + d_zeros)
+                np_ = len(p_zeros)
+                p_args, d_args = args[:np_], args[np_:]
+                p_s = _sampling_dict(p_args[6:], p_flags)
+                d_s = _sampling_dict(d_args[5:], flags)
+                _, _, _, _, e.k_cache, e.v_cache = e._mixed_step_fn(
+                    e.params, e.k_cache, e.v_cache,
+                    *p_args[:6], p_s, *d_args[:5], d_s,
                 )
             else:
                 raise RuntimeError(f"unknown multihost step kind {kind}")

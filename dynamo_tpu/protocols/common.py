@@ -34,9 +34,26 @@ class SamplingOptions(BaseModel):
     frequency_penalty: Optional[float] = None
     presence_penalty: Optional[float] = None
     repetition_penalty: Optional[float] = None
+    # token id -> additive logit bias (OpenAI-style; string keys from the
+    # HTTP layer are normalized to ints by the adapters)
+    logit_bias: Optional[dict[int, float]] = None
     seed: Optional[int] = None
     n: int = 1
     use_greedy: bool = False
+
+    @property
+    def needs_penalties(self) -> bool:
+        """True when this request needs the token-count penalty sampling
+        path (a separately-compiled device step variant carrying per-slot
+        token-count tables; min_p/logit_bias ride the base path)."""
+        return bool(
+            self.frequency_penalty
+            or self.presence_penalty
+            or (
+                self.repetition_penalty is not None
+                and self.repetition_penalty != 1.0
+            )
+        )
 
     def normalized(self) -> "SamplingOptions":
         """Resolve greedy mode: temperature<=0 means greedy decoding."""

@@ -16,7 +16,7 @@ import time
 import uuid
 from typing import Any, Literal, Optional, Union
 
-from pydantic import BaseModel, ConfigDict, Field
+from pydantic import BaseModel, ConfigDict, Field, field_validator
 
 from dynamo_tpu.protocols.common import (
     FinishReason,
@@ -42,6 +42,31 @@ class ExtOptions(BaseModel):
     repetition_penalty: Optional[float] = None
     annotations: list[str] = Field(default_factory=list)
     use_raw_prompt: Optional[bool] = None
+
+
+def _int_logit_bias(
+    bias: Optional[dict[str, float]],
+) -> Optional[dict[int, float]]:
+    """OpenAI carries logit_bias keyed by token-id STRINGS; engines want
+    ints. Keys are validated at request-model validation time
+    (_validate_logit_bias below -> 400), so this conversion can't fail
+    on the engine path."""
+    if not bias:
+        return None
+    return {int(k): float(v) for k, v in bias.items()}
+
+
+def _validate_logit_bias(v: Optional[dict[str, float]]):
+    """Pydantic field validator: reject non-token-id keys DURING request
+    validation so clients get a 400, not a mid-generation 500."""
+    for k in v or {}:
+        try:
+            tok = int(k)
+        except ValueError:
+            raise ValueError(f"logit_bias key {k!r} is not a token id")
+        if tok < 0:
+            raise ValueError(f"logit_bias token id {tok} is negative")
+    return v
 
 
 # ---------------------------------------------------------------------------
@@ -100,6 +125,8 @@ class ChatCompletionRequest(BaseModel):
     # accept the reference's field name too
     nvext: Optional[ExtOptions] = None
 
+    _check_logit_bias = field_validator("logit_bias")(_validate_logit_bias)
+
     def extension(self) -> ExtOptions:
         return self.ext or self.nvext or ExtOptions()
 
@@ -114,6 +141,7 @@ class ChatCompletionRequest(BaseModel):
             frequency_penalty=self.frequency_penalty,
             presence_penalty=self.presence_penalty,
             repetition_penalty=ext.repetition_penalty,
+            logit_bias=_int_logit_bias(self.logit_bias),
             seed=self.seed,
             n=self.n or 1,
             use_greedy=bool(ext.greedy_sampling),
@@ -202,10 +230,13 @@ class CompletionRequest(BaseModel):
     stop: Union[str, list[str], None] = None
     presence_penalty: Optional[float] = None
     frequency_penalty: Optional[float] = None
+    logit_bias: Optional[dict[str, float]] = None
     seed: Optional[int] = None
     user: Optional[str] = None
     ext: Optional[ExtOptions] = None
     nvext: Optional[ExtOptions] = None
+
+    _check_logit_bias = field_validator("logit_bias")(_validate_logit_bias)
 
     def extension(self) -> ExtOptions:
         return self.ext or self.nvext or ExtOptions()
@@ -220,6 +251,7 @@ class CompletionRequest(BaseModel):
             frequency_penalty=self.frequency_penalty,
             presence_penalty=self.presence_penalty,
             repetition_penalty=ext.repetition_penalty,
+            logit_bias=_int_logit_bias(self.logit_bias),
             seed=self.seed,
             n=self.n or 1,
             use_greedy=bool(ext.greedy_sampling),

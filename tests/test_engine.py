@@ -551,45 +551,3 @@ async def test_multi_step_under_block_pressure():
         await engine.shutdown()
 
 
-def test_admission_coalescing_holds_then_releases():
-    """With coalescing on, a lone arrival is held while decode has work
-    (its prefill would cost a full weight pass for one row), released by
-    quorum, age, or an idle engine."""
-    alloc = BlockAllocator(64, 4)
-    sched = Scheduler(alloc, 4, max_batch_size=8, prefill_chunk_size=16)
-    # large window: held-phase asserts must not age out under CI load;
-    # the age-release phase backdates arrived_at instead of sleeping
-    sched.prefill_coalesce_s = 30.0
-    sched.prefill_coalesce_min = 3
-
-    # idle engine: admits immediately regardless of coalescing
-    sched.add_request(_mk_seq(list(range(1, 9)), request_id="idle"))
-    assert sched.plan().kind == "prefill"
-    for w in sched._plan_prefill_batch():
-        sched.complete_prefill_chunk(w)
-    assert sched.running
-
-    # busy engine: a single arrival is held -> decode keeps planning
-    sched.add_request(_mk_seq(list(range(1, 9)), request_id="held"))
-    assert sched._admission_held()
-    assert sched.plan().kind == "decode"
-    assert len(sched.waiting) == 1
-    # ...and the pipelined planner may run past the held arrival
-    assert sched.plan_pipelined_window(sched.running[:], 1) is not None
-
-    # quorum releases immediately
-    sched.add_request(_mk_seq(list(range(1, 9)), request_id="q2"))
-    sched.add_request(_mk_seq(list(range(1, 9)), request_id="q3"))
-    assert not sched._admission_held()
-    assert sched.plan().kind == "prefill"
-    assert len(sched.prefilling) == 3
-
-    # age releases a straggler
-    while sched.prefilling:  # token budget may split the batch
-        for w in sched._plan_prefill_batch():
-            sched.complete_prefill_chunk(w)
-    sched.add_request(_mk_seq(list(range(1, 9)), request_id="aged"))
-    assert sched._admission_held()
-    sched.waiting[0].arrived_at -= 31.0  # simulate the wait
-    assert not sched._admission_held()
-    assert sched.plan().kind == "prefill"
