@@ -114,17 +114,10 @@ async def _run(model_cfg, wl) -> dict:
         decode_steps=int(os.environ.get("DYN_BENCH_DECODE_STEPS", "32")),
         hbm_utilization=0.7,
     )
-    # one batch bucket = one compile per step kind: every step (decode
-    # AND batched prefill share BATCH_BUCKETS) pads to full batch, and
-    # all prompts chunk at the same length, so the only reachable step
-    # shapes are the ones warmup exercises. Compiles are minutes over
-    # the chip tunnel; the padded-lane compute overhead is noise.
-    from dynamo_tpu.engine.scheduler import Scheduler
-
-    Scheduler.BATCH_BUCKETS = [wl["batch"]]
-    # hold block-table width constant across the whole run too
-    total_blocks = -(-(wl["isl"] + wl["osl"] + wl["block_size"]) // wl["block_size"])
-    Scheduler.TABLE_BUCKET = max(Scheduler.TABLE_BUCKET, total_blocks)
+    # static serving shapes (EngineConfig.static_shapes, default on)
+    # pin the decode batch, table width, and prefill buckets so the only
+    # reachable step shapes are the ones warmup exercises — compiles
+    # are minutes over the chip tunnel.
     print(f"# engine launching (compile ~minutes on first run)", file=sys.stderr, flush=True)
     engine = await JaxEngine.launch(cfg, model_config=model_cfg)
     print("# engine up", file=sys.stderr, flush=True)

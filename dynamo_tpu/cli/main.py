@@ -69,7 +69,7 @@ def build_parser() -> argparse.ArgumentParser:
                      help="fused decode window: tokens per device "
                           "dispatch (amortizes dispatch latency; tokens "
                           "stream in bursts of this size)")
-    run.add_argument("--mixed-prefill-rows", type=int, default=4,
+    run.add_argument("--mixed-prefill-rows", type=int, default=8,
                      help="mixed continuous batching (needs "
                           "--decode-steps > 1): pending prefill chunks "
                           "ride the decode window's dispatch in a fixed "

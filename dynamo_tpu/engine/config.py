@@ -51,8 +51,23 @@ class EngineConfig:
     # window instead of a dedicated full-weight pass while decode
     # stalls. rows=0 disables (reference behavior: vLLM's mixed
     # scheduler, container/deps/vllm/...-patch :535).
-    mixed_prefill_rows: int = 4
+    # rows=8: each mixed window graduates up to 8 prefills into decode;
+    # at 4 windows per 128-token generation that sustains a full
+    # 32-deep decode batch (4 rows measured as a decode-population cap
+    # of 16 — half the batch idle)
+    mixed_prefill_rows: int = 8
     mixed_prefill_len: int = 256
+    # static serving shapes: pad the decode batch to max_batch_size and
+    # block-table width to the max_model_len cap so the decode/mixed
+    # dispatch is ONE compiled shape (padded rows are ~free — decode is
+    # weight-read-bound). Composition-dependent buckets AOT-compile
+    # mid-serve, which measured as ~100 s p99 TTFT stalls over the chip
+    # tunnel.
+    static_shapes: bool = True
+    # compile every reachable serving shape at startup (None = auto:
+    # on for TPU backends, off elsewhere). Lazy compiles take minutes
+    # over a chip tunnel and land mid-serve as 100 s+ TTFT stalls.
+    prewarm: Optional[bool] = None
     # weights
     random_weights: bool = False  # bench/test mode: skip checkpoint load
     # weight-only quantization applied at load: None | "int8"

@@ -48,13 +48,19 @@ from dynamo_tpu.engine.scheduler import (
     StepPlan,
 )
 from dynamo_tpu.models import ModelConfig
+from dynamo_tpu.utils.bucketing import next_bucket
 from dynamo_tpu.models.llama import (
     CACHE_SPEC,
     init_cache,
     param_specs,
 )
 from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
-from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+)
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
 from dynamo_tpu.tokens import DEFAULT_SALT, TokenBlockSequence
 
@@ -149,6 +155,18 @@ class JaxEngine:
         )
         devices = jax.devices()[: mesh_cfg.size]
         self.mesh = build_mesh(mesh_cfg, devices)
+        from dynamo_tpu.models.llama import set_attention_mesh
+
+        if self._pp == 1:
+            # enable the Pallas decode kernel on multi-device tp meshes
+            # (shard_map over "tp"; see models/llama.py attend_mlp).
+            # pp engines keep the gather path: "tp" is a GSPMD auto axis
+            # inside the pp stage rotation.
+            set_attention_mesh(self.mesh)
+        else:
+            # a stale mesh left by an earlier engine in this process
+            # would poison the pp trace with a manual-tp shard_map
+            set_attention_mesh(None)
         if cfg.num_nodes > 1 and cfg.node_rank == 0:
             from dynamo_tpu.parallel.multihost import StepBroadcaster
 
@@ -221,19 +239,87 @@ class JaxEngine:
             max_prefill_tokens=cfg.max_prefill_tokens,
         )
         self.scheduler.decode_lookahead = max(1, cfg.decode_steps)
+        if cfg.static_shapes:
+            # one compiled decode/mixed shape: pad the decode batch to
+            # max_batch_size and the table width to the max_model_len
+            # cap (+ window growth margin). Composition-dependent
+            # buckets would otherwise AOT-compile MID-SERVE (minutes
+            # per variant over a chip tunnel — measured as 100 s TTFT
+            # p99 stalls). Coarse prefill buckets bound that path too.
+            sched = self.scheduler
+            sched.decode_batch_pad = next_bucket(
+                cfg.max_batch_size, Scheduler.BATCH_BUCKETS
+            )
+            eff_len = (
+                cfg.max_model_len or self.model_config.max_position_embeddings
+            )
+            # capped by the cache itself: a sequence can never hold more
+            # blocks than exist, and an uncapped long-context
+            # max_position_embeddings would give every decode step a
+            # thousands-wide dead block table (grid overhead per page)
+            blocks_cap = min(
+                -(-(eff_len + max(1, cfg.decode_steps))
+                  // cfg.block_size) + 1,
+                num_blocks,
+            )
+            sched.table_width_pad = max(
+                Scheduler.TABLE_BUCKET,
+                -(-blocks_cap // Scheduler.TABLE_BUCKET)
+                * Scheduler.TABLE_BUCKET,
+            )
+            # three prefill-batch shapes (each bucket is a multi-minute
+            # AOT prewarm): a single-row shape so a lone prompt on an
+            # idle engine doesn't pay 8× padded compute (prefill is
+            # compute-bound, unlike decode), the mixed rectangle's row
+            # count, and the full-burst width
+            sched.prefill_batch_buckets = sorted(
+                {1,
+                 min(cfg.mixed_prefill_rows, sched.decode_batch_pad),
+                 sched.decode_batch_pad}
+            )
+            sched.prefill_chunk_buckets = [256, 1024, 4096]
         if cfg.decode_steps > 1 and cfg.mixed_prefill_rows > 0:
             # normalize to bucket values: _pad_prefill_rect's fixed
             # rectangle must be >= the bucketed prefill arrays, which
             # round UP (a non-bucket rows/len would crash every mixed
             # step and fail all in-flight requests)
-            from dynamo_tpu.utils.bucketing import next_bucket
-
             cfg.mixed_prefill_rows = next_bucket(
-                cfg.mixed_prefill_rows, Scheduler.BATCH_BUCKETS
+                cfg.mixed_prefill_rows, self.scheduler.prefill_batch_buckets
             )
             cfg.mixed_prefill_len = next_bucket(
-                cfg.mixed_prefill_len, Scheduler.CHUNK_BUCKETS
+                cfg.mixed_prefill_len, self.scheduler.prefill_chunk_buckets
             )
+            # the rectangle must fit the prefill token budget the HBM
+            # headroom sizing reserves for (see _auto_num_blocks area);
+            # shrink along the bucket lists so the fixed rectangle
+            # stays a bucket value (pad invariant)
+            pb = self.scheduler.prefill_batch_buckets
+            pc = self.scheduler.prefill_chunk_buckets
+            cap = max(pc[0], self.scheduler.max_prefill_tokens)
+
+            def down(v: int, buckets: list) -> int:
+                smaller = [b for b in buckets if b < v]
+                return smaller[-1] if smaller else buckets[0]
+
+            while cfg.mixed_prefill_len > max(cap, pc[0]) and (
+                cfg.mixed_prefill_len > pc[0]
+            ):
+                cfg.mixed_prefill_len = down(cfg.mixed_prefill_len, pc)
+            while (
+                cfg.mixed_prefill_rows * cfg.mixed_prefill_len > cap
+                and cfg.mixed_prefill_rows > pb[0]
+            ):
+                cfg.mixed_prefill_rows = down(cfg.mixed_prefill_rows, pb)
+            if cfg.mixed_prefill_rows * cfg.mixed_prefill_len > cap:
+                # the smallest rectangle still exceeds the configured
+                # prefill budget: running it anyway would silently
+                # violate the HBM headroom that budget reserves
+                log.warning(
+                    "mixed prefill rectangle %dx%d exceeds "
+                    "max_prefill_tokens=%d; disabling mixed batching",
+                    cfg.mixed_prefill_rows, cfg.mixed_prefill_len, cap,
+                )
+                cfg.mixed_prefill_rows = 0
             self.scheduler.mixed_prefill_rows = cfg.mixed_prefill_rows
             self.scheduler.mixed_prefill_len = cfg.mixed_prefill_len
         self.scheduler.on_finish = self._emit_finish
@@ -289,6 +375,11 @@ class JaxEngine:
             )
             self.scheduler.onboard = self._safe_onboard
         self._build_step_fn()
+        prewarm = cfg.prewarm
+        if prewarm is None:
+            prewarm = jax.default_backend() == "tpu"
+        if prewarm:
+            self._prewarm()
         log.info(
             "engine up: %s, mesh=%s, blocks=%d×%d",
             cfg.model_name,
@@ -297,17 +388,131 @@ class JaxEngine:
             cfg.block_size,
         )
 
+    def _prewarm(self) -> None:
+        """Compile every serving-path shape variant NOW, before the
+        engine accepts traffic. With static_shapes the reachable set is
+        small and fixed: the fused decode window, the mixed window, and
+        the dedicated-prefill rectangles. A lazy compile is minutes
+        over a chip tunnel and would land mid-serve as a 100 s+ TTFT
+        stall (measured). All dummy work writes to the reserved garbage
+        slot 0 with ctx=0, so the KV cache is untouched semantically."""
+        sched = self.scheduler
+        assert sched is not None
+        t0 = time.monotonic()
+        width = sched.table_width_pad or sched.TABLE_BUCKET
+
+        def sampling_for(n: int) -> SamplingBatch:
+            return SamplingBatch.from_options(
+                [SamplingOptions(use_greedy=True)] * n, [0] * n
+            )
+
+        def prefill_arrays(b: int, t: int) -> dict[str, np.ndarray]:
+            return {
+                "tokens": np.zeros((b, t), np.int32),
+                "positions": np.zeros((b, t), np.int32),
+                "slot_mapping": np.zeros((b * t,), np.int32),
+                "block_tables": np.zeros((b, width), np.int32),
+                "context_lens": np.zeros((b,), np.int32),
+                "last_token_idx": np.zeros((b,), np.int32),
+            }
+
+        def decode_arrays(b: int) -> dict[str, np.ndarray]:
+            return {
+                "tokens": np.zeros((b, 1), np.int32),
+                "positions": np.zeros((b, 1), np.int32),
+                "slot_mapping": np.zeros((b,), np.int32),
+                "block_tables": np.zeros((b, width), np.int32),
+                "context_lens": np.zeros((b,), np.int32),
+                "valid_steps": np.zeros((b,), np.int32),
+                "last_token_idx": np.zeros((b,), np.int32),
+            }
+
+        # NOTE: direct jitted calls, NOT _run_device_step — prewarm runs
+        # during _initialize on every rank in the same order, before the
+        # followers' receive loop exists, so the step broadcast must not
+        # fire here (the jit's own collectives line up because all ranks
+        # prewarm the same shapes in the same sequence).
+        max_chunk = next_bucket(
+            self.config.prefill_chunk_size, sched.prefill_chunk_buckets
+        )
+        chunks = [c for c in sched.prefill_chunk_buckets if c <= max_chunk]
+        for chunk in chunks:
+            for b in sched.prefill_batch_buckets:
+                # the planner only emits multi-row rectangles whose
+                # padded area fits the prefill token budget (single-row
+                # steps may use the full chunk regardless)
+                if (
+                    b > sched.prefill_batch_buckets[0]
+                    and b * chunk > sched.max_prefill_tokens
+                ):
+                    continue
+                a, s = prefill_arrays(b, chunk), sampling_for(b)
+                out = self._step_fn(
+                    self.params, self.k_cache, self.v_cache, a["tokens"],
+                    a["positions"], a["slot_mapping"], a["block_tables"],
+                    a["context_lens"], a["last_token_idx"], s.arrays,
+                )
+                _, _, self.k_cache, self.v_cache = out
+                jax.block_until_ready(self.k_cache)
+        B = sched.decode_batch_pad or next_bucket(1, sched.BATCH_BUCKETS)
+        if self._multi_step_fn is None:
+            # single-step decode serving shape (decode_steps == 1)
+            a, s = decode_arrays(B), sampling_for(B)
+            _, _, self.k_cache, self.v_cache = self._step_fn(
+                self.params, self.k_cache, self.v_cache, a["tokens"],
+                a["positions"], a["slot_mapping"], a["block_tables"],
+                a["context_lens"], a["last_token_idx"], s.arrays,
+            )
+            jax.block_until_ready(self.k_cache)
+        if self._multi_step_fn is not None:
+            a, s = decode_arrays(B), sampling_for(B)
+            packed, _, self.k_cache, self.v_cache = self._multi_step_fn(
+                self.params, self.k_cache, self.v_cache, a["tokens"],
+                a["positions"], a["block_tables"], a["context_lens"],
+                a["valid_steps"], s.arrays,
+            )
+            jax.block_until_ready(packed)
+        if (
+            self._mixed_step_fn is not None
+            and sched.mixed_prefill_rows > 0
+        ):
+            P, T = self.config.mixed_prefill_rows, self.config.mixed_prefill_len
+            p = prefill_arrays(P, T)
+            d = decode_arrays(B)
+            sp, sd = sampling_for(P), sampling_for(B)
+            packed, _, self.k_cache, self.v_cache = self._mixed_step_fn(
+                self.params, self.k_cache, self.v_cache,
+                p["tokens"], p["positions"], p["slot_mapping"],
+                p["block_tables"], p["context_lens"], p["last_token_idx"],
+                sp.arrays,
+                d["tokens"], d["positions"], d["block_tables"],
+                d["context_lens"], d["valid_steps"], sd.arrays,
+            )
+            jax.block_until_ready(packed)
+        log.info("prewarm done in %.1fs", time.monotonic() - t0)
+
     def _auto_num_blocks(self, devices) -> int:
         """Size the KV cache from free HBM (fallback: modest default)."""
         mc = self.model_config
         assert mc is not None
+        # TPU tiling pads the cache's trailing [Hkv, Dh] dims (minor to
+        # a 128-lane multiple, second-minor to the sublane tile) — a
+        # small-geometry cache can occupy several× its unpadded bytes,
+        # so size from PADDED dims or the chip overcommits at compile
+        itemsize = jnp.dtype(self.config.kv_cache_dtype).itemsize
+        dh_pad = -(-mc.head_dim // 128) * 128
+        # second-minor bound: 8 covers the layouts observed on v5e for
+        # the paged cache (bf16 caches lower to packed (..,128)(2,1)
+        # tiles — empirically a [32,S,8,128] bf16 cache occupies its
+        # unpadded bytes, so 16-sublane padding does NOT apply here)
+        hk_pad = -(-mc.num_key_value_heads // 8) * 8
         bytes_per_block_total = (
             2  # K and V
             * mc.num_hidden_layers
             * self.config.block_size
-            * mc.num_key_value_heads
-            * mc.head_dim
-            * jnp.dtype(self.config.kv_cache_dtype).itemsize
+            * hk_pad
+            * dh_pad
+            * itemsize
         )
         free = None
         try:
@@ -592,7 +797,16 @@ class JaxEngine:
                 params, k_cache, v_cache, d_tokens, d_positions,
                 d_block_tables, d_context_lens, d_valid_steps, d_sampling,
             )
-            return p_next, p_lp, packed, last_tok, k_cache, v_cache
+            # ONE flat host transfer for all outputs: each separate
+            # device->host read costs a full round trip over a tunneled
+            # chip (~200 ms measured), which would triple the window's
+            # sync cost
+            flat = jnp.concatenate([
+                packed.reshape(-1),
+                p_next.astype(jnp.float32),
+                p_lp,
+            ])
+            return flat, last_tok, k_cache, v_cache
 
         self._multi_step_fn = (
             jax.jit(decode_window, donate_argnums=(1, 2)) if K > 1 else None
@@ -652,23 +866,34 @@ class JaxEngine:
         assert self.scheduler is not None
         from dynamo_tpu.parallel.multihost import FatalMultihostError
 
-        def pump_kvbm() -> None:
+        def pump_kvbm() -> bool:
+            """False = fatal multihost failure: the loop must fail all
+            requests and stop (a raise here would escape _step_loop and
+            leave every request stream hanging on a dead thread)."""
             if self.kvbm is None:
-                return
+                return True
             try:
                 self.kvbm.pump()
             except FatalMultihostError:
-                raise  # inside a mirrored collective: not recoverable
+                log.exception(
+                    "fatal multihost failure inside a mirrored KV op; "
+                    "taking the engine down"
+                )
+                return False
             except Exception:
                 log.exception("kv offload pump failed; disabling kvbm")
                 self._disable_kvbm()
+            return True
 
         while self._running:
             self._drain_incoming()
             if not self.scheduler.has_work:
                 # idle: drain the offload queue (and run the pump's
                 # periodic G4 index refresh) before sleeping
-                pump_kvbm()
+                if not pump_kvbm():
+                    self._fail_all()
+                    self._running = False
+                    return
                 if self.kvbm is not None and self.kvbm.pending_offloads:
                     continue  # more queued: keep draining
                 self._wake.wait(timeout=0.05)
@@ -688,7 +913,10 @@ class JaxEngine:
                 log.exception("engine step failed; failing in-flight requests")
                 self._fail_all()
                 continue
-            pump_kvbm()
+            if not pump_kvbm():
+                self._fail_all()
+                self._running = False
+                return
 
     def _disable_kvbm(self) -> None:
         """Offload tiers are an optimization: on failure, degrade to
@@ -832,16 +1060,42 @@ class JaxEngine:
                 break
         return n
 
+    _trace_enabled = bool(os.environ.get("DYN_STEP_TRACE"))
+
+    def _trace(self, event: str, **fields) -> None:
+        """Step tracing (DYN_STEP_TRACE=1): one log line per engine
+        step with kind, wall time, and batch geometry — the profiling
+        surface for serving-stall forensics (reference analogue: the
+        runtime's tracing spans, SURVEY.md §5)."""
+        if self._trace_enabled:
+            log.info(
+                "step %s %s", event,
+                " ".join(f"{k}={v}" for k, v in fields.items()),
+            )
+
     def _one_step(self) -> None:
         sched = self.scheduler
         assert sched is not None
+        t_plan = time.monotonic()
         plan = sched.plan()
         if plan.kind == "idle":
             time.sleep(0.001)
             return
+        if self._trace_enabled:
+            self._trace(
+                "plan", kind=plan.kind,
+                prefill=len(plan.prefill_batch),
+                decode=len(plan.decode_seqs),
+                waiting=len(sched.waiting),
+                plan_ms=round((time.monotonic() - t_plan) * 1e3, 1),
+            )
         if plan.kind == "mixed":
             if self._mixed_step_fn is not None:
+                t0 = time.monotonic()
                 self._mixed_window(plan)
+                self._trace(
+                    "mixed", ms=round((time.monotonic() - t0) * 1e3, 1)
+                )
                 return
             plan.kind = "prefill"  # no fused window: prefill this step
         if plan.kind == "prefill":
@@ -859,10 +1113,22 @@ class JaxEngine:
         sampling = self._batch_sampling(seqs, B)
 
         if plan.kind == "decode" and self._multi_step_fn is not None:
+            t0 = time.monotonic()
             self._decode_pipelined(seqs, arrays, sampling)
+            self._trace(
+                "window_seq",
+                ms=round((time.monotonic() - t0) * 1e3, 1),
+                b=len(seqs),
+            )
             return
 
+        t0 = time.monotonic()
         next_tokens, logprobs = self._run_device_step(arrays, sampling)
+        self._trace(
+            "dispatch_" + plan.kind,
+            shape=arrays["tokens"].shape,
+            ms=round((time.monotonic() - t0) * 1e3, 1),
+        )
 
         if plan.kind == "prefill":
             for i, work in enumerate(plan.prefill_batch):
@@ -976,9 +1242,17 @@ class JaxEngine:
         pending = self._dispatch_multi_step(arrays, sampling)
 
         def emit(window) -> None:
+            t0 = time.monotonic()
             tok_m, lp_m = self._unpack_window(np.asarray(window[0]))
+            t1 = time.monotonic()
             for i, seq in enumerate(seqs):
                 self._emit_window(seq, tok_m[i], lp_m[i])
+            self._trace(
+                "window",
+                sync_ms=round((t1 - t0) * 1e3, 1),
+                emit_ms=round((time.monotonic() - t1) * 1e3, 1),
+                b=len(seqs),
+            )
 
         while True:
             nxt = None
@@ -1075,31 +1349,33 @@ class JaxEngine:
             self._mh_broadcast.announce_mixed(
                 p_pad, sampling_p, d_arrays, sampling_d
             )
-        p_next, p_lp, packed, _last_tok, self.k_cache, self.v_cache = (
-            self._mixed_step_fn(
-                self.params,
-                self.k_cache,
-                self.v_cache,
-                p_pad["tokens"],
-                p_pad["positions"],
-                p_pad["slot_mapping"],
-                p_pad["block_tables"],
-                p_pad["context_lens"],
-                p_pad["last_token_idx"],
-                sampling_p.arrays,
-                d_arrays["tokens"],
-                d_arrays["positions"],
-                d_arrays["block_tables"],
-                d_arrays["context_lens"],
-                d_arrays["valid_steps"],
-                sampling_d.arrays,
-            )
+        flat, _last_tok, self.k_cache, self.v_cache = self._mixed_step_fn(
+            self.params,
+            self.k_cache,
+            self.v_cache,
+            p_pad["tokens"],
+            p_pad["positions"],
+            p_pad["slot_mapping"],
+            p_pad["block_tables"],
+            p_pad["context_lens"],
+            p_pad["last_token_idx"],
+            sampling_p.arrays,
+            d_arrays["tokens"],
+            d_arrays["positions"],
+            d_arrays["block_tables"],
+            d_arrays["context_lens"],
+            d_arrays["valid_steps"],
+            sampling_d.arrays,
         )
         from dynamo_tpu.parallel.multihost import host_value
 
-        p_next_h = host_value(p_next)
-        p_lp_h = host_value(p_lp)
-        tok_m, lp_m = self._unpack_window(host_value(packed))
+        flat_h = host_value(flat)  # one transfer for window + prefill
+        B = d_arrays["tokens"].shape[0]
+        K = self.scheduler.decode_lookahead
+        P = p_pad["tokens"].shape[0]
+        tok_m, lp_m = self._unpack_window(flat_h[: B * 2 * K].reshape(B, 2 * K))
+        p_next_h = flat_h[B * 2 * K : B * 2 * K + P].astype(np.int32)
+        p_lp_h = flat_h[B * 2 * K + P :]
         for i, work in enumerate(works):
             sched.complete_prefill_chunk(work)
             if work.is_last_chunk:
@@ -1262,6 +1538,13 @@ class JaxEngine:
     async def shutdown(self) -> None:
         self._running = False
         self._wake.set()
+        from dynamo_tpu.models.llama import (
+            get_attention_mesh,
+            set_attention_mesh,
+        )
+
+        if get_attention_mesh() is self.mesh:
+            set_attention_mesh(None)  # don't leak into later engines
         if self._thread is not None:
             await asyncio.get_running_loop().run_in_executor(
                 None, functools.partial(self._thread.join, timeout=10)

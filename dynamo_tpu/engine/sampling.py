@@ -248,41 +248,61 @@ def sample(
         s["temperature"], s["top_k"], s["top_p"], s["min_p"], s["seeds"]
     )
     greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # rows that need top-k/top-p/min-p shaping (vs free sampling)
+    need_filter = (top_k > 0) | (top_p < 1.0) | (min_p > 0.0)
 
     def sampled_path(_) -> jax.Array:
-        # top-k / top-p / min-p filtering on sorted logits
+        # EXACT free sampling via the gumbel-max trick — NO vocab sort.
+        # A full [B, V] argsort per step was ~60% of a fused decode
+        # step at V=128k (measured 2.5 s vs 0.95 s windows on v5e) and
+        # the OpenAI default (temperature=1, no filters) hits it on
+        # every HTTP request.
         temp = jnp.maximum(temperature, 1e-4)[:, None]
         scaled = logits / temp
-        sort_idx = jnp.argsort(-scaled, axis=-1)  # descending
-        sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
-        ranks = jnp.arange(V, dtype=jnp.int32)[None, :]
-        # top-k mask (0 = disabled)
-        k = jnp.where(top_k > 0, top_k, V)[:, None]
-        k_mask = ranks < k
-        # top-p mask on the sorted distribution (always keep rank 0)
-        sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cumprobs = jnp.cumsum(sorted_probs, axis=-1)
-        p_mask = (cumprobs - sorted_probs) < top_p[:, None]
-        # min-p: drop tokens whose prob < min_p × max prob (rank 0 is
-        # the max after the descending sort, so it always survives)
-        m_mask = sorted_probs >= (min_p[:, None] * sorted_probs[:, :1])
-        keep = k_mask & p_mask & m_mask
-        filtered = jnp.where(keep, sorted_logits, NEG_INF)
-        # per-slot independent RNG streams
         keys = jax.vmap(jax.random.key)(seeds)
         gumbel = jax.vmap(
             lambda key, shape=(V,): jax.random.gumbel(key, shape, jnp.float32)
         )(keys)
-        choice_sorted = jnp.argmax(filtered + gumbel, axis=-1)
-        sampled_tok = jnp.take_along_axis(
-            sort_idx, choice_sorted[:, None], axis=-1
-        )[:, 0].astype(jnp.int32)
+        free_tok = jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
+
+        def filtered(_) -> jax.Array:
+            # top-k / top-p / min-p shaping on the top-KF slice only.
+            # Probabilities are normalized against the FULL vocab
+            # (logsumexp over scaled — no sort needed), so the top_p
+            # cutoff is exact whenever it falls inside the slice; the
+            # only approximation is truncating ultra-flat tails (or
+            # top_k > KF) to the KF most likely tokens.
+            KF = min(128, V)
+            vals, idx = jax.lax.top_k(scaled, KF)  # [B, KF] descending
+            ranks = jnp.arange(KF, dtype=jnp.int32)[None, :]
+            k = jnp.where(top_k > 0, top_k, V)[:, None]
+            k_mask = ranks < k
+            lse = jax.nn.logsumexp(scaled, axis=-1, keepdims=True)
+            sprobs = jnp.exp(vals - lse)  # true full-vocab probabilities
+            cum = jnp.cumsum(sprobs, axis=-1)
+            p_mask = (cum - sprobs) < top_p[:, None]
+            m_mask = sprobs >= (min_p[:, None] * sprobs[:, :1])
+            keep = k_mask & p_mask & m_mask
+            fvals = jnp.where(keep, vals, NEG_INF)
+            g = jnp.take_along_axis(gumbel, idx, axis=-1)
+            choice = jnp.argmax(fvals + g, axis=-1)
+            return jnp.take_along_axis(idx, choice[:, None], axis=-1)[
+                :, 0
+            ].astype(jnp.int32)
+
+        # the top-k machinery only runs when some row filters
+        sampled_tok = jax.lax.cond(
+            jnp.any(need_filter & (temperature > 0.0)),
+            filtered,
+            lambda _: free_tok,
+            None,
+        )
+        sampled_tok = jnp.where(need_filter, sampled_tok, free_tok)
         is_greedy = temperature <= 0.0
         return jnp.where(is_greedy, greedy_tok, sampled_tok)
 
-    # the sort/gumbel machinery is ~30% of a fused decode step: skip it
-    # entirely when the whole batch decodes greedily (runtime-dependent
-    # branch — both sides are compiled, only one executes)
+    # skip sampling entirely when the whole batch decodes greedily
+    # (runtime-dependent branch — both sides compiled, one executes)
     next_tok = jax.lax.cond(
         jnp.all(temperature <= 0.0), lambda _: greedy_tok, sampled_path, None
     )

@@ -148,6 +148,19 @@ class Scheduler:
         # rectangle (0 rows = mixed planning off)
         self.mixed_prefill_rows = 0
         self.mixed_prefill_len = 256
+        # static serving shapes (engine sets these): every jit variant
+        # costs a multi-minute AOT compile on a tunneled chip, and
+        # composition-dependent buckets compile MID-SERVE. Padding the
+        # decode batch to one fixed size and the block-table width to
+        # the max_model_len cap makes the decode/mixed dispatch ONE
+        # compiled shape — padded rows are ctx=0 no-ops the Pallas
+        # kernel skips, and decode is weight-read-bound so the extra
+        # rows are ~free. Coarse prefill buckets bound that path's
+        # variant count too.
+        self.decode_batch_pad: Optional[int] = None
+        self.table_width_pad: Optional[int] = None
+        self.prefill_batch_buckets: list[int] = list(self.BATCH_BUCKETS)
+        self.prefill_chunk_buckets: list[int] = list(self.CHUNK_BUCKETS)
         self._arrival = 0
         # invoked on every finish (incl. cancellations reaped inside plan())
         self.on_finish: Optional[Callable[[Sequence, FinishReason], None]] = None
@@ -316,12 +329,12 @@ class Scheduler:
             # plus many short ones must not inflate into a huge step
             new_max = max(max_chunk, chunk)
             area = (
-                next_bucket(len(works) + 1, self.BATCH_BUCKETS)
-                * next_bucket(new_max, self.CHUNK_BUCKETS)
+                next_bucket(len(works) + 1, self.prefill_batch_buckets)
+                * next_bucket(new_max, self.prefill_chunk_buckets)
             )
             cur_area = (
-                next_bucket(len(works), self.BATCH_BUCKETS)
-                * next_bucket(max_chunk, self.CHUNK_BUCKETS)
+                next_bucket(len(works), self.prefill_batch_buckets)
+                * next_bucket(max_chunk, self.prefill_chunk_buckets)
                 if works
                 else 0
             )
@@ -449,11 +462,9 @@ class Scheduler:
 
         bs = self.block_size
         n = len(seqs)
-        B = next_bucket(n, self.BATCH_BUCKETS)
+        B = self._decode_batch(n)
         max_blocks = max(len(s.block_table) for s in seqs)
-        width = max(
-            self.TABLE_BUCKET, -(-max_blocks // self.TABLE_BUCKET) * self.TABLE_BUCKET
-        )
+        width = self._table_width(max_blocks)
         tokens = np.zeros((B, 1), np.int32)  # device carry overrides
         positions = np.zeros((B, 1), np.int32)
         tables = np.zeros((B, width), np.int32)
@@ -538,6 +549,24 @@ class Scheduler:
     CHUNK_BUCKETS = [16, 32, 64, 128, 256, 512, 1024, 2048, 4096]
     TABLE_BUCKET = 8  # block-table width rounded to multiples of this
 
+    def _table_width(self, max_blocks: int) -> int:
+        """Block-table width for a step: the fixed serving cap when set
+        (one compiled shape), bucketed otherwise — growing past the cap
+        degrades to a wider bucket rather than corrupting tables."""
+        w = max(
+            self.TABLE_BUCKET,
+            -(-max_blocks // self.TABLE_BUCKET) * self.TABLE_BUCKET,
+        )
+        if self.table_width_pad is not None and w <= self.table_width_pad:
+            return self.table_width_pad
+        return w
+
+    def _decode_batch(self, n: int) -> int:
+        b = next_bucket(n, self.BATCH_BUCKETS)
+        if self.decode_batch_pad is not None and b <= self.decode_batch_pad:
+            return self.decode_batch_pad
+        return b
+
     def build_prefill_batch_arrays(
         self, works: list[PrefillWork]
     ) -> dict[str, np.ndarray]:
@@ -546,13 +575,12 @@ class Scheduler:
         bucket; pads write to the garbage slot 0 like decode pads)."""
         bs = self.block_size
         n = len(works)
-        B = next_bucket(n, self.BATCH_BUCKETS)
-        T = next_bucket(max(len(w.tokens) for w in works), self.CHUNK_BUCKETS)
-        max_blocks = max(len(w.seq.block_table) for w in works)
-        width = max(
-            self.TABLE_BUCKET,
-            -(-max_blocks // self.TABLE_BUCKET) * self.TABLE_BUCKET,
+        B = next_bucket(n, self.prefill_batch_buckets)
+        T = next_bucket(
+            max(len(w.tokens) for w in works), self.prefill_chunk_buckets
         )
+        max_blocks = max(len(w.seq.block_table) for w in works)
+        width = self._table_width(max_blocks)
         tokens = np.zeros((B, T), np.int32)
         positions = np.zeros((B, T), np.int32)
         slot_mapping = np.zeros((B * T,), np.int32)
@@ -623,11 +651,9 @@ class Scheduler:
     def build_decode_arrays(self, seqs: list[Sequence]) -> dict[str, np.ndarray]:
         bs = self.block_size
         n = len(seqs)
-        B = next_bucket(n, self.BATCH_BUCKETS)
+        B = self._decode_batch(n)
         max_blocks = max(len(s.block_table) for s in seqs)
-        width = max(
-            self.TABLE_BUCKET, -(-max_blocks // self.TABLE_BUCKET) * self.TABLE_BUCKET
-        )
+        width = self._table_width(max_blocks)
         tokens = np.zeros((B, 1), np.int32)
         positions = np.zeros((B, 1), np.int32)
         slot_mapping = np.zeros((B,), np.int32)

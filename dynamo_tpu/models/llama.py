@@ -290,38 +290,58 @@ def paged_attention_reference(
     ).reshape(B, S)
     keys = k_cache_l[slot_ids]  # [B, S, Hk, Dh]
     vals = v_cache_l[slot_ids]
-    # GQA: expand kv heads to q heads
+    # GQA via grouped einsum — no [B, S, H, Dh] materialization of
+    # group-expanded keys/values (the repeat would multiply attention's
+    # HBM traffic by H/Hk)
     group = H // Hk
-    keys = jnp.repeat(keys, group, axis=2)  # [B, S, H, Dh]
-    vals = jnp.repeat(vals, group, axis=2)
+    qg = q.reshape(B, T, Hk, group, Dh)
     scale = 1.0 / math.sqrt(Dh)
     scores = jnp.einsum(
-        "bthd,bshd->bhts", q, keys, preferred_element_type=jnp.float32
-    ) * scale  # [B, H, T, S]
-    key_pos = jnp.arange(S, dtype=jnp.int32)[None, None, None, :]
-    mask = (key_pos <= positions[:, None, :, None]) & (
-        key_pos < context_lens[:, None, None, None]
+        "btkgd,bskd->bkgts", qg, keys, preferred_element_type=jnp.float32
+    ) * scale  # [B, Hk, G, T, S]
+    key_pos = jnp.arange(S, dtype=jnp.int32)[None, None, None, None, :]
+    pos_q = positions[:, None, None, :, None]
+    mask = (key_pos <= pos_q) & (
+        key_pos < context_lens[:, None, None, None, None]
     )
     if sliding_window is not None:
-        mask &= key_pos > positions[:, None, :, None] - sliding_window
+        mask = mask & (key_pos > pos_q - sliding_window)
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhts,bshd->bthd", probs, vals)  # [B, T, H, Dh]
-    return out
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, vals)
+    return out.reshape(B, T, H, Dh)
+
+
+# Mesh for multi-device Pallas attention: attention is local per
+# KV-head shard, so the decode kernel runs under shard_map over "tp"
+# (one kernel instance per shard, no collectives). Set by the engine
+# BEFORE tracing its step functions (module state is captured at trace
+# time); pp engines leave it unset — inside the pp stage rotation "tp"
+# is a GSPMD auto axis that a manual shard_map can't claim.
+_ATTN_MESH: Optional[Mesh] = None
+
+
+def set_attention_mesh(mesh: Optional[Mesh]) -> None:
+    global _ATTN_MESH
+    _ATTN_MESH = mesh
+
+
+def get_attention_mesh() -> Optional[Mesh]:
+    return _ATTN_MESH
 
 
 def attn_impl() -> str:
     """Attention implementation: DYN_ATTN_IMPL = auto|reference|pallas.
 
-    auto = the Pallas decode kernel on TPU, XLA gather path elsewhere
+    auto = the Pallas decode kernel on TPU (single device, or any tp
+    mesh registered via set_attention_mesh), XLA gather path elsewhere
     (Pallas runs interpreted off-TPU: correct but slow — tests only).
-    Multi-device meshes stay on the gather path until the kernel is
-    shard_map-wrapped over the "tp" axis (attention is local per KV-head
-    shard, so that wrap is mechanical).
     """
     impl = os.environ.get("DYN_ATTN_IMPL", "auto")
     if impl == "auto":
-        if jax.default_backend() == "tpu" and jax.device_count() == 1:
+        if jax.default_backend() == "tpu" and (
+            jax.device_count() == 1 or _ATTN_MESH is not None
+        ):
             return "pallas"
         return "reference"
     return impl
@@ -364,12 +384,41 @@ def make_layer_parts(
 
     def attend_mlp(lp, x, q, k_cache_l, v_cache_l):
         B, T = x.shape[0], x.shape[1]
-        if T == 1 and cfg.sliding_window is None and attn_impl() == "pallas":
+        if T == 1 and attn_impl() == "pallas" and (
+            jax.device_count() == 1 or _ATTN_MESH is not None
+        ):
+            import functools as _ft
+
             from dynamo_tpu.ops.paged_attention import paged_attention_decode
 
-            attn = paged_attention_decode(
-                q[:, 0], k_cache_l, v_cache_l, block_tables, context_lens,
-                block_size, interpret=jax.default_backend() != "tpu",
+            kern = _ft.partial(
+                paged_attention_decode,
+                block_size=block_size,
+                sliding_window=cfg.sliding_window,
+                interpret=jax.default_backend() != "tpu",
+            )
+            mesh = _ATTN_MESH
+            if mesh is not None and mesh.size > 1:
+                # one kernel per tp shard: q heads and the cache's
+                # KV-head axis are both tp-sharded; tables/ctx ride
+                # replicated. Other mesh axes (dp/ep/sp) are unmapped
+                # (replicated through the kernel).
+                kern = jax.shard_map(
+                    kern,
+                    mesh=mesh,
+                    in_specs=(
+                        P(None, "tp", None),
+                        P(None, "tp", None),
+                        P(None, "tp", None),
+                        P(None, None),
+                        P(None),
+                    ),
+                    out_specs=P(None, "tp", None),
+                    axis_names={"tp"},
+                    check_vma=False,
+                )
+            attn = kern(
+                q[:, 0], k_cache_l, v_cache_l, block_tables, context_lens
             )[:, None]  # [B, 1, H, Dh]
         else:
             attn = paged_attention_reference(
@@ -459,47 +508,41 @@ def forward(
 
     layer_params = {k: params[k] for k in layer_param_names(params)}
 
-    if tokens.shape[1] == 1:
-        # DECODE: the KV cache rides the scan CARRY with the new k/v
-        # scattered DIRECTLY into the full stack at [layer, slots] — NOT
-        # the xs/ys stream. Scanned-over caches make XLA re-stack the
-        # ENTIRE cache every step (a read+write of all cache bytes per
-        # token); a carried cache aliases in place, and the direct
-        # scatter touches only the written rows (a slice-copy+DUS
-        # variant still moved one full layer slice per layer). Measured
-        # on v5e (8B int8, fused K=32): 24.6 xs/ys -> 20.7 slice-DUS ->
-        # 19.3 direct-scatter ms/step; engine 882 -> 1022 -> 1090
-        # tok/s. Prefill keeps the xs/ys layout — the restack amortizes
-        # over the whole chunk there and measured faster end-to-end
-        # (T is static under jit: one layout per trace).
-        Hk, Dh = cfg.num_key_value_heads, cfg.head_dim
-        qkv, attend_mlp = make_layer_parts(
-            cfg, positions, block_tables, context_lens, block_size
-        )
-        B = tokens.shape[0]
+    # The KV cache rides the scan CARRY with the new k/v scattered
+    # DIRECTLY into the full stack at [layer, slots] — NOT the xs/ys
+    # stream. Scanned-over caches make XLA materialize a re-stacked
+    # copy of the ENTIRE cache (an HLO temp of cache size — with an
+    # auto-sized multi-GB cache that alone OOMs the chip, and it costs
+    # a read+write of all cache bytes per step); a carried cache
+    # aliases in place, and the direct scatter touches only the
+    # written rows (a slice-copy+DUS variant still moved one full
+    # layer slice per layer). Measured on v5e (8B int8, fused K=32):
+    # 24.6 xs/ys -> 20.7 slice-DUS -> 19.3 direct-scatter ms/step;
+    # engine 882 -> 1022 -> 1090 tok/s. Prefill (T>1) uses the same
+    # formulation: its chunk amortizes the scatter and the peak-memory
+    # profile stays flat (pipeline-parallel stages keep the xs/ys
+    # layout over their L/pp slice — parallel/pipeline.py).
+    Hk, Dh = cfg.num_key_value_heads, cfg.head_dim
+    qkv, attend_mlp = make_layer_parts(
+        cfg, positions, block_tables, context_lens, block_size
+    )
+    B, T = tokens.shape
 
-        def body(carry, inp):
-            x, kc, vc = carry
-            lp, i = inp
-            q, k, v = qkv(lp, x)
-            kc = kc.at[i, slot_mapping].set(k.reshape(B, Hk, Dh))
-            vc = vc.at[i, slot_mapping].set(v.reshape(B, Hk, Dh))
-            kcl = jax.lax.dynamic_index_in_dim(kc, i, 0, keepdims=False)
-            vcl = jax.lax.dynamic_index_in_dim(vc, i, 0, keepdims=False)
-            x = attend_mlp(lp, x, q, kcl, vcl)
-            return (x, kc, vc), None
+    def body(carry, inp):
+        x, kc, vc = carry
+        lp, i = inp
+        q, k, v = qkv(lp, x)
+        kc = kc.at[i, slot_mapping].set(k.reshape(B * T, Hk, Dh))
+        vc = vc.at[i, slot_mapping].set(v.reshape(B * T, Hk, Dh))
+        kcl = jax.lax.dynamic_index_in_dim(kc, i, 0, keepdims=False)
+        vcl = jax.lax.dynamic_index_in_dim(vc, i, 0, keepdims=False)
+        x = attend_mlp(lp, x, q, kcl, vcl)
+        return (x, kc, vc), None
 
-        (x, new_k, new_v), _ = jax.lax.scan(
-            body, (x, k_cache, v_cache),
-            (layer_params, jnp.arange(cfg.num_hidden_layers)),
-        )
-    else:
-        layer_fn = make_layer_fn(
-            cfg, positions, slot_mapping, block_tables, context_lens, block_size
-        )
-        x, (new_k, new_v) = jax.lax.scan(
-            layer_fn, x, (layer_params, k_cache, v_cache)
-        )
+    (x, new_k, new_v), _ = jax.lax.scan(
+        body, (x, k_cache, v_cache),
+        (layer_params, jnp.arange(cfg.num_hidden_layers)),
+    )
 
     x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
     # logits only at each sequence's last real token

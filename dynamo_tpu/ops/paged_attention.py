@@ -4,7 +4,8 @@ The hot op of the decode loop (TPU replacement for the CUDA/Triton paged
 attention the reference delegates to vLLM; ≈ the role of the patch's
 Triton kernels, container/deps/vllm/...-patch kv_rearrange + vLLM's
 paged_attention_v1). Semantics match
-``models.llama.paged_attention_reference`` for T=1 queries.
+``models.llama.paged_attention_reference`` for T=1 queries, including
+``sliding_window`` (Mistral-family).
 
 Design (see /opt/skills/guides/pallas_guide.md):
 - grid = (batch, page): pages iterate innermost, so the flash-attention
@@ -20,18 +21,26 @@ Design (see /opt/skills/guides/pallas_guide.md):
   the page index_map dereferences the block table *before* the body
   runs, so only the pages a sequence actually references are pulled
   into VMEM — no [B, S, H, Dh] gather materialization.
-- pages past a sequence's context length are masked out AND their
-  compute is skipped via ``pl.when``.
+- grid steps outside a sequence's live page range are CLAMPED onto the
+  nearest live page in the index map: Pallas skips the copy when the
+  block index repeats between steps, so table-width padding and
+  out-of-window pages cost no HBM traffic (their compute is also
+  skipped via ``pl.when``).
 
-HBM traffic per decode step ≈ ctx_len × Hkv × Dh × 2 per sequence —
+HBM traffic per decode step ≈ window × Hkv × Dh × 2 per sequence —
 the roofline minimum — vs the reference path's group-expanded
 materialization.
+
+TP: attention is local per KV-head shard, so multi-device meshes wrap
+this kernel in ``shard_map`` over the "tp" axis (models/llama.py
+attend_mlp) — one kernel instance per shard, no collectives.
 """
 
 from __future__ import annotations
 
 import functools
 import math
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -52,6 +61,7 @@ def _decode_kernel(
     *,
     block_size: int,
     scale: float,
+    window: Optional[int],
 ):
     b = pl.program_id(0)
     j = pl.program_id(1)
@@ -63,8 +73,11 @@ def _decode_kernel(
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     ctx = ctx_ref[b]
+    # first key position a decode query (at position ctx-1) may attend to
+    lo = jnp.int32(0) if window is None else jnp.maximum(ctx - window, 0)
+    page_live = (j * block_size < ctx) & ((j + 1) * block_size > lo)
 
-    @pl.when(j * block_size < ctx)
+    @pl.when(page_live)
     def _page():
         H, Dh = q_ref.shape[1], q_ref.shape[2]
         bs, Hk = k_ref.shape[1], k_ref.shape[2]
@@ -89,7 +102,7 @@ def _decode_kernel(
         pos = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_size), 1
         )
-        valid = pos < ctx  # [1, bs]; decode query attends to all < ctx
+        valid = (pos < ctx) & (pos >= lo)  # [1, bs]
         s = jnp.where(valid, s, -1e30)
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -119,7 +132,9 @@ def _decode_kernel(
         )
 
 
-@functools.partial(jax.jit, static_argnames=("block_size", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("block_size", "sliding_window", "interpret")
+)
 def paged_attention_decode(
     q: jax.Array,  # [B, H, Dh] (decode: one query token per sequence)
     k_cache_l: jax.Array,  # [n_slots, Hkv, Dh] (one layer)
@@ -127,6 +142,7 @@ def paged_attention_decode(
     block_tables: jax.Array,  # [B, W] int32
     context_lens: jax.Array,  # [B] int32
     block_size: int,
+    sliding_window: Optional[int] = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Returns [B, H, Dh] attention outputs."""
@@ -139,19 +155,24 @@ def paged_attention_decode(
     kp = k_cache_l.reshape(N, block_size, Hk, Dh)
     vp = v_cache_l.reshape(N, block_size, Hk, Dh)
 
+    def kv_index(b, j, t, c):
+        # clamp dead grid steps (past the last live page, or before a
+        # sliding window's first) onto the nearest live page: a repeated
+        # block index skips the HBM copy entirely
+        last = jnp.maximum((c[b] - 1) // block_size, 0)
+        jj = jnp.minimum(j, last)
+        if sliding_window is not None:
+            first = jnp.clip((c[b] - sliding_window) // block_size, 0, last)
+            jj = jnp.maximum(jj, first)
+        return (t[b, jj], 0, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # block_tables, context_lens
         grid=(B, W),
         in_specs=[
             pl.BlockSpec((1, H, Dh), lambda b, j, t, c: (b, 0, 0)),
-            pl.BlockSpec(
-                (1, block_size, Hk, Dh),
-                lambda b, j, t, c: (t[b, j], 0, 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, block_size, Hk, Dh),
-                lambda b, j, t, c: (t[b, j], 0, 0, 0),
-            ),
+            pl.BlockSpec((1, block_size, Hk, Dh), kv_index),
+            pl.BlockSpec((1, block_size, Hk, Dh), kv_index),
         ],
         out_specs=pl.BlockSpec((1, H, Dh), lambda b, j, t, c: (b, 0, 0)),
         scratch_shapes=[
@@ -161,7 +182,10 @@ def paged_attention_decode(
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, block_size=block_size, scale=scale),
+        functools.partial(
+            _decode_kernel, block_size=block_size, scale=scale,
+            window=sliding_window,
+        ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, Dh), q.dtype),
         interpret=interpret,

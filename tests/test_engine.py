@@ -397,20 +397,16 @@ def test_prefill_batch_admits_free_rows_under_pinned_buckets():
     alloc = BlockAllocator(256, 4)
     sched = Scheduler(alloc, 4, max_batch_size=8, prefill_chunk_size=16,
                       max_prefill_tokens=16)
-    old = Scheduler.BATCH_BUCKETS
-    Scheduler.BATCH_BUCKETS = [8]  # bench-style pinning
-    try:
-        for i in range(4):
-            sched.add_request(_mk_seq(list(range(1, 17)), request_id=f"p{i}"))
-        plan = sched.plan()
-        # area = 8 (pinned B) * 16 (T bucket) = 128 > budget 16, but
-        # every extra row is free: all 4 must batch into one step
-        assert plan.kind == "prefill"
-        assert len(plan.prefill_batch) == 4
-        arrays = sched.build_prefill_batch_arrays(plan.prefill_batch)
-        assert arrays["tokens"].shape == (8, 16)
-    finally:
-        Scheduler.BATCH_BUCKETS = old
+    sched.prefill_batch_buckets = [8]  # bench-style pinning
+    for i in range(4):
+        sched.add_request(_mk_seq(list(range(1, 17)), request_id=f"p{i}"))
+    plan = sched.plan()
+    # area = 8 (pinned B) * 16 (T bucket) = 128 > budget 16, but
+    # every extra row is free: all 4 must batch into one step
+    assert plan.kind == "prefill"
+    assert len(plan.prefill_batch) == 4
+    arrays = sched.build_prefill_batch_arrays(plan.prefill_batch)
+    assert arrays["tokens"].shape == (8, 16)
 
 
 async def test_multi_step_with_pipeline_parallelism():

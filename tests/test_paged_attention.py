@@ -75,6 +75,97 @@ def test_decode_kernel_bf16():
     )
 
 
+@pytest.mark.parametrize("window,ctx_lens", [
+    (8, [7, 29]),     # window < block_size
+    (16, [40, 33]),   # window == block_size
+    (24, [50, 3]),    # window spans pages; one ctx inside window
+])
+def test_decode_kernel_sliding_window(window, ctx_lens):
+    Dh, bs, num_blocks = 128, 16, 16
+    B, H, Hk = 2, 4, 2
+    q, k, v, tables, ctx = _setup(B, H, Hk, Dh, num_blocks, bs, ctx_lens)
+    out = paged_attention_decode(
+        q, k, v, tables, ctx, bs, sliding_window=window, interpret=True
+    )
+    positions = jnp.maximum(ctx - 1, 0)[:, None]
+    ref = paged_attention_reference(
+        q[:, None], k, v, tables, positions, ctx, bs, sliding_window=window
+    )[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_decode_kernel_shard_map_tp():
+    """The kernel under shard_map over a tp axis (attention is local per
+    KV-head shard) matches the single-kernel result — the multi-device
+    integration models/llama.py attend_mlp uses."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    Dh, bs, num_blocks = 128, 16, 16
+    B, H, Hk = 2, 8, 4
+    q, k, v, tables, ctx = _setup(B, H, Hk, Dh, num_blocks, bs, [23, 37])
+    mesh = build_mesh(MeshConfig(dp=2, tp=4), jax.devices())
+    kern = functools.partial(
+        paged_attention_decode, block_size=bs, interpret=True
+    )
+    wrapped = jax.shard_map(
+        kern,
+        mesh=mesh,
+        in_specs=(
+            P(None, "tp", None), P(None, "tp", None), P(None, "tp", None),
+            P(None, None), P(None),
+        ),
+        out_specs=P(None, "tp", None),
+        axis_names={"tp"},
+        check_vma=False,
+    )
+    out = jax.jit(wrapped)(q, k, v, tables, ctx)
+    single = kern(q, k, v, tables, ctx)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(single), rtol=2e-2, atol=2e-2
+    )
+
+
+async def test_engine_tp_with_pallas_attention(monkeypatch):
+    """Full engine on a tp=2 CPU mesh with the Pallas kernel forced
+    (interpret) must match the reference-path engine's greedy tokens —
+    the integration that unlocks fast attention on multi-chip ladders."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.models.llama import set_attention_mesh
+    from tests.test_engine import MODEL_DIR, _generate
+
+    cfg = dict(
+        model_path=MODEL_DIR, model_name="tiny", random_weights=True,
+        num_blocks=32, block_size=8, max_batch_size=4,
+        prefill_chunk_size=32, max_model_len=128,
+        tensor_parallel_size=2,
+    )
+    prompt = list(range(1, 20))
+    try:
+        monkeypatch.setenv("DYN_ATTN_IMPL", "reference")
+        eng = await JaxEngine.launch(EngineConfig(**cfg))
+        try:
+            ref_toks, _ = await _generate(eng, prompt, max_tokens=4)
+        finally:
+            await eng.shutdown()
+
+        monkeypatch.setenv("DYN_ATTN_IMPL", "pallas")
+        eng = await JaxEngine.launch(EngineConfig(**cfg))
+        try:
+            pal_toks, _ = await _generate(eng, prompt, max_tokens=4)
+        finally:
+            await eng.shutdown()
+    finally:
+        set_attention_mesh(None)
+    assert pal_toks == ref_toks
+
+
 async def test_engine_with_pallas_attention(monkeypatch):
     """Full engine decode through the kernel (interpret mode) must produce
     the same greedy tokens as the reference path."""
