@@ -68,6 +68,13 @@ class EngineConfig:
     # on for TPU backends, off elsewhere). Lazy compiles take minutes
     # over a chip tunnel and land mid-serve as 100 s+ TTFT stalls.
     prewarm: Optional[bool] = None
+    # also prewarm the penalty-sampling step variants (requests using
+    # frequency/presence/repetition penalties select a separately-
+    # compiled step carrying token-count tables). Off by default: it
+    # roughly doubles startup compiles for a feature many deployments
+    # never receive — the first penalties request then pays a one-time
+    # compile stall instead.
+    prewarm_penalties: bool = False
     # weights
     random_weights: bool = False  # bench/test mode: skip checkpoint load
     # weight-only quantization applied at load: None | "int8"

@@ -401,9 +401,19 @@ class JaxEngine:
         t0 = time.monotonic()
         width = sched.table_width_pad or sched.TABLE_BUCKET
 
-        def sampling_for(n: int) -> SamplingBatch:
+        def sampling_for(n: int, penalties: bool = False) -> SamplingBatch:
+            opts = (
+                SamplingOptions(
+                    temperature=1.0, frequency_penalty=0.1,
+                    presence_penalty=0.1, repetition_penalty=1.1,
+                )
+                if penalties
+                else SamplingOptions(use_greedy=True)
+            )
             return SamplingBatch.from_options(
-                [SamplingOptions(use_greedy=True)] * n, [0] * n
+                [opts] * n, [0] * n,
+                [{} for _ in range(n)] if penalties else None,
+                [np.zeros((0,), np.int32)] * n if penalties else None,
             )
 
         def prefill_arrays(b: int, t: int) -> dict[str, np.ndarray]:
@@ -436,25 +446,40 @@ class JaxEngine:
             self.config.prefill_chunk_size, sched.prefill_chunk_buckets
         )
         chunks = [c for c in sched.prefill_chunk_buckets if c <= max_chunk]
-        for chunk in chunks:
-            for b in sched.prefill_batch_buckets:
-                # the planner only emits multi-row rectangles whose
-                # padded area fits the prefill token budget (single-row
-                # steps may use the full chunk regardless)
-                if (
-                    b > sched.prefill_batch_buckets[0]
-                    and b * chunk > sched.max_prefill_tokens
-                ):
-                    continue
-                a, s = prefill_arrays(b, chunk), sampling_for(b)
-                out = self._step_fn(
-                    self.params, self.k_cache, self.v_cache, a["tokens"],
-                    a["positions"], a["slot_mapping"], a["block_tables"],
-                    a["context_lens"], a["last_token_idx"], s.arrays,
-                )
-                _, _, self.k_cache, self.v_cache = out
-                jax.block_until_ready(self.k_cache)
+        # two passes: the first call sees the init_cache sharding, later
+        # ones XLA's canonical output sharding — a different jit
+        # signature. Pass 2 ensures every shape is compiled against the
+        # steady-state sharding (cache hit if they're equal).
+        for _ in range(2):
+            for chunk in chunks:
+                for b in sched.prefill_batch_buckets:
+                    # the planner only emits multi-row rectangles whose
+                    # padded area fits the prefill token budget (single-
+                    # row steps may use the full chunk regardless)
+                    if (
+                        b > sched.prefill_batch_buckets[0]
+                        and b * chunk > sched.max_prefill_tokens
+                    ):
+                        continue
+                    a, s = prefill_arrays(b, chunk), sampling_for(b)
+                    out = self._step_fn(
+                        self.params, self.k_cache, self.v_cache, a["tokens"],
+                        a["positions"], a["slot_mapping"], a["block_tables"],
+                        a["context_lens"], a["last_token_idx"], s.arrays,
+                    )
+                    _, _, self.k_cache, self.v_cache = out
+                    jax.block_until_ready(self.k_cache)
         B = sched.decode_batch_pad or next_bucket(1, sched.BATCH_BUCKETS)
+        if self.config.prewarm_penalties and self._multi_step_fn is not None:
+            # opt-in: the penalty-table step variant (default: the
+            # first penalties request pays a one-time compile instead)
+            a = decode_arrays(B)
+            packed, _, self.k_cache, self.v_cache = self._multi_step_fn(
+                self.params, self.k_cache, self.v_cache, a["tokens"],
+                a["positions"], a["block_tables"], a["context_lens"],
+                a["valid_steps"], sampling_for(B, penalties=True).arrays,
+            )
+            jax.block_until_ready(packed)
         if self._multi_step_fn is None:
             # single-step decode serving shape (decode_steps == 1)
             a, s = decode_arrays(B), sampling_for(B)
@@ -464,10 +489,24 @@ class JaxEngine:
                 a["context_lens"], a["last_token_idx"], s.arrays,
             )
             jax.block_until_ready(self.k_cache)
+        last_tok = None
         if self._multi_step_fn is not None:
             a, s = decode_arrays(B), sampling_for(B)
-            packed, _, self.k_cache, self.v_cache = self._multi_step_fn(
+            packed, last_tok, self.k_cache, self.v_cache = self._multi_step_fn(
                 self.params, self.k_cache, self.v_cache, a["tokens"],
+                a["positions"], a["block_tables"], a["context_lens"],
+                a["valid_steps"], s.arrays,
+            )
+            # the pipelined path feeds the previous window's DEVICE
+            # token column — a committed device array is a different
+            # jit signature than host numpy, so warm that variant too
+            # (an unwarmed variant is a minutes-long mid-serve compile)
+            if self._chain_pure_fn is not None:
+                last_tok = self._chain_pure_fn(
+                    last_tok, np.zeros((B,), np.int32)
+                )
+            packed, last_tok, self.k_cache, self.v_cache = self._multi_step_fn(
+                self.params, self.k_cache, self.v_cache, last_tok,
                 a["positions"], a["block_tables"], a["context_lens"],
                 a["valid_steps"], s.arrays,
             )
@@ -480,15 +519,30 @@ class JaxEngine:
             p = prefill_arrays(P, T)
             d = decode_arrays(B)
             sp, sd = sampling_for(P), sampling_for(B)
-            packed, _, self.k_cache, self.v_cache = self._mixed_step_fn(
-                self.params, self.k_cache, self.v_cache,
-                p["tokens"], p["positions"], p["slot_mapping"],
-                p["block_tables"], p["context_lens"], p["last_token_idx"],
-                sp.arrays,
-                d["tokens"], d["positions"], d["block_tables"],
-                d["context_lens"], d["valid_steps"], sd.arrays,
+            flat, m_last, p_next, self.k_cache, self.v_cache = (
+                self._mixed_step_fn(
+                    self.params, self.k_cache, self.v_cache,
+                    p["tokens"], p["positions"], p["slot_mapping"],
+                    p["block_tables"], p["context_lens"],
+                    p["last_token_idx"], sp.arrays,
+                    d["tokens"], d["positions"], d["block_tables"],
+                    d["context_lens"], d["valid_steps"], sd.arrays,
+                )
             )
-            jax.block_until_ready(packed)
+            assert self._chain_fn is not None
+            chained = self._chain_fn(m_last, p_next, np.zeros((B,), np.int32))
+            # chained-token mixed variant (pipelined mixed windows)
+            flat, m_last, p_next, self.k_cache, self.v_cache = (
+                self._mixed_step_fn(
+                    self.params, self.k_cache, self.v_cache,
+                    p["tokens"], p["positions"], p["slot_mapping"],
+                    p["block_tables"], p["context_lens"],
+                    p["last_token_idx"], sp.arrays,
+                    chained, d["positions"], d["block_tables"],
+                    d["context_lens"], d["valid_steps"], sd.arrays,
+                )
+            )
+            jax.block_until_ready(flat)
         log.info("prewarm done in %.1fs", time.monotonic() - t0)
 
     def _auto_num_blocks(self, devices) -> int:
@@ -638,6 +692,31 @@ class JaxEngine:
         block_size = self.config.block_size
         assert mc is not None
 
+        # Pin every step fn's outputs to ONE canonical sharding. A jit
+        # signature includes each input's committed sharding, and the
+        # caches/token columns thread from outputs back into inputs —
+        # without pinning, the sharding lineage (init vs step-output vs
+        # mixed-output) silently forks the signature and a "prewarmed"
+        # shape recompiles at serve time (measured: a 69 s mid-serve
+        # stall for an already-warmed prefill shape).
+        from jax.sharding import NamedSharding, PartitionSpec as PSpec
+
+        if self._pp > 1:
+            from dynamo_tpu.parallel.pipeline import PP_CACHE_SPEC
+
+            cache_sp = PP_CACHE_SPEC
+        else:
+            cache_sp = CACHE_SPEC
+        ns_cache = NamedSharding(self.mesh, cache_sp)
+        ns_rep2 = NamedSharding(self.mesh, PSpec(None, None))
+        ns_rep1 = NamedSharding(self.mesh, PSpec(None))
+
+        def pin_caches(k, v):
+            return (
+                jax.lax.with_sharding_constraint(k, ns_cache),
+                jax.lax.with_sharding_constraint(v, ns_cache),
+            )
+
         if self._pp > 1:
             from dynamo_tpu.parallel.pipeline import forward_pp
 
@@ -676,6 +755,7 @@ class JaxEngine:
                 *mm_args,
             )
             next_tokens, logprobs = sample(logits, sampling)
+            new_k, new_v = pin_caches(new_k, new_v)
             return next_tokens, logprobs, new_k, new_v
 
         # donate the caches: XLA aliases them in-place. One jitted fn
@@ -757,6 +837,8 @@ class JaxEngine:
             packed = jnp.concatenate(
                 [toks.T.astype(jnp.float32), lps.T], axis=1
             )  # [B, 2K]
+            k_cache, v_cache = pin_caches(k_cache, v_cache)
+            last_tok = jax.lax.with_sharding_constraint(last_tok, ns_rep2)
             return packed, last_tok, k_cache, v_cache
 
         def mixed_step(
@@ -800,13 +882,33 @@ class JaxEngine:
             # ONE flat host transfer for all outputs: each separate
             # device->host read costs a full round trip over a tunneled
             # chip (~200 ms measured), which would triple the window's
-            # sync cost
+            # sync cost. p_next additionally returns device-resident so
+            # a pipelined next window can chain graduated prefills'
+            # first tokens without a host hop.
             flat = jnp.concatenate([
                 packed.reshape(-1),
                 p_next.astype(jnp.float32),
                 p_lp,
             ])
-            return flat, last_tok, k_cache, v_cache
+            p_next = jax.lax.with_sharding_constraint(p_next, ns_rep1)
+            return flat, last_tok, p_next, k_cache, v_cache
+
+        def chain_tokens(last_tok, p_next, src_idx):
+            """Next window's token column, gathered on device from the
+            in-flight window's outputs: rows [0, B) of the concat are
+            the decode window's last tokens, rows [B, B+P) the prefill
+            rectangle's sampled tokens (graduations)."""
+            cat = jnp.concatenate([last_tok[:, 0], p_next])
+            return jax.lax.with_sharding_constraint(
+                jnp.take(cat, src_idx)[:, None], ns_rep2
+            )
+
+        def chain_tokens_pure(last_tok, src_idx):
+            """Chain from a pure decode window (no prefill rectangle
+            outputs to graduate)."""
+            return jax.lax.with_sharding_constraint(
+                jnp.take(last_tok[:, 0], src_idx)[:, None], ns_rep2
+            )
 
         self._multi_step_fn = (
             jax.jit(decode_window, donate_argnums=(1, 2)) if K > 1 else None
@@ -814,6 +916,8 @@ class JaxEngine:
         self._mixed_step_fn = (
             jax.jit(mixed_step, donate_argnums=(1, 2)) if K > 1 else None
         )
+        self._chain_fn = jax.jit(chain_tokens) if K > 1 else None
+        self._chain_pure_fn = jax.jit(chain_tokens_pure) if K > 1 else None
 
     def _run_device_step(self, arrays: dict[str, np.ndarray], sampling: SamplingBatch):
         assert self._step_fn is not None
@@ -1092,7 +1196,7 @@ class JaxEngine:
         if plan.kind == "mixed":
             if self._mixed_step_fn is not None:
                 t0 = time.monotonic()
-                self._mixed_window(plan)
+                self._window_pipeline(plan.prefill_batch, plan.decode_seqs)
                 self._trace(
                     "mixed", ms=round((time.monotonic() - t0) * 1e3, 1)
                 )
@@ -1114,7 +1218,7 @@ class JaxEngine:
 
         if plan.kind == "decode" and self._multi_step_fn is not None:
             t0 = time.monotonic()
-            self._decode_pipelined(seqs, arrays, sampling)
+            self._window_pipeline([], seqs)
             self._trace(
                 "window_seq",
                 ms=round((time.monotonic() - t0) * 1e3, 1),
@@ -1144,18 +1248,20 @@ class JaxEngine:
                 self._emit_token(seq, int(next_tokens[i]), float(logprobs[i]))
 
     def _batch_sampling(
-        self, seqs: list, B: int, offset: int = 0
+        self, seqs: list, B: int, offset=0
     ) -> SamplingBatch:
-        """Per-slot sampling params; ``offset`` advances the per-step
-        seeds past tokens of an in-flight (not yet host-applied) window."""
+        """Per-slot sampling params; ``offset`` (int, or per-seq list)
+        advances the per-step seeds past tokens of an in-flight (not
+        yet host-applied) window."""
         opts = [s.request.sampling.normalized() for s in seqs]
         pad = B - len(seqs)
+        offs = offset if isinstance(offset, list) else [offset] * len(seqs)
         seeds = []
-        for s in seqs:
+        for s, off in zip(seqs, offs):
             base = s.request.sampling.seed
             seeds.append(
                 (base if base is not None else hash(s.request_id) & 0x7FFFFFFF)
-                + s.generated + offset
+                + s.generated + off
             )
         seeds += [0] * pad
         gen_counts = prompt_ids = None
@@ -1210,79 +1316,6 @@ class JaxEngine:
         packed, _ = self._dispatch_multi_step(arrays, sampling)
         return self._unpack_window(np.asarray(packed))
 
-    def _decode_pipelined(
-        self, seqs: list, arrays: dict[str, np.ndarray], sampling: SamplingBatch
-    ) -> None:
-        """Fused decode with the host work hidden behind the device.
-
-        While window k runs on device, the host plans window k+1 (block
-        extension, shifted positions — scheduler.plan_pipelined_window)
-        and dispatches it fed by k's device-resident last tokens, THEN
-        syncs and emits window k. Over a high-latency chip link this
-        hides the per-window round trip + python bookkeeping that
-        otherwise serializes with compute (~35-40% of decode wall time
-        measured on the tunneled v5e).
-
-        Safety: the planner never preempts and requires every sequence
-        mid-stream with budget past the in-flight window; any state
-        change observed after emitting window k (finish/cancel/stop)
-        flushes the pipeline — the in-flight window is synced, surviving
-        sequences keep its tokens, finished ones discard theirs (their
-        blocks stay allocated until that flush, so no reuse races the
-        in-flight writes). Multihost leaders don't pipeline: followers
-        need host token values per announce.
-        """
-        sched = self.scheduler
-        assert sched is not None
-        K = sched.decode_lookahead
-        # penalty batches don't pipeline: window k+1's sparse count
-        # tables are built from host state that lags the in-flight
-        # window's tokens, so its penalties would be silently stale
-        pipelining = self._mh_broadcast is None and not sampling.has_penalties
-        pending = self._dispatch_multi_step(arrays, sampling)
-
-        def emit(window) -> None:
-            t0 = time.monotonic()
-            tok_m, lp_m = self._unpack_window(np.asarray(window[0]))
-            t1 = time.monotonic()
-            for i, seq in enumerate(seqs):
-                self._emit_window(seq, tok_m[i], lp_m[i])
-            self._trace(
-                "window",
-                sync_ms=round((t1 - t0) * 1e3, 1),
-                emit_ms=round((time.monotonic() - t1) * 1e3, 1),
-                b=len(seqs),
-            )
-
-        while True:
-            nxt = None
-            # _running: a shutdown() mid-stream must flush the in-flight
-            # window and return, not keep dispatching until the batch
-            # drains (the thread join would time out and kvbm.close()
-            # would race the still-running engine thread)
-            if (
-                pipelining
-                and self._running
-                and self._incoming.empty()
-                and self._control.empty()
-            ):
-                nxt = sched.plan_pipelined_window(seqs, K)
-            if nxt is not None:
-                B = nxt["tokens"].shape[0]
-                next_sampling = self._batch_sampling(seqs, B, offset=K)
-                next_pending = self._dispatch_multi_step(
-                    nxt, next_sampling, tokens_dev=pending[1]
-                )
-            # sync + emit window k (device already busy with k+1)
-            emit(pending)
-            if nxt is None:
-                return
-            pending = next_pending
-            if any(s.state != SeqState.RUNNING for s in seqs):
-                # composition changed under the in-flight window: flush
-                emit(pending)
-                return
-
     def _pad_prefill_rect(
         self, arrays: dict[str, np.ndarray], P: int, T: int, width: int
     ) -> dict[str, np.ndarray]:
@@ -1310,70 +1343,65 @@ class JaxEngine:
         out["last_token_idx"][:B0] = arrays["last_token_idx"]
         return out
 
-    def _mixed_window(self, plan: StepPlan) -> None:
-        """One mixed dispatch: prefill rectangle + K-step decode window
-        (see mixed_step in _build_step_fn). Multimodal chunks fall back
-        to a dedicated prefill step — embedding injection doesn't ride
-        the fixed rectangle."""
-        sched = self.scheduler
-        assert sched is not None and self._mixed_step_fn is not None
-        works = plan.prefill_batch
-        seqs = plan.decode_seqs
-        p_arrays = sched.build_prefill_batch_arrays(works)
-        if "extra_embeds" in p_arrays:
-            sampling = self._batch_sampling(
-                [w.seq for w in works], p_arrays["tokens"].shape[0]
-            )
-            next_tokens, logprobs = self._run_device_step(p_arrays, sampling)
-            for i, work in enumerate(works):
-                sched.complete_prefill_chunk(work)
-                if work.is_last_chunk:
-                    self._emit_token(
-                        work.seq, int(next_tokens[i]), float(logprobs[i])
-                    )
-            return
-        d_arrays = sched.build_decode_arrays(seqs)
+    def _dispatch_mixed(
+        self,
+        works: list,
+        seqs: list,
+        p_arrays: dict[str, np.ndarray],
+        d_arrays: dict[str, np.ndarray],
+        sampling_p: SamplingBatch,
+        sampling_d: SamplingBatch,
+        tokens_dev=None,
+    ):
+        """Launch one mixed window; returns device (flat, last_tok,
+        p_next) — callers sync `flat` when they need values."""
+        assert self._mixed_step_fn is not None
         P = self.config.mixed_prefill_rows
         T = self.config.mixed_prefill_len
         width = max(
-            p_arrays["block_tables"].shape[1], d_arrays["block_tables"].shape[1]
+            p_arrays["block_tables"].shape[1],
+            d_arrays["block_tables"].shape[1],
         )
         p_pad = self._pad_prefill_rect(p_arrays, P, T, width)
         if d_arrays["block_tables"].shape[1] < width:
             dt = np.zeros((d_arrays["block_tables"].shape[0], width), np.int32)
             dt[:, : d_arrays["block_tables"].shape[1]] = d_arrays["block_tables"]
             d_arrays["block_tables"] = dt
-        sampling_p = self._batch_sampling([w.seq for w in works], P)
-        sampling_d = self._batch_sampling(seqs, d_arrays["tokens"].shape[0])
         if self._mh_broadcast is not None:
             self._mh_broadcast.announce_mixed(
                 p_pad, sampling_p, d_arrays, sampling_d
             )
-        flat, _last_tok, self.k_cache, self.v_cache = self._mixed_step_fn(
-            self.params,
-            self.k_cache,
-            self.v_cache,
-            p_pad["tokens"],
-            p_pad["positions"],
-            p_pad["slot_mapping"],
-            p_pad["block_tables"],
-            p_pad["context_lens"],
-            p_pad["last_token_idx"],
-            sampling_p.arrays,
-            d_arrays["tokens"],
-            d_arrays["positions"],
-            d_arrays["block_tables"],
-            d_arrays["context_lens"],
-            d_arrays["valid_steps"],
-            sampling_d.arrays,
+        flat, last_tok, p_next, self.k_cache, self.v_cache = (
+            self._mixed_step_fn(
+                self.params,
+                self.k_cache,
+                self.v_cache,
+                p_pad["tokens"],
+                p_pad["positions"],
+                p_pad["slot_mapping"],
+                p_pad["block_tables"],
+                p_pad["context_lens"],
+                p_pad["last_token_idx"],
+                sampling_p.arrays,
+                d_arrays["tokens"] if tokens_dev is None else tokens_dev,
+                d_arrays["positions"],
+                d_arrays["block_tables"],
+                d_arrays["context_lens"],
+                d_arrays["valid_steps"],
+                sampling_d.arrays,
+            )
         )
-        from dynamo_tpu.parallel.multihost import host_value
+        return flat, last_tok, p_next, d_arrays["tokens"].shape[0]
 
-        flat_h = host_value(flat)  # one transfer for window + prefill
-        B = d_arrays["tokens"].shape[0]
-        K = self.scheduler.decode_lookahead
-        P = p_pad["tokens"].shape[0]
-        tok_m, lp_m = self._unpack_window(flat_h[: B * 2 * K].reshape(B, 2 * K))
+    def _emit_mixed(self, works: list, seqs: list, flat_h, B: int) -> None:
+        """Sync-side bookkeeping of one mixed window's flat output."""
+        sched = self.scheduler
+        assert sched is not None
+        K = sched.decode_lookahead
+        P = self.config.mixed_prefill_rows
+        tok_m, lp_m = self._unpack_window(
+            flat_h[: B * 2 * K].reshape(B, 2 * K)
+        )
         p_next_h = flat_h[B * 2 * K : B * 2 * K + P].astype(np.int32)
         p_lp_h = flat_h[B * 2 * K + P :]
         for i, work in enumerate(works):
@@ -1382,6 +1410,162 @@ class JaxEngine:
                 self._emit_token(work.seq, int(p_next_h[i]), float(p_lp_h[i]))
         for i, seq in enumerate(seqs):
             self._emit_window(seq, tok_m[i], lp_m[i])
+
+    def _drain_incoming_only(self) -> None:
+        """Drain ONLY the submit queue (not the control queue) — used
+        inside the window pipeline, where control calls (KV export /
+        import) must NOT run against host state that lags the in-flight
+        window by up to K tokens."""
+        assert self.scheduler is not None
+        while True:
+            try:
+                item = self._incoming.get_nowait()
+            except thread_queue.Empty:
+                return
+            self.scheduler.add_request(item)
+
+    def _window_pipeline(self, works: list, seqs: list) -> None:
+        """THE serving loop: fused decode windows with optional prefill
+        rectangles, PIPELINED. While window k runs on device, the host
+        plans window k+1 — last-chunk prefills of k GRADUATE to decode
+        rows of k+1, their first token chained on device from k's
+        outputs (scheduler.plan_pipelined_mixed + chain_tokens); new
+        arrivals are admitted straight into k+1's rectangle; sequences
+        finishing INSIDE k simply aren't rows of k+1. k+1 is dispatched
+        BEFORE k is synced, so the device never idles on the host round
+        trip (~25% of a window over the chip tunnel). Any irregularity
+        (stop-token finishes, cancellations, multimodal, penalties,
+        multihost, control-plane calls, shutdown) flushes the pipeline:
+        the in-flight window is synced, surviving sequences keep its
+        tokens, finished ones discard theirs (their blocks stay
+        allocated until the flush, so no reuse races in-flight writes).
+        Multimodal prefill chunks fall back to a dedicated step —
+        embedding injection doesn't ride the fixed rectangle."""
+        sched = self.scheduler
+        assert sched is not None
+        from dynamo_tpu.parallel.multihost import host_value
+
+        K = sched.decode_lookahead
+        pipelining = self._mh_broadcast is None
+
+        def penalties_in(ws: list, ss: list) -> bool:
+            return any(
+                w.seq.request.sampling.needs_penalties for w in ws
+            ) or any(s.request.sampling.needs_penalties for s in ss)
+
+        # dispatch window k
+        if works:
+            p_arrays = sched.build_prefill_batch_arrays(works)
+            if "extra_embeds" in p_arrays:
+                sampling = self._batch_sampling(
+                    [w.seq for w in works], p_arrays["tokens"].shape[0]
+                )
+                next_tokens, logprobs = self._run_device_step(
+                    p_arrays, sampling
+                )
+                for i, work in enumerate(works):
+                    sched.complete_prefill_chunk(work)
+                    if work.is_last_chunk:
+                        self._emit_token(
+                            work.seq, int(next_tokens[i]), float(logprobs[i])
+                        )
+                return
+            d_arrays = sched.build_decode_arrays(seqs)
+            sampling_p = self._batch_sampling(
+                [w.seq for w in works], self.config.mixed_prefill_rows
+            )
+            sampling_d = self._batch_sampling(seqs, d_arrays["tokens"].shape[0])
+            pipelining = pipelining and not (
+                sampling_p.has_penalties or sampling_d.has_penalties
+            )
+            pending = ("mixed",) + self._dispatch_mixed(
+                works, seqs, p_arrays, d_arrays, sampling_p, sampling_d
+            )
+        else:
+            d_arrays = sched.build_decode_arrays(seqs)
+            sampling_d = self._batch_sampling(seqs, d_arrays["tokens"].shape[0])
+            pipelining = pipelining and not sampling_d.has_penalties
+            packed, last_tok = self._dispatch_multi_step(d_arrays, sampling_d)
+            pending = ("pure", packed, last_tok, d_arrays["tokens"].shape[0])
+
+        def emit_cur(works_, seqs_, pend) -> None:
+            t0 = time.monotonic()
+            if pend[0] == "mixed":
+                self._emit_mixed(works_, seqs_, host_value(pend[1]), pend[4])
+            else:
+                tok_m, lp_m = self._unpack_window(host_value(pend[1]))
+                for i, seq in enumerate(seqs_):
+                    self._emit_window(seq, tok_m[i], lp_m[i])
+            self._trace(
+                "window", kind=pend[0], b=len(seqs_), p=len(works_),
+                wait=len(sched.waiting), pref=len(sched.prefilling),
+                run=len(sched.running),
+                ms=round((time.monotonic() - t0) * 1e3, 1),
+            )
+
+        while True:
+            nxt = None
+            # _running: a shutdown() mid-stream must flush the in-flight
+            # window and return, not keep dispatching until the batch
+            # drains (the thread join would time out and kvbm.close()
+            # would race the still-running engine thread)
+            if pipelining and self._running and self._control.empty():
+                # arrivals don't break the pipeline: drain them (ONLY
+                # the submit queue) so plan_pipelined_mixed can admit
+                # them straight into the next window's rectangle
+                self._drain_incoming_only()
+                nxt = sched.plan_pipelined_mixed(seqs, works, K)
+            next_pending = None
+            if nxt is not None and not penalties_in(nxt["works2"], nxt["seqs"]):
+                p2 = None
+                if nxt["works2"]:
+                    p2 = sched.build_prefill_batch_arrays(nxt["works2"])
+                if p2 is not None and "extra_embeds" in p2:
+                    nxt = None  # multimodal never rides the pipeline
+                else:
+                    if pending[0] == "mixed":
+                        chained = self._chain_fn(
+                            pending[2], pending[3], nxt["src_idx"]
+                        )
+                    else:
+                        chained = self._chain_pure_fn(
+                            pending[2], nxt["src_idx"]
+                        )
+                    s_d2 = self._batch_sampling(
+                        nxt["seqs"],
+                        nxt["arrays"]["tokens"].shape[0],
+                        offset=nxt["offsets"],
+                    )
+                    if p2 is not None:
+                        s_p2 = self._batch_sampling(
+                            [w.seq for w in nxt["works2"]],
+                            self.config.mixed_prefill_rows,
+                        )
+                        next_pending = ("mixed",) + self._dispatch_mixed(
+                            nxt["works2"], nxt["seqs"], p2, nxt["arrays"],
+                            s_p2, s_d2, tokens_dev=chained,
+                        )
+                    else:
+                        # pure decode window, chained — no rectangle
+                        packed, last_tok = self._dispatch_multi_step(
+                            nxt["arrays"], s_d2, tokens_dev=chained
+                        )
+                        next_pending = (
+                            "pure", packed, last_tok,
+                            nxt["arrays"]["tokens"].shape[0],
+                        )
+            # sync + emit window k (device already busy with k+1)
+            emit_cur(works, seqs, pending)
+            if next_pending is None:
+                return
+            works, seqs = nxt["works2"], nxt["seqs"]
+            pending = next_pending
+            if any(s.state != SeqState.RUNNING for s in seqs) or any(
+                w.seq.state != SeqState.PREFILL for w in works
+            ):
+                # composition changed under the in-flight window: flush
+                emit_cur(works, seqs, pending)
+                return
 
     def _emit_token(self, seq: Sequence, token: int, logprob: float) -> None:
         sched = self.scheduler

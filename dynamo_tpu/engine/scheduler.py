@@ -408,27 +408,35 @@ class Scheduler:
                 safe.append(seq)
         return safe
 
-    def plan_pipelined_window(
-        self, seqs: list[Sequence], offset: int
+    def plan_pipelined_mixed(
+        self, seqs: list[Sequence], works: list[PrefillWork], offset: int
     ) -> Optional[dict]:
-        """Arrays for the NEXT fused decode window while the current one
-        is still in flight. ``offset`` tokens per sequence (the in-flight
-        window) are not yet reflected in host state, so positions/
-        context/budget all shift by it. Returns None when pipelining is
-        ineligible — pending admissions or prefills, a sequence that is
-        not plainly mid-stream with budget beyond the in-flight window,
-        or block exhaustion (this path NEVER preempts: a preemption
-        would recompute state the in-flight window is about to change).
+        """Plan the NEXT window while a MIXED window is in flight.
 
-        The tokens row is a placeholder: the engine feeds the device-
-        resident last-token column of the in-flight window's output, so
-        the dispatch never waits on a host round trip.
+        The in-flight window is decoding ``offset`` tokens for ``seqs``
+        AND prefilling ``works``; last-chunk works GRADUATE to decode
+        rows of the next window (their first sampled token is
+        device-resident in the in-flight window's outputs — the engine
+        chains it via an on-device gather, indexed by ``src_idx``:
+        row j of the old decode batch -> j, graduated work r ->
+        B_pad + r). Returns None (flush the pipeline) whenever anything
+        irregular appears: a non-final chunk, cancellations, budget
+        inside the in-flight window, batch overflow, or block
+        exhaustion (never preempts here).
+
+        Returns {"seqs", "works2", "arrays", "src_idx", "offsets"}:
+        the next window's decode seqs (old + graduated), its prefill
+        works, the decode arrays (tokens are placeholders), the token-
+        source gather index, and per-row seed offsets.
         """
-        import numpy as np
-
-        if self.prefilling or self.waiting:
-            return None
-        K = self.decode_lookahead
+        if self.waiting:
+            self._admit()
+        for w in works:
+            if not w.is_last_chunk:
+                return None
+            if w.seq.is_cancelled and w.seq.is_cancelled():
+                return None
+        survivors: list[Sequence] = []
         for seq in seqs:
             if seq.state != SeqState.RUNNING:
                 return None
@@ -438,13 +446,33 @@ class Scheduler:
                 seq.max_new_tokens is not None
                 and seq.max_new_tokens - seq.generated <= offset
             ):
-                return None
+                # finishes INSIDE the in-flight window: simply not a
+                # row of the next one (its blocks are freed at sync,
+                # which the next window never touches) — refusing to
+                # pipeline here would block the chain whenever ANY
+                # sequence nears its budget, i.e. almost always
+                continue
+            survivors.append(seq)
+        graduated = [w.seq for w in works]
+        grad_row = {id(w.seq): r for r, w in enumerate(works)}
+        old_row = {id(s): j for j, s in enumerate(seqs)}
+        next_seqs = survivors + graduated
+        if not next_seqs or len(next_seqs) > self.max_batch_size:
+            return None
+        K = self.decode_lookahead
+        # block allocation for the whole next window (no preemption on
+        # this path; rollback on exhaustion)
         added: list[Sequence] = []
         ok = True
-        for seq in seqs:
-            needed = seq.blocks_needed(
-                seq.total_len + offset + K, self.block_size
-            )
+        for seq in next_seqs:
+            if id(seq) in grad_row:
+                # after the in-flight window: prompt + 1 sampled token,
+                # then K more in the next window
+                needed = seq.blocks_needed(seq.total_len + 1 + K, self.block_size)
+            else:
+                needed = seq.blocks_needed(
+                    seq.total_len + offset + K, self.block_size
+                )
             while len(seq.block_table) < needed:
                 try:
                     seq.block_table.append(self.allocator.allocate_block())
@@ -455,35 +483,69 @@ class Scheduler:
             if not ok:
                 break
         if not ok:
-            # rollback: the freshly-added (uncommitted) blocks go back
             for seq in reversed(added):
                 self.allocator.free_sequence([seq.block_table.pop()])
             return None
+        # next window's prefill rows: pending chunks excluding the
+        # in-flight works' seqs
+        works2: list[PrefillWork] = []
+        if self.mixed_prefill_rows > 0:
+            busy = set(id(s) for s in graduated)
+            avail = [s for s in self.prefilling if id(s) not in busy]
+            saved = self.prefilling
+            self.prefilling = deque(avail)
+            try:
+                works2 = self._plan_prefill_batch(
+                    budget=self.mixed_prefill_rows * self.mixed_prefill_len,
+                    max_seqs=self.mixed_prefill_rows,
+                    max_chunk_len=self.mixed_prefill_len,
+                )
+            finally:
+                self.prefilling = saved
 
         bs = self.block_size
-        n = len(seqs)
+        n = len(next_seqs)
         B = self._decode_batch(n)
-        max_blocks = max(len(s.block_table) for s in seqs)
+        max_blocks = max(len(s.block_table) for s in next_seqs)
         width = self._table_width(max_blocks)
-        tokens = np.zeros((B, 1), np.int32)  # device carry overrides
         positions = np.zeros((B, 1), np.int32)
         tables = np.zeros((B, width), np.int32)
         ctx = np.zeros((B,), np.int32)
         valid_steps = np.zeros((B,), np.int32)
-        for i, s in enumerate(seqs):
-            positions[i, 0] = s.total_len - 1 + offset
+        src_idx = np.zeros((B,), np.int32)
+        offsets = [0] * n
+        for i, s in enumerate(next_seqs):
+            if id(s) in grad_row:
+                pos = s.total_len  # the in-flight-sampled token's slot
+                c = s.total_len + 1
+                gen_after = 1
+                src_idx[i] = self._decode_batch(len(seqs)) + grad_row[id(s)]
+            else:
+                pos = s.total_len - 1 + offset
+                c = s.total_len + offset
+                gen_after = offset
+                src_idx[i] = old_row[id(s)]
+            positions[i, 0] = pos
             tables[i, : len(s.block_table)] = s.block_table
-            ctx[i] = s.total_len + offset
+            ctx[i] = c
             v = K
             if s.max_new_tokens is not None:
-                v = min(v, max(1, s.max_new_tokens - s.generated - offset))
+                v = min(v, max(1, s.max_new_tokens - s.generated - gen_after))
             valid_steps[i] = v
-        return {
-            "tokens": tokens,
+            offsets[i] = gen_after
+        arrays = {
+            "tokens": np.zeros((B, 1), np.int32),  # device chain overrides
             "positions": positions,
             "block_tables": tables,
             "context_lens": ctx,
             "valid_steps": valid_steps,
+        }
+        return {
+            "seqs": next_seqs,
+            "works2": works2,
+            "arrays": arrays,
+            "src_idx": src_idx,
+            "offsets": offsets,
         }
 
     def _preempt(self, victim: Sequence) -> None:

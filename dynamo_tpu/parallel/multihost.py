@@ -539,7 +539,7 @@ class StepFollower:
                 p_args, d_args = args[:np_], args[np_:]
                 p_s = _sampling_dict(p_args[6:], p_flags)
                 d_s = _sampling_dict(d_args[5:], flags)
-                _, _, e.k_cache, e.v_cache = e._mixed_step_fn(
+                _, _, _, e.k_cache, e.v_cache = e._mixed_step_fn(
                     e.params, e.k_cache, e.v_cache,
                     *p_args[:6], p_s, *d_args[:5], d_s,
                 )

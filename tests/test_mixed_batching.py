@@ -150,14 +150,14 @@ async def test_mixed_engine_staggered_arrivals_match_dedicated():
         # count mixed dispatches to prove the path runs
         n_mixed = 0
         if mixed:
-            orig = engine._mixed_window
+            orig = engine._dispatch_mixed
 
-            def counting(plan):
+            def counting(*a, **kw):
                 nonlocal n_mixed
                 n_mixed += 1
-                return orig(plan)
+                return orig(*a, **kw)
 
-            engine._mixed_window = counting
+            engine._dispatch_mixed = counting
         try:
             async def staggered(i: int):
                 await asyncio.sleep(0.15 * i)
@@ -176,6 +176,39 @@ async def test_mixed_engine_staggered_arrivals_match_dedicated():
     mixed_out, n_mixed = await run(True)
     dedicated_out, _ = await run(False)
     assert n_mixed > 0, "staggered arrivals never took the mixed path"
+    assert mixed_out == dedicated_out
+
+
+async def test_pipelined_mixed_chain_matches_dedicated():
+    """Continuous staggered arrivals with long generations force CHAINS
+    of pipelined mixed windows (prefill graduation chained on device);
+    greedy outputs must still match the mixed-off engine exactly."""
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    prompts = [list(range(1, 10 + 2 * i)) for i in range(6)]
+
+    async def run(mixed: bool):
+        engine = await JaxEngine.launch(
+            _engine_config(
+                mixed_prefill_rows=2 if mixed else 0, max_batch_size=8
+            )
+        )
+        try:
+            async def staggered(i: int):
+                await asyncio.sleep(0.1 * i)
+                return await _generate(
+                    engine, prompts[i], max_tokens=24, request_id=f"pl{i}"
+                )
+
+            results = await asyncio.gather(*[staggered(i) for i in range(6)])
+            for toks, fin in results:
+                assert len(toks) == 24, fin
+            return [r[0] for r in results]
+        finally:
+            await engine.shutdown()
+
+    mixed_out = await run(True)
+    dedicated_out = await run(False)
     assert mixed_out == dedicated_out
 
 
