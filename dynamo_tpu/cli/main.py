@@ -122,6 +122,11 @@ def build_parser() -> argparse.ArgumentParser:
     store.add_argument("--native", action="store_true",
                        help="run the C++ coordinator (native/store; built "
                             "on demand, wire-identical to the python one)")
+    store.add_argument("--persist-path", default=None,
+                       help="durability: WAL + snapshot at this path — "
+                           "model registrations, queues, and the object "
+                           "plane survive a coordinator restart (leased "
+                           "liveness keys stay ephemeral, like etcd)")
 
     serve = sub.add_parser("serve", help="serve a @service graph "
                            "(≈ reference `dynamo serve`)")
@@ -778,7 +783,10 @@ def _exec_native_store(args: Any) -> None:
             host = socket.gethostbyname(args.host)
         except OSError:
             raise SystemExit(f"cannot resolve --host {args.host!r}")
-        os.execv(binary, [binary, "--host", host, "--port", str(args.port)])
+        argv = [binary, "--host", host, "--port", str(args.port)]
+        if getattr(args, "persist_path", None):
+            argv += ["--persist-path", args.persist_path]
+        os.execv(binary, argv)
     log.warning("native store binary unavailable; using the python server")
 
 
@@ -1170,9 +1178,14 @@ def main(argv: Optional[list[str]] = None) -> None:
     elif args.command == "store":
         if args.native:
             _exec_native_store(args)
+        from dynamo_tpu.store.memory import MemoryStore
         from dynamo_tpu.store.server import StoreServer
 
-        server = StoreServer(host=args.host, port=args.port)
+        server = StoreServer(
+            store=MemoryStore(persist_path=args.persist_path),
+            host=args.host,
+            port=args.port,
+        )
         try:
             asyncio.run(server.serve_forever())
         except KeyboardInterrupt:

@@ -199,12 +199,18 @@ class Scheduler:
             and self.mixed_prefill_rows > 0
             and self._prefill_backlog()
             <= 2 * self.mixed_prefill_rows * self.mixed_prefill_len
+            and (
+                len(self.prefilling) <= self.mixed_prefill_rows
+                or len(self.running) >= len(self.prefilling)
+            )
         ):
             # mixed step: prefill rides the decode window's dispatch,
             # bounded to the engine's fixed rectangle. Large backlogs
-            # (cold-start bursts, long prompts) fall through to the
-            # dedicated batched-prefill step below — trickling them
-            # through the small rectangle would multiply TTFT.
+            # (cold-start bursts, long prompts) and prefill-heavy
+            # moments (a synchronized cohort with few decoders — the
+            # rectangle would RAMP the batch 8 rows per window while
+            # decode runs near-empty) fall through to the dedicated
+            # batched-prefill step below.
             works = self._plan_prefill_batch(
                 budget=self.mixed_prefill_rows * self.mixed_prefill_len,
                 max_seqs=self.mixed_prefill_rows,
@@ -492,6 +498,16 @@ class Scheduler:
         if self.mixed_prefill_rows > 0:
             busy = set(id(s) for s in graduated)
             avail = [s for s in self.prefilling if id(s) not in busy]
+            if (
+                len(avail) > self.mixed_prefill_rows
+                and len(next_seqs) < len(avail)
+            ):
+                # prefill-heavy: break the chain so the outer plan can
+                # run a dedicated batched prefill instead of ramping
+                # the batch 8 rows per window
+                for seq in reversed(added):
+                    self.allocator.free_sequence([seq.block_table.pop()])
+                return None
             saved = self.prefilling
             self.prefilling = deque(avail)
             try:

@@ -105,7 +105,11 @@ class MemoryStore(Store):
     one event loop (the store is not thread-safe by design; cross-thread use
     goes through the TCP client)."""
 
-    def __init__(self, lease_sweep_interval_s: float = 0.5):
+    def __init__(
+        self,
+        lease_sweep_interval_s: float = 0.5,
+        persist_path: Optional[str] = None,
+    ):
         self._kv: dict[str, KvEntry] = {}
         self._version = itertools.count(1)
         self._watches: set[_MemWatch] = set()
@@ -117,6 +121,107 @@ class MemoryStore(Store):
         self._sweep_interval = lease_sweep_interval_s
         self._sweeper: Optional[asyncio.Task] = None
         self._closed = False
+        # durability (store/persist.py): WAL + snapshot replay. Without
+        # it a coordinator restart loses model registrations, deployment
+        # specs, prefill queues, and the whole G4 object tier.
+        self._wal = None
+        if persist_path:
+            from dynamo_tpu.store.persist import WriteAheadLog
+
+            self._wal = WriteAheadLog(persist_path)
+            self._restore()
+
+    # -- durability -------------------------------------------------------
+    def _restore(self) -> None:
+        from dynamo_tpu.store.persist import decode_value
+
+        snap, records = self._wal.replay()
+        max_ver = 0
+        snap_next: dict[str, int] = {}
+        if snap:
+            max_ver = int(snap.get("version", 0))
+            for e in snap.get("kv", []):
+                self._kv[e["k"]] = KvEntry(
+                    key=e["k"], value=decode_value(e["v"]),
+                    version=int(e["ver"]), lease_id=NO_LEASE,
+                )
+            for name, qs in snap.get("queues", {}).items():
+                q = self._queues[name]
+                snap_next[name] = int(qs["next_id"])
+                q.next_id = itertools.count(int(qs["next_id"]))
+                for m in qs.get("msgs", []):
+                    q.ready.append(
+                        QueueMessage(id=int(m["id"]), payload=decode_value(m["p"]))
+                    )
+            for bucket, objs in snap.get("objects", {}).items():
+                for name, data in objs.items():
+                    self._objects[bucket][name] = decode_value(data)
+        acked: dict[str, set[int]] = defaultdict(set)
+        pushes: dict[str, list[QueueMessage]] = defaultdict(list)
+        q_next: dict[str, int] = {}
+        for rec in records:
+            op = rec["op"]
+            if op == "kv_put":
+                max_ver = max(max_ver, int(rec["ver"]))
+                self._kv[rec["k"]] = KvEntry(
+                    key=rec["k"], value=decode_value(rec["v"]),
+                    version=int(rec["ver"]), lease_id=NO_LEASE,
+                )
+            elif op == "kv_del":
+                self._kv.pop(rec["k"], None)
+            elif op == "q_push":
+                # a crash between snapshot replace and log truncation
+                # leaves pre-compaction records behind: anything the
+                # snapshot already folded in (id < its next_id) must
+                # not replay, or queued work would deliver twice
+                if int(rec["id"]) < snap_next.get(rec["q"], 0):
+                    continue
+                pushes[rec["q"]].append(
+                    QueueMessage(id=int(rec["id"]), payload=decode_value(rec["p"]))
+                )
+                q_next[rec["q"]] = max(
+                    q_next.get(rec["q"], 1), int(rec["id"]) + 1
+                )
+            elif op == "q_ack":
+                acked[rec["q"]].add(int(rec["id"]))
+            elif op == "obj_put":
+                self._objects[rec["b"]][rec["n"]] = decode_value(rec["v"])
+            elif op == "obj_del":
+                self._objects.get(rec["b"], {}).pop(rec["n"], None)
+        for name, msgs in pushes.items():
+            q = self._queues[name]
+            for m in msgs:
+                if m.id not in acked[name]:
+                    q.ready.append(m)
+        for name, nid in q_next.items():
+            self._queues[name].next_id = itertools.count(nid)
+        # acks for messages restored from the SNAPSHOT
+        for name, ids in acked.items():
+            q = self._queues[name]
+            if ids:
+                q.ready = deque(m for m in q.ready if m.id not in ids)
+        self._version = itertools.count(max_ver + 1)
+
+    def _snapshot(self) -> dict:
+        from dynamo_tpu.store.persist import snapshot_from_state
+
+        queues = {
+            name: (
+                next(q.next_id),  # consumes one id: monotonicity kept
+                list(q.ready) + [m for m, _ in q.in_flight.values()],
+            )
+            for name, q in self._queues.items()
+        }
+        # itertools.count was advanced by the peek above; rebuild
+        for name, (nid, _) in queues.items():
+            self._queues[name].next_id = itertools.count(nid + 1)
+        ver = next(self._version)
+        self._version = itertools.count(ver + 1)
+        return snapshot_from_state(self._kv, queues, self._objects, ver)
+
+    def _maybe_compact(self) -> None:
+        if self._wal is not None and self._wal.needs_compaction():
+            self._wal.compact(self._snapshot())
 
     def _ensure_sweeper(self) -> None:
         if self._sweeper is None or self._sweeper.done():
@@ -163,6 +268,14 @@ class MemoryStore(Store):
         version = next(self._version)
         entry = KvEntry(key=key, value=value, version=version, lease_id=lease_id)
         self._kv[key] = entry
+        if self._wal is not None and lease_id == NO_LEASE:
+            # leased keys are liveness registrations: ephemeral by design
+            from dynamo_tpu.store.persist import encode_value
+
+            self._wal.append(
+                "kv_put", k=key, v=encode_value(value), ver=version
+            )
+            self._maybe_compact()
         self._emit(WatchEvent("put", entry))
         return version
 
@@ -187,6 +300,8 @@ class MemoryStore(Store):
             return False
         if entry.lease_id != NO_LEASE and entry.lease_id in self._leases:
             self._leases[entry.lease_id].keys.discard(key)
+        if self._wal is not None and entry.lease_id == NO_LEASE:
+            self._wal.append("kv_del", k=key)
         self._emit(WatchEvent("delete", entry))
         return True
 
@@ -241,6 +356,13 @@ class MemoryStore(Store):
         self._ensure_sweeper()
         q = self._queues[queue]
         msg = QueueMessage(id=next(q.next_id), payload=payload)
+        if self._wal is not None:
+            from dynamo_tpu.store.persist import encode_value
+
+            self._wal.append(
+                "q_push", q=queue, id=msg.id, p=encode_value(payload)
+            )
+            self._maybe_compact()
         async with q.cond:
             q.ready.append(msg)
             q.cond.notify()
@@ -266,7 +388,10 @@ class MemoryStore(Store):
 
     async def queue_ack(self, queue: str, msg_id: int) -> bool:
         q = self._queues[queue]
-        return q.in_flight.pop(msg_id, None) is not None
+        acked = q.in_flight.pop(msg_id, None) is not None
+        if acked and self._wal is not None:
+            self._wal.append("q_ack", q=queue, id=msg_id)
+        return acked
 
     async def queue_len(self, queue: str) -> int:
         q = self._queues[queue]
@@ -275,12 +400,20 @@ class MemoryStore(Store):
     # -- object store -----------------------------------------------------
     async def obj_put(self, bucket: str, name: str, data: bytes) -> None:
         self._objects[bucket][name] = bytes(data)
+        if self._wal is not None:
+            from dynamo_tpu.store.persist import encode_value
+
+            self._wal.append("obj_put", b=bucket, n=name, v=encode_value(data))
+            self._maybe_compact()
 
     async def obj_get(self, bucket: str, name: str) -> Optional[bytes]:
         return self._objects.get(bucket, {}).get(name)
 
     async def obj_delete(self, bucket: str, name: str) -> bool:
-        return self._objects.get(bucket, {}).pop(name, None) is not None
+        deleted = self._objects.get(bucket, {}).pop(name, None) is not None
+        if deleted and self._wal is not None:
+            self._wal.append("obj_del", b=bucket, n=name)
+        return deleted
 
     async def obj_list(self, bucket: str) -> list[str]:
         return sorted(self._objects.get(bucket, {}).keys())
@@ -294,3 +427,7 @@ class MemoryStore(Store):
             await w.close()
         for s in list(self._subs):
             await s.close()
+        if self._wal is not None:
+            # fold the log into a snapshot: clean restarts replay O(1)
+            self._wal.compact(self._snapshot())
+            self._wal.close()

@@ -1,0 +1,152 @@
+"""Durability for the coordinator store: append-only op log + snapshot.
+
+The reference leans on etcd's raft log and JetStream's file store for
+control-plane durability (reference: lib/runtime/src/transports/
+{etcd,nats}.rs); the self-hosted store mirrors that contract with a
+simple WAL: every surviving mutation appends one JSONL record, startup
+replays snapshot + log, and compaction folds the log into a fresh
+snapshot when it grows past ``compact_bytes``.
+
+What survives a restart (and what deliberately does not):
+- KV entries WITHOUT a lease — lease-attached keys are liveness
+  registrations; their owners must re-register after a coordinator
+  restart (same effective behavior as etcd lease expiry during the
+  outage).
+- Queues: pushed-but-unacked messages, including in-flight ones (they
+  come back READY — at-least-once redelivery, like JetStream).
+- The object plane (G4 KV tier, model artifacts).
+
+Values are base64 in the JSONL records: the log stays greppable and the
+control plane is low-rate, so text framing costs nothing that matters.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from typing import Any, Iterator, Optional
+
+SNAP_SUFFIX = ".snap"
+
+
+def _b64(data: bytes) -> str:
+    return base64.b64encode(bytes(data)).decode("ascii")
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+class WriteAheadLog:
+    """Append-only JSONL op log with snapshot-based compaction."""
+
+    def __init__(self, path: str, compact_bytes: int = 8 << 20):
+        self.path = path
+        self.snap_path = path + SNAP_SUFFIX
+        self.compact_bytes = compact_bytes
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._fh = None
+
+    # -- writing ----------------------------------------------------------
+    def _file(self):
+        if self._fh is None:
+            self._fh = open(self.path, "a", encoding="utf-8")
+        return self._fh
+
+    def append(self, op: str, **fields: Any) -> None:
+        rec = {"op": op, **fields}
+        fh = self._file()
+        fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        fh.flush()
+
+    @property
+    def size(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def needs_compaction(self) -> bool:
+        return self.size > self.compact_bytes
+
+    # -- replay -----------------------------------------------------------
+    def replay(self) -> tuple[Optional[dict], Iterator[dict]]:
+        """(snapshot dict or None, iterator of log records)."""
+        snap = None
+        if os.path.exists(self.snap_path):
+            with open(self.snap_path, encoding="utf-8") as f:
+                snap = json.load(f)
+
+        def records() -> Iterator[dict]:
+            if not os.path.exists(self.path):
+                return
+            with open(self.path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError:
+                        # torn tail write from a crash: stop replay here
+                        return
+
+        return snap, records()
+
+    def compact(self, snapshot: dict) -> None:
+        """Write a fresh snapshot atomically and truncate the log."""
+        tmp = self.snap_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(snapshot, f, separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snap_path)
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        with open(self.path, "w", encoding="utf-8") as f:
+            f.flush()
+            os.fsync(f.fileno())
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+# -- snapshot/record schema helpers (shared by MemoryStore) ----------------
+
+
+def snapshot_from_state(kv, queues, objects, version: int) -> dict:
+    """Serialize surviving state (see module docstring for the contract)."""
+    return {
+        "version": version,
+        "kv": [
+            {"k": e.key, "v": _b64(e.value), "ver": e.version}
+            for e in kv.values()
+            if e.lease_id == 0  # NO_LEASE — leased keys are ephemeral
+        ],
+        "queues": {
+            name: {
+                "next_id": q_next,
+                # in-flight comes back ready: at-least-once redelivery
+                "msgs": [
+                    {"id": m.id, "p": _b64(m.payload)} for m in msgs
+                ],
+            }
+            for name, (q_next, msgs) in queues.items()
+        },
+        "objects": {
+            bucket: {name: _b64(data) for name, data in objs.items()}
+            for bucket, objs in objects.items()
+        },
+    }
+
+
+def encode_value(value: bytes) -> str:
+    return _b64(value)
+
+
+def decode_value(s: str) -> bytes:
+    return _unb64(s)

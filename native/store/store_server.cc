@@ -74,6 +74,9 @@ static bool subject_matches(const std::string& pattern, const std::string& subje
   return pt.size() == st.size();
 }
 
+static volatile sig_atomic_t g_stop = 0;
+static void on_term(int) { g_stop = 1; }
+
 struct Conn;  // fwd
 
 struct Entry {
@@ -146,6 +149,13 @@ struct Server {
   std::unordered_map<std::string, QueueState> queues;
   std::unordered_map<std::string, std::map<std::string, std::string>> objects;
   uint64_t pop_order = 0;
+  // durability (mirrors the python store's contract, store/persist.py):
+  // periodic + shutdown snapshots of unleased KV, queues (in-flight
+  // restored as ready: at-least-once), and the object plane. Leased
+  // keys are liveness registrations — ephemeral by design.
+  std::string persist_path;
+  bool dirty = false;
+  double last_snap = 0;
 
   // ---- framing ----------------------------------------------------------
   void send_frame(Conn* c, const Val& v) {
@@ -219,6 +229,7 @@ struct Server {
     }
     Entry e{std::move(value), ++version, lease_id};
     kv[key] = e;
+    if (lease_id == 0) dirty = true;
     emit_watch("put", key, e);
     return e.version;
   }
@@ -228,6 +239,7 @@ struct Server {
     if (it == kv.end()) return false;
     Entry e = std::move(it->second);
     kv.erase(it);
+    if (e.lease_id == 0) dirty = true;
     if (e.lease_id != 0) {
       auto l = leases.find(e.lease_id);
       if (l != leases.end()) l->second.keys.erase(key);
@@ -367,6 +379,7 @@ struct Server {
         QMsg msg{q.next_id++, arg(1).s};
         int64_t id = msg.id;
         q.ready.push_back(std::move(msg));
+        dirty = true;
         serve_parked(arg(0).s);
         reply_ok(c, rid, Val::integer(id));
       } else if (op == "queue_pop") {
@@ -386,13 +399,16 @@ struct Server {
         }
       } else if (op == "queue_ack") {
         auto& q = queues[arg(0).s];
-        reply_ok(c, rid, Val::boolean(q.in_flight.erase(arg(1).i) > 0));
+        bool acked = q.in_flight.erase(arg(1).i) > 0;
+        if (acked) dirty = true;
+        reply_ok(c, rid, Val::boolean(acked));
       } else if (op == "queue_len") {
         auto& q = queues[arg(0).s];
         reply_ok(c, rid,
                  Val::integer((int64_t)(q.ready.size() + q.in_flight.size())));
       } else if (op == "obj_put") {
         objects[arg(0).s][arg(1).s] = arg(2).s;
+        dirty = true;
         reply_ok(c, rid, Val::boolean(true));
       } else if (op == "obj_get") {
         auto b = objects.find(arg(0).s);
@@ -402,6 +418,7 @@ struct Server {
       } else if (op == "obj_delete") {
         auto b = objects.find(arg(0).s);
         bool deleted = b != objects.end() && b->second.erase(arg(1).s) > 0;
+        if (deleted) dirty = true;
         reply_ok(c, rid, Val::boolean(deleted));
       } else if (op == "obj_list") {
         Val out = Val::arr();
@@ -437,8 +454,136 @@ struct Server {
     }
   }
 
+  // ---- durability -------------------------------------------------------
+  // Binary snapshot, atomic tmp+rename. Format (all ints little-endian):
+  //   "DTPUSNAP1" | u64 version
+  //   u32 n_kv    | { str key | u64 ver | str value }       (unleased only)
+  //   u32 n_queue | { str name | u64 next_id | u32 n | { u64 id | str p } }
+  //   u32 n_bkt   | { str bucket | u32 n | { str name | str data } }
+  static void put_u32(std::string& b, uint32_t v) { b.append((char*)&v, 4); }
+  static void put_u64(std::string& b, uint64_t v) { b.append((char*)&v, 8); }
+  static void put_str(std::string& b, const std::string& s) {
+    put_u32(b, (uint32_t)s.size());
+    b.append(s);
+  }
+  struct Rd {
+    const std::string& b;
+    size_t off = 0;
+    bool ok = true;
+    uint32_t u32() {
+      if (off + 4 > b.size()) { ok = false; return 0; }
+      uint32_t v; memcpy(&v, b.data() + off, 4); off += 4; return v;
+    }
+    uint64_t u64() {
+      if (off + 8 > b.size()) { ok = false; return 0; }
+      uint64_t v; memcpy(&v, b.data() + off, 8); off += 8; return v;
+    }
+    std::string str() {
+      uint32_t n = u32();
+      if (!ok || off + n > b.size()) { ok = false; return {}; }
+      std::string s = b.substr(off, n); off += n; return s;
+    }
+  };
+
+  void save_snapshot() {
+    if (persist_path.empty()) return;
+    std::string b;
+    b.append("DTPUSNAP1");
+    put_u64(b, (uint64_t)version);
+    uint32_t n_kv = 0;
+    for (auto& e : kv) if (e.second.lease_id == 0) ++n_kv;
+    put_u32(b, n_kv);
+    for (auto& e : kv) {
+      if (e.second.lease_id != 0) continue;
+      put_str(b, e.first);
+      put_u64(b, (uint64_t)e.second.version);
+      put_str(b, e.second.value);
+    }
+    put_u32(b, (uint32_t)queues.size());
+    for (auto& qe : queues) {
+      put_str(b, qe.first);
+      put_u64(b, (uint64_t)qe.second.next_id);
+      put_u32(b, (uint32_t)(qe.second.ready.size() + qe.second.in_flight.size()));
+      for (auto& m : qe.second.ready) { put_u64(b, (uint64_t)m.id); put_str(b, m.payload); }
+      for (auto& f : qe.second.in_flight) {
+        put_u64(b, (uint64_t)f.second.first.id);
+        put_str(b, f.second.first.payload);
+      }
+    }
+    put_u32(b, (uint32_t)objects.size());
+    for (auto& be : objects) {
+      put_str(b, be.first);
+      put_u32(b, (uint32_t)be.second.size());
+      for (auto& oe : be.second) { put_str(b, oe.first); put_str(b, oe.second); }
+    }
+    // every failure below leaves the previous snapshot intact and keeps
+    // dirty set, so the 2s tick retries — renaming a short write over
+    // the last good snapshot would LOSE durably-persisted state
+    std::string tmp = persist_path + ".tmp";
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (!f) { perror("snapshot open"); return; }
+    bool ok = fwrite(b.data(), 1, b.size(), f) == b.size();
+    ok = (fflush(f) == 0) && ok;
+    ok = (fsync(fileno(f)) == 0) && ok;
+    fclose(f);
+    if (!ok) { perror("snapshot write"); unlink(tmp.c_str()); return; }
+    if (rename(tmp.c_str(), persist_path.c_str()) != 0) {
+      perror("snapshot rename");
+      return;
+    }
+    dirty = false;
+    last_snap = now_s();
+  }
+
+  void load_snapshot() {
+    if (persist_path.empty()) return;
+    FILE* f = fopen(persist_path.c_str(), "rb");
+    if (!f) return;  // first boot
+    std::string b;
+    char buf[1 << 16];
+    size_t n;
+    while ((n = fread(buf, 1, sizeof buf, f)) > 0) b.append(buf, n);
+    fclose(f);
+    if (b.size() < 9 || b.compare(0, 9, "DTPUSNAP1") != 0) {
+      fprintf(stderr, "persist: unrecognized snapshot header, ignoring\n");
+      return;
+    }
+    Rd r{b, 9};
+    version = (int64_t)r.u64();
+    for (uint32_t i = r.u32(); r.ok && i > 0; --i) {
+      std::string key = r.str();
+      Entry e;
+      e.version = (int64_t)r.u64();
+      e.value = r.str();
+      if (r.ok) kv[key] = std::move(e);
+    }
+    for (uint32_t i = r.ok ? r.u32() : 0; r.ok && i > 0; --i) {
+      std::string name = r.str();
+      QueueState& q = queues[name];
+      q.next_id = (int64_t)r.u64();
+      for (uint32_t j = r.u32(); r.ok && j > 0; --j) {
+        QMsg m;
+        m.id = (int64_t)r.u64();
+        m.payload = r.str();
+        if (r.ok) q.ready.push_back(std::move(m));  // in-flight -> ready
+      }
+    }
+    for (uint32_t i = r.ok ? r.u32() : 0; r.ok && i > 0; --i) {
+      std::string bucket = r.str();
+      for (uint32_t j = r.u32(); r.ok && j > 0; --j) {
+        std::string nm = r.str();
+        std::string data = r.str();
+        if (r.ok) objects[bucket][nm] = std::move(data);
+      }
+    }
+    if (!r.ok) fprintf(stderr, "persist: truncated snapshot (partial restore)\n");
+  }
+
   // ---- periodic sweep ---------------------------------------------------
   void sweep() {
+    // durability tick: fold mutations into a snapshot at most every 2s
+    if (dirty && !persist_path.empty() && now_s() - last_snap > 2.0)
+      save_snapshot();
     double now = now_s();
     std::vector<int64_t> expired;
     for (auto& kv2 : leases)
@@ -516,6 +661,7 @@ struct Server {
   // ---- main loop --------------------------------------------------------
   int run(const char* host, int port) {
     signal(SIGPIPE, SIG_IGN);
+    load_snapshot();
     listen_fd = socket(AF_INET, SOCK_STREAM, 0);
     int one = 1;
     setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
@@ -550,6 +696,10 @@ struct Server {
         fds.push_back({kv2.first, ev, 0});
       }
       int rc = poll(fds.data(), (nfds_t)fds.size(), 100 /*ms: sweep tick*/);
+      if (g_stop) {
+        save_snapshot();
+        return 0;
+      }
       if (rc < 0 && errno != EINTR) {
         perror("poll");
         return 1;
@@ -611,10 +761,19 @@ struct Server {
 int main(int argc, char** argv) {
   const char* host = "0.0.0.0";
   int port = 4222;
+  const char* persist = nullptr;
   for (int i = 1; i < argc - 1; ++i) {
     if (!strcmp(argv[i], "--host")) host = argv[++i];
     else if (!strcmp(argv[i], "--port")) port = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--persist-path")) persist = argv[++i];
   }
   Server s;
+  if (persist) s.persist_path = persist;
+  // graceful shutdown: fold state into a final snapshot (the poll loop
+  // notices g_stop via EINTR / its 100ms tick)
+  struct sigaction sa{};
+  sa.sa_handler = on_term;
+  sigaction(SIGTERM, &sa, nullptr);
+  sigaction(SIGINT, &sa, nullptr);
   return s.run(host, port);
 }

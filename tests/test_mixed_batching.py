@@ -135,19 +135,18 @@ async def _generate(engine, prompt_ids, max_tokens=8, request_id="r"):
     return out, final
 
 
-async def test_mixed_engine_staggered_arrivals_match_dedicated():
-    """Stagger arrivals so stragglers' prefills ride mixed windows; the
-    greedy outputs must match a mixed-off engine run of the same
-    prompts (and the mixed path must actually trigger)."""
+async def test_mixed_engine_straggler_rides_mixed_window():
+    """A straggler arriving while another request decodes must ride a
+    mixed window (not stall decode with a dedicated pass), and greedy
+    outputs must match a mixed-off engine run of the same prompts."""
     from dynamo_tpu.engine.engine import JaxEngine
 
-    prompts = [list(range(1, 14 + 3 * i)) for i in range(4)]
+    prompts = [list(range(1, 14 + 3 * i)) for i in range(3)]
 
     async def run(mixed: bool):
         engine = await JaxEngine.launch(
             _engine_config(mixed_prefill_rows=2 if mixed else 0)
         )
-        # count mixed dispatches to prove the path runs
         n_mixed = 0
         if mixed:
             orig = engine._dispatch_mixed
@@ -159,24 +158,38 @@ async def test_mixed_engine_staggered_arrivals_match_dedicated():
 
             engine._dispatch_mixed = counting
         try:
-            async def staggered(i: int):
-                await asyncio.sleep(0.15 * i)
-                return await _generate(
-                    engine, prompts[i], max_tokens=12, request_id=f"s{i}"
-                )
+            adapter = engine.as_async_engine()
 
-            results = await asyncio.gather(*[staggered(i) for i in range(4)])
-            for toks, fin in results:
-                assert len(toks) == 12
-                assert fin.finish_reason == FinishReason.LENGTH
-            return [r[0] for r in results], n_mixed
+            async def consume(req, out: list):
+                async for item in adapter.generate(req, Context()):
+                    out.extend(item.token_ids)
+
+            # A decodes a LONG generation...
+            a_out: list = []
+            a_req = PreprocessedRequest(
+                request_id="a", token_ids=prompts[0],
+                sampling=SamplingOptions(use_greedy=True),
+                stop=StopConditions(max_tokens=120),
+            )
+            a_task = asyncio.create_task(consume(a_req, a_out))
+            while len(a_out) < 8:  # guaranteed mid-decode
+                await asyncio.sleep(0.01)
+            # ...when stragglers B and C arrive: their prefills must
+            # ride the decode window's dispatch
+            b = await _generate(engine, prompts[1], max_tokens=24,
+                                request_id="b")
+            c = await _generate(engine, prompts[2], max_tokens=24,
+                                request_id="c")
+            await a_task
+            assert len(a_out) == 120
+            return a_out, b[0], c[0], n_mixed
         finally:
             await engine.shutdown()
 
-    mixed_out, n_mixed = await run(True)
-    dedicated_out, _ = await run(False)
-    assert n_mixed > 0, "staggered arrivals never took the mixed path"
-    assert mixed_out == dedicated_out
+    a1, b1, c1, n_mixed = await run(True)
+    a2, b2, c2, _ = await run(False)
+    assert n_mixed > 0, "stragglers never took the mixed path"
+    assert (a1, b1, c1) == (a2, b2, c2)
 
 
 async def test_pipelined_mixed_chain_matches_dedicated():

@@ -1,0 +1,194 @@
+"""Coordinator-store durability: WAL + snapshot replay.
+
+The reference's control plane rides etcd (raft-durable) and JetStream
+(file store) — a coordinator restart there loses nothing but leases
+(reference: lib/runtime/src/transports/{etcd,nats}.rs). The self-hosted
+store must honor the same contract: model registrations, deployment
+specs, prefill queues, and the G4 object plane survive a restart;
+lease-attached liveness keys do not (their owners re-register).
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from dynamo_tpu.store.memory import MemoryStore
+
+
+async def _fill(store: MemoryStore) -> int:
+    await store.kv_put("models/llama", b"card-payload")
+    await store.kv_put("deployments/d1", b"spec")
+    lease = await store.lease_grant(30.0)
+    await store.kv_put("instances/worker-1", b"alive", lease_id=lease)
+    for i in range(5):
+        await store.queue_push("prefill", f"req-{i}".encode())
+    # one popped-but-unacked (must come back READY), one acked (gone)
+    m_acked = await store.queue_pop("prefill", timeout_s=1)
+    await store.queue_ack("prefill", m_acked.id)
+    m_inflight = await store.queue_pop("prefill", timeout_s=1)
+    assert m_inflight is not None
+    await store.obj_put("kv-tier", "block-123", b"\x00\x01" * 64)
+    await store.obj_put("kv-tier", "block-456", b"\x02" * 16)
+    await store.obj_delete("kv-tier", "block-456")
+    return m_acked.id
+
+
+async def _verify(store: MemoryStore, acked_id: int) -> None:
+    assert (await store.kv_get("models/llama")).value == b"card-payload"
+    assert (await store.kv_get("deployments/d1")).value == b"spec"
+    # leased liveness keys are ephemeral by design
+    assert await store.kv_get("instances/worker-1") is None
+    # 5 pushed - 1 acked = 4 ready (the unacked in-flight one came back)
+    assert await store.queue_len("prefill") == 4
+    seen = set()
+    for _ in range(4):
+        m = await store.queue_pop("prefill", timeout_s=1)
+        seen.add(m.payload)
+    assert f"req-0".encode() not in seen or acked_id != 1
+    assert len(seen) == 4
+    assert await store.obj_get("kv-tier", "block-123") == b"\x00\x01" * 64
+    assert await store.obj_get("kv-tier", "block-456") is None
+    assert await store.obj_list("kv-tier") == ["block-123"]
+
+
+async def test_restart_replays_wal(tmp_path):
+    path = str(tmp_path / "store.wal")
+    s1 = MemoryStore(persist_path=path)
+    acked = await _fill(s1)
+    # crash: no close(), restart replays the raw WAL
+    s1._wal.close()
+    s2 = MemoryStore(persist_path=path)
+    await _verify(s2, acked)
+    await s2.close()
+
+
+async def test_restart_after_clean_close_uses_snapshot(tmp_path):
+    path = str(tmp_path / "store.wal")
+    s1 = MemoryStore(persist_path=path)
+    acked = await _fill(s1)
+    await s1.close()  # compacts into a snapshot, truncates the WAL
+    assert os.path.getsize(path) == 0
+    assert os.path.getsize(path + ".snap") > 0
+    s2 = MemoryStore(persist_path=path)
+    await _verify(s2, acked)
+    # survives a SECOND restart after more mutations on top of the snap
+    await s2.kv_put("models/llama", b"v2")
+    await s2.queue_push("prefill", b"late")
+    s2._wal.close()
+    s3 = MemoryStore(persist_path=path)
+    assert (await s3.kv_get("models/llama")).value == b"v2"
+    assert await s3.queue_len("prefill") == 5
+    await s3.close()
+
+
+async def test_compaction_bounds_log_growth(tmp_path):
+    path = str(tmp_path / "store.wal")
+    s = MemoryStore(persist_path=path)
+    s._wal.compact_bytes = 2048  # tiny threshold
+    for i in range(200):
+        await s.kv_put(f"k/{i % 10}", b"x" * 32)
+    assert s._wal.size < 4096  # compaction kept folding the log
+    s._wal.close()
+    s2 = MemoryStore(persist_path=path)
+    for i in range(10):
+        assert (await s2.kv_get(f"k/{i}")).value == b"x" * 32
+    await s2.close()
+
+
+async def test_torn_tail_write_is_tolerated(tmp_path):
+    path = str(tmp_path / "store.wal")
+    s = MemoryStore(persist_path=path)
+    await s.kv_put("good", b"1")
+    s._wal.close()
+    with open(path, "a") as f:
+        f.write('{"op":"kv_put","k":"torn"')  # crash mid-record
+    s2 = MemoryStore(persist_path=path)
+    assert (await s2.kv_get("good")).value == b"1"
+    assert await s2.kv_get("torn") is None
+    await s2.close()
+
+
+async def test_crash_between_snapshot_and_truncate_no_duplicates(tmp_path):
+    """compact() is replace-then-truncate; a crash in between leaves the
+    pre-compaction log next to the fresh snapshot. Replay must not
+    double-deliver queue messages the snapshot already folded in."""
+    path = str(tmp_path / "store.wal")
+    s = MemoryStore(persist_path=path)
+    for i in range(3):
+        await s.queue_push("q", f"m{i}".encode())
+    log_copy = open(path).read()  # pre-compaction log
+    await s.close()  # compact: snapshot written, log truncated
+    # simulate the crash: restore the stale log beside the new snapshot
+    with open(path, "w") as f:
+        f.write(log_copy)
+    s2 = MemoryStore(persist_path=path)
+    assert await s2.queue_len("q") == 3  # not 6
+    await s2.close()
+
+
+# ---------------------------------------------------------------------------
+# Native (C++) server: kill-and-restart must preserve the same state the
+# python store does (native/store/store_server.cc snapshot persistence).
+# ---------------------------------------------------------------------------
+
+import signal
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BINARY = os.path.join(REPO, "dynamo_tpu", "native", "dynamo_store")
+
+
+def _spawn_native(persist: str):
+    proc = subprocess.Popen(
+        [BINARY, "--host", "127.0.0.1", "--port", "0",
+         "--persist-path", persist],
+        stdout=subprocess.PIPE,
+    )
+    line = proc.stdout.readline()
+    assert line.startswith(b"LISTENING"), line
+    return proc, int(line.split()[1])
+
+
+async def test_native_store_restart_preserves_state(tmp_path):
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "native", "build.py")],
+        capture_output=True, text=True,
+    )
+    if not os.path.exists(BINARY):
+        pytest.skip(f"native store build unavailable: {r.stderr[-200:]}")
+    from dynamo_tpu.store.client import StoreClient
+
+    persist = str(tmp_path / "native.snap")
+    proc, port = _spawn_native(persist)
+    try:
+        c = await StoreClient.connect("127.0.0.1", port)
+        await c.kv_put("models/m", b"card")
+        lease = await c.lease_grant(30.0)
+        await c.kv_put("instances/w1", b"alive", lease_id=lease)
+        for i in range(3):
+            await c.queue_push("prefill", f"r{i}".encode())
+        m = await c.queue_pop("prefill", timeout_s=1)
+        await c.queue_ack("prefill", m.id)
+        await c.obj_put("bkt", "obj1", b"\x01\x02")
+        await c.close()
+    finally:
+        # graceful stop -> final snapshot
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=10) == 0
+
+    proc, port = _spawn_native(persist)
+    try:
+        c = await StoreClient.connect("127.0.0.1", port)
+        assert (await c.kv_get("models/m")).value == b"card"
+        assert await c.kv_get("instances/w1") is None  # leased: ephemeral
+        assert await c.queue_len("prefill") == 2
+        seen = {(await c.queue_pop("prefill", timeout_s=1)).payload
+                for _ in range(2)}
+        assert len(seen) == 2 and m.payload not in seen
+        assert await c.obj_get("bkt", "obj1") == b"\x01\x02"
+        await c.close()
+    finally:
+        proc.kill()
+        proc.wait()
