@@ -126,7 +126,10 @@ def build_parser() -> argparse.ArgumentParser:
                        help="durability: WAL + snapshot at this path — "
                            "model registrations, queues, and the object "
                            "plane survive a coordinator restart (leased "
-                           "liveness keys stay ephemeral, like etcd)")
+                           "liveness keys stay ephemeral, like etcd). "
+                           "python server: per-op WAL; --native: periodic "
+                           "+ SIGTERM snapshots (a hard kill can lose up "
+                           "to ~2s of acknowledged mutations)")
 
     serve = sub.add_parser("serve", help="serve a @service graph "
                            "(≈ reference `dynamo serve`)")

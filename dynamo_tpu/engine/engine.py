@@ -1086,31 +1086,51 @@ class JaxEngine:
 
     def _export_blocks(self, seq_hashes: list[int]) -> tuple[list[int], np.ndarray]:
         """ENGINE THREAD. Gather the longest cached prefix of seq_hashes
-        as packed blocks (device tier first, then host tier)."""
+        as packed blocks (device tier first, then host tier).
+
+        Multihost (num_nodes > 1): the cache's KV-head axis is sharded
+        ACROSS processes, so the export runs as a mirrored replicated
+        gather (announce + mirror_gather_full) — the leader ends up with
+        whole blocks for the transfer plane. Only the DEVICE-resident
+        prefix exports there: the sharded G2 pools hold per-process head
+        slices, and assembling those would need a host-side cross-
+        process collective the step broadcast channel doesn't carry
+        (per-tier design notes: docs/multihost.md)."""
         from dynamo_tpu.kvbm import BlockLayout
 
         assert self.allocator is not None and self.model_config is not None
-        if self.config.num_nodes > 1:
-            # the device gather below is leader-local; over a cross-
-            # process-sharded cache it would hang a collective. Disagg
-            # export is single-host (docs/multihost.md Limits).
-            raise RuntimeError("KV export is unsupported with num_nodes > 1")
         layout = BlockLayout.for_model(
             self.model_config, self.config.block_size, self.config.kv_cache_dtype
         )
+        multihost = self.config.num_nodes > 1
         plan: list[tuple[str, int]] = []  # (tier, device block | hash)
         for h in seq_hashes:
             bid = self.allocator.lookup_block(h)
             if bid is not None:
                 plan.append(("dev", bid))
             elif (
-                self.kvbm is not None
+                not multihost
+                and self.kvbm is not None
                 and hasattr(self.kvbm.host, "read")  # not the multihost shard pool
                 and self.kvbm.host.contains(h)
             ):
                 plan.append(("host", h))
             else:
                 break
+        if multihost:
+            from dynamo_tpu.parallel.multihost import mirror_gather_full
+
+            n = len(plan)
+            if n == 0:
+                return [], np.zeros((0, *layout.packed_shape), layout.np_dtype)
+            ids = [bid for _, bid in plan]
+            assert self._mh_broadcast is not None
+            self._mh_broadcast.announce_kv_export(ids)
+            packed = mirror_gather_full(
+                self.k_cache, self.v_cache, np.asarray(ids, np.int32),
+                self.config.block_size, self.mesh,
+            )
+            return seq_hashes[:n], packed
         n = len(plan)
         if n == 0:
             return [], np.zeros((0, *layout.packed_shape), layout.np_dtype)
@@ -1130,13 +1150,14 @@ class JaxEngine:
 
     def _import_blocks(self, seq_hashes: list[int], packed: np.ndarray) -> int:
         """ENGINE THREAD. Land remote KV blocks in the host tier; the
-        next admission onboards them into HBM (kvbm onboard())."""
+        next admission onboards them into HBM (kvbm onboard()).
+
+        Multihost: the full blocks broadcast to every process and each
+        inserts ITS head slice into its shard pool (lockstep kept);
+        onboarding then lifts them through the existing mirrored
+        scatter."""
         if self.kvbm is None:
             raise RuntimeError("KV import requires host_kv_blocks > 0")
-        if not hasattr(self.kvbm.host, "read"):
-            # ShardedKvOffload: a leader-local insert of full-packed rows
-            # would silently break pool lockstep with the followers
-            raise RuntimeError("KV import is unsupported with num_nodes > 1")
         if len(seq_hashes) > self.kvbm.host.num_blocks:
             # inserting would LRU-evict the delivery's own leading blocks,
             # silently voiding the remote prefill — reject instead
@@ -1144,6 +1165,17 @@ class JaxEngine:
                 f"KV import of {len(seq_hashes)} blocks exceeds host tier "
                 f"capacity {self.kvbm.host.num_blocks}"
             )
+        if not hasattr(self.kvbm.host, "read"):
+            # ShardedKvOffload: mirrored insert — every process slices
+            # its own head range so the pools stay in lockstep
+            from dynamo_tpu.parallel.multihost import local_head_rows
+
+            assert self._mh_broadcast is not None
+            self._mh_broadcast.announce_kv_import(seq_hashes, packed)
+            self.kvbm.host.insert_many(
+                seq_hashes, local_head_rows(packed, self.k_cache)
+            )
+            return len(seq_hashes)
         self.kvbm.host.insert_many(seq_hashes, packed)
         return len(seq_hashes)
 

@@ -57,6 +57,8 @@ KIND_KV_GATHER = 3  # mirrored KV offload gather (shard-local store)
 KIND_KV_SCATTER = 4  # mirrored KV onboard scatter (shard-local load)
 KIND_KV_DISABLE = 5  # leader-side offload failure: drop shard pools
 KIND_MIXED = 6  # mixed prefill-rectangle + K-step decode window
+KIND_KV_EXPORT = 7  # mirrored replicated gather (disagg KV export)
+KIND_KV_IMPORT = 8  # broadcast full blocks; each process pools its shard
 
 
 class FatalMultihostError(RuntimeError):
@@ -126,6 +128,23 @@ class StepBroadcaster:
         self._bcast((
             np.asarray(block_ids, np.int32),
             _split_hashes(seq_hashes),
+        ))
+
+    def announce_kv_export(self, block_ids: list[int]) -> None:
+        """Disagg export: all processes must enter the same replicated
+        gather (mirror_gather_full)."""
+        self._ctrl(KIND_KV_EXPORT, len(block_ids))
+        self._bcast((np.asarray(block_ids, np.int32),))
+
+    def announce_kv_import(
+        self, seq_hashes: list[int], packed_full: np.ndarray
+    ) -> None:
+        """Disagg import: ship the full blocks to every process; each
+        inserts ITS head slice into its shard pool (lockstep kept)."""
+        self._ctrl(KIND_KV_IMPORT, len(seq_hashes))
+        self._bcast((
+            _split_hashes(seq_hashes),
+            np.ascontiguousarray(packed_full),
         ))
 
     def announce_stop(self) -> None:
@@ -296,6 +315,63 @@ def mirror_scatter(k_cache, v_cache, block_ids: np.ndarray,
     )
     with mesh:
         return _scatter(k_cache, v_cache, jnp_i32(ids), data, block_size)
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=8)
+def _gather_full_fn(mesh, block_size: int):
+    """Cached jitted replicated gather — a per-call jit closure would
+    retrace + recompile on EVERY export, on every host, stalling the
+    lockstep step loop for seconds each time."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from dynamo_tpu.ops.block_copy import _gather
+
+    def gather_rep(k, v, ids):
+        packed = _gather(k, v, ids, block_size)
+        return jax.lax.with_sharding_constraint(
+            packed, NamedSharding(mesh, P())
+        )
+
+    return jax.jit(gather_rep)
+
+
+def mirror_gather_full(k_cache, v_cache, block_ids: np.ndarray,
+                       block_size: int, mesh) -> np.ndarray:
+    """All processes: jitted gather with a fully-REPLICATED output
+    sharding (XLA all-gathers the KV-head shards over the mesh), so
+    every process — in particular the leader running the disagg
+    transfer plane — holds WHOLE packed blocks. The ICI/DCN all-gather
+    is the cost of assembling a cross-process-sharded cache; the blocks
+    are about to travel over DCN anyway."""
+    import jax
+
+    from dynamo_tpu.ops.block_copy import pad_ids_to_bucket
+
+    n = len(block_ids)
+    with mesh:
+        packed = _gather_full_fn(mesh, block_size)(
+            k_cache, v_cache, jnp_i32(pad_ids_to_bucket(block_ids))
+        )
+        jax.block_until_ready(packed)
+    return np.asarray(packed.addressable_data(0))[:n]
+
+
+def local_head_rows(packed_full: np.ndarray, cache) -> np.ndarray:
+    """This process's KV-head slice of full packed blocks
+    [n, 2, L, bs, H, D] — the import-side inverse of
+    ``local_packed_rows``: unique H-extents of the process's
+    addressable cache shards, concatenated in H order, so shard pools
+    filled from imports line up with pools filled by mirror_gather."""
+    starts = sorted({s.index[2].start or 0 for s in cache.addressable_shards})
+    h_loc = cache.addressable_shards[0].data.shape[2]
+    return np.concatenate(
+        [packed_full[..., h0 : h0 + h_loc, :] for h0 in starts], axis=4
+    )
 
 
 def jnp_i32(arr: np.ndarray):
@@ -488,6 +564,30 @@ class StepFollower:
                 # leader failed mid-offload and degraded to G1-only:
                 # drop the shard pool in lockstep (no more KV kinds come)
                 pool = None
+                continue
+            if kind == KIND_KV_EXPORT:
+                (ids,) = self._bcast((np.zeros((b,), np.int32),))
+                mirror_gather_full(
+                    e.k_cache, e.v_cache, np.asarray(ids),
+                    e.config.block_size, e.mesh,
+                )  # leader keeps the result; followers just participate
+                continue
+            if kind == KIND_KV_IMPORT:
+                from dynamo_tpu.kvbm import BlockLayout
+
+                layout = BlockLayout.for_model(
+                    e.model_config, e.config.block_size,
+                    e.config.kv_cache_dtype,
+                )
+                halves, packed = self._bcast((
+                    np.zeros((2, b), np.uint32),
+                    np.zeros((b, *layout.packed_shape), layout.np_dtype),
+                ))
+                hashes = _join_hashes(np.asarray(halves))
+                assert pool is not None, "leader imports but follower has no pool"
+                pool.insert_many(
+                    hashes, local_head_rows(np.asarray(packed), e.k_cache)
+                )
                 continue
             if kind in (KIND_KV_GATHER, KIND_KV_SCATTER):
                 ids, halves = self._bcast((

@@ -267,15 +267,21 @@ class MemoryStore(Store):
             lease.keys.add(key)
         version = next(self._version)
         entry = KvEntry(key=key, value=value, version=version, lease_id=lease_id)
+        durable_prev = prev is not None and prev.lease_id == NO_LEASE
         self._kv[key] = entry
-        if self._wal is not None and lease_id == NO_LEASE:
-            # leased keys are liveness registrations: ephemeral by design
+        if self._wal is not None:
             from dynamo_tpu.store.persist import encode_value
 
-            self._wal.append(
-                "kv_put", k=key, v=encode_value(value), ver=version
-            )
-            self._maybe_compact()
+            if lease_id == NO_LEASE:
+                self._wal.append(
+                    "kv_put", k=key, v=encode_value(value), ver=version
+                )
+                self._maybe_compact()
+            elif durable_prev:
+                # a leased put SHADOWS a previously durable key: tombstone
+                # it, or a restart would resurrect the stale value
+                # (leased keys themselves are ephemeral by design)
+                self._wal.append("kv_del", k=key)
         self._emit(WatchEvent("put", entry))
         return version
 
@@ -356,6 +362,13 @@ class MemoryStore(Store):
         self._ensure_sweeper()
         q = self._queues[queue]
         msg = QueueMessage(id=next(q.next_id), payload=payload)
+        async with q.cond:
+            q.ready.append(msg)
+            q.cond.notify()
+        # log AFTER the state mutation (like kv_put/obj_put): a
+        # compaction triggered by this very append snapshots state that
+        # already CONTAINS the message — logging first would let the
+        # compaction truncate the push record while the snapshot misses it
         if self._wal is not None:
             from dynamo_tpu.store.persist import encode_value
 
@@ -363,9 +376,6 @@ class MemoryStore(Store):
                 "q_push", q=queue, id=msg.id, p=encode_value(payload)
             )
             self._maybe_compact()
-        async with q.cond:
-            q.ready.append(msg)
-            q.cond.notify()
         return msg.id
 
     async def queue_pop(

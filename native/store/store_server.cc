@@ -149,10 +149,15 @@ struct Server {
   std::unordered_map<std::string, QueueState> queues;
   std::unordered_map<std::string, std::map<std::string, std::string>> objects;
   uint64_t pop_order = 0;
-  // durability (mirrors the python store's contract, store/persist.py):
-  // periodic + shutdown snapshots of unleased KV, queues (in-flight
-  // restored as ready: at-least-once), and the object plane. Leased
-  // keys are liveness registrations — ephemeral by design.
+  // durability (same restart CONTRACT as the python store,
+  // store/persist.py — unleased KV, queues with in-flight restored as
+  // ready, the object plane; leased liveness keys ephemeral) but a
+  // WEAKER crash window: snapshots are periodic (2s tick) + SIGTERM,
+  // so a hard kill can lose up to ~2s of acknowledged mutations. The
+  // python server WALs each op before replying; matching that here
+  // would put an fsync on every mutation of the single-threaded event
+  // loop — the 2s window is the chosen trade and is documented in the
+  // CLI help.
   std::string persist_path;
   bool dirty = false;
   double last_snap = 0;

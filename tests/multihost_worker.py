@@ -66,6 +66,25 @@ async def main(rank: int, coord: str) -> None:
 
             prompt_a = list(range(1, 34))  # 4+ blocks
             toks = await gen("mh-0", prompt_a)
+            # disagg KV export over the cross-process-sharded cache:
+            # mirrored replicated gather assembles WHOLE blocks on the
+            # leader (engine._export_blocks multihost path)
+            from dynamo_tpu.tokens import TokenBlockSequence
+
+            seq_hashes = TokenBlockSequence(
+                prompt_a, block_size=8
+            ).sequence_hashes()
+            exp_hashes, packed = await engine.export_kv_blocks(seq_hashes)
+            export_ok = (
+                len(exp_hashes) >= 4
+                and packed.shape[0] == len(exp_hashes)
+                # full KV-head range assembled (not one process's shard)
+                and packed.shape[-2] == mc.num_key_value_heads
+                and float(abs(packed).sum()) > 0
+            )
+            # ...and the import side: land them back in the sharded G2
+            # pools (every process keeps its slice, lockstep preserved)
+            imported = await engine.import_kv_blocks(exp_hashes, packed)
             # churn evicts A from the device pool (13 usable blocks)
             for i, base in enumerate((40, 80)):
                 await gen(f"churn{i}", list(range(base, base + 33)))
@@ -75,6 +94,8 @@ async def main(rank: int, coord: str) -> None:
             print("RESULT " + json.dumps({
                 "tokens": toks, "repeat_matches": toks2 == toks,
                 "offloaded": offloaded,
+                "export_ok": export_ok,
+                "imported": imported,
             }), flush=True)
         else:
             # follower: the engine thread runs the mirror loop; wait for

@@ -51,6 +51,11 @@ def test_two_process_engine_serves_request():
         # tier after device eviction) continues identically
         assert result["offloaded"] > 0, result
         assert result["repeat_matches"], result
+        # disagg KV export/import over the cross-process-sharded cache:
+        # whole blocks assembled on the leader, re-imported into the
+        # lockstep shard pools (engine.{_export,_import}_blocks)
+        assert result["export_ok"], result
+        assert result["imported"] >= 4, result
     finally:
         for p in procs:
             if p.poll() is None:

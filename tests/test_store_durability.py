@@ -127,6 +127,35 @@ async def test_crash_between_snapshot_and_truncate_no_duplicates(tmp_path):
     await s2.close()
 
 
+async def test_compaction_triggered_by_push_keeps_the_push(tmp_path):
+    """The compaction triggered by a queue_push's own WAL append must
+    snapshot state that already contains that message."""
+    path = str(tmp_path / "store.wal")
+    s = MemoryStore(persist_path=path)
+    s._wal.compact_bytes = 1  # every append compacts
+    await s.queue_push("q", b"only")
+    s._wal.close()
+    s2 = MemoryStore(persist_path=path)
+    assert await s2.queue_len("q") == 1
+    assert (await s2.queue_pop("q", timeout_s=1)).payload == b"only"
+    await s2.close()
+
+
+async def test_leased_overwrite_tombstones_durable_value(tmp_path):
+    """A leased put shadowing a durable key must not let a restart
+    resurrect the stale durable value."""
+    path = str(tmp_path / "store.wal")
+    s = MemoryStore(persist_path=path)
+    await s.kv_put("svc/endpoint", b"v1")  # durable
+    lease = await s.lease_grant(30.0)
+    await s.kv_put("svc/endpoint", b"v2", lease_id=lease)  # live re-registration
+    s._wal.close()
+    s2 = MemoryStore(persist_path=path)
+    # live store would serve v2-or-nothing; stale v1 must NOT come back
+    assert await s2.kv_get("svc/endpoint") is None
+    await s2.close()
+
+
 # ---------------------------------------------------------------------------
 # Native (C++) server: kill-and-restart must preserve the same state the
 # python store does (native/store/store_server.cc snapshot persistence).
