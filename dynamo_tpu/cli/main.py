@@ -295,6 +295,12 @@ def _wrap_pipeline(args: Any, core, eos_ids: list[int]):
     tokenizer, formatter, model_name = _load_model_assets(args)
     if getattr(args, "vision_config", None):
         pre = _build_mm_preprocessor(args, tokenizer, formatter, model_name)
+    elif _is_vlm_checkpoint(getattr(args, "model_path", None)):
+        # REAL VLM checkpoint (LLaVA layout): tower + projector load
+        # straight from the model dir, no --vision-config needed
+        pre = _build_mm_preprocessor_from_checkpoint(
+            args, tokenizer, formatter, model_name
+        )
     else:
         pre = OpenAIPreprocessor(tokenizer, formatter, model_name=model_name)
     backend = Backend(tokenizer, eos_token_ids=eos_ids)
@@ -327,6 +333,77 @@ def _build_mm_preprocessor(args: Any, tokenizer, formatter, model_name: str):
         formatter,
         encode=encoder.encode_urls,
         image_token_id=image_token_id,
+        tokens_per_image=encoder.tokens_per_image,
+        model_name=model_name,
+    )
+
+
+def _is_vlm_checkpoint(model_path: Any) -> bool:
+    """True when the model dir is a VLM checkpoint WE can serve
+    multimodal: config.json carries a vision_config AND the weights use
+    the LLaVA layout (vision_tower.vision_model.*). Other VLM layouts
+    (Qwen2-VL, mllama, ...) fall back to text-only serving with a
+    warning rather than crashing at startup."""
+    import json
+
+    if not model_path or not os.path.isdir(str(model_path)):
+        return False
+    cfg_path = os.path.join(str(model_path), "config.json")
+    if not os.path.exists(cfg_path):
+        return False
+    try:
+        with open(cfg_path) as f:
+            if json.load(f).get("vision_config") is None:
+                return False
+        from dynamo_tpu.models.loader import _ShardedCheckpoint
+
+        names = _ShardedCheckpoint(str(model_path)).names()
+        if any(n.startswith("vision_tower.vision_model.") for n in names):
+            return True
+        log.warning(
+            "%s has a vision_config but not the LLaVA weight layout; "
+            "serving TEXT-ONLY (supported VLM layout: "
+            "vision_tower.vision_model.* + multi_modal_projector.*)",
+            model_path,
+        )
+        return False
+    except Exception:
+        return False
+
+
+def _build_mm_preprocessor_from_checkpoint(
+    args: Any, tokenizer, formatter, model_name: str
+):
+    """Vision-language pipeline head from a REAL VLM checkpoint: the
+    tower + projector weights come from the model dir's safetensors
+    (models/vision.py load_vision_hf); the image token id comes from
+    the config's image_token_index (or the tokenizer)."""
+    import json
+
+    from dynamo_tpu.models.vision import load_vision_hf
+    from dynamo_tpu.multimodal import MultimodalPreprocessor, VisionEncoder
+
+    vcfg, vparams = load_vision_hf(args.model_path)
+    encoder = VisionEncoder(vcfg, params=vparams)
+    with open(os.path.join(args.model_path, "config.json")) as f:
+        raw = json.load(f)
+    image_token_id = raw.get("image_token_index")
+    if image_token_id is None:
+        image_token_id = tokenizer.token_to_id(args.image_token)
+    if image_token_id is None:
+        raise SystemExit(
+            f"tokenizer has no {args.image_token!r} token and the config "
+            "has no image_token_index; pass --image-token"
+        )
+    log.info(
+        "VLM checkpoint: vision tower %d layers, %d tokens/image",
+        vcfg.num_hidden_layers, encoder.tokens_per_image,
+    )
+    return MultimodalPreprocessor(
+        tokenizer,
+        formatter,
+        encode=encoder.encode_urls,
+        image_token_id=int(image_token_id),
         tokens_per_image=encoder.tokens_per_image,
         model_name=model_name,
     )

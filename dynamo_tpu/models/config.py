@@ -40,6 +40,9 @@ class ModelConfig:
     num_experts_per_tok: int = 2
     # multimodal (filled for vision-language models)
     vision_config: Optional[dict] = None
+    # token id the processor substitutes per image patch slot (LLaVA's
+    # image_token_index); None = resolve via the tokenizer
+    image_token_index: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.head_dim is None:
@@ -62,6 +65,34 @@ class ModelConfig:
 
     @classmethod
     def from_dict(cls, raw: dict) -> "ModelConfig":
+        # VLM configs (LLaVA layout) nest the language model under
+        # text_config; hoist it and keep the vision_config alongside
+        # (reference: examples/multimodal serves such checkpoints)
+        if "text_config" in raw:
+            merged = dict(raw["text_config"])
+            if raw.get("vision_config") is not None:
+                merged["vision_config"] = raw["vision_config"]
+            if "image_token_index" in raw:
+                merged["image_token_index"] = raw["image_token_index"]
+            structural = {
+                "hidden_size", "num_hidden_layers",
+                "num_attention_heads", "intermediate_size",
+            }
+            missing = structural - set(merged)
+            if missing:
+                # real llava-hf text_configs are often sparse and lean
+                # on transformers' LlamaConfig (7B) defaults — which
+                # this dataclass happens to share. Weight loading
+                # validates every shape, so a wrong guess fails loudly
+                # there; random-weight runs would not, hence the warning.
+                import logging
+
+                logging.getLogger("dynamo_tpu.models").warning(
+                    "text_config omits %s; assuming Llama-7B-shaped "
+                    "defaults (weight loading validates shapes)",
+                    sorted(missing),
+                )
+            raw = merged
         known = {f.name for f in cls.__dataclass_fields__.values()}  # type: ignore[attr-defined]
         kwargs = {k: v for k, v in raw.items() if k in known}
         # qwen2 checkpoints always use qkv bias but don't say so in config

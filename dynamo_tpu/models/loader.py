@@ -162,13 +162,27 @@ class _ShardedCheckpoint:
                     for name in f.keys():
                         self._name_to_file[name] = path
         self._open_handles: dict[str, Any] = {}
+        # VLM checkpoints (LLaVA layout) prefix the language model's
+        # weights: the standard llama maps resolve transparently
+        self._prefix = (
+            "language_model."
+            if "language_model.model.embed_tokens.weight" in self._name_to_file
+            else ""
+        )
 
     def names(self) -> set[str]:
-        return set(self._name_to_file)
+        if not self._prefix:
+            return set(self._name_to_file)
+        return {
+            n[len(self._prefix):] if n.startswith(self._prefix) else n
+            for n in self._name_to_file
+        }
 
     def get(self, name: str) -> np.ndarray:
         from safetensors import safe_open
 
+        if name not in self._name_to_file:
+            name = self._prefix + name
         path = self._name_to_file[name]
         handle = self._open_handles.get(path)
         if handle is None:

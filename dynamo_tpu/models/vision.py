@@ -36,6 +36,13 @@ class VisionConfig:
     num_attention_heads: int = 16
     layer_norm_eps: float = 1e-5
     projection_dim: int = 4096  # language-model hidden size
+    # CLIP prepends a learned class token (sees attention, dropped from
+    # the patch features afterwards — LLaVA's feature-select semantics)
+    use_class_token: bool = False
+    # whether the final layernorm applies before the projector: LLaVA's
+    # default vision_feature_layer=-2 taps the PENULTIMATE hidden state,
+    # bypassing post_layernorm
+    apply_post_ln: bool = True
 
     @property
     def num_patches(self) -> int:
@@ -55,9 +62,14 @@ def vision_param_shapes(cfg: VisionConfig) -> dict[str, tuple[tuple[int, ...], A
     L, D, F = cfg.num_hidden_layers, cfg.hidden_size, cfg.intermediate_size
     P = cfg.projection_dim
     bf16 = jnp.bfloat16
+    n_pos = cfg.num_patches + (1 if cfg.use_class_token else 0)
+    shapes_head = (
+        {"class_embed": ((D,), jnp.float32)} if cfg.use_class_token else {}
+    )
     return {
+        **shapes_head,
         "patch_embed": ((cfg.patch_dim, D), bf16),
-        "pos_embed": ((cfg.num_patches, D), jnp.float32),
+        "pos_embed": ((n_pos, D), jnp.float32),
         "ln_pre": ((2, D), jnp.float32),  # [scale, bias]
         "wq": ((L, D, D), bf16),
         "bq": ((L, D), bf16),
@@ -125,6 +137,125 @@ def load_vision_params(cfg: VisionConfig, path: str) -> Params:
     return params
 
 
+def load_vision_hf(model_dir: str) -> tuple[VisionConfig, Params]:
+    """Load the vision tower + projector from a REAL VLM checkpoint
+    directory (LLaVA layout: CLIP tower under
+    ``vision_tower.vision_model.*``, projector under
+    ``multi_modal_projector.*`` — reference: examples/multimodal serves
+    such checkpoints through its encode worker).
+
+    Mapping notes:
+    - the conv patch embedding [D, 3, p, p] becomes our reshape-matmul
+      patch_embed [p*p*3, D] (pixels patchify row-major (p, p, 3));
+    - the class token participates in attention exactly as in CLIP and
+      is dropped from the features afterwards (LLaVA feature select);
+    - ``vision_feature_layer`` (default -2) is honored by truncating
+      the layer stack and skipping post_layernorm — HF taps the
+      PENULTIMATE hidden state for the projector;
+    - projection_dim comes from the projector weight itself, not the
+      config (real llava text_configs are sparse, and CLIP's own
+      ``projection_dim`` key means its contrastive head);
+    - nn.Linear weights are [out, in] and transpose into our [in, out].
+    """
+    import json
+    import os
+
+    import numpy as np
+
+    with open(os.path.join(model_dir, "config.json")) as f:
+        raw = json.load(f)
+    vraw = dict(raw.get("vision_config") or raw)
+    vraw.pop("projection_dim", None)  # CLIP's contrastive head, not ours
+    vcfg = VisionConfig.from_dict(vraw)
+    vcfg.use_class_token = True
+
+    from dynamo_tpu.models.loader import _ShardedCheckpoint
+
+    ckpt = _ShardedCheckpoint(model_dir)
+    names = ckpt.names()
+    vt = "vision_tower.vision_model."
+    if not any(n.startswith(vt) for n in names):
+        raise ValueError(
+            f"{model_dir} has no {vt}* weights — not a LLaVA-layout VLM"
+        )
+    # vision_feature_layer: -2 = penultimate hidden state, no post-LN
+    feature_layer = int(raw.get("vision_feature_layer", -2))
+    if feature_layer < 0:
+        n_layers = vcfg.num_hidden_layers + 1 + feature_layer
+    else:
+        n_layers = feature_layer
+    if not 0 < n_layers <= vcfg.num_hidden_layers:
+        raise ValueError(
+            f"vision_feature_layer={feature_layer} out of range for "
+            f"{vcfg.num_hidden_layers} layers"
+        )
+    vcfg.apply_post_ln = n_layers == vcfg.num_hidden_layers
+    vcfg.num_hidden_layers = n_layers
+
+    def t(name: str) -> np.ndarray:
+        from dynamo_tpu.models.quant import np_to_f32
+
+        return np_to_f32(ckpt.get(name))
+
+    def lin(prefix: str):  # nn.Linear -> (w [in, out], b [out])
+        return t(prefix + ".weight").T, t(prefix + ".bias")
+
+    def ln(prefix: str) -> np.ndarray:  # [2, D] = [scale, bias]
+        return np.stack([t(prefix + ".weight"), t(prefix + ".bias")])
+
+    p: dict = {}
+    conv = t(vt + "embeddings.patch_embedding.weight")  # [D, 3, p, p]
+    p["patch_embed"] = conv.transpose(2, 3, 1, 0).reshape(
+        vcfg.patch_dim, vcfg.hidden_size
+    )
+    p["class_embed"] = t(vt + "embeddings.class_embedding").reshape(-1)
+    p["pos_embed"] = t(vt + "embeddings.position_embedding.weight")
+    # CLIP's attribute really is spelled "pre_layrnorm"
+    p["ln_pre"] = ln(vt + "pre_layrnorm")
+    p["ln_post"] = ln(vt + "post_layernorm")
+    per_layer: dict[str, list] = {
+        k: [] for k in (
+            "wq", "bq", "wk", "bk", "wv", "bv", "wo", "bo",
+            "ln1", "ln2", "mlp_up", "mlp_up_b", "mlp_down", "mlp_down_b",
+        )
+    }
+    for i in range(vcfg.num_hidden_layers):
+        lp = f"{vt}encoder.layers.{i}."
+        for ours, theirs in (
+            ("q", "q_proj"), ("k", "k_proj"), ("v", "v_proj"),
+            ("o", "out_proj"),
+        ):
+            w, b = lin(lp + "self_attn." + theirs)
+            per_layer["w" + ours].append(w)
+            per_layer["b" + ours].append(b)
+        per_layer["ln1"].append(ln(lp + "layer_norm1"))
+        per_layer["ln2"].append(ln(lp + "layer_norm2"))
+        w, b = lin(lp + "mlp.fc1")
+        per_layer["mlp_up"].append(w)
+        per_layer["mlp_up_b"].append(b)
+        w, b = lin(lp + "mlp.fc2")
+        per_layer["mlp_down"].append(w)
+        per_layer["mlp_down_b"].append(b)
+    for k, v in per_layer.items():
+        p[k] = np.stack(v)
+    w, b = lin("multi_modal_projector.linear_1")
+    p["proj_1"], p["proj_1_b"] = w, b
+    # projection dim = the projector's actual output width (the
+    # language hidden size); sparse real-world configs don't carry it
+    vcfg.projection_dim = int(w.shape[1])
+    w, b = lin("multi_modal_projector.linear_2")
+    p["proj_2"], p["proj_2_b"] = w, b
+
+    shapes = vision_param_shapes(vcfg)
+    params: Params = {}
+    for name, (shape, dtype) in shapes.items():
+        arr = p[name]
+        if tuple(arr.shape) != shape:
+            raise ValueError(f"{name}: expected {shape}, got {arr.shape}")
+        params[name] = jnp.asarray(arr, dtype=dtype)
+    return vcfg, params
+
+
 def _layernorm(x: jax.Array, ln: jax.Array, eps: float) -> jax.Array:
     """ln: [2, D] = [scale, bias]."""
     xf = x.astype(jnp.float32)
@@ -152,6 +283,12 @@ def encode_images(cfg: VisionConfig, params: Params, pixels: jax.Array) -> jax.A
     Dh = D // H
 
     x = patchify(cfg, pixels).astype(jnp.bfloat16) @ params["patch_embed"]
+    if cfg.use_class_token:
+        cls = jnp.broadcast_to(
+            params["class_embed"].astype(x.dtype)[None, None, :],
+            (x.shape[0], 1, x.shape[-1]),
+        )
+        x = jnp.concatenate([cls, x], axis=1)
     x = x + params["pos_embed"].astype(x.dtype)
     x = _layernorm(x, params["ln_pre"], eps)
 
@@ -177,7 +314,10 @@ def encode_images(cfg: VisionConfig, params: Params, pixels: jax.Array) -> jax.A
         "ln1", "ln2", "mlp_up", "mlp_up_b", "mlp_down", "mlp_down_b",
     ]
     x, _ = jax.lax.scan(layer_fn, x, {n: params[n] for n in layer_names})
-    x = _layernorm(x, params["ln_post"], eps)
+    if cfg.apply_post_ln:
+        x = _layernorm(x, params["ln_post"], eps)
+    if cfg.use_class_token:
+        x = x[:, 1:]  # feature-select: drop the class token's slot
     # LLaVA-style projector into the language model's embedding space
     x = jax.nn.gelu(x @ params["proj_1"] + params["proj_1_b"])
     x = x @ params["proj_2"] + params["proj_2_b"]

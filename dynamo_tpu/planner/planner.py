@@ -68,19 +68,27 @@ class _Signal:
 class Planner:
     def __init__(
         self,
-        store: Store,
-        component: Component,  # the decode component (for load_metrics)
+        store: Optional[Store],
+        component: Optional[Component],  # decode component (load_metrics)
         connector: Connector,
         config: Optional[PlannerConfig] = None,
         prefill_workers: int = 0,
         decode_workers: int = 1,
     ):
+        """``store``/``component`` may be None for a DRIVEN planner:
+        the caller feeds snapshots straight into make_adjustments()
+        (the planner-simulation example and what-if analyses) instead
+        of collect() polling live metrics."""
         self.store = store
         self.component = component
         self.connector = connector
         self.config = config or PlannerConfig()
         self.aggregator = KvMetricsAggregator()
-        self.queue = PrefillQueue(store, component.namespace.name)
+        self.queue = (
+            PrefillQueue(store, component.namespace.name)
+            if store is not None and component is not None
+            else None
+        )
         self.decode_workers = decode_workers
         self.prefill_workers = prefill_workers
         self._decode_sig = _Signal()
@@ -90,11 +98,16 @@ class Planner:
         self.on_metrics: Optional[Any] = None  # hook for tracing/tensorboard
 
     async def start(self) -> None:
+        assert self.component is not None and self.queue is not None, (
+            "a driven planner (store=None) has no live metrics to poll — "
+            "feed make_adjustments() directly"
+        )
         sub = await self.component.subscribe("load_metrics")
         self.aggregator.start_consuming(sub)
         self._task = asyncio.create_task(self._run())
 
     async def collect(self) -> dict[str, float]:
+        assert self.queue is not None
         fresh = self.aggregator.fresh_metrics()
         usages = [m.gpu_cache_usage_perc for m in fresh.values()]
         kv_load = sum(usages) / len(usages) if usages else 0.0

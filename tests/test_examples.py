@@ -116,7 +116,40 @@ def test_example_configs_generate_valid_manifests():
         names.add(spec.name)
         for doc in graph_manifests(spec, image="example/dyn:test"):
             validate_k8s_doc(doc)
-    assert {"llm-agg", "llm-disagg", "llm-disagg-multinode", "vlm"} <= names
+    assert {
+        "llm-agg", "llm-disagg", "llm-disagg-multinode", "vlm",
+        "llm-moe-ep", "llm-vlm",
+    } <= names
+
+
+async def test_planner_sim_scales_up_and_down(tmp_path):
+    """The planner-benchmark analogue (examples/llm/planner_sim.py):
+    under a sinusoidal load the REAL planner must scale decode and
+    prefill up into the peak and back down after it, and the recorded
+    JSONL trace must carry the replica story."""
+    import json
+
+    from examples.llm.planner_sim import simulate
+
+    out = str(tmp_path / "trace.jsonl")
+    summary = await simulate(out, period_ticks=60, cycles=2.0)
+    assert summary["scale_ups"] >= 2, summary
+    assert summary["scale_downs"] >= 2, summary
+    assert summary["peak_decode_workers"] > 1, summary
+    assert summary["final_decode_workers"] == 1, summary  # back down
+    rows = [json.loads(l) for l in open(out)]
+    assert len(rows) == summary["ticks"]
+    assert {"kv_load_mean", "decode_workers", "prefill_workers"} <= set(rows[0])
+    # the committed example trace must match the simulator exactly
+    # (deterministic; regenerate with `python -m examples.llm.planner_sim
+    # --out examples/llm/planner_trace.jsonl` after planner changes)
+    import os
+    committed = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "llm", "planner_trace.jsonl",
+    )
+    committed_rows = [json.loads(l) for l in open(committed)]
+    assert committed_rows == rows
 
 
 def test_example_launch_scripts_use_real_cli_flags():
