@@ -322,8 +322,22 @@ def _build_mm_preprocessor(args: Any, tokenizer, formatter, model_name: str):
         vparams = load_vision_params(vcfg, args.vision_weights)
     else:
         log.warning("vision tower using RANDOM weights (no --vision-weights)")
+    return _mm_preprocessor(
+        args, tokenizer, formatter, model_name, vcfg, vparams, None
+    )
+
+
+def _mm_preprocessor(
+    args: Any, tokenizer, formatter, model_name: str, vcfg, vparams,
+    image_token_id,
+):
+    """Shared tail of both multimodal pipeline heads: encoder + token-id
+    resolution + preprocessor wiring (one copy, two entry points)."""
+    from dynamo_tpu.multimodal import MultimodalPreprocessor, VisionEncoder
+
     encoder = VisionEncoder(vcfg, params=vparams)
-    image_token_id = tokenizer.token_to_id(args.image_token)
+    if image_token_id is None:
+        image_token_id = tokenizer.token_to_id(args.image_token)
     if image_token_id is None:
         raise SystemExit(
             f"tokenizer has no {args.image_token!r} token; pass --image-token"
@@ -332,7 +346,7 @@ def _build_mm_preprocessor(args: Any, tokenizer, formatter, model_name: str):
         tokenizer,
         formatter,
         encode=encoder.encode_urls,
-        image_token_id=image_token_id,
+        image_token_id=int(image_token_id),
         tokens_per_image=encoder.tokens_per_image,
         model_name=model_name,
     )
@@ -384,28 +398,15 @@ def _build_mm_preprocessor_from_checkpoint(
     from dynamo_tpu.multimodal import MultimodalPreprocessor, VisionEncoder
 
     vcfg, vparams = load_vision_hf(args.model_path)
-    encoder = VisionEncoder(vcfg, params=vparams)
     with open(os.path.join(args.model_path, "config.json")) as f:
         raw = json.load(f)
-    image_token_id = raw.get("image_token_index")
-    if image_token_id is None:
-        image_token_id = tokenizer.token_to_id(args.image_token)
-    if image_token_id is None:
-        raise SystemExit(
-            f"tokenizer has no {args.image_token!r} token and the config "
-            "has no image_token_index; pass --image-token"
-        )
     log.info(
-        "VLM checkpoint: vision tower %d layers, %d tokens/image",
-        vcfg.num_hidden_layers, encoder.tokens_per_image,
+        "VLM checkpoint: vision tower %d layers (feature-selected)",
+        vcfg.num_hidden_layers,
     )
-    return MultimodalPreprocessor(
-        tokenizer,
-        formatter,
-        encode=encoder.encode_urls,
-        image_token_id=int(image_token_id),
-        tokens_per_image=encoder.tokens_per_image,
-        model_name=model_name,
+    return _mm_preprocessor(
+        args, tokenizer, formatter, model_name, vcfg, vparams,
+        raw.get("image_token_index"),
     )
 
 

@@ -415,25 +415,30 @@ class Scheduler:
         return safe
 
     def plan_pipelined_mixed(
-        self, seqs: list[Sequence], works: list[PrefillWork], offset: int
+        self, seqs: list[Sequence], works: list[PrefillWork], lag: dict
     ) -> Optional[dict]:
-        """Plan the NEXT window while a MIXED window is in flight.
+        """Plan the NEXT window while one or more windows are in flight.
 
-        The in-flight window is decoding ``offset`` tokens for ``seqs``
-        AND prefilling ``works``; last-chunk works GRADUATE to decode
-        rows of the next window (their first sampled token is
-        device-resident in the in-flight window's outputs — the engine
-        chains it via an on-device gather, indexed by ``src_idx``:
-        row j of the old decode batch -> j, graduated work r ->
-        B_pad + r). Returns None (flush the pipeline) whenever anything
-        irregular appears: a non-final chunk, cancellations, budget
-        inside the in-flight window, batch overflow, or block
-        exhaustion (never preempts here).
+        ``lag`` maps id(seq) -> tokens generated by in-flight windows
+        but not yet applied to host state (decode rows contribute their
+        valid steps per window; a last-chunk prefill contributes its
+        one sampled token). The newest in-flight window is decoding for
+        ``seqs`` AND prefilling ``works``; last-chunk works GRADUATE to
+        decode rows of the next window (their first sampled token is
+        device-resident in that window's outputs — the engine chains it
+        via an on-device gather, indexed by ``src_idx``: row j of the
+        newest decode batch -> j, graduated work r -> B_pad + r).
+        Returns None (flush the pipeline) whenever anything irregular
+        appears: a non-final chunk, cancellations, budget inside the
+        in-flight windows, batch overflow, or block exhaustion (never
+        preempts here).
 
-        Returns {"seqs", "works2", "arrays", "src_idx", "offsets"}:
-        the next window's decode seqs (old + graduated), its prefill
-        works, the decode arrays (tokens are placeholders), the token-
-        source gather index, and per-row seed offsets.
+        Returns {"seqs", "works2", "arrays", "src_idx", "offsets",
+        "vmap"}: the next window's decode seqs (old + graduated), its
+        prefill works, the decode arrays (tokens are placeholders), the
+        token-source gather index, per-row seed offsets (= lags), and
+        the valid-step counts this window will add per sequence (the
+        engine folds them into ``lag`` on dispatch).
         """
         if self.waiting:
             self._admit()
@@ -450,9 +455,9 @@ class Scheduler:
                 return None
             if (
                 seq.max_new_tokens is not None
-                and seq.max_new_tokens - seq.generated <= offset
+                and seq.max_new_tokens - seq.generated <= lag.get(id(seq), 0)
             ):
-                # finishes INSIDE the in-flight window: simply not a
+                # finishes INSIDE an in-flight window: simply not a
                 # row of the next one (its blocks are freed at sync,
                 # which the next window never touches) — refusing to
                 # pipeline here would block the chain whenever ANY
@@ -467,18 +472,14 @@ class Scheduler:
             return None
         K = self.decode_lookahead
         # block allocation for the whole next window (no preemption on
-        # this path; rollback on exhaustion)
+        # this path; rollback on exhaustion). lag covers a graduated
+        # row's in-flight sampled token, so one formula serves all.
         added: list[Sequence] = []
         ok = True
         for seq in next_seqs:
-            if id(seq) in grad_row:
-                # after the in-flight window: prompt + 1 sampled token,
-                # then K more in the next window
-                needed = seq.blocks_needed(seq.total_len + 1 + K, self.block_size)
-            else:
-                needed = seq.blocks_needed(
-                    seq.total_len + offset + K, self.block_size
-                )
+            needed = seq.blocks_needed(
+                seq.total_len + lag.get(id(seq), 0) + K, self.block_size
+            )
             while len(seq.block_table) < needed:
                 try:
                     seq.block_table.append(self.allocator.allocate_block())
@@ -530,25 +531,24 @@ class Scheduler:
         valid_steps = np.zeros((B,), np.int32)
         src_idx = np.zeros((B,), np.int32)
         offsets = [0] * n
+        vmap: dict[int, int] = {}
         for i, s in enumerate(next_seqs):
+            gen_after = lag.get(id(s), 0)
             if id(s) in grad_row:
-                pos = s.total_len  # the in-flight-sampled token's slot
-                c = s.total_len + 1
-                gen_after = 1
                 src_idx[i] = self._decode_batch(len(seqs)) + grad_row[id(s)]
             else:
-                pos = s.total_len - 1 + offset
-                c = s.total_len + offset
-                gen_after = offset
                 src_idx[i] = old_row[id(s)]
-            positions[i, 0] = pos
+            # the sampled-but-unapplied tokens occupy slots up to
+            # total_len - 1 + lag; the next window starts there
+            positions[i, 0] = s.total_len - 1 + gen_after
             tables[i, : len(s.block_table)] = s.block_table
-            ctx[i] = c
+            ctx[i] = s.total_len + gen_after
             v = K
             if s.max_new_tokens is not None:
                 v = min(v, max(1, s.max_new_tokens - s.generated - gen_after))
             valid_steps[i] = v
             offsets[i] = gen_after
+            vmap[id(s)] = v
         arrays = {
             "tokens": np.zeros((B, 1), np.int32),  # device chain overrides
             "positions": positions,
@@ -562,6 +562,7 @@ class Scheduler:
             "arrays": arrays,
             "src_idx": src_idx,
             "offsets": offsets,
+            "vmap": vmap,
         }
 
     def _preempt(self, victim: Sequence) -> None:

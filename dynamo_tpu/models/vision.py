@@ -189,7 +189,9 @@ def load_vision_hf(model_dir: str) -> tuple[VisionConfig, Params]:
             f"vision_feature_layer={feature_layer} out of range for "
             f"{vcfg.num_hidden_layers} layers"
         )
-    vcfg.apply_post_ln = n_layers == vcfg.num_hidden_layers
+    # HF's hidden_states tuple is always PRE-post_layernorm — LLaVA
+    # feature select never applies it, not even for the last layer
+    vcfg.apply_post_ln = False
     vcfg.num_hidden_layers = n_layers
 
     def t(name: str) -> np.ndarray:
