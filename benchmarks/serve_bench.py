@@ -66,9 +66,6 @@ SHAPES = {
                     block_size=16, max_batch_size=32, decode_steps=32,
                     hbm_utilization=0.7, prefill_chunk_size=1024,
                     max_model_len=320),
-        # note: prefill_coalesce_s measured mixed here — +3% tok/s at
-        # c=32 but a c=16 regression with long TTFT tails — so the
-        # recorded sweep keeps it off (engine default)
         # isl is in WORDS (load_gen builds text); the test tokenizer
         # expands ~9 tokens/word, so 14 words ≈ 130 prompt tokens —
         # matching bench.py's 128/128 token workload under

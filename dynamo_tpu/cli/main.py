@@ -313,7 +313,6 @@ def _build_mm_preprocessor(args: Any, tokenizer, formatter, model_name: str):
     import json
 
     from dynamo_tpu.models.vision import VisionConfig, load_vision_params
-    from dynamo_tpu.multimodal import MultimodalPreprocessor, VisionEncoder
 
     with open(args.vision_config) as f:
         vcfg = VisionConfig.from_dict(json.load(f))
@@ -395,7 +394,6 @@ def _build_mm_preprocessor_from_checkpoint(
     import json
 
     from dynamo_tpu.models.vision import load_vision_hf
-    from dynamo_tpu.multimodal import MultimodalPreprocessor, VisionEncoder
 
     vcfg, vparams = load_vision_hf(args.model_path)
     with open(os.path.join(args.model_path, "config.json")) as f:

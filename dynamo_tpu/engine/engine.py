@@ -114,6 +114,13 @@ class JaxEngine:
         # step-failure quarantine (see _quarantine_step_failure)
         self._last_plan: Optional[StepPlan] = None
         self._step_failures = 0
+        try:
+            self.PIPELINE_DEPTH = max(
+                1, int(os.environ.get("DYN_PIPELINE_DEPTH", "2"))
+            )
+        except ValueError:
+            log.warning("ignoring malformed DYN_PIPELINE_DEPTH; using 2")
+            self.PIPELINE_DEPTH = 2
         self.kv_event_sink: Optional[Callable[[str, list[int], list[int]], None]] = None
 
     # ------------------------------------------------------------------
@@ -1474,8 +1481,9 @@ class JaxEngine:
     # in-flight windows: 2 hides the tunnel's per-window transfer
     # serialization behind compute (measured 705 -> 602 ms/window on
     # v5e; depth 3 adds nothing, depth 1 trades ~7% throughput for one
-    # window less first-token latency)
-    PIPELINE_DEPTH = max(1, int(os.environ.get("DYN_PIPELINE_DEPTH", "2")))
+    # window less first-token latency). Set via DYN_PIPELINE_DEPTH
+    # (read at engine construction; see __init__).
+    PIPELINE_DEPTH = 2
 
     def _window_pipeline(self, works: list, seqs: list) -> None:
         """THE serving loop: fused decode windows with optional prefill
@@ -1530,8 +1538,8 @@ class JaxEngine:
                 e = {"kind": "pure", "flat": out[1], "last": out[2],
                      "b": out[3]}
             else:
-                e = {"kind": "mixed", "flat": out[0], "last": out[1],
-                     "p_next": out[2], "b": out[3]}
+                e = {"kind": "mixed", "flat": out[1], "last": out[2],
+                     "p_next": out[3], "b": out[4]}
             e["works"] = works_
             e["seqs"] = seqs_
             e["vmap"] = dict(vmap)
@@ -1565,7 +1573,7 @@ class JaxEngine:
             pipelining = pipelining and not (
                 sampling_p.has_penalties or sampling_d.has_penalties
             )
-            out = self._dispatch_mixed(
+            out = ("mixed",) + self._dispatch_mixed(
                 works, seqs, p_arrays, d_arrays, sampling_p, sampling_d
             )
         else:
@@ -1631,7 +1639,7 @@ class JaxEngine:
                     [w.seq for w in nxt["works2"]],
                     self.config.mixed_prefill_rows,
                 )
-                out = self._dispatch_mixed(
+                out = ("mixed",) + self._dispatch_mixed(
                     nxt["works2"], nxt["seqs"], p2, nxt["arrays"],
                     s_p2, s_d2, tokens_dev=chained,
                 )

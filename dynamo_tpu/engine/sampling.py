@@ -235,8 +235,15 @@ def sample(
     """
     B, V = logits.shape
     rows = jnp.arange(B)[:, None]
-    # logit bias first (OpenAI: bias applies before sampling of any kind)
-    logits = logits.at[rows, s["bias_ids"]].add(s["bias_vals"])
+    # logit bias first (OpenAI: bias applies before sampling of any
+    # kind). Runtime-guarded: the scatter copies the whole [B, V]
+    # logits every step, and almost no request carries a bias.
+    logits = jax.lax.cond(
+        jnp.any(s["bias_vals"] != 0.0),
+        lambda l: l.at[rows, s["bias_ids"]].add(s["bias_vals"]),
+        lambda l: l,
+        logits,
+    )
     if "rep_pen" in s:
         if gen_dense is None:
             gen_dense = dense_gen_counts(s, V)
