@@ -260,6 +260,11 @@ class JaxEngine:
             sched.decode_batch_pad = next_bucket(
                 cfg.max_batch_size, Scheduler.BATCH_BUCKETS
             )
+            if sched.decode_batch_pad > 4:
+                # low-concurrency bucket: a lone stream decodes in a
+                # [4,1]-padded window (~10% lighter than the full pad)
+                # for a handful of extra prewarmed variants
+                sched.decode_batch_small = 4
             eff_len = (
                 cfg.max_model_len or self.model_config.max_position_embeddings
             )
@@ -482,80 +487,110 @@ class JaxEngine:
                     )
                     _, _, self.k_cache, self.v_cache = out
                     jax.block_until_ready(self.k_cache)
-        B = sched.decode_batch_pad or next_bucket(1, sched.BATCH_BUCKETS)
+        decode_buckets = sorted(
+            {b for b in (sched.decode_batch_small, sched.decode_batch_pad)
+             if b}
+        ) or [next_bucket(1, sched.BATCH_BUCKETS)]
+        B = decode_buckets[-1]
         if self.config.prewarm_penalties and self._multi_step_fn is not None:
             # opt-in: the penalty-table step variant (default: the
             # first penalties request pays a one-time compile instead)
-            a = decode_arrays(B)
-            packed, _, self.k_cache, self.v_cache = self._multi_step_fn(
-                self.params, self.k_cache, self.v_cache, a["tokens"],
-                a["positions"], a["block_tables"], a["context_lens"],
-                a["valid_steps"], sampling_for(B, penalties=True).arrays,
-            )
-            jax.block_until_ready(packed)
-        if self._multi_step_fn is None:
-            # single-step decode serving shape (decode_steps == 1)
-            a, s = decode_arrays(B), sampling_for(B)
-            _, _, self.k_cache, self.v_cache = self._step_fn(
-                self.params, self.k_cache, self.v_cache, a["tokens"],
-                a["positions"], a["slot_mapping"], a["block_tables"],
-                a["context_lens"], a["last_token_idx"], s.arrays,
-            )
-            jax.block_until_ready(self.k_cache)
-        last_tok = None
-        if self._multi_step_fn is not None:
-            a, s = decode_arrays(B), sampling_for(B)
-            packed, last_tok, self.k_cache, self.v_cache = self._multi_step_fn(
-                self.params, self.k_cache, self.v_cache, a["tokens"],
-                a["positions"], a["block_tables"], a["context_lens"],
-                a["valid_steps"], s.arrays,
-            )
-            # the pipelined path feeds the previous window's DEVICE
-            # token column — a committed device array is a different
-            # jit signature than host numpy, so warm that variant too
-            # (an unwarmed variant is a minutes-long mid-serve compile)
-            if self._chain_pure_fn is not None:
-                last_tok = self._chain_pure_fn(
-                    last_tok, np.zeros((B,), np.int32)
+            for Bd in decode_buckets:
+                a = decode_arrays(Bd)
+                packed, _, self.k_cache, self.v_cache = self._multi_step_fn(
+                    self.params, self.k_cache, self.v_cache, a["tokens"],
+                    a["positions"], a["block_tables"], a["context_lens"],
+                    a["valid_steps"], sampling_for(Bd, penalties=True).arrays,
                 )
-            packed, last_tok, self.k_cache, self.v_cache = self._multi_step_fn(
-                self.params, self.k_cache, self.v_cache, last_tok,
-                a["positions"], a["block_tables"], a["context_lens"],
-                a["valid_steps"], s.arrays,
-            )
-            jax.block_until_ready(packed)
+                jax.block_until_ready(packed)
+        if self._multi_step_fn is None:
+            # single-step decode serving shapes (decode_steps == 1)
+            for Bd in decode_buckets:
+                a, s = decode_arrays(Bd), sampling_for(Bd)
+                _, _, self.k_cache, self.v_cache = self._step_fn(
+                    self.params, self.k_cache, self.v_cache, a["tokens"],
+                    a["positions"], a["slot_mapping"], a["block_tables"],
+                    a["context_lens"], a["last_token_idx"], s.arrays,
+                )
+                jax.block_until_ready(self.k_cache)
+        lasts: dict[int, Any] = {}
+        p_nexts: dict[int, Any] = {}
+        if self._multi_step_fn is not None:
+            for Bd in decode_buckets:
+                a, s = decode_arrays(Bd), sampling_for(Bd)
+                packed, last_tok, self.k_cache, self.v_cache = (
+                    self._multi_step_fn(
+                        self.params, self.k_cache, self.v_cache, a["tokens"],
+                        a["positions"], a["block_tables"],
+                        a["context_lens"], a["valid_steps"], s.arrays,
+                    )
+                )
+                # the pipelined path feeds the previous window's DEVICE
+                # token column — a committed device array is a different
+                # jit signature than host numpy, so warm that variant
+                # too (an unwarmed variant is a mid-serve compile)
+                if self._chain_pure_fn is not None:
+                    last_tok = self._chain_pure_fn(
+                        last_tok, np.zeros((Bd,), np.int32)
+                    )
+                packed, last_tok, self.k_cache, self.v_cache = (
+                    self._multi_step_fn(
+                        self.params, self.k_cache, self.v_cache, last_tok,
+                        a["positions"], a["block_tables"],
+                        a["context_lens"], a["valid_steps"], s.arrays,
+                    )
+                )
+                jax.block_until_ready(packed)
+                lasts[Bd] = last_tok
         if (
             self._mixed_step_fn is not None
             and sched.mixed_prefill_rows > 0
         ):
             P, T = self.config.mixed_prefill_rows, self.config.mixed_prefill_len
             p = prefill_arrays(P, T)
-            d = decode_arrays(B)
-            sp, sd = sampling_for(P), sampling_for(B)
-            flat, m_last, p_next, self.k_cache, self.v_cache = (
-                self._mixed_step_fn(
-                    self.params, self.k_cache, self.v_cache,
-                    p["tokens"], p["positions"], p["slot_mapping"],
-                    p["block_tables"], p["context_lens"],
-                    p["last_token_idx"], sp.arrays,
-                    d["tokens"], d["positions"], d["block_tables"],
-                    d["context_lens"], d["valid_steps"], sd.arrays,
+            sp = sampling_for(P)
+            for Bd in decode_buckets:
+                d = decode_arrays(Bd)
+                sd = sampling_for(Bd)
+                flat, m_last, p_next, self.k_cache, self.v_cache = (
+                    self._mixed_step_fn(
+                        self.params, self.k_cache, self.v_cache,
+                        p["tokens"], p["positions"], p["slot_mapping"],
+                        p["block_tables"], p["context_lens"],
+                        p["last_token_idx"], sp.arrays,
+                        d["tokens"], d["positions"], d["block_tables"],
+                        d["context_lens"], d["valid_steps"], sd.arrays,
+                    )
                 )
-            )
-            assert self._chain_fn is not None
-            chained = self._chain_fn(m_last, p_next, np.zeros((B,), np.int32))
-            # chained-token mixed variant (pipelined mixed windows)
-            flat, m_last, p_next, self.k_cache, self.v_cache = (
-                self._mixed_step_fn(
-                    self.params, self.k_cache, self.v_cache,
-                    p["tokens"], p["positions"], p["slot_mapping"],
-                    p["block_tables"], p["context_lens"],
-                    p["last_token_idx"], sp.arrays,
-                    chained, d["positions"], d["block_tables"],
-                    d["context_lens"], d["valid_steps"], sd.arrays,
+                assert self._chain_fn is not None
+                chained = self._chain_fn(
+                    m_last, p_next, np.zeros((Bd,), np.int32)
                 )
-            )
-            jax.block_until_ready(flat)
+                # chained-token mixed variant (pipelined mixed windows)
+                flat, m_last, p_next, self.k_cache, self.v_cache = (
+                    self._mixed_step_fn(
+                        self.params, self.k_cache, self.v_cache,
+                        p["tokens"], p["positions"], p["slot_mapping"],
+                        p["block_tables"], p["context_lens"],
+                        p["last_token_idx"], sp.arrays,
+                        chained, d["positions"], d["block_tables"],
+                        d["context_lens"], d["valid_steps"], sd.arrays,
+                    )
+                )
+                jax.block_until_ready(flat)
+                lasts[Bd] = m_last
+                p_nexts[Bd] = p_next
+        if self._chain_pure_fn is not None:
+            # chain gathers across bucket TRANSITIONS (population
+            # crossing the small-bucket boundary mid-pipeline)
+            for b_from in decode_buckets:
+                for b_to in decode_buckets:
+                    if b_from == b_to or b_from not in lasts:
+                        continue
+                    idx = np.zeros((b_to,), np.int32)
+                    self._chain_pure_fn(lasts[b_from], idx)
+                    if b_from in p_nexts:
+                        self._chain_fn(lasts[b_from], p_nexts[b_from], idx)
         log.info("prewarm done in %.1fs", time.monotonic() - t0)
 
     def _auto_num_blocks(self, devices) -> int:

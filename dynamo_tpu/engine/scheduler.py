@@ -158,6 +158,10 @@ class Scheduler:
         # rows are ~free. Coarse prefill buckets bound that path's
         # variant count too.
         self.decode_batch_pad: Optional[int] = None
+        # optional SMALL decode bucket below the pad (e.g. 4): low
+        # concurrency decodes in a lighter window at the cost of a few
+        # extra prewarmed variants
+        self.decode_batch_small: Optional[int] = None
         self.table_width_pad: Optional[int] = None
         self.prefill_batch_buckets: list[int] = list(self.BATCH_BUCKETS)
         self.prefill_chunk_buckets: list[int] = list(self.CHUNK_BUCKETS)
@@ -641,6 +645,11 @@ class Scheduler:
         return w
 
     def _decode_batch(self, n: int) -> int:
+        if (
+            self.decode_batch_small is not None
+            and n <= self.decode_batch_small
+        ):
+            return self.decode_batch_small
         b = next_bucket(n, self.BATCH_BUCKETS)
         if self.decode_batch_pad is not None and b <= self.decode_batch_pad:
             return self.decode_batch_pad
