@@ -47,6 +47,10 @@ class SequenceState:
     jailed: str = ""
     completion_tokens: int = 0
     finish: Optional[FinishReason] = None
+    # logprobs requested: emit token chunks even when their text is
+    # empty (e.g. a final token decoding to "" before a stop fires) so
+    # every generated token's logprob entry reaches the client
+    want_logprobs: bool = False
 
     def step(self, token_ids: list[int]) -> tuple[str, Optional[FinishReason]]:
         """Feed engine token deltas; returns (text_to_emit, finish_reason)."""
@@ -122,6 +126,7 @@ class Backend(Operator):
             hidden_stop_ids=hidden,
             max_tokens=stop.max_tokens,
             min_tokens=stop.min_tokens,
+            want_logprobs=request.output.logprobs is not None,
         )
         return request, state
 
@@ -149,13 +154,22 @@ class Backend(Operator):
             kept_lps = (
                 item.log_probs[: len(kept_ids)] if item.log_probs else item.log_probs
             )
-            if text or item.finish_reason is None and finish is None:
+            kept_tops = (
+                item.top_logprobs[: len(kept_ids)]
+                if item.top_logprobs
+                else item.top_logprobs
+            )
+            if text or (state.want_logprobs and kept_ids) or (
+                item.finish_reason is None and finish is None
+            ):
                 yield LLMEngineOutput(
                     request_id=item.request_id,
                     token_ids=kept_ids,
                     text=text,
                     cum_log_probs=item.cum_log_probs,
                     log_probs=kept_lps,
+                    top_logprobs=kept_tops,
+                    index=item.index,
                 )
             if finish is not None:
                 # our stop fired first: tell the engine to stop generating

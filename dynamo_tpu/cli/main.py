@@ -304,7 +304,14 @@ def _wrap_pipeline(args: Any, core, eos_ids: list[int]):
     else:
         pre = OpenAIPreprocessor(tokenizer, formatter, model_name=model_name)
     backend = Backend(tokenizer, eos_token_ids=eos_ids)
-    return model_name, build_pipeline(pre, backend, core)
+    from dynamo_tpu.preprocessor.fanout import ChoiceFanout
+
+    # fanout sits between the preprocessor and the (backend -> engine)
+    # tail: n>1 becomes n single-choice engine streams, each with its
+    # own detokenizer/stop state, merged with choice indices
+    return model_name, build_pipeline(
+        pre, ChoiceFanout(build_pipeline(backend, core))
+    )
 
 
 def _build_mm_preprocessor(args: Any, tokenizer, formatter, model_name: str):

@@ -75,6 +75,11 @@ class EngineConfig:
     # never receive — the first penalties request then pays a one-time
     # compile stall instead.
     prewarm_penalties: bool = False
+    # likewise for the top-logprobs step variant (requests with
+    # top_logprobs > 0 / completions logprobs > 0). Off by default for
+    # the same startup-cost reason; the first such request pays a
+    # one-time compile stall instead.
+    prewarm_logprobs: bool = False
     # weights
     random_weights: bool = False  # bench/test mode: skip checkpoint load
     # weight-only quantization applied at load: None | "int8"
@@ -109,7 +114,9 @@ def load_engine_config(args: Any) -> EngineConfig:
         leader_addr=getattr(args, "leader_addr", ""),
         quantization=getattr(args, "quantization", None),
         decode_steps=getattr(args, "decode_steps", 1),
-        mixed_prefill_rows=getattr(args, "mixed_prefill_rows", 4),
+        mixed_prefill_rows=getattr(
+            args, "mixed_prefill_rows", EngineConfig.mixed_prefill_rows
+        ),
         mixed_prefill_len=getattr(args, "mixed_prefill_len", 256),
         host_kv_blocks=getattr(args, "host_kv_blocks", 0),
         disk_kv_blocks=getattr(args, "disk_kv_blocks", 0),

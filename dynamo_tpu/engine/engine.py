@@ -149,6 +149,8 @@ class JaxEngine:
         return engine
 
     def _initialize(self) -> None:
+        from dynamo_tpu.utils.jaxtools import enable_compile_cache
+
         cfg = self.config
         if cfg.num_nodes > 1:
             # multi-host bring-up (reference: MultiNodeConfig, engines.rs:41)
@@ -157,6 +159,9 @@ class JaxEngine:
                 num_processes=cfg.num_nodes,
                 process_id=cfg.node_rank,
             )
+        # after distributed init: probing the backend before it would
+        # break jax.distributed.initialize (must precede any XLA call)
+        enable_compile_cache()  # restarts reuse tunnel-compiled variants
         mesh_cfg = MeshConfig(
             dp=cfg.data_parallel_size,
             pp=cfg.pipeline_parallel_size,
@@ -419,7 +424,9 @@ class JaxEngine:
         t0 = time.monotonic()
         width = sched.table_width_pad or sched.TABLE_BUCKET
 
-        def sampling_for(n: int, penalties: bool = False) -> SamplingBatch:
+        def sampling_for(
+            n: int, penalties: bool = False, toplp: bool = False
+        ) -> SamplingBatch:
             opts = (
                 SamplingOptions(
                     temperature=1.0, frequency_penalty=0.1,
@@ -432,7 +439,13 @@ class JaxEngine:
                 [opts] * n, [0] * n,
                 [{} for _ in range(n)] if penalties else None,
                 [np.zeros((0,), np.int32)] * n if penalties else None,
+                [1] * n if toplp else None,
             )
+
+        # opt-in: also compile the top-logprobs output variants
+        tlp_variants = (
+            [False, True] if self.config.prewarm_logprobs else [False]
+        )
 
         def prefill_arrays(b: int, t: int) -> dict[str, np.ndarray]:
             return {
@@ -479,14 +492,17 @@ class JaxEngine:
                         and b * chunk > sched.max_prefill_tokens
                     ):
                         continue
-                    a, s = prefill_arrays(b, chunk), sampling_for(b)
-                    out = self._step_fn(
-                        self.params, self.k_cache, self.v_cache, a["tokens"],
-                        a["positions"], a["slot_mapping"], a["block_tables"],
-                        a["context_lens"], a["last_token_idx"], s.arrays,
-                    )
-                    _, _, self.k_cache, self.v_cache = out
-                    jax.block_until_ready(self.k_cache)
+                    for tv in tlp_variants:
+                        a = prefill_arrays(b, chunk)
+                        s = sampling_for(b, toplp=tv)
+                        out = self._step_fn(
+                            self.params, self.k_cache, self.v_cache,
+                            a["tokens"], a["positions"], a["slot_mapping"],
+                            a["block_tables"], a["context_lens"],
+                            a["last_token_idx"], s.arrays,
+                        )
+                        self.k_cache, self.v_cache = out[-2], out[-1]
+                        jax.block_until_ready(self.k_cache)
         decode_buckets = sorted(
             {b for b in (sched.decode_batch_small, sched.decode_batch_pad)
              if b}
@@ -494,29 +510,51 @@ class JaxEngine:
         B = decode_buckets[-1]
         if self.config.prewarm_penalties and self._multi_step_fn is not None:
             # opt-in: the penalty-table step variant (default: the
-            # first penalties request pays a one-time compile instead)
+            # first penalties request pays a one-time compile instead).
+            # With prewarm_logprobs also on, the penalties+top-logprobs
+            # COMBINED pytree is its own jit signature — warm it too.
             for Bd in decode_buckets:
-                a = decode_arrays(Bd)
-                packed, _, self.k_cache, self.v_cache = self._multi_step_fn(
-                    self.params, self.k_cache, self.v_cache, a["tokens"],
-                    a["positions"], a["block_tables"], a["context_lens"],
-                    a["valid_steps"], sampling_for(Bd, penalties=True).arrays,
-                )
-                jax.block_until_ready(packed)
+                for tv in tlp_variants:
+                    a = decode_arrays(Bd)
+                    packed, _, self.k_cache, self.v_cache = (
+                        self._multi_step_fn(
+                            self.params, self.k_cache, self.v_cache,
+                            a["tokens"], a["positions"], a["block_tables"],
+                            a["context_lens"], a["valid_steps"],
+                            sampling_for(Bd, penalties=True, toplp=tv).arrays,
+                        )
+                    )
+                    jax.block_until_ready(packed)
         if self._multi_step_fn is None:
             # single-step decode serving shapes (decode_steps == 1)
             for Bd in decode_buckets:
-                a, s = decode_arrays(Bd), sampling_for(Bd)
-                _, _, self.k_cache, self.v_cache = self._step_fn(
-                    self.params, self.k_cache, self.v_cache, a["tokens"],
-                    a["positions"], a["slot_mapping"], a["block_tables"],
-                    a["context_lens"], a["last_token_idx"], s.arrays,
-                )
-                jax.block_until_ready(self.k_cache)
+                for tv in tlp_variants:
+                    a = decode_arrays(Bd)
+                    s = sampling_for(Bd, toplp=tv)
+                    out = self._step_fn(
+                        self.params, self.k_cache, self.v_cache, a["tokens"],
+                        a["positions"], a["slot_mapping"], a["block_tables"],
+                        a["context_lens"], a["last_token_idx"], s.arrays,
+                    )
+                    self.k_cache, self.v_cache = out[-2], out[-1]
+                    jax.block_until_ready(self.k_cache)
         lasts: dict[int, Any] = {}
         p_nexts: dict[int, Any] = {}
         if self._multi_step_fn is not None:
             for Bd in decode_buckets:
+                for tv in [v for v in tlp_variants if v]:
+                    # top-lp window variant (unchained — that path
+                    # doesn't pipeline, so no chained-token warm needed)
+                    a = decode_arrays(Bd)
+                    s = sampling_for(Bd, toplp=True)
+                    packed, _lt, self.k_cache, self.v_cache = (
+                        self._multi_step_fn(
+                            self.params, self.k_cache, self.v_cache,
+                            a["tokens"], a["positions"], a["block_tables"],
+                            a["context_lens"], a["valid_steps"], s.arrays,
+                        )
+                    )
+                    jax.block_until_ready(packed)
                 a, s = decode_arrays(Bd), sampling_for(Bd)
                 packed, last_tok, self.k_cache, self.v_cache = (
                     self._multi_step_fn(
@@ -802,9 +840,12 @@ class JaxEngine:
                 block_size,
                 *mm_args,
             )
-            next_tokens, logprobs = sample(logits, sampling)
+            # sample() returns 2 outputs on the base path, 4 when the
+            # batch carries the top-logprobs marker (a separately-traced
+            # variant — the pytree structure differs)
+            s_out = sample(logits, sampling)
             new_k, new_v = pin_caches(new_k, new_v)
-            return next_tokens, logprobs, new_k, new_v
+            return (*s_out, new_k, new_v)
 
         # donate the caches: XLA aliases them in-place. One jitted fn
         # serves both arities (jit retraces per signature); the
@@ -832,8 +873,11 @@ class JaxEngine:
             K single steps exactly. When the batch carries penalty
             tables, a dense [B, V] generated-token count rides the scan
             carry and updates after every sampled token, so penalties
-            inside the window are exact too."""
+            inside the window are exact too. When it carries the
+            top-logprobs marker, each step's top-TOPLP_N alternatives
+            ride the packed output (ids exact in f32: vocab < 2^24)."""
             has_pen = "rep_pen" in sampling
+            has_tlp = "top_lp_n" in sampling
             B = tokens.shape[0]
             V = mc.vocab_size
             gen0 = dense_gen_counts(sampling, V) if has_pen else jnp.zeros((B, 1))
@@ -865,26 +909,35 @@ class JaxEngine:
                 )
                 s_i = dict(sampling)
                 s_i["seeds"] = sampling["seeds"] + i.astype(jnp.uint32)
-                nt, lp = sample(
+                s_res = sample(
                     logits, s_i,
                     gen if has_pen else None,
                     prompt_dense,
                 )
+                nt = s_res[0]
                 if has_pen:
                     gen = gen.at[jnp.arange(B), nt].add(1.0)
-                return (k_c, v_c, nt[:, None], pos + 1, ctx + 1, gen), (nt, lp)
+                return (k_c, v_c, nt[:, None], pos + 1, ctx + 1, gen), s_res
 
             carry = (k_cache, v_cache, tokens, positions, context_lens, gen0)
-            (k_cache, v_cache, last_tok, *_), (toks, lps) = jax.lax.scan(
+            (k_cache, v_cache, last_tok, *_), ys = jax.lax.scan(
                 body, carry, jnp.arange(K)
             )
+            toks, lps = ys[0], ys[1]
             # one packed host transfer per window (tokens are exact in
             # f32: vocab ids < 2^24), plus the device-resident last
             # token column for chaining the next window without a host
             # round trip
-            packed = jnp.concatenate(
-                [toks.T.astype(jnp.float32), lps.T], axis=1
-            )  # [B, 2K]
+            cols = [toks.T.astype(jnp.float32), lps.T]
+            if has_tlp:
+                # [K, B, N] -> [B, K*N]
+                tids, tlps = ys[2], ys[3]
+                N = tids.shape[-1]
+                cols.append(
+                    tids.transpose(1, 0, 2).reshape(B, K * N).astype(jnp.float32)
+                )
+                cols.append(tlps.transpose(1, 0, 2).reshape(B, K * N))
+            packed = jnp.concatenate(cols, axis=1)  # [B, 2K (+2KN)]
             k_cache, v_cache = pin_caches(k_cache, v_cache)
             last_tok = jax.lax.with_sharding_constraint(last_tok, ns_rep2)
             return packed, last_tok, k_cache, v_cache
@@ -922,6 +975,10 @@ class JaxEngine:
                 p_slot_mapping, p_block_tables, p_context_lens,
                 p_last_idx, bs,
             )
+            # top-logprobs batches never reach the mixed step (the
+            # window pipeline diverts them to dedicated prefill +
+            # pure windows — see _window_pipeline), so both sampling
+            # dicts here are 2-output variants
             p_next, p_lp = sample(p_logits, p_sampling)
             packed, last_tok, k_cache, v_cache = decode_window(
                 params, k_cache, v_cache, d_tokens, d_positions,
@@ -933,11 +990,9 @@ class JaxEngine:
             # sync cost. p_next additionally returns device-resident so
             # a pipelined next window can chain graduated prefills'
             # first tokens without a host hop.
-            flat = jnp.concatenate([
-                packed.reshape(-1),
-                p_next.astype(jnp.float32),
-                p_lp,
-            ])
+            flat = jnp.concatenate(
+                [packed.reshape(-1), p_next.astype(jnp.float32), p_lp]
+            )
             p_next = jax.lax.with_sharding_constraint(p_next, ns_rep1)
             return flat, last_tok, p_next, k_cache, v_cache
 
@@ -989,16 +1044,17 @@ class JaxEngine:
                 )
             self._mh_broadcast.announce_step(arrays, sampling)
         if "extra_embeds" in arrays:
-            next_tokens, logprobs, self.k_cache, self.v_cache = self._step_fn_mm(
+            out = self._step_fn_mm(
                 *base_args, arrays["extra_embeds"], arrays["embeds_mask"]
             )
         else:
-            next_tokens, logprobs, self.k_cache, self.v_cache = self._step_fn(
-                *base_args
-            )
+            out = self._step_fn(*base_args)
+        self.k_cache, self.v_cache = out[-2], out[-1]
         from dynamo_tpu.parallel.multihost import host_value
 
-        return host_value(next_tokens), host_value(logprobs)
+        # (next_tokens, logprobs) base; (+ top_ids, top_lps) on the
+        # top-logprobs variant
+        return tuple(host_value(x) for x in out[:-2])
 
     # ------------------------------------------------------------------
     # Engine thread loop
@@ -1316,25 +1372,34 @@ class JaxEngine:
             return
 
         t0 = time.monotonic()
-        next_tokens, logprobs = self._run_device_step(arrays, sampling)
+        s_out = self._run_device_step(arrays, sampling)
+        next_tokens, logprobs = s_out[0], s_out[1]
+        tops = s_out[2:] if len(s_out) > 2 else None
         self._trace(
             "dispatch_" + plan.kind,
             shape=arrays["tokens"].shape,
             ms=round((time.monotonic() - t0) * 1e3, 1),
         )
 
+        def top_row(i):
+            return (tops[0][i], tops[1][i]) if tops is not None else None
+
         if plan.kind == "prefill":
             for i, work in enumerate(plan.prefill_batch):
                 sched.complete_prefill_chunk(work)
                 if work.is_last_chunk:
                     self._emit_token(
-                        work.seq, int(next_tokens[i]), float(logprobs[i])
+                        work.seq, int(next_tokens[i]), float(logprobs[i]),
+                        top=top_row(i),
                     )
         else:
             for i, seq in enumerate(seqs):
                 if seq.state != SeqState.RUNNING:
                     continue
-                self._emit_token(seq, int(next_tokens[i]), float(logprobs[i]))
+                self._emit_token(
+                    seq, int(next_tokens[i]), float(logprobs[i]),
+                    top=top_row(i),
+                )
 
     def _batch_sampling(
         self, seqs: list, B: int, offset=0
@@ -1368,7 +1433,14 @@ class JaxEngine:
             gen_counts += [{} for _ in range(pad)]
             prompt_ids += [np.zeros((0,), np.int32)] * pad
         opts += [opts[-1]] * pad
-        return SamplingBatch.from_options(opts, seeds, gen_counts, prompt_ids)
+        top_lp = None
+        if self._wants_toplp(seqs):
+            top_lp = [
+                (s.request.output.logprobs or 0) for s in seqs
+            ] + [0] * pad
+        return SamplingBatch.from_options(
+            opts, seeds, gen_counts, prompt_ids, top_lp
+        )
 
     def _dispatch_multi_step(
         self,
@@ -1397,13 +1469,34 @@ class JaxEngine:
         return packed, last_tok
 
     @staticmethod
-    def _unpack_window(packed_host: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        K = packed_host.shape[1] // 2
-        return packed_host[:, :K].astype(np.int32), packed_host[:, K:]
+    def _unpack_window(
+        packed_host: np.ndarray, toplp: bool = False
+    ) -> tuple[np.ndarray, ...]:
+        """Split a window's packed [B, cols] output: (toks [B,K],
+        lps [B,K]) base; with ``toplp`` additionally (top_ids [B,K,N]
+        i32, top_lps [B,K,N]) — layout set by decode_window."""
+        from dynamo_tpu.engine.sampling import TOPLP_N
+
+        B = packed_host.shape[0]
+        if not toplp:
+            K = packed_host.shape[1] // 2
+            return packed_host[:, :K].astype(np.int32), packed_host[:, K:]
+        K = packed_host.shape[1] // (2 + 2 * TOPLP_N)
+        toks = packed_host[:, :K].astype(np.int32)
+        lps = packed_host[:, K : 2 * K]
+        tids = packed_host[:, 2 * K : 2 * K + K * TOPLP_N].reshape(
+            B, K, TOPLP_N
+        ).astype(np.int32)
+        tlps = packed_host[:, 2 * K + K * TOPLP_N :].reshape(B, K, TOPLP_N)
+        return toks, lps, tids, tlps
+
+    @staticmethod
+    def _wants_toplp(seqs: list) -> bool:
+        return any((s.request.output.logprobs or 0) > 0 for s in seqs)
 
     def _run_multi_step(self, arrays: dict[str, np.ndarray], sampling: SamplingBatch):
         packed, _ = self._dispatch_multi_step(arrays, sampling)
-        return self._unpack_window(np.asarray(packed))
+        return self._unpack_window(np.asarray(packed), sampling.has_toplp)
 
     def _pad_prefill_rect(
         self, arrays: dict[str, np.ndarray], P: int, T: int, width: int
@@ -1483,9 +1576,16 @@ class JaxEngine:
         return flat, last_tok, p_next, d_arrays["tokens"].shape[0]
 
     def _emit_mixed(self, works: list, seqs: list, flat_h, B: int) -> None:
-        """Sync-side bookkeeping of one mixed window's flat output."""
+        """Sync-side bookkeeping of one mixed window's flat output.
+        Mixed windows never carry the top-logprobs variant (the window
+        pipeline diverts toplp batches to dedicated prefill + pure
+        windows), so the flat layout is always the base one."""
         sched = self.scheduler
         assert sched is not None
+        assert not (
+            self._wants_toplp(seqs)
+            or self._wants_toplp([w.seq for w in works])
+        ), "top-logprobs batch reached the mixed step"
         K = sched.decode_lookahead
         P = self.config.mixed_prefill_rows
         tok_m, lp_m = self._unpack_window(
@@ -1549,9 +1649,15 @@ class JaxEngine:
         lag: dict[int, int] = {}
 
         def penalties_in(ws: list, ss: list) -> bool:
-            return any(
-                w.seq.request.sampling.needs_penalties for w in ws
-            ) or any(s.request.sampling.needs_penalties for s in ss)
+            # penalties AND top-logprobs both flush/block the pipeline:
+            # their windows carry extra state/outputs that the chained
+            # dispatch path doesn't thread
+            return (
+                any(w.seq.request.sampling.needs_penalties for w in ws)
+                or any(s.request.sampling.needs_penalties for s in ss)
+                or self._wants_toplp([w.seq for w in ws])
+                or self._wants_toplp(ss)
+            )
 
         def add_lag(entry) -> None:
             for sid, v in entry["vmap"].items():
@@ -1586,18 +1692,34 @@ class JaxEngine:
         # dispatch the first window
         if works:
             p_arrays = sched.build_prefill_batch_arrays(works)
-            if "extra_embeds" in p_arrays:
+            # Multimodal chunks AND top-logprobs batches take a
+            # dedicated prefill step instead of the mixed rectangle:
+            # embedding injection doesn't ride the fixed rectangle, and
+            # the mixed toplp jit variant is deliberately NOT part of
+            # the prewarm set (prewarm_logprobs covers dedicated
+            # prefill + pure windows; an unwarmed variant is a
+            # multi-minute mid-serve compile over a chip tunnel).
+            # Decode follows on the next plan.
+            if "extra_embeds" in p_arrays or (
+                self._wants_toplp([w.seq for w in works])
+                or self._wants_toplp(seqs)
+            ):
                 sampling = self._batch_sampling(
                     [w.seq for w in works], p_arrays["tokens"].shape[0]
                 )
-                next_tokens, logprobs = self._run_device_step(
-                    p_arrays, sampling
-                )
+                s_out = self._run_device_step(p_arrays, sampling)
+                next_tokens, logprobs = s_out[0], s_out[1]
                 for i, work in enumerate(works):
                     sched.complete_prefill_chunk(work)
                     if work.is_last_chunk:
+                        top = (
+                            (s_out[2][i], s_out[3][i])
+                            if len(s_out) > 2
+                            else None
+                        )
                         self._emit_token(
-                            work.seq, int(next_tokens[i]), float(logprobs[i])
+                            work.seq, int(next_tokens[i]), float(logprobs[i]),
+                            top=top,
                         )
                 return
             d_arrays = sched.build_decode_arrays(seqs)
@@ -1607,6 +1729,7 @@ class JaxEngine:
             sampling_d = self._batch_sampling(seqs, d_arrays["tokens"].shape[0])
             pipelining = pipelining and not (
                 sampling_p.has_penalties or sampling_d.has_penalties
+                or sampling_p.has_toplp or sampling_d.has_toplp
             )
             out = ("mixed",) + self._dispatch_mixed(
                 works, seqs, p_arrays, d_arrays, sampling_p, sampling_d
@@ -1614,7 +1737,9 @@ class JaxEngine:
         else:
             d_arrays = sched.build_decode_arrays(seqs)
             sampling_d = self._batch_sampling(seqs, d_arrays["tokens"].shape[0])
-            pipelining = pipelining and not sampling_d.has_penalties
+            pipelining = pipelining and not (
+                sampling_d.has_penalties or sampling_d.has_toplp
+            )
             out = ("pure",) + self._dispatch_multi_step(d_arrays, sampling_d) \
                 + (d_arrays["tokens"].shape[0],)
         vmap0 = {
@@ -1631,9 +1756,11 @@ class JaxEngine:
                     e["works"], e["seqs"], host_value(e["flat"]), e["b"]
                 )
             else:
-                tok_m, lp_m = self._unpack_window(host_value(e["flat"]))
+                tlp = self._wants_toplp(e["seqs"])
+                win = self._unpack_window(host_value(e["flat"]), tlp)
                 for i, seq in enumerate(e["seqs"]):
-                    self._emit_window(seq, tok_m[i], lp_m[i])
+                    tops = (win[2][i], win[3][i]) if tlp else None
+                    self._emit_window(seq, win[0][i], win[1][i], tops=tops)
             sub_lag(e)
             self._trace(
                 "window", kind=e["kind"], b=len(e["seqs"]),
@@ -1714,31 +1841,49 @@ class JaxEngine:
                     emit_entry(pending.popleft())
                 return
 
-    def _emit_token(self, seq: Sequence, token: int, logprob: float) -> None:
+    @staticmethod
+    def _top_entry(seq: Sequence, ids, lps) -> dict[int, float]:
+        """One token's top-logprob alternatives, trimmed to the count
+        the request asked for ({} when this seq only wants the chosen
+        logprob but rode a top-lp batch)."""
+        k = seq.request.output.logprobs or 0
+        return {int(i): float(l) for i, l in zip(ids[:k], lps[:k])}
+
+    def _emit_token(
+        self, seq: Sequence, token: int, logprob: float, top=None
+    ) -> None:
         sched = self.scheduler
         assert sched is not None
         sched.append_token(seq, token)
         if seq.emit is not None:
+            tl = None
+            if top is not None and (seq.request.output.logprobs or 0) > 0:
+                tl = [self._top_entry(seq, top[0], top[1])]
             seq.emit(
                 LLMEngineOutput(
                     request_id=seq.request_id,
                     token_ids=[token],
                     log_probs=[logprob],
+                    top_logprobs=tl,
                 )
             )
         reason = sched.should_finish(seq)
         if reason is not None:
             sched.finish(seq, reason)
 
-    def _emit_window(self, seq: Sequence, tokens, logprobs) -> None:
+    def _emit_window(self, seq: Sequence, tokens, logprobs, tops=None) -> None:
         """Append a fused-decode window's tokens, stopping at the first
         finish condition (the rest of the window is discarded), and emit
         ONE output carrying all kept tokens — the backend consumes
-        multi-token deltas, so there's no per-token queue hop."""
+        multi-token deltas, so there's no per-token queue hop.
+        ``tops`` = (top_ids [K, N], top_lps [K, N]) on the top-logprobs
+        variant."""
         sched = self.scheduler
         assert sched is not None
         kept_toks: list[int] = []
         kept_lps: list[float] = []
+        kept_tops: list[dict[int, float]] = []
+        want_tl = tops is not None and (seq.request.output.logprobs or 0) > 0
         finish: Optional[FinishReason] = None
         for j in range(len(tokens)):
             if seq.state != SeqState.RUNNING:
@@ -1746,6 +1891,8 @@ class JaxEngine:
             sched.append_token(seq, int(tokens[j]))
             kept_toks.append(int(tokens[j]))
             kept_lps.append(float(logprobs[j]))
+            if want_tl:
+                kept_tops.append(self._top_entry(seq, tops[0][j], tops[1][j]))
             finish = sched.should_finish(seq)
             if finish is not None:
                 break
@@ -1755,6 +1902,7 @@ class JaxEngine:
                     request_id=seq.request_id,
                     token_ids=kept_toks,
                     log_probs=kept_lps,
+                    top_logprobs=kept_tops if want_tl else None,
                 )
             )
         if finish is not None:

@@ -35,14 +35,25 @@ import jax.numpy as jnp
 import numpy as np
 
 from dynamo_tpu.protocols.common import SamplingOptions
-from dynamo_tpu.utils.bucketing import next_bucket
 
 NEG_INF = -1e30
 
-# sparse-table width buckets (per-batch max, rounded up — a handful of
-# compile variants, only for requests that actually use the feature)
-BIAS_BUCKETS = [4, 16, 64, 512]
-COUNT_BUCKETS = [64, 256, 1024, 4096]
+# Sparse tables are pinned to ONE width each (not bucketed): a width
+# change is a new jit signature, and a mid-serve AOT compile over a
+# chip tunnel is a multi-minute TTFT stall (ADVICE r3: the bucketed
+# widths were reachable by any logit_bias request with >4 entries).
+# BIAS_W covers OpenAI's 300-entry logit_bias cap outright; COUNT_W
+# truncates penalty token-count tables at 4096 distinct ids (documented
+# bound — beyond it the least-recently-sorted ids stop contributing).
+BIAS_W = 512
+COUNT_W = 4096
+# top-logprob alternatives returned by the "top_lp" step variant
+# (OpenAI caps top_logprobs at 20)
+TOPLP_N = 20
+
+# Back-compat aliases (tests/benchmarks referenced the bucket lists)
+BIAS_BUCKETS = [BIAS_W]
+COUNT_BUCKETS = [COUNT_W]
 
 
 @dataclass
@@ -77,6 +88,10 @@ class SamplingBatch:
     def has_penalties(self) -> bool:
         return "rep_pen" in self.arrays
 
+    @property
+    def has_toplp(self) -> bool:
+        return "top_lp_n" in self.arrays
+
     @classmethod
     def from_options(
         cls,
@@ -84,10 +99,14 @@ class SamplingBatch:
         step_seeds: list[int],
         gen_token_counts: Optional[list[dict[int, int]]] = None,
         prompt_token_ids: Optional[list[np.ndarray]] = None,
+        top_lp: Optional[list[int]] = None,
     ) -> "SamplingBatch":
         """``gen_token_counts``/``prompt_token_ids`` (parallel to opts)
         supply the per-sequence token state the penalty path needs; they
-        may be None when no option in the batch needs penalties."""
+        may be None when no option in the batch needs penalties.
+        ``top_lp`` (per-slot requested alternative counts, any > 0)
+        selects the top-logprobs step variant: sample() additionally
+        returns the TOPLP_N most likely ids + logprobs per slot."""
         n = len(opts)
         a: dict[str, np.ndarray] = {
             "temperature": np.zeros((n,), np.float32),
@@ -107,20 +126,23 @@ class SamplingBatch:
                 a["top_p"][i] = o.top_p
             if o.min_p:
                 a["min_p"][i] = o.min_p
-        # sparse logit bias (base path; all-zeros rows are no-ops)
-        nb = next_bucket(
-            max((len(o.logit_bias or {}) for o in opts), default=0) or 1,
-            BIAS_BUCKETS,
-        )
-        a["bias_ids"] = np.zeros((n, nb), np.int32)
-        a["bias_vals"] = np.zeros((n, nb), np.float32)
+        # sparse logit bias (base path; all-zeros rows are no-ops).
+        # Fixed BIAS_W width: one compiled shape (OpenAI caps logit_bias
+        # at 300 entries, so nothing real ever truncates).
+        a["bias_ids"] = np.zeros((n, BIAS_W), np.int32)
+        a["bias_vals"] = np.zeros((n, BIAS_W), np.float32)
         for i, o in enumerate(opts):
-            for j, (tok, v) in enumerate(sorted((o.logit_bias or {}).items())):
+            items = sorted((o.logit_bias or {}).items())[:BIAS_W]
+            for j, (tok, v) in enumerate(items):
                 a["bias_ids"][i, j] = tok
                 a["bias_vals"][i, j] = v
         if any(o.needs_penalties for o in opts):
             a.update(
                 cls._penalty_arrays(opts, gen_token_counts, prompt_token_ids)
+            )
+        if top_lp is not None and any(k > 0 for k in top_lp):
+            a["top_lp_n"] = np.asarray(
+                [min(max(k, 0), TOPLP_N) for k in top_lp], np.int32
             )
         return cls(a)
 
@@ -147,24 +169,18 @@ class SamplingBatch:
                 a["pres_pen"][i] = o.presence_penalty
             if o.repetition_penalty:
                 a["rep_pen"][i] = o.repetition_penalty
-        np_w = next_bucket(
-            max((len(c) for c in gen_token_counts), default=0) or 1,
-            COUNT_BUCKETS,
-        )
-        nr_w = next_bucket(
-            max((len(p) for p in prompt_token_ids), default=0) or 1,
-            COUNT_BUCKETS,
-        )
-        a["gen_ids"] = np.zeros((n, np_w), np.int32)
-        a["gen_counts"] = np.zeros((n, np_w), np.float32)
-        a["prompt_ids"] = np.zeros((n, nr_w), np.int32)
-        a["prompt_counts"] = np.zeros((n, nr_w), np.float32)
+        # fixed COUNT_W width (one compiled penalty variant — see the
+        # BIAS_W/COUNT_W note at the top of the module)
+        a["gen_ids"] = np.zeros((n, COUNT_W), np.int32)
+        a["gen_counts"] = np.zeros((n, COUNT_W), np.float32)
+        a["prompt_ids"] = np.zeros((n, COUNT_W), np.int32)
+        a["prompt_counts"] = np.zeros((n, COUNT_W), np.float32)
         for i, counts in enumerate(gen_token_counts):
-            for j, (tok, c) in enumerate(sorted(counts.items())[:np_w]):
+            for j, (tok, c) in enumerate(sorted(counts.items())[:COUNT_W]):
                 a["gen_ids"][i, j] = tok
                 a["gen_counts"][i, j] = c
         for i, toks in enumerate(prompt_token_ids):
-            t = np.asarray(toks, np.int32)[:nr_w]
+            t = np.asarray(toks, np.int32)[:COUNT_W]
             a["prompt_ids"][i, : len(t)] = t
             a["prompt_counts"][i, : len(t)] = 1.0
         return a
@@ -225,8 +241,12 @@ def sample(
     s: dict,  # SamplingBatch.arrays (device-side pytree)
     gen_dense: Optional[jax.Array] = None,  # [B, V] carried counts
     prompt_dense: Optional[jax.Array] = None,
-) -> tuple[jax.Array, jax.Array]:
-    """Returns (next_tokens [B] i32, logprobs_of_chosen [B] f32).
+) -> tuple[jax.Array, ...]:
+    """Returns (next_tokens [B] i32, logprobs_of_chosen [B] f32); when
+    ``s`` carries the "top_lp_n" marker (top-logprobs step variant),
+    additionally (top_ids [B, TOPLP_N] i32, top_lps [B, TOPLP_N] f32) —
+    the most likely alternatives of the SAME post-bias/penalty
+    distribution the chosen logprob is measured on.
 
     The penalty tables (``gen_dense``/``prompt_dense``) are passed
     explicitly by fused-window callers so the carry survives across
@@ -315,6 +335,15 @@ def sample(
     )
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     chosen_lp = jnp.take_along_axis(logprobs, next_tok[:, None], axis=-1)[:, 0]
+    if "top_lp_n" in s:
+        top_lps, top_ids = jax.lax.top_k(logprobs, min(TOPLP_N, V))
+        if top_ids.shape[-1] < TOPLP_N:  # tiny test vocabs
+            pad = TOPLP_N - top_ids.shape[-1]
+            top_ids = jnp.pad(top_ids, ((0, 0), (0, pad)))
+            top_lps = jnp.pad(
+                top_lps, ((0, 0), (0, pad)), constant_values=NEG_INF
+            )
+        return next_tok, chosen_lp, top_ids.astype(jnp.int32), top_lps
     return next_tok, chosen_lp
 
 

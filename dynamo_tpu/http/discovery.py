@@ -146,7 +146,11 @@ class ModelWatcher:
             log.warning("model %s: no chat template in card artifacts", entry.name)
         pre = OpenAIPreprocessor(tokenizer, formatter, model_name=entry.name)
         backend = Backend(tokenizer, eos_token_ids=card.model_info.eos_token_ids)
-        pipeline = build_pipeline(pre, backend, router)
+        from dynamo_tpu.preprocessor.fanout import ChoiceFanout
+
+        pipeline = build_pipeline(
+            pre, ChoiceFanout(build_pipeline(backend, router))
+        )
 
         if entry.model_type in ("chat", "chat_completion"):
             self.manager.add_chat_model(entry.name, pipeline)

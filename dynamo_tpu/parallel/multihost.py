@@ -37,6 +37,7 @@ import numpy as np
 # sampling dict, so followers can allocate matching broadcast buffers
 CTRL_LEN = 16
 FLAG_PENALTIES = 1  # sampling dict carries the penalty tables
+FLAG_TOPLP = 2  # sampling dict carries the top-logprobs marker
 
 # fixed key order for broadcasting SamplingBatch.arrays as a tuple
 SAMPLING_BASE_KEYS = (
@@ -154,39 +155,51 @@ class StepBroadcaster:
 def _fill_sampling_desc(ctrl: np.ndarray, off: int, s: dict) -> None:
     """Write a sampling dict's structure descriptor (flags + sparse
     table widths) into ctrl[off:off+4]."""
-    ctrl[off] = FLAG_PENALTIES if "rep_pen" in s else 0
+    ctrl[off] = (FLAG_PENALTIES if "rep_pen" in s else 0) | (
+        FLAG_TOPLP if "top_lp_n" in s else 0
+    )
     ctrl[off + 1] = s["bias_ids"].shape[1]
     if "rep_pen" in s:
         ctrl[off + 2] = s["gen_ids"].shape[1]
         ctrl[off + 3] = s["prompt_ids"].shape[1]
 
 
-def _sampling_keys(has_pen: bool) -> tuple:
-    return SAMPLING_BASE_KEYS + (SAMPLING_PEN_KEYS if has_pen else ())
+def _sampling_keys(has_pen: bool, has_tlp: bool = False) -> tuple:
+    # the top_lp_n marker key selects the top-logprobs jit variant;
+    # omitting it on followers would trace a DIFFERENT program than the
+    # leader's (divergent collectives across hosts)
+    return (
+        SAMPLING_BASE_KEYS
+        + (SAMPLING_PEN_KEYS if has_pen else ())
+        + ((("top_lp_n", np.int32),) if has_tlp else ())
+    )
 
 
 def _sampling_tuple(sampling) -> tuple:
     s = sampling.arrays
     return tuple(
-        np.asarray(s[k], dt) for k, dt in _sampling_keys("rep_pen" in s)
+        np.asarray(s[k], dt)
+        for k, dt in _sampling_keys("rep_pen" in s, "top_lp_n" in s)
     )
 
 
 def _zeros_sampling(b: int, flags: int, nb: int, ng: int, nr: int) -> tuple:
     has_pen = bool(flags & FLAG_PENALTIES)
+    has_tlp = bool(flags & FLAG_TOPLP)
     widths = {"bias_ids": nb, "bias_vals": nb, "gen_ids": ng,
               "gen_counts": ng, "prompt_ids": nr, "prompt_counts": nr}
     return tuple(
         np.zeros((b, widths[k]) if k in widths else (b,), dt)
-        for k, dt in _sampling_keys(has_pen)
+        for k, dt in _sampling_keys(has_pen, has_tlp)
     )
 
 
 def _sampling_dict(args: tuple, flags: int) -> dict:
     has_pen = bool(flags & FLAG_PENALTIES)
+    has_tlp = bool(flags & FLAG_TOPLP)
     return {
         k: np.asarray(v)
-        for (k, _), v in zip(_sampling_keys(has_pen), args)
+        for (k, _), v in zip(_sampling_keys(has_pen, has_tlp), args)
     }
 
 
@@ -614,10 +627,11 @@ class StepFollower:
                 args = self._bcast(_zeros_step(b, t, w, flags, nb, ng, nr))
                 tokens, positions, slots, tables, ctx, last = args[:6]
                 s = _sampling_dict(args[6:], flags)
-                _, _, e.k_cache, e.v_cache = e._step_fn(
+                out = e._step_fn(
                     e.params, e.k_cache, e.v_cache, tokens, positions,
                     slots, tables, ctx, last, s,
                 )
+                e.k_cache, e.v_cache = out[-2], out[-1]
             elif kind == KIND_MULTI_STEP:
                 args = self._bcast(
                     _zeros_multi_step(b, w, flags, nb, ng, nr)

@@ -1,0 +1,99 @@
+"""ChoiceFanout: OpenAI ``n>1`` as N engine sequences.
+
+The reference's protocol layer carries ``n`` through to its engines
+(reference: lib/llm/src/protocols/common.rs SamplingOptions.n); here the
+fan-out happens above the engine: one PreprocessedRequest becomes N
+single-choice requests sharing the prompt (the engine's prefix cache
+makes the marginal cost of each extra choice one decode row — the
+prompt's KV blocks are content-addressed and reused across choices).
+Outputs merge into one stream with each item tagged by choice ``index``.
+
+Seeds: choice j samples with seed+j when the request pins a seed
+(distinct streams, reproducible); unseeded requests get distinct
+request-id-derived streams for free (the engine hashes request_id).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
+
+
+class _ChoiceContext(Context):
+    """Per-choice context: isolated stop (one choice hitting its stop
+    condition must NOT cancel its siblings — the Backend calls
+    stop_generating() on ITS stream's context) while still observing
+    the parent's cancellation (client disconnect kills all choices)."""
+
+    def __init__(self, parent: Context):
+        super().__init__(id=parent.id)
+        self._parent = parent
+
+    @property
+    def is_stopped(self) -> bool:
+        return self._stop.is_set() or self._parent.is_stopped
+
+    @property
+    def is_killed(self) -> bool:
+        return self._kill.is_set() or self._parent.is_killed
+
+
+class ChoiceFanout(AsyncEngine):
+    """Wraps an AsyncEngine consuming PreprocessedRequest; fans n>1 out."""
+
+    def __init__(self, inner: AsyncEngine):
+        self.inner = inner
+
+    def generate(self, request: Any, context: Context) -> EngineStream:
+        if not isinstance(request, PreprocessedRequest):
+            request = PreprocessedRequest.model_validate(request)
+        if request.sampling.n <= 1:
+            return self.inner.generate(request, context)
+        return self._fan(request, context)
+
+    async def _fan(
+        self, request: PreprocessedRequest, context: Context
+    ) -> AsyncIterator[Any]:
+        n = request.sampling.n
+        queue: asyncio.Queue = asyncio.Queue()
+        _DONE = object()
+
+        async def pump(j: int) -> None:
+            sub = request.model_copy(deep=True)
+            sub.request_id = f"{request.request_id}-c{j}"
+            sub.sampling.n = 1
+            if sub.sampling.seed is not None:
+                sub.sampling.seed = sub.sampling.seed + j
+            try:
+                async for item in self.inner.generate(
+                    sub, _ChoiceContext(context)
+                ):
+                    if not isinstance(item, LLMEngineOutput):
+                        item = LLMEngineOutput.model_validate(item)
+                    item.index = j
+                    # restore the parent id: choices belong to ONE
+                    # completion object upstream
+                    item.request_id = request.request_id
+                    await queue.put(item)
+            except BaseException as exc:  # propagate to the merger
+                await queue.put(exc)
+            finally:
+                await queue.put(_DONE)
+
+        tasks = [asyncio.create_task(pump(j)) for j in range(n)]
+        done = 0
+        try:
+            while done < n:
+                item = await queue.get()
+                if item is _DONE:
+                    done += 1
+                    continue
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            for t in tasks:
+                t.cancel()

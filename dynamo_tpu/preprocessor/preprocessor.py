@@ -34,6 +34,7 @@ class _ReqState:
     prompt_tokens: int
     include_usage: bool
     logprobs: bool
+    n: int = 1  # choices (ChoiceFanout tags items with their index)
 
 
 class OpenAIPreprocessor(Operator):
@@ -123,8 +124,77 @@ class OpenAIPreprocessor(Operator):
             prompt_tokens=len(pre.token_ids),
             include_usage=include_usage,
             logprobs=pre.output.logprobs is not None,
+            n=pre.sampling.n,
         )
         return pre, state
+
+    # -- logprob payload construction -------------------------------------
+    def _token_str(self, tid: int) -> str:
+        return self.tokenizer.decode([tid], skip_special_tokens=False)
+
+    def _chat_logprobs(self, item: LLMEngineOutput) -> Optional[dict]:
+        """OpenAI chat logprobs content for one delta
+        (reference: lib/llm/src/protocols/common.rs:323-372)."""
+        if not item.token_ids or not item.log_probs:
+            return None
+        entries = []
+        for k, tid in enumerate(item.token_ids):
+            tstr = self._token_str(tid)
+            tops = (
+                item.top_logprobs[k]
+                if item.top_logprobs and k < len(item.top_logprobs)
+                else {}
+            )
+            alts = []
+            for alt, lp in tops.items():
+                astr = self._token_str(alt)
+                alts.append(
+                    {
+                        "token": astr,
+                        "logprob": lp,
+                        "bytes": list(astr.encode("utf-8")),
+                    }
+                )
+            entries.append(
+                {
+                    "token": tstr,
+                    "logprob": item.log_probs[k],
+                    "bytes": list(tstr.encode("utf-8")),
+                    "top_logprobs": alts,
+                }
+            )
+        return {"content": entries}
+
+    def _completion_logprobs(
+        self, item: LLMEngineOutput, char_off: int
+    ) -> tuple[Optional[dict], int]:
+        """Legacy completions logprobs object for one delta; returns
+        (payload, advanced char offset)."""
+        if not item.token_ids or not item.log_probs:
+            return None, char_off
+        toks, offs, tops = [], [], []
+        for k, tid in enumerate(item.token_ids):
+            tstr = self._token_str(tid)
+            toks.append(tstr)
+            offs.append(char_off)
+            char_off += len(tstr)
+            t = (
+                item.top_logprobs[k]
+                if item.top_logprobs and k < len(item.top_logprobs)
+                else None
+            )
+            tops.append(
+                {self._token_str(a): lp for a, lp in t.items()}
+                if t
+                else None
+            )
+        payload = {
+            "tokens": toks,
+            "token_logprobs": list(item.log_probs),
+            "top_logprobs": tops if any(t is not None for t in tops) else None,
+            "text_offset": offs,
+        }
+        return payload, char_off
 
     async def backward(
         self,
@@ -132,33 +202,60 @@ class OpenAIPreprocessor(Operator):
         state: _ReqState,
         context: Context,
     ) -> AsyncIterator[Any]:
-        """Map the Backend's text-delta stream into OpenAI chunk objects."""
+        """Map the Backend's text-delta stream into OpenAI chunk objects.
+
+        Handles n>1 (ChoiceFanout tags items with their choice index):
+        per-choice deltas/finish chunks; ONE trailing usage chunk after
+        every choice has finished, completion tokens summed across
+        choices (prompt counted once, OpenAI semantics)."""
         if state.kind == "chat":
             gen = ChatDeltaGenerator(model=state.model, request_id=state.request_id)
         else:
             gen = CompletionDeltaGenerator(model=state.model, request_id=state.request_id)
-        completion_tokens = 0
+        completion_tokens: dict[int, int] = {}
+        char_offsets: dict[int, int] = {}
+        finished: set[int] = set()
+        total_completion = 0
         async for raw in stream:
             item = (
                 raw
                 if isinstance(raw, LLMEngineOutput)
                 else LLMEngineOutput.model_validate(raw)
             )
-            completion_tokens += len(item.token_ids)
-            if item.text:
-                yield gen.text_chunk(item.text)
+            idx = item.index
+            completion_tokens[idx] = completion_tokens.get(idx, 0) + len(
+                item.token_ids
+            )
+            lp_payload = None
+            if state.logprobs:
+                if state.kind == "chat":
+                    lp_payload = self._chat_logprobs(item)
+                else:
+                    lp_payload, char_offsets[idx] = self._completion_logprobs(
+                        item, char_offsets.get(idx, 0)
+                    )
+            if item.text or lp_payload:
+                yield gen.text_chunk(
+                    item.text or "", index=idx, logprobs=lp_payload
+                )
             if item.finish_reason is not None:
-                yield gen.finish_chunk(item.finish_reason)
+                yield gen.finish_chunk(item.finish_reason, index=idx)
+                finished.add(idx)
+                total_completion += (
+                    item.completion_tokens or completion_tokens.get(idx, 0)
+                )
+                if len(finished) < state.n:
+                    continue
                 if state.include_usage:
                     # OpenAI semantics: usage rides a trailing chunk with
                     # an empty choices array (stream_options.include_usage);
                     # the non-streaming aggregators pick it up from there
-                    ct = item.completion_tokens or completion_tokens
                     yield gen.usage_chunk(
                         Usage(
                             prompt_tokens=state.prompt_tokens,
-                            completion_tokens=ct,
-                            total_tokens=state.prompt_tokens + ct,
+                            completion_tokens=total_completion,
+                            total_tokens=state.prompt_tokens
+                            + total_completion,
                         )
                     )
                 return

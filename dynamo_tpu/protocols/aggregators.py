@@ -29,6 +29,7 @@ class ChatAggregator:
         self._texts: dict[int, list[str]] = {}
         self._roles: dict[int, str] = {}
         self._finish: dict[int, str | None] = {}
+        self._logprobs: dict[int, list] = {}
         self._usage: Usage | None = None
 
     def push(self, chunk: ChatCompletionChunk) -> None:
@@ -43,6 +44,10 @@ class ChatAggregator:
                 self._roles[idx] = choice.delta.role
             if choice.delta.content:
                 self._texts.setdefault(idx, []).append(choice.delta.content)
+            if choice.logprobs and choice.logprobs.get("content"):
+                self._logprobs.setdefault(idx, []).extend(
+                    choice.logprobs["content"]
+                )
             if choice.finish_reason is not None:
                 self._finish[idx] = choice.finish_reason
 
@@ -56,6 +61,11 @@ class ChatAggregator:
                     content="".join(self._texts.get(i, [])),
                 ),
                 finish_reason=self._finish.get(i),
+                logprobs=(
+                    {"content": self._logprobs[i]}
+                    if i in self._logprobs
+                    else None
+                ),
             )
             for i in indices
         ]
@@ -91,6 +101,7 @@ class CompletionAggregator:
         self._created: int = 0
         self._texts: dict[int, list[str]] = {}
         self._finish: dict[int, str | None] = {}
+        self._logprobs: dict[int, dict] = {}
         self._usage: Usage | None = None
 
     def push(self, chunk: CompletionResponse) -> None:
@@ -102,6 +113,22 @@ class CompletionAggregator:
         for choice in chunk.choices:
             if choice.text:
                 self._texts.setdefault(choice.index, []).append(choice.text)
+            if choice.logprobs:
+                # legacy format: parallel lists — concatenate across deltas
+                acc = self._logprobs.setdefault(
+                    choice.index,
+                    {"tokens": [], "token_logprobs": [], "top_logprobs": [],
+                     "text_offset": []},
+                )
+                lp = choice.logprobs
+                acc["tokens"].extend(lp.get("tokens") or [])
+                acc["token_logprobs"].extend(lp.get("token_logprobs") or [])
+                tl = lp.get("top_logprobs")
+                acc["top_logprobs"].extend(
+                    tl if tl is not None
+                    else [None] * len(lp.get("tokens") or [])
+                )
+                acc["text_offset"].extend(lp.get("text_offset") or [])
             if choice.finish_reason is not None:
                 self._finish[choice.index] = choice.finish_reason
 
@@ -112,6 +139,7 @@ class CompletionAggregator:
                 index=i,
                 text="".join(self._texts.get(i, [])),
                 finish_reason=self._finish.get(i),
+                logprobs=self._logprobs.get(i),
             )
             for i in indices
         ]

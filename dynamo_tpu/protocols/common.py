@@ -11,7 +11,7 @@ these types, which keeps every engine frontend-agnostic.
 from __future__ import annotations
 
 import enum
-from typing import Any, Optional
+from typing import Any, ClassVar, Optional
 
 from pydantic import BaseModel, Field
 
@@ -55,12 +55,22 @@ class SamplingOptions(BaseModel):
             )
         )
 
+    # On-device sampling shapes the distribution on the top-TOP_K_CAP
+    # logit slice (a full 128k-vocab sort costs ~50 ms/step on v5e —
+    # engine/sampling.py). top_k above the cap is clamped here, at the
+    # request boundary, so the behavior is documented rather than a
+    # silent truncation (ADVICE r3: sampling.py top-128 bound).
+    TOP_K_CAP: ClassVar[int] = 128
+
     def normalized(self) -> "SamplingOptions":
-        """Resolve greedy mode: temperature<=0 means greedy decoding."""
+        """Resolve greedy mode: temperature<=0 means greedy decoding.
+        Clamps top_k to TOP_K_CAP (see note above)."""
         s = self.model_copy()
         if s.temperature is not None and s.temperature <= 0.0:
             s.use_greedy = True
             s.temperature = None
+        if s.top_k is not None and s.top_k > self.TOP_K_CAP:
+            s.top_k = self.TOP_K_CAP
         return s
 
 
@@ -132,6 +142,14 @@ class LLMEngineOutput(BaseModel):
     text: Optional[str] = None
     cum_log_probs: Optional[float] = None
     log_probs: Optional[list[float]] = None
+    # Parallel to token_ids when the request asked for top_logprobs:
+    # each entry maps alternative token id -> logprob (top-N slice of
+    # the same post-bias/penalty distribution log_probs comes from).
+    # Reference: lib/llm/src/protocols/common.rs:323-372 TopLogprob.
+    top_logprobs: Optional[list[dict[int, float]]] = None
+    # Choice index for n>1 fan-out (preprocessor fans a request into n
+    # engine sequences; chunks carry their choice index back upstream)
+    index: int = 0
     finish_reason: Optional[FinishReason] = None
     # Engine metrics piggybacked on the final chunk
     prompt_tokens: Optional[int] = None

@@ -69,6 +69,17 @@ def _validate_logit_bias(v: Optional[dict[str, float]]):
     return v
 
 
+# n>1 fans out as N engine sequences sharing the prompt's prefix-cache
+# blocks; the cap bounds one request's batch-slot footprint.
+MAX_N_CHOICES = 16
+
+
+def _validate_n(v: Optional[int]):
+    if v is not None and not (1 <= v <= MAX_N_CHOICES):
+        raise ValueError(f"n must be between 1 and {MAX_N_CHOICES}")
+    return v
+
+
 # ---------------------------------------------------------------------------
 # Chat completions
 # ---------------------------------------------------------------------------
@@ -126,6 +137,16 @@ class ChatCompletionRequest(BaseModel):
     nvext: Optional[ExtOptions] = None
 
     _check_logit_bias = field_validator("logit_bias")(_validate_logit_bias)
+    _check_n = field_validator("n")(_validate_n)
+
+    @field_validator("top_logprobs")
+    @classmethod
+    def _check_top_logprobs(cls, v, info):
+        if v is not None and not (0 <= v <= 20):
+            raise ValueError("top_logprobs must be between 0 and 20")
+        if v and not info.data.get("logprobs"):
+            raise ValueError("top_logprobs requires logprobs=true")
+        return v
 
     def extension(self) -> ExtOptions:
         return self.ext or self.nvext or ExtOptions()
@@ -237,6 +258,15 @@ class CompletionRequest(BaseModel):
     nvext: Optional[ExtOptions] = None
 
     _check_logit_bias = field_validator("logit_bias")(_validate_logit_bias)
+    _check_n = field_validator("n")(_validate_n)
+
+    @field_validator("logprobs")
+    @classmethod
+    def _check_logprobs(cls, v):
+        # legacy completions API: logprobs is the alternative count
+        if v is not None and not (0 <= v <= 20):
+            raise ValueError("logprobs must be between 0 and 20")
+        return v
 
     def extension(self) -> ExtOptions:
         return self.ext or self.nvext or ExtOptions()
@@ -318,29 +348,42 @@ class ChatDeltaGenerator:
         self.id = request_id or f"chatcmpl-{uuid.uuid4().hex}"
         self.model = model
         self.created = _now()
-        self._first = True
+        # choice indices that have emitted their role delta (n>1: every
+        # choice's first chunk carries role="assistant")
+        self._started: set[int] = set()
 
-    def role_chunk(self) -> ChatCompletionChunk:
-        self._first = False
+    def role_chunk(self, index: int = 0) -> ChatCompletionChunk:
+        self._started.add(index)
         return ChatCompletionChunk(
             id=self.id,
             created=self.created,
             model=self.model,
             choices=[
-                ChatCompletionChunkChoice(delta=ChatDelta(role="assistant", content=""))
+                ChatCompletionChunkChoice(
+                    index=index, delta=ChatDelta(role="assistant", content="")
+                )
             ],
         )
 
-    def text_chunk(self, text: str, index: int = 0) -> ChatCompletionChunk:
+    def text_chunk(
+        self,
+        text: str,
+        index: int = 0,
+        logprobs: Optional[dict[str, Any]] = None,
+    ) -> ChatCompletionChunk:
         delta = ChatDelta(content=text)
-        if self._first:
+        if index not in self._started:
             delta.role = "assistant"
-            self._first = False
+            self._started.add(index)
         return ChatCompletionChunk(
             id=self.id,
             created=self.created,
             model=self.model,
-            choices=[ChatCompletionChunkChoice(index=index, delta=delta)],
+            choices=[
+                ChatCompletionChunkChoice(
+                    index=index, delta=delta, logprobs=logprobs
+                )
+            ],
         )
 
     def finish_chunk(
@@ -375,12 +418,19 @@ class CompletionDeltaGenerator:
         self.model = model
         self.created = _now()
 
-    def text_chunk(self, text: str, index: int = 0) -> CompletionResponse:
+    def text_chunk(
+        self,
+        text: str,
+        index: int = 0,
+        logprobs: Optional[dict[str, Any]] = None,
+    ) -> CompletionResponse:
         return CompletionResponse(
             id=self.id,
             created=self.created,
             model=self.model,
-            choices=[CompletionChoice(index=index, text=text)],
+            choices=[
+                CompletionChoice(index=index, text=text, logprobs=logprobs)
+            ],
         )
 
     def finish_chunk(
