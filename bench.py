@@ -31,10 +31,11 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 # v5e datasheet HBM bandwidth. Kept as the roofline denominator for
-# cross-round comparability, but note: a raw bf16 weight-streaming
-# probe on this environment's tunneled chip measures ~165 GB/s
-# achievable, so vs_baseline ≈ 0.20 here corresponds to ~full
-# memory-bandwidth utilization of the hardware as actually reachable.
+# cross-round comparability. Practical context (BASELINE.md round-2
+# revision): an amortized weight-streaming probe on this environment's
+# tunneled chip reaches ~400 GB/s, so the practically-achievable
+# roofline is ~half the datasheet number — vs_baseline ≈ 0.5 would be
+# full practical-bandwidth utilization here.
 HBM_BW_BYTES = 819e9
 
 
@@ -77,6 +78,9 @@ def _build_config(cpu_mode: bool):
     workload["batch"] = int(os.environ.get("DYN_BENCH_BATCH", workload["batch"]))
     workload["isl"] = int(os.environ.get("DYN_BENCH_ISL", workload["isl"]))
     workload["osl"] = int(os.environ.get("DYN_BENCH_OSL", workload["osl"]))
+    workload["block_size"] = int(
+        os.environ.get("DYN_BENCH_BLOCK_SIZE", workload["block_size"])
+    )
     return model, workload
 
 

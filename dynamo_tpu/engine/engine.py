@@ -1095,6 +1095,24 @@ class JaxEngine:
 
         while self._running:
             self._drain_incoming()
+            if (
+                not self.scheduler.running
+                and not self.scheduler.prefilling
+                and len(self.scheduler.waiting) >= 2
+            ):
+                # an arrival BURST onto an idle engine: the submitter is
+                # still enqueueing (e.g. a gather of N requests, or an
+                # HTTP cohort) — planning now would split the burst
+                # across prefill steps and desynchronize the decode
+                # population for its whole lifetime. Wait out the burst
+                # while it is still growing (bounded: ~16 ms worst case
+                # vs a multi-hundred-ms prefill dispatch saved).
+                for _ in range(8):
+                    before = len(self.scheduler.waiting)
+                    time.sleep(0.002)
+                    self._drain_incoming()
+                    if len(self.scheduler.waiting) == before:
+                        break
             if not self.scheduler.has_work:
                 # idle: drain the offload queue (and run the pump's
                 # periodic G4 index refresh) before sleeping
@@ -1649,12 +1667,14 @@ class JaxEngine:
         lag: dict[int, int] = {}
 
         def penalties_in(ws: list, ss: list) -> bool:
-            # penalties AND top-logprobs both flush/block the pipeline:
-            # their windows carry extra state/outputs that the chained
-            # dispatch path doesn't thread
+            # penalties, top-logprobs and logit-bias all flush/block the
+            # pipeline: their windows run separately-compiled variants
+            # whose chained-dispatch signatures aren't prewarmed
             return (
                 any(w.seq.request.sampling.needs_penalties for w in ws)
                 or any(s.request.sampling.needs_penalties for s in ss)
+                or any(w.seq.request.sampling.logit_bias for w in ws)
+                or any(s.request.sampling.logit_bias for s in ss)
                 or self._wants_toplp([w.seq for w in ws])
                 or self._wants_toplp(ss)
             )
@@ -1730,6 +1750,7 @@ class JaxEngine:
             pipelining = pipelining and not (
                 sampling_p.has_penalties or sampling_d.has_penalties
                 or sampling_p.has_toplp or sampling_d.has_toplp
+                or sampling_p.has_bias or sampling_d.has_bias
             )
             out = ("mixed",) + self._dispatch_mixed(
                 works, seqs, p_arrays, d_arrays, sampling_p, sampling_d
@@ -1739,6 +1760,7 @@ class JaxEngine:
             sampling_d = self._batch_sampling(seqs, d_arrays["tokens"].shape[0])
             pipelining = pipelining and not (
                 sampling_d.has_penalties or sampling_d.has_toplp
+                or sampling_d.has_bias
             )
             out = ("pure",) + self._dispatch_multi_step(d_arrays, sampling_d) \
                 + (d_arrays["tokens"].shape[0],)
@@ -1926,21 +1948,30 @@ class JaxEngine:
         have caused it instead of killing every in-flight stream
         (VERDICT r2 weak #6: one poisoned request must not fail all).
 
-        Heuristic: a failure in a step that was PREFILLING new requests
-        is attributed to those requests — their data is the new input;
-        the decode sequences' host state is untouched (emission happens
-        after the device sync, which never completed) so they retry
-        cleanly on the next step. Repeated failures (or failures in
-        pure-decode steps, where no single culprit is identifiable)
-        fall back to _fail_all. Returns True when contained."""
+        Heuristic: the FIRST failure is retried outright — host state is
+        untouched (emission happens after the device sync, which never
+        completed), so a transient fault (device hiccup, allocator
+        pressure) costs one replanned step instead of innocent requests'
+        lives (ADVICE r3: don't terminate requests on transient faults).
+        A repeat failure in a step that was PREFILLING new requests is
+        attributed to those requests — their data is the new input.
+        Further repeats (or repeat failures in pure-decode steps, where
+        no single culprit is identifiable) fall back to _fail_all.
+        Returns True when contained."""
         sched = self.scheduler
         plan = self._last_plan
         self._last_plan = None
+        if self._step_failures == 1 and sched is not None and plan is not None:
+            log.exception(
+                "engine step failed (kind=%s); retrying once before "
+                "quarantining", plan.kind,
+            )
+            return True
         if (
             sched is None
             or plan is None
             or not plan.prefill_batch
-            or self._step_failures > 2
+            or self._step_failures > 3
         ):
             return False
         ids = [w.seq.request_id for w in plan.prefill_batch]

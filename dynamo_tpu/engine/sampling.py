@@ -64,8 +64,11 @@ class SamplingBatch:
 
     base keys (always present):
       temperature [B] f32 (0 = greedy), top_k [B] i32 (0 = off),
-      top_p [B] f32 (1 = off), min_p [B] f32 (0 = off), seeds [B] u32,
-      bias_ids [B, NB] i32, bias_vals [B, NB] f32 (padded id 0 / val 0)
+      top_p [B] f32 (1 = off), min_p [B] f32 (0 = off), seeds [B] u32
+
+    bias keys (only when a request in the batch carries logit_bias —
+    presence selects the bias jit variant):
+      bias_ids [B, BIAS_W] i32, bias_vals [B, BIAS_W] f32 (pad id 0/0)
 
     penalty keys (only when a request in the batch uses them — selects
     the penalty-variant compiled step):
@@ -87,6 +90,10 @@ class SamplingBatch:
     @property
     def has_penalties(self) -> bool:
         return "rep_pen" in self.arrays
+
+    @property
+    def has_bias(self) -> bool:
+        return "bias_ids" in self.arrays
 
     @property
     def has_toplp(self) -> bool:
@@ -126,16 +133,19 @@ class SamplingBatch:
                 a["top_p"][i] = o.top_p
             if o.min_p:
                 a["min_p"][i] = o.min_p
-        # sparse logit bias (base path; all-zeros rows are no-ops).
-        # Fixed BIAS_W width: one compiled shape (OpenAI caps logit_bias
-        # at 300 entries, so nothing real ever truncates).
-        a["bias_ids"] = np.zeros((n, BIAS_W), np.int32)
-        a["bias_vals"] = np.zeros((n, BIAS_W), np.float32)
-        for i, o in enumerate(opts):
-            items = sorted((o.logit_bias or {}).items())[:BIAS_W]
-            for j, (tok, v) in enumerate(items):
-                a["bias_ids"][i, j] = tok
-                a["bias_vals"][i, j] = v
+        # sparse logit bias: PRESENCE-KEYED like the penalty tables —
+        # batches with no bias (approximately all of them) ship nothing
+        # and select the bias-free jit variant; bias batches carry one
+        # fixed BIAS_W width (OpenAI caps logit_bias at 300 entries, so
+        # nothing real ever truncates, and one width = one signature).
+        if any(o.logit_bias for o in opts):
+            a["bias_ids"] = np.zeros((n, BIAS_W), np.int32)
+            a["bias_vals"] = np.zeros((n, BIAS_W), np.float32)
+            for i, o in enumerate(opts):
+                items = sorted((o.logit_bias or {}).items())[:BIAS_W]
+                for j, (tok, v) in enumerate(items):
+                    a["bias_ids"][i, j] = tok
+                    a["bias_vals"][i, j] = v
         if any(o.needs_penalties for o in opts):
             a.update(
                 cls._penalty_arrays(opts, gen_token_counts, prompt_token_ids)
@@ -256,14 +266,10 @@ def sample(
     B, V = logits.shape
     rows = jnp.arange(B)[:, None]
     # logit bias first (OpenAI: bias applies before sampling of any
-    # kind). Runtime-guarded: the scatter copies the whole [B, V]
-    # logits every step, and almost no request carries a bias.
-    logits = jax.lax.cond(
-        jnp.any(s["bias_vals"] != 0.0),
-        lambda l: l.at[rows, s["bias_ids"]].add(s["bias_vals"]),
-        lambda l: l,
-        logits,
-    )
+    # kind). Presence-keyed: bias-free batches (the common case) select
+    # a variant without the scatter at all.
+    if "bias_ids" in s:
+        logits = logits.at[rows, s["bias_ids"]].add(s["bias_vals"])
     if "rep_pen" in s:
         if gen_dense is None:
             gen_dense = dense_gen_counts(s, V)
@@ -354,8 +360,9 @@ def reference_sample_numpy(
     bias + penalties + filtering masks (no RNG; used by parity tests to
     check the device pipeline's distribution shaping)."""
     x = logits.astype(np.float64).copy()
-    for tok, v in zip(s["bias_ids"][row], s["bias_vals"][row]):
-        x[int(tok)] += float(v)
+    if "bias_ids" in s:
+        for tok, v in zip(s["bias_ids"][row], s["bias_vals"][row]):
+            x[int(tok)] += float(v)
     if "rep_pen" in s:
         gen = np.zeros_like(x)
         for tok, c in zip(s["gen_ids"][row], s["gen_counts"][row]):

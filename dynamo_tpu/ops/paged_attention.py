@@ -48,21 +48,33 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _decode_kernel(
+def _decode_kernel_stacked(
+    layer_ref,  # scalar prefetch: [1] int32 — layer to read
     tables_ref,  # scalar prefetch: [B, W] int32
     ctx_ref,  # scalar prefetch: [B] int32
     q_ref,  # [1, H, Dh]
-    k_ref,  # [1, bs, Hk, Dh] — page j of the sequence
-    v_ref,  # [1, bs, Hk, Dh]
+    k_ref,  # [1, 1, bs, Hk, Dh] — page j of the sequence, layer layer_ref[0]
+    v_ref,
     o_ref,  # [1, H, Dh]
-    acc_ref,  # VMEM scratch [H, Dh] f32
-    m_ref,  # VMEM scratch [H, 1] f32
-    l_ref,  # VMEM scratch [H, 1] f32
+    acc_ref,
+    m_ref,
+    l_ref,
     *,
     block_size: int,
     scale: float,
     window: Optional[int],
 ):
+    """THE flash-decode kernel body, over a stacked cache
+    [L, N, bs, Hk, Dh] with the layer as a scalar-prefetch index (the
+    per-layer API wraps it with L=1). Rationale for layer indexing in
+    the BlockSpec: slicing one layer out of the carried cache before a
+    pallas_call materializes a full-layer copy at the custom-call
+    boundary (XLA cannot fuse a producer slice into a custom call) —
+    measured ~11 ms/step at a 4.7 GB cache, scaling linearly with cache
+    size. Indexing here keeps per-step HBM traffic at just the
+    referenced pages. GQA groups query heads over their shared KV head
+    via unrolled per-KV-head matmuls (Mosaic has no batched dot_general
+    with differing batch positions; Hk is small and static)."""
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -73,21 +85,19 @@ def _decode_kernel(
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     ctx = ctx_ref[b]
-    # first key position a decode query (at position ctx-1) may attend to
     lo = jnp.int32(0) if window is None else jnp.maximum(ctx - window, 0)
     page_live = (j * block_size < ctx) & ((j + 1) * block_size > lo)
 
     @pl.when(page_live)
     def _page():
         H, Dh = q_ref.shape[1], q_ref.shape[2]
-        bs, Hk = k_ref.shape[1], k_ref.shape[2]
+        bs, Hk = k_ref.shape[2], k_ref.shape[3]
         G = H // Hk
-        q = q_ref[0].astype(jnp.float32)  # [H, Dh]
-        k = k_ref[0].astype(jnp.float32)  # [bs, Hk, Dh]
-        v = v_ref[0].astype(jnp.float32)
-        # GQA: group query heads over their shared KV head. Unrolled
-        # per-KV-head matmuls — Mosaic has no batched dot_general with
-        # differing batch positions, and Hk is small and static.
+        # storage dtype straight into the MXU (bf16 operands, f32
+        # accumulation) — f32 upcasts double VMEM for nothing
+        q = q_ref[0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
         qg = q.reshape(Hk, G, Dh)
         s = jnp.concatenate(
             [
@@ -98,11 +108,11 @@ def _decode_kernel(
                 for hk in range(Hk)
             ],
             axis=0,
-        ) * scale  # [H, bs]
+        ) * scale
         pos = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_size), 1
         )
-        valid = (pos < ctx) & (pos >= lo)  # [1, bs]
+        valid = (pos < ctx) & (pos >= lo)
         s = jnp.where(valid, s, -1e30)
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -110,7 +120,7 @@ def _decode_kernel(
         p = jnp.where(valid, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pg = p.reshape(Hk, G, bs)
+        pg = p.astype(v.dtype).reshape(Hk, G, bs)
         pv = jnp.concatenate(
             [
                 jax.lax.dot_general(
@@ -120,16 +130,300 @@ def _decode_kernel(
                 for hk in range(Hk)
             ],
             axis=0,
-        )  # [H, Dh]
+        )
         acc_ref[:] = acc_ref[:] * alpha + pv
         m_ref[:] = m_new
 
     @pl.when(j == pl.num_programs(1) - 1)
     def _finish():
-        # padded batch rows have ctx == 0 -> l == 0; clamp instead of NaN
         o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-9)).astype(
             o_ref.dtype
         )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_size", "sliding_window", "interpret")
+)
+def paged_attention_decode_stacked(
+    q: jax.Array,  # [B, H, Dh]
+    k_cache: jax.Array,  # [L, n_slots, Hkv, Dh] — the FULL stacked cache
+    v_cache: jax.Array,
+    layer_idx: jax.Array,  # scalar int32 — layer to attend over
+    block_tables: jax.Array,  # [B, W] int32
+    context_lens: jax.Array,  # [B] int32
+    block_size: int,
+    sliding_window: Optional[int] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Decode attention over layer ``layer_idx`` of the stacked cache.
+
+    Equivalent to ``paged_attention_decode(q, k_cache[layer_idx], ...)``
+    but WITHOUT materializing the layer slice (see
+    _decode_kernel_stacked). This is the hot decode path the engine's
+    layer scan uses: the cache stays a scan carry and only referenced
+    pages move."""
+    B, H, Dh = q.shape
+    L, S, Hk, _ = k_cache.shape
+    N = S // block_size
+    W = block_tables.shape[1]
+    scale = 1.0 / math.sqrt(Dh)
+
+    # leading-dim split: layout-preserving (free) on TPU
+    kp = k_cache.reshape(L, N, block_size, Hk, Dh)
+    vp = v_cache.reshape(L, N, block_size, Hk, Dh)
+    layer_arr = jnp.asarray(layer_idx, jnp.int32).reshape(1)
+
+    def kv_index(b, j, lyr, t, c):
+        last = jnp.maximum((c[b] - 1) // block_size, 0)
+        jj = jnp.minimum(j, last)
+        if sliding_window is not None:
+            first = jnp.clip((c[b] - sliding_window) // block_size, 0, last)
+            jj = jnp.maximum(jj, first)
+        return (lyr[0], t[b, jj], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # layer, block_tables, context_lens
+        grid=(B, W),
+        in_specs=[
+            pl.BlockSpec((1, H, Dh), lambda b, j, lyr, t, c: (b, 0, 0)),
+            pl.BlockSpec((1, 1, block_size, Hk, Dh), kv_index),
+            pl.BlockSpec((1, 1, block_size, Hk, Dh), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, H, Dh), lambda b, j, lyr, t, c: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, Dh), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _decode_kernel_stacked, block_size=block_size, scale=scale,
+            window=sliding_window,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Dh), q.dtype),
+        interpret=interpret,
+    )(layer_arr, block_tables, context_lens, q, kp, vp)
+
+
+def _prefill_kernel_stacked(
+    layer_ref,   # scalar prefetch: [1] int32
+    starts_ref,  # scalar prefetch: [B] int32 — first query position per row
+    tables_ref,  # scalar prefetch: [B, W] int32
+    ctx_ref,     # scalar prefetch: [B] int32 (context incl. this chunk)
+    q_ref,   # [1, 1, Tq, H, Dh] — query tile qi of row b
+    k_ref,   # [1, 1, bs, Hk, Dh] — page j, layer layer_ref[0]
+    v_ref,
+    o_ref,   # [1, 1, Tq, H, Dh]
+    acc_ref,  # VMEM scratch [Hk*G*Tq, Dh] f32 (hk-major row order)
+    m_ref,    # VMEM scratch [Hk*G*Tq, 1] f32
+    l_ref,    # VMEM scratch [Hk*G*Tq, 1] f32
+    *,
+    block_size: int,
+    tq: int,
+    scale: float,
+    window: Optional[int],
+):
+    """Flash prefill over the paged cache: one query TILE of ``tq``
+    tokens vs one KV page per grid step, causal (+ sliding window)
+    masked, online-softmax state in VMEM across the page axis. The
+    chunk's own K/V are read back from the cache (the caller scatters
+    them in before attending), so chunked long prompts attend their
+    full prefix without any [T, S] score materialization — the XLA
+    reference path's [B, Hk, G, T, S] scores tensor is ~400 MB at
+    T=1024/S=3072 and its HBM traffic dominates long-prompt TTFT."""
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    ctx = ctx_ref[b]
+    start = starts_ref[b]
+    # query positions covered by this tile
+    q_lo = start + qi * tq
+    q_hi_excl = jnp.minimum(start + (qi + 1) * tq, ctx)
+    # keys this tile may attend: [lo_bound, q_hi_excl)
+    lo_bound = (
+        jnp.int32(0) if window is None
+        else jnp.maximum(q_lo - (window - 1), 0)
+    )
+    page_live = (
+        (j * block_size < q_hi_excl)
+        & ((j + 1) * block_size > lo_bound)
+        & (q_lo < ctx)
+    )
+
+    @pl.when(page_live)
+    def _page():
+        Tq, H, Dh = q_ref.shape[2], q_ref.shape[3], q_ref.shape[4]
+        bs, Hk = k_ref.shape[2], k_ref.shape[3]
+        G = H // Hk
+        # keep q/k/v in their storage dtype (bf16 in serving): the MXU
+        # takes bf16 operands natively with f32 accumulation, and f32
+        # upcasts would double the kernel's VMEM footprint (scoped-vmem
+        # OOM at block_size=128 geometries)
+        q = q_ref[0, 0]  # [Tq, H, Dh]
+        k = k_ref[0, 0]  # [bs, Hk, Dh]
+        v = v_ref[0, 0]
+        # hk-major rows: [Hk, Tq*G, Dh] -> flat [Hk*Tq*G, Dh]
+        qg = q.reshape(Tq, Hk, G, Dh).swapaxes(0, 1).reshape(Hk, Tq * G, Dh)
+        s = jnp.concatenate(
+            [
+                jax.lax.dot_general(
+                    qg[hk], k[:, hk, :], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                for hk in range(Hk)
+            ],
+            axis=0,
+        ) * scale  # [Hk*Tq*G, bs] f32
+        key_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, bs), 1
+        )  # [1, bs]
+        # per-row query position: row r = (hk, t, g) -> q token t
+        t_idx = (
+            jax.lax.broadcasted_iota(jnp.int32, (Hk * Tq * G, 1), 0)
+            // G % Tq
+        )
+        q_pos = q_lo + t_idx  # [rows, 1]
+        valid = (key_pos <= q_pos) & (key_pos < ctx) & (q_pos < ctx)
+        if window is not None:
+            valid = valid & (key_pos > q_pos - window)
+        s = jnp.where(valid, s, -1e30)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(valid, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # p in the value dtype for the MXU (standard flash practice; the
+        # softmax stats above stay f32)
+        pg = p.astype(v.dtype).reshape(Hk, Tq * G, bs)
+        pv = jnp.concatenate(
+            [
+                jax.lax.dot_general(
+                    pg[hk], v[:, hk, :], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                for hk in range(Hk)
+            ],
+            axis=0,
+        )  # [Hk*Tq*G, Dh]
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = m_new
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finish():
+        Tq, H, Dh = q_ref.shape[2], q_ref.shape[3], q_ref.shape[4]
+        Hk = k_ref.shape[3]
+        G = H // Hk
+        # rows with no valid key (padded rows/tokens): clamp, not NaN
+        out = acc_ref[:] / jnp.maximum(l_ref[:], 1e-9)
+        out = out.reshape(Hk, Tq, G, Dh).swapaxes(0, 1).reshape(Tq, H, Dh)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_size", "sliding_window", "interpret"),
+)
+def paged_attention_prefill_stacked(
+    q: jax.Array,  # [B, T, H, Dh] — a (possibly chunked) prefill rectangle
+    k_cache: jax.Array,  # [L, n_slots, Hkv, Dh] stacked cache
+    v_cache: jax.Array,
+    layer_idx: jax.Array,  # scalar int32
+    block_tables: jax.Array,  # [B, W] int32
+    start_pos: jax.Array,  # [B] int32 — absolute position of q[:, 0]
+    context_lens: jax.Array,  # [B] int32 — total context incl. this chunk
+    block_size: int,
+    sliding_window: Optional[int] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash prefill attention over the paged cache; returns
+    [B, T, H, Dh]. Requires the chunk's K/V to already be scattered
+    into the cache (models/llama.py writes before attending). Rows are
+    contiguous token runs: q[b, t] sits at absolute position
+    start_pos[b] + t (padded rows: start 0 / ctx 0 -> all-masked)."""
+    B, T, H, Dh = q.shape
+    L, S, Hk, _ = k_cache.shape
+    N = S // block_size
+    W = block_tables.shape[1]
+    scale = 1.0 / math.sqrt(Dh)
+    # query tile: 128 keeps the kernel's VMEM state ~2 MB for the 8B
+    # geometry at block_size=16; halve while the f32 working-set
+    # ESTIMATE (acc + scores) exceeds 5 MB — measured actual usage runs
+    # ~2.8x the estimate (17.5 MB at a 6.3 MB estimate: probs, masks,
+    # relayout copies), and the scoped-VMEM budget is 16 MB, so 5 MB
+    # estimated ≈ 14 MB actual with margin. Hit by big block_size
+    # (128-token pages) and wide-H geometries (70B H=64).
+    tq = 128 if T % 128 == 0 else T
+    # only halve while divisibility survives (odd-factor T stops where
+    # it is — the kernel then runs one bigger tile; correctness first)
+    while tq > 16 and T % (tq // 2) == 0 and (
+        tq * H * (Dh + 2 * block_size) * 4 > 5 * 2**20
+    ):
+        tq //= 2
+    n_tiles = T // tq
+
+    kp = k_cache.reshape(L, N, block_size, Hk, Dh)
+    vp = v_cache.reshape(L, N, block_size, Hk, Dh)
+    q5 = q.reshape(B, n_tiles, tq, H, Dh)
+    layer_arr = jnp.asarray(layer_idx, jnp.int32).reshape(1)
+    starts = jnp.asarray(start_pos, jnp.int32)
+
+    def kv_index(b, qi, j, lyr, st, t, c):
+        # clamp dead steps onto the nearest live page: repeats skip the
+        # HBM copy. Live range for tile qi: pages touching
+        # [max(0, tile_start - window), min(tile_end, ctx))
+        last_any = jnp.maximum((c[b] - 1) // block_size, 0)
+        tile_hi = jnp.minimum(st[b] + (qi + 1) * tq, c[b])
+        last = jnp.clip((tile_hi - 1) // block_size, 0, last_any)
+        jj = jnp.minimum(j, last)
+        if sliding_window is not None:
+            first = jnp.clip(
+                (st[b] + qi * tq - (sliding_window - 1)) // block_size,
+                0, last,
+            )
+            jj = jnp.maximum(jj, first)
+        return (lyr[0], t[b, jj], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,  # layer, starts, block_tables, context_lens
+        grid=(B, n_tiles, W),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, tq, H, Dh),
+                lambda b, qi, j, lyr, st, t, c: (b, qi, 0, 0, 0),
+            ),
+            pl.BlockSpec((1, 1, block_size, Hk, Dh), kv_index),
+            pl.BlockSpec((1, 1, block_size, Hk, Dh), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, tq, H, Dh),
+            lambda b, qi, j, lyr, st, t, c: (b, qi, 0, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((Hk * tq * (H // Hk), Dh), jnp.float32),
+            pltpu.VMEM((Hk * tq * (H // Hk), 1), jnp.float32),
+            pltpu.VMEM((Hk * tq * (H // Hk), 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _prefill_kernel_stacked, block_size=block_size, tq=tq,
+            scale=scale, window=sliding_window,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, n_tiles, tq, H, Dh), q.dtype),
+        interpret=interpret,
+    )(layer_arr, starts, block_tables, context_lens, q5, kp, vp)
+    return out.reshape(B, T, H, Dh)
 
 
 @functools.partial(
@@ -145,49 +439,14 @@ def paged_attention_decode(
     sliding_window: Optional[int] = None,
     interpret: bool = False,
 ) -> jax.Array:
-    """Returns [B, H, Dh] attention outputs."""
-    B, H, Dh = q.shape
-    S, Hk, _ = k_cache_l.shape
-    N = S // block_size
-    W = block_tables.shape[1]
-    scale = 1.0 / math.sqrt(Dh)
+    """Returns [B, H, Dh] attention outputs.
 
-    kp = k_cache_l.reshape(N, block_size, Hk, Dh)
-    vp = v_cache_l.reshape(N, block_size, Hk, Dh)
-
-    def kv_index(b, j, t, c):
-        # clamp dead grid steps (past the last live page, or before a
-        # sliding window's first) onto the nearest live page: a repeated
-        # block index skips the HBM copy entirely
-        last = jnp.maximum((c[b] - 1) // block_size, 0)
-        jj = jnp.minimum(j, last)
-        if sliding_window is not None:
-            first = jnp.clip((c[b] - sliding_window) // block_size, 0, last)
-            jj = jnp.maximum(jj, first)
-        return (t[b, jj], 0, 0, 0)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # block_tables, context_lens
-        grid=(B, W),
-        in_specs=[
-            pl.BlockSpec((1, H, Dh), lambda b, j, t, c: (b, 0, 0)),
-            pl.BlockSpec((1, block_size, Hk, Dh), kv_index),
-            pl.BlockSpec((1, block_size, Hk, Dh), kv_index),
-        ],
-        out_specs=pl.BlockSpec((1, H, Dh), lambda b, j, t, c: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((H, Dh), jnp.float32),
-            pltpu.VMEM((H, 1), jnp.float32),
-            pltpu.VMEM((H, 1), jnp.float32),
-        ],
-    )
-    out = pl.pallas_call(
-        functools.partial(
-            _decode_kernel, block_size=block_size, scale=scale,
-            window=sliding_window,
-        ),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, Dh), q.dtype),
+    Thin wrapper over the stacked kernel with a single-layer stack
+    (k_cache_l[None] is a free expand-dims) — ONE flash-decode kernel
+    body serves both the per-layer API (tests, external callers) and
+    the engine's stacked hot path."""
+    return paged_attention_decode_stacked(
+        q, k_cache_l[None], v_cache_l[None], jnp.int32(0), block_tables,
+        context_lens, block_size=block_size, sliding_window=sliding_window,
         interpret=interpret,
-    )(block_tables, context_lens, q, kp, vp)
-    return out
+    )

@@ -38,11 +38,14 @@ import numpy as np
 CTRL_LEN = 16
 FLAG_PENALTIES = 1  # sampling dict carries the penalty tables
 FLAG_TOPLP = 2  # sampling dict carries the top-logprobs marker
+FLAG_BIAS = 4  # sampling dict carries the logit-bias tables
 
 # fixed key order for broadcasting SamplingBatch.arrays as a tuple
 SAMPLING_BASE_KEYS = (
     ("temperature", np.float32), ("top_k", np.int32), ("top_p", np.float32),
     ("min_p", np.float32), ("seeds", np.uint32),
+)
+SAMPLING_BIAS_KEYS = (
     ("bias_ids", np.int32), ("bias_vals", np.float32),
 )
 SAMPLING_PEN_KEYS = (
@@ -155,51 +158,57 @@ class StepBroadcaster:
 def _fill_sampling_desc(ctrl: np.ndarray, off: int, s: dict) -> None:
     """Write a sampling dict's structure descriptor (flags + sparse
     table widths) into ctrl[off:off+4]."""
-    ctrl[off] = (FLAG_PENALTIES if "rep_pen" in s else 0) | (
-        FLAG_TOPLP if "top_lp_n" in s else 0
+    ctrl[off] = (
+        (FLAG_PENALTIES if "rep_pen" in s else 0)
+        | (FLAG_TOPLP if "top_lp_n" in s else 0)
+        | (FLAG_BIAS if "bias_ids" in s else 0)
     )
-    ctrl[off + 1] = s["bias_ids"].shape[1]
+    ctrl[off + 1] = s["bias_ids"].shape[1] if "bias_ids" in s else 0
     if "rep_pen" in s:
         ctrl[off + 2] = s["gen_ids"].shape[1]
         ctrl[off + 3] = s["prompt_ids"].shape[1]
 
 
-def _sampling_keys(has_pen: bool, has_tlp: bool = False) -> tuple:
-    # the top_lp_n marker key selects the top-logprobs jit variant;
-    # omitting it on followers would trace a DIFFERENT program than the
-    # leader's (divergent collectives across hosts)
+def _sampling_keys(flags: int) -> tuple:
+    # optional key groups select jit VARIANTS; omitting one on followers
+    # would trace a DIFFERENT program than the leader's (divergent
+    # collectives across hosts)
     return (
         SAMPLING_BASE_KEYS
-        + (SAMPLING_PEN_KEYS if has_pen else ())
-        + ((("top_lp_n", np.int32),) if has_tlp else ())
+        + (SAMPLING_BIAS_KEYS if flags & FLAG_BIAS else ())
+        + (SAMPLING_PEN_KEYS if flags & FLAG_PENALTIES else ())
+        + ((("top_lp_n", np.int32),) if flags & FLAG_TOPLP else ())
+    )
+
+
+def _sampling_flags(s: dict) -> int:
+    return (
+        (FLAG_PENALTIES if "rep_pen" in s else 0)
+        | (FLAG_TOPLP if "top_lp_n" in s else 0)
+        | (FLAG_BIAS if "bias_ids" in s else 0)
     )
 
 
 def _sampling_tuple(sampling) -> tuple:
     s = sampling.arrays
     return tuple(
-        np.asarray(s[k], dt)
-        for k, dt in _sampling_keys("rep_pen" in s, "top_lp_n" in s)
+        np.asarray(s[k], dt) for k, dt in _sampling_keys(_sampling_flags(s))
     )
 
 
 def _zeros_sampling(b: int, flags: int, nb: int, ng: int, nr: int) -> tuple:
-    has_pen = bool(flags & FLAG_PENALTIES)
-    has_tlp = bool(flags & FLAG_TOPLP)
     widths = {"bias_ids": nb, "bias_vals": nb, "gen_ids": ng,
               "gen_counts": ng, "prompt_ids": nr, "prompt_counts": nr}
     return tuple(
         np.zeros((b, widths[k]) if k in widths else (b,), dt)
-        for k, dt in _sampling_keys(has_pen, has_tlp)
+        for k, dt in _sampling_keys(flags)
     )
 
 
 def _sampling_dict(args: tuple, flags: int) -> dict:
-    has_pen = bool(flags & FLAG_PENALTIES)
-    has_tlp = bool(flags & FLAG_TOPLP)
     return {
         k: np.asarray(v)
-        for (k, _), v in zip(_sampling_keys(has_pen, has_tlp), args)
+        for (k, _), v in zip(_sampling_keys(flags), args)
     }
 
 

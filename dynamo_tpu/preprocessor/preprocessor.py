@@ -132,6 +132,16 @@ class OpenAIPreprocessor(Operator):
     def _token_str(self, tid: int) -> str:
         return self.tokenizer.decode([tid], skip_special_tokens=False)
 
+    def _token_bytes(self, tid: int) -> list[int]:
+        """OpenAI's per-token ``bytes``: the token's RAW contribution —
+        clients reassemble partial-UTF-8 tokens from these, which the
+        display string (decode of one id -> U+FFFD for partial
+        sequences) cannot provide."""
+        try:
+            return list(self.tokenizer.token_bytes(tid))
+        except Exception:
+            return list(self._token_str(tid).encode("utf-8"))
+
     def _chat_logprobs(self, item: LLMEngineOutput) -> Optional[dict]:
         """OpenAI chat logprobs content for one delta
         (reference: lib/llm/src/protocols/common.rs:323-372)."""
@@ -145,21 +155,19 @@ class OpenAIPreprocessor(Operator):
                 if item.top_logprobs and k < len(item.top_logprobs)
                 else {}
             )
-            alts = []
-            for alt, lp in tops.items():
-                astr = self._token_str(alt)
-                alts.append(
-                    {
-                        "token": astr,
-                        "logprob": lp,
-                        "bytes": list(astr.encode("utf-8")),
-                    }
-                )
+            alts = [
+                {
+                    "token": self._token_str(alt),
+                    "logprob": lp,
+                    "bytes": self._token_bytes(alt),
+                }
+                for alt, lp in tops.items()
+            ]
             entries.append(
                 {
                     "token": tstr,
                     "logprob": item.log_probs[k],
-                    "bytes": list(tstr.encode("utf-8")),
+                    "bytes": self._token_bytes(tid),
                     "top_logprobs": alts,
                 }
             )

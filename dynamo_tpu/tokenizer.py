@@ -20,6 +20,29 @@ from tokenizers import Tokenizer as _HfTokenizer
 
 REPLACEMENT_CHAR = "�"
 
+_U2B: Optional[dict] = None
+
+
+def _unicode_to_byte() -> dict:
+    """Inverse of GPT-2's bytes_to_unicode: the printable-unicode
+    alphabet byte-level BPE vocabularies are written in."""
+    global _U2B
+    if _U2B is None:
+        bs = (
+            list(range(ord("!"), ord("~") + 1))
+            + list(range(0xA1, 0xAC + 1))
+            + list(range(0xAE, 0xFF + 1))
+        )
+        cs = bs[:]
+        n = 0
+        for b in range(256):
+            if b not in bs:
+                bs.append(b)
+                cs.append(256 + n)
+                n += 1
+        _U2B = {chr(c): b for b, c in zip(bs, cs)}
+    return _U2B
+
 
 class Tokenizer:
     """Thin wrapper over a HuggingFace `tokenizers` fast tokenizer."""
@@ -49,6 +72,24 @@ class Tokenizer:
     @property
     def vocab_size(self) -> int:
         return self._tok.get_vocab_size()
+
+    def token_bytes(self, id_: int) -> bytes:
+        """The RAW bytes one token contributes to the output stream —
+        what OpenAI's logprob ``bytes`` field carries so clients can
+        reassemble partial-UTF-8 tokens (decode([id]) alone yields
+        U+FFFD for a token holding an incomplete multi-byte sequence).
+        Byte-level BPE tokens map back through the GPT-2 unicode<->byte
+        table; SentencePiece pieces map their word-boundary marker to a
+        space; anything else falls back to the decoded text's UTF-8."""
+        tok = self.id_to_token(id_)
+        if tok is None:
+            return self.decode([id_]).encode("utf-8")
+        table = _unicode_to_byte()
+        if all(ch in table for ch in tok):
+            return bytes(table[ch] for ch in tok)
+        if "▁" in tok:  # SentencePiece ▁ word boundary
+            return tok.replace("▁", " ").encode("utf-8")
+        return self.decode([id_]).encode("utf-8")
 
     def special_token_ids(self) -> set[int]:
         return {

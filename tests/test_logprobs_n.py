@@ -419,3 +419,21 @@ def test_request_validation_rejects_bad_params():
 def test_top_k_clamped_at_validation_boundary():
     opts = SamplingOptions(top_k=4096, temperature=0.7).normalized()
     assert opts.top_k == SamplingOptions.TOP_K_CAP
+
+
+def test_token_bytes_reassemble_multibyte():
+    """OpenAI's logprob ``bytes`` field must carry each token's RAW
+    byte contribution: per-token decode() of a byte-level BPE yields
+    U+FFFD for partial UTF-8 sequences, but concatenating token_bytes
+    reconstructs the exact text (the field's whole purpose)."""
+    from dynamo_tpu.tokenizer import Tokenizer
+
+    t = Tokenizer.from_file(
+        os.path.join(os.path.dirname(__file__), "data", "tiny_llama_model")
+    )
+    s = "héllo \U0001F30D 你好"
+    ids = t.encode(s)
+    # the failure mode this guards against: single-id decode garbles
+    assert any("�" in t.decode([i]) for i in ids)
+    joined = b"".join(t.token_bytes(i) for i in ids)
+    assert joined == t.decode(ids).encode("utf-8")

@@ -39,6 +39,114 @@ def _setup(B, H, Hk, Dh, num_blocks, bs, ctx_lens, seed=0):
     "B,H,Hk,ctx_lens",
     [
         (2, 4, 2, [7, 29]),  # GQA, ragged contexts
+        (3, 8, 1, [1, 33, 5]),  # MQA, ctx=1 edge
+        (2, 4, 2, [40, 0]),  # padded row (ctx=0)
+    ],
+)
+@pytest.mark.parametrize("window", [None, 24])
+def test_decode_kernel_stacked_matches_per_layer(B, H, Hk, ctx_lens, window):
+    """The stacked-cache kernel (layer via scalar prefetch — the engine's
+    decode hot path, avoiding the per-layer slice copy) must match the
+    per-layer kernel on every layer."""
+    from dynamo_tpu.ops.paged_attention import paged_attention_decode_stacked
+
+    Dh, bs, num_blocks, L = 128, 16, 16, 3
+    rng = np.random.default_rng(7)
+    q, k0, v0, tables, ctx = _setup(B, H, Hk, Dh, num_blocks, bs, ctx_lens)
+    k_stack = jnp.asarray(
+        rng.standard_normal((L, num_blocks * bs, Hk, Dh)).astype(np.float32)
+    )
+    v_stack = jnp.asarray(
+        rng.standard_normal((L, num_blocks * bs, Hk, Dh)).astype(np.float32)
+    )
+    for layer in range(L):
+        out = paged_attention_decode_stacked(
+            q, k_stack, v_stack, jnp.int32(layer), tables, ctx, bs,
+            sliding_window=window, interpret=True,
+        )
+        ref = paged_attention_decode(
+            q, k_stack[layer], v_stack[layer], tables, ctx, bs,
+            sliding_window=window, interpret=True,
+        )
+        valid = np.asarray(ctx) > 0
+        np.testing.assert_allclose(
+            np.asarray(out)[valid], np.asarray(ref)[valid],
+            rtol=2e-2, atol=2e-2,
+        )
+
+
+@pytest.mark.parametrize(
+    "B,H,Hk,T,starts,ctx_lens,window",
+    [
+        # full prefill from position 0, ragged lens, GQA
+        (2, 4, 2, 32, [0, 0], [30, 17], None),
+        # chunked: rows resume mid-prompt (prefix already in cache)
+        (2, 4, 2, 16, [20, 5], [36, 21], None),
+        # MQA + block-aligned + a padded row (start 0 / ctx 0)
+        (3, 8, 1, 16, [0, 16, 0], [16, 32, 0], None),
+        # sliding window across pages
+        (2, 4, 2, 32, [0, 24], [32, 56], 20),
+        # tile boundary: T = 2 tiles when tq divides (tiny tq via T=256
+        # would be slow interpreted; T=32 runs one tile — covered above)
+    ],
+)
+def test_prefill_kernel_matches_reference(B, H, Hk, T, starts, ctx_lens, window):
+    """Flash prefill over the paged cache (VERDICT r3 item 2: the T>1
+    path must stop falling back to the XLA group-expand reference)."""
+    from dynamo_tpu.ops.paged_attention import paged_attention_prefill_stacked
+
+    Dh, bs, num_blocks = 128, 16, 16
+    rng = np.random.default_rng(11)
+    _, k, v, tables, ctx = _setup(B, H, Hk, Dh, num_blocks, bs, ctx_lens)
+    q = jnp.asarray(rng.standard_normal((B, T, H, Dh)).astype(np.float32))
+    starts_a = jnp.asarray(starts, np.int32)
+    out = paged_attention_prefill_stacked(
+        q, k[None], v[None], jnp.int32(0), tables, starts_a, ctx, bs,
+        sliding_window=window, interpret=True,
+    )
+    positions = starts_a[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    ref = paged_attention_reference(
+        q, k, v, tables, positions, ctx, bs, window
+    )
+    # compare only REAL tokens (start + t < ctx); padded tokens are
+    # discarded downstream (the reference NaN-masks differently)
+    for b in range(B):
+        n = max(0, int(ctx[b]) - int(starts[b]))
+        n = min(n, T)
+        if n == 0:
+            continue
+        np.testing.assert_allclose(
+            np.asarray(out)[b, :n], np.asarray(ref)[b, :n],
+            rtol=2e-2, atol=2e-2,
+        )
+
+
+def test_prefill_kernel_multi_tile():
+    """T > tile size exercises the query-tile grid axis (tq=128)."""
+    from dynamo_tpu.ops.paged_attention import paged_attention_prefill_stacked
+
+    B, H, Hk, Dh, bs = 1, 2, 1, 128, 16
+    T = 256  # two 128-token tiles
+    num_blocks = 20
+    rng = np.random.default_rng(3)
+    _, k, v, tables, ctx = _setup(B, H, Hk, Dh, num_blocks, bs, [256])
+    q = jnp.asarray(rng.standard_normal((B, T, H, Dh)).astype(np.float32))
+    starts = jnp.zeros((B,), jnp.int32)
+    out = paged_attention_prefill_stacked(
+        q, k[None], v[None], jnp.int32(0), tables, starts, ctx, bs,
+        interpret=True,
+    )
+    positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+    ref = paged_attention_reference(q, k, v, tables, positions, ctx, bs)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2
+    )
+
+
+@pytest.mark.parametrize(
+    "B,H,Hk,ctx_lens",
+    [
+        (2, 4, 2, [7, 29]),  # GQA, ragged contexts
         (1, 4, 4, [16]),  # MHA, exactly block-aligned
         (3, 8, 1, [1, 33, 5]),  # MQA, ctx=1 edge
         (2, 4, 2, [40, 0]),  # padded row (ctx=0)
