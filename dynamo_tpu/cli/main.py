@@ -125,11 +125,17 @@ def build_parser() -> argparse.ArgumentParser:
     store.add_argument("--persist-path", default=None,
                        help="durability: WAL + snapshot at this path — "
                            "model registrations, queues, and the object "
-                           "plane survive a coordinator restart (leased "
-                           "liveness keys stay ephemeral, like etcd). "
-                           "python server: per-op WAL; --native: periodic "
-                           "+ SIGTERM snapshots (a hard kill can lose up "
-                           "to ~2s of acknowledged mutations)")
+                           "plane survive a coordinator restart, incl. a "
+                           "hard kill (leased liveness keys stay "
+                           "ephemeral, like etcd). Both servers append "
+                           "each acked mutation to a flushed WAL "
+                           "(process-crash durable; host/power-crash "
+                           "durability needs --fsync-wal on the native "
+                           "server) and fold it into snapshots")
+    store.add_argument("--fsync-wal", action="store_true",
+                       help="(--native) fsync every WAL record before "
+                            "acking: power-loss durable, like etcd's "
+                            "raft-log fsync, at per-op fsync cost")
 
     serve = sub.add_parser("serve", help="serve a @service graph "
                            "(≈ reference `dynamo serve`)")
@@ -872,7 +878,15 @@ def _exec_native_store(args: Any) -> None:
         argv = [binary, "--host", host, "--port", str(args.port)]
         if getattr(args, "persist_path", None):
             argv += ["--persist-path", args.persist_path]
+        if getattr(args, "fsync_wal", False):
+            argv += ["--fsync-wal"]
         os.execv(binary, argv)
+    if getattr(args, "fsync_wal", False):
+        raise SystemExit(
+            "--fsync-wal needs the native store binary, which is "
+            "unavailable; refusing to silently serve with the python "
+            "server's weaker (flush-only) WAL durability"
+        )
     log.warning("native store binary unavailable; using the python server")
 
 

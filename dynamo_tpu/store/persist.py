@@ -55,6 +55,13 @@ class WriteAheadLog:
         return self._fh
 
     def append(self, op: str, **fields: Any) -> None:
+        """Append one record, flushed (not fsynced): acked mutations
+        survive a PROCESS crash — kernel buffers hold the record even
+        after kill -9 — but a host/power crash can lose the unsynced
+        tail. For power-loss durability use the native store with
+        --fsync-wal (etcd fsyncs its raft log the same way); a per-op
+        fsync here would serialize the asyncio control plane on disk
+        latency for a guarantee the deployment story doesn't rest on."""
         rec = {"op": op, **fields}
         fh = self._file()
         fh.write(json.dumps(rec, separators=(",", ":")) + "\n")

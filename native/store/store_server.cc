@@ -149,16 +149,23 @@ struct Server {
   std::unordered_map<std::string, QueueState> queues;
   std::unordered_map<std::string, std::map<std::string, std::string>> objects;
   uint64_t pop_order = 0;
-  // durability (same restart CONTRACT as the python store,
-  // store/persist.py — unleased KV, queues with in-flight restored as
-  // ready, the object plane; leased liveness keys ephemeral) but a
-  // WEAKER crash window: snapshots are periodic (2s tick) + SIGTERM,
-  // so a hard kill can lose up to ~2s of acknowledged mutations. The
-  // python server WALs each op before replying; matching that here
-  // would put an fsync on every mutation of the single-threaded event
-  // loop — the 2s window is the chosen trade and is documented in the
-  // CLI help.
+  // durability — same restart CONTRACT as the python store
+  // (store/persist.py: unleased KV, queues with in-flight restored as
+  // ready, the object plane; leased liveness keys ephemeral) and the
+  // same MECHANISM: every surviving mutation appends one WAL record
+  // (flushed before the reply is sent — kernel-buffered, so it
+  // survives a kill -9; --fsync-wal additionally fsyncs per record for
+  // power-loss durability, like etcd's raft log fsync). Snapshots
+  // (2s tick + SIGTERM) act as WAL compaction: a successful snapshot
+  // truncates the log. Replay order on boot: snapshot, then WAL
+  // records; q_push records already folded into the snapshot
+  // (id < its next_id) are skipped so queued work never delivers
+  // twice. Reference role: etcd raft log + JetStream file store
+  // (lib/runtime/src/transports/{etcd,nats}.rs).
   std::string persist_path;
+  std::string wal_path;
+  FILE* wal = nullptr;
+  bool fsync_wal = false;
   bool dirty = false;
   double last_snap = 0;
 
@@ -223,6 +230,7 @@ struct Server {
 
   int64_t kv_put(const std::string& key, std::string value, int64_t lease_id) {
     auto prev = kv.find(key);
+    bool durable_prev = prev != kv.end() && prev->second.lease_id == 0;
     if (prev != kv.end() && prev->second.lease_id != lease_id) {
       auto old = leases.find(prev->second.lease_id);
       if (old != leases.end()) old->second.keys.erase(key);
@@ -234,7 +242,15 @@ struct Server {
     }
     Entry e{std::move(value), ++version, lease_id};
     kv[key] = e;
-    if (lease_id == 0) dirty = true;
+    if (lease_id == 0) {
+      dirty = true;
+      wal_kv_put(key, e.version, e.value);
+    } else if (durable_prev) {
+      // a leased put SHADOWS a previously durable key: tombstone it,
+      // or a restart would resurrect the stale value
+      dirty = true;
+      wal_kv_del(key);
+    }
     emit_watch("put", key, e);
     return e.version;
   }
@@ -244,7 +260,10 @@ struct Server {
     if (it == kv.end()) return false;
     Entry e = std::move(it->second);
     kv.erase(it);
-    if (e.lease_id == 0) dirty = true;
+    if (e.lease_id == 0) {
+      dirty = true;
+      wal_kv_del(key);
+    }
     if (e.lease_id != 0) {
       auto l = leases.find(e.lease_id);
       if (l != leases.end()) l->second.keys.erase(key);
@@ -383,6 +402,7 @@ struct Server {
         auto& q = queues[arg(0).s];
         QMsg msg{q.next_id++, arg(1).s};
         int64_t id = msg.id;
+        wal_q_push(arg(0).s, id, msg.payload);
         q.ready.push_back(std::move(msg));
         dirty = true;
         serve_parked(arg(0).s);
@@ -405,7 +425,10 @@ struct Server {
       } else if (op == "queue_ack") {
         auto& q = queues[arg(0).s];
         bool acked = q.in_flight.erase(arg(1).i) > 0;
-        if (acked) dirty = true;
+        if (acked) {
+          dirty = true;
+          wal_q_ack(arg(0).s, arg(1).i);
+        }
         reply_ok(c, rid, Val::boolean(acked));
       } else if (op == "queue_len") {
         auto& q = queues[arg(0).s];
@@ -414,6 +437,7 @@ struct Server {
       } else if (op == "obj_put") {
         objects[arg(0).s][arg(1).s] = arg(2).s;
         dirty = true;
+        wal_obj_put(arg(0).s, arg(1).s, arg(2).s);
         reply_ok(c, rid, Val::boolean(true));
       } else if (op == "obj_get") {
         auto b = objects.find(arg(0).s);
@@ -423,7 +447,10 @@ struct Server {
       } else if (op == "obj_delete") {
         auto b = objects.find(arg(0).s);
         bool deleted = b != objects.end() && b->second.erase(arg(1).s) > 0;
-        if (deleted) dirty = true;
+        if (deleted) {
+          dirty = true;
+          wal_obj_del(arg(0).s, arg(1).s);
+        }
         reply_ok(c, rid, Val::boolean(deleted));
       } else if (op == "obj_list") {
         Val out = Val::arr();
@@ -490,6 +517,185 @@ struct Server {
     }
   };
 
+  // ---- write-ahead log --------------------------------------------------
+  // Record: u32 body_len | u8 op | op fields (strings are u32-prefixed).
+  // Ops: 1 kv_put(key, u64 ver, value)  2 kv_del(key)
+  //      3 q_push(name, u64 id, payload) 4 q_ack(name, u64 id)
+  //      5 obj_put(bucket, name, data)   6 obj_del(bucket, name)
+  enum { W_KV_PUT = 1, W_KV_DEL, W_Q_PUSH, W_Q_ACK, W_OBJ_PUT, W_OBJ_DEL };
+
+  void wal_write(const std::string& body) {
+    if (wal_path.empty()) return;
+    if (!wal) {
+      wal = fopen(wal_path.c_str(), "ab");
+      if (!wal) { perror("wal open"); return; }
+    }
+    std::string rec;
+    put_u32(rec, (uint32_t)body.size());
+    rec += body;
+    // flush before the reply goes out: acked mutations survive a
+    // process kill. --fsync-wal extends that to host/power crashes.
+    bool ok = fwrite(rec.data(), 1, rec.size(), wal) == rec.size();
+    ok = (fflush(wal) == 0) && ok;
+    if (fsync_wal) ok = (fsync(fileno(wal)) == 0) && ok;
+    if (!ok) {
+      // A short/failed write (ENOSPC, EIO) may leave a TORN RECORD in
+      // the middle of the log — replay stops at the first bad record,
+      // so every later append would be silently lost on restart.
+      // Force an immediate snapshot instead: it captures current state
+      // (including this mutation) and truncates the broken log.
+      perror("wal write (forcing snapshot)");
+      fclose(wal);
+      wal = nullptr;
+      dirty = true;
+      save_snapshot();  // retries via the 2s tick if it also fails
+    }
+  }
+
+  void wal_kv_put(const std::string& key, int64_t ver, const std::string& value) {
+    if (wal_path.empty()) return;
+    std::string b(1, (char)W_KV_PUT);
+    put_str(b, key); put_u64(b, (uint64_t)ver); put_str(b, value);
+    wal_write(b);
+  }
+  void wal_kv_del(const std::string& key) {
+    if (wal_path.empty()) return;
+    std::string b(1, (char)W_KV_DEL);
+    put_str(b, key);
+    wal_write(b);
+  }
+  void wal_q_push(const std::string& q, int64_t id, const std::string& payload) {
+    if (wal_path.empty()) return;
+    std::string b(1, (char)W_Q_PUSH);
+    put_str(b, q); put_u64(b, (uint64_t)id); put_str(b, payload);
+    wal_write(b);
+  }
+  void wal_q_ack(const std::string& q, int64_t id) {
+    if (wal_path.empty()) return;
+    std::string b(1, (char)W_Q_ACK);
+    put_str(b, q); put_u64(b, (uint64_t)id);
+    wal_write(b);
+  }
+  void wal_obj_put(const std::string& bucket, const std::string& name,
+                   const std::string& data) {
+    if (wal_path.empty()) return;
+    std::string b(1, (char)W_OBJ_PUT);
+    put_str(b, bucket); put_str(b, name); put_str(b, data);
+    wal_write(b);
+  }
+  void wal_obj_del(const std::string& bucket, const std::string& name) {
+    if (wal_path.empty()) return;
+    std::string b(1, (char)W_OBJ_DEL);
+    put_str(b, bucket); put_str(b, name);
+    wal_write(b);
+  }
+
+  void wal_truncate() {
+    if (wal_path.empty()) return;
+    if (wal) { fclose(wal); wal = nullptr; }
+    FILE* t = fopen(wal_path.c_str(), "wb");
+    if (t) {
+      fflush(t);
+      fsync(fileno(t));
+      fclose(t);
+    }
+  }
+
+  void replay_wal(const std::unordered_map<std::string, int64_t>& snap_next) {
+    if (wal_path.empty()) return;
+    FILE* f = fopen(wal_path.c_str(), "rb");
+    if (!f) return;
+    std::string b;
+    char buf[1 << 16];
+    size_t n;
+    while ((n = fread(buf, 1, sizeof buf, f)) > 0) b.append(buf, n);
+    fclose(f);
+    std::unordered_map<std::string, std::set<int64_t>> acked;
+    std::unordered_map<std::string, std::deque<QMsg>> pushes;
+    std::unordered_map<std::string, int64_t> q_next;
+    size_t off = 0;
+    size_t n_rec = 0;
+    while (off + 4 <= b.size()) {
+      uint32_t len;
+      memcpy(&len, b.data() + off, 4);
+      if (off + 4 + len > b.size() || len == 0) break;  // torn tail: stop
+      Rd r{b, off + 4};
+      size_t end = off + 4 + len;
+      uint8_t op = (uint8_t)b[r.off++];
+      if (op == W_KV_PUT) {
+        std::string key = r.str();
+        int64_t ver = (int64_t)r.u64();
+        std::string val = r.str();
+        if (r.ok) {
+          kv[key] = Entry{std::move(val), ver, 0};
+          version = std::max(version, ver);
+        }
+      } else if (op == W_KV_DEL) {
+        std::string key = r.str();
+        if (r.ok) kv.erase(key);
+      } else if (op == W_Q_PUSH) {
+        std::string qn = r.str();
+        int64_t id = (int64_t)r.u64();
+        std::string payload = r.str();
+        if (r.ok) {
+          // records already folded into the snapshot (id < its
+          // next_id) must not replay: queued work would deliver twice
+          auto sn = snap_next.find(qn);
+          if (sn == snap_next.end() || id >= sn->second) {
+            pushes[qn].push_back(QMsg{id, std::move(payload)});
+            auto& nx = q_next[qn];
+            nx = std::max(nx, id + 1);
+          }
+        }
+      } else if (op == W_Q_ACK) {
+        std::string qn = r.str();
+        int64_t id = (int64_t)r.u64();
+        if (r.ok) acked[qn].insert(id);
+      } else if (op == W_OBJ_PUT) {
+        std::string bucket = r.str();
+        std::string name = r.str();
+        std::string data = r.str();
+        if (r.ok) objects[bucket][name] = std::move(data);
+      } else if (op == W_OBJ_DEL) {
+        std::string bucket = r.str();
+        std::string name = r.str();
+        if (r.ok) {
+          auto it = objects.find(bucket);
+          if (it != objects.end()) it->second.erase(name);
+        }
+      } else {
+        break;  // unknown op: stop replay (forward-compat guard)
+      }
+      if (!r.ok) break;
+      off = end;
+      ++n_rec;
+    }
+    for (auto& pe : pushes) {
+      auto& q = queues[pe.first];
+      auto& ack = acked[pe.first];
+      for (auto& m : pe.second)
+        if (!ack.count(m.id)) q.ready.push_back(std::move(m));
+    }
+    for (auto& ne : q_next) {
+      auto& q = queues[ne.first];
+      q.next_id = std::max(q.next_id, ne.second);
+    }
+    // acks may target messages restored from the SNAPSHOT
+    for (auto& ae : acked) {
+      auto qi = queues.find(ae.first);
+      if (qi == queues.end()) continue;
+      auto& ready = qi->second.ready;
+      ready.erase(
+          std::remove_if(ready.begin(), ready.end(),
+                         [&](const QMsg& m) { return ae.second.count(m.id) > 0; }),
+          ready.end());
+    }
+    if (n_rec > 0) dirty = true;  // compact replayed records on first tick
+    if (off < b.size())
+      fprintf(stderr, "persist: torn WAL tail at %zu/%zu (stopped replay)\n",
+              off, b.size());
+  }
+
   void save_snapshot() {
     if (persist_path.empty()) return;
     std::string b;
@@ -538,50 +744,61 @@ struct Server {
     }
     dirty = false;
     last_snap = now_s();
+    // a durable snapshot folds in everything the WAL recorded: truncate
+    // (a crash between rename and truncate is safe — replay skips
+    // q_push records the snapshot already holds, and kv/obj records
+    // are idempotent)
+    wal_truncate();
   }
 
   void load_snapshot() {
     if (persist_path.empty()) return;
+    std::unordered_map<std::string, int64_t> snap_next;
     FILE* f = fopen(persist_path.c_str(), "rb");
-    if (!f) return;  // first boot
-    std::string b;
-    char buf[1 << 16];
-    size_t n;
-    while ((n = fread(buf, 1, sizeof buf, f)) > 0) b.append(buf, n);
-    fclose(f);
-    if (b.size() < 9 || b.compare(0, 9, "DTPUSNAP1") != 0) {
-      fprintf(stderr, "persist: unrecognized snapshot header, ignoring\n");
-      return;
-    }
-    Rd r{b, 9};
-    version = (int64_t)r.u64();
-    for (uint32_t i = r.u32(); r.ok && i > 0; --i) {
-      std::string key = r.str();
-      Entry e;
-      e.version = (int64_t)r.u64();
-      e.value = r.str();
-      if (r.ok) kv[key] = std::move(e);
-    }
-    for (uint32_t i = r.ok ? r.u32() : 0; r.ok && i > 0; --i) {
-      std::string name = r.str();
-      QueueState& q = queues[name];
-      q.next_id = (int64_t)r.u64();
-      for (uint32_t j = r.u32(); r.ok && j > 0; --j) {
-        QMsg m;
-        m.id = (int64_t)r.u64();
-        m.payload = r.str();
-        if (r.ok) q.ready.push_back(std::move(m));  // in-flight -> ready
+    if (f) {
+      std::string b;
+      char buf[1 << 16];
+      size_t n;
+      while ((n = fread(buf, 1, sizeof buf, f)) > 0) b.append(buf, n);
+      fclose(f);
+      if (b.size() < 9 || b.compare(0, 9, "DTPUSNAP1") != 0) {
+        fprintf(stderr, "persist: unrecognized snapshot header, ignoring\n");
+      } else {
+        Rd r{b, 9};
+        version = (int64_t)r.u64();
+        for (uint32_t i = r.u32(); r.ok && i > 0; --i) {
+          std::string key = r.str();
+          Entry e;
+          e.version = (int64_t)r.u64();
+          e.value = r.str();
+          if (r.ok) kv[key] = std::move(e);
+        }
+        for (uint32_t i = r.ok ? r.u32() : 0; r.ok && i > 0; --i) {
+          std::string name = r.str();
+          QueueState& q = queues[name];
+          q.next_id = (int64_t)r.u64();
+          snap_next[name] = q.next_id;
+          for (uint32_t j = r.u32(); r.ok && j > 0; --j) {
+            QMsg m;
+            m.id = (int64_t)r.u64();
+            m.payload = r.str();
+            if (r.ok) q.ready.push_back(std::move(m));  // in-flight -> ready
+          }
+        }
+        for (uint32_t i = r.ok ? r.u32() : 0; r.ok && i > 0; --i) {
+          std::string bucket = r.str();
+          for (uint32_t j = r.u32(); r.ok && j > 0; --j) {
+            std::string nm = r.str();
+            std::string data = r.str();
+            if (r.ok) objects[bucket][nm] = std::move(data);
+          }
+        }
+        if (!r.ok)
+          fprintf(stderr, "persist: truncated snapshot (partial restore)\n");
       }
     }
-    for (uint32_t i = r.ok ? r.u32() : 0; r.ok && i > 0; --i) {
-      std::string bucket = r.str();
-      for (uint32_t j = r.u32(); r.ok && j > 0; --j) {
-        std::string nm = r.str();
-        std::string data = r.str();
-        if (r.ok) objects[bucket][nm] = std::move(data);
-      }
-    }
-    if (!r.ok) fprintf(stderr, "persist: truncated snapshot (partial restore)\n");
+    // then the op log: everything acked since that snapshot
+    replay_wal(snap_next);
   }
 
   // ---- periodic sweep ---------------------------------------------------
@@ -767,13 +984,20 @@ int main(int argc, char** argv) {
   const char* host = "0.0.0.0";
   int port = 4222;
   const char* persist = nullptr;
-  for (int i = 1; i < argc - 1; ++i) {
+  bool fsync_wal = false;
+  for (int i = 1; i < argc; ++i) {
+    if (!strcmp(argv[i], "--fsync-wal")) { fsync_wal = true; continue; }
+    if (i >= argc - 1) break;
     if (!strcmp(argv[i], "--host")) host = argv[++i];
     else if (!strcmp(argv[i], "--port")) port = atoi(argv[++i]);
     else if (!strcmp(argv[i], "--persist-path")) persist = argv[++i];
   }
   Server s;
-  if (persist) s.persist_path = persist;
+  if (persist) {
+    s.persist_path = persist;
+    s.wal_path = std::string(persist) + ".wal";
+    s.fsync_wal = fsync_wal;
+  }
   // graceful shutdown: fold state into a final snapshot (the poll loop
   // notices g_stop via EINTR / its 100ms tick)
   struct sigaction sa{};

@@ -323,3 +323,129 @@ async def test_native_codec_randomized_roundtrip(native_store):
         assert await c.obj_get("fz", "blob") == blob
     finally:
         await c.close()
+
+
+async def test_native_store_wal_survives_kill9(native_store_binary, tmp_path):
+    """Durability parity with the python store (VERDICT r3 item 7): the
+    native server WALs every acked mutation, so a kill -9 UNDER TRAFFIC
+    (no SIGTERM snapshot, no 2s tick grace) loses nothing acked — KV,
+    unacked queue messages (including in-flight, redelivered as ready),
+    and the object plane all survive; acked messages never redeliver.
+    Reference role: etcd raft log / JetStream file store
+    (lib/runtime/src/transports/{etcd,nats}.rs)."""
+    import signal
+
+    from dynamo_tpu.store.client import StoreClient
+
+    persist = str(tmp_path / "store.bin")
+
+    def start():
+        proc = subprocess.Popen(
+            [native_store_binary, "--host", "127.0.0.1", "--port", "0",
+             "--persist-path", persist],
+            stdout=subprocess.PIPE,
+        )
+        line = proc.stdout.readline()
+        assert line.startswith(b"LISTENING"), line
+        return proc, int(line.split()[1])
+
+    proc, port = start()
+    try:
+        c = await StoreClient.connect("127.0.0.1", port)
+        await c.kv_put("model/reg", b"card-v1")
+        await c.kv_put("model/other", b"x")
+        await c.kv_delete("model/other")
+        lid = await c.lease_grant(30.0)
+        await c.kv_put("live/worker", b"ephemeral", lease_id=lid)
+        for i in range(4):
+            await c.queue_push("prefill", f"job-{i}".encode())
+        # job-0 popped+acked (must NOT come back), job-1 popped but
+        # UNACKED (in-flight at the kill: must come back ready)
+        m0 = await c.queue_pop("prefill", timeout_s=1)
+        assert m0.payload == b"job-0"
+        assert await c.queue_ack("prefill", m0.id)
+        m1 = await c.queue_pop("prefill", timeout_s=1)
+        assert m1.payload == b"job-1"
+        await c.obj_put("artifacts", "tok.json", b"{}")
+        await c.close()
+    finally:
+        # hard kill: no SIGTERM handler, no final snapshot
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+
+    proc, port = start()
+    try:
+        c = await StoreClient.connect("127.0.0.1", port)
+        e = await c.kv_get("model/reg")
+        assert e is not None and e.value == b"card-v1"
+        assert await c.kv_get("model/other") is None
+        # leased liveness key is ephemeral by design
+        assert await c.kv_get("live/worker") is None
+        # in-flight job-1 redelivers; job-2/3 still queued; job-0 never
+        seen = []
+        for _ in range(3):
+            m = await c.queue_pop("prefill", timeout_s=1)
+            assert m is not None
+            seen.append(m.payload)
+            await c.queue_ack("prefill", m.id)
+        assert sorted(seen) == [b"job-1", b"job-2", b"job-3"]
+        assert await c.queue_pop("prefill", timeout_s=0) is None
+        assert await c.obj_get("artifacts", "tok.json") == b"{}"
+        # new pushes must not collide with pre-crash message ids
+        nid = await c.queue_push("prefill", b"post-crash")
+        assert nid > m1.id
+        await c.close()
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+async def test_native_store_wal_compaction_no_double_delivery(
+    native_store_binary, tmp_path
+):
+    """A snapshot (2s tick) folds WAL records in and truncates the log;
+    messages folded into the snapshot must not ALSO replay from any
+    surviving WAL records after a later crash."""
+    import signal
+
+    from dynamo_tpu.store.client import StoreClient
+
+    persist = str(tmp_path / "store.bin")
+    proc = subprocess.Popen(
+        [native_store_binary, "--host", "127.0.0.1", "--port", "0",
+         "--persist-path", persist],
+        stdout=subprocess.PIPE,
+    )
+    line = proc.stdout.readline()
+    port = int(line.split()[1])
+    try:
+        c = await StoreClient.connect("127.0.0.1", port)
+        await c.queue_push("q", b"early")
+        await asyncio.sleep(2.5)  # let the snapshot tick fold + truncate
+        await c.queue_push("q", b"late")  # lands in the fresh WAL
+        await c.close()
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+
+    proc = subprocess.Popen(
+        [native_store_binary, "--host", "127.0.0.1", "--port", "0",
+         "--persist-path", persist],
+        stdout=subprocess.PIPE,
+    )
+    line = proc.stdout.readline()
+    port = int(line.split()[1])
+    try:
+        c = await StoreClient.connect("127.0.0.1", port)
+        got = []
+        while True:
+            m = await c.queue_pop("q", timeout_s=0)
+            if m is None:
+                break
+            got.append(m.payload)
+            await c.queue_ack("q", m.id)
+        assert sorted(got) == [b"early", b"late"]
+        await c.close()
+    finally:
+        proc.kill()
+        proc.wait()
