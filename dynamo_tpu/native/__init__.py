@@ -68,6 +68,10 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.dyn_radix_remove_worker.argtypes = [ctypes.c_void_p, i64]
     lib.dyn_radix_find.restype = sz
     lib.dyn_radix_find.argtypes = [ctypes.c_void_p, p(u64), sz, p(i64), p(ctypes.c_uint32), sz]
+    lib.dyn_radix_find_multi.restype = sz
+    lib.dyn_radix_find_multi.argtypes = [
+        p(ctypes.c_void_p), sz, p(u64), sz, p(i64), p(ctypes.c_uint32), sz,
+    ]
     lib.dyn_radix_num_blocks.restype = sz
     lib.dyn_radix_num_blocks.argtypes = [ctypes.c_void_p]
     lib.dyn_radix_applied.restype = u64
@@ -236,6 +240,37 @@ class NativeRadix:
     @property
     def applied_events(self) -> int:
         return self._lib.dyn_radix_applied(self._h)
+
+
+def radix_find_multi(trees, seq_hashes) -> dict[int, int]:
+    """Batched find_matches over several NativeRadix trees with ONE FFI
+    crossing (the sharded indexer's match path — per-call ctypes
+    overhead otherwise floors its latency at n_shards x a single tree).
+    Worker sets must be disjoint across trees (sharded-by-worker)."""
+    import numpy as np
+
+    assert trees
+    lib = trees[0]._lib
+    arr = NativeRadix._as_u64(seq_hashes)
+    handles = (ctypes.c_void_p * len(trees))(
+        *[t._h for t in trees]
+    )
+    cap = max(
+        64,
+        2 * sum(lib.dyn_radix_num_workers(t._h) for t in trees),
+    )
+    workers = np.empty(cap, dtype=np.int64)
+    scores = np.empty(cap, dtype=np.uint32)
+    n = lib.dyn_radix_find_multi(
+        handles,
+        len(trees),
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        len(arr),
+        workers.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        scores.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        cap,
+    )
+    return {int(workers[i]): int(scores[i]) for i in range(n)}
 
 
 # ---------------------------------------------------------------------------

@@ -86,14 +86,14 @@ void dyn_radix_remove_worker(void* h, int64_t worker) {
   remove_worker_impl(static_cast<Radix*>(h), worker);
 }
 
-// Walk seq_hashes accumulating the longest consecutive prefix per worker.
-// Writes up to `cap` (worker, score) pairs; returns the number written.
+// Walk seq_hashes accumulating the longest consecutive prefix per worker,
+// appending (worker, score) pairs to the output vectors.
 // Semantics match indexer.py::RadixTree.find_matches: the active set is
 // the intersection of owners along the walk; a worker's score is the depth
 // it stayed in the intersection.
-size_t dyn_radix_find(void* h, const uint64_t* seq_hashes, size_t n,
-                      int64_t* out_workers, uint32_t* out_scores, size_t cap) {
-  Radix* r = static_cast<Radix*>(h);
+static void find_impl(Radix* r, const uint64_t* seq_hashes, size_t n,
+                      std::vector<int64_t>& out_workers,
+                      std::vector<uint32_t>& out_scores) {
   std::vector<int64_t> active;   // current intersection, sorted
   std::vector<int64_t> workers;  // all workers ever active, sorted
   std::vector<uint32_t> scores;  // parallel to `workers`
@@ -122,6 +122,36 @@ size_t dyn_radix_find(void* h, const uint64_t* seq_hashes, size_t n,
       scores[idx] = static_cast<uint32_t>(i + 1);
     }
   }
+  out_workers.insert(out_workers.end(), workers.begin(), workers.end());
+  out_scores.insert(out_scores.end(), scores.begin(), scores.end());
+}
+
+// Writes up to `cap` (worker, score) pairs; returns the number written.
+size_t dyn_radix_find(void* h, const uint64_t* seq_hashes, size_t n,
+                      int64_t* out_workers, uint32_t* out_scores, size_t cap) {
+  std::vector<int64_t> workers;
+  std::vector<uint32_t> scores;
+  find_impl(static_cast<Radix*>(h), seq_hashes, n, workers, scores);
+  size_t out = workers.size() < cap ? workers.size() : cap;
+  for (size_t i = 0; i < out; ++i) {
+    out_workers[i] = workers[i];
+    out_scores[i] = scores[i];
+  }
+  return out;
+}
+
+// Batched match over several independent trees (the sharded indexer's
+// shards — worker sets are disjoint, so results simply concatenate).
+// ONE ctypes crossing instead of one per shard: the per-call FFI
+// overhead was the sharded indexer's match-latency floor.
+size_t dyn_radix_find_multi(void* const* hs, size_t n_trees,
+                            const uint64_t* seq_hashes, size_t n,
+                            int64_t* out_workers, uint32_t* out_scores,
+                            size_t cap) {
+  std::vector<int64_t> workers;
+  std::vector<uint32_t> scores;
+  for (size_t t = 0; t < n_trees; ++t)
+    find_impl(static_cast<Radix*>(hs[t]), seq_hashes, n, workers, scores);
   size_t out = workers.size() < cap ? workers.size() : cap;
   for (size_t i = 0; i < out; ++i) {
     out_workers[i] = workers[i];
