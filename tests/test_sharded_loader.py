@@ -1,0 +1,71 @@
+"""Shard-aware checkpoint loading (VERDICT r3 item 6, the 70B ladder):
+``load_params_sharded`` must produce arrays identical to the stacked
+loader — same global values, same shardings — while each process only
+ever reads its own slices (safetensors partial reads +
+jax.make_array_from_callback)."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import param_specs
+from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
+from tests.test_quantization import _write_tiny_checkpoint
+
+
+def _cfg(**kw):
+    defaults = dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=8,
+        max_position_embeddings=128,
+    )
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+def _host(arr) -> np.ndarray:
+    return np.asarray(jax.device_get(arr))
+
+
+@pytest.mark.parametrize("tied", [False, True])
+@pytest.mark.parametrize("quantize", [None, "int8"])
+def test_sharded_load_matches_stacked(tmp_path, tied, quantize):
+    from dynamo_tpu.models.loader import load_params, load_params_sharded
+
+    cfg = _cfg()
+    path = str(tmp_path / "ckpt")
+    _write_tiny_checkpoint(cfg, path, tied=tied, seed=3)
+    # tp=8 exercises the 70B ladder's per-shard geometry (Hkv/tp = 1)
+    mesh = build_mesh(MeshConfig(tp=8), jax.devices()[:8])
+    ref = load_params(cfg, path, mesh, quantize=quantize)
+    got = load_params_sharded(cfg, path, mesh, quantize=quantize)
+    assert set(ref) == set(got)
+    for name in sorted(ref):
+        r, g = _host(ref[name]), _host(got[name])
+        assert r.shape == g.shape, name
+        assert r.dtype == g.dtype, name
+        np.testing.assert_array_equal(r, g, err_msg=name)
+        assert ref[name].sharding == got[name].sharding, name
+
+
+def test_sharded_load_serves_through_engine(tmp_path):
+    """resolve_model with DYN_SHARDED_LOAD=1 produces a servable model
+    (forward parity is transitively covered by the equality test; this
+    guards the resolve_model wiring)."""
+    from dynamo_tpu.models import loader
+
+    cfg = _cfg()
+    path = str(tmp_path / "ckpt")
+    _write_tiny_checkpoint(cfg, path, seed=7)
+    mesh = build_mesh(MeshConfig(tp=8), jax.devices()[:8])
+    os.environ["DYN_SHARDED_LOAD"] = "1"
+    try:
+        mc, params = loader.resolve_model(path, mesh=mesh)
+    finally:
+        os.environ.pop("DYN_SHARDED_LOAD", None)
+    assert mc.hidden_size == cfg.hidden_size
+    assert params["wq"].sharding.spec == param_specs(mc)["wq"]
