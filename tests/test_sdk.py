@@ -88,7 +88,10 @@ async def test_serve_service_and_dependency_calls():
         # and through Middle's own endpoint engine
         comp = drt_b.namespace("sdktest").component("middle")
         client = await comp.endpoint("generate").client()
-        ids = await client.wait_for_instances(timeout_s=5)
+        # generous budget: the wait is event-driven (store watch), but
+        # under full-suite load discovery propagation can take far
+        # longer than the happy-path seconds (r3 flake)
+        ids = await client.wait_for_instances(timeout_s=60)
         stream = await client.generate_direct(ids[0], {"tokens": [5]})
         items = [i async for i in stream]
         assert items == [{"token": 11}]
