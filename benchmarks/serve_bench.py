@@ -63,7 +63,7 @@ SHAPES = {
             max_position_embeddings=8192,
         ),
         engine=dict(random_weights=True, quantization="int8",
-                    block_size=16, max_batch_size=32, decode_steps=32,
+                    block_size=128, max_batch_size=32, decode_steps=32,
                     hbm_utilization=0.7, prefill_chunk_size=1024,
                     max_model_len=320),
         # isl is in WORDS (load_gen builds text); the test tokenizer
@@ -71,6 +71,28 @@ SHAPES = {
         # matching bench.py's 128/128 token workload under
         # max_model_len=320
         isl=14, osl=128, duration=90.0, concurrency=[1, 4, 16, 32],
+    ),
+    # the REFERENCE methodology (examples/llm/benchmarks/README.md:28-100
+    # + perf.sh): ISL 3000 tokens / OSL 150, concurrency 1 -> 256.
+    # Real block-table widths, real HBM pressure: one 16 GB chip's KV
+    # budget holds only a handful of 3.2k-token contexts resident, so
+    # high concurrencies measure the scheduler's admission/queueing
+    # behavior under pressure — exactly what the r3 sweep (130-token
+    # prompts, max_model_len 320) never exercised.
+    "tpu_ref": dict(
+        config=dict(
+            model_type="llama", vocab_size=128256, hidden_size=4096,
+            intermediate_size=14336, num_hidden_layers=32,
+            num_attention_heads=32, num_key_value_heads=8,
+            max_position_embeddings=8192,
+        ),
+        engine=dict(random_weights=True, quantization="int8",
+                    block_size=128, max_batch_size=32, decode_steps=32,
+                    hbm_utilization=0.7, prefill_chunk_size=1024,
+                    max_model_len=3328),
+        # ~9 tokens/word with the test tokenizer: 334 words ≈ 3000
+        # prompt tokens
+        isl=334, osl=150, duration=120.0, concurrency=[1, 4, 16, 64, 256],
     ),
 }
 
@@ -145,7 +167,7 @@ async def drive(args, shape: dict) -> list[dict]:
 
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--mode", choices=["cpu", "tpu"], default="cpu")
+    p.add_argument("--mode", choices=["cpu", "tpu", "tpu_ref"], default="cpu")
     p.add_argument("--duration", type=float, default=None)
     p.add_argument("--concurrency", default=None, help="comma list override")
     p.add_argument("--ready-timeout", type=float, default=1200.0)

@@ -696,8 +696,8 @@ async def _run_sp_prefill_worker(args: Any, ns: str) -> None:
         ecfg.model_path, random_weights=ecfg.random_weights, seed=ecfg.seed
     )
     prefiller = LongContextPrefiller(
-        mc, params, mesh, block_size=ecfg.block_size, attn=args.sp_attn,
-        kv_dtype=ecfg.kv_cache_dtype,
+        mc, params, mesh, block_size=ecfg.resolve_block_size(),
+        attn=args.sp_attn, kv_dtype=ecfg.kv_cache_dtype,
     )
     drt = await DistributedRuntime.create(config=_runtime_config(args))
     drt.runtime.install_signal_handlers()

@@ -19,8 +19,11 @@ class EngineConfig:
     num_nodes: int = 1
     node_rank: int = 0
     leader_addr: str = ""
-    # KV cache
-    block_size: int = 16
+    # KV cache. block_size None = auto: 128-token pages on TPU backends
+    # (measured +20% decode and the prefill kernel's MXU-width match —
+    # 16-wide pages run the flash dots at 16/128 systolic efficiency),
+    # 16 elsewhere (CPU tests, finer prefix-cache granularity).
+    block_size: Optional[int] = None
     num_blocks: Optional[int] = None  # None = size by gpu_memory_utilization
     hbm_utilization: float = 0.9
     kv_cache_dtype: str = "bfloat16"
@@ -88,6 +91,15 @@ class EngineConfig:
     quantization: Optional[str] = None
     seed: int = 0
     extra: dict[str, Any] = field(default_factory=dict)
+
+    def resolve_block_size(self) -> int:
+        """The effective page size (see block_size). Initializes the
+        JAX backend — call only where that is already safe."""
+        if self.block_size is not None:
+            return self.block_size
+        import jax
+
+        return 128 if jax.default_backend() == "tpu" else 16
 
     @property
     def mesh_devices(self) -> int:

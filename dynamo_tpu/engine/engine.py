@@ -162,6 +162,10 @@ class JaxEngine:
         # after distributed init: probing the backend before it would
         # break jax.distributed.initialize (must precede any XLA call)
         enable_compile_cache()  # restarts reuse tunnel-compiled variants
+        if cfg.block_size is None:
+            # 128-token pages on TPU (MXU-width flash dots, +20%
+            # measured decode), 16 elsewhere — see EngineConfig
+            cfg.block_size = cfg.resolve_block_size()
         mesh_cfg = MeshConfig(
             dp=cfg.data_parallel_size,
             pp=cfg.pipeline_parallel_size,
