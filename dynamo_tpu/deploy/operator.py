@@ -262,16 +262,61 @@ class Reconciler:
 
     # -- loop --------------------------------------------------------------
     async def run(self, shutdown: Optional[asyncio.Event] = None) -> None:
+        """Event-driven control loop: a store WATCH on the deployment
+        spec prefix triggers an immediate reconcile on every spec
+        change (the reference's controller-runtime operator watches its
+        CRDs the same way —
+        deploy/cloud/operator/internal/controller/*_controller.go);
+        ``interval_s`` remains as the periodic resync that catches
+        drift in the ACTUAL state (crashed replicas, manual scaling)."""
         shutdown = shutdown or asyncio.Event()
-        while not shutdown.is_set():
-            try:
-                await self.reconcile_once()
-            except Exception:
-                log.exception("reconcile pass failed")
-            try:
-                await asyncio.wait_for(shutdown.wait(), timeout=self.interval_s)
-            except asyncio.TimeoutError:
-                pass
+        wake = asyncio.Event()
+        watch = None
+        pump_task: Optional[asyncio.Task] = None
+        try:
+            watch = await self.store.watch_prefix(
+                deployment_key(self.namespace, "")
+            )
+
+            async def pump() -> None:
+                try:
+                    async for _ev in watch:
+                        wake.set()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("spec watch died; falling back to polling")
+
+            pump_task = asyncio.create_task(pump())
+        except Exception:
+            log.warning("store watch unavailable; reconciling by poll only")
+        try:
+            while not shutdown.is_set():
+                # clear BEFORE reconciling: a spec change landing while
+                # the pass is in flight re-sets the event and triggers
+                # the next pass instead of being lost until the resync
+                wake.clear()
+                try:
+                    await self.reconcile_once()
+                except Exception:
+                    log.exception("reconcile pass failed")
+                stop_t = asyncio.create_task(shutdown.wait())
+                wake_t = asyncio.create_task(wake.wait())
+                done, pending = await asyncio.wait(
+                    {stop_t, wake_t},
+                    timeout=self.interval_s,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                for t in pending:
+                    t.cancel()
+        finally:
+            if pump_task is not None:
+                pump_task.cancel()
+            if watch is not None:
+                try:
+                    await watch.close()
+                except Exception:
+                    pass
 
     # -- spec CRUD (shared by api-store and the deploy CLI) ---------------
     async def apply(self, spec: GraphDeploymentSpec) -> None:

@@ -413,3 +413,33 @@ async def test_mirror_only_touches_owned_files(tmp_path):
     assert not any(n.startswith("dgd.ns.") for n in names)
     assert any("theirs" in n for n in names)
     await store.close()
+
+
+async def test_reconciler_watch_triggers_immediate_reconcile():
+    """The control loop is EVENT-driven (reference: the controller-
+    runtime operator watches its CRDs): applying a spec must reconcile
+    promptly even with a long periodic-resync interval."""
+    store = MemoryStore()
+    sup = FakeSupervisor(store, "ns", {"backend": 1})
+    await sup.start()
+    rec = Reconciler(store, "ns", interval_s=60.0)
+    stop = asyncio.Event()
+    task = asyncio.create_task(rec.run(stop))
+    try:
+        await asyncio.sleep(0.3)  # loop idle, waiting on watch/interval
+        await rec.apply(GraphDeploymentSpec(
+            name="d1", namespace="ns",
+            services={"backend": ServiceSpec(replicas=3)},
+        ))
+        deadline = asyncio.get_running_loop().time() + 10.0
+        while asyncio.get_running_loop().time() < deadline:
+            if sup.counts.get("backend") == 3:
+                break
+            await asyncio.sleep(0.1)
+        # far faster than the 60s resync: the watch drove it
+        assert sup.counts.get("backend") == 3
+    finally:
+        stop.set()
+        await asyncio.wait_for(task, 10)
+        await sup.stop()
+        await store.close()
