@@ -73,10 +73,12 @@ class EngineConfig:
     prewarm: Optional[bool] = None
     # also prewarm the penalty-sampling step variants (requests using
     # frequency/presence/repetition penalties select a separately-
-    # compiled step carrying token-count tables). Off by default: it
-    # roughly doubles startup compiles for a feature many deployments
-    # never receive — the first penalties request then pays a one-time
-    # compile stall instead.
+    # compiled step carrying token-count tables) — covers the dedicated
+    # prefill shapes and the pure decode windows, the only paths such
+    # requests take (they never ride the mixed rectangle). Off by
+    # default: it roughly doubles startup compiles for a feature many
+    # deployments never receive — the first penalties request then pays
+    # a one-time compile stall instead.
     prewarm_penalties: bool = False
     # likewise for the top-logprobs step variant (requests with
     # top_logprobs > 0 / completions logprobs > 0). Off by default for

@@ -446,9 +446,14 @@ class JaxEngine:
                 [1] * n if toplp else None,
             )
 
-        # opt-in: also compile the top-logprobs output variants
+        # opt-in variant axes: top-logprobs outputs and penalty tables
+        # (each is a distinct jit signature; the cross product is only
+        # compiled when BOTH flags are on)
         tlp_variants = (
             [False, True] if self.config.prewarm_logprobs else [False]
+        )
+        pen_variants = (
+            [False, True] if self.config.prewarm_penalties else [False]
         )
 
         def prefill_arrays(b: int, t: int) -> dict[str, np.ndarray]:
@@ -497,16 +502,18 @@ class JaxEngine:
                     ):
                         continue
                     for tv in tlp_variants:
-                        a = prefill_arrays(b, chunk)
-                        s = sampling_for(b, toplp=tv)
-                        out = self._step_fn(
-                            self.params, self.k_cache, self.v_cache,
-                            a["tokens"], a["positions"], a["slot_mapping"],
-                            a["block_tables"], a["context_lens"],
-                            a["last_token_idx"], s.arrays,
-                        )
-                        self.k_cache, self.v_cache = out[-2], out[-1]
-                        jax.block_until_ready(self.k_cache)
+                        for pv in pen_variants:
+                            a = prefill_arrays(b, chunk)
+                            s = sampling_for(b, penalties=pv, toplp=tv)
+                            out = self._step_fn(
+                                self.params, self.k_cache, self.v_cache,
+                                a["tokens"], a["positions"],
+                                a["slot_mapping"], a["block_tables"],
+                                a["context_lens"], a["last_token_idx"],
+                                s.arrays,
+                            )
+                            self.k_cache, self.v_cache = out[-2], out[-1]
+                            jax.block_until_ready(self.k_cache)
         decode_buckets = sorted(
             {b for b in (sched.decode_batch_small, sched.decode_batch_pad)
              if b}
@@ -533,15 +540,17 @@ class JaxEngine:
             # single-step decode serving shapes (decode_steps == 1)
             for Bd in decode_buckets:
                 for tv in tlp_variants:
-                    a = decode_arrays(Bd)
-                    s = sampling_for(Bd, toplp=tv)
-                    out = self._step_fn(
-                        self.params, self.k_cache, self.v_cache, a["tokens"],
-                        a["positions"], a["slot_mapping"], a["block_tables"],
-                        a["context_lens"], a["last_token_idx"], s.arrays,
-                    )
-                    self.k_cache, self.v_cache = out[-2], out[-1]
-                    jax.block_until_ready(self.k_cache)
+                    for pv in pen_variants:
+                        a = decode_arrays(Bd)
+                        s = sampling_for(Bd, penalties=pv, toplp=tv)
+                        out = self._step_fn(
+                            self.params, self.k_cache, self.v_cache,
+                            a["tokens"], a["positions"], a["slot_mapping"],
+                            a["block_tables"], a["context_lens"],
+                            a["last_token_idx"], s.arrays,
+                        )
+                        self.k_cache, self.v_cache = out[-2], out[-1]
+                        jax.block_until_ready(self.k_cache)
         lasts: dict[int, Any] = {}
         p_nexts: dict[int, Any] = {}
         if self._multi_step_fn is not None:
@@ -703,15 +712,23 @@ class JaxEngine:
             self.config.max_batch_size * self.config.prefill_chunk_size,
             2 * (self.config.max_prefill_tokens or self.config.prefill_chunk_size),
         )
-        # scores-width estimate: capped — attention scores are one
-        # layer-transient, and an uncapped max_position_embeddings
-        # (e.g. 8192 default) would swallow the whole budget and floor
-        # the cache into thrashing territory
-        s_est = min(
-            (self.config.max_model_len or mc.max_position_embeddings)
-            + 8 * self.config.block_size,
-            4096,
-        )
+        # scores-width estimate: only the XLA reference attention
+        # materializes [T, S] scores (one layer-transient, capped so an
+        # uncapped max_position_embeddings can't swallow the budget).
+        # The Pallas flash kernels keep scores in VMEM — charging HBM
+        # for them would waste gigabytes of KV capacity exactly on the
+        # long-context workloads that need it (at max_model_len 3328 /
+        # max_prefill_tokens 4096 the phantom term is ~4 GB).
+        from dynamo_tpu.models.llama import pallas_attention_active
+
+        if pallas_attention_active():
+            s_est = 0
+        else:
+            s_est = min(
+                (self.config.max_model_len or mc.max_position_embeddings)
+                + 8 * self.config.block_size,
+                4096,
+            )
         e_mult = max(1, mc.num_local_experts)
         per_tok = (
             12 * mc.hidden_size
@@ -1716,18 +1733,16 @@ class JaxEngine:
         # dispatch the first window
         if works:
             p_arrays = sched.build_prefill_batch_arrays(works)
-            # Multimodal chunks AND top-logprobs batches take a
-            # dedicated prefill step instead of the mixed rectangle:
-            # embedding injection doesn't ride the fixed rectangle, and
-            # the mixed toplp jit variant is deliberately NOT part of
-            # the prewarm set (prewarm_logprobs covers dedicated
-            # prefill + pure windows; an unwarmed variant is a
-            # multi-minute mid-serve compile over a chip tunnel).
-            # Decode follows on the next plan.
-            if "extra_embeds" in p_arrays or (
-                self._wants_toplp([w.seq for w in works])
-                or self._wants_toplp(seqs)
-            ):
+            # Multimodal chunks, top-logprobs AND penalty/bias batches
+            # take a dedicated prefill step instead of the mixed
+            # rectangle: embedding injection doesn't ride the fixed
+            # rectangle, and the mixed jit variants for those sampling
+            # features are deliberately NOT part of the prewarm set
+            # (the opt-in prewarms cover dedicated prefill + pure
+            # windows; an unwarmed variant is a multi-minute mid-serve
+            # compile over a chip tunnel). Decode follows on the next
+            # plan.
+            if "extra_embeds" in p_arrays or penalties_in(works, seqs):
                 sampling = self._batch_sampling(
                     [w.seq for w in works], p_arrays["tokens"].shape[0]
                 )

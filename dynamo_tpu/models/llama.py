@@ -330,6 +330,19 @@ def get_attention_mesh() -> Optional[Mesh]:
     return _ATTN_MESH
 
 
+def pallas_attention_active() -> bool:
+    """True when the model will ACTUALLY dispatch the Pallas attention
+    kernels (the predicate attend_mlp uses) — impl choice AND a usable
+    device/mesh configuration. The engine's HBM auto-sizing keys off
+    this same predicate: sizing on attn_impl() alone would zero the
+    XLA-path scores-transient budget in configurations (e.g. pp meshes,
+    where the attention mesh is deliberately unset) that still run the
+    reference path."""
+    return attn_impl() == "pallas" and (
+        jax.device_count() == 1 or _ATTN_MESH is not None
+    )
+
+
 def attn_impl() -> str:
     """Attention implementation: DYN_ATTN_IMPL = auto|reference|pallas.
 
@@ -382,12 +395,8 @@ def make_layer_parts(
         q, k = rope(q, k, positions, cfg.rope_theta)
         return q, k, v
 
-    def _use_pallas_decode() -> bool:
-        """True when the Pallas decode kernel should run (vs the XLA
-        reference path)."""
-        return attn_impl() == "pallas" and (
-            jax.device_count() == 1 or _ATTN_MESH is not None
-        )
+    # one predicate for dispatch AND the engine's HBM sizing
+    _use_pallas_decode = pallas_attention_active
 
     def _pallas_decode_attn(q, stacked_args):
         """Run the flash-decode kernel (shard_mapped per tp shard on
