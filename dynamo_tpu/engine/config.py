@@ -71,14 +71,14 @@ class EngineConfig:
     # on for TPU backends, off elsewhere). Lazy compiles take minutes
     # over a chip tunnel and land mid-serve as 100 s+ TTFT stalls.
     prewarm: Optional[bool] = None
-    # also prewarm the penalty-sampling step variants (requests using
-    # frequency/presence/repetition penalties select a separately-
-    # compiled step carrying token-count tables) — covers the dedicated
-    # prefill shapes and the pure decode windows, the only paths such
-    # requests take (they never ride the mixed rectangle). Off by
-    # default: it roughly doubles startup compiles for a feature many
-    # deployments never receive — the first penalties request then pays
-    # a one-time compile stall instead.
+    # also prewarm the penalty-sampling AND logit-bias step variants
+    # (each selects a separately-compiled step carrying its tables) —
+    # covers the dedicated prefill shapes and the pure decode windows,
+    # the only paths such requests take (they never ride the mixed
+    # rectangle). Off by default: it multiplies startup compiles for
+    # features many deployments never receive — the first such request
+    # then pays a one-time compile stall instead. Multi-feature combos
+    # in one batch (e.g. bias+penalties) always compile on first use.
     prewarm_penalties: bool = False
     # likewise for the top-logprobs step variant (requests with
     # top_logprobs > 0 / completions logprobs > 0). Off by default for

@@ -429,7 +429,8 @@ class JaxEngine:
         width = sched.table_width_pad or sched.TABLE_BUCKET
 
         def sampling_for(
-            n: int, penalties: bool = False, toplp: bool = False
+            n: int, penalties: bool = False, toplp: bool = False,
+            bias: bool = False,
         ) -> SamplingBatch:
             opts = (
                 SamplingOptions(
@@ -439,6 +440,8 @@ class JaxEngine:
                 if penalties
                 else SamplingOptions(use_greedy=True)
             )
+            if bias:
+                opts = opts.model_copy(update={"logit_bias": {1: 0.0}})
             return SamplingBatch.from_options(
                 [opts] * n, [0] * n,
                 [{} for _ in range(n)] if penalties else None,
@@ -446,15 +449,24 @@ class JaxEngine:
                 [1] * n if toplp else None,
             )
 
-        # opt-in variant axes: top-logprobs outputs and penalty tables
-        # (each is a distinct jit signature; the cross product is only
-        # compiled when BOTH flags are on)
-        tlp_variants = (
-            [False, True] if self.config.prewarm_logprobs else [False]
-        )
-        pen_variants = (
-            [False, True] if self.config.prewarm_penalties else [False]
-        )
+        # Opt-in sampling-feature variants beyond the base signature,
+        # as (penalties, toplp, bias) tuples. prewarm_penalties warms
+        # the penalty AND logit-bias single-feature variants (the two
+        # features that divert to dedicated prefill + pure windows);
+        # prewarm_logprobs warms top-logprobs; with both flags the
+        # penalties+toplp combo is warmed too. Multi-feature combos
+        # beyond that (e.g. bias+penalties in one batch) still compile
+        # on first use — the cross product would explode startup time.
+        feat_variants: list[tuple[bool, bool, bool]] = [
+            (False, False, False)
+        ]
+        if self.config.prewarm_logprobs:
+            feat_variants.append((False, True, False))
+        if self.config.prewarm_penalties:
+            feat_variants.append((True, False, False))
+            feat_variants.append((False, False, True))
+        if self.config.prewarm_logprobs and self.config.prewarm_penalties:
+            feat_variants.append((True, True, False))
 
         def prefill_arrays(b: int, t: int) -> dict[str, np.ndarray]:
             return {
@@ -501,73 +513,58 @@ class JaxEngine:
                         and b * chunk > sched.max_prefill_tokens
                     ):
                         continue
-                    for tv in tlp_variants:
-                        for pv in pen_variants:
-                            a = prefill_arrays(b, chunk)
-                            s = sampling_for(b, penalties=pv, toplp=tv)
-                            out = self._step_fn(
-                                self.params, self.k_cache, self.v_cache,
-                                a["tokens"], a["positions"],
-                                a["slot_mapping"], a["block_tables"],
-                                a["context_lens"], a["last_token_idx"],
-                                s.arrays,
-                            )
-                            self.k_cache, self.v_cache = out[-2], out[-1]
-                            jax.block_until_ready(self.k_cache)
+                    for pv, tv, bv in feat_variants:
+                        a = prefill_arrays(b, chunk)
+                        s = sampling_for(b, penalties=pv, toplp=tv, bias=bv)
+                        out = self._step_fn(
+                            self.params, self.k_cache, self.v_cache,
+                            a["tokens"], a["positions"],
+                            a["slot_mapping"], a["block_tables"],
+                            a["context_lens"], a["last_token_idx"],
+                            s.arrays,
+                        )
+                        self.k_cache, self.v_cache = out[-2], out[-1]
+                        jax.block_until_ready(self.k_cache)
         decode_buckets = sorted(
             {b for b in (sched.decode_batch_small, sched.decode_batch_pad)
              if b}
         ) or [next_bucket(1, sched.BATCH_BUCKETS)]
         B = decode_buckets[-1]
-        if self.config.prewarm_penalties and self._multi_step_fn is not None:
-            # opt-in: the penalty-table step variant (default: the
-            # first penalties request pays a one-time compile instead).
-            # With prewarm_logprobs also on, the penalties+top-logprobs
-            # COMBINED pytree is its own jit signature — warm it too.
+        if self._multi_step_fn is not None:
+            # opt-in sampling-feature window variants (the base window
+            # is warmed with chaining below)
             for Bd in decode_buckets:
-                for tv in tlp_variants:
+                for pv, tv, bv in feat_variants[1:]:
                     a = decode_arrays(Bd)
                     packed, _, self.k_cache, self.v_cache = (
                         self._multi_step_fn(
                             self.params, self.k_cache, self.v_cache,
                             a["tokens"], a["positions"], a["block_tables"],
                             a["context_lens"], a["valid_steps"],
-                            sampling_for(Bd, penalties=True, toplp=tv).arrays,
+                            sampling_for(
+                                Bd, penalties=pv, toplp=tv, bias=bv
+                            ).arrays,
                         )
                     )
                     jax.block_until_ready(packed)
         if self._multi_step_fn is None:
             # single-step decode serving shapes (decode_steps == 1)
             for Bd in decode_buckets:
-                for tv in tlp_variants:
-                    for pv in pen_variants:
-                        a = decode_arrays(Bd)
-                        s = sampling_for(Bd, penalties=pv, toplp=tv)
-                        out = self._step_fn(
-                            self.params, self.k_cache, self.v_cache,
-                            a["tokens"], a["positions"], a["slot_mapping"],
-                            a["block_tables"], a["context_lens"],
-                            a["last_token_idx"], s.arrays,
-                        )
-                        self.k_cache, self.v_cache = out[-2], out[-1]
-                        jax.block_until_ready(self.k_cache)
+                for pv, tv, bv in feat_variants:
+                    a = decode_arrays(Bd)
+                    s = sampling_for(Bd, penalties=pv, toplp=tv, bias=bv)
+                    out = self._step_fn(
+                        self.params, self.k_cache, self.v_cache,
+                        a["tokens"], a["positions"], a["slot_mapping"],
+                        a["block_tables"], a["context_lens"],
+                        a["last_token_idx"], s.arrays,
+                    )
+                    self.k_cache, self.v_cache = out[-2], out[-1]
+                    jax.block_until_ready(self.k_cache)
         lasts: dict[int, Any] = {}
         p_nexts: dict[int, Any] = {}
         if self._multi_step_fn is not None:
             for Bd in decode_buckets:
-                for tv in [v for v in tlp_variants if v]:
-                    # top-lp window variant (unchained — that path
-                    # doesn't pipeline, so no chained-token warm needed)
-                    a = decode_arrays(Bd)
-                    s = sampling_for(Bd, toplp=True)
-                    packed, _lt, self.k_cache, self.v_cache = (
-                        self._multi_step_fn(
-                            self.params, self.k_cache, self.v_cache,
-                            a["tokens"], a["positions"], a["block_tables"],
-                            a["context_lens"], a["valid_steps"], s.arrays,
-                        )
-                    )
-                    jax.block_until_ready(packed)
                 a, s = decode_arrays(Bd), sampling_for(Bd)
                 packed, last_tok, self.k_cache, self.v_cache = (
                     self._multi_step_fn(

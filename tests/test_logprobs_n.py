@@ -437,3 +437,45 @@ def test_token_bytes_reassemble_multibyte():
     assert any("�" in t.decode([i]) for i in ids)
     joined = b"".join(t.token_bytes(i) for i in ids)
     assert joined == t.decode(ids).encode("utf-8")
+
+
+async def test_logit_bias_variant_end_to_end():
+    """logit_bias is presence-keyed (a separate jit variant): a biased
+    request must actually steer sampling, and bias-free requests on the
+    same engine keep using the bias-free variant."""
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    engine = await JaxEngine.launch(_engine_config())
+    try:
+        prompt = list(range(1, 16))
+        base = PreprocessedRequest(
+            request_id="nb", token_ids=prompt,
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=3),
+        )
+        toks_base = [
+            t for it in await _collect(engine, base) for t in it.token_ids
+        ]
+        # +30 bias on a fixed token overwhelms a random-weight model's
+        # logits: every greedy pick becomes that token
+        forced = 7
+        biased = PreprocessedRequest(
+            request_id="wb", token_ids=prompt,
+            sampling=SamplingOptions(
+                use_greedy=True, logit_bias={forced: 30.0}
+            ),
+            stop=StopConditions(max_tokens=3),
+        )
+        toks_b = [
+            t for it in await _collect(engine, biased) for t in it.token_ids
+        ]
+        assert toks_b == [forced] * 3
+        # and the engine still serves unbiased traffic identically
+        base2 = base.model_copy(deep=True)
+        base2.request_id = "nb2"
+        toks_base2 = [
+            t for it in await _collect(engine, base2) for t in it.token_ids
+        ]
+        assert toks_base2 == toks_base
+    finally:
+        await engine.shutdown()
