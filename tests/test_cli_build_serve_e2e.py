@@ -27,7 +27,10 @@ def test_build_push_serve_package_e2e(tmp_path):
             [sys.executable, "-m", "dynamo_tpu.cli.main", "build",
              "examples.hello_world.graph:Frontend", "--name", "hello",
              "-o", str(out), "--push", *common],
-            env=ENV, cwd=REPO, capture_output=True, text=True, timeout=120,
+            # generous: under full-suite load the interpreter-heavy
+            # build subprocess can take far longer than its isolated
+            # ~10 s (load flake otherwise)
+            env=ENV, cwd=REPO, capture_output=True, text=True, timeout=300,
         )
         assert r.returncode == 0, r.stdout + r.stderr
         assert "pushed hello:" in r.stdout
@@ -77,7 +80,7 @@ def test_build_push_serve_package_e2e(tmp_path):
                     drt.namespace("hello").component("frontend")
                     .endpoint("generate").client()
                 )
-                ids = await client.wait_for_instances(120)
+                ids = await client.wait_for_instances(300)
                 stream = await client.generate_direct(
                     ids[0], {"text": "ship it"}, Context()
                 )
