@@ -77,6 +77,17 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--mixed-prefill-len", type=int, default=256,
                      help="per-row token cap of the mixed prefill "
                           "rectangle")
+    run.add_argument("--mixed-prefill-wide-len", type=int, default=1024,
+                     help="adaptive WIDE mixed rectangle: at low decode "
+                          "occupancy the mixed window swaps to "
+                          "[rows*len/wide_len, wide_len] (same token "
+                          "budget, fewer rows) so long prompts stop "
+                          "trickling at --mixed-prefill-len per window; "
+                          "0 disables")
+    run.add_argument("--mixed-wide-max-running", type=int, default=4,
+                     help="decode-occupancy ceiling for the wide "
+                          "rectangle (above it the narrow rectangle's "
+                          "extra rows win)")
     run.add_argument("--tensor-parallel-size", type=int, default=1)
     run.add_argument("--pipeline-parallel-size", type=int, default=1,
                      help="GPipe stage rotation over a pp mesh axis")

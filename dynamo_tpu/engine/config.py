@@ -60,6 +60,21 @@ class EngineConfig:
     # of 16 — half the batch idle)
     mixed_prefill_rows: int = 8
     mixed_prefill_len: int = 256
+    # adaptive WIDE mixed rectangle: when decode occupancy is low
+    # (running <= mixed_wide_max_running) and few prompts are
+    # prefilling, the mixed window swaps its rectangle for
+    # [~rows*len/wide_len, wide_len] — same token budget, fewer rows —
+    # so a long prompt prefills in backlog/wide_len windows instead of
+    # backlog/len (measured: a 3000-token prompt at ISL-3000/c=4 took
+    # 12 windows = 8.4 s TTFT through the 256-token trickle; dedicated
+    # prefill instead starves decode — benchmarks/RESULTS.md negative
+    # result). 0 disables. The wide variant costs a few extra prewarm
+    # compiles at startup.
+    mixed_prefill_wide_len: int = 1024
+    # decode-occupancy ceiling for the wide rectangle: above this many
+    # running sequences the narrow rectangle's extra rows matter more
+    # than per-prompt prefill latency
+    mixed_wide_max_running: int = 4
     # static serving shapes: pad the decode batch to max_batch_size and
     # block-table width to the max_model_len cap so the decode/mixed
     # dispatch is ONE compiled shape (padded rows are ~free — decode is
@@ -132,6 +147,14 @@ def load_engine_config(args: Any) -> EngineConfig:
             args, "mixed_prefill_rows", EngineConfig.mixed_prefill_rows
         ),
         mixed_prefill_len=getattr(args, "mixed_prefill_len", 256),
+        mixed_prefill_wide_len=getattr(
+            args, "mixed_prefill_wide_len",
+            EngineConfig.mixed_prefill_wide_len,
+        ),
+        mixed_wide_max_running=getattr(
+            args, "mixed_wide_max_running",
+            EngineConfig.mixed_wide_max_running,
+        ),
         host_kv_blocks=getattr(args, "host_kv_blocks", 0),
         disk_kv_blocks=getattr(args, "disk_kv_blocks", 0),
         disk_kv_path=getattr(args, "disk_kv_path", ""),

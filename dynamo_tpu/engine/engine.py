@@ -100,6 +100,9 @@ class JaxEngine:
         self._step_fn_mm: Optional[Callable] = None
         self._multi_step_fn: Optional[Callable] = None
         self._mixed_step_fn: Optional[Callable] = None
+        # wide mixed rectangle (rows, len), set when enabled (see
+        # _initialize; scheduler._mixed_rect picks per population)
+        self._wide_rect: Optional[tuple[int, int]] = None
         self._pp = config.pipeline_parallel_size
         # multi-host: rank 0 leads (scheduler + broadcast), others follow
         self._is_follower = config.num_nodes > 1 and config.node_rank > 0
@@ -349,6 +352,44 @@ class JaxEngine:
                 cfg.mixed_prefill_rows = 0
             self.scheduler.mixed_prefill_rows = cfg.mixed_prefill_rows
             self.scheduler.mixed_prefill_len = cfg.mixed_prefill_len
+            # adaptive WIDE rectangle: same token budget, fewer rows —
+            # long prompts at low decode occupancy prefill in
+            # backlog/wide_len windows instead of backlog/len
+            # (config.mixed_prefill_wide_len; scheduler._mixed_rect)
+            wide = getattr(cfg, "mixed_prefill_wide_len", 0)
+            if cfg.mixed_prefill_rows > 0 and wide > cfg.mixed_prefill_len:
+                # never wider than one prefill chunk: _plan_prefill_batch
+                # caps every row's chunk at prefill_chunk_size, so a
+                # longer rectangle would dispatch permanently-padded
+                # dead tokens
+                wl = next_bucket(
+                    min(wide, cfg.prefill_chunk_size), pc
+                )
+                while wl > max(cap, pc[0]) and wl > pc[0]:
+                    wl = down(wl, pc)
+                # the wide rect keeps the narrow rect's token budget
+                # (rows*len): shrink wl until at least one row fits —
+                # if that lands back at the narrow len, the budget is
+                # too small for a wide variant and it stays disabled
+                budget = cfg.mixed_prefill_rows * cfg.mixed_prefill_len
+                while budget // wl < 1 and wl > pc[0]:
+                    wl = down(wl, pc)
+                wr = min(budget // wl, cap // wl)
+                if wl > cfg.mixed_prefill_len and wr >= 1:
+                    sched = self.scheduler
+                    if wr not in sched.prefill_batch_buckets:
+                        # the rectangle must be a batch bucket, or
+                        # bucketed prefill arrays round PAST it and
+                        # every wide mixed step crashes
+                        sched.prefill_batch_buckets = sorted(
+                            set(sched.prefill_batch_buckets) | {wr}
+                        )
+                    sched.mixed_prefill_wide_rows = wr
+                    sched.mixed_prefill_wide_len = wl
+                    sched.mixed_wide_max_running = getattr(
+                        cfg, "mixed_wide_max_running", 4
+                    )
+                    self._wide_rect = (wr, wl)
         self.scheduler.on_finish = self._emit_finish
         if cfg.disk_kv_blocks > 0 and cfg.host_kv_blocks <= 0:
             raise ValueError(
@@ -594,51 +635,58 @@ class JaxEngine:
             self._mixed_step_fn is not None
             and sched.mixed_prefill_rows > 0
         ):
-            P, T = self.config.mixed_prefill_rows, self.config.mixed_prefill_len
-            p = prefill_arrays(P, T)
-            sp = sampling_for(P)
-            for Bd in decode_buckets:
-                d = decode_arrays(Bd)
-                sd = sampling_for(Bd)
-                flat, m_last, p_next, self.k_cache, self.v_cache = (
-                    self._mixed_step_fn(
-                        self.params, self.k_cache, self.v_cache,
-                        p["tokens"], p["positions"], p["slot_mapping"],
-                        p["block_tables"], p["context_lens"],
-                        p["last_token_idx"], sp.arrays,
-                        d["tokens"], d["positions"], d["block_tables"],
-                        d["context_lens"], d["valid_steps"], sd.arrays,
+            rects = [
+                (self.config.mixed_prefill_rows, self.config.mixed_prefill_len)
+            ]
+            if self._wide_rect is not None:
+                rects.append(self._wide_rect)
+            for P, T in rects:
+                p = prefill_arrays(P, T)
+                sp = sampling_for(P)
+                for Bd in decode_buckets:
+                    d = decode_arrays(Bd)
+                    sd = sampling_for(Bd)
+                    flat, m_last, p_next, self.k_cache, self.v_cache = (
+                        self._mixed_step_fn(
+                            self.params, self.k_cache, self.v_cache,
+                            p["tokens"], p["positions"], p["slot_mapping"],
+                            p["block_tables"], p["context_lens"],
+                            p["last_token_idx"], sp.arrays,
+                            d["tokens"], d["positions"], d["block_tables"],
+                            d["context_lens"], d["valid_steps"], sd.arrays,
+                        )
                     )
-                )
-                assert self._chain_fn is not None
-                chained = self._chain_fn(
-                    m_last, p_next, np.zeros((Bd,), np.int32)
-                )
-                # chained-token mixed variant (pipelined mixed windows)
-                flat, m_last, p_next, self.k_cache, self.v_cache = (
-                    self._mixed_step_fn(
-                        self.params, self.k_cache, self.v_cache,
-                        p["tokens"], p["positions"], p["slot_mapping"],
-                        p["block_tables"], p["context_lens"],
-                        p["last_token_idx"], sp.arrays,
-                        chained, d["positions"], d["block_tables"],
-                        d["context_lens"], d["valid_steps"], sd.arrays,
+                    assert self._chain_fn is not None
+                    chained = self._chain_fn(
+                        m_last, p_next, np.zeros((Bd,), np.int32)
                     )
-                )
-                jax.block_until_ready(flat)
-                lasts[Bd] = m_last
-                p_nexts[Bd] = p_next
+                    # chained-token mixed variant (pipelined mixed windows)
+                    flat, m_last, p_next, self.k_cache, self.v_cache = (
+                        self._mixed_step_fn(
+                            self.params, self.k_cache, self.v_cache,
+                            p["tokens"], p["positions"], p["slot_mapping"],
+                            p["block_tables"], p["context_lens"],
+                            p["last_token_idx"], sp.arrays,
+                            chained, d["positions"], d["block_tables"],
+                            d["context_lens"], d["valid_steps"], sd.arrays,
+                        )
+                    )
+                    jax.block_until_ready(flat)
+                    lasts[Bd] = m_last
+                    p_nexts[(Bd, P)] = p_next
         if self._chain_pure_fn is not None:
             # chain gathers across bucket TRANSITIONS (population
-            # crossing the small-bucket boundary mid-pipeline)
+            # crossing the small-bucket boundary mid-pipeline), for
+            # every prefill-rectangle width in play (narrow + wide)
             for b_from in decode_buckets:
                 for b_to in decode_buckets:
                     if b_from == b_to or b_from not in lasts:
                         continue
                     idx = np.zeros((b_to,), np.int32)
                     self._chain_pure_fn(lasts[b_from], idx)
-                    if b_from in p_nexts:
-                        self._chain_fn(lasts[b_from], p_nexts[b_from], idx)
+                    for (bf, pw), pn in p_nexts.items():
+                        if bf == b_from:
+                            self._chain_fn(lasts[b_from], pn, idx)
         log.info("prewarm done in %.1fs", time.monotonic() - t0)
 
     def _auto_num_blocks(self, devices) -> int:
@@ -1377,7 +1425,9 @@ class JaxEngine:
         if plan.kind == "mixed":
             if self._mixed_step_fn is not None:
                 t0 = time.monotonic()
-                self._window_pipeline(plan.prefill_batch, plan.decode_seqs)
+                self._window_pipeline(
+                    plan.prefill_batch, plan.decode_seqs, rect=plan.rect
+                )
                 self._trace(
                     "mixed", ms=round((time.monotonic() - t0) * 1e3, 1)
                 )
@@ -1570,12 +1620,14 @@ class JaxEngine:
         sampling_p: SamplingBatch,
         sampling_d: SamplingBatch,
         tokens_dev=None,
+        rect: Optional[tuple[int, int]] = None,
     ):
         """Launch one mixed window; returns device (flat, last_tok,
         p_next) — callers sync `flat` when they need values."""
         assert self._mixed_step_fn is not None
-        P = self.config.mixed_prefill_rows
-        T = self.config.mixed_prefill_len
+        P, T = rect or (
+            self.config.mixed_prefill_rows, self.config.mixed_prefill_len
+        )
         width = max(
             p_arrays["block_tables"].shape[1],
             d_arrays["block_tables"].shape[1],
@@ -1609,13 +1661,18 @@ class JaxEngine:
                 sampling_d.arrays,
             )
         )
-        return flat, last_tok, p_next, d_arrays["tokens"].shape[0]
+        return flat, last_tok, p_next, d_arrays["tokens"].shape[0], P
 
-    def _emit_mixed(self, works: list, seqs: list, flat_h, B: int) -> None:
+    def _emit_mixed(
+        self, works: list, seqs: list, flat_h, B: int,
+        P: Optional[int] = None,
+    ) -> None:
         """Sync-side bookkeeping of one mixed window's flat output.
-        Mixed windows never carry the top-logprobs variant (the window
-        pipeline diverts toplp batches to dedicated prefill + pure
-        windows), so the flat layout is always the base one."""
+        ``P`` = the window's prefill-rectangle row count (narrow
+        default, or the wide rect's). Mixed windows never carry the
+        top-logprobs variant (the window pipeline diverts toplp batches
+        to dedicated prefill + pure windows), so the flat layout is
+        always the base one."""
         sched = self.scheduler
         assert sched is not None
         assert not (
@@ -1623,7 +1680,8 @@ class JaxEngine:
             or self._wants_toplp([w.seq for w in works])
         ), "top-logprobs batch reached the mixed step"
         K = sched.decode_lookahead
-        P = self.config.mixed_prefill_rows
+        if P is None:
+            P = self.config.mixed_prefill_rows
         tok_m, lp_m = self._unpack_window(
             flat_h[: B * 2 * K].reshape(B, 2 * K)
         )
@@ -1656,7 +1714,10 @@ class JaxEngine:
     # (read at engine construction; see __init__).
     PIPELINE_DEPTH = 2
 
-    def _window_pipeline(self, works: list, seqs: list) -> None:
+    def _window_pipeline(
+        self, works: list, seqs: list,
+        rect: Optional[tuple[int, int]] = None,
+    ) -> None:
         """THE serving loop: fused decode windows with optional prefill
         rectangles, PIPELINED to depth 2. While windows k and k+1 run
         on device, the host plans window k+2 — last-chunk prefills
@@ -1718,7 +1779,7 @@ class JaxEngine:
                      "b": out[3]}
             else:
                 e = {"kind": "mixed", "flat": out[1], "last": out[2],
-                     "p_next": out[3], "b": out[4]}
+                     "p_next": out[3], "b": out[4], "p_rows": out[5]}
             e["works"] = works_
             e["seqs"] = seqs_
             e["vmap"] = dict(vmap)
@@ -1759,9 +1820,8 @@ class JaxEngine:
                         )
                 return
             d_arrays = sched.build_decode_arrays(seqs)
-            sampling_p = self._batch_sampling(
-                [w.seq for w in works], self.config.mixed_prefill_rows
-            )
+            p_rows = (rect or (self.config.mixed_prefill_rows, 0))[0]
+            sampling_p = self._batch_sampling([w.seq for w in works], p_rows)
             sampling_d = self._batch_sampling(seqs, d_arrays["tokens"].shape[0])
             pipelining = pipelining and not (
                 sampling_p.has_penalties or sampling_d.has_penalties
@@ -1769,7 +1829,8 @@ class JaxEngine:
                 or sampling_p.has_bias or sampling_d.has_bias
             )
             out = ("mixed",) + self._dispatch_mixed(
-                works, seqs, p_arrays, d_arrays, sampling_p, sampling_d
+                works, seqs, p_arrays, d_arrays, sampling_p, sampling_d,
+                rect=rect,
             )
         else:
             d_arrays = sched.build_decode_arrays(seqs)
@@ -1791,7 +1852,8 @@ class JaxEngine:
             t0 = time.monotonic()
             if e["kind"] == "mixed":
                 self._emit_mixed(
-                    e["works"], e["seqs"], host_value(e["flat"]), e["b"]
+                    e["works"], e["seqs"], host_value(e["flat"]), e["b"],
+                    P=e["p_rows"],
                 )
             else:
                 tlp = self._wants_toplp(e["seqs"])
@@ -1836,12 +1898,11 @@ class JaxEngine:
             )
             if p2 is not None:
                 s_p2 = self._batch_sampling(
-                    [w.seq for w in nxt["works2"]],
-                    self.config.mixed_prefill_rows,
+                    [w.seq for w in nxt["works2"]], nxt["rect"][0]
                 )
                 out = ("mixed",) + self._dispatch_mixed(
                     nxt["works2"], nxt["seqs"], p2, nxt["arrays"],
-                    s_p2, s_d2, tokens_dev=chained,
+                    s_p2, s_d2, tokens_dev=chained, rect=nxt["rect"],
                 )
             else:
                 out = ("pure",) + self._dispatch_multi_step(

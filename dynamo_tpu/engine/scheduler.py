@@ -109,6 +109,9 @@ class StepPlan:
     kind: str  # "prefill" | "decode" | "mixed" | "idle"
     prefill_batch: list[PrefillWork] = field(default_factory=list)
     decode_seqs: list[Sequence] = field(default_factory=list)
+    # mixed plans: the [rows, len] prefill rectangle this window was
+    # planned against (narrow or wide — engine pads to exactly this)
+    rect: Optional[tuple[int, int]] = None
 
     @property
     def prefill(self) -> Optional[PrefillWork]:
@@ -148,6 +151,13 @@ class Scheduler:
         # rectangle (0 rows = mixed planning off)
         self.mixed_prefill_rows = 0
         self.mixed_prefill_len = 256
+        # adaptive wide rectangle (engine sets these; 0 rows = off):
+        # at low decode occupancy the mixed window swaps to
+        # [wide_rows, wide_len] — same token budget, fewer rows — so a
+        # long prompt stops trickling at mixed_prefill_len per window
+        self.mixed_prefill_wide_rows = 0
+        self.mixed_prefill_wide_len = 0
+        self.mixed_wide_max_running = 4
         # static serving shapes (engine sets these): every jit variant
         # costs a multi-minute AOT compile on a tunneled chip, and
         # composition-dependent buckets compile MID-SERVE. Padding the
@@ -197,33 +207,35 @@ class Scheduler:
     def plan(self) -> StepPlan:
         self._reap_cancelled()
         self._admit()
+        rows, rlen = self._mixed_rect()
         if (
             self.prefilling
             and self.running
-            and self.mixed_prefill_rows > 0
-            and self._prefill_backlog()
-            <= 2 * self.mixed_prefill_rows * self.mixed_prefill_len
+            and rows > 0
+            and self._prefill_backlog() <= 2 * rows * rlen
             and (
-                len(self.prefilling) <= self.mixed_prefill_rows
+                len(self.prefilling) <= rows
                 or len(self.running) >= len(self.prefilling)
             )
         ):
             # mixed step: prefill rides the decode window's dispatch,
-            # bounded to the engine's fixed rectangle. Large backlogs
+            # bounded to the chosen rectangle (narrow, or wide at low
+            # decode occupancy — _mixed_rect). Large backlogs
             # (cold-start bursts, long prompts) and prefill-heavy
             # moments (a synchronized cohort with few decoders — the
             # rectangle would RAMP the batch 8 rows per window while
             # decode runs near-empty) fall through to the dedicated
             # batched-prefill step below.
             works = self._plan_prefill_batch(
-                budget=self.mixed_prefill_rows * self.mixed_prefill_len,
-                max_seqs=self.mixed_prefill_rows,
-                max_chunk_len=self.mixed_prefill_len,
+                budget=rows * rlen,
+                max_seqs=rows,
+                max_chunk_len=rlen,
             )
             decode = self._plan_decode()
             if works and decode:
                 return StepPlan(
-                    kind="mixed", prefill_batch=works, decode_seqs=decode
+                    kind="mixed", prefill_batch=works, decode_seqs=decode,
+                    rect=(rows, rlen),
                 )
             if works:
                 return StepPlan(kind="prefill", prefill_batch=works)
@@ -246,6 +258,36 @@ class Scheduler:
         return sum(
             max(1, s.total_len - s.num_computed) for s in self.prefilling
         )
+
+    def _mixed_rect(
+        self,
+        n_running: Optional[int] = None,
+        prefill_seqs: Optional[list[Sequence]] = None,
+    ) -> tuple[int, int]:
+        """The mixed window's prefill rectangle for a given population
+        (defaults: the scheduler's current one; plan_pipelined_mixed
+        passes the NEXT window's): the wide [wide_rows, wide_len]
+        variant when decode occupancy is low, few prompts are
+        prefilling, and at least one needs more than a narrow chunk —
+        a long prompt then prefills in backlog/wide_len windows instead
+        of backlog/len, while decode keeps riding along (dedicated
+        prefill instead starves it: benchmarks/RESULTS.md ISL-3000
+        negative result). Otherwise the narrow rectangle's extra rows
+        graduate more stragglers per window."""
+        if n_running is None:
+            n_running = len(self.running)
+        if prefill_seqs is None:
+            prefill_seqs = list(self.prefilling)
+        if (
+            self.mixed_prefill_wide_rows > 0
+            and n_running <= self.mixed_wide_max_running
+            and len(prefill_seqs) <= self.mixed_prefill_wide_rows
+            and sum(
+                max(1, s.total_len - s.num_computed) for s in prefill_seqs
+            ) > self.mixed_prefill_len
+        ):
+            return self.mixed_prefill_wide_rows, self.mixed_prefill_wide_len
+        return self.mixed_prefill_rows, self.mixed_prefill_len
 
     def _reap_cancelled(self) -> None:
         for pool in (self.waiting, self.prefilling):
@@ -500,13 +542,16 @@ class Scheduler:
         # next window's prefill rows: pending chunks excluding the
         # in-flight works' seqs
         works2: list[PrefillWork] = []
+        rows, rlen = self.mixed_prefill_rows, self.mixed_prefill_len
         if self.mixed_prefill_rows > 0:
             busy = set(id(s) for s in graduated)
             avail = [s for s in self.prefilling if id(s) not in busy]
-            if (
-                len(avail) > self.mixed_prefill_rows
-                and len(next_seqs) < len(avail)
-            ):
+            # adaptive rect for the NEXT window: its decode population
+            # is next_seqs (not self.running, which lags the pipeline)
+            rows, rlen = self._mixed_rect(
+                n_running=len(next_seqs), prefill_seqs=avail
+            )
+            if len(avail) > rows and len(next_seqs) < len(avail):
                 # prefill-heavy: break the chain so the outer plan can
                 # run a dedicated batched prefill instead of ramping
                 # the batch 8 rows per window
@@ -517,9 +562,9 @@ class Scheduler:
             self.prefilling = deque(avail)
             try:
                 works2 = self._plan_prefill_batch(
-                    budget=self.mixed_prefill_rows * self.mixed_prefill_len,
-                    max_seqs=self.mixed_prefill_rows,
-                    max_chunk_len=self.mixed_prefill_len,
+                    budget=rows * rlen,
+                    max_seqs=rows,
+                    max_chunk_len=rlen,
                 )
             finally:
                 self.prefilling = saved
@@ -567,6 +612,7 @@ class Scheduler:
             "src_idx": src_idx,
             "offsets": offsets,
             "vmap": vmap,
+            "rect": (rows, rlen),
         }
 
     def _preempt(self, victim: Sequence) -> None:

@@ -83,6 +83,49 @@ def test_scheduler_mixed_backlog_falls_back_to_dedicated_prefill():
     assert len(plan.prefill.tokens) > 16  # full chunking, not the rect
 
 
+def test_scheduler_wide_rect_at_low_occupancy():
+    """A long prompt with few decoders swaps the mixed rectangle for
+    the wide variant (same token budget, fewer rows) so it stops
+    trickling at mixed_prefill_len per window; high decode occupancy
+    keeps the narrow rectangle's extra rows."""
+    alloc = BlockAllocator(2048, 4)
+    sched = Scheduler(alloc, 4, max_batch_size=16, prefill_chunk_size=512)
+    sched.mixed_prefill_rows = 4
+    sched.mixed_prefill_len = 32
+    sched.mixed_prefill_wide_rows = 1
+    sched.mixed_prefill_wide_len = 128
+    sched.mixed_wide_max_running = 4
+    a = _mk_seq(list(range(8)), request_id="a")
+    sched.add_request(a)
+    sched.complete_prefill_chunk(sched.plan().prefill)
+    # long prompt (backlog > narrow len), 1 decoder -> wide rect
+    b = _mk_seq(list(range(200)), request_id="b")
+    sched.add_request(b)
+    plan = sched.plan()
+    assert plan.kind == "mixed"
+    assert plan.rect == (1, 128)
+    assert len(plan.prefill.tokens) == 128  # wide chunk, not 32
+    # drain b's prefill; then raise decode occupancy past the ceiling
+    while True:
+        p = sched.plan()
+        if p.kind != "mixed" or not p.prefill_batch:
+            break
+        for w in p.prefill_batch:
+            sched.complete_prefill_chunk(w)
+    for i in range(5):
+        s = _mk_seq(list(range(6)), request_id=f"d{i}")
+        sched.add_request(s)
+        p = sched.plan()
+        for w in p.prefill_batch:
+            sched.complete_prefill_chunk(w)
+    assert sched.num_running >= 5
+    c = _mk_seq(list(range(200)), request_id="c")
+    sched.add_request(c)
+    plan = sched.plan()
+    assert plan.kind == "mixed"
+    assert plan.rect == (4, 32)  # narrow: occupancy above the ceiling
+
+
 def test_scheduler_mixed_disabled_keeps_either_or():
     alloc = BlockAllocator(256, 4)
     sched = Scheduler(alloc, 4, max_batch_size=8, prefill_chunk_size=64)
@@ -223,6 +266,67 @@ async def test_pipelined_mixed_chain_matches_dedicated():
     mixed_out = await run(True)
     dedicated_out = await run(False)
     assert mixed_out == dedicated_out
+
+
+async def test_wide_rect_engine_matches_narrow_only():
+    """A long prompt arriving while one request decodes takes the WIDE
+    mixed rectangle (fewer windows to first token); greedy outputs must
+    match an engine with the wide variant disabled. (Static shapes
+    bucket the narrow len 16 up to 128, so the wide variant here must
+    be 256 to differ.)"""
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    long_prompt = list(np.random.RandomState(7).randint(1, 250, size=180))
+
+    async def run(wide_len: int):
+        # prefill_chunk_size must cover the wide len: the engine clamps
+        # the wide rectangle to one chunk (longer would pad dead tokens)
+        engine = await JaxEngine.launch(
+            _engine_config(
+                mixed_prefill_rows=2, mixed_prefill_len=16,
+                mixed_prefill_wide_len=wide_len, num_blocks=256,
+                prefill_chunk_size=256,
+            )
+        )
+        wide_rects = 0
+        orig = engine._dispatch_mixed
+
+        def counting(*a, **kw):
+            nonlocal wide_rects
+            r = kw.get("rect")
+            if r is not None and r[1] > engine.config.mixed_prefill_len:
+                wide_rects += 1
+            return orig(*a, **kw)
+
+        engine._dispatch_mixed = counting
+        try:
+            adapter = engine.as_async_engine()
+            a_out: list = []
+
+            async def consume(req, out: list):
+                async for item in adapter.generate(req, Context()):
+                    out.extend(item.token_ids)
+
+            a_req = PreprocessedRequest(
+                request_id="a", token_ids=list(range(1, 12)),
+                sampling=SamplingOptions(use_greedy=True),
+                stop=StopConditions(max_tokens=80),
+            )
+            a_task = asyncio.create_task(consume(a_req, a_out))
+            while len(a_out) < 4:
+                await asyncio.sleep(0.01)
+            b = await _generate(engine, long_prompt, max_tokens=16,
+                                request_id="b")
+            await a_task
+            return a_out, b[0], wide_rects
+        finally:
+            await engine.shutdown()
+
+    a1, b1, n_wide = await run(256)
+    a2, b2, n_off = await run(0)
+    assert n_wide > 0, "long prompt never took the wide rectangle"
+    assert n_off == 0
+    assert (a1, b1) == (a2, b2)
 
 
 async def test_mixed_engine_long_prompt_and_pressure():
