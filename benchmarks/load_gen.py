@@ -53,11 +53,38 @@ class Stats:
         self.errors = 0
         self.completed = 0
         self.elapsed = 0.0  # actual wall time incl. the drain window
+        # multiturn mode: TTFT split by first vs returning turns
+        self.ttft_first: list[float] = []
+        self.ttft_later: list[float] = []
 
 
 async def one_request(session: aiohttp.ClientSession, args, stats: Stats) -> None:
     # unique head defeats cross-request prefix caching; body sized to ~ISL
     prompt = f"req-{random.random():.9f} " + PROMPT_WORD * max(1, args.isl - 2)
+    await _stream_completion(session, args, stats, prompt)
+
+
+async def chat_turn(
+    session: aiohttp.ClientSession, args, stats: Stats, prompt: str,
+    first_turn: bool,
+) -> str | None:
+    """One conversation turn: send the full history as the prompt,
+    collect the generated text (the next turn appends it). TTFT lands
+    in stats.ttft_first / stats.ttft_later — later turns are where
+    prefix reuse and KV offload show up."""
+    return await _stream_completion(
+        session, args, stats, prompt, first_turn=first_turn, collect=True
+    )
+
+
+async def _stream_completion(
+    session: aiohttp.ClientSession, args, stats: Stats, prompt: str,
+    first_turn: bool | None = None, collect: bool = False,
+) -> str | None:
+    """Stream one /v1/completions call, accounting TTFT/ITL/E2E/tokens
+    into ``stats``. ``first_turn`` additionally buckets the TTFT into
+    ttft_first/ttft_later (multiturn mode). Returns the generated text
+    when ``collect`` (None on error)."""
     body = {
         "model": args.model,
         "prompt": prompt,
@@ -72,6 +99,7 @@ async def one_request(session: aiohttp.ClientSession, args, stats: Stats) -> Non
     t_prev = None
     n_est = 0
     n_usage = None
+    text_parts: list[str] = []
     try:
         async with session.post(
             f"{args.url}/v1/completions", json=body,
@@ -79,7 +107,7 @@ async def one_request(session: aiohttp.ClientSession, args, stats: Stats) -> Non
         ) as resp:
             if resp.status != 200:
                 stats.errors += 1
-                return
+                return None
             async for line in resp.content:
                 line = line.strip()
                 if not line.startswith(b"data:"):
@@ -99,16 +127,55 @@ async def one_request(session: aiohttp.ClientSession, args, stats: Stats) -> Non
                     # ITL here is inter-CHUNK latency: servers with fused
                     # multi-step decode stream several tokens per chunk
                     if t_prev is None:
-                        stats.ttft.append(now - t0)
+                        ttft = now - t0
+                        stats.ttft.append(ttft)
+                        if first_turn is not None:
+                            (stats.ttft_first if first_turn
+                             else stats.ttft_later).append(ttft)
                     else:
                         stats.itl.append(now - t_prev)
                     t_prev = now
                     n_est += max(1, len(text.split()))
+                    if collect:
+                        text_parts.append(text)
         stats.e2e.append(time.monotonic() - t0)
         stats.tokens += n_usage if n_usage is not None else n_est
         stats.completed += 1
+        return "".join(text_parts) if collect else ""
     except Exception:
         stats.errors += 1
+        return None
+
+
+async def run_multiturn(args, users: int, turns: int, think: float) -> Stats:
+    """Multi-turn conversations: ``users`` concurrent users, each
+    holding a growing chat history for ``turns`` sequential requests
+    with ~``think`` seconds of think time between turns (reference
+    recipe: the KV-offload benchmark's 'multi-turn conversations x
+    users' workload, docs/architecture.md:91-96 — the system-memory KV
+    tier is measured as TTFT on RETURNING turns whose prefix blocks
+    were evicted from HBM in between)."""
+    stats = Stats()
+
+    async def user(u: int) -> None:
+        # distinct head per conversation: users never share prefixes
+        history = f"user-{u}-{random.random():.9f} "
+        for t in range(turns):
+            history += f" Q{t}: " + PROMPT_WORD * max(1, args.isl - 2)
+            out = await chat_turn(
+                session, args, stats, history, first_turn=(t == 0)
+            )
+            if out is None:
+                return  # conversation aborted (error)
+            history += " " + out
+            if think > 0 and t < turns - 1:
+                await asyncio.sleep(random.uniform(0.5 * think, 1.5 * think))
+
+    t_start = time.monotonic()
+    async with aiohttp.ClientSession() as session:
+        await asyncio.gather(*[user(u) for u in range(users)])
+    stats.elapsed = time.monotonic() - t_start
+    return stats
 
 
 async def run_open_loop(args, rate_fn) -> Stats:
@@ -169,7 +236,13 @@ async def main() -> None:
     p.add_argument("--duration", type=float, default=30.0)
     p.add_argument("--request-timeout", type=float, default=120.0)
     p.add_argument("--rate-mode", default="constant",
-                   choices=["constant", "sweep", "sin"])
+                   choices=["constant", "sweep", "sin", "multiturn"])
+    p.add_argument("--users", type=int, default=8,
+                   help="concurrent conversations for --rate-mode multiturn")
+    p.add_argument("--turns", type=int, default=4,
+                   help="turns per conversation for --rate-mode multiturn")
+    p.add_argument("--think-time", type=float, default=0.0,
+                   help="mean seconds between a user's turns")
     p.add_argument("--rate", type=float, default=2.0)
     p.add_argument("--concurrency", default="1,2,4,8",
                    help="comma list for --rate-mode sweep")
@@ -179,7 +252,20 @@ async def main() -> None:
                    help="sin period seconds (planner benchmark: 150)")
     args = p.parse_args()
 
-    if args.rate_mode == "constant":
+    if args.rate_mode == "multiturn":
+        stats = await run_multiturn(
+            args, args.users, args.turns, args.think_time
+        )
+        report(f"multiturn-{args.users}x{args.turns}", stats, args.duration)
+        print(json.dumps({
+            "ttft_first_ms": {
+                k: round(v * 1000, 1)
+                for k, v in _percentiles(stats.ttft_first).items()},
+            "ttft_later_ms": {
+                k: round(v * 1000, 1)
+                for k, v in _percentiles(stats.ttft_later).items()},
+        }), flush=True)
+    elif args.rate_mode == "constant":
         stats = await run_open_loop(args, lambda t: args.rate)
         report(f"constant-{args.rate}", stats, args.duration)
     elif args.rate_mode == "sin":

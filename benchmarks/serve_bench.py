@@ -36,7 +36,13 @@ REPO = os.path.dirname(HERE)
 sys.path.insert(0, REPO)
 sys.path.insert(0, HERE)
 
-from load_gen import Stats, one_request, run_closed_loop  # noqa: E402
+from load_gen import (  # noqa: E402
+    Stats,
+    _percentiles,
+    one_request,
+    run_closed_loop,
+    run_multiturn,
+)
 
 TINY_MODEL = os.path.join(REPO, "tests", "data", "tiny_llama_model")
 
@@ -93,6 +99,47 @@ SHAPES = {
         # ~9 tokens/word with the test tokenizer: 334 words ≈ 3000
         # prompt tokens
         isl=334, osl=150, duration=120.0, concurrency=[1, 4, 16, 64, 256],
+    ),
+    # KV-offload A/B on the reference's multi-turn recipe
+    # (docs/architecture.md:91-96: multi-turn conversations x users,
+    # system-memory KV tier measured as TTFT on RETURNING turns vs
+    # prefix-caching-only). G1 is deliberately constrained
+    # (num_blocks) so conversations evict between turns; variant B's
+    # G2 host tier restores their blocks instead of recomputing.
+    "tpu_offload": dict(
+        config=dict(
+            model_type="llama", vocab_size=128256, hidden_size=4096,
+            intermediate_size=14336, num_hidden_layers=32,
+            num_attention_heads=32, num_key_value_heads=8,
+            max_position_embeddings=8192,
+        ),
+        engine=dict(random_weights=True, quantization="int8",
+                    block_size=128, max_batch_size=32, decode_steps=32,
+                    prefill_chunk_size=1024, max_model_len=2304,
+                    num_blocks=192),
+        engine_b=dict(host_kv_blocks=768),  # overlay: the G2 tier
+        # ~30 words x ~9 tok/word = ~270 prompt tokens per turn + 64
+        # generated: 6 turns end near 2000 tokens of history
+        workload="multiturn",
+        isl=30, osl=64, users=24, turns=6, think=8.0,
+        duration=0.0, concurrency=[],
+    ),
+    # CI smoke of the same machinery on CPU (tiny model, no pressure
+    # claims — just that both variants serve and the report emits)
+    "cpu_offload": dict(
+        config=dict(
+            model_type="llama", vocab_size=2048, hidden_size=128,
+            intermediate_size=256, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=2048,
+        ),
+        engine=dict(random_weights=True, num_blocks=64, block_size=16,
+                    max_batch_size=8, decode_steps=4,
+                    prefill_chunk_size=256, max_model_len=512),
+        engine_b=dict(host_kv_blocks=256),
+        workload="multiturn",
+        isl=4, osl=8, users=4, turns=3, think=0.2,
+        duration=0.0, concurrency=[],
     ),
 }
 
@@ -165,28 +212,15 @@ async def drive(args, shape: dict) -> list[dict]:
     return results
 
 
-def main() -> None:
-    p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--mode", choices=["cpu", "tpu", "tpu_ref"], default="cpu")
-    p.add_argument("--duration", type=float, default=None)
-    p.add_argument("--concurrency", default=None, help="comma list override")
-    p.add_argument("--ready-timeout", type=float, default=1200.0)
-    p.add_argument("--out", default=None, help="results JSON path")
-    cli = p.parse_args()
-
-    shape = SHAPES[cli.mode]
-    if cli.duration:
-        shape = dict(shape, duration=cli.duration)
-    if cli.concurrency:
-        shape = dict(
-            shape, concurrency=[int(x) for x in cli.concurrency.split(",")]
-        )
-
-    tmp = tempfile.mkdtemp(prefix="dyn_serve_bench_")
-    model_dir = make_model_dir(tmp, shape)
-    engine_args = os.path.join(tmp, "engine.json")
+def launch_server(
+    mode: str, engine: dict, model_dir: str, tmp: str, tag: str,
+    ready_timeout: float,
+):
+    """Start the real serving stack for one engine config; returns
+    (proc, url, log_fh). Raises with the log tail if it never comes up."""
+    engine_args = os.path.join(tmp, f"engine_{tag}.json")
     with open(engine_args, "w") as f:
-        json.dump(shape["engine"], f)
+        json.dump(engine, f)
     port = free_port()
     # APPEND to PYTHONPATH: replacing it would drop the accelerator
     # plugin's sitecustomize dir (e.g. the axon tunnel registers its
@@ -196,9 +230,9 @@ def main() -> None:
         os.environ,
         PYTHONPATH=REPO + (os.pathsep + inherited if inherited else ""),
     )
-    if cli.mode == "cpu":
+    if mode.startswith("cpu"):
         env["JAX_PLATFORMS"] = "cpu"
-    server_log = os.path.join(tmp, "server.log")
+    server_log = os.path.join(tmp, f"server_{tag}.log")
     log_fh = open(server_log, "w")
     proc = subprocess.Popen(
         [
@@ -214,25 +248,167 @@ def main() -> None:
     )
     url = f"http://127.0.0.1:{port}"
     try:
+        wait_ready(url, ready_timeout)
+    except RuntimeError:
+        with open(server_log) as f:
+            print("--- server log tail ---\n" + f.read()[-4000:],
+                  file=sys.stderr)
+        stop_server(proc, log_fh)
+        raise
+    return proc, url, log_fh
+
+
+def stop_server(proc, log_fh) -> None:
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+    log_fh.close()
+
+
+def bench_args(url: str, shape: dict):
+    class A:
+        pass
+
+    a = A()
+    a.url = url
+    a.model = "bench"
+    a.isl = shape["isl"]
+    a.osl = shape["osl"]
+    a.duration = shape["duration"]
+    a.request_timeout = 600.0
+    return a
+
+
+def drive_multiturn(cli, shape: dict, model_dir: str, tmp: str) -> list[dict]:
+    """A/B the multi-turn conversation workload: variant 'prefix_only'
+    (base engine) vs 'g2_host' (base + engine_b overlay, the host KV
+    tier). Each variant gets its own server; the headline is the
+    RETURNING-turn TTFT delta (reference: docs/architecture.md:91-96,
+    +40% TTFT from the system-memory tier)."""
+    variants = [
+        ("prefix_only", dict(shape["engine"])),
+        ("g2_host", dict(shape["engine"], **shape["engine_b"])),
+    ]
+    rows = []
+    for tag, engine in variants:
+        proc, url, log_fh = launch_server(
+            cli.mode, engine, model_dir, tmp, tag, cli.ready_timeout
+        )
         try:
-            wait_ready(url, cli.ready_timeout)
-        except RuntimeError:
-            with open(server_log) as f:
-                print("--- server log tail ---\n" + f.read()[-4000:],
-                      file=sys.stderr)
-            raise
+            a = bench_args(url, shape)
+            # warmup: one short conversation compiles every shape
+            warm_stats = asyncio.run(
+                run_multiturn(a, users=1, turns=2, think=0.0)
+            )
+            if warm_stats.errors:
+                raise RuntimeError(f"{tag}: warmup conversation errored")
+            stats = asyncio.run(
+                run_multiturn(
+                    a, users=shape["users"], turns=shape["turns"],
+                    think=shape["think"],
+                )
+            )
+            row = {
+                "variant": tag,
+                "users": shape["users"],
+                "turns": shape["turns"],
+                "completed": stats.completed,
+                "errors": stats.errors,
+                "output_tok_per_s": round(
+                    stats.tokens / max(stats.elapsed, 1e-9), 2
+                ),
+                "ttft_first_ms": {
+                    k: round(v * 1000, 1)
+                    for k, v in _percentiles(stats.ttft_first).items()},
+                "ttft_later_ms": {
+                    k: round(v * 1000, 1)
+                    for k, v in _percentiles(stats.ttft_later).items()},
+                "e2e_ms": {
+                    k: round(v * 1000, 1)
+                    for k, v in _percentiles(stats.e2e).items()},
+            }
+            print(json.dumps(row), flush=True)
+            rows.append(row)
+        finally:
+            stop_server(proc, log_fh)
+    return rows
 
-        class A:
-            pass
 
-        a = A()
-        a.url = url
-        a.model = "bench"
-        a.isl = shape["isl"]
-        a.osl = shape["osl"]
-        a.duration = shape["duration"]
-        a.request_timeout = 600.0
-        rows = asyncio.run(drive(a, shape))
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--mode",
+        choices=["cpu", "tpu", "tpu_ref", "tpu_offload", "cpu_offload"],
+        default="cpu",
+    )
+    p.add_argument("--duration", type=float, default=None)
+    p.add_argument("--concurrency", default=None, help="comma list override")
+    p.add_argument("--users", type=int, default=None)
+    p.add_argument("--turns", type=int, default=None)
+    p.add_argument("--ready-timeout", type=float, default=1200.0)
+    p.add_argument("--out", default=None, help="results JSON path")
+    cli = p.parse_args()
+
+    shape = SHAPES[cli.mode]
+    if cli.duration:
+        shape = dict(shape, duration=cli.duration)
+    if cli.concurrency:
+        shape = dict(
+            shape, concurrency=[int(x) for x in cli.concurrency.split(",")]
+        )
+    if cli.users:
+        shape = dict(shape, users=cli.users)
+    if cli.turns:
+        shape = dict(shape, turns=cli.turns)
+
+    tmp = tempfile.mkdtemp(prefix="dyn_serve_bench_")
+    model_dir = make_model_dir(tmp, shape)
+    try:
+        if shape.get("workload") == "multiturn":
+            rows = drive_multiturn(cli, shape, model_dir, tmp)
+            out_path = cli.out or os.path.join(
+                HERE, f"results_{cli.mode}.json"
+            )
+            with open(out_path, "w") as f:
+                json.dump(
+                    {
+                        "mode": cli.mode,
+                        "workload": "multiturn",
+                        "isl": shape["isl"],
+                        "osl": shape["osl"],
+                        "users": shape["users"],
+                        "turns": shape["turns"],
+                        "think_s": shape["think"],
+                        "engine": shape["engine"],
+                        "engine_b": shape["engine_b"],
+                        "model_geometry": shape["config"],
+                        "rows": rows,
+                    },
+                    f,
+                    indent=1,
+                )
+            print("\n| variant | out tok/s | turn-1 TTFT p50 | "
+                  "returning-turn TTFT p50 | p99 |")
+            print("|---|---|---|---|---|")
+            for r in rows:
+                print(
+                    f"| {r['variant']} | {r['output_tok_per_s']} "
+                    f"| {r['ttft_first_ms']['p50']} "
+                    f"| {r['ttft_later_ms']['p50']} "
+                    f"| {r['ttft_later_ms']['p99']} |"
+                )
+            return
+
+        proc, url, log_fh = launch_server(
+            cli.mode, shape["engine"], model_dir, tmp, "main",
+            cli.ready_timeout,
+        )
+        try:
+            rows = asyncio.run(drive(bench_args(url, shape), shape))
+        finally:
+            stop_server(proc, log_fh)
         out_path = cli.out or os.path.join(HERE, f"results_{cli.mode}.json")
         with open(out_path, "w") as f:
             json.dump(
@@ -258,12 +434,6 @@ def main() -> None:
                 f"| {r['e2e_ms']['p50']} |"
             )
     finally:
-        proc.send_signal(signal.SIGTERM)
-        try:
-            proc.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-        log_fh.close()
         shutil.rmtree(tmp, ignore_errors=True)
 
 

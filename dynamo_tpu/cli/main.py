@@ -6,7 +6,7 @@ the in×out matrix; launch/llmctl — model registration ctl;
 components/http — standalone frontend).
 
   dynamo-tpu run --in {http|text|stdin|batch:F|dyn://NS.COMP.EP} \
-                 --out {echo_core|echo_full|jax|pystr:F|dyn://NS.COMP.EP} \
+                 --out {echo_core|echo_full|jax|pystr:F|dyn://NS.COMP.EP|subproc:CMD} \
                  [--model-path DIR] [--model-name NAME] ...
 
   dynamo-tpu store            # run the coordinator (replaces etcd+NATS)
@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import atexit
 import logging
 import os
 import sys
@@ -43,7 +44,11 @@ def build_parser() -> argparse.ArgumentParser:
                           "dyn://ns.comp.ep (serve as worker)")
     run.add_argument("--out", dest="out_mode", default="echo_full",
                      help="echo_core | echo_full | jax | pystr:FILE.py | "
-                          "dyn://ns.comp.ep")
+                          "dyn://ns.comp.ep | subproc:CMD (spawn CMD as "
+                          "a child engine that registers on a generated "
+                          "{endpoint}; placeholders {endpoint} "
+                          "{store_host} {store_port} {model_path} "
+                          "{model_name} are substituted)")
     run.add_argument("--batch-output", default=None,
                      help="output path for --in batch: (default "
                           "INPUT.output.jsonl)")
@@ -123,6 +128,10 @@ def build_parser() -> argparse.ArgumentParser:
                      help="address prefill workers use to reach this "
                           "worker's KV transfer server")
     # KV offload tiers
+    run.add_argument("--subproc-ready-timeout", type=float, default=1800.0,
+                     help="startup budget for --out subproc: children "
+                          "(a real engine's AOT prewarm is minutes over "
+                          "a chip tunnel)")
     run.add_argument("--host-kv-blocks", type=int, default=0)
     run.add_argument("--disk-kv-blocks", type=int, default=0)
     run.add_argument("--disk-kv-path", default="")
@@ -455,6 +464,51 @@ async def _build_local_pipeline(args: Any):
     return _wrap_pipeline(args, core, eos_ids)
 
 
+async def _connect_remote(
+    args: Any, path: str, wait_timeout: float = 30.0, alive=None
+):
+    """Build the local pre/post pipeline around remote worker(s) at
+    ``path``, behind a push router honoring --router-mode. ``alive``
+    (optional) is polled while waiting for the first instance and may
+    raise to abort early (the subproc adapter passes a child-process
+    liveness check)."""
+    import time as _time
+
+    from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    ns, comp, ep = parse_dyn_path(path)
+    cfg = _runtime_config(args)
+    drt = await DistributedRuntime.create(config=cfg)
+    component = drt.namespace(ns).component(comp)
+    client = await component.endpoint(ep).client()
+    deadline = _time.monotonic() + wait_timeout
+    while True:
+        if alive is not None:
+            alive()
+        step = min(5.0, max(0.1, deadline - _time.monotonic()))
+        try:
+            await client.wait_for_instances(step)
+            break
+        except asyncio.TimeoutError:
+            if _time.monotonic() >= deadline:
+                raise
+    if args.router_mode == "kv":
+        from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+
+        kv_router = await KvRouter.create(component, client)
+        router = KvPushRouter(kv_router)
+    else:
+        mode = (
+            RouterMode.ROUND_ROBIN
+            if args.router_mode == "round_robin"
+            else RouterMode.RANDOM
+        )
+        router = PushRouter(client, mode)
+    # remote workers speak PreprocessedRequest: wrap with local pre/post
+    return _wrap_pipeline(args, router, [])
+
+
 async def cmd_run(args: Any) -> None:
     from dynamo_tpu.http.service import HttpService, ModelManager
 
@@ -492,29 +546,93 @@ async def cmd_run(args: Any) -> None:
         engine = PythonStrEngine(path)
     elif out.startswith(DYN_SCHEME):
         # remote worker(s) behind a push router
-        from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
-        from dynamo_tpu.runtime.runtime import DistributedRuntime
+        model_name, engine = await _connect_remote(args, out)
+    elif out.startswith("subproc:"):
+        # subprocess engine adapter (reference: launch/dynamo-run/src/
+        # subprocess.rs — spawn the engine as a child process that
+        # connects BACK over the endpoint plane, then serve through it;
+        # the reference embeds vllm/sglang python scripts this way).
+        # The command line may reference {endpoint}, {store_host},
+        # {store_port}, {model_path}, {model_name}; the same values are
+        # exported as DYN_SUBPROC_* env vars. Anything able to serve
+        # PreprocessedRequest -> LLMEngineOutput on the endpoint plane
+        # qualifies — e.g.:
+        #   --out "subproc:python -m dynamo_tpu.cli.main run
+        #          --in {endpoint} --out jax --model-path {model_path}
+        #          --store-port {store_port}"
+        import shlex
+        import subprocess
 
-        ns, comp, ep = parse_dyn_path(out)
-        cfg = _runtime_config(args)
-        drt = await DistributedRuntime.create(config=cfg)
-        component = drt.namespace(ns).component(comp)
-        client = await component.endpoint(ep).client()
-        await client.wait_for_instances()
-        if args.router_mode == "kv":
-            from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+        ep_path = f"{DYN_SCHEME}internal.subproc{os.getpid()}.generate"
+        # resolve the store address the way the parent itself connects
+        # (flags > env > config file > defaults) — raw args would hand
+        # the child port "0" whenever the flag is omitted
+        _rt_cfg = _runtime_config(args)
+        subs = {
+            "endpoint": ep_path,
+            "store_host": _rt_cfg.store_host,
+            "store_port": str(_rt_cfg.store_port),
+            "model_path": args.model_path or "",
+            "model_name": args.model_name or "",
+        }
+        cmdline = out[len("subproc:"):]
 
-            kv_router = await KvRouter.create(component, client)
-            router = KvPushRouter(kv_router)
-        else:
-            mode = (
-                RouterMode.ROUND_ROBIN
-                if args.router_mode == "round_robin"
-                else RouterMode.RANDOM
+        def _sub(token: str) -> str:
+            # targeted placeholder substitution (str.format would choke
+            # on unrelated braces, e.g. inline JSON engine args)
+            for k, v in subs.items():
+                token = token.replace("{" + k + "}", v)
+            return token
+
+        argv = [_sub(a) for a in shlex.split(cmdline)]
+        env = dict(
+            os.environ,
+            **{f"DYN_SUBPROC_{k.upper()}": v for k, v in subs.items()},
+        )
+        child = subprocess.Popen(argv, env=env)
+        print(f"subprocess engine: pid={child.pid} endpoint={ep_path}",
+              flush=True)
+
+        def _reap_child() -> None:
+            if child.poll() is None:
+                child.terminate()
+                try:
+                    child.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    child.kill()
+
+        atexit.register(_reap_child)
+        # SIGTERM's default action skips atexit — convert it to a normal
+        # exit so the child engine is reaped when the adapter is stopped
+        import signal as _sig
+
+        def _on_term(signum, frame):
+            _reap_child()
+            sys.exit(0)
+
+        for _s in (_sig.SIGTERM, _sig.SIGINT):
+            try:
+                _sig.signal(_s, _on_term)
+            except (ValueError, OSError):
+                pass  # non-main thread or unsupported platform
+        def _child_alive() -> None:
+            if child.poll() is not None:
+                raise SystemExit(
+                    f"subprocess engine exited during startup "
+                    f"(rc={child.returncode})"
+                )
+
+        try:
+            # startup budget covers a real engine's AOT prewarm
+            # (multi-minute over a chip tunnel)
+            model_name, engine = await _connect_remote(
+                args, ep_path,
+                wait_timeout=args.subproc_ready_timeout,
+                alive=_child_alive,
             )
-            router = PushRouter(client, mode)
-        # remote workers speak PreprocessedRequest: wrap with local pre/post
-        model_name, engine = _wrap_pipeline(args, router, [])
+        except BaseException:
+            _reap_child()
+            raise
     elif out == "auto":
         # discovery-driven frontend: serve whatever models workers register
         # (reference: components/http standalone frontend + ModelWatcher)

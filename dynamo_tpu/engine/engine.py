@@ -361,10 +361,12 @@ class JaxEngine:
                 # never wider than one prefill chunk: _plan_prefill_batch
                 # caps every row's chunk at prefill_chunk_size, so a
                 # longer rectangle would dispatch permanently-padded
-                # dead tokens
-                wl = next_bucket(
-                    min(wide, cfg.prefill_chunk_size), pc
-                )
+                # dead tokens. Round DOWN to a bucket (next_bucket
+                # rounds up, which for a non-bucket chunk size like 512
+                # would reintroduce exactly that padding).
+                wl = next_bucket(min(wide, cfg.prefill_chunk_size), pc)
+                while wl > cfg.prefill_chunk_size and wl > pc[0]:
+                    wl = down(wl, pc)
                 while wl > max(cap, pc[0]) and wl > pc[0]:
                     wl = down(wl, pc)
                 # the wide rect keeps the narrow rect's token budget

@@ -207,12 +207,13 @@ class Scheduler:
     def plan(self) -> StepPlan:
         self._reap_cancelled()
         self._admit()
-        rows, rlen = self._mixed_rect()
+        backlog = self._prefill_backlog() if self.prefilling else 0
+        rows, rlen = self._mixed_rect(backlog=backlog)
         if (
             self.prefilling
             and self.running
             and rows > 0
-            and self._prefill_backlog() <= 2 * rows * rlen
+            and backlog <= 2 * rows * rlen
             and (
                 len(self.prefilling) <= rows
                 or len(self.running) >= len(self.prefilling)
@@ -263,6 +264,7 @@ class Scheduler:
         self,
         n_running: Optional[int] = None,
         prefill_seqs: Optional[list[Sequence]] = None,
+        backlog: Optional[int] = None,
     ) -> tuple[int, int]:
         """The mixed window's prefill rectangle for a given population
         (defaults: the scheduler's current one; plan_pipelined_mixed
@@ -277,14 +279,16 @@ class Scheduler:
         if n_running is None:
             n_running = len(self.running)
         if prefill_seqs is None:
-            prefill_seqs = list(self.prefilling)
+            prefill_seqs = self.prefilling
+        if backlog is None:
+            backlog = sum(
+                max(1, s.total_len - s.num_computed) for s in prefill_seqs
+            )
         if (
             self.mixed_prefill_wide_rows > 0
             and n_running <= self.mixed_wide_max_running
             and len(prefill_seqs) <= self.mixed_prefill_wide_rows
-            and sum(
-                max(1, s.total_len - s.num_computed) for s in prefill_seqs
-            ) > self.mixed_prefill_len
+            and backlog > self.mixed_prefill_len
         ):
             return self.mixed_prefill_wide_rows, self.mixed_prefill_wide_len
         return self.mixed_prefill_rows, self.mixed_prefill_len

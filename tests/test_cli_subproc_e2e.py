@@ -1,0 +1,69 @@
+"""Subprocess engine adapter e2e (reference:
+launch/dynamo-run/src/subprocess.rs — dynamo-run spawns the engine as a
+child process that connects BACK over the endpoint plane, then serves
+through it; vLLM/SGLang are embedded python scripts run this way).
+
+Here the frontend runs ``--out "subproc:python -m dynamo_tpu.cli.main
+run --in {endpoint} --out jax ..."`` — the placeholders are substituted
+with a generated endpoint path and the coordinator address, the child
+registers there, and the frontend proxies with local pre/post. Killing
+the frontend must also reap the child (atexit)."""
+
+import time
+
+from cli_harness import MODEL_DIR, CliFleet, complete, free_port, wait_http
+
+
+def test_subprocess_engine_adapter_serves_http():
+    store_port = free_port()
+    http_port = free_port()
+    fleet = CliFleet()
+    try:
+        fleet.spawn("store", "--host", "127.0.0.1", "--port", str(store_port))
+        time.sleep(2)
+        child_cmd = (
+            "subproc:python -m dynamo_tpu.cli.main run "
+            "--in {endpoint} --out jax --model-path {model_path} "
+            "--store-host {store_host} --store-port {store_port}"
+        )
+        frontend = fleet.spawn(
+            "run", "--in", "http", "--out", child_cmd,
+            "--model-path", MODEL_DIR,
+            "--store-host", "127.0.0.1", "--store-port", str(store_port),
+            "--http-host", "127.0.0.1", "--http-port", str(http_port),
+        )
+        wait_http(
+            f"http://127.0.0.1:{http_port}/v1/models",
+            lambda b: b"tiny_llama_model" in b,
+            timeout=240.0,
+        )
+        out = complete(http_port, "subprocess engines still serve", 8)
+        # token COUNT is the robust assertion: the tiny model's greedy
+        # tokens can legitimately detokenize to an empty string
+        assert out["usage"]["completion_tokens"] == 8
+        assert out["choices"][0]["finish_reason"] == "length"
+        fleet.assert_alive()
+        # the adapter owns the child: killing the frontend must reap it.
+        # The child holds the store lease for the generated endpoint; a
+        # leaked child would keep the instance registered.
+        import signal as _signal
+        import urllib.request
+
+        frontend.send_signal(_signal.SIGTERM)
+        frontend.wait(timeout=20)
+        fleet.forget(frontend)
+        deadline = time.monotonic() + 60
+        gone = False
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/v1/models", timeout=2
+                ):
+                    pass
+            except Exception:
+                gone = True
+                break
+            time.sleep(0.5)
+        assert gone, "frontend kept serving after SIGTERM"
+    finally:
+        fleet.teardown()

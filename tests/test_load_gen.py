@@ -37,3 +37,28 @@ async def test_closed_loop_against_echo():
         assert p["p50"] >= 0
     finally:
         await service.stop()
+
+
+async def test_multiturn_conversations_against_echo():
+    """Multi-turn mode: each user's history grows turn over turn and
+    TTFT is split into first-turn vs returning-turn buckets (the
+    KV-offload benchmark's workload shape)."""
+    lg = _load_gen()
+    manager = ModelManager()
+    manager.add_completion_model("echo", EchoEngineFull())
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        args = type("A", (), dict(
+            url=f"http://127.0.0.1:{service.port}", model="echo",
+            isl=4, osl=6, duration=0.0, request_timeout=30.0,
+        ))()
+        users, turns = 3, 3
+        stats = await lg.run_multiturn(args, users, turns, think=0.0)
+        assert stats.errors == 0
+        assert stats.completed == users * turns
+        assert len(stats.ttft_first) == users
+        assert len(stats.ttft_later) == users * (turns - 1)
+        assert stats.tokens > 0
+    finally:
+        await service.stop()
