@@ -44,6 +44,12 @@ def _percentiles(xs: list[float], ps=(50, 90, 99)) -> dict[str, float]:
     }
 
 
+def ms(xs: list[float]) -> dict[str, float]:
+    """TTFT/ITL/E2E percentiles in rounded milliseconds (the one
+    reporting format, shared with serve_bench)."""
+    return {k: round(v * 1000, 1) for k, v in _percentiles(xs).items()}
+
+
 class Stats:
     def __init__(self) -> None:
         self.ttft: list[float] = []
@@ -220,9 +226,9 @@ def report(tag: str, stats: Stats, duration: float) -> None:
         "completed": stats.completed,
         "errors": stats.errors,
         "output_tok_per_s": round(stats.tokens / max(elapsed, 1e-9), 2),
-        "ttft_ms": {k: round(v * 1000, 1) for k, v in _percentiles(stats.ttft).items()},
-        "inter_chunk_ms": {k: round(v * 1000, 1) for k, v in _percentiles(stats.itl).items()},
-        "e2e_ms": {k: round(v * 1000, 1) for k, v in _percentiles(stats.e2e).items()},
+        "ttft_ms": ms(stats.ttft),
+        "inter_chunk_ms": ms(stats.itl),
+        "e2e_ms": ms(stats.e2e),
     }
     print(json.dumps(out), flush=True)
 
@@ -258,12 +264,8 @@ async def main() -> None:
         )
         report(f"multiturn-{args.users}x{args.turns}", stats, args.duration)
         print(json.dumps({
-            "ttft_first_ms": {
-                k: round(v * 1000, 1)
-                for k, v in _percentiles(stats.ttft_first).items()},
-            "ttft_later_ms": {
-                k: round(v * 1000, 1)
-                for k, v in _percentiles(stats.ttft_later).items()},
+            "ttft_first_ms": ms(stats.ttft_first),
+            "ttft_later_ms": ms(stats.ttft_later),
         }), flush=True)
     elif args.rate_mode == "constant":
         stats = await run_open_loop(args, lambda t: args.rate)

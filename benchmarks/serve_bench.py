@@ -38,7 +38,7 @@ sys.path.insert(0, HERE)
 
 from load_gen import (  # noqa: E402
     Stats,
-    _percentiles,
+    ms,
     one_request,
     run_closed_loop,
     run_multiturn,
@@ -188,8 +188,6 @@ async def drive(args, shape: dict) -> list[dict]:
                 *[one_request(session, args, warm) for _ in range(c)]
             )
         stats = await run_closed_loop(args, c)
-        from load_gen import _percentiles
-
         if stats.completed and not stats.tokens:
             raise RuntimeError(
                 f"concurrency {c}: {stats.completed} requests completed "
@@ -202,10 +200,8 @@ async def drive(args, shape: dict) -> list[dict]:
             "completed": stats.completed,
             "errors": stats.errors,
             "output_tok_per_s": round(stats.tokens / max(stats.elapsed, 1e-9), 2),
-            "ttft_ms": {k: round(v * 1000, 1)
-                        for k, v in _percentiles(stats.ttft).items()},
-            "e2e_ms": {k: round(v * 1000, 1)
-                       for k, v in _percentiles(stats.e2e).items()},
+            "ttft_ms": ms(stats.ttft),
+            "e2e_ms": ms(stats.e2e),
         }
         print(json.dumps(row), flush=True)
         results.append(row)
@@ -319,15 +315,9 @@ def drive_multiturn(cli, shape: dict, model_dir: str, tmp: str) -> list[dict]:
                 "output_tok_per_s": round(
                     stats.tokens / max(stats.elapsed, 1e-9), 2
                 ),
-                "ttft_first_ms": {
-                    k: round(v * 1000, 1)
-                    for k, v in _percentiles(stats.ttft_first).items()},
-                "ttft_later_ms": {
-                    k: round(v * 1000, 1)
-                    for k, v in _percentiles(stats.ttft_later).items()},
-                "e2e_ms": {
-                    k: round(v * 1000, 1)
-                    for k, v in _percentiles(stats.e2e).items()},
+                "ttft_first_ms": ms(stats.ttft_first),
+                "ttft_later_ms": ms(stats.ttft_later),
+                "e2e_ms": ms(stats.e2e),
             }
             print(json.dumps(row), flush=True)
             rows.append(row)
@@ -347,6 +337,12 @@ def main() -> None:
     p.add_argument("--concurrency", default=None, help="comma list override")
     p.add_argument("--users", type=int, default=None)
     p.add_argument("--turns", type=int, default=None)
+    p.add_argument("--keep-logs", default=None,
+                   help="copy server logs to this directory instead of "
+                        "deleting them with the tmp dir (stall forensics)")
+    p.add_argument("--engine-override", default=None,
+                   help="JSON dict merged over the shape's engine config "
+                        "(e.g. '{\"mixed_wide_max_running\": 32}')")
     p.add_argument("--ready-timeout", type=float, default=1200.0)
     p.add_argument("--out", default=None, help="results JSON path")
     cli = p.parse_args()
@@ -362,6 +358,11 @@ def main() -> None:
         shape = dict(shape, users=cli.users)
     if cli.turns:
         shape = dict(shape, turns=cli.turns)
+    if cli.engine_override:
+        shape = dict(
+            shape,
+            engine=dict(shape["engine"], **json.loads(cli.engine_override)),
+        )
 
     tmp = tempfile.mkdtemp(prefix="dyn_serve_bench_")
     model_dir = make_model_dir(tmp, shape)
@@ -434,6 +435,11 @@ def main() -> None:
                 f"| {r['e2e_ms']['p50']} |"
             )
     finally:
+        if cli.keep_logs:
+            os.makedirs(cli.keep_logs, exist_ok=True)
+            for f in os.listdir(tmp):
+                if f.startswith("server") and f.endswith(".log"):
+                    shutil.copy(os.path.join(tmp, f), cli.keep_logs)
         shutil.rmtree(tmp, ignore_errors=True)
 
 

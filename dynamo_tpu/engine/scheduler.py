@@ -184,6 +184,9 @@ class Scheduler:
         # prefix-cache stats (one query per admitted request)
         self.prefix_queries = 0
         self.prefix_hits = 0
+        # recompute-preemption count (observability: healthy serving
+        # should sit at ~0 — see _growth_reserve)
+        self.preemptions = 0
 
     # -- intake -----------------------------------------------------------
     def add_request(self, seq: Sequence) -> None:
@@ -304,7 +307,46 @@ class Scheduler:
                 self.running.remove(seq)
                 self.finish(seq, FinishReason.CANCELLED)
 
+    def _growth_reserve(self) -> int:
+        """Blocks the CURRENT population still needs to finish its
+        generations (exact when max_tokens is known; one decode window
+        otherwise). Admission leaves this many blocks free: without the
+        reserve, blocks freed by a preemption are instantly consumed by
+        the next waiting prompt, and the following decode window
+        preempts again — a recompute cascade in which every admission
+        costs a running request its entire prompt's prefill windows
+        (observed as a c=64 ISL-3000 collapse to 35 out tok/s with
+        ~9-minute TTFT outliers; 20 preemptions per 120 s even in
+        healthy runs)."""
+        r = 0
+        for seq in self.running:
+            if seq.max_new_tokens is not None:
+                end = seq.total_len + max(
+                    0, seq.max_new_tokens - seq.generated
+                )
+            else:
+                end = seq.total_len + self.decode_lookahead
+            r += max(
+                0,
+                seq.blocks_needed(end, self.block_size)
+                - len(seq.block_table),
+            )
+        for seq in self.prefilling:
+            # a prefilling seq holds its full prompt's blocks already;
+            # reserve its generation growth
+            if seq.max_new_tokens is not None:
+                end = seq.total_len + seq.max_new_tokens
+            else:
+                end = seq.total_len + self.decode_lookahead
+            r += max(
+                0,
+                seq.blocks_needed(end, self.block_size)
+                - len(seq.block_table),
+            )
+        return r
+
     def _admit(self) -> None:
+        reserve = None  # computed lazily, refreshed per admission
         while self.waiting and (
             len(self.running) + len(self.prefilling) < self.max_batch_size
         ):
@@ -316,6 +358,15 @@ class Scheduler:
             seq_hashes = seq.tokens.sequence_hashes()
             # blocks for the whole prompt + 1 growth block
             n_prompt_blocks = seq.blocks_needed(seq.total_len, self.block_size)
+            if reserve is None:
+                reserve = self._growth_reserve()
+            if self.allocator.num_free < n_prompt_blocks + reserve:
+                break  # backpressure: the population's growth comes first
+            # admitting this seq adds its own growth to the reserve
+            reserve += seq.blocks_needed(
+                seq.total_len + (seq.max_new_tokens or self.decode_lookahead),
+                self.block_size,
+            ) - n_prompt_blocks
             try:
                 complete = seq_hashes[: n_prompt_blocks]
                 blocks, cached = self.allocator.allocate_prefix(complete)
@@ -620,6 +671,7 @@ class Scheduler:
         }
 
     def _preempt(self, victim: Sequence) -> None:
+        self.preemptions += 1
         log.warning("preempting %s (recompute)", victim.request_id)
         self.running.remove(victim)
         self.allocator.free_sequence(victim.block_table)

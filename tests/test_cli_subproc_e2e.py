@@ -9,6 +9,7 @@ with a generated endpoint path and the coordinator address, the child
 registers there, and the frontend proxies with local pre/post. Killing
 the frontend must also reap the child (atexit)."""
 
+import os
 import time
 
 from cli_harness import MODEL_DIR, CliFleet, complete, free_port, wait_http
@@ -44,26 +45,33 @@ def test_subprocess_engine_adapter_serves_http():
         assert out["choices"][0]["finish_reason"] == "length"
         fleet.assert_alive()
         # the adapter owns the child: killing the frontend must reap it.
-        # The child holds the store lease for the generated endpoint; a
-        # leaked child would keep the instance registered.
+        # Assert on the CHILD's actual process (its cmdline carries the
+        # generated internal.subproc endpoint) — the frontend's port
+        # going dark says nothing about the child, which CliFleet never
+        # spawned and so would leak silently past teardown.
         import signal as _signal
-        import urllib.request
 
+        def child_pids() -> list[int]:
+            pids = []
+            for pid in os.listdir("/proc"):
+                if not pid.isdigit():
+                    continue
+                try:
+                    with open(f"/proc/{pid}/cmdline", "rb") as f:
+                        if b"internal.subproc" in f.read():
+                            pids.append(int(pid))
+                except OSError:
+                    pass
+            return pids
+
+        assert child_pids(), "child engine process not found"
         frontend.send_signal(_signal.SIGTERM)
         frontend.wait(timeout=20)
         fleet.forget(frontend)
         deadline = time.monotonic() + 60
-        gone = False
-        while time.monotonic() < deadline:
-            try:
-                with urllib.request.urlopen(
-                    f"http://127.0.0.1:{http_port}/v1/models", timeout=2
-                ):
-                    pass
-            except Exception:
-                gone = True
-                break
+        while time.monotonic() < deadline and child_pids():
             time.sleep(0.5)
-        assert gone, "frontend kept serving after SIGTERM"
+        leaked = child_pids()
+        assert not leaked, f"child engine leaked after SIGTERM: {leaked}"
     finally:
         fleet.teardown()

@@ -15,7 +15,7 @@ import numpy as np
 
 from dynamo_tpu.engine.allocator import BlockAllocator
 from dynamo_tpu.engine.config import EngineConfig
-from dynamo_tpu.engine.scheduler import Scheduler, Sequence
+from dynamo_tpu.engine.scheduler import Scheduler, SeqState, Sequence
 from dynamo_tpu.protocols.common import (
     FinishReason,
     PreprocessedRequest,
@@ -136,6 +136,46 @@ def test_scheduler_mixed_disabled_keeps_either_or():
     b = _mk_seq(list(range(5, 25)), request_id="b")
     sched.add_request(b)
     assert sched.plan().kind == "prefill"
+
+
+def test_admission_reserves_population_growth():
+    """Admission must leave the blocks the RUNNING population still
+    needs to finish: without the reserve, a freed block is instantly
+    eaten by the next waiting prompt and decode growth preempts a
+    running sequence — a recompute cascade under closed-loop pressure
+    (observed as the ISL-3000 c=64 collapse)."""
+    alloc = BlockAllocator(16, 4)
+    sched = Scheduler(alloc, 4, max_batch_size=8, prefill_chunk_size=64)
+    sched.decode_lookahead = 4
+    # A: 20-token prompt (5 blocks), will generate 12 more -> needs 8
+    # blocks total, i.e. growth reserve 3 once prefilled
+    a = _mk_seq(list(range(20)), max_tokens=12, request_id="a")
+    sched.add_request(a)
+    plan = sched.plan()
+    assert plan.kind == "prefill"
+    for w in plan.prefill_batch:
+        sched.complete_prefill_chunk(w)
+    assert sched.num_running == 1
+    # B: 36-token prompt (9 blocks). free = 11, but A's growth needs 3
+    # -> 9 + 3 > 11: B must WAIT (no reserve would admit it and later
+    # preempt A)
+    b = _mk_seq(list(range(36)), max_tokens=4, request_id="b")
+    sched.add_request(b)
+    plan = sched.plan()
+    assert plan.kind == "decode"  # B not admitted
+    assert len(sched.waiting) == 1
+    # A decodes to completion without ever being preempted
+    while a.state == SeqState.RUNNING:
+        sched.plan()
+        sched.append_token(a, 1)
+        r = sched.should_finish(a)
+        if r is not None:
+            sched.finish(a, r)
+    assert sched.preemptions == 0
+    # A's blocks freed -> B admits now
+    plan = sched.plan()
+    assert plan.kind == "prefill"
+    assert plan.prefill.seq.request_id == "b"
 
 
 # ---------------------------------------------------------------------------
