@@ -117,7 +117,10 @@ SHAPES = {
                     block_size=128, max_batch_size=32, decode_steps=32,
                     prefill_chunk_size=1024, max_model_len=2304,
                     num_blocks=192),
-        engine_b=dict(host_kv_blocks=768),  # overlay: the G2 tier
+        # overlay: the G2 tier, FORCED past the restore-vs-recompute
+        # probe — this mode exists to measure the tier itself (the
+        # gate would disable it on a slow tunnel link)
+        engine_b=dict(host_kv_blocks=768, kv_offload_force=True),
         # ~30 words x ~9 tok/word = ~270 prompt tokens per turn + 64
         # generated: 6 turns end near 2000 tokens of history
         workload="multiturn",
@@ -136,7 +139,7 @@ SHAPES = {
         engine=dict(random_weights=True, num_blocks=64, block_size=16,
                     max_batch_size=8, decode_steps=4,
                     prefill_chunk_size=256, max_model_len=512),
-        engine_b=dict(host_kv_blocks=256),
+        engine_b=dict(host_kv_blocks=256, kv_offload_force=True),
         workload="multiturn",
         isl=4, osl=8, users=4, turns=3, think=0.2,
         duration=0.0, concurrency=[],

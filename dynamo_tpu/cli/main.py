@@ -89,10 +89,12 @@ def build_parser() -> argparse.ArgumentParser:
                           "budget, fewer rows) so long prompts stop "
                           "trickling at --mixed-prefill-len per window; "
                           "0 disables")
-    run.add_argument("--mixed-wide-max-running", type=int, default=4,
+    run.add_argument("--mixed-wide-max-running", type=int, default=None,
                      help="decode-occupancy ceiling for the wide "
-                          "rectangle (above it the narrow rectangle's "
-                          "extra rows win)")
+                          "rectangle (default: none — the wide and "
+                          "narrow rectangles cost the same padded "
+                          "budget, so the swap is free at any "
+                          "occupancy when few prompts are prefilling)")
     run.add_argument("--tensor-parallel-size", type=int, default=1)
     run.add_argument("--pipeline-parallel-size", type=int, default=1,
                      help="GPipe stage rotation over a pp mesh axis")

@@ -79,6 +79,23 @@ class BlockAllocator:
                 break
         return n
 
+    def free_need(self, seq_hashes: list[int], n_total: int) -> int:
+        """How many blocks allocating this prompt would take from the
+        FREE pool (no allocation): fresh blocks plus matched prefix
+        blocks that are currently cached-free. Matched blocks pinned by
+        other sequences cost the free pool nothing — charging them
+        would make admission stall on exactly the shared-prefix
+        workloads prefix caching exists for."""
+        need = n_total
+        if self.enable_prefix_caching:
+            for h in seq_hashes:
+                bid = self._hash_index.get(h)
+                if bid is None:
+                    break
+                if bid not in self._free:
+                    need -= 1  # actively shared: already pinned elsewhere
+        return max(0, need)
+
     # -- allocation -------------------------------------------------------
     def allocate_prefix(self, seq_hashes: list[int]) -> tuple[list[int], int]:
         """Allocate blocks for a prompt: reuse the cached complete-block

@@ -33,6 +33,16 @@ class EngineConfig:
     disk_kv_blocks: int = 0
     disk_kv_path: str = ""
     kv_offload_batch: int = 16
+    # restore-vs-recompute gate for the G2 host tier: at startup the
+    # engine probes real host<->device copy bandwidth and disables the
+    # tier when restoring a block costs more than recomputing its
+    # tokens (block_size / this rate). Chips behind a slow tunnel fail
+    # the probe (measured: unthrottled G2 collapsed multi-turn serving
+    # 16x, throttled still 2x — benchmarks/RESULTS.md); directly
+    # attached HBM<->DRAM passes easily. Set kv_offload_force=True to
+    # keep the tier regardless (benchmarking, known-fast links).
+    kv_recompute_tok_per_s: float = 2000.0
+    kv_offload_force: bool = False
     # G4 remote tier: bucket in the coordinator store's object plane
     # ("" = disabled; requires the worker to run with a store, and
     # host_kv_blocks > 0 for the demotion cascade to reach it)
@@ -60,9 +70,9 @@ class EngineConfig:
     # of 16 — half the batch idle)
     mixed_prefill_rows: int = 8
     mixed_prefill_len: int = 256
-    # adaptive WIDE mixed rectangle: when decode occupancy is low
-    # (running <= mixed_wide_max_running) and few prompts are
-    # prefilling, the mixed window swaps its rectangle for
+    # adaptive WIDE mixed rectangle: when few prompts are prefilling
+    # (and decode occupancy is under mixed_wide_max_running, if set),
+    # the mixed window swaps its rectangle for
     # [~rows*len/wide_len, wide_len] — same token budget, fewer rows —
     # so a long prompt prefills in backlog/wide_len windows instead of
     # backlog/len (measured: a 3000-token prompt at ISL-3000/c=4 took
@@ -71,10 +81,15 @@ class EngineConfig:
     # result). 0 disables. The wide variant costs a few extra prewarm
     # compiles at startup.
     mixed_prefill_wide_len: int = 1024
-    # decode-occupancy ceiling for the wide rectangle: above this many
-    # running sequences the narrow rectangle's extra rows matter more
-    # than per-prompt prefill latency
-    mixed_wide_max_running: int = 4
+    # decode-occupancy ceiling for the wide rectangle (None = no
+    # ceiling, the default): the wide and narrow rectangles have the
+    # SAME padded token budget, so when at most wide_rows prompts are
+    # prefilling the wide swap costs decode nothing at any occupancy —
+    # measured at ISL-3000 c=16: 123.6 -> 138.2 out tok/s, p50 TTFT
+    # 17.7 -> 10.8 s when the old ceiling of 4 was lifted. The real
+    # guards are the prefilling-count (<= wide_rows) and backlog
+    # (> narrow len) conditions in scheduler._mixed_rect.
+    mixed_wide_max_running: Optional[int] = None
     # static serving shapes: pad the decode batch to max_batch_size and
     # block-table width to the max_model_len cap so the decode/mixed
     # dispatch is ONE compiled shape (padded rows are ~free — decode is

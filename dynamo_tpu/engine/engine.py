@@ -103,6 +103,12 @@ class JaxEngine:
         # wide mixed rectangle (rows, len), set when enabled (see
         # _initialize; scheduler._mixed_rect picks per population)
         self._wide_rect: Optional[tuple[int, int]] = None
+        # blocks the busy-path offload pump may move per serving step
+        # (derived from the probed copy bandwidth in _gate_kv_offload;
+        # 0 = transfers wait for idle moments; None = pump's own
+        # default batch — the multihost sharded tier, which has no
+        # local probe)
+        self._kv_busy_pump_cap: Optional[int] = 0
         self._pp = config.pipeline_parallel_size
         # multi-host: rank 0 leads (scheduler + broadcast), others follow
         self._is_follower = config.num_nodes > 1 and config.node_rank > 0
@@ -389,7 +395,7 @@ class JaxEngine:
                     sched.mixed_prefill_wide_rows = wr
                     sched.mixed_prefill_wide_len = wl
                     sched.mixed_wide_max_running = getattr(
-                        cfg, "mixed_wide_max_running", 4
+                        cfg, "mixed_wide_max_running", None
                     )
                     self._wide_rect = (wr, wl)
         self.scheduler.on_finish = self._emit_finish
@@ -448,6 +454,7 @@ class JaxEngine:
         prewarm = cfg.prewarm
         if prewarm is None:
             prewarm = jax.default_backend() == "tpu"
+        self._gate_kv_offload()
         if prewarm:
             self._prewarm()
         log.info(
@@ -689,7 +696,110 @@ class JaxEngine:
                     for (bf, pw), pn in p_nexts.items():
                         if bf == b_from:
                             self._chain_fn(lasts[b_from], pn, idx)
+        if self.kvbm is not None and self._mh_broadcast is None:
+            # (single-host manager only: the multihost sharded offload
+            # runs mirrored gathers, a different program)
+            # KV offload/onboard shapes: each gather/scatter id bucket is
+            # its own cache-sized jit program — an unwarmed bucket lands
+            # as a mid-serve stall exactly when the first conversation's
+            # blocks offload (measured: the multi-turn A/B's first turns
+            # all stalled ~80 s together). Warm the buckets the offload
+            # batch and prompt-onboard paths can reach.
+            from dynamo_tpu.ops.block_copy import ID_BUCKETS
+
+            width_cap = sched.table_width_pad or 32
+            max_ids = min(
+                max(self.config.kv_offload_batch, width_cap),
+                ID_BUCKETS[-1],
+            )
+            for b in [x for x in ID_BUCKETS if x <= max_ids]:
+                ids = [0] * b  # garbage block: reads/writes are harmless
+                data = self._kv_gather(ids)
+                self._kv_scatter(ids, data)
+            jax.block_until_ready(self.k_cache)
         log.info("prewarm done in %.1fs", time.monotonic() - t0)
+
+    def _gate_kv_offload(self) -> None:
+        """Restore-vs-recompute gate for the G2 host tier: probe the
+        REAL host<->device copy bandwidth and drop the tier when
+        restoring a block costs more than recomputing its tokens.
+
+        Rationale (measured, benchmarks/RESULTS.md): on a tunneled chip
+        a 16.8 MB block moves slower than the flash-prefill path
+        recomputes its 128 tokens, so every onboard and write-through
+        offload made multi-turn serving STRICTLY worse (16x collapse
+        unthrottled, 2x throttled). On directly-attached hardware
+        (PCIe/DMA, or CPU where host==device) the probe passes and the
+        tier behaves as designed. kv_offload_force keeps it
+        unconditionally."""
+        cfg = self.config
+        if self.kvbm is None:
+            return
+        if self._mh_broadcast is not None:
+            # sharded tier: mirrored transfers, no local probe — keep
+            # the full busy-path batch (None = pump default) rather
+            # than starving offload to idle-only with no measurement
+            self._kv_busy_pump_cap = None
+            return
+        n = 4
+        ids = [0] * n  # garbage block: harmless reads/writes
+        data = self._kv_gather(ids)  # compile
+        self._kv_scatter(ids, data)
+        jax.block_until_ready(self.k_cache)
+        # best-of-3: one contended sample must not permanently kill a
+        # tier the link can actually sustain (capacity question ->
+        # best observed bandwidth is the right estimator)
+        gather_bps = scatter_bps = 0.0
+        for _ in range(3):
+            t0 = time.monotonic()
+            data = self._kv_gather(ids)
+            t1 = time.monotonic()
+            self._kv_scatter(ids, data)
+            jax.block_until_ready(self.k_cache)
+            t2 = time.monotonic()
+            gather_bps = max(gather_bps, data.nbytes / max(t1 - t0, 1e-9))
+            scatter_bps = max(scatter_bps, data.nbytes / max(t2 - t1, 1e-9))
+        block_bytes = data.nbytes / n
+        # restoring a block must beat recomputing block_size tokens
+        required = block_bytes * cfg.kv_recompute_tok_per_s / max(
+            1, cfg.block_size or 1
+        )
+        bps = min(gather_bps, scatter_bps)
+        # busy-path offload cap from the measured bandwidth: allow only
+        # what fits in ~20 ms between serving steps (0 on slow links —
+        # transfers then wait for idle moments)
+        self._kv_busy_pump_cap = min(4, int(bps * 0.02 / block_bytes))
+        if bps >= required:
+            log.info(
+                "G2 host KV tier active: copy bandwidth %.0f MB/s >= "
+                "threshold %.0f MB/s (busy-path cap %d blocks/step)",
+                bps / 1e6, required / 1e6, self._kv_busy_pump_cap,
+            )
+        elif cfg.kv_offload_force or cfg.disk_kv_blocks > 0 or cfg.remote_kv_bucket:
+            # explicitly configured G3/G4 tiers must not vanish behind
+            # a probe (mirrors the config-time invariant above): keep
+            # the cascade, loudly
+            log.warning(
+                "G2 host KV tier kept (%s) despite copy bandwidth "
+                "%.0f MB/s < restore-beats-recompute threshold "
+                "%.0f MB/s — restores will be slower than recompute "
+                "on this link",
+                "kv_offload_force" if cfg.kv_offload_force
+                else "G3/G4 tiers configured",
+                bps / 1e6, required / 1e6,
+            )
+        else:
+            log.warning(
+                "G2 host KV tier disabled: measured copy bandwidth "
+                "%.0f MB/s (gather %.0f / scatter %.0f) is below the "
+                "restore-beats-recompute threshold %.0f MB/s at "
+                "kv_recompute_tok_per_s=%.0f — restoring blocks would "
+                "be slower than re-prefilling them on this link. Set "
+                "kv_offload_force=true to keep the tier.",
+                bps / 1e6, gather_bps / 1e6, scatter_bps / 1e6,
+                required / 1e6, cfg.kv_recompute_tok_per_s,
+            )
+            self._disable_kvbm()
 
     def _auto_num_blocks(self, devices) -> int:
         """Size the KV cache from free HBM (fallback: modest default)."""
@@ -1142,14 +1252,14 @@ class JaxEngine:
         assert self.scheduler is not None
         from dynamo_tpu.parallel.multihost import FatalMultihostError
 
-        def pump_kvbm() -> bool:
+        def pump_kvbm(max_blocks: Optional[int] = None) -> bool:
             """False = fatal multihost failure: the loop must fail all
             requests and stop (a raise here would escape _step_loop and
             leave every request stream hanging on a dead thread)."""
             if self.kvbm is None:
                 return True
             try:
-                self.kvbm.pump()
+                self.kvbm.pump(max_blocks)
             except FatalMultihostError:
                 log.exception(
                     "fatal multihost failure inside a mirrored KV op; "
@@ -1183,8 +1293,11 @@ class JaxEngine:
                         break
             if not self.scheduler.has_work:
                 # idle: drain the offload queue (and run the pump's
-                # periodic G4 index refresh) before sleeping
-                if not pump_kvbm():
+                # periodic G4 index refresh) before sleeping. SMALL
+                # batches per iteration: each block is a multi-MB
+                # device->host transfer, and a request arriving
+                # mid-batch must not wait out a 16-block gather.
+                if not pump_kvbm(4):
                     self._fail_all()
                     self._running = False
                     return
@@ -1212,7 +1325,14 @@ class JaxEngine:
                     )
                     self._fail_all()
                 continue
-            if not pump_kvbm():
+            # BUSY path: bounded by the probed copy bandwidth (~20 ms
+            # of transfer per step; 0 on slow links). Unbounded
+            # write-through offload between serving steps put multi-MB
+            # transfers on every window and collapsed multi-turn
+            # serving 16x on the tunneled chip (benchmarks/RESULTS.md);
+            # pending commits are bounded by G1 size, revalidated at
+            # pump time, and drain at idle moments.
+            if not pump_kvbm(self._kv_busy_pump_cap):
                 self._fail_all()
                 self._running = False
                 return

@@ -157,7 +157,7 @@ class Scheduler:
         # long prompt stops trickling at mixed_prefill_len per window
         self.mixed_prefill_wide_rows = 0
         self.mixed_prefill_wide_len = 0
-        self.mixed_wide_max_running = 4
+        self.mixed_wide_max_running: Optional[int] = None
         # static serving shapes (engine sets these): every jit variant
         # costs a multi-minute AOT compile on a tunneled chip, and
         # composition-dependent buckets compile MID-SERVE. Padding the
@@ -289,7 +289,10 @@ class Scheduler:
             )
         if (
             self.mixed_prefill_wide_rows > 0
-            and n_running <= self.mixed_wide_max_running
+            and (
+                self.mixed_wide_max_running is None
+                or n_running <= self.mixed_wide_max_running
+            )
             and len(prefill_seqs) <= self.mixed_prefill_wide_rows
             and backlog > self.mixed_prefill_len
         ):
@@ -360,7 +363,12 @@ class Scheduler:
             n_prompt_blocks = seq.blocks_needed(seq.total_len, self.block_size)
             if reserve is None:
                 reserve = self._growth_reserve()
-            if self.allocator.num_free < n_prompt_blocks + reserve:
+            # charge only what admission actually takes from the free
+            # pool: actively-shared prefix blocks are already pinned
+            free_need = self.allocator.free_need(
+                seq_hashes[:n_prompt_blocks], n_prompt_blocks
+            )
+            if self.allocator.num_free < free_need + reserve:
                 break  # backpressure: the population's growth comes first
             # admitting this seq adds its own growth to the reserve
             reserve += seq.blocks_needed(

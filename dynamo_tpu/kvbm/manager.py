@@ -211,8 +211,14 @@ class KvBlockManager:
     REMOTE_REFRESH_S = 5.0
 
     # -- offload pump (engine thread, between steps) -----------------------
-    def pump(self) -> int:
-        """Offload up to ``offload_batch`` pending blocks; returns count."""
+    def pump(self, max_blocks: Optional[int] = None) -> int:
+        """Offload up to ``max_blocks`` (default ``offload_batch``)
+        pending blocks; returns count. ``max_blocks=0`` runs only the
+        periodic G4 index refresh — the engine uses it to keep the
+        refresh alive while serving is busy (each offloaded block is a
+        multi-MB device->host transfer on the engine thread; measured on
+        the tunneled chip, unthrottled write-through offload collapsed
+        multi-turn serving 16x — benchmarks/RESULTS.md)."""
         if self.remote is not None:
             # periodic G4 index refresh: discover blocks OTHER workers
             # demoted since we attached (the cross-worker tier benefit)
@@ -225,10 +231,13 @@ class KvBlockManager:
                     self.remote.refresh_remote_index()
                 except Exception:
                     log.exception("G4 index refresh failed")
-        if not self._pending:
+        if not self._pending or max_blocks == 0:
             return 0
+        cap = self._offload_batch if max_blocks is None else min(
+            max_blocks, self._offload_batch
+        )
         batch: list[tuple[int, int]] = []
-        while self._pending and len(batch) < self._offload_batch:
+        while self._pending and len(batch) < cap:
             h, bid = self._pending.popitem(last=False)
             # the device block may have been evicted/reassigned since commit
             if self._resolve(h) == bid and not self.host.contains(h):

@@ -494,10 +494,15 @@ class ShardedKvOffload:
     def pending_offloads(self) -> int:
         return len(self._pending)
 
-    def pump(self) -> int:
+    def pump(self, max_blocks: Optional[int] = None) -> int:
         e = self.engine
+        if max_blocks == 0:
+            return 0
+        cap = self._offload_batch if max_blocks is None else min(
+            max_blocks, self._offload_batch
+        )
         batch: list[tuple[int, int]] = []
-        while self._pending and len(batch) < self._offload_batch:
+        while self._pending and len(batch) < cap:
             h, bid = self._pending.popitem(last=False)
             if e.allocator.lookup_block(h) == bid and not self.pool.contains(h):
                 batch.append((h, bid))

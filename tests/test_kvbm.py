@@ -444,3 +444,35 @@ def test_g4_flaky_remote_reads_as_miss_not_crash():
     dev.hash_index.clear()
     # remote read raises -> treated as missing prefix row, no exception
     assert m.onboard([71, 72], [5, 6]) == 0
+
+
+async def test_restore_vs_recompute_gate():
+    """The G2 tier auto-disables when the probed host<->device copy
+    bandwidth cannot beat recompute (kv_recompute_tok_per_s absurdly
+    high simulates a slow link), and kv_offload_force keeps it."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    base = dict(
+        model_path=MODEL_DIR, model_name="tiny", random_weights=True,
+        num_blocks=13, block_size=8, max_batch_size=4,
+        prefill_chunk_size=32, max_model_len=128, host_kv_blocks=64,
+    )
+    # threshold no real link can meet -> tier dropped at startup
+    engine = await JaxEngine.launch(
+        EngineConfig(**base, kv_recompute_tok_per_s=1e15)
+    )
+    try:
+        assert engine.kvbm is None
+    finally:
+        await engine.shutdown()
+    # force overrides the gate
+    engine = await JaxEngine.launch(
+        EngineConfig(
+            **base, kv_recompute_tok_per_s=1e15, kv_offload_force=True
+        )
+    )
+    try:
+        assert engine.kvbm is not None
+    finally:
+        await engine.shutdown()

@@ -156,10 +156,11 @@ def test_admission_reserves_population_growth():
     for w in plan.prefill_batch:
         sched.complete_prefill_chunk(w)
     assert sched.num_running == 1
-    # B: 36-token prompt (9 blocks). free = 11, but A's growth needs 3
-    # -> 9 + 3 > 11: B must WAIT (no reserve would admit it and later
-    # preempt A)
-    b = _mk_seq(list(range(36)), max_tokens=4, request_id="b")
+    # B: 36-token prompt (9 blocks), DISTINCT from A (a shared prefix
+    # would be charged only for its fresh tail). free = 11, but A's
+    # growth needs 3 -> 9 + 3 > 11: B must WAIT (no reserve would
+    # admit it and later preempt A)
+    b = _mk_seq(list(range(100, 136)), max_tokens=4, request_id="b")
     sched.add_request(b)
     plan = sched.plan()
     assert plan.kind == "decode"  # B not admitted
@@ -398,3 +399,34 @@ async def test_mixed_engine_long_prompt_and_pressure():
         assert solo == results[0][0]
     finally:
         await engine.shutdown()
+
+
+def test_admission_gate_ignores_actively_shared_prefix():
+    """The growth-reserve admission gate charges only what admission
+    takes from the FREE pool: a prompt whose prefix blocks are pinned
+    by running sequences admits even when free blocks < total prompt
+    blocks (shared-prefix workloads must not stall on phantom need)."""
+    alloc = BlockAllocator(16, 4)
+    sched = Scheduler(alloc, 4, max_batch_size=8, prefill_chunk_size=64)
+    sched.decode_lookahead = 1
+    # A: 40-token prompt = 10 blocks, pinned and running
+    a = _mk_seq(list(range(40)), max_tokens=2, request_id="a")
+    sched.add_request(a)
+    plan = sched.plan()
+    while plan.kind == "prefill":
+        for w in plan.prefill_batch:
+            sched.complete_prefill_chunk(w)
+        plan = sched.plan()
+    assert sched.num_running == 1
+    assert alloc.num_free < 10  # free pool cannot hold the prompt fresh
+    # B: SAME 40-token prompt + 4 extra tokens = 11 blocks total, but
+    # 10 are actively shared with A -> only ~1-2 fresh needed
+    b = _mk_seq(list(range(40)) + [99, 98, 97, 96], max_tokens=2,
+                request_id="b")
+    sched.add_request(b)
+    plan = sched.plan()
+    assert plan.kind in ("prefill", "mixed")
+    assert any(
+        w.seq.request_id == "b"
+        for w in plan.prefill_batch
+    ), "shared-prefix prompt was not admitted"
