@@ -92,6 +92,12 @@ SHAPES = {
             num_attention_heads=32, num_key_value_heads=8,
             max_position_embeddings=8192,
         ),
+        # hbm_utilization stays 0.7: 0.8 measured +3.5% at saturation
+        # (341 vs 298 blocks, c=64 138->143) BUT introduced a one-time
+        # ~106 s mid-serve stall shortly after startup (memory
+        # pressure; absent at 0.7 — see RESULTS.md negative result),
+        # which lands inside interactive windows. 0.85 was flat:
+        # residency stops binding near ~340 blocks at this shape.
         engine=dict(random_weights=True, quantization="int8",
                     block_size=128, max_batch_size=32, decode_steps=32,
                     hbm_utilization=0.7, prefill_chunk_size=1024,
