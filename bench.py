@@ -75,7 +75,14 @@ def _build_config(cpu_mode: bool):
         # conservative utilization below. block_size 128 = the TPU
         # serving default (MXU-width kernel dots; +20% measured over
         # 16-token pages)
-        workload = dict(batch=32, isl=128, osl=128, num_blocks=None,
+        # batch 64 default: the cohort-admission fix (scheduler.py
+        # plan() cohort gate) made wide closed batches pay — windows
+        # are weights-bound, so doubling rows nearly doubles tokens
+        # per window (measured ladder on-chip: B=32 1514, B=64 2181,
+        # B=128 2464 tok/s at p50 TTFT 577/1048/1710 ms; B=64 is the
+        # default as the throughput/TTFT balance, DYN_BENCH_BATCH
+        # overrides)
+        workload = dict(batch=64, isl=128, osl=128, num_blocks=None,
                         block_size=128, quant=quant, model_name=bench_model)
     workload["batch"] = int(os.environ.get("DYN_BENCH_BATCH", workload["batch"]))
     workload["isl"] = int(os.environ.get("DYN_BENCH_ISL", workload["isl"]))
@@ -99,6 +106,15 @@ def _kv_bytes_per_token(mc) -> int:
 
 
 async def _run(model_cfg, wl) -> dict:
+    if os.environ.get("DYN_STEP_TRACE"):
+        # step-trace forensics print via logging.INFO; the bench is a
+        # bare script, so wire a handler or the trace silently drops
+        import logging
+
+        logging.basicConfig(
+            level=logging.INFO, stream=sys.stderr,
+            format="%(asctime)s %(name)s: %(message)s",
+        )
     import numpy as np
 
     from dynamo_tpu.engine.config import EngineConfig

@@ -300,20 +300,30 @@ class JaxEngine:
                 -(-blocks_cap // Scheduler.TABLE_BUCKET)
                 * Scheduler.TABLE_BUCKET,
             )
-            # three prefill-batch shapes (each bucket is a multi-minute
-            # AOT prewarm): a single-row shape so a lone prompt on an
-            # idle engine doesn't pay 8× padded compute (prefill is
+            # prefill-batch shapes (each bucket is a multi-minute AOT
+            # prewarm): a single-row shape so a lone prompt on an idle
+            # engine doesn't pay 8× padded compute (prefill is
             # compute-bound, unlike decode), the mixed rectangle's row
-            # count, and the full-burst width
+            # count, the full-burst width, AND the budget-filling width
+            # (max_prefill_tokens / smallest chunk): without it, a
+            # burst wider than the mixed rows has no bucket between
+            # rows and the full pad, so batched prefill degrades to
+            # rows-sized steps — measured at B=64 as staggered prefill
+            # waves that desynchronize decode for the population's
+            # lifetime (windows run 16-40 wide at full-window cost,
+            # 924 vs 1505 tok/s)
+            sched.prefill_chunk_buckets = [128, 256, 1024, 4096]
+            budget_rows = max(
+                1,
+                (cfg.max_prefill_tokens or 4096)
+                // sched.prefill_chunk_buckets[0],
+            )
             sched.prefill_batch_buckets = sorted(
                 {1,
                  min(cfg.mixed_prefill_rows, sched.decode_batch_pad),
+                 min(budget_rows, sched.decode_batch_pad),
                  sched.decode_batch_pad}
             )
-            # 128 matters: a full-batch burst of short prompts (the
-            # closed-batch benchmark shape) packs into ONE [B, 128]
-            # dispatch instead of B/rows padded [rows, 256] steps
-            sched.prefill_chunk_buckets = [128, 256, 1024, 4096]
         if cfg.decode_steps > 1 and cfg.mixed_prefill_rows > 0:
             # normalize to bucket values: _pad_prefill_rect's fixed
             # rectangle must be >= the bucketed prefill arrays, which

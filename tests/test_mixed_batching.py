@@ -138,6 +138,49 @@ def test_scheduler_mixed_disabled_keeps_either_or():
     assert sched.plan().kind == "prefill"
 
 
+def test_cohort_takes_dedicated_prefill_not_trickle():
+    """A cohort (more prompts than rectangle rows, whole backlog within
+    one prefill budget) takes a dedicated batched step even when decode
+    occupancy is high — trickling it 'rows' per window staggers the
+    population into partial-width waves (measured: B=64 closed batch
+    924 vs 2181 tok/s)."""
+    alloc = BlockAllocator(4096, 4)
+    sched = Scheduler(
+        alloc, 4, max_batch_size=64, prefill_chunk_size=64,
+        max_prefill_tokens=512,
+    )
+    sched.mixed_prefill_rows = 4
+    sched.mixed_prefill_len = 32
+    for i in range(16):
+        s = _mk_seq(list(range(8)), request_id=f"r{i}")
+        sched.add_request(s)
+        p = sched.plan()
+        for w in p.prefill_batch:
+            sched.complete_prefill_chunk(w)
+    assert sched.num_running == 16
+    # cohort: 12 prompts x 20 tokens = 240 <= 512 budget, count > rows.
+    # CRITICAL test geometry: 240 is also <= the mixed-gate bound
+    # 2*rows*rlen (256) and running(16) >= prefilling(12), so the
+    # PRE-cohort gate trickled exactly this through the 4-row
+    # rectangle — the assertion below fails without the cohort gate.
+    for i in range(12):
+        sched.add_request(
+            _mk_seq([200 + i] + list(range(300, 319)), request_id=f"c{i}")
+        )
+    plan = sched.plan()
+    assert plan.kind == "prefill", "cohort must take the dedicated step"
+    assert len(plan.prefill_batch) > sched.mixed_prefill_rows
+    # a straggler (single prompt) still rides the mixed rectangle
+    while sched.prefilling:
+        p = sched.plan()
+        if not p.prefill_batch:
+            break
+        for w in p.prefill_batch:
+            sched.complete_prefill_chunk(w)
+    sched.add_request(_mk_seq(list(range(400, 420)), request_id="s"))
+    assert sched.plan().kind == "mixed"
+
+
 def test_admission_reserves_population_growth():
     """Admission must leave the blocks the RUNNING population still
     needs to finish: without the reserve, a freed block is instantly
