@@ -283,6 +283,12 @@ class JaxEngine:
                 # [4,1]-padded window (~10% lighter than the full pad)
                 # for a handful of extra prewarmed variants
                 sched.decode_batch_small = 4
+            if sched.decode_batch_pad >= 64:
+                # mid bucket: a half-occupancy population on a wide-pad
+                # engine decodes in [pad/2]-windows (measured ~11% at
+                # c=32 on a max_batch=64 engine) for one more set of
+                # prewarmed variants
+                sched.decode_batch_mid = sched.decode_batch_pad // 2
             eff_len = (
                 cfg.max_model_len or self.model_config.max_position_embeddings
             )
@@ -586,7 +592,8 @@ class JaxEngine:
                         self.k_cache, self.v_cache = out[-2], out[-1]
                         jax.block_until_ready(self.k_cache)
         decode_buckets = sorted(
-            {b for b in (sched.decode_batch_small, sched.decode_batch_pad)
+            {b for b in (sched.decode_batch_small, sched.decode_batch_mid,
+                         sched.decode_batch_pad)
              if b}
         ) or [next_bucket(1, sched.BATCH_BUCKETS)]
         B = decode_buckets[-1]

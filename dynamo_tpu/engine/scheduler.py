@@ -172,6 +172,10 @@ class Scheduler:
         # concurrency decodes in a lighter window at the cost of a few
         # extra prewarmed variants
         self.decode_batch_small: Optional[int] = None
+        # optional MID bucket between small and pad (engine sets pad/2
+        # for wide pads): a max_batch=64 engine otherwise pads a
+        # 32-deep population to 64 rows (~11% measured at c=32)
+        self.decode_batch_mid: Optional[int] = None
         self.table_width_pad: Optional[int] = None
         self.prefill_batch_buckets: list[int] = list(self.BATCH_BUCKETS)
         self.prefill_chunk_buckets: list[int] = list(self.CHUNK_BUCKETS)
@@ -780,6 +784,8 @@ class Scheduler:
             and n <= self.decode_batch_small
         ):
             return self.decode_batch_small
+        if self.decode_batch_mid is not None and n <= self.decode_batch_mid:
+            return self.decode_batch_mid
         b = next_bucket(n, self.BATCH_BUCKETS)
         if self.decode_batch_pad is not None and b <= self.decode_batch_pad:
             return self.decode_batch_pad

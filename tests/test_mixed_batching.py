@@ -473,3 +473,20 @@ def test_admission_gate_ignores_actively_shared_prefix():
         w.seq.request_id == "b"
         for w in plan.prefill_batch
     ), "shared-prefix prompt was not admitted"
+
+
+def test_mid_decode_bucket_selection():
+    """Wide-pad engines get a mid decode bucket: a half-occupancy
+    population decodes in [pad/2]-padded windows instead of the full
+    pad (measured ~11% at c=32 on a max_batch=64 engine)."""
+    alloc = BlockAllocator(4096, 4)
+    sched = Scheduler(alloc, 4, max_batch_size=64)
+    sched.decode_batch_small = 4
+    sched.decode_batch_mid = 32
+    sched.decode_batch_pad = 64
+    assert sched._decode_batch(3) == 4
+    assert sched._decode_batch(4) == 4
+    assert sched._decode_batch(5) == 32
+    assert sched._decode_batch(32) == 32
+    assert sched._decode_batch(33) == 64
+    assert sched._decode_batch(64) == 64
