@@ -90,6 +90,12 @@ class EngineConfig:
     # guards are the prefilling-count (<= wide_rows) and backlog
     # (> narrow len) conditions in scheduler._mixed_rect.
     mixed_wide_max_running: Optional[int] = None
+    # explicit MID decode bucket override (None = auto: pad/2 when the
+    # pad is >= 64). Deployments whose steady population sits well
+    # under max_batch_size (e.g. long-context residency caps) can pin
+    # a lighter window here at the cost of one more prewarmed variant
+    # set.
+    decode_batch_mid: Optional[int] = None
     # static serving shapes: pad the decode batch to max_batch_size and
     # block-table width to the max_model_len cap so the decode/mixed
     # dispatch is ONE compiled shape (padded rows are ~free — decode is

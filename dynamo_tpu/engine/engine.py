@@ -283,7 +283,28 @@ class JaxEngine:
                 # [4,1]-padded window (~10% lighter than the full pad)
                 # for a handful of extra prewarmed variants
                 sched.decode_batch_small = 4
-            if sched.decode_batch_pad >= 64:
+            if cfg.decode_batch_mid is not None:
+                # explicit override: the LARGEST bucket <= the request
+                # strictly between the small bucket and the pad (a mid
+                # bucket at/above the pad is a no-op, at/below small is
+                # dead code that still costs AOT prewarms). 0 = no mid
+                # bucket, explicitly (None = auto).
+                lo = sched.decode_batch_small or 0
+                fits = [
+                    b for b in Scheduler.BATCH_BUCKETS
+                    if lo < b < sched.decode_batch_pad
+                    and b <= cfg.decode_batch_mid
+                ]
+                if cfg.decode_batch_mid > 0 and fits:
+                    sched.decode_batch_mid = fits[-1]
+                elif cfg.decode_batch_mid > 0:
+                    log.warning(
+                        "decode_batch_mid=%d has no bucket strictly "
+                        "between the small bucket (%d) and the pad "
+                        "(%d); ignoring the override",
+                        cfg.decode_batch_mid, lo, sched.decode_batch_pad,
+                    )
+            elif sched.decode_batch_pad >= 64:
                 # mid bucket: a half-occupancy population on a wide-pad
                 # engine decodes in [pad/2]-windows (measured ~11% at
                 # c=32 on a max_batch=64 engine) for one more set of

@@ -490,3 +490,39 @@ def test_mid_decode_bucket_selection():
     assert sched._decode_batch(32) == 32
     assert sched._decode_batch(33) == 64
     assert sched._decode_batch(64) == 64
+
+
+async def test_mid_decode_bucket_override_semantics():
+    """Explicit decode_batch_mid rounds DOWN to a real bucket strictly
+    between the small bucket and the pad; 0 disables the auto mid; out
+    of range values are ignored (never a no-op mid == pad or dead
+    mid <= small)."""
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    async def launch(**kw):
+        return await JaxEngine.launch(_engine_config(
+            max_batch_size=64, num_blocks=512, **kw
+        ))
+
+    if True:
+        e = await launch(decode_batch_mid=48)
+        try:
+            assert e.scheduler.decode_batch_mid == 32  # rounds DOWN
+        finally:
+            await e.shutdown()
+        e = await launch(decode_batch_mid=0)
+        try:
+            assert e.scheduler.decode_batch_mid is None  # 0 disables auto
+        finally:
+            await e.shutdown()
+        e = await launch(decode_batch_mid=2)
+        try:
+            assert e.scheduler.decode_batch_mid is None  # below small
+        finally:
+            await e.shutdown()
+        e = await launch()  # auto: pad 64 -> mid 32
+        try:
+            assert e.scheduler.decode_batch_mid == 32
+        finally:
+            await e.shutdown()
+
