@@ -101,8 +101,15 @@ def _param_bytes(mc, quant: str) -> int:
     return bytes_per * (per_layer * L + 2 * V * D)
 
 
-def _kv_bytes_per_token(mc) -> int:
-    return 2 * mc.num_hidden_layers * mc.num_key_value_heads * mc.head_dim * 2
+def _kv_bytes_per_token(mc) -> float:
+    dt = os.environ.get("DYN_BENCH_KV_DTYPE", "bfloat16")
+    if dt in ("fp8", "float8", "float8_e4m3fn", "float8_e5m2"):
+        per_elem = 1.0
+    elif dt == "int8":
+        per_elem = 1.0 + 4.0 / mc.head_dim  # + per-(slot, head) f32 scale
+    else:
+        per_elem = 2.0
+    return 2 * mc.num_hidden_layers * mc.num_key_value_heads * mc.head_dim * per_elem
 
 
 async def _run(model_cfg, wl) -> dict:
@@ -129,6 +136,7 @@ async def _run(model_cfg, wl) -> dict:
     cfg = EngineConfig(
         model_path="", model_name="bench", random_weights=True,
         quantization="int8" if wl["quant"] == "int8" else None,
+        kv_cache_dtype=os.environ.get("DYN_BENCH_KV_DTYPE", "bfloat16"),
         num_blocks=wl["num_blocks"], block_size=wl["block_size"],
         max_batch_size=wl["batch"],
         prefill_chunk_size=int(os.environ.get("DYN_BENCH_PREFILL_CHUNK", "1024")),
@@ -219,6 +227,7 @@ def main() -> None:
             "hidden": model_cfg.hidden_size,
             "vocab": model_cfg.vocab_size,
             "quant": wl["quant"],
+            "kv_dtype": os.environ.get("DYN_BENCH_KV_DTYPE", "bfloat16"),
             "batch": wl["batch"],
             "isl": wl["isl"],
             "osl": wl["osl"],

@@ -50,7 +50,7 @@ log = logging.getLogger("dynamo_tpu.disagg.transfer")
 DeliverFn = Callable[[list[int], np.ndarray], Awaitable[None]]
 
 # float dtypes the receiver will cast from (bounds itemsize too)
-_CASTABLE = {"bfloat16", "float16", "float32"}
+_CASTABLE = {"bfloat16", "float16", "float32", "float8_e4m3fn"}
 
 
 class _HeadAssembler:

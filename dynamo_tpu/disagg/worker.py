@@ -84,7 +84,7 @@ class DisaggDecodeEngine(AsyncEngine):
         assert engine.model_config is not None
         layout = BlockLayout.for_model(
             engine.model_config, engine.config.block_size,
-            engine.config.kv_cache_dtype,
+            engine.config.wire_kv_dtype(),
         )
         server = TransferServer(
             deliver=lambda hashes, packed: engine.import_kv_blocks(hashes, packed),

@@ -26,7 +26,32 @@ class EngineConfig:
     block_size: Optional[int] = None
     num_blocks: Optional[int] = None  # None = size by gpu_memory_utilization
     hbm_utilization: float = 0.9
+    # "bfloat16" or "float8_e4m3fn" (alias "fp8"): quantized fp8 KV
+    # halves cache bytes per token — doubles long-context residency and
+    # halves decode-attention HBM reads — at ~1/16 relative rounding
+    # per element (reference analogue: vLLM --kv-cache-dtype fp8 the
+    # reference passes through, lib/llm vLLM engine args). Scale-free
+    # E4M3 storage: the Pallas kernels upcast to bf16 at the VMEM edge
+    # (exact), so no per-page scale plumbing — an int8-with-scales
+    # variant needs a lane->sublane scale-tile relayout Mosaic's TPU
+    # lowering rejects ("unsupported shape cast"; benchmarks/RESULTS.md).
     kv_cache_dtype: str = "bfloat16"
+
+    def __post_init__(self) -> None:
+        aliases = {"fp8": "float8_e4m3fn", "float8": "float8_e4m3fn"}
+        self.kv_cache_dtype = aliases.get(
+            self.kv_cache_dtype, self.kv_cache_dtype
+        )
+
+    def wire_kv_dtype(self) -> str:
+        """Dtype of PACKED KV blocks (host tiers, disagg wire): an int8
+        device cache dequantizes at the block-copy boundary
+        (ops/block_copy.py), so everything off-device stays bfloat16;
+        float caches ship their own dtype."""
+        return (
+            "bfloat16" if self.kv_cache_dtype == "int8"
+            else self.kv_cache_dtype
+        )
     enable_prefix_caching: bool = True
     # KV offload tiers (G2 host / G3 disk; 0 = disabled)
     host_kv_blocks: int = 0

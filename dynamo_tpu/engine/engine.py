@@ -234,6 +234,30 @@ class JaxEngine:
         )
         self.eos_token_ids = self.model_config.eos_token_ids
 
+        if jnp.dtype(cfg.kv_cache_dtype) == jnp.int8:
+            # int8 KV limits (ops/kv_quant.py documents the layout):
+            # the in-kernel scale-tile reshape needs lane-multiple pages
+            # on real TPUs, and the pp cache layout has no scale plane
+            if self._pp > 1:
+                raise ValueError(
+                    "kv_cache_dtype=int8 is not supported with "
+                    "pipeline_parallel_size > 1 (use bfloat16 or fp8)"
+                )
+            if cfg.num_nodes > 1:
+                raise ValueError(
+                    "kv_cache_dtype=int8 is not yet supported with "
+                    "num_nodes > 1 (the mirrored gather/scatter paths "
+                    "move plain cache arrays); use bfloat16 or fp8"
+                )
+            if (
+                jax.default_backend() == "tpu"
+                and cfg.block_size % 128 != 0
+            ):
+                raise ValueError(
+                    f"kv_cache_dtype=int8 on TPU requires block_size to "
+                    f"be a multiple of 128 (got {cfg.block_size}); the "
+                    f"scale-tile reshape is lane-preserving only then"
+                )
         num_blocks = cfg.num_blocks or self._auto_num_blocks(devices)
         if cfg.num_nodes > 1:
             # every process must build identically-shaped caches; only
@@ -479,7 +503,7 @@ class JaxEngine:
                     remote_bucket=cfg.remote_kv_bucket,
                 ),
                 BlockLayout.for_model(
-                    self.model_config, cfg.block_size, cfg.kv_cache_dtype
+                    self.model_config, cfg.block_size, cfg.wire_kv_dtype()
                 ),
                 gather_fn=self._kv_gather,
                 scatter_fn=self._kv_scatter,
@@ -862,6 +886,13 @@ class JaxEngine:
             * dh_pad
             * itemsize
         )
+        if jnp.dtype(self.config.kv_cache_dtype) == jnp.int8:
+            # per-(slot, head) f32 scale planes ([L, N, Hk*bs] per K/V —
+            # layout already lane-compact, no tile padding to model)
+            bytes_per_block_total += (
+                2 * mc.num_hidden_layers * self.config.block_size
+                * mc.num_key_value_heads * 4
+            )
         free = None
         try:
             stats = devices[0].memory_stats()
@@ -1012,12 +1043,20 @@ class JaxEngine:
         ns_cache = NamedSharding(self.mesh, cache_sp)
         ns_rep2 = NamedSharding(self.mesh, PSpec(None, None))
         ns_rep1 = NamedSharding(self.mesh, PSpec(None))
+        from dynamo_tpu.models.llama import SCALE_SPEC
+
+        ns_scale = NamedSharding(self.mesh, SCALE_SPEC)
 
         def pin_caches(k, v):
-            return (
-                jax.lax.with_sharding_constraint(k, ns_cache),
-                jax.lax.with_sharding_constraint(v, ns_cache),
-            )
+            def pin(c):
+                if isinstance(c, tuple):  # int8 cache: (values, scales)
+                    return (
+                        jax.lax.with_sharding_constraint(c[0], ns_cache),
+                        jax.lax.with_sharding_constraint(c[1], ns_scale),
+                    )
+                return jax.lax.with_sharding_constraint(c, ns_cache)
+
+            return pin(k), pin(v)
 
         if self._pp > 1:
             from dynamo_tpu.parallel.pipeline import forward_pp
@@ -1442,7 +1481,8 @@ class JaxEngine:
 
         assert self.allocator is not None and self.model_config is not None
         layout = BlockLayout.for_model(
-            self.model_config, self.config.block_size, self.config.kv_cache_dtype
+            self.model_config, self.config.block_size,
+            self.config.wire_kv_dtype(),
         )
         multihost = self.config.num_nodes > 1
         plan: list[tuple[str, int]] = []  # (tier, device block | hash)

@@ -166,6 +166,15 @@ def cache_shape(
 
 
 CACHE_SPEC = P(None, None, "tp", None)
+# int8-cache scale arrays [L, N, Hk, bs]: tp shards the head axis
+SCALE_SPEC = P(None, None, "tp", None)
+
+
+def kv_cache_is_quantized(cache) -> bool:
+    """True when ``cache`` is an int8 (values, scales) pair rather than
+    a plain float array. The quantized cache threads through jit/scan/
+    donation as a pytree; only code that indexes into it branches."""
+    return isinstance(cache, tuple)
 
 
 def init_cache(
@@ -175,14 +184,31 @@ def init_cache(
     mesh: Optional[Mesh] = None,
     dtype=jnp.bfloat16,
     spec: Optional[P] = None,
-) -> tuple[jax.Array, jax.Array]:
+):
+    """Zeroed paged KV cache: (k_cache, v_cache). Float dtypes give
+    plain arrays (fp8 e4m3 = scale-free quantized storage); int8 gives
+    (values, scales) pairs with per-(slot, head) f32 scales
+    (ops/kv_quant.py documents the scale layout)."""
     shape = cache_shape(cfg, num_blocks, block_size)
     k = jnp.zeros(shape, dtype=dtype)
     v = jnp.zeros(shape, dtype=dtype)
     if mesh is not None:
         sh = NamedSharding(mesh, spec if spec is not None else CACHE_SPEC)
         k, v = jax.device_put(k, sh), jax.device_put(v, sh)
-    return k, v
+    if jnp.dtype(dtype) != jnp.int8:
+        return k, v
+    from dynamo_tpu.ops.kv_quant import kv_scale_shape
+
+    sshape = kv_scale_shape(
+        cfg.num_hidden_layers, num_blocks, block_size,
+        cfg.num_key_value_heads,
+    )
+    ks = jnp.ones(sshape, jnp.float32)
+    vs = jnp.ones(sshape, jnp.float32)
+    if mesh is not None:
+        ssh = NamedSharding(mesh, SCALE_SPEC)
+        ks, vs = jax.device_put(ks, ssh), jax.device_put(vs, ssh)
+    return (k, ks), (v, vs)
 
 
 # ---------------------------------------------------------------------------
@@ -280,6 +306,30 @@ def paged_attention_reference(
     TPU fast path with identical semantics. ``sliding_window`` masks keys
     older than the window (Mistral-family).
     """
+    if kv_cache_is_quantized(k_cache_l):
+        # int8 cache: dequantize each layer-slice pair in f32, then run
+        # the plain path (test oracle; the kernels scale in-register)
+        from dynamo_tpu.ops.kv_quant import gather_slot_scales
+
+        (kv_l, ks_l), (vv_l, vs_l) = k_cache_l, v_cache_l
+        Hk = kv_l.shape[-2]
+        B = q.shape[0]
+        S = block_tables.shape[1] * block_size
+        slot_ids = (
+            block_tables[:, :, None] * block_size
+            + jnp.arange(block_size, dtype=block_tables.dtype)[None, None, :]
+        ).reshape(B, S)
+        ksc = gather_slot_scales(ks_l, slot_ids, block_size, Hk)
+        vsc = gather_slot_scales(vs_l, slot_ids, block_size, Hk)
+        keys = (
+            kv_l[slot_ids].astype(jnp.float32) * ksc[..., None]
+        ).astype(q.dtype)
+        vals = (
+            vv_l[slot_ids].astype(jnp.float32) * vsc[..., None]
+        ).astype(q.dtype)
+        return _reference_attend(
+            q, keys, vals, positions, context_lens, sliding_window
+        )
     B, T, H, Dh = q.shape
     Hk = k_cache_l.shape[-2]
     S = block_tables.shape[1] * block_size
@@ -290,9 +340,31 @@ def paged_attention_reference(
     ).reshape(B, S)
     keys = k_cache_l[slot_ids]  # [B, S, Hk, Dh]
     vals = v_cache_l[slot_ids]
-    # GQA via grouped einsum — no [B, S, H, Dh] materialization of
-    # group-expanded keys/values (the repeat would multiply attention's
-    # HBM traffic by H/Hk)
+    if keys.dtype != q.dtype:
+        # quantized (fp8) cache: dequantize for the einsum (exact cast)
+        keys = keys.astype(q.dtype)
+        vals = vals.astype(q.dtype)
+    return _reference_attend(
+        q, keys, vals, positions, context_lens, sliding_window
+    )
+
+
+def _reference_attend(
+    q: jax.Array,  # [B, T, H, Dh]
+    keys: jax.Array,  # [B, S, Hk, Dh] gathered (and dequantized) pages
+    vals: jax.Array,
+    positions: jax.Array,
+    context_lens: jax.Array,
+    sliding_window: Optional[int],
+) -> jax.Array:
+    """Masked-attention tail of the XLA reference path.
+
+    GQA via grouped einsum — no [B, S, H, Dh] materialization of
+    group-expanded keys/values (the repeat would multiply attention's
+    HBM traffic by H/Hk)."""
+    B, T, H, Dh = q.shape
+    Hk = keys.shape[-2]
+    S = keys.shape[1]
     group = H // Hk
     qg = q.reshape(B, T, Hk, group, Dh)
     scale = 1.0 / math.sqrt(Dh)
@@ -410,37 +482,54 @@ def make_layer_parts(
         )
 
         k_cache, v_cache, layer_idx = stacked_args
-        kern = _ft.partial(
+        ksc = vsc = None
+        if kv_cache_is_quantized(k_cache):
+            (k_cache, ksc), (v_cache, vsc) = k_cache, v_cache
+        base = _ft.partial(
             paged_attention_decode_stacked,
             block_size=block_size,
             sliding_window=cfg.sliding_window,
             interpret=jax.default_backend() != "tpu",
         )
+        if ksc is None:
+            kern = base
+        else:
+            def kern(q_, kc_, vc_, li_, bt_, cl_, ks_, vs_):
+                return base(
+                    q_, kc_, vc_, li_, bt_, cl_, k_scale=ks_, v_scale=vs_
+                )
         mesh = _ATTN_MESH
         if mesh is not None and mesh.size > 1:
             # one kernel per tp shard: q heads and the cache's KV-head
             # axis (dim 2 of the stacked layout) are tp-sharded; layer
             # index, tables and ctx ride replicated. Other mesh axes
             # (dp/ep/sp) are unmapped (replicated through the kernel).
+            # int8 scale arrays shard on their hk-major minor dim —
+            # contiguous tp chunks are exactly each shard's heads
+            # (SCALE_SPEC).
+            in_specs = (
+                P(None, "tp", None),
+                P(None, None, "tp", None),
+                P(None, None, "tp", None),
+                P(),
+                P(None, None),
+                P(None),
+            )
+            if ksc is not None:
+                in_specs += (SCALE_SPEC, SCALE_SPEC)
             kern = jax.shard_map(
                 kern,
                 mesh=mesh,
-                in_specs=(
-                    P(None, "tp", None),
-                    P(None, None, "tp", None),
-                    P(None, None, "tp", None),
-                    P(),
-                    P(None, None),
-                    P(None),
-                ),
+                in_specs=in_specs,
                 out_specs=P(None, "tp", None),
                 axis_names={"tp"},
                 check_vma=False,
             )
-        return kern(
-            q[:, 0], k_cache, v_cache, layer_idx, block_tables,
-            context_lens,
-        )[:, None]  # [B, 1, H, Dh]
+        args = (q[:, 0], k_cache, v_cache, layer_idx, block_tables,
+                context_lens)
+        if ksc is not None:
+            args += (ksc, vsc)
+        return kern(*args)[:, None]  # [B, 1, H, Dh]
 
     def _pallas_prefill_attn(q, stacked_args):
         """Flash prefill over the paged cache (T > 1): tile×page grid,
@@ -456,34 +545,49 @@ def make_layer_parts(
         )
 
         k_cache, v_cache, layer_idx = stacked_args
-        kern = _ft.partial(
+        ksc = vsc = None
+        if kv_cache_is_quantized(k_cache):
+            (k_cache, ksc), (v_cache, vsc) = k_cache, v_cache
+        base = _ft.partial(
             paged_attention_prefill_stacked,
             block_size=block_size,
             sliding_window=cfg.sliding_window,
             interpret=jax.default_backend() != "tpu",
         )
+        if ksc is None:
+            kern = base
+        else:
+            def kern(q_, kc_, vc_, li_, bt_, st_, cl_, ks_, vs_):
+                return base(
+                    q_, kc_, vc_, li_, bt_, st_, cl_,
+                    k_scale=ks_, v_scale=vs_,
+                )
         mesh = _ATTN_MESH
         if mesh is not None and mesh.size > 1:
+            in_specs = (
+                P(None, None, "tp", None),
+                P(None, None, "tp", None),
+                P(None, None, "tp", None),
+                P(),
+                P(None, None),
+                P(None),
+                P(None),
+            )
+            if ksc is not None:
+                in_specs += (SCALE_SPEC, SCALE_SPEC)
             kern = jax.shard_map(
                 kern,
                 mesh=mesh,
-                in_specs=(
-                    P(None, None, "tp", None),
-                    P(None, None, "tp", None),
-                    P(None, None, "tp", None),
-                    P(),
-                    P(None, None),
-                    P(None),
-                    P(None),
-                ),
+                in_specs=in_specs,
                 out_specs=P(None, None, "tp", None),
                 axis_names={"tp"},
                 check_vma=False,
             )
-        return kern(
-            q, k_cache, v_cache, layer_idx, block_tables,
-            positions[:, 0], context_lens,
-        )  # [B, T, H, Dh]
+        args = (q, k_cache, v_cache, layer_idx, block_tables,
+                positions[:, 0], context_lens)
+        if ksc is not None:
+            args += (ksc, vsc)
+        return kern(*args)  # [B, T, H, Dh]
 
     def _post_attn(lp, x, attn):
         """Everything after attention: output projection + MLP/MoE
@@ -500,16 +604,23 @@ def make_layer_parts(
             x = x + mlp_out.astype(x.dtype)
         return x
 
+    def _expand1(cache_l):
+        """Per-layer cache -> 1-layer stack (free expand-dims), for
+        plain arrays and int8 (values, scales) pairs alike."""
+        if kv_cache_is_quantized(cache_l):
+            return (cache_l[0][None], cache_l[1][None])
+        return cache_l[None]
+
     def attend_mlp(lp, x, q, k_cache_l, v_cache_l):
         T = x.shape[1]
         if T == 1 and _use_pallas_decode():
             # per-layer cache: run as a 1-layer stack (free expand-dims)
             attn = _pallas_decode_attn(
-                q, (k_cache_l[None], v_cache_l[None], jnp.int32(0))
+                q, (_expand1(k_cache_l), _expand1(v_cache_l), jnp.int32(0))
             )
         elif _use_pallas_decode():
             attn = _pallas_prefill_attn(
-                q, (k_cache_l[None], v_cache_l[None], jnp.int32(0))
+                q, (_expand1(k_cache_l), _expand1(v_cache_l), jnp.int32(0))
             )
         else:
             attn = paged_attention_reference(
@@ -538,9 +649,17 @@ def make_layer_parts(
                 else _pallas_prefill_attn(q, (k_cache, v_cache, layer_idx))
             )
             return _post_attn(lp, x, attn)
-        kcl = jax.lax.dynamic_index_in_dim(k_cache, layer_idx, 0, keepdims=False)
-        vcl = jax.lax.dynamic_index_in_dim(v_cache, layer_idx, 0, keepdims=False)
-        return attend_mlp(lp, x, q, kcl, vcl)
+        def slice_layer(cache):
+            if kv_cache_is_quantized(cache):
+                return tuple(
+                    jax.lax.dynamic_index_in_dim(c, layer_idx, 0, keepdims=False)
+                    for c in cache
+                )
+            return jax.lax.dynamic_index_in_dim(
+                cache, layer_idx, 0, keepdims=False
+            )
+
+        return attend_mlp(lp, x, q, slice_layer(k_cache), slice_layer(v_cache))
 
     return qkv, attend_mlp, attend_mlp_stacked
 
@@ -566,10 +685,21 @@ def make_layer_fn(
     def layer_fn(x, scanned):
         B, T = x.shape[0], x.shape[1]
         lp, k_cache_l, v_cache_l = scanned
+        if kv_cache_is_quantized(k_cache_l):
+            raise NotImplementedError(
+                "int8 KV cache is not supported on the pipeline-parallel "
+                "path (per-layer xs/ys cache layout); use bfloat16 or "
+                "float8_e4m3fn with pipeline_parallel_size > 1"
+            )
         q, k, v = qkv(lp, x)
-        # write new kv into the paged cache (layer slice)
-        k_cache_l = k_cache_l.at[slot_mapping].set(k.reshape(B * T, Hk, Dh))
-        v_cache_l = v_cache_l.at[slot_mapping].set(v.reshape(B * T, Hk, Dh))
+        # write new kv into the paged cache (layer slice); astype is the
+        # quantization step for fp8 caches (RN convert), a no-op for bf16
+        k_cache_l = k_cache_l.at[slot_mapping].set(
+            k.reshape(B * T, Hk, Dh).astype(k_cache_l.dtype)
+        )
+        v_cache_l = v_cache_l.at[slot_mapping].set(
+            v.reshape(B * T, Hk, Dh).astype(v_cache_l.dtype)
+        )
         x = attend_mlp(lp, x, q, k_cache_l, v_cache_l)
         return x, (k_cache_l, v_cache_l)
 
@@ -634,12 +764,56 @@ def forward(
     )
     B, T = tokens.shape
 
+    quantized = kv_cache_is_quantized(k_cache)
+    if quantized:
+        from dynamo_tpu.ops.kv_quant import (
+            quantize_kv,
+            scale_scatter_indices,
+        )
+
+        n_idx, off_idx = scale_scatter_indices(slot_mapping, block_size, Hk)
+
+    def write_kv(cache, new, i):
+        """Scatter this layer's fresh K or V rows [B*T, Hk, Dh] into the
+        carried cache at ``slot_mapping`` — the int8 path quantizes
+        per (token, head) and scatters the scales alongside; the astype
+        is the fp8 quantization step (bf16 no-op).
+
+        Scale-write forms matter enormously here: only the CANONICAL
+        scatter (one indexed axis + suffix window — the values write's
+        form) updates the carried array in place. The decode path
+        (T=1) therefore read-modify-writes whole [Hk, bs] page tiles —
+        safe because decode rows own distinct tail pages (padded rows
+        all hit the garbage page 0, where racing writes are harmless).
+        The indexed-slice form (``.at[i, n, :, off]``) makes XLA
+        materialize + copy the full scale plane per layer at the
+        Pallas custom-call boundary (measured: +2 ms/step at a
+        500-block cache, scaling with cache size) — prefill keeps it
+        because a chunk writes many slots per page (tile RMW would
+        race) and its cost amortizes over the chunk's tokens."""
+        if not quantized:
+            return cache.at[i, slot_mapping].set(new.astype(cache.dtype))
+        q8, sc = quantize_kv(new)
+        vals, scales = cache
+        vals = vals.at[i, slot_mapping].set(q8)
+        if T == 1:
+            bs_ = scales.shape[-1]
+            page = scales[i, n_idx]  # [M, Hk, bs] gather
+            col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, bs_), 2)
+            page = jnp.where(
+                col == off_idx[:, None, None], sc[:, :, None], page
+            )
+            scales = scales.at[i, n_idx].set(page)
+        else:
+            scales = scales.at[i, n_idx, :, off_idx].set(sc)
+        return (vals, scales)
+
     def body(carry, inp):
         x, kc, vc = carry
         lp, i = inp
         q, k, v = qkv(lp, x)
-        kc = kc.at[i, slot_mapping].set(k.reshape(B * T, Hk, Dh))
-        vc = vc.at[i, slot_mapping].set(v.reshape(B * T, Hk, Dh))
+        kc = write_kv(kc, k.reshape(B * T, Hk, Dh), i)
+        vc = write_kv(vc, v.reshape(B * T, Hk, Dh), i)
         # attention reads the layer THROUGH the stacked cache (no layer
         # slice materialized — see attend_mlp_stacked)
         x = attend_mlp_stacked(lp, x, q, kc, vc, i)

@@ -32,6 +32,11 @@ def _bucket(n: int) -> int:
 
 @functools.partial(jax.jit, static_argnums=(3,))
 def _gather(k, v, ids, block_size):
+    """Plain-array caches (bf16/fp8); int8 (values, scales) caches are
+    handled by _gather's tuple-aware dispatch in gather_blocks via
+    _gather_quant — the PACKED host format is always a float array, so
+    tier contents and the disagg wire stay dtype-stable regardless of
+    the device cache's quantization."""
     L, S, H, D = k.shape
     N = S // block_size
     kr = k.reshape(L, N, block_size, H, D)
@@ -50,6 +55,47 @@ def _scatter(k, v, ids, packed, block_size):
     kr = k.reshape(L, N, block_size, H, D).at[:, ids].set(data[0])
     vr = v.reshape(L, N, block_size, H, D).at[:, ids].set(data[1])
     return kr.reshape(L, S, H, D), vr.reshape(L, S, H, D)
+
+
+@functools.partial(jax.jit, static_argnums=(5,))
+def _gather_quant(kv, ks, vv, vs, ids, block_size):
+    """int8 cache -> packed bf16 blocks: dequantize at the tier
+    boundary so host pools / the disagg wire keep one float layout
+    (requantizing on restore is idempotent: dequantized values are
+    exactly representable under their original scale)."""
+    from dynamo_tpu.ops.kv_quant import dequantize_kv
+
+    L, S, H, D = kv.shape
+    N = S // block_size
+
+    def deq(vals, scales):
+        vb = jnp.take(vals.reshape(L, N, block_size, H, D), ids, axis=1)
+        sb = jnp.take(scales, ids, axis=1)  # [L, n, H, bs]
+        return dequantize_kv(vb, sb.transpose(0, 1, 3, 2), jnp.bfloat16)
+
+    packed = jnp.stack([deq(kv, ks), deq(vv, vs)], axis=0)
+    return packed.transpose(2, 0, 1, 3, 4, 5)  # [n, 2, L, bs, H, D]
+
+
+@functools.partial(jax.jit, static_argnums=(6,), donate_argnums=(0, 1, 2, 3))
+def _scatter_quant(kv, ks, vv, vs, ids, packed, block_size):
+    """Packed float blocks -> int8 cache: requantize per (slot, head)
+    and scatter values + scales (inverse of _gather_quant)."""
+    from dynamo_tpu.ops.kv_quant import quantize_kv
+
+    L, S, H, D = kv.shape
+    N = S // block_size
+    data = packed.transpose(1, 2, 0, 3, 4, 5)  # [2, L, n, bs, H, D]
+
+    def enc(vals, scales, blocks):
+        q8, sc = quantize_kv(blocks)  # [L, n, bs, H, D] -> + [L, n, bs, H]
+        vals = vals.reshape(L, N, block_size, H, D).at[:, ids].set(q8)
+        scales = scales.at[:, ids].set(sc.transpose(0, 1, 3, 2))
+        return vals.reshape(L, S, H, D), scales
+
+    kv, ks = enc(kv, ks, data[0])
+    vv, vs = enc(vv, vs, data[1])
+    return kv, ks, vv, vs
 
 
 def pad_ids_to_bucket(block_ids) -> np.ndarray:
@@ -72,9 +118,14 @@ def pad_rows_to(n_ids: int, data: np.ndarray) -> np.ndarray:
 
 
 def gather_blocks(k, v, block_ids: list[int], block_size: int) -> np.ndarray:
-    """Device → host: returns packed [n, 2, L, bs, Hkv, Dh] ndarray."""
+    """Device → host: returns packed [n, 2, L, bs, Hkv, Dh] ndarray.
+    int8 (values, scales) caches dequantize to bf16 at this boundary."""
     n = len(block_ids)
-    packed = _gather(k, v, pad_ids_to_bucket(block_ids), block_size)
+    ids = pad_ids_to_bucket(block_ids)
+    if isinstance(k, tuple):
+        packed = _gather_quant(k[0], k[1], v[0], v[1], ids, block_size)
+    else:
+        packed = _gather(k, v, ids, block_size)
     return np.asarray(packed)[:n]
 
 
@@ -82,7 +133,13 @@ def scatter_blocks(k, v, block_ids: list[int], data: np.ndarray, block_size: int
     """Host → device: writes packed blocks, returns new (k, v).
 
     Inputs k/v are DONATED — callers must replace their references.
+    int8 (values, scales) caches requantize at this boundary.
     """
     ids = pad_ids_to_bucket(block_ids)
     data = pad_rows_to(len(ids), data)
+    if isinstance(k, tuple):
+        kv, ks, vv, vs = _scatter_quant(
+            k[0], k[1], v[0], v[1], ids, jnp.asarray(data), block_size
+        )
+        return (kv, ks), (vv, vs)
     return _scatter(k, v, ids, jnp.asarray(data), block_size)

@@ -48,21 +48,28 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+def _scale_rows(ks2: jax.Array, rows_per_hk: int) -> jax.Array:
+    """Expand a per-page scale tile [Hk, bs] to score-row layout
+    [Hk*rows_per_hk, bs] (rows are hk-major in both kernels). The tile
+    is loaded in this orientation directly from the [L, N, Hk, bs]
+    scale storage (ops/kv_quant.py explains why that layout is the one
+    Mosaic accepts), so the expansion is a broadcast + leading-dim
+    merge — the lane dim (bs) never moves."""
+    Hk, bs = ks2.shape
+    return jnp.broadcast_to(
+        ks2[:, None, :], (Hk, rows_per_hk, bs)
+    ).reshape(Hk * rows_per_hk, bs)
+
+
 def _decode_kernel_stacked(
     layer_ref,  # scalar prefetch: [1] int32 — layer to read
     tables_ref,  # scalar prefetch: [B, W] int32
     ctx_ref,  # scalar prefetch: [B] int32
-    q_ref,  # [1, H, Dh]
-    k_ref,  # [1, 1, bs, Hk, Dh] — page j of the sequence, layer layer_ref[0]
-    v_ref,
-    o_ref,  # [1, H, Dh]
-    acc_ref,
-    m_ref,
-    l_ref,
-    *,
+    *refs,  # q, k, v, [ks, vs,] o, acc, m, l — scales iff quantized
     block_size: int,
     scale: float,
     window: Optional[int],
+    quantized: bool,
 ):
     """THE flash-decode kernel body, over a stacked cache
     [L, N, bs, Hk, Dh] with the layer as a scalar-prefetch index (the
@@ -74,7 +81,21 @@ def _decode_kernel_stacked(
     size. Indexing here keeps per-step HBM traffic at just the
     referenced pages. GQA groups query heads over their shared KV head
     via unrolled per-KV-head matmuls (Mosaic has no batched dot_general
-    with differing batch positions; Hk is small and static)."""
+    with differing batch positions; Hk is small and static).
+
+    ``quantized``: int8 cache values with per-(slot, head) f32 scales
+    riding two extra page-tile refs [1, 1, Hk, bs]. K's scale applies to
+    the f32 SCORES per column (exact: int8 -> bf16 is lossless, so the
+    only rounding is the quantization itself); V's scale folds into the
+    probabilities before the PV dot (p is f32 at that point). int8 page
+    loads convert at essentially bf16-load speed on v5e (measured 8.7
+    vs 8.0 ms/call at ISL-3000 geometry) — unlike fp8, whose emulated
+    convert collapses the kernel to 29 GB/s effective (13.8 ms/call)."""
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -94,10 +115,17 @@ def _decode_kernel_stacked(
         bs, Hk = k_ref.shape[2], k_ref.shape[3]
         G = H // Hk
         # storage dtype straight into the MXU (bf16 operands, f32
-        # accumulation) — f32 upcasts double VMEM for nothing
+        # accumulation) — f32 upcasts double VMEM for nothing. A
+        # quantized fp8 cache (engine kv_cache_dtype=float8_e4m3fn)
+        # upcasts to the query dtype here: every e4m3 value is exactly
+        # representable in bf16, so the HBM read is byte-halved and the
+        # convert is free VPU work (the dot itself stays bf16×bf16).
         q = q_ref[0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
+        if k.dtype != q.dtype:
+            k = k.astype(q.dtype)
+            v = v.astype(q.dtype)
         qg = q.reshape(Hk, G, Dh)
         s = jnp.concatenate(
             [
@@ -109,6 +137,9 @@ def _decode_kernel_stacked(
             ],
             axis=0,
         ) * scale
+        if quantized:
+            # K dequant via per-column score scaling (f32, exact)
+            s = s * _scale_rows(ks_ref[0, 0], G)
         pos = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_size), 1
         )
@@ -120,6 +151,9 @@ def _decode_kernel_stacked(
         p = jnp.where(valid, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if quantized:
+            # V dequant folded into the probabilities while still f32
+            p = p * _scale_rows(vs_ref[0, 0], G)
         pg = p.astype(v.dtype).reshape(Hk, G, bs)
         pv = jnp.concatenate(
             [
@@ -154,6 +188,8 @@ def paged_attention_decode_stacked(
     block_size: int,
     sliding_window: Optional[int] = None,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [L, N, Hkv, bs] f32 (int8 cache)
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Decode attention over layer ``layer_idx`` of the stacked cache.
 
@@ -161,12 +197,18 @@ def paged_attention_decode_stacked(
     but WITHOUT materializing the layer slice (see
     _decode_kernel_stacked). This is the hot decode path the engine's
     layer scan uses: the cache stays a scan carry and only referenced
-    pages move."""
+    pages move.
+
+    ``k_scale``/``v_scale``: per-(slot, head) dequant scales for an
+    int8 cache, stored [L, N, Hk, bs] (layout rationale:
+    ops/kv_quant.py). The scale tile loads directly as [Hk, bs] — no
+    in-kernel reshape, so any page geometry lowers."""
     B, H, Dh = q.shape
     L, S, Hk, _ = k_cache.shape
     N = S // block_size
     W = block_tables.shape[1]
     scale = 1.0 / math.sqrt(Dh)
+    quantized = k_scale is not None
 
     # leading-dim split: layout-preserving (free) on TPU
     kp = k_cache.reshape(L, N, block_size, Hk, Dh)
@@ -181,14 +223,26 @@ def paged_attention_decode_stacked(
             jj = jnp.maximum(jj, first)
         return (lyr[0], t[b, jj], 0, 0, 0)
 
+    def scale_index(b, j, lyr, t, c):
+        return kv_index(b, j, lyr, t, c)[:2] + (0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, H, Dh), lambda b, j, lyr, t, c: (b, 0, 0)),
+        pl.BlockSpec((1, 1, block_size, Hk, Dh), kv_index),
+        pl.BlockSpec((1, 1, block_size, Hk, Dh), kv_index),
+    ]
+    inputs = [q, kp, vp]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1, Hk, block_size), scale_index),
+            pl.BlockSpec((1, 1, Hk, block_size), scale_index),
+        ]
+        inputs += [k_scale, v_scale]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,  # layer, block_tables, context_lens
         grid=(B, W),
-        in_specs=[
-            pl.BlockSpec((1, H, Dh), lambda b, j, lyr, t, c: (b, 0, 0)),
-            pl.BlockSpec((1, 1, block_size, Hk, Dh), kv_index),
-            pl.BlockSpec((1, 1, block_size, Hk, Dh), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, H, Dh), lambda b, j, lyr, t, c: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((H, Dh), jnp.float32),
@@ -199,12 +253,12 @@ def paged_attention_decode_stacked(
     return pl.pallas_call(
         functools.partial(
             _decode_kernel_stacked, block_size=block_size, scale=scale,
-            window=sliding_window,
+            window=sliding_window, quantized=quantized,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, Dh), q.dtype),
         interpret=interpret,
-    )(layer_arr, block_tables, context_lens, q, kp, vp)
+    )(layer_arr, block_tables, context_lens, *inputs)
 
 
 def _prefill_kernel_stacked(
@@ -212,18 +266,12 @@ def _prefill_kernel_stacked(
     starts_ref,  # scalar prefetch: [B] int32 — first query position per row
     tables_ref,  # scalar prefetch: [B, W] int32
     ctx_ref,     # scalar prefetch: [B] int32 (context incl. this chunk)
-    q_ref,   # [1, 1, Tq, H, Dh] — query tile qi of row b
-    k_ref,   # [1, 1, bs, Hk, Dh] — page j, layer layer_ref[0]
-    v_ref,
-    o_ref,   # [1, 1, Tq, H, Dh]
-    acc_ref,  # VMEM scratch [Hk*G*Tq, Dh] f32 (hk-major row order)
-    m_ref,    # VMEM scratch [Hk*G*Tq, 1] f32
-    l_ref,    # VMEM scratch [Hk*G*Tq, 1] f32
-    *,
+    *refs,  # q, k, v, [ks, vs,] o, acc, m, l — scales iff quantized
     block_size: int,
     tq: int,
     scale: float,
     window: Optional[int],
+    quantized: bool,
 ):
     """Flash prefill over the paged cache: one query TILE of ``tq``
     tokens vs one KV page per grid step, causal (+ sliding window)
@@ -233,6 +281,11 @@ def _prefill_kernel_stacked(
     full prefix without any [T, S] score materialization — the XLA
     reference path's [B, Hk, G, T, S] scores tensor is ~400 MB at
     T=1024/S=3072 and its HBM traffic dominates long-prompt TTFT."""
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     qi = pl.program_id(1)
     j = pl.program_id(2)
@@ -271,6 +324,11 @@ def _prefill_kernel_stacked(
         q = q_ref[0, 0]  # [Tq, H, Dh]
         k = k_ref[0, 0]  # [bs, Hk, Dh]
         v = v_ref[0, 0]
+        if k.dtype != q.dtype:
+            # quantized fp8 cache: upcast to the query/compute dtype
+            # (exact — e4m3 ⊂ bf16); HBM traffic stays 1 byte/elem
+            k = k.astype(q.dtype)
+            v = v.astype(q.dtype)
         # hk-major rows: [Hk, Tq*G, Dh] -> flat [Hk*Tq*G, Dh]
         qg = q.reshape(Tq, Hk, G, Dh).swapaxes(0, 1).reshape(Hk, Tq * G, Dh)
         s = jnp.concatenate(
@@ -283,6 +341,10 @@ def _prefill_kernel_stacked(
             ],
             axis=0,
         ) * scale  # [Hk*Tq*G, bs] f32
+        if quantized:
+            # int8 cache: K's per-(slot, head) scale applied to the f32
+            # scores per column (see _decode_kernel_stacked)
+            s = s * _scale_rows(ks_ref[0, 0], Tq * G)
         key_pos = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, bs), 1
         )  # [1, bs]
@@ -302,6 +364,9 @@ def _prefill_kernel_stacked(
         p = jnp.where(valid, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if quantized:
+            # V dequant folded into the probabilities while still f32
+            p = p * _scale_rows(vs_ref[0, 0], Tq * G)
         # p in the value dtype for the MXU (standard flash practice; the
         # softmax stats above stay f32)
         pg = p.astype(v.dtype).reshape(Hk, Tq * G, bs)
@@ -344,17 +409,22 @@ def paged_attention_prefill_stacked(
     block_size: int,
     sliding_window: Optional[int] = None,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [L, N, Hkv, bs] f32 (int8 cache)
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Flash prefill attention over the paged cache; returns
     [B, T, H, Dh]. Requires the chunk's K/V to already be scattered
     into the cache (models/llama.py writes before attending). Rows are
     contiguous token runs: q[b, t] sits at absolute position
-    start_pos[b] + t (padded rows: start 0 / ctx 0 -> all-masked)."""
+    start_pos[b] + t (padded rows: start 0 / ctx 0 -> all-masked).
+    ``k_scale``/``v_scale``: int8-cache dequant scales (layout and
+    constraints documented on paged_attention_decode_stacked)."""
     B, T, H, Dh = q.shape
     L, S, Hk, _ = k_cache.shape
     N = S // block_size
     W = block_tables.shape[1]
     scale = 1.0 / math.sqrt(Dh)
+    quantized = k_scale is not None
     # query tile: 128 keeps the kernel's VMEM state ~2 MB for the 8B
     # geometry at block_size=16; halve while the f32 working-set
     # ESTIMATE (acc + scores) exceeds 5 MB — measured actual usage runs
@@ -393,17 +463,29 @@ def paged_attention_prefill_stacked(
             jj = jnp.maximum(jj, first)
         return (lyr[0], t[b, jj], 0, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, tq, H, Dh),
+            lambda b, qi, j, lyr, st, t, c: (b, qi, 0, 0, 0),
+        ),
+        pl.BlockSpec((1, 1, block_size, Hk, Dh), kv_index),
+        pl.BlockSpec((1, 1, block_size, Hk, Dh), kv_index),
+    ]
+    inputs = [q5, kp, vp]
+    if quantized:
+        def scale_index(b, qi, j, lyr, st, t, c):
+            return kv_index(b, qi, j, lyr, st, t, c)[:2] + (0, 0)
+
+        in_specs += [
+            pl.BlockSpec((1, 1, Hk, block_size), scale_index),
+            pl.BlockSpec((1, 1, Hk, block_size), scale_index),
+        ]
+        inputs += [k_scale, v_scale]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,  # layer, starts, block_tables, context_lens
         grid=(B, n_tiles, W),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, tq, H, Dh),
-                lambda b, qi, j, lyr, st, t, c: (b, qi, 0, 0, 0),
-            ),
-            pl.BlockSpec((1, 1, block_size, Hk, Dh), kv_index),
-            pl.BlockSpec((1, 1, block_size, Hk, Dh), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, tq, H, Dh),
             lambda b, qi, j, lyr, st, t, c: (b, qi, 0, 0, 0),
@@ -417,12 +499,12 @@ def paged_attention_prefill_stacked(
     out = pl.pallas_call(
         functools.partial(
             _prefill_kernel_stacked, block_size=block_size, tq=tq,
-            scale=scale, window=sliding_window,
+            scale=scale, window=sliding_window, quantized=quantized,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, n_tiles, tq, H, Dh), q.dtype),
         interpret=interpret,
-    )(layer_arr, starts, block_tables, context_lens, q5, kp, vp)
+    )(layer_arr, starts, block_tables, context_lens, *inputs)
     return out.reshape(B, T, H, Dh)
 
 
@@ -438,6 +520,8 @@ def paged_attention_decode(
     block_size: int,
     sliding_window: Optional[int] = None,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [N, Hkv, bs] f32 (int8 cache)
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Returns [B, H, Dh] attention outputs.
 
@@ -449,4 +533,6 @@ def paged_attention_decode(
         q, k_cache_l[None], v_cache_l[None], jnp.int32(0), block_tables,
         context_lens, block_size=block_size, sliding_window=sliding_window,
         interpret=interpret,
+        k_scale=None if k_scale is None else k_scale[None],
+        v_scale=None if v_scale is None else v_scale[None],
     )

@@ -389,6 +389,8 @@ def local_head_rows(packed_full: np.ndarray, cache) -> np.ndarray:
     ``local_packed_rows``: unique H-extents of the process's
     addressable cache shards, concatenated in H order, so shard pools
     filled from imports line up with pools filled by mirror_gather."""
+    if isinstance(cache, tuple):  # int8 cache: shard geometry from values
+        cache = cache[0]
     starts = sorted({s.index[2].start or 0 for s in cache.addressable_shards})
     h_loc = cache.addressable_shards[0].data.shape[2]
     return np.concatenate(
@@ -604,7 +606,7 @@ class StepFollower:
 
                 layout = BlockLayout.for_model(
                     e.model_config, e.config.block_size,
-                    e.config.kv_cache_dtype,
+                    e.config.wire_kv_dtype(),
                 )
                 halves, packed = self._bcast((
                     np.zeros((2, b), np.uint32),

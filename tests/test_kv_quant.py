@@ -1,0 +1,421 @@
+"""Quantized (fp8 E4M3) KV cache: kernel parity and bounded logit error.
+
+The engine's ``kv_cache_dtype="float8_e4m3fn"`` halves KV bytes per
+token (doubling long-context residency and halving decode-attention HBM
+reads — reference analogue: the vLLM ``--kv-cache-dtype fp8`` option
+the reference's engine args pass through). Storage is scale-free E4M3;
+the Pallas kernels and the XLA reference path upcast to the compute
+dtype at the read edge (exact: every e4m3 value is representable in
+bf16). These tests pin down:
+
+- kernel ≡ reference on the SAME quantized contents (both dequantize
+  exactly, so they must agree to normal kernel tolerance), and
+- the end-to-end quantization error vs a bf16 cache is bounded at the
+  logit level (the e4m3 mantissa gives ~2^-4 per-element rounding that
+  averages out over the Dh/seq reductions).
+"""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import (
+    forward,
+    init_cache,
+    init_params,
+    paged_attention_reference,
+)
+from dynamo_tpu.ops.paged_attention import (
+    paged_attention_decode,
+    paged_attention_prefill_stacked,
+)
+
+F8 = jnp.float8_e4m3fn
+
+
+def _setup(B, H, Hk, Dh, num_blocks, bs, ctx_lens, seed=0):
+    rng = np.random.default_rng(seed)
+    q = rng.standard_normal((B, H, Dh)).astype(np.float32)
+    k = rng.standard_normal((num_blocks * bs, Hk, Dh)).astype(np.float32)
+    v = rng.standard_normal((num_blocks * bs, Hk, Dh)).astype(np.float32)
+    W = max((c + bs - 1) // bs for c in ctx_lens)
+    tables = np.zeros((B, W), np.int32)
+    next_page = 1
+    for b, c in enumerate(ctx_lens):
+        n = (c + bs - 1) // bs
+        tables[b, :n] = np.arange(next_page, next_page + n, dtype=np.int32)
+        next_page += n
+    ctx = np.asarray(ctx_lens, np.int32)
+    return (
+        jnp.asarray(q, jnp.bfloat16),
+        jnp.asarray(k, jnp.bfloat16),
+        jnp.asarray(v, jnp.bfloat16),
+        jnp.asarray(tables),
+        jnp.asarray(ctx),
+    )
+
+
+def test_decode_kernel_fp8_matches_reference_same_contents():
+    """Kernel vs XLA reference over one shared fp8 cache: both read the
+    identical quantized values, so outputs agree to kernel tolerance."""
+    Dh, bs, num_blocks = 128, 16, 16
+    B, H, Hk = 2, 4, 2
+    q, k, v, tables, ctx = _setup(B, H, Hk, Dh, num_blocks, bs, [23, 37])
+    k8, v8 = k.astype(F8), v.astype(F8)
+    out = paged_attention_decode(q, k8, v8, tables, ctx, bs, interpret=True)
+    assert out.dtype == q.dtype
+    ref = paged_attention_reference(
+        q[:, None], k8, v8, tables, (ctx - 1)[:, None], ctx, bs
+    )[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=1e-1, atol=1e-1,
+    )
+
+
+def test_decode_kernel_fp8_error_vs_bf16_bounded():
+    """Per-element e4m3 rounding (~6%) must average out over the Dh=128
+    and sequence reductions: attention outputs within a few % of bf16."""
+    Dh, bs, num_blocks = 128, 16, 16
+    B, H, Hk = 2, 8, 4
+    q, k, v, tables, ctx = _setup(B, H, Hk, Dh, num_blocks, bs, [40, 64])
+    out16 = paged_attention_decode(q, k, v, tables, ctx, bs, interpret=True)
+    out8 = paged_attention_decode(
+        q, k.astype(F8), v.astype(F8), tables, ctx, bs, interpret=True
+    )
+    a16 = np.asarray(out16, np.float32)
+    a8 = np.asarray(out8, np.float32)
+    # relative to the output scale, not elementwise (outputs near zero)
+    denom = max(1e-6, float(np.abs(a16).max()))
+    assert float(np.abs(a8 - a16).max()) / denom < 0.08
+
+
+def test_prefill_kernel_fp8_matches_reference():
+    """Flash prefill over an fp8 cache (chunk already scattered in, as
+    the model does) matches the reference path on the same contents."""
+    Dh, bs, num_blocks = 128, 16, 16
+    B, H, Hk, T = 2, 4, 2, 16
+    rng = np.random.default_rng(3)
+    ctx_lens = [16, 9]
+    q = jnp.asarray(
+        rng.standard_normal((B, T, H, Dh)), jnp.bfloat16
+    )
+    k = jnp.asarray(
+        rng.standard_normal((num_blocks * bs, Hk, Dh)), jnp.bfloat16
+    ).astype(F8)
+    v = jnp.asarray(
+        rng.standard_normal((num_blocks * bs, Hk, Dh)), jnp.bfloat16
+    ).astype(F8)
+    tables = np.zeros((B, 2), np.int32)
+    tables[0], tables[1] = [1, 2], [3, 4]
+    tables = jnp.asarray(tables)
+    ctx = jnp.asarray(ctx_lens, np.int32)
+    starts = jnp.zeros((B,), jnp.int32)
+    out = paged_attention_prefill_stacked(
+        q, k[None], v[None], jnp.int32(0), tables, starts, ctx, bs,
+        interpret=True,
+    )
+    positions = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+    ref = paged_attention_reference(
+        q, k, v, tables, positions, ctx, bs
+    )
+    # rows past ctx are padding — compare valid tokens only
+    for b, c in enumerate(ctx_lens):
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32)[b, :c],
+            np.asarray(ref, np.float32)[b, :c],
+            rtol=1e-1, atol=1e-1,
+        )
+
+
+def _tiny_cfg() -> ModelConfig:
+    return ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128,
+    )
+
+
+def test_forward_fp8_cache_bounded_logit_error():
+    """One full model step (prefill write + attend) with an fp8 cache:
+    logits within a bounded distance of the bf16-cache run — the
+    end-to-end 'bounded logit error' contract for quantized KV."""
+    cfg = _tiny_cfg()
+    params = init_params(cfg, seed=0)
+    bs, num_blocks = 8, 16
+    B, T = 2, 16
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(1, 255, (B, T)), jnp.int32
+    )
+    positions = jnp.broadcast_to(jnp.arange(T)[None], (B, T)).astype(jnp.int32)
+    tables = jnp.asarray([[1, 2, 0, 0], [3, 4, 0, 0]], jnp.int32)
+    slot = (
+        jnp.take_along_axis(
+            tables, (positions // bs), axis=1
+        ) * bs + positions % bs
+    ).reshape(-1)
+    ctx = jnp.asarray([T, T], jnp.int32)
+    last = jnp.asarray([T - 1, T - 1], jnp.int32)
+
+    outs = {}
+    for name, dtype in [("bf16", jnp.bfloat16), ("fp8", F8)]:
+        kc, vc = init_cache(cfg, num_blocks, bs, dtype=dtype)
+        logits, _, _ = forward(
+            cfg, params, kc, vc, tokens, positions, slot, tables, ctx,
+            last, bs,
+        )
+        outs[name] = np.asarray(logits, np.float32)
+    diff = np.abs(outs["fp8"] - outs["bf16"]).max()
+    scale = np.abs(outs["bf16"]).max()
+    assert diff / max(scale, 1e-6) < 0.1, (diff, scale)
+    # and the quantization must actually be lossy-but-close, not zeroed
+    assert np.abs(outs["fp8"]).max() > 0
+
+
+async def test_engine_fp8_kv_generates(monkeypatch):
+    """Engine e2e with kv_cache_dtype=fp8 (alias accepted): launches,
+    prefills through the paged cache, decodes valid tokens."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from tests.test_engine import MODEL_DIR, _generate
+
+    cfg = EngineConfig(
+        model_path=MODEL_DIR, model_name="tiny", random_weights=True,
+        num_blocks=32, block_size=8, max_batch_size=4,
+        prefill_chunk_size=32, max_model_len=128,
+        kv_cache_dtype="fp8",
+    )
+    assert cfg.kv_cache_dtype == "float8_e4m3fn"  # alias normalized
+    eng = await JaxEngine.launch(cfg)
+    try:
+        assert eng.k_cache.dtype == F8
+        toks, _ = await _generate(eng, list(range(1, 20)), max_tokens=8)
+        assert len(toks) == 8
+        assert all(0 <= t < 2048 for t in toks)  # tiny model vocab
+    finally:
+        await eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# int8 cache with per-(token, head) scales (ops/kv_quant.py)
+# ---------------------------------------------------------------------------
+
+
+def _quantize_layer(k):
+    """Float [S, Hk, Dh] -> (int8 [S, Hk, Dh], scales [N, Hk*bs]) in the
+    kernel's hk-major page layout, for bs inferred by the caller."""
+    from dynamo_tpu.ops.kv_quant import quantize_kv
+
+    q8, sc = quantize_kv(k)  # sc [S, Hk]
+    return q8, sc
+
+
+def _scales_to_layout(sc, bs):
+    S, Hk = sc.shape
+    N = S // bs
+    return sc.reshape(N, bs, Hk).transpose(0, 2, 1)  # [N, Hk, bs]
+
+
+def test_decode_kernel_int8_matches_dequant_reference():
+    """int8 kernel (scales applied in-register) vs the XLA reference on
+    the SAME quantized contents: K's scale lands on f32 scores and V's
+    on f32 probabilities, so agreement is at bf16-dot tolerance."""
+    Dh, bs, num_blocks = 128, 16, 16
+    B, H, Hk = 2, 8, 4
+    q, k, v, tables, ctx = _setup(B, H, Hk, Dh, num_blocks, bs, [23, 37])
+    k8, ksc = _quantize_layer(k)
+    v8, vsc = _quantize_layer(v)
+    out = paged_attention_decode(
+        q, k8, v8, tables, ctx, bs, interpret=True,
+        k_scale=jnp.asarray(_scales_to_layout(ksc, bs)),
+        v_scale=jnp.asarray(_scales_to_layout(vsc, bs)),
+    )
+    from dynamo_tpu.models.llama import paged_attention_reference
+
+    ref = paged_attention_reference(
+        q[:, None],
+        (k8, jnp.asarray(_scales_to_layout(ksc, bs))),
+        (v8, jnp.asarray(_scales_to_layout(vsc, bs))),
+        tables, (ctx - 1)[:, None], ctx, bs,
+    )[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=5e-2, atol=5e-2,
+    )
+
+
+def test_decode_kernel_int8_error_vs_bf16_bounded():
+    """Per-(token, head) int8 rounding (~0.4%/elem) must leave decode
+    attention outputs within ~2% of the bf16 cache."""
+    Dh, bs, num_blocks = 128, 16, 16
+    B, H, Hk = 2, 8, 4
+    q, k, v, tables, ctx = _setup(B, H, Hk, Dh, num_blocks, bs, [40, 64])
+    out16 = paged_attention_decode(q, k, v, tables, ctx, bs, interpret=True)
+    k8, ksc = _quantize_layer(k)
+    v8, vsc = _quantize_layer(v)
+    out8 = paged_attention_decode(
+        q, k8, v8, tables, ctx, bs, interpret=True,
+        k_scale=jnp.asarray(_scales_to_layout(ksc, bs)),
+        v_scale=jnp.asarray(_scales_to_layout(vsc, bs)),
+    )
+    a16 = np.asarray(out16, np.float32)
+    a8 = np.asarray(out8, np.float32)
+    denom = max(1e-6, float(np.abs(a16).max()))
+    assert float(np.abs(a8 - a16).max()) / denom < 0.02
+
+
+def test_prefill_kernel_int8_matches_reference():
+    Dh, bs, num_blocks = 128, 16, 16
+    B, H, Hk, T = 2, 4, 2, 16
+    rng = np.random.default_rng(3)
+    ctx_lens = [16, 9]
+    q = jnp.asarray(rng.standard_normal((B, T, H, Dh)), jnp.bfloat16)
+    k = jnp.asarray(
+        rng.standard_normal((num_blocks * bs, Hk, Dh)), jnp.bfloat16
+    )
+    v = jnp.asarray(
+        rng.standard_normal((num_blocks * bs, Hk, Dh)), jnp.bfloat16
+    )
+    k8, ksc = _quantize_layer(k)
+    v8, vsc = _quantize_layer(v)
+    ks_l = jnp.asarray(_scales_to_layout(ksc, bs))
+    vs_l = jnp.asarray(_scales_to_layout(vsc, bs))
+    tables = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    ctx = jnp.asarray(ctx_lens, np.int32)
+    starts = jnp.zeros((B,), jnp.int32)
+    out = paged_attention_prefill_stacked(
+        q, k8[None], v8[None], jnp.int32(0), tables, starts, ctx, bs,
+        interpret=True, k_scale=ks_l[None], v_scale=vs_l[None],
+    )
+    from dynamo_tpu.models.llama import paged_attention_reference
+
+    positions = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+    ref = paged_attention_reference(
+        q, (k8, ks_l), (v8, vs_l), tables, positions, ctx, bs
+    )
+    for b, c in enumerate(ctx_lens):
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32)[b, :c],
+            np.asarray(ref, np.float32)[b, :c],
+            rtol=5e-2, atol=5e-2,
+        )
+
+
+def test_forward_int8_cache_bounded_logit_error():
+    """Full model step with the int8 (values, scales) cache: logits
+    within ~3% of the bf16 run — int8-per-token beats fp8's e4m3
+    rounding by an order of magnitude."""
+    cfg = _tiny_cfg()
+    params = init_params(cfg, seed=0)
+    bs, num_blocks = 8, 16
+    B, T = 2, 16
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(1, 255, (B, T)), jnp.int32
+    )
+    positions = jnp.broadcast_to(jnp.arange(T)[None], (B, T)).astype(jnp.int32)
+    tables = jnp.asarray([[1, 2, 0, 0], [3, 4, 0, 0]], jnp.int32)
+    slot = (
+        jnp.take_along_axis(tables, (positions // bs), axis=1) * bs
+        + positions % bs
+    ).reshape(-1)
+    ctx = jnp.asarray([T, T], jnp.int32)
+    last = jnp.asarray([T - 1, T - 1], jnp.int32)
+
+    outs = {}
+    for name, dtype in [("bf16", jnp.bfloat16), ("int8", jnp.int8)]:
+        kc, vc = init_cache(cfg, num_blocks, bs, dtype=dtype)
+        logits, kc2, vc2 = forward(
+            cfg, params, kc, vc, tokens, positions, slot, tables, ctx,
+            last, bs,
+        )
+        outs[name] = np.asarray(logits, np.float32)
+        if name == "int8":
+            # the carried cache stays a (values, scales) pair
+            assert isinstance(kc2, tuple) and kc2[0].dtype == jnp.int8
+    diff = np.abs(outs["int8"] - outs["bf16"]).max()
+    scale = np.abs(outs["bf16"]).max()
+    assert diff / max(scale, 1e-6) < 0.03, (diff, scale)
+
+
+def test_int8_block_gather_scatter_roundtrip():
+    """Tier boundary: int8 cache -> packed bf16 blocks -> scatter back.
+    The bf16 wire rounds dequantized values to 8 mantissa bits, so a
+    round-trip reproduces values within ±1 int8 step and scales within
+    bf16 precision — the same error order as quantization itself."""
+    from dynamo_tpu.ops.block_copy import gather_blocks, scatter_blocks
+    from dynamo_tpu.ops.kv_quant import kv_scale_shape, quantize_kv
+
+    L, bs, num_blocks, Hk, Dh = 3, 16, 8, 2, 128
+    rng = np.random.default_rng(0)
+    raw = rng.standard_normal((L, num_blocks * bs, Hk, Dh)).astype(np.float32)
+    q8, sc = quantize_kv(jnp.asarray(raw))
+    sc_l = jnp.asarray(
+        np.asarray(sc).reshape(L, num_blocks, bs, Hk).transpose(0, 1, 3, 2)
+    )
+    k = (q8, sc_l)
+    v = (jnp.array(q8), jnp.array(sc_l))  # distinct buffers: scatter donates
+    packed = gather_blocks(k, v, [2, 5], bs)
+    assert packed.dtype == np.asarray(jnp.zeros(1, jnp.bfloat16)).dtype
+    assert packed.shape == (2, 2, L, bs, Hk, Dh)
+    # wipe the two blocks, scatter the packed copy back
+    sc_np = np.asarray(sc_l)  # snapshot: scatter DONATES its cache args
+    kz = (q8.at[:, 2 * bs:3 * bs].set(0), jnp.array(sc_l))
+    (k2, ks2), _ = scatter_blocks(kz, v, [2, 5], packed, bs)
+    dv = (
+        np.asarray(k2[:, 2 * bs:3 * bs], np.int32)
+        - np.asarray(q8[:, 2 * bs:3 * bs], np.int32)
+    )
+    assert np.abs(dv).max() <= 1, np.abs(dv).max()
+    np.testing.assert_allclose(np.asarray(ks2), sc_np, rtol=1e-2)
+
+
+async def test_engine_int8_kv_generates():
+    """Engine e2e with kv_cache_dtype=int8 on the CPU reference path."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from tests.test_engine import MODEL_DIR, _generate
+
+    cfg = EngineConfig(
+        model_path=MODEL_DIR, model_name="tiny", random_weights=True,
+        num_blocks=32, block_size=8, max_batch_size=4,
+        prefill_chunk_size=32, max_model_len=128,
+        kv_cache_dtype="int8",
+    )
+    eng = await JaxEngine.launch(cfg)
+    try:
+        assert isinstance(eng.k_cache, tuple)
+        toks, _ = await _generate(eng, list(range(1, 20)), max_tokens=8)
+        assert len(toks) == 8
+        assert all(0 <= t < 2048 for t in toks)
+    finally:
+        await eng.shutdown()
+
+
+async def test_engine_int8_kv_matches_bf16_greedy():
+    """Greedy decode tokens under the int8 cache match the bf16 cache on
+    the tiny model (quantization noise far below the logit gaps)."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from tests.test_engine import MODEL_DIR, _generate
+
+    base = dict(
+        model_path=MODEL_DIR, model_name="tiny", random_weights=True,
+        num_blocks=32, block_size=8, max_batch_size=4,
+        prefill_chunk_size=32, max_model_len=128,
+    )
+    prompt = list(range(1, 20))
+    eng = await JaxEngine.launch(EngineConfig(**base))
+    try:
+        ref_toks, _ = await _generate(eng, prompt, max_tokens=6)
+    finally:
+        await eng.shutdown()
+    eng = await JaxEngine.launch(EngineConfig(**base, kv_cache_dtype="int8"))
+    try:
+        q_toks, _ = await _generate(eng, prompt, max_tokens=6)
+    finally:
+        await eng.shutdown()
+    assert q_toks == ref_toks
