@@ -191,11 +191,20 @@ class OpenAIPreprocessor(Operator):
                 if item.top_logprobs and k < len(item.top_logprobs)
                 else None
             )
-            tops.append(
-                {self._token_str(a): lp for a, lp in t.items()}
-                if t
-                else None
-            )
+            if t:
+                # the legacy schema keys alternatives by token STRING —
+                # distinct token ids can decode to the same text (e.g.
+                # multibyte fragments); keep the max logprob per string
+                # so a collision never shadows the likelier (often the
+                # chosen) entry
+                d: dict[str, float] = {}
+                for a, lp in t.items():
+                    s = self._token_str(a)
+                    if s not in d or lp > d[s]:
+                        d[s] = lp
+                tops.append(d)
+            else:
+                tops.append(None)
         payload = {
             "tokens": toks,
             "token_logprobs": list(item.log_probs),
@@ -247,6 +256,11 @@ class OpenAIPreprocessor(Operator):
                     item.text or "", index=idx, logprobs=lp_payload
                 )
             if item.finish_reason is not None:
+                if state.kind == "chat" and idx not in gen._started:
+                    # a choice whose every token detokenized to "" never
+                    # got a content delta — OpenAI streams still carry
+                    # the assistant role delta for EVERY choice
+                    yield gen.role_chunk(index=idx)
                 yield gen.finish_chunk(item.finish_reason, index=idx)
                 finished.add(idx)
                 total_completion += (
