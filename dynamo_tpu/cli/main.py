@@ -251,6 +251,14 @@ def build_parser() -> argparse.ArgumentParser:
                                "(local) or real cluster Deployments "
                                "(kubectl scale)")
     operator.add_argument("--k8s-namespace", default="default")
+    operator.add_argument("--watch-k8s", action="store_true",
+                          help="in-cluster mode: watch "
+                          "DynamoGraphDeployment CRs via the k8s API "
+                          "(kubectl) as the source of desired state and "
+                          "write reconcile status back to each CR")
+    operator.add_argument("--kubectl", default="kubectl",
+                          help="kubectl binary for --backend=kubectl / "
+                          "--watch-k8s")
     operator.add_argument("--state-dir", default=None,
                           help="persist applied specs here (survive "
                                "coordinator restarts)")
@@ -467,10 +475,19 @@ async def _build_local_pipeline(args: Any):
 
 
 async def _connect_remote(
-    args: Any, path: str, wait_timeout: float = 30.0, alive=None
+    args: Any, path: str, wait_timeout: Optional[float] = None, alive=None
 ):
     """Build the local pre/post pipeline around remote worker(s) at
-    ``path``, behind a push router honoring --router-mode. ``alive``
+    ``path``, behind a push router honoring --router-mode.
+
+    ``wait_timeout`` None = DYN_DISCOVERY_TIMEOUT (default 300 s). The
+    wait itself is event-driven (a store-prefix watch sets an
+    asyncio.Event — runtime/component.py), so a generous budget costs
+    nothing when workers are fast; the budget exists only to fail a
+    fleet whose workers never come up. 30 s proved too tight for a
+    worker that must JIT-compile its model while a loaded machine
+    contends for cores (the r3/r4 full-suite discovery flakes — each
+    passed isolated, timed out under load). ``alive``
     (optional) is polled while waiting for the first instance and may
     raise to abort early (the subproc adapter passes a child-process
     liveness check)."""
@@ -479,6 +496,8 @@ async def _connect_remote(
     from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
     from dynamo_tpu.runtime.runtime import DistributedRuntime
 
+    if wait_timeout is None:
+        wait_timeout = float(os.environ.get("DYN_DISCOVERY_TIMEOUT", "300"))
     ns, comp, ep = parse_dyn_path(path)
     cfg = _runtime_config(args)
     drt = await DistributedRuntime.create(config=cfg)
@@ -828,7 +847,7 @@ async def _run_sp_prefill_worker(args: Any, ns: str) -> None:
     )
     prefiller = LongContextPrefiller(
         mc, params, mesh, block_size=ecfg.resolve_block_size(),
-        attn=args.sp_attn, kv_dtype=ecfg.kv_cache_dtype,
+        attn=args.sp_attn, kv_dtype=ecfg.wire_kv_dtype(),
     )
     drt = await DistributedRuntime.create(config=_runtime_config(args))
     drt.runtime.install_signal_handlers()
@@ -1325,7 +1344,8 @@ async def cmd_operator(args: Any) -> None:
         from dynamo_tpu.deploy.operator import KubectlConnector
 
         factory = lambda spec: KubectlConnector(  # noqa: E731
-            spec.name, k8s_namespace=args.k8s_namespace
+            spec.name, k8s_namespace=args.k8s_namespace,
+            kubectl=getattr(args, "kubectl", "kubectl"),
         )
     rec = Reconciler(drt.store, args.namespace, interval_s=args.interval,
                      connector_factory=factory,
@@ -1340,6 +1360,17 @@ async def cmd_operator(args: Any) -> None:
         api = ApiStore(rec, port=args.api_port)
         await api.start()
         print(f"api-store on :{api.port}", flush=True)
+    cr_task = None
+    if getattr(args, "watch_k8s", False):
+        from dynamo_tpu.deploy.operator import CrWatcher
+
+        cr = CrWatcher(
+            rec, k8s_namespace=args.k8s_namespace,
+            kubectl=getattr(args, "kubectl", "kubectl"),
+        )
+        rec.on_results = cr.write_status
+        print("watching DynamoGraphDeployment CRs (in-cluster mode)",
+              flush=True)
     print("operator reconciling", flush=True)
     shutdown = asyncio.Event()
 
@@ -1348,8 +1379,12 @@ async def cmd_operator(args: Any) -> None:
         shutdown.set()
 
     watcher = asyncio.create_task(_watch())
+    if getattr(args, "watch_k8s", False):
+        cr_task = asyncio.create_task(cr.run(shutdown))
     await rec.run(shutdown)
     watcher.cancel()
+    if cr_task is not None:
+        cr_task.cancel()
     if api is not None:
         await api.stop()
     await drt.shutdown()

@@ -82,6 +82,258 @@ class KubectlConnector:
         return rc == 0
 
 
+def split_json_stream(buf: str) -> tuple[list[str], str]:
+    """Split a concatenation of top-level JSON objects (kubectl's
+    ``--watch -o json`` output) into complete documents + the
+    unfinished tail. Brace counting with string/escape awareness —
+    no framing assumptions about pretty-printing or newlines."""
+    docs: list[str] = []
+    depth = 0
+    in_str = False
+    esc = False
+    start = None
+    consumed = 0
+    for i, ch in enumerate(buf):
+        if esc:
+            esc = False
+            continue
+        if in_str:
+            if ch == "\\":
+                esc = True
+            elif ch == '"':
+                in_str = False
+            continue
+        if ch == '"':
+            in_str = True
+        elif ch == "{":
+            if depth == 0:
+                start = i
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0 and start is not None:
+                docs.append(buf[start : i + 1])
+                consumed = i + 1
+                start = None
+    return docs, buf[consumed:]
+
+
+class CrWatcher:
+    """In-cluster desired-state source: watches DynamoGraphDeployment
+    CRs through the Kubernetes API and mirrors them into the
+    reconciler's store, then writes ``.status`` back after each
+    reconcile pass.
+
+    This is the piece that makes ``kubectl apply`` of the rendered CRDs
+    (deploy/manifests.py) actually drive the operator, matching the
+    reference controller's contract (reference:
+    deploy/cloud/operator/internal/controller/
+    dynamographdeployment_controller.go — watch CRs, reconcile, update
+    CR status). The API surface is ``kubectl get --watch-only
+    --output-watch-events -o json`` (a stream of
+    {"type": ADDED|MODIFIED|DELETED, "object": {...}} docs) plus
+    ``kubectl patch --subresource=status`` — the same kubectl-CLI
+    transport the KubectlConnector uses, so one binary dependency
+    covers both directions."""
+
+    def __init__(self, reconciler: "Reconciler", k8s_namespace: str = "default",
+                 kubectl: str = "kubectl", resync_s: float = 30.0):
+        self.rec = reconciler
+        self.k8s_namespace = k8s_namespace
+        self.kubectl = kubectl
+        self.resync_s = resync_s
+        self._known: set[str] = set()
+        self._last_status: dict[str, str] = {}
+
+    async def _run(self, *argv: str) -> tuple[int, str]:
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                self.kubectl, "-n", self.k8s_namespace, *argv,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.STDOUT,
+            )
+        except OSError as exc:
+            # kubectl missing / transient fork failure: degrade, never
+            # kill the watcher task
+            return 127, f"spawn {self.kubectl}: {exc}"
+        out, _ = await proc.communicate()
+        return proc.returncode or 0, out.decode(errors="replace")
+
+    def _plural(self) -> str:
+        from dynamo_tpu.deploy.manifests import PLURAL
+
+        return PLURAL
+
+    def _to_spec(self, obj: dict) -> GraphDeploymentSpec:
+        """CR JSON -> spec. The CR's metadata.namespace is the KUBE
+        namespace; the reconciler's logical namespace is authoritative
+        for store keys (one operator instance serves one of each)."""
+        spec = GraphDeploymentSpec.from_dict(obj)
+        spec.namespace = self.rec.namespace
+        return spec
+
+    async def sync_once(self) -> int:
+        """Full resync: make the store's deployment set exactly mirror
+        the cluster's CR set. Returns the number of CRs seen."""
+        import json
+
+        rc, out = await self._run("get", self._plural(), "-o", "json")
+        if rc != 0:
+            log.warning("kubectl get CRs failed: %s", out.strip()[:500])
+            return -1
+        try:
+            items = json.loads(out).get("items", [])
+        except json.JSONDecodeError:
+            log.warning("kubectl get CRs: bad JSON")
+            return -1
+        want: dict[str, GraphDeploymentSpec] = {}
+        for item in items:
+            try:
+                spec = self._to_spec(item)
+                spec.validate()
+                want[spec.name] = spec
+            except Exception as exc:
+                log.warning("skipping bad CR: %s", exc)
+        current = {
+            s.name: s.to_bytes() for s in await self.rec.list_deployments()
+        }
+        for spec in want.values():
+            await self._apply_if_changed(spec, current)
+        # in-cluster mode makes the CR set THE source of desired state
+        # (reference semantics): store deployments without a backing CR
+        # are removed, including ones applied through other paths and
+        # CRs deleted while the watcher was down
+        for existing in current:
+            if existing not in want:
+                await self.rec.delete(existing)
+        self._known = set(want)
+        return len(want)
+
+    async def _apply_if_changed(
+        self,
+        spec: GraphDeploymentSpec,
+        current: Optional[dict[str, bytes]] = None,
+    ) -> None:
+        """apply() only when the stored spec differs: a no-op re-put
+        would fire the reconciler's prefix-watch wake and a kubectl
+        status-patch per deployment on every resync of an idle
+        cluster. ``current`` (name -> stored bytes) lets sync_once pay
+        one prefix scan for the whole batch."""
+        if current is None:
+            current = {
+                s.name: s.to_bytes()
+                for s in await self.rec.list_deployments()
+            }
+        if current.get(spec.name) == spec.to_bytes():
+            return
+        await self.rec.apply(spec)
+
+    async def _consume_event(self, doc: str) -> None:
+        import json
+
+        try:
+            ev = json.loads(doc)
+        except json.JSONDecodeError:
+            return
+        obj = ev.get("object") or {}
+        etype = ev.get("type")
+        if etype == "DELETED":
+            # delete needs only the name — a CR that went invalid before
+            # deletion must still leave desired state
+            name = (obj.get("metadata") or {}).get("name")
+            if name:
+                await self.rec.delete(name)
+                self._known.discard(name)
+            return
+        try:
+            spec = self._to_spec(obj)
+            spec.validate()
+        except Exception as exc:
+            log.warning("ignoring bad CR event: %s", exc)
+            return
+        await self._apply_if_changed(spec)
+        self._known.add(spec.name)
+
+    async def run(self, shutdown: Optional[asyncio.Event] = None) -> None:
+        """Resync, then hold a watch open; events mirror into the store
+        (whose prefix-watch wakes the reconciler immediately). A dying
+        watch process degrades to resync-by-poll at ``resync_s``."""
+        shutdown = shutdown or asyncio.Event()
+        while not shutdown.is_set():
+            proc = None
+            try:
+                await self.sync_once()
+                proc = await asyncio.create_subprocess_exec(
+                    self.kubectl, "-n", self.k8s_namespace, "get",
+                    self._plural(), "--watch-only",
+                    "--output-watch-events=true", "-o", "json",
+                    stdout=asyncio.subprocess.PIPE,
+                    stderr=asyncio.subprocess.DEVNULL,
+                )
+                assert proc.stdout is not None
+                tail = ""
+                while not shutdown.is_set():
+                    try:
+                        chunk = await asyncio.wait_for(
+                            proc.stdout.read(65536), timeout=self.resync_s
+                        )
+                    except asyncio.TimeoutError:
+                        # quiet stream: resync to catch silent drops but
+                        # KEEP the healthy watch process open
+                        await self.sync_once()
+                        continue
+                    if not chunk:
+                        break  # watch closed; outer loop resyncs
+                    docs, tail = split_json_stream(tail + chunk.decode())
+                    for doc in docs:
+                        await self._consume_event(doc)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("CR watch failed; retrying")
+            finally:
+                if proc is not None and proc.returncode is None:
+                    try:
+                        proc.terminate()
+                        await proc.wait()
+                    except ProcessLookupError:
+                        pass
+            await asyncio.sleep(min(5.0, self.resync_s))
+
+    async def write_status(self, results: list[ReconcileResult]) -> None:
+        """Patch each CR's status subresource with the pass outcome
+        (reference controller parity: CR .status reflects reconcile
+        state)."""
+        import json
+
+        for r in results:
+            state = (
+                "failed" if r.errors
+                else ("successful" if r.converged else "pending")
+            )
+            body = json.dumps({
+                "status": {
+                    "state": state,
+                    "lastActions": r.actions[-8:],
+                    "errors": r.errors[:8],
+                }
+            })
+            if self._last_status.get(r.deployment) == body:
+                # converged clusters reconcile every interval_s: don't
+                # spawn a no-op kubectl patch per deployment per pass
+                continue
+            self._last_status[r.deployment] = body
+            rc, out = await self._run(
+                "patch", f"{self._plural()}/{r.deployment}",
+                "--subresource=status", "--type=merge", "-p", body,
+            )
+            if rc != 0:
+                log.warning(
+                    "status patch for %s failed: %s",
+                    r.deployment, out.strip()[:300],
+                )
+
+
 class Reconciler:
     """One reconciler per namespace; drives every deployment under it.
 
@@ -110,6 +362,9 @@ class Reconciler:
         # deletes through any path — CLI, REST, raw store — propagate
         # and can't resurrect)
         self.state_dir = state_dir
+        # optional post-pass hook (CrWatcher.write_status in in-cluster
+        # mode: CR .status mirrors each pass's outcome)
+        self.on_results = None
         self._task: Optional[asyncio.Task] = None
 
     # -- desired/actual ----------------------------------------------------
@@ -297,7 +552,9 @@ class Reconciler:
                 # the next pass instead of being lost until the resync
                 wake.clear()
                 try:
-                    await self.reconcile_once()
+                    results = await self.reconcile_once()
+                    if self.on_results is not None:
+                        await self.on_results(results)
                 except Exception:
                     log.exception("reconcile pass failed")
                 stop_t = asyncio.create_task(shutdown.wait())

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Optional
 
@@ -261,9 +262,20 @@ class Client:
     def instance_ids(self) -> list[int]:
         return sorted(self.instances)
 
-    async def wait_for_instances(self, timeout_s: float = 30.0) -> list[int]:
+    async def wait_for_instances(
+        self, timeout_s: Optional[float] = None
+    ) -> list[int]:
         """Block until at least one instance is live
-        (reference: client.wait_for_endpoints)."""
+        (reference: client.wait_for_endpoints).
+
+        The wait is event-driven (the store-prefix watch sets
+        ``_instances_event``), so the budget is pure failure detection:
+        None = DYN_DISCOVERY_TIMEOUT env (default 300 s) — wide enough
+        that a worker JIT-compiling its model on a loaded machine isn't
+        declared dead (the r3/r4 full-suite flakes were exactly this:
+        30 s budgets expiring while a healthy worker compiled)."""
+        if timeout_s is None:
+            timeout_s = float(os.environ.get("DYN_DISCOVERY_TIMEOUT", "300"))
         await asyncio.wait_for(self._instances_event.wait(), timeout_s)
         return self.instance_ids()
 

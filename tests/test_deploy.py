@@ -443,3 +443,102 @@ async def test_reconciler_watch_triggers_immediate_reconcile():
         await asyncio.wait_for(task, 10)
         await sup.stop()
         await store.close()
+
+
+def test_split_json_stream_framing():
+    """kubectl --watch emits concatenated pretty-printed JSON docs; the
+    splitter must frame them without newline assumptions and keep
+    braces inside strings out of the count."""
+    from dynamo_tpu.deploy.operator import split_json_stream
+
+    a = json.dumps({"type": "ADDED", "object": {"x": "br{ace\"}"}}, indent=2)
+    b = json.dumps({"type": "DELETED", "object": {"y": 1}})
+    docs, tail = split_json_stream(a + "\n" + b + '{"partial"')
+    assert [json.loads(d)["type"] for d in docs] == ["ADDED", "DELETED"]
+    assert tail == '{"partial"'
+    docs2, tail2 = split_json_stream(tail + ': 1}')
+    assert json.loads(docs2[0]) == {"partial": 1} and tail2 == ""
+
+
+def _cr_json(name: str, replicas: int) -> dict:
+    return {
+        "apiVersion": "dynamo-tpu.dev/v1alpha1",
+        "kind": "DynamoGraphDeployment",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"services": {"backend": {"replicas": replicas,
+                                          "resources": {"tpu": 1}}}},
+    }
+
+
+async def test_cr_watcher_kubectl_drives_reconcile(tmp_path):
+    """envtest-style in-cluster flow through a FAKE kubectl: an applied
+    CR (kubectl get) lands in the store, the reconciler converges
+    replicas to the CR's spec, the status patch goes back through
+    kubectl --subresource=status, and watch events (MODIFIED/DELETED)
+    mutate desired state."""
+    import os
+    import stat
+
+    from dynamo_tpu.deploy.operator import CrWatcher
+
+    cr_list = {"apiVersion": "v1", "kind": "List",
+               "items": [_cr_json("web", 3)]}
+    patch_log = tmp_path / "patches.log"
+    fake = tmp_path / "kubectl"
+    fake.write_text(
+        "#!/bin/sh\n"
+        "case \"$*\" in\n"
+        "  *patch*) echo \"$@\" >> %s; exit 0 ;;\n"
+        "  *'-o json'*) cat %s ;;\n"
+        "esac\n" % (patch_log, tmp_path / "crs.json")
+    )
+    (tmp_path / "crs.json").write_text(json.dumps(cr_list))
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+
+    store = MemoryStore()
+
+    class FakeK8s:
+        def __init__(self):
+            self.replicas_map = {"backend": 0}
+
+        async def replicas(self, component):
+            return self.replicas_map.get(component)
+
+        async def set_replicas(self, component, n):
+            self.replicas_map[component] = n
+            return True
+
+    conn = FakeK8s()
+    rec = Reconciler(store, "dynamo", connector_factory=lambda spec: conn)
+    watcher = CrWatcher(rec, kubectl=str(fake))
+    # 1) kubectl apply'd CR -> store -> reconcile converges replicas
+    assert await watcher.sync_once() == 1
+    results = await rec.reconcile_once()
+    assert conn.replicas_map == {"backend": 3}
+    assert results[0].converged
+    # 2) status written back to the CR through the status subresource
+    await watcher.write_status(results)
+    logged = patch_log.read_text()
+    assert "--subresource=status" in logged
+    assert "dynamographdeployments/web" in logged
+    assert '\\"state\\": \\"successful\\"' in logged or '"state": "successful"' in logged
+    # 3) a MODIFIED watch event re-scales
+    await watcher._consume_event(json.dumps(
+        {"type": "MODIFIED", "object": _cr_json("web", 5)}
+    ))
+    await rec.reconcile_once()
+    assert conn.replicas_map == {"backend": 5}
+    # 4) DELETED removes the deployment from desired state
+    await watcher._consume_event(json.dumps(
+        {"type": "DELETED", "object": _cr_json("web", 5)}
+    ))
+    assert await rec.list_deployments() == []
+    # 5) a store spec with no backing CR is removed on full resync
+    await rec.apply(GraphDeploymentSpec(
+        name="orphan", namespace="dynamo",
+        services={"backend": ServiceSpec(replicas=1)},
+    ))
+    await watcher.sync_once()
+    names = [s.name for s in await rec.list_deployments()]
+    assert names == ["web"]
+    await store.close()
