@@ -1277,7 +1277,19 @@ class JaxEngine:
         self._chain_fn = jax.jit(chain_tokens) if K > 1 else None
         self._chain_pure_fn = jax.jit(chain_tokens_pure) if K > 1 else None
 
-    def _run_device_step(self, arrays: dict[str, np.ndarray], sampling: SamplingBatch):
+    def _run_device_step(
+        self,
+        arrays: dict[str, np.ndarray],
+        sampling: SamplingBatch,
+        sync: bool = True,
+    ):
+        """``sync=False`` skips the device->host read of the sampled
+        outputs (returns None): a prefill batch with NO last chunks has
+        no token anyone needs, and over a tunneled chip each host read
+        is a full round trip (~200 ms measured) — a 3-chunk ISL-3000
+        prompt pays it twice for nothing. The dispatch still happens
+        (and still broadcasts under multihost); donated caches chain
+        the next step regardless."""
         assert self._step_fn is not None
         base_args = (
             self.params,
@@ -1293,11 +1305,11 @@ class JaxEngine:
         )
         if self._mh_broadcast is not None:
             if "extra_embeds" in arrays:
-                raise RuntimeError(
-                    "multimodal embedding injection is not supported with "
-                    "num_nodes>1"
-                )
-            self._mh_broadcast.announce_step(arrays, sampling)
+                # embed rectangle broadcasts as its own control kind so
+                # followers enter the mm-variant step with real embeds
+                self._mh_broadcast.announce_step_mm(arrays, sampling)
+            else:
+                self._mh_broadcast.announce_step(arrays, sampling)
         if "extra_embeds" in arrays:
             out = self._step_fn_mm(
                 *base_args, arrays["extra_embeds"], arrays["embeds_mask"]
@@ -1305,6 +1317,8 @@ class JaxEngine:
         else:
             out = self._step_fn(*base_args)
         self.k_cache, self.v_cache = out[-2], out[-1]
+        if not sync:
+            return None
         from dynamo_tpu.parallel.multihost import host_value
 
         # (next_tokens, logprobs) base; (+ top_ids, top_lps) on the
@@ -1658,13 +1672,20 @@ class JaxEngine:
             return
 
         t0 = time.monotonic()
-        s_out = self._run_device_step(arrays, sampling)
-        next_tokens, logprobs = s_out[0], s_out[1]
-        tops = s_out[2:] if len(s_out) > 2 else None
+        need_sync = plan.kind != "prefill" or any(
+            w.is_last_chunk for w in plan.prefill_batch
+        )
+        s_out = self._run_device_step(arrays, sampling, sync=need_sync)
+        if s_out is not None:
+            next_tokens, logprobs = s_out[0], s_out[1]
+            tops = s_out[2:] if len(s_out) > 2 else None
+        else:
+            next_tokens = logprobs = tops = None
         self._trace(
             "dispatch_" + plan.kind,
             shape=arrays["tokens"].shape,
             ms=round((time.monotonic() - t0) * 1e3, 1),
+            sync=need_sync,
         )
 
         def top_row(i):
@@ -1927,9 +1948,12 @@ class JaxEngine:
         are admitted straight into the next rectangle; sequences
         finishing inside in-flight windows simply aren't rows of later
         ones. Per-sequence ``lag`` (sampled-but-unapplied tokens across
-        all in-flight windows) drives positions/budgets. Any
+        all in-flight windows) drives positions/budgets. Multihost
+        leaders pipeline too: chained windows send a KIND_CHAIN
+        pre-announcement so followers derive the token column from
+        their own device outputs (parallel/multihost.py). Any
         irregularity (stop-token finishes, cancellations, multimodal,
-        penalties, multihost, control-plane calls, shutdown) flushes
+        penalties, control-plane calls, shutdown) flushes
         the pipeline: in-flight windows are synced in order, surviving
         sequences keep their tokens, finished ones discard theirs
         (their blocks stay allocated until the flush, so no reuse races
@@ -1942,7 +1966,10 @@ class JaxEngine:
 
         from dynamo_tpu.parallel.multihost import host_value
 
-        pipelining = self._mh_broadcast is None
+        # multihost included: pipelined windows broadcast a KIND_CHAIN
+        # pre-announcement so followers derive the token column from
+        # their own retained device outputs (parallel/multihost.py)
+        pipelining = True
         lag: dict[int, int] = {}
 
         def penalties_in(ws: list, ss: list) -> bool:
@@ -2004,8 +2031,10 @@ class JaxEngine:
                 sampling = self._batch_sampling(
                     [w.seq for w in works], p_arrays["tokens"].shape[0]
                 )
-                s_out = self._run_device_step(p_arrays, sampling)
-                next_tokens, logprobs = s_out[0], s_out[1]
+                s_out = self._run_device_step(
+                    p_arrays, sampling,
+                    sync=any(w.is_last_chunk for w in works),
+                )
                 for i, work in enumerate(works):
                     sched.complete_prefill_chunk(work)
                     if work.is_last_chunk:
@@ -2015,7 +2044,7 @@ class JaxEngine:
                             else None
                         )
                         self._emit_token(
-                            work.seq, int(next_tokens[i]), float(logprobs[i]),
+                            work.seq, int(s_out[0][i]), float(s_out[1][i]),
                             top=top,
                         )
                 return
@@ -2085,6 +2114,13 @@ class JaxEngine:
                 p2 = sched.build_prefill_batch_arrays(nxt["works2"])
                 if "extra_embeds" in p2:
                     return False  # multimodal never rides the pipeline
+            if self._mh_broadcast is not None:
+                # multihost pipelining: followers chain the SAME token
+                # column from their own retained device outputs — the
+                # next announce's host token values are placeholders
+                self._mh_broadcast.announce_chain(
+                    nxt["src_idx"], newest["kind"] == "mixed"
+                )
             if newest["kind"] == "mixed":
                 chained = self._chain_fn(
                     newest["last"], newest["p_next"], nxt["src_idx"]

@@ -107,11 +107,17 @@ class KvPushRouter(AsyncEngine):
             request.annotations = list(request.annotations) + [
                 f"kv_hit_rate:{decision.prefix_hit_rate:.3f}"
             ]
-        stream = await self.router.client.generate_direct(
-            decision.worker_id, request, context
-        )
-        async for item in stream:
-            yield item
+        # schedule() charged this decision as optimistic in-flight load;
+        # release it early when the stream finishes (expiry otherwise
+        # clears it on the worker's next metrics publish)
+        try:
+            stream = await self.router.client.generate_direct(
+                decision.worker_id, request, context
+            )
+            async for item in stream:
+                yield item
+        finally:
+            self.router.scheduler.note_done(decision.worker_id)
 
     def generate(self, request: Any, context: Context) -> EngineStream:
         return self._gen(request, context)

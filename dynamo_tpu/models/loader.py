@@ -130,14 +130,6 @@ def resolve_model(
                 or (knob != "0" and mesh is not None
                     and jax.process_count() > 1)
             )
-            if sharded and model_config.is_moe:
-                # expert stacks aren't shard-loadable yet; the stacked
-                # loader must keep working for multi-process MoE
-                log.warning(
-                    "sharded weight load not implemented for MoE expert "
-                    "stacks; falling back to the stacked loader"
-                )
-                sharded = False
             if sharded and mesh is not None:
                 params = load_params_sharded(
                     model_config, model_path, mesh, specs, quantize=quantize
@@ -413,9 +405,25 @@ def load_params_sharded(
 
     def add_plain(name: str, tmpl: str, transpose: bool) -> None:
         shape, dtype = shapes[name]
+        E = cfg.num_local_experts
 
         def cb(index):
-            if "{i}" in tmpl:  # stacked per-layer tensor: dim 0 = layer
+            if "{e}" in tmpl:
+                # expert stack [L, E, in, out]: dims 0/1 = layer/expert;
+                # each (layer, expert) is its own checkpoint tensor, so
+                # the ep×tp shard reads only its expert slices' slices
+                l_sl, e_sl = index[0], index[1]
+                rest = tuple(index[2:])
+                out = np.stack([
+                    np.stack([
+                        read_slice(
+                            tmpl.format(i=i, e=e), transpose, rest
+                        )
+                        for e in range(*e_sl.indices(E))
+                    ])
+                    for i in range(*l_sl.indices(L))
+                ])
+            elif "{i}" in tmpl:  # stacked per-layer tensor: dim 0 = layer
                 l_sl = index[0]
                 rest = tuple(index[1:])
                 layers = range(*l_sl.indices(L))
@@ -471,33 +479,62 @@ def load_params_sharded(
                 s_shape, s_sh, lambda idx: s[idx]
             )
             return
-        # stacked per-layer: quantize layer-by-layer, append each local
-        # shard's slice as we go (dim 0 of both q and s is the layer)
+        # stacked per-layer (and per-expert): quantize tensor-by-tensor,
+        # append each local shard's slice as we go. Expert stacks
+        # [L, E, ...] iterate (layer, expert) pairs layer-major; the
+        # local parts list reshapes back to its [l_local, e_local, ...]
+        # block. Host transient stays ONE unstacked tensor's f32.
+        E = cfg.num_local_experts
+        experts = "{e}" in tmpl
         q_map = q_sh.addressable_devices_indices_map(shape)
         s_map = s_sh.addressable_devices_indices_map(s_shape)
         q_parts: dict = {d: [] for d in q_map}
         s_parts: dict = {d: [] for d in s_map}
-        for i in range(L):
-            full = quant.np_to_f32(ckpt.get(tmpl.format(i=i)))
+        pairs = (
+            [(i, e) for i in range(L) for e in range(E)]
+            if experts else [(i, None) for i in range(L)]
+        )
+        for i, e in pairs:
+            raw = ckpt.get(
+                tmpl.format(i=i, e=e) if experts else tmpl.format(i=i)
+            )
+            full = quant.np_to_f32(raw)
             if transpose:
                 full = full.T
             q, s = quant.quantize_array(full, axis)
             del full
+            lead = 2 if experts else 1
+
+            def want(idx) -> bool:
+                if i not in range(*idx[0].indices(L)):
+                    return False
+                return not experts or e in range(*idx[1].indices(E))
+
             for d, idx in q_map.items():
-                if i in range(*idx[0].indices(L)):
-                    q_parts[d].append(q[tuple(idx[1:])])
+                if want(idx):
+                    q_parts[d].append(q[tuple(idx[lead:])])
             for d, idx in s_map.items():
-                if i in range(*idx[0].indices(L)):
-                    s_parts[d].append(s[tuple(idx[1:])])
-        params[name] = jax.make_array_from_single_device_arrays(
-            shape, q_sh,
-            [jax.device_put(np.stack(q_parts[d]), d) for d in q_map],
-        )
-        params[name + quant.SCALE_SUFFIX] = (
-            jax.make_array_from_single_device_arrays(
-                s_shape, s_sh,
-                [jax.device_put(np.stack(s_parts[d]), d) for d in s_map],
+                if want(idx):
+                    s_parts[d].append(s[tuple(idx[lead:])])
+
+        def assemble(parts_map, index_map, full_shape, sharding):
+            arrays = []
+            for d, idx in index_map.items():
+                stacked = np.stack(parts_map[d])
+                if experts:
+                    n_l = len(range(*idx[0].indices(L)))
+                    n_e = len(range(*idx[1].indices(E)))
+                    stacked = stacked.reshape(
+                        n_l, n_e, *stacked.shape[1:]
+                    )
+                arrays.append(jax.device_put(stacked, d))
+            return jax.make_array_from_single_device_arrays(
+                full_shape, sharding, arrays
             )
+
+        params[name] = assemble(q_parts, q_map, shape, q_sh)
+        params[name + quant.SCALE_SUFFIX] = assemble(
+            s_parts, s_map, s_shape, s_sh
         )
 
     def quantizing(name: str) -> bool:
@@ -533,11 +570,6 @@ def load_params_sharded(
     for name, (tmpl, transpose) in layer_map.items():
         if name not in shapes:
             continue
-        if "{e}" in tmpl:
-            raise NotImplementedError(
-                "sharded loading of MoE expert stacks is not implemented; "
-                "use the stacked loader (load_params)"
-            )
         if quantizing(name):
             add_quantized(name, tmpl, transpose)
         else:

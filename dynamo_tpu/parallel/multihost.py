@@ -63,6 +63,8 @@ KIND_KV_DISABLE = 5  # leader-side offload failure: drop shard pools
 KIND_MIXED = 6  # mixed prefill-rectangle + K-step decode window
 KIND_KV_EXPORT = 7  # mirrored replicated gather (disagg KV export)
 KIND_KV_IMPORT = 8  # broadcast full blocks; each process pools its shard
+KIND_STEP_MM = 9  # single step + multimodal embed rectangle (VLM)
+KIND_CHAIN = 10  # next window's token column = device-chained outputs
 
 
 class FatalMultihostError(RuntimeError):
@@ -97,6 +99,37 @@ class StepBroadcaster:
         w = arrays["block_tables"].shape[1]
         self._ctrl(KIND_STEP, b, t, w, sampling.arrays)
         self._bcast(_step_tuple(arrays, sampling))
+
+    def announce_step_mm(self, arrays: dict, sampling) -> None:
+        """Multimodal prefill step: the embed rectangle [B, T, D] f32 +
+        its bool mask ride the broadcast after the step arrays (D is
+        model hidden_size — both sides derive it, so the control word
+        stays unchanged). Reference analogue: the multimodal examples'
+        encode-worker -> LLM embedding handoff running multinode
+        (examples/multimodal/)."""
+        b, t = arrays["tokens"].shape
+        w = arrays["block_tables"].shape[1]
+        self._ctrl(KIND_STEP_MM, b, t, w, sampling.arrays)
+        self._bcast(
+            _step_tuple(arrays, sampling)
+            + (
+                np.asarray(arrays["extra_embeds"], np.float32),
+                # bool over the wire as uint8: broadcast dtype safety
+                np.asarray(arrays["embeds_mask"], np.uint8),
+            )
+        )
+
+    def announce_chain(self, src_idx: np.ndarray, prev_mixed: bool) -> None:
+        """Pipelined window: the NEXT multi-step/mixed announce's token
+        column must come from each process's OWN retained device
+        outputs (chain_tokens over the previous window's last-token
+        column / prefill graduations) — the host token values in that
+        announce are placeholders. This is what lifts the decode
+        pipeline's single-host limit: followers never need the leader's
+        host token values, they compute the identical chain from the
+        identical device state."""
+        self._ctrl(KIND_CHAIN, len(src_idx), int(prev_mixed))
+        self._bcast((np.asarray(src_idx, np.int32),))
 
     def announce_multi_step(self, arrays: dict, sampling) -> None:
         b = arrays["tokens"].shape[0]
@@ -584,6 +617,11 @@ class StepFollower:
         pool: Optional[ShardKvPool] = None
         if e.config.host_kv_blocks > 0:
             pool = ShardKvPool(e.config.host_kv_blocks)
+        # device-resident outputs of the previous window, retained for
+        # pipelined chaining (KIND_CHAIN)
+        prev_last = None
+        prev_pnext = None
+        chained = None
         while True:
             ctrl = np.asarray(self._bcast(np.zeros((CTRL_LEN,), np.int32)))
             kind, b, t, w, flags, nb, ng, nr = (int(x) for x in ctrl[:8])
@@ -639,25 +677,54 @@ class StepFollower:
                         e.config.block_size, e.mesh,
                     )
                 continue
-            if kind == KIND_STEP:
-                args = self._bcast(_zeros_step(b, t, w, flags, nb, ng, nr))
+            if kind in (KIND_STEP, KIND_STEP_MM):
+                zeros = _zeros_step(b, t, w, flags, nb, ng, nr)
+                if kind == KIND_STEP_MM:
+                    D = e.model_config.hidden_size
+                    zeros = zeros + (
+                        np.zeros((b, t, D), np.float32),
+                        np.zeros((b, t), np.uint8),
+                    )
+                args = self._bcast(zeros)
+                mm_args = ()
+                if kind == KIND_STEP_MM:
+                    embeds, mask = args[-2], args[-1]
+                    args = args[:-2]
+                    mm_args = (
+                        np.asarray(embeds),
+                        np.asarray(mask).astype(bool),
+                    )
                 tokens, positions, slots, tables, ctx, last = args[:6]
                 s = _sampling_dict(args[6:], flags)
                 out = e._step_fn(
                     e.params, e.k_cache, e.v_cache, tokens, positions,
-                    slots, tables, ctx, last, s,
+                    slots, tables, ctx, last, s, *mm_args,
                 )
                 e.k_cache, e.v_cache = out[-2], out[-1]
+            elif kind == KIND_CHAIN:
+                (src,) = self._bcast((np.zeros((b,), np.int32),))
+                prev_mixed = bool(t)
+                assert prev_last is not None, "chain without a prior window"
+                if prev_mixed:
+                    assert prev_pnext is not None
+                    chained = e._chain_fn(
+                        prev_last, prev_pnext, np.asarray(src)
+                    )
+                else:
+                    chained = e._chain_pure_fn(prev_last, np.asarray(src))
             elif kind == KIND_MULTI_STEP:
                 args = self._bcast(
                     _zeros_multi_step(b, w, flags, nb, ng, nr)
                 )
                 tokens, positions, tables, ctx, valid = args[:5]
+                if chained is not None:
+                    tokens, chained = chained, None
                 s = _sampling_dict(args[5:], flags)
-                _, _, e.k_cache, e.v_cache = e._multi_step_fn(
+                _, prev_last, e.k_cache, e.v_cache = e._multi_step_fn(
                     e.params, e.k_cache, e.v_cache, tokens, positions,
                     tables, ctx, valid, s,
                 )
+                prev_pnext = None
             elif kind == KIND_MIXED:
                 p, t_rect, p_flags, p_nb, p_ng, p_nr = (
                     int(x) for x in ctrl[8:14]
@@ -669,9 +736,14 @@ class StepFollower:
                 p_args, d_args = args[:np_], args[np_:]
                 p_s = _sampling_dict(p_args[6:], p_flags)
                 d_s = _sampling_dict(d_args[5:], flags)
-                _, _, _, e.k_cache, e.v_cache = e._mixed_step_fn(
-                    e.params, e.k_cache, e.v_cache,
-                    *p_args[:6], p_s, *d_args[:5], d_s,
+                d_list = list(d_args[:5])
+                if chained is not None:
+                    d_list[0], chained = chained, None
+                _, prev_last, prev_pnext, e.k_cache, e.v_cache = (
+                    e._mixed_step_fn(
+                        e.params, e.k_cache, e.v_cache,
+                        *p_args[:6], p_s, *d_list, d_s,
+                    )
                 )
             else:
                 raise RuntimeError(f"unknown multihost step kind {kind}")

@@ -85,6 +85,30 @@ async def main(rank: int, coord: str) -> None:
             # ...and the import side: land them back in the sharded G2
             # pools (every process keeps its slice, lockstep preserved)
             imported = await engine.import_kv_blocks(exp_hashes, packed)
+            # multimodal under multihost: an embed-injection prefill
+            # broadcasts as its own control kind (KIND_STEP_MM) so the
+            # follower enters the mm step variant with real embeds
+            import numpy as np
+
+            from dynamo_tpu.multimodal.embeds import pack_segments
+
+            mm_req = PreprocessedRequest(
+                request_id="mh-mm",
+                token_ids=list(range(1, 18)),
+                sampling=SamplingOptions(use_greedy=True),
+                stop=StopConditions(max_tokens=3, ignore_eos=True),
+                mm_embeds=pack_segments(
+                    [(4, np.full((6, 32), 0.1, np.float32))]
+                ),
+            )
+            mm_toks = []
+            async for out in engine.as_async_engine().generate(
+                mm_req, Context()
+            ):
+                mm_toks.extend(out.token_ids)
+            mm_ok = len(mm_toks) == 3 and all(
+                0 <= t < 128 for t in mm_toks
+            )
             # churn evicts A from the device pool (13 usable blocks)
             for i, base in enumerate((40, 80)):
                 await gen(f"churn{i}", list(range(base, base + 33)))
@@ -96,6 +120,7 @@ async def main(rank: int, coord: str) -> None:
                 "offloaded": offloaded,
                 "export_ok": export_ok,
                 "imported": imported,
+                "mm_ok": mm_ok,
             }), flush=True)
         else:
             # follower: the engine thread runs the mirror loop; wait for

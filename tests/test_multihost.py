@@ -56,6 +56,9 @@ def test_two_process_engine_serves_request():
         # lockstep shard pools (engine.{_export,_import}_blocks)
         assert result["export_ok"], result
         assert result["imported"] >= 4, result
+        # multimodal embed-injection prefill over the step broadcast
+        # (KIND_STEP_MM): the follower mirrored the mm step variant
+        assert result["mm_ok"], result
     finally:
         for p in procs:
             if p.poll() is None:

@@ -69,3 +69,73 @@ def test_sharded_load_serves_through_engine(tmp_path):
         os.environ.pop("DYN_SHARDED_LOAD", None)
     assert mc.hidden_size == cfg.hidden_size
     assert params["wq"].sharding.spec == param_specs(mc)["wq"]
+
+
+def _write_moe_checkpoint(cfg, path, seed=0):
+    """Mixtral-layout safetensors checkpoint (per-expert w1/w2/w3)."""
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(seed)
+    D, F, V = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    H, Hk, Dh, L = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                    cfg.head_dim, cfg.num_hidden_layers)
+    E = cfg.num_local_experts
+    t = {}
+    t["model.embed_tokens.weight"] = rng.standard_normal((V, D)).astype(np.float32)
+    t["model.norm.weight"] = np.ones((D,), np.float32)
+    t["lm_head.weight"] = rng.standard_normal((V, D)).astype(np.float32)
+    for i in range(L):
+        p = f"model.layers.{i}"
+        t[f"{p}.input_layernorm.weight"] = np.ones((D,), np.float32)
+        t[f"{p}.post_attention_layernorm.weight"] = np.ones((D,), np.float32)
+        for nm, shape in [("q_proj", (H * Dh, D)), ("k_proj", (Hk * Dh, D)),
+                          ("v_proj", (Hk * Dh, D)), ("o_proj", (D, H * Dh))]:
+            t[f"{p}.self_attn.{nm}.weight"] = (
+                rng.standard_normal(shape).astype(np.float32) * 0.1
+            )
+        t[f"{p}.block_sparse_moe.gate.weight"] = (
+            rng.standard_normal((E, D)).astype(np.float32) * 0.1
+        )
+        for e in range(E):
+            q = f"{p}.block_sparse_moe.experts.{e}"
+            t[f"{q}.w1.weight"] = rng.standard_normal((F, D)).astype(np.float32) * 0.1
+            t[f"{q}.w2.weight"] = rng.standard_normal((D, F)).astype(np.float32) * 0.1
+            t[f"{q}.w3.weight"] = rng.standard_normal((F, D)).astype(np.float32) * 0.1
+    os.makedirs(path, exist_ok=True)
+    from safetensors.numpy import save_file as _sf
+
+    _sf(t, os.path.join(path, "model.safetensors"))
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump({
+            "model_type": "mixtral", "vocab_size": V, "hidden_size": D,
+            "intermediate_size": F, "num_hidden_layers": L,
+            "num_attention_heads": H, "num_key_value_heads": Hk,
+            "max_position_embeddings": cfg.max_position_embeddings,
+            "num_local_experts": E, "num_experts_per_tok": 2,
+        }, f)
+    return t
+
+
+@pytest.mark.parametrize("quantize", [None, "int8"])
+def test_sharded_load_moe_expert_stacks(tmp_path, quantize):
+    """VERDICT r4 item 6: expert stacks [L, E, in, out] shard-load over
+    an ep x tp mesh with parity against the stacked loader — BASELINE
+    config 4's (Mixtral/DeepSeek EP) real-checkpoint path."""
+    from dynamo_tpu.models.loader import load_params, load_params_sharded
+
+    cfg = _cfg(num_local_experts=4, num_experts_per_tok=2)
+    path = str(tmp_path / "ckpt")
+    _write_moe_checkpoint(cfg, path, seed=5)
+    mesh = build_mesh(MeshConfig(ep=4, tp=2), jax.devices()[:8])
+    ref = load_params(cfg, path, mesh, quantize=quantize)
+    got = load_params_sharded(cfg, path, mesh, quantize=quantize)
+    assert set(ref) == set(got)
+    for name in sorted(ref):
+        r, g = _host(ref[name]), _host(got[name])
+        assert r.shape == g.shape, name
+        assert r.dtype == g.dtype, name
+        np.testing.assert_array_equal(r, g, err_msg=name)
+        assert ref[name].sharding == got[name].sharding, name
+    # the expert stacks really are ep-sharded (each device holds E/ep)
+    shard = got["w_gate"].addressable_shards[0]
+    assert shard.data.shape[1] == cfg.num_local_experts // 4
