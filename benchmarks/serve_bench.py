@@ -99,6 +99,10 @@ SHAPES = {
         # which lands inside interactive windows. 0.85 was flat:
         # residency stops binding near ~340 blocks at this shape.
         engine=dict(random_weights=True, quantization="int8",
+                    # int8 KV: the r5 record (saturation 139 -> 172
+                    # out tok/s with the mid-chunk sync skip; see
+                    # RESULTS.md round-5 sections)
+                    kv_cache_dtype="int8",
                     block_size=128, max_batch_size=32, decode_steps=32,
                     hbm_utilization=0.7, prefill_chunk_size=1024,
                     max_model_len=3328),
