@@ -87,14 +87,14 @@ async def test_prefill_step_failure_quarantines_only_prefills():
                 raise RuntimeError("injected prefill failure")
             return orig_mixed(works, seqs, *a, **kw)
 
-        def boom_step(arrays, sampling):
+        def boom_step(arrays, sampling, **kw):
             if (
                 state["armed"]
                 and arrays["tokens"].shape[1] > 1  # a prefill dispatch
             ):
                 state["fired"] += 1
                 raise RuntimeError("injected prefill failure")
-            return orig_step(arrays, sampling)
+            return orig_step(arrays, sampling, **kw)
 
         engine._dispatch_mixed = boom_mixed
         engine._run_device_step = boom_step
