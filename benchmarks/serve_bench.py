@@ -69,7 +69,11 @@ SHAPES = {
             max_position_embeddings=8192,
         ),
         engine=dict(random_weights=True, quantization="int8",
-                    block_size=128, max_batch_size=32, decode_steps=32,
+                    # max_batch_size=64 is the r5 number of record: the
+                    # wide engine serves c=64 at ~1.9k out tok/s and
+                    # holds lower concurrencies at or above the old
+                    # mb=32 engine (mid decode bucket; RESULTS.md)
+                    block_size=128, max_batch_size=64, decode_steps=32,
                     hbm_utilization=0.7, prefill_chunk_size=1024,
                     max_model_len=320),
         # isl is in WORDS (load_gen builds text); the test tokenizer
