@@ -243,12 +243,6 @@ class JaxEngine:
                     "kv_cache_dtype=int8 is not supported with "
                     "pipeline_parallel_size > 1 (use bfloat16 or fp8)"
                 )
-            if cfg.num_nodes > 1:
-                raise ValueError(
-                    "kv_cache_dtype=int8 is not yet supported with "
-                    "num_nodes > 1 (the mirrored gather/scatter paths "
-                    "move plain cache arrays); use bfloat16 or fp8"
-                )
             if (
                 jax.default_backend() == "tpu"
                 and cfg.block_size % 128 != 0

@@ -331,13 +331,22 @@ def mirror_gather(k_cache, v_cache, block_ids: np.ndarray, block_size: int,
     import jax
     from jax.sharding import NamedSharding
 
-    from dynamo_tpu.ops.block_copy import _gather, pad_ids_to_bucket
+    from dynamo_tpu.ops.block_copy import (
+        _gather,
+        _gather_quant,
+        pad_ids_to_bucket,
+    )
 
     n = len(block_ids)
+    ids = jnp_i32(pad_ids_to_bucket(block_ids))
     with mesh:
-        packed = _gather(
-            k_cache, v_cache, jnp_i32(pad_ids_to_bucket(block_ids)), block_size
-        )
+        if isinstance(k_cache, tuple):  # int8: dequant to the bf16 wire
+            packed = _gather_quant(
+                k_cache[0], k_cache[1], v_cache[0], v_cache[1], ids,
+                block_size,
+            )
+        else:
+            packed = _gather(k_cache, v_cache, ids, block_size)
         packed = jax.device_put(
             packed, NamedSharding(mesh, _packed_spec())
         )
@@ -354,21 +363,29 @@ def mirror_scatter(k_cache, v_cache, block_ids: np.ndarray,
 
     from dynamo_tpu.ops.block_copy import (
         _scatter,
+        _scatter_quant,
         pad_ids_to_bucket,
         pad_rows_to,
     )
 
     ids = pad_ids_to_bucket(block_ids)
     local_rows = pad_rows_to(len(ids), local_rows)
+    quant = isinstance(k_cache, tuple)
+    kv = k_cache[0] if quant else k_cache
     global_shape = (
-        len(ids), 2, k_cache.shape[0], block_size,
-        k_cache.shape[2], k_cache.shape[3],
+        len(ids), 2, kv.shape[0], block_size, kv.shape[2], kv.shape[3],
     )
     sharding = NamedSharding(mesh, _packed_spec())
     data = jax.make_array_from_process_local_data(
         sharding, np.ascontiguousarray(local_rows), global_shape
     )
     with mesh:
+        if quant:  # requantize the bf16 wire rows into values + scales
+            kvv, ks, vv, vs = _scatter_quant(
+                k_cache[0], k_cache[1], v_cache[0], v_cache[1],
+                jnp_i32(ids), data, block_size,
+            )
+            return (kvv, ks), (vv, vs)
         return _scatter(k_cache, v_cache, jnp_i32(ids), data, block_size)
 
 
@@ -376,18 +393,22 @@ import functools
 
 
 @functools.lru_cache(maxsize=8)
-def _gather_full_fn(mesh, block_size: int):
+def _gather_full_fn(mesh, block_size: int, quant: bool = False):
     """Cached jitted replicated gather — a per-call jit closure would
     retrace + recompile on EVERY export, on every host, stalling the
-    lockstep step loop for seconds each time."""
+    lockstep step loop for seconds each time. ``quant``: int8
+    (values, scales) caches dequantize to the bf16 wire in-graph."""
     import jax
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
-    from dynamo_tpu.ops.block_copy import _gather
+    from dynamo_tpu.ops.block_copy import _gather, _gather_quant
 
     def gather_rep(k, v, ids):
-        packed = _gather(k, v, ids, block_size)
+        if quant:
+            packed = _gather_quant(k[0], k[1], v[0], v[1], ids, block_size)
+        else:
+            packed = _gather(k, v, ids, block_size)
         return jax.lax.with_sharding_constraint(
             packed, NamedSharding(mesh, P())
         )
@@ -409,9 +430,9 @@ def mirror_gather_full(k_cache, v_cache, block_ids: np.ndarray,
 
     n = len(block_ids)
     with mesh:
-        packed = _gather_full_fn(mesh, block_size)(
-            k_cache, v_cache, jnp_i32(pad_ids_to_bucket(block_ids))
-        )
+        packed = _gather_full_fn(
+            mesh, block_size, quant=isinstance(k_cache, tuple)
+        )(k_cache, v_cache, jnp_i32(pad_ids_to_bucket(block_ids)))
         jax.block_until_ready(packed)
     return np.asarray(packed.addressable_data(0))[:n]
 

@@ -22,7 +22,7 @@ os.environ["XLA_FLAGS"] = (
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-async def main(rank: int, coord: str) -> None:
+async def main(rank: int, coord: str, kv_dtype: str = "float32") -> None:
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.engine import JaxEngine
     from dynamo_tpu.models.config import ModelConfig
@@ -44,7 +44,7 @@ async def main(rank: int, coord: str) -> None:
             num_blocks=14, block_size=8, max_batch_size=4,
             tensor_parallel_size=2, decode_steps=2,
             num_nodes=2, node_rank=rank, leader_addr=coord,
-            kv_cache_dtype="float32",
+            kv_cache_dtype=kv_dtype,
             # sharded G2 offload: small device pool forces eviction,
             # the repeat prompt onboards through the mirrored tier
             host_kv_blocks=16,
@@ -132,4 +132,7 @@ async def main(rank: int, coord: str) -> None:
 
 
 if __name__ == "__main__":
-    asyncio.run(main(int(sys.argv[1]), sys.argv[2]))
+    asyncio.run(main(
+        int(sys.argv[1]), sys.argv[2],
+        sys.argv[3] if len(sys.argv) > 3 else "float32",
+    ))

@@ -21,12 +21,12 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_engine_serves_request():
+def _run_pair(kv_dtype: str) -> dict:
     coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ, PYTHONPATH=REPO)
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(rank), coord],
+            [sys.executable, WORKER, str(rank), coord, kv_dtype],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True,
         )
@@ -59,10 +59,24 @@ def test_two_process_engine_serves_request():
         # multimodal embed-injection prefill over the step broadcast
         # (KIND_STEP_MM): the follower mirrored the mm step variant
         assert result["mm_ok"], result
+        return result
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+def test_two_process_engine_serves_request():
+    _run_pair("float32")
+
+
+def test_two_process_engine_int8_kv():
+    """The same 2-process protocol over an int8 (values, scales) cache:
+    quantized writes inside the lockstep steps, mirrored offload /
+    export / import dequantizing to the bf16 wire at the block-copy
+    boundary (mirror_gather/_scatter tuple dispatch) — the combination
+    the 70B ladder budget assumes (docs/multihost.md)."""
+    _run_pair("int8")
 
 
 def test_hash_halves_survive_broadcast_canonicalization():
