@@ -17,7 +17,9 @@ dominates the measurement); DYN_BENCH_QUANT=int8|none (default int8 on
 TPU: weight-only per-channel int8, which is also what lets the REAL
 8B flagship shape fit one 16 GB chip — bf16 does not);
 DYN_BENCH_MODEL=8b|3.8b (default 8b: R1-Distill-Llama-8B geometry,
-BASELINE.md config 1).
+BASELINE.md config 1); DYN_BENCH_KV_DTYPE=bfloat16|int8|float8_e4m3fn
+(default bfloat16 — int8 halves KV bytes/token and is the long-context
+serving default, see benchmarks/RESULTS.md round-5 sections).
 """
 
 from __future__ import annotations
@@ -162,7 +164,9 @@ async def _run(model_cfg, wl) -> dict:
 
     async def one_request(i: int) -> tuple[float, float, int]:
         prompt = rng.integers(1, model_cfg.vocab_size, size=wl["isl"]).tolist()
-        prompt[0] = 7 + i  # unique head: avoid total prefix collapse
+        # unique head: avoid total prefix collapse (mod: warmup ids
+        # 9000+ must stay inside the CPU smoke model's tiny vocab)
+        prompt[0] = (7 + i) % (model_cfg.vocab_size - 1) + 1
         req = PreprocessedRequest(
             request_id=f"bench-{i}",
             token_ids=prompt,
