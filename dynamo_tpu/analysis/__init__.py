@@ -1,0 +1,36 @@
+"""dynalint: AST-based invariant checks for the async/TPU serving stack.
+
+The reference Dynamo leans on Rust's compiler to rule out whole classes
+of concurrency and resource bugs statically; this package is the Python
+reproduction's substitute guardrail. Pure stdlib (``ast`` + ``fnmatch``)
+— zero dependencies, runs at pytest time and on every PR.
+
+Public API::
+
+    from dynamo_tpu.analysis import lint_paths, lint_source, all_rules
+    findings = lint_paths(["dynamo_tpu"], config=load_config())
+
+CLI: ``dynamo-tpu lint [paths] [--format json]`` — exits non-zero on
+unsuppressed findings. Suppress a finding in place with
+``# dynalint: disable=<rule-name> — justification``.
+"""
+
+from dynamo_tpu.analysis.config import DEFAULTS, load_config  # noqa: F401
+from dynamo_tpu.analysis.findings import (  # noqa: F401
+    Finding,
+    format_json,
+    format_text,
+    unsuppressed,
+)
+from dynamo_tpu.analysis.registry import (  # noqa: F401
+    LintModule,
+    Rule,
+    all_rules,
+    get_rule,
+    rule,
+)
+from dynamo_tpu.analysis.walker import (  # noqa: F401
+    iter_files,
+    lint_paths,
+    lint_source,
+)

@@ -1,0 +1,99 @@
+"""`dynamo-tpu lint` — run dynalint from the command line.
+
+Exit codes: 0 clean, 1 unsuppressed findings (merge-gating), 2 usage
+error. ``--format json`` emits the machine-readable report on stdout so
+CI can archive it; the exit code gates either way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import Any
+
+from dynamo_tpu.analysis.config import load_config
+from dynamo_tpu.analysis.findings import format_json, format_text, unsuppressed
+from dynamo_tpu.analysis.registry import all_rules, get_rule
+from dynamo_tpu.analysis.walker import iter_files, lint_paths
+
+
+def add_lint_parser(sub: Any) -> None:
+    """Attach the `lint` subparser (called from cli/main.build_parser)."""
+    lint = sub.add_parser(
+        "lint",
+        help="static invariant checks for the async/TPU serving stack",
+        description="AST-based repo linter (dynalint). Rules target the "
+        "failure modes this codebase actually has: blocked event loops, "
+        "dropped task handles, swallowed cancellation, host syncs in jit "
+        "paths, awaits under thread locks, bare excepts.",
+    )
+    lint.add_argument("paths", nargs="*", default=None,
+                      help="files/dirs to lint (default: [tool.dynalint] "
+                           "include, i.e. dynamo_tpu/)")
+    lint.add_argument("--format", dest="fmt", default="text",
+                      choices=["text", "json"])
+    lint.add_argument("--rules", default=None,
+                      help="comma-separated rule names to run "
+                           "(default: all minus config `disable`)")
+    lint.add_argument("--list-rules", action="store_true",
+                      help="print the rule catalog and exit")
+    lint.add_argument("--show-suppressed", action="store_true",
+                      help="text format: also print waived findings")
+    lint.add_argument("--pyproject", default=None,
+                      help="explicit pyproject.toml for [tool.dynalint]")
+
+
+def cmd_lint(args: Any) -> int:
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.code}  {r.name:26s} {r.summary}")
+        return 0
+    # anchor config discovery at the linted tree, not the cwd: `dynamo-tpu
+    # lint /repo/pkg` from anywhere must see /repo's [tool.dynalint]
+    config = load_config(
+        start=args.paths[0] if args.paths else ".", pyproject=args.pyproject
+    )
+    if args.rules:
+        try:
+            rules = [get_rule(n.strip()) for n in args.rules.split(",") if n.strip()]
+        except KeyError as exc:
+            print(f"dynalint: {exc.args[0]}", file=sys.stderr)
+            return 2
+    else:
+        rules = None  # lint_paths applies config `disable`
+    paths = args.paths or list(config.get("include", ["dynamo_tpu"]))
+    # a gate that scans nothing must fail loudly, not pass green: a
+    # typo'd path (or running outside the repo) would otherwise report
+    # "0 findings" and exit 0 while checking zero files. Diagnostics go
+    # to stderr so `--format json > report.json` stays machine-readable.
+    missing = [p for p in paths if not Path(p).exists()]
+    if missing:
+        print(f"dynalint: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    files = iter_files(paths, exclude=list(config.get("exclude", [])))
+    if not files:
+        print(f"dynalint: no python files under: {', '.join(map(str, paths))}",
+              file=sys.stderr)
+        return 2
+    findings = lint_paths(paths, rules=rules, config=config, files=files)
+    if args.fmt == "json":
+        print(format_json(findings))
+    else:
+        print(format_text(findings, show_suppressed=args.show_suppressed))
+    return 1 if unsuppressed(findings) else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Standalone entry: `python -m dynamo_tpu.analysis.cli [paths...]`."""
+    parser = argparse.ArgumentParser(prog="dynalint")
+    sub = parser.add_subparsers(dest="command", required=True)
+    add_lint_parser(sub)
+    if argv is None:
+        argv = sys.argv[1:]
+    return cmd_lint(parser.parse_args(["lint", *argv]))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

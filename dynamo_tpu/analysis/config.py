@@ -1,0 +1,85 @@
+"""[tool.dynalint] configuration from pyproject.toml.
+
+Keys (all optional):
+  include       — path globs linted when the CLI gets no paths
+                  (default: ["dynamo_tpu"])
+  exclude       — path prefixes/globs skipped during the walk
+  disable       — rule names turned off globally
+  hot-functions — extra function names treated as jit hot paths (DL004)
+
+Parsing uses stdlib ``tomllib`` when present (3.11+), else the vendored
+``tomli`` this environment ships; with neither, config silently falls
+back to defaults — the linter must never add a dependency.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from typing import Any, Optional
+
+DEFAULTS: dict[str, Any] = {
+    "include": ["dynamo_tpu"],
+    "exclude": [],
+    "disable": [],
+    "hot-functions": [],
+}
+
+
+def _load_toml(path: Path) -> Optional[dict]:
+    try:
+        import tomllib  # py311+
+    except ImportError:
+        try:
+            import tomli as tomllib  # type: ignore[no-redef]
+        except ImportError:
+            return None
+    try:
+        with open(path, "rb") as f:
+            return tomllib.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def find_pyproject(start: Path) -> Optional[Path]:
+    cur = start.resolve()
+    if cur.is_file():
+        cur = cur.parent
+    for candidate in [cur, *cur.parents]:
+        p = candidate / "pyproject.toml"
+        if p.is_file():
+            return p
+    return None
+
+
+def load_config(start: Optional[str] = None,
+                pyproject: Optional[str] = None) -> dict[str, Any]:
+    """Merged config: DEFAULTS overlaid with [tool.dynalint]."""
+    cfg = dict(DEFAULTS)
+    path = Path(pyproject) if pyproject else find_pyproject(Path(start or "."))
+    if path is None:
+        return cfg
+    data = _load_toml(path)
+    if not data:
+        return cfg
+    table = data.get("tool", {}).get("dynalint", {})
+    if isinstance(table, dict):
+        # a typo'd key (hot_functions vs hot-functions) would otherwise
+        # no-op silently while the author believes the guard is active —
+        # the same failure mode bad-suppression findings exist for
+        unknown = sorted(set(table) - set(DEFAULTS))
+        if unknown:
+            print(
+                f"dynalint: unknown [tool.dynalint] key(s) in {path}: "
+                f"{', '.join(unknown)} (known: {', '.join(sorted(DEFAULTS))})",
+                file=sys.stderr,
+            )
+        cfg.update({k: v for k, v in table.items() if k in DEFAULTS})
+    # anchor relative include paths at the pyproject's directory so
+    # `dynamo-tpu lint` works from any cwd inside the repo
+    root = path.parent
+    cfg["include"] = [
+        p if Path(p).is_absolute() else str(root / p)
+        for p in cfg.get("include", [])
+    ]
+    return cfg

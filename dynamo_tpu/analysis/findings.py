@@ -1,0 +1,60 @@
+"""Finding record + report formatting for dynalint.
+
+A ``Finding`` is one rule violation at one source location. Suppressed
+findings are kept (flagged) rather than dropped so reporters can show
+what was waived and the self-clean gate can count both populations.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str  # kebab-case rule name, e.g. "blocking-call-in-async"
+    code: str  # stable short code, e.g. "DL001"
+    path: str  # file the finding is in (as given to the walker)
+    line: int  # 1-based source line
+    col: int  # 0-based column
+    message: str
+    suppressed: bool = False
+
+
+def unsuppressed(findings: list[Finding]) -> list[Finding]:
+    return [f for f in findings if not f.suppressed]
+
+
+def format_text(findings: list[Finding], *, show_suppressed: bool = False) -> str:
+    """flake8-style one-line-per-finding report plus a summary line."""
+    lines = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code)):
+        if f.suppressed and not show_suppressed:
+            continue
+        tag = " (suppressed)" if f.suppressed else ""
+        lines.append(
+            f"{f.path}:{f.line}:{f.col + 1}: {f.code} [{f.rule}] {f.message}{tag}"
+        )
+    live = len(unsuppressed(findings))
+    waived = len(findings) - live
+    lines.append(f"dynalint: {live} finding(s), {waived} suppressed")
+    return "\n".join(lines)
+
+
+def format_json(findings: list[Finding]) -> str:
+    """Machine-readable report: {findings: [...], summary: {...}}."""
+    payload = {
+        "findings": [
+            asdict(f)
+            for f in sorted(
+                findings, key=lambda f: (f.path, f.line, f.col, f.code)
+            )
+        ],
+        "summary": {
+            "total": len(findings),
+            "unsuppressed": len(unsuppressed(findings)),
+            "suppressed": len(findings) - len(unsuppressed(findings)),
+        },
+    }
+    return json.dumps(payload, indent=2)

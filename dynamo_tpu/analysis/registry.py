@@ -1,0 +1,68 @@
+"""Rule registry: rules self-register at import via the @rule decorator.
+
+A rule is a function ``check(module: LintModule) -> Iterable[(node, msg)]``
+plus metadata (kebab-case name, stable DLxxx code, summary). The walker
+runs every enabled rule over every file and stamps the rule's metadata
+onto each (node, message) pair to build ``Finding``s; rules never import
+each other.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Tuple
+
+CheckResult = Iterable[Tuple[ast.AST, str]]
+
+
+@dataclass
+class LintModule:
+    """One parsed source file handed to each rule."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    config: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class Rule:
+    name: str
+    code: str
+    summary: str
+    check: Callable[[LintModule], CheckResult]
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def rule(name: str, code: str, summary: str):
+    """Register ``check(module)`` as a rule. Import-time side effect."""
+
+    def deco(check: Callable[[LintModule], CheckResult]):
+        if name in _REGISTRY:
+            raise ValueError(f"duplicate rule {name!r}")
+        _REGISTRY[name] = Rule(name=name, code=code, summary=summary, check=check)
+        return check
+
+    return deco
+
+
+def all_rules() -> list[Rule]:
+    """Every registered rule, ordered by code (imports rule modules)."""
+    # importing the rules package triggers registration; deferred so the
+    # registry module itself stays import-cycle-free
+    import dynamo_tpu.analysis.rules  # noqa: F401
+
+    return sorted(_REGISTRY.values(), key=lambda r: r.code)
+
+
+def get_rule(name: str) -> Rule:
+    import dynamo_tpu.analysis.rules  # noqa: F401
+
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        known = ", ".join(sorted(_REGISTRY))
+        raise KeyError(f"unknown rule {name!r} (known: {known})") from None

@@ -1,0 +1,17 @@
+"""dynalint rule modules — importing this package registers every rule.
+
+Adding a rule: create a module here, decorate a ``check(module)``
+function with ``@rule(name, code, summary)`` from
+``dynamo_tpu.analysis.registry``, and import the module below. Pick the
+next free DLxxx code; never reuse a retired one (suppression comments
+reference rule names, reports reference codes).
+"""
+
+from dynamo_tpu.analysis.rules import (  # noqa: F401
+    await_locked,
+    bare_except,
+    blocking_async,
+    dropped_task,
+    host_sync_jit,
+    swallowed_cancel,
+)

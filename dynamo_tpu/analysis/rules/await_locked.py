@@ -1,0 +1,87 @@
+"""DL005 await-while-locked: a suspension point (``await``, ``async
+for``, ``async with``, async comprehension) inside a ``with`` block
+whose context manager looks like a *threading* lock.
+
+Suspending at an await point while holding a thread lock is a deadlock
+factory: the coroutine parks, the loop runs other tasks, and any thread
+(or task via an executor) that touches the same lock wedges — including
+the one needed to let the awaiting coroutine resume. Use
+``asyncio.Lock`` with ``async with``, or do the awaited work outside the
+critical section.
+
+Heuristic: the context expression is ``threading.Lock()/RLock()`` (or a
+call to a name ending in Lock), or a name/attribute whose last segment
+is "lock"/"rlock"/"mutex" (optionally prefixed, e.g. ``write_lock``) —
+a *word-boundary* match, so ``free_blocks`` and other "…block…" names in
+this KV-block-manager codebase are not mistaken for locks. ``async
+with`` is never flagged."""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from dynamo_tpu.analysis.registry import LintModule, rule
+from dynamo_tpu.analysis.rules.common import (
+    FunctionScopeVisitor,
+    dotted_name,
+    walk_in_scope,
+)
+
+LOCK_CALLS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+_LOCK_NAME = re.compile(r"(?:^|.*_)r?(?:lock|mutex)$")
+
+
+def _looks_like_thread_lock(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Call):
+        return (dotted_name(expr.func) or "") in LOCK_CALLS
+    name = dotted_name(expr)
+    if name is None:
+        return False
+    return _LOCK_NAME.match(name.rsplit(".", 1)[-1].lower()) is not None
+
+
+@rule(
+    "await-while-locked",
+    "DL005",
+    "await suspends while holding a threading lock (deadlock risk)",
+)
+def check(module: LintModule):
+    findings: list[tuple[ast.AST, str]] = []
+    flagged: set[ast.AST] = set()  # one finding per await, however many locks
+
+    class V(FunctionScopeVisitor):
+        def visit_With(self, node: ast.With) -> None:
+            if self.in_async and any(
+                _looks_like_thread_lock(item.context_expr)
+                for item in node.items
+            ):
+                for sub in walk_in_scope(node):
+                    # every suspension point counts, not just `await`:
+                    # async for/with and async comprehensions suspend too
+                    if isinstance(
+                        sub, (ast.Await, ast.AsyncFor, ast.AsyncWith)
+                    ):
+                        suspends = sub
+                    elif isinstance(sub, ast.comprehension) and sub.is_async:
+                        suspends = sub.iter
+                    else:
+                        continue
+                    if suspends in flagged:
+                        continue
+                    flagged.add(suspends)
+                    findings.append(
+                        (
+                            suspends,
+                            "suspension point (await / async for / "
+                            "async with) while holding a threading "
+                            "lock: the coroutine parks mid-critical-"
+                            "section and anything contending the lock "
+                            "wedges; use asyncio.Lock (`async with`) "
+                            "or move the async work out",
+                        )
+                    )
+            self.generic_visit(node)
+
+    V().visit(module.tree)
+    return findings

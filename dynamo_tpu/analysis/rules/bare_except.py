@@ -1,0 +1,33 @@
+"""DL006 bare-except: ``except:`` with no exception type.
+
+A bare except catches everything including ``SystemExit``,
+``KeyboardInterrupt``, and ``asyncio.CancelledError`` — shutdown and
+cancellation silently stop working. Catch the narrowest type that the
+handler actually recovers from; ``except Exception`` is the widest
+acceptable net."""
+
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.analysis.registry import LintModule, rule
+
+
+@rule(
+    "bare-except",
+    "DL006",
+    "bare `except:` catches SystemExit/KeyboardInterrupt/CancelledError",
+)
+def check(module: LintModule):
+    findings: list[tuple[ast.AST, str]] = []
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append(
+                (
+                    node,
+                    "bare `except:` also catches SystemExit/"
+                    "KeyboardInterrupt/CancelledError; catch a specific "
+                    "type (at widest, `except Exception`)",
+                )
+            )
+    return findings

@@ -1,0 +1,63 @@
+"""DL001 blocking-call-in-async: synchronous sleeps / process / network
+calls inside ``async def`` bodies stall the whole event loop — every
+in-flight request stream on that loop freezes for the duration.
+
+Remediations: ``await asyncio.sleep``, ``asyncio.create_subprocess_*``,
+``loop.run_in_executor`` / ``asyncio.to_thread`` for everything else.
+Calls inside nested *sync* ``def``s are not flagged (those run wherever
+the helper is invoked — often a worker thread)."""
+
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.analysis.registry import LintModule, rule
+from dynamo_tpu.analysis.rules.common import FunctionScopeVisitor, dotted_name
+
+# dotted call name -> suggested replacement
+BLOCKING_CALLS = {
+    "time.sleep": "await asyncio.sleep(...)",
+    "subprocess.run": "asyncio.create_subprocess_exec(...)",
+    "subprocess.call": "asyncio.create_subprocess_exec(...)",
+    "subprocess.check_call": "asyncio.create_subprocess_exec(...)",
+    "subprocess.check_output": "asyncio.create_subprocess_exec(...)",
+    "subprocess.getoutput": "asyncio.create_subprocess_shell(...)",
+    "os.system": "asyncio.create_subprocess_shell(...)",
+    "socket.create_connection": "asyncio.open_connection(...)",
+    "socket.getaddrinfo": "loop.getaddrinfo(...)",
+    "socket.gethostbyname": "loop.getaddrinfo(...)",
+    "urllib.request.urlopen": "loop.run_in_executor(...)",
+    "requests.get": "loop.run_in_executor(...)",
+    "requests.post": "loop.run_in_executor(...)",
+    "requests.put": "loop.run_in_executor(...)",
+    "requests.delete": "loop.run_in_executor(...)",
+    "requests.head": "loop.run_in_executor(...)",
+    "requests.request": "loop.run_in_executor(...)",
+}
+
+
+@rule(
+    "blocking-call-in-async",
+    "DL001",
+    "blocking sleep/process/network call inside an async def body",
+)
+def check(module: LintModule):
+    findings: list[tuple[ast.AST, str]] = []
+
+    class V(FunctionScopeVisitor):
+        def visit_Call(self, node: ast.Call) -> None:
+            if self.in_async:
+                name = dotted_name(node.func)
+                hint = BLOCKING_CALLS.get(name or "")
+                if hint is not None:
+                    findings.append(
+                        (
+                            node,
+                            f"`{name}(...)` blocks the event loop; use "
+                            f"{hint} or offload to an executor",
+                        )
+                    )
+            self.generic_visit(node)
+
+    V().visit(module.tree)
+    return findings

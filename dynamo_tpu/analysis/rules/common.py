@@ -1,0 +1,59 @@
+"""Shared AST helpers for dynalint rules."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_in_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``node``'s body without descending into nested function
+    definitions — "what executes in THIS function's frame"."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
+class FunctionScopeVisitor(ast.NodeVisitor):
+    """NodeVisitor that tracks the kind of the innermost enclosing
+    function ("async" or "sync"), so rules can ask whether a node
+    executes in an async frame without being fooled by nested sync
+    helpers defined inside ``async def`` bodies."""
+
+    def __init__(self) -> None:
+        self._scope: list[str] = []
+
+    @property
+    def in_async(self) -> bool:
+        return bool(self._scope) and self._scope[-1] == "async"
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._scope.append("async")
+        self.generic_visit(node)
+        self._scope.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._scope.append("sync")
+        self.generic_visit(node)
+        self._scope.pop()
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._scope.append("sync")
+        self.generic_visit(node)
+        self._scope.pop()

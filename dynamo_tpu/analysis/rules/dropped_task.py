@@ -1,0 +1,94 @@
+"""DL002 dropped-task-handle: ``asyncio.create_task(...)`` (or
+``ensure_future`` / ``loop.create_task``) as a bare expression statement.
+
+The event loop holds only a *weak* reference to tasks — a handle that is
+neither assigned, awaited, nor registered anywhere can be garbage
+collected mid-flight, silently cancelling the task; its exceptions are
+also never observed. Keep a strong reference (``dynamo_tpu.utils.tasks
+.spawn`` does this and logs crashes) or await the task.
+
+``asyncio.TaskGroup``-style receivers (``tg.create_task(...)`` etc.) are
+exempt: the group holds the reference and re-raises exceptions."""
+
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.analysis.registry import LintModule, rule
+from dynamo_tpu.analysis.rules.common import dotted_name
+
+SPAWNERS = {
+    "asyncio.create_task",
+    "asyncio.ensure_future",
+    "create_task",  # from asyncio import create_task
+    "ensure_future",
+}
+# receivers whose .create_task already keeps a strong reference and
+# surfaces exceptions (structured concurrency): not a dropped handle
+GROUP_RECEIVERS = {"tg", "group", "task_group", "taskgroup", "nursery"}
+# `asyncio.get_running_loop().create_task(...)` — the chain roots in a
+# Call, so dotted_name() can't resolve it; match the loop getter itself
+LOOP_GETTERS = {
+    "asyncio.get_running_loop",
+    "asyncio.get_event_loop",
+    "get_running_loop",
+    "get_event_loop",
+}
+
+
+def _is_spawner(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if name is not None:
+        if name in SPAWNERS:
+            return True
+        if name.endswith(".create_task"):
+            receiver = name[: -len(".create_task")].rsplit(".", 1)[-1].lower()
+            return receiver not in GROUP_RECEIVERS
+        return False
+    if isinstance(call.func, ast.Attribute) and call.func.attr == "create_task":
+        base = call.func.value
+        return (
+            isinstance(base, ast.Call)
+            and (dotted_name(base.func) or "") in LOOP_GETTERS
+        )
+    return False
+
+
+def _display(func: ast.AST) -> str:
+    """Readable call-target for Call-rooted chains dotted_name can't
+    resolve, e.g. `asyncio.get_running_loop().create_task`."""
+    if isinstance(func, ast.Attribute):
+        if isinstance(func.value, ast.Call):
+            base = dotted_name(func.value.func)
+            if base:
+                return f"{base}().{func.attr}"
+        return func.attr
+    return "create_task"
+
+
+@rule(
+    "dropped-task-handle",
+    "DL002",
+    "task spawned without keeping a handle (GC can cancel it mid-flight)",
+)
+def check(module: LintModule):
+    findings: list[tuple[ast.AST, str]] = []
+    for node in ast.walk(module.tree):
+        # only a *bare expression statement* drops the handle; assignment,
+        # await, or use as an argument (gather, list.append) all keep one
+        if (
+            isinstance(node, ast.Expr)
+            and isinstance(node.value, ast.Call)
+            and _is_spawner(node.value)
+        ):
+            name = dotted_name(node.value.func) or _display(node.value.func)
+            findings.append(
+                (
+                    node,
+                    f"`{name}(...)` result is dropped — the loop only "
+                    "weak-refs tasks, so GC can cancel it and its "
+                    "exceptions are never logged; keep the handle "
+                    "(e.g. dynamo_tpu.utils.tasks.spawn)",
+                )
+            )
+    return findings

@@ -1,0 +1,101 @@
+"""DL004 host-sync-in-jit-path: host↔device synchronization inside a
+jit-compiled function.
+
+``.item()`` / ``.tolist()`` / ``np.asarray`` / ``jax.device_get`` /
+``.block_until_ready()`` inside a ``jax.jit``/``pjit`` function either
+fail at trace time or — worse, via callbacks — force a device round-trip
+on every step of the decode hot loop, collapsing throughput.
+
+Detection: functions decorated with jit/pjit (including
+``functools.partial(jax.jit, ...)``), functions *passed* to a
+``jax.jit(fn, ...)`` call in the same module, and any function named in
+the ``hot-functions`` config list ([tool.dynalint]) — the engine step
+loop can be pinned there without a decorator.
+
+``float()``/``int()`` are deliberately not flagged: shape arithmetic
+(``float(x.shape[-1]) ** -0.5``) is static and idiomatic in jit code."""
+
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.analysis.registry import LintModule, rule
+from dynamo_tpu.analysis.rules.common import dotted_name
+
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit"}
+SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+SYNC_CALLS = {
+    "np.asarray",
+    "np.array",
+    "numpy.asarray",
+    "numpy.array",
+    "jax.device_get",
+}
+
+
+def _is_jit_expr(expr: ast.AST) -> bool:
+    """jit / jax.jit / pjit, possibly wrapped in functools.partial."""
+    if (dotted_name(expr) or "") in JIT_NAMES:
+        return True
+    if isinstance(expr, ast.Call):
+        fn = dotted_name(expr.func) or ""
+        if fn in JIT_NAMES:
+            return True
+        if fn in ("functools.partial", "partial") and expr.args:
+            return (dotted_name(expr.args[0]) or "") in JIT_NAMES
+    return False
+
+
+def _jit_function_names(tree: ast.Module) -> set[str]:
+    """Names of plain functions passed to a jit call: `jax.jit(step, ...)`."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and (dotted_name(node.func) or "") in JIT_NAMES:
+            if node.args and isinstance(node.args[0], ast.Name):
+                names.add(node.args[0].id)
+    return names
+
+
+@rule(
+    "host-sync-in-jit-path",
+    "DL004",
+    "host-device sync (.item/np.asarray/block_until_ready) in a jit path",
+)
+def check(module: LintModule):
+    findings: list[tuple[ast.AST, str]] = []
+    jit_called = _jit_function_names(module.tree)
+    hot_extra = set(module.config.get("hot-functions", []))
+
+    def scan(fn: ast.AST) -> None:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in SYNC_ATTRS
+            ):
+                findings.append(
+                    (
+                        node,
+                        f"`.{node.func.attr}()` synchronizes host and "
+                        "device inside a jit path; hoist it out of the "
+                        "compiled function",
+                    )
+                )
+            elif (dotted_name(node.func) or "") in SYNC_CALLS:
+                findings.append(
+                    (
+                        node,
+                        f"`{dotted_name(node.func)}(...)` materializes on "
+                        "host inside a jit path; use jnp ops or hoist it "
+                        "out of the compiled function",
+                    )
+                )
+
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        jit_decorated = any(_is_jit_expr(d) for d in node.decorator_list)
+        if jit_decorated or node.name in jit_called or node.name in hot_extra:
+            scan(node)
+    return findings

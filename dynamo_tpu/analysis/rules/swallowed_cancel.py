@@ -1,0 +1,85 @@
+"""DL003 swallowed-cancellation: an ``except`` handler inside an
+``async def`` that catches ``asyncio.CancelledError`` (explicitly, via
+``BaseException``, or via a tuple containing either) without re-raising.
+
+Swallowing cancellation makes ``task.cancel()`` a no-op: shutdown hangs,
+timeouts never fire, and the canceller believes the task stopped while
+it keeps running. The fix is a dedicated handler first::
+
+    except asyncio.CancelledError:
+        raise
+
+``except Exception`` is deliberately NOT flagged: since Python 3.8
+``CancelledError`` derives from ``BaseException``, so ``Exception``
+cannot catch it. Bare ``except:`` is left to DL006 (one finding per
+defect)."""
+
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.analysis.registry import LintModule, rule
+from dynamo_tpu.analysis.rules.common import (
+    FunctionScopeVisitor,
+    dotted_name,
+    walk_in_scope,
+)
+
+CANCEL_NAMES = {
+    "BaseException",
+    "CancelledError",
+    "asyncio.CancelledError",
+    "concurrent.futures.CancelledError",
+}
+
+
+def _catches_cancellation(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return False  # bare except: DL006's territory
+    exprs = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any((dotted_name(e) or "") in CANCEL_NAMES for e in exprs)
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """True if any code path in the handler body (this frame only)
+    contains a raise statement."""
+    for node in handler.body:
+        # a `raise` inside a nested def/lambda runs in another frame
+        # (maybe never): it does not re-raise for THIS handler
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Raise):
+            return True
+        for sub in walk_in_scope(node):
+            if isinstance(sub, ast.Raise):
+                return True
+    return False
+
+
+@rule(
+    "swallowed-cancellation",
+    "DL003",
+    "except handler in async code catches CancelledError without re-raising",
+)
+def check(module: LintModule):
+    findings: list[tuple[ast.AST, str]] = []
+
+    class V(FunctionScopeVisitor):
+        def visit_Try(self, node: ast.Try) -> None:
+            if self.in_async:
+                for handler in node.handlers:
+                    if _catches_cancellation(handler) and not _reraises(handler):
+                        findings.append(
+                            (
+                                handler,
+                                "handler catches asyncio.CancelledError "
+                                "but never re-raises — task.cancel() is "
+                                "silently absorbed; add `except asyncio."
+                                "CancelledError: raise` first",
+                            )
+                        )
+            self.generic_visit(node)
+
+    V().visit(module.tree)
+    return findings

@@ -1,0 +1,259 @@
+"""File walker: discover sources, run rules, apply suppressions.
+
+Suppression syntax (scanned per physical line, flake8-noqa style):
+
+    x = do_thing()  # dynalint: disable=blocking-call-in-async — one-shot CLI
+    y = other()     # dynalint: disable=bare-except,await-while-locked — why
+    # dynalint: disable-file=bare-except   (first 10 lines: whole file)
+
+``disable=all`` waives every rule on that line. Findings anchored to the
+first line of a multi-line statement honor a comment on that line.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import glob
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Iterable, Optional
+
+from dynamo_tpu.analysis.findings import Finding
+from dynamo_tpu.analysis.registry import LintModule, Rule, all_rules
+
+# rule names only: the match stops at whitespace that isn't around a
+# comma, so a trailing justification ("... — why" or "... - why") can't
+# be swallowed into the rule list
+_RULE_LIST = r"([\w-]+(?:\s*,\s*[\w-]+)*)"
+_SUPPRESS_RE = re.compile(r"#\s*dynalint:\s*disable=" + _RULE_LIST)
+_SUPPRESS_FILE_RE = re.compile(r"#\s*dynalint:\s*disable-file=" + _RULE_LIST)
+_FILE_SCOPE_LINES = 10  # disable-file must appear near the top
+
+
+def _parse_rule_list(raw: str, known: set[str]) -> set[str]:
+    """Comma-separated rule names; a token that isn't a known rule ends
+    the list (it's justification prose: `disable=rule, kept for X`). The
+    *first* token is kept even when unknown so a typo'd rule name is
+    reported instead of silently waiving nothing."""
+    names: set[str] = set()
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if part in known:
+            names.add(part)
+        else:
+            if not names:
+                names.add(part)  # leading typo: surfaced as bad-suppression
+            break
+    return names
+
+
+def scan_suppressions(
+    source: str, known: set[str]
+) -> tuple[dict[int, set[str]], set[str], list[tuple[int, str]]]:
+    """(line -> waived rule names, file-wide waived names, problems).
+
+    Only real COMMENT tokens count — a directive quoted inside a string
+    or docstring (e.g. documentation showing the syntax) must not waive
+    anything, or any file could silently disable rules via prose.
+    ``problems`` are directives that have no effect (misplaced
+    disable-file), reported as findings so they fail loudly."""
+    per_line: dict[int, set[str]] = {}
+    per_file: set[str] = set()
+    problems: list[tuple[int, str]] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return per_line, per_file, problems  # unparseable: DL000 reports it
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        i = tok.start[0]
+        m = _SUPPRESS_RE.search(tok.string)
+        if m:
+            per_line.setdefault(i, set()).update(
+                _parse_rule_list(m.group(1), known)
+            )
+        m = _SUPPRESS_FILE_RE.search(tok.string)
+        if m:
+            if i <= _FILE_SCOPE_LINES:
+                per_file.update(_parse_rule_list(m.group(1), known))
+            else:
+                problems.append(
+                    (
+                        i,
+                        "disable-file directive past line "
+                        f"{_FILE_SCOPE_LINES} has no effect; move it to "
+                        "the top of the file",
+                    )
+                )
+    return per_line, per_file, problems
+
+
+def _suppressed(finding: Finding, per_line: dict[int, set[str]],
+                per_file: set[str]) -> bool:
+    names = per_file | per_line.get(finding.line, set())
+    return finding.rule in names or "all" in names
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    rules: Optional[Iterable[Rule]] = None,
+    config: Optional[dict] = None,
+) -> list[Finding]:
+    """Lint one source string. Syntax errors surface as a pseudo-finding
+    (code DL000) rather than crashing the walk."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [
+            Finding(
+                rule="parse-error",
+                code="DL000",
+                path=path,
+                line=exc.lineno or 1,
+                col=(exc.offset or 1) - 1,
+                message=f"file does not parse: {exc.msg}",
+            )
+        ]
+    config = config or {}
+    module = LintModule(path=path, source=source, tree=tree, config=config)
+    if rules is None:
+        # config `disable` applies to every entry point (CLI, pytest
+        # gate, API) — not just the CLI — or the gates would disagree
+        disabled = set(config.get("disable", []))
+        rules = [r for r in all_rules() if r.name not in disabled]
+    # validated against the full registry, not the enabled subset, so
+    # running one rule doesn't flag waivers that belong to the others
+    known = {r.name for r in all_rules()} | {"all"}
+    per_line, per_file, problems = scan_suppressions(source, known)
+    findings: list[Finding] = []
+    # an ineffective directive (misplaced disable-file) or a suppression
+    # naming a rule that doesn't exist (typo) would otherwise waive
+    # nothing *silently* — surface both as findings
+    for line_no, message in problems:
+        findings.append(
+            Finding(
+                rule="bad-suppression",
+                code="DL000",
+                path=path,
+                line=line_no,
+                col=0,
+                message=message,
+            )
+        )
+    suppression_sites = [(1, per_file)] if per_file else []
+    suppression_sites += sorted(per_line.items(), key=lambda kv: kv[0])
+    for line_no, names in suppression_sites:
+        for name in sorted(names - known):
+            findings.append(
+                Finding(
+                    rule="bad-suppression",
+                    code="DL000",
+                    path=path,
+                    line=line_no,
+                    col=0,
+                    message=f"suppression names unknown rule {name!r} "
+                    "and waives nothing (typo?)",
+                )
+            )
+    for r in rules:
+        for node, message in r.check(module):
+            f = Finding(
+                rule=r.name,
+                code=r.code,
+                path=path,
+                line=getattr(node, "lineno", 1),
+                col=getattr(node, "col_offset", 0),
+                message=message,
+            )
+            if _suppressed(f, per_line, per_file):
+                f = dataclasses.replace(f, suppressed=True)
+            findings.append(f)
+    return findings
+
+
+def _excluded(path_str: str, exclude: list[str]) -> bool:
+    """True when any exclude pattern matches the path. Patterns are
+    directory prefixes ("dynamo_tpu/native") or fnmatch globs; matching
+    is segment-aligned and works for absolute and relative paths alike."""
+    posix = path_str.replace("\\", "/")
+    wrapped = "/" + posix.strip("/") + "/"
+    for pat in exclude:
+        pat = pat.strip("/")
+        if "/" + pat + "/" in wrapped:
+            return True
+        if (
+            fnmatch.fnmatch(posix, pat)
+            or fnmatch.fnmatch(posix, "*/" + pat)
+            or fnmatch.fnmatch(posix, pat + "/*")
+            or fnmatch.fnmatch(posix, "*/" + pat + "/*")
+        ):
+            return True
+    return False
+
+
+def iter_files(
+    paths: Iterable[str], exclude: Optional[list[str]] = None
+) -> list[Path]:
+    """Expand files/directories/globs into a sorted .py file list."""
+    exclude = exclude or []
+    out: set[Path] = set()
+    expanded: list[str] = []
+    for p in paths:
+        # include entries may be globs ("dynamo_tpu/*"); a literal path
+        # with no glob chars passes through untouched
+        if any(ch in str(p) for ch in "*?["):
+            expanded.extend(glob.glob(str(p), recursive=True))
+        else:
+            expanded.append(str(p))
+    for p in expanded:
+        root = Path(p)
+        if root.is_file():
+            if root.suffix == ".py" and not _excluded(str(root), exclude):
+                out.add(root)
+        elif root.is_dir():
+            for f in root.rglob("*.py"):
+                if not _excluded(str(f), exclude):
+                    out.add(f)
+    return sorted(out)
+
+
+def lint_paths(
+    paths: Iterable[str],
+    rules: Optional[Iterable[Rule]] = None,
+    config: Optional[dict] = None,
+    files: Optional[list[Path]] = None,
+) -> list[Finding]:
+    """Lint every .py file under ``paths`` (honoring config excludes).
+    Pass ``files`` to reuse an already-computed ``iter_files`` walk."""
+    config = config or {}
+    rule_list = list(rules) if rules is not None else None
+    findings: list[Finding] = []
+    if files is None:
+        files = iter_files(paths, exclude=list(config.get("exclude", [])))
+    for f in files:
+        try:
+            source = f.read_text(encoding="utf-8", errors="replace")
+        except OSError as exc:
+            findings.append(
+                Finding(
+                    rule="read-error",
+                    code="DL000",
+                    path=str(f),
+                    line=1,
+                    col=0,
+                    message=f"unreadable: {exc}",
+                )
+            )
+            continue
+        findings.extend(
+            lint_source(source, path=str(f), rules=rule_list, config=config)
+        )
+    return findings

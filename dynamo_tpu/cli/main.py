@@ -25,6 +25,7 @@ from typing import Any, Optional
 
 from dynamo_tpu.runtime.config import RuntimeConfig
 from dynamo_tpu.runtime.logging import init_logging
+from dynamo_tpu.utils.tasks import spawn
 
 log = logging.getLogger("dynamo_tpu.cli")
 
@@ -262,6 +263,11 @@ def build_parser() -> argparse.ArgumentParser:
     operator.add_argument("--state-dir", default=None,
                           help="persist applied specs here (survive "
                                "coordinator restarts)")
+
+    # static analysis: `dynamo-tpu lint` (dynamo_tpu/analysis — dynalint)
+    from dynamo_tpu.analysis.cli import add_lint_parser
+
+    add_lint_parser(sub)
 
     models = sub.add_parser("models", help="model registry ctl (≈ llmctl)")
     models.add_argument("action", choices=["list", "register", "remove"])
@@ -819,7 +825,7 @@ async def _run_prefill_worker(args: Any) -> None:
         await drt.runtime.wait_shutdown()
         shutdown.set()
 
-    watcher = asyncio.create_task(_watch_shutdown())
+    watcher = spawn(_watch_shutdown(), name="cli-shutdown-watch")
     await run_prefill_worker(jax_engine, drt.store, ns, shutdown)
     watcher.cancel()
     await jax_engine.shutdown()
@@ -862,7 +868,7 @@ async def _run_sp_prefill_worker(args: Any, ns: str) -> None:
         await drt.runtime.wait_shutdown()
         shutdown.set()
 
-    watcher = asyncio.create_task(_watch_shutdown())
+    watcher = spawn(_watch_shutdown(), name="cli-shutdown-watch")
     await run_prefill_worker(prefiller, drt.store, ns, shutdown)
     watcher.cancel()
     await drt.shutdown()
@@ -1378,9 +1384,9 @@ async def cmd_operator(args: Any) -> None:
         await drt.runtime.wait_shutdown()
         shutdown.set()
 
-    watcher = asyncio.create_task(_watch())
+    watcher = spawn(_watch(), name="operator-shutdown-watch")
     if getattr(args, "watch_k8s", False):
-        cr_task = asyncio.create_task(cr.run(shutdown))
+        cr_task = spawn(cr.run(shutdown), name="operator-cr-watch")
     await rec.run(shutdown)
     watcher.cancel()
     if cr_task is not None:
@@ -1432,6 +1438,11 @@ async def cmd_models(args: Any) -> None:
 
 def main(argv: Optional[list[str]] = None) -> None:
     args = build_parser().parse_args(argv)
+    if args.command == "lint":
+        # pure static analysis: no logging/jax setup, exit code gates CI
+        from dynamo_tpu.analysis.cli import cmd_lint
+
+        sys.exit(cmd_lint(args))
     init_logging()
     from dynamo_tpu.utils.jaxtools import configure_from_env
 

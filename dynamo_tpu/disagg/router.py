@@ -17,6 +17,7 @@ from typing import Optional
 
 from dynamo_tpu.disagg.protocols import DisaggConfig, conf_key
 from dynamo_tpu.store.base import Store
+from dynamo_tpu.utils.tasks import spawn
 
 log = logging.getLogger("dynamo_tpu.disagg.router")
 
@@ -56,7 +57,10 @@ class DisaggRouter:
                         except Exception:
                             log.exception("bad disagg conf update ignored")
 
-            router._watch_task = asyncio.create_task(_follow())
+            # spawn (not bare create_task): the registry pins the task
+            # against GC and a crash in the watch loop is logged instead
+            # of dying silently with the config frozen at its last value
+            router._watch_task = spawn(_follow(), name="disagg-conf-watch")
         return router
 
     def should_prefill_remote(self, prefill_len: int, queue_depth: int) -> bool:

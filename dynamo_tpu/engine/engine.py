@@ -1370,6 +1370,9 @@ class JaxEngine:
                 # population for its whole lifetime. Wait out the burst
                 # while it is still growing (bounded: ~16 ms worst case
                 # vs a multi-hundred-ms prefill dispatch saved).
+                # blocking sleep is deliberate: _step_loop runs on the
+                # dedicated "jax-engine" thread (launch()), never on the
+                # event loop, so this parks only the engine thread
                 for _ in range(8):
                     before = len(self.scheduler.waiting)
                     time.sleep(0.002)
@@ -1620,6 +1623,8 @@ class JaxEngine:
         plan = sched.plan()
         self._last_plan = plan  # step-failure attribution (quarantine)
         if plan.kind == "idle":
+            # blocking sleep is deliberate: _one_step executes on the
+            # dedicated "jax-engine" thread, never on the event loop
             time.sleep(0.001)
             return
         if self._trace_enabled:

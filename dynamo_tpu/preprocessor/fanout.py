@@ -78,7 +78,10 @@ class ChoiceFanout(AsyncEngine):
                     # completion object upstream
                     item.request_id = request.request_id
                     await queue.put(item)
-            except BaseException as exc:  # propagate to the merger
+            # the merger (not the loop) owns pump lifetimes: every exit —
+            # including cancellation during its teardown — must enqueue the
+            # exception + _DONE or the `done < n` loop hangs forever
+            except BaseException as exc:  # dynalint: disable=swallowed-cancellation
                 await queue.put(exc)
             finally:
                 await queue.put(_DONE)

@@ -94,7 +94,12 @@ class EndpointServer:
                 except Exception as exc:
                     log.exception("engine error on %s", ep)
                     await send({"t": "err", "sid": sid, "e": f"{type(exc).__name__}: {exc}"})
-            except (ConnectionError, asyncio.CancelledError):
+            except asyncio.CancelledError:
+                # connection teardown cancels in-flight streams; the task
+                # must end *cancelled* (not "done") or the canceller in
+                # _handle's finally believes it finished cleanly
+                raise
+            except ConnectionError:
                 pass
             finally:
                 self.active_requests -= 1
@@ -163,7 +168,9 @@ class EndpointConnection:
                 q = self._queues.get(msg.get("sid"))
                 if q is not None:
                     q.put_nowait(msg)
-        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+        except asyncio.CancelledError:
+            raise  # close() cancels us; finally below still fails waiters
+        except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
             self.closed = True

@@ -108,7 +108,9 @@ class StoreClient(Store):
                     fut = self._pending.pop(mid, None)
                     if fut is not None and not fut.done():
                         fut.set_result(msg)
-        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+        except asyncio.CancelledError:
+            raise  # close() cancels us; finally below still fails waiters
+        except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
             err = ConnectionError("store connection lost")

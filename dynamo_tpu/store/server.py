@@ -83,7 +83,9 @@ class StoreServer:
                 async for ev in watch:
                     await send({"i": sid, "s": _enc_event(ev)})
                 await send({"i": sid, "end": True})
-            except (ConnectionError, asyncio.CancelledError):
+            except asyncio.CancelledError:
+                raise  # stream_close/conn-drop cancels pumps; stay cancellable
+            except ConnectionError:
                 pass
 
         async def pump_sub(sid: int, sub: Any) -> None:
@@ -91,7 +93,9 @@ class StoreServer:
                 async for subject, payload in sub:
                     await send({"i": sid, "s": {"subj": subject, "p": payload}})
                 await send({"i": sid, "end": True})
-            except (ConnectionError, asyncio.CancelledError):
+            except asyncio.CancelledError:
+                raise  # stream_close/conn-drop cancels pumps; stay cancellable
+            except ConnectionError:
                 pass
 
         store = self.store
@@ -177,7 +181,9 @@ class StoreServer:
                 else:
                     raise ValueError(f"unknown op {op!r}")
                 await send({"i": rid, "ok": True, "v": value})
-            except (ConnectionError, asyncio.CancelledError):
+            except asyncio.CancelledError:
+                raise  # connection teardown cancels pending requests
+            except ConnectionError:
                 pass
             except Exception as exc:  # structured error back to caller
                 try:

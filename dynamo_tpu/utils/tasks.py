@@ -1,0 +1,53 @@
+"""Background-task bookkeeping: strong references + crash logging.
+
+The event loop holds only weak references to tasks; a handle that is
+dropped can be garbage collected mid-flight (silently cancelling the
+task), and an un-awaited task's exception is never surfaced until
+interpreter shutdown prints "Task exception was never retrieved".
+``spawn`` fixes both: the module-level registry keeps the task alive and
+a done-callback logs any crash immediately. This is the remediation the
+dropped-task-handle (DL002) lint rule points at.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Coroutine, Optional
+
+log = logging.getLogger("dynamo_tpu.tasks")
+
+# strong references: keeps spawned tasks alive until they finish
+_BACKGROUND: set[asyncio.Task] = set()
+
+
+def spawn(
+    coro: Coroutine[Any, Any, Any], *, name: Optional[str] = None
+) -> asyncio.Task:
+    """create_task + strong reference + exception-logging done-callback.
+
+    Use for fire-and-forget loops (watchers, pumps, reconcilers). The
+    returned handle supports cancel()/await like any task; callers that
+    keep their own reference lose nothing by the registry also holding
+    one until completion.
+    """
+    task = asyncio.get_running_loop().create_task(coro, name=name)
+    _BACKGROUND.add(task)
+    task.add_done_callback(_finalize)
+    return task
+
+
+def _finalize(task: asyncio.Task) -> None:
+    _BACKGROUND.discard(task)
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        log.error(
+            "background task %r crashed", task.get_name(), exc_info=exc
+        )
+
+
+def background_count() -> int:
+    """Live spawned-task count (introspection/tests)."""
+    return len(_BACKGROUND)

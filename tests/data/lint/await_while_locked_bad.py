@@ -1,0 +1,14 @@
+"""Fixture: await under a threading lock (DL005 must fire)."""
+import threading
+
+_lock = threading.Lock()
+
+
+async def update(shared):
+    with _lock:
+        await shared.flush()  # VIOLATION: suspends holding a thread lock
+
+
+async def update_inline(shared):
+    with threading.RLock():
+        await shared.flush()  # VIOLATION

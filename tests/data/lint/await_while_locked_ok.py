@@ -1,0 +1,22 @@
+"""Fixture: clean locking patterns (DL005 must stay quiet)."""
+import asyncio
+import threading
+
+_alock = asyncio.Lock()
+_tlock = threading.Lock()
+
+
+async def update(shared):
+    async with _alock:
+        await shared.flush()  # asyncio lock: suspension is safe
+
+
+def sync_update(shared):
+    with _tlock:
+        shared.flush()  # sync code: no suspension possible
+
+
+async def read_then_await(shared):
+    with _tlock:
+        snapshot = shared.value  # critical section stays synchronous
+    await shared.publish(snapshot)

@@ -1,0 +1,8 @@
+"""Fixture: bare except (DL006 must fire)."""
+
+
+def parse(payload):
+    try:
+        return int(payload)
+    except:  # noqa: E722 — VIOLATION: swallows SystemExit/KeyboardInterrupt
+        return None

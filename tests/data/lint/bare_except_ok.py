@@ -1,0 +1,10 @@
+"""Fixture: typed excepts (DL006 must stay quiet)."""
+
+
+def parse(payload):
+    try:
+        return int(payload)
+    except ValueError:
+        return None
+    except Exception:
+        return None

@@ -1,0 +1,9 @@
+"""Fixture: blocking calls on the event loop (DL001 must fire)."""
+import subprocess
+import time
+
+
+async def refresh_loop():
+    while True:
+        time.sleep(0.5)  # VIOLATION: parks the whole event loop
+        subprocess.run(["true"])  # VIOLATION: blocks until the child exits

@@ -1,0 +1,14 @@
+"""Fixture: the clean counterparts (DL001 must stay quiet)."""
+import asyncio
+import time
+
+
+def blocking_io():
+    # sync def: runs on whatever thread calls it, not the loop
+    time.sleep(0.5)
+
+
+async def refresh_loop():
+    while True:
+        await asyncio.sleep(0.5)
+        await asyncio.get_running_loop().run_in_executor(None, blocking_io)

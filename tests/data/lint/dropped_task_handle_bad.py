@@ -1,0 +1,10 @@
+"""Fixture: fire-and-forget task with no handle (DL002 must fire)."""
+import asyncio
+
+
+async def pump():
+    await asyncio.sleep(0)
+
+
+async def start():
+    asyncio.create_task(pump())  # VIOLATION: handle dropped, GC may cancel

@@ -1,0 +1,12 @@
+"""Fixture: task handles kept (DL002 must stay quiet)."""
+import asyncio
+
+
+async def pump():
+    await asyncio.sleep(0)
+
+
+async def start():
+    task = asyncio.create_task(pump())  # assigned: strong reference held
+    tasks = [asyncio.create_task(pump()) for _ in range(2)]  # registered
+    await asyncio.gather(task, *tasks)  # used as an argument

@@ -1,0 +1,23 @@
+"""Fixture: host-device syncs inside jit paths (DL004 must fire)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def decode_step(tokens, width):
+    probs = jnp.ones((width,))
+    top = probs.item()  # VIOLATION: device->host sync at trace time
+    host = np.asarray(probs)  # VIOLATION: materializes on host
+    return top, host
+
+
+def step(tokens):
+    out = tokens + 1
+    out.block_until_ready()  # VIOLATION: step is jit-compiled below
+    return out
+
+
+_step_fn = jax.jit(step, donate_argnums=(0,))

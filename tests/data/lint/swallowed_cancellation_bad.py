@@ -1,0 +1,17 @@
+"""Fixture: cancellation absorbed by a handler (DL003 must fire)."""
+import asyncio
+
+
+async def worker(queue):
+    try:
+        while True:
+            await queue.get()
+    except (ConnectionError, asyncio.CancelledError):  # VIOLATION
+        pass
+
+
+async def reaper(child):
+    try:
+        await child
+    except BaseException:  # VIOLATION: catches CancelledError, no re-raise
+        return None

@@ -1,0 +1,19 @@
+"""Fixture: cancellation re-raised (DL003 must stay quiet)."""
+import asyncio
+
+
+async def worker(queue):
+    try:
+        while True:
+            await queue.get()
+    except asyncio.CancelledError:
+        raise
+    except ConnectionError:
+        pass
+
+
+async def reaper(child):
+    try:
+        await child
+    except BaseException:
+        raise  # observed, then propagated

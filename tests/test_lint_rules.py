@@ -1,0 +1,375 @@
+"""Per-rule dynalint tests: every rule fires on its violating fixture
+and stays quiet on the clean one; suppression comments, config, and the
+CLI exit-code contract are covered here too."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from dynamo_tpu.analysis import (
+    all_rules,
+    format_json,
+    format_text,
+    get_rule,
+    lint_source,
+    unsuppressed,
+)
+
+DATA = Path(__file__).parent / "data" / "lint"
+REPO = Path(__file__).resolve().parents[1]
+
+# (rule name, fixture stem, expected minimum findings in the bad fixture)
+CASES = [
+    ("blocking-call-in-async", "blocking_call_in_async", 2),
+    ("dropped-task-handle", "dropped_task_handle", 1),
+    ("swallowed-cancellation", "swallowed_cancellation", 2),
+    ("host-sync-in-jit-path", "host_sync_in_jit_path", 3),
+    ("await-while-locked", "await_while_locked", 2),
+    ("bare-except", "bare_except", 1),
+]
+
+
+def test_case_table_covers_every_rule():
+    assert {name for name, _, _ in CASES} == {r.name for r in all_rules()}
+
+
+@pytest.mark.pre_merge
+@pytest.mark.parametrize("rule_name,stem,min_hits", CASES)
+def test_rule_fires_on_violating_fixture(rule_name, stem, min_hits):
+    src = (DATA / f"{stem}_bad.py").read_text()
+    findings = lint_source(src, path=f"{stem}_bad.py",
+                           rules=[get_rule(rule_name)])
+    assert len(findings) >= min_hits, format_text(findings)
+    assert all(f.rule == rule_name for f in findings)
+    assert all(not f.suppressed for f in findings)
+    # every violation is marked in the fixture for human readers
+    lines = src.splitlines()
+    for f in findings:
+        assert "VIOLATION" in lines[f.line - 1], (
+            f"finding at unmarked line {f.line}: {lines[f.line - 1]!r}"
+        )
+
+
+@pytest.mark.pre_merge
+@pytest.mark.parametrize("rule_name,stem,min_hits", CASES)
+def test_all_rules_quiet_on_clean_fixture(rule_name, stem, min_hits):
+    # clean fixtures must pass EVERY rule, not just their own: each one
+    # shows the idiomatic replacement pattern, which must itself be clean
+    src = (DATA / f"{stem}_ok.py").read_text()
+    findings = lint_source(src, path=f"{stem}_ok.py")
+    assert findings == [], format_text(findings)
+
+
+@pytest.mark.pre_merge
+def test_suppression_comment_waives_finding():
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # dynalint: disable=blocking-call-in-async\n"
+    )
+    findings = lint_source(src)
+    assert len(findings) == 1 and findings[0].suppressed
+    assert unsuppressed(findings) == []
+
+
+def test_suppression_requires_matching_rule_name():
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # dynalint: disable=bare-except\n"
+    )
+    assert len(unsuppressed(lint_source(src))) == 1
+
+
+def test_disable_all_waives_everything_on_the_line():
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # dynalint: disable=all\n"
+    )
+    assert unsuppressed(lint_source(src)) == []
+
+
+def test_disable_file_waives_whole_file():
+    src = (
+        "# dynalint: disable-file=bare-except\n"
+        "def f():\n"
+        "    try:\n"
+        "        return 1\n"
+        "    except:\n"
+        "        return 0\n"
+        "def g():\n"
+        "    try:\n"
+        "        return 1\n"
+        "    except:\n"
+        "        return 0\n"
+    )
+    findings = lint_source(src)
+    assert len(findings) == 2 and all(f.suppressed for f in findings)
+
+
+def test_suppression_with_ascii_hyphen_justification():
+    # `disable=<rule> - why` (plain hyphen, not em-dash) must not fold
+    # the justification into the rule-name list
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # dynalint: disable=blocking-call-in-async - CLI\n"
+    )
+    assert unsuppressed(lint_source(src)) == []
+
+
+def test_unknown_rule_in_suppression_is_reported():
+    # a typo'd rule name waives nothing; that must be loud, not silent
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # dynalint: disable=blocking-call-in-asink\n"
+    )
+    findings = unsuppressed(lint_source(src))
+    assert {f.rule for f in findings} == {
+        "bad-suppression", "blocking-call-in-async",
+    }
+
+
+def test_nested_locks_yield_one_finding_per_await():
+    src = (
+        "import threading\n"
+        "async def f(s):\n"
+        "    with threading.Lock():\n"
+        "        with threading.Lock():\n"
+        "            await s.flush()\n"
+    )
+    findings = lint_source(src, rules=[get_rule("await-while-locked")])
+    assert len(findings) == 1
+
+
+def test_cli_missing_path_exits_2():
+    out = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.cli.main", "lint",
+         "no/such/dir"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 2, out.stdout + out.stderr
+    assert "no such path" in out.stderr
+
+
+def test_suppression_inside_string_literal_is_inert():
+    # docs/prose quoting the directive must not waive anything
+    src = (
+        'DOC = """example: # dynalint: disable-file=bare-except"""\n'
+        "import time\n"
+        "async def f():\n"
+        '    s = "# dynalint: disable=blocking-call-in-async"\n'
+        "    time.sleep(1)\n"
+        "    try:\n"
+        "        return s\n"
+        "    except:\n"
+        "        pass\n"
+    )
+    live = unsuppressed(lint_source(src))
+    assert {f.rule for f in live} == {"blocking-call-in-async", "bare-except"}
+
+
+def test_taskgroup_create_task_not_flagged():
+    src = (
+        "import asyncio\n"
+        "async def f():\n"
+        "    async with asyncio.TaskGroup() as tg:\n"
+        "        tg.create_task(asyncio.sleep(0))\n"
+        "    loop = asyncio.get_running_loop()\n"
+        "    loop.create_task(asyncio.sleep(0))\n"
+    )
+    findings = lint_source(src, rules=[get_rule("dropped-task-handle")])
+    # the TaskGroup spawn is structured concurrency (group keeps the
+    # reference); the bare loop.create_task is still a dropped handle
+    assert len(findings) == 1 and findings[0].line == 6
+
+
+def test_block_names_are_not_locks():
+    src = (
+        "async def alloc(self):\n"
+        "    with self.free_blocks:\n"
+        "        await self.notify()\n"
+        "    with self.write_lock:\n"
+        "        await self.notify()\n"
+    )
+    findings = lint_source(src, rules=[get_rule("await-while-locked")])
+    assert len(findings) == 1 and findings[0].line == 5
+
+
+def test_config_disable_honored_by_api_entry_point():
+    # `disable` must bind lint_source/lint_paths (the pytest gate), not
+    # just the CLI, or the two gates disagree
+    src = "def f():\n    try:\n        return 1\n    except:\n        pass\n"
+    assert len(lint_source(src)) == 1
+    assert lint_source(src, config={"disable": ["bare-except"]}) == []
+
+
+def test_unqualified_create_task_import_flagged():
+    src = (
+        "from asyncio import create_task\n"
+        "async def f():\n"
+        "    create_task(f())\n"
+    )
+    findings = lint_source(src, rules=[get_rule("dropped-task-handle")])
+    assert len(findings) == 1 and findings[0].line == 3
+
+
+def test_config_anchored_at_lint_path_and_stderr_diagnostics(tmp_path):
+    # config comes from the linted tree (not the cwd), unknown config
+    # keys warn on stderr, and usage errors never pollute stdout
+    proj = tmp_path / "proj"
+    (proj / "pkg").mkdir(parents=True)
+    (proj / "pyproject.toml").write_text(
+        "[tool.dynalint]\ndisable = [\"bare-except\"]\nbogus_key = 1\n"
+    )
+    (proj / "pkg" / "mod.py").write_text(
+        "def f():\n    try:\n        return 1\n    except:\n        pass\n"
+    )
+
+    def run(*argv):
+        return subprocess.run(
+            [sys.executable, "-m", "dynamo_tpu.cli.main", "lint", *argv],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+
+    out = run(str(proj / "pkg"))
+    assert out.returncode == 0, out.stdout + out.stderr  # disable honored
+    assert "bogus_key" in out.stderr and "bogus_key" not in out.stdout
+    bad = run(str(tmp_path / "nope"), "--format", "json")
+    assert bad.returncode == 2 and bad.stdout.strip() == ""
+
+
+def test_loop_create_task_chain_flagged():
+    # the house idiom roots the attribute chain in a Call — must not
+    # slip past the rule
+    src = (
+        "import asyncio\n"
+        "async def f():\n"
+        "    asyncio.get_running_loop().create_task(asyncio.sleep(0))\n"
+    )
+    findings = lint_source(src, rules=[get_rule("dropped-task-handle")])
+    assert len(findings) == 1 and findings[0].line == 3
+
+
+def test_comma_justification_does_not_break_suppression():
+    # natural English after the rule name must not parse as rule names
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # dynalint: disable=blocking-call-in-async, legacy kept\n"
+    )
+    assert unsuppressed(lint_source(src)) == []
+
+
+def test_misplaced_disable_file_is_reported():
+    src = "\n" * 10 + (
+        "# dynalint: disable-file=bare-except\n"
+        "def f():\n"
+        "    try:\n"
+        "        return 1\n"
+        "    except:\n"
+        "        pass\n"
+    )
+    live = unsuppressed(lint_source(src))
+    assert {f.rule for f in live} == {"bad-suppression", "bare-except"}
+    assert any("no effect" in f.message for f in live)
+
+
+def test_raise_in_nested_def_is_not_a_reraise():
+    src = (
+        "import asyncio\n"
+        "async def f(child):\n"
+        "    try:\n"
+        "        await child\n"
+        "    except BaseException:\n"
+        "        def h():\n"
+        "            raise ValueError()\n"
+        "        return h\n"
+    )
+    findings = lint_source(src, rules=[get_rule("swallowed-cancellation")])
+    assert len(findings) == 1
+
+
+def test_async_for_under_thread_lock_flagged():
+    src = (
+        "async def f(s):\n"
+        "    with s._lock:\n"
+        "        async for item in s.watch():\n"
+        "            s.apply(item)\n"
+    )
+    findings = lint_source(src, rules=[get_rule("await-while-locked")])
+    assert len(findings) == 1 and findings[0].line == 3
+
+
+def test_dropped_task_message_names_the_chain():
+    src = (
+        "import asyncio\n"
+        "async def f():\n"
+        "    asyncio.get_running_loop().create_task(asyncio.sleep(0))\n"
+    )
+    (f,) = lint_source(src, rules=[get_rule("dropped-task-handle")])
+    assert "asyncio.get_running_loop().create_task" in f.message
+
+
+def test_include_globs_expand(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("def f():\n    try:\n        return 1\n"
+                                "    except:\n        pass\n")
+    from dynamo_tpu.analysis import iter_files
+
+    assert iter_files([str(tmp_path / "*")]) == [pkg / "mod.py"]
+    findings = lint_source((pkg / "mod.py").read_text())
+    assert len(findings) == 1
+
+
+def test_syntax_error_becomes_parse_finding():
+    findings = lint_source("def broken(:\n")
+    assert len(findings) == 1
+    assert findings[0].code == "DL000" and findings[0].rule == "parse-error"
+
+
+def test_rule_catalog_metadata():
+    rules = all_rules()
+    assert len(rules) == 6
+    codes = [r.code for r in rules]
+    assert codes == sorted(codes) and len(set(codes)) == len(codes)
+    assert all(r.name == r.name.lower() and " " not in r.name for r in rules)
+
+
+def test_json_report_shape():
+    src = "def f():\n    try:\n        return 1\n    except:\n        return 0\n"
+    payload = json.loads(format_json(lint_source(src)))
+    assert payload["summary"]["unsuppressed"] == 1
+    (f,) = payload["findings"]
+    assert f["code"] == "DL006" and f["line"] == 4 and not f["suppressed"]
+
+
+@pytest.mark.pre_merge
+def test_cli_exit_codes_gate_on_findings():
+    # non-zero on a violating file, zero on a clean one: the CI contract
+    def run(*argv):
+        return subprocess.run(
+            [sys.executable, "-m", "dynamo_tpu.cli.main", "lint", *argv],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+    bad = run(str(DATA / "bare_except_bad.py"), "--format", "json")
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert json.loads(bad.stdout)["summary"]["unsuppressed"] >= 1
+    ok = run(str(DATA / "bare_except_ok.py"))
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+
+
+def test_cli_list_rules():
+    out = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.cli.main", "lint", "--list-rules"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0
+    for r in all_rules():
+        assert r.code in out.stdout and r.name in out.stdout
