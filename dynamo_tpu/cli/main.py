@@ -269,6 +269,21 @@ def build_parser() -> argparse.ArgumentParser:
 
     add_lint_parser(sub)
 
+    # observability: `dynamo-tpu trace export` (dynamo_tpu/telemetry)
+    trace = sub.add_parser(
+        "trace", help="span-log tooling (DYN_TRACE_FILE JSONL)"
+    )
+    trace.add_argument("action", choices=["export"],
+                       help="export: JSONL span logs -> Chrome-trace/"
+                            "Perfetto JSON (open in ui.perfetto.dev)")
+    trace.add_argument("files", nargs="+",
+                       help="one or more DYN_TRACE_FILE JSONL logs "
+                            "(one per process in a disaggregated fleet)")
+    trace.add_argument("--output", "-o", default=None,
+                       help="output path (default stdout)")
+    trace.add_argument("--trace-id", default=None,
+                       help="filter to one trace (id prefix is enough)")
+
     models = sub.add_parser("models", help="model registry ctl (≈ llmctl)")
     models.add_argument("action", choices=["list", "register", "remove"])
     models.add_argument("name", nargs="?")
@@ -1436,6 +1451,31 @@ async def cmd_models(args: Any) -> None:
         await client.close()
 
 
+def cmd_trace(args: Any) -> int:
+    """Span-log export (pure file transform: no logging/jax setup)."""
+    from dynamo_tpu.telemetry.export import export_chrome_trace
+
+    # tolerate missing logs: a fleet role that never emitted a span
+    # never creates its DYN_TRACE_FILE — warn and export the rest
+    files = []
+    for path in args.files:
+        if os.path.exists(path):
+            files.append(path)
+        else:
+            print(f"warning: no span log at {path}", file=sys.stderr)
+    if not files:
+        print("error: none of the span logs exist", file=sys.stderr)
+        return 1
+    if args.output:
+        with open(args.output, "w") as f:
+            n = export_chrome_trace(files, f, trace_id=args.trace_id)
+        print(f"exported {n} spans -> {args.output}", file=sys.stderr)
+    else:
+        n = export_chrome_trace(files, sys.stdout, trace_id=args.trace_id)
+        print(f"exported {n} spans", file=sys.stderr)
+    return 0 if n else 1
+
+
 def main(argv: Optional[list[str]] = None) -> None:
     args = build_parser().parse_args(argv)
     if args.command == "lint":
@@ -1443,6 +1483,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         from dynamo_tpu.analysis.cli import cmd_lint
 
         sys.exit(cmd_lint(args))
+    if args.command == "trace":
+        sys.exit(cmd_trace(args))
     init_logging()
     from dynamo_tpu.utils.jaxtools import configure_from_env
 

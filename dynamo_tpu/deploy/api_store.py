@@ -62,9 +62,10 @@ class ApiStore:
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
-        for s in self._runner.sites:
-            self.port = s._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
-            break
+        if self.port == 0:
+            # public API (no aiohttp private internals): the runner
+            # exposes every site's bound (host, port)
+            self.port = self._runner.addresses[0][1]
 
     async def stop(self) -> None:
         if self._runner is not None:

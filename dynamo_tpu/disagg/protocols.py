@@ -12,7 +12,16 @@ because blocks are addressed by chained content hash on both sides.
 from __future__ import annotations
 
 import json
-from dataclasses import asdict, dataclass, field
+from dataclasses import asdict, dataclass, field, fields
+from typing import Optional
+
+
+def _known_fields(cls, data: dict) -> dict:
+    """Drop unknown keys before constructing: queue/store payloads are
+    read by whatever worker version pops them, so a NEWER sender's extra
+    field must not crash an older-schema reader (and vice versa)."""
+    known = {f.name for f in fields(cls)}
+    return {k: v for k, v in data.items() if k in known}
 
 
 @dataclass
@@ -21,13 +30,17 @@ class RemotePrefillRequest:
     token_ids: list[int]
     block_size: int
     transfer_key: str  # store key holding the decode worker's TransferMetadata
+    # trace context ({"trace_id", "span_id"}) so the prefill worker's
+    # spans join the decode request's trace (telemetry/spans.py);
+    # optional: payloads from older workers simply lack it
+    trace: Optional[dict] = None
 
     def to_bytes(self) -> bytes:
         return json.dumps(asdict(self)).encode()
 
     @classmethod
     def from_bytes(cls, raw: bytes) -> "RemotePrefillRequest":
-        return cls(**json.loads(raw.decode()))
+        return cls(**_known_fields(cls, json.loads(raw.decode())))
 
 
 @dataclass
@@ -46,7 +59,7 @@ class DisaggConfig:
 
     @classmethod
     def from_bytes(cls, raw: bytes) -> "DisaggConfig":
-        return cls(**json.loads(raw.decode()))
+        return cls(**_known_fields(cls, json.loads(raw.decode())))
 
 
 def conf_key(namespace: str) -> str:

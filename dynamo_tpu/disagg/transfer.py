@@ -43,6 +43,12 @@ from dynamo_tpu.disagg.protocols import transfer_key
 from dynamo_tpu.kvbm.layout import BlockLayout, resolve_dtype
 from dynamo_tpu.ops.kv_rearrange import cast_packed
 from dynamo_tpu.store.base import Store
+from dynamo_tpu.telemetry import get_tracer
+from dynamo_tpu.telemetry.instruments import (
+    KV_TRANSFER_BLOCKS,
+    KV_TRANSFER_BYTES,
+    KV_TRANSFER_SECONDS,
+)
 
 log = logging.getLogger("dynamo_tpu.disagg.transfer")
 
@@ -191,6 +197,8 @@ class TransferServer:
                 raise ValueError(f"transfer dtype {header['dtype']} not castable")
             dtype = resolve_dtype(header["dtype"])
             payload = await reader.readexactly(int(np.prod(shape)) * dtype.itemsize)
+            KV_TRANSFER_BYTES.labels("recv").inc(len(payload))
+            KV_TRANSFER_BLOCKS.labels("recv").inc(len(hashes))
             packed = cast_packed(
                 np.frombuffer(payload, dtype=dtype).reshape(shape),
                 self._layout.np_dtype,
@@ -282,30 +290,49 @@ class TransferClient:
         connect_timeout_s: float = 5.0,
         head_start: int = 0,
         head_count: Optional[int] = None,
+        trace: Optional[dict] = None,
     ) -> bool:
         """Ship packed blocks to a peer; True on acknowledged delivery.
         ``head_start/head_count`` tag a TP head slice (ops/kv_rearrange);
-        omitted means full heads. Every stage is bounded: a stale or
+        omitted means full heads. ``trace`` links the transfer span into
+        the request's trace. Every stage is bounded: a stale or
         unroutable peer address must not stall the prefill worker."""
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(meta.host, meta.port),
-            timeout=connect_timeout_s,
+        span = get_tracer().span(
+            "kv_transfer.put", parent=trace,
+            attrs={"service": "prefill", "blocks": len(hashes),
+                   "bytes": int(packed.nbytes)},
         )
+        t0 = time.monotonic()
+        ok = False
         try:
-            hdr: dict = {
-                "request_id": request_id,
-                "hashes": [int(h) for h in hashes],
-                "dtype": packed.dtype.name,
-                "shape": list(packed.shape),
-            }
-            if head_count is not None:
-                hdr["head_start"] = head_start
-                hdr["head_count"] = head_count
-            header = json.dumps(hdr).encode()
-            writer.write(len(header).to_bytes(4, "big") + header)
-            writer.write(packed.tobytes())
-            await asyncio.wait_for(writer.drain(), timeout=timeout_s)
-            line = await asyncio.wait_for(reader.readline(), timeout=timeout_s)
-            return bool(json.loads(line.decode()).get("ok"))
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(meta.host, meta.port),
+                timeout=connect_timeout_s,
+            )
+            try:
+                hdr: dict = {
+                    "request_id": request_id,
+                    "hashes": [int(h) for h in hashes],
+                    "dtype": packed.dtype.name,
+                    "shape": list(packed.shape),
+                }
+                if head_count is not None:
+                    hdr["head_start"] = head_start
+                    hdr["head_count"] = head_count
+                header = json.dumps(hdr).encode()
+                writer.write(len(header).to_bytes(4, "big") + header)
+                writer.write(packed.tobytes())
+                await asyncio.wait_for(writer.drain(), timeout=timeout_s)
+                line = await asyncio.wait_for(reader.readline(), timeout=timeout_s)
+                ok = bool(json.loads(line.decode()).get("ok"))
+                return ok
+            finally:
+                writer.close()
         finally:
-            writer.close()
+            KV_TRANSFER_SECONDS.labels("send").observe(time.monotonic() - t0)
+            if ok:
+                KV_TRANSFER_BYTES.labels("send").inc(int(packed.nbytes))
+                KV_TRANSFER_BLOCKS.labels("send").inc(len(hashes))
+            else:
+                span.set_attr("error", "rejected-or-failed")
+            span.end()

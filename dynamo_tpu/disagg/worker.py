@@ -22,7 +22,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Any, AsyncIterator
+import time
+from typing import Any, AsyncIterator, Optional
 
 from dynamo_tpu.disagg.prefill_queue import PrefillQueue
 from dynamo_tpu.disagg.protocols import (
@@ -37,6 +38,13 @@ from dynamo_tpu.kvbm import BlockLayout
 from dynamo_tpu.protocols.common import PreprocessedRequest
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
 from dynamo_tpu.store.base import Store
+from dynamo_tpu.telemetry import get_tracer, propagation_context
+from dynamo_tpu.telemetry.instruments import (
+    DISAGG_LOCAL_FALLBACKS,
+    DISAGG_REMOTE_PREFILLS,
+    PREFILL_QUEUE_DEPTH,
+    PREFILL_QUEUE_WAIT,
+)
 from dynamo_tpu.tokens import TokenBlockSequence
 
 log = logging.getLogger("dynamo_tpu.disagg.worker")
@@ -98,7 +106,9 @@ class DisaggDecodeEngine(AsyncEngine):
         )
         return cls(engine, store, namespace, router, server, key)
 
-    async def _maybe_remote_prefill(self, request: PreprocessedRequest) -> None:
+    async def _maybe_remote_prefill(
+        self, request: PreprocessedRequest, context: Optional[Context] = None
+    ) -> None:
         conf = self.router.conf
         if not conf.enabled:
             return
@@ -121,33 +131,55 @@ class DisaggDecodeEngine(AsyncEngine):
             )
             return
         depth = await self.queue.depth()
+        PREFILL_QUEUE_DEPTH.set(depth)
         if not self.router.should_prefill_remote(prefill_len, depth):
             return
         self.remote_prefills += 1
+        DISAGG_REMOTE_PREFILLS.inc()
         rid = request.request_id
-        done = self.server.completion_event(rid)
-        await self.queue.enqueue(
-            RemotePrefillRequest(
-                request_id=rid,
-                token_ids=list(request.token_ids),
-                block_size=bs,
-                transfer_key=self.my_transfer_key,
-            )
+        # enqueue-to-KV-landed wait: the span the "where did TTFT go?"
+        # question is usually answered by
+        span = get_tracer().span(
+            "prefill_queue.wait", parent=context,
+            attrs={"service": "decode", "prefill_tokens": prefill_len,
+                   "queue_depth": depth},
         )
+        t0 = time.monotonic()
+        # the finally must cover the enqueue too: a store failure there
+        # would otherwise leak the completion-event entry, the span,
+        # and the queue-wait observation
+        done = self.server.completion_event(rid)
         try:
+            await self.queue.enqueue(
+                RemotePrefillRequest(
+                    request_id=rid,
+                    token_ids=list(request.token_ids),
+                    block_size=bs,
+                    transfer_key=self.my_transfer_key,
+                    # our span when tracing here, else the inbound
+                    # context (incl. a head's negative sampling mark)
+                    # passed through verbatim — telemetry/spans.py
+                    # propagation_context owns the rules
+                    trace=propagation_context(span, context),
+                )
+            )
             await asyncio.wait_for(
                 done.wait(), timeout=self.router.conf.transfer_timeout_s
             )
         except asyncio.TimeoutError:
             self.local_fallbacks += 1
+            DISAGG_LOCAL_FALLBACKS.inc()
+            span.set_attr("timeout_fallback", True)
             log.warning("remote prefill %s timed out; prefilling locally", rid)
         finally:
+            PREFILL_QUEUE_WAIT.observe(time.monotonic() - t0)
+            span.end()
             self.server.discard_completion(rid)
 
     async def _gen(self, request: Any, context: Context) -> AsyncIterator[Any]:
         if not isinstance(request, PreprocessedRequest):
             request = PreprocessedRequest.model_validate(request)
-        await self._maybe_remote_prefill(request)
+        await self._maybe_remote_prefill(request, context)
         inner = self.engine.as_async_engine()
         async for item in inner.generate(request, context):
             yield item
@@ -213,40 +245,54 @@ async def _prefill_one(
         raise ValueError(
             f"block_size mismatch: decode {req.block_size} != prefill {bs}"
         )
-    if hasattr(engine, "prefill_export"):
-        # sequence-parallel prefiller (parallel/long_context.py): the
-        # prompt is sharded over an sp mesh and attended with ring/
-        # Ulysses attention — no engine scheduler involved
-        found, packed = await engine.prefill_export(list(req.token_ids))
-    else:
-        # run the prompt with max_tokens=1: computes + content-addresses
-        # the prompt's full blocks in this engine's cache
-        preq = PreprocessedRequest(
-            request_id=f"prefill-{req.request_id}",
-            token_ids=list(req.token_ids),
-            sampling=SamplingOptions(use_greedy=True),
-            stop=StopConditions(max_tokens=1, ignore_eos=True),
-        )
-        adapter = engine.as_async_engine()
-        async for _ in adapter.generate(preq, Context()):
-            pass
-        tokens = TokenBlockSequence(list(req.token_ids), block_size=bs)
-        hashes = tokens.sequence_hashes()[: len(req.token_ids) // bs]
-        found, packed = await engine.export_kv_blocks(hashes)
-    if not found:
-        raise RuntimeError("prefill produced no exportable blocks")
-    meta = await TransferClient.fetch_metadata(store, req.transfer_key)
-    if meta is None:
-        raise RuntimeError(f"no transfer metadata at {req.transfer_key}")
-    # Single-host: export all-gathers full heads over the mesh, so one put
-    # carries the whole block regardless of this worker's TP degree. A
-    # multi-host prefill rank ships only its local slice instead, tagged
-    # head_start/head_count; the decode side assembles (ops/kv_rearrange,
-    # ≈ reference Triton kv_rearrange for prefill-TP ≠ decode-TP).
-    ok = await TransferClient.put(meta, req.request_id, found, packed)
-    if not ok:
-        raise RuntimeError("transfer rejected by decode worker")
-    log.info(
-        "prefilled %s: shipped %d/%d blocks",
-        req.request_id, len(found), len(req.token_ids) // bs,
+    # joins the decode request's trace via the queued trace context
+    span = get_tracer().span(
+        "prefill.remote", parent=req.trace,
+        attrs={"service": "prefill", "prompt_tokens": len(req.token_ids)},
     )
+    # downstream child: engine spans on this worker attach to the
+    # prefill span (the adapter path below builds its own Context)
+    with span:
+        if hasattr(engine, "prefill_export"):
+            # sequence-parallel prefiller (parallel/long_context.py): the
+            # prompt is sharded over an sp mesh and attended with ring/
+            # Ulysses attention — no engine scheduler involved
+            found, packed = await engine.prefill_export(list(req.token_ids))
+        else:
+            # run the prompt with max_tokens=1: computes + content-addresses
+            # the prompt's full blocks in this engine's cache
+            preq = PreprocessedRequest(
+                request_id=f"prefill-{req.request_id}",
+                token_ids=list(req.token_ids),
+                sampling=SamplingOptions(use_greedy=True),
+                stop=StopConditions(max_tokens=1, ignore_eos=True),
+            )
+            ctx = Context()
+            ctx.set_trace(propagation_context(span, req.trace) or {})
+            adapter = engine.as_async_engine()
+            async for _ in adapter.generate(preq, ctx):
+                pass
+            tokens = TokenBlockSequence(list(req.token_ids), block_size=bs)
+            hashes = tokens.sequence_hashes()[: len(req.token_ids) // bs]
+            found, packed = await engine.export_kv_blocks(hashes)
+        if not found:
+            raise RuntimeError("prefill produced no exportable blocks")
+        meta = await TransferClient.fetch_metadata(store, req.transfer_key)
+        if meta is None:
+            raise RuntimeError(f"no transfer metadata at {req.transfer_key}")
+        # Single-host: export all-gathers full heads over the mesh, so one put
+        # carries the whole block regardless of this worker's TP degree. A
+        # multi-host prefill rank ships only its local slice instead, tagged
+        # head_start/head_count; the decode side assembles (ops/kv_rearrange,
+        # ≈ reference Triton kv_rearrange for prefill-TP ≠ decode-TP).
+        ok = await TransferClient.put(
+            meta, req.request_id, found, packed,
+            trace=propagation_context(span, req.trace),
+        )
+        if not ok:
+            raise RuntimeError("transfer rejected by decode worker")
+        span.set_attr("blocks", len(found))
+        log.info(
+            "prefilled %s: shipped %d/%d blocks",
+            req.request_id, len(found), len(req.token_ids) // bs,
+        )

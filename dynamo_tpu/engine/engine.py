@@ -62,9 +62,49 @@ from dynamo_tpu.protocols.common import (
     SamplingOptions,
 )
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
+from dynamo_tpu.telemetry import get_tracer
+from dynamo_tpu.telemetry.instruments import (
+    ENGINE_BATCH_OCCUPANCY,
+    ENGINE_COMPILE_EVENTS,
+    ENGINE_PREWARM_SECONDS,
+    ENGINE_QUEUE_DEPTH,
+    ENGINE_REQUESTS_FINISHED,
+    ENGINE_STEP_SECONDS,
+    ENGINE_TOKENS_GENERATED,
+)
 from dynamo_tpu.tokens import DEFAULT_SALT, TokenBlockSequence
 
 log = logging.getLogger("dynamo_tpu.engine")
+
+# compile-event attribution: "prewarm" while ANY engine's _initialize/
+# _prewarm runs, "serve" otherwise — a serve-phase compile is exactly
+# the mid-serve TTFT stall the static-shape machinery exists to prevent,
+# so it deserves its own counter series. jax.monitoring events carry no
+# engine identity, so a refcount of initializing engines is the closest
+# attribution a multi-engine process allows.
+_initializing_engines = 0
+_compile_listener_registered = False
+
+
+def _register_compile_listener() -> None:
+    """Count XLA compilations via jax.monitoring duration events
+    (best-effort: event names vary across jax versions, so filter on
+    substring; absence of the API degrades to no compile counting)."""
+    global _compile_listener_registered
+    if _compile_listener_registered:
+        return
+    _compile_listener_registered = True
+    try:
+        from jax import monitoring
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            if "compile" in event:
+                phase = "prewarm" if _initializing_engines > 0 else "serve"
+                ENGINE_COMPILE_EVENTS.labels(phase).inc()
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # pragma: no cover — older/newer jax without the API
+        log.debug("jax.monitoring unavailable; compile events not counted")
 
 
 @dataclass
@@ -158,6 +198,15 @@ class JaxEngine:
         return engine
 
     def _initialize(self) -> None:
+        global _initializing_engines
+        _register_compile_listener()
+        _initializing_engines += 1
+        try:
+            self._initialize_inner()
+        finally:
+            _initializing_engines -= 1
+
+    def _initialize_inner(self) -> None:
         from dynamo_tpu.utils.jaxtools import enable_compile_cache
 
         cfg = self.config
@@ -773,6 +822,7 @@ class JaxEngine:
                 data = self._kv_gather(ids)
                 self._kv_scatter(ids, data)
             jax.block_until_ready(self.k_cache)
+        ENGINE_PREWARM_SECONDS.set(time.monotonic() - t0)
         log.info("prewarm done in %.1fs", time.monotonic() - t0)
 
     def _gate_kv_offload(self) -> None:
@@ -1622,6 +1672,12 @@ class JaxEngine:
         self._last_plan = None
         plan = sched.plan()
         self._last_plan = plan  # step-failure attribution (quarantine)
+        # per-step load gauges: two locked float stores per step, noise
+        # next to a device dispatch
+        ENGINE_BATCH_OCCUPANCY.set(
+            sched.num_running / max(1, self.config.max_batch_size)
+        )
+        ENGINE_QUEUE_DEPTH.set(sched.num_waiting)
         if plan.kind == "idle":
             # blocking sleep is deliberate: _one_step executes on the
             # dedicated "jax-engine" thread, never on the event loop
@@ -1640,6 +1696,9 @@ class JaxEngine:
                 t0 = time.monotonic()
                 self._window_pipeline(
                     plan.prefill_batch, plan.decode_seqs, rect=plan.rect
+                )
+                ENGINE_STEP_SECONDS.labels("mixed").observe(
+                    time.monotonic() - t0
                 )
                 self._trace(
                     "mixed", ms=round((time.monotonic() - t0) * 1e3, 1)
@@ -1663,6 +1722,9 @@ class JaxEngine:
         if plan.kind == "decode" and self._multi_step_fn is not None:
             t0 = time.monotonic()
             self._window_pipeline([], seqs)
+            ENGINE_STEP_SECONDS.labels("decode").observe(
+                time.monotonic() - t0
+            )
             self._trace(
                 "window_seq",
                 ms=round((time.monotonic() - t0) * 1e3, 1),
@@ -1680,6 +1742,7 @@ class JaxEngine:
             tops = s_out[2:] if len(s_out) > 2 else None
         else:
             next_tokens = logprobs = tops = None
+        ENGINE_STEP_SECONDS.labels(plan.kind).observe(time.monotonic() - t0)
         self._trace(
             "dispatch_" + plan.kind,
             shape=arrays["tokens"].shape,
@@ -2189,6 +2252,7 @@ class JaxEngine:
         sched = self.scheduler
         assert sched is not None
         sched.append_token(seq, token)
+        ENGINE_TOKENS_GENERATED.inc()
         if seq.emit is not None:
             tl = None
             if top is not None and (seq.request.output.logprobs or 0) > 0:
@@ -2230,6 +2294,8 @@ class JaxEngine:
             finish = sched.should_finish(seq)
             if finish is not None:
                 break
+        if kept_toks:
+            ENGINE_TOKENS_GENERATED.inc(len(kept_toks))
         if kept_toks and seq.emit is not None:
             seq.emit(
                 LLMEngineOutput(
@@ -2243,7 +2309,12 @@ class JaxEngine:
             sched.finish(seq, finish)
 
     def _emit_finish(self, seq: Sequence, reason: FinishReason) -> None:
-        """Scheduler on_finish hook: close the request's output stream."""
+        """Scheduler on_finish hook: close the request's output stream,
+        bump finish counters, and emit the request's engine-side span
+        tree (queue wait → prefill → decode) from the lifecycle stamps
+        the scheduler recorded."""
+        ENGINE_REQUESTS_FINISHED.labels(str(reason.value)).inc()
+        self._emit_lifecycle_spans(seq, reason)
         if seq.emit is not None:
             seq.emit(
                 LLMEngineOutput(
@@ -2254,6 +2325,54 @@ class JaxEngine:
                 )
             )
             seq.emit(None)  # sentinel: stream closed
+
+    def _emit_lifecycle_spans(self, seq: Sequence, reason: FinishReason) -> None:
+        """Record the engine's per-request spans at finish time. Span
+        boundaries come from the scheduler's monotonic stamps, anchored
+        to the submit instant's wall clock so cross-process nesting
+        holds. No-op (two attribute reads) when tracing is disabled."""
+        tracer = get_tracer()
+        if not tracer.enabled or not seq.t_submit:
+            return
+        parent = seq.trace
+        if parent is None:
+            # untraced caller: WE are the trace head — one sampling
+            # decision and ONE minted trace for the request, so its
+            # three spans stay correlated (three independent record()
+            # calls would each sample separately and root a separate
+            # trace)
+            import random
+
+            from dynamo_tpu.telemetry import new_trace_id
+
+            if tracer.sample < 1.0 and random.random() >= tracer.sample:
+                return
+            parent = {"trace_id": new_trace_id(), "span_id": None}
+
+        def wall(mono: float) -> float:
+            return seq.t_submit_wall + (mono - seq.t_submit)
+
+        now = time.monotonic()
+        attrs = {"service": "engine"}
+        if seq.t_admit:
+            tracer.record(
+                "engine.queue_wait", start=seq.t_submit_wall,
+                duration_s=seq.t_admit - seq.t_submit, parent=parent,
+                attrs=attrs,
+            )
+        if seq.t_admit and seq.t_prefill_done:
+            tracer.record(
+                "engine.prefill", start=wall(seq.t_admit),
+                duration_s=seq.t_prefill_done - seq.t_admit, parent=parent,
+                attrs={**attrs, "prompt_tokens": len(seq.request.token_ids),
+                       "cached_tokens": seq.num_cached_prompt},
+            )
+            tracer.record(
+                "engine.decode", start=wall(seq.t_prefill_done),
+                duration_s=now - seq.t_prefill_done, parent=parent,
+                attrs={**attrs, "tokens": seq.generated,
+                       "finish_reason": str(reason.value)},
+            )
 
     def _quarantine_step_failure(self) -> bool:
         """Try to contain a step failure to the requests most likely to
@@ -2383,6 +2502,12 @@ class JaxEngine:
             is_cancelled=lambda: context.is_stopped,
             mm_segments=mm_segments,
         )
+        # lifecycle stamps + trace link: _emit_finish turns these into
+        # engine.{queue_wait,prefill,decode} spans (cheap plain fields
+        # when tracing is off)
+        seq.t_submit = time.monotonic()
+        seq.t_submit_wall = time.time()
+        seq.trace = context.trace_context()
         self._incoming.put(seq)
         self._wake.set()
         return out

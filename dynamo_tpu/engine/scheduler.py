@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import enum
 import logging
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -28,6 +29,10 @@ import numpy as np
 
 from dynamo_tpu.engine.allocator import BlockAllocator, NoBlocksError
 from dynamo_tpu.protocols.common import FinishReason, PreprocessedRequest
+from dynamo_tpu.telemetry.instruments import (
+    ENGINE_PREEMPTIONS,
+    ENGINE_QUEUE_WAIT,
+)
 from dynamo_tpu.tokens import TokenBlockSequence
 
 log = logging.getLogger("dynamo_tpu.engine.scheduler")
@@ -67,6 +72,15 @@ class Sequence:
     # computed once — np.unique over a long prompt must not sit on the
     # per-step host path)
     prompt_unique: Optional[Any] = None
+    # request-lifecycle stamps (telemetry): monotonic except the wall
+    # anchor; the engine emits queue-wait/prefill/decode spans from
+    # these at finish time (engine.py _emit_finish)
+    t_submit: float = 0.0  # engine.submit() (monotonic)
+    t_submit_wall: float = 0.0  # same instant, wall clock
+    t_admit: float = 0.0  # first admission into prefilling
+    t_prefill_done: float = 0.0  # last prompt chunk computed
+    # propagated trace context ({"trace_id", "span_id"}) or None
+    trace: Optional[dict] = None
 
     @property
     def request_id(self) -> str:
@@ -414,6 +428,12 @@ class Scheduler:
             except NoBlocksError:
                 break  # backpressure: try again next step
             self.waiting.popleft()
+            if seq.t_admit == 0.0:
+                # first admission only: a preempted-and-readmitted seq
+                # keeps its original queue-wait measurement
+                seq.t_admit = time.monotonic()
+                if seq.t_submit:
+                    ENGINE_QUEUE_WAIT.observe(seq.t_admit - seq.t_submit)
             seq.block_table = blocks
             seq.num_cached_prompt = cached * self.block_size
             seq.num_computed = seq.num_cached_prompt
@@ -492,6 +512,8 @@ class Scheduler:
         if work.is_last_chunk:
             self.prefilling.remove(seq)
             seq.state = SeqState.RUNNING
+            if seq.t_prefill_done == 0.0:
+                seq.t_prefill_done = time.monotonic()
             self.running.append(seq)
 
     def _seq_lookahead(self, seq: Sequence) -> int:
@@ -704,6 +726,7 @@ class Scheduler:
 
     def _preempt(self, victim: Sequence) -> None:
         self.preemptions += 1
+        ENGINE_PREEMPTIONS.inc()
         log.warning("preempting %s (recompute)", victim.request_id)
         self.running.remove(victim)
         self.allocator.free_sequence(victim.block_table)

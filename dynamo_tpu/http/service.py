@@ -9,6 +9,14 @@ ModelWatcher in discovery.py).
 
 aiohttp replaces axum (fastapi/uvicorn are unavailable in this image and
 aiohttp's raw StreamResponse is lower overhead for SSE anyway).
+
+Observability (ISSUE 2): requests carry an ``X-Request-Id`` (client's,
+or generated) echoed on every response and stamped into log records
+(runtime/logging.py RequestIdFilter) and the request's root span, so
+logs, traces, and client reports join on one id. Metrics moved from
+prometheus_client onto the unified registry (telemetry/instruments.py
+— same metric names); ``/metrics`` renders the whole process registry,
+engine instruments included.
 """
 
 from __future__ import annotations
@@ -18,16 +26,10 @@ import contextlib
 import json
 import logging
 import time
+import uuid
 from typing import Optional
 
 from aiohttp import web
-from prometheus_client import (
-    CONTENT_TYPE_LATEST,
-    Counter,
-    Gauge,
-    Histogram,
-    generate_latest,
-)
 
 from dynamo_tpu.protocols.aggregators import ChatAggregator, CompletionAggregator
 from dynamo_tpu.protocols.openai import (
@@ -38,28 +40,27 @@ from dynamo_tpu.protocols.openai import (
 )
 from dynamo_tpu.protocols.sse import encode_done, encode_sse
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.logging import set_log_request_id
+from dynamo_tpu.telemetry import REGISTRY, get_tracer, propagation_context
+from dynamo_tpu.telemetry.instruments import (
+    HTTP_DURATION,
+    HTTP_INFLIGHT,
+    HTTP_REQUESTS,
+    HTTP_TTFT,
+)
 
 log = logging.getLogger("dynamo_tpu.http")
 
-# -- Prometheus metrics (≈ reference http/service/metrics.rs) ---------------
-REQUEST_COUNTER = Counter(
-    "dynamo_http_requests_total",
-    "Total HTTP LLM requests",
-    ["model", "endpoint", "status"],
-)
-INFLIGHT_GAUGE = Gauge(
-    "dynamo_http_inflight_requests", "In-flight HTTP LLM requests", ["model"]
-)
-DURATION_HISTOGRAM = Histogram(
-    "dynamo_http_request_duration_seconds",
-    "HTTP LLM request duration",
-    ["model", "endpoint"],
-)
-TTFT_HISTOGRAM = Histogram(
-    "dynamo_http_time_to_first_token_seconds",
-    "Time to first streamed token",
-    ["model"],
-)
+REQUEST_ID_HEADER = "X-Request-Id"
+
+
+def _request_id_from(request: web.Request) -> str:
+    """The client's X-Request-Id (sanitized) or a fresh one."""
+    rid = request.headers.get(REQUEST_ID_HEADER, "").strip()
+    if rid:
+        # bounded + printable: the id lands in logs/headers verbatim
+        rid = "".join(c for c in rid[:128] if c.isprintable())
+    return rid or uuid.uuid4().hex
 
 
 class ModelManager:
@@ -146,7 +147,7 @@ class HttpService:
         )
 
     async def _metrics(self, request: web.Request) -> web.Response:
-        return web.Response(body=generate_latest(), content_type=CONTENT_TYPE_LATEST.split(";")[0])
+        return web.Response(text=REGISTRY.render(), content_type="text/plain")
 
     async def _models(self, request: web.Request) -> web.Response:
         return web.json_response(self.models.list_models().model_dump())
@@ -159,48 +160,81 @@ class HttpService:
 
     async def _handle_llm(self, request: web.Request, kind: str) -> web.StreamResponse:
         endpoint = "chat_completions" if kind == "chat" else "completions"
-        try:
-            body = await request.json()
-        except json.JSONDecodeError:
-            return self._error(400, "invalid JSON body", "", endpoint)
-        try:
-            if kind == "chat":
-                req = ChatCompletionRequest.model_validate(body)
-            else:
-                req = CompletionRequest.model_validate(body)
-        except Exception as exc:
-            return self._error(400, f"invalid request: {exc}", "", endpoint)
-
-        model = req.model
-        engines = (
-            self.models.chat_engines if kind == "chat" else self.models.completion_engines
+        rid = _request_id_from(request)
+        # root span of the request's trace: every downstream span
+        # (preprocess, router dispatch, worker, engine, disagg) nests
+        # under this one via the Context's trace ids
+        span = get_tracer().span(
+            "http.request",
+            attrs={"service": "frontend", "endpoint": endpoint,
+                   "request_id": rid},
         )
-        engine = engines.get(model)
-        if engine is None:
-            return self._error(404, f"model {model!r} not found", model, endpoint)
-
-        ctx = Context()
-        start = time.monotonic()
-        INFLIGHT_GAUGE.labels(model).inc()
+        set_log_request_id(rid, span.trace_id or None)
         try:
-            stream = engine.generate(req, ctx)
-            if req.stream:
-                return await self._stream_sse(request, stream, ctx, model, endpoint, start)
-            # aggregate to a single response object
-            agg = ChatAggregator() if kind == "chat" else CompletionAggregator()
-            async for chunk in stream:
-                agg.push(chunk)
-            REQUEST_COUNTER.labels(model, endpoint, "200").inc()
-            DURATION_HISTOGRAM.labels(model, endpoint).observe(time.monotonic() - start)
-            return web.json_response(agg.response().model_dump(exclude_none=True))
-        except asyncio.CancelledError:
-            ctx.kill()
-            raise
-        except Exception as exc:
-            log.exception("engine failure for %s", model)
-            return self._error(500, f"engine error: {exc}", model, endpoint)
+            try:
+                body = await request.json()
+            except json.JSONDecodeError:
+                return self._error(400, "invalid JSON body", "", endpoint, rid)
+            try:
+                if kind == "chat":
+                    req = ChatCompletionRequest.model_validate(body)
+                else:
+                    req = CompletionRequest.model_validate(body)
+            except Exception as exc:
+                return self._error(
+                    400, f"invalid request: {exc}", "", endpoint, rid
+                )
+
+            model = req.model
+            span.set_attr("model", model)
+            engines = (
+                self.models.chat_engines if kind == "chat" else self.models.completion_engines
+            )
+            engine = engines.get(model)
+            if engine is None:
+                return self._error(
+                    404, f"model {model!r} not found", model, endpoint, rid
+                )
+
+            ctx = Context(id=rid)
+            # the head's decision governs the WHOLE trace: a sampled-out
+            # root propagates {"sampled": False} so downstream processes
+            # don't start orphan root traces of their own
+            ctx.set_trace(propagation_context(span) or {})
+            start = time.monotonic()
+            HTTP_INFLIGHT.labels(model).inc()
+            try:
+                stream = engine.generate(req, ctx)
+                if req.stream:
+                    return await self._stream_sse(
+                        request, stream, ctx, model, endpoint, start, rid
+                    )
+                # aggregate to a single response object
+                agg = ChatAggregator() if kind == "chat" else CompletionAggregator()
+                async for chunk in stream:
+                    agg.push(chunk)
+                HTTP_REQUESTS.labels(model, endpoint, "200").inc()
+                HTTP_DURATION.labels(model, endpoint).observe(
+                    time.monotonic() - start
+                )
+                return web.json_response(
+                    agg.response().model_dump(exclude_none=True),
+                    headers={REQUEST_ID_HEADER: rid},
+                )
+            except asyncio.CancelledError:
+                ctx.kill()
+                span.set_attr("status", "499")
+                raise
+            except Exception as exc:
+                log.exception("engine failure for %s", model)
+                return self._error(
+                    500, f"engine error: {exc}", model, endpoint, rid
+                )
+            finally:
+                HTTP_INFLIGHT.labels(model).dec()
         finally:
-            INFLIGHT_GAUGE.labels(model).dec()
+            span.end()
+            set_log_request_id(None)
 
     async def _stream_sse(
         self,
@@ -210,22 +244,23 @@ class HttpService:
         model: str,
         endpoint: str,
         start: float,
+        rid: str = "",
     ) -> web.StreamResponse:
-        resp = web.StreamResponse(
-            status=200,
-            headers={
-                "Content-Type": "text/event-stream",
-                "Cache-Control": "no-cache",
-                "Connection": "keep-alive",
-            },
-        )
+        headers = {
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+        }
+        if rid:
+            headers[REQUEST_ID_HEADER] = rid
+        resp = web.StreamResponse(status=200, headers=headers)
         await resp.prepare(request)
         first = True
         status = "200"
         try:
             async for chunk in stream:
                 if first:
-                    TTFT_HISTOGRAM.labels(model).observe(time.monotonic() - start)
+                    HTTP_TTFT.labels(model).observe(time.monotonic() - start)
                     first = False
                 payload = chunk.model_dump(exclude_none=True) if hasattr(chunk, "model_dump") else chunk
                 await resp.write(encode_sse(payload).encode())
@@ -247,17 +282,21 @@ class HttpService:
             )
             status = "500"
         finally:
-            REQUEST_COUNTER.labels(model, endpoint, status).inc()
-            DURATION_HISTOGRAM.labels(model, endpoint).observe(time.monotonic() - start)
+            HTTP_REQUESTS.labels(model, endpoint, status).inc()
+            HTTP_DURATION.labels(model, endpoint).observe(time.monotonic() - start)
         with contextlib.suppress(ConnectionResetError):
             await resp.write_eof()
         return resp
 
-    def _error(self, status: int, message: str, model: str, endpoint: str) -> web.Response:
-        REQUEST_COUNTER.labels(model, endpoint, str(status)).inc()
+    def _error(
+        self, status: int, message: str, model: str, endpoint: str,
+        rid: str = "",
+    ) -> web.Response:
+        HTTP_REQUESTS.labels(model, endpoint, str(status)).inc()
         return web.json_response(
             {"error": {"message": message, "type": "invalid_request_error"}},
             status=status,
+            headers={REQUEST_ID_HEADER: rid} if rid else None,
         )
 
 

@@ -30,6 +30,10 @@ import numpy as np
 from dynamo_tpu.kvbm.layout import BlockLayout
 from dynamo_tpu.kvbm.pool import TierPool
 from dynamo_tpu.kvbm.storage import DiskBlockStorage, HostBlockStorage
+from dynamo_tpu.telemetry.instruments import (
+    KVBM_OFFLOADED_BLOCKS,
+    KVBM_ONBOARDED_BLOCKS,
+)
 
 log = logging.getLogger("dynamo_tpu.kvbm")
 
@@ -249,6 +253,7 @@ class KvBlockManager:
         packed = self._gather(ids)
         self.host.insert_many(hashes, packed)
         self.stats.offloaded_blocks += len(batch)
+        KVBM_OFFLOADED_BLOCKS.inc(len(batch))
         self._refresh_gauges()
         return len(batch)
 
@@ -350,6 +355,7 @@ class KvBlockManager:
             self.host.insert(h, remote_data[j])
             self.stats.remote_got_blocks += 1
         self.stats.onboarded_blocks += n
+        KVBM_ONBOARDED_BLOCKS.inc(n)
         self._refresh_gauges()
         return n
 

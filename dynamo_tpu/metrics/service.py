@@ -5,6 +5,12 @@ ForwardPassMetrics, aggregate (avg/std load, active blocks/slots),
 serve Prometheus ``/metrics``, and watch KV hit-rate events. Transport
 here: subscribe to the component's ``load_metrics`` subject (same feed
 as router and planner) and the frontend's KV hit-rate events.
+
+Exposition rides the unified telemetry registry (telemetry/metrics.py):
+the gauges below are declared once on a per-service Registry and
+re-populated from a fresh aggregator snapshot at each scrape, so the
+text format (HELP/TYPE pairs, label escaping, series dedup) is produced
+by one implementation shared with the HTTP frontend.
 """
 
 from __future__ import annotations
@@ -19,6 +25,8 @@ from aiohttp import web
 
 from dynamo_tpu.kv_router.scheduler import KvMetricsAggregator
 from dynamo_tpu.runtime.component import Component
+from dynamo_tpu.telemetry.metrics import Registry
+from dynamo_tpu.utils.tasks import spawn
 
 log = logging.getLogger("dynamo_tpu.metrics")
 
@@ -41,6 +49,34 @@ class MetricsService:
         self._overlap_sum = 0.0
         self._runner: Optional[web.AppRunner] = None
         self._hit_task: Optional[asyncio.Task] = None
+        # per-service registry (gauge names ≈ reference
+        # components/metrics/src/lib.rs:339-545)
+        self.registry = Registry()
+        r = self.registry
+        self._g_load_avg = r.gauge(
+            "llm_kv_load_avg", "mean KV cache usage across workers")
+        self._g_load_std = r.gauge(
+            "llm_kv_load_std", "stddev of KV cache usage")
+        self._g_blocks_active = r.gauge(
+            "llm_kv_blocks_active", "total active KV blocks")
+        self._g_blocks_total = r.gauge(
+            "llm_kv_blocks_total", "total KV blocks")
+        self._g_active_slots = r.gauge(
+            "llm_requests_active_slots", "busy request slots")
+        self._g_total_slots = r.gauge(
+            "llm_requests_total_slots", "total request slots")
+        self._g_waiting = r.gauge(
+            "llm_requests_waiting", "queued requests")
+        self._g_workers = r.gauge(
+            "llm_workers_reporting", "workers with fresh metrics")
+        self._g_worker_usage = r.gauge(
+            "llm_worker_kv_cache_usage", "per-worker KV cache usage",
+            labels=("worker",),
+        )
+        self._g_hit_events = r.gauge(
+            "llm_kv_hit_rate_events", "KV hit rate events seen")
+        self._g_avg_hit = r.gauge(
+            "llm_kv_avg_hit_rate", "mean prefix overlap fraction")
 
     async def start(self) -> None:
         sub = await self.component.subscribe("load_metrics")
@@ -56,28 +92,24 @@ class MetricsService:
                 except Exception:
                     log.exception("bad kv-hit-rate payload")
 
-        self._hit_task = asyncio.create_task(pump_hits())
+        # spawn (not bare create_task): a crash in the hit-rate pump is
+        # logged instead of dying silently with hit-rate gauges frozen
+        self._hit_task = spawn(pump_hits(), name="metrics-hit-pump")
         app = web.Application()
         app.router.add_get("/metrics", self._handle_metrics)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
-        for s in self._runner.sites:
-            self.port = s._server.sockets[0].getsockname()[1]
+        if self.port == 0:
+            # public API (no aiohttp private internals): the runner
+            # exposes every site's bound (host, port)
+            self.port = self._runner.addresses[0][1]
         log.info("metrics service on :%d/metrics", self.port)
 
     def render(self) -> str:
-        """Prometheus text exposition (gauge names ≈ reference
-        components/metrics/src/lib.rs:339-545)."""
+        """Prometheus text exposition from a fresh aggregator snapshot."""
         fresh = self.aggregator.fresh_metrics()
-        lines: list[str] = []
-
-        def gauge(name: str, help_: str, value: float, labels: str = "") -> None:
-            lines.append(f"# HELP {name} {help_}")
-            lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name}{labels} {value}")
-
         loads = [m.gpu_cache_usage_perc for m in fresh.values()]
         mean = sum(loads) / len(loads) if loads else 0.0
         std = (
@@ -85,47 +117,35 @@ class MetricsService:
             if loads
             else 0.0
         )
-        gauge("llm_kv_load_avg", "mean KV cache usage across workers", mean)
-        gauge("llm_kv_load_std", "stddev of KV cache usage", std)
-        gauge(
-            "llm_kv_blocks_active",
-            "total active KV blocks",
-            float(sum(m.kv_active_blocks for m in fresh.values())),
+        self._g_load_avg.set(mean)
+        self._g_load_std.set(std)
+        self._g_blocks_active.set(
+            float(sum(m.kv_active_blocks for m in fresh.values()))
         )
-        gauge(
-            "llm_kv_blocks_total",
-            "total KV blocks",
-            float(sum(m.kv_total_blocks for m in fresh.values())),
+        self._g_blocks_total.set(
+            float(sum(m.kv_total_blocks for m in fresh.values()))
         )
-        gauge(
-            "llm_requests_active_slots",
-            "busy request slots",
-            float(sum(m.request_active_slots for m in fresh.values())),
+        self._g_active_slots.set(
+            float(sum(m.request_active_slots for m in fresh.values()))
         )
-        gauge(
-            "llm_requests_total_slots",
-            "total request slots",
-            float(sum(m.request_total_slots for m in fresh.values())),
+        self._g_total_slots.set(
+            float(sum(m.request_total_slots for m in fresh.values()))
         )
-        gauge(
-            "llm_requests_waiting",
-            "queued requests",
-            float(sum(m.num_requests_waiting for m in fresh.values())),
+        self._g_waiting.set(
+            float(sum(m.num_requests_waiting for m in fresh.values()))
         )
-        gauge("llm_workers_reporting", "workers with fresh metrics", float(len(fresh)))
+        self._g_workers.set(float(len(fresh)))
+        # per-worker series re-seed from the snapshot: a worker that
+        # stopped reporting must drop out of the payload, not go stale
+        self._g_worker_usage.clear()
         for wid, m in sorted(fresh.items()):
-            gauge(
-                "llm_worker_kv_cache_usage",
-                "per-worker KV cache usage",
-                m.gpu_cache_usage_perc,
-                labels=f'{{worker="{wid:x}"}}',
-            )
+            self._g_worker_usage.labels(f"{wid:x}").set(m.gpu_cache_usage_perc)
         avg_hit = (
             self._overlap_sum / self._isl_sum if self._isl_sum > 0 else 0.0
         )
-        gauge("llm_kv_hit_rate_events", "KV hit rate events seen", float(self._hit_events))
-        gauge("llm_kv_avg_hit_rate", "mean prefix overlap fraction", avg_hit)
-        return "\n".join(lines) + "\n"
+        self._g_hit_events.set(float(self._hit_events))
+        self._g_avg_hit.set(avg_hit)
+        return self.registry.render()
 
     async def _handle_metrics(self, _req: web.Request) -> web.Response:
         return web.Response(text=self.render(), content_type="text/plain")

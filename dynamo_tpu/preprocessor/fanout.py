@@ -29,7 +29,12 @@ class _ChoiceContext(Context):
     the parent's cancellation (client disconnect kills all choices)."""
 
     def __init__(self, parent: Context):
-        super().__init__(id=parent.id)
+        super().__init__(
+            id=parent.id,
+            trace_id=parent.trace_id,
+            span_id=parent.span_id,
+        )
+        self.trace_sampled = parent.trace_sampled
         self._parent = parent
 
     @property

@@ -104,14 +104,20 @@ class OpenAIPreprocessor(Operator):
         request: Union[ChatCompletionRequest, CompletionRequest],
         context: Context,
     ) -> tuple[PreprocessedRequest, _ReqState]:
-        if isinstance(request, ChatCompletionRequest):
-            pre = self.preprocess_chat(request)
-            kind = "chat"
-        elif isinstance(request, CompletionRequest):
-            pre = self.preprocess_completion(request)
-            kind = "completion"
-        else:
-            raise TypeError(f"unsupported request type {type(request)}")
+        from dynamo_tpu.telemetry import get_tracer
+
+        with get_tracer().span(
+            "preprocess", parent=context, attrs={"service": "frontend"}
+        ) as span:
+            if isinstance(request, ChatCompletionRequest):
+                pre = self.preprocess_chat(request)
+                kind = "chat"
+            elif isinstance(request, CompletionRequest):
+                pre = self.preprocess_completion(request)
+                kind = "completion"
+            else:
+                raise TypeError(f"unsupported request type {type(request)}")
+            span.set_attr("prompt_tokens", len(pre.token_ids))
         # OpenAI semantics: non-streaming responses ALWAYS carry usage;
         # streaming only includes it with stream_options.include_usage
         include_usage = not request.stream or bool(

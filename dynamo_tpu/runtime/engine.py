@@ -19,17 +19,57 @@ Resp = TypeVar("Resp")
 
 
 class Context:
-    """Per-request control: id + cooperative cancellation.
+    """Per-request control: id + cooperative cancellation + trace link.
 
     ``stop`` asks the producer to finish gracefully (emit what it has);
     ``kill`` demands immediate termination (reference: engine.rs
     AsyncEngineContext stop_generating/kill).
+
+    ``trace_id``/``span_id`` carry the request's trace context through
+    component calls (and across the wire — runtime/service.py ships them
+    in the ``ctx`` frame): ``span_id`` is the currently-active parent
+    span downstream spans should attach to. Both stay None when tracing
+    is off, so the fields are pure baggage on the hot path.
     """
 
-    def __init__(self, id: Optional[str] = None):
+    def __init__(
+        self,
+        id: Optional[str] = None,
+        trace_id: Optional[str] = None,
+        span_id: Optional[str] = None,
+    ):
         self.id = id or uuid.uuid4().hex
+        self.trace_id = trace_id
+        self.span_id = span_id
+        # None = no sampling decision seen; False = the trace head
+        # explicitly sampled this request OUT — downstream tracers must
+        # not start fresh roots for it (the mark rides the wire)
+        self.trace_sampled: Optional[bool] = None
         self._stop = asyncio.Event()
         self._kill = asyncio.Event()
+
+    def trace_context(self) -> Optional[dict]:
+        """Propagation dict for the wire / telemetry spans, or None.
+        A negative sampling decision propagates as ``{"sampled": False}``
+        so one head decision governs the whole distributed trace."""
+        if self.trace_sampled is False:
+            return {"sampled": False}
+        if self.trace_id is None:
+            return None
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    def set_trace(self, span: Any) -> None:
+        """Adopt ``span`` (a telemetry Span or trace-context dict) as the
+        parent for downstream work. No-op for null/disabled spans."""
+        ctx = span if isinstance(span, dict) else getattr(
+            span, "trace_context", lambda: None
+        )()
+        if ctx and ctx.get("sampled") is False:
+            self.trace_sampled = False
+        elif ctx and ctx.get("trace_id"):
+            self.trace_id = ctx["trace_id"]
+            self.span_id = ctx.get("span_id")
+            self.trace_sampled = True
 
     def stop_generating(self) -> None:
         self._stop.set()
@@ -51,7 +91,8 @@ class Context:
 
     def child(self) -> "Context":
         """A linked context sharing cancellation with this one."""
-        c = Context(id=self.id)
+        c = Context(id=self.id, trace_id=self.trace_id, span_id=self.span_id)
+        c.trace_sampled = self.trace_sampled
         c._stop = self._stop
         c._kill = self._kill
         return c

@@ -19,12 +19,52 @@ Precedence: explicit args > env vars > config file > defaults.
 
 from __future__ import annotations
 
+import contextvars
 import json
 import logging
 import os
 import sys
 import time
 from typing import Any, Optional
+
+# -- per-request log correlation (ISSUE 2 satellite: logs, traces, and
+# client reports join on one id) --------------------------------------------
+# Set by the HTTP frontend for the lifetime of a request's handler task;
+# contextvars follow the asyncio task, so concurrent requests don't
+# cross-stamp. Records emitted from other threads (e.g. the jax-engine
+# step thread) simply carry no request id.
+_request_id_var: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "dyn_request_id", default=None
+)
+_trace_id_var: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "dyn_trace_id", default=None
+)
+
+
+def set_log_request_id(
+    request_id: Optional[str], trace_id: Optional[str] = None
+) -> None:
+    """Stamp subsequent log records in this task with the request id
+    (and optionally its trace id)."""
+    _request_id_var.set(request_id)
+    _trace_id_var.set(trace_id)
+
+
+def current_log_request_id() -> Optional[str]:
+    return _request_id_var.get()
+
+
+class RequestIdFilter(logging.Filter):
+    """Copies the contextvars onto each record: ``record.request_id`` /
+    ``record.trace_id`` (None when outside a request), plus a preformatted
+    ``record.rid_suffix`` for the plain-text formatter."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        rid = _request_id_var.get()
+        record.request_id = rid
+        record.trace_id = _trace_id_var.get()
+        record.rid_suffix = f" [rid={rid}]" if rid else ""
+        return True
 
 
 def parse_env_filter(spec: str) -> tuple[int, dict[str, int]]:
@@ -68,6 +108,12 @@ class JsonlFormatter(logging.Formatter):
             "target": record.name,
             "message": record.getMessage(),
         }
+        # request/trace correlation (set by RequestIdFilter when the
+        # record was emitted inside a request's task)
+        if getattr(record, "request_id", None):
+            out["request_id"] = record.request_id
+        if getattr(record, "trace_id", None):
+            out["trace_id"] = record.trace_id
         if record.exc_info:
             out["exception"] = self.formatException(record.exc_info)
         return json.dumps(out)
@@ -121,12 +167,13 @@ def init_logging(
         handler = logging.FileHandler(log_file)
     else:
         handler = logging.StreamHandler(sys.stderr)
+    handler.addFilter(RequestIdFilter())
     if jsonl:
         handler.setFormatter(JsonlFormatter(local_tz=local_tz))
     else:
         handler.setFormatter(
             logging.Formatter(
-                "%(asctime)s %(levelname)-7s %(name)s: %(message)s",
+                "%(asctime)s %(levelname)-7s %(name)s: %(message)s%(rid_suffix)s",
                 datefmt="%H:%M:%S",
             )
         )

@@ -67,26 +67,43 @@ class PushRouter(AsyncEngine):
         raise ValueError(f"cannot auto-pick in mode {self.mode}")
 
     async def _gen(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        from dynamo_tpu.telemetry import get_tracer
+
         exclude: set[int] = set()
         last_err: Exception | None = None
-        for _ in range(self.max_attempts):
-            instance_id = await self._pick(request, exclude)
-            try:
-                stream = await self.client.generate_direct(
-                    instance_id, request, context
-                )
-            except (OSError, asyncio.TimeoutError, KeyError) as exc:
-                # worker vanished between discovery and dial: try another
-                log.warning("instance %x unreachable: %s", instance_id, exc)
-                exclude.add(instance_id)
-                last_err = exc
-                continue
-            async for item in stream:
-                yield item
-            return
-        raise RuntimeError(
-            f"all attempts failed for {self.client.endpoint.path}: {last_err}"
+        # one span for the whole routed dispatch (pick + stream); the
+        # worker's own span parents here via the wire's trace context
+        span = get_tracer().span(
+            "router.dispatch", parent=context,
+            attrs={"service": "frontend", "mode": self.mode.value},
         )
+        if span:
+            context = context.child()
+            context.set_trace(span)
+        try:
+            for attempt in range(self.max_attempts):
+                instance_id = await self._pick(request, exclude)
+                try:
+                    stream = await self.client.generate_direct(
+                        instance_id, request, context
+                    )
+                except (OSError, asyncio.TimeoutError, KeyError) as exc:
+                    # worker vanished between discovery and dial: try another
+                    log.warning("instance %x unreachable: %s", instance_id, exc)
+                    exclude.add(instance_id)
+                    last_err = exc
+                    continue
+                span.set_attr("instance", f"{instance_id:x}")
+                if attempt:
+                    span.set_attr("retries", attempt)
+                async for item in stream:
+                    yield item
+                return
+            raise RuntimeError(
+                f"all attempts failed for {self.client.endpoint.path}: {last_err}"
+            )
+        finally:
+            span.end()
 
     def generate(self, request: Any, context: Context) -> EngineStream:
         return self._gen(request, context)

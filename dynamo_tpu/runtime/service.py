@@ -9,7 +9,7 @@ response items stream back on the same connection, multiplexed by stream id.
 This removes the NATS hop and the reverse TCP dial of the reference design.
 
 Wire frames (length-prefixed msgpack, see store/wire.py):
-  caller→worker: {t:"req",  sid, ep, ctx:{id}, p: payload}
+  caller→worker: {t:"req",  sid, ep, ctx:{id, trace_id?, span_id?}, p: payload}
                  {t:"stop", sid} | {t:"kill", sid}
   worker→caller: {t:"item", sid, p} | {t:"err", sid, e} | {t:"fin", sid}
 """
@@ -23,6 +23,7 @@ from typing import Any, AsyncIterator, Optional
 
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 from dynamo_tpu.store.wire import read_frame, shutdown_server, write_frame
+from dynamo_tpu.telemetry import get_tracer, propagation_context
 
 log = logging.getLogger("dynamo_tpu.runtime.service")
 
@@ -78,6 +79,16 @@ class EndpointServer:
 
         async def run_stream(sid: int, ep: str, ctx: Context, payload: Any) -> None:
             self.active_requests += 1
+            # one span per served stream, linked to the caller's trace
+            # context from the wire; downstream engine spans parent here
+            span = get_tracer().span(
+                "worker.generate", parent=ctx,
+                attrs={"service": "worker", "endpoint": ep},
+            )
+            # a real span re-parents downstream work; a NULL span still
+            # propagates the inbound context or, when WE are the head
+            # and sampling dropped the root, the negative mark
+            ctx.set_trace(propagation_context(span, ctx) or {})
             try:
                 engine = self._endpoints.get(ep)
                 if engine is None:
@@ -102,6 +113,9 @@ class EndpointServer:
             except ConnectionError:
                 pass
             finally:
+                if ctx.is_killed:
+                    span.set_attr("killed", True)
+                span.end()
                 self.active_requests -= 1
                 streams.pop(sid, None)
 
@@ -114,7 +128,14 @@ class EndpointServer:
                 t = msg.get("t")
                 if t == "req":
                     sid = msg["sid"]
-                    ctx = Context(id=msg.get("ctx", {}).get("id"))
+                    wire_ctx = msg.get("ctx", {})
+                    ctx = Context(
+                        id=wire_ctx.get("id"),
+                        trace_id=wire_ctx.get("trace_id"),
+                        span_id=wire_ctx.get("span_id"),
+                    )
+                    if wire_ctx.get("sampled") is False:
+                        ctx.trace_sampled = False
                     task = asyncio.get_running_loop().create_task(
                         run_stream(sid, msg["ep"], ctx, msg.get("p"))
                     )
@@ -193,8 +214,18 @@ class EndpointConnection:
         q: asyncio.Queue = asyncio.Queue()
         self._queues[sid] = q
         loop = asyncio.get_running_loop()
+        wire_ctx: dict = {"id": ctx.id}
+        if ctx.trace_sampled is False:
+            # the head's negative sampling decision rides the wire so
+            # downstream tracers stay quiet for this request too
+            wire_ctx["sampled"] = False
+        elif ctx.trace_id is not None:
+            # trace context rides the existing control frame — no extra
+            # hop, and workers join the caller's trace (telemetry/spans.py)
+            wire_ctx["trace_id"] = ctx.trace_id
+            wire_ctx["span_id"] = ctx.span_id
         await self._send(
-            {"t": "req", "sid": sid, "ep": endpoint, "ctx": {"id": ctx.id}, "p": to_wire(payload)}
+            {"t": "req", "sid": sid, "ep": endpoint, "ctx": wire_ctx, "p": to_wire(payload)}
         )
 
         # Cancellation rides the Context, not the consumer: the moment the

@@ -1,0 +1,34 @@
+"""dynamo_tpu.telemetry — dependency-free tracing + metrics.
+
+Two halves (docs/observability.md is the operator-facing guide):
+
+- **Spans** (spans.py): ``get_tracer().span("name", parent=ctx)`` with
+  trace-context propagation over the existing transport. Enabled by
+  ``DYN_TRACE_FILE`` (JSONL); ``dynamo-tpu trace export`` renders
+  Perfetto/chrome://tracing flame graphs (export.py).
+- **Metrics** (metrics.py): one process registry of labeled counters/
+  gauges/histograms with Prometheus text exposition and cardinality
+  guard rails; the serving stack's catalog lives in instruments.py.
+"""
+
+from dynamo_tpu.telemetry.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Metric,
+    Registry,
+    REGISTRY,
+    check_scrape_safety,
+    escape_label_value,
+)
+from dynamo_tpu.telemetry.spans import (  # noqa: F401
+    NULL_SPAN,
+    JsonlSpanExporter,
+    Span,
+    Tracer,
+    get_tracer,
+    new_span_id,
+    new_trace_id,
+    propagation_context,
+    reset_tracer,
+)

@@ -1,0 +1,134 @@
+"""Span-log post-processing: JSONL → Chrome-trace/Perfetto JSON.
+
+``dynamo-tpu trace export`` turns one or more ``DYN_TRACE_FILE`` span
+logs (one per process in a disaggregated fleet) into a Chrome Trace
+Event Format file that chrome://tracing and https://ui.perfetto.dev
+render as a flame graph — a single slow request reads as nested bars:
+http.request → router.dispatch → worker.generate → prefill_queue.wait /
+engine.decode → kv_transfer.put.
+
+Mapping: each trace_id becomes a "process" row (pid), each span a
+complete event ("ph": "X") with microsecond timestamps; the originating
+service (span attr ``service``) becomes the thread name so frontend /
+decode / prefill lanes separate visually. Wall-clock start times keep
+cross-process spans ordered on one machine.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, Optional, TextIO
+
+
+def load_spans(paths: Iterable[str]) -> list[dict]:
+    """Read spans from JSONL files; malformed lines are skipped (a
+    SIGKILL'd process may leave a torn final line)."""
+    spans: list[dict] = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(obj, dict) and obj.get("name"):
+                    spans.append(obj)
+    return spans
+
+
+def build_span_tree(spans: list[dict]) -> dict[str, dict]:
+    """Group spans by trace: {trace_id: {"spans": [...], "roots": [...],
+    "children": {span_id: [child, ...]}}}. Roots are spans whose
+    parent_id is absent or refers to a span not in the log (e.g. a
+    sampled-out upstream)."""
+    traces: dict[str, dict] = {}
+    for s in spans:
+        t = traces.setdefault(
+            s.get("trace_id", ""), {"spans": [], "roots": [], "children": {}}
+        )
+        t["spans"].append(s)
+    for t in traces.values():
+        ids = {s["span_id"] for s in t["spans"] if s.get("span_id")}
+        for s in t["spans"]:
+            parent = s.get("parent_id")
+            if parent and parent in ids:
+                t["children"].setdefault(parent, []).append(s)
+            else:
+                t["roots"].append(s)
+    return traces
+
+
+def to_chrome_trace(spans: list[dict]) -> dict:
+    """Chrome Trace Event Format (JSON object flavor)."""
+    events: list[dict] = []
+    # stable pid per trace, tid per service lane
+    pids: dict[str, int] = {}
+    tids: dict[tuple, int] = {}
+    for s in sorted(spans, key=lambda x: x.get("start", 0.0)):
+        trace_id = s.get("trace_id", "?")
+        pid = pids.setdefault(trace_id, len(pids) + 1)
+        attrs = s.get("attrs") or {}
+        service = str(attrs.get("service", ""))
+        tid = tids.setdefault((trace_id, service), len(tids) + 1)
+        start_us = float(s.get("start", 0.0)) * 1e6
+        dur_us = max(0.0, float(s.get("duration_s") or 0.0)) * 1e6
+        args = dict(attrs)
+        args["span_id"] = s.get("span_id", "")
+        if s.get("parent_id"):
+            args["parent_id"] = s["parent_id"]
+        events.append(
+            {
+                "name": s["name"],
+                "ph": "X",
+                "ts": round(start_us, 1),
+                "dur": round(dur_us, 1),
+                "pid": pid,
+                "tid": tid,
+                "cat": service or "span",
+                "args": args,
+            }
+        )
+    # metadata rows: trace ids as process names, services as thread names
+    for trace_id, pid in pids.items():
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": f"trace {trace_id[:12]}"},
+            }
+        )
+    for (trace_id, service), tid in tids.items():
+        events.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pids[trace_id],
+                "tid": tid,
+                "args": {"name": service or "spans"},
+            }
+        )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(
+    in_paths: Iterable[str],
+    out: TextIO,
+    trace_id: Optional[str] = None,
+) -> int:
+    """Write the Chrome-trace JSON for the given span logs; returns the
+    number of spans exported. ``trace_id`` filters to one request (prefix
+    match, so the first 8-12 chars from a log line are enough)."""
+    spans = load_spans(in_paths)
+    if trace_id:
+        spans = [
+            s for s in spans
+            if str(s.get("trace_id", "")).startswith(trace_id)
+        ]
+    json.dump(to_chrome_trace(spans), out, indent=1)
+    out.write("\n")
+    return len(spans)

@@ -1,0 +1,130 @@
+"""The serving stack's metric catalog — every instrument in one place.
+
+One module so the surface is auditable (docs/observability.md mirrors
+this file) and so the cardinality gate (tests/test_metric_cardinality.py)
+can walk the whole registry by importing one module. Layers import their
+instruments from here; nothing else registers process-global metrics.
+
+Naming: ``dynamo_<layer>_<what>_<unit>`` with Prometheus suffix
+conventions (``_total`` counters, ``_seconds`` histograms). The http
+family keeps the seed's prometheus_client names so dashboards survive
+the migration.
+"""
+
+from __future__ import annotations
+
+from dynamo_tpu.telemetry.metrics import REGISTRY
+
+# -- HTTP frontend (names unchanged from the seed's prometheus_client) ------
+HTTP_REQUESTS = REGISTRY.counter(
+    "dynamo_http_requests_total",
+    "Total HTTP LLM requests",
+    labels=("model", "endpoint", "status"),
+)
+HTTP_INFLIGHT = REGISTRY.gauge(
+    "dynamo_http_inflight_requests",
+    "In-flight HTTP LLM requests",
+    labels=("model",),
+)
+HTTP_DURATION = REGISTRY.histogram(
+    "dynamo_http_request_duration_seconds",
+    "HTTP LLM request duration",
+    labels=("model", "endpoint"),
+)
+HTTP_TTFT = REGISTRY.histogram(
+    "dynamo_http_time_to_first_token_seconds",
+    "Time to first streamed token",
+    labels=("model",),
+)
+
+# -- engine (scheduler + step loop; the instruments ISSUE 2 calls out) ------
+_STEP_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 15.0, 60.0, float("inf"),
+)
+ENGINE_STEP_SECONDS = REGISTRY.histogram(
+    "dynamo_engine_step_seconds",
+    "Engine device-step wall time by step kind",
+    labels=("kind",),  # prefill | decode | mixed | window
+    buckets=_STEP_BUCKETS,
+)
+ENGINE_BATCH_OCCUPANCY = REGISTRY.gauge(
+    "dynamo_engine_batch_occupancy",
+    "Running sequences / max_batch_size (sampled each step)",
+)
+ENGINE_QUEUE_DEPTH = REGISTRY.gauge(
+    "dynamo_engine_queue_depth",
+    "Requests waiting or prefilling (not yet decoding)",
+)
+ENGINE_QUEUE_WAIT = REGISTRY.histogram(
+    "dynamo_engine_queue_wait_seconds",
+    "Submit-to-admission wait (time in the scheduler's waiting queue)",
+    buckets=_STEP_BUCKETS,
+)
+ENGINE_PREEMPTIONS = REGISTRY.counter(
+    "dynamo_engine_preemptions_total",
+    "Recompute preemptions (healthy serving sits at ~0)",
+)
+ENGINE_COMPILE_EVENTS = REGISTRY.counter(
+    "dynamo_engine_compile_events_total",
+    "Step-shape compilations by phase (prewarm vs mid-serve lazy)",
+    labels=("phase",),  # prewarm | serve
+)
+ENGINE_PREWARM_SECONDS = REGISTRY.gauge(
+    "dynamo_engine_prewarm_seconds",
+    "Wall time of the startup AOT prewarm pass",
+)
+ENGINE_REQUESTS_FINISHED = REGISTRY.counter(
+    "dynamo_engine_requests_finished_total",
+    "Sequences finished by reason",
+    labels=("reason",),  # stop | length | cancelled | error | ...
+)
+ENGINE_TOKENS_GENERATED = REGISTRY.counter(
+    "dynamo_engine_tokens_generated_total",
+    "Decoded tokens emitted to request streams",
+)
+
+# -- KV block manager / transfer plane --------------------------------------
+KV_TRANSFER_BYTES = REGISTRY.counter(
+    "dynamo_kv_transfer_bytes_total",
+    "KV block bytes moved over the disagg transfer plane",
+    labels=("direction",),  # send | recv
+)
+KV_TRANSFER_SECONDS = REGISTRY.histogram(
+    "dynamo_kv_transfer_seconds",
+    "Wall time of one KV transfer put (connect to ack)",
+    labels=("direction",),
+    buckets=_STEP_BUCKETS,
+)
+KV_TRANSFER_BLOCKS = REGISTRY.counter(
+    "dynamo_kv_transfer_blocks_total",
+    "KV blocks moved over the disagg transfer plane",
+    labels=("direction",),
+)
+KVBM_OFFLOADED_BLOCKS = REGISTRY.counter(
+    "dynamo_kvbm_offloaded_blocks_total",
+    "Blocks demoted from device HBM into the host tier",
+)
+KVBM_ONBOARDED_BLOCKS = REGISTRY.counter(
+    "dynamo_kvbm_onboarded_blocks_total",
+    "Blocks promoted from offload tiers back into device HBM",
+)
+
+# -- disaggregation (decode-side routing + prefill queue) -------------------
+DISAGG_REMOTE_PREFILLS = REGISTRY.counter(
+    "dynamo_disagg_remote_prefills_total",
+    "Requests routed to a remote prefill worker",
+)
+DISAGG_LOCAL_FALLBACKS = REGISTRY.counter(
+    "dynamo_disagg_local_fallbacks_total",
+    "Remote prefills that timed out and fell back to local prefill",
+)
+PREFILL_QUEUE_DEPTH = REGISTRY.gauge(
+    "dynamo_prefill_queue_depth",
+    "Prefill queue depth observed at the last routing decision",
+)
+PREFILL_QUEUE_WAIT = REGISTRY.histogram(
+    "dynamo_prefill_queue_wait_seconds",
+    "Enqueue-to-KV-landed wait for remote prefills (decode side)",
+    buckets=_STEP_BUCKETS,
+)

@@ -1,0 +1,415 @@
+"""Unified process-local metrics registry with Prometheus exposition.
+
+Replaces the scattered fragments the port grew organically — the
+prometheus_client instruments in http/service.py and the hand-rolled
+``render()`` in metrics/service.py — with ONE dependency-free registry
+(reference: lib/llm/src/http/service/metrics.rs + the metrics component,
+components/metrics/src/lib.rs:339-545).
+
+Instruments: Counter, Gauge, Histogram — all optionally labeled. A
+labeled instrument is a family; each distinct label-value tuple is a
+series created on first touch via ``metric.labels(...)``.
+
+Scrape safety (ISSUE 2 satellite: the metrics surface must stay
+scrape-safe):
+
+- label NAMES are validated at declaration against a denylist of
+  per-request identifiers (labeling by request id would grow one series
+  per request until the scrape payload OOMs the scraper);
+- series counts are bounded at runtime (``max_series``): past the bound
+  new label combinations collapse into a single ``{<label>="_overflow"}``
+  series with one warning, so a cardinality bug degrades metrics instead
+  of memory;
+- ``check_scrape_safety()`` walks a registry and raises on violations —
+  the pytest gate (tests/test_metric_cardinality.py) runs it over every
+  instrument the serving stack declares.
+
+Thread safety: instruments are touched from the asyncio loop AND the
+dedicated jax-engine thread; all mutation happens behind per-series
+locks (observations are tiny — dict lookup + float adds).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+from typing import Iterable, Optional, Sequence
+
+log = logging.getLogger("dynamo_tpu.telemetry")
+
+# Label names that would key a series per request/trace — unbounded
+# cardinality by construction. Declaration-time error, not a runtime one.
+FORBIDDEN_LABEL_NAMES = frozenset(
+    {"request_id", "trace_id", "span_id", "session_id", "uuid", "id"}
+)
+
+DEFAULT_MAX_SERIES = 512
+OVERFLOW_LABEL_VALUE = "_overflow"
+
+# prometheus_client's default buckets: keeps the http histograms'
+# exposition shape identical to what the seed emitted.
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.25, 0.5, 0.75,
+    1.0, 2.5, 5.0, 7.5, 10.0, float("inf"),
+)
+
+_METRIC_NAME_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:"
+)
+
+
+def escape_label_value(value: str) -> str:
+    """Prometheus text-format label escaping: backslash, quote, newline."""
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def format_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def format_le(v: float) -> str:
+    """Bucket-bound label values keep prometheus_client's formatting
+    (``le="1.0"``, never ``le="1"``): the le string is part of series
+    IDENTITY, so changing it would orphan every existing dashboard
+    series across the migration."""
+    if v == math.inf:
+        return "+Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return f"{int(v)}.0"
+    return repr(float(v))
+
+
+class _Series:
+    """One sample cell (counter/gauge)."""
+
+    __slots__ = ("value", "lock")
+
+    def __init__(self) -> None:
+        self.value = 0.0
+        self.lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self.lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def set(self, value: float) -> None:
+        with self.lock:
+            self.value = float(value)
+
+
+class _HistogramSeries:
+    __slots__ = ("buckets", "counts", "sum", "count", "lock")
+
+    def __init__(self, buckets: tuple):
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)
+        self.sum = 0.0
+        self.count = 0
+        self.lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self.lock:
+            self.sum += value
+            self.count += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self.counts[i] += 1
+                    break
+
+
+class Metric:
+    """Base family: name, help, label names, series map. Unlabeled
+    metrics expose the series verbs (inc/set/observe) directly."""
+
+    type: str = "untyped"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labels: Sequence[str] = (),
+        max_series: int = DEFAULT_MAX_SERIES,
+    ):
+        if not name or not set(name) <= _METRIC_NAME_OK or name[0].isdigit():
+            raise ValueError(f"invalid metric name {name!r}")
+        if not help:
+            raise ValueError(f"metric {name} needs help text")
+        bad = set(labels) & FORBIDDEN_LABEL_NAMES
+        if bad:
+            raise ValueError(
+                f"metric {name}: label(s) {sorted(bad)} key a series per "
+                f"request — unbounded cardinality; put the id on the SPAN, "
+                f"not the metric"
+            )
+        if len(set(labels)) != len(labels):
+            raise ValueError(f"metric {name}: duplicate label names")
+        self.name = name
+        self.help = help
+        self.label_names = tuple(labels)
+        self.max_series = max_series
+        self._series: dict[tuple, object] = {}
+        self._lock = threading.Lock()
+        self._overflowed = False
+
+    def _new_series(self):  # pragma: no cover — subclasses override
+        raise NotImplementedError
+
+    def labels(self, *values, **kw):
+        """The series for one label-value combination (created on first
+        touch; collapses into the overflow series past ``max_series``)."""
+        if kw:
+            if values:
+                raise ValueError("pass labels positionally OR by name")
+            try:
+                values = tuple(kw[n] for n in self.label_names)
+            except KeyError as e:
+                raise ValueError(f"metric {self.name}: missing label {e}")
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"metric {self.name} expects labels {self.label_names}, "
+                f"got {values!r}"
+            )
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                if key != () and len(self._series) >= self.max_series:
+                    if not self._overflowed:
+                        self._overflowed = True
+                        log.warning(
+                            "metric %s exceeded %d series; collapsing new "
+                            "label combinations into %r",
+                            self.name, self.max_series, OVERFLOW_LABEL_VALUE,
+                        )
+                    key = tuple(
+                        OVERFLOW_LABEL_VALUE for _ in self.label_names
+                    )
+                    series = self._series.get(key)
+                    if series is not None:
+                        return series
+                series = self._new_series()
+                self._series[key] = series
+            return series
+
+    def clear(self) -> None:
+        """Drop every series (aggregation services re-populate per
+        scrape from a fresh snapshot)."""
+        with self._lock:
+            self._series.clear()
+            self._overflowed = False
+
+    @property
+    def num_series(self) -> int:
+        return len(self._series)
+
+    # -- exposition --------------------------------------------------------
+    def render(self) -> list[str]:
+        lines = [
+            f"# HELP {self.name} {escape_help(self.help)}",
+            f"# TYPE {self.name} {self.type}",
+        ]
+        with self._lock:
+            items = sorted(self._series.items())
+        for key, series in items:
+            lines.extend(self._render_series(key, series))
+        return lines
+
+    def _label_str(self, key: tuple, extra: str = "") -> str:
+        parts = [
+            f'{n}="{escape_label_value(v)}"'
+            for n, v in zip(self.label_names, key)
+        ]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def _render_series(self, key, series) -> list[str]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    type = "counter"
+
+    def _new_series(self) -> _Series:
+        return _Series()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)
+
+    def _render_series(self, key, series) -> list[str]:
+        return [
+            f"{self.name}{self._label_str(key)} {format_value(series.value)}"
+        ]
+
+
+class Gauge(Metric):
+    type = "gauge"
+
+    def _new_series(self) -> _Series:
+        return _Series()
+
+    def set(self, value: float) -> None:
+        self.labels().set(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.labels().dec(amount)
+
+    def _render_series(self, key, series) -> list[str]:
+        return [
+            f"{self.name}{self._label_str(key)} {format_value(series.value)}"
+        ]
+
+
+class Histogram(Metric):
+    type = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labels: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+        max_series: int = DEFAULT_MAX_SERIES,
+    ):
+        super().__init__(name, help, labels, max_series)
+        bs = sorted(set(float(b) for b in buckets))
+        if not bs or bs[-1] != math.inf:
+            bs.append(math.inf)
+        self.buckets = tuple(bs)
+
+    def _new_series(self) -> _HistogramSeries:
+        return _HistogramSeries(self.buckets)
+
+    def observe(self, value: float) -> None:
+        self.labels().observe(value)
+
+    def _render_series(self, key, series) -> list[str]:
+        # snapshot under the series lock: a concurrent observe() from
+        # the jax-engine thread mid-render would otherwise emit an
+        # exposition where the +Inf bucket != _count (strict scrapers
+        # — and tests/prom_parser.py — reject that)
+        with series.lock:
+            counts = list(series.counts)
+            total = series.count
+            sum_ = series.sum
+        lines = []
+        cum = 0
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            le = f'le="{format_le(b)}"'
+            lines.append(
+                f"{self.name}_bucket{self._label_str(key, le)} {cum}"
+            )
+        lines.append(
+            f"{self.name}_sum{self._label_str(key)} {format_value(sum_)}"
+        )
+        lines.append(f"{self.name}_count{self._label_str(key)} {total}")
+        return lines
+
+
+class Registry:
+    """A set of metric families rendered as one Prometheus payload."""
+
+    def __init__(self) -> None:
+        self._metrics: dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: Metric) -> Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                if type(existing) is not type(metric) or (
+                    existing.label_names != metric.label_names
+                ):
+                    raise ValueError(
+                        f"metric {metric.name} re-registered with a "
+                        f"different type/labels"
+                    )
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    # get-or-create helpers (idempotent: module reloads in tests must
+    # not raise on duplicate names)
+    def counter(self, name: str, help: str, labels: Sequence[str] = (),
+                max_series: int = DEFAULT_MAX_SERIES) -> Counter:
+        return self.register(Counter(name, help, labels, max_series))  # type: ignore[return-value]
+
+    def gauge(self, name: str, help: str, labels: Sequence[str] = (),
+              max_series: int = DEFAULT_MAX_SERIES) -> Gauge:
+        return self.register(Gauge(name, help, labels, max_series))  # type: ignore[return-value]
+
+    def histogram(
+        self, name: str, help: str, labels: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+        max_series: int = DEFAULT_MAX_SERIES,
+    ) -> Histogram:
+        return self.register(Histogram(name, help, labels, buckets, max_series))  # type: ignore[return-value]
+
+    def get(self, name: str) -> Optional[Metric]:
+        return self._metrics.get(name)
+
+    def metrics(self) -> list[Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: list[str] = []
+        for metric in sorted(self.metrics(), key=lambda m: m.name):
+            lines.extend(metric.render())
+        return "\n".join(lines) + "\n"
+
+
+def check_scrape_safety(
+    registry: Registry,
+    extra_forbidden: Iterable[str] = (),
+    max_series: int = 10_000,
+) -> None:
+    """Raise ValueError if any registered metric could produce an
+    unbounded scrape payload. Construction already rejects forbidden
+    label names; this re-walks a live registry (catching metrics built
+    around the constructor, config drift, absurd max_series) so a test
+    gate can hold the line."""
+    forbidden = FORBIDDEN_LABEL_NAMES | set(extra_forbidden)
+    problems: list[str] = []
+    for m in registry.metrics():
+        bad = set(m.label_names) & forbidden
+        if bad:
+            problems.append(f"{m.name}: forbidden label(s) {sorted(bad)}")
+        if m.label_names and m.max_series > max_series:
+            problems.append(
+                f"{m.name}: max_series={m.max_series} exceeds the "
+                f"scrape-safety bound {max_series}"
+            )
+        if not m.help:
+            problems.append(f"{m.name}: missing help text")
+    if problems:
+        raise ValueError(
+            "metrics registry is not scrape-safe:\n  "
+            + "\n  ".join(problems)
+        )
+
+
+# -- the process registry ---------------------------------------------------
+REGISTRY = Registry()

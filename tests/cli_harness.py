@@ -43,11 +43,13 @@ class CliFleet:
     def procs(self) -> list[subprocess.Popen]:
         return [p for p, _ in self._fleet if p is not None]
 
-    def spawn(self, *args: str) -> subprocess.Popen:
+    def spawn(self, *args: str, env: dict | None = None) -> subprocess.Popen:
+        """``env`` adds/overrides variables on top of the shared ENV
+        (e.g. a per-process DYN_TRACE_FILE)."""
         logf = tempfile.TemporaryFile()
         proc = subprocess.Popen(
             [sys.executable, "-m", "dynamo_tpu.cli.main", *args],
-            env=ENV, stdout=logf, stderr=subprocess.STDOUT,
+            env={**ENV, **(env or {})}, stdout=logf, stderr=subprocess.STDOUT,
         )
         self._fleet.append((proc, logf))
         return proc

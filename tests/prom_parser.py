@@ -1,0 +1,220 @@
+"""Strict Prometheus text-exposition parser for tests.
+
+Validates the invariants scrapers rely on (ISSUE 2 satellite: every
+/metrics payload must be well-formed):
+
+- each family has exactly one ``# HELP`` and one ``# TYPE`` line, HELP
+  first, both before any of its samples, and families are contiguous;
+- sample names match the family (histograms may add ``_bucket``/
+  ``_sum``/``_count``);
+- label strings parse under the escaping rules (backslash, quote,
+  newline) with no duplicate label names;
+- no duplicate series (same sample name + label set twice);
+- histogram series: cumulative bucket counts are monotonic, the +Inf
+  bucket equals ``_count``, and all three sample kinds are present.
+
+``parse(text)`` returns {family: Family} or raises ValueError.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Family:
+    name: str
+    help: str
+    type: str
+    # series key: (sample_name, tuple(sorted(label items)))
+    samples: dict = field(default_factory=dict)
+
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>[^ ]+)$"
+)
+_LABEL_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\.)*)"'
+)
+
+
+def _parse_labels(raw: str) -> dict:
+    labels: dict = {}
+    rest = raw
+    while rest:
+        m = _LABEL_RE.match(rest)
+        if not m:
+            raise ValueError(f"malformed label segment: {rest!r}")
+        name = m.group("name")
+        if name in labels:
+            raise ValueError(f"duplicate label name {name!r}")
+        value = m.group("value")
+        # unescape: \\ \" \n — anything else escaped is invalid
+        out = []
+        i = 0
+        while i < len(value):
+            c = value[i]
+            if c == "\\":
+                i += 1
+                if i >= len(value):
+                    raise ValueError(f"dangling escape in {value!r}")
+                nxt = value[i]
+                if nxt == "n":
+                    out.append("\n")
+                elif nxt in ("\\", '"'):
+                    out.append(nxt)
+                else:
+                    raise ValueError(f"invalid escape \\{nxt} in {value!r}")
+            else:
+                out.append(c)
+            i += 1
+        labels[name] = "".join(out)
+        rest = rest[m.end():]
+        if rest.startswith(","):
+            rest = rest[1:]
+        elif rest:
+            raise ValueError(f"junk after label: {rest!r}")
+    return labels
+
+
+def _parse_value(raw: str) -> float:
+    if raw == "+Inf":
+        return math.inf
+    if raw == "-Inf":
+        return -math.inf
+    if raw == "NaN":
+        return math.nan
+    return float(raw)
+
+
+def _family_of(sample_name: str, families: dict) -> "Family | None":
+    if sample_name in families:
+        return families[sample_name]
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix):
+            base = sample_name[: -len(suffix)]
+            fam = families.get(base)
+            if fam is not None and fam.type == "histogram":
+                return fam
+    return None
+
+
+def parse(text: str) -> dict:
+    families: dict[str, Family] = {}
+    current: Family | None = None
+    pending_help: tuple | None = None  # (name, help) awaiting TYPE
+    closed: set[str] = set()  # families that may not reappear
+
+    for lineno, line in enumerate(text.split("\n"), 1):
+        if not line:
+            continue
+        try:
+            if line.startswith("# HELP "):
+                parts = line[len("# HELP "):].split(" ", 1)
+                if len(parts) != 2 or not parts[0]:
+                    raise ValueError("malformed HELP line")
+                name, help_text = parts
+                if name in families:
+                    raise ValueError(f"duplicate HELP for {name}")
+                if pending_help is not None:
+                    raise ValueError(
+                        f"HELP for {name} while {pending_help[0]} has no TYPE"
+                    )
+                if current is not None:
+                    closed.add(current.name)
+                    current = None
+                pending_help = (name, help_text)
+            elif line.startswith("# TYPE "):
+                parts = line[len("# TYPE "):].split(" ")
+                if len(parts) != 2:
+                    raise ValueError("malformed TYPE line")
+                name, type_ = parts
+                if type_ not in ("counter", "gauge", "histogram",
+                                 "summary", "untyped"):
+                    raise ValueError(f"unknown metric type {type_!r}")
+                if pending_help is None or pending_help[0] != name:
+                    raise ValueError(f"TYPE for {name} without HELP first")
+                if name in closed or name in families:
+                    raise ValueError(f"family {name} re-opened")
+                current = Family(name=name, help=pending_help[1], type=type_)
+                families[name] = current
+                pending_help = None
+            elif line.startswith("#"):
+                continue  # comment
+            else:
+                m = _SAMPLE_RE.match(line)
+                if not m:
+                    raise ValueError("malformed sample line")
+                sname = m.group("name")
+                fam = _family_of(sname, families)
+                if fam is None:
+                    raise ValueError(f"sample {sname} has no HELP/TYPE")
+                if current is None or fam is not current:
+                    raise ValueError(
+                        f"sample {sname} outside its family block "
+                        f"(families must be contiguous)"
+                    )
+                labels = _parse_labels(m.group("labels") or "")
+                value = _parse_value(m.group("value"))
+                key = (sname, tuple(sorted(labels.items())))
+                if key in fam.samples:
+                    raise ValueError(f"duplicate series {key}")
+                fam.samples[key] = value
+        except ValueError as e:
+            raise ValueError(f"line {lineno}: {e} :: {line!r}") from None
+
+    if pending_help is not None:
+        raise ValueError(f"HELP for {pending_help[0]} without TYPE")
+    _check_histograms(families)
+    return families
+
+
+def _check_histograms(families: dict) -> None:
+    for fam in families.values():
+        if fam.type != "histogram":
+            continue
+        # group by non-le label set
+        series: dict[tuple, dict] = {}
+        for (sname, labels), value in fam.samples.items():
+            base_labels = tuple(kv for kv in labels if kv[0] != "le")
+            entry = series.setdefault(
+                base_labels, {"buckets": [], "sum": None, "count": None}
+            )
+            if sname == fam.name + "_bucket":
+                le = dict(labels).get("le")
+                if le is None:
+                    raise ValueError(f"{fam.name}: bucket without le label")
+                entry["buckets"].append((_parse_value(le), value))
+            elif sname == fam.name + "_sum":
+                entry["sum"] = value
+            elif sname == fam.name + "_count":
+                entry["count"] = value
+            else:
+                raise ValueError(
+                    f"{fam.name}: unexpected histogram sample {sname}"
+                )
+        for base_labels, entry in series.items():
+            if entry["sum"] is None or entry["count"] is None:
+                raise ValueError(
+                    f"{fam.name}{dict(base_labels)}: missing _sum/_count"
+                )
+            buckets = sorted(entry["buckets"])
+            if not buckets or buckets[-1][0] != math.inf:
+                raise ValueError(
+                    f"{fam.name}{dict(base_labels)}: no +Inf bucket"
+                )
+            counts = [c for _, c in buckets]
+            if any(b > a for a, b in zip(counts[1:], counts)):
+                raise ValueError(
+                    f"{fam.name}{dict(base_labels)}: bucket counts not "
+                    f"cumulative"
+                )
+            if counts[-1] != entry["count"]:
+                raise ValueError(
+                    f"{fam.name}{dict(base_labels)}: +Inf bucket "
+                    f"{counts[-1]} != count {entry['count']}"
+                )

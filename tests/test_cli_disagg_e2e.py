@@ -5,17 +5,36 @@ max-local-prefill-length exercises queue → prefill engine → KV transfer
 → host-tier onboarding → decode (the flagship path of SURVEY.md §3.3);
 with random weights the assertions are structural (finish_reason and
 usage counts), plus a short-prompt local-prefill request, and liveness
-of every process afterwards."""
+of every process afterwards.
+
+Tracing (ISSUE 2 acceptance): every process runs with its own
+DYN_TRACE_FILE; afterwards the merged span logs must contain ONE
+connected trace for the long request — frontend root → router →
+worker → prefill-queue wait → remote prefill → KV transfer → decode —
+with every child span's wall-clock window nested inside the root
+request span."""
 
 import json
+import os
 import time
 
 from cli_harness import MODEL_DIR, CliFleet, complete, free_port, wait_http
 
 
-def test_disagg_serving_end_to_end():
+def _load_spans(paths):
+    from dynamo_tpu.telemetry.export import build_span_tree, load_spans
+
+    spans = load_spans([p for p in paths if os.path.exists(p)])
+    return spans, build_span_tree(spans)
+
+
+def test_disagg_serving_end_to_end(tmp_path):
     store_port = free_port()
     http_port = free_port()
+    trace_files = {
+        role: str(tmp_path / f"{role}.jsonl")
+        for role in ("frontend", "decode", "prefill")
+    }
     fleet = CliFleet()
     try:
         fleet.spawn("store", "--host", "127.0.0.1", "--port", str(store_port))
@@ -28,16 +47,19 @@ def test_disagg_serving_end_to_end():
             "--max-local-prefill-length", "24",
             "--host-kv-blocks", "64",
             *common,
+            env={"DYN_TRACE_FILE": trace_files["decode"]},
         )
         fleet.spawn(
             "run", "--role", "prefill", "--out", "jax",
             "--model-path", MODEL_DIR, "--namespace", "e2e",
             *common,
+            env={"DYN_TRACE_FILE": trace_files["prefill"]},
         )
         fleet.spawn(
             "run", "--in", "http", "--out", "dyn://e2e.backend.generate",
             "--model-path", MODEL_DIR, "--http-port", str(http_port),
             *common,
+            env={"DYN_TRACE_FILE": trace_files["frontend"]},
         )
         wait_http(
             f"http://127.0.0.1:{http_port}/v1/models",
@@ -54,3 +76,109 @@ def test_disagg_serving_end_to_end():
         fleet.assert_alive()
     finally:
         fleet.teardown()
+
+    # ---- exported trace: one connected tree across three processes ------
+    spans, traces = _load_spans(trace_files.values())
+    assert spans, "no spans exported despite DYN_TRACE_FILE"
+    by_name_global = {}
+    for s in spans:
+        by_name_global.setdefault(s["name"], []).append(s)
+
+    # the long request's trace is the one that crossed the prefill queue
+    queue_waits = by_name_global.get("prefill_queue.wait") or []
+    assert queue_waits, "remote-prefill path produced no queue-wait span"
+    trace_id = queue_waits[0]["trace_id"]
+    trace = traces[trace_id]
+    names = {s["name"] for s in trace["spans"]}
+    # timeout fallback (transfer slower than transfer_timeout_s under CI
+    # load): the decode worker prefilled locally and the prefill
+    # worker's subtree may be incomplete/straggling — those spans are
+    # then optional and exempt from nesting, everything else still holds
+    fallback = bool(queue_waits[0]["attrs"].get("timeout_fallback"))
+    required = {
+        "http.request",        # frontend root
+        "preprocess",          # frontend tokenize
+        "router.dispatch",     # frontend -> worker routing
+        "worker.generate",     # decode worker endpoint stream
+        "prefill_queue.wait",  # decode-side enqueue-to-KV-landed wait
+        "engine.prefill",      # decode engine phases
+        "engine.decode",
+    }
+    prefill_side = {"prefill.remote", "kv_transfer.put"}
+    if not fallback:
+        required |= prefill_side  # prefill worker's compute + shipment
+    assert required <= names, f"missing spans: {required - names}"
+
+    by_id = {s["span_id"]: s for s in trace["spans"]}
+
+    def one(name):
+        matches = [s for s in trace["spans"] if s["name"] == name]
+        assert matches, name
+        return matches[0]
+
+    root = one("http.request")
+    assert "parent_id" not in root or root["parent_id"] is None
+    assert root["attrs"]["request_id"]
+
+    # parent links: each hop chains into the previous one
+    assert one("router.dispatch")["parent_id"] == root["span_id"]
+    assert by_id[one("worker.generate")["span_id"]]["parent_id"] == (
+        one("router.dispatch")["span_id"]
+    )
+    assert one("prefill_queue.wait")["parent_id"] == (
+        one("worker.generate")["span_id"]
+    )
+    if "prefill.remote" in names:
+        assert one("prefill.remote")["parent_id"] == (
+            one("prefill_queue.wait")["span_id"]
+        )
+    if "kv_transfer.put" in names:
+        assert one("kv_transfer.put")["parent_id"] == (
+            one("prefill.remote")["span_id"]
+        )
+    # decode-worker engine spans parent on the worker stream span
+    decode_engines = [
+        s for s in trace["spans"]
+        if s["name"] == "engine.decode"
+        and s.get("parent_id") == one("worker.generate")["span_id"]
+    ]
+    assert decode_engines, "decode engine span not linked to the worker span"
+
+    # nesting: every child's wall-clock window sits inside the root
+    # request span (same machine — one system clock; small epsilon for
+    # write-time jitter). On timeout fallback the prefill worker's
+    # subtree (prefill.remote and descendants) legitimately outlives
+    # the request — exclude exactly that subtree then.
+    stragglers: set = set()
+    if fallback and "prefill.remote" in names:
+        frontier = {one("prefill.remote")["span_id"]}
+        while frontier:
+            stragglers |= frontier
+            frontier = {
+                s["span_id"] for s in trace["spans"]
+                if s.get("parent_id") in frontier
+                and s["span_id"] not in stragglers
+            }
+    eps = 0.25
+    r0 = root["start"]
+    r1 = root["start"] + root["duration_s"]
+    for s in trace["spans"]:
+        if s["span_id"] == root["span_id"] or s["span_id"] in stragglers:
+            continue
+        s0 = s["start"]
+        s1 = s["start"] + (s["duration_s"] or 0.0)
+        assert s0 >= r0 - eps, f"{s['name']} starts before the root span"
+        assert s1 <= r1 + eps, f"{s['name']} ends after the root span"
+
+    # the short request produced a second, disjoint trace with NO
+    # queue-wait span (local prefill)
+    local_traces = [
+        t for tid, t in traces.items()
+        if tid != trace_id
+        and any(s["name"] == "http.request" for s in t["spans"])
+    ]
+    assert local_traces, "short request produced no trace"
+    assert all(
+        "prefill_queue.wait" not in {s["name"] for s in t["spans"]}
+        for t in local_traces
+    )

@@ -1,0 +1,66 @@
+"""Cardinality gate (ISSUE 2 satellite): walk every metric the serving
+stack registers and fail the build if the surface could become
+scrape-unsafe — per-request identifier labels, absurd series bounds, or
+missing help text. Importing the layer modules below is what populates
+the process registry, so a new instrument anywhere in the stack is
+automatically in scope."""
+
+import importlib
+
+import pytest
+
+from dynamo_tpu.telemetry import REGISTRY, check_scrape_safety
+from dynamo_tpu.telemetry.metrics import (
+    DEFAULT_MAX_SERIES,
+    FORBIDDEN_LABEL_NAMES,
+    Registry,
+)
+
+# every module that declares or touches process-global instruments
+_INSTRUMENTED_MODULES = [
+    "dynamo_tpu.telemetry.instruments",
+    "dynamo_tpu.http.service",
+    "dynamo_tpu.metrics.service",
+    "dynamo_tpu.disagg.worker",
+    "dynamo_tpu.disagg.transfer",
+    "dynamo_tpu.engine.scheduler",
+    "dynamo_tpu.kvbm.manager",
+]
+
+
+def _load_all() -> None:
+    for mod in _INSTRUMENTED_MODULES:
+        importlib.import_module(mod)
+
+
+def test_process_registry_is_scrape_safe():
+    _load_all()
+    check_scrape_safety(REGISTRY)
+
+
+def test_every_instrument_has_bounded_labels():
+    _load_all()
+    for m in REGISTRY.metrics():
+        # denylist enforced at declaration; belt-and-braces here
+        assert not (set(m.label_names) & FORBIDDEN_LABEL_NAMES), m.name
+        assert m.max_series <= DEFAULT_MAX_SERIES, (
+            f"{m.name}: raise the gate bound deliberately if a metric "
+            f"really needs more than {DEFAULT_MAX_SERIES} series"
+        )
+        assert m.help, m.name
+
+
+def test_metrics_service_registry_is_scrape_safe():
+    """The aggregation service builds a per-instance registry; its
+    declarations must pass the same gate (constructed without a
+    component — declaration happens in __init__ before any I/O)."""
+    from dynamo_tpu.metrics.service import MetricsService
+
+    svc = MetricsService(component=None, host="127.0.0.1", port=0)  # type: ignore[arg-type]
+    check_scrape_safety(svc.registry)
+
+
+def test_gate_catches_a_request_id_label():
+    r = Registry()
+    with pytest.raises(ValueError):
+        r.counter("bad_total", "h", labels=("request_id",))

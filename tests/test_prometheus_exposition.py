@@ -1,0 +1,202 @@
+"""Prometheus exposition correctness for every /metrics surface
+(ISSUE 2 satellite): the strict text-format parser in prom_parser.py
+validates HELP/TYPE pairing, label escaping, series dedup, and
+histogram invariants against REAL payloads served over HTTP by both
+the OpenAI frontend and the metrics aggregation service."""
+
+import asyncio
+import json
+from typing import Any, AsyncIterator
+
+import aiohttp
+
+from prom_parser import parse
+
+from dynamo_tpu.http.service import HttpService, ModelManager
+from dynamo_tpu.protocols.common import FinishReason
+from dynamo_tpu.protocols.openai import ChatCompletionRequest, ChatDeltaGenerator
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
+
+
+class TinyEngine(AsyncEngine):
+    async def _gen(self, request: Any, ctx: Context) -> AsyncIterator[Any]:
+        gen = ChatDeltaGenerator(model=request.model)
+        yield gen.text_chunk("hi ")
+        yield gen.finish_chunk(FinishReason.STOP)
+
+    def generate(self, request: Any, context: Context) -> EngineStream:
+        return self._gen(request, context)
+
+
+# unique model/404 names: the process registry is shared suite-wide, so
+# assertions must scope to THIS test's label values
+MODEL = "prom-expo-m"
+MISSING = "prom-expo-nope"
+
+
+async def _serve() -> tuple[HttpService, str]:
+    manager = ModelManager()
+    manager.add_chat_model(MODEL, TinyEngine())
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    return service, f"http://127.0.0.1:{service.port}"
+
+
+async def test_http_frontend_metrics_payload_well_formed():
+    service, base = await _serve()
+    try:
+        async with aiohttp.ClientSession() as s:
+            payload = {
+                "model": MODEL,
+                "messages": [{"role": "user", "content": "x"}],
+            }
+            # drive every instrument: success, 404, streaming TTFT
+            async with s.post(f"{base}/v1/chat/completions", json=payload) as r:
+                assert r.status == 200
+            async with s.post(
+                f"{base}/v1/chat/completions",
+                json={**payload, "model": MISSING},
+            ) as r:
+                assert r.status == 404
+            async with s.post(
+                f"{base}/v1/chat/completions", json={**payload, "stream": True}
+            ) as r:
+                assert r.status == 200
+                await r.read()
+            async with s.get(f"{base}/metrics") as r:
+                assert r.status == 200
+                text = await r.text()
+        families = parse(text)  # raises on any malformation
+        reqs = families["dynamo_http_requests_total"]
+        assert reqs.type == "counter"
+        by_status = {
+            dict(k[1])["status"]: v for k, v in reqs.samples.items()
+            if dict(k[1])["model"] in (MODEL, MISSING)
+        }
+        assert by_status.get("404") == 1
+        assert families["dynamo_http_request_duration_seconds"].type == "histogram"
+        # TTFT observed exactly once for this model (the streaming request)
+        ttft = families["dynamo_http_time_to_first_token_seconds"]
+        counts = [
+            v for (name, labels), v in ttft.samples.items()
+            if name.endswith("_count") and dict(labels)["model"] == MODEL
+        ]
+        assert counts == [1]
+        # engine instruments are declared in the same registry and render
+        # HELP/TYPE even with no series — still a valid payload
+        assert "dynamo_engine_step_seconds" in families
+    finally:
+        await service.stop()
+
+
+async def test_http_request_id_echoed_and_generated():
+    """Satellite: X-Request-Id propagates (client's) or is generated."""
+    service, base = await _serve()
+    try:
+        async with aiohttp.ClientSession() as s:
+            payload = {
+                "model": "m",
+                "messages": [{"role": "user", "content": "x"}],
+            }
+            async with s.post(
+                f"{base}/v1/chat/completions", json=payload,
+                headers={"X-Request-Id": "client-rid-42"},
+            ) as r:
+                assert r.headers["X-Request-Id"] == "client-rid-42"
+            async with s.post(f"{base}/v1/chat/completions", json=payload) as r:
+                generated = r.headers["X-Request-Id"]
+                assert len(generated) == 32  # uuid4 hex
+            # errors echo it too
+            async with s.post(
+                f"{base}/v1/chat/completions",
+                json={**payload, "model": "nope"},
+                headers={"X-Request-Id": "rid-err"},
+            ) as r:
+                assert r.status == 404
+                assert r.headers["X-Request-Id"] == "rid-err"
+            # streaming responses carry the header on the SSE response
+            async with s.post(
+                f"{base}/v1/chat/completions",
+                json={**payload, "stream": True},
+                headers={"X-Request-Id": "rid-sse"},
+            ) as r:
+                assert r.headers["X-Request-Id"] == "rid-sse"
+                await r.read()
+    finally:
+        await service.stop()
+
+
+async def test_metrics_service_payload_well_formed():
+    from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.metrics.service import MetricsService
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+    from dynamo_tpu.store.memory import MemoryStore
+    from dynamo_tpu.store.server import StoreServer
+
+    server = StoreServer(MemoryStore(), port=0)
+    await server.start()
+    drt = await DistributedRuntime.create(
+        config=RuntimeConfig(store_port=server.port, worker_host="127.0.0.1")
+    )
+    comp = drt.namespace("promns").component("backend")
+    svc = MetricsService(comp, host="127.0.0.1", port=0)
+    await svc.start()
+    try:
+        # two workers, one with a label-escaping-hostile id is impossible
+        # (ids are ints), so exercise the multi-series path instead
+        for wid, usage in ((0xAB, 0.5), (0xCD, 0.25)):
+            svc.aggregator.update(
+                ForwardPassMetrics(
+                    worker_id=wid, gpu_cache_usage_perc=usage,
+                    kv_active_blocks=4, kv_total_blocks=8,
+                    request_active_slots=1, request_total_slots=2,
+                )
+            )
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{svc.port}/metrics") as r:
+                assert r.status == 200
+                text = await r.text()
+        families = parse(text)
+        workers = families["llm_worker_kv_cache_usage"]
+        assert {dict(k[1])["worker"] for k in workers.samples} == {"ab", "cd"}
+        assert families["llm_kv_blocks_active"].samples[
+            ("llm_kv_blocks_active", ())
+        ] == 8.0
+        # a worker aging out of the snapshot drops from the payload
+        svc.aggregator.metrics.clear()
+        families2 = parse(svc.render())
+        assert not families2["llm_worker_kv_cache_usage"].samples
+    finally:
+        await svc.close()
+        await drt.shutdown()
+        await server.stop()
+
+
+def test_parser_rejects_malformed_payloads():
+    import pytest
+
+    # samples before TYPE
+    with pytest.raises(ValueError):
+        parse("x_total 1\n# HELP x_total h\n# TYPE x_total counter\n")
+    # duplicate series
+    with pytest.raises(ValueError, match="duplicate series"):
+        parse(
+            "# HELP x_total h\n# TYPE x_total counter\n"
+            'x_total{a="1"} 1\nx_total{a="1"} 2\n'
+        )
+    # non-contiguous family
+    with pytest.raises(ValueError):
+        parse(
+            "# HELP a h\n# TYPE a gauge\na 1\n"
+            "# HELP b h\n# TYPE b gauge\nb 1\na 2\n"
+        )
+    # bad escape
+    with pytest.raises(ValueError, match="escape"):
+        parse('# HELP x h\n# TYPE x gauge\nx{l="a\\q"} 1\n')
+    # histogram +Inf/count mismatch
+    with pytest.raises(ValueError, match="\\+Inf"):
+        parse(
+            "# HELP h h\n# TYPE h histogram\n"
+            'h_bucket{le="+Inf"} 2\nh_sum 1\nh_count 3\n'
+        )

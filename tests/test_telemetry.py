@@ -1,0 +1,405 @@
+"""Unit tests for dynamo_tpu.telemetry: spans + tracer + context
+propagation, the metrics registry, and the Perfetto/Chrome export."""
+
+import json
+import math
+import threading
+
+import pytest
+
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.telemetry import (
+    NULL_SPAN,
+    JsonlSpanExporter,
+    Registry,
+    Tracer,
+    check_scrape_safety,
+    get_tracer,
+    reset_tracer,
+)
+from dynamo_tpu.telemetry.export import (
+    build_span_tree,
+    load_spans,
+    to_chrome_trace,
+)
+
+
+class ListExporter:
+    def __init__(self):
+        self.spans = []
+
+    def export(self, span):
+        self.spans.append(span)
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+def test_disabled_tracer_returns_null_span():
+    t = Tracer()
+    assert not t.enabled
+    s = t.span("x")
+    assert s is NULL_SPAN
+    s.set_attr("a", 1)  # all no-ops
+    s.end()
+    assert s.trace_context() is None
+    with t.span("y") as s2:
+        assert s2 is NULL_SPAN
+
+
+def test_span_parenting_and_export():
+    t = Tracer()
+    sink = ListExporter()
+    t.add_exporter(sink)
+    with t.span("root", attrs={"service": "frontend"}) as root:
+        with t.span("child", parent=root) as child:
+            child.set_attr("k", "v")
+    assert [s.name for s in sink.spans] == ["child", "root"]
+    c, r = sink.spans
+    assert c.trace_id == r.trace_id
+    assert c.parent_id == r.span_id
+    assert r.parent_id is None
+    assert c.attrs["k"] == "v"
+    assert c.duration_s is not None and c.duration_s >= 0
+
+
+def test_span_parent_from_dict_and_context():
+    t = Tracer()
+    sink = ListExporter()
+    t.add_exporter(sink)
+    s1 = t.span("a", parent={"trace_id": "t1", "span_id": "p1"})
+    s1.end()
+    assert s1.trace_id == "t1" and s1.parent_id == "p1"
+    # runtime Context carries trace ids and acts as a parent
+    ctx = Context(trace_id="t2", span_id="p2")
+    s2 = t.span("b", parent=ctx)
+    s2.end()
+    assert s2.trace_id == "t2" and s2.parent_id == "p2"
+    # and adopts a span as its trace
+    ctx2 = Context()
+    assert ctx2.trace_context() is None
+    ctx2.set_trace(s2)
+    assert ctx2.trace_id == "t2" and ctx2.span_id == s2.span_id
+    # child() propagates the trace link
+    assert ctx2.child().trace_id == "t2"
+
+
+def test_record_explicit_timestamps():
+    t = Tracer()
+    sink = ListExporter()
+    t.add_exporter(sink)
+    sid = t.record(
+        "engine.decode", start=123.0, duration_s=0.5,
+        parent={"trace_id": "tt", "span_id": "pp"}, attrs={"tokens": 7},
+    )
+    assert sid
+    (s,) = sink.spans
+    assert s.start == 123.0 and s.duration_s == 0.5
+    assert s.trace_id == "tt" and s.parent_id == "pp"
+
+
+def test_sampling_zero_drops_roots_but_keeps_propagated():
+    t = Tracer(sample=0.0)
+    sink = ListExporter()
+    t.add_exporter(sink)
+    assert t.span("root") is NULL_SPAN
+    # inbound context: the head already sampled this trace IN
+    s = t.span("child", parent={"trace_id": "t", "span_id": "p"})
+    assert s is not NULL_SPAN
+    s.end()
+    assert len(sink.spans) == 1
+
+
+def test_negative_sampling_decision_propagates():
+    """A head's sampled-OUT mark must suppress downstream spans — a
+    worker with its own (sample=1.0) tracer must not start orphan
+    roots for a request the frontend dropped."""
+    # head: sampling off
+    head = Tracer(sample=0.0)
+    head_sink = ListExporter()
+    head.add_exporter(head_sink)
+    root = head.span("http.request")
+    assert root is NULL_SPAN
+    ctx = Context()
+    ctx.set_trace(root)  # no-op: NULL carries nothing
+    ctx.trace_sampled = False  # what the frontend sets explicitly
+    assert ctx.trace_context() == {"sampled": False}
+    # the mark survives the wire round-trip and child()
+    assert ctx.child().trace_context() == {"sampled": False}
+    # downstream: fully-sampling tracer stays quiet for this request
+    worker = Tracer(sample=1.0)
+    worker_sink = ListExporter()
+    worker.add_exporter(worker_sink)
+    assert worker.span("worker.generate", parent=ctx) is NULL_SPAN
+    assert worker.record(
+        "engine.decode", start=1.0, duration_s=0.1,
+        parent=ctx.trace_context(),
+    ) is None
+    assert not worker_sink.spans
+    # ...but an untraced request (no decision at all) may still root
+    assert worker.span("worker.generate", parent=Context()) is not NULL_SPAN
+
+
+def test_propagation_context_rules(monkeypatch, tmp_path):
+    """One helper owns the boundary rules: real span wins; NULL span
+    passes the inbound through (incl. a negative mark); NULL span at an
+    enabled head propagates {"sampled": False}; disabled → None."""
+    from dynamo_tpu.telemetry import propagation_context
+
+    reset_tracer()
+    try:
+        # disabled tracer, no inbound: no decision
+        assert propagation_context(NULL_SPAN) is None
+        # disabled tracer, inbound context: passed through verbatim
+        inbound = {"trace_id": "t", "span_id": "p"}
+        assert propagation_context(NULL_SPAN, inbound) == inbound
+        assert propagation_context(NULL_SPAN, {"sampled": False}) == {
+            "sampled": False
+        }
+        ctx = Context(trace_id="t", span_id="p")
+        assert propagation_context(NULL_SPAN, ctx) == inbound
+        # enabled tracer, NULL span, no inbound: we are the head and
+        # sampling dropped the root — negative mark propagates
+        monkeypatch.setenv("DYN_TRACE_FILE", str(tmp_path / "p.jsonl"))
+        reset_tracer()
+        assert propagation_context(NULL_SPAN) == {"sampled": False}
+        # a real span always wins
+        span = get_tracer().span("x")
+        assert propagation_context(span, inbound) == span.trace_context()
+        span.end()
+    finally:
+        reset_tracer()
+
+
+def test_remote_prefill_request_schema_tolerance():
+    """Queue payload compat both ways: old payloads lack `trace`, and a
+    NEWER sender's unknown keys must not crash this reader."""
+    from dynamo_tpu.disagg.protocols import RemotePrefillRequest
+
+    old = json.dumps({
+        "request_id": "r", "token_ids": [1], "block_size": 4,
+        "transfer_key": "k",
+    }).encode()
+    assert RemotePrefillRequest.from_bytes(old).trace is None
+    future = json.dumps({
+        "request_id": "r", "token_ids": [1], "block_size": 4,
+        "transfer_key": "k", "trace": {"sampled": False},
+        "some_future_field": 42,
+    }).encode()
+    req = RemotePrefillRequest.from_bytes(future)
+    assert req.trace == {"sampled": False}
+
+
+def test_choice_fanout_context_keeps_trace():
+    """n>1 per-choice contexts must carry the parent's trace link (and
+    a head's negative sampling mark) through to the engine."""
+    from dynamo_tpu.preprocessor.fanout import _ChoiceContext
+
+    parent = Context(trace_id="t9", span_id="s9")
+    parent.trace_sampled = True
+    child = _ChoiceContext(parent)
+    assert child.trace_context() == {"trace_id": "t9", "span_id": "s9"}
+    dropped = Context()
+    dropped.trace_sampled = False
+    assert _ChoiceContext(dropped).trace_context() == {"sampled": False}
+
+
+def test_exception_inside_span_sets_error_attr():
+    t = Tracer()
+    sink = ListExporter()
+    t.add_exporter(sink)
+    with pytest.raises(RuntimeError):
+        with t.span("boom"):
+            raise RuntimeError("x")
+    assert sink.spans[0].attrs["error"] == "RuntimeError"
+
+
+def test_jsonl_exporter_roundtrip(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    t = Tracer()
+    t.add_exporter(JsonlSpanExporter(path))
+    with t.span("root") as root:
+        t.span("child", parent=root).end()
+    spans = load_spans([path])
+    assert {s["name"] for s in spans} == {"root", "child"}
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["child"]["parent_id"] == by_name["root"]["span_id"]
+
+
+def test_get_tracer_env_wiring(tmp_path, monkeypatch):
+    path = str(tmp_path / "t.jsonl")
+    monkeypatch.setenv("DYN_TRACE_FILE", path)
+    reset_tracer()
+    try:
+        tr = get_tracer()
+        assert tr.enabled
+        tr.span("e").end()
+        assert load_spans([path])[0]["name"] == "e"
+    finally:
+        reset_tracer()
+    monkeypatch.delenv("DYN_TRACE_FILE")
+    reset_tracer()
+    assert not get_tracer().enabled
+    reset_tracer()
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    r = Registry()
+    c = r.counter("t_requests_total", "help", labels=("model",))
+    c.labels("m").inc()
+    c.labels("m").inc(2)
+    g = r.gauge("t_gauge", "help")
+    g.set(3.5)
+    g.inc()
+    h = r.histogram("t_lat_seconds", "help", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(99)
+    text = r.render()
+    assert 't_requests_total{model="m"} 3' in text
+    assert "t_gauge 4.5" in text
+    # le values keep prometheus_client's formatting (series identity):
+    # integral bounds render "1.0", never "1"
+    assert 't_lat_seconds_bucket{le="0.1"} 1' in text
+    assert 't_lat_seconds_bucket{le="1.0"} 2' in text
+    assert 'le="1"}' not in text
+    assert 't_lat_seconds_bucket{le="+Inf"} 3' in text
+    assert "t_lat_seconds_count 3" in text
+    # strict parser accepts our own output
+    from prom_parser import parse
+
+    parse(text)
+
+
+def test_forbidden_label_names_rejected():
+    r = Registry()
+    with pytest.raises(ValueError, match="cardinality"):
+        r.counter("t_bad_total", "help", labels=("request_id",))
+    with pytest.raises(ValueError, match="needs help"):
+        r.counter("t_nohelp_total", "")
+
+
+def test_duplicate_registration_idempotent_but_conflict_raises():
+    r = Registry()
+    a = r.counter("t_x_total", "help", labels=("l",))
+    b = r.counter("t_x_total", "help", labels=("l",))
+    assert a is b
+    with pytest.raises(ValueError, match="re-registered"):
+        r.gauge("t_x_total", "help")
+
+
+def test_label_escaping_renders_and_parses():
+    r = Registry()
+    c = r.counter("t_esc_total", "help", labels=("v",))
+    c.labels('we"ird\\na\nme').inc()
+    text = r.render()
+    from prom_parser import parse
+
+    fams = parse(text)
+    key, = fams["t_esc_total"].samples
+    assert dict(key[1])["v"] == 'we"ird\\na\nme'
+
+
+def test_series_overflow_collapses():
+    r = Registry()
+    c = r.counter("t_of_total", "help", labels=("k",), max_series=4)
+    for i in range(10):
+        c.labels(str(i)).inc()
+    assert c.num_series <= 5  # 4 real + 1 overflow
+    text = r.render()
+    assert "_overflow" in text
+
+
+def test_check_scrape_safety_flags_bad_registry():
+    r = Registry()
+    ok = r.counter("t_fine_total", "help", labels=("model",))
+    ok.labels("m").inc()
+    check_scrape_safety(r)  # passes
+    # sneak a forbidden label past the constructor
+    bad = object.__new__(type(ok))
+    bad.__dict__.update(ok.__dict__)
+    bad.name = "t_smuggled_total"
+    bad.label_names = ("request_id",)
+    r._metrics["t_smuggled_total"] = bad
+    with pytest.raises(ValueError, match="forbidden label"):
+        check_scrape_safety(r)
+
+
+def test_thread_safety_of_counter():
+    r = Registry()
+    c = r.counter("t_mt_total", "help")
+
+    def work():
+        for _ in range(10_000):
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.labels().value == 40_000
+
+
+# ---------------------------------------------------------------------------
+# export
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_export(tmp_path):
+    path = str(tmp_path / "s.jsonl")
+    t = Tracer()
+    t.add_exporter(JsonlSpanExporter(path))
+    with t.span("http.request", attrs={"service": "frontend"}) as root:
+        t.span("engine.decode", parent=root,
+               attrs={"service": "engine"}).end()
+    spans = load_spans([path])
+    tree = build_span_tree(spans)
+    (trace,) = tree.values()
+    assert len(trace["roots"]) == 1
+    assert trace["roots"][0]["name"] == "http.request"
+    chrome = to_chrome_trace(spans)
+    complete = [e for e in chrome["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in complete} == {"http.request", "engine.decode"}
+    for e in complete:
+        assert e["ts"] >= 0 and e["dur"] >= 0
+    # json-serializable end to end
+    json.dumps(chrome)
+
+
+def test_load_spans_skips_torn_lines(tmp_path):
+    p = tmp_path / "torn.jsonl"
+    p.write_text(
+        json.dumps({"name": "a", "trace_id": "t", "span_id": "s",
+                    "start": 1.0, "duration_s": 0.1}) + "\n"
+        + '{"name": "b", "trace'  # torn final line (SIGKILL mid-write)
+    )
+    spans = load_spans([str(p)])
+    assert [s["name"] for s in spans] == ["a"]
+
+
+def test_cli_trace_export(tmp_path, capsys):
+    from dynamo_tpu.cli.main import main
+
+    path = str(tmp_path / "s.jsonl")
+    t = Tracer()
+    t.add_exporter(JsonlSpanExporter(path))
+    t.span("root").end()
+    out = str(tmp_path / "chrome.json")
+    with pytest.raises(SystemExit) as exc:
+        main(["trace", "export", path, "-o", out])
+    assert exc.value.code == 0
+    data = json.loads(open(out).read())
+    assert any(e["name"] == "root" for e in data["traceEvents"])
+
+
+def test_histogram_math_nan_free():
+    r = Registry()
+    h = r.histogram("t_h_seconds", "help", buckets=(1.0,))
+    h.observe(math.inf)  # lands in +Inf bucket, sum becomes inf
+    text = r.render()
+    assert 't_h_seconds_bucket{le="+Inf"} 1' in text
