@@ -20,6 +20,16 @@ DYN_BENCH_MODEL=8b|3.8b (default 8b: R1-Distill-Llama-8B geometry,
 BASELINE.md config 1); DYN_BENCH_KV_DTYPE=bfloat16|int8|float8_e4m3fn
 (default bfloat16 — int8 halves KV bytes/token and is the long-context
 serving default, see benchmarks/RESULTS.md round-5 sections).
+
+``--spec`` switches to the speculative-decoding A/B mode: the same
+workload runs once without and once with speculation (both at
+decode_steps=1 — speculation replaces fused windows), and the JSON line
+reports accept rate, proposed/accepted draft tokens, and out-tok/s for
+both sides (vs_baseline = spec/plain throughput ratio). Knobs:
+DYN_BENCH_SPEC_DRAFTER (default "ngram"), DYN_BENCH_SPEC_TOKENS
+(default 4). Repetitive prompts (the self-drafting sweet spot) via
+DYN_BENCH_SPEC_REPEAT=1 — the default keeps the standard random-prompt
+workload, where the reported accept rate is an honest floor.
 """
 
 from __future__ import annotations
@@ -114,7 +124,7 @@ def _kv_bytes_per_token(mc) -> float:
     return 2 * mc.num_hidden_layers * mc.num_key_value_heads * mc.head_dim * per_elem
 
 
-async def _run(model_cfg, wl) -> dict:
+async def _run(model_cfg, wl, spec: bool = False, decode_steps=None) -> dict:
     if os.environ.get("DYN_STEP_TRACE"):
         # step-trace forensics print via logging.INFO; the bench is a
         # bare script, so wire a handler or the trace silently drops
@@ -148,7 +158,15 @@ async def _run(model_cfg, wl) -> dict:
         # 1389-1450 @ ~640-780 ms at K=32) — per-window fixed costs
         # amortize over twice the tokens. Serving configs tune their own
         # decode_steps (the sweeps run 32).
-        decode_steps=int(os.environ.get("DYN_BENCH_DECODE_STEPS", "64")),
+        decode_steps=(
+            decode_steps
+            if decode_steps is not None
+            else int(os.environ.get("DYN_BENCH_DECODE_STEPS", "64"))
+        ),
+        spec_decode=(
+            os.environ.get("DYN_BENCH_SPEC_DRAFTER", "ngram") if spec else ""
+        ),
+        spec_tokens=int(os.environ.get("DYN_BENCH_SPEC_TOKENS", "4")),
         hbm_utilization=0.7,
     )
     # static serving shapes (EngineConfig.static_shapes, default on)
@@ -162,8 +180,21 @@ async def _run(model_cfg, wl) -> dict:
     rng = np.random.default_rng(0)
     adapter = engine.as_async_engine()
 
+    repeat_prompts = os.environ.get("DYN_BENCH_SPEC_REPEAT") == "1"
+
     async def one_request(i: int) -> tuple[float, float, int]:
-        prompt = rng.integers(1, model_cfg.vocab_size, size=wl["isl"]).tolist()
+        if repeat_prompts:
+            # self-similar prompt (doc-repetition workload): the n-gram
+            # drafter's sweet spot — accept rates here show the ceiling
+            period = max(8, wl["isl"] // 8)
+            unit = rng.integers(
+                1, model_cfg.vocab_size, size=period
+            ).tolist()
+            prompt = (unit * (wl["isl"] // period + 1))[: wl["isl"]]
+        else:
+            prompt = rng.integers(
+                1, model_cfg.vocab_size, size=wl["isl"]
+            ).tolist()
         # unique head: avoid total prefix collapse (mod: warmup ids
         # 9000+ must stay inside the CPU smoke model's tiny vocab)
         prompt[0] = (7 + i) % (model_cfg.vocab_size - 1) + 1
@@ -201,6 +232,8 @@ async def _run(model_cfg, wl) -> dict:
     step_bytes = _param_bytes(model_cfg, wl["quant"]) + wl["batch"] * avg_ctx * _kv_bytes_per_token(model_cfg)
     roofline_tput = wl["batch"] / (step_bytes / HBM_BW_BYTES)
 
+    spec_proposed = engine.spec_proposed_total
+    spec_accepted = engine.spec_accepted_total
     await engine.shutdown()
     return {
         "tput": tput,
@@ -208,7 +241,48 @@ async def _run(model_cfg, wl) -> dict:
         "total_tokens": total_tokens,
         "wall_s": wall,
         "roofline": roofline_tput,
+        "spec_proposed": spec_proposed,
+        "spec_accepted": spec_accepted,
     }
+
+
+def _main_spec_ab(model_cfg, wl) -> None:
+    """--spec: A/B the same workload with and without speculation (both
+    at decode_steps=1) and report accept rate + both throughputs."""
+    base = asyncio.run(_run(model_cfg, wl, spec=False, decode_steps=1))
+    spec = asyncio.run(_run(model_cfg, wl, spec=True, decode_steps=1))
+    proposed, accepted = spec["spec_proposed"], spec["spec_accepted"]
+    out = {
+        "metric": "engine_spec_decode_ab_1chip",
+        "value": round(spec["tput"], 2),
+        "unit": "tokens/sec",
+        # spec vs plain decode on the identical workload: > 1.0 means
+        # speculation converted spare decode FLOPs into tokens/step
+        "vs_baseline": round(spec["tput"] / max(base["tput"], 1e-9), 4),
+        "config": {
+            "model": wl["model_name"],
+            "batch": wl["batch"],
+            "isl": wl["isl"],
+            "osl": wl["osl"],
+            "drafter": os.environ.get("DYN_BENCH_SPEC_DRAFTER", "ngram"),
+            "spec_tokens": int(os.environ.get("DYN_BENCH_SPEC_TOKENS", "4")),
+            "repeat_prompts": os.environ.get("DYN_BENCH_SPEC_REPEAT") == "1",
+            "plain_tok_s": round(base["tput"], 2),
+            "spec_tok_s": round(spec["tput"], 2),
+            "proposed_tokens": proposed,
+            "accepted_tokens": accepted,
+            "accept_rate": round(accepted / proposed, 4) if proposed else 0.0,
+            "p50_ttft_ms_plain": round(base["p50_ttft_s"] * 1000, 1),
+            "p50_ttft_ms_spec": round(spec["p50_ttft_s"] * 1000, 1),
+        },
+    }
+    print(json.dumps(out))
+    print(
+        f"# spec A/B: plain={base['tput']:.1f} spec={spec['tput']:.1f} tok/s "
+        f"accept={out['config']['accept_rate']:.2%} "
+        f"({accepted}/{proposed} drafts)",
+        file=sys.stderr,
+    )
 
 
 def main() -> None:
@@ -218,6 +292,9 @@ def main() -> None:
 
         force_platform("cpu")
     model_cfg, wl = _build_config(cpu_mode)
+    if "--spec" in sys.argv[1:]:
+        _main_spec_ab(model_cfg, wl)
+        return
     r = asyncio.run(_run(model_cfg, wl))
     out = {
         "metric": "engine_decode_throughput_1chip",

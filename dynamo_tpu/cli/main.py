@@ -75,6 +75,15 @@ def build_parser() -> argparse.ArgumentParser:
                      help="fused decode window: tokens per device "
                           "dispatch (amortizes dispatch latency; tokens "
                           "stream in bursts of this size)")
+    run.add_argument("--spec-decode", default="",
+                     help="speculative decoding drafter (needs "
+                          "--decode-steps 1): ngram[:N] = prompt-lookup "
+                          "self-drafting, bigram:PATH = static table; "
+                          "empty disables (docs/speculative_decoding.md)")
+    run.add_argument("--spec-tokens", type=int, default=4,
+                     help="max draft tokens verified per sequence per "
+                          "step (K); each decode step then emits 1..K+1 "
+                          "tokens per sequence")
     run.add_argument("--mixed-prefill-rows", type=int, default=8,
                      help="mixed continuous batching (needs "
                           "--decode-steps > 1): pending prefill chunks "

@@ -115,6 +115,19 @@ class EngineConfig:
     # guards are the prefilling-count (<= wide_rows) and backlog
     # (> narrow len) conditions in scheduler._mixed_rect.
     mixed_wide_max_running: Optional[int] = None
+    # speculative decoding (dynamo_tpu/spec; needs decode_steps == 1 —
+    # fused windows and speculation are competing multi-token-per-
+    # dispatch techniques and do not compose): a dependency-free drafter
+    # proposes up to spec_tokens tokens per sequence per step, one
+    # jitted verify forward scores them all through the paged-KV
+    # attention, and rejection sampling keeps the longest accepted
+    # prefix + 1 fresh token. "" disables; "ngram[:N]" = prompt-lookup
+    # self-drafting, "bigram:PATH" = static table (spec/drafter.py).
+    # Per-request opt-out via PreprocessedRequest.speculative=False
+    # (OpenAI ext.speculative). docs/speculative_decoding.md covers K
+    # tuning and accept-rate interpretation.
+    spec_decode: str = ""
+    spec_tokens: int = 4
     # explicit MID decode bucket override (None = auto: pad/2 when the
     # pad is >= 64). Deployments whose steady population sits well
     # under max_batch_size (e.g. long-context residency caps) can pin
@@ -201,6 +214,8 @@ def load_engine_config(args: Any) -> EngineConfig:
             args, "mixed_wide_max_running",
             EngineConfig.mixed_wide_max_running,
         ),
+        spec_decode=getattr(args, "spec_decode", "") or "",
+        spec_tokens=getattr(args, "spec_tokens", EngineConfig.spec_tokens),
         host_kv_blocks=getattr(args, "host_kv_blocks", 0),
         disk_kv_blocks=getattr(args, "disk_kv_blocks", 0),
         disk_kv_path=getattr(args, "disk_kv_path", ""),

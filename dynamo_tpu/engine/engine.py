@@ -71,6 +71,10 @@ from dynamo_tpu.telemetry.instruments import (
     ENGINE_REQUESTS_FINISHED,
     ENGINE_STEP_SECONDS,
     ENGINE_TOKENS_GENERATED,
+    SPEC_ACCEPT_RATE,
+    SPEC_ACCEPTED_TOKENS,
+    SPEC_PROPOSED_TOKENS,
+    SPEC_STEP_SECONDS,
 )
 from dynamo_tpu.tokens import DEFAULT_SALT, TokenBlockSequence
 
@@ -163,6 +167,14 @@ class JaxEngine:
         # step-failure quarantine (see _quarantine_step_failure)
         self._last_plan: Optional[StepPlan] = None
         self._step_failures = 0
+        # speculative decoding (dynamo_tpu/spec; config.spec_decode)
+        self._drafter = None
+        self._spec_step_fn: Optional[Callable] = None
+        self.spec_proposed_total = 0  # bench/introspection counters
+        self.spec_accepted_total = 0
+        # recent sync=False dispatches whose device errors would DEFER
+        # to a later synced step (_annotate_deferred_error)
+        self._unsynced_steps: list[str] = []
         try:
             self.PIPELINE_DEPTH = max(
                 1, int(os.environ.get("DYN_PIPELINE_DEPTH", "2"))
@@ -210,6 +222,37 @@ class JaxEngine:
         from dynamo_tpu.utils.jaxtools import enable_compile_cache
 
         cfg = self.config
+        if cfg.spec_decode:
+            # speculative decoding composes with neither fused windows
+            # (both are multi-token-per-dispatch techniques competing
+            # for the same step contract) nor the pp/multihost step
+            # protocols (the verify step is a new jit signature the
+            # follower/stage machinery doesn't mirror) — fail LOUDLY at
+            # config time rather than silently serving without it
+            if cfg.decode_steps > 1:
+                raise ValueError(
+                    "spec_decode requires decode_steps == 1 (fused "
+                    "decode windows and speculation do not compose)"
+                )
+            if self._pp > 1:
+                raise ValueError(
+                    "spec_decode is not supported with "
+                    "pipeline_parallel_size > 1"
+                )
+            if cfg.num_nodes > 1:
+                raise ValueError(
+                    "spec_decode is not supported with num_nodes > 1"
+                )
+            if cfg.spec_tokens < 1:
+                raise ValueError(
+                    f"spec_decode needs spec_tokens >= 1 (got "
+                    f"{cfg.spec_tokens}); 0 would silently serve "
+                    "without speculation while compiling a useless "
+                    "verify shape"
+                )
+            from dynamo_tpu.spec import build_drafter
+
+            self._drafter = build_drafter(cfg.spec_decode)
         if cfg.num_nodes > 1:
             # multi-host bring-up (reference: MultiNodeConfig, engines.rs:41)
             jax.distributed.initialize(
@@ -716,6 +759,28 @@ class JaxEngine:
                     )
                     self.k_cache, self.v_cache = out[-2], out[-1]
                     jax.block_until_ready(self.k_cache)
+        if self._spec_step_fn is not None:
+            # speculative verify shapes: one fixed [B, spec_tokens+1]
+            # rectangle per decode bucket (greedy and sampled rows share
+            # the one compiled variant — verify's sampling machinery is
+            # a runtime lax.cond)
+            Ssp = self.config.spec_tokens + 1
+            for Bd in decode_buckets:
+                sa = {
+                    "tokens": np.zeros((Bd, Ssp), np.int32),
+                    "positions": np.zeros((Bd, Ssp), np.int32),
+                    "slot_mapping": np.zeros((Bd * Ssp,), np.int32),
+                    "block_tables": np.zeros((Bd, width), np.int32),
+                    "context_lens": np.zeros((Bd,), np.int32),
+                    "draft_lens": np.zeros((Bd,), np.int32),
+                }
+                packed, self.k_cache, self.v_cache = self._spec_step_fn(
+                    self.params, self.k_cache, self.v_cache,
+                    sa["tokens"], sa["positions"], sa["slot_mapping"],
+                    sa["block_tables"], sa["context_lens"],
+                    sa["draft_lens"], sampling_for(Bd).arrays,
+                )
+                jax.block_until_ready(packed)
         lasts: dict[int, Any] = {}
         p_nexts: dict[int, Any] = {}
         if self._multi_step_fn is not None:
@@ -1312,6 +1377,53 @@ class JaxEngine:
                 jnp.take(last_tok[:, 0], src_idx)[:, None], ns_rep2
             )
 
+        def spec_step(
+            params,
+            k_cache,
+            v_cache,
+            tokens,  # [B, S] carry token + up to S-1 drafts per row
+            positions,  # [B, S] contiguous run from each row's base
+            slot_mapping,  # [B*S] (pads -> garbage slot 0)
+            block_tables,
+            context_lens,  # [B] real tokens incl. drafts
+            draft_lens,  # [B] valid drafts per row
+            sampling,  # SamplingBatch.arrays (base path only)
+        ):
+            """Speculative verify step: ONE forward over the draft run
+            through the paged-KV attention (draft KV is written
+            speculatively — rejected positions are overwritten by the
+            next real append before they can ever be read or
+            content-addressed), then on-device rejection sampling
+            (spec/verify.py). Output rides one packed host transfer:
+            [B, S out_tokens | S out_lps | 1 n_emit]."""
+            from dynamo_tpu.spec.verify import verify_tokens
+
+            logits_all, k_cache, v_cache = forward(
+                mc, params, k_cache, v_cache, tokens, positions,
+                slot_mapping, block_tables, context_lens,
+                jnp.zeros_like(context_lens), bs, logits_all=True,
+            )
+            out_toks, out_lps, n_emit = verify_tokens(
+                logits_all, tokens, draft_lens, sampling
+            )
+            packed = jnp.concatenate(
+                [
+                    out_toks.astype(jnp.float32),  # exact: vocab < 2^24
+                    out_lps,
+                    n_emit[:, None].astype(jnp.float32),
+                ],
+                axis=1,
+            )
+            k_cache, v_cache = pin_caches(k_cache, v_cache)
+            packed = jax.lax.with_sharding_constraint(packed, ns_rep2)
+            return packed, k_cache, v_cache
+
+        self._spec_step_fn = (
+            jax.jit(spec_step, donate_argnums=(1, 2))
+            if self.config.spec_decode
+            else None
+        )
+
         self._multi_step_fn = (
             jax.jit(decode_window, donate_argnums=(1, 2)) if K > 1 else None
         )
@@ -1326,6 +1438,7 @@ class JaxEngine:
         arrays: dict[str, np.ndarray],
         sampling: SamplingBatch,
         sync: bool = True,
+        origin: str = "",
     ):
         """``sync=False`` skips the device->host read of the sampled
         outputs (returns None): a prefill batch with NO last chunks has
@@ -1333,7 +1446,13 @@ class JaxEngine:
         is a full round trip (~200 ms measured) — a 3-chunk ISL-3000
         prompt pays it twice for nothing. The dispatch still happens
         (and still broadcasts under multihost); donated caches chain
-        the next step regardless."""
+        the next step regardless.
+
+        ``origin`` labels a sync=False dispatch for deferred-error
+        forensics: an async dispatch's device error only SURFACES at a
+        later synced step, so the failure the step loop catches may
+        belong to these earlier chunks, not the batch it was raised
+        under (_annotate_deferred_error)."""
         assert self._step_fn is not None
         base_args = (
             self.params,
@@ -1362,12 +1481,21 @@ class JaxEngine:
             out = self._step_fn(*base_args)
         self.k_cache, self.v_cache = out[-2], out[-1]
         if not sync:
+            self._unsynced_steps.append(
+                origin or f"shape={arrays['tokens'].shape}"
+            )
+            del self._unsynced_steps[:-8]  # bounded forensics window
             return None
         from dynamo_tpu.parallel.multihost import host_value
 
         # (next_tokens, logprobs) base; (+ top_ids, top_lps) on the
         # top-logprobs variant
-        return tuple(host_value(x) for x in out[:-2])
+        res = tuple(host_value(x) for x in out[:-2])
+        # a successful sync retires every earlier async dispatch
+        # (in-order device execution): their deferred errors would have
+        # surfaced in this host read
+        self._unsynced_steps.clear()
+        return res
 
     # ------------------------------------------------------------------
     # Engine thread loop
@@ -1455,8 +1583,9 @@ class JaxEngine:
                 self._fail_all()
                 self._running = False
                 return
-            except Exception:
+            except Exception as exc:
                 self._step_failures += 1
+                self._annotate_deferred_error(exc)
                 if not self._quarantine_step_failure():
                     log.exception(
                         "engine step failed; failing in-flight requests"
@@ -1705,6 +1834,26 @@ class JaxEngine:
                 )
                 return
             plan.kind = "prefill"  # no fused window: prefill this step
+        if (
+            plan.kind == "decode"
+            and self._drafter is not None
+            and plan.decode_seqs
+            and not self._spec_divert(plan.decode_seqs)
+        ):
+            t0 = time.monotonic()
+            if self._run_spec_step(plan.decode_seqs):
+                ENGINE_STEP_SECONDS.labels("spec").observe(
+                    time.monotonic() - t0
+                )
+                self._trace(
+                    "spec", b=len(plan.decode_seqs),
+                    ms=round((time.monotonic() - t0) * 1e3, 1),
+                )
+                return
+            # no drafter had a proposal for any row: fall through to the
+            # plain 1-token decode step — the [B, K+1] verify rectangle
+            # would spend (K+1)x the attention/lm_head work to emit
+            # exactly the same single token per sequence
         if plan.kind == "prefill":
             works = plan.prefill_batch
             assert works
@@ -1736,7 +1885,12 @@ class JaxEngine:
         need_sync = plan.kind != "prefill" or any(
             w.is_last_chunk for w in plan.prefill_batch
         )
-        s_out = self._run_device_step(arrays, sampling, sync=need_sync)
+        s_out = self._run_device_step(
+            arrays, sampling, sync=need_sync,
+            origin="prefill:" + ",".join(
+                w.seq.request_id for w in plan.prefill_batch
+            ) if plan.kind == "prefill" else "",
+        )
         if s_out is not None:
             next_tokens, logprobs = s_out[0], s_out[1]
             tops = s_out[2:] if len(s_out) > 2 else None
@@ -1769,6 +1923,161 @@ class JaxEngine:
                     seq, int(next_tokens[i]), float(logprobs[i]),
                     top=top_row(i),
                 )
+
+    # ------------------------------------------------------------------
+    # Speculative decoding (dynamo_tpu/spec; docs/speculative_decoding.md)
+    # ------------------------------------------------------------------
+    def _seq_spec_enabled(self, seq: Sequence) -> bool:
+        """Per-request opt-out: PreprocessedRequest.speculative=False
+        turns speculation off for one request; None/True follow the
+        engine default (a configured drafter)."""
+        return (
+            self._drafter is not None
+            and getattr(seq.request, "speculative", None) is not False
+        )
+
+    def _spec_divert(self, seqs: list) -> bool:
+        """Batches that must take the plain decode step instead of the
+        verify step: penalty/bias/top-logprobs sampling rides
+        separately-compiled step variants the verify path deliberately
+        doesn't replicate, and ANY opted-out request diverts its whole
+        batch — the opt-out contract is the LITERAL plain-decode path,
+        and the verify step computes logits through the T>1 prefill
+        attention kernel (different reduction/tiling order than the
+        T==1 decode kernel: near-tie argmax can flip on TPU) and draws
+        sampled tokens from a different seeded RNG stream than
+        sample(). Riding along would approximate, not honor, the
+        request."""
+        return (
+            self._wants_toplp(seqs)
+            or any(s.request.sampling.needs_penalties for s in seqs)
+            or any(s.request.sampling.logit_bias for s in seqs)
+            or any(not self._seq_spec_enabled(s) for s in seqs)
+        )
+
+    def _run_spec_step(self, seqs: list) -> bool:
+        """One speculative decode step: draft on host, verify on device,
+        roll back rejected drafts. Returns False — with NOTHING staged
+        and no dispatch made — when no sequence got a proposal, so the
+        caller can run the plain decode step instead.
+
+        Contract with the rest of the engine (this is the part that
+        changes the 1-token/seq/step assumption): each sequence emits
+        1..spec_tokens+1 tokens through _emit_window — the SAME
+        multi-token append path fused windows use, so stop conditions,
+        max_tokens clamping, logprobs emission, prefix-cache block
+        commits and SSE multi-token deltas all behave as they do for
+        windows. Draft tokens are staged into seq.tokens for array
+        building (scheduler.reserve_spec_tokens) and ALWAYS unwound
+        after the device sync (TokenBlockSequence.unwind) before the
+        verified tokens are appended — so host token state, generated
+        counts and block content-addressing only ever see verified
+        tokens, and blocks speculatively grown for draft KV stay
+        uncommitted until real tokens fill them."""
+        # lazy: dynamo_tpu.spec imports engine.sampling — a module-level
+        # import here would cycle through the package __init__
+        from dynamo_tpu.spec.verify import unpack_spec_output
+
+        sched = self.scheduler
+        assert sched is not None and self._spec_step_fn is not None
+        assert self._drafter is not None
+        S = self.config.spec_tokens + 1
+        t_draft = time.monotonic()
+        # cap the history the drafter sees (Drafter.window, None = all):
+        # a full all_tokens() + full-history scan per sequence per step
+        # is O(context) host work on the serialized engine thread and
+        # grows without bound on long-context serving
+        window = getattr(self._drafter, "window", None)
+        proposals: list[tuple] = []  # (carry token, drafts)
+        for seq in seqs:
+            budget = S - 1
+            if seq.max_new_tokens is not None:
+                # leave room for the verify step's guaranteed +1 token:
+                # drafts past the budget would be discarded by
+                # _emit_window anyway, but their KV writes would still
+                # need blocks the growth reserve never budgeted
+                budget = min(
+                    budget, max(0, seq.max_new_tokens - seq.generated - 1)
+                )
+            drafts: list[int] = []
+            carry = None
+            if budget > 0 and self._seq_spec_enabled(seq):
+                # ONE history materialization per sequence per step: the
+                # drafter scan and the carry token both read this copy
+                hist = (
+                    seq.tokens.tail_tokens(window)
+                    if window
+                    else seq.tokens.all_tokens()
+                )
+                carry = hist[-1]
+                drafts = list(self._drafter.propose(hist, budget))[:budget]
+            proposals.append((carry, drafts))
+        # the draft-phase histogram covers PROPOSAL cost only (the
+        # drafter-tuning signal) — staging/array/sampling prep below is
+        # fixed per-step engine work, not drafter work
+        SPEC_STEP_SECONDS.labels("draft").observe(time.monotonic() - t_draft)
+        if not any(d for _, d in proposals):
+            return False  # nothing staged: caller runs plain decode
+        works: list[tuple] = []
+        staged = 0
+        for seq, (carry, drafts) in zip(seqs, proposals):
+            k = sched.reserve_spec_tokens(seq, drafts) if drafts else 0
+            staged += k
+            if carry is None:
+                carry = seq.tokens.last_token()
+            works.append((seq, [carry] + drafts[:k]))
+        if staged == 0:
+            # block pressure shrank every row's kept drafts to zero:
+            # rows are bare [carry] tokens, nothing was appended to any
+            # sequence — bail to plain decode instead of paying the
+            # (K+1)x rectangle to emit 1 token per sequence
+            return False
+        arrays = sched.build_spec_arrays(works, S)
+        B = arrays["tokens"].shape[0]
+        sampling = self._batch_sampling(seqs, B)
+        t0 = time.monotonic()
+        try:
+            packed, self.k_cache, self.v_cache = self._spec_step_fn(
+                self.params, self.k_cache, self.v_cache,
+                arrays["tokens"], arrays["positions"],
+                arrays["slot_mapping"], arrays["block_tables"],
+                arrays["context_lens"], arrays["draft_lens"],
+                sampling.arrays,
+            )
+            toks, lps, n_emit = unpack_spec_output(np.asarray(packed), S)
+            # successful host sync: earlier async dispatches are
+            # known-good (in-order execution) — retire deferred-error
+            # forensics or later failures would blame retired chunks
+            self._unsynced_steps.clear()
+        except Exception:
+            # host token state must not keep staged (unverified) drafts
+            # when the step dies — the quarantine retry would otherwise
+            # replan with drafts baked into every sequence's history
+            for seq, row in works:
+                if len(row) > 1:
+                    seq.tokens.unwind(len(row) - 1)
+            raise
+        SPEC_STEP_SECONDS.labels("verify").observe(time.monotonic() - t0)
+        proposed = sum(len(row) - 1 for _, row in works)
+        accepted = int(sum(n_emit[i] - 1 for i in range(len(works))))
+        if proposed:
+            SPEC_PROPOSED_TOKENS.labels(self._drafter.kind).inc(proposed)
+            if accepted:
+                SPEC_ACCEPTED_TOKENS.labels(self._drafter.kind).inc(accepted)
+            SPEC_ACCEPT_RATE.set(accepted / proposed)
+            self.spec_proposed_total += proposed
+            self.spec_accepted_total += accepted
+        for i, (seq, row) in enumerate(works):
+            if len(row) > 1:
+                seq.tokens.unwind(len(row) - 1)  # rejected AND accepted
+                # drafts: the accepted prefix re-appends through
+                # append_token below so commits/penalty counts take the
+                # normal path
+            if seq.state != SeqState.RUNNING:
+                continue
+            n = int(n_emit[i])
+            self._emit_window(seq, toks[i, :n], lps[i, :n])
+        return True
 
     def _batch_sampling(
         self, seqs: list, B: int, offset=0
@@ -2096,6 +2405,9 @@ class JaxEngine:
                 s_out = self._run_device_step(
                     p_arrays, sampling,
                     sync=any(w.is_last_chunk for w in works),
+                    origin="prefill:" + ",".join(
+                        w.seq.request_id for w in works
+                    ),
                 )
                 for i, work in enumerate(works):
                     sched.complete_prefill_chunk(work)
@@ -2152,6 +2464,10 @@ class JaxEngine:
                 for i, seq in enumerate(e["seqs"]):
                     tops = (win[2][i], win[3][i]) if tlp else None
                     self._emit_window(seq, win[0][i], win[1][i], tops=tops)
+            # window sync succeeded: earlier async dispatches are
+            # known-good (in-order execution) — retire deferred-error
+            # forensics
+            self._unsynced_steps.clear()
             sub_lag(e)
             self._trace(
                 "window", kind=e["kind"], b=len(e["seqs"]),
@@ -2373,6 +2689,29 @@ class JaxEngine:
                 attrs={**attrs, "tokens": seq.generated,
                        "finish_reason": str(reason.value)},
             )
+
+    def _annotate_deferred_error(self, exc: BaseException) -> None:
+        """A device error from an earlier ``sync=False`` prefill dispatch
+        only SURFACES at the next synced step (async dispatch defers
+        device-side failures to the first host read). Annotate the
+        raised error so quarantine forensics don't blame the batch the
+        exception happened to be raised under (ADVICE r5)."""
+        if not self._unsynced_steps:
+            return
+        note = (
+            f"{len(self._unsynced_steps)} earlier sync=False prefill "
+            f"dispatch(es) were never synced "
+            f"[{'; '.join(self._unsynced_steps)}]; a deferred device "
+            "error from those chunks can surface at this later synced "
+            "step — the current batch may not be the origin"
+        )
+        log.warning("step failure may be deferred: %s", note)
+        add_note = getattr(exc, "add_note", None)  # PEP 678, 3.11+
+        if add_note is not None:
+            add_note(note)
+        else:
+            exc.args = exc.args + (note,)
+        self._unsynced_steps.clear()
 
     def _quarantine_step_failure(self) -> bool:
         """Try to contain a step failure to the requests most likely to

@@ -246,6 +246,35 @@ def apply_penalties(
     return logits
 
 
+def filter_keep_mask(
+    vals: jax.Array,  # [..., KF] descending top-KF slice of scaled logits
+    lse: jax.Array,  # [..., 1] full-vocab logsumexp of the scaled logits
+    top_k: jax.Array,  # broadcastable against vals[..., :1]
+    top_p: jax.Array,
+    min_p: jax.Array,
+    vocab: int,
+) -> jax.Array:
+    """Boolean keep mask implementing top-k/top-p/min-p shaping over a
+    descending top-KF logit slice. ONE definition shared by sample()'s
+    filtered path and the speculative verifier (spec/verify.py) — the
+    two must agree exactly or speculative acceptance would target a
+    different distribution than non-speculative sampling draws from.
+
+    Probabilities are normalized against the FULL vocab (via ``lse``),
+    so the top_p cutoff is exact whenever it falls inside the slice; the
+    only approximation is truncating ultra-flat tails (or top_k > KF) to
+    the KF most likely tokens."""
+    KF = vals.shape[-1]
+    ranks = jnp.arange(KF, dtype=jnp.int32)
+    k = jnp.where(top_k > 0, top_k, vocab)[..., None]
+    k_mask = ranks < k
+    sprobs = jnp.exp(vals - lse)  # true full-vocab probabilities
+    cum = jnp.cumsum(sprobs, axis=-1)
+    p_mask = (cum - sprobs) < top_p[..., None]
+    m_mask = sprobs >= (min_p[..., None] * sprobs[..., :1])
+    return k_mask & p_mask & m_mask
+
+
 def sample(
     logits: jax.Array,  # [B, V] f32
     s: dict,  # SamplingBatch.arrays (device-side pytree)
@@ -307,15 +336,8 @@ def sample(
             # top_k > KF) to the KF most likely tokens.
             KF = min(128, V)
             vals, idx = jax.lax.top_k(scaled, KF)  # [B, KF] descending
-            ranks = jnp.arange(KF, dtype=jnp.int32)[None, :]
-            k = jnp.where(top_k > 0, top_k, V)[:, None]
-            k_mask = ranks < k
             lse = jax.nn.logsumexp(scaled, axis=-1, keepdims=True)
-            sprobs = jnp.exp(vals - lse)  # true full-vocab probabilities
-            cum = jnp.cumsum(sprobs, axis=-1)
-            p_mask = (cum - sprobs) < top_p[:, None]
-            m_mask = sprobs >= (min_p[:, None] * sprobs[:, :1])
-            keep = k_mask & p_mask & m_mask
+            keep = filter_keep_mask(vals, lse, top_k, top_p, min_p, V)
             fvals = jnp.where(keep, vals, NEG_INF)
             g = jnp.take_along_axis(gumbel, idx, axis=-1)
             choice = jnp.argmax(fvals + g, axis=-1)

@@ -724,6 +724,90 @@ class Scheduler:
             "rect": (rows, rlen),
         }
 
+    # -- speculative decoding (dynamo_tpu/spec) ---------------------------
+    def reserve_spec_tokens(self, seq: Sequence, drafts: list[int]) -> int:
+        """Stage up to ``len(drafts)`` draft tokens for one verify step:
+        allocate the blocks their KV writes need (positions
+        [total_len-1, total_len-1+k) — the verify forward writes every
+        draft's KV speculatively), then append the kept drafts to the
+        sequence's token state so array building sees them. The engine
+        UNWINDS the appended drafts after the device sync
+        (TokenBlockSequence.unwind) and re-appends only the accepted
+        prefix through append_token — so block content-addressing
+        (committed_blocks / _commit_full_blocks) never sees unverified
+        draft tokens: num_computed is untouched here, and a block is
+        only committed once real appended tokens cover it.
+
+        Never preempts (speculation is an optimization): on block
+        exhaustion the draft count shrinks to what the sequence's
+        current table already covers. Returns the kept draft count.
+        """
+        k = len(drafts)
+        bs = self.block_size
+        while k > 0:
+            needed = seq.blocks_needed(seq.total_len + k, bs)
+            try:
+                while len(seq.block_table) < needed:
+                    seq.block_table.append(self.allocator.allocate_block())
+                break
+            except NoBlocksError:
+                # keep what fits in the blocks already held — blocks
+                # speculatively appended above stay on the table (plain
+                # growth the sequence will need anyway) but are never
+                # committed/content-addressed until real tokens fill them
+                k = min(k, len(seq.block_table) * bs - seq.total_len)
+        if k > 0:
+            seq.tokens.extend(drafts[:k])
+        return max(0, k)
+
+    def build_spec_arrays(
+        self, works: list[tuple[Sequence, list[int]]], S: int
+    ) -> dict[str, np.ndarray]:
+        """Verify-step tensors for [(seq, row_tokens)] rows, where
+        ``row_tokens`` is the CONTIGUOUS run [last committed token,
+        draft_0, ..., draft_{k-1}] (the engine already holds these —
+        re-materializing each sequence's full history here would put a
+        second O(context) copy on the per-step host path), padded to the
+        fixed width ``S`` (= spec_tokens+1 — one compiled shape). Call
+        AFTER reserve_spec_tokens (seq.total_len includes the staged
+        drafts). Row-internal pads keep contiguous positions (the Pallas
+        prefill kernel derives per-token positions from positions[:, 0])
+        but write to the reserved garbage slot 0; context_lens covers
+        only real tokens, so attention never reads a pad's KV."""
+        bs = self.block_size
+        n = len(works)
+        B = self._decode_batch(n)
+        max_blocks = max(len(s.block_table) for s, _ in works)
+        width = self._table_width(max_blocks)
+        tokens = np.zeros((B, S), np.int32)
+        positions = np.zeros((B, S), np.int32)
+        slot_mapping = np.zeros((B * S,), np.int32)
+        tables = np.zeros((B, width), np.int32)
+        ctx = np.zeros((B,), np.int32)
+        draft_lens = np.zeros((B,), np.int32)
+        for i, (seq, row) in enumerate(works):
+            k = len(row) - 1
+            base = seq.total_len - k - 1  # position of the carry token
+            tokens[i, : k + 1] = row
+            positions[i, :] = np.arange(base, base + S)
+            for j in range(k + 1):
+                pos = base + j
+                slot_mapping[i * S + j] = (
+                    seq.block_table[pos // bs] * bs + pos % bs
+                )
+            tables[i, : len(seq.block_table)] = seq.block_table
+            ctx[i] = seq.total_len
+            draft_lens[i] = k
+        return {
+            "tokens": tokens,
+            "positions": positions,
+            "slot_mapping": slot_mapping,
+            "block_tables": tables,
+            "context_lens": ctx,
+            "draft_lens": draft_lens,
+            "last_token_idx": np.zeros((B,), np.int32),
+        }
+
     def _preempt(self, victim: Sequence) -> None:
         self.preemptions += 1
         ENGINE_PREEMPTIONS.inc()

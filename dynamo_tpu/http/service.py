@@ -187,6 +187,14 @@ class HttpService:
 
             model = req.model
             span.set_attr("model", model)
+            # per-request speculative-decoding opt-in/out rides the ext
+            # field straight through to PreprocessedRequest.speculative
+            # (the engine resolves None to its configured default);
+            # stamp explicit choices on the root span so traces show
+            # which requests ran speculatively
+            spec_opt = req.extension().speculative
+            if spec_opt is not None:
+                span.set_attr("speculative", bool(spec_opt))
             engines = (
                 self.models.chat_engines if kind == "chat" else self.models.completion_engines
             )

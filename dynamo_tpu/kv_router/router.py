@@ -117,7 +117,9 @@ class KvPushRouter(AsyncEngine):
             async for item in stream:
                 yield item
         finally:
-            self.router.scheduler.note_done(decision.worker_id)
+            self.router.scheduler.note_done(
+                decision.worker_id, decision.dispatch_token
+            )
 
     def generate(self, request: Any, context: Context) -> EngineStream:
         return self._gen(request, context)

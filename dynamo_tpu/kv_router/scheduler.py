@@ -106,6 +106,11 @@ class SchedulingDecision:
     worker_id: int
     overlap_blocks: int
     total_blocks: int
+    # the in-flight charge this decision placed (note_dispatch's return):
+    # pass it back to note_done so completion releases THIS request's
+    # charge, not some later request's (ADVICE r5: anonymous pops under
+    # bursts released the wrong entry)
+    dispatch_token: float = 0.0
 
     @property
     def prefix_hit_rate(self) -> float:
@@ -143,17 +148,34 @@ class KvScheduler:
         self.inflight: dict[int, list[float]] = {}
         self.inflight_ttl_s = 5.0
 
-    def note_dispatch(self, worker_id: int) -> None:
-        self.inflight.setdefault(worker_id, []).append(time.monotonic())
+    def note_dispatch(self, worker_id: int) -> float:
+        """Charge one in-flight dispatch; returns the charge's token
+        (its monotonic timestamp). Keep it and hand it to note_done —
+        an anonymous release under a burst would pop the OLDEST entry,
+        i.e. release a later request's still-live charge."""
+        token = time.monotonic()
+        self.inflight.setdefault(worker_id, []).append(token)
+        return token
 
-    def note_done(self, worker_id: int) -> None:
+    def note_done(self, worker_id: int, token: Optional[float] = None) -> None:
         """Optional early release (proxy paths that observe stream
-        completion); expiry handles callers that never report back."""
+        completion); expiry handles callers that never report back.
+        ``token`` (note_dispatch's return) releases that SPECIFIC charge
+        — a no-op if it already expired or was consumed by a newer
+        metrics snapshot. token=None keeps the legacy oldest-entry pop
+        for callers that didn't record one."""
         entries = self.inflight.get(worker_id)
-        if entries:
+        if not entries:
+            return
+        if token is None:
             entries.pop(0)
-            if not entries:
-                self.inflight.pop(worker_id, None)
+        else:
+            try:
+                entries.remove(token)
+            except ValueError:
+                return  # already expired / released by a fresher snapshot
+        if not entries:
+            self.inflight.pop(worker_id, None)
 
     def _active_inflight(self, worker_id: int) -> int:
         entries = self.inflight.get(worker_id)
@@ -200,11 +222,12 @@ class KvScheduler:
                         worker_id=w, num_requests_waiting=n
                     )
         wid = self.selector(overlaps, metrics, candidates)
-        self.note_dispatch(wid)
+        token = self.note_dispatch(wid)
         decision = SchedulingDecision(
             worker_id=wid,
             overlap_blocks=overlaps.scores.get(wid, 0),
             total_blocks=overlaps.total_blocks,
+            dispatch_token=token,
         )
         if self.on_hit_rate is not None:
             self.on_hit_rate(

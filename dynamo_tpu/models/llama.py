@@ -729,6 +729,7 @@ def forward(
     block_size: int,
     extra_embeds: Optional[jax.Array] = None,  # [B, T, D] injected embeds
     embeds_mask: Optional[jax.Array] = None,  # [B, T] bool: use injected
+    logits_all: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One model step. Returns (logits[B, V], new_k_cache, new_v_cache).
 
@@ -736,6 +737,12 @@ def forward(
     patches from models/vision.py) over the token embeddings at masked
     positions — the multimodal injection point (reference:
     examples/multimodal encode-worker → LLM embedding handoff).
+
+    ``logits_all=True`` (trace-time constant) returns logits at EVERY
+    fed position — [B, T, V] instead of [B, V] — the speculative-decode
+    verify step needs the target distribution at each draft position
+    (dynamo_tpu/spec). Only sensible for small T: the lm_head matmul and
+    the [B, T, V] f32 output scale linearly with T.
     """
     x = scale_embed(cfg, embed_lookup(params, tokens))  # [B, T, D]
     if extra_embeds is not None:
@@ -771,7 +778,7 @@ def forward(
             scale_scatter_indices,
         )
 
-        n_idx, off_idx = scale_scatter_indices(slot_mapping, block_size, Hk)
+        n_idx, off_idx = scale_scatter_indices(slot_mapping, block_size)
 
     def write_kv(cache, new, i):
         """Scatter this layer's fresh K or V rows [B*T, Hk, Dh] into the
@@ -825,6 +832,9 @@ def forward(
     )
 
     x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
+    if logits_all:
+        # every position's logits (speculative verify) — [B, T, V]
+        return mm(params, "lm_head", x).astype(jnp.float32), new_k, new_v
     # logits only at each sequence's last real token
     x_last = jnp.take_along_axis(
         x, last_token_idx[:, None, None].astype(jnp.int32), axis=1

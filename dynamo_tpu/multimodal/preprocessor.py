@@ -99,6 +99,7 @@ class MultimodalPreprocessor(OpenAIPreprocessor):
             output=request.output_options(),
             model=request.model,
             annotations=list(request.extension().annotations),
+            speculative=request.extension().speculative,
             mm_embeds=pack_segments(segments),
         )
 

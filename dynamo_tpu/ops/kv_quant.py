@@ -48,12 +48,18 @@ def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
 
 
 def scale_scatter_indices(
-    slot_mapping: jax.Array, block_size: int, num_kv_heads: int
+    slot_mapping: jax.Array, block_size: int
 ) -> tuple[jax.Array, jax.Array]:
     """Flat slot ids [M] -> (pages [M], offsets [M]) addressing the
-    [L, N, Hk, bs] scale array: the write is
-    ``scales.at[layer, pages, :, offsets].set(sc[M, Hk])`` — all heads
-    of one slot's scale column in one indexed-slice scatter."""
+    [L, N, Hk, bs] scale array. Prefill (T > 1) writes via the
+    indexed-slice scatter ``scales.at[layer, pages, :, offsets].set(
+    sc[M, Hk])`` — all heads of one slot's scale column at once. Decode
+    (T == 1) instead read-modify-writes whole [Hk, bs] page TILES
+    selected by ``pages`` (gather page, jnp.where on the ``offsets``
+    column, set back): only the canonical one-indexed-axis scatter form
+    updates the carried cache in place, and the indexed-slice form at
+    T == 1 made XLA materialize + copy the full scale plane per layer at
+    the Pallas custom-call boundary (see models/llama.py write_kv)."""
     return slot_mapping // block_size, slot_mapping % block_size
 
 

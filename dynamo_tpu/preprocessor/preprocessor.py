@@ -70,6 +70,7 @@ class OpenAIPreprocessor(Operator):
             output=request.output_options(),
             model=request.model,
             annotations=list(ext.annotations),
+            speculative=ext.speculative,
         )
 
     def preprocess_completion(self, request: CompletionRequest) -> PreprocessedRequest:
@@ -88,6 +89,7 @@ class OpenAIPreprocessor(Operator):
             token_ids = list(prompt[0])
         else:
             raise ValueError("empty prompt")
+        ext = request.extension()
         return PreprocessedRequest(
             request_id=f"cmpl-{uuid.uuid4().hex}",
             token_ids=token_ids,
@@ -95,7 +97,8 @@ class OpenAIPreprocessor(Operator):
             stop=request.stop_conditions(),
             output=request.output_options(),
             model=request.model,
-            annotations=list(request.extension().annotations),
+            annotations=list(ext.annotations),
+            speculative=ext.speculative,
         )
 
     # -- Operator interface ----------------------------------------------

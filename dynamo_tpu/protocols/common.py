@@ -119,6 +119,15 @@ class PreprocessedRequest(BaseModel):
     # Routing hints
     model: Optional[str] = None
     lora_name: Optional[str] = None
+    # Speculative decoding opt-in/out for THIS request (OpenAI
+    # ext.speculative; docs/speculative_decoding.md): None follows the
+    # engine default (on when the engine has a configured drafter),
+    # False forces the literal plain-decode path (its batch diverts
+    # from the verify step), True is a no-op on engines without a
+    # drafter. Output distribution is preserved either way — this knob
+    # trades per-request latency shape (token bursts) and exact seeded
+    # reproducibility vs a non-speculative engine.
+    speculative: Optional[bool] = None
     # Disaggregation: filled by the disagg router when prefill is remote
     remote_prefill: Optional[dict[str, Any]] = None
     annotations: list[str] = Field(default_factory=list)

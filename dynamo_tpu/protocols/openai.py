@@ -42,6 +42,11 @@ class ExtOptions(BaseModel):
     repetition_penalty: Optional[float] = None
     annotations: list[str] = Field(default_factory=list)
     use_raw_prompt: Optional[bool] = None
+    # per-request speculative-decoding opt-in/out (None = engine
+    # default; False = plain decode for this request; True = no-op on
+    # engines without a configured drafter) — carried through the
+    # preprocessor into PreprocessedRequest.speculative
+    speculative: Optional[bool] = None
 
 
 def _int_logit_bias(

@@ -1,0 +1,40 @@
+"""Speculative decoding subsystem: drafting + batched verification.
+
+Converts spare decode-step FLOPs into tokens/step (Leviathan et al.,
+"Fast Inference from Transformers via Speculative Decoding"): a cheap
+*drafter* proposes up to K tokens per sequence, the engine scores all of
+them in ONE jitted forward over K+1 positions through the existing
+paged-KV attention, and on-device rejection sampling keeps the longest
+accepted prefix plus one freshly sampled token — provably preserving the
+target sampling distribution (greedy mode is bit-identical to
+non-speculative greedy by construction).
+
+Layout:
+- ``drafter.py`` — the pluggable :class:`Drafter` protocol and the two
+  dependency-free drafters (prompt-lookup n-gram matching against the
+  request's own token history, and a static bigram table loadable from a
+  file), plus :func:`build_drafter` for config-string construction.
+- ``verify.py`` — the device-side batched verification (jax) used inside
+  the engine's jitted spec step, and the host-side unpack helper.
+
+Engine wiring lives in ``engine/engine.py`` (``_run_spec_step``) and
+``engine/scheduler.py`` (``reserve_spec_tokens`` / ``build_spec_arrays``)
+— see docs/speculative_decoding.md.
+"""
+
+from dynamo_tpu.spec.drafter import (
+    BigramTableDrafter,
+    Drafter,
+    NgramDrafter,
+    build_drafter,
+)
+from dynamo_tpu.spec.verify import unpack_spec_output, verify_tokens
+
+__all__ = [
+    "BigramTableDrafter",
+    "Drafter",
+    "NgramDrafter",
+    "build_drafter",
+    "unpack_spec_output",
+    "verify_tokens",
+]

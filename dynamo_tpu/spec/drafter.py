@@ -1,0 +1,171 @@
+"""Dependency-free drafters for speculative decoding.
+
+A drafter proposes up to ``k`` continuation tokens from a sequence's own
+token history — no second model, no extra weights in HBM, fully testable
+on CPU. Both drafters here emit *deterministic* proposals, i.e. the
+draft distribution is a point mass at the proposed token; the engine's
+rejection sampler (spec/verify.py) exploits that: accept token ``d``
+with probability ``p_target(d)``, else resample from the renormalized
+remainder — the output distribution is exactly the target's.
+
+Drafters run on the engine thread's host path (between device
+dispatches), so ``propose`` must be cheap: the n-gram matcher is a
+vectorised numpy scan over the history, the bigram drafter a table walk.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Protocol, Sequence, runtime_checkable
+
+import numpy as np
+
+
+@runtime_checkable
+class Drafter(Protocol):
+    """Pluggable proposal source for speculative decoding.
+
+    ``kind`` labels telemetry series; ``propose`` returns 0..k draft
+    token ids continuing ``token_ids`` (an empty list = no proposal —
+    the sequence decodes one token normally that step). ``window`` is
+    the history suffix length the drafter actually reads (None = all):
+    the engine materializes only that tail per step, keeping the host
+    draft phase O(window) instead of O(context).
+    """
+
+    kind: str
+    window: "int | None"
+
+    def propose(self, token_ids: Sequence[int], k: int) -> list[int]:
+        ...
+
+
+class NgramDrafter:
+    """Prompt-lookup / n-gram drafting (as popularised by vLLM and TGI):
+    match the sequence's trailing n-gram against its OWN earlier history
+    and propose the tokens that followed the most recent prior
+    occurrence. Strong on the workloads self-drafting targets —
+    summarisation, code editing, RAG, multi-turn chat — where the
+    continuation frequently copies spans of the prompt."""
+
+    kind = "ngram"
+
+    def __init__(
+        self, max_ngram: int = 3, min_ngram: int = 1,
+        max_window: int = 4096,
+    ):
+        if max_ngram < min_ngram or min_ngram < 1:
+            raise ValueError(
+                f"need max_ngram >= min_ngram >= 1, got "
+                f"{max_ngram}/{min_ngram}"
+            )
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+        # bound the per-step scan: the matcher reads only the last
+        # max_window tokens (vLLM's prompt-lookup bounds its scan the
+        # same way) — an unbounded scan is O(context) host work per
+        # sequence per decode step on the serialized engine thread
+        self.window = max_window
+
+    def propose(self, token_ids: Sequence[int], k: int) -> list[int]:
+        arr = np.asarray(token_ids, dtype=np.int64)
+        n_hist = len(arr)
+        if k <= 0 or n_hist < self.min_ngram + 1:
+            return []
+        for n in range(min(self.max_ngram, n_hist - 1), self.min_ngram - 1, -1):
+            suffix = arr[-n:]
+            # windows over arr[:-1]: start positions 0..n_hist-1-n, which
+            # excludes the terminal suffix itself (it starts at n_hist-n)
+            windows = np.lib.stride_tricks.sliding_window_view(arr[:-1], n)
+            starts = np.nonzero(np.all(windows == suffix, axis=1))[0]
+            if len(starts) == 0:
+                continue
+            i = int(starts[-1])  # most recent prior occurrence
+            cont = arr[i + n : i + n + k]
+            if len(cont):
+                return [int(t) for t in cont]
+        return []
+
+
+class BigramTableDrafter:
+    """Static bigram drafting: a ``[vocab]`` table of most-likely next
+    token (-1 = no entry), chained k steps from the sequence's last
+    token. The table ships as a file (offline corpus statistics) so the
+    drafter costs one array in host RAM and zero device bytes."""
+
+    kind = "bigram"
+    window = 1  # only the last token feeds the table walk
+
+    def __init__(self, table: np.ndarray):
+        self.table = np.asarray(table, dtype=np.int64).reshape(-1)
+
+    @classmethod
+    def from_file(cls, path: str) -> "BigramTableDrafter":
+        """Load a table from ``.npz``/``.npy`` (array under key "next"
+        for npz) or JSON ({"token_id": next_id, ...})."""
+        if path.endswith(".npz"):
+            with np.load(path) as z:
+                return cls(z["next"])
+        if path.endswith(".npy"):
+            return cls(np.load(path))
+        with open(path) as f:
+            mapping = json.load(f)
+        pairs = {int(t): int(n) for t, n in mapping.items()}
+        size = max(pairs) + 1 if pairs else 1
+        table = np.full((size,), -1, dtype=np.int64)
+        for t, n in pairs.items():
+            table[t] = n
+        return cls(table)
+
+    @classmethod
+    def from_corpus(
+        cls, token_ids: Sequence[int], vocab_size: int
+    ) -> "BigramTableDrafter":
+        """Most-frequent-successor table from a token stream (test and
+        bench helper; production tables come from from_file)."""
+        arr = np.asarray(token_ids, dtype=np.int64)
+        table = np.full((vocab_size,), -1, dtype=np.int64)
+        if len(arr) < 2:
+            return cls(table)
+        pair_keys = arr[:-1] * vocab_size + arr[1:]
+        keys, counts = np.unique(pair_keys, return_counts=True)
+        # ascending count order: the last write per first-token wins
+        order = np.argsort(counts, kind="stable")
+        firsts = keys[order] // vocab_size
+        seconds = keys[order] % vocab_size
+        table[firsts] = seconds
+        return cls(table)
+
+    def propose(self, token_ids: Sequence[int], k: int) -> list[int]:
+        if k <= 0 or not len(token_ids):
+            return []
+        out: list[int] = []
+        cur = int(token_ids[-1])
+        for _ in range(k):
+            if not (0 <= cur < len(self.table)):
+                break
+            nxt = int(self.table[cur])
+            if nxt < 0:
+                break
+            out.append(nxt)
+            cur = nxt
+        return out
+
+
+def build_drafter(spec: str) -> Drafter:
+    """Construct a drafter from a config string:
+
+    - ``"ngram"`` or ``"ngram:N"`` — prompt-lookup with max n-gram N
+      (default 3);
+    - ``"bigram:PATH"`` — static table from PATH (.npz/.npy/json).
+    """
+    name, _, arg = spec.partition(":")
+    if name == "ngram":
+        return NgramDrafter(max_ngram=int(arg) if arg else 3)
+    if name == "bigram":
+        if not arg:
+            raise ValueError("bigram drafter needs a table path: bigram:PATH")
+        return BigramTableDrafter.from_file(arg)
+    raise ValueError(
+        f"unknown drafter {spec!r} (expected ngram[:N] or bigram:PATH)"
+    )

@@ -45,7 +45,7 @@ _STEP_BUCKETS = (
 ENGINE_STEP_SECONDS = REGISTRY.histogram(
     "dynamo_engine_step_seconds",
     "Engine device-step wall time by step kind",
-    labels=("kind",),  # prefill | decode | mixed | window
+    labels=("kind",),  # prefill | decode | mixed | window | spec
     buckets=_STEP_BUCKETS,
 )
 ENGINE_BATCH_OCCUPANCY = REGISTRY.gauge(
@@ -82,6 +82,28 @@ ENGINE_REQUESTS_FINISHED = REGISTRY.counter(
 ENGINE_TOKENS_GENERATED = REGISTRY.counter(
     "dynamo_engine_tokens_generated_total",
     "Decoded tokens emitted to request streams",
+)
+
+# -- speculative decoding (engine spec step; dynamo_tpu/spec) ---------------
+SPEC_PROPOSED_TOKENS = REGISTRY.counter(
+    "dynamo_spec_proposed_tokens_total",
+    "Draft tokens proposed to the speculative verify step",
+    labels=("drafter",),  # ngram | bigram
+)
+SPEC_ACCEPTED_TOKENS = REGISTRY.counter(
+    "dynamo_spec_accepted_tokens_total",
+    "Draft tokens accepted by rejection sampling",
+    labels=("drafter",),
+)
+SPEC_ACCEPT_RATE = REGISTRY.gauge(
+    "dynamo_spec_accept_rate",
+    "Accepted/proposed draft tokens of the last speculative step",
+)
+SPEC_STEP_SECONDS = REGISTRY.histogram(
+    "dynamo_spec_step_seconds",
+    "Speculative step latency by phase (host drafting vs device verify)",
+    labels=("phase",),  # draft | verify
+    buckets=_STEP_BUCKETS,
 )
 
 # -- KV block manager / transfer plane --------------------------------------

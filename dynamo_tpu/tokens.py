@@ -203,15 +203,58 @@ class TokenBlockSequence:
             self.partial.push(t)
 
     def unwind(self, n: int = 1) -> None:
-        """Remove the last ``n`` tokens (e.g. speculative-decode rollback)."""
+        """Remove the last ``n`` tokens (e.g. speculative-decode rollback).
+
+        Tail-only unwinds (the speculative common case: K staged drafts
+        that never crossed a block boundary) pop straight off the
+        partial block — no O(sequence) all_tokens rebuild; hashes of
+        complete blocks are untouched either way (content-addressed)."""
+        if n < 0 or n > len(self):
+            raise ValueError(f"unwind {n} out of range 0..{len(self)}")
+        if n <= len(self.partial.tokens):
+            if n:
+                del self.partial.tokens[-n:]
+            return
         self.truncate(len(self) - n)
 
     # -- views ------------------------------------------------------------
+    def last_token(self) -> int:
+        """The final token without materializing the whole sequence
+        (the speculative decode hot path reads this every step)."""
+        if self.partial.tokens:
+            return self.partial.tokens[-1]
+        if self.blocks:
+            return self.blocks[-1].tokens[-1]
+        raise IndexError("empty sequence has no last token")
+
     def all_tokens(self) -> list[int]:
         out: list[int] = []
         for b in self.blocks:
             out.extend(b.tokens)
         out.extend(self.partial.tokens)
+        return out
+
+    def tail_tokens(self, n: int) -> list[int]:
+        """The last ``n`` tokens (fewer if the sequence is shorter),
+        built by walking blocks from the END — O(n), not O(sequence).
+        The speculative drafter's windowed history read (a full
+        all_tokens() per sequence per decode step would grow without
+        bound on long contexts)."""
+        if n <= 0:
+            return []
+        # collect chunks walking backwards, flatten ONCE at the end —
+        # repeated list prepends would be O(n^2 / block_size)
+        chunks: list = [self.partial.tokens[-n:]]
+        got = len(chunks[0])
+        for b in reversed(self.blocks):
+            if got >= n:
+                break
+            take = min(n - got, len(b.tokens))
+            chunks.append(b.tokens[-take:])
+            got += take
+        out: list[int] = []
+        for c in reversed(chunks):
+            out.extend(c)
         return out
 
     def block_hashes(self) -> list[int]:
