@@ -182,7 +182,7 @@ async def _run(model_cfg, wl, spec: bool = False, decode_steps=None) -> dict:
 
     repeat_prompts = os.environ.get("DYN_BENCH_SPEC_REPEAT") == "1"
 
-    async def one_request(i: int) -> tuple[float, float, int]:
+    async def one_request(i: int) -> tuple[float, float, int, list]:
         if repeat_prompts:
             # self-similar prompt (doc-repetition workload): the n-gram
             # drafter's sweet spot — accept rates here show the ceiling
@@ -207,11 +207,18 @@ async def _run(model_cfg, wl, spec: bool = False, decode_steps=None) -> dict:
         t_start = time.monotonic()
         t_first = None
         n = 0
+        # chunk arrival log (t, tokens_in_chunk): fused windows deliver
+        # tokens in bursts, so per-token ITL is each gap amortized over
+        # the chunk it delivered
+        arrivals: list[tuple[float, int]] = []
         async for item in adapter.generate(req, Context()):
-            if item.token_ids and t_first is None:
-                t_first = time.monotonic()
+            if item.token_ids:
+                now = time.monotonic()
+                if t_first is None:
+                    t_first = now
+                arrivals.append((now, len(item.token_ids)))
             n += len(item.token_ids)
-        return t_start, t_first or time.monotonic(), n
+        return t_start, t_first or time.monotonic(), n, arrivals
 
     # warmup at FULL batch: the measurement's shapes (batched prefill at
     # B=batch, decode at the batch bucket) must compile now, not inside
@@ -224,6 +231,14 @@ async def _run(model_cfg, wl, spec: bool = False, decode_steps=None) -> dict:
     t1 = time.monotonic()
     total_tokens = sum(r[2] for r in results)
     ttfts = [r[1] - r[0] for r in results]
+    # per-token ITL samples across all requests: each inter-chunk gap
+    # contributes one sample per token it delivered (tail percentiles
+    # are what BENCH_* files exist to capture — p50 hides the stalls)
+    itls: list[float] = []
+    for _, _, _, arrivals in results:
+        for (t_prev, _), (t_cur, k) in zip(arrivals, arrivals[1:]):
+            if k > 0:
+                itls.extend([(t_cur - t_prev) / k] * k)
     wall = t1 - t0
     tput = total_tokens / wall
 
@@ -237,13 +252,31 @@ async def _run(model_cfg, wl, spec: bool = False, decode_steps=None) -> dict:
     await engine.shutdown()
     return {
         "tput": tput,
-        "p50_ttft_s": sorted(ttfts)[len(ttfts) // 2],
+        "p50_ttft_s": _percentile(ttfts, 50),
+        "p90_ttft_s": _percentile(ttfts, 90),
+        "p99_ttft_s": _percentile(ttfts, 99),
+        "p50_itl_s": _percentile(itls, 50),
+        "p90_itl_s": _percentile(itls, 90),
+        "p99_itl_s": _percentile(itls, 99),
         "total_tokens": total_tokens,
         "wall_s": wall,
         "roofline": roofline_tput,
         "spec_proposed": spec_proposed,
         "spec_accepted": spec_accepted,
     }
+
+
+def _percentile(samples: list, p: float) -> float:
+    """Nearest-rank percentile (0.0 on an empty sample set)."""
+    if not samples:
+        return 0.0
+    import math
+
+    s = sorted(samples)
+    # true ceil — round() is round-half-to-even, which overshoots the
+    # rank (to the max) whenever p*N/100 lands on an integer
+    k = min(len(s) - 1, max(0, math.ceil(p / 100.0 * len(s)) - 1))
+    return s[k]
 
 
 def _main_spec_ab(model_cfg, wl) -> None:
@@ -274,6 +307,10 @@ def _main_spec_ab(model_cfg, wl) -> None:
             "accept_rate": round(accepted / proposed, 4) if proposed else 0.0,
             "p50_ttft_ms_plain": round(base["p50_ttft_s"] * 1000, 1),
             "p50_ttft_ms_spec": round(spec["p50_ttft_s"] * 1000, 1),
+            "p99_ttft_ms_plain": round(base["p99_ttft_s"] * 1000, 1),
+            "p99_ttft_ms_spec": round(spec["p99_ttft_s"] * 1000, 1),
+            "p99_itl_ms_plain": round(base["p99_itl_s"] * 1000, 2),
+            "p99_itl_ms_spec": round(spec["p99_itl_s"] * 1000, 2),
         },
     }
     print(json.dumps(out))
@@ -314,12 +351,22 @@ def main() -> None:
             "osl": wl["osl"],
             "decode_steps": int(os.environ.get("DYN_BENCH_DECODE_STEPS", "64")),
             "p50_ttft_ms": round(r["p50_ttft_s"] * 1000, 1),
+            # tails (ISSUE 4 satellite): the serving story lives in the
+            # p90/p99, not the median — BENCH_* files must capture them
+            "p90_ttft_ms": round(r["p90_ttft_s"] * 1000, 1),
+            "p99_ttft_ms": round(r["p99_ttft_s"] * 1000, 1),
+            "p50_itl_ms": round(r["p50_itl_s"] * 1000, 2),
+            "p90_itl_ms": round(r["p90_itl_s"] * 1000, 2),
+            "p99_itl_ms": round(r["p99_itl_s"] * 1000, 2),
         },
     }
     print(json.dumps(out))
     print(
         f"# detail: total_tokens={r['total_tokens']} wall={r['wall_s']:.2f}s "
-        f"p50_ttft={r['p50_ttft_s'] * 1000:.0f}ms roofline={r['roofline']:.0f} tok/s",
+        f"ttft p50/p90/p99={r['p50_ttft_s'] * 1000:.0f}/"
+        f"{r['p90_ttft_s'] * 1000:.0f}/{r['p99_ttft_s'] * 1000:.0f}ms "
+        f"itl p50/p99={r['p50_itl_s'] * 1000:.1f}/"
+        f"{r['p99_itl_s'] * 1000:.1f}ms roofline={r['roofline']:.0f} tok/s",
         file=sys.stderr,
     )
 

@@ -14,4 +14,5 @@ from dynamo_tpu.analysis.rules import (  # noqa: F401
     dropped_task,
     host_sync_jit,
     swallowed_cancel,
+    unbounded_buffer,
 )

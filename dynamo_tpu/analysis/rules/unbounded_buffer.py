@@ -1,0 +1,170 @@
+"""DL007 unbounded-telemetry-buffer: an in-memory telemetry buffer that
+only ever grows.
+
+Telemetry state (histories, step records, event/sample buffers, span
+rings) lives for the PROCESS lifetime and is appended to on hot paths —
+an append with no ``maxlen``/trim is a slow memory leak that surfaces
+as an OOM days into a serving run, exactly when the buffer was supposed
+to help debug. The flight recorder (telemetry/recorder.py) and planner
+history show the two sanctioned shapes:
+
+    self.ring = deque(maxlen=256)          # bounded by construction
+    self.history.append(snap)
+    del self.history[:-600]                # explicit trim
+
+The rule fires on growth sites (``.append``/``.extend``/
+``.appendleft``/``+=``) of instance attributes that (a) are initialized
+as a plain ``[]`` or ``deque()`` *without* ``maxlen``, (b) have a
+telemetry-ish name (history/record/buffer/event/sample/trace/span/
+metric/timing/latency/outcome/measurement/dump/log/ring/step), and
+(c) are never bounded anywhere in the class (``del x[...]``, slice
+assignment, ``.pop()``/``.popleft()``/``.clear()``, or re-assignment
+outside the initializing statement all count as bounding).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from dynamo_tpu.analysis.registry import LintModule, rule
+
+BUFFERISH = (
+    "history", "record", "buffer", "buf", "event", "sample", "trace",
+    "span", "metric", "timing", "latenc", "outcome", "measurement",
+    "dump", "log", "ring", "step",
+)
+GROW_METHODS = {"append", "extend", "appendleft", "extendleft", "insert"}
+BOUND_METHODS = {"pop", "popleft", "popitem", "clear"}
+
+
+def _is_bufferish(name: str) -> bool:
+    low = name.lower()
+    return any(k in low for k in BUFFERISH)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.NAME`` -> "NAME" (None otherwise)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _unbounded_buffer_ctor(value: ast.AST) -> bool:
+    """True for ``[]`` / ``list()`` / ``deque()`` without maxlen."""
+    if isinstance(value, ast.List) and not value.elts:
+        return True
+    if isinstance(value, ast.Call):
+        fn = value.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else ""
+        )
+        if name == "list" and not value.args and not value.keywords:
+            return True
+        if name == "deque":
+            has_maxlen = any(k.arg == "maxlen" for k in value.keywords) or (
+                len(value.args) >= 2
+            )
+            return not has_maxlen
+    return False
+
+
+class _ClassScan(ast.NodeVisitor):
+    """One class body: buffer inits, growth sites, bounding ops.
+    Nested classes scan separately (visit_ClassDef stops descent)."""
+
+    def __init__(self) -> None:
+        self.inits: dict[str, ast.AST] = {}  # attr -> init stmt node
+        self.grows: list[tuple[str, ast.AST]] = []
+        self.bounded: set[str] = set()
+        self.assign_counts: dict[str, int] = {}
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        return  # nested class: scanned on its own
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            self._note_assign(tgt, node.value, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._note_assign(node.target, node.value, node)
+        self.generic_visit(node)
+
+    def _note_assign(self, tgt: ast.AST, value: ast.AST, stmt: ast.AST) -> None:
+        if isinstance(tgt, ast.Subscript):
+            # slice/item assignment bounds (`x[:] = x[-n:]`)
+            attr = _self_attr(tgt.value)
+            if attr:
+                self.bounded.add(attr)
+            return
+        attr = _self_attr(tgt)
+        if attr is None:
+            return
+        self.assign_counts[attr] = self.assign_counts.get(attr, 0) + 1
+        if _unbounded_buffer_ctor(value):
+            self.inits.setdefault(attr, stmt)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript):
+                attr = _self_attr(tgt.value)
+                if attr:
+                    self.bounded.add(attr)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        attr = _self_attr(node.target)
+        if attr is not None and isinstance(node.op, ast.Add):
+            self.grows.append((attr, node))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute):
+            attr = _self_attr(node.func.value)
+            if attr is not None:
+                if node.func.attr in GROW_METHODS:
+                    self.grows.append((attr, node))
+                elif node.func.attr in BOUND_METHODS:
+                    self.bounded.add(attr)
+        self.generic_visit(node)
+
+
+@rule(
+    "unbounded-telemetry-buffer",
+    "DL007",
+    "telemetry buffer appended without maxlen/trim (grows for the "
+    "process lifetime)",
+)
+def check(module: LintModule):
+    findings: list[tuple[ast.AST, str]] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        scan = _ClassScan()
+        for stmt in node.body:
+            scan.visit(stmt)
+        for attr, site in scan.grows:
+            if attr not in scan.inits or not _is_bufferish(attr):
+                continue
+            if attr in scan.bounded:
+                continue
+            if scan.assign_counts.get(attr, 0) > 1:
+                # re-assigned elsewhere (e.g. snapshot-and-reset): the
+                # buffer has a lifecycle, not unbounded growth
+                continue
+            findings.append(
+                (
+                    site,
+                    f"`self.{attr}` grows without a bound — telemetry "
+                    "buffers live for the process lifetime; use "
+                    "deque(maxlen=N) or trim after appending "
+                    "(`del self." + attr + "[:-N]`)",
+                )
+            )
+    return findings

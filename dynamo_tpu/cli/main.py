@@ -139,6 +139,25 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--advertise-host", default="127.0.0.1",
                      help="address prefill workers use to reach this "
                           "worker's KV transfer server")
+    # observability (docs/observability.md: SLO + flight recorder)
+    run.add_argument("--slo-ttft-ms", type=float, default=None,
+                     help="TTFT target evaluated per finished request "
+                          "(engine-side submit -> first token); feeds "
+                          "dynamo_slo_attainment / "
+                          "dynamo_goodput_tokens_total")
+    run.add_argument("--slo-itl-ms", type=float, default=None,
+                     help="mean inter-token-latency target per request")
+    run.add_argument("--slow-step-ms", type=float, default=None,
+                     help="slow-step watchdog: a device step longer "
+                          "than this dumps the flight-recorder ring to "
+                          "JSONL (default: DYN_SLOW_STEP_MS, else off)")
+    run.add_argument("--flight-recorder-steps", type=int, default=256,
+                     help="flight-recorder ring capacity (last N engine "
+                          "steps kept for /debug/state + anomaly dumps; "
+                          "0 disables)")
+    run.add_argument("--flight-dump-dir", default="",
+                     help="where flight-recorder JSONL dumps land "
+                          "(default: DYN_FLIGHT_DIR or the tmp dir)")
     # KV offload tiers
     run.add_argument("--subproc-ready-timeout", type=float, default=1800.0,
                      help="startup budget for --out subproc: children "
@@ -292,6 +311,27 @@ def build_parser() -> argparse.ArgumentParser:
                        help="output path (default stdout)")
     trace.add_argument("--trace-id", default=None,
                        help="filter to one trace (id prefix is enough)")
+
+    # observability: `dynamo-tpu top` (live fleet view over /debug/state)
+    top = sub.add_parser(
+        "top", help="live fleet view: poll /debug/state and render a "
+                    "terminal table (batch occupancy, KV usage, tok/s, "
+                    "SLO attainment, HBM)"
+    )
+    top.add_argument("urls", nargs="*",
+                     help="debug endpoint base URLs (default "
+                          "http://127.0.0.1:8000); frontends and worker "
+                          "metrics servers both qualify")
+    top.add_argument("--interval", type=float, default=2.0,
+                     help="seconds between polls")
+    top.add_argument("--iterations", type=int, default=None,
+                     help="stop after N frames (default: run forever)")
+    top.add_argument("--once", action="store_true",
+                     help="render one frame and exit")
+    top.add_argument("--raw", action="store_true",
+                     help="print JSON rows instead of the table")
+    top.add_argument("--no-clear", action="store_true",
+                     help="don't clear the screen between frames")
 
     models = sub.add_parser("models", help="model registry ctl (≈ llmctl)")
     models.add_argument("action", choices=["list", "register", "remove"])
@@ -1494,6 +1534,11 @@ def main(argv: Optional[list[str]] = None) -> None:
         sys.exit(cmd_lint(args))
     if args.command == "trace":
         sys.exit(cmd_trace(args))
+    if args.command == "top":
+        # pure HTTP polling: no logging/jax setup
+        from dynamo_tpu.cli.top import cmd_top
+
+        sys.exit(cmd_top(args))
     init_logging()
     from dynamo_tpu.utils.jaxtools import configure_from_env
 

@@ -54,11 +54,21 @@ class BlockAllocator:
         )
         # seq_hash -> block id, for complete cached blocks (active or free)
         self._hash_index: dict[int, int] = {}
+        # free blocks still holding content-addressed KV (maintained
+        # incrementally: O(free) scans per scrape would defeat the
+        # point of a per-step gauge)
+        self._cached_free = 0
 
     # -- introspection ----------------------------------------------------
     @property
     def num_free(self) -> int:
         return len(self._free)
+
+    @property
+    def num_cached_free(self) -> int:
+        """Free blocks whose content is still content-addressed — the
+        prefix cache's evictable working set (observability)."""
+        return self._cached_free
 
     @property
     def usage(self) -> float:
@@ -142,6 +152,8 @@ class BlockAllocator:
             return
         block.seq_hash = seq_hash
         self._hash_index[seq_hash] = block_id
+        if block_id in self._free:  # defensive: commits normally target
+            self._cached_free += 1  # active blocks
         if self.on_event:
             self.on_event("stored", [seq_hash], [block_id])
 
@@ -161,12 +173,14 @@ class BlockAllocator:
             else:
                 self._free[bid] = None  # cached-free: evict LRU-last
                 self._free.move_to_end(bid, last=True)
+                self._cached_free += 1
 
     # -- internals --------------------------------------------------------
     def _ref(self, bid: int) -> None:
         block = self._blocks[bid]
         if block.ref_count == 0:
-            self._free.pop(bid, None)
+            if self._free.pop(bid, -1) is None and block.seq_hash is not None:
+                self._cached_free -= 1
         block.ref_count += 1
 
     def _evictable_count(self) -> int:
@@ -179,6 +193,7 @@ class BlockAllocator:
         block = self._blocks[bid]
         if block.seq_hash is not None:
             # evicting cached content
+            self._cached_free -= 1
             self._hash_index.pop(block.seq_hash, None)
             if self.on_event:
                 self.on_event("removed", [block.seq_hash], [bid])

@@ -159,6 +159,22 @@ class EngineConfig:
     # the same startup-cost reason; the first such request pays a
     # one-time compile stall instead.
     prewarm_logprobs: bool = False
+    # observability (telemetry/{recorder,slo}.py; docs/observability.md)
+    # step flight recorder: ring of the last N step records, auto-dumped
+    # to JSONL around anomalies. 0 disables recording entirely.
+    flight_recorder_steps: int = 256
+    # slow-step watchdog: a device step longer than this dumps the ring
+    # (None = DYN_SLOW_STEP_MS env, else off). Millseconds of WALL time
+    # per dispatch — size it to a few windows, not a single token.
+    slow_step_ms: Optional[float] = None
+    # where flight-recorder dumps land ("" = DYN_FLIGHT_DIR or tmpdir)
+    flight_dump_dir: str = ""
+    # SLO targets evaluated per finished request (engine-side TTFT =
+    # submit -> first emitted token; ITL = mean decode inter-token
+    # latency). None = no target; attainment/goodput then track 1.0 /
+    # nothing while the raw TTFT/ITL histograms still populate.
+    slo_ttft_ms: Optional[float] = None
+    slo_itl_ms: Optional[float] = None
     # weights
     random_weights: bool = False  # bench/test mode: skip checkpoint load
     # weight-only quantization applied at load: None | "int8"
@@ -220,6 +236,13 @@ def load_engine_config(args: Any) -> EngineConfig:
         disk_kv_blocks=getattr(args, "disk_kv_blocks", 0),
         disk_kv_path=getattr(args, "disk_kv_path", ""),
         remote_kv_bucket=getattr(args, "remote_kv_bucket", ""),
+        flight_recorder_steps=getattr(
+            args, "flight_recorder_steps", EngineConfig.flight_recorder_steps
+        ),
+        slow_step_ms=getattr(args, "slow_step_ms", None),
+        flight_dump_dir=getattr(args, "flight_dump_dir", "") or "",
+        slo_ttft_ms=getattr(args, "slo_ttft_ms", None),
+        slo_itl_ms=getattr(args, "slo_itl_ms", None),
     )
     for k, v in extra.items():
         if hasattr(cfg, k):

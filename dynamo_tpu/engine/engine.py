@@ -63,6 +63,11 @@ from dynamo_tpu.protocols.common import (
 )
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
 from dynamo_tpu.telemetry import get_tracer
+from dynamo_tpu.telemetry.debug import (
+    register_debug_provider,
+    unregister_debug_provider,
+)
+from dynamo_tpu.telemetry.hbm import HbmAccountant, tree_bytes
 from dynamo_tpu.telemetry.instruments import (
     ENGINE_BATCH_OCCUPANCY,
     ENGINE_COMPILE_EVENTS,
@@ -71,11 +76,16 @@ from dynamo_tpu.telemetry.instruments import (
     ENGINE_REQUESTS_FINISHED,
     ENGINE_STEP_SECONDS,
     ENGINE_TOKENS_GENERATED,
+    KV_POOL_BLOCKS_ACTIVE,
+    KV_POOL_BLOCKS_TOTAL,
+    KV_POOL_CACHED_FREE_BLOCKS,
     SPEC_ACCEPT_RATE,
     SPEC_ACCEPTED_TOKENS,
     SPEC_PROPOSED_TOKENS,
     SPEC_STEP_SECONDS,
 )
+from dynamo_tpu.telemetry.recorder import FlightRecorder
+from dynamo_tpu.telemetry.slo import SloConfig, SloTracker
 from dynamo_tpu.tokens import DEFAULT_SALT, TokenBlockSequence
 
 log = logging.getLogger("dynamo_tpu.engine")
@@ -123,6 +133,15 @@ class ForwardPassMetrics:
     num_requests_waiting: int = 0
     gpu_cache_usage_perc: float = 0.0
     gpu_prefix_cache_hit_rate: float = 0.0
+    # SLO/goodput signals (telemetry/slo.py): rolling attainment of the
+    # configured TTFT/ITL targets and cumulative goodput tokens — the
+    # Planner scales on *goodput*, not raw load, when targets are set.
+    # slo_enabled lets aggregators average attainment over only the
+    # workers that actually evaluate targets (a target-less worker's
+    # constant 1.0 would dilute the fleet signal).
+    slo_enabled: bool = False
+    slo_attainment: float = 1.0
+    goodput_tokens_total: int = 0
 
     def to_dict(self) -> dict:
         return self.__dict__.copy()
@@ -172,9 +191,40 @@ class JaxEngine:
         self._spec_step_fn: Optional[Callable] = None
         self.spec_proposed_total = 0  # bench/introspection counters
         self.spec_accepted_total = 0
+        # per-engine token counter (the registry counter is process-
+        # global): /debug/state exposes it so `top` can derive tok/s
+        # from deltas regardless of SLO configuration
+        self.tokens_generated_total = 0
         # recent sync=False dispatches whose device errors would DEFER
         # to a later synced step (_annotate_deferred_error)
         self._unsynced_steps: list[str] = []
+        # observability (docs/observability.md): step flight recorder
+        # with slow-step watchdog, SLO/goodput tracker, HBM accountant
+        slow_ms = config.slow_step_ms
+        if slow_ms is None:
+            try:
+                env = os.environ.get("DYN_SLOW_STEP_MS")
+                slow_ms = float(env) if env else None
+            except ValueError:
+                log.warning("ignoring malformed DYN_SLOW_STEP_MS")
+                slow_ms = None
+        self.recorder: Optional[FlightRecorder] = (
+            FlightRecorder(
+                capacity=config.flight_recorder_steps,
+                slow_step_s=slow_ms / 1e3 if slow_ms else None,
+                dump_dir=config.flight_dump_dir,
+            )
+            if config.flight_recorder_steps > 0
+            else None
+        )
+        self.slo = SloTracker(
+            SloConfig(ttft_ms=config.slo_ttft_ms, itl_ms=config.slo_itl_ms)
+        )
+        self.hbm = HbmAccountant()
+        # per-dispatch phase timings (_run_device_step fills; the step
+        # recorder reads) — a plain dict, engine-thread only
+        self._last_phases: dict[str, float] = {}
+        self._debug_name: Optional[str] = None
         try:
             self.PIPELINE_DEPTH = max(
                 1, int(os.environ.get("DYN_PIPELINE_DEPTH", "2"))
@@ -207,6 +257,11 @@ class JaxEngine:
             target=engine._step_loop, name="jax-engine", daemon=True
         )
         engine._thread.start()
+        # live introspection: /debug/state serves this snapshot (latest
+        # engine wins the bare "engine" name; shutdown unregisters only
+        # its own registration)
+        engine._debug_name = "engine"
+        register_debug_provider(engine._debug_name, engine.debug_state)
         return engine
 
     def _initialize(self) -> None:
@@ -604,6 +659,13 @@ class JaxEngine:
         self._gate_kv_offload()
         if prewarm:
             self._prewarm()
+        # HBM accounting: long-lived allocations once, live stats on
+        # refresh (per-step sampled + every /debug/state snapshot)
+        self.hbm.set_device(devices[0] if len(devices) else None)
+        self.hbm.set_static(
+            tree_bytes(self.params), tree_bytes((self.k_cache, self.v_cache))
+        )
+        self.hbm.refresh()
         log.info(
             "engine up: %s, mesh=%s, blocks=%d×%d",
             cfg.model_name,
@@ -1473,6 +1535,7 @@ class JaxEngine:
                 self._mh_broadcast.announce_step_mm(arrays, sampling)
             else:
                 self._mh_broadcast.announce_step(arrays, sampling)
+        t_disp = time.monotonic()
         if "extra_embeds" in arrays:
             out = self._step_fn_mm(
                 *base_args, arrays["extra_embeds"], arrays["embeds_mask"]
@@ -1480,6 +1543,10 @@ class JaxEngine:
         else:
             out = self._step_fn(*base_args)
         self.k_cache, self.v_cache = out[-2], out[-1]
+        t_done = time.monotonic()
+        self._last_phases = {
+            "dispatch_ms": round((t_done - t_disp) * 1e3, 3)
+        }
         if not sync:
             self._unsynced_steps.append(
                 origin or f"shape={arrays['tokens'].shape}"
@@ -1491,6 +1558,9 @@ class JaxEngine:
         # (next_tokens, logprobs) base; (+ top_ids, top_lps) on the
         # top-logprobs variant
         res = tuple(host_value(x) for x in out[:-2])
+        self._last_phases["sync_ms"] = round(
+            (time.monotonic() - t_done) * 1e3, 3
+        )
         # a successful sync retires every earlier async dispatch
         # (in-order device execution): their deferred errors would have
         # surfaced in this host read
@@ -1792,6 +1862,61 @@ class JaxEngine:
                 " ".join(f"{k}={v}" for k, v in fields.items()),
             )
 
+    # -- step flight recording (telemetry/recorder.py) ---------------------
+    _step_counter = 0
+    _last_preemptions = 0
+
+    def _update_pool_gauges(self) -> None:
+        """KV-pool occupancy gauges from the allocator (refreshed per
+        step AND per debug snapshot so /metrics and /debug/state agree
+        on the same moment)."""
+        alloc = self.allocator
+        if alloc is None:
+            return
+        KV_POOL_BLOCKS_TOTAL.set(alloc.num_blocks - 1)
+        KV_POOL_BLOCKS_ACTIVE.set(alloc.num_blocks - 1 - alloc.num_free)
+        KV_POOL_CACHED_FREE_BLOCKS.set(alloc.num_cached_free)
+
+    def _record_step(
+        self, kind: str, duration_s: float,
+        batch: int = 0, prefill_rows: int = 0, use_phases: bool = True,
+        **extra,
+    ) -> None:
+        """One flight-recorder entry per device step: kind, batch
+        composition, queue depth, per-phase latency (dispatch/sync from
+        ``_last_phases``), preemption delta. Engine-thread only.
+
+        ``use_phases=False`` for records whose dispatch did NOT go
+        through ``_run_device_step`` (fused windows, spec) — merging
+        ``_last_phases`` there would attribute a stale, unrelated
+        dispatch's timings to this step."""
+        sched = self.scheduler
+        self._step_counter += 1
+        self._update_pool_gauges()
+        if self._step_counter % 32 == 0:
+            try:
+                self.hbm.refresh()
+            except Exception:  # stats are advisory; never fail a step
+                log.debug("hbm refresh failed", exc_info=True)
+        phases, self._last_phases = self._last_phases, {}
+        if self.recorder is None or sched is None:
+            return
+        pre = sched.preemptions
+        fields = dict(
+            batch=batch,
+            prefill_rows=prefill_rows,
+            running=sched.num_running,
+            prefilling=len(sched.prefilling),
+            queue_depth=sched.num_waiting,
+            kv_free=self.allocator.num_free if self.allocator else 0,
+            preemptions=pre - self._last_preemptions,
+        )
+        self._last_preemptions = pre
+        if use_phases:
+            fields.update(phases)
+        fields.update(extra)
+        self.recorder.record(kind, duration_s, **fields)
+
     def _one_step(self) -> None:
         sched = self.scheduler
         assert sched is not None
@@ -1801,6 +1926,11 @@ class JaxEngine:
         self._last_plan = None
         plan = sched.plan()
         self._last_plan = plan  # step-failure attribution (quarantine)
+        plan_ms = round((time.monotonic() - t_plan) * 1e3, 3)
+        # phase stamps from an earlier, never-recorded dispatch (e.g. a
+        # dedicated prefill inside the window pipeline) must not leak
+        # into this step's record
+        self._last_phases = {}
         # per-step load gauges: two locked float stores per step, noise
         # next to a device dispatch
         ENGINE_BATCH_OCCUPANCY.set(
@@ -1881,7 +2011,7 @@ class JaxEngine:
             )
             return
 
-        t0 = time.monotonic()
+        t_step = time.monotonic()
         need_sync = plan.kind != "prefill" or any(
             w.is_last_chunk for w in plan.prefill_batch
         )
@@ -1896,11 +2026,19 @@ class JaxEngine:
             tops = s_out[2:] if len(s_out) > 2 else None
         else:
             next_tokens = logprobs = tops = None
-        ENGINE_STEP_SECONDS.labels(plan.kind).observe(time.monotonic() - t0)
+        dt = time.monotonic() - t_step
+        ENGINE_STEP_SECONDS.labels(plan.kind).observe(dt)
+        self._record_step(
+            plan.kind, dt,
+            batch=len(seqs),
+            prefill_rows=len(plan.prefill_batch),
+            plan_ms=plan_ms,
+            synced=need_sync,
+        )
         self._trace(
             "dispatch_" + plan.kind,
             shape=arrays["tokens"].shape,
-            ms=round((time.monotonic() - t0) * 1e3, 1),
+            ms=round(dt * 1e3, 1),
             sync=need_sync,
         )
 
@@ -2015,7 +2153,8 @@ class JaxEngine:
         # the draft-phase histogram covers PROPOSAL cost only (the
         # drafter-tuning signal) — staging/array/sampling prep below is
         # fixed per-step engine work, not drafter work
-        SPEC_STEP_SECONDS.labels("draft").observe(time.monotonic() - t_draft)
+        draft_s = time.monotonic() - t_draft
+        SPEC_STEP_SECONDS.labels("draft").observe(draft_s)
         if not any(d for _, d in proposals):
             return False  # nothing staged: caller runs plain decode
         works: list[tuple] = []
@@ -2057,9 +2196,19 @@ class JaxEngine:
                 if len(row) > 1:
                     seq.tokens.unwind(len(row) - 1)
             raise
-        SPEC_STEP_SECONDS.labels("verify").observe(time.monotonic() - t0)
+        verify_s = time.monotonic() - t0
+        SPEC_STEP_SECONDS.labels("verify").observe(verify_s)
         proposed = sum(len(row) - 1 for _, row in works)
         accepted = int(sum(n_emit[i] - 1 for i in range(len(works))))
+        self._record_step(
+            "spec", draft_s + verify_s,
+            batch=len(works),
+            use_phases=False,  # draft/verify ms below ARE the phases
+            draft_ms=round(draft_s * 1e3, 3),
+            verify_ms=round(verify_s * 1e3, 3),
+            spec_proposed=proposed,
+            spec_accepted=accepted,
+        )
         if proposed:
             SPEC_PROPOSED_TOKENS.labels(self._drafter.kind).inc(proposed)
             if accepted:
@@ -2469,12 +2618,24 @@ class JaxEngine:
             # forensics
             self._unsynced_steps.clear()
             sub_lag(e)
+            win_s = time.monotonic() - t0
+            # one flight-recorder entry per WINDOW (the serving-path
+            # unit of work): duration is the host-side sync+emit wait —
+            # the dispatch overlapped earlier windows by design
+            self._record_step(
+                "window_" + e["kind"], win_s,
+                batch=len(e["seqs"]),
+                prefill_rows=len(e["works"]),
+                pipeline_depth=len(pending),
+                use_phases=False,  # dispatched via the window fns, not
+                # _run_device_step — its phase stamps belong elsewhere
+            )
             self._trace(
                 "window", kind=e["kind"], b=len(e["seqs"]),
                 p=len(e["works"]), wait=len(sched.waiting),
                 pref=len(sched.prefilling), run=len(sched.running),
                 depth=len(pending),
-                ms=round((time.monotonic() - t0) * 1e3, 1),
+                ms=round(win_s * 1e3, 1),
             )
 
         def try_extend() -> bool:
@@ -2569,6 +2730,7 @@ class JaxEngine:
         assert sched is not None
         sched.append_token(seq, token)
         ENGINE_TOKENS_GENERATED.inc()
+        self.tokens_generated_total += 1
         if seq.emit is not None:
             tl = None
             if top is not None and (seq.request.output.logprobs or 0) > 0:
@@ -2612,6 +2774,7 @@ class JaxEngine:
                 break
         if kept_toks:
             ENGINE_TOKENS_GENERATED.inc(len(kept_toks))
+            self.tokens_generated_total += len(kept_toks)
         if kept_toks and seq.emit is not None:
             seq.emit(
                 LLMEngineOutput(
@@ -2626,10 +2789,12 @@ class JaxEngine:
 
     def _emit_finish(self, seq: Sequence, reason: FinishReason) -> None:
         """Scheduler on_finish hook: close the request's output stream,
-        bump finish counters, and emit the request's engine-side span
-        tree (queue wait → prefill → decode) from the lifecycle stamps
-        the scheduler recorded."""
+        bump finish counters, evaluate the request against the SLO
+        targets, and emit the request's engine-side span tree (queue
+        wait → prefill → decode) from the lifecycle stamps the
+        scheduler recorded."""
         ENGINE_REQUESTS_FINISHED.labels(str(reason.value)).inc()
+        self._observe_slo(seq, reason)
         self._emit_lifecycle_spans(seq, reason)
         if seq.emit is not None:
             seq.emit(
@@ -2641,6 +2806,39 @@ class JaxEngine:
                 )
             )
             seq.emit(None)  # sentinel: stream closed
+
+    def _observe_slo(self, seq: Sequence, reason: FinishReason) -> None:
+        """Per-request TTFT/ITL vs the configured targets (telemetry/
+        slo.py). Engine-side TTFT = submit → first appended token; ITL
+        = mean decode inter-token latency. Requests that never produced
+        a token (errors/cancellations before first emit) don't score —
+        they'd poison attainment with infrastructure failures the SLO
+        targets don't describe. An SLO miss trips the flight recorder's
+        request watchdog so the steps that served the slow request are
+        preserved on disk."""
+        if reason in (FinishReason.ERROR, FinishReason.CANCELLED):
+            # infrastructure failures and client disconnects don't
+            # score: counting an errored request's fast partial tokens
+            # as 'met' goodput would report a fleet in an error loop as
+            # HEALTHY — the opposite of what the Planner signal means
+            return
+        if not seq.t_submit or not seq.t_first_token:
+            return
+        ttft_s = seq.t_first_token - seq.t_submit
+        itl_s = None
+        if seq.generated > 1:
+            itl_s = (time.monotonic() - seq.t_first_token) / (
+                seq.generated - 1
+            )
+        met = self.slo.observe(ttft_s, itl_s, completion_tokens=seq.generated)
+        if not met and self.recorder is not None:
+            self.recorder.note_slow_request(
+                seq.request_id,
+                ttft_ms=round(ttft_s * 1e3, 3),
+                itl_ms=round(itl_s * 1e3, 3) if itl_s is not None else None,
+                tokens=seq.generated,
+                finish_reason=str(reason.value),
+            )
 
     def _emit_lifecycle_spans(self, seq: Sequence, reason: FinishReason) -> None:
         """Record the engine's per-request spans at finish time. Span
@@ -2869,11 +3067,127 @@ class JaxEngine:
                 if sched.prefix_queries
                 else 0.0
             ),
+            slo_enabled=self.slo.config.enabled,
+            slo_attainment=self.slo.attainment,
+            goodput_tokens_total=self.slo.goodput_tokens,
         )
+
+    def debug_state(self) -> dict:
+        """Live snapshot for ``/debug/state`` (telemetry/debug.py):
+        scheduler slots, KV block pool occupancy/fragmentation, prefill
+        queue depth, in-flight requests, recent flight-recorder steps,
+        SLO attainment, HBM accounting.
+
+        Reads live structures WITHOUT stopping the engine thread — a
+        snapshot that waited for the step loop would hang exactly when
+        the loop is stuck, which is when you need it. Values may be a
+        step apart from each other; every field is advisory."""
+        sched, alloc = self.scheduler, self.allocator
+        out: dict = {
+            "model": self.config.model_name,
+            "running": self._running,
+            "max_batch_size": self.config.max_batch_size,
+            "decode_steps": self.config.decode_steps,
+            "block_size": self.config.block_size,
+            "tokens_generated_total": self.tokens_generated_total,
+        }
+        if sched is not None:
+            def req_row(seq) -> dict:
+                return {
+                    "request_id": seq.request_id,
+                    "state": str(seq.state.value),
+                    "prompt_tokens": len(seq.request.token_ids),
+                    "generated": seq.generated,
+                    "computed": seq.num_computed,
+                    "blocks": len(seq.block_table),
+                }
+
+            running = list(sched.running)
+            prefilling = list(sched.prefilling)
+            waiting = list(sched.waiting)
+            out["scheduler"] = {
+                "running": len(running),
+                "prefilling": len(prefilling),
+                "waiting": len(waiting),
+                "queue_depth": len(waiting) + len(prefilling),
+                "preemptions": sched.preemptions,
+                "prefix_queries": sched.prefix_queries,
+                "prefix_hits": sched.prefix_hits,
+                # bounded: the fleet view needs the shape of the batch,
+                # not one row per request at max_batch_size=256
+                "requests": [
+                    req_row(s) for s in (running + prefilling + waiting)[:64]
+                ],
+            }
+        if alloc is not None:
+            self._update_pool_gauges()
+            usable = alloc.num_blocks - 1
+            free = alloc.num_free
+            cached_free = alloc.num_cached_free
+            out["kv_pool"] = {
+                "total_blocks": usable,
+                "active_blocks": usable - free,
+                "free_blocks": free,
+                "cached_free_blocks": cached_free,
+                "usage": alloc.usage,
+                # fraction of the free pool still holding reusable
+                # content-addressed KV (the prefix cache's evictable
+                # working set — high is GOOD until allocation pressure
+                # starts evicting it)
+                "cached_free_fraction": (cached_free / free) if free else 0.0,
+            }
+        out["hbm"] = self.hbm.refresh()
+        out["slo"] = self.slo.stats()
+        if self.recorder is not None:
+            out["flight_recorder"] = self.recorder.stats()
+            out["recent_steps"] = self.recorder.snapshot(32)
+        if self._drafter is not None:
+            out["spec"] = {
+                "drafter": getattr(self._drafter, "kind", "?"),
+                "proposed_total": self.spec_proposed_total,
+                "accepted_total": self.spec_accepted_total,
+            }
+        if sched is not None and alloc is not None:
+            out["load"] = self.stats().to_dict()
+        return out
+
+    async def wait_for_state(
+        self, predicate: Callable[["JaxEngine"], bool],
+        timeout: float = 30.0, poll_s: float = 0.005,
+    ) -> None:
+        """Await an engine-state condition (e.g. ``lambda e:
+        e.scheduler.num_running >= 3``) instead of sleeping a guessed
+        wall-clock interval — the injectable-event replacement for
+        timing-based test choreography. Raises asyncio.TimeoutError."""
+        deadline = time.monotonic() + timeout
+        last_exc: Optional[BaseException] = None
+        while True:
+            try:
+                if predicate(self):
+                    return
+                last_exc = None
+            except Exception as exc:
+                # tolerated (scheduler mid-mutation races) but REMEMBERED:
+                # a predicate that raises every poll (typo'd attribute)
+                # must surface its error, not a bare timeout
+                last_exc = exc
+            if time.monotonic() >= deadline:
+                detail = (
+                    f"; predicate raised every poll: {last_exc!r}"
+                    if last_exc is not None else ""
+                )
+                raise asyncio.TimeoutError(
+                    f"engine state predicate not met within {timeout}s"
+                    + detail
+                )
+            await asyncio.sleep(poll_s)
 
     async def shutdown(self) -> None:
         self._running = False
         self._wake.set()
+        if self._debug_name is not None:
+            unregister_debug_provider(self._debug_name, self.debug_state)
+            self._debug_name = None
         from dynamo_tpu.models.llama import (
             get_attention_mesh,
             set_attention_mesh,

@@ -79,6 +79,7 @@ class Sequence:
     t_submit_wall: float = 0.0  # same instant, wall clock
     t_admit: float = 0.0  # first admission into prefilling
     t_prefill_done: float = 0.0  # last prompt chunk computed
+    t_first_token: float = 0.0  # first generated token appended (TTFT)
     # propagated trace context ({"trace_id", "span_id"}) or None
     trace: Optional[dict] = None
 
@@ -825,6 +826,10 @@ class Scheduler:
     def append_token(self, seq: Sequence, token: int) -> None:
         seq.tokens.append(int(token))
         seq.generated += 1
+        if seq.t_first_token == 0.0:
+            # TTFT stamp (telemetry/slo.py): every emit path — plain
+            # step, fused window, spec verify — funnels through here
+            seq.t_first_token = time.monotonic()
         if seq.request.sampling.needs_penalties:
             seq.gen_counts[int(token)] = seq.gen_counts.get(int(token), 0) + 1
         # the just-sampled token's KV is NOT in the cache yet — it only gets

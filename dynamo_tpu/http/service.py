@@ -41,7 +41,13 @@ from dynamo_tpu.protocols.openai import (
 from dynamo_tpu.protocols.sse import encode_done, encode_sse
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 from dynamo_tpu.runtime.logging import set_log_request_id
-from dynamo_tpu.telemetry import REGISTRY, get_tracer, propagation_context
+from dynamo_tpu.telemetry import (
+    REGISTRY,
+    capture_profile,
+    collect_debug_state,
+    get_tracer,
+    propagation_context,
+)
 from dynamo_tpu.telemetry.instruments import (
     HTTP_DURATION,
     HTTP_INFLIGHT,
@@ -113,6 +119,8 @@ class HttpService:
                 web.get("/health", self._health),
                 web.get("/live", self._health),
                 web.get("/metrics", self._metrics),
+                web.get("/debug/state", self._debug_state),
+                web.get("/debug/profile", self._debug_profile),
                 web.get("/v1/models", self._models),
                 web.post("/v1/chat/completions", self._chat),
                 web.post("/v1/completions", self._completions),
@@ -148,6 +156,39 @@ class HttpService:
 
     async def _metrics(self, request: web.Request) -> web.Response:
         return web.Response(text=REGISTRY.render(), content_type="text/plain")
+
+    async def _debug_state(self, request: web.Request) -> web.Response:
+        """Live introspection (docs/observability.md): a JSON snapshot
+        from every registered debug provider — scheduler slots, KV pool
+        occupancy, flight-recorder tail, SLO attainment, HBM — plus the
+        frontend's own model table. `dynamo-tpu top` polls this."""
+        state = collect_debug_state()
+        state["frontend"] = {
+            "models": [m.id for m in self.models.list_models().data],
+            "host": self.host,
+            "port": self.port,
+        }
+        return web.json_response(state)
+
+    async def _debug_profile(self, request: web.Request) -> web.Response:
+        """On-demand ``jax.profiler`` capture: ``/debug/profile?ms=N``
+        records N ms and returns the Perfetto-loadable trace dir."""
+        try:
+            ms = int(request.query.get("ms", "1000"))
+        except ValueError:
+            return web.json_response(
+                {"error": "ms must be an integer"}, status=400
+            )
+        try:
+            result = await capture_profile(ms)
+        except RuntimeError as exc:  # capture already running
+            return web.json_response({"error": str(exc)}, status=409)
+        except Exception as exc:
+            log.exception("profile capture failed")
+            return web.json_response(
+                {"error": f"{type(exc).__name__}: {exc}"}, status=500
+            )
+        return web.json_response(result)
 
     async def _models(self, request: web.Request) -> web.Response:
         return web.json_response(self.models.list_models().model_dump())

@@ -39,6 +39,14 @@ class ForwardPassMetrics(BaseModel):
     num_requests_waiting: int = 0
     gpu_cache_usage_perc: float = 0.0
     gpu_prefix_cache_hit_rate: float = 0.0
+    # SLO/goodput signals (telemetry/slo.py; defaults keep the wire
+    # compatible with workers that predate them). slo_enabled marks a
+    # worker that actually evaluates targets — aggregators average
+    # attainment over only those (a target-less worker's constant 1.0
+    # would dilute the fleet signal).
+    slo_enabled: bool = False
+    slo_attainment: float = 1.0
+    goodput_tokens_total: int = 0
 
 
 class KvHitRateEvent(BaseModel):

@@ -25,7 +25,9 @@ from aiohttp import web
 
 from dynamo_tpu.kv_router.scheduler import KvMetricsAggregator
 from dynamo_tpu.runtime.component import Component
+from dynamo_tpu.telemetry.debug import capture_profile, collect_debug_state
 from dynamo_tpu.telemetry.metrics import Registry
+from dynamo_tpu.telemetry.slo import aggregate_slo
 from dynamo_tpu.utils.tasks import spawn
 
 log = logging.getLogger("dynamo_tpu.metrics")
@@ -77,6 +79,14 @@ class MetricsService:
             "llm_kv_hit_rate_events", "KV hit rate events seen")
         self._g_avg_hit = r.gauge(
             "llm_kv_avg_hit_rate", "mean prefix overlap fraction")
+        # SLO/goodput rollup (telemetry/slo.py signals riding the same
+        # load_metrics feed — the Planner scales on these)
+        self._g_slo_attainment = r.gauge(
+            "llm_slo_attainment", "mean rolling SLO attainment across "
+            "workers reporting targets")
+        self._g_goodput = r.gauge(
+            "llm_goodput_tokens", "total goodput tokens (SLO-met "
+            "completion tokens) across workers")
 
     async def start(self) -> None:
         sub = await self.component.subscribe("load_metrics")
@@ -97,6 +107,8 @@ class MetricsService:
         self._hit_task = spawn(pump_hits(), name="metrics-hit-pump")
         app = web.Application()
         app.router.add_get("/metrics", self._handle_metrics)
+        app.router.add_get("/debug/state", self._handle_debug_state)
+        app.router.add_get("/debug/profile", self._handle_debug_profile)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -145,10 +157,44 @@ class MetricsService:
         )
         self._g_hit_events.set(float(self._hit_events))
         self._g_avg_hit.set(avg_hit)
+        attainment, goodput = aggregate_slo(fresh.values())
+        self._g_slo_attainment.set(attainment)
+        self._g_goodput.set(goodput)
         return self.registry.render()
 
     async def _handle_metrics(self, _req: web.Request) -> web.Response:
         return web.Response(text=self.render(), content_type="text/plain")
+
+    async def _handle_debug_state(self, _req: web.Request) -> web.Response:
+        """Fleet-side /debug/state: the aggregator's per-worker load
+        view plus any local debug providers (an in-process engine's
+        snapshot shows up here when the metrics server shares the
+        worker process)."""
+        state = collect_debug_state()
+        fresh = self.aggregator.fresh_metrics()
+        state["workers"] = {
+            f"{wid:x}": m.model_dump() if hasattr(m, "model_dump")
+            else dict(m.__dict__)
+            for wid, m in sorted(fresh.items())
+        }
+        return web.json_response(state)
+
+    async def _handle_debug_profile(self, req: web.Request) -> web.Response:
+        try:
+            ms = int(req.query.get("ms", "1000"))
+        except ValueError:
+            return web.json_response(
+                {"error": "ms must be an integer"}, status=400
+            )
+        try:
+            return web.json_response(await capture_profile(ms))
+        except RuntimeError as exc:
+            return web.json_response({"error": str(exc)}, status=409)
+        except Exception as exc:
+            log.exception("profile capture failed")
+            return web.json_response(
+                {"error": f"{type(exc).__name__}: {exc}"}, status=500
+            )
 
     async def close(self) -> None:
         if self._hit_task is not None:

@@ -26,6 +26,7 @@ from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
 from dynamo_tpu.kv_router.scheduler import KvMetricsAggregator
 from dynamo_tpu.runtime.component import Component
 from dynamo_tpu.store.base import Store
+from dynamo_tpu.telemetry.slo import aggregate_slo
 
 log = logging.getLogger("dynamo_tpu.planner")
 
@@ -113,11 +114,21 @@ class Planner:
         kv_load = sum(usages) / len(usages) if usages else 0.0
         depth = await self.queue.depth()
         per_worker = depth / max(1, self.prefill_workers)
+        # SLO/goodput signals riding the same load_metrics feed
+        # (telemetry/slo.py aggregate_slo — one rollup shared with the
+        # metrics service so the two can't diverge): attainment is the
+        # health signal raw KV load can't see — a fleet can sit under
+        # the KV watermark while every request misses its ITL target.
+        # Logged to metrics_log (numeric keys flow to JSONL/TensorBoard
+        # automatically) and available to watermark logic.
+        attainment, goodput = aggregate_slo(fresh.values())
         snap = {
             "kv_load_mean": kv_load,
             "decode_workers_reporting": float(len(fresh)),
             "prefill_queue_depth": float(depth),
             "prefill_queue_per_worker": per_worker,
+            "slo_attainment_mean": attainment,
+            "goodput_tokens_total": goodput,
             "ts": time.time(),
         }
         self.history.append(snap)

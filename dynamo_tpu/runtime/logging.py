@@ -122,7 +122,14 @@ class JsonlFormatter(logging.Formatter):
 def _load_config_file(path: str) -> dict[str, Any]:
     try:
         if path.endswith(".toml"):
-            import tomllib
+            try:
+                import tomllib  # py311+
+            except ImportError:
+                # 3.10: the vendored tomli this environment ships. A
+                # bare `import tomllib` here used to land in the broad
+                # except below, silently IGNORING the whole config file
+                # (tier-1 test_logging caught it).
+                import tomli as tomllib  # type: ignore[no-redef]
 
             with open(path, "rb") as f:
                 return tomllib.load(f)

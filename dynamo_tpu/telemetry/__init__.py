@@ -1,6 +1,7 @@
-"""dynamo_tpu.telemetry — dependency-free tracing + metrics.
+"""dynamo_tpu.telemetry — dependency-free tracing, metrics, and live
+introspection.
 
-Two halves (docs/observability.md is the operator-facing guide):
+Four pieces (docs/observability.md is the operator-facing guide):
 
 - **Spans** (spans.py): ``get_tracer().span("name", parent=ctx)`` with
   trace-context propagation over the existing transport. Enabled by
@@ -9,6 +10,13 @@ Two halves (docs/observability.md is the operator-facing guide):
 - **Metrics** (metrics.py): one process registry of labeled counters/
   gauges/histograms with Prometheus text exposition and cardinality
   guard rails; the serving stack's catalog lives in instruments.py.
+- **Live introspection** (debug.py, recorder.py, hbm.py): the
+  ``/debug/state``/``/debug/profile`` provider registry, the engine's
+  step flight recorder with slow-step watchdog dumps, and HBM memory
+  accounting. ``dynamo-tpu top`` renders the fleet view.
+- **SLO/goodput** (slo.py): per-request TTFT/ITL vs configured targets
+  → ``dynamo_slo_attainment``/``dynamo_goodput_tokens_total``, riding
+  the worker load feed for the Planner.
 """
 
 from dynamo_tpu.telemetry.metrics import (  # noqa: F401
@@ -21,6 +29,16 @@ from dynamo_tpu.telemetry.metrics import (  # noqa: F401
     check_scrape_safety,
     escape_label_value,
 )
+from dynamo_tpu.telemetry.debug import (  # noqa: F401
+    capture_profile,
+    collect_debug_state,
+    debug_provider_names,
+    register_debug_provider,
+    unregister_debug_provider,
+)
+from dynamo_tpu.telemetry.hbm import HbmAccountant, tree_bytes  # noqa: F401
+from dynamo_tpu.telemetry.recorder import FlightRecorder  # noqa: F401
+from dynamo_tpu.telemetry.slo import SloConfig, SloTracker  # noqa: F401
 from dynamo_tpu.telemetry.spans import (  # noqa: F401
     NULL_SPAN,
     JsonlSpanExporter,

@@ -1,0 +1,107 @@
+"""Live-introspection plumbing behind the ``/debug/*`` endpoints.
+
+A process-global registry of *debug-state providers*: any subsystem
+that can describe "what am I doing right now" registers a zero-arg
+callable returning a JSON-able dict (the engine registers its
+scheduler/KV-pool/flight-recorder snapshot; a metrics service registers
+its aggregator view). ``collect_debug_state()`` assembles one snapshot
+— a provider that raises contributes an ``{"error": ...}`` stanza
+instead of breaking the endpoint (introspection must keep working
+exactly when things are broken).
+
+``capture_profile()`` backs ``/debug/profile?ms=N``: an on-demand
+``jax.profiler`` capture written where TensorBoard/Perfetto can load it
+(the profiler emits ``plugins/profile/*/trace.json.gz`` under the
+output dir — load it at https://ui.perfetto.dev). One capture at a
+time per process; concurrent requests get a busy error.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import tempfile
+import threading
+import time
+from typing import Callable, Optional
+
+log = logging.getLogger("dynamo_tpu.telemetry.debug")
+
+_providers: dict[str, Callable[[], dict]] = {}
+_providers_lock = threading.Lock()
+
+# one jax.profiler capture at a time (the profiler itself is global)
+_profile_lock = threading.Lock()
+_profile_seq = 0
+
+MAX_PROFILE_MS = 30_000
+
+
+def register_debug_provider(name: str, fn: Callable[[], dict]) -> None:
+    """Register (or replace) a named snapshot provider."""
+    with _providers_lock:
+        _providers[name] = fn
+
+
+def unregister_debug_provider(
+    name: str, fn: Optional[Callable[[], dict]] = None
+) -> None:
+    """Remove a provider; with ``fn`` given, only if it is still the
+    registered one (an engine shutting down must not yank a newer
+    engine's registration)."""
+    with _providers_lock:
+        # == (not `is`): bound methods are fresh objects per attribute
+        # access but compare equal for the same instance+function
+        if fn is None or _providers.get(name) == fn:
+            _providers.pop(name, None)
+
+
+def debug_provider_names() -> list[str]:
+    with _providers_lock:
+        return sorted(_providers)
+
+
+def collect_debug_state() -> dict:
+    """One JSON-able snapshot across every registered provider."""
+    with _providers_lock:
+        providers = dict(_providers)
+    out: dict = {"ts": time.time(), "pid": os.getpid()}
+    for name, fn in sorted(providers.items()):
+        try:
+            out[name] = fn()
+        except Exception as exc:
+            # the snapshot reads live structures without stopping the
+            # world — a torn read must degrade to an error stanza, not
+            # a 500 on the one endpoint you need during an incident
+            log.exception("debug provider %r failed", name)
+            out[name] = {"error": f"{type(exc).__name__}: {exc}"}
+    return out
+
+
+async def capture_profile(ms: int, out_dir: str = "") -> dict:
+    """Run ``jax.profiler`` for ``ms`` milliseconds; returns
+    ``{"trace_dir", "duration_ms"}`` (raises RuntimeError when a capture
+    is already running or the profiler is unavailable)."""
+    global _profile_seq
+    ms = max(1, min(int(ms), MAX_PROFILE_MS))
+    if not _profile_lock.acquire(blocking=False):
+        raise RuntimeError("a profile capture is already running")
+    try:
+        import jax
+
+        _profile_seq += 1
+        d = out_dir or os.path.join(
+            os.environ.get("DYN_PROFILE_DIR") or tempfile.gettempdir(),
+            f"dynamo_profile_{os.getpid()}_{_profile_seq:03d}",
+        )
+        os.makedirs(d, exist_ok=True)
+        jax.profiler.start_trace(d)
+        try:
+            await asyncio.sleep(ms / 1000.0)
+        finally:
+            jax.profiler.stop_trace()
+        log.info("profiler capture (%d ms) -> %s", ms, d)
+        return {"trace_dir": d, "duration_ms": ms}
+    finally:
+        _profile_lock.release()

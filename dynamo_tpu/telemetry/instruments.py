@@ -132,6 +132,96 @@ KVBM_ONBOARDED_BLOCKS = REGISTRY.counter(
     "Blocks promoted from offload tiers back into device HBM",
 )
 
+# -- SLO / goodput (telemetry/slo.py; targets via --slo-ttft-ms/--slo-itl-ms)
+# latency-target-shaped buckets: TTFT targets live in the tens-of-ms to
+# tens-of-seconds range, ITL targets in the ms to hundreds-of-ms range
+_TTFT_BUCKETS = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+    float("inf"),
+)
+_ITL_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    float("inf"),
+)
+REQUEST_TTFT_SECONDS = REGISTRY.histogram(
+    "dynamo_request_ttft_seconds",
+    "Per-request time to first token, measured at the engine "
+    "(submit to first emitted token)",
+    buckets=_TTFT_BUCKETS,
+)
+REQUEST_ITL_SECONDS = REGISTRY.histogram(
+    "dynamo_request_itl_seconds",
+    "Per-request mean inter-token latency over the decode phase",
+    buckets=_ITL_BUCKETS,
+)
+SLO_ATTAINMENT = REGISTRY.gauge(
+    "dynamo_slo_attainment",
+    "Rolling fraction of recent requests meeting the configured "
+    "TTFT/ITL targets (1.0 when no targets are set)",
+)
+GOODPUT_TOKENS = REGISTRY.counter(
+    "dynamo_goodput_tokens_total",
+    "Completion tokens from requests that met their SLO targets",
+)
+SLO_REQUESTS = REGISTRY.counter(
+    "dynamo_slo_requests_total",
+    "Requests evaluated against the SLO targets, by outcome",
+    labels=("outcome",),  # met | missed
+)
+
+# -- flight recorder + slow-step watchdog (telemetry/recorder.py) -----------
+SLOW_STEPS = REGISTRY.counter(
+    "dynamo_engine_slow_steps_total",
+    "Engine steps that breached the slow-step watchdog threshold",
+    labels=("kind",),
+)
+FLIGHT_DUMPS = REGISTRY.counter(
+    "dynamo_flight_recorder_dumps_total",
+    "Flight-recorder ring dumps written, by trigger",
+    labels=("reason",),  # slow_step | slow_request | manual
+)
+
+# -- KV pool occupancy (allocator view; refreshed per step + per snapshot) --
+KV_POOL_BLOCKS_ACTIVE = REGISTRY.gauge(
+    "dynamo_kv_pool_blocks_active",
+    "KV blocks currently referenced by sequences (excludes the "
+    "reserved garbage block)",
+)
+KV_POOL_BLOCKS_TOTAL = REGISTRY.gauge(
+    "dynamo_kv_pool_blocks_total",
+    "Usable KV blocks in the device pool (excludes the reserved "
+    "garbage block)",
+)
+KV_POOL_CACHED_FREE_BLOCKS = REGISTRY.gauge(
+    "dynamo_kv_pool_cached_free_blocks",
+    "Free blocks still holding content-addressed (reusable) KV — the "
+    "prefix cache's evictable working set",
+)
+
+# -- HBM accounting (telemetry/hbm.py) --------------------------------------
+HBM_WEIGHT_BYTES = REGISTRY.gauge(
+    "dynamo_hbm_weight_bytes",
+    "Bytes held by model parameters (logical, across shards)",
+)
+HBM_KV_POOL_BYTES = REGISTRY.gauge(
+    "dynamo_hbm_kv_pool_bytes",
+    "Bytes held by the device KV cache pool (logical, across shards)",
+)
+HBM_BYTES_IN_USE = REGISTRY.gauge(
+    "dynamo_hbm_bytes_in_use",
+    "Live device memory in use (device.memory_stats when available; "
+    "accounted weights+KV fallback otherwise)",
+)
+HBM_BYTES_LIMIT = REGISTRY.gauge(
+    "dynamo_hbm_bytes_limit",
+    "Device memory capacity reported by the runtime (0 = unknown)",
+)
+HBM_PEAK_BYTES = REGISTRY.gauge(
+    "dynamo_hbm_peak_bytes",
+    "Peak live-buffer watermark (device-reported peak, or the "
+    "accounted maximum on backends without memory stats)",
+)
+
 # -- disaggregation (decode-side routing + prefill queue) -------------------
 DISAGG_REMOTE_PREFILLS = REGISTRY.counter(
     "dynamo_disagg_remote_prefills_total",

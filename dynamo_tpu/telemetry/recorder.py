@@ -1,0 +1,201 @@
+"""Step flight recorder: a bounded ring of the engine's recent steps.
+
+The serving stack's post-hoc traces (spans.py) answer *where a finished
+request's time went*; the flight recorder answers the harder forensic
+question — *what was the engine doing in the seconds around an anomaly*
+(a step that blew the ITL budget, a request that missed its SLO, a
+watchdog trip). The engine records one entry per device step — kind,
+batch composition, queue depth, per-phase latency, preemptions, spec
+accept counts — into a ``deque(maxlen=N)``; when the slow-step watchdog
+trips, the whole ring auto-dumps to JSONL so the offending step lands
+on disk *with its surrounding context* instead of scrolling out of a
+log buffer.
+
+Design constraints:
+
+- **Bounded by construction.** The ring is a ``deque(maxlen=...)`` —
+  dynalint DL007 (unbounded-telemetry-buffer) exists to keep it and any
+  sibling buffers that way.
+- **Engine-thread cheap.** ``record()`` is a dict build + deque append
+  behind a lock; the watchdog comparison is one float compare. Dumps
+  are rate-limited (``min_dump_interval_s``) so a pathological phase
+  can't turn the recorder into a disk-write loop.
+- **Injectable clock.** ``clock`` defaults to ``time.monotonic`` but is
+  a constructor argument so tests drive the watchdog deterministically.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from dynamo_tpu.telemetry.instruments import (
+    FLIGHT_DUMPS,
+    SLOW_STEPS,
+)
+
+log = logging.getLogger("dynamo_tpu.telemetry.recorder")
+
+
+def default_dump_dir() -> str:
+    return os.environ.get("DYN_FLIGHT_DIR") or tempfile.gettempdir()
+
+
+class FlightRecorder:
+    """Ring buffer of step records with a slow-step watchdog.
+
+    ``slow_step_s`` — steps longer than this dump the ring (None = the
+    watchdog is off; the ring still records for ``/debug/state``).
+    ``dump_dir`` — where JSONL dumps land (default: DYN_FLIGHT_DIR or
+    the system temp dir).
+    ``max_dump_files`` — on-disk cap: writing dump K+1 unlinks this
+    recorder's oldest file, so a chronically-breaching process leaks
+    neither memory NOR disk (the rate limit bounds the write rate, not
+    the total).
+    """
+
+    def __init__(
+        self,
+        capacity: int = 256,
+        slow_step_s: Optional[float] = None,
+        dump_dir: str = "",
+        min_dump_interval_s: float = 30.0,
+        max_dump_files: int = 16,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.capacity = max(1, int(capacity))
+        self.slow_step_s = slow_step_s
+        self.dump_dir = dump_dir or default_dump_dir()
+        self.min_dump_interval_s = min_dump_interval_s
+        self._clock = clock
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._last_dump: float = -float("inf")
+        self._dump_seq = 0
+        self._dump_paths: deque = deque(maxlen=max(1, max_dump_files))
+        self.steps_recorded = 0
+        self.slow_steps = 0
+        self.dumps_written = 0
+        self.last_dump_path: Optional[str] = None
+
+    # -- recording ---------------------------------------------------------
+    def record(self, kind: str, duration_s: float, **fields) -> Optional[str]:
+        """Append one step record; returns a dump path when the slow-step
+        watchdog tripped (None otherwise). ``fields`` should be scalar
+        (they land in JSONL verbatim): batch sizes, queue depth,
+        per-phase millisecond timings, preemption/spec counts."""
+        rec = {
+            "ts": time.time(),
+            "kind": kind,
+            "duration_ms": round(duration_s * 1e3, 3),
+        }
+        rec.update(fields)
+        slow = self.slow_step_s is not None and duration_s > self.slow_step_s
+        if slow:
+            rec["slow"] = True
+            rec["slow_threshold_ms"] = round(self.slow_step_s * 1e3, 3)
+        with self._lock:
+            self._ring.append(rec)
+            self.steps_recorded += 1
+            if slow:
+                self.slow_steps += 1
+        if slow:
+            SLOW_STEPS.labels(kind).inc()
+            return self.dump(reason=f"slow_step:{kind}")
+        return None
+
+    def note_slow_request(self, request_id: str, **fields) -> Optional[str]:
+        """A request-level watchdog trip (e.g. an SLO breach): record a
+        marker entry and dump the ring so the steps that served the slow
+        request are preserved. When the rate limiter would suppress the
+        dump anyway, the marker is skipped too — sustained misses would
+        otherwise flush the ring's step records (the payload the dump
+        exists to preserve) with hundreds of markers per window."""
+        with self._lock:
+            if self._clock() - self._last_dump < self.min_dump_interval_s:
+                return None
+            rec = {"ts": time.time(), "kind": "slow_request",
+                   "request_id": request_id}
+            rec.update(fields)
+            self._ring.append(rec)
+        return self.dump(reason=f"slow_request:{request_id}")
+
+    # -- dumping -----------------------------------------------------------
+    def dump(self, reason: str = "manual") -> Optional[str]:
+        """Write the ring as JSONL (one header line, then the records,
+        oldest first). Rate-limited; returns the path or None when
+        suppressed/failed."""
+        now = self._clock()
+        with self._lock:
+            if now - self._last_dump < self.min_dump_interval_s:
+                return None
+            self._last_dump = now
+            self._dump_seq += 1
+            seq = self._dump_seq
+            records = list(self._ring)
+        path = os.path.join(
+            self.dump_dir,
+            f"dynamo_flight_{os.getpid()}_{seq:03d}.jsonl",
+        )
+        try:
+            with open(path, "w") as f:
+                f.write(json.dumps({
+                    "flight_recorder_dump": True,
+                    "reason": reason,
+                    "ts": time.time(),
+                    "pid": os.getpid(),
+                    "records": len(records),
+                }) + "\n")
+                for rec in records:
+                    f.write(json.dumps(rec) + "\n")
+        except OSError:
+            log.exception("flight-recorder dump to %s failed", path)
+            with self._lock:
+                # a FAILED dump must not arm the rate limiter: nothing
+                # was persisted, so the next trigger should try again
+                if self._last_dump == now:
+                    self._last_dump = -float("inf")
+            return None
+        evict: Optional[str] = None
+        with self._lock:
+            self.dumps_written += 1
+            self.last_dump_path = path
+            if len(self._dump_paths) == self._dump_paths.maxlen:
+                evict = self._dump_paths[0]  # rolls off on append
+            self._dump_paths.append(path)
+        if evict is not None:
+            try:
+                os.unlink(evict)
+            except OSError:
+                pass  # already gone / external cleanup: cap still holds
+        FLIGHT_DUMPS.labels(reason.split(":", 1)[0]).inc()
+        log.warning("flight recorder dumped %d steps to %s (%s)",
+                    len(records), path, reason)
+        return path
+
+    # -- introspection -----------------------------------------------------
+    def snapshot(self, n: int = 32) -> list[dict]:
+        """The most recent ``n`` records, oldest first (for /debug/state)."""
+        with self._lock:
+            ring = list(self._ring)
+        return ring[-n:]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "recorded": self.steps_recorded,
+                "slow_steps": self.slow_steps,
+                "dumps": self.dumps_written,
+                "last_dump": self.last_dump_path,
+                "slow_threshold_ms": (
+                    round(self.slow_step_s * 1e3, 3)
+                    if self.slow_step_s is not None else None
+                ),
+            }

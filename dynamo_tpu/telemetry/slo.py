@@ -1,0 +1,143 @@
+"""SLO attainment + goodput tracking for per-request latency targets.
+
+The paper's serving claims are *tail*-latency claims: a deployment is
+healthy when requests meet their TTFT/ITL targets, not when mean
+throughput looks fine — and the Planner should scale on the fraction of
+requests that actually met their targets (goodput), not raw tokens
+(PAPERS.md: Orca/vLLM show batch composition trades throughput against
+ITL directly). This module turns per-request TTFT/ITL measurements into:
+
+- ``dynamo_request_ttft_seconds`` / ``dynamo_request_itl_seconds``
+  histograms (always on — the raw distributions);
+- ``dynamo_slo_attainment`` — rolling fraction of recent requests that
+  met BOTH configured targets (windowed over the last ``window``
+  requests, bounded by construction);
+- ``dynamo_goodput_tokens_total`` — completion tokens from requests
+  that met their SLO (the Planner's scaling signal);
+- ``dynamo_slo_requests_total{outcome}`` — met/missed counts.
+
+Targets come from ``--slo-ttft-ms`` / ``--slo-itl-ms``
+(EngineConfig.slo_ttft_ms / slo_itl_ms); with no targets set the
+tracker records distributions only and reports attainment 1.0.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional
+
+from dynamo_tpu.telemetry.instruments import (
+    GOODPUT_TOKENS,
+    REQUEST_ITL_SECONDS,
+    REQUEST_TTFT_SECONDS,
+    SLO_ATTAINMENT,
+    SLO_REQUESTS,
+)
+
+
+@dataclass(frozen=True)
+class SloConfig:
+    """Latency targets; None disables that half of the check."""
+
+    ttft_ms: Optional[float] = None
+    itl_ms: Optional[float] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.ttft_ms is not None or self.itl_ms is not None
+
+    def to_dict(self) -> dict:
+        return {"ttft_ms": self.ttft_ms, "itl_ms": self.itl_ms}
+
+
+def aggregate_slo(metrics) -> tuple[float, float]:
+    """Fleet rollup over an iterable of ForwardPassMetrics-shaped
+    objects: (attainment mean over workers that EVALUATE targets,
+    goodput token sum). One implementation for both consumers (the
+    metrics service's ``llm_*`` gauges and the Planner's snapshot) so
+    the two can't diverge. Workers without targets report a constant
+    1.0 that would dilute the mean — they're excluded; with none
+    reporting, attainment is 1.0."""
+    attain: list[float] = []
+    goodput = 0.0
+    for m in metrics:
+        if getattr(m, "slo_enabled", False):
+            attain.append(getattr(m, "slo_attainment", 1.0))
+        goodput += float(getattr(m, "goodput_tokens_total", 0))
+    return (sum(attain) / len(attain) if attain else 1.0), goodput
+
+
+class SloTracker:
+    """Rolling SLO attainment over the last ``window`` finished requests.
+
+    Thread-safety: ``observe()`` runs on the engine thread (request
+    finish), readers (debug snapshot, stats publisher) on the event
+    loop — the outcome window mutates behind a lock.
+    """
+
+    def __init__(self, config: Optional[SloConfig] = None, window: int = 512):
+        self.config = config or SloConfig()
+        self._outcomes: deque = deque(maxlen=max(1, window))
+        self._lock = threading.Lock()
+        self.requests_seen = 0
+        self.requests_met = 0
+        self.goodput_tokens = 0
+
+    def observe(
+        self,
+        ttft_s: Optional[float],
+        itl_s: Optional[float],
+        completion_tokens: int = 0,
+    ) -> bool:
+        """Record one finished request. ``itl_s`` is the request's mean
+        inter-token latency (None for single-token generations — the
+        ITL target then doesn't apply). Returns whether the request met
+        every configured target."""
+        if ttft_s is not None:
+            REQUEST_TTFT_SECONDS.observe(ttft_s)
+        if itl_s is not None:
+            REQUEST_ITL_SECONDS.observe(itl_s)
+        met = True
+        if self.config.ttft_ms is not None and ttft_s is not None:
+            met = met and ttft_s * 1e3 <= self.config.ttft_ms
+        if self.config.itl_ms is not None and itl_s is not None:
+            met = met and itl_s * 1e3 <= self.config.itl_ms
+        if not self.config.enabled:
+            return met
+        with self._lock:
+            self._outcomes.append(bool(met))
+            self.requests_seen += 1
+            if met:
+                self.requests_met += 1
+                self.goodput_tokens += int(completion_tokens)
+            attainment = sum(self._outcomes) / len(self._outcomes)
+        SLO_REQUESTS.labels("met" if met else "missed").inc()
+        if met and completion_tokens:
+            GOODPUT_TOKENS.inc(completion_tokens)
+        SLO_ATTAINMENT.set(attainment)
+        return met
+
+    @property
+    def attainment(self) -> float:
+        """Rolling attainment over the window (1.0 when no targets are
+        configured or nothing finished yet)."""
+        with self._lock:
+            if not self._outcomes:
+                return 1.0
+            return sum(self._outcomes) / len(self._outcomes)
+
+    def stats(self) -> dict:
+        with self._lock:
+            window_len = len(self._outcomes)
+            window_met = sum(self._outcomes)
+        return {
+            "targets": self.config.to_dict(),
+            "enabled": self.config.enabled,
+            "attainment": (window_met / window_len) if window_len else 1.0,
+            "window": window_len,
+            "requests_seen": self.requests_seen,
+            "requests_met": self.requests_met,
+            "goodput_tokens_total": self.goodput_tokens,
+        }

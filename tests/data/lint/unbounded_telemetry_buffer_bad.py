@@ -1,0 +1,15 @@
+"""DL007 violations: telemetry buffers that only ever grow."""
+
+from collections import deque
+
+
+class StepTelemetry:
+    def __init__(self):
+        self.step_records = []
+        self.events = deque()  # deque without maxlen is just as leaky
+        self.latencies: list = []
+
+    def on_step(self, record, event, ms):
+        self.step_records.append(record)  # VIOLATION: no trim anywhere
+        self.events.append(event)  # VIOLATION: deque() has no maxlen
+        self.latencies += [ms]  # VIOLATION: += grows the same way

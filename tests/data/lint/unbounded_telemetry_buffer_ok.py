@@ -1,0 +1,24 @@
+"""DL007 clean patterns: bounded by construction, or trimmed after
+appending."""
+
+from collections import deque
+
+
+class StepTelemetry:
+    def __init__(self):
+        self.step_records = deque(maxlen=256)  # bounded by construction
+        self.history = []
+        self.events = []
+        self.block_table = []  # not a telemetry buffer: out of scope
+
+    def on_step(self, record, snap, event, block):
+        self.step_records.append(record)
+        self.history.append(snap)
+        del self.history[:-600]  # explicit trim after append
+        self.events.append(event)
+        self.block_table.append(block)
+
+    def flush(self):
+        out = list(self.events)
+        self.events.clear()  # drained elsewhere: has a lifecycle
+        return out

@@ -29,6 +29,7 @@ CASES = [
     ("host-sync-in-jit-path", "host_sync_in_jit_path", 3),
     ("await-while-locked", "await_while_locked", 2),
     ("bare-except", "bare_except", 1),
+    ("unbounded-telemetry-buffer", "unbounded_telemetry_buffer", 3),
 ]
 
 
@@ -336,7 +337,7 @@ def test_syntax_error_becomes_parse_finding():
 
 def test_rule_catalog_metadata():
     rules = all_rules()
-    assert len(rules) == 6
+    assert len(rules) == 7
     codes = [r.code for r in rules]
     assert codes == sorted(codes) and len(set(codes)) == len(codes)
     assert all(r.name == r.name.lower() and " " not in r.name for r in rules)

@@ -19,12 +19,35 @@ from dynamo_tpu.telemetry.metrics import (
 # every module that declares or touches process-global instruments
 _INSTRUMENTED_MODULES = [
     "dynamo_tpu.telemetry.instruments",
+    "dynamo_tpu.telemetry.recorder",
+    "dynamo_tpu.telemetry.slo",
+    "dynamo_tpu.telemetry.hbm",
     "dynamo_tpu.http.service",
     "dynamo_tpu.metrics.service",
     "dynamo_tpu.disagg.worker",
     "dynamo_tpu.disagg.transfer",
     "dynamo_tpu.engine.scheduler",
     "dynamo_tpu.kvbm.manager",
+]
+
+# the ISSUE 4 observability surface: these series must exist in the
+# process registry (catalog drift fails here, not in a dashboard)
+_REQUIRED_SERIES = [
+    "dynamo_slo_attainment",
+    "dynamo_goodput_tokens_total",
+    "dynamo_slo_requests_total",
+    "dynamo_request_ttft_seconds",
+    "dynamo_request_itl_seconds",
+    "dynamo_engine_slow_steps_total",
+    "dynamo_flight_recorder_dumps_total",
+    "dynamo_kv_pool_blocks_active",
+    "dynamo_kv_pool_blocks_total",
+    "dynamo_kv_pool_cached_free_blocks",
+    "dynamo_hbm_weight_bytes",
+    "dynamo_hbm_kv_pool_bytes",
+    "dynamo_hbm_bytes_in_use",
+    "dynamo_hbm_bytes_limit",
+    "dynamo_hbm_peak_bytes",
 ]
 
 
@@ -58,6 +81,22 @@ def test_metrics_service_registry_is_scrape_safe():
 
     svc = MetricsService(component=None, host="127.0.0.1", port=0)  # type: ignore[arg-type]
     check_scrape_safety(svc.registry)
+
+
+def test_observability_series_are_registered():
+    _load_all()
+    missing = [n for n in _REQUIRED_SERIES if REGISTRY.get(n) is None]
+    assert not missing, f"catalog drifted: {missing}"
+    # bounded label sets on the labeled ones
+    assert REGISTRY.get("dynamo_slo_requests_total").label_names == (
+        "outcome",
+    )
+    assert REGISTRY.get("dynamo_engine_slow_steps_total").label_names == (
+        "kind",
+    )
+    assert REGISTRY.get(
+        "dynamo_flight_recorder_dumps_total"
+    ).label_names == ("reason",)
 
 
 def test_gate_catches_a_request_id_label():

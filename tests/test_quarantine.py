@@ -100,7 +100,15 @@ async def test_prefill_step_failure_quarantines_only_prefills():
         engine._run_device_step = boom_step
 
         async def victim():
-            await asyncio.sleep(0.4)  # long-gen requests are decoding
+            # arm only once every survivor is DECODING — a wall-clock
+            # sleep here guessed at prefill latency and flaked whenever
+            # a loaded machine prefilled slower than the guess
+            # (engine.wait_for_state is the injectable replacement)
+            await engine.wait_for_state(
+                lambda e: e.scheduler is not None
+                and e.scheduler.num_running >= 3
+                and all(s.generated >= 1 for s in e.scheduler.running),
+            )
             state["armed"] = True
             try:
                 return await _gen(engine, range(1, 12), request_id="victim")
