@@ -124,7 +124,12 @@ def _kv_bytes_per_token(mc) -> float:
     return 2 * mc.num_hidden_layers * mc.num_key_value_heads * mc.head_dim * per_elem
 
 
-async def _run(model_cfg, wl, spec: bool = False, decode_steps=None) -> dict:
+async def _run(
+    model_cfg, wl, spec: bool = False, decode_steps=None, slo=None,
+) -> dict:
+    """``slo`` = (ttft_ms, itl_ms) targets; when set, the result dict
+    gains slo_attainment / goodput_tokens / requests_met from the
+    engine's SloTracker (the --chaos mode's scoreboard)."""
     if os.environ.get("DYN_STEP_TRACE"):
         # step-trace forensics print via logging.INFO; the bench is a
         # bare script, so wire a handler or the trace silently drops
@@ -168,6 +173,8 @@ async def _run(model_cfg, wl, spec: bool = False, decode_steps=None) -> dict:
         ),
         spec_tokens=int(os.environ.get("DYN_BENCH_SPEC_TOKENS", "4")),
         hbm_utilization=0.7,
+        slo_ttft_ms=(slo[0] if slo else None),
+        slo_itl_ms=(slo[1] if slo else None),
     )
     # static serving shapes (EngineConfig.static_shapes, default on)
     # pin the decode batch, table width, and prefill buckets so the only
@@ -249,8 +256,10 @@ async def _run(model_cfg, wl, spec: bool = False, decode_steps=None) -> dict:
 
     spec_proposed = engine.spec_proposed_total
     spec_accepted = engine.spec_accepted_total
+    slo_stats = engine.slo.stats()
     await engine.shutdown()
     return {
+        "slo": slo_stats,
         "tput": tput,
         "p50_ttft_s": _percentile(ttfts, 50),
         "p90_ttft_s": _percentile(ttfts, 90),
@@ -322,6 +331,80 @@ def _main_spec_ab(model_cfg, wl) -> None:
     )
 
 
+def _main_chaos_ab(model_cfg, wl) -> None:
+    """--chaos: goodput/SLO attainment under a canned, fixed-seed fault
+    plan vs the identical fault-free workload (docs/robustness.md).
+
+    The plan (override with DYN_FAULTS) delays a fraction of engine
+    steps and injects two transient step errors — the quarantine/retry
+    machinery must absorb them. SLO targets default to 3x the fault-free
+    run's p50s (env DYN_BENCH_SLO_TTFT_MS / DYN_BENCH_SLO_ITL_MS pin
+    absolute targets instead)."""
+    from dynamo_tpu import faults
+
+    env_ttft = float(os.environ.get("DYN_BENCH_SLO_TTFT_MS", 0))
+    env_itl = float(os.environ.get("DYN_BENCH_SLO_ITL_MS", 0))
+    if env_ttft and env_itl:
+        # both targets pinned: the probe run would be discarded — skip it
+        ttft_ms, itl_ms = env_ttft, env_itl
+    else:
+        probe = asyncio.run(_run(model_cfg, wl))
+        ttft_ms = env_ttft or max(50.0, probe["p50_ttft_s"] * 3e3)
+        itl_ms = env_itl or max(5.0, probe["p50_itl_s"] * 3e3)
+    slo = (round(ttft_ms, 2), round(itl_ms, 2))
+    base = asyncio.run(_run(model_cfg, wl, slo=slo))
+
+    plan_spec = os.environ.get("DYN_FAULTS") or (
+        f"seed={os.environ.get('DYN_BENCH_CHAOS_SEED', '42')};"
+        f"engine.step:delay={os.environ.get('DYN_BENCH_CHAOS_DELAY', '0.005')}"
+        f"@p=0.2;engine.step:error@after=50@max=2"
+    )
+    injector = faults.activate(faults.parse_plan(plan_spec))
+    try:
+        chaos = asyncio.run(_run(model_cfg, wl, slo=slo))
+        fired = injector.stats()["fired_total"]
+    finally:
+        faults.deactivate()
+
+    base_goodput = base["slo"]["goodput_tokens_total"]
+    chaos_goodput = chaos["slo"]["goodput_tokens_total"]
+    out = {
+        "metric": "engine_chaos_goodput_1chip",
+        "value": round(chaos_goodput / max(chaos["wall_s"], 1e-9), 2),
+        "unit": "goodput_tokens/sec",
+        # goodput retained under the canned fault plan, relative to the
+        # fault-free run at the same SLO targets (1.0 = chaos-immune)
+        "vs_baseline": round(chaos_goodput / max(base_goodput, 1), 4),
+        "config": {
+            "model": wl["model_name"],
+            "batch": wl["batch"],
+            "isl": wl["isl"],
+            "osl": wl["osl"],
+            "fault_plan": plan_spec,
+            "faults_fired": fired,
+            "slo_ttft_ms": slo[0],
+            "slo_itl_ms": slo[1],
+            "base_tok_s": round(base["tput"], 2),
+            "chaos_tok_s": round(chaos["tput"], 2),
+            "base_slo_attainment": round(base["slo"]["attainment"], 4),
+            "chaos_slo_attainment": round(chaos["slo"]["attainment"], 4),
+            "base_goodput_tokens": base_goodput,
+            "chaos_goodput_tokens": chaos_goodput,
+            "p99_ttft_ms_base": round(base["p99_ttft_s"] * 1000, 1),
+            "p99_ttft_ms_chaos": round(chaos["p99_ttft_s"] * 1000, 1),
+            "p99_itl_ms_base": round(base["p99_itl_s"] * 1000, 2),
+            "p99_itl_ms_chaos": round(chaos["p99_itl_s"] * 1000, 2),
+        },
+    }
+    print(json.dumps(out))
+    print(
+        f"# chaos A/B: base={base['tput']:.1f} chaos={chaos['tput']:.1f} "
+        f"tok/s, attainment {base['slo']['attainment']:.2%} -> "
+        f"{chaos['slo']['attainment']:.2%}, {fired} fault(s) fired",
+        file=sys.stderr,
+    )
+
+
 def main() -> None:
     cpu_mode = os.environ.get("DYN_BENCH_PLATFORM") == "cpu"
     if cpu_mode:
@@ -331,6 +414,9 @@ def main() -> None:
     model_cfg, wl = _build_config(cpu_mode)
     if "--spec" in sys.argv[1:]:
         _main_spec_ab(model_cfg, wl)
+        return
+    if "--chaos" in sys.argv[1:]:
+        _main_chaos_ab(model_cfg, wl)
         return
     r = asyncio.run(_run(model_cfg, wl))
     out = {

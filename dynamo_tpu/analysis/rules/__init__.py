@@ -13,6 +13,7 @@ from dynamo_tpu.analysis.rules import (  # noqa: F401
     blocking_async,
     dropped_task,
     host_sync_jit,
+    retry_loop,
     swallowed_cancel,
     unbounded_buffer,
 )

@@ -1,0 +1,121 @@
+"""DL008 unbounded-retry-loop: a ``while True:`` reconnect loop with no
+pacing.
+
+A loop that redials a peer (``asyncio.open_connection``, ``.connect``,
+``create_connection``, ...) and handles failure with a bare
+``continue`` hammers a flapping or restarting peer as fast as the
+connect syscall fails — a tight loop that turns one dead coordinator
+into a self-inflicted connect storm across the fleet (the SRE
+retry-budget literature's canonical anti-pattern). Every reconnect loop
+must pace itself: capped exponential backoff + jitter
+(``utils/backoff.py Backoff``) is the house idiom; a plain
+``asyncio.sleep`` bound also counts.
+
+The rule fires on ``while True:`` (or ``while 1:``) loops whose body
+awaits a connection-establishing call and contains NO pacing bound —
+no ``asyncio.sleep``/``time.sleep`` call, and nothing named like a
+backoff helper (``backoff.sleep()``, ``Backoff(...)``,
+``next_delay``). Read loops (``await read_frame(...)`` etc.) are not
+connection-establishing and are never flagged: blocking on data is the
+correct way to wait.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.analysis.registry import LintModule, rule
+from dynamo_tpu.analysis.rules.common import dotted_name
+
+# call names (last dotted component) that establish a connection
+CONNECT_NAMES = {
+    "open_connection",
+    "create_connection",
+    "connect",
+    "reconnect",
+    "dial",
+    "open_unix_connection",
+}
+
+# names that count as pacing: a sleep, or anything backoff-shaped
+SLEEP_NAMES = {"sleep"}
+BACKOFFISH = ("backoff", "next_delay")
+
+
+def _last_component(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _is_while_true(node: ast.While) -> bool:
+    test = node.test
+    return (
+        isinstance(test, ast.Constant) and bool(test.value) is True
+    )
+
+
+class _LoopScan(ast.NodeVisitor):
+    """One loop body: connection-establishing awaits + pacing bounds.
+    Nested function definitions scope separately (their loops are
+    scanned when the walker reaches them; their sleeps don't pace us).
+    """
+
+    def __init__(self) -> None:
+        self.connects: list[tuple[ast.AST, str]] = []
+        self.paced = False
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return  # separate scope
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        return  # separate scope
+
+    def visit_While(self, node: ast.While) -> None:
+        return  # inner loops are scanned on their own
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func) or ""
+        last = _last_component(name)
+        if last in SLEEP_NAMES or any(b in name.lower() for b in BACKOFFISH):
+            self.paced = True
+        elif last in CONNECT_NAMES:
+            self.connects.append((node, name))
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if any(b in node.id.lower() for b in BACKOFFISH):
+            self.paced = True
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if any(b in node.attr.lower() for b in BACKOFFISH):
+            self.paced = True
+        self.generic_visit(node)
+
+
+@rule(
+    "unbounded-retry-loop",
+    "DL008",
+    "while-True reconnect loop with no backoff/sleep pacing (hammers a "
+    "flapping peer)",
+)
+def check(module: LintModule):
+    findings: list[tuple[ast.AST, str]] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.While) or not _is_while_true(node):
+            continue
+        scan = _LoopScan()
+        for stmt in node.body:
+            scan.visit(stmt)
+        if scan.paced or not scan.connects:
+            continue
+        for site, name in scan.connects:
+            findings.append(
+                (
+                    site,
+                    f"`{name}(...)` retried in a `while True:` loop with "
+                    "no backoff/sleep — pace reconnects with "
+                    "utils.backoff.Backoff (capped exponential + jitter) "
+                    "or at least `await asyncio.sleep(...)`",
+                )
+            )
+    return findings

@@ -139,6 +139,23 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--advertise-host", default="127.0.0.1",
                      help="address prefill workers use to reach this "
                           "worker's KV transfer server")
+    # robustness (docs/robustness.md: deadlines + load shedding; fault
+    # injection is enabled via the DYN_FAULTS env var, never a flag)
+    run.add_argument("--default-deadline-ms", type=float, default=None,
+                     help="deadline budget applied to requests without "
+                          "an X-Request-Timeout-Ms header; expired "
+                          "requests are cancelled at every stage "
+                          "(queue, prefill, decode) and their KV blocks "
+                          "freed (default: no deadline)")
+    run.add_argument("--shed-queue-depth", type=int, default=0,
+                     help="admission control: reject requests 429 + "
+                          "Retry-After when the engine's queue depth "
+                          "(waiting + prefilling) reaches this "
+                          "(--in http with a local engine; 0 disables)")
+    run.add_argument("--shed-kv-usage", type=float, default=0.0,
+                     help="admission control: shed when the device KV "
+                          "pool usage fraction reaches this (e.g. 0.95; "
+                          "0 disables)")
     # observability (docs/observability.md: SLO + flight recorder)
     run.add_argument("--slo-ttft-ms", type=float, default=None,
                      help="TTFT target evaluated per finished request "
@@ -540,8 +557,11 @@ async def _build_core_engine(args: Any):
 
 
 async def _build_local_pipeline(args: Any):
-    core, eos_ids, _ = await _build_core_engine(args)
-    return _wrap_pipeline(args, core, eos_ids)
+    """Returns (model_name, pipeline, jax_engine_or_None) — the engine
+    handle feeds frontend admission control when serving locally."""
+    core, eos_ids, jax_engine = await _build_core_engine(args)
+    name, pipeline = _wrap_pipeline(args, core, eos_ids)
+    return name, pipeline, jax_engine
 
 
 async def _connect_remote(
@@ -622,7 +642,7 @@ async def cmd_run(args: Any) -> None:
             model_name = args.model_name or "worker"
             engine, _, jax_engine = await _build_core_engine(args)
         else:
-            model_name, engine = await _build_local_pipeline(args)
+            model_name, engine, jax_engine = await _build_local_pipeline(args)
     elif out == "echo_full":
         from dynamo_tpu.engines import EchoEngineFull
 
@@ -737,7 +757,17 @@ async def cmd_run(args: Any) -> None:
         manager = ModelManager()
         watcher = ModelWatcher(drt, manager, router_mode=args.router_mode)
         await watcher.start()
-        service = HttpService(manager, host=args.http_host, port=args.http_port)
+        # no local engine -> no load signal for admission control here;
+        # deadlines still propagate to workers over the endpoint wire
+        if args.shed_queue_depth or args.shed_kv_usage:
+            log.warning(
+                "--shed-* flags need a local jax engine for load "
+                "signals; admission control disabled"
+            )
+        service = HttpService(
+            manager, host=args.http_host, port=args.http_port,
+            default_deadline_ms=args.default_deadline_ms,
+        )
         await service.start()
         print(f"listening on http://{args.http_host}:{service.port}", flush=True)
         await drt.runtime.wait_shutdown()
@@ -753,7 +783,35 @@ async def cmd_run(args: Any) -> None:
         manager = ModelManager()
         manager.add_chat_model(model_name, engine)
         manager.add_completion_model(model_name, engine)
-        service = HttpService(manager, host=args.http_host, port=args.http_port)
+        admission = None
+        if (args.shed_queue_depth or args.shed_kv_usage) and jax_engine is not None:
+            from dynamo_tpu.http.admission import (
+                AdmissionConfig,
+                AdmissionController,
+                engine_load_fn,
+            )
+
+            admission = AdmissionController(
+                AdmissionConfig(
+                    max_queue_depth=args.shed_queue_depth,
+                    max_kv_usage=args.shed_kv_usage,
+                ),
+                engine_load_fn(jax_engine),
+            )
+            print(
+                f"admission control: queue<{args.shed_queue_depth or '-'} "
+                f"kv<{args.shed_kv_usage or '-'}", flush=True,
+            )
+        elif args.shed_queue_depth or args.shed_kv_usage:
+            log.warning(
+                "--shed-* flags need a local jax engine for load "
+                "signals; admission control disabled"
+            )
+        service = HttpService(
+            manager, host=args.http_host, port=args.http_port,
+            admission=admission,
+            default_deadline_ms=args.default_deadline_ms,
+        )
         await service.start()
         print(f"listening on http://{args.http_host}:{service.port}", flush=True)
         await asyncio.Event().wait()
@@ -1543,6 +1601,11 @@ def main(argv: Optional[list[str]] = None) -> None:
     from dynamo_tpu.utils.jaxtools import configure_from_env
 
     configure_from_env()
+    # deterministic fault injection (docs/robustness.md): DYN_FAULTS
+    # activates a plan for THIS process; unset = every hook is a no-op
+    from dynamo_tpu import faults
+
+    faults.init_from_env()
     if args.command == "run":
         try:
             asyncio.run(cmd_run(args))

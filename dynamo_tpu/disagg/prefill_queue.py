@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from dynamo_tpu import faults
 from dynamo_tpu.disagg.protocols import RemotePrefillRequest, queue_name
 from dynamo_tpu.store.base import Store
 
@@ -27,6 +28,11 @@ class PrefillQueue:
     async def dequeue(
         self, timeout_s: float = 1.0
     ) -> Optional[tuple[int, RemotePrefillRequest]]:
+        if faults.ACTIVE is not None:
+            # injected dequeue faults: delays model a backed-up queue,
+            # errors a flapping coordinator (the worker loop's retry/
+            # redelivery path absorbs both)
+            await faults.ACTIVE.fire_async("prefill.dequeue", queue=self._queue)
         msg = await self._store.queue_pop(self._queue, timeout_s=timeout_s)
         if msg is None:
             return None

@@ -34,6 +34,12 @@ class RemotePrefillRequest:
     # spans join the decode request's trace (telemetry/spans.py);
     # optional: payloads from older workers simply lack it
     trace: Optional[dict] = None
+    # request deadline as a wall-clock epoch instant (time.time()); a
+    # prefill worker popping an expired message acks + skips it instead
+    # of computing KV nobody will wait for. Wall clock is deliberate:
+    # the queue crosses processes/hosts, and coarse deadline skew is
+    # harmless (the decode side enforces its own monotonic budget).
+    deadline_ts: Optional[float] = None
 
     def to_bytes(self) -> bytes:
         return json.dumps(asdict(self)).encode()

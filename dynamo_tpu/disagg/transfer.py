@@ -39,6 +39,7 @@ from typing import Awaitable, Callable, Optional
 
 import numpy as np
 
+from dynamo_tpu import faults
 from dynamo_tpu.disagg.protocols import transfer_key
 from dynamo_tpu.kvbm.layout import BlockLayout, resolve_dtype
 from dynamo_tpu.ops.kv_rearrange import cast_packed
@@ -172,6 +173,14 @@ class TransferServer:
             if hdr_len > 1 << 20:
                 raise ValueError("oversized transfer header")
             header = json.loads((await reader.readexactly(hdr_len)).decode())
+            if faults.ACTIVE is not None:
+                # receiver-side injection: an error here NACKs the
+                # transfer (sender retries/fails); a delay models a slow
+                # delivery into the host tier
+                await faults.ACTIVE.fire_async(
+                    "kv_transfer.get",
+                    request_id=header.get("request_id", ""),
+                )
             shape = tuple(int(d) for d in header["shape"])
             hashes = [int(h) for h in header["hashes"]]
             full_heads = self._layout.packed_shape[-2]
@@ -305,6 +314,12 @@ class TransferClient:
         t0 = time.monotonic()
         ok = False
         try:
+            if faults.ACTIVE is not None:
+                # sender-side injection: drop/error surfaces as a failed
+                # put, which the prefill worker's bounded retry absorbs
+                await faults.ACTIVE.fire_async(
+                    "kv_transfer.put", request_id=request_id
+                )
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(meta.host, meta.port),
                 timeout=connect_timeout_s,

@@ -40,6 +40,7 @@ from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
 from dynamo_tpu.store.base import Store
 from dynamo_tpu.telemetry import get_tracer, propagation_context
 from dynamo_tpu.telemetry.instruments import (
+    DEADLINE_EXPIRED,
     DISAGG_LOCAL_FALLBACKS,
     DISAGG_REMOTE_PREFILLS,
     PREFILL_QUEUE_DEPTH,
@@ -134,6 +135,18 @@ class DisaggDecodeEngine(AsyncEngine):
         PREFILL_QUEUE_DEPTH.set(depth)
         if not self.router.should_prefill_remote(prefill_len, depth):
             return
+        # deadline budget (docs/robustness.md): the transfer wait may
+        # not outlive the request's remaining budget, and a nearly-
+        # expired request skips the remote hop entirely (local prefill
+        # fails fast in the engine's own deadline reap instead)
+        wait_s = self.router.conf.transfer_timeout_s
+        deadline_ts = None
+        if context is not None and context.deadline is not None:
+            remaining_ms = context.remaining_ms() or 0.0
+            if remaining_ms / 1e3 <= 0.05:
+                return
+            wait_s = min(wait_s, remaining_ms / 1e3)
+            deadline_ts = time.time() + remaining_ms / 1e3
         self.remote_prefills += 1
         DISAGG_REMOTE_PREFILLS.inc()
         rid = request.request_id
@@ -161,11 +174,10 @@ class DisaggDecodeEngine(AsyncEngine):
                     # passed through verbatim — telemetry/spans.py
                     # propagation_context owns the rules
                     trace=propagation_context(span, context),
+                    deadline_ts=deadline_ts,
                 )
             )
-            await asyncio.wait_for(
-                done.wait(), timeout=self.router.conf.transfer_timeout_s
-            )
+            await asyncio.wait_for(done.wait(), timeout=wait_s)
         except asyncio.TimeoutError:
             self.local_fallbacks += 1
             DISAGG_LOCAL_FALLBACKS.inc()
@@ -215,6 +227,16 @@ async def run_prefill_worker(
         if got is None:
             continue
         msg_id, req = got
+        if req.deadline_ts is not None and time.time() >= req.deadline_ts:
+            # the decode side stopped waiting long ago: computing this
+            # KV would be pure waste — retire the message
+            DEADLINE_EXPIRED.labels("prefill_queue").inc()
+            log.warning(
+                "prefill %s deadline expired in queue; dropping",
+                req.request_id,
+            )
+            await queue.ack(msg_id)
+            continue
         try:
             await _prefill_one(engine, store, req, bs)
             await queue.ack(msg_id)

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dynamo_tpu import faults
 from dynamo_tpu.engine.allocator import BlockAllocator
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.kvbm import BlockLayout, KvbmConfig, KvBlockManager
@@ -262,6 +263,17 @@ class JaxEngine:
         # its own registration)
         engine._debug_name = "engine"
         register_debug_provider(engine._debug_name, engine.debug_state)
+        if faults.ACTIVE is not None and engine.recorder is not None:
+            # fired faults land in the flight recorder's ring so an
+            # anomaly dump shows the injected chaos next to the steps
+            # it perturbed
+            recorder = engine.recorder
+            faults.ACTIVE.add_listener(
+                lambda rec: recorder.record(
+                    "fault", 0.0,
+                    point=rec.get("point"), fault_kind=rec.get("kind"),
+                )
+            )
         return engine
 
     def _initialize(self) -> None:
@@ -1605,6 +1617,9 @@ class JaxEngine:
             return True
 
         while self._running:
+            # worker-liveness injection point: `kill` rules here model a
+            # hard worker death between steps (one-shot by default)
+            faults.fire("worker.liveness")
             self._drain_incoming()
             if (
                 not self.scheduler.running
@@ -1920,6 +1935,10 @@ class JaxEngine:
     def _one_step(self) -> None:
         sched = self.scheduler
         assert sched is not None
+        # injected device-step faults (docs/robustness.md): a delay here
+        # models a straggling dispatch, an error exercises the
+        # quarantine path, a kill is a worker death. No-op without a plan.
+        faults.fire("engine.step")
         t_plan = time.monotonic()
         # clear BEFORE plan(): a failure inside planning must not be
         # attributed to the previous step's (healthy) requests
@@ -2816,7 +2835,9 @@ class JaxEngine:
         targets don't describe. An SLO miss trips the flight recorder's
         request watchdog so the steps that served the slow request are
         preserved on disk."""
-        if reason in (FinishReason.ERROR, FinishReason.CANCELLED):
+        if reason in (
+            FinishReason.ERROR, FinishReason.CANCELLED, FinishReason.TIMEOUT
+        ):
             # infrastructure failures and client disconnects don't
             # score: counting an errored request's fast partial tokens
             # as 'met' goodput would report a fleet in an error loop as
@@ -3045,6 +3066,10 @@ class JaxEngine:
         seq.t_submit = time.monotonic()
         seq.t_submit_wall = time.time()
         seq.trace = context.trace_context()
+        if context.deadline is not None:
+            # same-process monotonic instant: the scheduler reaps the
+            # sequence (and frees its KV blocks) once this passes
+            seq.deadline = context.deadline
         self._incoming.put(seq)
         self._wake.set()
         return out

@@ -30,6 +30,7 @@ import numpy as np
 from dynamo_tpu.engine.allocator import BlockAllocator, NoBlocksError
 from dynamo_tpu.protocols.common import FinishReason, PreprocessedRequest
 from dynamo_tpu.telemetry.instruments import (
+    DEADLINE_EXPIRED,
     ENGINE_PREEMPTIONS,
     ENGINE_QUEUE_WAIT,
 )
@@ -63,6 +64,10 @@ class Sequence:
     emit: Optional[Callable] = None  # called with LLMEngineOutput-shaped dicts
     is_cancelled: Optional[Callable[[], bool]] = None
     finish_reason: Optional[FinishReason] = None
+    # request deadline (monotonic instant; 0.0 = none): expired
+    # sequences are reaped in plan() — queue, prefill, and decode alike
+    # — so their KV blocks free instead of burning further steps
+    deadline: float = 0.0
     # multimodal: [(token offset, embeds[n, D])] to inject during prefill
     mm_segments: list = field(default_factory=list)
     # generated-token counts for frequency/presence/repetition penalties
@@ -330,15 +335,39 @@ class Scheduler:
         return self.mixed_prefill_rows, self.mixed_prefill_len
 
     def _reap_cancelled(self) -> None:
-        for pool in (self.waiting, self.prefilling):
+        """Remove cancelled AND deadline-expired sequences from every
+        pool. finish() frees their KV blocks, so an expired request
+        costs nothing past the step that notices it."""
+        now = time.monotonic()
+
+        def _expired(seq: Sequence) -> bool:
+            return bool(seq.deadline) and now >= seq.deadline
+
+        for pool, stage in ((self.waiting, "queue"), (self.prefilling, "prefill")):
             for seq in list(pool):
                 if seq.is_cancelled and seq.is_cancelled():
                     pool.remove(seq)
                     self.finish(seq, FinishReason.CANCELLED)
+                elif _expired(seq):
+                    pool.remove(seq)
+                    DEADLINE_EXPIRED.labels(stage).inc()
+                    log.warning(
+                        "request %s deadline expired in %s; cancelling",
+                        seq.request_id, stage,
+                    )
+                    self.finish(seq, FinishReason.TIMEOUT)
         for seq in list(self.running):
             if seq.is_cancelled and seq.is_cancelled():
                 self.running.remove(seq)
                 self.finish(seq, FinishReason.CANCELLED)
+            elif _expired(seq):
+                self.running.remove(seq)
+                DEADLINE_EXPIRED.labels("decode").inc()
+                log.warning(
+                    "request %s deadline expired mid-decode; cancelling",
+                    seq.request_id,
+                )
+                self.finish(seq, FinishReason.TIMEOUT)
 
     def _growth_reserve(self) -> int:
         """Blocks the CURRENT population still needs to finish its
@@ -589,16 +618,23 @@ class Scheduler:
         """
         if self.waiting:
             self._admit()
+        now = time.monotonic()
+
+        def _dead(seq: Sequence) -> bool:
+            if seq.is_cancelled and seq.is_cancelled():
+                return True
+            return bool(seq.deadline) and now >= seq.deadline
+
         for w in works:
             if not w.is_last_chunk:
                 return None
-            if w.seq.is_cancelled and w.seq.is_cancelled():
+            if _dead(w.seq):
                 return None
         survivors: list[Sequence] = []
         for seq in seqs:
             if seq.state != SeqState.RUNNING:
                 return None
-            if seq.is_cancelled and seq.is_cancelled():
+            if _dead(seq):
                 return None
             if (
                 seq.max_new_tokens is not None

@@ -1,0 +1,60 @@
+"""Deterministic, seed-driven fault injection (docs/robustness.md).
+
+Usage::
+
+    from dynamo_tpu import faults
+
+    # sync hot path (engine thread):
+    faults.fire("engine.step", kind=plan.kind)
+
+    # async hot path — guard so no coroutine is created when disabled:
+    if faults.ACTIVE is not None:
+        await faults.ACTIVE.fire_async("store.call", op=op)
+
+Activate via ``DYN_FAULTS`` (CLI startup calls ``init_from_env()``), a
+JSON plan file (``DYN_FAULTS=@plan.json``), or programmatically with
+``activate(FaultPlan(...))`` in tests.
+"""
+
+from dynamo_tpu.faults import injector as _injector
+from dynamo_tpu.faults.injector import (
+    ENV_VAR,
+    FaultInjector,
+    activate,
+    deactivate,
+    fire,
+    init_from_env,
+)
+from dynamo_tpu.faults.plan import (
+    DroppedFrameError,
+    FaultInjectedError,
+    FaultPlan,
+    FaultRule,
+    parse_plan,
+    parse_rule,
+)
+
+
+def __getattr__(name: str):
+    # ACTIVE lives on the injector module (activate/deactivate rebind
+    # it); forward attribute access so `faults.ACTIVE` is always current
+    if name == "ACTIVE":
+        return _injector.ACTIVE
+    raise AttributeError(name)
+
+
+__all__ = [
+    "ACTIVE",
+    "ENV_VAR",
+    "DroppedFrameError",
+    "FaultInjectedError",
+    "FaultInjector",
+    "FaultPlan",
+    "FaultRule",
+    "activate",
+    "deactivate",
+    "fire",
+    "init_from_env",
+    "parse_plan",
+    "parse_rule",
+]

@@ -1,0 +1,315 @@
+"""FaultInjector: the runtime half of the fault-injection subsystem.
+
+One process-global injector (module attribute ``ACTIVE``) evaluates the
+active :class:`FaultPlan` at named injection points. The points live at
+the stack's existing failure seams (docs/robustness.md catalogs them):
+
+    http.request        frontend request handling (async)
+    transport.send      worker data-plane frame send (async)
+    transport.recv      worker data-plane frame receive (async)
+    store.call          coordinator-store client op (async; ctx: op)
+    prefill.dequeue     prefill-queue pop (async)
+    kv_transfer.put     disagg KV block shipment, sender side (async)
+    kv_transfer.get     disagg KV block delivery, receiver side (async)
+    engine.step         one engine device step (sync, engine thread)
+    worker.liveness     engine step-loop heartbeat (sync; kill target)
+
+Hot-path contract: when no plan is active, every hook is a module
+attribute load plus an ``is None`` check — no coroutine creation, no
+locking, no allocation. Call sites therefore guard explicitly::
+
+    from dynamo_tpu import faults
+    ...
+    if faults.ACTIVE is not None:
+        await faults.ACTIVE.fire_async("transport.send", request_id=rid)
+
+Sync points use :func:`fire`, which does the same guard internally.
+
+Every fired fault increments ``dynamo_faults_fired_total{point,kind}``,
+lands in the injector's bounded ring (served under ``/debug/state`` →
+``"faults"``), and is forwarded to any registered listeners (the engine
+forwards engine-thread faults into its flight recorder).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from dynamo_tpu.faults.plan import FaultPlan, FaultRule, parse_plan
+from dynamo_tpu.telemetry.debug import (
+    register_debug_provider,
+    unregister_debug_provider,
+)
+from dynamo_tpu.telemetry.instruments import FAULTS_FIRED
+
+log = logging.getLogger("dynamo_tpu.faults")
+
+ENV_VAR = "DYN_FAULTS"
+
+# how a `kill` rule takes the process down: os._exit skips atexit /
+# finally blocks, which is the point — a SIGKILL'd worker doesn't clean
+# up either. Tests monkeypatch this module attribute.
+_kill_process: Callable[[int], None] = os._exit
+KILL_EXIT_CODE = 70
+
+
+class _RuleState:
+    """Mutable per-rule runtime state (the rule itself stays immutable).
+    ``ephemeral`` marks request-scoped (header-armed) rules, which are
+    pruned once exhausted so a chaos soak never accumulates dead rules."""
+
+    __slots__ = ("rule", "rng", "passes", "fires", "ephemeral")
+
+    def __init__(self, rule: FaultRule, rng, ephemeral: bool = False):
+        self.rule = rule
+        self.rng = rng
+        self.passes = 0
+        self.fires = 0
+        self.ephemeral = ephemeral
+
+    @property
+    def exhausted(self) -> bool:
+        return (
+            self.rule.max_fires is not None
+            and self.fires >= self.rule.max_fires
+        )
+
+
+class FaultInjector:
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._states = [
+            _RuleState(rule, plan.rule_rng(i))
+            for i, rule in enumerate(plan.rules)
+        ]
+        # (point -> states) index so a pass through a point only touches
+        # its own rules
+        self._by_point: dict[str, list[_RuleState]] = {}
+        for st in self._states:
+            self._by_point.setdefault(st.rule.point, []).append(st)
+        self.fired_total = 0
+        # bounded forensic ring, mirrored into /debug/state
+        self._fired_ring: deque = deque(maxlen=256)
+        self._listeners: list[Callable[[dict], None]] = []
+
+    # -- evaluation -------------------------------------------------------
+    def _due(self, point: str, ctx: dict) -> list[FaultRule]:
+        """Advance counters for one pass through ``point``; return the
+        rules that fire (usually 0 or 1)."""
+        states = self._by_point.get(point)
+        if not states:
+            return []
+        due: list[FaultRule] = []
+        prune = False
+        with self._lock:
+            for st in states:
+                rule = st.rule
+                if rule.match is not None and not any(
+                    rule.match in str(v) for v in ctx.values()
+                ):
+                    continue
+                st.passes += 1
+                if st.passes <= rule.after:
+                    continue
+                if st.exhausted:
+                    prune = prune or st.ephemeral
+                    continue
+                if rule.p < 1.0 and st.rng.random() >= rule.p:
+                    continue
+                st.fires += 1
+                due.append(rule)
+                prune = prune or (st.ephemeral and st.exhausted)
+            if prune:
+                # header-armed rules die with their last fire; plan
+                # rules keep their state for stats()
+                self._prune_exhausted_ephemerals_locked()
+        for rule in due:
+            self._note_fired(rule, ctx)
+        return due
+
+    def _note_fired(self, rule: FaultRule, ctx: dict) -> None:
+        FAULTS_FIRED.labels(rule.point, rule.kind).inc()
+        rec = {
+            "ts": time.time(),
+            "point": rule.point,
+            "kind": rule.kind,
+            "value": rule.value,
+        }
+        rec.update({k: str(v) for k, v in ctx.items()})
+        with self._lock:
+            self.fired_total += 1
+            self._fired_ring.append(rec)
+        log.warning(
+            "fault fired: %s %s%s ctx=%s", rule.point, rule.kind,
+            f"={rule.value}" if rule.value is not None else "", ctx,
+        )
+        for listener in list(self._listeners):
+            try:
+                listener(rec)
+            except Exception:
+                log.exception("fault listener failed")
+
+    def _act_sync(self, rule: FaultRule) -> None:
+        if rule.kind in ("delay", "stall"):
+            time.sleep(rule.delay_s)
+        elif rule.kind == "kill":
+            log.error("fault kill at %s: exiting process", rule.point)
+            _kill_process(KILL_EXIT_CODE)
+        else:
+            raise rule.exc()
+
+    async def _act_async(self, rule: FaultRule) -> None:
+        if rule.kind in ("delay", "stall"):
+            await asyncio.sleep(rule.delay_s)
+        elif rule.kind == "kill":
+            log.error("fault kill at %s: exiting process", rule.point)
+            _kill_process(KILL_EXIT_CODE)
+        else:
+            raise rule.exc()
+
+    # -- public hooks -----------------------------------------------------
+    def fire(self, point: str, **ctx) -> None:
+        """Sync injection point (engine thread / non-async code)."""
+        for rule in self._due(point, ctx):
+            self._act_sync(rule)
+
+    async def fire_async(self, point: str, **ctx) -> None:
+        """Async injection point (event-loop code). Delays await."""
+        for rule in self._due(point, ctx):
+            await self._act_async(rule)
+
+    # -- request-scoped rules (X-Dyn-Fault header) ------------------------
+    # hard cap on live header-armed rules: rules whose request never
+    # reaches their point would otherwise accumulate for the plan's
+    # lifetime (the oldest are dropped past the cap)
+    MAX_REQUEST_RULES = 256
+
+    def _prune_exhausted_ephemerals_locked(self) -> None:
+        dead = [
+            st for st in self._states if st.ephemeral and st.exhausted
+        ]
+        if not dead:
+            return
+        dead_set = set(map(id, dead))
+        self._states = [
+            st for st in self._states if id(st) not in dead_set
+        ]
+        for point in {st.rule.point for st in dead}:
+            self._by_point[point] = [
+                st for st in self._by_point.get(point, ())
+                if id(st) not in dead_set
+            ]
+
+    def _drop_oldest_ephemerals_locked(self, keep: int) -> None:
+        live = [st for st in self._states if st.ephemeral]
+        for st in live[: max(0, len(live) - keep)]:
+            self._states.remove(st)
+            self._by_point[st.rule.point].remove(st)
+
+    def arm_request(self, spec: str, request_id: str) -> int:
+        """Append header-supplied rules scoped to ``request_id`` (their
+        ``match`` is forced to the id; ``max`` defaults to 1). Only
+        honored when the active plan opted in (``allow_request_rules``).
+        Armed rules are EPHEMERAL: pruned once exhausted, and capped at
+        MAX_REQUEST_RULES live rules overall. Returns the number armed."""
+        if not self.plan.allow_request_rules:
+            return 0
+        plan = parse_plan(spec)
+        armed = 0
+        with self._lock:
+            base = len(self._states)
+            for i, rule in enumerate(plan.rules):
+                rule.match = request_id
+                if rule.max_fires is None:
+                    rule.max_fires = 1
+                st = _RuleState(
+                    rule, _rng_for(self.plan.seed, rule, base + i),
+                    ephemeral=True,
+                )
+                self._states.append(st)
+                self._by_point.setdefault(rule.point, []).append(st)
+                armed += 1
+            self._drop_oldest_ephemerals_locked(self.MAX_REQUEST_RULES)
+        return armed
+
+    def add_listener(self, listener: Callable[[dict], None]) -> None:
+        self._listeners.append(listener)
+
+    # -- introspection ----------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "seed": self.plan.seed,
+                "rules": [
+                    {**st.rule.to_dict(), "passes": st.passes,
+                     "fires": st.fires}
+                    for st in self._states
+                ],
+                "fired_total": self.fired_total,
+                "recent": list(self._fired_ring)[-32:],
+            }
+
+
+def _rng_for(seed: int, rule: FaultRule, index: int):
+    """Per-rule rng for request-scoped (header-armed) rules; index-keyed
+    seeding keeps them deterministic for a fixed arrival order."""
+    import random
+
+    return random.Random(f"{seed}:{rule.point}:{index}")
+
+
+# ---------------------------------------------------------------------------
+# Process-global activation
+# ---------------------------------------------------------------------------
+
+ACTIVE: Optional[FaultInjector] = None
+
+
+def activate(plan: FaultPlan) -> FaultInjector:
+    """Install ``plan`` as the process's active fault plan."""
+    global ACTIVE
+    deactivate()
+    ACTIVE = FaultInjector(plan)
+    register_debug_provider("faults", ACTIVE.stats)
+    log.warning(
+        "fault injection ACTIVE: seed=%d, %d rule(s)",
+        plan.seed, len(plan.rules),
+    )
+    return ACTIVE
+
+
+def deactivate() -> None:
+    global ACTIVE
+    if ACTIVE is not None:
+        unregister_debug_provider("faults", ACTIVE.stats)
+        ACTIVE = None
+
+
+def init_from_env() -> Optional[FaultInjector]:
+    """Activate a plan from ``DYN_FAULTS`` if set (CLI startup calls
+    this); returns the injector or None."""
+    spec = os.environ.get(ENV_VAR, "").strip()
+    if not spec:
+        return None
+    try:
+        return activate(parse_plan(spec))
+    except Exception:
+        # a malformed plan must not take the process down — but it must
+        # be LOUD: silently serving without the chaos you asked for
+        # invalidates the experiment
+        log.exception("malformed %s ignored: %r", ENV_VAR, spec)
+        return None
+
+
+def fire(point: str, **ctx) -> None:
+    """Module-level sync hook: no-op unless a plan is active."""
+    inj = ACTIVE
+    if inj is not None:
+        inj.fire(point, **ctx)

@@ -1,0 +1,215 @@
+"""FaultPlan: the declarative description of which faults to inject where.
+
+A plan is a seed plus an ordered list of rules. Sources (docs/
+robustness.md):
+
+- the ``DYN_FAULTS`` environment variable (compact string syntax),
+- a JSON file (``DYN_FAULTS=@/path/plan.json``),
+- a per-request ``X-Dyn-Fault`` header (parsed with the same syntax and
+  scoped to one request id; only honored when the active plan allows
+  it — see injector.arm_request).
+
+Compact syntax — ``;``-separated elements, each either a plan-level
+``key=value`` setting or a rule::
+
+    DYN_FAULTS="seed=42;store.call:delay=0.05@p=0.5;engine.step:error@after=3@max=2"
+
+Rule grammar: ``point:kind[=value][@mod=value]...``
+
+kinds
+    ``delay=S``   sleep S seconds at the point (async points await)
+    ``stall=S``   alias of delay with a 30 s default — "hung peer"
+    ``error[=E]`` raise (E: ``conn`` ConnectionError, ``os`` OSError,
+                  ``timeout`` asyncio.TimeoutError, ``runtime``/default
+                  FaultInjectedError)
+    ``drop``      raise DroppedFrameError (a ConnectionError): at
+                  transport points the existing connection-loss
+                  handling turns this into a realistic peer-vanished
+                  teardown
+    ``kill``      terminate THIS process (one-shot worker death);
+                  implies max=1 unless overridden
+
+modifiers
+    ``@p=0.3``      fire with probability 0.3 (seeded, per-rule stream)
+    ``@after=N``    skip the first N passes through the point
+    ``@max=M``      fire at most M times (kill defaults to 1)
+    ``@match=S``    fire only when some string context value (e.g.
+                    request_id, op name) contains S
+
+Determinism: every rule draws from its own ``random.Random`` seeded
+from ``(plan seed, point, rule index)``, so the fire pattern at one
+point is a pure function of the seed and that point's call sequence —
+independent of scheduling interleave across points.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class FaultInjectedError(RuntimeError):
+    """Default error raised by ``error`` rules."""
+
+
+class DroppedFrameError(ConnectionError):
+    """Raised by ``drop`` rules: call sites treat it as a lost peer."""
+
+
+KINDS = ("delay", "stall", "error", "drop", "kill")
+
+_ERROR_TYPES = {
+    "": FaultInjectedError,
+    "runtime": FaultInjectedError,
+    "conn": ConnectionError,
+    "connection": ConnectionError,
+    "os": OSError,
+    "timeout": asyncio.TimeoutError,
+}
+
+
+@dataclass
+class FaultRule:
+    point: str
+    kind: str  # one of KINDS
+    value: Optional[str] = None  # seconds for delay/stall, exc name for error
+    p: float = 1.0
+    after: int = 0
+    max_fires: Optional[int] = None
+    match: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r} (known: {', '.join(KINDS)})"
+            )
+        if not (0.0 <= self.p <= 1.0):
+            raise ValueError(f"fault probability out of [0,1]: {self.p}")
+        if self.kind in ("delay", "stall"):
+            float(self.delay_s)  # validate at parse time, not at fire time
+        if self.kind == "error" and (self.value or "") not in _ERROR_TYPES:
+            raise ValueError(
+                f"unknown error type {self.value!r} "
+                f"(known: {', '.join(k for k in _ERROR_TYPES if k)})"
+            )
+        if self.kind == "kill" and self.max_fires is None:
+            self.max_fires = 1
+
+    @property
+    def delay_s(self) -> float:
+        if self.value is not None:
+            return float(self.value)
+        return 30.0 if self.kind == "stall" else 0.0
+
+    def exc(self) -> BaseException:
+        if self.kind == "drop":
+            return DroppedFrameError(
+                f"injected frame drop at {self.point}"
+            )
+        return _ERROR_TYPES[self.value or ""](
+            f"injected fault at {self.point}"
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "point": self.point, "kind": self.kind, "value": self.value,
+            "p": self.p, "after": self.after, "max": self.max_fires,
+            "match": self.match,
+        }
+
+
+@dataclass
+class FaultPlan:
+    seed: int = 0
+    rules: list[FaultRule] = field(default_factory=list)
+    # whether per-request X-Dyn-Fault headers may append scoped rules
+    allow_request_rules: bool = False
+
+    def rule_rng(self, index: int) -> random.Random:
+        rule = self.rules[index]
+        return random.Random(f"{self.seed}:{rule.point}:{index}")
+
+    def to_dict(self) -> dict:
+        return {
+            "seed": self.seed,
+            "allow_request_rules": self.allow_request_rules,
+            "rules": [r.to_dict() for r in self.rules],
+        }
+
+
+def parse_rule(text: str) -> FaultRule:
+    """One ``point:kind[=value][@mod=value]...`` element."""
+    text = text.strip()
+    head, *mods = text.split("@")
+    if ":" not in head:
+        raise ValueError(
+            f"fault rule {text!r} needs point:kind (e.g. store.call:error)"
+        )
+    point, _, kind_part = head.partition(":")
+    kind, _, value = kind_part.partition("=")
+    kw: dict = {}
+    for mod in mods:
+        key, eq, val = mod.strip().partition("=")
+        if not eq:
+            raise ValueError(f"fault modifier {mod!r} needs key=value")
+        if key == "p":
+            kw["p"] = float(val)
+        elif key == "after":
+            kw["after"] = int(val)
+        elif key == "max":
+            kw["max_fires"] = int(val)
+        elif key == "match":
+            kw["match"] = val
+        else:
+            raise ValueError(
+                f"unknown fault modifier {key!r} (known: p, after, max, match)"
+            )
+    return FaultRule(
+        point=point.strip(), kind=kind.strip(),
+        value=value if value != "" else None, **kw,
+    )
+
+
+def parse_plan(spec: str) -> FaultPlan:
+    """Parse the compact ``DYN_FAULTS`` syntax (or ``@path`` / JSON)."""
+    spec = spec.strip()
+    if spec.startswith("@"):
+        with open(spec[1:]) as f:
+            spec = f.read().strip()
+    if spec.startswith("{"):
+        return plan_from_dict(json.loads(spec))
+    plan = FaultPlan()
+    for element in spec.split(";"):
+        element = element.strip()
+        if not element:
+            continue
+        if element.startswith("seed="):
+            plan.seed = int(element[len("seed="):])
+        elif element in ("header", "header=1"):
+            plan.allow_request_rules = True
+        else:
+            plan.rules.append(parse_rule(element))
+    return plan
+
+
+def plan_from_dict(data: dict) -> FaultPlan:
+    rules = []
+    for r in data.get("rules", []):
+        rules.append(
+            FaultRule(
+                point=r["point"], kind=r["kind"], value=r.get("value"),
+                p=float(r.get("p", 1.0)), after=int(r.get("after", 0)),
+                max_fires=(
+                    int(r["max"]) if r.get("max") is not None else None
+                ),
+                match=r.get("match"),
+            )
+        )
+    return FaultPlan(
+        seed=int(data.get("seed", 0)),
+        rules=rules,
+        allow_request_rules=bool(data.get("allow_request_rules", False)),
+    )

@@ -1,0 +1,172 @@
+"""Admission control / load shedding for the HTTP frontend.
+
+The Tail-at-Scale failure mode this prevents: under overload an
+unbounded queue converts every request into a guaranteed SLO miss (and
+eventually an OOM) — the fleet "serves" everything and satisfies
+nothing. Rejecting early with ``429 Retry-After`` keeps the queue
+shallow enough that admitted requests still meet their deadlines, and
+gives well-behaved clients an explicit pacing signal.
+
+Signals (read per request from a live load snapshot — the engine's
+``stats()`` in single-process serving; anything matching the
+``LoadSnapshot`` shape elsewhere):
+
+- scheduler queue depth (waiting + prefilling) vs ``max_queue_depth``
+- KV pool pressure vs ``max_kv_usage``
+
+Retry budget: when overloaded, a small token bucket still admits a
+bounded trickle of probe requests (SRE retry-budget pattern inverted to
+the server side) so recovery is observed promptly instead of waiting a
+full Retry-After period after the backlog drains.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from dynamo_tpu.telemetry.instruments import REQUESTS_SHED
+
+
+@dataclass
+class LoadSnapshot:
+    queue_depth: int = 0
+    active_slots: int = 0
+    total_slots: int = 0
+    kv_usage: float = 0.0  # 0..1 fraction of the device KV pool in use
+
+
+@dataclass
+class AdmissionConfig:
+    max_queue_depth: int = 0   # 0 = queue-depth check disabled
+    max_kv_usage: float = 0.0  # 0.0 = KV-pressure check disabled
+    retry_after_s: float = 1.0  # base Retry-After; scaled by backlog
+    probe_rate_per_s: float = 1.0  # token-bucket refill (probes/s)
+    probe_burst: float = 2.0       # token-bucket capacity
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_queue_depth > 0 or self.max_kv_usage > 0.0
+
+
+@dataclass
+class Rejection:
+    reason: str        # queue_depth | kv_pressure
+    retry_after_s: float
+    detail: str
+
+
+class TokenBucket:
+    """Minimal monotonic-clock token bucket (injectable clock)."""
+
+    def __init__(
+        self, rate_per_s: float, burst: float,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.rate = max(0.0, rate_per_s)
+        self.burst = max(0.0, burst)
+        self._clock = clock
+        self._tokens = self.burst
+        self._last = clock()
+
+    def take(self, n: float = 1.0) -> bool:
+        now = self._clock()
+        self._tokens = min(
+            self.burst, self._tokens + (now - self._last) * self.rate
+        )
+        self._last = now
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False
+
+
+class AdmissionController:
+    """Per-request admit/reject decision from a live load snapshot.
+
+    ``load_fn`` returns a :class:`LoadSnapshot` (or None when load is
+    momentarily unknown — unknown load ADMITS: shedding must fail open,
+    an introspection hiccup is not overload).
+    """
+
+    def __init__(
+        self,
+        config: AdmissionConfig,
+        load_fn: Callable[[], Optional[LoadSnapshot]],
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.config = config
+        self.load_fn = load_fn
+        self._probes = TokenBucket(
+            config.probe_rate_per_s, config.probe_burst, clock=clock
+        )
+        self.shed_total = 0
+        self.admitted_total = 0
+
+    def check(self) -> Optional[Rejection]:
+        """None = admit; a Rejection = shed with 429 + Retry-After."""
+        cfg = self.config
+        if not cfg.enabled:
+            return None
+        try:
+            load = self.load_fn()
+        except Exception:
+            load = None
+        if load is None:
+            self.admitted_total += 1
+            return None
+        reason = detail = None
+        over = 0.0  # backlog multiple, scales Retry-After
+        if cfg.max_queue_depth > 0 and load.queue_depth >= cfg.max_queue_depth:
+            reason = "queue_depth"
+            over = load.queue_depth / cfg.max_queue_depth
+            detail = (
+                f"queue depth {load.queue_depth} >= limit "
+                f"{cfg.max_queue_depth}"
+            )
+        elif cfg.max_kv_usage > 0.0 and load.kv_usage >= cfg.max_kv_usage:
+            reason = "kv_pressure"
+            over = load.kv_usage / cfg.max_kv_usage
+            detail = (
+                f"kv pool usage {load.kv_usage:.2f} >= limit "
+                f"{cfg.max_kv_usage:.2f}"
+            )
+        if reason is None or self._probes.take():
+            self.admitted_total += 1
+            return None
+        self.shed_total += 1
+        REQUESTS_SHED.labels(reason).inc()
+        # deeper backlog -> longer Retry-After (coarse drain estimate),
+        # capped so clients never park for minutes on a stale hint
+        retry_after = min(30.0, self.config.retry_after_s * max(1.0, over))
+        return Rejection(
+            reason=reason, retry_after_s=retry_after, detail=detail or reason
+        )
+
+    def stats(self) -> dict:
+        return {
+            "enabled": self.config.enabled,
+            "max_queue_depth": self.config.max_queue_depth,
+            "max_kv_usage": self.config.max_kv_usage,
+            "shed_total": self.shed_total,
+            "admitted_total": self.admitted_total,
+        }
+
+
+def engine_load_fn(engine) -> Callable[[], Optional[LoadSnapshot]]:
+    """Adapt a JaxEngine's ForwardPassMetrics into LoadSnapshots."""
+
+    def load() -> Optional[LoadSnapshot]:
+        try:
+            stats = engine.stats()
+        except Exception:
+            return None
+        return LoadSnapshot(
+            queue_depth=stats.num_requests_waiting,
+            active_slots=stats.request_active_slots,
+            total_slots=stats.request_total_slots,
+            kv_usage=stats.gpu_cache_usage_perc,
+        )
+
+    return load

@@ -21,6 +21,8 @@ from typing import Optional
 from dynamo_tpu.model_card.card import MODELS_PREFIX, ModelEntry, fetch_card
 from dynamo_tpu.runtime.component import parse_dyn_path
 from dynamo_tpu.runtime.runtime import DistributedRuntime
+from dynamo_tpu.telemetry.instruments import WATCH_RESTARTS
+from dynamo_tpu.utils.backoff import Backoff
 
 log = logging.getLogger("dynamo_tpu.http.discovery")
 
@@ -44,6 +46,7 @@ class ModelWatcher:
         self._models: dict[str, tuple[str, list]] = {}
         self._watch = None
         self._task: Optional[asyncio.Task] = None
+        self._closed = False
 
     async def start(self) -> None:
         self._watch = await self.drt.store.watch_prefix(f"{MODELS_PREFIX}/")
@@ -56,6 +59,7 @@ class ModelWatcher:
         self._task = asyncio.get_running_loop().create_task(self._pump())
 
     async def close(self) -> None:
+        self._closed = True
         if self._task is not None:
             self._task.cancel()
             self._task = None
@@ -65,20 +69,61 @@ class ModelWatcher:
             await self._drop_model(slug)
 
     async def _pump(self) -> None:
+        """Consume watch events; when the watch dies (store restart,
+        connection blip), resubscribe on capped backoff + jitter and
+        resync from the fresh snapshot — the model registry must never
+        silently FREEZE (the pre-fix failure mode: one watch error and
+        the frontend served a stale model table forever)."""
         assert self._watch is not None
-        try:
-            async for ev in self._watch:
-                try:
-                    if ev.type == "put":
-                        await self._on_put(ev.entry.key, ev.entry.value)
-                    else:
-                        await self._on_delete(ev.entry.key)
-                except Exception:
-                    log.exception("model watch event failed: %s", ev.entry.key)
-        except asyncio.CancelledError:
-            raise
-        except Exception:
-            log.exception("model watch died; registry frozen")
+        backoff = Backoff(base_s=0.5, cap_s=30.0)
+        while not self._closed:
+            try:
+                async for ev in self._watch:
+                    try:
+                        if ev.type == "put":
+                            await self._on_put(ev.entry.key, ev.entry.value)
+                        else:
+                            await self._on_delete(ev.entry.key)
+                    except Exception:
+                        log.exception(
+                            "model watch event failed: %s", ev.entry.key
+                        )
+                # stream ENDED cleanly (store dropped it): resubscribe too
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("model watch died; resubscribing")
+            if self._closed:
+                return
+            WATCH_RESTARTS.labels("models").inc()
+            await backoff.sleep()
+            try:
+                self._watch = await self.drt.store.watch_prefix(
+                    f"{MODELS_PREFIX}/"
+                )
+            except Exception:
+                log.warning("model watch resubscribe failed; retrying",
+                            exc_info=True)
+                continue
+            backoff.reset()
+            try:
+                await self._resync(self._watch.snapshot())
+            except Exception:
+                log.exception("model registry resync failed")
+            log.info("model watch resubscribed")
+
+    async def _resync(self, snapshot: list) -> None:
+        """Reconcile registry state against a fresh watch snapshot:
+        events lost during the outage are replayed as put/delete."""
+        live_keys = {e.key for e in snapshot}
+        known_keys = {k for keys in self._instances.values() for k in keys}
+        for key in sorted(known_keys - live_keys):
+            await self._on_delete(key)
+        for entry in snapshot:
+            try:
+                await self._on_put(entry.key, entry.value)
+            except Exception:
+                log.exception("bad model entry in resync: %s", entry.key)
 
     # -- event handling ---------------------------------------------------
     @staticmethod

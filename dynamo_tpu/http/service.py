@@ -25,12 +25,14 @@ import asyncio
 import contextlib
 import json
 import logging
+import math
 import time
 import uuid
 from typing import Optional
 
 from aiohttp import web
 
+from dynamo_tpu import faults
 from dynamo_tpu.protocols.aggregators import ChatAggregator, CompletionAggregator
 from dynamo_tpu.protocols.openai import (
     ChatCompletionRequest,
@@ -58,6 +60,12 @@ from dynamo_tpu.telemetry.instruments import (
 log = logging.getLogger("dynamo_tpu.http")
 
 REQUEST_ID_HEADER = "X-Request-Id"
+# per-request deadline budget in milliseconds (docs/robustness.md);
+# --default-deadline-ms applies when the header is absent
+REQUEST_TIMEOUT_HEADER = "X-Request-Timeout-Ms"
+# per-request fault rules (only honored when the active DYN_FAULTS plan
+# opted in with `header`; see dynamo_tpu/faults)
+FAULT_HEADER = "X-Dyn-Fault"
 
 
 def _request_id_from(request: web.Request) -> str:
@@ -109,10 +117,17 @@ class HttpService:
         model_manager: Optional[ModelManager] = None,
         host: str = "0.0.0.0",
         port: int = 8000,
+        admission=None,
+        default_deadline_ms: Optional[float] = None,
     ):
         self.models = model_manager or ModelManager()
         self.host = host
         self.port = port
+        # load shedding (http/admission.py AdmissionController); None =
+        # every request admitted (zero-change default)
+        self.admission = admission
+        # deadline budget applied when X-Request-Timeout-Ms is absent
+        self.default_deadline_ms = default_deadline_ms
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self.app.add_routes(
             [
@@ -212,6 +227,61 @@ class HttpService:
         )
         set_log_request_id(rid, span.trace_id or None)
         try:
+            if faults.ACTIVE is not None:
+                # per-request chaos: the X-Dyn-Fault header arms rules
+                # scoped to this request id (no-op unless the active
+                # plan opted in), then the frontend's own injection
+                # point fires
+                hdr = request.headers.get(FAULT_HEADER)
+                if hdr:
+                    try:
+                        faults.ACTIVE.arm_request(hdr, rid)
+                    except ValueError as exc:
+                        return self._error(
+                            400, f"bad {FAULT_HEADER}: {exc}", "",
+                            endpoint, rid,
+                        )
+                await faults.ACTIVE.fire_async("http.request", request_id=rid)
+            # admission control (docs/robustness.md): consult live load
+            # BEFORE any expensive work; shed with 429 + Retry-After
+            # instead of queueing unboundedly
+            if self.admission is not None:
+                rejection = self.admission.check()
+                if rejection is not None:
+                    log.warning(
+                        "shedding request %s: %s", rid, rejection.detail
+                    )
+                    span.set_attr("shed", rejection.reason)
+                    return self._error(
+                        429,
+                        f"server overloaded ({rejection.detail}); retry "
+                        "after the indicated delay",
+                        "", endpoint, rid,
+                        headers={
+                            "Retry-After": str(
+                                max(1, int(rejection.retry_after_s))
+                            )
+                        },
+                    )
+            # per-request deadline budget: header beats the configured
+            # default; invalid values are a client error, not a guess
+            deadline_ms: Optional[float] = self.default_deadline_ms
+            raw_timeout = request.headers.get(REQUEST_TIMEOUT_HEADER)
+            if raw_timeout:
+                try:
+                    deadline_ms = float(raw_timeout)
+                    # not (x > 0) also rejects NaN, which would mint a
+                    # never-expiring local deadline but ship a 0 ms
+                    # budget over the wire
+                    if not (deadline_ms > 0) or math.isinf(deadline_ms):
+                        raise ValueError
+                except ValueError:
+                    return self._error(
+                        400,
+                        f"{REQUEST_TIMEOUT_HEADER} must be a positive "
+                        "number of milliseconds",
+                        "", endpoint, rid,
+                    )
             try:
                 body = await request.json()
             except json.JSONDecodeError:
@@ -246,6 +316,13 @@ class HttpService:
                 )
 
             ctx = Context(id=rid)
+            if deadline_ms is not None:
+                # the budget starts at admission; it propagates with the
+                # context (and over the worker wire) so every stage —
+                # queue wait, prefill dispatch, decode — can cancel the
+                # request instead of burning steps past its deadline
+                ctx.set_deadline_ms(deadline_ms)
+                span.set_attr("deadline_ms", deadline_ms)
             # the head's decision governs the WHOLE trace: a sampled-out
             # root propagates {"sampled": False} so downstream processes
             # don't start orphan root traces of their own
@@ -339,13 +416,19 @@ class HttpService:
 
     def _error(
         self, status: int, message: str, model: str, endpoint: str,
-        rid: str = "",
+        rid: str = "", headers: Optional[dict] = None,
     ) -> web.Response:
         HTTP_REQUESTS.labels(model, endpoint, str(status)).inc()
+        all_headers = dict(headers or {})
+        if rid:
+            all_headers[REQUEST_ID_HEADER] = rid
+        err_type = (
+            "overloaded_error" if status == 429 else "invalid_request_error"
+        )
         return web.json_response(
-            {"error": {"message": message, "type": "invalid_request_error"}},
+            {"error": {"message": message, "type": err_type}},
             status=status,
-            headers={REQUEST_ID_HEADER: rid} if rid else None,
+            headers=all_headers or None,
         )
 
 

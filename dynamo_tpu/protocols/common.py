@@ -20,6 +20,7 @@ class FinishReason(str, enum.Enum):
     STOP = "stop"            # hit a stop token / stop string
     LENGTH = "length"        # hit max_tokens / context limit
     CANCELLED = "cancelled"  # client disconnected or kill-signalled
+    TIMEOUT = "timeout"      # request deadline budget expired
     ERROR = "error"
     CONTENT_FILTER = "content_filter"
 

@@ -26,6 +26,8 @@ import msgpack
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 from dynamo_tpu.runtime.runtime import DistributedRuntime
 from dynamo_tpu.store.base import Subscription, WatchEvent
+from dynamo_tpu.telemetry.instruments import WATCH_RESTARTS
+from dynamo_tpu.utils.backoff import Backoff
 
 log = logging.getLogger("dynamo_tpu.runtime.component")
 
@@ -227,6 +229,7 @@ class Client:
         self._watch = None
         self._watch_task: Optional[asyncio.Task] = None
         self._instances_event = asyncio.Event()
+        self._closed = False
         if static_instance is not None:
             self._instances_event.set()
 
@@ -241,9 +244,52 @@ class Client:
         self._watch_task = asyncio.get_running_loop().create_task(self._watch_loop())
 
     async def _watch_loop(self) -> None:
+        """Apply discovery events; on watch death (store restart/blip)
+        resubscribe with capped backoff + jitter and resync from the
+        fresh snapshot — a frozen instance view would keep routing to
+        dead workers and never see new ones."""
         assert self._watch is not None
-        async for ev in self._watch:
-            self._apply(ev)
+        prefix = f"{INSTANCE_PREFIX}/{self.endpoint.path}:"
+        backoff = Backoff(base_s=0.5, cap_s=30.0)
+        while not self._closed:
+            try:
+                async for ev in self._watch:
+                    self._apply(ev)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("instance watch died; resubscribing")
+            if self._closed:
+                return
+            WATCH_RESTARTS.labels("instances").inc()
+            await backoff.sleep()
+            try:
+                self._watch = await self.endpoint.drt.store.watch_prefix(prefix)
+            except Exception:
+                log.warning("instance watch resubscribe failed; retrying",
+                            exc_info=True)
+                continue
+            backoff.reset()
+            try:
+                fresh = {}
+                for entry in self._watch.snapshot():
+                    try:
+                        inst = _decode_instance(entry.key, entry.value)
+                    except Exception:
+                        # one malformed entry must not re-freeze the view
+                        log.exception("bad instance entry in resync: %s",
+                                      entry.key)
+                        continue
+                    fresh[inst.instance_id] = inst
+                self.instances.clear()
+                self.instances.update(fresh)
+                if self.instances:
+                    self._instances_event.set()
+                else:
+                    self._instances_event.clear()
+                log.info("instance watch resubscribed (%d live)", len(fresh))
+            except Exception:
+                log.exception("instance view resync failed; watch continues")
 
     def _apply(self, ev: WatchEvent) -> None:
         if ev.type == "put":
@@ -298,6 +344,7 @@ class Client:
             raise ConnectionError(str(exc)) from exc
 
     async def close(self) -> None:
+        self._closed = True
         if self._watch_task is not None:
             self._watch_task.cancel()
         if self._watch is not None:

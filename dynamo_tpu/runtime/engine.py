@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import abc
 import asyncio
+import time
 import uuid
 from typing import Any, AsyncIterator, Awaitable, Callable, Generic, Optional, TypeVar
 
@@ -41,6 +42,12 @@ class Context:
         self.id = id or uuid.uuid4().hex
         self.trace_id = trace_id
         self.span_id = span_id
+        # request deadline (docs/robustness.md): a time.monotonic()
+        # instant, or None for no budget. The REMAINING budget rides
+        # the wire (runtime/service.py ships deadline_ms; the receiver
+        # re-anchors to its own clock), so cross-process propagation
+        # never compares wall clocks.
+        self.deadline: Optional[float] = None
         # None = no sampling decision seen; False = the trace head
         # explicitly sampled this request OUT — downstream tracers must
         # not start fresh roots for it (the mark rides the wire)
@@ -71,6 +78,23 @@ class Context:
             self.span_id = ctx.get("span_id")
             self.trace_sampled = True
 
+    def set_deadline_ms(self, budget_ms: Optional[float]) -> None:
+        """Arm (or clear, with None) a deadline ``budget_ms`` from now."""
+        self.deadline = (
+            time.monotonic() + budget_ms / 1e3
+            if budget_ms is not None else None
+        )
+
+    def remaining_ms(self) -> Optional[float]:
+        """Milliseconds of budget left (None = no deadline; >= 0.0)."""
+        if self.deadline is None:
+            return None
+        return max(0.0, (self.deadline - time.monotonic()) * 1e3)
+
+    @property
+    def is_expired(self) -> bool:
+        return self.deadline is not None and time.monotonic() >= self.deadline
+
     def stop_generating(self) -> None:
         self._stop.set()
 
@@ -93,6 +117,7 @@ class Context:
         """A linked context sharing cancellation with this one."""
         c = Context(id=self.id, trace_id=self.trace_id, span_id=self.span_id)
         c.trace_sampled = self.trace_sampled
+        c.deadline = self.deadline
         c._stop = self._stop
         c._kill = self._kill
         return c

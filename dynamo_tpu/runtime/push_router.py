@@ -4,7 +4,13 @@ Analogue of the reference's PushRouter (reference:
 lib/runtime/src/pipeline/network/egress/push_router.rs:34-204) with the
 same modes: random, round-robin, direct, and a pluggable selector hook the
 KV-aware router uses (reference: lib/llm/src/kv_router.rs KvPushRouter).
-Retries on connection failure against a different instance.
+
+Failover (docs/robustness.md): dispatch failures AND streams that die
+before yielding a single item are re-dispatched to a different instance
+under a bounded retry budget with exponential backoff + jitter. A
+stream that dies AFTER items were yielded cannot be replayed (tokens
+already reached the client); it terminates with a clean error the HTTP
+layer turns into an SSE ``error`` event — never a hung connection.
 """
 
 from __future__ import annotations
@@ -17,8 +23,34 @@ from typing import Any, AsyncIterator, Awaitable, Callable, Optional
 
 from dynamo_tpu.runtime.component import Client
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
+from dynamo_tpu.runtime.service import ConnectionLostError
+from dynamo_tpu.telemetry.instruments import (
+    FAILOVER_RETRIES,
+    MIDSTREAM_ABORTS,
+)
+from dynamo_tpu.utils.backoff import Backoff
 
 log = logging.getLogger("dynamo_tpu.runtime.push_router")
+
+
+class WorkerStreamLostError(RuntimeError):
+    """A worker died after streaming part of a response; the stream is
+    not replayable. Carries a clean, client-presentable message."""
+
+
+async def deadline_backoff_sleep(backoff: Backoff, context: Context) -> None:
+    """One failover backoff, clamped to the request's remaining deadline
+    budget; raises TimeoutError instead of retrying past the deadline.
+    Shared by PushRouter and KvPushRouter."""
+    delay = backoff.next_delay()
+    remaining = context.remaining_ms()
+    if remaining is not None:
+        if remaining <= 0:
+            raise asyncio.TimeoutError(
+                "request deadline exceeded during failover"
+            )
+        delay = min(delay, remaining / 1e3)
+    await asyncio.sleep(delay)
 
 # A selector maps (request, live instance ids) -> chosen instance id.
 Selector = Callable[[Any, list[int]], Awaitable[int]]
@@ -38,11 +70,15 @@ class PushRouter(AsyncEngine):
         mode: RouterMode = RouterMode.RANDOM,
         selector: Optional[Selector] = None,
         max_attempts: int = 3,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 2.0,
     ):
         self.client = client
         self.mode = mode
         self.selector = selector
         self.max_attempts = max_attempts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
         self._rr_index = 0
         if mode == RouterMode.CUSTOM and selector is None:
             raise ValueError("CUSTOM mode requires a selector")
@@ -71,6 +107,7 @@ class PushRouter(AsyncEngine):
 
         exclude: set[int] = set()
         last_err: Exception | None = None
+        backoff = Backoff(base_s=self.backoff_base_s, cap_s=self.backoff_cap_s)
         # one span for the whole routed dispatch (pick + stream); the
         # worker's own span parents here via the wire's trace context
         span = get_tracer().span(
@@ -82,6 +119,9 @@ class PushRouter(AsyncEngine):
             context.set_trace(span)
         try:
             for attempt in range(self.max_attempts):
+                if attempt:
+                    FAILOVER_RETRIES.inc()
+                    await deadline_backoff_sleep(backoff, context)
                 instance_id = await self._pick(request, exclude)
                 try:
                     stream = await self.client.generate_direct(
@@ -96,9 +136,32 @@ class PushRouter(AsyncEngine):
                 span.set_attr("instance", f"{instance_id:x}")
                 if attempt:
                     span.set_attr("retries", attempt)
-                async for item in stream:
-                    yield item
-                return
+                yielded = False
+                try:
+                    async for item in stream:
+                        yielded = True
+                        yield item
+                    return
+                except ConnectionLostError as exc:
+                    # the WORKER died while this stream was open
+                    exclude.add(instance_id)
+                    last_err = exc
+                    if yielded:
+                        # tokens already reached the client: a silent
+                        # re-dispatch would replay/duplicate them. End
+                        # with a clean error instead (the HTTP layer
+                        # turns this into an SSE `error` event).
+                        MIDSTREAM_ABORTS.inc()
+                        span.set_attr("midstream_abort", True)
+                        raise WorkerStreamLostError(
+                            "worker connection lost mid-stream; partial "
+                            "response cannot be resumed"
+                        ) from exc
+                    log.warning(
+                        "instance %x died before first item; failing over",
+                        instance_id,
+                    )
+                    continue
             raise RuntimeError(
                 f"all attempts failed for {self.client.endpoint.path}: {last_err}"
             )

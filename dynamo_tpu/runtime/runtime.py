@@ -86,7 +86,12 @@ class DistributedRuntime:
             if config.static:
                 store = MemoryStore()
             else:
-                store = await StoreClient.connect(config.store_host, config.store_port)
+                # reconnect: a coordinator blip redials on backoff instead
+                # of bricking the client (docs/robustness.md); the lease
+                # keepalive below decides whether the process survives it
+                store = await StoreClient.connect(
+                    config.store_host, config.store_port, reconnect=True
+                )
         lease_id = await store.lease_grant(config.lease_ttl_s)
         drt = cls(runtime, store, config, lease_id)
         drt._keepalive_task = asyncio.get_running_loop().create_task(
@@ -95,16 +100,31 @@ class DistributedRuntime:
         return drt
 
     async def _keepalive_loop(self) -> None:
+        # transient store disconnects are tolerated for up to the lease
+        # TTL (the client is redialing on backoff underneath); once the
+        # TTL has certainly lapsed the lease is gone server-side anyway,
+        # so the process shuts down rather than serve unregistered
+        down_since: Optional[float] = None
         while not self.runtime.is_shutdown:
             await asyncio.sleep(self.config.lease_keepalive_s)
             try:
                 ok = await self.store.lease_keepalive(self.primary_lease_id)
-                if not ok:
-                    log.error("primary lease lost; shutting down")
+            except ConnectionError:
+                now = asyncio.get_running_loop().time()
+                if down_since is None:
+                    down_since = now
+                    log.warning(
+                        "store unreachable; retrying keepalive within the "
+                        "lease TTL (%.0fs)", self.config.lease_ttl_s,
+                    )
+                if now - down_since >= self.config.lease_ttl_s:
+                    log.error("store connection lost; shutting down")
                     self.runtime.shutdown()
                     return
-            except ConnectionError:
-                log.error("store connection lost; shutting down")
+                continue
+            down_since = None
+            if not ok:
+                log.error("primary lease lost; shutting down")
                 self.runtime.shutdown()
                 return
 

@@ -21,11 +21,20 @@ import itertools
 import logging
 from typing import Any, AsyncIterator, Optional
 
+from dynamo_tpu import faults
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 from dynamo_tpu.store.wire import read_frame, shutdown_server, write_frame
 from dynamo_tpu.telemetry import get_tracer, propagation_context
 
 log = logging.getLogger("dynamo_tpu.runtime.service")
+
+
+class ConnectionLostError(ConnectionError):
+    """The worker connection died while a response stream was open.
+
+    Raised to stream consumers (instead of a bare RuntimeError) so
+    routers can distinguish a vanished WORKER — retryable before the
+    first token — from a genuine engine error, which is not."""
 
 
 def to_wire(obj: Any) -> Any:
@@ -136,6 +145,10 @@ class EndpointServer:
                     )
                     if wire_ctx.get("sampled") is False:
                         ctx.trace_sampled = False
+                    if wire_ctx.get("deadline_ms") is not None:
+                        # re-anchor the caller's REMAINING budget to our
+                        # own monotonic clock (wall clocks never compared)
+                        ctx.set_deadline_ms(float(wire_ctx["deadline_ms"]))
                     task = asyncio.get_running_loop().create_task(
                         run_stream(sid, msg["ep"], ctx, msg.get("p"))
                     )
@@ -186,6 +199,13 @@ class EndpointConnection:
         try:
             while True:
                 msg = await read_frame(self._reader)
+                if faults.ACTIVE is not None:
+                    # injected recv faults: a `drop` here is a realistic
+                    # peer-vanished teardown (ConnectionError ends the
+                    # loop and fails every waiter below)
+                    await faults.ACTIVE.fire_async(
+                        "transport.recv", sid=msg.get("sid") or ""
+                    )
                 q = self._queues.get(msg.get("sid"))
                 if q is not None:
                     q.put_nowait(msg)
@@ -196,11 +216,17 @@ class EndpointConnection:
         finally:
             self.closed = True
             for q in self._queues.values():
-                q.put_nowait({"t": "err", "e": "connection lost"})
+                q.put_nowait({"t": "err", "e": "connection lost", "lost": True})
 
     async def _send(self, obj: Any) -> None:
         if self._writer is None or self.closed:
             raise ConnectionError("endpoint connection closed")
+        if faults.ACTIVE is not None:
+            await faults.ACTIVE.fire_async(
+                "transport.send",
+                endpoint=obj.get("ep") or "",
+                request_id=(obj.get("ctx") or {}).get("id") or "",
+            )
         async with self._lock:
             write_frame(self._writer, obj)
             await self._writer.drain()
@@ -215,6 +241,10 @@ class EndpointConnection:
         self._queues[sid] = q
         loop = asyncio.get_running_loop()
         wire_ctx: dict = {"id": ctx.id}
+        if ctx.deadline is not None:
+            # ship the REMAINING budget; the worker re-anchors it to its
+            # own monotonic clock (see EndpointServer._handle)
+            wire_ctx["deadline_ms"] = ctx.remaining_ms()
         if ctx.trace_sampled is False:
             # the head's negative sampling decision rides the wire so
             # downstream tracers stay quiet for this request too
@@ -257,6 +287,10 @@ class EndpointConnection:
                         return
                     elif t == "err":
                         finished = True
+                        if msg.get("lost"):
+                            raise ConnectionLostError(
+                                msg.get("e", "connection lost")
+                            )
                         raise RuntimeError(msg.get("e", "remote error"))
             finally:
                 notifier.cancel()

@@ -2,14 +2,26 @@
 
 Multiplexes concurrent requests over one connection; watches and
 subscriptions are server-push streams dispatched to local queues.
+
+Reconnect (docs/robustness.md): with ``reconnect=True`` a lost
+connection is redialed with capped exponential backoff + jitter
+(utils/backoff.py) instead of failing the client permanently — a
+flapping or restarting coordinator is never hammered by a tight
+reconnect loop. In-flight calls at the moment of loss still fail with
+ConnectionError (they cannot be replayed safely), and open
+watches/subscriptions END (their consumers — http/discovery.py, the
+component Client — resubscribe on their own backoff); calls issued
+after the redial succeed.
 """
 
 from __future__ import annotations
 
 import asyncio
 import itertools
+import logging
 from typing import Any, AsyncIterator, Optional
 
+from dynamo_tpu import faults
 from dynamo_tpu.store.base import (
     NO_LEASE,
     KvEntry,
@@ -20,6 +32,10 @@ from dynamo_tpu.store.base import (
     WatchEvent,
 )
 from dynamo_tpu.store.wire import read_frame, write_frame
+from dynamo_tpu.telemetry.instruments import STORE_RECONNECTS
+from dynamo_tpu.utils.backoff import Backoff
+
+log = logging.getLogger("dynamo_tpu.store.client")
 
 
 def _dec_entry(d: dict) -> KvEntry:
@@ -82,15 +98,59 @@ class StoreClient(Store):
         self._rx_task: asyncio.Task | None = None
         self._write_lock = asyncio.Lock()
         self._closed = False
+        self._reconnect = False
+        self._connected = False
 
     @classmethod
-    async def connect(cls, host: str = "127.0.0.1", port: int = 4222) -> "StoreClient":
+    async def connect(
+        cls,
+        host: str = "127.0.0.1",
+        port: int = 4222,
+        reconnect: bool = False,
+    ) -> "StoreClient":
         client = cls(host, port)
+        client._reconnect = reconnect
         client._reader, client._writer = await asyncio.open_connection(host, port)
-        client._rx_task = asyncio.get_running_loop().create_task(client._rx_loop())
+        client._connected = True
+        client._rx_task = asyncio.get_running_loop().create_task(
+            client._rx_forever()
+        )
         return client
 
-    async def _rx_loop(self) -> None:
+    async def _rx_forever(self) -> None:
+        """Read frames until the connection dies; with reconnect
+        enabled, redial on capped backoff + jitter and resume."""
+        backoff = Backoff(base_s=0.2, cap_s=10.0)
+        while True:
+            try:
+                await self._rx_once()
+            finally:
+                self._connected = False
+            if self._closed or not self._reconnect:
+                return
+            while not self._closed:
+                delay = await backoff.sleep()
+                try:
+                    self._reader, self._writer = await asyncio.open_connection(
+                        self.host, self.port
+                    )
+                except OSError:
+                    log.debug(
+                        "store redial failed (next in ~%.1fs)", delay
+                    )
+                    continue
+                self._connected = True
+                backoff.reset()
+                STORE_RECONNECTS.inc()
+                log.warning(
+                    "store connection re-established to %s:%d",
+                    self.host, self.port,
+                )
+                break
+            if self._closed:
+                return
+
+    async def _rx_once(self) -> None:
         assert self._reader is not None
         try:
             while True:
@@ -113,6 +173,9 @@ class StoreClient(Store):
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
+            # per-connection cleanup: in-flight calls cannot be replayed
+            # safely (the op may have applied server-side), so they fail;
+            # stream consumers see end-of-stream and resubscribe themselves
             err = ConnectionError("store connection lost")
             for fut in self._pending.values():
                 if not fut.done():
@@ -123,8 +186,10 @@ class StoreClient(Store):
             self._streams.clear()
 
     async def _call(self, op: str, *args: Any) -> Any:
-        if self._writer is None or self._closed:
+        if self._writer is None or self._closed or not self._connected:
             raise ConnectionError("store client not connected")
+        if faults.ACTIVE is not None:
+            await faults.ACTIVE.fire_async("store.call", op=op)
         rid = next(self._req_ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut

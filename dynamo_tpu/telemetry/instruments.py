@@ -222,6 +222,45 @@ HBM_PEAK_BYTES = REGISTRY.gauge(
     "accounted maximum on backends without memory stats)",
 )
 
+# -- robustness (docs/robustness.md: faults, deadlines, shedding, failover) -
+FAULTS_FIRED = REGISTRY.counter(
+    "dynamo_faults_fired_total",
+    "Injected faults fired, by injection point and fault kind "
+    "(nonzero only when a DYN_FAULTS plan is active)",
+    labels=("point", "kind"),
+)
+WATCH_RESTARTS = REGISTRY.counter(
+    "dynamo_watch_restarts_total",
+    "Store watch streams resubscribed after dying (discovery watchers "
+    "recover instead of freezing their registry)",
+    labels=("watcher",),  # models | instances
+)
+STORE_RECONNECTS = REGISTRY.counter(
+    "dynamo_store_reconnects_total",
+    "Coordinator-store client redials after a lost connection",
+)
+DEADLINE_EXPIRED = REGISTRY.counter(
+    "dynamo_deadline_expired_total",
+    "Requests cancelled because their deadline budget expired, by the "
+    "lifecycle stage that caught the expiry",
+    labels=("stage",),  # admission | queue | prefill | decode | prefill_queue
+)
+REQUESTS_SHED = REGISTRY.counter(
+    "dynamo_requests_shed_total",
+    "Requests rejected 429 by admission control, by overload signal",
+    labels=("reason",),  # queue_depth | kv_pressure
+)
+FAILOVER_RETRIES = REGISTRY.counter(
+    "dynamo_failover_retries_total",
+    "Requests re-dispatched to another worker after a dispatch or "
+    "pre-first-token stream failure",
+)
+MIDSTREAM_ABORTS = REGISTRY.counter(
+    "dynamo_midstream_aborts_total",
+    "Streams terminated with a clean error after their worker died "
+    "mid-generation (tokens already streamed; not retryable)",
+)
+
 # -- disaggregation (decode-side routing + prefill queue) -------------------
 DISAGG_REMOTE_PREFILLS = REGISTRY.counter(
     "dynamo_disagg_remote_prefills_total",

@@ -1,0 +1,53 @@
+"""Capped exponential backoff with jitter.
+
+One shared implementation for every reconnect/retry loop in the stack
+(store client redial, discovery watch resubscribe, router failover),
+so "retry with backoff + jitter" means the same thing everywhere and
+dynalint DL008 (unbounded-retry-loop) has a recognizable idiom to
+accept. Half-to-full jitter (AWS architecture-blog variant): the delay
+for attempt n is uniform in [cap/2, cap] of ``base * factor**n``, which
+de-synchronizes a thundering herd of reconnecting clients while keeping
+a deterministic lower bound on pacing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Optional
+
+
+class Backoff:
+    """Stateful backoff schedule: call ``next_delay()`` (or ``sleep()``)
+    per failed attempt, ``reset()`` after a success.
+
+    ``rng`` is injectable so tests (and the seeded fault-injection
+    suite) get deterministic schedules.
+    """
+
+    def __init__(
+        self,
+        base_s: float = 0.1,
+        cap_s: float = 30.0,
+        factor: float = 2.0,
+        rng: Optional[random.Random] = None,
+    ):
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.factor = factor
+        self.attempt = 0
+        self._rng = rng or random.Random()
+
+    def next_delay(self) -> float:
+        """The jittered delay for the current attempt; advances state."""
+        raw = min(self.cap_s, self.base_s * (self.factor ** self.attempt))
+        self.attempt += 1
+        return self._rng.uniform(raw / 2.0, raw)
+
+    async def sleep(self) -> float:
+        delay = self.next_delay()
+        await asyncio.sleep(delay)
+        return delay
+
+    def reset(self) -> None:
+        self.attempt = 0

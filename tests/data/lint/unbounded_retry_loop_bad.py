@@ -1,0 +1,20 @@
+"""Fixture: tight reconnect loops with no pacing (DL008 must fire)."""
+import asyncio
+
+
+async def reconnect_forever(host, port):
+    while True:
+        try:
+            reader, writer = await asyncio.open_connection(host, port)  # VIOLATION: no backoff between redials
+            return reader, writer
+        except OSError:
+            continue
+
+
+async def redial_client(client):
+    while True:
+        try:
+            await client.connect()  # VIOLATION: hammers a flapping peer
+            break
+        except ConnectionError:
+            pass

@@ -1,0 +1,31 @@
+"""Fixture: paced reconnect loops (DL008 must stay quiet)."""
+import asyncio
+
+from dynamo_tpu.utils.backoff import Backoff
+
+
+async def reconnect_with_backoff(host, port):
+    backoff = Backoff(base_s=0.2, cap_s=10.0)  # capped exponential + jitter
+    while True:
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+            return reader, writer
+        except OSError:
+            await backoff.sleep()
+
+
+async def redial_with_plain_sleep(client):
+    while True:
+        try:
+            await client.connect()
+            break
+        except ConnectionError:
+            await asyncio.sleep(1.0)  # fixed pacing still bounds the rate
+
+
+async def read_loop(reader, handle):
+    # read loops block on DATA, not on connection establishment: never
+    # flagged even without a sleep
+    while True:
+        frame = await reader.readexactly(4)
+        handle(frame)

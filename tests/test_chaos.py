@@ -1,0 +1,531 @@
+"""Deterministic chaos suite (docs/robustness.md acceptance gates).
+
+Every test here runs a fixed-seed fault plan (or a provoked failure)
+and asserts a graceful-degradation contract:
+
+- injected engine-step faults never change greedy output (quarantine
+  retries absorb them);
+- expired-deadline requests are cancelled at queue/decode stage and
+  their KV blocks freed;
+- overload sheds with 429 + Retry-After instead of queueing unboundedly;
+- a worker dying mid-stream never hangs the consumer: pre-first-token
+  streams fail over, mid-stream ones end with a clean error (and a
+  clean SSE ``error`` event at the HTTP layer).
+"""
+
+import asyncio
+import os
+import time
+from typing import Any, AsyncIterator
+
+import pytest
+
+from dynamo_tpu import faults
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context, FnEngine, collect
+
+MODEL_DIR = os.path.join(os.path.dirname(__file__), "data", "tiny_llama_model")
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.deactivate()
+    yield
+    faults.deactivate()
+
+
+def _engine_config(**kw):
+    from dynamo_tpu.engine.config import EngineConfig
+
+    defaults = dict(
+        model_path=MODEL_DIR,
+        model_name="tiny",
+        random_weights=True,
+        num_blocks=128,
+        block_size=8,
+        max_batch_size=8,
+        prefill_chunk_size=32,
+        max_model_len=256,
+    )
+    defaults.update(kw)
+    return EngineConfig(**defaults)
+
+
+async def _generate(engine, prompt_ids, max_tokens=8, ctx=None, request_id="r"):
+    adapter = engine.as_async_engine()
+    req = PreprocessedRequest(
+        request_id=request_id,
+        token_ids=list(prompt_ids),
+        sampling=SamplingOptions(use_greedy=True),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    )
+    out, final = [], None
+    async for item in adapter.generate(req, ctx or Context()):
+        out.extend(item.token_ids)
+        if item.is_final:
+            final = item
+    return out, final
+
+
+# ---------------------------------------------------------------------------
+# Engine under the canned chaos plan
+# ---------------------------------------------------------------------------
+
+
+async def test_engine_greedy_bit_identical_under_step_faults():
+    """The canned plan delays steps and injects one transient step
+    error; quarantine retries the first failure with host state
+    untouched, so greedy output must be BIT-IDENTICAL to fault-free."""
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    engine = await JaxEngine.launch(_engine_config())
+    try:
+        prompt = list(range(1, 40))
+        baseline, fin = await _generate(engine, prompt, request_id="base")
+        assert fin.finish_reason == FinishReason.LENGTH
+
+        faults.activate(faults.parse_plan(
+            "seed=1234;engine.step:delay=0.002@p=0.3;"
+            "engine.step:error@after=2@max=1"
+        ))
+        chaotic, fin2 = await _generate(engine, prompt, request_id="chaos")
+        assert fin2.finish_reason == FinishReason.LENGTH
+        assert chaotic == baseline
+        # the plan actually fired (determinism: error always fires once)
+        stats = faults.ACTIVE.stats()
+        fired = {
+            (r["point"], r["kind"]): r["fires"] for r in stats["rules"]
+        }
+        assert fired[("engine.step", "error")] == 1
+    finally:
+        faults.deactivate()
+        await engine.shutdown()
+
+
+async def test_expired_deadline_frees_kv_blocks_mid_decode():
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    engine = await JaxEngine.launch(_engine_config())
+    try:
+        ctx = Context()
+        ctx.set_deadline_ms(250.0)
+        t0 = time.monotonic()
+        toks, fin = await _generate(
+            engine, list(range(1, 30)), max_tokens=100_000, ctx=ctx,
+            request_id="deadline-decode",
+        )
+        assert fin is not None
+        assert fin.finish_reason == FinishReason.TIMEOUT
+        assert time.monotonic() - t0 < 30.0  # cancelled, not served out
+        # KV blocks freed once the reap ran
+        await engine.wait_for_state(
+            lambda e: e.allocator.num_free == e.allocator.num_blocks - 1,
+            timeout=10.0,
+        )
+    finally:
+        await engine.shutdown()
+
+
+def test_expired_deadline_reaped_from_queue_frees_blocks():
+    """Scheduler-level: a request whose deadline lapses while WAITING is
+    finished with TIMEOUT before it ever takes blocks; one that expires
+    in PREFILL frees the blocks it held."""
+    from dynamo_tpu.engine.allocator import BlockAllocator
+    from dynamo_tpu.engine.scheduler import Scheduler, Sequence
+    from dynamo_tpu.tokens import TokenBlockSequence
+
+    alloc = BlockAllocator(32, 4)
+    sched = Scheduler(alloc, block_size=4, max_batch_size=4)
+    finishes = []
+    sched.on_finish = lambda seq, reason: finishes.append(
+        (seq.request_id, reason)
+    )
+
+    def make_seq(rid: str, deadline: float) -> Sequence:
+        req = PreprocessedRequest(
+            request_id=rid, token_ids=list(range(1, 9)),
+            stop=StopConditions(max_tokens=4),
+        )
+        seq = Sequence(request=req, tokens=TokenBlockSequence(
+            list(req.token_ids), block_size=4,
+        ))
+        seq.deadline = deadline
+        return seq
+
+    expired = make_seq("expired", time.monotonic() - 1.0)
+    live = make_seq("live", time.monotonic() + 60.0)
+    sched.add_request(expired)
+    sched.add_request(live)
+    free_before = alloc.num_free
+    plan = sched.plan()
+    assert ("expired", FinishReason.TIMEOUT) in finishes
+    assert plan.kind == "prefill"
+    assert [w.seq.request_id for w in plan.prefill_batch] == ["live"]
+    # prefill-stage expiry: lapse the live seq's deadline mid-prefill
+    live.deadline = time.monotonic() - 0.001
+    plan2 = sched.plan()
+    assert ("live", FinishReason.TIMEOUT) in finishes
+    assert plan2.kind == "idle"
+    assert alloc.num_free == free_before  # every block returned
+
+
+# ---------------------------------------------------------------------------
+# Overload shedding (429 + Retry-After)
+# ---------------------------------------------------------------------------
+
+
+async def test_overload_sheds_429_with_retry_after():
+    import aiohttp
+
+    from dynamo_tpu.http.admission import (
+        AdmissionConfig,
+        AdmissionController,
+        LoadSnapshot,
+    )
+    from dynamo_tpu.http.service import HttpService, ModelManager
+    from dynamo_tpu.protocols.openai import ChatDeltaGenerator
+
+    load = LoadSnapshot(queue_depth=0, kv_usage=0.0)
+
+    async def chat(request, ctx):
+        gen = ChatDeltaGenerator(model="m")
+        yield gen.text_chunk("ok ")
+        yield gen.finish_chunk(FinishReason.STOP)
+
+    manager = ModelManager()
+    manager.add_chat_model("m", FnEngine(chat))
+    admission = AdmissionController(
+        AdmissionConfig(
+            max_queue_depth=4, max_kv_usage=0.95, retry_after_s=2.0,
+            probe_rate_per_s=0.0, probe_burst=0.0,  # deterministic: no probes
+        ),
+        lambda: load,
+    )
+    service = HttpService(
+        manager, host="127.0.0.1", port=0, admission=admission
+    )
+    await service.start()
+    base = f"http://127.0.0.1:{service.port}"
+    body = {"model": "m", "messages": [{"role": "user", "content": "hi"}]}
+    try:
+        async with aiohttp.ClientSession() as s:
+            # healthy: admitted
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+            # saturate the queue signal: shed with Retry-After
+            load.queue_depth = 8
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 429
+                retry_after = int(r.headers["Retry-After"])
+                assert retry_after >= 1
+                err = await r.json()
+                assert err["error"]["type"] == "overloaded_error"
+            # KV pressure sheds too
+            load.queue_depth = 0
+            load.kv_usage = 0.99
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 429
+            # pressure gone: admitted again (recovery, not a latch)
+            load.kv_usage = 0.0
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+        assert admission.shed_total == 2
+    finally:
+        await service.stop()
+
+
+async def test_probe_bucket_admits_bounded_trickle_under_overload():
+    from dynamo_tpu.http.admission import (
+        AdmissionConfig,
+        AdmissionController,
+        LoadSnapshot,
+    )
+
+    now = [0.0]
+    ctl = AdmissionController(
+        AdmissionConfig(
+            max_queue_depth=1, probe_rate_per_s=1.0, probe_burst=2.0
+        ),
+        lambda: LoadSnapshot(queue_depth=10),
+        clock=lambda: now[0],
+    )
+    # burst of 2 probes admitted, the rest shed
+    results = [ctl.check() is None for _ in range(6)]
+    assert results == [True, True, False, False, False, False]
+    now[0] += 3.0  # refill (capped at the burst of 2)
+    assert ctl.check() is None
+    assert ctl.check() is None
+    assert ctl.check() is not None
+
+
+# ---------------------------------------------------------------------------
+# Mid-stream worker failure: failover or clean termination, never a hang
+# ---------------------------------------------------------------------------
+
+
+async def _two_worker_fleet():
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+    from dynamo_tpu.store.memory import MemoryStore
+    from dynamo_tpu.store.server import StoreServer
+
+    server = StoreServer(MemoryStore(lease_sweep_interval_s=0.1), port=0)
+    await server.start()
+    cfg = lambda: RuntimeConfig(  # noqa: E731
+        store_port=server.port, worker_host="127.0.0.1",
+        lease_ttl_s=2.0, lease_keepalive_s=0.5,
+    )
+    drts = [await DistributedRuntime.create(config=cfg()) for _ in range(3)]
+    w1, w2, frontend = drts
+
+    def worker_engine(tag: str) -> FnEngine:
+        async def gen(request: Any, ctx: Context) -> AsyncIterator[Any]:
+            for i in range(3):
+                yield {"worker": tag, "i": i}
+
+        return FnEngine(gen)
+
+    for drt, tag in ((w1, "w1"), (w2, "w2")):
+        ep = drt.namespace("ns").component("gen").endpoint("generate")
+        await ep.serve(worker_engine(tag))
+    ep = frontend.namespace("ns").component("gen").endpoint("generate")
+    client = await ep.client()
+    await client.wait_for_instances(timeout_s=10)
+    for _ in range(100):
+        if len(client.instance_ids()) == 2:
+            break
+        await asyncio.sleep(0.05)
+    assert len(client.instance_ids()) == 2
+    return server, drts, client
+
+
+async def test_pre_first_token_stream_loss_fails_over():
+    """A connection that dies before the first item re-dispatches to a
+    healthy worker and the request still completes."""
+    from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+
+    server, drts, client = await _two_worker_fleet()
+    try:
+        router = PushRouter(client, RouterMode.ROUND_ROBIN)
+        # the FIRST frame the frontend receives is dropped -> the stream
+        # dies with zero items yielded -> failover
+        faults.activate(faults.parse_plan("seed=5;transport.recv:drop@max=1"))
+        items = await asyncio.wait_for(
+            collect(router.generate({"x": 1}, Context())), timeout=20
+        )
+        assert [i["i"] for i in items] == [0, 1, 2]
+    finally:
+        faults.deactivate()
+        await client.close()
+        for drt in drts:
+            await drt.shutdown()
+        await server.stop()
+
+
+async def test_midstream_loss_terminates_cleanly_not_hangs():
+    """After items have streamed, a dead worker ends the stream with
+    WorkerStreamLostError promptly — never a hang, never a silent
+    replay onto another worker."""
+    from dynamo_tpu.runtime.push_router import (
+        PushRouter,
+        RouterMode,
+        WorkerStreamLostError,
+    )
+
+    server, drts, client = await _two_worker_fleet()
+    try:
+        router = PushRouter(client, RouterMode.ROUND_ROBIN)
+        faults.activate(
+            faults.parse_plan("seed=5;transport.recv:drop@after=1@max=1")
+        )
+
+        async def consume():
+            got = []
+            with pytest.raises(WorkerStreamLostError):
+                async for item in router.generate({"x": 1}, Context()):
+                    got.append(item)
+            return got
+
+        got = await asyncio.wait_for(consume(), timeout=20)
+        assert len(got) >= 1  # tokens had streamed: not replayable
+    finally:
+        faults.deactivate()
+        await client.close()
+        for drt in drts:
+            await drt.shutdown()
+        await server.stop()
+
+
+async def test_sse_stream_ends_with_clean_error_event():
+    """HTTP layer: a mid-stream worker loss surfaces as an SSE `error`
+    event followed by end-of-stream — the client is never left hanging."""
+    import aiohttp
+
+    from dynamo_tpu.http.service import HttpService, ModelManager
+    from dynamo_tpu.protocols.openai import ChatDeltaGenerator
+    from dynamo_tpu.runtime.push_router import WorkerStreamLostError
+
+    async def dying_chat(request, ctx):
+        gen = ChatDeltaGenerator(model="m")
+        yield gen.text_chunk("partial ")
+        raise WorkerStreamLostError(
+            "worker connection lost mid-stream; partial response cannot "
+            "be resumed"
+        )
+
+    manager = ModelManager()
+    manager.add_chat_model("m", FnEngine(dying_chat))
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    base = f"http://127.0.0.1:{service.port}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "m", "stream": True,
+                      "messages": [{"role": "user", "content": "hi"}]},
+            ) as r:
+                assert r.status == 200
+                raw = await asyncio.wait_for(r.read(), timeout=15)
+        text = raw.decode()
+        assert "partial" in text
+        assert "event: error" in text
+        assert "worker connection lost" in text
+    finally:
+        await service.stop()
+
+
+# ---------------------------------------------------------------------------
+# Store reconnect + watch resubscribe (registry must never freeze)
+# ---------------------------------------------------------------------------
+
+
+async def test_store_client_reconnects_after_coordinator_restart():
+    from dynamo_tpu.store.client import StoreClient
+    from dynamo_tpu.store.memory import MemoryStore
+    from dynamo_tpu.store.server import StoreServer
+
+    server = StoreServer(MemoryStore(), host="127.0.0.1", port=0)
+    await server.start()
+    port = server.port
+    client = await StoreClient.connect("127.0.0.1", port, reconnect=True)
+    try:
+        await client.kv_put("k", b"v1")
+        await server.stop()
+        # while down, calls fail fast with ConnectionError (no hang)
+        await asyncio.sleep(0.1)
+        with pytest.raises(ConnectionError):
+            await asyncio.wait_for(client.kv_get("k"), timeout=5)
+        # coordinator restarts on the SAME port (fresh state, as after a
+        # crash without --persist-path)
+        server2 = StoreServer(MemoryStore(), host="127.0.0.1", port=port)
+        await server2.start()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            try:
+                await client.kv_put("k2", b"v2")
+                break
+            except ConnectionError:
+                await asyncio.sleep(0.1)
+        else:
+            raise AssertionError("client never reconnected")
+        assert (await client.kv_get("k2")).value == b"v2"
+        await server2.stop()
+    finally:
+        await client.close()
+
+
+async def test_model_watch_resubscribes_after_watch_death():
+    """ModelWatcher must resubscribe (not freeze) when its watch dies,
+    and replay registry deltas from the fresh snapshot."""
+    from dynamo_tpu.http.discovery import ModelWatcher
+    from dynamo_tpu.http.service import ModelManager
+    from dynamo_tpu.telemetry import REGISTRY
+
+    class FakeWatch:
+        def __init__(self, fail_after_start: bool):
+            self.fail = fail_after_start
+            self.queue: asyncio.Queue = asyncio.Queue()
+
+        def snapshot(self):
+            return []
+
+        def __aiter__(self):
+            return self._iter()
+
+        async def _iter(self):
+            if self.fail:
+                raise RuntimeError("watch transport died")
+            while True:
+                item = await self.queue.get()
+                if item is None:
+                    return
+                yield item
+
+        async def close(self):
+            self.queue.put_nowait(None)
+
+    watches = [FakeWatch(True), FakeWatch(False)]
+    calls = []
+
+    class FakeStore:
+        async def watch_prefix(self, prefix):
+            calls.append(prefix)
+            return watches[len(calls) - 1]
+
+    class FakeDrt:
+        store = FakeStore()
+
+    metric = REGISTRY.get("dynamo_watch_restarts_total")
+    before = metric.labels("models").value
+    watcher = ModelWatcher(FakeDrt(), ModelManager())
+    await watcher.start()
+    try:
+        deadline = time.monotonic() + 10
+        while len(calls) < 2 and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        assert len(calls) == 2, "watch was never resubscribed"
+        assert metric.labels("models").value == before + 1
+    finally:
+        await watcher.close()
+
+
+# ---------------------------------------------------------------------------
+# Deadline propagation over the worker wire
+# ---------------------------------------------------------------------------
+
+
+async def test_deadline_rides_the_endpoint_wire():
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    drt = await DistributedRuntime.create(
+        config=RuntimeConfig(static=True, worker_host="127.0.0.1")
+    )
+    seen: dict = {}
+
+    async def gen(request: Any, ctx: Context) -> AsyncIterator[Any]:
+        seen["remaining_ms"] = ctx.remaining_ms()
+        yield {"ok": True}
+
+    try:
+        ep = drt.namespace("t").component("c").endpoint("generate")
+        await ep.serve(FnEngine(gen))
+        client = await ep.client()
+        ids = await client.wait_for_instances(timeout_s=5)
+        ctx = Context()
+        ctx.set_deadline_ms(5000.0)
+        stream = await client.generate_direct(ids[0], {"x": 1}, ctx)
+        await collect(stream)
+        assert seen["remaining_ms"] is not None
+        assert 0 < seen["remaining_ms"] <= 5000.0
+        await client.close()
+    finally:
+        await drt.shutdown()

@@ -5,7 +5,9 @@ continues on the survivors, docs/disagg_serving.md elasticity story)."""
 
 import json
 import signal
+import socket
 import time
+import urllib.request
 
 from cli_harness import MODEL_DIR, CliFleet, complete, free_port, wait_http
 
@@ -71,6 +73,75 @@ def test_worker_death_failover():
             lambda b: b"llm_workers_reporting 1" in b.replace(b".0", b""),
             timeout=60,
         )
+        fleet.assert_alive()
+    finally:
+        fleet.teardown()
+
+
+def test_worker_death_mid_stream_never_hangs():
+    """Kill the worker WHILE a response is streaming: the SSE stream
+    must terminate promptly — either with a clean `error` event or a
+    final chunk + [DONE] — never hang the connection (docs/
+    robustness.md mid-stream failover contract)."""
+    store_port = free_port()
+    http_port = free_port()
+    fleet = CliFleet()
+    try:
+        fleet.spawn("store", "--host", "127.0.0.1", "--port", str(store_port))
+        time.sleep(2)
+        common = ["--store-host", "127.0.0.1", "--store-port", str(store_port)]
+        worker = fleet.spawn(
+            "run", "--in", "dyn://ms.backend.generate", "--out", "jax",
+            "--model-path", MODEL_DIR, *common,
+        )
+        fleet.spawn(
+            "run", "--in", "http", "--out", "dyn://ms.backend.generate",
+            "--model-path", MODEL_DIR, "--http-port", str(http_port),
+            *common,
+        )
+        wait_http(
+            f"http://127.0.0.1:{http_port}/v1/models",
+            lambda b: json.loads(b)["data"],
+        )
+        body = json.dumps({
+            "model": "tiny_llama_model", "prompt": "mid stream kill",
+            "max_tokens": 100000, "stream": True,
+            "ext": {"ignore_eos": True},
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http_port}/v1/completions", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        # per-read socket timeout is the hang detector: any single read
+        # stalling past it fails the test
+        resp = urllib.request.urlopen(req, timeout=30)
+        first = resp.readline()
+        assert first.startswith(b"data:"), first
+        # tokens are flowing: hard-kill the only worker mid-generation
+        worker.send_signal(signal.SIGKILL)
+        worker.wait(timeout=10)
+        fleet.forget(worker)
+        deadline = time.monotonic() + 60
+        tail = [first]
+        try:
+            while time.monotonic() < deadline:
+                line = resp.readline()
+                if not line:
+                    break  # clean EOF: the server closed the stream
+                tail.append(line)
+            else:
+                raise AssertionError(
+                    f"stream still open 60s after worker death: "
+                    f"{tail[-3:]!r}"
+                )
+        except socket.timeout:
+            raise AssertionError(
+                f"stream READ hung after worker death: {tail[-3:]!r}"
+            )
+        text = b"".join(tail).decode(errors="replace")
+        # clean termination: an SSE error event, or a final chunk +
+        # [DONE] (the backend converts an ended stream into a finish)
+        assert ("event: error" in text) or ("[DONE]" in text), text[-2000:]
         fleet.assert_alive()
     finally:
         fleet.teardown()

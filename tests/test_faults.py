@@ -1,0 +1,300 @@
+"""Fault-injection subsystem unit tests (docs/robustness.md): plan
+parsing, determinism by seed, and each wired injection point actually
+firing through its real call site."""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu import faults
+from dynamo_tpu.faults import (
+    DroppedFrameError,
+    FaultInjectedError,
+    FaultPlan,
+    FaultRule,
+    parse_plan,
+    parse_rule,
+)
+from dynamo_tpu.faults import injector as injector_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.deactivate()
+    yield
+    faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# Parsing
+# ---------------------------------------------------------------------------
+
+
+def test_parse_compact_syntax():
+    plan = parse_plan(
+        "seed=42;store.call:delay=0.05@p=0.5;"
+        "engine.step:error@after=3@max=2;"
+        "transport.recv:drop;worker.liveness:kill;header"
+    )
+    assert plan.seed == 42
+    assert plan.allow_request_rules
+    assert [r.point for r in plan.rules] == [
+        "store.call", "engine.step", "transport.recv", "worker.liveness",
+    ]
+    delay, err, drop, kill = plan.rules
+    assert delay.kind == "delay" and delay.delay_s == 0.05 and delay.p == 0.5
+    assert err.after == 3 and err.max_fires == 2
+    assert drop.kind == "drop"
+    assert kill.max_fires == 1  # kill is one-shot unless overridden
+
+
+def test_parse_rule_match_and_error_types():
+    r = parse_rule("kv_transfer.put:error=conn@match=req-7")
+    assert r.match == "req-7"
+    assert isinstance(r.exc(), ConnectionError)
+    assert isinstance(parse_rule("a.b:error").exc(), FaultInjectedError)
+    assert isinstance(parse_rule("a.b:drop").exc(), DroppedFrameError)
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_rule("no-colon-here")
+    with pytest.raises(ValueError):
+        parse_rule("p.x:frobnicate")
+    with pytest.raises(ValueError):
+        parse_rule("p.x:error@p=1.5")
+    with pytest.raises(ValueError):
+        parse_rule("p.x:error@bogus=1")
+    with pytest.raises(ValueError):
+        parse_rule("p.x:delay=not-a-number")
+
+
+def test_parse_json_plan(tmp_path):
+    doc = {
+        "seed": 9,
+        "rules": [
+            {"point": "store.call", "kind": "error", "p": 0.25, "max": 3}
+        ],
+    }
+    plan = parse_plan(json.dumps(doc))
+    assert plan.seed == 9 and plan.rules[0].max_fires == 3
+    path = tmp_path / "plan.json"
+    path.write_text(json.dumps(doc))
+    plan2 = parse_plan(f"@{path}")
+    assert plan2.to_dict() == plan.to_dict()
+
+
+# ---------------------------------------------------------------------------
+# Determinism
+# ---------------------------------------------------------------------------
+
+
+def _fire_pattern(seed: int, n: int = 64) -> list[bool]:
+    plan = parse_plan(f"seed={seed};p.x:error@p=0.3")
+    inj = faults.FaultInjector(plan)
+    out = []
+    for _ in range(n):
+        try:
+            inj.fire("p.x")
+            out.append(False)
+        except FaultInjectedError:
+            out.append(True)
+    return out
+
+
+def test_same_seed_same_fire_pattern():
+    assert _fire_pattern(7) == _fire_pattern(7)
+    assert any(_fire_pattern(7))  # p=0.3 over 64 passes certainly fires
+
+
+def test_different_seed_different_pattern():
+    assert _fire_pattern(7) != _fire_pattern(8)
+
+
+def test_per_point_streams_independent_of_interleave():
+    """The pattern at one point must not depend on traffic at another."""
+    plan = parse_plan("seed=1;a.a:error@p=0.5;b.b:error@p=0.5")
+
+    def run(interleave: bool) -> list[bool]:
+        inj = faults.FaultInjector(plan)
+        out = []
+        for i in range(32):
+            if interleave:
+                try:
+                    inj.fire("b.b")
+                except FaultInjectedError:
+                    pass
+            try:
+                inj.fire("a.a")
+                out.append(False)
+            except FaultInjectedError:
+                out.append(True)
+        return out
+
+    assert run(False) == run(True)
+
+
+def test_after_and_max_modifiers():
+    plan = FaultPlan(seed=0, rules=[
+        FaultRule(point="p", kind="error", after=2, max_fires=2)
+    ])
+    inj = faults.FaultInjector(plan)
+    fires = []
+    for i in range(6):
+        try:
+            inj.fire("p")
+            fires.append(False)
+        except FaultInjectedError:
+            fires.append(True)
+    assert fires == [False, False, True, True, False, False]
+
+
+def test_match_modifier_scopes_by_context():
+    plan = FaultPlan(seed=0, rules=[
+        FaultRule(point="p", kind="error", match="victim")
+    ])
+    inj = faults.FaultInjector(plan)
+    inj.fire("p", request_id="innocent")  # no raise
+    with pytest.raises(FaultInjectedError):
+        inj.fire("p", request_id="victim-123")
+
+
+def test_kill_invokes_process_exit(monkeypatch):
+    calls = []
+    monkeypatch.setattr(injector_mod, "_kill_process", calls.append)
+    plan = parse_plan("seed=0;worker.liveness:kill")
+    inj = faults.FaultInjector(plan)
+    inj.fire("worker.liveness")
+    inj.fire("worker.liveness")  # one-shot: second pass is a no-op
+    assert calls == [injector_mod.KILL_EXIT_CODE]
+
+
+def test_stats_and_counter_and_listener():
+    from dynamo_tpu.telemetry import REGISTRY
+
+    plan = parse_plan("seed=0;p.q:error@max=1")
+    inj = faults.activate(plan)
+    seen = []
+    inj.add_listener(seen.append)
+    metric = REGISTRY.get("dynamo_faults_fired_total")
+    before = metric.labels("p.q", "error").value
+    with pytest.raises(FaultInjectedError):
+        faults.fire("p.q", request_id="r1")
+    assert metric.labels("p.q", "error").value == before + 1
+    assert seen and seen[0]["point"] == "p.q"
+    stats = inj.stats()
+    assert stats["fired_total"] == 1
+    assert stats["rules"][0]["fires"] == 1
+    assert stats["recent"][0]["request_id"] == "r1"
+
+
+def test_arm_request_requires_plan_opt_in():
+    inj = faults.FaultInjector(parse_plan("seed=0"))
+    assert inj.arm_request("p.x:error", "rid") == 0  # not opted in
+    inj2 = faults.FaultInjector(parse_plan("seed=0;header"))
+    assert inj2.arm_request("p.x:error", "rid-9") == 1
+    inj2.fire("p.x", request_id="other")  # scoped: no raise
+    with pytest.raises(FaultInjectedError):
+        inj2.fire("p.x", request_id="rid-9")
+    inj2.fire("p.x", request_id="rid-9")  # max defaulted to 1
+
+
+def test_init_from_env(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "seed=3;p.x:error")
+    inj = faults.init_from_env()
+    assert inj is not None and faults.ACTIVE is inj
+    faults.deactivate()
+    monkeypatch.setenv(faults.ENV_VAR, "totally;;;broken@@@")
+    assert faults.init_from_env() is None  # loud log, no crash
+
+
+# ---------------------------------------------------------------------------
+# Wired call sites: each injection point fires through its real seam
+# ---------------------------------------------------------------------------
+
+
+async def test_point_store_call():
+    from dynamo_tpu.store.memory import MemoryStore
+    from dynamo_tpu.store.server import StoreServer
+    from dynamo_tpu.store.client import StoreClient
+
+    server = StoreServer(store=MemoryStore(), host="127.0.0.1", port=0)
+    await server.start()
+    client = await StoreClient.connect("127.0.0.1", server.port)
+    try:
+        await client.kv_put("k", b"v")
+        faults.activate(parse_plan("seed=0;store.call:error@max=1"))
+        with pytest.raises(FaultInjectedError):
+            await client.kv_get("k")
+        # max=1 exhausted: the store works again
+        assert (await client.kv_get("k")).value == b"v"
+    finally:
+        faults.deactivate()
+        await client.close()
+        await server.stop()
+
+
+async def test_point_transport_send_and_recv():
+    from dynamo_tpu.runtime.engine import Context, FnEngine
+    from dynamo_tpu.runtime.service import (
+        ConnectionLostError,
+        EndpointConnection,
+        EndpointServer,
+    )
+
+    async def echo(req, ctx):
+        yield {"ok": req}
+
+    server = EndpointServer(host="127.0.0.1", port=0)
+    server.register("ep", FnEngine(echo))
+    await server.start()
+    conn = await EndpointConnection.connect("127.0.0.1", server.port)
+    try:
+        # send: an injected conn error surfaces at the caller
+        faults.activate(parse_plan("seed=0;transport.send:error=conn@max=1"))
+        with pytest.raises(ConnectionError):
+            await conn.request("ep", {"x": 1}, Context())
+        # recv: a drop tears the connection down -> ConnectionLostError
+        faults.activate(parse_plan("seed=0;transport.recv:drop@max=1"))
+        stream = await conn.request("ep", {"x": 2}, Context())
+        with pytest.raises(ConnectionLostError):
+            async for _ in stream:
+                pass
+    finally:
+        faults.deactivate()
+        await conn.close()
+        await server.stop()
+
+
+async def test_point_prefill_dequeue():
+    from dynamo_tpu.disagg.prefill_queue import PrefillQueue
+    from dynamo_tpu.store.memory import MemoryStore
+
+    q = PrefillQueue(MemoryStore(), "ns")
+    faults.activate(parse_plan("seed=0;prefill.dequeue:error@max=1"))
+    with pytest.raises(FaultInjectedError):
+        await q.dequeue(timeout_s=0.01)
+    assert await q.dequeue(timeout_s=0.01) is None  # recovered
+
+
+async def test_point_kv_transfer_put():
+    from dynamo_tpu.disagg.transfer import TransferClient, TransferMetadata
+
+    faults.activate(parse_plan("seed=0;kv_transfer.put:error=conn@max=1"))
+    import numpy as np
+
+    meta = TransferMetadata(host="127.0.0.1", port=1, worker_id=1, layout="{}")
+    with pytest.raises(ConnectionError):
+        await TransferClient.put(meta, "rid", [1], np.zeros((1, 2, 2)))
+
+
+def test_point_engine_step_and_liveness_names():
+    """The engine fires both sync points through faults.fire; verify the
+    module-level hook honors an active plan (the full engine path is
+    covered by the chaos suite)."""
+    faults.activate(parse_plan("seed=0;engine.step:error@max=1"))
+    with pytest.raises(FaultInjectedError):
+        faults.fire("engine.step")
+    faults.fire("engine.step")  # exhausted
+    faults.fire("worker.liveness")  # no rule: no-op

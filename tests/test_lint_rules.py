@@ -30,6 +30,7 @@ CASES = [
     ("await-while-locked", "await_while_locked", 2),
     ("bare-except", "bare_except", 1),
     ("unbounded-telemetry-buffer", "unbounded_telemetry_buffer", 3),
+    ("unbounded-retry-loop", "unbounded_retry_loop", 2),
 ]
 
 
@@ -337,7 +338,7 @@ def test_syntax_error_becomes_parse_finding():
 
 def test_rule_catalog_metadata():
     rules = all_rules()
-    assert len(rules) == 7
+    assert len(rules) == 8
     codes = [r.code for r in rules]
     assert codes == sorted(codes) and len(set(codes)) == len(codes)
     assert all(r.name == r.name.lower() and " " not in r.name for r in rules)
