@@ -405,7 +405,122 @@ def _main_chaos_ab(model_cfg, wl) -> None:
     )
 
 
+def _main_sim() -> None:
+    """--sim: scaling-policy regression watch, no accelerator at all.
+
+    Replays a canned diurnal+burst trace (fixed seed 2026) through the
+    discrete-event fleet simulator at three static fleet sizes and once
+    with the autoscaling planner, and reports SLO attainment + goodput
+    per configuration as two JSON lines (planner_sim_slo_attainment /
+    planner_sim_goodput). The headline attainment is of OFFERED load —
+    shed and killed requests count as misses — so a policy cannot look
+    healthy by rejecting traffic; per-row `slo_attainment` (of admitted
+    work) is kept alongside. Policy regressions — watermark changes,
+    admission defaults, degradation ladder — move these numbers while
+    the chip benches stay flat. Knobs: DYN_BENCH_SIM_DURATION (sim
+    seconds, default 1800), DYN_BENCH_SIM_SEED."""
+    from dynamo_tpu.planner import PlannerConfig
+    from dynamo_tpu.sim import (
+        FleetSim,
+        SimConfig,
+        bursty_trace,
+        diurnal_trace,
+        merge_traces,
+    )
+
+    seed = int(os.environ.get("DYN_BENCH_SIM_SEED", "2026"))
+    duration = float(os.environ.get("DYN_BENCH_SIM_DURATION", "1800"))
+    trace = merge_traces(
+        diurnal_trace(duration, seed, base_rps=12.0, peak_rps=45.0,
+                      period_s=duration),
+        bursty_trace(duration, seed + 1, calm_rps=4.0, burst_rps=60.0,
+                     mean_calm_s=240.0, mean_burst_s=25.0),
+    )
+    fleet_sizes = (2, 4, 8)
+    rows: dict[str, dict] = {}
+
+    def run_one(decode: int, autoscale: bool) -> dict:
+        cfg = SimConfig(initial_decode=decode, initial_prefill=1,
+                        max_queue_depth=150, slo_ttft_ms=3000.0,
+                        slo_itl_ms=60.0)
+        fleet = FleetSim(trace, cfg)
+        if autoscale:
+            fleet.attach_planner(PlannerConfig(
+                adjustment_interval_s=20.0, grace_cycles=2,
+                reconcile_cycles=2, slo_target=0.95,
+                min_decode=1, max_decode=max(fleet_sizes),
+                min_prefill=1, max_prefill=4,
+            ))
+        res = fleet.run()
+        # worker-seconds actually provisioned (resource cost) — the
+        # timeline integral for EVERY row, so static and autoscaled
+        # runs are costed over the same horizon (trace + drain)
+        worker_ticks = sum(
+            s["decode_workers_reporting"] for s in res["timeline"]
+        ) * cfg.metric_interval_s
+        return {
+            "slo_attainment": round(res["slo_attainment"], 4),
+            "slo_attainment_offered": round(
+                res["slo_attainment_offered"], 4
+            ),
+            "goodput_tok_s": round(res["goodput_tok_s"], 2),
+            "shed": res["shed"],
+            "requests": res["requests"],
+            "worker_seconds": round(worker_ticks, 1),
+        }
+
+    for n in fleet_sizes:
+        rows[f"static-{n}"] = run_one(n, autoscale=False)
+    rows["planner"] = run_one(2, autoscale=True)
+
+    config = {
+        "seed": seed,
+        "duration_s": duration,
+        "trace_requests": len(trace),
+        "fleet_sizes": list(fleet_sizes),
+        **rows,
+    }
+    peak = rows[f"static-{max(fleet_sizes)}"]
+    dyn = rows["planner"]
+    print(json.dumps({
+        "metric": "planner_sim_slo_attainment",
+        "value": dyn["slo_attainment_offered"],
+        "unit": "fraction",
+        # autoscaled offered-load attainment relative to the capacity-
+        # planned static peak fleet (1.0 = planner matches peak
+        # provisioning without peak cost)
+        "vs_baseline": round(
+            dyn["slo_attainment_offered"]
+            / max(1e-9, peak["slo_attainment_offered"]), 4
+        ),
+        "config": config,
+    }))
+    print(json.dumps({
+        "metric": "planner_sim_goodput",
+        "value": dyn["goodput_tok_s"],
+        "unit": "goodput_tokens/sec",
+        "vs_baseline": round(
+            dyn["goodput_tok_s"] / max(1e-9, peak["goodput_tok_s"]), 4
+        ),
+        "config": {
+            "planner_worker_seconds": dyn["worker_seconds"],
+            "static_peak_worker_seconds": peak["worker_seconds"],
+        },
+    }))
+    print(
+        "# sim: " + " ".join(
+            f"{k}={v['slo_attainment_offered']:.3f}"
+            f"@{v['goodput_tok_s']:.0f}tok/s"
+            for k, v in rows.items()
+        ),
+        file=sys.stderr,
+    )
+
+
 def main() -> None:
+    if "--sim" in sys.argv[1:]:
+        _main_sim()  # pure host-side discrete-event run: no jax, no chip
+        return
     cpu_mode = os.environ.get("DYN_BENCH_PLATFORM") == "cpu"
     if cpu_mode:
         from dynamo_tpu.utils.jaxtools import force_platform

@@ -16,4 +16,5 @@ from dynamo_tpu.analysis.rules import (  # noqa: F401
     retry_loop,
     swallowed_cancel,
     unbounded_buffer,
+    wall_clock,
 )

@@ -1,0 +1,176 @@
+"""DL009 wall-clock-in-control-loop: code that HAS an injectable clock
+must not bypass it inside its loops.
+
+The planner, the admission token bucket, retry backoff, and the fleet
+simulator all take an injectable ``Clock`` (``utils/clock.py``) so
+control policy is testable on virtual time — a million simulated
+requests, zero real sleeps, bit-identical replays. One stray
+``time.monotonic()`` or ``asyncio.sleep()`` inside such a loop silently
+splits the timeline: half the loop runs on simulated seconds, half on
+wall seconds, and the simulation (or the test) drifts in ways that only
+show up as flakes.
+
+The rule is structural, not path-based: a function is "clock-bearing"
+when it takes a ``clock`` parameter or belongs to a class that stores
+one (``self.clock`` / ``self._clock`` assignment, or a ``clock``
+parameter on any of its methods). Inside a clock-bearing function,
+direct calls to ``time.monotonic`` / ``time.time`` / ``time.sleep`` /
+``asyncio.sleep`` within any ``while``/``for`` loop body are flagged —
+route them through the clock (``self.clock.monotonic()``,
+``await self.clock.sleep(...)``) instead. Straight-line code (setup,
+one-shot stamps) is not flagged; loops are where timelines diverge.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.analysis.registry import LintModule, rule
+from dynamo_tpu.analysis.rules.common import dotted_name
+
+WALL_CLOCK_CALLS = {
+    "time.monotonic",
+    "time.time",
+    "time.sleep",
+    "asyncio.sleep",
+}
+
+
+def _class_bears_clock(cls: ast.ClassDef) -> bool:
+    """self.clock/self._clock assigned anywhere, or any method takes a
+    ``clock`` parameter."""
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and t.attr in ("clock", "_clock")
+                ):
+                    return True
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            names = [
+                a.arg
+                for a in (
+                    args.posonlyargs + args.args + args.kwonlyargs
+                )
+            ]
+            if "clock" in names:
+                return True
+    return False
+
+
+def _fn_bears_clock(fn) -> bool:
+    args = fn.args
+    return "clock" in [
+        a.arg for a in (args.posonlyargs + args.args + args.kwonlyargs)
+    ]
+
+
+class _LoopScan(ast.NodeVisitor):
+    """Wall-clock calls inside one loop body; nested defs scope apart
+    (their loops are scanned when the walker reaches them)."""
+
+    def __init__(self) -> None:
+        self.hits: list[tuple[ast.AST, str]] = []
+
+    def visit_FunctionDef(self, node) -> None:
+        return
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        return
+
+    def visit_While(self, node) -> None:
+        return  # inner loops are scanned as their own entries
+
+    def visit_For(self, node) -> None:
+        return
+
+    def visit_AsyncFor(self, node) -> None:
+        return
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func) or ""
+        if name in WALL_CLOCK_CALLS:
+            self.hits.append((node, name))
+        self.generic_visit(node)
+
+
+@rule(
+    "wall-clock-in-control-loop",
+    "DL009",
+    "loop in clock-injectable code calls time.*/asyncio.sleep directly, "
+    "bypassing the injectable Clock (breaks simulation/driven mode)",
+)
+def check(module: LintModule):
+    findings: list[tuple[ast.AST, str]] = []
+
+    def own_loops(fn) -> list[ast.AST]:
+        """Loops belonging to ``fn`` itself — nested defs scope apart
+        (they're scanned on their own bearing status)."""
+        loops: list[ast.AST] = []
+
+        def walk(node) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    continue
+                if isinstance(child, (ast.While, ast.For, ast.AsyncFor)):
+                    loops.append(child)
+                walk(child)
+
+        walk(fn)
+        return loops
+
+    def scan_function(fn, bearing: bool) -> None:
+        if not (bearing or _fn_bears_clock(fn)):
+            return
+        for node in own_loops(fn):
+            scan = _LoopScan()
+            # the loop's own repeated expressions first: a while
+            # condition (`while time.monotonic() < deadline:`) or a for
+            # iterable re-evaluates every iteration and splits the
+            # timeline exactly like a call in the body would
+            if isinstance(node, ast.While):
+                scan.visit(node.test)
+            else:
+                scan.visit(node.iter)
+            for stmt in node.body + node.orelse:
+                scan.visit(stmt)
+            for site, name in scan.hits:
+                findings.append(
+                    (
+                        site,
+                        f"`{name}(...)` inside a loop of clock-injectable "
+                        "code — route time through the injectable Clock "
+                        "(self.clock.monotonic() / await "
+                        "self.clock.sleep(...)) so driven/simulated runs "
+                        "stay on one timeline",
+                    )
+                )
+
+    # direct methods inherit their class's clock-bearing status …
+    direct_methods: set[ast.AST] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ClassDef):
+            bearing = _class_bears_clock(node)
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    direct_methods.add(item)
+                    scan_function(item, bearing)
+
+    # … every other function — module level, nested in a function, OR
+    # nested inside a method — is scoped on its own clock parameter
+    for node in ast.walk(module.tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node not in direct_methods
+        ):
+            scan_function(node, bearing=False)
+    return findings

@@ -260,6 +260,24 @@ def build_parser() -> argparse.ArgumentParser:
     planner.add_argument("--max-decode", type=int, default=8)
     planner.add_argument("--min-prefill", type=int, default=0)
     planner.add_argument("--max-prefill", type=int, default=8)
+    planner.add_argument("--grace-cycles", type=int, default=2,
+                         help="consecutive breach cycles before acting")
+    planner.add_argument("--slo-target", type=float, default=0.0,
+                         help="scale decode up when slo_attainment_mean "
+                              "stays below this (0 = watermark-only)")
+    planner.add_argument("--slo-headroom", type=float, default=0.03,
+                         help="extra attainment above --slo-target "
+                              "required before scaling down")
+    planner.add_argument("--reconcile-cycles", type=int, default=3,
+                         help="adjustment cycles a worker may go missing "
+                              "before reconciliation replaces it (0 = off)")
+    planner.add_argument("--spawn-grace-cycles", type=int, default=10,
+                         help="adjustment cycles an ordered worker may "
+                              "take to start reporting before it is "
+                              "presumed dead and replaced")
+    planner.add_argument("--degrade-max-level", type=int, default=3,
+                         help="graceful-degradation ladder ceiling "
+                              "(0 disables the ladder)")
     planner.add_argument("--store-host", default="127.0.0.1")
     planner.add_argument("--store-port", type=int, default=4222)
     planner.add_argument("--log-dir", default=None,
@@ -757,15 +775,41 @@ async def cmd_run(args: Any) -> None:
         manager = ModelManager()
         watcher = ModelWatcher(drt, manager, router_mode=args.router_mode)
         await watcher.start()
-        # no local engine -> no load signal for admission control here;
-        # deadlines still propagate to workers over the endpoint wire
+        # no local engine -> no load signal, so caps can't bind here
+        # (deadlines still propagate to workers over the endpoint wire)
+        # — but the planner's degradation ladder can: rung 3 sheds this
+        # frontend to the probe trickle via force_shed
         if args.shed_queue_depth or args.shed_kv_usage:
             log.warning(
                 "--shed-* flags need a local jax engine for load "
-                "signals; admission control disabled"
+                "signals; load-based admission control disabled"
             )
+        from dynamo_tpu.http.admission import (
+            AdmissionConfig,
+            AdmissionController,
+        )
+        from dynamo_tpu.planner.degradation import (
+            ServingDegradation,
+            watch_degradation,
+        )
+
+        admission = AdmissionController(
+            AdmissionConfig(
+                max_queue_depth=args.shed_queue_depth,
+                max_kv_usage=args.shed_kv_usage,
+            ),
+            load_fn=lambda: None,  # fail open until the ladder says shed
+        )
+        spawn(
+            watch_degradation(
+                drt.store, args.namespace,
+                ServingDegradation(admission=admission),
+            ),
+            name="degradation-watch",
+        )
         service = HttpService(
             manager, host=args.http_host, port=args.http_port,
+            admission=admission,
             default_deadline_ms=args.default_deadline_ms,
         )
         await service.start()
@@ -797,6 +841,7 @@ async def cmd_run(args: Any) -> None:
                     max_kv_usage=args.shed_kv_usage,
                 ),
                 engine_load_fn(jax_engine),
+                on_shed=jax_engine.slo.note_shed,
             )
             print(
                 f"admission control: queue<{args.shed_queue_depth or '-'} "
@@ -889,6 +934,20 @@ async def cmd_run(args: Any) -> None:
                 await asyncio.get_running_loop().run_in_executor(
                     None, jax_engine.kvbm.attach_remote, adapter
                 )
+        if jax_engine is not None:
+            # planner degradation ladder (docs/autoscaling.md): follow
+            # the published rung; rung 2+ suspends spec decode here
+            from dynamo_tpu.planner.degradation import (
+                ServingDegradation,
+                watch_degradation,
+            )
+
+            spawn(
+                watch_degradation(
+                    drt.store, ns, ServingDegradation(engine=jax_engine)
+                ),
+                name="degradation-watch",
+            )
         await endpoint.serve(engine)
         if args.model_path and args.model_path.endswith(".gguf"):
             # ModelDeploymentCard artifacts (tokenizer.json etc.) come
@@ -1368,6 +1427,7 @@ async def cmd_metrics(args: Any) -> None:
 
 async def cmd_planner(args: Any) -> None:
     from dynamo_tpu.planner.connector import LocalConnector
+    from dynamo_tpu.planner.degradation import StoreDegradation
     from dynamo_tpu.planner.planner import Planner, PlannerConfig
     from dynamo_tpu.runtime.runtime import DistributedRuntime
 
@@ -1378,6 +1438,13 @@ async def cmd_planner(args: Any) -> None:
         drt.store,
         component,
         LocalConnector(drt.store, args.namespace),
+        # ladder rungs publish to the store; workers' watch_degradation
+        # tasks apply them (admission caps, spec suspend)
+        degradation=(
+            StoreDegradation(drt.store, args.namespace)
+            if args.degrade_max_level > 0
+            else None
+        ),
         config=PlannerConfig(
             decode_component=args.component,
             prefill_component=args.prefill_component,
@@ -1387,6 +1454,12 @@ async def cmd_planner(args: Any) -> None:
             max_decode=args.max_decode,
             min_prefill=args.min_prefill,
             max_prefill=args.max_prefill,
+            grace_cycles=args.grace_cycles,
+            slo_target=args.slo_target,
+            slo_headroom=args.slo_headroom,
+            reconcile_cycles=args.reconcile_cycles,
+            spawn_grace_cycles=args.spawn_grace_cycles,
+            degrade_max_level=args.degrade_max_level,
         ),
     )
     mlog = None

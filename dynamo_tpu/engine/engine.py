@@ -190,6 +190,10 @@ class JaxEngine:
         # speculative decoding (dynamo_tpu/spec; config.spec_decode)
         self._drafter = None
         self._spec_step_fn: Optional[Callable] = None
+        # runtime suspend (degradation ladder rung 2, planner/
+        # degradation.py): flipped from the asyncio thread, read by the
+        # engine thread each step — a plain bool attr is race-free here
+        self.spec_suspended = False
         self.spec_proposed_total = 0  # bench/introspection counters
         self.spec_accepted_total = 0
         # per-engine token counter (the registry counter is process-
@@ -1986,6 +1990,7 @@ class JaxEngine:
         if (
             plan.kind == "decode"
             and self._drafter is not None
+            and not self.spec_suspended
             and plan.decode_seqs
             and not self._spec_divert(plan.decode_seqs)
         ):

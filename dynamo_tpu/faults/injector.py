@@ -41,7 +41,12 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
-from dynamo_tpu.faults.plan import FaultPlan, FaultRule, parse_plan
+from dynamo_tpu.faults.plan import (
+    FaultPlan,
+    FaultRule,
+    RuleState,
+    parse_plan,
+)
 from dynamo_tpu.telemetry.debug import (
     register_debug_provider,
     unregister_debug_provider,
@@ -59,26 +64,17 @@ _kill_process: Callable[[int], None] = os._exit
 KILL_EXIT_CODE = 70
 
 
-class _RuleState:
-    """Mutable per-rule runtime state (the rule itself stays immutable).
-    ``ephemeral`` marks request-scoped (header-armed) rules, which are
-    pruned once exhausted so a chaos soak never accumulates dead rules."""
+class _RuleState(RuleState):
+    """The shared eligibility state (plan.RuleState — the sim driver
+    runs the identical ``step()``) plus the injector-only ``ephemeral``
+    flag: request-scoped (header-armed) rules are pruned once exhausted
+    so a chaos soak never accumulates dead rules."""
 
-    __slots__ = ("rule", "rng", "passes", "fires", "ephemeral")
+    __slots__ = ("ephemeral",)
 
     def __init__(self, rule: FaultRule, rng, ephemeral: bool = False):
-        self.rule = rule
-        self.rng = rng
-        self.passes = 0
-        self.fires = 0
+        super().__init__(rule, rng)
         self.ephemeral = ephemeral
-
-    @property
-    def exhausted(self) -> bool:
-        return (
-            self.rule.max_fires is not None
-            and self.fires >= self.rule.max_fires
-        )
 
 
 class FaultInjector:
@@ -110,21 +106,8 @@ class FaultInjector:
         prune = False
         with self._lock:
             for st in states:
-                rule = st.rule
-                if rule.match is not None and not any(
-                    rule.match in str(v) for v in ctx.values()
-                ):
-                    continue
-                st.passes += 1
-                if st.passes <= rule.after:
-                    continue
-                if st.exhausted:
-                    prune = prune or st.ephemeral
-                    continue
-                if rule.p < 1.0 and st.rng.random() >= rule.p:
-                    continue
-                st.fires += 1
-                due.append(rule)
+                if st.step(ctx):
+                    due.append(st.rule)
                 prune = prune or (st.ephemeral and st.exhausted)
             if prune:
                 # header-armed rules die with their last fire; plan

@@ -140,6 +140,48 @@ class FaultPlan:
         }
 
 
+class RuleState:
+    """Mutable evaluation state for one rule: counters plus the rule's
+    seeded stream. ``step()`` is THE eligibility algorithm — match →
+    after → max → p, in that order, one RNG draw per probabilistic
+    pass. Both evaluation planes (the live ``FaultInjector`` and the
+    simulator's ``SimFaultDriver``) run this exact method, so a chaos
+    plan replayed as a what-if cannot drift from live behavior: any
+    future mod (a new gate, a reordering) lands in both at once."""
+
+    __slots__ = ("rule", "rng", "passes", "fires")
+
+    def __init__(self, rule: FaultRule, rng: random.Random):
+        self.rule = rule
+        self.rng = rng
+        self.passes = 0
+        self.fires = 0
+
+    @property
+    def exhausted(self) -> bool:
+        return (
+            self.rule.max_fires is not None
+            and self.fires >= self.rule.max_fires
+        )
+
+    def step(self, ctx: dict) -> bool:
+        """One pass of this rule's point; True when the rule fires."""
+        rule = self.rule
+        if rule.match is not None and not any(
+            rule.match in str(v) for v in ctx.values()
+        ):
+            return False
+        self.passes += 1
+        if self.passes <= rule.after:
+            return False
+        if self.exhausted:
+            return False
+        if rule.p < 1.0 and self.rng.random() >= rule.p:
+            return False
+        self.fires += 1
+        return True
+
+
 def parse_rule(text: str) -> FaultRule:
     """One ``point:kind[=value][@mod=value]...`` element."""
     text = text.strip()

@@ -22,11 +22,11 @@ full Retry-After period after the backlog drains.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
 from dynamo_tpu.telemetry.instruments import REQUESTS_SHED
+from dynamo_tpu.utils.clock import SYSTEM
 
 
 @dataclass
@@ -58,17 +58,18 @@ class Rejection:
 
 
 class TokenBucket:
-    """Minimal monotonic-clock token bucket (injectable clock)."""
+    """Minimal monotonic-clock token bucket (injectable clock: pass the
+    sim clock's ``monotonic`` to run admission on virtual time)."""
 
     def __init__(
         self, rate_per_s: float, burst: float,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Optional[Callable[[], float]] = None,
     ):
         self.rate = max(0.0, rate_per_s)
         self.burst = max(0.0, burst)
-        self._clock = clock
+        self._clock = clock or SYSTEM.monotonic
         self._tokens = self.burst
-        self._last = clock()
+        self._last = self._clock()
 
     def take(self, n: float = 1.0) -> bool:
         now = self._clock()
@@ -94,26 +95,48 @@ class AdmissionController:
         self,
         config: AdmissionConfig,
         load_fn: Callable[[], Optional[LoadSnapshot]],
-        clock: Callable[[], float] = time.monotonic,
+        clock: Optional[Callable[[], float]] = None,
+        on_shed: Optional[Callable[[], None]] = None,
     ):
         self.config = config
         self.load_fn = load_fn
+        # scores each shed into the SLO rolling window (SloTracker
+        # .note_shed) so the planner's attainment signal sees offered
+        # load, not just the requests the fleet chose to serve
+        self.on_shed = on_shed
         self._probes = TokenBucket(
             config.probe_rate_per_s, config.probe_burst, clock=clock
         )
+        # degradation ladder rung 3 (planner/degradation.py): shed to
+        # the probe trickle even when no load signal is available —
+        # the one case where failing open is wrong, because the planner
+        # has already concluded the fleet is saturated past max size
+        self.force_shed = False
         self.shed_total = 0
         self.admitted_total = 0
 
     def check(self) -> Optional[Rejection]:
         """None = admit; a Rejection = shed with 429 + Retry-After."""
         cfg = self.config
-        if not cfg.enabled:
+        # force_shed engages the controller even with no caps
+        # configured (the --out auto frontend ships caps of 0)
+        if not cfg.enabled and not self.force_shed:
             return None
         try:
             load = self.load_fn()
         except Exception:
             load = None
         if load is None:
+            if self.force_shed and not self._probes.take():
+                self.shed_total += 1
+                REQUESTS_SHED.labels("degraded").inc()
+                if self.on_shed is not None:
+                    self.on_shed()
+                return Rejection(
+                    "degraded", cfg.retry_after_s,
+                    "degradation ladder: shedding to the probe trickle "
+                    "(fleet saturated, no local load signal)",
+                )
             self.admitted_total += 1
             return None
         reason = detail = None
@@ -137,6 +160,8 @@ class AdmissionController:
             return None
         self.shed_total += 1
         REQUESTS_SHED.labels(reason).inc()
+        if self.on_shed is not None:
+            self.on_shed()
         # deeper backlog -> longer Retry-After (coarse drain estimate),
         # capped so clients never park for minutes on a stale hint
         retry_after = min(30.0, self.config.retry_after_s * max(1.0, over))

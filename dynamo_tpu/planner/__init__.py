@@ -5,7 +5,7 @@ components/planner/src/dynamo/planner/{local_connector.py,
 kubernetes_connector.py}.
 """
 
-from dynamo_tpu.planner.planner import Planner, PlannerConfig
+from dynamo_tpu.planner.planner import DegradationHooks, Planner, PlannerConfig
 from dynamo_tpu.planner.connector import LocalConnector
 
-__all__ = ["Planner", "PlannerConfig", "LocalConnector"]
+__all__ = ["Planner", "PlannerConfig", "DegradationHooks", "LocalConnector"]

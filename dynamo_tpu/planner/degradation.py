@@ -1,0 +1,215 @@
+"""Graceful-degradation ladder: one policy, two planes.
+
+The planner decides the rung (`planner.py` escalates when the fleet is
+saturated at ``max_decode`` and still breaching); this module is how a
+rung becomes behavior:
+
+- :class:`LadderPolicy` — the shared math (how much each rung tightens
+  admission, when spec decode turns off). The fleet simulator and live
+  serving both apply it, so what the sim proves is what production does.
+- :class:`ServingDegradation` — applies a rung inside a serving
+  process: scales the :class:`~dynamo_tpu.http.admission.AdmissionController`
+  caps down and suspends speculative decoding on the engine.
+- :class:`StoreDegradation` — the planner side in a distributed fleet:
+  publishes the rung to the store under :func:`degradation_key`, where
+  every worker's :func:`watch_degradation` task picks it up (capped
+  backoff + snapshot resync, same contract as the model watcher — the
+  ladder must never silently freeze).
+
+In the simulator none of the store plumbing exists: ``FleetSim``
+implements ``DegradationHooks`` directly and applies the same
+:class:`LadderPolicy` synchronously at virtual time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from dynamo_tpu.telemetry.instruments import PLANNER_DEGRADATION_LEVEL
+from dynamo_tpu.utils import tasks
+from dynamo_tpu.utils.backoff import Backoff
+
+log = logging.getLogger("dynamo_tpu.planner.degradation")
+
+LEVEL_NAMES = (
+    "normal", "tighten admission", "spec decode off", "shed aggressively"
+)
+
+
+def degradation_key(namespace: str) -> str:
+    return f"{namespace}/planner/degradation"
+
+
+@dataclass(frozen=True)
+class LadderPolicy:
+    """What each rung does, as numbers (docs/autoscaling.md):
+    level 1+ scales the admission caps down, level 2+ disables spec
+    decode, level 3 clamps the queue to a shallow shed line."""
+
+    queue_factor: float = 0.5
+    kv_factor: float = 0.95
+    shed_queue_depth: int = 32
+
+    def admission_caps(
+        self, base_queue: int, base_kv: float, level: int
+    ) -> tuple[int, float]:
+        """A base cap of 0 means "check disabled" and stays 0 when
+        tightened — except the rung-3 shed line, which imposes itself
+        on the queue whenever load signals exist to enforce it."""
+        if level <= 0:
+            return base_queue, base_kv
+        queue = (
+            max(1, int(base_queue * self.queue_factor))
+            if base_queue > 0 else 0
+        )
+        if level >= 3:
+            queue = (
+                min(queue, self.shed_queue_depth)
+                if queue > 0 else self.shed_queue_depth
+            )
+        return queue, base_kv * self.kv_factor
+
+    def spec_enabled(self, base: bool, level: int) -> bool:
+        return base and level < 2
+
+    def force_shed(self, level: int) -> bool:
+        """Rung 3 on a frontend WITHOUT load signals: shed to the probe
+        trickle rather than failing open (where load signals exist, the
+        clamped admission caps govern instead)."""
+        return level >= 3
+
+
+class ServingDegradation:
+    """DegradationHooks applied to a live serving process. Both targets
+    are optional so each process wires what it owns: a frontend passes
+    its admission controller, a worker passes its engine (spec decode
+    suspends via the ``spec_suspended`` flag the step loop reads)."""
+
+    def __init__(
+        self,
+        admission: Optional[Any] = None,
+        engine: Optional[Any] = None,
+        policy: Optional[LadderPolicy] = None,
+    ):
+        self.admission = admission
+        self.engine = engine
+        self.policy = policy or LadderPolicy()
+        self.level = 0
+        if admission is not None:
+            self._base_queue = admission.config.max_queue_depth
+            self._base_kv = admission.config.max_kv_usage
+
+    def set_level(self, level: int) -> None:
+        level = max(0, level)
+        if level == self.level:
+            return
+        log.warning(
+            "degradation level %d -> %d (%s)",
+            self.level, level, LEVEL_NAMES[min(level, 3)],
+        )
+        self.level = level
+        PLANNER_DEGRADATION_LEVEL.set(level)
+        if self.admission is not None:
+            queue, kv = self.policy.admission_caps(
+                self._base_queue, self._base_kv, level
+            )
+            self.admission.config.max_queue_depth = queue
+            self.admission.config.max_kv_usage = kv
+            self.admission.force_shed = self.policy.force_shed(level)
+        if self.engine is not None:
+            # plain attribute flip: read by the engine thread each step
+            self.engine.spec_suspended = not self.policy.spec_enabled(
+                True, level
+            )
+
+
+class StoreDegradation:
+    """DegradationHooks for the distributed planner: publish the rung
+    (fire-and-forget — the planner's control loop must not block on a
+    flapping store; the watcher side resyncs from snapshots anyway).
+    Payloads carry a wall-clock ``seq`` stamp so a put delayed behind a
+    store reconnect cannot overwrite a newer rung on the watcher side
+    (and a restarted planner's stamps keep increasing)."""
+
+    def __init__(self, store: Any, namespace: str):
+        self.store = store
+        self.key = degradation_key(namespace)
+
+    def set_level(self, level: int) -> None:
+        payload = json.dumps(
+            {"level": int(level), "seq": time.time_ns()}
+        ).encode()
+
+        async def _put() -> None:
+            try:
+                await self.store.kv_put(self.key, payload)
+            except Exception:
+                log.warning(
+                    "failed to publish degradation level %d", level,
+                    exc_info=True,
+                )
+
+        tasks.spawn(_put(), name="degradation-publish")
+
+
+async def watch_degradation(
+    store: Any, namespace: str, hooks: ServingDegradation
+) -> None:
+    """Follow the planner's published rung forever (run under
+    ``utils.tasks.spawn``). Watch death resubscribes on capped backoff
+    with a snapshot resync; a deleted key means level 0; entries whose
+    ``seq`` is older than the last applied one are stale out-of-order
+    writes and are ignored."""
+    key = degradation_key(namespace)
+    backoff = Backoff(base_s=0.5, cap_s=30.0)
+    watch = None
+    last_seq = -1
+
+    def apply(value: bytes) -> None:
+        nonlocal last_seq
+        try:
+            obj = json.loads(value)
+            level = int(obj.get("level", 0))
+            seq = int(obj.get("seq", last_seq + 1))
+        except (ValueError, TypeError, json.JSONDecodeError):
+            log.warning("malformed degradation entry: %r", value[:80])
+            return
+        if seq < last_seq:
+            log.warning(
+                "ignoring stale degradation write (seq %d < %d)",
+                seq, last_seq,
+            )
+            return
+        last_seq = seq
+        hooks.set_level(level)
+
+    while True:
+        try:
+            if watch is None:
+                watch = await store.watch_prefix(key)
+                backoff.reset()
+                snapshot = watch.snapshot()
+                if snapshot:
+                    apply(snapshot[-1].value)
+                else:
+                    last_seq = -1
+                    hooks.set_level(0)
+            async for ev in watch:
+                if ev.type == "put":
+                    apply(ev.entry.value)
+                else:
+                    last_seq = -1  # key deleted: planner reset/retired
+                    hooks.set_level(0)
+            # stream ended cleanly (store dropped it): resubscribe
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.warning("degradation watch died; resubscribing",
+                        exc_info=True)
+        watch = None
+        await backoff.sleep()

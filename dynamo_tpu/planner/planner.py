@@ -7,9 +7,35 @@ grace periods and add/remove workers through a connector. Thresholds
 default to the reference's (decode KV 0.9/0.5; prefill queue per-worker
 0.5/0.2 — planner.py:42-50).
 
+Beyond the reference's watermarks, this planner closes three more loops
+(docs/autoscaling.md):
+
+- **SLO-aware scaling** — with ``slo_target`` set, sustained
+  ``slo_attainment_mean`` below target scales decode up even when KV
+  load sits under the watermark (a fleet can be latency-sick while
+  memory-healthy), and scale-down additionally requires SLO headroom
+  (``slo_target + slo_headroom``) so the planner never trades a met SLO
+  for a saved chip.
+- **Graceful degradation** — when the fleet is already at
+  ``max_decode`` and the scale-up condition persists, the planner walks
+  a degradation ladder instead of thrashing: level 1 tightens
+  admission, level 2 disables speculative decoding, level 3 sheds
+  aggressively. Steps are applied through an injectable
+  :class:`DegradationHooks` and unwound one level at a time once
+  headroom returns.
+- **Self-healing reconciliation** — ``collect()`` reports
+  ``decode_workers_reporting`` (workers whose metrics actually arrive);
+  when that stays below the planner's *intent* for
+  ``reconcile_cycles`` adjustment rounds (a chaos ``kill``, an OOM'd
+  pod), the planner replaces the missing workers without touching its
+  intent, emitting ``dynamo_planner_replacements_total``.
+
 Metrics arrive over the workers' ``load_metrics`` component subject (the
 same feed the KV router's scheduler consumes), so the planner is just
-another subscriber — no extra worker-side machinery.
+another subscriber — no extra worker-side machinery. All time flows
+through an injectable :class:`~dynamo_tpu.utils.clock.Clock`, which is
+what lets the discrete-event fleet simulator (``dynamo_tpu/sim``) drive
+this exact code against a million-request virtual day.
 """
 
 from __future__ import annotations
@@ -17,16 +43,23 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import logging
-import time
-from dataclasses import dataclass, field
+from collections import deque
+from dataclasses import dataclass
 from typing import Any, Optional, Protocol
 
 from dynamo_tpu.disagg.prefill_queue import PrefillQueue
-from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
 from dynamo_tpu.kv_router.scheduler import KvMetricsAggregator
 from dynamo_tpu.runtime.component import Component
 from dynamo_tpu.store.base import Store
+from dynamo_tpu.telemetry.instruments import (
+    PLANNER_CONNECTOR_FAILURES,
+    PLANNER_DEGRADATION_LEVEL,
+    PLANNER_REPLACEMENTS,
+    PLANNER_SCALE_EVENTS,
+)
+from dynamo_tpu.planner.degradation import LEVEL_NAMES
 from dynamo_tpu.telemetry.slo import aggregate_slo
+from dynamo_tpu.utils.clock import SYSTEM, Clock
 
 log = logging.getLogger("dynamo_tpu.planner")
 
@@ -34,6 +67,14 @@ log = logging.getLogger("dynamo_tpu.planner")
 class Connector(Protocol):
     async def add_component(self, component: str) -> bool: ...
     async def remove_component(self, component: str) -> bool: ...
+
+
+class DegradationHooks(Protocol):
+    """What the serving plane exposes to the degradation ladder. The
+    sim's fleet implements this directly; live serving wires it to the
+    admission controller + engine spec toggle."""
+
+    def set_level(self, level: int) -> None: ...
 
 
 @dataclass
@@ -54,6 +95,26 @@ class PlannerConfig:
     max_prefill: int = 8
     # consecutive breaches required before acting (grace periods)
     grace_cycles: int = 2
+    # SLO-driven scaling: 0.0 disables (pure watermark planner, the
+    # pre-ISSUE-6 behavior). With a target, sustained attainment below
+    # it scales decode up even under the KV watermark, and scale-down
+    # requires attainment >= target + headroom.
+    slo_target: float = 0.0
+    slo_headroom: float = 0.03
+    # adjustment cycles a worker may go missing (reporting < intent)
+    # before reconciliation replaces it; 0 disables self-healing
+    reconcile_cycles: int = 3
+    # adjustment cycles an ordered worker (scale-up or replacement) may
+    # take to start reporting before reconciliation presumes the spawn
+    # dead and replaces it too — real provisioning (pod schedule + model
+    # load + first publish) routinely outlasts reconcile_cycles, and
+    # without this credit every slow spawn triggers a duplicate
+    spawn_grace_cycles: int = 10
+    # degradation ladder ceiling (0 disables the ladder entirely)
+    degrade_max_level: int = 3
+    # rate limit for connector-refusal warnings (satellite: don't spam
+    # the log every adjustment cycle at max/min capacity)
+    connector_warn_interval_s: float = 60.0
 
 
 @dataclass
@@ -75,15 +136,22 @@ class Planner:
         config: Optional[PlannerConfig] = None,
         prefill_workers: int = 0,
         decode_workers: int = 1,
+        clock: Optional[Clock] = None,
+        degradation: Optional[DegradationHooks] = None,
     ):
         """``store``/``component`` may be None for a DRIVEN planner:
         the caller feeds snapshots straight into make_adjustments()
-        (the planner-simulation example and what-if analyses) instead
-        of collect() polling live metrics."""
+        (the fleet simulator, the planner-simulation example, what-if
+        analyses) instead of collect() polling live metrics. ``clock``
+        defaults to the real system clock; the simulator passes its
+        virtual clock so ``_run`` and snapshot timestamps never touch
+        wall time."""
         self.store = store
         self.component = component
         self.connector = connector
         self.config = config or PlannerConfig()
+        self.clock = clock or SYSTEM
+        self.degradation = degradation
         self.aggregator = KvMetricsAggregator()
         self.queue = (
             PrefillQueue(store, component.namespace.name)
@@ -94,6 +162,19 @@ class Planner:
         self.prefill_workers = prefill_workers
         self._decode_sig = _Signal()
         self._prefill_sig = _Signal()
+        self._missing_streak = 0
+        self._surplus_streak = 0
+        self._relax_streak = 0
+        self._adjust_cycle = 0
+        # decode workers ordered but not yet reporting, one expiry cycle
+        # per order (FIFO): reconciliation subtracts these from
+        # "missing" until the fleet catches up or each order's own
+        # spawn_grace_cycles expire — a shared deadline would let every
+        # new order refresh a dead spawn's credit forever
+        self._provisioning: deque[int] = deque()
+        self._last_connector_warn: dict[str, float] = {}
+        self.degradation_level = 0
+        self.replacements_total = 0
         self._task: Optional[asyncio.Task] = None
         self.history: list[dict[str, Any]] = []  # observability ring
         self.on_metrics: Optional[Any] = None  # hook for tracing/tensorboard
@@ -129,7 +210,8 @@ class Planner:
             "prefill_queue_per_worker": per_worker,
             "slo_attainment_mean": attainment,
             "goodput_tokens_total": goodput,
-            "ts": time.time(),
+            "degradation_level": float(self.degradation_level),
+            "ts": self.clock.time(),
         }
         self.history.append(snap)
         del self.history[:-600]
@@ -140,59 +222,258 @@ class Planner:
                 pass
         return snap
 
+    # -- connector plumbing (streak reset + rate-limited refusal warning) --
+
+    def _warn_connector(self, op: str, component: str, note: str) -> None:
+        PLANNER_CONNECTOR_FAILURES.labels(op).inc()
+        key = f"{op}:{component}"
+        now = self.clock.monotonic()
+        last = self._last_connector_warn.get(key)
+        if (
+            last is not None
+            and now - last < self.config.connector_warn_interval_s
+        ):
+            return
+        self._last_connector_warn[key] = now
+        log.warning(
+            "connector refused %s %s (%s); streak reset — will re-arm "
+            "after %d fresh breach cycle(s)",
+            op, component, note, self.config.grace_cycles,
+        )
+
+    async def _scale(self, op: str, component: str, signal: _Signal) -> bool:
+        """One add/remove through the connector. On refusal the breach
+        streak RESETS (instead of silently re-issuing the same failed
+        command every adjustment cycle) and a rate-limited warning
+        records why nothing is happening."""
+        ok = (
+            await self.connector.add_component(component)
+            if op == "add"
+            else await self.connector.remove_component(component)
+        )
+        if not ok:
+            signal.up_streak = 0
+            signal.down_streak = 0
+            self._warn_connector(op, component, "command not acknowledged")
+        return ok
+
+    # -- reconciliation (self-healing) -------------------------------------
+
+    def _note_provisioning(self, n: int = 1) -> None:
+        """Credit ``n`` decode workers as ordered-but-provisioning so
+        reconciliation doesn't mistake spawn latency for a loss."""
+        expire = self._adjust_cycle + self.config.spawn_grace_cycles
+        self._provisioning.extend([expire] * n)
+
+    async def _reconcile(self, snap: dict[str, float]) -> None:
+        """Converge the fleet onto the planner's intent in both
+        directions: replace workers the fleet lost without the planner
+        asking (chaos kill, OOM, preempted node) and drain surplus
+        workers the fleet gained without it asking (a slow spawn landing
+        after a scale-down already passed it). Intent stays put; the
+        connector moves the reported count to match it. Workers the
+        planner itself just ordered get ``spawn_grace_cycles`` to start
+        reporting before they count as missing."""
+        c = self.config
+        reporting = snap.get("decode_workers_reporting")
+        if c.reconcile_cycles <= 0 or reporting is None:
+            return
+        # each order expires on its own deadline (oldest first): a fresh
+        # order must not extend a dead spawn's credit, and one dead
+        # spawn expiring must not strip credit from healthy later orders
+        expired = 0
+        while self._provisioning and self._adjust_cycle >= self._provisioning[0]:
+            self._provisioning.popleft()
+            expired += 1
+        if expired:
+            log.warning(
+                "%d ordered decode worker(s) never reported within "
+                "%d cycles; presuming the spawn(s) dead",
+                expired, c.spawn_grace_cycles,
+            )
+        missing = self.decode_workers - int(reporting)
+        if missing < 0:
+            # surplus: a spawn landed after a scale-down raced past it,
+            # or capacity was added out of band. Intent stays
+            # authoritative — without this path the extra worker runs
+            # (and bills) forever, because the policy down-branch is
+            # clamped by intent, not by the reported count. Drain one
+            # worker per sustained reconcile window.
+            self._missing_streak = 0
+            self._provisioning.clear()  # everything ordered has landed
+            self._surplus_streak += 1
+            if self._surplus_streak < c.reconcile_cycles:
+                return
+            self._surplus_streak = 0
+            if await self.connector.remove_component(c.decode_component):
+                PLANNER_SCALE_EVENTS.labels(
+                    c.decode_component, "drain"
+                ).inc()
+                log.warning(
+                    "reconciliation: draining surplus %s worker "
+                    "(reporting %d > intent %d)",
+                    c.decode_component, int(reporting), self.decode_workers,
+                )
+            else:
+                self._warn_connector(
+                    "remove", c.decode_component, "surplus drain refused"
+                )
+            return
+        self._surplus_streak = 0
+        if missing == 0:
+            self._missing_streak = 0
+            self._provisioning.clear()  # fleet caught up with intent
+            return
+        # credits beyond the observed gap correspond to spawns that
+        # already landed — retire the oldest (first ordered, first up)
+        while len(self._provisioning) > missing:
+            self._provisioning.popleft()
+        if missing <= len(self._provisioning):
+            return  # fully explained by in-flight spawns: wait them out
+        self._missing_streak += 1
+        if self._missing_streak < c.reconcile_cycles:
+            return
+        self._missing_streak = 0
+        for _ in range(missing - len(self._provisioning)):
+            if await self.connector.add_component(c.decode_component):
+                self.replacements_total += 1
+                self._note_provisioning()
+                PLANNER_REPLACEMENTS.labels(c.decode_component).inc()
+                log.warning(
+                    "reconciliation: replacing lost %s worker "
+                    "(reporting %d < intent %d)",
+                    c.decode_component, int(reporting), self.decode_workers,
+                )
+            else:
+                self._warn_connector(
+                    "add", c.decode_component, "replacement refused"
+                )
+                break
+
+    # -- degradation ladder -------------------------------------------------
+
+    def _set_degradation(self, level: int) -> None:
+        c = self.config
+        level = max(0, min(c.degrade_max_level, level))
+        if level == self.degradation_level:
+            return
+        log.warning(
+            "degradation ladder: level %d -> %d (%s)",
+            self.degradation_level, level,
+            LEVEL_NAMES[min(level, len(LEVEL_NAMES) - 1)],
+        )
+        self.degradation_level = level
+        PLANNER_DEGRADATION_LEVEL.set(level)
+        if self.degradation is not None:
+            try:
+                self.degradation.set_level(level)
+            except Exception:
+                log.exception("degradation hook failed at level %d", level)
+
     async def make_adjustments(self, snap: dict[str, float]) -> None:
         c = self.config
+        self._adjust_cycle += 1
+        await self._reconcile(snap)
+        kv = snap.get("kv_load_mean", 0.0)
+        slo = snap.get("slo_attainment_mean", 1.0)
+        reporting = snap.get("decode_workers_reporting")
+        # ZERO workers reporting is an outage, not an idle fleet: the
+        # kv/slo defaults (0.0 / 1.0) are vacuous, and acting on them
+        # would build scale-DOWN pressure that decays intent toward
+        # min_decode while reconciliation is trying to restore the
+        # fleet. Freeze decode scaling and the ladder until metrics
+        # return; prefill scaling stays live (queue depth is
+        # store-backed, not worker-reported).
+        blind = reporting is not None and int(reporting) <= 0
+        slo_on = c.slo_target > 0.0
+        # latency-sick even if memory-healthy -> scale-up pressure
+        slo_breach = slo_on and slo < c.slo_target
+        # scale-down needs BOTH kv headroom and slo headroom
+        slo_headroom = (not slo_on) or slo >= c.slo_target + c.slo_headroom
         self._decode_sig.observe(
-            up=snap["kv_load_mean"] > c.decode_kv_scale_up,
-            down=snap["kv_load_mean"] < c.decode_kv_scale_down,
+            up=(kv > c.decode_kv_scale_up or slo_breach) and not blind,
+            down=kv < c.decode_kv_scale_down and slo_headroom and not blind,
         )
         self._prefill_sig.observe(
-            up=snap["prefill_queue_per_worker"] > c.prefill_queue_scale_up,
-            down=snap["prefill_queue_per_worker"] < c.prefill_queue_scale_down,
+            up=snap.get("prefill_queue_per_worker", 0.0)
+            > c.prefill_queue_scale_up,
+            down=snap.get("prefill_queue_per_worker", 0.0)
+            < c.prefill_queue_scale_down,
         )
-        if (
-            self._decode_sig.up_streak >= c.grace_cycles
-            and self.decode_workers < c.max_decode
-        ):
-            if await self.connector.add_component(c.decode_component):
-                self.decode_workers += 1
+        if self._decode_sig.up_streak >= c.grace_cycles:
+            if self.decode_workers < c.max_decode:
+                if await self._scale("add", c.decode_component,
+                                     self._decode_sig):
+                    self.decode_workers += 1
+                    self._note_provisioning()
+                    self._decode_sig = _Signal()
+                    PLANNER_SCALE_EVENTS.labels(
+                        c.decode_component, "up"
+                    ).inc()
+                    log.info("scaled decode up to %d", self.decode_workers)
+                elif c.degrade_max_level > 0:
+                    # the connector refused the add: real capacity is
+                    # smaller than --max-decode says, so the fleet is
+                    # saturated in practice — degrade rather than let
+                    # every request miss while a rate-limited warning
+                    # is the only response (streaks already reset in
+                    # _scale, pacing escalation per breach window)
+                    self._set_degradation(self.degradation_level + 1)
+            elif c.degrade_max_level > 0:
+                # saturated at max fleet and still breaching: degrade
+                # one rung per persistent-breach window instead of
+                # letting every request miss its target
+                self._set_degradation(self.degradation_level + 1)
                 self._decode_sig = _Signal()
-                log.info("scaled decode up to %d", self.decode_workers)
         elif (
             self._decode_sig.down_streak >= c.grace_cycles
             and self.decode_workers > c.min_decode
         ):
-            if await self.connector.remove_component(c.decode_component):
+            if await self._scale("remove", c.decode_component,
+                                 self._decode_sig):
                 self.decode_workers -= 1
                 self._decode_sig = _Signal()
+                PLANNER_SCALE_EVENTS.labels(c.decode_component, "down").inc()
                 log.info("scaled decode down to %d", self.decode_workers)
-        if (
-            self._prefill_sig.up_streak >= c.grace_cycles
-            and self.prefill_workers < c.max_prefill
-        ):
-            if await self.connector.add_component(c.prefill_component):
-                self.prefill_workers += 1
-                self._prefill_sig = _Signal()
-                log.info("scaled prefill up to %d", self.prefill_workers)
+        # unwind the ladder one rung at a time once the fleet has real
+        # headroom (under the scale-UP watermark, SLO met with margin)
+        if self.degradation_level > 0:
+            relaxed = kv < c.decode_kv_scale_up and slo_headroom and not blind
+            self._relax_streak = self._relax_streak + 1 if relaxed else 0
+            if self._relax_streak >= c.grace_cycles:
+                self._set_degradation(self.degradation_level - 1)
+                self._relax_streak = 0
+        if self._prefill_sig.up_streak >= c.grace_cycles:
+            if self.prefill_workers < c.max_prefill:
+                if await self._scale("add", c.prefill_component,
+                                     self._prefill_sig):
+                    self.prefill_workers += 1
+                    self._prefill_sig = _Signal()
+                    PLANNER_SCALE_EVENTS.labels(
+                        c.prefill_component, "up"
+                    ).inc()
+                    log.info("scaled prefill up to %d", self.prefill_workers)
         elif (
             self._prefill_sig.down_streak >= c.grace_cycles
             and self.prefill_workers > c.min_prefill
         ):
-            if await self.connector.remove_component(c.prefill_component):
+            if await self._scale("remove", c.prefill_component,
+                                 self._prefill_sig):
                 self.prefill_workers -= 1
                 self._prefill_sig = _Signal()
+                PLANNER_SCALE_EVENTS.labels(c.prefill_component, "down").inc()
                 log.info("scaled prefill down to %d", self.prefill_workers)
 
     async def _run(self) -> None:
         c = self.config
-        last_adjust = time.monotonic()
+        last_adjust = self.clock.monotonic()
         while True:
             snap = await self.collect()
-            now = time.monotonic()
+            now = self.clock.monotonic()
             if now - last_adjust >= c.adjustment_interval_s:
                 await self.make_adjustments(snap)
                 last_adjust = now
-            await asyncio.sleep(c.metric_interval_s)
+            await self.clock.sleep(c.metric_interval_s)
 
     async def close(self) -> None:
         if self._task is not None:

@@ -1,0 +1,72 @@
+"""FaultPlan composition: the PR-5 chaos grammar at simulated time.
+
+The same :class:`~dynamo_tpu.faults.plan.FaultPlan` (``DYN_FAULTS``
+syntax, JSON files, seeds) drives faults in the simulator, so a chaos
+scenario written for a live fleet replays as a what-if against the
+virtual one. Rule semantics are identical — per-rule
+``random.Random((seed, point, index))`` streams, ``@p``/``@after``/
+``@max``/``@match`` — but evaluation has no global side effects (no
+process metrics, no process kill): the fleet interprets the fired rules
+at its own seams.
+
+Sim injection points and their interpretations (docs/autoscaling.md):
+
+    http.request      per arrival — ``error``/``drop`` fail the request
+                      before admission; ``delay=S`` adds S seconds of
+                      frontend latency to its TTFT
+    engine.step       per worker heartbeat — ``stall=S``/``delay=S``
+                      slow that worker's decode by ``stall_factor`` for
+                      S simulated seconds
+    worker.liveness   per worker heartbeat — ``kill`` removes the
+                      worker abruptly: in-flight requests fail, KV
+                      vanishes, and only the planner's reconciliation
+                      brings capacity back
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dynamo_tpu.faults.plan import FaultPlan, FaultRule, RuleState
+
+SIM_POINTS = ("http.request", "engine.step", "worker.liveness")
+
+
+class SimFaultDriver:
+    """Side-effect-free re-evaluation of a FaultPlan on the literal
+    eligibility algorithm the live injector runs
+    (``plan.RuleState.step`` — same counters, same seeded streams),
+    minus the acting (the fleet acts) and process-global telemetry."""
+
+    def __init__(self, plan: Optional[FaultPlan]):
+        self.plan = plan or FaultPlan()
+        self._states = [
+            RuleState(rule, self.plan.rule_rng(i))
+            for i, rule in enumerate(self.plan.rules)
+        ]
+        self._by_point: dict[str, list[RuleState]] = {}
+        for st in self._states:
+            self._by_point.setdefault(st.rule.point, []).append(st)
+        self.fired: list[tuple[float, str, str]] = []  # (t, point, kind)
+
+    def due(self, now: float, point: str, **ctx) -> list[FaultRule]:
+        """One pass through ``point``; returns the rules that fire."""
+        states = self._by_point.get(point)
+        if not states:
+            return []
+        out: list[FaultRule] = []
+        for st in states:
+            if st.step(ctx):
+                self.fired.append((now, st.rule.point, st.rule.kind))
+                out.append(st.rule)
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "seed": self.plan.seed,
+            "fired_total": len(self.fired),
+            "rules": [
+                {**st.rule.to_dict(), "passes": st.passes, "fires": st.fires}
+                for st in self._states
+            ],
+        }

@@ -1,0 +1,163 @@
+"""Arrival-trace generators: the offered load side of the simulator.
+
+Three canonical shapes, all seeded and deterministic:
+
+- :func:`diurnal_trace` — a nonhomogeneous Poisson process whose rate
+  follows a day-night sinusoid (the planner-benchmark workload at
+  fleet scale), sampled by Lewis-Shedler thinning;
+- :func:`bursty_trace` — a 2-state Markov-modulated Poisson process
+  (calm/burst), the flash-crowd shape that stresses admission control
+  and scale-up latency;
+- request/output lengths from :class:`LengthModel` — clamped lognormal
+  heavy tails (the BurstGPT/ShareGPT-like shape: most requests short,
+  a fat tail of long ones that dominates KV pressure).
+
+Every generator returns a time-sorted ``list[SimRequest]``; composition
+is concatenation + re-sort (``merge_traces``), which is how the bench's
+canned "diurnal + burst" workload is built.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+
+@dataclass(frozen=True)
+class SimRequest:
+    rid: int
+    t: float  # arrival, simulated seconds
+    prompt_tokens: int
+    output_tokens: int
+
+
+@dataclass(frozen=True)
+class LengthModel:
+    """Clamped lognormal: ``exp(N(mu, sigma))`` clipped to [lo, hi].
+    Defaults give ~180-token prompts / ~80-token outputs with a heavy
+    right tail (p99 several times the median)."""
+
+    prompt_median: float = 160.0
+    prompt_sigma: float = 0.8
+    prompt_min: int = 8
+    prompt_max: int = 4096
+    output_median: float = 64.0
+    output_sigma: float = 0.7
+    output_min: int = 4
+    output_max: int = 1024
+
+    def sample(self, rng: random.Random) -> tuple[int, int]:
+        p = rng.lognormvariate(math.log(self.prompt_median),
+                               self.prompt_sigma)
+        o = rng.lognormvariate(math.log(self.output_median),
+                               self.output_sigma)
+        return (
+            int(min(self.prompt_max, max(self.prompt_min, p))),
+            int(min(self.output_max, max(self.output_min, o))),
+        )
+
+
+def poisson_trace(
+    rate_fn: Callable[[float], float],
+    rate_max: float,
+    duration_s: float,
+    seed: int,
+    lengths: Optional[LengthModel] = None,
+    rid_base: int = 0,
+) -> list[SimRequest]:
+    """Nonhomogeneous Poisson arrivals by thinning: propose at the
+    envelope rate ``rate_max``, accept with ``rate_fn(t)/rate_max``."""
+    assert rate_max > 0
+    # str seeds hash via sha512 (stable across processes); tuple seeds
+    # would fall back to salted hash() and break replay determinism
+    rng = random.Random(f"trace:{seed}")
+    lengths = lengths or LengthModel()
+    out: list[SimRequest] = []
+    t = 0.0
+    rid = rid_base
+    while True:
+        t += rng.expovariate(rate_max)
+        if t >= duration_s:
+            break
+        if rng.random() <= rate_fn(t) / rate_max:
+            p, o = lengths.sample(rng)
+            out.append(SimRequest(rid=rid, t=t, prompt_tokens=p,
+                                  output_tokens=o))
+            rid += 1
+    return out
+
+
+def diurnal_trace(
+    duration_s: float,
+    seed: int,
+    base_rps: float = 10.0,
+    peak_rps: float = 40.0,
+    period_s: float = 3600.0,
+    lengths: Optional[LengthModel] = None,
+    rid_base: int = 0,
+) -> list[SimRequest]:
+    """Sinusoidal day: rate swings base→peak→base once per period."""
+    amp = (peak_rps - base_rps) / 2.0
+    mid = base_rps + amp
+
+    def rate(t: float) -> float:
+        return mid - amp * math.cos(2.0 * math.pi * t / period_s)
+
+    return poisson_trace(rate, peak_rps, duration_s, seed,
+                         lengths=lengths, rid_base=rid_base)
+
+
+def bursty_trace(
+    duration_s: float,
+    seed: int,
+    calm_rps: float = 15.0,
+    burst_rps: float = 90.0,
+    mean_calm_s: float = 120.0,
+    mean_burst_s: float = 20.0,
+    lengths: Optional[LengthModel] = None,
+    rid_base: int = 0,
+) -> list[SimRequest]:
+    """2-state MMPP: exponential dwell in calm/burst, Poisson arrivals
+    at the state's rate. The burst state is the admission-control and
+    scale-up-latency stressor."""
+    rng = random.Random(f"mmpp:{seed}")
+    lengths = lengths or LengthModel()
+    out: list[SimRequest] = []
+    t = 0.0
+    rid = rid_base
+    bursting = False
+    state_end = rng.expovariate(1.0 / mean_calm_s)
+    while t < duration_s:
+        rate = burst_rps if bursting else calm_rps
+        t_next = t + rng.expovariate(rate)
+        if t_next >= state_end:
+            # no arrival before the state flips; jump to the boundary
+            t = state_end
+            bursting = not bursting
+            state_end = t + rng.expovariate(
+                1.0 / (mean_burst_s if bursting else mean_calm_s)
+            )
+            continue
+        t = t_next
+        if t >= duration_s:
+            break
+        p, o = lengths.sample(rng)
+        out.append(SimRequest(rid=rid, t=t, prompt_tokens=p,
+                              output_tokens=o))
+        rid += 1
+    return out
+
+
+def merge_traces(*traces: list[SimRequest]) -> list[SimRequest]:
+    """Compose workloads (e.g. diurnal baseline + a flash burst): merge
+    by arrival time, re-assigning rids so they stay unique and ordered."""
+    merged = sorted(
+        (r for tr in traces for r in tr), key=lambda r: (r.t, r.rid)
+    )
+    return [
+        SimRequest(rid=i, t=r.t, prompt_tokens=r.prompt_tokens,
+                   output_tokens=r.output_tokens)
+        for i, r in enumerate(merged)
+    ]

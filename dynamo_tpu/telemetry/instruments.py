@@ -261,6 +261,31 @@ MIDSTREAM_ABORTS = REGISTRY.counter(
     "mid-generation (tokens already streamed; not retryable)",
 )
 
+# -- autoscaling planner (planner/planner.py; docs/autoscaling.md) ----------
+PLANNER_SCALE_EVENTS = REGISTRY.counter(
+    "dynamo_planner_scale_events_total",
+    "Successful planner scaling actions, by component and direction",
+    # direction: up | down (policy) | drain (reconciliation removing a
+    # surplus worker the fleet gained without the planner asking)
+    labels=("component", "direction"),
+)
+PLANNER_REPLACEMENTS = REGISTRY.counter(
+    "dynamo_planner_replacements_total",
+    "Workers replaced by the planner's self-healing reconciliation "
+    "(intent said N, the fleet reported fewer for reconcile_cycles)",
+    labels=("component",),
+)
+PLANNER_DEGRADATION_LEVEL = REGISTRY.gauge(
+    "dynamo_planner_degradation_level",
+    "Graceful-degradation ladder position (0 normal, 1 tighten "
+    "admission, 2 disable spec decode, 3 shed aggressively)",
+)
+PLANNER_CONNECTOR_FAILURES = REGISTRY.counter(
+    "dynamo_planner_connector_failures_total",
+    "Planner add/remove commands the connector refused or failed",
+    labels=("op",),  # add | remove
+)
+
 # -- disaggregation (decode-side routing + prefill queue) -------------------
 DISAGG_REMOTE_PREFILLS = REGISTRY.counter(
     "dynamo_disagg_remote_prefills_total",

@@ -119,6 +119,23 @@ class SloTracker:
         SLO_ATTAINMENT.set(attainment)
         return met
 
+    def note_shed(self) -> None:
+        """Score an admission-shed request as an SLO miss in the rolling
+        window. Without this the attainment signal only sees requests the
+        fleet chose to serve, so under sustained overload the admission
+        controller sheds load while attainment reads ~1.0 and the
+        Planner's SLO-breach scale-up never fires — the fleet rejects its
+        way to a perfect score. Shed requests carry no TTFT/ITL sample
+        (they never ran), so the histograms are untouched."""
+        if not self.config.enabled:
+            return
+        with self._lock:
+            self._outcomes.append(False)
+            self.requests_seen += 1
+            attainment = sum(self._outcomes) / len(self._outcomes)
+        SLO_REQUESTS.labels("shed").inc()
+        SLO_ATTAINMENT.set(attainment)
+
     @property
     def attainment(self) -> float:
         """Rolling attainment over the window (1.0 when no targets are

@@ -12,9 +12,10 @@ a deterministic lower bound on pacing.
 
 from __future__ import annotations
 
-import asyncio
 import random
 from typing import Optional
+
+from dynamo_tpu.utils.clock import SYSTEM, Clock
 
 
 class Backoff:
@@ -22,7 +23,9 @@ class Backoff:
     per failed attempt, ``reset()`` after a success.
 
     ``rng`` is injectable so tests (and the seeded fault-injection
-    suite) get deterministic schedules.
+    suite) get deterministic schedules; ``clock`` is injectable so
+    driven/simulated control loops (dynamo_tpu/sim) pace retries on
+    virtual time instead of real sleeps.
     """
 
     def __init__(
@@ -31,12 +34,14 @@ class Backoff:
         cap_s: float = 30.0,
         factor: float = 2.0,
         rng: Optional[random.Random] = None,
+        clock: Optional[Clock] = None,
     ):
         self.base_s = base_s
         self.cap_s = cap_s
         self.factor = factor
         self.attempt = 0
         self._rng = rng or random.Random()
+        self._clock = clock or SYSTEM
 
     def next_delay(self) -> float:
         """The jittered delay for the current attempt; advances state."""
@@ -46,7 +51,7 @@ class Backoff:
 
     async def sleep(self) -> float:
         delay = self.next_delay()
-        await asyncio.sleep(delay)
+        await self._clock.sleep(delay)
         return delay
 
     def reset(self) -> None:

@@ -1,0 +1,55 @@
+"""Injectable clock: the seam that makes control loops simulatable.
+
+Every control loop in the stack (the autoscaling Planner, admission
+token buckets, retry backoff) reads time and sleeps through a ``Clock``
+instead of calling ``time.monotonic()`` / ``asyncio.sleep()`` directly.
+Production code passes nothing and gets :data:`SYSTEM` (real monotonic
+time, real asyncio sleeps); the discrete-event fleet simulator
+(``dynamo_tpu/sim``) passes its virtual clock, so scaling policy runs
+against millions of simulated requests with zero real sleeps and
+bit-identical replays.
+
+dynalint DL009 (``wall-clock-in-control-loop``) enforces the seam: code
+that *has* an injectable clock available must not bypass it inside its
+control loops.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Protocol, runtime_checkable
+
+
+@runtime_checkable
+class Clock(Protocol):
+    """What a control loop needs from time.
+
+    - ``monotonic()`` — interval math (never compared across processes);
+    - ``time()`` — wall-clock stamps for logs/snapshots (a virtual clock
+      returns simulated seconds here so replays are deterministic);
+    - ``sleep(s)`` — pacing (a virtual clock either advances instantly
+      or refuses, depending on whether the loop is driven externally).
+    """
+
+    def monotonic(self) -> float: ...
+    def time(self) -> float: ...
+    async def sleep(self, seconds: float) -> None: ...
+
+
+class SystemClock:
+    """The real thing: ``time.monotonic``/``time.time``/``asyncio.sleep``."""
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    def time(self) -> float:
+        return time.time()
+
+    async def sleep(self, seconds: float) -> None:
+        await asyncio.sleep(seconds)
+
+
+# process-wide default; control loops take `clock: Optional[Clock] = None`
+# and fall back to this
+SYSTEM = SystemClock()

@@ -16,6 +16,21 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent XLA compilation cache (.jax_cache/, gitignored): tier-1 is
+# dominated by re-jitting the same programs on every run — and every
+# CLI-e2e subprocess recompiles them again from scratch. Set through the
+# environment (not jax.config) so spawned worker processes inherit it.
+# setdefault keeps any externally-configured cache location in charge.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache",
+    ),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
 

@@ -1,0 +1,55 @@
+"""Fixture: clock-injectable code bypassing its Clock inside loops."""
+
+import asyncio
+import time
+
+
+class Planner:
+    """Takes an injectable clock, then ignores it in the control loop."""
+
+    def __init__(self, clock=None):
+        self.clock = clock
+
+    async def run(self):
+        last = self.clock.monotonic()
+        while True:
+            now = time.monotonic()  # VIOLATION: bypasses self.clock
+            if now - last > 30.0:
+                last = now
+            await asyncio.sleep(5.0)  # VIOLATION: bypasses self.clock
+
+
+class Bucket:
+    def __init__(self):
+        self._clock = None  # assigned later (still clock-bearing)
+
+    def refill_forever(self):
+        for _ in range(100):
+            time.sleep(0.1)  # VIOLATION: bypasses self._clock
+
+
+class Scheduler:
+    """NOT clock-bearing itself — but the helper nested inside its
+    method takes a clock parameter and must be scanned on its own."""
+
+    def poll(self):
+        def wait_step(clock, deadline):
+            while clock.monotonic() < deadline:
+                time.sleep(0.5)  # VIOLATION: nested def bears a clock
+
+        return wait_step
+
+
+def paced_probe(url, clock):
+    while True:
+        stamp = time.time()  # VIOLATION: function takes a clock param
+        if stamp:
+            break
+
+
+def wait_for(predicate, clock, timeout=5.0):
+    deadline = time.monotonic() + timeout  # straight-line: not flagged
+    while time.monotonic() < deadline:  # VIOLATION: condition on wall time
+        if predicate():
+            return True
+    return False

@@ -1,0 +1,37 @@
+"""Fixture: the idiomatic patterns DL009 must stay quiet on."""
+
+import asyncio
+import time
+
+
+class Planner:
+    """Clock-bearing code routing ALL loop time through the clock."""
+
+    def __init__(self, clock=None):
+        self.clock = clock
+
+    async def run(self):
+        last = self.clock.monotonic()
+        while True:
+            now = self.clock.monotonic()
+            if now - last > 30.0:
+                last = now
+            await self.clock.sleep(5.0)
+
+
+class PlainWatcher:
+    """No injectable clock anywhere: wall time in loops is fine (there
+    is no simulated timeline to diverge from)."""
+
+    async def watch(self):
+        while True:
+            started = time.monotonic()
+            if started:
+                await asyncio.sleep(1.0)
+
+
+def one_shot_stamp(clock):
+    # straight-line wall-clock use in clock-bearing code is allowed;
+    # only loops split the timeline
+    t0 = time.monotonic()
+    return clock.monotonic() - t0

@@ -31,6 +31,7 @@ CASES = [
     ("bare-except", "bare_except", 1),
     ("unbounded-telemetry-buffer", "unbounded_telemetry_buffer", 3),
     ("unbounded-retry-loop", "unbounded_retry_loop", 2),
+    ("wall-clock-in-control-loop", "wall_clock_in_control_loop", 6),
 ]
 
 
@@ -338,7 +339,7 @@ def test_syntax_error_becomes_parse_finding():
 
 def test_rule_catalog_metadata():
     rules = all_rules()
-    assert len(rules) == 8
+    assert len(rules) == 9
     codes = [r.code for r in rules]
     assert codes == sorted(codes) and len(set(codes)) == len(codes)
     assert all(r.name == r.name.lower() and " " not in r.name for r in rules)

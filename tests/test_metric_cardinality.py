@@ -28,6 +28,7 @@ _INSTRUMENTED_MODULES = [
     "dynamo_tpu.disagg.transfer",
     "dynamo_tpu.engine.scheduler",
     "dynamo_tpu.kvbm.manager",
+    "dynamo_tpu.planner.planner",
 ]
 
 # the ISSUE 4 observability surface: these series must exist in the
@@ -48,6 +49,11 @@ _REQUIRED_SERIES = [
     "dynamo_hbm_bytes_in_use",
     "dynamo_hbm_bytes_limit",
     "dynamo_hbm_peak_bytes",
+    # ISSUE 6: the self-healing planner surface
+    "dynamo_planner_scale_events_total",
+    "dynamo_planner_replacements_total",
+    "dynamo_planner_degradation_level",
+    "dynamo_planner_connector_failures_total",
 ]
 
 
@@ -97,6 +103,12 @@ def test_observability_series_are_registered():
     assert REGISTRY.get(
         "dynamo_flight_recorder_dumps_total"
     ).label_names == ("reason",)
+    assert REGISTRY.get(
+        "dynamo_planner_scale_events_total"
+    ).label_names == ("component", "direction")
+    assert REGISTRY.get(
+        "dynamo_planner_replacements_total"
+    ).label_names == ("component",)
 
 
 def test_gate_catches_a_request_id_label():

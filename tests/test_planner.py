@@ -1,0 +1,551 @@
+"""Planner control-loop semantics (driven mode, no store/workers) and
+the full-fleet simulator replays: watermark hysteresis, grace cycles,
+clamping, SLO-triggered scaling, the degradation ladder, connector
+refusals, self-healing reconciliation — and the ISSUE-6 acceptance
+replay: ≥100k simulated requests with a composed seed-42 fault plan
+(worker kill mid-burst), bit-identical across two runs, with the
+planner restoring SLO attainment without human input."""
+
+import logging
+import time
+
+import pytest
+
+from dynamo_tpu.faults.plan import parse_plan
+from dynamo_tpu.planner.planner import Planner, PlannerConfig, _Signal
+from dynamo_tpu.sim import FleetSim, SimConfig, bursty_trace
+
+# --- driven-planner harness -------------------------------------------------
+
+
+class Grants:
+    """Connector that grants (or refuses) and remembers the story."""
+
+    def __init__(self, add_ok=True, remove_ok=True):
+        self.add_ok = add_ok
+        self.remove_ok = remove_ok
+        self.calls = []
+
+    async def add_component(self, component):
+        self.calls.append(("add", component))
+        return self.add_ok
+
+    async def remove_component(self, component):
+        self.calls.append(("remove", component))
+        return self.remove_ok
+
+
+class Hooks:
+    def __init__(self):
+        self.levels = []
+
+    def set_level(self, level):
+        self.levels.append(level)
+
+
+def driven(config=None, conn=None, hooks=None, decode=1, prefill=0):
+    conn = conn or Grants()
+    planner = Planner(
+        store=None, component=None, connector=conn,
+        config=config or PlannerConfig(grace_cycles=2),
+        decode_workers=decode, prefill_workers=prefill,
+        degradation=hooks,
+    )
+    return planner, conn
+
+
+def snap(kv=0.0, queue=0.0, slo=1.0, reporting=None, goodput=0.0):
+    s = {
+        "kv_load_mean": kv,
+        "prefill_queue_depth": queue,
+        "prefill_queue_per_worker": queue,
+        "slo_attainment_mean": slo,
+        "goodput_tokens_total": goodput,
+    }
+    if reporting is not None:
+        s["decode_workers_reporting"] = float(reporting)
+    return s
+
+
+# --- watermarks, grace, clamping -------------------------------------------
+
+
+async def test_watermark_hysteresis_band_is_quiet():
+    planner, conn = driven()
+    for _ in range(6):  # between the watermarks: no action ever
+        await planner.make_adjustments(snap(kv=0.7))
+    assert conn.calls == []
+    assert planner.decode_workers == 1
+
+
+async def test_grace_cycles_gate_scale_up_and_down():
+    planner, conn = driven(decode=2)
+    await planner.make_adjustments(snap(kv=0.95))
+    assert conn.calls == []  # streak 1 < grace 2
+    await planner.make_adjustments(snap(kv=0.95))
+    assert conn.calls == [("add", "backend")]
+    assert planner.decode_workers == 3
+    # a breach interrupted by a healthy cycle starts over
+    await planner.make_adjustments(snap(kv=0.95))
+    await planner.make_adjustments(snap(kv=0.7))
+    await planner.make_adjustments(snap(kv=0.95))
+    assert planner.decode_workers == 3
+    # sustained low load scales down after grace
+    await planner.make_adjustments(snap(kv=0.1))
+    await planner.make_adjustments(snap(kv=0.1))
+    assert conn.calls[-1] == ("remove", "backend")
+    assert planner.decode_workers == 2
+
+
+async def test_min_max_clamping():
+    cfg = PlannerConfig(grace_cycles=1, min_decode=1, max_decode=2,
+                        degrade_max_level=0)
+    planner, conn = driven(config=cfg, decode=2)
+    for _ in range(4):
+        await planner.make_adjustments(snap(kv=0.99))
+    assert conn.calls == []  # already at max, ladder disabled
+    planner2, conn2 = driven(config=cfg, decode=1)
+    for _ in range(4):
+        await planner2.make_adjustments(snap(kv=0.01))
+    assert conn2.calls == []  # already at min
+
+
+# --- SLO-aware scaling ------------------------------------------------------
+
+
+async def test_slo_breach_scales_up_even_under_kv_watermark():
+    cfg = PlannerConfig(grace_cycles=2, slo_target=0.9)
+    planner, conn = driven(config=cfg)
+    # memory-healthy (kv 0.3) but latency-sick (attainment 0.7)
+    await planner.make_adjustments(snap(kv=0.3, slo=0.7))
+    await planner.make_adjustments(snap(kv=0.3, slo=0.7))
+    assert conn.calls == [("add", "backend")]
+    assert planner.decode_workers == 2
+
+
+async def test_scale_down_requires_slo_headroom():
+    cfg = PlannerConfig(grace_cycles=2, slo_target=0.9, slo_headroom=0.05)
+    planner, conn = driven(config=cfg, decode=3)
+    # kv says shrink, but attainment sits inside the headroom band
+    for _ in range(4):
+        await planner.make_adjustments(snap(kv=0.1, slo=0.92))
+    assert conn.calls == []
+    # with real headroom the shrink proceeds
+    await planner.make_adjustments(snap(kv=0.1, slo=0.97))
+    await planner.make_adjustments(snap(kv=0.1, slo=0.97))
+    assert conn.calls == [("remove", "backend")]
+
+
+async def test_slo_disabled_keeps_pure_watermark_behavior():
+    planner, conn = driven(decode=2)  # slo_target defaults to 0 (off)
+    await planner.make_adjustments(snap(kv=0.1, slo=0.0))
+    await planner.make_adjustments(snap(kv=0.1, slo=0.0))
+    assert conn.calls == [("remove", "backend")]  # attainment ignored
+
+
+# --- degradation ladder -----------------------------------------------------
+
+
+async def test_ladder_escalates_at_max_capacity_and_relaxes_after():
+    hooks = Hooks()
+    cfg = PlannerConfig(grace_cycles=2, max_decode=1, slo_target=0.9)
+    planner, conn = driven(config=cfg, hooks=hooks)
+    for _ in range(4):  # two grace windows at max capacity, breaching
+        await planner.make_adjustments(snap(kv=0.95, slo=0.5))
+    assert conn.calls == []  # can't scale: degrade instead
+    assert hooks.levels == [1, 2]
+    assert planner.degradation_level == 2
+    # headroom returns: unwind one rung per grace window
+    for _ in range(4):
+        await planner.make_adjustments(snap(kv=0.2, slo=1.0))
+    assert hooks.levels == [1, 2, 1, 0]
+    assert planner.degradation_level == 0
+
+
+# --- connector refusals (satellite) ----------------------------------------
+
+
+async def test_connector_refusal_resets_streak_and_rate_limits_warning(caplog):
+    conn = Grants(add_ok=False)
+    planner, _ = driven(config=PlannerConfig(grace_cycles=2), conn=conn)
+    with caplog.at_level(logging.WARNING, logger="dynamo_tpu.planner"):
+        for _ in range(5):
+            await planner.make_adjustments(snap(kv=0.95))
+    # refusals at cycle 2 and (after streak reset) cycle 4 — NOT 2,3,4,5
+    assert [c for c in conn.calls if c[0] == "add"] == [
+        ("add", "backend"), ("add", "backend"),
+    ]
+    assert planner.decode_workers == 1  # intent untouched by refusals
+    warnings = [r for r in caplog.records if "connector refused" in r.message]
+    assert len(warnings) == 1  # second refusal suppressed by the rate limit
+
+
+# --- self-healing reconciliation (satellite) --------------------------------
+
+
+async def test_reconciliation_replaces_externally_killed_worker():
+    cfg = PlannerConfig(grace_cycles=99, reconcile_cycles=2)
+    planner, conn = driven(config=cfg, decode=3)
+    await planner.make_adjustments(snap(kv=0.7, reporting=2))
+    assert conn.calls == []  # one missing cycle: not yet
+    await planner.make_adjustments(snap(kv=0.7, reporting=2))
+    assert conn.calls == [("add", "backend")]
+    assert planner.decode_workers == 3  # replacement, not a scale-up
+    assert planner.replacements_total == 1
+    # once reporting recovers, the streak clears and nothing more happens
+    await planner.make_adjustments(snap(kv=0.7, reporting=3))
+    await planner.make_adjustments(snap(kv=0.7, reporting=3))
+    assert len(conn.calls) == 1
+
+
+async def test_reconciliation_replaces_multiple_missing_workers():
+    cfg = PlannerConfig(grace_cycles=99, reconcile_cycles=1)
+    planner, conn = driven(config=cfg, decode=4)
+    await planner.make_adjustments(snap(kv=0.7, reporting=2))
+    assert conn.calls == [("add", "backend")] * 2
+    assert planner.replacements_total == 2
+
+
+async def test_reconciliation_waits_out_replacement_provisioning():
+    """A replacement the planner just ordered gets spawn_grace_cycles
+    to start reporting; only after the grace expires is the spawn
+    presumed dead and replaced again (no duplicate per slow spawn)."""
+    cfg = PlannerConfig(grace_cycles=99, reconcile_cycles=2,
+                        spawn_grace_cycles=4)
+    planner, conn = driven(config=cfg, decode=3)
+    await planner.make_adjustments(snap(kv=0.7, reporting=2))
+    await planner.make_adjustments(snap(kv=0.7, reporting=2))
+    assert len(conn.calls) == 1  # replacement ordered at cycle 2
+    # still not reporting, but within the provisioning grace: no dup
+    for _ in range(3):
+        await planner.make_adjustments(snap(kv=0.7, reporting=2))
+    assert len(conn.calls) == 1
+    # grace expired (cycle 6) -> presumed dead -> replaced again after
+    # the reconcile streak re-accumulates
+    for _ in range(3):
+        await planner.make_adjustments(snap(kv=0.7, reporting=2))
+    assert len(conn.calls) == 2
+    assert planner.replacements_total == 2
+
+
+async def test_scale_up_provisioning_does_not_look_like_a_loss():
+    """Right after a scale-up, reporting < intent is spawn latency,
+    not a dead worker — reconciliation must not order a duplicate."""
+    cfg = PlannerConfig(grace_cycles=1, reconcile_cycles=1,
+                        spawn_grace_cycles=5)
+    planner, conn = driven(config=cfg, decode=1)
+    await planner.make_adjustments(snap(kv=0.95, reporting=1))
+    assert conn.calls == [("add", "backend")]  # scale-up, intent 2
+    for _ in range(3):  # provisioning window: no spurious replacement
+        await planner.make_adjustments(snap(kv=0.7, reporting=1))
+    assert len(conn.calls) == 1
+    # the worker comes up: credit clears, later losses detect normally
+    await planner.make_adjustments(snap(kv=0.7, reporting=2))
+    await planner.make_adjustments(snap(kv=0.7, reporting=1))
+    assert conn.calls[-1] == ("add", "backend")
+    assert planner.replacements_total == 1
+
+
+async def test_reconciliation_drains_surplus_worker():
+    """A spawn that lands after a scale-down already raced past it (or
+    out-of-band capacity) leaves reporting > intent with no policy path
+    to remove it — reconciliation drains it, one per sustained
+    reconcile window, without touching intent."""
+    cfg = PlannerConfig(grace_cycles=99, reconcile_cycles=2)
+    planner, conn = driven(config=cfg, decode=2)
+    await planner.make_adjustments(snap(kv=0.7, reporting=3))
+    assert conn.calls == []  # one surplus cycle: not yet
+    await planner.make_adjustments(snap(kv=0.7, reporting=3))
+    assert conn.calls == [("remove", "backend")]
+    assert planner.decode_workers == 2  # intent untouched
+    # the drain landed: reporting matches intent, nothing more happens
+    await planner.make_adjustments(snap(kv=0.7, reporting=2))
+    await planner.make_adjustments(snap(kv=0.7, reporting=2))
+    assert len(conn.calls) == 1
+    # a transient surplus (stale metrics for one cycle) never drains
+    await planner.make_adjustments(snap(kv=0.7, reporting=3))
+    await planner.make_adjustments(snap(kv=0.7, reporting=2))
+    assert len(conn.calls) == 1
+
+
+async def test_reconciliation_drains_surplus_at_min_decode():
+    """The policy down-branch is clamped at min_decode, so only the
+    reconciliation drain can ever remove a surplus there."""
+    cfg = PlannerConfig(grace_cycles=99, reconcile_cycles=1, min_decode=1)
+    planner, conn = driven(config=cfg, decode=1)
+    await planner.make_adjustments(snap(kv=0.7, reporting=2))
+    assert conn.calls == [("remove", "backend")]
+    assert planner.decode_workers == 1
+
+
+async def test_reconciliation_disabled_or_unreported_is_inert():
+    planner, conn = driven(
+        config=PlannerConfig(grace_cycles=99, reconcile_cycles=0), decode=3
+    )
+    for _ in range(5):
+        await planner.make_adjustments(snap(kv=0.7, reporting=1))
+    assert conn.calls == []
+    planner2, conn2 = driven(
+        config=PlannerConfig(grace_cycles=99, reconcile_cycles=1), decode=3
+    )
+    await planner2.make_adjustments(snap(kv=0.7))  # no reporting key at all
+    assert conn2.calls == []
+
+
+async def test_streak_survives_signal_reset_on_scale(caplog):
+    """Scaling resets the watermark signal object; _Signal.observe math
+    stays monotone around it."""
+    sig = _Signal()
+    sig.observe(up=True, down=False)
+    sig.observe(up=True, down=False)
+    assert sig.up_streak == 2 and sig.down_streak == 0
+    sig.observe(up=False, down=True)
+    assert sig.up_streak == 0 and sig.down_streak == 1
+
+
+# --- degradation ladder wiring (planner/degradation.py) ---------------------
+
+
+def test_ladder_policy_math_matches_rung_semantics():
+    from dynamo_tpu.planner.degradation import LadderPolicy
+
+    p = LadderPolicy(queue_factor=0.5, kv_factor=0.95, shed_queue_depth=8)
+    assert p.admission_caps(100, 0.9, 0) == (100, 0.9)
+    assert p.admission_caps(100, 0.9, 1) == (50, pytest.approx(0.855))
+    assert p.admission_caps(100, 0.9, 2) == (50, pytest.approx(0.855))
+    assert p.admission_caps(100, 0.9, 3) == (8, pytest.approx(0.855))
+    assert p.admission_caps(1, 0.9, 1)[0] == 1  # floor, never zero
+    # a disabled cap (0) stays disabled when tightened...
+    assert p.admission_caps(0, 0.0, 1) == (0, 0.0)
+    # ...except the rung-3 shed line, which imposes itself on the queue
+    assert p.admission_caps(0, 0.0, 3) == (8, 0.0)
+    assert [p.spec_enabled(True, lvl) for lvl in range(4)] == [
+        True, True, False, False,
+    ]
+    assert not p.spec_enabled(False, 0)  # never re-enables a disabled base
+
+
+def test_serving_degradation_applies_and_restores():
+    from types import SimpleNamespace
+
+    from dynamo_tpu.http.admission import AdmissionConfig, AdmissionController
+    from dynamo_tpu.planner.degradation import ServingDegradation
+
+    admission = AdmissionController(
+        AdmissionConfig(max_queue_depth=100, max_kv_usage=0.9),
+        load_fn=lambda: None,
+    )
+    engine = SimpleNamespace(spec_suspended=False)
+    hooks = ServingDegradation(admission=admission, engine=engine)
+    hooks.set_level(1)
+    assert admission.config.max_queue_depth == 50
+    assert not engine.spec_suspended
+    hooks.set_level(2)
+    assert engine.spec_suspended
+    hooks.set_level(3)
+    assert admission.config.max_queue_depth == 32
+    assert admission.force_shed  # rung 3 binds even without load signals
+    hooks.set_level(0)  # full unwind restores the base caps + spec
+    assert admission.config.max_queue_depth == 100
+    assert admission.config.max_kv_usage == pytest.approx(0.9)
+    assert not admission.force_shed
+    assert not engine.spec_suspended
+
+
+def test_force_shed_sheds_signal_less_frontend_to_probe_trickle():
+    """Rung 3 on a frontend with no load signal must NOT fail open:
+    everything beyond the probe bucket gets 429 with reason=degraded."""
+    from dynamo_tpu.http.admission import AdmissionConfig, AdmissionController
+
+    t = [0.0]
+    admission = AdmissionController(
+        AdmissionConfig(probe_rate_per_s=1.0, probe_burst=1.0),
+        load_fn=lambda: None,
+        clock=lambda: t[0],
+    )
+    assert admission.check() is None  # fail-open by default
+    admission.force_shed = True
+    assert admission.check() is None  # the probe token
+    rej = admission.check()
+    assert rej is not None and rej.reason == "degraded"
+    t[0] += 1.0  # bucket refills: the trickle keeps flowing
+    assert admission.check() is None
+    admission.force_shed = False
+    assert admission.check() is None
+
+
+async def test_store_degradation_publishes_the_rung():
+    import asyncio
+    import json
+
+    from dynamo_tpu.planner.degradation import (
+        StoreDegradation,
+        degradation_key,
+    )
+
+    puts = []
+
+    class FakeStore:
+        async def kv_put(self, key, value, lease_id=0):
+            puts.append((key, value))
+            return 1
+
+    StoreDegradation(FakeStore(), "dynamo").set_level(2)
+    await asyncio.sleep(0)  # let the fire-and-forget publish task run
+    assert len(puts) == 1
+    key, value = puts[0]
+    assert key == degradation_key("dynamo")
+    body = json.loads(value)
+    assert body["level"] == 2
+    assert body["seq"] > 0  # ordering stamp for the watcher side
+
+
+async def test_watch_degradation_follows_snapshot_and_events():
+    import asyncio
+    import json
+    from types import SimpleNamespace
+
+    from dynamo_tpu.planner.degradation import (
+        ServingDegradation,
+        degradation_key,
+        watch_degradation,
+    )
+    from dynamo_tpu.store.base import KvEntry, WatchEvent
+
+    key = degradation_key("dynamo")
+
+    def entry(level, seq=None):
+        body = {"level": level}
+        if seq is not None:
+            body["seq"] = seq
+        return KvEntry(key, json.dumps(body).encode(), 1)
+
+    events = asyncio.Queue()
+
+    class FakeWatch:
+        def snapshot(self):
+            return [entry(1)]  # pre-existing rung applies immediately
+
+        def __aiter__(self):
+            return self
+
+        async def __anext__(self):
+            return await events.get()
+
+    class FakeStore:
+        async def watch_prefix(self, prefix):
+            assert prefix == key
+            return FakeWatch()
+
+    engine = SimpleNamespace(spec_suspended=False)
+    hooks = ServingDegradation(engine=engine)
+    task = asyncio.get_running_loop().create_task(
+        watch_degradation(FakeStore(), "dynamo", hooks)
+    )
+    try:
+        await asyncio.sleep(0)
+        assert hooks.level == 1  # from the snapshot
+        await events.put(WatchEvent("put", entry(2)))
+        await asyncio.sleep(0.01)
+        assert hooks.level == 2 and engine.spec_suspended
+        await events.put(WatchEvent("delete", entry(0)))
+        await asyncio.sleep(0.01)
+        assert hooks.level == 0 and not engine.spec_suspended
+        # a put delayed behind a store reconnect must not overwrite a
+        # newer rung: stale seq is ignored
+        await events.put(WatchEvent("put", entry(3, seq=50)))
+        await events.put(WatchEvent("put", entry(1, seq=40)))
+        await asyncio.sleep(0.01)
+        assert hooks.level == 3
+    finally:
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+
+
+# --- full sim replays -------------------------------------------------------
+
+
+def _acceptance_run():
+    """Bursty trace + composed seed-42 fault plan: a worker is killed
+    mid-run while bursts keep landing; the planner must detect it via
+    reconciliation and restore attainment. Returns (fleet, result)."""
+    trace = bursty_trace(
+        2800.0, seed=42, calm_rps=30.0, burst_rps=70.0,
+        mean_calm_s=120.0, mean_burst_s=30.0,
+    )
+    plan = parse_plan("seed=42;worker.liveness:kill@after=1200")
+    cfg = SimConfig(
+        initial_decode=4, initial_prefill=2, max_queue_depth=150,
+        slo_ttft_ms=3000.0, slo_itl_ms=60.0,
+    )
+    fleet = FleetSim(trace, cfg, plan=plan)
+    fleet.attach_planner(PlannerConfig(
+        adjustment_interval_s=20.0, grace_cycles=2, reconcile_cycles=2,
+        slo_target=0.9, min_decode=2, max_decode=8,
+        min_prefill=1, max_prefill=4,
+    ))
+    return fleet, fleet.run()
+
+
+def test_sim_replay_100k_requests_recovers_slo_and_is_bit_identical():
+    slo_target = 0.9
+    t0 = time.monotonic()
+    fleet_a, res_a = _acceptance_run()
+    fleet_b, res_b = _acceptance_run()
+    wall = time.monotonic() - t0
+    # scale + budget: >=100k simulated requests, both replays in <30s
+    assert res_a["requests"] >= 100_000, res_a["requests"]
+    assert wall < 30.0, f"two replays took {wall:.1f}s"
+    # the composed fault plan actually struck mid-run
+    assert res_a["workers_killed"] == 1
+    assert res_a["killed_inflight"] > 0
+    kill_t = fleet_a.faults.fired[0][0]
+    assert 0 < kill_t < 2800.0
+    # self-healing: reconciliation replaced the worker without help
+    assert res_a["planner"]["replacements"] >= 1
+    # ... and SLO attainment came back to target afterwards: the
+    # rolling window recovers within the post-kill horizon and holds
+    # at the end of the run
+    post_kill = [
+        s["slo_attainment_mean"]
+        for s in res_a["timeline"]
+        if kill_t + 60.0 <= s["ts"] <= kill_t + 400.0
+    ]
+    assert post_kill and max(post_kill) >= slo_target
+    assert res_a["final_window_attainment"] >= slo_target
+    # deterministic replay: two runs at the same seed are BIT-identical,
+    # timeline and all
+    assert res_a == res_b
+
+
+def test_sim_replay_scale_up_beats_frozen_fleet():
+    """Sanity on the closed loop itself: the same overload trace with
+    the planner frozen (min=max=initial) must do no better than the
+    autoscaled run on goodput."""
+    trace = bursty_trace(
+        900.0, seed=7, calm_rps=40.0, burst_rps=80.0,
+        mean_calm_s=90.0, mean_burst_s=45.0,
+    )
+
+    def run(autoscale):
+        cfg = SimConfig(initial_decode=2, initial_prefill=1,
+                        max_queue_depth=150, slo_ttft_ms=3000.0)
+        fleet = FleetSim(trace, cfg)
+        fleet.attach_planner(PlannerConfig(
+            adjustment_interval_s=20.0, grace_cycles=2,
+            slo_target=0.9, min_decode=2,
+            max_decode=8 if autoscale else 2,
+            min_prefill=1, max_prefill=4,
+        ))
+        return fleet.run()
+
+    frozen = run(autoscale=False)
+    scaled = run(autoscale=True)
+    assert scaled["goodput_tokens"] > frozen["goodput_tokens"]
+    # the loop actually scaled into the bursts (and back down after —
+    # the run ends in a calm drain, so the FINAL count is small again)
+    peak = max(
+        s["decode_workers_reporting"] for s in scaled["timeline"]
+    )
+    assert peak > 2

@@ -1,0 +1,269 @@
+"""Discrete-event simulator internals (dynamo_tpu/sim): virtual clock
+ordering, trace generator determinism, the worker service-time model,
+FaultPlan re-evaluation at sim time, and fleet-level admission/
+degradation behavior. The planner-in-the-loop replay tests live in
+tests/test_planner.py."""
+
+import pytest
+
+from dynamo_tpu.faults.plan import parse_plan
+from dynamo_tpu.sim import (
+    FleetSim,
+    LengthModel,
+    SimClock,
+    SimConfig,
+    SimFaultDriver,
+    SimLoop,
+    SimWorker,
+    WorkerProfile,
+    bursty_trace,
+    diurnal_trace,
+    drive,
+    merge_traces,
+)
+
+# --- core ------------------------------------------------------------------
+
+
+def test_sim_loop_orders_events_and_breaks_ties_by_schedule_order():
+    loop = SimLoop()
+    seen = []
+    loop.at(2.0, seen.append, "b")
+    loop.at(1.0, seen.append, "a")
+    loop.at(2.0, seen.append, "c")  # same t as "b": schedule order wins
+    loop.run()
+    assert seen == ["a", "b", "c"]
+    assert loop.now == 2.0
+
+
+def test_sim_loop_after_and_until():
+    loop = SimLoop()
+    seen = []
+    loop.after(5.0, seen.append, 1)
+    loop.after(15.0, seen.append, 2)
+    loop.run(until=10.0)
+    assert seen == [1] and loop.now == 10.0
+    loop.run()
+    assert seen == [1, 2] and loop.now == 15.0
+
+
+def test_events_scheduled_in_the_past_clamp_to_now():
+    loop = SimLoop()
+    seen = []
+
+    def late():
+        loop.at(0.0, seen.append, "clamped")  # the past is not schedulable
+
+    loop.at(3.0, late)
+    loop.run()
+    assert seen == ["clamped"] and loop.now == 3.0
+
+
+def test_sim_clock_refuses_to_sleep():
+    clock = SimClock(SimLoop())
+    with pytest.raises(RuntimeError):
+        drive(clock.sleep(1.0))
+
+
+def test_drive_rejects_coroutines_that_actually_await():
+    class _Pending:
+        def __await__(self):
+            yield
+
+    async def pends():
+        await _Pending()
+
+    async def immediate():
+        return 42
+
+    assert drive(immediate()) == 42
+    with pytest.raises(RuntimeError):
+        drive(pends())
+
+
+# --- traces ----------------------------------------------------------------
+
+
+def test_traces_are_deterministic_and_sorted():
+    a = diurnal_trace(600.0, seed=7)
+    b = diurnal_trace(600.0, seed=7)
+    assert a == b and len(a) > 100
+    assert all(x.t <= y.t for x, y in zip(a, a[1:]))
+    c = diurnal_trace(600.0, seed=8)
+    assert a != c  # seed actually matters
+
+    d = bursty_trace(600.0, seed=7)
+    e = bursty_trace(600.0, seed=7)
+    assert d == e and len(d) > 100
+    assert all(x.t <= y.t for x, y in zip(d, d[1:]))
+
+
+def test_length_model_clamps_heavy_tail():
+    lm = LengthModel(prompt_max=512, output_max=256)
+    trace = diurnal_trace(1200.0, seed=3, lengths=lm)
+    prompts = [r.prompt_tokens for r in trace]
+    outputs = [r.output_tokens for r in trace]
+    assert max(prompts) <= 512 and min(prompts) >= lm.prompt_min
+    assert max(outputs) <= 256 and min(outputs) >= lm.output_min
+    # heavy tail: p99 well above the median
+    prompts.sort()
+    assert prompts[int(0.99 * len(prompts))] > 2 * prompts[len(prompts) // 2]
+
+
+def test_bursty_trace_actually_bursts():
+    tr = bursty_trace(
+        1200.0, seed=11, calm_rps=5.0, burst_rps=80.0,
+        mean_calm_s=60.0, mean_burst_s=20.0,
+    )
+    # per-10s arrival counts must span calm (<~100/10s) and burst rates
+    buckets = [0] * 120
+    for r in tr:
+        buckets[min(119, int(r.t // 10))] += 1
+    assert min(buckets) < 200 and max(buckets) > 400
+
+
+def test_merge_traces_reassigns_ordered_unique_rids():
+    a = diurnal_trace(300.0, seed=1)
+    b = bursty_trace(300.0, seed=2)
+    m = merge_traces(a, b)
+    assert len(m) == len(a) + len(b)
+    assert [r.rid for r in m] == list(range(len(m)))
+    assert all(x.t <= y.t for x, y in zip(m, m[1:]))
+
+
+# --- worker model ----------------------------------------------------------
+
+
+def test_worker_admission_bounds_slots_and_kv():
+    prof = WorkerProfile(batch_slots=2, kv_blocks=10, block_size=128)
+    w = SimWorker(0, prof)
+    blocks = prof.blocks_for(128, 128, spec_on=False)
+    assert blocks == 2
+    assert w.can_admit(blocks)
+    w.admit(1, blocks)
+    w.admit(2, blocks)
+    assert not w.can_admit(blocks)  # slots exhausted
+    w.release(1)
+    assert w.can_admit(blocks)
+    assert not w.can_admit(9)  # kv exhausted (4 used + 9 > 10)
+
+
+def test_worker_itl_grows_with_occupancy_and_spec_speeds_it_up():
+    prof = WorkerProfile(decode_tok_s_max=2000.0, n_half=16)
+    w = SimWorker(0, prof)
+    idle = w.itl_s(0.0, spec_on=False)
+    for i in range(32):
+        w.admit(i, 1)
+    loaded = w.itl_s(0.0, spec_on=False)
+    assert loaded > idle
+    assert w.itl_s(0.0, spec_on=True) < loaded
+    w.slow_until = 10.0
+    w.slow_factor = 4.0
+    assert w.itl_s(5.0, spec_on=False) == pytest.approx(4 * loaded)
+    assert w.itl_s(15.0, spec_on=False) == pytest.approx(loaded)
+
+
+def test_spec_charges_kv_overhead():
+    prof = WorkerProfile(spec_kv_overhead_blocks=1)
+    assert (
+        prof.blocks_for(128, 128, spec_on=True)
+        == prof.blocks_for(128, 128, spec_on=False) + 1
+    )
+
+
+# --- fault driver ----------------------------------------------------------
+
+
+def test_sim_fault_driver_matches_plan_semantics():
+    plan = parse_plan("seed=5;worker.liveness:kill@after=3@max=1")
+    drv = SimFaultDriver(plan)
+    fires = [bool(drv.due(float(i), "worker.liveness")) for i in range(8)]
+    # after=3 skips the first three passes; max=1 stops after one fire
+    assert fires == [False, False, False, True, False, False, False, False]
+    assert drv.fired == [(3.0, "worker.liveness", "kill")]
+
+
+def test_sim_fault_driver_probability_streams_are_seeded():
+    plan = parse_plan("seed=42;engine.step:delay=0.5@p=0.3")
+    a = SimFaultDriver(plan)
+    b = SimFaultDriver(plan)
+    pattern_a = [bool(a.due(i, "engine.step")) for i in range(200)]
+    pattern_b = [bool(b.due(i, "engine.step")) for i in range(200)]
+    assert pattern_a == pattern_b
+    assert 20 < sum(pattern_a) < 100  # ~30% of 200
+
+
+def test_sim_fault_driver_match_scopes_to_context():
+    plan = parse_plan("seed=1;http.request:error@match=sim-7")
+    drv = SimFaultDriver(plan)
+    assert not drv.due(0.0, "http.request", rid="sim-1")
+    assert drv.due(0.0, "http.request", rid="sim-7")
+
+
+# --- fleet -----------------------------------------------------------------
+
+
+def _light_trace(n=200, seed=3):
+    return diurnal_trace(
+        200.0, seed=seed, base_rps=1.0, peak_rps=2.0, period_s=200.0
+    )[:n]
+
+
+def test_fleet_completes_everything_under_light_load():
+    res = FleetSim(_light_trace(), SimConfig(initial_decode=2)).run()
+    assert res["requests"] == res["completed"]
+    assert res["shed"] == 0 and res["unfinished"] == 0
+    assert res["slo_attainment"] == 1.0
+    assert res["goodput_tokens"] > 0
+
+
+def test_fleet_sheds_under_flood_and_admitted_requests_still_meet_slo():
+    # 200 rps into one worker: admission must shed, and what IS admitted
+    # must still be served within target (the Tail-at-Scale contract)
+    trace = bursty_trace(
+        60.0, seed=9, calm_rps=200.0, burst_rps=200.0, mean_calm_s=1e9,
+    )
+    res = FleetSim(
+        trace,
+        SimConfig(initial_decode=1, max_queue_depth=40, slo_ttft_ms=4000.0),
+    ).run()
+    assert res["shed"] > 100
+    assert res["completed"] > 0
+    assert res["slo_attainment"] > 0.8
+
+
+def test_degradation_ladder_tightens_admission_and_disables_spec():
+    fleet = FleetSim(_light_trace(), SimConfig(max_queue_depth=100))
+    base_queue = fleet.admission.config.max_queue_depth
+    fleet.set_level(1)
+    assert fleet.admission.config.max_queue_depth < base_queue
+    assert fleet.spec_enabled
+    fleet.set_level(2)
+    assert not fleet.spec_enabled
+    fleet.set_level(3)
+    assert fleet.admission.config.max_queue_depth <= fleet.config.shed_queue_depth
+    fleet.set_level(0)
+    assert fleet.admission.config.max_queue_depth == base_queue
+    assert fleet.spec_enabled
+
+
+def test_http_request_faults_fail_or_delay_requests():
+    trace = _light_trace(100)
+    plan = parse_plan("seed=2;http.request:error@max=5")
+    res = FleetSim(trace, SimConfig(initial_decode=2), plan=plan).run()
+    assert res["failed_frontend"] == 5
+    assert res["completed"] == res["requests"] - 5
+
+
+def test_worker_kill_drops_inflight_and_frees_nothing_twice():
+    trace = diurnal_trace(
+        120.0, seed=4, base_rps=10.0, peak_rps=10.0, period_s=120.0
+    )
+    plan = parse_plan("seed=2;worker.liveness:kill@after=30")
+    res = FleetSim(trace, SimConfig(initial_decode=2), plan=plan).run()
+    assert res["workers_killed"] == 1
+    assert res["killed_inflight"] > 0
+    assert res["decode_workers_final"] == 1  # nobody heals a planner-less fleet
+    assert res["completed"] + res["killed_inflight"] + res["shed"] + res[
+        "unfinished"
+    ] == res["requests"]

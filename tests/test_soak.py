@@ -27,9 +27,12 @@ async def echo_stream(request: Any, ctx: Context) -> AsyncIterator[Any]:
 async def test_soak_request_churn_no_leaks():
     server = StoreServer(MemoryStore(lease_sweep_interval_s=0.1), port=0)
     await server.start()
+    # generous TTL: this test measures churn/leaks, not lease expiry — a
+    # multi-second scheduler stall under full-suite load must not kill the
+    # worker's lease (lost lease => runtime shutdown => 300s router hang)
     cfg = lambda: RuntimeConfig(  # noqa: E731
         store_host="127.0.0.1", store_port=server.port,
-        worker_host="127.0.0.1", lease_ttl_s=2.0, lease_keepalive_s=0.5,
+        worker_host="127.0.0.1", lease_ttl_s=30.0, lease_keepalive_s=0.5,
     )
     worker = await DistributedRuntime.create(config=cfg())
     frontend = await DistributedRuntime.create(config=cfg())
