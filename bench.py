@@ -30,6 +30,15 @@ DYN_BENCH_SPEC_DRAFTER (default "ngram"), DYN_BENCH_SPEC_TOKENS
 (default 4). Repetitive prompts (the self-drafting sweet spot) via
 DYN_BENCH_SPEC_REPEAT=1 — the default keeps the standard random-prompt
 workload, where the reported accept rate is an honest floor.
+
+``--overlap`` is the serial-vs-overlap A/B (docs/performance.md): the
+same workload at decode_steps=1 runs once with --no-overlap (fully
+serial plan -> dispatch -> sync -> emit) and once with the overlapped
+decode pipeline; vs_baseline = overlap/serial throughput, and both
+sides report device_idle_frac so the attribution is measured, not
+asserted. The headline run also emits device_idle_frac + per-step
+overlap stats in its config; DYN_BENCH_OVERLAP=0 forces the serial
+loop there (the escape hatch A/B at the headline decode_steps).
 """
 
 from __future__ import annotations
@@ -126,10 +135,18 @@ def _kv_bytes_per_token(mc) -> float:
 
 async def _run(
     model_cfg, wl, spec: bool = False, decode_steps=None, slo=None,
+    overlap: bool = True,
 ) -> dict:
     """``slo`` = (ttft_ms, itl_ms) targets; when set, the result dict
     gains slo_attainment / goodput_tokens / requests_met from the
-    engine's SloTracker (the --chaos mode's scoreboard)."""
+    engine's SloTracker (the --chaos mode's scoreboard).
+
+    ``overlap=False`` runs the fully serial step loop (the --no-overlap
+    escape hatch) — the A/B baseline for _main_overlap_ab. Every run
+    reports ``device_idle_frac``: the OverlapTracker's idle-gap growth
+    over the measured window divided by wall time (0.0 = the device
+    always had a dispatched step to chew on; the serial loop's value is
+    exactly the host plan+unpack+emit share the pipeline removes)."""
     if os.environ.get("DYN_STEP_TRACE"):
         # step-trace forensics print via logging.INFO; the bench is a
         # bare script, so wire a handler or the trace silently drops
@@ -172,6 +189,7 @@ async def _run(
             os.environ.get("DYN_BENCH_SPEC_DRAFTER", "ngram") if spec else ""
         ),
         spec_tokens=int(os.environ.get("DYN_BENCH_SPEC_TOKENS", "4")),
+        overlap=overlap,
         hbm_utilization=0.7,
         slo_ttft_ms=(slo[0] if slo else None),
         slo_itl_ms=(slo[1] if slo else None),
@@ -233,9 +251,11 @@ async def _run(
     await asyncio.gather(*[one_request(9000 + i) for i in range(wl["batch"])])
     print("# warmup done; measuring", file=sys.stderr, flush=True)
 
+    idle0 = engine.overlap.stats()
     t0 = time.monotonic()
     results = await asyncio.gather(*[one_request(i) for i in range(wl["batch"])])
     t1 = time.monotonic()
+    idle1 = engine.overlap.stats()
     total_tokens = sum(r[2] for r in results)
     ttfts = [r[1] - r[0] for r in results]
     # per-token ITL samples across all requests: each inter-chunk gap
@@ -254,12 +274,38 @@ async def _run(
     step_bytes = _param_bytes(model_cfg, wl["quant"]) + wl["batch"] * avg_ctx * _kv_bytes_per_token(model_cfg)
     roofline_tput = wl["batch"] / (step_bytes / HBM_BW_BYTES)
 
+    # device-idle attribution over the MEASURED window only (warmup
+    # compiles would otherwise swamp the number): the fraction of wall
+    # time the device provably sat without a dispatched step while the
+    # host did serial work (telemetry/overlap.py — a host-observable
+    # lower bound; exact for the serial loop)
+    idle_s = idle1["idle_gap_s_total"] - idle0["idle_gap_s_total"]
+    steps = idle1["steps_dispatched"] - idle0["steps_dispatched"]
+    overlap_stats = {
+        "device_idle_frac": round(max(0.0, idle_s) / max(wall, 1e-9), 4),
+        "idle_gap_s_total": round(max(0.0, idle_s), 4),
+        "steps_dispatched": steps,
+        "idle_gap_ms_per_step": round(
+            max(0.0, idle_s) * 1e3 / max(steps, 1), 3
+        ),
+        # the tracker's max is lifetime-wide: report it only when it
+        # GREW during the window (the new max happened in-measurement);
+        # 0.0 otherwise, so a warmup-era gap never masquerades as the
+        # measured run's worst step
+        "max_idle_gap_ms": (
+            idle1["max_idle_gap_ms"]
+            if idle1["max_idle_gap_ms"] > idle0["max_idle_gap_ms"]
+            else 0.0
+        ),
+        "overlap_enabled": overlap,
+    }
     spec_proposed = engine.spec_proposed_total
     spec_accepted = engine.spec_accepted_total
     slo_stats = engine.slo.stats()
     await engine.shutdown()
     return {
         "slo": slo_stats,
+        "overlap": overlap_stats,
         "tput": tput,
         "p50_ttft_s": _percentile(ttfts, 50),
         "p90_ttft_s": _percentile(ttfts, 90),
@@ -327,6 +373,57 @@ def _main_spec_ab(model_cfg, wl) -> None:
         f"# spec A/B: plain={base['tput']:.1f} spec={spec['tput']:.1f} tok/s "
         f"accept={out['config']['accept_rate']:.2%} "
         f"({accepted}/{proposed} drafts)",
+        file=sys.stderr,
+    )
+
+
+def _main_overlap_ab(model_cfg, wl) -> None:
+    """--overlap: serial-vs-overlap A/B at decode_steps=1 — the shape
+    where the host's per-step plan+unpack+emit time is fully exposed,
+    so the pipeline's contribution is attributable. vs_baseline is
+    overlap/serial throughput on the identical workload; both sides
+    report device_idle_frac (the serial side's value IS the host share
+    the pipeline exists to hide — if it were ~0 there would be nothing
+    to win and the A/B honestly reports that)."""
+    serial = asyncio.run(
+        _run(model_cfg, wl, decode_steps=1, overlap=False)
+    )
+    over = asyncio.run(_run(model_cfg, wl, decode_steps=1, overlap=True))
+    out = {
+        "metric": "engine_overlap_decode_ab_1chip",
+        "value": round(over["tput"], 2),
+        "unit": "tokens/sec",
+        # overlapped pipeline vs the serial loop on the identical
+        # workload: > 1.0 means the double-buffered host schedule
+        # converted device idle gaps into tokens
+        "vs_baseline": round(over["tput"] / max(serial["tput"], 1e-9), 4),
+        "config": {
+            "model": wl["model_name"],
+            "batch": wl["batch"],
+            "isl": wl["isl"],
+            "osl": wl["osl"],
+            "serial_tok_s": round(serial["tput"], 2),
+            "overlap_tok_s": round(over["tput"], 2),
+            "serial_device_idle_frac":
+                serial["overlap"]["device_idle_frac"],
+            "overlap_device_idle_frac":
+                over["overlap"]["device_idle_frac"],
+            "serial_idle_gap_ms_per_step":
+                serial["overlap"]["idle_gap_ms_per_step"],
+            "overlap_idle_gap_ms_per_step":
+                over["overlap"]["idle_gap_ms_per_step"],
+            "p50_itl_ms_serial": round(serial["p50_itl_s"] * 1000, 2),
+            "p50_itl_ms_overlap": round(over["p50_itl_s"] * 1000, 2),
+            "p99_itl_ms_serial": round(serial["p99_itl_s"] * 1000, 2),
+            "p99_itl_ms_overlap": round(over["p99_itl_s"] * 1000, 2),
+        },
+    }
+    print(json.dumps(out))
+    print(
+        f"# overlap A/B: serial={serial['tput']:.1f} "
+        f"overlap={over['tput']:.1f} tok/s, device_idle_frac "
+        f"{serial['overlap']['device_idle_frac']:.3f} -> "
+        f"{over['overlap']['device_idle_frac']:.3f}",
         file=sys.stderr,
     )
 
@@ -533,7 +630,11 @@ def main() -> None:
     if "--chaos" in sys.argv[1:]:
         _main_chaos_ab(model_cfg, wl)
         return
-    r = asyncio.run(_run(model_cfg, wl))
+    if "--overlap" in sys.argv[1:]:
+        _main_overlap_ab(model_cfg, wl)
+        return
+    headline_overlap = os.environ.get("DYN_BENCH_OVERLAP", "1") != "0"
+    r = asyncio.run(_run(model_cfg, wl, overlap=headline_overlap))
     out = {
         "metric": "engine_decode_throughput_1chip",
         "value": round(r["tput"], 2),
@@ -551,6 +652,15 @@ def main() -> None:
             "isl": wl["isl"],
             "osl": wl["osl"],
             "decode_steps": int(os.environ.get("DYN_BENCH_DECODE_STEPS", "64")),
+            # overlapped-pipeline attribution (ISSUE 7): the device-idle
+            # share of the measured wall plus per-step overlap stats —
+            # movement in the headline number is attributable to the
+            # pipeline only if this fraction moved with it
+            "overlap": r["overlap"]["overlap_enabled"],
+            "device_idle_frac": r["overlap"]["device_idle_frac"],
+            "idle_gap_ms_per_step": r["overlap"]["idle_gap_ms_per_step"],
+            "max_idle_gap_ms": r["overlap"]["max_idle_gap_ms"],
+            "steps_dispatched": r["overlap"]["steps_dispatched"],
             "p50_ttft_ms": round(r["p50_ttft_s"] * 1000, 1),
             # tails (ISSUE 4 satellite): the serving story lives in the
             # p90/p99, not the median — BENCH_* files must capture them
@@ -567,7 +677,8 @@ def main() -> None:
         f"ttft p50/p90/p99={r['p50_ttft_s'] * 1000:.0f}/"
         f"{r['p90_ttft_s'] * 1000:.0f}/{r['p99_ttft_s'] * 1000:.0f}ms "
         f"itl p50/p99={r['p50_itl_s'] * 1000:.1f}/"
-        f"{r['p99_itl_s'] * 1000:.1f}ms roofline={r['roofline']:.0f} tok/s",
+        f"{r['p99_itl_s'] * 1000:.1f}ms roofline={r['roofline']:.0f} tok/s "
+        f"device_idle_frac={r['overlap']['device_idle_frac']:.3f}",
         file=sys.stderr,
     )
 

@@ -6,6 +6,8 @@ Keys (all optional):
   exclude       — path prefixes/globs skipped during the walk
   disable       — rule names turned off globally
   hot-functions — extra function names treated as jit hot paths (DL004)
+  step-loop-functions — function names treated as the engine step loop
+                  by hidden-host-sync-in-step-loop (DL010)
 
 Parsing uses stdlib ``tomllib`` when present (3.11+), else the vendored
 ``tomli`` this environment ships; with neither, config silently falls
@@ -23,6 +25,7 @@ DEFAULTS: dict[str, Any] = {
     "exclude": [],
     "disable": [],
     "hot-functions": [],
+    "step-loop-functions": [],
 }
 
 

@@ -12,6 +12,7 @@ from dynamo_tpu.analysis.rules import (  # noqa: F401
     bare_except,
     blocking_async,
     dropped_task,
+    hidden_sync,
     host_sync_jit,
     retry_loop,
     swallowed_cancel,

@@ -1,0 +1,120 @@
+"""DL010 hidden-host-sync-in-step-loop: device→host synchronization in
+the engine step loop anywhere but the designated harvest point.
+
+The overlapped decode pipeline (docs/performance.md) only hides host
+work behind device execution if the step loop's ONE sync happens at its
+harvest point — a function whose name marks it as such. Any other
+``jax.block_until_ready(...)`` / ``.block_until_ready()`` / ``.item()``
+/ ``.tolist()`` / ``np.asarray``/``np.array`` / ``jax.device_get`` /
+``host_value`` (the house sync primitive, parallel/multihost.py)
+inside the loop silently re-serializes the pipeline: the host parks on
+the device mid-plan, the device then parks on the host mid-step, and
+the idle gap the pipeline exists to remove comes back — invisibly,
+because the code still computes the right answer. (This is the runtime
+twin of DL004, which guards the *inside* of jit-compiled functions;
+DL010 guards the host loop that drives them.)
+
+Scope is name-structural, like DL009: a function is part of the step
+loop when its name contains ``step_loop`` (the engine's loop itself) or
+appears in the ``step-loop-functions`` config list ([tool.dynalint] —
+seeded with the engine's dispatch/pipeline entry points). Nested defs
+inside a scoped function are the loop's helper closures and stay in
+scope — EXCEPT functions whose name contains ``harvest``, the
+designated sync point, which are exempt along with everything they
+alone contain. ``np.asarray`` on an already-host array is flagged too:
+inside the step loop it is at best a redundant copy and at worst a
+hidden sync the next refactor trips over — move the materialization to
+the harvest function either way.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.analysis.registry import LintModule, rule
+from dynamo_tpu.analysis.rules.common import dotted_name
+
+SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+SYNC_CALLS = {
+    "np.asarray",
+    "np.array",
+    "numpy.asarray",
+    "numpy.array",
+    "jax.device_get",
+    "jax.block_until_ready",
+    # the house sync primitive (parallel/multihost.py): the step loop's
+    # harvest functions call it; anywhere else it IS the hidden sync
+    "host_value",
+    "multihost.host_value",
+}
+
+
+def _is_harvest(name: str) -> bool:
+    return "harvest" in name
+
+
+def _in_scope(name: str, extra: set[str]) -> bool:
+    return "step_loop" in name or name in extra
+
+
+@rule(
+    "hidden-host-sync-in-step-loop",
+    "DL010",
+    "device sync (.item/np.asarray/block_until_ready) in the engine "
+    "step loop outside the designated harvest point — re-serializes "
+    "the overlapped decode pipeline",
+)
+def check(module: LintModule):
+    findings: list[tuple[ast.AST, str]] = []
+    extra = set(module.config.get("step-loop-functions", []))
+
+    def scan(fn: ast.AST) -> None:
+        """Flag sync calls in ``fn`` and its nested defs, skipping any
+        nested subtree whose def is harvest-named (the designated sync
+        point scopes apart, including its own nested helpers)."""
+
+        def walk(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and _is_harvest(child.name):
+                    continue
+                if isinstance(child, ast.Call):
+                    name = dotted_name(child.func) or ""
+                    if name in SYNC_CALLS:
+                        findings.append(
+                            (
+                                child,
+                                f"`{name}(...)` syncs device->host inside "
+                                "the engine step loop — move the "
+                                "materialization to the designated "
+                                "harvest function so the overlapped "
+                                "pipeline keeps the device fed",
+                            )
+                        )
+                    elif (
+                        isinstance(child.func, ast.Attribute)
+                        and child.func.attr in SYNC_ATTRS
+                    ):
+                        findings.append(
+                            (
+                                child,
+                                f"`.{child.func.attr}()` syncs device->"
+                                "host inside the engine step loop "
+                                "outside the designated harvest point — "
+                                "it re-serializes the overlapped "
+                                "pipeline",
+                            )
+                        )
+                walk(child)
+
+        walk(fn)
+
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if _is_harvest(node.name):
+            continue
+        if _in_scope(node.name, extra):
+            scan(node)
+    return findings

@@ -84,6 +84,12 @@ def build_parser() -> argparse.ArgumentParser:
                      help="max draft tokens verified per sequence per "
                           "step (K); each decode step then emits 1..K+1 "
                           "tokens per sequence")
+    run.add_argument("--no-overlap", action="store_true",
+                     help="disable the overlapped decode pipeline "
+                          "(docs/performance.md): restores the fully "
+                          "serial plan -> dispatch -> sync -> emit step "
+                          "loop. Escape hatch + A/B baseline; greedy "
+                          "output is bit-identical either way")
     run.add_argument("--mixed-prefill-rows", type=int, default=8,
                      help="mixed continuous batching (needs "
                           "--decode-steps > 1): pending prefill chunks "

@@ -128,6 +128,20 @@ class EngineConfig:
     # tuning and accept-rate interpretation.
     spec_decode: str = ""
     spec_tokens: int = 4
+    # overlapped decode pipeline (docs/performance.md): double-buffer
+    # host scheduling against device execution so the only hot-path
+    # sync waits on a result that is already (or nearly) done. At
+    # decode_steps == 1 the plain decode loop runs dispatch(N+1) —
+    # token column chained on device — before harvesting step N; at
+    # decode_steps > 1 the cohort prefill dispatch additionally chains
+    # its first tokens straight into the first decode window instead of
+    # hard-syncing between the two. Greedy output is bit-identical with
+    # overlap on or off (the compute is the same program over the same
+    # values; only the host's position in the timeline moves).
+    # False (--no-overlap) restores the fully serial
+    # plan -> dispatch -> sync -> emit loop — the escape hatch and the
+    # A/B baseline (bench.py --overlap).
+    overlap: bool = True
     # explicit MID decode bucket override (None = auto: pad/2 when the
     # pad is >= 64). Deployments whose steady population sits well
     # under max_batch_size (e.g. long-context residency caps) can pin
@@ -232,6 +246,7 @@ def load_engine_config(args: Any) -> EngineConfig:
         ),
         spec_decode=getattr(args, "spec_decode", "") or "",
         spec_tokens=getattr(args, "spec_tokens", EngineConfig.spec_tokens),
+        overlap=not getattr(args, "no_overlap", False),
         host_kv_blocks=getattr(args, "host_kv_blocks", 0),
         disk_kv_blocks=getattr(args, "disk_kv_blocks", 0),
         disk_kv_path=getattr(args, "disk_kv_path", ""),

@@ -85,6 +85,7 @@ from dynamo_tpu.telemetry.instruments import (
     SPEC_PROPOSED_TOKENS,
     SPEC_STEP_SECONDS,
 )
+from dynamo_tpu.telemetry.overlap import OverlapTracker
 from dynamo_tpu.telemetry.recorder import FlightRecorder
 from dynamo_tpu.telemetry.slo import SloConfig, SloTracker
 from dynamo_tpu.tokens import DEFAULT_SALT, TokenBlockSequence
@@ -148,6 +149,26 @@ class ForwardPassMetrics:
         return self.__dict__.copy()
 
 
+
+def _lag_add(lag: dict, entry: dict) -> None:
+    """Charge an in-flight entry to a pipeline's lag ledger: ``vmap``
+    maps id(seq) -> tokens the entry will add, sampled on device but
+    not yet applied to host state (both pipelined step loops share
+    this invariant — scheduler.plan_pipelined_* read the same map)."""
+    for sid, v in entry["vmap"].items():
+        lag[sid] = lag.get(sid, 0) + v
+
+
+def _lag_sub(lag: dict, entry: dict) -> None:
+    """Release a harvested entry's charges from the lag ledger."""
+    for sid, v in entry["vmap"].items():
+        left = lag.get(sid, 0) - v
+        if left > 0:
+            lag[sid] = left
+        else:
+            lag.pop(sid, None)
+
+
 class JaxEngine:
     def __init__(self, config: EngineConfig):
         self.config = config
@@ -164,6 +185,8 @@ class JaxEngine:
         self._step_fn_mm: Optional[Callable] = None
         self._multi_step_fn: Optional[Callable] = None
         self._mixed_step_fn: Optional[Callable] = None
+        self._chain_next_fn: Optional[Callable] = None
+        self._pack_pair_fn: Optional[Callable] = None
         # wide mixed rectangle (rows, len), set when enabled (see
         # _initialize; scheduler._mixed_rect picks per population)
         self._wide_rect: Optional[tuple[int, int]] = None
@@ -218,10 +241,18 @@ class JaxEngine:
                 capacity=config.flight_recorder_steps,
                 slow_step_s=slow_ms / 1e3 if slow_ms else None,
                 dump_dir=config.flight_dump_dir,
+                # a device idle gap as long as a slow step is the same
+                # anomaly spent on the host side of the pipeline
+                idle_gap_slow_s=slow_ms / 1e3 if slow_ms else None,
             )
             if config.flight_recorder_steps > 0
             else None
         )
+        # overlapped decode pipeline (docs/performance.md): device
+        # idle-gap accounting feeding the flight recorder's
+        # idle_gap_ms stamps, /debug/state "overlap", and bench.py's
+        # device_idle_frac. Engine-thread only.
+        self.overlap = OverlapTracker()
         self.slo = SloTracker(
             SloConfig(ttft_ms=config.slo_ttft_ms, itl_ms=config.slo_itl_ms)
         )
@@ -777,6 +808,7 @@ class JaxEngine:
         # ones XLA's canonical output sharding — a different jit
         # signature. Pass 2 ensures every shape is compiled against the
         # steady-state sharding (cache hit if they're equal).
+        p_outs: dict[int, tuple] = {}  # base-variant prefill outputs
         for _ in range(2):
             for chunk in chunks:
                 for b in sched.prefill_batch_buckets:
@@ -799,6 +831,9 @@ class JaxEngine:
                             s.arrays,
                         )
                         self.k_cache, self.v_cache = out[-2], out[-1]
+                        if not (pv or tv or bv):
+                            # retained for the overlap-glue warm below
+                            p_outs[b] = out[:2]
                         jax.block_until_ready(self.k_cache)
         decode_buckets = sorted(
             {b for b in (sched.decode_batch_small, sched.decode_batch_mid,
@@ -837,6 +872,53 @@ class JaxEngine:
                     )
                     self.k_cache, self.v_cache = out[-2], out[-1]
                     jax.block_until_ready(self.k_cache)
+        if (
+            self._multi_step_fn is None
+            and self._drafter is None
+            and self._overlap_ok()
+        ):
+            # overlapped decode pipeline variants (docs/performance.md):
+            # the chained dispatch feeds the previous step's DEVICE
+            # token column — a committed device array is a different
+            # jit signature than host numpy — plus the packed harvest
+            # and the chain gathers, including bucket transitions for a
+            # shrinking population. An unwarmed variant is a mid-serve
+            # compile.
+            toks_by_bucket: dict[int, Any] = {}
+            for Bd in decode_buckets:
+                a = decode_arrays(Bd)
+                s = sampling_for(Bd)
+                out = self._step_fn(
+                    self.params, self.k_cache, self.v_cache,
+                    a["tokens"], a["positions"], a["slot_mapping"],
+                    a["block_tables"], a["context_lens"],
+                    a["last_token_idx"], s.arrays,
+                )
+                self.k_cache, self.v_cache = out[-2], out[-1]
+                col = self._chain_next_fn(out[0], np.zeros((Bd,), np.int32))
+                out = self._step_fn(
+                    self.params, self.k_cache, self.v_cache,
+                    col, a["positions"], a["slot_mapping"],
+                    a["block_tables"], a["context_lens"],
+                    a["last_token_idx"], s.arrays,
+                )
+                self.k_cache, self.v_cache = out[-2], out[-1]
+                jax.block_until_ready(self._pack_pair_fn(out[0], out[1]))
+                toks_by_bucket[Bd] = out[0]
+            for b_from, tok in toks_by_bucket.items():
+                for b_to in decode_buckets:
+                    if b_to != b_from:
+                        self._chain_next_fn(tok, np.zeros((b_to,), np.int32))
+        if self._multi_step_fn is not None and self._overlap_ok():
+            # cohort-graduation glue (the window pipeline's prefill-only
+            # entry): packed prefill harvest + first-token chain from
+            # each prefill batch bucket into each decode bucket (the
+            # chained window itself shares the chain_pure-warmed
+            # signature — ns_rep2-constrained device column)
+            for b, (nt, lp) in p_outs.items():
+                jax.block_until_ready(self._pack_pair_fn(nt, lp))
+                for Bd in decode_buckets:
+                    self._chain_next_fn(nt, np.zeros((Bd,), np.int32))
         if self._spec_step_fn is not None:
             # speculative verify shapes: one fixed [B, spec_tokens+1]
             # rectangle per decode bucket (greedy and sampled rows share
@@ -1455,6 +1537,28 @@ class JaxEngine:
                 jnp.take(last_tok[:, 0], src_idx)[:, None], ns_rep2
             )
 
+        def chain_next(next_tokens, src_idx):
+            """Next step's [B', 1] token column gathered on device from
+            a single-step dispatch's sampled tokens [B] (the overlapped
+            decode pipeline) or a prefill batch's sampled first tokens
+            (the cohort-graduation entry) — no host round trip."""
+            return jax.lax.with_sharding_constraint(
+                jnp.take(next_tokens, src_idx)[:, None], ns_rep2
+            )
+
+        def pack_pair(next_tokens, logprobs):
+            """One packed [2B] host transfer for a single-step
+            dispatch's outputs (token ids exact in f32: vocab < 2^24) —
+            over a tunneled chip each separate device->host read is a
+            full round trip, so the overlapped pipeline's harvest syncs
+            exactly one array per step."""
+            return jax.lax.with_sharding_constraint(
+                jnp.concatenate(
+                    [next_tokens.astype(jnp.float32), logprobs]
+                ),
+                ns_rep1,
+            )
+
         def spec_step(
             params,
             k_cache,
@@ -1510,27 +1614,30 @@ class JaxEngine:
         )
         self._chain_fn = jax.jit(chain_tokens) if K > 1 else None
         self._chain_pure_fn = jax.jit(chain_tokens_pure) if K > 1 else None
+        # overlapped-pipeline glue (both K regimes): on-device token
+        # chaining off a single-step/prefill dispatch + packed harvest
+        self._chain_next_fn = jax.jit(chain_next)
+        self._pack_pair_fn = jax.jit(pack_pair)
 
-    def _run_device_step(
+    def _dispatch_device_step(
         self,
         arrays: dict[str, np.ndarray],
         sampling: SamplingBatch,
-        sync: bool = True,
         origin: str = "",
-    ):
-        """``sync=False`` skips the device->host read of the sampled
-        outputs (returns None): a prefill batch with NO last chunks has
-        no token anyone needs, and over a tunneled chip each host read
-        is a full round trip (~200 ms measured) — a 3-chunk ISL-3000
-        prompt pays it twice for nothing. The dispatch still happens
-        (and still broadcasts under multihost); donated caches chain
-        the next step regardless.
+        defer_sync: bool = True,
+    ) -> tuple:
+        """DISPATCH half of a fused device step: announce (multihost),
+        launch the jitted step, swap the donated caches, and return the
+        sampled DEVICE outputs — no host sync. The caller harvests via
+        ``_harvest_device_step`` when (and only when) it needs values;
+        between the two, the host is free to plan/pack the next step
+        while the device executes this one (docs/performance.md).
 
-        ``origin`` labels a sync=False dispatch for deferred-error
-        forensics: an async dispatch's device error only SURFACES at a
-        later synced step, so the failure the step loop catches may
-        belong to these earlier chunks, not the batch it was raised
-        under (_annotate_deferred_error)."""
+        ``origin`` labels the dispatch for deferred-error forensics: an
+        async dispatch's device error only SURFACES at a later synced
+        step (_annotate_deferred_error). ``defer_sync=False`` skips that
+        registration — for callers that harvest THIS dispatch before
+        doing anything else, its error surfaces under its own batch."""
         assert self._step_fn is not None
         base_args = (
             self.params,
@@ -1551,6 +1658,7 @@ class JaxEngine:
                 self._mh_broadcast.announce_step_mm(arrays, sampling)
             else:
                 self._mh_broadcast.announce_step(arrays, sampling)
+        idle_gap_s = self.overlap.note_dispatch()
         t_disp = time.monotonic()
         if "extra_embeds" in arrays:
             out = self._step_fn_mm(
@@ -1561,27 +1669,57 @@ class JaxEngine:
         self.k_cache, self.v_cache = out[-2], out[-1]
         t_done = time.monotonic()
         self._last_phases = {
-            "dispatch_ms": round((t_done - t_disp) * 1e3, 3)
+            "dispatch_ms": round((t_done - t_disp) * 1e3, 3),
+            "idle_gap_ms": round(idle_gap_s * 1e3, 3),
         }
-        if not sync:
+        if defer_sync:
             self._unsynced_steps.append(
                 origin or f"shape={arrays['tokens'].shape}"
             )
             del self._unsynced_steps[:-8]  # bounded forensics window
-            return None
+        return out[:-2]
+
+    def _harvest_device_step(self, outs: tuple) -> tuple:
+        """HARVEST half: the designated host-sync point for step
+        outputs (dynalint DL010 flags syncs anywhere else in the step
+        loop). Blocks until the device result lands on host — under the
+        overlapped pipeline that result is already (or nearly) done."""
         from dynamo_tpu.parallel.multihost import host_value
 
+        t0 = time.monotonic()
         # (next_tokens, logprobs) base; (+ top_ids, top_lps) on the
         # top-logprobs variant
-        res = tuple(host_value(x) for x in out[:-2])
+        res = tuple(host_value(x) for x in outs)
+        self.overlap.note_complete(all_prior=True)
         self._last_phases["sync_ms"] = round(
-            (time.monotonic() - t_done) * 1e3, 3
+            (time.monotonic() - t0) * 1e3, 3
         )
         # a successful sync retires every earlier async dispatch
         # (in-order device execution): their deferred errors would have
         # surfaced in this host read
         self._unsynced_steps.clear()
         return res
+
+    def _run_device_step(
+        self,
+        arrays: dict[str, np.ndarray],
+        sampling: SamplingBatch,
+        sync: bool = True,
+        origin: str = "",
+    ):
+        """``sync=False`` skips the device->host read of the sampled
+        outputs (returns None): a prefill batch with NO last chunks has
+        no token anyone needs, and over a tunneled chip each host read
+        is a full round trip (~200 ms measured) — a 3-chunk ISL-3000
+        prompt pays it twice for nothing. The dispatch still happens
+        (and still broadcasts under multihost); donated caches chain
+        the next step regardless."""
+        outs = self._dispatch_device_step(
+            arrays, sampling, origin=origin, defer_sync=not sync
+        )
+        if not sync:
+            return None
+        return self._harvest_device_step(outs)
 
     # ------------------------------------------------------------------
     # Engine thread loop
@@ -1658,6 +1796,9 @@ class JaxEngine:
                     return
                 if self.kvbm is not None and self.kvbm.pending_offloads:
                     continue  # more queued: keep draining
+                # no work: the wait for the next request is load, not a
+                # device idle gap — drop the overlap tracker's anchor
+                self.overlap.note_idle()
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
@@ -1674,6 +1815,8 @@ class JaxEngine:
                 return
             except Exception as exc:
                 self._step_failures += 1
+                # queue depth is unknowable after an aborted dispatch
+                self.overlap.reset()
                 self._annotate_deferred_error(exc)
                 if not self._quarantine_step_failure():
                     log.exception(
@@ -2008,6 +2151,47 @@ class JaxEngine:
             # plain 1-token decode step — the [B, K+1] verify rectangle
             # would spend (K+1)x the attention/lm_head work to emit
             # exactly the same single token per sequence
+        if (
+            plan.kind == "decode"
+            and self._multi_step_fn is None
+            and self._drafter is None
+            and self._overlap_ok()
+            and plan.decode_seqs
+            and not self._overlap_divert(plan.decode_seqs)
+        ):
+            # overlapped single-step decode (docs/performance.md):
+            # dispatch N+1 before harvesting N so the TPU never idles
+            # for the host's plan+unpack time. --no-overlap restores
+            # the serial loop below.
+            t0 = time.monotonic()
+            self._decode_pipeline(plan.decode_seqs, plan_ms=plan_ms)
+            self._trace(
+                "decode_pipeline", b=len(plan.decode_seqs),
+                ms=round((time.monotonic() - t0) * 1e3, 1),
+            )
+            return
+        if (
+            plan.kind == "prefill"
+            and self._multi_step_fn is not None
+            and self._overlap_ok()
+            and plan.prefill_batch
+            and all(w.is_last_chunk for w in plan.prefill_batch)
+        ):
+            # cohort graduation without the hard sync: the prefill
+            # dispatch's first tokens chain on device into the first
+            # decode window (_window_pipeline prefill-only entry) —
+            # multimodal/penalty/top-logprobs batches fall back to the
+            # dedicated serial prefill inside the pipeline
+            t0 = time.monotonic()
+            self._window_pipeline(plan.prefill_batch, [])
+            ENGINE_STEP_SECONDS.labels("prefill").observe(
+                time.monotonic() - t0
+            )
+            self._trace(
+                "prefill_graduating", rows=len(plan.prefill_batch),
+                ms=round((time.monotonic() - t0) * 1e3, 1),
+            )
+            return
         if plan.kind == "prefill":
             works = plan.prefill_batch
             assert works
@@ -2199,6 +2383,7 @@ class JaxEngine:
         B = arrays["tokens"].shape[0]
         sampling = self._batch_sampling(seqs, B)
         t0 = time.monotonic()
+        self.overlap.note_dispatch()
         try:
             packed, self.k_cache, self.v_cache = self._spec_step_fn(
                 self.params, self.k_cache, self.v_cache,
@@ -2207,7 +2392,10 @@ class JaxEngine:
                 arrays["context_lens"], arrays["draft_lens"],
                 sampling.arrays,
             )
-            toks, lps, n_emit = unpack_spec_output(np.asarray(packed), S)
+            # unpack_spec_output is the spec path's designated harvest
+            # point (DL010): the device->host sync happens inside it
+            toks, lps, n_emit = unpack_spec_output(packed, S)
+            self.overlap.note_complete(all_prior=True)
             # successful host sync: earlier async dispatches are
             # known-good (in-order execution) — retire deferred-error
             # forensics or later failures would blame retired chunks
@@ -2251,6 +2439,200 @@ class JaxEngine:
             n = int(n_emit[i])
             self._emit_window(seq, toks[i, :n], lps[i, :n])
         return True
+
+    # ------------------------------------------------------------------
+    # Overlapped single-step decode (docs/performance.md)
+    # ------------------------------------------------------------------
+    def _overlap_ok(self) -> bool:
+        """The overlapped pipelines run single-host, pp=1, leader-less:
+        the chained-dispatch announce protocol doesn't exist for
+        followers, and the pp stage rotation keeps its serial step."""
+        return (
+            self.config.overlap
+            and self._mh_broadcast is None
+            and not self._is_follower
+            and self._pp == 1
+        )
+
+    def _overlap_divert(self, seqs: list) -> bool:
+        """Batches that must take the SERIAL step instead of the
+        overlapped decode pipeline: penalty/bias generated-token counts
+        live on host one step behind dispatch (a lagged count would
+        change the sampled distribution), and top-logprobs rides a
+        separately-compiled step variant whose chained-token signature
+        is deliberately not prewarmed (mirrors the window pipeline's
+        penalties_in gate)."""
+        return (
+            self._wants_toplp(seqs)
+            or any(s.request.sampling.needs_penalties for s in seqs)
+            or any(s.request.sampling.logit_bias for s in seqs)
+        )
+
+    def _decode_pipeline(self, seqs: list, plan_ms: float = 0.0) -> None:
+        """Double-buffered single-step decode — the decode_steps == 1
+        serving path restructured so the device never waits out the
+        host's plan+unpack+emit time (ROADMAP item 2's host-side lever):
+
+        - while device step N executes, the host plans AND dispatches
+          step N+1, its token column chained ON DEVICE from N's sampled
+          tokens (``chain_next``): per-step host->device traffic is the
+          small position/slot/seed arrays only, and there is no host
+          round trip between consecutive steps;
+        - step N's packed [2B] output is harvested only after N+1 is in
+          flight, so the hot-path sync waits on a result that is
+          already (or nearly) done;
+        - scheduler state (token appends, stop checks, block frees,
+          prefix-cache commits) runs ONE STEP BEHIND dispatch.
+          ``plan_pipelined_decode`` predicts every ``should_finish``
+          condition a step ahead so an in-flight step never writes KV
+          into blocks a harvest-time ``finish()`` frees; a token
+          sampled past a late-detected stop (cancellation, deadline,
+          backend stop-string) is DISCARDED at harvest — never
+          appended, never emitted, never content-addressed — and the
+          pipeline flushes so ``plan()`` reaps with nothing in flight;
+        - the pipeline NEVER preempts and never admits: block pressure
+          or new arrivals drain it back to the serial planner.
+
+        Greedy output is bit-identical to the serial loop (same step
+        program over the same values); sampled output draws the
+        identical seed stream (seeds offset by the in-flight lag).
+        """
+        sched = self.scheduler
+        assert sched is not None
+        from collections import deque
+
+        from dynamo_tpu.parallel.multihost import host_value
+
+        lag: dict[int, int] = {}
+
+        def _dead(seq) -> bool:
+            if seq.is_cancelled and seq.is_cancelled():
+                return True
+            return bool(seq.deadline) and time.monotonic() >= seq.deadline
+
+        def dispatch(seqs_, arrays, sampling, p_ms: float) -> dict:
+            t0 = time.monotonic()
+            outs = self._dispatch_device_step(
+                arrays, sampling, origin="decode-pipeline"
+            )
+            packed = self._pack_pair_fn(outs[0], outs[1])
+            return {
+                "packed": packed,
+                "toks": outs[0],  # device column the next step chains off
+                "seqs": seqs_,
+                "b": arrays["context_lens"].shape[0],
+                "vmap": {id(s): 1 for s in seqs_},
+                "t_disp": t0,
+                "plan_ms": p_ms,
+                # consumed here, not by _record_step's use_phases: at
+                # harvest time _last_phases belongs to a LATER dispatch
+                "phases": dict(self._last_phases),
+            }
+
+        def try_extend() -> bool:
+            # each extension is one logical engine step: the fault point
+            # (docs/robustness.md) must see it, or a whole decode inside
+            # one _one_step call would evade per-step fault plans. Fired
+            # BEFORE planning/allocation: an injected error propagates
+            # with host state only advanced through the last harvest, so
+            # the quarantine retry recomputes the abandoned in-flight
+            # step bit-identically (KV slots rewritten with same values)
+            faults.fire("engine.step")
+            newest = pending[-1]
+            self._drain_incoming_only()
+            if sched.waiting or sched.prefilling:
+                return False  # drain: the serial planner admits/prefills
+            t_plan = time.monotonic()
+            nxt = sched.plan_pipelined_decode(newest["seqs"], lag)
+            if nxt is None:
+                return False
+            arrays = nxt["arrays"]
+            arrays["tokens"] = self._chain_next_fn(
+                newest["toks"], nxt["src_idx"]
+            )
+            sampling = self._batch_sampling(
+                nxt["seqs"],
+                arrays["context_lens"].shape[0],
+                offset=nxt["offsets"],
+            )
+            e = dispatch(
+                nxt["seqs"], arrays, sampling,
+                round((time.monotonic() - t_plan) * 1e3, 3),
+            )
+            _lag_add(lag, e)
+            pending.append(e)
+            return True
+
+        def harvest(e, depth: int) -> bool:
+            t0 = time.monotonic()
+            packed_h = host_value(e["packed"])
+            self.overlap.note_complete()
+            self._unsynced_steps.clear()
+            sync_ms = round((time.monotonic() - t0) * 1e3, 3)
+            B = e["b"]
+            toks = packed_h[:B].astype(np.int32)
+            lps = packed_h[B : 2 * B]
+            finished = False
+            for i, seq in enumerate(e["seqs"]):
+                if seq.state != SeqState.RUNNING:
+                    continue
+                if _dead(seq):
+                    # late-detected stop: DISCARD the in-flight token —
+                    # nothing appended means nothing emitted and nothing
+                    # the prefix cache could ever content-address
+                    finished = True
+                    continue
+                self._emit_token(seq, int(toks[i]), float(lps[i]))
+                if seq.state != SeqState.RUNNING:
+                    finished = True
+            _lag_sub(lag, e)
+            dt = time.monotonic() - e["t_disp"]
+            ENGINE_STEP_SECONDS.labels("decode").observe(dt)
+            self._record_step(
+                "decode", dt,
+                batch=len(e["seqs"]),
+                use_phases=False,  # per-entry stamps below
+                plan_ms=e["plan_ms"],
+                sync_ms=sync_ms,
+                pipeline_depth=depth,
+                # host time this step ran UNDER (planning/dispatching
+                # N+1, emitting N-1) — the overlapped span
+                overlap_ms=round((t0 - e["t_disp"]) * 1e3, 3),
+                **e["phases"],
+            )
+            self._trace(
+                "pipe_decode", b=len(e["seqs"]), depth=depth,
+                ms=round(dt * 1e3, 1), sync_ms=sync_ms,
+            )
+            return finished
+
+        arrays = sched.build_decode_arrays(seqs)
+        sampling = self._batch_sampling(seqs, arrays["tokens"].shape[0])
+        entry = dispatch(seqs, arrays, sampling, plan_ms)
+        _lag_add(lag, entry)
+        pending = deque([entry])
+        while pending:
+            # extend BEFORE harvesting: nothing has been freed since the
+            # last harvest, so planning here never touches blocks an
+            # in-flight step writes. _running/_control: shutdown and
+            # engine-thread calls flush rather than starve.
+            while (
+                len(pending) < self.PIPELINE_DEPTH
+                and self._running
+                and self._control.empty()
+            ):
+                if not try_extend():
+                    break
+            finished = harvest(pending.popleft(), depth=len(pending) + 1)
+            if finished and pending:
+                # a finish freed blocks (or a stop was detected) with a
+                # step in flight: predicted finishes were already
+                # excluded from it, and no allocation can occur until
+                # the pipeline drains — flush so plan()/admission and
+                # the reap run with nothing in flight
+                while pending:
+                    harvest(pending.popleft(), depth=len(pending))
+                return
 
     def _batch_sampling(
         self, seqs: list, B: int, offset=0
@@ -2306,6 +2688,7 @@ class JaxEngine:
         assert self._multi_step_fn is not None
         if self._mh_broadcast is not None:
             self._mh_broadcast.announce_multi_step(arrays, sampling)
+        self.overlap.note_dispatch()
         packed, last_tok, self.k_cache, self.v_cache = self._multi_step_fn(
             self.params,
             self.k_cache,
@@ -2344,10 +2727,6 @@ class JaxEngine:
     @staticmethod
     def _wants_toplp(seqs: list) -> bool:
         return any((s.request.output.logprobs or 0) > 0 for s in seqs)
-
-    def _run_multi_step(self, arrays: dict[str, np.ndarray], sampling: SamplingBatch):
-        packed, _ = self._dispatch_multi_step(arrays, sampling)
-        return self._unpack_window(np.asarray(packed), sampling.has_toplp)
 
     def _pad_prefill_rect(
         self, arrays: dict[str, np.ndarray], P: int, T: int, width: int
@@ -2406,6 +2785,7 @@ class JaxEngine:
             self._mh_broadcast.announce_mixed(
                 p_pad, sampling_p, d_arrays, sampling_d
             )
+        self.overlap.note_dispatch()
         flat, last_tok, p_next, self.k_cache, self.v_cache = (
             self._mixed_step_fn(
                 self.params,
@@ -2529,18 +2909,6 @@ class JaxEngine:
                 or self._wants_toplp(ss)
             )
 
-        def add_lag(entry) -> None:
-            for sid, v in entry["vmap"].items():
-                lag[sid] = lag.get(sid, 0) + v
-
-        def sub_lag(entry) -> None:
-            for sid, v in entry["vmap"].items():
-                left = lag.get(sid, 0) - v
-                if left > 0:
-                    lag[sid] = left
-                else:
-                    lag.pop(sid, None)
-
         def make_entry(out, works_, seqs_, vmap: dict) -> dict:
             """One pipeline entry; the lag invariant (vmap = tokens this
             window adds per sequence, incl. +1 per graduating last
@@ -2548,6 +2916,12 @@ class JaxEngine:
             if out[0] == "pure":
                 e = {"kind": "pure", "flat": out[1], "last": out[2],
                      "b": out[3]}
+            elif out[0] == "prefill":
+                # prefill-only cohort entry (overlap path): no decode
+                # rows; the sampled first tokens chain on device into
+                # the NEXT window via chain_next (try_extend)
+                e = {"kind": "prefill", "packed": out[1], "p_next": out[2],
+                     "p_rows": out[3], "b": 0}
             else:
                 e = {"kind": "mixed", "flat": out[1], "last": out[2],
                      "p_next": out[3], "b": out[4], "p_rows": out[5]}
@@ -2557,6 +2931,9 @@ class JaxEngine:
             for w in works_:
                 if w.is_last_chunk:
                     e["vmap"][id(w.seq)] = e["vmap"].get(id(w.seq), 0) + 1
+            # overlap phase stamps for this entry's flight-recorder row
+            e["t_disp"] = time.monotonic()
+            e["idle_gap_ms"] = round(self.overlap.last_idle_gap_s * 1e3, 3)
             return e
 
         # dispatch the first window
@@ -2595,19 +2972,47 @@ class JaxEngine:
                             top=top,
                         )
                 return
-            d_arrays = sched.build_decode_arrays(seqs)
-            p_rows = (rect or (self.config.mixed_prefill_rows, 0))[0]
-            sampling_p = self._batch_sampling([w.seq for w in works], p_rows)
-            sampling_d = self._batch_sampling(seqs, d_arrays["tokens"].shape[0])
-            pipelining = pipelining and not (
-                sampling_p.has_penalties or sampling_d.has_penalties
-                or sampling_p.has_toplp or sampling_d.has_toplp
-                or sampling_p.has_bias or sampling_d.has_bias
-            )
-            out = ("mixed",) + self._dispatch_mixed(
-                works, seqs, p_arrays, d_arrays, sampling_p, sampling_d,
-                rect=rect,
-            )
+            if not seqs:
+                # prefill-only first entry (overlapped cohort
+                # graduation, _one_step): dispatch the cohort WITHOUT a
+                # hard sync — try_extend chains its sampled first
+                # tokens on device into the first decode window, so the
+                # prefill->decode boundary costs no host round trip
+                assert all(w.is_last_chunk for w in works)
+                sampling_p = self._batch_sampling(
+                    [w.seq for w in works], p_arrays["tokens"].shape[0]
+                )
+                outs = self._dispatch_device_step(
+                    p_arrays, sampling_p,
+                    origin="prefill:" + ",".join(
+                        w.seq.request_id for w in works
+                    ),
+                )
+                out = (
+                    "prefill",
+                    self._pack_pair_fn(outs[0], outs[1]),
+                    outs[0],
+                    p_arrays["tokens"].shape[0],
+                )
+                d_arrays = None
+            else:
+                d_arrays = sched.build_decode_arrays(seqs)
+                p_rows = (rect or (self.config.mixed_prefill_rows, 0))[0]
+                sampling_p = self._batch_sampling(
+                    [w.seq for w in works], p_rows
+                )
+                sampling_d = self._batch_sampling(
+                    seqs, d_arrays["tokens"].shape[0]
+                )
+                pipelining = pipelining and not (
+                    sampling_p.has_penalties or sampling_d.has_penalties
+                    or sampling_p.has_toplp or sampling_d.has_toplp
+                    or sampling_p.has_bias or sampling_d.has_bias
+                )
+                out = ("mixed",) + self._dispatch_mixed(
+                    works, seqs, p_arrays, d_arrays, sampling_p, sampling_d,
+                    rect=rect,
+                )
         else:
             d_arrays = sched.build_decode_arrays(seqs)
             sampling_d = self._batch_sampling(seqs, d_arrays["tokens"].shape[0])
@@ -2617,16 +3022,33 @@ class JaxEngine:
             )
             out = ("pure",) + self._dispatch_multi_step(d_arrays, sampling_d) \
                 + (d_arrays["tokens"].shape[0],)
-        vmap0 = {
-            id(s): int(d_arrays["valid_steps"][i]) for i, s in enumerate(seqs)
-        }
+        vmap0 = (
+            {id(s): int(d_arrays["valid_steps"][i])
+             for i, s in enumerate(seqs)}
+            if d_arrays is not None
+            else {}
+        )
         entry = make_entry(out, works, seqs, vmap0)
-        add_lag(entry)
+        _lag_add(lag, entry)
         pending = deque([entry])
 
-        def emit_entry(e) -> None:
+        def harvest_entry(e) -> None:
             t0 = time.monotonic()
-            if e["kind"] == "mixed":
+            if e["kind"] == "prefill":
+                # cohort-graduation entry: one packed [2P] transfer
+                # carrying first tokens + logprobs; the decode window
+                # chained off them is already in flight behind it
+                ph = host_value(e["packed"])
+                P = e["p_rows"]
+                p_next_h = ph[:P].astype(np.int32)
+                p_lp_h = ph[P : 2 * P]
+                for i, work in enumerate(e["works"]):
+                    sched.complete_prefill_chunk(work)
+                    if work.is_last_chunk:
+                        self._emit_token(
+                            work.seq, int(p_next_h[i]), float(p_lp_h[i])
+                        )
+            elif e["kind"] == "mixed":
                 self._emit_mixed(
                     e["works"], e["seqs"], host_value(e["flat"]), e["b"],
                     P=e["p_rows"],
@@ -2637,11 +3059,12 @@ class JaxEngine:
                 for i, seq in enumerate(e["seqs"]):
                     tops = (win[2][i], win[3][i]) if tlp else None
                     self._emit_window(seq, win[0][i], win[1][i], tops=tops)
+            self.overlap.note_complete()
             # window sync succeeded: earlier async dispatches are
             # known-good (in-order execution) — retire deferred-error
             # forensics
             self._unsynced_steps.clear()
-            sub_lag(e)
+            _lag_sub(lag, e)
             win_s = time.monotonic() - t0
             # one flight-recorder entry per WINDOW (the serving-path
             # unit of work): duration is the host-side sync+emit wait —
@@ -2653,6 +3076,11 @@ class JaxEngine:
                 pipeline_depth=len(pending),
                 use_phases=False,  # dispatched via the window fns, not
                 # _run_device_step — its phase stamps belong elsewhere
+                # overlap phase stamps (telemetry/overlap.py): the span
+                # this window ran under other host work, and the device
+                # idle gap that preceded its dispatch
+                overlap_ms=round((t0 - e["t_disp"]) * 1e3, 3),
+                idle_gap_ms=e["idle_gap_ms"],
             )
             self._trace(
                 "window", kind=e["kind"], b=len(e["seqs"]),
@@ -2668,7 +3096,10 @@ class JaxEngine:
             newest = pending[-1]
             self._drain_incoming_only()
             nxt = sched.plan_pipelined_mixed(
-                newest["seqs"], newest["works"], lag
+                newest["seqs"], newest["works"], lag,
+                # a prefill-only entry's token vector is the prefill
+                # rows alone — graduated row r chains from index r
+                grad_base=0 if newest["kind"] == "prefill" else None,
             )
             if nxt is None or penalties_in(nxt["works2"], nxt["seqs"]):
                 return False
@@ -2680,11 +3111,18 @@ class JaxEngine:
             if self._mh_broadcast is not None:
                 # multihost pipelining: followers chain the SAME token
                 # column from their own retained device outputs — the
-                # next announce's host token values are placeholders
+                # next announce's host token values are placeholders.
+                # (prefill-only entries exist only single-host:
+                # _one_step gates them on _overlap_ok)
+                assert newest["kind"] != "prefill"
                 self._mh_broadcast.announce_chain(
                     nxt["src_idx"], newest["kind"] == "mixed"
                 )
-            if newest["kind"] == "mixed":
+            if newest["kind"] == "prefill":
+                chained = self._chain_next_fn(
+                    newest["p_next"], nxt["src_idx"]
+                )
+            elif newest["kind"] == "mixed":
                 chained = self._chain_fn(
                     newest["last"], newest["p_next"], nxt["src_idx"]
                 )
@@ -2708,7 +3146,7 @@ class JaxEngine:
                     nxt["arrays"], s_d2, tokens_dev=chained
                 ) + (nxt["arrays"]["tokens"].shape[0],)
             e = make_entry(out, nxt["works2"], nxt["seqs"], nxt["vmap"])
-            add_lag(e)
+            _lag_add(lag, e)
             pending.append(e)
             return True
 
@@ -2726,7 +3164,7 @@ class JaxEngine:
             ):
                 if not try_extend():
                     break
-            emit_entry(pending.popleft())
+            harvest_entry(pending.popleft())
             if any(
                 s.state != SeqState.RUNNING for e in pending for s in e["seqs"]
             ) or any(
@@ -2736,7 +3174,7 @@ class JaxEngine:
             ):
                 # composition changed under in-flight windows: flush
                 while pending:
-                    emit_entry(pending.popleft())
+                    harvest_entry(pending.popleft())
                 return
 
     @staticmethod
@@ -3168,6 +3606,13 @@ class JaxEngine:
             }
         out["hbm"] = self.hbm.refresh()
         out["slo"] = self.slo.stats()
+        # overlapped-pipeline health (docs/performance.md): device
+        # idle-gap accounting — read device_idle_frac as
+        # idle_gap_s_total growth over wall time under load
+        out["overlap"] = {
+            "enabled": self.config.overlap,
+            **self.overlap.stats(),
+        }
         if self.recorder is not None:
             out["flight_recorder"] = self.recorder.stats()
             out["recent_steps"] = self.recorder.snapshot(32)

@@ -590,8 +590,117 @@ class Scheduler:
                 safe.append(seq)
         return safe
 
+    def plan_pipelined_decode(
+        self, seqs: list[Sequence], lag: dict
+    ) -> Optional[dict]:
+        """Plan the NEXT single-token decode step while one is in
+        flight (the decode_steps == 1 overlapped pipeline,
+        engine._decode_pipeline / docs/performance.md).
+
+        ``lag`` maps id(seq) -> tokens sampled by in-flight steps but
+        not yet applied to host state (one per step here). Sequences
+        that FINISH inside the in-flight lag — max_tokens reached,
+        max_model_len hit, or block-table cap — are simply not rows of
+        the next step, mirroring ``should_finish`` one step ahead so a
+        predicted finish never leaves an in-flight step writing KV into
+        blocks a harvest-time ``finish()`` just freed. Returns None
+        (flush the pipeline) on anything irregular: cancellation,
+        deadline expiry, a non-RUNNING state, or block exhaustion —
+        this path NEVER preempts (a preemption would free blocks an
+        in-flight step still writes); the outer serial plan() handles
+        pressure with nothing in flight.
+
+        Returns {"seqs", "arrays", "src_idx", "offsets", "vmap"}: the
+        next step's rows, its decode arrays (the token column is a
+        placeholder — the engine chains it on device from the in-flight
+        step's sampled tokens via ``src_idx``), per-row seed offsets
+        (= lags), and the one token each row will add.
+        """
+        now = time.monotonic()
+        survivors: list[Sequence] = []
+        for seq in seqs:
+            if seq.state != SeqState.RUNNING:
+                return None
+            if seq.is_cancelled and seq.is_cancelled():
+                return None
+            if bool(seq.deadline) and now >= seq.deadline:
+                return None
+            gl = lag.get(id(seq), 0)
+            if (
+                seq.max_new_tokens is not None
+                and seq.max_new_tokens - seq.generated <= gl
+            ):
+                continue  # finishes inside the in-flight step
+            if self.max_model_len and seq.total_len + gl >= self.max_model_len:
+                continue
+            if len(seq.block_table) >= self.allocator.num_blocks - 1:
+                continue  # should_finish's can't-grow-further clause
+            survivors.append(seq)
+        if not survivors:
+            return None
+        bs = self.block_size
+        # block growth for the next step's KV write (the in-flight
+        # token's slot) — no preemption; rollback on exhaustion
+        added: list[Sequence] = []
+        ok = True
+        for seq in survivors:
+            needed = seq.blocks_needed(
+                seq.total_len + lag.get(id(seq), 0) + 1, bs
+            )
+            while len(seq.block_table) < needed:
+                try:
+                    seq.block_table.append(self.allocator.allocate_block())
+                    added.append(seq)
+                except NoBlocksError:
+                    ok = False
+                    break
+            if not ok:
+                break
+        if not ok:
+            for seq in reversed(added):
+                self.allocator.free_sequence([seq.block_table.pop()])
+            return None
+        old_row = {id(s): j for j, s in enumerate(seqs)}
+        n = len(survivors)
+        B = self._decode_batch(n)
+        max_blocks = max(len(s.block_table) for s in survivors)
+        width = self._table_width(max_blocks)
+        positions = np.zeros((B, 1), np.int32)
+        slot_mapping = np.zeros((B,), np.int32)
+        tables = np.zeros((B, width), np.int32)
+        ctx = np.zeros((B,), np.int32)
+        src_idx = np.zeros((B,), np.int32)
+        offsets = [0] * n
+        vmap: dict[int, int] = {}
+        for i, s in enumerate(survivors):
+            gl = lag.get(id(s), 0)
+            src_idx[i] = old_row[id(s)]
+            pos = s.total_len - 1 + gl
+            positions[i, 0] = pos
+            slot_mapping[i] = s.block_table[pos // bs] * bs + pos % bs
+            tables[i, : len(s.block_table)] = s.block_table
+            ctx[i] = s.total_len + gl
+            offsets[i] = gl
+            vmap[id(s)] = 1
+        arrays = {
+            "tokens": np.zeros((B, 1), np.int32),  # device chain overrides
+            "positions": positions,
+            "slot_mapping": slot_mapping,
+            "block_tables": tables,
+            "context_lens": ctx,
+            "last_token_idx": np.zeros((B,), np.int32),
+        }
+        return {
+            "seqs": survivors,
+            "arrays": arrays,
+            "src_idx": src_idx,
+            "offsets": offsets,
+            "vmap": vmap,
+        }
+
     def plan_pipelined_mixed(
-        self, seqs: list[Sequence], works: list[PrefillWork], lag: dict
+        self, seqs: list[Sequence], works: list[PrefillWork], lag: dict,
+        grad_base: Optional[int] = None,
     ) -> Optional[dict]:
         """Plan the NEXT window while one or more windows are in flight.
 
@@ -603,7 +712,11 @@ class Scheduler:
         decode rows of the next window (their first sampled token is
         device-resident in that window's outputs — the engine chains it
         via an on-device gather, indexed by ``src_idx``: row j of the
-        newest decode batch -> j, graduated work r -> B_pad + r).
+        newest decode batch -> j, graduated work r -> grad_base + r,
+        where ``grad_base`` defaults to the newest window's padded
+        decode width; a prefill-only in-flight entry — the cohort
+        dispatch the overlapped window pipeline chains its first window
+        off — passes 0, its token vector being the prefill rows alone).
         Returns None (flush the pipeline) whenever anything irregular
         appears: a non-final chunk, cancellations, budget inside the
         in-flight windows, batch overflow, or block exhaustion (never
@@ -727,10 +840,12 @@ class Scheduler:
         src_idx = np.zeros((B,), np.int32)
         offsets = [0] * n
         vmap: dict[int, int] = {}
+        if grad_base is None:
+            grad_base = self._decode_batch(len(seqs)) if seqs else 0
         for i, s in enumerate(next_seqs):
             gen_after = lag.get(id(s), 0)
             if id(s) in grad_row:
-                src_idx[i] = self._decode_batch(len(seqs)) + grad_row[id(s)]
+                src_idx[i] = grad_base + grad_row[id(s)]
             else:
                 src_idx[i] = old_row[id(s)]
             # the sampled-but-unapplied tokens occupy slots up to

@@ -168,12 +168,15 @@ def verify_tokens(
 
 
 def unpack_spec_output(
-    packed_host: np.ndarray, S: int
+    packed, S: int
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Split the spec step's packed [B, 2S+1] host transfer back into
+    """Sync + split the spec step's packed [B, 2S+1] output into
     (out_tokens [B, S] i32, out_lps [B, S] f32, n_emit [B] i32) — token
     ids are exact in f32 (vocab < 2^24), mirroring the fused window's
-    packed-transfer idiom."""
+    packed-transfer idiom. This is the spec path's DESIGNATED HARVEST
+    point (dynalint DL010): the one device->host sync of the verify
+    step happens here, not inline in the engine step loop."""
+    packed_host = np.asarray(packed)
     toks = packed_host[:, :S].astype(np.int32)
     lps = packed_host[:, S : 2 * S]
     n_emit = packed_host[:, 2 * S].astype(np.int32)

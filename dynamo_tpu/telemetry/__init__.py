@@ -37,6 +37,7 @@ from dynamo_tpu.telemetry.debug import (  # noqa: F401
     unregister_debug_provider,
 )
 from dynamo_tpu.telemetry.hbm import HbmAccountant, tree_bytes  # noqa: F401
+from dynamo_tpu.telemetry.overlap import OverlapTracker  # noqa: F401
 from dynamo_tpu.telemetry.recorder import FlightRecorder  # noqa: F401
 from dynamo_tpu.telemetry.slo import SloConfig, SloTracker  # noqa: F401
 from dynamo_tpu.telemetry.spans import (  # noqa: F401

@@ -52,6 +52,12 @@ class FlightRecorder:
 
     ``slow_step_s`` — steps longer than this dump the ring (None = the
     watchdog is off; the ring still records for ``/debug/state``).
+    ``idle_gap_slow_s`` — a step whose ``idle_gap_ms`` field (the
+    device idle span the overlap tracker measured before its dispatch,
+    telemetry/overlap.py) exceeds this dumps the ring too: a device
+    that sat idle for a slow-step's worth of time is the same anomaly
+    as a slow step, just spent on the host side of the pipeline
+    (None = follow ``slow_step_s``).
     ``dump_dir`` — where JSONL dumps land (default: DYN_FLIGHT_DIR or
     the system temp dir).
     ``max_dump_files`` — on-disk cap: writing dump K+1 unlinks this
@@ -68,9 +74,13 @@ class FlightRecorder:
         min_dump_interval_s: float = 30.0,
         max_dump_files: int = 16,
         clock: Callable[[], float] = time.monotonic,
+        idle_gap_slow_s: Optional[float] = None,
     ):
         self.capacity = max(1, int(capacity))
         self.slow_step_s = slow_step_s
+        self.idle_gap_slow_s = (
+            idle_gap_slow_s if idle_gap_slow_s is not None else slow_step_s
+        )
         self.dump_dir = dump_dir or default_dump_dir()
         self.min_dump_interval_s = min_dump_interval_s
         self._clock = clock
@@ -100,14 +110,33 @@ class FlightRecorder:
         if slow:
             rec["slow"] = True
             rec["slow_threshold_ms"] = round(self.slow_step_s * 1e3, 3)
+        # device-idle watchdog (telemetry/overlap.py): a large idle gap
+        # before this dispatch is dump-worthy like a slow step — the
+        # time went missing on the host side of the pipeline instead of
+        # inside the device step
+        gap_ms = fields.get("idle_gap_ms")
+        idle_slow = (
+            not slow
+            and self.idle_gap_slow_s is not None
+            and isinstance(gap_ms, (int, float))
+            and gap_ms > self.idle_gap_slow_s * 1e3
+        )
+        if idle_slow:
+            rec["slow_idle_gap"] = True
+            rec["idle_gap_threshold_ms"] = round(
+                self.idle_gap_slow_s * 1e3, 3
+            )
         with self._lock:
             self._ring.append(rec)
             self.steps_recorded += 1
-            if slow:
+            if slow or idle_slow:
                 self.slow_steps += 1
         if slow:
             SLOW_STEPS.labels(kind).inc()
             return self.dump(reason=f"slow_step:{kind}")
+        if idle_slow:
+            SLOW_STEPS.labels(kind).inc()
+            return self.dump(reason=f"idle_gap:{kind}")
         return None
 
     def note_slow_request(self, request_id: str, **fields) -> Optional[str]:

@@ -1,0 +1,30 @@
+"""Violating fixture for hidden-host-sync-in-step-loop (DL010): the
+engine step loop synchronizing device->host outside the designated
+harvest point — every one of these re-serializes the overlapped decode
+pipeline (the device drains while the host blocks mid-plan)."""
+
+import jax
+import numpy as np
+
+from dynamo_tpu.parallel.multihost import host_value
+
+
+def step_loop(engine):
+    while engine.running:
+        out = engine.dispatch()
+        toks = np.asarray(out)  # VIOLATION: sync mid-loop, not at harvest
+        jax.block_until_ready(out)  # VIOLATION: host parks on the device
+        n = engine.counter.item()  # VIOLATION: scalar read is a full sync
+        lps = host_value(out)  # VIOLATION: the house sync, same problem
+        engine.emit(toks, lps, n)
+
+
+def decode_step_loop(engine):
+    def drain(out):
+        # nested helper closures are part of the loop (only
+        # harvest-named defs scope apart)
+        return out.tolist()  # VIOLATION: hidden sync in a loop helper
+
+    for out in engine.pending:
+        drain(out)
+        out.block_until_ready()  # VIOLATION: per-item hard sync

@@ -1,0 +1,25 @@
+"""Clean fixture for hidden-host-sync-in-step-loop (DL010): the step
+loop keeps device handles in flight and funnels its ONE device->host
+sync through a harvest-named function — the engine's
+``_harvest_device_step`` idiom (docs/performance.md). While the newest
+dispatch executes on device, the host materializes only the oldest,
+already-finished result."""
+
+import numpy as np
+
+
+def harvest_step(out):
+    # the designated harvest point: the loop's single sync lives here,
+    # waiting on a result that is already (or nearly) done
+    return np.asarray(out)
+
+
+def step_loop(engine):
+    inflight = None
+    while engine.running:
+        nxt = engine.dispatch()  # device starts step N+1 ...
+        if inflight is not None:
+            engine.emit(harvest_step(inflight))  # ... while N lands
+        inflight = nxt
+    if inflight is not None:
+        engine.emit(harvest_step(inflight))

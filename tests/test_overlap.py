@@ -1,0 +1,303 @@
+"""Overlapped decode pipeline (docs/performance.md): bit-identity vs
+the serial loop, late-stop rollback, preemption/block-pressure safety,
+the cohort-graduation window entry, and the OverlapTracker /
+flight-recorder idle-gap plumbing. CPU-runnable tier-1, like
+tests/test_spec.py."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.telemetry.overlap import OverlapTracker
+
+MODEL_DIR = os.path.join(os.path.dirname(__file__), "data", "tiny_llama_model")
+
+
+# ---------------------------------------------------------------------------
+# OverlapTracker units (fake clock)
+# ---------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_tracker_counts_idle_gap_only_when_queue_empty():
+    clk = _Clock()
+    tr = OverlapTracker(clock=clk)
+    assert tr.note_dispatch() == 0.0  # no completion anchor yet
+    clk.t = 1.0
+    tr.note_complete()
+    clk.t = 1.5
+    # queue empty + anchored: the 0.5 s host-side span is device idle
+    assert tr.note_dispatch() == pytest.approx(0.5)
+    # second dispatch while one is in flight: device has queued work
+    clk.t = 1.6
+    assert tr.note_dispatch() == 0.0
+    clk.t = 2.0
+    tr.note_complete()  # oldest harvested; one still in flight
+    clk.t = 3.0
+    assert tr.note_dispatch() == 0.0  # nonempty queue -> no idle
+    s = tr.stats()
+    assert s["steps_dispatched"] == 4
+    assert s["idle_events"] == 1
+    assert s["idle_gap_s_total"] == pytest.approx(0.5)
+    assert s["max_idle_gap_ms"] == pytest.approx(500.0)
+
+
+def test_tracker_all_prior_retirement_and_idle_reset():
+    clk = _Clock()
+    tr = OverlapTracker(clock=clk)
+    tr.note_dispatch()
+    tr.note_dispatch()  # e.g. sync=False prefill + synced step
+    clk.t = 1.0
+    tr.note_complete(all_prior=True)  # the newest sync retires both
+    assert tr.inflight == 0
+    # note_idle drops the anchor: a no-work wait is not device idleness
+    tr.note_idle()
+    clk.t = 10.0
+    assert tr.note_dispatch() == 0.0
+    # reset forgets a poisoned queue (aborted dispatch)
+    tr.note_dispatch()
+    tr.reset()
+    assert tr.inflight == 0
+
+
+def test_recorder_idle_gap_watchdog_dumps(tmp_path):
+    from dynamo_tpu.telemetry.recorder import FlightRecorder
+
+    clk = _Clock()
+    rec = FlightRecorder(
+        capacity=8, slow_step_s=10.0, dump_dir=str(tmp_path),
+        idle_gap_slow_s=0.05, clock=clk,
+    )
+    # fast step, small gap: no dump
+    assert rec.record("decode", 0.001, idle_gap_ms=1.0) is None
+    clk.t = 100.0  # past the dump rate limit
+    path = rec.record("decode", 0.001, idle_gap_ms=80.0)
+    assert path is not None and os.path.exists(path)
+    with open(path) as f:
+        lines = f.read().splitlines()
+    assert '"reason": "idle_gap:decode"' in lines[0]
+    assert any('"slow_idle_gap": true' in ln for ln in lines[1:])
+
+
+# ---------------------------------------------------------------------------
+# Engine: overlap vs serial bit-identity
+# ---------------------------------------------------------------------------
+
+
+def _engine_config(**kw):
+    from dynamo_tpu.engine.config import EngineConfig
+
+    base = dict(
+        model_path=MODEL_DIR, model_name="tiny", random_weights=True,
+        num_blocks=64, block_size=8, max_batch_size=4,
+        prefill_chunk_size=32, max_model_len=128,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+async def _generate(engine, prompt_ids, max_tokens=8, request_id="r",
+                    temperature=None, seed=None, context=None):
+    sampling = (
+        SamplingOptions(use_greedy=True)
+        if temperature is None
+        else SamplingOptions(temperature=temperature, seed=seed)
+    )
+    req = PreprocessedRequest(
+        request_id=request_id,
+        token_ids=list(prompt_ids),
+        sampling=sampling,
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    )
+    out = []
+    final = None
+    async for item in engine.as_async_engine().generate(
+        req, context or Context()
+    ):
+        out.extend(item.token_ids)
+        if item.is_final:
+            final = item
+    return out, final
+
+
+PROMPTS = [list(range(1, 12)), list(range(5, 21)), [7, 7, 3, 9, 1, 2]]
+
+
+async def _decode_all(engine, max_tokens=9, temperature=None, seed=7):
+    outs = await asyncio.gather(*[
+        _generate(engine, p, max_tokens=max_tokens, request_id=f"r{i}",
+                  temperature=temperature, seed=seed)
+        for i, p in enumerate(PROMPTS)
+    ])
+    return [o[0] for o in outs]
+
+
+async def test_overlap_greedy_bit_identical_vs_serial():
+    """THE acceptance criterion: overlap on vs --no-overlap produce the
+    same greedy tokens, token for token, at decode_steps=1 — and the
+    overlap engine actually pipelined (dispatched with a step still in
+    flight at least once). The sampled path must match too: the seed
+    stream is identical, only offset by the in-flight lag."""
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    eng = await JaxEngine.launch(_engine_config(overlap=True))
+    try:
+        over = await _decode_all(eng)
+        over_sampled = await _decode_all(eng, temperature=0.8)
+        assert eng.overlap.steps_dispatched > 0
+        dbg = eng.debug_state()["overlap"]
+        assert dbg["enabled"] is True
+    finally:
+        await eng.shutdown()
+
+    eng = await JaxEngine.launch(_engine_config(overlap=False))
+    try:
+        serial = await _decode_all(eng)
+        serial_sampled = await _decode_all(eng, temperature=0.8)
+        assert eng.debug_state()["overlap"]["enabled"] is False
+    finally:
+        await eng.shutdown()
+    assert over == serial
+    assert over_sampled == serial_sampled
+    assert all(len(o) == 9 for o in over)
+
+
+async def test_overlap_window_graduation_bit_identical():
+    """decode_steps > 1: the cohort-graduation entry (prefill dispatch
+    chaining first tokens on device into the first window) must not
+    change greedy output vs the serial prefill -> window boundary."""
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    eng = await JaxEngine.launch(_engine_config(decode_steps=4, overlap=True))
+    try:
+        over = await _decode_all(eng, max_tokens=11)
+    finally:
+        await eng.shutdown()
+    eng = await JaxEngine.launch(_engine_config(decode_steps=4, overlap=False))
+    try:
+        serial = await _decode_all(eng, max_tokens=11)
+    finally:
+        await eng.shutdown()
+    assert over == serial
+    assert all(len(o) == 11 for o in over)
+
+
+async def test_overlap_late_stop_discards_inflight_tokens():
+    """Late-detected stop: a cancellation that lands while a step is in
+    flight must terminate the stream with nothing extra emitted after
+    the cancel is observed, free every block, and leave the prefix
+    cache clean — a fresh continuation through the same engine matches
+    a fresh engine's (post-stop tokens were never content-addressed)."""
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.runtime.engine import Context
+
+    eng = await JaxEngine.launch(_engine_config(overlap=True))
+    try:
+        free0 = eng.allocator.num_free
+        ctx = Context()
+        req = PreprocessedRequest(
+            request_id="late-stop",
+            token_ids=PROMPTS[0],
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=64, ignore_eos=True),
+        )
+        stream = eng.as_async_engine().generate(req, ctx)
+        got = []
+        async for item in stream:
+            got.extend(item.token_ids)
+            if len(got) >= 2:
+                # the backend's stop-string detection cancels exactly
+                # like this: via the context, one step late
+                ctx.stop_generating()
+                break
+        # the engine reaps the cancelled sequence and frees its blocks
+        await eng.wait_for_state(
+            lambda e: not e.scheduler.running and not e.scheduler.waiting
+            and not e.scheduler.prefilling
+        )
+        await eng.wait_for_state(
+            lambda e: e.allocator.num_free == free0
+        )
+        # prefix-cache integrity: continuing prompt+got through the warm
+        # cache matches a fresh engine (nothing past the stop committed)
+        cont_warm, _ = await _generate(
+            eng, PROMPTS[0] + got, max_tokens=4, request_id="cont"
+        )
+    finally:
+        await eng.shutdown()
+    fresh = await JaxEngine.launch(_engine_config(overlap=False))
+    try:
+        cont_fresh, _ = await _generate(
+            fresh, PROMPTS[0] + got, max_tokens=4, request_id="cont2"
+        )
+    finally:
+        await fresh.shutdown()
+    assert cont_warm == cont_fresh
+
+
+async def test_overlap_under_block_pressure_matches_roomy_engine():
+    """Block exhaustion mid-pipeline: plan_pipelined_decode never
+    preempts with a step in flight — it drains back to the serial
+    planner, which preempts safely. Output under pressure (preemption +
+    recompute) must equal a roomy engine's greedy output."""
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    prompts = [list(range(1, 14)), list(range(3, 17)), list(range(2, 13))]
+
+    async def run(num_blocks):
+        eng = await JaxEngine.launch(
+            _engine_config(overlap=True, num_blocks=num_blocks)
+        )
+        try:
+            outs = await asyncio.gather(*[
+                _generate(eng, p, max_tokens=16, request_id=f"p{i}")
+                for i, p in enumerate(prompts)
+            ])
+            return [o[0] for o in outs], eng.scheduler.preemptions
+        finally:
+            await eng.shutdown()
+
+    # 13 usable blocks of 8 tokens: the three sequences need ~12 at
+    # their ends, so growth collides mid-decode and someone recomputes
+    tight, _ = await run(14)
+    roomy, roomy_preempt = await run(64)
+    assert roomy_preempt == 0
+    assert tight == roomy
+    assert all(len(t) == 16 for t in tight)
+
+
+async def test_overlap_records_phase_stamps():
+    """The flight recorder's decode records carry the overlap phase
+    stamps (overlap_ms / idle_gap_ms / sync_ms) so the win is
+    measurable, not asserted — and /debug/state exposes the tracker."""
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    eng = await JaxEngine.launch(_engine_config(overlap=True))
+    try:
+        await _generate(eng, PROMPTS[0], max_tokens=6)
+        recs = [r for r in eng.recorder.snapshot(64) if r["kind"] == "decode"]
+        assert recs, "no decode records"
+        piped = [r for r in recs if "overlap_ms" in r]
+        assert piped, "no pipelined decode records"
+        assert all("sync_ms" in r for r in piped)
+        assert any("idle_gap_ms" in r for r in recs)
+        dbg = eng.debug_state()["overlap"]
+        assert dbg["steps_dispatched"] > 0
+    finally:
+        await eng.shutdown()
