@@ -1,26 +1,42 @@
-"""dynalint: AST-based invariant checks for the async/TPU serving stack.
+"""dynalint: whole-program invariant checks for the async/TPU stack.
 
 The reference Dynamo leans on Rust's compiler to rule out whole classes
 of concurrency and resource bugs statically; this package is the Python
 reproduction's substitute guardrail. Pure stdlib (``ast`` + ``fnmatch``)
 — zero dependencies, runs at pytest time and on every PR.
 
+Two layers (docs/static_analysis.md):
+
+- per-file AST rules (DL0xx) over one ``LintModule`` at a time;
+- whole-program rules (DL1xx) over a :class:`LintProgram` — a
+  project-wide symbol table + call graph (``callgraph.py``) with
+  async-context / step-loop / thread-affinity taints propagated along
+  it (``taint.py``) — catching blocking calls, device syncs, and
+  undeclared cross-thread mutations hidden call levels deep.
+
 Public API::
 
     from dynamo_tpu.analysis import lint_paths, lint_source, all_rules
     findings = lint_paths(["dynamo_tpu"], config=load_config())
 
-CLI: ``dynamo-tpu lint [paths] [--format json]`` — exits non-zero on
-unsuppressed findings. Suppress a finding in place with
-``# dynalint: disable=<rule-name> — justification``.
+CLI: ``dynamo-tpu lint [paths] [--format json|github] [--changed]
+[--baseline FILE]`` — exits non-zero on gating findings. Suppress a
+finding in place with ``# dynalint: disable=<rule-name> —
+justification``; declare a deliberate cross-thread write with
+``# dynalint: handoff=<why>`` (plus ``affinity.handoff(...)`` for the
+runtime sanitizer).
 """
 
 from dynamo_tpu.analysis.config import DEFAULTS, load_config  # noqa: F401
 from dynamo_tpu.analysis.findings import (  # noqa: F401
     Finding,
+    apply_baseline,
+    format_github,
     format_json,
     format_text,
+    gating,
     unsuppressed,
+    write_baseline,
 )
 from dynamo_tpu.analysis.registry import (  # noqa: F401
     LintModule,
@@ -33,4 +49,33 @@ from dynamo_tpu.analysis.walker import (  # noqa: F401
     iter_files,
     lint_paths,
     lint_source,
+    lint_sources_program,
 )
+
+
+def __getattr__(name):
+    # program-layer API without import cycles at package import time
+    if name in (
+        "LintProgram",
+        "ProgramRule",
+        "all_program_rules",
+        "get_program_rule",
+        "program_rule",
+        "build_program",
+    ):
+        from dynamo_tpu.analysis import program
+
+        return getattr(program, name)
+    if name in ("CallGraph", "build_callgraph"):
+        from dynamo_tpu.analysis import callgraph
+
+        return getattr(callgraph, name)
+    if name in ("Taints", "compute_taints", "format_chain"):
+        from dynamo_tpu.analysis import taint
+
+        return getattr(taint, name)
+    if name in ("LintCache", "default_cache_dir", "rule_signature"):
+        from dynamo_tpu.analysis import cache
+
+        return getattr(cache, name)
+    raise AttributeError(name)

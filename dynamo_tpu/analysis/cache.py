@@ -1,0 +1,160 @@
+"""On-disk lint result cache: warm whole-repo lint in well under 5s.
+
+Keys are *content-derived*, so invalidation is automatic and exact:
+
+- per-file entry: ``sha256(file bytes) + rule-set signature + config
+  hash`` -> that file's per-file-rule findings;
+- whole-program entry: ``sha256(every file's sha, sorted by path) +
+  program-rule signature + config hash`` -> the program pass findings
+  (the call graph spans every file, so ANY edit invalidates it — the
+  per-file entries for untouched files still hit).
+
+The **rule-set signature folds in a hash of the analysis package's own
+sources**: editing a rule, the call-graph builder, or the taint engine
+invalidates every entry without a version knob to forget to bump.
+
+Storage is one JSON file under ``.dynalint_cache/`` next to
+pyproject.toml (gitignored), written atomically (tmp + rename) and
+pruned of entries unused for 7 days so stale blobs don't accumulate.
+Every failure path degrades to a miss — the cache must never be the
+reason lint is wrong or crashes; ``dynamo-tpu lint --no-cache``
+bypasses it entirely.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import tempfile
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from dynamo_tpu.analysis.findings import Finding
+
+_PRUNE_AFTER_S = 7 * 24 * 3600
+_pkg_hash: Optional[str] = None
+
+
+def _package_hash() -> str:
+    """sha256 over the analysis package's own sources (+ the affinity
+    vocabulary the rules read), computed once per process."""
+    global _pkg_hash
+    if _pkg_hash is None:
+        h = hashlib.sha256()
+        pkg = Path(__file__).parent
+        files = sorted(pkg.rglob("*.py"))
+        affinity = pkg.parent / "utils" / "affinity.py"
+        if affinity.exists():
+            files.append(affinity)
+        for f in files:
+            try:
+                h.update(f.name.encode())
+                h.update(f.read_bytes())
+            except OSError:
+                pass
+        _pkg_hash = h.hexdigest()[:16]
+    return _pkg_hash
+
+
+def file_sha(path: Path) -> Optional[str]:
+    try:
+        return hashlib.sha256(path.read_bytes()).hexdigest()
+    except OSError:
+        return None
+
+
+def rule_signature(rule_names: List[str], config: dict) -> str:
+    """One token binding the enabled rules + config + analyzer code."""
+    h = hashlib.sha256()
+    h.update(",".join(sorted(rule_names)).encode())
+    h.update(json.dumps(config, sort_keys=True, default=str).encode())
+    h.update(_package_hash().encode())
+    return h.hexdigest()[:16]
+
+
+class LintCache:
+    def __init__(self, cache_dir: Path):
+        self.path = Path(cache_dir) / "cache.json"
+        self.hits = 0
+        self.misses = 0
+        self._dirty = False
+        self._entries: Dict[str, dict] = {}
+        try:
+            data = json.loads(self.path.read_text())
+            if isinstance(data, dict) and data.get("version") == 1:
+                self._entries = data.get("entries", {})
+        except (OSError, ValueError):
+            self._entries = {}
+
+    # -- keys ------------------------------------------------------------
+    @staticmethod
+    def file_key(path: str, sha: str, sig: str) -> str:
+        # path is part of the key: findings embed it, so identical
+        # content at a new location must not replay the old path
+        ph = hashlib.sha256(path.encode()).hexdigest()[:12]
+        return f"f:{sha}:{ph}:{sig}"
+
+    @staticmethod
+    def program_key(shas: Dict[str, str], sig: str) -> str:
+        h = hashlib.sha256()
+        for path in sorted(shas):
+            h.update(path.encode())
+            h.update(shas[path].encode())
+        return f"p:{h.hexdigest()[:32]}:{sig}"
+
+    # -- get/put ---------------------------------------------------------
+    def get(self, key: str) -> Optional[List[Finding]]:
+        entry = self._entries.get(key)
+        if entry is None:
+            self.misses += 1
+            return None
+        entry["ts"] = time.time()
+        self._dirty = True  # ts refresh keeps hot entries alive
+        self.hits += 1
+        try:
+            return [Finding(**f) for f in entry["findings"]]
+        except (TypeError, KeyError):
+            self.misses += 1
+            return None
+
+    def put(self, key: str, findings: List[Finding]) -> None:
+        self._entries[key] = {
+            "ts": time.time(),
+            "findings": [dataclasses.asdict(f) for f in findings],
+        }
+        self._dirty = True
+
+    # -- persistence -----------------------------------------------------
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        now = time.time()
+        entries = {
+            k: v
+            for k, v in self._entries.items()
+            if now - v.get("ts", 0) < _PRUNE_AFTER_S
+        }
+        payload = json.dumps({"version": 1, "entries": entries})
+        try:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(
+                dir=str(self.path.parent), suffix=".tmp"
+            )
+            with os.fdopen(fd, "w") as f:
+                f.write(payload)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # a cache that can't persist is just a cold cache
+
+
+def default_cache_dir(start: Path) -> Optional[Path]:
+    """.dynalint_cache/ next to the governing pyproject.toml."""
+    from dynamo_tpu.analysis.config import find_pyproject
+
+    pyproject = find_pyproject(start)
+    if pyproject is None:
+        return None
+    return pyproject.parent / ".dynalint_cache"

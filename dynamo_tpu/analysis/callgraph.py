@@ -1,0 +1,643 @@
+"""Whole-program symbol table + call graph for dynalint.
+
+Per-file AST rules (DL001-DL010) see one frame at a time; a blocking
+call or device sync hidden one call level deep is invisible to them.
+This module builds the project-wide view the DL1xx rules run on:
+
+- a **symbol table**: every module, class, function, and method in the
+  linted file set, addressed by qualname ``pkg.mod:Class.method`` /
+  ``pkg.mod:func`` / ``pkg.mod:outer.<locals>.inner``;
+- a **call graph**: best-effort resolution of every ``ast.Call`` to a
+  project function — direct names, imported symbols (``import a.b as
+  c`` / ``from a.b import f``), ``self.``/``cls.`` method dispatch
+  (including one level of attribute-type inference from
+  ``self.x = ClassName(...)`` in any method), class instantiation
+  (edge to ``__init__``), ``functools.partial`` unwrapping, and
+  function *references* passed as callbacks;
+- **edge kinds**: a reference passed to a thread-handoff construct
+  (``run_in_executor``, ``asyncio.to_thread``, ``threading.Thread
+  (target=...)``, ``call_soon_threadsafe``,
+  ``run_coroutine_threadsafe``) is a ``spawn``/``to_loop`` edge, not a
+  same-context call — the taint passes (taint.py) must not propagate
+  the caller's execution context across it;
+- **unresolved calls are counted, not dropped**: dynamic dispatch we
+  can't see (``getattr(obj, name)()``, callables in dicts, externals'
+  callbacks) is tallied per caller so the analysis reports its own
+  blind spots instead of silently pretending coverage.
+
+Resolution is deliberately conservative-but-useful: a miss becomes an
+``unresolved`` entry (no edge), never a wrong edge to an unrelated
+symbol.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from dynamo_tpu.analysis.astutil import dotted_name
+
+# call-site receivers that hand a callable to ANOTHER thread/loop.
+# value = the execution context the callable lands on: "other" (a fresh
+# or pool thread), "loop" (the event loop). Matched on the last one or
+# two segments of the dotted receiver.
+HANDOFF_RECEIVERS: Dict[str, str] = {
+    "run_in_executor": "other",
+    "to_thread": "other",
+    "call_soon_threadsafe": "loop",
+    "call_soon": "loop",
+    "call_later": "loop",
+    "run_coroutine_threadsafe": "loop",
+    "Thread": "other",  # threading.Thread(target=...)
+    "spawn": "loop",  # utils.tasks.spawn(coro) — stays on the loop
+    "create_task": "loop",
+    "ensure_future": "loop",
+}
+
+# edge kinds
+CALL = "call"  # same execution context: caller's frame invokes callee
+REF = "ref"  # callable passed around in the same context (callback)
+SPAWN_OTHER = "spawn-other"  # callee runs on some other thread
+SPAWN_LOOP = "spawn-loop"  # callee runs on the event loop
+
+# same-context kinds (taint flows across these)
+SAME_CONTEXT = (CALL, REF)
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str  # "pkg.mod:Class.method" or "pkg.mod:func"
+    module: str  # dotted module name
+    path: str  # source file
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    is_async: bool
+    cls: Optional[str] = None  # enclosing class qualname ("pkg.mod:Cls")
+    decorators: List[str] = field(default_factory=list)  # dotted names
+    affinity: Optional[str] = None  # @thread_affinity("...") literal
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1].split(":")[-1]
+
+    @property
+    def lineno(self) -> int:
+        return getattr(self.node, "lineno", 1)
+
+
+@dataclass
+class ClassInfo:
+    qualname: str  # "pkg.mod:Cls"
+    module: str
+    path: str
+    node: ast.ClassDef
+    bases: List[str] = field(default_factory=list)  # raw dotted names
+    methods: Dict[str, str] = field(default_factory=dict)  # name -> fn qualname
+    # self.<attr> = ClassName(...) inference: attr -> class qualname
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    affinity: Optional[str] = None  # @thread_affinity on the class
+
+
+@dataclass
+class Edge:
+    caller: str
+    callee: str
+    kind: str  # CALL | REF | SPAWN_OTHER | SPAWN_LOOP
+    lineno: int
+
+
+@dataclass
+class CallGraph:
+    functions: Dict[str, FunctionInfo] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    edges: List[Edge] = field(default_factory=list)
+    # caller qualname -> raw call strings that did not resolve
+    unresolved: Dict[str, List[str]] = field(default_factory=dict)
+    # module dotted name -> {local symbol -> fully dotted target}
+    imports: Dict[str, Dict[str, str]] = field(default_factory=dict)
+
+    # -- derived views ---------------------------------------------------
+    def out_edges(self, caller: str) -> List[Edge]:
+        return self._by_caller.get(caller, [])
+
+    def in_edges(self, callee: str) -> List[Edge]:
+        return self._by_callee.get(callee, [])
+
+    def freeze(self) -> None:
+        """Build the adjacency indexes once the edge list is final."""
+        self._by_caller: Dict[str, List[Edge]] = {}
+        self._by_callee: Dict[str, List[Edge]] = {}
+        for e in self.edges:
+            self._by_caller.setdefault(e.caller, []).append(e)
+            self._by_callee.setdefault(e.callee, []).append(e)
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "functions": len(self.functions),
+            "classes": len(self.classes),
+            "edges": len(self.edges),
+            "unresolved_calls": sum(len(v) for v in self.unresolved.values()),
+        }
+
+
+def module_name_for(path: Path, roots: Optional[List[Path]] = None) -> str:
+    """Dotted module name for a file: walk up while __init__.py exists
+    (the project layout truth), so dynamo_tpu/ops/kv_quant.py maps to
+    dynamo_tpu.ops.kv_quant regardless of cwd."""
+    path = path.resolve()
+    parts = [path.stem] if path.name != "__init__.py" else []
+    cur = path.parent
+    while (cur / "__init__.py").exists():
+        parts.append(cur.name)
+        cur = cur.parent
+    if not parts:  # stray script: module name is the stem
+        parts = [path.stem]
+    return ".".join(reversed(parts))
+
+
+def _decorator_names(node: ast.AST) -> List[str]:
+    out = []
+    for d in getattr(node, "decorator_list", []):
+        target = d.func if isinstance(d, ast.Call) else d
+        name = dotted_name(target)
+        if name:
+            out.append(name)
+    return out
+
+
+def _affinity_literal(node: ast.AST) -> Optional[str]:
+    """The literal domain from a @thread_affinity("...") decorator."""
+    for d in getattr(node, "decorator_list", []):
+        if not isinstance(d, ast.Call):
+            continue
+        name = dotted_name(d.func) or ""
+        if name.split(".")[-1] == "thread_affinity" and d.args:
+            arg = d.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                return arg.value
+    return None
+
+
+class _ModuleIndexer(ast.NodeVisitor):
+    """First pass over one module: symbols + imports (no call edges)."""
+
+    def __init__(self, graph: CallGraph, module: str, path: str,
+                 tree: ast.Module):
+        self.graph = graph
+        self.module = module
+        self.path = path
+        self.tree = tree
+        self.imports: Dict[str, str] = {}
+        self._scope: List[str] = []  # qualname suffix stack
+        self._class: List[str] = []  # enclosing class qualnames
+        self._class_depth: List[int] = []  # len(_scope) at class entry
+        self._in_function = False
+
+    def run(self) -> None:
+        self.graph.imports[self.module] = self.imports
+        self.visit(self.tree)
+
+    # imports ------------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            if a.asname:
+                self.imports[a.asname] = a.name
+            else:
+                # `import a.b.c` binds `a`; dotted call spellings
+                # (a.b.c.f()) resolve through the full prefix entry
+                self.imports[a.name.split(".")[0]] = a.name.split(".")[0]
+                if "." in a.name:
+                    self.imports[a.name] = a.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.level:  # relative: resolve against this module/package
+            parts = self.module.split(".")
+            # in pkg/mod.py (module pkg.mod) "from ." is pkg: strip 1;
+            # in pkg/__init__.py (module pkg) "from ." is pkg: strip 0
+            strip = node.level if not self._is_package() else node.level - 1
+            anchor = ".".join(parts[: len(parts) - strip]) if strip else \
+                self.module
+            prefix = anchor + ("." + node.module if node.module else "")
+        else:
+            prefix = node.module or ""
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imports[a.asname or a.name] = (
+                f"{prefix}.{a.name}" if prefix else a.name
+            )
+
+    def _is_package(self) -> bool:
+        return Path(self.path).name == "__init__.py"
+
+    # defs ---------------------------------------------------------------
+    def _qual(self, name: str) -> str:
+        if self._scope:
+            return f"{self.module}:{'.'.join(self._scope)}.{name}"
+        return f"{self.module}:{name}"
+
+    def _add_function(self, node, is_async: bool) -> None:
+        qn = self._qual(node.name)
+        in_class_body = bool(self._class) and \
+            len(self._scope) == self._class_depth[-1]
+        info = FunctionInfo(
+            qualname=qn,
+            module=self.module,
+            path=self.path,
+            node=node,
+            is_async=is_async,
+            cls=self._class[-1] if in_class_body else None,
+            decorators=_decorator_names(node),
+            affinity=_affinity_literal(node),
+        )
+        self.graph.functions[qn] = info
+        if info.cls is not None:
+            self.graph.classes[info.cls].methods[node.name] = qn
+        # children defined inside this function are <locals>-scoped
+        self._scope.append(f"{node.name}.<locals>")
+        was = self._in_function
+        self._in_function = True
+        self.generic_visit(node)
+        self._in_function = was
+        self._scope.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._add_function(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._add_function(node, is_async=True)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        qn = self._qual(node.name)
+        self.graph.classes[qn] = ClassInfo(
+            qualname=qn,
+            module=self.module,
+            path=self.path,
+            node=node,
+            bases=[b for b in (dotted_name(x) for x in node.bases) if b],
+            affinity=_affinity_literal(node),
+        )
+        self._scope.append(node.name)
+        self._class.append(qn)
+        self._class_depth.append(len(self._scope))
+        self.generic_visit(node)
+        self._class_depth.pop()
+        self._class.pop()
+        self._scope.pop()
+
+
+def _infer_attr_types(graph: CallGraph) -> None:
+    """self.<attr> = ClassName(...) in any method -> attr type, so
+    ``self.scheduler.plan()`` resolves into the Scheduler class."""
+    for cls in graph.classes.values():
+        for mname, fq in cls.methods.items():
+            fn = graph.functions.get(fq)
+            if fn is None:
+                continue
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                value = node.value
+                if isinstance(value, ast.BoolOp):
+                    # the `self.x = x or Default()` idiom: type from the
+                    # constructor operand
+                    calls = [v for v in value.values
+                             if isinstance(v, ast.Call)]
+                    value = calls[-1] if calls else value
+                if not isinstance(value, ast.Call):
+                    continue
+                cname = dotted_name(value.func)
+                if not cname:
+                    continue
+                target_cls = _resolve_class(graph, fn.module, cname)
+                if target_cls is None:
+                    continue
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        cls.attr_types.setdefault(t.attr, target_cls)
+
+
+def _resolve_symbol(graph: CallGraph, module: str, name: str) -> Optional[str]:
+    """Resolve a dotted name as seen from ``module`` to a project
+    function qualname (follows import aliases one level)."""
+    imports = graph.imports.get(module, {})
+    head, _, rest = name.partition(".")
+    # same-module function (incl. nested refs by bare name)
+    if not rest:
+        qn = f"{module}:{name}"
+        if qn in graph.functions:
+            return qn
+        target = imports.get(name)
+        if target:
+            return _dotted_to_function(graph, target)
+        return None
+    # head is an import alias: a module or a symbol
+    target = imports.get(head)
+    if target:
+        return _dotted_to_function(graph, f"{target}.{rest}")
+    # fully dotted name used without alias (import a.b.c)
+    return _dotted_to_function(graph, name)
+
+
+def _dotted_to_function(
+    graph: CallGraph, dotted: str, _seen: Optional[Set[str]] = None
+) -> Optional[str]:
+    """pkg.mod.func / pkg.mod.Cls.method -> qualname, if in-project."""
+    seen = _seen if _seen is not None else set()
+    if dotted in seen:  # re-export cycle (import x as x, pkg __init__s)
+        return None
+    seen.add(dotted)
+    parts = dotted.split(".")
+    for split in range(len(parts) - 1, 0, -1):
+        mod = ".".join(parts[:split])
+        if mod not in graph.imports:  # not a project module
+            continue
+        sym = ".".join(parts[split:])
+        qn = f"{mod}:{sym}"
+        if qn in graph.functions:
+            return qn
+        # Cls.method
+        if "." in sym:
+            cls_name, _, meth = sym.rpartition(".")
+            cls = graph.classes.get(f"{mod}:{cls_name}")
+            if cls and meth in cls.methods:
+                return cls.methods[meth]
+        # Cls -> __init__
+        cls = graph.classes.get(qn)
+        if cls is not None:
+            return cls.methods.get("__init__")
+        # re-exported symbol (from x import y in mod's __init__)
+        reexport = graph.imports.get(mod, {}).get(sym.split(".")[0])
+        if reexport:
+            tail = sym.partition(".")[2]
+            return _dotted_to_function(
+                graph, reexport + ("." + tail if tail else ""), seen
+            )
+    return None
+
+
+def _resolve_class(graph: CallGraph, module: str, name: str) -> Optional[str]:
+    """Resolve a dotted name to a project class qualname."""
+    imports = graph.imports.get(module, {})
+    head, _, rest = name.partition(".")
+    if not rest:
+        qn = f"{module}:{name}"
+        if qn in graph.classes:
+            return qn
+        target = imports.get(name)
+        if target:
+            return _dotted_to_class(graph, target)
+        return None
+    target = imports.get(head)
+    if target:
+        return _dotted_to_class(graph, f"{target}.{rest}")
+    return _dotted_to_class(graph, name)
+
+
+def _dotted_to_class(
+    graph: CallGraph, dotted: str, _seen: Optional[Set[str]] = None
+) -> Optional[str]:
+    seen = _seen if _seen is not None else set()
+    if dotted in seen:
+        return None
+    seen.add(dotted)
+    parts = dotted.split(".")
+    for split in range(len(parts), 0, -1):
+        mod = ".".join(parts[:split])
+        if mod not in graph.imports:
+            continue
+        sym = ".".join(parts[split:])
+        if not sym:
+            continue
+        qn = f"{mod}:{sym}"
+        if qn in graph.classes:
+            return qn
+        reexport = graph.imports.get(mod, {}).get(sym.split(".")[0])
+        if reexport:
+            tail = sym.partition(".")[2]
+            return _dotted_to_class(
+                graph, reexport + ("." + tail if tail else ""), seen
+            )
+    return None
+
+
+def _method_in_mro(graph: CallGraph, cls_qn: str, method: str,
+                   _seen: Optional[Set[str]] = None) -> Optional[str]:
+    """Look up a method through project-local base classes."""
+    seen = _seen or set()
+    if cls_qn in seen:
+        return None
+    seen.add(cls_qn)
+    cls = graph.classes.get(cls_qn)
+    if cls is None:
+        return None
+    if method in cls.methods:
+        return cls.methods[method]
+    for base in cls.bases:
+        base_qn = _resolve_class(graph, cls.module, base)
+        if base_qn:
+            hit = _method_in_mro(graph, base_qn, method, seen)
+            if hit:
+                return hit
+    return None
+
+
+class _CallResolver(ast.NodeVisitor):
+    """Second pass: walk one function's own frame and emit edges."""
+
+    def __init__(self, graph: CallGraph, fn: FunctionInfo):
+        self.graph = graph
+        self.fn = fn
+
+    def run(self) -> None:
+        # body only: decorator expressions run at import time, not in
+        # this function's frame
+        for child in self.fn.node.body:
+            self._walk(child)
+
+    def _walk(self, node: ast.AST) -> None:
+        # stay in this frame: nested defs resolve their own bodies
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # defining a nested function is a same-context REF edge —
+            # if the parent never calls it the taint is conservative,
+            # which is the right direction for a linter
+            nested = self._nested_qualname(node.name)
+            if nested:
+                self._edge(nested, REF, node.lineno)
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.Call):
+            self._handle_call(node)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child)
+
+    def _nested_qualname(self, name: str) -> Optional[str]:
+        qn = f"{self.fn.qualname}.<locals>.{name}"
+        return qn if qn in self.graph.functions else None
+
+    def _enclosing_class(self) -> Optional[str]:
+        """The class a closure's ``self`` refers to: walk the qualname
+        up past ``<locals>`` segments to the outermost method."""
+        if self.fn.cls is not None:
+            return self.fn.cls
+        if "<locals>" not in self.fn.qualname:
+            return None
+        outer_qn = self.fn.qualname.split(".<locals>.", 1)[0]
+        outer = self.graph.functions.get(outer_qn)
+        return outer.cls if outer else None
+
+    def _edge(self, callee: str, kind: str, lineno: int) -> None:
+        self.graph.edges.append(
+            Edge(caller=self.fn.qualname, callee=callee, kind=kind,
+                 lineno=lineno)
+        )
+
+    def _unresolved(self, raw: str) -> None:
+        self.graph.unresolved.setdefault(self.fn.qualname, []).append(raw)
+
+    # -- resolution ------------------------------------------------------
+    def _handle_call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func)
+        handled_args: Set[int] = set()
+        if name is not None:
+            tail = name.split(".")[-1]
+            handoff = HANDOFF_RECEIVERS.get(tail)
+            if handoff is not None:
+                handled_args = self._handoff_refs(node, handoff)
+            if tail == "partial" and node.args:
+                # functools.partial(f, ...): same-context ref to f
+                target = self._resolve_expr(node.args[0])
+                if target:
+                    self._edge(target, REF, node.lineno)
+                handled_args.add(0)
+            target = self._resolve_call_name(name)
+            if target is not None:
+                self._edge(target, CALL, node.lineno)
+            elif handoff is None and not self._is_external(name):
+                self._unresolved(name)
+        else:
+            # getattr(x, n)(), obj.table[k](), (a or b)() — dynamic
+            self._unresolved(ast.unparse(node.func)[:60] if hasattr(
+                ast, "unparse") else "<dynamic>")
+        # callable references in arguments (callbacks): same context
+        for i, arg in enumerate(node.args):
+            if i in handled_args:
+                continue
+            ref = self._resolve_expr(arg)
+            if ref:
+                self._edge(ref, REF, node.lineno)
+        for kw in node.keywords:
+            if kw.arg == "target" and "Thread" in (name or ""):
+                continue  # handled by _handoff_refs
+            ref = self._resolve_expr(kw.value)
+            if ref:
+                self._edge(ref, REF, node.lineno)
+
+    def _handoff_refs(self, node: ast.Call, context: str) -> Set[int]:
+        """Emit spawn edges for callables handed to another context;
+        returns positional arg indexes consumed."""
+        kind = SPAWN_LOOP if context == "loop" else SPAWN_OTHER
+        consumed: Set[int] = set()
+        for i, arg in enumerate(node.args):
+            target = self._resolve_expr(arg)
+            if target:
+                self._edge(target, kind, node.lineno)
+                consumed.add(i)
+        for kw in node.keywords:
+            if kw.arg in ("target", "func", "callback"):
+                target = self._resolve_expr(kw.value)
+                if target:
+                    self._edge(target, kind, node.lineno)
+        return consumed
+
+    def _resolve_expr(self, expr: ast.AST) -> Optional[str]:
+        """A bare function reference (or call producing a coroutine —
+        ``run_coroutine_threadsafe(coro_fn(...), loop)``)."""
+        if isinstance(expr, ast.Call):
+            # coroutine objects / partial results: resolve the callee
+            inner = dotted_name(expr.func)
+            if inner and inner.split(".")[-1] == "partial" and expr.args:
+                return self._resolve_expr(expr.args[0])
+            if inner:
+                return self._resolve_call_name(inner)
+            return None
+        name = dotted_name(expr)
+        if name is None:
+            return None
+        return self._resolve_call_name(name)
+
+    def _resolve_call_name(self, name: str) -> Optional[str]:
+        graph, fn = self.graph, self.fn
+        parts = name.split(".")
+        if parts[0] in ("self", "cls"):
+            cls_qn = self._enclosing_class()
+            if cls_qn is None:
+                return None
+            if len(parts) == 2:
+                return _method_in_mro(graph, cls_qn, parts[1])
+            if len(parts) >= 3:
+                # self.attr.method(): one level of attr-type inference
+                cls = graph.classes.get(cls_qn)
+                attr_cls = cls.attr_types.get(parts[1]) if cls else None
+                if attr_cls is not None:
+                    return _method_in_mro(graph, attr_cls, parts[2])
+            return None
+        # nested function in the enclosing chain
+        if len(parts) == 1:
+            qn = self._nested_qualname(parts[0])
+            if qn:
+                return qn
+            # sibling nested function (shared parent scope)
+            if "<locals>" in fn.qualname:
+                parent = fn.qualname.rsplit(".<locals>.", 1)[0]
+                sibling = f"{parent}.<locals>.{parts[0]}"
+                if sibling in graph.functions:
+                    return sibling
+        resolved = _resolve_symbol(graph, fn.module, name)
+        if resolved:
+            return resolved
+        # ClassName(...) instantiation -> __init__
+        cls_qn = _resolve_class(graph, fn.module, name)
+        if cls_qn:
+            init = _method_in_mro(graph, cls_qn, "__init__")
+            return init
+        return None
+
+    def _is_external(self, name: str) -> bool:
+        """True when the call clearly targets an import we know is NOT
+        a project module (stdlib/third-party): not 'unresolved', just
+        out of scope."""
+        head = name.split(".")[0]
+        imports = self.graph.imports.get(self.fn.module, {})
+        target = imports.get(head)
+        if target is None:
+            # builtins (len, print, isinstance...) and local variables:
+            # plain single names are out-of-scope, dotted ones through
+            # unknown receivers are dynamic -> count those
+            return "." not in name
+        root = target.split(".")[0]
+        return not any(m == root or m.startswith(root + ".")
+                       for m in self.graph.imports)
+
+
+def build_callgraph(
+    modules: List[Tuple[str, ast.Module]],  # (path, parsed tree)
+) -> CallGraph:
+    """Build the project call graph from parsed modules."""
+    graph = CallGraph()
+    indexed = []
+    for path, tree in modules:
+        mod = module_name_for(Path(path))
+        indexed.append((mod, path, tree))
+        _ModuleIndexer(graph, mod, str(path), tree).run()
+    _infer_attr_types(graph)
+    for fn in list(graph.functions.values()):
+        _CallResolver(graph, fn).run()
+    graph.freeze()
+    return graph

@@ -1,19 +1,34 @@
 """`dynamo-tpu lint` — run dynalint from the command line.
 
-Exit codes: 0 clean, 1 unsuppressed findings (merge-gating), 2 usage
+Exit codes: 0 clean, 1 gating findings (merge-blocking), 2 usage
 error. ``--format json`` emits the machine-readable report on stdout so
-CI can archive it; the exit code gates either way.
+CI can archive it; ``--format github`` emits workflow-command
+annotations that land inline on a PR diff; the exit code gates either
+way. ``--changed`` scopes the *report* to files touched vs git HEAD
+(the whole-program pass still sees the full project — a one-line edit
+can create a transitive finding in the file it touched). ``--baseline``
+grandfathers a findings backlog: listed findings warn, new ones fail;
+``--update-baseline`` rewrites the file from the current state.
 """
 
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 from pathlib import Path
-from typing import Any
+from typing import Any, Optional
 
-from dynamo_tpu.analysis.config import load_config
-from dynamo_tpu.analysis.findings import format_json, format_text, unsuppressed
+from dynamo_tpu.analysis.config import find_pyproject, load_config
+from dynamo_tpu.analysis.findings import (
+    apply_baseline,
+    format_github,
+    format_json,
+    format_text,
+    gating,
+    write_baseline,
+)
+from dynamo_tpu.analysis.program import all_program_rules, get_program_rule
 from dynamo_tpu.analysis.registry import all_rules, get_rule
 from dynamo_tpu.analysis.walker import iter_files, lint_paths
 
@@ -23,16 +38,18 @@ def add_lint_parser(sub: Any) -> None:
     lint = sub.add_parser(
         "lint",
         help="static invariant checks for the async/TPU serving stack",
-        description="AST-based repo linter (dynalint). Rules target the "
-        "failure modes this codebase actually has: blocked event loops, "
-        "dropped task handles, swallowed cancellation, host syncs in jit "
-        "paths, awaits under thread locks, bare excepts.",
+        description="Whole-program repo linter (dynalint). Per-file AST "
+        "rules (DL0xx) target blocked event loops, dropped task handles, "
+        "swallowed cancellation, host syncs in jit paths, awaits under "
+        "thread locks; whole-program rules (DL1xx) propagate async/"
+        "step-loop/thread-affinity taints over the project call graph to "
+        "catch the same bugs hidden one or more call levels deep.",
     )
     lint.add_argument("paths", nargs="*", default=None,
                       help="files/dirs to lint (default: [tool.dynalint] "
                            "include, i.e. dynamo_tpu/)")
     lint.add_argument("--format", dest="fmt", default="text",
-                      choices=["text", "json"])
+                      choices=["text", "json", "github"])
     lint.add_argument("--rules", default=None,
                       help="comma-separated rule names to run "
                            "(default: all minus config `disable`)")
@@ -42,26 +59,93 @@ def add_lint_parser(sub: Any) -> None:
                       help="text format: also print waived findings")
     lint.add_argument("--pyproject", default=None,
                       help="explicit pyproject.toml for [tool.dynalint]")
+    lint.add_argument("--changed", action="store_true",
+                      help="report only findings in files changed vs git "
+                           "HEAD (incl. untracked); the whole-program "
+                           "pass still analyzes the full project")
+    lint.add_argument("--no-cache", action="store_true",
+                      help="bypass the on-disk result cache "
+                           "(.dynalint_cache/)")
+    lint.add_argument("--stats", action="store_true",
+                      help="print cache + call-graph statistics to stderr")
+    lint.add_argument("--baseline", default=None,
+                      help="baseline file: listed findings warn instead "
+                           "of gating (default: config `baseline` key)")
+    lint.add_argument("--update-baseline", action="store_true",
+                      help="rewrite the baseline file from the current "
+                           "live findings, then exit 0")
+
+
+def _changed_files(repo_root: Path) -> Optional[set[Path]]:
+    """Files changed vs HEAD plus untracked, absolute; None = git
+    unavailable (the caller degrades to a full report). Paths are
+    anchored at the git TOPLEVEL — `git diff --name-only` always
+    reports relative to it, which is not necessarily the pyproject
+    directory (monorepos)."""
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"], cwd=repo_root,
+            capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if top.returncode != 0:
+        return None
+    toplevel = Path(top.stdout.strip())
+    out: set[Path] = set()
+    for args in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "-o", "--exclude-standard"],
+    ):
+        try:
+            r = subprocess.run(
+                args, cwd=toplevel, capture_output=True, text=True,
+                timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if r.returncode != 0:
+            return None
+        for line in r.stdout.splitlines():
+            if line.strip():
+                out.add((toplevel / line.strip()).resolve())
+    return out
+
+
+def _resolve_rules(spec: str):
+    """Split a --rules list across both registries."""
+    file_rules, prog_rules = [], []
+    for name in (n.strip() for n in spec.split(",")):
+        if not name:
+            continue
+        try:
+            file_rules.append(get_rule(name))
+            continue
+        except KeyError:
+            pass
+        prog_rules.append(get_program_rule(name))  # raises with catalog
+    return file_rules, prog_rules
 
 
 def cmd_lint(args: Any) -> int:
     if args.list_rules:
         for r in all_rules():
-            print(f"{r.code}  {r.name:26s} {r.summary}")
+            print(f"{r.code}  {r.name:34s} {r.summary}")
+        for r in all_program_rules():
+            print(f"{r.code}  {r.name:34s} {r.summary}")
         return 0
     # anchor config discovery at the linted tree, not the cwd: `dynamo-tpu
     # lint /repo/pkg` from anywhere must see /repo's [tool.dynalint]
     config = load_config(
         start=args.paths[0] if args.paths else ".", pyproject=args.pyproject
     )
+    file_rules = prog_rules = None
     if args.rules:
         try:
-            rules = [get_rule(n.strip()) for n in args.rules.split(",") if n.strip()]
+            file_rules, prog_rules = _resolve_rules(args.rules)
         except KeyError as exc:
             print(f"dynalint: {exc.args[0]}", file=sys.stderr)
             return 2
-    else:
-        rules = None  # lint_paths applies config `disable`
     paths = args.paths or list(config.get("include", ["dynamo_tpu"]))
     # a gate that scans nothing must fail loudly, not pass green: a
     # typo'd path (or running outside the repo) would otherwise report
@@ -77,12 +161,89 @@ def cmd_lint(args: Any) -> int:
         print(f"dynalint: no python files under: {', '.join(map(str, paths))}",
               file=sys.stderr)
         return 2
-    findings = lint_paths(paths, rules=rules, config=config, files=files)
+
+    cache = None
+    if not args.no_cache:
+        from dynamo_tpu.analysis.cache import LintCache, default_cache_dir
+
+        cache_dir = default_cache_dir(Path(str(paths[0])))
+        if cache_dir is not None:
+            cache = LintCache(cache_dir)
+    stats: dict = {}
+    findings = lint_paths(
+        paths,
+        rules=file_rules,
+        config=config,
+        files=files,
+        program_rules=prog_rules,
+        cache=cache,
+        stats_out=stats,
+    )
+    if args.stats:
+        if cache is not None:
+            print(
+                f"dynalint: cache {cache.hits} hit(s), "
+                f"{cache.misses} miss(es)",
+                file=sys.stderr,
+            )
+        graph_stats = stats.get("callgraph")
+        if graph_stats == "cached":
+            print("dynalint: program pass served from cache "
+                  "(no graph rebuilt)", file=sys.stderr)
+        elif isinstance(graph_stats, dict):
+            print(
+                "dynalint: call graph: "
+                + ", ".join(f"{k}={v}" for k, v in graph_stats.items()),
+                file=sys.stderr,
+            )
+
+    pyproject = (
+        Path(args.pyproject)
+        if args.pyproject
+        else find_pyproject(Path(str(paths[0])))
+    )
+    root = pyproject.parent if pyproject else None
+
+    baseline_arg = args.baseline or config.get("baseline") or None
+    baseline_path = None
+    if baseline_arg:
+        baseline_path = Path(baseline_arg)
+        if not baseline_path.is_absolute() and root is not None:
+            baseline_path = root / baseline_path
+    if args.update_baseline:
+        # BEFORE the --changed filter: rewriting the baseline from a
+        # scoped report would silently drop every other grandfathered
+        # entry and fail the next full-repo run
+        if baseline_path is None:
+            print("dynalint: --update-baseline needs --baseline PATH or a "
+                  "config `baseline` key", file=sys.stderr)
+            return 2
+        n = write_baseline(findings, baseline_path, root)
+        print(f"dynalint: baseline written: {n} grandfathered finding(s) "
+              f"-> {baseline_path}", file=sys.stderr)
+        return 0
+
+    if args.changed:
+        changed = _changed_files(root or Path.cwd())
+        if changed is None:
+            print("dynalint: --changed needs git; reporting everything",
+                  file=sys.stderr)
+        else:
+            findings = [
+                f for f in findings
+                if Path(f.path).resolve() in changed
+            ]
+
+    if baseline_path is not None and baseline_path.exists():
+        findings = apply_baseline(findings, baseline_path, root)
+
     if args.fmt == "json":
         print(format_json(findings))
+    elif args.fmt == "github":
+        print(format_github(findings))
     else:
         print(format_text(findings, show_suppressed=args.show_suppressed))
-    return 1 if unsuppressed(findings) else 0
+    return 1 if gating(findings) else 0
 
 
 def main(argv: list[str] | None = None) -> int:

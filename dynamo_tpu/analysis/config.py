@@ -7,7 +7,16 @@ Keys (all optional):
   disable       — rule names turned off globally
   hot-functions — extra function names treated as jit hot paths (DL004)
   step-loop-functions — function names treated as the engine step loop
-                  by hidden-host-sync-in-step-loop (DL010)
+                  by hidden-host-sync-in-step-loop (DL010) and as the
+                  seeds of the transitive DL102 taint
+  affinity-entry-points — "pattern=domain" strings seeding the thread-
+                  affinity taint (DL103) for entry points that carry no
+                  @thread_affinity decorator; pattern is a bare function
+                  name or an fnmatch over qualnames
+                  ("pkg.mod:Cls.method")
+  baseline      — path (relative to pyproject.toml) of the findings
+                  baseline file; listed findings warn instead of gating
+                  (see `dynamo-tpu lint --baseline/--update-baseline`)
 
 Parsing uses stdlib ``tomllib`` when present (3.11+), else the vendored
 ``tomli`` this environment ships; with neither, config silently falls
@@ -26,6 +35,8 @@ DEFAULTS: dict[str, Any] = {
     "disable": [],
     "hot-functions": [],
     "step-loop-functions": [],
+    "affinity-entry-points": [],
+    "baseline": "",
 }
 
 

@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import json
 from dataclasses import asdict, dataclass
+from pathlib import Path
+from typing import Optional
 
 
 @dataclass(frozen=True)
@@ -20,10 +22,16 @@ class Finding:
     col: int  # 0-based column
     message: str
     suppressed: bool = False
+    baselined: bool = False  # grandfathered by a --baseline file: warn
 
 
 def unsuppressed(findings: list[Finding]) -> list[Finding]:
     return [f for f in findings if not f.suppressed]
+
+
+def gating(findings: list[Finding]) -> list[Finding]:
+    """Findings that fail the build: live AND not grandfathered."""
+    return [f for f in findings if not f.suppressed and not f.baselined]
 
 
 def format_text(findings: list[Finding], *, show_suppressed: bool = False) -> str:
@@ -32,13 +40,44 @@ def format_text(findings: list[Finding], *, show_suppressed: bool = False) -> st
     for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code)):
         if f.suppressed and not show_suppressed:
             continue
-        tag = " (suppressed)" if f.suppressed else ""
+        tag = " (suppressed)" if f.suppressed else (
+            " (baseline)" if f.baselined else ""
+        )
         lines.append(
             f"{f.path}:{f.line}:{f.col + 1}: {f.code} [{f.rule}] {f.message}{tag}"
         )
-    live = len(unsuppressed(findings))
-    waived = len(findings) - live
-    lines.append(f"dynalint: {live} finding(s), {waived} suppressed")
+    live = len(gating(findings))
+    baselined = sum(1 for f in findings if f.baselined and not f.suppressed)
+    waived = len(findings) - live - baselined
+    summary = f"dynalint: {live} finding(s), {waived} suppressed"
+    if baselined:
+        summary += f", {baselined} baselined"
+    lines.append(summary)
+    return "\n".join(lines)
+
+
+def format_github(findings: list[Finding]) -> str:
+    """GitHub Actions workflow commands: gating findings annotate as
+    errors, baselined ones as warnings, suppressed ones are omitted —
+    the annotations land inline on the PR diff with no extra action."""
+
+    def esc(msg: str) -> str:
+        # workflow-command data escaping (%, CR, LF)
+        return (
+            msg.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+        )
+
+    lines = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code)):
+        if f.suppressed:
+            continue
+        level = "warning" if f.baselined else "error"
+        lines.append(
+            f"::{level} file={f.path},line={f.line},col={f.col + 1},"
+            f"title={f.code} [{f.rule}]::{esc(f.message)}"
+        )
+    live = len(gating(findings))
+    lines.append(f"dynalint: {live} finding(s)")
     return "\n".join(lines)
 
 
@@ -55,6 +94,68 @@ def format_json(findings: list[Finding]) -> str:
             "total": len(findings),
             "unsuppressed": len(unsuppressed(findings)),
             "suppressed": len(findings) - len(unsuppressed(findings)),
+            "baselined": sum(
+                1 for f in findings if f.baselined and not f.suppressed
+            ),
+            "gating": len(gating(findings)),
         },
     }
     return json.dumps(payload, indent=2)
+
+
+# -- baseline files -------------------------------------------------------
+# A baseline grandfathers existing findings so a newly-tightened rule can
+# gate NEW violations immediately while the backlog burns down: listed
+# findings warn, unlisted ones fail. Fingerprints are (rule, path,
+# message) — deliberately line-free, so unrelated edits that shift a
+# grandfathered finding up or down the file don't resurrect it.
+
+
+def _fingerprint(f: Finding, root: Optional[Path]) -> tuple[str, str, str]:
+    path = f.path
+    if root is not None:
+        try:
+            path = str(Path(path).resolve().relative_to(root.resolve()))
+        except ValueError:
+            pass
+    return (f.rule, path, f.message)
+
+
+def write_baseline(
+    findings: list[Finding], path: Path, root: Optional[Path] = None
+) -> int:
+    """Write the current live findings as the new baseline; returns the
+    entry count."""
+    entries = sorted(
+        {_fingerprint(f, root) for f in unsuppressed(findings)}
+    )
+    payload = {
+        "version": 1,
+        "findings": [
+            {"rule": r, "path": p, "message": m} for r, p, m in entries
+        ],
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n")
+    return len(entries)
+
+
+def apply_baseline(
+    findings: list[Finding], path: Path, root: Optional[Path] = None
+) -> list[Finding]:
+    """Demote findings listed in the baseline file to warnings."""
+    import dataclasses
+
+    try:
+        data = json.loads(path.read_text())
+        known = {
+            (e["rule"], e["path"], e["message"])
+            for e in data.get("findings", [])
+        }
+    except (OSError, ValueError, KeyError, TypeError):
+        return findings  # unreadable baseline = no grandfathering
+    out = []
+    for f in findings:
+        if not f.suppressed and _fingerprint(f, root) in known:
+            f = dataclasses.replace(f, baselined=True)
+        out.append(f)
+    return out

@@ -1,0 +1,92 @@
+"""Whole-program lint pass: LintProgram + the program-rule registry.
+
+Per-file rules (``registry.Rule``) see one ``LintModule``; program
+rules see a :class:`LintProgram` — every parsed module plus the call
+graph and taints — and can report findings anywhere in the project.
+They register with :func:`program_rule` and are run by the walker's
+``lint_paths`` after the per-file pass, through the same suppression
+and config-``disable`` machinery, so the CLI / pytest gate / API all
+agree.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Tuple
+
+from dynamo_tpu.analysis.callgraph import CallGraph, build_callgraph
+from dynamo_tpu.analysis.registry import LintModule
+from dynamo_tpu.analysis.taint import Taints, compute_taints
+
+# (path, anchor node, message)
+ProgramCheckResult = Iterable[Tuple[str, ast.AST, str]]
+
+
+@dataclass
+class LintProgram:
+    """Everything a whole-program rule needs, built once per run."""
+
+    modules: Dict[str, LintModule]  # path -> parsed module
+    graph: CallGraph
+    taints: Taints
+    config: Dict[str, Any] = field(default_factory=dict)
+
+    def function_module(self, qualname: str) -> LintModule | None:
+        fn = self.graph.functions.get(qualname)
+        return self.modules.get(fn.path) if fn else None
+
+
+@dataclass(frozen=True)
+class ProgramRule:
+    name: str
+    code: str
+    summary: str
+    check: Callable[[LintProgram], ProgramCheckResult]
+
+
+_PROGRAM_REGISTRY: Dict[str, ProgramRule] = {}
+
+
+def program_rule(name: str, code: str, summary: str):
+    """Register ``check(program)`` as a whole-program rule."""
+
+    def deco(check: Callable[[LintProgram], ProgramCheckResult]):
+        if name in _PROGRAM_REGISTRY:
+            raise ValueError(f"duplicate program rule {name!r}")
+        _PROGRAM_REGISTRY[name] = ProgramRule(
+            name=name, code=code, summary=summary, check=check
+        )
+        return check
+
+    return deco
+
+
+def all_program_rules() -> List[ProgramRule]:
+    import dynamo_tpu.analysis.rules  # noqa: F401  (registration)
+
+    return sorted(_PROGRAM_REGISTRY.values(), key=lambda r: r.code)
+
+
+def get_program_rule(name: str) -> ProgramRule:
+    import dynamo_tpu.analysis.rules  # noqa: F401
+
+    try:
+        return _PROGRAM_REGISTRY[name]
+    except KeyError:
+        known = ", ".join(sorted(_PROGRAM_REGISTRY))
+        raise KeyError(
+            f"unknown program rule {name!r} (known: {known})"
+        ) from None
+
+
+def build_program(
+    modules: Dict[str, LintModule], config: Dict[str, Any]
+) -> LintProgram:
+    graph = build_callgraph(
+        [(path, m.tree) for path, m in modules.items()]
+    )
+    taints = compute_taints(graph, config)
+    return LintProgram(
+        modules=modules, graph=graph, taints=taints, config=config
+    )

@@ -1,21 +1,28 @@
 """dynalint rule modules — importing this package registers every rule.
 
-Adding a rule: create a module here, decorate a ``check(module)``
-function with ``@rule(name, code, summary)`` from
+Adding a per-file rule: create a module here, decorate a
+``check(module)`` function with ``@rule(name, code, summary)`` from
 ``dynamo_tpu.analysis.registry``, and import the module below. Pick the
 next free DLxxx code; never reuse a retired one (suppression comments
 reference rule names, reports reference codes).
+
+Whole-program rules (DL1xx) decorate ``check(program)`` with
+``@program_rule(...)`` from ``dynamo_tpu.analysis.program`` instead —
+they see the call graph + taints rather than a single file.
 """
 
 from dynamo_tpu.analysis.rules import (  # noqa: F401
     await_locked,
     bare_except,
     blocking_async,
+    cross_thread,
     dropped_task,
     hidden_sync,
     host_sync_jit,
     retry_loop,
     swallowed_cancel,
+    transitive_blocking,
+    transitive_sync,
     unbounded_buffer,
     wall_clock,
 )

@@ -12,28 +12,14 @@ from __future__ import annotations
 import ast
 
 from dynamo_tpu.analysis.registry import LintModule, rule
-from dynamo_tpu.analysis.rules.common import FunctionScopeVisitor, dotted_name
+from dynamo_tpu.analysis.rules.common import (
+    BLOCKING_CALLS,
+    FunctionScopeVisitor,
+    dotted_name,
+)
 
-# dotted call name -> suggested replacement
-BLOCKING_CALLS = {
-    "time.sleep": "await asyncio.sleep(...)",
-    "subprocess.run": "asyncio.create_subprocess_exec(...)",
-    "subprocess.call": "asyncio.create_subprocess_exec(...)",
-    "subprocess.check_call": "asyncio.create_subprocess_exec(...)",
-    "subprocess.check_output": "asyncio.create_subprocess_exec(...)",
-    "subprocess.getoutput": "asyncio.create_subprocess_shell(...)",
-    "os.system": "asyncio.create_subprocess_shell(...)",
-    "socket.create_connection": "asyncio.open_connection(...)",
-    "socket.getaddrinfo": "loop.getaddrinfo(...)",
-    "socket.gethostbyname": "loop.getaddrinfo(...)",
-    "urllib.request.urlopen": "loop.run_in_executor(...)",
-    "requests.get": "loop.run_in_executor(...)",
-    "requests.post": "loop.run_in_executor(...)",
-    "requests.put": "loop.run_in_executor(...)",
-    "requests.delete": "loop.run_in_executor(...)",
-    "requests.head": "loop.run_in_executor(...)",
-    "requests.request": "loop.run_in_executor(...)",
-}
+# the shared table lives in common.py (DL101 reuses it for the
+# transitive pass); this module keeps the name for its callers
 
 
 @rule(

@@ -3,31 +3,12 @@
 from __future__ import annotations
 
 import ast
-from typing import Iterator, Optional
+from typing import Iterator, Optional  # noqa: F401
 
-
-def dotted_name(node: ast.AST) -> Optional[str]:
-    """``a.b.c`` for a Name/Attribute chain, else None."""
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def walk_in_scope(node: ast.AST) -> Iterator[ast.AST]:
-    """Walk ``node``'s body without descending into nested function
-    definitions — "what executes in THIS function's frame"."""
-    stack: list[ast.AST] = list(ast.iter_child_nodes(node))
-    while stack:
-        child = stack.pop()
-        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
-            continue
-        yield child
-        stack.extend(ast.iter_child_nodes(child))
+from dynamo_tpu.analysis.astutil import (  # noqa: F401
+    dotted_name,
+    walk_in_scope,
+)
 
 
 class FunctionScopeVisitor(ast.NodeVisitor):
@@ -57,3 +38,58 @@ class FunctionScopeVisitor(ast.NodeVisitor):
         self._scope.append("sync")
         self.generic_visit(node)
         self._scope.pop()
+
+
+# blocking sync calls that stall an event loop (DL001 direct-frame,
+# DL101 transitive) — dotted call name -> suggested replacement
+BLOCKING_CALLS = {
+    "time.sleep": "await asyncio.sleep(...)",
+    "subprocess.run": "asyncio.create_subprocess_exec(...)",
+    "subprocess.call": "asyncio.create_subprocess_exec(...)",
+    "subprocess.check_call": "asyncio.create_subprocess_exec(...)",
+    "subprocess.check_output": "asyncio.create_subprocess_exec(...)",
+    "subprocess.getoutput": "asyncio.create_subprocess_shell(...)",
+    "os.system": "asyncio.create_subprocess_shell(...)",
+    "socket.create_connection": "asyncio.open_connection(...)",
+    "socket.getaddrinfo": "loop.getaddrinfo(...)",
+    "socket.gethostbyname": "loop.getaddrinfo(...)",
+    "urllib.request.urlopen": "loop.run_in_executor(...)",
+    "requests.get": "loop.run_in_executor(...)",
+    "requests.post": "loop.run_in_executor(...)",
+    "requests.put": "loop.run_in_executor(...)",
+    "requests.delete": "loop.run_in_executor(...)",
+    "requests.head": "loop.run_in_executor(...)",
+    "requests.request": "loop.run_in_executor(...)",
+}
+
+# device->host sync operations (DL010 direct-frame, DL102 transitive)
+SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+SYNC_CALLS = {
+    "np.asarray",
+    "np.array",
+    "numpy.asarray",
+    "numpy.array",
+    "jax.device_get",
+    "jax.block_until_ready",
+    # the house sync primitive (parallel/multihost.py)
+    "host_value",
+    "multihost.host_value",
+}
+
+
+import re as _re
+
+_LOCK_NAME = _re.compile(r"(?:^|.*_)r?(?:lock|mutex)$")
+LOCK_CALLS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+
+
+def looks_like_thread_lock(expr: ast.AST) -> bool:
+    """Shared lock heuristic (DL005, DL103): the expression constructs a
+    threading lock or is a name whose last segment is lock/rlock/mutex
+    (word-boundary matched — `free_blocks` is not a lock)."""
+    if isinstance(expr, ast.Call):
+        return (dotted_name(expr.func) or "") in LOCK_CALLS
+    name = dotted_name(expr)
+    if name is None:
+        return False
+    return _LOCK_NAME.match(name.rsplit(".", 1)[-1].lower()) is not None

@@ -1,0 +1,307 @@
+"""DL103 cross-thread-mutation: an attribute written from a different
+concurrency domain than the one that owns it, without a declared
+handoff.
+
+The codebase's cross-thread seams are attribute flips: the event loop
+(planner degradation task) writes ``engine.spec_suspended`` while the
+engine thread reads it every step; watcher tasks update registries
+that other threads snapshot. Each of these is *fine when declared* —
+and a latent race when it isn't. This rule makes the declaration
+mandatory:
+
+**Ownership** comes from two sources:
+
+1. ``affinity.guard_attrs(obj, {"attr": "domain"})`` — the runtime
+   sanitizer's registration doubles as the static declaration, scoped
+   to the class whose method registers it. A write from a
+   differently-tainted function is flagged when the receiver's class
+   matches the declaring class — or cannot be resolved at all
+   (parameters, untyped attributes like degradation's ``self.engine``:
+   exactly the cross-object seams the rule exists for, kept
+   name-matched on purpose). A *resolvable* receiver of an unrelated
+   class that merely shares the attribute name is left to the
+   undeclared-conflict scan below.
+2. Undeclared attributes: per class, ``self.<attr>`` write sites are
+   grouped by the writing method's affinity taint
+   (analysis/taint.py: ``@thread_affinity`` declarations + coroutines
+   = "loop", propagated along calls). If one attribute is written from
+   two or more distinct domains, every cross-domain write site is
+   flagged — the attribute is de facto shared state and nobody said
+   so. ``__init__``/``__post_init__``/``__new__`` writes are
+   construction-time and exempt (the object is not shared yet).
+
+**A declared handoff waives the site.** Any of:
+
+- the write is inside ``with affinity.handoff(...)`` (the runtime
+  sanitizer's sanction — using it makes both planes agree);
+- the write is inside ``with <lock>:`` (DL005's word-boundary lock
+  heuristic: ``threading.Lock()`` / names ending in lock/rlock/mutex);
+- the statement's first line carries ``# dynalint: handoff=<why>`` —
+  an explicit declaration-with-justification, deliberately distinct
+  from ``disable=`` (a handoff is a design statement, not a waiver);
+- the value flows through ``queue.Queue`` / ``call_soon_threadsafe`` /
+  ``run_coroutine_threadsafe`` instead of a direct attribute write —
+  those simply never look like attribute writes, so they pass for
+  free.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from dynamo_tpu.analysis.program import LintProgram, program_rule
+from dynamo_tpu.analysis.rules.common import (
+    dotted_name,
+    looks_like_thread_lock,
+)
+from dynamo_tpu.analysis.taint import format_chain
+
+_HANDOFF_COMMENT = re.compile(r"#\s*dynalint:\s*handoff=")
+_CTOR_NAMES = {"__init__", "__post_init__", "__new__", "__enter__"}
+
+
+def _is_handoff_cm(expr: ast.AST) -> bool:
+    """``with affinity.handoff(...)`` / ``with handoff(...)``."""
+    if isinstance(expr, ast.Call):
+        name = dotted_name(expr.func) or ""
+        return name.split(".")[-1] == "handoff"
+    return False
+
+
+class _WriteCollector(ast.NodeVisitor):
+    """Attribute write sites in one function frame, with sanction info
+    from the enclosing ``with`` stack."""
+
+    def __init__(self, source_lines: List[str]):
+        self.lines = source_lines
+        self.sanction_depth = 0
+        # (receiver, attr, node, sanctioned)
+        self.writes: List[Tuple[str, str, ast.AST, bool]] = []
+
+    def _sanctioned(self, node: ast.AST) -> bool:
+        if self.sanction_depth > 0:
+            return True
+        i = getattr(node, "lineno", 0) - 1
+        if 0 <= i < len(self.lines) and _HANDOFF_COMMENT.search(self.lines[i]):
+            return True
+        return False
+
+    def _note(self, target: ast.AST, node: ast.AST) -> None:
+        if not isinstance(target, ast.Attribute):
+            return
+        receiver = dotted_name(target.value)
+        if receiver is None:
+            return
+        self.writes.append(
+            (receiver, target.attr, node, self._sanctioned(node))
+        )
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._note(t, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._note(node.target, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._note(node.target, node)
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        guards = any(
+            _is_handoff_cm(i.context_expr) or
+            looks_like_thread_lock(i.context_expr)
+            for i in node.items
+        )
+        if guards:
+            self.sanction_depth += 1
+        self.generic_visit(node)
+        if guards:
+            self.sanction_depth -= 1
+
+    # stay in this frame — nested defs are their own graph nodes with
+    # their own taints and get collected separately
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        pass
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass
+
+
+def _declared_attrs(program: LintProgram) -> Dict[str, Tuple[str, str]]:
+    """Scan for ``guard_attrs(obj, {literal})`` calls: attr name ->
+    (domain, declaring class qualname or '')."""
+    out: Dict[str, Tuple[str, str]] = {}
+    graph = program.graph
+    for qn, fn in graph.functions.items():
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if name.split(".")[-1] != "guard_attrs":
+                continue
+            if len(node.args) < 2 or not isinstance(node.args[1], ast.Dict):
+                continue
+            for k, v in zip(node.args[1].keys, node.args[1].values):
+                if (
+                    isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)
+                ):
+                    out[k.value] = (v.value, fn.cls or "")
+    return out
+
+
+def _enclosing_class_of(program: LintProgram, qn: str) -> Optional[str]:
+    fn = program.graph.functions.get(qn)
+    if fn is None:
+        return None
+    if fn.cls is not None:
+        return fn.cls
+    if "<locals>" in qn:
+        outer = program.graph.functions.get(qn.split(".<locals>.", 1)[0])
+        return outer.cls if outer else None
+    return None
+
+
+def _receiver_class(
+    program: LintProgram, qn: str, receiver: str
+) -> Optional[str]:
+    """Best-effort class of the write's receiver: ``self`` -> enclosing
+    class; ``self.<a>`` -> the enclosing class's inferred attr type;
+    anything else (parameters, locals) -> unknown (None)."""
+    parts = receiver.split(".")
+    if parts[0] not in ("self", "cls"):
+        return None
+    own = _enclosing_class_of(program, qn)
+    if own is None or len(parts) == 1:
+        return own
+    if len(parts) == 2:
+        cls = program.graph.classes.get(own)
+        return cls.attr_types.get(parts[1]) if cls else None
+    return None
+
+
+@program_rule(
+    "cross-thread-mutation",
+    "DL103",
+    "attribute written from a different concurrency domain than its "
+    "owner without a declared handoff (queue/call_soon_threadsafe/"
+    "lock/affinity.handoff/# dynalint: handoff=)",
+)
+def check(program: LintProgram):
+    graph = program.graph
+    taints = program.taints
+    declared = _declared_attrs(program)
+
+    # collect write sites once per function
+    # qn -> [(receiver, attr, node, sanctioned)]
+    sites: Dict[str, List[Tuple[str, str, ast.AST, bool]]] = {}
+    for qn, fn in graph.functions.items():
+        module = program.modules.get(fn.path)
+        if module is None:
+            continue
+        collector = _WriteCollector(module.source.splitlines())
+        for stmt in fn.node.body:
+            collector.visit(stmt)
+        if collector.writes:
+            sites[qn] = collector.writes
+
+    # -- pass 1: declared attributes, any receiver -----------------------
+    for qn, writes in sites.items():
+        fn = graph.functions[qn]
+        if fn.name in _CTOR_NAMES:
+            continue
+        domains = taints.domains(qn)
+        if not domains:
+            continue
+        for receiver, attr, node, sanctioned in writes:
+            decl = declared.get(attr)
+            if decl is None or sanctioned:
+                continue
+            owner_domain, owner_cls = decl
+            if owner_domain in domains:
+                continue  # writer (at least sometimes) IS the owner
+            # ownership is class-scoped when both sides are known: an
+            # unrelated class's attribute that merely shares the name
+            # must not inherit the declaration. Unresolvable receivers
+            # (parameters, untyped attrs — e.g. degradation's
+            # self.engine) stay name-matched: conservative on purpose,
+            # that IS the cross-object seam the rule exists for.
+            recv_cls = _receiver_class(program, qn, receiver)
+            if owner_cls and recv_cls and recv_cls != owner_cls:
+                continue
+            chain = taints.affinity.get(qn, {})
+            some_chain = next(iter(chain.values()), [qn])
+            yield (
+                fn.path,
+                node,
+                f"`{receiver}.{attr}` is {owner_domain!r}-affine "
+                f"(affinity.guard_attrs) but written from "
+                f"{'/'.join(sorted(domains))}-domain code "
+                f"(chain: {format_chain(some_chain)}); wrap in "
+                "affinity.handoff(...)/a lock, route through "
+                "call_soon_threadsafe or a queue, or mark the line "
+                "`# dynalint: handoff=<why>`",
+            )
+
+    # -- pass 2: undeclared self.<attr> written from >= 2 domains --------
+    # class qualname -> attr -> [(qn, node, domains, sanctioned)]
+    by_class: Dict[str, Dict[str, List]] = {}
+    for qn, writes in sites.items():
+        fn = graph.functions[qn]
+        if fn.name in _CTOR_NAMES:
+            continue
+        cls = _enclosing_class_of(program, qn)
+        if cls is None:
+            continue
+        domains = taints.domains(qn)
+        for receiver, attr, node, sanctioned in writes:
+            if receiver != "self":
+                continue
+            decl = declared.get(attr)
+            # a declaration only exempts the conflict scan for the
+            # class it was registered against (or an unscoped one) —
+            # other classes' same-named attrs are still judged
+            if decl is not None and decl[1] in ("", cls):
+                continue
+            by_class.setdefault(cls, {}).setdefault(attr, []).append(
+                (qn, node, domains, sanctioned)
+            )
+    for cls, attrs in sorted(by_class.items()):
+        for attr, entries in sorted(attrs.items()):
+            all_domains: Set[str] = set()
+            for _, _, domains, _ in entries:
+                all_domains |= domains
+            if len(all_domains) < 2:
+                continue
+            cls_name = cls.split(":")[-1]
+            for qn, node, domains, sanctioned in entries:
+                if sanctioned or not domains:
+                    continue
+                fn = graph.functions[qn]
+                others = sorted(all_domains - domains)
+                if not others:
+                    continue
+                chain = taints.affinity.get(qn, {})
+                some_chain = next(iter(chain.values()), [qn])
+                yield (
+                    fn.path,
+                    node,
+                    f"`{cls_name}.{attr}` is written from "
+                    f"{'/'.join(sorted(domains))} here (chain: "
+                    f"{format_chain(some_chain)}) AND from "
+                    f"{'/'.join(others)} elsewhere — shared state "
+                    "with no declared handoff; guard with a lock/"
+                    "affinity.handoff(...), hand off via a queue/"
+                    "call_soon_threadsafe, or mark the deliberate "
+                    "seam `# dynalint: handoff=<why>`",
+                )

@@ -32,21 +32,14 @@ from __future__ import annotations
 import ast
 
 from dynamo_tpu.analysis.registry import LintModule, rule
-from dynamo_tpu.analysis.rules.common import dotted_name
+from dynamo_tpu.analysis.rules.common import (
+    SYNC_ATTRS,
+    SYNC_CALLS,
+    dotted_name,
+)
 
-SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
-SYNC_CALLS = {
-    "np.asarray",
-    "np.array",
-    "numpy.asarray",
-    "numpy.array",
-    "jax.device_get",
-    "jax.block_until_ready",
-    # the house sync primitive (parallel/multihost.py): the step loop's
-    # harvest functions call it; anywhere else it IS the hidden sync
-    "host_value",
-    "multihost.host_value",
-}
+# SYNC_ATTRS / SYNC_CALLS live in common.py (DL102 reuses them
+# for the transitive pass)
 
 
 def _is_harvest(name: str) -> bool:

@@ -1,0 +1,62 @@
+"""DL101 transitive-blocking-call-in-async: a blocking call inside a
+*sync* function that async code reaches through ordinary calls.
+
+DL001 sees ``time.sleep`` directly inside an ``async def``; it cannot
+see the same sleep one call level down — ``await handler()`` ->
+``handler`` calls ``_retry()`` -> ``_retry`` sleeps. The event loop
+stalls identically either way. This rule flags blocking calls in any
+function carrying the *async-context* taint (analysis/taint.py):
+reachable from a coroutine along same-context call/ref edges, with
+propagation stopped at thread handoffs (``run_in_executor`` /
+``asyncio.to_thread`` / ``Thread(target=...)`` — running the helper on
+another thread is the sanctioned fix) and at functions declared
+``@thread_affinity`` for a non-loop domain.
+
+Direct frames (the blocking call lexically inside ``async def``) are
+DL001's and are not re-reported here; findings come with the call
+chain that makes them believable.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.analysis.program import LintProgram, program_rule
+from dynamo_tpu.analysis.rules.common import (
+    BLOCKING_CALLS,
+    dotted_name,
+    walk_in_scope,
+)
+from dynamo_tpu.analysis.taint import format_chain
+
+
+@program_rule(
+    "transitive-blocking-call-in-async",
+    "DL101",
+    "blocking call in a sync function reachable from a coroutine "
+    "(stalls the event loop from one or more call levels down)",
+)
+def check(program: LintProgram):
+    graph = program.graph
+    for qn, chain in program.taints.async_ctx.items():
+        fn = graph.functions.get(qn)
+        if fn is None or fn.is_async:
+            continue  # direct async frames are DL001's
+        if len(chain) < 2:
+            continue
+        for node in walk_in_scope(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            hint = BLOCKING_CALLS.get(name or "")
+            if hint is None:
+                continue
+            depth = len(chain) - 1
+            yield (
+                fn.path,
+                node,
+                f"`{name}(...)` blocks the event loop {depth} call "
+                f"level(s) below coroutine `{chain[0].split(':')[-1]}` "
+                f"(chain: {format_chain(chain)}); use {hint} or run "
+                "the helper in an executor",
+            )

@@ -1,0 +1,69 @@
+"""DL102 transitive-host-sync-in-step-loop: a device->host sync in a
+helper the engine step loop reaches through ordinary calls.
+
+DL010 guards the step loop's *own* frames (entry points named in
+config ``step-loop-functions``, anything named ``*step_loop*``, and
+their nested closures). But a `.item()` buried in a utility the loop
+calls re-serializes the overlapped decode pipeline just as surely —
+the host parks mid-plan, the device drains, and the idle gap the
+pipeline exists to remove comes back invisibly (docs/performance.md).
+
+This rule closes that gap: it flags the DL010 sync-op set inside any
+function carrying the *step-loop* taint at depth >= 1 (reachable from
+an entry point along same-context edges). Harvest-named functions are
+the sanctioned sync points: they neither receive nor forward the
+taint, so the designated harvest and everything only it calls stay
+exempt — same convention as DL010, which keeps direct frames; together
+the two rules subsume the old single-frame view.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.analysis.program import LintProgram, program_rule
+from dynamo_tpu.analysis.rules.common import (
+    SYNC_ATTRS,
+    SYNC_CALLS,
+    dotted_name,
+    walk_in_scope,
+)
+from dynamo_tpu.analysis.taint import format_chain
+
+
+@program_rule(
+    "transitive-host-sync-in-step-loop",
+    "DL102",
+    "device sync in a helper reachable from the engine step loop "
+    "(re-serializes the overlapped pipeline from a call level down)",
+)
+def check(program: LintProgram):
+    graph = program.graph
+    for qn, chain in program.taints.step_loop.items():
+        if len(chain) < 2:
+            continue  # entry points' own frames are DL010's
+        fn = graph.functions.get(qn)
+        if fn is None or "harvest" in fn.name.lower():
+            continue
+        for node in walk_in_scope(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if name in SYNC_CALLS:
+                what = f"`{name}(...)`"
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in SYNC_ATTRS
+            ):
+                what = f"`.{node.func.attr}()`"
+            else:
+                continue
+            yield (
+                fn.path,
+                node,
+                f"{what} syncs device->host {len(chain) - 1} call "
+                f"level(s) below step-loop entry "
+                f"`{chain[0].split(':')[-1]}` (chain: "
+                f"{format_chain(chain)}); move the materialization to "
+                "the designated harvest function",
+            )

@@ -1,0 +1,225 @@
+"""Dataflow over the call graph: three taints the DL1xx rules consume.
+
+Each taint is a property of a *function* ("code reachable from X runs
+in context Y"), propagated along same-context call/ref edges of the
+:class:`~dynamo_tpu.analysis.callgraph.CallGraph` with a worklist BFS.
+Every tainted function remembers the shortest call chain that tainted
+it, so a finding two levels deep can print the path a human needs to
+believe it.
+
+- **async-context** — reachable from a coroutine body without crossing
+  a thread handoff: a blocking call anywhere in this set stalls the
+  event loop (DL101). Seeds: every ``async def``. Propagation stops at
+  spawn edges (``run_in_executor`` / ``to_thread`` / ``Thread(target=
+  ...)`` — the callee runs elsewhere, blocking there is the *fix*) and
+  at functions explicitly declared ``@thread_affinity`` for a
+  non-"loop" domain (a declared engine/planner function reached from
+  async code is a different bug — DL103's).
+
+- **step-loop** — reachable from the engine step loop's entry points
+  (config ``step-loop-functions`` + any function whose name contains
+  ``step_loop``): a device->host sync anywhere in this set
+  re-serializes the overlapped decode pipeline (DL102). Propagation
+  stops at harvest-named functions (the sanctioned sync points, same
+  convention as DL010) and spawn edges.
+
+- **thread-affinity** — which domain's thread executes this function:
+  seeded from ``@thread_affinity("engine"|"loop"|"planner")``
+  declarations, config ``affinity-entry-points`` (``qualname=domain``),
+  and every ``async def`` (coroutines run on the event loop).
+  Propagates along same-context edges; spawn-to-loop edges
+  (``call_soon_threadsafe`` / ``run_coroutine_threadsafe``) retarget
+  the callee to "loop"; spawn-to-other edges stop propagation (a fresh
+  thread is no declared domain). A function's own declaration always
+  wins over anything propagated into it. Functions reachable from
+  several domains carry the full set — shared code, judged by DL103 at
+  its write sites.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from dynamo_tpu.analysis.callgraph import (
+    CallGraph,
+    Edge,
+    FunctionInfo,
+    SAME_CONTEXT,
+    SPAWN_LOOP,
+)
+
+LOOP_DOMAIN = "loop"
+
+
+@dataclass
+class Taints:
+    """qualname -> shortest seeding chain (list of qualnames, seed
+    first, tainted function last)."""
+
+    async_ctx: Dict[str, List[str]] = field(default_factory=dict)
+    step_loop: Dict[str, List[str]] = field(default_factory=dict)
+    # qualname -> {domain -> chain}
+    affinity: Dict[str, Dict[str, List[str]]] = field(default_factory=dict)
+
+    def domains(self, qualname: str) -> Set[str]:
+        return set(self.affinity.get(qualname, {}))
+
+
+def _is_harvest(fn: FunctionInfo) -> bool:
+    return "harvest" in fn.name.lower()
+
+
+def _declared_affinity(graph: CallGraph, fn: FunctionInfo) -> Optional[str]:
+    """@thread_affinity on the function, else on its class."""
+    if fn.affinity:
+        return fn.affinity
+    if fn.cls is not None:
+        cls = graph.classes.get(fn.cls)
+        if cls is not None and cls.affinity:
+            return cls.affinity
+    return None
+
+
+def _bfs(
+    graph: CallGraph,
+    seeds: Dict[str, List[str]],
+    *,
+    stop: Optional[callable] = None,
+) -> Dict[str, List[str]]:
+    """Propagate seeds along same-context edges; ``stop(fn)`` prunes a
+    function from *receiving and forwarding* the taint (it keeps its
+    own seed if it is one)."""
+    out: Dict[str, List[str]] = dict(seeds)
+    # FIFO worklist = true BFS: with first-writer-wins, the recorded
+    # chain is genuinely the shortest — a LIFO here would print a
+    # 5-deep path for a function also reachable at depth 1
+    work = deque(seeds)
+    while work:
+        cur = work.popleft()
+        chain = out[cur]
+        for e in graph.out_edges(cur):
+            if e.kind not in SAME_CONTEXT:
+                continue
+            callee = graph.functions.get(e.callee)
+            if callee is None or e.callee in out:
+                continue
+            if stop is not None and stop(callee):
+                continue
+            out[e.callee] = chain + [e.callee]
+            work.append(e.callee)
+    return out
+
+
+def compute_async_taint(graph: CallGraph) -> Dict[str, List[str]]:
+    seeds = {
+        qn: [qn]
+        for qn, fn in graph.functions.items()
+        if fn.is_async
+    }
+
+    def stop(fn: FunctionInfo) -> bool:
+        decl = _declared_affinity(graph, fn)
+        return decl is not None and decl != LOOP_DOMAIN
+
+    return _bfs(graph, seeds, stop=stop)
+
+
+def compute_step_loop_taint(
+    graph: CallGraph, config: dict
+) -> Dict[str, List[str]]:
+    names = set(config.get("step-loop-functions", []))
+    seeds: Dict[str, List[str]] = {}
+    for qn, fn in graph.functions.items():
+        if fn.name in names or "step_loop" in fn.name:
+            if not _is_harvest(fn):
+                seeds[qn] = [qn]
+    return _bfs(graph, seeds, stop=_is_harvest)
+
+
+def _entry_point_seeds(
+    graph: CallGraph, config: dict
+) -> List[Tuple[str, str]]:
+    """config ``affinity-entry-points = ["pat=domain", ...]`` where pat
+    is an fnmatch over qualnames (or a bare function name)."""
+    out: List[Tuple[str, str]] = []
+    for entry in config.get("affinity-entry-points", []):
+        pat, _, domain = str(entry).partition("=")
+        pat, domain = pat.strip(), domain.strip()
+        if not pat or not domain:
+            continue
+        for qn, fn in graph.functions.items():
+            if fn.name == pat or fnmatch.fnmatch(qn, pat):
+                out.append((qn, domain))
+    return out
+
+
+def compute_affinity_taint(
+    graph: CallGraph, config: dict
+) -> Dict[str, Dict[str, List[str]]]:
+    # declared functions are pinned: they hold exactly their declared
+    # domain and nothing propagates in
+    declared: Dict[str, str] = {}
+    for qn, fn in graph.functions.items():
+        d = _declared_affinity(graph, fn)
+        if d is not None:
+            declared[qn] = d
+    for qn, domain in _entry_point_seeds(graph, config):
+        declared.setdefault(qn, domain)
+
+    result: Dict[str, Dict[str, List[str]]] = {}
+
+    def add(qn: str, domain: str, chain: List[str]) -> bool:
+        slot = result.setdefault(qn, {})
+        if domain in slot:
+            return False
+        slot[domain] = chain
+        return True
+
+    work: deque[Tuple[str, str]] = deque()
+    for qn, domain in declared.items():
+        add(qn, domain, [qn])
+        work.append((qn, domain))
+    # coroutines run on the event loop (unless explicitly declared)
+    for qn, fn in graph.functions.items():
+        if fn.is_async and qn not in declared:
+            if add(qn, LOOP_DOMAIN, [qn]):
+                work.append((qn, LOOP_DOMAIN))
+
+    while work:
+        cur, domain = work.popleft()
+        chain = result[cur][domain]
+        for e in graph.out_edges(cur):
+            callee = graph.functions.get(e.callee)
+            if callee is None:
+                continue
+            if e.kind in SAME_CONTEXT:
+                new_domain = domain
+            elif e.kind == SPAWN_LOOP:
+                new_domain = LOOP_DOMAIN
+            else:  # spawn-other: a fresh/pool thread, no domain
+                continue
+            if e.callee in declared:
+                continue  # declaration wins; no propagation in
+            if add(e.callee, new_domain, chain + [e.callee]):
+                work.append((e.callee, new_domain))
+    return result
+
+
+def compute_taints(graph: CallGraph, config: dict) -> Taints:
+    return Taints(
+        async_ctx=compute_async_taint(graph),
+        step_loop=compute_step_loop_taint(graph, config),
+        affinity=compute_affinity_taint(graph, config),
+    )
+
+
+def format_chain(chain: List[str]) -> str:
+    """Human-readable call chain: short names with the seed marked."""
+    def short(qn: str) -> str:
+        mod, _, sym = qn.partition(":")
+        return f"{mod.rsplit('.', 1)[-1]}.{sym}" if sym else qn
+
+    return " -> ".join(short(q) for q in chain)

@@ -16,6 +16,7 @@ import ast
 import dataclasses
 import fnmatch
 import glob
+import hashlib
 import io
 import re
 import tokenize
@@ -101,27 +102,46 @@ def _suppressed(finding: Finding, per_line: dict[int, set[str]],
     return finding.rule in names or "all" in names
 
 
+def known_rule_names() -> set[str]:
+    """Every rule name suppressions may legitimately reference —
+    per-file AND whole-program rules."""
+    from dynamo_tpu.analysis.program import all_program_rules
+
+    return (
+        {r.name for r in all_rules()}
+        | {r.name for r in all_program_rules()}
+        | {"all"}
+    )
+
+
 def lint_source(
     source: str,
     path: str = "<string>",
     rules: Optional[Iterable[Rule]] = None,
     config: Optional[dict] = None,
+    *,
+    tree: Optional[ast.Module] = None,
 ) -> list[Finding]:
-    """Lint one source string. Syntax errors surface as a pseudo-finding
-    (code DL000) rather than crashing the walk."""
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as exc:
-        return [
-            Finding(
-                rule="parse-error",
-                code="DL000",
-                path=path,
-                line=exc.lineno or 1,
-                col=(exc.offset or 1) - 1,
-                message=f"file does not parse: {exc.msg}",
-            )
-        ]
+    """Lint one source string with the per-file rules. Syntax errors
+    surface as a pseudo-finding (code DL000) rather than crashing the
+    walk. Pass ``tree`` to reuse an already-parsed AST (``lint_paths``
+    does, so a cold run parses each file once, not twice). (Whole-
+    program DL1xx rules need the project view — see ``lint_paths`` /
+    ``lint_sources_program``.)"""
+    if tree is None:
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as exc:
+            return [
+                Finding(
+                    rule="parse-error",
+                    code="DL000",
+                    path=path,
+                    line=exc.lineno or 1,
+                    col=(exc.offset or 1) - 1,
+                    message=f"file does not parse: {exc.msg}",
+                )
+            ]
     config = config or {}
     module = LintModule(path=path, source=source, tree=tree, config=config)
     if rules is None:
@@ -131,7 +151,7 @@ def lint_source(
         rules = [r for r in all_rules() if r.name not in disabled]
     # validated against the full registry, not the enabled subset, so
     # running one rule doesn't flag waivers that belong to the others
-    known = {r.name for r in all_rules()} | {"all"}
+    known = known_rule_names()
     per_line, per_file, problems = scan_suppressions(source, known)
     findings: list[Finding] = []
     # an ineffective directive (misplaced disable-file) or a suppression
@@ -225,35 +245,197 @@ def iter_files(
     return sorted(out)
 
 
+def _program_findings(
+    modules: dict[str, LintModule],
+    prog_rules: list,
+    config: dict,
+    stats_out: Optional[dict] = None,
+) -> list[Finding]:
+    """Run the whole-program rules over parsed modules, applying the
+    same per-line/per-file suppression machinery as the file pass."""
+    from dynamo_tpu.analysis.program import build_program
+
+    if not modules or not prog_rules:
+        return []
+    program = build_program(modules, config)
+    if stats_out is not None:
+        stats_out["callgraph"] = program.graph.stats()
+    known = known_rule_names()
+    suppression_cache: dict[str, tuple] = {}
+    findings: list[Finding] = []
+    for r in prog_rules:
+        for path, node, message in r.check(program):
+            f = Finding(
+                rule=r.name,
+                code=r.code,
+                path=path,
+                line=getattr(node, "lineno", 1),
+                col=getattr(node, "col_offset", 0),
+                message=message,
+            )
+            if path not in suppression_cache:
+                mod = modules.get(path)
+                suppression_cache[path] = (
+                    scan_suppressions(mod.source, known)[:2]
+                    if mod
+                    else ({}, set())
+                )
+            per_line, per_file = suppression_cache[path]
+            if _suppressed(f, per_line, per_file):
+                f = dataclasses.replace(f, suppressed=True)
+            findings.append(f)
+    return findings
+
+
+def lint_sources_program(
+    sources: dict[str, str],
+    rules: Optional[list] = None,
+    config: Optional[dict] = None,
+) -> list[Finding]:
+    """Whole-program lint over in-memory sources ({path: source}) —
+    the fixture/test entry point for the DL1xx rules."""
+    from dynamo_tpu.analysis.program import all_program_rules
+
+    config = config or {}
+    if rules is None:
+        disabled = set(config.get("disable", []))
+        rules = [
+            r for r in all_program_rules() if r.name not in disabled
+        ]
+    modules: dict[str, LintModule] = {}
+    for path, source in sources.items():
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            continue  # DL000 belongs to the per-file pass
+        modules[path] = LintModule(
+            path=path, source=source, tree=tree, config=config
+        )
+    return _program_findings(modules, list(rules), config)
+
+
 def lint_paths(
     paths: Iterable[str],
     rules: Optional[Iterable[Rule]] = None,
     config: Optional[dict] = None,
     files: Optional[list[Path]] = None,
+    *,
+    program_rules: Optional[list] = None,
+    cache=None,
+    stats_out: Optional[dict] = None,
 ) -> list[Finding]:
-    """Lint every .py file under ``paths`` (honoring config excludes).
-    Pass ``files`` to reuse an already-computed ``iter_files`` walk."""
+    """Lint every .py file under ``paths`` (honoring config excludes):
+    the per-file rules, then the whole-program DL1xx pass.
+
+    Pass ``files`` to reuse an already-computed ``iter_files`` walk.
+    ``rules``/``program_rules`` restrict each pass; an explicit
+    ``rules`` selection alone also turns the program pass off (asking
+    for one rule means that rule, not that rule plus DL1xx).
+    ``cache`` is an ``analysis.cache.LintCache``: per-file results key
+    on each file's sha, the program result keys on every sha, so a
+    warm unchanged repo lints without parsing a single file.
+    """
+    from dynamo_tpu.analysis.cache import LintCache, rule_signature
+    from dynamo_tpu.analysis.program import all_program_rules
+
     config = config or {}
-    rule_list = list(rules) if rules is not None else None
+    disabled = set(config.get("disable", []))
+    file_rules = (
+        list(rules)
+        if rules is not None
+        else [r for r in all_rules() if r.name not in disabled]
+    )
+    if program_rules is not None:
+        prog_rules = list(program_rules)
+    elif rules is not None:
+        prog_rules = []
+    else:
+        prog_rules = [
+            r for r in all_program_rules() if r.name not in disabled
+        ]
     findings: list[Finding] = []
     if files is None:
         files = iter_files(paths, exclude=list(config.get("exclude", [])))
+
+    file_sig = prog_sig = None
+    if cache is not None:
+        file_sig = rule_signature([r.name for r in file_rules], config)
+        prog_sig = rule_signature(
+            ["program"] + [r.name for r in prog_rules], config
+        )
+
+    shas: dict[str, str] = {}
+    sources: dict[str, str] = {}
+    trees: dict[str, ast.Module] = {}  # parsed once, shared by passes
+    pending_file_keys: dict[str, str] = {}  # path -> cache key
     for f in files:
+        path = str(f)
         try:
-            source = f.read_text(encoding="utf-8", errors="replace")
+            raw = f.read_bytes()
         except OSError as exc:
             findings.append(
                 Finding(
                     rule="read-error",
                     code="DL000",
-                    path=str(f),
+                    path=path,
                     line=1,
                     col=0,
                     message=f"unreadable: {exc}",
                 )
             )
             continue
-        findings.extend(
-            lint_source(source, path=str(f), rules=rule_list, config=config)
+        source = raw.decode("utf-8", errors="replace")
+        sources[path] = source
+        if cache is not None:
+            sha = hashlib.sha256(raw).hexdigest()
+            shas[path] = sha
+            key = LintCache.file_key(path, sha, file_sig)
+            cached = cache.get(key)
+            if cached is not None:
+                findings.extend(cached)
+                continue
+            pending_file_keys[path] = key
+        try:
+            trees[path] = ast.parse(source, filename=path)
+        except SyntaxError:
+            pass  # lint_source below emits the DL000
+        file_findings = lint_source(
+            source, path=path, rules=file_rules, config=config,
+            tree=trees.get(path),
         )
+        findings.extend(file_findings)
+        if cache is not None:
+            cache.put(pending_file_keys[path], file_findings)
+
+    # -- whole-program pass ----------------------------------------------
+    if prog_rules and sources:
+        prog_key = None
+        if cache is not None and len(shas) == len(sources):
+            prog_key = LintCache.program_key(shas, prog_sig)
+            cached = cache.get(prog_key)
+            if cached is not None:
+                if stats_out is not None:
+                    stats_out["callgraph"] = "cached"
+                findings.extend(cached)
+                cache.save()
+                return findings
+        modules: dict[str, LintModule] = {}
+        for path, source in sources.items():
+            tree = trees.get(path)
+            if tree is None:  # cache-hit or syntax-error file
+                try:
+                    tree = ast.parse(source, filename=path)
+                except SyntaxError:
+                    continue  # already a DL000 finding from the file pass
+            modules[path] = LintModule(
+                path=path, source=source, tree=tree, config=config
+            )
+        prog_findings = _program_findings(
+            modules, prog_rules, config, stats_out=stats_out
+        )
+        findings.extend(prog_findings)
+        if cache is not None and prog_key is not None:
+            cache.put(prog_key, prog_findings)
+    if cache is not None:
+        cache.save()
     return findings

@@ -1436,7 +1436,11 @@ async def cmd_planner(args: Any) -> None:
     from dynamo_tpu.planner.degradation import StoreDegradation
     from dynamo_tpu.planner.planner import Planner, PlannerConfig
     from dynamo_tpu.runtime.runtime import DistributedRuntime
+    from dynamo_tpu.utils import affinity
 
+    # a planner process's event loop IS the planner domain (in-process
+    # planners driven from tests stay on their host's "loop" binding)
+    affinity.register_thread("planner")
     drt = await DistributedRuntime.create(config=_runtime_config(args))
     drt.runtime.install_signal_handlers()
     component = drt.namespace(args.namespace).component(args.component)

@@ -49,6 +49,7 @@ from dynamo_tpu.engine.scheduler import (
     StepPlan,
 )
 from dynamo_tpu.models import ModelConfig
+from dynamo_tpu.utils import affinity
 from dynamo_tpu.utils.bucketing import next_bucket
 from dynamo_tpu.models.llama import (
     CACHE_SPEC,
@@ -204,7 +205,7 @@ class JaxEngine:
         self._incoming: thread_queue.Queue = thread_queue.Queue()
         self._control: thread_queue.Queue = thread_queue.Queue()
         self._wake = threading.Event()
-        self._running = False
+        self._running = False  # dynalint: handoff=stop-flag — one-way bool, each side only ever writes False; readers poll per step/await
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._seed_counter = 0
         # step-failure quarantine (see _quarantine_step_failure)
@@ -289,6 +290,12 @@ class JaxEngine:
         engine._loop = loop
         await loop.run_in_executor(None, engine._initialize)
         engine._running = True
+        # affinity sanitizer (docs/static_analysis.md, DYN_AFFINITY_CHECK=1):
+        # this thread IS the event loop; the step loop registers "engine"
+        # at its own start. spec_suspended is engine-affine — the loop-side
+        # writer (planner degradation rung) declares its handoff.
+        affinity.register_thread("loop")
+        affinity.guard_attrs(engine, {"spec_suspended": "engine"})
         engine._thread = threading.Thread(
             target=engine._step_loop, name="jax-engine", daemon=True
         )
@@ -1724,7 +1731,17 @@ class JaxEngine:
     # ------------------------------------------------------------------
     # Engine thread loop
     # ------------------------------------------------------------------
+    @affinity.thread_affinity("engine")
     def _step_loop(self) -> None:
+        affinity.register_thread("engine")
+        try:
+            self._step_loop_body()
+        finally:
+            # OS thread idents are reused — a stale binding would blame
+            # "engine" for a later unrelated thread's writes
+            affinity.unregister_thread()
+
+    def _step_loop_body(self) -> None:
         if self._is_follower:
             # follower ranks mirror the leader's device dispatches until
             # the leader broadcasts STOP (parallel/multihost.py)
@@ -1734,7 +1751,7 @@ class JaxEngine:
                 StepFollower(self).run()
             except Exception:
                 log.exception("multihost follower loop failed")
-            self._running = False
+            self._running = False  # dynalint: handoff=stop-flag — one-way bool, each side only ever writes False; readers poll per step/await
             return
         assert self.scheduler is not None
         from dynamo_tpu.parallel.multihost import FatalMultihostError
@@ -1792,7 +1809,7 @@ class JaxEngine:
                 # mid-batch must not wait out a 16-block gather.
                 if not pump_kvbm(4):
                     self._fail_all()
-                    self._running = False
+                    self._running = False  # dynalint: handoff=stop-flag — one-way bool, each side only ever writes False; readers poll per step/await
                     return
                 if self.kvbm is not None and self.kvbm.pending_offloads:
                     continue  # more queued: keep draining
@@ -1811,7 +1828,7 @@ class JaxEngine:
                     "taking the engine down"
                 )
                 self._fail_all()
-                self._running = False
+                self._running = False  # dynalint: handoff=stop-flag — one-way bool, each side only ever writes False; readers poll per step/await
                 return
             except Exception as exc:
                 self._step_failures += 1
@@ -1833,7 +1850,7 @@ class JaxEngine:
             # pump time, and drain at idle moments.
             if not pump_kvbm(self._kv_busy_pump_cap):
                 self._fail_all()
-                self._running = False
+                self._running = False  # dynalint: handoff=stop-flag — one-way bool, each side only ever writes False; readers poll per step/await
                 return
 
     def _disable_kvbm(self) -> None:
@@ -2322,7 +2339,7 @@ class JaxEngine:
         uncommitted until real tokens fill them."""
         # lazy: dynamo_tpu.spec imports engine.sampling — a module-level
         # import here would cycle through the package __init__
-        from dynamo_tpu.spec.verify import unpack_spec_output
+        from dynamo_tpu.spec.verify import harvest_spec_output
 
         sched = self.scheduler
         assert sched is not None and self._spec_step_fn is not None
@@ -2392,9 +2409,9 @@ class JaxEngine:
                 arrays["context_lens"], arrays["draft_lens"],
                 sampling.arrays,
             )
-            # unpack_spec_output is the spec path's designated harvest
+            # harvest_spec_output is the spec path's designated harvest
             # point (DL010): the device->host sync happens inside it
-            toks, lps, n_emit = unpack_spec_output(packed, S)
+            toks, lps, n_emit = harvest_spec_output(packed, S)
             self.overlap.note_complete(all_prior=True)
             # successful host sync: earlier async dispatches are
             # known-good (in-order execution) — retire deferred-error
@@ -2659,8 +2676,10 @@ class JaxEngine:
             gen_counts = [dict(s.gen_counts) for s in seqs]
             for s in seqs:
                 if s.prompt_unique is None:
+                    # request.token_ids is a host python list; cached
+                    # once per sequence, no device array involved
                     s.prompt_unique = np.unique(
-                        np.asarray(s.request.token_ids, np.int32)
+                        np.asarray(s.request.token_ids, np.int32)  # dynalint: disable=transitive-host-sync-in-step-loop — host-list conversion
                     )
             prompt_ids = [s.prompt_unique for s in seqs]
             gen_counts += [{} for _ in range(pad)]
@@ -3658,7 +3677,7 @@ class JaxEngine:
             await asyncio.sleep(poll_s)
 
     async def shutdown(self) -> None:
-        self._running = False
+        self._running = False  # dynalint: handoff=stop-flag — one-way bool, each side only ever writes False; readers poll per step/await
         self._wake.set()
         if self._debug_name is not None:
             unregister_debug_provider(self._debug_name, self.debug_state)

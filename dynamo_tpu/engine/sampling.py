@@ -120,7 +120,8 @@ class SamplingBatch:
             "top_k": np.zeros((n,), np.int32),
             "top_p": np.ones((n,), np.float32),
             "min_p": np.zeros((n,), np.float32),
-            "seeds": np.asarray(step_seeds, np.uint32),
+            # host python list -> ndarray; no device array involved
+            "seeds": np.asarray(step_seeds, np.uint32),  # dynalint: disable=transitive-host-sync-in-step-loop — host-list conversion
         }
         for i, o in enumerate(opts):
             if not o.use_greedy and o.temperature is not None:
@@ -151,7 +152,8 @@ class SamplingBatch:
                 cls._penalty_arrays(opts, gen_token_counts, prompt_token_ids)
             )
         if top_lp is not None and any(k > 0 for k in top_lp):
-            a["top_lp_n"] = np.asarray(
+            # host python list -> ndarray; no device array involved
+            a["top_lp_n"] = np.asarray(  # dynalint: disable=transitive-host-sync-in-step-loop — host-list conversion
                 [min(max(k, 0), TOPLP_N) for k in top_lp], np.int32
             )
         return cls(a)
@@ -190,7 +192,8 @@ class SamplingBatch:
                 a["gen_ids"][i, j] = tok
                 a["gen_counts"][i, j] = c
         for i, toks in enumerate(prompt_token_ids):
-            t = np.asarray(toks, np.int32)[:COUNT_W]
+            # host python list -> ndarray; no device array involved
+            t = np.asarray(toks, np.int32)[:COUNT_W]  # dynalint: disable=transitive-host-sync-in-step-loop — host-list conversion
             a["prompt_ids"][i, : len(t)] = t
             a["prompt_counts"][i, : len(t)] = 1.0
         return a

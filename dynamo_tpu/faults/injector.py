@@ -78,6 +78,12 @@ class _RuleState(RuleState):
 
 
 class FaultInjector:
+    # cross-thread contract (dynalint DL103 vocabulary): fire() is
+    # called from every domain at once — the engine thread
+    # (engine.step, worker.liveness), the event loop (http.request,
+    # transport), planner-side store calls. All mutable state
+    # (_states counters incl. the one-shot kill arming, fired_total,
+    # _fired_ring) flips only under _lock — the declared handoff.
     def __init__(self, plan: FaultPlan):
         self.plan = plan
         self._lock = threading.Lock()

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dynamo_tpu.utils.jaxtools import shard_map
 from dynamo_tpu.models.config import ModelConfig
 
 Params = dict[str, Any]
@@ -517,7 +518,7 @@ def make_layer_parts(
             )
             if ksc is not None:
                 in_specs += (SCALE_SPEC, SCALE_SPEC)
-            kern = jax.shard_map(
+            kern = shard_map(
                 kern,
                 mesh=mesh,
                 in_specs=in_specs,
@@ -575,7 +576,7 @@ def make_layer_parts(
             )
             if ksc is not None:
                 in_specs += (SCALE_SPEC, SCALE_SPEC)
-            kern = jax.shard_map(
+            kern = shard_map(
                 kern,
                 mesh=mesh,
                 in_specs=in_specs,
@@ -1048,7 +1049,7 @@ def _moe_mlp_sparse(cfg: ModelConfig, lp: Params, h: jax.Array) -> jax.Array:
             out = local_compute(lp_e, x_r, topw_r, topi_r, shard)
             return jax.lax.psum(out, ("ep", "tp"))
 
-        out = jax.shard_map(
+        out = shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(lp_specs, P(None, None), P(None, None), P(None, None)),

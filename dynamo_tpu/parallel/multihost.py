@@ -1,3 +1,8 @@
+# dynalint: disable-file=transitive-host-sync-in-step-loop — the broadcast
+# plane serializes host-side plan metadata (token columns, slot maps:
+# python lists/host buffers) into wire frames inside the leader's dispatch
+# path BY DESIGN; `host_value` is this file's audited device sync.
+# Re-audit when the multi-chip tier is repaired (ROADMAP open item 1).
 """Multi-host serving: leader→follower step broadcast.
 
 The reference brings up multi-node engines with a leader that owns

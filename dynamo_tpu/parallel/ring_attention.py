@@ -26,7 +26,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from dynamo_tpu.utils.jaxtools import shard_map
 
 
 def _merge(m, l, acc, m_new, l_new, acc_new):

@@ -31,7 +31,7 @@ from dataclasses import dataclass
 from typing import Any, Optional
 
 from dynamo_tpu.telemetry.instruments import PLANNER_DEGRADATION_LEVEL
-from dynamo_tpu.utils import tasks
+from dynamo_tpu.utils import affinity, tasks
 from dynamo_tpu.utils.backoff import Backoff
 
 log = logging.getLogger("dynamo_tpu.planner.degradation")
@@ -122,10 +122,15 @@ class ServingDegradation:
             self.admission.config.max_kv_usage = kv
             self.admission.force_shed = self.policy.force_shed(level)
         if self.engine is not None:
-            # plain attribute flip: read by the engine thread each step
-            self.engine.spec_suspended = not self.policy.spec_enabled(
-                True, level
-            )
+            # deliberate cross-domain flip: this runs on the event loop
+            # (watch_degradation task), the engine thread reads the bool
+            # each step. A plain store is race-free for a bool; declared
+            # so both enforcement planes (DL103 + DYN_AFFINITY_CHECK)
+            # know it is sanctioned.
+            with affinity.handoff("degradation rung -> engine.spec_suspended"):
+                self.engine.spec_suspended = not self.policy.spec_enabled(  # dynalint: handoff=degradation-rung — loop->engine bool flip, read each step
+                    True, level
+                )
 
 
 class StoreDegradation:

@@ -28,13 +28,13 @@ from dynamo_tpu.spec.drafter import (
     NgramDrafter,
     build_drafter,
 )
-from dynamo_tpu.spec.verify import unpack_spec_output, verify_tokens
+from dynamo_tpu.spec.verify import harvest_spec_output, verify_tokens
 
 __all__ = [
     "BigramTableDrafter",
     "Drafter",
     "NgramDrafter",
     "build_drafter",
-    "unpack_spec_output",
+    "harvest_spec_output",
     "verify_tokens",
 ]

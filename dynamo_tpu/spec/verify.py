@@ -167,7 +167,7 @@ def verify_tokens(
     return out_tokens, out_lps, n_emit
 
 
-def unpack_spec_output(
+def harvest_spec_output(
     packed, S: int
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Sync + split the spec step's packed [B, 2S+1] output into

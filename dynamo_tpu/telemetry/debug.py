@@ -28,6 +28,11 @@ from typing import Callable, Optional
 
 log = logging.getLogger("dynamo_tpu.telemetry.debug")
 
+# cross-thread contract (dynalint DL103 vocabulary, docs/
+# static_analysis.md): the registry is written from the event loop
+# (engines registering at launch) AND read/written from arbitrary
+# threads (debug endpoints, shutdown paths) — _providers_lock is the
+# declared handoff; every access below takes it
 _providers: dict[str, Callable[[], dict]] = {}
 _providers_lock = threading.Lock()
 

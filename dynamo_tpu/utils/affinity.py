@@ -1,0 +1,261 @@
+"""Thread-affinity declarations + optional runtime sanitizer.
+
+dynamo-tpu has three concurrency domains (docs/static_analysis.md):
+
+- ``"engine"``  — the dedicated jax step-loop thread (`engine/engine.py`)
+- ``"loop"``    — the asyncio event loop the frontend/runtime run on
+- ``"planner"`` — the planner control loop / watcher tasks
+
+State that crosses a domain boundary must go through a declared handoff
+(a queue, ``call_soon_threadsafe``, ``run_coroutine_threadsafe``, a
+lock, or an explicit marker). This module is the *declaration
+vocabulary* both enforcement planes share:
+
+Static plane: :func:`thread_affinity` tags a function/method/class with
+its home domain; dynalint's whole-program taint pass
+(``analysis/taint.py``) seeds thread-affinity propagation from these
+tags and DL103 flags undeclared cross-domain attribute writes.
+
+Runtime plane (``DYN_AFFINITY_CHECK=1``): :func:`register_thread` binds
+the calling thread to a domain, :func:`guard_attrs` arms an object's
+attributes so a write from a thread bound to a *different* domain
+raises :class:`AffinityViolation` — naming the writing thread, the
+owning domain's thread, and the attribute — unless the write happens
+inside a :func:`handoff` block. Catches the violations static analysis
+can't see (dynamic dispatch, getattr-driven writes, third-party
+callbacks). Disabled (the default) everything here is inert: the
+decorator only stamps metadata and ``guard_attrs`` is a no-op, so the
+serving hot path pays nothing.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import threading
+from typing import Any, Callable, Dict, Optional, TypeVar
+
+log = logging.getLogger("dynamo_tpu.utils.affinity")
+
+DOMAINS = ("engine", "loop", "planner")
+
+F = TypeVar("F", bound=Callable)
+
+
+class AffinityViolation(RuntimeError):
+    """A cross-domain write (or call) outside a declared handoff."""
+
+
+# -- enablement -----------------------------------------------------------
+
+_enabled: Optional[bool] = None
+
+
+def enabled() -> bool:
+    """True when the runtime sanitizer is armed (``DYN_AFFINITY_CHECK=1``
+    or :func:`set_enabled`). Evaluated lazily so tests can flip the env
+    var before constructing the objects they want guarded."""
+    global _enabled
+    if _enabled is None:
+        _enabled = os.environ.get("DYN_AFFINITY_CHECK", "") == "1"
+    return _enabled
+
+
+def set_enabled(value: Optional[bool]) -> None:
+    """Test hook: force the sanitizer on/off; ``None`` re-reads the env."""
+    global _enabled
+    _enabled = value
+
+
+# -- thread <-> domain registry ------------------------------------------
+
+_registry_lock = threading.Lock()
+_thread_domain: Dict[int, str] = {}  # thread ident -> domain
+_domain_thread: Dict[str, str] = {}  # domain -> last registered thread name
+
+
+def register_thread(domain: str, *, thread: Optional[threading.Thread] = None) -> None:
+    """Bind ``thread`` (default: the calling thread) to ``domain``.
+
+    Call this where a domain's loop starts — the engine thread's run
+    loop, the asyncio entrypoint, the planner control loop. Rebinding
+    the same thread is allowed (a process may restart its engine);
+    idents of exited threads are reaped opportunistically."""
+    if domain not in DOMAINS:
+        raise ValueError(f"unknown affinity domain {domain!r} (known: {DOMAINS})")
+    t = thread or threading.current_thread()
+    with _registry_lock:
+        _thread_domain[t.ident] = domain
+        _domain_thread[domain] = t.name
+
+
+def unregister_thread(thread: Optional[threading.Thread] = None) -> None:
+    """Unbind a thread (call when a domain loop exits — OS thread idents
+    are reused, and a stale binding would mis-attribute later writes)."""
+    t = thread or threading.current_thread()
+    with _registry_lock:
+        _thread_domain.pop(t.ident, None)
+
+
+def current_domain() -> Optional[str]:
+    """The calling thread's registered domain, or None."""
+    with _registry_lock:
+        return _thread_domain.get(threading.get_ident())
+
+
+def domain_thread_name(domain: str) -> Optional[str]:
+    with _registry_lock:
+        return _domain_thread.get(domain)
+
+
+def reset_registry() -> None:
+    """Test hook: drop every thread/domain binding."""
+    with _registry_lock:
+        _thread_domain.clear()
+        _domain_thread.clear()
+
+
+# -- handoff grace --------------------------------------------------------
+
+_handoff = threading.local()
+
+
+class handoff:
+    """Context manager sanctioning cross-domain writes in its block.
+
+    The runtime twin of the static ``# dynalint: handoff=<why>`` comment:
+    use both on a deliberate cross-thread mutation so the static rule
+    and the sanitizer agree it is a declared seam.
+    """
+
+    def __init__(self, why: str):
+        self.why = why
+
+    def __enter__(self) -> "handoff":
+        _handoff.depth = getattr(_handoff, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        _handoff.depth -= 1
+
+
+def in_handoff() -> bool:
+    return getattr(_handoff, "depth", 0) > 0
+
+
+# -- declarations ---------------------------------------------------------
+
+def thread_affinity(domain: str) -> Callable[[F], F]:
+    """Declare a function/method/class's home concurrency domain.
+
+    Static: the tag seeds dynalint's affinity taint (a tagged function
+    and everything it transitively calls is assumed to run on that
+    domain's thread; an explicit tag on a callee overrides the caller's
+    propagated domain).
+
+    Runtime (sanitizer armed): entering a tagged *function* from a
+    thread registered to a different domain raises
+    :class:`AffinityViolation`. Unregistered threads pass — tests and
+    one-shot setup code run wherever they run; the sanitizer only
+    judges threads that declared themselves.
+    """
+    if domain not in DOMAINS:
+        raise ValueError(f"unknown affinity domain {domain!r} (known: {DOMAINS})")
+
+    def deco(obj: F) -> F:
+        if isinstance(obj, type):
+            obj.__dyn_affinity__ = domain  # type: ignore[attr-defined]
+            return obj
+
+        @functools.wraps(obj)
+        def wrapper(*args: Any, **kwargs: Any):
+            if enabled():
+                cur = current_domain()
+                if cur is not None and cur != domain and not in_handoff():
+                    raise AffinityViolation(
+                        f"{obj.__qualname__} is {domain!r}-affine "
+                        f"(owner thread {domain_thread_name(domain)!r}) but "
+                        f"was called from thread "
+                        f"{threading.current_thread().name!r} registered to "
+                        f"domain {cur!r}; route through a declared handoff"
+                    )
+            return obj(*args, **kwargs)
+
+        wrapper.__dyn_affinity__ = domain  # type: ignore[attr-defined]
+        # the undecorated function, for introspection/tests
+        wrapper.__wrapped__ = obj
+        return wrapper  # type: ignore[return-value]
+
+    return deco
+
+
+# -- attribute guards -----------------------------------------------------
+
+_GUARD_ATTR = "__dyn_guarded_attrs__"
+_guard_classes: Dict[type, type] = {}
+_guard_classes_lock = threading.Lock()
+
+
+def _guard_subclass(cls: type) -> type:
+    with _guard_classes_lock:
+        sub = _guard_classes.get(cls)
+        if sub is None:
+            def __setattr__(self: Any, name: str, value: Any) -> None:
+                guards = self.__dict__.get(_GUARD_ATTR)
+                if guards is not None:
+                    owner = guards.get(name)
+                    if owner is not None:
+                        cur = current_domain()
+                        if cur is not None and cur != owner and not in_handoff():
+                            raise AffinityViolation(
+                                f"write to {type(self).__name__}.{name} "
+                                f"from thread "
+                                f"{threading.current_thread().name!r} "
+                                f"(domain {cur!r}) but the attribute is "
+                                f"{owner!r}-affine (owner thread "
+                                f"{domain_thread_name(owner)!r}); wrap the "
+                                f"write in affinity.handoff(...) or route "
+                                f"it through a queue/call_soon_threadsafe"
+                            )
+                object.__setattr__(self, name, value)
+
+            sub = type(cls.__name__, (cls,), {
+                "__setattr__": __setattr__,
+                # keep repr/pickle/isinstance stories untouched
+                "__module__": cls.__module__,
+                "__qualname__": cls.__qualname__,
+            })
+            _guard_classes[cls] = sub
+        return sub
+
+
+def guard_attrs(obj: Any, domains_by_attr: Dict[str, str]) -> Any:
+    """Arm ``obj`` so writes to the named attributes from a thread bound
+    to a different domain raise :class:`AffinityViolation`.
+
+    No-op unless the sanitizer is enabled. Implemented by rebinding the
+    instance to a cached ``__setattr__``-overriding subclass, so only
+    guarded *instances* pay the check and the class itself is untouched.
+    Safe to call repeatedly; later calls merge more attributes."""
+    if not enabled():
+        return obj
+    for attr, domain in domains_by_attr.items():
+        if domain not in DOMAINS:
+            raise ValueError(
+                f"unknown affinity domain {domain!r} for attr {attr!r}"
+            )
+    cls = type(obj)
+    if cls in _guard_classes.values():
+        obj.__dict__.setdefault(_GUARD_ATTR, {}).update(domains_by_attr)
+        return obj
+    sub = _guard_subclass(cls)
+    try:
+        object.__setattr__(obj, _GUARD_ATTR,
+                           {**obj.__dict__.get(_GUARD_ATTR, {}),
+                            **domains_by_attr})
+        obj.__class__ = sub
+    except TypeError:  # __slots__/extension classes can't rebind
+        log.warning("affinity guard: cannot rebind %s; attrs unguarded",
+                    cls.__name__)
+    return obj

@@ -1,4 +1,4 @@
-"""JAX platform selection helpers.
+"""JAX platform selection helpers + version-compat shims.
 
 Some environments register accelerator PJRT plugins at interpreter boot;
 jax initializes every registered backend on first use, which can dial
@@ -7,11 +7,18 @@ deregisters other factories before any backend is created.
 
 Controlled by ``DYN_JAX_PLATFORM`` (e.g. "cpu") and
 ``DYN_JAX_CPU_DEVICES`` (virtual device count for sharding dev-runs).
+
+``shard_map`` / ``pcast`` below bridge the public ``jax.shard_map`` API
+(jax >= 0.6: ``axis_names=`` for partial-auto, ``check_vma=``) onto the
+``jax.experimental.shard_map`` API older jax ships (``auto=`` /
+``check_rep=``), so the sharded model code is written once against the
+current API and still runs on the pinned environment.
 """
 
 from __future__ import annotations
 
 import os
+from typing import Any, Callable, Optional
 
 
 def force_platform(platform: str, cpu_devices: int | None = None) -> None:
@@ -97,3 +104,75 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
         _cache_enabled = True
     except Exception:  # unsupported jax version: cache is an optimization
         pass
+
+
+def shard_map(
+    f: Callable,
+    *,
+    mesh: Any,
+    in_specs: Any,
+    out_specs: Any,
+    axis_names: Optional[set] = None,
+    check_vma: bool = True,
+) -> Callable:
+    """``jax.shard_map`` with the >=0.6 keyword surface, on any jax.
+
+    ``axis_names`` lists the *manual* mesh axes (the rest stay auto, as
+    in the public API); omitted means fully manual. On older jax this
+    lowers to ``jax.experimental.shard_map.shard_map`` with
+    ``auto = mesh.axis_names - axis_names`` and ``check_rep=False``:
+    the old rep checker predates the vma system and rejects valid
+    partial-auto programs, and with it off ``pcast`` is a no-op (which
+    is exactly how :func:`pcast` degrades below).
+    """
+    import jax
+
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        kwargs: dict[str, Any] = dict(
+            mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return native(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as _esm
+
+    auto = (
+        frozenset(mesh.axis_names) - frozenset(axis_names)
+        if axis_names is not None
+        else frozenset()
+    )
+    return _esm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False, auto=auto,
+    )
+
+
+def pcast(x: Any, axis_names: Any, to: str = "varying") -> Any:
+    """``jax.lax.pcast`` when jax has it; identity otherwise.
+
+    Only sound because the :func:`shard_map` fallback above always runs
+    with ``check_rep=False`` — without replication tracking there is no
+    varying/invariant distinction for the cast to repair."""
+    import jax
+
+    native = getattr(jax.lax, "pcast", None)
+    if native is not None:
+        return native(x, axis_names, to=to)
+    return x
+
+
+def partial_auto_shard_map_supported() -> bool:
+    """True when this jax can lower *partial-auto* shard_map (some mesh
+    axes manual, the rest auto).
+
+    The public ``jax.shard_map`` (>= 0.6) lowers it fine; the 0.4.x
+    experimental fallback emits a ``PartitionId`` instruction the XLA
+    SPMD partitioner rejects with UNIMPLEMENTED ("meaning is ambiguous").
+    Fully-manual shard_map (every mesh axis in ``axis_names``) works on
+    both — only the mixed mode needs this probe. Tests that exercise
+    pp x tp / ep x tp partial-auto meshes skip on old jax via this."""
+    import jax
+
+    return getattr(jax, "shard_map", None) is not None

@@ -1,0 +1,52 @@
+"""Violating fixture for DL103 cross-thread-mutation: attributes
+shared between the engine thread and the event loop with no declared
+handoff — the writes race silently today and break mysteriously later."""
+
+from dynamo_tpu.utils.affinity import guard_attrs, thread_affinity
+
+
+class Engine:
+    def __init__(self):
+        self.spec_paused = False  # construction writes are exempt
+        self.steps_done = 0
+        guard_attrs(self, {"spec_paused": "engine"})
+
+    @thread_affinity("engine")
+    def step_once(self):
+        self.steps_done = self.steps_done + 1  # fine: engine-only attr
+        if self.spec_paused:
+            return None
+        return self.run()
+
+    def run(self):
+        return object()
+
+
+class Watcher:
+    def __init__(self, engine):
+        self.engine = engine
+
+    async def on_rung_change(self, level):
+        # two call levels below the coroutine: loop-affine taint rides
+        # the calls down to the write
+        self.apply_rung(level)
+
+    def apply_rung(self, level):
+        self.push_level(level)
+
+    def push_level(self, level):
+        self.engine.spec_paused = level >= 2  # VIOLATION: loop writes engine-affine attr
+
+
+class Counter:
+    """Undeclared shared attribute: written from both domains."""
+
+    def __init__(self):
+        self.total = 0
+
+    @thread_affinity("engine")
+    def bump_from_engine(self):
+        self.total = self.total + 1  # VIOLATION: shares with loop write
+
+    async def reset_from_loop(self):
+        self.total = 0  # VIOLATION: shares with engine write

@@ -1,0 +1,57 @@
+"""Clean fixture for DL103: every cross-domain write is a declared
+handoff — affinity.handoff(...), a lock, a threadsafe loop call, or an
+explicit `# dynalint: handoff=` marker on the deliberate seam."""
+
+import threading
+
+from dynamo_tpu.utils import affinity
+from dynamo_tpu.utils.affinity import guard_attrs, thread_affinity
+
+
+class Engine:
+    def __init__(self):
+        self.spec_paused = False
+        self.steps_done = 0
+        self._lock = threading.Lock()
+        guard_attrs(self, {"spec_paused": "engine"})
+
+    @thread_affinity("engine")
+    def step_once(self):
+        with self._lock:
+            self.steps_done = self.steps_done + 1
+        if self.spec_paused:
+            return None
+        return self.run()
+
+    def run(self):
+        return object()
+
+
+class Watcher:
+    def __init__(self, engine, loop):
+        self.engine = engine
+        self.loop = loop
+
+    async def on_rung_change(self, level):
+        self.apply_rung(level)
+
+    def apply_rung(self, level):
+        # declared on BOTH planes: the runtime sanctions the write, the
+        # comment tells the static rule (and the reader) why it is safe
+        with affinity.handoff("rung -> engine.spec_paused"):
+            self.engine.spec_paused = level >= 2  # dynalint: handoff=rung flip — engine reads the bool each step
+
+
+class Counter:
+    def __init__(self):
+        self.total = 0
+        self._lock = threading.Lock()
+
+    @thread_affinity("engine")
+    def bump_from_engine(self):
+        with self._lock:
+            self.total = self.total + 1
+
+    async def reset_from_loop(self):
+        with self._lock:
+            self.total = 0

@@ -1,0 +1,41 @@
+"""Violating fixture for DL101 transitive-blocking-call-in-async: the
+blocking calls live in SYNC helpers — invisible to DL001 — reached from
+coroutines through ordinary calls, partials, and callback references."""
+
+import functools
+import time
+
+import requests
+
+
+async def handle_request(payload):
+    # level 0: clean async frame (nothing for DL001 here)
+    return await process(payload)
+
+
+async def process(payload):
+    prepared = prepare(payload)  # async -> sync, level 1
+    schedule(functools.partial(slow_io, prepared))  # ref via partial
+    return prepared
+
+
+def prepare(payload):
+    return _retry_fetch(payload)  # level 2
+
+
+def _retry_fetch(payload):
+    for _ in range(3):
+        time.sleep(0.5)  # VIOLATION: 2+ call levels below a coroutine
+        out = requests.get(payload)  # VIOLATION: blocks the event loop
+        if out:
+            return out
+    return None
+
+
+def schedule(fn):
+    fn()
+
+
+def slow_io(prepared):
+    time.sleep(1.0)  # VIOLATION: reached via functools.partial ref
+    return prepared

@@ -1,0 +1,41 @@
+"""Clean fixture for DL101: the same helpers, but every blocking call
+is either handed off to another thread (executor / to_thread — the
+sanctioned remediation) or lives in code declared for a non-loop domain
+(the engine thread may sleep; it is not the event loop)."""
+
+import asyncio
+import time
+
+from dynamo_tpu.utils.affinity import thread_affinity
+
+
+async def handle_request(payload):
+    loop = asyncio.get_running_loop()
+    # blocking helper runs on a pool thread: the handoff cuts the taint
+    prepared = await loop.run_in_executor(None, prepare, payload)
+    await asyncio.to_thread(slow_io, prepared)
+    return prepared
+
+
+def prepare(payload):
+    return _retry_fetch(payload)
+
+
+def _retry_fetch(payload):
+    for _ in range(3):
+        time.sleep(0.5)  # fine: only ever reached via an executor
+        if payload:
+            return payload
+    return None
+
+
+def slow_io(prepared):
+    time.sleep(1.0)  # fine: asyncio.to_thread target
+    return prepared
+
+
+@thread_affinity("engine")
+def engine_pacing(budget_s):
+    # fine: declared engine-thread code — the dedicated step-loop
+    # thread may sleep without stalling the event loop
+    time.sleep(budget_s)

@@ -1,0 +1,41 @@
+"""Violating fixture for DL102 transitive-host-sync-in-step-loop:
+device->host syncs in helpers the step loop reaches through calls —
+outside DL010's single-frame view."""
+
+import numpy as np
+
+
+def run_step_loop(state):
+    while state.running:
+        plan = make_plan(state)
+        dispatch(state, plan)
+
+
+def make_plan(state):
+    # level 1 below the loop: DL010 cannot see this frame
+    depth = int(state.queue_depth.item())  # VIOLATION: hidden sync
+    return {"depth": depth}
+
+
+def dispatch(state, plan):
+    state.launch(plan)
+    note_stats(plan)
+
+
+def note_stats(plan):
+    # level 2 below the loop, reached via dispatch
+    tokens = np.asarray(plan["tokens"])  # VIOLATION: hidden sync
+    plan["stats"] = tokens.sum()
+
+
+def drain(state):
+    return finalize(state)
+
+
+def finalize(state):
+    # two levels below step_loop_tail (a second loop entry point)
+    return state.result.tolist()  # VIOLATION: hidden sync
+
+
+def step_loop_tail(state):
+    return drain(state)

@@ -1,0 +1,192 @@
+"""Runtime affinity sanitizer (dynamo_tpu/utils/affinity.py,
+DYN_AFFINITY_CHECK=1): thread/domain registry, attribute guards,
+handoff grace, the @thread_affinity entry check — and the engine
+end-to-end under the sanitizer: a full generate must pass while a raw
+cross-thread write to a guarded attribute is rejected with a diagnostic
+naming both threads and the attribute."""
+
+import asyncio
+import threading
+
+import pytest
+
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.utils import affinity
+
+
+@pytest.fixture(autouse=True)
+def _sanitizer_armed():
+    affinity.set_enabled(True)
+    affinity.reset_registry()
+    yield
+    affinity.reset_registry()
+    affinity.set_enabled(None)  # back to env-driven
+
+
+class Box:
+    def __init__(self):
+        self.flag = False
+        self.other = 0
+
+
+def test_cross_thread_write_rejected_naming_threads_and_attr():
+    box = Box()
+    affinity.guard_attrs(box, {"flag": "engine"})
+    done = threading.Event()
+
+    def engine_side():
+        affinity.register_thread("engine")
+        box.flag = True  # owner domain: allowed
+        done.wait(5)
+        affinity.unregister_thread()
+
+    t = threading.Thread(target=engine_side, name="fake-engine")
+    t.start()
+    try:
+        affinity.register_thread("loop")  # this (main) thread = loop
+        with pytest.raises(affinity.AffinityViolation) as exc:
+            box.flag = False
+        msg = str(exc.value)
+        # the diagnostic must name the attribute, the writing thread +
+        # domain, and the owning domain's thread
+        assert "flag" in msg
+        assert "loop" in msg and "engine" in msg
+        assert threading.current_thread().name in msg
+        assert "fake-engine" in msg
+    finally:
+        done.set()
+        t.join(5)
+
+
+def test_handoff_sanctions_cross_domain_write():
+    box = Box()
+    affinity.guard_attrs(box, {"flag": "engine"})
+    affinity.register_thread("loop")
+    with affinity.handoff("test seam"):
+        box.flag = True
+    assert box.flag is True
+    # unguarded attrs never check
+    box.other = 7
+    assert box.other == 7
+
+
+def test_unregistered_threads_pass():
+    # pytest's main thread has no domain: writes are not judged
+    box = Box()
+    affinity.guard_attrs(box, {"flag": "engine"})
+    box.flag = True
+    assert box.flag
+
+
+def test_thread_affinity_decorator_entry_check():
+    @affinity.thread_affinity("engine")
+    def step():
+        return 42
+
+    assert step() == 42  # unregistered caller passes
+    affinity.register_thread("loop")
+    with pytest.raises(affinity.AffinityViolation):
+        step()
+    with affinity.handoff("driving the step inline"):
+        assert step() == 42
+    assert step.__dyn_affinity__ == "engine"
+
+
+def test_disabled_sanitizer_is_inert():
+    affinity.set_enabled(False)
+    box = Box()
+    out = affinity.guard_attrs(box, {"flag": "engine"})
+    assert type(out) is Box  # no subclass rebind
+    affinity.register_thread("loop")
+    box.flag = True  # nothing raises
+
+    @affinity.thread_affinity("engine")
+    def step():
+        return 1
+
+    assert step() == 1
+
+
+def test_guard_attrs_merges_and_repr_stays_sane():
+    box = Box()
+    affinity.guard_attrs(box, {"flag": "engine"})
+    affinity.guard_attrs(box, {"other": "loop"})
+    affinity.register_thread("planner")
+    with pytest.raises(affinity.AffinityViolation):
+        box.flag = True
+    with pytest.raises(affinity.AffinityViolation):
+        box.other = 1
+    assert type(box).__name__ == "Box"  # cosmetic identity preserved
+
+
+def test_unknown_domain_rejected():
+    with pytest.raises(ValueError):
+        affinity.register_thread("gpu")
+    with pytest.raises(ValueError):
+        affinity.thread_affinity("gpu")
+    with pytest.raises(ValueError):
+        affinity.guard_attrs(Box(), {"flag": "gpu"})
+
+
+# ---------------------------------------------------------------------------
+# engine end-to-end under the sanitizer
+# ---------------------------------------------------------------------------
+
+
+async def test_engine_generates_under_sanitizer_and_rejects_raw_flip():
+    """DYN_AFFINITY_CHECK=1 over the real engine: launch registers the
+    loop, the step loop registers the engine thread, spec_suspended is
+    guarded — a normal generate plus the sanctioned degradation flip
+    must pass; a raw cross-thread write must raise."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.planner.degradation import ServingDegradation
+
+    mc = ModelConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128,
+    )
+    engine = await JaxEngine.launch(
+        EngineConfig(
+            model_path="", model_name="affinity-test", random_weights=True,
+            num_blocks=32, block_size=4, max_batch_size=4,
+            kv_cache_dtype="float32",
+        ),
+        model_config=mc,
+    )
+    try:
+        # the sanctioned seam: degradation rung flips spec_suspended
+        # through affinity.handoff — must not raise on the loop thread
+        deg = ServingDegradation(engine=engine)
+        deg.set_level(2)
+        assert engine.spec_suspended is True
+        deg.set_level(0)
+        assert engine.spec_suspended is False
+
+        # a raw flip from the loop thread is exactly what the sanitizer
+        # exists to catch
+        with pytest.raises(affinity.AffinityViolation) as exc:
+            engine.spec_suspended = True
+        assert "spec_suspended" in str(exc.value)
+
+        # and the engine still serves correctly with guards armed
+        adapter = engine.as_async_engine()
+        req = PreprocessedRequest(
+            request_id="aff-1",
+            token_ids=list(range(1, 20)),
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=4, ignore_eos=True),
+        )
+        toks = []
+        async for item in adapter.generate(req, Context()):
+            toks.extend(item.token_ids)
+        assert len(toks) == 4
+    finally:
+        await engine.shutdown()

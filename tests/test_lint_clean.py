@@ -1,9 +1,13 @@
 """Self-clean gate: `dynamo-tpu lint` over dynamo_tpu/ must report zero
-unsuppressed findings. This test IS the CI wiring — it runs inside the
-tier-1 pytest command on every change, so a new blocking call, dropped
-task handle, or swallowed cancellation fails the merge without any extra
-CI configuration."""
+unsuppressed findings — per-file rules AND the whole-program DL1xx pass
+(call graph + taints). This test IS the CI wiring — it runs inside the
+tier-1 pytest command on every change, so a new blocking call, hidden
+transitive device sync, or undeclared cross-thread write fails the
+merge without any extra CI configuration. It also measures the warm
+path: a second run through the on-disk result cache must finish in
+under 5s, which is what keeps whole-repo lint viable inside tier-1."""
 
+import time
 from pathlib import Path
 
 import pytest
@@ -14,6 +18,7 @@ from dynamo_tpu.analysis import (
     load_config,
     unsuppressed,
 )
+from dynamo_tpu.analysis.cache import LintCache
 
 REPO = Path(__file__).resolve().parents[1]
 
@@ -21,13 +26,36 @@ REPO = Path(__file__).resolve().parents[1]
 @pytest.mark.pre_merge
 def test_repo_is_lint_clean():
     cfg = load_config(start=str(REPO))
-    findings = lint_paths(cfg["include"], config=cfg)
+    cache = LintCache(REPO / ".dynalint_cache")
+    findings = lint_paths(cfg["include"], config=cfg, cache=cache)
     live = unsuppressed(findings)
     assert live == [], (
         "unsuppressed dynalint findings (fix them, or waive a deliberate "
-        "pattern in place with `# dynalint: disable=<rule> — why`):\n"
-        + format_text(findings)
+        "pattern in place with `# dynalint: disable=<rule> — why`; declare "
+        "a deliberate cross-thread write with `# dynalint: handoff=<why>`"
+        "):\n" + format_text(findings)
     )
+
+
+@pytest.mark.pre_merge
+def test_warm_whole_repo_lint_under_5s():
+    # the acceptance bound for keeping lint inside tier-1: with the
+    # cache primed, a full-repo lint hits the per-file AND program
+    # entries and never parses a file. Prime explicitly so the test
+    # holds standalone, then measure a fresh cache instance (true
+    # cold-process warm path: read cache.json, hash files, look up).
+    cfg = load_config(start=str(REPO))
+    lint_paths(cfg["include"], config=cfg,
+               cache=LintCache(REPO / ".dynalint_cache"))
+    cache = LintCache(REPO / ".dynalint_cache")
+    t0 = time.monotonic()
+    findings = lint_paths(cfg["include"], config=cfg, cache=cache)
+    dt = time.monotonic() - t0
+    assert unsuppressed(findings) == []
+    assert cache.misses == 0, (
+        f"warm run missed the cache {cache.misses} time(s) — key drift?"
+    )
+    assert dt < 5.0, f"warm whole-repo lint took {dt:.1f}s (budget 5s)"
 
 
 @pytest.mark.pre_merge

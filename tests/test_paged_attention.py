@@ -214,6 +214,8 @@ def test_decode_kernel_shard_map_tp():
 
     from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
 
+    from dynamo_tpu.utils.jaxtools import shard_map
+
     Dh, bs, num_blocks = 128, 16, 16
     B, H, Hk = 2, 8, 4
     q, k, v, tables, ctx = _setup(B, H, Hk, Dh, num_blocks, bs, [23, 37])
@@ -221,7 +223,7 @@ def test_decode_kernel_shard_map_tp():
     kern = functools.partial(
         paged_attention_decode, block_size=bs, interpret=True
     )
-    wrapped = jax.shard_map(
+    wrapped = shard_map(
         kern,
         mesh=mesh,
         in_specs=(
