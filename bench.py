@@ -18,8 +18,12 @@ TPU: weight-only per-channel int8, which is also what lets the REAL
 8B flagship shape fit one 16 GB chip — bf16 does not);
 DYN_BENCH_MODEL=8b|3.8b (default 8b: R1-Distill-Llama-8B geometry,
 BASELINE.md config 1); DYN_BENCH_KV_DTYPE=bfloat16|int8|float8_e4m3fn
-(default bfloat16 — int8 halves KV bytes/token and is the long-context
-serving default, see benchmarks/RESULTS.md round-5 sections).
+(default int8 — the Pallas decode kernel dequantizes int8 pages
+in-register, so the halved KV bytes are pure roofline headroom;
+``--kv-dtype`` below records the bf16-vs-int8 delta);
+DYN_MATMUL_IMPL=auto|reference|pallas selects the quantized-matmul
+path (models/llama.py — auto is the fused dequant Pallas kernels on a
+single TPU chip) and the headline JSON records the resolved impl.
 
 ``--spec`` switches to the speculative-decoding A/B mode: the same
 workload runs once without and once with speculation (both at
@@ -30,6 +34,18 @@ DYN_BENCH_SPEC_DRAFTER (default "ngram"), DYN_BENCH_SPEC_TOKENS
 (default 4). Repetitive prompts (the self-drafting sweet spot) via
 DYN_BENCH_SPEC_REPEAT=1 — the default keeps the standard random-prompt
 workload, where the reported accept rate is an honest floor.
+
+``--matmul`` is the reference-vs-Pallas quantized-matmul A/B at the
+headline config: the same workload runs once with
+DYN_MATMUL_IMPL=reference (XLA mixed int8×bf16 dot) and once with
+=pallas (ops/qmatmul.py fused dequant kernels); vs_baseline =
+pallas/reference throughput. ``--kv-dtype`` is the bf16-vs-int8 KV
+cache A/B (vs_baseline = int8/bf16). ``--phases`` augments the
+headline JSON with a per-phase device-time + HBM-bytes breakdown
+(attention / MLP / LM-head / sampling, docs/performance.md): each
+phase microbenches the real step computation at the headline geometry
+and reports its ideal HBM bytes and the bandwidth its measured time
+implies — the roofline gap decomposed instead of guessed at.
 
 ``--overlap`` is the serial-vs-overlap A/B (docs/performance.md): the
 same workload at decode_steps=1 runs once with --no-overlap (fully
@@ -122,8 +138,15 @@ def _param_bytes(mc, quant: str) -> int:
     return bytes_per * (per_layer * L + 2 * V * D)
 
 
-def _kv_bytes_per_token(mc) -> float:
-    dt = os.environ.get("DYN_BENCH_KV_DTYPE", "bfloat16")
+def _bench_kv_dtype() -> str:
+    # int8 headline default: the decode kernel reads int8 pages with
+    # in-register dequant, so halved KV bytes are pure roofline headroom
+    # (the bf16-vs-int8 delta is recorded by --kv-dtype)
+    return os.environ.get("DYN_BENCH_KV_DTYPE", "int8")
+
+
+def _kv_bytes_per_token(mc, kv_dtype: str = None) -> float:
+    dt = kv_dtype or _bench_kv_dtype()
     if dt in ("fp8", "float8", "float8_e4m3fn", "float8_e5m2"):
         per_elem = 1.0
     elif dt == "int8":
@@ -135,7 +158,7 @@ def _kv_bytes_per_token(mc) -> float:
 
 async def _run(
     model_cfg, wl, spec: bool = False, decode_steps=None, slo=None,
-    overlap: bool = True,
+    overlap: bool = True, kv_dtype: str = None,
 ) -> dict:
     """``slo`` = (ttft_ms, itl_ms) targets; when set, the result dict
     gains slo_attainment / goodput_tokens / requests_met from the
@@ -167,10 +190,11 @@ async def _run(
     )
     from dynamo_tpu.runtime.engine import Context
 
+    kv_dtype = kv_dtype or _bench_kv_dtype()
     cfg = EngineConfig(
         model_path="", model_name="bench", random_weights=True,
         quantization="int8" if wl["quant"] == "int8" else None,
-        kv_cache_dtype=os.environ.get("DYN_BENCH_KV_DTYPE", "bfloat16"),
+        kv_cache_dtype=kv_dtype,
         num_blocks=wl["num_blocks"], block_size=wl["block_size"],
         max_batch_size=wl["batch"],
         prefill_chunk_size=int(os.environ.get("DYN_BENCH_PREFILL_CHUNK", "1024")),
@@ -271,7 +295,7 @@ async def _run(
 
     # roofline: per decode step, read all weights once + each seq's KV
     avg_ctx = wl["isl"] + wl["osl"] / 2
-    step_bytes = _param_bytes(model_cfg, wl["quant"]) + wl["batch"] * avg_ctx * _kv_bytes_per_token(model_cfg)
+    step_bytes = _param_bytes(model_cfg, wl["quant"]) + wl["batch"] * avg_ctx * _kv_bytes_per_token(model_cfg, kv_dtype)
     roofline_tput = wl["batch"] / (step_bytes / HBM_BW_BYTES)
 
     # device-idle attribution over the MEASURED window only (warmup
@@ -302,10 +326,16 @@ async def _run(
     spec_proposed = engine.spec_proposed_total
     spec_accepted = engine.spec_accepted_total
     slo_stats = engine.slo.stats()
+    # resolve the matmul impl WHILE the engine's mesh is registered:
+    # shutdown clears it, after which auto would misreport "reference"
+    # on multi-device hosts for a run that used the Pallas kernels
+    matmul_impl = _resolved_matmul_impl()
     await engine.shutdown()
     return {
         "slo": slo_stats,
         "overlap": overlap_stats,
+        "kv_dtype": kv_dtype,
+        "matmul_impl": matmul_impl,
         "tput": tput,
         "p50_ttft_s": _percentile(ttfts, 50),
         "p90_ttft_s": _percentile(ttfts, 90),
@@ -426,6 +456,270 @@ def _main_overlap_ab(model_cfg, wl) -> None:
         f"{over['overlap']['device_idle_frac']:.3f}",
         file=sys.stderr,
     )
+
+
+def _resolved_matmul_impl() -> str:
+    from dynamo_tpu.models.llama import matmul_impl
+
+    return matmul_impl()
+
+
+def _main_matmul_ab(model_cfg, wl) -> None:
+    """--matmul: reference-vs-Pallas quantized-matmul A/B at the
+    headline config (same workload, same decode_steps). vs_baseline =
+    pallas/reference throughput — > 1.0 means the in-register dequant
+    kernels converted int8 weight bytes into tokens the XLA mixed-dtype
+    dot could not. Off-TPU the Pallas side runs interpreted (a
+    correctness smoke, not a speed number — the JSON records the
+    backend so nobody reads a CPU ratio as a win)."""
+    os.environ["DYN_MATMUL_IMPL"] = "reference"
+    ref = asyncio.run(_run(model_cfg, wl))
+    os.environ["DYN_MATMUL_IMPL"] = "pallas"
+    try:
+        pal = asyncio.run(_run(model_cfg, wl))
+    finally:
+        os.environ.pop("DYN_MATMUL_IMPL", None)
+    import jax
+
+    out = {
+        "metric": "engine_matmul_ab_1chip",
+        "value": round(pal["tput"], 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(pal["tput"] / max(ref["tput"], 1e-9), 4),
+        "config": {
+            "model": wl["model_name"],
+            "batch": wl["batch"],
+            "isl": wl["isl"],
+            "osl": wl["osl"],
+            "quant": wl["quant"],
+            "kv_dtype": ref["kv_dtype"],
+            "backend": jax.default_backend(),
+            "reference_tok_s": round(ref["tput"], 2),
+            "pallas_tok_s": round(pal["tput"], 2),
+            "p50_itl_ms_reference": round(ref["p50_itl_s"] * 1000, 2),
+            "p50_itl_ms_pallas": round(pal["p50_itl_s"] * 1000, 2),
+            "p99_itl_ms_reference": round(ref["p99_itl_s"] * 1000, 2),
+            "p99_itl_ms_pallas": round(pal["p99_itl_s"] * 1000, 2),
+        },
+    }
+    print(json.dumps(out))
+    print(
+        f"# matmul A/B: reference={ref['tput']:.1f} "
+        f"pallas={pal['tput']:.1f} tok/s "
+        f"(x{out['vs_baseline']:.3f})",
+        file=sys.stderr,
+    )
+
+
+def _main_kv_dtype_ab(model_cfg, wl) -> None:
+    """--kv-dtype: bf16-vs-int8 KV cache A/B at the headline config.
+    vs_baseline = int8/bf16 throughput — the record of what flipping
+    the headline default to the quantized cache actually bought (the
+    decode kernel reads int8 pages + scales either way; only the cache
+    bytes change)."""
+    bf16 = asyncio.run(_run(model_cfg, wl, kv_dtype="bfloat16"))
+    int8 = asyncio.run(_run(model_cfg, wl, kv_dtype="int8"))
+    avg_ctx = wl["isl"] + wl["osl"] / 2
+    out = {
+        "metric": "engine_kv_dtype_ab_1chip",
+        "value": round(int8["tput"], 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(int8["tput"] / max(bf16["tput"], 1e-9), 4),
+        "config": {
+            "model": wl["model_name"],
+            "batch": wl["batch"],
+            "isl": wl["isl"],
+            "osl": wl["osl"],
+            "quant": wl["quant"],
+            "matmul_impl": int8["matmul_impl"],
+            "bf16_tok_s": round(bf16["tput"], 2),
+            "int8_tok_s": round(int8["tput"], 2),
+            # the byte story behind the ratio: per-step KV traffic at
+            # the workload's average context, both dtypes
+            "kv_bytes_per_step_bf16": int(
+                wl["batch"] * avg_ctx
+                * _kv_bytes_per_token(model_cfg, "bfloat16")
+            ),
+            "kv_bytes_per_step_int8": int(
+                wl["batch"] * avg_ctx
+                * _kv_bytes_per_token(model_cfg, "int8")
+            ),
+            "p50_itl_ms_bf16": round(bf16["p50_itl_s"] * 1000, 2),
+            "p50_itl_ms_int8": round(int8["p50_itl_s"] * 1000, 2),
+            "p99_itl_ms_bf16": round(bf16["p99_itl_s"] * 1000, 2),
+            "p99_itl_ms_int8": round(int8["p99_itl_s"] * 1000, 2),
+        },
+    }
+    print(json.dumps(out))
+    print(
+        f"# kv-dtype A/B: bf16={bf16['tput']:.1f} int8={int8['tput']:.1f} "
+        f"tok/s (x{out['vs_baseline']:.3f})",
+        file=sys.stderr,
+    )
+
+
+def _phase_breakdown(model_cfg, wl, kv_dtype: str) -> dict:
+    """Decompose one decode step's device time into attention / MLP /
+    LM-head / sampling by microbenching each phase's REAL computation
+    (the serving params and cache geometry, the serving kernels) at the
+    headline shape. Per phase: measured device ms, the ideal HBM bytes
+    that phase must move, and the bandwidth the measured time implies —
+    achieved-vs-ideal, so the roofline gap names its owner instead of
+    being guessed at. ``step_ms_sum`` vs the engine-measured step time
+    shows how much of a real step the decomposition accounts for."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.quant import init_params_quantized
+
+    mc = model_cfg
+    B = wl["batch"]
+    bs = wl["block_size"]
+    avg_ctx = int(wl["isl"] + wl["osl"] / 2)
+    L, D, F, V = (
+        mc.num_hidden_layers, mc.hidden_size, mc.intermediate_size,
+        mc.vocab_size,
+    )
+    H, Hk, Dh = mc.num_attention_heads, mc.num_key_value_heads, mc.head_dim
+    quant = wl["quant"] == "int8"
+    params = (
+        init_params_quantized(mc, seed=0) if quant
+        else llama.init_params(mc, seed=0)
+    )
+    # register a size-1 mesh exactly like the single-chip engine does,
+    # so matmul_impl/pallas_matmul_active resolve HERE the same way
+    # they did inside the headline run (the engine cleared the mesh at
+    # shutdown; without this, multi-device hosts would microbench the
+    # reference path while the headline ran the fused kernels)
+    from jax.sharding import Mesh
+
+    prev_mesh = llama.get_attention_mesh()
+    llama.set_attention_mesh(
+        Mesh(
+            np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1),
+            ("dp", "pp", "tp", "ep"),
+        )
+    )
+
+    blocks_per_seq = -(-avg_ctx // bs)
+    num_blocks = B * blocks_per_seq + 1
+    cache_dt = {"int8": jnp.int8, "bfloat16": jnp.bfloat16}.get(
+        kv_dtype, jnp.bfloat16
+    )
+    k_cache, v_cache = llama.init_cache(mc, num_blocks, bs, dtype=cache_dt)
+    tables = np.asarray(
+        1 + np.arange(B * blocks_per_seq).reshape(B, blocks_per_seq),
+        np.int32,
+    )
+    ctx = np.full((B,), avg_ctx, np.int32)
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, H, Dh)), jnp.bfloat16)
+    x_dec = jnp.asarray(rng.standard_normal((B, 1, D)), jnp.bfloat16)
+    x_last = x_dec[:, 0]
+    logits = jnp.asarray(rng.standard_normal((B, V)), jnp.float32)
+
+    interpret = jax.default_backend() != "tpu"
+    lp = {
+        k: params[k][0] if params[k].shape[0] == L else params[k]
+        for k in llama.layer_param_names(params)
+    }
+
+    def attn_layer(q, kc, vc, t, c):
+        from dynamo_tpu.ops.paged_attention import (
+            paged_attention_decode_stacked,
+        )
+
+        ksc = vsc = None
+        if llama.kv_cache_is_quantized(kc):
+            (kc, ksc), (vc, vsc) = kc, vc
+        return paged_attention_decode_stacked(
+            q, kc, vc, jnp.int32(0), t, c, block_size=bs,
+            interpret=interpret, k_scale=ksc, v_scale=vsc,
+        )
+
+    def mlp_full(x):
+        """One layer's complete matmul set at the decode shape: the
+        qkv projections feed wq's output through the SHARED
+        post-attention chain (llama.post_attn_mlp — the exact served
+        composition, fused Pallas epilogues and all; attention itself
+        is the phase above). k/v are returned so DCE cannot drop their
+        weight reads from the measurement."""
+        h = llama.rmsnorm(x, lp["attn_norm"], mc.rms_norm_eps)
+        a = llama.mm(lp, "wq", h)
+        k = llama.mm(lp, "wk", h)
+        v = llama.mm(lp, "wv", h)
+        return llama.post_attn_mlp(mc, lp, x, a), k, v
+
+    def lm_head_fn(x):
+        return llama.lm_head(params, x)
+
+    def sample_fn(lg):
+        lse = jax.nn.logsumexp(lg, axis=-1)
+        tok = jnp.argmax(lg, axis=-1)
+        return tok, jnp.take_along_axis(lg, tok[:, None], 1)[:, 0] - lse
+
+    def timed(fn, *args, reps: int = 5) -> float:
+        f = jax.jit(fn)
+        out = f(*args)
+        jax.block_until_ready(out)  # compile outside the clock
+        best = float("inf")
+        for _ in range(reps):
+            t0 = _time.monotonic()
+            out = f(*args)
+            jax.block_until_ready(out)
+            best = min(best, _time.monotonic() - t0)
+        return best
+
+    try:
+        t_attn1 = timed(
+            attn_layer, q, k_cache, v_cache, jnp.asarray(tables),
+            jnp.asarray(ctx),
+        )
+        t_mlp1 = timed(mlp_full, x_dec)
+        t_lm = timed(lm_head_fn, x_last)
+        t_sample = timed(sample_fn, logits)
+    finally:
+        llama.set_attention_mesh(prev_mesh)
+
+    wbytes = 1 if quant else 2
+    mlp_weight_bytes = (
+        D * H * Dh + 2 * D * Hk * Dh + H * Dh * D + 3 * D * F
+    ) * wbytes
+    phases = {
+        "attention": {
+            "device_ms": round(t_attn1 * L * 1e3, 3),
+            "ideal_bytes": int(
+                B * avg_ctx * _kv_bytes_per_token(mc, kv_dtype)
+            ),
+        },
+        "mlp": {
+            "device_ms": round(t_mlp1 * L * 1e3, 3),
+            "ideal_bytes": int(mlp_weight_bytes * L),
+        },
+        "lm_head": {
+            "device_ms": round(t_lm * 1e3, 3),
+            "ideal_bytes": int(D * V * wbytes + (V * 4 if quant else 0)),
+        },
+        "sampling": {
+            "device_ms": round(t_sample * 1e3, 3),
+            "ideal_bytes": int(B * V * 4),
+        },
+    }
+    for ph in phases.values():
+        dt = ph["device_ms"] / 1e3
+        ph["implied_gbs"] = round(ph["ideal_bytes"] / max(dt, 1e-9) / 1e9, 2)
+        ph["bw_frac"] = round(
+            ph["ideal_bytes"] / max(dt, 1e-9) / HBM_BW_BYTES, 4
+        )
+    phases["step_ms_sum"] = round(
+        sum(p["device_ms"] for p in phases.values() if isinstance(p, dict)),
+        3,
+    )
+    return phases
 
 
 def _main_chaos_ab(model_cfg, wl) -> None:
@@ -633,8 +927,19 @@ def main() -> None:
     if "--overlap" in sys.argv[1:]:
         _main_overlap_ab(model_cfg, wl)
         return
+    if "--matmul" in sys.argv[1:]:
+        _main_matmul_ab(model_cfg, wl)
+        return
+    if "--kv-dtype" in sys.argv[1:]:
+        _main_kv_dtype_ab(model_cfg, wl)
+        return
     headline_overlap = os.environ.get("DYN_BENCH_OVERLAP", "1") != "0"
     r = asyncio.run(_run(model_cfg, wl, overlap=headline_overlap))
+    phases = (
+        _phase_breakdown(model_cfg, wl, r["kv_dtype"])
+        if "--phases" in sys.argv[1:]
+        else None
+    )
     out = {
         "metric": "engine_decode_throughput_1chip",
         "value": round(r["tput"], 2),
@@ -647,7 +952,10 @@ def main() -> None:
             "hidden": model_cfg.hidden_size,
             "vocab": model_cfg.vocab_size,
             "quant": wl["quant"],
-            "kv_dtype": os.environ.get("DYN_BENCH_KV_DTYPE", "bfloat16"),
+            "kv_dtype": r["kv_dtype"],
+            # resolved quantized-matmul impl (ops/qmatmul.py kernels vs
+            # XLA mixed dot) — headline movement must name its lever
+            "matmul_impl": r["matmul_impl"],
             "batch": wl["batch"],
             "isl": wl["isl"],
             "osl": wl["osl"],
@@ -671,6 +979,14 @@ def main() -> None:
             "p99_itl_ms": round(r["p99_itl_s"] * 1000, 2),
         },
     }
+    if phases is not None:
+        # per-phase device-time + bytes breakdown (--phases): the
+        # roofline gap decomposed in the artifact itself
+        out["config"]["phases"] = phases
+        step_ms_engine = round(
+            wl["batch"] / max(r["tput"], 1e-9) * 1e3, 3
+        )
+        out["config"]["phases"]["step_ms_engine"] = step_ms_engine
     print(json.dumps(out))
     print(
         f"# detail: total_tokens={r['total_tokens']} wall={r['wall_s']:.2f}s "

@@ -706,6 +706,7 @@ class JaxEngine:
                 remote_objects=getattr(self, "_remote_kv_objects", None),
             )
             self.scheduler.onboard = self._safe_onboard
+        self._ensure_qmatmul_tuned()
         self._build_step_fn()
         prewarm = cfg.prewarm
         if prewarm is None:
@@ -727,6 +728,60 @@ class JaxEngine:
             num_blocks,
             cfg.block_size,
         )
+
+    def _ensure_qmatmul_tuned(self) -> None:
+        """Resolve tile configs for every qmatmul shape the step
+        functions can reach, BEFORE those functions trace — the tile
+        choice is a trace-time constant, so a tuned entry landing after
+        tracing would never be used. Reads the on-disk tune table;
+        with DYN_QMATMUL_TUNE=1 on TPU, missing shapes are measured and
+        persisted here (one-time cost, then cached). The step-shape
+        prewarm below then compiles the kernels as part of the jitted
+        steps — no separate kernel warmup is needed."""
+        from dynamo_tpu.models.llama import pallas_matmul_active
+
+        if not pallas_matmul_active() or self.config.quantization != "int8":
+            return
+        mc, sched = self.model_config, self.scheduler
+        assert mc is not None and sched is not None
+        D, F, V = mc.hidden_size, mc.intermediate_size, mc.vocab_size
+        H, Hk, Dh = (
+            mc.num_attention_heads, mc.num_key_value_heads, mc.head_dim,
+        )
+        decode_buckets = sorted(
+            {b for b in (sched.decode_batch_small, sched.decode_batch_mid,
+                         sched.decode_batch_pad) if b}
+        ) or [1]
+        ms = set(decode_buckets)
+        max_chunk = next_bucket(
+            self.config.prefill_chunk_size, sched.prefill_chunk_buckets
+        )
+        for b in sched.prefill_batch_buckets:
+            for chunk in sched.prefill_chunk_buckets:
+                if chunk <= max_chunk:
+                    ms.add(b * chunk)
+        if self.config.spec_decode:
+            for b in decode_buckets:
+                ms.add(b * (self.config.spec_tokens + 1))
+        shapes: list[tuple[int, int, int, str]] = []
+        for m in sorted(ms):
+            shapes += [
+                (m, D, H * Dh, "mm"),          # wq
+                (m, D, Hk * Dh, "mm"),         # wk / wv
+                (m, H * Dh, D, "residual"),    # wo + residual epilogue
+                (m, F, D, "residual"),         # w_down + residual epilogue
+                (m, D, F, "gate_up"),          # fused gate/up
+            ]
+        # lm_head reads [B, D] (last-token gather) on every non-spec
+        # path; the spec verify path feeds the full [B, S] rectangle
+        lm_ms = set(decode_buckets) | set(sched.prefill_batch_buckets)
+        if self.config.spec_decode:
+            lm_ms |= {b * (self.config.spec_tokens + 1) for b in decode_buckets}
+        for m in sorted(lm_ms):
+            shapes.append((m, D, V, "lm_head"))
+        from dynamo_tpu.ops import qmatmul
+
+        qmatmul.ensure_tuned(shapes)
 
     def _prewarm(self) -> None:
         """Compile every serving-path shape variant NOW, before the

@@ -246,14 +246,59 @@ def scale_embed(cfg: ModelConfig, x: jax.Array) -> jax.Array:
     return (x.astype(jnp.float32) * math.sqrt(cfg.hidden_size)).astype(x.dtype)
 
 
+def matmul_impl() -> str:
+    """Quantized-matmul implementation: DYN_MATMUL_IMPL =
+    auto|reference|pallas (mirrors DYN_ATTN_IMPL).
+
+    auto = the fused dequant Pallas kernels (ops/qmatmul.py) on TPU for
+    single-device serving (jax.device_count() == 1, or an engine-
+    registered size-1 mesh), the XLA mixed-dtype dot elsewhere. Off-TPU
+    the kernels run interpreted (correct but slow — tests only).
+    Multi-device meshes stay on the reference path: wo/w_down contract
+    a tp-sharded axis, and the kernels carry no psum story."""
+    impl = os.environ.get("DYN_MATMUL_IMPL", "auto")
+    if impl == "auto":
+        if jax.default_backend() == "tpu" and _single_device_matmul():
+            return "pallas"
+        return "reference"
+    return impl
+
+
+def _single_device_matmul() -> bool:
+    return jax.device_count() == 1 or (
+        _ATTN_MESH is not None and _ATTN_MESH.size == 1
+    )
+
+
+def pallas_matmul_active() -> bool:
+    """True when quantized matmuls will ACTUALLY dispatch the Pallas
+    dequant kernels — impl choice AND an unsharded-weights
+    configuration (the same shape of predicate as
+    pallas_attention_active)."""
+    return matmul_impl() == "pallas" and _single_device_matmul()
+
+
+def _qmm_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
 def mm(p: Params, name: str, x: jax.Array) -> jax.Array:
     """x @ p[name], transparently handling int8 weight-only quantization
-    (models/quant.py): a mixed-dtype dot (bf16 activations × int8 weight,
-    f32 accumulation) keeps HBM reads int8-sized — measured ~1.3-2×
-    decode speedup over bf16 on v5e — then the per-output-channel scale
-    applies to the f32 product before casting back."""
+    (models/quant.py). Reference epilogue: a mixed-dtype dot (bf16
+    activations × int8 weight, f32 accumulation) keeps HBM reads
+    int8-sized — measured ~1.3-2× decode speedup over bf16 on v5e —
+    then the per-output-channel scale applies to the f32 product before
+    casting back. Under DYN_MATMUL_IMPL=pallas the fused dequant kernel
+    (ops/qmatmul.py) does the same math with the upcast in-register,
+    which is what actually reaches int8-byte-bound weight reads."""
     w = p[name]
     if w.dtype == jnp.int8:
+        if pallas_matmul_active():
+            from dynamo_tpu.ops.qmatmul import qmm
+
+            return qmm(
+                x, w, p[name + "_scale"], interpret=_qmm_interpret()
+            )
         y = jax.lax.dot_general(
             x, w, (((x.ndim - 1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -438,6 +483,63 @@ def attn_impl() -> str:
 # ---------------------------------------------------------------------------
 
 
+def fused_mlp_ok(cfg: ModelConfig, lp: Params) -> bool:
+    """The fused dequant epilogues serve this layer: dense MLP with
+    every hot-path weight int8-quantized and a kernel-supported gate
+    activation, under the Pallas matmul impl."""
+    return (
+        not cfg.is_moe
+        and pallas_matmul_active()
+        and cfg.hidden_act in ("silu", "gelu")
+        and all(
+            n in lp and lp[n].dtype == jnp.int8
+            for n in ("wo", "w_gate", "w_up", "w_down")
+        )
+    )
+
+
+def post_attn_mlp(
+    cfg: ModelConfig, lp: Params, x: jax.Array, a: jax.Array
+) -> jax.Array:
+    """Everything after attention: output projection + MLP/MoE residual
+    — ONE copy shared by every attention variant AND the bench's
+    per-phase microbenches (bench.py --phases), so the measured matmul
+    composition can never drift from the served one. ``a`` is the
+    flattened attention output [B, T, H*Dh].
+
+    Under the Pallas matmul impl (int8 weights) the decode hot path
+    runs three fused kernels instead of five ops: wo with the residual
+    add in-epilogue, ONE gate/up pass with SiLU·mul in-kernel (the
+    [.., F] intermediates never hit HBM), and w_down with the second
+    residual add in-epilogue — the rounding points match the reference
+    composition exactly (ops/qmatmul.py)."""
+    if fused_mlp_ok(cfg, lp):
+        from dynamo_tpu.ops.qmatmul import qmm, qmm_gate_up
+
+        interp = _qmm_interpret()
+        x = qmm(a, lp["wo"], lp["wo_scale"], residual=x, interpret=interp)
+        h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
+        hh = qmm_gate_up(
+            h, lp["w_gate"], lp["w_gate_scale"],
+            lp["w_up"], lp["w_up_scale"],
+            act=cfg.hidden_act, interpret=interp,
+        )
+        return qmm(
+            hh, lp["w_down"], lp["w_down_scale"], residual=x,
+            interpret=interp,
+        )
+    x = x + mm(lp, "wo", a).astype(x.dtype)
+    h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
+    if cfg.is_moe:
+        x = x + _moe_mlp(cfg, lp, h).astype(x.dtype)
+    else:
+        mlp_out = mm(
+            lp, "w_down", mlp_act(cfg, mm(lp, "w_gate", h)) * mm(lp, "w_up", h)
+        )
+        x = x + mlp_out.astype(x.dtype)
+    return x
+
+
 def make_layer_parts(
     cfg: ModelConfig,
     positions: jax.Array,  # [B, T]
@@ -591,19 +693,8 @@ def make_layer_parts(
         return kern(*args)  # [B, T, H, Dh]
 
     def _post_attn(lp, x, attn):
-        """Everything after attention: output projection + MLP/MoE
-        residual — ONE copy shared by every attention variant."""
         B, T = x.shape[0], x.shape[1]
-        x = x + mm(lp, "wo", attn.reshape(B, T, H * Dh)).astype(x.dtype)
-        h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
-        if cfg.is_moe:
-            x = x + _moe_mlp(cfg, lp, h).astype(x.dtype)
-        else:
-            mlp_out = mm(
-                lp, "w_down", mlp_act(cfg, mm(lp, "w_gate", h)) * mm(lp, "w_up", h)
-            )
-            x = x + mlp_out.astype(x.dtype)
-        return x
+        return post_attn_mlp(cfg, lp, x, attn.reshape(B, T, H * Dh))
 
     def _expand1(cache_l):
         """Per-layer cache -> 1-layer stack (free expand-dims), for
@@ -835,13 +926,28 @@ def forward(
     x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps, cfg.norm_bias_one)
     if logits_all:
         # every position's logits (speculative verify) — [B, T, V]
-        return mm(params, "lm_head", x).astype(jnp.float32), new_k, new_v
+        return lm_head(params, x), new_k, new_v
     # logits only at each sequence's last real token
     x_last = jnp.take_along_axis(
         x, last_token_idx[:, None, None].astype(jnp.int32), axis=1
     )[:, 0]  # [B, D]
-    logits = mm(params, "lm_head", x_last).astype(jnp.float32)  # [B, V]
-    return logits, new_k, new_v
+    return lm_head(params, x_last), new_k, new_v  # [B, V]
+
+
+def lm_head(p: Params, x: jax.Array) -> jax.Array:
+    """Final-hidden → f32 logits. Int8 tables under the Pallas impl go
+    through the vocab-tiled kernel variant (its own tune key — at
+    V=128256 the LM head is the single largest weight read of a decode
+    step); either path rounds through the activation dtype before the
+    f32 upcast, so the logits grid is identical."""
+    w = p["lm_head"]
+    if w.dtype == jnp.int8 and pallas_matmul_active():
+        from dynamo_tpu.ops.qmatmul import qmm_lm_head
+
+        return qmm_lm_head(
+            x, w, p["lm_head_scale"], interpret=_qmm_interpret()
+        ).astype(jnp.float32)
+    return mm(p, "lm_head", x).astype(jnp.float32)
 
 
 def moe_impl() -> str:

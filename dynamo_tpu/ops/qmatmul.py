@@ -1,0 +1,514 @@
+"""Fused int8-weight × float-activation matmul Pallas kernels.
+
+THE weight-bound decode hot path (ROADMAP item 2). The engine's int8
+serving weights (models/quant.py) used to reach the MXU through a mixed
+int8×bf16 ``jax.lax.dot_general`` — XLA materializes the upcast weight
+tile in a way that never approaches int8-byte-bound (measured only
+~1.3-2× over bf16 on v5e, far from the 2× byte ratio, and worse once
+the scale multiply lands as a separate HBM-visiting op). These kernels
+do what Marlin-style fused dequant GEMMs do on GPU: stream the int8
+weight tiles from HBM, upcast **in register**, accumulate in f32, and
+apply the per-output-channel f32 scale in the epilogue — the upcast
+never exists in HBM, so the weight read is byte-bound at 1 B/elem.
+
+Kernel family (one body, flag-specialized like ops/paged_attention.py):
+
+- ``qmm``            — y = (x @ w_int8) * scale, optional fused
+                        residual add in the epilogue (``wo`` / ``w_down``:
+                        the decode residual never round-trips HBM between
+                        the matmul and the add);
+- ``qmm_gate_up``    — act(x @ Wg * sg) * (x @ Wu * su): both MLP weight
+                        tensors stream through ONE kernel pass and the
+                        SiLU·mul epilogue runs on the f32 accumulators'
+                        tiles in VMEM (the [M, F] gate/up intermediates
+                        never hit HBM);
+- ``qmm_lm_head``    — the vocab-tiled variant: at V=128256 the LM head
+                        is the single largest weight read of a decode
+                        step, so N-tiling + a dedicated tune key matter.
+
+Numerics contract (tests/test_qmatmul.py): int8→bf16 upcast is exact,
+products accumulate in f32, the dequant scale applies in f32, and the
+output rounds to the activation dtype exactly like the reference
+``models.llama.mm`` epilogue — residual adds and the SiLU·mul run in
+the output dtype so both impls round at the same points. Remaining
+differences vs the reference are K-tile accumulation ORDER only.
+
+Grid = (M-tiles, N-tiles, K-tiles), K innermost: the f32 accumulator
+lives in VMEM scratch across K steps and every weight byte is read
+exactly once per M-tile. Tile sizes come from a small autotune table
+keyed on (M-bucket, K, N, kind) with an on-disk JSON cache in the style
+of analysis/cache.py (atomic writes, every failure degrades to the
+heuristic default); ``DYN_QMATMUL_TUNE=1`` measures candidates on real
+hardware at engine prewarm and persists the winners.
+
+Dispatch lives in ``models.llama.matmul_impl`` (DYN_MATMUL_IMPL =
+auto|reference|pallas, mirroring DYN_ATTN_IMPL); off-TPU the kernels
+run interpreted so tier-1 exercises them on CPU. Multi-device meshes
+keep the reference path: the contraction axis of ``wo``/``w_down`` is
+tp-sharded, and a shard_mapped qmatmul would need its own psum story —
+single-chip decode (the headline bench) is where the weight-bound win
+lives.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# M (token-rows) buckets the tune table is keyed on; the wrapper pads
+# every call up to its bucket (padded rows compute zeros and are sliced
+# off), so one compiled kernel serves each bucket like the engine's
+# batch buckets do.
+M_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
+
+def m_bucket(m: int) -> int:
+    for b in M_BUCKETS:
+        if m <= b:
+            return b
+    # beyond the ladder: round UP to a multiple of the largest bucket
+    # (rounding down would make the pad width negative and crash; every
+    # bm candidate <= 512 divides any multiple of 8192)
+    top = M_BUCKETS[-1]
+    return -(-m // top) * top
+
+
+# ---------------------------------------------------------------------------
+# Tile selection: heuristic defaults + on-disk autotune table
+# ---------------------------------------------------------------------------
+
+
+def _largest_divisor(n: int, candidates: tuple[int, ...]) -> int:
+    """Largest candidate dividing n, else n itself (a full dim is always
+    a legal Mosaic block dim regardless of alignment)."""
+    for c in candidates:
+        if c <= n and n % c == 0:
+            return c
+    return n
+
+
+def default_tiles(mb: int, K: int, N: int, kind: str) -> tuple[int, int, int]:
+    """Heuristic (bm, bn, bk). Rationale: bm covers the whole decode
+    batch in one tile (M is tiny next to K/N); bk ~512 keeps the x tile
+    and accumulator small while amortizing the K-loop; bn ~512-1024
+    makes the int8 weight tile the dominant VMEM tenant (that's the
+    stream we must keep wide). All non-full tiles are multiples of 128
+    so both the int8 sublane rule (32) and the lane rule (128) hold."""
+    bm = min(mb, 256)
+    bk = _largest_divisor(K, (512, 256, 128))
+    if kind == "lm_head":
+        # vocab is huge and M tiny: widen N so the weight stream (the
+        # only traffic that matters at [D, 128256]) runs long tiles.
+        # 768 divides 128256 (= 167 * 768); 512 does not.
+        bn = _largest_divisor(N, (1024, 768, 512, 384, 256, 128))
+    else:
+        bn = _largest_divisor(N, (512, 384, 256, 128))
+    if kind == "gate_up":
+        # two weight tiles + two accumulators live at once: halve K
+        # depth to keep the working set near the single-weight variants'
+        bk = _largest_divisor(K, (256, 128))
+    return bm, bn, bk
+
+
+def _valid_tiles(tiles, mb: int, K: int, N: int) -> bool:
+    """A tune-table entry is only trusted if it still describes a legal
+    blocking — corrupt or stale entries degrade to the default."""
+    if not (
+        isinstance(tiles, (list, tuple))
+        and len(tiles) == 3
+        and all(isinstance(t, int) and t > 0 for t in tiles)
+    ):
+        return False
+    bm, bn, bk = tiles
+    if mb % bm or N % bn or K % bk:
+        return False
+    # non-full tiles must satisfy the lane rule
+    if bn != N and bn % 128:
+        return False
+    if bk != K and bk % 128:
+        return False
+    if bm != mb and bm % 8:
+        return False
+    return True
+
+
+def _tune_path() -> Optional[Path]:
+    env = os.environ.get("DYN_QMATMUL_TUNE_DIR")
+    if env:
+        return Path(env) / "tune.json"
+    try:
+        from dynamo_tpu.analysis.config import find_pyproject
+
+        pyproject = find_pyproject(Path(__file__).resolve())
+        if pyproject is not None:
+            return pyproject.parent / ".dynamo_qmatmul" / "tune.json"
+    except Exception:
+        pass
+    return None
+
+
+_table: Optional[dict] = None
+
+
+def _load_table() -> dict:
+    """Entries: {"kind:mb:K:N": [bm, bn, bk]}. Any failure — missing
+    file, bad JSON, wrong schema — degrades to an empty table; the
+    kernel must never be wrong or crash because of the cache."""
+    global _table
+    if _table is None:
+        _table = {}
+        path = _tune_path()
+        if path is not None:
+            try:
+                data = json.loads(path.read_text())
+                if isinstance(data, dict) and data.get("version") == 1:
+                    entries = data.get("entries")
+                    if isinstance(entries, dict):
+                        _table = entries
+            except (OSError, ValueError):
+                _table = {}
+    return _table
+
+
+def _save_table() -> None:
+    path = _tune_path()
+    if path is None or _table is None:
+        return
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=str(path.parent), suffix=".tmp")
+        with os.fdopen(fd, "w") as f:
+            f.write(json.dumps({"version": 1, "entries": _table}))
+        os.replace(tmp, path)
+    except OSError:
+        pass  # a table that can't persist is just an unwarmed table
+
+
+def _reset_table_for_tests() -> None:
+    global _table
+    _table = None
+
+
+def tune_key(m: int, K: int, N: int, kind: str) -> str:
+    return f"{kind}:{m_bucket(m)}:{K}:{N}"
+
+
+def tile_config(m: int, K: int, N: int, kind: str) -> tuple[int, int, int]:
+    """(bm, bn, bk) for this shape: the tuned entry when one exists and
+    still validates, the heuristic default otherwise."""
+    mb = m_bucket(m)
+    entry = _load_table().get(tune_key(m, K, N, kind))
+    if entry is not None and _valid_tiles(entry, mb, K, N):
+        return tuple(entry)
+    return default_tiles(mb, K, N, kind)
+
+
+def record_tiles(
+    m: int, K: int, N: int, kind: str, tiles: tuple[int, int, int]
+) -> None:
+    table = _load_table()
+    table[tune_key(m, K, N, kind)] = list(tiles)
+    _save_table()
+
+
+def _candidate_tiles(mb: int, K: int, N: int, kind: str):
+    """Small candidate grid around the default (autotune is a table fill,
+    not a search problem — a handful of compiles per shape)."""
+    seen = set()
+    bms = {min(mb, 128), min(mb, 256), min(mb, 512)}
+    bns = {
+        _largest_divisor(N, (c,)) for c in (256, 384, 512, 768, 1024)
+    } | {default_tiles(mb, K, N, kind)[1]}
+    bks = {_largest_divisor(K, (c,)) for c in (128, 256, 512, 1024)}
+    for bm in sorted(bms):
+        for bn in sorted(bns):
+            for bk in sorted(bks):
+                t = (bm, bn, bk)
+                if t not in seen and _valid_tiles(list(t), mb, K, N):
+                    seen.add(t)
+                    yield t
+
+
+def autotune(
+    m: int, K: int, N: int, kind: str, dtype=jnp.bfloat16, repeats: int = 3
+) -> tuple[int, int, int]:
+    """Measure candidate tilings on the real device and persist the
+    winner. TPU only — interpret-mode timings would tune for the
+    emulator; off-TPU this returns the default untouched."""
+    import time
+
+    if jax.default_backend() != "tpu":
+        return tile_config(m, K, N, kind)
+    mb = m_bucket(m)
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (mb, K), jnp.float32).astype(dtype)
+    w = jax.random.randint(key, (K, N), -127, 128, jnp.int8)
+    s = jnp.full((N,), 0.01, jnp.float32)
+    best, best_t = None, float("inf")
+    res = jnp.zeros((mb, N), dtype)
+    for tiles in _candidate_tiles(mb, K, N, kind):
+        try:
+            # measure the EXACT kernel variant the serving path
+            # dispatches for this kind — the residual epilogue streams
+            # an extra [bm, bn] input per tile, a different traffic
+            # profile than the plain kernel
+            if kind == "gate_up":
+                fn = jax.jit(lambda a: qmm_gate_up(a, w, s, w, s, tiles=tiles))
+            elif kind == "residual":
+                fn = jax.jit(
+                    lambda a: qmm(a, w, s, residual=res, tiles=tiles)
+                )
+            elif kind == "lm_head":
+                fn = jax.jit(lambda a: qmm_lm_head(a, w, s, tiles=tiles))
+            else:
+                fn = jax.jit(lambda a: qmm(a, w, s, tiles=tiles))
+            jax.block_until_ready(fn(x))  # compile
+            t0 = time.monotonic()
+            for _ in range(repeats):
+                out = fn(x)
+            jax.block_until_ready(out)
+            dt = (time.monotonic() - t0) / repeats
+        except Exception:
+            continue  # a candidate Mosaic rejects is just not a candidate
+        if dt < best_t:
+            best, best_t = tiles, dt
+    if best is not None:
+        record_tiles(m, K, N, kind, best)
+        return best
+    return tile_config(m, K, N, kind)
+
+
+# ---------------------------------------------------------------------------
+# The kernel body (flag-specialized: residual / gate-up epilogues)
+# ---------------------------------------------------------------------------
+
+
+def _act(name: str, g: jax.Array) -> jax.Array:
+    """Gate activation, mirroring models.llama.mlp_act (same failure
+    contract: silently substituting silu would serve corrupt logits)."""
+    if name == "gelu":
+        return jax.nn.gelu(g, approximate=True)
+    if name == "silu":
+        return jax.nn.silu(g)
+    raise ValueError(f"unsupported activation {name!r}")
+
+
+def _qmm_kernel(
+    *refs,
+    n_k: int,
+    fused: str,  # "" | "residual" | "gate_up"
+    act: str,
+):
+    """One (bm, bn) output tile accumulated over the K grid axis.
+
+    refs layout by variant:
+      plain:    x, w, s, o, acc
+      residual: x, w, s, r, o, acc
+      gate_up:  x, wg, sg, wu, su, o, accg, accu
+
+    The int8 weight tile upcasts to the activation dtype IN REGISTER
+    (exact: |w| <= 127 is representable in bf16) and feeds the MXU as a
+    native bf16×bf16 dot with f32 accumulation — the dequant scale
+    multiplies the f32 accumulator once, in the epilogue."""
+    if fused == "gate_up":
+        x_ref, wg_ref, sg_ref, wu_ref, su_ref, o_ref, accg_ref, accu_ref = refs
+    elif fused == "residual":
+        x_ref, w_ref, s_ref, r_ref, o_ref, acc_ref = refs
+    else:
+        x_ref, w_ref, s_ref, o_ref, acc_ref = refs
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        if fused == "gate_up":
+            accg_ref[:] = jnp.zeros_like(accg_ref)
+            accu_ref[:] = jnp.zeros_like(accu_ref)
+        else:
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[:]
+    dims = (((1,), (0,)), ((), ()))
+    if fused == "gate_up":
+        accg_ref[:] += jax.lax.dot_general(
+            x, wg_ref[:].astype(x.dtype), dims,
+            preferred_element_type=jnp.float32,
+        )
+        accu_ref[:] += jax.lax.dot_general(
+            x, wu_ref[:].astype(x.dtype), dims,
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        acc_ref[:] += jax.lax.dot_general(
+            x, w_ref[:].astype(x.dtype), dims,
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(k == n_k - 1)
+    def _epilogue():
+        if fused == "gate_up":
+            # round each dequantized matmul to the output dtype BEFORE
+            # the activation — the same rounding points as the reference
+            # mlp_act(mm(gate)) * mm(up) composition
+            g = (accg_ref[:] * sg_ref[:]).astype(o_ref.dtype)
+            u = (accu_ref[:] * su_ref[:]).astype(o_ref.dtype)
+            o_ref[:] = _act(act, g) * u
+        elif fused == "residual":
+            # residual add in the output dtype (reference: x + mm(...)
+            # .astype(x.dtype) — the cast happens before the add)
+            o_ref[:] = r_ref[:] + (acc_ref[:] * s_ref[:]).astype(o_ref.dtype)
+        else:
+            o_ref[:] = (acc_ref[:] * s_ref[:]).astype(o_ref.dtype)
+
+
+def _qmm_call(
+    x2: jax.Array,  # [M, K] float activations (bf16/f32)
+    weights: list[jax.Array],  # one [K, N] int8, or two for gate_up
+    scales: list[jax.Array],  # matching [N] f32 per-channel scales
+    residual2: Optional[jax.Array],  # [M, N] or None
+    kind: str,
+    fused: str,
+    act: str,
+    interpret: bool,
+    tiles: Optional[tuple[int, int, int]],
+) -> jax.Array:
+    M, K = x2.shape
+    N = weights[0].shape[1]
+    for w in weights:
+        assert w.dtype == jnp.int8 and w.shape == (K, N)
+    bm, bn, bk = tiles if tiles is not None else tile_config(M, K, N, kind)
+    mp = m_bucket(M)
+    bm = min(bm, mp)
+    # explicit `tiles` bypasses _valid_tiles — a non-dividing blocking
+    # would silently leave output columns unwritten (grid floor-division
+    # drops the remainder), so fail loudly instead
+    if mp % bm or N % bn or K % bk:
+        raise ValueError(
+            f"tiles (bm={bm}, bn={bn}, bk={bk}) must divide the padded "
+            f"problem (M={mp}, N={N}, K={K})"
+        )
+    if M != mp:
+        x2 = jnp.pad(x2, ((0, mp - M), (0, 0)))
+        if residual2 is not None:
+            residual2 = jnp.pad(residual2, ((0, mp - M), (0, 0)))
+    grid = (mp // bm, N // bn, K // bk)
+
+    in_specs = [pl.BlockSpec((bm, bk), lambda i, j, k: (i, k))]
+    inputs: list[jax.Array] = [x2]
+    for w, s in zip(weights, scales):
+        in_specs.append(pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)))
+        in_specs.append(pl.BlockSpec((1, bn), lambda i, j, k: (0, j)))
+        inputs.append(w)
+        inputs.append(s.reshape(1, N).astype(jnp.float32))
+    if residual2 is not None:
+        in_specs.append(pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)))
+        inputs.append(residual2)
+
+    scratch = [pltpu.VMEM((bm, bn), jnp.float32)]
+    if fused == "gate_up":
+        scratch.append(pltpu.VMEM((bm, bn), jnp.float32))
+
+    out = pl.pallas_call(
+        functools.partial(
+            _qmm_kernel, n_k=grid[2], fused=fused, act=act
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, N), x2.dtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*inputs)
+    return out[:M] if M != mp else out
+
+
+def _flatten(x: jax.Array) -> tuple[jax.Array, tuple[int, ...]]:
+    return x.reshape(-1, x.shape[-1]), x.shape[:-1]
+
+
+def qmm(
+    x: jax.Array,  # [..., K] float activations
+    w: jax.Array,  # [K, N] int8
+    scale: jax.Array,  # [N] f32 per-output-channel dequant scale
+    residual: Optional[jax.Array] = None,  # [..., N] fused epilogue add
+    kind: str = "mm",
+    interpret: bool = False,
+    tiles: Optional[tuple[int, int, int]] = None,
+) -> jax.Array:
+    """y = (x @ w) * scale (+ residual), rounded to x.dtype — the
+    in-kernel-dequant replacement for the reference ``mm`` epilogue."""
+    x2, lead = _flatten(x)
+    r2 = None
+    if residual is not None:
+        r2, _ = _flatten(residual)
+        kind = "residual" if kind == "mm" else kind
+    y = _qmm_call(
+        x2, [w], [scale], r2, kind,
+        "residual" if residual is not None else "", "silu", interpret, tiles,
+    )
+    return y.reshape(*lead, w.shape[1])
+
+
+def qmm_gate_up(
+    x: jax.Array,  # [..., D]
+    w_gate: jax.Array,  # [D, F] int8
+    gate_scale: jax.Array,  # [F] f32
+    w_up: jax.Array,  # [D, F] int8
+    up_scale: jax.Array,  # [F] f32
+    act: str = "silu",
+    interpret: bool = False,
+    tiles: Optional[tuple[int, int, int]] = None,
+) -> jax.Array:
+    """act(x @ Wg * sg) * (x @ Wu * su) — both MLP weights stream in one
+    kernel pass; the [..., F] gate/up intermediates never touch HBM."""
+    x2, lead = _flatten(x)
+    y = _qmm_call(
+        x2, [w_gate, w_up], [gate_scale, up_scale], None, "gate_up",
+        "gate_up", act, interpret, tiles,
+    )
+    return y.reshape(*lead, w_gate.shape[1])
+
+
+def qmm_lm_head(
+    x: jax.Array,  # [..., D] final hidden states
+    w: jax.Array,  # [D, V] int8
+    scale: jax.Array,  # [V] f32
+    interpret: bool = False,
+    tiles: Optional[tuple[int, int, int]] = None,
+) -> jax.Array:
+    """The vocab-tiled LM-head qmm (its own tune key: at V=128256 this
+    is the single largest weight read per decode step). Output rounds
+    to x.dtype exactly like ``mm`` — the caller upcasts to f32 for
+    sampling, same as the reference path."""
+    x2, lead = _flatten(x)
+    y = _qmm_call(
+        x2, [w], [scale], None, "lm_head", "", "silu", interpret, tiles
+    )
+    return y.reshape(*lead, w.shape[1])
+
+
+def ensure_tuned(
+    shapes: list[tuple[int, int, int, str]], tune: Optional[bool] = None
+) -> None:
+    """Engine-prewarm hook: make sure every reachable (M, K, N, kind)
+    has a tile config ready before the step functions trace. With
+    DYN_QMATMUL_TUNE=1 on TPU this measures and persists winners (a few
+    compiles per missing shape — one-time, cached on disk); otherwise
+    the heuristic defaults serve, and any previously-tuned entries load
+    from the cache."""
+    if tune is None:
+        tune = os.environ.get("DYN_QMATMUL_TUNE") == "1"
+    table = _load_table()
+    for m, K, N, kind in shapes:
+        if tune and tune_key(m, K, N, kind) not in table:
+            autotune(m, K, N, kind)
+        else:
+            tile_config(m, K, N, kind)  # validates/loads the entry

@@ -95,16 +95,85 @@ def test_kv_to_packed_blocks_layout():
     np.testing.assert_array_equal(packed[0, 1, 0], v[0, :bs])
 
 
-@pytest.mark.skip(
-    reason="newly reachable after the shard_map compat shim (the whole file was a\n    collection error before): the sp-prefilled KV cache drifts from the decode\n    engine's own prefill by the last token — multi-chip tier repair, ROADMAP\n    open item 1",
-)
+def test_sp_prefill_kv_matches_engine_prefill():
+    """The contract behind the import path, asserted at the KV seam
+    itself: the sp prefiller's per-position K/V must agree with what
+    the decode engine's own paged prefill writes for the same prompt.
+
+    Diagnosis of the old "last-token drift" skip (2026-08-03): there is
+    NO indexing off-by-one. Layer-0 K/V — which see embedding, norm,
+    qkv matmul and RoPE but no attention — are BIT-EXACT between the
+    two paths (asserted below: an off-by-one in positions, slots, or
+    rope angles would break this loudly). The drift enters at the first
+    ATTENTION output: ring attention's per-shard online softmax and the
+    engine's single-pass reference attention accumulate in different
+    orders, so their bf16 outputs differ by ~1-2 ulp, and every
+    layer>=1 position inherits that noise (measured max ~0.03 at
+    |x|~2). Greedy decode over imported KV can therefore flip a token
+    whose top-2 logit gap is inside the noise — which is what the old
+    skip saw at its final decoded token."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.llama import forward, init_cache
+
+    params = init_params(CFG, seed=0)
+    mesh = build_mesh(MeshConfig(sp=4), jax.devices()[:4])
+    bs = 4
+    prefiller = LongContextPrefiller(
+        CFG, params, mesh, block_size=bs, kv_dtype="float32"
+    )
+    prompt = list(np.random.default_rng(1).integers(1, 100, 19))
+    last, k_sp, v_sp = prefiller.prefill(prompt)
+
+    # the engine's own prefill of the same prompt: one paged forward
+    T = len(prompt)
+    k_cache, v_cache = init_cache(CFG, 16, bs, dtype=jnp.float32)
+    table = np.arange(1, 7, dtype=np.int32)[None]
+    slots = (
+        table[0][np.arange(T) // bs] * bs + np.arange(T) % bs
+    ).astype(np.int32)
+    fwd = jax.jit(functools.partial(forward, CFG, block_size=bs))
+    logits, k_c, v_c = fwd(
+        params, k_cache, v_cache,
+        jnp.asarray([prompt], jnp.int32),
+        jnp.arange(T, dtype=jnp.int32)[None],
+        jnp.asarray(slots), jnp.asarray(table),
+        jnp.asarray([T], jnp.int32), jnp.asarray([T - 1], jnp.int32),
+    )
+    k_eng = np.asarray(k_c)[:, slots]  # [L, T, Hk, Dh]
+    v_eng = np.asarray(v_c)[:, slots]
+
+    # layer 0 = the off-by-one detector: no attention upstream, so any
+    # position/slot/rope indexing bug shows as O(1) error here
+    np.testing.assert_array_equal(k_sp[0], k_eng[0])
+    np.testing.assert_array_equal(v_sp[0], v_eng[0])
+    # layers >= 1 carry the cross-algorithm attention rounding — every
+    # position must stay within bf16-ulp-scale tolerance (an indexing
+    # bug would be O(1), orders of magnitude past this bound)
+    np.testing.assert_allclose(k_sp, k_eng, rtol=5e-2, atol=5e-2)
+    np.testing.assert_allclose(v_sp, v_eng, rtol=5e-2, atol=5e-2)
+    np.testing.assert_allclose(
+        last, np.asarray(logits)[0], rtol=5e-2, atol=5e-2
+    )
+
+
 async def test_sp_prefiller_feeds_decode_engine():
-    """Flagship: KV computed by the sp=4 ring prefiller is imported by a
-    decode engine, which then decodes identically to a purely-local
-    run (the disagg two-worker simulation, sequence-parallel edition)."""
+    """Flagship: KV computed by the sp=4 ring prefiller is imported by
+    a decode engine, which then decodes the same continuation as a
+    purely-local run — up to greedy near-ties. Exact token equality is
+    NOT the contract: the imported KV differs from the engine's own
+    prefill by ~1-2 bf16 ulp of attention-algorithm rounding (see
+    test_sp_prefill_kv_matches_engine_prefill for the diagnosis), so at
+    any position where the two runs disagree, the chosen tokens must be
+    a near-tie — their greedy logprobs within the noise band. A real
+    KV bug (wrong block, wrong position) would make the divergent
+    logprobs differ by O(1) and fail loudly."""
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.engine import JaxEngine
     from dynamo_tpu.protocols.common import (
+        OutputOptions,
         PreprocessedRequest,
         SamplingOptions,
         StopConditions,
@@ -128,7 +197,7 @@ async def test_sp_prefiller_feeds_decode_engine():
     )
     np.testing.assert_allclose(last, ref_last[0], rtol=5e-2, atol=5e-2)
 
-    async def decode(with_import: bool) -> list[int]:
+    async def decode(with_import: bool) -> tuple[list[int], list[float]]:
         engine = await JaxEngine.launch(
             EngineConfig(
                 model_path="", model_name="d", random_weights=True,
@@ -146,11 +215,32 @@ async def test_sp_prefiller_feeds_decode_engine():
             request_id="sp1", token_ids=list(prompt),
             sampling=SamplingOptions(use_greedy=True),
             stop=StopConditions(max_tokens=6, ignore_eos=True),
+            output=OutputOptions(logprobs=0),
         )
         toks: list[int] = []
+        lps: list[float] = []
         async for item in engine.as_async_engine().generate(req, Context()):
             toks.extend(item.token_ids)
+            if item.log_probs:
+                lps.extend(item.log_probs)
         await engine.shutdown()
-        return toks
+        return toks, lps
 
-    assert await decode(True) == await decode(False)
+    toks_imp, lps_imp = await decode(True)
+    toks_loc, lps_loc = await decode(False)
+    assert len(toks_imp) == len(toks_loc) == 6
+    assert len(lps_imp) == len(lps_loc) == 6
+    for i, (a, b) in enumerate(zip(toks_imp, toks_loc)):
+        if a == b:
+            continue
+        # divergence is only legitimate as a greedy near-tie: both
+        # runs' chosen-token logprobs must sit within the KV-rounding
+        # noise band of each other
+        assert abs(lps_imp[i] - lps_loc[i]) < 0.1, (
+            f"token {i} diverged ({a} vs {b}) with logprob gap "
+            f"{abs(lps_imp[i] - lps_loc[i]):.4f} — a real KV bug, not "
+            f"attention-rounding noise"
+        )
+        # after a flip the runs walk different paths; nothing further
+        # is comparable position-by-position
+        break

@@ -1,0 +1,331 @@
+"""Fused int8 dequant-matmul kernels (ops/qmatmul.py): numerics vs the
+reference ``mm()`` path, every fused epilogue variant, the engine-level
+greedy bit-identity contract (DYN_MATMUL_IMPL=reference vs =pallas in
+interpret mode — ISSUE 9 acceptance), and the autotune table's
+roundtrip / corruption-degrades-to-default behavior.
+
+All kernel calls run ``interpret=True`` (tier-1 is CPU); the engine
+tests register a size-1 mesh through JaxEngine.launch so
+``pallas_matmul_active()`` holds exactly as it does on a single chip.
+"""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.ops import qmatmul
+from dynamo_tpu.ops.qmatmul import (
+    default_tiles,
+    m_bucket,
+    qmm,
+    qmm_gate_up,
+    qmm_lm_head,
+    record_tiles,
+    tile_config,
+)
+
+RNG = np.random.default_rng(7)
+
+
+def _mk(m, k, n, dtype=jnp.bfloat16, lead=()):
+    x = jnp.asarray(RNG.standard_normal((*lead, m, k)), dtype)
+    w = jnp.asarray(RNG.integers(-127, 128, (k, n)), jnp.int8)
+    s = jnp.asarray(RNG.uniform(0.001, 0.02, n), jnp.float32)
+    return x, w, s
+
+
+def _ref_mm(x, w, s):
+    """The reference mm() epilogue: mixed dot, f32 accumulate, scale in
+    f32, round to the activation dtype."""
+    y = jax.lax.dot_general(
+        x, w, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return (y * s).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Kernel numerics
+# ---------------------------------------------------------------------------
+
+
+def test_qmm_f32_epilogue_exact_single_k_tile():
+    """With one K tile there is no accumulation-order freedom: the int8
+    upcast, the f32 products, and the f32 scale multiply must be EXACT
+    against the reference dot (int8 -> float is lossless, products of
+    floats are exact in f32 preferred-type accumulation)."""
+    x, w, s = _mk(5, 64, 256, dtype=jnp.float32)
+    y = qmm(x, w, s, interpret=True)  # K=64 -> bk=K (single tile)
+    ref = _ref_mm(x, w, s)
+    assert y.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(ref))
+
+
+def test_qmm_bf16_within_tolerance_tiled_k():
+    """Forced multi-tile K: only accumulation ORDER differs from the
+    reference, so the bf16 outputs may differ by at most ~1 ulp."""
+    x, w, s = _mk(33, 512, 384)
+    y = qmm(x, w, s, interpret=True, tiles=(64, 128, 128))
+    ref = _ref_mm(x, w, s)
+    a, b = np.asarray(y, np.float32), np.asarray(ref, np.float32)
+    # 1 bf16 ulp at the observed magnitudes (~|x| <= 8 here)
+    np.testing.assert_allclose(a, b, rtol=2e-2, atol=6e-2)
+    assert y.shape == (33, 384)  # padded rows sliced back off
+
+
+def test_qmm_leading_batch_dims():
+    x, w, s = _mk(6, 64, 128, lead=(3,))
+    y = qmm(x, w, s, interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(y, np.float32), np.asarray(_ref_mm(x, w, s), np.float32)
+    )
+
+
+def test_qmm_residual_epilogue_matches_reference_rounding():
+    """residual + (acc*scale).astype(dtype): the add happens in the
+    OUTPUT dtype, exactly like the reference ``x + mm(...).astype``
+    composition — single K tile makes it bit-exact."""
+    x, w, s = _mk(8, 128, 256)
+    r = jnp.asarray(RNG.standard_normal((8, 256)), jnp.bfloat16)
+    y = qmm(x, w, s, residual=r, interpret=True)
+    ref = r + _ref_mm(x, w, s)
+    np.testing.assert_array_equal(
+        np.asarray(y, np.float32), np.asarray(ref, np.float32)
+    )
+
+
+@pytest.mark.parametrize("act", ["silu", "gelu"])
+def test_qmm_gate_up_fused(act):
+    """act(x@Wg*sg) * (x@Wu*su) with both matmul outputs rounded to the
+    activation dtype BEFORE the activation — the reference
+    ``mlp_act(mm(gate)) * mm(up)`` rounding points."""
+    x, wg, sg = _mk(8, 128, 256)
+    _, wu, su = _mk(8, 128, 256)
+    y = qmm_gate_up(x, wg, sg, wu, su, act=act, interpret=True)
+    g, u = _ref_mm(x, wg, sg), _ref_mm(x, wu, su)
+    ref = (
+        jax.nn.gelu(g, approximate=True) if act == "gelu" else jax.nn.silu(g)
+    ) * u
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_qmm_gate_up_rejects_unknown_act():
+    x, wg, sg = _mk(8, 128, 128)
+    with pytest.raises(ValueError, match="unsupported activation"):
+        qmm_gate_up(x, wg, sg, wg, sg, act="relu6", interpret=True)
+
+
+def test_qmm_lm_head_vocab_tiled():
+    """The vocab-tiled variant over a non-power-of-two N that only a
+    subset of tile widths divide (128256 = 167 * 768 — the real
+    flagship vocab's divisibility structure, scaled down)."""
+    V = 768 * 3
+    x, w, s = _mk(4, 64, V)
+    y = qmm_lm_head(x, w, s, interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(y, np.float32), np.asarray(_ref_mm(x, w, s), np.float32)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Tile selection + autotune table
+# ---------------------------------------------------------------------------
+
+
+def test_m_bucket_monotonic():
+    assert m_bucket(1) == 8
+    assert m_bucket(8) == 8
+    assert m_bucket(9) == 16
+    assert m_bucket(64) == 64
+    # beyond the ladder the bucket rounds UP (rounding down would make
+    # the pad width negative and crash the wrapper)
+    top = qmatmul.M_BUCKETS[-1]
+    assert m_bucket(top + 1) == 2 * top
+    assert m_bucket(3 * top) == 3 * top
+
+
+def test_qmm_m_above_largest_bucket():
+    """M past the bucket ladder (e.g. a wide prefill rectangle) must
+    compute, not crash on a negative pad."""
+    top = qmatmul.M_BUCKETS[-1]
+    x, w, s = _mk(top + 3, 64, 128, dtype=jnp.float32)
+    y = qmm(x, w, s, interpret=True)
+    assert y.shape == (top + 3, 128)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(_ref_mm(x, w, s)))
+
+
+def test_qmm_rejects_non_dividing_explicit_tiles():
+    """The explicit `tiles` kwarg bypasses table validation; a blocking
+    that doesn't divide the problem must fail loudly (a silent floor-
+    divided grid would leave output columns unwritten)."""
+    x, w, s = _mk(8, 256, 256)
+    with pytest.raises(ValueError, match="must divide"):
+        qmm(x, w, s, interpret=True, tiles=(8, 200, 256))
+
+
+@pytest.mark.parametrize(
+    "mb,K,N,kind",
+    [
+        (64, 4096, 4096, "mm"),
+        (64, 4096, 1024, "mm"),
+        (64, 4096, 14336, "gate_up"),
+        (64, 14336, 4096, "residual"),
+        (64, 4096, 128256, "lm_head"),
+        (8, 64, 96, "mm"),  # tiny/odd: full-dim fallbacks
+    ],
+)
+def test_default_tiles_always_legal(mb, K, N, kind):
+    bm, bn, bk = default_tiles(mb, K, N, kind)
+    assert mb % bm == 0 and N % bn == 0 and K % bk == 0
+    assert bn == N or bn % 128 == 0
+    assert bk == K or bk % 128 == 0
+
+
+def test_lm_head_tiles_divide_flagship_vocab():
+    # 128256 is not divisible by 512; the lm_head candidate ladder must
+    # land on a divisor (768), not crash or fall back to full-V tiles
+    _, bn, _ = default_tiles(64, 4096, 128256, "lm_head")
+    assert 128256 % bn == 0 and bn >= 256
+
+
+@pytest.fixture
+def tune_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("DYN_QMATMUL_TUNE_DIR", str(tmp_path))
+    qmatmul._reset_table_for_tests()
+    yield tmp_path
+    qmatmul._reset_table_for_tests()
+
+
+def test_tune_table_roundtrip(tune_dir):
+    record_tiles(48, 512, 768, "mm", (64, 256, 128))
+    # fresh process simulation: drop the in-memory table, reload disk
+    qmatmul._reset_table_for_tests()
+    assert tile_config(48, 512, 768, "mm") == (64, 256, 128)
+    # a different key still gets the heuristic default
+    assert tile_config(48, 512, 384, "mm") == default_tiles(64, 512, 384, "mm")
+    data = json.loads((tune_dir / "tune.json").read_text())
+    assert data["version"] == 1 and "mm:64:512:768" in data["entries"]
+
+
+def test_tune_table_corruption_degrades_to_default(tune_dir):
+    (tune_dir / "tune.json").write_text("{not json")
+    qmatmul._reset_table_for_tests()
+    assert tile_config(64, 512, 768, "mm") == default_tiles(64, 512, 768, "mm")
+    # structurally-valid JSON with a poisoned entry: the entry must be
+    # rejected by validation, not fed to the kernel
+    (tune_dir / "tune.json").write_text(json.dumps({
+        "version": 1,
+        "entries": {
+            "mm:64:512:768": [7, 100, 3],      # divides nothing
+            "mm:64:512:384": "garbage",          # wrong type
+            "mm:64:512:256": [64, 128],          # wrong arity
+        },
+    }))
+    qmatmul._reset_table_for_tests()
+    assert tile_config(64, 512, 768, "mm") == default_tiles(64, 512, 768, "mm")
+    assert tile_config(64, 512, 384, "mm") == default_tiles(64, 512, 384, "mm")
+    assert tile_config(64, 512, 256, "mm") == default_tiles(64, 512, 256, "mm")
+
+
+def test_ensure_tuned_off_tpu_is_read_only(tune_dir):
+    """ensure_tuned without DYN_QMATMUL_TUNE resolves configs but never
+    writes (no autotune off-TPU; the cache stays whatever it was)."""
+    qmatmul.ensure_tuned([(64, 512, 768, "mm"), (64, 512, 384, "gate_up")])
+    assert not (tune_dir / "tune.json").exists()
+
+
+def test_tuned_entry_used_by_kernel(tune_dir):
+    """A (valid) tuned entry actually drives the kernel blocking and
+    produces the same numbers as the default blocking."""
+    record_tiles(8, 256, 256, "mm", (8, 128, 128))
+    qmatmul._reset_table_for_tests()
+    x, w, s = _mk(8, 256, 256)
+    y = qmm(x, w, s, interpret=True)
+    ref = _ref_mm(x, w, s)
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=6e-2,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Model-level dispatch + engine greedy bit-identity (the acceptance gate)
+# ---------------------------------------------------------------------------
+
+
+def test_matmul_impl_dispatch(monkeypatch):
+    from dynamo_tpu.models import llama
+
+    monkeypatch.setenv("DYN_MATMUL_IMPL", "reference")
+    assert llama.matmul_impl() == "reference"
+    assert not llama.pallas_matmul_active()
+    monkeypatch.setenv("DYN_MATMUL_IMPL", "pallas")
+    assert llama.matmul_impl() == "pallas"
+    monkeypatch.delenv("DYN_MATMUL_IMPL")
+    # auto off-TPU = reference (kernels only via explicit opt-in here)
+    assert llama.matmul_impl() == "reference"
+
+
+async def _engine_tokens(model_cfg, decode_steps: int) -> list[int]:
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    engine = await JaxEngine.launch(
+        EngineConfig(
+            model_path="", model_name="qmm", random_weights=True,
+            quantization="int8", num_blocks=64, block_size=8,
+            max_batch_size=4, decode_steps=decode_steps,
+            kv_cache_dtype="int8",
+        ),
+        model_config=model_cfg,
+    )
+    try:
+        req = PreprocessedRequest(
+            request_id="q", token_ids=list(range(1, 20)),
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=10, ignore_eos=True),
+        )
+        toks: list[int] = []
+        async for out in engine.as_async_engine().generate(req, Context()):
+            toks.extend(out.token_ids)
+        return toks
+    finally:
+        await engine.shutdown()
+
+
+@pytest.mark.parametrize("decode_steps", [1, 2])
+def test_engine_greedy_bit_identical_reference_vs_pallas(
+    decode_steps, monkeypatch
+):
+    """ISSUE 9 acceptance: the engine's greedy output is bit-identical
+    between DYN_MATMUL_IMPL=reference and =pallas (interpret mode on
+    CPU), over the int8 KV cache, on both the single-step (overlapped
+    pipeline) and fused-window decode paths."""
+    from dynamo_tpu.models.config import ModelConfig
+
+    mc = ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256,
+    )
+    monkeypatch.setenv("DYN_MATMUL_IMPL", "reference")
+    ref = asyncio.run(_engine_tokens(mc, decode_steps))
+    monkeypatch.setenv("DYN_MATMUL_IMPL", "pallas")
+    pal = asyncio.run(_engine_tokens(mc, decode_steps))
+    assert ref == pal
+    assert len(ref) == 10
