@@ -47,6 +47,15 @@ phase microbenches the real step computation at the headline geometry
 and reports its ideal HBM bytes and the bandwidth its measured time
 implies — the roofline gap decomposed instead of guessed at.
 
+``--sentinel`` is the bench regression gate: the headline workload runs
+once and its tok/s + per-bucket attribution compare against the
+committed ``BENCH_BASELINE.json`` (explicit noise bands; override with
+``--baseline PATH`` / ``DYN_BENCH_BASELINE``). Exit 1 on regression,
+with the attribution delta naming the bucket that ate the loss; exit 2
+when the profile has no baseline (seed with ``--update-baseline``).
+``--quick`` shrinks the workload for the CI CPU-interpret smoke tier;
+``DYN_SENTINEL_REPORT=path`` writes the report JSON as an artifact.
+
 ``--overlap`` is the serial-vs-overlap A/B (docs/performance.md): the
 same workload at decode_steps=1 runs once with --no-overlap (fully
 serial plan -> dispatch -> sync -> emit) and once with the overlapped
@@ -67,13 +76,16 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# v5e datasheet HBM bandwidth. Kept as the roofline denominator for
-# cross-round comparability. Practical context (BASELINE.md round-2
-# revision): an amortized weight-streaming probe on this environment's
-# tunneled chip reaches ~400 GB/s, so the practically-achievable
-# roofline is ~half the datasheet number — vs_baseline ≈ 0.5 would be
-# full practical-bandwidth utilization here.
-HBM_BW_BYTES = 819e9
+# The roofline/byte-budget math lives in telemetry/roofline.py now —
+# ONE formula shared with the engine's live attribution ledger
+# (dynamo_roofline_frac), so the bench artifact and the serving gauges
+# can never disagree about the denominator.
+from dynamo_tpu.telemetry.roofline import (  # noqa: E402
+    HBM_BW_BYTES,
+    kv_bytes_per_token as _roofline_kv_bytes_per_token,
+    param_bytes as _roofline_param_bytes,
+    phase_ideal_bytes as _roofline_phase_ideal_bytes,
+)
 
 
 def _build_config(cpu_mode: bool):
@@ -131,11 +143,7 @@ def _build_config(cpu_mode: bool):
 
 
 def _param_bytes(mc, quant: str) -> int:
-    D, F, V, L = mc.hidden_size, mc.intermediate_size, mc.vocab_size, mc.num_hidden_layers
-    H, Hk, Dh = mc.num_attention_heads, mc.num_key_value_heads, mc.head_dim
-    per_layer = D * H * Dh + 2 * D * Hk * Dh + H * Dh * D + 3 * D * F
-    bytes_per = 1 if quant == "int8" else 2
-    return bytes_per * (per_layer * L + 2 * V * D)
+    return _roofline_param_bytes(mc, quant)
 
 
 def _bench_kv_dtype() -> str:
@@ -146,14 +154,7 @@ def _bench_kv_dtype() -> str:
 
 
 def _kv_bytes_per_token(mc, kv_dtype: str = None) -> float:
-    dt = kv_dtype or _bench_kv_dtype()
-    if dt in ("fp8", "float8", "float8_e4m3fn", "float8_e5m2"):
-        per_elem = 1.0
-    elif dt == "int8":
-        per_elem = 1.0 + 4.0 / mc.head_dim  # + per-(slot, head) f32 scale
-    else:
-        per_elem = 2.0
-    return 2 * mc.num_hidden_layers * mc.num_key_value_heads * mc.head_dim * per_elem
+    return _roofline_kv_bytes_per_token(mc, kv_dtype or _bench_kv_dtype())
 
 
 async def _run(
@@ -326,6 +327,11 @@ async def _run(
     spec_proposed = engine.spec_proposed_total
     spec_accepted = engine.spec_accepted_total
     slo_stats = engine.slo.stats()
+    # live perf attribution (telemetry/attribution.py): the ledger's
+    # rolling window over the run — loss-bucket fractions plus the
+    # live roofline_frac computed from the SAME formula as the
+    # "roofline" denominator below (telemetry/roofline.py)
+    attribution = engine.attribution.window_summary()
     # resolve the matmul impl WHILE the engine's mesh is registered:
     # shutdown clears it, after which auto would misreport "reference"
     # on multi-device hosts for a run that used the Pallas kernels
@@ -333,6 +339,7 @@ async def _run(
     await engine.shutdown()
     return {
         "slo": slo_stats,
+        "attribution": attribution,
         "overlap": overlap_stats,
         "kv_dtype": kv_dtype,
         "matmul_impl": matmul_impl,
@@ -685,28 +692,29 @@ def _phase_breakdown(model_cfg, wl, kv_dtype: str) -> dict:
     finally:
         llama.set_attention_mesh(prev_mesh)
 
-    wbytes = 1 if quant else 2
-    mlp_weight_bytes = (
-        D * H * Dh + 2 * D * Hk * Dh + H * Dh * D + 3 * D * F
-    ) * wbytes
+    # per-phase byte budget from the SHARED roofline model
+    # (telemetry/roofline.py) — the same prior the serving-side
+    # attribution ledger splits device time with, so --phases and
+    # /debug/attribution decompose against identical denominators
+    ideal = _roofline_phase_ideal_bytes(
+        mc, B, avg_ctx, "int8" if quant else None, kv_dtype
+    )
     phases = {
         "attention": {
             "device_ms": round(t_attn1 * L * 1e3, 3),
-            "ideal_bytes": int(
-                B * avg_ctx * _kv_bytes_per_token(mc, kv_dtype)
-            ),
+            "ideal_bytes": ideal["attention"],
         },
         "mlp": {
             "device_ms": round(t_mlp1 * L * 1e3, 3),
-            "ideal_bytes": int(mlp_weight_bytes * L),
+            "ideal_bytes": ideal["mlp"],
         },
         "lm_head": {
             "device_ms": round(t_lm * 1e3, 3),
-            "ideal_bytes": int(D * V * wbytes + (V * 4 if quant else 0)),
+            "ideal_bytes": ideal["lm_head"],
         },
         "sampling": {
             "device_ms": round(t_sample * 1e3, 3),
-            "ideal_bytes": int(B * V * 4),
+            "ideal_bytes": ideal["sampling"],
         },
     }
     for ph in phases.values():
@@ -908,6 +916,169 @@ def _main_sim() -> None:
     )
 
 
+def _sentinel_profile_key(cpu_mode: bool, wl: dict, quick: bool) -> str:
+    """Baseline entries key on platform + model + quick/full so a CPU
+    CI run never compares against a TPU headline number."""
+    return (
+        f"{'cpu' if cpu_mode else 'tpu'}-{wl['model_name']}-"
+        f"{'quick' if quick else 'full'}"
+    )
+
+
+def _sentinel_compare(measured: dict, base: dict) -> dict:
+    """Pure comparison logic (unit-tested without an engine): measured
+    ``{"tok_s", "roofline_frac", "step_time_frac"}`` vs a baseline
+    entry with EXPLICIT noise bands. Returns the verdict dict printed
+    as the sentinel report:
+
+    - ``regressed`` — tok/s fell below ``base.tok_s × (1 − noise_frac)``
+      (the gate; roofline_frac rides along informationally since it
+      moves with tok/s by construction);
+    - ``bucket_deltas`` — measured − baseline per attribution bucket;
+    - ``losing_bucket`` — the bucket whose time share GREW most beyond
+      the per-bucket noise band (``bucket_noise_abs``): the named owner
+      of the lost tokens.
+    """
+    noise = float(base.get("noise_frac", 0.15))
+    floor = base["tok_s"] * (1.0 - noise)
+    regressed = measured["tok_s"] < floor
+    bucket_noise = float(base.get("bucket_noise_abs", 0.05))
+    deltas: dict[str, float] = {}
+    losing, losing_delta = "", 0.0
+    for bucket, base_frac in (base.get("step_time_frac") or {}).items():
+        cur = (measured.get("step_time_frac") or {}).get(bucket, 0.0)
+        d = round(cur - float(base_frac), 4)
+        deltas[bucket] = d
+        if d > losing_delta and d > bucket_noise:
+            losing, losing_delta = bucket, d
+    if regressed and not losing and deltas:
+        # nothing beat the bucket band but the headline fell: name the
+        # largest POSITIVE mover, or call the slowdown uniform — naming
+        # a bucket that shrank would send the reader chasing the one
+        # place the time did NOT go
+        grew = {k: v for k, v in deltas.items() if v > 0}
+        losing = max(grew, key=grew.get) if grew else "uniform"
+    return {
+        "regressed": regressed,
+        "tok_s": round(measured["tok_s"], 2),
+        "baseline_tok_s": base["tok_s"],
+        "noise_frac": noise,
+        "floor_tok_s": round(floor, 2),
+        "roofline_frac": measured.get("roofline_frac"),
+        "baseline_roofline_frac": base.get("roofline_frac"),
+        "bucket_deltas": deltas,
+        "bucket_noise_abs": bucket_noise,
+        "losing_bucket": losing,
+    }
+
+
+def _sentinel_baseline_path() -> str:
+    return os.environ.get("DYN_BENCH_BASELINE") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json"
+    )
+
+
+def _main_sentinel(model_cfg, wl, cpu_mode: bool) -> None:
+    """--sentinel: the bench regression gate (docs/observability.md
+    "Perf attribution"). Runs the headline workload, compares tok/s and
+    the attribution breakdown against the committed BENCH_BASELINE.json
+    (override: --baseline PATH / DYN_BENCH_BASELINE), prints the
+    attribution delta naming the bucket that ate the loss, and exits
+    nonzero on regression. ``--quick`` shrinks the workload for the CI
+    CPU-interpret smoke tier; ``--update-baseline`` rewrites this
+    profile's entry from the measured run (commit the diff
+    deliberately). DYN_SENTINEL_REPORT=path additionally writes the
+    report JSON there (the CI artifact)."""
+    argv = sys.argv[1:]
+    quick = "--quick" in argv
+    if quick:
+        # small enough for a CI CPU run, big enough for a steady decode
+        # window (the attribution fractions need some steps)
+        wl = dict(wl, batch=min(wl["batch"], 2), isl=min(wl["isl"], 16),
+                  osl=min(wl["osl"], 16))
+    decode_steps = 4 if quick else None
+    path = _sentinel_baseline_path()
+    if "--baseline" in argv:
+        i = argv.index("--baseline") + 1
+        if i >= len(argv) or argv[i].startswith("--"):
+            raise SystemExit("--baseline requires a path argument")
+        path = argv[i]
+    key = _sentinel_profile_key(cpu_mode, wl, quick)
+    r = asyncio.run(_run(model_cfg, wl, decode_steps=decode_steps))
+    attr = r["attribution"]
+    measured = {
+        "tok_s": r["tput"],
+        "roofline_frac": (
+            attr["roofline_frac"]
+            if attr["roofline_frac"] is not None
+            else round(r["tput"] / r["roofline"], 6)
+        ),
+        "step_time_frac": attr["frac"],
+    }
+    baselines: dict = {"profiles": {}}
+    if os.path.exists(path):
+        with open(path) as f:
+            baselines = json.load(f)
+    if "--update-baseline" in argv:
+        baselines.setdefault("profiles", {})[key] = {
+            "tok_s": round(measured["tok_s"], 2),
+            "roofline_frac": round(measured["roofline_frac"], 6),
+            "step_time_frac": {
+                k: round(v, 4)
+                for k, v in measured["step_time_frac"].items()
+            },
+            # explicit noise bands: CPU-interpret timings swing with
+            # runner hardware, so the quick tier gets a wide gate —
+            # tighten deliberately, per profile, when the fleet is known
+            "noise_frac": 0.15 if not cpu_mode else 0.5,
+            "bucket_noise_abs": 0.05 if not cpu_mode else 0.2,
+        }
+        with open(path, "w") as f:
+            json.dump(baselines, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"# sentinel: baseline profile {key!r} written to {path}",
+              file=sys.stderr)
+    base = (baselines.get("profiles") or {}).get(key)
+    if base is None:
+        print(json.dumps({
+            "metric": "bench_sentinel", "value": round(r["tput"], 2),
+            "unit": "tokens/sec", "vs_baseline": 0.0,
+            "config": {"error": f"no baseline profile {key!r} in {path}",
+                       "hint": "run with --update-baseline and commit"},
+        }))
+        sys.exit(2)
+    verdict = _sentinel_compare(measured, base)
+    out = {
+        "metric": "bench_sentinel",
+        "value": round(r["tput"], 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(r["tput"] / max(base["tok_s"], 1e-9), 4),
+        "config": {"profile": key, "baseline_path": path, **verdict},
+    }
+    print(json.dumps(out))
+    report_path = os.environ.get("DYN_SENTINEL_REPORT")
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+    if verdict["regressed"]:
+        delta = verdict["bucket_deltas"].get(verdict["losing_bucket"], 0.0)
+        print(
+            f"# SENTINEL REGRESSION: {verdict['tok_s']} tok/s < floor "
+            f"{verdict['floor_tok_s']} (baseline {base['tok_s']} "
+            f"-{verdict['noise_frac']:.0%}); losing bucket: "
+            f"{verdict['losing_bucket'] or 'unknown'} "
+            f"({delta:+.4f} of step time)",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    print(
+        f"# sentinel OK: {verdict['tok_s']} tok/s >= floor "
+        f"{verdict['floor_tok_s']} ({key})",
+        file=sys.stderr,
+    )
+
+
 def main() -> None:
     if "--sim" in sys.argv[1:]:
         _main_sim()  # pure host-side discrete-event run: no jax, no chip
@@ -918,6 +1089,9 @@ def main() -> None:
 
         force_platform("cpu")
     model_cfg, wl = _build_config(cpu_mode)
+    if "--sentinel" in sys.argv[1:]:
+        _main_sentinel(model_cfg, wl, cpu_mode)
+        return
     if "--spec" in sys.argv[1:]:
         _main_spec_ab(model_cfg, wl)
         return
@@ -965,6 +1139,18 @@ def main() -> None:
             # movement in the headline number is attributable to the
             # pipeline only if this fraction moved with it
             "overlap": r["overlap"]["overlap_enabled"],
+            # live attribution (telemetry/attribution.py): the serving-
+            # side decomposition of this run's wall time; roofline_frac
+            # here and vs_baseline above share one formula
+            # (telemetry/roofline.py) so they must agree up to
+            # windowing (the ledger's frac is decode-records-only and
+            # skips engine-idle spans; vs_baseline divides by the whole
+            # measured wall incl. prefill)
+            "roofline_frac_live": r["attribution"]["roofline_frac"],
+            "top_loss_bucket": r["attribution"]["top_loss_bucket"],
+            "step_time_frac": {
+                k: v for k, v in r["attribution"]["frac"].items() if v > 0
+            },
             "device_idle_frac": r["overlap"]["device_idle_frac"],
             "idle_gap_ms_per_step": r["overlap"]["idle_gap_ms_per_step"],
             "max_idle_gap_ms": r["overlap"]["max_idle_gap_ms"],

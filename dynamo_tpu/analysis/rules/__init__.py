@@ -24,5 +24,6 @@ from dynamo_tpu.analysis.rules import (  # noqa: F401
     transitive_blocking,
     transitive_sync,
     unbounded_buffer,
+    unclosed_span,
     wall_clock,
 )

@@ -1,0 +1,230 @@
+"""DL012 unclosed-span: a started span must end on EVERY path.
+
+Spans (telemetry/spans.py) export at ``end()``; a span that never ends
+silently vanishes from the trace — the request *looks* untraced exactly
+when something went wrong enough to take an early exit, which is when
+the span mattered. The sanctioned shapes are:
+
+- ``with tracer.span(...)`` / ``with span:`` — ``__exit__`` ends it,
+  exception or not;
+- ``span = tracer.span(...)`` followed by ``span.end()`` inside a
+  ``finally:`` block;
+- straight-line ``span.end()`` with no ``return``/``raise``/``break``/
+  ``continue`` between start and end.
+
+Flagged: a span-start result bound to a name whose ``end()`` is only
+reachable conditionally (inside an ``if``/loop/``except`` arm), or
+never called, or separated from the start by an early exit. A span that
+*escapes* the function — returned, yielded, stored on an object, passed
+to another call — is someone else's lifecycle and is not flagged
+(``propagation_context(span, ...)`` hand-offs stay clean).
+
+Span-start detection is name-based (the linter sees one file at a
+time): calls to an attribute named ``span``/``start_span``, or
+``start`` on a receiver whose name mentions spans/tracers
+(``spans.start(...)``, ``self._tracer.start(...)``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Tuple
+
+from dynamo_tpu.analysis.registry import LintModule, rule
+from dynamo_tpu.analysis.rules.common import dotted_name
+
+# var uses that neither close nor leak the span
+_NEUTRAL_METHODS = {"set_attr", "trace_context", "to_dict"}
+_CONDITIONAL_ANCESTORS = (
+    ast.If, ast.While, ast.For, ast.AsyncFor, ast.ExceptHandler,
+    ast.IfExp,
+)
+_EARLY_EXITS = (ast.Return, ast.Raise, ast.Break, ast.Continue)
+
+
+def _is_span_start(call: ast.Call) -> bool:
+    fn = call.func
+    if not isinstance(fn, ast.Attribute):
+        return False
+    if fn.attr in ("span", "start_span"):
+        return True
+    if fn.attr == "start":
+        recv = dotted_name(fn.value) or ""
+        last = recv.rsplit(".", 1)[-1].lower()
+        return "span" in last or "tracer" in last
+    return False
+
+
+def _parent_map(root: ast.AST) -> dict[ast.AST, ast.AST]:
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _ancestors(node: ast.AST, parents: dict, stop: ast.AST) -> list[ast.AST]:
+    out = []
+    cur = parents.get(node)
+    while cur is not None and cur is not stop:
+        out.append(cur)
+        cur = parents.get(cur)
+    return out
+
+
+def _in_finally(node: ast.AST, parents: dict, stop: ast.AST) -> bool:
+    cur, prev = parents.get(node), node
+    while cur is not None and prev is not stop:
+        if isinstance(cur, ast.Try) and any(
+            prev is s or _contains(s, prev) for s in cur.finalbody
+        ):
+            return True
+        prev, cur = cur, parents.get(cur)
+    return False
+
+
+def _contains(tree: ast.AST, target: ast.AST) -> bool:
+    return any(n is target for n in ast.walk(tree))
+
+
+def _stmt_of(node: ast.AST, parents: dict, fn: ast.AST) -> Optional[ast.stmt]:
+    """The direct-child statement of ``fn``'s body chain holding node."""
+    cur = node
+    while cur is not None and parents.get(cur) is not fn:
+        cur = parents.get(cur)
+    return cur if isinstance(cur, ast.stmt) else None
+
+
+def _check_function(fn) -> Iterable[Tuple[ast.AST, str]]:
+    parents = _parent_map(fn)
+    # span vars started in THIS function; starts inside nested defs are
+    # skipped here (the module walk hands every def to _check_function,
+    # so nested lifecycles scope apart)
+    assigns: list[tuple[str, ast.Assign]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        if isinstance(node.value, ast.Call) and _is_span_start(node.value):
+            # skip starts inside nested defs: their enclosing function
+            # is checked separately
+            if any(
+                isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                for a in _ancestors(node, parents, fn)
+            ):
+                continue
+            assigns.append((tgt.id, node))
+    for var, assign in assigns:
+        ends: list[ast.AST] = []
+        end_in_finally = False
+        end_unconditional: Optional[ast.AST] = None
+        closed_by_with = False
+        escapes = False
+        rebound = False
+        for node in ast.walk(fn):
+            if node is assign.targets[0]:
+                continue
+            if isinstance(node, ast.Name) and node.id == var:
+                if isinstance(node.ctx, ast.Store):
+                    if parents.get(node) is not assign:
+                        rebound = True  # reassigned: stop tracking
+                    continue
+                parent = parents.get(node)
+                anc = _ancestors(node, parents, fn)
+                if any(
+                    isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    for a in anc
+                ):
+                    escapes = True  # captured by a closure
+                    continue
+                if isinstance(parent, ast.withitem) and parent.context_expr is node:
+                    closed_by_with = True
+                    continue
+                if isinstance(parent, ast.Attribute):
+                    call = parents.get(parent)
+                    is_call = (
+                        isinstance(call, ast.Call) and call.func is parent
+                    )
+                    if parent.attr == "end" and is_call:
+                        ends.append(node)
+                        if _in_finally(node, parents, fn):
+                            end_in_finally = True
+                        elif not any(
+                            isinstance(a, _CONDITIONAL_ANCESTORS)
+                            for a in anc
+                        ):
+                            end_unconditional = node
+                        continue
+                    if parent.attr in _NEUTRAL_METHODS or not is_call:
+                        continue  # set_attr / attribute read: neutral
+                    escapes = True
+                    continue
+                if isinstance(parent, (ast.BoolOp, ast.UnaryOp, ast.Compare)):
+                    continue  # truthiness tests are neutral
+                if isinstance(parent, (ast.If, ast.While)) and getattr(
+                    parent, "test", None
+                ) is node:
+                    continue
+                # call argument, return/yield value, container element,
+                # attribute/subscript store target... — the span leaves
+                # this function's custody
+                escapes = True
+        if closed_by_with or end_in_finally or escapes or rebound:
+            continue
+        if not ends:
+            yield (
+                assign,
+                f"span {var!r} is started but never ended (and never "
+                f"used as a context manager) — it will not export; "
+                f"use `with`, or end() in a finally:",
+            )
+            continue
+        if end_unconditional is None:
+            yield (
+                assign,
+                f"span {var!r} only ends on some paths (every end() is "
+                f"inside a conditional branch) — an early exit leaks "
+                f"it; move end() to a finally: or use `with`",
+            )
+            continue
+        # straight-line end: flag early exits between start and end
+        a_stmt = _stmt_of(assign, parents, fn)
+        e_stmt = _stmt_of(end_unconditional, parents, fn)
+        if a_stmt is None or e_stmt is None:
+            continue
+        # the end must live in the same statement list as the start for
+        # the straight-line scan to mean anything
+        holder = None
+        for field in ("body", "orelse", "finalbody"):
+            stmts = getattr(fn, field, None)
+            if stmts and a_stmt in stmts:
+                holder = stmts
+        if holder is None or e_stmt not in holder:
+            continue
+        between = holder[holder.index(a_stmt) + 1 : holder.index(e_stmt)]
+        for stmt in between:
+            exits = [
+                n for n in ast.walk(stmt) if isinstance(n, _EARLY_EXITS)
+            ]
+            if exits:
+                yield (
+                    exits[0],
+                    f"path between {var!r}'s start and its end() can "
+                    f"exit early here — the span leaks on that path; "
+                    f"wrap in try/finally or use `with`",
+                )
+                break
+
+
+@rule(
+    "unclosed-span",
+    "DL012",
+    "span started but not ended on every path (leaks from traces on "
+    "early exits); use `with` or end() in a finally",
+)
+def check(module: LintModule) -> Iterable[Tuple[ast.AST, str]]:
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield from _check_function(node)

@@ -373,6 +373,10 @@ def build_parser() -> argparse.ArgumentParser:
                      help="print JSON rows instead of the table")
     top.add_argument("--no-clear", action="store_true",
                      help="don't clear the screen between frames")
+    top.add_argument("--watch-roofline", action="store_true",
+                     help="sort workers by roofline_frac ascending — "
+                          "the worker losing the most throughput to "
+                          "its loss buckets renders first")
 
     models = sub.add_parser("models", help="model registry ctl (≈ llmctl)")
     models.add_argument("action", choices=["list", "register", "remove"])

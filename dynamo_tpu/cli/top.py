@@ -62,15 +62,19 @@ def _engine_row(url: str, state: dict, prev: Optional[dict],
     hbm = eng.get("hbm") or {}
     load = eng.get("load") or {}
     rec = eng.get("flight_recorder") or {}
+    attr = (eng.get("attribution") or {}).get("window") or {}
     tok_rate: Optional[float] = None
     # tokens_generated_total counts ALL generated tokens (goodput only
-    # counts SLO-met ones and stays 0 when no targets are configured)
+    # counts SLO-met ones and stays 0 when no targets are configured).
+    # No prior snapshot, a zero/negative poll gap, or a counter that
+    # went BACKWARDS (worker restart) all mean "no delta yet" — render
+    # the absence marker, never a fabricated 0.0 rate.
     toks = eng.get("tokens_generated_total")
     if prev is not None and prev_ts is not None and toks is not None:
         prev_toks = (prev.get("engine") or {}).get("tokens_generated_total")
         dt = now - prev_ts
-        if prev_toks is not None and dt > 0:
-            tok_rate = max(0.0, (toks - prev_toks) / dt)
+        if prev_toks is not None and dt > 0 and toks >= prev_toks:
+            tok_rate = (toks - prev_toks) / dt
     return {
         "url": url,
         "model": eng.get("model") or "-",
@@ -82,6 +86,10 @@ def _engine_row(url: str, state: dict, prev: Optional[dict],
         "kv_total": pool.get("total_blocks"),
         "tok_s": tok_rate,
         "slo": slo.get("attainment") if slo.get("enabled") else None,
+        # perf attribution (telemetry/attribution.py): live roofline
+        # fraction + the window's dominant loss bucket per worker
+        "roofline": attr.get("roofline_frac"),
+        "loss_bucket": attr.get("top_loss_bucket") or None,
         "hbm": hbm.get("bytes_in_use"),
         "slow_steps": rec.get("slow_steps"),
         "preemptions": sched.get("preemptions"),
@@ -91,8 +99,8 @@ def _engine_row(url: str, state: dict, prev: Optional[dict],
 
 HEADER = (
     f"{'WORKER':<28} {'MODEL':<12} {'RUN':>5} {'WAIT':>5} "
-    f"{'KV%':>7} {'TOK/S':>8} {'SLO%':>7} {'HBM':>9} "
-    f"{'SLOW':>5} {'PREEMPT':>7}"
+    f"{'KV%':>7} {'TOK/S':>8} {'ROOF%':>7} {'LOSS':>10} {'SLO%':>7} "
+    f"{'HBM':>9} {'SLOW':>5} {'PREEMPT':>7}"
 )
 
 
@@ -111,7 +119,10 @@ def render_frame(rows: list[dict], out: TextIO) -> None:
         out.write(
             f"{r['url']:<28} {str(r['model'])[:12]:<12} {run_s:>5} "
             f"{str(r['waiting'] if r['waiting'] is not None else '-'):>5} "
-            f"{_pct(r['kv_usage']):>7} {tok} {_pct(r['slo']):>7} "
+            f"{_pct(r['kv_usage']):>7} {tok} "
+            f"{_pct(r.get('roofline')):>7} "
+            f"{str(r.get('loss_bucket') or '-')[:10]:>10} "
+            f"{_pct(r['slo']):>7} "
             f"{_fmt_bytes(r['hbm']):>9} "
             f"{str(r['slow_steps'] if r['slow_steps'] is not None else '-'):>5} "
             f"{str(r['preemptions'] if r['preemptions'] is not None else '-'):>7}\n"
@@ -126,10 +137,14 @@ async def run_top(
     raw: bool = False,
     clear: bool = True,
     out: TextIO = sys.stdout,
+    watch_roofline: bool = False,
 ) -> int:
     """Poll ``urls`` and render frames until ``iterations`` runs out
     (None = forever). Returns an exit code (1 when EVERY worker errored
-    on the final frame — a dead fleet should fail scripts)."""
+    on the final frame — a dead fleet should fail scripts).
+    ``watch_roofline`` sorts the table by roofline_frac ascending —
+    the worker bleeding the most throughput floats to the top (workers
+    without a decode window sort last; errored rows stay last)."""
     prev: dict[str, tuple[dict, float]] = {}
     n = 0
     all_failed = False
@@ -154,6 +169,13 @@ async def run_top(
                     p[1] if p else None,
                 ))
                 prev[url] = (res, now)
+            if watch_roofline:
+                rows.sort(key=lambda r: (
+                    "error" in r and r.get("error") is not None,
+                    r.get("roofline") is None,
+                    r.get("roofline") if r.get("roofline") is not None
+                    else 0.0,
+                ))
             if raw:
                 payload = {
                     r["url"] if "url" in r else urls[i]: r
@@ -182,6 +204,7 @@ def cmd_top(args: Any) -> int:
             iterations=1 if args.once else args.iterations,
             raw=args.raw,
             clear=not args.no_clear,
+            watch_roofline=getattr(args, "watch_roofline", False),
         ))
     except KeyboardInterrupt:
         return 0

@@ -69,6 +69,12 @@ from dynamo_tpu.telemetry.debug import (
     register_debug_provider,
     unregister_debug_provider,
 )
+from dynamo_tpu.telemetry.attribution import (
+    AttributionLedger,
+    BlackBox,
+    register_attribution_provider,
+    unregister_attribution_provider,
+)
 from dynamo_tpu.telemetry.hbm import HbmAccountant, tree_bytes
 from dynamo_tpu.telemetry.instruments import (
     ENGINE_BATCH_OCCUPANCY,
@@ -145,6 +151,12 @@ class ForwardPassMetrics:
     slo_enabled: bool = False
     slo_attainment: float = 1.0
     goodput_tokens_total: int = 0
+    # perf attribution (telemetry/attribution.py): live achieved/roofline
+    # ratio and the window's dominant loss bucket. -1.0 = no decode
+    # window yet (aggregators exclude it from the fleet mean — a fresh
+    # worker must not read as either perfect or broken).
+    roofline_frac: float = -1.0
+    top_loss_bucket: str = ""
 
     def to_dict(self) -> dict:
         return self.__dict__.copy()
@@ -258,6 +270,21 @@ class JaxEngine:
             SloConfig(ttft_ms=config.slo_ttft_ms, itl_ms=config.slo_itl_ms)
         )
         self.hbm = HbmAccountant()
+        # continuous perf attribution (telemetry/attribution.py): the
+        # per-step loss-bucket ledger behind dynamo_step_time_frac /
+        # dynamo_roofline_frac; the byte model installs in
+        # _initialize_inner once the geometry is known. Engine-thread
+        # writes, snapshot reads.
+        self.attribution = AttributionLedger()
+        # anomaly-triggered black-box capture: slow-step/idle-gap
+        # watchdog trips and roofline-band drops bundle the flight
+        # recorder ring + attribution window + /debug/state into one
+        # timestamped dump dir (rate-limited)
+        self.blackbox = BlackBox(
+            recorder=self.recorder,
+            ledger=self.attribution,
+            dump_dir=config.flight_dump_dir,
+        )
         # per-dispatch phase timings (_run_device_step fills; the step
         # recorder reads) — a plain dict, engine-thread only
         self._last_phases: dict[str, float] = {}
@@ -305,6 +332,9 @@ class JaxEngine:
         # its own registration)
         engine._debug_name = "engine"
         register_debug_provider(engine._debug_name, engine.debug_state)
+        register_attribution_provider(
+            engine._debug_name, engine.attribution_state
+        )
         if faults.ACTIVE is not None and engine.recorder is not None:
             # fired faults land in the flight recorder's ring so an
             # anomaly dump shows the injected chaos next to the steps
@@ -434,6 +464,14 @@ class JaxEngine:
             quantize=cfg.quantization,
         )
         self.eos_token_ids = self.model_config.eos_token_ids
+        # install the attribution ledger's byte model: geometry + quant
+        # + kv dtype are now final, so the live roofline denominator is
+        # computed from the same formula bench.py prints (roofline.py)
+        from dynamo_tpu.telemetry.roofline import build_roofline
+
+        self.attribution.configure(build_roofline(
+            self.model_config, cfg.quantization, cfg.kv_cache_dtype,
+        ))
 
         if jnp.dtype(cfg.kv_cache_dtype) == jnp.int8:
             # int8 KV limits (ops/kv_quant.py documents the layout):
@@ -1870,7 +1908,9 @@ class JaxEngine:
                     continue  # more queued: keep draining
                 # no work: the wait for the next request is load, not a
                 # device idle gap — drop the overlap tracker's anchor
+                # and break the attribution timeline for the same reason
                 self.overlap.note_idle()
+                self.attribution.note_idle()
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
@@ -2114,6 +2154,7 @@ class JaxEngine:
     def _record_step(
         self, kind: str, duration_s: float,
         batch: int = 0, prefill_rows: int = 0, use_phases: bool = True,
+        tokens: int = 0, overlapped: bool = False,
         **extra,
     ) -> None:
         """One flight-recorder entry per device step: kind, batch
@@ -2123,7 +2164,14 @@ class JaxEngine:
         ``use_phases=False`` for records whose dispatch did NOT go
         through ``_run_device_step`` (fused windows, spec) — merging
         ``_last_phases`` there would attribute a stale, unrelated
-        dispatch's timings to this step."""
+        dispatch's timings to this step.
+
+        ``tokens``/``overlapped`` feed the attribution ledger
+        (telemetry/attribution.py): tokens emitted by this step and
+        whether its dispatch overlapped other host work (the decode/
+        window pipelines) — the ledger's partition rules differ
+        (docstring there). A slow-step/idle-gap watchdog dump or a
+        ledger roofline-band anomaly triggers the black-box bundle."""
         sched = self.scheduler
         self._step_counter += 1
         self._update_pool_gauges()
@@ -2133,7 +2181,7 @@ class JaxEngine:
             except Exception:  # stats are advisory; never fail a step
                 log.debug("hbm refresh failed", exc_info=True)
         phases, self._last_phases = self._last_phases, {}
-        if self.recorder is None or sched is None:
+        if sched is None:
             return
         pre = sched.preemptions
         fields = dict(
@@ -2149,7 +2197,36 @@ class JaxEngine:
         if use_phases:
             fields.update(phases)
         fields.update(extra)
-        self.recorder.record(kind, duration_s, **fields)
+        # attribution ledger: live context from the scheduler (advisory
+        # — one step stale under the pipelines); the spec step's
+        # draft/verify stamps map onto plan/sync (host drafting ahead
+        # of the harvest-blocking verify)
+        try:
+            anomaly = self.attribution.note_step(
+                kind, duration_s,
+                batch=batch or fields["running"],
+                tokens=tokens,
+                context_tokens=sum(
+                    s.num_computed for s in sched.running
+                ),
+                plan_ms=fields.get("plan_ms") or fields.get("draft_ms") or 0.0,
+                dispatch_ms=fields.get("dispatch_ms") or 0.0,
+                sync_ms=fields.get("sync_ms") or fields.get("verify_ms") or 0.0,
+                idle_gap_ms=fields.get("idle_gap_ms") or 0.0,
+                overlapped=overlapped,
+            )
+        except Exception:  # advisory: never fail a step on accounting
+            log.debug("attribution note failed", exc_info=True)
+            anomaly = None
+        dump = None
+        if self.recorder is not None:
+            dump = self.recorder.record(kind, duration_s, **fields)
+        if dump is not None:
+            # watchdog tripped (slow step or idle gap): preserve the
+            # full forensic context, not just the ring
+            self.blackbox.trigger(f"watchdog:{kind}")
+        elif anomaly is not None:
+            self.blackbox.trigger(anomaly)
 
     def _one_step(self) -> None:
         sched = self.scheduler
@@ -2312,6 +2389,10 @@ class JaxEngine:
             plan.kind, dt,
             batch=len(seqs),
             prefill_rows=len(plan.prefill_batch),
+            tokens=(
+                sum(1 for w in plan.prefill_batch if w.is_last_chunk)
+                if plan.kind == "prefill" else len(seqs)
+            ),
             plan_ms=plan_ms,
             synced=need_sync,
         )
@@ -2487,6 +2568,7 @@ class JaxEngine:
         self._record_step(
             "spec", draft_s + verify_s,
             batch=len(works),
+            tokens=len(works) + accepted,  # accepted prefix + 1 per row
             use_phases=False,  # draft/verify ms below ARE the phases
             draft_ms=round(draft_s * 1e3, 3),
             verify_ms=round(verify_s * 1e3, 3),
@@ -2663,6 +2745,8 @@ class JaxEngine:
             self._record_step(
                 "decode", dt,
                 batch=len(e["seqs"]),
+                tokens=len(e["seqs"]),
+                overlapped=True,
                 use_phases=False,  # per-entry stamps below
                 plan_ms=e["plan_ms"],
                 sync_ms=sync_ms,
@@ -3147,6 +3231,8 @@ class JaxEngine:
                 "window_" + e["kind"], win_s,
                 batch=len(e["seqs"]),
                 prefill_rows=len(e["works"]),
+                tokens=sum(e["vmap"].values()),
+                overlapped=True,
                 pipeline_depth=len(pending),
                 use_phases=False,  # dispatched via the window fns, not
                 # _run_device_step — its phase stamps belong elsewhere
@@ -3370,13 +3456,18 @@ class JaxEngine:
             )
         met = self.slo.observe(ttft_s, itl_s, completion_tokens=seq.generated)
         if not met and self.recorder is not None:
-            self.recorder.note_slow_request(
+            dump = self.recorder.note_slow_request(
                 seq.request_id,
                 ttft_ms=round(ttft_s * 1e3, 3),
                 itl_ms=round(itl_s * 1e3, 3) if itl_s is not None else None,
                 tokens=seq.generated,
                 finish_reason=str(reason.value),
             )
+            if dump is not None:
+                # the ring dump fired: preserve the rest of the state
+                # too (both limiters gate independently — a suppressed
+                # ring dump means a recent bundle already exists)
+                self.blackbox.trigger(f"slo_miss:{seq.request_id}")
 
     def _emit_lifecycle_spans(self, seq: Sequence, reason: FinishReason) -> None:
         """Record the engine's per-request spans at finish time. Span
@@ -3597,6 +3688,10 @@ class JaxEngine:
     def stats(self) -> ForwardPassMetrics:
         sched, alloc = self.scheduler, self.allocator
         assert sched is not None and alloc is not None
+        # cached rollup (refreshed every GAUGE_EVERY steps): stats()
+        # feeds admission control per HTTP request and the metrics
+        # publisher per interval — neither may pay an O(window) pass
+        attr = self.attribution.summary_cached()
         return ForwardPassMetrics(
             request_active_slots=sched.num_running,
             request_total_slots=self.config.max_batch_size,
@@ -3612,7 +3707,23 @@ class JaxEngine:
             slo_enabled=self.slo.config.enabled,
             slo_attainment=self.slo.attainment,
             goodput_tokens_total=self.slo.goodput_tokens,
+            roofline_frac=(
+                attr["roofline_frac"]
+                if attr["roofline_frac"] is not None else -1.0
+            ),
+            top_loss_bucket=attr["top_loss_bucket"],
         )
+
+    def attribution_state(self) -> dict:
+        """Provider behind ``/debug/attribution``: the ledger window +
+        recent per-step rows and the black-box capture stats."""
+        # gauges refresh here too, so /metrics scraped next to the
+        # endpoint agrees with the snapshot (mirrors _update_pool_gauges)
+        self.attribution.refresh_gauges()
+        return {
+            "attribution": self.attribution.snapshot(),
+            "blackbox": self.blackbox.stats(),
+        }
 
     def debug_state(self) -> dict:
         """Live snapshot for ``/debug/state`` (telemetry/debug.py):
@@ -3687,6 +3798,11 @@ class JaxEngine:
             "enabled": self.config.overlap,
             **self.overlap.stats(),
         }
+        # perf attribution (telemetry/attribution.py): where the decode
+        # window's wall time went, the live roofline fraction, and the
+        # black-box capture state — what `top`'s ROOF%/LOSS columns read
+        out["attribution"] = self.attribution.snapshot()
+        out["blackbox"] = self.blackbox.stats()
         if self.recorder is not None:
             out["flight_recorder"] = self.recorder.stats()
             out["recent_steps"] = self.recorder.snapshot(32)
@@ -3736,6 +3852,9 @@ class JaxEngine:
         self._wake.set()
         if self._debug_name is not None:
             unregister_debug_provider(self._debug_name, self.debug_state)
+            unregister_attribution_provider(
+                self._debug_name, self.attribution_state
+            )
             self._debug_name = None
         from dynamo_tpu.models.llama import (
             get_attention_mesh,
@@ -3748,6 +3867,11 @@ class JaxEngine:
             await asyncio.get_running_loop().run_in_executor(
                 None, functools.partial(self._thread.join, timeout=10)
             )
+        # let an in-flight black-box bundle finish writing — its
+        # forensics are the reason the process is probably going down
+        await asyncio.get_running_loop().run_in_executor(
+            None, functools.partial(self.blackbox.flush, 5.0)
+        )
         if self._mh_broadcast is not None:
             # release follower ranks blocked on the next control
             # broadcast (strictly after the step thread has joined, so

@@ -135,6 +135,7 @@ class HttpService:
                 web.get("/live", self._health),
                 web.get("/metrics", self._metrics),
                 web.get("/debug/state", self._debug_state),
+                web.get("/debug/attribution", self._debug_attribution),
                 web.get("/debug/profile", self._debug_profile),
                 web.get("/v1/models", self._models),
                 web.post("/v1/chat/completions", self._chat),
@@ -184,6 +185,15 @@ class HttpService:
             "port": self.port,
         }
         return web.json_response(state)
+
+    async def _debug_attribution(self, request: web.Request) -> web.Response:
+        """Perf attribution (docs/observability.md "Perf attribution"):
+        the decode window's loss-bucket fractions, live roofline_frac,
+        per-bucket tokens-lost rates, recent per-step rows, and the
+        black-box capture state — the 'where do the tokens go' endpoint."""
+        from dynamo_tpu.telemetry.attribution import collect_attribution
+
+        return web.json_response(collect_attribution())
 
     async def _debug_profile(self, request: web.Request) -> web.Response:
         """On-demand ``jax.profiler`` capture: ``/debug/profile?ms=N``

@@ -47,6 +47,12 @@ class ForwardPassMetrics(BaseModel):
     slo_enabled: bool = False
     slo_attainment: float = 1.0
     goodput_tokens_total: int = 0
+    # perf attribution (telemetry/attribution.py): live achieved-over-
+    # roofline ratio and the attribution window's dominant loss bucket.
+    # -1.0 = no decode window yet; aggregators exclude it from the
+    # fleet mean (`dynamo-tpu top` renders it per worker as ROOF%/LOSS).
+    roofline_frac: float = -1.0
+    top_loss_bucket: str = ""
 
 
 class KvHitRateEvent(BaseModel):

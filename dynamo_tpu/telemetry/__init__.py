@@ -36,6 +36,13 @@ from dynamo_tpu.telemetry.debug import (  # noqa: F401
     register_debug_provider,
     unregister_debug_provider,
 )
+from dynamo_tpu.telemetry.attribution import (  # noqa: F401
+    AttributionLedger,
+    BlackBox,
+    collect_attribution,
+    register_attribution_provider,
+    unregister_attribution_provider,
+)
 from dynamo_tpu.telemetry.hbm import HbmAccountant, tree_bytes  # noqa: F401
 from dynamo_tpu.telemetry.overlap import OverlapTracker  # noqa: F401
 from dynamo_tpu.telemetry.recorder import FlightRecorder  # noqa: F401

@@ -28,13 +28,65 @@ from typing import Callable, Optional
 
 log = logging.getLogger("dynamo_tpu.telemetry.debug")
 
-# cross-thread contract (dynalint DL103 vocabulary, docs/
-# static_analysis.md): the registry is written from the event loop
-# (engines registering at launch) AND read/written from arbitrary
-# threads (debug endpoints, shutdown paths) — _providers_lock is the
-# declared handoff; every access below takes it
-_providers: dict[str, Callable[[], dict]] = {}
-_providers_lock = threading.Lock()
+class ProviderRegistry:
+    """Named zero-arg snapshot providers behind one lock — the shape
+    both ``/debug/state`` and ``/debug/attribution`` share (one
+    implementation so fixes to the identity-checked unregister or the
+    error-stanza collect can't drift between them).
+
+    Cross-thread contract (dynalint DL103 vocabulary, docs/
+    static_analysis.md): written from the event loop (engines
+    registering at launch) AND read/written from arbitrary threads
+    (debug endpoints, shutdown paths) — the lock is the declared
+    handoff; every access takes it.
+    """
+
+    def __init__(self, what: str):
+        self._what = what
+        self._providers: dict[str, Callable[[], dict]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, fn: Callable[[], dict]) -> None:
+        """Register (or replace) a named snapshot provider."""
+        with self._lock:
+            self._providers[name] = fn
+
+    def unregister(
+        self, name: str, fn: Optional[Callable[[], dict]] = None
+    ) -> None:
+        """Remove a provider; with ``fn`` given, only if it is still
+        the registered one (an engine shutting down must not yank a
+        newer engine's registration)."""
+        with self._lock:
+            # == (not `is`): bound methods are fresh objects per
+            # attribute access but compare equal for the same
+            # instance+function
+            if fn is None or self._providers.get(name) == fn:
+                self._providers.pop(name, None)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._providers)
+
+    def collect(self) -> dict:
+        """One JSON-able snapshot across every registered provider."""
+        with self._lock:
+            providers = dict(self._providers)
+        out: dict = {"ts": time.time(), "pid": os.getpid()}
+        for name, fn in sorted(providers.items()):
+            try:
+                out[name] = fn()
+            except Exception as exc:
+                # the snapshot reads live structures without stopping
+                # the world — a torn read must degrade to an error
+                # stanza, not a 500 on the one endpoint you need
+                # during an incident
+                log.exception("%s provider %r failed", self._what, name)
+                out[name] = {"error": f"{type(exc).__name__}: {exc}"}
+        return out
+
+
+_DEBUG_PROVIDERS = ProviderRegistry("debug")
 
 # one jax.profiler capture at a time (the profiler itself is global)
 _profile_lock = threading.Lock()
@@ -44,44 +96,21 @@ MAX_PROFILE_MS = 30_000
 
 
 def register_debug_provider(name: str, fn: Callable[[], dict]) -> None:
-    """Register (or replace) a named snapshot provider."""
-    with _providers_lock:
-        _providers[name] = fn
+    _DEBUG_PROVIDERS.register(name, fn)
 
 
 def unregister_debug_provider(
     name: str, fn: Optional[Callable[[], dict]] = None
 ) -> None:
-    """Remove a provider; with ``fn`` given, only if it is still the
-    registered one (an engine shutting down must not yank a newer
-    engine's registration)."""
-    with _providers_lock:
-        # == (not `is`): bound methods are fresh objects per attribute
-        # access but compare equal for the same instance+function
-        if fn is None or _providers.get(name) == fn:
-            _providers.pop(name, None)
+    _DEBUG_PROVIDERS.unregister(name, fn)
 
 
 def debug_provider_names() -> list[str]:
-    with _providers_lock:
-        return sorted(_providers)
+    return _DEBUG_PROVIDERS.names()
 
 
 def collect_debug_state() -> dict:
-    """One JSON-able snapshot across every registered provider."""
-    with _providers_lock:
-        providers = dict(_providers)
-    out: dict = {"ts": time.time(), "pid": os.getpid()}
-    for name, fn in sorted(providers.items()):
-        try:
-            out[name] = fn()
-        except Exception as exc:
-            # the snapshot reads live structures without stopping the
-            # world — a torn read must degrade to an error stanza, not
-            # a 500 on the one endpoint you need during an incident
-            log.exception("debug provider %r failed", name)
-            out[name] = {"error": f"{type(exc).__name__}: {exc}"}
-    return out
+    return _DEBUG_PROVIDERS.collect()
 
 
 async def capture_profile(ms: int, out_dir: str = "") -> dict:

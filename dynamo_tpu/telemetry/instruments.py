@@ -169,6 +169,33 @@ SLO_REQUESTS = REGISTRY.counter(
     labels=("outcome",),  # met | missed
 )
 
+# -- perf attribution (telemetry/attribution.py; docs/observability.md) -----
+STEP_TIME_FRAC = REGISTRY.gauge(
+    "dynamo_step_time_frac",
+    "Fraction of the rolling decode window's wall time attributed to "
+    "each loss bucket (queue_wait/plan/dispatch/sync/idle_gap + the "
+    "device split attention/mlp/lm_head/sampling); sums to ~1.0",
+    labels=("component",),
+)
+ROOFLINE_FRAC = REGISTRY.gauge(
+    "dynamo_roofline_frac",
+    "Achieved decode tok/s over the kv_dtype-aware byte-bound roofline "
+    "at the live geometry (telemetry/roofline.py — the same formula as "
+    "bench.py's headline vs_baseline)",
+)
+TOKENS_LOST_PER_S = REGISTRY.gauge(
+    "dynamo_tokens_lost_per_s",
+    "Tokens/s of roofline headroom attributed to each loss bucket — "
+    "'the other 60%' as a first-class per-component series",
+    labels=("component",),
+)
+BLACKBOX_DUMPS = REGISTRY.counter(
+    "dynamo_blackbox_dumps_total",
+    "Anomaly-triggered black-box forensic bundles written, by trigger "
+    "(watchdog / roofline_drop / slo_miss / manual)",
+    labels=("reason",),
+)
+
 # -- flight recorder + slow-step watchdog (telemetry/recorder.py) -----------
 SLOW_STEPS = REGISTRY.counter(
     "dynamo_engine_slow_steps_total",

@@ -1,0 +1,48 @@
+"""Fixture: span lifecycles that leak on some (or all) paths."""
+
+from telemetry import get_tracer, spans
+
+
+def never_ended(request):
+    span = get_tracer().span("http.request")  # VIOLATION: no end() at all
+    span.set_attr("model", request.model)
+    return handle(request)
+
+
+def conditional_end_only(ok):
+    span = get_tracer().span("work")  # VIOLATION: end() only in one arm
+    if ok:
+        span.end()
+    return ok
+
+
+def end_in_except_only(fn):
+    span = spans.start("risky")  # VIOLATION: end() only on the error path
+    try:
+        fn()
+    except ValueError:
+        span.end()
+
+
+def early_exit_between(items):
+    span = get_tracer().span("batch")
+    for it in items:
+        if it is None:
+            return None  # VIOLATION: leaves before span.end()
+    span.end()
+    return items
+
+
+async def async_leak(ctx):
+    span = get_tracer().span("worker.generate", parent=ctx)  # VIOLATION
+    await do_work(ctx)
+    if ctx.killed:
+        span.end()
+
+
+def handle(request):
+    return request
+
+
+async def do_work(ctx):
+    return ctx

@@ -1,0 +1,68 @@
+"""Fixture: sanctioned span lifecycles — none of these flag."""
+
+from telemetry import get_tracer, spans
+
+
+def with_block(request):
+    with get_tracer().span("http.request") as span:
+        span.set_attr("model", request.model)
+        return handle(request)
+
+
+def named_with(request):
+    span = get_tracer().span("http.request")
+    with span:
+        return handle(request)
+
+
+def end_in_finally(request):
+    span = get_tracer().span("http.request")
+    try:
+        if request is None:
+            return None
+        return handle(request)
+    finally:
+        span.end()
+
+
+def straight_line(request):
+    span = spans.start("preprocess")
+    span.set_attr("kind", "chat")
+    out = handle(request)
+    span.end()
+    return out
+
+
+def escapes_as_return(request):
+    # the caller owns the lifecycle now — not this function's leak
+    return get_tracer().span("stream", attrs={"rid": request.rid})
+
+
+def escapes_into_context(request, ctx):
+    span = get_tracer().span("router.dispatch")
+    ctx.set_trace(span)  # handed off: downstream ends it
+    return ctx
+
+
+def escapes_via_propagation(req, ctx):
+    span = get_tracer().span("prefill_queue.wait", parent=ctx)
+    try:
+        return propagation_context(span, ctx)
+    finally:
+        span.end()
+
+
+def truthiness_gate(ctx):
+    span = get_tracer().span("maybe")
+    if span:
+        ctx.note("traced")
+    span.end()
+    return ctx
+
+
+def handle(request):
+    return request
+
+
+def propagation_context(span, ctx):
+    return ctx

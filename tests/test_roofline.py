@@ -1,0 +1,121 @@
+"""telemetry/roofline.py: the ONE roofline formula bench.py and the
+attribution ledger share, pinned to the 8B int8 numbers documented in
+docs/performance.md (the byte table and the ~5.4k → ~5.9k tok/s
+bf16→int8 KV headline move)."""
+
+import pytest
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.telemetry.roofline import (
+    HBM_BW_BYTES,
+    RooflineModel,
+    build_roofline,
+    kv_bytes_per_token,
+    param_bytes,
+    phase_ideal_bytes,
+    roofline_tok_s,
+    step_bytes,
+)
+
+
+def _mc_8b() -> ModelConfig:
+    # DeepSeek-R1-Distill-Llama-8B geometry (BASELINE.md config 1) —
+    # the bench.py headline shape
+    return ModelConfig(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_hidden_layers=32, num_attention_heads=32,
+        num_key_value_heads=8, max_position_embeddings=8192,
+    )
+
+
+# headline workload: batch 64, isl 128 / osl 128 -> avg ctx 192
+B, AVG_CTX = 64, 192
+
+
+def test_8b_int8_param_bytes_pin():
+    # int8 weights ≈ 8.03 GB (fits a 16 GB v5e chip with KV headroom;
+    # docs/performance.md: MLP+projections ~6.98 GB + 2·V·D ~1.05 GB)
+    assert param_bytes(_mc_8b(), "int8") == pytest.approx(8.03e9, rel=0.01)
+    assert param_bytes(_mc_8b(), None) == 2 * param_bytes(_mc_8b(), "int8")
+
+
+def test_8b_kv_bytes_per_token_pin():
+    mc = _mc_8b()
+    # 2·L·Hk·Dh = 65536 elements/token; int8 pays +4/128 for the
+    # per-(slot, head) f32 scale, fp8 is scale-free
+    assert kv_bytes_per_token(mc, "bfloat16") == 131072.0
+    assert kv_bytes_per_token(mc, "int8") == 65536 * (1 + 4 / 128)
+    assert kv_bytes_per_token(mc, "float8_e4m3fn") == 65536.0
+
+
+def test_8b_headline_roofline_pins():
+    mc = _mc_8b()
+    # the numbers every BENCH_r* vs_baseline was computed against:
+    # bf16 KV -> ~5437 tok/s (ROADMAP item 2's denominator), int8 KV ->
+    # ~5916 (docs/performance.md "the target moves from ~5.4k to ~5.9k")
+    assert roofline_tok_s(mc, B, AVG_CTX, "int8", "bfloat16") == pytest.approx(
+        5437.0, abs=1.0
+    )
+    assert roofline_tok_s(mc, B, AVG_CTX, "int8", "int8") == pytest.approx(
+        5915.7, abs=1.0
+    )
+
+
+def test_8b_phase_byte_table_pins():
+    # the docs/performance.md byte table at the headline config
+    ph = phase_ideal_bytes(_mc_8b(), B, AVG_CTX, "int8", "int8")
+    assert ph["mlp"] == pytest.approx(6.98e9, rel=0.01)
+    assert ph["attention"] == pytest.approx(0.83e9, rel=0.01)
+    assert ph["lm_head"] == pytest.approx(0.526e9, rel=0.01)
+    assert ph["sampling"] == pytest.approx(33e6, rel=0.01)
+    bf16 = phase_ideal_bytes(_mc_8b(), B, AVG_CTX, "int8", "bfloat16")
+    assert bf16["attention"] == pytest.approx(1.61e9, rel=0.01)
+    # phases + embedding = the step total (phase table excludes the
+    # embedding read, which rides param_bytes)
+    mc = _mc_8b()
+    assert (
+        ph["mlp"] + ph["lm_head"] + ph["attention"]
+        <= step_bytes(mc, B, AVG_CTX, "int8", "int8")
+    )
+
+
+def test_bench_imports_the_same_formulas():
+    """bench.py must not grow a private copy again: its helpers ARE the
+    shared ones."""
+    import bench
+
+    mc = _mc_8b()
+    assert bench._param_bytes(mc, "int8") == param_bytes(mc, "int8")
+    assert bench._kv_bytes_per_token(mc, "int8") == kv_bytes_per_token(
+        mc, "int8"
+    )
+    assert bench.HBM_BW_BYTES == HBM_BW_BYTES
+
+
+def test_roofline_model_matches_free_functions():
+    mc = _mc_8b()
+    rm = build_roofline(mc, "int8", "int8")
+    assert isinstance(rm, RooflineModel)
+    # ideal_step_s at the headline geometry reproduces the tok/s pin
+    # (the model adds the [B, V] sampling read — sub-0.5% at 8B)
+    ideal = rm.ideal_step_s(B, B * AVG_CTX)
+    assert B / ideal == pytest.approx(
+        roofline_tok_s(mc, B, AVG_CTX, "int8", "int8"), rel=0.005
+    )
+    fr = rm.phase_fractions(B, B * AVG_CTX)
+    assert sum(fr.values()) == pytest.approx(1.0)
+    # weight-bound decode: MLP dominates the prior
+    assert fr["mlp"] > 0.5 and fr["attention"] < 0.2
+
+
+def test_roofline_model_phase_prior_matches_phase_table():
+    """The ledger's device-split prior and bench --phases must
+    decompose against the IDENTICAL byte table (the embedding gather
+    belongs to neither: it reads B rows, not the table)."""
+    mc = _mc_8b()
+    rm = build_roofline(mc, "int8", "int8")
+    ph = phase_ideal_bytes(mc, B, AVG_CTX, "int8", "int8")
+    total = sum(ph.values())
+    fr = rm.phase_fractions(B, B * AVG_CTX)
+    for k, v in ph.items():
+        assert fr[k] == pytest.approx(v / total, rel=1e-9), k
