@@ -25,6 +25,14 @@ DYN_MATMUL_IMPL=auto|reference|pallas selects the quantized-matmul
 path (models/llama.py — auto is the fused dequant Pallas kernels on a
 single TPU chip) and the headline JSON records the resolved impl.
 
+The HEADLINE runs overlapped speculative decoding by default
+(DYN_BENCH_SPEC=1: spec + the decode pipeline composed at
+decode_steps=1 over the int8 KV cache — docs/speculative_decoding.md's
+pipelined section; its JSON carries a ``spec`` stanza with drafter,
+spec_tokens, accept_rate and draft_hidden_frac). DYN_BENCH_SPEC=0 is
+the escape hatch back to the fused-window headline
+(DYN_BENCH_DECODE_STEPS windows, no speculation).
+
 ``--spec`` switches to the speculative-decoding A/B mode: the same
 workload runs once without and once with speculation (both at
 decode_steps=1 — speculation replaces fused windows), and the JSON line
@@ -34,6 +42,14 @@ DYN_BENCH_SPEC_DRAFTER (default "ngram"), DYN_BENCH_SPEC_TOKENS
 (default 4). Repetitive prompts (the self-drafting sweet spot) via
 DYN_BENCH_SPEC_REPEAT=1 — the default keeps the standard random-prompt
 workload, where the reported accept rate is an honest floor.
+
+``--spec-overlap`` is the three-way composition A/B at decode_steps=1:
+serial spec (overlap off) vs pipelined spec (the composition) vs plain
+overlap (spec off) on the identical workload; vs_baseline =
+pipelined-spec / serial-spec throughput, with draft_hidden_frac (how
+much host draft wall time the pipeline hid under device execution) and
+both sides' device_idle_frac reported so the win is measured, not
+asserted.
 
 ``--matmul`` is the reference-vs-Pallas quantized-matmul A/B at the
 headline config: the same workload runs once with
@@ -326,6 +342,11 @@ async def _run(
     }
     spec_proposed = engine.spec_proposed_total
     spec_accepted = engine.spec_accepted_total
+    # overlapped spec pipeline accounting (docs/speculative_decoding.md):
+    # fraction of host draft wall time hidden under device execution
+    hid = engine.spec_draft_hidden_s_total
+    exp = engine.spec_draft_exposed_s_total
+    spec_hidden_frac = round(hid / (hid + exp), 4) if (hid + exp) > 0 else 0.0
     slo_stats = engine.slo.stats()
     # live perf attribution (telemetry/attribution.py): the ledger's
     # rolling window over the run — loss-bucket fractions plus the
@@ -355,6 +376,7 @@ async def _run(
         "roofline": roofline_tput,
         "spec_proposed": spec_proposed,
         "spec_accepted": spec_accepted,
+        "spec_draft_hidden_frac": spec_hidden_frac,
     }
 
 
@@ -410,6 +432,68 @@ def _main_spec_ab(model_cfg, wl) -> None:
         f"# spec A/B: plain={base['tput']:.1f} spec={spec['tput']:.1f} tok/s "
         f"accept={out['config']['accept_rate']:.2%} "
         f"({accepted}/{proposed} drafts)",
+        file=sys.stderr,
+    )
+
+
+def _main_spec_overlap_ab(model_cfg, wl) -> None:
+    """--spec-overlap: the composition A/B (docs/speculative_decoding.md
+    pipelined section). Three runs of the identical workload at
+    decode_steps=1: serial spec (drafting fully exposed as device
+    idle), pipelined spec (drafting hidden under the in-flight verify),
+    and plain overlap (no speculation — the floor the composition must
+    beat for spec to earn its verify rectangle). vs_baseline =
+    pipelined-spec / serial-spec throughput; draft_hidden_frac is the
+    measured fraction of draft wall time the pipeline hid."""
+    serial = asyncio.run(
+        _run(model_cfg, wl, spec=True, decode_steps=1, overlap=False)
+    )
+    piped = asyncio.run(
+        _run(model_cfg, wl, spec=True, decode_steps=1, overlap=True)
+    )
+    plain = asyncio.run(
+        _run(model_cfg, wl, spec=False, decode_steps=1, overlap=True)
+    )
+    prop, acc = piped["spec_proposed"], piped["spec_accepted"]
+    out = {
+        "metric": "engine_spec_overlap_ab_1chip",
+        "value": round(piped["tput"], 2),
+        "unit": "tokens/sec",
+        # pipelined vs serial spec on the identical workload: > 1.0
+        # means the double-buffered schedule converted exposed host
+        # draft time into device work
+        "vs_baseline": round(piped["tput"] / max(serial["tput"], 1e-9), 4),
+        "config": {
+            "model": wl["model_name"],
+            "batch": wl["batch"],
+            "isl": wl["isl"],
+            "osl": wl["osl"],
+            "drafter": os.environ.get("DYN_BENCH_SPEC_DRAFTER", "ngram"),
+            "spec_tokens": int(os.environ.get("DYN_BENCH_SPEC_TOKENS", "4")),
+            "repeat_prompts": os.environ.get("DYN_BENCH_SPEC_REPEAT") == "1",
+            "serial_spec_tok_s": round(serial["tput"], 2),
+            "pipelined_spec_tok_s": round(piped["tput"], 2),
+            "plain_overlap_tok_s": round(plain["tput"], 2),
+            "accept_rate": round(acc / prop, 4) if prop else 0.0,
+            "proposed_tokens": prop,
+            "accepted_tokens": acc,
+            "draft_hidden_frac": piped["spec_draft_hidden_frac"],
+            "serial_device_idle_frac":
+                serial["overlap"]["device_idle_frac"],
+            "pipelined_device_idle_frac":
+                piped["overlap"]["device_idle_frac"],
+            "p99_itl_ms_serial_spec": round(serial["p99_itl_s"] * 1000, 2),
+            "p99_itl_ms_pipelined_spec": round(piped["p99_itl_s"] * 1000, 2),
+            "p99_itl_ms_plain_overlap": round(plain["p99_itl_s"] * 1000, 2),
+        },
+    }
+    print(json.dumps(out))
+    print(
+        f"# spec-overlap A/B: serial-spec={serial['tput']:.1f} "
+        f"pipelined-spec={piped['tput']:.1f} "
+        f"plain-overlap={plain['tput']:.1f} tok/s, "
+        f"accept={out['config']['accept_rate']:.2%}, "
+        f"draft_hidden={piped['spec_draft_hidden_frac']:.2%}",
         file=sys.stderr,
     )
 
@@ -916,12 +1000,20 @@ def _main_sim() -> None:
     )
 
 
-def _sentinel_profile_key(cpu_mode: bool, wl: dict, quick: bool) -> str:
+def _sentinel_profile_key(
+    cpu_mode: bool, wl: dict, quick: bool, spec: bool = True
+) -> str:
     """Baseline entries key on platform + model + quick/full so a CPU
-    CI run never compares against a TPU headline number."""
+    CI run never compares against a TPU headline number. The default
+    (spec+overlap) headline keeps the bare key; the DYN_BENCH_SPEC=0
+    escape hatch gets its own ``-nospec`` profile — the two modes run
+    entirely different step programs (fused windows vs the spec
+    pipeline at decode_steps=1), so comparing across them would make
+    the gate vacuous in one direction and a false alarm in the other."""
     return (
         f"{'cpu' if cpu_mode else 'tpu'}-{wl['model_name']}-"
         f"{'quick' if quick else 'full'}"
+        + ("" if spec else "-nospec")
     )
 
 
@@ -996,15 +1088,21 @@ def _main_sentinel(model_cfg, wl, cpu_mode: bool) -> None:
         # window (the attribution fractions need some steps)
         wl = dict(wl, batch=min(wl["batch"], 2), isl=min(wl["isl"], 16),
                   osl=min(wl["osl"], 16))
-    decode_steps = 4 if quick else None
+    # the sentinel gates the HEADLINE configuration, which defaults to
+    # overlapped speculative decoding at decode_steps=1 (DYN_BENCH_SPEC
+    # escape hatch mirrors the headline's)
+    headline_spec = os.environ.get("DYN_BENCH_SPEC", "1") != "0"
+    decode_steps = 1 if headline_spec else (4 if quick else None)
     path = _sentinel_baseline_path()
     if "--baseline" in argv:
         i = argv.index("--baseline") + 1
         if i >= len(argv) or argv[i].startswith("--"):
             raise SystemExit("--baseline requires a path argument")
         path = argv[i]
-    key = _sentinel_profile_key(cpu_mode, wl, quick)
-    r = asyncio.run(_run(model_cfg, wl, decode_steps=decode_steps))
+    key = _sentinel_profile_key(cpu_mode, wl, quick, spec=headline_spec)
+    r = asyncio.run(_run(
+        model_cfg, wl, spec=headline_spec, decode_steps=decode_steps
+    ))
     attr = r["attribution"]
     measured = {
         "tok_s": r["tput"],
@@ -1098,6 +1196,9 @@ def main() -> None:
     if "--chaos" in sys.argv[1:]:
         _main_chaos_ab(model_cfg, wl)
         return
+    if "--spec-overlap" in sys.argv[1:]:
+        _main_spec_overlap_ab(model_cfg, wl)
+        return
     if "--overlap" in sys.argv[1:]:
         _main_overlap_ab(model_cfg, wl)
         return
@@ -1108,7 +1209,16 @@ def main() -> None:
         _main_kv_dtype_ab(model_cfg, wl)
         return
     headline_overlap = os.environ.get("DYN_BENCH_OVERLAP", "1") != "0"
-    r = asyncio.run(_run(model_cfg, wl, overlap=headline_overlap))
+    # headline default: overlapped speculative decoding over int8 KV —
+    # spec (accepted drafts multiply tokens/step) composed with the
+    # decode pipeline (drafting hidden under the in-flight verify), at
+    # decode_steps=1 (speculation replaces fused windows).
+    # DYN_BENCH_SPEC=0 is the escape hatch back to the window headline.
+    headline_spec = os.environ.get("DYN_BENCH_SPEC", "1") != "0"
+    r = asyncio.run(_run(
+        model_cfg, wl, overlap=headline_overlap, spec=headline_spec,
+        decode_steps=1 if headline_spec else None,
+    ))
     phases = (
         _phase_breakdown(model_cfg, wl, r["kv_dtype"])
         if "--phases" in sys.argv[1:]
@@ -1133,7 +1243,33 @@ def main() -> None:
             "batch": wl["batch"],
             "isl": wl["isl"],
             "osl": wl["osl"],
-            "decode_steps": int(os.environ.get("DYN_BENCH_DECODE_STEPS", "64")),
+            "decode_steps": (
+                1 if headline_spec
+                else int(os.environ.get("DYN_BENCH_DECODE_STEPS", "64"))
+            ),
+            # speculative decoding stanza (docs/speculative_decoding.md):
+            # the headline's spec composition, or enabled=False under
+            # the DYN_BENCH_SPEC=0 escape hatch
+            "spec": (
+                {
+                    "enabled": True,
+                    "drafter": os.environ.get(
+                        "DYN_BENCH_SPEC_DRAFTER", "ngram"
+                    ),
+                    "spec_tokens": int(
+                        os.environ.get("DYN_BENCH_SPEC_TOKENS", "4")
+                    ),
+                    "proposed_tokens": r["spec_proposed"],
+                    "accepted_tokens": r["spec_accepted"],
+                    "accept_rate": (
+                        round(r["spec_accepted"] / r["spec_proposed"], 4)
+                        if r["spec_proposed"] else 0.0
+                    ),
+                    "draft_hidden_frac": r["spec_draft_hidden_frac"],
+                }
+                if headline_spec
+                else {"enabled": False}
+            ),
             # overlapped-pipeline attribution (ISSUE 7): the device-idle
             # share of the measured wall plus per-step overlap stats —
             # movement in the headline number is attributable to the

@@ -89,6 +89,7 @@ from dynamo_tpu.telemetry.instruments import (
     KV_POOL_CACHED_FREE_BLOCKS,
     SPEC_ACCEPT_RATE,
     SPEC_ACCEPTED_TOKENS,
+    SPEC_DRAFT_HIDDEN_FRAC,
     SPEC_PROPOSED_TOKENS,
     SPEC_STEP_SECONDS,
 )
@@ -226,12 +227,24 @@ class JaxEngine:
         # speculative decoding (dynamo_tpu/spec; config.spec_decode)
         self._drafter = None
         self._spec_step_fn: Optional[Callable] = None
+        self._chain_spec_fn: Optional[Callable] = None
         # runtime suspend (degradation ladder rung 2, planner/
         # degradation.py): flipped from the asyncio thread, read by the
         # engine thread each step — a plain bool attr is race-free here
         self.spec_suspended = False
         self.spec_proposed_total = 0  # bench/introspection counters
         self.spec_accepted_total = 0
+        # overlapped spec pipeline accounting (docs/speculative_decoding.md):
+        # wall seconds of host drafting hidden under device execution
+        # (optimistic pre-drafts) vs exposed on the dispatch critical
+        # path (first-step drafts + harvest-time repairs), and how often
+        # the pre-draft's predicted tail matched the realized one.
+        # Engine-thread writes; bench//debug/state read advisorily.
+        self.spec_draft_hidden_s_total = 0.0
+        self.spec_draft_exposed_s_total = 0.0
+        self.spec_predraft_hits = 0
+        self.spec_predraft_misses = 0
+        self.spec_pipeline_steps = 0
         # per-engine token counter (the registry counter is process-
         # global): /debug/state exposes it so `top` can derive tok/s
         # from deltas regardless of SLO configuration
@@ -972,12 +985,11 @@ class JaxEngine:
                     )
                     self.k_cache, self.v_cache = out[-2], out[-1]
                     jax.block_until_ready(self.k_cache)
-        if (
-            self._multi_step_fn is None
-            and self._drafter is None
-            and self._overlap_ok()
-        ):
-            # overlapped decode pipeline variants (docs/performance.md):
+        if self._multi_step_fn is None and self._overlap_ok():
+            # overlapped decode pipeline variants (docs/performance.md)
+            # — warmed on spec engines too: zero-proposal/suspended/
+            # opted-out batches fall back to the plain decode paths, and
+            # an unwarmed chained variant would be a mid-serve compile.
             # the chained dispatch feeds the previous step's DEVICE
             # token column — a committed device array is a different
             # jit signature than host numpy — plus the packed harvest
@@ -1025,15 +1037,20 @@ class JaxEngine:
             # the one compiled variant — verify's sampling machinery is
             # a runtime lax.cond)
             Ssp = self.config.spec_tokens + 1
-            for Bd in decode_buckets:
-                sa = {
-                    "tokens": np.zeros((Bd, Ssp), np.int32),
-                    "positions": np.zeros((Bd, Ssp), np.int32),
-                    "slot_mapping": np.zeros((Bd * Ssp,), np.int32),
-                    "block_tables": np.zeros((Bd, width), np.int32),
-                    "context_lens": np.zeros((Bd,), np.int32),
-                    "draft_lens": np.zeros((Bd,), np.int32),
+
+            def spec_arrays(b: int) -> dict[str, np.ndarray]:
+                return {
+                    "tokens": np.zeros((b, Ssp), np.int32),
+                    "positions": np.zeros((b, Ssp), np.int32),
+                    "slot_mapping": np.zeros((b * Ssp,), np.int32),
+                    "block_tables": np.zeros((b, width), np.int32),
+                    "context_lens": np.zeros((b,), np.int32),
+                    "draft_lens": np.zeros((b,), np.int32),
                 }
+
+            spec_packed: dict[int, Any] = {}
+            for Bd in decode_buckets:
+                sa = spec_arrays(Bd)
                 packed, self.k_cache, self.v_cache = self._spec_step_fn(
                     self.params, self.k_cache, self.v_cache,
                     sa["tokens"], sa["positions"], sa["slot_mapping"],
@@ -1041,6 +1058,43 @@ class JaxEngine:
                     sa["draft_lens"], sampling_for(Bd).arrays,
                 )
                 jax.block_until_ready(packed)
+                spec_packed[Bd] = packed
+            if self._overlap_ok() and self._chain_spec_fn is not None:
+                # pipelined spec variants (docs/speculative_decoding.md):
+                # the verify rectangle fed a DEVICE token column — the
+                # carry chained from the previous step's packed output
+                # is a committed device array, a different jit signature
+                # than host numpy — plus the chain gathers themselves,
+                # including bucket TRANSITIONS for a shrinking
+                # population. An unwarmed variant is a mid-serve
+                # compile, the same gap the decode pipeline's prewarm
+                # closes for plain decode.
+                for Bd in decode_buckets:
+                    # transitions only SHRINK (the pipeline never
+                    # admits; survivors are a subset of the previous
+                    # rows), so growing b_from < Bd pairs are
+                    # unreachable and not worth a compile
+                    for b_from in decode_buckets:
+                        if b_from < Bd:
+                            continue
+                        col = self._chain_spec_fn(
+                            spec_packed[b_from],
+                            np.zeros((Bd, Ssp), np.int32),
+                            np.zeros((Bd,), np.int32),
+                        )
+                        if b_from != Bd:
+                            continue
+                        sa = spec_arrays(Bd)
+                        packed, self.k_cache, self.v_cache = (
+                            self._spec_step_fn(
+                                self.params, self.k_cache, self.v_cache,
+                                col, sa["positions"], sa["slot_mapping"],
+                                sa["block_tables"], sa["context_lens"],
+                                sa["draft_lens"], sampling_for(Bd).arrays,
+                            )
+                        )
+                        jax.block_until_ready(packed)
+                        spec_packed[Bd] = packed
         lasts: dict[int, Any] = {}
         p_nexts: dict[int, Any] = {}
         if self._multi_step_fn is not None:
@@ -1676,9 +1730,9 @@ class JaxEngine:
             speculatively — rejected positions are overwritten by the
             next real append before they can ever be read or
             content-addressed), then on-device rejection sampling
-            (spec/verify.py). Output rides one packed host transfer:
-            [B, S out_tokens | S out_lps | 1 n_emit]."""
-            from dynamo_tpu.spec.verify import verify_tokens
+            (spec/verify.py). Output rides one packed host transfer
+            (verify.pack_spec): [B, S out_tokens | S out_lps | 1 n_emit]."""
+            from dynamo_tpu.spec.verify import pack_spec, verify_tokens
 
             logits_all, k_cache, v_cache = forward(
                 mc, params, k_cache, v_cache, tokens, positions,
@@ -1688,22 +1742,39 @@ class JaxEngine:
             out_toks, out_lps, n_emit = verify_tokens(
                 logits_all, tokens, draft_lens, sampling
             )
-            packed = jnp.concatenate(
-                [
-                    out_toks.astype(jnp.float32),  # exact: vocab < 2^24
-                    out_lps,
-                    n_emit[:, None].astype(jnp.float32),
-                ],
-                axis=1,
-            )
+            packed = pack_spec(out_toks, out_lps, n_emit)
             k_cache, v_cache = pin_caches(k_cache, v_cache)
             packed = jax.lax.with_sharding_constraint(packed, ns_rep2)
             return packed, k_cache, v_cache
+
+        def chain_spec(packed, host_tokens, src_idx):
+            """Next verify step's [B', S] token rectangle for the
+            overlapped spec pipeline: column 0 — each row's CARRY token
+            (the in-flight step's LAST emitted token, out_tokens at
+            n_emit-1) — gathered on device from the packed verify
+            output, columns 1.. the host-proposed drafts. The spec
+            twin of ``chain_next``: the carry never round-trips
+            host<->device between consecutive verify steps, and the
+            gather rebuckets a shrinking population (src_idx maps new
+            rows onto the previous step's rows)."""
+            S_ = host_tokens.shape[1]
+            out_toks = packed[:, :S_].astype(jnp.int32)
+            n_emit = packed[:, 2 * S_].astype(jnp.int32)
+            carry = jnp.take_along_axis(
+                out_toks, jnp.clip(n_emit - 1, 0, S_ - 1)[:, None], axis=1
+            )[:, 0]
+            col = jnp.take(carry, src_idx)
+            return jax.lax.with_sharding_constraint(
+                host_tokens.at[:, 0].set(col), ns_rep2
+            )
 
         self._spec_step_fn = (
             jax.jit(spec_step, donate_argnums=(1, 2))
             if self.config.spec_decode
             else None
+        )
+        self._chain_spec_fn = (
+            jax.jit(chain_spec) if self.config.spec_decode else None
         )
 
         self._multi_step_fn = (
@@ -2279,6 +2350,7 @@ class JaxEngine:
                 )
                 return
             plan.kind = "prefill"  # no fused window: prefill this step
+        spec_fell_through = False
         if (
             plan.kind == "decode"
             and self._drafter is not None
@@ -2287,10 +2359,21 @@ class JaxEngine:
             and not self._spec_divert(plan.decode_seqs)
         ):
             t0 = time.monotonic()
-            if self._run_spec_step(plan.decode_seqs):
-                ENGINE_STEP_SECONDS.labels("spec").observe(
-                    time.monotonic() - t0
-                )
+            if self._overlap_ok() and not self._overlap_divert(
+                plan.decode_seqs
+            ):
+                # overlapped speculative decode (the tentpole of
+                # docs/speculative_decoding.md's pipelined section):
+                # host drafting for step N+1 runs WHILE the device
+                # verifies step N
+                ran = self._spec_pipeline(plan.decode_seqs, plan_ms=plan_ms)
+            else:
+                ran = self._run_spec_step(plan.decode_seqs)
+            if ran:
+                # per-STEP latency histograms are observed inside the
+                # step bodies (_run_spec_step / _finish_spec_record) —
+                # one pipeline call drains many steps, so observing the
+                # whole drain here would poison the spec p99
                 self._trace(
                     "spec", b=len(plan.decode_seqs),
                     ms=round((time.monotonic() - t0) * 1e3, 1),
@@ -2299,15 +2382,23 @@ class JaxEngine:
             # no drafter had a proposal for any row: fall through to the
             # plain 1-token decode step — the [B, K+1] verify rectangle
             # would spend (K+1)x the attention/lm_head work to emit
-            # exactly the same single token per sequence
+            # exactly the same single token per sequence. Take ONE
+            # serial step (not the plain pipeline, which would keep
+            # speculation off for its whole drain) and retry drafting
+            # at the next plan.
+            spec_fell_through = True
         if (
             plan.kind == "decode"
             and self._multi_step_fn is None
-            and self._drafter is None
+            and not spec_fell_through
             and self._overlap_ok()
             and plan.decode_seqs
             and not self._overlap_divert(plan.decode_seqs)
         ):
+            # spec-suspended (degradation rung 2) and opted-out batches
+            # reach here too: the overlapped plain pipeline IS the
+            # literal plain-decode path (bit-identical to serial), so
+            # the opt-out contract holds
             # overlapped single-step decode (docs/performance.md):
             # dispatch N+1 before harvesting N so the TPU never idles
             # for the host's plan+unpack time. --no-overlap restores
@@ -2454,11 +2545,15 @@ class JaxEngine:
             or any(not self._seq_spec_enabled(s) for s in seqs)
         )
 
-    def _run_spec_step(self, seqs: list) -> bool:
+    def _run_spec_step(self, seqs: list, proposals=None) -> bool:
         """One speculative decode step: draft on host, verify on device,
         roll back rejected drafts. Returns False — with NOTHING staged
         and no dispatch made — when no sequence got a proposal, so the
-        caller can run the plain decode step instead.
+        caller can run the plain decode step instead. ``proposals``
+        (aligned with ``seqs``) skips the draft loop — the spec
+        pipeline's block-pressure fallback already drafted this batch,
+        and re-drafting would double both the host cost and the
+        exposed-draft accounting.
 
         Contract with the rest of the engine (this is the part that
         changes the 1-token/seq/step assumption): each sequence emits
@@ -2473,58 +2568,42 @@ class JaxEngine:
         counts and block content-addressing only ever see verified
         tokens, and blocks speculatively grown for draft KV stay
         uncommitted until real tokens fill them."""
-        # lazy: dynamo_tpu.spec imports engine.sampling — a module-level
-        # import here would cycle through the package __init__
-        from dynamo_tpu.spec.verify import harvest_spec_output
-
         sched = self.scheduler
         assert sched is not None and self._spec_step_fn is not None
         assert self._drafter is not None
         S = self.config.spec_tokens + 1
-        t_draft = time.monotonic()
-        # cap the history the drafter sees (Drafter.window, None = all):
-        # a full all_tokens() + full-history scan per sequence per step
-        # is O(context) host work on the serialized engine thread and
-        # grows without bound on long-context serving
-        window = getattr(self._drafter, "window", None)
-        proposals: list[tuple] = []  # (carry token, drafts)
-        for seq in seqs:
-            budget = S - 1
-            if seq.max_new_tokens is not None:
-                # leave room for the verify step's guaranteed +1 token:
-                # drafts past the budget would be discarded by
+        t_step = time.monotonic()
+        draft_s = 0.0
+        if proposals is None:
+            t_draft = time.monotonic()
+            proposals = []
+            for seq in seqs:
+                # budget leaves room for the verify step's guaranteed
+                # +1 token: drafts past it would be discarded by
                 # _emit_window anyway, but their KV writes would still
                 # need blocks the growth reserve never budgeted
-                budget = min(
-                    budget, max(0, seq.max_new_tokens - seq.generated - 1)
+                budget = self._spec_budget(seq)
+                proposals.append(
+                    self._draft_tokens(seq, budget)
+                    if self._seq_spec_enabled(seq)
+                    else []
                 )
-            drafts: list[int] = []
-            carry = None
-            if budget > 0 and self._seq_spec_enabled(seq):
-                # ONE history materialization per sequence per step: the
-                # drafter scan and the carry token both read this copy
-                hist = (
-                    seq.tokens.tail_tokens(window)
-                    if window
-                    else seq.tokens.all_tokens()
-                )
-                carry = hist[-1]
-                drafts = list(self._drafter.propose(hist, budget))[:budget]
-            proposals.append((carry, drafts))
-        # the draft-phase histogram covers PROPOSAL cost only (the
-        # drafter-tuning signal) — staging/array/sampling prep below is
-        # fixed per-step engine work, not drafter work
-        draft_s = time.monotonic() - t_draft
-        SPEC_STEP_SECONDS.labels("draft").observe(draft_s)
-        if not any(d for _, d in proposals):
+            # the draft-phase histogram covers PROPOSAL cost only (the
+            # drafter-tuning signal) — staging/array/sampling prep
+            # below is fixed per-step engine work, not drafter work
+            draft_s = time.monotonic() - t_draft
+            SPEC_STEP_SECONDS.labels("draft").observe(draft_s)
+            self.spec_draft_exposed_s_total += draft_s
+        if not any(proposals):
             return False  # nothing staged: caller runs plain decode
         works: list[tuple] = []
         staged = 0
-        for seq, (carry, drafts) in zip(seqs, proposals):
+        for seq, drafts in zip(seqs, proposals):
+            # carry read BEFORE staging: reserve_spec_tokens appends the
+            # drafts to token state, after which last_token() is a draft
+            carry = seq.tokens.last_token()
             k = sched.reserve_spec_tokens(seq, drafts) if drafts else 0
             staged += k
-            if carry is None:
-                carry = seq.tokens.last_token()
             works.append((seq, [carry] + drafts[:k]))
         if staged == 0:
             # block pressure shrank every row's kept drafts to zero:
@@ -2536,23 +2615,11 @@ class JaxEngine:
         B = arrays["tokens"].shape[0]
         sampling = self._batch_sampling(seqs, B)
         t0 = time.monotonic()
-        self.overlap.note_dispatch()
         try:
-            packed, self.k_cache, self.v_cache = self._spec_step_fn(
-                self.params, self.k_cache, self.v_cache,
-                arrays["tokens"], arrays["positions"],
-                arrays["slot_mapping"], arrays["block_tables"],
-                arrays["context_lens"], arrays["draft_lens"],
-                sampling.arrays,
-            )
-            # harvest_spec_output is the spec path's designated harvest
+            packed = self._dispatch_spec_step(arrays, sampling)
+            # _harvest_spec_step is the spec path's designated harvest
             # point (DL010): the device->host sync happens inside it
-            toks, lps, n_emit = harvest_spec_output(packed, S)
-            self.overlap.note_complete(all_prior=True)
-            # successful host sync: earlier async dispatches are
-            # known-good (in-order execution) — retire deferred-error
-            # forensics or later failures would blame retired chunks
-            self._unsynced_steps.clear()
+            toks, lps, n_emit, _ = self._harvest_spec_step(packed, S)
         except Exception:
             # host token state must not keep staged (unverified) drafts
             # when the step dies — the quarantine retry would otherwise
@@ -2592,7 +2659,438 @@ class JaxEngine:
                 continue
             n = int(n_emit[i])
             self._emit_window(seq, toks[i, :n], lps[i, :n])
+        ENGINE_STEP_SECONDS.labels("spec").observe(time.monotonic() - t_step)
         return True
+
+    def _spec_budget(self, seq: Sequence, lag: int = 0) -> int:
+        """Draft budget for one sequence: spec_tokens, clamped to leave
+        room for the verify step's guaranteed +1 token. ``lag`` shifts
+        the clamp past tokens a harvested-but-not-yet-emitted step will
+        add (the pipelined planner's view of ``generated``)."""
+        budget = self.config.spec_tokens
+        if seq.max_new_tokens is not None:
+            budget = min(
+                budget, max(0, seq.max_new_tokens - seq.generated - lag - 1)
+            )
+        return budget
+
+    def _draft_tokens(self, seq: Sequence, budget: int, suffix=()) -> list:
+        """Proposals for one sequence — through the per-sequence
+        incremental n-gram index when the drafter provides one
+        (``seq.drafter_state``; ``NgramDrafter.make_index``), the plain
+        windowed ``propose`` otherwise. The index appends committed
+        tokens as they arrive and rebuilds only when the sequence
+        SHRANK (unwind/truncation) — the from-scratch tail scan was
+        O(window) host work per row per step. ``suffix`` = tokens that
+        will exist once in-flight emits apply (the pipeline's pre-draft
+        and repair contexts) — proposals are computed as if they were
+        appended, but token state and the index never see them."""
+        d = self._drafter
+        assert d is not None
+        if budget <= 0:
+            return []
+        # cap the history the drafter sees (Drafter.window, None = all):
+        # a full all_tokens() per sequence per step is O(context) host
+        # work on the serialized engine thread
+        window = getattr(d, "window", None)
+        make = getattr(d, "make_index", None)
+        if make is None or not window:
+            hist = (
+                seq.tokens.tail_tokens(window)
+                if window
+                else seq.tokens.all_tokens()
+            )
+            if suffix:
+                hist = hist + [int(t) for t in suffix]
+                if window:
+                    hist = hist[-window:]
+            return list(d.propose(hist, budget))[:budget]
+        T = len(seq.tokens)
+        idx = seq.drafter_state
+        if idx is None or idx.seq_len > T:
+            # first draft, or the sequence shrank: rebuild from the tail
+            idx = make(seq.tokens.tail_tokens(window), T)
+            seq.drafter_state = idx
+        elif idx.seq_len < T:
+            # append what was committed since the last draft (emitted
+            # tokens only: the paths that call this never leave staged
+            # drafts in token state at draft time)
+            idx.extend(seq.tokens.tail_tokens(T - idx.seq_len))
+        return list(idx.propose(budget, suffix))[:budget]
+
+    def _dispatch_spec_step(
+        self, arrays: dict[str, np.ndarray], sampling: SamplingBatch,
+        tokens_dev=None,
+    ):
+        """DISPATCH half of the speculative verify step (the spec twin
+        of ``_dispatch_device_step``): launch the jitted verify, swap
+        the donated caches, and return the packed [B, 2S+1] DEVICE
+        output — no host sync. ``tokens_dev`` feeds the chain_spec'd
+        device token column (the pipelined signature); None feeds the
+        host rectangle. Callers harvest via ``_harvest_spec_step``;
+        between the two, the host is free to emit the previous step and
+        pre-draft the next one while the device verifies this one."""
+        assert self._spec_step_fn is not None
+        idle_gap_s = self.overlap.note_dispatch()
+        t0 = time.monotonic()
+        packed, self.k_cache, self.v_cache = self._spec_step_fn(
+            self.params, self.k_cache, self.v_cache,
+            arrays["tokens"] if tokens_dev is None else tokens_dev,
+            arrays["positions"], arrays["slot_mapping"],
+            arrays["block_tables"], arrays["context_lens"],
+            arrays["draft_lens"], sampling.arrays,
+        )
+        self._last_phases = {
+            "dispatch_ms": round((time.monotonic() - t0) * 1e3, 3),
+            "idle_gap_ms": round(idle_gap_s * 1e3, 3),
+        }
+        self._unsynced_steps.append("spec-verify")
+        del self._unsynced_steps[:-8]  # bounded forensics window
+        return packed
+
+    def _harvest_spec_step(self, packed, S: int) -> tuple:
+        """HARVEST half: the spec path's designated host-sync point
+        (``harvest_spec_output`` does the one device->host read).
+        Returns (toks, lps, n_emit, sync_s)."""
+        from dynamo_tpu.spec.verify import harvest_spec_output
+
+        t0 = time.monotonic()
+        toks, lps, n_emit = harvest_spec_output(packed, S)
+        self.overlap.note_complete(all_prior=True)
+        # successful host sync: earlier async dispatches are known-good
+        # (in-order execution) — retire deferred-error forensics
+        self._unsynced_steps.clear()
+        return toks, lps, n_emit, time.monotonic() - t0
+
+    @staticmethod
+    def _seq_dead(seq: Sequence) -> bool:
+        """Late-detected stop: cancellation or deadline expiry observed
+        after a step that includes the row went in flight."""
+        if seq.is_cancelled and seq.is_cancelled():
+            return True
+        return bool(seq.deadline) and time.monotonic() >= seq.deadline
+
+    def _spec_predraft(self, works: list) -> list:
+        """Optimistic pre-draft for the NEXT verify step, computed
+        while the CURRENT one runs on device (the hidden half of the
+        spec pipeline's draft cost): for each row, predict the bonus
+        token with the drafter itself (suffix = this step's drafts),
+        then propose the next draft run from the predicted full-accept
+        tail — exactly the context the row realizes IF every draft is
+        accepted and the bonus matches the prediction. Returns per-row
+        ``(predicted_bonus, proposals)`` or None when the drafter has
+        no prediction — those rows re-draft at harvest. Host-only:
+        reads token state and the per-sequence index, mutates
+        neither."""
+        out = []
+        for seq, drafts in works:
+            pre = None
+            if self._seq_spec_enabled(seq):
+                guess = self._draft_tokens(seq, 1, suffix=drafts)
+                if guess:
+                    k = len(drafts)
+                    # budget as serial would compute it at the realized
+                    # state (generated advances by k+1 on full accept)
+                    budget = self._spec_budget(seq, k + 1)
+                    pre = (
+                        guess[0],
+                        self._draft_tokens(
+                            seq, budget, suffix=list(drafts) + guess
+                        ),
+                    )
+            out.append(pre)
+        return out
+
+    def _dispatch_spec_entry(
+        self, nxt: dict, plan_ms: float, draft_ms: float, tokens_dev,
+    ) -> dict:
+        """Build sampling and dispatch one pipelined verify step from a
+        ``plan_pipelined_spec`` result; returns the pipeline entry."""
+        works = nxt["works"]
+        B = nxt["arrays"]["context_lens"].shape[0]
+        sampling = self._batch_sampling(
+            [s for s, _ in works], B, offset=nxt["offsets"]
+        )
+        packed = self._dispatch_spec_step(
+            nxt["arrays"], sampling, tokens_dev=tokens_dev
+        )
+        return {
+            "packed": packed,
+            "works": works,
+            "t_disp": time.monotonic(),
+            "plan_ms": plan_ms,
+            "draft_ms": draft_ms,
+            # consumed by _finish_spec_record, not use_phases: at
+            # record time _last_phases belongs to a LATER dispatch
+            "phases": dict(self._last_phases),
+        }
+
+    def _emit_spec_entry(self, entry: dict, toks, lps, n_emit) -> bool:
+        """Apply one harvested verify step to host state — the deferred
+        emit, running while the NEXT step executes on device. Returns
+        True when a late-detected stop DISCARDED a row's tokens (never
+        appended, never content-addressed): the pipeline must then
+        flush so the serial plan()'s reap frees the blocks with nothing
+        in flight. Predicted finishes (max_tokens/model-len/block-cap)
+        emit normally and do NOT flush — the next step excludes those
+        rows, and nothing allocates until after its harvest, so their
+        freed blocks cannot race its writes."""
+        late = False
+        proposed = accepted = emitted = 0
+        for i, (seq, drafts) in enumerate(entry["works"]):
+            if seq.state != SeqState.RUNNING:
+                continue
+            if self._seq_dead(seq):
+                late = True
+                continue
+            # proposed counted ONLY for rows that emit: a discarded
+            # row's drafts counting as proposals-without-acceptances
+            # would bias accept_rate low vs the serial step's books
+            n = int(n_emit[i])
+            proposed += len(drafts)
+            accepted += n - 1
+            emitted += n
+            self._emit_window(seq, toks[i, :n], lps[i, :n])
+        entry["proposed"] = proposed
+        entry["accepted"] = accepted
+        entry["tokens"] = emitted
+        if proposed:
+            SPEC_PROPOSED_TOKENS.labels(self._drafter.kind).inc(proposed)
+            if accepted:
+                SPEC_ACCEPTED_TOKENS.labels(self._drafter.kind).inc(accepted)
+            SPEC_ACCEPT_RATE.set(accepted / proposed)
+            self.spec_proposed_total += proposed
+            self.spec_accepted_total += accepted
+        return late
+
+    def _finish_spec_record(self, entry: dict, sync_s: float) -> None:
+        """Flight-recorder + attribution row for one pipelined spec
+        step (kind "spec", overlapped): exposed draft/plan time rides
+        ``plan_ms`` (the ledger's overlapped branch bills the measured
+        idle gap to plan first), the harvest block is ``sync_ms``, and
+        the hidden pre-draft simply isn't loss — the device was busy
+        under it, so it lands in the device-phase buckets and the
+        fractions still sum to 1.0 by construction."""
+        self.spec_pipeline_steps += 1
+        tot = self.spec_draft_hidden_s_total + self.spec_draft_exposed_s_total
+        if tot > 0:
+            SPEC_DRAFT_HIDDEN_FRAC.set(
+                self.spec_draft_hidden_s_total / tot
+            )
+        dt = time.monotonic() - entry["t_disp"]
+        ENGINE_STEP_SECONDS.labels("spec").observe(dt)
+        # the harvest block is the pipelined analogue of the serial
+        # verify wall (device execution remainder when healthy)
+        SPEC_STEP_SECONDS.labels("verify").observe(sync_s)
+        self._record_step(
+            "spec", dt,
+            batch=len(entry["works"]),
+            tokens=entry.get("tokens", 0),
+            overlapped=True,
+            use_phases=False,  # per-entry stamps below
+            plan_ms=entry["plan_ms"],
+            draft_ms=entry["draft_ms"],
+            sync_ms=round(sync_s * 1e3, 3),
+            spec_proposed=entry.get("proposed", 0),
+            spec_accepted=entry.get("accepted", 0),
+            **entry["phases"],
+        )
+
+    def _spec_pipeline(self, seqs: list, plan_ms: float = 0.0) -> bool:
+        """Overlapped speculative decode — spec (PR 3) composed with
+        the decode pipeline's double-buffering (PR 7), ROADMAP item 2's
+        biggest unplayed lever. The serial spec loop pays host drafting
+        as device idle every step (draft -> dispatch -> harvest ->
+        emit, fully serialized); here the host drafts and plans step
+        N+1 WHILE the device runs step N's verify:
+
+        - at dispatch of step N the host PRE-DRAFTS step N+1 from the
+          *optimistic* all-accepted tail (history + N's drafts + the
+          drafter's own prediction of the bonus token — exactly the
+          post-N history IF every draft is accepted and the bonus
+          matches). At high accept rates most rows realize that tail,
+          and their next proposals are already in hand when N's result
+          lands;
+        - the harvest (the designated sync) reveals each row's realized
+          tail; rows that diverged are RE-DRAFTED from the actual tail
+          at harvest, so the proposal stream is byte-identical to the
+          serial loop's and output stays bit-identical to serial spec —
+          greedy AND seeded-sampled (the sampled realization depends on
+          the proposals, so a cheaper drop-the-drafts repair would
+          break it);
+        - ``plan_pipelined_spec`` mirrors every ``should_finish``
+          condition using the EXACT emitted counts, reserves blocks for
+          the in-flight tokens (up to K+1 per row) plus the next draft
+          run with rollback on ``NoBlocksError``, and never
+          preempts/admits — any irregularity (new arrivals, opt-outs,
+          cancellation, deadline, block pressure, zero proposals)
+          flushes back to the serial planner, the same divert
+          discipline as ``_overlap_divert``;
+        - step N's emit/bookkeeping (append_token, stop checks, block
+          commits, SSE deltas) runs AFTER N+1 is dispatched, so the
+          device-exposed host span between consecutive verifies is
+          repair + plan only — the draft cost is hidden
+          (``dynamo_spec_draft_hidden_frac`` reports how much);
+        - the carry token chains ON DEVICE (``chain_spec``): column 0
+          of N+1's rectangle gathers each row's last emitted token from
+          N's packed output, so consecutive verifies exchange no token
+          values through the host.
+
+        Late-detected stops DISCARD the in-flight tokens for that row
+        at emit and flush the pipeline so plan()'s reap runs with
+        nothing in flight. Unlike the serial step, drafts are never
+        staged into ``seq.tokens`` (array geometry comes from the
+        planner's explicit lags), so a step failure leaves nothing to
+        unwind and the quarantine retry replans from clean host state.
+
+        Returns False — with NOTHING dispatched — when no row has a
+        proposal, so the caller runs the plain step and retries
+        drafting at the next plan."""
+        sched = self.scheduler
+        assert sched is not None and self._chain_spec_fn is not None
+        S = self.config.spec_tokens + 1
+        # first step: serial-style (exposed) draft over clean state
+        t0 = time.monotonic()
+        entries = []
+        for seq in seqs:
+            drafts = (
+                self._draft_tokens(seq, self._spec_budget(seq))
+                if self._seq_spec_enabled(seq)
+                else []
+            )
+            entries.append((seq, 0, drafts))
+        draft_s = time.monotonic() - t0
+        SPEC_STEP_SECONDS.labels("draft").observe(draft_s)
+        if not any(d for _, _, d in entries):
+            return False  # nothing to verify: caller runs plain decode
+        self.spec_draft_exposed_s_total += draft_s
+        t_plan = time.monotonic()
+        nxt = sched.plan_pipelined_spec(entries, S)
+        if nxt is None:
+            # block pressure or another irregularity at entry: the
+            # serial spec step handles it (reserve_spec_tokens shrinks
+            # draft runs instead of flushing) — identical to what a
+            # serial-spec engine does at this state. Hand over the
+            # proposals already drafted above rather than paying the
+            # host scan twice.
+            return self._run_spec_step(
+                seqs, proposals=[d for _, _, d in entries]
+            )
+        if not any(d for _, d in nxt["works"]):
+            return False  # clamping dropped every draft: plain step
+        # first step chains from nothing: host carry column (the
+        # prewarmed serial signature)
+        arrays = nxt["arrays"]
+        for i, (seq, _) in enumerate(nxt["works"]):
+            arrays["tokens"][i, 0] = seq.tokens.last_token()
+        entry = self._dispatch_spec_entry(
+            nxt,
+            plan_ms=plan_ms + round((time.monotonic() - t_plan) * 1e3, 3),
+            draft_ms=round(draft_s * 1e3, 3),
+            tokens_dev=None,
+        )
+        while True:
+            # one logical engine step per turn: the fault point must
+            # see it (docs/robustness.md) — fired BEFORE the pre-draft,
+            # so an injected error propagates with host state only
+            # advanced through the last emit and the quarantine retry
+            # recomputes the abandoned in-flight verify bit-identically
+            faults.fire("engine.step")
+            # ---- device busy: hide the next step's drafting ----
+            t0 = time.monotonic()
+            pres = self._spec_predraft(entry["works"])
+            predraft_s = time.monotonic() - t0
+            SPEC_STEP_SECONDS.labels("predraft").observe(predraft_s)
+            self.spec_draft_hidden_s_total += predraft_s
+            self._drain_incoming_only()
+            # ---- harvest step N (the designated sync) ----
+            toks, lps, n_emit, sync_s = self._harvest_spec_step(
+                entry["packed"], S
+            )
+            # ---- repair + plan + dispatch N+1 (the exposed span) ----
+            # the repair loop is the exposed DRAFT cost (pre-draft
+            # misses re-proposing from the realized tail); the plan +
+            # chain + dispatch below are exposed PLAN cost. The split
+            # matters: draft_hidden_frac compares hidden vs exposed
+            # *drafting* only — folding constant per-step plan time
+            # into it would understate the hiding at high hit rates.
+            t_rep = time.monotonic()
+            entries = []
+            for i, (seq, drafts) in enumerate(entry["works"]):
+                n = int(n_emit[i])
+                emitted = [int(t) for t in toks[i, :n]]
+                pre = pres[i]
+                if (
+                    pre is not None
+                    and n == len(drafts) + 1
+                    and emitted
+                    and emitted[-1] == pre[0]
+                ):
+                    nxt_drafts = pre[1]
+                    self.spec_predraft_hits += 1
+                else:
+                    # realized tail diverged from the optimistic one:
+                    # re-draft from the actual tail so the proposal
+                    # stream stays byte-identical to serial spec
+                    nxt_drafts = self._draft_tokens(
+                        seq, self._spec_budget(seq, n), suffix=emitted
+                    )
+                    self.spec_predraft_misses += 1
+                entries.append((seq, n, nxt_drafts))
+            repair_s = time.monotonic() - t_rep
+            SPEC_STEP_SECONDS.labels("draft").observe(repair_s)
+            self.spec_draft_exposed_s_total += repair_s
+            flush = (
+                bool(sched.waiting)
+                or bool(sched.prefilling)
+                or not self._running
+                or not self._control.empty()
+                # degradation rung 2 (planner/degradation.py) flips
+                # spec_suspended from the loop thread: the serial loop
+                # honors it every plan, so the pipeline must not keep
+                # paying the verify rectangle for a whole batch drain
+                or self.spec_suspended
+            )
+            nxt = None if flush else sched.plan_pipelined_spec(entries, S)
+            if nxt is not None and not any(d for _, d in nxt["works"]):
+                # zero proposals across the batch: the [B, S] rectangle
+                # would pay (K+1)x the work for 1 token/row — flush and
+                # let the next plan take the plain step (no deadlock:
+                # emit below still applies this step's tokens)
+                nxt = None
+            next_entry = None
+            if nxt is not None:
+                tokens_dev = self._chain_spec_fn(
+                    entry["packed"], nxt["arrays"]["tokens"], nxt["src_idx"]
+                )
+                # the attribution ledger's plan_ms carries the WHOLE
+                # exposed host span (repair + plan + chain): its
+                # overlapped branch bills the measured idle gap to plan
+                # first, which is exactly where exposed drafting should
+                # land ("exposed draft stays plan")
+                next_entry = self._dispatch_spec_entry(
+                    nxt,
+                    plan_ms=round((time.monotonic() - t_rep) * 1e3, 3),
+                    draft_ms=round(repair_s * 1e3, 3),
+                    tokens_dev=tokens_dev,
+                )
+            # ---- emit step N under N+1's device time ----
+            late_stop = self._emit_spec_entry(entry, toks, lps, n_emit)
+            self._finish_spec_record(entry, sync_s)
+            if next_entry is None:
+                return True
+            if late_stop:
+                # a stop landed while N+1 was planned: its rows may
+                # include the stopped sequence — harvest it, discard
+                # dead rows' tokens, and return with nothing in flight
+                # so the serial reap frees the blocks safely
+                toks, lps, n_emit, sync_s = self._harvest_spec_step(
+                    next_entry["packed"], S
+                )
+                self._emit_spec_entry(next_entry, toks, lps, n_emit)
+                self._finish_spec_record(next_entry, sync_s)
+                return True
+            entry = next_entry
 
     # ------------------------------------------------------------------
     # Overlapped single-step decode (docs/performance.md)
@@ -3807,10 +4305,26 @@ class JaxEngine:
             out["flight_recorder"] = self.recorder.stats()
             out["recent_steps"] = self.recorder.snapshot(32)
         if self._drafter is not None:
+            hid = self.spec_draft_hidden_s_total
+            exp = self.spec_draft_exposed_s_total
             out["spec"] = {
                 "drafter": getattr(self._drafter, "kind", "?"),
                 "proposed_total": self.spec_proposed_total,
                 "accepted_total": self.spec_accepted_total,
+                # overlapped spec pipeline health (docs/
+                # speculative_decoding.md): how much host draft wall
+                # time the pipeline hid under device execution, and how
+                # often the optimistic pre-draft matched the realized
+                # tail (a miss re-drafts on the exposed critical path)
+                "pipelined": self._overlap_ok(),
+                "pipeline_steps": self.spec_pipeline_steps,
+                "draft_hidden_s": round(hid, 6),
+                "draft_exposed_s": round(exp, 6),
+                "draft_hidden_frac": (
+                    round(hid / (hid + exp), 4) if (hid + exp) > 0 else 0.0
+                ),
+                "predraft_hits": self.spec_predraft_hits,
+                "predraft_misses": self.spec_predraft_misses,
             }
         if sched is not None and alloc is not None:
             out["load"] = self.stats().to_dict()

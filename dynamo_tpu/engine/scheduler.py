@@ -77,6 +77,11 @@ class Sequence:
     # computed once — np.unique over a long prompt must not sit on the
     # per-step host path)
     prompt_unique: Optional[Any] = None
+    # per-sequence drafter state (spec/drafter.py NgramIndex): the
+    # engine keeps the incremental n-gram index here so the per-step
+    # proposal is a hashed lookup instead of an O(window) re-scan;
+    # rebuilt whenever the sequence shrinks (unwind/truncation)
+    drafter_state: Optional[Any] = None
     # request-lifecycle stamps (telemetry): monotonic except the wall
     # anchor; the engine emits queue-wait/prefill/decode spans from
     # these at finish time (engine.py _emit_finish)
@@ -912,6 +917,31 @@ class Scheduler:
             seq.tokens.extend(drafts[:k])
         return max(0, k)
 
+    def _fill_spec_row(
+        self, arrays: dict[str, np.ndarray], i: int, seq: Sequence,
+        base: int, k: int, S: int,
+    ) -> None:
+        """One verify-step row's tensor geometry — THE shared layout
+        for both spec planners (serial ``build_spec_arrays`` over
+        staged drafts, pipelined ``plan_pipelined_spec`` over explicit
+        lags): positions contiguous from the carry token at ``base``,
+        the k+1 real slots resolved through the block table (row pads
+        write the reserved garbage slot 0), ``context_lens`` = real
+        tokens including drafts (= base+1+k). The pipelined path's
+        bit-identity-to-serial contract depends on the two callers
+        producing identical rows for identical states, so the layout
+        lives here and nowhere else."""
+        bs = self.block_size
+        arrays["positions"][i, :] = np.arange(base, base + S)
+        for j in range(k + 1):
+            pos = base + j
+            arrays["slot_mapping"][i * S + j] = (
+                seq.block_table[pos // bs] * bs + pos % bs
+            )
+        arrays["block_tables"][i, : len(seq.block_table)] = seq.block_table
+        arrays["context_lens"][i] = base + 1 + k
+        arrays["draft_lens"][i] = k
+
     def build_spec_arrays(
         self, works: list[tuple[Sequence, list[int]]], S: int
     ) -> dict[str, np.ndarray]:
@@ -926,38 +956,144 @@ class Scheduler:
         prefill kernel derives per-token positions from positions[:, 0])
         but write to the reserved garbage slot 0; context_lens covers
         only real tokens, so attention never reads a pad's KV."""
-        bs = self.block_size
         n = len(works)
         B = self._decode_batch(n)
         max_blocks = max(len(s.block_table) for s, _ in works)
         width = self._table_width(max_blocks)
-        tokens = np.zeros((B, S), np.int32)
-        positions = np.zeros((B, S), np.int32)
-        slot_mapping = np.zeros((B * S,), np.int32)
-        tables = np.zeros((B, width), np.int32)
-        ctx = np.zeros((B,), np.int32)
-        draft_lens = np.zeros((B,), np.int32)
+        arrays = {
+            "tokens": np.zeros((B, S), np.int32),
+            "positions": np.zeros((B, S), np.int32),
+            "slot_mapping": np.zeros((B * S,), np.int32),
+            "block_tables": np.zeros((B, width), np.int32),
+            "context_lens": np.zeros((B,), np.int32),
+            "draft_lens": np.zeros((B,), np.int32),
+            "last_token_idx": np.zeros((B,), np.int32),
+        }
         for i, (seq, row) in enumerate(works):
             k = len(row) - 1
-            base = seq.total_len - k - 1  # position of the carry token
-            tokens[i, : k + 1] = row
-            positions[i, :] = np.arange(base, base + S)
-            for j in range(k + 1):
-                pos = base + j
-                slot_mapping[i * S + j] = (
-                    seq.block_table[pos // bs] * bs + pos % bs
+            # carry position: total_len here INCLUDES the staged drafts
+            base = seq.total_len - k - 1
+            arrays["tokens"][i, : k + 1] = row
+            self._fill_spec_row(arrays, i, seq, base, k, S)
+        return arrays
+
+    def plan_pipelined_spec(
+        self, entries: list, S: int
+    ) -> Optional[dict]:
+        """Plan the NEXT speculative verify step while the PREVIOUS
+        one's emitted tokens are not yet applied to host state (the
+        overlapped spec pipeline, engine._spec_pipeline /
+        docs/speculative_decoding.md).
+
+        ``entries`` is the previous step's row list as
+        ``(seq, lag, drafts)``: ``lag`` = tokens that step emitted for
+        the row (EXACT — the spec pipeline plans between harvest and
+        emit, so unlike ``plan_pipelined_decode`` the in-flight token
+        count is known, 1..K+1), ``drafts`` = the repaired proposals
+        for the next step. Same discipline as the other pipelined
+        planners: sequences that FINISH inside the lag (max_tokens,
+        max_model_len, block-table cap — ``should_finish`` mirrored one
+        emit ahead) are simply not rows of the next step; anything
+        irregular (cancellation, deadline expiry, a non-RUNNING state,
+        block exhaustion) returns None — flush to the serial planner,
+        which admits/preempts/reaps with nothing in flight. This path
+        NEVER preempts. Block growth reserves the row's in-flight
+        tokens plus its draft run (``total_len + lag + k`` — the same
+        coverage ``reserve_spec_tokens`` gives the serial step), with
+        rollback on ``NoBlocksError``. Drafts are clamped to the
+        remaining ``max_tokens`` budget exactly as the serial draft
+        loop clamps them (bit-identity of the proposal stream).
+
+        Returns {"works", "arrays", "src_idx", "offsets"}: ``works`` =
+        (seq, kept_drafts) rows of the next step; ``arrays`` = the
+        verify-step tensors, with token column 0 a placeholder — the
+        engine chains each row's carry token ON DEVICE from the
+        previous step's packed output (``chain_spec``), gathered by
+        ``src_idx`` (= the row's index in ``entries``); ``offsets`` =
+        per-row seed offsets (= lags). Unlike the serial path, nothing
+        is staged into ``seq.tokens`` — array geometry comes from the
+        explicit (lag, drafts) and host token state stays clean for the
+        overlapped emit/bookkeeping.
+        """
+        now = time.monotonic()
+        survivors: list[tuple[int, Sequence, int, list[int]]] = []
+        for row, (seq, gl, drafts) in enumerate(entries):
+            if seq.state != SeqState.RUNNING:
+                return None
+            if seq.is_cancelled and seq.is_cancelled():
+                return None
+            if bool(seq.deadline) and now >= seq.deadline:
+                return None
+            if (
+                seq.max_new_tokens is not None
+                and seq.max_new_tokens - seq.generated <= gl
+            ):
+                continue  # finishes inside the in-flight emit
+            if self.max_model_len and seq.total_len + gl >= self.max_model_len:
+                continue
+            if len(seq.block_table) >= self.allocator.num_blocks - 1:
+                continue  # should_finish's can't-grow-further clause
+            k = len(drafts)
+            if seq.max_new_tokens is not None:
+                # leave room for the verify step's guaranteed +1 token
+                # (the serial draft loop's budget clamp, shifted by lag)
+                k = min(
+                    k, max(0, seq.max_new_tokens - seq.generated - gl - 1)
                 )
-            tables[i, : len(seq.block_table)] = seq.block_table
-            ctx[i] = seq.total_len
-            draft_lens[i] = k
-        return {
-            "tokens": tokens,
-            "positions": positions,
-            "slot_mapping": slot_mapping,
-            "block_tables": tables,
-            "context_lens": ctx,
-            "draft_lens": draft_lens,
+            survivors.append((row, seq, gl, drafts[: min(k, S - 1)]))
+        if not survivors:
+            return None
+        bs = self.block_size
+        added: list[Sequence] = []
+        ok = True
+        for _, seq, gl, drafts in survivors:
+            needed = seq.blocks_needed(seq.total_len + gl + len(drafts), bs)
+            while len(seq.block_table) < needed:
+                try:
+                    seq.block_table.append(self.allocator.allocate_block())
+                    added.append(seq)
+                except NoBlocksError:
+                    ok = False
+                    break
+            if not ok:
+                break
+        if not ok:
+            for seq in reversed(added):
+                self.allocator.free_sequence([seq.block_table.pop()])
+            return None
+        n = len(survivors)
+        B = self._decode_batch(n)
+        max_blocks = max(len(s.block_table) for _, s, _, _ in survivors)
+        width = self._table_width(max_blocks)
+        arrays = {
+            # tokens column 0 = placeholder (device chain fills it)
+            "tokens": np.zeros((B, S), np.int32),
+            "positions": np.zeros((B, S), np.int32),
+            "slot_mapping": np.zeros((B * S,), np.int32),
+            "block_tables": np.zeros((B, width), np.int32),
+            "context_lens": np.zeros((B,), np.int32),
+            "draft_lens": np.zeros((B,), np.int32),
             "last_token_idx": np.zeros((B,), np.int32),
+        }
+        src_idx = np.zeros((B,), np.int32)
+        offsets = [0] * n
+        works: list[tuple[Sequence, list[int]]] = []
+        for i, (row, seq, gl, drafts) in enumerate(survivors):
+            k = len(drafts)
+            # carry position: total_len + lag - 1 (the emit has not yet
+            # applied; same row a serial plan would build post-emit)
+            base = seq.total_len + gl - 1
+            if k:
+                arrays["tokens"][i, 1 : k + 1] = drafts
+            self._fill_spec_row(arrays, i, seq, base, k, S)
+            src_idx[i] = row
+            offsets[i] = gl
+            works.append((seq, drafts))
+        return {
+            "works": works,
+            "arrays": arrays,
+            "src_idx": src_idx,
+            "offsets": offsets,
         }
 
     def _preempt(self, victim: Sequence) -> None:

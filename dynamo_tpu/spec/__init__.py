@@ -17,24 +17,28 @@ Layout:
 - ``verify.py`` — the device-side batched verification (jax) used inside
   the engine's jitted spec step, and the host-side unpack helper.
 
-Engine wiring lives in ``engine/engine.py`` (``_run_spec_step``) and
-``engine/scheduler.py`` (``reserve_spec_tokens`` / ``build_spec_arrays``)
-— see docs/speculative_decoding.md.
+Engine wiring lives in ``engine/engine.py`` (``_run_spec_step`` for the
+serial step, ``_spec_pipeline`` for the overlapped one) and
+``engine/scheduler.py`` (``reserve_spec_tokens`` / ``build_spec_arrays``
+/ ``plan_pipelined_spec``) — see docs/speculative_decoding.md.
 """
 
 from dynamo_tpu.spec.drafter import (
     BigramTableDrafter,
     Drafter,
     NgramDrafter,
+    NgramIndex,
     build_drafter,
 )
-from dynamo_tpu.spec.verify import harvest_spec_output, verify_tokens
+from dynamo_tpu.spec.verify import harvest_spec_output, pack_spec, verify_tokens
 
 __all__ = [
     "BigramTableDrafter",
     "Drafter",
     "NgramDrafter",
+    "NgramIndex",
     "build_drafter",
     "harvest_spec_output",
+    "pack_spec",
     "verify_tokens",
 ]

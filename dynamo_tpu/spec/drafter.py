@@ -67,6 +67,18 @@ class NgramDrafter:
         # sequence per decode step on the serialized engine thread
         self.window = max_window
 
+    def make_index(
+        self, tokens: Sequence[int], seq_len: int
+    ) -> "NgramIndex":
+        """Per-sequence incremental index (engine keeps one on each
+        Sequence): proposals bit-identical to ``propose`` over the same
+        window, without the O(window) re-scan every step. ``tokens`` is
+        the sequence's trailing ``window`` slice, ``seq_len`` its
+        absolute length."""
+        return NgramIndex(
+            self.max_ngram, self.min_ngram, self.window, tokens, seq_len
+        )
+
     def propose(self, token_ids: Sequence[int], k: int) -> list[int]:
         arr = np.asarray(token_ids, dtype=np.int64)
         n_hist = len(arr)
@@ -84,6 +96,152 @@ class NgramDrafter:
             cont = arr[i + n : i + n + k]
             if len(cont):
                 return [int(t) for t in cont]
+        return []
+
+
+class NgramIndex:
+    """Incremental per-sequence occurrence index for :class:`NgramDrafter`.
+
+    The from-scratch matcher re-scans ``tail_tokens(window)`` — O(window
+    × n-gram orders) host work per sequence per decode step, on the
+    serialized engine thread. This index maintains the same answer
+    incrementally: ``extend`` appends accepted tokens (O(orders) per
+    token), ``propose`` answers in O(orders × (suffix + k)) via hashed
+    last-occurrence lookups, and an unwind/truncation (sequence got
+    SHORTER) invalidates the whole index — the engine rebuilds it from
+    the tail (``NgramDrafter.make_index``), which is the rare path.
+
+    Exactness contract (pinned by tests): for any committed history and
+    any ``suffix`` of not-yet-appended tokens,
+
+        index.propose(k, suffix)
+        == drafter.propose((tail_tokens(window) + suffix)[-window:], k)
+
+    i.e. proposals are bit-identical to the from-scratch build over the
+    drafter's bounded window. The pieces that make that hold:
+
+    - per (order n, gram) the map keeps the last TWO occurrence start
+      positions (absolute): the most recent may be the query's own
+      terminal occurrence (excluded, exactly as the scratch scan's
+      ``windows over arr[:-1]`` excludes it) — the previous one then
+      answers;
+    - an occurrence at absolute start ``pos`` is visible only when
+      ``pos >= total_len - window`` (the scratch scan never sees older
+      tokens) and ``pos + n <= total_len - 1`` (a match must have at
+      least one continuation token);
+    - occurrences that touch the ``suffix`` region cannot be in the map
+      (it only indexes committed tokens), so a short linear scan covers
+      the boundary — the suffix is at most K+1 tokens;
+    - the retained token list compacts to the last ``window`` tokens
+      once it doubles, so memory and rebuild cost stay O(window) no
+      matter how long the generation runs.
+    """
+
+    def __init__(
+        self, max_ngram: int, min_ngram: int, window: int,
+        tokens: Sequence[int], seq_len: int,
+    ):
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+        self.window = window
+        # absolute sequence length covered; tokens = its trailing slice
+        self.seq_len = int(seq_len)
+        self.tokens: list[int] = [int(t) for t in tokens]
+        # maps[n]: gram tuple -> (previous_start, last_start), absolute
+        self.maps: dict[int, dict] = {
+            n: {} for n in range(min_ngram, max_ngram + 1)
+        }
+        self._index_from(0)
+
+    @property
+    def _base(self) -> int:
+        """Absolute position of ``self.tokens[0]``."""
+        return self.seq_len - len(self.tokens)
+
+    def _index_from(self, start_rel: int) -> None:
+        base = self._base
+        toks = self.tokens
+        for p in range(start_rel, len(toks)):
+            for n in range(self.min_ngram, min(self.max_ngram, p + 1) + 1):
+                gram = tuple(toks[p + 1 - n : p + 1])
+                m = self.maps[n]
+                prev = m.get(gram)
+                m[gram] = (prev[1] if prev else None, base + p + 1 - n)
+
+    def extend(self, new_tokens: Sequence[int]) -> None:
+        """Append committed tokens (the accepted/emitted ones — never
+        staged drafts) and index the grams they complete."""
+        if not new_tokens:
+            return
+        start_rel = len(self.tokens)
+        self.tokens.extend(int(t) for t in new_tokens)
+        self.seq_len += len(new_tokens)
+        self._index_from(start_rel)
+        if len(self.tokens) > 2 * self.window:
+            # amortized O(1)/token compaction: everything older than the
+            # window is invisible to propose() anyway
+            self.tokens = self.tokens[-self.window:]
+            self.maps = {
+                n: {} for n in range(self.min_ngram, self.max_ngram + 1)
+            }
+            self._index_from(0)
+
+    def _at(self, pos: int, sfx: Sequence[int]):
+        """Conceptual token at absolute ``pos`` over committed+suffix
+        (None when out of range)."""
+        if pos < self.seq_len:
+            rel = pos - self._base
+            return self.tokens[rel] if rel >= 0 else None
+        j = pos - self.seq_len
+        return int(sfx[j]) if j < len(sfx) else None
+
+    def _slice(self, a: int, b: int, sfx: Sequence[int]) -> list[int]:
+        out: list[int] = []
+        for pos in range(a, b):
+            t = self._at(pos, sfx)
+            if t is None:
+                break
+            out.append(int(t))
+        return out
+
+    def propose(self, k: int, suffix: Sequence[int] = ()) -> list[int]:
+        sfx = [int(t) for t in suffix]
+        total = self.seq_len + len(sfx)
+        n_hist = min(self.window, total)  # the scratch scan's length
+        if k <= 0 or n_hist < self.min_ngram + 1:
+            return []
+        w0 = total - n_hist  # first visible absolute start position
+        for n in range(
+            min(self.max_ngram, n_hist - 1), self.min_ngram - 1, -1
+        ):
+            q = tuple(self._slice(total - n, total, sfx))
+            best = -1
+            # committed-region occurrences: hashed last-two lookup. An
+            # entry ends at or before seq_len, so pos + n <= total - 1
+            # holds automatically whenever the suffix is nonempty; with
+            # an empty suffix it exactly excludes the terminal gram.
+            ent = self.maps[n].get(q)
+            if ent is not None:
+                for pos in (ent[1], ent[0]):
+                    if pos is None or pos < w0:
+                        continue
+                    if pos + n <= total - 1:
+                        best = pos
+                        break
+            # boundary/suffix occurrences (start touches the suffix):
+            # not indexable, but the region is at most |sfx| + 1 starts
+            lo = max(w0, self.seq_len - n + 1, 0)
+            for j in range(total - 1 - n, lo - 1, -1):
+                if j <= best:
+                    break  # the map already found something more recent
+                if self._slice(j, j + n, sfx) == list(q):
+                    best = j
+                    break
+            if best < 0:
+                continue
+            cont = self._slice(best + n, best + n + k, sfx)
+            if cont:
+                return cont
         return []
 
 

@@ -167,6 +167,28 @@ def verify_tokens(
     return out_tokens, out_lps, n_emit
 
 
+def pack_spec(
+    out_tokens: jax.Array, out_lps: jax.Array, n_emit: jax.Array
+) -> jax.Array:
+    """One packed [B, 2S+1] device array for the verify step's outputs
+    — ``[S out_tokens | S out_lps | 1 n_emit]`` per row, token ids and
+    emit counts exact in f32 (vocab < 2^24). The twin of the engine's
+    ``pack_pair``: over a tunneled chip every separate device->host
+    read is a full round trip, so the spec harvest syncs exactly one
+    array per step — serial and pipelined alike. ``harvest_spec_output``
+    below is the matching (and only) unpacker; the overlapped spec
+    pipeline additionally gathers the next step's carry column from
+    this layout on device (engine ``chain_spec``)."""
+    return jnp.concatenate(
+        [
+            out_tokens.astype(jnp.float32),
+            out_lps,
+            n_emit[:, None].astype(jnp.float32),
+        ],
+        axis=1,
+    )
+
+
 def harvest_spec_output(
     packed, S: int
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:

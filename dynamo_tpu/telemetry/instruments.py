@@ -101,9 +101,17 @@ SPEC_ACCEPT_RATE = REGISTRY.gauge(
 )
 SPEC_STEP_SECONDS = REGISTRY.histogram(
     "dynamo_spec_step_seconds",
-    "Speculative step latency by phase (host drafting vs device verify)",
-    labels=("phase",),  # draft | verify
+    "Speculative step latency by phase (host drafting vs device verify; "
+    "the overlapped pipeline adds predraft = optimistic drafting hidden "
+    "under device time)",
+    labels=("phase",),  # draft | verify | predraft
     buckets=_STEP_BUCKETS,
+)
+SPEC_DRAFT_HIDDEN_FRAC = REGISTRY.gauge(
+    "dynamo_spec_draft_hidden_frac",
+    "Fraction of host draft wall time the overlapped spec pipeline hid "
+    "under device execution (hidden predraft / (hidden + exposed); "
+    "exposed = first-step drafts + harvest-time repairs)",
 )
 
 # -- KV block manager / transfer plane --------------------------------------

@@ -60,6 +60,11 @@ _REQUIRED_SERIES = [
     "dynamo_roofline_frac",
     "dynamo_tokens_lost_per_s",
     "dynamo_blackbox_dumps_total",
+    # ISSUE 12: the overlapped spec pipeline surface
+    "dynamo_spec_draft_hidden_frac",
+    "dynamo_spec_accept_rate",
+    "dynamo_spec_proposed_tokens_total",
+    "dynamo_spec_accepted_tokens_total",
 ]
 
 

@@ -710,6 +710,15 @@ async def test_e2e_debug_attribution_endpoint_and_metrics_agree():
     service = None
     try:
         await _gen(engine, range(1, 24), max_tokens=16, request_id="attr")
+        # the final harvest's attribution record can trail the stream
+        # close by an engine-thread tick (the pipelines emit before they
+        # record); snapshotting mid-trail compares two different windows
+        # — wait for the ledger to quiesce before fetching
+        await engine.wait_for_state(lambda e: not e.scheduler.has_work)
+        last = -1
+        while engine.attribution.steps_noted != last:
+            last = engine.attribution.steps_noted
+            await asyncio.sleep(0.05)
         service, base = await _start_frontend()
         async with aiohttp.ClientSession() as s:
             async with s.get(f"{base}/debug/attribution") as r:
@@ -852,6 +861,13 @@ def test_sentinel_profile_keys_split_platform_and_tier():
     wl = {"model_name": "tiny"}
     assert bench._sentinel_profile_key(True, wl, True) == "cpu-tiny-quick"
     assert bench._sentinel_profile_key(False, wl, False) == "tpu-tiny-full"
+    # the DYN_BENCH_SPEC=0 escape hatch runs a different step program
+    # (fused windows vs the spec pipeline) — its baseline must not
+    # share a key with the spec headline's
+    assert (
+        bench._sentinel_profile_key(True, wl, True, spec=False)
+        == "cpu-tiny-quick-nospec"
+    )
 
 
 def test_committed_baseline_has_the_ci_profile():
