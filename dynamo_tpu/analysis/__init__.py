@@ -35,6 +35,7 @@ from dynamo_tpu.analysis.findings import (  # noqa: F401
     format_json,
     format_text,
     gating,
+    stale_baseline_entries,
     unsuppressed,
     write_baseline,
 )

@@ -626,6 +626,23 @@ class _CallResolver(ast.NodeVisitor):
                        for m in self.graph.imports)
 
 
+def resolve_name(graph: CallGraph, fn: FunctionInfo, name: str) -> Optional[str]:
+    """Public seam for sibling analyses (jaxsem.py): resolve a dotted
+    call name *as seen from inside ``fn``* — same-frame nested
+    functions, ``self``/``cls`` dispatch, import aliases, class
+    constructors — to a project function qualname, or None. Exactly the
+    resolution the edge builder uses, so a DL2xx rule and the call
+    graph can never disagree about what a call targets."""
+    return _CallResolver(graph, fn)._resolve_call_name(name)
+
+
+def enclosing_class(graph: CallGraph, fn: FunctionInfo) -> Optional[str]:
+    """The class ``self`` refers to inside ``fn`` (walks ``<locals>``
+    closures up to the outermost method) — public twin of the edge
+    builder's own lookup, shared with jaxsem.py."""
+    return _CallResolver(graph, fn)._enclosing_class()
+
+
 def build_callgraph(
     modules: List[Tuple[str, ast.Module]],  # (path, parsed tree)
 ) -> CallGraph:

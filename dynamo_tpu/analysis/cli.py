@@ -26,6 +26,7 @@ from dynamo_tpu.analysis.findings import (
     format_json,
     format_text,
     gating,
+    stale_baseline_entries,
     write_baseline,
 )
 from dynamo_tpu.analysis.program import all_program_rules, get_program_rule
@@ -218,9 +219,17 @@ def cmd_lint(args: Any) -> int:
             print("dynalint: --update-baseline needs --baseline PATH or a "
                   "config `baseline` key", file=sys.stderr)
             return 2
+        # name what the rewrite prunes: the grandfather list must only
+        # ever shrink toward zero, and a silent rewrite hides progress
+        stale = (
+            stale_baseline_entries(findings, baseline_path, root)
+            if baseline_path.exists()
+            else []
+        )
         n = write_baseline(findings, baseline_path, root)
-        print(f"dynalint: baseline written: {n} grandfathered finding(s) "
-              f"-> {baseline_path}", file=sys.stderr)
+        pruned = f", pruned {len(stale)} stale" if stale else ""
+        print(f"dynalint: baseline written: {n} grandfathered finding(s)"
+              f"{pruned} -> {baseline_path}", file=sys.stderr)
         return 0
 
     if args.changed:
@@ -236,6 +245,19 @@ def cmd_lint(args: Any) -> int:
 
     if baseline_path is not None and baseline_path.exists():
         findings = apply_baseline(findings, baseline_path, root)
+        if not args.changed:
+            # a fingerprint matching nothing is a fixed violation whose
+            # grandfather entry lingers; surface it so the backlog list
+            # shrinks monotonically (--changed scopes the report, so
+            # its narrowed view must not cry stale about the rest)
+            stale = stale_baseline_entries(findings, baseline_path, root)
+            for rule, spath, _ in stale[:10]:
+                print(f"dynalint: stale baseline entry: [{rule}] {spath} "
+                      "matches no current finding", file=sys.stderr)
+            if stale:
+                print(f"dynalint: {len(stale)} stale baseline entr"
+                      f"{'y' if len(stale) == 1 else 'ies'} — prune with "
+                      "--update-baseline", file=sys.stderr)
 
     if args.fmt == "json":
         print(format_json(findings))

@@ -14,6 +14,11 @@ Keys (all optional):
                   @thread_affinity decorator; pattern is a bare function
                   name or an fnmatch over qualnames
                   ("pkg.mod:Cls.method")
+  prewarm-functions — extra function names treated as prewarm roots by
+                  prewarm-coverage (DL203) in addition to any function
+                  whose name contains "prewarm"; jitted callables
+                  reachable from the step loop must be referenced from
+                  a prewarm root (or code it reaches)
   baseline      — path (relative to pyproject.toml) of the findings
                   baseline file; listed findings warn instead of gating
                   (see `dynamo-tpu lint --baseline/--update-baseline`)
@@ -36,6 +41,7 @@ DEFAULTS: dict[str, Any] = {
     "hot-functions": [],
     "step-loop-functions": [],
     "affinity-entry-points": [],
+    "prewarm-functions": [],
     "baseline": "",
 }
 

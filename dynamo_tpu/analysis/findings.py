@@ -139,6 +139,26 @@ def write_baseline(
     return len(entries)
 
 
+def stale_baseline_entries(
+    findings: list[Finding], path: Path, root: Optional[Path] = None
+) -> list[tuple[str, str, str]]:
+    """Baseline entries that match NO current finding — the violation
+    was fixed (or the rule/message changed) but the grandfather entry
+    lingers.  The CLI warns about these so the backlog list shrinks
+    monotonically, and ``--update-baseline`` prunes them (it rewrites
+    from live findings, so a stale fingerprint cannot survive)."""
+    try:
+        data = json.loads(path.read_text())
+        entries = {
+            (e["rule"], e["path"], e["message"])
+            for e in data.get("findings", [])
+        }
+    except (OSError, ValueError, KeyError, TypeError):
+        return []
+    live = {_fingerprint(f, root) for f in unsuppressed(findings)}
+    return sorted(entries - live)
+
+
 def apply_baseline(
     findings: list[Finding], path: Path, root: Optional[Path] = None
 ) -> list[Finding]:

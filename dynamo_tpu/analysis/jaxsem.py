@@ -1,0 +1,480 @@
+"""JAX-semantics layer for dynalint: the jit-site inventory the DL2xx
+rules share.
+
+The reference Dynamo's hot-path contracts are enforced by Rust's type
+system; our TPU engine's equivalents are *conventions around jit*:
+
+- a buffer passed in a ``donate_argnums`` position **no longer exists**
+  after the dispatch — the caller must rebind it from the outputs (the
+  engine's ``self.k_cache, self.v_cache = step_fn(...)`` swap idiom);
+- a value landing in a ``static_argnums``/``static_argnames`` slot is a
+  **compile-time constant**: feed it a per-step local and every step
+  silently recompiles; feed it a device array and the call needs a
+  host sync just to hash it;
+- every jitted callable reachable from the step loop must be compiled
+  by ``_prewarm`` — a cold variant is a multi-second mid-serve stall
+  (docs/performance.md).
+
+None of these are visible to Python.  This module builds, once per
+program pass, the inventory those contracts are checked against:
+
+- **sites**: every ``jax.jit(...)`` / ``functools.partial(jax.jit,
+  ...)`` expression in the project — as a decorator, assigned to a
+  ``self.<attr>`` (including the engine's ``jax.jit(f) if cond else
+  None`` and alias ``self._step_fn_mm = self._step_fn`` forms), or
+  bound to a local — resolved to the wrapped function where possible,
+  with parsed ``donate_argnums`` / ``static_argnums`` /
+  ``static_argnames``;
+- **call resolution**: given an ``ast.Call`` inside a function, which
+  jit site (if any) it invokes — through the same name-resolution
+  machinery the call graph uses (``callgraph.resolve_name``), plus the
+  attr/local binding maps the call graph has no notion of;
+- **one-level summaries**: which *parameters* of an ordinary function
+  flow (as bare names) into a donated or static slot of a jit site in
+  its body — so DL201/DL202 see through one wrapper frame
+  (``scatter_blocks(k, v, ...)`` donates its callers' buffers just as
+  surely as ``_scatter`` does).
+
+The inventory is memoized on the :class:`LintProgram` instance, so the
+three DL2xx rules share one build.  Cache correctness is free: this
+file lives in the analysis package, whose source bytes are folded into
+the rule-set signature (``cache._package_hash``) — editing jaxsem.py
+invalidates every cached DL2xx finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from dynamo_tpu.analysis.astutil import dotted_name, walk_in_scope
+from dynamo_tpu.analysis.callgraph import (
+    CallGraph,
+    FunctionInfo,
+    enclosing_class,
+    resolve_name,
+)
+
+
+@dataclass
+class JitSite:
+    """One ``jax.jit`` wrapping in the project."""
+
+    key: str  # stable identity ("qualname" / "cls::attr" / "fn::local")
+    path: str
+    lineno: int
+    kind: str  # "decorator" | "attr" | "local"
+    wrapped: Optional[str]  # wrapped fn qualname (None: lambda/opaque)
+    donate: Tuple[int, ...] = ()
+    static: Tuple[int, ...] = ()
+    static_names: Tuple[str, ...] = ()
+
+    @property
+    def label(self) -> str:
+        """Human name for messages: the bound attr/local for assigned
+        sites, the wrapped function's short name for decorators."""
+        if self.kind == "attr":
+            return "self." + self.key.rsplit("::", 1)[-1]
+        if self.kind == "local":
+            return self.key.rsplit("::", 1)[-1]
+        return self.key.rsplit(":", 1)[-1]
+
+
+@dataclass
+class ParamFlow:
+    """A wrapper parameter that flows into a jit slot one level down."""
+
+    site: JitSite
+    param: str  # the wrapper's parameter name (for kwarg call sites)
+
+
+@dataclass
+class JitInventory:
+    sites: List[JitSite] = field(default_factory=list)
+    by_qualname: Dict[str, JitSite] = field(default_factory=dict)
+    by_attr: Dict[Tuple[str, str], JitSite] = field(default_factory=dict)
+    by_local: Dict[Tuple[str, str], JitSite] = field(default_factory=dict)
+    # wrapper fn qualname -> {param positional index -> flow} (index is
+    # the CALLER-side positional index: ``self`` already stripped)
+    donating_params: Dict[str, Dict[int, ParamFlow]] = field(
+        default_factory=dict
+    )
+    static_params: Dict[str, Dict[int, ParamFlow]] = field(
+        default_factory=dict
+    )
+
+
+# -- jit-expression recognition ------------------------------------------
+
+
+def _resolves_to(imports: Dict[str, str], name: str, full: str) -> bool:
+    """Does ``name``, as written in a module with ``imports``, denote
+    the fully-qualified ``full`` (e.g. "jax.jit")?"""
+    if name == full:
+        return True
+    head, _, rest = name.partition(".")
+    target = imports.get(head)
+    if target is None:
+        return False
+    return (target + ("." + rest if rest else "")) == full
+
+
+def _argnums(node: Optional[ast.AST]) -> Tuple[int, ...]:
+    """donate_argnums/static_argnums literal -> tuple of ints (an int,
+    a tuple/list of ints; anything dynamic degrades to empty — a miss,
+    never a wrong index)."""
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.append(el.value)
+        return tuple(out)
+    return ()
+
+
+def _argnames(node: Optional[ast.AST]) -> Tuple[str, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            el.value
+            for el in node.elts
+            if isinstance(el, ast.Constant) and isinstance(el.value, str)
+        )
+    return ()
+
+
+@dataclass
+class _JitExpr:
+    wrapped: Optional[ast.AST]  # the wrapped-callable expression
+    donate: Tuple[int, ...]
+    static: Tuple[int, ...]
+    static_names: Tuple[str, ...]
+    lineno: int
+
+
+def parse_jit_expr(node: ast.AST, imports: Dict[str, str]) -> Optional[_JitExpr]:
+    """Recognize ``jax.jit``, ``jax.jit(f, ...)`` and
+    ``functools.partial(jax.jit, ...)`` expressions (any import
+    spelling); None for everything else."""
+    if not isinstance(node, ast.Call):
+        # bare `@jax.jit` decorator
+        name = dotted_name(node)
+        if name and _resolves_to(imports, name, "jax.jit"):
+            return _JitExpr(None, (), (), (), getattr(node, "lineno", 1))
+        return None
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    kw = {k.arg: k.value for k in node.keywords if k.arg}
+    if _resolves_to(imports, name, "jax.jit"):
+        wrapped = node.args[0] if node.args else None
+        return _JitExpr(
+            wrapped,
+            _argnums(kw.get("donate_argnums")),
+            _argnums(kw.get("static_argnums")),
+            _argnames(kw.get("static_argnames")),
+            node.lineno,
+        )
+    if _resolves_to(imports, name, "functools.partial") and node.args:
+        inner = dotted_name(node.args[0])
+        if inner and _resolves_to(imports, inner, "jax.jit"):
+            # partial(jax.jit, ...)(f): wrapped supplied by the
+            # decorator context
+            wrapped = node.args[1] if len(node.args) > 1 else None
+            return _JitExpr(
+                wrapped,
+                _argnums(kw.get("donate_argnums")),
+                _argnums(kw.get("static_argnums")),
+                _argnames(kw.get("static_argnames")),
+                node.lineno,
+            )
+    return None
+
+
+def _jit_value_candidates(value: ast.AST) -> Iterator[ast.AST]:
+    """Expressions a jit binding may hide in on an assignment RHS: the
+    value itself, either arm of ``jit(f) if cond else None``, the
+    operands of ``x or jit(f)``."""
+    yield value
+    if isinstance(value, ast.IfExp):
+        yield from _jit_value_candidates(value.body)
+        yield from _jit_value_candidates(value.orelse)
+    elif isinstance(value, ast.BoolOp):
+        for v in value.values:
+            yield from _jit_value_candidates(v)
+
+
+# -- inventory build ------------------------------------------------------
+
+
+def _positional_params(fn: FunctionInfo) -> List[str]:
+    """Caller-visible positional parameter names (``self``/``cls``
+    stripped for methods — call-site index 0 is the first real arg)."""
+    a = fn.node.args
+    names = [p.arg for p in (*a.posonlyargs, *a.args)]
+    if fn.cls is not None and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+def _resolve_wrapped(
+    graph: CallGraph, fn: FunctionInfo, expr: Optional[ast.AST]
+) -> Optional[str]:
+    if expr is None or isinstance(expr, ast.Lambda):
+        return None
+    name = dotted_name(expr)
+    if name is None:
+        return None
+    return resolve_name(graph, fn, name)
+
+
+def build_inventory(graph: CallGraph) -> JitInventory:
+    inv = JitInventory()
+
+    def add(site: JitSite) -> JitSite:
+        inv.sites.append(site)
+        return site
+
+    # pass 1a: decorated functions
+    for qn, fn in graph.functions.items():
+        imports = graph.imports.get(fn.module, {})
+        for deco in getattr(fn.node, "decorator_list", []):
+            je = parse_jit_expr(deco, imports)
+            if je is None:
+                continue
+            inv.by_qualname[qn] = add(
+                JitSite(
+                    key=qn,
+                    path=fn.path,
+                    lineno=fn.lineno,
+                    kind="decorator",
+                    wrapped=qn,
+                    donate=je.donate,
+                    static=je.static,
+                    static_names=je.static_names,
+                )
+            )
+            break
+
+    # pass 1b: jit expressions assigned to attrs / locals
+    aliases: List[Tuple[str, str, str]] = []  # (cls_qn, new_attr, src_attr)
+    for qn, fn in graph.functions.items():
+        imports = graph.imports.get(fn.module, {})
+        for node in walk_in_scope(fn.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            je = None
+            for cand in _jit_value_candidates(node.value):
+                je = parse_jit_expr(cand, imports)
+                if je is not None:
+                    break
+            cls_qn = enclosing_class(graph, fn)
+            if je is None:
+                # alias form: self.Y = self.X where X is a jit attr
+                src = dotted_name(node.value)
+                if cls_qn and src and src.startswith(("self.", "cls.")):
+                    for t in node.targets:
+                        tn = dotted_name(t)
+                        if tn and tn.startswith(("self.", "cls.")):
+                            aliases.append(
+                                (cls_qn, tn.split(".", 1)[1],
+                                 src.split(".", 1)[1])
+                            )
+                continue
+            wrapped = _resolve_wrapped(graph, fn, je.wrapped)
+            for t in node.targets:
+                tn = dotted_name(t)
+                if tn is None:
+                    continue
+                if tn.startswith(("self.", "cls.")) and cls_qn:
+                    attr = tn.split(".", 1)[1]
+                    if "." in attr:
+                        continue
+                    inv.by_attr[(cls_qn, attr)] = add(
+                        JitSite(
+                            key=f"{cls_qn}::{attr}",
+                            path=fn.path,
+                            lineno=node.lineno,
+                            kind="attr",
+                            wrapped=wrapped,
+                            donate=je.donate,
+                            static=je.static,
+                            static_names=je.static_names,
+                        )
+                    )
+                elif "." not in tn:
+                    inv.by_local[(qn, tn)] = add(
+                        JitSite(
+                            key=f"{qn}::{tn}",
+                            path=fn.path,
+                            lineno=node.lineno,
+                            kind="local",
+                            wrapped=wrapped,
+                            donate=je.donate,
+                            static=je.static,
+                            static_names=je.static_names,
+                        )
+                    )
+    # pass 1c: attr aliases share the source site — coverage and
+    # donation semantics follow the CALLABLE, not the binding name
+    for cls_qn, new_attr, src_attr in aliases:
+        src = inv.by_attr.get((cls_qn, src_attr))
+        if src is not None:
+            inv.by_attr.setdefault((cls_qn, new_attr), src)
+
+    # pass 2: one-level wrapper summaries (param -> donated/static slot)
+    for qn, fn in graph.functions.items():
+        params = _positional_params(fn)
+        if not params:
+            continue
+        index_of = {p: i for i, p in enumerate(params)}
+        for node in walk_in_scope(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            site = resolve_call_site(inv, graph, fn, node)
+            if site is None:
+                continue
+            for slot_kind, slots in (("donate", site.donate),
+                                     ("static", site.static)):
+                out = (inv.donating_params if slot_kind == "donate"
+                       else inv.static_params)
+                for i in slots:
+                    if i >= len(node.args):
+                        continue
+                    arg = node.args[i]
+                    if isinstance(arg, ast.Name) and arg.id in index_of:
+                        out.setdefault(qn, {})[index_of[arg.id]] = ParamFlow(
+                            site=site, param=arg.id
+                        )
+            for kwarg in node.keywords:
+                if kwarg.arg in site.static_names and isinstance(
+                    kwarg.value, ast.Name
+                ) and kwarg.value.id in index_of:
+                    inv.static_params.setdefault(qn, {})[
+                        index_of[kwarg.value.id]
+                    ] = ParamFlow(site=site, param=kwarg.value.id)
+    return inv
+
+
+def inventory_of(program) -> JitInventory:
+    """The program's jit inventory, built once and memoized on the
+    LintProgram instance (the three DL2xx rules share it)."""
+    inv = getattr(program, "_jaxsem_inventory", None)
+    if inv is None:
+        inv = build_inventory(program.graph)
+        program._jaxsem_inventory = inv
+    return inv
+
+
+# -- call-site resolution -------------------------------------------------
+
+
+def _attr_site(
+    inv: JitInventory, graph: CallGraph, cls_qn: Optional[str], attr: str
+) -> Optional[JitSite]:
+    """(class, attr) lookup through project-local bases."""
+    seen = set()
+    while cls_qn and cls_qn not in seen:
+        seen.add(cls_qn)
+        site = inv.by_attr.get((cls_qn, attr))
+        if site is not None:
+            return site
+        cls = graph.classes.get(cls_qn)
+        if cls is None or not cls.bases:
+            return None
+        from dynamo_tpu.analysis.callgraph import _resolve_class
+
+        cls_qn = _resolve_class(graph, cls.module, cls.bases[0])
+    return None
+
+
+def resolve_call_site(
+    inv: JitInventory, graph: CallGraph, fn: FunctionInfo, call: ast.Call
+) -> Optional[JitSite]:
+    """The jit site an ``ast.Call`` inside ``fn`` invokes, or None."""
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    parts = name.split(".")
+    if parts[0] in ("self", "cls") and len(parts) == 2:
+        return _attr_site(inv, graph, enclosing_class(graph, fn), parts[1])
+    if len(parts) == 1:
+        # local jit binding — in this frame or an enclosing closure's
+        scope = fn.qualname
+        while True:
+            site = inv.by_local.get((scope, parts[0]))
+            if site is not None:
+                return site
+            if ".<locals>." not in scope:
+                break
+            scope = scope.rsplit(".<locals>.", 1)[0]
+    resolved = resolve_name(graph, fn, name)
+    if resolved is not None:
+        return inv.by_qualname.get(resolved)
+    return None
+
+
+def donated_flows(
+    inv: JitInventory, graph: CallGraph, fn: FunctionInfo, call: ast.Call
+) -> Optional[Tuple[str, Dict[int, JitSite]]]:
+    """(label, {positional index -> site}) for a call that donates —
+    directly a jit site, or through a one-level wrapper summary."""
+    site = resolve_call_site(inv, graph, fn, call)
+    if site is not None and site.donate:
+        return site.label, {i: site for i in site.donate}
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    resolved = resolve_name(graph, fn, name)
+    if resolved is None:
+        return None
+    flows = inv.donating_params.get(resolved)
+    if not flows:
+        return None
+    short = resolved.rsplit(":", 1)[-1]
+    return (
+        short,
+        {i: pf.site for i, pf in flows.items()},
+    )
+
+
+# -- call-argument helpers ------------------------------------------------
+
+
+def effective_positional(
+    call: ast.Call, local_tuples: Dict[str, ast.Tuple]
+) -> List[Optional[ast.AST]]:
+    """Positional argument expressions by index, expanding a leading
+    ``*name`` whose ``name`` is bound to a tuple literal in the same
+    frame (the engine's ``base_args = (params, k, v, ...)`` /
+    ``self._step_fn(*base_args)`` idiom).  An unexpandable ``*arg``
+    yields None placeholders — a miss, never a wrong index."""
+    out: List[Optional[ast.AST]] = []
+    for arg in call.args:
+        if isinstance(arg, ast.Starred):
+            name = dotted_name(arg.value)
+            tup = local_tuples.get(name) if name else None
+            if tup is not None:
+                out.extend(tup.elts)
+            else:
+                return out  # unknown star: later indexes unknowable
+        else:
+            out.append(arg)
+    return out
+
+
+def value_key(expr: ast.AST) -> Optional[str]:
+    """Dataflow key for a donate-position argument: a bare name
+    ("k_cache"), a dotted attribute ("self.k_cache"), or the base of a
+    subscript (donating ``k[0]`` invalidates an element of ``k``)."""
+    if isinstance(expr, ast.Subscript):
+        return value_key(expr.value)
+    return dotted_name(expr)

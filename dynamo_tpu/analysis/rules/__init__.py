@@ -17,13 +17,16 @@ from dynamo_tpu.analysis.rules import (  # noqa: F401
     blocking_async,
     cross_thread,
     dropped_task,
+    dynamic_static,
     hidden_sync,
     host_sync_jit,
+    prewarm_coverage,
     retry_loop,
     swallowed_cancel,
     transitive_blocking,
     transitive_sync,
     unbounded_buffer,
     unclosed_span,
+    use_after_donate,
     wall_clock,
 )

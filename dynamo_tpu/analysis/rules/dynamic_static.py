@@ -1,0 +1,207 @@
+"""DL202 dynamic-static-arg: a per-step / unhashable / device value
+flowing into a jit ``static_argnums``/``static_argnames`` slot.
+
+A static slot is part of the *compile key*: jit hashes the value and
+specializes the program on it.  Three ways to get that wrong, in
+rising order of subtlety:
+
+- **unhashable containers** (a list/dict/set literal, a comprehension)
+  — ``TypeError`` at the first call, or a silent retrace per identity;
+- **device arrays** — a value produced by another jitted call needs a
+  host sync just to hash, and retraces whenever the *content* changes;
+- **per-step values** — a local recomputed each loop iteration
+  (``len(batch)``, a call result) inside step-loop-reachable code:
+  every distinct value silently compiles a new executable, turning the
+  steady-state decode loop into a compile loop (the mid-serve stall
+  DL203's prewarm contract exists to prevent).
+
+Container literals and device-array locals are flagged everywhere —
+they are wrong regardless of context.  Call expressions and
+loop-assigned locals are flagged only in functions carrying the
+**step-loop taint** (reachable from the configured step-loop entry
+points): at init/prewarm time, feeding a computed bucket size to a
+static slot is exactly how AOT warming is supposed to work, so flagging
+it there would be noise.  Like DL201, a one-level wrapper summary sees
+a dynamic value handed to a helper whose parameter lands in a static
+slot one frame down — the message prints the hop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from dynamo_tpu.analysis import jaxsem
+from dynamo_tpu.analysis.program import LintProgram, program_rule
+from dynamo_tpu.analysis.rules.common import dotted_name
+from dynamo_tpu.analysis.astutil import walk_in_scope
+from dynamo_tpu.analysis.taint import format_chain
+
+_UNHASHABLE = (
+    ast.List,
+    ast.Dict,
+    ast.Set,
+    ast.ListComp,
+    ast.DictComp,
+    ast.SetComp,
+    ast.GeneratorExp,
+)
+
+
+def _local_facts(
+    program: LintProgram, fn
+) -> Tuple[Set[str], Set[str], Dict[str, ast.Tuple]]:
+    """(names assigned from jit-site calls, names assigned inside a
+    loop body, same-frame tuple literals) for one function."""
+    inv = jaxsem.inventory_of(program)
+    device_names: Set[str] = set()
+    loop_names: Set[str] = set()
+    tuples: Dict[str, ast.Tuple] = {}
+
+    def scan(body: List[ast.stmt], in_loop: bool) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                # the loop TARGET is the archetypal per-iteration value
+                loop_names.update(
+                    n.id for n in ast.walk(stmt.target)
+                    if isinstance(n, ast.Name)
+                )
+            if isinstance(stmt, ast.Assign):
+                names = [
+                    t.id for t in stmt.targets if isinstance(t, ast.Name)
+                ]
+                for t in stmt.targets:
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        names.extend(
+                            el.id for el in t.elts
+                            if isinstance(el, ast.Name)
+                        )
+                if in_loop:
+                    loop_names.update(names)
+                if isinstance(stmt.value, ast.Call) and jaxsem.resolve_call_site(
+                    inv, program.graph, fn, stmt.value
+                ):
+                    device_names.update(names)
+                if (
+                    isinstance(stmt.value, ast.Tuple)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                ):
+                    tuples[stmt.targets[0].id] = stmt.value
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub:
+                    scan(
+                        sub,
+                        in_loop or isinstance(stmt, (ast.For, ast.AsyncFor,
+                                                     ast.While)),
+                    )
+            for h in getattr(stmt, "handlers", []):
+                scan(h.body, in_loop)
+
+    scan(fn.node.body, False)
+    return device_names, loop_names, tuples
+
+
+def _classify(
+    expr: ast.AST,
+    *,
+    in_step_loop: bool,
+    device_names: Set[str],
+    loop_names: Set[str],
+) -> Optional[str]:
+    """Why this expression must not land in a static slot, or None."""
+    if isinstance(expr, _UNHASHABLE):
+        return (
+            "an unhashable container literal (jit cannot hash it into "
+            "the compile key)"
+        )
+    if isinstance(expr, ast.Name):
+        if expr.id in device_names:
+            return (
+                "a device array (the result of a jitted call — hashing "
+                "it needs a host sync and retraces per value)"
+            )
+        if in_step_loop and expr.id in loop_names:
+            return (
+                "a per-step local (assigned inside a loop — every new "
+                "value silently compiles a new executable)"
+            )
+        return None
+    if in_step_loop and isinstance(expr, ast.Call):
+        return (
+            "computed per call in step-loop-reachable code (every "
+            "distinct value is a silent recompile)"
+        )
+    return None
+
+
+@program_rule(
+    "dynamic-static-arg",
+    "DL202",
+    "a non-compile-time-constant value (per-step local, device array, "
+    "unhashable container) flowing into a jit static_argnums slot",
+)
+def check(program: LintProgram):
+    inv = jaxsem.inventory_of(program)
+    graph = program.graph
+    for qn, fn in graph.functions.items():
+        chain = program.taints.step_loop.get(qn)
+        in_step_loop = chain is not None
+        device_names, loop_names, tuples = _local_facts(program, fn)
+        for node in walk_in_scope(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            # direct jit site, else a one-level wrapper summary
+            site = jaxsem.resolve_call_site(inv, graph, fn, node)
+            via = ""
+            slots: Dict[int, str] = {}
+            names: Dict[str, str] = {}
+            if site is not None and (site.static or site.static_names):
+                label = site.label
+                slots = {i: label for i in site.static}
+                names = {n: label for n in site.static_names}
+            else:
+                name = dotted_name(node.func)
+                resolved = (
+                    jaxsem.resolve_name(graph, fn, name) if name else None
+                )
+                flows = inv.static_params.get(resolved or "", {})
+                if not flows:
+                    continue
+                short = (resolved or "").rsplit(":", 1)[-1]
+                for i, pf in flows.items():
+                    slots[i] = pf.site.label
+                    names[pf.param] = pf.site.label
+                    via = f" (one call level down: `{short}` -> " \
+                          f"`{pf.site.label}`)"
+            args = jaxsem.effective_positional(node, tuples)
+            checks: List[Tuple[ast.AST, str]] = []
+            for i, label in slots.items():
+                if i < len(args) and args[i] is not None:
+                    checks.append((args[i], label))
+            for kw in node.keywords:
+                if kw.arg in names:
+                    checks.append((kw.value, names[kw.arg]))
+            for expr, label in checks:
+                why = _classify(
+                    expr,
+                    in_step_loop=in_step_loop,
+                    device_names=device_names,
+                    loop_names=loop_names,
+                )
+                if why is None:
+                    continue
+                suffix = ""
+                if in_step_loop and chain and len(chain) > 1:
+                    suffix = f" (step-loop chain: {format_chain(chain)})"
+                yield (
+                    fn.path,
+                    expr,
+                    f"static_argnums slot of jitted `{label}`{via} "
+                    f"receives {why}; hoist a genuine constant, or make "
+                    f"the argument a traced (non-static) input{suffix}",
+                )

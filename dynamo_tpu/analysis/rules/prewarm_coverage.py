@@ -1,0 +1,148 @@
+"""DL203 prewarm-coverage: a jitted callable the step loop can reach
+that no ``_prewarm`` path references.
+
+The engine's static-shape discipline (docs/performance.md) promises
+that every jit signature the serve path can hit is compiled at startup
+by ``_prewarm`` — a signature that isn't is a multi-second XLA compile
+in the middle of serving, exactly the TTFT/ITL stall the shape
+bucketing exists to prevent.  That contract has been re-broken by hand
+in almost every pipeline PR (the spec/overlap prewarm patches, the
+PR-12 review's unreachable-prewarm find), because nothing checked it.
+
+This rule checks it mechanically:
+
+1. collect the jit-site inventory (jaxsem.py) — decorated functions
+   and ``self.<attr> = jax.jit(...)`` bindings;
+2. find every site *invoked* from a function carrying the **step-loop
+   taint** (reachable from the configured ``step-loop-functions`` /
+   ``*step_loop*`` entry points along same-context call edges — the
+   PR-8 pass);
+3. find every site *referenced* on a **prewarm path**: any function
+   whose name contains ``prewarm`` (plus config ``prewarm-functions``
+   entries), and everything reachable from those along same-context
+   edges;
+4. a site in (2) but not (3) is a compile-at-serve-time hazard — one
+   finding per jitted callable, anchored at its first step-loop
+   invocation, printing the taint chain that makes it reachable.
+
+The runtime twin is the compile fence (``DYN_COMPILE_FENCE=1``,
+utils/compile_fence.py): a serve-phase XLA compile — i.e. this rule's
+hazard actually firing in production — escalates to a flight-recorder
+``serve_compile`` record and a black-box bundle.  Static rule for the
+PR diff, runtime fence for everything the static view can't see.
+
+Coverage is judged per *callable*, not per jit signature: prewarm
+feeding the right shapes/dtypes through the referenced callable is its
+job (and the fence's to verify), not this rule's.  A deliberately
+cold variant (e.g. a rare diagnostic path) is suppressed in place with
+``# dynalint: disable=prewarm-coverage — why``.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from typing import Dict, List, Set, Tuple
+
+from dynamo_tpu.analysis import jaxsem
+from dynamo_tpu.analysis.astutil import walk_in_scope
+from dynamo_tpu.analysis.callgraph import SAME_CONTEXT, enclosing_class
+from dynamo_tpu.analysis.program import LintProgram, program_rule
+from dynamo_tpu.analysis.taint import format_chain
+
+
+def _prewarm_roots(program: LintProgram) -> Set[str]:
+    extra = set(program.config.get("prewarm-functions", []))
+    roots = set()
+    for qn, fn in program.graph.functions.items():
+        if "prewarm" in fn.name.lower() or fn.name in extra:
+            roots.add(qn)
+    return roots
+
+
+def _reachable(program: LintProgram, roots: Set[str]) -> Set[str]:
+    graph = program.graph
+    seen = set(roots)
+    work = deque(roots)
+    while work:
+        cur = work.popleft()
+        for e in graph.out_edges(cur):
+            if e.kind in SAME_CONTEXT and e.callee not in seen:
+                if e.callee in graph.functions:
+                    seen.add(e.callee)
+                    work.append(e.callee)
+    return seen
+
+
+def _referenced_sites(program: LintProgram, fns: Set[str]) -> Set[str]:
+    """Site keys referenced (called OR mentioned) inside ``fns``."""
+    inv = jaxsem.inventory_of(program)
+    graph = program.graph
+    covered: Set[str] = set()
+    for qn in fns:
+        fn = graph.functions.get(qn)
+        if fn is None:
+            continue
+        cls_qn = enclosing_class(graph, fn)
+        for node in walk_in_scope(fn.node):
+            if isinstance(node, ast.Call):
+                site = jaxsem.resolve_call_site(inv, graph, fn, node)
+                if site is not None:
+                    covered.add(site.key)
+            elif isinstance(node, ast.Attribute) and cls_qn is not None:
+                # a bare mention (`self._step_fn is not None`, passing
+                # the callable along) counts as prewarm awareness
+                site = inv.by_attr.get((cls_qn, node.attr))
+                if site is not None:
+                    covered.add(site.key)
+            elif isinstance(node, ast.Name):
+                site = inv.by_qualname.get(
+                    jaxsem.resolve_name(graph, fn, node.id) or ""
+                )
+                if site is not None:
+                    covered.add(site.key)
+    return covered
+
+
+@program_rule(
+    "prewarm-coverage",
+    "DL203",
+    "a jitted callable reachable from the step loop that no _prewarm "
+    "path references (first serve-time call compiles mid-serve)",
+)
+def check(program: LintProgram):
+    inv = jaxsem.inventory_of(program)
+    graph = program.graph
+    covered = _referenced_sites(
+        program, _reachable(program, _prewarm_roots(program))
+    )
+    # first step-loop invocation per site key, in deterministic order
+    hits: Dict[str, Tuple[str, ast.AST, List[str]]] = {}
+    for qn in sorted(program.taints.step_loop):
+        fn = graph.functions.get(qn)
+        if fn is None:
+            continue
+        chain = program.taints.step_loop[qn]
+        for node in walk_in_scope(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            site = jaxsem.resolve_call_site(inv, graph, fn, node)
+            if site is None or site.key in covered:
+                continue
+            prev = hits.get(site.key)
+            if prev is None or (
+                len(chain) < len(prev[2])
+            ):
+                hits[site.key] = (fn.path, node, chain)
+    for key in sorted(hits):
+        path, node, chain = hits[key]
+        site = next(s for s in inv.sites if s.key == key)
+        yield (
+            path,
+            node,
+            f"jitted `{site.label}` (defined {site.path}:{site.lineno}) "
+            "is invoked on the serve path but referenced by no prewarm "
+            f"function — its first call is a mid-serve XLA compile "
+            f"(step-loop chain: {format_chain(chain)}); warm it in "
+            "_prewarm, or waive a deliberately cold variant in place",
+        )

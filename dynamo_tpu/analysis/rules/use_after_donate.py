@@ -1,0 +1,284 @@
+"""DL201 use-after-donate: reading a buffer after it was passed in a
+``donate_argnums`` position.
+
+``jax.jit(..., donate_argnums=...)`` lets XLA alias an input buffer
+into an output — the KV caches update in place instead of doubling HBM.
+The contract is invisible to Python: after the dispatch the donated
+array object still *looks* alive, but its buffer is gone; touching it
+raises (TPU) or silently reads garbage (some backends/interpret mode).
+The engine's sanctioned pattern is the **swap idiom** — rebind the
+donated names from the call's outputs before anything else reads them:
+
+    self.k_cache, self.v_cache = step_fn(params, self.k_cache,
+                                         self.v_cache, ...)
+    # or, equivalently, via an intermediate:
+    out = step_fn(params, self.k_cache, self.v_cache, ...)
+    self.k_cache, self.v_cache = out[-2], out[-1]
+
+This rule runs a statement-ordered dataflow over every project
+function: an argument in a donated position (a bare name, a
+``self.attr``, or a subscript's base; ``*tuple``-packed argument lists
+are expanded through same-frame tuple literals) is *poisoned* by the
+call and stays poisoned until an assignment rebinds it.  Reads of a
+poisoned value are findings.  Branches are analyzed independently and
+merged conservatively (poisoned-in-either stays poisoned); loop bodies
+get a second pass so loop-carried poison is seen.
+
+Two escalations close the gaps a single frame can't see:
+
+- **one-level inter-procedural**: a call to an ordinary function whose
+  own body passes the corresponding parameter into a donated slot
+  (``scatter_blocks`` -> ``_scatter``) poisons the caller's argument
+  too — the message prints the ``wrapper -> jit`` hop;
+- **attribute carryover** (the dispatch/harvest split): a ``self.``
+  attribute donated and *never rebound in the same function* is
+  reported at the donating call — the next frame to read it (the
+  harvest half, the next step's dispatch) sees a freed buffer, and no
+  intra-frame analysis there can know it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from dynamo_tpu.analysis import jaxsem
+from dynamo_tpu.analysis.program import LintProgram, program_rule
+from dynamo_tpu.analysis.rules.common import dotted_name
+
+
+class _PoisonInfo:
+    __slots__ = ("label", "lineno", "node", "via")
+
+    def __init__(self, label: str, lineno: int, node: ast.AST, via: str):
+        self.label = label  # the donating callable, for the message
+        self.lineno = lineno
+        self.node = node  # the donating call (anchor for carryover)
+        self.via = via  # "" or "wrapper -> jit" hop text
+
+
+class _FunctionScan:
+    def __init__(self, program: LintProgram, fn) -> None:
+        self.program = program
+        self.graph = program.graph
+        self.inv = jaxsem.inventory_of(program)
+        self.fn = fn
+        self.findings: List[Tuple[ast.AST, str]] = []
+        self.local_tuples: Dict[str, ast.Tuple] = {}
+        self._reported: Set[Tuple[int, str]] = set()
+        self._carryover: Dict[str, _PoisonInfo] = {}
+
+    # -- entry -----------------------------------------------------------
+    def run(self) -> List[Tuple[ast.AST, str]]:
+        env: Dict[str, _PoisonInfo] = {}
+        self._exec_body(self.fn.node.body, env)
+        # attribute carryover: donated self-state never rebound here
+        for key, info in env.items():
+            if not key.startswith(("self.", "cls.")):
+                continue
+            if (info.lineno, "carry:" + key) in self._reported:
+                continue
+            self._reported.add((info.lineno, "carry:" + key))
+            self.findings.append(
+                (
+                    info.node,
+                    f"`{key}` is passed in a donated position of jitted "
+                    f"`{info.label}`{info.via} but never rebound in this "
+                    "function — the buffer is freed at dispatch, and the "
+                    "next frame to read the attribute (the harvest half, "
+                    "the next step) gets a deleted buffer; rebind with "
+                    "the swap idiom `a, b = step_fn(a, b, ...)`",
+                )
+            )
+        return self.findings
+
+    # -- statement walk ---------------------------------------------------
+    def _exec_body(self, body: List[ast.stmt], env: Dict) -> bool:
+        """Process statements in order; True when the body *terminates*
+        (return/raise/break/continue on every path) — a terminating
+        branch's poison never reaches the fall-through code."""
+        for stmt in body:
+            if self._exec_stmt(stmt, env):
+                return True
+        return False
+
+    def _exec_stmt(self, stmt: ast.stmt, env: Dict) -> bool:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return False  # nested frames analyze themselves
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._exec_assign(stmt, env)
+        elif isinstance(stmt, ast.If):
+            self._reads(stmt.test, env)
+            then_env, else_env = dict(env), dict(env)
+            then_ends = self._exec_body(stmt.body, then_env)
+            else_ends = self._exec_body(stmt.orelse, else_env)
+            env.clear()
+            if not else_ends:
+                env.update(else_env)
+            if not then_ends:
+                env.update(then_env)  # poisoned-in-either stays poisoned
+            if then_ends and else_ends:
+                return True
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._reads(stmt.iter, env)
+            self._unbind(stmt.target, env)
+            # two passes: the second sees poison carried around the
+            # back edge (donate late in the body, read early next turn)
+            self._exec_body(stmt.body, env)
+            self._unbind(stmt.target, env)
+            self._exec_body(stmt.body, env)
+            self._exec_body(stmt.orelse, env)
+        elif isinstance(stmt, ast.While):
+            self._reads(stmt.test, env)
+            self._exec_body(stmt.body, env)
+            self._reads(stmt.test, env)
+            self._exec_body(stmt.body, env)
+            self._exec_body(stmt.orelse, env)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._reads(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self._unbind(item.optional_vars, env)
+            self._exec_body(stmt.body, env)
+        elif isinstance(stmt, ast.Try):
+            self._exec_body(stmt.body, env)
+            for h in stmt.handlers:
+                self._exec_body(h.body, env)
+            self._exec_body(stmt.orelse, env)
+            self._exec_body(stmt.finalbody, env)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._unbind(t, env)
+        elif isinstance(stmt, (ast.Return, ast.Expr, ast.Raise,
+                               ast.Assert)):
+            for child in ast.iter_child_nodes(stmt):
+                self._reads(child, env)
+                self._poison_calls(child, env)
+            if isinstance(stmt, (ast.Return, ast.Raise)):
+                return True
+        elif isinstance(stmt, (ast.Break, ast.Continue)):
+            return True  # nothing after it in THIS body executes
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._reads(child, env)
+        return False
+
+    def _exec_assign(self, stmt: ast.stmt, env: Dict) -> None:
+        if isinstance(stmt, ast.AugAssign):
+            # x += ... reads the (possibly poisoned) target first
+            self._reads(stmt.target, env)
+            self._reads(stmt.value, env)
+            self._poison_calls(stmt.value, env)
+            self._unbind(stmt.target, env)
+            return
+        value = stmt.value
+        targets = (
+            stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        )
+        if value is not None:
+            self._reads(value, env)
+            self._poison_calls(value, env)
+            # remember same-frame tuple packs for *args expansion
+            if (
+                isinstance(stmt, ast.Assign)
+                and isinstance(value, ast.Tuple)
+                and len(targets) == 1
+                and isinstance(targets[0], ast.Name)
+            ):
+                self.local_tuples[targets[0].id] = value
+        for t in targets:
+            self._unbind(t, env)
+
+    # -- reads / poison ----------------------------------------------------
+    def _reads(self, expr: ast.AST, env: Dict) -> None:
+        """Flag loads of poisoned keys anywhere under ``expr`` (nested
+        function definitions excluded: closures run later, usually
+        after the rebind — the walk prunes their whole subtree, which
+        ``ast.walk`` cannot)."""
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue  # subtree pruned: the closure body never scans
+            stack.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, (ast.Name, ast.Attribute)):
+                continue
+            key = dotted_name(node)
+            if key is None or key not in env:
+                continue
+            # attribute chains report once, at the outermost match
+            info = env.pop(key)
+            if (node.lineno, key) in self._reported:
+                continue
+            self._reported.add((node.lineno, key))
+            self.findings.append(
+                (
+                    node,
+                    f"`{key}` was donated to jitted `{info.label}`"
+                    f"{info.via} on line {info.lineno} and is read here "
+                    "before being rebound — the donated buffer no longer "
+                    "exists after dispatch; rebind it from the call's "
+                    "outputs first (`a, b = step_fn(a, b, ...)`)",
+                )
+            )
+
+    def _poison_calls(self, expr: ast.AST, env: Dict) -> None:
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue  # a closure's calls run later, not here
+            stack.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, ast.Call):
+                continue
+            flows = jaxsem.donated_flows(
+                self.inv, self.graph, self.fn, node
+            )
+            if flows is None:
+                continue
+            label, by_index = flows
+            site = jaxsem.resolve_call_site(
+                self.inv, self.graph, self.fn, node
+            )
+            via = ""
+            if site is None or not site.donate:
+                # one-level wrapper: show the hop
+                first = next(iter(by_index.values()))
+                via = f" (via `{label}` -> `{first.label}`)"
+            args = jaxsem.effective_positional(node, self.local_tuples)
+            for i in by_index:
+                if i >= len(args) or args[i] is None:
+                    continue
+                key = jaxsem.value_key(args[i])
+                if key is None:
+                    continue
+                env[key] = _PoisonInfo(label, node.lineno, node, via)
+
+    def _unbind(self, target: ast.AST, env: Dict) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._unbind(el, env)
+            return
+        if isinstance(target, ast.Starred):
+            self._unbind(target.value, env)
+            return
+        key = jaxsem.value_key(target)
+        if key is not None:
+            env.pop(key, None)
+
+
+@program_rule(
+    "use-after-donate",
+    "DL201",
+    "a buffer read after being passed in a jit donate_argnums position "
+    "(freed at dispatch; rebind via the swap idiom first)",
+)
+def check(program: LintProgram):
+    for fn in program.graph.functions.values():
+        scan = _FunctionScan(program, fn)
+        for node, message in scan.run():
+            yield fn.path, node, message

@@ -49,7 +49,7 @@ from dynamo_tpu.engine.scheduler import (
     StepPlan,
 )
 from dynamo_tpu.models import ModelConfig
-from dynamo_tpu.utils import affinity
+from dynamo_tpu.utils import affinity, compile_fence
 from dynamo_tpu.utils.bucketing import next_bucket
 from dynamo_tpu.models.llama import (
     CACHE_SPEC,
@@ -77,6 +77,7 @@ from dynamo_tpu.telemetry.attribution import (
 )
 from dynamo_tpu.telemetry.hbm import HbmAccountant, tree_bytes
 from dynamo_tpu.telemetry.instruments import (
+    COMPILE_FENCE_EVENTS,
     ENGINE_BATCH_OCCUPANCY,
     ENGINE_COMPILE_EVENTS,
     ENGINE_PREWARM_SECONDS,
@@ -125,6 +126,12 @@ def _register_compile_listener() -> None:
             if "compile" in event:
                 phase = "prewarm" if _initializing_engines > 0 else "serve"
                 ENGINE_COMPILE_EVENTS.labels(phase).inc()
+                # compile fence (DYN_COMPILE_FENCE, docs/static_analysis
+                # .md): the fence keeps its own allowed-window refcount
+                # — _initialize registers it alongside this phase tag —
+                # and collects anything outside it for _record_step to
+                # escalate. Inert unless armed.
+                compile_fence.note_compile(event, duration)
 
         monitoring.register_event_duration_secs_listener(_on_duration)
     except Exception:  # pragma: no cover — older/newer jax without the API
@@ -366,7 +373,11 @@ class JaxEngine:
         _register_compile_listener()
         _initializing_engines += 1
         try:
-            self._initialize_inner()
+            # the prewarm window registers the fence's allowed phase:
+            # everything compiled in here is sanctioned AOT warming;
+            # anything after is a mid-serve compile the fence escalates
+            with compile_fence.allow():
+                self._initialize_inner()
         finally:
             _initializing_engines -= 1
 
@@ -2298,6 +2309,47 @@ class JaxEngine:
             self.blackbox.trigger(f"watchdog:{kind}")
         elif anomaly is not None:
             self.blackbox.trigger(anomaly)
+        self._check_compile_fence(kind)
+
+    def _check_compile_fence(self, kind: str) -> None:
+        """Escalate serve-phase compiles the fence collected since the
+        last step (DYN_COMPILE_FENCE, utils/compile_fence.py): ONE
+        flight-recorder ``serve_compile`` record per drain — the events
+        of a single unprewarmed signature coalesce instead of spamming
+        the ring — plus a black-box bundle (its own rate limit applies)
+        and a hard error under fatal mode."""
+        if not compile_fence.enabled():
+            return
+        events, n_events = compile_fence.drain()
+        if not n_events:
+            return
+        # n_events is the TRUE count; `events` holds at most the
+        # fence's bounded detail window — a retrace storm past the
+        # bound still counts in full
+        COMPILE_FENCE_EVENTS.inc(n_events)
+        total_s = sum(e["duration_ms"] for e in events) / 1e3
+        summary = dict(
+            compiles=n_events,
+            event=events[0]["event"] if events else "<overflowed>",
+            step_kind=kind,
+        )
+        if self.recorder is not None:
+            # record() is watchdog-bearing; a mid-serve compile IS the
+            # anomaly, so let a long one trip the slow-step dump too
+            self.recorder.record("serve_compile", total_s, **summary)
+        self.blackbox.trigger("serve_compile")
+        log.warning(
+            "compile fence: %d serve-phase compile event(s) during a "
+            "%s step (first: %s, %.0f ms total) — an unprewarmed jit "
+            "signature compiled mid-serve",
+            n_events, kind, summary["event"], total_s * 1e3,
+        )
+        if compile_fence.fatal():
+            raise compile_fence.CompileFenceError(
+                f"serve-phase compile under DYN_COMPILE_FENCE=fatal: "
+                f"{n_events} event(s), first {summary['event']!r} "
+                f"during a {kind} step"
+            )
 
     def _one_step(self) -> None:
         sched = self.scheduler
@@ -4296,6 +4348,10 @@ class JaxEngine:
             "enabled": self.config.overlap,
             **self.overlap.stats(),
         }
+        # serve-phase compile fence (DYN_COMPILE_FENCE): mode + lifetime
+        # escalation count, so `top`//debug/state show whether a fenced
+        # worker has compiled anything mid-serve
+        out["compile_fence"] = compile_fence.stats()
         # perf attribution (telemetry/attribution.py): where the decode
         # window's wall time went, the live roofline fraction, and the
         # black-box capture state — what `top`'s ROOF%/LOSS columns read

@@ -11,6 +11,17 @@ buffer. Cache buffers are donated on scatter so the update is in-place.
 Block-id batches are padded to power-of-two buckets so each shape
 compiles once. Block 0 is the engine's garbage block: padding gathers
 read it (discarded) and padding scatters write it (harmless).
+
+Two contracts here are mechanically enforced (docs/static_analysis.md
+"The JAX-semantics layer"): the scatter paths donate their cache
+inputs, so every caller must rebind from the return value — dynalint
+DL201 (`use-after-donate`) follows the donation one wrapper level up
+through :func:`scatter_blocks`'s parameters and flags any read of the
+old references; and each id bucket is its own cache-sized jit program,
+so the engine prewarms the reachable buckets (`_prewarm`'s kvbm loop —
+DL203 `prewarm-coverage` checks the callables are referenced there,
+and `DYN_COMPILE_FENCE=1` catches any bucket prewarm missed at
+runtime).
 """
 
 from __future__ import annotations

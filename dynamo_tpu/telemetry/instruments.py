@@ -74,6 +74,12 @@ ENGINE_PREWARM_SECONDS = REGISTRY.gauge(
     "dynamo_engine_prewarm_seconds",
     "Wall time of the startup AOT prewarm pass",
 )
+COMPILE_FENCE_EVENTS = REGISTRY.counter(
+    "dynamo_compile_fence_events_total",
+    "Serve-phase XLA compile events escalated by the compile fence "
+    "(nonzero only under DYN_COMPILE_FENCE; each one is an unprewarmed "
+    "jit signature compiling mid-serve)",
+)
 ENGINE_REQUESTS_FINISHED = REGISTRY.counter(
     "dynamo_engine_requests_finished_total",
     "Sequences finished by reason",

@@ -1,0 +1,159 @@
+"""Serve-phase compile fence — the runtime twin of dynalint DL203.
+
+DL203 (analysis/rules/prewarm_coverage.py) proves *statically* that
+every jitted callable the step loop reaches is referenced by a prewarm
+path.  What static analysis cannot prove is that prewarm fed each
+callable every *signature* serving will: shapes, dtypes, shardings,
+sampling-feature pytree variants.  The fence closes that gap at
+runtime, in the mold of the affinity sanitizer (utils/affinity.py):
+inert by default, armed by an env var, catching exactly the violations
+the static plane can't see.
+
+Armed with ``DYN_COMPILE_FENCE=1``, every XLA compile event reported by
+``jax.monitoring`` (the PR-2 listener in engine/engine.py) *outside an
+allowed window* is collected here.  The engine drains the pending
+events once per step (``_record_step``) and escalates: one
+flight-recorder ``serve_compile`` record per drain (the compile lands
+on disk with the steps around it), one black-box bundle
+(rate-limited), and a ``dynamo_compile_fence_events_total`` bump.
+``DYN_COMPILE_FENCE=fatal`` additionally raises
+:class:`CompileFenceError` from the drain site — the hard-error mode
+tests use to make an unprewarmed signature impossible to miss.
+
+The **allowed window** is a refcount: the engine's prewarm span
+(``JaxEngine._initialize``) wraps itself in :func:`allow`, registering
+"compiles are sanctioned now" — the same span the PR-2 phase tag calls
+"prewarm".  Anything outside that window is, by definition, a
+mid-serve compile: the multi-second TTFT stall the static-shape
+machinery exists to prevent (docs/performance.md).
+
+Disabled (the default), ``note_compile`` is a single boolean check —
+the serving hot path pays nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+_MAX_PENDING = 64  # bounded by construction (dynalint DL007)
+
+
+class CompileFenceError(RuntimeError):
+    """A serve-phase XLA compile under DYN_COMPILE_FENCE=fatal."""
+
+
+_lock = threading.Lock()
+_mode: Optional[str] = None  # None = re-read env; "off" | "record" | "fatal"
+_allowed = 0  # >0: compiles sanctioned (prewarm window)
+_pending: deque = deque(maxlen=_MAX_PENDING)
+_since_drain = 0  # true violation count since the last drain (the
+# deque bounds the *detail* kept per window, never the count — a
+# retrace storm past _MAX_PENDING events must not undercount)
+_events_total = 0  # lifetime count, survives drains (for /debug/state)
+
+
+def _resolve_mode() -> str:
+    raw = os.environ.get("DYN_COMPILE_FENCE", "").strip().lower()
+    if raw in ("1", "true", "record"):
+        return "record"
+    if raw == "fatal":
+        return "fatal"
+    return "off"
+
+
+def mode() -> str:
+    """The fence mode ("off" | "record" | "fatal"), env-resolved lazily
+    so tests can flip the variable before the engine constructs."""
+    global _mode
+    if _mode is None:
+        _mode = _resolve_mode()
+    return _mode
+
+
+def enabled() -> bool:
+    return mode() != "off"
+
+
+def fatal() -> bool:
+    return mode() == "fatal"
+
+
+def set_mode(value: Optional[str]) -> None:
+    """Test hook: force "off"/"record"/"fatal"; None re-reads the env."""
+    global _mode
+    _mode = value
+
+
+@contextlib.contextmanager
+def allow():
+    """Sanction compiles for the duration of the block (the engine's
+    prewarm window).  Re-entrant across engines: a refcount, like the
+    phase tag's ``_initializing_engines``."""
+    global _allowed
+    with _lock:
+        _allowed += 1
+    try:
+        yield
+    finally:
+        with _lock:
+            _allowed -= 1
+
+
+def note_compile(event: str, duration_s: float) -> None:
+    """Called by the engine's jax.monitoring listener for every compile
+    duration event.  Collects a violation when armed and outside an
+    allowed window; never raises (the listener runs inside XLA)."""
+    global _events_total, _since_drain
+    if not enabled():
+        return
+    with _lock:
+        if _allowed > 0:
+            return
+        _events_total += 1
+        _since_drain += 1
+        _pending.append(
+            {
+                "event": event,
+                "duration_ms": round(duration_s * 1e3, 3),
+                "ts": time.time(),
+            }
+        )
+
+
+def drain() -> Tuple[List[Dict], int]:
+    """Return-and-clear ``(pending events, true violation count)``
+    since the last drain.  The engine calls this once per recorded
+    step and escalates a non-empty result (flight-recorder record +
+    black-box bundle + counter; raise under fatal mode).  The count can
+    exceed ``len(events)``: the detail deque is bounded, the count is
+    not, so a recompile-per-step storm reports its real size."""
+    global _since_drain
+    with _lock:
+        out = list(_pending)
+        _pending.clear()
+        n = _since_drain
+        _since_drain = 0
+    return out, n
+
+
+def stats() -> Dict:
+    with _lock:
+        return {
+            "mode": mode(),
+            "pending": len(_pending),
+            "events_total": _events_total,
+        }
+
+
+def reset() -> None:
+    """Test hook: drop pending events and the counters."""
+    global _events_total, _since_drain
+    with _lock:
+        _pending.clear()
+        _events_total = 0
+        _since_drain = 0

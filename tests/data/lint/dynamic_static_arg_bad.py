@@ -1,0 +1,42 @@
+"""Violating fixture for DL202 dynamic-static-arg: per-step values,
+device arrays, and unhashable containers flowing into jit static slots
+— each one a silent recompile (or TypeError) per step."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(1,), static_argnames=("mode",))
+def bucketed_kernel(x, width, mode="decode"):
+    return x[:width]
+
+
+@jax.jit
+def device_step(x):
+    return x * 2
+
+
+def pad_rows(x, width):
+    # wrapper frame: `width` lands in bucketed_kernel's static slot —
+    # callers one level up inherit the constraint
+    return bucketed_kernel(x, width)
+
+
+def run_step_loop(state):
+    while state.running:
+        batch = state.next_batch()
+        n = len(batch)
+        out = bucketed_kernel(state.x, n)  # VIOLATION: per-step local
+        out = bucketed_kernel(state.x, len(batch))  # VIOLATION: computed per call
+        out = pad_rows(state.x, state.width_of(batch))  # VIOLATION: dynamic, one frame up
+        state.emit(out)
+
+
+def traced_width(state):
+    y = device_step(state.x)
+    return bucketed_kernel(state.x, y)  # VIOLATION: device array as static
+
+
+def unhashable_mode(x):
+    return bucketed_kernel(x, 4, mode=["decode", "prefill"])  # VIOLATION: unhashable

@@ -1,0 +1,51 @@
+"""Violating fixture for DL203 prewarm-coverage: jitted callables the
+step loop reaches that no prewarm path references — each one a
+mid-serve XLA compile on first use."""
+
+import functools
+
+import jax
+
+
+def _step(x):
+    return x + 1
+
+
+def _chain(x, idx):
+    return x[idx]
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def extra_kernel(col):
+    return col * 2
+
+
+@jax.jit
+def pack_pair(a, b):
+    return a, b
+
+
+def dispatch_extra(col):
+    # one frame below the loop: the compile lands here, mid-serve
+    return extra_kernel(col)  # VIOLATION: never prewarmed
+
+
+class Engine:
+    def __init__(self):
+        self.running = True
+        self._step_fn = jax.jit(_step)
+        self._chain_fn = jax.jit(_chain)
+
+    def _prewarm(self):
+        # warms the step... and forgets every other serve-path variant
+        self._step_fn(self.batch)
+
+    def run_step_loop(self):
+        while self.running:
+            out = self._step_fn(self.batch)
+            col = self._chain_fn(out, self.idx)  # VIOLATION: never prewarmed
+            packed = pack_pair(out, col)  # VIOLATION: never prewarmed
+            self.emit(dispatch_extra(packed))
+
+    def emit(self, packed):
+        self.sink(packed)

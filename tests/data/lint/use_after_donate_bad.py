@@ -1,0 +1,51 @@
+"""Violating fixture for DL201 use-after-donate: donated buffers read
+after dispatch — directly, through a wrapper frame, and left poisoned
+across the dispatch/harvest split."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def fused_step(k_cache, v_cache, tokens):
+    return tokens, k_cache + 1, v_cache + 1
+
+
+def scatter_into(k_cache, v_cache, rows):
+    # wrapper frame: params 0/1 land in fused_step's donated slots, so
+    # the CALLER's buffers are gone too (one-level summary)
+    return fused_step(k_cache, v_cache, rows)
+
+
+def direct_read_after_donate(k, v, tokens):
+    out = fused_step(k, v, tokens)
+    stats = k.sum()  # VIOLATION: k was donated, buffer freed
+    return out, stats
+
+
+def partial_rebind(k, v, tokens):
+    # only k is rebound; v stays poisoned
+    _, k, _ = fused_step(k, v, tokens)
+    return k, v.mean()  # VIOLATION: v read after donate
+
+
+def through_wrapper(k, v, rows):
+    packed = scatter_into(k, v, rows)
+    return packed, v.shape  # VIOLATION: donated one call level down
+
+
+class Engine:
+    def __init__(self):
+        self.k_cache = None
+        self.v_cache = None
+        self._step = fused_step
+
+    def dispatch(self, tokens):
+        # the harvest half reads self.k_cache next step — but the swap
+        # idiom was skipped, so the attribute now names a freed buffer
+        out = fused_step(self.k_cache, self.v_cache, tokens)  # VIOLATION ×2: never rebound
+        return out[0]
+
+    def harvest(self, handle):
+        return handle

@@ -1,0 +1,54 @@
+"""Clean fixture for DL201: every donated buffer is rebound from the
+call's outputs before anything reads it — the engine's swap idiom, its
+intermediate-tuple variant, the ``*packed-args`` form, and a wrapper
+whose caller swaps."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def fused_step(k_cache, v_cache, tokens):
+    return tokens, k_cache + 1, v_cache + 1
+
+
+def scatter_into(k_cache, v_cache, rows):
+    # donates its callers' buffers one level down; callers must swap
+    return fused_step(k_cache, v_cache, rows)
+
+
+def swap_idiom(k, v, tokens):
+    toks, k, v = fused_step(k, v, tokens)
+    return toks, k.shape, v.shape  # rebound: reads are the NEW buffers
+
+
+def intermediate_then_swap(k, v, tokens):
+    out = fused_step(k, v, tokens)
+    k, v = out[-2], out[-1]
+    return out[0], k, v
+
+
+def wrapper_caller_swaps(k, v, rows):
+    _, k, v = scatter_into(k, v, rows)
+    return k.sum() + v.sum()
+
+
+def branch_returns(k, v, quantized, rows):
+    if quantized:
+        # this arm's donation never reaches the fall-through read
+        return scatter_into(k, v, rows)
+    return k, v
+
+
+class Engine:
+    def __init__(self):
+        self.k_cache = None
+        self.v_cache = None
+
+    def dispatch(self, tokens):
+        # the sanctioned swap: attributes rebound in the same statement,
+        # with the argument list packed through a same-frame tuple
+        base_args = (self.k_cache, self.v_cache, tokens)
+        toks, self.k_cache, self.v_cache = fused_step(*base_args)
+        return toks

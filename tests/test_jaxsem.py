@@ -1,0 +1,535 @@
+"""JAX-semantics analysis (ISSUE 13): the jit-site inventory
+(analysis/jaxsem.py), targeted DL201/DL202/DL203 behaviors beyond the
+fixture pairs, the DL2xx cache self-invalidation regression, and the
+compile fence — units plus the e2e acceptance case (an unprewarmed
+shape under DYN_COMPILE_FENCE=1 produces exactly one flight-recorder
+``serve_compile`` record and one black-box bundle; a prewarmed run
+produces none)."""
+
+import ast
+import glob
+import os
+import textwrap
+
+import pytest
+
+from dynamo_tpu.analysis import jaxsem, load_config
+from dynamo_tpu.analysis.callgraph import build_callgraph
+from dynamo_tpu.analysis.findings import format_text
+from dynamo_tpu.analysis.program import get_program_rule
+from dynamo_tpu.analysis.walker import lint_sources_program
+from dynamo_tpu.utils import compile_fence
+
+MODEL_DIR = os.path.join(
+    os.path.dirname(__file__), "data", "tiny_llama_model"
+)
+
+
+def _inventory(source: str, path: str = "mod.py") -> jaxsem.JitInventory:
+    graph = build_callgraph([(path, ast.parse(textwrap.dedent(source)))])
+    return jaxsem.build_inventory(graph)
+
+
+def _run(rule: str, source: str, config=None):
+    return lint_sources_program(
+        {"mod.py": textwrap.dedent(source)},
+        rules=[get_program_rule(rule)],
+        config=config,
+    )
+
+
+# ---------------------------------------------------------------------------
+# jit-site inventory
+# ---------------------------------------------------------------------------
+
+
+def test_inventory_decorator_forms():
+    inv = _inventory(
+        """
+        import functools
+        import jax
+        from jax import jit as jjit
+
+        @jax.jit
+        def plain(x):
+            return x
+
+        @jjit
+        def aliased(x):
+            return x
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1),
+                           static_argnums=2,
+                           static_argnames=("mode", "width"))
+        def fused(k, v, size, mode="d", width=8):
+            return k
+        """
+    )
+    assert set(inv.by_qualname) == {"mod:plain", "mod:aliased", "mod:fused"}
+    fused = inv.by_qualname["mod:fused"]
+    assert fused.donate == (0, 1)
+    assert fused.static == (2,)
+    assert fused.static_names == ("mode", "width")
+    assert fused.kind == "decorator" and fused.wrapped == "mod:fused"
+
+
+def test_inventory_attr_local_conditional_and_alias_bindings():
+    inv = _inventory(
+        """
+        import jax
+
+        def _step(k, v, t):
+            return t, k, v
+
+        def _window(k, v, t):
+            return t, k, v
+
+        class Engine:
+            def build(self, multi):
+                self._step_fn = jax.jit(_step, donate_argnums=(0, 1))
+                self._window_fn = (
+                    jax.jit(_window, donate_argnums=(0, 1))
+                    if multi else None
+                )
+                self._step_fn_mm = self._step_fn  # alias
+                local = jax.jit(_step)
+                return local
+        """
+    )
+    step = inv.by_attr[("mod:Engine", "_step_fn")]
+    assert step.donate == (0, 1) and step.wrapped == "mod:_step"
+    # the `jit(...) if cond else None` arm is still a binding
+    window = inv.by_attr[("mod:Engine", "_window_fn")]
+    assert window.donate == (0, 1) and window.wrapped == "mod:_window"
+    # alias shares the SOURCE site (coverage follows the callable)
+    assert inv.by_attr[("mod:Engine", "_step_fn_mm")] is step
+    assert ("mod:Engine.build", "local") in inv.by_local
+
+
+def test_inventory_one_level_param_summaries():
+    inv = _inventory(
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1),
+                           static_argnums=(3,))
+        def _scatter(k, v, rows, block_size):
+            return k, v
+
+        def scatter_blocks(k, v, rows, block_size):
+            return _scatter(k, v, rows, block_size)
+        """
+    )
+    donating = inv.donating_params["mod:scatter_blocks"]
+    assert set(donating) == {0, 1}
+    assert donating[0].site.key == "mod:_scatter"
+    static = inv.static_params["mod:scatter_blocks"]
+    assert set(static) == {3} and static[3].param == "block_size"
+
+
+def test_effective_positional_expands_same_frame_tuple():
+    tree = ast.parse("base = (a, b, c)\nfn(*base, d)")
+    tup = tree.body[0].value
+    call = tree.body[1].value
+    args = jaxsem.effective_positional(call, {"base": tup})
+    assert len(args) == 4
+    assert [getattr(a, "id", None) for a in args] == ["a", "b", "c", "d"]
+    # unexpandable star: later indexes are unknowable, never wrong
+    assert jaxsem.effective_positional(call, {}) == []
+
+
+# ---------------------------------------------------------------------------
+# DL201 behaviors beyond the fixture pair
+# ---------------------------------------------------------------------------
+
+_DONATING_PRELUDE = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(k, v, t):
+        return t, k, v
+"""
+
+
+def test_dl201_loop_carried_poison_is_seen():
+    findings = _run(
+        "use-after-donate",
+        _DONATING_PRELUDE
+        + """
+        def loop(k, v, batches):
+            for t in batches:
+                out = k.mean()   # second iteration reads donated k
+                _ = step(k, v, t)
+            return out
+        """,
+    )
+    # two loop-carried bugs: iteration 2 reads donated k AND re-passes
+    # donated v into the next dispatch
+    assert len(findings) == 2, format_text(findings)
+    assert {"`k`" in f.message or "`v`" in f.message
+            for f in findings} == {True}
+
+
+def test_dl201_branch_rebind_in_both_arms_is_clean():
+    findings = _run(
+        "use-after-donate",
+        _DONATING_PRELUDE
+        + """
+        def both(k, v, t, flag):
+            if flag:
+                _, k, v = step(k, v, t)
+            else:
+                _, k, v = step(k, v, t + 1)
+            return k, v
+        """,
+    )
+    assert findings == [], format_text(findings)
+
+
+def test_dl201_rebind_in_one_arm_only_still_poisons():
+    findings = _run(
+        "use-after-donate",
+        _DONATING_PRELUDE
+        + """
+        def one_arm(k, v, t, flag):
+            if flag:
+                _, k, v = step(k, v, t)
+            else:
+                step(k, v, t)
+            return k
+        """,
+    )
+    assert len(findings) == 1, format_text(findings)
+
+
+def test_dl201_closure_reads_and_calls_are_not_this_frame():
+    # a lambda/def body runs LATER (usually after the rebind): neither
+    # its reads nor its donating calls belong to this frame's dataflow
+    findings = _run(
+        "use-after-donate",
+        _DONATING_PRELUDE
+        + """
+        def callback_capture(k, v, t):
+            out = step(k, v, t)
+            cb = lambda: k.shape      # runs after the rebind below
+            def later():
+                return step(k, v, t)  # not dispatched here
+            _, k, v = out
+            return cb, later
+        """,
+    )
+    assert findings == [], format_text(findings)
+
+
+def test_dl201_starred_tuple_args_analyze_like_explicit():
+    findings = _run(
+        "use-after-donate",
+        _DONATING_PRELUDE
+        + """
+        def packed(k, v, t):
+            base = (k, v, t)
+            out = step(*base)
+            return out, k     # k was donated through *base
+        """,
+    )
+    assert len(findings) == 1, format_text(findings)
+    assert "`k`" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# DL202 behaviors beyond the fixture pair
+# ---------------------------------------------------------------------------
+
+_STATIC_PRELUDE = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def kernel(x, width):
+        return x[:width]
+"""
+
+
+def test_dl202_call_expression_only_flags_in_step_loop_context():
+    clean = _run(
+        "dynamic-static-arg",
+        _STATIC_PRELUDE
+        + """
+        def prewarm(state, widths):
+            for w in widths:
+                kernel(state.x, int(w))   # init-time: sanctioned
+        """,
+    )
+    assert clean == [], format_text(clean)
+    hot = _run(
+        "dynamic-static-arg",
+        _STATIC_PRELUDE
+        + """
+        def run_step_loop(state):
+            while state.running:
+                kernel(state.x, int(state.n))
+        """,
+    )
+    assert len(hot) == 1, format_text(hot)
+    assert "per call" in hot[0].message
+
+
+def test_dl202_for_loop_target_is_a_per_step_local():
+    findings = _run(
+        "dynamic-static-arg",
+        _STATIC_PRELUDE
+        + """
+        def run_step_loop(state):
+            for width in state.widths:
+                kernel(state.x, width)
+        """,
+    )
+    assert len(findings) == 1, format_text(findings)
+    assert "per-step local" in findings[0].message
+
+
+def test_dl202_device_array_flags_everywhere():
+    findings = _run(
+        "dynamic-static-arg",
+        _STATIC_PRELUDE
+        + """
+        import jax
+
+        @jax.jit
+        def produce(x):
+            return x
+
+        def anywhere(x):
+            y = produce(x)
+            return kernel(x, y)
+        """,
+    )
+    assert len(findings) == 1, format_text(findings)
+    assert "device array" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# DL203 behaviors beyond the fixture pair
+# ---------------------------------------------------------------------------
+
+
+def test_dl203_alias_reference_counts_as_coverage():
+    # prewarm references the SOURCE binding; the loop invokes the alias
+    findings = _run(
+        "prewarm-coverage",
+        """
+        import jax
+
+        def _step(x):
+            return x
+
+        class Engine:
+            def __init__(self):
+                self._step_fn = jax.jit(_step)
+                self._step_fn_mm = self._step_fn
+
+            def _prewarm(self):
+                self._step_fn(0)
+
+            def run_step_loop(self):
+                while True:
+                    self._step_fn_mm(0)
+        """,
+    )
+    assert findings == [], format_text(findings)
+
+
+def test_dl203_config_prewarm_functions_extends_roots():
+    src = """
+        import jax
+
+        def _step(x):
+            return x
+
+        class Engine:
+            def __init__(self):
+                self._step_fn = jax.jit(_step)
+
+            def warm_everything(self):
+                self._step_fn(0)
+
+            def run_step_loop(self):
+                while True:
+                    self._step_fn(0)
+        """
+    # no *prewarm* name anywhere: uncovered
+    findings = _run("prewarm-coverage", src)
+    assert len(findings) == 1, format_text(findings)
+    assert "mid-serve" in findings[0].message
+    # config names the oddly-named warmer as a root
+    cfg = dict(load_config(start="."))
+    cfg["prewarm-functions"] = ["warm_everything"]
+    assert _run("prewarm-coverage", src, config=cfg) == []
+
+
+# ---------------------------------------------------------------------------
+# cache: DL2xx findings invalidate when jaxsem.py itself changes
+# ---------------------------------------------------------------------------
+
+
+def test_rule_signature_folds_in_jaxsem_source(tmp_path, monkeypatch):
+    """ISSUE 13 satellite: the ruleset-signature self-invalidation
+    (cache._package_hash hashes the analysis package's own sources)
+    must cover the NEW module — editing jaxsem.py has to invalidate
+    every cached DL2xx finding without a version knob."""
+    from dynamo_tpu.analysis import cache as cache_mod
+    from dynamo_tpu.analysis.cache import LintCache, rule_signature
+
+    # the real package hash walks a file set that includes jaxsem.py
+    real_pkg = os.path.dirname(cache_mod.__file__)
+    walked = {os.path.basename(str(p))
+              for p in __import__("pathlib").Path(real_pkg).rglob("*.py")}
+    assert "jaxsem.py" in walked
+
+    # end-to-end on a fake package: same walk, jaxsem.py edited between
+    pkg = tmp_path / "analysis"
+    pkg.mkdir()
+    (pkg / "jaxsem.py").write_text("INVENTORY = 1\n")
+    monkeypatch.setattr(cache_mod, "__file__", str(pkg / "cache.py"))
+    monkeypatch.setattr(cache_mod, "_pkg_hash", None)
+    rules = ["use-after-donate", "dynamic-static-arg", "prewarm-coverage"]
+    sig_v1 = rule_signature(rules, {})
+
+    store = LintCache(tmp_path / "c")
+    key_v1 = LintCache.program_key({"m.py": "sha"}, sig_v1)
+    store.put(key_v1, [])
+    assert store.get(key_v1) == []
+
+    (pkg / "jaxsem.py").write_text("INVENTORY = 2  # rule semantics moved\n")
+    monkeypatch.setattr(cache_mod, "_pkg_hash", None)
+    sig_v2 = rule_signature(rules, {})
+    assert sig_v2 != sig_v1
+    key_v2 = LintCache.program_key({"m.py": "sha"}, sig_v2)
+    assert store.get(key_v2) is None  # the edit is a miss, not a replay
+
+
+# ---------------------------------------------------------------------------
+# compile fence: units
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def fence():
+    compile_fence.set_mode("record")
+    compile_fence.reset()
+    yield compile_fence
+    compile_fence.set_mode(None)
+    compile_fence.reset()
+
+
+def test_fence_mode_resolution(monkeypatch):
+    compile_fence.set_mode(None)
+    monkeypatch.delenv("DYN_COMPILE_FENCE", raising=False)
+    assert compile_fence.mode() == "off" and not compile_fence.enabled()
+    for raw, want in (("1", "record"), ("fatal", "fatal"),
+                      ("true", "record"), ("", "off")):
+        compile_fence.set_mode(None)
+        monkeypatch.setenv("DYN_COMPILE_FENCE", raw)
+        assert compile_fence.mode() == want
+    compile_fence.set_mode(None)
+
+
+def test_fence_collects_outside_allowed_window(fence):
+    with fence.allow():
+        fence.note_compile("/jax/backend_compile", 0.5)  # sanctioned
+    assert fence.drain() == ([], 0)
+    fence.note_compile("/jax/backend_compile", 0.25)
+    fence.note_compile("/jax/core/compile/jaxpr_trace_duration", 0.05)
+    events, n = fence.drain()
+    assert n == 2
+    assert [e["event"] for e in events] == [
+        "/jax/backend_compile", "/jax/core/compile/jaxpr_trace_duration",
+    ]
+    assert events[0]["duration_ms"] == 250.0
+    assert fence.drain() == ([], 0)  # drained
+    assert fence.stats()["events_total"] == 2  # lifetime count survives
+
+
+def test_fence_disabled_is_inert_and_pending_is_bounded(fence):
+    fence.set_mode("off")
+    fence.note_compile("/jax/backend_compile", 1.0)
+    assert fence.stats()["events_total"] == 0
+    fence.set_mode("record")
+    for i in range(200):
+        fence.note_compile(f"/jax/backend_compile/{i}", 0.001)
+    assert fence.stats()["pending"] <= 64  # deque(maxlen): DL007 holds
+    # the DETAIL window is bounded; the violation count is not — a
+    # retrace storm past the bound must not undercount the metric
+    events, n = fence.drain()
+    assert len(events) <= 64 and n == 200
+    assert fence.fatal() is False
+    fence.set_mode("fatal")
+    assert fence.fatal() is True
+
+
+# ---------------------------------------------------------------------------
+# compile fence: the e2e acceptance case
+# ---------------------------------------------------------------------------
+
+
+async def test_fence_e2e_unprewarmed_shape_dumps_once(tmp_path, fence):
+    """ISSUE 13 acceptance: a normal prewarmed generate produces ZERO
+    serve_compile records; a deliberately un-prewarmed signature (a
+    penalties batch — the opt-in variant prewarm skips by default)
+    produces EXACTLY ONE flight-recorder serve_compile record and one
+    black-box bundle."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    async def gen(engine, rid, **samp):
+        req = PreprocessedRequest(
+            request_id=rid, token_ids=list(range(1, 9)),
+            sampling=SamplingOptions(**samp),
+            stop=StopConditions(max_tokens=1),
+        )
+        out = []
+        async for item in engine.as_async_engine().generate(req, Context()):
+            out.extend(item.token_ids)
+        return out
+
+    engine = await JaxEngine.launch(EngineConfig(
+        model_path=MODEL_DIR, model_name="tiny", random_weights=True,
+        num_blocks=128, block_size=8, max_batch_size=8,
+        prefill_chunk_size=32, max_model_len=256,
+        prewarm=True, overlap=False,
+        flight_dump_dir=str(tmp_path),
+    ))
+    try:
+        def fence_records():
+            return [r for r in engine.recorder.snapshot(256)
+                    if r["kind"] == "serve_compile"]
+
+        def bundles():
+            return glob.glob(str(tmp_path / "dynamo_blackbox_*"))
+
+        # prewarm itself compiled plenty — all inside the allowed window
+        assert fence.stats()["events_total"] == 0
+
+        out = await gen(engine, "warm", use_greedy=True)
+        assert out, "prewarmed generate produced no tokens"
+        assert fence_records() == [] and bundles() == []
+
+        out = await gen(engine, "cold", temperature=1.0,
+                        repetition_penalty=1.3)
+        assert out, "penalties generate produced no tokens"
+        recs = fence_records()
+        assert len(recs) == 1, recs
+        assert recs[0]["compiles"] >= 1
+        assert recs[0]["duration_ms"] > 0
+        assert len(bundles()) == 1, bundles()
+        assert engine.debug_state()["compile_fence"]["events_total"] >= 1
+    finally:
+        await engine.shutdown()

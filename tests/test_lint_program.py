@@ -30,6 +30,9 @@ PROGRAM_CASES = [
     ("transitive-blocking-call-in-async", "transitive_blocking", 3),
     ("transitive-host-sync-in-step-loop", "transitive_sync", 3),
     ("cross-thread-mutation", "cross_thread", 3),
+    ("use-after-donate", "use_after_donate", 4),
+    ("dynamic-static-arg", "dynamic_static_arg", 5),
+    ("prewarm-coverage", "prewarm_coverage", 3),
 ]
 
 
@@ -552,6 +555,71 @@ def test_cli_baseline_demotes_then_new_findings_fail(tmp_path):
     assert "(baseline)" in gated.stdout
 
 
+def test_cli_baseline_warns_on_stale_entries_and_update_prunes(tmp_path):
+    """ISSUE 13 satellite: a baseline fingerprint matching no current
+    finding is a fixed violation whose grandfather entry lingers — it
+    must warn on every run, and --update-baseline must prune it, so
+    the backlog list shrinks monotonically."""
+    base = tmp_path / "baseline.json"
+    target = str(DATA / "transitive_blocking_bad.py")
+    wrote = _run_cli(target, "--no-cache", "--baseline", str(base),
+                     "--update-baseline")
+    assert wrote.returncode == 0, wrote.stdout + wrote.stderr
+    payload = json.loads(base.read_text())
+    n_live = len(payload["findings"])
+    # graft a stale entry: a finding that no longer exists
+    payload["findings"].append({
+        "rule": "transitive-blocking-call-in-async",
+        "path": "pkg/deleted_module.py",
+        "message": "long since fixed",
+    })
+    base.write_text(json.dumps(payload))
+
+    run = _run_cli(target, "--no-cache", "--baseline", str(base))
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "stale baseline entry" in run.stderr
+    assert "deleted_module.py" in run.stderr
+    assert "prune with --update-baseline" in run.stderr
+
+    pruned = _run_cli(target, "--no-cache", "--baseline", str(base),
+                      "--update-baseline")
+    assert pruned.returncode == 0, pruned.stdout + pruned.stderr
+    assert "pruned 1 stale" in pruned.stderr
+    after = json.loads(base.read_text())["findings"]
+    assert len(after) == n_live
+    assert not any(e["path"] == "pkg/deleted_module.py" for e in after)
+
+    # pruned baseline: no stale warning, grandfathering still works
+    clean = _run_cli(target, "--no-cache", "--baseline", str(base))
+    assert clean.returncode == 0
+    assert "stale baseline entry" not in clean.stderr
+
+
+def test_stale_baseline_entries_api(tmp_path):
+    from dynamo_tpu.analysis import Finding, stale_baseline_entries
+
+    base = tmp_path / "b.json"
+    base.write_text(json.dumps({"version": 1, "findings": [
+        {"rule": "r", "path": "a.py", "message": "live"},
+        {"rule": "r", "path": "b.py", "message": "stale"},
+    ]}))
+    live = [Finding(rule="r", code="DL000", path="a.py", line=1, col=0,
+                    message="live")]
+    assert stale_baseline_entries(live, base) == [("r", "b.py", "stale")]
+    # suppressed findings don't keep an entry alive
+    waived = [dataclasses_replace_suppressed(live[0])]
+    assert len(stale_baseline_entries(waived, base)) == 2
+    # unreadable baseline: no stale reports (degrade like apply_baseline)
+    base.write_text("{broken")
+    assert stale_baseline_entries(live, base) == []
+
+
+def dataclasses_replace_suppressed(f):
+    import dataclasses
+
+    return dataclasses.replace(f, suppressed=True)
+
+
 def test_cli_changed_scopes_report(tmp_path):
     proj = tmp_path / "proj"
     (proj / "pkg").mkdir(parents=True)
@@ -598,9 +666,9 @@ def test_cli_changed_scopes_report(tmp_path):
 
 def test_program_rule_catalog_metadata():
     rules = all_program_rules()
-    assert len(rules) == 3
+    assert len(rules) == 6
     codes = [r.code for r in rules]
-    assert codes == ["DL101", "DL102", "DL103"]
+    assert codes == ["DL101", "DL102", "DL103", "DL201", "DL202", "DL203"]
     assert all(r.name == r.name.lower() and " " not in r.name
                for r in rules)
 

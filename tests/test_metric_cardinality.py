@@ -65,6 +65,8 @@ _REQUIRED_SERIES = [
     "dynamo_spec_accept_rate",
     "dynamo_spec_proposed_tokens_total",
     "dynamo_spec_accepted_tokens_total",
+    # ISSUE 13: the serve-phase compile fence (DYN_COMPILE_FENCE)
+    "dynamo_compile_fence_events_total",
 ]
 
 
@@ -130,6 +132,36 @@ def test_observability_series_are_registered():
     assert REGISTRY.get("dynamo_roofline_frac").label_names == ()
     assert REGISTRY.get("dynamo_blackbox_dumps_total").label_names == (
         "reason",
+    )
+
+
+def test_metric_catalog_docs_match_registry():
+    """docs/observability.md's catalog table IS the documentation
+    contract for the metric surface: every series the process registers
+    must have a row, and every row must name a live series.  Catalog
+    rot was a review nit before this test; now it's a tier-1 failure
+    in both directions (ISSUE 13 satellite)."""
+    import re
+    from pathlib import Path
+
+    _load_all()
+    registered = {m.name for m in REGISTRY.metrics()}
+    docs = (
+        Path(__file__).resolve().parents[1] / "docs" / "observability.md"
+    ).read_text()
+    documented = {
+        m.group(1)
+        for m in re.finditer(r"^\|\s*`(dynamo_[a-z0-9_]+)`", docs, re.M)
+    }
+    undocumented = sorted(registered - documented)
+    assert not undocumented, (
+        "series registered but missing from docs/observability.md's "
+        f"catalog table: {undocumented}"
+    )
+    ghosts = sorted(documented - registered)
+    assert not ghosts, (
+        "docs/observability.md catalog rows naming no registered "
+        f"series: {ghosts}"
     )
 
 
