@@ -814,6 +814,46 @@ def _phase_breakdown(model_cfg, wl, kv_dtype: str) -> dict:
     return phases
 
 
+def _migration_sim_ab() -> dict:
+    """Goodput retained under a mid-burst worker kill with mid-stream
+    migration on vs off (the live routers' default vs the PR-5 abort
+    behavior), replayed on the PR-6 discrete-event fleet — no
+    accelerator needed, deterministic at a fixed seed. Rides along with
+    --chaos so the kill-recovery policy is benched next to the
+    step-fault goodput number (docs/robustness.md)."""
+    from dynamo_tpu.faults.plan import parse_plan
+    from dynamo_tpu.sim import FleetSim, SimConfig, bursty_trace
+
+    trace = bursty_trace(
+        600.0, seed=2026, calm_rps=30.0, burst_rps=60.0,
+        mean_calm_s=90.0, mean_burst_s=30.0,
+    )
+    kill = "seed=42;worker.liveness:kill@after=240"
+
+    def run(migration, plan_spec=None):
+        plan = parse_plan(plan_spec) if plan_spec else None
+        return FleetSim(
+            trace, SimConfig(initial_decode=3, migration=migration),
+            plan=plan,
+        ).run()
+
+    base = run(True)  # fault-free reference
+    on = run(True, kill)
+    off = run(False, kill)
+    g = max(1, base["goodput_tokens"])
+    return {
+        "sim_kill_plan": kill,
+        "sim_goodput_retained_migration_on": round(
+            on["goodput_tokens"] / g, 4
+        ),
+        "sim_goodput_retained_migration_off": round(
+            off["goodput_tokens"] / g, 4
+        ),
+        "sim_resumed": on["resumed"],
+        "sim_lost_migration_off": off["lost_inflight"],
+    }
+
+
 def _main_chaos_ab(model_cfg, wl) -> None:
     """--chaos: goodput/SLO attainment under a canned, fixed-seed fault
     plan vs the identical fault-free workload (docs/robustness.md).
@@ -879,6 +919,18 @@ def _main_chaos_ab(model_cfg, wl) -> None:
             "p99_itl_ms_chaos": round(chaos["p99_itl_s"] * 1000, 2),
         },
     }
+    # mid-stream migration A/B (sim-based; DYN_BENCH_CHAOS_MIGRATION=0
+    # skips it): goodput retained through a worker kill, migration
+    # on vs off
+    if os.environ.get("DYN_BENCH_CHAOS_MIGRATION", "1") != "0":
+        out["config"]["migration"] = mig = _migration_sim_ab()
+        print(
+            f"# migration A/B (sim kill): goodput retained "
+            f"{mig['sim_goodput_retained_migration_off']:.4f} (off) -> "
+            f"{mig['sim_goodput_retained_migration_on']:.4f} (on), "
+            f"{mig['sim_resumed']} stream(s) resumed",
+            file=sys.stderr,
+        )
     print(json.dumps(out))
     print(
         f"# chaos A/B: base={base['tput']:.1f} chaos={chaos['tput']:.1f} "

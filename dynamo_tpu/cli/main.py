@@ -783,8 +783,6 @@ async def cmd_run(args: Any) -> None:
         drt = await DistributedRuntime.create(config=_runtime_config(args))
         drt.runtime.install_signal_handlers()
         manager = ModelManager()
-        watcher = ModelWatcher(drt, manager, router_mode=args.router_mode)
-        await watcher.start()
         # no local engine -> no load signal, so caps can't bind here
         # (deadlines still propagate to workers over the endpoint wire)
         # — but the planner's degradation ladder can: rung 3 sheds this
@@ -810,6 +808,12 @@ async def cmd_run(args: Any) -> None:
             ),
             load_fn=lambda: None,  # fail open until the ladder says shed
         )
+        # routers built by the watcher report migration resumes through
+        # admission.check(resume=True) — never shed, but on the books
+        watcher = ModelWatcher(
+            drt, manager, router_mode=args.router_mode, admission=admission
+        )
+        await watcher.start()
         spawn(
             watch_degradation(
                 drt.store, args.namespace,

@@ -24,6 +24,7 @@ import queue as thread_queue
 import threading
 import time
 import uuid
+import zlib
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Callable, Optional
 
@@ -3352,9 +3353,18 @@ class JaxEngine:
         seeds = []
         for s, off in zip(seqs, offs):
             base = s.request.sampling.seed
+            if base is None:
+                # crc32, NOT hash(): Python's str hash is SipHash-salted
+                # per process, and the unseeded base must be identical
+                # on whichever worker serves (or RESUMES) the request
+                base = zlib.crc32(s.request_id.encode()) & 0x7FFFFFFF
+            # resume_offset: a migrated request's RNG stream continues
+            # where the dead worker's delivery stopped (the request_id —
+            # hence the unseeded base — survives migration unchanged),
+            # so the continuation draws the same per-position samples
+            # the original stream would have (docs/robustness.md)
             seeds.append(
-                (base if base is not None else hash(s.request_id) & 0x7FFFFFFF)
-                + s.generated + off
+                base + s.generated + s.request.resume_offset + off
             )
         seeds += [0] * pad
         gen_counts = prompt_ids = None

@@ -114,9 +114,25 @@ class AdmissionController:
         self.force_shed = False
         self.shed_total = 0
         self.admitted_total = 0
+        self.resumed_total = 0
 
-    def check(self) -> Optional[Rejection]:
-        """None = admit; a Rejection = shed with 429 + Retry-After."""
+    def check(self, resume: bool = False) -> Optional[Rejection]:
+        """None = admit; a Rejection = shed with 429 + Retry-After.
+
+        ``resume=True`` marks a mid-stream migration re-dispatch
+        (docs/robustness.md "Mid-stream migration"): the request
+        already paid for admission when it first arrived and its
+        tokens are mid-flight to a client, so shedding it now would
+        convert a recoverable worker death into a dropped answer while
+        saving almost nothing — the continuation's marginal cost is a
+        re-prefill, not a whole new request. Resumes are therefore
+        ALWAYS admitted (even under force_shed); ``resumed_total``
+        counts migration windows (one per worker death a stream
+        recovers from, not one per retry or per request)."""
+        if resume:
+            self.resumed_total += 1
+            self.admitted_total += 1
+            return None
         cfg = self.config
         # force_shed engages the controller even with no caps
         # configured (the --out auto frontend ships caps of 0)
@@ -176,6 +192,7 @@ class AdmissionController:
             "max_kv_usage": self.config.max_kv_usage,
             "shed_total": self.shed_total,
             "admitted_total": self.admitted_total,
+            "resumed_total": self.resumed_total,
         }
 
 

@@ -36,11 +36,17 @@ class ModelWatcher:
         manager,
         router_mode: str = "round_robin",
         cache_dir: Optional[str] = None,
+        admission=None,
     ):
         self.drt = drt
         self.manager = manager
         self.router_mode = router_mode
         self.cache_dir = cache_dir
+        # the frontend's AdmissionController, handed to every router it
+        # builds: mid-stream migration resumes report through
+        # check(resume=True), which never sheds them (they already paid
+        # for admission — docs/robustness.md "Mid-stream migration")
+        self.admission = admission
         # slug -> set of live entry keys; slug -> (display name, closer)
         self._instances: dict[str, set[str]] = {}
         self._models: dict[str, tuple[str, list]] = {}
@@ -175,12 +181,13 @@ class ModelWatcher:
             from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
 
             kv_router = await KvRouter.create(component, client)
-            router = KvPushRouter(kv_router)
+            router = KvPushRouter(kv_router, admission=self.admission)
             closers.append(kv_router)
         else:
             router = PushRouter(
                 client,
                 RouterMode.ROUND_ROBIN if mode == "round_robin" else RouterMode.RANDOM,
+                admission=self.admission,
             )
 
         tokenizer = Tokenizer.from_file(local_dir)

@@ -23,12 +23,11 @@ from dynamo_tpu.kv_router.scheduler import (
 )
 from dynamo_tpu.runtime.component import Client, Component
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
-from dynamo_tpu.runtime.service import ConnectionLostError
-from dynamo_tpu.telemetry.instruments import (
-    FAILOVER_RETRIES,
-    MIDSTREAM_ABORTS,
+from dynamo_tpu.runtime.migration import (
+    DialFailedError,
+    MigrationConfig,
+    migrating_stream,
 )
-from dynamo_tpu.utils.backoff import Backoff
 
 log = logging.getLogger("dynamo_tpu.kv_router")
 
@@ -85,15 +84,22 @@ class KvRouter:
             await asyncio.sleep(1.0)
 
     def schedule(
-        self, token_ids: list[int], exclude: Optional[set[int]] = None
+        self,
+        token_ids: list[int],
+        exclude: Optional[set[int]] = None,
+        resume: bool = False,
     ) -> SchedulingDecision:
         """Pick a worker; ``exclude`` drops instances a failover already
-        saw die (falls back to the full live set if that empties it)."""
+        saw die (falls back to the full live set if that empties it).
+        ``resume`` marks a mid-stream migration re-dispatch: the
+        scheduler weighs prefix overlap more heavily so a cache-hot
+        instance turns the resume's re-prefill into a cheap onboard
+        (docs/robustness.md "Mid-stream migration")."""
         ids = self.client.instance_ids()
         if exclude:
             filtered = [i for i in ids if i not in exclude]
             ids = filtered or ids
-        return self.scheduler.schedule(token_ids, ids)
+        return self.scheduler.schedule(token_ids, ids, resume=resume)
 
     async def close(self) -> None:
         if self._prune_task is not None:
@@ -106,86 +112,72 @@ class KvPushRouter(AsyncEngine):
     """AsyncEngine that KV-routes each PreprocessedRequest then streams
     from the chosen worker (reference: kv_router.rs KvPushRouter).
 
-    Failover mirrors PushRouter: dial failures and streams that die
+    Failover and mid-stream migration mirror PushRouter (the shared
+    loop in runtime/migration.py): dial failures and streams that die
     before the first item re-schedule onto a different worker (bounded
     attempts, backoff + jitter); once items have streamed, a worker
-    death ends the stream with a clean WorkerStreamLostError instead of
-    a hang."""
+    death re-dispatches the request as a *resume* — and because the
+    resume's token_ids carry the already-delivered tokens, the KV-aware
+    ``schedule(resume=True)`` prefers instances whose prefix cache is
+    already hot for them. Only an exhausted (or opted-out) migration
+    ends the stream with a clean WorkerStreamLostError."""
 
-    def __init__(self, router: KvRouter, max_attempts: int = 3):
+    def __init__(
+        self,
+        router: KvRouter,
+        max_attempts: int = 3,
+        migration: Optional[MigrationConfig] = None,
+        admission: Any = None,
+    ):
         self.router = router
         self.max_attempts = max_attempts
+        self.migration = migration or MigrationConfig.from_env()
+        self.admission = admission
 
     async def _gen(self, request: Any, context: Context) -> AsyncIterator[Any]:
-        from dynamo_tpu.runtime.push_router import (
-            WorkerStreamLostError,
-            deadline_backoff_sleep,
-        )
-
-        token_ids = (
-            request.token_ids if hasattr(request, "token_ids") else request["token_ids"]
-        )
-        exclude: set[int] = set()
-        backoff = Backoff(base_s=0.05, cap_s=2.0)
-        last_err: Exception | None = None
-        for attempt in range(self.max_attempts):
-            if attempt:
-                FAILOVER_RETRIES.inc()
-                await deadline_backoff_sleep(backoff, context)
-            await self.router.client.wait_for_instances()
-            decision = self.router.schedule(list(token_ids), exclude=exclude)
-            # annotate the request with the expected prefix hit (the worker's
-            # disagg router uses it, reference: worker.py prefix_hit_rate)
-            if hasattr(request, "annotations"):
-                request.annotations = list(request.annotations) + [
+        async def dial(req, exclude, resume, wait_timeout_s):
+            await self.router.client.wait_for_instances(wait_timeout_s)
+            token_ids = (
+                req.token_ids
+                if hasattr(req, "token_ids")
+                else req["token_ids"]
+            )
+            decision = self.router.schedule(
+                list(token_ids), exclude=exclude, resume=resume
+            )
+            # annotate the request with the expected prefix hit (the
+            # worker's disagg router uses it, reference: worker.py
+            # prefix_hit_rate)
+            if hasattr(req, "annotations"):
+                req.annotations = list(req.annotations) + [
                     f"kv_hit_rate:{decision.prefix_hit_rate:.3f}"
                 ]
-            # schedule() charged this decision as optimistic in-flight load;
-            # release it early when the stream finishes (expiry otherwise
+            # schedule() charged this decision as optimistic in-flight
+            # load; release it when the segment ends (expiry otherwise
             # clears it on the worker's next metrics publish)
-            yielded = False
+            done = lambda: self.router.scheduler.note_done(  # noqa: E731
+                decision.worker_id, decision.dispatch_token
+            )
             try:
                 stream = await self.router.client.generate_direct(
-                    decision.worker_id, request, context
+                    decision.worker_id, req, context
                 )
-                async for item in stream:
-                    yielded = True
-                    yield item
-                return
-            except ConnectionLostError as exc:
-                exclude.add(decision.worker_id)
-                last_err = exc
-                if yielded:
-                    MIDSTREAM_ABORTS.inc()
-                    raise WorkerStreamLostError(
-                        "worker connection lost mid-stream; partial "
-                        "response cannot be resumed"
-                    ) from exc
-                log.warning(
-                    "worker %x died before first item; failing over",
-                    decision.worker_id,
-                )
-            except (OSError, asyncio.TimeoutError, KeyError) as exc:
-                exclude.add(decision.worker_id)
-                last_err = exc
-                if yielded:
-                    # tokens already reached the client: re-dispatching
-                    # would replay them from token 0 on another worker
-                    MIDSTREAM_ABORTS.inc()
-                    raise WorkerStreamLostError(
-                        "worker connection lost mid-stream; partial "
-                        "response cannot be resumed"
-                    ) from exc
-                log.warning(
-                    "worker %x unreachable: %s", decision.worker_id, exc
-                )
-            finally:
-                self.router.scheduler.note_done(
-                    decision.worker_id, decision.dispatch_token
-                )
-        raise RuntimeError(
-            f"all kv-routed attempts failed: {last_err}"
-        )
+            except BaseException as exc:
+                done()
+                if isinstance(exc, (OSError, asyncio.TimeoutError, KeyError)):
+                    # carry the picked worker out so the retry excludes
+                    # it instead of re-scheduling onto the same corpse
+                    raise DialFailedError(decision.worker_id, exc) from exc
+                raise
+            return decision.worker_id, stream, done
+
+        async for item in migrating_stream(
+            request, context, dial, self.migration,
+            admission=self.admission,
+            max_attempts=self.max_attempts,
+            endpoint_name="kv-routed generate",
+        ):
+            yield item
 
     def generate(self, request: Any, context: Context) -> EngineStream:
         return self._gen(request, context)

@@ -193,12 +193,30 @@ class KvScheduler:
             self.inflight.pop(worker_id, None)
         return len(live)
 
+    # how much harder prefix overlap weighs for a migration resume: a
+    # resume's token_ids carry the tokens already streamed, so a worker
+    # holding that prefix turns the re-prefill into a cheap onboard —
+    # worth crossing a load gradient for (docs/robustness.md
+    # "Mid-stream migration"). Applied by scaling the overlap scores the
+    # selector sees, so custom selectors keep their 3-arg signature.
+    resume_overlap_boost: float = 2.0
+
     def schedule(
-        self, token_ids: list[int], candidates: list[int]
+        self, token_ids: list[int], candidates: list[int],
+        resume: bool = False,
     ) -> SchedulingDecision:
         if not candidates:
             raise RuntimeError("no candidate workers")
         overlaps = self.indexer.find_matches_for_request(token_ids)
+        true_overlaps = overlaps
+        if resume and overlaps.scores:
+            overlaps = OverlapScores(
+                scores={
+                    w: s * self.resume_overlap_boost
+                    for w, s in overlaps.scores.items()
+                },
+                total_blocks=overlaps.total_blocks,
+            )
         fresh = self.aggregator.fresh_metrics()
         # prefer workers with a live health signal: if SOME candidates have
         # fresh metrics, a candidate without them is stale (hung publisher /
@@ -223,10 +241,11 @@ class KvScheduler:
                     )
         wid = self.selector(overlaps, metrics, candidates)
         token = self.note_dispatch(wid)
+        # decision + hit-rate event report the TRUE (unboosted) overlap
         decision = SchedulingDecision(
             worker_id=wid,
-            overlap_blocks=overlaps.scores.get(wid, 0),
-            total_blocks=overlaps.total_blocks,
+            overlap_blocks=true_overlaps.scores.get(wid, 0),
+            total_blocks=true_overlaps.total_blocks,
             dispatch_token=token,
         )
         if self.on_hit_rate is not None:

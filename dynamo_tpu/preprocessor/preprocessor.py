@@ -71,6 +71,7 @@ class OpenAIPreprocessor(Operator):
             model=request.model,
             annotations=list(ext.annotations),
             speculative=ext.speculative,
+            migration=ext.migration,
         )
 
     def preprocess_completion(self, request: CompletionRequest) -> PreprocessedRequest:
@@ -99,6 +100,7 @@ class OpenAIPreprocessor(Operator):
             model=request.model,
             annotations=list(ext.annotations),
             speculative=ext.speculative,
+            migration=ext.migration,
         )
 
     # -- Operator interface ----------------------------------------------

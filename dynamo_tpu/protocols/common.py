@@ -129,6 +129,20 @@ class PreprocessedRequest(BaseModel):
     # trades per-request latency shape (token bursts) and exact seeded
     # reproducibility vs a non-speculative engine.
     speculative: Optional[bool] = None
+    # Mid-stream migration (docs/robustness.md "Mid-stream migration"):
+    # ``resume_offset`` is the number of tokens a previous worker
+    # already generated AND delivered for this request before it died —
+    # the router's resume re-dispatch extends token_ids by those tokens
+    # and sets this offset so the engine's per-request sampling RNG
+    # (seeded ``base + generated + resume_offset`` per step) continues
+    # the SAME stream: greedy continuations are bit-identical and
+    # seeded/request-id-hashed sampling is stream-consistent across the
+    # splice. 0 for ordinary requests.
+    resume_offset: int = 0
+    # Per-request migration opt-out (OpenAI ext.migration): False keeps
+    # the PR-5 behavior (a mid-stream worker death ends the stream with
+    # a clean SSE error); None/True allow the routers to resume it.
+    migration: Optional[bool] = None
     # Disaggregation: filled by the disagg router when prefill is remote
     remote_prefill: Optional[dict[str, Any]] = None
     annotations: list[str] = Field(default_factory=list)

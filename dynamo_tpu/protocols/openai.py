@@ -47,6 +47,11 @@ class ExtOptions(BaseModel):
     # engines without a configured drafter) — carried through the
     # preprocessor into PreprocessedRequest.speculative
     speculative: Optional[bool] = None
+    # per-request mid-stream-migration opt-out (None = on; False = a
+    # worker death mid-stream ends the stream with a clean SSE error
+    # instead of resuming elsewhere) — carried through the preprocessor
+    # into PreprocessedRequest.migration (docs/robustness.md)
+    migration: Optional[bool] = None
 
 
 def _int_logit_bias(

@@ -1,0 +1,492 @@
+"""Mid-stream request migration: streams that survive worker death.
+
+The shared failover/resume engine behind both routers
+(``runtime/push_router.py PushRouter`` and ``kv_router/router.py
+KvPushRouter``). PR 5 gave the routers pre-first-token failover and a
+clean abort (``WorkerStreamLostError`` → SSE ``error``) once tokens had
+streamed; this module turns that abort into the *fallback*: when a
+worker dies after emitting tokens, the request is re-dispatched to a
+surviving worker as a **resume** and the continuation is spliced into
+the original stream (docs/robustness.md "Mid-stream migration").
+
+Resume semantics (the contract the engine implements):
+
+- the resume request's ``token_ids`` is the original prompt extended by
+  every token already **delivered** to the client — the new worker
+  prefills that prefix and generates the continuation from the exact
+  splice point, so there is nothing to dedup: tokens the dead worker
+  generated but never delivered are simply regenerated;
+- ``stop.max_tokens`` (and ``min_tokens``) shrink by the delivered
+  count so length accounting is seamless across the splice;
+- ``resume_offset`` carries the delivered count into the engine's
+  per-request RNG: the engine seeds step ``p`` of a sequence with
+  ``base + generated + resume_offset``, so the continuation draws the
+  SAME sample stream the original request would have at those positions
+  — greedy output is bit-identical and seeded (or request-id-hashed)
+  sampling is stream-consistent across the migration;
+- requests using token-count penalties (frequency/presence/repetition)
+  are NOT migratable: their penalty state counts *generated* tokens,
+  which a resume would reclassify as prompt. They keep the PR-5 abort.
+- ``usage``/``cum_log_probs`` on the continuation are re-anchored here
+  (the resumed engine sees an extended prompt and counts only its own
+  tokens), so upstream consumers observe one uninterrupted stream.
+
+Resume attempts are deadline-clamped through the shared ``Backoff``;
+``dynamo_midstream_resumes_total{result}`` and
+``dynamo_midstream_resume_seconds`` observe every splice, and the
+``router.resume`` fault point lets ``DYN_FAULTS`` kill the resume
+itself (double fault → the abort fallback).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import logging
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Awaitable, Callable, Optional
+
+from dynamo_tpu import faults
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.service import ConnectionLostError
+from dynamo_tpu.telemetry.instruments import (
+    FAILOVER_RETRIES,
+    MIDSTREAM_ABORTS,
+    MIDSTREAM_RESUMES,
+    RESUME_SECONDS,
+)
+from dynamo_tpu.utils.backoff import Backoff
+
+log = logging.getLogger("dynamo_tpu.runtime.migration")
+
+# faults/injector.py point: fired before every resume dispatch so chaos
+# plans can fail (or kill) the migration machinery itself
+FAULT_POINT = "router.resume"
+
+
+class WorkerStreamLostError(RuntimeError):
+    """A worker died after streaming part of a response and the stream
+    could not be resumed (migration disabled, opted out, ineligible, or
+    every resume attempt exhausted). Carries a clean, client-presentable
+    message; the HTTP layer renders it as an SSE ``error`` event."""
+
+
+# A dial callable: (request, excluded instance ids, resume flag, bounded
+# instance-wait budget or None) -> (instance id, response stream, segment
+# cleanup callback or None). Router-specific: PushRouter picks by mode,
+# KvPushRouter schedules KV-aware (cache-hot-biased for resumes).
+Dial = Callable[
+    [Any, set, bool, Optional[float]],
+    Awaitable[tuple[int, AsyncIterator[Any], Optional[Callable[[], None]]]],
+]
+
+
+class DialFailedError(Exception):
+    """A PICKED instance could not be dialed. Dial implementations wrap
+    transport failures in this so the loop can exclude the dead
+    instance before retrying — without it, a scheduler that
+    deterministically prefers the dead worker would re-pick it until
+    the whole attempt budget burned (the PR-5 routers excluded on dial
+    failure; this preserves that)."""
+
+    def __init__(self, instance_id: int, cause: BaseException):
+        super().__init__(f"instance {instance_id:x}: {cause}")
+        self.instance_id = instance_id
+        self.__cause__ = cause
+
+# failures that mean "this worker/attempt is gone, try another"
+# (CancelledError is deliberately NOT here). Dial implementations wrap
+# transport failures in DialFailedError; the instance wait raises
+# asyncio.TimeoutError and an emptied candidate set raises
+# RuntimeError. Anything else a dial raises is a programming/input bug
+# and must crash at the fault, not burn retries as fake fleet
+# unavailability. Stream iteration likewise retries only
+# transport-shaped errors.
+_DIAL_ERRORS = (DialFailedError, asyncio.TimeoutError, RuntimeError)
+_STREAM_ERRORS = (ConnectionLostError, OSError, asyncio.TimeoutError, KeyError)
+
+
+@dataclass
+class MigrationConfig:
+    """Mid-stream migration knobs (env-tunable; docs/robustness.md)."""
+
+    enabled: bool = True
+    # consecutive resume attempts without a spliced token before the
+    # abort fallback (a splice that delivers tokens resets the budget)
+    max_resumes: int = 3
+    # per-attempt bound on waiting for a live instance: a resume must
+    # fail fast toward the abort, not park on the 300 s discovery budget
+    instance_wait_s: float = 5.0
+
+    @classmethod
+    def from_env(cls) -> "MigrationConfig":
+        return cls(
+            enabled=os.environ.get("DYN_MIGRATION", "1").strip().lower()
+            not in ("0", "false", "off"),
+            max_resumes=int(os.environ.get("DYN_MIGRATION_MAX_RESUMES", "3")),
+            instance_wait_s=float(
+                os.environ.get("DYN_MIGRATION_WAIT_S", "5.0")
+            ),
+        )
+
+
+def _get(obj: Any, key: str, default: Any = None) -> Any:
+    if isinstance(obj, dict):
+        return obj.get(key, default)
+    return getattr(obj, key, default)
+
+
+def _set(obj: Any, key: str, value: Any) -> None:
+    if isinstance(obj, dict):
+        obj[key] = value
+    else:
+        setattr(obj, key, value)
+
+
+def resumable(request: Any) -> bool:
+    """Whether a request is eligible for mid-stream migration: it must
+    be token-shaped (a PreprocessedRequest or wire dict), not opted out
+    (``migration=False``), and penalty-free (see module docstring)."""
+    token_ids = _get(request, "token_ids")
+    if not isinstance(token_ids, list) or not token_ids:
+        return False
+    if _get(request, "migration") is False:
+        return False
+    sampling = _get(request, "sampling")
+    if sampling is not None:
+        needs = _get(sampling, "needs_penalties")
+        if needs is None and isinstance(sampling, dict):
+            # dict-shaped wire request: judge with the SAME predicate
+            # the typed model defines, so the two can never drift
+            from dynamo_tpu.protocols.common import SamplingOptions
+
+            try:
+                needs = SamplingOptions.model_validate(
+                    sampling
+                ).needs_penalties
+            except Exception:
+                return False  # unparseable sampling: don't risk it
+        if needs:
+            return False
+    return True
+
+
+class StreamProgress:
+    """The commit log of one migratable stream: every token the client
+    has received, plus the stitching state that makes a resumed
+    continuation indistinguishable from the original stream."""
+
+    def __init__(self, request: Any):
+        self.request = request
+        self.prompt_len = len(_get(request, "token_ids") or [])
+        self.emitted: list[int] = []
+        self.segments = 1
+        # the finish chunk reached the client: the answer is complete,
+        # a later transport death killed only the stream's trailing
+        # frame and must NOT trigger a resume
+        self.finished = False
+        # cum_log_probs carried out of completed segments: the resumed
+        # engine restarts its cumulation at 0 for the continuation
+        self.cum_base: float = 0.0
+        self._last_cum: Optional[float] = None
+        self._dict_items = False  # shape of the last item seen
+
+    def note(self, item: Any) -> Any:
+        """Record one delivered item; re-anchors continuation items
+        (cum_log_probs, final-chunk usage) onto the original request's
+        frame of reference. Returns the (possibly adjusted) item."""
+        self._dict_items = isinstance(item, dict)
+        toks = _get(item, "token_ids") or []
+        self.emitted.extend(toks)
+        cum = _get(item, "cum_log_probs")
+        if cum is not None:
+            if self.segments > 1 and self.cum_base:
+                cum = cum + self.cum_base
+                _set(item, "cum_log_probs", cum)
+            self._last_cum = cum
+        if _get(item, "finish_reason") is not None:
+            self.finished = True
+            if self.segments > 1:
+                if _get(item, "prompt_tokens") is not None:
+                    _set(item, "prompt_tokens", self.prompt_len)
+                if _get(item, "completion_tokens") is not None:
+                    _set(item, "completion_tokens", len(self.emitted))
+        return item
+
+    def budget_left(self) -> Optional[int]:
+        """Tokens of max_tokens budget the continuation may still emit
+        (None = unbounded)."""
+        stop = _get(self.request, "stop")
+        mt = _get(stop, "max_tokens") if stop is not None else None
+        if mt is None:
+            return None
+        return mt - len(self.emitted)
+
+    def resume_request(self) -> Any:
+        """The continuation request: prompt extended by every delivered
+        token, length budgets shrunk, RNG offset advanced. Always built
+        from the ORIGINAL request so repeated migrations compose."""
+        req = self.request
+        if hasattr(req, "model_copy"):
+            r = req.model_copy(deep=True)
+        else:
+            r = copy.deepcopy(req)
+        n = len(self.emitted)
+        _set(
+            r, "token_ids",
+            list(_get(req, "token_ids")) + list(self.emitted),
+        )
+        stop = _get(r, "stop")
+        if stop is not None:
+            mt = _get(stop, "max_tokens")
+            if mt is not None:
+                _set(stop, "max_tokens", max(1, mt - n))
+            mn = _get(stop, "min_tokens")
+            if mn:
+                _set(stop, "min_tokens", max(0, mn - n))
+        out = _get(r, "output")
+        if out is not None and _get(out, "echo"):
+            # the echo (if any) already streamed with the first segment
+            _set(out, "echo", False)
+        base_off = _get(req, "resume_offset", 0) or 0
+        _set(r, "resume_offset", base_off + n)
+        self.cum_base = self._last_cum if self._last_cum is not None else 0.0
+        self.segments += 1
+        return r
+
+    def synthesize_final(self, reason: str = "length") -> Any:
+        """A final chunk for the edge where the worker died having
+        delivered its entire token budget — only the finish marker was
+        lost, so nothing remains to resume."""
+        chunk = {
+            "request_id": _get(self.request, "request_id", "") or "",
+            "token_ids": [],
+            "finish_reason": reason,
+            "prompt_tokens": self.prompt_len,
+            "completion_tokens": len(self.emitted),
+        }
+        if self._dict_items:
+            return chunk
+        from dynamo_tpu.protocols.common import LLMEngineOutput
+
+        return LLMEngineOutput.model_validate(chunk)
+
+
+async def deadline_backoff_sleep(backoff: Backoff, context: Context) -> None:
+    """One failover/resume backoff, clamped to the request's remaining
+    deadline budget; raises TimeoutError instead of retrying past the
+    deadline. Shared by PushRouter and KvPushRouter."""
+    delay = backoff.next_delay()
+    remaining = context.remaining_ms()
+    if remaining is not None:
+        if remaining <= 0:
+            raise asyncio.TimeoutError(
+                "request deadline exceeded during failover"
+            )
+        delay = min(delay, remaining / 1e3)
+    await asyncio.sleep(delay)
+
+
+async def migrating_stream(
+    request: Any,
+    context: Context,
+    dial: Dial,
+    config: Optional[MigrationConfig] = None,
+    *,
+    admission: Any = None,
+    span: Any = None,
+    max_attempts: int = 3,
+    backoff_base_s: float = 0.05,
+    backoff_cap_s: float = 2.0,
+    endpoint_name: str = "",
+) -> AsyncIterator[Any]:
+    """Stream a routed request with failover AND mid-stream migration.
+
+    Phase 1 (pre-first-token) keeps PR-5 semantics: dial failures and
+    streams that die with nothing delivered re-dispatch under
+    ``max_attempts`` with backoff (``dynamo_failover_retries_total``).
+    Once tokens have been delivered, a worker death triggers migration:
+    the request is rebuilt as a resume (:class:`StreamProgress`) and
+    re-dispatched; a successful splice resets the resume budget, so a
+    long stream survives any number of *spaced* worker deaths.
+    ``config.max_resumes`` consecutive no-progress attempts (or a
+    request that is not :func:`resumable`) fall back to the PR-5 abort:
+    ``dynamo_midstream_aborts_total`` + :class:`WorkerStreamLostError`.
+    """
+    cfg = config or MigrationConfig.from_env()
+    exclude: set[int] = set()
+    backoff = Backoff(base_s=backoff_base_s, cap_s=backoff_cap_s)
+    if not cfg.enabled:
+        progress, no_resume_why = None, "migration disabled"
+    elif not resumable(request):
+        progress, no_resume_why = None, "stream is not resumable"
+    else:
+        progress, no_resume_why = StreamProgress(request), ""
+    cur_req = request
+    started = False  # any item delivered upstream
+    attempt = 0  # consecutive failures in the current phase
+    death_t: Optional[float] = None  # first loss of the active migration
+    resumes = 0
+
+    def _abort(
+        exc: Exception, detail: Optional[str] = None
+    ) -> WorkerStreamLostError:
+        MIDSTREAM_ABORTS.inc()
+        if span:
+            span.set_attr("midstream_abort", True)
+        if detail is None:
+            detail = no_resume_why or "resume attempts exhausted"
+        return WorkerStreamLostError(
+            f"worker connection lost mid-stream; {detail}"
+        )
+
+    async def _pace(exc: Exception) -> None:
+        """Backoff before the next attempt; past the deadline, finish
+        the way this phase fails (abort vs plain timeout)."""
+        try:
+            await deadline_backoff_sleep(backoff, context)
+        except asyncio.TimeoutError:
+            if started:
+                raise _abort(
+                    exc, "request deadline exceeded during resume"
+                ) from exc
+            raise
+
+    while True:
+        resume = started
+        done_cb: Optional[Callable[[], None]] = None
+        try:
+            if resume:
+                if faults.ACTIVE is not None:
+                    await faults.ACTIVE.fire_async(
+                        FAULT_POINT, request_id=context.id
+                    )
+                if admission is not None and attempt == 0:
+                    # resumes already paid for admission; check() with
+                    # resume=True NEVER sheds (it returns None by
+                    # contract, locked by tests) but keeps the books —
+                    # consulted once per migration window, not per
+                    # retry, so resumed_total counts windows
+                    admission.check(resume=True)
+            wait_s = None
+            if resume:
+                wait_s = cfg.instance_wait_s
+                remaining = context.remaining_ms()
+                if remaining is not None:
+                    wait_s = min(wait_s, max(0.05, remaining / 1e3))
+            instance_id, stream, done_cb = await dial(
+                cur_req, exclude, resume, wait_s
+            )
+        except asyncio.CancelledError:
+            raise
+        except _DIAL_ERRORS as exc:
+            if isinstance(exc, DialFailedError):
+                # the picked instance is unreachable: never re-pick it
+                exclude.add(exc.instance_id)
+            attempt += 1
+            if resume:
+                MIDSTREAM_RESUMES.labels("failed").inc()
+                log.warning(
+                    "resume dispatch failed for %s (attempt %d/%d): %s",
+                    context.id, attempt, cfg.max_resumes, exc,
+                )
+                if attempt >= cfg.max_resumes:
+                    raise _abort(exc) from exc
+            else:
+                log.warning(
+                    "dispatch failed for %s (attempt %d/%d): %s",
+                    endpoint_name or context.id, attempt, max_attempts, exc,
+                )
+                if attempt >= max_attempts:
+                    raise RuntimeError(
+                        f"all attempts failed for {endpoint_name}: {exc}"
+                    ) from exc
+                FAILOVER_RETRIES.inc()
+            await _pace(exc)
+            continue
+
+        if span:
+            span.set_attr("instance", f"{instance_id:x}")
+            if attempt and not resume:
+                span.set_attr("retries", attempt)
+        segment_tokens = False
+        try:
+            async for item in stream:
+                has_tokens = bool(_get(item, "token_ids"))
+                if resume and has_tokens and death_t is not None:
+                    # the splice is live: the continuation's first TOKEN
+                    # arrived and the client never saw the seam (a
+                    # token-less finish chunk — e.g. an instant
+                    # deadline/cancel on the resumed engine — is not a
+                    # successful splice and must not count as one)
+                    RESUME_SECONDS.observe(time.monotonic() - death_t)
+                    MIDSTREAM_RESUMES.labels("ok").inc()
+                    resumes += 1
+                    if span:
+                        span.set_attr("resumes", resumes)
+                    death_t = None
+                    attempt = 0
+                    backoff.reset()
+                segment_tokens = segment_tokens or has_tokens
+                started = True
+                if progress is not None:
+                    item = progress.note(item)
+                yield item
+            return
+        except asyncio.CancelledError:
+            raise
+        except _STREAM_ERRORS as exc:
+            exclude.add(instance_id)
+            if progress is not None and progress.finished:
+                # the finish chunk was already delivered — the death
+                # took only the stream's trailing completion frame;
+                # resuming would emit tokens AFTER the client's finish
+                return
+            if not started:
+                # pre-first-token: classic failover, replay from scratch
+                attempt += 1
+                log.warning(
+                    "instance %x died before first item (attempt %d/%d); "
+                    "failing over", instance_id, attempt, max_attempts,
+                )
+                if attempt >= max_attempts:
+                    raise RuntimeError(
+                        f"all attempts failed for {endpoint_name}: {exc}"
+                    ) from exc
+                FAILOVER_RETRIES.inc()
+                await _pace(exc)
+                continue
+            if progress is None:
+                # tokens delivered but the stream is not resumable:
+                # the PR-5 clean abort
+                raise _abort(exc) from exc
+            if segment_tokens:
+                # this segment delivered tokens: a fresh migration
+                # window with a full resume budget
+                attempt = 0
+                backoff.reset()
+            else:
+                attempt += 1
+                MIDSTREAM_RESUMES.labels("failed").inc()
+                if attempt >= cfg.max_resumes:
+                    raise _abort(exc) from exc
+            if death_t is None:
+                death_t = time.monotonic()
+            left = progress.budget_left()
+            if left is not None and left <= 0:
+                # the dead worker had delivered its entire token budget;
+                # only the finish marker was lost
+                yield progress.synthesize_final("length")
+                return
+            log.warning(
+                "instance %x died mid-stream for %s after %d token(s); "
+                "migrating", instance_id, context.id, len(progress.emitted),
+            )
+            cur_req = progress.resume_request()
+            await _pace(exc)
+            continue
+        finally:
+            if done_cb is not None:
+                done_cb()

@@ -5,12 +5,16 @@ lib/runtime/src/pipeline/network/egress/push_router.rs:34-204) with the
 same modes: random, round-robin, direct, and a pluggable selector hook the
 KV-aware router uses (reference: lib/llm/src/kv_router.rs KvPushRouter).
 
-Failover (docs/robustness.md): dispatch failures AND streams that die
-before yielding a single item are re-dispatched to a different instance
-under a bounded retry budget with exponential backoff + jitter. A
-stream that dies AFTER items were yielded cannot be replayed (tokens
-already reached the client); it terminates with a clean error the HTTP
-layer turns into an SSE ``error`` event — never a hung connection.
+Failover + migration (docs/robustness.md): dispatch failures AND streams
+that die before yielding a single item are re-dispatched to a different
+instance under a bounded retry budget with exponential backoff + jitter.
+A stream that dies AFTER items were yielded is *migrated*: the request
+is re-dispatched as a resume (prompt extended by the delivered tokens,
+length budgets shrunk, RNG offset advanced — runtime/migration.py) and
+the continuation splices into the original stream. Only when migration
+is disabled, opted out, or exhausted does the stream terminate with a
+clean ``WorkerStreamLostError`` the HTTP layer turns into an SSE
+``error`` event — never a hung connection.
 """
 
 from __future__ import annotations
@@ -23,34 +27,23 @@ from typing import Any, AsyncIterator, Awaitable, Callable, Optional
 
 from dynamo_tpu.runtime.component import Client
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
-from dynamo_tpu.runtime.service import ConnectionLostError
-from dynamo_tpu.telemetry.instruments import (
-    FAILOVER_RETRIES,
-    MIDSTREAM_ABORTS,
+from dynamo_tpu.runtime.migration import (
+    DialFailedError,
+    MigrationConfig,
+    WorkerStreamLostError,
+    deadline_backoff_sleep,
+    migrating_stream,
 )
-from dynamo_tpu.utils.backoff import Backoff
+
+__all__ = [
+    "PushRouter",
+    "RouterMode",
+    "Selector",
+    "WorkerStreamLostError",
+    "deadline_backoff_sleep",
+]
 
 log = logging.getLogger("dynamo_tpu.runtime.push_router")
-
-
-class WorkerStreamLostError(RuntimeError):
-    """A worker died after streaming part of a response; the stream is
-    not replayable. Carries a clean, client-presentable message."""
-
-
-async def deadline_backoff_sleep(backoff: Backoff, context: Context) -> None:
-    """One failover backoff, clamped to the request's remaining deadline
-    budget; raises TimeoutError instead of retrying past the deadline.
-    Shared by PushRouter and KvPushRouter."""
-    delay = backoff.next_delay()
-    remaining = context.remaining_ms()
-    if remaining is not None:
-        if remaining <= 0:
-            raise asyncio.TimeoutError(
-                "request deadline exceeded during failover"
-            )
-        delay = min(delay, remaining / 1e3)
-    await asyncio.sleep(delay)
 
 # A selector maps (request, live instance ids) -> chosen instance id.
 Selector = Callable[[Any, list[int]], Awaitable[int]]
@@ -72,6 +65,8 @@ class PushRouter(AsyncEngine):
         max_attempts: int = 3,
         backoff_base_s: float = 0.05,
         backoff_cap_s: float = 2.0,
+        migration: Optional[MigrationConfig] = None,
+        admission: Any = None,
     ):
         self.client = client
         self.mode = mode
@@ -79,15 +74,31 @@ class PushRouter(AsyncEngine):
         self.max_attempts = max_attempts
         self.backoff_base_s = backoff_base_s
         self.backoff_cap_s = backoff_cap_s
+        # mid-stream migration config (None = env defaults) and the
+        # frontend's AdmissionController when co-located: resumes report
+        # through check(resume=True), which never sheds them
+        self.migration = migration or MigrationConfig.from_env()
+        self.admission = admission
         self._rr_index = 0
         if mode == RouterMode.CUSTOM and selector is None:
             raise ValueError("CUSTOM mode requires a selector")
 
-    async def _pick(self, request: Any, exclude: set[int]) -> int:
+    async def _pick(
+        self,
+        request: Any,
+        exclude: set[int],
+        wait_timeout_s: Optional[float] = None,
+    ) -> int:
         ids = [i for i in self.client.instance_ids() if i not in exclude]
         if not ids:
-            ids = await self.client.wait_for_instances()
-            ids = [i for i in ids if i not in exclude]
+            live = await self.client.wait_for_instances(wait_timeout_s)
+            ids = [i for i in live if i not in exclude]
+            if not ids:
+                # every live instance is excluded: fall back to the full
+                # set (mirrors KvRouter.schedule) — a transient dial
+                # failure must not permanently bar a recovered worker
+                # while a stream's resume budget burns
+                ids = list(live)
             if not ids:
                 raise RuntimeError(
                     f"no live instances for {self.client.endpoint.path}"
@@ -105,11 +116,9 @@ class PushRouter(AsyncEngine):
     async def _gen(self, request: Any, context: Context) -> AsyncIterator[Any]:
         from dynamo_tpu.telemetry import get_tracer
 
-        exclude: set[int] = set()
-        last_err: Exception | None = None
-        backoff = Backoff(base_s=self.backoff_base_s, cap_s=self.backoff_cap_s)
-        # one span for the whole routed dispatch (pick + stream); the
-        # worker's own span parents here via the wire's trace context
+        # one span for the whole routed dispatch (pick + stream + any
+        # resumes); the worker's own span parents here via the wire's
+        # trace context
         span = get_tracer().span(
             "router.dispatch", parent=context,
             attrs={"service": "frontend", "mode": self.mode.value},
@@ -117,54 +126,29 @@ class PushRouter(AsyncEngine):
         if span:
             context = context.child()
             context.set_trace(span)
+
+        async def dial(req, exclude, resume, wait_timeout_s):
+            instance_id = await self._pick(req, exclude, wait_timeout_s)
+            try:
+                stream = await self.client.generate_direct(
+                    instance_id, req, context
+                )
+            except (OSError, asyncio.TimeoutError, KeyError) as exc:
+                # worker vanished between discovery and dial: carry the
+                # id out so the retry excludes it
+                raise DialFailedError(instance_id, exc) from exc
+            return instance_id, stream, None
+
         try:
-            for attempt in range(self.max_attempts):
-                if attempt:
-                    FAILOVER_RETRIES.inc()
-                    await deadline_backoff_sleep(backoff, context)
-                instance_id = await self._pick(request, exclude)
-                try:
-                    stream = await self.client.generate_direct(
-                        instance_id, request, context
-                    )
-                except (OSError, asyncio.TimeoutError, KeyError) as exc:
-                    # worker vanished between discovery and dial: try another
-                    log.warning("instance %x unreachable: %s", instance_id, exc)
-                    exclude.add(instance_id)
-                    last_err = exc
-                    continue
-                span.set_attr("instance", f"{instance_id:x}")
-                if attempt:
-                    span.set_attr("retries", attempt)
-                yielded = False
-                try:
-                    async for item in stream:
-                        yielded = True
-                        yield item
-                    return
-                except ConnectionLostError as exc:
-                    # the WORKER died while this stream was open
-                    exclude.add(instance_id)
-                    last_err = exc
-                    if yielded:
-                        # tokens already reached the client: a silent
-                        # re-dispatch would replay/duplicate them. End
-                        # with a clean error instead (the HTTP layer
-                        # turns this into an SSE `error` event).
-                        MIDSTREAM_ABORTS.inc()
-                        span.set_attr("midstream_abort", True)
-                        raise WorkerStreamLostError(
-                            "worker connection lost mid-stream; partial "
-                            "response cannot be resumed"
-                        ) from exc
-                    log.warning(
-                        "instance %x died before first item; failing over",
-                        instance_id,
-                    )
-                    continue
-            raise RuntimeError(
-                f"all attempts failed for {self.client.endpoint.path}: {last_err}"
-            )
+            async for item in migrating_stream(
+                request, context, dial, self.migration,
+                admission=self.admission, span=span,
+                max_attempts=self.max_attempts,
+                backoff_base_s=self.backoff_base_s,
+                backoff_cap_s=self.backoff_cap_s,
+                endpoint_name=self.client.endpoint.path,
+            ):
+                yield item
         finally:
             span.end()
 

@@ -26,6 +26,7 @@ fleet sizing moves attainment the way the bench data says it should.
 
 from __future__ import annotations
 
+import random
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Optional
@@ -58,6 +59,18 @@ class SimConfig:
     probe_rate_per_s: float = 1.0
     probe_burst: float = 2.0
     spec_enabled: bool = True
+    # mid-stream migration (docs/robustness.md "Mid-stream migration"):
+    # on by default to match the live routers — a worker kill re-queues
+    # its in-flight streams as resumes (re-prefill of prompt+emitted,
+    # then the remaining tokens) instead of scoring them lost. Resumes
+    # bypass admission, exactly like the live plane. False restores the
+    # PR-5 every-death-is-lost behavior.
+    migration: bool = True
+    # fraction of resumes landing on a cache-hot target (fleet-wide
+    # prefix reuse / a prior placement of the same prefix): those pay
+    # the cheap onboard rate instead of a full re-prefill. Drawn from a
+    # per-resume seeded stream so replays stay bit-identical.
+    resume_cache_hot_frac: float = 0.0
     # injected stalls multiply decode latency by this until they lapse
     stall_factor: float = 4.0
     # ladder tightening: level>=1 scales the admission caps, level 3
@@ -75,6 +88,14 @@ class _InFlight:
     worker: int = -1
     ttft: float = 0.0
     itl: float = 0.0
+    # mid-stream migration state: tokens delivered before the last
+    # worker death, how many times this stream resumed, whether the
+    # current resume found a cache-hot target, and when the current
+    # decode segment started emitting
+    emitted: int = 0
+    resumed_n: int = 0
+    resume_hot: bool = False
+    decode_start_t: float = 0.0
 
 
 class SimConnector:
@@ -161,7 +182,11 @@ class FleetSim:
         self.arrived = 0
         self.shed = 0
         self.failed_frontend = 0
-        self.killed_inflight = 0
+        self.killed_inflight = 0  # in-flight streams hit by a kill
+        self.resumed = 0          # of those, mid-stream (≥1 token) resumes
+        self.resumed_hot = 0      # resumes onto a cache-hot target
+        self.refailed = 0         # pre-first-token kills replayed as failover
+        self.lost_inflight = 0    # of those, dropped (migration off)
         self.completed = 0
         self.met = 0
         self.goodput_tokens = 0
@@ -287,13 +312,59 @@ class FleetSim:
         if w is None:
             return
         self.workers_killed += 1
+        now = self.loop.now
+        requeued = False
         for rid in list(w.active):
-            rec = self._inflight.pop(rid, None)
-            if rec is not None:
-                # mid-stream death: the request's stream is gone — a
-                # hard SLO miss, scored so attainment feels the outage
-                self.killed_inflight += 1
+            rec = self._inflight.get(rid)
+            if rec is None:
+                continue
+            self.killed_inflight += 1
+            if not self.config.migration:
+                # PR-5 behavior: the stream is gone — a hard SLO miss,
+                # scored so attainment feels the outage
+                self._inflight.pop(rid, None)
+                self.lost_inflight += 1
                 self._outcomes.append(False)
+                continue
+            # mid-stream migration (mirrors the live routers): tokens
+            # already delivered stay delivered; the request re-prefills
+            # prompt+emitted elsewhere (cheap onboard when the target is
+            # cache-hot) and decodes the remainder. The migration gap
+            # lands in the stream's mean ITL at finish time. Resumes
+            # re-enter the prefill queue directly — they already paid
+            # for admission, exactly like the live bypass.
+            seg = 0
+            if rec.itl > 0 and now > rec.decode_start_t:
+                seg = int((now - rec.decode_start_t) / rec.itl)
+            remaining_before = rec.req.output_tokens - rec.emitted
+            rec.emitted += max(0, min(seg, remaining_before - 1))
+            if rec.emitted > 0:
+                # a true mid-stream resume — books like the live
+                # plane's dynamo_midstream_resumes_total{ok}
+                rec.resumed_n += 1
+                self.resumed += 1
+            else:
+                # the kill landed before this request's FIRST token —
+                # the live plane replays it from scratch
+                # (pre-first-token failover, FAILOVER_RETRIES), so
+                # resumed_n stays 0, the re-placement recomputes its
+                # TTFT, and it is NOT counted as a resume
+                self.refailed += 1
+            rec.worker = -1  # invalidates the pending finish event
+            if rec.emitted > 0:
+                rng = random.Random(f"resume:{rid}:{rec.resumed_n}")
+                rec.resume_hot = (
+                    rng.random() < self.config.resume_cache_hot_frac
+                )
+                if rec.resume_hot:
+                    self.resumed_hot += 1
+            else:
+                # failover replays pay a full re-prefill, like live
+                rec.resume_hot = False
+            self._prefill_queue.append(rec)
+            requeued = True
+        if requeued:
+            self._drain_prefill()
 
     # -- request lifecycle --------------------------------------------------
 
@@ -335,11 +406,17 @@ class FleetSim:
         while self._prefill_queue and self._prefill_busy < self.prefill_capacity:
             rec = self._prefill_queue.popleft()
             self._prefill_busy += 1
-            dur = (
-                rec.req.prompt_tokens / self.config.worker.prefill_tok_s
-                + rec.frontend_delay
+            # the frontend fault delay applies once (the first pass);
+            # resumes re-prefill prompt + delivered tokens, at onboard
+            # speed when the placement is cache-hot
+            delay, rec.frontend_delay = rec.frontend_delay, 0.0
+            tokens = rec.req.prompt_tokens + rec.emitted
+            rate = (
+                self.config.worker.onboard_tok_s
+                if rec.resume_hot
+                else self.config.worker.prefill_tok_s
             )
-            self.loop.after(dur, self._on_prefill_done, rec)
+            self.loop.after(tokens / rate + delay, self._on_prefill_done, rec)
 
     def _on_prefill_done(self, rec: _InFlight) -> None:
         self._prefill_busy = max(0, self._prefill_busy - 1)
@@ -362,27 +439,45 @@ class FleetSim:
         worker.admit(rec.req.rid, blocks)
         now = self.loop.now
         rec.worker = worker.wid
-        rec.ttft = now - rec.req.t + self.config.worker.first_step_s
+        if rec.resumed_n == 0:
+            # a resume's first token already streamed before the kill:
+            # its TTFT stands; only the original placement sets it
+            rec.ttft = now - rec.req.t + self.config.worker.first_step_s
         rec.itl = worker.itl_s(now, self.spec_enabled)
+        rec.decode_start_t = now + self.config.worker.first_step_s
+        remaining = rec.req.output_tokens - rec.emitted
         self.loop.after(
-            self.config.worker.first_step_s
-            + rec.req.output_tokens * rec.itl,
+            self.config.worker.first_step_s + remaining * rec.itl,
             self._on_finish, rec.req.rid, worker.wid,
         )
         return True
 
     def _on_finish(self, rid: int, wid: int) -> None:
-        rec = self._inflight.pop(rid, None)
+        # get-then-pop: a STALE finish event (superseded by a kill that
+        # migrated this request elsewhere) must not evict the live
+        # record — only the finish from the request's current worker
+        # consumes it
+        rec = self._inflight.get(rid)
         if rec is None or rec.worker != wid:
             return  # superseded by a kill
+        self._inflight.pop(rid, None)
         worker = self.workers.get(wid)
         if worker is not None and rid in worker.active:
             worker.release(rid)
             if worker.draining and worker.occupancy == 0:
                 self._remove_worker(wid)
+        itl = rec.itl
+        if rec.resumed_n:
+            # the migration gap (re-prefill + queue wait) lands in the
+            # stream's mean inter-token latency, exactly as the live
+            # SLO tracker (mean decode ITL) would observe it
+            first_token_t = rec.req.t + rec.ttft
+            itl = (self.loop.now - first_token_t) / max(
+                1, rec.req.output_tokens
+            )
         met = (
             rec.ttft * 1e3 <= self.config.slo_ttft_ms
-            and rec.itl * 1e3 <= self.config.slo_itl_ms
+            and itl * 1e3 <= self.config.slo_itl_ms
         )
         self._outcomes.append(met)
         self.completed += 1
@@ -444,6 +539,10 @@ class FleetSim:
             "shed": self.shed,
             "failed_frontend": self.failed_frontend,
             "killed_inflight": self.killed_inflight,
+            "resumed": self.resumed,
+            "resumed_hot": self.resumed_hot,
+            "refailed": self.refailed,
+            "lost_inflight": self.lost_inflight,
             "unfinished": unfinished,
             # of ADMITTED work (the Tail-at-Scale contract: what you
             # accept, you serve well)

@@ -28,6 +28,11 @@ from dataclasses import dataclass
 @dataclass(frozen=True)
 class WorkerProfile:
     prefill_tok_s: float = 20_000.0   # pooled prefill server speed
+    # cache-hot resume onboarding (mid-stream migration, docs/
+    # robustness.md): when the target already holds the request's
+    # prefix KV, the "re-prefill" is a block onboard, not a forward
+    # pass — an order of magnitude cheaper than prefill_tok_s
+    onboard_tok_s: float = 200_000.0
     decode_tok_s_max: float = 2_000.0  # saturated per-worker ceiling
     n_half: int = 16                   # occupancy at half-ceiling
     batch_slots: int = 64

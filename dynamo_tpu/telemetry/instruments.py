@@ -299,7 +299,25 @@ FAILOVER_RETRIES = REGISTRY.counter(
 MIDSTREAM_ABORTS = REGISTRY.counter(
     "dynamo_midstream_aborts_total",
     "Streams terminated with a clean error after their worker died "
-    "mid-generation (tokens already streamed; not retryable)",
+    "mid-generation AND migration could not save them (disabled, "
+    "opted out, penalty-ineligible, or every resume attempt failed)",
+)
+MIDSTREAM_RESUMES = REGISTRY.counter(
+    "dynamo_midstream_resumes_total",
+    "Mid-stream migration outcomes: result=ok counts successful "
+    "splices (the resumed worker's first continuation token reached "
+    "the client), result=failed counts resume attempts that died "
+    "before splicing a token (dispatch failure or pre-splice stream "
+    "loss; the stream then retries or falls back to the abort)",
+    labels=("result",),  # ok | failed
+)
+RESUME_SECONDS = REGISTRY.histogram(
+    "dynamo_midstream_resume_seconds",
+    "Mid-stream migration latency: worker-death detection to the first "
+    "spliced continuation token (covers re-schedule, re-dispatch, and "
+    "the resume re-prefill — cache-hot placements sit in the low "
+    "buckets)",
+    buckets=_STEP_BUCKETS,
 )
 
 # -- autoscaling planner (planner/planner.py; docs/autoscaling.md) ----------

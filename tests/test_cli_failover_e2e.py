@@ -12,6 +12,24 @@ import urllib.request
 from cli_harness import MODEL_DIR, CliFleet, complete, free_port, wait_http
 
 
+def _metric_value(port: int, name: str, **labels) -> float:
+    """Sum of one family's samples on a /metrics page (0 if absent),
+    via the repo's strict exposition parser, filtered by label values."""
+    from prom_parser import parse as prom_parse
+
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10
+    ).read().decode()
+    family = prom_parse(body).get(name)
+    if family is None:
+        return 0.0
+    total = 0.0
+    for (_sample, label_items), value in family.samples.items():
+        if all(dict(label_items).get(k) == v for k, v in labels.items()):
+            total += value
+    return total
+
+
 def test_worker_death_failover():
     store_port = free_port()
     http_port = free_port()
@@ -73,6 +91,125 @@ def test_worker_death_failover():
             lambda b: b"llm_workers_reporting 1" in b.replace(b".0", b""),
             timeout=60,
         )
+        fleet.assert_alive()
+    finally:
+        fleet.teardown()
+
+
+def test_mid_stream_kill_migrates_byte_identical():
+    """ISSUE-14 acceptance: SIGKILL the serving worker after tokens have
+    streamed; with a survivor available the client receives ONE
+    uninterrupted SSE stream whose full greedy text is byte-identical
+    to a no-kill run — no SSE error, no duplicate or missing tokens at
+    the splice — and the frontend counts a resume, not an abort.
+
+    Worker A (the victim) runs with an injected per-step delay (proven
+    output-neutral by the chaos suite) so the stream outlives worker
+    B's spawn + registration; B is clean and serves both the resumed
+    continuation and the no-kill baseline."""
+    store_port = free_port()
+    http_port = free_port()
+    metrics_port = free_port()
+    fleet = CliFleet()
+    try:
+        fleet.spawn("store", "--host", "127.0.0.1", "--port", str(store_port))
+        time.sleep(2)
+        common = ["--store-host", "127.0.0.1", "--store-port", str(store_port)]
+        victim = fleet.spawn(
+            "run", "--in", "dyn://mig.backend.generate", "--out", "jax",
+            "--model-path", MODEL_DIR, *common,
+            env={"DYN_FAULTS": "seed=1;engine.step:delay=0.5"},
+        )
+        fleet.spawn(
+            "run", "--in", "http", "--out", "dyn://mig.backend.generate",
+            "--model-path", MODEL_DIR, "--http-port", str(http_port),
+            *common,
+        )
+        fleet.spawn(
+            "metrics", "--namespace", "mig", "--component", "backend",
+            "--port", str(metrics_port), *common,
+        )
+        wait_http(
+            f"http://127.0.0.1:{http_port}/v1/models",
+            lambda b: json.loads(b)["data"],
+        )
+        prompt = "migration byte identity"
+        # the victim's stream must OUTLIVE the survivor's spawn +
+        # registration even on a loaded machine (JIT prewarm can take
+        # ~60 s there — see wait_for_instances): 240 tokens at the
+        # injected 0.5 s/step keep it alive ≥120 s, matching the
+        # reporting-wait ceiling below; in the good case the kill lands
+        # within seconds and the survivor finishes the rest fast
+        n_tokens = 240
+        body = json.dumps({
+            "model": "tiny_llama_model", "prompt": prompt,
+            "max_tokens": n_tokens, "stream": True, "temperature": 0,
+            "ext": {"ignore_eos": True},
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http_port}/v1/completions", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        resp = urllib.request.urlopen(req, timeout=60)
+        first = resp.readline()
+        assert first.startswith(b"data:"), first
+        # tokens are flowing on the (slow) victim: bring up the survivor
+        survivor = fleet.spawn(
+            "run", "--in", "dyn://mig.backend.generate", "--out", "jax",
+            "--model-path", MODEL_DIR, *common,
+        )
+        assert survivor is not None
+        wait_http(
+            f"http://127.0.0.1:{metrics_port}/metrics",
+            lambda b: b"llm_workers_reporting 2" in b.replace(b".0", b""),
+            timeout=120,
+        )
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=10)
+        fleet.forget(victim)
+        # drain the stream: it must complete cleanly (no error event)
+        lines = [first]
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = resp.readline()
+            if not line:
+                break
+            lines.append(line)
+        text = b"".join(lines).decode()
+        assert "event: error" not in text, text[-2000:]
+        assert "[DONE]" in text, text[-2000:]
+        chunks = [
+            json.loads(ln[len("data:"):].strip())
+            for ln in text.splitlines()
+            if ln.startswith("data:") and "[DONE]" not in ln
+        ]
+        streamed = "".join(
+            c["choices"][0].get("text") or "" for c in chunks if c.get("choices")
+        )
+        finishes = [
+            c["choices"][0].get("finish_reason")
+            for c in chunks if c.get("choices")
+        ]
+        assert finishes[-1] == "length", finishes[-5:]
+        # the no-kill baseline: the same greedy request on the survivor
+        base_body = json.dumps({
+            "model": "tiny_llama_model", "prompt": prompt,
+            "max_tokens": n_tokens, "temperature": 0,
+            "ext": {"ignore_eos": True},
+        }).encode()
+        base = json.load(urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{http_port}/v1/completions", data=base_body,
+            headers={"Content-Type": "application/json"},
+        ), timeout=180))
+        assert base["choices"][0]["finish_reason"] == "length"
+        assert streamed == base["choices"][0]["text"]
+        # the frontend counted a successful resume and NO abort
+        assert _metric_value(
+            http_port, "dynamo_midstream_resumes_total", result="ok"
+        ) >= 1
+        assert _metric_value(
+            http_port, "dynamo_midstream_aborts_total"
+        ) == 0
         fleet.assert_alive()
     finally:
         fleet.teardown()

@@ -298,3 +298,45 @@ def test_point_engine_step_and_liveness_names():
         faults.fire("engine.step")
     faults.fire("engine.step")  # exhausted
     faults.fire("worker.liveness")  # no rule: no-op
+
+
+async def test_point_router_resume_fires_before_resume_dispatch():
+    """router.resume (runtime/migration.py): the double-fault point —
+    a plan can fail the mid-stream migration machinery itself. An
+    injected error counts as a failed resume attempt; the router-level
+    recovery path is covered in tests/test_migration.py."""
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.runtime.migration import (
+        MigrationConfig,
+        WorkerStreamLostError,
+        migrating_stream,
+    )
+    from dynamo_tpu.runtime.service import ConnectionLostError
+
+    async def dying_stream():
+        yield {"token_ids": [5]}
+        raise ConnectionLostError("gone")
+
+    dials = []
+
+    async def dial(req, exclude, resume, wait_s):
+        dials.append(resume)
+        return 1, dying_stream(), None
+
+    faults.activate(parse_plan("seed=0;router.resume:error@max=2"))
+    try:
+        req = {"token_ids": [1, 2], "stop": None}
+        got = []
+        with pytest.raises(WorkerStreamLostError):
+            async for item in migrating_stream(
+                req, Context(), dial,
+                MigrationConfig(max_resumes=2, instance_wait_s=0.1),
+                backoff_base_s=0.001, backoff_cap_s=0.002,
+            ):
+                got.append(item)
+        # the first dispatch streamed one token; both resume attempts
+        # died at the injected point before any dial happened
+        assert got and got[0]["token_ids"] == [5]
+        assert dials == [False]
+    finally:
+        faults.deactivate()

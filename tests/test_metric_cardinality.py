@@ -67,6 +67,11 @@ _REQUIRED_SERIES = [
     "dynamo_spec_accepted_tokens_total",
     # ISSUE 13: the serve-phase compile fence (DYN_COMPILE_FENCE)
     "dynamo_compile_fence_events_total",
+    # ISSUE 14: mid-stream migration (docs/robustness.md)
+    "dynamo_midstream_resumes_total",
+    "dynamo_midstream_resume_seconds",
+    "dynamo_midstream_aborts_total",
+    "dynamo_failover_retries_total",
 ]
 
 
@@ -133,6 +138,11 @@ def test_observability_series_are_registered():
     assert REGISTRY.get("dynamo_blackbox_dumps_total").label_names == (
         "reason",
     )
+    # migration outcomes key on the bounded {ok, failed} result set
+    assert REGISTRY.get("dynamo_midstream_resumes_total").label_names == (
+        "result",
+    )
+    assert REGISTRY.get("dynamo_midstream_resume_seconds").label_names == ()
 
 
 def test_metric_catalog_docs_match_registry():

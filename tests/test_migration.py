@@ -1,0 +1,692 @@
+"""Mid-stream request migration (docs/robustness.md "Mid-stream
+migration"): the routers' resume/splice machinery, the scheduler's
+cache-hot resume bias, the admission bypass, and the engine's
+resume_offset RNG contract.
+
+The fake worker here is a *faithful* miniature of the engine contract:
+deterministic next-token function of the LAST token only (so a resume
+from an extended prompt continues exactly like greedy decoding would),
+segment-local cum_log_probs, and a final chunk carrying its own
+prompt/completion counts — which is precisely what the splice must
+re-anchor."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu import faults
+from dynamo_tpu.http.admission import AdmissionConfig, AdmissionController
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context, collect
+from dynamo_tpu.runtime.migration import (
+    MigrationConfig,
+    StreamProgress,
+    WorkerStreamLostError,
+    resumable,
+)
+from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+from dynamo_tpu.runtime.service import ConnectionLostError
+from dynamo_tpu.telemetry.instruments import (
+    MIDSTREAM_ABORTS,
+    MIDSTREAM_RESUMES,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+def _next_tok(t: int) -> int:
+    return (t * 7 + 13) % 997
+
+
+def _reference_run(token_ids, n):
+    """What an unkilled greedy run emits for this prompt."""
+    out, t = [], token_ids[-1]
+    for _ in range(n):
+        t = _next_tok(t)
+        out.append(t)
+    return out
+
+
+class FakeWorker:
+    """Engine-contract fake: yields one token per item (dict-shaped,
+    like the wire), then a final chunk; optionally dies after
+    ``die_after`` items. Records every request it served."""
+
+    def __init__(self, die_after=None):
+        self.die_after = die_after
+        self.requests = []
+
+    async def stream(self, request):
+        self.requests.append(request)
+        toks = list(request.token_ids)
+        budget = request.stop.max_tokens
+        emitted = 0
+        cum = 0.0
+        while budget is None or emitted < budget:
+            if self.die_after is not None and emitted >= self.die_after:
+                raise ConnectionLostError("worker died mid-stream")
+            t = _next_tok(toks[-1])
+            toks.append(t)
+            emitted += 1
+            cum -= 0.5
+            yield {
+                "request_id": request.request_id,
+                "token_ids": [t],
+                "cum_log_probs": cum,
+            }
+            await asyncio.sleep(0)
+        yield {
+            "request_id": request.request_id,
+            "token_ids": [],
+            "finish_reason": "length",
+            "prompt_tokens": len(request.token_ids),
+            "completion_tokens": emitted,
+        }
+
+
+class _Endpoint:
+    path = "test.migration.generate"
+
+
+class FakeClient:
+    """Duck-typed runtime Client: a dict of live workers."""
+
+    def __init__(self, workers):
+        self.workers = dict(workers)
+        self.endpoint = _Endpoint()
+
+    def instance_ids(self):
+        return sorted(self.workers)
+
+    async def wait_for_instances(self, timeout_s=None):
+        ids = self.instance_ids()
+        if not ids:
+            raise asyncio.TimeoutError("no instances")
+        return ids
+
+    async def generate_direct(self, instance_id, request, context=None):
+        worker = self.workers.get(instance_id)
+        if worker is None:
+            raise KeyError(f"instance {instance_id:x} not found")
+        return worker.stream(request)
+
+
+def _req(prompt=None, max_tokens=8, **kw):
+    return PreprocessedRequest(
+        request_id="mig-1",
+        token_ids=list(prompt or [1, 2, 3]),
+        sampling=SamplingOptions(use_greedy=True),
+        stop=StopConditions(max_tokens=max_tokens),
+        **kw,
+    )
+
+
+def _val(metric, *labels):
+    return metric.labels(*labels).value
+
+
+def _router(client, **kw):
+    kw.setdefault("migration", MigrationConfig(instance_wait_s=0.5))
+    return PushRouter(client, RouterMode.ROUND_ROBIN, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the splice
+# ---------------------------------------------------------------------------
+
+
+async def test_midstream_death_resumes_and_splices_exactly():
+    """Kill after 3 delivered tokens: the client sees ONE stream whose
+    token sequence is bit-identical to an unkilled run — no repeats, no
+    gaps — and the abort counter stays untouched."""
+    # round-robin picks index 1 of the sorted ids first: the dying
+    # worker sits at id 2 so the first dispatch lands on it
+    dying, survivor = FakeWorker(die_after=3), FakeWorker()
+    client = FakeClient({1: survivor, 2: dying})
+    router = _router(client)
+    ok0 = _val(MIDSTREAM_RESUMES, "ok")
+    aborts0 = MIDSTREAM_ABORTS.labels().value
+    req = _req(max_tokens=8)
+
+    items = await asyncio.wait_for(
+        collect(router.generate(req, Context())), timeout=10
+    )
+    toks = [t for i in items for t in i.get("token_ids", [])]
+    assert toks == _reference_run(req.token_ids, 8)
+    assert _val(MIDSTREAM_RESUMES, "ok") == ok0 + 1
+    assert MIDSTREAM_ABORTS.labels().value == aborts0
+
+    # the resume the survivor saw: prompt extended by the 3 delivered
+    # tokens, budget shrunk, RNG offset advanced, same request id
+    assert len(survivor.requests) == 1
+    res = survivor.requests[0]
+    assert res.token_ids == req.token_ids + toks[:3]
+    assert res.stop.max_tokens == 5
+    assert res.resume_offset == 3
+    assert res.request_id == req.request_id
+
+    # usage on the final chunk is re-anchored to the ORIGINAL request
+    final = items[-1]
+    assert final["finish_reason"] == "length"
+    assert final["prompt_tokens"] == len(req.token_ids)
+    assert final["completion_tokens"] == 8
+
+    # cum_log_probs is continuous across the splice (each segment
+    # restarts at 0 engine-side; the splice re-anchors)
+    cums = [i["cum_log_probs"] for i in items if "cum_log_probs" in i]
+    assert cums == pytest.approx([-0.5 * (k + 1) for k in range(8)])
+
+
+async def test_double_migration_survives_two_spaced_deaths():
+    """Each splice that delivers tokens resets the resume budget: a
+    stream can migrate any number of times as long as it progresses."""
+    # dispatch order under round-robin + exclusion: 2 (dies after 2
+    # tokens), 1 (dies after 3 more), 3 (completes)
+    w1, w2, w3 = FakeWorker(die_after=3), FakeWorker(die_after=2), FakeWorker()
+    client = FakeClient({1: w1, 2: w2, 3: w3})
+    router = _router(client)
+    req = _req(max_tokens=10)
+    items = await asyncio.wait_for(
+        collect(router.generate(req, Context())), timeout=10
+    )
+    toks = [t for i in items for t in i.get("token_ids", [])]
+    assert toks == _reference_run(req.token_ids, 10)
+    # second resume extends by BOTH segments' deliveries
+    assert w3.requests[0].token_ids == req.token_ids + toks[:5]
+    assert w3.requests[0].resume_offset == 5
+    assert items[-1]["completion_tokens"] == 10
+
+
+async def test_budget_exhausted_death_synthesizes_final():
+    """The worker died having delivered every budgeted token — only the
+    finish marker was lost. Nothing remains to resume; the router
+    completes the stream itself with stitched usage."""
+    dying = FakeWorker(die_after=4)
+    client = FakeClient({1: FakeWorker(), 2: dying})
+    router = _router(client)
+    req = _req(max_tokens=4)
+    items = await asyncio.wait_for(
+        collect(router.generate(req, Context())), timeout=10
+    )
+    toks = [t for i in items for t in i.get("token_ids", [])]
+    assert toks == _reference_run(req.token_ids, 4)
+    final = items[-1]
+    assert final["finish_reason"] == "length"
+    assert final["completion_tokens"] == 4
+    assert final["prompt_tokens"] == len(req.token_ids)
+
+
+async def test_death_after_delivered_finish_does_not_resume():
+    """The finish chunk reached the client, then the transport died
+    before the stream's clean end: the answer is complete — no resume,
+    no extra tokens, no duplicate final, no abort."""
+
+    class FinishThenDie(FakeWorker):
+        async def stream(self, request):
+            async for item in super().stream(request):
+                yield item
+            raise ConnectionLostError("died after the finish chunk")
+
+    survivor = FakeWorker()
+    client = FakeClient({1: survivor, 2: FinishThenDie()})
+    router = _router(client)
+    ok0 = _val(MIDSTREAM_RESUMES, "ok")
+    aborts0 = MIDSTREAM_ABORTS.labels().value
+    req = _req(max_tokens=4)
+    items = await asyncio.wait_for(
+        collect(router.generate(req, Context())), timeout=10
+    )
+    toks = [t for i in items for t in i.get("token_ids", [])]
+    assert toks == _reference_run(req.token_ids, 4)
+    # exactly one final, no tokens after it, and the survivor never ran
+    finals = [i for i in items if i.get("finish_reason")]
+    assert len(finals) == 1 and items[-1] is finals[0]
+    assert survivor.requests == []
+    assert _val(MIDSTREAM_RESUMES, "ok") == ok0
+    assert MIDSTREAM_ABORTS.labels().value == aborts0
+
+
+async def test_transient_dial_failure_does_not_bar_recovered_worker():
+    """A resume dial that fails transiently excludes the worker for the
+    next pick, but exclusion must not become a permanent bar: when it
+    empties the candidate set, _pick falls back to the full live set
+    (mirroring KvRouter.schedule) and the recovered worker completes
+    the stream."""
+
+    class FlakyClient(FakeClient):
+        def __init__(self, workers, flaky, failures):
+            super().__init__(workers)
+            self.flaky = flaky
+            self.failures = failures
+
+        async def generate_direct(self, instance_id, request, context=None):
+            if instance_id == self.flaky and self.failures > 0:
+                self.failures -= 1
+                raise asyncio.TimeoutError("transient dial timeout")
+            return await super().generate_direct(
+                instance_id, request, context
+            )
+
+    # worker 2 dies mid-stream and stays dead (dial always refused via
+    # its absence after death); worker 1 refuses ONE resume dial then
+    # recovers
+    dying = FakeWorker(die_after=3)
+
+    class DyingGoneClient(FlakyClient):
+        async def generate_direct(self, instance_id, request, context=None):
+            if instance_id == 2 and dying.requests:
+                raise OSError("connection refused")  # stays dead
+            return await super().generate_direct(
+                instance_id, request, context
+            )
+
+    client = DyingGoneClient({1: FakeWorker(), 2: dying}, flaky=1, failures=1)
+    router = _router(
+        client, migration=MigrationConfig(max_resumes=4, instance_wait_s=0.2)
+    )
+    req = _req(max_tokens=8)
+    items = await asyncio.wait_for(
+        collect(router.generate(req, Context())), timeout=15
+    )
+    toks = [t for i in items for t in i.get("token_ids", [])]
+    assert toks == _reference_run(req.token_ids, 8)
+    assert items[-1]["finish_reason"] == "length"
+
+
+async def test_dial_failure_excludes_the_instance():
+    """A picked instance that refuses the dial is excluded from the
+    retry, so a selector that deterministically prefers it cannot burn
+    the whole attempt budget on one corpse (the PR-5 exclusion,
+    preserved through DialFailedError)."""
+
+    class RefusingClient(FakeClient):
+        def __init__(self, workers, refuse):
+            super().__init__(workers)
+            self.refuse = set(refuse)
+            self.dials = []
+
+        async def generate_direct(self, instance_id, request, context=None):
+            self.dials.append(instance_id)
+            if instance_id in self.refuse:
+                raise OSError("connection refused")
+            return await super().generate_direct(
+                instance_id, request, context
+            )
+
+    survivor = FakeWorker()
+    client = RefusingClient({1: survivor, 2: FakeWorker()}, refuse={2})
+    router = _router(client)  # round-robin dials the refusing 2 first
+    req = _req(max_tokens=4)
+    items = await asyncio.wait_for(
+        collect(router.generate(req, Context())), timeout=10
+    )
+    toks = [t for i in items for t in i.get("token_ids", [])]
+    assert toks == _reference_run(req.token_ids, 4)
+    # the corpse was dialed exactly once, then excluded
+    assert client.dials == [2, 1]
+
+
+# ---------------------------------------------------------------------------
+# the abort fallback
+# ---------------------------------------------------------------------------
+
+
+async def test_opt_out_keeps_clean_abort():
+    dying = FakeWorker(die_after=3)
+    client = FakeClient({1: FakeWorker(), 2: dying})
+    router = _router(client)
+    aborts0 = MIDSTREAM_ABORTS.labels().value
+    req = _req(max_tokens=8, migration=False)
+    got = []
+    with pytest.raises(WorkerStreamLostError):
+        async for item in router.generate(req, Context()):
+            got.append(item)
+    assert len(got) == 3  # delivered tokens stand; no resume happened
+    assert MIDSTREAM_ABORTS.labels().value == aborts0 + 1
+
+
+async def test_penalty_requests_are_not_migratable():
+    req = _req(max_tokens=8)
+    req.sampling.frequency_penalty = 0.5
+    assert not resumable(req)
+    dying = FakeWorker(die_after=2)
+    client = FakeClient({1: FakeWorker(), 2: dying})
+    router = _router(client)
+    with pytest.raises(WorkerStreamLostError):
+        await collect(router.generate(req, Context()))
+
+
+async def test_exhausted_resumes_fall_back_to_abort():
+    """Every candidate dies pre-splice: bounded attempts, failed
+    counter, then the PR-5 abort."""
+    client = FakeClient({
+        1: FakeWorker(die_after=0),
+        2: FakeWorker(die_after=3),
+        3: FakeWorker(die_after=0),
+        4: FakeWorker(die_after=0),
+    })
+    router = _router(
+        client,
+        migration=MigrationConfig(max_resumes=3, instance_wait_s=0.2),
+    )
+    failed0 = _val(MIDSTREAM_RESUMES, "failed")
+    aborts0 = MIDSTREAM_ABORTS.labels().value
+    with pytest.raises(WorkerStreamLostError):
+        await asyncio.wait_for(
+            collect(router.generate(_req(max_tokens=8), Context())),
+            timeout=20,
+        )
+    assert _val(MIDSTREAM_RESUMES, "failed") == failed0 + 3
+    assert MIDSTREAM_ABORTS.labels().value == aborts0 + 1
+
+
+async def test_no_survivors_aborts_within_resume_window():
+    """The lone worker dies mid-stream: resume attempts hit the bounded
+    instance wait (NOT the 300 s discovery budget) and fall back to the
+    abort promptly."""
+    dying = FakeWorker(die_after=2)
+
+    class LonelyClient(FakeClient):
+        async def generate_direct(self, instance_id, request, context=None):
+            stream = await super().generate_direct(
+                instance_id, request, context
+            )
+            # after the death the worker is gone entirely
+            async def wrap():
+                try:
+                    async for item in stream:
+                        yield item
+                except ConnectionLostError:
+                    self.workers.clear()
+                    raise
+
+            return wrap()
+
+    client = LonelyClient({1: dying})
+    router = _router(
+        client, migration=MigrationConfig(max_resumes=2, instance_wait_s=0.2)
+    )
+    with pytest.raises(WorkerStreamLostError):
+        await asyncio.wait_for(
+            collect(router.generate(_req(), Context())), timeout=10
+        )
+
+
+# ---------------------------------------------------------------------------
+# admission bypass
+# ---------------------------------------------------------------------------
+
+
+def test_admission_resume_flag_never_sheds():
+    ctl = AdmissionController(AdmissionConfig(), load_fn=lambda: None)
+    ctl.force_shed = True
+    ctl._probes.take(ctl.config.probe_burst)  # drain the probe trickle
+    assert ctl.check() is not None  # fresh requests shed
+    assert ctl.check(resume=True) is None  # resumes always admitted
+    assert ctl.resumed_total == 1
+
+
+async def test_saturated_frontend_still_completes_migrated_stream():
+    """ISSUE-14 satellite: with admission shedding every fresh request
+    (force_shed, probe bucket drained), a stream that was admitted
+    before saturation still migrates and completes."""
+    ctl = AdmissionController(AdmissionConfig(), load_fn=lambda: None)
+    # round-robin picks index 1 of the sorted ids first: the dying
+    # worker sits at id 2 so the first dispatch lands on it
+    dying, survivor = FakeWorker(die_after=3), FakeWorker()
+    client = FakeClient({1: survivor, 2: dying})
+    router = _router(client, admission=ctl)
+    req = _req(max_tokens=8)
+    stream = router.generate(req, Context())
+    items = [await stream.__anext__() for _ in range(2)]
+    # saturation arrives mid-stream
+    ctl.force_shed = True
+    ctl._probes.take(ctl.config.probe_burst)
+    assert ctl.check() is not None  # fresh traffic 429s
+    async for item in stream:
+        items.append(item)
+    toks = [t for i in items for t in i.get("token_ids", [])]
+    assert toks == _reference_run(req.token_ids, 8)
+    assert items[-1]["finish_reason"] == "length"
+    assert ctl.resumed_total >= 1
+
+
+# ---------------------------------------------------------------------------
+# the router.resume fault point (double fault)
+# ---------------------------------------------------------------------------
+
+
+async def test_fault_point_kills_first_resume_then_recovers():
+    # round-robin picks index 1 of the sorted ids first: the dying
+    # worker sits at id 2 so the first dispatch lands on it
+    dying, survivor = FakeWorker(die_after=3), FakeWorker()
+    client = FakeClient({1: survivor, 2: dying})
+    router = _router(client)
+    ok0 = _val(MIDSTREAM_RESUMES, "ok")
+    failed0 = _val(MIDSTREAM_RESUMES, "failed")
+    faults.activate(faults.parse_plan("seed=3;router.resume:error@max=1"))
+    try:
+        req = _req(max_tokens=8)
+        items = await asyncio.wait_for(
+            collect(router.generate(req, Context())), timeout=10
+        )
+    finally:
+        faults.deactivate()
+    toks = [t for i in items for t in i.get("token_ids", [])]
+    assert toks == _reference_run(req.token_ids, 8)
+    assert _val(MIDSTREAM_RESUMES, "failed") == failed0 + 1
+    assert _val(MIDSTREAM_RESUMES, "ok") == ok0 + 1
+
+
+# ---------------------------------------------------------------------------
+# KV-routed migration: cache-hot resume placement
+# ---------------------------------------------------------------------------
+
+
+def test_kv_scheduler_resume_boost_prefers_cache_hot():
+    """schedule(resume=True) doubles the overlap term the selector
+    sees (crossing load gradients a fresh request would respect) while
+    the decision still reports the TRUE overlap, and the boundary case
+    — a cache-hot worker maximally loaded vs an idle cold one — flips
+    from a tie to a deterministic cache-hot pick."""
+    from dynamo_tpu.kv_router.indexer import KvIndexer
+    from dynamo_tpu.kv_router.protocols import (
+        ForwardPassMetrics,
+        KvCacheEvent,
+        RouterEvent,
+    )
+    from dynamo_tpu.kv_router.scheduler import KvMetricsAggregator, KvScheduler
+    from dynamo_tpu.tokens import hash_sequence
+
+    indexer = KvIndexer(block_size=4)
+    agg = KvMetricsAggregator()
+    tokens = list(range(4))  # one block
+    _, hashes = hash_sequence(tokens, 4)
+    indexer.apply(RouterEvent(
+        worker_id=1, event_id=1,
+        event=KvCacheEvent(op="stored", block_hashes=hashes,
+                           token_block_size=4),
+    ))
+    # worker 1: holds the prefix, but KV-full with the deepest queue
+    # (logit 2*1 - 1.0 - 1.0 = 0); worker 2: idle and cold (logit 0) —
+    # a dead tie for a fresh request
+    agg.update(ForwardPassMetrics(
+        worker_id=1, gpu_cache_usage_perc=1.0, num_requests_waiting=4,
+    ))
+    agg.update(ForwardPassMetrics(
+        worker_id=2, gpu_cache_usage_perc=0.0, num_requests_waiting=0,
+    ))
+    seen = []
+
+    def capture(overlaps, metrics, candidates):
+        seen.append(dict(overlaps.scores))
+        from dynamo_tpu.kv_router.scheduler import default_selector
+
+        return default_selector(overlaps, metrics, candidates)
+
+    sched = KvScheduler(indexer, agg, selector=capture)
+    sched.inflight_ttl_s = 0.0  # isolate the overlap term
+    resume = sched.schedule(tokens, [1, 2], resume=True)
+    # the boosted overlap breaks the tie deterministically toward the
+    # cache-hot worker (2*2*1 - 2.0 = 2 > 0)
+    assert resume.worker_id == 1
+    assert seen[0] == {1: 1 * sched.resume_overlap_boost}
+    # the decision reports the TRUE overlap, not the boosted score
+    assert resume.overlap_blocks == 1
+    # a fresh request's selector sees the raw (unboosted) overlap —
+    # the dead-tie stands and either worker is a legitimate pick
+    fresh = sched.schedule(tokens, [1, 2])
+    assert seen[1] == {1: 1}
+    assert fresh.worker_id in (1, 2)
+
+
+async def test_kv_push_router_migrates_with_resume_scheduling():
+    """KvPushRouter end to end over a stub KvRouter: the resume is
+    scheduled with resume=True and the splice is exact."""
+    from dynamo_tpu.kv_router.router import KvPushRouter
+    from dynamo_tpu.kv_router.scheduler import SchedulingDecision
+
+    # the stub scheduler picks the lowest non-excluded id: the dying
+    # worker sits at id 1 so the first dispatch lands on it
+    dying, survivor = FakeWorker(die_after=3), FakeWorker()
+    client = FakeClient({1: dying, 2: survivor})
+    calls = []
+    released = []
+
+    class StubScheduler:
+        def note_done(self, wid, token=None):
+            released.append((wid, token))
+
+    class StubRouter:
+        def __init__(self):
+            self.client = client
+            self.scheduler = StubScheduler()
+
+        def schedule(self, token_ids, exclude=None, resume=False):
+            calls.append((len(token_ids), set(exclude or ()), resume))
+            wid = min(w for w in client.instance_ids()
+                      if w not in (exclude or ()))
+            return SchedulingDecision(
+                worker_id=wid, overlap_blocks=0, total_blocks=1,
+                dispatch_token=float(len(calls)),
+            )
+
+    router = KvPushRouter(
+        StubRouter(), migration=MigrationConfig(instance_wait_s=0.5)
+    )
+    req = _req(max_tokens=8)
+    items = await asyncio.wait_for(
+        collect(router.generate(req, Context())), timeout=10
+    )
+    toks = [t for i in items for t in i.get("token_ids", [])]
+    assert toks == _reference_run(req.token_ids, 8)
+    # first dispatch fresh, second a resume with the dead worker
+    # excluded and the token_ids extended by the delivered tokens
+    assert calls[0] == (len(req.token_ids), set(), False)
+    assert calls[1] == (len(req.token_ids) + 3, {1}, True)
+    # every segment released its in-flight scheduling charge
+    assert [w for w, _ in released] == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# StreamProgress units
+# ---------------------------------------------------------------------------
+
+
+def test_resume_request_composes_from_the_original():
+    req = _req(prompt=[5, 6], max_tokens=10)
+    req.stop.min_tokens = 4
+    p = StreamProgress(req)
+    p.note({"token_ids": [7, 8], "cum_log_probs": -1.0})
+    r1 = p.resume_request()
+    assert r1.token_ids == [5, 6, 7, 8]
+    assert r1.stop.max_tokens == 8
+    assert r1.stop.min_tokens == 2
+    assert r1.resume_offset == 2
+    # a later migration still builds from the ORIGINAL request
+    p.note({"token_ids": [9], "cum_log_probs": -0.25})
+    r2 = p.resume_request()
+    assert r2.token_ids == [5, 6, 7, 8, 9]
+    assert r2.stop.max_tokens == 7
+    assert r2.resume_offset == 3
+    # continuation items are re-anchored
+    item = p.note({"token_ids": [10], "cum_log_probs": -0.5})
+    assert item["cum_log_probs"] == pytest.approx(-1.75)
+
+
+def test_resumable_shapes():
+    assert resumable(_req())
+    assert not resumable({"x": 1})
+    assert not resumable(_req(migration=False))
+    assert resumable(
+        {"token_ids": [1, 2], "sampling": {"temperature": 0.7}}
+    )
+    assert not resumable(
+        {"token_ids": [1, 2], "sampling": {"presence_penalty": 1.0}}
+    )
+
+
+# ---------------------------------------------------------------------------
+# the engine RNG contract: resume_offset continues the sample stream
+# ---------------------------------------------------------------------------
+
+
+async def _engine_tokens(engine, req):
+    out = []
+    async for item in engine.as_async_engine().generate(req, Context()):
+        out.extend(item.token_ids)
+    return out
+
+
+@pytest.mark.parametrize("seed", [11, None])
+async def test_engine_resume_offset_continues_sampled_stream(seed):
+    """The acceptance contract behind bit-identical migration: a resume
+    whose prompt carries the delivered tokens and whose resume_offset
+    equals their count regenerates EXACTLY the tokens the original
+    request would have produced — for an explicit seed AND for the
+    request-id-hashed default stream."""
+    import os
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    model_dir = os.path.join(
+        os.path.dirname(__file__), "data", "tiny_llama_model"
+    )
+    engine = await JaxEngine.launch(EngineConfig(
+        model_path=model_dir, model_name="tiny", random_weights=True,
+        num_blocks=128, block_size=8, max_batch_size=8,
+        prefill_chunk_size=32, max_model_len=256,
+    ))
+    try:
+        prompt = list(range(1, 24))
+        sampling = SamplingOptions(temperature=0.9, top_k=20, seed=seed)
+        full = await _engine_tokens(engine, PreprocessedRequest(
+            request_id="resume-contract", token_ids=prompt,
+            sampling=sampling.model_copy(),
+            stop=StopConditions(max_tokens=10, ignore_eos=True),
+        ))
+        assert len(full) == 10
+        # resume from the 4-token splice point: same request id, prompt
+        # extended by the delivered tokens, offset = delivered count
+        cont = await _engine_tokens(engine, PreprocessedRequest(
+            request_id="resume-contract", token_ids=prompt + full[:4],
+            sampling=sampling.model_copy(),
+            stop=StopConditions(max_tokens=6, ignore_eos=True),
+            resume_offset=4,
+        ))
+        assert cont == full[4:]
+        # and WITHOUT the offset the streams diverge (the contract is
+        # doing real work) — greedy would mask this, sampling cannot
+        cont_no_off = await _engine_tokens(engine, PreprocessedRequest(
+            request_id="resume-contract", token_ids=prompt + full[:4],
+            sampling=sampling.model_copy(),
+            stop=StopConditions(max_tokens=6, ignore_eos=True),
+        ))
+        assert cont_no_off != full[4:]
+    finally:
+        await engine.shutdown()

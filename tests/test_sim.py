@@ -255,7 +255,11 @@ def test_http_request_faults_fail_or_delay_requests():
     assert res["completed"] == res["requests"] - 5
 
 
-def test_worker_kill_drops_inflight_and_frees_nothing_twice():
+def test_worker_kill_migrates_inflight_streams():
+    """Mid-stream migration default (mirrors the live routers): a kill
+    re-queues in-flight streams as resumes instead of dropping them —
+    every request still completes exactly once (conservation holds with
+    nothing lost)."""
     trace = diurnal_trace(
         120.0, seed=4, base_rps=10.0, peak_rps=10.0, period_s=120.0
     )
@@ -263,7 +267,102 @@ def test_worker_kill_drops_inflight_and_frees_nothing_twice():
     res = FleetSim(trace, SimConfig(initial_decode=2), plan=plan).run()
     assert res["workers_killed"] == 1
     assert res["killed_inflight"] > 0
+    # every killed stream was re-queued: mid-stream deaths as resumes,
+    # pre-first-token deaths as failover replays — none lost
+    assert res["resumed"] + res["refailed"] == res["killed_inflight"]
+    assert res["resumed"] > 0
+    assert res["lost_inflight"] == 0
     assert res["decode_workers_final"] == 1  # nobody heals a planner-less fleet
-    assert res["completed"] + res["killed_inflight"] + res["shed"] + res[
+    assert res["completed"] + res["shed"] + res["unfinished"] == res["requests"]
+
+
+def test_worker_kill_drops_inflight_with_migration_off():
+    """migration=False restores the PR-5 behavior: every mid-stream
+    death is lost and scored as an SLO miss, and the old conservation
+    identity (lost requests never complete) holds."""
+    trace = diurnal_trace(
+        120.0, seed=4, base_rps=10.0, peak_rps=10.0, period_s=120.0
+    )
+    plan = parse_plan("seed=2;worker.liveness:kill@after=30")
+    res = FleetSim(
+        trace, SimConfig(initial_decode=2, migration=False), plan=plan
+    ).run()
+    assert res["workers_killed"] == 1
+    assert res["killed_inflight"] > 0
+    assert res["resumed"] == 0
+    assert res["lost_inflight"] == res["killed_inflight"]
+    assert res["completed"] + res["lost_inflight"] + res["shed"] + res[
         "unfinished"
     ] == res["requests"]
+
+
+def test_pre_first_token_kill_recomputes_ttft():
+    """A kill landing before the request's FIRST token is a failover,
+    not a mid-stream resume: the live plane replays it from scratch, so
+    the re-placement must recompute TTFT instead of keeping the dead
+    placement's optimistic stamp (an emitted stream keeps its TTFT)."""
+    from dynamo_tpu.sim.fleet import _InFlight
+    from dynamo_tpu.sim.traces import SimRequest
+
+    fleet = FleetSim([], SimConfig(initial_decode=2))
+    fleet._spawn_worker(initial=True)
+    fleet._spawn_worker(initial=True)
+    rec = _InFlight(req=SimRequest(rid=1, t=0.0, prompt_tokens=64,
+                                   output_tokens=50))
+    fleet._inflight[1] = rec
+    assert fleet._try_place(rec)
+    ttft0 = rec.ttft
+    # the kill lands within first_step_s: zero tokens ever streamed
+    fleet._kill_worker(rec.worker)
+    assert fleet.killed_inflight == 1
+    assert fleet.refailed == 1 and fleet.resumed == 0
+    assert rec.emitted == 0 and rec.resumed_n == 0
+    # re-placed later, TTFT is the REAL (later) first-token time
+    fleet.loop._now = 7.0
+    assert fleet._try_place(rec)
+    assert rec.ttft > ttft0
+    assert rec.ttft == 7.0 - rec.req.t + fleet.config.worker.first_step_s
+    # whereas a stream with delivered tokens keeps its original TTFT
+    rec.emitted = 3
+    fleet._kill_worker(rec.worker)
+    assert rec.resumed_n == 1
+    ttft_mid = rec.ttft
+    fleet._spawn_worker(initial=True)  # both originals are dead now
+    fleet.loop._now = 20.0
+    assert fleet._try_place(rec)
+    assert rec.ttft == ttft_mid
+
+
+def test_migration_beats_loss_and_cache_hot_beats_cold():
+    """The kill-recovery ladder the live plane implements: migration
+    completes streams a kill would have lost, and a cache-hot resume
+    (cheap onboard) finishes sooner than a cold re-prefill."""
+    trace = diurnal_trace(
+        120.0, seed=4, base_rps=10.0, peak_rps=10.0, period_s=120.0
+    )
+
+    def run(migration, hot_frac=0.0):
+        plan = parse_plan("seed=2;worker.liveness:kill@after=30")
+        # slow prefill makes the re-prefill cost visible in finish times
+        cfg = SimConfig(
+            initial_decode=2, migration=migration,
+            resume_cache_hot_frac=hot_frac,
+            worker=WorkerProfile(prefill_tok_s=2_000.0),
+        )
+        return FleetSim(trace, cfg, plan=plan).run()
+
+    lost = run(False)
+    cold = run(True, hot_frac=0.0)
+    hot = run(True, hot_frac=1.0)
+    assert cold["completed"] > lost["completed"]
+    assert hot["resumed_hot"] == hot["resumed"] > 0
+    assert cold["resumed_hot"] == 0
+    # cache-hot resumes onboard instead of re-prefilling, so they don't
+    # burn the (deliberately slow) prefill pool's capacity: the hot
+    # fleet keeps the no-migration fleet's SLO numbers AND completes
+    # the killed streams, while cold re-prefill pays visibly
+    assert hot["completed"] == cold["completed"]
+    assert hot["met"] > cold["met"]
+    assert hot["goodput_tokens"] > cold["goodput_tokens"]
+    # and determinism survives the migration path
+    assert run(True, hot_frac=1.0) == hot
