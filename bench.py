@@ -72,6 +72,16 @@ when the profile has no baseline (seed with ``--update-baseline``).
 ``--quick`` shrinks the workload for the CI CPU-interpret smoke tier;
 ``DYN_SENTINEL_REPORT=path`` writes the report JSON as an artifact.
 
+``--guided`` is the guided-decoding A/B (docs/guided_decoding.md): the
+same workload at decode_steps=1 runs once unconstrained and once under
+a canned bounded JSON schema whose [B, V] allow-mask rides every
+sampling step; vs_baseline = guided/plain throughput — the mask's
+hot-path cost as a measured number. A guided-under-spec stanza reports
+the accept rate with masks on (proposals filter through the automaton,
+the verify step applies identical per-position masks);
+DYN_BENCH_GUIDED_SPEC=0 skips it, DYN_BENCH_GUIDED_TOKENIZER points the
+mask compiler at a different vocabulary.
+
 ``--overlap`` is the serial-vs-overlap A/B (docs/performance.md): the
 same workload at decode_steps=1 runs once with --no-overlap (fully
 serial plan -> dispatch -> sync -> emit) and once with the overlapped
@@ -175,7 +185,7 @@ def _kv_bytes_per_token(mc, kv_dtype: str = None) -> float:
 
 async def _run(
     model_cfg, wl, spec: bool = False, decode_steps=None, slo=None,
-    overlap: bool = True, kv_dtype: str = None,
+    overlap: bool = True, kv_dtype: str = None, guided: dict = None,
 ) -> dict:
     """``slo`` = (ttft_ms, itl_ms) targets; when set, the result dict
     gains slo_attainment / goodput_tokens / requests_met from the
@@ -186,7 +196,14 @@ async def _run(
     reports ``device_idle_frac``: the OverlapTracker's idle-gap growth
     over the measured window divided by wall time (0.0 = the device
     always had a dispatched step to chew on; the serial loop's value is
-    exactly the host plan+unpack+emit share the pipeline removes)."""
+    exactly the host plan+unpack+emit share the pipeline removes).
+
+    ``guided`` (a GuidedOptions-shaped dict) runs every request under
+    that constraint (docs/guided_decoding.md): the engine loads the
+    DYN_BENCH_GUIDED_TOKENIZER vocabulary (default: the tiny test
+    tokenizer — mask COST is shape-dependent, not content-dependent),
+    prewarms the masked variants, and each request decodes through the
+    allow-mask on the serial step path (guided's divert discipline)."""
     if os.environ.get("DYN_STEP_TRACE"):
         # step-trace forensics print via logging.INFO; the bench is a
         # bare script, so wire a handler or the trace silently drops
@@ -201,6 +218,7 @@ async def _run(
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.engine import JaxEngine
     from dynamo_tpu.protocols.common import (
+        GuidedOptions,
         PreprocessedRequest,
         SamplingOptions,
         StopConditions,
@@ -208,8 +226,21 @@ async def _run(
     from dynamo_tpu.runtime.engine import Context
 
     kv_dtype = kv_dtype or _bench_kv_dtype()
+    # guided runs need a real tokenizer vocabulary to compile the mask
+    # against; the synthetic bench model has none, so the tiny test
+    # tokenizer stands in (mask hot-path cost depends on [B, V] shape,
+    # not on which ids are allowed)
+    guided_tok = os.environ.get(
+        "DYN_BENCH_GUIDED_TOKENIZER",
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tests", "data", "tiny_llama_model",
+        ),
+    )
     cfg = EngineConfig(
-        model_path="", model_name="bench", random_weights=True,
+        model_path=guided_tok if guided else "",
+        model_name="bench", random_weights=True,
+        prewarm_guided=bool(guided),
         quantization="int8" if wl["quant"] == "int8" else None,
         kv_cache_dtype=kv_dtype,
         num_blocks=wl["num_blocks"], block_size=wl["block_size"],
@@ -269,6 +300,7 @@ async def _run(
             token_ids=prompt,
             sampling=SamplingOptions(use_greedy=True),
             stop=StopConditions(max_tokens=wl["osl"], ignore_eos=True),
+            guided=GuidedOptions(**guided) if guided else None,
         )
         t_start = time.monotonic()
         t_first = None
@@ -494,6 +526,82 @@ def _main_spec_overlap_ab(model_cfg, wl) -> None:
         f"plain-overlap={plain['tput']:.1f} tok/s, "
         f"accept={out['config']['accept_rate']:.2%}, "
         f"draft_hidden={piped['spec_draft_hidden_frac']:.2%}",
+        file=sys.stderr,
+    )
+
+
+# canned bench schema: bounded everywhere (strings capped, enum moods,
+# boolean) so a random-weights model always terminates the document —
+# what the A/B measures is the mask's hot-path cost, not schema luck
+GUIDED_BENCH_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "name": {"type": "string", "maxLength": 8},
+        "ok": {"type": "boolean"},
+        "mood": {"enum": ["happy", "sad", "neutral"]},
+        "score": {"type": "string", "pattern": "[0-9]{1,3}"},
+    },
+    "required": ["name", "ok", "mood", "score"],
+}
+
+
+def _main_guided_ab(model_cfg, wl) -> None:
+    """--guided: unconstrained vs schema-masked A/B at decode_steps=1
+    (docs/guided_decoding.md) — the mask's hot-path cost as a measured
+    number: per step the engine builds a [B, V] bool mask on host,
+    ships it with the batch, and the jitted step drops disallowed
+    logits to -inf before sampling. vs_baseline = guided/plain
+    throughput on the identical workload (< 1.0 by the mask's cost;
+    the gap IS the number). A guided-under-spec stanza reports the
+    accept rate with masks on (drafts filter through the automaton
+    before the verify step applies identical per-position masks);
+    DYN_BENCH_GUIDED_SPEC=0 skips it."""
+    guided_spec = {"kind": "json_schema", "json_schema": GUIDED_BENCH_SCHEMA}
+    plain = asyncio.run(_run(model_cfg, wl, decode_steps=1))
+    guided = asyncio.run(
+        _run(model_cfg, wl, decode_steps=1, guided=guided_spec)
+    )
+    cfg = {
+        "model": wl["model_name"],
+        "batch": wl["batch"],
+        "isl": wl["isl"],
+        "osl": wl["osl"],
+        "schema": "bench-canned-v1",
+        "plain_tok_s": round(plain["tput"], 2),
+        "guided_tok_s": round(guided["tput"], 2),
+        "p99_itl_ms_plain": round(plain["p99_itl_s"] * 1000, 2),
+        "p99_itl_ms_guided": round(guided["p99_itl_s"] * 1000, 2),
+        "guided_device_idle_frac": guided["overlap"]["device_idle_frac"],
+    }
+    if os.environ.get("DYN_BENCH_GUIDED_SPEC", "1") != "0":
+        gspec = asyncio.run(
+            _run(model_cfg, wl, spec=True, decode_steps=1, guided=guided_spec)
+        )
+        prop, acc = gspec["spec_proposed"], gspec["spec_accepted"]
+        cfg["spec"] = {
+            "guided_spec_tok_s": round(gspec["tput"], 2),
+            "proposed_tokens": prop,
+            "accepted_tokens": acc,
+            "accept_rate": round(acc / prop, 4) if prop else 0.0,
+            "drafter": os.environ.get("DYN_BENCH_SPEC_DRAFTER", "ngram"),
+            "spec_tokens": int(os.environ.get("DYN_BENCH_SPEC_TOKENS", "4")),
+        }
+    out = {
+        "metric": "engine_guided_ab_1chip",
+        "value": round(guided["tput"], 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(guided["tput"] / max(plain["tput"], 1e-9), 4),
+        "config": cfg,
+    }
+    print(json.dumps(out))
+    spec_note = (
+        f" spec-accept={cfg['spec']['accept_rate']:.2%}"
+        if "spec" in cfg else ""
+    )
+    print(
+        f"# guided A/B: plain={plain['tput']:.1f} "
+        f"guided={guided['tput']:.1f} tok/s "
+        f"(x{out['vs_baseline']:.3f}){spec_note}",
         file=sys.stderr,
     )
 
@@ -1253,6 +1361,9 @@ def main() -> None:
         return
     if "--overlap" in sys.argv[1:]:
         _main_overlap_ab(model_cfg, wl)
+        return
+    if "--guided" in sys.argv[1:]:
+        _main_guided_ab(model_cfg, wl)
         return
     if "--matmul" in sys.argv[1:]:
         _main_matmul_ab(model_cfg, wl)

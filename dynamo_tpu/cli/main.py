@@ -84,6 +84,11 @@ def build_parser() -> argparse.ArgumentParser:
                      help="max draft tokens verified per sequence per "
                           "step (K); each decode step then emits 1..K+1 "
                           "tokens per sequence")
+    run.add_argument("--prewarm-guided", action="store_true",
+                     help="prewarm the guided-decoding (allow-mask) "
+                          "step variants (needs --decode-steps 1): "
+                          "keeps structured-output traffic free of "
+                          "mid-serve compiles (docs/guided_decoding.md)")
     run.add_argument("--no-overlap", action="store_true",
                      help="disable the overlapped decode pipeline "
                           "(docs/performance.md): restores the fully "

@@ -173,6 +173,19 @@ class EngineConfig:
     # the same startup-cost reason; the first such request pays a
     # one-time compile stall instead.
     prewarm_logprobs: bool = False
+    # likewise for the guided-decoding (allow-mask) step variants
+    # (docs/guided_decoding.md): the masked serial prefill/decode
+    # shapes, plus the masked spec-verify rectangle on spec engines.
+    # Deployments serving structured-output traffic should turn this on
+    # — it is what keeps a guided run serve-compile-free under
+    # DYN_COMPILE_FENCE. The masked variant set mirrors the flags
+    # above: guided+penalties/bias warm only with prewarm_penalties,
+    # guided+top-logprobs only with prewarm_logprobs — combos outside
+    # the opted-in set pay the same documented first-use compile their
+    # unguided counterparts pay. Guided requests need decode_steps == 1
+    # (the mask advances on host per committed token), so this flag
+    # does too.
+    prewarm_guided: bool = False
     # observability (telemetry/{recorder,slo}.py; docs/observability.md)
     # step flight recorder: ring of the last N step records, auto-dumped
     # to JSONL around anomalies. 0 disables recording entirely.
@@ -246,6 +259,7 @@ def load_engine_config(args: Any) -> EngineConfig:
         ),
         spec_decode=getattr(args, "spec_decode", "") or "",
         spec_tokens=getattr(args, "spec_tokens", EngineConfig.spec_tokens),
+        prewarm_guided=getattr(args, "prewarm_guided", False),
         overlap=not getattr(args, "no_overlap", False),
         host_kv_blocks=getattr(args, "host_kv_blocks", 0),
         disk_kv_blocks=getattr(args, "disk_kv_blocks", 0),

@@ -86,6 +86,7 @@ from dynamo_tpu.telemetry.instruments import (
     ENGINE_REQUESTS_FINISHED,
     ENGINE_STEP_SECONDS,
     ENGINE_TOKENS_GENERATED,
+    GUIDED_REQUESTS,
     KV_POOL_BLOCKS_ACTIVE,
     KV_POOL_BLOCKS_TOTAL,
     KV_POOL_CACHED_FREE_BLOCKS,
@@ -236,6 +237,11 @@ class JaxEngine:
         self._drafter = None
         self._spec_step_fn: Optional[Callable] = None
         self._chain_spec_fn: Optional[Callable] = None
+        # guided decoding (dynamo_tpu/guided; docs/guided_decoding.md):
+        # the served tokenizer, loaded lazily on the first guided
+        # request (submit thread — compiles never stall the step loop)
+        # or eagerly when config.prewarm_guided
+        self._guided_tokenizer = None
         # runtime suspend (degradation ladder rung 2, planner/
         # degradation.py): flipped from the asyncio thread, read by the
         # engine thread each step — a plain bool attr is race-free here
@@ -417,6 +423,15 @@ class JaxEngine:
             from dynamo_tpu.spec import build_drafter
 
             self._drafter = build_drafter(cfg.spec_decode)
+        if cfg.prewarm_guided and cfg.decode_steps > 1:
+            # guided requests themselves are rejected per-request at
+            # submit() on fused-window engines; a config asking to
+            # prewarm their variants there is a deployment mistake
+            raise ValueError(
+                "prewarm_guided requires decode_steps == 1 (guided "
+                "masks advance on host per committed token; fused "
+                "windows sample K tokens per dispatch)"
+            )
         if cfg.num_nodes > 1:
             # multi-host bring-up (reference: MultiNodeConfig, engines.rs:41)
             jax.distributed.initialize(
@@ -1192,6 +1207,11 @@ class JaxEngine:
                     for (bf, pw), pn in p_nexts.items():
                         if bf == b_from:
                             self._chain_fn(lasts[b_from], pn, idx)
+        if self.config.prewarm_guided:
+            self._prewarm_guided(
+                chunks, decode_buckets, sampling_for, prefill_arrays,
+                decode_arrays,
+            )
         if self.kvbm is not None and self._mh_broadcast is None:
             # (single-host manager only: the multihost sharded offload
             # runs mirrored gathers, a different program)
@@ -1215,6 +1235,99 @@ class JaxEngine:
             jax.block_until_ready(self.k_cache)
         ENGINE_PREWARM_SECONDS.set(time.monotonic() - t0)
         log.info("prewarm done in %.1fs", time.monotonic() - t0)
+
+    def _prewarm_guided(
+        self, chunks, decode_buckets, sampling_for, prefill_arrays,
+        decode_arrays,
+    ) -> None:
+        """Warm the guided (allow-mask) jit variants — the masked
+        serial prefill rectangles and decode buckets, plus the masked
+        spec-verify rectangle on spec engines (docs/guided_decoding.md).
+        The mask is a presence-keyed sampling-pytree entry, so each is
+        its own compiled signature; an unwarmed one would land as a
+        mid-serve compile exactly when the first structured-output
+        request arrives (the compile fence flags it). Runs AFTER the
+        base warms, so every cache input already carries the
+        steady-state sharding. Guided serving is serial by design
+        (overlap/spec pipelines flush to serial), so no chained
+        device-column masked variants exist to warm."""
+        sched = self.scheduler
+        assert sched is not None and self.model_config is not None
+        V = self.model_config.vocab_size
+
+        def masked(s: SamplingBatch, b: int, S: Optional[int] = None):
+            out = SamplingBatch(dict(s.arrays))
+            shape = (b, V) if S is None else (b, S, V)
+            out.arrays["allow_mask"] = np.ones(shape, dtype=bool)
+            return out
+
+        # masked variants mirror the base prewarm's opt-in flag policy
+        # (penalties/bias under prewarm_penalties, top-logprobs under
+        # prewarm_logprobs): a guided request combined with a feature
+        # whose flag is off pays the same documented first-use compile
+        # the unguided feature pays
+        feat_variants: list[tuple[bool, bool, bool]] = [
+            (False, False, False)
+        ]
+        if self.config.prewarm_logprobs:
+            feat_variants.append((False, True, False))
+        if self.config.prewarm_penalties:
+            feat_variants.append((True, False, False))
+            feat_variants.append((False, False, True))
+        for chunk in chunks:
+            for b in sched.prefill_batch_buckets:
+                if (
+                    b > sched.prefill_batch_buckets[0]
+                    and b * chunk > sched.max_prefill_tokens
+                ):
+                    continue
+                for pv, tv, bv in feat_variants:
+                    a = prefill_arrays(b, chunk)
+                    s = masked(
+                        sampling_for(b, penalties=pv, toplp=tv, bias=bv), b
+                    )
+                    out = self._step_fn(
+                        self.params, self.k_cache, self.v_cache,
+                        a["tokens"], a["positions"], a["slot_mapping"],
+                        a["block_tables"], a["context_lens"],
+                        a["last_token_idx"], s.arrays,
+                    )
+                    self.k_cache, self.v_cache = out[-2], out[-1]
+                    jax.block_until_ready(self.k_cache)
+        for Bd in decode_buckets:
+            for pv, tv, bv in feat_variants:
+                a = decode_arrays(Bd)
+                s = masked(
+                    sampling_for(Bd, penalties=pv, toplp=tv, bias=bv), Bd
+                )
+                out = self._step_fn(
+                    self.params, self.k_cache, self.v_cache,
+                    a["tokens"], a["positions"], a["slot_mapping"],
+                    a["block_tables"], a["context_lens"],
+                    a["last_token_idx"], s.arrays,
+                )
+                self.k_cache, self.v_cache = out[-2], out[-1]
+                jax.block_until_ready(self.k_cache)
+        if self._spec_step_fn is not None:
+            Ssp = self.config.spec_tokens + 1
+            width = sched.table_width_pad or sched.TABLE_BUCKET
+            for Bd in decode_buckets:
+                sa = {
+                    "tokens": np.zeros((Bd, Ssp), np.int32),
+                    "positions": np.zeros((Bd, Ssp), np.int32),
+                    "slot_mapping": np.zeros((Bd * Ssp,), np.int32),
+                    "block_tables": np.zeros((Bd, width), np.int32),
+                    "context_lens": np.zeros((Bd,), np.int32),
+                    "draft_lens": np.zeros((Bd,), np.int32),
+                }
+                s = masked(sampling_for(Bd), Bd, S=Ssp)
+                packed, self.k_cache, self.v_cache = self._spec_step_fn(
+                    self.params, self.k_cache, self.v_cache,
+                    sa["tokens"], sa["positions"], sa["slot_mapping"],
+                    sa["block_tables"], sa["context_lens"],
+                    sa["draft_lens"], s.arrays,
+                )
+                jax.block_until_ready(packed)
 
     def _gate_kv_offload(self) -> None:
         """Restore-vs-recompute gate for the G2 host tier: probe the
@@ -2498,6 +2611,12 @@ class JaxEngine:
 
         B = arrays["tokens"].shape[0]
         sampling = self._batch_sampling(seqs, B)
+        gmask = self._guided_allow_mask(seqs, B)
+        if gmask is not None:
+            # guided rows constrain the sampled token (prefill's first
+            # token and every serial decode step); selects the masked
+            # jit variant (prewarmed under config.prewarm_guided)
+            sampling.arrays["allow_mask"] = gmask
 
         if plan.kind == "decode" and self._multi_step_fn is not None:
             t0 = time.monotonic()
@@ -2636,11 +2755,19 @@ class JaxEngine:
                 # _emit_window anyway, but their KV writes would still
                 # need blocks the growth reserve never budgeted
                 budget = self._spec_budget(seq)
-                proposals.append(
+                props = (
                     self._draft_tokens(seq, budget)
                     if self._seq_spec_enabled(seq)
                     else []
                 )
+                if props and seq.guided_state is not None:
+                    # guided spec: proposals filter through the SAME
+                    # automaton the verify masks apply — a draft the
+                    # mask would reject can never be proposed, so the
+                    # accepted prefix is exactly what serial guided
+                    # decode would have committed
+                    props = seq.guided_state.filter_drafts(props)
+                proposals.append(props)
             # the draft-phase histogram covers PROPOSAL cost only (the
             # drafter-tuning signal) — staging/array/sampling prep
             # below is fixed per-step engine work, not drafter work
@@ -2667,6 +2794,11 @@ class JaxEngine:
         arrays = sched.build_spec_arrays(works, S)
         B = arrays["tokens"].shape[0]
         sampling = self._batch_sampling(seqs, B)
+        gmask = self._guided_spec_masks(works, S, B)
+        if gmask is not None:
+            # [B, S, V] per-position masks: verify applies the identical
+            # transform the serial masked path would at each position
+            sampling.arrays["allow_mask"] = gmask
         t0 = time.monotonic()
         try:
             packed = self._dispatch_spec_step(arrays, sampling)
@@ -3163,14 +3295,21 @@ class JaxEngine:
         """Batches that must take the SERIAL step instead of the
         overlapped decode pipeline: penalty/bias generated-token counts
         live on host one step behind dispatch (a lagged count would
-        change the sampled distribution), and top-logprobs rides a
+        change the sampled distribution), top-logprobs rides a
         separately-compiled step variant whose chained-token signature
         is deliberately not prewarmed (mirrors the window pipeline's
-        penalties_in gate)."""
+        penalties_in gate), and guided sequences FLUSH TO SERIAL by
+        construction: step N+1's allow-mask is a function of step N's
+        sampled token, so it cannot be known at N+1's dispatch time —
+        the pipeline would have to dispatch with a stale mask
+        (docs/guided_decoding.md "Divert conditions"). This covers the
+        plain decode pipeline AND the overlapped spec pipeline (both
+        gate on this predicate)."""
         return (
             self._wants_toplp(seqs)
             or any(s.request.sampling.needs_penalties for s in seqs)
             or any(s.request.sampling.logit_bias for s in seqs)
+            or any(s.guided_state is not None for s in seqs)
         )
 
     def _decode_pipeline(self, seqs: list, plan_ms: float = 0.0) -> None:
@@ -3392,6 +3531,71 @@ class JaxEngine:
         return SamplingBatch.from_options(
             opts, seeds, gen_counts, prompt_ids, top_lp
         )
+
+    # ------------------------------------------------------------------
+    # Guided decoding (dynamo_tpu/guided; docs/guided_decoding.md)
+    # ------------------------------------------------------------------
+    def _guided_automaton(self, spec):
+        """Resolve a request's guided spec to a TokenAutomaton through
+        the process-wide compile LRU (submit thread: a compile or a
+        tokenizer load never stalls the step loop)."""
+        from dynamo_tpu.guided import automaton_for
+
+        if self._guided_tokenizer is None:
+            from dynamo_tpu.tokenizer import Tokenizer
+
+            self._guided_tokenizer = Tokenizer.from_file(
+                self.config.model_path
+            )
+        mc = self.model_config
+        assert mc is not None
+        eos = set(mc.eos_token_ids) | set(self.eos_token_ids)
+        return automaton_for(
+            spec,
+            self._guided_tokenizer,
+            self.config.model_path or self.config.model_name,
+            mc.vocab_size,
+            eos,
+        )
+
+    def _guided_allow_mask(
+        self, seqs: list, B: int
+    ) -> Optional[np.ndarray]:
+        """[B, V_pad] bool allow-mask for a serial prefill/decode batch,
+        or None when no sequence is guided. Unguided (and pad) rows are
+        all-True — the mask variant constrains only the rows that asked
+        for it. Pure host work over cached per-state masks (no device
+        arrays; DL010-clean)."""
+        if not any(s.guided_state is not None for s in seqs):
+            return None
+        assert self.model_config is not None
+        m = np.ones((B, self.model_config.vocab_size), dtype=bool)
+        for i, s in enumerate(seqs):
+            if s.guided_state is not None:
+                m[i] = s.guided_state.allow_mask()
+        return m
+
+    def _guided_spec_masks(
+        self, works: list, S: int, B: int
+    ) -> Optional[np.ndarray]:
+        """[B, S, V_pad] per-position masks for a spec verify batch
+        (``works`` rows are (seq, [carry] + kept_drafts)), or None when
+        no row is guided. Position j of a guided row is the automaton
+        state after its first j drafts commit — the SAME mask sequence
+        the serial path would apply step by step, which is what makes
+        guided speculative verification exact. Positions past a row's
+        kept drafts (never emitted) and unguided rows stay all-True."""
+        if not any(seq.guided_state is not None for seq, _ in works):
+            return None
+        assert self.model_config is not None
+        V = self.model_config.vocab_size
+        m = np.ones((B, S, V), dtype=bool)
+        for i, (seq, row) in enumerate(works):
+            gs = seq.guided_state
+            if gs is None:
+                continue
+            m[i, : len(row)] = gs.masks_for_drafts(row[1:])
+        return m
 
     def _dispatch_multi_step(
         self,
@@ -4219,6 +4423,28 @@ class JaxEngine:
                 h.update(offset.to_bytes(8, "little"))
                 h.update(np.ascontiguousarray(arr).tobytes())
             salt = DEFAULT_SALT ^ int.from_bytes(h.digest(), "little")
+        guided_automaton = None
+        if request.guided is not None:
+            # guided decoding (docs/guided_decoding.md): compile (or
+            # LRU-fetch) the token automaton HERE, on the submit thread
+            # — a bad schema fails this request alone, and a compile
+            # never stalls the engine thread mid-step
+            if self.config.decode_steps != 1:
+                raise ValueError(
+                    "guided decoding requires decode_steps == 1 (the "
+                    "allow-mask advances on host per committed token; "
+                    "fused windows sample K tokens per dispatch)"
+                )
+            if request.resume_offset:
+                # a migrated request's generated tokens are folded into
+                # token_ids with no boundary marker — the automaton
+                # cursor cannot be reconstructed (the router refuses to
+                # resume guided requests for the same reason)
+                raise ValueError(
+                    "guided requests cannot resume mid-stream"
+                )
+            guided_automaton = self._guided_automaton(request.guided)
+            GUIDED_REQUESTS.labels(guided_automaton.kind).inc()
         seq = Sequence(
             request=request,
             tokens=TokenBlockSequence(
@@ -4228,6 +4454,10 @@ class JaxEngine:
             is_cancelled=lambda: context.is_stopped,
             mm_segments=mm_segments,
         )
+        if guided_automaton is not None:
+            from dynamo_tpu.guided import GuidedState
+
+            seq.guided_state = GuidedState(guided_automaton)
         # lifecycle stamps + trace link: _emit_finish turns these into
         # engine.{queue_wait,prefill,decode} spans (cheap plain fields
         # when tracing is off)

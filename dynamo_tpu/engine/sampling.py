@@ -75,6 +75,12 @@ class SamplingBatch:
       freq_pen [B] f32, pres_pen [B] f32, rep_pen [B] f32 (1 = off),
       gen_ids [B, NP] i32 + gen_counts [B, NP] f32 (generated tokens),
       prompt_ids [B, NR] i32 + prompt_counts [B, NR] f32 (presence=1)
+
+    guided key (only when a request in the batch carries a guided
+      constraint — selects the masked jit variant;
+      docs/guided_decoding.md):
+      allow_mask [B, V_pad] bool (unguided rows all-True); the spec
+      verify step carries [B, S, V_pad] instead (per fed position)
     """
 
     arrays: dict[str, np.ndarray] = field(default_factory=dict)
@@ -98,6 +104,10 @@ class SamplingBatch:
     @property
     def has_toplp(self) -> bool:
         return "top_lp_n" in self.arrays
+
+    @property
+    def has_guided(self) -> bool:
+        return "allow_mask" in self.arrays
 
     @classmethod
     def from_options(
@@ -308,6 +318,14 @@ def sample(
         if prompt_dense is None:
             prompt_dense = dense_prompt_presence(s, V)
         logits = apply_penalties(logits, s, gen_dense, prompt_dense)
+    if "allow_mask" in s:
+        # guided decoding (docs/guided_decoding.md): disallowed tokens
+        # drop to NEG_INF BEFORE the greedy argmax, the filter pipeline,
+        # and the logprob computation below, so greedy, seeded sampling,
+        # top-k/top-p/min-p, and returned logprobs all see the SAME
+        # constrained distribution. Presence-keyed like bias/penalties:
+        # unguided batches select the mask-free jit variant.
+        logits = jnp.where(s["allow_mask"], logits, NEG_INF)
 
     temperature, top_k, top_p, min_p, seeds = (
         s["temperature"], s["top_k"], s["top_p"], s["min_p"], s["seeds"]
@@ -400,4 +418,6 @@ def reference_sample_numpy(
         x = np.where(seen, np.where(x > 0, x / rp, x * rp), x)
         x = x - float(s["freq_pen"][row]) * gen
         x = x - float(s["pres_pen"][row]) * (gen > 0)
+    if "allow_mask" in s:
+        x = np.where(s["allow_mask"][row], x, NEG_INF)
     return x

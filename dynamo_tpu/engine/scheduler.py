@@ -82,6 +82,11 @@ class Sequence:
     # proposal is a hashed lookup instead of an O(window) re-scan;
     # rebuilt whenever the sequence shrinks (unwind/truncation)
     drafter_state: Optional[Any] = None
+    # per-sequence guided-decoding cursor (guided/automaton.GuidedState,
+    # docs/guided_decoding.md): advanced in append_token as tokens
+    # COMMIT — staged speculative drafts are unwound before verified
+    # tokens re-append, so the automaton only ever sees committed tokens
+    guided_state: Optional[Any] = None
     # request-lifecycle stamps (telemetry): monotonic except the wall
     # anchor; the engine emits queue-wait/prefill/decode spans from
     # these at finish time (engine.py _emit_finish)
@@ -1119,6 +1124,12 @@ class Scheduler:
             seq.t_first_token = time.monotonic()
         if seq.request.sampling.needs_penalties:
             seq.gen_counts[int(token)] = seq.gen_counts.get(int(token), 0) + 1
+        if seq.guided_state is not None:
+            # every emit path — plain step, spec verify — funnels
+            # through here, so the automaton cursor tracks exactly the
+            # committed token stream (guided requires decode_steps == 1;
+            # fused windows never carry guided sequences)
+            seq.guided_state.advance(int(token))
         # the just-sampled token's KV is NOT in the cache yet — it only gets
         # written when it is fed as input on the next step. Counting it as
         # computed would let _commit_full_blocks content-address a block

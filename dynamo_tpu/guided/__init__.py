@@ -1,0 +1,45 @@
+"""Guided decoding: schema-compiled token masks for constrained output.
+
+The subsystem that opens the structured-output / function-calling
+workload class (docs/guided_decoding.md):
+
+- ``fsm``       — byte-level regex -> NFA -> DFA + the json_object PDA
+- ``schema``    — JSON Schema -> DFA (fragment composition)
+- ``automaton`` — vocab tries, [V_pad] allow-masks, per-sequence
+                  ``GuidedState``, the process-wide compile LRU
+- ``tools``     — streaming tool-call parsing into OpenAI
+                  ``tool_calls`` deltas
+
+Dependency-free by design: the compiler targets the served tokenizer's
+vocabulary directly, and the mask rides the existing sampling pytree
+into the jitted step (engine/sampling.py) — applied before
+``filter_keep_mask`` so greedy, seeded sampling, top-k/top-p, logprobs,
+AND speculative verification all see the same constrained distribution.
+"""
+
+from dynamo_tpu.guided.automaton import (
+    GuidedState,
+    TokenAutomaton,
+    automaton_for,
+    normalize_spec,
+)
+from dynamo_tpu.guided.fsm import JsonAutomaton, compile_regex
+from dynamo_tpu.guided.schema import compile_schema
+from dynamo_tpu.guided.tools import (
+    ToolCallStreamParser,
+    forced_tool_name,
+    tool_parameters_schema,
+)
+
+__all__ = [
+    "GuidedState",
+    "TokenAutomaton",
+    "automaton_for",
+    "normalize_spec",
+    "JsonAutomaton",
+    "compile_regex",
+    "compile_schema",
+    "ToolCallStreamParser",
+    "forced_tool_name",
+    "tool_parameters_schema",
+]

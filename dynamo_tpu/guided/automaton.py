@@ -1,0 +1,349 @@
+"""Token-level automata: vocab tries, allow-masks, per-sequence state.
+
+The layer between the byte automata (guided/fsm.py, guided/schema.py)
+and the engine: a ``TokenAutomaton`` pairs one compiled byte automaton
+with one tokenizer's vocabulary and answers the two questions the
+decode hot path asks —
+
+- ``mask(state)``: which token ids may be sampled next ([V_pad] bool,
+  computed by walking the shared vocab byte-trie against the byte
+  automaton, LRU-cached per automaton state);
+- ``token_step(state, tok)``: the state after committing one token
+  (every byte of the token walked through the byte automaton).
+
+EOS semantics: special tokens never appear in the trie (they carry no
+output bytes), so the mask disallows them — EXCEPT the configured eos
+ids, which are allowed exactly at final automaton states. A state that
+is final with no outgoing byte transitions therefore masks to eos-only:
+the model is FORCED to stop when the document is complete.
+
+Compilation is the expensive part (subset construction + trie sharing),
+so ``automaton_for`` keeps a process-wide LRU keyed by
+(spec, tokenizer) — one compile serves every request carrying the same
+schema against the same served model — and meters compile seconds and
+cache hits (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from dynamo_tpu.guided.fsm import JsonAutomaton, compile_regex
+from dynamo_tpu.guided.schema import compile_schema
+from dynamo_tpu.telemetry.instruments import (
+    GUIDED_CACHE_EVENTS,
+    GUIDED_COMPILE_SECONDS,
+)
+
+# per-automaton bound on cached per-state masks (each is V_pad bytes;
+# at a 128k vocab that is ~0.5 GB at the cap — states repeat heavily in
+# practice because JSON structure revisits the same grammar positions)
+MASK_CACHE_STATES = 4096
+
+
+class _TrieNode:
+    __slots__ = ("children", "ids")
+
+    def __init__(self) -> None:
+        self.children: dict[int, _TrieNode] = {}
+        self.ids: list[int] = []
+
+
+def build_trie(token_bytes: list[Optional[bytes]]) -> _TrieNode:
+    """Byte-trie over the vocabulary: token ids collect at the node
+    their full byte sequence reaches. ``None`` entries (special tokens,
+    padding ids) are excluded — they can never be emitted under a mask."""
+    root = _TrieNode()
+    for tid, data in enumerate(token_bytes):
+        if not data:  # None or empty bytes: never maskable
+            continue
+        node = root
+        for b in data:
+            nxt = node.children.get(b)
+            if nxt is None:
+                nxt = node.children[b] = _TrieNode()
+            node = nxt
+        node.ids.append(tid)
+    return root
+
+
+class TokenAutomaton:
+    """One compiled (byte automaton, tokenizer) pair. Stateless per
+    request — per-sequence position lives in :class:`GuidedState`."""
+
+    def __init__(
+        self,
+        char_automaton: Any,
+        token_bytes: list[Optional[bytes]],
+        trie: _TrieNode,
+        vocab_pad: int,
+        eos_ids: frozenset[int],
+        kind: str = "",
+    ):
+        if len(token_bytes) > vocab_pad:
+            # the shared trie holds ids up to the TOKENIZER's vocab; a
+            # model whose lm_head is smaller could never emit them, and
+            # mask() would index past [vocab_pad]. Fail at COMPILE time
+            # (request admission) — not on the engine step path.
+            raise ValueError(
+                f"tokenizer vocab ({len(token_bytes)}) exceeds the "
+                f"model vocab ({vocab_pad}); guided masks cannot cover "
+                "tokens the model head cannot emit"
+            )
+        self.automaton = char_automaton
+        self._tok_bytes = token_bytes
+        self._trie = trie
+        self.vocab_pad = vocab_pad
+        self.eos_ids = frozenset(i for i in eos_ids if 0 <= i < vocab_pad)
+        self.kind = kind
+        self._mask_cache: OrderedDict[Any, np.ndarray] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def start_state(self) -> Any:
+        return self.automaton.start()
+
+    def is_final(self, state: Any) -> bool:
+        return self.automaton.is_final(state)
+
+    def token_step(self, state: Any, tok: int) -> Optional[Any]:
+        """State after committing token ``tok``, or None when any of
+        its bytes is disallowed (the token was not maskable here)."""
+        if not (0 <= tok < len(self._tok_bytes)):
+            return None
+        data = self._tok_bytes[tok]
+        if not data:
+            return None
+        step = self.automaton.step
+        for b in data:
+            state = step(state, b)
+            if state is None:
+                return None
+        return state
+
+    def mask(self, state: Any) -> np.ndarray:
+        """[V_pad] bool allow-mask for ``state`` (cached). A token is
+        allowed iff EVERY byte it contributes is a legal transition;
+        eos ids are allowed iff the state is final."""
+        with self._lock:
+            cached = self._mask_cache.get(state)
+            if cached is not None:
+                self._mask_cache.move_to_end(state)
+                return cached
+        m = np.zeros((self.vocab_pad,), dtype=bool)
+        step = self.automaton.step
+        # iterative trie x automaton product walk
+        stack: list[tuple[_TrieNode, Any]] = [(self._trie, state)]
+        while stack:
+            node, s = stack.pop()
+            for b, child in node.children.items():
+                ns = step(s, b)
+                if ns is None:
+                    continue
+                if child.ids:
+                    m[child.ids] = True
+                if child.children:
+                    stack.append((child, ns))
+        if self.is_final(state):
+            for e in self.eos_ids:
+                m[e] = True
+        m.setflags(write=False)  # cached array is shared across steps
+        with self._lock:
+            self._mask_cache[state] = m
+            while len(self._mask_cache) > MASK_CACHE_STATES:
+                self._mask_cache.popitem(last=False)
+        return m
+
+
+@dataclass
+class GuidedState:
+    """Per-sequence guided-decoding cursor (scheduler Sequence field,
+    like ``drafter_state``). ``advance`` runs on the engine thread as
+    tokens COMMIT (scheduler.append_token) — staged speculative drafts
+    never touch it, mirroring how token state itself is unwound."""
+
+    automaton: TokenAutomaton
+    state: Any = None
+    done: bool = False
+    # defensive marker: a committed token the automaton rejected (can
+    # only happen on unmasked paths; the mask itself prevents it)
+    broken: bool = False
+
+    def __post_init__(self) -> None:
+        if self.state is None:
+            self.state = self.automaton.start_state()
+
+    def allow_mask(self) -> np.ndarray:
+        if self.done:
+            # document complete (or state lost): only stopping is legal
+            m = np.zeros((self.automaton.vocab_pad,), dtype=bool)
+            eos = list(self.automaton.eos_ids)
+            if eos:
+                m[eos] = True
+            else:  # no configured eos: never mask everything out
+                m[:] = True
+            return m
+        return self.automaton.mask(self.state)
+
+    def advance(self, tok: int) -> None:
+        if self.done:
+            return
+        if tok in self.automaton.eos_ids:
+            self.done = True
+            return
+        ns = self.automaton.token_step(self.state, tok)
+        if ns is None:
+            self.done = True
+            self.broken = True
+            return
+        self.state = ns
+
+    # -- speculative-decoding hooks (docs/guided_decoding.md) ------------
+    def filter_drafts(self, drafts: list) -> list:
+        """Longest draft prefix the automaton accepts from the current
+        state (eos proposals are cut — the verify step's own sampling
+        emits eos through the mask when the document can end)."""
+        if self.done:
+            return []
+        out: list[int] = []
+        s = self.state
+        for t in drafts:
+            t = int(t)
+            if t in self.automaton.eos_ids:
+                break
+            ns = self.automaton.token_step(s, t)
+            if ns is None:
+                break
+            out.append(t)
+            s = ns
+        return out
+
+    def masks_for_drafts(self, drafts: list) -> np.ndarray:
+        """[len(drafts)+1, V_pad] per-position allow-masks for a verify
+        run: position j constrains the token sampled AFTER the first j
+        drafts commit. Drafts must already be filter_drafts-accepted."""
+        A = self.automaton
+        rows = [self.allow_mask()]
+        s = self.state
+        for t in drafts:
+            ns = A.token_step(s, int(t))
+            assert ns is not None, "masks_for_drafts on unfiltered drafts"
+            s = ns
+            rows.append(A.mask(s))
+        return np.stack(rows)
+
+
+# ---------------------------------------------------------------------------
+# Process-wide compile cache
+# ---------------------------------------------------------------------------
+
+_AUTOMATON_CACHE: OrderedDict[tuple, TokenAutomaton] = OrderedDict()
+_AUTOMATON_CACHE_SIZE = 64
+_TOKENIZER_CACHE: dict[str, tuple[list[Optional[bytes]], _TrieNode]] = {}
+_CACHE_LOCK = threading.Lock()
+
+
+def normalize_spec(guided: Any) -> dict:
+    """Canonical spec dict ({"kind", "json_schema"?, "regex"?}) from a
+    GuidedOptions model, a plain dict, or None. Raises ValueError for
+    malformed specs so callers fail the REQUEST, not the batch."""
+    if guided is None:
+        raise ValueError("no guided spec")
+    if hasattr(guided, "model_dump"):
+        guided = guided.model_dump(exclude_none=True)
+    kind = guided.get("kind")
+    if kind == "json_schema":
+        schema = guided.get("json_schema")
+        if not isinstance(schema, dict):
+            raise ValueError("json_schema spec needs a schema object")
+        return {"kind": kind, "json_schema": schema}
+    if kind == "regex":
+        rx = guided.get("regex")
+        if not isinstance(rx, str) or not rx:
+            raise ValueError("regex spec needs a pattern")
+        return {"kind": kind, "regex": rx}
+    if kind == "json_object":
+        return {"kind": kind}
+    raise ValueError(f"unknown guided kind {kind!r}")
+
+
+def _compile_char_automaton(spec: dict) -> Any:
+    kind = spec["kind"]
+    if kind == "json_schema":
+        return compile_schema(spec["json_schema"])
+    if kind == "regex":
+        return compile_regex(spec["regex"])
+    return JsonAutomaton()
+
+
+def token_bytes_table(
+    tokenizer: Any, key: str
+) -> tuple[list[Optional[bytes]], _TrieNode]:
+    """(token_bytes, shared trie) for one tokenizer, cached by ``key``
+    (the served model path — one table per process per model)."""
+    with _CACHE_LOCK:
+        hit = _TOKENIZER_CACHE.get(key)
+    if hit is not None:
+        return hit
+    specials = set(tokenizer.special_token_ids())
+    table: list[Optional[bytes]] = []
+    for tid in range(tokenizer.vocab_size):
+        if tid in specials:
+            table.append(None)
+            continue
+        try:
+            table.append(tokenizer.token_bytes(tid))
+        except Exception:
+            table.append(None)
+    trie = build_trie(table)
+    with _CACHE_LOCK:
+        _TOKENIZER_CACHE[key] = (table, trie)
+    return table, trie
+
+
+def automaton_for(
+    guided: Any,
+    tokenizer: Any,
+    tokenizer_key: str,
+    vocab_pad: int,
+    eos_ids,
+) -> TokenAutomaton:
+    """The process-wide entry point: compile (or fetch) the
+    TokenAutomaton for one (spec, tokenizer) pair. Compile time and
+    cache hits are metered — compiles happen at request admission, and
+    the LRU makes repeat schemas (the common case for structured-output
+    traffic) free."""
+    spec = normalize_spec(guided)
+    cache_key = (
+        json.dumps(spec, sort_keys=True),
+        tokenizer_key,
+        vocab_pad,
+        tuple(sorted(eos_ids)),
+    )
+    with _CACHE_LOCK:
+        hit = _AUTOMATON_CACHE.get(cache_key)
+        if hit is not None:
+            _AUTOMATON_CACHE.move_to_end(cache_key)
+    if hit is not None:
+        GUIDED_CACHE_EVENTS.labels("hit").inc()
+        return hit
+    GUIDED_CACHE_EVENTS.labels("miss").inc()
+    t0 = time.monotonic()
+    char_auto = _compile_char_automaton(spec)
+    table, trie = token_bytes_table(tokenizer, tokenizer_key)
+    auto = TokenAutomaton(
+        char_auto, table, trie, vocab_pad, frozenset(int(e) for e in eos_ids),
+        kind=spec["kind"],
+    )
+    GUIDED_COMPILE_SECONDS.labels(spec["kind"]).observe(time.monotonic() - t0)
+    with _CACHE_LOCK:
+        _AUTOMATON_CACHE[cache_key] = auto
+        while len(_AUTOMATON_CACHE) > _AUTOMATON_CACHE_SIZE:
+            _AUTOMATON_CACHE.popitem(last=False)
+    return auto

@@ -1,0 +1,602 @@
+"""Byte-level finite automata for constrained decoding.
+
+Dependency-free core of the guided-decoding subsystem
+(docs/guided_decoding.md): a regex subset compiles through a Thompson
+NFA into a DFA over BYTES, and ``json_object`` mode is a depth-bounded
+JSON pushdown automaton exposing the same small protocol. Everything
+token-level (vocab tries, allow-masks) lives one layer up in
+``guided/automaton.py`` — this module never sees a tokenizer.
+
+The shared protocol (duck-typed; both classes implement it):
+
+- ``start()``    -> opaque hashable state
+- ``step(s, b)`` -> next state for byte ``b`` (0..255), or ``None``
+                    when the byte is not allowed from ``s``
+- ``is_final(s)``-> True when generation may STOP here (the token-level
+                    layer allows EOS exactly at final states)
+
+Operating on bytes (not chars) keeps the automaton aligned with what
+tokens actually contribute to the stream (``Tokenizer.token_bytes``) —
+a token holding half a UTF-8 sequence advances the automaton half-way
+through that character, which a char-level automaton cannot express.
+
+Design bound: states are hashable and cheap to hash — the token layer
+caches one vocab mask per distinct state it encounters.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+# A byte set is a 256-bit int mask: bit b set <=> byte b allowed.
+ALL_BYTES = (1 << 256) - 1
+# regex `.`: any byte except \n (multi-byte chars therefore need one
+# `.` per BYTE — documented subset semantics)
+DOT_BYTES = ALL_BYTES & ~(1 << 0x0A)
+
+# bounded-repetition expansion cap: {m,n} duplicates the fragment n
+# times; past this the automaton (and its compile time) stops being
+# "negligible per-step cost"
+MAX_BOUNDED_REPEAT = 256
+
+
+def byteset(*chars: str) -> int:
+    m = 0
+    for c in chars:
+        for b in c.encode("utf-8"):
+            m |= 1 << b
+    return m
+
+
+def byterange(lo: int, hi: int) -> int:
+    """Inclusive byte range as a bitmask."""
+    return ((1 << (hi - lo + 1)) - 1) << lo
+
+
+DIGITS = byterange(0x30, 0x39)
+WORD = DIGITS | byterange(0x41, 0x5A) | byterange(0x61, 0x7A) | byteset("_")
+SPACE = byteset(" \t\n\r\f\v")
+
+
+class NfaBuilder:
+    """Thompson-construction NFA: fragments are (start, accept) state
+    pairs; every combinator allocates fresh states so fragments compose
+    freely. ``eps[s]`` are epsilon targets, ``edges[s]`` are
+    (byte-mask, target) pairs."""
+
+    def __init__(self) -> None:
+        self.eps: list[list[int]] = []
+        self.edges: list[list[tuple[int, int]]] = []
+
+    def state(self) -> int:
+        self.eps.append([])
+        self.edges.append([])
+        return len(self.eps) - 1
+
+    # -- fragment combinators (each returns (start, accept)) -------------
+    def lit_mask(self, mask: int) -> tuple[int, int]:
+        s, a = self.state(), self.state()
+        self.edges[s].append((mask, a))
+        return s, a
+
+    def empty(self) -> tuple[int, int]:
+        s = self.state()
+        return s, s
+
+    def seq_bytes(self, data: bytes) -> tuple[int, int]:
+        s = self.state()
+        cur = s
+        for b in data:
+            nxt = self.state()
+            self.edges[cur].append((1 << b, nxt))
+            cur = nxt
+        return s, cur
+
+    def concat(self, a: tuple[int, int], b: tuple[int, int]) -> tuple[int, int]:
+        self.eps[a[1]].append(b[0])
+        return a[0], b[1]
+
+    def alt(self, *frags: tuple[int, int]) -> tuple[int, int]:
+        s, acc = self.state(), self.state()
+        for f in frags:
+            self.eps[s].append(f[0])
+            self.eps[f[1]].append(acc)
+        return s, acc
+
+    def opt(self, f: tuple[int, int]) -> tuple[int, int]:
+        s, acc = self.state(), self.state()
+        self.eps[s] += [f[0], acc]
+        self.eps[f[1]].append(acc)
+        return s, acc
+
+    def star(self, f: tuple[int, int]) -> tuple[int, int]:
+        s, acc = self.state(), self.state()
+        self.eps[s] += [f[0], acc]
+        self.eps[f[1]] += [f[0], acc]
+        return s, acc
+
+    def plus(self, f: tuple[int, int]) -> tuple[int, int]:
+        s, acc = self.state(), self.state()
+        self.eps[s].append(f[0])
+        self.eps[f[1]] += [f[0], acc]
+        return s, acc
+
+    def repeat(
+        self, make, lo: int, hi: Optional[int]
+    ) -> tuple[int, int]:
+        """{lo,hi} by duplication; ``make()`` builds one fresh copy of
+        the fragment (fragments cannot be reused — their states carry
+        the epsilon wiring of their position). ``hi=None`` = unbounded."""
+        if hi is not None and hi - lo > MAX_BOUNDED_REPEAT:
+            raise ValueError(
+                f"bounded repetition span {lo},{hi} exceeds "
+                f"{MAX_BOUNDED_REPEAT}"
+            )
+        if lo > MAX_BOUNDED_REPEAT:
+            raise ValueError(f"repetition floor {lo} exceeds {MAX_BOUNDED_REPEAT}")
+        frag = self.empty()
+        for _ in range(lo):
+            frag = self.concat(frag, make())
+        if hi is None:
+            frag = self.concat(frag, self.star(make()))
+        else:
+            for _ in range(hi - lo):
+                frag = self.concat(frag, self.opt(make()))
+        return frag
+
+    # -- DFA via subset construction -------------------------------------
+    def _closure(self, states: frozenset[int]) -> frozenset[int]:
+        out = set(states)
+        stack = list(states)
+        while stack:
+            s = stack.pop()
+            for t in self.eps[s]:
+                if t not in out:
+                    out.add(t)
+                    stack.append(t)
+        return frozenset(out)
+
+    def to_dfa(self, frag: tuple[int, int]) -> "Dfa":
+        start_set = self._closure(frozenset([frag[0]]))
+        ids: dict[frozenset[int], int] = {start_set: 0}
+        table: list[dict[int, int]] = []
+        finals: list[bool] = []
+        work = [start_set]
+        accept = frag[1]
+        while work:
+            cur = work.pop()
+            row: dict[int, int] = {}
+            finals_idx = ids[cur]
+            while len(table) <= finals_idx:
+                table.append({})
+                finals.append(False)
+            finals[finals_idx] = accept in cur
+            # distinct edge masks reaching out of this subset
+            edges = [e for s in cur for e in self.edges[s]]
+            if edges:
+                for b in range(256):
+                    bit = 1 << b
+                    tgt = frozenset(
+                        t for mask, t in edges if mask & bit
+                    )
+                    if not tgt:
+                        continue
+                    tgt = self._closure(tgt)
+                    if tgt not in ids:
+                        ids[tgt] = len(ids)
+                        work.append(tgt)
+                    row[b] = ids[tgt]
+            table[finals_idx] = row
+        return Dfa(table, finals)
+
+
+class Dfa:
+    """Deterministic byte automaton. States are ints; every reachable
+    state is live (dead transitions are simply absent)."""
+
+    def __init__(self, table: list[dict[int, int]], finals: list[bool]):
+        self.table = table
+        self.finals = finals
+
+    def start(self) -> int:
+        return 0
+
+    def step(self, state: int, byte: int) -> Optional[int]:
+        return self.table[state].get(byte)
+
+    def is_final(self, state: int) -> bool:
+        return self.finals[state]
+
+    @property
+    def num_states(self) -> int:
+        return len(self.table)
+
+
+# ---------------------------------------------------------------------------
+# Regex subset -> NFA fragment
+# ---------------------------------------------------------------------------
+
+_CLASS_ESCAPES = {
+    "d": DIGITS,
+    "D": ALL_BYTES & ~DIGITS,
+    "w": WORD,
+    "W": ALL_BYTES & ~WORD,
+    "s": SPACE,
+    "S": ALL_BYTES & ~SPACE,
+}
+_LITERAL_ESCAPES = {
+    "n": "\n", "r": "\r", "t": "\t", "f": "\f", "v": "\v", "0": "\0",
+}
+
+
+class _RegexParser:
+    """Recursive-descent parser for the supported subset: literals,
+    UTF-8-encoded non-ASCII literals, ``.``, escapes (``\\d \\w \\s``
+    and their negations, ``\\n \\t`` etc., escaped metachars), char
+    classes ``[a-z0-9_]`` / ``[^...]``, groups ``(...)`` / ``(?:...)``,
+    quantifiers ``* + ? {m} {m,} {m,n}``, and alternation ``|``.
+    Fullmatch semantics: ``^``/``$`` at the pattern edges are accepted
+    and ignored; anywhere else they are an error."""
+
+    def __init__(self, pattern: str, b: NfaBuilder):
+        self.p = pattern
+        self.i = 0
+        self.b = b
+
+    def error(self, msg: str) -> ValueError:
+        return ValueError(f"regex: {msg} at offset {self.i} in {self.p!r}")
+
+    def peek(self) -> str:
+        return self.p[self.i] if self.i < len(self.p) else ""
+
+    def eat(self) -> str:
+        c = self.peek()
+        self.i += 1
+        return c
+
+    def parse(self) -> tuple[int, int]:
+        if self.peek() == "^":
+            self.eat()
+        frag = self.alternation()
+        if self.i < len(self.p):
+            raise self.error(f"unexpected {self.peek()!r}")
+        return frag
+
+    def alternation(self) -> tuple[int, int]:
+        frags = [self.concat()]
+        while self.peek() == "|":
+            self.eat()
+            frags.append(self.concat())
+        return frags[0] if len(frags) == 1 else self.b.alt(*frags)
+
+    def concat(self) -> tuple[int, int]:
+        frag = self.b.empty()
+        while self.peek() not in ("", "|", ")"):
+            if self.peek() == "$" and self.i == len(self.p) - 1:
+                self.eat()
+                break
+            frag = self.b.concat(frag, self.repeatable())
+        return frag
+
+    def repeatable(self) -> tuple[int, int]:
+        start_i = self.i
+        frag = self.atom()
+        c = self.peek()
+        if not c or c not in "*+?{":
+            return frag
+        end_i = self.i
+
+        def make() -> tuple[int, int]:
+            # fresh copy of the fragment: re-parse the atom's source span
+            # (fragments can't be reused — states carry position wiring)
+            save = self.i
+            self.i = start_i
+            f = self.atom()
+            assert self.i == end_i
+            self.i = save
+            return f
+
+        if c == "*":
+            self.eat()
+            return self.b.repeat(make, 0, None)
+        if c == "+":
+            self.eat()
+            return self.b.repeat(make, 1, None)
+        if c == "?":
+            self.eat()
+            return self.b.repeat(make, 0, 1)
+        # {m} {m,} {m,n}
+        j = self.p.find("}", self.i)
+        if j < 0:
+            raise self.error("unterminated {")
+        body = self.p[self.i + 1 : j]
+        self.i = j + 1
+        try:
+            if "," not in body:
+                lo = hi = int(body)
+            else:
+                lo_s, hi_s = body.split(",", 1)
+                lo = int(lo_s) if lo_s else 0
+                hi = int(hi_s) if hi_s else None
+        except ValueError:
+            raise self.error(f"bad repetition {{{body}}}")
+        if hi is not None and hi < lo:
+            raise self.error(f"bad repetition {{{body}}}")
+        return self.b.repeat(make, lo, hi)
+
+    def atom(self) -> tuple[int, int]:
+        c = self.eat()
+        if c == "(":
+            if self.p[self.i : self.i + 2] == "?:":
+                self.i += 2
+            elif self.peek() == "?":
+                raise self.error("only (?: non-capturing groups supported")
+            frag = self.alternation()
+            if self.eat() != ")":
+                raise self.error("unterminated group")
+            return frag
+        if c == ".":
+            return self.b.lit_mask(DOT_BYTES)
+        if c == "[":
+            return self.b.lit_mask(self.char_class())
+        if c == "\\":
+            return self.b.lit_mask(self.escape_mask())
+        if c in "*+?{":
+            raise self.error(f"dangling quantifier {c!r}")
+        if c in ")|":
+            raise self.error(f"unexpected {c!r}")
+        if c in "^$":
+            raise self.error(f"anchor {c!r} only supported at pattern edges")
+        return self.b.seq_bytes(c.encode("utf-8")) if len(c.encode("utf-8")) > 1 \
+            else self.b.lit_mask(1 << ord(c))
+
+    def escape_mask(self) -> int:
+        c = self.eat()
+        if not c:
+            raise self.error("dangling backslash")
+        if c in _CLASS_ESCAPES:
+            return _CLASS_ESCAPES[c]
+        if c in _LITERAL_ESCAPES:
+            return byteset(_LITERAL_ESCAPES[c])
+        if c == "x":
+            h = self.p[self.i : self.i + 2]
+            if len(h) != 2:
+                raise self.error("bad \\x escape")
+            self.i += 2
+            return 1 << int(h, 16)
+        # escaped metachar / punctuation: match it literally
+        return byteset(c)
+
+    def char_class(self) -> int:
+        negate = False
+        if self.peek() == "^":
+            self.eat()
+            negate = True
+        mask = 0
+        first = True
+        while True:
+            c = self.eat()
+            if not c:
+                raise self.error("unterminated character class")
+            if c == "]" and not first:
+                break
+            first = False
+            if c == "\\":
+                m = self.escape_mask()
+                mask |= m
+                continue
+            lo = ord(c)
+            if self.peek() == "-" and self.p[self.i + 1 : self.i + 2] not in ("]", ""):
+                self.eat()
+                hi_c = self.eat()
+                if hi_c == "\\":
+                    raise self.error("escape as range endpoint unsupported")
+                hi = ord(hi_c)
+                if hi < lo or hi > 0xFF:
+                    raise self.error(f"bad range {c}-{hi_c}")
+                mask |= byterange(lo, hi)
+            else:
+                if lo > 0x7F:
+                    # a class member is ONE byte transition; OR-ing a
+                    # multi-byte character's bytes in would match lone
+                    # lead/continuation bytes (invalid UTF-8), never
+                    # the character itself — reject instead of lying
+                    raise self.error(
+                        f"non-ASCII {c!r} in a character class is "
+                        "unsupported (classes are byte sets); use "
+                        f"alternation (...|{c}|...) instead"
+                    )
+                mask |= 1 << lo
+        return (ALL_BYTES & ~mask) if negate else mask
+
+
+def compile_regex(pattern: str) -> Dfa:
+    """Compile the supported regex subset into a byte DFA with
+    fullmatch semantics."""
+    b = NfaBuilder()
+    frag = _RegexParser(pattern, b).parse()
+    return b.to_dfa(frag)
+
+
+# ---------------------------------------------------------------------------
+# json_object mode: a depth-bounded JSON value automaton
+# ---------------------------------------------------------------------------
+
+# Opening a new {/[ past this stack depth is disallowed: the state
+# space (and the token layer's per-state mask cache) stays finite.
+MAX_JSON_DEPTH = 16
+
+_WS = frozenset(b" \t\n\r")
+_ESCAPABLE = frozenset(b'"\\/bfnrt')
+_HEX = frozenset(b"0123456789abcdefABCDEF")
+_DIGIT = frozenset(b"0123456789")
+
+# number states where the number read so far is already a complete
+# JSON number (a terminator byte may follow)
+_NUM_COMPLETE = frozenset(("N0", "NI", "NF", "ND"))
+
+
+class JsonAutomaton:
+    """Byte automaton accepting one JSON document (``json_object`` mode:
+    the top-level value must be an object). States are
+    ``(mode, aux, stack)`` tuples — ``stack`` is a tuple of ``"o"``/
+    ``"a"`` frames (bounded by MAX_JSON_DEPTH), ``aux`` carries literal
+    progress (``tru<e>``) — so they hash cheaply and the token layer's
+    per-state mask cache works unchanged.
+
+    String content allows any byte >= 0x20 except ``"`` and ``\\``
+    (UTF-8 well-formedness inside strings is the tokenizer's problem,
+    not the grammar's), plus the standard escapes and ``\\uXXXX``.
+    """
+
+    def __init__(
+        self, max_depth: int = MAX_JSON_DEPTH, top_level_object: bool = True
+    ):
+        self.max_depth = max_depth
+        self.top = top_level_object
+
+    def start(self):
+        return ("TOP", "", ()) if self.top else ("V", "", ())
+
+    def is_final(self, state) -> bool:
+        mode, _aux, stack = state
+        if stack:
+            return False
+        return mode == "END" or (mode in _NUM_COMPLETE)
+
+    # -- helpers ----------------------------------------------------------
+    @staticmethod
+    def _after_value(stack):
+        """State entered once a value closes, given the remaining stack."""
+        if not stack:
+            return ("END", "", ())
+        return (("OV", "", stack) if stack[-1] == "o" else ("AV", "", stack))
+
+    def step(self, state, byte: int):
+        mode, aux, stack = state
+
+        # string bodies (value strings S*, key strings K*)
+        if mode in ("S", "KS"):
+            if byte == 0x22:  # closing quote
+                return self._after_value(stack) if mode == "S" else (
+                    "COLON", "", stack
+                )
+            if byte == 0x5C:
+                return ("SE" if mode == "S" else "KSE", "", stack)
+            if byte >= 0x20:
+                return (mode, "", stack)
+            return None
+        if mode in ("SE", "KSE"):
+            base = "S" if mode == "SE" else "KS"
+            if byte in _ESCAPABLE:
+                return (base, "", stack)
+            if byte == 0x75:  # \uXXXX
+                return (base + "U", "1", stack)
+            return None
+        if mode in ("SU", "KSU"):
+            if byte not in _HEX:
+                return None
+            n = int(aux)
+            base = "S" if mode == "SU" else "KS"
+            return (base, "", stack) if n == 4 else (mode, str(n + 1), stack)
+
+        # literals: true/false/null spelled byte by byte
+        if mode == "L":
+            word = aux
+            if byte == ord(word[0]):
+                rest = word[1:]
+                if not rest:
+                    return self._after_value(stack)
+                return ("L", rest, stack)
+            return None
+
+        # numbers
+        if mode in ("N-", "NF0", "NE1"):  # a digit is REQUIRED here
+            if byte in _DIGIT:
+                if mode == "N-":
+                    return ("N0" if byte == 0x30 else "NI", "", stack)
+                return ("NF" if mode == "NF0" else "ND", "", stack)
+            return None
+        if mode in _NUM_COMPLETE:
+            if mode in ("NI", "NF", "ND") and byte in _DIGIT:
+                return (mode, "", stack)
+            if mode in ("N0", "NI") and byte == 0x2E:  # .
+                return ("NF0", "", stack)
+            if mode in ("N0", "NI", "NF") and byte in (0x65, 0x45):  # e E
+                return ("NE", "", stack)
+            # not a number byte: the number closed — the terminator byte
+            # is consumed by the after-value state
+            return self.step(self._after_value(stack), byte)
+        if mode == "NE":
+            if byte in (0x2B, 0x2D):
+                return ("NE1", "", stack)
+            if byte in _DIGIT:
+                return ("ND", "", stack)
+            return None
+
+        # whitespace is legal in every structural mode below
+        if byte in _WS:
+            return state
+
+        if mode == "TOP":  # json_object: the document must be an object
+            if byte == 0x7B:  # {
+                return ("O0", "", stack + ("o",))
+            return None
+        if mode == "V":  # any value
+            if byte == 0x7B:
+                if len(stack) >= self.max_depth:
+                    return None
+                return ("O0", "", stack + ("o",))
+            if byte == 0x5B:  # [
+                if len(stack) >= self.max_depth:
+                    return None
+                return ("A0", "", stack + ("a",))
+            if byte == 0x22:
+                return ("S", "", stack)
+            if byte == 0x2D:
+                return ("N-", "", stack)
+            if byte in _DIGIT:
+                return ("N0" if byte == 0x30 else "NI", "", stack)
+            if byte == 0x74:  # t
+                return ("L", "rue", stack)
+            if byte == 0x66:  # f
+                return ("L", "alse", stack)
+            if byte == 0x6E:  # n
+                return ("L", "ull", stack)
+            return None
+        if mode == "O0":  # just after '{': first key or '}'
+            if byte == 0x22:
+                return ("KS", "", stack)
+            if byte == 0x7D:  # }
+                return self._after_value(stack[:-1])
+            return None
+        if mode == "OK":  # after ',' in an object: a key is REQUIRED
+            if byte == 0x22:
+                return ("KS", "", stack)
+            return None
+        if mode == "COLON":
+            if byte == 0x3A:  # :
+                return ("V", "", stack)
+            return None
+        if mode == "OV":  # after a value inside an object
+            if byte == 0x2C:  # ,
+                return ("OK", "", stack)
+            if byte == 0x7D:
+                return self._after_value(stack[:-1])
+            return None
+        if mode == "A0":  # just after '[': first value or ']'
+            if byte == 0x5D:  # ]
+                return self._after_value(stack[:-1])
+            return self.step(("V", "", stack), byte)
+        if mode == "AV":  # after a value inside an array
+            if byte == 0x2C:
+                return ("V", "", stack)
+            if byte == 0x5D:
+                return self._after_value(stack[:-1])
+            return None
+        if mode == "END":  # trailing whitespace only
+            return None
+        raise AssertionError(f"unknown json automaton mode {mode!r}")
+
+
+CharAutomaton = Union[Dfa, JsonAutomaton]

@@ -1,0 +1,335 @@
+"""JSON Schema -> byte DFA for constrained decoding.
+
+Composes NFA fragments directly (guided/fsm.NfaBuilder) instead of
+going through a regex string — the optional-property comma problem that
+makes object regexes quadratic is a linear two-chain construction here
+(see ``_object_frag``).
+
+Supported subset (ValueError on anything else, at COMPILE time — a
+request with an uncompilable schema fails at the frontend, not
+mid-generation):
+
+- ``type``: object / array / string / integer / number / boolean / null
+- ``enum`` / ``const`` (JSON-encoded literal alternation)
+- object: ``properties`` (emitted in declared order), ``required``
+- array: ``items``, ``minItems`` / ``maxItems``
+- string: ``minLength`` / ``maxLength`` (in characters: one escape or
+  one UTF-8 sequence counts as one), ``pattern`` (the guided regex
+  subset, applied to the UNESCAPED content — patterns that need to
+  match ``"`` or ``\\`` inside strings are rejected)
+- ``anyOf`` / ``oneOf`` (alternation — oneOf's exclusivity is NOT
+  enforced), top-level ``$defs``/``definitions`` with local ``$ref``
+  expanded to ``MAX_REF_DEPTH``
+- numeric ``minimum``/``maximum`` etc. are NOT enforced (value bounds
+  are not regular); unknown constraint keys are ignored
+
+Whitespace: a bounded run (``WS_MAX`` bytes of space/tab/newline) is
+allowed after every structural token — enough for any sane formatting,
+while an UNBOUNDED ws loop would hand the model an infinite stall that
+never violates the mask.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from dynamo_tpu.guided.fsm import (
+    ALL_BYTES,
+    Dfa,
+    NfaBuilder,
+    _RegexParser,
+    byterange,
+    byteset,
+)
+
+WS_MAX = 6
+MAX_REF_DEPTH = 8
+
+_WS_MASK = byteset(" \t\n\r")
+# JSON string content: any byte >= 0x20 except '"' and '\' (multi-byte
+# UTF-8 is matched structurally by _string_char_frag)
+_HEX_MASK = byterange(0x30, 0x39) | byterange(0x41, 0x46) | byterange(0x61, 0x66)
+
+
+class SchemaCompiler:
+    def __init__(self, schema: dict, builder: Optional[NfaBuilder] = None):
+        self.schema = schema
+        self.b = builder or NfaBuilder()
+        self.defs = {}
+        for key in ("$defs", "definitions"):
+            if isinstance(schema.get(key), dict):
+                self.defs[key] = schema[key]
+
+    # -- small shared fragments ------------------------------------------
+    def ws(self):
+        return self.b.repeat(lambda: self.b.lit_mask(_WS_MASK), 0, WS_MAX)
+
+    def lit(self, text: str):
+        return self.b.seq_bytes(text.encode("utf-8"))
+
+    def _seq(self, *frags):
+        out = frags[0]
+        for f in frags[1:]:
+            out = self.b.concat(out, f)
+        return out
+
+    def _string_char_frag(self):
+        """One JSON string character: an unescaped single byte, a
+        standard escape, a \\uXXXX escape, or one complete multi-byte
+        UTF-8 sequence — each alternative counts as ONE toward
+        min/maxLength."""
+        b = self.b
+        ascii_ok = (
+            byterange(0x20, 0x21) | byterange(0x23, 0x5B) | byterange(0x5D, 0x7F)
+        )
+        esc = self._seq(
+            b.lit_mask(byteset("\\")),
+            b.alt(
+                b.lit_mask(byteset('"\\/bfnrt')),
+                self._seq(
+                    b.lit_mask(byteset("u")),
+                    b.repeat(lambda: b.lit_mask(_HEX_MASK), 4, 4),
+                ),
+            ),
+        )
+        cont = lambda: b.lit_mask(byterange(0x80, 0xBF))  # noqa: E731
+        utf8_2 = self._seq(b.lit_mask(byterange(0xC2, 0xDF)), cont())
+        utf8_3 = self._seq(b.lit_mask(byterange(0xE0, 0xEF)), cont(), cont())
+        utf8_4 = self._seq(b.lit_mask(byterange(0xF0, 0xF4)), cont(), cont(), cont())
+        return b.alt(b.lit_mask(ascii_ok), esc, utf8_2, utf8_3, utf8_4)
+
+    # -- per-type fragments ----------------------------------------------
+    # bytes a pattern-constrained string body may produce: everything a
+    # JSON string can carry UNESCAPED (no quote, no backslash, no
+    # control bytes). Pattern edges are intersected with this, so
+    # metacharacter forms (., [^...], \S) can never admit a raw '"'
+    # that would terminate the string early and break the JSON.
+    _PATTERN_CONTENT = (
+        ALL_BYTES & ~byteset('"', "\\") & ~byterange(0x00, 0x1F)
+    )
+
+    def _pattern_frag(self, pat: str):
+        """Compile a string ``pattern`` in a scratch builder, strip
+        string-illegal bytes from every edge, then graft the fragment
+        into the main NFA (states renumbered). A pattern that REQUIRES
+        an illegal byte (e.g. a literal '"') becomes unsatisfiable —
+        rejected below rather than emitted as broken JSON."""
+        sub = NfaBuilder()
+        frag = _RegexParser(pat, sub).parse()
+        n = len(sub.eps)
+        base = [self.b.state() for _ in range(n)]
+        dead_edge = False
+        for i in range(n):
+            self.b.eps[base[i]] = [base[t] for t in sub.eps[i]]
+            edges = []
+            for mask, t in sub.edges[i]:
+                stripped = mask & self._PATTERN_CONTENT
+                if stripped != mask and stripped == 0:
+                    dead_edge = True
+                if stripped:
+                    edges.append((stripped, base[t]))
+            self.b.edges[base[i]] = edges
+        if dead_edge:
+            raise ValueError(
+                f"string pattern {pat!r} requires a quote/backslash/"
+                "control byte, which JSON string content cannot carry "
+                "unescaped (patterns apply to unescaped content)"
+            )
+        return base[frag[0]], base[frag[1]]
+
+    def _string_frag(self, schema: dict):
+        b = self.b
+        if "pattern" in schema:
+            body = self._pattern_frag(schema["pattern"])
+        else:
+            lo = int(schema.get("minLength", 0))
+            hi = schema.get("maxLength")
+            body = b.repeat(
+                self._string_char_frag, lo, int(hi) if hi is not None else None
+            )
+        return self._seq(self.lit('"'), body, self.lit('"'))
+
+    def _number_frag(self, integer: bool):
+        b = self.b
+        int_part = self._seq(
+            b.opt(b.lit_mask(byteset("-"))),
+            b.alt(
+                b.lit_mask(byteset("0")),
+                self._seq(
+                    b.lit_mask(byterange(0x31, 0x39)),
+                    b.repeat(lambda: b.lit_mask(byterange(0x30, 0x39)), 0, None),
+                ),
+            ),
+        )
+        if integer:
+            return int_part
+        digit = lambda: self.b.lit_mask(byterange(0x30, 0x39))  # noqa: E731
+        frac = self._seq(self.lit("."), b.repeat(digit, 1, None))
+        exp = self._seq(
+            b.lit_mask(byteset("eE")),
+            b.opt(b.lit_mask(byteset("+-"))),
+            b.repeat(digit, 1, None),
+        )
+        return self._seq(int_part, b.opt(frac), b.opt(exp))
+
+    def _array_frag(self, schema: dict, depth: int):
+        b = self.b
+        items = schema.get("items", {})
+        lo = int(schema.get("minItems", 0))
+        hi = schema.get("maxItems")
+        hi = int(hi) if hi is not None else None
+
+        def item():
+            return self.value_frag(items, depth)
+
+        def rest_item():
+            return self._seq(self.ws(), self.lit(","), self.ws(), item())
+
+        if hi == 0:
+            inner = b.empty()
+        elif lo == 0:
+            inner = b.opt(
+                self._seq(
+                    item(),
+                    b.repeat(rest_item, 0, None if hi is None else hi - 1),
+                )
+            )
+        else:
+            inner = self._seq(
+                item(),
+                b.repeat(rest_item, lo - 1, None if hi is None else hi - 1),
+            )
+        return self._seq(
+            self.lit("["), self.ws(), inner, self.ws(), self.lit("]")
+        )
+
+    def _object_frag(self, schema: dict, depth: int):
+        """Properties in declared order, each present or (when not
+        required) absent. Linear two-chain construction: chain N tracks
+        'nothing emitted yet' (next property needs no comma), chain S
+        'something emitted' (next property is comma-prefixed); skipping
+        is an epsilon available only for optional properties."""
+        b = self.b
+        props: dict = schema.get("properties", {}) or {}
+        required = set(schema.get("required", []) or [])
+        unknown = required - set(props)
+        if unknown:
+            raise ValueError(f"required names {sorted(unknown)} not in properties")
+
+        def prop_frag(name: str, sub: Any):
+            return self._seq(
+                self.lit(json.dumps(name)),
+                self.ws(),
+                self.lit(":"),
+                self.ws(),
+                self.value_frag(sub, depth),
+            )
+
+        # none[i] / some[i]: about to decide property i, with nothing /
+        # something already emitted
+        n = len(props)
+        none_states = [b.state() for _ in range(n + 1)]
+        some_states = [b.state() for _ in range(n + 1)]
+        for i, (name, sub) in enumerate(props.items()):
+            f1 = prop_frag(name, sub)
+            b.eps[none_states[i]].append(f1[0])
+            b.eps[f1[1]].append(some_states[i + 1])
+            f2 = self._seq(
+                self.ws(), self.lit(","), self.ws(), prop_frag(name, sub)
+            )
+            b.eps[some_states[i]].append(f2[0])
+            b.eps[f2[1]].append(some_states[i + 1])
+            if name not in required:
+                b.eps[none_states[i]].append(none_states[i + 1])
+                b.eps[some_states[i]].append(some_states[i + 1])
+        end = b.state()
+        b.eps[none_states[n]].append(end)
+        b.eps[some_states[n]].append(end)
+        inner = (none_states[0], end)
+        return self._seq(
+            self.lit("{"), self.ws(), inner, self.ws(), self.lit("}")
+        )
+
+    # -- dispatch ---------------------------------------------------------
+    def _resolve_ref(self, ref: str) -> dict:
+        for prefix, key in (("#/$defs/", "$defs"), ("#/definitions/", "definitions")):
+            if ref.startswith(prefix):
+                name = ref[len(prefix):]
+                defs = self.defs.get(key, {})
+                if name in defs:
+                    return defs[name]
+        raise ValueError(f"unsupported $ref {ref!r} (local #/$defs/* only)")
+
+    def value_frag(self, schema: Any, depth: int = 0):
+        b = self.b
+        if depth > MAX_REF_DEPTH:
+            raise ValueError(
+                f"schema nesting/$ref expansion exceeds depth {MAX_REF_DEPTH}"
+            )
+        if schema is True or schema == {}:
+            # unconstrained subschema: any json value — delegate to the
+            # bounded generic value grammar (one level of each structure)
+            raise ValueError(
+                "unconstrained subschema ({}/true) is not supported; use "
+                'response_format {"type": "json_object"} for free-form JSON'
+            )
+        if not isinstance(schema, dict):
+            raise ValueError(f"schema must be an object, got {type(schema).__name__}")
+        if "$ref" in schema:
+            return self.value_frag(self._resolve_ref(schema["$ref"]), depth + 1)
+        if "const" in schema:
+            return self.lit(json.dumps(schema["const"], sort_keys=True))
+        if "enum" in schema:
+            if not schema["enum"]:
+                raise ValueError("empty enum")
+            return b.alt(
+                *[
+                    self.lit(json.dumps(v, sort_keys=True))
+                    for v in schema["enum"]
+                ]
+            )
+        for comb in ("anyOf", "oneOf"):
+            if comb in schema:
+                subs = schema[comb]
+                if not subs:
+                    raise ValueError(f"empty {comb}")
+                return b.alt(
+                    *[self.value_frag(s, depth + 1) for s in subs]
+                )
+        if "allOf" in schema:
+            raise ValueError("allOf is not supported")
+        t = schema.get("type")
+        if isinstance(t, list):
+            return b.alt(
+                *[
+                    self.value_frag({**schema, "type": one}, depth + 1)
+                    for one in t
+                ]
+            )
+        if t == "object":
+            return self._object_frag(schema, depth + 1)
+        if t == "array":
+            return self._array_frag(schema, depth + 1)
+        if t == "string":
+            return self._string_frag(schema)
+        if t == "integer":
+            return self._number_frag(integer=True)
+        if t == "number":
+            return self._number_frag(integer=False)
+        if t == "boolean":
+            return b.alt(self.lit("true"), self.lit("false"))
+        if t == "null":
+            return self.lit("null")
+        raise ValueError(f"unsupported schema: {json.dumps(schema)[:120]}")
+
+    def compile(self) -> Dfa:
+        # leading/trailing ws around the document itself
+        frag = self._seq(self.ws(), self.value_frag(self.schema), self.ws())
+        return self.b.to_dfa(frag)
+
+
+def compile_schema(schema: dict) -> Dfa:
+    """JSON Schema -> byte DFA with fullmatch-over-the-document
+    semantics. Raises ValueError for the unsupported subset."""
+    return SchemaCompiler(schema).compile()

@@ -1,0 +1,308 @@
+"""Streaming tool-call parsing: model text -> OpenAI tool_calls deltas.
+
+Two modes, selected by the preprocessor (docs/guided_decoding.md):
+
+- FORCED (``tool_choice`` names a function): generation was
+  schema-guided to the function's parameters object, so EVERY text
+  delta is an arguments delta — no detection needed, and the stream's
+  finish_reason is ``tool_calls`` by construction.
+
+- AUTO (``tools`` present, ``tool_choice`` auto/absent): the parser
+  watches the start of the output for the canonical inline-JSON call
+  shape ``{"name": "<fn>", "arguments": { ... }}`` (``"parameters"``
+  accepted as an alias). While the prefix is still AMBIGUOUS it
+  buffers (bounded); the moment it mismatches, everything buffered
+  flushes as ordinary content — plain chat traffic pays one bounded
+  buffer, never a lost token. On a match the function name becomes the
+  tool_call header delta and the arguments object streams through
+  brace-depth tracking (string-aware) until it closes.
+
+The parser emits a flat event list per feed() so the preprocessor's
+backward() can map events 1:1 onto ChatDelta chunks; one tool call per
+response (index 0), matching what schema-guided generation produces.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+# detection buffer bound: the call header `{"name": "<fn>", "arguments":`
+# comfortably fits; past this the output is treated as plain text
+DETECT_BUFFER_LIMIT = 256
+
+_WS = " \t\n\r"
+
+
+@dataclass
+class ToolEvent:
+    kind: str  # "text" | "tool_start" | "tool_args"
+    value: str = ""
+
+
+@dataclass
+class ToolCallStreamParser:
+    forced_name: Optional[str] = None
+    # internal phase: init/detect -> args -> tail | text
+    _phase: str = field(default="init", repr=False)
+    _buf: str = field(default="", repr=False)
+    # everything consumed by a matched header (replayed as text when
+    # the arguments value turns out not to be an object)
+    _header: str = field(default="", repr=False)
+    _name: str = field(default="", repr=False)
+    _depth: int = field(default=0, repr=False)
+    _in_str: bool = field(default=False, repr=False)
+    _esc: bool = field(default=False, repr=False)
+    _seen_obj: bool = field(default=False, repr=False)
+    started: bool = False
+
+    def __post_init__(self) -> None:
+        if self.forced_name is not None:
+            self._phase = "forced"
+
+    @property
+    def tool_call_detected(self) -> bool:
+        return self.started
+
+    @property
+    def arguments_complete(self) -> bool:
+        """True once the streamed arguments form a CLOSED object —
+        the preprocessor only reports finish_reason="tool_calls" when
+        this holds (a stream that stopped mid-arguments keeps its real
+        finish reason; clients json.loads on "tool_calls")."""
+        if not self.started:
+            return False
+        if self._phase == "forced":
+            return self._seen_obj and self._depth == 0
+        return self._phase == "tail"
+
+    def feed(self, text: str) -> list[ToolEvent]:
+        if not text:
+            return []
+        if self._phase == "forced":
+            out = []
+            if not self.started:
+                self.started = True
+                out.append(ToolEvent("tool_start", self.forced_name or ""))
+            self._track(text)
+            out.append(ToolEvent("tool_args", text))
+            return out
+        if self._phase == "text":
+            return [ToolEvent("text", text)]
+        if self._phase == "args":
+            return self._feed_args(text)
+        if self._phase == "tail":
+            return []  # wrapper remainder after the arguments closed
+        # detection phase
+        self._buf += text
+        return self._detect()
+
+    def finish(self) -> list[ToolEvent]:
+        """End of stream: flush whatever detection still holds. A
+        header whose arguments object never opened replays as text —
+        no tool_start was emitted for it."""
+        if self._phase in ("init", "detect") and self._buf:
+            self._phase = "text"
+            buf, self._buf = self._buf, ""
+            return [ToolEvent("text", buf)]
+        if self._phase == "args" and not self.started:
+            self._phase = "text"
+            header, self._header = self._header, ""
+            return [ToolEvent("text", header)] if header else []
+        return []
+
+    def _track(self, text: str) -> None:
+        """String-aware brace tracking over forced-mode passthrough —
+        feeds arguments_complete only (forced text IS the arguments)."""
+        for ch in text:
+            if self._in_str:
+                if self._esc:
+                    self._esc = False
+                elif ch == "\\":
+                    self._esc = True
+                elif ch == '"':
+                    self._in_str = False
+                continue
+            if self._depth == 0:
+                if ch == "{":
+                    self._seen_obj = True
+                    self._depth = 1
+                continue
+            if ch == '"':
+                self._in_str = True
+            elif ch == "{":
+                self._depth += 1
+            elif ch == "}":
+                self._depth -= 1
+
+    # -- detection --------------------------------------------------------
+    def _detect(self) -> list[ToolEvent]:
+        status, name, rest = _match_call_header(self._buf)
+        if status == "prefix":
+            if len(self._buf) > DETECT_BUFFER_LIMIT:
+                self._phase = "text"
+                buf, self._buf = self._buf, ""
+                return [ToolEvent("text", buf)]
+            self._phase = "detect"
+            return []
+        if status == "no":
+            self._phase = "text"
+            buf, self._buf = self._buf, ""
+            return [ToolEvent("text", buf)]
+        # header matched — but do NOT emit the tool_start delta until
+        # the arguments value proves to be an object: `"arguments":
+        # null` must degrade to plain text with no phantom call header
+        self._header = self._buf[: len(self._buf) - len(rest)]
+        self._buf = ""
+        self._phase = "args"
+        self._name = name
+        return self._feed_args(rest)
+
+    def _feed_args(self, text: str) -> list[ToolEvent]:
+        """Stream the arguments object, tracking brace depth with
+        string/escape awareness; the byte that closes it ends the
+        arguments — the wrapper's trailing ``}`` is swallowed."""
+        out: list[ToolEvent] = []
+        emitted: list[str] = []
+        for i, ch in enumerate(text):
+            if self._depth == 0:
+                # waiting for the args object to open
+                if ch in _WS:
+                    self._header += ch
+                    continue
+                if ch == "{":
+                    if not self.started:
+                        self.started = True
+                        out.append(ToolEvent("tool_start", self._name))
+                    self._depth = 1
+                    emitted.append(ch)
+                    continue
+                # not an object (null / string / number): degrade to
+                # text, replaying the consumed header verbatim
+                self._phase = "text"
+                header, self._header = self._header, ""
+                out.append(ToolEvent("text", header + text[i:]))
+                return out
+            emitted.append(ch)
+            if self._in_str:
+                if self._esc:
+                    self._esc = False
+                elif ch == "\\":
+                    self._esc = True
+                elif ch == '"':
+                    self._in_str = False
+                continue
+            if ch == '"':
+                self._in_str = True
+            elif ch == "{":
+                self._depth += 1
+            elif ch == "}":
+                self._depth -= 1
+                if self._depth == 0:
+                    self._phase = "tail"
+                    break
+        if emitted:
+            out.append(ToolEvent("tool_args", "".join(emitted)))
+        return out
+
+
+def _match_call_header(buf: str) -> tuple[str, str, str]:
+    """Match ``{ "name" : "<fn>" , "arguments"|"parameters" :`` against
+    ``buf``. Returns ("match", fn, rest) / ("prefix", "", "") when buf
+    is a proper prefix of a possible header / ("no", "", "")."""
+    i = 0
+    n = len(buf)
+
+    def skip_ws(j: int) -> int:
+        while j < n and buf[j] in _WS:
+            j += 1
+        return j
+
+    def expect(j: int, lit: str) -> tuple[str, int]:
+        # returns ("ok"|"prefix"|"no", next index)
+        for ch in lit:
+            if j >= n:
+                return "prefix", j
+            if buf[j] != ch:
+                return "no", j
+            j += 1
+        return "ok", j
+
+    i = skip_ws(i)
+    if i >= n:
+        return ("prefix", "", "")
+    st, i = expect(i, "{")
+    if st != "ok":
+        return (st if st == "prefix" else "no", "", "")
+    i = skip_ws(i)
+    st, i = expect(i, '"name"')
+    if st != "ok":
+        return (st if st == "prefix" else "no", "", "")
+    i = skip_ws(i)
+    st, i = expect(i, ":")
+    if st != "ok":
+        return (st if st == "prefix" else "no", "", "")
+    i = skip_ws(i)
+    st, i = expect(i, '"')
+    if st != "ok":
+        return (st if st == "prefix" else "no", "", "")
+    # function name: up to the closing quote (escapes not supported in
+    # function names — OpenAI names are [a-zA-Z0-9_-]{1,64})
+    j = i
+    while j < n and buf[j] != '"':
+        if buf[j] == "\\":
+            return ("no", "", "")
+        j += 1
+    if j >= n:
+        return ("prefix", "", "") if j - i <= 64 else ("no", "", "")
+    name = buf[i:j]
+    if not name:
+        return ("no", "", "")
+    i = j + 1
+    i = skip_ws(i)
+    st, i = expect(i, ",")
+    if st != "ok":
+        return (st if st == "prefix" else "no", "", "")
+    i = skip_ws(i)
+    matched_key = None
+    for key in ('"arguments"', '"parameters"'):
+        st, k = expect(i, key)
+        if st == "ok":
+            matched_key = key
+            i = k
+            break
+        if st == "prefix":
+            return ("prefix", "", "")
+    if matched_key is None:
+        return ("no", "", "")
+    i = skip_ws(i)
+    st, i = expect(i, ":")
+    if st != "ok":
+        return (st if st == "prefix" else "no", "", "")
+    return ("match", name, buf[i:])
+
+
+def forced_tool_name(tool_choice, tools) -> Optional[str]:
+    """The function name a request's tool_choice FORCES, or None.
+    Accepts the OpenAI object form ({"type": "function", "function":
+    {"name": ...}}), the bare {"name": ...} shorthand, and
+    ``"required"`` when exactly one tool is listed."""
+    if isinstance(tool_choice, dict):
+        fn = tool_choice.get("function") or tool_choice
+        name = fn.get("name") if isinstance(fn, dict) else None
+        return str(name) if name else None
+    if tool_choice == "required" and tools and len(tools) == 1:
+        fn = (tools[0] or {}).get("function") or {}
+        name = fn.get("name")
+        return str(name) if name else None
+    return None
+
+
+def tool_parameters_schema(tools, name: str) -> Optional[dict]:
+    """The ``parameters`` JSON Schema of the named tool, or None."""
+    for t in tools or []:
+        fn = (t or {}).get("function") or {}
+        if fn.get("name") == name:
+            params = fn.get("parameters")
+            return params if isinstance(params, dict) else None
+    return None

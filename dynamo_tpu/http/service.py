@@ -68,6 +68,14 @@ REQUEST_TIMEOUT_HEADER = "X-Request-Timeout-Ms"
 FAULT_HEADER = "X-Dyn-Fault"
 
 
+async def _chain_first(first, rest):
+    """Re-prepend the primed first chunk to the rest of the stream."""
+    if first is not None:
+        yield first
+    async for chunk in rest:
+        yield chunk
+
+
 def _request_id_from(request: web.Request) -> str:
     """The client's X-Request-Id (sanitized) or a fresh one."""
     rid = request.headers.get(REQUEST_ID_HEADER, "").strip()
@@ -316,6 +324,15 @@ class HttpService:
             spec_opt = req.extension().speculative
             if spec_opt is not None:
                 span.set_attr("speculative", bool(spec_opt))
+            # guided decoding / tool calling (docs/guided_decoding.md):
+            # stamp the constraint kind and tool surface on the root
+            # span so traces show which requests ran masked
+            rf = getattr(req, "response_format", None)
+            if isinstance(rf, dict) and rf.get("type"):
+                span.set_attr("response_format", str(rf["type"]))
+            tools = getattr(req, "tools", None)
+            if tools:
+                span.set_attr("tools", len(tools))
             engines = (
                 self.models.chat_engines if kind == "chat" else self.models.completion_engines
             )
@@ -342,8 +359,20 @@ class HttpService:
             try:
                 stream = engine.generate(req, ctx)
                 if req.stream:
+                    # prime the FIRST chunk before committing to an SSE
+                    # response: generation pipelines run lazily, so
+                    # request-shaped failures (uncompilable guided
+                    # schemas, bad token ids) surface on the first
+                    # __anext__ — they must return the 400 below, not a
+                    # 200 stream carrying an error event
+                    aiter = stream.__aiter__()
+                    try:
+                        first = await aiter.__anext__()
+                    except StopAsyncIteration:
+                        first = None
                     return await self._stream_sse(
-                        request, stream, ctx, model, endpoint, start, rid
+                        request, _chain_first(first, aiter), ctx, model,
+                        endpoint, start, rid,
                     )
                 # aggregate to a single response object
                 agg = ChatAggregator() if kind == "chat" else CompletionAggregator()
@@ -361,6 +390,19 @@ class HttpService:
                 ctx.kill()
                 span.set_attr("status", "499")
                 raise
+            except ValueError as exc:
+                # request-shaped failures surfacing past pydantic —
+                # uncompilable guided schemas, bad token ids — are the
+                # CLIENT's error, not an engine failure. Logged with the
+                # traceback anyway: if an internal defect ever surfaces
+                # as ValueError, the 400 must not hide it from operators
+                log.warning(
+                    "rejecting request %s as invalid: %s", rid, exc,
+                    exc_info=True,
+                )
+                return self._error(
+                    400, f"invalid request: {exc}", model, endpoint, rid
+                )
             except Exception as exc:
                 log.exception("engine failure for %s", model)
                 return self._error(

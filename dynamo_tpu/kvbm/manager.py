@@ -34,6 +34,7 @@ from dynamo_tpu.telemetry.instruments import (
     KVBM_OFFLOADED_BLOCKS,
     KVBM_ONBOARDED_BLOCKS,
 )
+from dynamo_tpu.utils.clock import SYSTEM, Clock
 
 log = logging.getLogger("dynamo_tpu.kvbm")
 
@@ -162,7 +163,12 @@ class KvBlockManager:
         scatter_fn: ScatterFn,
         resolve_fn: ResolveFn,
         remote_objects: Optional[SyncObjectStore] = None,
+        clock: Optional[Clock] = None,
     ):
+        # injectable clock (utils/clock.py; DL009 vocabulary): pump()'s
+        # G4 refresh throttle reads time through this seam, so tests and
+        # the fleet simulator can drive the refresh deterministically
+        self.clock = clock or SYSTEM
         self.config = config
         if config.host_num_blocks <= 0:
             raise ValueError("host_num_blocks must be positive")
@@ -226,9 +232,7 @@ class KvBlockManager:
         if self.remote is not None:
             # periodic G4 index refresh: discover blocks OTHER workers
             # demoted since we attached (the cross-worker tier benefit)
-            import time as _time
-
-            now = _time.monotonic()
+            now = self.clock.monotonic()
             if now - self._last_remote_refresh >= self.REMOTE_REFRESH_S:
                 self._last_remote_refresh = now
                 try:

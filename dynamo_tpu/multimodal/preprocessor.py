@@ -20,7 +20,7 @@ import numpy as np
 from dynamo_tpu.multimodal.embeds import pack_segments
 from dynamo_tpu.preprocessor.preprocessor import OpenAIPreprocessor
 from dynamo_tpu.protocols.common import PreprocessedRequest
-from dynamo_tpu.protocols.openai import ChatCompletionRequest
+from dynamo_tpu.protocols.openai import ChatCompletionRequest, guided_options
 
 # encode(urls) -> [n_images, tokens_per_image, D] float32
 EncodeFn = Callable[[list[str]], "np.ndarray"]
@@ -100,6 +100,8 @@ class MultimodalPreprocessor(OpenAIPreprocessor):
             model=request.model,
             annotations=list(request.extension().annotations),
             speculative=request.extension().speculative,
+            migration=request.extension().migration,
+            guided=guided_options(request),
             mm_embeds=pack_segments(segments),
         )
 

@@ -13,13 +13,18 @@ from dataclasses import dataclass
 from typing import Any, AsyncIterator, Optional, Union
 
 from dynamo_tpu.preprocessor.prompt import PromptFormatter
-from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
 from dynamo_tpu.protocols.openai import (
     ChatCompletionRequest,
     ChatDeltaGenerator,
     CompletionDeltaGenerator,
     CompletionRequest,
     Usage,
+    guided_options,
 )
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.pipeline import Operator
@@ -35,6 +40,11 @@ class _ReqState:
     include_usage: bool
     logprobs: bool
     n: int = 1  # choices (ChoiceFanout tags items with their index)
+    # tool-call streaming (docs/guided_decoding.md): "forced" wraps the
+    # whole (schema-guided) output as one tool call; "auto" watches the
+    # stream for the inline-JSON call shape and converts on detection
+    tool_mode: Optional[str] = None  # None | "forced" | "auto"
+    tool_name: Optional[str] = None  # the forced function's name
 
 
 class OpenAIPreprocessor(Operator):
@@ -72,6 +82,7 @@ class OpenAIPreprocessor(Operator):
             annotations=list(ext.annotations),
             speculative=ext.speculative,
             migration=ext.migration,
+            guided=guided_options(request),
         )
 
     def preprocess_completion(self, request: CompletionRequest) -> PreprocessedRequest:
@@ -101,6 +112,7 @@ class OpenAIPreprocessor(Operator):
             annotations=list(ext.annotations),
             speculative=ext.speculative,
             migration=ext.migration,
+            guided=guided_options(request),
         )
 
     # -- Operator interface ----------------------------------------------
@@ -128,6 +140,13 @@ class OpenAIPreprocessor(Operator):
         include_usage = not request.stream or bool(
             request.stream_options and request.stream_options.include_usage
         )
+        tool_mode = tool_name = None
+        if kind == "chat" and getattr(request, "tools", None):
+            from dynamo_tpu.guided.tools import forced_tool_name
+
+            if request.tool_choice != "none":
+                tool_name = forced_tool_name(request.tool_choice, request.tools)
+                tool_mode = "forced" if tool_name else "auto"
         state = _ReqState(
             kind=kind,
             model=request.model or self.model_name,
@@ -136,6 +155,8 @@ class OpenAIPreprocessor(Operator):
             include_usage=include_usage,
             logprobs=pre.output.logprobs is not None,
             n=pre.sampling.n,
+            tool_mode=tool_mode,
+            tool_name=tool_name,
         )
         return pre, state
 
@@ -235,11 +256,44 @@ class OpenAIPreprocessor(Operator):
         Handles n>1 (ChoiceFanout tags items with their choice index):
         per-choice deltas/finish chunks; ONE trailing usage chunk after
         every choice has finished, completion tokens summed across
-        choices (prompt counted once, OpenAI semantics)."""
+        choices (prompt counted once, OpenAI semantics).
+
+        Tool-call streams (state.tool_mode; docs/guided_decoding.md):
+        each choice's text runs through a ToolCallStreamParser — forced
+        mode converts every delta into arguments fragments, auto mode
+        converts on detection and flushes plain text untouched on a
+        miss. A detected call finishes with reason "tool_calls";
+        logprob payloads are dropped on tool-mode chat streams (the
+        parser re-chunks text, so per-delta alignment no longer holds)."""
         if state.kind == "chat":
             gen = ChatDeltaGenerator(model=state.model, request_id=state.request_id)
         else:
             gen = CompletionDeltaGenerator(model=state.model, request_id=state.request_id)
+        parsers: dict[int, Any] = {}
+        use_tools = state.kind == "chat" and state.tool_mode is not None
+
+        def tool_parser(idx: int):
+            p = parsers.get(idx)
+            if p is None:
+                from dynamo_tpu.guided.tools import ToolCallStreamParser
+
+                p = parsers[idx] = ToolCallStreamParser(
+                    forced_name=(
+                        state.tool_name if state.tool_mode == "forced" else None
+                    )
+                )
+            return p
+
+        def tool_chunks(idx: int, events):
+            for ev in events:
+                if ev.kind == "text":
+                    yield gen.text_chunk(ev.value, index=idx)
+                elif ev.kind == "tool_start":
+                    yield gen.tool_start_chunk(ev.value, index=idx)
+                elif ev.kind == "tool_args":
+                    if ev.value:
+                        yield gen.tool_args_chunk(ev.value, index=idx)
+
         completion_tokens: dict[int, int] = {}
         char_offsets: dict[int, int] = {}
         finished: set[int] = set()
@@ -255,24 +309,54 @@ class OpenAIPreprocessor(Operator):
                 item.token_ids
             )
             lp_payload = None
-            if state.logprobs:
+            if state.logprobs and not use_tools:
                 if state.kind == "chat":
                     lp_payload = self._chat_logprobs(item)
                 else:
                     lp_payload, char_offsets[idx] = self._completion_logprobs(
                         item, char_offsets.get(idx, 0)
                     )
-            if item.text or lp_payload:
+            if use_tools:
+                if item.text:
+                    for chunk in tool_chunks(idx, tool_parser(idx).feed(item.text)):
+                        yield chunk
+            elif item.text or lp_payload:
                 yield gen.text_chunk(
                     item.text or "", index=idx, logprobs=lp_payload
                 )
             if item.finish_reason is not None:
+                reason = item.finish_reason
+                if use_tools:
+                    p = tool_parser(idx)
+                    for chunk in tool_chunks(idx, p.finish()):
+                        yield chunk
+                    reason_str = (
+                        reason.value
+                        if isinstance(reason, FinishReason)
+                        else str(reason)
+                    )
+                    # OpenAI semantics: only a COMPLETED call finishes
+                    # with "tool_calls" — a stream truncated by
+                    # max_tokens OR stopped (eos) mid-arguments keeps
+                    # its real reason so clients never json.loads an
+                    # unterminated fragment
+                    if (
+                        p.tool_call_detected
+                        and p.arguments_complete
+                        and reason_str == "stop"
+                    ):
+                        from dynamo_tpu.telemetry.instruments import (
+                            TOOL_CALL_STREAMS,
+                        )
+
+                        TOOL_CALL_STREAMS.labels(state.tool_mode).inc()
+                        reason = "tool_calls"
                 if state.kind == "chat" and idx not in gen._started:
                     # a choice whose every token detokenized to "" never
                     # got a content delta — OpenAI streams still carry
                     # the assistant role delta for EVERY choice
                     yield gen.role_chunk(index=idx)
-                yield gen.finish_chunk(item.finish_reason, index=idx)
+                yield gen.finish_chunk(reason, index=idx)
                 finished.add(idx)
                 total_completion += (
                     item.completion_tokens or completion_tokens.get(idx, 0)

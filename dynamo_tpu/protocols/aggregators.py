@@ -30,6 +30,10 @@ class ChatAggregator:
         self._roles: dict[int, str] = {}
         self._finish: dict[int, str | None] = {}
         self._logprobs: dict[int, list] = {}
+        # per-choice tool calls: tool_call index -> {id, type, name,
+        # arguments parts} (streaming deltas carry the header once, then
+        # arguments fragments to concatenate — OpenAI tool-call shape)
+        self._tools: dict[int, dict[int, dict]] = {}
         self._usage: Usage | None = None
 
     def push(self, chunk: ChatCompletionChunk) -> None:
@@ -44,6 +48,18 @@ class ChatAggregator:
                 self._roles[idx] = choice.delta.role
             if choice.delta.content:
                 self._texts.setdefault(idx, []).append(choice.delta.content)
+            for tc in choice.delta.tool_calls or []:
+                ti = int(tc.get("index", 0))
+                acc = self._tools.setdefault(idx, {}).setdefault(
+                    ti, {"id": None, "type": "function", "name": "", "args": []}
+                )
+                if tc.get("id"):
+                    acc["id"] = tc["id"]
+                fn = tc.get("function") or {}
+                if fn.get("name"):
+                    acc["name"] = fn["name"]
+                if fn.get("arguments"):
+                    acc["args"].append(fn["arguments"])
             if choice.logprobs and choice.logprobs.get("content"):
                 self._logprobs.setdefault(idx, []).extend(
                     choice.logprobs["content"]
@@ -51,14 +67,39 @@ class ChatAggregator:
             if choice.finish_reason is not None:
                 self._finish[idx] = choice.finish_reason
 
+    def _tool_calls(self, idx: int) -> list[dict] | None:
+        acc = self._tools.get(idx)
+        if not acc:
+            return None
+        return [
+            {
+                "id": a["id"] or f"call_{i}",
+                "type": a["type"],
+                "function": {
+                    "name": a["name"],
+                    "arguments": "".join(a["args"]),
+                },
+            }
+            for i, a in sorted(acc.items())
+        ]
+
     def response(self) -> ChatCompletionResponse:
-        indices = sorted(set(self._texts) | set(self._finish) | set(self._roles) | {0})
+        indices = sorted(
+            set(self._texts) | set(self._finish) | set(self._roles)
+            | set(self._tools) | {0}
+        )
         choices = [
             ChatCompletionChoice(
                 index=i,
                 message=ChatMessage(
                     role=self._roles.get(i, "assistant"),
-                    content="".join(self._texts.get(i, [])),
+                    # OpenAI tool-call messages carry content=null
+                    content=(
+                        None
+                        if self._tools.get(i)
+                        else "".join(self._texts.get(i, []))
+                    ),
+                    tool_calls=self._tool_calls(i),
                 ),
                 finish_reason=self._finish.get(i),
                 logprobs=(

@@ -75,6 +75,19 @@ class SamplingOptions(BaseModel):
         return s
 
 
+class GuidedOptions(BaseModel):
+    """Constrained-decoding spec (docs/guided_decoding.md): compiled by
+    the serving engine into a token-level automaton over the served
+    tokenizer's vocab, whose allow-mask rides every sampling step.
+    Adapters build this from OpenAI ``response_format`` /
+    ``tool_choice`` (protocols/openai.py guided_options); engines treat
+    it as opaque data keyed for the process-wide compile cache."""
+
+    kind: str  # "json_schema" | "regex" | "json_object"
+    json_schema: Optional[dict[str, Any]] = None
+    regex: Optional[str] = None
+
+
 class StopConditions(BaseModel):
     """Stop criteria (reference: common.rs StopConditions).
 
@@ -129,6 +142,15 @@ class PreprocessedRequest(BaseModel):
     # trades per-request latency shape (token bursts) and exact seeded
     # reproducibility vs a non-speculative engine.
     speculative: Optional[bool] = None
+    # Guided decoding (docs/guided_decoding.md): a compiled-at-admission
+    # token-mask constraint (JSON Schema / regex / json_object mode).
+    # None = unconstrained. Per-request opt-out mirrors ext.speculative:
+    # OpenAI ext.guided=False keeps response_format/tools traffic
+    # unmasked (the frontend still parses tool calls from free text).
+    # Guided requests require an engine serving decode_steps == 1 (the
+    # mask advances on host per committed token; fused K-step windows
+    # sample K tokens per dispatch with no host in the loop).
+    guided: Optional[GuidedOptions] = None
     # Mid-stream migration (docs/robustness.md "Mid-stream migration"):
     # ``resume_offset`` is the number of tokens a previous worker
     # already generated AND delivered for this request before it died —

@@ -20,6 +20,7 @@ from pydantic import BaseModel, ConfigDict, Field, field_validator
 
 from dynamo_tpu.protocols.common import (
     FinishReason,
+    GuidedOptions,
     OutputOptions,
     SamplingOptions,
     StopConditions,
@@ -52,6 +53,14 @@ class ExtOptions(BaseModel):
     # instead of resuming elsewhere) — carried through the preprocessor
     # into PreprocessedRequest.migration (docs/robustness.md)
     migration: Optional[bool] = None
+    # per-request guided-decoding opt-out (docs/guided_decoding.md),
+    # mirroring ext.speculative: False serves response_format/tools
+    # traffic UNMASKED (tool-call parsing still runs on the free text);
+    # None/True compile the constraint into a token mask
+    guided: Optional[bool] = None
+    # raw regex constraint (engine extension — no OpenAI equivalent):
+    # the completion must fullmatch this pattern (guided regex subset)
+    guided_regex: Optional[str] = None
 
 
 def _int_logit_bias(
@@ -264,6 +273,9 @@ class CompletionRequest(BaseModel):
     logit_bias: Optional[dict[str, float]] = None
     seed: Optional[int] = None
     user: Optional[str] = None
+    # response_format is not part of the legacy completions API, but the
+    # guided-decoding path honors it here too (json_object/json_schema)
+    response_format: Optional[dict[str, Any]] = None
     ext: Optional[ExtOptions] = None
     nvext: Optional[ExtOptions] = None
 
@@ -323,6 +335,62 @@ class CompletionResponse(BaseModel):
     model: str
     choices: list[CompletionChoice]
     usage: Optional[Usage] = None
+
+
+# ---------------------------------------------------------------------------
+# Guided-decoding adaptation (docs/guided_decoding.md)
+# ---------------------------------------------------------------------------
+
+
+def guided_options(
+    request: Union[ChatCompletionRequest, CompletionRequest],
+) -> Optional[GuidedOptions]:
+    """Engine-facing guided spec from the OpenAI fields, priority order:
+
+    1. ``ext.guided=False`` — explicit opt-out, nothing is masked;
+    2. a FORCING ``tool_choice`` — the named tool's ``parameters``
+       schema constrains generation (the frontend wraps the output as a
+       tool call, so the model emits exactly the arguments object);
+    3. ``ext.guided_regex`` — raw regex constraint (engine extension);
+    4. ``response_format`` — ``json_object`` or ``json_schema`` (OpenAI
+       nests the schema at ``response_format.json_schema.schema``).
+
+    Raises ValueError for malformed response_format so the request
+    fails with a client error, not a mid-generation engine error."""
+    from dynamo_tpu.guided.tools import forced_tool_name, tool_parameters_schema
+
+    ext = request.extension()
+    if ext.guided is False:
+        return None
+    tools = getattr(request, "tools", None)
+    tool_choice = getattr(request, "tool_choice", None)
+    forced = forced_tool_name(tool_choice, tools) if tool_choice != "none" else None
+    if forced:
+        schema = tool_parameters_schema(tools, forced)
+        if schema is None:
+            raise ValueError(
+                f"tool_choice forces {forced!r} but no such tool (or no "
+                "parameters schema) was provided"
+            )
+        return GuidedOptions(kind="json_schema", json_schema=schema)
+    if ext.guided_regex:
+        return GuidedOptions(kind="regex", regex=ext.guided_regex)
+    rf = request.response_format
+    if isinstance(rf, dict) and rf.get("type"):
+        t = rf["type"]
+        if t == "json_object":
+            return GuidedOptions(kind="json_object")
+        if t == "json_schema":
+            js = rf.get("json_schema")
+            schema = js.get("schema") if isinstance(js, dict) else None
+            if not isinstance(schema, dict):
+                raise ValueError(
+                    "response_format.json_schema.schema must be an object"
+                )
+            return GuidedOptions(kind="json_schema", json_schema=schema)
+        if t != "text":
+            raise ValueError(f"unsupported response_format type {t!r}")
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -392,6 +460,55 @@ class ChatDeltaGenerator:
             choices=[
                 ChatCompletionChunkChoice(
                     index=index, delta=delta, logprobs=logprobs
+                )
+            ],
+        )
+
+    def tool_start_chunk(
+        self, name: str, index: int = 0, call_id: Optional[str] = None
+    ) -> ChatCompletionChunk:
+        """First tool-call delta of a choice: the id/type/name header
+        with empty arguments (OpenAI streaming tool-call shape)."""
+        delta = ChatDelta(
+            tool_calls=[
+                {
+                    "index": 0,
+                    "id": call_id or f"call_{uuid.uuid4().hex[:24]}",
+                    "type": "function",
+                    "function": {"name": name, "arguments": ""},
+                }
+            ]
+        )
+        if index not in self._started:
+            delta.role = "assistant"
+            self._started.add(index)
+        return ChatCompletionChunk(
+            id=self.id,
+            created=self.created,
+            model=self.model,
+            choices=[ChatCompletionChunkChoice(index=index, delta=delta)],
+        )
+
+    def tool_args_chunk(
+        self, arguments_delta: str, index: int = 0
+    ) -> ChatCompletionChunk:
+        """Incremental arguments fragment; clients concatenate the
+        ``function.arguments`` strings to reassemble the JSON object."""
+        return ChatCompletionChunk(
+            id=self.id,
+            created=self.created,
+            model=self.model,
+            choices=[
+                ChatCompletionChunkChoice(
+                    index=index,
+                    delta=ChatDelta(
+                        tool_calls=[
+                            {
+                                "index": 0,
+                                "function": {"arguments": arguments_delta},
+                            }
+                        ]
+                    ),
                 )
             ],
         )

@@ -148,11 +148,16 @@ def _set(obj: Any, key: str, value: Any) -> None:
 def resumable(request: Any) -> bool:
     """Whether a request is eligible for mid-stream migration: it must
     be token-shaped (a PreprocessedRequest or wire dict), not opted out
-    (``migration=False``), and penalty-free (see module docstring)."""
+    (``migration=False``), penalty-free (see module docstring), and not
+    guided — a resume folds delivered tokens into token_ids with no
+    prompt/generated boundary, so the guided automaton cursor could not
+    be reconstructed on the new worker (docs/guided_decoding.md)."""
     token_ids = _get(request, "token_ids")
     if not isinstance(token_ids, list) or not token_ids:
         return False
     if _get(request, "migration") is False:
+        return False
+    if _get(request, "guided") is not None:
         return False
     sampling = _get(request, "sampling")
     if sampling is not None:

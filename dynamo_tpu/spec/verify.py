@@ -86,6 +86,17 @@ def verify_tokens(
     """
     B, S, V = logits_all.shape
     K = S - 1
+    if "allow_mask" in s:
+        # guided decoding (docs/guided_decoding.md): the [B, S, V]
+        # per-position allow-mask — position j's mask is the automaton
+        # state AFTER the first j drafts commit, computed on host from
+        # the SAME automaton that masks the serial path. Applying it
+        # here, before argmax/shaping/log_softmax, is the transform
+        # sample() applies, at every fed position at once: draft
+        # acceptance, replacement sampling, the bonus token, and the
+        # emitted logprobs all target the constrained distribution, so
+        # speculative verification of structured output is EXACT.
+        logits_all = jnp.where(s["allow_mask"], logits_all, NEG_INF)
     temperature, seeds = s["temperature"], s["seeds"]
     greedy = temperature <= 0.0
     logprobs_full = jax.nn.log_softmax(logits_all, axis=-1)

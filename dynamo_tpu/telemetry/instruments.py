@@ -120,6 +120,30 @@ SPEC_DRAFT_HIDDEN_FRAC = REGISTRY.gauge(
     "exposed = first-step drafts + harvest-time repairs)",
 )
 
+# -- guided decoding (dynamo_tpu/guided; docs/guided_decoding.md) -----------
+GUIDED_COMPILE_SECONDS = REGISTRY.histogram(
+    "dynamo_guided_compile_seconds",
+    "Schema/regex -> token-automaton compile time (one compile per "
+    "(spec, tokenizer) pair; repeats hit the process-wide LRU)",
+    labels=("kind",),  # json_schema | regex | json_object
+    buckets=(0.001, 0.005, 0.025, 0.1, 0.5, 2.5, 10.0, float("inf")),
+)
+GUIDED_CACHE_EVENTS = REGISTRY.counter(
+    "dynamo_guided_cache_events_total",
+    "Guided-automaton compile-cache lookups by result",
+    labels=("result",),  # hit | miss
+)
+GUIDED_REQUESTS = REGISTRY.counter(
+    "dynamo_guided_requests_total",
+    "Requests admitted with a guided-decoding constraint",
+    labels=("kind",),  # json_schema | regex | json_object
+)
+TOOL_CALL_STREAMS = REGISTRY.counter(
+    "dynamo_tool_call_streams_total",
+    "Responses emitted as OpenAI tool_calls deltas",
+    labels=("mode",),  # forced | auto
+)
+
 # -- KV block manager / transfer plane --------------------------------------
 KV_TRANSFER_BYTES = REGISTRY.counter(
     "dynamo_kv_transfer_bytes_total",

@@ -1,0 +1,966 @@
+"""Guided decoding (ISSUE 15; docs/guided_decoding.md): compiler units,
+automaton-vs-reference fuzz over the real tokenizer vocab, engine e2e
+(greedy + seeded-sampled completions parse against the schema), guided
+spec bit-identity vs serial guided decode, the tool-call delta stream,
+and the prewarmed-guided compile-fence acceptance case."""
+
+import glob
+import json
+import os
+import random
+import re
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.guided.automaton import (
+    GuidedState,
+    TokenAutomaton,
+    automaton_for,
+    build_trie,
+    normalize_spec,
+)
+from dynamo_tpu.guided.fsm import JsonAutomaton, compile_regex
+from dynamo_tpu.guided.schema import compile_schema
+from dynamo_tpu.guided.tools import (
+    ToolCallStreamParser,
+    forced_tool_name,
+    tool_parameters_schema,
+)
+from dynamo_tpu.tokenizer import Tokenizer
+
+MODEL_DIR = os.path.join(os.path.dirname(__file__), "data", "tiny_llama_model")
+
+# bounded everywhere so a random-weights model always terminates the
+# document inside a small token budget (strings capped, enum, boolean)
+SCHEMA = {
+    "type": "object",
+    "properties": {
+        "name": {"type": "string", "maxLength": 4},
+        "ok": {"type": "boolean"},
+        "mood": {"enum": ["happy", "sad"]},
+    },
+    "required": ["name", "ok", "mood"],
+}
+
+
+def _accepts(auto, s: str) -> bool:
+    st = auto.start()
+    for b in s.encode():
+        st = auto.step(st, b)
+        if st is None:
+            return False
+    return auto.is_final(st)
+
+
+# ---------------------------------------------------------------------------
+# byte-automaton units
+# ---------------------------------------------------------------------------
+
+
+def test_regex_fuzz_matches_re_fullmatch():
+    """The regex subset compiles to a DFA that agrees with Python's
+    ``re.fullmatch`` on random strings (the compiler's ground truth)."""
+    patterns = [
+        r"[a-z]+",
+        r"\d{2,4}",
+        r"(foo|bar)*baz?",
+        r"a.c",
+        r"[^0-9]{1,3}",
+        r"-?(0|[1-9][0-9]*)(\.[0-9]+)?([eE][+-]?[0-9]+)?",
+        r"\w+@\w+\.(com|org)",
+        r"^abc$",
+        r"x{3}",
+        r"(ab){1,2}c",
+        r"[A-Fa-f0-9]{2}(:[A-Fa-f0-9]{2})*",
+    ]
+    rng = random.Random(0)
+    alphabet = "abcxyz019.@-eE:fo r\n"
+    for pat in patterns:
+        dfa = compile_regex(pat)
+        for _ in range(300):
+            s = "".join(
+                rng.choice(alphabet) for _ in range(rng.randrange(0, 8))
+            )
+            assert _accepts(dfa, s) == (re.fullmatch(pat, s) is not None), (
+                pat, s,
+            )
+
+
+def test_regex_rejects_unsupported_syntax():
+    for bad in [r"a{1,500}", r"(?P<x>a)", r"a|*", r"[z-a]", r"ab$cd",
+                "[é]"]:  # classes are byte sets; non-ASCII members lie
+        with pytest.raises(ValueError):
+            compile_regex(bad)
+    # non-ASCII literals match their full byte sequence via alternation
+    dfa = compile_regex("(é|è)x")
+    assert _accepts(dfa, "éx") and _accepts(dfa, "èx")
+    assert not _accepts(dfa, "\xc3x")  # a lone lead byte is not é
+
+
+def test_json_object_automaton():
+    ja = JsonAutomaton()
+    good = [
+        "{}",
+        '{"a": 1}',
+        '{"a": [1, 2.5, -3e2], "b": {"c": null}}',
+        '{"s": "he\\"llo", "t": true} ',
+        '{ "k" : [ ] }',
+        '{"u": "\\u00e9"}',
+    ]
+    bad = [
+        "",
+        "[1]",  # json_object mode: top level must be an object
+        '{"a": }',
+        '{"a": 1,}',
+        '{a: 1}',
+        '{"a": 01}',
+        '{"a": 1} x',
+        '{"a": "unterminated',
+        '{"a": 1 "b": 2}',
+    ]
+    for g in good:
+        assert _accepts(ja, g), g
+    for b in bad:
+        assert not _accepts(ja, b), b
+    # depth bound: opening past MAX_JSON_DEPTH is disallowed
+    deep = JsonAutomaton(max_depth=3)
+    assert _accepts(deep, '{"a": {"b": 1}}')
+    assert not _accepts(deep, '{"a": {"b": {"c": {"d": 1}}}}')
+
+
+def test_schema_compiler_accepts_and_rejects():
+    schema = {
+        "$defs": {"tag": {"type": "string", "maxLength": 3}},
+        "type": "object",
+        "properties": {
+            "name": {"type": "string", "maxLength": 8},
+            "age": {"type": "integer"},
+            "tags": {
+                "type": "array",
+                "items": {"$ref": "#/$defs/tag"},
+                "maxItems": 3,
+            },
+            "mood": {"enum": ["happy", "sad"]},
+            "extra": {"anyOf": [{"type": "null"}, {"type": "number"}]},
+        },
+        "required": ["name", "age"],
+    }
+    dfa = compile_schema(schema)
+    good = [
+        '{"name": "bob", "age": 3}',
+        '{"name":"a","age":-12,"tags":["x","yz"],"mood":"sad"}',
+        '{"name":"a","age":0,"mood":"happy","extra":null}',
+        '{"name":"a","age":7,"extra":-1.5e3}',
+    ]
+    bad = [
+        '{"age": 3}',  # missing required
+        '{"name":"bob"}',
+        '{"name":"bob","age":3.5}',  # float for integer
+        '{"age":3,"name":"bob"}',  # declared property order enforced
+        '{"name":"toolongname","age":1}',
+        '{"name":"b","age":1,"tags":["wxyz"]}',  # item too long
+        '{"name":"b","age":1,"mood":"angry"}',
+        '{"name":"b","age":1,"tags":["a","b","c","d"]}',  # maxItems
+    ]
+    for g in good:
+        assert _accepts(dfa, g), g
+        json.loads(g)  # the fixtures themselves are valid JSON
+    for b in bad:
+        assert not _accepts(dfa, b), b
+
+
+def test_schema_pattern_cannot_break_string_framing():
+    """Review fix: metacharacter patterns (., [^...], \\S) are
+    intersected with string-legal content bytes, so they can never
+    admit a raw quote/backslash that would terminate the JSON string
+    early; patterns REQUIRING such a byte are rejected at compile."""
+    dfa = compile_schema({
+        "type": "object",
+        "properties": {"v": {"type": "string", "pattern": ".+"}},
+        "required": ["v"],
+    })
+    assert _accepts(dfa, '{"v": "ab c"}')
+    # a raw quote inside the pattern-matched body is NOT mask-legal
+    # (the '.' edge was stripped of 0x22/0x5C/control bytes)
+    assert not _accepts(dfa, '{"v": "a"b"}')
+    assert not _accepts(dfa, '{"v": "a\\z"}')  # raw backslash in body
+    for pat in [r'a"b', r"a\\b"]:
+        with pytest.raises(ValueError):
+            compile_schema({
+                "type": "object",
+                "properties": {"v": {"type": "string", "pattern": pat}},
+                "required": ["v"],
+            })
+    # a class that PARTIALLY strips stays satisfiable on the legal
+    # subset: ["x] degrades to [x] (subset semantics, not an error)
+    dfa = compile_schema({
+        "type": "object",
+        "properties": {"v": {"type": "string", "pattern": r'["x]'}},
+        "required": ["v"],
+    })
+    assert _accepts(dfa, '{"v": "x"}')
+    assert not _accepts(dfa, '{"v": """}')
+
+
+def test_schema_compiler_rejects_unsupported():
+    for bad in [
+        {"allOf": [{"type": "string"}]},
+        {"enum": []},
+        {},  # unconstrained subschema
+        {"type": "object", "properties": {"a": {}}, "required": ["a"]},
+        {"type": "object", "required": ["ghost"]},
+        {"$ref": "#/external/thing"},
+    ]:
+        with pytest.raises(ValueError):
+            compile_schema(bad)
+
+
+# ---------------------------------------------------------------------------
+# token layer: automaton-vs-reference fuzz over the REAL tokenizer vocab
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return Tokenizer.from_file(MODEL_DIR)
+
+
+def _naive_mask(auto: TokenAutomaton, state) -> np.ndarray:
+    """Reference mask: re-validate EVERY token id by walking its bytes
+    through the byte automaton from ``state`` — the O(V * len) path the
+    trie walk exists to avoid."""
+    m = np.zeros((auto.vocab_pad,), dtype=bool)
+    for tid in range(auto.vocab_pad):
+        if auto.token_step(state, tid) is not None:
+            m[tid] = True
+    if auto.is_final(state):
+        for e in auto.eos_ids:
+            m[e] = True
+    return m
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        {"kind": "json_schema", "json_schema": SCHEMA},
+        {"kind": "regex", "regex": r"(yes|no), [0-9]{3}"},
+        {"kind": "json_object"},
+    ],
+    ids=["json_schema", "regex", "json_object"],
+)
+def test_mask_matches_naive_revalidation_fuzz(tok, spec):
+    """THE automaton-vs-reference fuzz (ISSUE 15 satellite): at every
+    state along random mask-legal walks, the trie-computed vocab mask
+    equals a naive per-token re-validation over the real tokenizer
+    vocabulary."""
+    auto = automaton_for(spec, tok, MODEL_DIR, 2048, {4})
+    rng = random.Random(2026)
+    for _walk in range(4):
+        state = auto.start_state()
+        for _step in range(16):
+            fast = auto.mask(state)
+            slow = _naive_mask(auto, state)
+            diff = np.flatnonzero(fast != slow)
+            assert diff.size == 0, (
+                f"mask mismatch at ids {diff[:8].tolist()} "
+                f"(walk state {state!r})"
+            )
+            choices = [
+                t for t in np.flatnonzero(fast).tolist()
+                if t not in auto.eos_ids
+            ]
+            if not choices:
+                break
+            nxt = rng.choice(choices)
+            state = auto.token_step(state, nxt)
+            assert state is not None
+
+
+def test_guided_state_advance_eos_and_done(tok):
+    auto = automaton_for(
+        {"kind": "regex", "regex": "ab"}, tok, MODEL_DIR, 2048, {4}
+    )
+    gs = GuidedState(auto)
+    a, b = tok.encode("a")[0], tok.encode("b")[0]
+    assert gs.allow_mask()[a] and not gs.allow_mask()[4]
+    gs.advance(a)
+    gs.advance(b)
+    # document complete: only stopping is legal
+    m = gs.allow_mask()
+    assert m[4] and m.sum() == 1
+    gs.advance(4)
+    assert gs.done and not gs.broken
+    # drafts filter through the automaton (and never propose eos)
+    gs2 = GuidedState(auto)
+    # 'ab' accepted; the third draft ('aba' is illegal) is cut
+    assert gs2.filter_drafts([a, b, a]) == [a, b]
+    assert gs2.filter_drafts([b]) == []  # 'b' illegal at the start
+    masks = gs2.masks_for_drafts([a])
+    assert masks.shape == (2, 2048)
+    assert masks[0][a] and masks[1][b] and not masks[1][a]
+
+
+def test_compile_cache_hits_and_metrics(tok):
+    from dynamo_tpu.telemetry import REGISTRY
+
+    spec = {"kind": "json_schema", "json_schema": {
+        "type": "object",
+        "properties": {"cachekey": {"type": "boolean"}},
+        "required": ["cachekey"],
+    }}
+    a1 = automaton_for(spec, tok, MODEL_DIR, 2048, {4})
+    a2 = automaton_for(dict(spec), tok, MODEL_DIR, 2048, {4})
+    assert a1 is a2  # LRU hit on the canonicalized spec key
+    text = REGISTRY.render()
+    assert 'dynamo_guided_cache_events_total{result="hit"}' in text
+    assert 'dynamo_guided_cache_events_total{result="miss"}' in text
+    assert "dynamo_guided_compile_seconds" in text
+
+
+def test_vocab_larger_than_model_rejected_at_compile(tok):
+    """Review fix: a tokenizer vocab larger than the model head fails
+    the REQUEST at automaton compile (admission), never as an
+    IndexError inside mask() on the engine step path."""
+    with pytest.raises(ValueError, match="exceeds the model vocab"):
+        automaton_for(
+            {"kind": "json_object"}, tok, MODEL_DIR, tok.vocab_size - 1,
+            {4},
+        )
+
+
+def test_normalize_spec_rejects_malformed():
+    for bad in [
+        None,
+        {"kind": "json_schema"},
+        {"kind": "regex"},
+        {"kind": "mystery"},
+    ]:
+        with pytest.raises(ValueError):
+            normalize_spec(bad)
+
+
+def test_trie_excludes_special_tokens():
+    trie = build_trie([b"ab", None, b"a", b""])
+    assert trie.children[ord("a")].ids == [2]
+    assert trie.children[ord("a")].children[ord("b")].ids == [0]
+
+
+# ---------------------------------------------------------------------------
+# tool-call streaming parser
+# ---------------------------------------------------------------------------
+
+
+def test_tool_parser_forced_mode_streams_arguments():
+    p = ToolCallStreamParser(forced_name="get_weather")
+    evs = p.feed('{"city": "Par') + p.feed('is"}') + p.finish()
+    assert evs[0].kind == "tool_start" and evs[0].value == "get_weather"
+    args = "".join(e.value for e in evs if e.kind == "tool_args")
+    assert json.loads(args) == {"city": "Paris"}
+    assert p.tool_call_detected
+
+
+def test_tool_parser_detects_inline_call_across_chunks():
+    p = ToolCallStreamParser()
+    chunks = ['{"na', 'me": "f", "argu', 'ments": {"x": "a}b", "n": {"m": 1}}}']
+    evs = []
+    for c in chunks:
+        evs += p.feed(c)
+    evs += p.finish()
+    assert p.tool_call_detected
+    assert [e.value for e in evs if e.kind == "tool_start"] == ["f"]
+    args = "".join(e.value for e in evs if e.kind == "tool_args")
+    # brace tracking is string-aware: "a}b" did not close the object
+    assert json.loads(args) == {"x": "a}b", "n": {"m": 1}}
+
+
+def test_tool_parser_flushes_plain_text_untouched():
+    p = ToolCallStreamParser()
+    evs = p.feed("Hello ") + p.feed("world") + p.finish()
+    assert not p.tool_call_detected
+    assert "".join(e.value for e in evs if e.kind == "text") == "Hello world"
+    # near-miss prefix: buffers, then flushes intact on mismatch
+    p2 = ToolCallStreamParser()
+    evs2 = p2.feed('{"nam') + p2.feed('ing": 1}') + p2.finish()
+    assert not p2.tool_call_detected
+    assert "".join(e.value for e in evs2 if e.kind == "text") == '{"naming": 1}'
+
+
+def test_tool_parser_non_object_arguments_degrade_with_no_header():
+    """Review fix: the tool_start header is deferred until the
+    arguments value proves to be an object — `"arguments": null`
+    replays as plain text with NO phantom call header."""
+    p = ToolCallStreamParser()
+    evs = p.feed('{"name": "f", "arguments": null}') + p.finish()
+    assert not p.tool_call_detected
+    assert [e.kind for e in evs] == ["text"]
+    assert evs[0].value == '{"name": "f", "arguments": null}'
+    # a header whose args object never arrives flushes intact at finish
+    p2 = ToolCallStreamParser()
+    assert p2.feed('{"name": "f", "arguments": ') == []
+    evs2 = p2.finish()
+    assert not p2.tool_call_detected
+    assert "".join(e.value for e in evs2) == '{"name": "f", "arguments": '
+
+
+def test_tool_parser_arguments_complete_tracking():
+    """Review fix: only a CLOSED arguments object counts as complete —
+    forced and auto mode alike."""
+    p = ToolCallStreamParser(forced_name="f")
+    p.feed('{"a": {"b": 1}')
+    assert p.tool_call_detected and not p.arguments_complete
+    p.feed("}")
+    assert p.arguments_complete
+    p2 = ToolCallStreamParser()
+    p2.feed('{"name": "f", "arguments": {"a": 1')
+    assert p2.tool_call_detected and not p2.arguments_complete
+    p2.feed("}}")
+    assert p2.arguments_complete
+
+
+def test_tool_parser_buffer_bound_and_unfinished_prefix():
+    p = ToolCallStreamParser()
+    big = "x" * 300
+    evs = p.feed(big)
+    assert "".join(e.value for e in evs if e.kind == "text") == big
+    # a stream that ENDS mid-detection flushes at finish()
+    p2 = ToolCallStreamParser()
+    assert p2.feed('{"name": "par') == []
+    evs2 = p2.finish()
+    assert "".join(e.value for e in evs2 if e.kind == "text") == '{"name": "par'
+
+
+def test_forced_tool_name_and_parameters_lookup():
+    tools = [
+        {"type": "function", "function": {
+            "name": "f", "parameters": {"type": "object", "properties": {}},
+        }},
+    ]
+    assert forced_tool_name(
+        {"type": "function", "function": {"name": "f"}}, tools
+    ) == "f"
+    assert forced_tool_name({"name": "f"}, tools) == "f"
+    assert forced_tool_name("required", tools) == "f"
+    assert forced_tool_name("auto", tools) is None
+    assert forced_tool_name(None, tools) is None
+    assert tool_parameters_schema(tools, "f") == {
+        "type": "object", "properties": {},
+    }
+    assert tool_parameters_schema(tools, "ghost") is None
+
+
+# ---------------------------------------------------------------------------
+# OpenAI adaptation: response_format / tools -> GuidedOptions
+# ---------------------------------------------------------------------------
+
+
+def test_guided_options_adaptation():
+    from dynamo_tpu.protocols.openai import (
+        ChatCompletionRequest,
+        guided_options,
+    )
+
+    base = {"model": "m", "messages": [{"role": "user", "content": "hi"}]}
+    assert guided_options(ChatCompletionRequest(**base)) is None
+    g = guided_options(ChatCompletionRequest(
+        **base, response_format={"type": "json_object"},
+    ))
+    assert g.kind == "json_object"
+    g = guided_options(ChatCompletionRequest(
+        **base,
+        response_format={
+            "type": "json_schema",
+            "json_schema": {"name": "s", "schema": SCHEMA},
+        },
+    ))
+    assert g.kind == "json_schema" and g.json_schema == SCHEMA
+    # a forcing tool_choice wins: the tool's parameters schema guides
+    g = guided_options(ChatCompletionRequest(
+        **base,
+        tools=[{"type": "function",
+                "function": {"name": "f", "parameters": SCHEMA}}],
+        tool_choice={"type": "function", "function": {"name": "f"}},
+    ))
+    assert g.kind == "json_schema" and g.json_schema == SCHEMA
+    # per-request opt-out mirrors ext.speculative
+    assert guided_options(ChatCompletionRequest(
+        **base,
+        response_format={"type": "json_object"},
+        ext={"guided": False},
+    )) is None
+    # engine regex extension
+    g = guided_options(ChatCompletionRequest(
+        **base, ext={"guided_regex": "[0-9]+"},
+    ))
+    assert g.kind == "regex" and g.regex == "[0-9]+"
+    with pytest.raises(ValueError):
+        guided_options(ChatCompletionRequest(
+            **base, response_format={"type": "json_schema"},
+        ))
+    with pytest.raises(ValueError):
+        guided_options(ChatCompletionRequest(
+            **base, response_format={"type": "grammar"},
+        ))
+
+
+def test_preprocessor_wires_guided_and_migration_refuses_it(tok):
+    from dynamo_tpu.preprocessor.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.protocols.openai import CompletionRequest
+    from dynamo_tpu.runtime.migration import resumable
+
+    pre = OpenAIPreprocessor(tok, formatter=None, model_name="tiny")
+    req = pre.preprocess_completion(CompletionRequest(
+        model="tiny", prompt="ab",
+        response_format={"type": "json_object"},
+    ))
+    assert req.guided is not None and req.guided.kind == "json_object"
+    # guided requests are not migratable (docs/guided_decoding.md)
+    assert resumable(req) is False
+    plain = pre.preprocess_completion(
+        CompletionRequest(model="tiny", prompt="ab")
+    )
+    assert plain.guided is None and resumable(plain) is True
+
+
+# ---------------------------------------------------------------------------
+# SSE tool-call delta stream e2e (preprocessor backward)
+# ---------------------------------------------------------------------------
+
+
+async def _collect_backward(pre, state, items):
+    async def stream():
+        for it in items:
+            yield it
+
+    from dynamo_tpu.runtime.engine import Context
+
+    return [c async for c in pre.backward(stream(), state, Context())]
+
+
+async def test_tool_call_delta_stream_e2e(tok):
+    """ISSUE 15 satellite: the streamed chunk sequence reassembles to
+    valid JSON arguments with finish_reason == "tool_calls" — both
+    forced mode and auto-detection."""
+    from dynamo_tpu.preprocessor.preprocessor import (
+        OpenAIPreprocessor,
+        _ReqState,
+    )
+    from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput
+
+    pre = OpenAIPreprocessor(tok, formatter=None, model_name="tiny")
+
+    def mk_state(mode, name=None):
+        return _ReqState(
+            kind="chat", model="tiny", request_id="r", prompt_tokens=3,
+            include_usage=True, logprobs=False, tool_mode=mode,
+            tool_name=name,
+        )
+
+    def items(texts, reason=FinishReason.STOP):
+        out = [
+            LLMEngineOutput(request_id="r", token_ids=[1], text=t)
+            for t in texts
+        ]
+        out.append(LLMEngineOutput(
+            request_id="r", finish_reason=reason,
+            prompt_tokens=3, completion_tokens=len(texts),
+        ))
+        return out
+
+    # forced: every delta is an arguments fragment
+    chunks = await _collect_backward(
+        pre, mk_state("forced", "get_weather"),
+        items(['{"city": ', '"Paris"', "}"]),
+    )
+    tool_deltas = [
+        tc
+        for c in chunks
+        for ch in c.choices
+        if ch.delta.tool_calls
+        for tc in ch.delta.tool_calls
+    ]
+    header = tool_deltas[0]
+    assert header["function"]["name"] == "get_weather"
+    assert header["id"].startswith("call_") and header["type"] == "function"
+    args = "".join(
+        tc["function"].get("arguments", "") for tc in tool_deltas
+    )
+    assert json.loads(args) == {"city": "Paris"}
+    finishes = [
+        ch.finish_reason
+        for c in chunks
+        for ch in c.choices
+        if ch.finish_reason
+    ]
+    assert finishes == ["tool_calls"]
+    usage = [c.usage for c in chunks if c.usage is not None]
+    assert usage and usage[0].completion_tokens == 3
+
+    # auto-detection on the inline-JSON call shape
+    chunks = await _collect_backward(
+        pre, mk_state("auto"),
+        items(['{"name": "f", "argu', 'ments": {"x": 1}}']),
+    )
+    tool_deltas = [
+        tc
+        for c in chunks
+        for ch in c.choices
+        if ch.delta.tool_calls
+        for tc in ch.delta.tool_calls
+    ]
+    assert tool_deltas[0]["function"]["name"] == "f"
+    args = "".join(
+        tc["function"].get("arguments", "") for tc in tool_deltas
+    )
+    assert json.loads(args) == {"x": 1}
+    assert [
+        ch.finish_reason for c in chunks for ch in c.choices
+        if ch.finish_reason
+    ] == ["tool_calls"]
+
+    # auto mode, plain text: content deltas untouched, normal finish
+    chunks = await _collect_backward(
+        pre, mk_state("auto"), items(["Hello ", "world"]),
+    )
+    text = "".join(
+        ch.delta.content or "" for c in chunks for ch in c.choices
+    )
+    assert text == "Hello world"
+    assert [
+        ch.finish_reason for c in chunks for ch in c.choices
+        if ch.finish_reason
+    ] == ["stop"]
+
+    # a call truncated by max_tokens mid-arguments keeps "length"
+    # (OpenAI semantics) — clients must not json.loads the fragment
+    chunks = await _collect_backward(
+        pre, mk_state("forced", "g"),
+        items(['{"a": tr'], reason=FinishReason.LENGTH),
+    )
+    assert [
+        ch.finish_reason for c in chunks for ch in c.choices
+        if ch.finish_reason
+    ] == ["length"]
+    # ... and an eos mid-arguments (auto mode: nothing forces the model
+    # to close the object) keeps "stop", never "tool_calls"
+    chunks = await _collect_backward(
+        pre, mk_state("auto"),
+        items(['{"name": "g", "arguments": {"a": 1'],
+              reason=FinishReason.STOP),
+    )
+    assert [
+        ch.finish_reason for c in chunks for ch in c.choices
+        if ch.finish_reason
+    ] == ["stop"]
+
+    # non-streaming aggregation folds the deltas into message.tool_calls
+    from dynamo_tpu.protocols.aggregators import ChatAggregator
+
+    chunks = await _collect_backward(
+        pre, mk_state("forced", "g"), items(['{"a": true}']),
+    )
+    resp = ChatAggregator.aggregate(chunks)
+    msg = resp.choices[0].message
+    assert msg.content is None
+    assert msg.tool_calls[0]["function"]["name"] == "g"
+    assert json.loads(msg.tool_calls[0]["function"]["arguments"]) == {
+        "a": True,
+    }
+    assert resp.choices[0].finish_reason == "tool_calls"
+
+
+# ---------------------------------------------------------------------------
+# engine e2e: greedy + seeded-sampled guided completions parse; guided
+# spec decode is bit-identical to serial guided decode
+# ---------------------------------------------------------------------------
+
+
+def _engine_config(**kw):
+    from dynamo_tpu.engine.config import EngineConfig
+
+    defaults = dict(
+        model_path=MODEL_DIR,
+        model_name="tiny",
+        random_weights=True,
+        num_blocks=128,
+        block_size=8,
+        max_batch_size=8,
+        prefill_chunk_size=32,
+        max_model_len=512,
+    )
+    defaults.update(kw)
+    return EngineConfig(**defaults)
+
+
+async def _generate(engine, rid, guided=None, temperature=None,
+                    max_tokens=150, speculative=None, prompt=(1, 2, 3, 4, 5)):
+    from dynamo_tpu.protocols.common import (
+        GuidedOptions,
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    sampling = (
+        SamplingOptions(use_greedy=True)
+        if temperature is None
+        else SamplingOptions(temperature=temperature, seed=11)
+    )
+    req = PreprocessedRequest(
+        request_id=rid,
+        token_ids=list(prompt),
+        sampling=sampling,
+        stop=StopConditions(max_tokens=max_tokens),
+        guided=GuidedOptions(**guided) if guided else None,
+        speculative=speculative,
+    )
+    toks, fin = [], None
+    async for item in engine.as_async_engine().generate(req, Context()):
+        toks.extend(item.token_ids)
+        if item.is_final:
+            fin = item.finish_reason
+    return toks, fin
+
+
+async def test_engine_guided_greedy_and_sampled_parse(tok):
+    """ISSUE 15 acceptance: a JSON-schema request returns output that
+    parses and validates against the schema under greedy AND seeded
+    sampling; a regex request fullmatches; seeded sampling is
+    deterministic; /metrics carries the guided series."""
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.telemetry import REGISTRY
+
+    engine = await JaxEngine.launch(_engine_config())
+    g = {"kind": "json_schema", "json_schema": SCHEMA}
+    try:
+        toks, _ = await _generate(engine, "greedy", guided=g)
+        doc = json.loads(tok.decode(toks, skip_special_tokens=True))
+        assert isinstance(doc["name"], str) and len(doc["name"]) <= 4
+        assert isinstance(doc["ok"], bool)
+        assert doc["mood"] in ("happy", "sad")
+        s1, _ = await _generate(engine, "samp", guided=g, temperature=0.9)
+        d2 = json.loads(tok.decode(s1, skip_special_tokens=True))
+        assert d2["mood"] in ("happy", "sad") and isinstance(d2["ok"], bool)
+        s2, _ = await _generate(engine, "samp", guided=g, temperature=0.9)
+        assert s1 == s2  # same request id + seed => same stream
+        rx = r"(yes|no), [0-9]{3}"
+        toks, _ = await _generate(
+            engine, "rx", guided={"kind": "regex", "regex": rx},
+        )
+        assert re.fullmatch(rx, tok.decode(toks, skip_special_tokens=True))
+        # unguided traffic on the same engine is unaffected
+        toks, fin = await _generate(engine, "plain", max_tokens=6)
+        assert len(toks) == 6
+    finally:
+        await engine.shutdown()
+    text = REGISTRY.render()
+    assert 'dynamo_guided_requests_total{kind="json_schema"}' in text
+    assert 'dynamo_guided_requests_total{kind="regex"}' in text
+
+
+async def test_engine_guided_spec_bit_identical(tok):
+    """ISSUE 15 acceptance: guided spec decode is bit-identical to
+    serial guided decode (the per-request spec opt-out IS the literal
+    serial masked path), with drafts genuinely proposed through the
+    automaton filter; seeded-sampled guided spec is deterministic and
+    schema-valid."""
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    engine = await JaxEngine.launch(
+        _engine_config(spec_decode="ngram", spec_tokens=4)
+    )
+    g = {"kind": "json_schema", "json_schema": SCHEMA}
+    prompt = (1, 2, 3, 4, 5, 6, 1, 2, 3, 4, 5, 6, 1, 2, 3)
+    try:
+        spec_toks, _ = await _generate(
+            engine, "spec", guided=g, prompt=prompt, max_tokens=120,
+        )
+        base_toks, _ = await _generate(
+            engine, "base", guided=g, prompt=prompt, max_tokens=120,
+            speculative=False,
+        )
+        assert spec_toks == base_toks
+        assert engine.spec_proposed_total > 0  # drafting really happened
+        json.loads(tok.decode(spec_toks, skip_special_tokens=True))
+        s1, _ = await _generate(
+            engine, "samp", guided=g, prompt=prompt, temperature=0.9,
+            max_tokens=120,
+        )
+        s2, _ = await _generate(
+            engine, "samp", guided=g, prompt=prompt, temperature=0.9,
+            max_tokens=120,
+        )
+        assert s1 == s2
+        json.loads(tok.decode(s1, skip_special_tokens=True))
+    finally:
+        await engine.shutdown()
+
+
+async def test_engine_rejects_guided_on_fused_windows():
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    engine = await JaxEngine.launch(_engine_config(decode_steps=4))
+    try:
+        with pytest.raises(ValueError, match="decode_steps"):
+            await _generate(
+                engine, "bad", guided={"kind": "json_object"}, max_tokens=4,
+            )
+    finally:
+        await engine.shutdown()
+
+
+async def test_http_guided_sse_e2e(tok):
+    """Full-stack HTTP e2e: (a) a streaming request with an
+    uncompilable schema is a 400, not a 200 SSE stream (the primed
+    first chunk surfaces admission failures before headers commit);
+    (b) a valid json_schema SSE stream reassembles to schema-valid
+    JSON; (c) a forced tool call streams tool_calls deltas whose
+    arguments reassemble and finish with "tool_calls"."""
+    import aiohttp
+
+    from dynamo_tpu.backend import Backend
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.http.service import HttpService, ModelManager
+    from dynamo_tpu.preprocessor import OpenAIPreprocessor, PromptFormatter
+    from dynamo_tpu.preprocessor.fanout import ChoiceFanout
+    from dynamo_tpu.protocols.sse import SseDecoder
+    from dynamo_tpu.runtime.pipeline import build_pipeline
+
+    engine = await JaxEngine.launch(_engine_config())
+    formatter = PromptFormatter.from_model_dir(MODEL_DIR)
+    pre = OpenAIPreprocessor(tok, formatter, model_name="tiny")
+    pipeline = build_pipeline(
+        pre,
+        ChoiceFanout(build_pipeline(
+            Backend(tok, eos_token_ids=engine.eos_token_ids),
+            engine.as_async_engine(),
+        )),
+    )
+    manager = ModelManager()
+    manager.add_chat_model("tiny", pipeline)
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    base = f"http://127.0.0.1:{service.port}"
+
+    async def sse_events(r):
+        dec = SseDecoder()
+        out = []
+        async for chunk, _ in r.content.iter_chunks():
+            for msg in dec.feed(chunk.decode()):
+                if msg.data and msg.data != "[DONE]":
+                    out.append(json.loads(msg.data))
+        return out
+
+    try:
+        async with aiohttp.ClientSession() as s:
+            # (a) uncompilable schema (allOf) under stream=true -> 400
+            async with s.post(f"{base}/v1/chat/completions", json={
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "x"}],
+                "stream": True, "max_tokens": 8,
+                "response_format": {"type": "json_schema", "json_schema": {
+                    "name": "bad",
+                    "schema": {"allOf": [{"type": "string"}]},
+                }},
+            }) as r:
+                assert r.status == 400
+                body = await r.json()
+                assert body["error"]["type"] == "invalid_request_error"
+            # (b) valid schema SSE stream -> schema-valid JSON
+            async with s.post(f"{base}/v1/chat/completions", json={
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "person"}],
+                "stream": True, "max_tokens": 150,
+                "response_format": {"type": "json_schema", "json_schema": {
+                    "name": "person", "schema": SCHEMA,
+                }},
+            }) as r:
+                assert r.status == 200
+                events = await sse_events(r)
+            text = "".join(
+                ch["delta"].get("content") or ""
+                for e in events for ch in e.get("choices", [])
+            )
+            doc = json.loads(text)
+            assert doc["mood"] in ("happy", "sad")
+            assert isinstance(doc["ok"], bool)
+            # (c) forced tool call over SSE
+            async with s.post(f"{base}/v1/chat/completions", json={
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "weather"}],
+                "stream": True, "max_tokens": 150,
+                "tools": [{"type": "function", "function": {
+                    "name": "get_weather",
+                    "parameters": {
+                        "type": "object",
+                        "properties": {
+                            "city": {"type": "string", "maxLength": 5},
+                            "units": {"enum": ["c", "f"]},
+                        },
+                        "required": ["city", "units"],
+                    },
+                }}],
+                "tool_choice": {
+                    "type": "function", "function": {"name": "get_weather"},
+                },
+            }) as r:
+                assert r.status == 200
+                events = await sse_events(r)
+            name = None
+            args = ""
+            finishes = []
+            for e in events:
+                for ch in e.get("choices", []):
+                    if ch.get("finish_reason"):
+                        finishes.append(ch["finish_reason"])
+                    for tc in (ch["delta"].get("tool_calls") or []):
+                        fn = tc.get("function") or {}
+                        if fn.get("name"):
+                            name = fn["name"]
+                        args += fn.get("arguments", "")
+            assert name == "get_weather" and finishes == ["tool_calls"]
+            doc = json.loads(args)
+            assert doc["units"] in ("c", "f") and len(doc["city"]) <= 5
+    finally:
+        await service.stop()
+        await engine.shutdown()
+
+
+@pytest.fixture
+def fence():
+    from dynamo_tpu.utils import compile_fence
+
+    compile_fence.set_mode("fatal")
+    compile_fence.reset()
+    yield compile_fence
+    compile_fence.set_mode(None)
+    compile_fence.reset()
+
+
+async def test_guided_prewarm_is_compile_fence_clean(tmp_path, fence):
+    """ISSUE 15 acceptance: a prewarmed guided run produces ZERO
+    serve_compile records under the FATAL fence — the masked prefill
+    and decode variants _prewarm_guided compiles are exactly the
+    signatures guided serving reaches."""
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    engine = await JaxEngine.launch(_engine_config(
+        prewarm=True, prewarm_guided=True, overlap=False,
+        flight_dump_dir=str(tmp_path),
+    ))
+    try:
+        assert fence.stats()["events_total"] == 0  # prewarm sanctioned
+        toks, _ = await _generate(
+            engine, "g", guided={"kind": "json_schema", "json_schema": SCHEMA},
+            max_tokens=100,
+        )
+        assert toks
+        recs = [
+            r for r in engine.recorder.snapshot(256)
+            if r["kind"] == "serve_compile"
+        ]
+        assert recs == [], recs
+        assert glob.glob(str(tmp_path / "dynamo_blackbox_*")) == []
+    finally:
+        await engine.shutdown()

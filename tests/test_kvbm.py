@@ -365,6 +365,61 @@ def test_g4_shared_across_workers():
     assert b.host.contains(51)
 
 
+def test_g4_refresh_throttle_is_clock_driven():
+    """ISSUE 15 satellite: the G4 refresh throttle reads time through
+    the injectable Clock seam (DL009 vocabulary), so a virtual clock
+    drives the refresh deterministically — no sleeps, no monkeypatching
+    time.monotonic."""
+    from dynamo_tpu.kvbm.remote import DictObjectStore
+
+    class TickClock:
+        def __init__(self):
+            self.now = 100.0
+
+        def monotonic(self):
+            return self.now
+
+        def time(self):
+            return self.now
+
+        async def sleep(self, seconds):
+            self.now += seconds
+
+    objects = DictObjectStore()
+    dev_a = FakeDevice(8)
+    a = _manager_g4(dev_a, objects, host_blocks=1)
+    clock = TickClock()
+    dev_b = FakeDevice(8)
+    b = KvBlockManager(
+        KvbmConfig(host_num_blocks=2, offload_batch=16, remote_bucket="kvg4"),
+        LAYOUT,
+        gather_fn=dev_b.gather,
+        scatter_fn=dev_b.scatter,
+        resolve_fn=dev_b.resolve,
+        remote_objects=objects,
+        clock=clock,
+    )
+    # the construction-time refresh saw an empty bucket; worker A
+    # demotes AFTERWARDS
+    for i, h in enumerate([71, 72]):
+        dev_a.blocks[i + 1] = _block(h)
+        dev_a.hash_index[h] = i + 1
+        a.on_block_committed(h, i + 1)
+        a.pump()
+    assert a.remote.contains(71)
+    b._last_remote_refresh = clock.monotonic()
+    b.pump()  # inside the throttle window: no refresh
+    assert b.match_offloaded([71]) == 0
+    clock.now += b.REMOTE_REFRESH_S - 0.001
+    b.pump()  # still 1 ms short of the window
+    assert b.match_offloaded([71]) == 0
+    clock.now += 0.001
+    b.pump()  # window elapsed ON THE INJECTED CLOCK: refresh fires
+    assert b.match_offloaded([71]) == 1
+    # default construction still runs on the system clock
+    assert _manager_g4(FakeDevice(4), DictObjectStore()).clock is not None
+
+
 def test_g4_missing_remote_truncates_onboard():
     """A block that vanished from the remote bucket (GC, eviction) must
     truncate the onboarded prefix, not corrupt it."""

@@ -72,6 +72,11 @@ _REQUIRED_SERIES = [
     "dynamo_midstream_resume_seconds",
     "dynamo_midstream_aborts_total",
     "dynamo_failover_retries_total",
+    # ISSUE 15: guided decoding / tool calls (docs/guided_decoding.md)
+    "dynamo_guided_compile_seconds",
+    "dynamo_guided_cache_events_total",
+    "dynamo_guided_requests_total",
+    "dynamo_tool_call_streams_total",
 ]
 
 
@@ -143,6 +148,19 @@ def test_observability_series_are_registered():
         "result",
     )
     assert REGISTRY.get("dynamo_midstream_resume_seconds").label_names == ()
+    # guided decoding keys on the bounded spec-kind / result / mode sets
+    assert REGISTRY.get("dynamo_guided_compile_seconds").label_names == (
+        "kind",
+    )
+    assert REGISTRY.get(
+        "dynamo_guided_cache_events_total"
+    ).label_names == ("result",)
+    assert REGISTRY.get("dynamo_guided_requests_total").label_names == (
+        "kind",
+    )
+    assert REGISTRY.get("dynamo_tool_call_streams_total").label_names == (
+        "mode",
+    )
 
 
 def test_metric_catalog_docs_match_registry():
