@@ -33,6 +33,7 @@ from dynamo_tpu.analysis.findings import (  # noqa: F401
     apply_baseline,
     format_github,
     format_json,
+    format_sarif,
     format_text,
     gating,
     stale_baseline_entries,

@@ -24,6 +24,7 @@ from dynamo_tpu.analysis.findings import (
     apply_baseline,
     format_github,
     format_json,
+    format_sarif,
     format_text,
     gating,
     stale_baseline_entries,
@@ -50,7 +51,7 @@ def add_lint_parser(sub: Any) -> None:
                       help="files/dirs to lint (default: [tool.dynalint] "
                            "include, i.e. dynamo_tpu/)")
     lint.add_argument("--format", dest="fmt", default="text",
-                      choices=["text", "json", "github"])
+                      choices=["text", "json", "github", "sarif"])
     lint.add_argument("--rules", default=None,
                       help="comma-separated rule names to run "
                            "(default: all minus config `disable`)")
@@ -68,7 +69,8 @@ def add_lint_parser(sub: Any) -> None:
                       help="bypass the on-disk result cache "
                            "(.dynalint_cache/)")
     lint.add_argument("--stats", action="store_true",
-                      help="print cache + call-graph statistics to stderr")
+                      help="print cache + call-graph + shard-inventory "
+                           "statistics to stderr")
     lint.add_argument("--baseline", default=None,
                       help="baseline file: listed findings warn instead "
                            "of gating (default: config `baseline` key)")
@@ -197,6 +199,13 @@ def cmd_lint(args: Any) -> int:
                 + ", ".join(f"{k}={v}" for k, v in graph_stats.items()),
                 file=sys.stderr,
             )
+        shard_stats = stats.get("shardsem")
+        if isinstance(shard_stats, dict):
+            print(
+                "dynalint: shard inventory: "
+                + ", ".join(f"{k}={v}" for k, v in shard_stats.items()),
+                file=sys.stderr,
+            )
 
     pyproject = (
         Path(args.pyproject)
@@ -263,6 +272,8 @@ def cmd_lint(args: Any) -> int:
         print(format_json(findings))
     elif args.fmt == "github":
         print(format_github(findings))
+    elif args.fmt == "sarif":
+        print(format_sarif(findings))
     else:
         print(format_text(findings, show_suppressed=args.show_suppressed))
     return 1 if gating(findings) else 0

@@ -103,6 +103,83 @@ def format_json(findings: list[Finding]) -> str:
     return json.dumps(payload, indent=2)
 
 
+def format_sarif(findings: list[Finding]) -> str:
+    """SARIF 2.1.0 report — the interchange format GitHub code scanning
+    ingests, so dynalint findings land in the repo's Security tab with
+    the same rule metadata the other emitters carry.  Gating findings
+    map to level "error", baselined ones to "warning"; suppressed
+    findings are emitted with a SARIF ``suppressions`` entry (status
+    "accepted") so the waiver stays visible rather than vanishing.
+    Rule metadata comes from both registries lazily — findings.py stays
+    import-light for every other consumer."""
+    from dynamo_tpu.analysis.program import all_program_rules
+    from dynamo_tpu.analysis.registry import all_rules
+
+    catalog = {}
+    for r in (*all_rules(), *all_program_rules()):
+        catalog[r.name] = {
+            "id": r.code,
+            "name": r.name,
+            "shortDescription": {"text": r.summary},
+        }
+    # findings can reference rules absent from the registries (old
+    # cache entries, tests): synthesize a minimal descriptor for those
+    for f in findings:
+        catalog.setdefault(f.rule, {
+            "id": f.code,
+            "name": f.rule,
+            "shortDescription": {"text": f.rule},
+        })
+    rules = sorted(catalog.values(), key=lambda r: (r["id"], r["name"]))
+    index = {r["name"]: i for i, r in enumerate(rules)}
+
+    results = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code)):
+        result = {
+            "ruleId": f.code,
+            "ruleIndex": index[f.rule],
+            "level": "warning" if f.baselined else "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace("\\", "/"),
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {
+                        "startLine": f.line,
+                        "startColumn": f.col + 1,
+                    },
+                },
+            }],
+        }
+        if f.suppressed:
+            result["suppressions"] = [{
+                "kind": "inSource",
+                "status": "accepted",
+            }]
+        results.append(result)
+
+    payload = {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "dynalint",
+                    "informationUri":
+                        "https://github.com/dynamo-tpu/dynamo-tpu"
+                        "/blob/main/docs/static_analysis.md",
+                    "rules": rules,
+                },
+            },
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }
+    return json.dumps(payload, indent=2)
+
+
 # -- baseline files -------------------------------------------------------
 # A baseline grandfathers existing findings so a newly-tightened rule can
 # gate NEW violations immediately while the backlog burns down: listed

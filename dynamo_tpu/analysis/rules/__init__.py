@@ -15,13 +15,17 @@ from dynamo_tpu.analysis.rules import (  # noqa: F401
     await_locked,
     bare_except,
     blocking_async,
+    collective_axis,
     cross_thread,
+    donation_mesh,
     dropped_task,
     dynamic_static,
     hidden_sync,
     host_sync_jit,
     prewarm_coverage,
     retry_loop,
+    shard_sync,
+    spec_arity,
     swallowed_cancel,
     transitive_blocking,
     transitive_sync,

@@ -1,0 +1,99 @@
+"""DL302 collective-axis-mismatch: a collective whose literal
+``axis_name`` is not among the enclosing shard site's declared axes.
+
+``psum(x, "dp")`` inside a body mapped with ``axis_names={"tp"}`` is
+not a Python error and not even a trace error on a single-axis dev
+mesh — it surfaces as a ``NameError``-at-trace on the real pod mesh,
+or worse, silently reduces over the wrong axis when both names exist.
+The shard-site inventory (``analysis/shardsem.py``) records each
+site's declared manual axes (a literal ``axis_names=`` set, the
+``auto=`` complement, or all mesh axes for the fully-manual form), and
+this rule checks every collective in the wrapped body, its nested
+closures, and helpers **one call level down** (the DL2xx one-level
+summary discipline) against them.
+
+The jaxsem degradation rules apply: a variable axis name (ring
+attention's ``axis_name`` parameter), an opaque mesh, or a dynamic
+``axis_names=`` value means the site's axis set is unknown — the
+collective is skipped and the miss is counted in ``--stats``, never
+guessed at.  A function reached from several shard sites is judged
+against the union of their declared axes (flagging only what no
+enclosing site declares).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.analysis import shardsem
+from dynamo_tpu.analysis.program import LintProgram, program_rule
+from dynamo_tpu.analysis.rules.common import walk_in_scope
+from dynamo_tpu.analysis.taint import format_chain
+
+
+@program_rule(
+    "collective-axis-mismatch",
+    "DL302",
+    "collective axis_name literal not among the enclosing shard_map "
+    "site's declared axes (trace error on the real mesh, or a reduce "
+    "over the wrong axis)",
+)
+def check(program: LintProgram):
+    graph = program.graph
+    reach = shardsem.body_reach(program)
+    for qn in sorted(reach):
+        fn = graph.functions.get(qn)
+        if fn is None:
+            continue
+        # one-level scope: the body's closure tree plus direct callees
+        candidates = []
+        for site, chain in reach[qn]:
+            root = chain[0]
+            outside = [
+                q for q in chain
+                if not shardsem.in_closure_tree(root, q)
+            ]
+            if len(outside) <= 1:
+                candidates.append((site, chain))
+        if not candidates:
+            continue
+        declared = frozenset()
+        unknown = False
+        for site, _ in candidates:
+            axes = site.declared_axes()
+            if axes is None:
+                unknown = True
+                break
+            declared |= axes
+        if unknown:
+            continue  # counted in the inventory's dynamic misses
+        imports = graph.imports.get(fn.module, {})
+        for node in walk_in_scope(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = shardsem.collective_axis_arg(imports, node)
+            if hit is None:
+                continue
+            cname, axis_expr = hit
+            used = shardsem.parse_axis_set(axis_expr)
+            if used is None:
+                continue  # dynamic axis expression: degrade, don't guess
+            missing = sorted(used - declared)
+            if not missing:
+                continue
+            site, chain = candidates[0]
+            where = (
+                f"one call level down: `{site.label}` -> "
+                f"`{fn.name}`"
+                if not shardsem.in_closure_tree(chain[0], qn)
+                else f"in the body of `{site.label}`"
+            )
+            yield (
+                fn.path,
+                node,
+                f"`{cname}` names axis {missing} but the enclosing "
+                f"shard_map site ({site.path}:{site.lineno}) declares "
+                f"axes {sorted(declared) or '{}'} ({where}; chain: "
+                f"{format_chain(chain)}); declare the axis in "
+                "axis_names= or fix the collective's axis_name",
+            )

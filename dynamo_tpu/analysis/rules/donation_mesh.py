@@ -1,0 +1,138 @@
+"""DL303 donation-across-mesh: a donated buffer whose sharding cannot
+be reused in place.
+
+Donation (DL201's subject) is a layout contract as much as a lifetime
+one: XLA reuses the donated buffer only when the parameter's sharding
+matches.  Two ways the mesh breaks it silently:
+
+- **spec drift**: the caller constrains a buffer to one
+  ``PartitionSpec`` and donates it to a jit/pjit site whose declared
+  ``in_shardings`` for that slot says another — XLA inserts a
+  resharding copy first, the "donated" buffer is copied anyway, and
+  the HBM headroom the donation was supposed to buy never appears
+  (it shows up later as an OOM at twice the KV-cache size);
+- **donation inside a shard_map body**: the body is traced per shard,
+  so the donated value is one shard's *view* — freeing it from inside
+  the mapped region invalidates storage the other shards (and the
+  caller's rebind idiom) still alias.
+
+Both endpoints come from the shard-site inventory
+(``analysis/shardsem.py``): per-function
+``x = with_sharding_constraint(x, P(...))`` bindings on one side,
+jit/pjit sites combining ``donate_argnums`` with literal
+``in_shardings`` on the other; the body-reachability map supplies the
+shard_map case, with the jit sites themselves resolved through the
+jaxsem inventory.  Dynamic specs degrade to counted misses — a
+comparison only happens between two literal specs.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.analysis import jaxsem, shardsem
+from dynamo_tpu.analysis.astutil import dotted_name, walk_in_scope
+from dynamo_tpu.analysis.callgraph import resolve_name
+from dynamo_tpu.analysis.program import LintProgram, program_rule
+from dynamo_tpu.analysis.taint import format_chain
+
+
+def _fmt(spec) -> str:
+    return "P(" + ", ".join(repr(e) for e in spec) + ")"
+
+
+@program_rule(
+    "donation-across-mesh",
+    "DL303",
+    "buffer donated under a mismatched sharding (resharding copy "
+    "defeats the donation) or donated inside a shard_map body",
+)
+def check(program: LintProgram):
+    graph = program.graph
+    inv = shardsem.inventory_of(program)
+    jinv = jaxsem.inventory_of(program)
+
+    # (a) donation from inside a shard_map body: the jit site invoked
+    # in a mapped frame donates a per-shard view
+    reach = shardsem.body_reach(program)
+    for qn in sorted(reach):
+        fn = graph.functions.get(qn)
+        if fn is None:
+            continue
+        site, chain = reach[qn][0]
+        for node in walk_in_scope(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            jsite = jaxsem.resolve_call_site(jinv, graph, fn, node)
+            if jsite is None or not jsite.donate:
+                continue
+            yield (
+                fn.path,
+                node,
+                f"`{jsite.label}` donates argument(s) "
+                f"{list(jsite.donate)} inside the shard_map body "
+                f"`{site.label}` (site {site.path}:{site.lineno}, "
+                f"chain: {format_chain(chain)}) — the donated value is "
+                "one shard's view and the other shards still alias its "
+                "storage; donate at the unmapped call boundary instead",
+            )
+
+    # (b) donated argument constrained to a spec that differs from the
+    # jit/pjit site's declared in_shardings for that slot
+    for qn, fn in sorted(graph.functions.items()):
+        constrained = inv.constraints.get(qn)
+        if not constrained:
+            continue
+        for node in walk_in_scope(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            jsite = _sharded_jit_site(inv, graph, fn, node)
+            if jsite is None or not jsite.donate:
+                continue
+            if jsite.in_shardings is None:
+                continue  # dynamic shardings: counted, not compared
+            for i in jsite.donate:
+                if i >= len(node.args) or i >= len(jsite.in_shardings):
+                    continue
+                arg = node.args[i]
+                if not isinstance(arg, ast.Name):
+                    continue
+                got = constrained.get(arg.id)
+                want = jsite.in_shardings[i]
+                if got is None or want == shardsem.DYNAMIC:
+                    continue
+                if shardsem.DYNAMIC in got or shardsem.DYNAMIC in want:
+                    continue
+                if got != want:
+                    yield (
+                        fn.path,
+                        node,
+                        f"`{arg.id}` is constrained to {_fmt(got)} but "
+                        f"donated into slot {i} of `{jsite.label}` "
+                        f"({jsite.path}:{jsite.lineno}) declared as "
+                        f"{_fmt(want)} — XLA reshards into a fresh "
+                        "buffer first, so the donation frees nothing; "
+                        "align the constraint with the site's "
+                        "in_shardings (or drop the donate)",
+                    )
+
+
+def _sharded_jit_site(inv, graph, fn, call):
+    """The donate+in_shardings site an ast.Call invokes, through a
+    local binding (closure chain) or a module-level name."""
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    if "." not in name:
+        scope = fn.qualname
+        while True:
+            site = inv.jit_by_local.get((scope, name))
+            if site is not None:
+                return site
+            if ".<locals>." not in scope:
+                break
+            scope = scope.rsplit(".<locals>.", 1)[0]
+    resolved = resolve_name(graph, fn, name)
+    if resolved is not None:
+        return inv.jit_by_qualname.get(resolved)
+    return None

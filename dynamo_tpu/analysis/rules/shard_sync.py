@@ -1,0 +1,72 @@
+"""DL301 host-sync-in-shard-body: a device->host sync reachable from
+inside a shard_map-wrapped body.
+
+DL010/DL102 police the step loop because one hidden ``.item()`` stalls
+one device.  Inside a ``shard_map`` body the same call is worse by a
+mesh factor: the body is traced into every shard's program, so a host
+materialization executes *per shard* and the slowest host round-trip
+gates all of them — the collective that follows waits on the last
+device, and the whole mesh serializes (the multi-host variant of
+docs/performance.md's overlap collapse).  On pods it is usually also a
+trace error, but only at deploy scale, long after the PR merged.
+
+The rule reuses DL010's sync-op set (``rules/common.py``) and scans
+every function the shard-site inventory's **body reachability** map
+covers: the wrapped callable, its nested closures (the house style
+wraps a local ``def``), and everything they reach along same-context
+call edges — direct and transitive frames alike, with the call chain
+printed.  There is no harvest exemption here: a sanctioned sync point
+cannot exist inside a mapped region.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.analysis import shardsem
+from dynamo_tpu.analysis.program import LintProgram, program_rule
+from dynamo_tpu.analysis.rules.common import (
+    SYNC_ATTRS,
+    SYNC_CALLS,
+    dotted_name,
+    walk_in_scope,
+)
+from dynamo_tpu.analysis.taint import format_chain
+
+
+@program_rule(
+    "host-sync-in-shard-body",
+    "DL301",
+    "device sync reachable from inside a shard_map body (executes per "
+    "shard and serializes the whole mesh)",
+)
+def check(program: LintProgram):
+    graph = program.graph
+    reach = shardsem.body_reach(program)
+    for qn in sorted(reach):
+        fn = graph.functions.get(qn)
+        if fn is None:
+            continue
+        site, chain = reach[qn][0]
+        for node in walk_in_scope(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if name in SYNC_CALLS:
+                what = f"`{name}(...)`"
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in SYNC_ATTRS
+            ):
+                what = f"`.{node.func.attr}()`"
+            else:
+                continue
+            yield (
+                fn.path,
+                node,
+                f"{what} syncs device->host inside the shard_map body "
+                f"`{site.label}` (site {site.path}:{site.lineno}, "
+                f"chain: {format_chain(chain)}) — the body runs per "
+                "shard, so this serializes every device in the mesh; "
+                "hoist the materialization outside the mapped region",
+            )

@@ -1,0 +1,113 @@
+"""DL304 spec-arity-drift: literal in_specs/out_specs that disagree
+with the wrapped function's signature or the declared axis set.
+
+``shard_map``'s spec pytrees are positional: add a parameter to the
+wrapped body and forget the matching ``in_specs`` entry, and jax
+reports a pytree-structure error at trace time — on the dev box if
+you're lucky, on the pod if the extra argument only flows on the
+multi-host path.  Worse, an axis name that appears in a spec but not
+in the site's declared manual axes partitions over an axis the body
+was never mapped over.  Both are mechanical to check once the
+shard-site inventory has parsed the literals:
+
+- **arity**: an ``in_specs=`` literal tuple must have one entry per
+  positional parameter of the (resolved) wrapped function; an
+  ``out_specs=`` literal tuple must match the body's returned tuple
+  arity when every ``return`` is a literal tuple of one consistent
+  length;
+- **axis set**: every axis a spec names must be among the site's
+  declared axes (literal ``axis_names=``, the ``auto=`` complement,
+  or a statically-known mesh's full axis set).
+
+Everything else follows the jaxsem degradation rules: a dynamic spec,
+an unresolved wrapped callable, ``*args`` in the signature, or an
+opaque mesh means the check silently doesn't apply — the miss is
+counted in ``--stats`` (shard inventory ``dynamic_misses``), never
+turned into a guessed index.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from dynamo_tpu.analysis import shardsem
+from dynamo_tpu.analysis.jaxsem import _positional_params
+from dynamo_tpu.analysis.program import LintProgram, program_rule
+
+
+def _return_arity(fn_node: ast.AST) -> Optional[int]:
+    """The wrapped body's returned-tuple arity, when every ``return``
+    is a literal tuple of one consistent length; None otherwise."""
+    arity: Optional[int] = None
+    from dynamo_tpu.analysis.astutil import walk_in_scope
+
+    for node in walk_in_scope(fn_node):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        if not isinstance(node.value, ast.Tuple):
+            return None
+        if arity is None:
+            arity = len(node.value.elts)
+        elif arity != len(node.value.elts):
+            return None
+    return arity
+
+
+@program_rule(
+    "spec-arity-drift",
+    "DL304",
+    "shard_map in_specs/out_specs literal whose arity or axis set "
+    "disagrees with the wrapped function's signature or declared axes",
+)
+def check(program: LintProgram):
+    graph = program.graph
+    inv = shardsem.inventory_of(program)
+    for site in inv.sites:
+        if site.kind != "shard_map" or site.node is None:
+            continue
+        wrapped = (
+            graph.functions.get(site.wrapped) if site.wrapped else None
+        )
+
+        if wrapped is not None and site.in_specs is not None:
+            a = wrapped.node.args
+            if a.vararg is None and a.kwarg is None:
+                params = _positional_params(wrapped)
+                if len(site.in_specs) != len(params):
+                    yield (
+                        site.path,
+                        site.node,
+                        f"in_specs has {len(site.in_specs)} entries "
+                        f"but `shard_map` -> `{site.label}` takes "
+                        f"{len(params)} positional parameter(s) "
+                        f"({', '.join(params) or 'none'}) — jax "
+                        "raises a pytree-structure error at trace "
+                        "time; keep one spec per argument",
+                    )
+
+        if wrapped is not None and site.out_specs is not None:
+            ret = _return_arity(wrapped.node)
+            if ret is not None and ret != len(site.out_specs):
+                yield (
+                    site.path,
+                    site.node,
+                    f"out_specs has {len(site.out_specs)} entries but "
+                    f"`shard_map` -> `{site.label}` returns a "
+                    f"{ret}-tuple — trace-time pytree mismatch; keep "
+                    "one spec per output",
+                )
+
+        declared = site.declared_axes()
+        if declared is not None and site.spec_axes:
+            stray = sorted(site.spec_axes - declared)
+            if stray:
+                yield (
+                    site.path,
+                    site.node,
+                    f"specs of `shard_map` -> `{site.label}` name "
+                    f"axis {stray} outside the site's declared axes "
+                    f"{sorted(declared) or '{}'} — the body was never "
+                    "mapped over that axis; declare it in axis_names= "
+                    "or fix the spec",
+                )

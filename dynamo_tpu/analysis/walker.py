@@ -259,7 +259,10 @@ def _program_findings(
         return []
     program = build_program(modules, config)
     if stats_out is not None:
+        from dynamo_tpu.analysis import shardsem
+
         stats_out["callgraph"] = program.graph.stats()
+        stats_out["shardsem"] = shardsem.inventory_of(program).stats()
     known = known_rule_names()
     suppression_cache: dict[str, tuple] = {}
     findings: list[Finding] = []

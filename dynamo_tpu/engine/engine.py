@@ -50,7 +50,7 @@ from dynamo_tpu.engine.scheduler import (
     StepPlan,
 )
 from dynamo_tpu.models import ModelConfig
-from dynamo_tpu.utils import affinity, compile_fence
+from dynamo_tpu.utils import affinity, compile_fence, transfer_fence
 from dynamo_tpu.utils.bucketing import next_bucket
 from dynamo_tpu.models.llama import (
     CACHE_SPEC,
@@ -95,6 +95,7 @@ from dynamo_tpu.telemetry.instruments import (
     SPEC_DRAFT_HIDDEN_FRAC,
     SPEC_PROPOSED_TOKENS,
     SPEC_STEP_SECONDS,
+    TRANSFER_FENCE_EVENTS,
 )
 from dynamo_tpu.telemetry.overlap import OverlapTracker
 from dynamo_tpu.telemetry.recorder import FlightRecorder
@@ -380,10 +381,14 @@ class JaxEngine:
         _register_compile_listener()
         _initializing_engines += 1
         try:
-            # the prewarm window registers the fence's allowed phase:
-            # everything compiled in here is sanctioned AOT warming;
-            # anything after is a mid-serve compile the fence escalates
-            with compile_fence.allow():
+            # the prewarm window registers both fences' allowed phase:
+            # everything compiled (and every host<->device upload) in
+            # here is sanctioned AOT warming; anything after is a
+            # mid-serve compile/transfer the fences escalate. arm()
+            # flips JAX's transfer guard to "disallow" first so the
+            # serve phase inherits the armed guard.
+            transfer_fence.arm()
+            with compile_fence.allow(), transfer_fence.allow():
                 self._initialize_inner()
         finally:
             _initializing_engines -= 1
@@ -1915,6 +1920,32 @@ class JaxEngine:
         self._chain_next_fn = jax.jit(chain_next)
         self._pack_pair_fn = jax.jit(pack_pair)
 
+    def _stage_step_inputs(
+        self, arrays: dict[str, np.ndarray], sampling: SamplingBatch
+    ) -> tuple[dict, SamplingBatch]:
+        """Explicitly stage the host-built step inputs onto the device
+        before feeding the jitted step.  Under the armed transfer fence
+        (DYN_TRANSFER_FENCE, utils/transfer_fence.py) a raw np.ndarray
+        argument would trip the guard as an implicit host->device
+        upload; ``jax.device_put`` is the sanctioned spelling of the
+        same transfer.  Only ndarray leaves are staged — Python scalars
+        keep their weak types (a device_put would change avals and
+        recompile every step variant).  Inert when the fence is off:
+        the default hot path feeds numpy exactly as before.  The
+        fence tests monkeypatch this method to reintroduce the
+        implicit upload the fence exists to catch."""
+        if not transfer_fence.enabled():
+            return arrays, sampling
+        staged = {
+            k: jax.device_put(v) if isinstance(v, np.ndarray) else v
+            for k, v in arrays.items()
+        }
+        samp = SamplingBatch(arrays={
+            k: jax.device_put(v) if isinstance(v, np.ndarray) else v
+            for k, v in sampling.arrays.items()
+        })
+        return staged, samp
+
     def _dispatch_device_step(
         self,
         arrays: dict[str, np.ndarray],
@@ -1935,6 +1966,15 @@ class JaxEngine:
         registration — for callers that harvest THIS dispatch before
         doing anything else, its error surfaces under its own batch."""
         assert self._step_fn is not None
+        if self._mh_broadcast is not None:
+            if "extra_embeds" in arrays:
+                # embed rectangle broadcasts as its own control kind so
+                # followers enter the mm-variant step with real embeds
+                self._mh_broadcast.announce_step_mm(arrays, sampling)
+            else:
+                self._mh_broadcast.announce_step(arrays, sampling)
+        # stage AFTER the announce: followers deserialize host numpy
+        arrays, sampling = self._stage_step_inputs(arrays, sampling)
         base_args = (
             self.params,
             self.k_cache,
@@ -1947,13 +1987,6 @@ class JaxEngine:
             arrays["last_token_idx"],
             sampling.arrays,
         )
-        if self._mh_broadcast is not None:
-            if "extra_embeds" in arrays:
-                # embed rectangle broadcasts as its own control kind so
-                # followers enter the mm-variant step with real embeds
-                self._mh_broadcast.announce_step_mm(arrays, sampling)
-            else:
-                self._mh_broadcast.announce_step(arrays, sampling)
         idle_gap_s = self.overlap.note_dispatch()
         t_disp = time.monotonic()
         if "extra_embeds" in arrays:
@@ -2122,6 +2155,24 @@ class JaxEngine:
                 self._running = False  # dynalint: handoff=stop-flag — one-way bool, each side only ever writes False; readers poll per step/await
                 return
             except Exception as exc:
+                if transfer_fence.intercept(exc):
+                    # the transfer guard raised at the offending site:
+                    # the aborted step may never reach _record_step, so
+                    # escalate here. Under fatal mode the fence error
+                    # takes the engine down like a fatal multihost
+                    # failure — streams get a terminal error, not a
+                    # hang on a dead thread.
+                    try:
+                        self._check_transfer_fence("aborted")
+                    except transfer_fence.TransferFenceError:
+                        log.exception(
+                            "serve-phase implicit transfer under "
+                            "DYN_TRANSFER_FENCE=fatal; taking the "
+                            "engine down"
+                        )
+                        self._fail_all()
+                        self._running = False  # dynalint: handoff=stop-flag — one-way bool, each side only ever writes False; readers poll per step/await
+                        return
                 self._step_failures += 1
                 # queue depth is unknowable after an aborted dispatch
                 self.overlap.reset()
@@ -2424,6 +2475,7 @@ class JaxEngine:
         elif anomaly is not None:
             self.blackbox.trigger(anomaly)
         self._check_compile_fence(kind)
+        self._check_transfer_fence(kind)
 
     def _check_compile_fence(self, kind: str) -> None:
         """Escalate serve-phase compiles the fence collected since the
@@ -2463,6 +2515,42 @@ class JaxEngine:
                 f"serve-phase compile under DYN_COMPILE_FENCE=fatal: "
                 f"{n_events} event(s), first {summary['event']!r} "
                 f"during a {kind} step"
+            )
+
+    def _check_transfer_fence(self, kind: str) -> None:
+        """Escalate serve-phase implicit transfers the fence collected
+        (DYN_TRANSFER_FENCE, utils/transfer_fence.py), mirroring the
+        compile fence: ONE flight-recorder ``serve_transfer`` record
+        per drain, one black-box bundle (its own rate limit applies),
+        one counter bump, and a hard error under fatal mode.  Runs from
+        ``_record_step`` each step and directly from the step-loop
+        handler when the guard's RuntimeError aborts a dispatch (the
+        aborted step may never reach ``_record_step``)."""
+        if not transfer_fence.enabled():
+            return
+        events, n_events = transfer_fence.drain()
+        if not n_events:
+            return
+        TRANSFER_FENCE_EVENTS.inc(n_events)
+        summary = dict(
+            transfers=n_events,
+            error=events[0]["error"] if events else "<overflowed>",
+            step_kind=kind,
+        )
+        if self.recorder is not None:
+            self.recorder.record("serve_transfer", 0.0, **summary)
+        self.blackbox.trigger("serve_transfer")
+        log.warning(
+            "transfer fence: %d serve-phase implicit transfer(s) "
+            "during a %s step (first: %s) — a host<->device sync "
+            "outside the dispatch/harvest contract",
+            n_events, kind, summary["error"],
+        )
+        if transfer_fence.fatal():
+            raise transfer_fence.TransferFenceError(
+                f"serve-phase implicit transfer under "
+                f"DYN_TRANSFER_FENCE=fatal: {n_events} event(s), "
+                f"first {summary['error']!r} during a {kind} step"
             )
 
     def _one_step(self) -> None:
@@ -2916,6 +3004,7 @@ class JaxEngine:
         between the two, the host is free to emit the previous step and
         pre-draft the next one while the device verifies this one."""
         assert self._spec_step_fn is not None
+        arrays, sampling = self._stage_step_inputs(arrays, sampling)
         idle_gap_s = self.overlap.note_dispatch()
         t0 = time.monotonic()
         packed, self.k_cache, self.v_cache = self._spec_step_fn(
@@ -3610,6 +3699,8 @@ class JaxEngine:
         assert self._multi_step_fn is not None
         if self._mh_broadcast is not None:
             self._mh_broadcast.announce_multi_step(arrays, sampling)
+        # stage AFTER the announce: followers deserialize host numpy
+        arrays, sampling = self._stage_step_inputs(arrays, sampling)
         self.overlap.note_dispatch()
         packed, last_tok, self.k_cache, self.v_cache = self._multi_step_fn(
             self.params,
@@ -3707,6 +3798,12 @@ class JaxEngine:
             self._mh_broadcast.announce_mixed(
                 p_pad, sampling_p, d_arrays, sampling_d
             )
+        # stage AFTER the announce: followers deserialize host numpy.
+        # d_arrays' row count is read below, so keep the staged copy
+        # separate from the host dict the caller may still hold.
+        B_d = d_arrays["tokens"].shape[0]
+        p_pad, sampling_p = self._stage_step_inputs(p_pad, sampling_p)
+        d_staged, sampling_d = self._stage_step_inputs(d_arrays, sampling_d)
         self.overlap.note_dispatch()
         flat, last_tok, p_next, self.k_cache, self.v_cache = (
             self._mixed_step_fn(
@@ -3720,15 +3817,15 @@ class JaxEngine:
                 p_pad["context_lens"],
                 p_pad["last_token_idx"],
                 sampling_p.arrays,
-                d_arrays["tokens"] if tokens_dev is None else tokens_dev,
-                d_arrays["positions"],
-                d_arrays["block_tables"],
-                d_arrays["context_lens"],
-                d_arrays["valid_steps"],
+                d_staged["tokens"] if tokens_dev is None else tokens_dev,
+                d_staged["positions"],
+                d_staged["block_tables"],
+                d_staged["context_lens"],
+                d_staged["valid_steps"],
                 sampling_d.arrays,
             )
         )
-        return flat, last_tok, p_next, d_arrays["tokens"].shape[0], P
+        return flat, last_tok, p_next, B_d, P
 
     def _emit_mixed(
         self, works: list, seqs: list, flat_h, B: int,
@@ -4592,6 +4689,7 @@ class JaxEngine:
         # escalation count, so `top`//debug/state show whether a fenced
         # worker has compiled anything mid-serve
         out["compile_fence"] = compile_fence.stats()
+        out["transfer_fence"] = transfer_fence.stats()
         # perf attribution (telemetry/attribution.py): where the decode
         # window's wall time went, the live roofline fraction, and the
         # black-box capture state — what `top`'s ROOF%/LOSS columns read

@@ -1,8 +1,3 @@
-# dynalint: disable-file=transitive-host-sync-in-step-loop — the broadcast
-# plane serializes host-side plan metadata (token columns, slot maps:
-# python lists/host buffers) into wire frames inside the leader's dispatch
-# path BY DESIGN; `host_value` is this file's audited device sync.
-# Re-audit when the multi-chip tier is repaired (ROADMAP open item 1).
 """Multi-host serving: leader→follower step broadcast.
 
 The reference brings up multi-node engines with a leader that owns
@@ -32,6 +27,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import numpy as np
 
 # control vector layout (int32[16]):
@@ -118,9 +114,9 @@ class StepBroadcaster:
         self._bcast(
             _step_tuple(arrays, sampling)
             + (
-                np.asarray(arrays["extra_embeds"], np.float32),
+                np.asarray(arrays["extra_embeds"], np.float32),  # dynalint: disable=transitive-host-sync-in-step-loop — host-built embed rectangle (np.ndarray from the encode worker); dtype coercion touches host memory only
                 # bool over the wire as uint8: broadcast dtype safety
-                np.asarray(arrays["embeds_mask"], np.uint8),
+                np.asarray(arrays["embeds_mask"], np.uint8),  # dynalint: disable=transitive-host-sync-in-step-loop — host-built bool mask; uint8 coercion for the wire, no device handle here
             )
         )
 
@@ -134,7 +130,7 @@ class StepBroadcaster:
         host token values, they compute the identical chain from the
         identical device state."""
         self._ctrl(KIND_CHAIN, len(src_idx), int(prev_mixed))
-        self._bcast((np.asarray(src_idx, np.int32),))
+        self._bcast((np.asarray(src_idx, np.int32),))  # dynalint: disable=transitive-host-sync-in-step-loop — src_idx is the scheduler's host-built row-source column, never a device array
 
     def announce_multi_step(self, arrays: dict, sampling) -> None:
         b = arrays["tokens"].shape[0]
@@ -230,7 +226,7 @@ def _sampling_flags(s: dict) -> int:
 def _sampling_tuple(sampling) -> tuple:
     s = sampling.arrays
     return tuple(
-        np.asarray(s[k], dt) for k, dt in _sampling_keys(_sampling_flags(s))
+        np.asarray(s[k], dt) for k, dt in _sampling_keys(_sampling_flags(s))  # dynalint: disable=transitive-host-sync-in-step-loop — SamplingBatch.arrays is a host-numpy pytree by contract (engine/sampling.py); wire-dtype coercion only
     )
 
 
@@ -250,24 +246,24 @@ def _sampling_dict(args: tuple, flags: int) -> dict:
     }
 
 
+_STEP_TUPLE_KEYS = (
+    "tokens", "positions", "slot_mapping", "block_tables",
+    "context_lens", "last_token_idx",
+)
+_MULTI_STEP_TUPLE_KEYS = (
+    "tokens", "positions", "block_tables", "context_lens", "valid_steps",
+)
+
+
 def _step_tuple(arrays: dict, sampling) -> tuple:
-    return (
-        np.asarray(arrays["tokens"], np.int32),
-        np.asarray(arrays["positions"], np.int32),
-        np.asarray(arrays["slot_mapping"], np.int32),
-        np.asarray(arrays["block_tables"], np.int32),
-        np.asarray(arrays["context_lens"], np.int32),
-        np.asarray(arrays["last_token_idx"], np.int32),
+    return tuple(
+        np.asarray(arrays[k], np.int32) for k in _STEP_TUPLE_KEYS  # dynalint: disable=transitive-host-sync-in-step-loop — the planner builds these rectangles on host (scheduler plan()); staging to device happens AFTER the announce, so no device handle reaches this tuple
     ) + _sampling_tuple(sampling)
 
 
 def _multi_step_tuple(arrays: dict, sampling) -> tuple:
-    return (
-        np.asarray(arrays["tokens"], np.int32),
-        np.asarray(arrays["positions"], np.int32),
-        np.asarray(arrays["block_tables"], np.int32),
-        np.asarray(arrays["context_lens"], np.int32),
-        np.asarray(arrays["valid_steps"], np.int32),
+    return tuple(
+        np.asarray(arrays[k], np.int32) for k in _MULTI_STEP_TUPLE_KEYS  # dynalint: disable=transitive-host-sync-in-step-loop — host-built window plan arrays (see _step_tuple); int32 wire coercion only
     ) + _sampling_tuple(sampling)
 
 
@@ -355,7 +351,7 @@ def mirror_gather(k_cache, v_cache, block_ids: np.ndarray, block_size: int,
         packed = jax.device_put(
             packed, NamedSharding(mesh, _packed_spec())
         )
-        jax.block_until_ready(packed)
+        jax.block_until_ready(packed)  # dynalint: disable=transitive-host-sync-in-step-loop — mirrored-collective completion barrier: every process must finish the gather before reading shard rows; this IS the offload plane's audited sync point
     return local_packed_rows(packed)[:n]
 
 
@@ -460,7 +456,7 @@ def local_head_rows(packed_full: np.ndarray, cache) -> np.ndarray:
 def jnp_i32(arr: np.ndarray):
     import jax.numpy as jnp
 
-    return jnp.asarray(np.asarray(arr, np.int32))
+    return jnp.asarray(np.asarray(arr, np.int32))  # dynalint: disable=transitive-host-sync-in-step-loop — arr is a host id list/array being UPLOADED (h2d), not a device value syncing down
 
 
 def local_packed_rows(arr) -> np.ndarray:
@@ -471,7 +467,7 @@ def local_packed_rows(arr) -> np.ndarray:
     for shard in arr.addressable_shards:
         h0 = shard.index[4].start or 0
         if h0 not in seen:
-            seen[h0] = np.asarray(shard.data)
+            seen[h0] = np.asarray(shard.data)  # dynalint: disable=transitive-host-sync-in-step-loop — the offload plane's designated device->host read: gathered KV rows land on host here, once per shard, behind mirror_gather's barrier
     return np.concatenate([seen[h] for h in sorted(seen)], axis=4)
 
 
@@ -574,7 +570,7 @@ class ShardedKvOffload:
         self.broadcaster.announce_kv(KIND_KV_GATHER, ids, hashes)
         try:
             rows = mirror_gather(
-                e.k_cache, e.v_cache, np.asarray(ids, np.int32),
+                e.k_cache, e.v_cache, np.asarray(ids, np.int32),  # dynalint: disable=transitive-host-sync-in-step-loop — ids is a host python list; list->numpy, nothing device-resident
                 e.config.block_size, e.mesh,
             )
         except Exception as exc:  # followers are inside the collective
@@ -777,9 +773,14 @@ class StepFollower:
 
 def host_value(arr) -> np.ndarray:
     """Device array -> host numpy, robust to multi-host replication:
-    np.asarray refuses non-fully-addressable arrays, but every process
-    holds a complete copy of replicated outputs in its local shard."""
+    jax.device_get refuses non-fully-addressable arrays, but every
+    process holds a complete copy of replicated outputs in its local
+    shard.  ``device_get`` rather than ``np.asarray``: this is the
+    engine's designated harvest point, and the explicit spelling keeps
+    it sanctioned under the armed transfer fence
+    (utils/transfer_fence.py) — an implicit ``__array__`` sync here
+    would be indistinguishable from the strays the fence hunts."""
     try:
-        return np.asarray(arr)
+        return np.asarray(jax.device_get(arr))
     except Exception:
-        return np.asarray(arr.addressable_data(0))
+        return np.asarray(jax.device_get(arr.addressable_data(0)))

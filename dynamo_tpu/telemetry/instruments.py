@@ -80,6 +80,12 @@ COMPILE_FENCE_EVENTS = REGISTRY.counter(
     "(nonzero only under DYN_COMPILE_FENCE; each one is an unprewarmed "
     "jit signature compiling mid-serve)",
 )
+TRANSFER_FENCE_EVENTS = REGISTRY.counter(
+    "dynamo_transfer_fence_events_total",
+    "Serve-phase implicit host<->device transfers escalated by the "
+    "transfer fence (nonzero only under DYN_TRANSFER_FENCE; each one "
+    "is a device sync or upload outside the dispatch/harvest contract)",
+)
 ENGINE_REQUESTS_FINISHED = REGISTRY.counter(
     "dynamo_engine_requests_finished_total",
     "Sequences finished by reason",

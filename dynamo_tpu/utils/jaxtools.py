@@ -152,15 +152,29 @@ def shard_map(
 def pcast(x: Any, axis_names: Any, to: str = "varying") -> Any:
     """``jax.lax.pcast`` when jax has it; identity otherwise.
 
-    Only sound because the :func:`shard_map` fallback above always runs
-    with ``check_rep=False`` — without replication tracking there is no
-    varying/invariant distinction for the cast to repair."""
+    The identity fallback is only sound because the :func:`shard_map`
+    fallback above always runs with ``check_rep=False`` — without
+    replication tracking there is no varying/invariant distinction for
+    the cast to repair.  That soundness argument is a CHECKED contract,
+    not prose: a jax new enough to ship the native ``jax.shard_map``
+    (whose vma system DOES track the distinction) but missing
+    ``jax.lax.pcast`` would make the identity silently wrong, so that
+    combination raises instead of degrading."""
     import jax
 
     native = getattr(jax.lax, "pcast", None)
     if native is not None:
         return native(x, axis_names, to=to)
+    if getattr(jax, "shard_map", None) is not None:
+        raise RuntimeError(
+            "pcast identity fallback is unsound on this jax: native "
+            "jax.shard_map tracks varying/invariant (vma) but jax.lax."
+            "pcast is missing, so the cast cannot be skipped silently"
+        )
     return x
+
+
+_partial_auto_supported: Optional[bool] = None
 
 
 def partial_auto_shard_map_supported() -> bool:
@@ -172,7 +186,12 @@ def partial_auto_shard_map_supported() -> bool:
     SPMD partitioner rejects with UNIMPLEMENTED ("meaning is ambiguous").
     Fully-manual shard_map (every mesh axis in ``axis_names``) works on
     both — only the mixed mode needs this probe. Tests that exercise
-    pp x tp / ep x tp partial-auto meshes skip on old jax via this."""
-    import jax
+    pp x tp / ep x tp partial-auto meshes skip on old jax via this.
+    Memoized: the jax version cannot change mid-process, and callers
+    probe per plan/step."""
+    global _partial_auto_supported
+    if _partial_auto_supported is None:
+        import jax
 
-    return getattr(jax, "shard_map", None) is not None
+        _partial_auto_supported = getattr(jax, "shard_map", None) is not None
+    return _partial_auto_supported

@@ -1,0 +1,216 @@
+"""Serve-phase transfer fence — the runtime twin of dynalint DL301.
+
+DL301 (analysis/rules/shard_sync.py) proves *statically* that no
+device->host sync hides inside a shard_map body, and DL010/DL102 pin
+host syncs to the designated harvest points.  What the static plane
+cannot see is an *implicit* transfer materializing at runtime — a raw
+``np.ndarray`` fed straight into a jitted step (silent host->device
+upload on every dispatch), or a stray ``np.asarray`` on a device value
+in a code path the call graph could not resolve.  The fence closes
+that gap in the mold of the compile fence (utils/compile_fence.py):
+inert by default, armed by an env var, escalated through the same
+flight-recorder / black-box / Prometheus spine.
+
+Armed with ``DYN_TRANSFER_FENCE=1``, :func:`arm` (called from
+``JaxEngine._initialize``) flips JAX's global ``transfer_guard`` to
+``"disallow"``: implicit transfers raise at the offending site while
+explicit ``jax.device_put`` / ``jax.device_get`` stay sanctioned —
+exactly the discipline the engine's dispatch/harvest split encodes.
+The prewarm window wraps itself in :func:`allow` (a refcount PLUS a
+thread-local ``jax.transfer_guard("allow")`` scope), because warming
+legitimately uploads dummy batches.  Outside that window a violation
+surfaces as a ``RuntimeError`` from XLA; the engine's step-loop
+handler routes it through :func:`intercept`, which recognizes the
+guard's message, records the event, and lets the engine escalate: one
+flight-recorder ``serve_transfer`` record per drain, one black-box
+bundle (rate-limited), one ``dynamo_transfer_fence_events_total``
+bump.  ``DYN_TRANSFER_FENCE=fatal`` additionally raises
+:class:`TransferFenceError` from the escalation site.
+
+Disabled (the default), nothing is armed and every hook is a single
+boolean check — the serving hot path pays nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+_MAX_PENDING = 64  # bounded by construction (dynalint DL007)
+
+# substrings the XLA transfer guard puts in its RuntimeError text; the
+# jaxlib exception type is not importable portably, so intercept()
+# matches on message shape instead
+_GUARD_MARKERS = (
+    "Disallowed host-to-device transfer",
+    "Disallowed device-to-host transfer",
+    "Disallowed device-to-device transfer",
+)
+
+
+class TransferFenceError(RuntimeError):
+    """A serve-phase implicit transfer under DYN_TRANSFER_FENCE=fatal."""
+
+
+_lock = threading.Lock()
+_mode: Optional[str] = None  # None = re-read env; "off" | "record" | "fatal"
+_allowed = 0  # >0: transfers sanctioned (prewarm window)
+_armed = False  # jax_transfer_guard flipped to "disallow"
+_pending: deque = deque(maxlen=_MAX_PENDING)
+_since_drain = 0  # true violation count since the last drain (the
+# deque bounds the *detail* kept per window, never the count)
+_events_total = 0  # lifetime count, survives drains (for /debug/state)
+
+
+def _resolve_mode() -> str:
+    raw = os.environ.get("DYN_TRANSFER_FENCE", "").strip().lower()
+    if raw in ("1", "true", "record"):
+        return "record"
+    if raw == "fatal":
+        return "fatal"
+    return "off"
+
+
+def mode() -> str:
+    """The fence mode ("off" | "record" | "fatal"), env-resolved lazily
+    so tests can flip the variable before the engine constructs."""
+    global _mode
+    if _mode is None:
+        _mode = _resolve_mode()
+    return _mode
+
+
+def enabled() -> bool:
+    return mode() != "off"
+
+
+def fatal() -> bool:
+    return mode() == "fatal"
+
+
+def set_mode(value: Optional[str]) -> None:
+    """Test hook: force "off"/"record"/"fatal"; None re-reads the env."""
+    global _mode
+    _mode = value
+
+
+def arm() -> bool:
+    """Flip JAX's global transfer guard to "disallow" (idempotent).
+    Called from the engine's ``_initialize`` when the fence is enabled;
+    explicit device_put/device_get remain sanctioned, implicit
+    transfers raise at the site.  Returns whether the guard is armed."""
+    global _armed
+    if not enabled():
+        return False
+    with _lock:
+        if not _armed:
+            import jax
+
+            jax.config.update("jax_transfer_guard", "disallow")
+            _armed = True
+    return True
+
+
+def disarm() -> None:
+    """Test hook: restore the permissive guard and forget armed state."""
+    global _armed
+    with _lock:
+        if _armed:
+            import jax
+
+            jax.config.update("jax_transfer_guard", "allow")
+            _armed = False
+
+
+def armed() -> bool:
+    with _lock:
+        return _armed
+
+
+@contextlib.contextmanager
+def allow():
+    """Sanction transfers for the duration of the block (the engine's
+    prewarm window).  Re-entrant across engines: a refcount, like the
+    compile fence's — plus a thread-local ``jax.transfer_guard`` scope,
+    because the global "disallow" can only be overridden per-thread."""
+    global _allowed
+    with _lock:
+        _allowed += 1
+        guard_needed = _armed
+    try:
+        if guard_needed:
+            import jax
+
+            with jax.transfer_guard("allow"):
+                yield
+        else:
+            yield
+    finally:
+        with _lock:
+            _allowed -= 1
+
+
+def intercept(exc: BaseException) -> bool:
+    """Recognize an XLA transfer-guard violation escaping a dispatch.
+
+    The guard raises at the offending call site, so unlike compiles the
+    violation arrives as an exception, not a monitoring event.  The
+    engine's step-loop handler calls this on every caught exception:
+    a match records the event (like ``note_compile``) and returns True
+    so the engine escalates through ``_check_transfer_fence`` instead
+    of the generic quarantine path.  Never raises."""
+    global _events_total, _since_drain
+    if not enabled() or not isinstance(exc, RuntimeError):
+        return False
+    text = str(exc)
+    if not any(marker in text for marker in _GUARD_MARKERS):
+        return False
+    with _lock:
+        if _allowed > 0:
+            return False
+        _events_total += 1
+        _since_drain += 1
+        _pending.append(
+            {
+                "error": text.splitlines()[0][:400],
+                "ts": time.time(),
+            }
+        )
+    return True
+
+
+def drain() -> Tuple[List[Dict], int]:
+    """Return-and-clear ``(pending events, true violation count)``
+    since the last drain.  The engine calls this from the escalation
+    site (and once per recorded step, mirroring the compile fence) and
+    escalates a non-empty result."""
+    global _since_drain
+    with _lock:
+        out = list(_pending)
+        _pending.clear()
+        n = _since_drain
+        _since_drain = 0
+    return out, n
+
+
+def stats() -> Dict:
+    with _lock:
+        return {
+            "mode": mode(),
+            "armed": _armed,
+            "pending": len(_pending),
+            "events_total": _events_total,
+        }
+
+
+def reset() -> None:
+    """Test hook: drop pending events and the counters."""
+    global _events_total, _since_drain
+    with _lock:
+        _pending.clear()
+        _events_total = 0
+        _since_drain = 0

@@ -33,6 +33,10 @@ PROGRAM_CASES = [
     ("use-after-donate", "use_after_donate", 4),
     ("dynamic-static-arg", "dynamic_static_arg", 5),
     ("prewarm-coverage", "prewarm_coverage", 3),
+    ("host-sync-in-shard-body", "shard_sync", 3),
+    ("collective-axis-mismatch", "collective_axis", 3),
+    ("donation-across-mesh", "donation_mesh", 3),
+    ("spec-arity-drift", "spec_arity", 3),
 ]
 
 
@@ -659,6 +663,66 @@ def test_cli_changed_scopes_report(tmp_path):
     assert out.returncode == 0, out.stdout + out.stderr
 
 
+def test_sarif_emitter_schema_shape():
+    """ISSUE 16 satellite: the SARIF document must carry the 2.1.0
+    schema shape GitHub code scanning validates — versioned envelope,
+    driver rule catalog with consistent ruleIndex back-references, and
+    physical locations with 1-based line/column under SRCROOT."""
+    from dynamo_tpu.analysis import format_sarif
+
+    path = DATA / "transitive_blocking_bad.py"
+    findings = lint_sources_program({str(path): path.read_text()})
+    doc = json.loads(format_sarif(findings))
+    assert doc["$schema"].endswith("sarif-2.1.0.json")
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "dynalint"
+    assert "static_analysis.md" in driver["informationUri"]
+    # every registered rule (per-file AND program) has a descriptor
+    names = {r["name"] for r in driver["rules"]}
+    assert {pr.name for pr in all_program_rules()} <= names
+    assert run["originalUriBaseIds"]["SRCROOT"]["uri"].startswith("file:")
+    assert run["results"], "expected findings from the bad fixture"
+    for res in run["results"]:
+        assert res["ruleId"] == driver["rules"][res["ruleIndex"]]["id"]
+        assert res["level"] in ("error", "warning")
+        assert res["message"]["text"]
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uriBaseId"] == "SRCROOT"
+        assert "\\" not in loc["artifactLocation"]["uri"]
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["startColumn"] >= 1
+
+
+def test_sarif_suppressed_findings_stay_visible():
+    src = (
+        "import time\n"
+        "async def serve():\n"
+        "    helper()\n"
+        "def helper():\n"
+        "    time.sleep(1)  # dynalint: disable=transitive-blocking-call-in-async — test waiver\n"
+    )
+    from dynamo_tpu.analysis import format_sarif
+
+    findings = lint_sources_program({"mod.py": src})
+    assert len(findings) == 1 and findings[0].suppressed
+    doc = json.loads(format_sarif(findings))
+    res = doc["runs"][0]["results"][0]
+    assert res["suppressions"] == [
+        {"kind": "inSource", "status": "accepted"}
+    ]
+
+
+def test_cli_sarif_format():
+    bad = _run_cli(str(DATA / "transitive_blocking_bad.py"),
+                   "--format", "sarif", "--no-cache")
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    doc = json.loads(bad.stdout)
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["results"]
+
+
 # ---------------------------------------------------------------------------
 # catalog metadata
 # ---------------------------------------------------------------------------
@@ -666,9 +730,12 @@ def test_cli_changed_scopes_report(tmp_path):
 
 def test_program_rule_catalog_metadata():
     rules = all_program_rules()
-    assert len(rules) == 6
+    assert len(rules) == 10
     codes = [r.code for r in rules]
-    assert codes == ["DL101", "DL102", "DL103", "DL201", "DL202", "DL203"]
+    assert codes == [
+        "DL101", "DL102", "DL103", "DL201", "DL202", "DL203",
+        "DL301", "DL302", "DL303", "DL304",
+    ]
     assert all(r.name == r.name.lower() and " " not in r.name
                for r in rules)
 

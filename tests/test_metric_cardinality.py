@@ -67,6 +67,8 @@ _REQUIRED_SERIES = [
     "dynamo_spec_accepted_tokens_total",
     # ISSUE 13: the serve-phase compile fence (DYN_COMPILE_FENCE)
     "dynamo_compile_fence_events_total",
+    # ISSUE 16: the serve-phase transfer fence (DYN_TRANSFER_FENCE)
+    "dynamo_transfer_fence_events_total",
     # ISSUE 14: mid-stream migration (docs/robustness.md)
     "dynamo_midstream_resumes_total",
     "dynamo_midstream_resume_seconds",

@@ -1,0 +1,216 @@
+"""Serve-phase transfer fence (ISSUE 16): units in the compile-fence
+mold plus the e2e acceptance cases — a prewarmed greedy generate runs
+CLEAN under DYN_TRANSFER_FENCE=fatal (the explicit device_put staging
+satisfies the armed guard), and a deliberately unstaged dispatch
+produces EXACTLY ONE flight-recorder ``serve_transfer`` record, one
+black-box bundle, and a Prometheus counter bump that agrees with
+``/debug/state``."""
+
+import glob
+import os
+
+import pytest
+
+from dynamo_tpu.utils import transfer_fence
+
+MODEL_DIR = os.path.join(
+    os.path.dirname(__file__), "data", "tiny_llama_model"
+)
+
+
+@pytest.fixture
+def fence():
+    transfer_fence.set_mode("record")
+    transfer_fence.reset()
+    yield transfer_fence
+    transfer_fence.set_mode(None)
+    transfer_fence.disarm()
+    transfer_fence.reset()
+
+
+# ---------------------------------------------------------------------------
+# units
+# ---------------------------------------------------------------------------
+
+
+def test_fence_mode_resolution(monkeypatch):
+    transfer_fence.set_mode(None)
+    monkeypatch.delenv("DYN_TRANSFER_FENCE", raising=False)
+    assert transfer_fence.mode() == "off"
+    assert not transfer_fence.enabled()
+    for raw, want in (
+        ("1", "record"), ("true", "record"), ("record", "record"),
+        ("fatal", "fatal"), ("garbage", "off"), ("", "off"),
+    ):
+        transfer_fence.set_mode(None)
+        monkeypatch.setenv("DYN_TRANSFER_FENCE", raw)
+        assert transfer_fence.mode() == want
+    transfer_fence.set_mode(None)
+
+
+def test_intercept_recognizes_guard_errors_only(fence):
+    guard = RuntimeError(
+        "Disallowed host-to-device transfer: aval=ShapedArray(int32[8])"
+    )
+    assert fence.intercept(guard) is True
+    events, n = fence.drain()
+    assert n == 1 and "host-to-device" in events[0]["error"]
+    # non-guard RuntimeErrors and non-RuntimeErrors pass through
+    assert fence.intercept(RuntimeError("unrelated dispatch crash")) is False
+    assert fence.intercept(ValueError("Disallowed host-to-device transfer")) is False
+    assert fence.drain() == ([], 0)
+    assert fence.stats()["events_total"] == 1  # lifetime count survives
+
+
+def test_intercept_sanctioned_inside_allow_window(fence):
+    exc = RuntimeError("Disallowed device-to-host transfer: aval=...")
+    with fence.allow():
+        assert fence.intercept(exc) is False
+    assert fence.drain() == ([], 0)
+    assert fence.intercept(exc) is True  # outside the window it counts
+
+
+def test_fence_disabled_is_inert_and_pending_is_bounded(fence):
+    fence.set_mode("off")
+    assert fence.intercept(
+        RuntimeError("Disallowed host-to-device transfer")
+    ) is False
+    assert fence.stats()["events_total"] == 0
+    fence.set_mode("record")
+    for i in range(200):
+        fence.intercept(
+            RuntimeError(f"Disallowed host-to-device transfer #{i}")
+        )
+    assert fence.stats()["pending"] <= 64  # deque(maxlen): DL007 holds
+    events, n = fence.drain()
+    assert n == 200 and len(events) <= 64  # true count survives overflow
+    assert fence.fatal() is False
+    fence.set_mode("fatal")
+    assert fence.fatal() is True
+
+
+def test_arm_flips_transfer_guard_and_disarm_restores(fence):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    assert fence.arm() is True
+    assert fence.stats()["armed"] is True
+    try:
+        dev = jax.device_put(np.arange(4, dtype=np.int32))  # explicit: fine
+        with pytest.raises(RuntimeError, match="Disallowed"):
+            # implicit host->device upload into a jitted add
+            jax.jit(lambda a, b: a + b)(
+                np.arange(4, dtype=np.int32), dev
+            )
+        # the prewarm window's thread-local allow overrides the guard
+        with fence.allow():
+            jnp.asarray(np.arange(4, dtype=np.int32)) + dev
+    finally:
+        fence.disarm()
+    assert fence.stats()["armed"] is False
+    jax.jit(lambda a, b: a + b)(np.arange(4, dtype=np.int32), dev)
+
+
+def test_arm_is_noop_when_disabled(fence):
+    fence.set_mode("off")
+    assert fence.arm() is False
+    assert fence.stats()["armed"] is False
+
+
+# ---------------------------------------------------------------------------
+# e2e acceptance
+# ---------------------------------------------------------------------------
+
+
+async def test_fence_e2e_clean_then_induced_transfer_dumps_once(
+    tmp_path, fence
+):
+    """ISSUE 16 acceptance: under the armed fence a prewarmed greedy
+    generate completes with ZERO serve_transfer records (the staging
+    path is the sanctioned spelling), and a dispatch with the staging
+    bypassed trips the guard — exactly one flight-recorder record, one
+    black-box bundle, and a counter that agrees with /debug/state."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.telemetry.instruments import TRANSFER_FENCE_EVENTS
+
+    counter0 = TRANSFER_FENCE_EVENTS.labels().value
+
+    async def gen(engine, rid, **samp):
+        req = PreprocessedRequest(
+            request_id=rid, token_ids=list(range(1, 9)),
+            sampling=SamplingOptions(**samp),
+            stop=StopConditions(max_tokens=2),
+        )
+        out = []
+        async for item in engine.as_async_engine().generate(req, Context()):
+            out.extend(item.token_ids)
+        return out
+
+    # fatal mode for the clean leg: any implicit transfer in the
+    # prewarmed greedy path would take the engine down loudly
+    fence.set_mode("fatal")
+    engine = await JaxEngine.launch(EngineConfig(
+        model_path=MODEL_DIR, model_name="tiny", random_weights=True,
+        num_blocks=128, block_size=8, max_batch_size=8,
+        prefill_chunk_size=32, max_model_len=256,
+        prewarm=True, overlap=False,
+        flight_dump_dir=str(tmp_path),
+    ))
+    try:
+        assert fence.stats()["armed"] is True
+
+        def fence_records():
+            return [r for r in engine.recorder.snapshot(256)
+                    if r["kind"] == "serve_transfer"]
+
+        def bundles():
+            return glob.glob(str(tmp_path / "dynamo_blackbox_*"))
+
+        out = await gen(engine, "clean", use_greedy=True)
+        assert out, "prewarmed greedy generate produced no tokens"
+        assert fence_records() == [] and bundles() == []
+        assert fence.stats()["events_total"] == 0
+
+        # induced violation: bypass the explicit staging for ONE
+        # dispatch — the raw numpy feed is the implicit upload the
+        # fence exists to catch. record mode: escalate, then recover.
+        fence.set_mode("record")
+        orig = engine._stage_step_inputs
+        leaked = {"n": 0}
+
+        def leaky(arrays, sampling):
+            if leaked["n"] == 0:
+                leaked["n"] += 1
+                return arrays, sampling
+            return orig(arrays, sampling)
+
+        engine._stage_step_inputs = leaky
+        try:
+            out = await gen(engine, "leaky", use_greedy=True)
+        finally:
+            engine._stage_step_inputs = orig
+        assert out, "engine did not recover after the induced violation"
+        assert leaked["n"] == 1
+
+        recs = fence_records()
+        assert len(recs) == 1, recs
+        assert recs[0]["transfers"] >= 1
+        assert "transfer" in recs[0]["error"].lower()
+        assert len(bundles()) == 1, bundles()
+
+        state = engine.debug_state()["transfer_fence"]
+        assert state["events_total"] >= 1
+        assert (
+            TRANSFER_FENCE_EVENTS.labels().value - counter0
+            == state["events_total"]
+        )
+    finally:
+        await engine.shutdown()
