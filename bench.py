@@ -82,6 +82,17 @@ the verify step applies identical per-position masks);
 DYN_BENCH_GUIDED_SPEC=0 skips it, DYN_BENCH_GUIDED_TOKENIZER points the
 mask compiler at a different vocabulary.
 
+``--fanout`` is the frontend host-plane ceiling (no accelerator, no
+jax): the real HttpService over a synthetic chat engine, driven with a
+non-stream RPS concurrency ladder and a concurrent-SSE stream ladder;
+reports the requests/sec ceiling and stream fan-out ceiling with the
+server loop's lag p99 per rung and the host-cost ledger's per-stream
+breakdown, as ``frontend_fanout_rps`` / ``frontend_fanout_streams``
+JSON lines gated against the committed ``cpu-fanout-*`` baseline
+profile (exit 1 regression / exit 2 missing profile; ``--quick``,
+``--update-baseline``, DYN_SENTINEL_REPORT as with ``--sentinel``).
+docs/observability.md "Host data plane" is the reading guide.
+
 ``--overlap`` is the serial-vs-overlap A/B (docs/performance.md): the
 same workload at decode_steps=1 runs once with --no-overlap (fully
 serial plan -> dispatch -> sync -> emit) and once with the overlapped
@@ -1160,6 +1171,345 @@ def _main_sim() -> None:
     )
 
 
+def _fanout_compare(measured: dict, base: dict) -> dict:
+    """Pure comparison for the fan-out sentinel (unit-tested without a
+    server): measured ``{"rps", "streams"}`` vs a baseline entry with an
+    explicit ``noise_frac``. Either headline falling below its floor is
+    a regression — host-plane throughput gates exactly like decode."""
+    noise = float(base.get("noise_frac", 0.5))
+    rps_floor = base["rps"] * (1.0 - noise)
+    streams_floor = base["streams"] * (1.0 - noise)
+    return {
+        "regressed": (
+            measured["rps"] < rps_floor
+            or measured["streams"] < streams_floor
+        ),
+        "rps": round(measured["rps"], 1),
+        "baseline_rps": base["rps"],
+        "floor_rps": round(rps_floor, 1),
+        "streams": measured["streams"],
+        "baseline_streams": base["streams"],
+        "floor_streams": int(streams_floor),
+        "noise_frac": noise,
+    }
+
+
+def _main_fanout() -> None:
+    """--fanout: the frontend host-plane ceiling — no accelerator, no
+    jax (docs/observability.md "Host data plane").
+
+    Boots the REAL HttpService (port 0, dedicated server thread/loop)
+    over a synthetic chat engine, then drives it from a client loop:
+
+    - a non-stream RPS ladder at rising concurrency (instant engine:
+      every microsecond measured is host work — parse, admission,
+      dispatch, aggregate, serialize), headline = best rung's req/s;
+    - a concurrent-SSE stream ladder (paced engine holds every rung's
+      streams open simultaneously), headline = the largest rung whose
+      streams ALL completed; each rung reports the server loop's lag
+      p99 over just that rung (LoopLagMonitor.reset_window between
+      rungs) and the ledger's per-stream host cost.
+
+    Emits TWO JSON lines — ``frontend_fanout_rps`` and
+    ``frontend_fanout_streams`` — gated against the committed
+    ``cpu-fanout-quick``/``cpu-fanout-full`` profile in
+    BENCH_BASELINE.json exactly like the decode sentinel (exit 1
+    regression / exit 2 missing profile; ``--update-baseline`` seeds;
+    DYN_SENTINEL_REPORT writes the CI artifact). ``--quick`` shrinks
+    both ladders for the CI tier. Knobs: DYN_BENCH_FANOUT_CHUNKS /
+    DYN_BENCH_FANOUT_INTERVAL_S shape the synthetic stream."""
+    import resource
+    import threading
+
+    import aiohttp
+
+    from dynamo_tpu.http.service import HttpService, ModelManager
+    from dynamo_tpu.protocols.openai import ChatDeltaGenerator
+    from dynamo_tpu.telemetry.hostplane import LoopLagMonitor
+
+    argv = sys.argv[1:]
+    quick = "--quick" in argv
+    chunks = int(os.environ.get("DYN_BENCH_FANOUT_CHUNKS", "4"))
+    interval_s = float(os.environ.get("DYN_BENCH_FANOUT_INTERVAL_S", "0.05"))
+
+    class _SyntheticEngine:
+        """Chat engine of pure host cost: real ChatCompletionChunk
+        objects (serialize cost is the production pydantic dump), zero
+        chip work. ``interval_s`` > 0 paces chunks so N in-flight
+        streams are N OPEN streams, not N sequential sprints."""
+
+        def __init__(self, pace_s: float):
+            self.pace_s = pace_s
+
+        def generate(self, req, ctx):
+            return self._gen(req, ctx)
+
+        async def _gen(self, req, ctx):
+            gen = ChatDeltaGenerator(model=req.model or "fanout")
+            yield gen.role_chunk()
+            for _ in range(chunks):
+                if self.pace_s > 0:
+                    await asyncio.sleep(self.pace_s)
+                else:
+                    await asyncio.sleep(0)
+                yield gen.text_chunk("synthetic delta text ")
+            yield gen.finish_chunk("stop")
+
+    # both the client and server sockets of every stream live in THIS
+    # process: 2 fds per open stream, so the ladder's top rung is
+    # bounded by the nofile limit (recorded in the config stanza)
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < hard:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+        soft = hard
+    fd_budget = max(64, (soft - 1000) // 2)
+    if os.environ.get("DYN_BENCH_FANOUT_SMOKE") == "1":
+        # tests/test_hostplane.py: the smallest honest run — one rung
+        # per ladder, enough traffic to populate every surface
+        rps_rungs = (2,)
+        rps_reqs_per_rung = 20
+        stream_rungs = (8,)
+    elif quick:
+        rps_rungs = (4, 16)
+        rps_reqs_per_rung = 300
+        stream_rungs = tuple(n for n in (64, 256) if n <= fd_budget)
+    else:
+        rps_rungs = (4, 16, 64, 256)
+        rps_reqs_per_rung = 1500
+        stream_rungs = tuple(
+            n for n in (512, 2048, 8192) if n <= fd_budget
+        )
+
+    # -- server side: real HttpService on its own thread + loop ----------
+    mm = ModelManager()
+    mm.add_chat_model("fanout", _SyntheticEngine(pace_s=0.0))
+    mm.add_chat_model("fanout-paced", _SyntheticEngine(pace_s=interval_s))
+    # fine-grained heartbeat (20 ms) so a few-second rung still yields a
+    # real p99; no blackbox — under deliberate overload the stall
+    # counter is the signal, a dump per rung would be noise
+    monitor = LoopLagMonitor(interval_s=0.02, window=4096)
+    svc = HttpService(mm, host="127.0.0.1", port=0, lag_monitor=monitor)
+    server_loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def _serve() -> None:
+        asyncio.set_event_loop(server_loop)
+        server_loop.run_until_complete(svc.start())
+        started.set()
+        server_loop.run_forever()
+
+    server = threading.Thread(target=_serve, name="fanout-server", daemon=True)
+    server.start()
+    if not started.wait(timeout=30):
+        raise SystemExit("fanout: server failed to start")
+    base_url = f"http://127.0.0.1:{svc.port}"
+
+    def _reset_lag() -> None:
+        server_loop.call_soon_threadsafe(monitor.reset_window)
+
+    # -- client side ------------------------------------------------------
+    async def _drive() -> dict:
+        timeout = aiohttp.ClientTimeout(
+            total=None, sock_connect=60, sock_read=120
+        )
+        conn = aiohttp.TCPConnector(limit=0)
+        results: dict = {"rps_rungs": [], "stream_rungs": []}
+        async with aiohttp.ClientSession(
+            timeout=timeout, connector=conn
+        ) as session:
+
+            async def lag_now() -> dict:
+                async with session.get(f"{base_url}/debug/hostplane") as r:
+                    snap = await r.json()
+                fe = snap.get("frontend", {})
+                return {
+                    "lag": fe.get("loop", {}).get("lag", {}),
+                    "stalls": fe.get("loop", {}).get("stalls", 0),
+                    "ledger": fe.get("ledger", {}),
+                }
+
+            body = {
+                "model": "fanout",
+                "messages": [{"role": "user", "content": "ping"}],
+                "stream": False,
+            }
+            for conc in rps_rungs:
+                _reset_lag()
+                left = rps_reqs_per_rung
+                errors = 0
+
+                async def worker():
+                    nonlocal left, errors
+                    url = f"{base_url}/v1/chat/completions"
+                    while left > 0:
+                        left -= 1
+                        async with session.post(url, json=body) as r:
+                            await r.read()
+                            if r.status != 200:
+                                errors += 1
+
+                t0 = time.monotonic()
+                await asyncio.gather(*(worker() for _ in range(conc)))
+                dt = time.monotonic() - t0
+                probe = await lag_now()
+                results["rps_rungs"].append({
+                    "concurrency": conc,
+                    "requests": rps_reqs_per_rung,
+                    "errors": errors,
+                    "rps": round(rps_reqs_per_rung / max(dt, 1e-9), 1),
+                    "lag_p99_ms": probe["lag"].get("p99_ms", 0.0),
+                    "lag_max_ms": probe["lag"].get("max_ms", 0.0),
+                })
+
+            sbody = dict(body, model="fanout-paced", stream=True)
+            for n in stream_rungs:
+                _reset_lag()
+                failures = 0
+
+                async def one_stream():
+                    nonlocal failures
+                    url = f"{base_url}/v1/chat/completions"
+                    try:
+                        async with session.post(url, json=sbody) as r:
+                            ok = r.status == 200
+                            async for _ in r.content:
+                                pass
+                            if not ok:
+                                failures += 1
+                    except (aiohttp.ClientError, OSError,
+                            asyncio.TimeoutError):
+                        failures += 1
+
+                t0 = time.monotonic()
+                tasks = []
+                for i in range(n):
+                    tasks.append(asyncio.ensure_future(one_stream()))
+                    if i % 256 == 255:
+                        # stagger socket bring-up so the listen backlog
+                        # measures streaming fan-out, not SYN flooding
+                        await asyncio.sleep(0)
+                await asyncio.gather(*tasks)
+                dt = time.monotonic() - t0
+                probe = await lag_now()
+                ledger = probe["ledger"]
+                results["stream_rungs"].append({
+                    "streams": n,
+                    "failures": failures,
+                    "wall_s": round(dt, 3),
+                    "lag_p99_ms": probe["lag"].get("p99_ms", 0.0),
+                    "lag_max_ms": probe["lag"].get("max_ms", 0.0),
+                    "stalls_total": probe["stalls"],
+                    "sse_write_ema_us": ledger.get("sse_write_ema_us"),
+                    "host_stage_ms_mean": (
+                        ledger.get("window", {}).get("stage_ms_mean", {})
+                    ),
+                })
+        return results
+
+    try:
+        results = asyncio.run(_drive())
+    finally:
+        asyncio.run_coroutine_threadsafe(svc.stop(), server_loop).result(30)
+        server_loop.call_soon_threadsafe(server_loop.stop)
+        server.join(timeout=30)
+
+    clean_rps = [r for r in results["rps_rungs"] if r["errors"] == 0]
+    rps_ceiling = max((r["rps"] for r in clean_rps), default=0.0)
+    clean_streams = [
+        r for r in results["stream_rungs"] if r["failures"] == 0
+    ]
+    stream_ceiling = max((r["streams"] for r in clean_streams), default=0)
+
+    # -- sentinel gate (same discipline as --sentinel) --------------------
+    path = _sentinel_baseline_path()
+    if "--baseline" in argv:
+        i = argv.index("--baseline") + 1
+        if i >= len(argv) or argv[i].startswith("--"):
+            raise SystemExit("--baseline requires a path argument")
+        path = argv[i]
+    key = f"cpu-fanout-{'quick' if quick else 'full'}"
+    measured = {"rps": rps_ceiling, "streams": stream_ceiling}
+    baselines: dict = {"profiles": {}}
+    if os.path.exists(path):
+        with open(path) as f:
+            baselines = json.load(f)
+    if "--update-baseline" in argv:
+        baselines.setdefault("profiles", {})[key] = {
+            "rps": round(rps_ceiling, 1),
+            "streams": stream_ceiling,
+            # single-core CI runners swing hard on pure host-throughput
+            # numbers — wide explicit band, tighten per-fleet on purpose
+            "noise_frac": 0.5,
+        }
+        with open(path, "w") as f:
+            json.dump(baselines, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"# fanout: baseline profile {key!r} written to {path}",
+              file=sys.stderr)
+    base = (baselines.get("profiles") or {}).get(key)
+    config = {
+        "profile": key,
+        "baseline_path": path,
+        "chunks_per_stream": chunks,
+        "chunk_interval_s": interval_s,
+        "fd_budget_streams": fd_budget,
+        "rps_rungs": results["rps_rungs"],
+        "stream_rungs": results["stream_rungs"],
+    }
+    if base is None:
+        print(json.dumps({
+            "metric": "frontend_fanout_rps", "value": rps_ceiling,
+            "unit": "requests/sec", "vs_baseline": 0.0,
+            "config": {"error": f"no baseline profile {key!r} in {path}",
+                       "hint": "run with --update-baseline and commit"},
+        }))
+        print(json.dumps({
+            "metric": "frontend_fanout_streams", "value": stream_ceiling,
+            "unit": "concurrent_streams", "vs_baseline": 0.0,
+            "config": {"error": f"no baseline profile {key!r} in {path}"},
+        }))
+        sys.exit(2)
+    verdict = _fanout_compare(measured, base)
+    out_rps = {
+        "metric": "frontend_fanout_rps",
+        "value": rps_ceiling,
+        "unit": "requests/sec",
+        "vs_baseline": round(rps_ceiling / max(base["rps"], 1e-9), 4),
+        "config": {**config, **verdict},
+    }
+    out_streams = {
+        "metric": "frontend_fanout_streams",
+        "value": stream_ceiling,
+        "unit": "concurrent_streams",
+        "vs_baseline": round(
+            stream_ceiling / max(base["streams"], 1e-9), 4
+        ),
+        "config": {"profile": key, **verdict},
+    }
+    print(json.dumps(out_rps))
+    print(json.dumps(out_streams))
+    report_path = os.environ.get("DYN_SENTINEL_REPORT")
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump({"rps": out_rps, "streams": out_streams}, f, indent=2)
+            f.write("\n")
+    if verdict["regressed"]:
+        print(
+            f"# FANOUT REGRESSION: rps {verdict['rps']} (floor "
+            f"{verdict['floor_rps']}) streams {verdict['streams']} "
+            f"(floor {verdict['floor_streams']}) vs baseline "
+            f"rps={base['rps']} streams={base['streams']} "
+            f"-{verdict['noise_frac']:.0%}",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    print(
+        f"# fanout OK: {rps_ceiling:.0f} req/s, {stream_ceiling} "
+        f"concurrent streams ({key})",
+        file=sys.stderr,
+    )
+
+
 def _sentinel_profile_key(
     cpu_mode: bool, wl: dict, quick: bool, spec: bool = True
 ) -> str:
@@ -1340,6 +1690,9 @@ def _main_sentinel(model_cfg, wl, cpu_mode: bool) -> None:
 def main() -> None:
     if "--sim" in sys.argv[1:]:
         _main_sim()  # pure host-side discrete-event run: no jax, no chip
+        return
+    if "--fanout" in sys.argv[1:]:
+        _main_fanout()  # frontend host-plane ceiling: no jax, no chip
         return
     cpu_mode = os.environ.get("DYN_BENCH_PLATFORM") == "cpu"
     if cpu_mode:

@@ -9,6 +9,10 @@ Keys (all optional):
   step-loop-functions — function names treated as the engine step loop
                   by hidden-host-sync-in-step-loop (DL010) and as the
                   seeds of the transitive DL102 taint
+  sse-writer-functions — function names treated as SSE chunk paths by
+                  blocking-work-in-chunk-path (DL013) in addition to
+                  any function whose name contains "stream_sse" or
+                  "sse_write"
   affinity-entry-points — "pattern=domain" strings seeding the thread-
                   affinity taint (DL103) for entry points that carry no
                   @thread_affinity decorator; pattern is a bare function
@@ -40,6 +44,7 @@ DEFAULTS: dict[str, Any] = {
     "disable": [],
     "hot-functions": [],
     "step-loop-functions": [],
+    "sse-writer-functions": [],
     "affinity-entry-points": [],
     "prewarm-functions": [],
     "baseline": "",

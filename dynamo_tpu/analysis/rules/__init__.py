@@ -15,6 +15,7 @@ from dynamo_tpu.analysis.rules import (  # noqa: F401
     await_locked,
     bare_except,
     blocking_async,
+    chunk_path,
     collective_axis,
     cross_thread,
     donation_mesh,

@@ -12,6 +12,12 @@ client-side from ``engine.tokens_generated_total`` deltas between
 polls, so the first frame shows ``-``. ``--once`` renders a single
 frame and exits (scriptable / testable); ``--raw`` prints the JSON
 instead of the table.
+
+Host-plane columns (telemetry/hostplane.py, polled best-effort from
+``/debug/hostplane``): LAG99 = the frontend event loop's lag p99 in
+ms, STRM = open SSE streams, RPS = finished requests/sec derived from
+``ledger.requests_total`` deltas (same ``-`` rule as TOK/S: first
+poll, zero poll gap, and counter rewinds render absence, not 0.0).
 """
 
 from __future__ import annotations
@@ -50,6 +56,55 @@ async def fetch_state(
     )) as resp:
         resp.raise_for_status()
         return await resp.json()
+
+
+async def fetch_hostplane(
+    session: aiohttp.ClientSession, base_url: str
+) -> Optional[dict[str, Any]]:
+    """Best-effort /debug/hostplane poll: an endpoint without the host
+    data plane (worker-only metrics server from an older build) is not
+    an error — its host columns just render ``-``."""
+    url = base_url.rstrip("/") + "/debug/hostplane"
+    try:
+        async with session.get(url, timeout=aiohttp.ClientTimeout(
+            total=POLL_TIMEOUT_S
+        )) as resp:
+            if resp.status != 200:
+                return None
+            return await resp.json()
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+        return None
+
+
+def _hostplane_cols(
+    hp: Optional[dict], prev_hp: Optional[dict],
+    now: float, prev_ts: Optional[float],
+) -> dict:
+    """Host-plane columns (LAG99 / STRM / RPS) from a /debug/hostplane
+    payload. RPS derives from ``ledger.requests_total`` deltas under
+    the same rule as TOK/S: no prior poll, a zero/negative poll gap, or
+    a counter that went backwards (frontend restart) all render the
+    absence marker, never a fabricated 0.0."""
+    cols: dict[str, Any] = {
+        "loop_lag_p99_ms": None, "streams_open": None, "rps": None,
+    }
+    fe = (hp or {}).get("frontend") or {}
+    lag = (fe.get("loop") or {}).get("lag") or {}
+    ledger = fe.get("ledger") or {}
+    if "p99_ms" in lag:
+        cols["loop_lag_p99_ms"] = lag["p99_ms"]
+    if "streams_open" in ledger:
+        cols["streams_open"] = ledger["streams_open"]
+    total = ledger.get("requests_total")
+    if prev_hp is not None and prev_ts is not None and total is not None:
+        prev_total = (
+            ((prev_hp.get("frontend") or {}).get("ledger") or {})
+            .get("requests_total")
+        )
+        dt = now - prev_ts
+        if prev_total is not None and dt > 0 and total >= prev_total:
+            cols["rps"] = (total - prev_total) / dt
+    return cols
 
 
 def _engine_row(url: str, state: dict, prev: Optional[dict],
@@ -100,7 +155,8 @@ def _engine_row(url: str, state: dict, prev: Optional[dict],
 HEADER = (
     f"{'WORKER':<28} {'MODEL':<12} {'RUN':>5} {'WAIT':>5} "
     f"{'KV%':>7} {'TOK/S':>8} {'ROOF%':>7} {'LOSS':>10} {'SLO%':>7} "
-    f"{'HBM':>9} {'SLOW':>5} {'PREEMPT':>7}"
+    f"{'HBM':>9} {'SLOW':>5} {'PREEMPT':>7} "
+    f"{'LAG99':>7} {'STRM':>6} {'RPS':>7}"
 )
 
 
@@ -116,6 +172,11 @@ def render_frame(rows: list[dict], out: TextIO) -> None:
             str(run) if run is not None else "-"
         )
         tok = f"{r['tok_s']:8.1f}" if r["tok_s"] is not None else "       -"
+        lag = r.get("loop_lag_p99_ms")
+        lag_s = f"{lag:7.1f}" if lag is not None else "      -"
+        strm = r.get("streams_open")
+        rps = r.get("rps")
+        rps_s = f"{rps:7.1f}" if rps is not None else "      -"
         out.write(
             f"{r['url']:<28} {str(r['model'])[:12]:<12} {run_s:>5} "
             f"{str(r['waiting'] if r['waiting'] is not None else '-'):>5} "
@@ -125,7 +186,8 @@ def render_frame(rows: list[dict], out: TextIO) -> None:
             f"{_pct(r['slo']):>7} "
             f"{_fmt_bytes(r['hbm']):>9} "
             f"{str(r['slow_steps'] if r['slow_steps'] is not None else '-'):>5} "
-            f"{str(r['preemptions'] if r['preemptions'] is not None else '-'):>7}\n"
+            f"{str(r['preemptions'] if r['preemptions'] is not None else '-'):>7} "
+            f"{lag_s} {str(strm if strm is not None else '-'):>6} {rps_s}\n"
         )
     out.flush()
 
@@ -146,6 +208,7 @@ async def run_top(
     the worker bleeding the most throughput floats to the top (workers
     without a decode window sort last; errored rows stay last)."""
     prev: dict[str, tuple[dict, float]] = {}
+    prev_hp: dict[str, Optional[dict]] = {}
     n = 0
     all_failed = False
     async with aiohttp.ClientSession() as session:
@@ -155,20 +218,28 @@ async def run_top(
                 *[fetch_state(session, u) for u in urls],
                 return_exceptions=True,
             )
+            hp_results = await asyncio.gather(
+                *[fetch_hostplane(session, u) for u in urls]
+            )
             rows: list[dict] = []
             all_failed = True
-            for url, res in zip(urls, results):
+            for url, res, hp in zip(urls, results, hp_results):
                 if isinstance(res, BaseException):
                     rows.append({"url": url, "error": str(res) or
                                  type(res).__name__})
                     continue
                 all_failed = False
                 p = prev.get(url)
-                rows.append(_engine_row(
+                row = _engine_row(
                     url, res, p[0] if p else None, now,
                     p[1] if p else None,
+                )
+                row.update(_hostplane_cols(
+                    hp, prev_hp.get(url), now, p[1] if p else None,
                 ))
+                rows.append(row)
                 prev[url] = (res, now)
+                prev_hp[url] = hp
             if watch_roofline:
                 rows.sort(key=lambda r: (
                     "error" in r and r.get("error") is not None,

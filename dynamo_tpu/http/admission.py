@@ -115,8 +115,27 @@ class AdmissionController:
         self.shed_total = 0
         self.admitted_total = 0
         self.resumed_total = 0
+        # host data plane (telemetry/hostplane.py): the admit/reject
+        # decision reads a LIVE load snapshot — engine.stats() under a
+        # busy loop is real host cost, so the controller keeps its own
+        # decision-latency EMA for /debug/hostplane
+        self._mono = clock or SYSTEM.monotonic
+        self.checks_total = 0
+        self.check_ema_s = 0.0
 
     def check(self, resume: bool = False) -> Optional[Rejection]:
+        t0 = self._mono()
+        try:
+            return self._decide(resume)
+        finally:
+            dt = self._mono() - t0
+            self.checks_total += 1
+            self.check_ema_s = (
+                dt if self.checks_total == 1
+                else self.check_ema_s + 0.2 * (dt - self.check_ema_s)
+            )
+
+    def _decide(self, resume: bool = False) -> Optional[Rejection]:
         """None = admit; a Rejection = shed with 429 + Retry-After.
 
         ``resume=True`` marks a mid-stream migration re-dispatch
@@ -193,6 +212,8 @@ class AdmissionController:
             "shed_total": self.shed_total,
             "admitted_total": self.admitted_total,
             "resumed_total": self.resumed_total,
+            "checks_total": self.checks_total,
+            "check_ema_us": round(self.check_ema_s * 1e6, 1),
         }
 
 

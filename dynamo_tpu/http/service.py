@@ -50,6 +50,13 @@ from dynamo_tpu.telemetry import (
     get_tracer,
     propagation_context,
 )
+from dynamo_tpu.telemetry.hostplane import (
+    LEDGER,
+    LoopLagMonitor,
+    collect_hostplane,
+    register_hostplane_provider,
+    unregister_hostplane_provider,
+)
 from dynamo_tpu.telemetry.instruments import (
     HTTP_DURATION,
     HTTP_INFLIGHT,
@@ -127,6 +134,7 @@ class HttpService:
         port: int = 8000,
         admission=None,
         default_deadline_ms: Optional[float] = None,
+        lag_monitor: Optional[LoopLagMonitor] = None,
     ):
         self.models = model_manager or ModelManager()
         self.host = host
@@ -136,6 +144,20 @@ class HttpService:
         self.admission = admission
         # deadline budget applied when X-Request-Timeout-Ms is absent
         self.default_deadline_ms = default_deadline_ms
+        # host data plane (telemetry/hostplane.py): the per-stream cost
+        # ledger is process-global (downstream stages stamp it by
+        # request id); the loop-lag monitor is per-service — a stall
+        # dumps its own flight ring + black-box bundle (loop_stall)
+        self.hostplane = LEDGER
+        if lag_monitor is None:
+            from dynamo_tpu.telemetry.attribution import BlackBox
+            from dynamo_tpu.telemetry.recorder import FlightRecorder
+
+            rec = FlightRecorder(capacity=256)
+            lag_monitor = LoopLagMonitor(
+                recorder=rec, blackbox=BlackBox(recorder=rec)
+            )
+        self.lag_monitor = lag_monitor
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self.app.add_routes(
             [
@@ -144,6 +166,7 @@ class HttpService:
                 web.get("/metrics", self._metrics),
                 web.get("/debug/state", self._debug_state),
                 web.get("/debug/attribution", self._debug_attribution),
+                web.get("/debug/hostplane", self._debug_hostplane),
                 web.get("/debug/profile", self._debug_profile),
                 web.get("/v1/models", self._models),
                 web.post("/v1/chat/completions", self._chat),
@@ -162,9 +185,15 @@ class HttpService:
         await site.start()
         if self.port == 0:
             self.port = self._runner.addresses[0][1]
+        # host data plane: heartbeat on THIS loop + the /debug/hostplane
+        # provider stanza (lag window, task census, ledger rollup)
+        self.lag_monitor.start()
+        register_hostplane_provider("frontend", self._hostplane_stanza)
         log.info("OpenAI HTTP service on %s:%d", self.host, self.port)
 
     async def stop(self) -> None:
+        unregister_hostplane_provider("frontend", self._hostplane_stanza)
+        await self.lag_monitor.stop()
         if self._runner is not None:
             await self._runner.cleanup()
 
@@ -202,6 +231,27 @@ class HttpService:
         from dynamo_tpu.telemetry.attribution import collect_attribution
 
         return web.json_response(collect_attribution())
+
+    def _hostplane_stanza(self) -> dict:
+        """The frontend's /debug/hostplane provider: loop-lag window +
+        task census from the monitor, per-stream cost rollup from the
+        ledger (docs/observability.md "Host data plane")."""
+        out = {
+            "loop": self.lag_monitor.snapshot(),
+            "ledger": self.hostplane.snapshot(recent=8),
+        }
+        if self.admission is not None:
+            out["admission"] = self.admission.stats()
+        return out
+
+    async def _debug_hostplane(self, request: web.Request) -> web.Response:
+        """Host data-plane introspection (docs/observability.md "Host
+        data plane"): event-loop lag p50/p99/max + stall count, the
+        asyncio task census, and the per-stream host-cost ledger's
+        rolling window — the 'is the HOST the bottleneck' endpoint.
+        The provider refreshes the loop-lag gauges, so a /metrics
+        scrape next to this endpoint describes the same window."""
+        return web.json_response(collect_hostplane())
 
     async def _debug_profile(self, request: web.Request) -> web.Response:
         """On-demand ``jax.profiler`` capture: ``/debug/profile?ms=N``
@@ -244,6 +294,10 @@ class HttpService:
                    "request_id": rid},
         )
         set_log_request_id(rid, span.trace_id or None)
+        # host-cost ledger record (telemetry/hostplane.py): stamped by
+        # every stage below; downstream stages (preprocessor tool
+        # parser, router dispatch) stamp by request id via note_stage
+        self.hostplane.begin(rid, endpoint)
         try:
             if faults.ACTIVE is not None:
                 # per-request chaos: the X-Dyn-Fault header arms rules
@@ -264,7 +318,11 @@ class HttpService:
             # BEFORE any expensive work; shed with 429 + Retry-After
             # instead of queueing unboundedly
             if self.admission is not None:
+                t_adm = time.monotonic()
                 rejection = self.admission.check()
+                self.hostplane.stage(
+                    rid, "admission", time.monotonic() - t_adm
+                )
                 if rejection is not None:
                     log.warning(
                         "shedding request %s: %s", rid, rejection.detail
@@ -300,6 +358,7 @@ class HttpService:
                         "number of milliseconds",
                         "", endpoint, rid,
                     )
+            t_pre = time.monotonic()
             try:
                 body = await request.json()
             except json.JSONDecodeError:
@@ -313,6 +372,10 @@ class HttpService:
                 return self._error(
                     400, f"invalid request: {exc}", "", endpoint, rid
                 )
+            # frontend share of preprocess: body read + pydantic
+            # validation (the pipeline's tokenize/template forward adds
+            # its share to the same stamp via note_stage)
+            self.hostplane.stage(rid, "preprocess", time.monotonic() - t_pre)
 
             model = req.model
             span.set_attr("model", model)
@@ -358,6 +421,12 @@ class HttpService:
             HTTP_INFLIGHT.labels(model).inc()
             try:
                 stream = engine.generate(req, ctx)
+                # dispatch stamp: building the generator is the local
+                # handoff cost (routed pipelines add the instance-pick
+                # share via note_stage inside the router)
+                self.hostplane.stage(
+                    rid, "dispatch", time.monotonic() - start
+                )
                 if req.stream:
                     # prime the FIRST chunk before committing to an SSE
                     # response: generation pipelines run lazily, so
@@ -366,10 +435,16 @@ class HttpService:
                     # __anext__ — they must return the 400 below, not a
                     # 200 stream carrying an error event
                     aiter = stream.__aiter__()
+                    t_prime = time.monotonic()
                     try:
                         first = await aiter.__anext__()
                     except StopAsyncIteration:
                         first = None
+                    # first-chunk priming = the engine-side share of
+                    # TTFB (the frontend TTFB-vs-engine-TTFT split)
+                    self.hostplane.stage(
+                        rid, "prime", time.monotonic() - t_prime
+                    )
                     return await self._stream_sse(
                         request, _chain_first(first, aiter), ctx, model,
                         endpoint, start, rid,
@@ -382,6 +457,7 @@ class HttpService:
                 HTTP_DURATION.labels(model, endpoint).observe(
                     time.monotonic() - start
                 )
+                self.hostplane.finish(rid, "200")
                 return web.json_response(
                     agg.response().model_dump(exclude_none=True),
                     headers={REQUEST_ID_HEADER: rid},
@@ -411,6 +487,11 @@ class HttpService:
             finally:
                 HTTP_INFLIGHT.labels(model).dec()
         finally:
+            # error/shed/4xx paths return before their stage reached a
+            # finish() call — close the ledger record so the active
+            # table can't grow (finish is idempotent: happy paths
+            # already popped theirs)
+            self.hostplane.finish(rid, "error")
             span.end()
             set_log_request_id(None)
 
@@ -433,6 +514,7 @@ class HttpService:
             headers[REQUEST_ID_HEADER] = rid
         resp = web.StreamResponse(status=200, headers=headers)
         await resp.prepare(request)
+        self.hostplane.mark_stream(rid)
         first = True
         status = "200"
         try:
@@ -440,8 +522,16 @@ class HttpService:
                 if first:
                     HTTP_TTFT.labels(model).observe(time.monotonic() - start)
                     first = False
+                # per-chunk cost feeds the ledger's EMA (serialize vs
+                # write split: a long write is transport backpressure)
+                t0 = time.monotonic()
                 payload = chunk.model_dump(exclude_none=True) if hasattr(chunk, "model_dump") else chunk
-                await resp.write(encode_sse(payload).encode())
+                data = encode_sse(payload).encode()
+                t1 = time.monotonic()
+                await resp.write(data)
+                self.hostplane.chunk(
+                    rid, t1 - t0, time.monotonic() - t1, len(data)
+                )
             await resp.write(encode_done().encode())
         except asyncio.CancelledError:
             # client went away: kill the in-flight generation, let the
@@ -462,6 +552,7 @@ class HttpService:
         finally:
             HTTP_REQUESTS.labels(model, endpoint, status).inc()
             HTTP_DURATION.labels(model, endpoint).observe(time.monotonic() - start)
+            self.hostplane.finish(rid, status)
         with contextlib.suppress(ConnectionResetError):
             await resp.write_eof()
         return resp

@@ -115,6 +115,7 @@ class MetricsService:
         app.router.add_get("/metrics", self._handle_metrics)
         app.router.add_get("/debug/state", self._handle_debug_state)
         app.router.add_get("/debug/attribution", self._handle_debug_attribution)
+        app.router.add_get("/debug/hostplane", self._handle_debug_hostplane)
         app.router.add_get("/debug/profile", self._handle_debug_profile)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
@@ -209,6 +210,16 @@ class MetricsService:
             for wid, m in sorted(fresh.items())
         }
         return web.json_response(state)
+
+    async def _handle_debug_hostplane(
+        self, _req: web.Request
+    ) -> web.Response:
+        """Host data-plane view (telemetry/hostplane.py): event-loop
+        lag, asyncio task census, and the per-stream cost ledger of
+        whatever co-located services registered a provider."""
+        from dynamo_tpu.telemetry.hostplane import collect_hostplane
+
+        return web.json_response(collect_hostplane())
 
     async def _handle_debug_profile(self, req: web.Request) -> web.Response:
         try:

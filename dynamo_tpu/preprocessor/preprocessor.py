@@ -8,6 +8,7 @@ transform_postprocessor_stream into SSE delta objects).
 
 from __future__ import annotations
 
+import time
 import uuid
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Optional, Union
@@ -28,6 +29,7 @@ from dynamo_tpu.protocols.openai import (
 )
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.pipeline import Operator
+from dynamo_tpu.telemetry.hostplane import note_stage
 from dynamo_tpu.tokenizer import Tokenizer
 
 
@@ -123,6 +125,7 @@ class OpenAIPreprocessor(Operator):
     ) -> tuple[PreprocessedRequest, _ReqState]:
         from dynamo_tpu.telemetry import get_tracer
 
+        t_pre = time.monotonic()
         with get_tracer().span(
             "preprocess", parent=context, attrs={"service": "frontend"}
         ) as span:
@@ -135,6 +138,11 @@ class OpenAIPreprocessor(Operator):
             else:
                 raise TypeError(f"unsupported request type {type(request)}")
             span.set_attr("prompt_tokens", len(pre.token_ids))
+        # accumulates onto the frontend's body-parse stamp: the pipeline
+        # runs this lazily inside the first __anext__, so without the
+        # stamp the template render + tokenize would masquerade as
+        # first-chunk priming in the host-cost ledger
+        note_stage(context.id, "preprocess", time.monotonic() - t_pre)
         # OpenAI semantics: non-streaming responses ALWAYS carry usage;
         # streaming only includes it with stream_options.include_usage
         include_usage = not request.stream or bool(
@@ -318,7 +326,12 @@ class OpenAIPreprocessor(Operator):
                     )
             if use_tools:
                 if item.text:
-                    for chunk in tool_chunks(idx, tool_parser(idx).feed(item.text)):
+                    t_tp = time.monotonic()
+                    events = tool_parser(idx).feed(item.text)
+                    note_stage(
+                        context.id, "tool_parser", time.monotonic() - t_tp
+                    )
+                    for chunk in tool_chunks(idx, events):
                         yield chunk
             elif item.text or lp_payload:
                 yield gen.text_chunk(
@@ -328,7 +341,12 @@ class OpenAIPreprocessor(Operator):
                 reason = item.finish_reason
                 if use_tools:
                     p = tool_parser(idx)
-                    for chunk in tool_chunks(idx, p.finish()):
+                    t_tp = time.monotonic()
+                    events = p.finish()
+                    note_stage(
+                        context.id, "tool_parser", time.monotonic() - t_tp
+                    )
+                    for chunk in tool_chunks(idx, events):
                         yield chunk
                     reason_str = (
                         reason.value

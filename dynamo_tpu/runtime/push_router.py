@@ -23,6 +23,7 @@ import asyncio
 import enum
 import logging
 import random
+import time
 from typing import Any, AsyncIterator, Awaitable, Callable, Optional
 
 from dynamo_tpu.runtime.component import Client
@@ -128,6 +129,9 @@ class PushRouter(AsyncEngine):
             context.set_trace(span)
 
         async def dial(req, exclude, resume, wait_timeout_s):
+            from dynamo_tpu.telemetry.hostplane import note_stage
+
+            t_dial = time.monotonic()
             instance_id = await self._pick(req, exclude, wait_timeout_s)
             try:
                 stream = await self.client.generate_direct(
@@ -137,6 +141,10 @@ class PushRouter(AsyncEngine):
                 # worker vanished between discovery and dial: carry the
                 # id out so the retry excludes it
                 raise DialFailedError(instance_id, exc) from exc
+            finally:
+                # host-cost ledger: instance pick + dial (accumulates
+                # across migration re-dials — re-dispatch is host cost)
+                note_stage(context.id, "dispatch", time.monotonic() - t_dial)
             return instance_id, stream, None
 
         try:

@@ -44,6 +44,15 @@ from dynamo_tpu.telemetry.attribution import (  # noqa: F401
     unregister_attribution_provider,
 )
 from dynamo_tpu.telemetry.hbm import HbmAccountant, tree_bytes  # noqa: F401
+from dynamo_tpu.telemetry.hostplane import (  # noqa: F401
+    HostCostLedger,
+    LoopLagMonitor,
+    collect_hostplane,
+    note_stage,
+    register_hostplane_provider,
+    task_census,
+    unregister_hostplane_provider,
+)
 from dynamo_tpu.telemetry.overlap import OverlapTracker  # noqa: F401
 from dynamo_tpu.telemetry.recorder import FlightRecorder  # noqa: F401
 from dynamo_tpu.telemetry.slo import SloConfig, SloTracker  # noqa: F401

@@ -37,6 +37,76 @@ HTTP_TTFT = REGISTRY.histogram(
     labels=("model",),
 )
 
+# -- host data plane (telemetry/hostplane.py; docs/observability.md
+# "Host data plane") — the frontend's event-loop lag monitor and the
+# per-stream host-cost ledger. Lag buckets are scheduling-tax shaped
+# (sub-ms healthy loop up to the multi-second stall a watchdog dump
+# should already have explained).
+_LAG_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, float("inf"),
+)
+HTTP_LOOP_LAG = REGISTRY.histogram(
+    "dynamo_http_loop_lag_seconds",
+    "Event-loop scheduling lag measured by the hostplane heartbeat "
+    "(how late the loop ran a task that asked to wake on a fixed "
+    "interval — every concurrent stream waits at least this long)",
+    buckets=_LAG_BUCKETS,
+)
+HTTP_LOOP_LAG_P99 = REGISTRY.gauge(
+    "dynamo_http_loop_lag_p99_seconds",
+    "p99 event-loop lag over the heartbeat's rolling window",
+)
+HTTP_LOOP_LAG_MAX = REGISTRY.gauge(
+    "dynamo_http_loop_lag_max_seconds",
+    "Max event-loop lag over the heartbeat's rolling window",
+)
+HTTP_LOOP_STALLS = REGISTRY.counter(
+    "dynamo_http_loop_stalls_total",
+    "Heartbeat wakes later than the stall threshold — some callback "
+    "held the loop synchronously; each (rate-limited) stall also dumps "
+    "the flight recorder and a black-box bundle with reason loop_stall",
+)
+HTTP_OPEN_STREAMS = REGISTRY.gauge(
+    "dynamo_http_open_streams",
+    "SSE streams currently open on this frontend",
+)
+HTTP_HOST_STAGE = REGISTRY.histogram(
+    "dynamo_http_host_stage_seconds",
+    "Per-request host-plane stage cost stamped by the cost ledger "
+    "(preprocess = parse/validate/tokenize, admission, dispatch = "
+    "router/engine handoff, prime = wait for the engine's first "
+    "chunk, tool_parser = streaming tool-call delta parsing)",
+    labels=("stage",),  # preprocess | admission | dispatch | prime | tool_parser
+    buckets=_LAG_BUCKETS,
+)
+HTTP_FIRST_CHUNK_WAIT = REGISTRY.histogram(
+    "dynamo_http_first_chunk_wait_seconds",
+    "Frontend's wait for the engine's FIRST chunk (first-chunk "
+    "priming): the engine-side share of TTFB — compare with "
+    "dynamo_http_time_to_first_token_seconds to split host stall "
+    "from chip stall",
+    buckets=(
+        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+        1.0, 2.5, 5.0, 15.0, 60.0, float("inf"),
+    ),
+)
+HTTP_SSE_WRITE_EMA = REGISTRY.gauge(
+    "dynamo_http_sse_write_ema_seconds",
+    "EMA of per-chunk SSE serialize+write cost across all streams "
+    "(an EMA, not a per-chunk series: thousands of streams x hundreds "
+    "of chunks must not mint histogram samples)",
+)
+HTTP_DRAIN_WAIT = REGISTRY.histogram(
+    "dynamo_http_drain_wait_seconds",
+    "Per-stream total time resp.write() spent awaiting transport "
+    "drain (write backpressure: slow clients eating loop time)",
+    buckets=(
+        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+        1.0, 2.5, 5.0, 15.0, 60.0, float("inf"),
+    ),
+)
+
 # -- engine (scheduler + step loop; the instruments ISSUE 2 calls out) ------
 _STEP_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,

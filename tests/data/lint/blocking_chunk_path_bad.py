@@ -1,0 +1,34 @@
+"""Violating fixture for blocking-work-in-chunk-path (DL013): the SSE
+writer loop doing heavyweight per-chunk work — every call here runs
+once per delta for every open stream on ONE event loop, so each is
+multiplied by streams × chunks at the fan-out ceiling."""
+
+import json
+import time
+
+
+async def _stream_sse(resp, stream, tokenizer, state):
+    history = []
+    async for chunk in stream:
+        history.append(chunk)
+        payload = json.dumps(history)  # VIOLATION: whole-aggregate dump per delta
+        text = tokenizer.decode(state.all_token_ids)  # VIOLATION: re-decodes history
+        time.sleep(0.0005)  # VIOLATION: sync sleep parks the whole loop
+        open("/tmp/sse.log", "a").write(text)  # VIOLATION: sync file op per chunk
+        await resp.write(payload.encode())
+
+
+def sse_write_pump(sock, chunks, agg):
+    for c in chunks:
+        sock.sendall(json.dumps(agg).encode())  # VIOLATION: sync socket send
+        # (the json.dumps above is flagged separately — two findings on
+        # one line: aggregate serialization AND a blocking socket op)
+
+
+async def _stream_sse_tools(resp, stream, agg):
+    async for chunk in stream:
+        def render():
+            # a helper defined in the loop still runs per chunk
+            return json.dumps(agg)  # VIOLATION: aggregate dump in loop helper
+
+        await resp.write(render().encode())

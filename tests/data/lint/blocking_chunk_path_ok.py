@@ -1,0 +1,25 @@
+"""Clean fixture for blocking-work-in-chunk-path (DL013): the SSE
+writer loop serializes only the DELTA per chunk and does its one-shot
+work before the loop starts; the aggregate render happens once, after
+the stream completes. (Also exercised against every other rule — clean
+fixtures must be clean, period.)"""
+
+import json
+
+
+def encode_delta(chunk):
+    # delta-only serializer (the encode_sse idiom): the per-chunk cost
+    # is proportional to the DELTA, not the stream so far
+    return f"data: {json.dumps(chunk)}\n\n"
+
+
+async def _stream_sse(resp, stream, tokenizer):
+    # one-shot priming work BEFORE the loop is not per-chunk cost
+    header = json.dumps({"object": "chat.completion.chunk"})
+    await resp.write(header.encode())
+    chunks = []
+    async for chunk in stream:
+        chunks.append(chunk)
+        await resp.write(encode_delta(chunk).encode())
+    # aggregate serialization happens ONCE, after the stream drained
+    await resp.write(json.dumps({"chunks": len(chunks)}).encode())

@@ -34,6 +34,7 @@ CASES = [
     ("wall-clock-in-control-loop", "wall_clock_in_control_loop", 6),
     ("hidden-host-sync-in-step-loop", "hidden_host_sync", 6),
     ("unclosed-span", "unclosed_span", 5),
+    ("blocking-work-in-chunk-path", "blocking_chunk_path", 7),
 ]
 
 
@@ -341,7 +342,7 @@ def test_syntax_error_becomes_parse_finding():
 
 def test_rule_catalog_metadata():
     rules = all_rules()
-    assert len(rules) == 11
+    assert len(rules) == 12
     codes = [r.code for r in rules]
     assert codes == sorted(codes) and len(set(codes)) == len(codes)
     assert all(r.name == r.name.lower() and " " not in r.name for r in rules)

@@ -23,6 +23,7 @@ _INSTRUMENTED_MODULES = [
     "dynamo_tpu.telemetry.slo",
     "dynamo_tpu.telemetry.hbm",
     "dynamo_tpu.telemetry.attribution",
+    "dynamo_tpu.telemetry.hostplane",
     "dynamo_tpu.http.service",
     "dynamo_tpu.metrics.service",
     "dynamo_tpu.disagg.worker",
@@ -79,6 +80,16 @@ _REQUIRED_SERIES = [
     "dynamo_guided_cache_events_total",
     "dynamo_guided_requests_total",
     "dynamo_tool_call_streams_total",
+    # ISSUE 17: the host data plane (telemetry/hostplane.py)
+    "dynamo_http_loop_lag_seconds",
+    "dynamo_http_loop_lag_p99_seconds",
+    "dynamo_http_loop_lag_max_seconds",
+    "dynamo_http_loop_stalls_total",
+    "dynamo_http_open_streams",
+    "dynamo_http_host_stage_seconds",
+    "dynamo_http_first_chunk_wait_seconds",
+    "dynamo_http_sse_write_ema_seconds",
+    "dynamo_http_drain_wait_seconds",
 ]
 
 
@@ -163,6 +174,13 @@ def test_observability_series_are_registered():
     assert REGISTRY.get("dynamo_tool_call_streams_total").label_names == (
         "mode",
     )
+    # the host-stage histogram keys on the fixed ledger stage set
+    assert REGISTRY.get("dynamo_http_host_stage_seconds").label_names == (
+        "stage",
+    )
+    assert REGISTRY.get("dynamo_http_loop_lag_seconds").label_names == ()
+    assert REGISTRY.get("dynamo_http_loop_stalls_total").label_names == ()
+    assert REGISTRY.get("dynamo_http_open_streams").label_names == ()
 
 
 def test_metric_catalog_docs_match_registry():
