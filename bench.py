@@ -93,6 +93,19 @@ profile (exit 1 regression / exit 2 missing profile; ``--quick``,
 ``--update-baseline``, DYN_SENTINEL_REPORT as with ``--sentinel``).
 docs/observability.md "Host data plane" is the reading guide.
 
+``--kvfleet`` is the fleet KV fabric A/B (docs/kvbm.md "Fleet
+fabric"; no accelerator, no jax): the canned diurnal trace with
+Zipf-popular shared prefix families replays through the fleet
+simulator twice — fabric off (every prompt reprefills its shared head)
+and fabric on (catalog hits fetch it from a peer's host tier or the
+shared bucket) — and reports the fleet prefix hit rate plus the
+fraction of the recompute bill avoided, as ``kvfleet_hit_rate`` /
+``kvfleet_reprefill_avoided`` JSON lines gated against the committed
+``cpu-kvfleet-*`` baseline profile (exit 1 regression / exit 2 missing
+profile; ``--quick``, ``--update-baseline``, DYN_SENTINEL_REPORT as
+with ``--sentinel``). Knobs: DYN_BENCH_KVFLEET_DURATION /
+DYN_BENCH_KVFLEET_SEED.
+
 ``--overlap`` is the serial-vs-overlap A/B (docs/performance.md): the
 same workload at decode_steps=1 runs once with --no-overlap (fully
 serial plan -> dispatch -> sync -> emit) and once with the overlapped
@@ -1171,6 +1184,186 @@ def _main_sim() -> None:
     )
 
 
+def _kvfleet_compare(measured: dict, base: dict) -> dict:
+    """Pure comparison for the kvfleet sentinel (unit-tested without a
+    sim run): measured ``{"hit_rate", "avoided_frac"}`` vs a baseline
+    entry with an explicit ``noise_frac``. Either headline falling
+    below its floor is a regression; a zero hit rate or a recompute
+    bill that did NOT shrink with the fabric on is an unconditional
+    regression — the A/B invariant holds regardless of how wide the
+    noise band is."""
+    noise = float(base.get("noise_frac", 0.25))
+    hit_floor = base["hit_rate"] * (1.0 - noise)
+    avoided_floor = base["avoided_frac"] * (1.0 - noise)
+    return {
+        "regressed": (
+            measured["hit_rate"] <= 0.0
+            or measured["avoided_frac"] <= 0.0
+            or measured["hit_rate"] < hit_floor
+            or measured["avoided_frac"] < avoided_floor
+        ),
+        "hit_rate": round(measured["hit_rate"], 4),
+        "baseline_hit_rate": base["hit_rate"],
+        "floor_hit_rate": round(hit_floor, 4),
+        "avoided_frac": round(measured["avoided_frac"], 4),
+        "baseline_avoided_frac": base["avoided_frac"],
+        "floor_avoided_frac": round(avoided_floor, 4),
+        "noise_frac": noise,
+    }
+
+
+def _main_kvfleet() -> None:
+    """--kvfleet: the fleet KV fabric A/B, pure host-side discrete-event
+    run — no jax, no chip (docs/kvbm.md "Fleet fabric").
+
+    The canned diurnal trace with Zipf-popular shared prefix families
+    (sim/traces.py PrefixModel: a few giant system prompts dominate)
+    replays through FleetSim twice: fabric off, where every request
+    reprefills its shared head, and fabric on, where catalog hits fetch
+    it at peer/bucket rate instead. Headlines:
+
+    - ``kvfleet_hit_rate`` — fleet prefix hit rate over requests that
+      carry a shared prefix;
+    - ``kvfleet_reprefill_avoided`` — the fraction of the fabric-off
+      recompute bill (prefilled tokens) the fabric removed.
+
+    Both gate against the committed ``cpu-kvfleet-quick``/``-full``
+    profile in BENCH_BASELINE.json (exit 1 regression / exit 2 missing
+    profile; ``--update-baseline`` seeds; DYN_SENTINEL_REPORT writes
+    the CI artifact). The determinism of the sim makes the noise band
+    narrow by construction — the band absorbs deliberate model
+    retuning, not run-to-run jitter."""
+    from dynamo_tpu.sim import FleetSim, SimConfig, diurnal_trace
+    from dynamo_tpu.sim.traces import PrefixModel
+
+    argv = sys.argv[1:]
+    quick = "--quick" in argv
+    seed = int(os.environ.get("DYN_BENCH_KVFLEET_SEED", "7"))
+    duration = float(os.environ.get(
+        "DYN_BENCH_KVFLEET_DURATION", "300" if quick else "1200"
+    ))
+    trace = diurnal_trace(
+        duration, seed, base_rps=8.0, peak_rps=24.0, period_s=duration,
+        prefixes=PrefixModel(),
+    )
+
+    def run_one(fabric: bool) -> dict:
+        cfg = SimConfig(
+            initial_decode=4, initial_prefill=1, max_queue_depth=200,
+            fabric=fabric,
+        )
+        return FleetSim(trace, cfg).run()["fabric"]
+
+    off = run_one(fabric=False)
+    on = run_one(fabric=True)
+    hit_rate = on["fleet_hit_rate"]
+    avoided = on["reprefill_tokens_avoided"]
+    avoided_frac = avoided / max(1, off["prefilled_tokens"])
+    measured = {"hit_rate": hit_rate, "avoided_frac": avoided_frac}
+
+    # -- sentinel gate (same discipline as --sentinel / --fanout) ---------
+    path = _sentinel_baseline_path()
+    if "--baseline" in argv:
+        i = argv.index("--baseline") + 1
+        if i >= len(argv) or argv[i].startswith("--"):
+            raise SystemExit("--baseline requires a path argument")
+        path = argv[i]
+    key = f"cpu-kvfleet-{'quick' if quick else 'full'}"
+    baselines: dict = {"profiles": {}}
+    if os.path.exists(path):
+        with open(path) as f:
+            baselines = json.load(f)
+    if "--update-baseline" in argv:
+        baselines.setdefault("profiles", {})[key] = {
+            "hit_rate": round(hit_rate, 4),
+            "avoided_frac": round(avoided_frac, 4),
+            # the sim is deterministic; the band exists for deliberate
+            # trace/model retuning, not machine noise
+            "noise_frac": 0.25,
+        }
+        with open(path, "w") as f:
+            json.dump(baselines, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"# kvfleet: baseline profile {key!r} written to {path}",
+              file=sys.stderr)
+    base = (baselines.get("profiles") or {}).get(key)
+    config = {
+        "profile": key,
+        "baseline_path": path,
+        "seed": seed,
+        "duration_s": duration,
+        "trace_requests": len(trace),
+        "prefix_requests": on["prefix_requests"],
+        "fleet_hits_host": on["fleet_hits_host"],
+        "fleet_hits_bucket": on["fleet_hits_bucket"],
+        "publishes": on["publishes"],
+        "demoted_bucket": on["demoted_bucket"],
+        "demoted_dropped": on["demoted_dropped"],
+        "prefilled_tokens_off": off["prefilled_tokens"],
+        "prefilled_tokens_on": on["prefilled_tokens"],
+        "reprefill_tokens_avoided": avoided,
+    }
+    if base is None:
+        print(json.dumps({
+            "metric": "kvfleet_hit_rate", "value": round(hit_rate, 4),
+            "unit": "fraction", "vs_baseline": 0.0,
+            "config": {"error": f"no baseline profile {key!r} in {path}",
+                       "hint": "run with --update-baseline and commit"},
+        }))
+        print(json.dumps({
+            "metric": "kvfleet_reprefill_avoided",
+            "value": round(avoided_frac, 4),
+            "unit": "fraction_of_prefill_bill", "vs_baseline": 0.0,
+            "config": {"error": f"no baseline profile {key!r} in {path}"},
+        }))
+        sys.exit(2)
+    verdict = _kvfleet_compare(measured, base)
+    out_hits = {
+        "metric": "kvfleet_hit_rate",
+        "value": round(hit_rate, 4),
+        "unit": "fraction",
+        "vs_baseline": round(hit_rate / max(base["hit_rate"], 1e-9), 4),
+        "config": {**config, **verdict},
+    }
+    out_avoided = {
+        "metric": "kvfleet_reprefill_avoided",
+        "value": round(avoided_frac, 4),
+        "unit": "fraction_of_prefill_bill",
+        "vs_baseline": round(
+            avoided_frac / max(base["avoided_frac"], 1e-9), 4
+        ),
+        "config": {"profile": key, **verdict},
+    }
+    print(json.dumps(out_hits))
+    print(json.dumps(out_avoided))
+    report_path = os.environ.get("DYN_SENTINEL_REPORT")
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump(
+                {"hit_rate": out_hits, "avoided": out_avoided},
+                f, indent=2,
+            )
+            f.write("\n")
+    if verdict["regressed"]:
+        print(
+            f"# KVFLEET REGRESSION: hit_rate {verdict['hit_rate']} "
+            f"(floor {verdict['floor_hit_rate']}) avoided_frac "
+            f"{verdict['avoided_frac']} (floor "
+            f"{verdict['floor_avoided_frac']}) vs baseline "
+            f"hit_rate={base['hit_rate']} "
+            f"avoided_frac={base['avoided_frac']} "
+            f"-{verdict['noise_frac']:.0%}",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    print(
+        f"# kvfleet OK: hit_rate {hit_rate:.3f}, "
+        f"{avoided} reprefill tokens avoided "
+        f"({avoided_frac:.1%} of the bill, {key})",
+        file=sys.stderr,
+    )
+
+
 def _fanout_compare(measured: dict, base: dict) -> dict:
     """Pure comparison for the fan-out sentinel (unit-tested without a
     server): measured ``{"rps", "streams"}`` vs a baseline entry with an
@@ -1693,6 +1886,9 @@ def main() -> None:
         return
     if "--fanout" in sys.argv[1:]:
         _main_fanout()  # frontend host-plane ceiling: no jax, no chip
+        return
+    if "--kvfleet" in sys.argv[1:]:
+        _main_kvfleet()  # fleet KV fabric A/B: no jax, no chip
         return
     cpu_mode = os.environ.get("DYN_BENCH_PLATFORM") == "cpu"
     if cpu_mode:

@@ -167,6 +167,7 @@ class HttpService:
                 web.get("/debug/state", self._debug_state),
                 web.get("/debug/attribution", self._debug_attribution),
                 web.get("/debug/hostplane", self._debug_hostplane),
+                web.get("/debug/kvfleet", self._debug_kvfleet),
                 web.get("/debug/profile", self._debug_profile),
                 web.get("/v1/models", self._models),
                 web.post("/v1/chat/completions", self._chat),
@@ -252,6 +253,18 @@ class HttpService:
         The provider refreshes the loop-lag gauges, so a /metrics
         scrape next to this endpoint describes the same window."""
         return web.json_response(collect_hostplane())
+
+    async def _debug_kvfleet(self, request: web.Request) -> web.Response:
+        """Fleet KV fabric introspection (docs/kvbm.md "Fleet fabric"):
+        the ``kvfleet:*`` provider stanzas only — per-fabric catalog
+        view size, fleet hit/fetch/demotion counters, current pressure
+        scale and host-tier residency. Empty when no fabric is attached
+        in this process (e.g. a pure frontend)."""
+        state = collect_debug_state()
+        fleet = {
+            k: v for k, v in state.items() if k.startswith("kvfleet")
+        }
+        return web.json_response(fleet)
 
     async def _debug_profile(self, request: web.Request) -> web.Response:
         """On-demand ``jax.profiler`` capture: ``/debug/profile?ms=N``

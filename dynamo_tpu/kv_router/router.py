@@ -41,6 +41,13 @@ class KvRouter:
         self.scheduler = KvScheduler(self.indexer, self.aggregator)
         self._prune_task: Optional[asyncio.Task] = None
 
+    def attach_fleet_catalog(self, catalog: Any) -> None:
+        """Score fleet-fetchable prefixes (kvbm/fabric.py
+        FleetPrefixCatalog) at the discounted fetch weight: blocks any
+        candidate can onboard from a peer's host tier or the shared
+        bucket stop reading as 'only worker A is cache-hot'."""
+        self.scheduler.fleet_catalog = catalog
+
     @classmethod
     async def create(
         cls, component: Component, client: Client, block_size: int = 16

@@ -13,10 +13,11 @@ import logging
 import random
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 from dynamo_tpu.kv_router.indexer import KvIndexer, OverlapScores
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvHitRateEvent
+from dynamo_tpu.tokens import hash_sequence
 
 log = logging.getLogger("dynamo_tpu.kv_router.scheduler")
 
@@ -111,6 +112,10 @@ class SchedulingDecision:
     # charge, not some later request's (ADVICE r5: anonymous pops under
     # bursts released the wrong entry)
     dispatch_token: float = 0.0
+    # leading blocks fetchable from the fleet KV fabric (peer host tier
+    # or shared bucket) — 0 when no catalog is attached. Informational:
+    # the logit already counted them at the discounted fetch weight.
+    fleet_blocks: int = 0
 
     @property
     def prefix_hit_rate(self) -> float:
@@ -127,11 +132,17 @@ class KvScheduler:
         aggregator: KvMetricsAggregator,
         selector: Selector = default_selector,
         on_hit_rate: Optional[Callable[[KvHitRateEvent], None]] = None,
+        fleet_catalog: Optional[Any] = None,
     ):
         self.indexer = indexer
         self.aggregator = aggregator
         self.selector = selector
         self.on_hit_rate = on_hit_rate
+        # fleet KV fabric catalog (kvbm/fabric.py FleetPrefixCatalog, or
+        # anything with match_prefix(seq_hashes) -> int): blocks any
+        # candidate can fetch from a peer's host tier / the shared
+        # bucket instead of recomputing. Counted at fleet_hit_weight.
+        self.fleet_catalog = fleet_catalog
         # optimistic in-flight accounting: published metrics lag by a
         # publish interval, so a BURST of concurrent no-overlap requests
         # would all see identical zero-load snapshots and (modulo the
@@ -201,6 +212,32 @@ class KvScheduler:
     # selector sees, so custom selectors keep their 3-arg signature.
     resume_overlap_boost: float = 2.0
 
+    # discount for fleet-fetchable blocks in the overlap term: a fetch
+    # from a peer's host tier / the shared bucket is far cheaper than
+    # recompute but dearer than a local (G1/G2) hit. Fleet blocks count
+    # for every candidate (any worker can fetch them), which NARROWS the
+    # local-overlap worker's advantage to 2*(1-w)*blocks of logit — the
+    # router stops thrash-pinning a loaded worker for a prefix the whole
+    # fleet can onboard. Must stay < 1.0: a fleet hit must never score
+    # at local weight, including under the resume boost (the boost
+    # multiplies AFTER this discount, so a resume racing a demotion sees
+    # boost*w*blocks, not boost*blocks).
+    fleet_hit_weight: float = 0.35
+
+    def _fleet_match(self, token_ids: list[int]) -> int:
+        """Leading blocks fetchable from the fleet fabric (catalog
+        membership only — no network). Never raises into routing."""
+        if self.fleet_catalog is None:
+            return 0
+        try:
+            _, seq_hashes = hash_sequence(
+                list(token_ids), self.indexer.block_size
+            )
+            return int(self.fleet_catalog.match_prefix(seq_hashes))
+        except Exception:
+            log.exception("fleet catalog match failed; scoring local-only")
+            return 0
+
     def schedule(
         self, token_ids: list[int], candidates: list[int],
         resume: bool = False,
@@ -209,12 +246,29 @@ class KvScheduler:
             raise RuntimeError("no candidate workers")
         overlaps = self.indexer.find_matches_for_request(token_ids)
         true_overlaps = overlaps
-        if resume and overlaps.scores:
+        fleet_blocks = self._fleet_match(token_ids)
+        if fleet_blocks or (resume and overlaps.scores):
+            boost = self.resume_overlap_boost if resume else 1.0
+            # effective overlap per candidate: local blocks at full
+            # weight + the fleet-fetchable extension at fetch weight.
+            # The resume boost scales the COMBINED score, so the fleet
+            # contribution stays discounted (satellite guarantee: a
+            # resume whose prefix was just demoted off every device
+            # scores boost*fleet_hit_weight*blocks, never at local
+            # weight as if the blocks were still resident).
+            # OverlapScores is sparse (absent = 0): only workers with a
+            # non-zero effective overlap get an entry, so a resume with
+            # no fleet catalog scores exactly as before.
+            scores = {}
+            for w in set(candidates) | set(overlaps.scores):
+                local = overlaps.scores.get(w, 0)
+                eff = local + self.fleet_hit_weight * max(
+                    0, fleet_blocks - local
+                )
+                if eff:
+                    scores[w] = boost * eff
             overlaps = OverlapScores(
-                scores={
-                    w: s * self.resume_overlap_boost
-                    for w, s in overlaps.scores.items()
-                },
+                scores=scores,
                 total_blocks=overlaps.total_blocks,
             )
         fresh = self.aggregator.fresh_metrics()
@@ -247,6 +301,7 @@ class KvScheduler:
             overlap_blocks=true_overlaps.scores.get(wid, 0),
             total_blocks=true_overlaps.total_blocks,
             dispatch_token=token,
+            fleet_blocks=fleet_blocks,
         )
         if self.on_hit_rate is not None:
             self.on_hit_rate(

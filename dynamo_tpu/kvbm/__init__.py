@@ -1,9 +1,20 @@
 """Multi-tier KV block manager (G1 HBM / G2 host / G3 disk / G4 remote).
 
 Reference: lib/llm/src/block_manager/ (KvBlockManager, tier pools,
-layouts, offload manager). See manager.py for the TPU-native design.
+layouts, offload manager). See manager.py for the TPU-native design and
+fabric.py for the fleet-wide catalog + peer-onboarding plane.
 """
 
+from dynamo_tpu.kvbm.fabric import (
+    DictCatalogBackend,
+    FleetKvFabric,
+    FleetPrefixCatalog,
+    LocalPeerRegistry,
+    PeerBlockServer,
+    PressureConfig,
+    StoreCatalogBackend,
+    TcpPeerClient,
+)
 from dynamo_tpu.kvbm.layout import BlockLayout
 from dynamo_tpu.kvbm.manager import KvbmConfig, KvbmStats, KvBlockManager
 from dynamo_tpu.kvbm.pool import TierPool
@@ -24,4 +35,12 @@ __all__ = [
     "DiskBlockStorage",
     "HostBlockStorage",
     "NullBlockStorage",
+    "DictCatalogBackend",
+    "FleetKvFabric",
+    "FleetPrefixCatalog",
+    "LocalPeerRegistry",
+    "PeerBlockServer",
+    "PressureConfig",
+    "StoreCatalogBackend",
+    "TcpPeerClient",
 ]

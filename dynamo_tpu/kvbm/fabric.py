@@ -1,0 +1,833 @@
+"""Fleet KV fabric: cross-worker prefix sharing + pressure-driven tiers.
+
+The single-worker KVBM (manager.py) gives one engine a G1→G2→G3/G4
+offload ladder. This module makes the ladder *fleet-wide* (ROADMAP item
+3; reference: block_manager.rs G1–G4 + NIXL transfer, offload.rs):
+
+- **Fleet prefix catalog** — content-addressed block-chain hashes (the
+  same chained sequence hashes ``tokens.py`` mints and the kv_router's
+  radix indexer keys on) mapped to ``(worker, tier, bytes, last_touch)``
+  locations, kept in the coordinator store. Each worker's KVBM publishes
+  when ``pump()`` lands blocks in G2 and prunes on eviction, so the
+  catalog is the fleet's always-current "who holds which prefix" map.
+- **Peer onboarding** — at admission, prompt blocks that miss every
+  local tier but hit the catalog are fetched from the owning peer's
+  host tier over the store wire plane (``store/wire.py`` framing) or
+  adopted from the shared G4 object bucket, then onboarded through the
+  existing jitted scatter. A system prompt is prefilled ONCE fleet-wide.
+- **Pressure-driven lifecycle** — host-pool watermarks drive G2→G3/G4
+  demotion with popularity-weighted victim selection: hot shared
+  prefixes demote to the *shared* G4 bucket (they outlive their owner),
+  cold private ones to local disk. The planner's degradation ladder
+  tightens the same watermark (the "demote cold KV" rung,
+  ``LadderPolicy.fabric_pressure_scale``).
+
+Thread contract: every fabric method the KVBM calls (`on_host_insert`,
+``prefetch``, ``enforce_pressure``) runs on the ENGINE thread, exactly
+like the manager itself. The peer block server runs on the event loop
+and reads the host tier through ``KvBlockManager.export_host_blocks``,
+which shares a lock with the engine-thread mutation paths.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import json
+import logging
+import socket
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import msgpack
+
+from dynamo_tpu.telemetry.debug import (
+    register_debug_provider,
+    unregister_debug_provider,
+)
+from dynamo_tpu.telemetry.instruments import (
+    KVBM_FLEET_CATALOG_ENTRIES,
+    KVBM_FLEET_DANGLING,
+    KVBM_FLEET_DEMOTED_BLOCKS,
+    KVBM_FLEET_FETCH_SECONDS,
+    KVBM_FLEET_FETCHED_BLOCKS,
+    KVBM_FLEET_HITS,
+)
+from dynamo_tpu.utils.clock import SYSTEM, Clock
+
+log = logging.getLogger("dynamo_tpu.kvbm.fabric")
+
+# tier names as published in the catalog. Only g2 (peer host tier) and
+# g4 (shared object bucket) are fleet-fetchable; g3 (a worker's local
+# disk) is private and exists in the catalog only so the owner's own
+# restarts and the debug surface can see it.
+TIER_HOST = "g2"
+TIER_DISK = "g3"
+TIER_SHARED = "g4"
+FLEET_TIERS = (TIER_HOST, TIER_SHARED)
+
+
+# ---------------------------------------------------------------------------
+# Catalog backends
+# ---------------------------------------------------------------------------
+
+
+class CatalogBackend(abc.ABC):
+    """Blocking catalog transport (the engine thread owns the pump that
+    publishes; same sync-facade pattern as SyncObjectStore)."""
+
+    @abc.abstractmethod
+    def put(self, seq_hash: int, worker_id: int, entry: dict) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, seq_hash: int, worker_id: int) -> None: ...
+
+    @abc.abstractmethod
+    def snapshot(self) -> dict[int, dict[int, dict]]:
+        """Full catalog view: seq_hash -> worker_id -> entry."""
+
+    def put_many(self, items: list[tuple[int, int, dict]]) -> None:
+        for h, w, e in items:
+            self.put(h, w, e)
+
+
+class DictCatalogBackend(CatalogBackend):
+    """In-process shared catalog for tests and single-process fleets
+    (every worker of the process holds the same instance)."""
+
+    def __init__(self) -> None:
+        self._data: dict[int, dict[int, dict]] = {}
+        self._lock = threading.Lock()
+
+    def put(self, seq_hash: int, worker_id: int, entry: dict) -> None:
+        with self._lock:
+            self._data.setdefault(seq_hash, {})[worker_id] = dict(entry)
+
+    def delete(self, seq_hash: int, worker_id: int) -> None:
+        with self._lock:
+            owners = self._data.get(seq_hash)
+            if owners is not None:
+                owners.pop(worker_id, None)
+                if not owners:
+                    self._data.pop(seq_hash, None)
+
+    def snapshot(self) -> dict[int, dict[int, dict]]:
+        with self._lock:
+            return {
+                h: {w: dict(e) for w, e in owners.items()}
+                for h, owners in self._data.items()
+            }
+
+
+def catalog_key_prefix(namespace: str) -> str:
+    return f"{namespace}/kvfleet/catalog/"
+
+
+class StoreCatalogBackend(CatalogBackend):
+    """Catalog in the coordinator store's KV plane.
+
+    Keys: ``{namespace}/kvfleet/catalog/{seq_hash:016x}/{worker_id}``,
+    values: JSON entries — small enough that a full-prefix snapshot is
+    one round trip, and a worker's keys can ride its lease so a dead
+    worker's G2 claims vanish with it.
+
+    Blocking bridge onto the runtime's loop with the SAME timeout
+    surfacing as the G4 object adapter (kvbm/remote.py): a store
+    timeout books ``dynamo_kvbm_remote_timeout_total{op=catalog}`` and
+    a flight-recorder record instead of killing the engine pump.
+    """
+
+    def __init__(
+        self,
+        store: Any,
+        namespace: str,
+        loop: asyncio.AbstractEventLoop,
+        timeout_s: float = 10.0,
+        lease_id: int = 0,
+        recorder: Any = None,
+    ):
+        self.store = store
+        self.prefix = catalog_key_prefix(namespace)
+        self.loop = loop
+        self.timeout_s = timeout_s
+        self.lease_id = lease_id
+        self.recorder = recorder
+
+    def _key(self, seq_hash: int, worker_id: int) -> str:
+        return f"{self.prefix}{seq_hash:016x}/{worker_id}"
+
+    def _run(self, coro, op: str):
+        from dynamo_tpu.kvbm.remote import run_on_loop
+
+        return run_on_loop(
+            coro, self.loop, self.timeout_s, op=f"catalog.{op}",
+            recorder=self.recorder,
+        )
+
+    def put(self, seq_hash: int, worker_id: int, entry: dict) -> None:
+        self._run(
+            self.store.kv_put(
+                self._key(seq_hash, worker_id),
+                json.dumps(entry).encode(),
+                self.lease_id,
+            ),
+            "put",
+        )
+
+    def put_many(self, items: list[tuple[int, int, dict]]) -> None:
+        if not items:
+            return
+
+        async def gather():
+            await asyncio.gather(
+                *[
+                    self.store.kv_put(
+                        self._key(h, w), json.dumps(e).encode(), self.lease_id
+                    )
+                    for h, w, e in items
+                ]
+            )
+
+        self._run(gather(), "put_many")
+
+    def delete(self, seq_hash: int, worker_id: int) -> None:
+        self._run(self.store.kv_delete(self._key(seq_hash, worker_id)), "delete")
+
+    def snapshot(self) -> dict[int, dict[int, dict]]:
+        entries = self._run(self.store.kv_get_prefix(self.prefix), "snapshot")
+        out: dict[int, dict[int, dict]] = {}
+        for e in entries:
+            tail = e.key[len(self.prefix):]
+            try:
+                hash_part, worker_part = tail.split("/", 1)
+                h = int(hash_part, 16)
+                w = int(worker_part)
+                entry = json.loads(e.value)
+            except (ValueError, json.JSONDecodeError):
+                log.warning("malformed catalog key/value: %r", e.key)
+                continue
+            out.setdefault(h, {})[w] = entry
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Fleet prefix catalog (local view + publisher)
+# ---------------------------------------------------------------------------
+
+
+class FleetPrefixCatalog:
+    """One participant's view of the fleet catalog.
+
+    Workers publish/prune through the backend as their G2 tier changes;
+    everyone (workers prefetching, the KV router scoring fleet hits)
+    reads through ``match_prefix``/``locations`` against a locally
+    cached snapshot refreshed by ``refresh()`` — membership checks stay
+    off the network, exactly like the G4 tier's local index.
+    """
+
+    def __init__(
+        self,
+        backend: CatalogBackend,
+        worker_id: int = -1,
+        clock: Optional[Clock] = None,
+    ):
+        self.backend = backend
+        self.worker_id = worker_id
+        self.clock = clock or SYSTEM
+        self._view: dict[int, dict[int, dict]] = {}
+
+    # -- publishing (engine thread of the owning worker) -------------------
+    def publish(
+        self, seq_hash: int, tier: str, nbytes: int, addr: str = ""
+    ) -> None:
+        entry = {
+            "tier": tier,
+            "bytes": int(nbytes),
+            "t": self.clock.time(),
+            "addr": addr,
+        }
+        self.backend.put(seq_hash, self.worker_id, entry)
+        self._view.setdefault(seq_hash, {})[self.worker_id] = entry
+
+    def publish_many(
+        self, hashes: list[int], tier: str, nbytes: int, addr: str = ""
+    ) -> None:
+        now = self.clock.time()
+        items = []
+        for h in hashes:
+            entry = {"tier": tier, "bytes": int(nbytes), "t": now, "addr": addr}
+            items.append((h, self.worker_id, entry))
+            self._view.setdefault(h, {})[self.worker_id] = entry
+        self.backend.put_many(items)
+
+    def retier(self, seq_hash: int, tier: str) -> None:
+        owners = self._view.get(seq_hash, {})
+        entry = dict(owners.get(self.worker_id) or {"bytes": 0, "addr": ""})
+        entry["tier"] = tier
+        entry["t"] = self.clock.time()
+        self.backend.put(seq_hash, self.worker_id, entry)
+        self._view.setdefault(seq_hash, {})[self.worker_id] = entry
+
+    def prune(self, seq_hash: int, worker_id: Optional[int] = None) -> None:
+        wid = self.worker_id if worker_id is None else worker_id
+        self.backend.delete(seq_hash, wid)
+        owners = self._view.get(seq_hash)
+        if owners is not None:
+            owners.pop(wid, None)
+            if not owners:
+                self._view.pop(seq_hash, None)
+
+    # -- reading ------------------------------------------------------------
+    def refresh(self) -> None:
+        self._view = self.backend.snapshot()
+        KVBM_FLEET_CATALOG_ENTRIES.set(len(self._view))
+
+    def locations(
+        self, seq_hash: int, exclude_worker: Optional[int] = None
+    ) -> list[tuple[int, dict]]:
+        """Fleet-fetchable locations of a block: peers' G2 copies and
+        anyone's G4 (shared-bucket) copies. A worker's own entries and
+        private G3 disk copies are not fetchable by the fleet."""
+        out = []
+        for w, entry in (self._view.get(seq_hash) or {}).items():
+            if exclude_worker is not None and w == exclude_worker:
+                continue
+            if entry.get("tier") in FLEET_TIERS:
+                out.append((w, entry))
+        # prefer shared-bucket copies (no peer round trip needed), then
+        # host copies by recency
+        out.sort(
+            key=lambda we: (
+                we[1].get("tier") != TIER_SHARED,
+                -float(we[1].get("t", 0.0)),
+            )
+        )
+        return out
+
+    def match_prefix(
+        self, seq_hashes: list[int], exclude_worker: Optional[int] = None
+    ) -> int:
+        """Leading consecutive blocks with at least one fleet-fetchable
+        location (membership only — no network, no fetches)."""
+        n = 0
+        for h in seq_hashes:
+            if self.locations(h, exclude_worker):
+                n += 1
+            else:
+                break
+        return n
+
+    @property
+    def num_entries(self) -> int:
+        return len(self._view)
+
+    def stats(self) -> dict:
+        tiers: dict[str, int] = {}
+        for owners in self._view.values():
+            for entry in owners.values():
+                t = entry.get("tier", "?")
+                tiers[t] = tiers.get(t, 0) + 1
+        return {"entries": len(self._view), "by_tier": tiers}
+
+
+# ---------------------------------------------------------------------------
+# Peer block plane (store wire framing)
+# ---------------------------------------------------------------------------
+
+
+class PeerFetcher(abc.ABC):
+    """Fetches packed block bytes from a peer's host tier."""
+
+    @abc.abstractmethod
+    def fetch(
+        self, addr: str, seq_hashes: list[int]
+    ) -> Optional[list[Optional[bytes]]]:
+        """Returns one ``bytes | None`` per hash; ``None`` overall when
+        the peer is unreachable. MUST NOT raise — a flaky peer reads as
+        a miss (the caller falls back to recompute)."""
+
+
+class LocalPeerRegistry(PeerFetcher):
+    """In-process peer plane for single-process fleets and tests:
+    ``addr`` is ``local:<name>``, mapped to the exporter callable each
+    worker registers (KvBlockManager.export_host_blocks)."""
+
+    def __init__(self) -> None:
+        self._exporters: dict[str, Callable[[list[int]], list[Optional[bytes]]]] = {}
+
+    def register(
+        self, name: str, exporter: Callable[[list[int]], list[Optional[bytes]]]
+    ) -> str:
+        addr = f"local:{name}"
+        self._exporters[addr] = exporter
+        return addr
+
+    def unregister(self, addr: str) -> None:
+        self._exporters.pop(addr, None)
+
+    def fetch(
+        self, addr: str, seq_hashes: list[int]
+    ) -> Optional[list[Optional[bytes]]]:
+        exporter = self._exporters.get(addr)
+        if exporter is None:
+            return None
+        try:
+            return exporter(seq_hashes)
+        except Exception:
+            log.exception("local peer fetch from %s failed", addr)
+            return None
+
+
+class PeerBlockServer:
+    """Serves a worker's G2 host-tier blocks to peers over the store
+    wire plane (length-prefixed msgpack, store/wire.py — the same
+    framing the coordinator store speaks).
+
+    Runs on the event loop; ``exporter`` must be thread-safe
+    (KvBlockManager.export_host_blocks takes the host-tier lock shared
+    with the engine thread's mutation paths)."""
+
+    def __init__(
+        self,
+        exporter: Callable[[list[int]], list[Optional[bytes]]],
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.exporter = exporter
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._writers: set[asyncio.StreamWriter] = set()
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> str:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("kvfleet peer block server on %s", self.addr)
+        return self.addr
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        from dynamo_tpu.store.wire import read_frame, write_frame
+
+        self._writers.add(writer)
+        try:
+            while True:
+                req = await read_frame(reader)
+                op = req.get("op")
+                if op == "fetch":
+                    hashes = [int(h) for h in req.get("hashes", [])]
+                    # the exporter is synchronous but lock-cheap (pure
+                    # host-RAM reads); run in the default executor so a
+                    # multi-MB gather doesn't stall this loop's streams
+                    blocks = await asyncio.get_running_loop().run_in_executor(
+                        None, self.exporter, hashes
+                    )
+                    write_frame(writer, {"blocks": blocks})
+                elif op == "ping":
+                    write_frame(writer, {"ok": True})
+                else:
+                    write_frame(writer, {"error": f"bad op {op!r}"})
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        except Exception:
+            log.exception("peer block connection failed")
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+
+    async def stop(self) -> None:
+        from dynamo_tpu.store.wire import shutdown_server
+
+        await shutdown_server(self._server, self._writers)
+        self._server = None
+
+
+class TcpPeerClient(PeerFetcher):
+    """Blocking peer fetch for the engine thread: one short-lived
+    connection per fetch batch, same framing as PeerBlockServer.
+    (The engine thread has no event loop; onboarding already tolerates
+    multi-ms G3/G4 reads, and a fetch replaces a whole re-prefill.)"""
+
+    def __init__(self, timeout_s: float = 5.0):
+        self.timeout_s = timeout_s
+
+    def fetch(
+        self, addr: str, seq_hashes: list[int]
+    ) -> Optional[list[Optional[bytes]]]:
+        from dynamo_tpu.store.wire import MAX_FRAME
+
+        try:
+            host, port_s = addr.rsplit(":", 1)
+            with socket.create_connection(
+                (host, int(port_s)), timeout=self.timeout_s
+            ) as sock:
+                body = msgpack.packb(
+                    {"op": "fetch", "hashes": list(seq_hashes)},
+                    use_bin_type=True,
+                )
+                sock.sendall(struct.pack("<I", len(body)) + body)
+                header = self._recv_exact(sock, 4)
+                (length,) = struct.unpack("<I", header)
+                if length > MAX_FRAME:
+                    raise ValueError(f"frame too large: {length}")
+                resp = msgpack.unpackb(
+                    self._recv_exact(sock, length), raw=False
+                )
+            blocks = resp.get("blocks")
+            if blocks is None or len(blocks) != len(seq_hashes):
+                return None
+            return list(blocks)
+        except (OSError, ValueError, msgpack.exceptions.UnpackException):
+            log.warning("peer fetch from %s failed", addr, exc_info=True)
+            return None
+
+    @staticmethod
+    def _recv_exact(sock: socket.socket, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed mid-frame")
+            buf.extend(chunk)
+        return bytes(buf)
+
+
+# ---------------------------------------------------------------------------
+# Pressure-driven tier lifecycle
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PressureConfig:
+    """G2 host-pool watermarks (docs/kvbm.md "Watermark knobs").
+
+    When occupancy crosses ``high_watermark``, blocks demote —
+    popularity-weighted victims, least-touched first — until occupancy
+    falls to ``low_watermark``. Hot shared blocks (touched at least
+    ``hot_min_touches`` times, or held by no other worker while fleet-
+    popular) go to the shared G4 bucket; cold private ones to local G3
+    disk. The planner's "demote cold KV" rung scales both watermarks
+    down via ``pressure_scale`` (LadderPolicy.fabric_pressure_scale)."""
+
+    high_watermark: float = 0.90
+    low_watermark: float = 0.70
+    hot_min_touches: int = 2
+    # demotions are engine-thread work (host RAM copy + disk/remote
+    # write); bound one pump's share of it like the offload batch
+    max_demotions_per_pump: int = 32
+
+
+@dataclass
+class _Resident:
+    nbytes: int = 0
+    touches: int = 0
+    last_touch: float = 0.0
+    seq: int = 0  # insertion order: deterministic LRU tie-break
+
+
+@dataclass
+class FabricStats:
+    fleet_hits_peer: int = 0
+    fleet_hits_bucket: int = 0
+    fetched_blocks: int = 0
+    fetch_failures: int = 0
+    dangling_pruned: int = 0
+    demoted_shared: int = 0
+    demoted_disk: int = 0
+    demoted_dropped: int = 0
+    published_blocks: int = 0
+    pruned_blocks: int = 0
+
+
+class FleetKvFabric:
+    """Per-worker glue between one KvBlockManager and the fleet: the
+    catalog publisher, the peer-onboarding path, and the G2 pressure
+    lifecycle. Engine-thread affine (see module docstring)."""
+
+    # throttle for catalog snapshot refreshes run from the pump (same
+    # cadence discipline as the manager's G4 index refresh)
+    REFRESH_S = 5.0
+
+    def __init__(
+        self,
+        catalog: FleetPrefixCatalog,
+        fetcher: Optional[PeerFetcher] = None,
+        pressure: Optional[PressureConfig] = None,
+        clock: Optional[Clock] = None,
+        addr: str = "",
+        name: str = "",
+    ):
+        self.catalog = catalog
+        self.fetcher = fetcher
+        self.pressure = pressure or PressureConfig()
+        self.clock = clock or SYSTEM
+        self.addr = addr
+        self.name = name or f"worker{catalog.worker_id}"
+        self.manager: Any = None
+        self.stats = FabricStats()
+        self._pressure_scale = 1.0
+        self._resident: dict[int, _Resident] = {}
+        self._seq = 0
+        self._last_refresh = 0.0
+        self._provider_name = ""
+
+    # -- wiring -------------------------------------------------------------
+    def attach(self, manager: Any) -> None:
+        """Bind to a KvBlockManager (calls back into
+        ``manager.attach_fabric``) and register the debug provider."""
+        self.manager = manager
+        manager.attach_fabric(self)
+        self._provider_name = f"kvfleet:{self.name}"
+        register_debug_provider(self._provider_name, self.debug_stanza)
+
+    def close(self) -> None:
+        if self._provider_name:
+            unregister_debug_provider(self._provider_name, self.debug_stanza)
+            self._provider_name = ""
+
+    def set_pressure_scale(self, scale: float) -> None:
+        """The degradation ladder's "demote cold KV" rung: scale both
+        watermarks down so cold KV demotes earlier under fleet stress
+        (1.0 = rung 0 baseline)."""
+        self._pressure_scale = max(0.05, min(1.0, float(scale)))
+
+    # -- manager hooks (engine thread) ---------------------------------------
+    def on_host_insert(self, seq_hash: int, nbytes: int) -> None:
+        self._track_resident(seq_hash, nbytes)
+        self.catalog.publish(seq_hash, TIER_HOST, nbytes, addr=self.addr)
+        self.stats.published_blocks += 1
+
+    def on_host_insert_many(self, seq_hashes: list[int], nbytes: int) -> None:
+        """Batched G2 landing (one catalog round trip per pump). A block
+        the same batch already LRU-evicted again is skipped — its
+        on_host_evict already recorded the true tier."""
+        m = self.manager
+        live = [
+            h for h in seq_hashes if m is None or m.host.contains(h)
+        ]
+        for h in live:
+            self._track_resident(h, nbytes)
+        self.catalog.publish_many(live, TIER_HOST, nbytes, addr=self.addr)
+        self.stats.published_blocks += len(live)
+
+    def _track_resident(self, seq_hash: int, nbytes: int) -> None:
+        if seq_hash not in self._resident:
+            self._seq += 1
+            self._resident[seq_hash] = _Resident(
+                nbytes=nbytes, touches=0,
+                last_touch=self.clock.monotonic(), seq=self._seq,
+            )
+
+    def on_host_evict(self, seq_hash: int, dest: Optional[str]) -> None:
+        """The host pool evicted a block; ``dest`` is where the demotion
+        cascade routed it (g3/g4) or ``None`` when it was dropped. The
+        catalog is retiered or pruned so an entry is NEVER dangling."""
+        self._resident.pop(seq_hash, None)
+        if dest in (TIER_DISK, TIER_SHARED):
+            self.catalog.retier(seq_hash, dest)
+        else:
+            self.catalog.prune(seq_hash)
+            self.stats.pruned_blocks += 1
+
+    def on_tier_move(self, seq_hash: int, dest: str) -> None:
+        """A lower-tier cascade moved the block (disk LRU -> bucket)."""
+        self.catalog.retier(seq_hash, dest)
+
+    def on_block_dropped(self, seq_hash: int) -> None:
+        """A lower tier lost the block for good (disk LRU overflow with
+        no bucket, remote GC). Prune our claim — and every g4 claim,
+        since the shared bucket's loss invalidates all of them."""
+        self.catalog.prune(seq_hash)
+        for w, entry in list(
+            (self.catalog._view.get(seq_hash) or {}).items()
+        ):
+            if entry.get("tier") == TIER_SHARED:
+                self.catalog.prune(seq_hash, w)
+        self.stats.pruned_blocks += 1
+
+    def note_touch(self, seq_hashes: list[int]) -> None:
+        now = self.clock.monotonic()
+        for h in seq_hashes:
+            meta = self._resident.get(h)
+            if meta is not None:
+                meta.touches += 1
+                meta.last_touch = now
+
+    def maybe_refresh(self) -> None:
+        now = self.clock.monotonic()
+        if now - self._last_refresh >= self.REFRESH_S:
+            self._last_refresh = now
+            try:
+                self.catalog.refresh()
+            except Exception:
+                log.exception("fleet catalog refresh failed")
+
+    # -- peer onboarding (engine thread, admission path) ---------------------
+    def prefetch(self, seq_hashes: list[int]) -> int:
+        """Land the longest possible leading run of ``seq_hashes`` in
+        locally readable tiers: blocks missing everywhere locally but
+        present in the catalog are fetched from the owning peer's host
+        tier (wire plane) into G2, or adopted from the shared G4 bucket
+        index. Returns the number of blocks made local. A failed fetch
+        prunes the dangling entry and stops — the caller's onboard plan
+        truncates there and the engine recomputes, never crashes."""
+        m = self.manager
+        if m is None:
+            return 0
+        fetched = 0
+        # plan the leading run of fleet-only blocks
+        for h in seq_hashes:
+            if m.contains_local(h):
+                continue
+            locs = self.catalog.locations(
+                h, exclude_worker=self.catalog.worker_id
+            )
+            if not locs:
+                break
+            if not self._fetch_one(h, locs):
+                break
+            fetched += 1
+        if fetched:
+            self.stats.fetched_blocks += fetched
+            KVBM_FLEET_FETCHED_BLOCKS.inc(fetched)
+        return fetched
+
+    def _fetch_one(self, seq_hash: int, locs: list[tuple[int, dict]]) -> bool:
+        m = self.manager
+        expected = m.layout.block_bytes
+        for worker, entry in locs:
+            tier = entry.get("tier")
+            if tier == TIER_SHARED:
+                # shared-bucket copy: adopt into the local G4 index, the
+                # existing onboard path reads it through RemoteTier
+                if m.remote is not None and m.adopt_remote(seq_hash):
+                    self.stats.fleet_hits_bucket += 1
+                    KVBM_FLEET_HITS.labels("bucket").inc()
+                    return True
+                continue
+            if tier == TIER_HOST and self.fetcher is not None:
+                addr = entry.get("addr") or ""
+                if not addr:
+                    continue
+                t0 = self.clock.monotonic()
+                blocks = self.fetcher.fetch(addr, [seq_hash])
+                KVBM_FLEET_FETCH_SECONDS.observe(self.clock.monotonic() - t0)
+                raw = blocks[0] if blocks else None
+                if raw is None or len(raw) != expected:
+                    self.stats.fetch_failures += 1
+                    continue
+                m.insert_host_bytes(seq_hash, raw)
+                self.stats.fleet_hits_peer += 1
+                KVBM_FLEET_HITS.labels("peer").inc()
+                return True
+        # every advertised location failed: the entry is dangling —
+        # prune so the next request goes straight to recompute
+        for worker, _ in locs:
+            self.catalog.prune(seq_hash, worker)
+        self.stats.dangling_pruned += 1
+        KVBM_FLEET_DANGLING.inc()
+        return False
+
+    # -- pressure lifecycle (engine thread, from pump) ------------------------
+    def enforce_pressure(self) -> int:
+        """Demote G2 victims while occupancy exceeds the (ladder-scaled)
+        high watermark, until it reaches the low watermark or the pump
+        budget runs out. Victims are popularity-weighted: least-touched,
+        then oldest. Returns blocks demoted."""
+        m = self.manager
+        if m is None:
+            return 0
+        total = m.host.num_blocks
+        if total <= 0:
+            return 0
+        high = self.pressure.high_watermark * self._pressure_scale
+        low = self.pressure.low_watermark * self._pressure_scale
+        if m.host.num_cached <= high * total:
+            return 0
+        target = int(low * total)
+        victims = sorted(
+            (h for h in self._resident if m.host.contains(h)),
+            key=lambda h: (
+                self._resident[h].touches,
+                self._resident[h].last_touch,
+                self._resident[h].seq,
+            ),
+        )
+        demoted = 0
+        for h in victims:
+            if m.host.num_cached <= target:
+                break
+            if demoted >= self.pressure.max_demotions_per_pump:
+                break
+            dest = self._route_victim(h)
+            routed = m.demote_block(h, dest)
+            self._resident.pop(h, None)
+            if routed == TIER_SHARED:
+                self.catalog.retier(h, TIER_SHARED)
+                self.stats.demoted_shared += 1
+                KVBM_FLEET_DEMOTED_BLOCKS.labels("shared").inc()
+            elif routed == TIER_DISK:
+                self.catalog.retier(h, TIER_DISK)
+                self.stats.demoted_disk += 1
+                KVBM_FLEET_DEMOTED_BLOCKS.labels("disk").inc()
+            else:
+                self.catalog.prune(h)
+                self.stats.demoted_dropped += 1
+                KVBM_FLEET_DEMOTED_BLOCKS.labels("dropped").inc()
+            demoted += 1
+        return demoted
+
+    def _route_victim(self, seq_hash: int) -> str:
+        """Hot shared prefixes -> shared G4 bucket (they stay fetchable
+        fleet-wide, surviving this worker); cold private ones -> local
+        disk (cheap, private)."""
+        m = self.manager
+        meta = self._resident.get(seq_hash)
+        hot = meta is not None and meta.touches >= self.pressure.hot_min_touches
+        if hot and m.remote is not None:
+            return TIER_SHARED
+        if m.disk is not None:
+            return TIER_DISK
+        if m.remote is not None:
+            # no disk tier: even cold blocks beat recompute if a shared
+            # bucket exists
+            return TIER_SHARED
+        return "drop"
+
+    # -- introspection --------------------------------------------------------
+    def debug_stanza(self) -> dict:
+        s = self.stats
+        return {
+            "addr": self.addr,
+            "catalog": self.catalog.stats(),
+            "resident_tracked": len(self._resident),
+            "pressure_scale": self._pressure_scale,
+            "watermarks": {
+                "high": self.pressure.high_watermark * self._pressure_scale,
+                "low": self.pressure.low_watermark * self._pressure_scale,
+            },
+            "fleet_hits": {
+                "peer": s.fleet_hits_peer,
+                "bucket": s.fleet_hits_bucket,
+            },
+            "fetched_blocks": s.fetched_blocks,
+            "fetch_failures": s.fetch_failures,
+            "dangling_pruned": s.dangling_pruned,
+            "demoted": {
+                "shared": s.demoted_shared,
+                "disk": s.demoted_disk,
+                "dropped": s.demoted_dropped,
+            },
+            "published_blocks": s.published_blocks,
+            "pruned_blocks": s.pruned_blocks,
+        }

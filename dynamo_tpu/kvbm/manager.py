@@ -21,9 +21,10 @@ offload.rs:43-751). Responsibilities:
 from __future__ import annotations
 
 import logging
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 import numpy as np
 
@@ -188,7 +189,7 @@ class KvBlockManager:
         if config.disk_num_blocks > 0:
             self.disk = TierPool(
                 DiskBlockStorage(layout, config.disk_num_blocks, config.disk_path),
-                on_evict=self._demote_remote,
+                on_evict=self._on_disk_evict,
             )
         self.host = TierPool(
             HostBlockStorage(layout, config.host_num_blocks),
@@ -198,6 +199,20 @@ class KvBlockManager:
         self._pending: OrderedDict[int, int] = OrderedDict()
         self._last_remote_refresh = 0.0
         self.stats = KvbmStats()
+        # fleet KV fabric (kvbm/fabric.py FleetKvFabric), late-bound via
+        # attach_fabric(). The host-tier lock exists for it: the peer
+        # block server exports G2 blocks from the event loop while the
+        # engine thread mutates the pool, so every host-pool touch that
+        # moves data goes through this lock (uncontended when no fabric
+        # is attached — a few ns per pump, not per step).
+        self.fabric: Any = None
+        self._host_lock = threading.Lock()
+
+    def attach_fabric(self, fabric: Any) -> None:
+        """Bind the fleet fabric (idempotent; engine thread or setup
+        thread, before serving). The fabric's hooks then run inside
+        pump()/onboard() on the engine thread."""
+        self.fabric = fabric
 
     def attach_remote(self, objects: SyncObjectStore) -> None:
         """Late-bind the G4 tier (the worker's store connection usually
@@ -239,7 +254,12 @@ class KvBlockManager:
                     self.remote.refresh_remote_index()
                 except Exception:
                     log.exception("G4 index refresh failed")
+        if self.fabric is not None:
+            # catalog snapshot refresh rides the same pump cadence as
+            # the G4 index (throttled inside the fabric)
+            self.fabric.maybe_refresh()
         if not self._pending or max_blocks == 0:
+            self._enforce_fabric_pressure()
             return 0
         cap = self._offload_batch if max_blocks is None else min(
             max_blocks, self._offload_batch
@@ -251,39 +271,76 @@ class KvBlockManager:
             if self._resolve(h) == bid and not self.host.contains(h):
                 batch.append((h, bid))
         if not batch:
+            self._enforce_fabric_pressure()
             return 0
         hashes = [h for h, _ in batch]
         ids = [b for _, b in batch]
         packed = self._gather(ids)
-        self.host.insert_many(hashes, packed)
+        with self._host_lock:
+            self.host.insert_many(hashes, packed)
+        if self.fabric is not None:
+            # publish the landed blocks to the fleet catalog (batched:
+            # one store round trip per pump, not per block)
+            self.fabric.on_host_insert_many(hashes, self.layout.block_bytes)
         self.stats.offloaded_blocks += len(batch)
         KVBM_OFFLOADED_BLOCKS.inc(len(batch))
+        self._enforce_fabric_pressure()
         self._refresh_gauges()
         return len(batch)
+
+    def _enforce_fabric_pressure(self) -> None:
+        """Watermark-driven G2 demotion, once per pump (the fabric
+        no-ops below the high watermark). A broken fabric must degrade
+        to single-worker behavior, not kill the offload pump."""
+        if self.fabric is None:
+            return
+        try:
+            self.fabric.enforce_pressure()
+        except Exception:
+            log.exception("fleet pressure enforcement failed")
 
     @property
     def pending_offloads(self) -> int:
         return len(self._pending)
 
     def _demote(self, seq_hash: int, data: np.ndarray) -> None:
+        # destination strings are the catalog tier names
+        # (fabric.TIER_DISK / TIER_SHARED): the fabric retiers or prunes
+        # the hash's catalog entry so it is never dangling
+        dest: Optional[str] = None
         if self.disk is not None:
             self.disk.insert(seq_hash, data)
             self.stats.demoted_blocks += 1
+            dest = "g3"
         elif self.remote is not None:
             # no G3: the cascade skips straight to remote
-            self._demote_remote(seq_hash, data)
+            if self._demote_remote(seq_hash, data):
+                dest = "g4"
+        if self.fabric is not None:
+            self.fabric.on_host_evict(seq_hash, dest)
 
-    def _demote_remote(self, seq_hash: int, data: np.ndarray) -> None:
+    def _demote_remote(self, seq_hash: int, data: np.ndarray) -> bool:
         if self.remote is None:
-            return
+            return False
         try:
             self.remote.insert(seq_hash, data)
             self.stats.demoted_blocks += 1
             self.stats.remote_put_blocks += 1
+            return True
         except Exception:
             # remote tier is best-effort cache: a flaky store must not
             # take the engine's offload pump down
             log.exception("G4 remote put failed for %x", seq_hash)
+            return False
+
+    def _on_disk_evict(self, seq_hash: int, data: np.ndarray) -> None:
+        """G3's eviction cascade (disk LRU overflow -> remote)."""
+        landed = self._demote_remote(seq_hash, data)
+        if self.fabric is not None:
+            if landed:
+                self.fabric.on_tier_move(seq_hash, "g4")
+            else:
+                self.fabric.on_block_dropped(seq_hash)
 
     # -- onboarding (engine thread, at admission) --------------------------
     def match_offloaded(self, seq_hashes: list[int]) -> int:
@@ -305,6 +362,16 @@ class KvBlockManager:
         """Copy the longest available prefix of ``seq_hashes`` from lower
         tiers into the given (freshly allocated) device blocks. Returns the
         number of blocks onboarded."""
+        if self.fabric is not None:
+            # fleet prefetch: blocks missing every local tier but hitting
+            # the fleet catalog are pulled from the owning peer's host
+            # tier / adopted from the shared bucket FIRST, so the plan
+            # below sees them as local hits (a fetch replaces a whole
+            # re-prefill; failures degrade to recompute, never raise)
+            try:
+                self.fabric.prefetch(seq_hashes[: len(device_blocks)])
+            except Exception:
+                log.exception("fleet prefetch failed")
         # plan first (membership only — no reads, no promotions yet, so the
         # plan can't be invalidated by eviction cascades mid-loop)
         host_rows: list[tuple[int, int]] = []  # (row index, hash)
@@ -331,6 +398,13 @@ class KvBlockManager:
             assert self.remote is not None
             remote_data = self.remote.read([h for _, h in remote_rows])
             if remote_data is None:
+                if self.fabric is not None:
+                    # the G4 read dropped whatever keys the bucket lost
+                    # from the local index; prune their catalog claims so
+                    # the fleet stops advertising them (never dangling)
+                    for _, h in remote_rows:
+                        if not self.remote.contains(h):
+                            self.fabric.on_block_dropped(h)
                 n = remote_rows[0][0]
                 remote_rows = []
         if n == 0:
@@ -339,7 +413,8 @@ class KvBlockManager:
         disk_rows = [(i, h) for i, h in disk_rows if i < n]
         rows = np.zeros((n, *self.layout.packed_shape), self.layout.np_dtype)
         if host_rows:
-            data = self.host.read([h for _, h in host_rows])  # one batched read
+            with self._host_lock:
+                data = self.host.read([h for _, h in host_rows])  # one batched read
             for j, (i, _) in enumerate(host_rows):
                 rows[i] = data[j]
         disk_data = None
@@ -353,20 +428,105 @@ class KvBlockManager:
         self._scatter(device_blocks[:n], rows)
         # promote lower-tier hits into the host tier AFTER all reads and
         # the scatter: promotion may trigger demotion-eviction cascades
-        for j, (_, h) in enumerate(disk_rows):
-            self.host.insert(h, disk_data[j])
-        for j, (_, h) in enumerate(remote_rows):
-            self.host.insert(h, remote_data[j])
-            self.stats.remote_got_blocks += 1
+        promoted: list[int] = []
+        with self._host_lock:
+            for j, (_, h) in enumerate(disk_rows):
+                self.host.insert(h, disk_data[j])
+                promoted.append(h)
+            for j, (_, h) in enumerate(remote_rows):
+                self.host.insert(h, remote_data[j])
+                self.stats.remote_got_blocks += 1
+                promoted.append(h)
+        if self.fabric is not None:
+            if promoted:
+                self.fabric.on_host_insert_many(
+                    promoted, self.layout.block_bytes
+                )
+            # popularity signal for the pressure lifecycle's
+            # victim selection: every onboarded block was just used
+            self.fabric.note_touch(seq_hashes[:n])
         self.stats.onboarded_blocks += n
         KVBM_ONBOARDED_BLOCKS.inc(n)
         self._refresh_gauges()
         return n
+
+    # -- fleet fabric surface (kvbm/fabric.py) ------------------------------
+    def contains_local(self, seq_hash: int) -> bool:
+        """Membership across every locally readable tier (G2/G3/G4
+        index) — what the fleet prefetch skips past."""
+        return (
+            self.host.contains(seq_hash)
+            or (self.disk is not None and self.disk.contains(seq_hash))
+            or (self.remote is not None and self.remote.contains(seq_hash))
+        )
+
+    def adopt_remote(self, seq_hash: int) -> bool:
+        """Adopt a catalog-advertised shared-bucket block into the local
+        G4 index without waiting for the periodic list refresh; the
+        existing onboard path then reads it through RemoteTier (and
+        un-adopts on a failed read)."""
+        if self.remote is None:
+            return False
+        self.remote._known.add(seq_hash)
+        return True
+
+    def insert_host_bytes(self, seq_hash: int, raw: bytes) -> None:
+        """Land one peer-fetched packed block in the host tier (engine
+        thread; the fleet prefetch path). Publishes to the catalog like
+        any other G2 landing."""
+        block = np.frombuffer(raw, self.layout.np_dtype).reshape(
+            self.layout.packed_shape
+        )
+        with self._host_lock:
+            self.host.insert(seq_hash, block)
+        if self.fabric is not None:
+            self.fabric.on_host_insert(seq_hash, self.layout.block_bytes)
+
+    def export_host_blocks(self, seq_hashes: list[int]) -> list[Optional[bytes]]:
+        """Read G2 blocks as raw bytes for a peer (called from the peer
+        block server's executor thread — the host lock is the handoff
+        with the engine thread's mutation paths). Misses are None."""
+        out: list[Optional[bytes]] = []
+        with self._host_lock:
+            for h in seq_hashes:
+                if self.host.contains(h):
+                    out.append(
+                        np.ascontiguousarray(self.host.read([h])[0]).tobytes()
+                    )
+                else:
+                    out.append(None)
+        return out
+
+    def demote_block(self, seq_hash: int, dest: str) -> Optional[str]:
+        """Explicitly demote one G2 block (the pressure lifecycle's
+        routed eviction — bypasses the LRU cascade so hot shared blocks
+        can go to the shared bucket while cold ones go to disk).
+        Returns where the block actually landed ("g3"/"g4") or None when
+        it was dropped; the caller owns the catalog update."""
+        with self._host_lock:
+            if not self.host.contains(seq_hash):
+                return None
+            data = self.host.read([seq_hash])[0]
+            self.host.evict(seq_hash)  # index-only: no on_evict cascade
+        if dest == "g4" and self.remote is not None:
+            if self._demote_remote(seq_hash, data):
+                return "g4"
+            dest = "g3"  # remote refused: fall back to disk
+        if dest == "g3" and self.disk is not None:
+            self.disk.insert(seq_hash, data)
+            self.stats.demoted_blocks += 1
+            return "g3"
+        return None
 
     def _refresh_gauges(self) -> None:
         self.stats.host_cached_blocks = self.host.num_cached
         self.stats.disk_cached_blocks = self.disk.num_cached if self.disk else 0
 
     def close(self) -> None:
+        if self.fabric is not None:
+            try:
+                self.fabric.close()
+            except Exception:  # pragma: no cover - shutdown is best-effort
+                log.exception("fleet fabric close failed")
         if self.disk is not None:
             self.disk.storage.close()

@@ -11,28 +11,92 @@ for its remote tier behind blocking NIXL calls
 from __future__ import annotations
 
 import asyncio
-from typing import Optional
+import concurrent.futures
+import logging
+import time
+from typing import Any, Optional
 
 from dynamo_tpu.kvbm.manager import SyncObjectStore
+from dynamo_tpu.telemetry.instruments import KVBM_REMOTE_TIMEOUTS
+
+log = logging.getLogger("dynamo_tpu.kvbm.remote")
+
+
+class StoreRoundTripTimeout(TimeoutError):
+    """A blocking store round trip from the engine thread hit its
+    deadline. Carries the operation context a bare
+    ``concurrent.futures.TimeoutError`` swallows — op name, deadline,
+    elapsed — so the flight recorder and logs can say WHICH plane
+    stalled instead of killing the pump with an anonymous traceback."""
+
+    def __init__(self, op: str, timeout_s: float, elapsed_s: float):
+        self.op = op
+        self.timeout_s = timeout_s
+        self.elapsed_s = elapsed_s
+        super().__init__(
+            f"store round trip {op!r} exceeded {timeout_s:.1f}s "
+            f"deadline ({elapsed_s:.1f}s elapsed)"
+        )
+
+
+def run_on_loop(
+    coro,
+    loop: asyncio.AbstractEventLoop,
+    timeout_s: float,
+    op: str,
+    recorder: Any = None,
+):
+    """Schedule ``coro`` onto the runtime's loop and block the calling
+    (engine) thread on the result. A deadline miss books the
+    ``dynamo_kvbm_remote_timeout_total{op=...}`` counter and a
+    flight-recorder record, cancels the in-flight coroutine, and raises
+    :class:`StoreRoundTripTimeout` — callers (RemoteTier.read, the
+    fabric catalog) already treat any exception as a tier miss, so the
+    pump degrades instead of dying on a bare TimeoutError."""
+    fut = asyncio.run_coroutine_threadsafe(coro, loop)
+    t0 = time.monotonic()
+    try:
+        return fut.result(timeout=timeout_s)
+    except concurrent.futures.TimeoutError:
+        fut.cancel()
+        elapsed = time.monotonic() - t0
+        KVBM_REMOTE_TIMEOUTS.labels(op).inc()
+        if recorder is not None:
+            try:
+                recorder.record(
+                    "kvbm_remote_timeout",
+                    duration_s=elapsed,
+                    op=op,
+                    timeout_s=timeout_s,
+                )
+            except Exception:  # pragma: no cover - recorder is best-effort
+                log.exception("flight record for store timeout failed")
+        log.warning(
+            "store round trip %r timed out after %.1fs (deadline %.1fs)",
+            op, elapsed, timeout_s,
+        )
+        raise StoreRoundTripTimeout(op, timeout_s, elapsed) from None
 
 
 class StoreObjectAdapter(SyncObjectStore):
     def __init__(self, store, bucket: str, loop: asyncio.AbstractEventLoop,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, recorder: Any = None):
         self.store = store
         self.bucket = bucket
         self.loop = loop
         self.timeout_s = timeout_s
+        self.recorder = recorder
 
-    def _run(self, coro):
-        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
-        return fut.result(timeout=self.timeout_s)
+    def _run(self, coro, op: str):
+        return run_on_loop(
+            coro, self.loop, self.timeout_s, op=op, recorder=self.recorder
+        )
 
     def put(self, key: str, data: bytes) -> None:
-        self._run(self.store.obj_put(self.bucket, key, data))
+        self._run(self.store.obj_put(self.bucket, key, data), "put")
 
     def get(self, key: str) -> Optional[bytes]:
-        return self._run(self.store.obj_get(self.bucket, key))
+        return self._run(self.store.obj_get(self.bucket, key), "get")
 
     def get_many(self, keys: list[str]) -> list[Optional[bytes]]:
         """One blocking wait for the whole batch: the gets overlap on
@@ -45,10 +109,10 @@ class StoreObjectAdapter(SyncObjectStore):
                 *[self.store.obj_get(self.bucket, k) for k in keys]
             )
 
-        return list(self._run(gather()))
+        return list(self._run(gather(), "get_many"))
 
     def list_keys(self) -> list[str]:
-        return list(self._run(self.store.obj_list(self.bucket)))
+        return list(self._run(self.store.obj_list(self.bucket), "list"))
 
 
 class DictObjectStore(SyncObjectStore):

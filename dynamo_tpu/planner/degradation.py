@@ -54,6 +54,12 @@ class LadderPolicy:
     queue_factor: float = 0.5
     kv_factor: float = 0.95
     shed_queue_depth: int = 32
+    # the "demote cold KV" rung (fleet KV fabric, kvbm/fabric.py): each
+    # rung scales the G2 host-pool watermarks down by this factor, so
+    # cold KV demotes to disk / the shared bucket earlier the deeper
+    # the fleet degrades — host RAM is given back before admission or
+    # spec decode have to give anything up
+    fabric_scale_factor: float = 0.75
 
     def admission_caps(
         self, base_queue: int, base_kv: float, level: int
@@ -77,6 +83,15 @@ class LadderPolicy:
     def spec_enabled(self, base: bool, level: int) -> bool:
         return base and level < 2
 
+    def fabric_pressure_scale(self, level: int) -> float:
+        """Watermark multiplier for the fleet fabric's G2 pressure
+        lifecycle: 1.0 at rung 0, tightening geometrically per rung
+        (floored — the host tier must keep SOME working set or every
+        admission pays a fetch)."""
+        if level <= 0:
+            return 1.0
+        return max(0.25, self.fabric_scale_factor ** min(level, 3))
+
     def force_shed(self, level: int) -> bool:
         """Rung 3 on a frontend WITHOUT load signals: shed to the probe
         trickle rather than failing open (where load signals exist, the
@@ -95,10 +110,14 @@ class ServingDegradation:
         admission: Optional[Any] = None,
         engine: Optional[Any] = None,
         policy: Optional[LadderPolicy] = None,
+        fabric: Optional[Any] = None,
     ):
         self.admission = admission
         self.engine = engine
         self.policy = policy or LadderPolicy()
+        # fleet KV fabric (kvbm/fabric.py FleetKvFabric): the "demote
+        # cold KV" rung scales its G2 watermarks via set_pressure_scale
+        self.fabric = fabric
         self.level = 0
         if admission is not None:
             self._base_queue = admission.config.max_queue_depth
@@ -130,6 +149,14 @@ class ServingDegradation:
             with affinity.handoff("degradation rung -> engine.spec_suspended"):
                 self.engine.spec_suspended = not self.policy.spec_enabled(  # dynalint: handoff=degradation-rung — loop->engine bool flip, read each step
                     True, level
+                )
+        if self.fabric is not None:
+            # same cross-domain shape as spec_suspended: a plain float
+            # store the engine-thread pump reads at its next pressure
+            # pass (the "demote cold KV" rung)
+            with affinity.handoff("degradation rung -> fabric watermarks"):
+                self.fabric.set_pressure_scale(  # dynalint: handoff=degradation-rung — loop->engine float flip, read each pump
+                    self.policy.fabric_pressure_scale(level)
                 )
 
 

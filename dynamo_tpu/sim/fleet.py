@@ -78,6 +78,22 @@ class SimConfig:
     degrade_queue_factor: float = 0.5
     degrade_kv_factor: float = 0.95
     shed_queue_depth: int = 32
+    # fleet KV fabric (kvbm/fabric.py) modeled at prefix-family
+    # granularity: the first prefill of a prefix_id publishes it to the
+    # fleet catalog (G2 somewhere in the fleet); later requests of the
+    # family fetch the shared head at the fabric rate instead of
+    # recomputing it. Watermark pressure demotes least-popular families
+    # — hot ones to the shared bucket (slower fetch, survives), cold
+    # ones out of the fabric entirely (a fleet-wide miss; their home is
+    # a single worker's private disk). The planner's "demote cold KV"
+    # rung scales fabric_host_prefixes via LadderPolicy.
+    fabric: bool = False
+    fabric_host_prefixes: int = 6  # G2 capacity, in prefix families
+    fabric_hot_min_hits: int = 2
+    # fetch rates: peer host tier ≫ shared bucket, both ≫ the 20k tok/s
+    # prefill recompute they replace, both ≪ the 200k tok/s local onboard
+    fabric_peer_fetch_tok_s: float = 60_000.0
+    fabric_bucket_fetch_tok_s: float = 30_000.0
     worker: WorkerProfile = field(default_factory=WorkerProfile)
 
 
@@ -194,6 +210,18 @@ class FleetSim:
         self.workers_spawned = 0
         self.step_errors = 0
         self.degradation_level = 0
+        # fleet KV fabric scoreboard (prefix_id -> tier/hits/last_touch)
+        self._fabric: dict[int, dict[str, Any]] = {}
+        self._fabric_scale = 1.0
+        self.prefix_requests = 0         # prefill passes carrying a prefix
+        self.fleet_hits_host = 0
+        self.fleet_hits_bucket = 0
+        self.fleet_publishes = 0
+        self.fleet_demoted_bucket = 0
+        self.fleet_demoted_dropped = 0
+        self.fleet_fetched_tokens = 0
+        self.reprefill_tokens_avoided = 0
+        self.prefilled_tokens = 0        # tokens recomputed at prefill rate
         self.timeline: list[dict[str, Any]] = []
         self.horizon = (trace[-1].t if trace else 0.0) + self.config.drain_s
         self._next_adjust_t = 0.0
@@ -246,6 +274,12 @@ class FleetSim:
         self.spec_enabled = self.ladder.spec_enabled(
             self.config.spec_enabled, level
         )
+        # the "demote cold KV" rung: tighten the fabric's G2 watermark
+        # through the SAME LadderPolicy math ServingDegradation applies
+        # to a live FleetKvFabric, and demote immediately
+        self._fabric_scale = self.ladder.fabric_pressure_scale(level)
+        if self.config.fabric:
+            self._fabric_enforce()
 
     # -- load + snapshots ---------------------------------------------------
 
@@ -410,13 +444,79 @@ class FleetSim:
             # resumes re-prefill prompt + delivered tokens, at onboard
             # speed when the placement is cache-hot
             delay, rec.frontend_delay = rec.frontend_delay, 0.0
-            tokens = rec.req.prompt_tokens + rec.emitted
-            rate = (
-                self.config.worker.onboard_tok_s
-                if rec.resume_hot
-                else self.config.worker.prefill_tok_s
+            self.loop.after(
+                self._prefill_duration(rec) + delay,
+                self._on_prefill_done, rec,
             )
-            self.loop.after(tokens / rate + delay, self._on_prefill_done, rec)
+
+    def _prefill_duration(self, rec: _InFlight) -> float:
+        """Seconds this prefill pass occupies a prefill slot, split
+        between fabric fetch (the shared head, when the fleet catalog
+        hits) and recompute (everything else). Also the fabric's
+        publish/touch point — this is where a live KVBM's pump lands
+        blocks in G2 and prefetch pulls them from peers."""
+        w = self.config.worker
+        tokens = rec.req.prompt_tokens + rec.emitted
+        if rec.resume_hot:
+            # cache-hot resume: the whole re-prefill rides the local
+            # onboard path (no recompute, no fabric round trip)
+            return tokens / w.onboard_tok_s
+        if not self.config.fabric or rec.req.prefix_id < 0:
+            self.prefilled_tokens += tokens
+            return tokens / w.prefill_tok_s
+        pid = rec.req.prefix_id
+        ptoks = min(rec.req.prefix_tokens, tokens)
+        self.prefix_requests += 1
+        now = self.loop.now
+        entry = self._fabric.get(pid)
+        if entry is None:
+            # first sighting fleet-wide: pay the full prefill once, then
+            # publish the family to the catalog (G2 on this placement)
+            self._fabric[pid] = {"tier": "host", "hits": 1, "last": now}
+            self.fleet_publishes += 1
+            self._fabric_enforce()
+            self.prefilled_tokens += tokens
+            return tokens / w.prefill_tok_s
+        entry["hits"] += 1
+        entry["last"] = now
+        if entry["tier"] == "host":
+            self.fleet_hits_host += 1
+            fetch_rate = self.config.fabric_peer_fetch_tok_s
+        else:
+            self.fleet_hits_bucket += 1
+            fetch_rate = self.config.fabric_bucket_fetch_tok_s
+            # a bucket hit promotes the family back into G2 (the live
+            # onboard inserts fetched blocks into the host tier)
+            entry["tier"] = "host"
+            self._fabric_enforce()
+        self.fleet_fetched_tokens += ptoks
+        self.reprefill_tokens_avoided += ptoks
+        rest = tokens - ptoks
+        self.prefilled_tokens += rest
+        return ptoks / fetch_rate + rest / w.prefill_tok_s
+
+    def _fabric_enforce(self) -> None:
+        """Watermark pressure at prefix-family granularity: when more
+        families sit in G2 than the (ladder-scaled) capacity, demote
+        popularity-weighted victims — least-hit first, stalest breaking
+        ties. Hot families go to the shared bucket (still fleet-
+        fetchable, slower); cold ones leave the fabric (their only copy
+        is one worker's private disk — a fleet-wide miss)."""
+        cap = max(1, int(self.config.fabric_host_prefixes
+                         * self._fabric_scale))
+        host = [(pid, e) for pid, e in self._fabric.items()
+                if e["tier"] == "host"]
+        excess = len(host) - cap
+        if excess <= 0:
+            return
+        host.sort(key=lambda pe: (pe[1]["hits"], pe[1]["last"], pe[0]))
+        for pid, e in host[:excess]:
+            if e["hits"] >= self.config.fabric_hot_min_hits:
+                e["tier"] = "bucket"
+                self.fleet_demoted_bucket += 1
+            else:
+                del self._fabric[pid]
+                self.fleet_demoted_dropped += 1
 
     def _on_prefill_done(self, rec: _InFlight) -> None:
         self._prefill_busy = max(0, self._prefill_busy - 1)
@@ -565,6 +665,27 @@ class FleetSim:
             "degradation_level": self.degradation_level,
             "decode_workers_final": len(self.workers),
             "prefill_servers_final": self.prefill_servers,
+            # fleet KV fabric A/B surface (bench.py --kvfleet headline):
+            # prefilled_tokens is the recompute bill — with the fabric
+            # on, every fleet hit moves its shared head from this figure
+            # into fleet_fetched_tokens
+            "fabric": {
+                "enabled": self.config.fabric,
+                "prefix_requests": self.prefix_requests,
+                "fleet_hits": self.fleet_hits_host + self.fleet_hits_bucket,
+                "fleet_hits_host": self.fleet_hits_host,
+                "fleet_hits_bucket": self.fleet_hits_bucket,
+                "fleet_hit_rate": (
+                    (self.fleet_hits_host + self.fleet_hits_bucket)
+                    / self.prefix_requests if self.prefix_requests else 0.0
+                ),
+                "publishes": self.fleet_publishes,
+                "demoted_bucket": self.fleet_demoted_bucket,
+                "demoted_dropped": self.fleet_demoted_dropped,
+                "fleet_fetched_tokens": self.fleet_fetched_tokens,
+                "reprefill_tokens_avoided": self.reprefill_tokens_avoided,
+                "prefilled_tokens": self.prefilled_tokens,
+            },
             "planner": (
                 {
                     "decode_intent": self.planner.decode_workers,

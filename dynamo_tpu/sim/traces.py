@@ -31,6 +31,54 @@ class SimRequest:
     t: float  # arrival, simulated seconds
     prompt_tokens: int
     output_tokens: int
+    # shared-prefix identity for the fleet KV fabric model
+    # (sim/fleet.py): requests carrying the same prefix_id share their
+    # first prefix_tokens prompt tokens (a system prompt / few-shot
+    # header), which is what cross-worker prefix sharing dedups.
+    # -1 = private prompt (no shared prefix).
+    prefix_id: int = -1
+    prefix_tokens: int = 0
+
+
+@dataclass(frozen=True)
+class PrefixModel:
+    """Shared-prefix popularity: a Zipf draw over ``num_prefixes``
+    prefix families for ``share_frac`` of requests (the multi-tenant
+    shape: a few giant system prompts dominate, a long tail barely
+    repeats). Each family's prefix length is deterministic in its id —
+    clamped lognormal, seeded by the id — so every request of a family
+    agrees on how many leading tokens are shared."""
+
+    num_prefixes: int = 16
+    zipf_s: float = 1.1
+    share_frac: float = 0.8
+    prefix_median: float = 384.0
+    prefix_sigma: float = 0.5
+    prefix_min: int = 64
+    prefix_max: int = 2048
+
+    def prefix_len(self, prefix_id: int) -> int:
+        rng = random.Random(f"prefixlen:{prefix_id}")
+        n = rng.lognormvariate(math.log(self.prefix_median),
+                               self.prefix_sigma)
+        return int(min(self.prefix_max, max(self.prefix_min, n)))
+
+    def sample(self, rng: random.Random) -> tuple[int, int]:
+        """(prefix_id, prefix_tokens); (-1, 0) for a private prompt."""
+        if rng.random() >= self.share_frac:
+            return -1, 0
+        weights = [1.0 / (k + 1) ** self.zipf_s
+                   for k in range(self.num_prefixes)]
+        total = sum(weights)
+        u = rng.random() * total
+        acc = 0.0
+        pid = self.num_prefixes - 1
+        for k, w in enumerate(weights):
+            acc += w
+            if u <= acc:
+                pid = k
+                break
+        return pid, self.prefix_len(pid)
 
 
 @dataclass(frozen=True)
@@ -59,6 +107,18 @@ class LengthModel:
         )
 
 
+def _with_prefix(
+    rid: int, t: float, p: int, o: int,
+    prefixes: Optional[PrefixModel], rng: random.Random,
+) -> SimRequest:
+    """Attach a shared-prefix draw: the shared head is PREPENDED to the
+    sampled private remainder, so prefix-carrying prompts are longer —
+    exactly the cost cross-worker sharing exists to avoid recomputing."""
+    pid, ptoks = prefixes.sample(rng) if prefixes is not None else (-1, 0)
+    return SimRequest(rid=rid, t=t, prompt_tokens=p + ptoks,
+                      output_tokens=o, prefix_id=pid, prefix_tokens=ptoks)
+
+
 def poisson_trace(
     rate_fn: Callable[[float], float],
     rate_max: float,
@@ -66,6 +126,7 @@ def poisson_trace(
     seed: int,
     lengths: Optional[LengthModel] = None,
     rid_base: int = 0,
+    prefixes: Optional[PrefixModel] = None,
 ) -> list[SimRequest]:
     """Nonhomogeneous Poisson arrivals by thinning: propose at the
     envelope rate ``rate_max``, accept with ``rate_fn(t)/rate_max``."""
@@ -83,8 +144,7 @@ def poisson_trace(
             break
         if rng.random() <= rate_fn(t) / rate_max:
             p, o = lengths.sample(rng)
-            out.append(SimRequest(rid=rid, t=t, prompt_tokens=p,
-                                  output_tokens=o))
+            out.append(_with_prefix(rid, t, p, o, prefixes, rng))
             rid += 1
     return out
 
@@ -97,6 +157,7 @@ def diurnal_trace(
     period_s: float = 3600.0,
     lengths: Optional[LengthModel] = None,
     rid_base: int = 0,
+    prefixes: Optional[PrefixModel] = None,
 ) -> list[SimRequest]:
     """Sinusoidal day: rate swings base→peak→base once per period."""
     amp = (peak_rps - base_rps) / 2.0
@@ -106,7 +167,8 @@ def diurnal_trace(
         return mid - amp * math.cos(2.0 * math.pi * t / period_s)
 
     return poisson_trace(rate, peak_rps, duration_s, seed,
-                         lengths=lengths, rid_base=rid_base)
+                         lengths=lengths, rid_base=rid_base,
+                         prefixes=prefixes)
 
 
 def bursty_trace(
@@ -118,6 +180,7 @@ def bursty_trace(
     mean_burst_s: float = 20.0,
     lengths: Optional[LengthModel] = None,
     rid_base: int = 0,
+    prefixes: Optional[PrefixModel] = None,
 ) -> list[SimRequest]:
     """2-state MMPP: exponential dwell in calm/burst, Poisson arrivals
     at the state's rate. The burst state is the admission-control and
@@ -144,8 +207,7 @@ def bursty_trace(
         if t >= duration_s:
             break
         p, o = lengths.sample(rng)
-        out.append(SimRequest(rid=rid, t=t, prompt_tokens=p,
-                              output_tokens=o))
+        out.append(_with_prefix(rid, t, p, o, prefixes, rng))
         rid += 1
     return out
 
@@ -158,6 +220,7 @@ def merge_traces(*traces: list[SimRequest]) -> list[SimRequest]:
     )
     return [
         SimRequest(rid=i, t=r.t, prompt_tokens=r.prompt_tokens,
-                   output_tokens=r.output_tokens)
+                   output_tokens=r.output_tokens,
+                   prefix_id=r.prefix_id, prefix_tokens=r.prefix_tokens)
         for i, r in enumerate(merged)
     ]

@@ -245,6 +245,54 @@ KVBM_ONBOARDED_BLOCKS = REGISTRY.counter(
     "dynamo_kvbm_onboarded_blocks_total",
     "Blocks promoted from offload tiers back into device HBM",
 )
+KVBM_REMOTE_TIMEOUTS = REGISTRY.counter(
+    "dynamo_kvbm_remote_timeout_total",
+    "Blocking store round trips from the engine thread that hit their "
+    "deadline (G4 object plane + fleet catalog), by operation — each "
+    "one also books a flight-recorder record instead of killing the "
+    "offload pump",
+    labels=("op",),  # put | get | get_many | list | catalog.*
+)
+
+# -- fleet KV fabric (kvbm/fabric.py; docs/kvbm.md "Fleet fabric") -----------
+KVBM_FLEET_HITS = REGISTRY.counter(
+    "dynamo_kvbm_fleet_hits_total",
+    "Prompt blocks missing every local tier but onboarded from the "
+    "fleet instead of recomputed, by source (peer = another worker's "
+    "host tier over the wire plane, bucket = the shared G4 object "
+    "bucket adopted via the catalog)",
+    labels=("source",),  # peer | bucket
+)
+KVBM_FLEET_FETCHED_BLOCKS = REGISTRY.counter(
+    "dynamo_kvbm_fleet_fetched_blocks_total",
+    "Blocks landed in local tiers by fleet prefetch at admission",
+)
+KVBM_FLEET_FETCH_SECONDS = REGISTRY.histogram(
+    "dynamo_kvbm_fleet_fetch_seconds",
+    "Wall time of one peer host-tier fetch round trip (connect to "
+    "last block byte)",
+    buckets=(
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+        0.5, 1.0, 2.5, float("inf"),
+    ),
+)
+KVBM_FLEET_DEMOTED_BLOCKS = REGISTRY.counter(
+    "dynamo_kvbm_fleet_demoted_blocks_total",
+    "G2 blocks demoted by the watermark pressure lifecycle, by "
+    "destination (shared = hot shared prefixes to the G4 bucket, disk "
+    "= cold private blocks to local G3, dropped = no lower tier)",
+    labels=("dest",),  # shared | disk | dropped
+)
+KVBM_FLEET_CATALOG_ENTRIES = REGISTRY.gauge(
+    "dynamo_kvbm_fleet_catalog_entries",
+    "Distinct block hashes in this participant's fleet-catalog view "
+    "after the last snapshot refresh",
+)
+KVBM_FLEET_DANGLING = REGISTRY.counter(
+    "dynamo_kvbm_fleet_dangling_total",
+    "Catalog entries pruned because every advertised location failed "
+    "to produce the block (the request falls back to recompute)",
+)
 
 # -- SLO / goodput (telemetry/slo.py; targets via --slo-ttft-ms/--slo-itl-ms)
 # latency-target-shaped buckets: TTFT targets live in the tens-of-ms to

@@ -90,6 +90,14 @@ _REQUIRED_SERIES = [
     "dynamo_http_first_chunk_wait_seconds",
     "dynamo_http_sse_write_ema_seconds",
     "dynamo_http_drain_wait_seconds",
+    # ISSUE 18: the fleet KV fabric (kvbm/fabric.py, docs/kvbm.md)
+    "dynamo_kvbm_remote_timeout_total",
+    "dynamo_kvbm_fleet_hits_total",
+    "dynamo_kvbm_fleet_fetched_blocks_total",
+    "dynamo_kvbm_fleet_fetch_seconds",
+    "dynamo_kvbm_fleet_demoted_blocks_total",
+    "dynamo_kvbm_fleet_catalog_entries",
+    "dynamo_kvbm_fleet_dangling_total",
 ]
 
 
@@ -181,6 +189,19 @@ def test_observability_series_are_registered():
     assert REGISTRY.get("dynamo_http_loop_lag_seconds").label_names == ()
     assert REGISTRY.get("dynamo_http_loop_stalls_total").label_names == ()
     assert REGISTRY.get("dynamo_http_open_streams").label_names == ()
+    # fleet fabric: hit source and demotion destination are fixed enums
+    assert REGISTRY.get("dynamo_kvbm_fleet_hits_total").label_names == (
+        "source",
+    )
+    assert REGISTRY.get(
+        "dynamo_kvbm_fleet_demoted_blocks_total"
+    ).label_names == ("dest",)
+    assert REGISTRY.get("dynamo_kvbm_remote_timeout_total").label_names == (
+        "op",
+    )
+    assert REGISTRY.get(
+        "dynamo_kvbm_fleet_catalog_entries"
+    ).label_names == ()
 
 
 def test_metric_catalog_docs_match_registry():
