@@ -1,0 +1,143 @@
+"""``dynamo-tpu autopsy <rid>`` — render one request's timeline.
+
+Fetches ``/debug/request/{rid}`` from a frontend (or worker metrics
+server) and prints the record as an ASCII waterfall: each attributed
+stage as a bar positioned on the request's wall-clock span, followed
+by the router decisions, engine/prefill segments, and discrete events
+(shed, fault firings, migration splice, …). The footer checks that
+the attributed stages explain the request's wall time — a coverage
+gap means a stage nobody instrumented, which is itself the finding.
+
+With ``--json`` the raw record is printed instead (scriptable). When
+the record carries a trace id the footer prints the matching
+``dynamo-tpu trace export --rid`` invocation so the operator can jump
+from the waterfall to the full Perfetto span tree.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Optional, TextIO
+
+from dynamo_tpu.telemetry.autopsy import waterfall
+
+FETCH_TIMEOUT_S = 5.0
+BAR_WIDTH = 40
+
+
+def fetch_record(base_url: str, rid: str) -> tuple[Optional[dict], str]:
+    """GET the record; returns (record, "") or (None, error-reason)."""
+    url = base_url.rstrip("/") + "/debug/request/" + urllib.parse.quote(rid)
+    try:
+        with urllib.request.urlopen(url, timeout=FETCH_TIMEOUT_S) as resp:
+            return json.loads(resp.read().decode()), ""
+    except urllib.error.HTTPError as exc:
+        if exc.code == 404:
+            try:
+                detail = json.loads(exc.read().decode()).get("error", "")
+            except Exception:
+                detail = ""
+            return None, detail or f"no record for {rid!r}"
+        return None, f"HTTP {exc.code} from {url}"
+    except (urllib.error.URLError, OSError, ValueError) as exc:
+        return None, f"cannot reach {url}: {exc}"
+
+
+def _bar(start_ms: float, dur_ms: float, total_ms: float) -> str:
+    """One waterfall lane: offset spaces, then a bar sized to share of
+    the total. Zero-duration stages still get one visible tick."""
+    if total_ms <= 0:
+        return ""
+    lo = int(round(BAR_WIDTH * max(0.0, start_ms) / total_ms))
+    n = int(round(BAR_WIDTH * max(0.0, dur_ms) / total_ms))
+    lo = min(lo, BAR_WIDTH - 1)
+    n = max(1, min(n, BAR_WIDTH - lo))
+    return " " * lo + "#" * n
+
+
+def render(record: dict, out: TextIO) -> None:
+    rid = record.get("rid", "?")
+    flags = record.get("flags") or []
+    out.write(f"request {rid}  endpoint={record.get('endpoint', '?')}  "
+              f"status={record.get('status', '?')}"
+              f"{'  [in-flight]' if not record.get('finished') else ''}\n")
+    if flags:
+        out.write(f"flags: {', '.join(flags)}  "
+                  f"(retained: {record.get('retained', '?')})\n")
+    wf = waterfall(record)
+    total = wf["total_ms"]
+    if wf["rows"]:
+        name_w = max(len(r["name"]) for r in wf["rows"])
+        out.write(f"\n{'stage':<{name_w}}  {'start':>10} {'dur':>10}\n")
+        for r in wf["rows"]:
+            out.write(
+                f"{r['name']:<{name_w}}  {r['start_ms']:>8.1f}ms "
+                f"{r['dur_ms']:>8.1f}ms |"
+                f"{_bar(r['start_ms'], r['dur_ms'], total):<{BAR_WIDTH}}|\n"
+            )
+        mark = "OK" if wf["covered"] else "GAP"
+        out.write(
+            f"[{mark}] wall {total:.1f}ms, attributed "
+            f"{wf['explained_ms']:.1f}ms "
+            f"({wf['coverage'] * 100:.1f}% coverage)\n"
+        )
+    router = record.get("router") or []
+    if router:
+        out.write("\nrouter:\n")
+        for d in router:
+            bits = [f"worker={d.get('worker', '?')}",
+                    f"mode={d.get('mode', '?')}"]
+            if d.get("total_blocks"):
+                bits.append(
+                    f"overlap={d.get('overlap_blocks', 0)}/"
+                    f"{d['total_blocks']} blocks"
+                )
+            if d.get("fleet_blocks"):
+                bits.append(f"fleet={d['fleet_blocks']}")
+            if d.get("resume"):
+                bits.append("RESUME")
+            out.write(f"  {' '.join(bits)}\n")
+    segments = record.get("segments") or []
+    if segments:
+        out.write("\nsegments:\n")
+        for s in segments:
+            src = s.get("source", "?")
+            rest = {k: v for k, v in s.items() if k != "source"}
+            out.write(f"  [{src}] " + " ".join(
+                f"{k}={json.dumps(v)}" for k, v in sorted(rest.items())
+            ) + "\n")
+    events = record.get("events") or []
+    if events:
+        out.write("\nevents:\n")
+        for e in events:
+            kind = e.get("kind", "?")
+            t = e.get("t_ms")
+            t_s = f"{t:>8.1f}ms" if isinstance(t, (int, float)) else "       --"
+            rest = {k: v for k, v in e.items() if k not in ("kind", "t_ms")}
+            out.write(f"  {t_s}  {kind}  " + " ".join(
+                f"{k}={json.dumps(v)}" for k, v in sorted(rest.items())
+            ) + "\n")
+    trace_id = record.get("trace_id")
+    if trace_id:
+        out.write(
+            f"\ntrace_id: {trace_id}\n"
+            f"  spans: dynamo-tpu trace export <span-log.jsonl ...> "
+            f"--rid {rid} -o trace.json\n"
+        )
+    out.flush()
+
+
+def cmd_autopsy(args: Any) -> int:
+    record, err = fetch_record(args.url, args.rid)
+    if record is None:
+        print(f"autopsy: {err}", file=sys.stderr)
+        return 1
+    if getattr(args, "json", False):
+        print(json.dumps(record, indent=1))
+        return 0
+    render(record, sys.stdout)
+    return 0

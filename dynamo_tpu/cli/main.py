@@ -357,6 +357,10 @@ def build_parser() -> argparse.ArgumentParser:
                        help="output path (default stdout)")
     trace.add_argument("--trace-id", default=None,
                        help="filter to one trace (id prefix is enough)")
+    trace.add_argument("--rid", default=None,
+                       help="filter to the trace(s) of one request id "
+                            "(X-Request-Id) — resolved by scanning span "
+                            "attrs; pairs with `dynamo-tpu autopsy`")
 
     # observability: `dynamo-tpu top` (live fleet view over /debug/state)
     top = sub.add_parser(
@@ -382,6 +386,19 @@ def build_parser() -> argparse.ArgumentParser:
                      help="sort workers by roofline_frac ascending — "
                           "the worker losing the most throughput to "
                           "its loss buckets renders first")
+
+    # observability: `dynamo-tpu autopsy <rid>` (per-request timeline)
+    autopsy_p = sub.add_parser(
+        "autopsy", help="fetch one request's autopsy record "
+                        "(/debug/request/{rid}) and render an ASCII "
+                        "waterfall with a wall-clock coverage check"
+    )
+    autopsy_p.add_argument("rid", help="request id (X-Request-Id)")
+    autopsy_p.add_argument("--url", default="http://127.0.0.1:8000",
+                           help="frontend or metrics-server base URL")
+    autopsy_p.add_argument("--json", action="store_true",
+                           help="print the raw record instead of the "
+                                "waterfall")
 
     models = sub.add_parser("models", help="model registry ctl (≈ llmctl)")
     models.add_argument("action", choices=["list", "register", "remove"])
@@ -1669,12 +1686,30 @@ def cmd_trace(args: Any) -> int:
     if not files:
         print("error: none of the span logs exist", file=sys.stderr)
         return 1
+    trace_id = args.trace_id
+    if getattr(args, "rid", None):
+        if trace_id:
+            print("error: --rid and --trace-id are mutually exclusive",
+                  file=sys.stderr)
+            return 1
+        from dynamo_tpu.telemetry.export import trace_ids_for_request
+
+        ids = trace_ids_for_request(files, args.rid)
+        if not ids:
+            print(f"error: no spans carry request_id={args.rid!r} "
+                  "(was the frontend started with DYN_TRACE_FILE?)",
+                  file=sys.stderr)
+            return 1
+        if len(ids) > 1:
+            print(f"warning: rid {args.rid!r} matched {len(ids)} traces; "
+                  f"exporting {ids[0]}", file=sys.stderr)
+        trace_id = ids[0]
     if args.output:
         with open(args.output, "w") as f:
-            n = export_chrome_trace(files, f, trace_id=args.trace_id)
+            n = export_chrome_trace(files, f, trace_id=trace_id)
         print(f"exported {n} spans -> {args.output}", file=sys.stderr)
     else:
-        n = export_chrome_trace(files, sys.stdout, trace_id=args.trace_id)
+        n = export_chrome_trace(files, sys.stdout, trace_id=trace_id)
         print(f"exported {n} spans", file=sys.stderr)
     return 0 if n else 1
 
@@ -1693,6 +1728,11 @@ def main(argv: Optional[list[str]] = None) -> None:
         from dynamo_tpu.cli.top import cmd_top
 
         sys.exit(cmd_top(args))
+    if args.command == "autopsy":
+        # one HTTP GET + terminal render: no logging/jax setup
+        from dynamo_tpu.cli.autopsy import cmd_autopsy
+
+        sys.exit(cmd_autopsy(args))
     init_logging()
     from dynamo_tpu.utils.jaxtools import configure_from_env
 

@@ -18,6 +18,13 @@ Host-plane columns (telemetry/hostplane.py, polled best-effort from
 ms, STRM = open SSE streams, RPS = finished requests/sec derived from
 ``ledger.requests_total`` deltas (same ``-`` rule as TOK/S: first
 poll, zero poll gap, and counter rewinds render absence, not 0.0).
+
+SLOW counts the endpoint's retained autopsy exemplars (telemetry/
+autopsy.py, best-effort from ``/debug/requests``): requests kept by
+tail sampling because they were flagged (SLO miss, migrated, faulted,
+shed, …) or landed in the p99 latency tail — a rising SLOW with a flat
+SSTEP (flight-recorder slow steps) points the operator at the host/
+fleet path rather than the device loop.
 """
 
 from __future__ import annotations
@@ -74,6 +81,38 @@ async def fetch_hostplane(
             return await resp.json()
     except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
         return None
+
+
+async def fetch_requests(
+    session: aiohttp.ClientSession, base_url: str
+) -> Optional[dict[str, Any]]:
+    """Best-effort /debug/requests poll (request-autopsy exemplar
+    index). An endpoint predating the autopsy plane renders ``-`` in
+    the SLOW column rather than erroring the row."""
+    url = base_url.rstrip("/") + "/debug/requests"
+    try:
+        async with session.get(url, timeout=aiohttp.ClientTimeout(
+            total=POLL_TIMEOUT_S
+        )) as resp:
+            if resp.status != 200:
+                return None
+            return await resp.json()
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+        return None
+
+
+def _autopsy_cols(ap: Optional[dict]) -> dict:
+    """SLOW column from a /debug/requests payload: the count of
+    retained exemplars. Absence (no autopsy plane, error stanza, or a
+    malformed payload) renders ``-``; an empty exemplar ring is real
+    data and renders 0."""
+    cols: dict[str, Any] = {"slow_requests": None}
+    coll = (ap or {}).get("collector")
+    if isinstance(coll, dict):
+        ex = coll.get("exemplars")
+        if isinstance(ex, list):
+            cols["slow_requests"] = len(ex)
+    return cols
 
 
 def _hostplane_cols(
@@ -155,7 +194,7 @@ def _engine_row(url: str, state: dict, prev: Optional[dict],
 HEADER = (
     f"{'WORKER':<28} {'MODEL':<12} {'RUN':>5} {'WAIT':>5} "
     f"{'KV%':>7} {'TOK/S':>8} {'ROOF%':>7} {'LOSS':>10} {'SLO%':>7} "
-    f"{'HBM':>9} {'SLOW':>5} {'PREEMPT':>7} "
+    f"{'HBM':>9} {'SSTEP':>5} {'SLOW':>5} {'PREEMPT':>7} "
     f"{'LAG99':>7} {'STRM':>6} {'RPS':>7}"
 )
 
@@ -186,6 +225,7 @@ def render_frame(rows: list[dict], out: TextIO) -> None:
             f"{_pct(r['slo']):>7} "
             f"{_fmt_bytes(r['hbm']):>9} "
             f"{str(r['slow_steps'] if r['slow_steps'] is not None else '-'):>5} "
+            f"{str(r['slow_requests'] if r.get('slow_requests') is not None else '-'):>5} "
             f"{str(r['preemptions'] if r['preemptions'] is not None else '-'):>7} "
             f"{lag_s} {str(strm if strm is not None else '-'):>6} {rps_s}\n"
         )
@@ -221,9 +261,14 @@ async def run_top(
             hp_results = await asyncio.gather(
                 *[fetch_hostplane(session, u) for u in urls]
             )
+            ap_results = await asyncio.gather(
+                *[fetch_requests(session, u) for u in urls]
+            )
             rows: list[dict] = []
             all_failed = True
-            for url, res, hp in zip(urls, results, hp_results):
+            for url, res, hp, ap in zip(
+                urls, results, hp_results, ap_results
+            ):
                 if isinstance(res, BaseException):
                     rows.append({"url": url, "error": str(res) or
                                  type(res).__name__})
@@ -237,6 +282,7 @@ async def run_top(
                 row.update(_hostplane_cols(
                     hp, prev_hp.get(url), now, p[1] if p else None,
                 ))
+                row.update(_autopsy_cols(ap))
                 rows.append(row)
                 prev[url] = (res, now)
                 prev_hp[url] = hp

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import Any, AsyncIterator, Optional
 
@@ -38,7 +39,7 @@ from dynamo_tpu.kvbm import BlockLayout
 from dynamo_tpu.protocols.common import PreprocessedRequest
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
 from dynamo_tpu.store.base import Store
-from dynamo_tpu.telemetry import get_tracer, propagation_context
+from dynamo_tpu.telemetry import autopsy, get_tracer, propagation_context
 from dynamo_tpu.telemetry.instruments import (
     DEADLINE_EXPIRED,
     DISAGG_LOCAL_FALLBACKS,
@@ -158,6 +159,7 @@ class DisaggDecodeEngine(AsyncEngine):
                    "queue_depth": depth},
         )
         t0 = time.monotonic()
+        timed_out = False
         # the finally must cover the enqueue too: a store failure there
         # would otherwise leak the completion-event entry, the span,
         # and the queue-wait observation
@@ -180,11 +182,26 @@ class DisaggDecodeEngine(AsyncEngine):
             await asyncio.wait_for(done.wait(), timeout=wait_s)
         except asyncio.TimeoutError:
             self.local_fallbacks += 1
+            timed_out = True
             DISAGG_LOCAL_FALLBACKS.inc()
             span.set_attr("timeout_fallback", True)
             log.warning("remote prefill %s timed out; prefilling locally", rid)
         finally:
             PREFILL_QUEUE_WAIT.observe(time.monotonic() - t0)
+            # request autopsy: the decode-side remote-prefill wait as
+            # its own segment — it parks in this process's pending
+            # table and ships with the engine segment on the seg frame
+            # keyed on the CALLER's Context.id (the frontend's autopsy
+            # rid), not the preprocessor's request_id — ctx.id is what
+            # the endpoint server's take_pending ships on the seg frame
+            autopsy.publish_segment(context.id or rid, {
+                "source": "remote_prefill",
+                "pid": os.getpid(),
+                "wait_ms": round((time.monotonic() - t0) * 1e3, 3),
+                "queue_depth": depth,
+                "prefill_tokens": prefill_len,
+                "timeout_fallback": timed_out,
+            })
             span.end()
             self.server.discard_completion(rid)
 

@@ -65,7 +65,7 @@ from dynamo_tpu.protocols.common import (
     SamplingOptions,
 )
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
-from dynamo_tpu.telemetry import get_tracer
+from dynamo_tpu.telemetry import autopsy, get_tracer
 from dynamo_tpu.telemetry.debug import (
     register_debug_provider,
     unregister_debug_provider,
@@ -4214,6 +4214,13 @@ class JaxEngine:
         sched.append_token(seq, token)
         ENGINE_TOKENS_GENERATED.inc()
         self.tokens_generated_total += 1
+        reason = sched.should_finish(seq)
+        if reason is not None:
+            # finalize SLO + autopsy BEFORE the last token item hits the
+            # output queue: the serving layer ships the autopsy payload
+            # ahead of each item, and consumers abandon the stream at
+            # this token (max_tokens), never reaching the finish item
+            self._finalize_observability(seq, reason)
         if seq.emit is not None:
             tl = None
             if top is not None and (seq.request.output.logprobs or 0) > 0:
@@ -4226,7 +4233,6 @@ class JaxEngine:
                     top_logprobs=tl,
                 )
             )
-        reason = sched.should_finish(seq)
         if reason is not None:
             sched.finish(seq, reason)
 
@@ -4258,6 +4264,10 @@ class JaxEngine:
         if kept_toks:
             ENGINE_TOKENS_GENERATED.inc(len(kept_toks))
             self.tokens_generated_total += len(kept_toks)
+        if finish is not None:
+            # see _emit_token: the autopsy payload must be pending
+            # before the last token item is queued
+            self._finalize_observability(seq, finish)
         if kept_toks and seq.emit is not None:
             seq.emit(
                 LLMEngineOutput(
@@ -4277,7 +4287,7 @@ class JaxEngine:
         wait → prefill → decode) from the lifecycle stamps the
         scheduler recorded."""
         ENGINE_REQUESTS_FINISHED.labels(str(reason.value)).inc()
-        self._observe_slo(seq, reason)
+        self._finalize_observability(seq, reason)
         self._emit_lifecycle_spans(seq, reason)
         if seq.emit is not None:
             seq.emit(
@@ -4290,7 +4300,26 @@ class JaxEngine:
             )
             seq.emit(None)  # sentinel: stream closed
 
-    def _observe_slo(self, seq: Sequence, reason: FinishReason) -> None:
+    def _finalize_observability(
+        self, seq: Sequence, reason: FinishReason
+    ) -> None:
+        """SLO verdict + autopsy segment, exactly once per request.
+
+        Called EARLY — before the last token item is emitted — from the
+        decode paths (consumers abandon the stream at max_tokens, so a
+        payload published at the finish item would never ship), and
+        again from the on_finish hook for paths that end without a
+        trailing token (aborts, deadline kills, prefill-only finishes);
+        the guard makes the second call a no-op."""
+        if seq.observability_done:
+            return
+        seq.observability_done = True
+        slo_met = self._observe_slo(seq, reason)
+        self._publish_autopsy(seq, reason, slo_met)
+
+    def _observe_slo(
+        self, seq: Sequence, reason: FinishReason
+    ) -> Optional[bool]:
         """Per-request TTFT/ITL vs the configured targets (telemetry/
         slo.py). Engine-side TTFT = submit → first appended token; ITL
         = mean decode inter-token latency. Requests that never produced
@@ -4298,7 +4327,8 @@ class JaxEngine:
         they'd poison attainment with infrastructure failures the SLO
         targets don't describe. An SLO miss trips the flight recorder's
         request watchdog so the steps that served the slow request are
-        preserved on disk."""
+        preserved on disk. Returns the verdict (None = unscored) so the
+        autopsy segment can carry the slo_miss flag."""
         if reason in (
             FinishReason.ERROR, FinishReason.CANCELLED, FinishReason.TIMEOUT
         ):
@@ -4306,9 +4336,9 @@ class JaxEngine:
             # score: counting an errored request's fast partial tokens
             # as 'met' goodput would report a fleet in an error loop as
             # HEALTHY — the opposite of what the Planner signal means
-            return
+            return None
         if not seq.t_submit or not seq.t_first_token:
-            return
+            return None
         ttft_s = seq.t_first_token - seq.t_submit
         itl_s = None
         if seq.generated > 1:
@@ -4329,6 +4359,87 @@ class JaxEngine:
                 # too (both limiters gate independently — a suppressed
                 # ring dump means a recent bundle already exists)
                 self.blackbox.trigger(f"slo_miss:{seq.request_id}")
+        return met
+
+    def _publish_autopsy(
+        self, seq: Sequence, reason: FinishReason, slo_met: Optional[bool]
+    ) -> None:
+        """Publish the request's engine-side autopsy segment under its
+        rid (telemetry/autopsy.py). In the frontend's process it lands
+        straight on the active record; on a remote worker it parks in
+        the pending table and the endpoint server ships it on the
+        ``seg`` wire frame before fin. One bounded dict per request —
+        the per-step decode summary comes from the flight recorder's
+        ring tail and only for requests that missed their SLO, so the
+        happy path stays O(1)."""
+        try:
+            now = time.monotonic()
+            seg: dict = {
+                "source": "engine",
+                "pid": os.getpid(),
+                "finish_reason": str(reason.value),
+                "prompt_tokens": len(seq.request.token_ids),
+                "cached_prompt_tokens": seq.num_cached_prompt,
+                "tokens": seq.generated,
+                "resume_offset": int(
+                    getattr(seq.request, "resume_offset", 0) or 0
+                ),
+                "guided": seq.guided_state is not None,
+                "slo_miss": slo_met is False,
+            }
+            if seq.t_submit:
+                if seq.t_admit:
+                    seg["queue_wait_ms"] = round(
+                        (seq.t_admit - seq.t_submit) * 1e3, 3
+                    )
+                if seq.t_admit and seq.t_prefill_done:
+                    seg["prefill_ms"] = round(
+                        (seq.t_prefill_done - seq.t_admit) * 1e3, 3
+                    )
+                if seq.t_prefill_done:
+                    seg["decode_ms"] = round(
+                        (now - seq.t_prefill_done) * 1e3, 3
+                    )
+                if seq.t_first_token:
+                    seg["ttft_ms"] = round(
+                        (seq.t_first_token - seq.t_submit) * 1e3, 3
+                    )
+            sched = self.scheduler
+            if sched is not None:
+                seg["preemptions_total"] = sched.preemptions
+            if self.spec_proposed_total:
+                seg["spec"] = {
+                    "proposed_total": self.spec_proposed_total,
+                    "accepted_total": self.spec_accepted_total,
+                    "accept_rate": round(
+                        self.spec_accepted_total
+                        / max(1, self.spec_proposed_total),
+                        4,
+                    ),
+                }
+            if slo_met is False and self.recorder is not None:
+                steps = [
+                    r for r in self.recorder.snapshot(32)
+                    if r.get("kind") in ("decode", "mixed", "spec")
+                ]
+                if steps:
+                    durs = [
+                        float(r.get("duration_ms") or 0.0) for r in steps
+                    ]
+                    seg["decode_window"] = {
+                        "steps": len(steps),
+                        "mean_ms": round(sum(durs) / len(durs), 3),
+                        "max_ms": round(max(durs), 3),
+                        "slow_steps": sum(
+                            1 for r in steps if r.get("slow")
+                        ),
+                    }
+            autopsy.publish_segment(
+                seq.autopsy_rid or seq.request_id, seg
+            )
+        except Exception:
+            # the autopsy plane must never take down a finishing request
+            log.exception("autopsy segment publish failed")
 
     def _emit_lifecycle_spans(self, seq: Sequence, reason: FinishReason) -> None:
         """Record the engine's per-request spans at finish time. Span
@@ -4550,6 +4661,7 @@ class JaxEngine:
             emit=emit,
             is_cancelled=lambda: context.is_stopped,
             mm_segments=mm_segments,
+            autopsy_rid=getattr(context, "id", "") or "",
         )
         if guided_automaton is not None:
             from dynamo_tpu.guided import GuidedState

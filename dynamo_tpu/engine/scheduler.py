@@ -29,6 +29,7 @@ import numpy as np
 
 from dynamo_tpu.engine.allocator import BlockAllocator, NoBlocksError
 from dynamo_tpu.protocols.common import FinishReason, PreprocessedRequest
+from dynamo_tpu.telemetry import autopsy
 from dynamo_tpu.telemetry.instruments import (
     DEADLINE_EXPIRED,
     ENGINE_PREEMPTIONS,
@@ -97,6 +98,16 @@ class Sequence:
     t_first_token: float = 0.0  # first generated token appended (TTFT)
     # propagated trace context ({"trace_id", "span_id"}) or None
     trace: Optional[dict] = None
+    # the CALLER's request id (Context.id — the frontend's autopsy key),
+    # distinct from request.request_id (the preprocessor's cmpl-… id):
+    # engine-side autopsy segments/events must key on this or the
+    # endpoint server's take_pending(ctx.id) never finds them
+    autopsy_rid: str = ""
+    # SLO + autopsy finalization must run BEFORE the last token item is
+    # emitted (consumers abandon the stream at max_tokens, ahead of the
+    # finish-marked item) — this guard keeps the early call and the
+    # on_finish hook from double-counting
+    observability_done: bool = False
 
     @property
     def request_id(self) -> str:
@@ -448,9 +459,20 @@ class Scheduler:
                 complete = seq_hashes[: n_prompt_blocks]
                 blocks, cached = self.allocator.allocate_prefix(complete)
                 if self.onboard is not None and cached < len(complete):
-                    n_on = self.onboard(
-                        complete[cached:], blocks[cached : len(complete)]
+                    # the onboard hook is (hashes, blocks) -> n with no
+                    # request identity — park the admitting seq's rid in
+                    # the autopsy thread-local so the fleet fabric's
+                    # prefetch (same thread, synchronous chain) can
+                    # stamp its hit/miss onto this request's record
+                    autopsy.set_onboard_rid(
+                        seq.autopsy_rid or seq.request_id
                     )
+                    try:
+                        n_on = self.onboard(
+                            complete[cached:], blocks[cached : len(complete)]
+                        )
+                    finally:
+                        autopsy.set_onboard_rid(None)
                     for i in range(n_on):
                         self.allocator.commit_block(
                             blocks[cached + i], complete[cached + i]

@@ -139,6 +139,18 @@ class FaultInjector:
             "fault fired: %s %s%s ctx=%s", rule.point, rule.kind,
             f"={rule.value}" if rule.value is not None else "", ctx,
         )
+        rid = ctx.get("request_id")
+        if rid:
+            # request autopsy: a fault that fired WITH a request id in
+            # scope lands on that request's timeline and flags it for
+            # exemplar retention (import here: faults is imported by
+            # layers below telemetry)
+            from dynamo_tpu.telemetry import autopsy
+
+            autopsy.note_event(
+                str(rid), "fault", flag="faulted",
+                point=rule.point, fault_kind=rule.kind,
+            )
         for listener in list(self._listeners):
             try:
                 listener(rec)

@@ -50,6 +50,7 @@ from dynamo_tpu.telemetry import (
     get_tracer,
     propagation_context,
 )
+from dynamo_tpu.telemetry import autopsy
 from dynamo_tpu.telemetry.hostplane import (
     LEDGER,
     LoopLagMonitor,
@@ -168,6 +169,8 @@ class HttpService:
                 web.get("/debug/attribution", self._debug_attribution),
                 web.get("/debug/hostplane", self._debug_hostplane),
                 web.get("/debug/kvfleet", self._debug_kvfleet),
+                web.get("/debug/requests", self._debug_requests),
+                web.get("/debug/request/{rid}", self._debug_request),
                 web.get("/debug/profile", self._debug_profile),
                 web.get("/v1/models", self._models),
                 web.post("/v1/chat/completions", self._chat),
@@ -266,6 +269,26 @@ class HttpService:
         }
         return web.json_response(fleet)
 
+    async def _debug_requests(self, request: web.Request) -> web.Response:
+        """Request-autopsy exemplar index (docs/observability.md
+        "Request autopsy"): retention counters + one summary line per
+        retained tail exemplar, via the autopsy provider registry."""
+        return web.json_response(autopsy.collect_autopsy())
+
+    async def _debug_request(self, request: web.Request) -> web.Response:
+        """One request's full autopsy record: in-flight (partial) or a
+        retained exemplar. 404 = never seen here, or finished fast and
+        clean and was dropped by tail retention."""
+        rid = request.match_info["rid"]
+        rec = autopsy.get_record(rid)
+        if rec is None:
+            return web.json_response(
+                {"error": f"no autopsy record for {rid!r} (never seen, "
+                          "or dropped at finish by tail retention)"},
+                status=404,
+            )
+        return web.json_response(rec)
+
     async def _debug_profile(self, request: web.Request) -> web.Response:
         """On-demand ``jax.profiler`` capture: ``/debug/profile?ms=N``
         records N ms and returns the Perfetto-loadable trace dir."""
@@ -311,6 +334,11 @@ class HttpService:
         # every stage below; downstream stages (preprocessor tool
         # parser, router dispatch) stamp by request id via note_stage
         self.hostplane.begin(rid, endpoint)
+        # autopsy record (telemetry/autopsy.py): the per-request join
+        # layer — router dials, engine segments, and fleet events land
+        # on this rid; the hostplane row is adopted at finish
+        autopsy.begin_request(rid, endpoint)
+        autopsy.set_trace(rid, span.trace_id or None)
         try:
             if faults.ACTIVE is not None:
                 # per-request chaos: the X-Dyn-Fault header arms rules
@@ -341,6 +369,11 @@ class HttpService:
                         "shedding request %s: %s", rid, rejection.detail
                     )
                     span.set_attr("shed", rejection.reason)
+                    autopsy.note_event(
+                        rid, "shed", flag="shed",
+                        reason=rejection.reason,
+                        retry_after_s=round(rejection.retry_after_s, 3),
+                    )
                     return self._error(
                         429,
                         f"server overloaded ({rejection.detail}); retry "
@@ -426,6 +459,7 @@ class HttpService:
                 # request instead of burning steps past its deadline
                 ctx.set_deadline_ms(deadline_ms)
                 span.set_attr("deadline_ms", deadline_ms)
+                autopsy.note_event(rid, "deadline_budget", ms=deadline_ms)
             # the head's decision governs the WHOLE trace: a sampled-out
             # root propagates {"sampled": False} so downstream processes
             # don't start orphan root traces of their own
@@ -470,7 +504,9 @@ class HttpService:
                 HTTP_DURATION.labels(model, endpoint).observe(
                     time.monotonic() - start
                 )
-                self.hostplane.finish(rid, "200")
+                autopsy.finish_request(
+                    rid, "200", host=self.hostplane.finish(rid, "200")
+                )
                 return web.json_response(
                     agg.response().model_dump(exclude_none=True),
                     headers={REQUEST_ID_HEADER: rid},
@@ -489,6 +525,12 @@ class HttpService:
                     "rejecting request %s as invalid: %s", rid, exc,
                     exc_info=True,
                 )
+                # covers guided-rejects (uncompilable schemas): flagged
+                # so the autopsy exemplar survives tail retention
+                autopsy.note_event(
+                    rid, "request_rejected", flag="rejected",
+                    error=str(exc)[:200],
+                )
                 return self._error(
                     400, f"invalid request: {exc}", model, endpoint, rid
                 )
@@ -503,8 +545,10 @@ class HttpService:
             # error/shed/4xx paths return before their stage reached a
             # finish() call — close the ledger record so the active
             # table can't grow (finish is idempotent: happy paths
-            # already popped theirs)
-            self.hostplane.finish(rid, "error")
+            # already popped theirs; the autopsy close mirrors it)
+            autopsy.finish_request(
+                rid, "error", host=self.hostplane.finish(rid, "error")
+            )
             span.end()
             set_log_request_id(None)
 
@@ -565,7 +609,9 @@ class HttpService:
         finally:
             HTTP_REQUESTS.labels(model, endpoint, status).inc()
             HTTP_DURATION.labels(model, endpoint).observe(time.monotonic() - start)
-            self.hostplane.finish(rid, status)
+            autopsy.finish_request(
+                rid, status, host=self.hostplane.finish(rid, status)
+            )
         with contextlib.suppress(ConnectionResetError):
             await resp.write_eof()
         return resp

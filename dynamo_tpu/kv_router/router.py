@@ -28,6 +28,7 @@ from dynamo_tpu.runtime.migration import (
     MigrationConfig,
     migrating_stream,
 )
+from dynamo_tpu.telemetry import autopsy
 
 log = logging.getLogger("dynamo_tpu.kv_router")
 
@@ -151,6 +152,16 @@ class KvPushRouter(AsyncEngine):
             )
             decision = self.router.schedule(
                 list(token_ids), exclude=exclude, resume=resume
+            )
+            # request autopsy: the routing decision — worker chosen plus
+            # the overlap/fleet-block score that chose it (re-dials and
+            # resumes append their own entries)
+            autopsy.note_router(
+                context.id, decision.worker_id,
+                overlap_blocks=decision.overlap_blocks,
+                total_blocks=decision.total_blocks,
+                fleet_blocks=decision.fleet_blocks,
+                resume=resume, mode="kv",
             )
             # annotate the request with the expected prefix hit (the
             # worker's disagg router uses it, reference: worker.py

@@ -43,6 +43,7 @@ from typing import Any, Callable, Optional
 
 import msgpack
 
+from dynamo_tpu.telemetry import autopsy
 from dynamo_tpu.telemetry.debug import (
     register_debug_provider,
     unregister_debug_provider,
@@ -700,6 +701,17 @@ class FleetKvFabric:
         if fetched:
             self.stats.fetched_blocks += fetched
             KVBM_FLEET_FETCHED_BLOCKS.inc(fetched)
+        # request autopsy: the admission path parks the admitting seq's
+        # rid in a thread-local (engine/scheduler.py around the onboard
+        # hook) — stamp this prefetch's outcome onto that request's
+        # timeline (one bounded event per admission, not per block)
+        rid = autopsy.current_onboard_rid()
+        if rid:
+            autopsy.note_event(
+                rid, "kvfleet_prefetch",
+                blocks=fetched, hit=bool(fetched),
+                requested=len(seq_hashes),
+            )
         return fetched
 
     def _fetch_one(self, seq_hash: int, locs: list[tuple[int, dict]]) -> bool:

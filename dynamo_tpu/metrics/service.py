@@ -94,6 +94,23 @@ class MetricsService:
             "llm_roofline_frac", "mean live roofline fraction across "
             "workers with decode activity")
 
+    def build_app(self) -> web.Application:
+        """The debug/metrics route table, separable from ``start()`` so
+        the endpoint-parity test can compare it against the HTTP
+        frontend's without binding a socket. The ``/debug/*`` surface
+        mirrors the frontend: an operator mid-incident must not have to
+        remember which port grew which endpoint."""
+        app = web.Application()
+        app.router.add_get("/metrics", self._handle_metrics)
+        app.router.add_get("/debug/state", self._handle_debug_state)
+        app.router.add_get("/debug/attribution", self._handle_debug_attribution)
+        app.router.add_get("/debug/hostplane", self._handle_debug_hostplane)
+        app.router.add_get("/debug/kvfleet", self._handle_debug_kvfleet)
+        app.router.add_get("/debug/requests", self._handle_debug_requests)
+        app.router.add_get("/debug/request/{rid}", self._handle_debug_request)
+        app.router.add_get("/debug/profile", self._handle_debug_profile)
+        return app
+
     async def start(self) -> None:
         sub = await self.component.subscribe("load_metrics")
         self.aggregator.start_consuming(sub)
@@ -111,13 +128,7 @@ class MetricsService:
         # spawn (not bare create_task): a crash in the hit-rate pump is
         # logged instead of dying silently with hit-rate gauges frozen
         self._hit_task = spawn(pump_hits(), name="metrics-hit-pump")
-        app = web.Application()
-        app.router.add_get("/metrics", self._handle_metrics)
-        app.router.add_get("/debug/state", self._handle_debug_state)
-        app.router.add_get("/debug/attribution", self._handle_debug_attribution)
-        app.router.add_get("/debug/hostplane", self._handle_debug_hostplane)
-        app.router.add_get("/debug/profile", self._handle_debug_profile)
-        self._runner = web.AppRunner(app)
+        self._runner = web.AppRunner(self.build_app())
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
@@ -220,6 +231,40 @@ class MetricsService:
         from dynamo_tpu.telemetry.hostplane import collect_hostplane
 
         return web.json_response(collect_hostplane())
+
+    async def _handle_debug_kvfleet(self, _req: web.Request) -> web.Response:
+        """Fleet KV fabric introspection (docs/kvbm.md "Fleet fabric"):
+        the ``kvfleet:*`` provider stanzas only — mirrors the HTTP
+        frontend's endpoint for processes that co-locate a fabric with
+        the metrics server (a worker). Empty when no fabric is attached
+        here."""
+        state = collect_debug_state()
+        fleet = {
+            k: v for k, v in state.items() if k.startswith("kvfleet")
+        }
+        return web.json_response(fleet)
+
+    async def _handle_debug_requests(self, _req: web.Request) -> web.Response:
+        """Request-autopsy exemplar index for THIS process (docs/
+        observability.md "Request autopsy") — on a worker that is the
+        pending engine-side segments plus any records finished here."""
+        from dynamo_tpu.telemetry import autopsy
+
+        return web.json_response(autopsy.collect_autopsy())
+
+    async def _handle_debug_request(self, req: web.Request) -> web.Response:
+        """One request's autopsy record, mirroring the frontend route."""
+        from dynamo_tpu.telemetry import autopsy
+
+        rid = req.match_info["rid"]
+        rec = autopsy.get_record(rid)
+        if rec is None:
+            return web.json_response(
+                {"error": f"no autopsy record for {rid!r} (never seen, "
+                          "or dropped at finish by tail retention)"},
+                status=404,
+            )
+        return web.json_response(rec)
 
     async def _handle_debug_profile(self, req: web.Request) -> web.Response:
         try:

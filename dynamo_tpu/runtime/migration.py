@@ -51,6 +51,7 @@ from typing import Any, AsyncIterator, Awaitable, Callable, Optional
 from dynamo_tpu import faults
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.service import ConnectionLostError
+from dynamo_tpu.telemetry import autopsy
 from dynamo_tpu.telemetry.instruments import (
     FAILOVER_RETRIES,
     MIDSTREAM_ABORTS,
@@ -333,6 +334,7 @@ async def migrating_stream(
     started = False  # any item delivered upstream
     attempt = 0  # consecutive failures in the current phase
     death_t: Optional[float] = None  # first loss of the active migration
+    death_instance: Optional[int] = None  # the worker that loss took
     resumes = 0
 
     def _abort(
@@ -343,6 +345,11 @@ async def migrating_stream(
             span.set_attr("midstream_abort", True)
         if detail is None:
             detail = no_resume_why or "resume attempts exhausted"
+        # autopsy: a worker death that migration could NOT save —
+        # flagged so the exemplar survives tail retention
+        autopsy.note_event(
+            context.id, "midstream_abort", flag="aborted", detail=detail
+        )
         return WorkerStreamLostError(
             f"worker connection lost mid-stream; {detail}"
         )
@@ -393,6 +400,10 @@ async def migrating_stream(
             attempt += 1
             if resume:
                 MIDSTREAM_RESUMES.labels("failed").inc()
+                autopsy.note_event(
+                    context.id, "resume_dial_failed", attempt=attempt,
+                    error=f"{type(exc).__name__}",
+                )
                 log.warning(
                     "resume dispatch failed for %s (attempt %d/%d): %s",
                     context.id, attempt, cfg.max_resumes, exc,
@@ -426,12 +437,30 @@ async def migrating_stream(
                     # token-less finish chunk — e.g. an instant
                     # deadline/cancel on the resumed engine — is not a
                     # successful splice and must not count as one)
-                    RESUME_SECONDS.observe(time.monotonic() - death_t)
+                    gap_s = time.monotonic() - death_t
+                    RESUME_SECONDS.observe(gap_s)
                     MIDSTREAM_RESUMES.labels("ok").inc()
                     resumes += 1
                     if span:
                         span.set_attr("resumes", resumes)
+                    # autopsy: the splice point, with BOTH worker ids —
+                    # the waterfall shows where one worker's segment
+                    # ends and the survivor's begins
+                    autopsy.note_event(
+                        context.id, "resume_splice", flag="migrated",
+                        from_worker=(
+                            f"{death_instance:x}"
+                            if death_instance is not None else ""
+                        ),
+                        to_worker=f"{instance_id:x}",
+                        gap_ms=round(gap_s * 1e3, 3),
+                        delivered=(
+                            len(progress.emitted)
+                            if progress is not None else 0
+                        ),
+                    )
                     death_t = None
+                    death_instance = None
                     attempt = 0
                     backoff.reset()
                 segment_tokens = segment_tokens or has_tokens
@@ -461,6 +490,10 @@ async def migrating_stream(
                         f"all attempts failed for {endpoint_name}: {exc}"
                     ) from exc
                 FAILOVER_RETRIES.inc()
+                autopsy.note_event(
+                    context.id, "failover_retry",
+                    worker=f"{instance_id:x}", attempt=attempt,
+                )
                 await _pace(exc)
                 continue
             if progress is None:
@@ -479,6 +512,18 @@ async def migrating_stream(
                     raise _abort(exc) from exc
             if death_t is None:
                 death_t = time.monotonic()
+            if death_instance is None:
+                death_instance = instance_id
+                # autopsy: the dead worker's engine segment can never
+                # ship (its process is gone) — synthesize its side of
+                # the waterfall from what the frontend observed, so a
+                # migrated request still shows both workers' segments
+                autopsy.publish_segment(context.id, {
+                    "source": "worker_died",
+                    "worker": f"{instance_id:x}",
+                    "tokens": len(progress.emitted),
+                    "segments_delivered": progress.segments,
+                })
             left = progress.budget_left()
             if left is not None and left <= 0:
                 # the dead worker had delivered its entire token budget;

@@ -129,10 +129,17 @@ class PushRouter(AsyncEngine):
             context.set_trace(span)
 
         async def dial(req, exclude, resume, wait_timeout_s):
+            from dynamo_tpu.telemetry import autopsy
             from dynamo_tpu.telemetry.hostplane import note_stage
 
             t_dial = time.monotonic()
             instance_id = await self._pick(req, exclude, wait_timeout_s)
+            # request autopsy: every dial (first dispatch, failover
+            # retry, migration resume) lands on the request's timeline
+            autopsy.note_router(
+                context.id, instance_id,
+                resume=resume, mode=self.mode.value,
+            )
             try:
                 stream = await self.client.generate_direct(
                     instance_id, req, context

@@ -12,6 +12,10 @@ Wire frames (length-prefixed msgpack, see store/wire.py):
   caller→worker: {t:"req",  sid, ep, ctx:{id, trace_id?, span_id?}, p: payload}
                  {t:"stop", sid} | {t:"kill", sid}
   worker→caller: {t:"item", sid, p} | {t:"err", sid, e} | {t:"fin", sid}
+                 {t:"seg",  sid, p: {segments, events}}  (request autopsy:
+                 the worker's engine-side timeline for the stream's rid,
+                 sent once before fin; consumers that predate it ignore
+                 unknown frame types)
 """
 
 from __future__ import annotations
@@ -24,7 +28,7 @@ from typing import Any, AsyncIterator, Optional
 from dynamo_tpu import faults
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 from dynamo_tpu.store.wire import read_frame, shutdown_server, write_frame
-from dynamo_tpu.telemetry import get_tracer, propagation_context
+from dynamo_tpu.telemetry import autopsy, get_tracer, propagation_context
 
 log = logging.getLogger("dynamo_tpu.runtime.service")
 
@@ -107,7 +111,28 @@ class EndpointServer:
                     async for item in engine.generate(payload, ctx):
                         if ctx.is_killed:
                             break
+                        # request autopsy: ship anything the engine has
+                        # published so far AHEAD of the item it precedes.
+                        # The engine finalizes its segment before queuing
+                        # the LAST TOKEN item (engine.py
+                        # _finalize_observability) because consumers
+                        # abandon the stream right there — at max_tokens,
+                        # before the finish-marked item — so a payload
+                        # sent any later is never read by the caller
+                        seg = autopsy.take_pending(ctx.id)
+                        if seg is not None and (
+                            seg.get("segments") or seg.get("events")
+                        ):
+                            await send({"t": "seg", "sid": sid, "p": seg})
                         await send({"t": "item", "sid": sid, "p": to_wire(item)})
+                    # fallback for payloads published after the last item
+                    # (aborts, engines without the early finalize): ride
+                    # one frame before fin for callers that drain fully
+                    seg = autopsy.take_pending(ctx.id)
+                    if seg is not None and (
+                        seg.get("segments") or seg.get("events")
+                    ):
+                        await send({"t": "seg", "sid": sid, "p": seg})
                     await send({"t": "fin", "sid": sid})
                 except asyncio.CancelledError:
                     raise
@@ -282,6 +307,11 @@ class EndpointConnection:
                     t = msg.get("t")
                     if t == "item":
                         yield msg.get("p")
+                    elif t == "seg":
+                        # worker's autopsy payload: fold into the local
+                        # record for this rid (or relay further up when
+                        # this process is itself a worker)
+                        autopsy.merge_pending(ctx.id, msg.get("p"))
                     elif t == "fin":
                         finished = True
                         return

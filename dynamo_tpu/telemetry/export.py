@@ -115,6 +115,20 @@ def to_chrome_trace(spans: list[dict]) -> dict:
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
+def trace_ids_for_request(paths: Iterable[str], rid: str) -> list[str]:
+    """The trace ids whose spans carry ``request_id == rid`` (the HTTP
+    frontend stamps it on the request's root span) — how ``trace export
+    --rid`` and an autopsy record cross-link to the span tree. Usually
+    one id; more means the rid was reused across requests."""
+    ids = {
+        str(s.get("trace_id"))
+        for s in load_spans(paths)
+        if s.get("trace_id")
+        and str((s.get("attrs") or {}).get("request_id", "")) == rid
+    }
+    return sorted(ids)
+
+
 def export_chrome_trace(
     in_paths: Iterable[str],
     out: TextIO,

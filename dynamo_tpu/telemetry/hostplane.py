@@ -406,11 +406,14 @@ class HostCostLedger:
         if n % GAUGE_EVERY == 0:
             HTTP_SSE_WRITE_EMA.set(ema)
 
-    def finish(self, rid: str, status: str = "200") -> None:
+    def finish(self, rid: str, status: str = "200") -> Optional[dict]:
+        """Close the request's ledger entry; returns the finished row
+        (None on a repeat call) so the autopsy plane can adopt the
+        frontend stages without re-deriving them."""
         with self._lock:
             rec = self._active.pop(rid, None)
             if rec is None:
-                return
+                return None
             try:
                 self._active_order.remove(rid)
             except ValueError:
@@ -456,6 +459,7 @@ class HostCostLedger:
         if was_stream:
             HTTP_OPEN_STREAMS.set(float(open_now))
             HTTP_DRAIN_WAIT.observe(rec.drain_wait_s)
+        return row
 
     # -- introspection -----------------------------------------------------
     def summary(self) -> dict:

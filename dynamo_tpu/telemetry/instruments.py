@@ -358,6 +358,31 @@ BLACKBOX_DUMPS = REGISTRY.counter(
     labels=("reason",),
 )
 
+# -- request autopsy (telemetry/autopsy.py; docs/observability.md
+# "Request autopsy") — request-bounded only: one counter bump per
+# request at finish plus one per attached segment, NEVER per chunk
+AUTOPSY_REQUESTS = REGISTRY.counter(
+    "dynamo_autopsy_requests_total",
+    "Requests closed by the autopsy collector, by retention outcome "
+    "(retained = kept as an exemplar: flagged slow/migrated/faulted/"
+    "shed/rejected or at the rolling p99 tail; dropped = finished "
+    "clean and fast, record discarded)",
+    labels=("outcome",),  # retained | dropped
+)
+AUTOPSY_EXEMPLARS = REGISTRY.gauge(
+    "dynamo_autopsy_exemplars",
+    "Exemplar records currently held in the autopsy ring "
+    "(bounded; serves /debug/requests and the top SLOW column)",
+)
+AUTOPSY_SEGMENTS = REGISTRY.counter(
+    "dynamo_autopsy_segments_total",
+    "Execution segments attached to autopsy records, by source "
+    "(engine = an engine's finish summary, remote_prefill = the "
+    "disagg decode-side wait, worker_died = the synthesized stub "
+    "for a worker that was lost mid-stream)",
+    labels=("source",),  # engine | remote_prefill | worker_died
+)
+
 # -- flight recorder + slow-step watchdog (telemetry/recorder.py) -----------
 SLOW_STEPS = REGISTRY.counter(
     "dynamo_engine_slow_steps_total",

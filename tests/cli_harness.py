@@ -104,16 +104,40 @@ def wait_http(url: str, ready: Callable[[bytes], Any], timeout: float = 180.0) -
 
 
 def complete(port: int, prompt: str, max_tokens: int,
-             model: str = "tiny_llama_model") -> dict:
+             model: str = "tiny_llama_model", rid: str | None = None) -> dict:
     """Non-streaming /v1/completions call; returns the parsed response.
-    ignore_eos rides the ext options (extension(), protocols/openai.py)."""
+    ignore_eos rides the ext options (extension(), protocols/openai.py).
+    ``rid`` sets X-Request-Id so the request's autopsy record is
+    addressable at /debug/request/{rid} afterwards."""
     body = json.dumps({
         "model": model, "prompt": prompt, "max_tokens": max_tokens,
         "ext": {"ignore_eos": True},
     }).encode()
+    headers = {"Content-Type": "application/json"}
+    if rid:
+        headers["X-Request-Id"] = rid
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}/v1/completions", data=body,
-        headers={"Content-Type": "application/json"},
+        headers=headers,
     )
     with urllib.request.urlopen(req, timeout=180) as r:
         return json.load(r)
+
+
+def fetch_autopsy(port: int, rid: str, timeout: float = 20.0) -> dict:
+    """Poll /debug/request/{rid} until the record is finished (the
+    streaming path closes it in a finally that can trail the last SSE
+    byte by a beat)."""
+    url = f"http://127.0.0.1:{port}/debug/request/{rid}"
+    deadline = time.monotonic() + timeout
+    last: dict = {}
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=5) as r:
+                last = json.load(r)
+            if last.get("finished"):
+                return last
+        except Exception:
+            pass
+        time.sleep(0.5)
+    raise TimeoutError(f"no finished autopsy record for {rid}: {last}")

@@ -1,0 +1,470 @@
+"""Request autopsy (docs/observability.md "Request autopsy"): the
+tail-sampled per-request timeline plane — collector retention math,
+the cross-process pending table, the waterfall coverage check, the
+debug-endpoint parity between frontend and metrics service, and the
+migration splice appearing in a record end to end (in-process)."""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.telemetry.autopsy import (
+    GAUGE_EVERY,
+    MIN_WINDOW,
+    AutopsyCollector,
+    collect_autopsy,
+    register_autopsy_provider,
+    unregister_autopsy_provider,
+    waterfall,
+)
+
+
+def _collector(**kw):
+    """Collector on an injectable clock: tests advance time, never
+    sleep."""
+    t = {"now": 0.0}
+
+    def clock():
+        return t["now"]
+
+    c = AutopsyCollector(clock=clock, wall=lambda: 1e9 + t["now"], **kw)
+    return c, t
+
+
+def _finish_n(c, t, n, total_s=0.010, prefix="warm"):
+    """Drive n unflagged requests of the given duration through the
+    collector (fills the rolling window / p99 state)."""
+    for i in range(n):
+        rid = f"{prefix}-{i}"
+        c.begin(rid, "/v1/completions")
+        t["now"] += total_s
+        c.finish(rid, "200", host={"ttfb_ms": total_s * 500,
+                                   "stages_ms": {}})
+
+
+# ---------------------------------------------------------------------------
+# retention
+# ---------------------------------------------------------------------------
+
+
+def test_warmup_retains_everything():
+    """Below MIN_WINDOW finished requests the p99 estimate is noise:
+    every record is an exemplar (the bounded ring makes this safe)."""
+    c, t = _collector()
+    c.begin("r1", "/v1/completions")
+    t["now"] += 0.005
+    row = c.finish("r1", "200")
+    assert row is not None and row["retained"] == "tail_p99"
+
+
+def test_fast_unflagged_request_is_dropped_after_warmup():
+    c, t = _collector()
+    # warm-up past MIN_WINDOW and a GAUGE_EVERY threshold recompute
+    _finish_n(c, t, max(MIN_WINDOW, GAUGE_EVERY), total_s=0.100)
+    assert c.snapshot()["p99_total_ms"] > 0
+    c.begin("fast", "/v1/completions")
+    t["now"] += 0.001  # far below the 100ms p99
+    assert c.finish("fast", "200") is None
+    assert c.get("fast") is None
+    snap = c.snapshot()
+    assert snap["dropped_total"] >= 1
+
+
+def test_p99_tail_request_is_retained():
+    c, t = _collector()
+    _finish_n(c, t, max(MIN_WINDOW, GAUGE_EVERY), total_s=0.010)
+    c.begin("slow", "/v1/completions")
+    t["now"] += 0.500  # way past the 10ms p99
+    row = c.finish("slow", "200")
+    assert row is not None and row["retained"] == "tail_p99"
+    assert c.get("slow")["rid"] == "slow"
+
+
+@pytest.mark.parametrize("flag,via", [
+    ("slo_miss", "segment"),
+    ("shed", "event"),
+    ("migrated", "event"),
+    ("faulted", "event"),
+    ("deadline", "segment"),
+    ("error", "status"),
+])
+def test_flagged_fast_request_is_retained(flag, via):
+    """The whole point of tail sampling: a FAST request that was
+    flagged (SLO miss, shed, migrated, faulted, deadline, error) is
+    still an exemplar."""
+    c, t = _collector()
+    _finish_n(c, t, max(MIN_WINDOW, GAUGE_EVERY), total_s=0.100)
+    c.begin("bad", "/v1/chat/completions")
+    if via == "segment":
+        seg = {"source": "engine"}
+        if flag == "slo_miss":
+            seg["slo_miss"] = True
+        else:
+            seg["finish_reason"] = "timeout"
+        c.publish_segment("bad", seg)
+    elif via == "event":
+        c.note_event("bad", "whatever", flag=flag)
+    t["now"] += 0.001
+    status = "500" if via == "status" else "200"
+    row = c.finish("bad", status)
+    assert row is not None
+    assert row["retained"] == "flag"
+    assert flag in row["flags"]
+
+
+def test_finish_is_idempotent_and_unknown_rid_is_none():
+    c, t = _collector()
+    c.begin("r1", "/v1/completions")
+    t["now"] += 0.002
+    assert c.finish("r1", "200") is not None
+    assert c.finish("r1", "200") is None  # first call won
+    assert c.finish("never-began", "200") is None
+
+
+def test_exemplar_ring_is_bounded():
+    c, t = _collector(max_exemplars=4)
+    _finish_n(c, t, 10, total_s=0.010)  # warm-up retains all 10
+    idx = c.index()
+    assert len(idx) == 4
+    assert idx[0]["rid"] == "warm-9"  # newest first
+
+
+# ---------------------------------------------------------------------------
+# cross-process pending table
+# ---------------------------------------------------------------------------
+
+
+def test_pending_take_merge_round_trip():
+    """Worker-side publishes for an rid with no local record park in
+    the pending table; take_pending pops them (the seg wire frame) and
+    merge_pending folds them into the caller's record — including the
+    flag carried inside a pending event."""
+    worker, _ = _collector()
+    frontend, t = _collector()
+    rid = "xproc-1"
+    worker.publish_segment(rid, {"source": "engine", "tokens": 5,
+                                 "finish_reason": "stop"})
+    worker.note_event(rid, "fault", flag="faulted", point="engine.step")
+    payload = worker.take_pending(rid)
+    assert payload is not None
+    assert len(payload["segments"]) == 1
+    assert payload["events"][0]["flag"] == "faulted"
+    assert worker.take_pending(rid) is None  # popped exactly once
+    # the frontend folds the shipped payload into its active record
+    frontend.begin(rid, "/v1/completions")
+    frontend.merge_pending(rid, payload)
+    t["now"] += 0.002
+    row = frontend.finish(rid, "200")
+    assert row is not None and row["retained"] == "flag"
+    assert row["flags"] == ["faulted"]
+    assert row["segments"][0]["tokens"] == 5
+    assert any(e["kind"] == "fault" for e in row["events"])
+
+
+def test_finish_merges_local_pending():
+    """A segment that arrives before begin() (in-process engine racing
+    the frontend) still lands in the finished record."""
+    c, t = _collector()
+    rid = "race-1"
+    c.publish_segment(rid, {"source": "engine", "slo_miss": True})
+    c.begin(rid, "/v1/completions")
+    t["now"] += 0.002
+    row = c.finish(rid, "200")
+    assert row is not None
+    assert row["segments"][0]["slo_miss"] is True
+    assert "slo_miss" in row["flags"]
+
+
+def test_pending_table_is_bounded_fifo():
+    c, _ = _collector(max_pending=3)
+    for i in range(5):
+        c.publish_segment(f"p-{i}", {"source": "engine"})
+    assert c.take_pending("p-0") is None  # FIFO-evicted
+    assert c.take_pending("p-4") is not None
+
+
+# ---------------------------------------------------------------------------
+# record shape
+# ---------------------------------------------------------------------------
+
+
+def test_router_decisions_and_inflight_view():
+    c, t = _collector()
+    c.begin("r1", "/v1/chat/completions")
+    c.set_trace("r1", "tid-1234")
+    c.note_router("r1", 0xBEEF, overlap_blocks=3, total_blocks=9,
+                  fleet_blocks=2)
+    t["now"] += 0.010
+    c.note_router("r1", 0xCAFE, resume=True)
+    live = c.get("r1")
+    assert live["finished"] is False
+    assert [d["worker"] for d in live["router"]] == ["beef", "cafe"]
+    assert live["router"][0]["overlap_blocks"] == 3
+    assert live["router"][0]["fleet_blocks"] == 2
+    assert live["router"][1]["resume"] is True
+    assert live["trace_id"] == "tid-1234"
+    row = c.finish("r1", "200")
+    assert row["router"] == live["router"]
+
+
+def test_record_is_json_serializable():
+    c, t = _collector()
+    c.begin("r1", "/v1/completions")
+    c.note_event("r1", "deadline_budget", ms=500)
+    c.publish_segment("r1", {"source": "engine", "prefill_ms": 1.0})
+    t["now"] += 0.002
+    row = c.finish("r1", "200", host={"ttfb_ms": 1.0,
+                                      "stages_ms": {"preprocess": 0.5}})
+    json.dumps(row)
+    json.dumps(c.snapshot())
+
+
+# ---------------------------------------------------------------------------
+# waterfall coverage
+# ---------------------------------------------------------------------------
+
+
+def test_waterfall_explains_wall_clock():
+    rec = {
+        "total_ms": 100.0,
+        "ttfb_ms": 40.0,
+        "host": {"stages_ms": {"preprocess": 3.0, "dispatch": 1.0,
+                               "prime": 36.0}},
+    }
+    wf = waterfall(rec)
+    assert wf["covered"] is True
+    assert wf["explained_ms"] == pytest.approx(100.0)
+    names = [r["name"] for r in wf["rows"]]
+    assert names == ["preprocess", "dispatch", "prime", "stream"]
+    # rows tile the span: each starts where the previous ended
+    for prev, cur in zip(wf["rows"], wf["rows"][1:]):
+        assert cur["start_ms"] == pytest.approx(
+            prev["start_ms"] + prev["dur_ms"]
+        )
+
+
+def test_waterfall_surfaces_host_gap():
+    """Time between the staged host work and first byte is rendered as
+    an explicit (host gap) row — a growing gap IS the finding."""
+    rec = {"total_ms": 50.0, "ttfb_ms": 30.0,
+           "host": {"stages_ms": {"preprocess": 2.0}}}
+    wf = waterfall(rec)
+    gap = next(r for r in wf["rows"] if r["name"] == "(host gap)")
+    assert gap["dur_ms"] == pytest.approx(28.0)
+    assert wf["covered"] is True
+
+
+def test_waterfall_without_ttfb_is_unattributed():
+    wf = waterfall({"total_ms": 10.0, "host": {"stages_ms": {}}})
+    assert [r["name"] for r in wf["rows"]] == ["(unattributed)"]
+    assert wf["covered"] is True
+
+
+# ---------------------------------------------------------------------------
+# provider registry (fourth ProviderRegistry instance)
+# ---------------------------------------------------------------------------
+
+
+def test_collect_autopsy_has_collector_stanza_and_degrades():
+    out = collect_autopsy()
+    assert "ts" in out and "pid" in out
+    assert "requests_total" in out["collector"]
+    assert isinstance(out["collector"]["exemplars"], list)
+
+    def broken() -> dict:
+        raise RuntimeError("boom")
+
+    register_autopsy_provider("broken", broken)
+    try:
+        out = collect_autopsy()
+        assert "error" in out["broken"]  # degraded, not raised
+        assert "requests_total" in out["collector"]
+    finally:
+        unregister_autopsy_provider("broken")
+
+
+# ---------------------------------------------------------------------------
+# endpoint parity: the frontend and the metrics service expose the SAME
+# /debug surface (ISSUE 19 satellite — an operator mid-incident must
+# not have to remember which port grew which endpoint)
+# ---------------------------------------------------------------------------
+
+
+def _debug_paths(app) -> set:
+    return {
+        r.resource.canonical
+        for r in app.router.routes()
+        if r.resource is not None
+        and r.resource.canonical.startswith("/debug/")
+    }
+
+
+def test_debug_endpoint_parity_frontend_vs_metrics_service():
+    from dynamo_tpu.http.service import HttpService
+    from dynamo_tpu.metrics.service import MetricsService
+
+    fe = HttpService()
+    ms = MetricsService(component=None, host="127.0.0.1", port=0)  # type: ignore[arg-type]
+    assert _debug_paths(fe.app) == _debug_paths(ms.build_app())
+    # the autopsy pair is explicitly part of the contract
+    assert "/debug/request/{rid}" in _debug_paths(fe.app)
+    assert "/debug/requests" in _debug_paths(fe.app)
+    assert "/debug/kvfleet" in _debug_paths(ms.build_app())
+
+
+# ---------------------------------------------------------------------------
+# migration splice lands in the record (in-process, real PushRouter)
+# ---------------------------------------------------------------------------
+
+
+async def test_migrated_request_record_shows_both_workers_and_splice():
+    """Kill a fake worker after 3 tokens behind the real PushRouter:
+    the autopsy record carries the dead worker's synthesized segment,
+    the survivor's dial, and the resume_splice event naming BOTH
+    worker ids — and the 'migrated' flag retains it as an exemplar."""
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context, collect
+    from dynamo_tpu.runtime.migration import MigrationConfig
+    from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+    from dynamo_tpu.runtime.service import ConnectionLostError
+    from dynamo_tpu.telemetry import autopsy
+
+    class FakeWorker:
+        def __init__(self, die_after=None):
+            self.die_after = die_after
+            self.requests = []
+
+        async def stream(self, request):
+            self.requests.append(request)
+            last = list(request.token_ids)[-1]
+            emitted = 0
+            while emitted < request.stop.max_tokens:
+                if self.die_after is not None and emitted >= self.die_after:
+                    raise ConnectionLostError("worker died mid-stream")
+                last = (last * 7 + 13) % 997
+                emitted += 1
+                yield {"request_id": request.request_id,
+                       "token_ids": [last]}
+                await asyncio.sleep(0)
+            yield {"request_id": request.request_id, "token_ids": [],
+                   "finish_reason": "length",
+                   "prompt_tokens": len(request.token_ids),
+                   "completion_tokens": emitted}
+
+    class _Endpoint:
+        path = "test.autopsy.generate"
+
+    class FakeClient:
+        def __init__(self, workers):
+            self.workers = dict(workers)
+            self.endpoint = _Endpoint()
+
+        def instance_ids(self):
+            return sorted(self.workers)
+
+        async def wait_for_instances(self, timeout_s=None):
+            return self.instance_ids()
+
+        async def generate_direct(self, instance_id, request, context=None):
+            return self.workers[instance_id].stream(request)
+
+    # round-robin picks index 1 of the sorted ids first: the dying
+    # worker sits at id 2 so the first dispatch lands on it
+    dying, survivor = FakeWorker(die_after=3), FakeWorker()
+    router = PushRouter(
+        FakeClient({1: survivor, 2: dying}), RouterMode.ROUND_ROBIN,
+        migration=MigrationConfig(instance_wait_s=0.5),
+    )
+    ctx = Context()
+    autopsy.begin_request(ctx.id, "/v1/completions")
+    req = PreprocessedRequest(
+        request_id="autopsy-mig", token_ids=[1, 2, 3],
+        sampling=SamplingOptions(use_greedy=True),
+        stop=StopConditions(max_tokens=8),
+    )
+    items = await asyncio.wait_for(
+        collect(router.generate(req, ctx)), timeout=10
+    )
+    assert items[-1]["finish_reason"] == "length"
+    row = autopsy.finish_request(ctx.id, "200")
+    assert row is not None and "migrated" in row["flags"]
+    # the dead worker's side is a synthesized segment (its real engine
+    # segment died with the process); both worker ids are on the splice
+    dead = [s for s in row["segments"] if s["source"] == "worker_died"]
+    assert len(dead) == 1 and dead[0]["worker"] == "2"
+    assert dead[0]["tokens"] == 3
+    splice = [e for e in row["events"] if e["kind"] == "resume_splice"]
+    assert len(splice) == 1
+    assert splice[0]["from_worker"] == "2"
+    assert splice[0]["to_worker"] == "1"
+    assert splice[0]["delivered"] == 3
+    # both dials recorded, second one marked as the resume
+    assert [d["worker"] for d in row["router"]] == ["2", "1"]
+    assert row["router"][1]["resume"] is True
+
+
+# ---------------------------------------------------------------------------
+# CLI pieces (pure functions — no sockets)
+# ---------------------------------------------------------------------------
+
+
+def test_top_autopsy_cols_absence_vs_zero():
+    from dynamo_tpu.cli.top import _autopsy_cols
+
+    assert _autopsy_cols(None)["slow_requests"] is None
+    assert _autopsy_cols({"collector": {"exemplars": []}}) == {
+        "slow_requests": 0
+    }
+    assert _autopsy_cols(
+        {"collector": {"exemplars": [{}, {}]}}
+    )["slow_requests"] == 2
+    assert _autopsy_cols({"collector": {"error": "x"}})[
+        "slow_requests"
+    ] is None
+
+
+def test_cli_render_waterfall(capsys):
+    import sys
+
+    from dynamo_tpu.cli.autopsy import render
+
+    c, t = _collector()
+    c.begin("r1", "/v1/chat/completions")
+    c.set_trace("r1", "abcd1234")
+    c.note_router("r1", 0xBEEF, overlap_blocks=3, total_blocks=10)
+    c.publish_segment("r1", {"source": "engine", "slo_miss": True,
+                             "prefill_ms": 30.0, "decode_ms": 60.0})
+    t["now"] += 0.100
+    row = c.finish("r1", "200", host={
+        "ttfb_ms": 40.0,
+        "stages_ms": {"preprocess": 3.0, "dispatch": 1.0, "prime": 36.0},
+    })
+    render(row, sys.stdout)
+    out = capsys.readouterr().out
+    assert "[OK]" in out and "100.0% coverage" in out
+    assert "slo_miss" in out
+    assert "worker=beef" in out
+    assert "trace export" in out and "--rid r1" in out
+
+
+def test_trace_ids_for_request(tmp_path):
+    from dynamo_tpu.telemetry.export import trace_ids_for_request
+
+    log = tmp_path / "spans.jsonl"
+    log.write_text("\n".join([
+        json.dumps({"name": "http.request", "trace_id": "t-1",
+                    "span_id": "s1", "start": 1.0, "duration_s": 0.1,
+                    "attrs": {"request_id": "rid-1"}}),
+        json.dumps({"name": "engine.decode", "trace_id": "t-1",
+                    "span_id": "s2", "start": 1.0, "duration_s": 0.1}),
+        json.dumps({"name": "http.request", "trace_id": "t-2",
+                    "span_id": "s3", "start": 2.0, "duration_s": 0.1,
+                    "attrs": {"request_id": "rid-2"}}),
+    ]) + "\n")
+    assert trace_ids_for_request([str(log)], "rid-1") == ["t-1"]
+    assert trace_ids_for_request([str(log)], "rid-404") == []

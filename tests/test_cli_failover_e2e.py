@@ -9,7 +9,14 @@ import socket
 import time
 import urllib.request
 
-from cli_harness import MODEL_DIR, CliFleet, complete, free_port, wait_http
+from cli_harness import (
+    MODEL_DIR,
+    CliFleet,
+    complete,
+    fetch_autopsy,
+    free_port,
+    wait_http,
+)
 
 
 def _metric_value(port: int, name: str, **labels) -> float:
@@ -66,6 +73,16 @@ def test_worker_death_failover():
         for _ in range(4):
             out = complete(http_port, "failover test prompt", max_tokens=4)
             assert out["choices"][0]["finish_reason"] == "length"
+
+        # the metrics service mirrors the frontend's debug surface
+        # (ISSUE 19 satellite): kvfleet and the autopsy pair answer live
+        mirror = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{metrics_port}/debug/requests", timeout=10
+        ))
+        assert "collector" in mirror, mirror
+        json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{metrics_port}/debug/kvfleet", timeout=10
+        ))
 
         # hard-kill one worker (no graceful drain: its connection drop
         # must revoke the lease and remove it from routing)
@@ -146,9 +163,11 @@ def test_mid_stream_kill_migrates_byte_identical():
             "max_tokens": n_tokens, "stream": True, "temperature": 0,
             "ext": {"ignore_eos": True},
         }).encode()
+        mig_rid = "autopsy-migration-e2e"
         req = urllib.request.Request(
             f"http://127.0.0.1:{http_port}/v1/completions", data=body,
-            headers={"Content-Type": "application/json"},
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": mig_rid},
         )
         resp = urllib.request.urlopen(req, timeout=60)
         first = resp.readline()
@@ -210,6 +229,30 @@ def test_mid_stream_kill_migrates_byte_identical():
         assert _metric_value(
             http_port, "dynamo_midstream_aborts_total"
         ) == 0
+
+        # ---- request autopsy (ISSUE 19 acceptance): the mid-stream-
+        # killed request's record shows BOTH workers' segments and the
+        # splice point. The victim died by SIGKILL, so its engine
+        # segment can never ship — the frontend synthesized its side
+        # (worker_died); the survivor's real engine segment arrived on
+        # the seg wire frame with the resume offset.
+        rec = fetch_autopsy(http_port, mig_rid)
+        assert "migrated" in rec["flags"], rec["flags"]
+        assert rec["retained"] == "flag"
+        died = [s for s in rec["segments"] if s["source"] == "worker_died"]
+        engine = [s for s in rec["segments"] if s["source"] == "engine"]
+        assert died and engine, rec["segments"]
+        assert died[0]["tokens"] >= 1  # the victim delivered tokens
+        assert engine[0]["resume_offset"] == died[0]["tokens"]
+        splices = [e for e in rec["events"]
+                   if e.get("kind") == "resume_splice"]
+        assert splices, rec["events"]
+        assert splices[0]["from_worker"] == died[0]["worker"]
+        assert splices[0]["to_worker"] != splices[0]["from_worker"]
+        assert splices[0]["delivered"] == died[0]["tokens"]
+        # both dials recorded; the survivor's is marked as the resume
+        assert len(rec["router"]) >= 2
+        assert rec["router"][-1]["resume"] is True
         fleet.assert_alive()
     finally:
         fleet.teardown()

@@ -24,6 +24,7 @@ _INSTRUMENTED_MODULES = [
     "dynamo_tpu.telemetry.hbm",
     "dynamo_tpu.telemetry.attribution",
     "dynamo_tpu.telemetry.hostplane",
+    "dynamo_tpu.telemetry.autopsy",
     "dynamo_tpu.http.service",
     "dynamo_tpu.metrics.service",
     "dynamo_tpu.disagg.worker",
@@ -98,6 +99,12 @@ _REQUIRED_SERIES = [
     "dynamo_kvbm_fleet_demoted_blocks_total",
     "dynamo_kvbm_fleet_catalog_entries",
     "dynamo_kvbm_fleet_dangling_total",
+    # ISSUE 19: request autopsy (telemetry/autopsy.py) — request-bounded
+    # counters only; the per-request detail lives in the exemplar ring,
+    # never as labeled series
+    "dynamo_autopsy_requests_total",
+    "dynamo_autopsy_exemplars",
+    "dynamo_autopsy_segments_total",
 ]
 
 
@@ -202,6 +209,15 @@ def test_observability_series_are_registered():
     assert REGISTRY.get(
         "dynamo_kvbm_fleet_catalog_entries"
     ).label_names == ()
+    # autopsy: retention outcome and segment source are fixed enums;
+    # the rid itself must never become a label (gate below enforces)
+    assert REGISTRY.get("dynamo_autopsy_requests_total").label_names == (
+        "outcome",
+    )
+    assert REGISTRY.get("dynamo_autopsy_exemplars").label_names == ()
+    assert REGISTRY.get("dynamo_autopsy_segments_total").label_names == (
+        "source",
+    )
 
 
 def test_metric_catalog_docs_match_registry():
