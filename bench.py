@@ -986,6 +986,49 @@ def _migration_sim_ab() -> dict:
     }
 
 
+def _drain_sim_ab() -> dict:
+    """Kill-vs-drain A/B on the discrete-event fleet: the same worker
+    goes down at the same instant under the same seed — reactively
+    (worker.liveness:kill — streams resume after full re-prefill) vs
+    gracefully (worker.drain — proactive handoff, onboard-rate
+    resumes, zero lost tokens). The headline is the SLO-attainment
+    dip: the drain's must be strictly shallower
+    (docs/robustness.md "Graceful drain & rolling restarts")."""
+    from dynamo_tpu.faults.plan import parse_plan
+    from dynamo_tpu.sim import FleetSim, SimConfig, bursty_trace
+
+    trace = bursty_trace(
+        600.0, seed=2026, calm_rps=30.0, burst_rps=60.0,
+        mean_calm_s=90.0, mean_burst_s=30.0,
+    )
+
+    def run(point):
+        plan = parse_plan(f"seed=42;{point}:kill@after=240")
+        # kill_detect_s models the reactive path's death-detection gap
+        # (stream error + failover backoff) — only kills pay it; the
+        # drain's handoff latency is the config default
+        return FleetSim(
+            trace, SimConfig(initial_decode=3, kill_detect_s=2.0),
+            plan=plan,
+        ).run()
+
+    def dip(res):
+        att = [s["slo_attainment_mean"] for s in res["timeline"]]
+        return 1.0 - min(att) if att else 0.0
+
+    kill = run("worker.liveness")
+    drain = run("worker.drain")
+    return {
+        "sim_fault_at_s": 240,
+        "sim_attainment_dip_kill": round(dip(kill), 4),
+        "sim_attainment_dip_drain": round(dip(drain), 4),
+        "sim_streams_migrated_drain": drain["drained_inflight"],
+        "sim_streams_hit_kill": kill["killed_inflight"],
+        "sim_goodput_kill": kill["goodput_tokens"],
+        "sim_goodput_drain": drain["goodput_tokens"],
+    }
+
+
 def _main_chaos_ab(model_cfg, wl) -> None:
     """--chaos: goodput/SLO attainment under a canned, fixed-seed fault
     plan vs the identical fault-free workload (docs/robustness.md).
@@ -1061,6 +1104,18 @@ def _main_chaos_ab(model_cfg, wl) -> None:
             f"{mig['sim_goodput_retained_migration_off']:.4f} (off) -> "
             f"{mig['sim_goodput_retained_migration_on']:.4f} (on), "
             f"{mig['sim_resumed']} stream(s) resumed",
+            file=sys.stderr,
+        )
+    # graceful-drain A/B (sim-based; DYN_BENCH_CHAOS_DRAIN=0 skips it):
+    # the same departure as a kill vs as a planned drain — the drain's
+    # attainment dip must be the shallower one
+    if os.environ.get("DYN_BENCH_CHAOS_DRAIN", "1") != "0":
+        out["config"]["drain"] = dr = _drain_sim_ab()
+        print(
+            f"# drain A/B (sim): attainment dip "
+            f"{dr['sim_attainment_dip_kill']:.4f} (kill) -> "
+            f"{dr['sim_attainment_dip_drain']:.4f} (drain), "
+            f"{dr['sim_streams_migrated_drain']} stream(s) handed off",
             file=sys.stderr,
         )
     print(json.dumps(out))

@@ -167,6 +167,13 @@ def build_parser() -> argparse.ArgumentParser:
                      help="admission control: shed when the device KV "
                           "pool usage fraction reaches this (e.g. 0.95; "
                           "0 disables)")
+    run.add_argument("--drain-timeout-s", type=float, default=None,
+                     help="graceful-drain budget for worker mode: on "
+                          "SIGTERM (or a worker.drain control call) "
+                          "in-flight streams are handed off to healthy "
+                          "peers and the worker exits 0 once idle or "
+                          "this deadline passes (default: "
+                          "DYN_DRAIN_TIMEOUT_S, else 30)")
     # observability (docs/observability.md: SLO + flight recorder)
     run.add_argument("--slo-ttft-ms", type=float, default=None,
                      help="TTFT target evaluated per finished request "
@@ -412,6 +419,23 @@ def build_parser() -> argparse.ArgumentParser:
     )
     models.add_argument("--store-host", default="127.0.0.1")
     models.add_argument("--store-port", type=int, default=4222)
+
+    # lifecycle: `dynamo-tpu drain <worker>` (docs/robustness.md
+    # "Graceful drain & rolling restarts")
+    drain_p = sub.add_parser(
+        "drain", help="gracefully drain a worker: it stops admitting, "
+                      "hands in-flight streams to healthy peers, "
+                      "deregisters, and exits 0"
+    )
+    drain_p.add_argument("worker",
+                         help="instance id in hex (as shown by "
+                              "`models list` or `top`)")
+    drain_p.add_argument("--namespace", default="dynamo")
+    drain_p.add_argument("--store-host", default="127.0.0.1")
+    drain_p.add_argument("--store-port", type=int, default=4222)
+    drain_p.add_argument("--timeout", type=float, default=45.0,
+                         help="how long to wait for the worker to "
+                              "deregister before giving up (exit 1)")
     return p
 
 
@@ -984,7 +1008,7 @@ async def cmd_run(args: Any) -> None:
                 ),
                 name="degradation-watch",
             )
-        await endpoint.serve(engine)
+        instance = await endpoint.serve(engine)
         if args.model_path and args.model_path.endswith(".gguf"):
             # ModelDeploymentCard artifacts (tokenizer.json etc.) come
             # from model directories; a GGUF worker would register a
@@ -1009,7 +1033,25 @@ async def cmd_run(args: Any) -> None:
                 drt.primary_lease_id,
             )
         print(f"worker serving {in_mode}", flush=True)
+        # lifecycle (docs/robustness.md "Graceful drain"): a
+        # worker.drain control call converges onto the same shutdown
+        # event SIGTERM sets; either way the drain runs before the
+        # lease is revoked, so departure is planned, not discovered
+        from dynamo_tpu.runtime.drain import (
+            DrainCoordinator,
+            serve_drain_control,
+        )
+
+        spawn(
+            serve_drain_control(drt, ns, instance, drt.runtime),
+            name="drain-control",
+        )
         await drt.runtime.wait_shutdown()
+        await DrainCoordinator(
+            drt, component, endpoint, instance,
+            engine=jax_engine,
+            timeout_s=args.drain_timeout_s,
+        ).drain()
         await drt.shutdown()
     else:
         raise SystemExit(f"unknown --in {in_mode!r}")
@@ -1631,6 +1673,28 @@ async def cmd_operator(args: Any) -> None:
     await drt.shutdown()
 
 
+async def cmd_drain(args: Any) -> int:
+    """Issue the worker.drain control call and poll discovery until the
+    instance key disappears (the worker deletes it as its last act)."""
+    from dynamo_tpu.runtime.drain import request_drain
+    from dynamo_tpu.store.client import StoreClient
+
+    client = await StoreClient.connect(args.store_host, args.store_port)
+    try:
+        print(f"draining {args.worker} in {args.namespace!r}...", flush=True)
+        ok = await request_drain(
+            client, args.namespace, args.worker, timeout_s=args.timeout
+        )
+    finally:
+        await client.close()
+    if ok:
+        print(f"worker {args.worker} drained and deregistered")
+        return 0
+    print(f"worker {args.worker} still registered after {args.timeout}s "
+          "(is it alive? did the control call reach it?)")
+    return 1
+
+
 async def cmd_models(args: Any) -> None:
     from dynamo_tpu.model_card import list_entries, register_llm, unregister_model
     from dynamo_tpu.store.client import StoreClient
@@ -1777,6 +1841,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         asyncio.run(cmd_planner(args))
     elif args.command == "models":
         asyncio.run(cmd_models(args))
+    elif args.command == "drain":
+        sys.exit(asyncio.run(cmd_drain(args)))
     elif args.command == "deploy":
         asyncio.run(cmd_deploy(args))
     elif args.command == "operator":

@@ -172,6 +172,9 @@ def _engine_row(url: str, state: dict, prev: Optional[dict],
     return {
         "url": url,
         "model": eng.get("model") or "-",
+        # lifecycle state from the engine's drain flag; workers
+        # predating the field (or frontends) report None → '-'
+        "draining": eng.get("draining"),
         "running": sched.get("running"),
         "waiting": sched.get("queue_depth"),
         "max_batch": eng.get("max_batch_size"),
@@ -192,7 +195,7 @@ def _engine_row(url: str, state: dict, prev: Optional[dict],
 
 
 HEADER = (
-    f"{'WORKER':<28} {'MODEL':<12} {'RUN':>5} {'WAIT':>5} "
+    f"{'WORKER':<28} {'MODEL':<12} {'STATE':>5} {'RUN':>5} {'WAIT':>5} "
     f"{'KV%':>7} {'TOK/S':>8} {'ROOF%':>7} {'LOSS':>10} {'SLO%':>7} "
     f"{'HBM':>9} {'SSTEP':>5} {'SLOW':>5} {'PREEMPT':>7} "
     f"{'LAG99':>7} {'STRM':>6} {'RPS':>7}"
@@ -216,8 +219,11 @@ def render_frame(rows: list[dict], out: TextIO) -> None:
         strm = r.get("streams_open")
         rps = r.get("rps")
         rps_s = f"{rps:7.1f}" if rps is not None else "      -"
+        dr = r.get("draining")
+        state_s = "-" if dr is None else ("DRAIN" if dr else "up")
         out.write(
-            f"{r['url']:<28} {str(r['model'])[:12]:<12} {run_s:>5} "
+            f"{r['url']:<28} {str(r['model'])[:12]:<12} {state_s:>5} "
+            f"{run_s:>5} "
             f"{str(r['waiting'] if r['waiting'] is not None else '-'):>5} "
             f"{_pct(r['kv_usage']):>7} {tok} "
             f"{_pct(r.get('roofline')):>7} "

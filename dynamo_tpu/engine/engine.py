@@ -229,6 +229,11 @@ class JaxEngine:
         self._control: thread_queue.Queue = thread_queue.Queue()
         self._wake = threading.Event()
         self._running = False  # dynalint: handoff=stop-flag — one-way bool, each side only ever writes False; readers poll per step/await
+        # graceful drain (runtime/drain.py; docs/robustness.md): once
+        # set, submit() rejects new work and the step loop hands off
+        # every eligible in-flight stream with FinishReason.MIGRATE
+        self._draining = False  # dynalint: handoff=drain-flag — one-way bool, only ever flipped True; engine thread polls per step
+        self._drain_migrated = 0
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._seed_counter = 0
         # step-failure quarantine (see _quarantine_step_failure)
@@ -2102,6 +2107,11 @@ class JaxEngine:
             # hard worker death between steps (one-shot by default)
             faults.fire("worker.liveness")
             self._drain_incoming()
+            if self._draining:
+                # graceful drain: hand off eligible in-flight streams at
+                # this step boundary (every generated token has already
+                # been emitted, so the router's commit log is exact)
+                self._migrate_eligible()
             if (
                 not self.scheduler.running
                 and not self.scheduler.prefilling
@@ -3318,6 +3328,10 @@ class JaxEngine:
                 bool(sched.waiting)
                 or bool(sched.prefilling)
                 or not self._running
+                # a drain must reach the serial loop's migrate sweep:
+                # the pipeline would otherwise hold its streams until
+                # they finish naturally, riding out the whole deadline
+                or self._draining
                 or not self._control.empty()
                 # degradation rung 2 (planner/degradation.py) flips
                 # spec_suspended from the loop thread: the serial loop
@@ -3554,6 +3568,7 @@ class JaxEngine:
             while (
                 len(pending) < self.PIPELINE_DEPTH
                 and self._running
+                and not self._draining
                 and self._control.empty()
             ):
                 if not try_extend():
@@ -4330,7 +4345,10 @@ class JaxEngine:
         preserved on disk. Returns the verdict (None = unscored) so the
         autopsy segment can carry the slo_miss flag."""
         if reason in (
-            FinishReason.ERROR, FinishReason.CANCELLED, FinishReason.TIMEOUT
+            FinishReason.ERROR, FinishReason.CANCELLED, FinishReason.TIMEOUT,
+            # a drain handoff is a planned partial segment, not a served
+            # request: the resumed continuation scores on the peer
+            FinishReason.MIGRATE,
         ):
             # infrastructure failures and client disconnects don't
             # score: counting an errored request's fast partial tokens
@@ -4567,6 +4585,78 @@ class JaxEngine:
         self.scheduler.waiting.clear()
 
     # ------------------------------------------------------------------
+    # Graceful drain (runtime/drain.py; docs/robustness.md)
+    # ------------------------------------------------------------------
+    def begin_drain(self) -> None:
+        """Thread-safe: stop admitting and hand off in-flight streams.
+
+        submit() rejects from the next call; the step loop finishes
+        every MIGRATABLE sequence with ``FinishReason.MIGRATE`` at the
+        next step boundary, which the routers turn into a proactive
+        resume on a healthy peer. Ineligible streams (guided,
+        penalty-sampling, opted out — the same set migration.resumable
+        refuses) keep running until they complete or the drain
+        deadline's reactive fallback ends them."""
+        self._draining = True
+        self._wake.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drain_migrated(self) -> int:
+        """Streams handed off with MIGRATE since begin_drain() (feeds
+        dynamo_drain_streams_migrated_total)."""
+        return self._drain_migrated
+
+    def active_streams(self) -> int:
+        """Sequences still attached to a client stream (advisory; the
+        drain coordinator polls this toward zero)."""
+        sched = self.scheduler
+        if sched is None:
+            return 0
+        return sched.num_running + sched.num_waiting
+
+    @staticmethod
+    def _drain_migratable(request) -> bool:
+        """Engine-side mirror of migration.resumable()'s *request*
+        eligibility: only streams the router could actually resume get
+        the MIGRATE handoff — the rest finish naturally or ride the
+        deadline fallback."""
+        if getattr(request, "migration", None) is False:
+            return False
+        if getattr(request, "guided", None) is not None:
+            return False
+        sampling = getattr(request, "sampling", None)
+        if sampling is not None and getattr(sampling, "needs_penalties", False):
+            return False
+        return True
+
+    def _migrate_eligible(self) -> None:
+        """Engine thread: finish every migratable sequence with MIGRATE.
+        Runs each loop iteration while draining, so a submit that raced
+        the flag is swept on the next boundary too."""
+        assert self.scheduler is not None
+        sched = self.scheduler
+        for pool in (sched.running, sched.prefilling, sched.waiting):
+            for seq in list(pool):
+                if not self._drain_migratable(seq.request):
+                    continue
+                try:
+                    if seq in pool:
+                        pool.remove(seq)
+                    sched.finish(seq, FinishReason.MIGRATE)
+                    self._drain_migrated += 1
+                except Exception:
+                    # a failed handoff must not take the engine thread
+                    # down mid-drain: this stream rides the deadline and
+                    # the reactive resume path instead
+                    log.exception(
+                        "drain handoff failed for %s", seq.request_id
+                    )
+
+    # ------------------------------------------------------------------
     # Async interface
     # ------------------------------------------------------------------
     def submit(
@@ -4574,6 +4664,12 @@ class JaxEngine:
     ) -> asyncio.Queue:
         """Thread-safe submit; returns the asyncio output queue."""
         assert self._loop is not None
+        if self._draining:
+            # routers stop placing here the moment the DRAINING flag
+            # lands in discovery; a submit that still arrives (flag
+            # propagation race) must fail fast so the caller's failover
+            # re-dispatches it to a healthy peer
+            raise RuntimeError("engine is draining; not admitting new requests")
         out: asyncio.Queue = asyncio.Queue()
         loop = self._loop
 
@@ -4742,6 +4838,9 @@ class JaxEngine:
             "decode_steps": self.config.decode_steps,
             "block_size": self.config.block_size,
             "tokens_generated_total": self.tokens_generated_total,
+            # graceful drain flag ("top" renders the DRAIN state from
+            # this; absent on older builds → the '-' rule)
+            "draining": self._draining,
         }
         if sched is not None:
             def req_row(seq) -> dict:

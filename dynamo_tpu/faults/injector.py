@@ -13,6 +13,12 @@ the stack's existing failure seams (docs/robustness.md catalogs them):
     kv_transfer.get     disagg KV block delivery, receiver side (async)
     engine.step         one engine device step (sync, engine thread)
     worker.liveness     engine step-loop heartbeat (sync; kill target)
+    store.publish_drain DRAINING-flag publish during graceful drain
+                        (async; ctx: instance — error = routers learn
+                        from lease expiry instead)
+    worker.drain        proactive stream handoff during graceful drain
+                        (async; ctx: instance — stall/error exercises
+                        the drain-deadline reactive fallback)
 
 Hot-path contract: when no plan is active, every hook is a module
 attribute load plus an ``is None`` check — no coroutine creation, no

@@ -83,7 +83,11 @@ class KvRouter:
         scheduler.rs endpoint-watch driven cleanup)."""
         known: set[int] = set()
         while True:
-            live = set(self.client.instance_ids())
+            # the FULL dialable view: a DRAINING worker is alive and
+            # serving its in-flight streams — pruning its index on the
+            # flag (instead of on departure) would misroute the very
+            # resumes the drain is handing off
+            live = set(self.client.instance_ids(include_draining=True))
             for dead in known - live:
                 log.info("pruning dead worker %x from kv index", dead)
                 self.indexer.tree.remove_worker(dead)
@@ -107,7 +111,10 @@ class KvRouter:
         if exclude:
             filtered = [i for i in ids if i not in exclude]
             ids = filtered or ids
-        return self.scheduler.schedule(token_ids, ids, resume=resume)
+        return self.scheduler.schedule(
+            token_ids, ids, resume=resume,
+            draining=self.client.draining_ids(),
+        )
 
     async def close(self) -> None:
         if self._prune_task is not None:

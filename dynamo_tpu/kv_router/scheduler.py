@@ -241,12 +241,29 @@ class KvScheduler:
     def schedule(
         self, token_ids: list[int], candidates: list[int],
         resume: bool = False,
+        draining: Optional[set[int]] = None,
     ) -> SchedulingDecision:
         if not candidates:
             raise RuntimeError("no candidate workers")
         overlaps = self.indexer.find_matches_for_request(token_ids)
         true_overlaps = overlaps
         fleet_blocks = self._fleet_match(token_ids)
+        if draining:
+            # DRAINING workers never take fresh placement (defensive:
+            # the router's candidate list already excludes them; fall
+            # back only if that empties the set entirely)...
+            healthy = [w for w in candidates if w not in draining]
+            candidates = healthy or candidates
+            # ...but their indexed prefixes don't vanish: the drain
+            # publishes/retiers them into the fleet catalog before the
+            # handoff, so count them as FLEET overlap (fetchable by any
+            # candidate at fleet_hit_weight) rather than local — even
+            # when the catalog refresh hasn't landed yet
+            drain_local = max(
+                (overlaps.scores.get(w, 0) for w in draining), default=0
+            )
+            if drain_local > fleet_blocks:
+                fleet_blocks = drain_local
         if fleet_blocks or (resume and overlaps.scores):
             boost = self.resume_overlap_boost if resume else 1.0
             # effective overlap per candidate: local blocks at full

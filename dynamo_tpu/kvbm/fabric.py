@@ -816,6 +816,49 @@ class FleetKvFabric:
             return TIER_SHARED
         return "drop"
 
+    def on_drain(self, max_blocks: Optional[int] = None) -> int:
+        """Graceful-drain handoff (runtime/drain.py): make this worker's
+        hot prefixes outlive it. Hot G2 residents are demoted into the
+        shared bucket — the only tier that survives the process — and
+        their catalog claims retiered, so a resume landing on a peer
+        onboards from G4 instead of recomputing. Cold/private blocks
+        stay put: during the drain window peers can still fetch them
+        from our host tier, and the claims ride our store lease so they
+        vanish cleanly at exit instead of dangling. Engine thread;
+        ``max_blocks`` keeps the sweep deadline-bounded. Returns blocks
+        demoted to the bucket."""
+        m = self.manager
+        if m is None or m.remote is None:
+            return 0
+        hot = sorted(
+            (
+                h for h, meta in self._resident.items()
+                if m.host.contains(h)
+                and meta.touches >= self.pressure.hot_min_touches
+            ),
+            key=lambda h: (
+                -self._resident[h].touches,
+                self._resident[h].last_touch,
+            ),
+        )
+        demoted = 0
+        for h in hot:
+            if max_blocks is not None and demoted >= max_blocks:
+                break
+            routed = m.demote_block(h, TIER_SHARED)
+            self._resident.pop(h, None)
+            if routed == TIER_SHARED:
+                self.catalog.retier(h, TIER_SHARED)
+                self.stats.demoted_shared += 1
+                KVBM_FLEET_DEMOTED_BLOCKS.labels("shared").inc()
+                demoted += 1
+            else:
+                # demotion fell through (bucket write failed / block
+                # raced out) — never leave the claim dangling
+                self.catalog.prune(h)
+                self.stats.pruned_blocks += 1
+        return demoted
+
     # -- introspection --------------------------------------------------------
     def debug_stanza(self) -> dict:
         s = self.stats

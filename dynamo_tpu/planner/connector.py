@@ -59,6 +59,16 @@ class LocalConnector:
             log.warning("remove %s failed: %s", component, r.get("error"))
         return bool(r.get("ok"))
 
+    async def drain_component(self, component: str) -> bool:
+        """Scale down via the drain protocol: the supervisor SIGTERMs
+        the newest replica with the grace widened past the drain
+        deadline, so the worker hands its in-flight streams to peers
+        before exiting (docs/robustness.md "Graceful drain")."""
+        r = await self._command("drain", component)
+        if not r.get("ok"):
+            log.warning("drain %s failed: %s", component, r.get("error"))
+        return bool(r.get("ok"))
+
     async def replicas(self, component: str) -> Optional[int]:
         entry = await self.store.kv_get(state_key(self.namespace))
         if entry is None:
@@ -100,6 +110,12 @@ class KubernetesConnector:
         return await self._patch_replicas(component, +1)
 
     async def remove_component(self, component: str) -> bool:
+        return await self._patch_replicas(component, -1)
+
+    async def drain_component(self, component: str) -> bool:
+        """Kubernetes already drains on scale-down: the pod gets
+        SIGTERM + terminationGracePeriodSeconds, which is exactly the
+        worker's drain path. Delegates to the replica patch."""
         return await self._patch_replicas(component, -1)
 
     async def replicas(self, component: str) -> Optional[int]:

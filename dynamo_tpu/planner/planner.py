@@ -67,6 +67,18 @@ log = logging.getLogger("dynamo_tpu.planner")
 class Connector(Protocol):
     async def add_component(self, component: str) -> bool: ...
     async def remove_component(self, component: str) -> bool: ...
+    # optional: graceful scale-down (docs/robustness.md "Graceful
+    # drain"); connectors without it fall back to remove_component
+
+
+def _drain_or_remove(connector: Any, component: str):
+    """Scale-downs prefer the drain protocol — the departing worker
+    hands its streams off instead of dropping them — and fall back to
+    the hard remove for connectors that predate it."""
+    drain = getattr(connector, "drain_component", None)
+    if drain is not None:
+        return drain(component)
+    return connector.remove_component(component)
 
 
 class DegradationHooks(Protocol):
@@ -249,7 +261,7 @@ class Planner:
         ok = (
             await self.connector.add_component(component)
             if op == "add"
-            else await self.connector.remove_component(component)
+            else await _drain_or_remove(self.connector, component)
         )
         if not ok:
             signal.up_streak = 0
@@ -305,7 +317,7 @@ class Planner:
             if self._surplus_streak < c.reconcile_cycles:
                 return
             self._surplus_streak = 0
-            if await self.connector.remove_component(c.decode_component):
+            if await _drain_or_remove(self.connector, c.decode_component):
                 PLANNER_SCALE_EVENTS.labels(
                     c.decode_component, "drain"
                 ).inc()
@@ -481,3 +493,73 @@ class Planner:
             with contextlib.suppress(asyncio.CancelledError):
                 await self._task
         await self.aggregator.close()
+
+
+async def rolling_restart(
+    connector: Any,
+    component: str,
+    max_unavailable: int = 1,
+    health_timeout_s: float = 120.0,
+    poll_interval_s: float = 1.0,
+    clock: Clock = SYSTEM,
+) -> int:
+    """Cycle every replica of ``component`` through a graceful drain,
+    at most ``max_unavailable`` down at a time (docs/robustness.md
+    "Graceful drain & rolling restarts").
+
+    Each round drains the oldest replica(s) — the worker hands its
+    in-flight streams to peers and exits 0 — spawns replacements, and
+    gates on the reported replica count recovering to the baseline
+    before touching the next one, so a replacement that never comes up
+    healthy stops the rollout instead of cascading into an outage.
+    Returns the number of replicas cycled (== the starting count on a
+    complete rollout).
+    """
+
+    async def _wait_count(target: int) -> bool:
+        deadline = clock.monotonic() + health_timeout_s
+        while clock.monotonic() < deadline:
+            if await connector.replicas(component) == target:
+                return True
+            await clock.sleep(poll_interval_s)
+        return False
+
+    baseline = await connector.replicas(component)
+    if not baseline:
+        log.warning("rolling restart of %s: no replicas reported", component)
+        return 0
+    max_unavailable = max(1, min(max_unavailable, baseline))
+    cycled = 0
+    while cycled < baseline:
+        batch = min(max_unavailable, baseline - cycled)
+        drained = 0
+        for _ in range(batch):
+            if not await _drain_or_remove(connector, component):
+                log.warning(
+                    "rolling restart of %s aborted: drain refused after "
+                    "%d replica(s) cycled", component, cycled,
+                )
+                return cycled
+            drained += 1
+        for _ in range(drained):
+            if not await connector.add_component(component):
+                log.warning(
+                    "rolling restart of %s aborted: replacement spawn "
+                    "refused after %d replica(s) cycled", component, cycled,
+                )
+                return cycled
+        # health gate: the batch's replacements must be UP (reported
+        # count back at baseline) before the next batch goes down
+        if not await _wait_count(baseline):
+            log.warning(
+                "rolling restart of %s aborted: fleet did not return to "
+                "%d replicas within %.0fs (%d cycled)",
+                component, baseline, health_timeout_s, cycled,
+            )
+            return cycled
+        cycled += drained
+        log.info(
+            "rolling restart of %s: %d/%d replica(s) cycled",
+            component, cycled, baseline,
+        )
+    return cycled

@@ -23,6 +23,12 @@ class FinishReason(str, enum.Enum):
     TIMEOUT = "timeout"      # request deadline budget expired
     ERROR = "error"
     CONTENT_FILTER = "content_filter"
+    # drain handoff marker, never client-facing: a draining worker ends
+    # each active stream with this so the router re-dispatches it as a
+    # resume on a healthy peer (runtime/drain.py; docs/robustness.md
+    # "Graceful drain"). The router consumes the chunk — clients only
+    # ever see the continuation's real finish.
+    MIGRATE = "migrate"
 
 
 class SamplingOptions(BaseModel):

@@ -59,6 +59,10 @@ class Instance:
     namespace: str
     component: str
     endpoint: str
+    # graceful drain (docs/robustness.md): a draining instance stays in
+    # the view (in-flight dials keep working) but is excluded from
+    # fresh placement the moment the flag lands — no lease-TTL wait
+    draining: bool = False
 
     @property
     def path(self) -> str:
@@ -154,6 +158,7 @@ def _decode_instance(key: str, value: bytes) -> Instance:
         namespace=ns,
         component=comp,
         endpoint=ep,
+        draining=bool(meta.get("draining", False)),
     )
 
 
@@ -205,6 +210,20 @@ class Endpoint:
         log.info("serving %s as instance %x on port %d", self.path, lid, server.port)
         return instance
 
+    async def set_draining(self, instance: Instance) -> None:
+        """Publish the DRAINING flag by rewriting the instance's
+        discovery entry in place (same key, same lease): every watching
+        Client sees the put immediately and drops the instance from
+        fresh placement while keeping its address dialable for
+        in-flight streams (docs/robustness.md "Graceful drain")."""
+        payload = msgpack.packb(
+            {"host": instance.host, "port": instance.port, "draining": True},
+            use_bin_type=True,
+        )
+        await self.drt.store.kv_put(
+            instance.path, payload, lease_id=instance.instance_id
+        )
+
     # -- client -----------------------------------------------------------
     async def client(self, static_instance: Optional[Instance] = None) -> "Client":
         c = Client(self, static_instance=static_instance)
@@ -239,8 +258,7 @@ class Client:
         for entry in self._watch.snapshot():
             inst = _decode_instance(entry.key, entry.value)
             self.instances[inst.instance_id] = inst
-        if self.instances:
-            self._instances_event.set()
+        self._refresh_event()
         self._watch_task = asyncio.get_running_loop().create_task(self._watch_loop())
 
     async def _watch_loop(self) -> None:
@@ -283,10 +301,7 @@ class Client:
                     fresh[inst.instance_id] = inst
                 self.instances.clear()
                 self.instances.update(fresh)
-                if self.instances:
-                    self._instances_event.set()
-                else:
-                    self._instances_event.clear()
+                self._refresh_event()
                 log.info("instance watch resubscribed (%d live)", len(fresh))
             except Exception:
                 log.exception("instance view resync failed; watch continues")
@@ -295,18 +310,39 @@ class Client:
         if ev.type == "put":
             inst = _decode_instance(ev.entry.key, ev.entry.value)
             self.instances[inst.instance_id] = inst
-            self._instances_event.set()
         elif ev.type == "delete":
             _, _, lease_hex = ev.entry.key.rpartition(":")
             try:
                 self.instances.pop(int(lease_hex, 16), None)
             except ValueError:
                 pass
-            if not self.instances:
-                self._instances_event.clear()
+        self._refresh_event()
 
-    def instance_ids(self) -> list[int]:
-        return sorted(self.instances)
+    def _refresh_event(self) -> None:
+        """The readiness event tracks ROUTABLE (non-draining) instances:
+        waiters must not unblock onto a fleet that is all on its way
+        out."""
+        if any(not i.draining for i in self.instances.values()):
+            self._instances_event.set()
+        else:
+            self._instances_event.clear()
+
+    def instance_ids(self, include_draining: bool = False) -> list[int]:
+        """Instances eligible for FRESH placement. Draining instances
+        are excluded by default — both routers AND the resume path pick
+        from this list, so a resume can never land on a worker that is
+        itself on the way out. ``include_draining=True`` returns the
+        full dialable view (in-flight work, kv-index pruning)."""
+        if include_draining:
+            return sorted(self.instances)
+        return sorted(
+            i for i, inst in self.instances.items() if not inst.draining
+        )
+
+    def draining_ids(self) -> set[int]:
+        return {
+            i for i, inst in self.instances.items() if inst.draining
+        }
 
     async def wait_for_instances(
         self, timeout_s: Optional[float] = None

@@ -1,0 +1,308 @@
+"""Graceful worker drain: planned departure with proactive handoff.
+
+A kill (docs/robustness.md "Mid-stream migration") is *reactive*: the
+router discovers the death from a broken stream, synthesizes the lost
+finish, and replays from its commit log. A drain is *planned* — the
+worker is still healthy — so the departure can be made invisible:
+
+1. publish the DRAINING flag (the instance's discovery entry is
+   rewritten in place on the same key/lease, so every watching Client
+   drops it from fresh placement *immediately* instead of waiting out
+   the lease TTL, while in-flight dials stay alive),
+2. retier hot KV-fabric prefixes into the shared bucket so the blocks
+   outlive the process and resumes onboard instead of recomputing,
+3. hand off every migratable in-flight stream at a step boundary:
+   the engine finishes it with ``FinishReason.MIGRATE``, which the
+   router loop (runtime/migration.py) consumes — never surfacing it to
+   the client — and re-dispatches as a resume with an EXACT commit log
+   (every generated token was already emitted; nothing to synthesize),
+4. wait for the engine to idle under ``--drain-timeout-s``; streams
+   that can't migrate (guided, penalties, opted out) get the window to
+   finish naturally, and past the deadline the worker exits anyway and
+   the reactive machinery catches whatever is left,
+5. deregister (delete the instance key) and let the process exit 0.
+
+``worker.drain`` / ``store.publish_drain`` fault points (faults/
+injector.py) hook the handoff and the flag publish so chaos runs can
+exercise the deadline fallback.
+
+The control side — ``dynamo-tpu drain <worker>`` and the planner's
+scale-down — publishes ``{"op": "drain", "instance": "<hex>"}`` on the
+namespace's worker-control subject; the worker's listener converges
+that onto the same SIGTERM shutdown path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from dynamo_tpu import faults
+from dynamo_tpu.runtime.component import INSTANCE_PREFIX
+
+log = logging.getLogger("dynamo_tpu.drain")
+
+DEFAULT_DRAIN_TIMEOUT_S = 30.0
+
+#: per-namespace pub/sub subject workers listen on for lifecycle ops
+WORKER_CONTROL_SUBJECT = "worker.control"
+
+
+def worker_control_subject(namespace: str) -> str:
+    return f"{namespace}.{WORKER_CONTROL_SUBJECT}"
+
+
+def drain_timeout_from_env(default: float = DEFAULT_DRAIN_TIMEOUT_S) -> float:
+    try:
+        return float(os.environ.get("DYN_DRAIN_TIMEOUT_S", default))
+    except ValueError:
+        return default
+
+
+@dataclass
+class DrainResult:
+    #: "completed" (idle before deadline) | "deadline" (streams left;
+    #: reactive fallback catches them) | "no_peer" (nowhere to hand
+    #: off; served out the window instead of migrating)
+    result: str
+    streams_migrated: int
+    elapsed_s: float
+    fabric_blocks_shared: int = 0
+
+
+class DrainCoordinator:
+    """Runs the drain sequence for one serving worker.
+
+    Built in worker mode (cli/main.py) next to ``endpoint.serve``;
+    ``drain()`` runs after ``wait_shutdown()`` returns — whether that
+    was SIGTERM, a ``worker.drain`` control call, or Ctrl-C — and
+    before ``drt.shutdown()`` revokes the lease.
+    """
+
+    def __init__(
+        self,
+        drt: Any,
+        component: Any,
+        endpoint: Any,
+        instance: Any,
+        engine: Any = None,
+        timeout_s: Optional[float] = None,
+        poll_interval_s: float = 0.05,
+    ):
+        self.drt = drt
+        self.component = component
+        self.endpoint = endpoint
+        self.instance = instance
+        self.engine = engine
+        self.timeout_s = (
+            timeout_s if timeout_s is not None else drain_timeout_from_env()
+        )
+        self.poll_interval_s = poll_interval_s
+
+    async def drain(self) -> DrainResult:
+        from dynamo_tpu.telemetry.instruments import (
+            DRAIN_HANDOFF_SECONDS,
+            DRAIN_STREAMS_MIGRATED,
+            WORKER_DRAINS,
+        )
+
+        t0 = time.monotonic()
+        deadline = t0 + self.timeout_s
+        migrated_before = (
+            self.engine.drain_migrated if self.engine is not None else 0
+        )
+
+        # 1. DRAINING flag — routers stop fresh placement immediately.
+        # A failed publish (store down, injected fault) degrades to the
+        # lease-TTL path the reactive machinery already covers; the
+        # drain itself proceeds.
+        try:
+            if faults.ACTIVE is not None:
+                await faults.ACTIVE.fire_async(
+                    "store.publish_drain",
+                    instance=f"{self.instance.instance_id:x}",
+                )
+            await self.endpoint.set_draining(self.instance)
+        except Exception as exc:
+            log.warning(
+                "drain: DRAINING publish failed (%s); routers will "
+                "learn from lease expiry instead", exc,
+            )
+
+        # 2. KV fabric: push hot prefixes into the shared bucket so the
+        # resumes this drain is about to hand off onboard cheaply on
+        # the peer (and survive our exit). Fabric is engine-thread
+        # affine; call_on_thread work drains even while draining.
+        blocks_shared = 0
+        fabric = self._fabric()
+        if fabric is not None and self.engine is not None:
+            try:
+                blocks_shared = await asyncio.wait_for(
+                    self.engine.acall_on_thread(fabric.on_drain),
+                    timeout=max(1.0, self.timeout_s / 3),
+                )
+            except Exception as exc:
+                log.warning("drain: fabric handoff skipped: %s", exc)
+
+        # 3. Peer check: with no healthy non-draining peer there is
+        # nobody to migrate onto — MIGRATE handoffs would only bounce.
+        # Serve out the window instead and let the deadline cap it.
+        has_peer = await self._has_healthy_peer()
+
+        result = "completed"
+        if self.engine is not None:
+            active0 = self.engine.active_streams()
+            if has_peer:
+                try:
+                    if faults.ACTIVE is not None:
+                        await faults.ACTIVE.fire_async(
+                            "worker.drain",
+                            instance=f"{self.instance.instance_id:x}",
+                        )
+                    self.engine.begin_drain()
+                except Exception as exc:
+                    # injected stall/error in the handoff: skip the
+                    # proactive sweep — the deadline fallback (and the
+                    # routers' reactive resume after exit) take over
+                    log.warning("drain: proactive handoff failed: %s", exc)
+                    result = "deadline"
+            if not await self._wait_idle(deadline):
+                result = "deadline"
+            if not has_peer and active0 > 0:
+                # streams were live with nowhere to hand them: the
+                # window served what it could, the rest is on the
+                # reactive path — distinct failure mode for operators
+                result = "no_peer"
+
+        migrated = (
+            self.engine.drain_migrated - migrated_before
+            if self.engine is not None
+            else 0
+        )
+        elapsed = time.monotonic() - t0
+
+        WORKER_DRAINS.labels(result).inc()
+        DRAIN_HANDOFF_SECONDS.observe(elapsed)
+        if migrated:
+            DRAIN_STREAMS_MIGRATED.inc(migrated)
+
+        # 5. Deregister: the watchers see a delete (not a TTL lapse) so
+        # the instance disappears the moment we stop serving.
+        try:
+            await self.drt.store.kv_delete(self.instance.path)
+        except Exception as exc:
+            log.warning("drain: deregister failed (%s); lease revoke "
+                        "at shutdown cleans up", exc)
+
+        log.info(
+            "drain %s: %s in %.2fs (%d stream(s) migrated, %d block(s) "
+            "to shared)", f"{self.instance.instance_id:x}", result,
+            elapsed, migrated, blocks_shared,
+        )
+        return DrainResult(
+            result=result,
+            streams_migrated=migrated,
+            elapsed_s=elapsed,
+            fabric_blocks_shared=blocks_shared,
+        )
+
+    def _fabric(self) -> Any:
+        eng = self.engine
+        kvbm = getattr(eng, "kvbm", None) if eng is not None else None
+        return getattr(kvbm, "fabric", None) if kvbm is not None else None
+
+    async def _has_healthy_peer(self) -> bool:
+        try:
+            instances = await self.component.list_instances()
+        except Exception as exc:
+            log.warning("drain: peer listing failed: %s", exc)
+            return False
+        me = self.instance.instance_id
+        return any(
+            i.instance_id != me and not i.draining for i in instances
+        )
+
+    async def _wait_idle(self, deadline: float) -> bool:
+        """Poll the engine toward zero attached streams. True = idle."""
+        assert self.engine is not None
+        while True:
+            if self.engine.active_streams() == 0:
+                return True
+            if time.monotonic() >= deadline:
+                log.warning(
+                    "drain deadline: %d stream(s) still active; the "
+                    "reactive resume path takes over after exit",
+                    self.engine.active_streams(),
+                )
+                return False
+            await asyncio.sleep(self.poll_interval_s)
+
+
+async def serve_drain_control(
+    drt: Any, namespace: str, instance: Any, runtime: Any
+) -> None:
+    """Worker-side listener for ``worker.drain`` control calls.
+
+    A matching ``{"op": "drain", "instance": "<hex>"}`` (or one with no
+    instance — "drain whoever hears this") converges onto the SIGTERM
+    path by setting the runtime shutdown event; worker mode then runs
+    the DrainCoordinator before exiting. Acks on ``reply_to`` when the
+    caller asked for one.
+    """
+    sub = await drt.store.subscribe(worker_control_subject(namespace))
+    me = f"{instance.instance_id:x}"
+    async for _subject, payload in sub:
+        try:
+            cmd = json.loads(payload.decode())
+        except Exception:
+            log.warning("malformed worker-control payload: %r", payload[:80])
+            continue
+        if cmd.get("op") != "drain":
+            continue
+        target = cmd.get("instance")
+        if target is not None and str(target).lower() != me:
+            continue
+        log.info("drain requested via control call")
+        reply_to = cmd.get("reply_to")
+        if reply_to:
+            try:
+                await drt.store.publish(
+                    reply_to,
+                    json.dumps({"ok": True, "instance": me}).encode(),
+                )
+            except Exception:
+                pass
+        runtime.shutdown()
+
+
+async def request_drain(
+    store: Any,
+    namespace: str,
+    instance_hex: str,
+    timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S + 15.0,
+    poll_interval_s: float = 0.25,
+) -> bool:
+    """Client side of ``dynamo-tpu drain <worker>`` / planner scale-down:
+    publish the control call, then poll discovery until the instance
+    key disappears (the worker deletes it as its last act). True iff
+    the worker departed within ``timeout_s``."""
+    target = instance_hex.lower().lstrip("0x") or "0"
+    await store.publish(
+        worker_control_subject(namespace),
+        json.dumps({"op": "drain", "instance": target}).encode(),
+    )
+    suffix = f":{target}"
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        entries = await store.kv_get_prefix(
+            f"{INSTANCE_PREFIX}/{namespace}/"
+        )
+        if not any(e.key.endswith(suffix) for e in entries):
+            return True
+        await asyncio.sleep(poll_interval_s)
+    return False

@@ -336,6 +336,10 @@ async def migrating_stream(
     death_t: Optional[float] = None  # first loss of the active migration
     death_instance: Optional[int] = None  # the worker that loss took
     resumes = 0
+    # why the active migration window opened: "crash" (worker died under
+    # us) vs "drain" (the worker handed the stream off on purpose) —
+    # stamped on the resume_splice autopsy event
+    loss_reason = "crash"
 
     def _abort(
         exc: Exception, detail: Optional[str] = None
@@ -428,8 +432,20 @@ async def migrating_stream(
             if attempt and not resume:
                 span.set_attr("retries", attempt)
         segment_tokens = False
+        drain_handoff = False
         try:
             async for item in stream:
+                fr = _get(item, "finish_reason")
+                if fr is not None and str(getattr(fr, "value", fr)) == "migrate":
+                    # drain handoff sentinel (docs/robustness.md
+                    # "Graceful drain"): the worker is leaving on
+                    # purpose and ended the stream at a step boundary
+                    # with every generated token already flushed, so
+                    # the commit log below is EXACT. Consume the marker
+                    # — it is never client-facing — and re-dispatch as
+                    # a resume on a healthy peer.
+                    drain_handoff = True
+                    break
                 has_tokens = bool(_get(item, "token_ids"))
                 if resume and has_tokens and death_t is not None:
                     # the splice is live: the continuation's first TOKEN
@@ -448,6 +464,7 @@ async def migrating_stream(
                     # ends and the survivor's begins
                     autopsy.note_event(
                         context.id, "resume_splice", flag="migrated",
+                        reason=loss_reason,
                         from_worker=(
                             f"{death_instance:x}"
                             if death_instance is not None else ""
@@ -461,6 +478,7 @@ async def migrating_stream(
                     )
                     death_t = None
                     death_instance = None
+                    loss_reason = "crash"
                     attempt = 0
                     backoff.reset()
                 segment_tokens = segment_tokens or has_tokens
@@ -468,7 +486,8 @@ async def migrating_stream(
                 if progress is not None:
                     item = progress.note(item)
                 yield item
-            return
+            if not drain_handoff:
+                return
         except asyncio.CancelledError:
             raise
         except _STREAM_ERRORS as exc:
@@ -540,3 +559,60 @@ async def migrating_stream(
         finally:
             if done_cb is not None:
                 done_cb()
+
+        # -- drain handoff (reached only via the sentinel break) ----------
+        # The draining worker is excluded for the rest of this stream; a
+        # healthy peer takes the resume. No backoff: this is a PLANNED
+        # handoff — the fleet has capacity by construction, and every
+        # waiting millisecond is client-visible gap.
+        exclude.add(instance_id)
+        loss_reason = "drain"
+        if not started:
+            # nothing delivered yet: replay from scratch on a peer
+            attempt += 1
+            if attempt >= max_attempts:
+                raise RuntimeError(
+                    f"all attempts failed for {endpoint_name}: "
+                    "worker drained before first item"
+                )
+            FAILOVER_RETRIES.inc()
+            autopsy.note_event(
+                context.id, "failover_retry", worker=f"{instance_id:x}",
+                attempt=attempt, reason="drain",
+            )
+            continue
+        if progress is None:
+            # tokens delivered but this stream cannot resume (migration
+            # disabled frontend-side / ineligible shape): same clean
+            # abort a crash would produce
+            raise _abort(RuntimeError("worker drained mid-stream"))
+        if segment_tokens:
+            attempt = 0
+            backoff.reset()
+        else:
+            # a resume that spliced nothing before the NEXT handoff
+            # still burns resume budget — the same no-progress guard
+            # the crash path applies
+            attempt += 1
+            if attempt >= cfg.max_resumes:
+                raise _abort(RuntimeError("worker drained mid-stream"))
+        if death_t is None:
+            death_t = time.monotonic()
+        if death_instance is None:
+            death_instance = instance_id
+        autopsy.note_event(
+            context.id, "drain_handoff", worker=f"{instance_id:x}",
+            delivered=len(progress.emitted),
+        )
+        left = progress.budget_left()
+        if left is not None and left <= 0:
+            # the handoff raced the length finish: the full budget was
+            # delivered, only the finish marker remains
+            yield progress.synthesize_final("length")
+            return
+        log.info(
+            "instance %x draining; migrating %s after %d token(s)",
+            instance_id, context.id, len(progress.emitted),
+        )
+        cur_req = progress.resume_request()
+        continue

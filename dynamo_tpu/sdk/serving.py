@@ -98,7 +98,9 @@ class Supervisor:
         log.info("spawned %s (pid %d, chips %s)", name, proc.pid, alloc.chip_ids)
         return child
 
-    async def _stop_child(self, name: str, sig: int = signal.SIGTERM) -> None:
+    async def _stop_child(
+        self, name: str, sig: int = signal.SIGTERM, grace_s: float = 15
+    ) -> None:
         child = self._children.pop(name, None)
         if child is None:
             return
@@ -106,7 +108,7 @@ class Supervisor:
         if child.proc.returncode is None:
             child.proc.send_signal(sig)
             try:
-                await asyncio.wait_for(child.proc.wait(), timeout=15)
+                await asyncio.wait_for(child.proc.wait(), timeout=grace_s)
             except asyncio.TimeoutError:
                 child.proc.kill()
                 await child.proc.wait()
@@ -136,6 +138,25 @@ class Supervisor:
                 await self._stop_child(names[-1])  # newest first
                 await self._publish_state()
                 return {"ok": True, "name": names[-1]}
+            if op == "drain":
+                # graceful scale-down (docs/robustness.md "Graceful
+                # drain"): same SIGTERM as remove — the worker's own
+                # handler runs the drain protocol — but with the grace
+                # widened past the drain deadline so a busy worker
+                # hands its streams off instead of being killed at 15s.
+                # Retires the OLDEST replica (remove trims the newest):
+                # that is what lets rolling_restart cycle the whole
+                # fleet instead of re-restarting its own replacements.
+                names = self.replicas(comp)
+                if not names:
+                    return {"ok": False, "error": f"no replicas of {comp!r}"}
+                from dynamo_tpu.runtime.drain import drain_timeout_from_env
+
+                await self._stop_child(
+                    names[0], grace_s=drain_timeout_from_env() + 15
+                )
+                await self._publish_state()
+                return {"ok": True, "name": names[0]}
             if op == "state":
                 return {"ok": True, "state": self._state()}
             raise ValueError(f"unknown op {op!r}")

@@ -71,6 +71,23 @@ class SimConfig:
     # the cheap onboard rate instead of a full re-prefill. Drawn from a
     # per-resume seeded stream so replays stay bit-identical.
     resume_cache_hot_frac: float = 0.0
+    # graceful drain (docs/robustness.md "Graceful drain & rolling
+    # restarts"): a worker.drain fault hands every active stream off at
+    # a step boundary — zero lost finish to synthesize, and because the
+    # departing worker pre-publishes its KV catalog entries the resume
+    # pays only the handoff latency plus an onboard-rate re-prefill
+    # (vs a kill's full recompute). drain_proactive additionally routes
+    # planner scale-downs through the migrating drain instead of the
+    # stop-admitting-and-wait removal (off by default so existing
+    # seeded runs stay bit-identical).
+    drain_handoff_s: float = 0.05
+    drain_proactive: bool = False
+    # reactive-path detection latency: a KILLED worker's streams are
+    # only re-dispatched once the router notices the death (stream
+    # error + failover backoff) — the asymmetry the drain protocol
+    # removes. 0 (default) keeps the pre-drain instantaneous-requeue
+    # model, so existing seeded runs stay bit-identical.
+    kill_detect_s: float = 0.0
     # injected stalls multiply decode latency by this until they lapse
     stall_factor: float = 4.0
     # ladder tightening: level>=1 scales the admission caps, level 3
@@ -152,6 +169,22 @@ class SimConnector:
             f._remove_worker(victim.wid)
         return True
 
+    async def drain_component(self, component: str) -> bool:
+        """The planner's graceful scale-down. With ``drain_proactive``
+        the victim migrates its active streams through the drain
+        protocol (zero lost tokens, onboard-rate resumes); off (the
+        default) it falls back to remove_component's stop-admitting-
+        and-wait behavior so existing seeded runs stay bit-identical."""
+        f = self.fleet
+        if component == f.prefill_component or not f.config.drain_proactive:
+            return await self.remove_component(component)
+        candidates = [w for w in f.workers.values() if not w.draining]
+        if not candidates:
+            return False
+        victim = min(candidates, key=lambda w: (w.occupancy, -w.wid))
+        f._drain_worker(victim.wid)
+        return True
+
 
 class FleetSim:
     def __init__(
@@ -207,6 +240,8 @@ class FleetSim:
         self.met = 0
         self.goodput_tokens = 0
         self.workers_killed = 0
+        self.workers_drained = 0  # planned departures (drain protocol)
+        self.drained_inflight = 0  # streams handed off by drains
         self.workers_spawned = 0
         self.step_errors = 0
         self.degradation_level = 0
@@ -395,10 +430,59 @@ class FleetSim:
             else:
                 # failover replays pay a full re-prefill, like live
                 rec.resume_hot = False
-            self._prefill_queue.append(rec)
-            requeued = True
+            if self.config.kill_detect_s > 0:
+                self.loop.after(
+                    self.config.kill_detect_s, self._requeue_resume, rec
+                )
+            else:
+                self._prefill_queue.append(rec)
+                requeued = True
         if requeued:
             self._drain_prefill()
+
+    def _drain_worker(self, wid: int) -> None:
+        """Graceful counterpart of ``_kill_worker``: the worker hands
+        every active stream off at a step boundary. Delivered tokens
+        stay delivered (same commit-log math as a kill, but nothing to
+        synthesize), and because the departing worker pre-publishes its
+        KV catalog entries the resume always rides the onboard rate —
+        the kill path's full recompute is exactly the cost this
+        protocol exists to avoid. Each resume re-enters prefill after
+        ``drain_handoff_s`` (flag publish + MIGRATE + re-dispatch)."""
+        w = self.workers.pop(wid, None)
+        if w is None:
+            return
+        self.workers_drained += 1
+        now = self.loop.now
+        for rid in list(w.active):
+            rec = self._inflight.get(rid)
+            if rec is None:
+                continue
+            self.drained_inflight += 1
+            seg = 0
+            if rec.itl > 0 and now > rec.decode_start_t:
+                seg = int((now - rec.decode_start_t) / rec.itl)
+            remaining_before = rec.req.output_tokens - rec.emitted
+            rec.emitted += max(0, min(seg, remaining_before - 1))
+            if rec.emitted > 0:
+                rec.resumed_n += 1
+                self.resumed += 1
+                self.resumed_hot += 1
+            else:
+                # drained before the first token: replayed from scratch
+                # (TTFT recomputes), like the live pre-first-token path
+                self.refailed += 1
+            rec.worker = -1  # invalidates the pending finish event
+            rec.resume_hot = True
+            self.loop.after(
+                self.config.drain_handoff_s, self._requeue_resume, rec
+            )
+
+    def _requeue_resume(self, rec: _InFlight) -> None:
+        if rec.req.rid not in self._inflight:
+            return
+        self._prefill_queue.append(rec)
+        self._drain_prefill()
 
     # -- request lifecycle --------------------------------------------------
 
@@ -611,6 +695,11 @@ class FleetSim:
             ):
                 if rule.kind == "kill":
                     self._kill_worker(wid)
+            # planned departure: any rule at worker.drain runs the
+            # graceful protocol on this worker (the kill-vs-drain A/B
+            # fires the same schedule at both points and diffs the dip)
+            for rule in self.faults.due(now, "worker.drain", worker=f"w{wid}"):
+                self._drain_worker(wid)
         if now + self.config.heartbeat_interval_s <= self.horizon:
             self.loop.after(self.config.heartbeat_interval_s, self._heartbeat)
 
@@ -660,6 +749,8 @@ class FleetSim:
             "goodput_tok_s": self.goodput_tokens / max(1e-9, self.loop.now),
             "workers_spawned": self.workers_spawned,
             "workers_killed": self.workers_killed,
+            "workers_drained": self.workers_drained,
+            "drained_inflight": self.drained_inflight,
             "step_errors": self.step_errors,
             "faults_fired": len(self.faults.fired),
             "degradation_level": self.degradation_level,

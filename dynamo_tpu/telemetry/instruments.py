@@ -492,6 +492,29 @@ RESUME_SECONDS = REGISTRY.histogram(
     "buckets)",
     buckets=_STEP_BUCKETS,
 )
+WORKER_DRAINS = REGISTRY.counter(
+    "dynamo_worker_drains_total",
+    "Graceful drains run by this worker (runtime/drain.py), by result: "
+    "completed = every eligible stream handed off inside the deadline, "
+    "deadline = the --drain-timeout-s budget expired and leftover "
+    "streams fell back to the reactive abort/resume path, no_peer = no "
+    "healthy peer existed so the worker served until done or deadline "
+    "instead of migrating",
+    labels=("result",),  # completed | deadline | no_peer
+)
+DRAIN_HANDOFF_SECONDS = REGISTRY.histogram(
+    "dynamo_drain_handoff_seconds",
+    "Wall time of one graceful drain's handoff phase: DRAINING flag "
+    "published to the moment the last eligible stream left the engine "
+    "(deadline-capped; docs/robustness.md 'Graceful drain')",
+    buckets=_STEP_BUCKETS,
+)
+DRAIN_STREAMS_MIGRATED = REGISTRY.counter(
+    "dynamo_drain_streams_migrated_total",
+    "Active streams a graceful drain proactively handed off with the "
+    "MIGRATE marker (each becomes a reason=drain resume splice on its "
+    "router)",
+)
 
 # -- autoscaling planner (planner/planner.py; docs/autoscaling.md) ----------
 PLANNER_SCALE_EVENTS = REGISTRY.counter(

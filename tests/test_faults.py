@@ -340,3 +340,139 @@ async def test_point_router_resume_fires_before_resume_dispatch():
         assert dials == [False]
     finally:
         faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20 points: store.publish_drain / worker.drain through the real
+# DrainCoordinator call sites (runtime/drain.py)
+# ---------------------------------------------------------------------------
+
+
+class _DrainInstance:
+    def __init__(self, iid: int = 0xABC):
+        self.instance_id = iid
+        self.path = f"instances/ns/comp/ep:{iid:x}"
+        self.draining = False
+
+
+class _DrainStore:
+    def __init__(self):
+        self.deleted = []
+
+    async def kv_delete(self, key):
+        self.deleted.append(key)
+        return True
+
+
+class _DrainDrt:
+    def __init__(self):
+        self.store = _DrainStore()
+
+
+class _DrainEndpoint:
+    def __init__(self):
+        self.drained = []
+
+    async def set_draining(self, instance):
+        self.drained.append(instance)
+
+
+class _DrainComponent:
+    def __init__(self, instances):
+        self._instances = instances
+
+    async def list_instances(self):
+        return self._instances
+
+
+class _DrainEngine:
+    kvbm = None
+
+    def __init__(self, active: int = 0):
+        self._active = active
+        self.drain_begun = False
+        self.drain_migrated = 0
+
+    def active_streams(self):
+        return self._active
+
+    def begin_drain(self):
+        # proactive sweep: everything migratable hands off immediately
+        self.drain_begun = True
+        self.drain_migrated += self._active
+        self._active = 0
+
+
+def _drain_coordinator(engine, peers=None, timeout_s=0.2):
+    from dynamo_tpu.runtime.drain import DrainCoordinator
+
+    me = _DrainInstance()
+    peer = _DrainInstance(0xDEF)
+    return DrainCoordinator(
+        _DrainDrt(),
+        _DrainComponent([me, peer] if peers is None else peers),
+        _DrainEndpoint(),
+        me,
+        engine=engine,
+        timeout_s=timeout_s,
+        poll_interval_s=0.01,
+    )
+
+
+async def test_point_store_publish_drain_degrades_flag_publish():
+    """An injected store.publish_drain error must NOT abort the drain:
+    the DRAINING publish is skipped (routers fall back to lease expiry)
+    but the handoff, wait, and deregistration all still run."""
+    coord = _drain_coordinator(_DrainEngine(active=2))
+    faults.activate(parse_plan("seed=0;store.publish_drain:error@max=1"))
+    res = await coord.drain()
+    assert res.result == "completed"
+    assert res.streams_migrated == 2
+    assert coord.endpoint.drained == []  # publish was the injected fault
+    assert coord.drt.store.deleted == [coord.instance.path]  # still deregisters
+
+
+async def test_point_worker_drain_forces_deadline_fallback():
+    """An injected worker.drain error skips the proactive MIGRATE sweep;
+    with streams still attached the coordinator rides the deadline and
+    reports the reactive-fallback outcome."""
+    eng = _DrainEngine(active=1)
+    coord = _drain_coordinator(eng, timeout_s=0.1)
+    faults.activate(parse_plan("seed=0;worker.drain:error@max=1"))
+    res = await coord.drain()
+    assert res.result == "deadline"
+    assert not eng.drain_begun
+    assert res.streams_migrated == 0
+    # deregistration is unconditional — reactive path needs the key gone
+    assert coord.drt.store.deleted == [coord.instance.path]
+
+
+async def test_drain_clean_when_no_fault_active():
+    """Baseline for the two tests above: same coordinator, no plan."""
+    coord = _drain_coordinator(_DrainEngine(active=3))
+    res = await coord.drain()
+    assert res.result == "completed"
+    assert res.streams_migrated == 3
+    assert len(coord.endpoint.drained) == 1
+
+
+def test_drain_points_have_independent_seeded_streams():
+    """The two new points draw from per-rule seeded streams like every
+    other point: same plan → same pattern, and the two points' streams
+    are independent of each other."""
+    def pattern(point: str) -> list[bool]:
+        inj = faults.FaultInjector(parse_plan(
+            "seed=9;worker.drain:error@p=0.5;store.publish_drain:error@p=0.5"
+        ))
+        out = []
+        for _ in range(64):
+            try:
+                inj.fire(point)
+                out.append(False)
+            except FaultInjectedError:
+                out.append(True)
+        return out
+
+    assert pattern("worker.drain") == pattern("worker.drain")
+    assert pattern("store.publish_drain") == pattern("store.publish_drain")
+    assert pattern("worker.drain") != pattern("store.publish_drain")

@@ -105,6 +105,10 @@ _REQUIRED_SERIES = [
     "dynamo_autopsy_requests_total",
     "dynamo_autopsy_exemplars",
     "dynamo_autopsy_segments_total",
+    # ISSUE 20: graceful drain (runtime/drain.py, docs/robustness.md)
+    "dynamo_worker_drains_total",
+    "dynamo_drain_handoff_seconds",
+    "dynamo_drain_streams_migrated_total",
 ]
 
 
